@@ -1,0 +1,2 @@
+"""Re-export of ops.pad for nn.functional (paddle exposes pad in both)."""
+from .ops.manipulation import pad  # noqa: F401

@@ -1,0 +1,17 @@
+"""AMP (reference: python/paddle/amp/ — auto_cast.py:462 amp_guard, :1029
+auto_cast, grad_scaler.py:657 GradScaler, amp_lists.py white/black lists).
+
+TPU-native notes: bf16 is the native low-precision dtype (no loss scaling
+strictly needed — GradScaler becomes a cheap pass-through that still
+implements the full found_inf protocol for float16 parity). O1 casting
+hooks the single ``apply_op`` dispatch point instead of per-op generated AMP
+blocks (eager_gen.py:589).
+"""
+from .auto_cast import (auto_cast, amp_guard, decorate, amp_decorate,
+                        is_float16_supported, is_bfloat16_supported,
+                        WHITE_LIST, BLACK_LIST, amp_state)
+from .grad_scaler import GradScaler, AmpScaler
+from . import debugging  # noqa: F401
+
+__all__ = ["auto_cast", "amp_guard", "decorate", "GradScaler", "AmpScaler",
+           "is_float16_supported", "is_bfloat16_supported", "debugging"]

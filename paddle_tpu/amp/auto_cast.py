@@ -1,0 +1,132 @@
+"""auto_cast / decorate — O1 (op-level autocast) and O2 (model cast).
+
+Reference: python/paddle/amp/auto_cast.py:462 (amp_guard), :1029 (auto_cast);
+op lists python/paddle/amp/amp_lists.py. The O1 cast hook lives in
+framework.tensor.apply_op via ``maybe_autocast_inputs``.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Optional, Set
+
+import jax.numpy as jnp
+
+from ..framework.dtype import to_dtype
+from ..framework.tensor import Tensor, no_grad
+
+# ops whose inputs are cast to the low-precision dtype under O1
+# (FP16_WHITE_LIST in amp_lists.py: matmul-class + conv-class)
+WHITE_LIST: Set[str] = {
+    "matmul", "bmm", "mm", "mv", "linear", "conv1d", "conv2d", "conv3d",
+    "conv1d_transpose", "conv2d_transpose", "conv3d_transpose", "einsum",
+    "addmm", "sdpa", "flash_attention", "flash_attn_unpadded",
+}
+
+# ops forced to float32 under O1 (FP16_BLACK_LIST: numerically sensitive)
+BLACK_LIST: Set[str] = {
+    "exp", "log", "log2", "log10", "log1p", "pow", "square", "sqrt", "rsqrt",
+    "softmax", "log_softmax", "cross_entropy", "nll_loss", "kl_div",
+    "binary_cross_entropy", "bce_with_logits", "mse_loss", "l1_loss",
+    "mean", "sum", "p_norm", "cumsum", "logsumexp", "erf", "erfinv",
+    "layer_norm", "bn_stats", "batch_norm", "group_norm", "rms_norm",
+    "softmax_with_cross_entropy", "sigmoid_focal_loss",
+}
+
+_state = threading.local()
+
+
+class _AmpState:
+    __slots__ = ("enable", "dtype", "level")
+
+    def __init__(self, enable, dtype, level):
+        self.enable = enable
+        self.dtype = dtype
+        self.level = level
+
+
+def amp_state() -> Optional[_AmpState]:
+    return getattr(_state, "amp", None)
+
+
+def maybe_autocast_inputs(op_name: str, arrs):
+    """Called by apply_op: cast input arrays per O1 lists. Returns the
+    (possibly) cast list."""
+    st = amp_state()
+    if st is None or not st.enable or st.level != "O1":
+        return arrs
+    if op_name in WHITE_LIST:
+        tgt = st.dtype
+        return [a.astype(tgt)
+                if hasattr(a, "dtype") and a.dtype == jnp.float32 else a
+                for a in arrs]
+    if op_name in BLACK_LIST:
+        return [a.astype(jnp.float32)
+                if hasattr(a, "dtype") and a.dtype in (jnp.float16,
+                                                       jnp.bfloat16) else a
+                for a in arrs]
+    return arrs
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16", use_promote=True):
+    """paddle.amp.auto_cast analog. Default low dtype on TPU is bfloat16."""
+    if level not in ("O0", "O1", "O2"):
+        raise ValueError(f"level must be O0/O1/O2, got {level}")
+    added_w, added_b = set(), set()
+    if custom_white_list:
+        added_w = set(custom_white_list) - WHITE_LIST
+        WHITE_LIST.update(added_w)
+    if custom_black_list:
+        added_b = set(custom_black_list) - BLACK_LIST
+        BLACK_LIST.update(added_b)
+    prev = amp_state()
+    _state.amp = _AmpState(enable and level != "O0",
+                           to_dtype(dtype).np_dtype, level)
+    try:
+        yield
+    finally:
+        _state.amp = prev
+        WHITE_LIST.difference_update(added_w)
+        BLACK_LIST.difference_update(added_b)
+
+
+amp_guard = auto_cast
+
+
+_FP32_KEEP_LAYERS = ("BatchNorm", "LayerNorm", "GroupNorm", "InstanceNorm",
+                     "RMSNorm")
+
+
+def decorate(models, optimizers=None, level="O2", dtype="bfloat16",
+             master_weight=None, save_dtype=None, master_grad=False):
+    """O2: cast model params to the low dtype, keeping norm layers fp32
+    (reference auto_cast.py amp_decorate). Optimizer master weights are
+    handled by the Adam-family `multi_precision` path."""
+    if level == "O1":
+        return (models, optimizers) if optimizers is not None else models
+    nd = to_dtype(dtype).np_dtype
+    model_list = models if isinstance(models, (list, tuple)) else [models]
+    with no_grad():
+        for model in model_list:
+            for layer in model.sublayers(include_self=True):
+                if any(k in type(layer).__name__ for k in _FP32_KEEP_LAYERS):
+                    continue
+                for p in layer._parameters.values():
+                    if p is not None and p._data.dtype == jnp.float32:
+                        p._data = p._data.astype(nd)
+    if optimizers is not None:
+        return models, optimizers
+    return models
+
+
+amp_decorate = decorate
+
+
+def is_float16_supported(device=None) -> bool:
+    return True
+
+
+def is_bfloat16_supported(device=None) -> bool:
+    return True

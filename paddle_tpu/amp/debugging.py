@@ -1,0 +1,75 @@
+"""Numerical debugging (reference: python/paddle/amp/debugging.py —
+check_numerics, tensor stats; plus FLAGS_check_nan_inf hooks in
+fluid/eager/nan_inf_utils.cc which here live in framework.tensor.apply_op)."""
+from __future__ import annotations
+
+import contextlib
+from enum import Enum
+from typing import List, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.flags import set_flags
+from ..framework.tensor import Tensor
+
+__all__ = ["enable_operator_stats_collection", "check_numerics",
+           "enable_tensor_checker", "disable_tensor_checker",
+           "collect_operator_numerical_stats", "DebugMode",
+           "TensorCheckerConfig"]
+
+
+class DebugMode(Enum):
+    CHECK_NAN_INF_AND_ABORT = 0
+    CHECK_NAN_INF = 1
+    CHECK_ALL = 2
+
+
+class TensorCheckerConfig:
+    def __init__(self, enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+                 output_dir=None, checked_op_list=None,
+                 skipped_op_list=None):
+        self.enable = enable
+        self.debug_mode = debug_mode
+
+
+def enable_tensor_checker(config: TensorCheckerConfig):
+    set_flags({"FLAGS_check_nan_inf": config.enable})
+    set_flags({"FLAGS_check_nan_inf_level":
+               0 if config.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT
+               else 1})
+
+
+def disable_tensor_checker():
+    set_flags({"FLAGS_check_nan_inf": False})
+
+
+def check_numerics(tensor: Tensor, op_type: str = "", var_name: str = "",
+                   debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT):
+    """Return (num_nan, num_inf, num_zero) stats; abort per mode."""
+    a = tensor._data
+    n_nan = int(jnp.isnan(a).sum())
+    n_inf = int(jnp.isinf(a).sum())
+    n_zero = int((a == 0).sum())
+    if n_nan or n_inf:
+        msg = (f"[check_numerics] op={op_type} var={var_name}: "
+               f"{n_nan} NaN, {n_inf} Inf")
+        if debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT:
+            raise FloatingPointError(msg)
+        print("WARNING:", msg)
+    return (Tensor(jnp.asarray(n_nan)), Tensor(jnp.asarray(n_inf)),
+            Tensor(jnp.asarray(n_zero)))
+
+
+@contextlib.contextmanager
+def enable_operator_stats_collection():
+    stats: List[Tuple[str, str]] = []
+    yield stats
+
+
+def collect_operator_numerical_stats(tensor: Tensor):
+    a = np.asarray(tensor._data, dtype=np.float64)
+    return {"min": float(a.min()), "max": float(a.max()),
+            "mean": float(a.mean()),
+            "num_nan": int(np.isnan(a).sum()),
+            "num_inf": int(np.isinf(a).sum())}

@@ -1,0 +1,9 @@
+"""Autograd public API (reference: python/paddle/autograd/ — backward,
+paddle.grad via egr::Grad /root/reference/paddle/fluid/eager/general_grad.h,
+PyLayer python/paddle/autograd/py_layer.py)."""
+from .backward_api import backward, grad
+from .py_layer import PyLayer, PyLayerContext
+from ..framework.tensor import no_grad, enable_grad, set_grad_enabled
+
+__all__ = ["backward", "grad", "PyLayer", "PyLayerContext", "no_grad",
+           "enable_grad", "set_grad_enabled"]

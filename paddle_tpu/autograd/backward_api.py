@@ -1,0 +1,65 @@
+"""paddle.autograd.backward / paddle.grad analogs.
+
+Reference: egr::Backward (/root/reference/paddle/fluid/eager/backward.cc:439)
+and egr::Grad (general_grad.h). ``grad`` runs the same engine but captures
+grads for exactly the requested inputs without touching ``.grad``.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+from ..framework.tensor import Tensor, run_backward
+
+
+def _as_list(x):
+    if x is None:
+        return None
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def backward(tensors, grad_tensors=None, retain_graph=False):
+    tensors = _as_list(tensors)
+    grad_tensors = _as_list(grad_tensors)
+    run_backward(tensors, grad_tensors, retain_graph)
+
+
+def grad(outputs, inputs, grad_outputs=None, retain_graph=None,
+         create_graph=False, only_inputs=True, allow_unused=False,
+         no_grad_vars=None) -> List[Optional[Tensor]]:
+    """paddle.grad analog: returns grads of ``outputs`` w.r.t ``inputs``.
+
+    Implementation: snapshot each input's ``.grad``, run the engine with
+    ``_retain_grad`` forced on the inputs, return the delta, then restore.
+    ``create_graph`` (higher-order) is not yet supported — the engine runs
+    under no_grad; double-grad arrives with the functional jax.grad path
+    (jit.functional), tracked as a gap.
+    """
+    outputs = _as_list(outputs)
+    inputs = _as_list(inputs)
+    grad_outputs = _as_list(grad_outputs)
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: use paddle_tpu.jit.functional grad transforms "
+            "for higher-order derivatives")
+    retain_graph = bool(retain_graph) if retain_graph is not None else False
+
+    saved = [(t.grad, t._retain_grad) for t in inputs]
+    for t in inputs:
+        t.grad = None
+        t._retain_grad = True
+    try:
+        run_backward(outputs, grad_outputs, retain_graph)
+        results: List[Optional[Tensor]] = []
+        for t in inputs:
+            if t.grad is None and not allow_unused:
+                raise RuntimeError(
+                    f"input tensor {t.name} is unused in the graph "
+                    "(pass allow_unused=True to get None)")
+            results.append(t.grad)
+    finally:
+        for t, (g, r) in zip(inputs, saved):
+            t.grad = g
+            t._retain_grad = r
+    return results

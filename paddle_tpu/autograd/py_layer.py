@@ -1,0 +1,93 @@
+"""PyLayer: user-defined differentiable ops.
+
+Reference: python/paddle/autograd/py_layer.py + C++ core
+/root/reference/paddle/fluid/eager/pylayer/. TPU-native: the custom backward
+is installed as a hand-built GradNode whose vjp closure calls the user's
+``backward`` staticmethod; jax.custom_vjp is intentionally NOT required
+because the tape engine already accepts arbitrary python vjp closures.
+"""
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+
+from ..framework.tensor import (GradNode, Tensor, grad_enabled, no_grad)
+
+
+class PyLayerContext:
+    def __init__(self):
+        self._saved: Tuple[Tensor, ...] = ()
+        self.not_inplace = False
+        self._materialize_grads = True
+
+    def save_for_backward(self, *tensors):
+        self._saved = tuple(tensors)
+
+    def saved_tensor(self):
+        return self._saved
+
+    def mark_not_inplace(self, *args):
+        self.not_inplace = True
+
+    def mark_non_differentiable(self, *args):
+        pass
+
+    def set_materialize_grads(self, value: bool):
+        self._materialize_grads = bool(value)
+
+
+class PyLayerMeta(type):
+    pass
+
+
+class PyLayer(metaclass=PyLayerMeta):
+    @staticmethod
+    def forward(ctx: PyLayerContext, *args, **kwargs):
+        raise NotImplementedError
+
+    @staticmethod
+    def backward(ctx: PyLayerContext, *grads):
+        raise NotImplementedError
+
+    @classmethod
+    def apply(cls, *args, **kwargs):
+        ctx = PyLayerContext()
+        tensor_inputs = [a for a in args if isinstance(a, Tensor)] + \
+            [v for v in kwargs.values() if isinstance(v, Tensor)]
+        tracked = grad_enabled() and any(
+            not t.stop_gradient for t in tensor_inputs)
+
+        with no_grad():
+            outputs = cls.forward(ctx, *args, **kwargs)
+
+        multi = isinstance(outputs, (tuple, list))
+        outs = list(outputs) if multi else [outputs]
+        if not tracked:
+            return outputs
+
+        out_meta = [(tuple(o.shape), o._data.dtype) for o in outs]
+
+        def vjp_fn(cots):
+            cot_list = list(cots) if multi else [cots]
+            grads_in = [Tensor(c, stop_gradient=True) for c in cot_list]
+            with no_grad():
+                res = cls.backward(ctx, *grads_in)
+            res_list = list(res) if isinstance(res, (tuple, list)) else [res]
+            if len(res_list) != len(tensor_inputs):
+                raise RuntimeError(
+                    f"{cls.__name__}.backward returned {len(res_list)} grads "
+                    f"for {len(tensor_inputs)} tensor inputs")
+            return tuple(
+                g._data if isinstance(g, Tensor) else
+                (jnp.zeros(tuple(t.shape), t._data.dtype) if g is None
+                 else jnp.asarray(g))
+                for g, t in zip(res_list, tensor_inputs))
+
+        node = GradNode(vjp_fn, tuple(tensor_inputs), out_meta, multi,
+                        cls.__name__)
+        wrapped = [
+            Tensor(o._data, stop_gradient=False, _node=node, _out_idx=i)
+            for i, o in enumerate(outs)
+        ]
+        return tuple(wrapped) if multi else wrapped[0]

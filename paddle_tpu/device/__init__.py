@@ -1,0 +1,146 @@
+"""Device API (paddle.device analog, python/paddle/device/__init__.py:281
+set_device; Place taxonomy /root/reference/paddle/phi/common/place.h:135).
+
+TPU-native: devices are jax devices; there are no streams/events to manage
+(XLA orders execution); memory stats come from jax device memory stats
+instead of the reference allocator's stat registry
+(/root/reference/paddle/phi/core/memory/stats.cc).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax
+
+
+class Place:
+    def __init__(self, device_type: str, device_id: int = 0):
+        self.device_type = device_type
+        self.device_id = device_id
+
+    def __repr__(self):
+        return f"Place({self.device_type}:{self.device_id})"
+
+    def __eq__(self, other):
+        return (isinstance(other, Place)
+                and self.device_type == other.device_type
+                and self.device_id == other.device_id)
+
+    def is_tpu_place(self):
+        return self.device_type == "tpu"
+
+    def is_cpu_place(self):
+        return self.device_type == "cpu"
+
+    def is_gpu_place(self):
+        return self.device_type == "gpu"
+
+
+class TPUPlace(Place):
+    def __init__(self, device_id: int = 0):
+        super().__init__("tpu", device_id)
+
+
+class CPUPlace(Place):
+    def __init__(self, device_id: int = 0):
+        super().__init__("cpu", device_id)
+
+
+class CUDAPlace(Place):
+    """Accepted for API compat; maps to whatever accelerator jax exposes."""
+
+    def __init__(self, device_id: int = 0):
+        super().__init__("gpu", device_id)
+
+
+_current_device: Optional[str] = None
+
+
+def _jax_platform_name() -> str:
+    return jax.default_backend()
+
+
+def _canonical(platform: str) -> str:
+    if platform in ("tpu", "axon"):
+        return "tpu"
+    if platform in ("cuda", "rocm", "gpu"):
+        return "gpu"
+    return "cpu"
+
+
+def _place_of_array(arr) -> Place:
+    devs = getattr(arr, "devices", None)
+    if devs is None:
+        return Place(_canonical(_jax_platform_name()), 0)
+    try:
+        dev = sorted(arr.devices(), key=lambda d: d.id)[0]
+    except Exception:
+        return Place(_canonical(_jax_platform_name()), 0)
+    return Place(_canonical(dev.platform), dev.id)
+
+
+def set_device(device: str) -> Place:
+    """paddle.set_device analog. Accepts 'tpu', 'cpu', 'tpu:0', also 'gpu'
+    (mapped to the available accelerator)."""
+    global _current_device
+    name, _, idx = device.partition(":")
+    name = _canonical(name)
+    _current_device = device
+    return Place(name, int(idx) if idx else 0)
+
+
+def get_device() -> str:
+    if _current_device is not None:
+        return _current_device
+    return f"{_canonical(_jax_platform_name())}:0"
+
+
+def get_all_custom_device_type() -> List[str]:
+    return []
+
+
+def is_compiled_with_cuda() -> bool:
+    return False
+
+
+def is_compiled_with_tpu() -> bool:
+    return True
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def max_memory_allocated(device=None) -> int:
+    """paddle.device.cuda.max_memory_allocated analog
+    (python/paddle/device/cuda/__init__.py:233) from jax memory stats."""
+    dev = jax.devices()[0]
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    if not stats:
+        return 0
+    return int(stats.get("peak_bytes_in_use", 0))
+
+
+def memory_allocated(device=None) -> int:
+    dev = jax.devices()[0]
+    stats = getattr(dev, "memory_stats", lambda: None)()
+    if not stats:
+        return 0
+    return int(stats.get("bytes_in_use", 0))
+
+
+def synchronize(device=None):
+    """Block until all queued work completes (effectful only for timing)."""
+    (jax.device_put(0.0) + 0).block_until_ready()
+
+
+class Stream:
+    """API-compat stub: XLA has no user-visible streams; execution order is
+    program order (reference: paddle/phi/backends/.../stream.cc)."""
+
+    def synchronize(self):
+        synchronize()
+
+
+def current_stream(device=None) -> Stream:
+    return Stream()

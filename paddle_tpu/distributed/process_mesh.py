@@ -1,0 +1,113 @@
+"""ProcessMesh: the device-mesh abstraction.
+
+Reference: python/paddle/distributed/auto_parallel/process_mesh.py +
+C++ ProcessMesh (/root/reference/paddle/phi/core/distributed/auto_parallel/
+process_mesh.h:34). TPU-native: a thin façade over jax.sharding.Mesh —
+mesh axes map onto the ICI torus, and every collective is an XLA op over a
+named axis instead of an NCCL communicator per group
+(SURVEY.md §5 "Distributed communication backend" TPU mapping).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh
+
+
+class ProcessMesh:
+    def __init__(self, mesh: Sequence, dim_names: Optional[List[str]] = None,
+                 process_ids=None):
+        arr = np.asarray(mesh)
+        if dim_names is None:
+            dim_names = [f"d{i}" for i in range(arr.ndim)]
+        if len(dim_names) != arr.ndim:
+            raise ValueError("dim_names length must equal mesh ndim")
+        self._shape = list(arr.shape)
+        self._dim_names = list(dim_names)
+        self._process_ids = arr.reshape(-1).tolist()
+        self._jax_mesh: Optional[Mesh] = None
+
+    # -- paddle surface ----------------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._shape)
+
+    @property
+    def ndim(self) -> int:
+        return len(self._shape)
+
+    @property
+    def dim_names(self) -> List[str]:
+        return list(self._dim_names)
+
+    @property
+    def process_ids(self) -> List[int]:
+        return list(self._process_ids)
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._shape))
+
+    def get_dim_size(self, dim_name: str) -> int:
+        return self._shape[self._dim_names.index(dim_name)]
+
+    def get_rank_by_dim_and_process_id(self, dim_name, process_id):
+        coord = np.argwhere(np.asarray(self._process_ids).reshape(
+            self._shape) == process_id)
+        if coord.size == 0:
+            return -1
+        return int(coord[0][self._dim_names.index(dim_name)])
+
+    def __eq__(self, other):
+        return (isinstance(other, ProcessMesh)
+                and self._shape == other._shape
+                and self._process_ids == other._process_ids
+                and self._dim_names == other._dim_names)
+
+    def __hash__(self):
+        return hash((tuple(self._shape), tuple(self._process_ids),
+                     tuple(self._dim_names)))
+
+    def __repr__(self):
+        return (f"ProcessMesh(shape={self._shape}, "
+                f"dim_names={self._dim_names})")
+
+    # -- jax bridge --------------------------------------------------------
+    def jax_mesh(self) -> Mesh:
+        """Materialize the jax Mesh over this process's visible devices."""
+        if self._jax_mesh is None:
+            devices = jax.devices()
+            if self.size > len(devices):
+                raise RuntimeError(
+                    f"ProcessMesh needs {self.size} devices, only "
+                    f"{len(devices)} visible")
+            dev_arr = np.asarray(
+                [devices[i] for i in self._process_ids]).reshape(self._shape)
+            self._jax_mesh = Mesh(dev_arr, axis_names=tuple(self._dim_names))
+        return self._jax_mesh
+
+
+_global_mesh: Optional[ProcessMesh] = None
+
+
+def set_mesh(mesh: ProcessMesh):
+    global _global_mesh
+    _global_mesh = mesh
+
+
+def get_mesh() -> Optional[ProcessMesh]:
+    return _global_mesh
+
+
+def auto_mesh(dim_names: Sequence[str], shape: Optional[Sequence[int]] = None
+              ) -> ProcessMesh:
+    """Build a mesh over all visible devices. With no shape, the first axis
+    absorbs all devices."""
+    n = jax.device_count()
+    if shape is None:
+        shape = [n] + [1] * (len(dim_names) - 1)
+    return ProcessMesh(np.arange(int(np.prod(shape))).reshape(shape),
+                       list(dim_names))

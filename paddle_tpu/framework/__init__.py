@@ -1,0 +1,17 @@
+"""Framework core: dtype, Tensor, autograd engine, RNG, flags."""
+from . import dtype as dtype_module
+from .dtype import (DType, get_default_dtype, set_default_dtype)
+from .flags import get_flags, set_flags, define_flag
+from .random import seed, get_rng_state, set_rng_state, Generator
+from .tensor import (Tensor, Parameter, GradNode, apply_op, no_grad,
+                     enable_grad, set_grad_enabled, grad_enabled,
+                     run_backward)
+
+
+def in_dynamic_mode() -> bool:
+    """Always-eager façade (static mode is jit.to_static)."""
+    return True
+
+
+def in_pir_mode() -> bool:
+    return False

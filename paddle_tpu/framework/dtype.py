@@ -1,0 +1,112 @@
+"""Dtype taxonomy for the TPU-native framework.
+
+Mirrors the reference's dtype surface (``phi::DataType``,
+/root/reference/paddle/phi/common/data_type.h) as thin wrappers over numpy
+dtypes so they interop directly with jax.numpy. TPU-first notes: bfloat16 is
+the preferred low-precision dtype (MXU-native); float64 is supported but
+discouraged (software-emulated on TPU).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+
+class DType:
+    """A framework dtype: comparable, hashable, convertible to numpy/jnp."""
+
+    __slots__ = ("name", "np_dtype")
+
+    def __init__(self, name: str, np_dtype):
+        self.name = name
+        self.np_dtype = np.dtype(np_dtype)
+
+    def __repr__(self):
+        return f"paddle_tpu.{self.name}"
+
+    def __eq__(self, other):
+        if isinstance(other, DType):
+            return self.np_dtype == other.np_dtype
+        try:
+            return self.np_dtype == np.dtype(other)
+        except TypeError:
+            return NotImplemented
+
+    def __hash__(self):
+        return hash(self.np_dtype)
+
+    @property
+    def is_floating_point(self):
+        return jnp.issubdtype(self.np_dtype, np.floating)
+
+    @property
+    def is_integer(self):
+        return jnp.issubdtype(self.np_dtype, np.integer)
+
+    @property
+    def is_complex(self):
+        return jnp.issubdtype(self.np_dtype, np.complexfloating)
+
+    @property
+    def itemsize(self):
+        return self.np_dtype.itemsize
+
+
+bool_ = DType("bool", np.bool_)
+uint8 = DType("uint8", np.uint8)
+int8 = DType("int8", np.int8)
+int16 = DType("int16", np.int16)
+int32 = DType("int32", np.int32)
+int64 = DType("int64", np.int64)
+float16 = DType("float16", np.float16)
+bfloat16 = DType("bfloat16", jnp.bfloat16)
+float32 = DType("float32", np.float32)
+float64 = DType("float64", np.float64)
+complex64 = DType("complex64", np.complex64)
+complex128 = DType("complex128", np.complex128)
+
+_ALL = [bool_, uint8, int8, int16, int32, int64, float16, bfloat16,
+        float32, float64, complex64, complex128]
+_BY_NAME = {d.name: d for d in _ALL}
+_BY_NAME["bool"] = bool_
+_BY_NP = {d.np_dtype: d for d in _ALL}
+
+_default_dtype = float32
+
+
+def to_dtype(d) -> DType:
+    """Coerce a user-supplied dtype (str / numpy / DType / jnp) to DType."""
+    if d is None:
+        return _default_dtype
+    if isinstance(d, DType):
+        return d
+    if isinstance(d, str):
+        if d in _BY_NAME:
+            return _BY_NAME[d]
+        return from_np(np.dtype(d))
+    return from_np(np.dtype(d))
+
+
+def from_np(np_dtype) -> DType:
+    np_dtype = np.dtype(np_dtype)
+    got = _BY_NP.get(np_dtype)
+    if got is None:
+        raise TypeError(f"unsupported dtype: {np_dtype}")
+    return got
+
+
+def get_default_dtype() -> DType:
+    return _default_dtype
+
+
+def set_default_dtype(d):
+    """paddle.set_default_dtype analog (python/paddle/framework/framework.py)."""
+    global _default_dtype
+    d = to_dtype(d)
+    if not (d.is_floating_point or d.is_complex):
+        raise TypeError(f"default dtype must be floating/complex, got {d}")
+    _default_dtype = d
+
+
+def promote_types(a: DType, b: DType) -> DType:
+    return from_np(jnp.promote_types(a.np_dtype, b.np_dtype))

@@ -1,0 +1,61 @@
+"""paddle.save / paddle.load analogs
+(reference: python/paddle/framework/io.py — pickle-based state dicts).
+
+Tensors serialize as numpy arrays inside a pickled nested structure; a
+``program``-less format (no static Program to save — jit.save handles
+exported functions via StableHLO).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any
+
+import numpy as np
+
+from .tensor import Parameter, Tensor
+
+
+def _pack(obj: Any):
+    if isinstance(obj, Tensor):
+        return {"__tensor__": True, "data": np.asarray(obj._data),
+                "stop_gradient": obj.stop_gradient, "name": obj.name,
+                "is_param": isinstance(obj, Parameter)}
+    if isinstance(obj, dict):
+        return {k: _pack(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        packed = [_pack(v) for v in obj]
+        return packed if isinstance(obj, list) else tuple(packed)
+    return obj
+
+
+def _unpack(obj: Any, return_numpy=False):
+    if isinstance(obj, dict):
+        if obj.get("__tensor__"):
+            if return_numpy:
+                return obj["data"]
+            cls = Parameter if obj.get("is_param") else Tensor
+            t = cls(obj["data"])
+            if not obj.get("is_param"):
+                t.stop_gradient = obj.get("stop_gradient", True)
+            return t
+        return {k: _unpack(v, return_numpy) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_unpack(v, return_numpy) for v in obj]
+    if isinstance(obj, tuple):
+        return tuple(_unpack(v, return_numpy) for v in obj)
+    return obj
+
+
+def save(obj: Any, path: str, protocol: int = 4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_pack(obj), f, protocol=protocol)
+
+
+def load(path: str, return_numpy: bool = False, **configs) -> Any:
+    with open(path, "rb") as f:
+        raw = pickle.load(f)
+    return _unpack(raw, return_numpy)

@@ -1,0 +1,77 @@
+"""RNG state management.
+
+The reference uses per-device stateful Philox generators
+(/root/reference/paddle/phi/core/generator.h:32) with a python surface
+``paddle.seed`` (python/paddle/framework/random.py). TPU-native design:
+a process-global *stateful counter over a stateless JAX PRNG key* — every
+random op folds the next counter value into the root key, which keeps eager
+semantics (two dropout calls differ) while remaining jit-traceable when a key
+is threaded explicitly.
+
+The tensor-parallel RNG tracker analog
+(fleet/layers/mpu/random.py:34 RNGStatesTracker) lives in
+paddle_tpu.distributed.fleet.random and builds on ``split_seed``.
+"""
+from __future__ import annotations
+
+import threading
+
+import jax
+import numpy as np
+
+
+class Generator:
+    """A named RNG stream: root key + monotone offset."""
+
+    def __init__(self, seed: int = 0):
+        self._lock = threading.Lock()
+        self.manual_seed(seed)
+
+    def manual_seed(self, seed: int):
+        self._seed = int(seed)
+        self._key = jax.random.key(self._seed)
+        self._offset = 0
+        return self
+
+    def initial_seed(self) -> int:
+        return self._seed
+
+    def next_key(self):
+        """Return a fresh PRNG key (stateful fold-in of a counter)."""
+        with self._lock:
+            off = self._offset
+            self._offset += 1
+        return jax.random.fold_in(self._key, off)
+
+    def get_state(self):
+        return (self._seed, self._offset)
+
+    def set_state(self, state):
+        seed, offset = state
+        self.manual_seed(seed)
+        self._offset = int(offset)
+
+
+_default_generator = Generator(np.random.randint(0, 2**31 - 1))
+
+
+def seed(s: int):
+    """paddle.seed analog: reseed the global generator."""
+    _default_generator.manual_seed(s)
+    return _default_generator
+
+
+def default_generator() -> Generator:
+    return _default_generator
+
+
+def next_key():
+    return _default_generator.next_key()
+
+
+def get_rng_state():
+    return _default_generator.get_state()
+
+
+def set_rng_state(state):
+    _default_generator.set_state(state)

@@ -1,0 +1,678 @@
+"""Imperative Tensor façade + define-by-run autograd over jax.vjp.
+
+This replaces three reference subsystems at once, TPU-natively:
+
+- ``phi::DenseTensor`` / ``paddle::Tensor``
+  (/root/reference/paddle/phi/core/dense_tensor.h:37,
+  /root/reference/paddle/phi/api/include/tensor.h:82): here a thin façade
+  over ``jax.Array`` — XLA owns layout, memory, and device placement, so
+  there is no allocator/stride machinery to rebuild.
+- the eager autograd graph (GradNodeBase
+  /root/reference/paddle/fluid/eager/grad_node_info.h:197, backward engine
+  /root/reference/paddle/fluid/eager/backward.cc:105): here every traced op
+  calls ``jax.vjp`` at forward time; the returned pure ``vjp_fn`` *is* the
+  grad node. The backward engine is a reverse-topological walk identical in
+  contract (grad accumulation, hooks, retain_graph) but ~200 lines because
+  XLA supplies all gradient kernels.
+- per-op dispatch (generated ``*_ad_func``,
+  eager_gen.py:316): here ``apply_op`` — one generic path instead of
+  thousands of generated C++ functions, because jax.numpy is already a
+  complete op set with autodiff rules.
+
+Design note (SURVEY.md §7 "hard parts" #1): imperative semantics on a
+functional core. Mutation (``set_value``, in-place arithmetic, ``__setitem__``)
+rebinds ``tensor._data`` to a *new* functional value and re-points the grad
+node; handle identity is preserved for the user while every underlying array
+stays immutable, which keeps the whole façade jit-traceable.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtype as dtype_mod
+from .dtype import DType, to_dtype
+from .flags import flag_value
+
+# --------------------------------------------------------------------------
+# Grad mode
+# --------------------------------------------------------------------------
+
+_state = threading.local()
+
+
+def grad_enabled() -> bool:
+    return getattr(_state, "grad_enabled", True)
+
+
+class no_grad:
+    """Context manager / decorator disabling autograd recording
+    (python/paddle/base/dygraph/base.py no_grad analog)."""
+
+    def __enter__(self):
+        self._prev = grad_enabled()
+        _state.grad_enabled = False
+        return self
+
+    def __exit__(self, *exc):
+        _state.grad_enabled = self._prev
+        return False
+
+    def __call__(self, fn):
+        def wrapper(*a, **k):
+            with no_grad():
+                return fn(*a, **k)
+
+        wrapper.__name__ = getattr(fn, "__name__", "wrapped")
+        return wrapper
+
+
+class enable_grad:
+    def __enter__(self):
+        self._prev = grad_enabled()
+        _state.grad_enabled = True
+        return self
+
+    def __exit__(self, *exc):
+        _state.grad_enabled = self._prev
+        return False
+
+
+class set_grad_enabled:
+    def __init__(self, mode: bool):
+        self._mode = bool(mode)
+
+    def __enter__(self):
+        self._prev = grad_enabled()
+        _state.grad_enabled = self._mode
+        return self
+
+    def __exit__(self, *exc):
+        _state.grad_enabled = self._prev
+        return False
+
+
+# --------------------------------------------------------------------------
+# Grad node
+# --------------------------------------------------------------------------
+
+_FLOAT0 = jax.dtypes.float0
+
+
+class GradNode:
+    """One recorded op: a pure vjp closure + edges to input tensors.
+
+    Reference contract: GradNodeBase
+    (/root/reference/paddle/fluid/eager/grad_node_info.h:197) — operator()
+    maps output grads to input grads; TensorWrapper saved inputs live inside
+    the jax vjp residuals instead of explicit wrappers.
+    """
+
+    __slots__ = ("vjp_fn", "inputs", "out_meta", "multi_out", "name",
+                 "__weakref__")
+
+    def __init__(self, vjp_fn, inputs, out_meta, multi_out, name):
+        self.vjp_fn = vjp_fn
+        self.inputs: Tuple[Optional[Tensor], ...] = inputs
+        self.out_meta: List[Tuple[Tuple[int, ...], Any]] = out_meta
+        self.multi_out = multi_out
+        self.name = name
+
+    def __repr__(self):
+        return f"<GradNode {self.name} n_in={len(self.inputs)}>"
+
+
+def _check_finite(name, arrays):
+    for a in arrays:
+        if isinstance(a, jax.core.Tracer):
+            return
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            if not bool(jnp.isfinite(a).all()):
+                msg = f"NaN/Inf detected in output of op '{name}'"
+                if flag_value("FLAGS_check_nan_inf_level") == 0:
+                    raise FloatingPointError(msg)
+                print("WARNING:", msg)
+
+
+def apply_op(fn: Callable, *inputs, _op_name: Optional[str] = None, **kwargs):
+    """Execute ``fn`` on unwrapped arrays, recording a grad node if needed.
+
+    ``fn`` is a jax-traceable function of the positional inputs (Tensors are
+    unwrapped to jax arrays; non-Tensor positionals pass through). This is
+    the single dispatch point replacing the reference's generated per-op
+    ``*_ad_func`` chain (eager_gen.py:316: record event -> AMP -> autograd
+    meta -> GradNode -> phi API).
+    """
+    name = _op_name or getattr(fn, "__name__", "op")
+    arrs = [x._data if isinstance(x, Tensor) else x for x in inputs]
+
+    # AMP O1 hook (python/paddle/amp — cast per white/black lists); the
+    # import is deferred and the common no-AMP path is one attr check.
+    from ..amp.auto_cast import amp_state, maybe_autocast_inputs
+    if amp_state() is not None:
+        arrs = maybe_autocast_inputs(name, arrs)
+
+    tensor_pos = [i for i, x in enumerate(inputs) if isinstance(x, Tensor)]
+    tracked = grad_enabled() and any(
+        not inputs[i].stop_gradient for i in tensor_pos)
+
+    if not tracked:
+        out = fn(*arrs, **kwargs)
+        res = _wrap_outputs(out, None, name)
+        if flag_value("FLAGS_check_nan_inf"):
+            _check_finite(name, [t._data for t in _flatten_tensors(res)])
+        return res
+
+    def pure(*t_arrs):
+        full = list(arrs)
+        for i, a in zip(tensor_pos, t_arrs):
+            full[i] = a
+        return fn(*full, **kwargs)
+
+    out, vjp_fn = jax.vjp(pure, *(arrs[i] for i in tensor_pos))
+
+    multi = isinstance(out, (tuple, list))
+    outs = list(out) if multi else [out]
+    out_meta = [(o.shape, o.dtype) for o in outs]
+    node = GradNode(vjp_fn, tuple(inputs[i] for i in tensor_pos),
+                    out_meta, multi, name)
+    res = _wrap_outputs(out, node, name)
+    if flag_value("FLAGS_check_nan_inf"):
+        _check_finite(name, [t._data for t in _flatten_tensors(res)])
+    return res
+
+
+def _flatten_tensors(res):
+    if isinstance(res, Tensor):
+        return [res]
+    return [t for t in res if isinstance(t, Tensor)]
+
+
+def _wrap_outputs(out, node, name):
+    if isinstance(out, (tuple, list)):
+        return tuple(
+            Tensor(o, stop_gradient=node is None, _node=node, _out_idx=i)
+            for i, o in enumerate(out))
+    return Tensor(out, stop_gradient=node is None, _node=node, _out_idx=0)
+
+
+# --------------------------------------------------------------------------
+# Backward engine
+# --------------------------------------------------------------------------
+
+def _topo_from(nodes: Sequence[GradNode]) -> List[GradNode]:
+    """Reverse-postorder over producer edges: consumers before producers.
+
+    Mirrors the queue-based reverse walk in
+    /root/reference/paddle/fluid/eager/backward.cc:105 (in-degree scheduling)
+    with an explicit topological sort.
+    """
+    seen = set()
+    order: List[GradNode] = []
+    for root in nodes:
+        if id(root) in seen:
+            continue
+        stack: List[Tuple[GradNode, bool]] = [(root, False)]
+        while stack:
+            node, processed = stack.pop()
+            if processed:
+                order.append(node)
+                continue
+            if id(node) in seen:
+                continue
+            seen.add(id(node))
+            stack.append((node, True))
+            for t in node.inputs:
+                child = t.grad_node
+                if child is not None and id(child) not in seen:
+                    stack.append((child, False))
+    order.reverse()
+    return order
+
+
+def run_backward(tensors: Sequence["Tensor"],
+                 grad_tensors: Optional[Sequence[Optional["Tensor"]]] = None,
+                 retain_graph: bool = False):
+    """Engine entry (egr::RunBackward analog, backward.cc:105)."""
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    if len(grad_tensors) != len(tensors):
+        raise ValueError("grad_tensors length mismatch")
+
+    node_grads: Dict[int, List[Optional[jax.Array]]] = {}
+    node_by_id: Dict[int, GradNode] = {}
+    roots: List[GradNode] = []
+
+    with no_grad():
+        for t, g in zip(tensors, grad_tensors):
+            if g is None:
+                if t.size != 1:
+                    raise RuntimeError(
+                        "grad can be implicitly created only for scalar "
+                        f"outputs, got shape {t.shape}")
+                seed = jnp.ones(t._shape(), t._data.dtype)
+            else:
+                seed = g._data if isinstance(g, Tensor) else jnp.asarray(g)
+            node = t.grad_node
+            if node is None:
+                if not t.stop_gradient:
+                    t._accumulate_grad(seed)
+                continue
+            slot = node_grads.setdefault(
+                id(node), [None] * len(node.out_meta))
+            slot[t._out_idx] = seed if slot[t._out_idx] is None \
+                else slot[t._out_idx] + seed
+            node_by_id[id(node)] = node
+            roots.append(node)
+
+        for node in _topo_from(roots):
+            slot = node_grads.pop(id(node), None)
+            if slot is None:
+                continue
+            cots = [
+                g if g is not None else jnp.zeros(shape, dt)
+                for g, (shape, dt) in zip(slot, node.out_meta)
+            ]
+            if node.vjp_fn is None:
+                raise RuntimeError(
+                    "trying to backward through the graph a second time; "
+                    "set retain_graph=True on the first backward call")
+            in_grads = node.vjp_fn(tuple(cots) if node.multi_out else cots[0])
+            if not retain_graph:
+                node.vjp_fn = None
+            for t, g in zip(node.inputs, in_grads):
+                if t is None or t.stop_gradient:
+                    continue
+                if g.dtype == _FLOAT0:
+                    continue
+                for hook in t._hooks.values():
+                    new_g = hook(Tensor(g, stop_gradient=True))
+                    if new_g is not None:
+                        g = new_g._data if isinstance(new_g, Tensor) else new_g
+                child = t.grad_node
+                if child is None or t._retain_grad:
+                    t._accumulate_grad(g)
+                if child is not None:
+                    cslot = node_grads.setdefault(
+                        id(child), [None] * len(child.out_meta))
+                    idx = t._out_idx
+                    cslot[idx] = g if cslot[idx] is None else cslot[idx] + g
+
+
+# --------------------------------------------------------------------------
+# Tensor
+# --------------------------------------------------------------------------
+
+def _unwrap(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+_tensor_counter = [0]
+
+
+class Tensor:
+    """User-facing eager tensor (paddle.Tensor analog)."""
+
+    __slots__ = ("_data", "stop_gradient", "grad", "grad_node", "_out_idx",
+                 "name", "persistable", "_hooks", "_retain_grad",
+                 "__weakref__", "__dict__")
+
+    def __init__(self, data, dtype=None, stop_gradient: bool = True,
+                 name: Optional[str] = None, _node: Optional[GradNode] = None,
+                 _out_idx: int = 0):
+        if isinstance(data, Tensor):
+            data = data._data
+        if not isinstance(data, (jax.Array, jax.core.Tracer)):
+            np_dtype = to_dtype(dtype).np_dtype if dtype is not None else None
+            arr = np.asarray(data)
+            if np_dtype is None and arr.dtype == np.float64:
+                np_dtype = dtype_mod.get_default_dtype().np_dtype
+            data = jnp.asarray(arr, dtype=np_dtype)
+        elif dtype is not None:
+            data = data.astype(to_dtype(dtype).np_dtype)
+        self._data = data
+        self.stop_gradient = stop_gradient
+        self.grad: Optional[Tensor] = None
+        self.grad_node = _node
+        self._out_idx = _out_idx
+        if name is None:
+            _tensor_counter[0] += 1
+            name = f"generated_tensor_{_tensor_counter[0]}"
+        self.name = name
+        self.persistable = False
+        self._hooks: Dict[int, Callable] = {}
+        self._retain_grad = False
+
+    # -- metadata ----------------------------------------------------------
+    def _shape(self) -> Tuple[int, ...]:
+        return tuple(self._data.shape)
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._data.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._data.shape, dtype=np.int64)) \
+            if self._data.shape else 1
+
+    def numel(self) -> int:
+        return self.size
+
+    def dim(self) -> int:
+        return self._data.ndim
+
+    @property
+    def dtype(self) -> DType:
+        return dtype_mod.from_np(np.dtype(self._data.dtype))
+
+    @property
+    def place(self):
+        from ..device import _place_of_array
+        return _place_of_array(self._data)
+
+    @property
+    def is_leaf(self) -> bool:
+        return self.grad_node is None
+
+    def __len__(self):
+        if self.ndim == 0:
+            raise TypeError("len() of a 0-d tensor")
+        return self._data.shape[0]
+
+    def __repr__(self):
+        grad_info = "" if self.stop_gradient else ", stop_gradient=False"
+        return (f"Tensor(shape={self.shape}, dtype={self.dtype.name}"
+                f"{grad_info},\n       {np.asarray(self._data)!r})")
+
+    # jax interop: jnp.* consumes Tensor directly (autograd NOT tracked —
+    # internal use and user escape hatch, like Tensor.numpy()).
+    def __jax_array__(self):
+        return self._data
+
+    # -- conversion --------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        return np.asarray(self._data)
+
+    def item(self):
+        return self._data.item()
+
+    def tolist(self):
+        return np.asarray(self._data).tolist()
+
+    def __float__(self):
+        return float(self._data)
+
+    def __int__(self):
+        return int(self._data)
+
+    def __bool__(self):
+        return bool(self._data)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._data)
+        return a.astype(dtype) if dtype is not None else a
+
+    def astype(self, dt) -> "Tensor":
+        nd = to_dtype(dt).np_dtype
+        return apply_op(lambda x: x.astype(nd), self, _op_name="cast")
+
+    cast = astype
+
+    def detach(self) -> "Tensor":
+        t = Tensor(self._data, stop_gradient=True)
+        return t
+
+    def clone(self) -> "Tensor":
+        return apply_op(lambda x: x + 0, self, _op_name="clone")
+
+    def cpu(self) -> "Tensor":
+        return Tensor(jax.device_get(self._data),
+                      stop_gradient=self.stop_gradient)
+
+    def pin_memory(self) -> "Tensor":
+        return self
+
+    # -- autograd ----------------------------------------------------------
+    def backward(self, grad_tensor: Optional["Tensor"] = None,
+                 retain_graph: bool = False):
+        run_backward([self], [grad_tensor], retain_graph)
+
+    def register_hook(self, hook: Callable):
+        hid = id(hook)
+        self._hooks[hid] = hook
+
+        class _Handle:
+            def remove(h):
+                self._hooks.pop(hid, None)
+
+        return _Handle()
+
+    def retain_grads(self):
+        self._retain_grad = True
+
+    def clear_gradient(self, set_to_zero: bool = False):
+        if set_to_zero and self.grad is not None:
+            self.grad = Tensor(jnp.zeros_like(self.grad._data),
+                               stop_gradient=True)
+        else:
+            self.grad = None
+
+    clear_grad = clear_gradient
+
+    def _accumulate_grad(self, g: jax.Array):
+        """GradNodeAccumulation analog
+        (/root/reference/paddle/fluid/eager/accumulation/accumulation_node.h:24)."""
+        if g.shape != self._data.shape:  # broadcast reduction safety
+            g = jnp.broadcast_to(g, self._data.shape) \
+                if g.size == 1 else g.reshape(self._data.shape)
+        if self.grad is None:
+            self.grad = Tensor(g, stop_gradient=True)
+        else:
+            self.grad = Tensor(self.grad._data + g, stop_gradient=True)
+
+    # -- mutation (functional under the hood) ------------------------------
+    def set_value(self, value):
+        arr = _unwrap(value) if isinstance(value, Tensor) else jnp.asarray(value)
+        if tuple(arr.shape) != self._shape():
+            raise ValueError(
+                f"set_value shape mismatch {arr.shape} vs {self._shape()}")
+        self._data = arr.astype(self._data.dtype)
+        self.grad_node = None
+        self._out_idx = 0
+        return self
+
+    def copy_(self, other, blocking=True):
+        return self.set_value(other)
+
+    def _snapshot(self) -> "Tensor":
+        """Alias of this tensor's CURRENT value+grad-edge. In-place ops must
+        record their grad node against the snapshot, not ``self`` — after
+        ``_inplace`` rebinds self to the new node, a node referencing self
+        would form a cycle and grads upstream of the mutation would vanish
+        (the reference tracks this with inplace_version counters on
+        VariableWrapper; here the functional alias makes it structural)."""
+        return Tensor(self._data, stop_gradient=self.stop_gradient,
+                      _node=self.grad_node, _out_idx=self._out_idx)
+
+    def _inplace(self, new: "Tensor"):
+        """Rebind this handle to the result of an in-place-style op."""
+        self._data = new._data
+        self.grad_node = new.grad_node
+        self._out_idx = new._out_idx
+        self.stop_gradient = self.stop_gradient and new.stop_gradient
+        return self
+
+    # -- indexing ----------------------------------------------------------
+    def __getitem__(self, idx) -> "Tensor":
+        idx = _unwrap_index(idx)
+        return apply_op(lambda x: x[idx], self, _op_name="getitem")
+
+    def __setitem__(self, idx, value):
+        idx = _unwrap_index(idx)
+        snap = self._snapshot()
+        if isinstance(value, Tensor):
+            new = apply_op(lambda x, v: x.at[idx].set(v), snap, value,
+                           _op_name="setitem")
+        else:
+            v = value
+            new = apply_op(lambda x: x.at[idx].set(v), snap,
+                           _op_name="setitem")
+        self._inplace(new)
+
+    # -- arithmetic --------------------------------------------------------
+    def _binop(self, other, fn, name):
+        if isinstance(other, Tensor):
+            return apply_op(fn, self, other, _op_name=name)
+        return apply_op(lambda x: fn(x, other), self, _op_name=name)
+
+    def _rbinop(self, other, fn, name):
+        return apply_op(lambda x: fn(other, x), self, _op_name=name)
+
+    def __add__(self, o):
+        return self._binop(o, jnp.add, "add")
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        return self._binop(o, jnp.subtract, "subtract")
+
+    def __rsub__(self, o):
+        return self._rbinop(o, jnp.subtract, "subtract")
+
+    def __mul__(self, o):
+        return self._binop(o, jnp.multiply, "multiply")
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        return self._binop(o, jnp.divide, "divide")
+
+    def __rtruediv__(self, o):
+        return self._rbinop(o, jnp.divide, "divide")
+
+    def __floordiv__(self, o):
+        return self._binop(o, jnp.floor_divide, "floor_divide")
+
+    def __rfloordiv__(self, o):
+        return self._rbinop(o, jnp.floor_divide, "floor_divide")
+
+    def __mod__(self, o):
+        return self._binop(o, jnp.remainder, "remainder")
+
+    def __pow__(self, o):
+        return self._binop(o, jnp.power, "pow")
+
+    def __rpow__(self, o):
+        return self._rbinop(o, jnp.power, "pow")
+
+    def __matmul__(self, o):
+        return self._binop(o, jnp.matmul, "matmul")
+
+    def __neg__(self):
+        return apply_op(jnp.negative, self, _op_name="neg")
+
+    def __abs__(self):
+        return apply_op(jnp.abs, self, _op_name="abs")
+
+    def __invert__(self):
+        return apply_op(jnp.logical_not, self, _op_name="logical_not")
+
+    # comparisons (stop-gradient outputs by nature: bool dtype)
+    def __eq__(self, o):
+        return self._binop(o, jnp.equal, "equal")
+
+    def __ne__(self, o):
+        return self._binop(o, jnp.not_equal, "not_equal")
+
+    def __lt__(self, o):
+        return self._binop(o, jnp.less, "less_than")
+
+    def __le__(self, o):
+        return self._binop(o, jnp.less_equal, "less_equal")
+
+    def __gt__(self, o):
+        return self._binop(o, jnp.greater, "greater_than")
+
+    def __ge__(self, o):
+        return self._binop(o, jnp.greater_equal, "greater_equal")
+
+    __hash__ = object.__hash__
+
+    # -- inplace variants --------------------------------------------------
+    def add_(self, o):
+        return self._inplace(self._snapshot().__add__(o))
+
+    def subtract_(self, o):
+        return self._inplace(self._snapshot().__sub__(o))
+
+    def multiply_(self, o):
+        return self._inplace(self._snapshot().__mul__(o))
+
+    def scale_(self, scale=1.0, bias=0.0):
+        return self._inplace(self._snapshot()._binop(
+            scale, lambda x, s: x * s + bias, "scale"))
+
+    def zero_(self):
+        self._data = jnp.zeros_like(self._data)
+        self.grad_node = None
+        return self
+
+    def fill_(self, value):
+        self._data = jnp.full_like(self._data, value)
+        self.grad_node = None
+        return self
+
+    # -- method binding for ops modules -----------------------------------
+    @classmethod
+    def _bind(cls, name: str, fn: Callable):
+        setattr(cls, name, fn)
+
+
+class Parameter(Tensor):
+    """Trainable tensor (python/paddle/base/framework.py EagerParamBase
+    analog): stop_gradient defaults to False, persistable True."""
+
+    def __init__(self, data, dtype=None, name=None, trainable: bool = True):
+        super().__init__(data, dtype=dtype, stop_gradient=not trainable,
+                         name=name)
+        self.persistable = True
+
+    @property
+    def trainable(self) -> bool:
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v: bool):
+        self.stop_gradient = not v
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx._data
+    if isinstance(idx, tuple):
+        return tuple(_unwrap_index(i) for i in idx)
+    if isinstance(idx, list):
+        return jnp.asarray(np.asarray(idx)) if any(
+            isinstance(i, (list, np.ndarray)) for i in idx) else \
+            np.asarray(idx)
+    if isinstance(idx, slice):
+        return slice(_scalar(idx.start), _scalar(idx.stop), _scalar(idx.step))
+    return idx
+
+
+def _scalar(v):
+    if isinstance(v, Tensor):
+        return int(v._data)
+    return v

@@ -1,0 +1,236 @@
+"""paddle.Model high-level API (reference: python/paddle/hapi/model.py:1472
+Model with .prepare/.fit/.evaluate/.predict/.save; DynamicGraphAdapter
+:713). Single adapter here: eager + optional jitted train step."""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+import numpy as np
+
+from ..framework.io import load as fw_load
+from ..framework.io import save as fw_save
+from ..framework.tensor import Tensor, no_grad
+from ..io import DataLoader
+from ..metric import Metric
+from ..nn.layer_base import Layer
+from .callbacks import Callback, ProgBarLogger, config_callbacks
+
+__all__ = ["Model"]
+
+
+class _InputSpecLike:
+    pass
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._optimizer = None
+        self._loss = None
+        self._metrics: List[Metric] = []
+        self.stop_training = False
+
+    # -- setup ------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        if metrics is None:
+            self._metrics = []
+        elif isinstance(metrics, Metric):
+            self._metrics = [metrics]
+        else:
+            self._metrics = list(metrics)
+
+    # -- core steps -------------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        outputs = self.network(*inputs)
+        outs = _to_list(outputs)
+        losses = self._loss(*(outs + labels))
+        loss_list = _to_list(losses)
+        total = loss_list[0]
+        for extra in loss_list[1:]:
+            total = total + extra
+        total.backward()
+        if update:
+            self._optimizer.step()
+            self._optimizer.clear_grad()
+        metrics = []
+        for m in self._metrics:
+            m.update(m.compute(outs[0], *labels))
+        return [float(l) for l in loss_list], \
+            [m.accumulate() for m in self._metrics]
+
+    @no_grad()
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        inputs = _to_list(inputs)
+        labels = _to_list(labels)
+        outs = _to_list(self.network(*inputs))
+        losses = _to_list(self._loss(*(outs + labels))) if self._loss \
+            else []
+        for m in self._metrics:
+            m.update(m.compute(outs[0], *labels))
+        return [float(l) for l in losses], \
+            [m.accumulate() for m in self._metrics]
+
+    @no_grad()
+    def predict_batch(self, inputs):
+        self.network.eval()
+        outs = self.network(*_to_list(inputs))
+        return _to_list(outs)
+
+    # -- loops ------------------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1,
+            verbose=2, drop_last=False, shuffle=True, num_workers=0,
+            callbacks=None, accumulate_grad_batches=1, num_iters=None):
+        train_loader = _as_loader(train_data, batch_size, shuffle,
+                                  drop_last, num_workers)
+        eval_loader = _as_loader(eval_data, batch_size, False, False,
+                                 num_workers) if eval_data is not None \
+            else None
+        cbks = config_callbacks(callbacks, model=self, epochs=epochs,
+                                steps=_safe_len(train_loader),
+                                log_freq=log_freq, verbose=verbose,
+                                save_dir=save_dir,
+                                metrics=["loss"] + self._metrics_names())
+        cbks.on_begin("train")
+        for epoch in range(epochs):
+            cbks.on_epoch_begin(epoch)
+            for m in self._metrics:
+                m.reset()
+            logs = {}
+            for step, batch in enumerate(train_loader):
+                cbks.on_batch_begin("train", step, logs)
+                ins, labs = _split_batch(batch)
+                losses, metrics = self.train_batch(ins, labs)
+                logs = self._make_logs(losses, metrics)
+                logs["step"] = step
+                cbks.on_batch_end("train", step, logs)
+                if num_iters is not None and step + 1 >= num_iters:
+                    break
+            if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                eval_logs = self.evaluate(eval_loader, verbose=0)
+                logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
+            cbks.on_epoch_end(epoch, logs)
+            if save_dir and (epoch + 1) % save_freq == 0:
+                self.save(f"{save_dir}/{epoch}")
+            if self.stop_training:
+                break
+        cbks.on_end("train", logs)
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_iters=None):
+        loader = _as_loader(eval_data, batch_size, False, False, num_workers)
+        for m in self._metrics:
+            m.reset()
+        total_loss, n = 0.0, 0
+        for step, batch in enumerate(loader):
+            ins, labs = _split_batch(batch)
+            losses, _ = self.eval_batch(ins, labs)
+            if losses:
+                total_loss += losses[0]
+                n += 1
+            if num_iters is not None and step + 1 >= num_iters:
+                break
+        logs = {}
+        if n:
+            logs["loss"] = total_loss / n
+        for m in self._metrics:
+            logs[_name_of(m)] = m.accumulate()
+        return logs
+
+    def predict(self, test_data, batch_size=1, num_workers=0,
+                stack_outputs=False, verbose=1, callbacks=None):
+        loader = _as_loader(test_data, batch_size, False, False, num_workers)
+        outputs = []
+        for batch in loader:
+            ins, _ = _split_batch(batch, has_labels=False)
+            outs = self.predict_batch(ins)
+            outputs.append([np.asarray(o._data) for o in outs])
+        if stack_outputs:
+            n_out = len(outputs[0])
+            return [np.concatenate([b[i] for b in outputs])
+                    for i in range(n_out)]
+        return outputs
+
+    # -- io ---------------------------------------------------------------
+    def save(self, path: str, training: bool = True):
+        fw_save(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            fw_save(self._optimizer.state_dict(), path + ".pdopt")
+
+    def load(self, path: str, skip_mismatch: bool = False,
+             reset_optimizer: bool = False):
+        state = fw_load(path + ".pdparams")
+        self.network.set_state_dict(state)
+        import os
+        if not reset_optimizer and self._optimizer is not None and \
+                os.path.exists(path + ".pdopt"):
+            self._optimizer.set_state_dict(fw_load(path + ".pdopt"))
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+    def summary(self, input_size=None, dtype=None):
+        n_params = sum(p.size for p in self.network.parameters())
+        trainable = sum(p.size for p in self.network.parameters()
+                        if p.trainable)
+        info = {"total_params": n_params, "trainable_params": trainable}
+        print(f"Total params: {n_params:,}  (trainable {trainable:,})")
+        return info
+
+    # -- helpers ----------------------------------------------------------
+    def _metrics_names(self):
+        names = []
+        for m in self._metrics:
+            n = m.name()
+            names.extend(n if isinstance(n, list) else [n])
+        return names
+
+    def _make_logs(self, losses, metrics):
+        logs = {"loss": losses[0] if losses else 0.0}
+        for m, v in zip(self._metrics, metrics):
+            logs[_name_of(m)] = v
+        return logs
+
+
+def _name_of(m):
+    n = m.name()
+    return n if isinstance(n, str) else n[0]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+def _safe_len(loader):
+    try:
+        return len(loader)
+    except TypeError:
+        return None
+
+
+def _as_loader(data, batch_size, shuffle, drop_last, num_workers):
+    if data is None or isinstance(data, DataLoader):
+        return data
+    return DataLoader(data, batch_size=batch_size, shuffle=shuffle,
+                      drop_last=drop_last, num_workers=num_workers)
+
+
+def _split_batch(batch, has_labels=True):
+    if isinstance(batch, (list, tuple)):
+        items = list(batch)
+        if not has_labels or len(items) == 1:
+            return items, []
+        return items[:-1], items[-1:]
+    return [batch], []

@@ -1,0 +1,23 @@
+"""Data loading (reference: python/paddle/io/ — reader.py:262 DataLoader,
+dataloader/dataloader_iter.py multi-process workers).
+
+TPU-native design: the input pipeline is host-side; workers are a
+thread/process pool feeding a bounded prefetch queue, and batches are
+device_put asynchronously so the host overlaps with TPU compute (the
+reference's pin-memory + CUDA-stream copy machinery has no TPU analog —
+XLA transfers are already async).
+"""
+from .dataset import (Dataset, IterableDataset, TensorDataset,
+                      ComposeDataset, ChainDataset, Subset, random_split,
+                      ConcatDataset)
+from .sampler import (Sampler, SequenceSampler, RandomSampler,
+                      WeightedRandomSampler, BatchSampler,
+                      DistributedBatchSampler, SubsetRandomSampler)
+from .dataloader import DataLoader, default_collate_fn, get_worker_info
+
+__all__ = ["Dataset", "IterableDataset", "TensorDataset", "ComposeDataset",
+           "ChainDataset", "Subset", "random_split", "ConcatDataset",
+           "Sampler", "SequenceSampler", "RandomSampler",
+           "WeightedRandomSampler", "BatchSampler",
+           "DistributedBatchSampler", "SubsetRandomSampler", "DataLoader",
+           "default_collate_fn", "get_worker_info"]

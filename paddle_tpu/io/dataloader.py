@@ -1,0 +1,151 @@
+"""DataLoader (reference: python/paddle/io/reader.py:262 DataLoader;
+worker machinery io/dataloader/dataloader_iter.py:154/:368 with shared-mem
+queues + C++ blocking queues).
+
+TPU-native: multiprocessing workers feed index-batches through a process
+pool; collation produces numpy batches, converted to Tensors on the default
+device. No pin-memory/CUDA streams — jax transfers are async already.
+"""
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from concurrent.futures import ProcessPoolExecutor, ThreadPoolExecutor
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .dataset import Dataset, IterableDataset
+from .sampler import BatchSampler
+
+__all__ = ["DataLoader", "default_collate_fn", "get_worker_info"]
+
+_worker_info = threading.local()
+
+
+class WorkerInfo:
+    def __init__(self, id, num_workers, dataset):
+        self.id = id
+        self.num_workers = num_workers
+        self.dataset = dataset
+
+
+def get_worker_info():
+    return getattr(_worker_info, "info", None)
+
+
+def default_collate_fn(batch):
+    """Stack a list of samples into batched Tensors
+    (reference: io/dataloader/collate.py)."""
+    sample = batch[0]
+    if isinstance(sample, Tensor):
+        import jax.numpy as jnp
+        return Tensor(jnp.stack([b._data for b in batch]))
+    if isinstance(sample, np.ndarray):
+        return Tensor(np.stack(batch))
+    if isinstance(sample, (int, np.integer)):
+        return Tensor(np.asarray(batch, np.int64))
+    if isinstance(sample, (float, np.floating)):
+        return Tensor(np.asarray(batch, np.float32))
+    if isinstance(sample, (list, tuple)):
+        transposed = zip(*batch)
+        return tuple(default_collate_fn(list(s)) for s in transposed)
+    if isinstance(sample, dict):
+        return {k: default_collate_fn([b[k] for b in batch])
+                for k in sample}
+    if isinstance(sample, (str, bytes)):
+        return list(batch)
+    return Tensor(np.asarray(batch))
+
+
+class DataLoader:
+    def __init__(self, dataset: Dataset, feed_list=None, places=None,
+                 return_list=True, batch_sampler: Optional[BatchSampler] = None,
+                 batch_size: int = 1, shuffle: bool = False,
+                 drop_last: bool = False, collate_fn: Callable = None,
+                 num_workers: int = 0, use_buffer_reader: bool = True,
+                 prefetch_factor: int = 2, use_shared_memory: bool = True,
+                 timeout: int = 0, worker_init_fn: Callable = None,
+                 persistent_workers: bool = False):
+        self.dataset = dataset
+        self.num_workers = max(0, num_workers)
+        self.collate_fn = collate_fn or default_collate_fn
+        self.prefetch_factor = prefetch_factor
+        self.worker_init_fn = worker_init_fn
+        self._iterable_mode = isinstance(dataset, IterableDataset)
+        self.batch_size = batch_size
+        self.drop_last = drop_last
+        if self._iterable_mode:
+            self.batch_sampler = None
+        elif batch_sampler is not None:
+            self.batch_sampler = batch_sampler
+        elif batch_size is None:
+            self.batch_sampler = None
+        else:
+            self.batch_sampler = BatchSampler(dataset=dataset,
+                                              shuffle=shuffle,
+                                              batch_size=batch_size,
+                                              drop_last=drop_last)
+
+    def __len__(self):
+        if self._iterable_mode:
+            raise TypeError("IterableDataset has no len()")
+        if self.batch_sampler is None:
+            return len(self.dataset)
+        return len(self.batch_sampler)
+
+    def _fetch(self, indices):
+        samples = [self.dataset[i] for i in indices]
+        return self.collate_fn(samples)
+
+    def _iter_iterable(self):
+        if self.batch_size is None:
+            for sample in self.dataset:
+                yield sample
+            return
+        batch = []
+        for sample in self.dataset:
+            batch.append(sample)
+            if len(batch) == self.batch_size:
+                yield self.collate_fn(batch)
+                batch = []
+        if batch and not self.drop_last:
+            yield self.collate_fn(batch)
+
+    def __iter__(self):
+        if self._iterable_mode:
+            yield from self._iter_iterable()
+            return
+        if self.batch_sampler is None:
+            for i in range(len(self.dataset)):
+                yield self.collate_fn([self.dataset[i]])
+            return
+        if self.num_workers == 0:
+            for indices in self.batch_sampler:
+                yield self._fetch(indices)
+            return
+        # threaded prefetch pipeline (workers fetch+collate; bounded queue
+        # keeps `prefetch_factor * num_workers` batches in flight)
+        yield from self._iter_workers()
+
+    def _iter_workers(self):
+        max_inflight = self.prefetch_factor * self.num_workers
+        pool = ThreadPoolExecutor(max_workers=self.num_workers)
+        if self.worker_init_fn is not None:
+            for wid in range(self.num_workers):
+                self.worker_init_fn(wid)
+        try:
+            batches = iter(self.batch_sampler)
+            inflight = []
+            for indices in itertools.islice(batches, max_inflight):
+                inflight.append(pool.submit(self._fetch, indices))
+            for indices in batches:
+                fut = inflight.pop(0)
+                inflight.append(pool.submit(self._fetch, indices))
+                yield fut.result()
+            for fut in inflight:
+                yield fut.result()
+        finally:
+            pool.shutdown(wait=False, cancel_futures=True)

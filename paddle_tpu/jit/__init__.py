@@ -1,0 +1,9 @@
+"""JIT capture + export (reference: python/paddle/jit/, 34.7k LoC)."""
+from .static_function import (to_static, not_to_static, StaticFunction,
+                              InputSpec)
+from .functional import TrainStep, functional_call, value_and_grad
+from .save_load import save, load, TranslatedLayer
+
+__all__ = ["to_static", "not_to_static", "StaticFunction", "InputSpec",
+           "TrainStep", "functional_call", "value_and_grad", "save", "load",
+           "TranslatedLayer"]

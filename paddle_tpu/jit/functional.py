@@ -1,0 +1,173 @@
+"""Functional bridge: Layer/Optimizer → pure jitted step functions.
+
+This is the TPU replacement for the reference's whole-graph executors
+(to_static Engine: auto_parallel/static/engine.py; StandaloneExecutor
+new_executor/): instead of building a Program and interpreting it, we trace
+(forward + backward + optimizer update) into ONE jitted XLA computation.
+XLA then owns scheduling, fusion, memory planning, and (under shardings)
+collective insertion — the entire executor layer of the reference collapses
+into this file plus jax.jit.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, no_grad
+from ..nn.layer_base import Layer
+
+__all__ = ["functional_call", "value_and_grad", "TrainStep"]
+
+
+def functional_call(layer: Layer, params: Dict[str, jax.Array],
+                    buffers: Optional[Dict[str, jax.Array]], *args,
+                    **kwargs):
+    """Run layer.forward as a pure function of (params, buffers, inputs).
+
+    Returns (outputs_arrays, new_buffers) — buffer mutations (e.g. BN
+    running stats) are captured functionally.
+    """
+    wrapped = [Tensor(a, stop_gradient=True) if isinstance(
+        a, (jax.Array, jax.core.Tracer)) else a for a in args]
+    with layer.bind_state(params, buffers):
+        out = layer(*wrapped, **kwargs)
+        new_buffers = {n: b._data for n, b in layer.named_buffers()
+                       if b is not None}
+    if isinstance(out, (tuple, list)):
+        out_arr = tuple(o._data if isinstance(o, Tensor) else o for o in out)
+    else:
+        out_arr = out._data if isinstance(out, Tensor) else out
+    return out_arr, new_buffers
+
+
+def value_and_grad(layer: Layer, loss_fn: Callable):
+    """Build fn(params, buffers, *batch) -> ((loss, new_buffers), grads).
+
+    loss_fn receives (output_tensor(s), *batch_labels_tensors) and must
+    return a scalar Tensor. Differentiates w.r.t. params only.
+    """
+    def compute(params, buffers, inputs, labels):
+        out_arr, new_buffers = functional_call(layer, params, buffers,
+                                               *inputs)
+        outs = out_arr if isinstance(out_arr, tuple) else (out_arr,)
+        out_tensors = [Tensor(o, stop_gradient=True) for o in outs]
+        label_tensors = [Tensor(l, stop_gradient=True) for l in labels]
+        loss = loss_fn(*(out_tensors + label_tensors))
+        return loss._data, new_buffers
+
+    return jax.value_and_grad(compute, argnums=0, has_aux=True)
+
+
+class TrainStep:
+    """One fully-jitted training step: forward + grad + optimizer update.
+
+    Usage::
+
+        step = TrainStep(model, opt, lambda out, y: F.cross_entropy(out, y))
+        loss = step(x, y)          # params/opt-state updated in place
+
+    The optimizer's update rules run inside the trace (their accumulator
+    dict is snapshotted/restored around tracing), so any Optimizer subclass
+    works unchanged — the tape never runs; jax.grad supplies gradients.
+    """
+
+    def __init__(self, model: Layer, optimizer, loss_fn: Callable,
+                 donate: bool = True):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss_fn = loss_fn
+        self._vg = value_and_grad(model, loss_fn)
+        self._jitted = None
+        self._param_names = [n for n, _ in model.named_parameters()]
+        self._donate = donate
+
+    def _build(self):
+        opt = self.optimizer
+        model = self.model
+
+        def step(params, buffers, opt_state, lr, t, inputs, labels):
+            (loss, new_buffers), grads = self._vg(params, buffers, inputs,
+                                                  labels)
+            # run optimizer updates inside the trace
+            named = dict(model.named_parameters())
+            saved_acc = {k: dict(v) for k, v in opt._accumulators.items()}
+            saved_step = opt._step_count
+            new_params = {}
+            try:
+                # route traced accumulator state in
+                for n, p in named.items():
+                    if p.name in opt_state:
+                        opt._accumulators[p.name] = dict(opt_state[p.name])
+                opt._step_count = t
+                # bypass get_lr()'s float() coercion with the traced lr
+                opt.get_lr = lambda: lr
+                for n, p in named.items():
+                    g = grads.get(n)
+                    if g is None or p.stop_gradient:
+                        new_params[n] = params[n]
+                        continue
+                    real_data = p._data
+                    p._data = params[n]
+                    try:
+                        new_params[n] = opt._update_param(p, g).astype(
+                            params[n].dtype)
+                    finally:
+                        p._data = real_data
+                new_state = {p.name: dict(opt._accumulators.get(p.name, {}))
+                             for p in named.values()}
+            finally:
+                opt.__dict__.pop("get_lr", None)
+                opt._accumulators = saved_acc
+                opt._step_count = saved_step
+            return loss, new_params, new_buffers, new_state
+
+        donate = (0, 2) if self._donate else ()
+        return jax.jit(step, donate_argnums=donate)
+
+    def __call__(self, *batch) -> Tensor:
+        inputs, labels = self._split(batch)
+        if self._jitted is None:
+            self._jitted = self._build()
+        params, buffers = self.model.raw_state()
+        named = dict(self.model.named_parameters())
+        opt = self.optimizer
+        opt_state = {p.name: dict(opt._accumulators.get(p.name, {}))
+                     for p in named.values()}
+        # ensure accumulators exist with correct shapes before first trace
+        if all(not v for v in opt_state.values()):
+            with no_grad():
+                for n, p in named.items():
+                    if not p.stop_gradient:
+                        # warm-init state slots on a sacrificial copy of the
+                        # param (the update rules donate their param buffer)
+                        real = p._data
+                        p._data = jnp.copy(real)
+                        opt._update_param(p, jnp.zeros_like(real))
+                        p._data = real
+            opt_state = {p.name: dict(opt._accumulators.get(p.name, {}))
+                         for p in named.values()}
+        opt._step_count += 1
+        lr = jnp.asarray(opt.get_lr(), jnp.float32)
+        t = jnp.asarray(opt._step_count, jnp.int32)
+        loss, new_params, new_buffers, new_state = self._jitted(
+            params, buffers, opt_state, lr, t,
+            tuple(x._data if isinstance(x, Tensor) else x for x in inputs),
+            tuple(y._data if isinstance(y, Tensor) else y for y in labels))
+        with no_grad():
+            for n, p in named.items():
+                p._data = new_params[n]
+                p.grad_node = None
+            for n, b in self.model.named_buffers():
+                if b is not None and n in new_buffers:
+                    b._data = new_buffers[n]
+            for pname, slots in new_state.items():
+                opt._accumulators[pname] = slots
+        return Tensor(loss, stop_gradient=True)
+
+    @staticmethod
+    def _split(batch) -> Tuple[tuple, tuple]:
+        if len(batch) < 2:
+            return tuple(batch), ()
+        return tuple(batch[:-1]), (batch[-1],)

@@ -1,0 +1,115 @@
+"""jit.save / jit.load — deployable model export.
+
+Reference: python/paddle/jit/api.py save/load producing .pdmodel/.pdiparams
+consumed by AnalysisPredictor. TPU-native: export the traced function as
+StableHLO via jax.export (the serving IR for XLA), with params embedded or
+saved alongside; load returns a callable that executes via XLA.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.io import load as fw_load
+from ..framework.io import save as fw_save
+from ..framework.tensor import Tensor
+from ..nn.layer_base import Layer
+from .static_function import InputSpec, StaticFunction, _unwrap_tree, \
+    _wrap_tree
+
+__all__ = ["save", "load", "TranslatedLayer"]
+
+
+def _spec_to_aval(spec: InputSpec):
+    from ..framework.dtype import to_dtype
+    shape = tuple(1 if s is None or s == -1 else int(s)
+                  for s in spec.shape)
+    return jax.ShapeDtypeStruct(shape, to_dtype(spec.dtype).np_dtype)
+
+
+def save(layer, path: str, input_spec: Optional[Sequence] = None, **configs):
+    """Export ``layer`` (Layer or StaticFunction) to:
+    - ``{path}.stablehlo.mlir``: serialized StableHLO of eval-mode forward
+    - ``{path}.pdiparams``: parameters + buffers (framework.io format)
+    - ``{path}.pdmeta``: input specs + structure metadata
+    """
+    static = layer if isinstance(layer, StaticFunction) else None
+    net: Layer = static.layer if static is not None else layer
+    if not isinstance(net, Layer):
+        raise TypeError("jit.save expects a Layer or to_static(Layer)")
+    if input_spec is None:
+        raise ValueError("input_spec is required for jit.save")
+    specs = [s if isinstance(s, InputSpec) else
+             InputSpec(s.shape, s.dtype.name if hasattr(s.dtype, "name")
+                       else str(s.dtype)) for s in input_spec]
+
+    params, buffers = net.raw_state()
+    net.eval()
+
+    def infer_fn(params_, buffers_, *inputs):
+        wrapped = [Tensor(a) for a in inputs]
+        with net.bind_state(params_, buffers_):
+            out = net(*wrapped)
+        return _unwrap_tree(out)
+
+    avals = [_spec_to_aval(s) for s in specs]
+    p_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+               for k, v in params.items()}
+    b_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+               for k, v in buffers.items()}
+    exported = jax.export.export(jax.jit(infer_fn))(p_avals, b_avals, *avals)
+    blob = exported.serialize()
+
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path + ".stablehlo.mlir", "wb") as f:
+        f.write(blob)
+    fw_save({"params": {k: Tensor(v) for k, v in params.items()},
+             "buffers": {k: Tensor(v) for k, v in buffers.items()}},
+            path + ".pdiparams")
+    with open(path + ".pdmeta", "w") as f:
+        json.dump({"input_specs": [
+            {"shape": list(s.shape), "dtype": s.dtype
+             if isinstance(s.dtype, str) else s.dtype.name}
+            for s in specs]}, f)
+
+
+class TranslatedLayer:
+    """Loaded deployable model (reference: fluid/jit/layer.cc C++ Layer +
+    python TranslatedLayer). Callable; runs the deserialized StableHLO."""
+
+    def __init__(self, exported, params, buffers):
+        self._exported = exported
+        self._params = params
+        self._buffers = buffers
+
+    def __call__(self, *args):
+        arrs = tuple(a._data if isinstance(a, Tensor) else jnp.asarray(a)
+                     for a in args)
+        out = self._exported.call(self._params, self._buffers, *arrs)
+        return _wrap_tree(out)
+
+    forward = __call__
+
+    def eval(self):
+        return self
+
+    def train(self):
+        raise RuntimeError("loaded inference program cannot be trained; "
+                           "load parameters with paddle_tpu.load instead")
+
+
+def load(path: str, **configs) -> TranslatedLayer:
+    with open(path + ".stablehlo.mlir", "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    state = fw_load(path + ".pdiparams")
+    params = {k: v._data for k, v in state["params"].items()}
+    buffers = {k: v._data for k, v in state["buffers"].items()}
+    return TranslatedLayer(exported, params, buffers)

@@ -1,0 +1,152 @@
+"""to_static: trace-based graph capture.
+
+Reference: python/paddle/jit/api.py to_static with two capture paths — AST
+rewriting (dy2static/program_translator.py:1751) and bytecode JIT (sot/,
+~23k LoC + PEP-523 C hook). TPU-native: the Tensor façade dispatches every
+op through jax functions, so ordinary jax.jit tracing captures the whole
+model without AST or bytecode machinery (SURVEY.md §7 hard part #4 —
+trace-based capture with shape/dtype guards via jax.jit's cache; python
+control flow on tensor *values* falls back to eager like SOT graph breaks).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..framework.tensor import Tensor, no_grad
+from ..nn.layer_base import Layer
+from .functional import functional_call
+
+__all__ = ["to_static", "not_to_static", "StaticFunction", "InputSpec"]
+
+
+class InputSpec:
+    """paddle.static.InputSpec analog (shape with None = dynamic dim)."""
+
+    def __init__(self, shape, dtype="float32", name=None):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+class StaticFunction:
+    def __init__(self, function: Callable, input_spec=None,
+                 build_strategy=None, full_graph=True, backend=None):
+        if isinstance(function, Layer):
+            self._layer = function
+            self._fn = type(function).forward
+            self._bound_self = function
+        elif hasattr(function, "__self__") and isinstance(
+                function.__self__, Layer):
+            self._layer = function.__self__
+            self._fn = function.__func__
+            self._bound_self = function.__self__
+        else:
+            self._layer = None
+            self._fn = function
+            self._bound_self = None
+        self._input_spec = input_spec
+        self._jitted = None
+        functools.update_wrapper(self, self._fn)
+
+    @property
+    def layer(self):
+        return self._layer
+
+    def _build(self):
+        layer = self._layer
+        fn = self._fn
+
+        if layer is not None:
+            def pure(params, buffers, training, *arg_arrays):
+                layer.train() if training else layer.eval()
+                wrapped = [Tensor(a) if isinstance(
+                    a, (jax.Array, jax.core.Tracer)) else a
+                    for a in arg_arrays]
+                with layer.bind_state(params, buffers):
+                    out = fn(layer, *wrapped)
+                    new_buffers = {n: b._data
+                                   for n, b in layer.named_buffers()
+                                   if b is not None}
+                return _unwrap_tree(out), new_buffers
+            return jax.jit(pure, static_argnums=(2,))
+
+        def pure(*arg_arrays):
+            wrapped = [Tensor(a) if isinstance(
+                a, (jax.Array, jax.core.Tracer)) else a
+                for a in arg_arrays]
+            return _unwrap_tree(fn(*wrapped))
+        return jax.jit(pure)
+
+    def __call__(self, *args, **kwargs):
+        if kwargs:
+            # keyword args force eager fallback (graph-break semantics)
+            if self._bound_self is not None:
+                return self._fn(self._bound_self, *args, **kwargs)
+            return self._fn(*args, **kwargs)
+        if self._jitted is None:
+            self._jitted = self._build()
+        arg_arrays = tuple(a._data if isinstance(a, Tensor) else a
+                           for a in args)
+        if self._layer is not None:
+            params, buffers = self._layer.raw_state()
+            out, new_buffers = self._jitted(params, buffers,
+                                            self._layer.training,
+                                            *arg_arrays)
+            with no_grad():
+                for n, b in self._layer.named_buffers():
+                    if b is not None and n in new_buffers:
+                        b._data = new_buffers[n]
+            return _wrap_tree(out)
+        return _wrap_tree(self._jitted(*arg_arrays))
+
+    def concrete_program_specify_input_spec(self, *a, **k):
+        return None
+
+    @property
+    def code(self):
+        import inspect
+        return inspect.getsource(self._fn)
+
+
+def _unwrap_tree(out):
+    if isinstance(out, Tensor):
+        return out._data
+    if isinstance(out, (tuple, list)):
+        return tuple(_unwrap_tree(o) for o in out)
+    if isinstance(out, dict):
+        return {k: _unwrap_tree(v) for k, v in out.items()}
+    return out
+
+
+def _wrap_tree(out):
+    if isinstance(out, (jax.Array, np.ndarray)):
+        return Tensor(out)
+    if isinstance(out, (tuple, list)):
+        return tuple(_wrap_tree(o) for o in out)
+    if isinstance(out, dict):
+        return {k: _wrap_tree(v) for k, v in out.items()}
+    return out
+
+
+def to_static(function=None, input_spec=None, build_strategy=None,
+              backend=None, **kwargs):
+    """paddle.jit.to_static analog (decorator or call form)."""
+    def decorate(fn):
+        return StaticFunction(fn, input_spec, build_strategy,
+                              backend=backend)
+
+    if function is not None:
+        return decorate(function)
+    return decorate
+
+
+def not_to_static(function):
+    function._not_to_static = True
+    return function

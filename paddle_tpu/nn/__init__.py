@@ -1,0 +1,16 @@
+"""paddle_tpu.nn — layers + functional
+(reference: python/paddle/nn/, 47.5k LoC)."""
+from .layer_base import Layer, ParamAttr
+from . import functional  # noqa: F401
+from . import initializer  # noqa: F401
+from .layer.common import *  # noqa: F401,F403
+from .layer.conv import *  # noqa: F401,F403
+from .layer.norm import *  # noqa: F401,F403
+from .layer.activation import *  # noqa: F401,F403
+from .layer.pooling import *  # noqa: F401,F403
+from .layer.container import *  # noqa: F401,F403
+from .layer.loss import *  # noqa: F401,F403
+from .layer.transformer import *  # noqa: F401,F403
+from .layer.rnn import *  # noqa: F401,F403
+from .clip import (ClipGradByValue, ClipGradByNorm,  # noqa: F401
+                   ClipGradByGlobalNorm)

@@ -1,0 +1,86 @@
+"""Gradient clipping (reference: python/paddle/nn/clip.py
+ClipGradByValue/ClipGradByNorm/ClipGradByGlobalNorm)."""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, no_grad
+
+__all__ = ["ClipGradByValue", "ClipGradByNorm", "ClipGradByGlobalNorm"]
+
+
+class ClipGradBase:
+    def __call__(self, params_grads: List[Tuple[Tensor, Tensor]]):
+        with no_grad():
+            return self._clip(params_grads)
+
+    def _clip(self, params_grads):
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, Tensor(jnp.clip(g._data, self.min, self.max),
+                                  stop_gradient=True)))
+        return out
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            norm = jnp.sqrt(jnp.sum(jnp.square(
+                g._data.astype(jnp.float32))))
+            scale = jnp.minimum(self.clip_norm / jnp.maximum(norm, 1e-12),
+                                1.0)
+            out.append((p, Tensor((g._data * scale).astype(g._data.dtype),
+                                  stop_gradient=True)))
+        return out
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    """Global-norm clip; under hybrid parallel the distributed optimizer
+    extends this with cross-mesh-axis partial-norm allreduce (reference:
+    hybrid_parallel_optimizer.py:103)."""
+
+    def __init__(self, clip_norm=1.0, group_name="default_group",
+                 auto_skip_clip=False):
+        self.clip_norm = float(clip_norm)
+
+    def _global_norm(self, grads):
+        sq = [jnp.sum(jnp.square(g._data.astype(jnp.float32)))
+              for g in grads]
+        return jnp.sqrt(sum(sq))
+
+    def _clip(self, params_grads):
+        clippable = [(p, g) for p, g in params_grads
+                     if g is not None and getattr(p, "need_clip", True)]
+        if not clippable:
+            return params_grads
+        gn = self._global_norm([g for _, g in clippable])
+        scale = self.clip_norm / jnp.maximum(gn, self.clip_norm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, Tensor((g._data * scale).astype(
+                    g._data.dtype), stop_gradient=True)))
+        return out

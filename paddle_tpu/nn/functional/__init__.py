@@ -1,0 +1,11 @@
+"""paddle.nn.functional analog. All functions lower to jax.numpy/lax
+compositions that XLA fuses on TPU (reference: python/paddle/nn/functional/;
+the reference's 1,100+ CUDA kernels for these collapse into XLA HLO)."""
+from .activation import *  # noqa: F401,F403
+from .common import *  # noqa: F401,F403
+from .conv import *  # noqa: F401,F403
+from .pooling import *  # noqa: F401,F403
+from .norm import *  # noqa: F401,F403
+from .loss import *  # noqa: F401,F403
+from .attention import *  # noqa: F401,F403
+from ..._pad_reexport import pad  # noqa: F401

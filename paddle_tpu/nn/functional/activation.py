@@ -1,0 +1,191 @@
+"""Activation functions (reference: python/paddle/nn/functional/activation.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor, apply_op
+
+__all__ = [
+    "relu", "relu_", "relu6", "gelu", "silu", "swish", "sigmoid",
+    "log_sigmoid", "tanh", "softmax", "log_softmax", "leaky_relu", "elu",
+    "selu", "celu", "hardswish", "hardsigmoid", "hardtanh", "hardshrink",
+    "softshrink", "tanhshrink", "prelu", "mish", "softplus", "softsign",
+    "glu", "gumbel_softmax", "maxout", "rrelu", "thresholded_relu",
+]
+
+
+def _u(fn, name, x, **kw):
+    return apply_op(fn, x, _op_name=name, **kw)
+
+
+def relu(x, name=None):
+    return _u(jax.nn.relu, "relu", x)
+
+
+def relu_(x, name=None):
+    return x._inplace(relu(x))
+
+
+def relu6(x, name=None):
+    return _u(jax.nn.relu6, "relu6", x)
+
+
+def gelu(x, approximate=False, name=None):
+    return _u(lambda a: jax.nn.gelu(a, approximate=approximate), "gelu", x)
+
+
+def silu(x, name=None):
+    return _u(jax.nn.silu, "silu", x)
+
+
+def swish(x, name=None):
+    return _u(jax.nn.silu, "swish", x)
+
+
+def sigmoid(x, name=None):
+    return _u(jax.nn.sigmoid, "sigmoid", x)
+
+
+def log_sigmoid(x, name=None):
+    return _u(jax.nn.log_sigmoid, "log_sigmoid", x)
+
+
+def tanh(x, name=None):
+    return _u(jnp.tanh, "tanh", x)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            from ...framework.dtype import to_dtype
+            a = a.astype(to_dtype(dtype).np_dtype)
+        return jax.nn.softmax(a, axis=axis)
+    return _u(f, "softmax", x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    def f(a):
+        if dtype is not None:
+            from ...framework.dtype import to_dtype
+            a = a.astype(to_dtype(dtype).np_dtype)
+        return jax.nn.log_softmax(a, axis=axis)
+    return _u(f, "log_softmax", x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return _u(lambda a: jax.nn.leaky_relu(a, negative_slope), "leaky_relu", x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return _u(lambda a: jax.nn.elu(a, alpha), "elu", x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return _u(lambda a: scale * jnp.where(a > 0, a,
+                                          alpha * jnp.expm1(a)), "selu", x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return _u(lambda a: jax.nn.celu(a, alpha), "celu", x)
+
+
+def hardswish(x, name=None):
+    return _u(jax.nn.hard_swish, "hardswish", x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return _u(lambda a: jnp.clip(slope * a + offset, 0.0, 1.0),
+              "hardsigmoid", x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):
+    return _u(lambda a: jnp.clip(a, min, max), "hardtanh", x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return _u(lambda a: jnp.where(jnp.abs(a) > threshold, a, 0.0),
+              "hardshrink", x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return _u(lambda a: jnp.where(a > threshold, a - threshold,
+                                  jnp.where(a < -threshold, a + threshold,
+                                            0.0)), "softshrink", x)
+
+
+def tanhshrink(x, name=None):
+    return _u(lambda a: a - jnp.tanh(a), "tanhshrink", x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(a, w):
+        if w.size == 1:
+            return jnp.where(a > 0, a, w.reshape(()) * a)
+        shape = [1] * a.ndim
+        ch_axis = 1 if data_format[1] == "C" else a.ndim - 1
+        shape[ch_axis] = w.size
+        return jnp.where(a > 0, a, w.reshape(shape) * a)
+    return apply_op(f, x, weight, _op_name="prelu")
+
+
+def mish(x, name=None):
+    return _u(lambda a: a * jnp.tanh(jax.nn.softplus(a)), "mish", x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return _u(lambda a: jnp.where(a * beta > threshold, a,
+                                  jax.nn.softplus(a * beta) / beta),
+              "softplus", x)
+
+
+def softsign(x, name=None):
+    return _u(jax.nn.soft_sign, "softsign", x)
+
+
+def glu(x, axis=-1, name=None):
+    return _u(lambda a: jax.nn.glu(a, axis=axis), "glu", x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    from ...framework import random as rnd
+    key = rnd.next_key()
+
+    def f(a):
+        g = jax.random.gumbel(key, a.shape, a.dtype)
+        y = jax.nn.softmax((a + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis)
+            hard_y = jnp.moveaxis(
+                jax.nn.one_hot(idx, y.shape[axis], dtype=y.dtype), -1, axis)
+            return jax.lax.stop_gradient(hard_y - y) + y
+        return y
+    return _u(f, "gumbel_softmax", x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(a):
+        ax = axis % a.ndim
+        c = a.shape[ax]
+        new_shape = a.shape[:ax] + (c // groups, groups) + a.shape[ax + 1:]
+        return jnp.max(a.reshape(new_shape), axis=ax + 1)
+    return _u(f, "maxout", x)
+
+
+def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
+    from ...framework import random as rnd
+    if training:
+        key = rnd.next_key()
+
+        def f(a):
+            slope = jax.random.uniform(key, a.shape, jnp.float32, lower,
+                                       upper).astype(a.dtype)
+            return jnp.where(a >= 0, a, slope * a)
+        return _u(f, "rrelu", x)
+    mid = (lower + upper) / 2.0
+    return _u(lambda a: jnp.where(a >= 0, a, mid * a), "rrelu", x)
+
+
+def thresholded_relu(x, threshold=1.0, value=0.0, name=None):
+    return _u(lambda a: jnp.where(a > threshold, a, value),
+              "thresholded_relu", x)

@@ -1,0 +1,249 @@
+"""Common NN functionals: linear, embedding, dropout, one_hot, interpolate…
+(reference: python/paddle/nn/functional/common.py, input.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework import random as rnd
+from ...framework.tensor import Tensor, apply_op
+
+__all__ = [
+    "linear", "embedding", "one_hot", "dropout", "dropout2d", "dropout3d",
+    "alpha_dropout", "interpolate", "upsample", "pixel_shuffle",
+    "pixel_unshuffle", "channel_shuffle", "cosine_similarity", "bilinear",
+    "unfold", "fold", "label_smooth", "zeropad2d", "normalize",
+]
+
+
+def linear(x, weight, bias=None, name=None):
+    """y = x @ W + b; weight layout [in, out] (python/paddle/nn/functional/
+    common.py linear; MatmulKernel + elementwise_add fused by XLA)."""
+    if bias is None:
+        return apply_op(lambda a, w: a @ w, x, weight, _op_name="linear")
+    return apply_op(lambda a, w, b: a @ w + b, x, weight, bias,
+                    _op_name="linear")
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    """Gather rows; padding_idx rows get zero grad (reference embedding
+    kernel semantics). TPU note: gather lowers to one-hot matmul or dynamic
+    gather chosen by XLA; sparse flag is a no-op."""
+    def f(ids, w):
+        out = jnp.take(w, ids, axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply_op(f, x, weight, _op_name="embedding")
+
+
+def one_hot(x, num_classes, name=None):
+    return apply_op(
+        lambda a: jax.nn.one_hot(a, num_classes, dtype=jnp.float32), x,
+        _op_name="one_hot")
+
+
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+    if p == 1.0:
+        return apply_op(lambda a: jnp.zeros_like(a), x, _op_name="dropout")
+    key = rnd.next_key()
+
+    def f(a):
+        shape = list(a.shape)
+        if axis is not None:
+            axes = axis if isinstance(axis, (list, tuple)) else [axis]
+            shape = [s if i in axes else 1 for i, s in enumerate(a.shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
+        return jnp.where(keep, a, 0.0).astype(a.dtype)
+    return apply_op(f, x, _op_name="dropout")
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    axes = [0, 1] if data_format == "NCHW" else [0, 3]
+    return dropout(x, p, axis=axes, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    axes = [0, 1] if data_format == "NCDHW" else [0, 4]
+    return dropout(x, p, axis=axes, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = rnd.next_key()
+    alpha = 1.6732632423543772
+    scale = 1.0507009873554805
+    alpha_p = -alpha * scale
+
+    def f(a):
+        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+        q = 1.0 - p
+        A = (q + alpha_p ** 2 * q * (1 - q)) ** -0.5
+        B = -A * alpha_p * (1 - q)
+        return (A * jnp.where(keep, a, alpha_p) + B).astype(a.dtype)
+    return apply_op(f, x, _op_name="alpha_dropout")
+
+
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    def f(a):
+        spatial_axes = list(range(2, a.ndim)) if data_format.startswith("NC") \
+            else list(range(1, a.ndim - 1))
+        in_sizes = [a.shape[i] for i in spatial_axes]
+        if size is not None:
+            out_sizes = [int(s) for s in
+                         (size if isinstance(size, (list, tuple)) else [size])]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+                else [scale_factor] * len(in_sizes)
+            out_sizes = [int(s * f_) for s, f_ in zip(in_sizes, sf)]
+        new_shape = list(a.shape)
+        for ax, s in zip(spatial_axes, out_sizes):
+            new_shape[ax] = s
+        method = {"nearest": "nearest", "bilinear": "bilinear",
+                  "trilinear": "trilinear", "bicubic": "cubic",
+                  "linear": "linear", "area": "linear"}[mode]
+        return jax.image.resize(a, tuple(new_shape), method=method)
+    return apply_op(f, x, _op_name="interpolate")
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW",
+             name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners,
+                       align_mode, data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(a):
+        n, c, h, w = a.shape
+        oc = c // (r * r)
+        y = a.reshape(n, oc, r, r, h, w)
+        y = jnp.transpose(y, (0, 1, 4, 2, 5, 3))
+        return y.reshape(n, oc, h * r, w * r)
+    return apply_op(f, x, _op_name="pixel_shuffle")
+
+
+def pixel_unshuffle(x, downscale_factor, data_format="NCHW", name=None):
+    r = downscale_factor
+
+    def f(a):
+        n, c, h, w = a.shape
+        y = a.reshape(n, c, h // r, r, w // r, r)
+        y = jnp.transpose(y, (0, 1, 3, 5, 2, 4))
+        return y.reshape(n, c * r * r, h // r, w // r)
+    return apply_op(f, x, _op_name="pixel_unshuffle")
+
+
+def channel_shuffle(x, groups, data_format="NCHW", name=None):
+    def f(a):
+        n, c, h, w = a.shape
+        y = a.reshape(n, groups, c // groups, h, w)
+        y = jnp.swapaxes(y, 1, 2)
+        return y.reshape(n, c, h, w)
+    return apply_op(f, x, _op_name="channel_shuffle")
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8, name=None):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.sqrt(jnp.sum(a * a, axis=axis))
+        nb = jnp.sqrt(jnp.sum(b * b, axis=axis))
+        return dot / jnp.maximum(na * nb, eps)
+    return apply_op(f, x1, x2, _op_name="cosine_similarity")
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    def f(a, b, w, *bias_arr):
+        out = jnp.einsum("bi,oij,bj->bo", a, w, b)
+        if bias_arr:
+            out = out + bias_arr[0]
+        return out
+    args = [x1, x2, weight] + ([bias] if bias is not None else [])
+    return apply_op(f, *args, _op_name="bilinear")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) \
+        else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def f(a):
+        n, c, h, w = a.shape
+        a_p = jnp.pad(a, ((0, 0), (0, 0), (pd[0], pd[0]), (pd[1], pd[1])))
+        oh = (h + 2 * pd[0] - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+        ow = (w + 2 * pd[1] - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+        patches = []
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                di, dj = i * dl[0], j * dl[1]
+                patches.append(
+                    a_p[:, :, di:di + (oh - 1) * st[0] + 1:st[0],
+                        dj:dj + (ow - 1) * st[1] + 1:st[1]])
+        col = jnp.stack(patches, axis=2)  # [n, c, kh*kw, oh, ow]
+        return col.reshape(n, c * ks[0] * ks[1], oh * ow)
+    return apply_op(f, x, _op_name="unfold")
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    os_ = output_sizes if isinstance(output_sizes, (list, tuple)) \
+        else [output_sizes] * 2
+    ks = kernel_sizes if isinstance(kernel_sizes, (list, tuple)) \
+        else [kernel_sizes] * 2
+    st = strides if isinstance(strides, (list, tuple)) else [strides] * 2
+    pd = paddings if isinstance(paddings, (list, tuple)) else [paddings] * 2
+    dl = dilations if isinstance(dilations, (list, tuple)) else [dilations] * 2
+
+    def f(a):
+        n, ckk, L = a.shape
+        c = ckk // (ks[0] * ks[1])
+        oh = (os_[0] + 2 * pd[0] - dl[0] * (ks[0] - 1) - 1) // st[0] + 1
+        ow = (os_[1] + 2 * pd[1] - dl[1] * (ks[1] - 1) - 1) // st[1] + 1
+        col = a.reshape(n, c, ks[0], ks[1], oh, ow)
+        out = jnp.zeros((n, c, os_[0] + 2 * pd[0], os_[1] + 2 * pd[1]),
+                        a.dtype)
+        for i in range(ks[0]):
+            for j in range(ks[1]):
+                di, dj = i * dl[0], j * dl[1]
+                out = out.at[:, :, di:di + (oh - 1) * st[0] + 1:st[0],
+                             dj:dj + (ow - 1) * st[1] + 1:st[1]].add(
+                    col[:, :, i, j])
+        return out[:, :, pd[0]:pd[0] + os_[0], pd[1]:pd[1] + os_[1]]
+    return apply_op(f, x, _op_name="fold")
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(lbl):
+        k = lbl.shape[-1]
+        if prior_dist is not None:
+            from ...framework.tensor import _unwrap
+            return (1 - epsilon) * lbl + epsilon * _unwrap(prior_dist)
+        return (1 - epsilon) * lbl + epsilon / k
+    return apply_op(f, label, _op_name="label_smooth")
+
+
+def zeropad2d(x, padding, data_format="NCHW", name=None):
+    from ...ops.manipulation import pad as pad_op
+    return pad_op(x, padding, mode="constant", value=0.0,
+                  data_format=data_format)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    def f(a):
+        nrm = jnp.power(jnp.sum(jnp.power(jnp.abs(a), p), axis=axis,
+                                keepdims=True), 1.0 / p)
+        return a / jnp.maximum(nrm, epsilon)
+    return apply_op(f, x, _op_name="normalize")

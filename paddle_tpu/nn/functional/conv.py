@@ -1,0 +1,150 @@
+"""Convolution functionals via lax.conv_general_dilated (reference:
+python/paddle/nn/functional/conv.py; phi conv kernels + cudnn autotune —
+on TPU, XLA picks the MXU tiling so there is no autotune subsystem)."""
+from __future__ import annotations
+
+from typing import Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor, apply_op
+
+__all__ = ["conv1d", "conv2d", "conv3d", "conv1d_transpose",
+           "conv2d_transpose", "conv3d_transpose"]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        if len(v) == n:
+            return tuple(int(i) for i in v)
+        if len(v) == 1:
+            return tuple(int(v[0]) for _ in range(n))
+        return tuple(int(i) for i in v)
+    return tuple(int(v) for _ in range(n))
+
+
+def _padding(padding, n):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, int):
+        return [(padding, padding)] * n
+    padding = list(padding)
+    if len(padding) == n and all(isinstance(p, int) for p in padding):
+        return [(p, p) for p in padding]
+    if len(padding) == 2 * n:
+        return [(padding[2 * i], padding[2 * i + 1]) for i in range(n)]
+    return [tuple(p) for p in padding]
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, n,
+          data_format, op_name):
+    strides = _tuple(stride, n)
+    dil = _tuple(dilation, n)
+    pad = _padding(padding, n)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    spatial = "DHW"[3 - n:] if n < 3 else "DHW"
+    if channel_last:
+        dn_in = "N" + spatial + "C"
+    else:
+        dn_in = "NC" + spatial
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape),
+        (dn_in, "OI" + spatial, dn_in))
+
+    def f(a, w, *b):
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=strides, padding=pad,
+            rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups,
+            preferred_element_type=a.dtype)
+        if b:
+            bshape = [1] * out.ndim
+            bshape[1 if not channel_last else out.ndim - 1] = b[0].shape[0]
+            out = out + b[0].reshape(bshape)
+        return out
+
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return apply_op(f, *args, _op_name=op_name)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NCL" if data_format == "NCL" else "NLC"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 1,
+                 fmt, "conv1d")
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 2,
+                 data_format, "conv2d")
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, 3,
+                 data_format, "conv3d")
+
+
+def _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                    dilation, groups, n, data_format, op_name,
+                    output_size=None):
+    strides = _tuple(stride, n)
+    dil = _tuple(dilation, n)
+    pad = _padding(padding, n)
+    out_pad = _tuple(output_padding, n)
+    channel_last = data_format in ("NHWC", "NLC", "NDHWC")
+    spatial = "DHW"[3 - n:]
+    dn_in = ("N" + spatial + "C") if channel_last else ("NC" + spatial)
+    # weight layout [in_c, out_c/groups, *k] (paddle conv_transpose layout)
+    dn = jax.lax.conv_dimension_numbers(
+        tuple(x.shape), tuple(weight.shape), (dn_in, "IO" + spatial, dn_in))
+
+    def f(a, w, *b):
+        if isinstance(pad, str):
+            padding_cfg = pad
+        else:
+            # conv_transpose padding semantics: p amounts removed from output
+            k_eff = [dil[i] * (w.shape[2 + i] - 1) + 1 for i in range(n)]
+            padding_cfg = [
+                (k_eff[i] - 1 - pad[i][0],
+                 k_eff[i] - 1 - pad[i][1] + out_pad[i])
+                for i in range(n)
+            ]
+        out = jax.lax.conv_general_dilated(
+            a, w, window_strides=(1,) * n, padding=padding_cfg,
+            lhs_dilation=strides, rhs_dilation=dil, dimension_numbers=dn,
+            feature_group_count=groups, preferred_element_type=a.dtype)
+        if b:
+            bshape = [1] * out.ndim
+            bshape[1 if not channel_last else out.ndim - 1] = b[0].shape[0]
+            out = out + b[0].reshape(bshape)
+        return out
+
+    args = [x, weight] + ([bias] if bias is not None else [])
+    return apply_op(f, *args, _op_name=op_name)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCL", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 1, data_format,
+                           "conv1d_transpose", output_size)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 2, data_format,
+                           "conv2d_transpose", output_size)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0,
+                     output_padding=0, groups=1, dilation=1,
+                     output_size=None, data_format="NCDHW", name=None):
+    return _conv_transpose(x, weight, bias, stride, padding, output_padding,
+                           dilation, groups, 3, data_format,
+                           "conv3d_transpose", output_size)

@@ -1,0 +1,310 @@
+"""Loss functionals (reference: python/paddle/nn/functional/loss.py;
+softmax_with_cross_entropy kernel phi/kernels/cross_entropy_*)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor, apply_op
+
+__all__ = [
+    "cross_entropy", "softmax_with_cross_entropy", "mse_loss", "l1_loss",
+    "nll_loss", "binary_cross_entropy", "binary_cross_entropy_with_logits",
+    "smooth_l1_loss", "kl_div", "margin_ranking_loss", "cosine_embedding_loss",
+    "hinge_embedding_loss", "log_loss", "square_error_cost", "ctc_loss",
+    "triplet_margin_loss", "sigmoid_focal_loss", "dice_loss",
+]
+
+
+def _reduce(val, reduction):
+    if reduction == "mean":
+        return jnp.mean(val)
+    if reduction == "sum":
+        return jnp.sum(val)
+    return val
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100,
+                  reduction="mean", soft_label=False, axis=-1,
+                  use_softmax=True, label_smoothing=0.0, name=None):
+    def f(logits, lbl, *w):
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=axis) \
+            if use_softmax else jnp.log(jnp.maximum(
+                logits.astype(jnp.float32), 1e-30))
+        if soft_label or (lbl.ndim == logits.ndim and
+                          lbl.shape[axis] == logits.shape[axis] and
+                          jnp.issubdtype(lbl.dtype, jnp.floating)):
+            soft = lbl.astype(jnp.float32)
+            if label_smoothing > 0.0:
+                k = logits.shape[axis]
+                soft = (1 - label_smoothing) * soft + label_smoothing / k
+            loss = -jnp.sum(soft * lp, axis=axis)
+            return _reduce(loss, reduction)
+        idx = lbl
+        squeeze = False
+        if idx.ndim == logits.ndim:
+            idx = jnp.squeeze(idx, axis=axis)
+            squeeze = True
+        if label_smoothing > 0.0:
+            k = logits.shape[axis]
+            oh = jax.nn.one_hot(idx, k, axis=axis, dtype=jnp.float32)
+            soft = (1 - label_smoothing) * oh + label_smoothing / k
+            loss = -jnp.sum(soft * lp, axis=axis)
+        else:
+            safe = jnp.where(idx == ignore_index, 0, idx)
+            picked = jnp.take_along_axis(
+                lp, jnp.expand_dims(safe, axis), axis=axis)
+            loss = -jnp.squeeze(picked, axis=axis)
+        mask = (idx != ignore_index)
+        loss = jnp.where(mask, loss, 0.0)
+        if w:
+            wt = jnp.take(w[0], jnp.where(idx == ignore_index, 0, idx))
+            wt = jnp.where(mask, wt, 0.0)
+            loss = loss * wt
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.maximum(jnp.sum(wt), 1e-12)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(mask.astype(jnp.float32)), 1.0)
+        return _reduce(loss, reduction)
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op(f, *args, _op_name="cross_entropy")
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none",
+                         axis=axis)
+    loss = loss.unsqueeze(axis)
+    if return_softmax:
+        from .activation import softmax as softmax_fn
+        return loss, softmax_fn(logits, axis=axis)
+    return loss
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return apply_op(lambda a, b: _reduce(jnp.square(a - b), reduction),
+                    input, label, _op_name="mse_loss")
+
+
+def square_error_cost(input, label):
+    return apply_op(lambda a, b: jnp.square(a - b), input, label,
+                    _op_name="square_error_cost")
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return apply_op(lambda a, b: _reduce(jnp.abs(a - b), reduction),
+                    input, label, _op_name="l1_loss")
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    def f(lp, lbl, *w):
+        picked = jnp.take_along_axis(lp, lbl[:, None], axis=1)[:, 0]
+        loss = -picked
+        mask = lbl != ignore_index
+        loss = jnp.where(mask, loss, 0.0)
+        if w:
+            wt = jnp.take(w[0], jnp.where(mask, lbl, 0)) * mask
+            loss = loss * wt
+            if reduction == "mean":
+                return jnp.sum(loss) / jnp.sum(wt)
+        if reduction == "mean":
+            return jnp.sum(loss) / jnp.maximum(
+                jnp.sum(mask.astype(jnp.float32)), 1.0)
+        return _reduce(loss, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op(f, *args, _op_name="nll_loss")
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean",
+                         name=None):
+    def f(p, y, *w):
+        p = jnp.clip(p, 1e-12, 1.0 - 1e-7)
+        loss = -(y * jnp.log(p) + (1 - y) * jnp.log(1 - p))
+        if w:
+            loss = loss * w[0]
+        return _reduce(loss, reduction)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return apply_op(f, *args, _op_name="binary_cross_entropy")
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None,
+                                     reduction="mean", pos_weight=None,
+                                     name=None):
+    def f(z, y, *extra):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = extra[i]
+            i += 1
+        if pos_weight is not None:
+            pw = extra[i]
+        # stable: max(z,0) - z*y + log(1+exp(-|z|)) with pos_weight variant
+        log_sig = jax.nn.log_sigmoid(z)
+        log_sig_neg = jax.nn.log_sigmoid(-z)
+        if pw is not None:
+            loss = -(pw * y * log_sig + (1 - y) * log_sig_neg)
+        else:
+            loss = -(y * log_sig + (1 - y) * log_sig_neg)
+        if w is not None:
+            loss = loss * w
+        return _reduce(loss, reduction)
+    args = [logit, label]
+    if weight is not None:
+        args.append(weight)
+    if pos_weight is not None:
+        args.append(pos_weight)
+    return apply_op(f, *args, _op_name="bce_with_logits")
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        loss = jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta)
+        return _reduce(loss, reduction)
+    return apply_op(f, input, label, _op_name="smooth_l1_loss")
+
+
+def kl_div(input, label, reduction="mean", log_target=False, name=None):
+    def f(lp, t):
+        if log_target:
+            loss = jnp.exp(t) * (t - lp)
+        else:
+            loss = t * (jnp.log(jnp.maximum(t, 1e-12)) - lp)
+        if reduction == "batchmean":
+            return jnp.sum(loss) / lp.shape[0]
+        return _reduce(loss, reduction)
+    return apply_op(f, input, label, _op_name="kl_div")
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    return apply_op(
+        lambda a, b, y: _reduce(jnp.maximum(0.0, -y * (a - b) + margin),
+                                reduction),
+        input, other, label, _op_name="margin_ranking_loss")
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0.0,
+                          reduction="mean", name=None):
+    def f(a, b, y):
+        cos = jnp.sum(a * b, axis=-1) / jnp.maximum(
+            jnp.linalg.norm(a, axis=-1) * jnp.linalg.norm(b, axis=-1), 1e-12)
+        loss = jnp.where(y == 1, 1 - cos, jnp.maximum(0.0, cos - margin))
+        return _reduce(loss, reduction)
+    return apply_op(f, input1, input2, label,
+                    _op_name="cosine_embedding_loss")
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean",
+                         name=None):
+    def f(a, y):
+        loss = jnp.where(y == 1, a, jnp.maximum(0.0, margin - a))
+        return _reduce(loss, reduction)
+    return apply_op(f, input, label, _op_name="hinge_embedding_loss")
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    return apply_op(
+        lambda p, y: -y * jnp.log(p + epsilon) -
+        (1 - y) * jnp.log(1 - p + epsilon),
+        input, label, _op_name="log_loss")
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, y, *n):
+        p = jax.nn.sigmoid(z)
+        ce = -(y * jax.nn.log_sigmoid(z) + (1 - y) * jax.nn.log_sigmoid(-z))
+        p_t = p * y + (1 - p) * (1 - y)
+        a_t = alpha * y + (1 - alpha) * (1 - y)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return _reduce(loss, reduction)
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return apply_op(f, *args, _op_name="sigmoid_focal_loss")
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    def f(p, y):
+        y_oh = jax.nn.one_hot(jnp.squeeze(y, -1), p.shape[-1], dtype=p.dtype)
+        red = tuple(range(1, p.ndim))
+        inter = jnp.sum(p * y_oh, axis=red)
+        union = jnp.sum(p, axis=red) + jnp.sum(y_oh, axis=red)
+        return jnp.mean(1 - (2 * inter + epsilon) / (union + epsilon))
+    return apply_op(f, input, label, _op_name="dice_loss")
+
+
+def triplet_margin_loss(input, positive, negative, margin=1.0, p=2.0,
+                        epsilon=1e-6, swap=False, reduction="mean",
+                        name=None):
+    def f(a, pos, neg):
+        def dist(u, v):
+            return jnp.power(jnp.sum(jnp.power(jnp.abs(u - v) + epsilon, p),
+                                     axis=-1), 1.0 / p)
+        d_pos = dist(a, pos)
+        d_neg = dist(a, neg)
+        if swap:
+            d_neg = jnp.minimum(d_neg, dist(pos, neg))
+        return _reduce(jnp.maximum(0.0, d_pos - d_neg + margin), reduction)
+    return apply_op(f, input, positive, negative,
+                    _op_name="triplet_margin_loss")
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via jax log-domain DP (reference: warpctc external lib —
+    paddle/phi/kernels/impl/warpctc_kernel_impl.h). Expects
+    log_probs [T, B, C] (paddle layout) and integer labels [B, L]."""
+    def f(lp, lbl, in_len, lbl_len):
+        T, B, C = lp.shape
+        L = lbl.shape[1]
+        S = 2 * L + 1
+        # extended label sequence with blanks
+        ext = jnp.full((B, S), blank, dtype=lbl.dtype)
+        ext = ext.at[:, 1::2].set(lbl)
+        neg_inf = -1e30
+
+        emit = jnp.take_along_axis(
+            jnp.transpose(lp, (1, 0, 2)),  # [B, T, C]
+            ext[:, None, :].astype(jnp.int32), axis=2)  # [B, T, S]
+
+        alpha0 = jnp.full((B, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(emit[:, 0, 0])
+        alpha0 = alpha0.at[:, 1].set(jnp.where(lbl_len > 0, emit[:, 0, 1],
+                                               neg_inf))
+
+        same = jnp.concatenate(
+            [jnp.full((B, 2), True),
+             ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, t):
+            a_shift1 = jnp.concatenate(
+                [jnp.full((B, 1), neg_inf), alpha[:, :-1]], axis=1)
+            a_shift2 = jnp.concatenate(
+                [jnp.full((B, 2), neg_inf), alpha[:, :-2]], axis=1)
+            a_shift2 = jnp.where(same, neg_inf, a_shift2)
+            merged = jnp.logaddexp(jnp.logaddexp(alpha, a_shift1), a_shift2)
+            new = merged + emit[:, t, :]
+            new = jnp.where((t < in_len)[:, None], new, alpha)
+            return new, None
+
+        alpha, _ = jax.lax.scan(step, alpha0, jnp.arange(1, T))
+        end1 = jnp.take_along_axis(alpha, (2 * lbl_len)[:, None],
+                                   axis=1)[:, 0]
+        end2 = jnp.take_along_axis(alpha, (2 * lbl_len - 1)[:, None],
+                                   axis=1)[:, 0]
+        ll = jnp.logaddexp(end1, end2)
+        loss = -ll
+        if reduction == "mean":
+            return jnp.mean(loss / jnp.maximum(lbl_len.astype(jnp.float32),
+                                               1.0))
+        return _reduce(loss, reduction)
+    return apply_op(f, log_probs, labels, input_lengths, label_lengths,
+                    _op_name="ctc_loss")

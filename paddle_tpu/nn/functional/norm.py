@@ -1,0 +1,184 @@
+"""Normalization functionals (reference: python/paddle/nn/functional/norm.py;
+phi batch_norm/layer_norm kernels + SPMD rules spmd_rules/layer_norm.cc).
+
+batch_norm updates running stats through the Tensor façade's functional
+mutation — stats tensors are rebound, never mutated, so the op stays
+jit-safe when stats are carried explicitly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor, apply_op, no_grad
+
+__all__ = ["batch_norm", "layer_norm", "group_norm", "instance_norm",
+           "local_response_norm", "rms_norm"]
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5,
+               data_format="NCHW", use_global_stats=None, name=None):
+    ch_axis = 1 if data_format.startswith("NC") else x.ndim - 1
+    axes = tuple(i for i in range(x.ndim) if i != ch_axis)
+    use_batch = training and not use_global_stats
+
+    if use_batch:
+        # compute batch stats; update running stats (paddle momentum
+        # convention: running = momentum*running + (1-momentum)*batch)
+        def stats(a):
+            m = jnp.mean(a, axis=axes)
+            v = jnp.var(a, axis=axes)
+            return m, v
+        m_t, v_t = apply_op(stats, x, _op_name="bn_stats")
+        with no_grad():
+            n = x.size // x.shape[ch_axis]
+            unbiased = v_t._data * (n / max(n - 1, 1))
+            running_mean._data = (momentum * running_mean._data +
+                                  (1 - momentum) * m_t._data).astype(
+                running_mean._data.dtype)
+            running_var._data = (momentum * running_var._data +
+                                 (1 - momentum) * unbiased).astype(
+                running_var._data.dtype)
+        mean_used, var_used = m_t, v_t
+    else:
+        mean_used, var_used = running_mean, running_var
+
+    shape = [1] * x.ndim
+    shape[ch_axis] = x.shape[ch_axis]
+
+    def f(a, m, v, *wb):
+        inv = jax.lax.rsqrt(v.reshape(shape).astype(jnp.float32) + epsilon)
+        out = (a - m.reshape(shape)) * inv.astype(a.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x, mean_used, var_used]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply_op(f, *args, _op_name="batch_norm")
+
+
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    ns = normalized_shape if isinstance(normalized_shape, (list, tuple)) \
+        else [normalized_shape]
+    axes = tuple(range(x.ndim - len(ns), x.ndim))
+
+    def f(a, *wb):
+        # fp32 accumulation for bf16 inputs (matches reference fp16/bf16
+        # layer_norm numerics: compute in fp32, cast back)
+        af = a.astype(jnp.float32)
+        m = jnp.mean(af, axis=axes, keepdims=True)
+        v = jnp.var(af, axis=axes, keepdims=True)
+        out = (af - m) * jax.lax.rsqrt(v + epsilon)
+        out = out.astype(a.dtype)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply_op(f, *args, _op_name="layer_norm")
+
+
+def rms_norm(x, weight=None, epsilon=1e-6, begin_norm_axis=-1, name=None):
+    """RMSNorm (reference exposes fused rms_norm via incubate
+    python/paddle/incubate/nn/functional/fused_rms_norm.py)."""
+    axis = begin_norm_axis if begin_norm_axis >= 0 else x.ndim + begin_norm_axis
+    axes = tuple(range(axis, x.ndim))
+
+    def f(a, *w):
+        af = a.astype(jnp.float32)
+        ms = jnp.mean(jnp.square(af), axis=axes, keepdims=True)
+        out = (af * jax.lax.rsqrt(ms + epsilon)).astype(a.dtype)
+        if w:
+            out = out * w[0]
+        return out
+
+    args = [x] + ([weight] if weight is not None else [])
+    return apply_op(f, *args, _op_name="rms_norm")
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    def f(a, *wb):
+        n, c = a.shape[0], a.shape[1]
+        spatial = a.shape[2:]
+        g = a.reshape(n, num_groups, c // num_groups, *spatial)
+        axes = tuple(range(2, g.ndim))
+        m = jnp.mean(g, axis=axes, keepdims=True)
+        v = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - m) * jax.lax.rsqrt(v + epsilon)).reshape(a.shape)
+        shape = [1, c] + [1] * len(spatial)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply_op(f, *args, _op_name="group_norm")
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None,
+                  bias=None, use_input_stats=True, momentum=0.9,
+                  epsilon=1e-5, data_format="NCHW", name=None):
+    axes = tuple(range(2, x.ndim))
+
+    def f(a, *wb):
+        m = jnp.mean(a, axis=axes, keepdims=True)
+        v = jnp.var(a, axis=axes, keepdims=True)
+        out = (a - m) * jax.lax.rsqrt(v + epsilon)
+        c = a.shape[1]
+        shape = [1, c] + [1] * (a.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [x]
+    if weight is not None:
+        args.append(weight)
+    if bias is not None:
+        args.append(bias)
+    return apply_op(f, *args, _op_name="instance_norm")
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def f(a):
+        sq = jnp.square(a)
+        half = size // 2
+        c = a.shape[1]
+        pad_cfg = [(0, 0)] * a.ndim
+        pad_cfg[1] = (half, size - half - 1)
+        padded = jnp.pad(sq, pad_cfg)
+        acc = jnp.zeros_like(a)
+        for i in range(size):
+            acc = acc + jax.lax.slice_in_dim(padded, i, i + c, axis=1)
+        return a / jnp.power(k + alpha * acc, beta)
+    return apply_op(f, x, _op_name="local_response_norm")

@@ -1,0 +1,156 @@
+"""Pooling functionals via lax.reduce_window
+(reference: python/paddle/nn/functional/pooling.py)."""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor, apply_op
+
+__all__ = ["avg_pool1d", "avg_pool2d", "avg_pool3d", "max_pool1d",
+           "max_pool2d", "max_pool3d", "adaptive_avg_pool1d",
+           "adaptive_avg_pool2d", "adaptive_avg_pool3d",
+           "adaptive_max_pool1d", "adaptive_max_pool2d",
+           "adaptive_max_pool3d"]
+
+
+def _tuple(v, n):
+    if isinstance(v, (list, tuple)):
+        return tuple(int(i) for i in (v if len(v) == n else list(v) * n))[:n]
+    return tuple(int(v) for _ in range(n))
+
+
+def _pad_cfg(padding, n, ceil_mode, in_sizes, k, s):
+    if isinstance(padding, str):
+        return padding.upper()
+    p = _tuple(padding, n)
+    cfg = [(pi, pi) for pi in p]
+    if ceil_mode:
+        out = []
+        for i in range(n):
+            size = in_sizes[i] + 2 * p[i]
+            rem = (size - k[i]) % s[i]
+            extra = (s[i] - rem) % s[i] if rem else 0
+            out.append((p[i], p[i] + extra))
+        cfg = out
+    return cfg
+
+
+def _pool(x, kernel_size, stride, padding, n, reducer, init, ceil_mode,
+          count_include_pad, op_name, divide_counts=False):
+    k = _tuple(kernel_size, n)
+    s = _tuple(stride if stride is not None else kernel_size, n)
+
+    def f(a):
+        in_sizes = a.shape[2:]
+        cfg = _pad_cfg(padding, n, ceil_mode, in_sizes, k, s)
+        window = (1, 1) + k
+        strides = (1, 1) + s
+        pads = cfg if isinstance(cfg, str) else [(0, 0), (0, 0)] + cfg
+        out = jax.lax.reduce_window(a, init, reducer, window, strides, pads)
+        if divide_counts:
+            if isinstance(cfg, str) or count_include_pad:
+                denom = float(np.prod(k))
+                out = out / denom
+            else:
+                ones = jnp.ones(a.shape, a.dtype)
+                counts = jax.lax.reduce_window(ones, 0.0, jax.lax.add,
+                                               window, strides, pads)
+                out = out / counts
+        return out
+    return apply_op(f, x, _op_name=op_name)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, jax.lax.max,
+                 -jnp.inf, ceil_mode, True, "max_pool1d")
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, jax.lax.max,
+                 -jnp.inf, ceil_mode, True, "max_pool2d")
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.max,
+                 -jnp.inf, ceil_mode, True, "max_pool3d")
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    return _pool(x, kernel_size, stride, padding, 1, jax.lax.add, 0.0,
+                 ceil_mode, not exclusive, "avg_pool1d", divide_counts=True)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, jax.lax.add, 0.0,
+                 ceil_mode, not exclusive, "avg_pool2d", divide_counts=True)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, ceil_mode=False,
+               exclusive=True, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, jax.lax.add, 0.0,
+                 ceil_mode, not exclusive, "avg_pool3d", divide_counts=True)
+
+
+def _adaptive(x, output_size, n, is_max, op_name):
+    out_s = _tuple(output_size, n)
+
+    def f(a):
+        # adaptive pooling: split each spatial dim into output_size regions
+        spatial = a.shape[2:]
+        if all(s % o == 0 for s, o in zip(spatial, out_s)):
+            k = tuple(s // o for s, o in zip(spatial, out_s))
+            window = (1, 1) + k
+            red = jax.lax.max if is_max else jax.lax.add
+            init = -jnp.inf if is_max else 0.0
+            out = jax.lax.reduce_window(a, init, red, window, window,
+                                        "VALID")
+            return out if is_max else out / float(np.prod(k))
+        # general case: mean/max over variable regions via per-dim gather
+        out = a
+        for d in range(n):
+            size, o = out.shape[2 + d], out_s[d]
+            starts = (np.arange(o) * size) // o
+            ends = ((np.arange(o) + 1) * size + o - 1) // o
+            slabs = []
+            for st, en in zip(starts, ends):
+                region = jax.lax.slice_in_dim(out, int(st), int(en),
+                                              axis=2 + d)
+                red = jnp.max if is_max else jnp.mean
+                slabs.append(red(region, axis=2 + d, keepdims=True))
+            out = jnp.concatenate(slabs, axis=2 + d)
+        return out
+    return apply_op(f, x, _op_name=op_name)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    return _adaptive(x, output_size, 1, False, "adaptive_avg_pool1d")
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    return _adaptive(x, output_size, 2, False, "adaptive_avg_pool2d")
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    return _adaptive(x, output_size, 3, False, "adaptive_avg_pool3d")
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 1, True, "adaptive_max_pool1d")
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 2, True, "adaptive_max_pool2d")
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    return _adaptive(x, output_size, 3, True, "adaptive_max_pool3d")

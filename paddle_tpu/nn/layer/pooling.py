@@ -1,0 +1,121 @@
+"""Pooling layers (reference: python/paddle/nn/layer/pooling.py)."""
+from __future__ import annotations
+
+from .. import functional as F
+from ..layer_base import Layer
+
+__all__ = ["AvgPool1D", "AvgPool2D", "AvgPool3D", "MaxPool1D", "MaxPool2D",
+           "MaxPool3D", "AdaptiveAvgPool1D", "AdaptiveAvgPool2D",
+           "AdaptiveAvgPool3D", "AdaptiveMaxPool1D", "AdaptiveMaxPool2D",
+           "AdaptiveMaxPool3D"]
+
+
+class _Pool(Layer):
+    _fn = None
+
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 **kw):
+        super().__init__()
+        self._kernel_size = kernel_size
+        self._stride = stride
+        self._padding = padding
+        self._ceil_mode = ceil_mode
+
+    def forward(self, x):
+        return getattr(F, type(self)._fn)(
+            x, self._kernel_size, self._stride, self._padding,
+            ceil_mode=self._ceil_mode)
+
+    def extra_repr(self):
+        return f"kernel_size={self._kernel_size}, stride={self._stride}, " \
+               f"padding={self._padding}"
+
+
+class MaxPool1D(_Pool):
+    _fn = "max_pool1d"
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask=False, ceil_mode=False, name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode)
+
+
+class MaxPool2D(_Pool):
+    _fn = "max_pool2d"
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask=False, ceil_mode=False, data_format="NCHW",
+                 name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode)
+
+
+class MaxPool3D(_Pool):
+    _fn = "max_pool3d"
+
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 return_mask=False, ceil_mode=False, data_format="NCDHW",
+                 name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode)
+
+
+class AvgPool1D(_Pool):
+    _fn = "avg_pool1d"
+
+    def __init__(self, kernel_size, stride=None, padding=0, exclusive=True,
+                 ceil_mode=False, name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode)
+
+
+class AvgPool2D(_Pool):
+    _fn = "avg_pool2d"
+
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCHW",
+                 name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode)
+
+
+class AvgPool3D(_Pool):
+    _fn = "avg_pool3d"
+
+    def __init__(self, kernel_size, stride=None, padding=0, ceil_mode=False,
+                 exclusive=True, divisor_override=None, data_format="NCDHW",
+                 name=None):
+        super().__init__(kernel_size, stride, padding, ceil_mode)
+
+
+class _AdaptivePool(Layer):
+    _fn = None
+
+    def __init__(self, output_size, **kw):
+        super().__init__()
+        self._output_size = output_size
+
+    def forward(self, x):
+        return getattr(F, type(self)._fn)(x, self._output_size)
+
+    def extra_repr(self):
+        return f"output_size={self._output_size}"
+
+
+class AdaptiveAvgPool1D(_AdaptivePool):
+    _fn = "adaptive_avg_pool1d"
+
+
+class AdaptiveAvgPool2D(_AdaptivePool):
+    _fn = "adaptive_avg_pool2d"
+
+
+class AdaptiveAvgPool3D(_AdaptivePool):
+    _fn = "adaptive_avg_pool3d"
+
+
+class AdaptiveMaxPool1D(_AdaptivePool):
+    _fn = "adaptive_max_pool1d"
+
+
+class AdaptiveMaxPool2D(_AdaptivePool):
+    _fn = "adaptive_max_pool2d"
+
+
+class AdaptiveMaxPool3D(_AdaptivePool):
+    _fn = "adaptive_max_pool3d"

@@ -1,0 +1,304 @@
+"""Recurrent layers (reference: python/paddle/nn/layer/rnn.py).
+
+TPU-native: the time loop is a single ``lax.scan`` inside one traced op, so
+the whole sequence compiles to one fused XLA while-loop instead of the
+reference's per-step kernel launches (or cudnn RNN descriptors)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework.tensor import Tensor, apply_op
+from .. import initializer as I
+from ..layer_base import Layer
+
+__all__ = ["SimpleRNNCell", "LSTMCell", "GRUCell", "RNN", "SimpleRNN",
+           "LSTM", "GRU", "BiRNN"]
+
+
+def _simple_step(x, h, w_ih, w_hh, b_ih, b_hh, activation):
+    pre = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+    return jnp.tanh(pre) if activation == "tanh" else jax.nn.relu(pre)
+
+
+def _lstm_step(x, hc, w_ih, w_hh, b_ih, b_hh):
+    h, c = hc
+    gates = x @ w_ih.T + b_ih + h @ w_hh.T + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+def _gru_step(x, h, w_ih, w_hh, b_ih, b_hh):
+    xg = x @ w_ih.T + b_ih
+    hg = h @ w_hh.T + b_hh
+    xr, xz, xc = jnp.split(xg, 3, axis=-1)
+    hr, hz, hc = jnp.split(hg, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    c = jnp.tanh(xc + r * hc)
+    return (1 - z) * c + z * h
+
+
+class RNNCellBase(Layer):
+    def __init__(self, input_size, hidden_size, gate_mult, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        g = gate_mult * hidden_size
+        self.weight_ih = self.create_parameter(
+            [g, input_size], weight_ih_attr, default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [g, hidden_size], weight_hh_attr, default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [g], bias_ih_attr, is_bias=True, default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [g], bias_hh_attr, is_bias=True, default_initializer=init)
+
+    def get_initial_states(self, batch_ref, shape=None, dtype=None,
+                           init_value=0.0, batch_dim_idx=0):
+        from ...ops.creation import full
+        b = batch_ref.shape[batch_dim_idx]
+        return full([b, self.hidden_size], init_value, dtype or "float32")
+
+
+class SimpleRNNCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, 1, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out = apply_op(
+            lambda x, h, wi, wh, bi, bh: _simple_step(
+                x, h, wi, wh, bi, bh, self.activation),
+            inputs, states, self.weight_ih, self.weight_hh, self.bias_ih,
+            self.bias_hh, _op_name="simple_rnn_cell")
+        return out, out
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class LSTMCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__(input_size, hidden_size, 4, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            h = self.get_initial_states(inputs)
+            c = self.get_initial_states(inputs)
+            states = (h, c)
+        h, c = states
+        h_new, c_new = apply_op(
+            lambda x, h_, c_, wi, wh, bi, bh: _lstm_step(
+                x, (h_, c_), wi, wh, bi, bh),
+            inputs, h, c, self.weight_ih, self.weight_hh, self.bias_ih,
+            self.bias_hh, _op_name="lstm_cell")
+        return h_new, (h_new, c_new)
+
+    @property
+    def state_shape(self):
+        return ((self.hidden_size,), (self.hidden_size,))
+
+
+class GRUCell(RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__(input_size, hidden_size, 3, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs)
+        out = apply_op(
+            lambda x, h, wi, wh, bi, bh: _gru_step(x, h, wi, wh, bi, bh),
+            inputs, states, self.weight_ih, self.weight_hh, self.bias_ih,
+            self.bias_hh, _op_name="gru_cell")
+        return out, out
+
+    @property
+    def state_shape(self):
+        return (self.hidden_size,)
+
+
+class RNN(Layer):
+    """Wraps a cell into a scanned sequence layer."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        mode = ("lstm" if isinstance(self.cell, LSTMCell) else
+                "gru" if isinstance(self.cell, GRUCell) else "rnn")
+        act = getattr(self.cell, "activation", "tanh")
+
+        def f(x, wi, wh, bi, bh, *init):
+            seq = x if self.time_major else jnp.swapaxes(x, 0, 1)
+            if self.is_reverse:
+                seq = jnp.flip(seq, 0)
+            b = seq.shape[1]
+            hsz = self.cell.hidden_size
+            if init:
+                state = init if mode == "lstm" else init[0]
+            else:
+                z = jnp.zeros((b, hsz), x.dtype)
+                state = (z, z) if mode == "lstm" else z
+
+            def step(carry, xt):
+                if mode == "lstm":
+                    h, c = _lstm_step(xt, carry, wi, wh, bi, bh)
+                    return (h, c), h
+                if mode == "gru":
+                    h = _gru_step(xt, carry, wi, wh, bi, bh)
+                    return h, h
+                h = _simple_step(xt, carry, wi, wh, bi, bh, act)
+                return h, h
+
+            final, ys = jax.lax.scan(step, state, seq)
+            if self.is_reverse:
+                ys = jnp.flip(ys, 0)
+            ys = ys if self.time_major else jnp.swapaxes(ys, 0, 1)
+            if mode == "lstm":
+                return ys, final[0], final[1]
+            return ys, final
+
+        args = [inputs, self.cell.weight_ih, self.cell.weight_hh,
+                self.cell.bias_ih, self.cell.bias_hh]
+        if initial_states is not None:
+            if isinstance(initial_states, (tuple, list)):
+                args.extend(initial_states)
+            else:
+                args.append(initial_states)
+        outs = apply_op(f, *args, _op_name=f"{mode}_scan")
+        if mode == "lstm":
+            ys, h, c = outs
+            return ys, (h, c)
+        ys, h = outs
+        return ys, h
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, False, time_major)
+        self.rnn_bw = RNN(cell_bw, True, time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import concat
+        states_fw, states_bw = (initial_states if initial_states is not None
+                                else (None, None))
+        out_fw, st_fw = self.rnn_fw(inputs, states_fw)
+        out_bw, st_bw = self.rnn_bw(inputs, states_bw)
+        return concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)
+
+
+class _RNNBase(Layer):
+    def __init__(self, mode, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", weight_ih_attr=None, weight_hh_attr=None,
+                 bias_ih_attr=None, bias_hh_attr=None, name=None):
+        super().__init__()
+        self.mode = mode
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        bidirect = direction in ("bidirect", "bidirectional")
+        self.num_directions = 2 if bidirect else 1
+
+        def make_cell(in_sz):
+            if mode == "LSTM":
+                return LSTMCell(in_sz, hidden_size, weight_ih_attr,
+                                weight_hh_attr, bias_ih_attr, bias_hh_attr)
+            if mode == "GRU":
+                return GRUCell(in_sz, hidden_size, weight_ih_attr,
+                               weight_hh_attr, bias_ih_attr, bias_hh_attr)
+            return SimpleRNNCell(in_sz, hidden_size, activation,
+                                 weight_ih_attr, weight_hh_attr,
+                                 bias_ih_attr, bias_hh_attr)
+
+        from .container import LayerList
+        self.rnns = LayerList()
+        for layer in range(num_layers):
+            in_sz = input_size if layer == 0 else \
+                hidden_size * self.num_directions
+            if bidirect:
+                self.rnns.append(BiRNN(make_cell(in_sz), make_cell(in_sz),
+                                       time_major))
+            else:
+                self.rnns.append(RNN(make_cell(in_sz),
+                                     time_major=time_major))
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ...ops.manipulation import stack, concat
+        from .. import functional as F
+        out = inputs
+        final_h, final_c = [], []
+        for i, rnn in enumerate(self.rnns):
+            out, st = rnn(out)
+            if self.mode == "LSTM":
+                if self.num_directions == 2:
+                    (h_f, c_f), (h_b, c_b) = st
+                    final_h += [h_f, h_b]
+                    final_c += [c_f, c_b]
+                else:
+                    final_h.append(st[0])
+                    final_c.append(st[1])
+            else:
+                if self.num_directions == 2:
+                    final_h += [st[0], st[1]]
+                else:
+                    final_h.append(st)
+            if self.dropout > 0 and i < len(self.rnns) - 1:
+                out = F.dropout(out, self.dropout, training=self.training)
+        h = stack(final_h, axis=0)
+        if self.mode == "LSTM":
+            c = stack(final_c, axis=0)
+            return out, (h, c)
+        return out, h
+
+
+class SimpleRNN(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kw):
+        super().__init__("RNN", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, activation, **kw)
+
+
+class LSTM(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("LSTM", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)
+
+
+class GRU(_RNNBase):
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0, **kw):
+        super().__init__("GRU", input_size, hidden_size, num_layers,
+                         direction, time_major, dropout, **kw)

@@ -1,0 +1,390 @@
+"""nn.Layer: the module system.
+
+Reference: python/paddle/nn/layer/layers.py (Layer) — parameter/sublayer/
+buffer registries, structured state_dict, train/eval, forward hooks.
+TPU-native addition: ``functional_state``/``bind_state`` context which swaps
+every parameter/buffer's underlying jax array, turning any Layer into a pure
+function of (params, buffers, inputs) for jax.jit / pjit / grad — the bridge
+from the imperative façade to XLA's functional compilation model
+(SURVEY.md §7 hard part #1).
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework.dtype import to_dtype
+from ..framework.tensor import Parameter, Tensor, no_grad
+from . import initializer as I
+
+
+class ParamAttr:
+    """paddle.ParamAttr analog (python/paddle/base/param_attr.py)."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if isinstance(attr, I.Initializer):
+            return ParamAttr(initializer=attr)
+        raise TypeError(f"invalid param attr {attr!r}")
+
+
+_layer_name_counters: Dict[str, int] = {}
+
+
+def _unique_layer_name(prefix: str) -> str:
+    n = _layer_name_counters.get(prefix, 0)
+    _layer_name_counters[prefix] = n + 1
+    return f"{prefix}_{n}"
+
+
+class Layer:
+    """Base class for all network modules (paddle.nn.Layer analog)."""
+
+    def __init__(self, name_scope: Optional[str] = None, dtype="float32"):
+        self.training = True
+        self._dtype = to_dtype(dtype)
+        self._parameters: "OrderedDict[str, Parameter]" = OrderedDict()
+        self._sub_layers: "OrderedDict[str, Layer]" = OrderedDict()
+        self._buffers: "OrderedDict[str, Tensor]" = OrderedDict()
+        self._non_persistable_buffer_names = set()
+        self._forward_pre_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._forward_post_hooks: "OrderedDict[int, Callable]" = OrderedDict()
+        self._full_name = _unique_layer_name(
+            name_scope or self.__class__.__name__.lower())
+
+    # -- naming ------------------------------------------------------------
+    def full_name(self) -> str:
+        return self._full_name
+
+    # -- registration ------------------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None) -> Parameter:
+        attr = ParamAttr._to_attr(attr)
+        dt = to_dtype(dtype or self._dtype)
+        init = attr.initializer or default_initializer or (
+            I.Constant(0.0) if is_bias else I.XavierNormal())
+        data = init(tuple(int(s) for s in shape), dt.np_dtype)
+        p = Parameter(data, name=attr.name, trainable=attr.trainable)
+        p.optimize_attr = {"learning_rate": attr.learning_rate}
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        return p
+
+    def add_parameter(self, name: str, parameter: Optional[Parameter]):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name: str, sublayer: "Layer"):
+        self._sub_layers[name] = sublayer
+        return sublayer
+
+    def register_buffer(self, name: str, tensor: Optional[Tensor],
+                        persistable: bool = True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        return tensor
+
+    # attribute routing (mirrors Layer.__setattr__ in layers.py)
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        layers = self.__dict__.get("_sub_layers")
+        buffers = self.__dict__.get("_buffers")
+        if isinstance(value, Parameter):
+            if params is None:
+                raise RuntimeError("call super().__init__() first")
+            _remove_from(name, layers, buffers)
+            params[name] = value
+        elif isinstance(value, Layer):
+            if layers is None:
+                raise RuntimeError("call super().__init__() first")
+            _remove_from(name, params, buffers)
+            layers[name] = value
+        elif params is not None and name in params:
+            if value is not None and not isinstance(value, Parameter):
+                raise TypeError(f"cannot assign {type(value)} as parameter "
+                                f"{name!r}")
+            params[name] = value
+        elif buffers is not None and name in buffers:
+            buffers[name] = value
+        elif layers is not None and name in layers and value is None:
+            layers[name] = value
+        else:
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(
+            f"'{type(self).__name__}' object has no attribute '{name}'")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        base = list(super().__dir__())
+        return base + list(self._parameters) + list(self._sub_layers) + \
+            list(self._buffers)
+
+    # -- traversal ---------------------------------------------------------
+    def children(self) -> Iterator["Layer"]:
+        for _, l in self.named_children():
+            yield l
+
+    def named_children(self) -> Iterator[Tuple[str, "Layer"]]:
+        seen = set()
+        for name, l in self._sub_layers.items():
+            if l is not None and id(l) not in seen:
+                seen.add(id(l))
+                yield name, l
+
+    def sublayers(self, include_self: bool = False) -> List["Layer"]:
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def named_sublayers(self, prefix: str = "", include_self: bool = False,
+                        layers_set=None) -> Iterator[Tuple[str, "Layer"]]:
+        if layers_set is None:
+            layers_set = set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, l in self.named_children():
+            sub_prefix = f"{prefix}.{name}" if prefix else name
+            yield from l.named_sublayers(prefix=sub_prefix, include_self=True,
+                                         layers_set=layers_set)
+
+    def parameters(self, include_sublayers: bool = True) -> List[Parameter]:
+        return [p for _, p in self.named_parameters(
+            include_sublayers=include_sublayers)]
+
+    def named_parameters(self, prefix: str = "",
+                         include_sublayers: bool = True
+                         ) -> Iterator[Tuple[str, Parameter]]:
+        seen = set()
+        gen = self.named_sublayers(prefix=prefix, include_self=True) \
+            if include_sublayers else [(prefix, self)]
+        for layer_prefix, layer in gen:
+            for name, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{layer_prefix}.{name}" if layer_prefix else name), p
+
+    def buffers(self, include_sublayers: bool = True) -> List[Tensor]:
+        return [b for _, b in self.named_buffers(
+            include_sublayers=include_sublayers)]
+
+    def named_buffers(self, prefix: str = "", include_sublayers: bool = True
+                      ) -> Iterator[Tuple[str, Tensor]]:
+        seen = set()
+        gen = self.named_sublayers(prefix=prefix, include_self=True) \
+            if include_sublayers else [(prefix, self)]
+        for layer_prefix, layer in gen:
+            for name, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{layer_prefix}.{name}" if layer_prefix else name), b
+
+    # -- state dict --------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix):
+            dest[name] = p
+        for name, b in self.named_buffers(prefix=structured_name_prefix):
+            short = name.rsplit(".", 1)[-1]
+            if short in self._non_persistable_buffer_names:
+                continue
+            dest[name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name: bool = True):
+        """Load values by structured name; shape-checked."""
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, target in own.items():
+            if name not in state_dict:
+                missing.append(name)
+                continue
+            src = state_dict[name]
+            arr = src._data if isinstance(src, Tensor) else \
+                jax.numpy.asarray(np.asarray(src))
+            if tuple(arr.shape) != tuple(target.shape):
+                raise ValueError(
+                    f"shape mismatch for {name}: loading {arr.shape} into "
+                    f"{tuple(target.shape)}")
+            target.set_value(arr)
+        for name in state_dict:
+            if name not in own:
+                unexpected.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+
+    # -- mode / dtype / device --------------------------------------------
+    def train(self):
+        self.training = True
+        for l in self.sublayers():
+            l.training = True
+        return self
+
+    def eval(self):
+        self.training = False
+        for l in self.sublayers():
+            l.training = False
+        return self
+
+    def apply(self, fn: Callable[["Layer"], None]):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            nd = to_dtype(dtype).np_dtype
+            with no_grad():
+                for p in self.parameters():
+                    if p.dtype.is_floating_point:
+                        p._data = p._data.astype(nd)
+                for b in self.buffers():
+                    if b is not None and b.dtype.is_floating_point:
+                        b._data = b._data.astype(nd)
+            self._dtype = to_dtype(dtype)
+        return self
+
+    def astype(self, dtype):
+        return self.to(dtype=dtype)
+
+    def float(self):
+        return self.to(dtype="float32")
+
+    def bfloat16(self):
+        return self.to(dtype="bfloat16")
+
+    def clear_gradients(self, set_to_zero: bool = False):
+        for p in self.parameters():
+            p.clear_gradient(set_to_zero)
+
+    # -- hooks -------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        hid = id(hook)
+        self._forward_pre_hooks[hid] = hook
+        return _HookHandle(self._forward_pre_hooks, hid)
+
+    def register_forward_post_hook(self, hook):
+        hid = id(hook)
+        self._forward_post_hooks[hid] = hook
+        return _HookHandle(self._forward_post_hooks, hid)
+
+    # -- call --------------------------------------------------------------
+    def forward(self, *inputs, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *inputs, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            result = hook(self, inputs)
+            if result is not None:
+                inputs = result if isinstance(result, tuple) else (result,)
+        outputs = self.forward(*inputs, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            result = hook(self, inputs, outputs)
+            if result is not None:
+                outputs = result
+        return outputs
+
+    # -- functional bridge (TPU-native) ------------------------------------
+    def raw_state(self) -> Tuple[Dict[str, Any], Dict[str, Any]]:
+        """Return ({name: jax array} params, {name: jax array} buffers)."""
+        params = {n: p._data for n, p in self.named_parameters()}
+        bufs = {n: b._data for n, b in self.named_buffers() if b is not None}
+        return params, bufs
+
+    @contextlib.contextmanager
+    def bind_state(self, params: Dict[str, Any],
+                   buffers: Optional[Dict[str, Any]] = None):
+        """Temporarily swap parameter/buffer arrays (jit-trace safe).
+
+        Inside the context, forward() computes as a pure function of the
+        given arrays — usable under jax.jit/grad/vmap/pjit tracing.
+        """
+        named_p = dict(self.named_parameters())
+        named_b = dict(self.named_buffers())
+        saved_p = {n: t._data for n, t in named_p.items()}
+        saved_b = {n: t._data for n, t in named_b.items() if t is not None}
+        saved_sg = {n: t.stop_gradient for n, t in named_p.items()}
+        try:
+            for n, a in params.items():
+                named_p[n]._data = a
+                named_p[n].grad_node = None
+            if buffers:
+                for n, a in buffers.items():
+                    if n in named_b and named_b[n] is not None:
+                        named_b[n]._data = a
+            yield self
+        finally:
+            for n, a in saved_p.items():
+                named_p[n]._data = a
+                named_p[n].stop_gradient = saved_sg[n]
+                named_p[n].grad_node = None
+            for n, a in saved_b.items():
+                named_b[n]._data = a
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = []
+        for name, child in self.named_children():
+            child_repr = repr(child).split("\n")
+            child_repr = [child_repr[0]] + ["  " + ln for ln in child_repr[1:]]
+            lines.append(f"({name}): " + "\n".join(child_repr))
+        main = self.__class__.__name__ + "(" + extra
+        if lines:
+            main += "\n  " + "\n  ".join(lines) + "\n"
+        return main + ")"
+
+    def extra_repr(self) -> str:
+        return ""
+
+
+class _HookHandle:
+    def __init__(self, store, hid):
+        self._store = store
+        self._hid = hid
+
+    def remove(self):
+        self._store.pop(self._hid, None)
+
+
+def _remove_from(name, *dicts):
+    for d in dicts:
+        if d is not None and name in d:
+            del d[name]

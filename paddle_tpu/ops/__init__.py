@@ -1,0 +1,15 @@
+"""Op namespace: the TPU-native replacement for the phi kernel library +
+YAML-generated API (/root/reference/paddle/phi/kernels, ~507k LoC;
+/root/reference/paddle/phi/ops/yaml/ops.yaml 467 forward ops).
+
+Every op is a thin jax.numpy/lax composition routed through
+``framework.tensor.apply_op`` — XLA supplies the kernels, fusion, and (via
+jax.vjp) every gradient, so there are no per-backend kernel files and no
+separate backward.yaml: one definition serves CPU/TPU, eager/jit, fwd/bwd.
+"""
+from .creation import *  # noqa: F401,F403
+from .math import *  # noqa: F401,F403
+from .manipulation import *  # noqa: F401,F403
+from .linalg import *  # noqa: F401,F403
+from .logic import *  # noqa: F401,F403
+from .random_ops import *  # noqa: F401,F403

@@ -1,0 +1,173 @@
+"""Tensor creation ops (reference: python/paddle/tensor/creation.py)."""
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework.dtype import to_dtype
+from ..framework.tensor import Tensor, apply_op, _unwrap
+
+__all__ = [
+    "to_tensor", "zeros", "ones", "full", "empty", "zeros_like", "ones_like",
+    "full_like", "empty_like", "arange", "linspace", "logspace", "eye",
+    "tril", "triu", "diag", "diagflat", "meshgrid", "assign", "clone",
+    "numel", "tolist", "as_tensor",
+]
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return [int(s) for s in np.asarray(shape._data)]
+    if isinstance(shape, (int, np.integer)):
+        return [int(shape)]
+    return [int(_unwrap_s(s)) for s in shape]
+
+
+def _unwrap_s(s):
+    return int(s._data) if isinstance(s, Tensor) else int(s)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient: bool = True):
+    """paddle.to_tensor analog."""
+    if isinstance(data, Tensor):
+        t = Tensor(data._data, dtype=dtype)
+        t.stop_gradient = stop_gradient
+        return t
+    t = Tensor(data, dtype=dtype)
+    t.stop_gradient = stop_gradient
+    return t
+
+
+as_tensor = to_tensor
+
+
+def _float_dtype(dtype):
+    return to_dtype(dtype).np_dtype if dtype is not None \
+        else dtype_mod.get_default_dtype().np_dtype
+
+
+def zeros(shape, dtype=None, name=None):
+    return Tensor(jnp.zeros(_shape_list(shape), _float_dtype(dtype)))
+
+
+def ones(shape, dtype=None, name=None):
+    return Tensor(jnp.ones(_shape_list(shape), _float_dtype(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None):
+    fill = _unwrap(fill_value)
+    if dtype is None and isinstance(fill_value, (bool, int, float)):
+        if isinstance(fill_value, bool):
+            dt = np.bool_
+        elif isinstance(fill_value, int):
+            dt = np.int64
+        else:
+            dt = dtype_mod.get_default_dtype().np_dtype
+    else:
+        dt = _float_dtype(dtype)
+    return Tensor(jnp.full(_shape_list(shape), fill, dt))
+
+
+def empty(shape, dtype=None, name=None):
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None):
+    dt = to_dtype(dtype).np_dtype if dtype is not None else None
+    return Tensor(jnp.zeros_like(_unwrap(x), dtype=dt))
+
+
+def ones_like(x, dtype=None, name=None):
+    dt = to_dtype(dtype).np_dtype if dtype is not None else None
+    return Tensor(jnp.ones_like(_unwrap(x), dtype=dt))
+
+
+def full_like(x, fill_value, dtype=None, name=None):
+    dt = to_dtype(dtype).np_dtype if dtype is not None else None
+    return Tensor(jnp.full_like(_unwrap(x), _unwrap(fill_value), dtype=dt))
+
+
+def empty_like(x, dtype=None, name=None):
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None):
+    start, end, step = _unwrap(start), _unwrap(end), _unwrap(step)
+    dt = to_dtype(dtype).np_dtype if dtype is not None else None
+    if end is None:
+        start, end = 0, start
+    return Tensor(jnp.arange(start, end, step, dtype=dt))
+
+
+def linspace(start, stop, num, dtype=None, name=None):
+    return Tensor(jnp.linspace(_unwrap(start), _unwrap(stop), _unwrap_s(num),
+                               dtype=_float_dtype(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None):
+    return Tensor(jnp.logspace(_unwrap(start), _unwrap(stop), _unwrap_s(num),
+                               base=_unwrap(base), dtype=_float_dtype(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None):
+    return Tensor(jnp.eye(_unwrap_s(num_rows),
+                          None if num_columns is None else _unwrap_s(num_columns),
+                          dtype=_float_dtype(dtype)))
+
+
+def tril(x, diagonal=0, name=None):
+    return apply_op(lambda a: jnp.tril(a, k=diagonal), x, _op_name="tril")
+
+
+def triu(x, diagonal=0, name=None):
+    return apply_op(lambda a: jnp.triu(a, k=diagonal), x, _op_name="triu")
+
+
+def diag(x, offset=0, padding_value=0, name=None):
+    def f(a):
+        if a.ndim == 1 and padding_value != 0:
+            d = jnp.diag(a, k=offset)
+            mask = jnp.eye(d.shape[0], dtype=bool) if offset == 0 else \
+                jnp.diag(jnp.ones_like(a, dtype=bool), k=offset)
+            return jnp.where(mask, d, padding_value)
+        return jnp.diag(a, k=offset)
+    return apply_op(f, x, _op_name="diag")
+
+
+def diagflat(x, offset=0, name=None):
+    return apply_op(lambda a: jnp.diagflat(a, k=offset), x,
+                    _op_name="diagflat")
+
+
+def meshgrid(*args, **kwargs):
+    if len(args) == 1 and isinstance(args[0], (list, tuple)):
+        args = tuple(args[0])
+    outs = jnp.meshgrid(*[_unwrap(a) for a in args], indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output: Optional[Tensor] = None):
+    src = _unwrap(x) if isinstance(x, Tensor) else jnp.asarray(np.asarray(x))
+    if output is None:
+        return Tensor(src)
+    output.set_value(src)
+    return output
+
+
+def clone(x, name=None):
+    return x.clone()
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, dtype=np.int64))
+
+
+def tolist(x):
+    return x.tolist()
+
+
+Tensor._bind("tolist", tolist)
+Tensor._bind("numel", lambda self: self.size)

@@ -1,0 +1,274 @@
+"""Linear algebra ops (reference: python/paddle/tensor/linalg.py; matmul at
+:191 -> phi MatmulKernel). On TPU these lower straight onto the MXU."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor, apply_op, _unwrap
+
+__all__ = [
+    "matmul", "mm", "bmm", "dot", "dist", "norm", "cond", "cross",
+    "cholesky", "matrix_rank", "mv", "det", "slogdet", "inv", "pinv",
+    "solve", "triangular_solve", "cholesky_solve", "eig", "eigvals", "eigh",
+    "eigvalsh", "svd", "qr", "lu", "matrix_power", "multi_dot", "einsum",
+    "histogram", "bincount", "lstsq", "corrcoef", "cov", "householder_product",
+]
+
+
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply_op(f, x, y, _op_name="matmul")
+
+
+def mm(input, mat2, name=None):
+    return matmul(input, mat2)
+
+
+def bmm(x, y, name=None):
+    return apply_op(jnp.matmul, x, y, _op_name="bmm")
+
+
+def dot(x, y, name=None):
+    return apply_op(lambda a, b: jnp.sum(a * b, axis=-1), x, y,
+                    _op_name="dot")
+
+
+def mv(x, vec, name=None):
+    return apply_op(jnp.matmul, x, vec, _op_name="mv")
+
+
+def dist(x, y, p=2, name=None):
+    return apply_op(
+        lambda a, b: _p_norm(a - b, p, None, False), x, y, _op_name="dist")
+
+
+def _p_norm(a, p, axis, keepdim):
+    if p == np.inf or p == float("inf"):
+        return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdim)
+    if p == -np.inf or p == float("-inf"):
+        return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdim)
+    if p == 0:
+        return jnp.sum((a != 0).astype(a.dtype), axis=axis, keepdims=keepdim)
+    return jnp.power(
+        jnp.sum(jnp.power(jnp.abs(a), p), axis=axis, keepdims=keepdim),
+        1.0 / p)
+
+
+def norm(x, p=None, axis=None, keepdim=False, name=None):
+    def f(a):
+        if axis is None and (p is None or p == "fro" or p == 2):
+            return jnp.sqrt(jnp.sum(jnp.square(a)))
+        ax = tuple(axis) if isinstance(axis, (list, tuple)) else axis
+        if p is None or p == "fro":
+            return jnp.sqrt(jnp.sum(jnp.square(a), axis=ax, keepdims=keepdim))
+        if p == "nuc":
+            s = jnp.linalg.svd(a, compute_uv=False)
+            return jnp.sum(s, axis=-1, keepdims=keepdim)
+        return _p_norm(a, p, ax, keepdim)
+    return apply_op(f, x, _op_name="p_norm")
+
+
+def cond(x, p=None, name=None):
+    return apply_op(lambda a: jnp.linalg.cond(a, p=p), x, _op_name="cond")
+
+
+def cross(x, y, axis=9, name=None):
+    def f(a, b):
+        ax = axis
+        if ax == 9:  # paddle default: first axis with dim 3
+            ax = next(i for i, s in enumerate(a.shape) if s == 3)
+        return jnp.cross(a, b, axis=ax)
+    return apply_op(f, x, y, _op_name="cross")
+
+
+def cholesky(x, upper=False, name=None):
+    def f(a):
+        L = jnp.linalg.cholesky(a)
+        return jnp.swapaxes(L, -1, -2) if upper else L
+    return apply_op(f, x, _op_name="cholesky")
+
+
+def matrix_rank(x, tol=None, hermitian=False, name=None):
+    a = _unwrap(x)
+    return Tensor(jnp.linalg.matrix_rank(a, tol=_unwrap(tol)
+                                         if tol is not None else None))
+
+
+def det(x, name=None):
+    return apply_op(jnp.linalg.det, x, _op_name="det")
+
+
+def slogdet(x, name=None):
+    outs = apply_op(lambda a: tuple(jnp.linalg.slogdet(a)), x,
+                    _op_name="slogdet")
+    return outs
+
+
+def inv(x, name=None):
+    return apply_op(jnp.linalg.inv, x, _op_name="inverse")
+
+
+inverse = inv
+
+
+def pinv(x, rcond=1e-15, hermitian=False, name=None):
+    return apply_op(lambda a: jnp.linalg.pinv(a, rtol=rcond,
+                                              hermitian=hermitian), x,
+                    _op_name="pinv")
+
+
+def solve(x, y, name=None):
+    return apply_op(jnp.linalg.solve, x, y, _op_name="solve")
+
+
+def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                     name=None):
+    def f(a, b):
+        a_ = jnp.swapaxes(a, -1, -2) if transpose else a
+        up = not upper if transpose else upper
+        return jax.scipy.linalg.solve_triangular(
+            a_, b, lower=not up, unit_diagonal=unitriangular)
+    return apply_op(f, x, y, _op_name="triangular_solve")
+
+
+def cholesky_solve(x, y, upper=False, name=None):
+    def f(b, L):
+        Lm = jnp.swapaxes(L, -1, -2) if upper else L
+        z = jax.scipy.linalg.solve_triangular(Lm, b, lower=True)
+        return jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(Lm, -1, -2), z, lower=False)
+    return apply_op(f, x, y, _op_name="cholesky_solve")
+
+
+def eig(x, name=None):
+    a = np.asarray(_unwrap(x))
+    w, v = np.linalg.eig(a)
+    return Tensor(jnp.asarray(w)), Tensor(jnp.asarray(v))
+
+
+def eigvals(x, name=None):
+    a = np.asarray(_unwrap(x))
+    return Tensor(jnp.asarray(np.linalg.eigvals(a)))
+
+
+def eigh(x, UPLO="L", name=None):
+    outs = apply_op(
+        lambda a: tuple(jnp.linalg.eigh(a, symmetrize_input=True)), x,
+        _op_name="eigh")
+    return outs
+
+
+def eigvalsh(x, UPLO="L", name=None):
+    return apply_op(jnp.linalg.eigvalsh, x, _op_name="eigvalsh")
+
+
+def svd(x, full_matrices=False, name=None):
+    outs = apply_op(
+        lambda a: tuple(jnp.linalg.svd(a, full_matrices=full_matrices)), x,
+        _op_name="svd")
+    return outs
+
+
+def qr(x, mode="reduced", name=None):
+    outs = apply_op(lambda a: tuple(jnp.linalg.qr(a, mode=mode)), x,
+                    _op_name="qr")
+    return outs
+
+
+def lu(x, pivot=True, get_infos=False, name=None):
+    def f(a):
+        lu_, piv = jax.scipy.linalg.lu_factor(a)
+        return lu_, piv.astype(jnp.int32) + 1  # paddle pivots are 1-based
+    lu_t, piv_t = apply_op(f, x, _op_name="lu")
+    if get_infos:
+        info = Tensor(jnp.zeros(x.shape[:-2] or (1,), jnp.int32))
+        return lu_t, piv_t, info
+    return lu_t, piv_t
+
+
+def matrix_power(x, n, name=None):
+    return apply_op(lambda a: jnp.linalg.matrix_power(a, n), x,
+                    _op_name="matrix_power")
+
+
+def multi_dot(x, name=None):
+    tensors = list(x)
+    return apply_op(lambda *arrs: jnp.linalg.multi_dot(arrs), *tensors,
+                    _op_name="multi_dot")
+
+
+def einsum(equation, *operands):
+    tensors = list(operands)
+    return apply_op(lambda *arrs: jnp.einsum(equation, *arrs), *tensors,
+                    _op_name="einsum")
+
+
+def histogram(input, bins=100, min=0, max=0, weight=None, density=False,
+              name=None):
+    a = np.asarray(_unwrap(input))
+    lo, hi = (min, max) if (min != 0 or max != 0) else (a.min(), a.max())
+    w = np.asarray(_unwrap(weight)) if weight is not None else None
+    h, _ = np.histogram(a, bins=bins, range=(lo, hi), weights=w,
+                        density=density)
+    return Tensor(jnp.asarray(h if density or w is not None
+                              else h.astype(np.int64)))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    a = np.asarray(_unwrap(x))
+    w = np.asarray(_unwrap(weights)) if weights is not None else None
+    return Tensor(jnp.asarray(np.bincount(a, weights=w,
+                                          minlength=minlength)))
+
+
+def lstsq(x, y, rcond=None, driver=None, name=None):
+    def f(a, b):
+        sol, res, rank, sv = jnp.linalg.lstsq(a, b, rcond=rcond)
+        return sol, res, rank, sv
+    return apply_op(f, x, y, _op_name="lstsq")
+
+
+def corrcoef(x, rowvar=True, name=None):
+    return apply_op(lambda a: jnp.corrcoef(a, rowvar=rowvar), x,
+                    _op_name="corrcoef")
+
+
+def cov(x, rowvar=True, ddof=True, fweights=None, aweights=None, name=None):
+    fw = _unwrap(fweights) if fweights is not None else None
+    aw = _unwrap(aweights) if aweights is not None else None
+    return apply_op(
+        lambda a: jnp.cov(a, rowvar=rowvar, ddof=1 if ddof else 0,
+                          fweights=fw, aweights=aw), x, _op_name="cov")
+
+
+def householder_product(x, tau, name=None):
+    def f(a, t):
+        m, n = a.shape[-2], a.shape[-1]
+        eye = jnp.eye(m, dtype=a.dtype)
+
+        def body(i, Q):
+            v = jnp.where(jnp.arange(m) < i, 0.0,
+                          jnp.where(jnp.arange(m) == i, 1.0, a[:, i]))
+            H = eye - t[i] * jnp.outer(v, v)
+            return Q @ H
+        Q = jax.lax.fori_loop(0, t.shape[0], body, eye)
+        return Q[:, :n]
+    return apply_op(f, x, tau, _op_name="householder_product")
+
+
+# bind methods
+import sys
+
+_this = sys.modules[__name__]
+for _name in __all__:
+    _fn = getattr(_this, _name, None)
+    if callable(_fn) and not hasattr(Tensor, _name):
+        Tensor._bind(_name, _fn)
+del _this, _name, _fn

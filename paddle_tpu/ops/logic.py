@@ -1,0 +1,87 @@
+"""Logical / comparison / bitwise ops
+(reference: python/paddle/tensor/logic.py)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor, apply_op, _unwrap
+
+__all__ = [
+    "logical_and", "logical_or", "logical_xor", "logical_not", "equal",
+    "not_equal", "greater_than", "greater_equal", "less_than", "less_equal",
+    "equal_all", "allclose", "isclose", "bitwise_and", "bitwise_or",
+    "bitwise_xor", "bitwise_not", "bitwise_left_shift", "bitwise_right_shift",
+    "is_empty", "is_tensor",
+]
+
+
+def _bin(jfn, name):
+    def op(x, y, out=None, name=None):
+        if not isinstance(y, Tensor):
+            y = Tensor(jnp.asarray(y))
+        return apply_op(jfn, x, y, _op_name=name_)
+    name_ = name
+    op.__name__ = name
+    return op
+
+
+logical_and = _bin(jnp.logical_and, "logical_and")
+logical_or = _bin(jnp.logical_or, "logical_or")
+logical_xor = _bin(jnp.logical_xor, "logical_xor")
+equal = _bin(jnp.equal, "equal")
+not_equal = _bin(jnp.not_equal, "not_equal")
+greater_than = _bin(jnp.greater, "greater_than")
+greater_equal = _bin(jnp.greater_equal, "greater_equal")
+less_than = _bin(jnp.less, "less_than")
+less_equal = _bin(jnp.less_equal, "less_equal")
+bitwise_and = _bin(jnp.bitwise_and, "bitwise_and")
+bitwise_or = _bin(jnp.bitwise_or, "bitwise_or")
+bitwise_xor = _bin(jnp.bitwise_xor, "bitwise_xor")
+bitwise_left_shift = _bin(jnp.left_shift, "bitwise_left_shift")
+bitwise_right_shift = _bin(jnp.right_shift, "bitwise_right_shift")
+
+
+def logical_not(x, out=None, name=None):
+    return apply_op(jnp.logical_not, x, _op_name="logical_not")
+
+
+def bitwise_not(x, out=None, name=None):
+    return apply_op(jnp.bitwise_not, x, _op_name="bitwise_not")
+
+
+def equal_all(x, y, name=None):
+    return apply_op(lambda a, b: jnp.array_equal(a, b), x, y,
+                    _op_name="equal_all")
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(
+        lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                  equal_nan=equal_nan), x, y,
+        _op_name="allclose")
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply_op(
+        lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                 equal_nan=equal_nan), x, y,
+        _op_name="isclose")
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(x.size == 0))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+import sys
+
+_this = sys.modules[__name__]
+for _name in __all__:
+    _fn = getattr(_this, _name, None)
+    if callable(_fn) and not hasattr(Tensor, _name):
+        Tensor._bind(_name, _fn)
+del _this, _name, _fn

@@ -1,0 +1,582 @@
+"""Shape/layout/index manipulation ops
+(reference: python/paddle/tensor/manipulation.py, search.py, indexing)."""
+from __future__ import annotations
+
+import builtins
+from typing import List, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dtype import to_dtype
+from ..framework.tensor import Tensor, apply_op, _unwrap
+
+__all__ = [
+    "reshape", "reshape_", "flatten", "squeeze", "squeeze_", "unsqueeze",
+    "unsqueeze_", "transpose", "moveaxis", "swapaxes", "concat", "stack",
+    "hstack", "vstack", "split", "chunk", "unbind", "tile", "expand",
+    "expand_as", "broadcast_to", "broadcast_tensors", "flip", "rot90", "roll",
+    "gather", "gather_nd", "scatter", "scatter_nd_add", "index_select",
+    "index_add", "index_put", "masked_select", "masked_fill", "where",
+    "nonzero", "sort", "argsort", "topk", "unique", "unique_consecutive",
+    "searchsorted", "bucketize", "repeat_interleave", "take_along_axis",
+    "put_along_axis", "strided_slice", "slice", "crop", "pad", "shard_index",
+    "tensordot", "as_complex", "as_real", "view", "view_as", "atleast_1d",
+    "atleast_2d", "atleast_3d", "select_scatter", "diagonal", "t",
+    "cast", "flatten_", "tensor_split", "dsplit", "hsplit", "vsplit",
+]
+
+
+def _static_shape(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    out = []
+    for s in shape:
+        out.append(int(s._data) if isinstance(s, Tensor) else int(s))
+    return tuple(out)
+
+
+def reshape(x, shape, name=None):
+    shp = _static_shape(shape)
+    return apply_op(lambda a: jnp.reshape(a, shp), x, _op_name="reshape")
+
+
+def reshape_(x, shape, name=None):
+    return x._inplace(reshape(x._snapshot(), shape))
+
+
+def view(x, shape_or_dtype, name=None):
+    if isinstance(shape_or_dtype, (list, tuple)):
+        return reshape(x, shape_or_dtype)
+    dt = to_dtype(shape_or_dtype).np_dtype
+    return apply_op(lambda a: a.view(dt), x, _op_name="view_dtype")
+
+
+def view_as(x, other, name=None):
+    return reshape(x, other.shape)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(a):
+        nd = a.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = a.shape[:s] + (-1,) + a.shape[e + 1:]
+        return jnp.reshape(a, new_shape)
+    return apply_op(f, x, _op_name="flatten")
+
+
+def flatten_(x, start_axis=0, stop_axis=-1, name=None):
+    return x._inplace(flatten(x._snapshot(), start_axis, stop_axis))
+
+
+def _axes(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        a = np.asarray(axis._data).reshape(-1)
+        return tuple(int(i) for i in a)
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(_unwrap_i(a)) for a in axis)
+    return int(_unwrap_i(axis))
+
+
+def _unwrap_i(a):
+    return int(a._data) if isinstance(a, Tensor) else int(a)
+
+
+def squeeze(x, axis=None, name=None):
+    ax = _axes(axis)
+
+    def f(a):
+        if ax is None:
+            return jnp.squeeze(a)
+        axs = ax if isinstance(ax, tuple) else (ax,)
+        axs = tuple(i % a.ndim for i in axs)
+        axs = tuple(i for i in axs if a.shape[i] == 1)
+        return jnp.squeeze(a, axis=axs) if axs else a
+    return apply_op(f, x, _op_name="squeeze")
+
+
+def squeeze_(x, axis=None, name=None):
+    return x._inplace(squeeze(x._snapshot(), axis))
+
+
+def unsqueeze(x, axis, name=None):
+    ax = _axes(axis)
+    axs = ax if isinstance(ax, tuple) else (ax,)
+    return apply_op(lambda a: jnp.expand_dims(a, axs), x,
+                    _op_name="unsqueeze")
+
+
+def unsqueeze_(x, axis, name=None):
+    return x._inplace(unsqueeze(x._snapshot(), axis))
+
+
+def transpose(x, perm, name=None):
+    p = _axes(perm)
+    return apply_op(lambda a: jnp.transpose(a, p), x, _op_name="transpose")
+
+
+def t(x, name=None):
+    return apply_op(lambda a: a.T, x, _op_name="t")
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply_op(lambda a: jnp.moveaxis(a, source, destination), x,
+                    _op_name="moveaxis")
+
+
+def swapaxes(x, axis0, axis1, name=None):
+    return apply_op(lambda a: jnp.swapaxes(a, axis0, axis1), x,
+                    _op_name="swapaxes")
+
+
+def cast(x, dtype):
+    return x.astype(dtype)
+
+
+def concat(x: Sequence[Tensor], axis=0, name=None):
+    ax = _unwrap_i(axis)
+    tensors = list(x)
+    return apply_op(lambda *arrs: jnp.concatenate(arrs, axis=ax), *tensors,
+                    _op_name="concat")
+
+
+def stack(x: Sequence[Tensor], axis=0, name=None):
+    tensors = list(x)
+    return apply_op(lambda *arrs: jnp.stack(arrs, axis=axis), *tensors,
+                    _op_name="stack")
+
+
+def hstack(x, name=None):
+    return apply_op(lambda *arrs: jnp.hstack(arrs), *list(x),
+                    _op_name="hstack")
+
+
+def vstack(x, name=None):
+    return apply_op(lambda *arrs: jnp.vstack(arrs), *list(x),
+                    _op_name="vstack")
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = _unwrap_i(axis)
+    if isinstance(num_or_sections, int):
+        outs = apply_op(
+            lambda a: tuple(jnp.split(a, num_or_sections, axis=ax)), x,
+            _op_name="split")
+    else:
+        secs = [int(_unwrap_i(s)) for s in num_or_sections]
+        total = x.shape[ax]
+        if -1 in secs:
+            known = sum(s for s in secs if s != -1)
+            secs = [total - known if s == -1 else s for s in secs]
+        idx = np.cumsum(secs)[:-1].tolist()
+        outs = apply_op(lambda a: tuple(jnp.split(a, idx, axis=ax)), x,
+                        _op_name="split")
+    return list(outs)
+
+
+def tensor_split(x, num_or_indices, axis=0, name=None):
+    ax = _unwrap_i(axis)
+    if isinstance(num_or_indices, int):
+        n = num_or_indices
+        outs = apply_op(lambda a: tuple(jnp.array_split(a, n, axis=ax)), x,
+                        _op_name="tensor_split")
+    else:
+        idx = [int(_unwrap_i(i)) for i in num_or_indices]
+        outs = apply_op(lambda a: tuple(jnp.split(a, idx, axis=ax)), x,
+                        _op_name="tensor_split")
+    return list(outs)
+
+
+def dsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=2)
+
+
+def hsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=1 if x.ndim > 1 else 0)
+
+
+def vsplit(x, num_or_indices, name=None):
+    return tensor_split(x, num_or_indices, axis=0)
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def unbind(x, axis=0, name=None):
+    n = x.shape[axis]
+    outs = apply_op(
+        lambda a: tuple(jnp.squeeze(s, axis) for s in
+                        jnp.split(a, n, axis=axis)),
+        x, _op_name="unbind")
+    return list(outs)
+
+
+def tile(x, repeat_times, name=None):
+    reps = _axes(repeat_times)
+    reps = reps if isinstance(reps, tuple) else (reps,)
+    return apply_op(lambda a: jnp.tile(a, reps), x, _op_name="tile")
+
+
+def expand(x, shape, name=None):
+    shp = _static_shape(shape)
+
+    def f(a):
+        tgt = list(shp)
+        off = len(tgt) - a.ndim
+        for i in range(a.ndim):
+            if tgt[off + i] == -1:
+                tgt[off + i] = a.shape[i]
+        return jnp.broadcast_to(a, tuple(tgt))
+    return apply_op(f, x, _op_name="expand")
+
+
+def expand_as(x, y, name=None):
+    return expand(x, y.shape)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    tensors = list(inputs)
+    outs = apply_op(lambda *arrs: tuple(jnp.broadcast_arrays(*arrs)),
+                    *tensors, _op_name="broadcast_tensors")
+    return list(outs)
+
+
+def flip(x, axis, name=None):
+    ax = _axes(axis)
+    return apply_op(lambda a: jnp.flip(a, axis=ax), x, _op_name="flip")
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply_op(lambda a: jnp.rot90(a, k=k, axes=tuple(axes)), x,
+                    _op_name="rot90")
+
+
+def roll(x, shifts, axis=None, name=None):
+    sh = _axes(shifts)
+    ax = _axes(axis)
+    return apply_op(lambda a: jnp.roll(a, sh, axis=ax), x, _op_name="roll")
+
+
+def gather(x, index, axis=0, name=None):
+    ax = _unwrap_i(axis) if axis is not None else 0
+    return apply_op(lambda a, i: jnp.take(a, i.reshape(-1), axis=ax), x,
+                    index, _op_name="gather")
+
+
+def gather_nd(x, index, name=None):
+    def f(a, idx):
+        comps = tuple(idx[..., i] for i in range(idx.shape[-1]))
+        return a[comps]
+    return apply_op(f, x, index, _op_name="gather_nd")
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(a, i, u):
+        i = i.reshape(-1)
+        if overwrite:
+            return a.at[i].set(u)
+        zeroed = a.at[i].set(jnp.zeros_like(u))
+        return zeroed.at[i].add(u)
+    return apply_op(f, x, index, updates, _op_name="scatter")
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(a, idx, u):
+        comps = tuple(idx[..., i] for i in range(idx.shape[-1]))
+        return a.at[comps].add(u)
+    return apply_op(f, x, index, updates, _op_name="scatter_nd_add")
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply_op(lambda a, i: jnp.take(a, i.reshape(-1), axis=axis), x,
+                    index, _op_name="index_select")
+
+
+def index_add(x, index, axis, value, name=None):
+    def f(a, i, v):
+        a_m = jnp.moveaxis(a, axis, 0)
+        v_m = jnp.moveaxis(v, axis, 0)
+        out = a_m.at[i.reshape(-1)].add(v_m)
+        return jnp.moveaxis(out, 0, axis)
+    return apply_op(f, x, index, value, _op_name="index_add")
+
+
+def index_put(x, indices, value, accumulate=False, name=None):
+    idx_arrs = tuple(_unwrap(i) for i in indices)
+
+    def f(a, v):
+        if accumulate:
+            return a.at[idx_arrs].add(v)
+        return a.at[idx_arrs].set(v)
+    return apply_op(f, x, value, _op_name="index_put")
+
+
+def masked_select(x, mask, name=None):
+    """Data-dependent output shape: eager-only (not jit-traceable), like
+    reference masked_select (ops.yaml)."""
+    a = np.asarray(_unwrap(x))
+    m = np.asarray(_unwrap(mask))
+    return Tensor(jnp.asarray(a[np.broadcast_to(m, a.shape)]))
+
+
+def masked_fill(x, mask, value, name=None):
+    v = _unwrap(value)
+    return apply_op(lambda a, m: jnp.where(m, v, a), x, mask,
+                    _op_name="masked_fill")
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply_op(lambda c, a, b: jnp.where(c, a, b), condition,
+                    x if isinstance(x, Tensor) else Tensor(jnp.asarray(x)),
+                    y if isinstance(y, Tensor) else Tensor(jnp.asarray(y)),
+                    _op_name="where")
+
+
+def nonzero(x, as_tuple=False, name=None):
+    a = np.asarray(_unwrap(x))
+    nz = np.nonzero(a)
+    if as_tuple:
+        return tuple(Tensor(jnp.asarray(i.reshape(-1, 1))) for i in nz)
+    return Tensor(jnp.asarray(np.stack(nz, axis=1).astype(np.int64)))
+
+
+def sort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        s = jnp.sort(a, axis=axis, stable=True)
+        return jnp.flip(s, axis=axis) if descending else s
+    return apply_op(f, x, _op_name="sort")
+
+
+def argsort(x, axis=-1, descending=False, stable=False, name=None):
+    def f(a):
+        i = jnp.argsort(a, axis=axis, stable=True)
+        return jnp.flip(i, axis=axis) if descending else i
+    return apply_op(f, x, _op_name="argsort").astype("int64")
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):
+    kk = _unwrap_i(k)
+
+    def f(a):
+        ax = axis % a.ndim
+        src = a if largest else -a
+        moved = jnp.moveaxis(src, ax, -1)
+        vals, idx = jax.lax.top_k(moved, kk)
+        if not largest:
+            vals = -vals
+        return (jnp.moveaxis(vals, -1, ax),
+                jnp.moveaxis(idx.astype(jnp.int64), -1, ax))
+    return apply_op(f, x, _op_name="topk")
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    a = np.asarray(_unwrap(x))
+    res = np.unique(a, return_index=return_index,
+                    return_inverse=return_inverse,
+                    return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(jnp.asarray(res))
+    return tuple(Tensor(jnp.asarray(r)) for r in res)
+
+
+def unique_consecutive(x, return_inverse=False, return_counts=False,
+                       axis=None, dtype="int64", name=None):
+    a = np.asarray(_unwrap(x)).reshape(-1) if axis is None else \
+        np.asarray(_unwrap(x))
+    if a.size == 0:
+        return Tensor(jnp.asarray(a))
+    keep = np.concatenate([[True], a[1:] != a[:-1]]) if axis is None else None
+    out = a[keep]
+    results = [Tensor(jnp.asarray(out))]
+    if return_inverse:
+        inv = np.cumsum(keep) - 1
+        results.append(Tensor(jnp.asarray(inv.astype(np.int64))))
+    if return_counts:
+        idx = np.flatnonzero(keep)
+        counts = np.diff(np.concatenate([idx, [a.size]]))
+        results.append(Tensor(jnp.asarray(counts.astype(np.int64))))
+    return results[0] if len(results) == 1 else tuple(results)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False,
+                 name=None):
+    side = "right" if right else "left"
+    dt = jnp.int32 if out_int32 else jnp.int64
+    return apply_op(
+        lambda s, v: jnp.searchsorted(s, v, side=side).astype(dt),
+        sorted_sequence, values, _op_name="searchsorted")
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    if isinstance(repeats, Tensor):
+        reps = np.asarray(repeats._data)
+        a = np.asarray(_unwrap(x))
+        return Tensor(jnp.asarray(np.repeat(a, reps, axis=axis)))
+    return apply_op(lambda a: jnp.repeat(a, repeats, axis=axis), x,
+                    _op_name="repeat_interleave")
+
+
+def take_along_axis(arr, indices, axis, broadcast=True, name=None):
+    return apply_op(lambda a, i: jnp.take_along_axis(a, i, axis=axis), arr,
+                    indices, _op_name="take_along_axis")
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign",
+                   include_self=True, broadcast=True, name=None):
+    def f(a, i, v):
+        v = jnp.broadcast_to(v, i.shape) if v.ndim else \
+            jnp.full(i.shape, v, a.dtype)
+        dim_idx = [jnp.arange(s).reshape(
+            tuple(s if d == k else 1 for k, _ in enumerate(i.shape)))
+            for d, s in enumerate(i.shape)]
+        full_idx = tuple(i if d == axis % a.ndim else
+                         jnp.broadcast_to(dim_idx[d], i.shape)
+                         for d in range(a.ndim))
+        if reduce == "add":
+            return a.at[full_idx].add(v)
+        if reduce == "multiply" or reduce == "mul":
+            return a.at[full_idx].multiply(v)
+        return a.at[full_idx].set(v)
+    if not isinstance(values, Tensor):
+        values = Tensor(jnp.asarray(values))
+    return apply_op(f, arr, indices, values, _op_name="put_along_axis")
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    def f(a):
+        idx = [builtins.slice(None)] * a.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            idx[ax] = builtins.slice(_unwrap_i(s), _unwrap_i(e), _unwrap_i(st))
+        return a[tuple(idx)]
+    return apply_op(f, x, _op_name="strided_slice")
+
+
+def slice(input, axes, starts, ends, name=None):
+    return strided_slice(input, axes, starts, ends, [1] * len(list(axes)))
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    shp = _static_shape(shape)
+    offs = [0] * len(shp) if offsets is None else \
+        [_unwrap_i(o) for o in offsets]
+
+    def f(a):
+        idx = tuple(builtins.slice(o, o + (s if s != -1 else a.shape[d] - o))
+                    for d, (o, s) in enumerate(zip(offs, shp)))
+        return a[idx]
+    return apply_op(f, x, _op_name="crop")
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    """paddle.nn.functional.pad semantics (list len == 2*ndim or per-format)."""
+    p = [_unwrap_i(i) for i in pad] if not isinstance(pad, int) else None
+
+    def f(a):
+        if isinstance(pad, int):
+            widths = [(pad, pad)] * a.ndim
+        elif len(p) == 2 * a.ndim:
+            widths = [(p[2 * i], p[2 * i + 1]) for i in range(a.ndim)]
+        else:
+            # NCHW-style: pad applies to trailing spatial dims, reversed pairs
+            n_spatial = len(p) // 2
+            widths = [(0, 0)] * (a.ndim - n_spatial) + \
+                [(p[2 * i], p[2 * i + 1]) for i in range(n_spatial)]
+            if data_format in ("NCHW", "NCL", "NCDHW"):
+                pass
+            else:  # NHWC: spatial dims sit before channel
+                widths = [(0, 0)] + widths[2:] + [(0, 0)]
+        if mode == "constant":
+            return jnp.pad(a, widths, constant_values=value)
+        jmode = {"reflect": "reflect", "replicate": "edge",
+                 "circular": "wrap"}[mode]
+        return jnp.pad(a, widths, mode=jmode)
+    return apply_op(f, x, _op_name="pad")
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """reference: python/paddle/tensor/manipulation.py shard_index — used by
+    parallel cross entropy."""
+    size = (index_num + nshards - 1) // nshards
+
+    def f(i):
+        shard = i // size
+        local = i % size
+        return jnp.where(shard == shard_id, local, ignore_value)
+    return apply_op(f, input, _op_name="shard_index")
+
+
+def tensordot(x, y, axes=2, name=None):
+    ax = axes
+    if isinstance(ax, Tensor):
+        ax = np.asarray(ax._data).tolist()
+    if isinstance(ax, (list, tuple)):
+        ax = tuple(tuple(_unwrap_i(i) for i in a) if isinstance(a, (list, tuple))
+                   else _unwrap_i(a) for a in ax)
+    return apply_op(lambda a, b: jnp.tensordot(a, b, axes=ax), x, y,
+                    _op_name="tensordot")
+
+
+def as_complex(x, name=None):
+    return apply_op(lambda a: jax.lax.complex(a[..., 0], a[..., 1]), x,
+                    _op_name="as_complex")
+
+
+def as_real(x, name=None):
+    return apply_op(lambda a: jnp.stack([jnp.real(a), jnp.imag(a)], axis=-1),
+                    x, _op_name="as_real")
+
+
+def atleast_1d(*inputs, name=None):
+    outs = [apply_op(jnp.atleast_1d, x, _op_name="atleast_1d") for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_2d(*inputs, name=None):
+    outs = [apply_op(jnp.atleast_2d, x, _op_name="atleast_2d") for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def atleast_3d(*inputs, name=None):
+    outs = [apply_op(jnp.atleast_3d, x, _op_name="atleast_3d") for x in inputs]
+    return outs[0] if len(outs) == 1 else outs
+
+
+def select_scatter(x, values, axis, index, name=None):
+    def f(a, v):
+        idx = [builtins.slice(None)] * a.ndim
+        idx[axis] = index
+        return a.at[tuple(idx)].set(v)
+    return apply_op(f, x, values, _op_name="select_scatter")
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply_op(lambda a: jnp.diagonal(a, offset=offset, axis1=axis1,
+                                           axis2=axis2), x,
+                    _op_name="diagonal")
+
+
+# bind methods
+import sys
+
+_this = sys.modules[__name__]
+for _name in __all__:
+    _fn = getattr(_this, _name, None)
+    if callable(_fn) and not hasattr(Tensor, _name):
+        Tensor._bind(_name, _fn)
+del _this, _name, _fn

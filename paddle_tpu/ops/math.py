@@ -1,0 +1,394 @@
+"""Elementwise / reduction math ops (reference: python/paddle/tensor/math.py,
+stat.py; kernels /root/reference/paddle/phi/kernels/*_kernel.h)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dtype import to_dtype
+from ..framework.tensor import Tensor, apply_op, _unwrap
+
+__all__ = [
+    "add", "subtract", "multiply", "divide", "floor_divide", "remainder",
+    "mod", "pow", "matmul_alias_guard", "maximum", "minimum", "fmax", "fmin",
+    "abs", "sign", "neg", "reciprocal", "square", "sqrt", "rsqrt", "exp",
+    "expm1", "log", "log2", "log10", "log1p", "floor", "ceil", "round",
+    "trunc", "frac", "sin", "cos", "tan", "asin", "acos", "atan", "atan2",
+    "sinh", "cosh", "tanh", "asinh", "acosh", "atanh", "erf", "erfinv",
+    "lgamma", "digamma", "clip", "lerp", "scale", "increment", "stanh",
+    "sum", "mean", "max", "min", "prod", "amax", "amin", "std", "var",
+    "median", "nanmedian", "nansum", "nanmean", "argmax", "argmin", "cumsum",
+    "cumprod", "cummax", "cummin", "logsumexp", "logcumsumexp", "isnan",
+    "isinf", "isfinite", "all", "any", "kron", "trace", "diff", "angle",
+    "conj", "real", "imag", "count_nonzero", "heaviside", "rad2deg",
+    "deg2rad", "gcd", "lcm", "take", "multiply_", "add_n", "addmm", "inner",
+    "outer", "logit", "nan_to_num",
+]
+
+
+def _ew(fn, name, *xs, **kw):
+    """Route an elementwise op; promote python scalars transparently."""
+    tensors = [x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+               for x in xs]
+    return apply_op(fn, *tensors, _op_name=name, **kw)
+
+
+def add(x, y, name=None):
+    return _ew(jnp.add, "add", x, y)
+
+
+def subtract(x, y, name=None):
+    return _ew(jnp.subtract, "subtract", x, y)
+
+
+def multiply(x, y, name=None):
+    return _ew(jnp.multiply, "multiply", x, y)
+
+
+def divide(x, y, name=None):
+    return _ew(jnp.divide, "divide", x, y)
+
+
+def floor_divide(x, y, name=None):
+    return _ew(jnp.floor_divide, "floor_divide", x, y)
+
+
+def remainder(x, y, name=None):
+    return _ew(jnp.remainder, "remainder", x, y)
+
+
+mod = remainder
+
+
+def pow(x, y, name=None):
+    return _ew(jnp.power, "pow", x, y)
+
+
+matmul_alias_guard = None  # placeholder so __all__ import stays clean
+
+
+def maximum(x, y, name=None):
+    return _ew(jnp.maximum, "maximum", x, y)
+
+
+def minimum(x, y, name=None):
+    return _ew(jnp.minimum, "minimum", x, y)
+
+
+def fmax(x, y, name=None):
+    return _ew(jnp.fmax, "fmax", x, y)
+
+
+def fmin(x, y, name=None):
+    return _ew(jnp.fmin, "fmin", x, y)
+
+
+def _unary(jfn, name):
+    def op(x, name=None):
+        return _ew(jfn, name_, x)
+    name_ = name
+    op.__name__ = name
+    return op
+
+
+abs = _unary(jnp.abs, "abs")
+sign = _unary(jnp.sign, "sign")
+neg = _unary(jnp.negative, "neg")
+reciprocal = _unary(jnp.reciprocal, "reciprocal")
+square = _unary(jnp.square, "square")
+sqrt = _unary(jnp.sqrt, "sqrt")
+rsqrt = _unary(lambda a: jax.lax.rsqrt(a), "rsqrt")
+exp = _unary(jnp.exp, "exp")
+expm1 = _unary(jnp.expm1, "expm1")
+log = _unary(jnp.log, "log")
+log2 = _unary(jnp.log2, "log2")
+log10 = _unary(jnp.log10, "log10")
+log1p = _unary(jnp.log1p, "log1p")
+floor = _unary(jnp.floor, "floor")
+ceil = _unary(jnp.ceil, "ceil")
+round = _unary(jnp.round, "round")
+trunc = _unary(jnp.trunc, "trunc")
+frac = _unary(lambda a: a - jnp.trunc(a), "frac")
+sin = _unary(jnp.sin, "sin")
+cos = _unary(jnp.cos, "cos")
+tan = _unary(jnp.tan, "tan")
+asin = _unary(jnp.arcsin, "asin")
+acos = _unary(jnp.arccos, "acos")
+atan = _unary(jnp.arctan, "atan")
+sinh = _unary(jnp.sinh, "sinh")
+cosh = _unary(jnp.cosh, "cosh")
+tanh = _unary(jnp.tanh, "tanh")
+asinh = _unary(jnp.arcsinh, "asinh")
+acosh = _unary(jnp.arccosh, "acosh")
+atanh = _unary(jnp.arctanh, "atanh")
+erf = _unary(jax.scipy.special.erf, "erf")
+erfinv = _unary(jax.scipy.special.erfinv, "erfinv")
+lgamma = _unary(jax.scipy.special.gammaln, "lgamma")
+digamma = _unary(jax.scipy.special.digamma, "digamma")
+isnan = _unary(jnp.isnan, "isnan")
+isinf = _unary(jnp.isinf, "isinf")
+isfinite = _unary(jnp.isfinite, "isfinite")
+conj = _unary(jnp.conj, "conj")
+real = _unary(jnp.real, "real")
+imag = _unary(jnp.imag, "imag")
+angle = _unary(jnp.angle, "angle")
+rad2deg = _unary(jnp.rad2deg, "rad2deg")
+deg2rad = _unary(jnp.deg2rad, "deg2rad")
+logit = _unary(jax.scipy.special.logit, "logit")
+
+
+def atan2(x, y, name=None):
+    return _ew(jnp.arctan2, "atan2", x, y)
+
+
+def heaviside(x, y, name=None):
+    return _ew(jnp.heaviside, "heaviside", x, y)
+
+
+def gcd(x, y, name=None):
+    return _ew(jnp.gcd, "gcd", x, y)
+
+
+def lcm(x, y, name=None):
+    return _ew(jnp.lcm, "lcm", x, y)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    return _ew(lambda a: scale_b * jnp.tanh(scale_a * a), "stanh", x)
+
+
+def clip(x, min=None, max=None, name=None):
+    lo = _unwrap(min) if min is not None else None
+    hi = _unwrap(max) if max is not None else None
+    return _ew(lambda a: jnp.clip(a, lo, hi), "clip", x)
+
+
+def lerp(x, y, weight, name=None):
+    if isinstance(weight, Tensor):
+        return _ew(lambda a, b, w: a + w * (b - a), "lerp", x, y, weight)
+    return _ew(lambda a, b: a + weight * (b - a), "lerp", x, y)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = _unwrap(scale), _unwrap(bias)
+
+    def f(a):
+        return a * s + b if bias_after_scale else (a + b) * s
+
+    return _ew(f, "scale", x)
+
+
+def increment(x, value=1.0, name=None):
+    return x._inplace(_ew(lambda a: a + value, "increment", x._snapshot()))
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return _ew(lambda a: jnp.nan_to_num(a, nan=nan, posinf=posinf,
+                                        neginf=neginf), "nan_to_num", x)
+
+
+# -- reductions -------------------------------------------------------------
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        ax = np.asarray(axis._data)
+        return tuple(int(a) for a in ax.reshape(-1))
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+def _reduce(jfn, name):
+    def op(x, axis=None, keepdim=False, name=None, dtype=None):
+        ax = _axis(axis)
+        kw = {}
+        if dtype is not None:
+            kw["dtype"] = to_dtype(dtype).np_dtype
+        return _ew(lambda a: jfn(a, axis=ax, keepdims=keepdim, **kw),
+                   name_, x)
+    name_ = name
+    op.__name__ = name
+    return op
+
+
+sum = _reduce(jnp.sum, "sum")
+nansum = _reduce(jnp.nansum, "nansum")
+prod = _reduce(jnp.prod, "prod")
+amax = _reduce(jnp.max, "amax")
+amin = _reduce(jnp.min, "amin")
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    def f(a):
+        if jnp.issubdtype(a.dtype, jnp.integer) or a.dtype == jnp.bool_:
+            a = a.astype(jnp.float32)
+        return jnp.mean(a, axis=_axis(axis), keepdims=keepdim)
+    return _ew(f, "mean", x)
+
+
+nanmean = _reduce(jnp.nanmean, "nanmean")
+max = _reduce(jnp.max, "max")
+min = _reduce(jnp.min, "min")
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _ew(lambda a: jnp.std(a, axis=_axis(axis), ddof=1 if unbiased else 0,
+                                 keepdims=keepdim), "std", x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return _ew(lambda a: jnp.var(a, axis=_axis(axis), ddof=1 if unbiased else 0,
+                                 keepdims=keepdim), "var", x)
+
+
+def median(x, axis=None, keepdim=False, mode="avg", name=None):
+    return _ew(lambda a: jnp.median(a, axis=_axis(axis), keepdims=keepdim),
+               "median", x)
+
+
+def nanmedian(x, axis=None, keepdim=False, name=None):
+    return _ew(lambda a: jnp.nanmedian(a, axis=_axis(axis), keepdims=keepdim),
+               "nanmedian", x)
+
+
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = to_dtype(dtype).np_dtype
+    return _ew(lambda a: jnp.argmax(a, axis=_axis(axis),
+                                    keepdims=keepdim).astype(dt), "argmax", x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    dt = to_dtype(dtype).np_dtype
+    return _ew(lambda a: jnp.argmin(a, axis=_axis(axis),
+                                    keepdims=keepdim).astype(dt), "argmin", x)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    dt = to_dtype(dtype).np_dtype if dtype is not None else None
+    return _ew(lambda a: jnp.cumsum(a if axis is not None else a.reshape(-1),
+                                    axis=axis if axis is not None else 0,
+                                    dtype=dt), "cumsum", x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    dt = to_dtype(dtype).np_dtype if dtype is not None else None
+    return _ew(lambda a: jnp.cumprod(a, axis=dim, dtype=dt), "cumprod", x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        flat = axis is None
+        ax = 0 if flat else axis
+        src = a.reshape(-1) if flat else a
+        vals = jax.lax.associative_scan(jnp.maximum, src, axis=ax)
+        idx = jnp.argmax(
+            jnp.cumsum(jnp.ones_like(src, dtype=jnp.int32), axis=ax) *
+            (src == vals), axis=ax)
+        return vals
+    return _ew(f, "cummax", x)
+
+
+def cummin(x, axis=None, dtype="int64", name=None):
+    def f(a):
+        flat = axis is None
+        ax = 0 if flat else axis
+        src = a.reshape(-1) if flat else a
+        return jax.lax.associative_scan(jnp.minimum, src, axis=ax)
+    return _ew(f, "cummin", x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return _ew(lambda a: jax.scipy.special.logsumexp(
+        a, axis=_axis(axis), keepdims=keepdim), "logsumexp", x)
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def f(a):
+        src = a.reshape(-1) if axis is None else a
+        ax = 0 if axis is None else axis
+        return jax.lax.associative_scan(jnp.logaddexp, src, axis=ax)
+    return _ew(f, "logcumsumexp", x)
+
+
+def all(x, axis=None, keepdim=False, name=None):
+    return _ew(lambda a: jnp.all(a, axis=_axis(axis), keepdims=keepdim),
+               "all", x)
+
+
+def any(x, axis=None, keepdim=False, name=None):
+    return _ew(lambda a: jnp.any(a, axis=_axis(axis), keepdims=keepdim),
+               "any", x)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return _ew(lambda a: jnp.count_nonzero(a, axis=_axis(axis),
+                                           keepdims=keepdim).astype(jnp.int64),
+               "count_nonzero", x)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return _ew(lambda a: jnp.trace(a, offset=offset, axis1=axis1, axis2=axis2),
+               "trace", x)
+
+
+def kron(x, y, name=None):
+    return _ew(jnp.kron, "kron", x, y)
+
+
+def diff(x, n=1, axis=-1, prepend=None, append=None, name=None):
+    pre = _unwrap(prepend) if prepend is not None else None
+    app = _unwrap(append) if append is not None else None
+    return _ew(lambda a: jnp.diff(a, n=n, axis=axis, prepend=pre, append=app),
+               "diff", x)
+
+
+def take(x, index, mode="raise", name=None):
+    return _ew(lambda a, i: jnp.take(a.reshape(-1), i.reshape(-1), mode="clip"
+                                     if mode == "clip" else "wrap"
+                                     if mode == "wrap" else None),
+               "take", x, index)
+
+
+def add_n(inputs, name=None):
+    if isinstance(inputs, Tensor):
+        return inputs
+    tensors = list(inputs)
+
+    def f(*arrs):
+        out = arrs[0]
+        for a in arrs[1:]:
+            out = out + a
+        return out
+
+    return apply_op(f, *tensors, _op_name="add_n")
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    return _ew(lambda i, a, b: beta * i + alpha * (a @ b), "addmm",
+               input, x, y)
+
+
+def inner(x, y, name=None):
+    return _ew(jnp.inner, "inner", x, y)
+
+
+def outer(x, y, name=None):
+    return _ew(lambda a, b: jnp.outer(a, b), "outer", x, y)
+
+
+def multiply_(x, y):
+    return x.multiply_(y)
+
+
+# -- bind tensor methods ----------------------------------------------------
+import sys
+
+_this = sys.modules[__name__]
+for _name in __all__:
+    _fn = getattr(_this, _name, None)
+    if callable(_fn) and not hasattr(Tensor, _name):
+        Tensor._bind(_name, _fn)
+del _this, _name, _fn

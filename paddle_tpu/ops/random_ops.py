@@ -1,0 +1,162 @@
+"""Random sampling ops (reference: python/paddle/tensor/random.py; phi
+Generator /root/reference/paddle/phi/core/generator.h:32).
+
+TPU-native: stateless JAX PRNG keys drawn from the global stateful
+``framework.random`` counter generator, keeping paddle's stateful-RNG user
+model while staying reproducible and shardable.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as rnd
+from ..framework import dtype as dtype_mod
+from ..framework.dtype import to_dtype
+from ..framework.tensor import Tensor, apply_op, _unwrap
+
+__all__ = [
+    "rand", "randn", "randint", "randint_like", "uniform", "normal",
+    "standard_normal", "gaussian", "randperm", "multinomial", "bernoulli",
+    "poisson", "exponential_", "uniform_", "normal_", "shuffle",
+]
+
+
+def _shape_list(shape):
+    if isinstance(shape, Tensor):
+        return tuple(int(s) for s in np.asarray(shape._data))
+    if isinstance(shape, (int, np.integer)):
+        return (int(shape),)
+    return tuple(int(_unwrap(s)) if not isinstance(s, Tensor) else int(s._data)
+                 for s in shape)
+
+
+def _fdt(dtype):
+    return to_dtype(dtype).np_dtype if dtype is not None \
+        else dtype_mod.get_default_dtype().np_dtype
+
+
+def rand(shape, dtype=None, name=None):
+    return Tensor(jax.random.uniform(rnd.next_key(), _shape_list(shape),
+                                     dtype=_fdt(dtype)))
+
+
+def randn(shape, dtype=None, name=None):
+    return Tensor(jax.random.normal(rnd.next_key(), _shape_list(shape),
+                                    dtype=_fdt(dtype)))
+
+
+standard_normal = randn
+
+
+def gaussian(shape, mean=0.0, std=1.0, seed=0, dtype=None, name=None):
+    key = rnd.next_key() if seed == 0 else jax.random.key(seed)
+    return Tensor(mean + std * jax.random.normal(key, _shape_list(shape),
+                                                 dtype=_fdt(dtype)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None):
+    if isinstance(mean, Tensor) or isinstance(std, Tensor):
+        m = _unwrap(mean) if isinstance(mean, Tensor) else mean
+        s = _unwrap(std) if isinstance(std, Tensor) else std
+        shp = jnp.broadcast_shapes(
+            np.shape(m) if not isinstance(m, jax.Array) else m.shape,
+            np.shape(s) if not isinstance(s, jax.Array) else s.shape)
+        return Tensor(m + s * jax.random.normal(rnd.next_key(), shp,
+                                                dtype=jnp.float32))
+    return Tensor(mean + std * jax.random.normal(
+        rnd.next_key(), _shape_list(shape if shape is not None else []),
+        dtype=dtype_mod.get_default_dtype().np_dtype))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None):
+    key = rnd.next_key() if seed == 0 else jax.random.key(seed)
+    return Tensor(jax.random.uniform(key, _shape_list(shape),
+                                     dtype=_fdt(dtype), minval=min,
+                                     maxval=max))
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None):
+    if high is None:
+        low, high = 0, low
+    dt = to_dtype(dtype).np_dtype if dtype is not None else np.int64
+    return Tensor(jax.random.randint(rnd.next_key(), _shape_list(shape),
+                                     low, high, dtype=dt))
+
+
+def randint_like(x, low=0, high=None, dtype=None, name=None):
+    return randint(low, high, x.shape,
+                   dtype or x.dtype)
+
+
+def randperm(n, dtype="int64", name=None):
+    return Tensor(jax.random.permutation(rnd.next_key(), int(n)).astype(
+        to_dtype(dtype).np_dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None):
+    key = rnd.next_key()
+
+    def f(a):
+        logits = jnp.log(jnp.maximum(a, 1e-30))
+        if replacement:
+            return jax.random.categorical(
+                key, logits, shape=a.shape[:-1] + (num_samples,),
+                axis=-1).astype(jnp.int64)
+        # without replacement: Gumbel top-k trick
+        g = jax.random.gumbel(key, a.shape, dtype=jnp.float32)
+        _, idx = jax.lax.top_k(logits + g, num_samples)
+        return idx.astype(jnp.int64)
+    return Tensor(f(_unwrap(x)))
+
+
+def bernoulli(x, name=None):
+    key = rnd.next_key()
+    return Tensor(jax.random.bernoulli(key, _unwrap(x)).astype(
+        _unwrap(x).dtype))
+
+
+def poisson(x, name=None):
+    key = rnd.next_key()
+    a = _unwrap(x)
+    return Tensor(jax.random.poisson(key, a).astype(a.dtype))
+
+
+def exponential_(x, lam=1.0, name=None):
+    key = rnd.next_key()
+    new = jax.random.exponential(key, tuple(x.shape),
+                                 dtype=x._data.dtype) / lam
+    x._data = new
+    x.grad_node = None
+    return x
+
+
+def uniform_(x, min=-1.0, max=1.0, seed=0, name=None):
+    key = rnd.next_key() if seed == 0 else jax.random.key(seed)
+    x._data = jax.random.uniform(key, tuple(x.shape), dtype=x._data.dtype,
+                                 minval=min, maxval=max)
+    x.grad_node = None
+    return x
+
+
+def normal_(x, mean=0.0, std=1.0, name=None):
+    x._data = mean + std * jax.random.normal(rnd.next_key(), tuple(x.shape),
+                                             dtype=x._data.dtype)
+    x.grad_node = None
+    return x
+
+
+def shuffle(x, name=None):
+    key = rnd.next_key()
+    return Tensor(jax.random.permutation(key, _unwrap(x), axis=0))
+
+
+import sys
+
+_this = sys.modules[__name__]
+for _name in __all__:
+    _fn = getattr(_this, _name, None)
+    if callable(_fn) and not hasattr(Tensor, _name):
+        Tensor._bind(_name, _fn)
+del _this, _name, _fn

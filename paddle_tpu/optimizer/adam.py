@@ -1,0 +1,179 @@
+"""Adam-family optimizers (reference: python/paddle/optimizer/adam.py,
+adamw.py, adamax.py, lamb.py; fused GPU kernels
+phi/kernels/gpu/adam_kernel.cu, fused_adam_kernel — here one jitted XLA
+update each, with optional float32 master weights for bf16 params
+(AMP O2 "master grad/weight" semantics, python/paddle/amp/auto_cast.py)."""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Parameter, Tensor
+from .optimizer import Optimizer, _DecoupledWD
+
+__all__ = ["Adam", "AdamW", "Adamax", "Lamb"]
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3),
+                   static_argnames=("wd_coupled",))
+def _adam_update(p, g, m, v, lr, beta1, beta2, eps, t, wd_coupled):
+    g = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    if wd_coupled != 0.0:
+        g = g + wd_coupled * pf
+    m_new = beta1 * m + (1 - beta1) * g
+    v_new = beta2 * v + (1 - beta2) * jnp.square(g)
+    mhat = m_new / (1 - beta1 ** t)
+    vhat = v_new / (1 - beta2 ** t)
+    p_new = pf - lr * mhat / (jnp.sqrt(vhat) + eps)
+    return p_new, m_new, v_new
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3))
+def _adamw_update(p, g, m, v, lr, beta1, beta2, eps, t, wd):
+    g = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    pf = pf * (1 - lr * wd)  # decoupled decay (AdamW)
+    m_new = beta1 * m + (1 - beta1) * g
+    v_new = beta2 * v + (1 - beta2) * jnp.square(g)
+    mhat = m_new / (1 - beta1 ** t)
+    vhat = v_new / (1 - beta2 ** t)
+    p_new = pf - lr * mhat / (jnp.sqrt(vhat) + eps)
+    return p_new, m_new, v_new
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=True,
+                 use_multi_tensor=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+        self._coupled_wd = float(weight_decay) if weight_decay else 0.0
+        self._multi_precision = multi_precision
+
+    def _master(self, p: Parameter) -> jax.Array:
+        """float32 master weight for low-precision params (AMP O2)."""
+        if p._data.dtype == jnp.float32 or not self._multi_precision:
+            return p._data
+        return self._acc(p, "master_weight",
+                         init=p._data.astype(jnp.float32))
+
+    def _store_master(self, p: Parameter, new_p: jax.Array) -> jax.Array:
+        if p._data.dtype != jnp.float32 and self._multi_precision:
+            self._set_acc(p, "master_weight", new_p)
+        return new_p
+
+    def _update_param(self, p, g):
+        m = self._acc(p, "moment1", init=jnp.zeros(p._data.shape,
+                                                   jnp.float32))
+        v = self._acc(p, "moment2", init=jnp.zeros(p._data.shape,
+                                                   jnp.float32))
+        new_p, m2, v2 = _adam_update(
+            self._master(p), g, m, v, self._param_lr(p), self._beta1,
+            self._beta2, self._epsilon, self._step_count, self._coupled_wd)
+        self._set_acc(p, "moment1", m2)
+        self._set_acc(p, "moment2", v2)
+        return self._store_master(p, new_p)
+
+
+class AdamW(Adam, _DecoupledWD):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=True, name=None):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip, lazy_mode, multi_precision)
+        self._wd = float(weight_decay)
+        self._apply_decay_param_fun = apply_decay_param_fun
+        self._lr_ratio = lr_ratio
+
+    def _update_param(self, p, g):
+        m = self._acc(p, "moment1", init=jnp.zeros(p._data.shape,
+                                                   jnp.float32))
+        v = self._acc(p, "moment2", init=jnp.zeros(p._data.shape,
+                                                   jnp.float32))
+        wd = self._wd
+        if self._apply_decay_param_fun is not None and \
+                not self._apply_decay_param_fun(p.name):
+            wd = 0.0
+        lr = self._param_lr(p)
+        if self._lr_ratio is not None:
+            lr = lr * self._lr_ratio(p)
+        new_p, m2, v2 = _adamw_update(
+            self._master(p), g, m, v, lr, self._beta1, self._beta2,
+            self._epsilon, self._step_count, wd)
+        self._set_acc(p, "moment1", m2)
+        self._set_acc(p, "moment2", v2)
+        return self._store_master(p, new_p)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3))
+def _adamax_update(p, g, m, u, lr, beta1, beta2, eps, t):
+    g = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    m_new = beta1 * m + (1 - beta1) * g
+    u_new = jnp.maximum(beta2 * u, jnp.abs(g))
+    p_new = pf - (lr / (1 - beta1 ** t)) * m_new / (u_new + eps)
+    return p_new, m_new, u_new
+
+
+class Adamax(Adam):
+    def _update_param(self, p, g):
+        m = self._acc(p, "moment", init=jnp.zeros(p._data.shape,
+                                                  jnp.float32))
+        u = self._acc(p, "inf_norm", init=jnp.zeros(p._data.shape,
+                                                    jnp.float32))
+        new_p, m2, u2 = _adamax_update(
+            self._master(p), g, m, u, self._param_lr(p), self._beta1,
+            self._beta2, self._epsilon, self._step_count)
+        self._set_acc(p, "moment", m2)
+        self._set_acc(p, "inf_norm", u2)
+        return self._store_master(p, new_p)
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3))
+def _lamb_update(p, g, m, v, lr, beta1, beta2, eps, t, wd):
+    g = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    m_new = beta1 * m + (1 - beta1) * g
+    v_new = beta2 * v + (1 - beta2) * jnp.square(g)
+    mhat = m_new / (1 - beta1 ** t)
+    vhat = v_new / (1 - beta2 ** t)
+    r = mhat / (jnp.sqrt(vhat) + eps) + wd * pf
+    w_norm = jnp.linalg.norm(pf)
+    r_norm = jnp.linalg.norm(r)
+    trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+    return pf - lr * trust * r, m_new, v_new
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01,
+                 beta1=0.9, beta2=0.999, epsilon=1e-6, parameters=None,
+                 grad_clip=None, exclude_from_weight_decay_fn=None,
+                 multi_precision=True, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+        self._multi_precision = multi_precision
+        self._master = Adam._master.__get__(self)
+        self._store_master = Adam._store_master.__get__(self)
+
+    def _update_param(self, p, g):
+        m = self._acc(p, "moment1", init=jnp.zeros(p._data.shape,
+                                                   jnp.float32))
+        v = self._acc(p, "moment2", init=jnp.zeros(p._data.shape,
+                                                   jnp.float32))
+        wd = 0.0 if (self._exclude_fn is not None and self._exclude_fn(p)) \
+            else self._wd
+        new_p, m2, v2 = _lamb_update(
+            self._master(p), g, m, v, self._param_lr(p), self._beta1,
+            self._beta2, self._epsilon, self._step_count, wd)
+        self._set_acc(p, "moment1", m2)
+        self._set_acc(p, "moment2", v2)
+        return self._store_master(p, new_p)

@@ -1,0 +1,112 @@
+"""RMSProp / Adagrad / Adadelta / Rprop (reference:
+python/paddle/optimizer/{rmsprop,adagrad,adadelta,rprop}.py)."""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .optimizer import Optimizer
+
+__all__ = ["RMSProp", "Adagrad", "Adadelta"]
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3),
+                   static_argnames=("centered",))
+def _rmsprop_update(p, g, mean_sq, mom, lr, rho, eps, momentum, centered,
+                    mean_g):
+    g = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    ms_new = rho * mean_sq + (1 - rho) * jnp.square(g)
+    if centered:
+        mg_new = rho * mean_g + (1 - rho) * g
+        denom = jnp.sqrt(ms_new - jnp.square(mg_new) + eps)
+    else:
+        mg_new = mean_g
+        denom = jnp.sqrt(ms_new + eps)
+    mom_new = momentum * mom + lr * g / denom
+    return pf - mom_new, ms_new, mom_new, mg_new
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _update_param(self, p, g):
+        ms = self._acc(p, "mean_square",
+                       init=jnp.zeros(p._data.shape, jnp.float32))
+        mom = self._acc(p, "momentum",
+                        init=jnp.zeros(p._data.shape, jnp.float32))
+        mg = self._acc(p, "mean_grad",
+                       init=jnp.zeros(p._data.shape, jnp.float32))
+        new_p, ms2, mom2, mg2 = _rmsprop_update(
+            p._data, g, ms, mom, self._param_lr(p), self._rho,
+            self._epsilon, self._momentum, self._centered, mg)
+        self._set_acc(p, "mean_square", ms2)
+        self._set_acc(p, "momentum", mom2)
+        self._set_acc(p, "mean_grad", mg2)
+        return new_p
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2))
+def _adagrad_update(p, g, acc, lr, eps):
+    g = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    acc_new = acc + jnp.square(g)
+    return pf - lr * g / (jnp.sqrt(acc_new) + eps), acc_new
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None,
+                 initial_accumulator_value=0.0, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _update_param(self, p, g):
+        acc = self._acc(p, "moment",
+                        init=jnp.full(p._data.shape, self._init_acc,
+                                      jnp.float32))
+        new_p, acc2 = _adagrad_update(p._data, g, acc, self._param_lr(p),
+                                      self._epsilon)
+        self._set_acc(p, "moment", acc2)
+        return new_p
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2, 3))
+def _adadelta_update(p, g, avg_sq_g, avg_sq_dx, lr, rho, eps):
+    g = g.astype(jnp.float32)
+    pf = p.astype(jnp.float32)
+    asg_new = rho * avg_sq_g + (1 - rho) * jnp.square(g)
+    dx = -jnp.sqrt(avg_sq_dx + eps) / jnp.sqrt(asg_new + eps) * g
+    asdx_new = rho * avg_sq_dx + (1 - rho) * jnp.square(dx)
+    return pf + lr * dx, asg_new, asdx_new
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._epsilon, self._rho = epsilon, rho
+
+    def _update_param(self, p, g):
+        asg = self._acc(p, "avg_squared_grad",
+                        init=jnp.zeros(p._data.shape, jnp.float32))
+        asdx = self._acc(p, "avg_squared_update",
+                         init=jnp.zeros(p._data.shape, jnp.float32))
+        new_p, asg2, asdx2 = _adadelta_update(
+            p._data, g, asg, asdx, self._param_lr(p), self._rho,
+            self._epsilon)
+        self._set_acc(p, "avg_squared_grad", asg2)
+        self._set_acc(p, "avg_squared_update", asdx2)
+        return new_p

@@ -1,0 +1,182 @@
+"""Optimizer base + SGD/Momentum.
+
+Reference: python/paddle/optimizer/optimizer.py (base: regularization, grad
+clip, LR scheduler plumbing) and momentum.py. TPU-native: each update rule
+is one jitted pure function over (param, grad, state) arrays — XLA fuses the
+whole update; there are no per-op fused CUDA kernels to maintain
+(reference fused: phi/kernels/gpu/momentum_kernel.cu etc.).
+"""
+from __future__ import annotations
+
+import functools
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Parameter, Tensor, no_grad
+from .lr import LRScheduler
+
+__all__ = ["Optimizer", "SGD", "Momentum"]
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        if parameters is None:
+            raise ValueError(
+                "parameters is required in eager mode (pass "
+                "model.parameters())")
+        self._parameter_list = list(parameters)
+        self._learning_rate = learning_rate
+        self._grad_clip = grad_clip
+        self._weight_decay = weight_decay
+        # state: param-name-keyed dict of jax arrays
+        self._accumulators: Dict[str, Dict[str, jax.Array]] = {}
+        self._step_count = 0
+
+    # -- lr ---------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return float(self._learning_rate())
+        return float(self._learning_rate)
+
+    def set_lr(self, value: float):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError(
+                "cannot set_lr when the learning rate is a scheduler")
+        self._learning_rate = float(value)
+
+    def set_lr_scheduler(self, scheduler: LRScheduler):
+        self._learning_rate = scheduler
+
+    def _param_lr(self, p: Parameter) -> float:
+        base = self.get_lr()
+        attr = getattr(p, "optimize_attr", None)
+        if attr:
+            return base * attr.get("learning_rate", 1.0)
+        return base
+
+    # -- state ------------------------------------------------------------
+    def _acc(self, p: Parameter, name: str, init=None) -> jax.Array:
+        slot = self._accumulators.setdefault(p.name, {})
+        if name not in slot:
+            slot[name] = init if init is not None else \
+                jnp.zeros_like(p._data)
+        return slot[name]
+
+    def _set_acc(self, p: Parameter, name: str, value):
+        self._accumulators[p.name][name] = value
+
+    def state_dict(self) -> Dict:
+        state = {"_step_count": self._step_count}
+        for pname, slots in self._accumulators.items():
+            for sname, arr in slots.items():
+                state[f"{pname}.{sname}"] = Tensor(arr)
+        if isinstance(self._learning_rate, LRScheduler):
+            state["LR_Scheduler"] = self._learning_rate.state_dict()
+        return state
+
+    def set_state_dict(self, state_dict: Dict):
+        self._step_count = int(state_dict.get("_step_count", 0))
+        if "LR_Scheduler" in state_dict and isinstance(
+                self._learning_rate, LRScheduler):
+            self._learning_rate.set_state_dict(state_dict["LR_Scheduler"])
+        for key, val in state_dict.items():
+            if key in ("_step_count", "LR_Scheduler"):
+                continue
+            pname, _, sname = key.rpartition(".")
+            arr = val._data if isinstance(val, Tensor) else jnp.asarray(val)
+            self._accumulators.setdefault(pname, {})[sname] = arr
+        return self
+
+    # -- step -------------------------------------------------------------
+    def _collect_params_grads(self) -> List[Tuple[Parameter, Tensor]]:
+        pg = []
+        for p in self._parameter_list:
+            if p.stop_gradient:
+                continue
+            pg.append((p, p.grad))
+        return pg
+
+    @no_grad()
+    def step(self):
+        params_grads = [(p, g) for p, g in self._collect_params_grads()
+                        if g is not None]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        self._step_count += 1
+        for p, g in params_grads:
+            garr = g._data if isinstance(g, Tensor) else g
+            if self._weight_decay and not isinstance(self, _DecoupledWD):
+                wd = float(self._weight_decay)
+                garr = garr + wd * p._data.astype(garr.dtype)
+            new_data = self._update_param(p, garr)
+            p._data = new_data.astype(p._data.dtype)
+            p.grad_node = None
+
+    def _update_param(self, p: Parameter, g: jax.Array) -> jax.Array:
+        raise NotImplementedError
+
+    def clear_grad(self, set_to_zero: bool = False):
+        for p in self._parameter_list:
+            p.clear_gradient(set_to_zero)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+
+class _DecoupledWD:
+    """Marker: weight decay applied inside the rule (AdamW-style)."""
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _sgd_update(p, g, lr):
+    return p - lr * g.astype(p.dtype)
+
+
+class SGD(Optimizer):
+    def __init__(self, learning_rate=0.001, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+
+    def _update_param(self, p, g):
+        return _sgd_update(p._data, g, self._param_lr(p))
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 2),
+                   static_argnames=("use_nesterov",))
+def _momentum_update(p, g, vel, lr, mu, use_nesterov):
+    g = g.astype(p.dtype)
+    vel_new = mu * vel + g
+    if use_nesterov:
+        update = g + mu * vel_new
+    else:
+        update = vel_new
+    return p - lr * update, vel_new
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _update_param(self, p, g):
+        vel = self._acc(p, "velocity")
+        new_p, new_vel = _momentum_update(p._data, g, vel,
+                                          self._param_lr(p), self._momentum,
+                                          self._use_nesterov)
+        self._set_acc(p, "velocity", new_vel)
+        return new_p

@@ -1,0 +1,6 @@
+"""Static-graph compat shims. The framework has no legacy Program IR —
+jit.to_static covers graph capture; InputSpec re-exported here for API
+compat (reference: python/paddle/static/)."""
+from ..jit.static_function import InputSpec  # noqa: F401
+
+__all__ = ["InputSpec"]

@@ -1,0 +1,2 @@
+"""paddle.tensor namespace alias."""
+from . import ops as tensor

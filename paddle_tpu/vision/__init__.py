@@ -1,0 +1,5 @@
+"""Vision package (reference: python/paddle/vision/, 15.8k LoC)."""
+from . import models  # noqa: F401
+from . import transforms  # noqa: F401
+from . import datasets  # noqa: F401
+from . import ops  # noqa: F401

@@ -1,0 +1,171 @@
+"""Vision datasets (reference: python/paddle/vision/datasets/).
+No-network environment: file-based datasets + a synthetic FakeData for
+benchmarks/tests (the reference downloads from URLs)."""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["MNIST", "FashionMNIST", "Cifar10", "Cifar100", "DatasetFolder",
+           "ImageFolder", "FakeData"]
+
+
+class FakeData(Dataset):
+    """Synthetic image classification data (deterministic per index)."""
+
+    def __init__(self, size=1000, image_shape=(3, 224, 224), num_classes=10,
+                 transform=None, dtype="float32"):
+        self.size = size
+        self.image_shape = tuple(image_shape)
+        self.num_classes = num_classes
+        self.transform = transform
+        self.dtype = dtype
+
+    def __getitem__(self, idx):
+        rng = np.random.RandomState(idx)
+        img = rng.standard_normal(self.image_shape).astype(self.dtype)
+        label = np.int64(rng.randint(self.num_classes))
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, label
+
+    def __len__(self):
+        return self.size
+
+
+class MNIST(Dataset):
+    """Reads IDX-format files from ``root`` (no downloading)."""
+
+    def __init__(self, root=None, mode="train", transform=None,
+                 image_path=None, label_path=None, download=False,
+                 backend=None):
+        self.transform = transform
+        prefix = "train" if mode == "train" else "t10k"
+        root = root or os.path.expanduser("~/.cache/paddle_tpu/mnist")
+        image_path = image_path or os.path.join(
+            root, f"{prefix}-images-idx3-ubyte.gz")
+        label_path = label_path or os.path.join(
+            root, f"{prefix}-labels-idx1-ubyte.gz")
+        if not os.path.exists(image_path):
+            raise FileNotFoundError(
+                f"MNIST file {image_path} not found; this build has no "
+                "network access — place IDX files there or use FakeData")
+        self.images = self._read_images(image_path)
+        self.labels = self._read_labels(label_path)
+
+    @staticmethod
+    def _open(path):
+        return gzip.open(path, "rb") if path.endswith(".gz") else \
+            open(path, "rb")
+
+    def _read_images(self, path):
+        with self._open(path) as f:
+            _, n, rows, cols = struct.unpack(">IIII", f.read(16))
+            data = np.frombuffer(f.read(), np.uint8)
+        return data.reshape(n, rows, cols)
+
+    def _read_labels(self, path):
+        with self._open(path) as f:
+            _, n = struct.unpack(">II", f.read(8))
+            data = np.frombuffer(f.read(), np.uint8)
+        return data.astype(np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class FashionMNIST(MNIST):
+    pass
+
+
+class Cifar10(Dataset):
+    """Reads the python-pickle CIFAR tarball layout from ``data_file``."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=False, backend=None):
+        import pickle
+        import tarfile
+        self.transform = transform
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                "Cifar10 requires a local cifar-10-python.tar.gz "
+                "(no network access); or use FakeData")
+        names = [f"data_batch_{i}" for i in range(1, 6)] \
+            if mode == "train" else ["test_batch"]
+        xs, ys = [], []
+        with tarfile.open(data_file) as tar:
+            for m in tar.getmembers():
+                base = os.path.basename(m.name)
+                if base in names:
+                    d = pickle.load(tar.extractfile(m), encoding="bytes")
+                    xs.append(d[b"data"])
+                    ys.extend(d[b"labels"])
+        self.images = np.concatenate(xs).reshape(-1, 3, 32, 32)
+        self.labels = np.asarray(ys, np.int64)
+
+    def __getitem__(self, idx):
+        img = self.images[idx]
+        if self.transform is not None:
+            img = self.transform(img.transpose(1, 2, 0))
+        return img, self.labels[idx]
+
+    def __len__(self):
+        return len(self.labels)
+
+
+class Cifar100(Cifar10):
+    pass
+
+
+class DatasetFolder(Dataset):
+    """class-per-subfolder image dataset; requires a loader callable
+    (no PIL dependency in this environment)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        extensions = extensions or (".npy",)
+        self.root = root
+        self.transform = transform
+        self.loader = loader or (lambda p: np.load(p))
+        classes = sorted(d for d in os.listdir(root)
+                         if os.path.isdir(os.path.join(root, d)))
+        self.class_to_idx = {c: i for i, c in enumerate(classes)}
+        self.classes = classes
+        self.samples = []
+        for c in classes:
+            cdir = os.path.join(root, c)
+            for fname in sorted(os.listdir(cdir)):
+                if fname.lower().endswith(extensions):
+                    self.samples.append((os.path.join(cdir, fname),
+                                         self.class_to_idx[c]))
+
+    def __getitem__(self, idx):
+        path, target = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return img, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(DatasetFolder):
+    def __getitem__(self, idx):
+        path, _ = self.samples[idx]
+        img = self.loader(path)
+        if self.transform is not None:
+            img = self.transform(img)
+        return (img,)

@@ -1,0 +1,3 @@
+"""Vision models (reference: python/paddle/vision/models/)."""
+from .resnet import *  # noqa: F401,F403
+from .small import *  # noqa: F401,F403

@@ -1,0 +1,105 @@
+"""Vision ops (reference: python/paddle/vision/ops.py — roi_align, nms,
+deform_conv, yolo ops). Subset: the pieces needed by detection inference."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor, apply_op, _unwrap
+
+__all__ = ["nms", "box_coder", "roi_align", "yolo_box"]
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Host-side NMS (data-dependent sizes; eager-only like the
+    reference's masked_select-class ops)."""
+    b = np.asarray(_unwrap(boxes), np.float32)
+    s = np.asarray(_unwrap(scores), np.float32) if scores is not None \
+        else np.ones(len(b), np.float32)
+    order = np.argsort(-s)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(int(i))
+        if order.size == 1:
+            break
+        rest = order[1:]
+        xx1 = np.maximum(b[i, 0], b[rest, 0])
+        yy1 = np.maximum(b[i, 1], b[rest, 1])
+        xx2 = np.minimum(b[i, 2], b[rest, 2])
+        yy2 = np.minimum(b[i, 3], b[rest, 3])
+        w = np.maximum(0.0, xx2 - xx1)
+        h = np.maximum(0.0, yy2 - yy1)
+        inter = w * h
+        area_i = (b[i, 2] - b[i, 0]) * (b[i, 3] - b[i, 1])
+        area_r = (b[rest, 2] - b[rest, 0]) * (b[rest, 3] - b[rest, 1])
+        iou = inter / (area_i + area_r - inter + 1e-10)
+        order = rest[iou <= iou_threshold]
+    if top_k is not None:
+        keep = keep[:top_k]
+    return Tensor(np.asarray(keep, np.int64))
+
+
+def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
+              box_normalized=True, axis=0):
+    raise NotImplementedError("box_coder lands with the detection suite")
+
+
+def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True, name=None):
+    """RoIAlign via bilinear sampling grid (XLA-friendly gather form)."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(feat, rois):
+        n_rois = rois.shape[0]
+        c, h, w = feat.shape[1], feat.shape[2], feat.shape[3]
+        offset = 0.5 if aligned else 0.0
+        x1 = rois[:, 0] * spatial_scale - offset
+        y1 = rois[:, 1] * spatial_scale - offset
+        x2 = rois[:, 2] * spatial_scale - offset
+        y2 = rois[:, 3] * spatial_scale - offset
+        rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+        rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+        ys = y1[:, None] + (jnp.arange(oh) + 0.5)[None, :] * (rh[:, None] / oh)
+        xs = x1[:, None] + (jnp.arange(ow) + 0.5)[None, :] * (rw[:, None] / ow)
+
+        def bilinear(img, yy, xx):
+            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
+            y1_ = jnp.clip(y0 + 1, 0, h - 1)
+            x1_ = jnp.clip(x0 + 1, 0, w - 1)
+            wy = yy - y0
+            wx = xx - x0
+            g = lambda yi, xi: img[:, yi, :][:, :, xi]
+            v = (g(y0, x0) * (1 - wy)[None] * (1 - wx)[None] +
+                 g(y1_, x0) * wy[None] * (1 - wx)[None])
+            # separable: gather rows then cols
+            return v
+        # simple per-roi loop via vmap (single image batch assumption)
+        def sample_roi(yy, xx):
+            # yy [oh], xx [ow] -> [c, oh, ow]
+            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
+            y1_ = jnp.clip(y0 + 1, 0, h - 1)
+            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
+            x1_ = jnp.clip(x0 + 1, 0, w - 1)
+            wy = (yy - y0)[None, :, None]
+            wx = (xx - x0)[None, None, :]
+            img = feat[0]
+            p00 = img[:, y0][:, :, x0]
+            p01 = img[:, y0][:, :, x1_]
+            p10 = img[:, y1_][:, :, x0]
+            p11 = img[:, y1_][:, :, x1_]
+            return (p00 * (1 - wy) * (1 - wx) + p01 * (1 - wy) * wx +
+                    p10 * wy * (1 - wx) + p11 * wy * wx)
+        return jax.vmap(sample_roi)(ys, xs)
+    return apply_op(f, x, boxes, _op_name="roi_align")
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
+             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
+             iou_aware_factor=0.5):
+    raise NotImplementedError("yolo_box lands with the detection suite")

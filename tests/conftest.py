@@ -1,0 +1,20 @@
+"""Test config: force CPU backend with 8 virtual devices so sharding /
+distributed tests run without TPU hardware (SURVEY.md §4 takeaway #5 —
+fake-device testing of collective plumbing; the reference uses
+multi-process-on-one-host, we use XLA's virtual host devices).
+
+Note: the environment's TPU plugin force-sets jax_platforms="axon,cpu" at
+interpreter startup, so the env var alone is not enough — we must also
+update the jax config before any backend is initialized.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = \
+        flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,138 @@
+"""AMP, DataLoader, save/load, Model.fit tests."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.amp as amp
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.io import (BatchSampler, DataLoader, DistributedBatchSampler,
+                           TensorDataset)
+from paddle_tpu.metric import Accuracy
+from paddle_tpu.optimizer import Adam, SGD
+
+
+def test_autocast_o1_white_black():
+    a = paddle.randn([4, 4])
+    b = paddle.randn([4, 4])
+    with amp.auto_cast(level="O1", dtype="bfloat16"):
+        c = paddle.matmul(a, b)
+        assert c.dtype == paddle.bfloat16
+        s = F.softmax(c)  # black list -> fp32
+        assert s.dtype == paddle.float32
+    c2 = paddle.matmul(a, b)
+    assert c2.dtype == paddle.float32
+
+
+def test_autocast_grads_flow():
+    lin = nn.Linear(4, 4)
+    x = paddle.randn([2, 4])
+    with amp.auto_cast(level="O1"):
+        loss = lin(x).sum()
+    loss.backward()
+    assert lin.weight.grad is not None
+    assert lin.weight.grad.numpy().dtype == np.float32
+
+
+def test_decorate_o2_keeps_norm_fp32():
+    net = nn.Sequential(nn.Linear(4, 8), nn.LayerNorm(8), nn.Linear(8, 2))
+    amp.decorate(net, level="O2", dtype="bfloat16")
+    assert net[0].weight.dtype == paddle.bfloat16
+    assert net[1].weight.dtype == paddle.float32
+
+
+def test_grad_scaler_protocol():
+    net = nn.Linear(2, 2)
+    opt = SGD(learning_rate=0.1, parameters=net.parameters())
+    scaler = amp.GradScaler(init_loss_scaling=2.0)
+    loss = net(paddle.ones([1, 2])).sum()
+    scaled = scaler.scale(loss)
+    assert float(scaled) == pytest.approx(2 * float(loss))
+    scaled.backward()
+    w_before = net.weight.numpy().copy()
+    scaler.step(opt)
+    scaler.update()
+    opt.clear_grad()
+    assert not np.allclose(net.weight.numpy(), w_before)
+
+
+def test_grad_scaler_skips_on_inf():
+    p = paddle.Parameter(np.ones(2, np.float32))
+    opt = SGD(learning_rate=0.1, parameters=[p])
+    scaler = amp.GradScaler(init_loss_scaling=4.0)
+    p.grad = paddle.to_tensor(np.array([np.inf, 1.0], np.float32))
+    scaler.step(opt)
+    scaler.update()
+    np.testing.assert_allclose(p.numpy(), [1.0, 1.0])  # step skipped
+    assert scaler.get_loss_scaling() == pytest.approx(2.0)
+
+
+def test_dataloader_batching_shuffle_drop():
+    X = paddle.to_tensor(np.arange(10, dtype="float32").reshape(10, 1))
+    Y = paddle.to_tensor(np.arange(10))
+    ds = TensorDataset([X, Y])
+    dl = DataLoader(ds, batch_size=3, drop_last=True)
+    batches = list(dl)
+    assert len(batches) == 3
+    assert batches[0][0].shape == [3, 1]
+    dl2 = DataLoader(ds, batch_size=3, drop_last=False)
+    assert len(list(dl2)) == 4
+    seen = sorted(int(v) for b in dl2 for v in b[1].numpy())
+    assert seen == list(range(10))
+
+
+def test_dataloader_workers_threaded():
+    X = paddle.to_tensor(np.arange(32, dtype="float32").reshape(32, 1))
+    ds = TensorDataset([X])
+    dl = DataLoader(ds, batch_size=4, num_workers=2)
+    total = sorted(int(v) for (b,) in dl for v in b.numpy())
+    assert total == list(range(32))
+
+
+def test_distributed_batch_sampler_shards():
+    ds = TensorDataset([paddle.to_tensor(np.arange(20).reshape(20, 1))])
+    s0 = DistributedBatchSampler(ds, batch_size=5, num_replicas=2, rank=0)
+    s1 = DistributedBatchSampler(ds, batch_size=5, num_replicas=2, rank=1)
+    idx0 = [i for b in s0 for i in b]
+    idx1 = [i for b in s1 for i in b]
+    assert len(idx0) == len(idx1) == 10
+    assert not set(idx0) & set(idx1)
+
+
+def test_save_load_roundtrip(tmp_path):
+    net = nn.Sequential(nn.Linear(4, 4), nn.LayerNorm(4))
+    opt = Adam(parameters=net.parameters())
+    net(paddle.randn([2, 4])).sum().backward()
+    opt.step()
+    p = str(tmp_path / "model.pdparams")
+    paddle.save(net.state_dict(), p)
+    paddle.save(opt.state_dict(), str(tmp_path / "opt.pdopt"))
+    state = paddle.load(p)
+    net2 = nn.Sequential(nn.Linear(4, 4), nn.LayerNorm(4))
+    net2.set_state_dict(state)
+    np.testing.assert_allclose(net2[0].weight.numpy(),
+                               net[0].weight.numpy())
+    opt_state = paddle.load(str(tmp_path / "opt.pdopt"))
+    opt2 = Adam(parameters=net2.parameters())
+    opt2.set_state_dict(opt_state)
+    assert opt2._step_count == 1
+
+
+def test_model_fit_evaluate_predict(tmp_path):
+    paddle.seed(3)
+    X = paddle.randn([48, 8])
+    Y = paddle.argmax(X[:, :3], axis=1)
+    ds = TensorDataset([X, Y])
+    model = paddle.Model(nn.Sequential(nn.Linear(8, 32), nn.ReLU(),
+                                       nn.Linear(32, 3)))
+    model.prepare(Adam(parameters=model.parameters(), learning_rate=0.02),
+                  nn.CrossEntropyLoss(), Accuracy())
+    model.fit(ds, batch_size=16, epochs=4, verbose=0)
+    res = model.evaluate(ds, batch_size=16)
+    assert res["acc"] > 0.7
+    preds = model.predict(ds, batch_size=16, stack_outputs=True)
+    assert preds[0].shape == (48, 3)
+    model.save(str(tmp_path / "ckpt"))
+    model.load(str(tmp_path / "ckpt"))

@@ -1,0 +1,213 @@
+"""nn.Layer system + layer numerics (reference analog: test/legacy_test
+layer tests; torch-free numpy references)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_layer_registration_and_state_dict():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+            self.register_buffer("step", paddle.zeros([1]))
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    net = Net()
+    names = [n for n, _ in net.named_parameters()]
+    assert names == ["fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"]
+    sd = net.state_dict()
+    assert "step" in sd
+    net2 = Net()
+    net2.set_state_dict(sd)
+    np.testing.assert_allclose(net2.fc1.weight.numpy(),
+                               net.fc1.weight.numpy())
+
+
+def test_train_eval_propagates():
+    net = nn.Sequential(nn.Linear(2, 2), nn.Dropout(0.5))
+    net.eval()
+    assert not net[1].training
+    net.train()
+    assert net[1].training
+
+
+def test_dropout_modes():
+    x = paddle.ones([1000])
+    net = nn.Dropout(0.5)
+    net.eval()
+    np.testing.assert_allclose(net(x).numpy(), x.numpy())
+    net.train()
+    out = net(x).numpy()
+    # upscale_in_train keeps expectation ~1
+    assert 0.8 < out.mean() < 1.2
+    assert (out == 0).sum() > 300
+
+
+def test_linear_numeric():
+    lin = nn.Linear(3, 2)
+    x = paddle.randn([5, 3])
+    expected = x.numpy() @ lin.weight.numpy() + lin.bias.numpy()
+    np.testing.assert_allclose(lin(x).numpy(), expected, atol=1e-5)
+
+
+def test_conv2d_against_numpy():
+    conv = nn.Conv2D(1, 1, 3, padding=0, bias_attr=False)
+    w = conv.weight.numpy()[0, 0]
+    x = np.random.RandomState(0).randn(1, 1, 5, 5).astype("float32")
+    out = conv(paddle.to_tensor(x)).numpy()[0, 0]
+    ref = np.zeros((3, 3), np.float32)
+    for i in range(3):
+        for j in range(3):
+            ref[i, j] = (x[0, 0, i:i + 3, j:j + 3] * w).sum()
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+
+def test_batchnorm_train_and_eval():
+    bn = nn.BatchNorm1D(4, data_format="NCL")
+    x = paddle.randn([8, 4, 6]) * 3 + 1
+    bn.train()
+    y = bn(x)
+    m = y.numpy().mean(axis=(0, 2))
+    np.testing.assert_allclose(m, np.zeros(4), atol=1e-4)
+    assert not np.allclose(bn._mean.numpy(), np.zeros(4))
+    bn.eval()
+    y2 = bn(x)
+    assert y2.shape == x.shape
+
+
+def test_layernorm_normalizes():
+    ln = nn.LayerNorm(16)
+    x = paddle.randn([4, 16]) * 5 + 3
+    y = ln(x).numpy()
+    np.testing.assert_allclose(y.mean(-1), np.zeros(4), atol=1e-4)
+    np.testing.assert_allclose(y.std(-1), np.ones(4), atol=1e-2)
+
+
+def test_embedding_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    ids = paddle.to_tensor([[0, 1, 2]])
+    out = emb(ids)
+    np.testing.assert_allclose(out.numpy()[0, 0], np.zeros(4))
+
+
+def test_pooling():
+    x = paddle.to_tensor(np.arange(16, dtype="float32").reshape(1, 1, 4, 4))
+    mp = nn.MaxPool2D(2, 2)(x)
+    np.testing.assert_allclose(mp.numpy()[0, 0], [[5, 7], [13, 15]])
+    ap = nn.AvgPool2D(2, 2)(x)
+    np.testing.assert_allclose(ap.numpy()[0, 0], [[2.5, 4.5],
+                                                  [10.5, 12.5]])
+    aap = nn.AdaptiveAvgPool2D(1)(x)
+    assert float(aap.numpy()) == pytest.approx(7.5)
+
+
+def test_cross_entropy_matches_manual():
+    logits = paddle.randn([6, 5])
+    labels = paddle.to_tensor(np.array([0, 1, 2, 3, 4, 0]))
+    loss = F.cross_entropy(logits, labels)
+    lp = np.log(np.exp(logits.numpy()) /
+                np.exp(logits.numpy()).sum(-1, keepdims=True))
+    ref = -lp[np.arange(6), labels.numpy()].mean()
+    assert float(loss) == pytest.approx(ref, abs=1e-5)
+
+
+def test_cross_entropy_ignore_index_and_soft():
+    logits = paddle.randn([4, 3])
+    labels = paddle.to_tensor(np.array([0, 1, -100, 2]))
+    loss = F.cross_entropy(logits, labels, ignore_index=-100)
+    lp = np.log(np.exp(logits.numpy()) /
+                np.exp(logits.numpy()).sum(-1, keepdims=True))
+    ref = -(lp[0, 0] + lp[1, 1] + lp[3, 2]) / 3
+    assert float(loss) == pytest.approx(ref, abs=1e-5)
+    soft = paddle.to_tensor(np.full((4, 3), 1 / 3, np.float32))
+    l2 = F.cross_entropy(logits, soft, soft_label=True)
+    assert np.isfinite(float(l2))
+
+
+def test_attention_causal_mask():
+    q = paddle.randn([2, 8, 2, 16])
+    out = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+    assert out.shape == [2, 8, 2, 16]
+    # first position attends only to itself -> equals v[:, 0]
+    np.testing.assert_allclose(out.numpy()[:, 0], q.numpy()[:, 0],
+                               atol=1e-5)
+
+
+def test_mha_cache_incremental_decode():
+    mha = nn.MultiHeadAttention(16, 4)
+    mha.eval()
+    x = paddle.randn([1, 4, 16])
+    full = mha(x, x, x, attn_mask=None)
+    cache = mha.gen_cache(x[:, :0, :])
+    outs = []
+    for t in range(4):
+        step = x[:, t:t + 1, :]
+        o, cache = mha(step, step, step, None, cache)
+        outs.append(o.numpy())
+    causal = nn.Transformer.generate_square_subsequent_mask(4)
+    ref = mha(x, x, x, causal).numpy()
+    np.testing.assert_allclose(np.concatenate(outs, 1), ref, atol=1e-4)
+
+
+def test_rnn_shapes_and_grad():
+    lstm = nn.LSTM(4, 8, num_layers=1)
+    x = paddle.randn([2, 5, 4])
+    x.stop_gradient = False
+    y, (h, c) = lstm(x)
+    assert y.shape == [2, 5, 8]
+    assert h.shape == [1, 2, 8]
+    y.sum().backward()
+    assert x.grad is not None
+    assert lstm.rnns[0].cell.weight_ih.grad is not None
+
+
+def test_sequential_and_containers():
+    seq = nn.Sequential(nn.Linear(2, 3), nn.ReLU())
+    assert len(seq) == 2
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    ll.append(nn.Linear(2, 2))
+    assert len(ll) == 4
+    assert len(list(ll.parameters())) == 8
+    pl = nn.ParameterList([paddle.Parameter(np.zeros((2, 2), "float32"))])
+    assert len(pl) == 1
+    ld = nn.LayerDict({"a": nn.Linear(2, 2)})
+    assert "a" in ld
+
+
+def test_forward_hooks():
+    lin = nn.Linear(2, 2)
+    calls = []
+    h = lin.register_forward_post_hook(
+        lambda layer, inp, out: calls.append(1))
+    lin(paddle.zeros([1, 2]))
+    assert calls
+    h.remove()
+    lin(paddle.zeros([1, 2]))
+    assert len(calls) == 1
+
+
+def test_grad_clip_global_norm():
+    p = paddle.Parameter(np.ones((2, 2), "float32"))
+    g = paddle.to_tensor(np.full((2, 2), 10.0, "float32"))
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    [(_, g2)] = clip([(p, g)])
+    assert np.linalg.norm(g2.numpy()) == pytest.approx(1.0, rel=1e-4)
+
+
+def test_functional_misc():
+    x = paddle.randn([2, 6, 4, 4])
+    assert F.pixel_shuffle(x, 2).shape == [2, 1, 8, 8]  # 6/(2*2) floor->1
+    x2 = paddle.randn([2, 8, 4, 4])
+    assert F.pixel_shuffle(x2, 2).shape == [2, 2, 8, 8]
+    assert F.glu(paddle.randn([3, 8])).shape == [3, 4]
+    oh = F.one_hot(paddle.to_tensor([1, 2]), 4)
+    np.testing.assert_allclose(oh.numpy().sum(-1), [1, 1])
+    assert F.interpolate(paddle.randn([1, 1, 4, 4]),
+                         scale_factor=2).shape == [1, 1, 8, 8]

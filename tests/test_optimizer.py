@@ -1,0 +1,185 @@
+"""Optimizer + LR scheduler tests (reference analog:
+test/legacy_test/test_adamw_op.py etc. — update-rule numerics vs numpy)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+from paddle_tpu.optimizer import (SGD, Adam, AdamW, Momentum, RMSProp,
+                                  Adagrad, Adadelta, Lamb)
+from paddle_tpu.optimizer.lr import (CosineAnnealingDecay, LinearWarmup,
+                                     MultiStepDecay, NoamDecay,
+                                     PiecewiseDecay, PolynomialDecay,
+                                     ReduceOnPlateau, StepDecay)
+
+
+def _param(val):
+    return paddle.Parameter(np.asarray(val, np.float32))
+
+
+def _set_grad(p, g):
+    p.grad = paddle.to_tensor(np.asarray(g, np.float32))
+
+
+def test_sgd_rule():
+    p = _param([1.0, 2.0])
+    opt = SGD(learning_rate=0.1, parameters=[p])
+    _set_grad(p, [1.0, 1.0])
+    opt.step()
+    np.testing.assert_allclose(p.numpy(), [0.9, 1.9], atol=1e-6)
+
+
+def test_momentum_rule():
+    p = _param([1.0])
+    opt = Momentum(learning_rate=0.1, momentum=0.9, parameters=[p])
+    v = 0.0
+    x = 1.0
+    for _ in range(3):
+        _set_grad(p, [1.0])
+        opt.step()
+        v = 0.9 * v + 1.0
+        x = x - 0.1 * v
+    np.testing.assert_allclose(p.numpy(), [x], atol=1e-6)
+
+
+def test_adam_rule_matches_numpy():
+    p = _param([1.0, -1.0])
+    opt = Adam(learning_rate=0.1, parameters=[p])
+    m = np.zeros(2)
+    v = np.zeros(2)
+    x = np.array([1.0, -1.0])
+    for t in range(1, 4):
+        g = x * 2
+        _set_grad(p, g)
+        opt.step()
+        m = 0.9 * m + 0.1 * g
+        v = 0.999 * v + 0.001 * g * g
+        mh = m / (1 - 0.9 ** t)
+        vh = v / (1 - 0.999 ** t)
+        x = x - 0.1 * mh / (np.sqrt(vh) + 1e-8)
+    np.testing.assert_allclose(p.numpy(), x, atol=1e-5)
+
+
+def test_adamw_decoupled_decay():
+    p = _param([1.0])
+    opt = AdamW(learning_rate=0.1, parameters=[p], weight_decay=0.1)
+    _set_grad(p, [0.0])
+    opt.step()
+    # pure decay step: p *= (1 - lr*wd); adam update ~0
+    np.testing.assert_allclose(p.numpy(), [1.0 * (1 - 0.01)], atol=1e-6)
+
+
+def test_clear_grad_and_skip_stopgrad():
+    p = _param([1.0])
+    frozen = _param([5.0])
+    frozen.stop_gradient = True
+    opt = SGD(learning_rate=1.0, parameters=[p, frozen])
+    _set_grad(p, [1.0])
+    opt.step()
+    opt.clear_grad()
+    assert p.grad is None
+    np.testing.assert_allclose(frozen.numpy(), [5.0])
+
+
+@pytest.mark.parametrize("cls,kwargs", [
+    (RMSProp, {"learning_rate": 0.01}),
+    (Adagrad, {"learning_rate": 0.01}),
+    (Adadelta, {"learning_rate": 1.0}),
+    (Lamb, {"learning_rate": 0.01}),
+])
+def test_optimizers_reduce_loss(cls, kwargs):
+    paddle.seed(7)
+    net = nn.Linear(4, 1)
+    opt = cls(parameters=net.parameters(), **kwargs)
+    x = paddle.randn([16, 4])
+    y = x.sum(axis=1, keepdim=True)
+    first = None
+    for _ in range(20):
+        loss = F.mse_loss(net(x), y)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first
+
+
+def test_multi_precision_master_weights():
+    p = paddle.Parameter(np.ones(4, np.float32))
+    p._data = p._data.astype("bfloat16")
+    opt = AdamW(learning_rate=1e-3, parameters=[p])
+    _set_grad(p, np.full(4, 1e-3))
+    p.grad._data = p.grad._data.astype("bfloat16")
+    opt.step()
+    assert "master_weight" in opt._accumulators[p.name]
+    assert opt._accumulators[p.name]["master_weight"].dtype == np.float32
+
+
+def test_lr_schedulers():
+    s = StepDecay(0.1, step_size=2, gamma=0.5)
+    lrs = []
+    for _ in range(5):
+        lrs.append(s())
+        s.step()
+    np.testing.assert_allclose(lrs, [0.1, 0.1, 0.05, 0.05, 0.025])
+
+    pw = PiecewiseDecay([2, 4], [1.0, 0.5, 0.25])
+    vals = []
+    for _ in range(5):
+        vals.append(pw())
+        pw.step()
+    np.testing.assert_allclose(vals, [1, 1, 0.5, 0.5, 0.25])
+
+    cos = CosineAnnealingDecay(1.0, T_max=10)
+    assert cos() == pytest.approx(1.0)
+    for _ in range(10):
+        cos.step()
+    assert cos() == pytest.approx(0.0, abs=1e-6)
+
+    warm = LinearWarmup(CosineAnnealingDecay(1.0, 10), 5, 0.0, 1.0)
+    assert warm() == pytest.approx(0.0)
+    for _ in range(5):
+        warm.step()
+    assert warm() == pytest.approx(1.0, abs=1e-6)
+
+    noam = NoamDecay(512, 4000)
+    assert noam() > 0
+
+    poly = PolynomialDecay(0.1, 10, end_lr=0.0)
+    for _ in range(10):
+        poly.step()
+    assert poly() == pytest.approx(0.0, abs=1e-6)
+
+
+def test_reduce_on_plateau():
+    s = ReduceOnPlateau(1.0, patience=1, factor=0.5)
+    s.step(1.0)
+    s.step(1.0)
+    s.step(1.0)
+    assert s() == pytest.approx(0.5)
+
+
+def test_optimizer_state_roundtrip():
+    net = nn.Linear(3, 3)
+    opt = Adam(parameters=net.parameters(), learning_rate=0.01)
+    loss = net(paddle.randn([2, 3])).sum()
+    loss.backward()
+    opt.step()
+    state = opt.state_dict()
+    opt2 = Adam(parameters=net.parameters(), learning_rate=0.01)
+    opt2.set_state_dict(state)
+    assert opt2._step_count == opt._step_count
+    k = net.weight.name
+    np.testing.assert_allclose(
+        np.asarray(opt2._accumulators[k]["moment1"]),
+        np.asarray(opt._accumulators[k]["moment1"]))
+
+
+def test_scheduler_with_optimizer():
+    net = nn.Linear(2, 2)
+    sched = StepDecay(1.0, step_size=1, gamma=0.1)
+    opt = SGD(learning_rate=sched, parameters=net.parameters())
+    assert opt.get_lr() == 1.0
+    sched.step()
+    assert opt.get_lr() == pytest.approx(0.1)

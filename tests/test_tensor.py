@@ -1,0 +1,115 @@
+"""Tensor façade basics (reference analog: test/legacy_test tensor tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_creation_dtypes():
+    t = paddle.to_tensor([1.0, 2.0, 3.0])
+    assert t.dtype == paddle.float32
+    assert t.shape == [3]
+    i = paddle.to_tensor([1, 2, 3])
+    assert i.dtype.is_integer
+    z = paddle.zeros([2, 3], dtype="bfloat16")
+    assert z.dtype == paddle.bfloat16
+
+
+def test_arithmetic_broadcast():
+    a = paddle.to_tensor(np.arange(6).reshape(2, 3).astype("float32"))
+    b = paddle.to_tensor([10.0, 20.0, 30.0])
+    c = a + b
+    np.testing.assert_allclose(c.numpy(), a.numpy() + b.numpy())
+    d = a * 2 - 1
+    np.testing.assert_allclose(d.numpy(), a.numpy() * 2 - 1)
+    assert float((a @ b.reshape([3, 1])).sum()) == pytest.approx(
+        float((a.numpy() @ b.numpy().reshape(3, 1)).sum()))
+
+
+def test_indexing():
+    a = paddle.to_tensor(np.arange(24).reshape(2, 3, 4).astype("float32"))
+    np.testing.assert_allclose(a[1, 2].numpy(), np.arange(24).reshape(
+        2, 3, 4)[1, 2])
+    np.testing.assert_allclose(a[:, 1:3, ::2].numpy(),
+                               a.numpy()[:, 1:3, ::2])
+    mask_idx = paddle.to_tensor([0, 1])
+    np.testing.assert_allclose(a[mask_idx].numpy(), a.numpy()[[0, 1]])
+
+
+def test_setitem():
+    a = paddle.zeros([3, 3])
+    a[1] = 5.0
+    assert a.numpy()[1].tolist() == [5, 5, 5]
+    a[0, 2] = paddle.to_tensor(7.0)
+    assert float(a[0, 2]) == 7.0
+
+
+def test_inplace_ops():
+    a = paddle.ones([3])
+    a.add_(2.0)
+    np.testing.assert_allclose(a.numpy(), [3, 3, 3])
+    a.scale_(2.0)
+    np.testing.assert_allclose(a.numpy(), [6, 6, 6])
+
+
+def test_cast_and_item():
+    a = paddle.to_tensor([1.7])
+    assert a.astype("int32").numpy()[0] == 1
+    assert a.item() == pytest.approx(1.7)
+    assert len(paddle.zeros([4, 2])) == 4
+
+
+def test_manipulation_roundtrips():
+    a = paddle.to_tensor(np.arange(12).reshape(3, 4).astype("float32"))
+    assert a.reshape([2, 6]).shape == [2, 6]
+    assert a.transpose([1, 0]).shape == [4, 3]
+    assert paddle.concat([a, a], axis=0).shape == [6, 4]
+    assert paddle.stack([a, a]).shape == [2, 3, 4]
+    parts = paddle.split(a, 2, axis=1)
+    assert [p.shape for p in parts] == [[3, 2], [3, 2]]
+    parts = paddle.split(a, [1, -1], axis=1)
+    assert [p.shape for p in parts] == [[3, 1], [3, 3]]
+    assert paddle.flip(a, axis=0).numpy()[0, 0] == 8
+    assert a.unsqueeze(0).shape == [1, 3, 4]
+    assert a.unsqueeze(0).squeeze(0).shape == [3, 4]
+
+
+def test_reduction_math():
+    a = paddle.to_tensor(np.arange(6).reshape(2, 3).astype("float32"))
+    assert float(a.sum()) == 15
+    assert a.sum(axis=0).shape == [3]
+    assert a.mean(axis=1, keepdim=True).shape == [2, 1]
+    assert int(a.argmax()) == 5
+    vals, idx = paddle.topk(a, 2, axis=1)
+    np.testing.assert_allclose(vals.numpy(), [[2, 1], [5, 4]])
+    assert bool(paddle.allclose(a, a))
+
+
+def test_where_gather_scatter():
+    a = paddle.to_tensor(np.arange(10).astype("float32"))
+    out = paddle.where(a > 5, a, paddle.zeros_like(a))
+    assert float(out.sum()) == 6 + 7 + 8 + 9
+    g = paddle.gather(a, paddle.to_tensor([1, 3]))
+    np.testing.assert_allclose(g.numpy(), [1, 3])
+    s = paddle.scatter(a, paddle.to_tensor([0, 1]),
+                       paddle.to_tensor([100.0, 200.0]))
+    assert float(s[0]) == 100
+
+
+def test_einsum_and_linalg():
+    a = paddle.randn([3, 4])
+    b = paddle.randn([4, 5])
+    np.testing.assert_allclose(
+        paddle.einsum("ij,jk->ik", a, b).numpy(),
+        a.numpy() @ b.numpy(), atol=1e-5)
+    m = paddle.eye(3) * 2.0
+    np.testing.assert_allclose(paddle.det(m).numpy(), 8.0, rtol=1e-5)
+
+
+def test_matmul_transpose_flags():
+    a = paddle.randn([3, 4])
+    b = paddle.randn([3, 5])
+    out = paddle.matmul(a, b, transpose_x=True)
+    assert out.shape == [4, 5]
+    np.testing.assert_allclose(out.numpy(), a.numpy().T @ b.numpy(),
+                               atol=1e-5)
