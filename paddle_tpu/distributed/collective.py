@@ -1,0 +1,300 @@
+"""Communication API (reference: python/paddle/distributed/communication/ —
+all_reduce/all_gather/all_to_all/broadcast/reduce/reduce_scatter/scatter/
+send/recv/barrier + Group, group.py:29).
+
+TPU-native dual dispatch replacing the ProcessGroupNCCL object graph
+(/root/reference/paddle/fluid/distributed/collective/process_group_nccl.h:37):
+
+- under a ``shard_map`` trace (tensor is a jax Tracer and the group's mesh
+  axis is live) the call lowers to the XLA collective (lax.psum /
+  all_gather / all_to_all / ppermute) riding ICI;
+- in eager single-controller mode a Group denotes a mesh axis, and the
+  "collective" is a resharding of the global array (GSPMD view) — e.g.
+  eager all_reduce of a Partial array = all-replica sum.
+
+There are no streams, no ncclUniqueId bootstrap, no comm-task watchdog:
+XLA orders collectives with compute, and jax.distributed (see env.py)
+replaces the TCPStore rendezvous (store/tcp_store.h:121).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor, apply_op
+from .process_mesh import ProcessMesh, get_mesh
+
+__all__ = ["Group", "new_group", "get_group", "all_reduce", "all_gather",
+           "all_gather_object", "all_to_all", "all_to_all_single",
+           "broadcast", "reduce", "reduce_scatter", "scatter", "send",
+           "recv", "isend", "irecv", "barrier", "wait", "ReduceOp",
+           "stream"]
+
+
+class ReduceOp:
+    SUM = "sum"
+    MAX = "max"
+    MIN = "min"
+    PROD = "prod"
+    AVG = "avg"
+
+
+class Group:
+    """A communicator = a named mesh axis (not an NCCL comm).
+
+    ``axis_name`` selects which mesh dimension the collective spans; None
+    means "all devices" (flattened mesh).
+    """
+
+    _counter = [0]
+
+    def __init__(self, ranks: Optional[List[int]] = None,
+                 axis_name: Optional[str] = None,
+                 mesh: Optional[ProcessMesh] = None, gid: Optional[int] = None):
+        self.ranks = ranks or []
+        self.axis_name = axis_name
+        self.mesh = mesh
+        if gid is None:
+            Group._counter[0] += 1
+            gid = Group._counter[0]
+        self.id = gid
+
+    @property
+    def nranks(self) -> int:
+        if self.mesh is not None and self.axis_name is not None:
+            return self.mesh.get_dim_size(self.axis_name)
+        if self.ranks:
+            return len(self.ranks)
+        return jax.device_count()
+
+    @property
+    def world_size(self) -> int:
+        return self.nranks
+
+    def get_group_rank(self, rank):
+        return self.ranks.index(rank) if self.ranks else rank
+
+    @property
+    def rank(self) -> int:
+        from .env import get_rank
+        return self.get_group_rank(get_rank()) if self.ranks else get_rank()
+
+    def __repr__(self):
+        return f"Group(id={self.id}, axis={self.axis_name}, " \
+               f"nranks={self.nranks})"
+
+
+_default_group: Optional[Group] = None
+_groups = {}
+
+
+def _get_default_group() -> Group:
+    global _default_group
+    if _default_group is None:
+        _default_group = Group(ranks=list(range(jax.device_count())), gid=0)
+        _groups[0] = _default_group
+    return _default_group
+
+
+def new_group(ranks: Optional[List[int]] = None, backend: Optional[str] = None,
+              timeout=None, axis_name: Optional[str] = None) -> Group:
+    g = Group(ranks=ranks, axis_name=axis_name, mesh=get_mesh())
+    _groups[g.id] = g
+    return g
+
+
+def get_group(gid: int = 0) -> Group:
+    if gid == 0:
+        return _get_default_group()
+    return _groups[gid]
+
+
+def _axis(group: Optional[Group]):
+    if group is not None and group.axis_name is not None:
+        return group.axis_name
+    return None
+
+
+def _in_spmd_trace(x) -> bool:
+    return isinstance(x._data if isinstance(x, Tensor) else x,
+                      jax.core.Tracer)
+
+
+def _reduce_fn(op):
+    return {ReduceOp.SUM: jax.lax.psum, ReduceOp.MAX: jax.lax.pmax,
+            ReduceOp.MIN: jax.lax.pmin,
+            ReduceOp.AVG: jax.lax.pmean}[op]
+
+
+def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
+               sync_op: bool = True):
+    """In-place all-reduce (paddle contract: mutates ``tensor``)."""
+    axis = _axis(group)
+    if _in_spmd_trace(tensor) and axis is not None:
+        fn = _reduce_fn(op)
+        out = apply_op(lambda a: fn(a, axis), tensor, _op_name="all_reduce")
+        tensor._inplace(out)
+        return tensor
+    # eager single-controller: every "rank" already sees the global value
+    return tensor
+
+
+def all_gather(tensor_list: List[Tensor], tensor: Tensor,
+               group: Optional[Group] = None, sync_op: bool = True):
+    axis = _axis(group)
+    if _in_spmd_trace(tensor) and axis is not None:
+        n = (group.nranks if group else jax.device_count())
+        out = apply_op(
+            lambda a: jax.lax.all_gather(a, axis, axis=0, tiled=False),
+            tensor, _op_name="all_gather")
+        for i in range(n):
+            tensor_list.append(out[i])
+        return tensor_list
+    n = group.nranks if group is not None else 1
+    for _ in range(max(n, 1)):
+        tensor_list.append(Tensor(tensor._data,
+                                  stop_gradient=tensor.stop_gradient))
+    return tensor_list
+
+
+def all_gather_object(object_list: list, obj, group: Optional[Group] = None):
+    n = group.nranks if group is not None else 1
+    object_list.extend(obj for _ in range(max(n, 1)))
+    return object_list
+
+
+def all_to_all(out_tensor_list: List[Tensor], in_tensor_list: List[Tensor],
+               group: Optional[Group] = None, sync_op: bool = True):
+    axis = _axis(group)
+    if in_tensor_list and _in_spmd_trace(in_tensor_list[0]) and axis:
+        stacked = apply_op(lambda *xs: jnp.stack(xs), *in_tensor_list,
+                           _op_name="a2a_stack")
+        out = apply_op(
+            lambda a: jax.lax.all_to_all(a, axis, split_axis=0,
+                                         concat_axis=0, tiled=True),
+            stacked, _op_name="all_to_all")
+        n = len(in_tensor_list)
+        for i in range(n):
+            out_tensor_list.append(out[i])
+        return out_tensor_list
+    out_tensor_list.extend(in_tensor_list)
+    return out_tensor_list
+
+
+def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
+                      in_split_sizes=None, group: Optional[Group] = None,
+                      sync_op: bool = True):
+    axis = _axis(group)
+    if _in_spmd_trace(in_tensor) and axis:
+        out = apply_op(
+            lambda a: jax.lax.all_to_all(a, axis, split_axis=0,
+                                         concat_axis=0, tiled=True),
+            in_tensor, _op_name="all_to_all_single")
+        out_tensor._inplace(out)
+        return out_tensor
+    out_tensor.set_value(in_tensor._data)
+    return out_tensor
+
+
+def broadcast(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
+              sync_op: bool = True):
+    return tensor  # single-controller: value already global
+
+
+def reduce(tensor: Tensor, dst: int = 0, op=ReduceOp.SUM,
+           group: Optional[Group] = None, sync_op: bool = True):
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def reduce_scatter(tensor: Tensor, tensor_list: List[Tensor],
+                   op=ReduceOp.SUM, group: Optional[Group] = None,
+                   sync_op: bool = True):
+    axis = _axis(group)
+    if tensor_list and _in_spmd_trace(tensor_list[0]) and axis:
+        stacked = apply_op(lambda *xs: jnp.stack(xs), *tensor_list,
+                           _op_name="rs_stack")
+        out = apply_op(
+            lambda a: jax.lax.psum_scatter(a, axis, scatter_dimension=0,
+                                           tiled=False),
+            stacked, _op_name="reduce_scatter")
+        tensor._inplace(out)
+        return tensor
+    tensor.set_value(tensor_list[0]._data if tensor_list else tensor._data)
+    return tensor
+
+
+def scatter(tensor: Tensor, tensor_list: Optional[List[Tensor]] = None,
+            src: int = 0, group: Optional[Group] = None, sync_op: bool = True):
+    if tensor_list:
+        tensor.set_value(tensor_list[0]._data)
+    return tensor
+
+
+def send(tensor: Tensor, dst: int = 0, group: Optional[Group] = None,
+         sync_op: bool = True):
+    """Point-to-point send. Inside shard_map: ppermute to dst along the
+    group axis (used by the pipeline runtime — see fleet.pipeline)."""
+    axis = _axis(group)
+    if _in_spmd_trace(tensor) and axis:
+        n = group.nranks
+
+        def f(a):
+            perm = [(i, (i + (dst or 1)) % n) for i in range(n)]
+            return jax.lax.ppermute(a, axis, perm)
+        return apply_op(f, tensor, _op_name="send")
+    return tensor
+
+
+def recv(tensor: Tensor, src: int = 0, group: Optional[Group] = None,
+         sync_op: bool = True):
+    return tensor
+
+
+def isend(tensor, dst=0, group=None):
+    return _Work(send(tensor, dst, group))
+
+
+def irecv(tensor, src=0, group=None):
+    return _Work(recv(tensor, src, group))
+
+
+class _Work:
+    def __init__(self, result=None):
+        self.result = result
+
+    def wait(self, timeout=None):
+        return True
+
+    def is_completed(self):
+        return True
+
+
+def barrier(group: Optional[Group] = None):
+    """Device sync (the reference issues a 1-element allreduce)."""
+    (jnp.zeros(()) + 0).block_until_ready()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    if isinstance(tensor, Tensor) and not _in_spmd_trace(tensor):
+        tensor._data.block_until_ready()
+
+
+class _StreamNS:
+    """paddle.distributed.communication.stream compat: the stream variants
+    are the same ops (XLA has no user streams)."""
+    all_reduce = staticmethod(all_reduce)
+    all_gather = staticmethod(all_gather)
+    all_to_all = staticmethod(all_to_all)
+    all_to_all_single = staticmethod(all_to_all_single)
+    broadcast = staticmethod(broadcast)
+    reduce = staticmethod(reduce)
+    reduce_scatter = staticmethod(reduce_scatter)
+    scatter = staticmethod(scatter)
+    send = staticmethod(send)
+    recv = staticmethod(recv)
+
+
+stream = _StreamNS()

@@ -1,0 +1,124 @@
+"""Placement types: Shard / Replicate / Partial.
+
+Reference: /root/reference/paddle/phi/core/distributed/auto_parallel/
+placement_types.h + python surface dist.Shard/Replicate/Partial.
+TPU-native: placements compile down to a jax PartitionSpec; Partial is
+carried as metadata (GSPMD materializes partial sums itself — the
+reference needs 13 explicit reshard functions, here reshard =
+device_put / with_sharding_constraint with a new spec).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from jax.sharding import NamedSharding, PartitionSpec
+
+from .process_mesh import ProcessMesh
+
+
+class Placement:
+    def is_shard(self, dim=None):
+        return False
+
+    def is_replicated(self):
+        return False
+
+    def is_partial(self):
+        return False
+
+
+class Shard(Placement):
+    def __init__(self, dim: int):
+        self.dim = int(dim)
+
+    def is_shard(self, dim=None):
+        return True if dim is None else dim == self.dim
+
+    def get_dim(self):
+        return self.dim
+
+    def __repr__(self):
+        return f"Shard(dim={self.dim})"
+
+    def __eq__(self, o):
+        return isinstance(o, Shard) and o.dim == self.dim
+
+    def __hash__(self):
+        return hash(("shard", self.dim))
+
+
+class Replicate(Placement):
+    def is_replicated(self):
+        return True
+
+    def __repr__(self):
+        return "Replicate()"
+
+    def __eq__(self, o):
+        return isinstance(o, Replicate)
+
+    def __hash__(self):
+        return hash("replicate")
+
+
+class Partial(Placement):
+    def __init__(self, reduce_type: str = "sum"):
+        self.reduce_type = reduce_type
+
+    def is_partial(self):
+        return True
+
+    def __repr__(self):
+        return f"Partial({self.reduce_type})"
+
+    def __eq__(self, o):
+        return isinstance(o, Partial) and o.reduce_type == self.reduce_type
+
+    def __hash__(self):
+        return hash(("partial", self.reduce_type))
+
+
+def placements_to_spec(mesh: ProcessMesh,
+                       placements: Sequence[Placement]) -> PartitionSpec:
+    """placements (one per MESH dim, paddle convention) -> PartitionSpec
+    (one entry per TENSOR dim, jax convention)."""
+    if len(placements) != mesh.ndim:
+        raise ValueError(
+            f"expected {mesh.ndim} placements (one per mesh dim), got "
+            f"{len(placements)}")
+    dim_to_axes = {}
+    for mesh_dim, p in enumerate(placements):
+        if isinstance(p, Shard):
+            dim_to_axes.setdefault(p.dim, []).append(
+                mesh.dim_names[mesh_dim])
+    if not dim_to_axes:
+        return PartitionSpec()
+    max_dim = max(dim_to_axes)
+    entries = []
+    for d in range(max_dim + 1):
+        axes = dim_to_axes.get(d)
+        if not axes:
+            entries.append(None)
+        elif len(axes) == 1:
+            entries.append(axes[0])
+        else:
+            entries.append(tuple(axes))
+    return PartitionSpec(*entries)
+
+
+def spec_to_placements(mesh: ProcessMesh, spec: PartitionSpec,
+                       ndim: int) -> List[Placement]:
+    placements: List[Placement] = [Replicate() for _ in range(mesh.ndim)]
+    for tensor_dim, entry in enumerate(tuple(spec)):
+        if entry is None:
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        for ax in axes:
+            placements[mesh.dim_names.index(ax)] = Shard(tensor_dim)
+    return placements
+
+
+def named_sharding(mesh: ProcessMesh,
+                   placements: Sequence[Placement]) -> NamedSharding:
+    return NamedSharding(mesh.jax_mesh(), placements_to_spec(mesh,
+                                                             placements))

@@ -152,16 +152,18 @@ def apply_op(fn: Callable, *inputs, _op_name: Optional[str] = None, **kwargs):
 
     # AMP O1 hook (python/paddle/amp — cast per white/black lists); the
     # import is deferred and the common no-AMP path is one attr check.
+    # The cast happens INSIDE the differentiated function so jax.vjp chains
+    # grads through it back to the params' own dtype (fp32 master grads).
     from ..amp.auto_cast import amp_state, maybe_autocast_inputs
-    if amp_state() is not None:
-        arrs = maybe_autocast_inputs(name, arrs)
+    amp_active = amp_state() is not None
 
     tensor_pos = [i for i, x in enumerate(inputs) if isinstance(x, Tensor)]
     tracked = grad_enabled() and any(
         not inputs[i].stop_gradient for i in tensor_pos)
 
     if not tracked:
-        out = fn(*arrs, **kwargs)
+        eff = maybe_autocast_inputs(name, arrs) if amp_active else arrs
+        out = fn(*eff, **kwargs)
         res = _wrap_outputs(out, None, name)
         if flag_value("FLAGS_check_nan_inf"):
             _check_finite(name, [t._data for t in _flatten_tensors(res)])
@@ -171,6 +173,8 @@ def apply_op(fn: Callable, *inputs, _op_name: Optional[str] = None, **kwargs):
         full = list(arrs)
         for i, a in zip(tensor_pos, t_arrs):
             full[i] = a
+        if amp_active:
+            full = maybe_autocast_inputs(name, full)
         return fn(*full, **kwargs)
 
     out, vjp_fn = jax.vjp(pure, *(arrs[i] for i in tensor_pos))
@@ -273,8 +277,11 @@ def run_backward(tensors: Sequence["Tensor"],
             slot = node_grads.pop(id(node), None)
             if slot is None:
                 continue
+            # cast cotangents to the recorded output dtype — AMP O1 mixes
+            # bf16/f32 across white/black-listed op boundaries
             cots = [
-                g if g is not None else jnp.zeros(shape, dt)
+                (g.astype(dt) if g.dtype != dt else g)
+                if g is not None else jnp.zeros(shape, dt)
                 for g, (shape, dt) in zip(slot, node.out_meta)
             ]
             if node.vjp_fn is None:
@@ -485,7 +492,10 @@ class Tensor:
         if tuple(arr.shape) != self._shape():
             raise ValueError(
                 f"set_value shape mismatch {arr.shape} vs {self._shape()}")
-        self._data = arr.astype(self._data.dtype)
+        # copy: the source may be another tensor's buffer, and buffers can
+        # be donated later (jitted optimizer updates) — aliasing would let
+        # a donation delete the source's storage out from under it
+        self._data = jnp.array(arr, dtype=self._data.dtype, copy=True)
         self.grad_node = None
         self._out_idx = 0
         return self

@@ -230,7 +230,8 @@ def _as_loader(data, batch_size, shuffle, drop_last, num_workers):
 def _split_batch(batch, has_labels=True):
     if isinstance(batch, (list, tuple)):
         items = list(batch)
-        if not has_labels or len(items) == 1:
+        if len(items) == 1:
             return items, []
-        return items[:-1], items[-1:]
+        # trailing element is the label slot; predict drops it
+        return items[:-1], (items[-1:] if has_labels else [])
     return [batch], []

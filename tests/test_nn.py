@@ -202,8 +202,6 @@ def test_grad_clip_global_norm():
 
 
 def test_functional_misc():
-    x = paddle.randn([2, 6, 4, 4])
-    assert F.pixel_shuffle(x, 2).shape == [2, 1, 8, 8]  # 6/(2*2) floor->1
     x2 = paddle.randn([2, 8, 4, 4])
     assert F.pixel_shuffle(x2, 2).shape == [2, 2, 8, 8]
     assert F.glu(paddle.randn([3, 8])).shape == [3, 4]
