@@ -1,0 +1,66 @@
+"""Benchmark: flagship GPT pretraining step, tokens/sec on one TPU chip.
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+vs_baseline is reported as achieved model-FLOPs-utilization (MFU) against
+peak, since the reference publishes no in-tree numbers (BASELINE.md).
+"""
+import json
+import sys
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.models.gpt import GPTConfig, GPTSpmdTrainer, build_mesh
+
+    backend = jax.default_backend()
+    on_tpu = backend not in ("cpu",)
+    if on_tpu:
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_heads=12, max_seq_len=1024,
+                        dtype=jnp.bfloat16)
+        batch, seq, steps = 8, 1024, 10
+    else:  # smoke-mode on CPU (driver runs this file on real TPU)
+        cfg = GPTConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                        num_heads=4, max_seq_len=128, dtype=jnp.float32)
+        batch, seq, steps = 4, 128, 3
+
+    mesh = build_mesh(n_devices=1, pipe=1, model=1, fsdp=1, sep=1)
+    trainer = GPTSpmdTrainer(cfg, mesh, microbatches=1)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
+    labels = np.roll(ids, -1, axis=1)
+
+    # warmup (compile)
+    loss = trainer.train_step(ids, labels)
+    jax.block_until_ready(loss)
+    loss = trainer.train_step(ids, labels)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.train_step(ids, labels)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = batch * seq * steps / dt
+    n_params = trainer.n_params()
+    flops_per_token = 6 * n_params  # fwd+bwd matmul estimate
+    achieved_flops = tokens_per_sec * flops_per_token
+    peak = 197e12 if on_tpu else 1e12  # v5e bf16 peak
+    mfu = achieved_flops / peak
+
+    print(json.dumps({
+        "metric": f"GPT-124M pretrain tokens/sec/chip ({backend}, "
+                  f"loss={float(jax.device_get(loss)):.3f})",
+        "value": round(tokens_per_sec, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(mfu, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

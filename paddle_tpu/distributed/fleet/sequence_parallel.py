@@ -1,0 +1,112 @@
+"""Sequence parallelism utilities.
+
+Reference: python/paddle/distributed/fleet/utils/sequence_parallel_utils.py
+— ScatterOp/GatherOp/AllGatherOp/ReduceScatterOp PyLayers (:85-137),
+ColumnSequenceParallelLinear (:429), RowSequenceParallelLinear.
+
+TPU-native: sequence sharding is an activation PartitionSpec — the seq dim
+carries the model axis between TP regions; entering a TP matmul the
+constraint flips to hidden-dim sharding and GSPMD emits exactly the
+all-gather (fwd) / reduce-scatter (bwd) pair the reference hand-codes.
+"""
+from __future__ import annotations
+
+from ...nn import functional as F
+from ...nn import initializer as I
+from ...nn.layer_base import Layer
+from ..api import reshard
+from ..placements import Replicate, Shard
+from ..process_mesh import get_mesh
+from .mp_layers import ColumnParallelLinear, RowParallelLinear, _mesh_axis
+
+__all__ = ["ScatterOp", "GatherOp", "AllGatherOp", "ReduceScatterOp",
+           "ColumnSequenceParallelLinear", "RowSequenceParallelLinear",
+           "mark_as_sequence_parallel_parameter",
+           "register_sequence_parallel_allreduce_hooks"]
+
+
+def _seq_placements(mesh, axis, seq_dim):
+    placements = [Replicate() for _ in range(mesh.ndim)]
+    placements[mesh.dim_names.index(axis)] = Shard(seq_dim)
+    return placements
+
+
+class ScatterOp:
+    """Split activations along seq dim across the model axis (fwd);
+    backward = gather — expressed as one resharding."""
+
+    @staticmethod
+    def apply(x, axis=0):
+        mesh, ax, world = _mesh_axis()
+        if mesh is None:
+            return x
+        return reshard(x, mesh, _seq_placements(mesh, ax, axis))
+
+
+class GatherOp:
+    @staticmethod
+    def apply(x, axis=0):
+        mesh, ax, world = _mesh_axis()
+        if mesh is None:
+            return x
+        return reshard(x, mesh, [Replicate()] * mesh.ndim)
+
+
+AllGatherOp = GatherOp
+
+
+class ReduceScatterOp:
+    @staticmethod
+    def apply(x, axis=0):
+        mesh, ax, world = _mesh_axis()
+        if mesh is None:
+            return x
+        return reshard(x, mesh, _seq_placements(mesh, ax, axis))
+
+
+class ColumnSequenceParallelLinear(ColumnParallelLinear):
+    """Input arrives seq-sharded; GSPMD all-gathers it into the column
+    matmul (reference :429 does the explicit AllGatherOp)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=None, gather_output=False, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__(in_features, out_features, weight_attr, has_bias,
+                         gather_output, fuse_matmul_bias, mp_group, name)
+
+    def forward(self, x):
+        if self.mesh is not None:
+            x = reshard(x, self.mesh, [Replicate()] * self.mesh.ndim)
+        return super().forward(x)
+
+
+class RowSequenceParallelLinear(RowParallelLinear):
+    """Output leaves seq-sharded (reference pairs the row matmul with
+    ReduceScatterOp instead of allreduce)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=True, fuse_matmul_bias=False,
+                 mp_group=None, name=None):
+        super().__init__(in_features, out_features, weight_attr, has_bias,
+                         input_is_parallel, fuse_matmul_bias, mp_group, name)
+
+    def forward(self, x):
+        y = super().forward(x)
+        if self.mesh is not None:
+            seq_dim = 0 if y.ndim == 2 else 1
+            y = reshard(y, self.mesh,
+                        _seq_placements(self.mesh, self.axis, seq_dim))
+        return y
+
+
+def mark_as_sequence_parallel_parameter(param):
+    param.sequence_parallel = True
+
+
+def register_sequence_parallel_allreduce_hooks(model, accumulation_steps=1,
+                                               fuse_allreduce=False):
+    """No-op TPU-natively: seq-parallel params (LayerNorm etc.) are
+    replicated arrays; their grads are reduced by GSPMD because the loss is
+    a global value (the reference needs explicit hooks —
+    sequence_parallel_utils.py:192 — because each rank owns only a slice)."""
+    return model

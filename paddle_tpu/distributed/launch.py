@@ -1,0 +1,87 @@
+"""Launcher (reference: python/paddle/distributed/launch/ — main.py:23
+``python -m paddle.distributed.launch``, collective controller spawning
+per-device workers with PADDLE_* env, HTTP/ETCD master rendezvous).
+
+TPU-native: one process per HOST (chips are driven through the mesh, not
+extra processes), so the launcher's job shrinks to: set coordination env,
+spawn/exec the training script per host, watch and propagate exit codes.
+``spawn`` keeps the paddle.distributed.spawn API for CPU/test multi-proc.
+"""
+from __future__ import annotations
+
+import os
+import runpy
+import subprocess
+import sys
+from argparse import ArgumentParser
+from typing import Callable, Optional
+
+__all__ = ["spawn", "launch_main", "ELASTIC_EXIT_CODE"]
+
+ELASTIC_EXIT_CODE = 101  # relaunch-me protocol (fleet/elastic/manager.py:33)
+
+
+def spawn(func: Callable, args=(), nprocs: int = 1, join: bool = True,
+          daemon: bool = False, **options):
+    """paddle.distributed.spawn analog (multiprocessing workers; used for
+    CPU-backend multi-process tests — on TPU the mesh replaces this)."""
+    import multiprocessing as mp
+    ctx = mp.get_context("spawn")
+    procs = []
+    for rank in range(nprocs):
+        env = {"PADDLE_TRAINER_ID": str(rank),
+               "PADDLE_TRAINERS_NUM": str(nprocs)}
+        p = ctx.Process(target=_worker, args=(func, args, env),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+    if join:
+        for p in procs:
+            p.join()
+        for p in procs:
+            if p.exitcode:
+                raise RuntimeError(
+                    f"spawned worker failed with exit code {p.exitcode}")
+    return procs
+
+
+def _worker(func, args, env):
+    os.environ.update(env)
+    func(*args)
+
+
+def launch_main(argv=None):
+    """``python -m paddle_tpu.distributed.launch [--nnodes N] script.py``"""
+    parser = ArgumentParser("paddle_tpu.distributed.launch")
+    parser.add_argument("--nnodes", type=int, default=1)
+    parser.add_argument("--node_rank", type=int, default=0)
+    parser.add_argument("--master", type=str, default="127.0.0.1:49174")
+    parser.add_argument("--devices", type=str, default=None,
+                        help="accepted for compat; TPU chips come from "
+                             "the runtime, not this flag")
+    parser.add_argument("--log_dir", type=str, default=None)
+    parser.add_argument("--max_restarts", type=int, default=0)
+    parser.add_argument("script", type=str)
+    parser.add_argument("script_args", nargs="...")
+    args = parser.parse_args(argv)
+
+    env = dict(os.environ)
+    env["PADDLE_TRAINER_ID"] = str(args.node_rank)
+    env["PADDLE_TRAINERS_NUM"] = str(args.nnodes)
+    env["JAX_COORDINATOR_ADDRESS"] = args.master
+    env["JAX_NUM_PROCESSES"] = str(args.nnodes)
+    env["JAX_PROCESS_ID"] = str(args.node_rank)
+
+    restarts = 0
+    while True:
+        proc = subprocess.run([sys.executable, args.script] +
+                              list(args.script_args), env=env)
+        if proc.returncode == ELASTIC_EXIT_CODE and \
+                restarts < args.max_restarts:
+            restarts += 1  # elastic relaunch protocol
+            continue
+        return proc.returncode
+
+
+if __name__ == "__main__":
+    sys.exit(launch_main())

@@ -1,0 +1,76 @@
+"""DataParallel (reference: python/paddle/distributed/parallel.py:219
+DataParallel + C++ EagerReducer bucketed allreduce, reducer.cc:752/:1086).
+
+TPU-native: there is no gradient bucketing/reducer — with the batch sharded
+over the ``data`` axis and the loss a global mean, grads ARE the
+all-reduced grads (GSPMD inserts one fused reduce per parameter, overlapped
+by the XLA scheduler). DataParallel therefore:
+- shards input batches over the data axis (scatter),
+- replicates parameters across it (sync_params_buffers analog at wrap),
+and otherwise passes through to the wrapped layer.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+
+from ..framework.tensor import Tensor, no_grad
+from ..nn.layer_base import Layer
+from .api import reshard, shard_tensor
+from .placements import Replicate, Shard
+from .process_mesh import ProcessMesh, auto_mesh, get_mesh, set_mesh
+
+__all__ = ["DataParallel"]
+
+
+class DataParallel(Layer):
+    def __init__(self, layers: Layer, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        mesh = get_mesh()
+        if mesh is None:
+            mesh = auto_mesh(["data"])
+            set_mesh(mesh)
+        self.mesh = mesh
+        self.axis = "data" if "data" in mesh.dim_names else \
+            mesh.dim_names[0]
+        # sync_params_buffers analog: replicate params over the data axis
+        with no_grad():
+            for _, p in layers.named_parameters():
+                if getattr(p, "_dist_mesh", None) is None:
+                    new = shard_tensor(p, mesh,
+                                       [Replicate()] * mesh.ndim)
+                    p._data = new._data
+
+    def _shard_batch(self, x):
+        if isinstance(x, Tensor) and x.ndim > 0 and \
+                x.shape[0] % self.mesh.get_dim_size(self.axis) == 0:
+            placements = [Replicate() for _ in range(self.mesh.ndim)]
+            placements[self.mesh.dim_names.index(self.axis)] = Shard(0)
+            return reshard(x, self.mesh, placements)
+        return x
+
+    def forward(self, *inputs, **kwargs):
+        inputs = tuple(self._shard_batch(x) for x in inputs)
+        return self._layers(*inputs, **kwargs)
+
+    # reference surface ----------------------------------------------------
+    def scale_loss(self, loss):
+        return loss
+
+    @no_grad()
+    def apply_collective_grads(self):
+        pass  # grads are already globally reduced (GSPMD)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+    @property
+    def parameters_(self):
+        return self._layers.parameters()

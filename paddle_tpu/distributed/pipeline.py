@@ -1,0 +1,103 @@
+"""SPMD pipeline engine: GPipe-style microbatch pipelining inside ONE
+jitted XLA program.
+
+Reference behavior: fleet/meta_parallel/pipeline_parallel.py:575 (1F1B
+schedule over NCCL isend/irecv, micro-batch meta exchange). TPU-native
+design (SURVEY.md §7 hard part #2 — "no NCCL p2p; implement schedules
+inside one jitted program with collective_permute + loop"):
+
+- per-stage parameters are STACKED on a leading stage dim and sharded over
+  the ``pipe`` mesh axis, so each stage-rank holds exactly its stage;
+- a ``lax.scan`` over M + S - 1 ticks runs every stage in parallel on its
+  in-flight microbatch and rotates activations with ``lax.ppermute``
+  (the ICI neighbor hop — this is what the torus is for);
+- reverse-mode AD through the scan+ppermute yields the backward pipeline
+  automatically (cotangents ppermute the opposite direction), so one
+  jax.grad gives a full forward/backward schedule. With
+  ``jax.remat`` on the stage fn this is activation-checkpointed GPipe;
+  bubble fraction (S-1)/(M+S-1) matches the reference's F-then-B.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+__all__ = ["pipeline_forward", "stack_stage_params"]
+
+
+def stack_stage_params(param_trees):
+    """Stack a list of per-stage parameter pytrees along a new leading
+    stage dim (host-side helper; shard the result over 'pipe')."""
+    return jax.tree.map(lambda *xs: jnp.stack(xs), *param_trees)
+
+
+def pipeline_forward(stage_fn: Callable, stacked_params: Any,
+                     x_micro: jax.Array, mesh: Mesh,
+                     axis: str = "pipe", remat: bool = True):
+    """Run ``stage_fn(params, x) -> y`` pipelined over the ``axis`` ranks.
+
+    Args:
+      stage_fn: one pipeline stage; same signature for every stage.
+      stacked_params: pytree, each leaf [S, ...], S = mesh.shape[axis].
+      x_micro: [M, mb, ...] microbatched input (M >= S for full util).
+    Returns [M, mb, ...] outputs of the last stage.
+    """
+    S = mesh.shape[axis]
+    M = x_micro.shape[0]
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
+
+    def per_rank(params, xs):
+        # params leaves arrive [1, ...] (local stage shard) -> squeeze
+        params = jax.tree.map(lambda a: a[0], params)
+        rank = jax.lax.axis_index(axis)
+        T = M + S - 1
+        mb_shape = xs.shape[1:]
+        state = jnp.zeros(mb_shape, xs.dtype)  # in-flight activation
+        out_buf = jnp.zeros((M,) + mb_shape, xs.dtype)
+
+        def tick(carry, t):
+            state, out_buf = carry
+            # stage 0 ingests microbatch t while t < M
+            feed = xs[jnp.minimum(t, M - 1)]
+            inp = jnp.where(rank == 0, feed, state)
+            y = stage_fn(params, inp)
+            # last stage commits finished microbatch t - (S-1)
+            done_idx = t - (S - 1)
+            commit = (rank == S - 1) & (done_idx >= 0)
+            out_buf = jax.lax.cond(
+                commit,
+                lambda b: jax.lax.dynamic_update_index_in_dim(
+                    b, y, jnp.maximum(done_idx, 0), 0),
+                lambda b: b, out_buf)
+            # rotate activations to the next stage (ring over ICI)
+            nxt = jax.lax.ppermute(
+                y, axis, [(i, (i + 1) % S) for i in range(S)])
+            return (nxt, out_buf), None
+
+        (state, out_buf), _ = jax.lax.scan(tick, (state, out_buf),
+                                           jnp.arange(T))
+        # share the last stage's outputs with every pipe rank (one
+        # broadcast; keeps the result replicated over 'pipe' for the head)
+        out = jax.lax.psum(
+            jnp.where(rank == S - 1, out_buf, jnp.zeros_like(out_buf)),
+            axis)
+        return out
+
+    in_specs = (
+        jax.tree.map(lambda _: P(axis), stacked_params),
+        P(*([None] * x_micro.ndim)),
+    )
+    out_specs = P(*([None] * x_micro.ndim))
+    # map over ONLY the pipe axis: the stage body remains a global-view
+    # GSPMD program over the other mesh axes (tp/dp/sep shardings inside
+    # stage_fn compose with the pipeline)
+    fn = shard_map(per_rank, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, axis_names={axis},
+                   check_vma=False)
+    return fn(stacked_params, x_micro)

@@ -1,0 +1,92 @@
+"""ZeRO-style group sharding (stage 1/2/3).
+
+Reference: python/paddle/distributed/sharding/group_sharded.py:50
+group_sharded_parallel dispatching to GroupShardedOptimizerStage2
+(fleet/meta_parallel/sharding/group_sharded_optimizer_stage2.py:53),
+GroupShardedStage2 (:46), GroupShardedStage3 (:85 — param sharding with
+on-demand gather PyLayers + reduce_scatter hooks).
+
+TPU-native: ZeRO == sharding annotations (SURVEY.md §7 hard part #3 —
+"express as fsdp-axis sharding rather than hooks"):
+- stage1/2: optimizer state (and grads, which under jit are transient XLA
+  values anyway) sharded over the axis — shard_optimizer does this;
+- stage3: parameters themselves sharded dim-0 over the axis; XLA
+  all-gathers at use and reduce-scatters grads, overlapping with compute
+  (the reference's forward-prefetch PyLayer :901 is XLA's latency-hiding
+  scheduler here).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..framework.tensor import Parameter, no_grad
+from ..nn.layer_base import Layer
+from .api import ShardingStage1, ShardingStage2, ShardingStage3, \
+    shard_optimizer
+from .process_mesh import ProcessMesh, get_mesh
+
+__all__ = ["group_sharded_parallel", "save_group_sharded_model",
+           "shard_model_stage3"]
+
+
+def _axis_of(mesh: ProcessMesh, preferred=("sharding", "fsdp", "data", "dp")):
+    for name in preferred:
+        if name in mesh.dim_names and mesh.get_dim_size(name) > 1:
+            return name
+    return mesh.dim_names[0]
+
+
+def shard_model_stage3(model: Layer, mesh: Optional[ProcessMesh] = None,
+                       axis_name: Optional[str] = None) -> Layer:
+    """Shard every parameter dim-0 over the sharding axis (ZeRO-3)."""
+    mesh = mesh or get_mesh()
+    if mesh is None:
+        return model
+    axis = axis_name or _axis_of(mesh)
+    n = mesh.get_dim_size(axis)
+    jmesh = mesh.jax_mesh()
+    with no_grad():
+        for _, p in model.named_parameters():
+            if p.ndim == 0 or p.shape[0] % n != 0:
+                sharding = NamedSharding(jmesh, PartitionSpec())
+            else:
+                sharding = NamedSharding(
+                    jmesh, PartitionSpec(axis, *([None] * (p.ndim - 1))))
+            p._data = jax.device_put(p._data, sharding)
+    return model
+
+
+def group_sharded_parallel(model: Layer, optimizer, level: str,
+                           scaler=None, group=None, offload=False,
+                           sync_buffers=False, buffer_max_size=2 ** 23,
+                           segment_size=2 ** 20, sync_comm=False,
+                           dp_group=None, exclude_layer=None):
+    """paddle.distributed.sharding.group_sharded_parallel analog.
+
+    level: 'os' (stage1) | 'os_g' (stage2) | 'p_g_os' (stage3).
+    """
+    mesh = get_mesh()
+    axis = _axis_of(mesh) if mesh is not None else "data"
+    if level == "os":
+        stage = ShardingStage1(axis, mesh)
+    elif level == "os_g":
+        stage = ShardingStage2(axis, mesh)
+    elif level == "p_g_os":
+        stage = ShardingStage3(axis, mesh)
+        shard_model_stage3(model, mesh, axis)
+    else:
+        raise ValueError(f"level must be os/os_g/p_g_os, got {level}")
+    optimizer = shard_optimizer(optimizer, stage)
+    return model, optimizer, scaler
+
+
+def save_group_sharded_model(model: Layer, output: str, optimizer=None):
+    """Gather-free save: state_dict arrays may be sharded; framework.io
+    converts via np.asarray which gathers replicas transparently."""
+    from ..framework.io import save
+    save(model.state_dict(), output + ".pdmodel")
+    if optimizer is not None:
+        save(optimizer.state_dict(), output + ".pdopt")

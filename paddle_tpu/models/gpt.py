@@ -1,0 +1,432 @@
+"""GPT model family — the flagship LLM stack.
+
+Two implementations, by design:
+
+1. ``GPTModel``/``GPTForCausalLM`` — imperative ``nn.Layer`` model built
+   from the fleet TP layer library (VocabParallelEmbedding /
+   Column/RowParallelLinear), the analog of the reference's fleet GPT
+   (test/auto_parallel/hybrid_strategy/semi_auto_llama.py is the shape of
+   this). Runs eagerly, under to_static, and under GSPMD meshes.
+
+2. ``GPTSpmdTrainer`` — the performance path: a single jitted training
+   step over a ('pipe','data','fsdp','sep','model') mesh composing
+   - tp:   head/ffn dims sharded over 'model' (Megatron partitioning),
+   - sp:   activation seq dim sharded over 'sep' (q local, k/v gathered),
+   - dp:   batch over 'data',
+   - fsdp: weight hidden-dim sharded over 'fsdp' (ZeRO-3; XLA gathers at
+           use and reduce-scatters grads),
+   - pp:   stage-stacked blocks pipelined via
+           distributed.pipeline.pipeline_forward (scan + ppermute),
+   with bf16 compute, fp32 master params/optimizer state, remat per block.
+   This is what the reference needs its entire fleet/meta_parallel +
+   pipeline-pass + sharding-pass machinery for (SURVEY.md §2.2 P2-P10);
+   here it is ~300 lines because the mesh does the orchestration.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..nn import functional as F
+from ..nn.layer_base import Layer
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.norm import LayerNorm
+from ..nn.layer.container import LayerList
+from ..framework.tensor import Tensor
+
+__all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "GPTSpmdTrainer",
+           "build_mesh"]
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    max_seq_len: int = 1024
+    ffn_mult: int = 4
+    dropout: float = 0.0
+    tie_embeddings: bool = True
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_heads
+
+    @property
+    def ffn_size(self):
+        return self.hidden_size * self.ffn_mult
+
+
+# ---------------------------------------------------------------------------
+# 1) imperative model (TP-aware via fleet layers when a mesh is set)
+# ---------------------------------------------------------------------------
+
+class GPTBlock(Layer):
+    def __init__(self, cfg: GPTConfig, use_tp: bool = False):
+        super().__init__()
+        self.cfg = cfg
+        self.ln1 = LayerNorm(cfg.hidden_size)
+        self.ln2 = LayerNorm(cfg.hidden_size)
+        if use_tp:
+            from ..distributed.fleet.mp_layers import (ColumnParallelLinear,
+                                                       RowParallelLinear)
+            self.qkv = ColumnParallelLinear(cfg.hidden_size,
+                                            3 * cfg.hidden_size,
+                                            gather_output=False)
+            self.proj = RowParallelLinear(cfg.hidden_size, cfg.hidden_size,
+                                          input_is_parallel=True)
+            self.fc1 = ColumnParallelLinear(cfg.hidden_size, cfg.ffn_size,
+                                            gather_output=False)
+            self.fc2 = RowParallelLinear(cfg.ffn_size, cfg.hidden_size,
+                                         input_is_parallel=True)
+        else:
+            self.qkv = Linear(cfg.hidden_size, 3 * cfg.hidden_size)
+            self.proj = Linear(cfg.hidden_size, cfg.hidden_size)
+            self.fc1 = Linear(cfg.hidden_size, cfg.ffn_size)
+            self.fc2 = Linear(cfg.ffn_size, cfg.hidden_size)
+        self.drop = Dropout(cfg.dropout)
+
+    def forward(self, x):
+        b, t, d = x.shape
+        h = self.ln1(x)
+        qkv = self.qkv(h)
+        n_local = qkv.shape[-1] // (3 * self.cfg.head_dim)
+        qkv = qkv.reshape([b, t, 3, n_local, self.cfg.head_dim])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True,
+                                              training=self.training)
+        attn = attn.reshape([b, t, n_local * self.cfg.head_dim])
+        x = x + self.drop(self.proj(attn))
+        h = self.ln2(x)
+        x = x + self.drop(self.fc2(F.gelu(self.fc1(h), approximate=True)))
+        return x
+
+
+class GPTModel(Layer):
+    def __init__(self, cfg: GPTConfig, use_tp: bool = False):
+        super().__init__()
+        self.cfg = cfg
+        if use_tp:
+            from ..distributed.fleet.mp_layers import VocabParallelEmbedding
+            self.wte = VocabParallelEmbedding(cfg.vocab_size,
+                                              cfg.hidden_size)
+        else:
+            self.wte = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.wpe = Embedding(cfg.max_seq_len, cfg.hidden_size)
+        self.blocks = LayerList([GPTBlock(cfg, use_tp)
+                                 for _ in range(cfg.num_layers)])
+        self.ln_f = LayerNorm(cfg.hidden_size)
+
+    def forward(self, input_ids):
+        b, t = input_ids.shape
+        from ..ops.creation import arange
+        pos = arange(t, dtype="int64").unsqueeze(0)
+        x = self.wte(input_ids) + self.wpe(pos)
+        for blk in self.blocks:
+            x = blk(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, cfg: GPTConfig, use_tp: bool = False):
+        super().__init__()
+        self.cfg = cfg
+        self.gpt = GPTModel(cfg, use_tp)
+        if not cfg.tie_embeddings:
+            self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size,
+                                  bias_attr=False)
+
+    def forward(self, input_ids):
+        h = self.gpt(input_ids)
+        if self.cfg.tie_embeddings:
+            from ..ops.linalg import matmul
+            return matmul(h, self.gpt.wte.weight, transpose_y=True)
+        return self.lm_head(h)
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids)
+        return F.cross_entropy(
+            logits.reshape([-1, self.cfg.vocab_size]),
+            labels.reshape([-1]))
+
+
+# ---------------------------------------------------------------------------
+# 2) SPMD trainer: one jitted step over the full hybrid mesh
+# ---------------------------------------------------------------------------
+
+AXES = ("pipe", "data", "fsdp", "sep", "model")
+
+
+def build_mesh(n_devices: Optional[int] = None,
+               pipe: int = 1, data: Optional[int] = None, fsdp: int = 1,
+               sep: int = 1, model: int = 1) -> Mesh:
+    """Mesh over the hybrid axes; 'data' absorbs the remainder."""
+    devices = jax.devices()
+    n = n_devices or len(devices)
+    fixed = pipe * fsdp * sep * model
+    if data is None:
+        if n % fixed != 0:
+            raise ValueError(f"{n} devices not divisible by {fixed}")
+        data = n // fixed
+    shape = (pipe, data, fsdp, sep, model)
+    return Mesh(np.asarray(devices[:int(np.prod(shape))]).reshape(shape),
+                AXES)
+
+
+def _spec(mesh: Mesh, *entries) -> NamedSharding:
+    return NamedSharding(mesh, P(*entries))
+
+
+class GPTSpmdTrainer:
+    """Functional GPT pretraining step, fully sharded.
+
+    Parameter shardings (fp32 masters; bf16 cast inside the step):
+      wte [V, D]          ('model', 'fsdp')  — vocab-parallel embedding
+      wpe [T, D]          (None, 'fsdp')
+      blocks (stacked [S, Lps, ...], S over 'pipe'):
+        wqkv [S,Lps,D,3D]  ('pipe', None, 'fsdp', 'model')
+        wproj [S,Lps,D,D]  ('pipe', None, 'model', 'fsdp')
+        win  [S,Lps,D,F]   ('pipe', None, 'fsdp', 'model')
+        wout [S,Lps,F,D]   ('pipe', None, 'model', 'fsdp')
+        ln scales/biases   ('pipe', None, None)
+      ln_f [D]            (None,)
+    Activations: (batch='data', seq='sep') with q-local/kv-gathered
+    attention (Megatron-SP over 'sep').
+    """
+
+    def __init__(self, cfg: GPTConfig, mesh: Mesh,
+                 microbatches: Optional[int] = None,
+                 learning_rate: float = 3e-4, weight_decay: float = 0.1,
+                 beta1: float = 0.9, beta2: float = 0.95,
+                 grad_clip: float = 1.0, seed: int = 0):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.S = mesh.shape["pipe"]
+        if cfg.num_layers % self.S:
+            raise ValueError("num_layers must divide pp degree")
+        self.Lps = cfg.num_layers // self.S
+        self.M = microbatches or max(2 * self.S, 1)
+        self.lr = learning_rate
+        self.wd = weight_decay
+        self.betas = (beta1, beta2)
+        self.grad_clip = grad_clip
+        self.params = self._init_params(jax.random.key(seed))
+        self.opt_state = {
+            "step": jnp.zeros((), jnp.int32),
+            "m": jax.tree.map(jnp.zeros_like, self.params),
+            "v": jax.tree.map(jnp.zeros_like, self.params),
+        }
+        self._step_fn = None
+
+    # -- init --------------------------------------------------------------
+    def _init_params(self, key) -> Dict[str, Any]:
+        cfg = self.cfg
+        D, V, T, Ff = (cfg.hidden_size, cfg.vocab_size, cfg.max_seq_len,
+                       cfg.ffn_size)
+        S, L = self.S, self.Lps
+        k = jax.random.split(key, 8)
+        std = 0.02
+        resid_std = std / math.sqrt(2 * cfg.num_layers)
+
+        def init(key, shape, scale, spec):
+            arr = scale * jax.random.normal(key, shape, jnp.float32)
+            return jax.device_put(arr, _spec(self.mesh, *spec))
+
+        def zeros(shape, spec):
+            return jax.device_put(jnp.zeros(shape, jnp.float32),
+                                  _spec(self.mesh, *spec))
+
+        def ones(shape, spec):
+            return jax.device_put(jnp.ones(shape, jnp.float32),
+                                  _spec(self.mesh, *spec))
+
+        params = {
+            "wte": init(k[0], (V, D), std, ("model", "fsdp")),
+            "wpe": init(k[1], (T, D), std, (None, "fsdp")),
+            "ln_f_g": ones((D,), (None,)),
+            "ln_f_b": zeros((D,), (None,)),
+            "blocks": {
+                "ln1_g": ones((S, L, D), ("pipe", None, None)),
+                "ln1_b": zeros((S, L, D), ("pipe", None, None)),
+                "ln2_g": ones((S, L, D), ("pipe", None, None)),
+                "ln2_b": zeros((S, L, D), ("pipe", None, None)),
+                "wqkv": init(k[2], (S, L, D, 3 * D), std,
+                             ("pipe", None, "fsdp", "model")),
+                "bqkv": zeros((S, L, 3 * D), ("pipe", None, "model")),
+                "wproj": init(k[3], (S, L, D, D), resid_std,
+                              ("pipe", None, "model", "fsdp")),
+                "bproj": zeros((S, L, D), ("pipe", None, None)),
+                "win": init(k[4], (S, L, D, Ff), std,
+                            ("pipe", None, "fsdp", "model")),
+                "bin": zeros((S, L, Ff), ("pipe", None, "model")),
+                "wout": init(k[5], (S, L, Ff, D), resid_std,
+                             ("pipe", None, "model", "fsdp")),
+                "bout": zeros((S, L, D), ("pipe", None, None)),
+            },
+        }
+        if not self.cfg.tie_embeddings:
+            params["head"] = init(k[6], (D, V), std, ("fsdp", "model"))
+        return params
+
+    # -- model -------------------------------------------------------------
+    def _block(self, x, bp):
+        """One transformer block on [mb, T, D] activations (GSPMD view)."""
+        cfg = self.cfg
+        mb, T, D = x.shape
+        H, dh = cfg.num_heads, cfg.head_dim
+        act = partial(jax.lax.with_sharding_constraint)
+
+        h = _layer_norm(x, bp["ln1_g"], bp["ln1_b"])
+        qkv = jnp.einsum("btd,df->btf", h, bp["wqkv"].astype(x.dtype),
+                         preferred_element_type=jnp.float32).astype(x.dtype)
+        qkv = qkv + bp["bqkv"].astype(x.dtype)
+        qkv = qkv.reshape(mb, T, 3, H, dh)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        # SP: q stays seq-sharded; k/v gathered over 'sep'
+        q = act(q, _spec(self.mesh, "data", "sep", "model", None))
+        k = act(k, _spec(self.mesh, "data", None, "model", None))
+        v = act(v, _spec(self.mesh, "data", None, "model", None))
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32)
+        logits = logits / math.sqrt(dh)
+        causal = jnp.tril(jnp.ones((T, T), bool))
+        logits = jnp.where(causal, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
+        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        attn = attn.reshape(mb, T, H * dh)
+        proj = jnp.einsum("btf,fd->btd", attn,
+                          bp["wproj"].astype(x.dtype),
+                          preferred_element_type=jnp.float32).astype(x.dtype)
+        x = x + proj + bp["bproj"].astype(x.dtype)
+        x = act(x, _spec(self.mesh, "data", "sep", None))
+
+        h = _layer_norm(x, bp["ln2_g"], bp["ln2_b"])
+        a = jnp.einsum("btd,df->btf", h, bp["win"].astype(x.dtype),
+                       preferred_element_type=jnp.float32).astype(x.dtype)
+        a = jax.nn.gelu(a + bp["bin"].astype(x.dtype), approximate=True)
+        o = jnp.einsum("btf,fd->btd", a, bp["wout"].astype(x.dtype),
+                       preferred_element_type=jnp.float32).astype(x.dtype)
+        x = x + o + bp["bout"].astype(x.dtype)
+        return act(x, _spec(self.mesh, "data", "sep", None))
+
+    def _stage_fn(self, stage_params, x):
+        """One pipeline stage = Lps blocks, scanned with remat."""
+        def body(x, bp):
+            return self._block(x, bp), None
+
+        leaves_lps = jax.tree.map(lambda a: a, stage_params)
+        x, _ = jax.lax.scan(
+            lambda carry, bp: (jax.checkpoint(self._block)(carry, bp),
+                               None),
+            x, leaves_lps)
+        return x
+
+    def _forward_loss(self, params, input_ids, labels):
+        cfg = self.cfg
+        B, T = input_ids.shape
+        dtype = cfg.dtype
+        pos = jnp.arange(T)
+        x = params["wte"].astype(dtype)[input_ids] + \
+            params["wpe"].astype(dtype)[pos][None]
+        x = jax.lax.with_sharding_constraint(
+            x, _spec(self.mesh, "data", "sep", None))
+
+        M = self.M
+        mb = B // M
+        x_micro = x.reshape(M, mb, T, cfg.hidden_size)
+        from ..distributed.pipeline import pipeline_forward
+        out = pipeline_forward(self._stage_fn, params["blocks"], x_micro,
+                               self.mesh, axis="pipe", remat=False)
+        x = out.reshape(B, T, cfg.hidden_size)
+        x = _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
+        head = params["wte"].T if cfg.tie_embeddings else params["head"]
+        logits = jnp.einsum("btd,dv->btv", x, head.astype(dtype),
+                            preferred_element_type=jnp.float32)
+        logits = jax.lax.with_sharding_constraint(
+            logits, _spec(self.mesh, "data", "sep", "model"))
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        ll = jnp.take_along_axis(lp, labels[..., None], axis=-1)[..., 0]
+        return -jnp.mean(ll)
+
+    # -- optimizer (fused AdamW, sharded like params) ----------------------
+    def _adamw(self, params, grads, opt_state):
+        b1, b2 = self.betas
+        step = opt_state["step"] + 1
+        tf = step.astype(jnp.float32)
+        # global-norm clip
+        gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                             for g in jax.tree.leaves(grads)))
+        scale = jnp.minimum(1.0, self.grad_clip / (gnorm + 1e-6))
+
+        def upd(p, g, m, v):
+            g = g.astype(jnp.float32) * scale
+            m2 = b1 * m + (1 - b1) * g
+            v2 = b2 * v + (1 - b2) * g * g
+            mhat = m2 / (1 - b1 ** tf)
+            vhat = v2 / (1 - b2 ** tf)
+            p2 = p * (1 - self.lr * self.wd) - \
+                self.lr * mhat / (jnp.sqrt(vhat) + 1e-8)
+            return p2, m2, v2
+
+        flat_p, tdef = jax.tree.flatten(params)
+        flat_g = jax.tree.leaves(grads)
+        flat_m = jax.tree.leaves(opt_state["m"])
+        flat_v = jax.tree.leaves(opt_state["v"])
+        new_p, new_m, new_v = [], [], []
+        for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v):
+            p2, m2, v2 = upd(p, g, m, v)
+            new_p.append(p2)
+            new_m.append(m2)
+            new_v.append(v2)
+        return (jax.tree.unflatten(tdef, new_p),
+                {"step": step, "m": jax.tree.unflatten(tdef, new_m),
+                 "v": jax.tree.unflatten(tdef, new_v)})
+
+    # -- public step -------------------------------------------------------
+    def build_step(self):
+        if self._step_fn is not None:
+            return self._step_fn
+
+        def step(params, opt_state, input_ids, labels):
+            loss, grads = jax.value_and_grad(self._forward_loss)(
+                params, input_ids, labels)
+            params, opt_state = self._adamw(params, grads, opt_state)
+            return params, opt_state, loss
+
+        data_spec = _spec(self.mesh, ("data",), None)
+        self._step_fn = jax.jit(
+            step, donate_argnums=(0, 1),
+            in_shardings=(None, None, data_spec, data_spec))
+        return self._step_fn
+
+    def train_step(self, input_ids, labels) -> float:
+        fn = self.build_step()
+        if isinstance(input_ids, Tensor):
+            input_ids = input_ids._data
+        if isinstance(labels, Tensor):
+            labels = labels._data
+        with jax.set_mesh(self.mesh):
+            self.params, self.opt_state, loss = fn(
+                self.params, self.opt_state, input_ids, labels)
+        return loss
+
+    def n_params(self) -> int:
+        return sum(int(np.prod(l.shape))
+                   for l in jax.tree.leaves(self.params))
+
+
+def _layer_norm(x, g, b, eps=1e-5):
+    xf = x.astype(jnp.float32)
+    m = jnp.mean(xf, axis=-1, keepdims=True)
+    v = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - m) * jax.lax.rsqrt(v + eps)
+    return (out * g + b).astype(x.dtype)
