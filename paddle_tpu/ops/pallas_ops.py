@@ -1,0 +1,345 @@
+"""Pallas TPU kernels: flash attention (fwd + bwd) with custom VJP.
+
+Replaces the reference's FlashAttention-2 CUDA integration
+(/root/reference/paddle/phi/kernels/gpu/flash_attn_kernel.cu via dynload of
+the external flashattn repo; cutlass memory_efficient_attention under
+kernels/fusion/cutlass/). TPU-native: blockwise online-softmax attention
+written in Pallas — q blocks stream against k/v blocks in VMEM with fp32
+accumulators on the MXU; backward follows the standard dq/dk/dv two-pass
+recomputation using saved logsumexp. Layout is paddle's
+[batch, seq, heads, head_dim] at the API boundary, [B*H, S, D] inside.
+
+On non-TPU backends the kernels run under ``interpret=True`` (tests), and
+nn.functional falls back to fused-XLA attention anyway.
+"""
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _choose_block(seq_len: int, target: int = 128) -> int:
+    b = min(target, seq_len)
+    while seq_len % b:
+        b //= 2
+    return max(b, 1)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, seq_len,
+                   causal, scale):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # [bq, d]
+    d = q.shape[-1]
+
+    nk = seq_len // bk
+    if causal:
+        # blocks strictly after this q block contribute nothing
+        upper = (qi + 1) * bq + bk - 1
+        nk_eff = jnp.minimum((upper // bk), nk)
+    else:
+        nk_eff = nk
+
+    def body(j, carry):
+        acc, m, l = carry
+        k = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale  # [bq, bk]
+        if causal:
+            iq = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            ik = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(iq >= ik, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + jnp.sum(p, axis=-1)
+        acc_new = acc * alpha[:, None] + jax.lax.dot(
+            p, v, preferred_element_type=jnp.float32)
+        return acc_new, m_new, l_new
+
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, nk_eff, body, (acc0, m0, l0))
+
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = (m + jnp.log(l_safe)).astype(jnp.float32)
+
+
+def _fa_forward(q, k, v, causal, scale, bq, bk):
+    BH, S, D = q.shape
+    grid = (BH, S // bq)
+    kernel = functools.partial(_fa_fwd_kernel, bq=bq, bk=bk, seq_len=S,
+                               causal=causal, scale=scale)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, S), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _fa_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                        dk_ref, dv_ref, *, bq, bk, seq_len, causal, scale):
+    ki = pl.program_id(1)
+    k = k_ref[0].astype(jnp.float32)  # [bk, d]
+    v = v_ref[0].astype(jnp.float32)
+    d = k.shape[-1]
+    nq = seq_len // bq
+    if causal:
+        start = (ki * bk) // bq  # first q block that can see this k block
+    else:
+        start = 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        do = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * bq, bq)]
+        delta = delta_ref[0, pl.ds(i * bq, bq)]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            iq = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            ik = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(iq >= ik, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])  # [bq, bk]
+        dv_new = dv + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        dk_new = dk + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return dk_new, dv_new
+
+    dk0 = jnp.zeros((bk, d), jnp.float32)
+    dv0 = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(start, nq, body, (dk0, dv0))
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, *, bq, bk, seq_len, causal, scale):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    d = q.shape[-1]
+    nk = seq_len // bk
+    if causal:
+        nk_eff = jnp.minimum(((qi + 1) * bq + bk - 1) // bk, nk)
+    else:
+        nk_eff = nk
+
+    def body(j, dq):
+        k = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        v = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        if causal:
+            iq = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            ik = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(iq >= ik, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None]) * scale
+        return dq + jax.lax.dot(ds, k,
+                                preferred_element_type=jnp.float32)
+
+    dq = jax.lax.fori_loop(0, nk_eff, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _fa_backward(res, g, causal, scale, bq, bk):
+    q, k, v, out, lse = res
+    BH, S, D = q.shape
+    delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32),
+                    axis=-1)  # [BH, S]
+    interp = _interpret()
+    dkdv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkdv_kernel, bq=bq, bk=bk, seq_len=S,
+                          causal=causal, scale=scale),
+        grid=(BH, S // bk),
+        in_specs=[
+            pl.BlockSpec((1, S, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, S, D), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, S), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, S), lambda b, j: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, S, D), q.dtype),
+        ],
+        interpret=interp,
+    )(q, k, v, g, lse, delta)
+    dk, dv = dkdv
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, bq=bq, bk=bk, seq_len=S,
+                          causal=causal, scale=scale),
+        grid=(BH, S // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+        ],
+        out_specs=[pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((BH, S, D), q.dtype)],
+        interpret=interp,
+    )(q, k, v, g, lse, delta)[0]
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public API: [B, S, H, D] layout with custom VJP
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _flash_bshd(q, k, v, causal, scale):
+    return _flash_fwd_rule(q, k, v, causal, scale)[0]
+
+
+def _pack(x):
+    B, S, H, D = x.shape
+    return jnp.swapaxes(x, 1, 2).reshape(B * H, S, D)
+
+
+def _unpack(x, B, H):
+    BH, S, D = x.shape
+    return jnp.swapaxes(x.reshape(B, H, S, D), 1, 2)
+
+
+def _flash_fwd_rule(q, k, v, causal, scale):
+    B, S, H, D = q.shape
+    bq = _choose_block(S)
+    bk = _choose_block(S)
+    qp, kp, vp = _pack(q), _pack(k), _pack(v)
+    out, lse = _fa_forward(qp, kp, vp, causal, scale, bq, bk)
+    return _unpack(out, B, H), (qp, kp, vp, out, lse, B, H, bq, bk)
+
+
+def _flash_bwd_rule(causal, scale, res, g):
+    qp, kp, vp, out, lse, B, H, bq, bk = res
+    gp = _pack(g)
+    dq, dk, dv = _fa_backward((qp, kp, vp, out, lse), gp, causal, scale,
+                              bq, bk)
+    return (_unpack(dq, B, H), _unpack(dk, B, H), _unpack(dv, B, H))
+
+
+_flash_bshd.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention_fwd(q, k, v, causal=False, scale=None):
+    """Fused attention on [batch, seq, heads, head_dim] arrays."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return _flash_bshd(q, k, v, causal, scale)
+
+
+# ---------------------------------------------------------------------------
+# ring attention (context parallelism over a mesh axis)
+# ---------------------------------------------------------------------------
+
+def ring_attention(q, k, v, mesh, axis: str = "sep", causal: bool = False,
+                   scale=None):
+    """Exact attention with the sequence sharded over ``axis``.
+
+    The reference has NO long-context mechanism (SURVEY.md P8 — absent);
+    this is the TPU-native superset: k/v blocks rotate around the ring via
+    ``ppermute`` while each rank accumulates its queries' online softmax —
+    peak memory per chip is O(S/N), comm is overlapped block-by-block over
+    ICI. Layout [B, S, H, D] global view; S sharded over ``axis``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    N = mesh.shape[axis]
+
+    def per_rank(ql, kl, vl):
+        rank = jax.lax.axis_index(axis)
+        B, Sl, H, D = ql.shape
+        qf = ql.astype(jnp.float32)
+        acc = jnp.zeros((B, Sl, H, D), jnp.float32)
+        m = jnp.full((B, Sl, H), NEG_INF, jnp.float32)
+        l = jnp.zeros((B, Sl, H), jnp.float32)
+
+        def step(carry, t):
+            acc, m, l, kb, vb = carry
+            src_rank = (rank - t) % N  # whose k/v block we hold now
+            s = jnp.einsum("bqhd,bkhd->bqhk", qf,
+                           kb.astype(jnp.float32)) * scale
+            if causal:
+                iq = rank * Sl + jax.lax.broadcasted_iota(
+                    jnp.int32, (Sl, Sl), 0)
+                ik = src_rank * Sl + jax.lax.broadcasted_iota(
+                    jnp.int32, (Sl, Sl), 1)
+                mask = (iq >= ik)[None, :, None, :]
+                s = jnp.where(mask, s, NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_new[..., None])
+            alpha = jnp.exp(m - m_new)
+            l_new = l * alpha + jnp.sum(p, axis=-1)
+            acc_new = acc * alpha[..., None] + jnp.einsum(
+                "bqhk,bkhd->bqhd", p, vb.astype(jnp.float32))
+            perm = [(i, (i + 1) % N) for i in range(N)]
+            kb2 = jax.lax.ppermute(kb, axis, perm)
+            vb2 = jax.lax.ppermute(vb, axis, perm)
+            return (acc_new, m_new, l_new, kb2, vb2), None
+
+        (acc, m, l, _, _), _ = jax.lax.scan(
+            step, (acc, m, l, kl, vl), jnp.arange(N))
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        return (acc / l_safe[..., None]).astype(ql.dtype)
+
+    spec = P(None, axis, None, None)
+    fn = jax.shard_map(per_rank, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, axis_names={axis}, check_vma=False)
+    return fn(q, k, v)

@@ -1,0 +1,92 @@
+"""Pallas flash attention + ring attention numerics (interpret mode on CPU;
+reference analog: flash_attn op tests in test/legacy_test)."""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.pallas_ops import flash_attention_fwd, ring_attention
+
+
+def _ref(q, k, v, causal):
+    d = q.shape[-1]
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) / math.sqrt(d)
+    if causal:
+        T = q.shape[1]
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None, None], s,
+                      -1e30)
+    p = jax.nn.softmax(s, -1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p,
+                      v.astype(jnp.float32)).astype(q.dtype)
+
+
+@pytest.fixture(scope="module")
+def qkv():
+    key = jax.random.key(0)
+    B, S, H, D = 2, 128, 2, 64
+    return tuple(jax.random.normal(jax.random.fold_in(key, i),
+                                   (B, S, H, D), jnp.float32)
+                 for i in range(3))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_forward(qkv, causal):
+    q, k, v = qkv
+    out = flash_attention_fwd(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_ref(q, k, v, causal)),
+                               atol=2e-5)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_backward(qkv, causal):
+    q, k, v = qkv
+    g1 = jax.grad(lambda *a: jnp.sum(
+        flash_attention_fwd(*a, causal=causal) ** 2), argnums=(0, 1, 2))(
+        q, k, v)
+    g2 = jax.grad(lambda *a: jnp.sum(_ref(*a, causal) ** 2),
+                  argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=5e-4)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention(qkv, causal):
+    from jax.sharding import Mesh
+    q, k, v = qkv
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sep",))
+    out = ring_attention(q, k, v, mesh, axis="sep", causal=causal)
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(_ref(q, k, v, causal)),
+                               atol=2e-5)
+
+
+def test_ring_attention_grad(qkv):
+    from jax.sharding import Mesh
+    q, k, v = qkv
+    mesh = Mesh(np.array(jax.devices()[:4]), ("sep",))
+    g1 = jax.grad(lambda q: jnp.sum(
+        ring_attention(q, k, v, mesh, causal=True) ** 2))(q)
+    g2 = jax.grad(lambda q: jnp.sum(_ref(q, k, v, True) ** 2))(q)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=5e-4)
+
+
+def test_functional_ring_attention_tensor_api():
+    import paddle_tpu as paddle
+    import paddle_tpu.nn.functional as F
+    import paddle_tpu.distributed as dist
+    mesh = dist.ProcessMesh(np.arange(4), ["sep"])
+    dist.set_mesh(mesh)
+    try:
+        q = paddle.randn([1, 64, 2, 32])
+        q.stop_gradient = False
+        out = F.ring_attention(q, q, q, causal=True)
+        ref = F.scaled_dot_product_attention(q, q, q, is_causal=True)
+        np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-4)
+        out.sum().backward()
+        assert q.grad is not None
+    finally:
+        dist.set_mesh(None)
