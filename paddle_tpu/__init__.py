@@ -30,6 +30,10 @@ from . import io  # noqa: F401
 from . import jit  # noqa: F401
 from . import vision  # noqa: F401
 from . import metric  # noqa: F401
+from . import profiler  # noqa: F401
+from . import distribution  # noqa: F401
+from . import incubate  # noqa: F401
+from . import distributed  # noqa: F401
 from . import static  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .framework.io import save, load  # noqa: F401

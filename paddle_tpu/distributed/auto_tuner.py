@@ -1,0 +1,134 @@
+"""Auto-tuner: parallel-config search.
+
+Reference: python/paddle/distributed/auto_tuner/ (tuner.py, prune.py) —
+grid search over dp/mp/pp/sharding degrees, micro-batch size, recompute;
+prunes by divisibility/memory model, launches trial runs, records best.
+
+TPU-native: candidates are mesh shapes; pruning uses an analytic memory
+model (params + optimizer state + activations vs HBM) and the trial is a
+user-supplied callable (typically: build GPTSpmdTrainer on the candidate
+mesh, run a few steps, return tokens/sec). Compile caching makes trials
+cheap relative to the reference's full relaunches.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import math
+import os
+import time
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["TunerConfig", "Candidate", "AutoTuner", "default_candidates",
+           "prune_by_memory"]
+
+
+@dataclasses.dataclass
+class Candidate:
+    dp: int = 1
+    mp: int = 1
+    pp: int = 1
+    sharding: int = 1
+    sep: int = 1
+    micro_batch_size: int = 1
+    use_recompute: bool = False
+
+    @property
+    def world(self):
+        return self.dp * self.mp * self.pp * self.sharding * self.sep
+
+    def as_dict(self):
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class TunerConfig:
+    n_devices: int = 8
+    global_batch_size: int = 32
+    max_mp: int = 8
+    max_pp: int = 8
+    hbm_bytes: float = 16e9  # v5e
+    model_params: float = 1e9
+    hidden_size: int = 2048
+    seq_len: int = 2048
+    layers: int = 24
+    dtype_bytes: int = 2
+    max_trials: int = 16
+
+
+def default_candidates(cfg: TunerConfig) -> List[Candidate]:
+    out = []
+    n = cfg.n_devices
+
+    def powers(limit):
+        p = 1
+        while p <= limit:
+            yield p
+            p *= 2
+
+    for mp in powers(min(cfg.max_mp, n)):
+        for pp in powers(min(cfg.max_pp, n // mp)):
+            rest = n // (mp * pp)
+            for sharding in powers(rest):
+                dp = rest // sharding
+                for mbs in (1, 2, 4, 8):
+                    if cfg.global_batch_size % (dp * mbs):
+                        continue
+                    for rc in (False, True):
+                        out.append(Candidate(dp, mp, pp, sharding, 1, mbs,
+                                             rc))
+    return out
+
+
+def prune_by_memory(cand: Candidate, cfg: TunerConfig) -> bool:
+    """True = keep. Analytic per-chip memory (reference prune.py's memory
+    model, re-derived for fp32 master + bf16 compute)."""
+    if cand.world != cfg.n_devices:
+        return False
+    if cfg.layers % cand.pp:
+        return False
+    if cfg.hidden_size % cand.mp:
+        return False
+    shard_ways = cand.mp * cand.pp * cand.sharding
+    # fp32 master + adam m/v (12B) sharded; bf16 working copy
+    param_bytes = cfg.model_params * (12 / shard_ways + 2 / (cand.mp *
+                                                             cand.pp))
+    act_per_layer = (cand.micro_batch_size * cfg.seq_len *
+                     cfg.hidden_size * cfg.dtype_bytes *
+                     (2 if cand.use_recompute else 14) / cand.mp)
+    act_bytes = act_per_layer * cfg.layers / cand.pp
+    return (param_bytes + act_bytes) < 0.9 * cfg.hbm_bytes
+
+
+class AutoTuner:
+    def __init__(self, cfg: TunerConfig,
+                 trial_fn: Callable[[Candidate], float],
+                 history_path: Optional[str] = None):
+        self.cfg = cfg
+        self.trial_fn = trial_fn
+        self.history: List[Dict] = []
+        self.history_path = history_path
+
+    def tune(self) -> Optional[Candidate]:
+        candidates = [c for c in default_candidates(self.cfg)
+                      if prune_by_memory(c, self.cfg)]
+        # prefer low-comm configs first (mp small, dp large)
+        candidates.sort(key=lambda c: (c.mp * c.pp, -c.dp))
+        best, best_score = None, -math.inf
+        for cand in candidates[:self.cfg.max_trials]:
+            t0 = time.time()
+            try:
+                score = self.trial_fn(cand)
+                err = None
+            except Exception as e:  # OOM / compile failure -> record, skip
+                score, err = -math.inf, str(e)
+            self.history.append({"candidate": cand.as_dict(),
+                                 "score": score, "error": err,
+                                 "elapsed_s": time.time() - t0})
+            if score > best_score:
+                best, best_score = cand, score
+        if self.history_path:
+            with open(self.history_path, "w") as f:
+                json.dump(self.history, f, indent=2)
+        return best

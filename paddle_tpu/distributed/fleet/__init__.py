@@ -123,6 +123,7 @@ def worker_index():
 
 
 from . import meta_parallel  # noqa: E402,F401
+from . import elastic  # noqa: E402,F401
 from .meta_parallel import PipelineLayer, LayerDesc, SharedLayerDesc  # noqa: E402,F401
 
 

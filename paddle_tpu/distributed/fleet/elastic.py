@@ -1,0 +1,127 @@
+"""Elastic training manager.
+
+Reference: python/paddle/distributed/fleet/elastic/manager.py:125
+ElasticManager — etcd-backed node registry, TTL heartbeats, fault-tolerance
+levels, scale-up/down watch, relaunch via ELASTIC_EXIT_CODE=101.
+
+TPU-native (SURVEY.md §5 failure-detection mapping): slice membership is
+static per job, so "elastic" = detect peer failure (coordination-service
+barrier timeout / heartbeat), save/restore a resharded checkpoint
+(distributed.checkpoint works across changed meshes by construction), and
+exit with the relaunch code for the launcher's watch loop.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from enum import Enum
+from typing import Callable, Optional
+
+from ..checkpoint import load_state_dict, save_state_dict
+from ..launch import ELASTIC_EXIT_CODE
+
+__all__ = ["ElasticLevel", "ElasticStatus", "ElasticManager",
+           "ELASTIC_EXIT_CODE"]
+
+
+class ElasticLevel(Enum):
+    NONE = 0
+    FAULT_TOLERANCE = 1  # fixed size, restart on failure
+    ELASTIC = 2          # size may change between restarts
+
+
+class ElasticStatus(Enum):
+    COMPLETED = 0
+    ERROR = 1
+    HOLD = 2
+    RESTART = 3
+    EXIT = 4
+
+
+class ElasticManager:
+    def __init__(self, checkpoint_dir: Optional[str] = None,
+                 heartbeat_interval: float = 10.0,
+                 heartbeat_timeout: float = 120.0,
+                 elastic_level: ElasticLevel = ElasticLevel.FAULT_TOLERANCE,
+                 on_failure: Optional[Callable] = None):
+        self.checkpoint_dir = checkpoint_dir or os.environ.get(
+            "PADDLE_ELASTIC_CKPT_DIR", "/tmp/paddle_tpu_elastic")
+        self.heartbeat_interval = heartbeat_interval
+        self.heartbeat_timeout = heartbeat_timeout
+        self.elastic_level = elastic_level
+        self.on_failure = on_failure
+        self._last_beats = {}
+        self._stop = threading.Event()
+        self._watcher: Optional[threading.Thread] = None
+        self._failed = False
+
+    # -- membership (coordination-service analog of etcd registry) --------
+    def register(self):
+        import jax
+        self._rank = jax.process_index()
+        self._world = jax.process_count()
+        self._last_beats = {r: time.monotonic()
+                            for r in range(self._world)}
+        return self
+
+    def heartbeat(self, rank: Optional[int] = None):
+        import jax
+        r = rank if rank is not None else jax.process_index()
+        self._last_beats[r] = time.monotonic()
+
+    def dead_peers(self):
+        now = time.monotonic()
+        return [r for r, t in self._last_beats.items()
+                if now - t > self.heartbeat_timeout]
+
+    def watch(self):
+        """Background failure watch (launcher controller.py poll analog)."""
+        def loop():
+            while not self._stop.is_set():
+                dead = self.dead_peers()
+                if dead:
+                    self._failed = True
+                    if self.on_failure is not None:
+                        self.on_failure(dead)
+                    break
+                self._stop.wait(self.heartbeat_interval)
+
+        self._watcher = threading.Thread(target=loop, daemon=True)
+        self._watcher.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+
+    @property
+    def failed(self) -> bool:
+        return self._failed
+
+    # -- checkpoint-restart protocol --------------------------------------
+    def save(self, state_dict, step: int):
+        save_state_dict(state_dict,
+                        os.path.join(self.checkpoint_dir, f"step_{step}"),
+                        async_save=True)
+        with open(os.path.join(self.checkpoint_dir, "LATEST"), "w") as f:
+            f.write(str(step))
+
+    def latest_step(self) -> Optional[int]:
+        p = os.path.join(self.checkpoint_dir, "LATEST")
+        if not os.path.exists(p):
+            return None
+        with open(p) as f:
+            return int(f.read().strip())
+
+    def restore(self, state_dict) -> Optional[int]:
+        step = self.latest_step()
+        if step is None:
+            return None
+        load_state_dict(state_dict,
+                        os.path.join(self.checkpoint_dir, f"step_{step}"))
+        return step
+
+    def request_relaunch(self):
+        """Exit with the relaunch code; the launcher restarts us
+        (reference manager.py:33 ELASTIC_EXIT_CODE protocol)."""
+        os._exit(ELASTIC_EXIT_CODE)

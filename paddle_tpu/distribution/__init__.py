@@ -1,0 +1,494 @@
+"""Probability distributions (reference: python/paddle/distribution/,
+9.3k LoC — Normal/Bernoulli/.../TransformedDistribution + KL registry)."""
+from __future__ import annotations
+
+import math
+from typing import Dict, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as rnd
+from ..framework.tensor import Tensor, apply_op, _unwrap
+
+__all__ = ["Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
+           "Exponential", "Beta", "Gamma", "Dirichlet", "Multinomial",
+           "LogNormal", "Laplace", "Gumbel", "Geometric", "Poisson",
+           "Cauchy", "StudentT", "kl_divergence", "register_kl"]
+
+
+def _t(x):
+    if isinstance(x, Tensor):
+        return x._data
+    return jnp.asarray(x, jnp.float32) if not isinstance(x, jax.Array) \
+        else x
+
+
+def _shape(sample_shape):
+    if isinstance(sample_shape, int):
+        return (sample_shape,)
+    return tuple(int(s) for s in sample_shape)
+
+
+class Distribution:
+    def __init__(self, batch_shape=(), event_shape=()):
+        self._batch_shape = tuple(batch_shape)
+        self._event_shape = tuple(event_shape)
+
+    @property
+    def batch_shape(self):
+        return self._batch_shape
+
+    @property
+    def event_shape(self):
+        return self._event_shape
+
+    @property
+    def mean(self):
+        raise NotImplementedError
+
+    @property
+    def variance(self):
+        raise NotImplementedError
+
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def prob(self, value):
+        return Tensor(jnp.exp(_t(self.log_prob(value))))
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(self.loc, self.batch_shape))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(self.scale ** 2, self.batch_shape))
+
+    @property
+    def stddev(self):
+        return Tensor(jnp.broadcast_to(self.scale, self.batch_shape))
+
+    def sample(self, shape=()):
+        eps = jax.random.normal(rnd.next_key(),
+                                _shape(shape) + self.batch_shape)
+        return Tensor(self.loc + self.scale * eps)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _t(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var) -
+                      jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    def entropy(self):
+        e = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return Tensor(jnp.broadcast_to(e, self.batch_shape))
+
+    def cdf(self, value):
+        return Tensor(jax.scipy.stats.norm.cdf(_t(value), self.loc,
+                                               self.scale))
+
+
+class LogNormal(Normal):
+    def sample(self, shape=()):
+        return Tensor(jnp.exp(_t(super().sample(shape))))
+
+    def log_prob(self, value):
+        v = _t(value)
+        return Tensor(jax.scipy.stats.norm.logpdf(jnp.log(v), self.loc,
+                                                  self.scale) - jnp.log(v))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.exp(self.loc + self.scale ** 2 / 2))
+
+
+class Uniform(Distribution):
+    def __init__(self, low, high, name=None):
+        self.low = _t(low)
+        self.high = _t(high)
+        super().__init__(jnp.broadcast_shapes(self.low.shape,
+                                              self.high.shape))
+
+    @property
+    def mean(self):
+        return Tensor((self.low + self.high) / 2)
+
+    @property
+    def variance(self):
+        return Tensor((self.high - self.low) ** 2 / 12)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(rnd.next_key(),
+                               _shape(shape) + self.batch_shape)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _t(value)
+        inside = (v >= self.low) & (v < self.high)
+        return Tensor(jnp.where(inside, -jnp.log(self.high - self.low),
+                                -jnp.inf))
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+
+class Bernoulli(Distribution):
+    def __init__(self, probs=None, logits=None, name=None):
+        if probs is not None:
+            self.probs = _t(probs)
+            self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+        else:
+            self.logits = _t(logits)
+            self.probs = jax.nn.sigmoid(self.logits)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.bernoulli(
+            rnd.next_key(), self.probs,
+            _shape(shape) + self.batch_shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _t(value)
+        return Tensor(v * jax.nn.log_sigmoid(self.logits) +
+                      (1 - v) * jax.nn.log_sigmoid(-self.logits))
+
+    def entropy(self):
+        p = self.probs
+        return Tensor(-(p * jnp.log(jnp.maximum(p, 1e-12)) +
+                        (1 - p) * jnp.log(jnp.maximum(1 - p, 1e-12))))
+
+
+class Categorical(Distribution):
+    def __init__(self, logits=None, probs=None, name=None):
+        if logits is not None:
+            self.logits = jax.nn.log_softmax(_t(logits), axis=-1)
+        else:
+            self.logits = jnp.log(jnp.maximum(_t(probs), 1e-30))
+            self.logits = self.logits - jax.scipy.special.logsumexp(
+                self.logits, axis=-1, keepdims=True)
+        self.probs = jnp.exp(self.logits)
+        super().__init__(self.logits.shape[:-1])
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.categorical(
+            rnd.next_key(), self.logits,
+            shape=_shape(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        idx = _t(value).astype(jnp.int32)
+        return Tensor(jnp.take_along_axis(
+            self.logits, idx[..., None], axis=-1)[..., 0])
+
+    def entropy(self):
+        return Tensor(-jnp.sum(self.probs * self.logits, axis=-1))
+
+
+class Exponential(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.rate)
+
+    @property
+    def variance(self):
+        return Tensor(1.0 / self.rate ** 2)
+
+    def sample(self, shape=()):
+        e = jax.random.exponential(rnd.next_key(),
+                                   _shape(shape) + self.batch_shape)
+        return Tensor(e / self.rate)
+
+    rsample = sample
+
+    def log_prob(self, value):
+        v = _t(value)
+        return Tensor(jnp.log(self.rate) - self.rate * v)
+
+    def entropy(self):
+        return Tensor(1.0 - jnp.log(self.rate))
+
+
+class Beta(Distribution):
+    def __init__(self, alpha, beta, name=None):
+        self.alpha = _t(alpha)
+        self.beta = _t(beta)
+        super().__init__(jnp.broadcast_shapes(self.alpha.shape,
+                                              self.beta.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.alpha / (self.alpha + self.beta))
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.beta(rnd.next_key(), self.alpha,
+                                      self.beta,
+                                      _shape(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        return Tensor(jax.scipy.stats.beta.logpdf(_t(value), self.alpha,
+                                                  self.beta))
+
+
+class Gamma(Distribution):
+    def __init__(self, concentration, rate, name=None):
+        self.concentration = _t(concentration)
+        self.rate = _t(rate)
+        super().__init__(jnp.broadcast_shapes(self.concentration.shape,
+                                              self.rate.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.concentration / self.rate)
+
+    def sample(self, shape=()):
+        g = jax.random.gamma(rnd.next_key(), self.concentration,
+                             _shape(shape) + self.batch_shape)
+        return Tensor(g / self.rate)
+
+    def log_prob(self, value):
+        return Tensor(jax.scipy.stats.gamma.logpdf(
+            _t(value), self.concentration, scale=1.0 / self.rate))
+
+
+class Dirichlet(Distribution):
+    def __init__(self, concentration, name=None):
+        self.concentration = _t(concentration)
+        super().__init__(self.concentration.shape[:-1],
+                         self.concentration.shape[-1:])
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.dirichlet(
+            rnd.next_key(), self.concentration,
+            _shape(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        return Tensor(jax.scipy.stats.dirichlet.logpdf(
+            jnp.moveaxis(_t(value), -1, 0), self.concentration))
+
+
+class Multinomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = int(total_count)
+        self.probs = _t(probs)
+        super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
+
+    def sample(self, shape=()):
+        n = self.probs.shape[-1]
+        idx = jax.random.categorical(
+            rnd.next_key(), jnp.log(jnp.maximum(self.probs, 1e-30)),
+            shape=_shape(shape) + (self.total_count,) + self.batch_shape)
+        counts = jax.nn.one_hot(idx, n).sum(axis=len(_shape(shape)))
+        return Tensor(counts)
+
+    def log_prob(self, value):
+        v = _t(value)
+        logits = jnp.log(jnp.maximum(self.probs, 1e-30))
+        return Tensor(jax.scipy.special.gammaln(self.total_count + 1) -
+                      jnp.sum(jax.scipy.special.gammaln(v + 1), -1) +
+                      jnp.sum(v * logits, -1))
+
+
+class Laplace(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+    def sample(self, shape=()):
+        return Tensor(self.loc + self.scale * jax.random.laplace(
+            rnd.next_key(), _shape(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        v = _t(value)
+        return Tensor(-jnp.abs(v - self.loc) / self.scale -
+                      jnp.log(2 * self.scale))
+
+    def entropy(self):
+        return Tensor(1 + jnp.log(2 * self.scale))
+
+
+class Gumbel(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.loc + self.scale * np.euler_gamma)
+
+    def sample(self, shape=()):
+        return Tensor(self.loc + self.scale * jax.random.gumbel(
+            rnd.next_key(), _shape(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        z = (_t(value) - self.loc) / self.scale
+        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+
+
+class Geometric(Distribution):
+    def __init__(self, probs, name=None):
+        self.probs = _t(probs)
+        super().__init__(self.probs.shape)
+
+    @property
+    def mean(self):
+        return Tensor(1.0 / self.probs)
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.geometric(
+            rnd.next_key(), self.probs,
+            _shape(shape) + self.batch_shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        v = _t(value)
+        return Tensor((v - 1) * jnp.log1p(-self.probs) +
+                      jnp.log(self.probs))
+
+
+class Poisson(Distribution):
+    def __init__(self, rate, name=None):
+        self.rate = _t(rate)
+        super().__init__(self.rate.shape)
+
+    @property
+    def mean(self):
+        return Tensor(self.rate)
+
+    def sample(self, shape=()):
+        return Tensor(jax.random.poisson(
+            rnd.next_key(), self.rate,
+            _shape(shape) + self.batch_shape).astype(jnp.float32))
+
+    def log_prob(self, value):
+        return Tensor(jax.scipy.stats.poisson.logpmf(_t(value), self.rate))
+
+
+class Cauchy(Distribution):
+    def __init__(self, loc, scale, name=None):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        return Tensor(self.loc + self.scale * jax.random.cauchy(
+            rnd.next_key(), _shape(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        return Tensor(jax.scipy.stats.cauchy.logpdf(_t(value), self.loc,
+                                                    self.scale))
+
+
+class StudentT(Distribution):
+    def __init__(self, df, loc=0.0, scale=1.0, name=None):
+        self.df = _t(df)
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+        super().__init__(jnp.broadcast_shapes(self.df.shape,
+                                              self.loc.shape,
+                                              self.scale.shape))
+
+    def sample(self, shape=()):
+        return Tensor(self.loc + self.scale * jax.random.t(
+            rnd.next_key(), self.df, _shape(shape) + self.batch_shape))
+
+    def log_prob(self, value):
+        return Tensor(jax.scipy.stats.t.logpdf(_t(value), self.df,
+                                               self.loc, self.scale))
+
+
+# -- KL registry -----------------------------------------------------------
+
+_KL_REGISTRY: Dict[Tuple[Type, Type], callable] = {}
+
+
+def register_kl(p_cls, q_cls):
+    def decorator(fn):
+        _KL_REGISTRY[(p_cls, q_cls)] = fn
+        return fn
+
+    return decorator
+
+
+def kl_divergence(p: Distribution, q: Distribution) -> Tensor:
+    for (pc, qc), fn in _KL_REGISTRY.items():
+        if isinstance(p, pc) and isinstance(q, qc):
+            return fn(p, q)
+    raise NotImplementedError(
+        f"no KL registered for ({type(p).__name__}, {type(q).__name__})")
+
+
+@register_kl(Normal, Normal)
+def _kl_normal(p, q):
+    var_ratio = (p.scale / q.scale) ** 2
+    t1 = ((p.loc - q.loc) / q.scale) ** 2
+    return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+
+
+@register_kl(Categorical, Categorical)
+def _kl_categorical(p, q):
+    return Tensor(jnp.sum(p.probs * (p.logits - q.logits), axis=-1))
+
+
+@register_kl(Bernoulli, Bernoulli)
+def _kl_bernoulli(p, q):
+    a = p.probs * (jnp.log(jnp.maximum(p.probs, 1e-12)) -
+                   jnp.log(jnp.maximum(q.probs, 1e-12)))
+    b = (1 - p.probs) * (jnp.log(jnp.maximum(1 - p.probs, 1e-12)) -
+                         jnp.log(jnp.maximum(1 - q.probs, 1e-12)))
+    return Tensor(a + b)
+
+
+@register_kl(Uniform, Uniform)
+def _kl_uniform(p, q):
+    return Tensor(jnp.log((q.high - q.low) / (p.high - p.low)))
+
+
+@register_kl(Exponential, Exponential)
+def _kl_exponential(p, q):
+    r = p.rate / q.rate
+    return Tensor(jnp.log(r) + q.rate / p.rate - 1)

@@ -1,0 +1,5 @@
+"""Incubate: experimental APIs (reference: python/paddle/incubate/, 42k LoC
+— fused ops, MoE, ASP sparsity, autograd prim)."""
+from . import nn  # noqa: F401
+from . import moe  # noqa: F401
+from .moe import MoELayer, GShardGate, SwitchGate  # noqa: F401

@@ -1,0 +1,162 @@
+"""Fused-op python APIs (reference: python/paddle/incubate/nn/functional/ —
+fused_multi_transformer, fused_attention, fused_feedforward, fused rope,
+fused_rms_norm, fused_layer_norm).
+
+TPU-native: "fused" is the default on XLA — these wrappers express the same
+contracts as compositions XLA fuses (or Pallas kernels for attention), so
+reference incubate call sites port directly.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ...framework.tensor import Tensor, apply_op
+from ...nn import functional as F
+
+__all__ = ["fused_rms_norm", "fused_layer_norm", "fused_rotary_position_embedding",
+           "fused_dropout_add", "fused_linear", "fused_feedforward",
+           "fused_attention", "fused_bias_act", "swiglu"]
+
+
+def fused_rms_norm(x, norm_weight, norm_bias=None, epsilon=1e-6,
+                   begin_norm_axis=-1, **kw):
+    out = F.rms_norm(x, norm_weight, epsilon, begin_norm_axis)
+    if norm_bias is not None:
+        out = out + norm_bias
+    return out, None
+
+
+def fused_layer_norm(x, norm_weight, norm_bias, epsilon=1e-5,
+                     begin_norm_axis=1, **kw):
+    shape = x.shape[begin_norm_axis:]
+    return F.layer_norm(x, shape, norm_weight, norm_bias, epsilon), None
+
+
+def fused_rotary_position_embedding(q, k=None, v=None, sin=None, cos=None,
+                                    position_ids=None, use_neox_rotary_style=True,
+                                    rotary_emb_base=10000.0):
+    """RoPE applied to [B, S, H, D] tensors (reference:
+    incubate/nn/functional/fused_rotary_position_embedding.py)."""
+    def rope(x_, sin_, cos_):
+        if use_neox_rotary_style:
+            x1, x2 = jnp.split(x_, 2, axis=-1)
+            rotated = jnp.concatenate([-x2, x1], axis=-1)
+        else:
+            x1 = x_[..., 0::2]
+            x2 = x_[..., 1::2]
+            rotated = jnp.stack([-x2, x1], axis=-1).reshape(x_.shape)
+        return x_ * cos_ + rotated * sin_
+
+    def build_sin_cos(x_):
+        B, S, H, D = x_.shape
+        pos = jnp.arange(S, dtype=jnp.float32)
+        inv = rotary_emb_base ** (-jnp.arange(0, D, 2, jnp.float32) / D)
+        freqs = jnp.outer(pos, inv)  # [S, D/2]
+        if use_neox_rotary_style:
+            emb = jnp.concatenate([freqs, freqs], axis=-1)
+        else:
+            emb = jnp.repeat(freqs, 2, axis=-1)
+        return (jnp.sin(emb)[None, :, None, :].astype(x_.dtype),
+                jnp.cos(emb)[None, :, None, :].astype(x_.dtype))
+
+    outs = []
+    for t in (q, k, v):
+        if t is None:
+            outs.append(None)
+            continue
+        if sin is None:
+            def f(a):
+                s_, c_ = build_sin_cos(a)
+                return rope(a, s_, c_)
+            outs.append(apply_op(f, t, _op_name="fused_rope"))
+        else:
+            outs.append(apply_op(lambda a, s_, c_: rope(a, s_, c_), t, sin,
+                                 cos, _op_name="fused_rope"))
+    return tuple(outs)
+
+
+def fused_dropout_add(x, y, p=0.0, training=True, mode="upscale_in_train",
+                      name=None):
+    return F.dropout(x, p=p, training=training, mode=mode) + y
+
+
+def fused_linear(x, weight, bias=None, transpose_weight=False, name=None):
+    if transpose_weight:
+        from ...ops.linalg import matmul
+        out = matmul(x, weight, transpose_y=True)
+        return out + bias if bias is not None else out
+    return F.linear(x, weight, bias)
+
+
+def swiglu(x, y=None, name=None):
+    if y is None:
+        a, b = None, None
+        def f(v):
+            a_, b_ = jnp.split(v, 2, axis=-1)
+            return jax.nn.silu(a_) * b_
+        return apply_op(f, x, _op_name="swiglu")
+    return apply_op(lambda a, b: jax.nn.silu(a) * b, x, y,
+                    _op_name="swiglu")
+
+
+def fused_bias_act(x, bias=None, act_method="gelu", **kw):
+    if bias is not None:
+        x = x + bias
+    return getattr(F, act_method)(x)
+
+
+def fused_feedforward(x, linear1_weight, linear2_weight, linear1_bias=None,
+                      linear2_bias=None, ln1_scale=None, ln1_bias=None,
+                      ln2_scale=None, ln2_bias=None, dropout1_rate=0.5,
+                      dropout2_rate=0.5, activation="relu",
+                      ln1_epsilon=1e-5, ln2_epsilon=1e-5,
+                      pre_layer_norm=False, training=True, name=None):
+    residual = x
+    if pre_layer_norm and ln1_scale is not None:
+        x = F.layer_norm(x, [x.shape[-1]], ln1_scale, ln1_bias, ln1_epsilon)
+    h = F.linear(x, linear1_weight, linear1_bias)
+    h = getattr(F, activation)(h)
+    h = F.dropout(h, dropout1_rate, training=training)
+    h = F.linear(h, linear2_weight, linear2_bias)
+    h = F.dropout(h, dropout2_rate, training=training)
+    out = residual + h
+    if not pre_layer_norm and ln2_scale is not None:
+        out = F.layer_norm(out, [out.shape[-1]], ln2_scale, ln2_bias,
+                           ln2_epsilon)
+    return out
+
+
+def fused_attention(x, qkv_weight, linear_weight, pre_layer_norm=False,
+                    pre_ln_scale=None, pre_ln_bias=None, ln_scale=None,
+                    ln_bias=None, pre_ln_epsilon=1e-5, qkv_bias=None,
+                    linear_bias=None, cache_kv=None, attn_mask=None,
+                    dropout_rate=0.5, attn_dropout_rate=0.5,
+                    ln_epsilon=1e-5, training=True, num_heads=None,
+                    name=None):
+    """Fused MHA block (reference fused_attention op). qkv_weight layout
+    [3, num_heads, head_dim, embed_dim]."""
+    residual = x
+    if pre_layer_norm and pre_ln_scale is not None:
+        x = F.layer_norm(x, [x.shape[-1]], pre_ln_scale, pre_ln_bias,
+                         pre_ln_epsilon)
+    three, H, hd, D = qkv_weight.shape
+    w = qkv_weight.reshape([3 * H * hd, D])
+    from ...ops.linalg import matmul
+    qkv = matmul(x, w, transpose_y=True)
+    if qkv_bias is not None:
+        qkv = qkv + qkv_bias.reshape([3 * H * hd])
+    B, S = x.shape[0], x.shape[1]
+    qkv = qkv.reshape([B, S, 3, H, hd])
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    out = F.scaled_dot_product_attention(q, k, v, attn_mask,
+                                         attn_dropout_rate,
+                                         training=training)
+    out = out.reshape([B, S, H * hd])
+    out = F.linear(out, linear_weight, linear_bias)
+    out = F.dropout(out, dropout_rate, training=training)
+    out = residual + out
+    if not pre_layer_norm and ln_scale is not None:
+        out = F.layer_norm(out, [out.shape[-1]], ln_scale, ln_bias,
+                           ln_epsilon)
+    return out
