@@ -38,13 +38,16 @@ def get_worker_info():
 
 def default_collate_fn(batch):
     """Stack a list of samples into batched Tensors
-    (reference: io/dataloader/collate.py)."""
+    (reference: io/dataloader/collate.py). Equal-shape numpy samples take
+    the native multithreaded-memcpy path (csrc/data_feed.cc)."""
     sample = batch[0]
     if isinstance(sample, Tensor):
         import jax.numpy as jnp
         return Tensor(jnp.stack([b._data for b in batch]))
     if isinstance(sample, np.ndarray):
-        return Tensor(np.stack(batch))
+        from .native import native_collate
+        fast = native_collate(batch)
+        return Tensor(fast if fast is not None else np.stack(batch))
     if isinstance(sample, (int, np.integer)):
         return Tensor(np.asarray(batch, np.int64))
     if isinstance(sample, (float, np.floating)):

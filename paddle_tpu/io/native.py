@@ -1,0 +1,152 @@
+"""ctypes bindings + lazy build of the native data-feed library
+(csrc/data_feed.cc). Reference analog: the C++ reader/blocking-queue stack
+under /root/reference/paddle/fluid/operators/reader/ (here a small C ABI
+consumed without pybind11)."""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "csrc", "data_feed.cc")
+_OUT_DIR = os.path.join(_REPO_ROOT, "build")
+_SO = os.path.join(_OUT_DIR, "libptfeed.so")
+
+
+def _build() -> Optional[str]:
+    os.makedirs(_OUT_DIR, exist_ok=True)
+    if os.path.exists(_SO) and \
+            os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
+           _SRC, "-o", _SO]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return _SO
+    except Exception:
+        return None
+
+
+def get_lib():
+    """Load (building if needed) the native library; None if unavailable."""
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        so = _build()
+        if so is None:
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(so)
+        lib.ptq_create.restype = ctypes.c_void_p
+        lib.ptq_create.argtypes = [ctypes.c_size_t]
+        lib.ptq_destroy.argtypes = [ctypes.c_void_p]
+        lib.ptq_close.argtypes = [ctypes.c_void_p]
+        lib.ptq_push.restype = ctypes.c_int
+        lib.ptq_push.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                 ctypes.c_size_t, ctypes.c_int]
+        lib.ptq_pop.restype = ctypes.c_int64
+        lib.ptq_pop.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                ctypes.c_size_t, ctypes.c_int]
+        lib.ptq_size.restype = ctypes.c_int64
+        lib.ptq_size.argtypes = [ctypes.c_void_p]
+        lib.pt_parallel_collate.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int]
+        lib.pt_gather_rows.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+            ctypes.c_int64, ctypes.c_int]
+        _lib = lib
+        return _lib
+
+
+class BlockingQueue:
+    """Native bounded byte queue (C++ blocking_queue analog)."""
+
+    def __init__(self, capacity: int = 8):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native data_feed library unavailable")
+        self._lib = lib
+        self._h = lib.ptq_create(capacity)
+
+    def push(self, data: bytes, timeout_ms: int = -1) -> int:
+        return self._lib.ptq_push(self._h, data, len(data), timeout_ms)
+
+    def pop(self, maxbytes: int, timeout_ms: int = -1) -> Optional[bytes]:
+        buf = ctypes.create_string_buffer(maxbytes)
+        n = self._lib.ptq_pop(self._h, buf, maxbytes, timeout_ms)
+        if n <= 0:
+            return None
+        return buf.raw[:n]
+
+    def close(self):
+        self._lib.ptq_close(self._h)
+
+    def __len__(self):
+        return self._lib.ptq_size(self._h)
+
+    def __del__(self):
+        try:
+            self._lib.ptq_destroy(self._h)
+        except Exception:
+            pass
+
+
+def native_collate(samples: List[np.ndarray]) -> Optional[np.ndarray]:
+    """Stack equal-shape contiguous samples with multithreaded memcpy;
+    None when the fast path does not apply."""
+    lib = get_lib()
+    if lib is None or not samples:
+        return None
+    first = samples[0]
+    if not isinstance(first, np.ndarray):
+        return None
+    shape, dtype = first.shape, first.dtype
+    if dtype == object:
+        return None
+    for s in samples:
+        if not isinstance(s, np.ndarray) or s.shape != shape or \
+                s.dtype != dtype or not s.flags.c_contiguous:
+            return None
+    n = len(samples)
+    out = np.empty((n,) + shape, dtype)
+    sample_bytes = first.nbytes
+    if sample_bytes == 0:
+        return out
+    ptrs = (ctypes.c_void_p * n)(
+        *[s.ctypes.data_as(ctypes.c_void_p).value for s in samples])
+    lib.pt_parallel_collate(out.ctypes.data_as(ctypes.c_void_p), ptrs, n,
+                            sample_bytes, min(8, max(1, n // 16)))
+    return out
+
+
+def native_gather_rows(src: np.ndarray, indices) -> Optional[np.ndarray]:
+    """batch = src[indices] with multithreaded row gather."""
+    lib = get_lib()
+    if lib is None or not isinstance(src, np.ndarray) or \
+            not src.flags.c_contiguous or src.ndim < 1:
+        return None
+    idx = np.ascontiguousarray(np.asarray(indices, np.int64))
+    row_bytes = src[0].nbytes if len(src) else 0
+    out = np.empty((len(idx),) + src.shape[1:], src.dtype)
+    if row_bytes:
+        lib.pt_gather_rows(
+            out.ctypes.data_as(ctypes.c_void_p),
+            src.ctypes.data_as(ctypes.c_void_p),
+            idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(idx), row_bytes, min(8, max(1, len(idx) // 64)))
+    return out
