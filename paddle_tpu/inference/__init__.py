@@ -1,0 +1,372 @@
+"""paddle_tpu.inference — deployment/serving API.
+
+Reference: paddle/fluid/inference (AnalysisPredictor,
+`paddle_inference_api.h` CreatePredictor/Config; python surface
+python/paddle/inference/__init__.py). The reference's inference stack is an
+IR-pass pipeline (~290 fusion passes) + TensorRT subgraph engine over a saved
+ProgramDesc. TPU-native: the saved artifact is serialized StableHLO
+(produced by ``paddle_tpu.jit.save``); "analysis passes" are XLA's job, so
+the Predictor is a thin, fast runner: deserialize → jit (AOT compile) →
+zero-copy handles → run.
+
+API parity surface:
+    config = Config(model_prefix)            # AnalysisConfig analog
+    config.enable_memory_optim()
+    predictor = create_predictor(config)
+    names = predictor.get_input_names()
+    h = predictor.get_input_handle(names[0]); h.copy_from_cpu(x)
+    predictor.run()
+    out = predictor.get_output_handle(predictor.get_output_names()[0])
+    y = out.copy_to_cpu()
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = [
+    "Config", "Predictor", "create_predictor", "PrecisionType",
+    "PlaceType", "Tensor", "get_version",
+]
+
+
+def get_version() -> str:
+    from .. import __version__
+    return __version__
+
+
+class PrecisionType(enum.Enum):
+    """Reference: paddle_infer::PrecisionType (paddle_inference_api.h)."""
+    Float32 = 0
+    Half = 1     # on TPU, mapped to bfloat16 (no fp16 MXU path)
+    Bfloat16 = 2
+    Int8 = 3
+
+
+class PlaceType(enum.Enum):
+    UNK = -1
+    CPU = 0
+    GPU = 1
+    TPU = 2
+
+
+class Tensor:
+    """Zero-copy I/O handle (reference: paddle_infer::Tensor / ZeroCopyTensor,
+    paddle/fluid/inference/api/details/zero_copy_tensor.cc)."""
+
+    def __init__(self, name: str, spec: jax.ShapeDtypeStruct):
+        self.name = name
+        self._spec = spec
+        self._value: Optional[jax.Array] = None
+
+    @property
+    def shape(self) -> List[int]:
+        if self._value is not None:
+            return list(self._value.shape)
+        return list(self._spec.shape)
+
+    def reshape(self, shape: Sequence[int]):
+        # kept for API compat; shapes are static in the exported program
+        if tuple(shape) != tuple(self._spec.shape):
+            raise ValueError(
+                f"input '{self.name}' was exported with static shape "
+                f"{tuple(self._spec.shape)}; got {tuple(shape)}. Re-export "
+                "with jit.save(input_spec=...) for the new shape.")
+
+    def type(self):
+        return self._spec.dtype
+
+    def copy_from_cpu(self, data) -> None:
+        arr = np.asarray(data)
+        if arr.shape != tuple(self._spec.shape):
+            raise ValueError(
+                f"input '{self.name}' expects shape {tuple(self._spec.shape)}"
+                f", got {arr.shape}")
+        self._value = jnp.asarray(arr, dtype=self._spec.dtype)
+
+    # share_external_data = zero-copy adopt of an existing device array
+    def share_external_data(self, tensor) -> None:
+        data = getattr(tensor, "_data", tensor)
+        self._value = jnp.asarray(data, dtype=self._spec.dtype)
+
+    def copy_to_cpu(self) -> np.ndarray:
+        if self._value is None:
+            raise RuntimeError(f"tensor '{self.name}' has no data; run() "
+                               "the predictor first")
+        return np.asarray(self._value)
+
+    def lod(self):
+        return []
+
+    def set_lod(self, lod):
+        pass
+
+
+class Config:
+    """AnalysisConfig analog (reference:
+    paddle/fluid/inference/api/analysis_config.cc). Holds the model path and
+    execution knobs; graph optimization toggles are accepted for parity but
+    XLA owns fusion/memory planning on TPU."""
+
+    def __init__(self, prog_file: Optional[str] = None,
+                 params_file: Optional[str] = None):
+        # reference passes (model, params); jit.save emits one prefix
+        self._prefix = None
+        if prog_file is not None:
+            self._prefix = self._strip(prog_file)
+        self._precision = PrecisionType.Float32
+        self._device = PlaceType.TPU
+        self._memory_optim = True
+        self._ir_optim = True
+        self._donate_inputs = False
+        self._math_threads = 1
+
+    @staticmethod
+    def _strip(path: str) -> str:
+        for suf in (".stablehlo.mlir", ".pdiparams", ".pdmeta", ".pdmodel"):
+            if path.endswith(suf):
+                return path[: -len(suf)]
+        return path
+
+    def set_model(self, prog_file: str, params_file: Optional[str] = None):
+        self._prefix = self._strip(prog_file)
+
+    def model_dir(self) -> Optional[str]:
+        return os.path.dirname(self._prefix) if self._prefix else None
+
+    def prog_file(self) -> str:
+        return self._prefix + ".stablehlo.mlir"
+
+    def params_file(self) -> str:
+        return self._prefix + ".pdiparams"
+
+    # --- device / precision -------------------------------------------------
+    def enable_use_gpu(self, memory_pool_init_size_mb: int = 100,
+                       device_id: int = 0, precision=PrecisionType.Float32):
+        # accepted for parity; execution targets the default JAX backend
+        self._device = PlaceType.TPU
+        self._precision = precision
+
+    def enable_tpu(self, precision=PrecisionType.Float32):
+        self._device = PlaceType.TPU
+        self._precision = precision
+
+    def disable_gpu(self):
+        self._device = PlaceType.CPU
+
+    def use_gpu(self) -> bool:
+        return self._device in (PlaceType.GPU, PlaceType.TPU)
+
+    def set_precision(self, precision: PrecisionType):
+        self._precision = precision
+
+    def precision(self) -> PrecisionType:
+        return self._precision
+
+    # --- optimization toggles (parity; XLA does the work) -------------------
+    def enable_memory_optim(self, x: bool = True):
+        self._memory_optim = x
+
+    def switch_ir_optim(self, x: bool = True):
+        self._ir_optim = x
+
+    def switch_ir_debug(self, x: bool = True):
+        pass
+
+    def enable_mkldnn(self):
+        pass
+
+    def set_cpu_math_library_num_threads(self, n: int):
+        self._math_threads = int(n)
+
+    def switch_use_feed_fetch_ops(self, x: bool = False):
+        pass
+
+    def switch_specify_input_names(self, x: bool = True):
+        pass
+
+    def enable_tensorrt_engine(self, *a, **k):
+        raise RuntimeError("TensorRT is a CUDA engine; on TPU the exported "
+                           "StableHLO is compiled by XLA directly")
+
+    # buffer donation: lets XLA reuse input buffers for outputs
+    def enable_input_donation(self, x: bool = True):
+        self._donate_inputs = x
+
+    def summary(self) -> str:
+        return json.dumps({
+            "model": self._prefix, "precision": self._precision.name,
+            "device": self._device.name, "memory_optim": self._memory_optim,
+        }, indent=2)
+
+
+class Predictor:
+    """AnalysisPredictor analog (reference:
+    paddle/fluid/inference/api/analysis_predictor.h:105; ZeroCopyRun :215).
+
+    Deserializes the StableHLO program, AOT-compiles it once (the analog of
+    OptimizeInferenceProgram — XLA runs fusion/layout/memory passes), and
+    executes with zero host↔device copies between run() calls."""
+
+    def __init__(self, config: Config):
+        if config._prefix is None:
+            raise ValueError("Config has no model path; use Config(prefix)")
+        self._config = config
+        prefix = config._prefix
+
+        from ..jit.save_load import load_artifacts
+        self._exported, params, buffers = load_artifacts(prefix)
+
+        if config._precision in (PrecisionType.Half, PrecisionType.Bfloat16):
+            # Weight-only bf16: halve HBM for weights; the convert back to
+            # the program's traced dtype is fused into the consuming dot by
+            # XLA. (The program's compute dtypes are fixed at export time —
+            # export under amp/bf16 for full low-precision compute.)
+            cast = lambda t: (t.astype(jnp.bfloat16)
+                              if jnp.issubdtype(t.dtype, jnp.floating) else t)
+            params = {k: cast(v) for k, v in params.items()}
+            buffers = {k: cast(v) for k, v in buffers.items()}
+            self._weight_only = True
+        else:
+            self._weight_only = False
+        self._params = params
+        self._buffers = buffers
+
+        with open(prefix + ".pdmeta") as f:
+            meta = json.load(f)
+        self._input_names: List[str] = []
+        self._inputs: Dict[str, Tensor] = {}
+        # in_avals is the flattened pytree [*param_leaves, *buffer_leaves,
+        # *inputs]; the declared inputs are the trailing entries.
+        n_in = len(meta["input_specs"])
+        in_avals = self._exported.in_avals[-n_in:] if n_in else []
+        for i, (spec, aval) in enumerate(zip(meta["input_specs"], in_avals)):
+            name = spec.get("name") or f"x{i}"
+            self._input_names.append(name)
+            self._inputs[name] = Tensor(name, jax.ShapeDtypeStruct(
+                tuple(aval.shape), aval.dtype))
+
+        self._outputs: Dict[str, Tensor] = {}
+        self._output_names: List[str] = []
+        self._compiled = None
+
+    # --- introspection ------------------------------------------------------
+    def get_input_names(self) -> List[str]:
+        return list(self._input_names)
+
+    def get_input_handle(self, name: str) -> Tensor:
+        return self._inputs[name]
+
+    def get_output_names(self) -> List[str]:
+        if not self._output_names:
+            n = len(self._exported.out_avals)
+            self._output_names = [f"output_{i}" for i in range(n)]
+        return list(self._output_names)
+
+    def get_output_handle(self, name: str) -> Tensor:
+        if name not in self._outputs:
+            idx = int(name.rsplit("_", 1)[1])
+            aval = self._exported.out_avals[idx]
+            self._outputs[name] = Tensor(name, aval)
+        return self._outputs[name]
+
+    # --- execution ----------------------------------------------------------
+    def _fn(self, params, buffers, *args):
+        flat, treedef = jax.tree.flatten((params, buffers, *args))
+        flat = [x.astype(av.dtype) if x.dtype != av.dtype else x
+                for x, av in zip(flat, self._exported.in_avals)]
+        params, buffers, *args = jax.tree.unflatten(treedef, flat)
+        return self._exported.call(params, buffers, *args)
+
+    def run(self, inputs: Optional[Sequence] = None):
+        """ZeroCopyRun. With ``inputs`` given, behaves like the reference's
+        convenience ``predictor.run([t0, t1])`` and returns outputs."""
+        if inputs is not None:
+            for name, x in zip(self._input_names, inputs):
+                data = getattr(x, "_data", x)
+                self._inputs[name]._value = jnp.asarray(data)
+        args = []
+        for name in self._input_names:
+            h = self._inputs[name]
+            if h._value is None:
+                raise RuntimeError(f"input '{name}' not set; call "
+                                   "copy_from_cpu first")
+            args.append(h._value)
+        if self._compiled is None:
+            donate = (tuple(range(2, 2 + len(args)))
+                      if self._config._donate_inputs else ())
+            self._compiled = jax.jit(self._fn, donate_argnums=donate)
+        outs = self._compiled(self._params, self._buffers, *args)
+        # exported programs may return nested pytrees (tuples/dicts); the
+        # handle set is the flattened leaves, matching out_avals order
+        outs = jax.tree.leaves(outs)
+        for i, o in enumerate(outs):
+            self.get_output_handle(f"output_{i}")._value = o
+        self._output_names = [f"output_{i}" for i in range(len(outs))]
+        if inputs is not None:
+            return [self._outputs[n] for n in self._output_names]
+        return True
+
+    def clear_intermediate_tensor(self):
+        pass
+
+    def try_shrink_memory(self):
+        pass
+
+    def clone(self) -> "Predictor":
+        """Share weights + compiled executable with a new handle set
+        (reference AnalysisPredictor::Clone shares the scope)."""
+        p = Predictor.__new__(Predictor)
+        p.__dict__.update(self.__dict__)
+        p._inputs = {n: Tensor(n, t._spec) for n, t in self._inputs.items()}
+        p._outputs = {}
+        p._output_names = []
+        return p
+
+
+def create_predictor(config: Config) -> Predictor:
+    """paddle_infer::CreatePredictor analog."""
+    return Predictor(config)
+
+
+def convert_to_mixed_precision(model_file: str, params_file: str,
+                               mixed_model_file: str,
+                               mixed_params_file: str,
+                               mixed_precision=PrecisionType.Bfloat16,
+                               backend=PlaceType.TPU,
+                               keep_io_types: bool = True,
+                               black_list=None):
+    """Offline weight conversion (reference:
+    paddle/fluid/inference/analysis/passes/convert_to_mixed_precision.cc).
+    On TPU only the weights need converting; compute precision follows the
+    weights under XLA."""
+    from ..framework.io import load as fw_load, save as fw_save
+    from ..framework.tensor import Tensor as FTensor
+    prefix = Config._strip(model_file)
+    out_prefix = Config._strip(mixed_model_file)
+    src_params = (params_file if params_file.endswith(".pdiparams")
+                  else Config._strip(params_file) + ".pdiparams")
+    dst_params = (mixed_params_file
+                  if mixed_params_file.endswith(".pdiparams")
+                  else Config._strip(mixed_params_file) + ".pdiparams")
+    state = fw_load(src_params)
+
+    def cast(v):
+        t = v._data
+        if jnp.issubdtype(t.dtype, jnp.floating):
+            return FTensor(t.astype(jnp.bfloat16))
+        return v
+    state = {grp: {k: cast(v) for k, v in d.items()}
+             for grp, d in state.items()}
+    import shutil
+    if out_prefix != prefix:
+        shutil.copyfile(prefix + ".stablehlo.mlir",
+                        out_prefix + ".stablehlo.mlir")
+        shutil.copyfile(prefix + ".pdmeta", out_prefix + ".pdmeta")
+    fw_save(state, dst_params)

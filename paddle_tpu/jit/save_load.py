@@ -77,7 +77,8 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **configs):
     with open(path + ".pdmeta", "w") as f:
         json.dump({"input_specs": [
             {"shape": list(s.shape), "dtype": s.dtype
-             if isinstance(s.dtype, str) else s.dtype.name}
+             if isinstance(s.dtype, str) else s.dtype.name,
+             "name": getattr(s, "name", None)}
             for s in specs]}, f)
 
 
@@ -106,10 +107,16 @@ class TranslatedLayer:
                            "load parameters with paddle_tpu.load instead")
 
 
-def load(path: str, **configs) -> TranslatedLayer:
-    with open(path + ".stablehlo.mlir", "rb") as f:
+def load_artifacts(prefix: str):
+    """Deserialize a jit.save'd model: (exported, params, buffers).
+    Shared by jit.load and inference.Predictor."""
+    with open(prefix + ".stablehlo.mlir", "rb") as f:
         exported = jax.export.deserialize(f.read())
-    state = fw_load(path + ".pdiparams")
+    state = fw_load(prefix + ".pdiparams")
     params = {k: v._data for k, v in state["params"].items()}
     buffers = {k: v._data for k, v in state["buffers"].items()}
-    return TranslatedLayer(exported, params, buffers)
+    return exported, params, buffers
+
+
+def load(path: str, **configs) -> TranslatedLayer:
+    return TranslatedLayer(*load_artifacts(path))

@@ -1,0 +1,98 @@
+"""Inference/export subsystem tests (reference test model:
+test/cpp/inference + python/paddle/inference API tests)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.jit import InputSpec
+
+
+class SmallNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.fc2 = nn.Linear(16, 4)
+
+    def forward(self, x):
+        return self.fc2(nn.functional.relu(self.fc1(x)))
+
+
+@pytest.fixture(scope="module")
+def saved_model(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("infer") / "model")
+    net = SmallNet()
+    paddle.jit.save(net, path,
+                    input_spec=[InputSpec([2, 8], "float32", name="x")])
+    x = np.random.RandomState(0).randn(2, 8).astype(np.float32)
+    net.eval()
+    ref = net(paddle.to_tensor(x)).numpy()
+    return path, x, ref
+
+
+def test_predictor_handles(saved_model):
+    from paddle_tpu import inference
+    path, x, ref = saved_model
+    config = inference.Config(path)
+    config.enable_memory_optim()
+    pred = inference.create_predictor(config)
+
+    names = pred.get_input_names()
+    assert names == ["x"]
+    h = pred.get_input_handle("x")
+    assert h.shape == [2, 8]
+    h.copy_from_cpu(x)
+    pred.run()
+    out = pred.get_output_handle(pred.get_output_names()[0])
+    np.testing.assert_allclose(out.copy_to_cpu(), ref, rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_run_convenience_and_clone(saved_model):
+    from paddle_tpu import inference
+    path, x, ref = saved_model
+    pred = inference.create_predictor(inference.Config(path))
+    outs = pred.run([x])
+    np.testing.assert_allclose(outs[0].copy_to_cpu(), ref,
+                               rtol=1e-5, atol=1e-5)
+    # clone shares weights/compilation but has its own handles
+    c = pred.clone()
+    c.get_input_handle("x").copy_from_cpu(x * 0)
+    c.run()
+    assert not np.allclose(
+        c.get_output_handle("output_0").copy_to_cpu(), ref)
+    # original handles untouched
+    np.testing.assert_allclose(
+        pred.get_output_handle("output_0").copy_to_cpu(), ref,
+        rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_bf16(saved_model):
+    from paddle_tpu import inference
+    path, x, ref = saved_model
+    config = inference.Config(path)
+    config.set_precision(inference.PrecisionType.Bfloat16)
+    pred = inference.create_predictor(config)
+    outs = pred.run([x])
+    np.testing.assert_allclose(outs[0].copy_to_cpu().astype(np.float32),
+                               ref, rtol=5e-2, atol=5e-2)
+
+
+def test_shape_validation(saved_model):
+    from paddle_tpu import inference
+    path, x, ref = saved_model
+    pred = inference.create_predictor(inference.Config(path))
+    with pytest.raises(ValueError):
+        pred.get_input_handle("x").copy_from_cpu(np.zeros((3, 8), np.float32))
+
+
+def test_convert_to_mixed_precision(saved_model, tmp_path):
+    from paddle_tpu import inference
+    path, x, ref = saved_model
+    out = str(tmp_path / "model_bf16")
+    inference.convert_to_mixed_precision(
+        path + ".stablehlo.mlir", path + ".pdiparams",
+        out + ".stablehlo.mlir", out + ".pdiparams")
+    pred = inference.create_predictor(inference.Config(out))
+    outs = pred.run([x])
+    np.testing.assert_allclose(outs[0].copy_to_cpu().astype(np.float32),
+                               ref, rtol=5e-2, atol=5e-2)
