@@ -1,0 +1,147 @@
+"""DenseNet (reference: python/paddle/vision/models/densenet.py —
+densenet121/161/169/201/264)."""
+from __future__ import annotations
+
+from ... import ops
+from ...nn import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D,
+                   Dropout, Layer, LayerList, Linear, MaxPool2D, ReLU,
+                   Sequential)
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201", "densenet264"]
+
+_CFG = {121: (64, 32, [6, 12, 24, 16]),
+        161: (96, 48, [6, 12, 36, 24]),
+        169: (64, 32, [6, 12, 32, 32]),
+        201: (64, 32, [6, 12, 48, 32]),
+        264: (64, 32, [6, 12, 64, 48])}
+
+
+class _DenseLayer(Layer):
+    def __init__(self, num_input_features, growth_rate, bn_size, dropout):
+        super().__init__()
+        self.norm1 = BatchNorm2D(num_input_features)
+        self.relu = ReLU()
+        self.conv1 = Conv2D(num_input_features, bn_size * growth_rate, 1,
+                            bias_attr=False)
+        self.norm2 = BatchNorm2D(bn_size * growth_rate)
+        self.conv2 = Conv2D(bn_size * growth_rate, growth_rate, 3,
+                            padding=1, bias_attr=False)
+        self.dropout = Dropout(dropout) if dropout else None
+
+    def forward(self, x):
+        out = self.conv1(self.relu(self.norm1(x)))
+        out = self.conv2(self.relu(self.norm2(out)))
+        if self.dropout is not None:
+            out = self.dropout(out)
+        return ops.concat([x, out], axis=1)
+
+
+class _DenseBlock(Layer):
+    def __init__(self, num_layers, num_input_features, bn_size, growth_rate,
+                 dropout):
+        super().__init__()
+        self.layers = LayerList([
+            _DenseLayer(num_input_features + i * growth_rate, growth_rate,
+                        bn_size, dropout) for i in range(num_layers)])
+
+    def forward(self, x):
+        for layer in self.layers:
+            x = layer(x)
+        return x
+
+
+class _Transition(Layer):
+    def __init__(self, num_input_features, num_output_features):
+        super().__init__()
+        self.norm = BatchNorm2D(num_input_features)
+        self.relu = ReLU()
+        self.conv = Conv2D(num_input_features, num_output_features, 1,
+                           bias_attr=False)
+        self.pool = AvgPool2D(2, stride=2)
+
+    def forward(self, x):
+        return self.pool(self.conv(self.relu(self.norm(x))))
+
+
+class DenseNet(Layer):
+    def __init__(self, layers=121, bn_size=4, dropout=0.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        num_init_features, growth_rate, block_config = _CFG[layers]
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.conv0 = Sequential(
+            Conv2D(3, num_init_features, 7, stride=2, padding=3,
+                   bias_attr=False),
+            BatchNorm2D(num_init_features), ReLU(),
+            MaxPool2D(3, stride=2, padding=1))
+        blocks = []
+        num_features = num_init_features
+        for i, num_layers in enumerate(block_config):
+            blocks.append(_DenseBlock(num_layers, num_features, bn_size,
+                                      growth_rate, dropout))
+            num_features += num_layers * growth_rate
+            if i != len(block_config) - 1:
+                blocks.append(_Transition(num_features, num_features // 2))
+                num_features //= 2
+        self.blocks = Sequential(*blocks)
+        self.norm5 = BatchNorm2D(num_features)
+        self.relu = ReLU()
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Linear(num_features, num_classes)
+
+    def forward(self, x):
+        x = self.relu(self.norm5(self.blocks(self.conv0(x))))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+def _densenet(layers, pretrained=False, **kwargs):
+    return DenseNet(layers=layers, **kwargs)
+
+
+def densenet121(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights unavailable (no network access); load a "
+            "state dict via set_state_dict")
+    return _densenet(121, pretrained, **kwargs)
+
+
+def densenet161(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights unavailable (no network access); load a "
+            "state dict via set_state_dict")
+    return _densenet(161, pretrained, **kwargs)
+
+
+def densenet169(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights unavailable (no network access); load a "
+            "state dict via set_state_dict")
+    return _densenet(169, pretrained, **kwargs)
+
+
+def densenet201(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights unavailable (no network access); load a "
+            "state dict via set_state_dict")
+    return _densenet(201, pretrained, **kwargs)
+
+
+def densenet264(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights unavailable (no network access); load a "
+            "state dict via set_state_dict")
+    return _densenet(264, pretrained, **kwargs)

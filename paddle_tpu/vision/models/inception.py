@@ -1,0 +1,234 @@
+"""GoogLeNet + InceptionV3 (reference:
+python/paddle/vision/models/googlenet.py, inceptionv3.py)."""
+from __future__ import annotations
+
+from ... import ops
+from ...nn import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D,
+                   Dropout, Layer, Linear, MaxPool2D, ReLU, Sequential)
+
+__all__ = ["GoogLeNet", "googlenet", "InceptionV3", "inception_v3"]
+
+
+class ConvBN(Layer):
+    def __init__(self, in_c, out_c, kernel, stride=1, padding=0):
+        super().__init__()
+        self.conv = Conv2D(in_c, out_c, kernel, stride=stride,
+                           padding=padding, bias_attr=False)
+        self.bn = BatchNorm2D(out_c)
+        self.relu = ReLU()
+
+    def forward(self, x):
+        return self.relu(self.bn(self.conv(x)))
+
+
+class _GoogInception(Layer):
+    """GoogLeNet inception block: 1x1 / 3x3 / 5x5 / pool-proj branches."""
+
+    def __init__(self, in_c, c1, c3r, c3, c5r, c5, proj):
+        super().__init__()
+        self.b1 = ConvBN(in_c, c1, 1)
+        self.b2 = Sequential(ConvBN(in_c, c3r, 1), ConvBN(c3r, c3, 3,
+                                                          padding=1))
+        self.b3 = Sequential(ConvBN(in_c, c5r, 1), ConvBN(c5r, c5, 5,
+                                                          padding=2))
+        self.b4 = Sequential(MaxPool2D(3, stride=1, padding=1),
+                             ConvBN(in_c, proj, 1))
+
+    def forward(self, x):
+        return ops.concat([self.b1(x), self.b2(x), self.b3(x), self.b4(x)],
+                          axis=1)
+
+
+class GoogLeNet(Layer):
+    """Returns (main, aux1, aux2) logits in train mode like the reference."""
+
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            ConvBN(3, 64, 7, stride=2, padding=3),
+            MaxPool2D(3, stride=2, padding=1),
+            ConvBN(64, 64, 1), ConvBN(64, 192, 3, padding=1),
+            MaxPool2D(3, stride=2, padding=1))
+        self.inc3a = _GoogInception(192, 64, 96, 128, 16, 32, 32)
+        self.inc3b = _GoogInception(256, 128, 128, 192, 32, 96, 64)
+        self.pool3 = MaxPool2D(3, stride=2, padding=1)
+        self.inc4a = _GoogInception(480, 192, 96, 208, 16, 48, 64)
+        self.inc4b = _GoogInception(512, 160, 112, 224, 24, 64, 64)
+        self.inc4c = _GoogInception(512, 128, 128, 256, 24, 64, 64)
+        self.inc4d = _GoogInception(512, 112, 144, 288, 32, 64, 64)
+        self.inc4e = _GoogInception(528, 256, 160, 320, 32, 128, 128)
+        self.pool4 = MaxPool2D(3, stride=2, padding=1)
+        self.inc5a = _GoogInception(832, 256, 160, 320, 32, 128, 128)
+        self.inc5b = _GoogInception(832, 384, 192, 384, 48, 128, 128)
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = Dropout(0.4)
+            self.fc = Linear(1024, num_classes)
+            # aux classifiers (train-time deep supervision)
+            self.aux1 = Sequential(AdaptiveAvgPool2D(4), ConvBN(512, 128, 1))
+            self.aux1_fc = Sequential(Linear(2048, 1024), ReLU(),
+                                      Dropout(0.7), Linear(1024, num_classes))
+            self.aux2 = Sequential(AdaptiveAvgPool2D(4), ConvBN(528, 128, 1))
+            self.aux2_fc = Sequential(Linear(2048, 1024), ReLU(),
+                                      Dropout(0.7), Linear(1024, num_classes))
+
+    def forward(self, x):
+        x = self.stem(x)
+        x = self.pool3(self.inc3b(self.inc3a(x)))
+        x = self.inc4a(x)
+        aux1_in = x
+        x = self.inc4d(self.inc4c(self.inc4b(x)))
+        aux2_in = x
+        x = self.pool4(self.inc4e(x))
+        x = self.inc5b(self.inc5a(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            main = self.fc(self.dropout(x.flatten(1)))
+            if self.training:
+                a1 = self.aux1_fc(self.aux1(aux1_in).flatten(1))
+                a2 = self.aux2_fc(self.aux2(aux2_in).flatten(1))
+                return main, a1, a2
+            return main
+        return x
+
+
+# ---------------- InceptionV3 ----------------
+
+class _InceptionA(Layer):
+    def __init__(self, in_c, pool_features):
+        super().__init__()
+        self.b1 = ConvBN(in_c, 64, 1)
+        self.b5 = Sequential(ConvBN(in_c, 48, 1),
+                             ConvBN(48, 64, 5, padding=2))
+        self.b3 = Sequential(ConvBN(in_c, 64, 1),
+                             ConvBN(64, 96, 3, padding=1),
+                             ConvBN(96, 96, 3, padding=1))
+        self.bp = Sequential(AvgPool2D(3, stride=1, padding=1),
+                             ConvBN(in_c, pool_features, 1))
+
+    def forward(self, x):
+        return ops.concat([self.b1(x), self.b5(x), self.b3(x), self.bp(x)],
+                          axis=1)
+
+
+class _ReductionA(Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = ConvBN(in_c, 384, 3, stride=2)
+        self.b3d = Sequential(ConvBN(in_c, 64, 1),
+                              ConvBN(64, 96, 3, padding=1),
+                              ConvBN(96, 96, 3, stride=2))
+        self.pool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return ops.concat([self.b3(x), self.b3d(x), self.pool(x)], axis=1)
+
+
+class _InceptionB(Layer):
+    def __init__(self, in_c, c7):
+        super().__init__()
+        self.b1 = ConvBN(in_c, 192, 1)
+        self.b7 = Sequential(ConvBN(in_c, c7, 1),
+                             ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+                             ConvBN(c7, 192, (7, 1), padding=(3, 0)))
+        self.b7d = Sequential(ConvBN(in_c, c7, 1),
+                              ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+                              ConvBN(c7, c7, (1, 7), padding=(0, 3)),
+                              ConvBN(c7, c7, (7, 1), padding=(3, 0)),
+                              ConvBN(c7, 192, (1, 7), padding=(0, 3)))
+        self.bp = Sequential(AvgPool2D(3, stride=1, padding=1),
+                             ConvBN(in_c, 192, 1))
+
+    def forward(self, x):
+        return ops.concat([self.b1(x), self.b7(x), self.b7d(x), self.bp(x)],
+                          axis=1)
+
+
+class _ReductionB(Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b3 = Sequential(ConvBN(in_c, 192, 1),
+                             ConvBN(192, 320, 3, stride=2))
+        self.b7 = Sequential(ConvBN(in_c, 192, 1),
+                             ConvBN(192, 192, (1, 7), padding=(0, 3)),
+                             ConvBN(192, 192, (7, 1), padding=(3, 0)),
+                             ConvBN(192, 192, 3, stride=2))
+        self.pool = MaxPool2D(3, stride=2)
+
+    def forward(self, x):
+        return ops.concat([self.b3(x), self.b7(x), self.pool(x)], axis=1)
+
+
+class _InceptionC(Layer):
+    def __init__(self, in_c):
+        super().__init__()
+        self.b1 = ConvBN(in_c, 320, 1)
+        self.b3_stem = ConvBN(in_c, 384, 1)
+        self.b3_a = ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3_b = ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.b3d_stem = Sequential(ConvBN(in_c, 448, 1),
+                                   ConvBN(448, 384, 3, padding=1))
+        self.b3d_a = ConvBN(384, 384, (1, 3), padding=(0, 1))
+        self.b3d_b = ConvBN(384, 384, (3, 1), padding=(1, 0))
+        self.bp = Sequential(AvgPool2D(3, stride=1, padding=1),
+                             ConvBN(in_c, 192, 1))
+
+    def forward(self, x):
+        s = self.b3_stem(x)
+        d = self.b3d_stem(x)
+        return ops.concat([
+            self.b1(x),
+            ops.concat([self.b3_a(s), self.b3_b(s)], axis=1),
+            ops.concat([self.b3d_a(d), self.b3d_b(d)], axis=1),
+            self.bp(x)], axis=1)
+
+
+class InceptionV3(Layer):
+    def __init__(self, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        self.stem = Sequential(
+            ConvBN(3, 32, 3, stride=2), ConvBN(32, 32, 3),
+            ConvBN(32, 64, 3, padding=1), MaxPool2D(3, stride=2),
+            ConvBN(64, 80, 1), ConvBN(80, 192, 3), MaxPool2D(3, stride=2))
+        self.blocks = Sequential(
+            _InceptionA(192, 32), _InceptionA(256, 64), _InceptionA(288, 64),
+            _ReductionA(288),
+            _InceptionB(768, 128), _InceptionB(768, 160),
+            _InceptionB(768, 160), _InceptionB(768, 192),
+            _ReductionB(768),
+            _InceptionC(1280), _InceptionC(2048))
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.dropout = Dropout(0.5)
+            self.fc = Linear(2048, num_classes)
+
+    def forward(self, x):
+        x = self.blocks(self.stem(x))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = self.fc(self.dropout(x.flatten(1)))
+        return x
+
+
+def googlenet(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights unavailable (no network access); load a "
+            "state dict via set_state_dict")
+    return GoogLeNet(**kwargs)
+
+
+def inception_v3(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights unavailable (no network access); load a "
+            "state dict via set_state_dict")
+    return InceptionV3(**kwargs)

@@ -1,0 +1,144 @@
+"""MobileNetV3 (reference: python/paddle/vision/models/mobilenetv3.py —
+MobileNetV3Small/Large, SE blocks, hardswish activations)."""
+from __future__ import annotations
+
+from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Dropout,
+                   Hardsigmoid, Hardswish, Layer, Linear, ReLU, Sequential)
+
+__all__ = ["MobileNetV3Small", "MobileNetV3Large", "mobilenet_v3_small",
+           "mobilenet_v3_large"]
+
+
+def _make_divisible(v, divisor=8, min_value=None):
+    min_value = min_value or divisor
+    new_v = max(min_value, int(v + divisor / 2) // divisor * divisor)
+    if new_v < 0.9 * v:
+        new_v += divisor
+    return new_v
+
+
+class ConvBNActivation(Sequential):
+    def __init__(self, in_c, out_c, kernel, stride=1, groups=1,
+                 activation=Hardswish):
+        padding = (kernel - 1) // 2
+        layers = [Conv2D(in_c, out_c, kernel, stride=stride, padding=padding,
+                         groups=groups, bias_attr=False),
+                  BatchNorm2D(out_c)]
+        if activation is not None:
+            layers.append(activation())
+        super().__init__(*layers)
+
+
+class SqueezeExcitation(Layer):
+    def __init__(self, input_c, squeeze_c):
+        super().__init__()
+        self.avgpool = AdaptiveAvgPool2D(1)
+        self.fc1 = Conv2D(input_c, squeeze_c, 1)
+        self.relu = ReLU()
+        self.fc2 = Conv2D(squeeze_c, input_c, 1)
+        self.hsigmoid = Hardsigmoid()
+
+    def forward(self, x):
+        s = self.hsigmoid(self.fc2(self.relu(self.fc1(self.avgpool(x)))))
+        return x * s
+
+
+class InvertedResidual(Layer):
+    def __init__(self, in_c, exp_c, out_c, kernel, stride, use_se, use_hs):
+        super().__init__()
+        self.use_res = stride == 1 and in_c == out_c
+        act = Hardswish if use_hs else ReLU
+        layers = []
+        if exp_c != in_c:
+            layers.append(ConvBNActivation(in_c, exp_c, 1, activation=act))
+        layers.append(ConvBNActivation(exp_c, exp_c, kernel, stride=stride,
+                                       groups=exp_c, activation=act))
+        if use_se:
+            layers.append(SqueezeExcitation(exp_c,
+                                            _make_divisible(exp_c // 4)))
+        layers.append(ConvBNActivation(exp_c, out_c, 1, activation=None))
+        self.block = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.block(x)
+        return x + out if self.use_res else out
+
+
+# (kernel, exp, out, use_se, use_hs, stride) per reference config
+_LARGE_CFG = [
+    (3, 16, 16, False, False, 1), (3, 64, 24, False, False, 2),
+    (3, 72, 24, False, False, 1), (5, 72, 40, True, False, 2),
+    (5, 120, 40, True, False, 1), (5, 120, 40, True, False, 1),
+    (3, 240, 80, False, True, 2), (3, 200, 80, False, True, 1),
+    (3, 184, 80, False, True, 1), (3, 184, 80, False, True, 1),
+    (3, 480, 112, True, True, 1), (3, 672, 112, True, True, 1),
+    (5, 672, 160, True, True, 2), (5, 960, 160, True, True, 1),
+    (5, 960, 160, True, True, 1)]
+_SMALL_CFG = [
+    (3, 16, 16, True, False, 2), (3, 72, 24, False, False, 2),
+    (3, 88, 24, False, False, 1), (5, 96, 40, True, True, 2),
+    (5, 240, 40, True, True, 1), (5, 240, 40, True, True, 1),
+    (5, 120, 48, True, True, 1), (5, 144, 48, True, True, 1),
+    (5, 288, 96, True, True, 2), (5, 576, 96, True, True, 1),
+    (5, 576, 96, True, True, 1)]
+
+
+class _MobileNetV3(Layer):
+    def __init__(self, cfg, last_channel, scale=1.0, num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        in_c = _make_divisible(16 * scale)
+        layers = [ConvBNActivation(3, in_c, 3, stride=2)]
+        for k, exp, out, se, hs, s in cfg:
+            exp_c = _make_divisible(exp * scale)
+            out_c = _make_divisible(out * scale)
+            layers.append(InvertedResidual(in_c, exp_c, out_c, k, s, se, hs))
+            in_c = out_c
+        last_conv = _make_divisible(6 * in_c)
+        layers.append(ConvBNActivation(in_c, last_conv, 1))
+        self.features = Sequential(*layers)
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Linear(last_conv, last_channel), Hardswish(),
+                Dropout(0.2), Linear(last_channel, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+class MobileNetV3Large(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_LARGE_CFG, _make_divisible(1280 * scale),
+                         scale, num_classes, with_pool)
+
+
+class MobileNetV3Small(_MobileNetV3):
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__(_SMALL_CFG, _make_divisible(1024 * scale),
+                         scale, num_classes, with_pool)
+
+
+def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights unavailable (no network access); load a "
+            "state dict via set_state_dict")
+    return MobileNetV3Small(scale=scale, **kwargs)
+
+
+def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights unavailable (no network access); load a "
+            "state dict via set_state_dict")
+    return MobileNetV3Large(scale=scale, **kwargs)

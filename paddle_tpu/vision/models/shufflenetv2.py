@@ -1,0 +1,157 @@
+"""ShuffleNetV2 (reference: python/paddle/vision/models/shufflenetv2.py —
+x0_25..x2_0 + swish variant; channel-shuffle via reshape/transpose, which XLA
+lowers to a pure layout change)."""
+from __future__ import annotations
+
+from ... import ops
+from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Layer, Linear,
+                   MaxPool2D, ReLU, Sequential, Swish)
+
+__all__ = ["ShuffleNetV2", "shufflenet_v2_x0_25", "shufflenet_v2_x0_33",
+           "shufflenet_v2_x0_5", "shufflenet_v2_x1_0", "shufflenet_v2_x1_5",
+           "shufflenet_v2_x2_0", "shufflenet_v2_swish"]
+
+
+def channel_shuffle(x, groups):
+    n, c, h, w = x.shape
+    x = x.reshape([n, groups, c // groups, h, w])
+    x = x.transpose([0, 2, 1, 3, 4])
+    return x.reshape([n, c, h, w])
+
+
+def _conv_bn_act(in_c, out_c, kernel, stride, groups=1, act=ReLU):
+    layers = [Conv2D(in_c, out_c, kernel, stride=stride,
+                     padding=(kernel - 1) // 2, groups=groups,
+                     bias_attr=False), BatchNorm2D(out_c)]
+    if act is not None:
+        layers.append(act())
+    return Sequential(*layers)
+
+
+class InvertedResidual(Layer):
+    def __init__(self, in_c, out_c, stride, act=ReLU):
+        super().__init__()
+        self.stride = stride
+        branch_c = out_c // 2
+        if stride == 1:
+            self.branch2 = Sequential(
+                _conv_bn_act(branch_c, branch_c, 1, 1, act=act),
+                _conv_bn_act(branch_c, branch_c, 3, 1, groups=branch_c,
+                             act=None),
+                _conv_bn_act(branch_c, branch_c, 1, 1, act=act))
+        else:
+            self.branch1 = Sequential(
+                _conv_bn_act(in_c, in_c, 3, stride, groups=in_c, act=None),
+                _conv_bn_act(in_c, branch_c, 1, 1, act=act))
+            self.branch2 = Sequential(
+                _conv_bn_act(in_c, branch_c, 1, 1, act=act),
+                _conv_bn_act(branch_c, branch_c, 3, stride, groups=branch_c,
+                             act=None),
+                _conv_bn_act(branch_c, branch_c, 1, 1, act=act))
+
+    def forward(self, x):
+        if self.stride == 1:
+            x1, x2 = ops.chunk(x, 2, axis=1)
+            out = ops.concat([x1, self.branch2(x2)], axis=1)
+        else:
+            out = ops.concat([self.branch1(x), self.branch2(x)], axis=1)
+        return channel_shuffle(out, 2)
+
+
+_STAGE_REPEATS = [4, 8, 4]
+_STAGE_OUT = {
+    0.25: [24, 24, 48, 96, 512], 0.33: [24, 32, 64, 128, 512],
+    0.5: [24, 48, 96, 192, 1024], 1.0: [24, 116, 232, 464, 1024],
+    1.5: [24, 176, 352, 704, 1024], 2.0: [24, 244, 488, 976, 2048]}
+
+
+class ShuffleNetV2(Layer):
+    def __init__(self, scale=1.0, act="relu", num_classes=1000,
+                 with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        act_layer = Swish if act == "swish" else ReLU
+        stage_out = _STAGE_OUT[scale]
+        self.conv1 = _conv_bn_act(3, stage_out[0], 3, 2, act=act_layer)
+        self.max_pool = MaxPool2D(3, stride=2, padding=1)
+        stages = []
+        in_c = stage_out[0]
+        for stage_i, repeats in enumerate(_STAGE_REPEATS):
+            out_c = stage_out[stage_i + 1]
+            stages.append(InvertedResidual(in_c, out_c, 2, act_layer))
+            for _ in range(repeats - 1):
+                stages.append(InvertedResidual(out_c, out_c, 1, act_layer))
+            in_c = out_c
+        self.stages = Sequential(*stages)
+        self.conv_last = _conv_bn_act(in_c, stage_out[-1], 1, 1,
+                                      act=act_layer)
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(stage_out[-1], num_classes)
+
+    def forward(self, x):
+        x = self.conv_last(self.stages(self.max_pool(self.conv1(x))))
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights unavailable (no network access); load a "
+            "state dict via set_state_dict")
+    return ShuffleNetV2(scale=0.25, **kwargs)
+
+
+def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights unavailable (no network access); load a "
+            "state dict via set_state_dict")
+    return ShuffleNetV2(scale=0.33, **kwargs)
+
+
+def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights unavailable (no network access); load a "
+            "state dict via set_state_dict")
+    return ShuffleNetV2(scale=0.5, **kwargs)
+
+
+def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights unavailable (no network access); load a "
+            "state dict via set_state_dict")
+    return ShuffleNetV2(scale=1.0, **kwargs)
+
+
+def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights unavailable (no network access); load a "
+            "state dict via set_state_dict")
+    return ShuffleNetV2(scale=1.5, **kwargs)
+
+
+def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights unavailable (no network access); load a "
+            "state dict via set_state_dict")
+    return ShuffleNetV2(scale=2.0, **kwargs)
+
+
+def shufflenet_v2_swish(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights unavailable (no network access); load a "
+            "state dict via set_state_dict")
+    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)

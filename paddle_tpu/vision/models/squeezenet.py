@@ -1,0 +1,86 @@
+"""SqueezeNet (reference: python/paddle/vision/models/squeezenet.py —
+squeezenet1_0/1_1 with Fire modules)."""
+from __future__ import annotations
+
+from ... import ops
+from ...nn import (AdaptiveAvgPool2D, Conv2D, Dropout, Layer, MaxPool2D,
+                   ReLU, Sequential)
+
+__all__ = ["SqueezeNet", "squeezenet1_0", "squeezenet1_1"]
+
+
+class Fire(Layer):
+    def __init__(self, inplanes, squeeze_planes, expand1x1_planes,
+                 expand3x3_planes):
+        super().__init__()
+        self.squeeze = Conv2D(inplanes, squeeze_planes, 1)
+        self.relu = ReLU()
+        self.expand1x1 = Conv2D(squeeze_planes, expand1x1_planes, 1)
+        self.expand3x3 = Conv2D(squeeze_planes, expand3x3_planes, 3,
+                                padding=1)
+
+    def forward(self, x):
+        x = self.relu(self.squeeze(x))
+        return ops.concat([self.relu(self.expand1x1(x)),
+                           self.relu(self.expand3x3(x))], axis=1)
+
+
+class SqueezeNet(Layer):
+    def __init__(self, version="1.0", num_classes=1000, with_pool=True):
+        super().__init__()
+        self.version = version
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        if version == "1.0":
+            self.features = Sequential(
+                Conv2D(3, 96, 7, stride=2), ReLU(),
+                MaxPool2D(3, stride=2),
+                Fire(96, 16, 64, 64), Fire(128, 16, 64, 64),
+                Fire(128, 32, 128, 128),
+                MaxPool2D(3, stride=2),
+                Fire(256, 32, 128, 128), Fire(256, 48, 192, 192),
+                Fire(384, 48, 192, 192), Fire(384, 64, 256, 256),
+                MaxPool2D(3, stride=2),
+                Fire(512, 64, 256, 256))
+        else:
+            self.features = Sequential(
+                Conv2D(3, 64, 3, stride=2), ReLU(),
+                MaxPool2D(3, stride=2),
+                Fire(64, 16, 64, 64), Fire(128, 16, 64, 64),
+                MaxPool2D(3, stride=2),
+                Fire(128, 32, 128, 128), Fire(256, 32, 128, 128),
+                MaxPool2D(3, stride=2),
+                Fire(256, 48, 192, 192), Fire(384, 48, 192, 192),
+                Fire(384, 64, 256, 256), Fire(512, 64, 256, 256))
+        if num_classes > 0:
+            self.classifier = Sequential(
+                Dropout(0.5),
+                Conv2D(512, num_classes, 1), ReLU())
+        if with_pool:
+            self.avgpool = AdaptiveAvgPool2D(1)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.num_classes > 0:
+            x = self.classifier(x)
+        if self.with_pool:
+            x = self.avgpool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+        return x
+
+
+def squeezenet1_0(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights unavailable (no network access); load a "
+            "state dict via set_state_dict")
+    return SqueezeNet(version="1.0", **kwargs)
+
+
+def squeezenet1_1(pretrained=False, **kwargs):
+    if pretrained:
+        raise NotImplementedError(
+            "pretrained weights unavailable (no network access); load a "
+            "state dict via set_state_dict")
+    return SqueezeNet(version="1.1", **kwargs)

@@ -1,0 +1,63 @@
+"""Vision model zoo forward tests (reference:
+test/legacy_test/test_vision_models.py pattern — build, forward, check
+logits shape)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import models
+
+
+def _run(model, size=64, num_classes=10, channels=3):
+    model.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(1, channels, size, size)
+        .astype(np.float32))
+    out = model(x)
+    assert tuple(out.shape) == (1, num_classes)
+    assert np.isfinite(out.numpy()).all()
+
+
+def test_mobilenet_v3():
+    _run(models.mobilenet_v3_small(num_classes=10))
+    _run(models.mobilenet_v3_large(num_classes=10))
+
+
+def test_mobilenet_v3_scaled():
+    _run(models.mobilenet_v3_small(scale=0.5, num_classes=10))
+
+
+def test_densenet121():
+    _run(models.densenet121(num_classes=10))
+
+
+def test_squeezenet():
+    _run(models.squeezenet1_0(num_classes=10), size=96)
+    _run(models.squeezenet1_1(num_classes=10), size=96)
+
+
+def test_shufflenet():
+    _run(models.shufflenet_v2_x0_25(num_classes=10))
+    _run(models.shufflenet_v2_swish(num_classes=10))
+
+
+def test_googlenet_eval_and_train():
+    m = models.googlenet(num_classes=10)
+    _run(m, size=96)
+    m.train()
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(1, 3, 96, 96).astype(np.float32))
+    main, a1, a2 = m(x)
+    assert tuple(main.shape) == tuple(a1.shape) == tuple(a2.shape) == (1, 10)
+
+
+def test_inception_v3():
+    _run(models.inception_v3(num_classes=10), size=96)
+
+
+def test_with_pool_false_feature_extractor():
+    m = models.mobilenet_v3_small(num_classes=0, with_pool=False)
+    m.eval()
+    x = paddle.to_tensor(np.zeros((1, 3, 64, 64), np.float32))
+    out = m(x)
+    assert len(out.shape) == 4  # feature map, no head
