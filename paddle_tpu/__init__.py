@@ -35,6 +35,7 @@ from . import distribution  # noqa: F401
 from . import incubate  # noqa: F401
 from . import distributed  # noqa: F401
 from . import static  # noqa: F401
+from . import sparse  # noqa: F401
 from . import inference  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .framework.io import save, load  # noqa: F401

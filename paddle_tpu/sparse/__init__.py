@@ -1,0 +1,32 @@
+"""paddle_tpu.sparse — COO/CSR sparse tensors + ops + nn (reference:
+/root/reference/python/paddle/sparse/__init__.py)."""
+from .tensor import SparseCooTensor, SparseCsrTensor, is_sparse  # noqa: F401
+from .creation import (  # noqa: F401
+    sparse_coo_tensor, sparse_csr_tensor, to_sparse_coo, to_sparse_csr)
+from .ops import (  # noqa: F401
+    abs, add, addmm, asin, asinh, atan, atanh, cast, coalesce, deg2rad,
+    divide, expm1, is_same_shape, isnan, leaky_relu, log1p, mask_as,
+    masked_matmul, matmul, multiply, mv, neg, pow, rad2deg, relu, relu6,
+    reshape, sin, sinh, sqrt, square, subtract, sum, tan, tanh, transpose)
+from . import nn  # noqa: F401
+
+# Dense-Tensor conversion methods (paddle exposes these on Tensor:
+# /root/reference/python/paddle/sparse/creation.py + pybind eager_method)
+from ..framework.tensor import Tensor as _Tensor
+
+_Tensor.to_sparse_coo = lambda self, sparse_dim=None: to_sparse_coo(
+    self, sparse_dim if sparse_dim is not None else len(self.shape))
+_Tensor.to_sparse_csr = lambda self: to_sparse_csr(self)
+_Tensor.is_sparse_coo = lambda self: False
+_Tensor.is_sparse_csr = lambda self: False
+
+__all__ = [
+    "SparseCooTensor", "SparseCsrTensor", "is_sparse",
+    "sparse_coo_tensor", "sparse_csr_tensor",
+    "abs", "add", "addmm", "asin", "asinh", "atan", "atanh", "cast",
+    "coalesce", "deg2rad", "divide", "expm1", "is_same_shape", "isnan",
+    "leaky_relu", "log1p", "mask_as", "masked_matmul", "matmul",
+    "multiply", "mv", "neg", "pow", "rad2deg", "relu", "relu6", "reshape",
+    "sin", "sinh", "sqrt", "square", "subtract", "sum", "tan", "tanh",
+    "transpose", "nn",
+]

@@ -1,0 +1,82 @@
+"""Sparse tensor creation (reference:
+/root/reference/python/paddle/sparse/creation.py — sparse_coo_tensor:
+creation.py:54, sparse_csr_tensor:~160)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .tensor import SparseCooTensor, SparseCsrTensor
+
+
+def _infer_dense_shape(indices, values):
+    idx = np.asarray(indices)
+    vals_shape = values.shape if hasattr(values, "shape") else \
+        np.asarray(values).shape
+    sparse_shape = [int(idx[d].max()) + 1 if idx.shape[1] else 0
+                    for d in range(idx.shape[0])]
+    return tuple(sparse_shape) + tuple(int(s) for s in vals_shape[1:])
+
+
+def sparse_coo_tensor(indices, values, shape=None, dtype=None,
+                      place=None, stop_gradient=True):
+    """Build a COO tensor from [sparse_ndim, nnz] indices + values."""
+    idx = indices._data if isinstance(indices, Tensor) else \
+        jnp.asarray(indices)
+    if shape is None:
+        shape = _infer_dense_shape(np.asarray(idx), values)
+    t = SparseCooTensor(idx, values if not dtype
+                        else Tensor(jnp.asarray(
+                            values._data if isinstance(values, Tensor)
+                            else values)).astype(dtype),
+                        shape)
+    t.values().stop_gradient = stop_gradient
+    return t
+
+
+def sparse_csr_tensor(crows, cols, values, shape, dtype=None,
+                      place=None, stop_gradient=True):
+    """Build a CSR tensor from compressed rows / cols / values."""
+    t = SparseCsrTensor(crows, cols, values, shape)
+    if dtype is not None:
+        t = t.astype(dtype)
+    t.values().stop_gradient = stop_gradient
+    return t
+
+
+def _coo_to_csr(coo: SparseCooTensor) -> SparseCsrTensor:
+    if coo.sparse_ndim not in (2, 3):
+        raise ValueError("CSR needs 2-D or batched 3-D sparse dims")
+    idx = np.asarray(coo._indices)
+    shape = coo._shape
+    if coo.sparse_ndim == 2:
+        order = np.lexsort((idx[1], idx[0]))
+        rows, cols = idx[0][order], idx[1][order]
+        crows = np.zeros(shape[0] + 1, dtype=np.int32)
+        np.add.at(crows, rows + 1, 1)
+        crows = np.cumsum(crows).astype(np.int32)
+        vals = coo.values()
+        from ..framework.tensor import apply_op
+        ord_arr = jnp.asarray(order)
+        vals = apply_op(lambda v: v[ord_arr], vals, _op_name="coo_sort")
+        return SparseCsrTensor(crows, cols, vals, shape)
+    raise NotImplementedError("batched COO→CSR: convert per batch")
+
+
+def to_sparse_coo(dense: Tensor, sparse_dim: int) -> SparseCooTensor:
+    """Dense→COO. Nonzero pattern is computed on host (data-dependent
+    shape — outside jit by design, like the reference's dense_to_coo
+    kernel paddle/phi/kernels/sparse/sparse_utils_kernel.h)."""
+    arr = np.asarray(dense.numpy())
+    red = tuple(range(sparse_dim, arr.ndim))
+    mask = (arr != 0).any(axis=red) if red else (arr != 0)
+    idx = np.stack(np.nonzero(mask)).astype(np.int32)
+    from ..framework.tensor import apply_op
+    idx_t = tuple(jnp.asarray(i) for i in idx)
+    vals = apply_op(lambda d: d[idx_t], dense, _op_name="dense_to_coo")
+    return SparseCooTensor(idx, vals, arr.shape, coalesced=True)
+
+
+def to_sparse_csr(dense: Tensor) -> SparseCsrTensor:
+    return _coo_to_csr(to_sparse_coo(dense, 2))

@@ -1,0 +1,250 @@
+"""sparse.nn — layers over sparse tensors (reference:
+/root/reference/python/paddle/sparse/nn/__init__.py: ReLU/ReLU6/LeakyReLU/
+Softmax/BatchNorm/SyncBatchNorm/Conv2D/Conv3D/SubmConv2D/SubmConv3D/
+MaxPool3D).
+
+TPU-first notes:
+- activations/norm run on the dense ``values`` array only.
+- Softmax is a per-CSR-row segment softmax (segment-max/segment-sum) —
+  the reference's csr softmax kernel
+  (paddle/phi/kernels/sparse/gpu/softmax_kernel.cu) done with XLA segment
+  ops.
+- Sparse convs lower to dense XLA conv on ``to_dense()`` then re-mask:
+  on TPU the MXU makes the dense conv the *fast* path for the
+  point-cloud densities these layers target; gather-based sparse conv
+  would serialize. SubmConv re-masks to the input pattern, matching
+  submanifold semantics.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, apply_op
+from ..nn.layer_base import Layer
+from ..nn import functional as F_dense
+from . import ops as sp_ops
+from .tensor import SparseCooTensor, SparseCsrTensor
+from .creation import to_sparse_coo
+
+
+class ReLU(Layer):
+    def forward(self, x):
+        return sp_ops.relu(x)
+
+
+class ReLU6(Layer):
+    def forward(self, x):
+        return sp_ops.relu6(x)
+
+
+class LeakyReLU(Layer):
+    def __init__(self, negative_slope=0.01, name=None):
+        super().__init__()
+        self._slope = negative_slope
+
+    def forward(self, x):
+        return sp_ops.leaky_relu(x, negative_slope=self._slope)
+
+
+def softmax(x, axis=-1, name=None):
+    """Sparse softmax over the last dim of a CSR matrix (per-row over
+    stored values) or COO last-sparse-dim."""
+    if axis != -1:
+        raise NotImplementedError("sparse softmax: axis=-1 only")
+    csr = x if isinstance(x, SparseCsrTensor) else None
+    coo = x.to_sparse_coo() if csr is not None else x.coalesce()
+    rows = coo._indices[:-1]
+    sparse_shape = coo._shape[:coo.sparse_ndim - 1]
+    lin = jnp.zeros(coo.nnz(), dtype=jnp.int32)
+    for d, s in enumerate(sparse_shape):
+        lin = lin * s + rows[d]
+    n_seg = 1
+    for s in sparse_shape:
+        n_seg *= s
+
+    def f(vals):
+        mx = jax.ops.segment_max(vals, lin, num_segments=n_seg)
+        e = jnp.exp(vals - mx[lin])
+        z = jax.ops.segment_sum(e, lin, num_segments=n_seg)
+        return e / z[lin]
+
+    out_vals = apply_op(f, coo.values(), _op_name="sparse_softmax")
+    out = SparseCooTensor(coo._indices, out_vals, coo._shape,
+                          coalesced=True)
+    return out.to_sparse_csr() if csr is not None else out
+
+
+class Softmax(Layer):
+    def __init__(self, axis=-1, name=None):
+        super().__init__()
+        self._axis = axis
+
+    def forward(self, x):
+        return softmax(x, self._axis)
+
+
+class BatchNorm(Layer):
+    """BatchNorm over the channel (last) dim of COO values — matches
+    paddle.sparse.nn.BatchNorm (NDHWC layout, norm over channels)."""
+
+    def __init__(self, num_features, momentum=0.9, epsilon=1e-5,
+                 weight_attr=None, bias_attr=None, data_format="NDHWC",
+                 name=None):
+        super().__init__()
+        from ..nn.layer.norm import BatchNorm1D
+        self._bn = BatchNorm1D(num_features, momentum=momentum,
+                               epsilon=epsilon)
+
+    def forward(self, x: SparseCooTensor):
+        vals = x.values()
+        out_vals = self._bn(vals)
+        return SparseCooTensor(x._indices, out_vals, x._shape,
+                               x._coalesced)
+
+
+class SyncBatchNorm(BatchNorm):
+    """On TPU, batch stats under pjit are already global (XLA reduces over
+    the sharded batch axis) so Sync==local BatchNorm by construction."""
+
+
+def _dense_conv_nd(x: SparseCooTensor, weight, bias, stride, padding,
+                   dilation, groups, dims, subm):
+    dense = x.to_dense()
+    conv = F_dense.conv3d if dims == 3 else F_dense.conv2d
+    fmt = "NDHWC" if dims == 3 else "NHWC"
+    # conv WITHOUT bias so the output support stays the kernel-reachable
+    # set; bias is added to stored values only (implicit zeros stay zero,
+    # matching reference sparse-conv semantics).
+    out = conv(dense, weight, bias=None, stride=stride, padding=padding,
+               dilation=dilation, groups=groups, data_format=fmt)
+    if subm:
+        # submanifold: output support == input support — only valid when
+        # the conv preserves spatial dims (stride 1, 'same' padding)
+        if list(out.shape) != list(x._shape[:-1]) + [out.shape[-1]]:
+            raise ValueError(
+                f"SubmConv requires output spatial dims == input "
+                f"({x._shape[:-1]}), got {out.shape[:-1]}; use stride=1 "
+                f"and padding=kernel_size//2")
+        mask_idx = tuple(x._indices)
+        vals = apply_op(lambda o: o[mask_idx], out, _op_name="subm_mask")
+        if bias is not None:
+            vals = apply_op(lambda v, b: v + b, vals, bias,
+                            _op_name="subm_bias")
+        return SparseCooTensor(x._indices, vals,
+                               tuple(out.shape), coalesced=True)
+    res = to_sparse_coo(out, len(out.shape) - 1)
+    if bias is not None:
+        vals = apply_op(lambda v, b: v + b, res.values(), bias,
+                        _op_name="sparse_conv_bias")
+        res = SparseCooTensor(res._indices, vals, res._shape,
+                              coalesced=True)
+    return res
+
+
+class _SparseConvNd(Layer):
+    _dims = 3
+    _subm = False
+
+    def __init__(self, in_channels, out_channels, kernel_size, stride=1,
+                 padding=0, dilation=1, groups=1, padding_mode="zeros",
+                 weight_attr=None, bias_attr=None, data_format=None,
+                 key=None):
+        super().__init__()
+        import numpy as np
+        from ..nn import initializer as I
+        d = self._dims
+        ks = tuple(kernel_size) if isinstance(kernel_size, (list, tuple)) \
+            else (kernel_size,) * d
+        w_shape = [out_channels, in_channels // groups, *ks]
+        fan_in = (in_channels // groups) * int(np.prod(ks))
+        self.weight = self.create_parameter(
+            shape=w_shape, attr=weight_attr,
+            default_initializer=I.KaimingUniform(fan_in=fan_in))
+        if bias_attr is not False:
+            bound = 1.0 / np.sqrt(fan_in)
+            self.bias = self.create_parameter(
+                shape=[out_channels], attr=bias_attr, is_bias=True,
+                default_initializer=I.Uniform(-bound, bound))
+        else:
+            self.bias = None
+        self._cfg = (stride, padding, dilation, groups)
+
+    def forward(self, x):
+        stride, padding, dilation, groups = self._cfg
+        return _dense_conv_nd(x, self.weight, self.bias, stride, padding,
+                              dilation, groups, self._dims, self._subm)
+
+
+class Conv3D(_SparseConvNd):
+    _dims, _subm = 3, False
+
+
+class SubmConv3D(_SparseConvNd):
+    _dims, _subm = 3, True
+
+
+class Conv2D(_SparseConvNd):
+    _dims, _subm = 2, False
+
+
+class SubmConv2D(_SparseConvNd):
+    _dims, _subm = 2, True
+
+
+class MaxPool3D(Layer):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, return_mask=False, data_format="NDHWC",
+                 name=None):
+        super().__init__()
+        self._k, self._s, self._p = kernel_size, stride, padding
+
+    def forward(self, x: SparseCooTensor):
+        dense = x.to_dense()
+        # pool kernels are NCDHW; sparse layout is NDHWC — transpose around
+        ncdhw = apply_op(lambda a: jnp.transpose(a, (0, 4, 1, 2, 3)),
+                         dense, _op_name="to_ncdhw")
+        out = F_dense.max_pool3d(ncdhw, self._k, stride=self._s,
+                                 padding=self._p)
+        out = apply_op(lambda a: jnp.transpose(a, (0, 2, 3, 4, 1)), out,
+                       _op_name="to_ndhwc")
+        return to_sparse_coo(out, len(out.shape) - 1)
+
+
+def attention(query, key, value, sparse_mask, key_padding_mask=None,
+              attn_mask=None, name=None):
+    """Sparse (SDDMM + SpMM) attention: scores only at mask's pattern.
+    Reference: paddle/phi/kernels/sparse/gpu/fused_attention_kernel.cu."""
+    if key_padding_mask is not None or attn_mask is not None:
+        raise NotImplementedError(
+            "sparse attention: encode padding/attn masks into sparse_mask's "
+            "pattern instead")
+    import math
+    d = query.shape[-1]
+    scale = 1.0 / math.sqrt(d)
+    b, h = query.shape[0], query.shape[1]
+    outs = []
+    for bi in range(b):
+        for hi in range(h):
+            q = apply_op(lambda a: a[bi, hi] * scale, query,
+                         _op_name="slice_q")
+            k = apply_op(lambda a: a[bi, hi].T, key, _op_name="slice_k")
+            v = apply_op(lambda a: a[bi, hi], value, _op_name="slice_v")
+            scores = sp_ops.masked_matmul(q, k, sparse_mask)
+            probs = softmax(scores, axis=-1)
+            outs.append(sp_ops.matmul(probs, v))
+    import jax.numpy as _j
+
+    def stack(*arrs):
+        return _j.stack(arrs).reshape((b, h) + arrs[0].shape)
+
+    return apply_op(stack, *outs, _op_name="sparse_attn_stack")
+
+
+functional = type("functional", (), {
+    "relu": staticmethod(sp_ops.relu),
+    "relu6": staticmethod(sp_ops.relu6),
+    "leaky_relu": staticmethod(sp_ops.leaky_relu),
+    "softmax": staticmethod(softmax),
+    "attention": staticmethod(attention),
+})()

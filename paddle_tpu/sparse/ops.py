@@ -1,0 +1,229 @@
+"""Sparse unary/binary/matmul ops (reference:
+/root/reference/python/paddle/sparse/unary.py, binary.py, multiary.py).
+
+All ops lower to gathers, scatter-adds and segment reductions on the dense
+component arrays — the XLA-friendly formulation; there are no per-format
+hand kernels (the reference has ~100 under paddle/phi/kernels/sparse/).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, apply_op
+from .tensor import SparseCooTensor, SparseCsrTensor, is_sparse
+
+
+def _map_values(x, fn, name):
+    """Apply a zero-preserving elementwise fn to the values array."""
+    out_vals = apply_op(fn, x.values(), _op_name=name)
+    if isinstance(x, SparseCooTensor):
+        return SparseCooTensor(x._indices, out_vals, x._shape, x._coalesced)
+    return SparseCsrTensor(x._crows, x._cols, out_vals, x._shape)
+
+
+# -- unary (zero-preserving) ----------------------------------------------
+
+def _unary(name, fn):
+    def op(x, *args, **kwargs):
+        return _map_values(x, lambda v: fn(v, *args, **kwargs),
+                           f"sparse_{name}")
+    op.__name__ = name
+    return op
+
+
+sin = _unary("sin", jnp.sin)
+sinh = _unary("sinh", jnp.sinh)
+tan = _unary("tan", jnp.tan)
+tanh = _unary("tanh", jnp.tanh)
+asin = _unary("asin", jnp.arcsin)
+asinh = _unary("asinh", jnp.arcsinh)
+atan = _unary("atan", jnp.arctan)
+atanh = _unary("atanh", jnp.arctanh)
+sqrt = _unary("sqrt", jnp.sqrt)
+square = _unary("square", jnp.square)
+abs = _unary("abs", jnp.abs)
+neg = _unary("neg", jnp.negative)
+expm1 = _unary("expm1", jnp.expm1)
+log1p = _unary("log1p", jnp.log1p)
+relu = _unary("relu", lambda v: jnp.maximum(v, 0))
+relu6 = _unary("relu6", lambda v: jnp.clip(v, 0, 6))
+leaky_relu = _unary("leaky_relu",
+                    lambda v, negative_slope=0.01:
+                    jnp.where(v >= 0, v, v * negative_slope))
+deg2rad = _unary("deg2rad", jnp.deg2rad)
+rad2deg = _unary("rad2deg", jnp.rad2deg)
+pow = _unary("pow", lambda v, factor: jnp.power(v, factor))
+
+
+def cast(x, index_dtype=None, value_dtype=None):
+    """Cast values and/or index dtype. Note: without jax x64 mode int64
+    indices are stored as int32 (JAX platform constraint)."""
+    out = x.astype(value_dtype) if value_dtype is not None else x
+    if index_dtype is not None:
+        idt = jnp.dtype(str(index_dtype)) if not hasattr(
+            index_dtype, "name") else jnp.dtype(index_dtype.name)
+        if isinstance(out, SparseCooTensor):
+            out = SparseCooTensor(out._indices, out._values, out._shape,
+                                  coalesced=out._coalesced)
+            out._indices = out._indices.astype(idt)
+        else:
+            out = SparseCsrTensor(out._crows, out._cols, out._values,
+                                  out._shape)
+            out._crows = out._crows.astype(idt)
+            out._cols = out._cols.astype(idt)
+    return out
+
+
+def isnan(x):
+    return _map_values(x, jnp.isnan, "sparse_isnan")
+
+
+def coalesce(x):
+    return x.coalesce()
+
+
+def reshape(x, shape):
+    dense = x.to_dense()
+    out = apply_op(lambda d: d.reshape(shape), dense, _op_name="sp_reshape")
+    from .creation import to_sparse_coo
+    return to_sparse_coo(out, len(shape))
+
+
+def transpose(x, perm):
+    return x.transpose(perm)
+
+
+def sum(x, axis=None, dtype=None, keepdim=False):
+    vals = x.values()
+    if axis is None:
+        return apply_op(lambda v: v.sum(), vals, _op_name="sparse_sum")
+    dense = x.to_dense()
+    return apply_op(lambda d: d.sum(axis=axis, keepdims=keepdim), dense,
+                    _op_name="sparse_sum")
+
+
+# -- binary ----------------------------------------------------------------
+
+def is_same_shape(x, y) -> bool:
+    return list(x.shape) == list(y.shape)
+
+
+def _union_coo(x: SparseCooTensor, y: SparseCooTensor, combine, name):
+    """Elementwise op over the union pattern: concat indices, combine via
+    coalesce's segment-sum."""
+    cx, cy = x.coalesce(), y.coalesce()
+    idx = jnp.concatenate([cx._indices, cy._indices], axis=1)
+    vx, vy = cx.values(), cy.values()
+    vals = apply_op(lambda a, b: jnp.concatenate([a, combine(b)]), vx, vy,
+                    _op_name=name)
+    return SparseCooTensor(idx, vals, x._shape).coalesce()
+
+
+def _to_coo(t) -> SparseCooTensor:
+    return t.to_sparse_coo() if isinstance(t, SparseCsrTensor) else t
+
+
+def add(x, y, name=None):
+    if is_sparse(x) and is_sparse(y):
+        out = _union_coo(_to_coo(x), _to_coo(y), lambda b: b, "sparse_add")
+        return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) \
+            else out
+    if is_sparse(x) and isinstance(y, Tensor):
+        return apply_op(lambda d, s: d + s, y, x.to_dense(),
+                        _op_name="sparse_dense_add")
+    raise TypeError("sparse.add expects sparse operands")
+
+
+def subtract(x, y, name=None):
+    out = _union_coo(_to_coo(x), _to_coo(y), lambda b: -b,
+                     "sparse_subtract")
+    return out.to_sparse_csr() if isinstance(x, SparseCsrTensor) else out
+
+
+def _intersect_dense(x, y, fn, name):
+    """Ops whose support is the intersection pattern — computed by
+    gathering both dense views at x's pattern (correct because the result
+    is zero wherever either operand is zero)."""
+    cx = x.coalesce() if isinstance(x, SparseCooTensor) else \
+        x.to_sparse_coo()
+    yd = y.to_dense() if is_sparse(y) else y
+    idx = tuple(cx._indices)
+    vals = apply_op(lambda v, d: fn(v, d[idx]), cx.values(), yd,
+                    _op_name=name)
+    out = SparseCooTensor(cx._indices, vals, cx._shape, coalesced=True)
+    if isinstance(x, SparseCsrTensor):
+        return out.to_sparse_csr()
+    return out
+
+
+def multiply(x, y, name=None):
+    return _intersect_dense(x, y, lambda v, d: v * d, "sparse_multiply")
+
+
+def divide(x, y, name=None):
+    return _intersect_dense(x, y, lambda v, d: v / d, "sparse_divide")
+
+
+def mask_as(x: Tensor, mask, name=None):
+    """Take dense ``x``'s entries at ``mask``'s sparsity pattern
+    (reference: paddle.sparse.mask_as)."""
+    cm = _to_coo(mask).coalesce()
+    idx = tuple(cm._indices)
+    vals = apply_op(lambda d: d[idx], x, _op_name="sparse_mask_as")
+    out = SparseCooTensor(cm._indices, vals, cm._shape, coalesced=True)
+    if isinstance(mask, SparseCsrTensor):
+        return out.to_sparse_csr()
+    return out
+
+
+# -- matmul ----------------------------------------------------------------
+
+def matmul(x, y, name=None):
+    """sparse @ dense → dense. COO formulation: gather dense rows at col
+    indices, scale by values, scatter-add into output rows — one fused
+    gather/scatter XLA graph (vs cuSPARSE SpMM in the reference,
+    paddle/phi/kernels/sparse/gpu/matmul_kernel.cu)."""
+    if not is_sparse(x):
+        raise TypeError("matmul expects sparse lhs")
+    coo = x if isinstance(x, SparseCooTensor) else x.to_sparse_coo()
+    coo = coo.coalesce()
+    if coo.sparse_ndim != 2:
+        raise NotImplementedError("sparse matmul: 2-D lhs only")
+    rows, cols = coo._indices[0], coo._indices[1]
+    n = coo._shape[0]
+    yd = y if isinstance(y, Tensor) else Tensor(jnp.asarray(y))
+
+    def f(vals, dense):
+        gathered = dense[cols] * vals[:, None]
+        out = jnp.zeros((n, dense.shape[1]), dtype=gathered.dtype)
+        return out.at[rows].add(gathered)
+
+    return apply_op(f, coo.values(), yd, _op_name="sparse_matmul")
+
+
+def mv(x, vec, name=None):
+    out = matmul(x, apply_op(lambda v: v[:, None], vec, _op_name="expand"))
+    return apply_op(lambda o: o[:, 0], out, _op_name="squeeze")
+
+
+def masked_matmul(x: Tensor, y: Tensor, mask, name=None):
+    """(x @ y) sampled at mask's pattern (SDDMM). Row/col gather + dot —
+    never materializes the dense product."""
+    coo = _to_coo(mask).coalesce()
+    rows, cols = coo._indices[0], coo._indices[1]
+
+    def f(a, b):
+        return (a[rows] * b[:, cols].T).sum(-1)
+
+    vals = apply_op(f, x, y, _op_name="masked_matmul")
+    out = SparseCooTensor(coo._indices, vals, coo._shape, coalesced=True)
+    if isinstance(mask, SparseCsrTensor):
+        return out.to_sparse_csr()
+    return out
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    prod = matmul(x, y)
+    dense_in = input.to_dense() if is_sparse(input) else input
+    return apply_op(lambda i, p: beta * i + alpha * p, dense_in, prod,
+                    _op_name="sparse_addmm")

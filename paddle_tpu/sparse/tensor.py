@@ -1,0 +1,263 @@
+"""Sparse tensor types, TPU-native.
+
+Reference capability: ``phi::SparseCooTensor`` / ``phi::SparseCsrTensor``
+(/root/reference/paddle/phi/core/sparse_coo_tensor.h,
+/root/reference/paddle/phi/core/sparse_csr_tensor.h) and the Python surface
+``paddle.sparse`` (/root/reference/python/paddle/sparse/__init__.py).
+
+TPU-first design: XLA has no native sparse formats, so sparse tensors here
+are *structs of dense arrays* — COO = (indices [ndim, nnz], values [nnz, ...]),
+CSR = (crows, cols, values) — and every op lowers to gather / scatter-add /
+segment reductions, which XLA tiles well. ``values`` is a framework
+``Tensor`` so autograd flows through sparse ops via the same vjp tape as
+dense ops (no separate sparse grad kernels, unlike the reference's
+``phi/kernels/sparse``).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor, apply_op
+
+
+def _as_tensor(x, dtype=None) -> Tensor:
+    if isinstance(x, Tensor):
+        return x.astype(dtype) if dtype is not None else x
+    return Tensor(jnp.asarray(x, dtype=dtype) if dtype is not None
+                  else jnp.asarray(x))
+
+
+def _as_index_array(x) -> jnp.ndarray:
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    return arr.astype(jnp.int32)
+
+
+class SparseCooTensor:
+    """COO sparse tensor: ``indices`` [sparse_ndim, nnz] + ``values``
+    [nnz, *dense_dims] + global ``shape``.
+
+    Mirrors the user contract of paddle's COO tensor
+    (``Tensor.is_sparse_coo()``, ``.indices()``, ``.values()``,
+    ``.to_dense()``); gradient support flows through ``values``.
+    """
+
+    is_sparse = True
+    format = "coo"
+
+    def __init__(self, indices, values, shape, coalesced: bool = False):
+        self._indices = _as_index_array(indices)
+        self._values = _as_tensor(values)
+        self._shape = tuple(int(s) for s in shape)
+        self._coalesced = coalesced
+        if self._indices.ndim != 2:
+            raise ValueError("indices must be [sparse_ndim, nnz]")
+        sparse_ndim = self._indices.shape[0]
+        dense_ndim = len(self._values.shape) - 1
+        if sparse_ndim + dense_ndim != len(self._shape):
+            raise ValueError(
+                f"sparse_ndim({sparse_ndim}) + dense_ndim({dense_ndim}) "
+                f"!= ndim({len(self._shape)})")
+
+    # -- metadata ---------------------------------------------------------
+    @property
+    def shape(self) -> List[int]:
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    @property
+    def stop_gradient(self):
+        return self._values.stop_gradient
+
+    @stop_gradient.setter
+    def stop_gradient(self, v):
+        self._values.stop_gradient = v
+
+    @property
+    def sparse_ndim(self) -> int:
+        return int(self._indices.shape[0])
+
+    def nnz(self) -> int:
+        return int(self._indices.shape[1])
+
+    def indices(self) -> Tensor:
+        return Tensor(self._indices)
+
+    def values(self) -> Tensor:
+        return self._values
+
+    def is_sparse_coo(self) -> bool:
+        return True
+
+    def is_sparse_csr(self) -> bool:
+        return False
+
+    def __repr__(self):
+        return (f"SparseCooTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    # -- conversions ------------------------------------------------------
+    def to_dense(self) -> Tensor:
+        idx = self._indices
+        shape = self._shape
+
+        def scatter(vals):
+            out = jnp.zeros(shape, dtype=vals.dtype)
+            return out.at[tuple(idx)].add(vals)
+
+        return apply_op(scatter, self._values, _op_name="sparse_to_dense")
+
+    def to_sparse_coo(self, sparse_dim=None) -> "SparseCooTensor":
+        return self
+
+    def to_sparse_csr(self) -> "SparseCsrTensor":
+        from .creation import _coo_to_csr
+        return _coo_to_csr(self.coalesce())
+
+    def coalesce(self) -> "SparseCooTensor":
+        """Sum duplicate coordinates (reference:
+        paddle/phi/kernels/sparse/coalesce_kernel.h). Segment-sum over a
+        linearized key — a TPU-friendly sorted reduction."""
+        if self._coalesced or self.nnz() == 0:
+            return self
+        idx = self._indices
+        # column-wise unique (lexicographic) — no index linearization, so
+        # no int32 overflow for large sparse shapes
+        uniq, inv = jnp.unique(idx, axis=1, return_inverse=True,
+                               size=idx.shape[1], fill_value=-1)
+        n_out = int((uniq[0] >= 0).sum())
+        new_idx = uniq[:, :n_out]
+
+        def seg(vals):
+            import jax
+            return jax.ops.segment_sum(vals, inv.reshape(-1),
+                                       num_segments=n_out)
+
+        new_vals = apply_op(seg, self._values, _op_name="sparse_coalesce")
+        return SparseCooTensor(new_idx, new_vals, self._shape,
+                               coalesced=True)
+
+    def transpose(self, perm) -> "SparseCooTensor":
+        perm = list(perm)
+        if sorted(perm) != list(range(self.sparse_ndim)):
+            raise NotImplementedError(
+                "sparse transpose supports sparse dims only")
+        new_idx = self._indices[jnp.asarray(perm)]
+        new_shape = tuple(self._shape[p] for p in perm) \
+            + self._shape[self.sparse_ndim:]
+        return SparseCooTensor(new_idx, self._values, new_shape)
+
+    def numpy(self) -> np.ndarray:
+        return self.to_dense().numpy()
+
+    def astype(self, dt) -> "SparseCooTensor":
+        return SparseCooTensor(self._indices, self._values.astype(dt),
+                               self._shape, self._coalesced)
+
+    def detach(self) -> "SparseCooTensor":
+        return SparseCooTensor(self._indices, self._values.detach(),
+                               self._shape, self._coalesced)
+
+
+class SparseCsrTensor:
+    """CSR sparse matrix (optionally batched): ``crows`` [(B,) nrows+1],
+    ``cols`` [(B,) nnz], ``values``.
+
+    Reference: /root/reference/paddle/phi/core/sparse_csr_tensor.h.
+    """
+
+    is_sparse = True
+    format = "csr"
+
+    def __init__(self, crows, cols, values, shape):
+        self._crows = _as_index_array(crows)
+        self._cols = _as_index_array(cols)
+        self._values = _as_tensor(values)
+        self._shape = tuple(int(s) for s in shape)
+        if len(self._shape) not in (2, 3):
+            raise ValueError("CSR supports 2-D or batched 3-D")
+
+    @property
+    def shape(self) -> List[int]:
+        return list(self._shape)
+
+    @property
+    def dtype(self):
+        return self._values.dtype
+
+    def nnz(self) -> int:
+        return int(self._cols.shape[-1])
+
+    def crows(self) -> Tensor:
+        return Tensor(self._crows)
+
+    def cols(self) -> Tensor:
+        return Tensor(self._cols)
+
+    def values(self) -> Tensor:
+        return self._values
+
+    def is_sparse_coo(self) -> bool:
+        return False
+
+    def is_sparse_csr(self) -> bool:
+        return True
+
+    def __repr__(self):
+        return (f"SparseCsrTensor(shape={self.shape}, nnz={self.nnz()}, "
+                f"dtype={self.dtype})")
+
+    def _rows(self) -> jnp.ndarray:
+        """Expand crows to a per-nnz row index (CSR→COO row vector)."""
+        nrows = self._shape[-2]
+        nnz = self._cols.shape[-1]
+        pos = jnp.arange(nnz, dtype=jnp.int32)
+
+        def expand(crows1d):
+            return jnp.searchsorted(crows1d[1:], pos, side="right") \
+                .astype(jnp.int32)
+
+        if self._crows.ndim == 1:
+            return expand(self._crows)
+        import jax
+        return jax.vmap(expand)(self._crows)
+
+    def to_sparse_coo(self, sparse_dim: Optional[int] = None) \
+            -> SparseCooTensor:
+        rows = self._rows()
+        if len(self._shape) == 2:
+            idx = jnp.stack([rows, self._cols])
+        else:
+            b = self._crows.shape[0]
+            nnz = self._cols.shape[-1]
+            batch = jnp.repeat(jnp.arange(b, dtype=jnp.int32), nnz)
+            idx = jnp.stack([batch, rows.reshape(-1),
+                             self._cols.reshape(-1)])
+        vals = self._values
+        if len(self._shape) == 3 and len(vals.shape) > 1:
+            vals = apply_op(lambda v: v.reshape(-1), vals,
+                            _op_name="csr_flatten_values")
+        return SparseCooTensor(idx, vals, self._shape, coalesced=True)
+
+    def to_dense(self) -> Tensor:
+        return self.to_sparse_coo().to_dense()
+
+    def numpy(self) -> np.ndarray:
+        return self.to_dense().numpy()
+
+    def astype(self, dt) -> "SparseCsrTensor":
+        return SparseCsrTensor(self._crows, self._cols,
+                               self._values.astype(dt), self._shape)
+
+    def detach(self) -> "SparseCsrTensor":
+        return SparseCsrTensor(self._crows, self._cols,
+                               self._values.detach(), self._shape)
+
+
+def is_sparse(x) -> bool:
+    return isinstance(x, (SparseCooTensor, SparseCsrTensor))

@@ -1,0 +1,220 @@
+"""Tests for paddle_tpu.sparse (model: reference test/legacy_test
+test_sparse_*_op.py — numeric checks vs dense NumPy references, plus
+gradient checks through sparse values)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import sparse
+
+
+def _rand_coo(shape=(4, 5), nnz=6, seed=0, dup=False):
+    rng = np.random.RandomState(seed)
+    n = int(np.prod(shape))
+    lin = rng.choice(n, size=nnz, replace=dup)
+    idx = np.stack(np.unravel_index(lin, shape)).astype(np.int32)
+    vals = rng.randn(nnz).astype(np.float32)
+    return idx, vals
+
+
+def test_coo_create_to_dense():
+    idx, vals = _rand_coo()
+    t = sparse.sparse_coo_tensor(idx, vals, (4, 5))
+    dense = np.zeros((4, 5), np.float32)
+    dense[idx[0], idx[1]] = vals
+    np.testing.assert_allclose(t.to_dense().numpy(), dense, rtol=1e-6)
+    assert t.is_sparse_coo() and not t.is_sparse_csr()
+    assert t.nnz() == 6 and t.shape == [4, 5]
+
+
+def test_coalesce_sums_duplicates():
+    idx = np.array([[0, 0, 1], [1, 1, 2]], np.int32)
+    vals = np.array([1.0, 2.0, 5.0], np.float32)
+    t = sparse.sparse_coo_tensor(idx, vals, (2, 3)).coalesce()
+    assert t.nnz() == 2
+    d = t.to_dense().numpy()
+    assert d[0, 1] == pytest.approx(3.0) and d[1, 2] == pytest.approx(5.0)
+
+
+def test_csr_roundtrip():
+    idx, vals = _rand_coo((6, 7), nnz=9, seed=1)
+    coo = sparse.sparse_coo_tensor(idx, vals, (6, 7))
+    csr = coo.to_sparse_csr()
+    np.testing.assert_allclose(csr.to_dense().numpy(),
+                               coo.to_dense().numpy(), rtol=1e-6)
+    back = csr.to_sparse_coo()
+    np.testing.assert_allclose(back.to_dense().numpy(),
+                               coo.to_dense().numpy(), rtol=1e-6)
+
+
+def test_dense_to_sparse_and_back():
+    x = paddle.to_tensor(np.array([[0, 1.0, 0], [2.0, 0, 3.0]], np.float32))
+    coo = x.to_sparse_coo(2)
+    assert coo.nnz() == 3
+    np.testing.assert_allclose(coo.to_dense().numpy(), x.numpy())
+    csr = x.to_sparse_csr()
+    np.testing.assert_allclose(csr.to_dense().numpy(), x.numpy())
+
+
+def test_unary_ops():
+    idx, vals = _rand_coo()
+    t = sparse.sparse_coo_tensor(idx, np.abs(vals) + 0.1, (4, 5))
+    np.testing.assert_allclose(
+        sparse.sqrt(t).to_dense().numpy(),
+        np.sqrt(t.to_dense().numpy()), rtol=1e-6)
+    r = sparse.relu(sparse.sparse_coo_tensor(idx, vals, (4, 5)))
+    np.testing.assert_allclose(r.to_dense().numpy(),
+                               np.maximum(0, r.to_dense().numpy()))
+
+
+def test_add_subtract_union_pattern():
+    a = sparse.sparse_coo_tensor([[0, 1], [0, 1]], [1.0, 2.0], (2, 2))
+    b = sparse.sparse_coo_tensor([[0, 1], [0, 0]], [10.0, 5.0], (2, 2))
+    s = sparse.add(a, b)
+    expect = a.to_dense().numpy() + b.to_dense().numpy()
+    np.testing.assert_allclose(s.to_dense().numpy(), expect, rtol=1e-6)
+    d = sparse.subtract(a, b)
+    np.testing.assert_allclose(d.to_dense().numpy(),
+                               a.to_dense().numpy() - b.to_dense().numpy(),
+                               rtol=1e-6)
+
+
+def test_multiply_intersection():
+    a = sparse.sparse_coo_tensor([[0, 1], [0, 1]], [3.0, 2.0], (2, 2))
+    b = sparse.sparse_coo_tensor([[0, 1], [0, 0]], [10.0, 5.0], (2, 2))
+    m = sparse.multiply(a, b)
+    np.testing.assert_allclose(m.to_dense().numpy(),
+                               a.to_dense().numpy() * b.to_dense().numpy(),
+                               rtol=1e-6)
+
+
+def test_matmul_and_grad():
+    idx, vals = _rand_coo((4, 5), nnz=7, seed=2)
+    sp = sparse.sparse_coo_tensor(idx, vals, (4, 5), stop_gradient=False)
+    dense = paddle.to_tensor(
+        np.random.RandomState(3).randn(5, 3).astype(np.float32))
+    dense.stop_gradient = False
+    out = sparse.matmul(sp, dense)
+    expect = sp.to_dense().numpy() @ dense.numpy()
+    np.testing.assert_allclose(out.numpy(), expect, rtol=1e-4, atol=1e-5)
+    out.sum().backward()
+    assert sp.values().grad is not None
+    assert dense.grad is not None
+    # d(sum(SpD))/dD = S^T @ ones
+    np.testing.assert_allclose(
+        dense.grad.numpy(),
+        sp.to_dense().numpy().T @ np.ones((4, 3), np.float32),
+        rtol=1e-4, atol=1e-5)
+
+
+def test_masked_matmul():
+    rng = np.random.RandomState(4)
+    x = paddle.to_tensor(rng.randn(4, 6).astype(np.float32))
+    y = paddle.to_tensor(rng.randn(6, 5).astype(np.float32))
+    idx, _ = _rand_coo((4, 5), nnz=8, seed=5)
+    mask = sparse.sparse_coo_tensor(idx, np.ones(8, np.float32), (4, 5))
+    out = sparse.masked_matmul(x, y, mask)
+    full = x.numpy() @ y.numpy()
+    expect = np.zeros((4, 5), np.float32)
+    expect[idx[0], idx[1]] = full[idx[0], idx[1]]
+    np.testing.assert_allclose(out.to_dense().numpy(), expect, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_csr_softmax():
+    x = paddle.to_tensor(np.array([[1.0, 0, 2.0], [0, 3.0, 4.0]],
+                                  np.float32))
+    csr = x.to_sparse_csr()
+    out = sparse.nn.functional.softmax(csr)
+    d = out.to_dense().numpy()
+    # row softmax over *stored* values only
+    r0 = np.exp([1.0, 2.0]) / np.exp([1.0, 2.0]).sum()
+    np.testing.assert_allclose(d[0, [0, 2]], r0, rtol=1e-5)
+    assert d[0, 1] == 0
+
+
+def test_sparse_nn_layers():
+    idx, vals = _rand_coo((3, 4), nnz=5, seed=6)
+    t = sparse.sparse_coo_tensor(idx, vals, (3, 4))
+    out = sparse.nn.ReLU()(t)
+    assert (out.to_dense().numpy() >= 0).all()
+    lr = sparse.nn.LeakyReLU(0.1)(t)
+    np.testing.assert_allclose(
+        lr.to_dense().numpy(),
+        np.where(t.to_dense().numpy() >= 0, t.to_dense().numpy(),
+                 np.where(t.to_dense().numpy() == 0, 0.0,
+                          0.1 * t.to_dense().numpy())), rtol=1e-5)
+
+
+def test_subm_conv3d_preserves_pattern():
+    rng = np.random.RandomState(7)
+    # NDHWC: [1, 4, 4, 4, 2], sparse on first 4 dims
+    dense = np.zeros((1, 4, 4, 4, 2), np.float32)
+    for _ in range(5):
+        dense[0, rng.randint(4), rng.randint(4), rng.randint(4)] = \
+            rng.randn(2)
+    x = sparse.to_sparse_coo(paddle.to_tensor(dense), 4)
+    conv = sparse.nn.SubmConv3D(2, 3, kernel_size=3, padding=1)
+    out = conv(x)
+    assert out.shape == [1, 4, 4, 4, 3]
+    assert out.nnz() == x.nnz()  # submanifold: same support
+
+
+def test_coalesce_large_shape_no_overflow():
+    # linearized row*col would overflow int32; column-unique must not
+    idx = np.array([[99999, 99999], [99998, 99999]], np.int32)
+    t = sparse.sparse_coo_tensor(idx, [1.0, 2.0], (100000, 100000))
+    c = t.coalesce()
+    assert c.nnz() == 2
+    np.testing.assert_array_equal(np.sort(np.asarray(c._indices)[1]),
+                                  [99998, 99999])
+
+
+def test_mixed_format_add():
+    a = sparse.sparse_coo_tensor([[0, 1], [0, 1]], [1.0, 2.0], (2, 2))
+    b_csr = a.to_sparse_csr()
+    out = sparse.add(b_csr, a)  # csr + coo → csr
+    assert out.is_sparse_csr()
+    np.testing.assert_allclose(out.to_dense().numpy(),
+                               2 * a.to_dense().numpy())
+    out2 = sparse.add(a, b_csr)  # coo + csr → coo
+    assert out2.is_sparse_coo()
+
+
+def test_mask_as_duplicate_mask_entries():
+    x = paddle.ones([2, 2])
+    mask = sparse.sparse_coo_tensor([[0, 0], [0, 0]], [1.0, 1.0], (2, 2))
+    out = sparse.mask_as(x, mask)
+    assert out.to_dense().numpy()[0, 0] == pytest.approx(1.0)
+
+
+def test_subm_conv_rejects_shrinking():
+    dense = np.zeros((1, 4, 4, 2), np.float32)
+    dense[0, 3, 3] = 1.0
+    x = sparse.to_sparse_coo(paddle.to_tensor(dense), 3)
+    conv = sparse.nn.SubmConv2D(2, 3, kernel_size=3, padding=0)
+    with pytest.raises(ValueError):
+        conv(x)
+
+
+def test_conv_bias_keeps_sparsity():
+    rng = np.random.RandomState(11)
+    dense = np.zeros((1, 4, 4, 2), np.float32)
+    dense[0, 1, 2] = rng.randn(2)
+    x = sparse.to_sparse_coo(paddle.to_tensor(dense), 3)
+    conv = sparse.nn.Conv2D(2, 3, kernel_size=3, padding=1)
+    out = conv(x)
+    # support = kernel-reachable positions only (3x3 neighborhood), not
+    # the whole 4x4 volume that a dense bias would light up
+    assert out.nnz() <= 9
+
+
+def test_mask_as():
+    rng = np.random.RandomState(8)
+    x = paddle.to_tensor(rng.randn(3, 4).astype(np.float32))
+    idx, vals = _rand_coo((3, 4), nnz=4, seed=9)
+    mask = sparse.sparse_coo_tensor(idx, vals, (3, 4))
+    out = sparse.mask_as(x, mask)
+    expect = np.zeros((3, 4), np.float32)
+    expect[idx[0], idx[1]] = x.numpy()[idx[0], idx[1]]
+    np.testing.assert_allclose(out.to_dense().numpy(), expect, rtol=1e-6)
