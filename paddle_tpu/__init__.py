@@ -36,6 +36,7 @@ from . import incubate  # noqa: F401
 from . import distributed  # noqa: F401
 from . import static  # noqa: F401
 from . import sparse  # noqa: F401
+from . import quantization  # noqa: F401
 from . import inference  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .framework.io import save, load  # noqa: F401

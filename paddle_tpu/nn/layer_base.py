@@ -114,11 +114,13 @@ class Layer:
             if params is None:
                 raise RuntimeError("call super().__init__() first")
             _remove_from(name, layers, buffers)
+            self.__dict__.pop(name, None)  # drop shadowing plain attr
             params[name] = value
         elif isinstance(value, Layer):
             if layers is None:
                 raise RuntimeError("call super().__init__() first")
             _remove_from(name, params, buffers)
+            self.__dict__.pop(name, None)  # drop shadowing plain attr
             layers[name] = value
         elif params is not None and name in params:
             if value is not None and not isinstance(value, Parameter):

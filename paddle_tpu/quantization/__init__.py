@@ -1,0 +1,26 @@
+"""paddle_tpu.quantization — QAT / PTQ (reference:
+/root/reference/python/paddle/quantization/__init__.py: QuantConfig,
+QAT qat.py:27, PTQ ptq.py:29, observers/abs_max.py, quanters/abs_max.py).
+
+TPU-first: fake-quantization is expressed as
+``x + stop_gradient(qdq(x) - x)`` — a straight-through estimator that is
+pure-functional and jit/pjit-traceable, instead of the reference's
+fake_quantize CUDA kernels (paddle/phi/kernels/gpu/fake_quantize_*.cu).
+int8 inference flows through the same qdq graph, which XLA folds onto the
+MXU's native int8 path when profitable.
+"""
+from .config import QuantConfig, SingleLayerConfig  # noqa: F401
+from .observers import AbsmaxObserver, AVGObserver  # noqa: F401
+from .quanters import (  # noqa: F401
+    FakeQuanterWithAbsMaxObserver, FakeQuanterChannelWiseAbsMaxObserver)
+from .qat import QAT  # noqa: F401
+from .ptq import PTQ  # noqa: F401
+from .wrapper import (  # noqa: F401
+    ObserveWrapper, QuantedLinear, QuantedConv2D, quant_dequant)
+
+__all__ = [
+    "QuantConfig", "SingleLayerConfig", "AbsmaxObserver", "AVGObserver",
+    "FakeQuanterWithAbsMaxObserver",
+    "FakeQuanterChannelWiseAbsMaxObserver", "QAT", "PTQ",
+    "ObserveWrapper", "QuantedLinear", "QuantedConv2D", "quant_dequant",
+]

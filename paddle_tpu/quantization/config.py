@@ -1,0 +1,124 @@
+"""QuantConfig (reference:
+/root/reference/python/paddle/quantization/config.py:67 — per-layer /
+per-name / per-type quantizer configuration with priority
+layer > name > type, plus factory.py QuanterFactory)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..nn.layer_base import Layer
+
+
+class QuanterFactory:
+    """Lazily-constructed quanter/observer spec (factory.py:28)."""
+
+    def __init__(self, cls, *args, **kwargs):
+        self._cls = cls
+        self._args = args
+        self._kwargs = kwargs
+
+    def _instance(self):
+        return self._cls(*self._args, **self._kwargs)
+
+
+def _as_factory(q):
+    if q is None or isinstance(q, QuanterFactory):
+        return q
+    if isinstance(q, type):
+        return QuanterFactory(q)
+    raise TypeError(f"expected QuanterFactory or class, got {type(q)}")
+
+
+class SingleLayerConfig:
+    """Quanter pair for one layer (config.py:40)."""
+
+    def __init__(self, activation=None, weight=None):
+        self._activation = _as_factory(activation)
+        self._weight = _as_factory(weight)
+
+    @property
+    def activation(self) -> Optional[QuanterFactory]:
+        return self._activation
+
+    @property
+    def weight(self) -> Optional[QuanterFactory]:
+        return self._weight
+
+    def __str__(self):
+        return f"activation: {self._activation}\nweight: {self._weight}"
+
+
+class QuantConfig:
+    def __init__(self, activation=None, weight=None):
+        if activation is None and weight is None:
+            self._global_config = None
+        else:
+            self._global_config = SingleLayerConfig(activation, weight)
+        self._layer2config = {}
+        self._prefix2config = {}
+        self._type2config = {}
+        self._qat_layer_mapping = _default_mapping()
+        self._customized_leaves = []
+
+    # -- registration (priority: layer > name > type > global) ------------
+    def add_layer_config(self, layer, activation=None, weight=None):
+        layers = layer if isinstance(layer, list) else [layer]
+        cfg = SingleLayerConfig(activation, weight)
+        for l in layers:
+            self._layer2config[id(l)] = cfg
+
+    def add_name_config(self, layer_name, activation=None, weight=None):
+        names = layer_name if isinstance(layer_name, list) else [layer_name]
+        cfg = SingleLayerConfig(activation, weight)
+        for n in names:
+            self._prefix2config[n] = cfg
+
+    def add_type_config(self, layer_type, activation=None, weight=None):
+        types = layer_type if isinstance(layer_type, list) else [layer_type]
+        cfg = SingleLayerConfig(activation, weight)
+        for t in types:
+            self._type2config[t] = cfg
+
+    def add_qat_layer_mapping(self, source_type, target_type):
+        self._qat_layer_mapping[source_type] = target_type
+
+    def add_customized_leaf(self, layer_type):
+        self._customized_leaves.append(layer_type)
+
+    @property
+    def customized_leaves(self):
+        return self._customized_leaves
+
+    @property
+    def qat_layer_mappings(self):
+        return self._qat_layer_mapping
+
+    @property
+    def default_qat_layer_mapping(self):
+        return _default_mapping()
+
+    @property
+    def global_config(self):
+        return self._global_config
+
+    # -- lookup -----------------------------------------------------------
+    def _get_config_by_layer(self, layer: Layer, name: str = ""):
+        if id(layer) in self._layer2config:
+            return self._layer2config[id(layer)]
+        for prefix, cfg in self._prefix2config.items():
+            if name == prefix or name.startswith(prefix + "."):
+                return cfg
+        for t, cfg in self._type2config.items():
+            if isinstance(layer, t):
+                return cfg
+        return self._global_config
+
+    def _is_quantifiable(self, layer: Layer) -> bool:
+        return type(layer) in self._qat_layer_mapping
+
+
+def _default_mapping():
+    from ..nn.layer.common import Linear
+    from ..nn.layer.conv import Conv2D
+    from .wrapper import QuantedConv2D, QuantedLinear
+    return {Linear: QuantedLinear, Conv2D: QuantedConv2D}

@@ -1,0 +1,89 @@
+"""Observers: collect activation/weight ranges during calibration
+(reference: /root/reference/python/paddle/quantization/observers/abs_max.py
+AbsmaxObserver; base_observer.py BaseObserver)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..nn.layer_base import Layer
+
+
+class BaseObserver(Layer):
+    """Identity layer that records quantization statistics on forward."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__()
+        self._quant_bits = quant_bits
+
+    def bit_length(self) -> int:
+        return self._quant_bits
+
+    def quant_axis(self):
+        return -1  # per-tensor
+
+    def scales(self):
+        raise NotImplementedError
+
+    def zero_points(self):
+        return 0.0
+
+    def forward(self, x):
+        self._observe(x)
+        return x
+
+    def _observe(self, x):
+        raise NotImplementedError
+
+
+class AbsmaxObserver(BaseObserver):
+    """Per-tensor abs-max range observer (observers/abs_max.py:30).
+    State is a registered buffer → survives paddle.save/load."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__(quant_bits)
+        self.register_buffer("_stat_max", Tensor(jnp.zeros((),
+                                                           jnp.float32)))
+
+    @property
+    def _max(self):
+        return float(np.asarray(self._buffers["_stat_max"]._data))
+
+    def _observe(self, x):
+        cur = float(jnp.max(jnp.abs(x._data)) if isinstance(x, Tensor)
+                    else np.abs(x).max())
+        self._buffers["_stat_max"] = Tensor(
+            jnp.asarray(max(self._max, cur), jnp.float32))
+
+    def scales(self):
+        qmax = float(2 ** (self._quant_bits - 1) - 1)
+        return max(self._max, 1e-8) / qmax
+
+    def cal_thresholds(self):
+        return self._max
+
+
+class AVGObserver(BaseObserver):
+    """Average-of-batch-absmax observer (imperative PTQ's 'avg' strategy,
+    reference python/paddle/quantization/imperative/ptq_quantizer.py)."""
+
+    def __init__(self, quant_bits: int = 8):
+        super().__init__(quant_bits)
+        self.register_buffer("_stat_sum", Tensor(jnp.zeros((),
+                                                           jnp.float32)))
+        self.register_buffer("_stat_n", Tensor(jnp.zeros((), jnp.int32)))
+
+    def _observe(self, x):
+        arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        s = float(np.asarray(self._buffers["_stat_sum"]._data))
+        n = int(np.asarray(self._buffers["_stat_n"]._data))
+        self._buffers["_stat_sum"] = Tensor(
+            jnp.asarray(s + float(jnp.max(jnp.abs(arr))), jnp.float32))
+        self._buffers["_stat_n"] = Tensor(jnp.asarray(n + 1, jnp.int32))
+
+    def scales(self):
+        qmax = float(2 ** (self._quant_bits - 1) - 1)
+        s = float(np.asarray(self._buffers["_stat_sum"]._data))
+        n = int(np.asarray(self._buffers["_stat_n"]._data))
+        return max(s / max(n, 1), 1e-8) / qmax

@@ -1,0 +1,83 @@
+"""PTQ — post-training quantization (reference:
+/root/reference/python/paddle/quantization/ptq.py:29 — insert observers,
+calibrate with forward passes, convert to a quantized model)."""
+from __future__ import annotations
+
+import copy
+
+from ..nn.layer_base import Layer
+from .config import QuantConfig
+from .qat import Quantization
+from .wrapper import ObserveWrapper, quant_dequant
+
+
+class _CalibratedLayer(Layer):
+    """Deploy-time layer: qdq input with the calibrated scale, then run
+    the original layer (whose weights were qdq'd in-place at convert)."""
+
+    def __init__(self, source: Layer, act_absmax, bits):
+        super().__init__()
+        self._source = source
+        self._absmax = act_absmax
+        self._bits = bits
+
+    def forward(self, x):
+        if self._absmax is not None:
+            x = quant_dequant(x, self._absmax, self._bits)
+        return self._source(x)
+
+
+class PTQ(Quantization):
+    def __init__(self, config: QuantConfig):
+        super().__init__(config)
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        """Insert activation observers in front of quantifiable layers."""
+        if not inplace:
+            model = copy.deepcopy(model)
+        model.eval()
+        self._walk(model, "")
+        return model
+
+    def _walk(self, layer: Layer, prefix: str):
+        for name, child in list(layer.named_children()):
+            full = f"{prefix}.{name}" if prefix else name
+            cfg = self._config._get_config_by_layer(child, full)
+            if cfg is not None and cfg.activation is not None and \
+                    self._config._is_quantifiable(child):
+                obs = cfg.activation._instance()
+                wrapper = ObserveWrapper(obs, child)
+                wrapper._weight_factory = cfg.weight
+                layer.add_sublayer(name, wrapper)
+            else:
+                self._walk(child, full)
+
+    def convert(self, model: Layer, inplace: bool = False,
+                remove_quanter: bool = True) -> Layer:
+        """Replace observers with fixed-scale qdq layers."""
+        if not inplace:
+            model = copy.deepcopy(model)
+        self._convert_walk(model)
+        model.eval()
+        return model
+
+    def _convert_walk(self, layer: Layer):
+        for name, child in list(layer.named_children()):
+            if isinstance(child, ObserveWrapper):
+                obs = child.observer
+                qmax = float(2 ** (obs.bit_length() - 1) - 1)
+                absmax = obs.scales() * qmax
+                source = child.observed
+                wf = getattr(child, "_weight_factory", None)
+                if wf is not None and getattr(source, "weight", None) \
+                        is not None:
+                    # weights are static post-training: bake the qdq into
+                    # the param (per the configured weight quanter)
+                    wq = wf._instance()
+                    source.weight.set_value(
+                        wq(source.weight).detach())
+                layer.add_sublayer(
+                    name, _CalibratedLayer(source, absmax,
+                                           obs.bit_length()))
+            else:
+                self._convert_walk(child)

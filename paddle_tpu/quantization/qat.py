@@ -1,0 +1,76 @@
+"""QAT — quantization-aware training (reference:
+/root/reference/python/paddle/quantization/qat.py:27 QAT.quantize: walk the
+model, replace mapped layer types with quanted wrappers per QuantConfig)."""
+from __future__ import annotations
+
+import copy
+
+from ..nn.layer_base import Layer
+from .config import QuantConfig
+
+
+class Quantization:
+    def __init__(self, config: QuantConfig):
+        self._config = config
+
+    def convert(self, model: Layer, inplace: bool = False,
+                remove_quanter: bool = True) -> Layer:
+        """Finalize a quantized model for deployment (qat.py:61 analog).
+
+        remove_quanter=True: strip the quanted wrappers, baking the weight
+        qdq into the source layer's parameters (deployment form; the
+        reference exits to paddle2onnx here, ours re-enters jit/inference
+        export). remove_quanter=False: keep wrappers, frozen in eval mode.
+        """
+        if not inplace:
+            model = copy.deepcopy(model)
+        if remove_quanter:
+            self._strip(model)
+        for layer in model.sublayers(include_self=True):
+            for q in ("weight_quanter", "activation_quanter"):
+                quanter = getattr(layer, q, None)
+                if quanter is not None:
+                    quanter.eval()
+        model.eval()
+        return model
+
+    def _strip(self, layer: Layer):
+        from .wrapper import _QuantedOpLayer
+        for name, child in list(layer.named_children()):
+            if isinstance(child, _QuantedOpLayer):
+                src = child._source
+                if child.weight_quanter is not None:
+                    src.weight.set_value(
+                        child.weight_quanter(src.weight).detach())
+                layer.add_sublayer(name, src)
+            else:
+                self._strip(child)
+
+
+class QAT(Quantization):
+    def __init__(self, config: QuantConfig):
+        super().__init__(config)
+
+    def _quantize_layer(self, parent: Layer, attr_name: str, child: Layer,
+                        full_name: str):
+        cfg = self._config._get_config_by_layer(child, full_name)
+        if cfg is None or not self._config._is_quantifiable(child):
+            return
+        target = self._config.qat_layer_mappings[type(child)]
+        parent.add_sublayer(attr_name, target(child, cfg))
+
+    def quantize(self, model: Layer, inplace: bool = False) -> Layer:
+        if not inplace:
+            model = copy.deepcopy(model)
+        self._walk(model, "")
+        return model
+
+    def _walk(self, layer: Layer, prefix: str):
+        for name, child in list(layer.named_children()):
+            full = f"{prefix}.{name}" if prefix else name
+            if type(child) in self._config.qat_layer_mappings:
+                self._quantize_layer(layer, name, child, full)
+            elif type(child) in self._config.customized_leaves:
+                continue
+            else:
+                self._walk(child, full)

@@ -1,0 +1,117 @@
+"""Fake quanters for QAT (reference:
+/root/reference/python/paddle/quantization/quanters/abs_max.py
+FakeQuanterWithAbsMaxObserver — EMA absmax + fake quantize).
+
+Straight-through estimator: out = x + stop_grad(qdq(x) - x). Identity
+gradient, quantized forward, all inside one XLA graph. Calibration state
+lives in registered buffers so it survives paddle.save/load.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor, apply_op
+from ..nn.layer_base import Layer
+from .wrapper import quant_dequant, _qdq_dynamic
+
+
+def _is_traced(arr) -> bool:
+    return isinstance(arr, jax.core.Tracer)
+
+
+class BaseQuanter(Layer):
+    def bit_length(self):
+        return self._quant_bits
+
+    def quant_axis(self):
+        return -1
+
+    def zero_points(self):
+        return 0.0
+
+
+class FakeQuanterWithAbsMaxObserver(BaseQuanter):
+    """Per-tensor EMA absmax fake quantizer (quanters/abs_max.py:63:
+    moving-average absmax state updated each training step).
+
+    Under jit tracing the EMA update is skipped: the frozen buffered scale
+    is used if calibrated, else the absmax is computed in-graph
+    (dynamic-range qdq) — both jit-safe.
+    """
+
+    def __init__(self, moving_rate: float = 0.9, bit_length: int = 8,
+                 dtype=None, name=None):
+        super().__init__()
+        self._moving_rate = moving_rate
+        self._quant_bits = bit_length
+        self.register_buffer("_scale_state",
+                             Tensor(jnp.zeros((), jnp.float32)))
+        self.register_buffer("_inited", Tensor(jnp.zeros((), jnp.bool_)))
+
+    def _state(self):
+        return float(np.asarray(self._buffers["_scale_state"]._data))
+
+    def _is_inited(self):
+        return bool(np.asarray(self._buffers["_inited"]._data))
+
+    def scales(self):
+        qmax = float(2 ** (self._quant_bits - 1) - 1)
+        return max(self._state(), 1e-8) / qmax
+
+    def forward(self, x):
+        arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        if _is_traced(arr):
+            if self._is_inited():
+                return quant_dequant(x, max(self._state(), 1e-8),
+                                     self._quant_bits)
+            return _qdq_dynamic(x, self._quant_bits)
+        cur = float(jnp.max(jnp.abs(arr)))
+        if self.training:
+            if not self._is_inited():
+                new = cur
+                self._buffers["_inited"] = Tensor(
+                    jnp.ones((), jnp.bool_))
+            else:
+                r = self._moving_rate
+                new = r * self._state() + (1 - r) * cur
+            self._buffers["_scale_state"] = Tensor(
+                jnp.asarray(new, jnp.float32))
+        absmax = max(self._state() if self._is_inited() else cur, 1e-8)
+        return quant_dequant(x, absmax, self._quant_bits)
+
+
+class FakeQuanterChannelWiseAbsMaxObserver(BaseQuanter):
+    """Per-channel absmax fake quantizer for weights (quanters/abs_max.py
+    channel-wise variant; quant_axis 0 = output channels). The per-channel
+    absmax is recomputed from the tensor each call (weights are live
+    during QAT); the last concrete absmax is kept for scales() export."""
+
+    def __init__(self, quant_axis: int = 0, bit_length: int = 8,
+                 dtype=None, name=None):
+        super().__init__()
+        self._axis = quant_axis
+        self._quant_bits = bit_length
+        # shape depends on the wrapped weight → not persistable
+        self.register_buffer("_last_absmax", None, persistable=False)
+
+    def quant_axis(self):
+        return self._axis
+
+    def scales(self):
+        qmax = float(2 ** (self._quant_bits - 1) - 1)
+        last = self._buffers.get("_last_absmax")
+        if last is None:
+            return None
+        return np.asarray(last._data) / qmax
+
+    def forward(self, x):
+        axis = self._axis
+        arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+        red = tuple(i for i in range(arr.ndim) if i != axis)
+        absmax = jnp.maximum(jnp.max(jnp.abs(arr), axis=red,
+                                     keepdims=True), 1e-8)
+        if not _is_traced(arr):
+            self._buffers["_last_absmax"] = Tensor(absmax)
+        return quant_dequant(x, absmax, self._quant_bits)

@@ -1,0 +1,121 @@
+"""Quantized layer wrappers (reference:
+/root/reference/python/paddle/quantization/wrapper.py ObserveWrapper;
+paddle/nn/quant/qat/linear.py QuantedLinear-style layers)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, apply_op
+from ..nn.layer_base import Layer
+
+
+def quant_dequant(x, absmax, bits: int = 8):
+    """Symmetric quantize→dequantize with straight-through gradient.
+    ``absmax`` may be a python float (per-tensor) or a broadcastable array
+    (per-channel, keepdims layout)."""
+    qmax = float(2 ** (bits - 1) - 1)
+    scale = absmax / qmax
+
+    def f(a):
+        q = jnp.clip(jnp.round(a / scale), -qmax - 1, qmax)
+        return a + jax.lax.stop_gradient(q * scale - a)
+
+    if isinstance(x, Tensor):
+        return apply_op(f, x, _op_name="quant_dequant")
+    return f(jnp.asarray(x))
+
+
+def _qdq_dynamic(x, bits: int = 8):
+    """qdq with absmax computed in-graph (jit-safe uncalibrated path)."""
+    qmax = float(2 ** (bits - 1) - 1)
+
+    def f(a):
+        scale = jnp.maximum(jnp.max(jnp.abs(a)), 1e-8) / qmax
+        q = jnp.clip(jnp.round(a / scale), -qmax - 1, qmax)
+        return a + jax.lax.stop_gradient(q * scale - a)
+
+    if isinstance(x, Tensor):
+        return apply_op(f, x, _op_name="quant_dequant_dynamic")
+    return f(jnp.asarray(x))
+
+
+class ObserveWrapper(Layer):
+    """Wrap a layer with activation observers on input/output
+    (wrapper.py:24)."""
+
+    def __init__(self, observer, observed, observe_input=True,
+                 observe_output=False):
+        super().__init__()
+        self._observer = observer
+        self._observed = observed
+        self._in = observe_input
+        self._out = observe_output
+
+    @property
+    def observed(self):
+        return self._observed
+
+    @property
+    def observer(self):
+        return self._observer
+
+    def forward(self, *args, **kwargs):
+        if self._in and args:
+            args = (self._observer(args[0]),) + args[1:]
+        out = self._observed(*args, **kwargs)
+        if self._out:
+            out = self._observer(out)
+        return out
+
+
+class _QuantedOpLayer(Layer):
+    """QAT wrapper: fake-quant the weight (per-channel) and the input
+    activation (per-tensor EMA) around the wrapped layer's op."""
+
+    def __init__(self, source, q_config):
+        super().__init__()
+        self._source = source
+        if q_config.weight is not None:
+            self.weight_quanter = q_config.weight._instance()
+        else:
+            self.weight_quanter = None
+        if q_config.activation is not None:
+            self.activation_quanter = q_config.activation._instance()
+        else:
+            self.activation_quanter = None
+
+    @property
+    def weight(self):
+        return self._source.weight
+
+    @property
+    def bias(self):
+        return getattr(self._source, "bias", None)
+
+    def _quanted_weight(self):
+        w = self._source.weight
+        if self.weight_quanter is not None:
+            w = self.weight_quanter(w)
+        return w
+
+    def _quanted_input(self, x):
+        if self.activation_quanter is not None:
+            x = self.activation_quanter(x)
+        return x
+
+
+class QuantedLinear(_QuantedOpLayer):
+    def forward(self, x):
+        from ..nn import functional as F
+        return F.linear(self._quanted_input(x), self._quanted_weight(),
+                        self.bias)
+
+
+class QuantedConv2D(_QuantedOpLayer):
+    def forward(self, x):
+        from ..nn import functional as F
+        src = self._source
+        return F.conv2d(self._quanted_input(x), self._quanted_weight(),
+                        src.bias, src._stride, src._padding, src._dilation,
+                        src._groups, src._data_format)

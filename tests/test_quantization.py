@@ -1,0 +1,218 @@
+"""Tests for paddle_tpu.quantization (model: reference
+test/quantization/test_qat.py, test_ptq.py — structural replacement checks
+plus numeric fake-quant behavior)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.quantization import (
+    QAT, PTQ, AbsmaxObserver, AVGObserver, FakeQuanterChannelWiseAbsMaxObserver,
+    FakeQuanterWithAbsMaxObserver, ObserveWrapper, QuantConfig,
+    QuantedConv2D, QuantedLinear, quant_dequant)
+from paddle_tpu.quantization.config import QuanterFactory
+
+
+class LeNetish(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2D(1, 4, 3, padding=1)
+        self.flat = nn.Flatten()
+        self.fc1 = nn.Linear(4 * 8 * 8, 16)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Linear(16, 10)
+
+    def forward(self, x):
+        return self.fc2(self.relu(self.fc1(self.flat(self.conv(x)))))
+
+
+def _qcfg():
+    return QuantConfig(
+        activation=QuanterFactory(FakeQuanterWithAbsMaxObserver,
+                                  moving_rate=0.9, bit_length=8),
+        weight=QuanterFactory(FakeQuanterChannelWiseAbsMaxObserver,
+                              quant_axis=0, bit_length=8))
+
+
+def test_quant_dequant_numerics():
+    x = paddle.to_tensor(np.linspace(-1, 1, 11).astype(np.float32))
+    out = quant_dequant(x, absmax=1.0, bits=8)
+    scale = 1.0 / 127
+    expect = np.clip(np.round(np.linspace(-1, 1, 11) / scale), -128,
+                     127) * scale
+    np.testing.assert_allclose(out.numpy(), expect, atol=1e-6)
+
+
+def test_quant_dequant_ste_gradient():
+    x = paddle.to_tensor(np.array([0.3, -0.7], np.float32))
+    x.stop_gradient = False
+    out = quant_dequant(x, absmax=1.0, bits=8)
+    out.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [1.0, 1.0])  # identity STE
+
+
+def test_qat_replaces_layers():
+    model = LeNetish()
+    qat = QAT(_qcfg())
+    q_model = qat.quantize(model, inplace=False)
+    assert isinstance(q_model.fc1, QuantedLinear)
+    assert isinstance(q_model.fc2, QuantedLinear)
+    assert isinstance(q_model.conv, QuantedConv2D)
+    assert isinstance(model.fc1, nn.Linear)  # original untouched
+    x = paddle.to_tensor(np.random.RandomState(0)
+                         .randn(2, 1, 8, 8).astype(np.float32))
+    out = q_model(x)
+    assert out.shape == [2, 10]
+    # fake-quant forward ≈ float forward
+    ref = model(x)
+    assert float(paddle.abs(out - ref).mean().numpy()) < 0.2
+
+
+def test_qat_backward_trains():
+    model = LeNetish()
+    q_model = QAT(_qcfg()).quantize(model, inplace=False)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=q_model.parameters())
+    x = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(4, 1, 8, 8).astype(np.float32))
+    before = q_model.fc1.weight.numpy().copy()
+    loss = q_model(x).sum()
+    loss.backward()
+    opt.step()
+    assert not np.allclose(before, q_model.fc1.weight.numpy())
+
+
+def test_name_and_type_config_priority():
+    cfg = QuantConfig()
+    cfg.add_type_config(nn.Linear,
+                        activation=QuanterFactory(
+                            FakeQuanterWithAbsMaxObserver))
+    model = LeNetish()
+    q = QAT(cfg).quantize(model, inplace=False)
+    assert isinstance(q.fc1, QuantedLinear)
+    assert isinstance(q.conv, nn.Conv2D)  # no conv config → untouched
+
+
+def test_ptq_observe_and_convert():
+    model = LeNetish()
+    cfg = QuantConfig(activation=QuanterFactory(AbsmaxObserver),
+                      weight=None)
+    ptq = PTQ(cfg)
+    observed = ptq.quantize(model, inplace=False)
+    assert isinstance(observed.fc1, ObserveWrapper)
+    rng = np.random.RandomState(2)
+    for _ in range(3):  # calibration passes
+        observed(paddle.to_tensor(rng.randn(2, 1, 8, 8)
+                                  .astype(np.float32)))
+    assert observed.fc1.observer._max > 0
+    converted = ptq.convert(observed, inplace=False)
+    x = paddle.to_tensor(rng.randn(2, 1, 8, 8).astype(np.float32))
+    out = converted(x)
+    ref = model(x)
+    assert out.shape == [2, 10]
+    assert float(paddle.abs(out - ref).mean().numpy()) < 0.2
+
+
+def test_observers():
+    obs = AbsmaxObserver(quant_bits=8)
+    obs(paddle.to_tensor(np.array([1.0, -3.0], np.float32)))
+    obs(paddle.to_tensor(np.array([2.0], np.float32)))
+    assert obs.cal_thresholds() == pytest.approx(3.0)
+    assert obs.scales() == pytest.approx(3.0 / 127)
+    avg = AVGObserver(quant_bits=8)
+    avg(paddle.to_tensor(np.array([1.0], np.float32)))
+    avg(paddle.to_tensor(np.array([3.0], np.float32)))
+    assert avg.scales() == pytest.approx(2.0 / 127)
+
+
+def test_qat_actually_quantizes():
+    # regression: quanter attrs must not be shadowed by stale None attrs
+    model = LeNetish()
+    q_model = QAT(_qcfg()).quantize(model, inplace=False)
+    assert q_model.fc1.weight_quanter is not None
+    assert q_model.fc1.activation_quanter is not None
+    # 2-bit quantization must visibly differ from float forward
+    cfg2 = QuantConfig(
+        activation=None,
+        weight=QuanterFactory(FakeQuanterChannelWiseAbsMaxObserver,
+                              quant_axis=0, bit_length=2))
+    q2 = QAT(cfg2).quantize(model, inplace=False)
+    x = paddle.to_tensor(np.random.RandomState(3)
+                         .randn(2, 1, 8, 8).astype(np.float32))
+    diff = float(paddle.abs(q2(x) - model(x)).mean().numpy())
+    assert diff > 1e-4, "weight fake-quant had no effect"
+
+
+def test_quanter_state_survives_save_load(tmp_path):
+    q = FakeQuanterWithAbsMaxObserver(moving_rate=0.5)
+    q(paddle.to_tensor(np.array([4.0], np.float32)))
+    assert q._state() > 0
+    sd = q.state_dict()
+    q2 = FakeQuanterWithAbsMaxObserver(moving_rate=0.5)
+    q2.set_state_dict(sd)
+    assert q2._state() == pytest.approx(q._state())
+    assert q2._is_inited()
+
+
+def test_channelwise_scales_exposed():
+    q = FakeQuanterChannelWiseAbsMaxObserver(quant_axis=0, bit_length=8)
+    w = paddle.to_tensor(np.array([[1.0, -2.0], [4.0, 3.0]], np.float32))
+    q(w)
+    s = q.scales()
+    assert s is not None
+    np.testing.assert_allclose(np.asarray(s).ravel(),
+                               [2.0 / 127, 4.0 / 127], rtol=1e-5)
+
+
+def test_qat_under_jit():
+    # calibrated quanter must be traceable (frozen-scale path)
+    model = nn.Sequential(nn.Linear(4, 4))
+    qm = QAT(_qcfg()).quantize(model, inplace=False)
+    x = paddle.to_tensor(np.random.RandomState(5)
+                         .randn(2, 4).astype(np.float32))
+    qm(x)  # calibrate once eagerly
+    jitted = paddle.jit.to_static(lambda t: qm(t))
+    out = jitted(x)
+    np.testing.assert_allclose(out.numpy(), qm(x).numpy(), rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_ptq_quantizes_weights_on_convert():
+    model = nn.Sequential(nn.Linear(4, 4))
+    cfg = QuantConfig(
+        activation=QuanterFactory(AbsmaxObserver),
+        weight=QuanterFactory(FakeQuanterChannelWiseAbsMaxObserver,
+                              quant_axis=0, bit_length=2))
+    ptq = PTQ(cfg)
+    obs = ptq.quantize(model, inplace=False)
+    x = paddle.to_tensor(np.random.RandomState(6)
+                         .randn(2, 4).astype(np.float32))
+    obs(x)
+    conv = ptq.convert(obs, inplace=False)
+    w_orig = model[0].weight.numpy()
+    w_conv = conv[0]._source.weight.numpy()
+    assert not np.allclose(w_orig, w_conv), \
+        "weight qdq not baked at convert"
+
+
+def test_qat_convert_strips_wrappers():
+    model = nn.Sequential(nn.Linear(4, 4))
+    qat = QAT(_qcfg())
+    qm = qat.quantize(model, inplace=False)
+    x = paddle.to_tensor(np.random.RandomState(7)
+                         .randn(2, 4).astype(np.float32))
+    qm(x)
+    deployed = qat.convert(qm, inplace=False, remove_quanter=True)
+    assert isinstance(deployed[0], nn.Linear)
+    kept = qat.convert(qm, inplace=False, remove_quanter=False)
+    assert isinstance(kept[0], QuantedLinear)
+
+
+def test_channelwise_quanter_axis():
+    q = FakeQuanterChannelWiseAbsMaxObserver(quant_axis=0, bit_length=8)
+    w = paddle.to_tensor(np.array([[1.0, -1.0], [100.0, -100.0]],
+                                  np.float32))
+    out = q(w).numpy()
+    # each row quantized with its own scale → small row survives
+    np.testing.assert_allclose(out[0], [1.0, -1.0], atol=0.02)
+    np.testing.assert_allclose(out[1], [100.0, -100.0], atol=1.0)
