@@ -37,6 +37,9 @@ from . import distributed  # noqa: F401
 from . import static  # noqa: F401
 from . import sparse  # noqa: F401
 from . import quantization  # noqa: F401
+from . import audio  # noqa: F401
+from . import geometric  # noqa: F401
+from . import text  # noqa: F401
 from . import inference  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .framework.io import save, load  # noqa: F401

@@ -1,0 +1,10 @@
+"""paddle_tpu.audio (reference: /root/reference/python/paddle/audio/
+__init__.py — features, functional; backends/datasets are IO-bound and
+delegated to paddle_tpu.io datasets)."""
+from . import functional  # noqa: F401
+from . import features  # noqa: F401
+from .features import (  # noqa: F401
+    MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram)
+
+__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
+           "LogMelSpectrogram", "MFCC"]

@@ -1,0 +1,143 @@
+"""paddle_tpu.audio.features (reference:
+/root/reference/python/paddle/audio/features/layers.py — Spectrogram:47,
+MelSpectrogram:132, LogMelSpectrogram:239, MFCC:346).
+
+TPU-first: STFT = static frame-gather + window multiply + rfft, one XLA
+graph (the reference routes through a frame op + paddle.signal.stft)."""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, apply_op
+from ..nn.layer_base import Layer
+from . import functional as F
+
+
+def _stft_power(x, n_fft, hop_length, win, center, pad_mode, power):
+    """[..., T] → [..., n_fft//2+1, n_frames] power spectrogram."""
+    def f(a, w):
+        if center:
+            pad = [(0, 0)] * (a.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            a = jnp.pad(a, pad, mode=pad_mode)
+        t = a.shape[-1]
+        n_frames = 1 + (t - n_fft) // hop_length
+        idx = (jnp.arange(n_frames)[:, None] * hop_length
+               + jnp.arange(n_fft)[None, :])
+        frames = a[..., idx] * w  # [..., n_frames, n_fft]
+        spec = jnp.fft.rfft(frames, axis=-1)
+        mag = jnp.abs(spec)
+        out = mag ** power if power != 1.0 else mag
+        return jnp.swapaxes(out, -1, -2)  # [..., freq, frames]
+
+    return apply_op(f, x, win, _op_name="stft_power")
+
+
+class Spectrogram(Layer):
+    """STFT power spectrogram (layers.py:47)."""
+
+    def __init__(self, n_fft: int = 512, hop_length: Optional[int] = 512,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 1.0, center: bool = True,
+                 pad_mode: str = "reflect", dtype: str = "float32"):
+        super().__init__()
+        if power <= 0:
+            raise ValueError("Power of spectrogram must be > 0.")
+        self.n_fft = n_fft
+        self.hop_length = hop_length or n_fft // 4
+        self.win_length = win_length or n_fft
+        self.power = power
+        self.center = center
+        self.pad_mode = pad_mode
+        w = F.get_window(window, self.win_length, fftbins=True,
+                         dtype=dtype)
+        if self.win_length < n_fft:  # center-pad window to n_fft
+            lpad = (n_fft - self.win_length) // 2
+            w = Tensor(jnp.pad(w._data,
+                               (lpad, n_fft - self.win_length - lpad)))
+        self.register_buffer("window", w)
+
+    def forward(self, x):
+        return _stft_power(x, self.n_fft, self.hop_length,
+                           self._buffers["window"], self.center,
+                           self.pad_mode, self.power)
+
+
+class MelSpectrogram(Layer):
+    """Spectrogram → mel filterbank (layers.py:132)."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 2048,
+                 hop_length: Optional[int] = 512,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm="slaney", dtype: str = "float32"):
+        super().__init__()
+        self._spectrogram = Spectrogram(n_fft, hop_length, win_length,
+                                        window, power, center, pad_mode,
+                                        dtype)
+        self.register_buffer(
+            "fbank_matrix",
+            F.compute_fbank_matrix(sr, n_fft, n_mels, f_min, f_max, htk,
+                                   norm, dtype))
+
+    def forward(self, x):
+        spec = self._spectrogram(x)
+        return apply_op(lambda fb, s: jnp.matmul(fb, s),
+                        self._buffers["fbank_matrix"], spec,
+                        _op_name="mel_fbank")
+
+
+class LogMelSpectrogram(Layer):
+    """Mel spectrogram in dB (layers.py:239)."""
+
+    def __init__(self, sr: int = 22050, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm="slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None,
+                 dtype: str = "float32"):
+        super().__init__()
+        self._melspectrogram = MelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, dtype)
+        self.ref_value = ref_value
+        self.amin = amin
+        self.top_db = top_db
+
+    def forward(self, x):
+        mel = self._melspectrogram(x)
+        return F.power_to_db(mel, self.ref_value, self.amin, self.top_db)
+
+
+class MFCC(Layer):
+    """Mel-frequency cepstral coefficients (layers.py:346)."""
+
+    def __init__(self, sr: int = 22050, n_mfcc: int = 40, n_fft: int = 512,
+                 hop_length: Optional[int] = None,
+                 win_length: Optional[int] = None, window: str = "hann",
+                 power: float = 2.0, center: bool = True,
+                 pad_mode: str = "reflect", n_mels: int = 64,
+                 f_min: float = 50.0, f_max: Optional[float] = None,
+                 htk: bool = False, norm="slaney", ref_value: float = 1.0,
+                 amin: float = 1e-10, top_db: Optional[float] = None,
+                 dtype: str = "float32"):
+        super().__init__()
+        self._log_melspectrogram = LogMelSpectrogram(
+            sr, n_fft, hop_length, win_length, window, power, center,
+            pad_mode, n_mels, f_min, f_max, htk, norm, ref_value, amin,
+            top_db, dtype)
+        self.register_buffer("dct_matrix",
+                             F.create_dct(n_mfcc, n_mels, dtype=dtype))
+
+    def forward(self, x):
+        logmel = self._log_melspectrogram(x)
+        return apply_op(
+            lambda d, m: jnp.swapaxes(
+                jnp.matmul(jnp.swapaxes(m, -1, -2), d), -1, -2),
+            self._buffers["dct_matrix"], logmel, _op_name="mfcc_dct")

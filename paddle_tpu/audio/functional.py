@@ -1,0 +1,230 @@
+"""paddle_tpu.audio.functional (reference:
+/root/reference/python/paddle/audio/functional/functional.py — hz_to_mel:29,
+mel_to_hz:83, mel_frequencies:126, fft_frequencies:166,
+compute_fbank_matrix:189, power_to_db:262, create_dct:306; window.py:396
+get_window)."""
+from __future__ import annotations
+
+import math
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+
+def _arr(x):
+    return x._data if isinstance(x, Tensor) else x
+
+
+def hz_to_mel(freq, htk: bool = False):
+    f = _arr(freq)
+    if htk:
+        out = 2595.0 * jnp.log10(1.0 + jnp.asarray(f) / 700.0) \
+            if isinstance(f, (jnp.ndarray, np.ndarray)) \
+            else 2595.0 * math.log10(1.0 + f / 700.0)
+        return Tensor(out) if isinstance(freq, Tensor) else out
+    # Slaney scale
+    f_min, f_sp = 0.0, 200.0 / 3
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    if isinstance(freq, (int, float)):
+        if freq >= min_log_hz:
+            return min_log_mel + math.log(freq / min_log_hz) / logstep
+        return (freq - f_min) / f_sp
+    f = jnp.asarray(f)
+    mels = (f - f_min) / f_sp
+    mels = jnp.where(f >= min_log_hz,
+                     min_log_mel + jnp.log(jnp.maximum(f, 1e-10)
+                                           / min_log_hz) / logstep, mels)
+    return Tensor(mels) if isinstance(freq, Tensor) else mels
+
+
+def mel_to_hz(mel, htk: bool = False):
+    m = _arr(mel)
+    if htk:
+        if isinstance(mel, (int, float)):
+            return 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+        out = 700.0 * (10.0 ** (jnp.asarray(m) / 2595.0) - 1.0)
+        return Tensor(out) if isinstance(mel, Tensor) else out
+    f_min, f_sp = 0.0, 200.0 / 3
+    min_log_hz = 1000.0
+    min_log_mel = (min_log_hz - f_min) / f_sp
+    logstep = math.log(6.4) / 27.0
+    if isinstance(mel, (int, float)):
+        if m >= min_log_mel:
+            return min_log_hz * math.exp(logstep * (m - min_log_mel))
+        return f_min + f_sp * m
+    m = jnp.asarray(m)
+    freqs = f_min + f_sp * m
+    freqs = jnp.where(m >= min_log_mel,
+                      min_log_hz * jnp.exp(logstep * (m - min_log_mel)),
+                      freqs)
+    return Tensor(freqs) if isinstance(mel, Tensor) else freqs
+
+
+def mel_frequencies(n_mels: int = 64, f_min: float = 0.0,
+                    f_max: float = 11025.0, htk: bool = False,
+                    dtype="float32"):
+    min_mel = hz_to_mel(float(f_min), htk=htk)
+    max_mel = hz_to_mel(float(f_max), htk=htk)
+    mels = jnp.linspace(min_mel, max_mel, n_mels)
+    return Tensor(mel_to_hz(mels, htk=htk).astype(str(dtype)))
+
+
+def fft_frequencies(sr: int, n_fft: int, dtype="float32"):
+    return Tensor(jnp.linspace(0, float(sr) / 2, 1 + n_fft // 2)
+                  .astype(str(dtype)))
+
+
+def compute_fbank_matrix(sr: int, n_fft: int, n_mels: int = 64,
+                         f_min: float = 0.0, f_max=None, htk: bool = False,
+                         norm="slaney", dtype="float32"):
+    """Triangular mel filterbank [n_mels, 1 + n_fft//2]."""
+    if f_max is None:
+        f_max = float(sr) / 2
+    fftfreqs = fft_frequencies(sr, n_fft)._data
+    mel_f = mel_frequencies(n_mels + 2, f_min, f_max, htk)._data
+    fdiff = jnp.diff(mel_f)
+    ramps = mel_f[:, None] - fftfreqs[None, :]
+    lower = -ramps[:-2] / fdiff[:-1, None]
+    upper = ramps[2:] / fdiff[1:, None]
+    weights = jnp.maximum(0, jnp.minimum(lower, upper))
+    if norm == "slaney":
+        enorm = 2.0 / (mel_f[2:n_mels + 2] - mel_f[:n_mels])
+        weights = weights * enorm[:, None]
+    elif isinstance(norm, (int, float)):
+        pn = jnp.maximum(
+            jnp.sum(jnp.abs(weights) ** norm, axis=-1,
+                    keepdims=True) ** (1.0 / norm), 1e-10)
+        weights = weights / pn
+    elif norm is not None:
+        raise ValueError(f"unsupported norm {norm!r}")
+    return Tensor(weights.astype(str(dtype)))
+
+
+def power_to_db(spect, ref_value: float = 1.0, amin: float = 1e-10,
+                top_db: float = 80.0):
+    """Power spectrogram → dB (functional.py:262)."""
+    if top_db is not None and top_db < 0:
+        raise ValueError("top_db must be non-negative")
+    if amin <= 0:
+        raise ValueError("amin must be strictly positive")
+    if ref_value <= 0:
+        raise ValueError("ref_value must be strictly positive")
+
+    def f(x):
+        log_spec = 10.0 * jnp.log10(jnp.maximum(amin, x))
+        log_spec = log_spec - 10.0 * math.log10(max(amin, ref_value))
+        if top_db is not None:
+            log_spec = jnp.maximum(log_spec, log_spec.max() - top_db)
+        return log_spec
+
+    if isinstance(spect, Tensor):
+        from ..framework.tensor import apply_op
+        return apply_op(f, spect, _op_name="power_to_db")
+    return f(jnp.asarray(spect))
+
+
+def create_dct(n_mfcc: int, n_mels: int, norm="ortho", dtype="float32"):
+    """DCT-II matrix [n_mels, n_mfcc] (functional.py:306)."""
+    n = jnp.arange(float(n_mels))
+    k = jnp.arange(float(n_mfcc))[:, None]
+    dct = jnp.cos(math.pi / float(n_mels) * (n + 0.5) * k)
+    if norm is None:
+        dct = dct * 2.0
+    else:
+        if norm != "ortho":
+            raise ValueError("norm must be 'ortho' or None")
+        ortho = jnp.full((n_mfcc,), math.sqrt(2.0 / n_mels))
+        ortho = ortho.at[0].set(math.sqrt(1.0 / n_mels))
+        dct = dct * ortho[:, None]
+    return Tensor(dct.T.astype(str(dtype)))
+
+
+# -- windows (window.py) ---------------------------------------------------
+
+def _general_cosine(M, a, sym):
+    if M <= 1:
+        return jnp.ones(max(M, 0))
+    if not sym:
+        M = M + 1
+    fac = jnp.linspace(-math.pi, math.pi, M)
+    w = jnp.zeros(M)
+    for k, ak in enumerate(a):
+        w = w + ak * jnp.cos(k * fac)
+    return w if sym or M == 1 else w[:-1]
+
+
+def _window_impl(name, M, sym, **kwargs):
+    name = name.lower()
+    if name in ("hamming",):
+        return _general_cosine(M, [0.54, 0.46], sym)
+    if name in ("hann", "hanning"):
+        return _general_cosine(M, [0.5, 0.5], sym)
+    if name == "blackman":
+        return _general_cosine(M, [0.42, 0.5, 0.08], sym)
+    if name == "nuttall":
+        return _general_cosine(M, [0.3635819, 0.4891775, 0.1365995,
+                                   0.0106411], sym)
+    if name in ("bartlett", "triang"):
+        if not sym:
+            M = M + 1
+        n = jnp.arange(M)
+        if name == "bartlett":
+            w = 1.0 - jnp.abs(2.0 * n / (M - 1) - 1.0)
+        else:
+            # triang has no zero endpoints
+            w = 1.0 - jnp.abs(2.0 * (n + 1) / (M + 1) - 1.0) \
+                if M % 2 else 1.0 - jnp.abs((2 * n + 1 - M) / M)
+        return w if sym else w[:-1]
+    if name == "cosine":
+        if not sym:
+            M = M + 1
+        w = jnp.sin(math.pi / M * (jnp.arange(M) + 0.5))
+        return w if sym else w[:-1]
+    if name == "gaussian":
+        std = kwargs.get("std", 7.0)
+        if not sym:
+            M = M + 1
+        n = jnp.arange(M) - (M - 1) / 2.0
+        w = jnp.exp(-(n ** 2) / (2 * std * std))
+        return w if sym else w[:-1]
+    if name == "exponential":
+        tau = kwargs.get("tau", 1.0)
+        if not sym:
+            M = M + 1
+        n = jnp.abs(jnp.arange(M) - (M - 1) / 2.0)
+        w = jnp.exp(-n / tau)
+        return w if sym else w[:-1]
+    if name == "kaiser":
+        beta = kwargs.get("beta", 12.0)
+        w = jnp.kaiser(M if sym else M + 1, beta)
+        return w if sym else w[:-1]
+    if name == "bohman":
+        if not sym:
+            M = M + 1
+        fac = jnp.abs(jnp.linspace(-1, 1, M))
+        w = (1 - fac) * jnp.cos(math.pi * fac) + \
+            1.0 / math.pi * jnp.sin(math.pi * fac)
+        return w if sym else w[:-1]
+    raise ValueError(f"unknown window {name!r}")
+
+
+def get_window(window, win_length: int, fftbins: bool = True,
+               dtype="float32"):
+    """Window by name, periodic by default (window.py:396)."""
+    if isinstance(window, (list, tuple)):
+        name, args = window[0], window[1:]
+        kw = {}
+        if name == "gaussian" and args:
+            kw["std"] = args[0]
+        elif name == "exponential" and args:
+            kw["tau"] = args[-1]
+        elif name == "kaiser" and args:
+            kw["beta"] = args[0]
+        w = _window_impl(name, win_length, not fftbins, **kw)
+    else:
+        w = _window_impl(window, win_length, not fftbins)
+    return Tensor(w.astype(str(dtype)))

@@ -1,0 +1,172 @@
+"""paddle_tpu.geometric — graph learning ops (reference:
+/root/reference/python/paddle/geometric/__init__.py: segment math,
+send_u_recv/send_ue_recv/send_uv message passing, reindex, sampling).
+
+TPU-first: everything is jax.ops.segment_* / gather — XLA's sorted-segment
+lowering replaces the reference's hand CUDA scatter kernels
+(paddle/phi/kernels/gpu/graph_send_recv_kernel.cu)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor, apply_op
+
+__all__ = ["segment_sum", "segment_mean", "segment_min", "segment_max",
+           "send_u_recv", "send_ue_recv", "send_uv", "reindex_graph",
+           "sample_neighbors"]
+
+
+def _idx(t):
+    arr = t._data if isinstance(t, Tensor) else jnp.asarray(t)
+    return arr.astype(jnp.int32)
+
+
+def _num_segments(segment_ids, count=None):
+    if count is not None:
+        return int(count)
+    ids = np.asarray(segment_ids)
+    return int(ids.max()) + 1 if ids.size else 0
+
+
+def _segment(op_name, jax_op, fill=0.0):
+    def op(data, segment_ids, name=None):
+        ids = _idx(segment_ids)
+        n = _num_segments(ids)
+
+        def f(d):
+            out = jax_op(d, ids, num_segments=n)
+            if fill is not None:
+                # empty segments → 0 (reference fills 0, not +-inf)
+                counts = jax.ops.segment_sum(
+                    jnp.ones(ids.shape[0]), ids, num_segments=n)
+                shape = (n,) + (1,) * (d.ndim - 1)
+                out = jnp.where(counts.reshape(shape) > 0, out, fill)
+            return out
+
+        return apply_op(f, data, _op_name=op_name)
+
+    op.__name__ = op_name
+    return op
+
+
+segment_sum = _segment("segment_sum", jax.ops.segment_sum, fill=None)
+segment_mean = _segment(
+    "segment_mean",
+    lambda d, ids, num_segments: jax.ops.segment_sum(
+        d, ids, num_segments=num_segments)
+    / jnp.maximum(jax.ops.segment_sum(
+        jnp.ones(ids.shape[0], d.dtype), ids,
+        num_segments=num_segments), 1.0).reshape(
+            (num_segments,) + (1,) * (d.ndim - 1)))
+segment_min = _segment("segment_min", jax.ops.segment_min)
+segment_max = _segment("segment_max", jax.ops.segment_max)
+
+_REDUCERS = {"sum": jax.ops.segment_sum, "mean": None,
+             "min": jax.ops.segment_min, "max": jax.ops.segment_max}
+
+
+def _reduce(msgs, dst, n, pool):
+    if pool == "mean":
+        s = jax.ops.segment_sum(msgs, dst, num_segments=n)
+        cnt = jax.ops.segment_sum(jnp.ones(dst.shape[0], msgs.dtype), dst,
+                                  num_segments=n)
+        return s / jnp.maximum(cnt, 1.0).reshape(
+            (n,) + (1,) * (msgs.ndim - 1))
+    red = _REDUCERS[pool]
+    out = red(msgs, dst, num_segments=n)
+    if pool in ("min", "max"):
+        cnt = jax.ops.segment_sum(jnp.ones(dst.shape[0]), dst,
+                                  num_segments=n)
+        out = jnp.where(cnt.reshape((n,) + (1,) * (msgs.ndim - 1)) > 0,
+                        out, 0.0)
+    return out
+
+
+def send_u_recv(x, src_index, dst_index, reduce_op: str = "sum",
+                out_size=None, name=None):
+    """Gather x[src] along edges, segment-reduce at dst
+    (message_passing/send_recv.py:55)."""
+    src, dst = _idx(src_index), _idx(dst_index)
+    # reference semantics: out_size None → one row per input node
+    n = int(out_size) if out_size is not None else int(x.shape[0])
+
+    def f(a):
+        return _reduce(a[src], dst, n, reduce_op)
+
+    return apply_op(f, x, _op_name="send_u_recv")
+
+
+def send_ue_recv(x, y, src_index, dst_index, message_op: str = "add",
+                 reduce_op: str = "sum", out_size=None, name=None):
+    """Combine x[src] with edge feature y, reduce at dst
+    (send_recv.py:210)."""
+    src, dst = _idx(src_index), _idx(dst_index)
+    n = int(out_size) if out_size is not None else int(x.shape[0])
+    combine = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+               "div": jnp.divide}[message_op]
+
+    def f(a, e):
+        return _reduce(combine(a[src], e), dst, n, reduce_op)
+
+    return apply_op(f, x, y, _op_name="send_ue_recv")
+
+
+def send_uv(x, y, src_index, dst_index, message_op: str = "add",
+            name=None):
+    """Per-edge message x[src] ⊕ y[dst] (send_recv.py:413)."""
+    src, dst = _idx(src_index), _idx(dst_index)
+    combine = {"add": jnp.add, "sub": jnp.subtract, "mul": jnp.multiply,
+               "div": jnp.divide}[message_op]
+
+    def f(a, b):
+        return combine(a[src], b[dst])
+
+    return apply_op(f, x, y, _op_name="send_uv")
+
+
+def reindex_graph(x, neighbors, count, value_buffer=None, index_buffer=None,
+                  name=None):
+    """Compact global ids to local ids (reindex.py:25). Host-side: output
+    shape is data-dependent (hash-map semantics), like the reference's
+    CPU/GPU hashtable kernel."""
+    xs = np.asarray(_idx(x))
+    nb = np.asarray(_idx(neighbors))
+    cnt = np.asarray(_idx(count))
+    uniq = {}
+    for v in xs.tolist():
+        uniq.setdefault(v, len(uniq))
+    out_nodes = list(xs.tolist())
+    for v in nb.tolist():
+        if v not in uniq:
+            uniq[v] = len(uniq)
+            out_nodes.append(v)
+    reindex_src = np.array([uniq[v] for v in nb.tolist()], np.int32)
+    dst = np.repeat(np.arange(len(xs), dtype=np.int32), cnt)
+    return (Tensor(jnp.asarray(reindex_src)), Tensor(jnp.asarray(dst)),
+            Tensor(jnp.asarray(np.array(out_nodes, np.int32))))
+
+
+def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
+                     eids=None, return_eids: bool = False,
+                     perm_buffer=None, name=None):
+    """CSC neighbor sampling (sampling/neighbors.py:26). Host-side RNG
+    (data-dependent output size); seeded from numpy's global RNG so
+    successive calls draw different subgraphs."""
+    if return_eids:
+        raise NotImplementedError("return_eids is not supported yet")
+    r = np.asarray(_idx(row))
+    cp = np.asarray(_idx(colptr))
+    nodes = np.asarray(_idx(input_nodes))
+    rng = np.random
+    out_neighbors, out_count = [], []
+    for v in nodes.tolist():
+        beg, end = int(cp[v]), int(cp[v + 1])
+        neigh = r[beg:end]
+        if 0 <= sample_size < len(neigh):
+            neigh = rng.choice(neigh, size=sample_size, replace=False)
+        out_neighbors.extend(neigh.tolist())
+        out_count.append(len(neigh))
+    return (Tensor(jnp.asarray(np.array(out_neighbors, np.int32))),
+            Tensor(jnp.asarray(np.array(out_count, np.int32))))

@@ -1,0 +1,86 @@
+"""paddle_tpu.text (reference: /root/reference/python/paddle/text/
+__init__.py — viterbi_decode:31 / ViterbiDecoder:110; dataset loaders are
+IO-bound and live in paddle_tpu.io).
+
+TPU-first: the Viterbi DP is a ``lax.scan`` over time with a vectorized
+[B, C_prev, C] max-plus inner step (the reference is a hand CUDA kernel,
+paddle/phi/kernels/gpu/viterbi_decode_kernel.cu); variable lengths are
+handled by identity backpointers past each sequence's end, so the whole
+batch decodes in one compiled graph.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+from ..nn.layer_base import Layer
+
+__all__ = ["viterbi_decode", "ViterbiDecoder"]
+
+
+def _arr(t):
+    return t._data if isinstance(t, Tensor) else jnp.asarray(t)
+
+
+def viterbi_decode(potentials, transition_params, lengths,
+                   include_bos_eos_tag: bool = True, name=None):
+    """Batched Viterbi decode → (scores [B], paths [B, max_len])."""
+    pot = _arr(potentials)
+    trans = _arr(transition_params)
+    lens = _arr(lengths).astype(jnp.int32)
+    b, seq_len, c = pot.shape
+    max_len = int(jnp.max(lens)) if lens.size else 0
+    if max_len == 0:
+        return (Tensor(jnp.zeros((b,), pot.dtype)),
+                Tensor(jnp.zeros((b, 0), jnp.int32)))
+    start_tag, stop_tag = c - 1, c - 2
+
+    alpha = pot[:, 0]
+    if include_bos_eos_tag:
+        alpha = alpha + trans[start_tag][None]
+
+    identity_bp = jnp.broadcast_to(jnp.arange(c, dtype=jnp.int32), (b, c))
+
+    def step(alpha, t):
+        scores = alpha[:, :, None] + trans[None]          # [B, Cprev, C]
+        best_prev = jnp.argmax(scores, axis=1).astype(jnp.int32)
+        alpha_new = jnp.max(scores, axis=1) + pot[:, t]
+        live = (t < lens)[:, None]
+        return (jnp.where(live, alpha_new, alpha),
+                jnp.where(live, best_prev, identity_bp))
+
+    alpha, hists = jax.lax.scan(step, alpha, jnp.arange(1, max_len))
+    if include_bos_eos_tag:
+        alpha = alpha + trans[:, stop_tag][None]
+
+    scores = jnp.max(alpha, axis=1)
+    last_tag = jnp.argmax(alpha, axis=1).astype(jnp.int32)
+
+    def back(tag, hist_t):
+        prev = jnp.take_along_axis(hist_t, tag[:, None], 1)[:, 0]
+        return prev, prev
+
+    _, prev_tags = jax.lax.scan(back, last_tag, hists, reverse=True)
+    # prev_tags[k] = tag at position k (k = 0..max_len-2)
+    path = jnp.concatenate(
+        [jnp.swapaxes(prev_tags, 0, 1), last_tag[:, None]], axis=1) \
+        if max_len > 1 else last_tag[:, None]
+    # zero-pad positions beyond each sequence's length
+    path = jnp.where(jnp.arange(max_len)[None] < lens[:, None], path, 0)
+    return Tensor(scores), Tensor(path)
+
+
+class ViterbiDecoder(Layer):
+    """Layer wrapper holding the transition matrix
+    (text/viterbi_decode.py:110)."""
+
+    def __init__(self, transitions, include_bos_eos_tag: bool = True,
+                 name=None):
+        super().__init__()
+        self.transitions = transitions
+        self.include_bos_eos_tag = include_bos_eos_tag
+
+    def forward(self, potentials, lengths):
+        return viterbi_decode(potentials, self.transitions, lengths,
+                              self.include_bos_eos_tag)

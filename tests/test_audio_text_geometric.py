@@ -1,0 +1,229 @@
+"""Tests for audio / text / geometric packages (model: reference
+test/legacy_test/test_audio_functions.py, test_viterbi_decode_op.py,
+test_graph_send_recv_op.py — numeric checks vs numpy/brute-force refs)."""
+import math
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio, geometric, text
+
+
+# -- audio -----------------------------------------------------------------
+
+def test_mel_hz_roundtrip():
+    for htk in (False, True):
+        f = 4000.0
+        m = audio.functional.hz_to_mel(f, htk=htk)
+        f2 = audio.functional.mel_to_hz(m, htk=htk)
+        assert f2 == pytest.approx(f, rel=1e-5)
+
+
+def test_fft_frequencies():
+    out = audio.functional.fft_frequencies(sr=16000, n_fft=512).numpy()
+    assert out.shape == (257,)
+    assert out[0] == 0 and out[-1] == pytest.approx(8000.0)
+
+
+def test_fbank_matrix_rows_nonneg():
+    fb = audio.functional.compute_fbank_matrix(
+        sr=16000, n_fft=512, n_mels=40).numpy()
+    assert fb.shape == (40, 257)
+    assert (fb >= 0).all()
+    assert (fb.sum(axis=1) > 0).all()  # every filter covers some bins
+
+
+def test_power_to_db():
+    x = paddle.to_tensor(np.array([1.0, 10.0, 100.0], np.float32))
+    db = audio.functional.power_to_db(x, top_db=None).numpy()
+    np.testing.assert_allclose(db, [0.0, 10.0, 20.0], atol=1e-4)
+
+
+def test_get_window_matches_numpy():
+    w = audio.functional.get_window("hann", 16, fftbins=True).numpy()
+    np.testing.assert_allclose(w, np.hanning(17)[:-1], atol=1e-6)
+    w = audio.functional.get_window("hamming", 16, fftbins=False).numpy()
+    np.testing.assert_allclose(w, np.hamming(16), atol=1e-6)
+
+
+def test_spectrogram_parseval_ish():
+    sr = 8000
+    t = np.arange(sr // 4) / sr
+    sig = np.sin(2 * math.pi * 1000 * t).astype(np.float32)
+    spec = audio.Spectrogram(n_fft=256, hop_length=128)(
+        paddle.to_tensor(sig[None]))
+    out = spec.numpy()[0]
+    assert out.shape[0] == 129
+    # energy peak at 1 kHz bin = 1000/8000*256 = bin 32
+    assert np.abs(out.mean(axis=1).argmax() - 32) <= 1
+
+
+def test_mfcc_shapes_and_grad():
+    sig = paddle.to_tensor(
+        np.random.RandomState(0).randn(2, 4000).astype(np.float32))
+    sig.stop_gradient = False
+    mfcc = audio.MFCC(sr=8000, n_mfcc=13, n_fft=256, n_mels=40,
+                      top_db=80.0)
+    out = mfcc(sig)
+    assert out.shape[0] == 2 and out.shape[1] == 13
+    out.sum().backward()
+    assert sig.grad is not None
+
+
+# -- geometric -------------------------------------------------------------
+
+def test_segment_ops():
+    data = paddle.to_tensor(np.array([[1., 2.], [3., 4.], [5., 6.]],
+                                     np.float32))
+    ids = paddle.to_tensor(np.array([0, 0, 1], np.int32))
+    np.testing.assert_allclose(geometric.segment_sum(data, ids).numpy(),
+                               [[4., 6.], [5., 6.]])
+    np.testing.assert_allclose(geometric.segment_mean(data, ids).numpy(),
+                               [[2., 3.], [5., 6.]])
+    np.testing.assert_allclose(geometric.segment_min(data, ids).numpy(),
+                               [[1., 2.], [5., 6.]])
+    np.testing.assert_allclose(geometric.segment_max(data, ids).numpy(),
+                               [[3., 4.], [5., 6.]])
+
+
+def test_send_u_recv():
+    x = paddle.to_tensor(np.array([[0., 2., 3.], [1., 4., 5.],
+                                   [2., 6., 7.]], np.float32))
+    src = paddle.to_tensor(np.array([0, 1, 2, 0], np.int32))
+    dst = paddle.to_tensor(np.array([1, 2, 1, 0], np.int32))
+    out = geometric.send_u_recv(x, src, dst, reduce_op="sum")
+    expect = np.zeros((3, 3), np.float32)
+    for s, d in [(0, 1), (1, 2), (2, 1), (0, 0)]:
+        expect[d] += x.numpy()[s]
+    np.testing.assert_allclose(out.numpy(), expect)
+    out_max = geometric.send_u_recv(x, src, dst, reduce_op="max")
+    assert out_max.numpy()[1].tolist() == [2., 6., 7.]
+
+
+def test_send_u_recv_grad():
+    x = paddle.to_tensor(np.eye(3, dtype=np.float32))
+    x.stop_gradient = False
+    src = paddle.to_tensor(np.array([0, 1], np.int32))
+    dst = paddle.to_tensor(np.array([1, 1], np.int32))
+    geometric.send_u_recv(x, src, dst).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy().sum(axis=1), [3., 3., 0.])
+
+
+def test_send_ue_recv_and_uv():
+    x = paddle.to_tensor(np.array([[1.], [2.]], np.float32))
+    e = paddle.to_tensor(np.array([[10.], [20.]], np.float32))
+    src = paddle.to_tensor(np.array([0, 1], np.int32))
+    dst = paddle.to_tensor(np.array([1, 0], np.int32))
+    out = geometric.send_ue_recv(x, e, src, dst, "add", "sum")
+    np.testing.assert_allclose(out.numpy(), [[22.], [11.]])
+    uv = geometric.send_uv(x, x, src, dst, "mul")
+    np.testing.assert_allclose(uv.numpy(), [[2.], [2.]])
+
+
+def test_reindex_graph():
+    x = paddle.to_tensor(np.array([0, 5, 9], np.int32))
+    neighbors = paddle.to_tensor(np.array([5, 9, 7, 0], np.int32))
+    count = paddle.to_tensor(np.array([2, 1, 1], np.int32))
+    reindex_src, reindex_dst, out_nodes = geometric.reindex_graph(
+        x, neighbors, count)
+    assert out_nodes.numpy().tolist() == [0, 5, 9, 7]
+    assert reindex_src.numpy().tolist() == [1, 2, 3, 0]
+    assert reindex_dst.numpy().tolist() == [0, 0, 1, 2]
+
+
+def test_sample_neighbors():
+    # CSC graph: node 0 ← {1,2}, node 1 ← {0}, node 2 ← {0,1}
+    row = paddle.to_tensor(np.array([1, 2, 0, 0, 1], np.int32))
+    colptr = paddle.to_tensor(np.array([0, 2, 3, 5], np.int32))
+    nodes = paddle.to_tensor(np.array([0, 2], np.int32))
+    nb, cnt = geometric.sample_neighbors(row, colptr, nodes,
+                                         sample_size=-1)
+    assert cnt.numpy().tolist() == [2, 2]
+    assert nb.numpy().tolist() == [1, 2, 0, 1]
+    nb2, cnt2 = geometric.sample_neighbors(row, colptr, nodes,
+                                           sample_size=1)
+    assert cnt2.numpy().tolist() == [1, 1]
+
+
+def test_send_u_recv_default_out_size_covers_isolated_nodes():
+    x = paddle.to_tensor(np.ones((5, 2), np.float32))
+    src = paddle.to_tensor(np.array([0, 1], np.int32))
+    dst = paddle.to_tensor(np.array([1, 2], np.int32))
+    out = geometric.send_u_recv(x, src, dst)
+    assert out.shape == [5, 2]  # rows for isolated nodes 3, 4 too
+    np.testing.assert_allclose(out.numpy()[3:], 0.0)
+
+
+def test_sample_neighbors_is_stochastic():
+    row = paddle.to_tensor(np.arange(100, dtype=np.int32))
+    colptr = paddle.to_tensor(np.array([0, 100], np.int32))
+    nodes = paddle.to_tensor(np.array([0], np.int32))
+    draws = {tuple(geometric.sample_neighbors(
+        row, colptr, nodes, sample_size=5)[0].numpy().tolist())
+        for _ in range(5)}
+    assert len(draws) > 1  # different subgraphs across calls
+
+
+def test_reference_default_shapes():
+    # Spectrogram defaults: power=1.0, hop=512 (reference layers.py:86)
+    sig = paddle.to_tensor(np.random.RandomState(1)
+                           .randn(1, 2048).astype(np.float32))
+    spec = audio.Spectrogram()(sig)
+    assert spec.shape == [1, 257, 5]  # (2048+512-512)//512+1 frames
+    import pytest as _pt
+    with _pt.raises(ValueError):
+        audio.Spectrogram(power=0.0)
+
+
+def test_fbank_pnorm():
+    fb = audio.functional.compute_fbank_matrix(
+        sr=16000, n_fft=512, n_mels=8, norm=2.0).numpy()
+    norms = np.sqrt((fb ** 2).sum(axis=1))
+    np.testing.assert_allclose(norms, 1.0, rtol=1e-4)
+
+
+# -- text ------------------------------------------------------------------
+
+def _brute_viterbi(pot, trans, length, include):
+    import itertools
+    c = pot.shape[-1]
+    best, best_path = -np.inf, None
+    for path in itertools.product(range(c), repeat=length):
+        s = pot[0, path[0]]
+        if include:
+            s += trans[c - 1, path[0]]
+        for t in range(1, length):
+            s += trans[path[t - 1], path[t]] + pot[t, path[t]]
+        if include:
+            s += trans[path[-1], c - 2]
+        if s > best:
+            best, best_path = s, path
+    return best, list(best_path)
+
+
+@pytest.mark.parametrize("include", [False, True])
+def test_viterbi_matches_bruteforce(include):
+    rng = np.random.RandomState(0)
+    b, l, c = 3, 5, 4
+    pot = rng.randn(b, l, c).astype(np.float32)
+    trans = rng.randn(c, c).astype(np.float32)
+    lens = np.array([5, 3, 1], np.int32)
+    scores, paths = text.viterbi_decode(
+        paddle.to_tensor(pot), paddle.to_tensor(trans),
+        paddle.to_tensor(lens), include_bos_eos_tag=include)
+    for i in range(b):
+        s, p = _brute_viterbi(pot[i], trans, int(lens[i]), include)
+        assert float(scores.numpy()[i]) == pytest.approx(s, rel=1e-4)
+        assert paths.numpy()[i, :lens[i]].tolist() == p
+
+
+def test_viterbi_decoder_layer():
+    rng = np.random.RandomState(1)
+    trans = paddle.to_tensor(rng.randn(3, 3).astype(np.float32))
+    dec = text.ViterbiDecoder(trans, include_bos_eos_tag=False)
+    pot = paddle.to_tensor(rng.randn(2, 4, 3).astype(np.float32))
+    lens = paddle.to_tensor(np.array([4, 2], np.int32))
+    scores, paths = dec(pot, lens)
+    assert scores.shape == [2] and paths.shape == [2, 4]
+    assert (paths.numpy()[1, 2:] == 0).all()
