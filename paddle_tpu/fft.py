@@ -1,0 +1,184 @@
+"""Discrete Fourier transforms (reference: python/paddle/fft.py — the
+reference backs these with pocketfft/cuFFT kernels, phi/kernels/fft_*;
+here XLA's native FFT HLO does the work via jnp.fft, so the whole module
+is thin dispatch with paddle argument conventions).
+
+Norm convention matches the reference: "backward" (default), "ortho",
+"forward".
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.tensor import Tensor, apply_op
+
+__all__ = [
+    "fft", "ifft", "rfft", "irfft", "hfft", "ihfft",
+    "fft2", "ifft2", "rfft2", "irfft2", "hfft2", "ihfft2",
+    "fftn", "ifftn", "rfftn", "irfftn", "hfftn", "ihfftn",
+    "fftfreq", "rfftfreq", "fftshift", "ifftshift",
+]
+
+_NORMS = ("backward", "ortho", "forward")
+
+
+def _check_norm(norm):
+    if norm is None:
+        return "backward"
+    if norm not in _NORMS:
+        raise ValueError(
+            f"norm should be one of {_NORMS}, but got {norm!r}")
+    return norm
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _fft1(fn, name, x, n, axis, norm):
+    norm = _check_norm(norm)
+    return apply_op(lambda a: fn(a, n=n, axis=axis, norm=norm), _t(x),
+                    _op_name=name)
+
+
+def _fftn(fn, name, x, s, axes, norm):
+    norm = _check_norm(norm)
+    return apply_op(lambda a: fn(a, s=s, axes=axes, norm=norm), _t(x),
+                    _op_name=name)
+
+
+def fft(x, n=None, axis=-1, norm="backward", name=None):
+    return _fft1(jnp.fft.fft, "fft", x, n, axis, norm)
+
+
+def ifft(x, n=None, axis=-1, norm="backward", name=None):
+    return _fft1(jnp.fft.ifft, "ifft", x, n, axis, norm)
+
+
+def rfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _fft1(jnp.fft.rfft, "rfft", x, n, axis, norm)
+
+
+def irfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _fft1(jnp.fft.irfft, "irfft", x, n, axis, norm)
+
+
+def hfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _fft1(jnp.fft.hfft, "hfft", x, n, axis, norm)
+
+
+def ihfft(x, n=None, axis=-1, norm="backward", name=None):
+    return _fft1(jnp.fft.ihfft, "ihfft", x, n, axis, norm)
+
+
+def fft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _fftn(jnp.fft.fft2, "fft2", x, s, axes, norm)
+
+
+def ifft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _fftn(jnp.fft.ifft2, "ifft2", x, s, axes, norm)
+
+
+def rfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _fftn(jnp.fft.rfft2, "rfft2", x, s, axes, norm)
+
+
+def irfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    return _fftn(jnp.fft.irfft2, "irfft2", x, s, axes, norm)
+
+
+def hfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    # jnp.fft has no hfft2; compose: fft on the leading transform axis then
+    # hfft over the last (verified against scipy.fft.hfft2 for all norms).
+    norm = _check_norm(norm)
+
+    def _h2(a):
+        n0 = None if s is None else s[0]
+        n1 = None if s is None else s[1]
+        a = jnp.fft.fft(a, n=n0, axis=axes[0], norm=norm)
+        return jnp.fft.hfft(a, n=n1, axis=axes[1], norm=norm)
+
+    return apply_op(_h2, _t(x), _op_name="hfft2")
+
+
+def ihfft2(x, s=None, axes=(-2, -1), norm="backward", name=None):
+    norm = _check_norm(norm)
+
+    def _ih2(a):
+        n0 = None if s is None else s[0]
+        n1 = None if s is None else s[1]
+        a = jnp.fft.ihfft(a, n=n1, axis=axes[1], norm=norm)
+        return jnp.fft.ifft(a, n=n0, axis=axes[0], norm=norm)
+
+    return apply_op(_ih2, _t(x), _op_name="ihfft2")
+
+
+def fftn(x, s=None, axes=None, norm="backward", name=None):
+    return _fftn(jnp.fft.fftn, "fftn", x, s, axes, norm)
+
+
+def ifftn(x, s=None, axes=None, norm="backward", name=None):
+    return _fftn(jnp.fft.ifftn, "ifftn", x, s, axes, norm)
+
+
+def rfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _fftn(jnp.fft.rfftn, "rfftn", x, s, axes, norm)
+
+
+def irfftn(x, s=None, axes=None, norm="backward", name=None):
+    return _fftn(jnp.fft.irfftn, "irfftn", x, s, axes, norm)
+
+
+def hfftn(x, s=None, axes=None, norm="backward", name=None):
+    if axes is None:
+        axes = tuple(range(_t(x).ndim))
+    norm = _check_norm(norm)
+
+    def _hn(a):
+        ss = s or [None] * len(axes)
+        for ax, n in zip(axes[:-1], ss[:-1]):
+            a = jnp.fft.fft(a, n=n, axis=ax, norm=norm)
+        return jnp.fft.hfft(a, n=ss[-1], axis=axes[-1], norm=norm)
+
+    return apply_op(_hn, _t(x), _op_name="hfftn")
+
+
+def ihfftn(x, s=None, axes=None, norm="backward", name=None):
+    if axes is None:
+        axes = tuple(range(_t(x).ndim))
+    norm = _check_norm(norm)
+
+    def _ihn(a):
+        ss = s or [None] * len(axes)
+        a = jnp.fft.ihfft(a, n=ss[-1], axis=axes[-1], norm=norm)
+        for ax, n in zip(reversed(axes[:-1]), reversed(ss[:-1])):
+            a = jnp.fft.ifft(a, n=n, axis=ax, norm=norm)
+        return a
+
+    return apply_op(_ihn, _t(x), _op_name="ihfftn")
+
+
+def fftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.fftfreq(n, d=d)
+    if dtype is not None:
+        from .framework.dtype import to_dtype
+        out = out.astype(to_dtype(dtype))
+    return Tensor(out)
+
+
+def rfftfreq(n, d=1.0, dtype=None, name=None):
+    out = jnp.fft.rfftfreq(n, d=d)
+    if dtype is not None:
+        from .framework.dtype import to_dtype
+        out = out.astype(to_dtype(dtype))
+    return Tensor(out)
+
+
+def fftshift(x, axes=None, name=None):
+    return apply_op(lambda a: jnp.fft.fftshift(a, axes=axes), _t(x),
+                    _op_name="fftshift")
+
+
+def ifftshift(x, axes=None, name=None):
+    return apply_op(lambda a: jnp.fft.ifftshift(a, axes=axes), _t(x),
+                    _op_name="ifftshift")

@@ -1,0 +1,63 @@
+"""paddle.hub parity: list/help/load entrypoints from a hubconf.py in a
+local directory or github-style repo dir (reference: python/paddle/hub.py).
+Network fetch is gated off (zero-egress environments); local sources work
+fully."""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+
+__all__ = ["list", "help", "load"]
+
+MODULE_HUBCONF = "hubconf.py"
+
+
+def _load_hubconf(repo_dir):
+    path = os.path.join(repo_dir, MODULE_HUBCONF)
+    if not os.path.exists(path):
+        raise FileNotFoundError(f"no {MODULE_HUBCONF} in {repo_dir}")
+    # unique module name per repo so repeated loads from different repos
+    # never alias each other in sys.modules
+    mod_name = f"paddle_tpu_hubconf_{abs(hash(os.path.abspath(path)))}"
+    spec = importlib.util.spec_from_file_location(mod_name, path)
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[mod_name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _resolve(repo_dir, source):
+    if source not in ("local", "github", "gitee"):
+        raise ValueError(
+            f"unknown source {source!r}: expected local/github/gitee")
+    if source != "local":
+        raise RuntimeError(
+            "remote hub sources are unavailable in this build (no network "
+            "egress); clone the repo and use source='local'")
+    return repo_dir
+
+
+def list(repo_dir, source="github", force_reload=False):  # noqa: A001
+    """List callable entrypoints exposed by the repo's hubconf."""
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    return [k for k, v in vars(mod).items()
+            if callable(v) and not k.startswith("_")]
+
+
+def help(repo_dir, model, source="github", force_reload=False):  # noqa: A001
+    """Return the docstring of one entrypoint."""
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    entry = getattr(mod, model, None)
+    if entry is None or not callable(entry):
+        raise RuntimeError(f"no callable entrypoint {model!r} in hubconf")
+    return entry.__doc__
+
+
+def load(repo_dir, model, source="github", force_reload=False, **kwargs):
+    """Instantiate an entrypoint: hub.load(dir, 'resnet50', source='local')."""
+    mod = _load_hubconf(_resolve(repo_dir, source))
+    entry = getattr(mod, model, None)
+    if entry is None or not callable(entry):
+        raise RuntimeError(f"no callable entrypoint {model!r} in hubconf")
+    return entry(**kwargs)

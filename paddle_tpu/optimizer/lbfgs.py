@@ -1,0 +1,178 @@
+"""L-BFGS optimizer (reference: python/paddle/optimizer/lbfgs.py — full-batch
+quasi-Newton with strong-Wolfe line search over a closure).
+
+TPU-native: parameters are flattened into ONE vector so the two-loop
+recursion is a handful of dot products/axpys XLA fuses; history lives as
+device arrays. The closure re-evaluates loss+grads (each evaluation is a
+normal traced forward/backward)."""
+from __future__ import annotations
+
+from typing import Callable, List, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Parameter, Tensor, no_grad
+from .optimizer import Optimizer
+
+__all__ = ["LBFGS"]
+
+
+def _flat(arrs):
+    return jnp.concatenate([jnp.ravel(a.astype(jnp.float32)) for a in arrs])
+
+
+class LBFGS(Optimizer):
+    def __init__(self, learning_rate=1.0, max_iter=20, max_eval=None,
+                 tolerance_grad=1e-7, tolerance_change=1e-9, history_size=100,
+                 line_search_fn=None, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip,
+                         name)
+        self.max_iter = max_iter
+        self.max_eval = max_eval if max_eval is not None else max_iter * 5 // 4
+        self.tolerance_grad = tolerance_grad
+        self.tolerance_change = tolerance_change
+        self.history_size = history_size
+        if line_search_fn not in (None, "strong_wolfe"):
+            raise ValueError("line_search_fn must be None or 'strong_wolfe'")
+        self.line_search_fn = line_search_fn
+        self._s: List = []
+        self._y: List = []
+
+    # -- param vector plumbing --------------------------------------------
+    def _gather(self):
+        return _flat([p._data for p in self._parameter_list])
+
+    def _gather_grad(self):
+        params_grads = [(p, p.grad) for p in self._parameter_list
+                        if p.grad is not None]
+        if self._grad_clip is not None and params_grads:
+            params_grads = self._grad_clip(params_grads)
+        clipped = {id(p): g for p, g in params_grads}
+        gs = []
+        for p in self._parameter_list:
+            g = clipped.get(id(p))
+            garr = jnp.zeros_like(p._data) if g is None else \
+                (g._data if isinstance(g, Tensor) else g)
+            if self._weight_decay:
+                wd = self._weight_decay
+                garr = wd.apply(p._data.astype(garr.dtype), garr) \
+                    if hasattr(wd, "apply") else garr + float(wd) * \
+                    p._data.astype(garr.dtype)
+            gs.append(garr)
+        return _flat(gs)
+
+    def _scatter(self, vec):
+        off = 0
+        for p in self._parameter_list:
+            n = int(np.prod(p._data.shape)) if p._data.shape else 1
+            chunk = vec[off:off + n].reshape(p._data.shape)
+            p._data = chunk.astype(p._data.dtype)
+            off += n
+
+    # -- two-loop recursion ------------------------------------------------
+    def _direction(self, g):
+        q = g
+        alphas = []
+        for s, y in zip(reversed(self._s), reversed(self._y)):
+            rho = 1.0 / jnp.vdot(y, s)
+            a = rho * jnp.vdot(s, q)
+            q = q - a * y
+            alphas.append((a, rho))
+        if self._s:
+            s, y = self._s[-1], self._y[-1]
+            gamma = jnp.vdot(s, y) / jnp.vdot(y, y)
+            q = gamma * q
+        for (a, rho), (s, y) in zip(reversed(alphas),
+                                    zip(self._s, self._y)):
+            b = rho * jnp.vdot(y, q)
+            q = q + (a - b) * s
+        return -q
+
+    @no_grad()
+    def step(self, closure: Optional[Callable] = None):
+        if closure is None:
+            raise ValueError("LBFGS.step requires a closure that recomputes "
+                             "the loss (call loss.backward() inside)")
+        lr = self.get_lr()
+        x = self._gather()
+
+        def call_closure():
+            # closure runs forward+backward with grads enabled
+            from ..framework.tensor import enable_grad
+            with enable_grad():
+                return closure()
+
+        loss = call_closure()
+        f = float(loss._data if isinstance(loss, Tensor) else loss)
+        g = self._gather_grad()
+        n_eval = 1
+        x_prev, g_prev = x, g
+
+        for _ in range(self.max_iter):
+            if float(jnp.max(jnp.abs(g))) <= self.tolerance_grad:
+                break
+            d = self._direction(g)
+            gtd = float(jnp.vdot(g, d))
+            if gtd > -1e-20:  # not a descent direction; reset history
+                self._s.clear(); self._y.clear()
+                d = -g
+                gtd = float(jnp.vdot(g, d))
+            t = lr
+            if self.line_search_fn == "strong_wolfe":
+                t, f, g, n_ev = self._strong_wolfe(call_closure, x, d, f, g,
+                                                   gtd, t)
+                n_eval += n_ev
+                x = x + t * d
+                self._scatter(x)
+            else:
+                x = x + t * d
+                self._scatter(x)
+                loss = call_closure()
+                f = float(loss._data if isinstance(loss, Tensor) else loss)
+                g = self._gather_grad()
+                n_eval += 1
+            s = x - x_prev
+            y = g - g_prev
+            if float(jnp.vdot(s, y)) > 1e-10:
+                self._s.append(s); self._y.append(y)
+                if len(self._s) > self.history_size:
+                    self._s.pop(0); self._y.pop(0)
+            if float(jnp.max(jnp.abs(s))) <= self.tolerance_change:
+                break
+            x_prev, g_prev = x, g
+            if n_eval >= self.max_eval:
+                break
+        self._step_count += 1
+        for p in self._parameter_list:
+            p.grad_node = None
+        return Tensor(jnp.asarray(f))
+
+    def _strong_wolfe(self, closure, x, d, f0, g0, gtd0, t,
+                      c1=1e-4, c2=0.9, max_ls=25):
+        """Bisection-based strong-Wolfe line search (contract of the
+        reference's _strong_wolfe, lbfgs.py)."""
+        lo, hi = 0.0, None
+        f_prev, n_ev = f0, 0
+        for _ in range(max_ls):
+            self._scatter(x + t * d)
+            loss = closure()
+            f = float(loss._data if isinstance(loss, Tensor) else loss)
+            g = self._gather_grad()
+            n_ev += 1
+            t_eval = t  # the step size f/g above belong to
+            if f > f0 + c1 * t * gtd0 or f >= f_prev:
+                hi = t
+            else:
+                gtd = float(jnp.vdot(g, d))
+                if abs(gtd) <= -c2 * gtd0:
+                    return t, f, g, n_ev
+                if gtd >= 0:
+                    hi = t
+                else:
+                    lo = t
+            t = (lo + hi) / 2.0 if hi is not None else t * 2.0
+            f_prev = f
+        # exhausted: return the last *evaluated* point so f/g match t
+        return t_eval, f, g, n_ev

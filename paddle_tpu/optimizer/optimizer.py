@@ -110,8 +110,11 @@ class Optimizer:
         for p, g in params_grads:
             garr = g._data if isinstance(g, Tensor) else g
             if self._weight_decay and not isinstance(self, _DecoupledWD):
-                wd = float(self._weight_decay)
-                garr = garr + wd * p._data.astype(garr.dtype)
+                wd = self._weight_decay
+                if hasattr(wd, "apply"):  # L1Decay/L2Decay regularizer
+                    garr = wd.apply(p._data.astype(garr.dtype), garr)
+                else:
+                    garr = garr + float(wd) * p._data.astype(garr.dtype)
             new_data = self._update_param(p, garr)
             p._data = new_data.astype(p._data.dtype)
             p.grad_node = None

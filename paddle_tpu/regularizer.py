@@ -1,0 +1,39 @@
+"""Weight-decay regularizers (reference: python/paddle/regularizer.py —
+L1Decay/L2Decay appended as grad terms by the optimizer).
+
+TPU-native: a regularizer is a pure function grad' = grad + d/dp penalty(p);
+the optimizer applies it inside its jitted update, so XLA fuses it with the
+main update kernel (the reference has dedicated CUDA append-regularization
+ops)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay"]
+
+
+class WeightDecayRegularizer:
+    def apply(self, param, grad):
+        raise NotImplementedError
+
+
+class L1Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def apply(self, param, grad):
+        return grad + self.coeff * jnp.sign(param)
+
+    def __repr__(self):
+        return f"L1Decay(coeff={self.coeff})"
+
+
+class L2Decay(WeightDecayRegularizer):
+    def __init__(self, coeff=0.0):
+        self.coeff = float(coeff)
+
+    def apply(self, param, grad):
+        return grad + self.coeff * param
+
+    def __repr__(self):
+        return f"L2Decay(coeff={self.coeff})"

@@ -1,0 +1,139 @@
+"""Signal processing: frame / overlap_add / stft / istft.
+
+Reference: python/paddle/signal.py (frame:24, overlap_add:131, stft:201,
+istft:365 — backed by phi frame/overlap_add kernels + fft). TPU-native:
+framing is a gather/reshape XLA fuses for free; FFTs are native HLO.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .framework.tensor import Tensor, apply_op
+
+__all__ = ["frame", "overlap_add", "stft", "istft"]
+
+
+def _t(x):
+    return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
+
+
+def _frame_impl(a, frame_length, hop_length, axis):
+    if axis not in (0, -1):
+        raise ValueError("axis must be 0 or -1")
+    if hop_length <= 0:
+        raise ValueError(f"hop_length must be positive, got {hop_length}")
+    n = a.shape[axis]
+    if frame_length > n:
+        raise ValueError(
+            f"frame_length ({frame_length}) exceeds signal length ({n}) "
+            f"on axis {axis}")
+    num_frames = 1 + (n - frame_length) // hop_length
+    starts = jnp.arange(num_frames) * hop_length
+    offs = jnp.arange(frame_length)
+    if axis == -1:
+        idx = starts[:, None] + offs[None, :]          # [F, L]
+        out = jnp.take(a, idx, axis=a.ndim - 1)        # [..., F, L]
+        return jnp.swapaxes(out, -1, -2)               # [..., L, F]
+    idx = starts[None, :] + offs[:, None]              # [L, F]
+    return jnp.take(a, idx, axis=0)                    # [L, F, ...]
+
+
+def frame(x, frame_length, hop_length, axis=-1, name=None):
+    """Slice overlapping frames off the signal (paddle.signal.frame)."""
+    return apply_op(
+        lambda a: _frame_impl(a, frame_length, hop_length, axis), _t(x),
+        _op_name="frame")
+
+
+def _overlap_add_impl(a, hop_length, axis):
+    if axis not in (0, -1):
+        raise ValueError("axis must be 0 or -1")
+    if axis == 0:
+        a = jnp.moveaxis(a, 1, -1)
+        a = jnp.moveaxis(a, 0, -2)  # [..., L, F] ordering
+        res = _overlap_add_impl(a, hop_length, -1)
+        return jnp.moveaxis(res, -1, 0)
+    frame_length, num_frames = a.shape[-2], a.shape[-1]
+    out_len = (num_frames - 1) * hop_length + frame_length
+    batch = a.shape[:-2]
+    out = jnp.zeros(batch + (out_len,), dtype=a.dtype)
+    idx = (jnp.arange(num_frames)[:, None] * hop_length +
+           jnp.arange(frame_length)[None, :]).reshape(-1)
+    frames = jnp.moveaxis(a, -1, -2).reshape(batch + (-1,))  # [..., F*L]
+    return out.at[..., idx].add(frames)
+
+
+def overlap_add(x, hop_length, axis=-1, name=None):
+    """Reconstruct a signal from framed slices (paddle.signal.overlap_add)."""
+    return apply_op(lambda a: _overlap_add_impl(a, hop_length, axis), _t(x),
+                    _op_name="overlap_add")
+
+
+def stft(x, n_fft, hop_length=None, win_length=None, window=None,
+         center=True, pad_mode="reflect", normalized=False, onesided=True,
+         name=None):
+    """Short-time Fourier transform; returns [..., n_fft//2+1, F] complex
+    (onesided) matching the reference contract."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    xt = _t(x)
+    if window is not None:
+        w = _t(window)._data.astype(jnp.float32)
+    else:
+        w = jnp.ones((win_length,), dtype=jnp.float32)
+    if win_length < n_fft:  # center-pad window to n_fft
+        lpad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+
+    def _stft(a):
+        if center:
+            pad = [(0, 0)] * (a.ndim - 1) + [(n_fft // 2, n_fft // 2)]
+            a = jnp.pad(a, pad, mode=pad_mode)
+        frames = _frame_impl(a, n_fft, hop_length, -1)   # [..., n_fft, F]
+        frames = frames * w[:, None]
+        fftfn = jnp.fft.rfft if onesided else jnp.fft.fft
+        spec = fftfn(frames, axis=-2)
+        if normalized:
+            spec = spec / jnp.sqrt(jnp.asarray(n_fft, spec.real.dtype))
+        return spec
+
+    return apply_op(_stft, xt, _op_name="stft")
+
+
+def istft(x, n_fft, hop_length=None, win_length=None, window=None,
+          center=True, normalized=False, onesided=True, length=None,
+          return_complex=False, name=None):
+    """Inverse STFT with least-squares window compensation."""
+    hop_length = hop_length or n_fft // 4
+    win_length = win_length or n_fft
+    xt = _t(x)
+    if window is not None:
+        w = _t(window)._data.astype(jnp.float32)
+    else:
+        w = jnp.ones((win_length,), dtype=jnp.float32)
+    if win_length < n_fft:
+        lpad = (n_fft - win_length) // 2
+        w = jnp.pad(w, (lpad, n_fft - win_length - lpad))
+
+    def _istft(spec):
+        if normalized:
+            spec = spec * jnp.sqrt(jnp.asarray(n_fft, jnp.float32))
+        ifftfn = jnp.fft.irfft if onesided else jnp.fft.ifft
+        frames = ifftfn(spec, n=n_fft, axis=-2)          # [..., n_fft, F]
+        if not return_complex:
+            frames = frames.real if jnp.iscomplexobj(frames) else frames
+        frames = frames * w[:, None]
+        sig = _overlap_add_impl(frames, hop_length, -1)
+        wsq = jnp.tile(
+            (w * w)[:, None], (1, spec.shape[-1]))       # [n_fft, F]
+        denom = _overlap_add_impl(wsq, hop_length, -1)
+        sig = sig / jnp.where(denom > 1e-11, denom, 1.0)
+        if center:
+            sig = sig[..., n_fft // 2:]
+            end = length if length is not None else sig.shape[-1] - n_fft // 2
+            sig = sig[..., :end]
+        elif length is not None:
+            sig = sig[..., :length]
+        return sig
+
+    return apply_op(_istft, xt, _op_name="istft")

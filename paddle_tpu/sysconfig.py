@@ -1,0 +1,19 @@
+"""Build/system config introspection (reference:
+python/paddle/sysconfig.py: get_include/get_lib)."""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory of C headers shipped with the package (csrc/)."""
+    return os.path.join(os.path.dirname(_ROOT), "csrc")
+
+
+def get_lib():
+    """Directory of compiled native libraries."""
+    return os.path.join(os.path.dirname(_ROOT), "csrc", "build")

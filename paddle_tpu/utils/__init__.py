@@ -1,0 +1,84 @@
+"""paddle.utils parity: deprecated decorator, try_import, require_version,
+unique_name, dlpack interop (reference: python/paddle/utils/)."""
+from __future__ import annotations
+
+import functools
+import importlib
+import warnings
+
+from . import unique_name  # noqa: F401
+from . import dlpack  # noqa: F401
+from . import cpp_extension  # noqa: F401
+
+__all__ = ["deprecated", "try_import", "require_version", "run_check",
+           "unique_name", "dlpack"]
+
+
+def deprecated(update_to="", since="", reason="", level=0):
+    """Mark an API deprecated (reference:
+    python/paddle/utils/deprecated.py) — warns at call time; level>=2
+    raises."""
+
+    def decorator(func):
+        msg = f"API {func.__module__}.{func.__name__} is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", use {update_to} instead"
+        if reason:
+            msg += f". Reason: {reason}"
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            if level >= 2:
+                raise RuntimeError(msg)
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        return wrapper
+
+    return decorator
+
+
+def try_import(module_name, err_msg=None):
+    """Import a soft dependency, raising a helpful error if absent
+    (reference: python/paddle/utils/lazy_import.py)."""
+    try:
+        return importlib.import_module(module_name)
+    except ImportError:
+        raise ImportError(
+            err_msg or f"Failed to import {module_name}. Please install it "
+            f"first (pip install {module_name}).")
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed paddle_tpu version is in range (reference:
+    python/paddle/utils/__init__.py require_version)."""
+    import paddle_tpu
+
+    def _tup(v):
+        return tuple(int(p) for p in str(v).split(".")[:3])
+
+    cur = _tup(paddle_tpu.__version__)
+    if _tup(min_version) > cur:
+        raise Exception(
+            f"installed version {paddle_tpu.__version__} < required "
+            f"minimum {min_version}")
+    if max_version is not None and _tup(max_version) < cur:
+        raise Exception(
+            f"installed version {paddle_tpu.__version__} > required "
+            f"maximum {max_version}")
+    return True
+
+
+def run_check():
+    """Sanity-check the install: one matmul on the default device
+    (reference: python/paddle/utils/install_check.py)."""
+    import jax
+    import jax.numpy as jnp
+    x = jnp.ones((4, 4), jnp.float32)
+    y = (x @ x).block_until_ready()
+    assert float(y[0, 0]) == 4.0
+    ndev = len(jax.devices())
+    print(f"paddle_tpu is installed successfully! "
+          f"backend={jax.default_backend()}, {ndev} device(s).")

@@ -1,0 +1,46 @@
+"""Custom C++ op extension builder (reference:
+python/paddle/utils/cpp_extension/ — CUDAExtension/CppExtension/load
+compiling user .cc/.cu into loadable paddle ops).
+
+TPU-native shape: a custom "op" is (a) a host-side C shared library called
+through ctypes for runtime/IO work, or (b) a Pallas kernel for device work.
+``load`` compiles C++ sources to a shared object with g++ and returns a
+ctypes.CDLL — the same mechanism csrc/ uses (csrc/data_feed.cc)."""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+
+__all__ = ["CppExtension", "load", "get_build_directory"]
+
+
+def get_build_directory():
+    d = os.environ.get("PADDLE_TPU_EXTENSION_DIR",
+                       os.path.join(tempfile.gettempdir(),
+                                    "paddle_tpu_extensions"))
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+class CppExtension:
+    def __init__(self, sources, extra_compile_args=None, **kwargs):
+        self.sources = list(sources)
+        self.extra_compile_args = list(extra_compile_args or [])
+
+
+def load(name, sources, extra_cxx_cflags=None, build_directory=None,
+         verbose=False):
+    """Compile C++ sources into <name>.so and dlopen it via ctypes."""
+    build_dir = build_directory or get_build_directory()
+    out = os.path.join(build_dir, f"{name}.so")
+    srcs = [os.path.abspath(s) for s in sources]
+    newest_src = max(os.path.getmtime(s) for s in srcs)
+    if not (os.path.exists(out) and os.path.getmtime(out) >= newest_src):
+        cmd = ["g++", "-O2", "-fPIC", "-shared", "-std=c++17",
+               *(extra_cxx_cflags or []), "-o", out, *srcs]
+        if verbose:
+            print("[cpp_extension]", " ".join(cmd))
+        subprocess.run(cmd, check=True, capture_output=not verbose)
+    return ctypes.CDLL(out)

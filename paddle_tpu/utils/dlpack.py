@@ -1,0 +1,41 @@
+"""DLPack interop (reference: python/paddle/utils/dlpack.py, C++ side
+paddle/fluid/framework/dlpack_tensor.cc). TPU-native: jax.Array already
+speaks the DLPack protocol; zero-copy on CPU, device transfer otherwise."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["to_dlpack", "from_dlpack"]
+
+
+class _CapsuleHolder:
+    """Adapter giving a raw capsule the array-API dlpack protocol (newer
+    jax.from_dlpack requires __dlpack__/__dlpack_device__ methods)."""
+
+    def __init__(self, capsule, device):
+        self._capsule = capsule
+        self._device = device
+
+    def __dlpack__(self, stream=None):
+        return self._capsule
+
+    def __dlpack_device__(self):
+        return self._device
+
+
+def to_dlpack(x):
+    """Export a Tensor as a DLPack capsule."""
+    arr = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    capsule = arr.__dlpack__()
+    return _CapsuleHolder(capsule, arr.__dlpack_device__())
+
+
+def from_dlpack(capsule):
+    """Import a DLPack capsule (or any object with __dlpack__) as a
+    Tensor."""
+    if not hasattr(capsule, "__dlpack__"):
+        capsule = _CapsuleHolder(capsule, (1, 0))  # assume kDLCPU
+    return Tensor(jnp.from_dlpack(capsule))

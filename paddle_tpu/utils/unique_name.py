@@ -1,0 +1,45 @@
+"""Unique-name generator (reference: python/paddle/utils/unique_name.py →
+base/unique_name.py UniqueNameGenerator): per-prefix counters with
+guard/switch support for snapshotting namespaces."""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+__all__ = ["generate", "switch", "guard"]
+
+_lock = threading.Lock()
+
+
+class UniqueNameGenerator:
+    def __init__(self):
+        self.ids = {}
+
+    def __call__(self, key: str) -> str:
+        with _lock:
+            n = self.ids.get(key, 0)
+            self.ids[key] = n + 1
+        return f"{key}_{n}"
+
+
+generator = UniqueNameGenerator()
+
+
+def generate(key: str) -> str:
+    return generator(key)
+
+
+def switch(new_generator=None):
+    global generator
+    old = generator
+    generator = new_generator or UniqueNameGenerator()
+    return old
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    old = switch(new_generator)
+    try:
+        yield
+    finally:
+        switch(old)

@@ -2,6 +2,7 @@
 from .resnet import *  # noqa: F401,F403
 from .small import *  # noqa: F401,F403
 from .mobilenetv3 import *  # noqa: F401,F403
+from .mobilenet import *  # noqa: F401,F403
 from .densenet import *  # noqa: F401,F403
 from .squeezenet import *  # noqa: F401,F403
 from .shufflenetv2 import *  # noqa: F401,F403

@@ -1,0 +1,128 @@
+"""MobileNetV1/V2 (reference: python/paddle/vision/models/mobilenetv1.py,
+mobilenetv2.py — depthwise-separable convs / inverted residuals)."""
+from __future__ import annotations
+
+from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Dropout, Layer,
+                   Linear, ReLU, ReLU6, Sequential)
+from .mobilenetv3 import _make_divisible
+
+__all__ = ["MobileNetV1", "MobileNetV2", "mobilenet_v1", "mobilenet_v2"]
+
+
+class _ConvBNReLU(Sequential):
+    def __init__(self, in_c, out_c, kernel, stride=1, groups=1, relu6=False):
+        super().__init__(
+            Conv2D(in_c, out_c, kernel, stride=stride,
+                   padding=(kernel - 1) // 2, groups=groups,
+                   bias_attr=False),
+            BatchNorm2D(out_c),
+            ReLU6() if relu6 else ReLU())
+
+
+class _DepthwiseSeparable(Sequential):
+    def __init__(self, in_c, out_c, stride):
+        super().__init__(
+            _ConvBNReLU(in_c, in_c, 3, stride=stride, groups=in_c),
+            _ConvBNReLU(in_c, out_c, 1))
+
+
+class MobileNetV1(Layer):
+    """13 depthwise-separable blocks, width multiplier `scale`."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        s = lambda c: int(c * scale)  # noqa: E731
+        cfg = [  # (out_c, stride)
+            (64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+            (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+            (1024, 1)]
+        layers = [_ConvBNReLU(3, s(32), 3, stride=2)]
+        in_c = s(32)
+        for out_c, stride in cfg:
+            layers.append(_DepthwiseSeparable(in_c, s(out_c), stride))
+            in_c = s(out_c)
+        self.features = Sequential(*layers)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.fc = Linear(s(1024), num_classes)
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.fc(x)
+        return x
+
+
+class _InvertedResidual(Layer):
+    def __init__(self, in_c, out_c, stride, expand_ratio):
+        super().__init__()
+        hidden = int(round(in_c * expand_ratio))
+        self.use_res = stride == 1 and in_c == out_c
+        layers = []
+        if expand_ratio != 1:
+            layers.append(_ConvBNReLU(in_c, hidden, 1, relu6=True))
+        layers += [
+            _ConvBNReLU(hidden, hidden, 3, stride=stride, groups=hidden,
+                        relu6=True),
+            Conv2D(hidden, out_c, 1, bias_attr=False),
+            BatchNorm2D(out_c)]
+        self.conv = Sequential(*layers)
+
+    def forward(self, x):
+        out = self.conv(x)
+        return x + out if self.use_res else out
+
+
+class MobileNetV2(Layer):
+    """Inverted-residual net, width multiplier `scale`."""
+
+    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
+        super().__init__()
+        self.num_classes = num_classes
+        self.with_pool = with_pool
+        cfg = [  # t, c, n, s
+            (1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
+            (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
+        in_c = _make_divisible(32 * scale)
+        last_c = _make_divisible(1280 * max(1.0, scale))
+        layers = [_ConvBNReLU(3, in_c, 3, stride=2, relu6=True)]
+        for t, c, n, s in cfg:
+            out_c = _make_divisible(c * scale)
+            for i in range(n):
+                layers.append(_InvertedResidual(
+                    in_c, out_c, s if i == 0 else 1, t))
+                in_c = out_c
+        layers.append(_ConvBNReLU(in_c, last_c, 1, relu6=True))
+        self.features = Sequential(*layers)
+        if with_pool:
+            self.pool = AdaptiveAvgPool2D(1)
+        if num_classes > 0:
+            self.classifier = Sequential(Dropout(0.2),
+                                         Linear(last_c, num_classes))
+
+    def forward(self, x):
+        x = self.features(x)
+        if self.with_pool:
+            x = self.pool(x)
+        if self.num_classes > 0:
+            x = x.flatten(1)
+            x = self.classifier(x)
+        return x
+
+
+def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return MobileNetV1(scale=scale, **kwargs)
+
+
+def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    if pretrained:
+        raise NotImplementedError("pretrained weights are not bundled")
+    return MobileNetV2(scale=scale, **kwargs)

@@ -1,0 +1,260 @@
+"""Tests for fft, signal, utils, hub, regularizer, LBFGS, ASP, mobilenet
+v1/v2, linalg namespace (SURVEY.md §2.3 inventory: paddle.fft via
+pocketfft kernels, paddle.signal, paddle.utils, paddle.hub,
+paddle.regularizer, optimizer/lbfgs.py, incubate/asp)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+# ---------------------------------------------------------------- fft
+def test_fft_roundtrip():
+    x = np.random.RandomState(0).randn(8, 16).astype(np.float32)
+    X = paddle.fft.fft(paddle.to_tensor(x.astype(np.complex64)))
+    back = paddle.fft.ifft(X)
+    np.testing.assert_allclose(back.numpy().real, x, atol=1e-4)
+    np.testing.assert_allclose(X.numpy(), np.fft.fft(x), atol=1e-2)
+
+
+def test_rfft_matches_numpy():
+    x = np.random.RandomState(1).randn(4, 32).astype(np.float32)
+    X = paddle.fft.rfft(paddle.to_tensor(x))
+    np.testing.assert_allclose(X.numpy(), np.fft.rfft(x).astype(np.complex64),
+                               atol=1e-3)
+    y = paddle.fft.irfft(X, n=32)
+    np.testing.assert_allclose(y.numpy(), x, atol=1e-4)
+
+
+def test_fft2_fftn_norms():
+    x = np.random.RandomState(2).randn(4, 8).astype(np.float32)
+    for norm in ("backward", "ortho", "forward"):
+        X = paddle.fft.fft2(paddle.to_tensor(x), norm=norm)
+        np.testing.assert_allclose(X.numpy(), np.fft.fft2(x, norm=norm),
+                                   atol=1e-3)
+    with pytest.raises(ValueError):
+        paddle.fft.fft(paddle.to_tensor(x), norm="bogus")
+
+
+def test_hfft2_ihfft2_match_scipy():
+    import scipy.fft as sfft
+    rng = np.random.RandomState(5)
+    x = (rng.randn(4, 5) + 1j * rng.randn(4, 5)).astype(np.complex64)
+    for norm in ("backward", "ortho", "forward"):
+        out = paddle.fft.hfft2(paddle.to_tensor(x), norm=norm)
+        np.testing.assert_allclose(out.numpy(), sfft.hfft2(x, norm=norm),
+                                   rtol=1e-3, atol=1e-3)
+        inv = paddle.fft.ihfft2(paddle.to_tensor(out.numpy()), norm=norm)
+        np.testing.assert_allclose(inv.numpy(),
+                                   sfft.ihfft2(out.numpy(), norm=norm),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_fftshift_fftfreq():
+    f = paddle.fft.fftfreq(8, d=0.5)
+    np.testing.assert_allclose(f.numpy(), np.fft.fftfreq(8, d=0.5), atol=1e-6)
+    x = paddle.to_tensor(np.arange(8, dtype=np.float32))
+    np.testing.assert_allclose(paddle.fft.fftshift(x).numpy(),
+                               np.fft.fftshift(np.arange(8)), atol=0)
+
+
+def test_fft_grad_flows():
+    x = paddle.to_tensor(np.random.randn(16).astype(np.float32),
+                         stop_gradient=False)
+    y = paddle.fft.rfft(x)
+    loss = (y.real() ** 2 + y.imag() ** 2).sum()
+    loss.backward()
+    assert x.grad is not None and x.grad.shape == [16]
+
+
+# ---------------------------------------------------------------- signal
+def test_frame_overlap_add_roundtrip():
+    x = np.arange(32, dtype=np.float32)
+    fr = paddle.signal.frame(paddle.to_tensor(x), frame_length=8,
+                             hop_length=8)
+    assert fr.shape == [8, 4]
+    back = paddle.signal.overlap_add(fr, hop_length=8)
+    np.testing.assert_allclose(back.numpy(), x, atol=1e-5)
+
+
+def test_stft_istft_roundtrip():
+    rng = np.random.RandomState(3)
+    x = rng.randn(2, 512).astype(np.float32)
+    w = np.hanning(128).astype(np.float32)
+    spec = paddle.signal.stft(paddle.to_tensor(x), n_fft=128, hop_length=32,
+                              window=paddle.to_tensor(w))
+    assert spec.shape[:2] == [2, 65]
+    rec = paddle.signal.istft(spec, n_fft=128, hop_length=32,
+                              window=paddle.to_tensor(w), length=512)
+    # edges lack full overlap; compare the interior
+    np.testing.assert_allclose(rec.numpy()[:, 64:-64], x[:, 64:-64],
+                               atol=1e-3)
+
+
+# ---------------------------------------------------------------- utils
+def test_deprecated_warns():
+    @paddle.utils.deprecated(update_to="new_api", since="0.1")
+    def old_api():
+        return 42
+
+    with pytest.warns(DeprecationWarning):
+        assert old_api() == 42
+
+
+def test_unique_name():
+    a = paddle.utils.unique_name.generate("fc")
+    b = paddle.utils.unique_name.generate("fc")
+    assert a != b and a.startswith("fc_")
+    with paddle.utils.unique_name.guard():
+        c = paddle.utils.unique_name.generate("fc")
+        assert c == "fc_0"
+
+
+def test_dlpack_roundtrip():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3))
+    cap = paddle.utils.dlpack.to_dlpack(x)
+    y = paddle.utils.dlpack.from_dlpack(cap)
+    np.testing.assert_allclose(y.numpy(), x.numpy())
+
+
+def test_require_version():
+    assert paddle.utils.require_version("0.0.1")
+    with pytest.raises(Exception):
+        paddle.utils.require_version("999.0.0")
+
+
+# ---------------------------------------------------------------- hub
+def test_hub_local(tmp_path):
+    (tmp_path / "hubconf.py").write_text(
+        "def tiny(n=3):\n"
+        "    'a tiny entrypoint'\n"
+        "    return list(range(n))\n")
+    assert "tiny" in paddle.hub.list(str(tmp_path), source="local")
+    assert "tiny entrypoint" in paddle.hub.help(str(tmp_path), "tiny",
+                                                source="local")
+    assert paddle.hub.load(str(tmp_path), "tiny", source="local", n=2) == \
+        [0, 1]
+    with pytest.raises(RuntimeError):
+        paddle.hub.load(str(tmp_path), "tiny")  # github source gated
+
+
+# ------------------------------------------------------- regularizer
+def test_l2decay_changes_update():
+    w0 = np.ones((4, 4), dtype=np.float32)
+    lin1 = paddle.nn.Linear(4, 4)
+    lin2 = paddle.nn.Linear(4, 4)
+    lin1.weight.set_value(paddle.to_tensor(w0))
+    lin2.weight.set_value(paddle.to_tensor(w0))
+    x = paddle.to_tensor(np.ones((2, 4), dtype=np.float32))
+    for lin, wd in ((lin1, None), (lin2, paddle.regularizer.L2Decay(0.5))):
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=lin.parameters(),
+                                   weight_decay=wd)
+        loss = lin(x).sum()
+        loss.backward()
+        opt.step()
+    # decayed weights must be strictly smaller
+    assert (lin2.weight.numpy() < lin1.weight.numpy()).all()
+
+
+def test_l1decay_sign():
+    reg = paddle.regularizer.L1Decay(0.1)
+    import jax.numpy as jnp
+    g = reg.apply(jnp.asarray([-2.0, 3.0]), jnp.zeros(2))
+    np.testing.assert_allclose(np.asarray(g), [-0.1, 0.1], atol=1e-6)
+
+
+def test_adam_accepts_regularizer():
+    lin = paddle.nn.Linear(4, 4)
+    opt = paddle.optimizer.Adam(parameters=lin.parameters(),
+                                weight_decay=paddle.regularizer.L2Decay(0.1))
+    x = paddle.to_tensor(np.ones((2, 4), dtype=np.float32))
+    lin(x).sum().backward()
+    w_before = lin.weight.numpy().copy()
+    opt.step()
+    assert not np.allclose(lin.weight.numpy(), w_before)
+    # AdamW / Lamb coerce the coefficient instead of crashing
+    paddle.optimizer.AdamW(parameters=lin.parameters(),
+                           weight_decay=paddle.regularizer.L2Decay(0.1))
+    paddle.optimizer.Lamb(parameters=lin.parameters(),
+                          lamb_weight_decay=paddle.regularizer.L2Decay(0.1))
+
+
+def test_frame_validates_lengths():
+    x = paddle.to_tensor(np.zeros(10, dtype=np.float32))
+    with pytest.raises(ValueError):
+        paddle.signal.frame(x, frame_length=16, hop_length=4)
+    with pytest.raises(ValueError):
+        paddle.signal.frame(x, frame_length=4, hop_length=0)
+
+
+def test_asp_rejects_unknown_algo():
+    from paddle_tpu.incubate import asp
+    lin = paddle.nn.Linear(8, 8)
+    with pytest.raises(ValueError):
+        asp.prune_model(lin, mask_algo="mask1d_typo")
+
+
+# ---------------------------------------------------------------- LBFGS
+def test_lbfgs_quadratic():
+    # minimize ||Wx - b||^2 over W; LBFGS should converge fast
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
+    b = paddle.to_tensor(rng.randn(8, 2).astype(np.float32))
+    lin = paddle.nn.Linear(4, 2)
+    opt = paddle.optimizer.LBFGS(learning_rate=0.5, max_iter=30,
+                                 parameters=lin.parameters(),
+                                 line_search_fn="strong_wolfe")
+
+    def closure():
+        opt.clear_grad()
+        loss = ((lin(x) - b) ** 2).mean()
+        loss.backward()
+        return loss
+
+    l0 = float(closure().numpy())
+    final = opt.step(closure)
+    assert float(final.numpy()) < l0 * 0.2
+
+
+# ---------------------------------------------------------------- ASP
+def test_asp_prune_and_decorate():
+    from paddle_tpu.incubate import asp
+    lin = paddle.nn.Linear(8, 8)
+    asp.prune_model(lin, n=2, m=4)
+    d = asp.calculate_density(lin.weight)
+    assert abs(d - 0.5) < 1e-6
+    opt = asp.decorate(paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=lin.parameters()))
+    x = paddle.to_tensor(np.random.randn(2, 8).astype(np.float32))
+    loss = lin(x).sum()
+    loss.backward()
+    opt.step()
+    # sparsity preserved after the step
+    assert abs(asp.calculate_density(lin.weight) - 0.5) < 1e-6
+
+
+# ------------------------------------------------------- mobilenet v1/v2
+@pytest.mark.parametrize("factory", ["mobilenet_v1", "mobilenet_v2"])
+def test_mobilenet_forward(factory):
+    model = getattr(paddle.vision.models, factory)(scale=0.25,
+                                                   num_classes=10)
+    model.eval()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(1, 3, 64, 64).astype(np.float32))
+    out = model(x)
+    assert out.shape == [1, 10]
+
+
+# ------------------------------------------------------- namespaces
+def test_linalg_namespace():
+    x = paddle.to_tensor(np.eye(3, dtype=np.float32) * 2)
+    out = paddle.linalg.matmul(x, x)
+    np.testing.assert_allclose(out.numpy(), np.eye(3) * 4, atol=1e-5)
+
+
+def test_onnx_sysconfig():
+    import os
+    with pytest.raises(NotImplementedError):
+        paddle.onnx.export(None, "/tmp/x")
+    assert os.path.basename(paddle.sysconfig.get_include()) == "csrc"
