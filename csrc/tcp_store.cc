@@ -1,0 +1,383 @@
+// Native TCP key-value store for distributed bootstrap.
+//
+// Reference analog: /root/reference/paddle/phi/core/distributed/store/
+// tcp_store.h:121 + tcp_utils.cc — the KV server every Paddle job uses to
+// rendezvous (ncclUniqueId exchange, barriers). Here it bootstraps
+// jax.distributed jobs, backs paddle_tpu.distributed.rpc rendezvous, and
+// the launcher's master. Exposed as a C ABI consumed via ctypes (no
+// pybind11 in the image).
+//
+// Protocol (little-endian):
+//   request:  u8 cmd | u32 keylen | key | u64 vallen | val
+//   response: u8 status (0 ok, 1 timeout/missing) | u64 len | payload
+//   cmds: 0 SET, 1 GET(blocking; val = 8-byte timeout_ms), 2 ADD(val =
+//         8-byte i64 delta; payload = new value as 8-byte i64),
+//         3 WAIT(val = 8-byte timeout_ms), 4 DEL, 5 NUM_KEYS
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::thread accept_thread;
+  std::atomic<bool> stopping{false};
+
+  std::mutex conn_mu;                 // guards conn_fds/live_conns
+  std::condition_variable conn_cv;    // signaled when a handler exits
+  std::vector<int> conn_fds;
+  int live_conns = 0;
+
+  std::mutex mu;
+  std::condition_variable cv;
+  std::unordered_map<std::string, std::vector<uint8_t>> kv;
+
+  void handle_conn(int fd);
+  void accept_loop();
+};
+
+void Server::handle_conn(int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    uint8_t cmd;
+    uint32_t keylen;
+    uint64_t vallen;
+    if (!recv_all(fd, &cmd, 1) || !recv_all(fd, &keylen, 4)) break;
+    if (keylen > (1u << 20)) break;  // sanity: keys are small
+    std::string key(keylen, '\0');
+    if (keylen && !recv_all(fd, &key[0], keylen)) break;
+    if (!recv_all(fd, &vallen, 8)) break;
+    if (vallen > (1ull << 32)) break;  // 4 GiB value cap
+    std::vector<uint8_t> val(vallen);
+    if (vallen && !recv_all(fd, val.data(), vallen)) break;
+
+    uint8_t status = 0;
+    std::vector<uint8_t> payload;
+    switch (cmd) {
+      case 0: {  // SET
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          kv[key] = std::move(val);
+        }
+        cv.notify_all();
+        break;
+      }
+      case 1:    // GET (blocking with timeout)
+      case 3: {  // WAIT
+        int64_t timeout_ms = -1;
+        if (val.size() == 8) std::memcpy(&timeout_ms, val.data(), 8);
+        std::unique_lock<std::mutex> lk(mu);
+        auto ready = [&] {
+          return stopping.load() || kv.find(key) != kv.end();
+        };
+        if (timeout_ms < 0) {
+          cv.wait(lk, ready);
+        } else if (!cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                ready)) {
+          status = 1;
+        }
+        auto it = kv.find(key);
+        if (it == kv.end()) {
+          status = 1;
+        } else if (cmd == 1) {
+          payload = it->second;
+        }
+        break;
+      }
+      case 2: {  // ADD — counters are decimal ASCII strings (reference
+                 // behavior), so set('k','5') then add('k',1) == 6 and an
+                 // add-created key reads back as b'6'
+        int64_t delta = 0;
+        if (val.size() == 8) std::memcpy(&delta, val.data(), 8);
+        int64_t cur = 0;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          auto it = kv.find(key);
+          if (it != kv.end() && !it->second.empty()) {
+            try {
+              size_t pos = 0;
+              std::string txt(it->second.begin(), it->second.end());
+              cur = std::stoll(txt, &pos);
+              if (pos != txt.size()) status = 1;  // trailing junk
+            } catch (const std::exception&) {
+              status = 1;  // non-numeric value: report, never crash
+            }
+          }
+          if (status == 0) {
+            cur += delta;
+            std::string enc = std::to_string(cur);
+            kv[key].assign(enc.begin(), enc.end());
+          }
+        }
+        if (status == 0) {
+          cv.notify_all();
+          payload.resize(8);
+          std::memcpy(payload.data(), &cur, 8);
+        }
+        break;
+      }
+      case 4: {  // DEL
+        std::lock_guard<std::mutex> lk(mu);
+        kv.erase(key);
+        break;
+      }
+      case 5: {  // NUM_KEYS
+        int64_t n;
+        {
+          std::lock_guard<std::mutex> lk(mu);
+          n = static_cast<int64_t>(kv.size());
+        }
+        payload.resize(8);
+        std::memcpy(payload.data(), &n, 8);
+        break;
+      }
+      default:
+        status = 1;
+    }
+    uint64_t plen = payload.size();
+    if (!send_all(fd, &status, 1) || !send_all(fd, &plen, 8) ||
+        (plen && !send_all(fd, payload.data(), plen))) {
+      break;
+    }
+  }
+  ::close(fd);
+  // last touch of *this: decrement + notify UNDER the lock, so once the
+  // stopper observes live_conns == 0 (holding the same lock) no handler
+  // thread can still dereference the Server
+  std::lock_guard<std::mutex> lk(conn_mu);
+  for (auto it = conn_fds.begin(); it != conn_fds.end(); ++it) {
+    if (*it == fd) {
+      conn_fds.erase(it);
+      break;
+    }
+  }
+  --live_conns;
+  conn_cv.notify_all();
+}
+
+void Server::accept_loop() {
+  for (;;) {
+    sockaddr_in peer{};
+    socklen_t plen = sizeof(peer);
+    int fd = ::accept(listen_fd, reinterpret_cast<sockaddr*>(&peer), &plen);
+    if (fd < 0) {
+      if (stopping.load()) return;
+      continue;
+    }
+    {
+      std::lock_guard<std::mutex> lk(conn_mu);
+      if (stopping.load()) {
+        ::close(fd);
+        return;
+      }
+      conn_fds.push_back(fd);
+      ++live_conns;
+    }
+    std::thread(&Server::handle_conn, this, fd).detach();
+  }
+}
+
+struct Client {
+  int fd = -1;
+};
+
+}  // namespace
+
+extern "C" {
+
+// ---- server ----
+void* pts_server_start(int port) {
+  int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) < 0 ||
+      ::listen(fd, 128) < 0) {
+    ::close(fd);
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  auto* s = new Server();
+  s->listen_fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread(&Server::accept_loop, s);
+  return s;
+}
+
+int pts_server_port(void* h) { return static_cast<Server*>(h)->port; }
+
+void pts_server_stop(void* h) {
+  auto* s = static_cast<Server*>(h);
+  s->stopping.store(true);
+  s->cv.notify_all();  // unblock server-side GET/WAIT sleepers
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  // force every open connection's recv to return, then wait for all
+  // handler threads to signal exit (they notify under conn_mu as their
+  // final touch of *s), so deletion below cannot race them
+  {
+    std::unique_lock<std::mutex> lk(s->conn_mu);
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+    s->conn_cv.wait(lk, [&] { return s->live_conns == 0; });
+  }
+  delete s;
+}
+
+// ---- client ----
+void* pts_client_connect(const char* host, int port, int timeout_ms) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  std::string port_s = std::to_string(port);
+  if (::getaddrinfo(host, port_s.c_str(), &hints, &res) != 0 || !res) {
+    return nullptr;
+  }
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms > 0 ? timeout_ms
+                                                           : 30000);
+  int fd = -1;
+  // retry until the server comes up (rendezvous race is normal)
+  while (std::chrono::steady_clock::now() < deadline) {
+    fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) break;
+    if (::connect(fd, res->ai_addr, res->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+  ::freeaddrinfo(res);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto* c = new Client();
+  c->fd = fd;
+  return c;
+}
+
+static int request(Client* c, uint8_t cmd, const char* key,
+                   const void* val, uint64_t vallen,
+                   uint8_t** out, uint64_t* out_len) {
+  uint32_t keylen = static_cast<uint32_t>(std::strlen(key));
+  if (!send_all(c->fd, &cmd, 1) || !send_all(c->fd, &keylen, 4) ||
+      !send_all(c->fd, key, keylen) || !send_all(c->fd, &vallen, 8) ||
+      (vallen && !send_all(c->fd, val, vallen))) {
+    return -1;
+  }
+  uint8_t status;
+  uint64_t plen;
+  if (!recv_all(c->fd, &status, 1) || !recv_all(c->fd, &plen, 8)) return -1;
+  uint8_t* buf = nullptr;
+  if (plen) {
+    buf = static_cast<uint8_t*>(::malloc(plen));
+    if (!recv_all(c->fd, buf, plen)) {
+      ::free(buf);
+      return -1;
+    }
+  }
+  if (out) {
+    *out = buf;
+    *out_len = plen;
+  } else {
+    ::free(buf);
+  }
+  return status;
+}
+
+int pts_set(void* h, const char* key, const void* val, uint64_t len) {
+  return request(static_cast<Client*>(h), 0, key, val, len, nullptr,
+                 nullptr);
+}
+
+// blocking get; returns 0 ok / 1 timeout / -1 io error; caller frees *out
+int pts_get(void* h, const char* key, int64_t timeout_ms, uint8_t** out,
+            uint64_t* out_len) {
+  return request(static_cast<Client*>(h), 1, key, &timeout_ms, 8, out,
+                 out_len);
+}
+
+// status: 0 ok (new counter in *out_val), 1 server rejected (non-numeric
+// existing value), -1 io error — counter value is out-of-band so negative
+// counters are unambiguous
+int pts_add(void* h, const char* key, int64_t delta, int64_t* out_val) {
+  uint8_t* out = nullptr;
+  uint64_t olen = 0;
+  int st = request(static_cast<Client*>(h), 2, key, &delta, 8, &out, &olen);
+  if (st == 0 && olen == 8 && out_val) std::memcpy(out_val, out, 8);
+  ::free(out);
+  return st;
+}
+
+int pts_wait(void* h, const char* key, int64_t timeout_ms) {
+  return request(static_cast<Client*>(h), 3, key, &timeout_ms, 8, nullptr,
+                 nullptr);
+}
+
+int pts_delete(void* h, const char* key) {
+  return request(static_cast<Client*>(h), 4, key, nullptr, 0, nullptr,
+                 nullptr);
+}
+
+int64_t pts_num_keys(void* h) {
+  uint8_t* out = nullptr;
+  uint64_t olen = 0;
+  int st = request(static_cast<Client*>(h), 5, "", nullptr, 0, &out, &olen);
+  int64_t v = -1;
+  if (st == 0 && olen == 8) std::memcpy(&v, out, 8);
+  ::free(out);
+  return v;
+}
+
+void pts_free(void* p) { ::free(p); }
+
+void pts_client_close(void* h) {
+  auto* c = static_cast<Client*>(h);
+  ::close(c->fd);
+  delete c;
+}
+
+}  // extern "C"

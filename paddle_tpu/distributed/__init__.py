@@ -21,6 +21,8 @@ from .sharding import group_sharded_parallel, save_group_sharded_model
 from . import fleet  # noqa: F401
 from . import pipeline  # noqa: F401
 from . import auto_tuner  # noqa: F401
+from . import rpc  # noqa: F401
+from .store import TCPStore  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict
 from .launch import spawn
 

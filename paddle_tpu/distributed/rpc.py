@@ -1,0 +1,216 @@
+"""paddle.distributed.rpc parity: init_rpc / rpc_sync / rpc_async /
+get_worker_info / shutdown.
+
+Reference: python/paddle/distributed/rpc/rpc.py over the brpc C++ agent
+(/root/reference/paddle/fluid/distributed/rpc/rpc_agent.cc). TPU-native
+design: rendezvous through the native TCPStore (csrc/tcp_store.cc), message
+transport over plain TCP sockets with pickled python payloads — RPC in the
+reference is a *control-plane* feature (parameter-server control, elastic
+coordination), not the tensor data plane (which is XLA collectives), so
+python-side serving with a thread pool matches the use while staying
+dependency-free.
+
+Only connect to trusted peers: like the reference's agent, payloads are
+pickled python objects, so the RPC mesh must live inside one trusted job
+(the launcher's private network), never exposed publicly.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass
+from typing import Any, Dict, Optional
+
+from .store import TCPStore
+
+__all__ = ["init_rpc", "rpc_sync", "rpc_async", "get_worker_info",
+           "get_all_worker_infos", "get_current_worker_info", "shutdown",
+           "WorkerInfo"]
+
+
+@dataclass(frozen=True)
+class WorkerInfo:
+    name: str
+    rank: int
+    ip: str
+    port: int
+
+
+class _RpcAgent:
+    def __init__(self, name: str, rank: int, world_size: int,
+                 store: TCPStore):
+        self.name = name
+        self.rank = rank
+        self.world_size = world_size
+        self.store = store
+        # separate pools: inbound serving must never queue behind outbound
+        # calls (self-RPC / mutual saturation would deadlock until timeout)
+        self._pool = ThreadPoolExecutor(max_workers=8)        # serve side
+        self._client_pool = ThreadPoolExecutor(max_workers=8)  # rpc_async
+        self._serve_sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._serve_sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._serve_sock.bind(("0.0.0.0", 0))
+        self._serve_sock.listen(64)
+        self.port = self._serve_sock.getsockname()[1]
+        self.ip = os.environ.get("PADDLE_LOCAL_IP", "127.0.0.1")
+        self._stop = False
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        # publish, then learn everyone
+        store.set(f"rpc/{rank}",
+                  pickle.dumps(WorkerInfo(name, rank, self.ip, self.port)))
+        self.workers: Dict[str, WorkerInfo] = {}
+        for r in range(world_size):
+            info = pickle.loads(store.get(f"rpc/{r}"))
+            self.workers[info.name] = info
+
+    # ---- server side -----------------------------------------------------
+    def _serve(self):
+        while not self._stop:
+            try:
+                conn, _ = self._serve_sock.accept()
+            except OSError:
+                return
+            self._pool.submit(self._handle, conn)
+
+    def _handle(self, conn: socket.socket):
+        try:
+            payload = _recv_msg(conn)
+            fn, args, kwargs = pickle.loads(payload)
+            try:
+                result = (True, fn(*args, **kwargs))
+            except Exception as e:  # ship the exception back
+                result = (False, e)
+            _send_msg(conn, pickle.dumps(result))
+        except Exception:
+            pass
+        finally:
+            conn.close()
+
+    # ---- client side -----------------------------------------------------
+    def call(self, to: str, fn, args, kwargs, timeout: float) -> Any:
+        info = self.workers.get(to)
+        if info is None:
+            raise ValueError(f"unknown rpc worker {to!r}; known: "
+                             f"{sorted(self.workers)}")
+        with socket.create_connection((info.ip, info.port),
+                                      timeout=timeout if timeout > 0
+                                      else None) as s:
+            _send_msg(s, pickle.dumps((fn, args or (), kwargs or {})))
+            ok, result = pickle.loads(_recv_msg(s))
+        if not ok:
+            raise result
+        return result
+
+    def stop(self):
+        self._stop = True
+        try:
+            self._serve_sock.close()
+        except OSError:
+            pass
+        self._pool.shutdown(wait=False)
+        self._client_pool.shutdown(wait=False)
+
+
+def _send_msg(s: socket.socket, data: bytes):
+    s.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def _recv_msg(s: socket.socket) -> bytes:
+    hdr = _recv_exact(s, 8)
+    (n,) = struct.unpack("<Q", hdr)
+    return _recv_exact(s, n)
+
+
+def _recv_exact(s: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        b = s.recv(min(n, 1 << 20))
+        if not b:
+            raise ConnectionError("rpc peer closed")
+        chunks.append(b)
+        n -= len(b)
+    return b"".join(chunks)
+
+
+_agent: Optional[_RpcAgent] = None
+
+
+def init_rpc(name: str, rank: Optional[int] = None,
+             world_size: Optional[int] = None,
+             master_endpoint: Optional[str] = None):
+    """Start this process's RPC agent and rendezvous with peers
+    (reference: rpc.py init_rpc — env fallbacks PADDLE_TRAINER_ID /
+    PADDLE_TRAINERS_NUM / PADDLE_MASTER)."""
+    global _agent
+    if _agent is not None:
+        raise RuntimeError("rpc already initialized")
+    rank = int(os.environ.get("PADDLE_TRAINER_ID", 0)) if rank is None \
+        else rank
+    world_size = int(os.environ.get("PADDLE_TRAINERS_NUM", 1)) \
+        if world_size is None else world_size
+    master_endpoint = master_endpoint or \
+        os.environ.get("PADDLE_MASTER", "127.0.0.1:0")
+    host, port_s = master_endpoint.rsplit(":", 1)
+    store = TCPStore(host, int(port_s), is_master=(rank == 0),
+                     world_size=world_size)
+    if rank == 0 and int(port_s) == 0:
+        # ephemeral master port: publish for spawned same-host peers
+        os.environ["PADDLE_MASTER"] = f"{host}:{store.port}"
+    _agent = _RpcAgent(name, rank, world_size, store)
+    store.barrier("rpc_init")
+    return _agent
+
+
+def rpc_sync(to: str, fn, args=None, kwargs=None, timeout: float = 180.0):
+    """Blocking remote call; returns fn(*args, **kwargs) run on `to`."""
+    if _agent is None:
+        raise RuntimeError("call init_rpc first")
+    return _agent.call(to, fn, args, kwargs, timeout)
+
+
+def rpc_async(to: str, fn, args=None, kwargs=None,
+              timeout: float = 180.0) -> Future:
+    """Non-blocking remote call returning a Future (reference returns a
+    FutureWrapper with .wait(); concurrent.futures.Future.result() is the
+    python-native equivalent — .wait is aliased)."""
+    if _agent is None:
+        raise RuntimeError("call init_rpc first")
+    fut = _agent._client_pool.submit(_agent.call, to, fn, args, kwargs,
+                                     timeout)
+    if not hasattr(fut, "wait"):
+        fut.wait = fut.result  # paddle API compat
+    return fut
+
+
+def get_worker_info(name: str) -> WorkerInfo:
+    if _agent is None:
+        raise RuntimeError("call init_rpc first")
+    return _agent.workers[name]
+
+
+def get_all_worker_infos():
+    if _agent is None:
+        raise RuntimeError("call init_rpc first")
+    return sorted(_agent.workers.values(), key=lambda w: w.rank)
+
+
+def get_current_worker_info() -> WorkerInfo:
+    if _agent is None:
+        raise RuntimeError("call init_rpc first")
+    return _agent.workers[_agent.name]
+
+
+def shutdown():
+    """Graceful: barrier so in-flight work drains, then stop the agent."""
+    global _agent
+    if _agent is None:
+        return
+    _agent.store.barrier("rpc_shutdown")
+    _agent.stop()
+    _agent.store.close()
+    _agent = None

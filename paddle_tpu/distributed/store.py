@@ -1,0 +1,216 @@
+"""TCPStore: native TCP key-value rendezvous store.
+
+Reference: /root/reference/paddle/phi/core/distributed/store/tcp_store.h:121
+(MasterDaemon + TCPStore client with set/get/add/wait/barrier) — the KV
+every Paddle job bootstraps through. Here the daemon is C++
+(csrc/tcp_store.cc, ctypes C ABI), and it backs the launcher master,
+``paddle_tpu.distributed.rpc`` rendezvous, and anything that needs a tiny
+coordination KV next to jax.distributed's coordination service.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+__all__ = ["TCPStore"]
+
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "csrc", "tcp_store.cc")
+_OUT_DIR = os.path.join(_REPO_ROOT, "build")
+_SO = os.path.join(_OUT_DIR, "libptstore.so")
+
+
+def _build() -> Optional[str]:
+    os.makedirs(_OUT_DIR, exist_ok=True)
+    if os.path.exists(_SO) and \
+            os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
+        return _SO
+    # compile to a per-pid temp path then atomically rename: concurrent
+    # first-use across spawned ranks must never dlopen a half-written .so
+    tmp = f"{_SO}.{os.getpid()}.tmp"
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-pthread", "-std=c++17",
+           _SRC, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
+        return _SO
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None
+
+
+def get_lib():
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        so = _build()
+        if so is None:
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(so)
+        lib.pts_server_start.restype = ctypes.c_void_p
+        lib.pts_server_start.argtypes = [ctypes.c_int]
+        lib.pts_server_port.restype = ctypes.c_int
+        lib.pts_server_port.argtypes = [ctypes.c_void_p]
+        lib.pts_server_stop.argtypes = [ctypes.c_void_p]
+        lib.pts_client_connect.restype = ctypes.c_void_p
+        lib.pts_client_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                           ctypes.c_int]
+        lib.pts_client_close.argtypes = [ctypes.c_void_p]
+        lib.pts_set.restype = ctypes.c_int
+        lib.pts_set.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_char_p, ctypes.c_uint64]
+        lib.pts_get.restype = ctypes.c_int
+        lib.pts_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_int64,
+                                ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+                                ctypes.POINTER(ctypes.c_uint64)]
+        lib.pts_add.restype = ctypes.c_int
+        lib.pts_add.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                ctypes.c_int64,
+                                ctypes.POINTER(ctypes.c_int64)]
+        lib.pts_wait.restype = ctypes.c_int
+        lib.pts_wait.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                 ctypes.c_int64]
+        lib.pts_delete.restype = ctypes.c_int
+        lib.pts_delete.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.pts_num_keys.restype = ctypes.c_int64
+        lib.pts_num_keys.argtypes = [ctypes.c_void_p]
+        lib.pts_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+class TCPStore:
+    """KV store client; rank 0 (is_master=True) also hosts the daemon.
+
+    API contract mirrors the reference TCPStore: set/get/add/wait plus a
+    counter-based barrier. One socket per instance; guarded by a lock, so
+    an instance is safe to share between threads (blocking gets serialize).
+    """
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 is_master: bool = False, world_size: int = 1,
+                 timeout: float = 30.0):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native TCPStore library failed to build")
+        self._lib = lib
+        self._mu = threading.Lock()
+        self._server = None
+        self.world_size = world_size
+        self.timeout_ms = int(timeout * 1000)
+        if is_master:
+            self._server = lib.pts_server_start(port)
+            if not self._server:
+                raise RuntimeError(f"TCPStore: cannot bind port {port}")
+            port = lib.pts_server_port(self._server)
+        self.host, self.port = host, port
+        self._client = lib.pts_client_connect(host.encode(), port,
+                                              self.timeout_ms)
+        if not self._client:
+            if self._server:
+                lib.pts_server_stop(self._server)
+            raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
+
+    # -- KV ----------------------------------------------------------------
+    def set(self, key: str, value) -> None:
+        data = value if isinstance(value, bytes) else str(value).encode()
+        with self._mu:
+            rc = self._lib.pts_set(self._client, key.encode(), data,
+                                   len(data))
+        if rc != 0:
+            raise RuntimeError(f"TCPStore.set({key!r}) failed")
+
+    def get(self, key: str, timeout: Optional[float] = None) -> bytes:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_uint64()
+        tmo = self.timeout_ms if timeout is None else int(timeout * 1000)
+        with self._mu:
+            rc = self._lib.pts_get(self._client, key.encode(), tmo,
+                                   ctypes.byref(out), ctypes.byref(out_len))
+        if rc == 1:
+            raise TimeoutError(
+                f"TCPStore.get({key!r}): no value within {tmo}ms")
+        if rc != 0:
+            raise ConnectionError(f"TCPStore.get({key!r}): io error "
+                                  f"(store unreachable)")
+        try:
+            return ctypes.string_at(out, out_len.value)
+        finally:
+            if out:
+                self._lib.pts_free(out)
+
+    def add(self, key: str, delta: int = 1) -> int:
+        out = ctypes.c_int64()
+        with self._mu:
+            rc = self._lib.pts_add(self._client, key.encode(), delta,
+                                   ctypes.byref(out))
+        if rc == 1:
+            raise ValueError(
+                f"TCPStore.add({key!r}): existing value is not an integer")
+        if rc != 0:
+            raise ConnectionError(f"TCPStore.add({key!r}): io error")
+        return int(out.value)
+
+    def wait(self, key: str, timeout: Optional[float] = None) -> None:
+        tmo = self.timeout_ms if timeout is None else int(timeout * 1000)
+        with self._mu:
+            rc = self._lib.pts_wait(self._client, key.encode(), tmo)
+        if rc != 0:
+            raise TimeoutError(f"TCPStore.wait({key!r}): not set within "
+                               f"{tmo}ms")
+
+    def delete_key(self, key: str) -> None:
+        with self._mu:
+            self._lib.pts_delete(self._client, key.encode())
+
+    def num_keys(self) -> int:
+        with self._mu:
+            return int(self._lib.pts_num_keys(self._client))
+
+    # -- barrier -----------------------------------------------------------
+    def barrier(self, tag: str = "", timeout: Optional[float] = None):
+        """Counter barrier across world_size participants. Every use of a
+        tag is round-numbered per instance, so reusing a tag (or calling
+        anonymous barriers in a loop) stays correct as long as all ranks
+        call the same barriers in the same order — the usual collective
+        contract."""
+        if not hasattr(self, "_barrier_rounds"):
+            self._barrier_rounds = {}
+        rnd = self._barrier_rounds.get(tag, 0)
+        self._barrier_rounds[tag] = rnd + 1
+        key = f"__barrier__/{tag}/{rnd}"
+        arrived = self.add(key + "/count", 1)
+        if arrived == self.world_size:
+            self.set(key + "/done", b"1")
+        self.wait(key + "/done", timeout)
+
+    def close(self):
+        with self._mu:
+            if self._client:
+                self._lib.pts_client_close(self._client)
+                self._client = None
+            if self._server:
+                self._lib.pts_server_stop(self._server)
+                self._server = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,217 @@
+"""Native TCPStore (csrc/tcp_store.cc) + distributed.rpc tests.
+
+SURVEY.md §4: the reference tests its store/RPC via real multi-process
+single-host runs (test/cpp/phi/core/test_tcp_store, test/rpc/). We do the
+same: in-process threads for the store contract, real subprocesses for the
+rpc mesh."""
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from paddle_tpu.distributed.store import TCPStore, get_lib
+
+
+pytestmark = pytest.mark.skipif(get_lib() is None,
+                                reason="native store failed to build")
+
+
+def test_store_set_get_roundtrip():
+    master = TCPStore(is_master=True, world_size=1)
+    try:
+        master.set("alpha", b"beta")
+        assert master.get("alpha") == b"beta"
+        master.set("alpha", b"gamma")  # overwrite
+        assert master.get("alpha") == b"gamma"
+        assert master.num_keys() >= 1
+        master.delete_key("alpha")
+        with pytest.raises(TimeoutError):
+            master.get("alpha", timeout=0.2)
+    finally:
+        master.close()
+
+
+def test_store_add_counter():
+    master = TCPStore(is_master=True, world_size=1)
+    try:
+        assert master.add("cnt", 1) == 1
+        assert master.add("cnt", 5) == 6
+        assert master.add("cnt", -2) == 4
+        # counters go negative without error and read back as decimal text
+        assert master.add("neg", -5) == -5
+        assert master.add("neg", 1) == -4
+        assert master.get("neg") == b"-4"
+        # set() with a decimal string then add() continues the counter
+        master.set("preset", b"12345678")
+        assert master.add("preset", 2) == 12345680
+        # add() on a non-numeric value reports cleanly (must NOT kill the
+        # server — regression for the std::stoll crash)
+        master.set("text", b"hello")
+        with pytest.raises(ValueError):
+            master.add("text", 1)
+        assert master.get("text") == b"hello"  # server still alive
+    finally:
+        master.close()
+
+
+def test_store_blocking_get_across_clients():
+    master = TCPStore(is_master=True, world_size=1)
+    client = TCPStore(port=master.port, world_size=1)
+    try:
+        got = {}
+
+        def getter():
+            got["v"] = client.get("late_key", timeout=5.0)
+
+        th = threading.Thread(target=getter)
+        th.start()
+        time.sleep(0.2)
+        master.set("late_key", b"arrived")
+        th.join(timeout=5)
+        assert got.get("v") == b"arrived"
+    finally:
+        client.close()
+        master.close()
+
+
+def test_store_wait_timeout():
+    master = TCPStore(is_master=True, world_size=1)
+    try:
+        t0 = time.time()
+        with pytest.raises(TimeoutError):
+            master.wait("never_set", timeout=0.3)
+        assert time.time() - t0 < 2.0
+    finally:
+        master.close()
+
+
+def test_store_barrier_two_clients():
+    master = TCPStore(is_master=True, world_size=2)
+    client = TCPStore(port=master.port, world_size=2)
+    try:
+        reached = []
+
+        def side(store, tag_id):
+            store.barrier("b1")
+            reached.append(tag_id)
+
+        t1 = threading.Thread(target=side, args=(master, 0))
+        t2 = threading.Thread(target=side, args=(client, 1))
+        t1.start()
+        time.sleep(0.1)
+        assert reached == []  # first waits for second
+        t2.start()
+        t1.join(5)
+        t2.join(5)
+        assert sorted(reached) == [0, 1]
+    finally:
+        client.close()
+        master.close()
+
+
+def test_store_barrier_named_tag_reused_in_loop():
+    master = TCPStore(is_master=True, world_size=2)
+    client = TCPStore(port=master.port, world_size=2)
+    try:
+        order = []
+
+        def side(store, who):
+            for i in range(3):
+                store.barrier("loop")  # same named tag every round
+                order.append((who, i))
+
+        t1 = threading.Thread(target=side, args=(master, "a"))
+        t2 = threading.Thread(target=side, args=(client, "b"))
+        t1.start()
+        t2.start()
+        t1.join(10)
+        t2.join(10)
+        assert len(order) == 6
+        # both sides completed every round (rounds can't be skipped)
+        for who in ("a", "b"):
+            assert [i for w, i in order if w == who] == [0, 1, 2]
+    finally:
+        client.close()
+        master.close()
+
+
+def test_store_large_value():
+    master = TCPStore(is_master=True, world_size=1)
+    try:
+        blob = os.urandom(2 * 1024 * 1024)
+        master.set("blob", blob)
+        assert master.get("blob") == blob
+    finally:
+        master.close()
+
+
+# ---------------------------------------------------------------- rpc
+_WORKER = r"""
+import os, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from paddle_tpu.distributed import rpc
+
+def double(x):
+    return 2 * x
+
+def whoami():
+    return rpc.get_current_worker_info().name
+
+rank = int(sys.argv[1])
+rpc.init_rpc(f"worker{{rank}}".format(rank=rank), rank=rank, world_size=2,
+             master_endpoint=sys.argv[2])
+if rank == 0:
+    assert rpc.rpc_sync("worker1", double, args=(21,)) == 42
+    fut = rpc.rpc_async("worker1", whoami)
+    assert fut.wait() == "worker1", fut.wait()
+    infos = rpc.get_all_worker_infos()
+    assert [w.name for w in infos] == ["worker0", "worker1"]
+rpc.shutdown()
+print(f"RANK{{rank}}_OK".format(rank=rank))
+"""
+
+
+def test_rpc_two_processes(tmp_path):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = tmp_path / "rpc_worker.py"
+    script.write_text(_WORKER.format(repo=repo))
+    # pick a free port for the master
+    import socket
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    endpoint = f"127.0.0.1:{port}"
+    procs = [subprocess.Popen([sys.executable, str(script), str(r), endpoint],
+                              stdout=subprocess.PIPE,
+                              stderr=subprocess.STDOUT, text=True)
+             for r in range(2)]
+    outs = []
+    for p in procs:
+        out, _ = p.communicate(timeout=120)
+        outs.append(out)
+        assert p.returncode == 0, out
+    assert "RANK0_OK" in outs[0]
+    assert "RANK1_OK" in outs[1]
+
+
+def _boom():
+    raise ValueError("remote exploded")
+
+
+def test_rpc_error_propagates():
+    from paddle_tpu.distributed import rpc as rpc_mod
+
+    agent = rpc_mod.init_rpc("solo", rank=0, world_size=1,
+                             master_endpoint="127.0.0.1:0")
+    try:
+        assert rpc_mod.rpc_sync("solo", len, args=("abcd",)) == 4
+        with pytest.raises(ValueError, match="remote exploded"):
+            rpc_mod.rpc_sync("solo", _boom)
+        with pytest.raises(ValueError, match="unknown rpc worker"):
+            rpc_mod.rpc_sync("nobody", len, args=("x",))
+    finally:
+        rpc_mod.shutdown()
