@@ -54,15 +54,19 @@ from .hapi.model import Model  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .tensor_module import tensor  # noqa: F401
 
-# paddle.disable_static / enable_static compat: the framework is always
-# "dynamic"; static graphs are jit.to_static traces.
 def disable_static(place=None):
+    from .static.graph import disable_static_mode
+    disable_static_mode()
     return None
 
 
 def enable_static():
-    raise NotImplementedError(
-        "paddle_tpu has no legacy static mode; use paddle_tpu.jit.to_static")
+    from .static.graph import enable_static_mode
+    enable_static_mode()
+
+
+def in_dygraph_mode():
+    return in_dynamic_mode()
 
 
 def is_grad_enabled():

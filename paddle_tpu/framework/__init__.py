@@ -9,8 +9,9 @@ from .tensor import (Tensor, Parameter, GradNode, apply_op, no_grad,
 
 
 def in_dynamic_mode() -> bool:
-    """Always-eager façade (static mode is jit.to_static)."""
-    return True
+    """True unless paddle.enable_static() switched to graph mode."""
+    from ..static.graph import in_static_mode
+    return not in_static_mode()
 
 
 def in_pir_mode() -> bool:

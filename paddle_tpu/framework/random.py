@@ -69,6 +69,20 @@ def next_key():
     return _default_generator.next_key()
 
 
+def op_key(*inputs):
+    """Key for a *recorded* random op (dropout etc.). If any input is a
+    static-graph Variable, returns a lazy key Variable that the static
+    Executor feeds fresh per run — otherwise the key captured at
+    graph-build time would replay the identical mask every Executor.run.
+    Concrete inputs get a fresh concrete key even under enable_static()
+    (eager preprocessing keeps working in static mode)."""
+    lazy = [x for x in inputs if getattr(x, "_is_lazy", False)]
+    if lazy:
+        from ..static.graph import static_rng_key, target_program
+        return static_rng_key(target_program(lazy))
+    return _default_generator.next_key()
+
+
 def get_rng_state():
     return _default_generator.get_state()
 

@@ -138,6 +138,12 @@ def _check_finite(name, arrays):
                 print("WARNING:", msg)
 
 
+# static-graph hook: paddle_tpu.static.graph installs (Variable, record_op)
+# here so lazy inputs divert the dispatch into the current Program.
+_lazy_cls = None
+_lazy_record = None
+
+
 def apply_op(fn: Callable, *inputs, _op_name: Optional[str] = None, **kwargs):
     """Execute ``fn`` on unwrapped arrays, recording a grad node if needed.
 
@@ -148,6 +154,9 @@ def apply_op(fn: Callable, *inputs, _op_name: Optional[str] = None, **kwargs):
     meta -> GradNode -> phi API).
     """
     name = _op_name or getattr(fn, "__name__", "op")
+    if _lazy_cls is not None and any(
+            isinstance(x, _lazy_cls) for x in inputs):
+        return _lazy_record(fn, inputs, kwargs, name)
     arrs = [x._data if isinstance(x, Tensor) else x for x in inputs]
 
     # AMP O1 hook (python/paddle/amp — cast per white/black lists); the

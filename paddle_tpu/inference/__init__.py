@@ -72,11 +72,15 @@ class Tensor:
         return list(self._spec.shape)
 
     def reshape(self, shape: Sequence[int]):
-        # kept for API compat; shapes are static in the exported program
-        if tuple(shape) != tuple(self._spec.shape):
+        # kept for API compat; non-int dims in the spec are jax.export
+        # symbolic dims (dynamic-batch exports) and accept any size
+        spec_shape = tuple(self._spec.shape)
+        if len(shape) != len(spec_shape) or any(
+                isinstance(s, int) and s != g
+                for s, g in zip(spec_shape, shape)):
             raise ValueError(
-                f"input '{self.name}' was exported with static shape "
-                f"{tuple(self._spec.shape)}; got {tuple(shape)}. Re-export "
+                f"input '{self.name}' was exported with shape "
+                f"{spec_shape}; got {tuple(shape)}. Re-export "
                 "with jit.save(input_spec=...) for the new shape.")
 
     def type(self):
@@ -84,9 +88,13 @@ class Tensor:
 
     def copy_from_cpu(self, data) -> None:
         arr = np.asarray(data)
-        if arr.shape != tuple(self._spec.shape):
+        spec_shape = tuple(self._spec.shape)
+        if len(arr.shape) != len(spec_shape) or any(
+                isinstance(s, int) and s != a
+                for s, a in zip(spec_shape, arr.shape)):
+            # non-int dims are jax.export symbolic dims: any size is valid
             raise ValueError(
-                f"input '{self.name}' expects shape {tuple(self._spec.shape)}"
+                f"input '{self.name}' expects shape {spec_shape}"
                 f", got {arr.shape}")
         self._value = jnp.asarray(arr, dtype=self._spec.dtype)
 

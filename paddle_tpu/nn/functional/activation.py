@@ -149,10 +149,11 @@ def glu(x, axis=-1, name=None):
 
 def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
     from ...framework import random as rnd
-    key = rnd.next_key()
+    from ...framework.tensor import apply_op
+    key = rnd.op_key(x)
 
-    def f(a):
-        g = jax.random.gumbel(key, a.shape, a.dtype)
+    def f(a, k):
+        g = jax.random.gumbel(k, a.shape, a.dtype)
         y = jax.nn.softmax((a + g) / temperature, axis=axis)
         if hard:
             idx = jnp.argmax(y, axis=axis)
@@ -160,7 +161,7 @@ def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
                 jax.nn.one_hot(idx, y.shape[axis], dtype=y.dtype), -1, axis)
             return jax.lax.stop_gradient(hard_y - y) + y
         return y
-    return _u(f, "gumbel_softmax", x)
+    return apply_op(f, x, key, _op_name="gumbel_softmax")
 
 
 def maxout(x, groups, axis=1, name=None):
@@ -175,13 +176,14 @@ def maxout(x, groups, axis=1, name=None):
 def rrelu(x, lower=1.0 / 8.0, upper=1.0 / 3.0, training=False, name=None):
     from ...framework import random as rnd
     if training:
-        key = rnd.next_key()
+        from ...framework.tensor import apply_op
+        key = rnd.op_key(x)
 
-        def f(a):
-            slope = jax.random.uniform(key, a.shape, jnp.float32, lower,
+        def f(a, k):
+            slope = jax.random.uniform(k, a.shape, jnp.float32, lower,
                                        upper).astype(a.dtype)
             return jnp.where(a >= 0, a, slope * a)
-        return _u(f, "rrelu", x)
+        return apply_op(f, x, key, _op_name="rrelu")
     mid = (lower + upper) / 2.0
     return _u(lambda a: jnp.where(a >= 0, a, mid * a), "rrelu", x)
 

@@ -56,7 +56,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  training=True, name=None):
     """paddle layout [batch_size, seq_len, num_heads, head_dim]."""
     drop = dropout_p if training else 0.0
-    rkey = rnd.next_key() if drop > 0.0 else None
+    rkey = rnd.op_key(query, key, value) if drop > 0.0 else None
 
     use_pallas = (attn_mask is None and drop == 0.0 and
                   _pallas_eligible(query))
@@ -66,12 +66,22 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
             lambda q, k, v: flash_attention_fwd(q, k, v, causal=is_causal),
             query, key, value, _op_name="flash_attention")
 
+    if drop > 0.0:
+        if attn_mask is not None:
+            return apply_op(
+                lambda q, k, v, m, rk:
+                    _sdpa_xla(q, k, v, m, is_causal, drop, rk),
+                query, key, value, attn_mask, rkey, _op_name="sdpa")
+        return apply_op(
+            lambda q, k, v, rk: _sdpa_xla(q, k, v, None, is_causal, drop,
+                                          rk),
+            query, key, value, rkey, _op_name="sdpa")
     if attn_mask is not None:
         return apply_op(
-            lambda q, k, v, m: _sdpa_xla(q, k, v, m, is_causal, drop, rkey),
+            lambda q, k, v, m: _sdpa_xla(q, k, v, m, is_causal, drop, None),
             query, key, value, attn_mask, _op_name="sdpa")
     return apply_op(
-        lambda q, k, v: _sdpa_xla(q, k, v, None, is_causal, drop, rkey),
+        lambda q, k, v: _sdpa_xla(q, k, v, None, is_causal, drop, None),
         query, key, value, _op_name="sdpa")
 
 

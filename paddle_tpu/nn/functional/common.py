@@ -50,18 +50,18 @@ def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
         return x if isinstance(x, Tensor) else Tensor(jnp.asarray(x))
     if p == 1.0:
         return apply_op(lambda a: jnp.zeros_like(a), x, _op_name="dropout")
-    key = rnd.next_key()
+    key = rnd.op_key(x)
 
-    def f(a):
+    def f(a, k):
         shape = list(a.shape)
         if axis is not None:
             axes = axis if isinstance(axis, (list, tuple)) else [axis]
             shape = [s if i in axes else 1 for i, s in enumerate(a.shape)]
-        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        keep = jax.random.bernoulli(k, 1.0 - p, tuple(shape))
         if mode == "upscale_in_train":
             return jnp.where(keep, a / (1.0 - p), 0.0).astype(a.dtype)
         return jnp.where(keep, a, 0.0).astype(a.dtype)
-    return apply_op(f, x, _op_name="dropout")
+    return apply_op(f, x, key, _op_name="dropout")
 
 
 def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
@@ -77,18 +77,18 @@ def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
 def alpha_dropout(x, p=0.5, training=True, name=None):
     if not training or p == 0.0:
         return x
-    key = rnd.next_key()
+    key = rnd.op_key(x)
     alpha = 1.6732632423543772
     scale = 1.0507009873554805
     alpha_p = -alpha * scale
 
-    def f(a):
-        keep = jax.random.bernoulli(key, 1.0 - p, a.shape)
+    def f(a, k):
+        keep = jax.random.bernoulli(k, 1.0 - p, a.shape)
         q = 1.0 - p
         A = (q + alpha_p ** 2 * q * (1 - q)) ** -0.5
         B = -A * alpha_p * (1 - q)
         return (A * jnp.where(keep, a, alpha_p) + B).astype(a.dtype)
-    return apply_op(f, x, _op_name="alpha_dropout")
+    return apply_op(f, x, key, _op_name="alpha_dropout")
 
 
 def interpolate(x, size=None, scale_factor=None, mode="nearest",

@@ -25,9 +25,13 @@ class Optimizer:
     def __init__(self, learning_rate=0.001, parameters=None,
                  weight_decay=None, grad_clip=None, name=None):
         if parameters is None:
-            raise ValueError(
-                "parameters is required in eager mode (pass "
-                "model.parameters())")
+            from ..static.graph import in_static_mode
+            if not in_static_mode():
+                raise ValueError(
+                    "parameters is required in eager mode (pass "
+                    "model.parameters()); in static mode minimize() "
+                    "collects the program's parameters")
+            parameters = []
         self._parameter_list = list(parameters)
         self._learning_rate = learning_rate
         self._grad_clip = grad_clip
@@ -130,6 +134,16 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        if getattr(loss, "_is_lazy", False):  # static-graph Variable
+            from ..static.graph import append_optimize
+            if parameters is not None:
+                self._parameter_list = list(parameters)
+            elif not self._parameter_list:
+                self._parameter_list = [
+                    p for p in loss.program._parameters
+                    if not p.stop_gradient]
+            append_optimize(self, loss)
+            return None, None
         loss.backward()
         self.step()
         self.clear_grad()

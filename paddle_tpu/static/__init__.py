@@ -1,6 +1,32 @@
-"""Static-graph compat shims. The framework has no legacy Program IR —
-jit.to_static covers graph capture; InputSpec re-exported here for API
-compat (reference: python/paddle/static/)."""
-from ..jit.static_function import InputSpec  # noqa: F401
+"""paddle.static analog — static-graph build + execution.
 
-__all__ = ["InputSpec"]
+Reference: python/paddle/static/ (24.9k LoC: Program/Executor user API,
+static.nn, io). TPU-native design in graph.py/executor.py: Variables defer
+the framework's single op-dispatch funnel into a recorded Program;
+jax.eval_shape is InferMeta; one jax.jit replay is the executor; StableHLO
+export is the deployment format.
+"""
+from ..jit.static_function import InputSpec  # noqa: F401
+from .graph import (Program, Variable, program_guard,  # noqa: F401
+                    default_main_program, default_startup_program, data,
+                    in_static_mode, create_parameter, create_global_var,
+                    append_backward, gradients, name_scope)
+from .executor import (Executor, CompiledProgram, BuildStrategy,  # noqa
+                       ExecutionStrategy, global_scope, scope_guard, Scope)
+from .io import (save_inference_model, load_inference_model,  # noqa: F401
+                 serialize_program, deserialize_program, normalize_program)
+from . import nn  # noqa: F401
+
+# paddle.static.py_func has no XLA analog; pure-python ops fall back to
+# dynamic mode (jax.pure_callback would break export portability)
+
+__all__ = [
+    "InputSpec", "Program", "Variable", "program_guard",
+    "default_main_program", "default_startup_program", "data",
+    "in_static_mode", "create_parameter", "create_global_var",
+    "append_backward", "gradients", "name_scope",
+    "Executor", "CompiledProgram", "BuildStrategy", "ExecutionStrategy",
+    "global_scope", "scope_guard", "Scope",
+    "save_inference_model", "load_inference_model", "serialize_program",
+    "deserialize_program", "normalize_program", "nn",
+]

@@ -1,0 +1,349 @@
+"""Static-graph Executor: jit-compiled Program replay.
+
+Reference: python/paddle/base/executor.py Executor.run feeding a
+StandaloneExecutor/PirInterpreter
+(paddle/fluid/framework/new_executor/interpretercore.h:30) that builds an
+instruction list with stream-aware dependencies and a garbage collector.
+TPU-native: the recorded OpNode list is replayed inside ONE ``jax.jit`` —
+XLA does scheduling, fusion, and memory planning; the compiled executable
+is cached per (program version, feed signature, fetch set), which is the
+analog of the reference's executor_cache (base/executor.py _ExecutorCache).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .graph import (Program, Variable, default_main_program,
+                    default_startup_program)
+
+__all__ = ["Executor", "CompiledProgram", "BuildStrategy",
+           "ExecutionStrategy", "global_scope", "scope_guard", "Scope"]
+
+
+class Scope:
+    """Minimal variable-scope shim (paddle/fluid/framework/scope.h:50)."""
+
+    def __init__(self):
+        self._vars: Dict[str, Any] = {}
+
+    def var(self, name):
+        return self._vars.setdefault(name, _ScopeVar(name))
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+    def drop_kids(self):
+        pass
+
+
+class _ScopeVar:
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def get_tensor(self):
+        return self._value
+
+    def set(self, value, place=None):
+        self._value = np.asarray(value)
+
+
+_global_scope = Scope()
+
+
+def global_scope() -> Scope:
+    return _global_scope
+
+
+class scope_guard:
+    def __init__(self, scope):
+        self.scope = scope
+
+    def __enter__(self):
+        global _global_scope
+        self._prev = _global_scope
+        _global_scope = self.scope
+        return self.scope
+
+    def __exit__(self, *exc):
+        global _global_scope
+        _global_scope = self._prev
+        return False
+
+
+class BuildStrategy:
+    def __init__(self):
+        self.memory_optimize = None
+        self.enable_inplace = None
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+
+
+def CompiledProgram(program, build_strategy=None):
+    """Compilation happens in Executor.run via jit; identity here."""
+    return program
+
+
+class Executor:
+    """paddle.static.Executor analog."""
+
+    _CACHE_CAP = 64  # compiled-program LRU bound (executor_cache analog)
+
+    def __init__(self, place=None):
+        self.place = place
+        from collections import OrderedDict
+        self._cache: "OrderedDict[Tuple, Any]" = OrderedDict()
+
+    def close(self):
+        self._cache.clear()
+
+    # -- internals ---------------------------------------------------------
+    def _resolve_fetch(self, program: Program, fetch_list):
+        fetch_vars: List[Variable] = []
+        for f in fetch_list or []:
+            if isinstance(f, str):
+                if f not in program.vars:
+                    raise KeyError(f"fetch variable '{f}' not in program")
+                fetch_vars.append(program.vars[f])
+            elif isinstance(f, Variable):
+                fetch_vars.append(f)
+            elif isinstance(f, Tensor):
+                # concrete tensor (e.g. a parameter): fetch current value
+                fetch_vars.append(f)
+            else:
+                raise TypeError(f"bad fetch entry {f!r}")
+        return fetch_vars
+
+    def _feed_vars(self, program: Program, feed: Dict[str, Any]):
+        unknown = [n for n in feed if n not in program.vars]
+        if unknown:
+            raise KeyError(
+                f"feed entries {unknown} are not variables of this program "
+                f"(feeds: {[v.name for v in program.vars.values() if getattr(v, 'is_feed', False)]})")
+        # feeding a non-feed Variable overrides its computed value
+        # (reference Executor honors feeds of intermediates the same way)
+        names = list(feed)
+        names.sort(key=lambda n: (program._feed_order.index(n)
+                   if n in program._feed_order
+                   else len(program._feed_order), n))
+        return names
+
+    def _build(self, program: Program, feed_names, fetch_vars, grad_params):
+        """Build + jit the replay function.
+
+        Signature: (cap_vals, feed_vals) -> (fetches..., grads...)
+        where grads covers program._grad_requests and optimizer params.
+        """
+        feed_name_set = set(feed_names)
+        grad_req = list(program._grad_requests.values())
+
+        # prune to ops reachable from fetches + losses (the analog of the
+        # reference's Program.clone(for_test)/prune_backward pruning)
+        roots: List[Variable] = []
+        for f in fetch_vars:
+            if isinstance(f, Variable):
+                if id(f) in program._grad_requests:
+                    roots.append(program._grad_requests[id(f)][0])
+                else:
+                    roots.append(f)
+        for _, loss_v in program._opt_specs:
+            roots.append(loss_v)
+        for loss_v, _ in grad_req:
+            roots.append(loss_v)
+        fed_ids = {id(program.vars[n]) for n in feed_names}
+        needed_ops = set()
+        stack = [v.producer for v in roots
+                 if v.producer is not None and id(v) not in fed_ids]
+        while stack:
+            node = stack.pop()
+            if node.idx in needed_ops:
+                continue
+            needed_ops.add(node.idx)
+            for x in node.inputs:
+                if (isinstance(x, Variable) and x.producer is not None
+                        and id(x) not in fed_ids):  # fed overrides cut here
+                    stack.append(x.producer)
+        live_ops = [op for op in program.ops if op.idx in needed_ops]
+
+        def run_graph(cap_vals, feed_vals):
+            env: Dict[int, Any] = {}
+            for name, val in zip(feed_names, feed_vals):
+                env[id(program.vars[name])] = val
+
+            def resolve(x):
+                if isinstance(x, Variable):
+                    key = id(x)
+                    if key not in env:
+                        if x.is_feed:
+                            raise KeyError(
+                                f"feed '{x.name}' missing from feed dict")
+                        raise KeyError(
+                            f"Variable '{x.name}' used before definition")
+                    return env[key]
+                if isinstance(x, Tensor):
+                    return cap_vals[program._cap_index[id(x)]]
+                return x
+
+            for node in live_ops:
+                args = [resolve(x) for x in node.inputs]
+                out = node.fn(*args, **node.kwargs)
+                outs = list(out) if isinstance(out, (tuple, list)) else [out]
+                for v, o in zip(node.outputs, outs):
+                    if id(v) not in env:  # fed overrides win
+                        env[id(v)] = o
+            return env
+
+        # which captured tensors need grads (by capture index)
+        need_grad_idx: List[int] = []
+        grad_feed_names: List[str] = []
+        for loss_var, wrt in grad_req:
+            if isinstance(wrt, Variable):
+                if wrt.name not in feed_name_set:
+                    raise KeyError(
+                        f"gradient w.r.t. feed '{wrt.name}' requested but "
+                        f"it is not fed")
+                grad_feed_names.append(wrt.name)
+            else:
+                need_grad_idx.append(program._cap_index[id(wrt)])
+        for opt, loss_var in program._opt_specs:
+            for p in opt._parameter_list:
+                if not p.stop_gradient and id(p) in program._cap_index:
+                    need_grad_idx.append(program._cap_index[id(p)])
+        need_grad_idx = sorted(set(need_grad_idx))
+        grad_feed_names = sorted(set(grad_feed_names))
+        loss_vars = [lv for lv, _ in grad_req] + \
+                    [lv for _, lv in program._opt_specs]
+        if (need_grad_idx or grad_feed_names) and not loss_vars:
+            raise RuntimeError("gradients requested without a loss")
+        loss_var = loss_vars[0] if loss_vars else None
+        for lv in loss_vars[1:]:
+            if lv is not loss_var:
+                raise NotImplementedError(
+                    "multiple distinct losses in one program")
+
+        def replay(cap_vals, feed_vals):
+            grads_by_idx: Dict[int, Any] = {}
+            grads_by_feed: Dict[str, Any] = {}
+            if loss_var is not None and (need_grad_idx or grad_feed_names):
+                # single forward trace: value_and_grad with the whole env
+                # as aux, so fetches reuse the same forward computation
+                feed_pos = [feed_names.index(n) for n in grad_feed_names]
+
+                def loss_and_env(wrt_caps, wrt_feeds):
+                    cv = list(cap_vals)
+                    for i, v in zip(need_grad_idx, wrt_caps):
+                        cv[i] = v
+                    fv = list(feed_vals)
+                    for i, v in zip(feed_pos, wrt_feeds):
+                        fv[i] = v
+                    e = run_graph(cv, fv)
+                    return e[id(loss_var)], e
+
+                (_, env), (gc, gf) = jax.value_and_grad(
+                    loss_and_env, argnums=(0, 1), has_aux=True)(
+                    [cap_vals[i] for i in need_grad_idx],
+                    [feed_vals[i] for i in feed_pos])
+                grads_by_idx = dict(zip(need_grad_idx, gc))
+                grads_by_feed = dict(zip(grad_feed_names, gf))
+            else:
+                env = run_graph(cap_vals, feed_vals)
+
+            out_fetches = []
+            for f in fetch_vars:
+                if isinstance(f, Variable):
+                    key = id(f)
+                    if key in program._grad_requests:
+                        _, wrt = program._grad_requests[key]
+                        if isinstance(wrt, Variable):
+                            out_fetches.append(grads_by_feed[wrt.name])
+                        else:
+                            out_fetches.append(
+                                grads_by_idx[program._cap_index[id(wrt)]])
+                    else:
+                        out_fetches.append(env[key])
+                else:  # concrete Tensor
+                    out_fetches.append(cap_vals[program._cap_index[id(f)]])
+            opt_grads = [grads_by_idx.get(i) for i in need_grad_idx]
+            return out_fetches, opt_grads
+
+        jitted = jax.jit(replay)
+        return jitted, need_grad_idx
+
+    # -- public ------------------------------------------------------------
+    def run(self, program: Optional[Program] = None,
+            feed: Optional[Dict[str, Any]] = None,
+            fetch_list: Optional[Sequence] = None,
+            feed_var_name="feed", fetch_var_name="fetch", scope=None,
+            return_numpy: bool = True, use_prune: bool = False):
+        from .io import _LoadedProgram
+        if isinstance(program, _LoadedProgram):
+            return program._run(feed or {}, fetch_list, return_numpy)
+        if program is None:
+            program = default_main_program()
+        feed = feed or {}
+        fetch_vars = self._resolve_fetch(program, fetch_list)
+        if not program.ops:
+            # startup program (params are initialized eagerly at creation)
+            if fetch_list is None:
+                return []
+            out = []
+            for f in fetch_vars:
+                if isinstance(f, Variable):
+                    if f.is_feed and f.name in feed:
+                        out.append(np.asarray(feed[f.name]))
+                    else:
+                        raise RuntimeError(
+                            f"cannot fetch '{f.name}' from a program with "
+                            f"no ops (feed it or add ops)")
+                else:
+                    out.append(np.asarray(f._data))
+            return out
+
+        feed_names = self._feed_vars(program, feed)
+        sig = tuple((n, tuple(np.shape(feed[n])),
+                     str(np.asarray(feed[n]).dtype)) for n in feed_names)
+        feed_vals = [np.asarray(feed[n]) for n in feed_names]
+        if program._rng_feed is not None:
+            # implicit per-run PRNG base key: fresh randomness each run
+            from ..framework import random as rnd
+            feed_names = feed_names + [program._rng_feed.name]
+            feed_vals = feed_vals + [rnd.next_key()]
+        key = (id(program), program._version, sig,
+               tuple(id(f) for f in fetch_vars))
+        entry = self._cache.get(key)
+        if entry is None:
+            entry = self._build(program, feed_names, fetch_vars, None)
+            self._cache[key] = entry
+            if len(self._cache) > self._CACHE_CAP:
+                self._cache.popitem(last=False)
+        else:
+            self._cache.move_to_end(key)
+        jitted, need_grad_idx = entry
+
+        cap_vals = [t._data for t in program._captured]
+        out_fetches, opt_grads = jitted(cap_vals, feed_vals)
+
+        # apply recorded optimizer updates eagerly (all optimizers/LR
+        # schedulers work unmodified; the jitted path is to_static)
+        if program._opt_specs and opt_grads:
+            grads_by_idx = dict(zip(need_grad_idx, opt_grads))
+            for opt, _ in program._opt_specs:
+                for p in opt._parameter_list:
+                    gi = program._cap_index.get(id(p))
+                    if gi is not None and gi in grads_by_idx:
+                        p._accumulate_grad(grads_by_idx[gi])
+                opt.step()
+                opt.clear_grad()
+
+        if fetch_list is None:
+            return []
+        if return_numpy:
+            return [np.asarray(jax.device_get(o)) for o in out_fetches]
+        return [Tensor(o) for o in out_fetches]

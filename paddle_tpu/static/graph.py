@@ -1,0 +1,565 @@
+"""Static-graph core: lazy Variables recorded into a Program.
+
+Reference: python/paddle/base/framework.py (Program :5886, Block :4219,
+Variable :1641, Operator :3105) — a protobuf ProgramDesc IR built by every
+layer call under ``paddle.enable_static()`` and executed later by the
+StandaloneExecutor (paddle/fluid/framework/new_executor/interpretercore.h:30).
+
+TPU-native design: there is no separate op IR to invent — every op in this
+framework already funnels through one dispatch point
+(``framework.tensor.apply_op``), so static mode simply *defers* that
+dispatch.  A ``Variable`` is a data-less Tensor carrying a
+``jax.ShapeDtypeStruct`` (with jax.export symbolic dims for None/-1 feed
+dims — the InferMeta analog is ``jax.eval_shape``, which reuses the exact
+op implementations instead of a second 49k-LoC shape-inference library,
+cf. paddle/phi/infermeta/).  Each deferred op appends an ``OpNode`` to the
+current ``Program``; ``static.Executor`` replays the node list inside one
+``jax.jit`` — XLA is the executor, dependency builder, and memory planner
+that interpretercore.h hand-implements for CUDA streams.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import dtype as dtype_mod
+from ..framework.dtype import to_dtype
+from ..framework import tensor as tensor_mod
+from ..framework.tensor import Tensor
+
+__all__ = [
+    "Variable", "OpNode", "Program", "Block", "program_guard",
+    "default_main_program", "default_startup_program", "data",
+    "in_static_mode", "enable_static_mode", "disable_static_mode",
+    "create_parameter", "create_global_var", "append_optimize",
+    "append_backward", "gradients", "name_scope",
+]
+
+_tls = threading.local()
+
+
+def _stack():
+    if not hasattr(_tls, "programs"):
+        _tls.programs = []
+    return _tls.programs
+
+
+# process-global default (paddle.enable_static) with a thread-local
+# override (program_guard), so a guard in one thread cannot flip e.g. a
+# DataLoader worker thread into static mode mid-batch
+_static_mode_global = [False]
+
+
+def in_static_mode() -> bool:
+    override = getattr(_tls, "static_override", None)
+    if override is not None:
+        return override
+    return _static_mode_global[0]
+
+
+def enable_static_mode():
+    _static_mode_global[0] = True
+    if not hasattr(_tls, "default_main"):
+        _tls.default_main = Program()
+        _tls.default_startup = Program()
+
+
+def disable_static_mode():
+    _static_mode_global[0] = False
+
+
+def default_main_program() -> "Program":
+    if _stack():
+        return _stack()[-1][0]
+    if not hasattr(_tls, "default_main"):
+        _tls.default_main = Program()
+    return _tls.default_main
+
+
+def default_startup_program() -> "Program":
+    if _stack():
+        return _stack()[-1][1]
+    if not hasattr(_tls, "default_startup"):
+        _tls.default_startup = Program()
+    return _tls.default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program: "Program",
+                  startup_program: Optional[
+                      "Program"] = None):
+    """paddle.static.program_guard analog (thread-local)."""
+    prev_override = getattr(_tls, "static_override", None)
+    _tls.static_override = True
+    _stack().append((main_program,
+                     startup_program or Program()))
+    try:
+        yield
+    finally:
+        _stack().pop()
+        _tls.static_override = prev_override
+
+
+@contextlib.contextmanager
+def name_scope(prefix: str):
+    yield
+
+
+# --------------------------------------------------------------------------
+# Variable: a data-less Tensor whose value exists only at Executor.run time
+# --------------------------------------------------------------------------
+
+class Variable(Tensor):
+    """Symbolic tensor in a Program (base/framework.py:1641 analog)."""
+
+    _is_lazy = True
+
+    def __init__(self, aval: jax.ShapeDtypeStruct, program: "Program",
+                 name: Optional[str] = None, producer=None, out_idx: int = 0,
+                 is_feed: bool = False, stop_gradient: bool = True):
+        # deliberately do NOT call Tensor.__init__ — no data exists
+        self._data = None
+        self.stop_gradient = stop_gradient
+        self.grad = None
+        self.grad_node = None
+        self._out_idx = out_idx
+        self._hooks = {}
+        self._retain_grad = False
+        self.persistable = False
+        self.aval = aval
+        self.program = program
+        self.producer = producer  # OpNode | None (feed/const source)
+        self.is_feed = is_feed
+        if name is None:
+            program._var_counter += 1
+            name = f"_generated_var_{program._var_counter}"
+        self.name = name
+        program.vars[name] = self
+
+    # -- metadata from the aval -------------------------------------------
+    def _shape(self):
+        return tuple(d if isinstance(d, int) else -1
+                     for d in self.aval.shape)
+
+    @property
+    def shape(self):
+        return list(self._shape())
+
+    @property
+    def ndim(self):
+        return len(self.aval.shape)
+
+    @property
+    def size(self):
+        dims = self._shape()
+        if -1 in dims:
+            return -1
+        return int(np.prod(dims, dtype=np.int64)) if dims else 1
+
+    @property
+    def dtype(self):
+        return dtype_mod.from_np(np.dtype(self.aval.dtype))
+
+    def __repr__(self):
+        return (f"Variable(name={self.name!r}, shape={self.shape}, "
+                f"dtype={self.dtype.name})")
+
+    def __len__(self):
+        d = self._shape()
+        if not d:
+            raise TypeError("len() of a 0-d Variable")
+        if d[0] == -1:
+            raise ValueError("first dim of Variable is dynamic")
+        return d[0]
+
+    def _no_data(self, what):
+        raise RuntimeError(
+            f"Variable '{self.name}' has no value at graph-build time; "
+            f"{what} is only available from Executor.run fetch results")
+
+    def numpy(self):
+        self._no_data("numpy()")
+
+    def item(self):
+        self._no_data("item()")
+
+    def __bool__(self):
+        self._no_data("bool()")
+
+    def __float__(self):
+        self._no_data("float()")
+
+    def __int__(self):
+        self._no_data("int()")
+
+    def __array__(self, dtype=None):
+        self._no_data("__array__")
+
+    def backward(self, grad_tensor=None, retain_graph=False):
+        raise RuntimeError(
+            "Variable.backward() is not defined at graph-build time; use "
+            "paddle.static.append_backward(loss) or optimizer.minimize")
+
+
+class OpNode:
+    """One deferred op: (fn, inputs, kwargs) -> output Variables.
+
+    The analog of framework.py:3105 Operator, except ``fn`` IS the op
+    implementation (a jax-traceable callable), so there is no opcode →
+    kernel lookup at execution time.
+    """
+
+    __slots__ = ("fn", "inputs", "kwargs", "outputs", "name", "idx")
+
+    def __init__(self, fn, inputs, kwargs, name, idx):
+        self.fn = fn
+        self.inputs = inputs      # tuple of Variable | Tensor | python const
+        self.kwargs = kwargs
+        self.outputs: List[Variable] = []
+        self.name = name
+        self.idx = idx
+
+    @property
+    def type(self):
+        return self.name
+
+    def __repr__(self):
+        ins = [getattr(x, "name", repr(x)) for x in self.inputs]
+        outs = [o.name for o in self.outputs]
+        return f"Op({self.name}: {ins} -> {outs})"
+
+
+class Block:
+    """Minimal Block shim (framework.py:4219) over the flat op list."""
+
+    def __init__(self, program):
+        self.program = program
+        self.idx = 0
+
+    @property
+    def ops(self):
+        return self.program.ops
+
+    @property
+    def vars(self):
+        return self.program.vars
+
+    def var(self, name):
+        return self.program.vars[name]
+
+    def has_var(self, name):
+        return name in self.program.vars
+
+    def all_parameters(self):
+        return list(self.program._parameters)
+
+    def create_var(self, name=None, shape=None, dtype="float32", **kw):
+        aval = _make_aval(shape or [], dtype, self.program)
+        return Variable(aval, self.program, name=name)
+
+
+class Program:
+    """Recorded static graph (base/framework.py:5886 analog)."""
+
+    def __init__(self):
+        self.ops: List[OpNode] = []
+        self.vars: Dict[str, Variable] = {}
+        self.random_seed = 0
+        self._var_counter = 0
+        self._version = 0
+        # concrete Tensors captured by ops (parameters and constants): they
+        # become jit arguments so in-place updates never retrigger capture
+        self._captured: List[Tensor] = []
+        self._cap_index: Dict[int, int] = {}
+        self._parameters: List[Tensor] = []
+        self._opt_specs: List[Tuple[Any, "Variable"]] = []  # (optimizer, loss)
+        self._grad_requests: Dict[int, Tuple[Variable, Any]] = {}
+        self._feed_order: List[str] = []
+        self._sym_scope = None  # jax.export.SymbolicScope, lazily created
+        self._rng_feed: Optional["Variable"] = None  # implicit per-run key
+        self._rng_counter = 0
+
+    # -- capture ----------------------------------------------------------
+    def capture(self, t: Tensor) -> int:
+        key = id(t)
+        if key not in self._cap_index:
+            self._cap_index[key] = len(self._captured)
+            self._captured.append(t)
+            if not t.stop_gradient or t.persistable:
+                self._parameters.append(t)
+        return self._cap_index[key]
+
+    def append_op_node(self, fn, inputs, kwargs, name) -> OpNode:
+        node = OpNode(fn, inputs, kwargs, name, len(self.ops))
+        self.ops.append(node)
+        self._version += 1
+        return node
+
+    # -- public API --------------------------------------------------------
+    def global_block(self) -> Block:
+        return Block(self)
+
+    @property
+    def blocks(self):
+        return [self.global_block()]
+
+    def block(self, idx=0):
+        return self.global_block()
+
+    @property
+    def num_blocks(self):
+        return 1
+
+    def list_vars(self):
+        return list(self.vars.values())
+
+    def all_parameters(self):
+        return list(self._parameters)
+
+    def parameters(self):
+        return list(self._parameters)
+
+    def clone(self, for_test: bool = False) -> "Program":
+        """Shallow clone sharing captured tensors (params); for_test drops
+        optimizer specs (the reference prunes backward ops)."""
+        p = Program.__new__(Program)
+        p.__dict__ = {}
+        p.ops = list(self.ops)
+        p.vars = dict(self.vars)
+        p.random_seed = self.random_seed
+        p._var_counter = self._var_counter
+        p._version = self._version
+        p._captured = list(self._captured)
+        p._cap_index = dict(self._cap_index)
+        p._parameters = list(self._parameters)
+        p._opt_specs = [] if for_test else list(self._opt_specs)
+        p._grad_requests = dict(self._grad_requests)
+        p._feed_order = list(self._feed_order)
+        p._sym_scope = self._sym_scope
+        p._rng_feed = self._rng_feed
+        p._rng_counter = self._rng_counter
+        return p
+
+    def __repr__(self):
+        lines = [f"Program(ops={len(self.ops)}, vars={len(self.vars)}, "
+                 f"params={len(self._parameters)})"]
+        lines += [f"  {op!r}" for op in self.ops[:40]]
+        if len(self.ops) > 40:
+            lines.append(f"  ... (+{len(self.ops) - 40} ops)")
+        return "\n".join(lines)
+
+    to_string = __repr__
+
+
+# --------------------------------------------------------------------------
+# The apply_op hook: defer ops touching Variables into the Program
+# --------------------------------------------------------------------------
+
+def _make_aval(shape, dtype,
+               program: Optional["Program"] = None) -> jax.ShapeDtypeStruct:
+    """None/-1 dims become jax.export symbolic dims. Dims are named by
+    position within one per-program scope, so the batch dim of every feed
+    unifies (x:[d0,4] - y:[d0,1] broadcasts at eval_shape time); genuinely
+    unrelated dynamic dims at the same position should be fed concrete."""
+    np_dtype = to_dtype(dtype).np_dtype
+    dims = []
+    for i, d in enumerate(shape):
+        if d is None or (isinstance(d, int) and d < 0):
+            if program is None:
+                program = default_main_program()
+            if program._sym_scope is None:
+                program._sym_scope = jax.export.SymbolicScope()
+            dims.append(jax.export.symbolic_shape(
+                f"d{i}", scope=program._sym_scope)[0])
+        else:
+            dims.append(int(d))
+    return jax.ShapeDtypeStruct(tuple(dims), np_dtype)
+
+
+def target_program(lazy_vars: Sequence["Variable"]) -> "Program":
+    """Ops append to the active program_guard program if one is open
+    (Paddle semantics; also makes clone() shared-Variable graphs record
+    into the clone, not the original), else to the producing program."""
+    if _stack():
+        program = _stack()[-1][0]
+    else:
+        program = lazy_vars[0].program
+    for v in lazy_vars:
+        if v.program is not program and program.vars.get(v.name) is not v:
+            raise RuntimeError(
+                f"Variable '{v.name}' belongs to a different Program")
+    return program
+
+
+def record_op(fn: Callable, inputs, kwargs, name):
+    """Called from apply_op when any input is a Variable."""
+    lazy = [x for x in inputs if isinstance(x, Variable)]
+    program = target_program(lazy)
+
+    # AMP O1: the eager path casts in apply_op; for deferred ops the cast
+    # must replay inside the recorded fn (amp decision baked at build time)
+    from ..amp.auto_cast import amp_state, maybe_autocast_inputs
+    if amp_state() is not None:
+        inner = fn
+
+        def fn(*args, **kw):
+            return inner(*maybe_autocast_inputs(name, list(args)), **kw)
+
+    node = program.append_op_node(fn, tuple(inputs), dict(kwargs), name)
+
+    # InferMeta via jax.eval_shape on the SAME op implementation
+    traced_pos = [i for i, x in enumerate(inputs) if isinstance(x, Tensor)]
+    metas = []
+    for i in traced_pos:
+        x = inputs[i]
+        if isinstance(x, Variable):
+            metas.append(x.aval)
+        else:
+            program.capture(x)
+            metas.append(jax.ShapeDtypeStruct(x._data.shape, x._data.dtype))
+
+    def meta_fn(*t_avals):
+        full = list(inputs)
+        for i, a in zip(traced_pos, t_avals):
+            full[i] = a
+        return fn(*full, **kwargs)
+
+    out = jax.eval_shape(meta_fn, *metas)
+
+    stop = not (tensor_mod.grad_enabled() and any(
+        isinstance(inputs[i], Tensor) and not inputs[i].stop_gradient
+        for i in traced_pos))
+    multi = isinstance(out, (tuple, list))
+    outs = list(out) if multi else [out]
+    out_vars = []
+    for i, o in enumerate(outs):
+        v = Variable(jax.ShapeDtypeStruct(o.shape, o.dtype), program,
+                     producer=node, out_idx=i, stop_gradient=stop)
+        node.outputs.append(v)
+        out_vars.append(v)
+    return tuple(out_vars) if multi else out_vars[0]
+
+
+# register the hook into the eager dispatch funnel
+tensor_mod._lazy_cls = Variable
+tensor_mod._lazy_record = record_op
+
+
+# --------------------------------------------------------------------------
+# Graph-building user API
+# --------------------------------------------------------------------------
+
+def static_rng_key(program: Optional["Program"] = None) -> Variable:
+    """A per-op lazy PRNG key: fold_in(run_base_key, build_counter). The
+    Executor feeds a fresh base key every run (analog of the reference's
+    per-kernel Philox offsets, phi/core/generator.h:32)."""
+    if program is None:
+        program = default_main_program()
+    if program._rng_feed is None:
+        k = jax.random.key(0)
+        program._rng_feed = Variable(
+            jax.ShapeDtypeStruct(k.shape, k.dtype), program,
+            name="@rng_base_key@", is_feed=True)
+    program._rng_counter += 1
+    c = program._rng_counter
+    from ..framework.tensor import apply_op
+    return apply_op(lambda k: jax.random.fold_in(k, c),
+                    program._rng_feed, _op_name="rng_fold_in")
+
+
+def data(name: str, shape: Sequence[Optional[int]], dtype="float32",
+         lod_level: int = 0) -> Variable:
+    """paddle.static.data — a feed slot (python/paddle/static/input.py)."""
+    program = default_main_program()
+    v = Variable(_make_aval(shape, dtype, program), program, name=name,
+                 is_feed=True)
+    program._feed_order.append(name)
+    return v
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None) -> Tensor:
+    """Eager parameter registered with the current Program (the analog of
+    startup-program initialization: params are concrete from creation)."""
+    from ..nn import initializer as init_mod
+    from ..framework.tensor import Parameter
+    if default_initializer is None:
+        default_initializer = (init_mod.Constant(0.0) if is_bias
+                               else init_mod.XavierNormal())
+    arr = default_initializer(tuple(int(s) for s in shape),
+                              to_dtype(dtype).np_dtype)
+    p = Parameter(arr, name=name)
+    default_main_program().capture(p)
+    return p
+
+
+def create_global_var(shape, value, dtype="float32", persistable=False,
+                      name=None) -> Tensor:
+    arr = jnp.full(tuple(int(s) for s in shape), value,
+                   to_dtype(dtype).np_dtype)
+    t = Tensor(arr, name=name)
+    t.persistable = persistable
+    default_main_program().capture(t)
+    return t
+
+
+def append_optimize(optimizer, loss: Variable):
+    """Record optimizer.minimize(loss) into the Program; the Executor
+    computes grads inside its jitted replay and applies the (eager)
+    optimizer update after each run."""
+    if not isinstance(loss, Variable):
+        raise TypeError("append_optimize expects a static Variable loss")
+    loss.program._opt_specs.append((optimizer, loss))
+    loss.program._version += 1
+
+
+def append_backward(loss: Variable, parameter_list=None,
+                    no_grad_set=None) -> List[Tuple[Tensor, Variable]]:
+    """paddle.static.append_backward analog: creates fetchable grad
+    Variables for every trainable parameter captured by the program."""
+    program = loss.program
+    if parameter_list is None:
+        parameter_list = [p for p in program._parameters
+                          if not p.stop_gradient]
+    out = []
+    for p in parameter_list:
+        gv = Variable(
+            jax.ShapeDtypeStruct(p._data.shape, p._data.dtype), program,
+            name=f"{p.name}@GRAD")
+        gv.producer = None
+        program._grad_requests[id(gv)] = (loss, p)
+        program._version += 1
+        out.append((p, gv))
+    return out
+
+
+def gradients(targets, inputs, target_gradients=None,
+              no_grad_set=None) -> List[Variable]:
+    """paddle.static.gradients analog for params and feed Variables."""
+    if isinstance(targets, (list, tuple)):
+        if len(targets) != 1:
+            raise NotImplementedError("single target supported")
+        targets = targets[0]
+    if not isinstance(inputs, (list, tuple)):
+        inputs = [inputs]
+    program = targets.program
+    out = []
+    for x in inputs:
+        if isinstance(x, Variable) and not x.is_feed:
+            raise NotImplementedError(
+                "gradients() w.r.t. intermediate Variables is not "
+                "supported; fetch grads of feeds or parameters")
+        shape = (x.aval.shape if isinstance(x, Variable)
+                 else x._data.shape)
+        dt = (x.aval.dtype if isinstance(x, Variable) else x._data.dtype)
+        gv = Variable(jax.ShapeDtypeStruct(shape, dt), program,
+                      name=f"{x.name}@GRAD")
+        program._grad_requests[id(gv)] = (targets, x)
+        program._version += 1
+        out.append(gv)
+    return out

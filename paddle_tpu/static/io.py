@@ -1,0 +1,173 @@
+"""Static-graph inference model save/load.
+
+Reference: python/paddle/static/io.py save_inference_model /
+load_inference_model (.pdmodel/.pdiparams consumed by AnalysisPredictor,
+paddle/fluid/inference/api/analysis_predictor.h:105). TPU-native: the
+Program's replay function is exported as StableHLO via jax.export —
+symbolic feed dims survive export, so one artifact serves any batch size.
+File format matches jit.save (``.stablehlo.mlir`` + ``.pdiparams`` +
+``.pdmeta``) so ``inference.Predictor`` and ``jit.load`` consume it too.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..framework.io import load as fw_load
+from ..framework.io import save as fw_save
+from ..framework.tensor import Tensor
+from .graph import Program, Variable
+
+__all__ = ["save_inference_model", "load_inference_model",
+           "serialize_program", "deserialize_program", "normalize_program"]
+
+
+def _build_infer_fn(program: Program, feed_vars: List[Variable],
+                    fetch_vars: List[Variable]):
+    # prune to the subgraph reachable from fetch_vars (the reference's
+    # prune pass in static/io.py save_inference_model)
+    needed = set()
+    stack = [f.producer for f in fetch_vars if f.producer is not None]
+    while stack:
+        node = stack.pop()
+        if node.idx in needed:
+            continue
+        needed.add(node.idx)
+        for x in node.inputs:
+            if isinstance(x, Variable) and x.producer is not None:
+                stack.append(x.producer)
+    live_ops = [op for op in program.ops if op.idx in needed]
+    # only captured tensors referenced by live ops get exported
+    live_caps = sorted({program._cap_index[id(x)]
+                        for op in live_ops for x in op.inputs
+                        if isinstance(x, Tensor)
+                        and not isinstance(x, Variable)})
+
+    def infer_fn(params_, buffers_, *feeds):
+        env: Dict[int, Any] = {}
+        for v, val in zip(feed_vars, feeds):
+            env[id(v)] = val
+        if program._rng_feed is not None:
+            # inference artifacts get a fixed key (deterministic serving)
+            env[id(program._rng_feed)] = jax.random.key(0)
+
+        def resolve(x):
+            if isinstance(x, Variable):
+                return env[id(x)]
+            if isinstance(x, Tensor):
+                return params_[f"cap_{program._cap_index[id(x)]}"]
+            return x
+
+        for node in live_ops:
+            args = [resolve(x) for x in node.inputs]
+            out = node.fn(*args, **node.kwargs)
+            outs = list(out) if isinstance(out, (tuple, list)) else [out]
+            for v, o in zip(node.outputs, outs):
+                env[id(v)] = o
+        return tuple(env[id(f)] for f in fetch_vars)
+
+    return infer_fn, live_caps
+
+
+def normalize_program(program, feed_vars, fetch_vars):
+    return program
+
+
+def save_inference_model(path_prefix: str, feed_vars, fetch_vars, executor,
+                         program: Optional[Program] = None, **kwargs):
+    """Export the subgraph feed_vars -> fetch_vars as StableHLO."""
+    if isinstance(feed_vars, Variable):
+        feed_vars = [feed_vars]
+    if isinstance(fetch_vars, Variable):
+        fetch_vars = [fetch_vars]
+    if program is None:
+        program = feed_vars[0].program
+
+    infer_fn, live_caps = _build_infer_fn(program, feed_vars, fetch_vars)
+    params = {f"cap_{i}": program._captured[i]._data for i in live_caps}
+    p_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+               for k, v in params.items()}
+    feed_avals = [v.aval for v in feed_vars]  # symbolic dims preserved
+    exported = jax.export.export(jax.jit(infer_fn))(
+        p_avals, {}, *feed_avals)
+
+    d = os.path.dirname(path_prefix)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path_prefix + ".stablehlo.mlir", "wb") as f:
+        f.write(exported.serialize())
+    fw_save({"params": {k: Tensor(v) for k, v in params.items()},
+             "buffers": {}}, path_prefix + ".pdiparams")
+    with open(path_prefix + ".pdmeta", "w") as f:
+        json.dump({
+            "input_specs": [{"shape": v.shape, "dtype": v.dtype.name,
+                             "name": v.name} for v in feed_vars],
+            "feed_names": [v.name for v in feed_vars],
+            "fetch_names": [v.name for v in fetch_vars],
+        }, f)
+
+
+class _LoadedProgram:
+    """Runnable handle returned by load_inference_model; Executor.run
+    dispatches to it (the reference returns a deserialized ProgramDesc)."""
+
+    def __init__(self, exported, params, feed_names, fetch_names):
+        self._exported = exported
+        self._params = params
+        self.feed_names = feed_names
+        self.fetch_names = fetch_names
+
+    def _run(self, feed: Dict[str, Any], fetch_list, return_numpy=True):
+        feeds = [np.asarray(feed[n]) for n in self.feed_names]
+        outs = self._exported.call(self._params, {}, *feeds)
+        outs = list(outs) if isinstance(outs, (tuple, list)) else [outs]
+        if fetch_list:
+            sel = []
+            for f in fetch_list:
+                name = f if isinstance(f, str) else getattr(f, "name", None)
+                if name in self.fetch_names:
+                    sel.append(outs[self.fetch_names.index(name)])
+            if sel:
+                outs = sel
+        if return_numpy:
+            return [np.asarray(jax.device_get(o)) for o in outs]
+        return [Tensor(o) for o in outs]
+
+
+def load_inference_model(path_prefix: str, executor, **kwargs):
+    """Returns [program, feed_target_names, fetch_targets] like the
+    reference; ``program`` is a _LoadedProgram usable with Executor.run."""
+    with open(path_prefix + ".stablehlo.mlir", "rb") as f:
+        exported = jax.export.deserialize(f.read())
+    state = fw_load(path_prefix + ".pdiparams")
+    params = {k: v._data for k, v in state["params"].items()}
+    with open(path_prefix + ".pdmeta") as f:
+        meta = json.load(f)
+    prog = _LoadedProgram(exported, params,
+                          meta.get("feed_names", []),
+                          meta.get("fetch_names", []))
+    return [prog, prog.feed_names, prog.fetch_names]
+
+
+def serialize_program(feed_vars, fetch_vars, program=None) -> bytes:
+    if isinstance(feed_vars, Variable):
+        feed_vars = [feed_vars]
+    if isinstance(fetch_vars, Variable):
+        fetch_vars = [fetch_vars]
+    if program is None:
+        program = feed_vars[0].program
+    infer_fn, live_caps = _build_infer_fn(program, feed_vars, fetch_vars)
+    params = {f"cap_{i}": program._captured[i]._data for i in live_caps}
+    p_avals = {k: jax.ShapeDtypeStruct(v.shape, v.dtype)
+               for k, v in params.items()}
+    exported = jax.export.export(jax.jit(infer_fn))(
+        p_avals, {}, *[v.aval for v in feed_vars])
+    return exported.serialize()
+
+
+def deserialize_program(blob: bytes):
+    return jax.export.deserialize(blob)

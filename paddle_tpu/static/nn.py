@@ -1,0 +1,141 @@
+"""static.nn — layer-building functions for static graphs.
+
+Reference: python/paddle/static/nn/common.py (fc, conv2d, batch_norm,
+embedding, ...). Each call creates eager parameters (registered with the
+current Program) and records the compute through the nn.functional ops —
+the same kernels as dynamic mode, only deferred.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..nn import functional as F
+from ..nn import initializer as init_mod
+from .graph import create_parameter, default_main_program
+
+__all__ = ["fc", "conv2d", "batch_norm", "embedding", "layer_norm",
+           "dropout", "prelu", "sequence_softmax"]
+
+
+def _act(x, activation):
+    if activation is None:
+        return x
+    fn = getattr(F, activation, None)
+    if fn is None:
+        raise ValueError(f"unknown activation '{activation}'")
+    return fn(x)
+
+
+def fc(x, size: int, num_flatten_dims: int = 1, weight_attr=None,
+       bias_attr=None, activation=None, name=None):
+    """static.nn.fc (static/nn/common.py:31): flattens dims
+    [num_flatten_dims:] into the feature dim; output shape =
+    x.shape[:num_flatten_dims] + [size]."""
+    if num_flatten_dims == -1:
+        num_flatten_dims = len(x.shape) - 1
+    tail = x.shape[num_flatten_dims:]
+    if any(d < 0 for d in tail):
+        raise ValueError("fc flattened feature dims must be static")
+    in_dim = int(np.prod(tail)) if tail else 1
+    if len(tail) != 1:
+        x = x.reshape(list(x.shape[:num_flatten_dims]) + [in_dim])
+    w = create_parameter([in_dim, size], dtype=x.dtype.name,
+                         default_initializer=init_mod.XavierNormal(),
+                         name=None if name is None else f"{name}.w_0")
+    out = F.linear(x, w)
+    if bias_attr is not False:
+        b = create_parameter([size], dtype=x.dtype.name, is_bias=True,
+                             name=None if name is None else f"{name}.b_0")
+        out = out + b
+    return _act(out, activation)
+
+
+def conv2d(input, num_filters: int, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCHW"):
+    if isinstance(filter_size, int):
+        filter_size = (filter_size, filter_size)
+    cin = input.shape[1] if data_format == "NCHW" else input.shape[-1]
+    if cin < 0:
+        raise ValueError("conv2d input channels must be static")
+    w = create_parameter(
+        [num_filters, cin // groups, *filter_size], dtype=input.dtype.name,
+        default_initializer=init_mod.KaimingUniform())
+    b = None
+    if bias_attr is not False:
+        b = create_parameter([num_filters], dtype=input.dtype.name,
+                             is_bias=True)
+    out = F.conv2d(input, w, bias=b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups,
+                   data_format=data_format)
+    return _act(out, act)
+
+
+def batch_norm(input, act=None, is_test: bool = False, momentum=0.9,
+               epsilon=1e-5, param_attr=None, bias_attr=None,
+               data_layout="NCHW", name=None, moving_mean_name=None,
+               moving_variance_name=None):
+    c = input.shape[1] if data_layout == "NCHW" else input.shape[-1]
+    if c < 0:
+        raise ValueError("batch_norm channel dim must be static")
+    dt = input.dtype.name
+    scale = create_parameter([c], dtype=dt,
+                             default_initializer=init_mod.Constant(1.0))
+    bias = create_parameter([c], dtype=dt, is_bias=True)
+    mean = create_parameter([c], dtype=dt,
+                            default_initializer=init_mod.Constant(0.0))
+    var = create_parameter([c], dtype=dt,
+                           default_initializer=init_mod.Constant(1.0))
+    mean.stop_gradient = True
+    var.stop_gradient = True
+    # static graphs run inference-style normalization against the captured
+    # running stats (training-mode stat updates belong to dynamic mode)
+    out = F.batch_norm(input, mean, var, weight=scale, bias=bias,
+                       training=False, momentum=momentum,
+                       epsilon=epsilon, data_format=data_layout)
+    return _act(out, act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    shape = input.shape[begin_norm_axis:]
+    if any(d < 0 for d in shape):
+        raise ValueError("layer_norm normalized dims must be static")
+    dt = input.dtype.name
+    n = int(np.prod(shape))
+    g = create_parameter([n], dtype=dt,
+                         default_initializer=init_mod.Constant(1.0)) \
+        if scale else None
+    b = create_parameter([n], dtype=dt, is_bias=True) if shift else None
+    flat = input.reshape(input.shape[:begin_norm_axis] + [n]) \
+        if len(shape) > 1 else input
+    out = F.layer_norm(flat, normalized_shape=[n], weight=g, bias=b,
+                       epsilon=epsilon)
+    if len(shape) > 1:
+        out = out.reshape(input.shape[:begin_norm_axis] + list(shape))
+    return _act(out, act)
+
+
+def embedding(input, size, is_sparse: bool = False, padding_idx=None,
+              param_attr=None, dtype="float32"):
+    w = create_parameter(list(size), dtype=dtype,
+                         default_initializer=init_mod.XavierNormal())
+    return F.embedding(input, w, padding_idx=padding_idx)
+
+
+def dropout(x, dropout_prob=0.5, is_test=False, seed=None, name=None):
+    return F.dropout(x, p=dropout_prob, training=not is_test)
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    n = 1 if mode == "all" else x.shape[1]
+    alpha = create_parameter([n], dtype=x.dtype.name,
+                             default_initializer=init_mod.Constant(0.25))
+    return F.prelu(x, alpha)
+
+
+def sequence_softmax(input, axis=-1):
+    return F.softmax(input, axis=axis)

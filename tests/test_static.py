@@ -1,0 +1,309 @@
+"""paddle.static graph-mode tests.
+
+Models the reference's static-graph usage patterns
+(test/legacy_test/test_program.py, test_executor_* and the static train
+loops in test/book/): build a Program under program_guard, run it with
+Executor feed/fetch, minimize with an optimizer, save/load inference model.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+@pytest.fixture(autouse=True)
+def _static_mode():
+    paddle.enable_static()
+    yield
+    paddle.disable_static()
+
+
+def _toy_data(n=32, d=4, seed=0):
+    rng = np.random.RandomState(seed)
+    xs = rng.randn(n, d).astype("float32")
+    w = rng.randn(d, 1).astype("float32")
+    ys = xs @ w + 0.1 * rng.randn(n, 1).astype("float32")
+    return xs, ys
+
+
+def test_data_and_fetch_forward():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        y = x * 2.0 + 1.0
+    exe = static.Executor()
+    xs = np.arange(8, dtype="float32").reshape(2, 4)
+    (out,) = exe.run(main, feed={"x": xs}, fetch_list=[y])
+    np.testing.assert_allclose(out, xs * 2 + 1, rtol=1e-6)
+    # different batch size recompiles transparently
+    xs3 = np.ones((3, 4), "float32")
+    (out3,) = exe.run(main, feed={"x": xs3}, fetch_list=[y])
+    assert out3.shape == (3, 4)
+
+
+def test_variable_metadata():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        assert x.shape == [-1, 4]
+        h = static.nn.fc(x, 8)
+        assert h.shape == [-1, 8]
+        assert h.dtype.name == "float32"
+        with pytest.raises(RuntimeError):
+            h.numpy()
+    assert len(main.ops) >= 1
+    assert "fc" in repr(main) or "linear" in repr(main)
+
+
+def test_static_nn_layer_forward():
+    """paddle.nn Layers record into the program like static.nn fns."""
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        lin = paddle.nn.Linear(4, 3)
+        out = paddle.nn.functional.relu(lin(x))
+    exe = static.Executor()
+    xs = np.random.RandomState(0).randn(5, 4).astype("float32")
+    (o,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    w = lin.weight.numpy()
+    b = lin.bias.numpy()
+    np.testing.assert_allclose(o, np.maximum(xs @ w + b, 0), rtol=1e-5)
+
+
+def test_minimize_training_loss_decreases():
+    main, startup = static.Program(), static.Program()
+    with static.program_guard(main, startup):
+        x = static.data("x", [None, 4], "float32")
+        y = static.data("y", [None, 1], "float32")
+        h = static.nn.fc(x, 16, activation="relu")
+        pred = static.nn.fc(h, 1)
+        loss = ((pred - y) ** 2).mean()
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    exe = static.Executor()
+    exe.run(startup)
+    xs, ys = _toy_data()
+    losses = []
+    for _ in range(40):
+        (lv,) = exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+        losses.append(float(lv))
+    assert losses[-1] < 0.25 * losses[0], losses[:3] + losses[-3:]
+
+
+def test_adam_minimize_and_param_fetch():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        y = static.data("y", [None, 1], "float32")
+        pred = static.nn.fc(x, 1, bias_attr=False)
+        loss = ((pred - y) ** 2).mean()
+        opt = paddle.optimizer.Adam(learning_rate=0.05)
+        opt.minimize(loss)
+    w = main.all_parameters()[0]
+    w0 = w.numpy().copy()
+    exe = static.Executor()
+    xs, ys = _toy_data()
+    for _ in range(5):
+        exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    assert not np.allclose(w.numpy(), w0)
+
+
+def test_append_backward_grad_fetch():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 3], "float32")
+        pred = static.nn.fc(x, 1, bias_attr=False)
+        loss = (pred ** 2).mean()
+        p_g = static.append_backward(loss)
+    (param, gvar), = [(p, g) for p, g in p_g]
+    exe = static.Executor()
+    xs = np.ones((4, 3), "float32")
+    lv, gv = exe.run(main, feed={"x": xs}, fetch_list=[loss, gvar])
+    # d/dw mean((xw)^2) = 2/N * x^T (x w)
+    w = param.numpy()
+    expect = 2.0 * xs.T @ (xs @ w) / 4
+    np.testing.assert_allclose(gv, expect, rtol=1e-5)
+
+
+def test_gradients_wrt_feed():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 2], "float32")
+        ysum = (x ** 3).sum()
+        (gx,) = static.gradients([ysum], [x])
+    exe = static.Executor()
+    xs = np.array([[1.0, 2.0], [3.0, 4.0]], "float32")
+    (g,) = exe.run(main, feed={"x": xs}, fetch_list=[gx])
+    np.testing.assert_allclose(g, 3 * xs ** 2, rtol=1e-5)
+
+
+def test_program_clone_for_test():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        y = static.data("y", [None, 1], "float32")
+        pred = static.nn.fc(x, 1)
+        loss = ((pred - y) ** 2).mean()
+        test_prog = main.clone(for_test=True)
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        opt.minimize(loss)
+    assert not test_prog._opt_specs and main._opt_specs
+    exe = static.Executor()
+    xs, ys = _toy_data(8)
+    (out,) = exe.run(test_prog, feed={"x": xs}, fetch_list=[pred])
+    assert out.shape == (8, 1)
+
+
+def test_save_load_inference_model(tmp_path):
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        h = static.nn.fc(x, 8, activation="relu")
+        out = static.nn.fc(h, 2)
+    exe = static.Executor()
+    xs = np.random.RandomState(1).randn(6, 4).astype("float32")
+    (ref,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+
+    prefix = str(tmp_path / "infer_model")
+    static.save_inference_model(prefix, [x], [out], exe)
+    prog, feed_names, fetch_names = static.load_inference_model(prefix, exe)
+    assert feed_names == ["x"]
+    (got,) = exe.run(prog, feed={"x": xs}, fetch_list=fetch_names)
+    np.testing.assert_allclose(got, ref, rtol=1e-5)
+    # dynamic batch dim survived export
+    (got2,) = exe.run(prog, feed={"x": xs[:2]}, fetch_list=fetch_names)
+    np.testing.assert_allclose(got2, ref[:2], rtol=1e-5)
+
+
+def test_scope_and_misc():
+    sc = static.Scope()
+    with static.scope_guard(sc):
+        assert static.global_scope() is sc
+        v = sc.var("w")
+        v.set(np.ones(3))
+        assert static.global_scope().find_var("w") is v
+    assert static.global_scope() is not sc
+    assert static.default_startup_program() is not None
+    with static.name_scope("block1"):
+        pass
+
+
+def test_static_dropout_fresh_mask_per_run():
+    """RNG ops must draw fresh randomness every Executor.run (the base key
+    is an implicit per-run feed, not baked at graph-build time)."""
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 64], "float32")
+        out = paddle.nn.functional.dropout(x, p=0.5, training=True)
+    exe = static.Executor()
+    xs = np.ones((4, 64), "float32")
+    (a,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    (b,) = exe.run(main, feed={"x": xs}, fetch_list=[out])
+    assert not np.array_equal(a, b), "dropout mask identical across runs"
+    # still a valid dropout: zeros and upscaled survivors only
+    assert set(np.unique(a)).issubset({0.0, 2.0})
+
+
+def test_fc_num_flatten_dims():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 3, 4], "float32")
+        out = static.nn.fc(x, 8, num_flatten_dims=1)
+        assert out.shape == [-1, 8]
+    exe = static.Executor()
+    (o,) = exe.run(main, feed={"x": np.ones((2, 3, 4), "float32")},
+                   fetch_list=[out])
+    assert o.shape == (2, 8)
+
+
+def test_fetch_feed_var_no_ops():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+    exe = static.Executor()
+    xs = np.ones((2, 4), "float32")
+    (o,) = exe.run(main, feed={"x": xs}, fetch_list=[x])
+    np.testing.assert_array_equal(o, xs)
+
+
+def test_mode_queries():
+    assert not paddle.in_dynamic_mode()
+    import paddle_tpu.framework as fw
+    assert not fw.in_dynamic_mode()
+    paddle.disable_static()
+    assert paddle.in_dynamic_mode() and fw.in_dynamic_mode()
+    paddle.enable_static()
+
+
+def test_clone_guard_records_into_clone():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 2], "float32")
+        y = x + 1.0
+    n_main = len(main.ops)
+    test_prog = main.clone(for_test=True)
+    with static.program_guard(test_prog):
+        z = y * 2.0
+    assert len(main.ops) == n_main, "op leaked into original program"
+    assert len(test_prog.ops) == n_main + 1
+    exe = static.Executor()
+    xs = np.ones((2, 2), "float32")
+    (o,) = exe.run(test_prog, feed={"x": xs}, fetch_list=[z])
+    np.testing.assert_allclose(o, (xs + 1) * 2)
+
+
+def test_minimize_respects_parameters_arg():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        y = static.data("y", [None, 1], "float32")
+        h = static.nn.fc(x, 4)
+        pred = static.nn.fc(h, 1, bias_attr=False)
+        loss = ((pred - y) ** 2).mean()
+        frozen = main.all_parameters()[:2]  # first fc's w and b
+        last_w = main.all_parameters()[2]
+        opt = paddle.optimizer.SGD(learning_rate=0.5)
+        opt.minimize(loss, parameters=[last_w])
+    f0 = [p.numpy().copy() for p in frozen]
+    w0 = last_w.numpy().copy()
+    exe = static.Executor()
+    xs, ys = _toy_data()
+    exe.run(main, feed={"x": xs, "y": ys}, fetch_list=[loss])
+    for p, v in zip(frozen, f0):
+        np.testing.assert_array_equal(p.numpy(), v)
+    assert not np.allclose(last_w.numpy(), w0)
+
+
+def test_eager_rng_ops_inside_static_mode():
+    """Concrete tensors keep eager semantics under enable_static()."""
+    t = paddle.ones([4, 8])
+    out = paddle.nn.functional.dropout(t, p=0.5, training=True)
+    assert out._data is not None
+    out2 = paddle.nn.functional.dropout(t, p=0.5, training=True)
+    assert not np.array_equal(out.numpy(), out2.numpy())
+
+
+def test_feed_unknown_name_raises():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        y = x * 2.0
+    exe = static.Executor()
+    with pytest.raises(KeyError):
+        exe.run(main, feed={"X_typo": np.ones((2, 4), "f4")},
+                fetch_list=[y])
+
+
+def test_feed_intermediate_override():
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 2], "float32")
+        mid = x * 10.0
+        out = mid + 1.0
+    exe = static.Executor()
+    xs = np.ones((2, 2), "float32")
+    override = np.full((2, 2), 5.0, "float32")
+    (o,) = exe.run(main, feed={"x": xs, mid.name: override},
+                   fetch_list=[out])
+    np.testing.assert_allclose(o, override + 1.0)
