@@ -179,17 +179,22 @@ void Server::handle_conn(int fd) {
       break;
     }
   }
+  // unregister BEFORE ::close so the stopper can never shutdown() a
+  // recycled fd number belonging to an unrelated descriptor
+  {
+    std::lock_guard<std::mutex> lk(conn_mu);
+    for (auto it = conn_fds.begin(); it != conn_fds.end(); ++it) {
+      if (*it == fd) {
+        conn_fds.erase(it);
+        break;
+      }
+    }
+  }
   ::close(fd);
   // last touch of *this: decrement + notify UNDER the lock, so once the
   // stopper observes live_conns == 0 (holding the same lock) no handler
   // thread can still dereference the Server
   std::lock_guard<std::mutex> lk(conn_mu);
-  for (auto it = conn_fds.begin(); it != conn_fds.end(); ++it) {
-    if (*it == fd) {
-      conn_fds.erase(it);
-      break;
-    }
-  }
   --live_conns;
   conn_cv.notify_all();
 }
@@ -262,7 +267,10 @@ void pts_server_stop(void* h) {
   // final touch of *s), so deletion below cannot race them
   {
     std::unique_lock<std::mutex> lk(s->conn_mu);
-    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RDWR);
+    // SHUT_RD only: unblocks the handler's recv loop but lets an
+    // in-flight response (e.g. a WAIT woken by the final barrier key)
+    // drain to the peer instead of flaking its last read
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RD);
     s->conn_cv.wait(lk, [&] { return s->live_conns == 0; });
   }
   delete s;

@@ -66,6 +66,11 @@ class _RpcAgent:
         self.workers: Dict[str, WorkerInfo] = {}
         for r in range(world_size):
             info = pickle.loads(store.get(f"rpc/{r}"))
+            if info.name in self.workers:
+                raise ValueError(
+                    f"duplicate rpc worker name {info.name!r} (ranks "
+                    f"{self.workers[info.name].rank} and {info.rank}); "
+                    f"names must be unique across ranks")
             self.workers[info.name] = info
 
     # ---- server side -----------------------------------------------------
@@ -85,7 +90,17 @@ class _RpcAgent:
                 result = (True, fn(*args, **kwargs))
             except Exception as e:  # ship the exception back
                 result = (False, e)
-            _send_msg(conn, pickle.dumps(result))
+            try:
+                blob = pickle.dumps(result)
+            except Exception as e:
+                # unpicklable result/exception: ship a picklable error
+                # instead of silently closing (caller would only see
+                # ConnectionError with no cause)
+                blob = pickle.dumps(
+                    (False, RuntimeError(
+                        f"rpc result not picklable: {type(e).__name__}: "
+                        f"{e}")))
+            _send_msg(conn, blob)
         except Exception:
             pass
         finally:

@@ -128,10 +128,17 @@ class TCPStore:
             raise RuntimeError(f"TCPStore: cannot connect {host}:{port}")
 
     # -- KV ----------------------------------------------------------------
+    def _h(self):
+        """Live client handle; raises instead of passing NULL into C
+        (use-after-close would otherwise segfault the interpreter)."""
+        if self._client is None:
+            raise RuntimeError("TCPStore is closed")
+        return self._client
+
     def set(self, key: str, value) -> None:
         data = value if isinstance(value, bytes) else str(value).encode()
         with self._mu:
-            rc = self._lib.pts_set(self._client, key.encode(), data,
+            rc = self._lib.pts_set(self._h(), key.encode(), data,
                                    len(data))
         if rc != 0:
             raise RuntimeError(f"TCPStore.set({key!r}) failed")
@@ -141,7 +148,7 @@ class TCPStore:
         out_len = ctypes.c_uint64()
         tmo = self.timeout_ms if timeout is None else int(timeout * 1000)
         with self._mu:
-            rc = self._lib.pts_get(self._client, key.encode(), tmo,
+            rc = self._lib.pts_get(self._h(), key.encode(), tmo,
                                    ctypes.byref(out), ctypes.byref(out_len))
         if rc == 1:
             raise TimeoutError(
@@ -158,7 +165,7 @@ class TCPStore:
     def add(self, key: str, delta: int = 1) -> int:
         out = ctypes.c_int64()
         with self._mu:
-            rc = self._lib.pts_add(self._client, key.encode(), delta,
+            rc = self._lib.pts_add(self._h(), key.encode(), delta,
                                    ctypes.byref(out))
         if rc == 1:
             raise ValueError(
@@ -170,18 +177,18 @@ class TCPStore:
     def wait(self, key: str, timeout: Optional[float] = None) -> None:
         tmo = self.timeout_ms if timeout is None else int(timeout * 1000)
         with self._mu:
-            rc = self._lib.pts_wait(self._client, key.encode(), tmo)
+            rc = self._lib.pts_wait(self._h(), key.encode(), tmo)
         if rc != 0:
             raise TimeoutError(f"TCPStore.wait({key!r}): not set within "
                                f"{tmo}ms")
 
     def delete_key(self, key: str) -> None:
         with self._mu:
-            self._lib.pts_delete(self._client, key.encode())
+            self._lib.pts_delete(self._h(), key.encode())
 
     def num_keys(self) -> int:
         with self._mu:
-            return int(self._lib.pts_num_keys(self._client))
+            return int(self._lib.pts_num_keys(self._h()))
 
     # -- barrier -----------------------------------------------------------
     def barrier(self, tag: str = "", timeout: Optional[float] = None):
