@@ -29,21 +29,24 @@ def main():
         batch, seq, steps = 4, 128, 3
 
     mesh = build_mesh(n_devices=1, pipe=1, model=1, fsdp=1, sep=1)
-    trainer = GPTSpmdTrainer(cfg, mesh, microbatches=1)
+    # single-chip 124M: activations fit, so remat would be pure FLOP waste
+    trainer = GPTSpmdTrainer(cfg, mesh, microbatches=1, remat=not on_tpu)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
     labels = np.roll(ids, -1, axis=1)
 
-    # warmup (compile)
+    # warmup (compile). NOTE: the barrier is a device_get of the scalar
+    # loss — block_until_ready returns early on tunneled TPU backends,
+    # which inflates throughput by only timing async dispatch.
     loss = trainer.train_step(ids, labels)
-    jax.block_until_ready(loss)
+    float(jax.device_get(loss))
     loss = trainer.train_step(ids, labels)
-    jax.block_until_ready(loss)
+    float(jax.device_get(loss))
 
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = trainer.train_step(ids, labels)
-    jax.block_until_ready(loss)
+    float(jax.device_get(loss))  # drains the whole dispatched pipeline
     dt = time.perf_counter() - t0
 
     tokens_per_sec = batch * seq * steps / dt

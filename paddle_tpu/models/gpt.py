@@ -207,9 +207,18 @@ class GPTSpmdTrainer:
                  microbatches: Optional[int] = None,
                  learning_rate: float = 3e-4, weight_decay: float = 0.1,
                  beta1: float = 0.9, beta2: float = 0.95,
-                 grad_clip: float = 1.0, seed: int = 0):
+                 grad_clip: float = 1.0, seed: int = 0,
+                 use_flash: Optional[bool] = None,
+                 remat: bool = True):
         self.cfg = cfg
         self.mesh = mesh
+        self.remat = remat  # per-block activation checkpointing
+        # Pallas flash attention on real TPU; XLA einsum attention
+        # elsewhere (interpret-mode pallas is orders slower on CPU, and
+        # the Mosaic kernel does not lower on GPU backends)
+        if use_flash is None:
+            use_flash = jax.default_backend() in ("tpu", "axon")
+        self.use_flash = use_flash
         self.S = mesh.shape["pipe"]
         if cfg.num_layers % self.S:
             raise ValueError("num_layers must divide pp degree")
@@ -291,17 +300,7 @@ class GPTSpmdTrainer:
         qkv = qkv + bp["bqkv"].astype(x.dtype)
         qkv = qkv.reshape(mb, T, 3, H, dh)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        # SP: q stays seq-sharded; k/v gathered over 'sep'
-        q = act(q, _spec(self.mesh, "data", "sep", "model", None))
-        k = act(k, _spec(self.mesh, "data", None, "model", None))
-        v = act(v, _spec(self.mesh, "data", None, "model", None))
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
-                            preferred_element_type=jnp.float32)
-        logits = logits / math.sqrt(dh)
-        causal = jnp.tril(jnp.ones((T, T), bool))
-        logits = jnp.where(causal, logits, -1e30)
-        probs = jax.nn.softmax(logits, axis=-1).astype(x.dtype)
-        attn = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        attn = self._attention(q, k, v, act)
         attn = attn.reshape(mb, T, H * dh)
         proj = jnp.einsum("btf,fd->btd", attn,
                           bp["wproj"].astype(x.dtype),
@@ -318,16 +317,48 @@ class GPTSpmdTrainer:
         x = x + o + bp["bout"].astype(x.dtype)
         return act(x, _spec(self.mesh, "data", "sep", None))
 
-    def _stage_fn(self, stage_params, x):
-        """One pipeline stage = Lps blocks, scanned with remat."""
-        def body(x, bp):
-            return self._block(x, bp), None
+    def _attention(self, q, k, v, act):
+        """Causal self-attention on [mb, T, H, dh]; Pallas flash kernel on
+        TPU (batch over 'data', heads over 'model' via shard_map), XLA
+        einsum with Megatron-SP (q seq-sharded, k/v gathered) otherwise."""
+        mb, T, H, dh = q.shape
+        shape = self.mesh.shape
+        # pipe must be 1: the Mosaic lowering requires manual_axes to
+        # cover EVERY mesh axis, and nested shard_map manual-axes do not
+        # union with the pipeline's, so flash attention cannot run inside
+        # the pipe shard_map (pipe>1 configs use the XLA einsum path)
+        flash_ok = (self.use_flash and shape["sep"] == 1
+                    and shape["pipe"] == 1
+                    and T % 128 == 0 and dh in (64, 128, 256)
+                    and H % shape["model"] == 0
+                    and mb % shape["data"] == 0)
+        if flash_ok:
+            from ..ops.pallas_ops import flash_attention_fwd
+            spec = P("data", None, "model", None)
+            f = jax.shard_map(
+                partial(flash_attention_fwd, causal=True),
+                in_specs=(spec, spec, spec),
+                out_specs=spec,
+                axis_names=set(self.mesh.axis_names),  # fully manual
+                check_vma=False)
+            return f(q, k, v)
+        # SP: q stays seq-sharded; k/v gathered over 'sep'
+        q = act(q, _spec(self.mesh, "data", "sep", "model", None))
+        k = act(k, _spec(self.mesh, "data", None, "model", None))
+        v = act(v, _spec(self.mesh, "data", None, "model", None))
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                            preferred_element_type=jnp.float32)
+        logits = logits / math.sqrt(dh)
+        causal = jnp.tril(jnp.ones((T, T), bool))
+        logits = jnp.where(causal, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
-        leaves_lps = jax.tree.map(lambda a: a, stage_params)
-        x, _ = jax.lax.scan(
-            lambda carry, bp: (jax.checkpoint(self._block)(carry, bp),
-                               None),
-            x, leaves_lps)
+    def _stage_fn(self, stage_params, x):
+        """One pipeline stage = Lps blocks, scanned (remat optional)."""
+        blk = jax.checkpoint(self._block) if self.remat else self._block
+        x, _ = jax.lax.scan(lambda carry, bp: (blk(carry, bp), None),
+                            x, stage_params)
         return x
 
     def _forward_loss(self, params, input_ids, labels):
@@ -340,13 +371,29 @@ class GPTSpmdTrainer:
         x = jax.lax.with_sharding_constraint(
             x, _spec(self.mesh, "data", "sep", None))
 
-        M = self.M
-        mb = B // M
-        x_micro = x.reshape(M, mb, T, cfg.hidden_size)
-        from ..distributed.pipeline import pipeline_forward
-        out = pipeline_forward(self._stage_fn, params["blocks"], x_micro,
-                               self.mesh, axis="pipe", remat=False)
-        x = out.reshape(B, T, cfg.hidden_size)
+        if self.S == 1:
+            # no pipeline: run the (single) stage outside the pipe
+            # shard_map (lets Pallas flash run); microbatches still scan
+            # so per-step working shapes match the pipelined path
+            stage = jax.tree.map(lambda a: a[0], params["blocks"])
+            if self.M > 1:
+                if B % self.M:
+                    raise ValueError(
+                        f"batch {B} not divisible by microbatches {self.M}")
+                xm = x.reshape(self.M, B // self.M, T, cfg.hidden_size)
+                x = jax.lax.map(partial(self._stage_fn, stage), xm)
+                x = x.reshape(B, T, cfg.hidden_size)
+            else:
+                x = self._stage_fn(stage, x)
+        else:
+            M = self.M
+            mb = B // M
+            x_micro = x.reshape(M, mb, T, cfg.hidden_size)
+            from ..distributed.pipeline import pipeline_forward
+            out = pipeline_forward(self._stage_fn, params["blocks"],
+                                   x_micro, self.mesh, axis="pipe",
+                                   remat=False)
+            x = out.reshape(B, T, cfg.hidden_size)
         x = _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
         head = params["wte"].T if cfg.tie_embeddings else params["head"]
         logits = jnp.einsum("btd,dv->btv", x, head.astype(dtype),

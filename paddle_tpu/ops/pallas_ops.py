@@ -79,7 +79,9 @@ def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, seq_len,
 
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = (m + jnp.log(l_safe)).astype(jnp.float32)
+    # lse carries a trailing singleton lane dim: TPU block mappings need
+    # the last two block dims (8,128)-divisible OR equal to the array dims
+    lse_ref[0] = (m + jnp.log(l_safe)).astype(jnp.float32)[:, None]
 
 
 def _fa_forward(q, k, v, causal, scale, bq, bk):
@@ -97,11 +99,11 @@ def _fa_forward(q, k, v, causal, scale, bq, bk):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, S, D), q.dtype),
-            jax.ShapeDtypeStruct((BH, S), jnp.float32),
+            jax.ShapeDtypeStruct((BH, S, 1), jnp.float32),
         ],
         interpret=_interpret(),
     )(q, k, v)
@@ -128,8 +130,8 @@ def _fa_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
         do = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * bq, bq)]
-        delta = delta_ref[0, pl.ds(i * bq, bq)]
+        lse = lse_ref[0, pl.ds(i * bq, bq), :][:, 0]
+        delta = delta_ref[0, pl.ds(i * bq, bq), :][:, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -160,8 +162,8 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32)
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0][:, 0]
+    delta = delta_ref[0][:, 0]
     d = q.shape[-1]
     nk = seq_len // bk
     if causal:
@@ -193,7 +195,7 @@ def _fa_backward(res, g, causal, scale, bq, bk):
     q, k, v, out, lse = res
     BH, S, D = q.shape
     delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32),
-                    axis=-1)  # [BH, S]
+                    axis=-1)[..., None]  # [BH, S, 1] (lane-dim, see fwd)
     interp = _interpret()
     dkdv = pl.pallas_call(
         functools.partial(_fa_bwd_dkdv_kernel, bq=bq, bk=bk, seq_len=S,
@@ -204,8 +206,8 @@ def _fa_backward(res, g, causal, scale, bq, bk):
             pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, S, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, S), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, S), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, S, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, S, 1), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
@@ -227,8 +229,8 @@ def _fa_backward(res, g, causal, scale, bq, bk):
             pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
         ],
         out_specs=[pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0))],
         out_shape=[jax.ShapeDtypeStruct((BH, S, D), q.dtype)],
