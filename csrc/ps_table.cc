@@ -1,0 +1,684 @@
+// Native parameter-server table engine.
+//
+// Reference analog: /root/reference/paddle/fluid/distributed/ps/ (35k LoC
+// brpc PS — BrpcPsServer/Client in service/brpc_ps_*.cc, sparse tables in
+// table/memory_sparse_table.cc, per-key optimizer rules in
+// table/sparse_sgd_rule.cc). That stack exists for embedding tables too
+// large for accelerator memory (CTR/recsys). TPU-native equivalent: dense
+// compute lives on-chip via XLA; only the host-memory sparse tables need a
+// native engine, served over the same socket conventions as tcp_store.cc
+// and consumed from Python via ctypes.
+//
+// Tables:
+//   sparse: i64 key -> float[dim] row, created on first pull with
+//           deterministic per-key uniform init; push applies the
+//           table's optimizer rule server-side (SGD / Adagrad), the
+//           contract of sparse_sgd_rule.cc.
+//   dense:  one float[size] slab with the same push rules.
+//
+// Protocol (little-endian), one request per round-trip:
+//   request:  u8 cmd | u32 table_id | u64 n | payload
+//   response: u8 status (0 ok, 1 bad table/args) | u64 len | payload
+//   cmds: 0 CREATE_SPARSE (payload: u32 dim, u8 opt, f32 lr, f32 init)
+//         1 PULL_SPARSE   (payload: i64 keys[n]) -> f32 rows[n*dim]
+//         2 PUSH_SPARSE   (payload: i64 keys[n], f32 grads[n*dim])
+//         3 CREATE_DENSE  (n = size; payload: u8 opt, f32 lr)
+//         4 PULL_DENSE    -> f32[size]
+//         5 PUSH_DENSE    (payload: f32 grads[size])
+//         6 NUM_KEYS      -> u64
+//         7 SAVE          (payload: path) — all tables, binary file
+//         8 LOAD          (payload: path)
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cmath>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+bool send_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::send(fd, p, n, MSG_NOSIGNAL);
+    if (w <= 0) return false;
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool recv_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::recv(fd, p, n, 0);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// deterministic per-key init: splitmix64 -> uniform(-scale, scale)
+uint64_t splitmix64(uint64_t x) {
+  x += 0x9e3779b97f4a7c15ull;
+  x = (x ^ (x >> 30)) * 0xbf58476d1ce4e5b9ull;
+  x = (x ^ (x >> 27)) * 0x94d049bb133111ebull;
+  return x ^ (x >> 31);
+}
+
+struct Table {
+  uint32_t dim = 0;        // sparse row width (0 => dense)
+  uint64_t dense_size = 0;
+  uint8_t opt = 0;         // 0 SGD, 1 Adagrad
+  float lr = 0.01f;
+  float init_scale = 0.0f;
+  uint64_t seed = 0;
+  std::mutex mu;
+  std::unordered_map<int64_t, std::vector<float>> rows;  // sparse weights
+  std::unordered_map<int64_t, std::vector<float>> accum; // adagrad state
+  std::vector<float> dense;
+  std::vector<float> dense_accum;
+
+  std::vector<float>& row(int64_t key) {
+    auto it = rows.find(key);
+    if (it != rows.end()) return it->second;
+    std::vector<float> r(dim);
+    uint64_t h = splitmix64(static_cast<uint64_t>(key) ^ seed);
+    for (uint32_t i = 0; i < dim; ++i) {
+      h = splitmix64(h);
+      float u = static_cast<float>(h >> 11) /
+                static_cast<float>(1ull << 53);  // [0,1)
+      r[i] = (2.0f * u - 1.0f) * init_scale;
+    }
+    return rows.emplace(key, std::move(r)).first->second;
+  }
+
+  void apply(float* w, float* acc, const float* g, uint32_t n) {
+    if (opt == 1) {  // adagrad
+      for (uint32_t i = 0; i < n; ++i) {
+        acc[i] += g[i] * g[i];
+        w[i] -= lr * g[i] / (std::sqrt(acc[i]) + 1e-8f);
+      }
+    } else {  // sgd
+      for (uint32_t i = 0; i < n; ++i) w[i] -= lr * g[i];
+    }
+  }
+};
+
+struct PsServer {
+  int listen_fd = -1;
+  int port = 0;
+  std::thread accept_thread;
+  std::atomic<bool> stopping{false};
+
+  std::mutex conn_mu;
+  std::condition_variable conn_cv;
+  std::vector<int> conn_fds;
+  int live_conns = 0;
+
+  std::mutex tables_mu;
+  std::unordered_map<uint32_t, Table*> tables;
+
+  ~PsServer() {
+    for (auto& kv : tables) delete kv.second;
+  }
+
+  Table* table(uint32_t id) {
+    std::lock_guard<std::mutex> lk(tables_mu);
+    auto it = tables.find(id);
+    return it == tables.end() ? nullptr : it->second;
+  }
+
+  bool save(const std::string& path);
+  bool load(const std::string& path);
+  void handle_conn(int fd);
+  void accept_loop();
+};
+
+bool PsServer::save(const std::string& path) {
+  std::FILE* f = std::fopen(path.c_str(), "wb");
+  if (!f) return false;
+  std::lock_guard<std::mutex> lk(tables_mu);
+  uint64_t ntab = tables.size();
+  std::fwrite(&ntab, 8, 1, f);
+  for (auto& kv : tables) {
+    Table* t = kv.second;
+    std::lock_guard<std::mutex> tl(t->mu);
+    uint32_t id = kv.first;
+    std::fwrite(&id, 4, 1, f);
+    std::fwrite(&t->dim, 4, 1, f);
+    std::fwrite(&t->dense_size, 8, 1, f);
+    std::fwrite(&t->opt, 1, 1, f);
+    std::fwrite(&t->lr, 4, 1, f);
+    std::fwrite(&t->init_scale, 4, 1, f);
+    std::fwrite(&t->seed, 8, 1, f);
+    uint64_t nrows = t->rows.size();
+    std::fwrite(&nrows, 8, 1, f);
+    for (auto& r : t->rows) {
+      std::fwrite(&r.first, 8, 1, f);
+      std::fwrite(r.second.data(), 4, t->dim, f);
+      auto ai = t->accum.find(r.first);
+      uint8_t has_acc = ai != t->accum.end();
+      std::fwrite(&has_acc, 1, 1, f);
+      if (has_acc) std::fwrite(ai->second.data(), 4, t->dim, f);
+    }
+    if (t->dense_size) {
+      std::fwrite(t->dense.data(), 4, t->dense_size, f);
+      uint8_t has_acc = !t->dense_accum.empty();
+      std::fwrite(&has_acc, 1, 1, f);
+      if (has_acc) std::fwrite(t->dense_accum.data(), 4, t->dense_size, f);
+    }
+  }
+  std::fclose(f);
+  return true;
+}
+
+bool PsServer::load(const std::string& path) {
+  // Parse the whole file into fresh Table objects first, then splice the
+  // CONTENTS into live tables under their own locks — existing Table*
+  // are never deleted, since detached handler threads may hold them.
+  std::FILE* f = std::fopen(path.c_str(), "rb");
+  if (!f) return false;
+  std::unordered_map<uint32_t, Table*> loaded;
+  auto fail = [&] {
+    for (auto& kv : loaded) delete kv.second;
+    std::fclose(f);
+    return false;
+  };
+  uint64_t ntab = 0;
+  if (std::fread(&ntab, 8, 1, f) != 1) return fail();
+  for (uint64_t i = 0; i < ntab; ++i) {
+    uint32_t id;
+    Table* t = new Table();
+    bool ok = std::fread(&id, 4, 1, f) == 1 &&
+              std::fread(&t->dim, 4, 1, f) == 1 &&
+              std::fread(&t->dense_size, 8, 1, f) == 1 &&
+              std::fread(&t->opt, 1, 1, f) == 1 &&
+              std::fread(&t->lr, 4, 1, f) == 1 &&
+              std::fread(&t->init_scale, 4, 1, f) == 1 &&
+              std::fread(&t->seed, 8, 1, f) == 1;
+    uint64_t nrows = 0;
+    ok = ok && std::fread(&nrows, 8, 1, f) == 1;
+    for (uint64_t r = 0; ok && r < nrows; ++r) {
+      int64_t key;
+      ok = std::fread(&key, 8, 1, f) == 1;
+      if (!ok) break;
+      std::vector<float> row(t->dim);
+      ok = std::fread(row.data(), 4, t->dim, f) == t->dim;
+      uint8_t has_acc = 0;
+      ok = ok && std::fread(&has_acc, 1, 1, f) == 1;
+      if (ok && has_acc) {
+        std::vector<float> acc(t->dim);
+        ok = std::fread(acc.data(), 4, t->dim, f) == t->dim;
+        if (ok) t->accum.emplace(key, std::move(acc));
+      }
+      if (ok) t->rows.emplace(key, std::move(row));
+    }
+    if (ok && t->dense_size) {
+      t->dense.resize(t->dense_size);
+      ok = std::fread(t->dense.data(), 4, t->dense_size, f) ==
+           t->dense_size;
+      uint8_t has_acc = 0;
+      ok = ok && std::fread(&has_acc, 1, 1, f) == 1;
+      if (ok && has_acc) {
+        t->dense_accum.resize(t->dense_size);
+        ok = std::fread(t->dense_accum.data(), 4, t->dense_size, f) ==
+             t->dense_size;
+      }
+    }
+    if (!ok) { delete t; return fail(); }
+    loaded[id] = t;
+  }
+  std::fclose(f);
+
+  std::lock_guard<std::mutex> lk(tables_mu);
+  for (auto& kv : loaded) {
+    auto it = tables.find(kv.first);
+    if (it == tables.end()) {
+      tables[kv.first] = kv.second;  // new table: adopt as-is
+      continue;
+    }
+    Table* live = it->second;
+    Table* nt = kv.second;
+    std::lock_guard<std::mutex> tl(live->mu);
+    live->dim = nt->dim;
+    live->dense_size = nt->dense_size;
+    live->opt = nt->opt;
+    live->lr = nt->lr;
+    live->init_scale = nt->init_scale;
+    live->seed = nt->seed;
+    live->rows.swap(nt->rows);
+    live->accum.swap(nt->accum);
+    live->dense.swap(nt->dense);
+    live->dense_accum.swap(nt->dense_accum);
+    delete nt;
+  }
+  return true;
+}
+
+void PsServer::handle_conn(int fd) {
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    uint8_t cmd;
+    uint32_t table_id;
+    uint64_t n;
+    if (!recv_all(fd, &cmd, 1) || !recv_all(fd, &table_id, 4) ||
+        !recv_all(fd, &n, 8)) {
+      break;
+    }
+    uint8_t status = 0;
+    std::vector<uint8_t> payload;
+    bool io_ok = true;
+    switch (cmd) {
+      case 0: {  // CREATE_SPARSE — idempotent: every trainer calls
+                 // create on the shared id; re-create must not wipe
+                 // trained rows, and Table* are never deleted while
+                 // serving (handler threads may hold them)
+        struct { uint32_t dim; uint8_t opt; float lr; float init; }
+            __attribute__((packed)) args;
+        io_ok = recv_all(fd, &args, sizeof(args));
+        if (!io_ok) break;
+        std::lock_guard<std::mutex> lk(tables_mu);
+        auto it = tables.find(table_id);
+        if (it != tables.end()) {
+          if (it->second->dim != args.dim || it->second->dense_size) {
+            status = 1;  // conflicting existing table
+          }
+          break;
+        }
+        Table* t = new Table();
+        t->dim = args.dim;
+        t->opt = args.opt;
+        t->lr = args.lr;
+        t->init_scale = args.init;
+        t->seed = splitmix64(table_id + 0x1234u);
+        tables[table_id] = t;
+        break;
+      }
+      case 1: {  // PULL_SPARSE
+        if (n > (1ull << 28)) { io_ok = false; break; }
+        std::vector<int64_t> keys(n);
+        io_ok = n == 0 || recv_all(fd, keys.data(), n * 8);
+        if (!io_ok) break;
+        Table* t = table(table_id);
+        if (!t || !t->dim) { status = 1; break; }
+        payload.resize(n * t->dim * 4);
+        float* out = reinterpret_cast<float*>(payload.data());
+        std::lock_guard<std::mutex> lk(t->mu);
+        for (uint64_t i = 0; i < n; ++i) {
+          auto& r = t->row(keys[i]);
+          std::memcpy(out + i * t->dim, r.data(), t->dim * 4);
+        }
+        break;
+      }
+      case 2: {  // PUSH_SPARSE: keys[n] | u64 glen | f32 grads[glen]
+        if (n > (1ull << 28)) { io_ok = false; break; }
+        std::vector<int64_t> keys(n);
+        io_ok = n == 0 || recv_all(fd, keys.data(), n * 8);
+        if (!io_ok) break;
+        uint64_t glen = 0;
+        io_ok = recv_all(fd, &glen, 8);
+        if (!io_ok || glen > (1ull << 32)) { io_ok = false; break; }
+        std::vector<float> grads(glen);
+        io_ok = glen == 0 || recv_all(fd, grads.data(), glen * 4);
+        if (!io_ok) break;
+        Table* t = table(table_id);
+        uint32_t dim = t ? t->dim : 0;
+        // bad table or mismatched grads: payload already consumed, so the
+        // connection stays in protocol sync and the client sees status 1
+        if (!t || !dim || glen != n * static_cast<uint64_t>(dim)) {
+          status = 1;
+          break;
+        }
+        std::lock_guard<std::mutex> lk(t->mu);
+        for (uint64_t i = 0; i < n; ++i) {
+          auto& w = t->row(keys[i]);
+          float* acc = nullptr;
+          if (t->opt == 1) {
+            auto ai = t->accum.find(keys[i]);
+            if (ai == t->accum.end()) {
+              ai = t->accum.emplace(keys[i],
+                                    std::vector<float>(dim, 0.f)).first;
+            }
+            acc = ai->second.data();
+          }
+          t->apply(w.data(), acc, grads.data() + i * dim, dim);
+        }
+        break;
+      }
+      case 3: {  // CREATE_DENSE (n = size) — idempotent like case 0
+        struct { uint8_t opt; float lr; } __attribute__((packed)) args;
+        io_ok = recv_all(fd, &args, sizeof(args));
+        if (!io_ok) break;
+        std::lock_guard<std::mutex> lk(tables_mu);
+        auto it = tables.find(table_id);
+        if (it != tables.end()) {
+          if (it->second->dense_size != n || it->second->dim) status = 1;
+          break;
+        }
+        Table* t = new Table();
+        t->dense_size = n;
+        t->opt = args.opt;
+        t->lr = args.lr;
+        t->dense.assign(n, 0.f);
+        if (args.opt == 1) t->dense_accum.assign(n, 0.f);
+        tables[table_id] = t;
+        break;
+      }
+      case 4: {  // PULL_DENSE
+        Table* t = table(table_id);
+        if (!t || !t->dense_size) { status = 1; break; }
+        std::lock_guard<std::mutex> lk(t->mu);
+        payload.resize(t->dense_size * 4);
+        std::memcpy(payload.data(), t->dense.data(), t->dense_size * 4);
+        break;
+      }
+      case 5: {  // PUSH_DENSE (n = client-declared grads length)
+        if (n > (1ull << 32)) { io_ok = false; break; }
+        std::vector<float> grads(n);
+        io_ok = n == 0 || recv_all(fd, grads.data(), n * 4);
+        if (!io_ok) break;
+        Table* t = table(table_id);
+        uint64_t sz = t ? t->dense_size : 0;
+        if (!t || !sz || n != sz) { status = 1; break; }
+        std::lock_guard<std::mutex> lk(t->mu);
+        t->apply(t->dense.data(),
+                 t->dense_accum.empty() ? nullptr : t->dense_accum.data(),
+                 grads.data(), sz);
+        break;
+      }
+      case 6: {  // NUM_KEYS
+        Table* t = table(table_id);
+        if (!t) { status = 1; break; }
+        std::lock_guard<std::mutex> lk(t->mu);
+        uint64_t nk = t->rows.size();
+        payload.resize(8);
+        std::memcpy(payload.data(), &nk, 8);
+        break;
+      }
+      case 7:    // SAVE (payload: path of n bytes)
+      case 8: {  // LOAD
+        std::string path(n, '\0');
+        io_ok = n == 0 || recv_all(fd, &path[0], n);
+        if (!io_ok) break;
+        bool ok = cmd == 7 ? save(path) : load(path);
+        if (!ok) status = 1;
+        break;
+      }
+      default:
+        status = 1;
+        break;
+    }
+    if (!io_ok) break;
+    uint64_t plen = payload.size();
+    if (!send_all(fd, &status, 1) || !send_all(fd, &plen, 8) ||
+        (plen && !send_all(fd, payload.data(), plen))) {
+      break;
+    }
+  }
+  {
+    std::lock_guard<std::mutex> lk(conn_mu);
+    for (auto it = conn_fds.begin(); it != conn_fds.end(); ++it) {
+      if (*it == fd) {
+        conn_fds.erase(it);
+        break;
+      }
+    }
+  }
+  ::close(fd);
+  std::lock_guard<std::mutex> lk(conn_mu);
+  --live_conns;
+  conn_cv.notify_all();
+}
+
+void PsServer::accept_loop() {
+  for (;;) {
+    sockaddr_in peer{};
+    socklen_t plen = sizeof(peer);
+    int fd = ::accept(listen_fd, reinterpret_cast<sockaddr*>(&peer),
+                      &plen);
+    if (fd < 0) {
+      if (stopping.load()) return;
+      continue;
+    }
+    if (stopping.load()) {
+      ::close(fd);
+      return;
+    }
+    {
+      std::lock_guard<std::mutex> lk(conn_mu);
+      conn_fds.push_back(fd);
+      ++live_conns;
+    }
+    std::thread(&PsServer::handle_conn, this, fd).detach();
+  }
+}
+
+struct PsClient {
+  int fd = -1;
+  std::mutex mu;
+};
+
+bool roundtrip(PsClient* c, uint8_t cmd, uint32_t table_id, uint64_t n,
+               const void* req1, size_t len1, const void* req2,
+               size_t len2, std::vector<uint8_t>* out) {
+  std::lock_guard<std::mutex> lk(c->mu);
+  if (c->fd < 0) return false;
+  if (!send_all(c->fd, &cmd, 1) || !send_all(c->fd, &table_id, 4) ||
+      !send_all(c->fd, &n, 8)) {
+    return false;
+  }
+  if (len1 && !send_all(c->fd, req1, len1)) return false;
+  if (len2 && !send_all(c->fd, req2, len2)) return false;
+  uint8_t status;
+  uint64_t plen;
+  if (!recv_all(c->fd, &status, 1) || !recv_all(c->fd, &plen, 8)) {
+    return false;
+  }
+  out->resize(plen);
+  if (plen && !recv_all(c->fd, out->data(), plen)) return false;
+  return status == 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* psrv_start(int port) {
+  auto* s = new PsServer();
+  s->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (s->listen_fd < 0) {
+    delete s;
+    return nullptr;
+  }
+  int one = 1;
+  ::setsockopt(s->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(s->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(s->listen_fd, 128) != 0) {
+    ::close(s->listen_fd);
+    delete s;
+    return nullptr;
+  }
+  socklen_t alen = sizeof(addr);
+  ::getsockname(s->listen_fd, reinterpret_cast<sockaddr*>(&addr), &alen);
+  s->port = ntohs(addr.sin_port);
+  s->accept_thread = std::thread(&PsServer::accept_loop, s);
+  return s;
+}
+
+int psrv_port(void* h) { return static_cast<PsServer*>(h)->port; }
+
+void psrv_stop(void* h) {
+  auto* s = static_cast<PsServer*>(h);
+  s->stopping.store(true);
+  ::shutdown(s->listen_fd, SHUT_RDWR);
+  ::close(s->listen_fd);
+  if (s->accept_thread.joinable()) s->accept_thread.join();
+  {
+    std::unique_lock<std::mutex> lk(s->conn_mu);
+    for (int fd : s->conn_fds) ::shutdown(fd, SHUT_RD);
+    s->conn_cv.wait(lk, [&] { return s->live_conns == 0; });
+  }
+  delete s;
+}
+
+void* psc_connect(const char* host, int port, int timeout_ms) {
+  addrinfo hints{}, *res = nullptr;
+  hints.ai_family = AF_INET;
+  hints.ai_socktype = SOCK_STREAM;
+  char portbuf[16];
+  std::snprintf(portbuf, sizeof(portbuf), "%d", port);
+  if (::getaddrinfo(host, portbuf, &hints, &res) != 0 || !res) {
+    return nullptr;
+  }
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  int fd = -1;
+  for (;;) {
+    fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) break;
+    if (::connect(fd, res->ai_addr, res->ai_addrlen) == 0) break;
+    ::close(fd);
+    fd = -1;
+    if (std::chrono::steady_clock::now() >= deadline) break;
+    std::this_thread::sleep_for(std::chrono::milliseconds(100));
+  }
+  ::freeaddrinfo(res);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  ::setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto* c = new PsClient();
+  c->fd = fd;
+  return c;
+}
+
+void psc_close(void* h) {
+  auto* c = static_cast<PsClient*>(h);
+  {
+    std::lock_guard<std::mutex> lk(c->mu);
+    if (c->fd >= 0) ::close(c->fd);
+    c->fd = -1;
+  }
+  delete c;
+}
+
+int psc_create_sparse(void* h, uint32_t table_id, uint32_t dim, int opt,
+                      float lr, float init_scale) {
+  struct { uint32_t dim; uint8_t opt; float lr; float init; }
+      __attribute__((packed)) args{dim, static_cast<uint8_t>(opt), lr,
+                                   init_scale};
+  std::vector<uint8_t> out;
+  return roundtrip(static_cast<PsClient*>(h), 0, table_id, 0, &args,
+                   sizeof(args), nullptr, 0, &out)
+             ? 0
+             : -1;
+}
+
+int psc_pull_sparse(void* h, uint32_t table_id, const int64_t* keys,
+                    uint64_t n, float* out_rows, uint64_t out_len) {
+  std::vector<uint8_t> out;
+  if (!roundtrip(static_cast<PsClient*>(h), 1, table_id, n, keys, n * 8,
+                 nullptr, 0, &out)) {
+    return -1;
+  }
+  if (out.size() != out_len * 4) return -1;
+  std::memcpy(out_rows, out.data(), out.size());
+  return 0;
+}
+
+int psc_push_sparse(void* h, uint32_t table_id, const int64_t* keys,
+                    uint64_t n, const float* grads, uint64_t grads_len) {
+  // wire format: keys[n] | u64 glen | grads[glen]
+  std::vector<uint8_t> req(n * 8 + 8 + grads_len * 4);
+  std::memcpy(req.data(), keys, n * 8);
+  std::memcpy(req.data() + n * 8, &grads_len, 8);
+  std::memcpy(req.data() + n * 8 + 8, grads, grads_len * 4);
+  std::vector<uint8_t> out;
+  return roundtrip(static_cast<PsClient*>(h), 2, table_id, n, req.data(),
+                   req.size(), nullptr, 0, &out)
+             ? 0
+             : -1;
+}
+
+int psc_create_dense(void* h, uint32_t table_id, uint64_t size, int opt,
+                     float lr) {
+  struct { uint8_t opt; float lr; } __attribute__((packed))
+      args{static_cast<uint8_t>(opt), lr};
+  std::vector<uint8_t> out;
+  return roundtrip(static_cast<PsClient*>(h), 3, table_id, size, &args,
+                   sizeof(args), nullptr, 0, &out)
+             ? 0
+             : -1;
+}
+
+int psc_pull_dense(void* h, uint32_t table_id, float* out_vals,
+                   uint64_t len) {
+  std::vector<uint8_t> out;
+  if (!roundtrip(static_cast<PsClient*>(h), 4, table_id, 0, nullptr, 0,
+                 nullptr, 0, &out)) {
+    return -1;
+  }
+  if (out.size() != len * 4) return -1;
+  std::memcpy(out_vals, out.data(), out.size());
+  return 0;
+}
+
+int psc_push_dense(void* h, uint32_t table_id, const float* grads,
+                   uint64_t len) {
+  std::vector<uint8_t> out;
+  return roundtrip(static_cast<PsClient*>(h), 5, table_id, len, grads,
+                   len * 4, nullptr, 0, &out)
+             ? 0
+             : -1;
+}
+
+int64_t psc_num_keys(void* h, uint32_t table_id) {
+  std::vector<uint8_t> out;
+  if (!roundtrip(static_cast<PsClient*>(h), 6, table_id, 0, nullptr, 0,
+                 nullptr, 0, &out) ||
+      out.size() != 8) {
+    return -1;
+  }
+  int64_t nk;
+  std::memcpy(&nk, out.data(), 8);
+  return nk;
+}
+
+int psc_save(void* h, const char* path) {
+  std::vector<uint8_t> out;
+  size_t n = std::strlen(path);
+  return roundtrip(static_cast<PsClient*>(h), 7, 0, n, path, n, nullptr,
+                   0, &out)
+             ? 0
+             : -1;
+}
+
+int psc_load(void* h, const char* path) {
+  std::vector<uint8_t> out;
+  size_t n = std::strlen(path);
+  return roundtrip(static_cast<PsClient*>(h), 8, 0, n, path, n, nullptr,
+                   0, &out)
+             ? 0
+             : -1;
+}
+
+}  // extern "C"

@@ -22,6 +22,7 @@ from . import fleet  # noqa: F401
 from . import pipeline  # noqa: F401
 from . import auto_tuner  # noqa: F401
 from . import rpc  # noqa: F401
+from . import ps  # noqa: F401
 from .store import TCPStore  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict
 from .launch import spawn

@@ -1,0 +1,374 @@
+"""Parameter-server training stack.
+
+Reference: /root/reference/paddle/fluid/distributed/ps/ (brpc PS servers,
+memory_sparse_table.cc, sparse_sgd_rule.cc) + python/paddle/distributed/ps
+and fleet PS mode (role_maker.py): trillion-parameter sparse embeddings
+held in host memory across PS nodes, pulled/pushed per batch by trainers.
+
+TPU-native design: the dense model lives on-chip (XLA); only the sparse
+embedding tables need host/parameter-server storage. csrc/ps_table.cc is
+the native table engine (deterministic per-key init, server-side SGD /
+Adagrad — the sparse_sgd_rule.cc contract); this module provides the
+ctypes client/server, a fleet-style role workflow
+(init_server/run_server/init_worker/stop_worker), and
+``DistributedEmbedding`` — a Layer that pulls rows on forward and pushes
+gradients from a backward hook, so a recsys model trains against the PS
+while the dense part runs the normal TPU autograd path.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..nn.layer_base import Layer
+from ..utils.native_build import build_native_so
+
+__all__ = ["PsServer", "PsClient", "SparseTable", "DistributedEmbedding",
+           "init_server", "run_server", "init_worker", "stop_worker",
+           "get_client"]
+
+_lock = threading.Lock()
+_lib = None
+_build_failed = False
+
+
+def _get_lib():
+    global _lib, _build_failed
+    if _lib is not None or _build_failed:
+        return _lib
+    with _lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        so = build_native_so("ps_table.cc", "libptps.so")
+        if so is None:
+            _build_failed = True
+            return None
+        lib = ctypes.CDLL(so)
+        lib.psrv_start.restype = ctypes.c_void_p
+        lib.psrv_start.argtypes = [ctypes.c_int]
+        lib.psrv_port.restype = ctypes.c_int
+        lib.psrv_port.argtypes = [ctypes.c_void_p]
+        lib.psrv_stop.argtypes = [ctypes.c_void_p]
+        lib.psc_connect.restype = ctypes.c_void_p
+        lib.psc_connect.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                    ctypes.c_int]
+        lib.psc_close.argtypes = [ctypes.c_void_p]
+        lib.psc_create_sparse.restype = ctypes.c_int
+        lib.psc_create_sparse.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_int, ctypes.c_float, ctypes.c_float]
+        lib.psc_pull_sparse.restype = ctypes.c_int
+        lib.psc_pull_sparse.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_uint64]
+        lib.psc_push_sparse.restype = ctypes.c_int
+        lib.psc_push_sparse.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_uint64]
+        lib.psc_create_dense.restype = ctypes.c_int
+        lib.psc_create_dense.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint64,
+            ctypes.c_int, ctypes.c_float]
+        lib.psc_pull_dense.restype = ctypes.c_int
+        lib.psc_pull_dense.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_uint64]
+        lib.psc_push_dense.restype = ctypes.c_int
+        lib.psc_push_dense.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_float), ctypes.c_uint64]
+        lib.psc_num_keys.restype = ctypes.c_int64
+        lib.psc_num_keys.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+        lib.psc_save.restype = ctypes.c_int
+        lib.psc_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.psc_load.restype = ctypes.c_int
+        lib.psc_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        _lib = lib
+        return _lib
+
+
+OPTIMIZERS = {"sgd": 0, "adagrad": 1}
+
+
+class PsServer:
+    """In-process native table server (BrpcPsServer analog)."""
+
+    def __init__(self, port: int = 0):
+        lib = _get_lib()
+        if lib is None:
+            raise RuntimeError("native PS library unavailable (g++ build "
+                               "failed); parameter-server mode needs it")
+        self._lib = lib
+        self._h = lib.psrv_start(port)
+        if not self._h:
+            raise RuntimeError(f"PsServer: cannot bind port {port}")
+        self.port = lib.psrv_port(self._h)
+
+    def stop(self):
+        if self._h:
+            self._lib.psrv_stop(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.stop()
+        except Exception:
+            pass
+
+
+class PsClient:
+    """Connection to one PS node (BrpcPsClient analog)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 timeout_s: float = 30.0):
+        lib = _get_lib()
+        if lib is None:
+            raise RuntimeError("native PS library unavailable")
+        self._lib = lib
+        self._mu = threading.Lock()
+        # table_id -> row dim, registered by create_sparse_table; needed
+        # to size pull buffers (per-connection, NOT shared across clients)
+        self._table_dims: Dict[int, int] = {}
+        self._h = lib.psc_connect(host.encode(), port,
+                                  int(timeout_s * 1000))
+        if not self._h:
+            raise RuntimeError(f"PsClient: cannot connect {host}:{port}")
+
+    def _handle(self):
+        if self._h is None:
+            raise RuntimeError("PsClient is closed")
+        return self._h
+
+    def close(self):
+        with self._mu:
+            if self._h:
+                self._lib.psc_close(self._h)
+                self._h = None
+
+    # -- tables ------------------------------------------------------------
+    def create_sparse_table(self, table_id: int, dim: int,
+                            optimizer: str = "sgd", lr: float = 0.01,
+                            init_scale: float = 0.05):
+        opt = OPTIMIZERS[optimizer]
+        with self._mu:
+            rc = self._lib.psc_create_sparse(self._handle(), table_id,
+                                             dim, opt, lr, init_scale)
+        if rc != 0:
+            raise RuntimeError(
+                f"create_sparse_table({table_id}) failed (an existing "
+                f"table with this id and a different dim?)")
+        self._table_dims[table_id] = dim
+
+    def pull_sparse(self, table_id: int, keys) -> np.ndarray:
+        keys = np.ascontiguousarray(keys, dtype=np.int64).ravel()
+        dim = self._table_dims.get(table_id)
+        if dim is None:
+            raise RuntimeError(
+                f"table {table_id} dim unknown to this client; call "
+                f"create_sparse_table(table_id, dim, ...) first (it is "
+                f"idempotent on the server)")
+        out = np.empty((keys.size, dim), np.float32)
+        with self._mu:
+            rc = self._lib.psc_pull_sparse(
+                self._handle(), table_id,
+                keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                keys.size,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                out.size)
+        if rc != 0:
+            raise RuntimeError(f"pull_sparse({table_id}) failed")
+        return out
+
+    def push_sparse(self, table_id: int, keys, grads):
+        keys = np.ascontiguousarray(keys, dtype=np.int64).ravel()
+        grads = np.ascontiguousarray(grads, dtype=np.float32)
+        with self._mu:
+            rc = self._lib.psc_push_sparse(
+                self._handle(), table_id,
+                keys.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                keys.size,
+                grads.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                grads.size)
+        if rc != 0:
+            raise RuntimeError(f"push_sparse({table_id}) failed")
+
+    def create_dense_table(self, table_id: int, size: int,
+                           optimizer: str = "sgd", lr: float = 0.01):
+        with self._mu:
+            rc = self._lib.psc_create_dense(self._handle(), table_id,
+                                            size, OPTIMIZERS[optimizer],
+                                            lr)
+        if rc != 0:
+            raise RuntimeError(f"create_dense_table({table_id}) failed")
+
+    def pull_dense(self, table_id: int, size: int) -> np.ndarray:
+        out = np.empty(size, np.float32)
+        with self._mu:
+            rc = self._lib.psc_pull_dense(
+                self._handle(), table_id,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                out.size)
+        if rc != 0:
+            raise RuntimeError(f"pull_dense({table_id}) failed")
+        return out
+
+    def push_dense(self, table_id: int, grads):
+        grads = np.ascontiguousarray(grads, dtype=np.float32).ravel()
+        with self._mu:
+            rc = self._lib.psc_push_dense(
+                self._handle(), table_id,
+                grads.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+                grads.size)
+        if rc != 0:
+            raise RuntimeError(f"push_dense({table_id}) failed")
+
+    def num_keys(self, table_id: int) -> int:
+        with self._mu:
+            nk = self._lib.psc_num_keys(self._handle(), table_id)
+        if nk < 0:
+            raise RuntimeError(f"num_keys({table_id}) failed")
+        return int(nk)
+
+    def save(self, path: str):
+        with self._mu:
+            if self._lib.psc_save(self._handle(), path.encode()) != 0:
+                raise RuntimeError(f"PS save({path}) failed")
+
+    def load(self, path: str):
+        with self._mu:
+            if self._lib.psc_load(self._handle(), path.encode()) != 0:
+                raise RuntimeError(f"PS load({path}) failed")
+
+class SparseTable:
+    """Handle for one sparse table (memory_sparse_table.cc analog)."""
+
+    _next_id = [0]
+
+    def __init__(self, client: PsClient, dim: int, optimizer: str = "sgd",
+                 lr: float = 0.01, init_scale: float = 0.05,
+                 table_id: Optional[int] = None):
+        if table_id is None:
+            SparseTable._next_id[0] += 1
+            table_id = SparseTable._next_id[0]
+        self.client = client
+        self.table_id = table_id
+        self.dim = dim
+        client.create_sparse_table(table_id, dim, optimizer, lr,
+                                   init_scale)
+
+    def pull(self, keys) -> np.ndarray:
+        return self.client.pull_sparse(self.table_id, keys)
+
+    def push(self, keys, grads):
+        self.client.push_sparse(self.table_id, keys, grads)
+
+    def num_keys(self) -> int:
+        return self.client.num_keys(self.table_id)
+
+
+class DistributedEmbedding(Layer):
+    """Embedding whose rows live on the parameter server.
+
+    Forward pulls the batch's rows (host -> TPU); backward pushes the
+    received row gradients back, where the server applies its optimizer
+    rule. The dense model trains through the ordinary optimizer; this
+    layer's "update" is entirely server-side — the contract of the
+    reference's distributed lookup table
+    (python/paddle/distributed/ps/coordinator + c_embedding path).
+    """
+
+    def __init__(self, client: PsClient, embedding_dim: int,
+                 optimizer: str = "sgd", lr: float = 0.1,
+                 init_scale: float = 0.05,
+                 table_id: Optional[int] = None):
+        super().__init__()
+        self.table = SparseTable(client, embedding_dim, optimizer, lr,
+                                 init_scale, table_id)
+        self.embedding_dim = embedding_dim
+
+    def forward(self, ids: Tensor) -> Tensor:
+        ids_np = np.asarray(ids.numpy(), np.int64)
+        flat = ids_np.ravel()
+        rows = self.table.pull(flat)  # [n, dim]
+        out = Tensor(rows.reshape(ids_np.shape + (self.embedding_dim,)),
+                     stop_gradient=False)
+        table = self.table
+
+        def push_hook(grad: Tensor):
+            g = np.asarray(grad.numpy(), np.float32).reshape(
+                flat.size, table.dim)
+            table.push(flat, g)
+            return grad
+
+        if self.training:
+            out.register_hook(push_hook)
+            out.retain_grads()
+        return out
+
+
+# ---------------------------------------------------------------------------
+# fleet-style PS workflow (role_maker.py PADDLE_TRAINING_ROLE contract)
+# ---------------------------------------------------------------------------
+
+_state = {"server": None, "client": None}
+
+
+def _ps_endpoint() -> str:
+    eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "127.0.0.1:0")
+    return eps.split(",")[0]
+
+
+def init_server(port: Optional[int] = None) -> "PsServer":
+    """Start this node's table server (fleet.init_server analog)."""
+    if _state["server"] is None:
+        if port is None:
+            ep = _ps_endpoint()
+            port = int(ep.rsplit(":", 1)[1])
+        _state["server"] = PsServer(port)
+    return _state["server"]
+
+
+def run_server():
+    """Block serving until stop (fleet.run_server analog); the native
+    server threads do the work, so this just parks the main thread."""
+    srv = init_server()
+    try:
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+def init_worker(host: Optional[str] = None,
+                port: Optional[int] = None) -> PsClient:
+    """Connect this trainer to the PS (fleet.init_worker analog)."""
+    if _state["client"] is None:
+        if host is None or port is None:
+            ep = _ps_endpoint()
+            h, p = ep.rsplit(":", 1)
+            host = host or h
+            port = port or int(p)
+        _state["client"] = PsClient(host, port)
+    return _state["client"]
+
+
+def get_client() -> Optional[PsClient]:
+    return _state["client"]
+
+
+def stop_worker():
+    if _state["client"] is not None:
+        _state["client"].close()
+        _state["client"] = None
+
+
+def stop_server():
+    if _state["server"] is not None:
+        _state["server"].stop()
+        _state["server"] = None

@@ -10,8 +10,6 @@ coordination KV next to jax.distributed's coordination service.
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
 import threading
 from typing import Optional
 
@@ -21,34 +19,6 @@ _lock = threading.Lock()
 _lib = None
 _build_failed = False
 
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
-_SRC = os.path.join(_REPO_ROOT, "csrc", "tcp_store.cc")
-_OUT_DIR = os.path.join(_REPO_ROOT, "build")
-_SO = os.path.join(_OUT_DIR, "libptstore.so")
-
-
-def _build() -> Optional[str]:
-    os.makedirs(_OUT_DIR, exist_ok=True)
-    if os.path.exists(_SO) and \
-            os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
-        return _SO
-    # compile to a per-pid temp path then atomically rename: concurrent
-    # first-use across spawned ranks must never dlopen a half-written .so
-    tmp = f"{_SO}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O2", "-shared", "-fPIC", "-pthread", "-std=c++17",
-           _SRC, "-o", tmp]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _SO)
-        return _SO
-    except Exception:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        return None
-
 
 def get_lib():
     global _lib, _build_failed
@@ -57,7 +27,8 @@ def get_lib():
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
-        so = _build()
+        from ..utils.native_build import build_native_so
+        so = build_native_so("tcp_store.cc", "libptstore.so", opt="-O2")
         if so is None:
             _build_failed = True
             return None

@@ -5,8 +5,6 @@ consumed without pybind11)."""
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
 import threading
 from typing import List, Optional
 
@@ -15,34 +13,6 @@ import numpy as np
 _lock = threading.Lock()
 _lib = None
 _build_failed = False
-
-_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))))
-_SRC = os.path.join(_REPO_ROOT, "csrc", "data_feed.cc")
-_OUT_DIR = os.path.join(_REPO_ROOT, "build")
-_SO = os.path.join(_OUT_DIR, "libptfeed.so")
-
-
-def _build() -> Optional[str]:
-    os.makedirs(_OUT_DIR, exist_ok=True)
-    if os.path.exists(_SO) and \
-            os.path.getmtime(_SO) >= os.path.getmtime(_SRC):
-        return _SO
-    # temp + atomic rename: concurrent first-use across worker processes
-    # must never dlopen a half-written .so
-    tmp = f"{_SO}.{os.getpid()}.tmp"
-    cmd = ["g++", "-O3", "-shared", "-fPIC", "-pthread", "-std=c++17",
-           _SRC, "-o", tmp]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
-        os.replace(tmp, _SO)
-        return _SO
-    except Exception:
-        try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        return None
 
 
 def get_lib():
@@ -53,7 +23,8 @@ def get_lib():
     with _lock:
         if _lib is not None or _build_failed:
             return _lib
-        so = _build()
+        from ..utils.native_build import build_native_so
+        so = build_native_so("data_feed.cc", "libptfeed.so")
         if so is None:
             _build_failed = True
             return None

@@ -1,0 +1,46 @@
+"""Shared lazy g++ build for the csrc/ native runtime libraries.
+
+One place for the compile-recipe (temp + atomic rename so concurrent
+first-use across processes never dlopens a half-written .so) used by
+io.native (data feed), distributed.store (TCPStore), and distributed.ps
+(sparse tables). The reference builds its native runtime through a CMake
+superbuild (/root/reference/CMakeLists.txt); here each library is one
+translation unit compiled on first import.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+from typing import Optional
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+OUT_DIR = os.path.join(REPO_ROOT, "build")
+
+
+def build_native_so(src_name: str, so_name: str,
+                    opt: str = "-O3") -> Optional[str]:
+    """Compile csrc/<src_name> to build/<so_name> if stale; returns the
+    .so path or None on failure (callers degrade to pure-python paths)."""
+    src = os.path.join(REPO_ROOT, "csrc", src_name)
+    so = os.path.join(OUT_DIR, so_name)
+    try:
+        os.makedirs(OUT_DIR, exist_ok=True)
+        if os.path.exists(so) and \
+                os.path.getmtime(so) >= os.path.getmtime(src):
+            return so
+    except OSError:  # missing csrc tree etc: degrade, don't raise
+        return so if os.path.exists(so) else None
+    tmp = f"{so}.{os.getpid()}.tmp"
+    cmd = ["g++", opt, "-shared", "-fPIC", "-pthread", "-std=c++17",
+           src, "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=180)
+        os.replace(tmp, so)
+        return so
+    except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        return None

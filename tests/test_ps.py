@@ -1,0 +1,155 @@
+"""Parameter-server stack tests (csrc/ps_table.cc + distributed.ps).
+
+Models the reference's PS test style (test/ps/, table unit tests under
+paddle/fluid/distributed/ps) on one host: in-process server + client.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import ps
+
+
+@pytest.fixture(scope="module")
+def server_client():
+    if ps._get_lib() is None:
+        pytest.skip("native PS library unavailable")
+    srv = ps.PsServer(0)
+    cli = ps.PsClient("127.0.0.1", srv.port)
+    yield srv, cli
+    cli.close()
+    srv.stop()
+
+
+def test_sparse_pull_deterministic_init(server_client):
+    _, cli = server_client
+    t = ps.SparseTable(cli, dim=8, optimizer="sgd", lr=0.5,
+                       init_scale=0.1)
+    keys = np.array([5, 42, 5], np.int64)
+    rows = t.pull(keys)
+    assert rows.shape == (3, 8)
+    np.testing.assert_array_equal(rows[0], rows[2])  # same key, same row
+    assert not np.array_equal(rows[0], rows[1])
+    assert np.abs(rows).max() <= 0.1 + 1e-6
+    # pulling again returns identical values (no reinit)
+    np.testing.assert_array_equal(t.pull(keys), rows)
+    assert t.num_keys() == 2
+
+
+def test_sparse_push_sgd_update(server_client):
+    _, cli = server_client
+    t = ps.SparseTable(cli, dim=4, optimizer="sgd", lr=0.5, init_scale=0.0)
+    keys = np.array([1, 2], np.int64)
+    w0 = t.pull(keys)
+    np.testing.assert_array_equal(w0, 0.0)  # init_scale 0 => zero rows
+    g = np.arange(8, dtype=np.float32).reshape(2, 4)
+    t.push(keys, g)
+    w1 = t.pull(keys)
+    np.testing.assert_allclose(w1, -0.5 * g, rtol=1e-6)
+    # duplicate keys in one push apply twice (server-side accumulation)
+    t.push(np.array([1, 1], np.int64), np.ones((2, 4), np.float32))
+    w2 = t.pull(np.array([1], np.int64))
+    np.testing.assert_allclose(w2[0], w1[0] - 0.5 * 2, rtol=1e-6)
+
+
+def test_sparse_adagrad(server_client):
+    _, cli = server_client
+    t = ps.SparseTable(cli, dim=2, optimizer="adagrad", lr=1.0,
+                       init_scale=0.0)
+    keys = np.array([7], np.int64)
+    g = np.array([[2.0, 0.5]], np.float32)
+    t.push(keys, g)
+    w = t.pull(keys)
+    # adagrad first step: w = -lr * g / (|g| + eps) = -sign(g)
+    np.testing.assert_allclose(w[0], [-1.0, -1.0], rtol=1e-4)
+
+
+def test_dense_table(server_client):
+    _, cli = server_client
+    cli.create_dense_table(100, size=6, optimizer="sgd", lr=0.1)
+    w = cli.pull_dense(100, 6)
+    np.testing.assert_array_equal(w, 0.0)
+    cli.push_dense(100, np.ones(6, np.float32))
+    np.testing.assert_allclose(cli.pull_dense(100, 6), -0.1, rtol=1e-6)
+
+
+def test_bad_table_keeps_connection(server_client):
+    _, cli = server_client
+    with pytest.raises(RuntimeError):
+        cli.pull_dense(9999, 4)
+    # connection still in protocol sync after the error
+    cli.create_dense_table(101, size=2)
+    assert cli.pull_dense(101, 2).shape == (2,)
+    cli._table_dims[9998] = 3
+    with pytest.raises(RuntimeError):
+        cli.push_sparse(9998, np.array([1], np.int64),
+                        np.ones((1, 3), np.float32))
+    assert cli.pull_dense(101, 2).shape == (2,)
+
+
+def test_save_load_roundtrip(server_client, tmp_path):
+    _, cli = server_client
+    t = ps.SparseTable(cli, dim=3, optimizer="sgd", lr=1.0,
+                       init_scale=0.05)
+    keys = np.array([10, 20, 30], np.int64)
+    t.push(keys, np.ones((3, 3), np.float32))
+    before = t.pull(keys)
+    path = str(tmp_path / "tables.psckpt")
+    cli.save(path)
+    t.push(keys, np.ones((3, 3), np.float32))  # mutate after save
+    cli.load(path)
+    np.testing.assert_array_equal(t.pull(keys), before)
+
+
+def test_distributed_embedding_training(server_client):
+    """End-to-end: PS embedding + on-chip dense layer learns a mapping."""
+    _, cli = server_client
+    emb = ps.DistributedEmbedding(cli, embedding_dim=8, optimizer="sgd",
+                                  lr=0.3, init_scale=0.05)
+    lin = paddle.nn.Linear(8, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.3,
+                               parameters=lin.parameters())
+    ids = paddle.to_tensor(np.array([[0, 1], [2, 3]], np.int64))
+    target = paddle.to_tensor(np.array([[1.0], [-1.0]], np.float32))
+    losses = []
+    for _ in range(60):
+        e = emb(ids)                      # [2, 2, 8] pulled from PS
+        feat = e.mean(axis=1)             # [2, 8]
+        pred = lin(feat)
+        loss = ((pred - target) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < 0.1 * losses[0], (losses[0], losses[-1])
+    assert emb.table.num_keys() == 4
+
+
+def test_fleet_style_workflow():
+    if ps._get_lib() is None:
+        pytest.skip("native PS library unavailable")
+    srv = ps.init_server(port=0)
+    cli = ps.init_worker(host="127.0.0.1", port=srv.port)
+    assert ps.get_client() is cli
+    t = ps.SparseTable(cli, dim=2)
+    assert t.pull(np.array([1], np.int64)).shape == (1, 2)
+    ps.stop_worker()
+    assert ps.get_client() is None
+    ps.stop_server()
+
+
+def test_second_trainer_create_is_idempotent(server_client):
+    """A second worker creating the shared table id must not wipe rows."""
+    srv, cli = server_client
+    t = ps.SparseTable(cli, dim=4, optimizer="sgd", lr=1.0,
+                       init_scale=0.0, table_id=777)
+    t.push(np.array([3], np.int64), np.ones((1, 4), np.float32))
+    trained = t.pull(np.array([3], np.int64))
+    cli2 = ps.PsClient("127.0.0.1", srv.port)
+    t2 = ps.SparseTable(cli2, dim=4, optimizer="sgd", lr=1.0,
+                        init_scale=0.0, table_id=777)
+    np.testing.assert_array_equal(t2.pull(np.array([3], np.int64)),
+                                  trained)
+    with pytest.raises(RuntimeError):  # conflicting dim is rejected
+        cli2.create_sparse_table(777, dim=8)
+    cli2.close()
