@@ -20,6 +20,7 @@ from .parallel import DataParallel
 from .sharding import group_sharded_parallel, save_group_sharded_model
 from . import fleet  # noqa: F401
 from . import pipeline  # noqa: F401
+from . import pipeline_schedules  # noqa: F401
 from . import auto_tuner  # noqa: F401
 from . import rpc  # noqa: F401
 from . import ps  # noqa: F401

@@ -138,6 +138,16 @@ class PipelineParallel(Layer):
         cfg = (strategy.pipeline_configs if strategy is not None else
                {"accumulate_steps": 1})
         self.accumulate_steps = cfg.get("accumulate_steps", 1)
+        # F-vs-B interleave per the named schedule (reference
+        # pipeline_scheduler_pass schedule_mode); 1F1B/ZeroBubble bound
+        # live microbatch graphs, FThenB retains all M before backward.
+        # Built once here: config errors surface at construction and the
+        # tick simulation stays off the per-step hot path.
+        from ..pipeline_schedules import get_schedule
+        self.schedule_mode = cfg.get("schedule_mode", "1F1B")
+        self._schedule = get_schedule(
+            self.schedule_mode, max(layers.num_stages, 1),
+            self.accumulate_steps)
 
     def forward(self, x):
         return self._layers(x)
@@ -147,12 +157,22 @@ class PipelineParallel(Layer):
         m = self.accumulate_steps
         micro_x = split_op(inputs, m, axis=0) if m > 1 else [inputs]
         micro_y = split_op(labels, m, axis=0) if m > 1 else [labels]
+        stages = max(self._layers.num_stages, 1)
+        # drive F/B in the LAST stage's order (the rank that owns the
+        # loss): FThenB -> all F then all B; 1F1B/ZB -> F0 B0 F1 B1 ...
+        pending = {}
         total = 0.0
-        for mx, my in zip(micro_x, micro_y):
-            out = self._layers(mx)
-            loss = self._layers._loss_fn(out, my)
-            (loss / m).backward()
-            total += float(loss)
+        for job in self._schedule.jobs(stages - 1):
+            if job.kind == "F" and job.chunk == 0:
+                out = self._layers(micro_x[job.mb])
+                loss = self._layers._loss_fn(out, micro_y[job.mb])
+                pending[job.mb] = loss
+                total += float(loss)
+            elif job.kind in ("B", "B_INPUT") and job.chunk == 0:
+                micro_loss = pending.pop(job.mb) / m
+                if scaler is not None:
+                    micro_loss = scaler.scale(micro_loss)
+                micro_loss.backward()
         if scaler is not None:
             scaler.step(optimizer)
             scaler.update()

@@ -1,0 +1,343 @@
+"""Named pipeline schedules: FThenB, 1F1B, interleaved (VPP), ZeroBubble.
+
+Reference: python/paddle/distributed/passes/pipeline_scheduler_pass/
+(pipeline_fthenb.py:35, pipeline_1f1b.py:39, pipeline_vpp.py:42,
+pipeline_zero_bubble.py:62) — each pass reorders a static program's
+micro-batch jobs into a per-rank instruction list. TPU-native framing:
+a schedule IS that deterministic job table. The table drives
+(a) the eager PipelineParallel runtime (real reordering of forward/
+backward micro-steps), and (b) analysis/tests (bubble accounting,
+dependency validation). The SPMD scan+ppermute engine
+(distributed.pipeline) realizes FThenB semantics inside one XLA program,
+where reverse-mode AD supplies the backward pipeline.
+
+Job kinds:
+  F(mb, chunk) — forward of microbatch `mb` through this rank's `chunk`
+  B(mb, chunk) — backward (input+weight grads; ZeroBubble splits it)
+  B_INPUT / B_WEIGHT — ZeroBubble's split backward (zero_bubble W jobs
+  are freely movable; scheduling them into the cooldown bubble is what
+  removes it — pipeline_zero_bubble.py:62 ZB-H1)
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+__all__ = ["Job", "PipelineSchedule", "FThenBSchedule", "OneFOneBSchedule",
+           "InterleavedSchedule", "ZeroBubbleSchedule", "get_schedule"]
+
+F = "F"
+B = "B"
+BI = "B_INPUT"
+BW = "B_WEIGHT"
+IDLE = "IDLE"
+
+
+@dataclasses.dataclass(frozen=True)
+class Job:
+    kind: str                 # F, B, B_INPUT, B_WEIGHT, IDLE
+    mb: int = -1              # microbatch index
+    chunk: int = 0            # virtual-stage chunk on this rank (VPP)
+
+    def __repr__(self):
+        c = f"c{self.chunk}" if self.chunk else ""
+        return f"{self.kind}{self.mb}{c}"
+
+
+class PipelineSchedule:
+    """Per-rank job tables for an S-stage, M-microbatch pipeline."""
+
+    name = "base"
+    num_chunks = 1
+
+    def __init__(self, num_stages: int, num_micro: int):
+        if num_micro < 1 or num_stages < 1:
+            raise ValueError("need >=1 stage and >=1 microbatch")
+        self.S = num_stages
+        self.M = num_micro
+
+    def jobs(self, rank: int) -> List[Job]:
+        raise NotImplementedError
+
+    # -- analysis ----------------------------------------------------------
+    def timeline(self) -> List[List[Job]]:
+        """jobs() per rank, padded to equal length with IDLE."""
+        per_rank = [self.jobs(r) for r in range(self.S)]
+        T = max(len(j) for j in per_rank)
+        return [j + [Job(IDLE)] * (T - len(j)) for j in per_rank]
+
+    def bubble_fraction(self) -> float:
+        tl = self.timeline()
+        total = sum(len(row) for row in tl)
+        idle = sum(1 for row in tl for j in row if j.kind == IDLE)
+        return idle / total if total else 0.0
+
+    def validate(self):
+        """Check cross-rank dataflow: F(mb) at virtual stage v needs
+        F(mb) at v-1 scheduled strictly earlier; B at v needs B at v+1
+        earlier plus this rank's own F(mb, v). One job per rank per tick
+        (the job list position IS the tick)."""
+        S, V = self.S, self.num_chunks
+        tl = self.timeline()
+        # tick of each (kind, mb, virtual_stage)
+        tick: Dict = {}
+        w_tick: Dict = {}
+        for r, row in enumerate(tl):
+            for t, j in enumerate(row):
+                if j.kind == IDLE:
+                    continue
+                v = j.chunk * S + r
+                if j.kind == BW:
+                    w_tick[(j.mb, v)] = t
+                    continue
+                kind = F if j.kind == F else B  # BI counts as B
+                key = (kind, j.mb, v)
+                if key in tick:
+                    raise AssertionError(f"duplicate job {key}")
+                tick[key] = t
+        for (mb, v), t in w_tick.items():
+            bt = tick.get((B, mb, v))
+            if bt is None or bt >= t:
+                raise AssertionError(
+                    f"{self.name}: W(mb={mb}) at stage {v} before its "
+                    f"B_INPUT")
+        depth = S * V
+        for (kind, mb, v), t in tick.items():
+            if kind == F and v > 0:
+                prev = tick.get((F, mb, v - 1))
+                if prev is None or prev >= t:
+                    raise AssertionError(
+                        f"{self.name}: F(mb={mb}) at stage {v} scheduled "
+                        f"tick {t} but stage {v-1} at {prev}")
+            if kind == B:
+                if v < depth - 1:
+                    nxt = tick.get((B, mb, v + 1))
+                    if nxt is None or nxt >= t:
+                        raise AssertionError(
+                            f"{self.name}: B(mb={mb}) at stage {v} tick "
+                            f"{t} but stage {v+1} at {nxt}")
+                fwd = tick.get((F, mb, v))
+                if fwd is None or fwd >= t:
+                    raise AssertionError(
+                        f"{self.name}: B(mb={mb}) stage {v} before its F")
+        return True
+
+
+class FThenBSchedule(PipelineSchedule):
+    """All forwards, then all backwards (pipeline_fthenb.py:35; GPipe).
+    Peak activation memory: M in-flight microbatches."""
+
+    name = "FThenB"
+
+    def jobs(self, rank: int) -> List[Job]:
+        out = [Job(IDLE)] * rank                      # fill
+        out += [Job(F, m) for m in range(self.M)]
+        # wait for the last stage's forwards + backward wave to arrive
+        out += [Job(IDLE)] * (2 * (self.S - 1 - rank))
+        out += [Job(B, m) for m in range(self.M)]
+        return out
+
+
+class OneFOneBSchedule(PipelineSchedule):
+    """1F1B (pipeline_1f1b.py:39): warmup forwards up to the in-flight
+    cap min(S-rank, M), then alternate 1F/1B, then cooldown backwards.
+    Peak activation memory: min(M, S-rank) microbatches — the reason it
+    replaces FThenB. Built by tick simulation so every cross-rank
+    dependency (activations down, cotangents up, one-tick transfer) holds
+    by construction."""
+
+    name = "1F1B"
+
+    def _cap(self, rank: int) -> int:
+        return min(self.S - rank, self.M)
+
+    def _build(self) -> List[List[Job]]:
+        if getattr(self, "_rows", None) is not None:
+            return self._rows
+        S, M = self.S, self.M
+        f_done: Dict = {}  # (mb, rank) -> completion tick
+        b_done: Dict = {}
+        rows: List[List[Job]] = [[] for _ in range(S)]
+        next_f = [0] * S
+        next_b = [0] * S
+        t = 0
+        while any(next_b[r] < M for r in range(S)):
+            if t > 6 * (M + S) + 8:
+                raise RuntimeError("1F1B scheduler did not converge")
+            new_jobs = []
+            for r in range(S):
+                job = None
+                m = next_b[r]
+                b_ready = (m < M and f_done.get((m, r), t) < t and
+                           (r == S - 1 or b_done.get((m, r + 1), t) < t))
+                in_flight = next_f[r] - next_b[r]
+                mf = next_f[r]
+                f_ready = (mf < M and in_flight < self._cap(r) and
+                           (r == 0 or f_done.get((mf, r - 1), t) < t))
+                if b_ready:
+                    job = Job(B, m)
+                    next_b[r] += 1
+                elif f_ready:
+                    job = Job(F, mf)
+                    next_f[r] += 1
+                new_jobs.append(job or Job(IDLE))
+                rows[r].append(new_jobs[-1])
+            for r, j in enumerate(new_jobs):
+                if j.kind == F:
+                    f_done[(j.mb, r)] = t
+                elif j.kind == B:
+                    b_done[(j.mb, r)] = t
+            t += 1
+        self._rows = rows
+        return rows
+
+    def jobs(self, rank: int) -> List[Job]:
+        return self._build()[rank]
+
+    def peak_live_microbatches(self, rank: int) -> int:
+        live = peak = 0
+        for j in self.jobs(rank):
+            if j.kind == F:
+                live += 1
+                peak = max(peak, live)
+            elif j.kind in (B, BI):
+                live -= 1
+        return peak
+
+
+class InterleavedSchedule(PipelineSchedule):
+    """Interleaved 1F1B / VPP (pipeline_vpp.py:42; Megatron interleaving):
+    each rank hosts `num_chunks` virtual stages (chunk c of rank r is
+    global stage c*S + r); microbatches are fed in groups of S so every
+    rank starts useful work after only `rank` ticks — the fill bubble
+    shrinks by ~1/num_chunks in time units since each tick is 1/V of a
+    full stage."""
+
+    name = "VPP"
+
+    def __init__(self, num_stages: int, num_micro: int,
+                 num_chunks: int = 2):
+        super().__init__(num_stages, num_micro)
+        if num_micro % num_stages:
+            raise ValueError("interleaved schedule needs M % S == 0 "
+                             "(Megatron constraint)")
+        self.num_chunks = num_chunks
+
+    def _forward_order(self) -> List[Job]:
+        """Chunk-major in groups of S microbatches: mbs 0..S-1 through
+        chunk 0, then 0..S-1 through chunk 1, ..., then next group."""
+        order = []
+        for g in range(0, self.M, self.S):
+            for c in range(self.num_chunks):
+                for m in range(g, min(g + self.S, self.M)):
+                    order.append((m, c))
+        return order
+
+    def _build(self) -> List[List[Job]]:
+        """Greedy simulation against cross-rank readiness, chunk-major
+        feed policy (the reference pass emits a precomputed ordering;
+        this derives a dependency-correct one from the same policy)."""
+        if getattr(self, "_rows", None) is not None:
+            return self._rows
+        S, V, M = self.S, self.num_chunks, self.M
+        depth = S * V
+        f_order = {r: list(self._forward_order()) for r in range(S)}
+        f_done: Dict = {}   # (mb, v) -> tick completed
+        b_done: Dict = {}
+        b_count = {r: 0 for r in range(S)}
+        rows: List[List[Job]] = [[] for _ in range(S)]
+        t = 0
+        max_ticks = 4 * (depth + V * M) + 8
+        while (any(f_order[r] for r in range(S)) or
+               any(b_count[r] < V * M for r in range(S))):
+            if t > max_ticks:
+                raise RuntimeError(
+                    "interleaved scheduler did not converge")
+            new_jobs = []
+            for r in range(S):
+                job = None
+                # prefer a ready backward (bounds live activations),
+                # deepest chunk first
+                for c in reversed(range(V)):
+                    v = c * S + r
+                    for m in range(M):
+                        if (m, v) in b_done:
+                            continue
+                        if f_done.get((m, v), t) >= t:
+                            continue
+                        if v == depth - 1 or \
+                                b_done.get((m, v + 1), t) < t:
+                            job = Job(B, m, c)
+                            break
+                    if job:
+                        break
+                if job is None and f_order[r]:
+                    m, c = f_order[r][0]
+                    v = c * S + r
+                    if v == 0 or f_done.get((m, v - 1), t) < t:
+                        f_order[r].pop(0)
+                        job = Job(F, m, c)
+                new_jobs.append(job or Job(IDLE))
+                rows[r].append(new_jobs[-1])
+            # commit completions at end of tick (same-tick sends land
+            # next tick, matching the ppermute/isend semantics)
+            for r, j in enumerate(new_jobs):
+                if j.kind == F:
+                    f_done[(j.mb, j.chunk * S + r)] = t
+                elif j.kind == B:
+                    b_done[(j.mb, j.chunk * S + r)] = t
+                    b_count[r] += 1
+            t += 1
+        self._rows = rows
+        return rows
+
+    def jobs(self, rank: int) -> List[Job]:
+        return self._build()[rank]
+
+
+class ZeroBubbleSchedule(OneFOneBSchedule):
+    """ZB-H1 (pipeline_zero_bubble.py:62,:151): split each backward into
+    B_INPUT (activation grads — on the critical path to the previous
+    stage) and B_WEIGHT (weight grads — free to move). B_INPUT keeps the
+    1F1B position; B_WEIGHT jobs drop into what was the cooldown bubble,
+    so the tail bubble disappears."""
+
+    name = "ZeroBubble"
+
+    def jobs(self, rank: int) -> List[Job]:
+        base = super().jobs(rank)
+        out: List[Job] = []
+        pending_w: List[Job] = []
+        for j in base:
+            if j.kind == B:
+                out.append(Job(BI, j.mb, j.chunk))
+                pending_w.append(Job(BW, j.mb, j.chunk))
+            elif j.kind == IDLE and pending_w:
+                out.append(pending_w.pop(0))  # fill bubbles with W work
+            else:
+                out.append(j)
+        out.extend(pending_w)
+        return out
+
+
+_SCHEDULES = {
+    "FThenB": FThenBSchedule,
+    "F-then-B": FThenBSchedule,
+    "1F1B": OneFOneBSchedule,
+    "VPP": InterleavedSchedule,
+    "ZBH1": ZeroBubbleSchedule,
+    "ZeroBubble": ZeroBubbleSchedule,
+}
+
+
+def get_schedule(name: str, num_stages: int, num_micro: int,
+                 num_chunks: int = 2) -> PipelineSchedule:
+    """Factory matching the reference's strategy switch
+    (pipeline_scheduler_pass/__init__.py apply_pass pipeline_mode)."""
+    cls = _SCHEDULES.get(name)
+    if cls is None:
+        raise ValueError(f"unknown pipeline schedule {name!r}; "
+                         f"choose from {sorted(set(_SCHEDULES))}")
+    if cls is InterleavedSchedule:
+        return cls(num_stages, num_micro, num_chunks)
+    return cls(num_stages, num_micro)

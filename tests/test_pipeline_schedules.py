@@ -1,0 +1,176 @@
+"""Named pipeline-schedule tests.
+
+Models the reference's pipeline-pass tests
+(test/distributed_passes/test_pipeline_scheduler_*.py): every schedule
+must be dependency-correct across ranks, and the schedules must keep
+their defining properties (1F1B bounded memory, ZeroBubble's W-filled
+cooldown, VPP's smaller bubble, identical numerics between schedules).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.pipeline_schedules import (
+    FThenBSchedule, InterleavedSchedule, OneFOneBSchedule,
+    ZeroBubbleSchedule, get_schedule)
+
+
+@pytest.mark.parametrize("S,M", [(1, 4), (2, 4), (4, 8), (3, 6), (4, 4)])
+def test_fthenb_and_1f1b_valid(S, M):
+    for cls in (FThenBSchedule, OneFOneBSchedule, ZeroBubbleSchedule):
+        sched = cls(S, M)
+        assert sched.validate()
+
+
+@pytest.mark.parametrize("S,M,V", [(2, 4, 2), (4, 8, 2), (2, 6, 3)])
+def test_interleaved_valid(S, M, V):
+    sched = InterleavedSchedule(S, M, num_chunks=V)
+    assert sched.validate()
+    # every rank runs V forwards and V backwards per microbatch
+    for r in range(S):
+        jobs = sched.jobs(r)
+        assert sum(j.kind == "F" for j in jobs) == V * M
+        assert sum(j.kind == "B" for j in jobs) == V * M
+
+
+def test_1f1b_memory_bounded():
+    """1F1B's reason to exist: live microbatches <= S - rank, while
+    FThenB holds all M (fleet pipeline_parallel.py:575 vs GPipe)."""
+    S, M = 4, 16
+    sched = OneFOneBSchedule(S, M)
+    for r in range(S):
+        assert sched.peak_live_microbatches(r) <= S - r
+    f_then_b = FThenBSchedule(S, M)
+    live = peak = 0
+    for j in f_then_b.jobs(0):
+        if j.kind == "F":
+            live += 1
+            peak = max(peak, live)
+        elif j.kind == "B":
+            live -= 1
+    assert peak == M
+
+
+def test_zero_bubble_fills_cooldown():
+    """ZB-H1: the idle slots of 1F1B get W jobs; total idle strictly
+    drops (pipeline_zero_bubble.py's point)."""
+    S, M = 4, 8
+    zb = ZeroBubbleSchedule(S, M)
+    base = OneFOneBSchedule(S, M)
+    assert zb.validate()
+    assert zb.bubble_fraction() < base.bubble_fraction()
+    # every microbatch got its split B_INPUT + B_WEIGHT on every rank
+    for r in range(S):
+        jobs = zb.jobs(r)
+        assert sum(j.kind == "B_INPUT" for j in jobs) == M
+        assert sum(j.kind == "B_WEIGHT" for j in jobs) == M
+
+
+def test_vpp_shrinks_fill_bubble():
+    """Interleaving starts every rank after ~rank ticks instead of
+    waiting a full stage per hop; with ticks 1/V of a stage the fill
+    bubble shrinks in time units (Megatron interleaved schedule)."""
+    S, M, V = 4, 8, 2
+    vpp = InterleavedSchedule(S, M, num_chunks=V)
+    gpipe = FThenBSchedule(S, M)
+    # time units: a VPP tick is 1/V of a full-stage tick
+    vpp_tl = vpp.timeline()
+    gp_tl = gpipe.timeline()
+    vpp_time = len(vpp_tl[0]) / V
+    gp_time = len(gp_tl[0])
+    assert vpp_time < gp_time
+
+
+def test_get_schedule_factory():
+    s = get_schedule("1F1B", 2, 4)
+    assert isinstance(s, OneFOneBSchedule)
+    assert isinstance(get_schedule("FThenB", 2, 4), FThenBSchedule)
+    assert isinstance(get_schedule("ZBH1", 2, 4), ZeroBubbleSchedule)
+    assert isinstance(get_schedule("VPP", 2, 4), InterleavedSchedule)
+    with pytest.raises(ValueError):
+        get_schedule("nope", 2, 4)
+    with pytest.raises(ValueError):
+        InterleavedSchedule(4, 6, 2)  # M % S != 0
+
+
+def _tiny_pipeline(seed=0):
+    from paddle_tpu.distributed.fleet.meta_parallel import (PipelineLayer,
+                                                            PipelineParallel)
+    paddle.seed(seed)
+    layers = PipelineLayer(
+        [paddle.nn.Linear(8, 16), paddle.nn.ReLU(),
+         paddle.nn.Linear(16, 8), paddle.nn.Linear(8, 1)],
+        num_stages=2,
+        loss_fn=lambda out, y: ((out - y) ** 2).mean())
+    return layers
+
+
+@pytest.mark.parametrize("mode", ["FThenB", "1F1B", "ZeroBubble"])
+def test_eager_runtime_schedules_same_numerics(mode):
+    """All schedules produce identical grads/updates — ordering only
+    changes memory/overlap (reference acc-align tests' contract)."""
+    from paddle_tpu.distributed.fleet.meta_parallel import PipelineParallel
+
+    class Strat:
+        pipeline_configs = {"accumulate_steps": 4, "schedule_mode": mode}
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(8, 8).astype("float32"))
+    y = paddle.to_tensor(rng.randn(8, 1).astype("float32"))
+
+    layers = _tiny_pipeline()
+    pp = PipelineParallel(layers, hcg=None, strategy=Strat())
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=layers.parameters())
+    loss = pp.train_batch((x, y), opt)
+    w_after = [p.numpy().copy() for p in layers.parameters()]
+
+    # reference run: plain FThenB
+    class Strat2:
+        pipeline_configs = {"accumulate_steps": 4,
+                            "schedule_mode": "FThenB"}
+
+    layers2 = _tiny_pipeline()
+    pp2 = PipelineParallel(layers2, hcg=None, strategy=Strat2())
+    opt2 = paddle.optimizer.SGD(learning_rate=0.1,
+                                parameters=layers2.parameters())
+    loss2 = pp2.train_batch((x, y), opt2)
+    np.testing.assert_allclose(float(loss), float(loss2), rtol=1e-6)
+    for a, b in zip(w_after, [p.numpy() for p in layers2.parameters()]):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-7)
+
+
+def test_train_batch_with_grad_scaler():
+    """scaler path: loss is scaled before backward so scaler.step's
+    unscale restores true grads (update magnitude matches no-scaler)."""
+    from paddle_tpu.distributed.fleet.meta_parallel import PipelineParallel
+
+    class Strat:
+        pipeline_configs = {"accumulate_steps": 2,
+                            "schedule_mode": "1F1B"}
+
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.randn(4, 8).astype("float32"))
+    y = paddle.to_tensor(rng.randn(4, 1).astype("float32"))
+
+    results = []
+    for use_scaler in (False, True):
+        layers = _tiny_pipeline()
+        pp = PipelineParallel(layers, hcg=None, strategy=Strat())
+        opt = paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=layers.parameters())
+        scaler = paddle.amp.GradScaler() if use_scaler else None
+        pp.train_batch((x, y), opt, scaler=scaler)
+        results.append([p.numpy().copy() for p in layers.parameters()])
+    for a, b in zip(*results):
+        np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6)
+
+
+def test_bad_schedule_mode_raises_at_construction():
+    from paddle_tpu.distributed.fleet.meta_parallel import PipelineParallel
+
+    class Strat:
+        pipeline_configs = {"accumulate_steps": 2, "schedule_mode": "1f1b"}
+
+    with pytest.raises(ValueError):
+        PipelineParallel(_tiny_pipeline(), hcg=None, strategy=Strat())
