@@ -35,6 +35,8 @@ from . import distribution  # noqa: F401
 from . import incubate  # noqa: F401
 from . import distributed  # noqa: F401
 from . import static  # noqa: F401
+from . import cost_model  # noqa: F401
+from . import decomposition  # noqa: F401
 from . import sparse  # noqa: F401
 from . import quantization  # noqa: F401
 from . import audio  # noqa: F401

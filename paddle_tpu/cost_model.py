@@ -1,0 +1,138 @@
+"""Cost model: op/program/communication time estimation.
+
+Reference: python/paddle/cost_model/cost_model.py (CostModel with static
+op-cost tables + profile_measure) and the auto-parallel comm/op cost
+library (python/paddle/distributed/auto_parallel/static/cost/) used by
+the planner and auto-tuner pruning. TPU-native: analytic roofline costs
+(FLOPs / peak, bytes / HBM bandwidth, collective bytes / ICI bandwidth)
+plus measured costs by timing the jitted program — XLA's compiled
+executable replaces the reference's per-op benchmark tables.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = ["CostModel", "CommCostModel", "measure_program"]
+
+# v5e-class defaults; overridable per instance
+DEFAULT_PEAK_FLOPS = 197e12       # bf16 FLOP/s
+DEFAULT_HBM_BW = 819e9            # bytes/s
+DEFAULT_ICI_BW = 4.5e10           # bytes/s per link (one direction)
+DEFAULT_DCN_BW = 1.25e10          # bytes/s
+
+
+class CostModel:
+    """Analytic + measured op/program costs (cost_model.py analog)."""
+
+    def __init__(self, peak_flops: float = DEFAULT_PEAK_FLOPS,
+                 hbm_bandwidth: float = DEFAULT_HBM_BW):
+        self.peak_flops = peak_flops
+        self.hbm_bw = hbm_bandwidth
+
+    # -- analytic ----------------------------------------------------------
+    def matmul_flops(self, m: int, k: int, n: int,
+                     batch: int = 1) -> float:
+        return 2.0 * batch * m * k * n
+
+    def conv2d_flops(self, n, cin, h, w, cout, kh, kw,
+                     stride=1, groups=1) -> float:
+        oh, ow = h // stride, w // stride
+        return 2.0 * n * oh * ow * cout * (cin // groups) * kh * kw
+
+    def op_time(self, flops: float = 0.0, bytes_moved: float = 0.0,
+                flops_util: float = 0.5) -> float:
+        """Roofline: max of compute time and memory time, seconds."""
+        t_c = flops / (self.peak_flops * flops_util) if flops else 0.0
+        t_m = bytes_moved / self.hbm_bw if bytes_moved else 0.0
+        return max(t_c, t_m)
+
+    def static_op_time(self, op_name: str, inputs_numel: int = 0,
+                       dtype_bytes: int = 4,
+                       flops: Optional[float] = None) -> float:
+        """Coarse per-op table for planner pruning: elementwise ops are
+        bandwidth-bound (one read+write pass); compute-bound ops require
+        their FLOP count (via matmul_flops/conv2d_flops) — returning 0
+        would make planners prefer matmul-heavy plans as free."""
+        if op_name in ("matmul", "conv2d", "conv3d", "einsum"):
+            if flops is None:
+                raise ValueError(
+                    f"'{op_name}' is compute-bound; pass flops= (see "
+                    f"matmul_flops/conv2d_flops)")
+            return self.op_time(
+                flops=flops,
+                bytes_moved=inputs_numel * dtype_bytes)
+        return self.op_time(bytes_moved=2 * inputs_numel * dtype_bytes)
+
+    # -- measured ----------------------------------------------------------
+    def profile_measure(self, run_fn, warmup: int = 2,
+                        iters: int = 5) -> float:
+        """Median wall time of a callable (the jitted program is the
+        cost model on real hardware); returns seconds."""
+        import jax
+
+        def sync(o):
+            jax.tree.map(
+                lambda a: np.asarray(jax.device_get(a))
+                if hasattr(a, "dtype") else a, o)
+
+        for _ in range(warmup):
+            sync(run_fn())  # drain async dispatch before timing starts
+        ts = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            sync(run_fn())
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts))
+
+
+class CommCostModel:
+    """Collective time estimates over the mesh fabric
+    (auto_parallel/static/cost/comm_op_cost.py analog, ring algorithm)."""
+
+    def __init__(self, bandwidth: float = DEFAULT_ICI_BW,
+                 latency_s: float = 1e-6):
+        self.bw = bandwidth
+        self.latency = latency_s
+
+    def all_reduce(self, nbytes: float, n: int) -> float:
+        if n <= 1:
+            return 0.0
+        return 2.0 * (n - 1) / n * nbytes / self.bw + \
+            2 * (n - 1) * self.latency
+
+    def all_gather(self, nbytes_per_rank: float, n: int) -> float:
+        if n <= 1:
+            return 0.0
+        return (n - 1) * nbytes_per_rank / self.bw + \
+            (n - 1) * self.latency
+
+    def reduce_scatter(self, nbytes_total: float, n: int) -> float:
+        if n <= 1:
+            return 0.0
+        return (n - 1) / n * nbytes_total / self.bw + \
+            (n - 1) * self.latency
+
+    def all_to_all(self, nbytes_per_rank: float, n: int) -> float:
+        if n <= 1:
+            return 0.0
+        return (n - 1) / n * nbytes_per_rank / self.bw + \
+            (n - 1) * self.latency
+
+    def p2p(self, nbytes: float) -> float:
+        return nbytes / self.bw + self.latency
+
+
+def measure_program(program, feed: Dict[str, Any], fetch_list,
+                    executor=None, warmup: int = 1,
+                    iters: int = 3) -> float:
+    """Median run time of a static Program (profile_measure over the
+    Executor; the reference profiles per-op via its cost model ops)."""
+    from .static import Executor
+    exe = executor or Executor()
+    cm = CostModel()
+    return cm.profile_measure(
+        lambda: exe.run(program, feed=feed, fetch_list=fetch_list),
+        warmup=warmup, iters=iters)

@@ -1,0 +1,41 @@
+"""Op decomposition API (paddle.decomposition compat).
+
+Reference: python/paddle/decomposition/decomp.py — rewrites composite ops
+(batch_norm, dropout, gelu, ...) in a PIR program into primitive ops so
+the CINN compiler and higher-order AD see a closed primitive set.
+
+TPU-native: there is nothing to decompose — every op in this framework
+is already expressed as jax primitives at record time, and XLA/StableHLO
+is the closed primitive set (jax.jvp/grad compose on it directly, cf.
+incubate.autograd). The API is kept so reference code importing
+paddle.decomposition keeps working; ``decompose`` verifies its inputs
+and returns the program's ops unchanged.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+__all__ = ["decompose", "decomp_ops_contain"]
+
+# ops the reference decomposes (decomp_rule registry) — informational
+_REFERENCE_DECOMPOSED = {
+    "batch_norm", "layer_norm", "dropout", "gelu", "silu", "softmax",
+    "mean", "pow", "relu", "rsqrt", "sigmoid", "squeeze", "stack",
+    "unsqueeze", "full_like", "instance_norm", "group_norm",
+}
+
+
+def decomp_ops_contain(op_name: str) -> bool:
+    return op_name in _REFERENCE_DECOMPOSED
+
+
+def decompose(program, src_vars: Optional[Sequence] = None,
+              blacklist: Optional[Sequence[str]] = None,
+              whitelist: Optional[Sequence[str]] = None):
+    """No-op pass-through: recorded ops are jax-primitive closures, the
+    decomposed form by construction. Returns ``src_vars`` (or the
+    program) unchanged, matching the reference signature."""
+    from .static.graph import Program
+    if program is not None and not isinstance(program, Program):
+        raise TypeError("decompose expects a paddle_tpu.static.Program")
+    return list(src_vars) if src_vars is not None else program

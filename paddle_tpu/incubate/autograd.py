@@ -1,0 +1,175 @@
+"""Higher-order / functional autograd (the "prim" system analog).
+
+Reference: python/paddle/incubate/autograd/ — functional.py (jvp, vjp,
+Jacobian, Hessian), primapi.py (forward_grad/grad over the primitive-op
+program), plus paddle/fluid/prim composite gradient rules. The reference
+needs a whole primitive-op dialect because its eager kernels have no
+forward-mode rules; here every op IS a jax primitive with jvp/transpose
+rules, so forward-mode, reverse-mode, and arbitrary composition
+(hessian = jacfwd(jacrev)) come directly from the transform stack.
+enable_prim/disable_prim exist for API compat and are no-ops: XLA always
+sees decomposed primitives.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+
+__all__ = ["jvp", "vjp", "Jacobian", "Hessian", "forward_grad", "grad",
+           "enable_prim", "disable_prim", "prim_enabled"]
+
+_prim_flag = [False]
+
+
+def enable_prim():
+    _prim_flag[0] = True
+
+
+def disable_prim():
+    _prim_flag[0] = False
+
+
+def prim_enabled() -> bool:
+    return _prim_flag[0]
+
+
+def _as_tuple(x):
+    return tuple(x) if isinstance(x, (tuple, list)) else (x,)
+
+
+def _unwrap(xs):
+    return tuple(x._data if isinstance(x, Tensor) else jnp.asarray(x)
+                 for x in _as_tuple(xs))
+
+
+def _wrap(arrs):
+    out = tuple(Tensor(a) for a in arrs)
+    return out if len(out) > 1 else out[0]
+
+
+def _pure(func: Callable) -> Callable:
+    """Lift a Tensor-level function to operate on raw arrays."""
+    def fn(*arrs):
+        outs = func(*[Tensor(a) for a in arrs])
+        outs = _as_tuple(outs)
+        arrs_out = tuple(o._data if isinstance(o, Tensor)
+                         else jnp.asarray(o) for o in outs)
+        return arrs_out if len(arrs_out) > 1 else arrs_out[0]
+    return fn
+
+
+def jvp(func: Callable, xs, v=None):
+    """Forward-mode: returns (func(xs), J @ v)
+    (incubate/autograd/functional.py jvp contract; v defaults to ones)."""
+    arrs = _unwrap(xs)
+    if v is None:
+        tangents = tuple(jnp.ones_like(a) for a in arrs)
+    else:
+        tangents = tuple(t.astype(a.dtype) for t, a in
+                         zip(_unwrap(v), arrs))
+    out, tangent_out = jax.jvp(_pure(func), arrs, tangents)
+    return (_wrap(_as_tuple(out)), _wrap(_as_tuple(tangent_out)))
+
+
+def vjp(func: Callable, xs, v=None):
+    """Reverse-mode: returns (func(xs), v^T @ J)
+    (functional.py vjp; v defaults to ones over the output)."""
+    arrs = _unwrap(xs)
+    out, vjp_fn = jax.vjp(_pure(func), *arrs)
+    outs = _as_tuple(out)
+    if v is None:
+        cot = tuple(jnp.ones_like(o) for o in outs)
+    else:
+        cot = tuple(c.astype(o.dtype) for c, o in zip(_unwrap(v), outs))
+    grads = vjp_fn(cot if len(outs) > 1 else cot[0])
+    return (_wrap(outs), _wrap(grads))
+
+
+class Jacobian:
+    """Lazy Jacobian (functional.py Jacobian): J[i, j] semantics over
+    flattened output/input; computed with jacrev (reverse-mode, right for
+    wide inputs) the first time it is materialized."""
+
+    def __init__(self, func: Callable, xs, is_batched: bool = False):
+        self._func = func
+        self._xs = xs
+        self._is_batched = is_batched
+        self._mat: Optional[np.ndarray] = None
+
+    def _compute(self) -> np.ndarray:
+        arrs = _unwrap(self._xs)
+        if len(arrs) != 1:
+            raise NotImplementedError("Jacobian over one input tensor")
+        a = arrs[0]
+        if self._is_batched:
+            # func is defined on batched input; per-sample jacobian is
+            # the batch diagonal of the full one: jac has shape
+            # [B, out..., B, in...] -> diagonal over the two batch axes
+            jac = jnp.asarray(jax.jacrev(_pure(self._func))(a))
+            out_nd = jac.ndim - a.ndim
+            diag = jnp.diagonal(jac, axis1=0, axis2=out_nd)
+            self._mat = np.asarray(jnp.moveaxis(diag, -1, 0))
+        else:
+            jac = jnp.asarray(jax.jacrev(_pure(self._func))(a))
+            out_sz = int(np.prod(jac.shape[:jac.ndim - a.ndim])) \
+                if a.ndim else jac.size
+            self._mat = np.asarray(jac).reshape(out_sz, a.size) \
+                if a.ndim else np.asarray(jac)
+        return self._mat
+
+    @property
+    def shape(self):
+        if self._mat is not None:
+            return self._mat.shape
+        # derive without materializing (jacrev can cost seconds)
+        a = _unwrap(self._xs)[0]
+        out = jax.eval_shape(_pure(self._func), jax.ShapeDtypeStruct(
+            a.shape, a.dtype))
+        out_shape = out.shape if hasattr(out, "shape") else ()
+        if self._is_batched:
+            return tuple([a.shape[0]] + list(out_shape[1:]) +
+                         list(a.shape[1:]))
+        out_sz = int(np.prod(out_shape)) if out_shape else 1
+        return (out_sz, int(np.prod(a.shape)) if a.shape else 1)
+
+    def __getitem__(self, idx):
+        if self._mat is None:
+            self._compute()
+        return Tensor(self._mat[idx])
+
+    def numpy(self):
+        if self._mat is None:
+            self._compute()
+        return self._mat
+
+
+class Hessian(Jacobian):
+    """Hessian of a scalar-output function (functional.py Hessian)."""
+
+    def _compute(self) -> np.ndarray:
+        arrs = _unwrap(self._xs)
+        if len(arrs) != 1:
+            raise NotImplementedError("Hessian over one input tensor")
+        a = arrs[0]
+        h = jax.hessian(_pure(self._func))(a)
+        self._mat = np.asarray(jnp.asarray(h)).reshape(a.size, a.size)
+        return self._mat
+
+
+def forward_grad(outputs, inputs, grad_inputs=None):
+    """primapi.forward_grad analog for static-graph Variables is not
+    needed — use jvp on the function instead."""
+    raise NotImplementedError(
+        "forward_grad over recorded programs is superseded by "
+        "incubate.autograd.jvp(func, xs)")
+
+
+def grad(outputs, inputs, grad_outputs=None):
+    """primapi.grad compat: delegates to paddle.autograd.grad."""
+    from ..autograd import grad as eager_grad
+    return eager_grad(outputs, inputs, grad_outputs)

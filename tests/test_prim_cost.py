@@ -1,0 +1,126 @@
+"""incubate.autograd (prim analog), cost_model, decomposition tests.
+
+Models the reference's test/autograd/ (jvp/vjp/Jacobian/Hessian) and
+test/cost_model/ suites.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.incubate import autograd as iag
+
+
+def test_jvp_matches_analytic():
+    x = paddle.to_tensor(np.array([1.0, 2.0, 3.0], "float32"))
+    v = paddle.to_tensor(np.array([1.0, 0.0, 0.0], "float32"))
+    out, jv = iag.jvp(lambda a: a ** 2, x, v)
+    np.testing.assert_allclose(out.numpy(), [1, 4, 9], rtol=1e-6)
+    np.testing.assert_allclose(jv.numpy(), [2, 0, 0], rtol=1e-6)
+
+
+def test_vjp_matches_analytic():
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    out, gx = iag.vjp(lambda a: (a ** 3).sum(), x)
+    np.testing.assert_allclose(float(out), 9.0, rtol=1e-6)
+    np.testing.assert_allclose(gx.numpy(), 3 * x.numpy() ** 2, rtol=1e-6)
+
+
+def test_jacobian_and_hessian():
+    x = paddle.to_tensor(np.array([1.0, 2.0], "float32"))
+    jac = iag.Jacobian(lambda a: a ** 2, x)
+    np.testing.assert_allclose(jac[:].numpy(), np.diag([2.0, 4.0]),
+                               rtol=1e-6)
+    hes = iag.Hessian(lambda a: (a ** 3).sum(), x)
+    np.testing.assert_allclose(hes[:].numpy(), np.diag([6.0, 12.0]),
+                               rtol=1e-5)
+
+
+def test_jvp_through_a_layer():
+    paddle.seed(0)
+    lin = paddle.nn.Linear(3, 2)
+    x = paddle.to_tensor(np.ones((1, 3), "float32"))
+    v = paddle.to_tensor(np.ones((1, 3), "float32"))
+    out, jv = iag.jvp(lambda a: lin(a), x, v)
+    # linear map: J @ v = W^T v summed = v @ W
+    np.testing.assert_allclose(jv.numpy(), np.ones((1, 3)) @ lin.weight.numpy(),
+                               rtol=1e-5)
+
+
+def test_prim_flags_and_grad():
+    iag.enable_prim()
+    assert iag.prim_enabled()
+    iag.disable_prim()
+    assert not iag.prim_enabled()
+    x = paddle.to_tensor(np.array([2.0], "float32"))
+    x.stop_gradient = False
+    y = (x ** 2).sum()
+    (g,) = iag.grad(y, [x])
+    np.testing.assert_allclose(g.numpy(), [4.0], rtol=1e-6)
+
+
+def test_cost_model_analytic():
+    from paddle_tpu.cost_model import CommCostModel, CostModel
+    cm = CostModel(peak_flops=100e12, hbm_bandwidth=800e9)
+    assert cm.matmul_flops(128, 256, 512) == 2 * 128 * 256 * 512
+    # big matmul is compute bound; elementwise op is bandwidth bound
+    t_mm = cm.op_time(flops=2 * 4096 ** 3, bytes_moved=3 * 4096 ** 2 * 2)
+    assert t_mm == pytest.approx(2 * 4096 ** 3 / (100e12 * 0.5))
+    ccm = CommCostModel(bandwidth=1e10, latency_s=0)
+    # ring allreduce: 2(n-1)/n * bytes / bw
+    assert ccm.all_reduce(1e9, 4) == pytest.approx(2 * 3 / 4 * 1e9 / 1e10)
+    assert ccm.all_reduce(1e9, 1) == 0.0
+    assert ccm.all_gather(1e6, 8) > ccm.p2p(1e6)
+
+
+def test_measure_program():
+    from paddle_tpu.cost_model import measure_program
+    paddle.enable_static()
+    try:
+        main = paddle.static.Program()
+        with paddle.static.program_guard(main):
+            x = paddle.static.data("x", [None, 32], "float32")
+            y = paddle.static.nn.fc(x, 32)
+        t = measure_program(main, {"x": np.ones((8, 32), "f4")}, [y])
+        assert 0 < t < 10.0
+    finally:
+        paddle.disable_static()
+
+
+def test_decomposition_shim():
+    from paddle_tpu import decomposition
+    assert decomposition.decomp_ops_contain("batch_norm")
+    assert not decomposition.decomp_ops_contain("matmul")
+    paddle.enable_static()
+    try:
+        p = paddle.static.Program()
+        assert decomposition.decompose(p) is p
+        with pytest.raises(TypeError):
+            decomposition.decompose(object())
+    finally:
+        paddle.disable_static()
+
+
+def test_batched_jacobian():
+    x = paddle.to_tensor(np.arange(24, dtype="float32").reshape(2, 3, 4))
+    jac = iag.Jacobian(lambda a: (a ** 2).sum(axis=(1, 2)), x,
+                       is_batched=True)
+    assert jac.shape == (2, 3, 4)
+    np.testing.assert_allclose(jac[:].numpy(), 2 * x.numpy(), rtol=1e-6)
+
+
+def test_jacobian_shape_without_materialize():
+    x = paddle.to_tensor(np.ones((3,), "float32"))
+    jac = iag.Jacobian(lambda a: a * 2.0, x)
+    assert jac.shape == (3, 3)
+    assert jac._mat is None  # shape derived via eval_shape, not jacrev
+
+
+def test_static_op_time_compute_bound_requires_flops():
+    from paddle_tpu.cost_model import CostModel
+    cm = CostModel()
+    with pytest.raises(ValueError):
+        cm.static_op_time("matmul", inputs_numel=1 << 20)
+    t = cm.static_op_time("matmul", inputs_numel=1 << 20,
+                          flops=cm.matmul_flops(512, 512, 512))
+    assert t > 0
+    assert cm.static_op_time("add", inputs_numel=1 << 20) > 0
