@@ -8,9 +8,10 @@ from .env import (ParallelEnv, get_rank, get_world_size, init_parallel_env,
                   is_initialized)
 from .process_mesh import ProcessMesh, auto_mesh, get_mesh, set_mesh
 from .placements import Partial, Placement, Replicate, Shard
-from .api import (ShardingStage1, ShardingStage2, ShardingStage3,
-                  dtensor_from_fn, get_placements, reshard, shard_layer,
-                  shard_optimizer, shard_tensor, unshard_dtensor)
+from .api import (DistModel, ShardingStage1, ShardingStage2,
+                  ShardingStage3, dtensor_from_fn, get_placements,
+                  reshard, shard_layer, shard_optimizer, shard_tensor,
+                  to_static, unshard_dtensor)
 from .collective import (Group, ReduceOp, all_gather, all_gather_object,
                          all_reduce, all_to_all, all_to_all_single, barrier,
                          broadcast, get_group, irecv, isend, new_group,
@@ -33,6 +34,7 @@ __all__ = [
     "is_initialized", "ProcessMesh", "auto_mesh", "get_mesh", "set_mesh",
     "Partial", "Placement", "Replicate", "Shard", "shard_tensor", "reshard",
     "shard_layer", "shard_optimizer", "dtensor_from_fn", "unshard_dtensor",
+    "DistModel", "to_static",
     "get_placements", "ShardingStage1", "ShardingStage2", "ShardingStage3",
     "Group", "ReduceOp", "new_group", "get_group", "all_reduce",
     "all_gather", "all_gather_object", "all_to_all", "all_to_all_single",

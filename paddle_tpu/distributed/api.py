@@ -25,6 +25,7 @@ from .placements import (Partial, Placement, Replicate, Shard,
 from .process_mesh import ProcessMesh, get_mesh
 
 __all__ = ["shard_tensor", "reshard", "shard_layer", "shard_optimizer",
+           "DistModel", "to_static",
            "dtensor_from_fn", "unshard_dtensor", "get_placements",
            "ShardingStage1", "ShardingStage2", "ShardingStage3"]
 
@@ -199,3 +200,96 @@ def unshard_dtensor(x: Tensor) -> Tensor:
     if mesh is None:
         return x
     return reshard(x, mesh, [Replicate() for _ in range(mesh.ndim)])
+
+
+class DistModel:
+    """Jitted distributed train/eval/predict wrapper
+    (auto_parallel/api.py:2132 DistModel).
+
+    The reference compiles the layer into a per-rank PIR program through
+    the static Engine (engine.py _parallel_pir); here the layer's
+    parameters already carry NamedShardings (shard_tensor/shard_layer),
+    so one jitted step — forward + grad + optimizer update via
+    jit.functional.TrainStep — IS the parallelized program: GSPMD
+    partitions it and inserts the collectives the reference's partition/
+    reshard passes emit. Batches are sharded over the mesh's first axis
+    (the data axis by convention).
+    """
+
+    def __init__(self, layer, loader=None, loss=None, optimizer=None,
+                 strategy=None, metrics=None):
+        self.network = layer
+        self._loss = loss
+        self._opt = optimizer
+        self._mode = "train" if (loss is not None and
+                                 optimizer is not None) else (
+            "eval" if loss is not None else "predict")
+        self._step = None  # train mode: the jitted TrainStep
+        # eval/predict run the eager forward: jit them per-user-need with
+        # paddle.jit.to_static(layer); only the train step is fused here
+
+    # -- mode switches (reference DistModel contract) ---------------------
+    def train(self):
+        if self._loss is None or self._opt is None:
+            raise RuntimeError("DistModel needs loss and optimizer for "
+                               "train mode (pass them to dist.to_static)")
+        self._mode = "train"
+        self.network.train()
+        return self
+
+    def eval(self):
+        if self._loss is None:
+            raise RuntimeError("DistModel needs a loss for eval mode")
+        self._mode = "eval"
+        self.network.eval()
+        return self
+
+    def predict(self):
+        self._mode = "predict"
+        self.network.eval()
+        return self
+
+    def _shard_batch(self, x):
+        mesh = get_mesh()
+        if mesh is None or not isinstance(x, Tensor):
+            return x
+        jm = mesh.jax_mesh()
+        n = jm.shape[jm.axis_names[0]]
+        if x._data.ndim and x._data.shape[0] % n == 0:
+            return shard_tensor(
+                x, mesh, [Shard(0)] + [Replicate()] * (mesh.ndim - 1))
+        return x
+
+    def __call__(self, *data):
+        data = tuple(self._shard_batch(d) for d in data)
+        if self._mode == "train":
+            if self._step is None:
+                from ..jit.functional import TrainStep
+                self._step = TrainStep(self.network, self._opt,
+                                       self._loss)
+            return self._step(*data)
+        if self._mode == "eval":
+            with no_grad():
+                out = self.network(*data[:-1])
+                return self._loss(out, data[-1])
+        with no_grad():
+            return self.network(*data)
+
+    def state_dict(self, *a, **k):
+        return self.network.state_dict(*a, **k)
+
+    def set_state_dict(self, sd, *a, **k):
+        return self.network.set_state_dict(sd, *a, **k)
+
+    def parameters(self):
+        return self.network.parameters()
+
+    def dist_main_program(self, mode=None):
+        return None  # no per-rank program object: GSPMD owns partitioning
+
+
+def to_static(layer, loader=None, loss=None, optimizer=None,
+              strategy=None, metrics=None) -> DistModel:
+    """dist.to_static (auto_parallel/api.py:2715): returns a DistModel
+    whose __call__ runs one fully-jitted, GSPMD-sharded step."""
+    return DistModel(layer, loader, loss, optimizer, strategy, metrics)

@@ -18,6 +18,8 @@ from . import sequence_parallel  # noqa: F401
 from .mp_layers import (ColumnParallelLinear, ParallelCrossEntropy,
                         RowParallelLinear, VocabParallelEmbedding)
 from .random import get_rng_state_tracker, model_parallel_random_seed
+from . import utils  # noqa: F401
+from .utils import recompute  # noqa: F401
 
 __all__ = ["init", "DistributedStrategy", "distributed_model",
            "distributed_optimizer", "get_hybrid_communicate_group",

@@ -1,0 +1,72 @@
+"""fleet.utils — recompute (activation checkpointing) and helpers.
+
+Reference: python/paddle/distributed/fleet/utils/__init__.py recompute /
+recompute_sequential (backed by PyLayer saving RNG state and re-running
+forward in backward). TPU-native: ``jax.checkpoint`` on the
+functionalized layer call — the recorded grad node's vjp recomputes the
+forward, so only the inputs are saved as residuals (SURVEY.md §7:
+rematerialisation trades FLOPs for HBM).
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from ...framework.tensor import Tensor, apply_op
+from ...nn.layer_base import Layer
+
+__all__ = ["recompute", "recompute_sequential"]
+
+
+def recompute(function, *args, **kwargs):
+    """Run ``function(*args)`` saving only inputs; forward re-runs inside
+    backward. ``function`` must be a Layer (its parameters are routed
+    through the recompute boundary so their gradients flow); for a plain
+    callable the call executes normally — correctness over memory, since
+    gradients to parameters closed over by an opaque callable cannot pass
+    a functional checkpoint boundary."""
+    kwargs.pop("use_reentrant", None)
+    kwargs.pop("preserve_rng_state", None)
+    if not isinstance(function, Layer):
+        return function(*args, **kwargs)
+
+    layer = function
+    params, buffers = layer.raw_state()
+    pnames = list(params)
+    bnames = list(buffers)
+    n_p, n_b = len(pnames), len(bnames)
+    from ...jit.functional import functional_call
+
+    def pure(*arrs):
+        p = dict(zip(pnames, arrs[:n_p]))
+        b = dict(zip(bnames, arrs[n_p:n_p + n_b]))
+        out, _ = functional_call(layer, p, b, *arrs[n_p + n_b:],
+                                 **kwargs)
+        return out
+
+    named = dict(layer.named_parameters())
+    param_tensors = [named[n] for n in pnames]
+    buffer_tensors = [dict(layer.named_buffers())[n] for n in bnames]
+    return apply_op(jax.checkpoint(pure), *param_tensors,
+                    *buffer_tensors, *args, _op_name="recompute")
+
+
+def recompute_sequential(ctx: dict, functions, *args, **kwargs):
+    """Reference recompute_sequential: checkpoint a Sequential in
+    ``ctx['segments']`` chunks."""
+    segments = int(ctx.get("segments", 1)) if ctx else 1
+    sublayers = list(functions) if not isinstance(functions, Layer) \
+        else list(functions.children())
+    if not sublayers:
+        return functions(*args, **kwargs)
+    n = len(sublayers)
+    bounds = [round(i * n / segments) for i in range(segments + 1)]
+    from ...nn.layer.container import Sequential
+    out = args[0] if len(args) == 1 else args
+    for lo, hi in zip(bounds[:-1], bounds[1:]):
+        if lo == hi:
+            continue
+        seg = Sequential(*sublayers[lo:hi])
+        out = recompute(seg, out, **kwargs)
+    return out

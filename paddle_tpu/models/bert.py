@@ -1,0 +1,210 @@
+"""BERT / ERNIE model family.
+
+Reference shape: the reference trains BERT-base DP and ERNIE-3.0
+finetune as flagship configs (BASELINE.md configs[1]/[3]); model code in
+its ecosystem lives in PaddleNLP, but the framework-side contract is the
+transformer layer stack (python/paddle/nn/layer/transformer.py) these
+models compose. Built entirely from this framework's nn layers so the
+whole family runs eagerly, under jit.to_static, and under
+dist.to_static/DistModel with GSPMD shardings.
+
+ERNIE (1.0/2.0-style) shares the BERT architecture with different
+pretraining objectives; ``ErnieModel`` reuses the encoder with the
+task-type embedding ERNIE adds.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from ..nn import functional as F
+from ..nn.layer_base import Layer
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.norm import LayerNorm
+from ..nn.layer.transformer import (TransformerEncoder,
+                                    TransformerEncoderLayer)
+
+__all__ = ["BertConfig", "BertModel", "BertPooler",
+           "BertForPretraining", "BertForSequenceClassification",
+           "bert_base", "bert_large", "ErnieModel",
+           "ErnieForSequenceClassification"]
+
+
+@dataclasses.dataclass
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    intermediate_size: int = 3072
+    hidden_act: str = "gelu"
+    hidden_dropout_prob: float = 0.1
+    attention_probs_dropout_prob: float = 0.1
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    use_task_id: bool = False  # ERNIE task-type embedding
+    task_type_vocab_size: int = 3
+
+
+class BertEmbeddings(Layer):
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.word_embeddings = Embedding(cfg.vocab_size, cfg.hidden_size)
+        self.position_embeddings = Embedding(cfg.max_position_embeddings,
+                                             cfg.hidden_size)
+        self.token_type_embeddings = Embedding(cfg.type_vocab_size,
+                                               cfg.hidden_size)
+        if cfg.use_task_id:
+            self.task_type_embeddings = Embedding(
+                cfg.task_type_vocab_size, cfg.hidden_size)
+        self.layer_norm = LayerNorm(cfg.hidden_size,
+                                    epsilon=cfg.layer_norm_eps)
+        self.dropout = Dropout(cfg.hidden_dropout_prob)
+        self._use_task_id = cfg.use_task_id
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                task_type_ids=None):
+        from ..ops.creation import arange, zeros_like
+        b, t = input_ids.shape
+        if position_ids is None:
+            position_ids = arange(t, dtype="int64").unsqueeze(0)
+        if token_type_ids is None:
+            token_type_ids = zeros_like(input_ids)
+        x = (self.word_embeddings(input_ids)
+             + self.position_embeddings(position_ids)
+             + self.token_type_embeddings(token_type_ids))
+        if self._use_task_id:
+            if task_type_ids is None:
+                task_type_ids = zeros_like(input_ids)
+            x = x + self.task_type_embeddings(task_type_ids)
+        return self.dropout(self.layer_norm(x))
+
+
+class BertPooler(Layer):
+    def __init__(self, hidden_size: int):
+        super().__init__()
+        self.dense = Linear(hidden_size, hidden_size)
+
+    def forward(self, hidden_states):
+        return F.tanh(self.dense(hidden_states[:, 0]))
+
+
+class BertModel(Layer):
+    """Encoder: embeddings -> TransformerEncoder -> (sequence, pooled)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.config = cfg
+        self.embeddings = BertEmbeddings(cfg)
+        enc_layer = TransformerEncoderLayer(
+            cfg.hidden_size, cfg.num_attention_heads,
+            cfg.intermediate_size, dropout=cfg.hidden_dropout_prob,
+            activation=cfg.hidden_act,
+            attn_dropout=cfg.attention_probs_dropout_prob,
+            layer_norm_eps=cfg.layer_norm_eps)
+        self.encoder = TransformerEncoder(enc_layer,
+                                          cfg.num_hidden_layers)
+        self.pooler = BertPooler(cfg.hidden_size)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None, task_type_ids=None):
+        if attention_mask is not None and attention_mask.ndim == 2:
+            # [B, T] 1/0 mask -> additive [B, 1, 1, T]
+            neg = (1.0 - attention_mask.astype("float32")) * -1e4
+            attention_mask = neg.unsqueeze(1).unsqueeze(1)
+        x = self.embeddings(input_ids, token_type_ids, position_ids,
+                            task_type_ids)
+        seq = self.encoder(x, attention_mask)
+        return seq, self.pooler(seq)
+
+
+class BertForPretraining(Layer):
+    """MLM + NSP heads (the BERT-base pretraining config)."""
+
+    def __init__(self, cfg: BertConfig):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.transform = Linear(cfg.hidden_size, cfg.hidden_size)
+        self.transform_norm = LayerNorm(cfg.hidden_size,
+                                        epsilon=cfg.layer_norm_eps)
+        self.nsp_head = Linear(cfg.hidden_size, 2)
+        self.config = cfg
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        seq, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                                attention_mask)
+        h = self.transform_norm(F.gelu(self.transform(seq)))
+        # decoder tied to word embeddings (BERT weight tying)
+        from ..ops.linalg import matmul
+        mlm_logits = matmul(
+            h, self.bert.embeddings.word_embeddings.weight,
+            transpose_y=True)
+        nsp_logits = self.nsp_head(pooled)
+        return mlm_logits, nsp_logits
+
+    def loss(self, input_ids, mlm_labels, nsp_labels=None,
+             token_type_ids=None, attention_mask=None,
+             ignore_index: int = -100):
+        mlm_logits, nsp_logits = self(input_ids, token_type_ids,
+                                      attention_mask=attention_mask)
+        V = self.config.vocab_size
+        mlm = F.cross_entropy(mlm_logits.reshape([-1, V]),
+                              mlm_labels.reshape([-1]),
+                              ignore_index=ignore_index)
+        if nsp_labels is None:
+            return mlm
+        nsp = F.cross_entropy(nsp_logits, nsp_labels.reshape([-1]))
+        return mlm + nsp
+
+
+class BertForSequenceClassification(Layer):
+    def __init__(self, cfg: BertConfig, num_classes: int = 2,
+                 dropout: Optional[float] = None):
+        super().__init__()
+        self.bert = BertModel(cfg)
+        self.dropout = Dropout(cfg.hidden_dropout_prob
+                               if dropout is None else dropout)
+        self.classifier = Linear(cfg.hidden_size, num_classes)
+
+    def forward(self, input_ids, token_type_ids=None, position_ids=None,
+                attention_mask=None):
+        _, pooled = self.bert(input_ids, token_type_ids, position_ids,
+                              attention_mask)
+        return self.classifier(self.dropout(pooled))
+
+
+def bert_base(**kwargs) -> BertConfig:
+    return BertConfig(**kwargs)
+
+
+def bert_large(**kwargs) -> BertConfig:
+    kwargs.setdefault("hidden_size", 1024)
+    kwargs.setdefault("num_hidden_layers", 24)
+    kwargs.setdefault("num_attention_heads", 16)
+    kwargs.setdefault("intermediate_size", 4096)
+    return BertConfig(**kwargs)
+
+
+class ErnieModel(BertModel):
+    """ERNIE encoder = BERT encoder + task-type embedding."""
+
+    def __init__(self, cfg: Optional[BertConfig] = None, **kwargs):
+        if cfg is None:
+            kwargs.setdefault("use_task_id", True)
+            cfg = BertConfig(**kwargs)
+        super().__init__(cfg)
+
+
+class ErnieForSequenceClassification(BertForSequenceClassification):
+    def __init__(self, cfg: Optional[BertConfig] = None,
+                 num_classes: int = 2, **kwargs):
+        if cfg is None:
+            kwargs.setdefault("use_task_id", True)
+            cfg = BertConfig(**kwargs)
+        super().__init__(cfg, num_classes)
+        self.bert = ErnieModel(cfg)
