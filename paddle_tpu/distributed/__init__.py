@@ -12,8 +12,9 @@ from .api import (DistModel, ShardingStage1, ShardingStage2,
                   ShardingStage3, dtensor_from_fn, get_placements,
                   reshard, shard_layer, shard_optimizer, shard_tensor,
                   to_static, unshard_dtensor)
-from .collective import (Group, ReduceOp, all_gather, all_gather_object,
-                         all_reduce, all_to_all, all_to_all_single, barrier,
+from .collective import (Group, P2POp, ReduceOp, all_gather,
+                         all_gather_object, all_reduce, all_to_all,
+                         all_to_all_single, barrier, batch_isend_irecv,
                          broadcast, get_group, irecv, isend, new_group,
                          recv, reduce, reduce_scatter, scatter, send,
                          stream, wait)

@@ -28,6 +28,7 @@ from ..framework.tensor import Tensor, apply_op
 from .process_mesh import ProcessMesh, get_mesh
 
 __all__ = ["Group", "new_group", "get_group", "all_reduce", "all_gather",
+           "P2POp", "batch_isend_irecv",
            "all_gather_object", "all_to_all", "all_to_all_single",
            "broadcast", "reduce", "reduce_scatter", "scatter", "send",
            "recv", "isend", "irecv", "barrier", "wait", "ReduceOp",
@@ -270,6 +271,32 @@ class _Work:
 
     def is_completed(self):
         return True
+
+
+class P2POp:
+    """One batched p2p descriptor (communication/batch_isend_irecv.py
+    P2POp): op is distributed.isend or distributed.irecv."""
+
+    def __init__(self, op, tensor, peer, group=None):
+        if op not in (isend, irecv):
+            raise ValueError("P2POp op must be paddle.distributed.isend "
+                             "or irecv")
+        self.op = op
+        self.tensor = tensor
+        self.peer = peer
+        self.group = group
+
+
+def batch_isend_irecv(p2p_op_list):
+    """Issue a batch of isend/irecv (pp_utils/p2p_communication.py:330
+    batched NCCL group calls); returns the list of work handles. Under
+    shard_map the sends are ppermutes XLA schedules together; eager
+    single-process semantics match isend/irecv."""
+    if not p2p_op_list:
+        return []
+    if not all(isinstance(p, P2POp) for p in p2p_op_list):
+        raise TypeError("batch_isend_irecv expects a list of P2POp")
+    return [p.op(p.tensor, p.peer, p.group) for p in p2p_op_list]
 
 
 def barrier(group: Optional[Group] = None):

@@ -30,6 +30,12 @@ def recompute(function, *args, **kwargs):
     kwargs.pop("preserve_rng_state", None)
     if not isinstance(function, Layer):
         return function(*args, **kwargs)
+    for k, v in kwargs.items():
+        if isinstance(v, Tensor) and not v.stop_gradient:
+            raise ValueError(
+                f"recompute: pass gradient-requiring tensor '{k}' "
+                f"positionally — keyword tensors bypass the checkpoint "
+                f"boundary and would silently get no gradient")
 
     layer = function
     params, buffers = layer.raw_state()
@@ -37,19 +43,31 @@ def recompute(function, *args, **kwargs):
     bnames = list(buffers)
     n_p, n_b = len(pnames), len(bnames)
     from ...jit.functional import functional_call
+    meta = {}
 
     def pure(*arrs):
         p = dict(zip(pnames, arrs[:n_p]))
         b = dict(zip(bnames, arrs[n_p:n_p + n_b]))
-        out, _ = functional_call(layer, p, b, *arrs[n_p + n_b:],
-                                 **kwargs)
-        return out
+        out, new_b = functional_call(layer, p, b, *arrs[n_p + n_b:],
+                                     **kwargs)
+        outs = out if isinstance(out, tuple) else (out,)
+        meta["n_out"] = len(outs)
+        # buffer mutations (BN running stats) ride along as extra outputs
+        return (*outs, *[new_b[n] for n in bnames])
 
     named = dict(layer.named_parameters())
+    named_bufs = dict(layer.named_buffers())
     param_tensors = [named[n] for n in pnames]
-    buffer_tensors = [dict(layer.named_buffers())[n] for n in bnames]
-    return apply_op(jax.checkpoint(pure), *param_tensors,
-                    *buffer_tensors, *args, _op_name="recompute")
+    buffer_tensors = [named_bufs[n] for n in bnames]
+    res = apply_op(jax.checkpoint(pure), *param_tensors,
+                   *buffer_tensors, *args, _op_name="recompute")
+    n_out = meta["n_out"]
+    from ...framework.tensor import no_grad
+    with no_grad():
+        for bt, new in zip(buffer_tensors, res[n_out:]):
+            bt._data = new._data
+    outs = res[:n_out]
+    return outs[0] if n_out == 1 else outs
 
 
 def recompute_sequential(ctx: dict, functions, *args, **kwargs):
@@ -63,10 +81,15 @@ def recompute_sequential(ctx: dict, functions, *args, **kwargs):
     n = len(sublayers)
     bounds = [round(i * n / segments) for i in range(segments + 1)]
     from ...nn.layer.container import Sequential
-    out = args[0] if len(args) == 1 else args
+    out = None
+    first = True
     for lo, hi in zip(bounds[:-1], bounds[1:]):
         if lo == hi:
             continue
         seg = Sequential(*sublayers[lo:hi])
-        out = recompute(seg, out, **kwargs)
+        if first:
+            out = recompute(seg, *args, **kwargs)
+            first = False
+        else:
+            out = recompute(seg, out, **kwargs)
     return out

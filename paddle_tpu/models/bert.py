@@ -202,9 +202,11 @@ class ErnieModel(BertModel):
 
 class ErnieForSequenceClassification(BertForSequenceClassification):
     def __init__(self, cfg: Optional[BertConfig] = None,
-                 num_classes: int = 2, **kwargs):
+                 num_classes: int = 2,
+                 dropout: Optional[float] = None, **kwargs):
         if cfg is None:
             kwargs.setdefault("use_task_id", True)
             cfg = BertConfig(**kwargs)
-        super().__init__(cfg, num_classes)
-        self.bert = ErnieModel(cfg)
+        # ErnieModel(cfg) == BertModel(cfg) once use_task_id is in the
+        # config, so the parent-built encoder is already the ERNIE one
+        super().__init__(cfg, num_classes, dropout)

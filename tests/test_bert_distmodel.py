@@ -162,3 +162,29 @@ def test_recompute_sequential_segments():
     np.testing.assert_allclose(out.numpy(), ref.numpy(), rtol=1e-6)
     (out.sum()).backward()
     assert net[0].weight.grad is not None
+
+
+def test_recompute_updates_bn_buffers():
+    from paddle_tpu.distributed.fleet.utils import recompute
+    paddle.seed(0)
+    block = paddle.nn.Sequential(paddle.nn.Conv2D(3, 4, 3, padding=1),
+                                 paddle.nn.BatchNorm2D(4))
+    bn = block[1]
+    mean0 = bn._mean.numpy().copy()
+    x = paddle.to_tensor(np.random.RandomState(0).randn(
+        2, 3, 8, 8).astype("float32"))
+    x.stop_gradient = False
+    out = recompute(block, x)
+    assert not np.allclose(bn._mean.numpy(), mean0), \
+        "BN running stats not updated through recompute"
+    (out.sum()).backward()
+    assert block[0].weight.grad is not None
+
+
+def test_recompute_rejects_grad_kwarg():
+    from paddle_tpu.distributed.fleet.utils import recompute
+    lin = paddle.nn.Linear(4, 4)
+    t = paddle.to_tensor(np.ones((2, 4), "float32"))
+    t.stop_gradient = False
+    with pytest.raises(ValueError):
+        recompute(lin, weight=t)
