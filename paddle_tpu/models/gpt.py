@@ -342,6 +342,21 @@ class GPTSpmdTrainer:
                 axis_names=set(self.mesh.axis_names),  # fully manual
                 check_vma=False)
             return f(q, k, v)
+        # long-context path: Ulysses all-to-all attention — seq-sharded
+        # activations become head-sharded full-sequence blocks, so per-chip
+        # kv memory is S*(H/n)*D instead of the gathered S*H*D
+        ulysses_ok = (self.use_flash and shape["pipe"] == 1
+                      and shape["sep"] > 1
+                      and T % 128 == 0 and dh in (64, 128, 256)
+                      and H % (shape["model"] * shape["sep"]) == 0
+                      and mb % shape["data"] == 0)
+        if ulysses_ok:
+            from ..ops.pallas_ops import ulysses_attention
+            return ulysses_attention(
+                q, k, v, self.mesh, axis="sep", causal=True,
+                manual_axes=set(self.mesh.axis_names),
+                use_flash=jax.default_backend() in ("tpu", "axon"),
+                in_spec=P("data", "sep", "model", None))
         # SP: q stays seq-sharded; k/v gathered over 'sep'
         q = act(q, _spec(self.mesh, "data", "sep", "model", None))
         k = act(k, _spec(self.mesh, "data", None, "model", None))

@@ -20,7 +20,7 @@ from ...framework import random as rnd
 from ...framework.tensor import Tensor, apply_op
 
 __all__ = ["scaled_dot_product_attention", "flash_attention",
-           "flash_attn_unpadded", "ring_attention"]
+           "flash_attn_unpadded", "ring_attention", "ulysses_attention"]
 
 
 def _sdpa_xla(q, k, v, mask, causal, dropout_p, key, scale=None):
@@ -138,6 +138,26 @@ def flash_attn_unpadded(query, key, value, cu_seqlens_q, cu_seqlens_k,
     out = apply_op(f, query, key, value, cu_seqlens_q, cu_seqlens_k,
                    _op_name="flash_attn_unpadded")
     return out, None
+
+
+def ulysses_attention(query, key, value, mesh=None, axis: str = "sep",
+                      causal: bool = False, name=None):
+    """All-to-all (DeepSpeed-Ulysses) sequence-parallel attention over a
+    mesh axis; the sibling of ring_attention for long-context scaling
+    (see ops.pallas_ops.ulysses_attention). Requires heads % axis_size
+    == 0; seq dim of the inputs sharded over ``axis``."""
+    from ...distributed.process_mesh import get_mesh
+    from ...ops.pallas_ops import ulysses_attention as _ulysses
+    if mesh is None:
+        pmesh = get_mesh()
+        if pmesh is None:
+            return scaled_dot_product_attention(query, key, value,
+                                                is_causal=causal)
+        mesh = pmesh.jax_mesh()
+    elif hasattr(mesh, "jax_mesh"):
+        mesh = mesh.jax_mesh()
+    return apply_op(lambda q, k, v: _ulysses(q, k, v, mesh, axis, causal),
+                    query, key, value, _op_name="ulysses_attention")
 
 
 def ring_attention(query, key, value, mesh=None, axis: str = "sep",

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import functools
 import math
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -288,6 +289,67 @@ def flash_attention_fwd(q, k, v, causal=False, scale=None):
 # ---------------------------------------------------------------------------
 # ring attention (context parallelism over a mesh axis)
 # ---------------------------------------------------------------------------
+
+def ulysses_attention(q, k, v, mesh, axis: str = "sep",
+                      causal: bool = False, scale=None,
+                      manual_axes=None, use_flash: Optional[bool] = None,
+                      in_spec=None):
+    """DeepSpeed-Ulysses attention: sequence-sharded activations are
+    all-to-all'd into head-sharded full-sequence blocks, attended
+    locally, and all-to-all'd back.
+
+    The reference has NO long-context mechanism (SURVEY.md P8 — absent);
+    with ring_attention below this is the TPU-native superset. vs ring:
+    per-chip kv memory drops to S*(H/n)*D (heads split) instead of the
+    gathered S*H*D, comm is two all-to-alls riding ICI, and causal
+    masking is the plain triangle since every rank sees the full
+    sequence for its head subset. Layout [B, S, H, D], S sharded over
+    ``axis``; requires num_heads % n == 0.
+
+    ``manual_axes``: mesh axes to go manual in the shard_map (defaults
+    to {axis}); pass ALL mesh axis names to run the Pallas flash kernel
+    inside (Mosaic requires a fully-manual region). ``in_spec``:
+    override the activation PartitionSpec when batch/head dims are also
+    sharded (e.g. P('data','sep','model',None) in the hybrid trainer)."""
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    from jax.sharding import PartitionSpec as P
+    axes = set(manual_axes) if manual_axes is not None else {axis}
+    if use_flash is None:
+        use_flash = (jax.default_backend() in ("tpu", "axon") and
+                     axes == set(mesh.axis_names))
+
+    def per_rank(ql, kl, vl):
+        # [B, S/n, H_loc, D] -> [B, S, H_loc/n, D]
+        def fwd(x):
+            return jax.lax.all_to_all(x, axis, split_axis=2,
+                                      concat_axis=1, tiled=True)
+
+        qg, kg, vg = fwd(ql), fwd(kl), fwd(vl)
+        if use_flash:
+            out = _flash_bshd(qg, kg, vg, causal, scale)
+        else:
+            out = _dense_bshd(qg, kg, vg, causal, scale)
+        return jax.lax.all_to_all(out, axis, split_axis=1,
+                                  concat_axis=2, tiled=True)
+
+    spec = in_spec if in_spec is not None else P(None, axis, None, None)
+    fn = jax.shard_map(per_rank, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, axis_names=axes, check_vma=False)
+    return fn(q, k, v)
+
+
+def _dense_bshd(q, k, v, causal, scale):
+    """Plain fused-XLA attention on [B, S, H, D] (fp32 softmax accum)."""
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        S_q, S_k = logits.shape[-2], logits.shape[-1]
+        mask = jnp.tril(jnp.ones((S_q, S_k), bool), k=S_k - S_q)
+        logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
 
 def ring_attention(q, k, v, mesh, axis: str = "sep", causal: bool = False,
                    scale=None):
