@@ -25,6 +25,7 @@ from . import pipeline  # noqa: F401
 from . import pipeline_schedules  # noqa: F401
 from . import auto_tuner  # noqa: F401
 from . import rpc  # noqa: F401
+from . import watchdog  # noqa: F401
 from . import ps  # noqa: F401
 from .store import TCPStore  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict

@@ -1,0 +1,178 @@
+"""Collective hang/failure detection.
+
+Reference: paddle/phi/core/distributed/comm_task_manager.h:37
+(CommTaskManager — an async watchdog thread that times out NCCL
+collectives, NCCLCommTask::IsTimeout nccl_comm_task.h:53) plus
+store-based exception propagation between ranks.
+
+TPU-native: XLA collectives cannot be interrupted mid-kernel, so the
+watchdog works at the step boundary — each rank heartbeats into the
+rendezvous TCPStore; a background thread flags peers whose heartbeat
+goes stale and surfaces exceptions other ranks published, so a hung or
+crashed worker is detected in O(timeout) instead of blocking the job
+forever (the contract of the reference's watchdog + async error
+handling).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+__all__ = ["CommWatchdog", "monitored_barrier"]
+
+_HB_PREFIX = "__watchdog__/hb"
+_ERR_PREFIX = "__watchdog__/err"
+
+
+class CommWatchdog:
+    """Store-backed heartbeat watchdog (CommTaskManager analog)."""
+
+    def __init__(self, store, rank: int, world_size: int,
+                 timeout: float = 60.0, interval: float = 2.0,
+                 on_failure: Optional[Callable] = None,
+                 auto_beat: bool = False):
+        """``auto_beat``: heartbeat from the background thread (process
+        liveness only — a rank hung inside a collective still beats).
+        Default False: the training loop must call beat() at step
+        boundaries, so a hang IS detected once timeout < hang duration;
+        size timeout above the longest legitimate step."""
+        self.store = store
+        self.rank = rank
+        self.world_size = world_size
+        self.timeout = timeout
+        self.interval = interval
+        self.on_failure = on_failure
+        self.auto_beat = auto_beat
+        self._stop = threading.Event()
+        self._failed: List[str] = []
+        self._exceptions: List[str] = []
+        self._start_time = time.time()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self):
+        if self._thread is not None:
+            return self
+        self.beat()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=self.interval * 2)
+            self._thread = None
+
+    # -- heartbeat ---------------------------------------------------------
+    def beat(self):
+        """Publish liveness; call at step boundaries."""
+        self.store.set(f"{_HB_PREFIX}/{self.rank}",
+                       repr(time.time()).encode())
+
+    def peer_ages(self) -> dict:
+        """Seconds since each peer's last heartbeat. A peer that never
+        heartbeat ages from THIS watchdog's start (startup grace: a
+        late-initializing rank is not instantly stale)."""
+        now = time.time()
+        ages = {}
+        for r in range(self.world_size):
+            if r == self.rank:
+                continue
+            try:
+                raw = self.store.get(f"{_HB_PREFIX}/{r}", timeout=1.0)
+                ages[r] = now - float(raw.decode())
+            except Exception:
+                ages[r] = now - self._start_time
+        return ages
+
+    # -- exception propagation (store-based, as the reference) -------------
+    def report_exception(self, message: str):
+        self.store.set(f"{_ERR_PREFIX}/{self.rank}",
+                       message.encode())
+
+    def peer_exceptions(self) -> dict:
+        out = {}
+        for r in range(self.world_size):
+            if r == self.rank:
+                continue
+            try:
+                out[r] = self.store.get(f"{_ERR_PREFIX}/{r}",
+                                        timeout=0.05).decode()
+            except Exception:
+                pass
+        return out
+
+    @property
+    def failures(self) -> List[str]:
+        return list(self._failed)
+
+    def check(self):
+        """Raise if any peer died or reported an exception (call at step
+        boundaries for fail-fast training loops)."""
+        if self._failed:
+            raise RuntimeError(
+                "distributed watchdog: " + "; ".join(self._failed))
+
+    # -- internals ---------------------------------------------------------
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            if self.auto_beat:
+                self.beat()
+            for r, msg in self.peer_exceptions().items():
+                note = f"rank {r} reported: {msg}"
+                if note not in self._exceptions:
+                    self._exceptions.append(note)
+            # staleness recomputed each sweep: a rank that recovers
+            # (heartbeat resumes) drops off; exceptions stay sticky
+            stale = [f"rank {r} heartbeat stale "
+                     f"({age:.1f}s > {self.timeout}s)"
+                     for r, age in self.peer_ages().items()
+                     if age > self.timeout]
+            self._failed = self._exceptions + stale
+            if self._failed and self.on_failure is not None:
+                try:
+                    self.on_failure(list(self._failed))
+                finally:
+                    self._stop.set()
+
+
+_barrier_rounds: dict = {}
+
+
+def monitored_barrier(store, rank: int, world_size: int,
+                      timeout: float = 60.0, tag: str = "mb"):
+    """Barrier that names the missing ranks on timeout (the reference's
+    monitored barrier / flight-recorder behavior): every rank registers,
+    rank 0 waits for all and publishes the release key. Each use of a
+    tag is round-numbered per process, so reuse works as long as all
+    ranks call the same barriers in order (collective contract)."""
+    rkey = (id(store), tag)
+    rnd = _barrier_rounds.get(rkey, 0)
+    _barrier_rounds[rkey] = rnd + 1
+    key = f"__watchdog__/barrier/{tag}/{rnd}"
+    store.set(f"{key}/arrived/{rank}", b"1")
+    deadline = time.time() + timeout
+    if rank == 0:
+        missing = list(range(1, world_size))
+        while missing and time.time() < deadline:
+            missing = [r for r in missing
+                       if not _has_key(store, f"{key}/arrived/{r}")]
+            if missing:
+                time.sleep(0.05)
+        if missing:
+            raise TimeoutError(
+                f"monitored_barrier('{tag}'): ranks {missing} missing "
+                f"after {timeout}s")
+        store.set(f"{key}/release", b"1")
+    else:
+        store.wait(f"{key}/release", timeout=timeout)
+
+
+def _has_key(store, key) -> bool:
+    try:
+        store.get(key, timeout=0.02)
+        return True
+    except Exception:
+        return False

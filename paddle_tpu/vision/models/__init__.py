@@ -7,3 +7,4 @@ from .densenet import *  # noqa: F401,F403
 from .squeezenet import *  # noqa: F401,F403
 from .shufflenetv2 import *  # noqa: F401,F403
 from .inception import *  # noqa: F401,F403
+from .ppyoloe import *  # noqa: F401,F403

@@ -1,0 +1,294 @@
+"""PP-YOLOE-style anchor-free detector.
+
+Reference shape: PP-YOLOE (PaddleDetection) — CSPRepResNet backbone
+(RepVGG-style 3x3+1x1 blocks in CSP stages), a CSP-PAN neck, and an
+anchor-free ET-head with Distribution Focal Loss regression
+(reg_max-bucket distributions per box side). The framework-side baseline
+(BASELINE.md configs[4]) benchmarks its *inference* path: static export
+-> StableHLO -> Predictor; that full path is implemented here. Training
+losses (VFL/DFL + task-aligned assignment) are PaddleDetection-repo
+scope, not framework scope, and are not reimplemented.
+
+TPU notes: everything up to NMS is one jittable graph (decode included);
+NMS runs on host via vision.ops.nms after thresholding, matching the
+usual TPU serving split.
+"""
+from __future__ import annotations
+
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ...framework.tensor import Tensor
+from ...nn import functional as F
+from ...nn.layer_base import Layer
+from ...nn.layer.activation import SiLU
+
+from ...nn.layer.container import LayerList, Sequential
+from ...nn.layer.conv import Conv2D
+from ...nn.layer.norm import BatchNorm2D
+
+__all__ = ["PPYOLOE", "CSPRepResNet", "CustomCSPPAN", "PPYOLOEHead",
+           "ppyoloe_s", "ppyoloe_m", "ppyoloe_l"]
+
+
+class ConvBNAct(Layer):
+    def __init__(self, cin, cout, k=3, stride=1, groups=1, act=True):
+        super().__init__()
+        self.conv = Conv2D(cin, cout, k, stride=stride,
+                           padding=(k - 1) // 2, groups=groups,
+                           bias_attr=False)
+        self.bn = BatchNorm2D(cout)
+        self.act = SiLU() if act else None
+
+    def forward(self, x):
+        x = self.bn(self.conv(x))
+        return self.act(x) if self.act is not None else x
+
+
+class RepVggBlock(Layer):
+    """Train-form RepVGG: parallel 3x3 + 1x1 conv-bn, summed then
+    activated (deploy-time fusion is a pure reparameterization)."""
+
+    def __init__(self, cin, cout):
+        super().__init__()
+        self.conv1 = ConvBNAct(cin, cout, 3, act=False)
+        self.conv2 = ConvBNAct(cin, cout, 1, act=False)
+        self.act = SiLU()
+
+    def forward(self, x):
+        return self.act(self.conv1(x) + self.conv2(x))
+
+
+class BasicBlock(Layer):
+    def __init__(self, cin, cout, shortcut=True):
+        super().__init__()
+        self.conv1 = ConvBNAct(cin, cout, 3)
+        self.conv2 = RepVggBlock(cout, cout)
+        self.shortcut = shortcut and cin == cout
+
+    def forward(self, x):
+        y = self.conv2(self.conv1(x))
+        return x + y if self.shortcut else y
+
+
+class EffectiveSE(Layer):
+    """ESE attention (one fc over pooled channels, sigmoid gate)."""
+
+    def __init__(self, ch):
+        super().__init__()
+        self.fc = Conv2D(ch, ch, 1)
+
+    def forward(self, x):
+        s = x.mean(axis=[2, 3], keepdim=True)
+        return x * F.sigmoid(self.fc(s))
+
+
+class CSPResStage(Layer):
+    def __init__(self, cin, cout, n, stride=2, use_attn=True):
+        super().__init__()
+        mid = (cin + cout) // 2
+        self.conv_down = ConvBNAct(cin, mid, 3, stride=stride) \
+            if stride > 1 else None
+        c = mid if self.conv_down is not None else cin
+        half = c // 2
+        self.conv1 = ConvBNAct(c, half, 1)
+        self.conv2 = ConvBNAct(c, half, 1)
+        self.blocks = Sequential(*[BasicBlock(half, half)
+                                   for _ in range(n)])
+        self.attn = EffectiveSE(c) if use_attn else None
+        self.conv3 = ConvBNAct(c, cout, 1)
+
+    def forward(self, x):
+        if self.conv_down is not None:
+            x = self.conv_down(x)
+        from ...ops.manipulation import concat
+        y = concat([self.conv1(x), self.blocks(self.conv2(x))], axis=1)
+        if self.attn is not None:
+            y = self.attn(y)
+        return self.conv3(y)
+
+
+class CSPRepResNet(Layer):
+    """Backbone returning C3, C4, C5 features (strides 8/16/32)."""
+
+    def __init__(self, depth_mult=0.33, width_mult=0.5):
+        super().__init__()
+        chs = [round(c * width_mult) for c in (64, 128, 256, 512, 1024)]
+        ns = [max(round(n * depth_mult), 1) for n in (3, 6, 6, 3)]
+        self.stem = Sequential(
+            ConvBNAct(3, chs[0] // 2, 3, stride=2),
+            ConvBNAct(chs[0] // 2, chs[0] // 2, 3),
+            ConvBNAct(chs[0] // 2, chs[0], 3),
+        )
+        self.stages = LayerList([
+            CSPResStage(chs[0], chs[1], ns[0]),
+            CSPResStage(chs[1], chs[2], ns[1]),
+            CSPResStage(chs[2], chs[3], ns[2]),
+            CSPResStage(chs[3], chs[4], ns[3]),
+        ])
+        self.out_channels = chs[2:]
+
+    def forward(self, x):
+        x = self.stem(x)
+        feats = []
+        for i, st in enumerate(self.stages):
+            x = st(x)
+            if i >= 1:
+                feats.append(x)
+        return feats  # [C3, C4, C5]
+
+
+class CustomCSPPAN(Layer):
+    """Simplified CSP-PAN: top-down then bottom-up fusion."""
+
+    def __init__(self, in_channels: Sequence[int], out_ch: int = 128,
+                 n: int = 1):
+        super().__init__()
+        c3, c4, c5 = in_channels
+        self.reduce5 = ConvBNAct(c5, out_ch, 1)
+        self.reduce4 = ConvBNAct(c4, out_ch, 1)
+        self.reduce3 = ConvBNAct(c3, out_ch, 1)
+        self.td4 = CSPResStage(out_ch * 2, out_ch, n, stride=1,
+                               use_attn=False)
+        self.td3 = CSPResStage(out_ch * 2, out_ch, n, stride=1,
+                               use_attn=False)
+        self.down3 = ConvBNAct(out_ch, out_ch, 3, stride=2)
+        self.bu4 = CSPResStage(out_ch * 2, out_ch, n, stride=1,
+                               use_attn=False)
+        self.down4 = ConvBNAct(out_ch, out_ch, 3, stride=2)
+        self.bu5 = CSPResStage(out_ch * 2, out_ch, n, stride=1,
+                               use_attn=False)
+        self.out_channels = [out_ch, out_ch, out_ch]
+
+    def forward(self, feats):
+        from ...ops.manipulation import concat
+        c3, c4, c5 = feats
+        p5 = self.reduce5(c5)
+        p4 = self.td4(concat([self.reduce4(c4),
+                              F.upsample(p5, scale_factor=2)], axis=1))
+        p3 = self.td3(concat([self.reduce3(c3),
+                              F.upsample(p4, scale_factor=2)], axis=1))
+        n4 = self.bu4(concat([self.down3(p3), p4], axis=1))
+        n5 = self.bu5(concat([self.down4(n4), p5], axis=1))
+        return [p3, n4, n5]
+
+
+class PPYOLOEHead(Layer):
+    """Anchor-free decoupled head with DFL regression (reg_max buckets
+    per side); decode to xyxy boxes is part of the graph."""
+
+    def __init__(self, in_channels: Sequence[int], num_classes: int = 80,
+                 reg_max: int = 16, strides=(8, 16, 32)):
+        super().__init__()
+        self.num_classes = num_classes
+        self.reg_max = reg_max
+        self.strides = strides
+        self.stem_cls = LayerList([EffectiveSE(c) for c in in_channels])
+        self.stem_reg = LayerList([EffectiveSE(c) for c in in_channels])
+        self.pred_cls = LayerList([Conv2D(c, num_classes, 3, padding=1)
+                                   for c in in_channels])
+        self.pred_reg = LayerList([Conv2D(c, 4 * (reg_max + 1), 3,
+                                          padding=1)
+                                   for c in in_channels])
+
+    def forward(self, feats):
+        """Returns (scores [B, N, C], boxes [B, N, 4] xyxy in input px)."""
+        import jax
+        import jax.numpy as jnp
+        from ...ops.manipulation import concat
+        from ...framework.tensor import apply_op
+
+        all_scores, all_boxes = [], []
+        for i, f in enumerate(feats):
+            b, c, h, w = f.shape
+            stride = self.strides[i]
+            cls_logit = self.pred_cls[i](self.stem_cls[i](f) + f)
+            reg_dist = self.pred_reg[i](self.stem_reg[i](f) + f)
+
+            def decode(logit, dist, h=h, w=w, stride=stride):
+                B = logit.shape[0]
+                C = self.num_classes
+                M = self.reg_max + 1
+                scores = jax.nn.sigmoid(logit)
+                scores = scores.reshape(B, C, h * w).transpose(0, 2, 1)
+                d = dist.reshape(B, 4, M, h * w)
+                d = jax.nn.softmax(d, axis=2)
+                proj = jnp.arange(M, dtype=d.dtype)
+                ltrb = jnp.einsum("bkmn,m->bkn", d, proj)  # [B,4,HW]
+                ys = (jnp.arange(h, dtype=d.dtype) + 0.5) * stride
+                xs = (jnp.arange(w, dtype=d.dtype) + 0.5) * stride
+                cy, cx = jnp.meshgrid(ys, xs, indexing="ij")
+                cx = cx.reshape(-1)
+                cy = cy.reshape(-1)
+                x1 = cx[None] - ltrb[:, 0] * stride
+                y1 = cy[None] - ltrb[:, 1] * stride
+                x2 = cx[None] + ltrb[:, 2] * stride
+                y2 = cy[None] + ltrb[:, 3] * stride
+                boxes = jnp.stack([x1, y1, x2, y2], axis=-1)  # [B,HW,4]
+                return scores, boxes
+
+            sc, bx = apply_op(decode, cls_logit, reg_dist,
+                              _op_name="yoloe_decode")
+            all_scores.append(sc)
+            all_boxes.append(bx)
+        return concat(all_scores, axis=1), concat(all_boxes, axis=1)
+
+
+class PPYOLOE(Layer):
+    def __init__(self, num_classes: int = 80, depth_mult=0.33,
+                 width_mult=0.5, neck_ch: Optional[int] = None):
+        super().__init__()
+        self.backbone = CSPRepResNet(depth_mult, width_mult)
+        neck_ch = neck_ch or round(192 * width_mult)
+        self.neck = CustomCSPPAN(self.backbone.out_channels, neck_ch,
+                                 n=max(round(3 * depth_mult), 1))
+        self.head = PPYOLOEHead(self.neck.out_channels, num_classes)
+        self.num_classes = num_classes
+
+    def forward(self, images):
+        """images [B, 3, H, W] -> (scores [B, N, C], boxes [B, N, 4])."""
+        return self.head(self.neck(self.backbone(images)))
+
+    def postprocess(self, scores: Tensor, boxes: Tensor,
+                    score_thresh: float = 0.25, iou_thresh: float = 0.6,
+                    max_dets: int = 100):
+        """Host-side NMS per image: returns list of
+        (boxes [k,4], scores [k], classes [k]) numpy triples."""
+        from ...vision.ops import nms
+        out = []
+        sc = np.asarray(scores.numpy())
+        bx = np.asarray(boxes.numpy())
+        for b in range(sc.shape[0]):
+            cls = sc[b].argmax(-1)
+            conf = sc[b].max(-1)
+            keep_mask = conf >= score_thresh
+            if not keep_mask.any():
+                out.append((np.zeros((0, 4), "f4"),
+                            np.zeros((0,), "f4"),
+                            np.zeros((0,), "i8")))
+                continue
+            kb = bx[b][keep_mask]
+            ks = conf[keep_mask]
+            kc = cls[keep_mask]
+            keep = nms(Tensor(kb), iou_threshold=iou_thresh,
+                       scores=Tensor(ks),
+                       category_idxs=Tensor(kc.astype("int64")),
+                       categories=list(range(self.num_classes)),
+                       top_k=max_dets)
+            idx = np.asarray(keep.numpy())
+            out.append((kb[idx], ks[idx], kc[idx]))
+        return out
+
+
+def ppyoloe_s(num_classes: int = 80, **kw) -> PPYOLOE:
+    return PPYOLOE(num_classes, depth_mult=0.33, width_mult=0.5, **kw)
+
+
+def ppyoloe_m(num_classes: int = 80, **kw) -> PPYOLOE:
+    return PPYOLOE(num_classes, depth_mult=0.67, width_mult=0.75, **kw)
+
+
+def ppyoloe_l(num_classes: int = 80, **kw) -> PPYOLOE:
+    return PPYOLOE(num_classes, depth_mult=1.0, width_mult=1.0, **kw)

@@ -11,13 +11,7 @@ from ..framework.tensor import Tensor, apply_op, _unwrap
 __all__ = ["nms", "box_coder", "roi_align", "yolo_box"]
 
 
-def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
-        categories=None, top_k=None):
-    """Host-side NMS (data-dependent sizes; eager-only like the
-    reference's masked_select-class ops)."""
-    b = np.asarray(_unwrap(boxes), np.float32)
-    s = np.asarray(_unwrap(scores), np.float32) if scores is not None \
-        else np.ones(len(b), np.float32)
+def _nms_single(b, s, iou_threshold):
     order = np.argsort(-s)
     keep = []
     while order.size:
@@ -37,9 +31,39 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
         area_r = (b[rest, 2] - b[rest, 0]) * (b[rest, 3] - b[rest, 1])
         iou = inter / (area_i + area_r - inter + 1e-10)
         order = rest[iou <= iou_threshold]
-    if top_k is not None:
-        keep = keep[:top_k]
-    return Tensor(np.asarray(keep, np.int64))
+    return keep
+
+
+def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
+        categories=None, top_k=None):
+    """Host-side NMS (data-dependent sizes; eager-only like the
+    reference's masked_select-class ops). With ``category_idxs``,
+    suppression runs per category and ``top_k`` caps each category
+    (paddle.vision.ops.nms contract); indices are returned in
+    descending-score order."""
+    b = np.asarray(_unwrap(boxes), np.float32)
+    s = np.asarray(_unwrap(scores), np.float32) if scores is not None \
+        else np.ones(len(b), np.float32)
+    if category_idxs is None:
+        keep = _nms_single(b, s, iou_threshold)
+        if top_k is not None:
+            keep = keep[:top_k]
+        return Tensor(np.asarray(keep, np.int64))
+
+    cats = np.asarray(_unwrap(category_idxs))
+    if categories is None:
+        categories = np.unique(cats).tolist()
+    keep_all = []
+    for c in categories:
+        (idx,) = np.nonzero(cats == c)
+        if idx.size == 0:
+            continue
+        kept = _nms_single(b[idx], s[idx], iou_threshold)
+        if top_k is not None:
+            kept = kept[:top_k]
+        keep_all.extend(int(idx[i]) for i in kept)
+    keep_all.sort(key=lambda i: -s[i])
+    return Tensor(np.asarray(keep_all, np.int64))
 
 
 def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",

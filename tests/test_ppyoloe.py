@@ -1,0 +1,83 @@
+"""PP-YOLOE detector tests: forward/decode shapes, NMS postprocess, and
+the full inference-export path (BASELINE configs[4]: static export ->
+StableHLO -> Predictor)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision.models import ppyoloe_s
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = ppyoloe_s(num_classes=4)
+    m.eval()
+    return m
+
+
+def test_forward_decode_shapes(model):
+    x = paddle.to_tensor(np.random.RandomState(0).rand(
+        1, 3, 64, 64).astype("float32"))
+    scores, boxes = model(x)
+    # strides 8/16/32 on 64x64 -> 64 + 16 + 4 = 84 anchors
+    assert scores.shape == [1, 84, 4]
+    assert boxes.shape == [1, 84, 4]
+    b = boxes.numpy()
+    assert (b[..., 2] >= b[..., 0]).all() and (b[..., 3] >= b[..., 1]).all()
+
+
+def test_postprocess_nms(model):
+    x = paddle.to_tensor(np.random.RandomState(1).rand(
+        2, 3, 64, 64).astype("float32"))
+    scores, boxes = model(x)
+    dets = model.postprocess(scores, boxes, score_thresh=0.0,
+                             iou_thresh=0.6, max_dets=10)
+    assert len(dets) == 2
+    for bx, sc, cl in dets:
+        assert bx.shape[1] == 4 and len(sc) == len(bx) == len(cl)
+        assert len(bx) <= 10 * 4  # top_k per category
+
+
+def test_export_and_predictor(model, tmp_path):
+    from paddle_tpu import inference
+    from paddle_tpu.jit import save as jit_save
+    from paddle_tpu.static import InputSpec
+
+    x = np.random.RandomState(2).rand(1, 3, 64, 64).astype("float32")
+    ref_scores, ref_boxes = model(paddle.to_tensor(x))
+
+    prefix = str(tmp_path / "ppyoloe")
+    jit_save(model, prefix,
+             input_spec=[InputSpec([1, 3, 64, 64], "float32")])
+    cfg = inference.Config(prefix)
+    pred = inference.create_predictor(cfg)
+    h = pred.get_input_handle(pred.get_input_names()[0])
+    h.copy_from_cpu(x)
+    pred.run()
+    outs = [pred.get_output_handle(n).copy_to_cpu()
+            for n in pred.get_output_names()]
+    assert len(outs) == 2
+    np.testing.assert_allclose(outs[0], ref_scores.numpy(), rtol=1e-4,
+                               atol=1e-5)
+    np.testing.assert_allclose(outs[1], ref_boxes.numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_nms_per_category():
+    """Overlapping boxes of different classes must both survive."""
+    from paddle_tpu.vision.ops import nms
+    boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11],
+                      [0, 0, 10, 10]], "float32")
+    scores = np.array([0.9, 0.8, 0.7], "float32")
+    cats = np.array([0, 1, 0], dtype="int64")  # box2 same class as box0
+    keep = nms(paddle.to_tensor(boxes), iou_threshold=0.5,
+               scores=paddle.to_tensor(scores),
+               category_idxs=paddle.to_tensor(cats),
+               categories=[0, 1]).numpy()
+    # box0 (cls0) and box1 (cls1) survive; box2 suppressed by box0
+    assert sorted(keep.tolist()) == [0, 1]
+    # class-agnostic: box1 suppressed too
+    keep2 = nms(paddle.to_tensor(boxes), iou_threshold=0.5,
+                scores=paddle.to_tensor(scores)).numpy()
+    assert keep2.tolist() == [0]

@@ -215,3 +215,94 @@ def test_rpc_error_propagates():
             rpc_mod.rpc_sync("nobody", len, args=("x",))
     finally:
         rpc_mod.shutdown()
+
+
+class TestWatchdog:
+    def test_heartbeat_and_stale_detection(self):
+        from paddle_tpu.distributed.store import TCPStore
+        from paddle_tpu.distributed.watchdog import CommWatchdog
+        store = TCPStore(is_master=True, world_size=2)
+        try:
+            w0 = CommWatchdog(store, rank=0, world_size=2, timeout=1.0,
+                              interval=0.2, auto_beat=True).start()
+            w1 = CommWatchdog(store, rank=1, world_size=2, timeout=1.0,
+                              interval=0.2, auto_beat=True).start()
+            time.sleep(0.6)
+            assert not w0.failures and not w1.failures
+            w0.check()
+            # rank 1 "hangs": stop its heartbeat thread
+            w1.stop()
+            deadline = time.time() + 5.0
+            while not w0.failures and time.time() < deadline:
+                time.sleep(0.2)
+            assert any("rank 1 heartbeat stale" in f for f in w0.failures)
+            try:
+                w0.check()
+                raise AssertionError("check() did not raise")
+            except RuntimeError:
+                pass
+            w0.stop()
+        finally:
+            store.close()
+
+    def test_exception_propagation(self):
+        from paddle_tpu.distributed.store import TCPStore
+        from paddle_tpu.distributed.watchdog import CommWatchdog
+        store = TCPStore(is_master=True, world_size=2)
+        try:
+            w0 = CommWatchdog(store, rank=0, world_size=2, timeout=30.0,
+                              interval=0.1, auto_beat=True).start()
+            w1 = CommWatchdog(store, rank=1, world_size=2, timeout=30.0,
+                              interval=0.1, auto_beat=True).start()
+            w1.report_exception("OOM on shard 3")
+            deadline = time.time() + 5.0
+            while not w0.failures and time.time() < deadline:
+                time.sleep(0.1)
+            assert any("OOM on shard 3" in f for f in w0.failures)
+            w0.stop(); w1.stop()
+        finally:
+            store.close()
+
+    def test_monitored_barrier_names_missing_rank(self):
+        from paddle_tpu.distributed.store import TCPStore
+        from paddle_tpu.distributed.watchdog import monitored_barrier
+        store = TCPStore(is_master=True, world_size=3)
+        try:
+            import threading
+            errs = []
+
+            def rank0():
+                try:
+                    monitored_barrier(store, 0, 3, timeout=1.0, tag="t1")
+                except TimeoutError as e:
+                    errs.append(str(e))
+
+            t = threading.Thread(target=rank0)
+            t.start()
+            store.set("__watchdog__/barrier/t1/0/arrived/1", b"1")
+            # rank 2 never arrives
+            t.join(timeout=5)
+            assert errs and "[2]" in errs[0], errs
+            # successful barrier: all arrive, each rank on its OWN client
+            # (one client socket serializes blocking waits)
+            from paddle_tpu.distributed.store import TCPStore as _TS
+            clients = [_TS(port=store.port, world_size=3)
+                       for _ in range(3)]
+            done = []
+
+            def all_ranks(r):
+                monitored_barrier(clients[r], r, 3, timeout=5.0,
+                                  tag="t2")
+                done.append(r)
+
+            ts = [threading.Thread(target=all_ranks, args=(r,))
+                  for r in range(3)]
+            for th in ts:
+                th.start()
+            for th in ts:
+                th.join(timeout=10)
+            assert sorted(done) == [0, 1, 2]
+            for c in clients:
+                c.close()
+        finally:
+            store.close()
