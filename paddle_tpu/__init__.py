@@ -8,8 +8,8 @@ capture — re-architected TPU-first (see SURVEY.md §7).
 """
 from .framework.dtype import (  # noqa: F401
     bool_ as bool, uint8, int8, int16, int32, int64, float16, bfloat16,
-    float32, float64, complex64, complex128, DType as dtype,
-    get_default_dtype, set_default_dtype)
+    float32, float64, complex64, complex128, float8_e4m3fn, float8_e5m2,
+    DType as dtype, get_default_dtype, set_default_dtype)
 from .framework import (  # noqa: F401
     Tensor, no_grad, enable_grad, set_grad_enabled, seed,
     get_rng_state, set_rng_state, in_dynamic_mode, in_pir_mode)
@@ -19,7 +19,7 @@ from .ops import *  # noqa: F401,F403
 from .ops import creation as _creation  # noqa: F401
 from .device import (  # noqa: F401
     set_device, get_device, is_compiled_with_cuda, CPUPlace, CUDAPlace,
-    TPUPlace)
+    CUDAPinnedPlace, TPUPlace)
 from . import device  # noqa: F401
 from . import autograd  # noqa: F401
 from .autograd import grad  # noqa: F401
@@ -55,6 +55,9 @@ from .regularizer import L1Decay, L2Decay  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .framework.io import save, load  # noqa: F401
 from .tensor_module import tensor  # noqa: F401
+from .nn.layer_base import ParamAttr  # noqa: F401
+from .distributed.parallel import DataParallel  # noqa: F401
+from .static.graph import create_parameter  # noqa: F401
 
 def disable_static(place=None):
     from .static.graph import disable_static_mode

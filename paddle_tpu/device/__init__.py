@@ -53,6 +53,14 @@ class CUDAPlace(Place):
         super().__init__("gpu", device_id)
 
 
+class CUDAPinnedPlace(Place):
+    """API compat: host memory is always 'pinned' from XLA's view
+    (device transfers stage through pinned buffers internally)."""
+
+    def __init__(self):
+        super().__init__("cpu", 0)
+
+
 _current_device: Optional[str] = None
 
 

@@ -136,7 +136,8 @@ def all_reduce(tensor: Tensor, op=ReduceOp.SUM, group: Optional[Group] = None,
     axis = _axis(group)
     if _in_spmd_trace(tensor) and axis is not None:
         fn = _reduce_fn(op)
-        out = apply_op(lambda a: fn(a, axis), tensor, _op_name="all_reduce")
+        out = apply_op(lambda a: fn(a, axis), tensor._snapshot(),
+                       _op_name="all_reduce")
         tensor._inplace(out)
         return tensor
     # eager single-controller: every "rank" already sees the global value
@@ -194,6 +195,8 @@ def all_to_all_single(out_tensor, in_tensor, out_split_sizes=None,
             lambda a: jax.lax.all_to_all(a, axis, split_axis=0,
                                          concat_axis=0, tiled=True),
             in_tensor, _op_name="all_to_all_single")
+        # out's node references in_tensor (a different handle), so the
+        # rebind of out_tensor cannot self-cycle
         out_tensor._inplace(out)
         return out_tensor
     out_tensor.set_value(in_tensor._data)

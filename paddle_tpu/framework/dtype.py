@@ -64,9 +64,12 @@ float32 = DType("float32", np.float32)
 float64 = DType("float64", np.float64)
 complex64 = DType("complex64", np.complex64)
 complex128 = DType("complex128", np.complex128)
+float8_e4m3fn = DType("float8_e4m3fn", jnp.float8_e4m3fn)
+float8_e5m2 = DType("float8_e5m2", jnp.float8_e5m2)
 
 _ALL = [bool_, uint8, int8, int16, int32, int64, float16, bfloat16,
-        float32, float64, complex64, complex128]
+        float32, float64, complex64, complex128, float8_e4m3fn,
+        float8_e5m2]
 _BY_NAME = {d.name: d for d in _ALL}
 _BY_NAME["bool"] = bool_
 _BY_NP = {d.np_dtype: d for d in _ALL}

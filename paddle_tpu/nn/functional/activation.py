@@ -24,7 +24,9 @@ def relu(x, name=None):
 
 
 def relu_(x, name=None):
-    return x._inplace(relu(x))
+    # snapshot: see Tensor._snapshot — recording the node against x
+    # itself would self-cycle after _inplace rebinds the grad edge
+    return x._inplace(relu(x._snapshot()))
 
 
 def relu6(x, name=None):

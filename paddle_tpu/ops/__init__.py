@@ -13,3 +13,4 @@ from .manipulation import *  # noqa: F401,F403
 from .linalg import *  # noqa: F401,F403
 from .logic import *  # noqa: F401,F403
 from .random_ops import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403

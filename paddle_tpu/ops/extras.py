@@ -1,0 +1,827 @@
+"""Long-tail op-surface parity: the remaining paddle top-level APIs.
+
+Reference: scattered across python/paddle/tensor/{math,manipulation,
+stat,search,creation}.py — each here is a thin jax.numpy / jax.scipy
+composition through apply_op (kernels, fusion, and gradients come from
+XLA). The in-place ``op_`` variants are generated at the bottom from
+their out-of-place bases (paddle's inplace ops rebind the tensor's
+buffer; the façade's ``_inplace`` preserves handle identity).
+"""
+from __future__ import annotations
+
+import math as _math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import jax.scipy.special as jsp
+import numpy as np
+
+from ..framework.tensor import Tensor, apply_op
+
+__all__ = [
+    # math
+    "logaddexp", "sinc", "signbit", "isneginf", "isposinf", "isreal",
+    "copysign", "hypot", "nextafter", "ldexp", "frexp", "i0", "i0e",
+    "i1", "i1e", "polygamma", "gammaln", "gammainc", "gammaincc",
+    "multigammaln", "sgn", "floor_mod",
+    # stats / reductions
+    "quantile", "nanquantile", "mode", "kthvalue",
+    "histogram_bin_edges", "histogramdd", "reduce_as", "trapezoid",
+    "cumulative_trapezoid", "cdist", "pdist",
+    # manipulation
+    "block_diag", "diag_embed", "unstack", "cartesian_prod",
+    "combinations", "slice_scatter", "diagonal_scatter",
+    "masked_scatter", "index_fill", "index_sample", "scatter_nd",
+    "dstack", "column_stack", "row_stack", "reverse", "unflatten",
+    "as_strided", "unfold", "vander", "polar", "complex",
+    "tril_indices", "triu_indices", "multiplex", "isin", "renorm",
+    "broadcast_shape", "shape", "rank",
+    # random
+    "binomial", "standard_gamma", "log_normal",
+    # dtype / predicates
+    "iinfo", "finfo", "is_floating_point", "is_complex", "is_integer",
+    # misc API
+    "set_printoptions", "LazyGuard", "summary", "flops",
+    "get_cuda_rng_state", "set_cuda_rng_state", "log_normal_",
+    "cauchy_", "geometric_", "check_shape", "batch",
+]
+
+
+def _u(fn, name, *xs, **kw):
+    return apply_op(fn, *xs, _op_name=name, **kw)
+
+
+# ---------------------------------------------------------------------------
+# math
+# ---------------------------------------------------------------------------
+
+def logaddexp(x, y, name=None):
+    return _u(jnp.logaddexp, "logaddexp", x, y)
+
+
+def sinc(x, name=None):
+    return _u(jnp.sinc, "sinc", x)
+
+
+def signbit(x, name=None):
+    return _u(jnp.signbit, "signbit", x)
+
+
+def isneginf(x, name=None):
+    return _u(jnp.isneginf, "isneginf", x)
+
+
+def isposinf(x, name=None):
+    return _u(jnp.isposinf, "isposinf", x)
+
+
+def isreal(x, name=None):
+    return _u(jnp.isreal, "isreal", x)
+
+
+def copysign(x, y, name=None):
+    if not isinstance(y, Tensor):
+        y = Tensor(jnp.asarray(y))
+    return _u(jnp.copysign, "copysign", x, y)
+
+
+def hypot(x, y, name=None):
+    return _u(jnp.hypot, "hypot", x, y)
+
+
+def nextafter(x, y, name=None):
+    return _u(jnp.nextafter, "nextafter", x, y)
+
+
+def ldexp(x, y, name=None):
+    return _u(lambda a, b: jnp.ldexp(a, b.astype(jnp.int32)), "ldexp",
+              x, y)
+
+
+def frexp(x, name=None):
+    return _u(lambda a: jnp.frexp(a), "frexp", x)
+
+
+def i0(x, name=None):
+    return _u(jsp.i0, "i0", x)
+
+
+def i0e(x, name=None):
+    return _u(jsp.i0e, "i0e", x)
+
+
+def i1(x, name=None):
+    return _u(jsp.i1, "i1", x)
+
+
+def i1e(x, name=None):
+    return _u(jsp.i1e, "i1e", x)
+
+
+def polygamma(x, n, name=None):
+    return _u(lambda a: jsp.polygamma(int(n), a), "polygamma", x)
+
+
+def gammaln(x, name=None):
+    return _u(jsp.gammaln, "gammaln", x)
+
+
+def gammainc(x, y, name=None):
+    return _u(jsp.gammainc, "gammainc", x, y)
+
+
+def gammaincc(x, y, name=None):
+    return _u(jsp.gammaincc, "gammaincc", x, y)
+
+
+def multigammaln(x, p, name=None):
+    return _u(lambda a: jsp.multigammaln(a, int(p)), "multigammaln", x)
+
+
+def sgn(x, name=None):
+    def f(a):
+        if jnp.issubdtype(a.dtype, jnp.complexfloating):
+            mag = jnp.abs(a)
+            return jnp.where(mag == 0, 0, a / jnp.where(mag == 0, 1, mag))
+        return jnp.sign(a)
+    return _u(f, "sgn", x)
+
+
+def floor_mod(x, y, name=None):
+    from .math import mod
+    return mod(x, y)
+
+
+# ---------------------------------------------------------------------------
+# stats / reductions
+# ---------------------------------------------------------------------------
+
+def quantile(x, q, axis=None, keepdim=False, interpolation="linear",
+             name=None):
+    return _u(lambda a: jnp.quantile(a, jnp.asarray(q), axis=axis,
+                                     keepdims=keepdim,
+                                     method=interpolation),
+              "quantile", x)
+
+
+def nanquantile(x, q, axis=None, keepdim=False, interpolation="linear",
+                name=None):
+    return _u(lambda a: jnp.nanquantile(a, jnp.asarray(q), axis=axis,
+                                        keepdims=keepdim,
+                                        method=interpolation),
+              "nanquantile", x)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    """Most frequent value (ties -> largest, paddle contract). O(n log n):
+    sort, then per-element run length from cummax/cummin of run
+    boundaries — no n*n comparison matrix."""
+    def f(a):
+        s = jnp.sort(a, axis=axis)
+        n = a.shape[axis]
+        sm = jnp.moveaxis(s, axis, -1)
+        p = jnp.broadcast_to(jnp.arange(n), sm.shape)
+        neq = sm[..., 1:] != sm[..., :-1]
+        run_start = jnp.concatenate(
+            [jnp.ones_like(sm[..., :1], bool), neq], axis=-1)
+        run_end = jnp.concatenate(
+            [neq, jnp.ones_like(sm[..., :1], bool)], axis=-1)
+        # start/end position of the run each element belongs to
+        last = sm.ndim - 1  # lax.cummax/cummin reject negative axes
+        s_pos = jax.lax.cummax(jnp.where(run_start, p, 0), axis=last)
+        e_pos = jnp.flip(jax.lax.cummin(
+            jnp.flip(jnp.where(run_end, p, n - 1), -1), axis=last), -1)
+        length = e_pos - s_pos + 1
+        # last max run = largest value on count ties (ascending sort)
+        best = (n - 1) - jnp.argmax(jnp.flip(length, -1), axis=-1)
+        vals = jnp.take_along_axis(sm, best[..., None], axis=-1)[..., 0]
+        return vals if not keepdim else jnp.expand_dims(vals, axis)
+    values = _u(f, "mode", x)
+    # indices: first occurrence of the value in the ORIGINAL tensor
+    def g(a, v):
+        vv = jnp.expand_dims(v, axis) if not keepdim else v
+        eq = a == vv
+        am = jnp.moveaxis(eq, axis, -1)
+        idx = jnp.argmax(am, axis=-1)
+        return idx if not keepdim else jnp.expand_dims(idx, axis)
+    indices = _u(g, "mode_idx", x, values)
+    return values, indices
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(a):
+        s = jnp.sort(a, axis=axis)
+        i = jnp.argsort(a, axis=axis)
+        vals = jnp.take(s, k - 1, axis=axis)
+        idx = jnp.take(i, k - 1, axis=axis)
+        if keepdim:
+            vals = jnp.expand_dims(vals, axis)
+            idx = jnp.expand_dims(idx, axis)
+        return vals, idx
+    return _u(f, "kthvalue", x)
+
+
+def histogram_bin_edges(x, bins=100, min=0, max=0, name=None):
+    def f(a):
+        lo, hi = (jnp.min(a), jnp.max(a)) if min == 0 and max == 0 \
+            else (min, max)
+        return jnp.linspace(lo, hi, bins + 1)
+    return _u(f, "histogram_bin_edges", x)
+
+
+def histogramdd(x, bins=10, ranges=None, density=False, weights=None,
+                name=None):
+    arrs = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+    w = np.asarray(weights.numpy()) if isinstance(weights, Tensor) \
+        else weights
+    h, edges = np.histogramdd(arrs, bins=bins, range=ranges,
+                              density=density, weights=w)
+    return Tensor(h), [Tensor(e) for e in edges]
+
+
+def reduce_as(x, target, name=None):
+    """Sum-reduce x to target's shape (the broadcast inverse)."""
+    def f(a, t):
+        extra = a.ndim - t.ndim
+        out = jnp.sum(a, axis=tuple(range(extra))) if extra else a
+        axes = tuple(i for i, (s, d) in
+                     enumerate(zip(t.shape, out.shape)) if s == 1 != d)
+        if axes:
+            out = jnp.sum(out, axis=axes, keepdims=True)
+        return out.reshape(t.shape)
+    return _u(f, "reduce_as", x, target)
+
+
+def trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    if x is not None:
+        return _u(lambda a, b: jnp.trapezoid(a, x=b, axis=axis),
+                  "trapezoid", y, x)
+    return _u(lambda a: jnp.trapezoid(a, dx=dx or 1.0, axis=axis),
+              "trapezoid", y)
+
+
+def cumulative_trapezoid(y, x=None, dx=None, axis=-1, name=None):
+    import jax.scipy.integrate as jsi
+    if hasattr(jsi, "cumulative_trapezoid"):
+        base = jsi.cumulative_trapezoid
+    else:
+        def base(a, x=None, dx=1.0, axis=-1):
+            am = jnp.moveaxis(a, axis, -1)
+            if x is not None:
+                xm = jnp.moveaxis(jnp.broadcast_to(x, a.shape), axis, -1)
+                d = xm[..., 1:] - xm[..., :-1]
+            else:
+                d = dx
+            avg = (am[..., 1:] + am[..., :-1]) / 2.0
+            return jnp.moveaxis(jnp.cumsum(avg * d, axis=-1), -1, axis)
+    if x is not None:
+        return _u(lambda a, b: base(a, x=b, axis=axis),
+                  "cumulative_trapezoid", y, x)
+    return _u(lambda a: base(a, dx=dx or 1.0, axis=axis),
+              "cumulative_trapezoid", y)
+
+
+def cdist(x, y, p=2.0, compute_mode=None, name=None):
+    def f(a, b):
+        d = a[..., :, None, :] - b[..., None, :, :]
+        if p == 2.0:
+            return jnp.sqrt(jnp.sum(d * d, axis=-1) + 1e-30)
+        return jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+    return _u(f, "cdist", x, y)
+
+
+def pdist(x, p=2.0, name=None):
+    def f(a):
+        n = a.shape[0]
+        d = a[:, None, :] - a[None, :, :]
+        if p == 2.0:
+            m = jnp.sqrt(jnp.sum(d * d, axis=-1) + 1e-30)
+        else:
+            m = jnp.sum(jnp.abs(d) ** p, axis=-1) ** (1.0 / p)
+        iu = jnp.triu_indices(n, k=1)
+        return m[iu]
+    return _u(f, "pdist", x)
+
+
+# ---------------------------------------------------------------------------
+# manipulation
+# ---------------------------------------------------------------------------
+
+def block_diag(inputs, name=None):
+    def f(*arrs):
+        arrs = [jnp.atleast_2d(a) for a in arrs]
+        rows = sum(a.shape[0] for a in arrs)
+        cols = sum(a.shape[1] for a in arrs)
+        out = jnp.zeros((rows, cols), arrs[0].dtype)
+        r = c = 0
+        for a in arrs:
+            out = jax.lax.dynamic_update_slice(out, a.astype(out.dtype),
+                                               (r, c))
+            r += a.shape[0]
+            c += a.shape[1]
+        return out
+    return _u(f, "block_diag", *inputs)
+
+
+def diag_embed(x, offset=0, dim1=-2, dim2=-1, name=None):
+    def f(a):
+        n = a.shape[-1] + abs(offset)
+        base = jnp.zeros(a.shape[:-1] + (n, n), a.dtype)
+        idx = jnp.arange(a.shape[-1])
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        out = base.at[..., r, c].set(a)
+        nd = out.ndim
+        d1 = dim1 % nd
+        d2 = dim2 % nd
+        perm = [i for i in range(nd) if i not in (nd - 2, nd - 1)]
+        # place the two new axes at dim1/dim2
+        order = []
+        src = iter(perm)
+        for i in range(nd):
+            if i == d1:
+                order.append(nd - 2)
+            elif i == d2:
+                order.append(nd - 1)
+            else:
+                order.append(next(src))
+        return jnp.transpose(out, order)
+    return _u(f, "diag_embed", x)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num or x.shape[axis]
+    return _u(lambda a: tuple(jnp.moveaxis(a, axis, 0)[i]
+                              for i in range(n)), "unstack", x)
+
+
+def cartesian_prod(inputs, name=None):
+    def f(*arrs):
+        grids = jnp.meshgrid(*arrs, indexing="ij")
+        return jnp.stack([g.reshape(-1) for g in grids], axis=-1)
+    out = _u(f, "cartesian_prod", *inputs)
+    return out
+
+
+def combinations(x, r=2, with_replacement=False, name=None):
+    from itertools import combinations as comb, combinations_with_replacement
+    n = x.shape[0]
+    gen = combinations_with_replacement(range(n), r) if with_replacement \
+        else comb(range(n), r)
+    idx = np.asarray(list(gen), np.int32).reshape(-1, r)
+    return _u(lambda a: a[jnp.asarray(idx)], "combinations", x)
+
+
+def slice_scatter(x, value, axes, starts, ends, strides, name=None):
+    def f(a, v):
+        idx = [slice(None)] * a.ndim
+        for ax, st, en, sd in zip(axes, starts, ends, strides):
+            idx[ax] = slice(st, en, sd)
+        return a.at[tuple(idx)].set(v.astype(a.dtype))
+    return _u(f, "slice_scatter", x, value)
+
+
+def diagonal_scatter(x, y, offset=0, axis1=0, axis2=1, name=None):
+    def f(a, v):
+        n = min(a.shape[axis1], a.shape[axis2]) - abs(offset)
+        idx = jnp.arange(n)
+        r = idx + max(-offset, 0)
+        c = idx + max(offset, 0)
+        am = jnp.moveaxis(a, (axis1, axis2), (0, 1))
+        am = am.at[r, c].set(jnp.moveaxis(v.astype(a.dtype), -1, 0)
+                             if v.ndim > 1 else v.astype(a.dtype))
+        return jnp.moveaxis(am, (0, 1), (axis1, axis2))
+    return _u(f, "diagonal_scatter", x, y)
+
+
+def masked_scatter(x, mask, value, name=None):
+    """Fill masked positions with consecutive values (paddle contract:
+    value is consumed in row-major order)."""
+    def f(a, m, v):
+        vf = v.reshape(-1)
+        pos = jnp.cumsum(m.reshape(-1)) - 1
+        take = vf[jnp.clip(pos, 0, vf.shape[0] - 1)].reshape(a.shape)
+        return jnp.where(m, take.astype(a.dtype), a)
+    return _u(f, "masked_scatter", x, mask, value)
+
+
+def index_fill(x, index, axis, value, name=None):
+    def f(a, i):
+        am = jnp.moveaxis(a, axis, 0)
+        am = am.at[i].set(jnp.asarray(value, a.dtype))
+        return jnp.moveaxis(am, 0, axis)
+    return _u(f, "index_fill", x, index)
+
+
+def index_sample(x, index, name=None):
+    return _u(lambda a, i: jnp.take_along_axis(a, i, axis=1),
+              "index_sample", x, index)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    def f(i, u):
+        out = jnp.zeros(tuple(int(s) for s in shape), u.dtype)
+        return out.at[tuple(jnp.moveaxis(i, -1, 0))].add(u)
+    return _u(f, "scatter_nd", index, updates)
+
+
+def dstack(inputs, name=None):
+    return _u(lambda *a: jnp.dstack(a), "dstack", *inputs)
+
+
+def column_stack(inputs, name=None):
+    return _u(lambda *a: jnp.column_stack(a), "column_stack", *inputs)
+
+
+def row_stack(inputs, name=None):
+    return _u(lambda *a: jnp.vstack(a), "row_stack", *inputs)
+
+
+def reverse(x, axis, name=None):
+    ax = tuple(axis) if isinstance(axis, (list, tuple)) else (axis,)
+    return _u(lambda a: jnp.flip(a, axis=ax), "reverse", x)
+
+
+def unflatten(x, axis, shape, name=None):
+    def f(a):
+        new = list(a.shape[:axis]) + list(shape) + \
+            list(a.shape[axis + 1:] if axis != -1 else [])
+        if axis == -1:
+            new = list(a.shape[:-1]) + list(shape)
+        return a.reshape(new)
+    return _u(f, "unflatten", x)
+
+
+def as_strided(x, shape, stride, offset=0, name=None):
+    """View with explicit strides (element units), via flat gather."""
+    def f(a):
+        flat = a.reshape(-1)
+        idx = jnp.full((), offset, jnp.int32)
+        grid = jnp.zeros(tuple(shape), jnp.int32) + idx
+        for d, (s, st) in enumerate(zip(shape, stride)):
+            r = jnp.arange(s, dtype=jnp.int32) * st
+            r = r.reshape((1,) * d + (s,) + (1,) * (len(shape) - d - 1))
+            grid = grid + r
+        return flat[grid]
+    return _u(f, "as_strided", x)
+
+
+def unfold(x, axis, size, step, name=None):
+    """Sliding windows along ``axis`` (tensor.unfold contract)."""
+    def f(a):
+        n = (a.shape[axis] - size) // step + 1
+        am = jnp.moveaxis(a, axis, -1)
+        starts = jnp.arange(n) * step
+        win = jnp.arange(size)
+        idx = starts[:, None] + win[None, :]
+        out = am[..., idx]  # [..., n, size]
+        return jnp.moveaxis(out, -2, axis)
+    return _u(f, "unfold", x)
+
+
+def vander(x, n=None, increasing=False, name=None):
+    return _u(lambda a: jnp.vander(a, N=n, increasing=increasing),
+              "vander", x)
+
+
+def polar(abs_t, angle, name=None):
+    # lax.complex keeps f32->c64 / f64->c128 (no silent downcast)
+    return _u(lambda r, t: jax.lax.complex(r * jnp.cos(t),
+                                           r * jnp.sin(t)),
+              "polar", abs_t, angle)
+
+
+def complex(real, imag, name=None):
+    return _u(lambda r, i: jax.lax.complex(r, i), "complex", real, imag)
+
+
+def tril_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.tril_indices(row, k=offset, m=col or row)
+    return Tensor(np.stack([r, c]).astype(np.int64))
+
+
+def triu_indices(row, col=None, offset=0, dtype="int64"):
+    r, c = np.triu_indices(row, k=offset, m=col or row)
+    return Tensor(np.stack([r, c]).astype(np.int64))
+
+
+def multiplex(inputs, index, name=None):
+    def f(i, *arrs):
+        stacked = jnp.stack(arrs)  # [K, B, ...]
+        sel = i.reshape(-1).astype(jnp.int32)
+        return stacked[sel, jnp.arange(stacked.shape[1])]
+    return _u(f, "multiplex", index, *inputs)
+
+
+def isin(x, test_x, assume_unique=False, invert=False, name=None):
+    return _u(lambda a, t: jnp.isin(a, t, invert=invert), "isin", x,
+              test_x)
+
+
+def renorm(x, p, axis, max_norm, name=None):
+    def f(a):
+        am = jnp.moveaxis(a, axis, 0)
+        flat = am.reshape(am.shape[0], -1)
+        norms = jnp.sum(jnp.abs(flat) ** p, axis=1) ** (1.0 / p)
+        scale = jnp.where(norms > max_norm,
+                          max_norm / (norms + 1e-12), 1.0)
+        out = flat * scale[:, None]
+        return jnp.moveaxis(out.reshape(am.shape), 0, axis)
+    return _u(f, "renorm", x)
+
+
+def broadcast_shape(x_shape, y_shape):
+    return list(np.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def shape(x):
+    """Runtime shape as a 1-D int32 Tensor (paddle.shape contract)."""
+    return _u(lambda a: jnp.asarray(a.shape, jnp.int32), "shape", x)
+
+
+def rank(x):
+    return Tensor(np.asarray(x.ndim, np.int32))
+
+
+# ---------------------------------------------------------------------------
+# random
+# ---------------------------------------------------------------------------
+
+def binomial(count, prob, name=None):
+    from ..framework import random as rnd
+    key = rnd.op_key(count, prob)
+    return _u(lambda n, p, k: jax.random.binomial(
+        k, n.astype(jnp.float32), p).astype(jnp.int64),
+        "binomial", count, prob, key)
+
+
+def standard_gamma(x, name=None):
+    from ..framework import random as rnd
+    key = rnd.op_key(x)
+    return _u(lambda a, k: jax.random.gamma(k, a), "standard_gamma", x,
+              key)
+
+
+def log_normal(mean=1.0, std=2.0, shape=None, dtype="float32", name=None):
+    from ..framework import random as rnd
+    from ..framework.dtype import to_dtype
+    key = rnd.next_key()
+    arr = jnp.exp(mean + std * jax.random.normal(
+        key, tuple(shape or []), jnp.float32))
+    return Tensor(arr.astype(to_dtype(dtype).np_dtype))
+
+
+def log_normal_(x, mean=1.0, std=2.0, name=None):
+    """In-place fill with LogNormal(mean, std) samples."""
+    from ..framework import random as rnd
+    key = rnd.op_key(x)
+    return x._inplace(_u(
+        lambda a, k: jnp.exp(mean + std * jax.random.normal(
+            k, a.shape, jnp.float32)).astype(a.dtype),
+        "log_normal_", x._snapshot(), key))
+
+
+def cauchy_(x, loc=0.0, scale=1.0, name=None):
+    """In-place fill with Cauchy(loc, scale) samples."""
+    from ..framework import random as rnd
+    key = rnd.op_key(x)
+    return x._inplace(_u(
+        lambda a, k: (loc + scale * jax.random.cauchy(
+            k, a.shape, jnp.float32)).astype(a.dtype),
+        "cauchy_", x._snapshot(), key))
+
+
+def geometric_(x, probs, name=None):
+    """In-place fill with Geometric(probs) samples (number of trials)."""
+    from ..framework import random as rnd
+    key = rnd.op_key(x)
+    return x._inplace(_u(
+        lambda a, k: jax.random.geometric(
+            k, a.shape, p=probs).astype(a.dtype),
+        "geometric_", x._snapshot(), key))
+
+
+def check_shape(x, expected_shape):
+    """Assert a tensor's static shape (paddle.static check helper):
+    -1/None entries match any size."""
+    actual = list(x.shape)
+    if len(actual) != len(expected_shape) or any(
+            e not in (-1, None) and e != a
+            for e, a in zip(expected_shape, actual)):
+        raise ValueError(f"shape mismatch: expected {expected_shape}, "
+                         f"got {actual}")
+    return True
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Deprecated reader decorator kept for API compat
+    (python/paddle/reader) — batches an iterable-returning reader."""
+    def batched():
+        buf = []
+        for item in reader():
+            buf.append(item)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+    return batched
+
+
+# ---------------------------------------------------------------------------
+# dtype / predicates / misc
+# ---------------------------------------------------------------------------
+
+class iinfo:
+    def __init__(self, dtype):
+        from ..framework.dtype import to_dtype
+        info = np.iinfo(to_dtype(dtype).np_dtype)
+        self.min = int(info.min)
+        self.max = int(info.max)
+        self.bits = info.bits
+        self.dtype = str(info.dtype)
+
+
+class finfo:
+    def __init__(self, dtype):
+        from ..framework.dtype import to_dtype
+        import ml_dtypes
+        info = ml_dtypes.finfo(to_dtype(dtype).np_dtype)
+        self.min = float(info.min)
+        self.max = float(info.max)
+        self.eps = float(info.eps)
+        self.tiny = float(info.tiny)
+        self.smallest_normal = float(info.smallest_normal)
+        self.resolution = float(info.resolution)
+        self.bits = info.bits
+        self.dtype = str(info.dtype)
+
+
+def is_floating_point(x) -> bool:
+    return jnp.issubdtype(np.dtype(x._data.dtype), jnp.floating)
+
+
+def is_complex(x) -> bool:
+    return jnp.issubdtype(np.dtype(x._data.dtype), jnp.complexfloating)
+
+
+def is_integer(x) -> bool:
+    return jnp.issubdtype(np.dtype(x._data.dtype), jnp.integer)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+class LazyGuard:
+    """paddle.LazyGuard compat: the reference defers parameter
+    materialization; here initialization is cheap (host numpy), so the
+    guard is a documented no-op context."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def summary(net, input_size=None, dtypes=None, input=None):
+    """Model summary (hapi.summary): walks sublayers, counts params."""
+    rows = []
+    total = trainable = 0
+    for name, sub in net.named_sublayers():
+        n_params = sum(int(np.prod(p.shape))
+                       for p in sub._parameters.values() if p is not None)
+        if n_params or not list(sub.children()):
+            rows.append((name or sub.__class__.__name__,
+                         sub.__class__.__name__, n_params))
+    for p in net.parameters():
+        n = int(np.prod(p.shape))
+        total += n
+        if not p.stop_gradient:
+            trainable += n
+    lines = [f"{'Layer':40s} {'Type':24s} {'Params':>12s}"]
+    lines += [f"{n[:40]:40s} {t[:24]:24s} {c:>12,d}" for n, t, c in rows]
+    lines.append(f"Total params: {total:,d}")
+    lines.append(f"Trainable params: {trainable:,d}")
+    out = "\n".join(lines)
+    print(out)
+    return {"total_params": total, "trainable_params": trainable}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Rough FLOPs estimate: 2*numel per linear/conv weight application
+    scaled by output spatial size (paddle.flops analog, coarse)."""
+    from ..nn.layer.conv import _ConvNd
+    from ..nn.layer.common import Linear
+    batch = input_size[0] if input_size else 1
+    total = 0
+    spatial = int(np.prod(input_size[2:])) if input_size and \
+        len(input_size) > 2 else 1
+    for _, sub in net.named_sublayers(include_self=True):
+        if isinstance(sub, Linear):
+            total += 2 * int(np.prod(sub.weight.shape)) * batch
+        elif isinstance(sub, _ConvNd):
+            total += 2 * int(np.prod(sub.weight.shape)) * batch * spatial
+    if print_detail:
+        print(f"FLOPs (approx): {total:,d}")
+    return total
+
+
+def get_cuda_rng_state():
+    from ..framework.random import get_rng_state
+    return get_rng_state()
+
+
+def set_cuda_rng_state(state):
+    from ..framework.random import set_rng_state
+    return set_rng_state(state)
+
+
+# ---------------------------------------------------------------------------
+# generated in-place variants (paddle `op_` contract: same computation,
+# the input tensor's buffer is rebound; returns the input handle)
+# ---------------------------------------------------------------------------
+
+_INPLACE_BASES = [
+    "abs", "acos", "addmm", "atan", "bernoulli", "bitwise_and",
+    "bitwise_left_shift", "bitwise_not", "bitwise_or",
+    "bitwise_right_shift", "bitwise_xor", "cast", "copysign", "cos",
+    "cumprod", "cumsum", "digamma", "divide", "equal", "erf", "expm1",
+    "floor_divide", "floor_mod", "frac", "gammainc", "gammaincc",
+    "gammaln", "gcd", "greater_equal", "greater_than", "hypot", "i0",
+    "index_add", "index_put", "lcm", "ldexp", "less_equal", "less_than",
+    "lgamma", "log", "log10", "log2", "logical_and", "logical_not",
+    "logical_or", "logit", "masked_fill", "masked_scatter", "mod",
+    "multigammaln", "nan_to_num", "neg", "polygamma", "pow", "remainder",
+    "renorm", "scatter", "sin", "sinc", "sinh", "square", "t", "tan",
+    "tanh", "transpose", "tril", "triu", "trunc", "where",
+]
+
+
+def _make_inplace(base_name, base_fn):
+    def fn(x, *args, **kwargs):
+        # the op must reference a SNAPSHOT of x, not x itself: _inplace
+        # rebinds x to the new grad node, and a node whose input is x
+        # would self-cycle and silently drop upstream gradients
+        return x._inplace(base_fn(x._snapshot(), *args, **kwargs))
+    fn.__name__ = base_name + "_"
+    fn.__doc__ = f"In-place variant of ``{base_name}`` (rebinds the " \
+                 f"tensor's buffer; returns the same handle)."
+    return fn
+
+
+def _install_inplace():
+    import sys
+    from . import math as _m
+    from . import manipulation as _mp
+    from . import linalg as _lin
+    from . import logic as _lg
+    from . import creation as _cr
+    from . import random_ops as _ro
+    here = sys.modules[__name__]
+    sources = [here, _m, _mp, _lin, _lg, _cr, _ro]
+    for base in _INPLACE_BASES:
+        fn = None
+        for mod in sources:
+            fn = getattr(mod, base, None)
+            if fn is not None:
+                break
+        if fn is None:
+            continue
+        name = base + "_"
+        wrapper = _make_inplace(base, fn)
+        setattr(here, name, wrapper)
+        __all__.append(name)
+        Tensor._bind(name, wrapper)
+
+
+_install_inplace()
+
+# bind the out-of-place extras as Tensor methods where paddle has them
+for _m_name in ["logaddexp", "sinc", "signbit", "isneginf", "isposinf",
+                "isreal", "copysign", "hypot", "nextafter", "ldexp",
+                "frexp", "i0", "i0e", "i1", "i1e", "polygamma",
+                "gammaln", "gammainc", "gammaincc", "multigammaln",
+                "sgn", "floor_mod", "quantile", "nanquantile", "mode",
+                "kthvalue", "cdist", "diag_embed", "unstack",
+                "slice_scatter", "diagonal_scatter", "masked_scatter",
+                "index_fill", "index_sample", "reverse", "unflatten",
+                "as_strided", "unfold", "vander", "isin", "renorm",
+                "is_floating_point", "is_complex", "is_integer",
+                "reduce_as", "trapezoid", "cumulative_trapezoid",
+                "log_normal_", "cauchy_", "geometric_"]:
+    Tensor._bind(_m_name, globals()[_m_name])
