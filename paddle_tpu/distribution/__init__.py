@@ -492,3 +492,275 @@ def _kl_uniform(p, q):
 def _kl_exponential(p, q):
     r = p.rate / q.rate
     return Tensor(jnp.log(r) + q.rate / p.rate - 1)
+
+
+# ---------------------------------------------------------------------------
+# long-tail distribution parity
+# ---------------------------------------------------------------------------
+
+class ExponentialFamily(Distribution):
+    """Base for exponential-family distributions (distribution/
+    exponential_family.py): entropy via Bregman divergence of the
+    log-normalizer is delegated to subclasses here."""
+
+
+class Binomial(Distribution):
+    def __init__(self, total_count, probs, name=None):
+        self.total_count = _t(total_count)
+        self.probs = _t(probs)
+        super().__init__(jnp.broadcast_shapes(self.total_count.shape,
+                                              self.probs.shape))
+
+    @property
+    def mean(self):
+        return Tensor(self.total_count * self.probs)
+
+    @property
+    def variance(self):
+        return Tensor(self.total_count * self.probs * (1 - self.probs))
+
+    def sample(self, shape=()):
+        out = jax.random.binomial(
+            rnd.next_key(), self.total_count.astype(jnp.float32),
+            self.probs, _shape(shape) + self.batch_shape)
+        return Tensor(out.astype(jnp.int64))
+
+    def log_prob(self, value):
+        v = _t(value).astype(jnp.float32)
+        n = self.total_count.astype(jnp.float32)
+        from jax.scipy.special import gammaln
+        logc = gammaln(n + 1) - gammaln(v + 1) - gammaln(n - v + 1)
+        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
+        return Tensor(logc + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+    def entropy(self):
+        # 2nd-order Stirling approximation (reference uses the same)
+        n, p = self.total_count.astype(jnp.float32), self.probs
+        return Tensor(0.5 * jnp.log(
+            2 * jnp.pi * jnp.e * n * p * (1 - p) + 1e-8))
+
+
+class Chi2(Gamma):
+    """Chi-squared = Gamma(df/2, rate=1/2) (distribution/chi2.py)."""
+
+    def __init__(self, df, name=None):
+        self.df = _t(df)
+        super().__init__(self.df / 2.0, jnp.full_like(_t(df), 0.5))
+
+
+class ContinuousBernoulli(Distribution):
+    def __init__(self, probs, lims=(0.499, 0.501), name=None):
+        self.probs = jnp.clip(_t(probs), 1e-6, 1 - 1e-6)
+        self._lims = lims
+        super().__init__(self.probs.shape)
+
+    def _log_norm(self):
+        p = self.probs
+        near_half = jnp.abs(p - 0.5) < (self._lims[1] - 0.5)
+        safe = jnp.where(near_half, 0.4, p)
+        c = jnp.log((2 * jnp.arctanh(1 - 2 * safe)) /
+                    (1 - 2 * safe + 1e-12))
+        return jnp.where(near_half, jnp.log(2.0), c)
+
+    def log_prob(self, value):
+        v = _t(value)
+        p = self.probs
+        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p) +
+                      self._log_norm())
+
+    def _near_half(self):
+        return jnp.abs(self.probs - 0.5) < (self._lims[1] - 0.5)
+
+    def sample(self, shape=()):
+        u = jax.random.uniform(rnd.next_key(),
+                               _shape(shape) + self.batch_shape)
+        p = self.probs
+        # inverse CDF; degenerates to uniform near p = 1/2
+        icdf = jnp.where(
+            self._near_half(), u,
+            (jnp.log1p(u * (p / (1 - p) - 1)) /
+             (jnp.log(p) - jnp.log1p(-p))))
+        return Tensor(jnp.clip(icdf, 0.0, 1.0))
+
+    @property
+    def mean(self):
+        p = self.probs
+        m = p / (2 * p - 1) + 1 / (2 * jnp.arctanh(1 - 2 * p))
+        return Tensor(jnp.where(self._near_half(), 0.5, m))
+
+
+class Independent(Distribution):
+    """Reinterpret batch dims as event dims (distribution/independent.py):
+    log_prob sums over the reinterpreted dims."""
+
+    def __init__(self, base, reinterpreted_batch_rank):
+        self.base = base
+        self.rank = int(reinterpreted_batch_rank)
+        bs = base.batch_shape
+        super().__init__(bs[:len(bs) - self.rank],
+                         bs[len(bs) - self.rank:] + base.event_shape)
+
+    def sample(self, shape=()):
+        return self.base.sample(shape)
+
+    def rsample(self, shape=()):
+        return self.base.rsample(shape)
+
+    def log_prob(self, value):
+        lp = _t(self.base.log_prob(value))
+        return Tensor(jnp.sum(lp, axis=tuple(range(-self.rank, 0))))
+
+    def entropy(self):
+        e = _t(self.base.entropy())
+        return Tensor(jnp.sum(e, axis=tuple(range(-self.rank, 0))))
+
+    @property
+    def mean(self):
+        return self.base.mean
+
+    @property
+    def variance(self):
+        return self.base.variance
+
+
+class MultivariateNormal(Distribution):
+    def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
+                 scale_tril=None, name=None):
+        self.loc = _t(loc)
+        if scale_tril is not None:
+            self._tril = _t(scale_tril)
+        elif covariance_matrix is not None:
+            self._tril = jnp.linalg.cholesky(_t(covariance_matrix))
+        elif precision_matrix is not None:
+            self._tril = jnp.linalg.cholesky(
+                jnp.linalg.inv(_t(precision_matrix)))
+        else:
+            raise ValueError("one of covariance_matrix/precision_matrix/"
+                             "scale_tril is required")
+        super().__init__(self.loc.shape[:-1], self.loc.shape[-1:])
+
+    @property
+    def mean(self):
+        return Tensor(self.loc)
+
+    @property
+    def covariance_matrix(self):
+        return Tensor(self._tril @ self._tril.swapaxes(-1, -2))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.sum(self._tril ** 2, axis=-1))
+
+    def sample(self, shape=()):
+        return self.rsample(shape)
+
+    def rsample(self, shape=()):
+        z = jax.random.normal(
+            rnd.next_key(),
+            _shape(shape) + self.batch_shape + self.event_shape)
+        return Tensor(self.loc + jnp.einsum("...ij,...j->...i",
+                                            self._tril, z))
+
+    def log_prob(self, value):
+        d = self.event_shape[0]
+        diff = _t(value) - self.loc
+        import jax.scipy.linalg as jsl
+        sol = jsl.solve_triangular(self._tril, diff[..., None],
+                                   lower=True)[..., 0]
+        maha = jnp.sum(sol ** 2, axis=-1)
+        logdet = jnp.sum(jnp.log(jnp.abs(jnp.diagonal(
+            self._tril, axis1=-2, axis2=-1))), axis=-1)
+        return Tensor(-0.5 * (maha + d * jnp.log(2 * jnp.pi)) - logdet)
+
+    def entropy(self):
+        d = self.event_shape[0]
+        logdet = jnp.sum(jnp.log(jnp.abs(jnp.diagonal(
+            self._tril, axis1=-2, axis2=-1))), axis=-1)
+        return Tensor(0.5 * d * (1 + jnp.log(2 * jnp.pi)) + logdet)
+
+
+class TransformedDistribution(Distribution):
+    """base pushed through a chain of transforms
+    (distribution/transformed_distribution.py)."""
+
+    def __init__(self, base, transforms):
+        self.base = base
+        self.transforms = list(transforms)
+        super().__init__(base.batch_shape, base.event_shape)
+
+    def sample(self, shape=()):
+        x = self.base.sample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def rsample(self, shape=()):
+        x = self.base.rsample(shape)
+        for t in self.transforms:
+            x = t.forward(x)
+        return x
+
+    def log_prob(self, value):
+        y = _t(value)
+        lp = jnp.zeros(())
+        v = Tensor(y)
+        for t in reversed(self.transforms):
+            x = t.inverse(v)
+            lp = lp - _t(t.forward_log_det_jacobian(x))
+            v = x
+        return Tensor(lp + _t(self.base.log_prob(v)))
+
+
+class LKJCholesky(Distribution):
+    """LKJ prior over correlation-matrix Cholesky factors
+    (distribution/lkj_cholesky.py); onion-method sampling."""
+
+    def __init__(self, dim, concentration=1.0, sample_method="onion",
+                 name=None):
+        self.dim = int(dim)
+        self.concentration = float(
+            concentration if not isinstance(concentration, Tensor)
+            else concentration.item())
+        super().__init__((), (self.dim, self.dim))
+
+    def sample(self, shape=()):
+        d = self.dim
+        eta = self.concentration
+        key = rnd.next_key()
+        shp = _shape(shape)
+        # onion method: sequential rows from beta marginals
+        k1, k2 = jax.random.split(key)
+        L = jnp.zeros(shp + (d, d))
+        L = L.at[..., 0, 0].set(1.0)
+        for i in range(1, d):
+            beta = jax.random.beta(jax.random.fold_in(k1, i),
+                                   i / 2.0, eta + (d - 1 - i) / 2.0, shp)
+            u = jax.random.normal(jax.random.fold_in(k2, i),
+                                  shp + (i,))
+            u = u / jnp.linalg.norm(u, axis=-1, keepdims=True)
+            w = jnp.sqrt(beta)[..., None] * u
+            L = L.at[..., i, :i].set(w)
+            L = L.at[..., i, i].set(jnp.sqrt(1 - beta))
+        return Tensor(L)
+
+    def log_prob(self, value):
+        L = _t(value)
+        d = self.dim
+        eta = self.concentration
+        diag = jnp.diagonal(L, axis1=-2, axis2=-1)[..., 1:]
+        orders = jnp.asarray([d - 2 - i + 2 * (eta - 1)
+                              for i in range(d - 1)])
+        unnorm = jnp.sum(orders * jnp.log(diag + 1e-30), axis=-1)
+        # normalizer (torch LKJCholesky): pi^{dm1/2} * mvlgamma terms
+        from jax.scipy.special import gammaln, multigammaln
+        dm1 = d - 1
+        alpha = eta + 0.5 * dm1
+        norm = (0.5 * dm1 * math.log(math.pi)
+                + multigammaln(jnp.asarray(alpha - 0.5), dm1)
+                - dm1 * gammaln(jnp.asarray(alpha)))
+        return Tensor(unnorm - norm)
+
+
+__all__ += ["ExponentialFamily", "Binomial", "Chi2",
+            "ContinuousBernoulli", "Independent", "MultivariateNormal",
+            "TransformedDistribution", "LKJCholesky"]

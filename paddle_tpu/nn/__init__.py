@@ -12,5 +12,7 @@ from .layer.container import *  # noqa: F401,F403
 from .layer.loss import *  # noqa: F401,F403
 from .layer.transformer import *  # noqa: F401,F403
 from .layer.rnn import *  # noqa: F401,F403
+from .layer.rnn import RNNCellBase  # noqa: F401
+from .layer.extras import *  # noqa: F401,F403
 from .clip import (ClipGradByValue, ClipGradByNorm,  # noqa: F401
                    ClipGradByGlobalNorm)

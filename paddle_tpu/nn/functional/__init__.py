@@ -8,4 +8,5 @@ from .pooling import *  # noqa: F401,F403
 from .norm import *  # noqa: F401,F403
 from .loss import *  # noqa: F401,F403
 from .attention import *  # noqa: F401,F403
+from .extras import *  # noqa: F401,F403
 from ..._pad_reexport import pad  # noqa: F401

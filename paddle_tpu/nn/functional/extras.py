@@ -1,0 +1,735 @@
+"""Long-tail nn.functional parity.
+
+Reference: python/paddle/nn/functional/{loss,pooling,vision,common}.py —
+the remaining functionals not covered by the core modules. Each is a
+jax composition through apply_op; window-indexed ops (unpool, fractional
+and LP pooling) share one patches helper instead of per-op CUDA kernels
+(phi/kernels/gpu/*pool*).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework import random as rnd
+from ...framework.tensor import Tensor, apply_op
+
+__all__ = [
+    "sequence_mask", "pairwise_distance", "temporal_shift",
+    "affine_grid", "grid_sample", "feature_alpha_dropout",
+    "lp_pool1d", "lp_pool2d", "max_unpool1d", "max_unpool2d",
+    "max_unpool3d", "fractional_max_pool2d", "fractional_max_pool3d",
+    "gaussian_nll_loss", "poisson_nll_loss", "soft_margin_loss",
+    "multi_label_soft_margin_loss", "multi_margin_loss", "npair_loss",
+    "triplet_margin_with_distance_loss", "hsigmoid_loss",
+    "margin_cross_entropy", "adaptive_log_softmax_with_loss",
+    "rnnt_loss", "gather_tree", "sparse_attention",
+    "flash_attn_qkvpacked", "flash_attn_varlen_qkvpacked",
+    "flashmask_attention", "class_center_sample",
+    "elu_", "hardtanh_", "leaky_relu_", "softmax_", "tanh_",
+    "thresholded_relu_",
+]
+
+
+def _u(fn, name, *xs, **kw):
+    return apply_op(fn, *xs, _op_name=name, **kw)
+
+
+# ---------------------------------------------------------------------------
+# shared window-patches helper (the unpool/fractional/LP pooling backbone)
+# ---------------------------------------------------------------------------
+
+def _patches(a, k, s):
+    """[B, C, *sp] -> (windows [B, C, *out, prod(k)], out_sizes).
+    No padding (callers pre-pad); pure gather, so grads flow."""
+    nd = len(k)
+    out_sizes = [(a.shape[2 + i] - k[i]) // s[i] + 1 for i in range(nd)]
+    idx_grids = []
+    for i in range(nd):
+        starts = jnp.arange(out_sizes[i]) * s[i]
+        offs = jnp.arange(k[i])
+        idx = starts[:, None] + offs[None, :]  # [out_i, k_i]
+        idx_grids.append(idx)
+    out = a
+    # successively gather each spatial axis into (out_i, k_i) pairs
+    for i in range(nd):
+        axis = 2 + 2 * i  # prior axes already split into (out, k)
+        out = jnp.take(out, idx_grids[i], axis=axis)
+    # now shape [B, C, o1, k1, o2, k2, ...] -> [B, C, o..., k...]
+    perm = [0, 1] + [2 + 2 * i for i in range(nd)] + \
+           [3 + 2 * i for i in range(nd)]
+    out = jnp.transpose(out, perm)
+    return out.reshape(out.shape[:2 + nd] + (-1,)), out_sizes
+
+
+def _tuple(v, n):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
+
+
+# ---------------------------------------------------------------------------
+# pooling family
+# ---------------------------------------------------------------------------
+
+def max_pool_with_index(x, kernel_size, stride=None, padding=0, nd=2,
+                        ceil_mode=False):
+    """(pooled, indices): indices are flat positions in the input's
+    spatial plane (paddle's max_pool return_mask contract)."""
+    k = _tuple(kernel_size, nd)
+    s = _tuple(stride if stride is not None else kernel_size, nd)
+    p = _tuple(padding, nd)
+
+    def f(a):
+        in_sp = a.shape[2:]
+        pads = [(pi, pi) for pi in p]
+        if ceil_mode:
+            # extend right padding so the ceil-counted last window fits
+            pads = []
+            for i, pi in enumerate(p):
+                span = in_sp[i] + 2 * pi - k[i]
+                n_out = -(-span // s[i]) + 1  # ceil division
+                need = (n_out - 1) * s[i] + k[i] - (in_sp[i] + 2 * pi)
+                pads.append((pi, pi + max(need, 0)))
+        a_p = jnp.pad(a, [(0, 0), (0, 0)] + pads,
+                      constant_values=-jnp.inf)
+        win, out_sizes = _patches(a_p, k, s)
+        arg = jnp.argmax(win, axis=-1)
+        pooled = jnp.max(win, axis=-1)
+        # window-local flat idx -> input-plane flat idx
+        loc = jnp.unravel_index(arg, k)
+        coords = []
+        for i in range(nd):
+            starts = jnp.arange(out_sizes[i]) * s[i] - p[i]
+            shape = [1] * arg.ndim
+            shape[2 + i] = out_sizes[i]
+            coords.append(loc[i] + starts.reshape(shape))
+        flat = coords[0]
+        for i in range(1, nd):
+            flat = flat * in_sp[i] + coords[i]
+        return pooled, flat.astype(jnp.int32)
+    return _u(f, "max_pool_with_index", x)
+
+
+def _unpool(x, indices, nd, kernel_size, stride=None, padding=0,
+            output_size=None, name=None):
+    k = _tuple(kernel_size, nd)
+    s = _tuple(stride if stride is not None else kernel_size, nd)
+    p = _tuple(padding, nd)
+
+    def f(a, idx):
+        in_sp = a.shape[2:]
+        if output_size is not None:
+            out_sp = tuple(output_size)[-nd:]
+        else:
+            out_sp = tuple((in_sp[i] - 1) * s[i] - 2 * p[i] + k[i]
+                           for i in range(nd))
+        B, C = a.shape[:2]
+        flat_out = jnp.zeros((B, C, int(np.prod(out_sp))), a.dtype)
+        fi = idx.reshape(B, C, -1)
+        fv = a.reshape(B, C, -1)
+        flat_out = jax.vmap(jax.vmap(
+            lambda o, i, v: o.at[i].set(v)))(flat_out, fi, fv)
+        return flat_out.reshape((B, C) + out_sp)
+    return _u(f, f"max_unpool{nd}d", x, indices)
+
+
+def max_unpool1d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+    return _unpool(x, indices, 1, kernel_size, stride, padding,
+                   output_size)
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+    return _unpool(x, indices, 2, kernel_size, stride, padding,
+                   output_size)
+
+
+def max_unpool3d(x, indices, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+    return _unpool(x, indices, 3, kernel_size, stride, padding,
+                   output_size)
+
+
+def _lp_pool(x, nd, norm_type, kernel_size, stride=None, padding=0,
+             ceil_mode=False):
+    k = _tuple(kernel_size, nd)
+    s = _tuple(stride if stride is not None else kernel_size, nd)
+    p = _tuple(padding, nd)
+
+    def f(a):
+        pads = [(pi, pi) for pi in p]
+        a_p = jnp.pad(a, [(0, 0), (0, 0)] + pads)
+        win, _ = _patches(a_p, k, s)
+        pw = jnp.sum(jnp.abs(win) ** norm_type, axis=-1)
+        return pw ** (1.0 / norm_type)
+    return _u(f, f"lp_pool{nd}d", x)
+
+
+def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCL", name=None):
+    return _lp_pool(x, 1, float(norm_type), kernel_size, stride, padding)
+
+
+def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
+              ceil_mode=False, data_format="NCHW", name=None):
+    return _lp_pool(x, 2, float(norm_type), kernel_size, stride, padding)
+
+
+def _fractional_pool(x, nd, output_size, random_u=None):
+    def f(a):
+        in_sp = a.shape[2:]
+        outs = _tuple(output_size, nd)
+        u = random_u if random_u is not None else 0.5
+        gathered = a
+        for i in range(nd):
+            n_in, n_out = in_sp[i], outs[i]
+            alpha = n_in / n_out
+            # pseudo-fractional boundaries (Graham 2014): ceil(alpha*(i+u))
+            edges = jnp.floor(alpha * (jnp.arange(n_out) + u)).astype(
+                jnp.int32)
+            starts = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                                      edges[:-1]])
+            sizes = edges - starts
+            kmax = int(math.ceil(alpha)) + 1
+            offs = jnp.arange(kmax)
+            idx = jnp.minimum(starts[:, None] + offs[None, :], n_in - 1)
+            valid = offs[None, :] < jnp.maximum(sizes, 1)[:, None]
+            axis = 2 + i
+            win = jnp.take(gathered, idx, axis=axis)  # [..., n_out, kmax, ...]
+            mask_shape = [1] * win.ndim
+            mask_shape[axis] = idx.shape[0]
+            mask_shape[axis + 1] = kmax
+            m = jnp.reshape(valid, mask_shape)
+            win = jnp.where(m, win, -jnp.inf)
+            gathered = jnp.max(win, axis=axis + 1)
+        return gathered
+    return _u(f, f"fractional_max_pool{nd}d", x)
+
+
+def fractional_max_pool2d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False, name=None):
+    out = _fractional_pool(x, 2, output_size, random_u)
+    return (out, None) if return_mask else out
+
+
+def fractional_max_pool3d(x, output_size, kernel_size=None,
+                          random_u=None, return_mask=False, name=None):
+    out = _fractional_pool(x, 3, output_size, random_u)
+    return (out, None) if return_mask else out
+
+
+# ---------------------------------------------------------------------------
+# vision / sequence
+# ---------------------------------------------------------------------------
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """2-D affine sampling grid (paddle contract: out [N, H, W, 2])."""
+    def f(t):
+        N = t.shape[0]
+        H, W = int(out_shape[-2]), int(out_shape[-1])
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, H)
+            xs = jnp.linspace(-1.0, 1.0, W)
+        else:
+            ys = (jnp.arange(H) + 0.5) * 2.0 / H - 1.0
+            xs = (jnp.arange(W) + 0.5) * 2.0 / W - 1.0
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=-1).reshape(-1, 3)  # [HW,3]
+        out = jnp.einsum("nij,pj->npi", t.astype(jnp.float32), base)
+        return out.reshape(N, H, W, 2)
+    return _u(f, "affine_grid", theta)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    """Sample x [N,C,H,W] at normalized grid [N,Hg,Wg,2] locations."""
+    def f(a, g):
+        N, C, H, W = a.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            fx = (gx + 1.0) * (W - 1) / 2.0
+            fy = (gy + 1.0) * (H - 1) / 2.0
+        else:
+            fx = ((gx + 1.0) * W - 1.0) / 2.0
+            fy = ((gy + 1.0) * H - 1.0) / 2.0
+
+        def gather(ix, iy):
+            inb = (ix >= 0) & (ix < W) & (iy >= 0) & (iy < H)
+            if padding_mode == "border":
+                ixc = jnp.clip(ix, 0, W - 1)
+                iyc = jnp.clip(iy, 0, H - 1)
+                inb = jnp.ones_like(inb)
+            else:  # zeros
+                ixc = jnp.clip(ix, 0, W - 1)
+                iyc = jnp.clip(iy, 0, H - 1)
+            vals = jax.vmap(lambda img, yy, xx: img[:, yy, xx])(
+                a, iyc, ixc)  # [N, C, Hg, Wg]
+            return vals * inb[:, None].astype(a.dtype)
+
+        if mode == "nearest":
+            return gather(jnp.round(fx).astype(jnp.int32),
+                          jnp.round(fy).astype(jnp.int32))
+        x0 = jnp.floor(fx).astype(jnp.int32)
+        y0 = jnp.floor(fy).astype(jnp.int32)
+        x1, y1 = x0 + 1, y0 + 1
+        wx = (fx - x0).astype(a.dtype)[:, None]
+        wy = (fy - y0).astype(a.dtype)[:, None]
+        v00 = gather(x0, y0)
+        v01 = gather(x1, y0)
+        v10 = gather(x0, y1)
+        v11 = gather(x1, y1)
+        top = v00 * (1 - wx) + v01 * wx
+        bot = v10 * (1 - wx) + v11 * wx
+        return top * (1 - wy) + bot * wy
+    return _u(f, "grid_sample", x, grid)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    def f(a):
+        NT, C, H, W = a.shape
+        N = NT // seg_num
+        v = a.reshape(N, seg_num, C, H, W)
+        fold = int(C * shift_ratio)
+        left = jnp.concatenate([v[:, 1:, :fold],
+                                jnp.zeros_like(v[:, :1, :fold])], axis=1)
+        right = jnp.concatenate([jnp.zeros_like(v[:, :1, fold:2 * fold]),
+                                 v[:, :-1, fold:2 * fold]], axis=1)
+        rest = v[:, :, 2 * fold:]
+        return jnp.concatenate([left, right, rest],
+                               axis=2).reshape(NT, C, H, W)
+    return _u(f, "temporal_shift", x)
+
+
+def sequence_mask(x, maxlen=None, dtype="int64", name=None):
+    from ...framework.dtype import to_dtype
+    def f(lens):
+        m = maxlen if maxlen is not None else int(jnp.max(lens))
+        return (jnp.arange(m)[None, :] <
+                lens.reshape(-1, 1)).astype(to_dtype(dtype).np_dtype)
+    if maxlen is None:
+        lens_np = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+        m = int(lens_np.max())
+        return _u(lambda l: (jnp.arange(m)[None, :] < l.reshape(-1, 1))
+                  .astype(to_dtype(dtype).np_dtype), "sequence_mask", x)
+    return _u(f, "sequence_mask", x)
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (paddle.nn.functional.gather_tree):
+    ids/parents [T, B, beam] -> full sequences per beam."""
+    def f(i, p):
+        T = i.shape[0]
+
+        def step(carry, t):
+            beams = carry  # [B, beam] current beam index per slot
+            tok = jnp.take_along_axis(i[t], beams, axis=-1)
+            par = jnp.take_along_axis(p[t], beams, axis=-1)
+            return par, tok
+
+        _, toks = jax.lax.scan(step, jnp.broadcast_to(
+            jnp.arange(i.shape[2]), i.shape[1:]), jnp.arange(T - 1, -1, -1))
+        return jnp.flip(toks, axis=0)
+    return _u(f, "gather_tree", ids, parents)
+
+
+# ---------------------------------------------------------------------------
+# dropout / distance
+# ---------------------------------------------------------------------------
+
+def feature_alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = rnd.op_key(x)
+
+    def f(a, k):
+        alpha_p = -1.7580993408473766
+        shape = (a.shape[0], a.shape[1]) + (1,) * (a.ndim - 2)
+        keep = jax.random.bernoulli(k, 1.0 - p, shape)
+        q = 1.0 - p
+        A = (q + alpha_p ** 2 * q * (1 - q)) ** -0.5
+        B = -A * alpha_p * (1 - q)
+        return (A * jnp.where(keep, a, alpha_p) + B).astype(a.dtype)
+    return _u(f, "feature_alpha_dropout", x, key)
+
+
+def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False,
+                      name=None):
+    def f(a, b):
+        d = a - b + epsilon
+        return jnp.sum(jnp.abs(d) ** p, axis=-1,
+                       keepdims=keepdim) ** (1.0 / p)
+    return _u(f, "pairwise_distance", x, y)
+
+
+# ---------------------------------------------------------------------------
+# losses
+# ---------------------------------------------------------------------------
+
+def _reduce(out, reduction):
+    if reduction == "mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
+
+
+def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
+                      reduction="mean", name=None):
+    def f(mu, y, var):
+        v = jnp.maximum(var, epsilon)
+        loss = 0.5 * (jnp.log(v) + (y - mu) ** 2 / v)
+        if full:
+            loss = loss + 0.5 * jnp.log(jnp.asarray(2 * jnp.pi, v.dtype))
+        return _reduce(loss, reduction)
+    return _u(f, "gaussian_nll_loss", input, label, variance)
+
+
+def poisson_nll_loss(input, label, log_input=True, full=False,
+                     epsilon=1e-8, reduction="mean", name=None):
+    def f(x, y):
+        if log_input:
+            loss = jnp.exp(x) - y * x
+        else:
+            loss = x - y * jnp.log(x + epsilon)
+        if full:
+            stirling = y * jnp.log(y + epsilon) - y + \
+                0.5 * jnp.log(2 * jnp.pi * (y + epsilon))
+            loss = loss + jnp.where(y > 1, stirling, 0.0)
+        return _reduce(loss, reduction)
+    return _u(f, "poisson_nll_loss", input, label)
+
+
+def soft_margin_loss(input, label, reduction="mean", name=None):
+    def f(x, y):
+        return _reduce(jnp.log1p(jnp.exp(-y * x)), reduction)
+    return _u(f, "soft_margin_loss", input, label)
+
+
+def multi_label_soft_margin_loss(input, label, weight=None,
+                                 reduction="mean", name=None):
+    def f(x, y, *w):
+        loss = -(y * jax.nn.log_sigmoid(x) +
+                 (1 - y) * jax.nn.log_sigmoid(-x))
+        if w:
+            loss = loss * w[0]
+        return _reduce(jnp.mean(loss, axis=-1), reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return _u(f, "multi_label_soft_margin_loss", *args)
+
+
+def multi_margin_loss(input, label, p=1, margin=1.0, weight=None,
+                      reduction="mean", name=None):
+    def f(x, y, *w):
+        N, C = x.shape
+        correct = jnp.take_along_axis(x, y[:, None], axis=1)
+        m = jnp.maximum(0.0, margin - correct + x) ** p
+        if w:
+            m = m * w[0][y][:, None]
+        mask = jax.nn.one_hot(y, C) == 0
+        return _reduce(jnp.sum(m * mask, axis=1) / C, reduction)
+    args = (input, label) + ((weight,) if weight is not None else ())
+    return _u(f, "multi_margin_loss", *args)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    def f(a, pos, y):
+        sim = a @ pos.T  # [N, N]
+        eq = (y[:, None] == y[None, :]).astype(a.dtype)
+        tgt = eq / jnp.sum(eq, axis=1, keepdims=True)
+        xent = jnp.mean(jnp.sum(
+            -tgt * jax.nn.log_softmax(sim, axis=1), axis=1))
+        reg = l2_reg * (jnp.mean(jnp.sum(a * a, axis=1)) +
+                        jnp.mean(jnp.sum(pos * pos, axis=1))) * 0.25
+        return xent + reg
+    return _u(f, "npair_loss", anchor, positive, labels)
+
+
+def triplet_margin_with_distance_loss(input, positive, negative,
+                                      distance_function=None, margin=1.0,
+                                      swap=False, reduction="mean",
+                                      name=None):
+    dist = distance_function or (lambda a, b: pairwise_distance(a, b))
+    d_pos = dist(input, positive)
+    d_neg = dist(input, negative)
+    if swap:
+        d_pn = dist(positive, negative)
+        d_neg = _u(lambda a, b: jnp.minimum(a, b), "min", d_neg, d_pn)
+    return _u(lambda dp, dn: _reduce(
+        jnp.maximum(dp - dn + margin, 0.0), reduction),
+        "triplet_margin_with_distance_loss", d_pos, d_neg)
+
+
+def hsigmoid_loss(input, label, num_classes, weight, bias=None,
+                  path_table=None, path_code=None, is_sparse=False,
+                  name=None):
+    """Hierarchical sigmoid over the default complete binary tree
+    (paddle contract: num_classes-1 internal nodes; class c's path is
+    its binary encoding from the root)."""
+    if path_table is not None or path_code is not None:
+        raise NotImplementedError(
+            "custom-tree hsigmoid (path_table/path_code) not supported; "
+            "use the default complete-binary-tree mode")
+    depth = max(int(math.ceil(math.log2(max(num_classes, 2)))), 1)
+
+    # host-side: per-class node path + branch codes in the complete tree
+    codes = np.zeros((num_classes, depth), np.int64)
+    nodes = np.zeros((num_classes, depth), np.int64)
+    lengths = np.zeros((num_classes,), np.int64)
+    for c in range(num_classes):
+        node = c + num_classes  # leaves occupy [num_classes, 2*num_classes)
+        path = []
+        while node > 1:
+            path.append((node // 2, node % 2))
+            node //= 2
+        path.reverse()
+        lengths[c] = len(path)
+        for d, (n, code) in enumerate(path):
+            nodes[c, d] = n - 1  # internal node ids are 1-based heap
+            codes[c, d] = code
+
+    def f(x, y, w, *b):
+        # weight is [num_classes-1, K] (one row per internal heap node)
+        nid = jnp.asarray(nodes)[y]      # [N, depth], values in [0, C-2]
+        code = jnp.asarray(codes)[y].astype(x.dtype)
+        ln = jnp.asarray(lengths)[y]
+        wn = w[nid]                      # [N, depth, K]
+        logits = jnp.einsum("nk,ndk->nd", x, wn)
+        if b:
+            logits = logits + b[0][nid]
+        valid = jnp.arange(depth)[None, :] < ln[:, None]
+        bce = jnp.maximum(logits, 0) - logits * code + \
+            jnp.log1p(jnp.exp(-jnp.abs(logits)))
+        return jnp.mean(jnp.sum(jnp.where(valid, bce, 0.0), axis=1))
+    args = (input, label, weight) + ((bias,) if bias is not None else ())
+    return _u(f, "hsigmoid_loss", *args)
+
+
+def margin_cross_entropy(logits, label, margin1=1.0, margin2=0.5,
+                         margin3=0.0, scale=64.0, group=None,
+                         return_softmax=False, reduction="mean"):
+    """ArcFace-style margin softmax (incubate margin_cross_entropy)."""
+    def f(lg, y):
+        N, C = lg.shape
+        theta = jnp.arccos(jnp.clip(lg, -1.0 + 1e-7, 1.0 - 1e-7))
+        m_theta = margin1 * theta + margin2
+        target_logit = jnp.cos(m_theta) - margin3
+        onehot = jax.nn.one_hot(y, C, dtype=lg.dtype)
+        out = (lg * (1 - onehot) + target_logit * onehot) * scale
+        logp = jax.nn.log_softmax(out, axis=-1)
+        loss = -jnp.take_along_axis(logp, y[:, None], axis=1)[:, 0]
+        return (_reduce(loss, reduction), jnp.exp(logp)) \
+            if return_softmax else _reduce(loss, reduction)
+    return _u(f, "margin_cross_entropy", logits, label)
+
+
+def adaptive_log_softmax_with_loss(input, label, head_weight,
+                                   tail_weights, cutoffs,
+                                   head_bias=None, name=None):
+    """Adaptive softmax (Grave et al.): frequent classes in the head,
+    rare classes in down-projected tail clusters."""
+    n_clusters = len(cutoffs)
+    shortlist = cutoffs[0]
+
+    def f(x, y, hw, *rest):
+        hb = rest[0] if head_bias is not None else None
+        tails = rest[1 if head_bias is not None else 0:]
+        head_logits = x @ hw  # [N, shortlist + n_tail_clusters]
+        if hb is not None:
+            head_logits = head_logits + hb
+        head_logp = jax.nn.log_softmax(head_logits, axis=-1)
+        out = jnp.zeros(y.shape, x.dtype)
+        in_short = y < shortlist
+        short_lp = jnp.take_along_axis(
+            head_logp, jnp.where(in_short, y, 0)[:, None], axis=1)[:, 0]
+        out = jnp.where(in_short, short_lp, out)
+        # tail cluster ci covers classes [cutoffs[ci], cutoffs[ci+1])
+        for ci in range(n_clusters - 1):
+            lo_c, hi_c = cutoffs[ci], cutoffs[ci + 1]
+            proj, cw = tails[2 * ci], tails[2 * ci + 1]
+            t_logp = jax.nn.log_softmax((x @ proj) @ cw, axis=-1)
+            in_c = (y >= lo_c) & (y < hi_c)
+            rel = jnp.where(in_c, y - lo_c, 0)
+            lp = head_logp[:, shortlist + ci] + jnp.take_along_axis(
+                t_logp, rel[:, None], axis=1)[:, 0]
+            out = jnp.where(in_c, lp, out)
+        return out, -jnp.mean(out)
+
+    args = [input, label, head_weight]
+    if head_bias is not None:
+        args.append(head_bias)
+    for tw in tail_weights:
+        args.extend(tw if isinstance(tw, (tuple, list)) else [tw])
+    return _u(f, "adaptive_log_softmax_with_loss", *args)
+
+
+def rnnt_loss(input, label, input_lengths, label_lengths, blank=0,
+              fastemit_lambda=0.0, reduction="mean", name=None):
+    """RNN-T loss via the standard alpha-recursion DP
+    (log-domain, scanned over time; reference wraps warprnnt)."""
+    def f(logits, y, t_lens, u_lens):
+        # logits [B, T, U+1, V]; standard recursion:
+        #   alpha[t, u] = logaddexp(alpha[t-1, u] + blank[t-1, u],
+        #                           alpha[t, u-1] + y_emit[t, u-1])
+        lp = jax.nn.log_softmax(logits, axis=-1)
+        B, T, U1, V = lp.shape
+        blank_lp = lp[..., blank]                       # [B, T, U+1]
+        y_lp = jnp.take_along_axis(
+            lp[:, :, :-1, :], y[:, None, :, None].astype(jnp.int32),
+            axis=-1)[..., 0]                            # [B, T, U]
+
+        def row(emit_in, y_row):
+            # sequential in u: alpha_t[u] from alpha_t[u-1]
+            def body(left, u):
+                val = jnp.logaddexp(emit_in[:, u], left + y_row[:, u - 1])
+                return val, val
+            a0 = emit_in[:, 0]
+            _, rest = jax.lax.scan(body, a0, jnp.arange(1, U1))
+            return jnp.concatenate([a0[None], rest], axis=0).T  # [B, U+1]
+
+        # t = 0: no arrival from above; u-chain only
+        neg = jnp.full((B, U1), -1e30).at[:, 0].set(0.0)
+        alpha0 = row(neg, y_lp[:, 0, :])
+
+        def time_step(alpha_prev, t):
+            emit_in = alpha_prev + blank_lp[:, t - 1, :]
+            alpha_t = row(emit_in, y_lp[:, t, :])
+            return alpha_t, alpha_t
+
+        _, alphas_rest = jax.lax.scan(time_step, alpha0,
+                                      jnp.arange(1, T))
+        alphas = jnp.concatenate([alpha0[None], alphas_rest],
+                                 axis=0)  # [T, B, U+1]
+        # ll = alpha[t_len-1, u_len] + blank[t_len-1, u_len]
+        t_idx = (t_lens - 1).astype(jnp.int32)
+        u_idx = u_lens.astype(jnp.int32)
+        batch = jnp.arange(B)
+        ll = alphas[t_idx, batch, u_idx] + \
+            blank_lp[batch, t_idx, u_idx]
+        return _reduce(-ll, reduction)
+    return _u(f, "rnnt_loss", input, label, input_lengths, label_lengths)
+
+
+# ---------------------------------------------------------------------------
+# attention wrappers / misc
+# ---------------------------------------------------------------------------
+
+def flash_attn_qkvpacked(qkv, dropout=0.0, causal=False,
+                         return_softmax=False, training=True, name=None):
+    from .attention import scaled_dot_product_attention
+    q = qkv[:, :, 0]
+    k = qkv[:, :, 1]
+    v = qkv[:, :, 2]
+    out = scaled_dot_product_attention(q, k, v, dropout_p=dropout,
+                                       is_causal=causal,
+                                       training=training)
+    return out, None
+
+
+def flash_attn_varlen_qkvpacked(qkv, cu_seqlens, max_seqlen, scale=None,
+                                dropout=0.0, causal=False,
+                                return_softmax=False, training=True,
+                                name=None):
+    from .attention import flash_attn_unpadded
+    q = qkv[:, 0]
+    k = qkv[:, 1]
+    v = qkv[:, 2]
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    return flash_attn_unpadded(q, k, v, cu_seqlens, cu_seqlens,
+                               max_seqlen, max_seqlen, scale,
+                               dropout, causal, return_softmax, training)
+
+
+def flashmask_attention(query, key, value, startend_row_indices=None,
+                        causal=True, name=None):
+    """FlashMask (column-wise sparse masking): for key column j, query
+    rows in [start_j, Sq) are masked out on top of the causal triangle.
+    Computed as a dense bool mask — XLA fuses it into the attention
+    (the reference fuses the same predicate in its CUDA kernel)."""
+    from .attention import scaled_dot_product_attention
+    if startend_row_indices is None:
+        return scaled_dot_product_attention(query, key, value,
+                                            is_causal=causal)
+    Sq = query.shape[1]
+    Skv = key.shape[1]
+
+    def build(idx):
+        start = idx.reshape(idx.shape[0], Skv)  # [B, Skv] (LT-1 layout)
+        rows = jnp.arange(Sq)[None, :, None]
+        cols = jnp.arange(Skv)[None, None, :]
+        base = rows >= cols if causal else \
+            jnp.ones((1, Sq, Skv), bool)
+        allowed = base & (rows < start[:, None, :])
+        return allowed[:, None]  # [B, 1, Sq, Skv]
+    mask = _u(build, "flashmask_build", startend_row_indices)
+    return scaled_dot_product_attention(query, key, value,
+                                        attn_mask=mask)
+
+
+def sparse_attention(query, key, value, sparse_csr_offset,
+                     sparse_csr_columns, key_padding_mask=None,
+                     attn_mask=None, name=None):
+    """Block-sparse attention contract; computed densely with the CSR
+    pattern materialized as a mask (XLA fuses; the reference uses a
+    dedicated CUDA kernel)."""
+    def f(q, k, v, offs, cols):
+        B, H, S, D = q.shape
+        logits = jnp.einsum("bhsd,bhtd->bhst", q, k) / math.sqrt(D)
+        dense_mask = jnp.zeros((S, S), bool)
+        # CSR -> dense (host shapes; offs/cols are static-sized)
+        row_ids = jnp.repeat(jnp.arange(S), jnp.diff(offs[0, 0]),
+                             total_repeat_length=cols.shape[-1])
+        dense_mask = dense_mask.at[row_ids, cols[0, 0]].set(True)
+        logits = jnp.where(dense_mask, logits, -1e30)
+        probs = jax.nn.softmax(logits, axis=-1)
+        return jnp.einsum("bhst,bhtd->bhsd", probs, v)
+    return _u(f, "sparse_attention", query, key, value,
+              sparse_csr_offset, sparse_csr_columns)
+
+
+def class_center_sample(label, num_classes, num_samples, group=None):
+    """Sample negative class centers plus all positives (PLSC-style
+    partial-fc): returns (remapped_label, sampled_class_indices)."""
+    lbl = np.asarray(label.numpy() if isinstance(label, Tensor) else label)
+    pos = np.unique(lbl)
+    if len(pos) >= num_samples:
+        sampled = pos
+    else:
+        rest = np.setdiff1d(np.arange(num_classes), pos)
+        seed = int(np.asarray(
+            jax.random.key_data(rnd.next_key())).ravel()[0]) & 0x7fffffff
+        rng = np.random.RandomState(seed)
+        extra = rng.choice(rest, num_samples - len(pos), replace=False)
+        sampled = np.sort(np.concatenate([pos, extra]))
+    remap = {int(c): i for i, c in enumerate(sampled)}
+    new_label = np.asarray([remap[int(c)] for c in lbl], np.int64)
+    return Tensor(new_label), Tensor(sampled.astype(np.int64))
+
+
+# ---------------------------------------------------------------------------
+# in-place activations
+# ---------------------------------------------------------------------------
+
+def _mk_inplace(base_name):
+    from . import activation as act_mod
+
+    base = getattr(act_mod, base_name)
+
+    def fn(x, *args, **kwargs):
+        return x._inplace(base(x._snapshot(), *args, **kwargs))
+    fn.__name__ = base_name + "_"
+    return fn
+
+
+elu_ = _mk_inplace("elu")
+hardtanh_ = _mk_inplace("hardtanh")
+leaky_relu_ = _mk_inplace("leaky_relu")
+softmax_ = _mk_inplace("softmax")
+tanh_ = _mk_inplace("tanh")
+thresholded_relu_ = _mk_inplace("thresholded_relu")

@@ -65,18 +65,30 @@ def _pool(x, kernel_size, stride, padding, n, reducer, init, ceil_mode,
 
 def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCL", name=None):
+    if return_mask:
+        from .extras import max_pool_with_index
+        return max_pool_with_index(x, kernel_size, stride, padding,
+                                   nd=1, ceil_mode=ceil_mode)
     return _pool(x, kernel_size, stride, padding, 1, jax.lax.max,
                  -jnp.inf, ceil_mode, True, "max_pool1d")
 
 
 def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCHW", name=None):
+    if return_mask:
+        from .extras import max_pool_with_index
+        return max_pool_with_index(x, kernel_size, stride, padding,
+                                   nd=2, ceil_mode=ceil_mode)
     return _pool(x, kernel_size, stride, padding, 2, jax.lax.max,
                  -jnp.inf, ceil_mode, True, "max_pool2d")
 
 
 def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
                ceil_mode=False, data_format="NCDHW", name=None):
+    if return_mask:
+        from .extras import max_pool_with_index
+        return max_pool_with_index(x, kernel_size, stride, padding,
+                                   nd=3, ceil_mode=ceil_mode)
     return _pool(x, kernel_size, stride, padding, 3, jax.lax.max,
                  -jnp.inf, ceil_mode, True, "max_pool3d")
 

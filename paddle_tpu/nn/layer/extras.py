@@ -1,0 +1,405 @@
+"""Long-tail nn layer parity: wrappers over functional.extras plus the
+seq2seq decoding helpers (reference: python/paddle/nn/layer/{loss,
+pooling,distance,container}.py and nn/decode.py)."""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ...framework.tensor import Tensor
+from .. import functional as F
+from ..layer_base import Layer
+
+__all__ = [
+    "PairwiseDistance", "Silu", "Softmax2D", "Unflatten", "ZeroPad1D",
+    "ZeroPad3D", "FeatureAlphaDropout", "LPPool1D", "LPPool2D",
+    "MaxUnPool1D", "MaxUnPool2D", "MaxUnPool3D", "FractionalMaxPool2D",
+    "FractionalMaxPool3D", "GaussianNLLLoss", "PoissonNLLLoss",
+    "SoftMarginLoss", "MultiLabelSoftMarginLoss", "MultiMarginLoss",
+    "TripletMarginWithDistanceLoss", "HSigmoidLoss", "RNNTLoss",
+    "AdaptiveLogSoftmaxWithLoss", "ParameterDict", "BeamSearchDecoder",
+    "dynamic_decode",
+]
+
+
+class PairwiseDistance(Layer):
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.epsilon, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        return F.pairwise_distance(x, y, self.p, self.epsilon,
+                                   self.keepdim)
+
+
+class Silu(Layer):
+    def __init__(self, name=None):
+        super().__init__()
+
+    def forward(self, x):
+        return F.silu(x)
+
+
+class Softmax2D(Layer):
+    """Softmax over the channel dim of NCHW input."""
+
+    def forward(self, x):
+        return F.softmax(x, axis=-3)
+
+
+class Unflatten(Layer):
+    def __init__(self, axis, shape, name=None):
+        super().__init__()
+        self.axis, self._shape = axis, shape
+
+    def forward(self, x):
+        return x.unflatten(self.axis, self._shape)
+
+
+class _ZeroPadNd(Layer):
+    def __init__(self, padding, nd, data_format):
+        super().__init__()
+        if isinstance(padding, int):
+            padding = [padding] * (2 * nd)
+        self.padding = list(padding)
+        self.nd = nd
+
+    def forward(self, x):
+        from ..._pad_reexport import pad
+        return pad(x, self.padding, mode="constant", value=0.0)
+
+
+class ZeroPad1D(_ZeroPadNd):
+    def __init__(self, padding, data_format="NCL", name=None):
+        super().__init__(padding, 1, data_format)
+
+
+class ZeroPad3D(_ZeroPadNd):
+    def __init__(self, padding, data_format="NCDHW", name=None):
+        super().__init__(padding, 3, data_format)
+
+
+class FeatureAlphaDropout(Layer):
+    def __init__(self, p=0.5, name=None):
+        super().__init__()
+        self.p = p
+
+    def forward(self, x):
+        return F.feature_alpha_dropout(x, self.p, self.training)
+
+
+class LPPool1D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCL", name=None):
+        super().__init__()
+        self.args = (norm_type, kernel_size, stride, padding, ceil_mode)
+
+    def forward(self, x):
+        n, k, s, p, c = self.args
+        return F.lp_pool1d(x, n, k, s, p, c)
+
+
+class LPPool2D(Layer):
+    def __init__(self, norm_type, kernel_size, stride=None, padding=0,
+                 ceil_mode=False, data_format="NCHW", name=None):
+        super().__init__()
+        self.args = (norm_type, kernel_size, stride, padding, ceil_mode)
+
+    def forward(self, x):
+        n, k, s, p, c = self.args
+        return F.lp_pool2d(x, n, k, s, p, c)
+
+
+class _MaxUnPoolNd(Layer):
+    def __init__(self, fn, kernel_size, stride=None, padding=0,
+                 output_size=None):
+        super().__init__()
+        self.fn = fn
+        self.args = (kernel_size, stride, padding)
+        self.output_size = output_size
+
+    def forward(self, x, indices):
+        k, s, p = self.args
+        return self.fn(x, indices, k, s, p,
+                       output_size=self.output_size)
+
+
+class MaxUnPool1D(_MaxUnPoolNd):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCL", output_size=None, name=None):
+        super().__init__(F.max_unpool1d, kernel_size, stride, padding,
+                         output_size)
+
+
+class MaxUnPool2D(_MaxUnPoolNd):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCHW", output_size=None, name=None):
+        super().__init__(F.max_unpool2d, kernel_size, stride, padding,
+                         output_size)
+
+
+class MaxUnPool3D(_MaxUnPoolNd):
+    def __init__(self, kernel_size, stride=None, padding=0,
+                 data_format="NCDHW", output_size=None, name=None):
+        super().__init__(F.max_unpool3d, kernel_size, stride, padding,
+                         output_size)
+
+
+class FractionalMaxPool2D(Layer):
+    def __init__(self, output_size, kernel_size=None, random_u=None,
+                 return_mask=False, name=None):
+        super().__init__()
+        self.output_size = output_size
+        self.random_u = random_u
+        self.return_mask = return_mask
+
+    def forward(self, x):
+        return F.fractional_max_pool2d(x, self.output_size,
+                                       random_u=self.random_u,
+                                       return_mask=self.return_mask)
+
+
+class FractionalMaxPool3D(FractionalMaxPool2D):
+    def forward(self, x):
+        return F.fractional_max_pool3d(x, self.output_size,
+                                       random_u=self.random_u,
+                                       return_mask=self.return_mask)
+
+
+class GaussianNLLLoss(Layer):
+    def __init__(self, full=False, epsilon=1e-6, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.full, self.epsilon, self.reduction = full, epsilon, reduction
+
+    def forward(self, input, label, variance):
+        return F.gaussian_nll_loss(input, label, variance, self.full,
+                                   self.epsilon, self.reduction)
+
+
+class PoissonNLLLoss(Layer):
+    def __init__(self, log_input=True, full=False, epsilon=1e-8,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.cfg = (log_input, full, epsilon, reduction)
+
+    def forward(self, input, label):
+        li, fu, ep, red = self.cfg
+        return F.poisson_nll_loss(input, label, li, fu, ep, red)
+
+
+class SoftMarginLoss(Layer):
+    def __init__(self, reduction="mean", name=None):
+        super().__init__()
+        self.reduction = reduction
+
+    def forward(self, input, label):
+        return F.soft_margin_loss(input, label, self.reduction)
+
+
+class MultiLabelSoftMarginLoss(Layer):
+    def __init__(self, weight=None, reduction="mean", name=None):
+        super().__init__()
+        self.weight, self.reduction = weight, reduction
+
+    def forward(self, input, label):
+        return F.multi_label_soft_margin_loss(input, label, self.weight,
+                                              self.reduction)
+
+
+class MultiMarginLoss(Layer):
+    def __init__(self, p=1, margin=1.0, weight=None, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.cfg = (p, margin, weight, reduction)
+
+    def forward(self, input, label):
+        p, m, w, red = self.cfg
+        return F.multi_margin_loss(input, label, p, m, w, red)
+
+
+class TripletMarginWithDistanceLoss(Layer):
+    def __init__(self, distance_function=None, margin=1.0, swap=False,
+                 reduction="mean", name=None):
+        super().__init__()
+        self.cfg = (distance_function, margin, swap, reduction)
+
+    def forward(self, input, positive, negative):
+        d, m, s, red = self.cfg
+        return F.triplet_margin_with_distance_loss(input, positive,
+                                                   negative, d, m, s, red)
+
+
+class HSigmoidLoss(Layer):
+    def __init__(self, feature_size, num_classes, weight_attr=None,
+                 bias_attr=None, is_custom=False, is_sparse=False,
+                 name=None):
+        super().__init__()
+        self.num_classes = num_classes
+        self.weight = self.create_parameter(
+            [num_classes - 1, feature_size], attr=weight_attr)
+        self.bias = None if bias_attr is False else \
+            self.create_parameter([num_classes - 1], attr=bias_attr,
+                                  is_bias=True)
+
+    def forward(self, input, label, path_table=None, path_code=None):
+        return F.hsigmoid_loss(input, label, self.num_classes,
+                               self.weight, self.bias, path_table,
+                               path_code)
+
+
+class RNNTLoss(Layer):
+    def __init__(self, blank=0, fastemit_lambda=0.0, reduction="mean",
+                 name=None):
+        super().__init__()
+        self.blank, self.reduction = blank, reduction
+
+    def forward(self, input, label, input_lengths, label_lengths):
+        return F.rnnt_loss(input, label, input_lengths, label_lengths,
+                           self.blank, reduction=self.reduction)
+
+
+class AdaptiveLogSoftmaxWithLoss(Layer):
+    """Adaptive softmax head (nn layer form): head covers the shortlist
+    + one logit per tail cluster; each tail cluster is down-projected by
+    div_value**(i+1)."""
+
+    def __init__(self, in_features, n_classes, cutoffs, div_value=4.0,
+                 head_bias=False, name=None):
+        super().__init__()
+        self.cutoffs = list(cutoffs) + [n_classes]
+        self.shortlist = self.cutoffs[0]
+        n_clusters = len(self.cutoffs) - 1
+        self.head_weight = self.create_parameter(
+            [in_features, self.shortlist + n_clusters])
+        self.head_bias = self.create_parameter(
+            [self.shortlist + n_clusters], is_bias=True) \
+            if head_bias else None
+        self.tail_projs = []
+        self.tail_ws = []
+        for i in range(n_clusters):
+            size = self.cutoffs[i + 1] - self.cutoffs[i]
+            hid = max(int(in_features / (div_value ** (i + 1))), 1)
+            proj = self.create_parameter([in_features, hid])
+            w = self.create_parameter([hid, size])
+            self.add_parameter(f"tail_proj_{i}", proj)
+            self.add_parameter(f"tail_w_{i}", w)
+            self.tail_projs.append(proj)
+            self.tail_ws.append(w)
+
+    def forward(self, input, label):
+        tails = [(p, w) for p, w in zip(self.tail_projs, self.tail_ws)]
+        return F.adaptive_log_softmax_with_loss(
+            input, label, self.head_weight, tails, self.cutoffs,
+            self.head_bias)
+
+    def log_prob(self, input):
+        out, _ = self.forward(input, __import__(
+            "paddle_tpu").zeros([input.shape[0]], dtype="int64"))
+        return out
+
+
+class ParameterDict(Layer):
+    """Dict container of parameters (nn.ParameterDict)."""
+
+    def __init__(self, parameters=None):
+        super().__init__()
+        if parameters:
+            for k, v in (parameters.items()
+                         if isinstance(parameters, dict) else parameters):
+                self.add_parameter(k, v)
+
+    def __getitem__(self, key):
+        return self._parameters[key]
+
+    def __setitem__(self, key, param):
+        self.add_parameter(key, param)
+
+    def __len__(self):
+        return len(self._parameters)
+
+    def __iter__(self):
+        return iter(self._parameters)
+
+    def keys(self):
+        return self._parameters.keys()
+
+    def values(self):
+        return self._parameters.values()
+
+    def items(self):
+        return self._parameters.items()
+
+    def update(self, parameters):
+        for k, v in (parameters.items()
+                     if isinstance(parameters, dict) else parameters):
+            self.add_parameter(k, v)
+
+
+# ---------------------------------------------------------------------------
+# seq2seq decoding (nn/decode.py BeamSearchDecoder + dynamic_decode)
+# ---------------------------------------------------------------------------
+
+class BeamSearchDecoder:
+    """Beam search over an RNN cell (reference nn/decode.py
+    BeamSearchDecoder). Host-driven loop (token-level python control
+    flow, like the reference's dynamic_decode while_op path)."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start_token = start_token
+        self.end_token = end_token
+        self.beam_size = beam_size
+        self.embedding_fn = embedding_fn
+        self.output_fn = output_fn
+
+    def decode(self, init_states, max_steps=32):
+        import paddle_tpu as paddle
+        import jax.numpy as jnp
+        B = None
+        # states: replicate per beam lazily after first step
+        log_probs = None
+        tokens = None
+
+        def step_logits(tok, states):
+            emb = self.embedding_fn(tok) if self.embedding_fn else tok
+            out, new_states = self.cell(emb, states)
+            logits = self.output_fn(out) if self.output_fn else out
+            return logits, new_states
+
+        start = paddle.full([1], self.start_token, dtype="int64")
+        logits, states = step_logits(start, init_states)
+        V = logits.shape[-1]
+        lp = F.log_softmax(logits, axis=-1)
+        arr = np.asarray(lp.numpy()).reshape(-1)
+        top = np.argsort(-arr)[:self.beam_size]
+        beams = [([int(t)], float(arr[t]), states) for t in top]
+
+        for _ in range(max_steps - 1):
+            candidates = []
+            for seq, score, st in beams:
+                if seq[-1] == self.end_token:
+                    candidates.append((seq, score, st))
+                    continue
+                tok = paddle.full([1], seq[-1], dtype="int64")
+                logits, st2 = step_logits(tok, st)
+                arr = np.asarray(F.log_softmax(
+                    logits, axis=-1).numpy()).reshape(-1)
+                top = np.argsort(-arr)[:self.beam_size]
+                for t in top:
+                    candidates.append((seq + [int(t)],
+                                       score + float(arr[t]), st2))
+            candidates.sort(key=lambda c: -c[1])
+            beams = candidates[:self.beam_size]
+            if all(b[0][-1] == self.end_token for b in beams):
+                break
+        best = beams[0]
+        return Tensor(np.asarray(best[0], np.int64)), best[1]
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
+    """Run a decoder to completion (nn/decode.py dynamic_decode)."""
+    return decoder.decode(inits, max_steps=max_step_num)

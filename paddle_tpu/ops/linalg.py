@@ -272,3 +272,153 @@ for _name in __all__:
     if callable(_fn) and not hasattr(Tensor, _name):
         Tensor._bind(_name, _fn)
 del _this, _name, _fn
+
+
+# ---------------------------------------------------------------------------
+# long-tail linalg parity (reference tensor/linalg.py remainder)
+# ---------------------------------------------------------------------------
+
+def vector_norm(x, p=2.0, axis=None, keepdim=False, name=None):
+    def f(a):
+        if p == float("inf"):
+            return jnp.max(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == float("-inf"):
+            return jnp.min(jnp.abs(a), axis=axis, keepdims=keepdim)
+        if p == 0:
+            return jnp.sum((a != 0).astype(a.dtype), axis=axis,
+                           keepdims=keepdim)
+        return jnp.sum(jnp.abs(a) ** p, axis=axis,
+                       keepdims=keepdim) ** (1.0 / p)
+    return apply_op(f, x, _op_name="vector_norm")
+
+
+def matrix_norm(x, p="fro", axis=(-2, -1), keepdim=False, name=None):
+    def f(a):
+        if p == "fro":
+            return jnp.sqrt(jnp.sum(a * a, axis=axis, keepdims=keepdim))
+        if p == "nuc":
+            s = jnp.linalg.svd(a, compute_uv=False)
+            out = jnp.sum(s, axis=-1)
+            return out[..., None, None] if keepdim else out
+        if p in (1, -1, jnp.inf, -jnp.inf, 2, -2):
+            return jnp.linalg.norm(a, ord=p, axis=axis, keepdims=keepdim)
+        raise ValueError(f"unsupported matrix norm order {p!r}")
+    return apply_op(f, x, _op_name="matrix_norm")
+
+
+def matrix_exp(x, name=None):
+    import jax.scipy.linalg as jsl
+    return apply_op(lambda a: jsl.expm(a), x, _op_name="matrix_exp")
+
+
+def cholesky_inverse(x, upper=False, name=None):
+    """Inverse of A given its Cholesky factor (tensor/linalg.py)."""
+    def f(L):
+        n = L.shape[-1]
+        eye = jnp.eye(n, dtype=L.dtype)
+        import jax.scipy.linalg as jsl
+        inv_f = jsl.solve_triangular(L, eye, lower=not upper)
+        return inv_f.T @ inv_f if not upper else inv_f @ inv_f.T
+    return apply_op(f, x, _op_name="cholesky_inverse")
+
+
+def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
+              name=None):
+    """Unpack combined LU factors + pivots into (P, L, U)."""
+    def f(lu, piv):
+        n = lu.shape[-2]
+        m = lu.shape[-1]
+        k = min(n, m)
+        L = jnp.tril(lu[..., :, :k], -1) + jnp.eye(n, k, dtype=lu.dtype)
+        U = jnp.triu(lu[..., :k, :])
+        # pivots (1-based sequential swaps) -> permutation matrix
+        def perm_from_pivots(pv):
+            perm = jnp.arange(n)
+            def body(i, pm):
+                j = pv[i] - 1
+                a, b = pm[i], pm[j]
+                pm = pm.at[i].set(b).at[j].set(a)
+                return pm
+            perm = jax.lax.fori_loop(0, pv.shape[0], body, perm)
+            # rows of M @ A = L @ U are permuted by `perm`; the contract
+            # A = P @ L @ U needs P = M.T, i.e. eye indexed by columns
+            return jnp.eye(n, dtype=lu.dtype)[:, perm]
+        P = perm_from_pivots(piv.astype(jnp.int32))
+        return P, L, U
+    return apply_op(f, lu_data, lu_pivots, _op_name="lu_unpack")
+
+
+def ormqr(x, tau, other, left=True, transpose=False, name=None):
+    """Multiply `other` by Q from a geqrf factorization (householder)."""
+    def f(a, t, c):
+        import jax.lax.linalg as lxl
+        q = lxl.householder_product(a, t)
+        qm = q.swapaxes(-1, -2) if transpose else q
+        return qm @ c if left else c @ qm
+    return apply_op(f, x, tau, other, _op_name="ormqr")
+
+
+def svd_lowrank(x, q=6, niter=2, M=None, name=None):
+    """Randomized low-rank SVD (tensor/linalg.py svd_lowrank)."""
+    from ..framework import random as rnd
+    key = rnd.op_key(x)
+
+    def f(a, k):
+        m, n = a.shape[-2:]
+        r = min(q, m, n)
+        omega = jax.random.normal(k, a.shape[:-2] + (n, r), a.dtype)
+        y = a @ omega
+        for _ in range(niter):
+            y = a @ (a.swapaxes(-1, -2) @ y)
+        Q, _ = jnp.linalg.qr(y)
+        B = Q.swapaxes(-1, -2) @ a
+        u, s, vh = jnp.linalg.svd(B, full_matrices=False)
+        return Q @ u, s, vh.swapaxes(-1, -2)
+    return apply_op(f, x, key, _op_name="svd_lowrank")
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    def f(a):
+        return a - jnp.mean(a, axis=-2, keepdims=True) if center else a
+    xc = apply_op(f, x, _op_name="pca_center")
+    k = q if q is not None else min(6, *x.shape[-2:])
+    u, s, v = svd_lowrank(xc, q=k, niter=niter)
+    return u, s, v
+
+
+def fp8_fp8_half_gemm_fused(x, y, transpose_x=False, transpose_y=False,
+                            bias=None, scale=1.0, output_dtype="float16",
+                            activation_type="identity", name=None):
+    """fp8 x fp8 -> half GEMM (reference: cutlass fp8 kernel,
+    phi/kernels/fusion/cutlass/fp8_gemm). TPU-native: cast to
+    float8_e4m3fn and let the MXU (v5p+/Trillium fp8 paths, emulated
+    elsewhere) accumulate; output in half precision."""
+    from ..framework.dtype import to_dtype
+    out_np = to_dtype(output_dtype).np_dtype
+
+    def f(a, b, *bias_arr):
+        a8 = a.astype(jnp.float8_e4m3fn)
+        b8 = b.astype(jnp.float8_e4m3fn)
+        if transpose_x:
+            a8 = a8.swapaxes(-1, -2)
+        if transpose_y:
+            b8 = b8.swapaxes(-1, -2)
+        out = jnp.matmul(a8, b8,
+                         preferred_element_type=jnp.float32) * scale
+        if bias_arr:
+            out = out + bias_arr[0].astype(jnp.float32)
+        if activation_type in ("gelu", "relu"):
+            out = jax.nn.gelu(out) if activation_type == "gelu" \
+                else jax.nn.relu(out)
+        return out.astype(out_np)
+    args = (x, y) + ((bias,) if bias is not None else ())
+    return apply_op(f, *args, _op_name="fp8_fp8_half_gemm_fused")
+
+
+_EXTRA_LINALG = ["vector_norm", "matrix_norm", "matrix_exp",
+                 "cholesky_inverse", "lu_unpack", "ormqr", "svd_lowrank",
+                 "pca_lowrank", "fp8_fp8_half_gemm_fused"]
+__all__ += _EXTRA_LINALG
+# the module's method-bind loop above ran before these were defined
+for _name in _EXTRA_LINALG:
+    Tensor._bind(_name, globals()[_name])

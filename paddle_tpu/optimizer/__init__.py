@@ -1,6 +1,7 @@
 """Optimizers (reference: python/paddle/optimizer/, 11.5k LoC)."""
 from .optimizer import Optimizer, SGD, Momentum  # noqa: F401
 from .adam import Adam, AdamW, Adamax, Lamb  # noqa: F401
-from .misc import RMSProp, Adagrad, Adadelta  # noqa: F401
+from .misc import (RMSProp, Adagrad, Adadelta, ASGD, Rprop,  # noqa: F401
+                   NAdam, RAdam)
 from .lbfgs import LBFGS  # noqa: F401
 from . import lr  # noqa: F401

@@ -110,3 +110,138 @@ class Adadelta(Optimizer):
         self._set_acc(p, "avg_squared_grad", asg2)
         self._set_acc(p, "avg_squared_update", asdx2)
         return new_p
+
+
+class ASGD(Optimizer):
+    """Averaged SGD (optimizer/asgd.py): steps with the mean of the last
+    ``batch_num`` gradients. A circular buffer of the window's gradients
+    keeps the running sum exact (d = d - oldest + newest, the reference's
+    ys buffer)."""
+
+    def __init__(self, learning_rate=0.001, batch_num=1, parameters=None,
+                 weight_decay=None, grad_clip=None, multi_precision=False,
+                 name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._n = max(int(batch_num), 1)
+
+    def _update_param(self, p, g):
+        g = g.astype(jnp.float32)
+        d = self._acc(p, "d", init=jnp.zeros(p._data.shape, jnp.float32))
+        ys = self._acc(p, "ys", init=jnp.zeros((self._n,) + p._data.shape,
+                                               jnp.float32))
+        slot = (self._step_count - 1) % self._n
+        oldest = ys[slot]
+        d2 = d - oldest + g
+        ys2 = ys.at[slot].set(g)
+        # before the window fills, average over the steps seen so far
+        seen = jnp.minimum(jnp.asarray(self._step_count, jnp.float32),
+                           float(self._n))
+        new_p = p._data.astype(jnp.float32) - \
+            self._param_lr(p) * d2 / seen
+        self._set_acc(p, "d", d2)
+        self._set_acc(p, "ys", ys2)
+        return new_p
+
+
+class Rprop(Optimizer):
+    """Resilient backprop (optimizer/rprop.py): per-weight step sizes
+    grown/shrunk by the sign agreement of successive gradients."""
+
+    def __init__(self, learning_rate=0.001, learning_rate_range=(1e-5, 50),
+                 parameters=None, etas=(0.5, 1.2), grad_clip=None,
+                 multi_precision=False, name=None):
+        super().__init__(learning_rate, parameters, None, grad_clip, name)
+        self._lr_min, self._lr_max = learning_rate_range
+        self._eta_neg, self._eta_pos = etas
+
+    def _update_param(self, p, g):
+        g = g.astype(jnp.float32)
+        prev = self._acc(p, "prev_grad",
+                         init=jnp.zeros(p._data.shape, jnp.float32))
+        step = self._acc(p, "step_size",
+                         init=jnp.full(p._data.shape,
+                                       float(self.get_lr()), jnp.float32))
+        sign = jnp.sign(g * prev)
+        step2 = jnp.clip(
+            jnp.where(sign > 0, step * self._eta_pos,
+                      jnp.where(sign < 0, step * self._eta_neg, step)),
+            self._lr_min, self._lr_max)
+        g_eff = jnp.where(sign < 0, 0.0, g)  # no step on sign flip
+        new_p = p._data.astype(jnp.float32) - jnp.sign(g_eff) * step2
+        self._set_acc(p, "prev_grad", g_eff)
+        self._set_acc(p, "step_size", step2)
+        return new_p
+
+
+class NAdam(Optimizer):
+    """Nesterov Adam (optimizer/nadam.py)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, momentum_decay=0.004, parameters=None,
+                 weight_decay=None, grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._b1, self._b2 = beta1, beta2
+        self._eps = epsilon
+        self._psi = momentum_decay
+
+    def _update_param(self, p, g):
+        g = g.astype(jnp.float32)
+        m = self._acc(p, "m", init=jnp.zeros(p._data.shape, jnp.float32))
+        v = self._acc(p, "v", init=jnp.zeros(p._data.shape, jnp.float32))
+        mu_prod = self._acc(p, "mu_prod",
+                            init=jnp.ones((), jnp.float32))
+        t = jnp.asarray(self._step_count, jnp.float32)
+        mu_t = self._b1 * (1 - 0.5 * 0.96 ** (t * self._psi))
+        mu_t1 = self._b1 * (1 - 0.5 * 0.96 ** ((t + 1) * self._psi))
+        mu_prod2 = mu_prod * mu_t
+        m2 = self._b1 * m + (1 - self._b1) * g
+        v2 = self._b2 * v + (1 - self._b2) * g * g
+        m_hat = mu_t1 * m2 / (1 - mu_prod2 * mu_t1) + \
+            (1 - mu_t) * g / (1 - mu_prod2)
+        v_hat = v2 / (1 - self._b2 ** t)
+        new_p = p._data.astype(jnp.float32) - self._param_lr(p) * \
+            m_hat / (jnp.sqrt(v_hat) + self._eps)
+        self._set_acc(p, "m", m2)
+        self._set_acc(p, "v", v2)
+        self._set_acc(p, "mu_prod", mu_prod2)
+        return new_p
+
+
+class RAdam(Optimizer):
+    """Rectified Adam (optimizer/radam.py): variance-rectification term
+    switches between SGD-with-momentum and Adam."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None):
+        super().__init__(learning_rate, parameters, weight_decay,
+                         grad_clip, name)
+        self._b1, self._b2 = beta1, beta2
+        self._eps = epsilon
+
+    def _update_param(self, p, g):
+        g = g.astype(jnp.float32)
+        m = self._acc(p, "m", init=jnp.zeros(p._data.shape, jnp.float32))
+        v = self._acc(p, "v", init=jnp.zeros(p._data.shape, jnp.float32))
+        t = jnp.asarray(self._step_count, jnp.float32)
+        m2 = self._b1 * m + (1 - self._b1) * g
+        v2 = self._b2 * v + (1 - self._b2) * g * g
+        m_hat = m2 / (1 - self._b1 ** t)
+        rho_inf = 2.0 / (1 - self._b2) - 1
+        rho_t = rho_inf - 2 * t * self._b2 ** t / (1 - self._b2 ** t)
+        r = jnp.sqrt(((rho_t - 4) * (rho_t - 2) * rho_inf) /
+                     jnp.maximum((rho_inf - 4) * (rho_inf - 2) * rho_t,
+                                 1e-12))
+        v_hat = jnp.sqrt(v2 / (1 - self._b2 ** t))
+        adam_step = r * m_hat / (v_hat + self._eps)
+        sgd_step = m_hat
+        step = jnp.where(rho_t > 4.0, adam_step, sgd_step)
+        new_p = p._data.astype(jnp.float32) - self._param_lr(p) * step
+        self._set_acc(p, "m", m2)
+        self._set_acc(p, "v", v2)
+        return new_p
+
+
+__all__ += ["ASGD", "Rprop", "NAdam", "RAdam"]

@@ -7,7 +7,8 @@ from .ops import (  # noqa: F401
     abs, add, addmm, asin, asinh, atan, atanh, cast, coalesce, deg2rad,
     divide, expm1, is_same_shape, isnan, leaky_relu, log1p, mask_as,
     masked_matmul, matmul, multiply, mv, neg, pow, rad2deg, relu, relu6,
-    reshape, sin, sinh, sqrt, square, subtract, sum, tan, tanh, transpose)
+    reshape, sin, sinh, slice, sqrt, square, subtract, sum, tan, tanh,
+    transpose, pca_lowrank)
 from . import nn  # noqa: F401
 
 # Dense-Tensor conversion methods (paddle exposes these on Tensor:
@@ -28,5 +29,5 @@ __all__ = [
     "leaky_relu", "log1p", "mask_as", "masked_matmul", "matmul",
     "multiply", "mv", "neg", "pow", "rad2deg", "relu", "relu6", "reshape",
     "sin", "sinh", "sqrt", "square", "subtract", "sum", "tan", "tanh",
-    "transpose", "nn",
+    "transpose", "nn", "slice", "pca_lowrank",
 ]

@@ -227,3 +227,27 @@ def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
     dense_in = input.to_dense() if is_sparse(input) else input
     return apply_op(lambda i, p: beta * i + alpha * p, dense_in, prod,
                     _op_name="sparse_addmm")
+
+
+def slice(x, axes, starts, ends, name=None):
+    """Slice a sparse tensor (reference sparse/unary.py slice): computed
+    on the dense view and re-sparsified (XLA fuses the scatter/gather;
+    there is no CUDA slice kernel to mirror)."""
+    from .creation import sparse_coo_tensor
+    dense = x.to_dense() if is_sparse(x) else x
+    from ..ops.manipulation import slice as dense_slice
+    out = dense_slice(dense, axes, starts, ends)
+    if not is_sparse(x):
+        return out
+    # to_sparse_coo routes the value gather through apply_op, so
+    # gradients flow to the sliced values (a raw numpy round-trip
+    # would silently detach them)
+    from .creation import to_sparse_coo
+    return to_sparse_coo(out, len(out.shape))
+
+
+def pca_lowrank(x, q=None, center=True, niter=2, name=None):
+    """Randomized PCA over the dense view (reference sparse pca_lowrank)."""
+    from ..ops.linalg import pca_lowrank as dense_pca
+    dense = x.to_dense() if is_sparse(x) else x
+    return dense_pca(dense, q=q, center=center, niter=niter)
