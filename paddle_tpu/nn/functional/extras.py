@@ -39,6 +39,11 @@ def _u(fn, name, *xs, **kw):
     return apply_op(fn, *xs, _op_name=name, **kw)
 
 
+# shared with the sibling modules (single definition each)
+from .loss import _reduce  # noqa: E402
+from .pooling import _tuple  # noqa: E402
+
+
 # ---------------------------------------------------------------------------
 # shared window-patches helper (the unpool/fractional/LP pooling backbone)
 # ---------------------------------------------------------------------------
@@ -64,10 +69,6 @@ def _patches(a, k, s):
            [3 + 2 * i for i in range(nd)]
     out = jnp.transpose(out, perm)
     return out.reshape(out.shape[:2 + nd] + (-1,)), out_sizes
-
-
-def _tuple(v, n):
-    return tuple(v) if isinstance(v, (list, tuple)) else (v,) * n
 
 
 # ---------------------------------------------------------------------------
@@ -162,6 +163,14 @@ def _lp_pool(x, nd, norm_type, kernel_size, stride=None, padding=0,
 
     def f(a):
         pads = [(pi, pi) for pi in p]
+        if ceil_mode:
+            # extend right padding (0-pad is exact for the |x|^p sum)
+            pads = []
+            for i, pi in enumerate(p):
+                span = a.shape[2 + i] + 2 * pi - k[i]
+                n_out = -(-span // s[i]) + 1
+                need = (n_out - 1) * s[i] + k[i] - (a.shape[2 + i] + 2 * pi)
+                pads.append((pi, pi + max(need, 0)))
         a_p = jnp.pad(a, [(0, 0), (0, 0)] + pads)
         win, _ = _patches(a_p, k, s)
         pw = jnp.sum(jnp.abs(win) ** norm_type, axis=-1)
@@ -171,12 +180,14 @@ def _lp_pool(x, nd, norm_type, kernel_size, stride=None, padding=0,
 
 def lp_pool1d(x, norm_type, kernel_size, stride=None, padding=0,
               ceil_mode=False, data_format="NCL", name=None):
-    return _lp_pool(x, 1, float(norm_type), kernel_size, stride, padding)
+    return _lp_pool(x, 1, float(norm_type), kernel_size, stride, padding,
+                    ceil_mode)
 
 
 def lp_pool2d(x, norm_type, kernel_size, stride=None, padding=0,
               ceil_mode=False, data_format="NCHW", name=None):
-    return _lp_pool(x, 2, float(norm_type), kernel_size, stride, padding)
+    return _lp_pool(x, 2, float(norm_type), kernel_size, stride, padding,
+                    ceil_mode)
 
 
 def _fractional_pool(x, nd, output_size, random_u=None):
@@ -212,14 +223,20 @@ def _fractional_pool(x, nd, output_size, random_u=None):
 
 def fractional_max_pool2d(x, output_size, kernel_size=None,
                           random_u=None, return_mask=False, name=None):
-    out = _fractional_pool(x, 2, output_size, random_u)
-    return (out, None) if return_mask else out
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool2d(return_mask=True) is not supported; "
+            "use max_pool2d(return_mask=True) for unpool indices")
+    return _fractional_pool(x, 2, output_size, random_u)
 
 
 def fractional_max_pool3d(x, output_size, kernel_size=None,
                           random_u=None, return_mask=False, name=None):
-    out = _fractional_pool(x, 3, output_size, random_u)
-    return (out, None) if return_mask else out
+    if return_mask:
+        raise NotImplementedError(
+            "fractional_max_pool3d(return_mask=True) is not supported; "
+            "use max_pool3d(return_mask=True) for unpool indices")
+    return _fractional_pool(x, 3, output_size, random_u)
 
 
 # ---------------------------------------------------------------------------
@@ -308,16 +325,11 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
 
 def sequence_mask(x, maxlen=None, dtype="int64", name=None):
     from ...framework.dtype import to_dtype
-    def f(lens):
-        m = maxlen if maxlen is not None else int(jnp.max(lens))
-        return (jnp.arange(m)[None, :] <
-                lens.reshape(-1, 1)).astype(to_dtype(dtype).np_dtype)
     if maxlen is None:
         lens_np = np.asarray(x.numpy() if isinstance(x, Tensor) else x)
-        m = int(lens_np.max())
-        return _u(lambda l: (jnp.arange(m)[None, :] < l.reshape(-1, 1))
-                  .astype(to_dtype(dtype).np_dtype), "sequence_mask", x)
-    return _u(f, "sequence_mask", x)
+        maxlen = int(lens_np.max())
+    return _u(lambda l: (jnp.arange(maxlen) < l[..., None])
+              .astype(to_dtype(dtype).np_dtype), "sequence_mask", x)
 
 
 def gather_tree(ids, parents):
@@ -370,14 +382,6 @@ def pairwise_distance(x, y, p=2.0, epsilon=1e-6, keepdim=False,
 # ---------------------------------------------------------------------------
 # losses
 # ---------------------------------------------------------------------------
-
-def _reduce(out, reduction):
-    if reduction == "mean":
-        return jnp.mean(out)
-    if reduction == "sum":
-        return jnp.sum(out)
-    return out
-
 
 def gaussian_nll_loss(input, label, variance, full=False, epsilon=1e-6,
                       reduction="mean", name=None):
@@ -678,19 +682,30 @@ def sparse_attention(query, key, value, sparse_csr_offset,
     """Block-sparse attention contract; computed densely with the CSR
     pattern materialized as a mask (XLA fuses; the reference uses a
     dedicated CUDA kernel)."""
-    def f(q, k, v, offs, cols):
+    def f(q, k, v, offs, cols, *masks):
         B, H, S, D = q.shape
         logits = jnp.einsum("bhsd,bhtd->bhst", q, k) / math.sqrt(D)
-        dense_mask = jnp.zeros((S, S), bool)
-        # CSR -> dense (host shapes; offs/cols are static-sized)
-        row_ids = jnp.repeat(jnp.arange(S), jnp.diff(offs[0, 0]),
-                             total_repeat_length=cols.shape[-1])
-        dense_mask = dense_mask.at[row_ids, cols[0, 0]].set(True)
+
+        def csr_to_dense(off_row, col_row):  # per (b, h)
+            row_ids = jnp.repeat(jnp.arange(S), jnp.diff(off_row),
+                                 total_repeat_length=col_row.shape[-1])
+            return jnp.zeros((S, S), bool).at[row_ids, col_row].set(True)
+
+        dense_mask = jax.vmap(jax.vmap(csr_to_dense))(offs, cols)
         logits = jnp.where(dense_mask, logits, -1e30)
+        i = 0
+        if key_padding_mask is not None:
+            kpm = masks[i]; i += 1
+            logits = jnp.where(kpm[:, None, None, :].astype(bool),
+                               logits, -1e30)
+        if attn_mask is not None:
+            logits = jnp.where(masks[i].astype(bool), logits, -1e30)
         probs = jax.nn.softmax(logits, axis=-1)
         return jnp.einsum("bhst,bhtd->bhsd", probs, v)
+    extra = tuple(m for m in (key_padding_mask, attn_mask)
+                  if m is not None)
     return _u(f, "sparse_attention", query, key, value,
-              sparse_csr_offset, sparse_csr_columns)
+              sparse_csr_offset, sparse_csr_columns, *extra)
 
 
 def class_center_sample(label, num_classes, num_samples, group=None):

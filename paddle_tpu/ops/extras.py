@@ -757,13 +757,17 @@ def set_cuda_rng_state(state):
 # ---------------------------------------------------------------------------
 
 _INPLACE_BASES = [
-    "abs", "acos", "addmm", "atan", "bernoulli", "bitwise_and",
+    "abs", "acos", "acosh", "addmm", "asin", "asinh", "atan", "atanh",
+    "bernoulli", "ceil", "clip", "cosh", "erfinv", "exp", "floor",
+    "lerp", "log1p", "logical_xor", "not_equal", "put_along_axis",
+    "reciprocal", "round", "rsqrt", "sigmoid", "sqrt",
+    "bitwise_and",
     "bitwise_left_shift", "bitwise_not", "bitwise_or",
     "bitwise_right_shift", "bitwise_xor", "cast", "copysign", "cos",
     "cumprod", "cumsum", "digamma", "divide", "equal", "erf", "expm1",
     "floor_divide", "floor_mod", "frac", "gammainc", "gammaincc",
     "gammaln", "gcd", "greater_equal", "greater_than", "hypot", "i0",
-    "index_add", "index_put", "lcm", "ldexp", "less_equal", "less_than",
+    "index_add", "index_fill", "index_put", "lcm", "ldexp", "less_equal", "less_than",
     "lgamma", "log", "log10", "log2", "logical_and", "logical_not",
     "logical_or", "logit", "masked_fill", "masked_scatter", "mod",
     "multigammaln", "nan_to_num", "neg", "polygamma", "pow", "remainder",
@@ -825,3 +829,86 @@ for _m_name in ["logaddexp", "sinc", "signbit", "isneginf", "isposinf",
                 "reduce_as", "trapezoid", "cumulative_trapezoid",
                 "log_normal_", "cauchy_", "geometric_"]:
     Tensor._bind(_m_name, globals()[_m_name])
+
+
+# ---------------------------------------------------------------------------
+# remaining Tensor-method parity (tensor/__init__.py method list)
+# ---------------------------------------------------------------------------
+
+def inverse(x, name=None):
+    return _u(jnp.linalg.inv, "inverse", x)
+
+
+def create_tensor(dtype="float32", name=None, persistable=False):
+    from ..framework.dtype import to_dtype
+    t = Tensor(jnp.zeros((), to_dtype(dtype).np_dtype), name=name)
+    t.persistable = persistable
+    return t
+
+
+def top_p_sampling(x, ps, threshold=None, topp_seed=None, seed=-1,
+                   k=0, mode="truncated", return_top=False, name=None):
+    """Nucleus sampling (tensor/search.py top_p_sampling): keep the
+    smallest prefix of descending probs whose mass reaches ps, renorm,
+    sample. Returns (sampled_probs, sampled_ids)."""
+    from ..framework import random as rnd
+    key = rnd.op_key(x, ps)
+
+    def f(probs, p_thresh, kk):
+        order = jnp.argsort(-probs, axis=-1)
+        sorted_p = jnp.take_along_axis(probs, order, axis=-1)
+        csum = jnp.cumsum(sorted_p, axis=-1)
+        keep = csum - sorted_p < p_thresh[..., None]  # keep first >= ps
+        keep = keep.at[..., 0].set(True)
+        filt = jnp.where(keep, sorted_p, 0.0)
+        filt = filt / jnp.sum(filt, axis=-1, keepdims=True)
+        g = jax.random.gumbel(kk, filt.shape)
+        choice = jnp.argmax(jnp.log(filt + 1e-30) + g, axis=-1)
+        ids = jnp.take_along_axis(order, choice[..., None],
+                                  axis=-1)
+        pvals = jnp.take_along_axis(probs, ids, axis=-1)
+        return pvals, ids.astype(jnp.int64)
+    return _u(f, "top_p_sampling", x, ps, key)
+
+
+def _bind_method_parity():
+    """Bind remaining functions the reference exposes as Tensor methods
+    (python/paddle/tensor/__init__.py tensor_method_func)."""
+    import sys
+    from . import creation as _cr
+    from . import linalg as _lin
+    from . import manipulation as _mp
+    from . import math as _m
+    here = sys.modules[__name__]
+
+    def _stft(self, *a, **k):
+        from .. import signal as _sig
+        return _sig.stft(self, *a, **k)
+
+    def _istft(self, *a, **k):
+        from .. import signal as _sig
+        return _sig.istft(self, *a, **k)
+
+    Tensor._bind("stft", _stft)
+    Tensor._bind("istft", _istft)
+    for name in ["diag", "diagflat", "tril", "triu", "multiplex",
+                 "scatter_nd", "histogram_bin_edges", "histogramdd",
+                 "polar", "rank", "broadcast_shape", "block_diag",
+                 "inverse", "top_p_sampling", "create_tensor",
+                 "create_parameter"]:
+        fn = None
+        for mod in (here, _m, _mp, _lin, _cr):
+            fn = getattr(mod, name, None)
+            if fn is not None:
+                break
+        if fn is None and name == "create_parameter":
+            from ..static.graph import create_parameter as fn  # noqa
+        if fn is not None:
+            Tensor._bind(name, fn)
+    from ..nn.functional.activation import sigmoid as _sigmoid
+    Tensor._bind("sigmoid", _sigmoid)
+    Tensor._bind("sigmoid_", _make_inplace("sigmoid", _sigmoid))
+
+
+_bind_method_parity()
+__all__ += ["inverse", "create_tensor", "top_p_sampling"]

@@ -315,10 +315,12 @@ def cholesky_inverse(x, upper=False, name=None):
     """Inverse of A given its Cholesky factor (tensor/linalg.py)."""
     def f(L):
         n = L.shape[-1]
-        eye = jnp.eye(n, dtype=L.dtype)
+        eye = jnp.broadcast_to(jnp.eye(n, dtype=L.dtype),
+                               L.shape[:-2] + (n, n))
         import jax.scipy.linalg as jsl
         inv_f = jsl.solve_triangular(L, eye, lower=not upper)
-        return inv_f.T @ inv_f if not upper else inv_f @ inv_f.T
+        inv_t = inv_f.swapaxes(-1, -2)
+        return inv_t @ inv_f if not upper else inv_f @ inv_t
     return apply_op(f, x, _op_name="cholesky_inverse")
 
 
@@ -343,7 +345,11 @@ def lu_unpack(lu_data, lu_pivots, unpack_ludata=True, unpack_pivots=True,
             # rows of M @ A = L @ U are permuted by `perm`; the contract
             # A = P @ L @ U needs P = M.T, i.e. eye indexed by columns
             return jnp.eye(n, dtype=lu.dtype)[:, perm]
-        P = perm_from_pivots(piv.astype(jnp.int32))
+        fn_p = perm_from_pivots
+        pv = piv.astype(jnp.int32)
+        for _ in range(pv.ndim - 1):  # vmap over leading batch dims
+            fn_p = jax.vmap(fn_p)
+        P = fn_p(pv)
         return P, L, U
     return apply_op(f, lu_data, lu_pivots, _op_name="lu_unpack")
 

@@ -309,3 +309,38 @@ def test_lkj_log_prob_normalized_d2():
     L = np.array([[1.0, 0.0], [r, np.sqrt(1 - r * r)]], "f4")
     lp = float(lkj.log_prob(paddle.to_tensor(L)))
     np.testing.assert_allclose(lp, np.log(0.5), rtol=1e-4)
+
+
+def test_batched_linalg_and_lp_ceil():
+    Ls = np.stack([np.linalg.cholesky(np.array([[4., 2], [2, 3]], "f4")),
+                   np.linalg.cholesky(np.array([[2., 0], [0, 5]], "f4"))])
+    inv = paddle.linalg.cholesky_inverse(paddle.to_tensor(Ls))
+    assert inv.shape == [2, 2, 2]
+    np.testing.assert_allclose(
+        inv.numpy()[1], np.linalg.inv(np.array([[2., 0], [0, 5]])),
+        rtol=1e-3)
+    out = F.lp_pool2d(paddle.ones([1, 1, 5, 5]), 2.0, 2, 2,
+                      ceil_mode=True)
+    assert out.shape == [1, 1, 3, 3]
+    with pytest.raises(NotImplementedError):
+        F.fractional_max_pool2d(paddle.ones([1, 1, 8, 8]), 4,
+                                return_mask=True)
+    m = F.sequence_mask(paddle.to_tensor(
+        np.array([[1, 2], [3, 4]], "int64")), maxlen=5)
+    assert m.shape == [2, 2, 5]
+
+
+def test_batched_lu_unpack():
+    import scipy.linalg as sl
+    A1 = np.array([[0., 1, 2], [3, 4, 5], [6, 7, 9]], "f4")
+    A2 = np.array([[5., 1, 0], [2, 3, 1], [0, 1, 4]], "f4")
+    lus, pivs = [], []
+    for A in (A1, A2):
+        lu, piv = sl.lu_factor(A)
+        lus.append(lu)
+        pivs.append(piv + 1)
+    P, L, U = paddle.linalg.lu_unpack(
+        paddle.to_tensor(np.stack(lus)),
+        paddle.to_tensor(np.stack(pivs)))
+    rec = np.einsum("bij,bjk,bkl->bil", P.numpy(), L.numpy(), U.numpy())
+    np.testing.assert_allclose(rec, np.stack([A1, A2]), atol=1e-4)

@@ -161,3 +161,25 @@ def test_polar_preserves_precision():
     c = paddle.polar(r, t)
     assert c.numpy().dtype == np.complex64
     np.testing.assert_allclose(c.numpy().imag, [1.0], atol=1e-6)
+
+
+def test_tensor_method_parity_with_reference():
+    ref = "/root/reference/python/paddle/tensor/__init__.py"
+    if not os.path.exists(ref):
+        pytest.skip("reference not mounted")
+    src = open(ref).read()
+    names = sorted(set(re.findall(r"^\s+'([a-zA-Z_][\w]*)',\s*$", src,
+                                  re.M)))
+    t = paddle.ones([2, 2])
+    missing = [n for n in names if not hasattr(t, n)]
+    assert not missing, f"missing Tensor methods: {missing}"
+
+
+def test_top_p_sampling_distribution():
+    probs = paddle.to_tensor(np.array([[0.6, 0.3, 0.08, 0.02]] * 200,
+                                      "f4"))
+    ps = paddle.to_tensor(np.full((200,), 0.7, "f4"))
+    pv, ids = paddle.top_p_sampling(probs, ps)
+    got = set(np.unique(ids.numpy()).tolist())
+    # nucleus at 0.7 keeps tokens {0, 1} only
+    assert got.issubset({0, 1}), got
