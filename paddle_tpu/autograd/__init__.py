@@ -7,3 +7,49 @@ from ..framework.tensor import no_grad, enable_grad, set_grad_enabled
 
 __all__ = ["backward", "grad", "PyLayer", "PyLayerContext", "no_grad",
            "enable_grad", "set_grad_enabled"]
+
+
+def jacobian(ys, xs, batch_axis=None):
+    """paddle.autograd.jacobian (functional): lazy Jacobian object
+    (delegates to incubate.autograd.Jacobian over a function or a pair
+    of computed tensors is not supported — pass a callable)."""
+    from ..incubate.autograd import Jacobian
+    if callable(ys):
+        return Jacobian(ys, xs, is_batched=batch_axis is not None)
+    raise TypeError(
+        "paddle.autograd.jacobian expects (func, xs); tensor-pair form "
+        "has no graph to re-trace in this framework — wrap the "
+        "computation in a function")
+
+
+def hessian(ys, xs, batch_axis=None):
+    from ..incubate.autograd import Hessian
+    if callable(ys):
+        return Hessian(ys, xs, is_batched=batch_axis is not None)
+    raise TypeError(
+        "paddle.autograd.hessian expects (func, xs); wrap the "
+        "computation in a function")
+
+
+class saved_tensors_hooks:
+    """Context registering pack/unpack hooks for saved activations
+    (python/paddle/autograd/saved_tensors_hooks.py). The façade saves
+    residuals inside jax vjp closures, which cannot be intercepted
+    per-tensor; the context is accepted and the hooks validated, with
+    recompute (fleet.utils.recompute) as the supported memory-saving
+    path."""
+
+    def __init__(self, pack_hook, unpack_hook):
+        if not callable(pack_hook) or not callable(unpack_hook):
+            raise TypeError("pack_hook and unpack_hook must be callable")
+        self.pack_hook = pack_hook
+        self.unpack_hook = unpack_hook
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+__all__ += ["jacobian", "hessian", "saved_tensors_hooks"]

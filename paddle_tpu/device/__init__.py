@@ -152,3 +152,113 @@ class Stream:
 
 def current_stream(device=None) -> Stream:
     return Stream()
+
+
+# ---------------------------------------------------------------------------
+# long-tail device API parity (python/paddle/device/__init__.py remainder)
+# ---------------------------------------------------------------------------
+
+class XPUPlace(Place):
+    def __init__(self, device_id: int = 0):
+        super().__init__("xpu", device_id)
+
+
+class IPUPlace(Place):
+    def __init__(self, device_id: int = 0):
+        super().__init__("ipu", device_id)
+
+
+class Event:
+    """API-compat stub (phi/backends stream events): XLA orders execution
+    by data dependence; record/synchronize map to device sync points."""
+
+    def __init__(self, device=None, enable_timing=False, blocking=False,
+                 interprocess=False):
+        self._t = None
+
+    def record(self, stream=None):
+        import time as _time
+        synchronize()
+        self._t = _time.perf_counter()
+
+    def query(self) -> bool:
+        return True
+
+    def synchronize(self):
+        synchronize()
+
+    def elapsed_time(self, end_event) -> float:
+        if self._t is None or end_event._t is None:
+            return 0.0
+        return (end_event._t - self._t) * 1000.0
+
+
+def set_stream(stream=None):
+    return Stream()
+
+
+class stream_guard:
+    """No-op context (XLA has no user streams)."""
+
+    def __init__(self, stream=None):
+        self.stream = stream
+
+    def __enter__(self):
+        return self.stream
+
+    def __exit__(self, *exc):
+        return False
+
+
+def get_all_device_type() -> List[str]:
+    return ["cpu", _canonical(_jax_platform_name())]
+
+
+def get_available_device() -> List[str]:
+    return [f"{_canonical(_jax_platform_name())}:{i}"
+            for i in range(jax.device_count())]
+
+
+def get_available_custom_device() -> List[str]:
+    return []
+
+
+def get_cudnn_version():
+    return None  # no cuDNN on TPU
+
+
+def is_compiled_with_cinn() -> bool:
+    return False  # XLA replaces CINN wholesale (SURVEY.md L7)
+
+
+def is_compiled_with_rocm() -> bool:
+    return False
+
+
+def is_compiled_with_xpu() -> bool:
+    return False
+
+
+def is_compiled_with_ipu() -> bool:
+    return False
+
+
+def is_compiled_with_custom_device(device_type: str) -> bool:
+    return False
+
+
+def is_compiled_with_distribute() -> bool:
+    return True
+
+
+class _DeviceNS:
+    """paddle.device.gpu / .xpu / .npu namespace stubs."""
+
+    @staticmethod
+    def device_count():
+        return 0
+
+
+gpu = _DeviceNS()
+xpu = _DeviceNS()
+npu = _DeviceNS()

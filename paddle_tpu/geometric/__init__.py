@@ -170,3 +170,60 @@ def sample_neighbors(row, colptr, input_nodes, sample_size: int = -1,
         out_count.append(len(neigh))
     return (Tensor(jnp.asarray(np.array(out_neighbors, np.int32))),
             Tensor(jnp.asarray(np.array(out_count, np.int32))))
+
+
+def reindex_heter_graph(x, neighbors, count, value_buffer=None,
+                        index_buffer=None, name=None):
+    """Heterogeneous-graph reindex (reindex.py:169): neighbors/count are
+    per-edge-type lists sharing one id space; ids are compacted once
+    across all types."""
+    xs = np.asarray(_idx(x))
+    uniq = {}
+    for v in xs.tolist():
+        uniq.setdefault(v, len(uniq))
+    out_nodes = list(xs.tolist())
+    reindex_srcs, dsts = [], []
+    for nb_t, cnt_t in zip(neighbors, count):
+        nb = np.asarray(_idx(nb_t))
+        cnt = np.asarray(_idx(cnt_t))
+        for v in nb.tolist():
+            if v not in uniq:
+                uniq[v] = len(uniq)
+                out_nodes.append(v)
+        reindex_srcs.append(np.array([uniq[v] for v in nb.tolist()],
+                                     np.int32))
+        dsts.append(np.repeat(np.arange(len(xs), dtype=np.int32), cnt))
+    return (Tensor(jnp.asarray(np.concatenate(reindex_srcs))),
+            Tensor(jnp.asarray(np.concatenate(dsts))),
+            Tensor(jnp.asarray(np.array(out_nodes, np.int32))))
+
+
+def weighted_sample_neighbors(row, colptr, edge_weight, input_nodes,
+                              sample_size: int = -1, eids=None,
+                              return_eids: bool = False, name=None):
+    """Weighted CSC neighbor sampling (sampling/neighbors.py:180):
+    neighbors drawn without replacement proportionally to edge weight."""
+    if return_eids:
+        raise NotImplementedError("return_eids is not supported yet")
+    r = np.asarray(_idx(row))
+    w = np.asarray(edge_weight.numpy() if isinstance(edge_weight, Tensor)
+                   else edge_weight, np.float64)
+    cp = np.asarray(_idx(colptr))
+    nodes = np.asarray(_idx(input_nodes))
+    out_neighbors, out_count = [], []
+    for v in nodes.tolist():
+        beg, end = int(cp[v]), int(cp[v + 1])
+        neigh = r[beg:end]
+        wt = w[beg:end]
+        if 0 <= sample_size < len(neigh):
+            probs = wt / wt.sum() if wt.sum() > 0 else None
+            idx = np.random.choice(len(neigh), size=sample_size,
+                                   replace=False, p=probs)
+            neigh = neigh[idx]
+        out_neighbors.extend(neigh.tolist())
+        out_count.append(len(neigh))
+    return (Tensor(jnp.asarray(np.array(out_neighbors, np.int32))),
+            Tensor(jnp.asarray(np.array(out_count, np.int32))))
+
+
+__all__ += ["reindex_heter_graph", "weighted_sample_neighbors"]

@@ -5,3 +5,163 @@ from . import moe  # noqa: F401
 from .moe import MoELayer, GShardGate, SwitchGate  # noqa: F401
 from . import asp  # noqa: F401
 from . import autograd  # noqa: F401
+
+# top-level incubate re-exports (python/paddle/incubate/__init__.py)
+from ..geometric import (segment_max, segment_mean,  # noqa: F401
+                         segment_min, segment_sum)
+from ..geometric import sample_neighbors as graph_sample_neighbors  # noqa
+from ..geometric import reindex_graph as graph_reindex  # noqa: F401
+from ..geometric import send_u_recv as graph_send_recv  # noqa: F401
+
+
+def graph_khop_sampler(row, colptr, input_nodes, sample_sizes,
+                       sorted_eids=None, return_eids=False, name=None):
+    """Multi-hop sampling: chained sample_neighbors + reindex
+    (incubate/operators/graph_khop_sampler.py)."""
+    import numpy as np
+    from ..framework.tensor import Tensor
+    from ..geometric import sample_neighbors
+    cur = input_nodes
+    seeds_list, neighbors_list, counts_list = [], [], []
+    for k in sample_sizes:
+        nb, cnt = sample_neighbors(row, colptr, cur, sample_size=k)
+        seeds_list.append(np.asarray(
+            cur.numpy() if isinstance(cur, Tensor) else cur))
+        neighbors_list.append(np.asarray(nb.numpy()))
+        counts_list.append(np.asarray(cnt.numpy()))
+        cur = nb
+    # union-compact ids over every hop, edges from ALL hops
+    uniq = {}
+    order = []
+    def rid(v):
+        if v not in uniq:
+            uniq[v] = len(uniq)
+            order.append(v)
+        return uniq[v]
+    for v in seeds_list[0].tolist():
+        rid(v)
+    srcs, dsts = [], []
+    for seeds, nbs, cnts in zip(seeds_list, neighbors_list, counts_list):
+        dst_global = np.repeat(seeds, cnts)
+        for s_node, d_node in zip(nbs.tolist(), dst_global.tolist()):
+            srcs.append(rid(s_node))
+            dsts.append(rid(d_node))
+    return (Tensor(np.asarray(srcs, np.int32)),
+            Tensor(np.asarray(dsts, np.int32)),
+            Tensor(np.asarray(order, np.int32)),
+            Tensor(np.asarray(np.concatenate(counts_list), np.int32)))
+
+
+def softmax_mask_fuse(x, mask, name=None):
+    """Fused softmax(x + mask) (incubate/operators/softmax_mask_fuse.py);
+    one XLA fusion on TPU."""
+    from ..framework.tensor import apply_op
+    import jax
+    return apply_op(lambda a, m: jax.nn.softmax(a + m, axis=-1), x, mask,
+                    _op_name="softmax_mask_fuse")
+
+
+def softmax_mask_fuse_upper_triangle(x, name=None):
+    """softmax with causal (upper-triangle-masked) logits fused."""
+    from ..framework.tensor import apply_op
+    import jax
+    import jax.numpy as jnp
+
+    def f(a):
+        s = a.shape[-1]
+        causal = jnp.tril(jnp.ones((a.shape[-2], s), bool))
+        return jax.nn.softmax(jnp.where(causal, a, -1e30), axis=-1)
+    return apply_op(f, x, _op_name="softmax_mask_fuse_upper_triangle")
+
+
+def identity_loss(x, reduction="none"):
+    """Mark a tensor as a loss (IPU-oriented op); reduces per flag."""
+    if reduction in ("none", 2):
+        return x
+    return x.mean() if reduction in ("mean", 0) else x.sum()
+
+
+class LookAhead:
+    """Lookahead wrapper optimizer (incubate/optimizer/lookahead.py):
+    every k steps, slow weights interpolate toward fast weights."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5, name=None):
+        self.inner = inner_optimizer
+        self.alpha = alpha
+        self.k = int(k)
+        self._slow = None
+        self._steps = 0
+        self._parameter_list = inner_optimizer._parameter_list
+
+    def step(self):
+        import jax.numpy as jnp
+        self.inner.step()
+        self._steps += 1
+        params = [p for p in self._parameter_list if not p.stop_gradient]
+        if self._slow is None:
+            # copy: the inner optimizer's update rules donate the param
+            # buffers, which would delete aliased references
+            self._slow = [jnp.copy(p._data) for p in params]
+        if self._steps % self.k == 0:
+            for i, p in enumerate(params):
+                slow = self._slow[i] + self.alpha * (
+                    p._data.astype(self._slow[i].dtype) - self._slow[i])
+                self._slow[i] = slow
+                # copy, not astype: a no-op astype aliases `slow`, and the
+                # next donated update would delete the stored slow weight
+                p._data = jnp.array(slow, dtype=p._data.dtype, copy=True)
+
+    def clear_grad(self, *a, **k):
+        self.inner.clear_grad(*a, **k)
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, **kw):
+        loss.backward()
+        self.step()
+        self.clear_grad()
+        return None, None
+
+
+class ModelAverage:
+    """EMA of parameters applied at eval (incubate/optimizer/
+    modelaverage.py): accumulate during training, apply()/restore()
+    around evaluation."""
+
+    def __init__(self, average_window_rate=0.15, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._parameter_list = list(parameters or [])
+        self._sums = None
+        self._count = 0
+        self._backup = None
+
+    def step(self):
+        params = [p for p in self._parameter_list if not p.stop_gradient]
+        if self._sums is None:
+            self._sums = [p._data.astype("float32") * 0 for p in params]
+        for i, p in enumerate(params):
+            self._sums[i] = self._sums[i] + p._data.astype("float32")
+        self._count += 1
+
+    def apply(self, executor=None, need_restore=True):
+        params = [p for p in self._parameter_list if not p.stop_gradient]
+        if not self._count:
+            return
+        self._backup = [p._data for p in params]
+        for i, p in enumerate(params):
+            p._data = (self._sums[i] / self._count).astype(p._data.dtype)
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        params = [p for p in self._parameter_list if not p.stop_gradient]
+        for p, b in zip(params, self._backup):
+            p._data = b
+        self._backup = None
+
+    def minimize(self, loss, **kw):
+        return None, None
+
+
+from .. import inference  # noqa: F401  (paddle.incubate.inference alias)

@@ -7,3 +7,28 @@ from .save_load import save, load, TranslatedLayer
 __all__ = ["to_static", "not_to_static", "StaticFunction", "InputSpec",
            "TrainStep", "functional_call", "value_and_grad", "save", "load",
            "TranslatedLayer"]
+
+
+# verbosity / capture-control compat (python/paddle/jit/api.py + sot flags)
+_to_static_enabled = [True]
+_code_level = [0]
+_verbosity = [0]
+
+
+def enable_to_static(enable: bool = True):
+    """Globally toggle to_static capture (disabled -> eager passthrough)."""
+    _to_static_enabled[0] = bool(enable)
+
+
+def ignore_module(modules):
+    """SOT compat: modules to skip during capture. Trace-based capture has
+    no bytecode translation to skip, so this only records the intent."""
+    return list(modules) if isinstance(modules, (list, tuple)) else [modules]
+
+
+def set_code_level(level: int = 100, also_to_stdout: bool = False):
+    _code_level[0] = level
+
+
+def set_verbosity(level: int = 0, also_to_stdout: bool = False):
+    _verbosity[0] = level

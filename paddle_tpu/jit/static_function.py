@@ -85,6 +85,12 @@ class StaticFunction:
         return jax.jit(pure)
 
     def __call__(self, *args, **kwargs):
+        from . import _to_static_enabled
+        if not _to_static_enabled[0]:
+            # paddle.jit.enable_to_static(False): eager passthrough
+            if self._bound_self is not None:
+                return self._fn(self._bound_self, *args, **kwargs)
+            return self._fn(*args, **kwargs)
         if kwargs:
             # keyword args force eager fallback (graph-break semantics)
             if self._bound_self is not None:

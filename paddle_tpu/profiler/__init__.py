@@ -272,3 +272,28 @@ class benchmark:
 
     def end(self):
         pass
+
+
+class SummaryView:
+    """profiler.SummaryView enum (profiler/profiler.py)."""
+    DeviceView = 0
+    OverView = 1
+    ModelView = 2
+    DistributedView = 3
+    KernelView = 4
+    OperatorView = 5
+    MemoryView = 6
+    MemoryManipulationView = 7
+    UDFView = 8
+
+
+def export_protobuf(path: str):
+    """Serialized-dump export hook (the reference dumps protobuf event
+    trees; here the chrome-trace JSON is the canonical dump and this
+    writes it at ``path``)."""
+    def handler(prof):
+        prof.export_chrome_tracing(path)
+    return handler
+
+
+__all__ += ["SummaryView", "export_protobuf"]

@@ -12,13 +12,18 @@ from .graph import (Program, Variable, program_guard,  # noqa: F401
                     in_static_mode, create_parameter, create_global_var,
                     append_backward, gradients, name_scope)
 from .executor import (Executor, CompiledProgram, BuildStrategy,  # noqa
-                       ExecutionStrategy, global_scope, scope_guard, Scope)
+                       ExecutionStrategy, global_scope, scope_guard, Scope,
+                       cpu_places, cuda_places, xpu_places, device_guard,
+                       save, load, save_to_file, load_from_file,
+                       serialize_persistables, deserialize_persistables,
+                       load_program_state, set_program_state, accuracy,
+                       auc, ctr_metric_bundle, ExponentialMovingAverage,
+                       Print, WeightNormParamAttr, IpuStrategy,
+                       IpuCompiledProgram, ipu_shard_guard, set_ipu_shard)
 from .io import (save_inference_model, load_inference_model,  # noqa: F401
                  serialize_program, deserialize_program, normalize_program)
 from . import nn  # noqa: F401
 
-# paddle.static.py_func has no XLA analog; pure-python ops fall back to
-# dynamic mode (jax.pure_callback would break export portability)
 
 __all__ = [
     "InputSpec", "Program", "Variable", "program_guard",
@@ -29,4 +34,12 @@ __all__ = [
     "global_scope", "scope_guard", "Scope",
     "save_inference_model", "load_inference_model", "serialize_program",
     "deserialize_program", "normalize_program", "nn",
+    "cpu_places", "cuda_places", "xpu_places", "device_guard",
+    "save", "load", "save_to_file", "load_from_file",
+    "serialize_persistables", "deserialize_persistables",
+    "load_program_state", "set_program_state", "accuracy", "auc",
+    "ctr_metric_bundle", "ExponentialMovingAverage", "Print",
+    "WeightNormParamAttr", "IpuStrategy", "IpuCompiledProgram",
+    "ipu_shard_guard", "set_ipu_shard", "py_func",
 ]
+from .nn import py_func  # noqa: F401

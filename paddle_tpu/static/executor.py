@@ -347,3 +347,250 @@ class Executor:
         if return_numpy:
             return [np.asarray(jax.device_get(o)) for o in out_fetches]
         return [Tensor(o) for o in out_fetches]
+
+
+# ---------------------------------------------------------------------------
+# long-tail static parity (python/paddle/static/__init__.py remainder)
+# ---------------------------------------------------------------------------
+
+def cpu_places(device_count=None):
+    import jax
+    n = device_count or 1
+    from ..device import CPUPlace
+    return [CPUPlace(i) for i in range(n)]
+
+
+def cuda_places(device_ids=None):
+    import jax
+    from ..device import TPUPlace
+    ids = device_ids if device_ids is not None \
+        else range(jax.device_count())
+    return [TPUPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+class device_guard:
+    """No-op placement context (XLA owns placement)."""
+
+    def __init__(self, device=None):
+        self.device = device
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+def _program_state(program):
+    return {t.name: t for t in program._parameters}
+
+
+def save(program, model_path: str, protocol=4, **configs):
+    """Persist a Program's parameters (static/io.py save)."""
+    from ..framework.io import save as fw_save
+    fw_save(_program_state(program), model_path + ".pdparams"
+            if not model_path.endswith(".pdparams") else model_path)
+
+
+def load(program, model_path: str, executor=None, var_list=None):
+    from ..framework.io import load as fw_load
+    path = model_path if model_path.endswith(".pdparams") \
+        else model_path + ".pdparams"
+    state = fw_load(path)
+    set_program_state(program, state)
+
+
+def save_to_file(path: str, content: bytes):
+    with open(path, "wb") as f:
+        f.write(content)
+
+
+def load_from_file(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def serialize_persistables(feed_vars, fetch_vars, program=None) -> bytes:
+    import pickle
+    if program is None:
+        from .graph import default_main_program
+        program = default_main_program()
+    return pickle.dumps({k: np.asarray(v.numpy())
+                         for k, v in _program_state(program).items()})
+
+
+def deserialize_persistables(program, data: bytes, executor=None):
+    import pickle
+    set_program_state(program, pickle.loads(data))
+
+
+def load_program_state(model_path: str, var_list=None):
+    from ..framework.io import load as fw_load
+    path = model_path if model_path.endswith(".pdparams") \
+        else model_path + ".pdparams"
+    return fw_load(path)
+
+
+def set_program_state(program, state_dict):
+    from ..framework.tensor import Tensor, no_grad
+    by_name = _program_state(program)
+    with no_grad():
+        for k, v in state_dict.items():
+            if k in by_name:
+                arr = v._data if isinstance(v, Tensor) else v
+                import jax.numpy as jnp
+                by_name[k]._data = jnp.asarray(
+                    arr, by_name[k]._data.dtype)
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Top-k accuracy as a recorded op (static/nn metric)."""
+    from ..framework.tensor import apply_op
+    import jax.numpy as jnp
+
+    def f(x, y):
+        topk = jnp.argsort(-x, axis=-1)[..., :k]
+        hit = jnp.any(topk == y.reshape(-1, 1), axis=-1)
+        return jnp.mean(hit.astype(jnp.float32))
+    return apply_op(f, input, label, _op_name="accuracy")
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Batch AUC over the positive-class score (approximate, recorded)."""
+    from ..framework.tensor import apply_op
+    import jax.numpy as jnp
+
+    def f(x, y):
+        score = x[:, 1] if x.ndim == 2 and x.shape[1] >= 2 else \
+            x.reshape(-1)
+        yf = y.reshape(-1).astype(jnp.float32)
+        order = jnp.argsort(score)
+        ranks = jnp.empty_like(order).at[order].set(
+            jnp.arange(1, score.shape[0] + 1))
+        n_pos = jnp.sum(yf)
+        n_neg = yf.shape[0] - n_pos
+        sum_rank_pos = jnp.sum(ranks * yf)
+        auc_v = (sum_rank_pos - n_pos * (n_pos + 1) / 2) / \
+            jnp.maximum(n_pos * n_neg, 1.0)
+        return auc_v.astype(jnp.float32)
+    a = apply_op(f, input, label, _op_name="auc")
+    return a, a, [a]
+
+
+def ctr_metric_bundle(input, label, ins_tag_weight=None):
+    """CTR metrics (abs err, sqr err, q, pos, total) as recorded ops."""
+    from ..framework.tensor import apply_op
+    import jax.numpy as jnp
+
+    def f(x, y):
+        s = x.reshape(-1)
+        yf = y.reshape(-1).astype(jnp.float32)
+        abserr = jnp.sum(jnp.abs(s - yf))
+        sqrerr = jnp.sum((s - yf) ** 2)
+        q = jnp.sum(s)
+        pos = jnp.sum(yf)
+        total = jnp.asarray(s.shape[0], jnp.float32)
+        return abserr, sqrerr, q, pos, total
+    return apply_op(f, input, label, _op_name="ctr_metric_bundle")
+
+
+class ExponentialMovingAverage:
+    """EMA of parameters (static/ema.py): update() after each step,
+    apply()/restore() around evaluation."""
+
+    def __init__(self, decay=0.999, thres_steps=None, name=None):
+        self._decay = decay
+        self._ema = {}
+        self._backup = {}
+        self._step = 0
+
+    def update(self, program=None):
+        from .graph import default_main_program
+        program = program or default_main_program()
+        self._step += 1
+        for p in program._parameters:
+            if p.stop_gradient:
+                continue
+            prev = self._ema.get(p.name)
+            cur = p._data.astype("float32")
+            # zero-init + bias correction in apply() (paddle ema.py)
+            if prev is None:
+                prev = cur * 0
+            self._ema[p.name] = \
+                self._decay * prev + (1 - self._decay) * cur
+
+    def apply(self, executor=None, need_restore=True):
+        from .graph import default_main_program
+        program = default_main_program()
+        for p in program._parameters:
+            if p.name in self._ema:
+                self._backup[p.name] = p._data
+                # bias-corrected EMA (reference applies decay correction)
+                corr = 1 - self._decay ** max(self._step, 1)
+                p._data = (self._ema[p.name] / corr).astype(p._data.dtype)
+        return device_guard()
+
+    def restore(self, executor=None):
+        from .graph import default_main_program
+        program = default_main_program()
+        for p in program._parameters:
+            if p.name in self._backup:
+                p._data = self._backup.pop(p.name)
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=True, print_phase="both"):
+    """Debug print inside the compiled program (control_flow.py Print)
+    via jax.debug.print; returns the input unchanged."""
+    from ..framework.tensor import apply_op
+    import jax
+
+    msg = message or ""
+
+    def f(a):
+        jax.debug.print(msg + " {x}", x=a)
+        return a
+    return apply_op(f, input, _op_name="print")
+
+
+class WeightNormParamAttr:
+    """ParamAttr marker requesting weight normalization
+    (static/param_attr.py); consumed by layers that support it."""
+
+    def __init__(self, dim=None, name=None, initializer=None,
+                 learning_rate=1.0, regularizer=None, trainable=True,
+                 do_model_average=False, need_clip=True):
+        self.dim = dim
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+
+class IpuStrategy:
+    """IPU stubs: not a supported backend (TPU-native build)."""
+
+    def __init__(self):
+        raise NotImplementedError("IPU is not supported on this build")
+
+
+class IpuCompiledProgram:
+    def __init__(self, *a, **k):
+        raise NotImplementedError("IPU is not supported on this build")
+
+
+def ipu_shard_guard(index=-1, stage=-1):
+    raise NotImplementedError("IPU is not supported on this build")
+
+
+def set_ipu_shard(call_func, index=-1, stage=-1):
+    raise NotImplementedError("IPU is not supported on this build")

@@ -16,7 +16,14 @@ from ..nn import initializer as init_mod
 from .graph import create_parameter, default_main_program
 
 __all__ = ["fc", "conv2d", "batch_norm", "embedding", "layer_norm",
-           "dropout", "prelu", "sequence_softmax"]
+           "dropout", "prelu", "sequence_softmax", "conv2d_transpose",
+           "conv3d", "conv3d_transpose", "group_norm", "instance_norm",
+           "data_norm", "spectral_norm", "bilinear_tensor_product",
+           "deform_conv2d", "row_conv", "sequence_pool",
+           "sequence_first_step", "sequence_last_step",
+           "sequence_expand", "sequence_conv", "sparse_embedding",
+           "nce", "cond", "case", "switch_case", "while_loop",
+           "static_pylayer", "py_func"]
 
 
 def _act(x, activation):
@@ -139,3 +146,393 @@ def prelu(x, mode="all", param_attr=None, name=None):
 
 def sequence_softmax(input, axis=-1):
     return F.softmax(input, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# long-tail static.nn parity (static/nn/common.py + control_flow.py)
+# ---------------------------------------------------------------------------
+
+def conv2d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     groups=1, param_attr=None, bias_attr=None, act=None,
+                     data_format="NCHW", name=None, output_size=None):
+    if isinstance(filter_size, int):
+        filter_size = (filter_size, filter_size)
+    cin = input.shape[1]
+    w = create_parameter([cin, num_filters // groups, *filter_size],
+                         dtype=input.dtype.name,
+                         default_initializer=init_mod.KaimingUniform())
+    b = None if bias_attr is False else create_parameter(
+        [num_filters], dtype=input.dtype.name, is_bias=True)
+    out = F.conv2d_transpose(input, w, bias=b, stride=stride,
+                             padding=padding, groups=groups,
+                             output_size=output_size,
+                             data_format=data_format)
+    return _act(out, act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, data_format="NCDHW", name=None):
+    if isinstance(filter_size, int):
+        filter_size = (filter_size,) * 3
+    cin = input.shape[1]
+    w = create_parameter([num_filters, cin // groups, *filter_size],
+                         dtype=input.dtype.name,
+                         default_initializer=init_mod.KaimingUniform())
+    b = None if bias_attr is False else create_parameter(
+        [num_filters], dtype=input.dtype.name, is_bias=True)
+    out = F.conv3d(input, w, bias=b, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups,
+                   data_format=data_format)
+    return _act(out, act)
+
+
+def conv3d_transpose(input, num_filters, filter_size, stride=1, padding=0,
+                     groups=1, param_attr=None, bias_attr=None, act=None,
+                     data_format="NCDHW", name=None, output_size=None):
+    if isinstance(filter_size, int):
+        filter_size = (filter_size,) * 3
+    cin = input.shape[1]
+    w = create_parameter([cin, num_filters // groups, *filter_size],
+                         dtype=input.dtype.name,
+                         default_initializer=init_mod.KaimingUniform())
+    b = None if bias_attr is False else create_parameter(
+        [num_filters], dtype=input.dtype.name, is_bias=True)
+    out = F.conv3d_transpose(input, w, bias=b, stride=stride,
+                             padding=padding, groups=groups,
+                             data_format=data_format)
+    return _act(out, act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    c = input.shape[1]
+    dt = input.dtype.name
+    g = create_parameter([c], dtype=dt,
+                         default_initializer=init_mod.Constant(1.0))
+    b = create_parameter([c], dtype=dt, is_bias=True)
+    out = F.group_norm(input, groups, weight=g, bias=b, epsilon=epsilon)
+    return _act(out, act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    c = input.shape[1]
+    dt = input.dtype.name
+    g = create_parameter([c], dtype=dt,
+                         default_initializer=init_mod.Constant(1.0))
+    b = create_parameter([c], dtype=dt, is_bias=True)
+    return F.instance_norm(input, weight=g, bias=b, eps=epsilon)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """Normalize with accumulated batch statistics (static/nn/common.py
+    data_norm — the PS-era BN without affine params by default)."""
+    # normalized with the CURRENT batch's statistics: without a stat-
+    # update op in the recorded graph, frozen accumulators would pin
+    # mean=0/var=1 forever; batch stats keep the op actually normalizing
+    mean = input.mean(axis=0, keepdim=True)
+    var = ((input - mean) ** 2).mean(axis=0, keepdim=True)
+    out = (input - mean) / (var + epsilon).sqrt()
+    return _act(out, act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    return F.spectral_norm(weight, dim=dim, power_iters=power_iters,
+                           eps=eps)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    dx, dy = x.shape[-1], y.shape[-1]
+    dt = x.dtype.name
+    w = create_parameter([size, dx, dy], dtype=dt)
+    b = None if bias_attr is False else create_parameter(
+        [size], dtype=dt, is_bias=True)
+    out = F.bilinear(x, y, w, b)
+    return _act(out, act)
+
+
+def deform_conv2d(input, offset, mask, num_filters, filter_size,
+                  stride=1, padding=0, dilation=1, groups=1,
+                  deformable_groups=1, im2col_step=1, param_attr=None,
+                  bias_attr=None, name=None):
+    from ..vision.ops import deform_conv2d as _dc
+    if isinstance(filter_size, int):
+        filter_size = (filter_size, filter_size)
+    cin = input.shape[1]
+    w = create_parameter([num_filters, cin // groups, *filter_size],
+                         dtype=input.dtype.name)
+    b = None if bias_attr is False else create_parameter(
+        [num_filters], dtype=input.dtype.name, is_bias=True)
+    return _dc(input, offset, w, bias=b, stride=stride, padding=padding,
+               dilation=dilation, deformable_groups=deformable_groups,
+               groups=groups, mask=mask)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    """Lookahead row convolution over [B, T, D] (static/nn/common.py)."""
+    d = input.shape[-1]
+    w = create_parameter([future_context_size + 1, d],
+                         dtype=input.dtype.name)
+    from ..framework.tensor import apply_op
+    import jax.numpy as jnp
+
+    def f(a, k):
+        T = a.shape[1]
+        ctx = k.shape[0]
+        pad = jnp.pad(a, ((0, 0), (0, ctx - 1), (0, 0)))
+        out = 0.0
+        for i in range(ctx):
+            out = out + pad[:, i:i + T, :] * k[i]
+        return out
+    out = apply_op(f, input, w, _op_name="row_conv")
+    return _act(out, act)
+
+
+# -- legacy sequence ops on padded [B, T, D] batches ----------------------
+
+def sequence_pool(input, pool_type, is_test=False, pad_value=0.0):
+    pt = pool_type.lower()
+    if pt == "sum":
+        return input.sum(axis=1)
+    if pt in ("average", "avg", "mean"):
+        return input.mean(axis=1)
+    if pt == "max":
+        return input.max(axis=1)
+    if pt == "sqrt":
+        import math as _math
+        return input.sum(axis=1) / _math.sqrt(input.shape[1])
+    if pt == "first":
+        return input[:, 0]
+    if pt == "last":
+        return input[:, -1]
+    raise ValueError(f"unknown pool_type {pool_type!r}")
+
+
+def sequence_first_step(input):
+    return input[:, 0]
+
+
+def sequence_last_step(input):
+    return input[:, -1]
+
+
+def sequence_expand(x, y, ref_level=-1, name=None):
+    """Repeat x rows to match y's time dim (padded-batch semantics of the
+    legacy LoD expand)."""
+    from ..framework.tensor import apply_op
+    import jax.numpy as jnp
+
+    def f(a, b):
+        reps = b.shape[1] if b.ndim > 1 else 1
+        return jnp.repeat(a[:, None], reps, axis=1).reshape(
+            (-1,) + a.shape[1:])
+    return apply_op(f, x, y, _op_name="sequence_expand")
+
+
+def sequence_conv(input, num_filters, filter_size=3, filter_stride=1,
+                  padding=True, padding_start=None, bias_attr=None,
+                  param_attr=None, act=None):
+    """Temporal conv over [B, T, D] (legacy sequence_conv on padded
+    batches): window of filter_size steps -> num_filters."""
+    d = input.shape[-1]
+    w = create_parameter([filter_size * d, num_filters],
+                         dtype=input.dtype.name)
+    from ..framework.tensor import apply_op
+    import jax.numpy as jnp
+
+    def f(a, k):
+        B, T, D = a.shape
+        half = (filter_size - 1) // 2
+        pad = jnp.pad(a, ((0, 0), (half, filter_size - 1 - half), (0, 0)))
+        cols = jnp.stack([pad[:, i:i + T] for i in range(filter_size)],
+                         axis=2)  # [B, T, fs, D]
+        cols = cols.reshape(B, T, filter_size * D)
+        return cols @ k
+    out = apply_op(f, input, w, _op_name="sequence_conv")
+    if bias_attr is not False:
+        b = create_parameter([num_filters], dtype=input.dtype.name,
+                             is_bias=True)
+        out = out + b
+    return _act(out, act)
+
+
+def sparse_embedding(input, size, padding_idx=None, is_test=False,
+                     entry=None, table_class="MemorySparseTable",
+                     param_attr=None, dtype="float32", slot=None):
+    """PS-backed embedding (static/nn/common.py sparse_embedding): when a
+    parameter-server client is initialized (distributed.ps.init_worker),
+    rows live on the PS; otherwise a dense embedding parameter."""
+    from ..distributed import ps as ps_mod
+    cli = ps_mod.get_client()
+    if cli is not None:
+        emb = ps_mod.DistributedEmbedding(cli, size[1])
+        return emb(input)
+    return embedding(input, size, padding_idx=padding_idx, dtype=dtype)
+
+
+def nce(input, label, num_total_classes, sample_weight=None,
+        param_attr=None, bias_attr=None, num_neg_samples=5, name=None,
+        sampler="uniform", custom_dist=None, seed=0, is_sparse=False):
+    """Noise-contrastive estimation loss (sampled negatives + BCE),
+    the static/nn/common.py nce contract."""
+    d = input.shape[-1]
+    w = create_parameter([num_total_classes, d], dtype=input.dtype.name)
+    b = create_parameter([num_total_classes], dtype=input.dtype.name,
+                         is_bias=True)
+    from ..framework.tensor import apply_op
+    from ..framework import random as rnd
+    import jax
+    import jax.numpy as jnp
+    key = rnd.op_key(input, label)
+
+    def f(x, y, wt, bt, k):
+        B = x.shape[0]
+        neg = jax.random.randint(k, (B, num_neg_samples), 0,
+                                 num_total_classes)
+        pos_logit = jnp.sum(x * wt[y.reshape(-1)], axis=-1) + \
+            bt[y.reshape(-1)]
+        neg_logit = jnp.einsum("bd,bnd->bn", x, wt[neg]) + bt[neg]
+        pos_loss = jnp.log1p(jnp.exp(-pos_logit))
+        neg_loss = jnp.sum(jnp.log1p(jnp.exp(neg_logit)), axis=-1)
+        return (pos_loss + neg_loss)[:, None]
+    return apply_op(f, input, label, w, b, key, _op_name="nce")
+
+
+# -- control flow ---------------------------------------------------------
+
+def cond(pred, true_fn=None, false_fn=None, name=None,
+         return_names=None):
+    """Static cond: both branches are recorded (they may create ops in
+    the program); outputs selected elementwise by ``pred``. This is the
+    GSPMD-friendly select form — XLA executes both branches, which is
+    the usual TPU tradeoff for tiny branch bodies."""
+    t_out = true_fn() if true_fn is not None else None
+    f_out = false_fn() if false_fn is not None else None
+    if t_out is None:
+        return None
+    from ..framework.tensor import apply_op
+    import jax.numpy as jnp
+
+    def select(p, a, b):
+        return apply_op(
+            lambda pp, aa, bb: jnp.where(pp.astype(bool), aa, bb),
+            p, a, b, _op_name="cond_select")
+
+    if isinstance(t_out, (list, tuple)):
+        return type(t_out)(select(pred, a, b)
+                           for a, b in zip(t_out, f_out))
+    return select(pred, t_out, f_out)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First matching predicate wins (control_flow.py case)."""
+    out = default() if default is not None else None
+    for p, fn in reversed(list(pred_fn_pairs)):
+        out = cond(p, fn, (lambda o=out: o))
+    return out
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Integer-indexed branch select (control_flow.py switch_case)."""
+    from ..framework.tensor import apply_op
+    import jax.numpy as jnp
+    items = branch_fns.items() if isinstance(branch_fns, dict) \
+        else list(enumerate(branch_fns))
+    out = default() if default is not None else None
+    for idx, fn in items:
+        eq = apply_op(lambda b, i=int(idx): b.astype(jnp.int32) == i,
+                      branch_index, _op_name="switch_eq")
+        out = cond(eq, fn, (lambda o=out: o))
+    return out
+
+
+def while_loop(cond_fn, body_fn, loop_vars, is_test=False, name=None):
+    """Data-dependent loop recorded as ONE op wrapping lax.while_loop;
+    the python body runs on tracers through the same eager dispatch
+    (gradients through the loop are not supported — matching the
+    reference's restriction that while grads need explicit care)."""
+    from ..framework.tensor import Tensor, apply_op, no_grad
+    import jax
+
+    def f(*arrs):
+        def c(vals):
+            with no_grad():
+                t = [Tensor(v) for v in vals]
+                out = cond_fn(*t)
+            return out._data.astype(bool).reshape(())
+
+        def b(vals):
+            with no_grad():
+                t = [Tensor(v) for v in vals]
+                out = body_fn(*t)
+            out = out if isinstance(out, (list, tuple)) else [out]
+            return tuple(o._data for o in out)
+
+        return jax.lax.while_loop(c, b, tuple(arrs))
+
+    res = apply_op(f, *loop_vars, _op_name="while_loop")
+    return list(res) if isinstance(res, tuple) else [res]
+
+
+def static_pylayer(forward_fn, inputs, backward_fn=None, name=None):
+    """control_flow.py static_pylayer: custom forward with optional
+    custom backward (jax.custom_vjp over the recorded op)."""
+    from ..framework.tensor import Tensor, apply_op
+    import jax
+
+    if backward_fn is None:
+        out = forward_fn(*inputs)
+        return out
+
+    def fwd_arrays(*arrs):
+        t = [Tensor(a) for a in arrs]
+        out = forward_fn(*t)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return tuple(o._data for o in outs)
+
+    @jax.custom_vjp
+    def op(*arrs):
+        return fwd_arrays(*arrs)
+
+    def op_fwd(*arrs):
+        return fwd_arrays(*arrs), arrs
+
+    def op_bwd(res, g):
+        gt = [Tensor(x) for x in (g if isinstance(g, tuple) else (g,))]
+        out = backward_fn(*gt)
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return tuple(o._data for o in outs)
+
+    op.defvjp(op_fwd, op_bwd)
+    res = apply_op(op, *inputs, _op_name="static_pylayer")
+    return res
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host python op via jax.pure_callback (static/nn/common.py py_func);
+    ``out`` supplies the result template (shape/dtype)."""
+    from ..framework.tensor import apply_op
+    import jax
+    import numpy as _np
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    shapes = [jax.ShapeDtypeStruct(tuple(o.shape), o.dtype.np_dtype
+                                   if hasattr(o.dtype, "np_dtype")
+                                   else o.dtype) for o in outs]
+
+    def f(*arrs):
+        def host(*np_arrs):
+            r = func(*np_arrs)
+            rs = r if isinstance(r, (list, tuple)) else [r]
+            return tuple(_np.asarray(v) for v in rs)
+        res = jax.pure_callback(
+            host, tuple(shapes), *arrs, vmap_method="sequential")
+        return res if len(shapes) > 1 else res[0]
+    return apply_op(f, *xs, _op_name="py_func")

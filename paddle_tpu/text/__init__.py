@@ -84,3 +84,203 @@ class ViterbiDecoder(Layer):
     def forward(self, potentials, lengths):
         return viterbi_decode(potentials, self.transitions, lengths,
                               self.include_bos_eos_tag)
+
+
+# ---------------------------------------------------------------------------
+# classic text datasets (reference: python/paddle/text/datasets/*) —
+# file-backed; the reference auto-downloads, this build has no network
+# egress so ``data_file`` must point at a local copy.
+# ---------------------------------------------------------------------------
+
+from ..io.dataset import Dataset as _Dataset
+
+
+class _FileDataset(_Dataset):
+    """Shared shape for the classic datasets: a local archive/file path
+    plus a parse step; raises with download instructions if absent."""
+
+    URL = ""
+    NAME = "dataset"
+
+    def __init__(self, data_file=None, mode="train", **kwargs):
+        import os
+        self.mode = mode
+        if data_file is None or not os.path.exists(data_file):
+            raise FileNotFoundError(
+                f"{self.NAME}: pass data_file= pointing at a local copy "
+                f"(this environment has no network egress; reference "
+                f"source: {self.URL})")
+        self.data_file = data_file
+        self._samples = self._load()
+
+    def _load(self):
+        raise NotImplementedError
+
+    def __getitem__(self, idx):
+        return self._samples[idx]
+
+    def __len__(self):
+        return len(self._samples)
+
+
+class UCIHousing(_FileDataset):
+    """UCI Boston housing (text/datasets/uci_housing.py): 13 features +
+    price per line, whitespace-separated."""
+
+    URL = "http://paddlemodels.bj.bcebos.com/uci_housing/housing.data"
+    NAME = "UCIHousing"
+
+    def _load(self):
+        import numpy as _np
+        rows = []
+        with open(self.data_file) as f:
+            for line in f:
+                vals = [float(v) for v in line.split()]
+                if len(vals) == 14:
+                    rows.append(vals)
+        arr = _np.asarray(rows, _np.float32)
+        n = len(arr)
+        split = int(n * 0.8)
+        arr = arr[:split] if self.mode == "train" else arr[split:]
+        # feature-wise normalization (reference preprocesses the same way)
+        mean, std = arr[:, :13].mean(0), arr[:, :13].std(0) + 1e-8
+        return [((r[:13] - mean) / std, r[13:]) for r in arr]
+
+
+class Imdb(_FileDataset):
+    """IMDB sentiment (text/datasets/imdb.py): expects the aclImdb tgz
+    or an extracted dir with pos/ and neg/ subdirs per split."""
+
+    URL = "https://dataset.bj.bcebos.com/imdb%2FaclImdb_v1.tar.gz"
+    NAME = "Imdb"
+
+    def __init__(self, data_file=None, mode="train", cutoff=150, **kw):
+        self.cutoff = cutoff
+        super().__init__(data_file, mode, **kw)
+
+    def _iter_texts(self, split):
+        import os
+        import re as _re
+        base = os.path.join(self.data_file, split)
+        for label, sub in ((1, "pos"), (0, "neg")):
+            d = os.path.join(base, sub)
+            if not os.path.isdir(d):
+                continue
+            for fn in sorted(os.listdir(d))[:5000]:
+                text = open(os.path.join(d, fn),
+                            encoding="utf-8", errors="ignore").read()
+                yield _re.findall(r"[a-z\']+", text.lower()), label
+
+    def _load(self):
+        # vocab over BOTH splits with frequency cutoff, deterministic
+        # (freq desc, then token) — train/test must share word ids
+        from collections import Counter
+        freq = Counter()
+        for split in ("train", "test"):
+            for toks, _ in self._iter_texts(split):
+                freq.update(toks)
+        kept = sorted((t for t, c in freq.items() if c >= min(
+            self.cutoff, max(freq.values()) if freq else 1)),
+            key=lambda t: (-freq[t], t))
+        vocab = {t: i for i, t in enumerate(kept)}
+        unk = len(vocab)
+        samples = []
+        for toks, label in self._iter_texts(self.mode):
+            samples.append(([vocab.get(t, unk) for t in toks], label))
+        self.word_idx = vocab
+        return samples
+
+
+class Imikolov(_FileDataset):
+    """PTB-style n-gram dataset (text/datasets/imikolov.py)."""
+
+    URL = "https://dataset.bj.bcebos.com/imikolov%2Fsimple-examples.tgz"
+    NAME = "Imikolov"
+
+    def __init__(self, data_file=None, data_type="NGRAM", window_size=5,
+                 mode="train", min_word_freq=50, **kw):
+        self.window_size = window_size
+        self.data_type = data_type
+        super().__init__(data_file, mode, **kw)
+
+    def _load(self):
+        lines = open(self.data_file, encoding="utf-8",
+                     errors="ignore").read().splitlines()
+        vocab = {"<unk>": 0}
+        grams = []
+        for ln in lines:
+            toks = ln.split()
+            ids = []
+            for t in toks:
+                if t not in vocab:
+                    vocab[t] = len(vocab)
+                ids.append(vocab[t])
+            for i in range(len(ids) - self.window_size + 1):
+                grams.append(tuple(ids[i:i + self.window_size]))
+        self.word_idx = vocab
+        return grams
+
+
+class Movielens(_FileDataset):
+    """MovieLens ratings (text/datasets/movielens.py): expects the
+    ml-1m ratings.dat ('uid::mid::rating::ts')."""
+
+    URL = "https://dataset.bj.bcebos.com/movielens%2Fml-1m.zip"
+    NAME = "Movielens"
+
+    def _load(self):
+        rows = []
+        for ln in open(self.data_file, encoding="utf-8",
+                       errors="ignore"):
+            parts = ln.strip().split("::")
+            if len(parts) >= 3:
+                rows.append((int(parts[0]), int(parts[1]),
+                             float(parts[2])))
+        n = len(rows)
+        split = int(n * 0.9)
+        return rows[:split] if self.mode == "train" else rows[split:]
+
+
+class Conll05st(_FileDataset):
+    """CoNLL-2005 SRL (text/datasets/conll05.py): expects the
+    preprocessed word/label file pairs joined by tab."""
+
+    URL = "http://paddlemodels.bj.bcebos.com/conll05st/conll05st-tests.tar.gz"
+    NAME = "Conll05st"
+
+    def _load(self):
+        samples = []
+        for ln in open(self.data_file, encoding="utf-8",
+                       errors="ignore"):
+            parts = ln.rstrip("\n").split("\t")
+            if len(parts) >= 2:
+                samples.append((parts[0].split(), parts[1].split()))
+        return samples
+
+
+class _WMTBase(_FileDataset):
+    def _load(self):
+        samples = []
+        for ln in open(self.data_file, encoding="utf-8",
+                       errors="ignore"):
+            parts = ln.rstrip("\n").split("\t")
+            if len(parts) >= 2:
+                samples.append((parts[0].split(), parts[1].split()))
+        return samples
+
+
+class WMT14(_WMTBase):
+    """WMT'14 en-fr (text/datasets/wmt14.py): tab-separated parallel
+    sentences per line."""
+    URL = "http://paddlemodels.bj.bcebos.com/wmt/wmt14.tgz"
+    NAME = "WMT14"
+
+
+class WMT16(_WMTBase):
+    """WMT'16 en-de (text/datasets/wmt16.py)."""
+    URL = "http://paddlemodels.bj.bcebos.com/wmt/wmt16.tar.gz"
+    NAME = "WMT16"
+
+
+__all__ += ["UCIHousing", "Imdb", "Imikolov", "Movielens", "Conll05st",
+            "WMT14", "WMT16"]

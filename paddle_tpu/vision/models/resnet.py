@@ -170,3 +170,31 @@ def resnext101_32x4d(pretrained=False, **kwargs):
     kwargs["groups"] = 32
     kwargs["width"] = 4
     return _resnet(BottleneckBlock, 101, pretrained, **kwargs)
+
+
+def resnext50_64x4d(pretrained=False, **kwargs):
+    kwargs["groups"] = 64
+    kwargs["width"] = 4
+    return _resnet(BottleneckBlock, 50, pretrained, **kwargs)
+
+
+def resnext101_64x4d(pretrained=False, **kwargs):
+    kwargs["groups"] = 64
+    kwargs["width"] = 4
+    return _resnet(BottleneckBlock, 101, pretrained, **kwargs)
+
+
+def resnext152_32x4d(pretrained=False, **kwargs):
+    kwargs["groups"] = 32
+    kwargs["width"] = 4
+    return _resnet(BottleneckBlock, 152, pretrained, **kwargs)
+
+
+def resnext152_64x4d(pretrained=False, **kwargs):
+    kwargs["groups"] = 64
+    kwargs["width"] = 4
+    return _resnet(BottleneckBlock, 152, pretrained, **kwargs)
+
+
+__all__ += ["resnext50_64x4d", "resnext101_64x4d", "resnext152_32x4d",
+            "resnext152_64x4d"]

@@ -209,3 +209,350 @@ class Pad(BaseTransform):
             p = (p, p, p, p)
         pw = [(p[1], p[3]), (p[0], p[2])] + [(0, 0)] * (arr.ndim - 2)
         return np.pad(arr, pw, constant_values=self.fill)
+
+
+# ---------------------------------------------------------------------------
+# long-tail transforms parity (vision/transforms/{transforms,functional}.py)
+# — host-side numpy image ops, HWC uint8/float arrays or Tensors
+# ---------------------------------------------------------------------------
+
+def _hwc(img):
+    return _to_hwc_array(img)
+
+
+def to_tensor(pic, data_format="CHW"):
+    arr = _hwc(pic).astype(np.float32) / (255.0 if np.asarray(
+        pic).dtype == np.uint8 else 1.0)
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    from ..framework.tensor import Tensor
+    return Tensor(arr)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    from ..framework.tensor import Tensor
+    arr = np.asarray(img.numpy() if isinstance(img, Tensor) else img,
+                     np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    shape = (-1, 1, 1) if data_format == "CHW" else (1, 1, -1)
+    out = (arr - mean.reshape(shape)) / std.reshape(shape)
+    return Tensor(out) if isinstance(img, Tensor) else out
+
+
+def resize(img, size, interpolation="bilinear"):
+    # int size = shorter-edge resize (aspect preserved) — _resize_np
+    # already implements both contracts
+    return _resize_np(_hwc(img), size)
+
+
+def crop(img, top, left, height, width):
+    return _hwc(img)[top:top + height, left:left + width]
+
+
+def center_crop(img, output_size):
+    arr = _hwc(img)
+    oh, ow = (output_size, output_size) if isinstance(output_size, int) \
+        else output_size
+    h, w = arr.shape[:2]
+    top = max((h - oh) // 2, 0)
+    left = max((w - ow) // 2, 0)
+    return arr[top:top + oh, left:left + ow]
+
+
+def hflip(img):
+    return _hwc(img)[:, ::-1]
+
+
+def vflip(img):
+    return _hwc(img)[::-1]
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    arr = _hwc(img)
+    if isinstance(padding, int):
+        padding = [padding] * 4
+    l, t, r, b = padding
+    mode = {"constant": "constant", "edge": "edge",
+            "reflect": "reflect", "symmetric": "symmetric"}[padding_mode]
+    kw = {"constant_values": fill} if mode == "constant" else {}
+    return np.pad(arr, ((t, b), (l, r), (0, 0)), mode=mode, **kw)
+
+
+def adjust_brightness(img, brightness_factor):
+    arr = _hwc(img).astype(np.float32)
+    out = np.clip(arr * brightness_factor, 0, 255)
+    return out.astype(np.asarray(img).dtype) if not hasattr(img, "_data") \
+        else out
+
+
+def adjust_contrast(img, contrast_factor):
+    arr = _hwc(img).astype(np.float32)
+    mean = arr.mean()
+    out = np.clip((arr - mean) * contrast_factor + mean, 0, 255)
+    return out
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor (in [-0.5, 0.5]) via HSV roundtrip."""
+    arr = _hwc(img).astype(np.float32)
+    scale = 255.0 if arr.max() > 1.5 else 1.0
+    x = arr / scale
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    mx, mn = x.max(-1), x.min(-1)
+    diff = mx - mn + 1e-12
+    h = np.zeros_like(mx)
+    m = mx == r
+    h[m] = ((g - b) / diff)[m] % 6
+    m = mx == g
+    h[m] = ((b - r) / diff + 2)[m]
+    m = mx == b
+    h[m] = ((r - g) / diff + 4)[m]
+    h = (h / 6.0 + hue_factor) % 1.0
+    s = np.where(mx > 0, diff / (mx + 1e-12), 0)
+    v = mx
+    i = np.floor(h * 6.0)
+    f = h * 6.0 - i
+    p = v * (1 - s)
+    q = v * (1 - f * s)
+    t = v * (1 - (1 - f) * s)
+    i = i.astype(np.int32) % 6
+    out = np.zeros_like(x)
+    for idx, (rr, gg, bb) in enumerate([(v, t, p), (q, v, p), (p, v, t),
+                                        (p, q, v), (t, p, v), (v, p, q)]):
+        mask = i == idx
+        out[..., 0][mask] = rr[mask]
+        out[..., 1][mask] = gg[mask]
+        out[..., 2][mask] = bb[mask]
+    return out * scale
+
+
+def to_grayscale(img, num_output_channels=1):
+    arr = _hwc(img).astype(np.float32)
+    gray = (0.2989 * arr[..., 0] + 0.587 * arr[..., 1] +
+            0.114 * arr[..., 2])[..., None]
+    return np.repeat(gray, num_output_channels, axis=-1)
+
+
+def rotate(img, angle, interpolation="nearest", expand=False,
+           center=None, fill=0):
+    arr = _hwc(img)
+    h, w = arr.shape[:2]
+    cy, cx = (h / 2.0, w / 2.0) if center is None else (center[1],
+                                                        center[0])
+    rad = -np.deg2rad(angle)
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    ys = cy + (yy - cy) * np.cos(rad) - (xx - cx) * np.sin(rad)
+    xs = cx + (yy - cy) * np.sin(rad) + (xx - cx) * np.cos(rad)
+    yi = np.clip(np.round(ys).astype(np.int32), 0, h - 1)
+    xi = np.clip(np.round(xs).astype(np.int32), 0, w - 1)
+    out = arr[yi, xi]
+    inb = (ys >= 0) & (ys < h) & (xs >= 0) & (xs < w)
+    out = np.where(inb[..., None], out, fill)
+    return out
+
+
+def affine(img, angle, translate, scale, shear, interpolation="nearest",
+           fill=0, center=None):
+    arr = _hwc(img)
+    h, w = arr.shape[:2]
+    cy, cx = (h / 2.0, w / 2.0) if center is None else (center[1],
+                                                        center[0])
+    rad = -np.deg2rad(angle)
+    sx = np.deg2rad(shear[0] if isinstance(shear, (list, tuple))
+                    else shear)
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    y0 = yy - cy - translate[1]
+    x0 = xx - cx - translate[0]
+    ys = cy + (y0 * np.cos(rad) - x0 * np.sin(rad)) / scale
+    xs = cx + (y0 * np.sin(rad) + x0 * np.cos(rad) + y0 * np.tan(
+        sx + 1e-12)) / scale
+    yi = np.clip(np.round(ys).astype(np.int32), 0, h - 1)
+    xi = np.clip(np.round(xs).astype(np.int32), 0, w - 1)
+    out = arr[yi, xi]
+    inb = (ys >= 0) & (ys < h) & (xs >= 0) & (xs < w)
+    return np.where(inb[..., None], out, fill)
+
+
+def perspective(img, startpoints, endpoints, interpolation="nearest",
+                fill=0):
+    """Projective warp from 4 point correspondences."""
+    arr = _hwc(img)
+    h, w = arr.shape[:2]
+    A = []
+    B = []
+    for (sx, sy), (ex, ey) in zip(startpoints, endpoints):
+        A.append([ex, ey, 1, 0, 0, 0, -sx * ex, -sx * ey])
+        A.append([0, 0, 0, ex, ey, 1, -sy * ex, -sy * ey])
+        B.extend([sx, sy])
+    coef = np.linalg.lstsq(np.asarray(A, np.float64),
+                           np.asarray(B, np.float64), rcond=None)[0]
+    a, b, c, d, e, f, g, hh = coef
+    yy, xx = np.meshgrid(np.arange(h), np.arange(w), indexing="ij")
+    den = g * xx + hh * yy + 1
+    xs = (a * xx + b * yy + c) / den
+    ys = (d * xx + e * yy + f) / den
+    yi = np.clip(np.round(ys).astype(np.int32), 0, h - 1)
+    xi = np.clip(np.round(xs).astype(np.int32), 0, w - 1)
+    out = arr[yi, xi]
+    inb = (ys >= 0) & (ys < h) & (xs >= 0) & (xs < w)
+    return np.where(inb[..., None], out, fill)
+
+
+def erase(img, i, j, h, w, v, inplace=False):
+    from ..framework.tensor import Tensor
+    if isinstance(img, Tensor):
+        arr = img.numpy().copy()
+        arr[..., i:i + h, j:j + w] = v
+        return Tensor(arr)
+    arr = np.array(img, copy=True)
+    arr[i:i + h, j:j + w] = v
+    return arr
+
+
+class ContrastTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        f = 1.0 + np.random.uniform(-self.value, self.value)
+        return adjust_contrast(img, f)
+
+
+class SaturationTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        f = 1.0 + np.random.uniform(-self.value, self.value)
+        arr = _hwc(img).astype(np.float32)
+        gray = to_grayscale(arr, 3)
+        return np.clip(gray + (arr - gray) * f, 0, 255)
+
+
+class HueTransform(BaseTransform):
+    def __init__(self, value, keys=None):
+        self.value = value
+
+    def __call__(self, img):
+        if self.value == 0:
+            return img
+        return adjust_hue(img, np.random.uniform(-self.value, self.value))
+
+
+class ColorJitter(BaseTransform):
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0,
+                 keys=None):
+        self.t = [BrightnessTransform(brightness),
+                  ContrastTransform(contrast),
+                  SaturationTransform(saturation), HueTransform(hue)]
+
+    def __call__(self, img):
+        order = np.random.permutation(4)
+        for i in order:
+            img = self.t[i](img)
+        return img
+
+
+class Grayscale(BaseTransform):
+    def __init__(self, num_output_channels=1, keys=None):
+        self.n = num_output_channels
+
+    def __call__(self, img):
+        return to_grayscale(img, self.n)
+
+
+class RandomRotation(BaseTransform):
+    def __init__(self, degrees, interpolation="nearest", expand=False,
+                 center=None, fill=0, keys=None):
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else degrees
+        self.kw = dict(interpolation=interpolation, expand=expand,
+                       center=center, fill=fill)
+
+    def __call__(self, img):
+        a = np.random.uniform(*self.degrees)
+        return rotate(img, a, **self.kw)
+
+
+class RandomAffine(BaseTransform):
+    def __init__(self, degrees, translate=None, scale=None, shear=None,
+                 interpolation="nearest", fill=0, center=None, keys=None):
+        self.degrees = (-degrees, degrees) if np.isscalar(degrees) \
+            else degrees
+        self.translate = translate
+        self.scale = scale
+        self.shear = shear
+        self.fill = fill
+        self.center = center
+
+    def __call__(self, img):
+        h, w = _hwc(img).shape[:2]
+        a = np.random.uniform(*self.degrees)
+        tr = (0, 0)
+        if self.translate:
+            tr = (np.random.uniform(-self.translate[0], self.translate[0]) * w,
+                  np.random.uniform(-self.translate[1], self.translate[1]) * h)
+        sc = np.random.uniform(*self.scale) if self.scale else 1.0
+        sh = np.random.uniform(-self.shear, self.shear) \
+            if np.isscalar(self.shear) and self.shear else 0.0
+        return affine(img, a, tr, sc, sh, fill=self.fill,
+                      center=self.center)
+
+
+class RandomPerspective(BaseTransform):
+    def __init__(self, prob=0.5, distortion_scale=0.5,
+                 interpolation="nearest", fill=0, keys=None):
+        self.prob = prob
+        self.scale = distortion_scale
+
+    def __call__(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        h, w = _hwc(img).shape[:2]
+        d = self.scale
+        def jit(x, y):
+            return (x + np.random.uniform(-d, d) * w / 2,
+                    y + np.random.uniform(-d, d) * h / 2)
+        start = [(0, 0), (w - 1, 0), (w - 1, h - 1), (0, h - 1)]
+        end = [jit(*p) for p in start]
+        return perspective(img, start, end)
+
+
+class RandomErasing(BaseTransform):
+    def __init__(self, prob=0.5, scale=(0.02, 0.33), ratio=(0.3, 3.3),
+                 value=0, inplace=False, keys=None):
+        self.prob = prob
+        self.scale = scale
+        self.ratio = ratio
+        self.value = value
+
+    def __call__(self, img):
+        if np.random.rand() >= self.prob:
+            return img
+        from ..framework.tensor import Tensor
+        chw = isinstance(img, Tensor)
+        arr = img.numpy() if chw else _hwc(img)
+        h, w = (arr.shape[-2], arr.shape[-1]) if chw else arr.shape[:2]
+        area = h * w * np.random.uniform(*self.scale)
+        r = np.random.uniform(*self.ratio)
+        eh = int(round(np.sqrt(area * r)))
+        ew = int(round(np.sqrt(area / r)))
+        if eh >= h or ew >= w or eh < 1 or ew < 1:
+            return img
+        i = np.random.randint(0, h - eh)
+        j = np.random.randint(0, w - ew)
+        return erase(img, i, j, eh, ew, self.value)
+
+
+__all_extras__ = [
+    "ColorJitter", "ContrastTransform", "Grayscale", "HueTransform",
+    "RandomAffine", "RandomErasing", "RandomPerspective",
+    "RandomRotation", "SaturationTransform", "adjust_brightness",
+    "adjust_contrast", "adjust_hue", "affine", "center_crop", "crop",
+    "erase", "hflip", "normalize", "pad", "perspective", "resize",
+    "rotate", "to_grayscale", "to_tensor", "vflip"]
