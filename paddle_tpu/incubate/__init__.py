@@ -75,10 +75,15 @@ def softmax_mask_fuse_upper_triangle(x, name=None):
 
 
 def identity_loss(x, reduction="none"):
-    """Mark a tensor as a loss (IPU-oriented op); reduces per flag."""
+    """Mark a tensor as a loss (IPU-oriented op); reduces per flag
+    (reference codes: 0=sum, 1=mean, 2=none)."""
     if reduction in ("none", 2):
         return x
-    return x.mean() if reduction in ("mean", 0) else x.sum()
+    if reduction in ("mean", 1):
+        return x.mean()
+    if reduction in ("sum", 0):
+        return x.sum()
+    raise ValueError(f"unknown reduction {reduction!r}")
 
 
 class LookAhead:

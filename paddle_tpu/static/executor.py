@@ -525,15 +525,27 @@ class ExponentialMovingAverage:
                 self._decay * prev + (1 - self._decay) * cur
 
     def apply(self, executor=None, need_restore=True):
-        from .graph import default_main_program
-        program = default_main_program()
-        for p in program._parameters:
-            if p.name in self._ema:
-                self._backup[p.name] = p._data
-                # bias-corrected EMA (reference applies decay correction)
-                corr = 1 - self._decay ** max(self._step, 1)
-                p._data = (self._ema[p.name] / corr).astype(p._data.dtype)
-        return device_guard()
+        """Context manager: installs EMA weights, restores on exit when
+        need_restore (reference static/nn/common.py contract)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            from .graph import default_main_program
+            program = default_main_program()
+            for p in program._parameters:
+                if p.name in self._ema:
+                    self._backup[p.name] = p._data
+                    # bias-corrected EMA (decay correction, zero-init)
+                    corr = 1 - self._decay ** max(self._step, 1)
+                    p._data = (self._ema[p.name] / corr).astype(
+                        p._data.dtype)
+            try:
+                yield self
+            finally:
+                if need_restore:
+                    self.restore(executor)
+        return _ctx()
 
     def restore(self, executor=None):
         from .graph import default_main_program
@@ -552,7 +564,8 @@ def Print(input, first_n=-1, message=None, summarize=20,
     from ..framework.tensor import apply_op
     import jax
 
-    msg = message or ""
+    # user text must not be interpreted as a format template
+    msg = (message or "").replace("{", "{{").replace("}", "}}")
 
     def f(a):
         jax.debug.print(msg + " {x}", x=a)

@@ -414,7 +414,11 @@ def cond(pred, true_fn=None, false_fn=None, name=None,
     GSPMD-friendly select form — XLA executes both branches, which is
     the usual TPU tradeoff for tiny branch bodies."""
     t_out = true_fn() if true_fn is not None else None
-    f_out = false_fn() if false_fn is not None else None
+    if false_fn is None:
+        # no else-branch: the reference returns the true branch's output
+        # unconditionally in this form
+        return t_out
+    f_out = false_fn()
     if t_out is None:
         return None
     from ..framework.tensor import apply_op
@@ -432,9 +436,15 @@ def cond(pred, true_fn=None, false_fn=None, name=None,
 
 
 def case(pred_fn_pairs, default=None, name=None):
-    """First matching predicate wins (control_flow.py case)."""
-    out = default() if default is not None else None
-    for p, fn in reversed(list(pred_fn_pairs)):
+    """First matching predicate wins (control_flow.py case); with no
+    default, the LAST pair's fn is the fallback (reference contract)."""
+    pairs = list(pred_fn_pairs)
+    if default is None:
+        if not pairs:
+            raise ValueError("case needs pred_fn_pairs")
+        default = pairs[-1][1]
+    out = default()
+    for p, fn in reversed(pairs):
         out = cond(p, fn, (lambda o=out: o))
     return out
 
@@ -443,9 +453,13 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
     """Integer-indexed branch select (control_flow.py switch_case)."""
     from ..framework.tensor import apply_op
     import jax.numpy as jnp
-    items = branch_fns.items() if isinstance(branch_fns, dict) \
+    items = list(branch_fns.items()) if isinstance(branch_fns, dict) \
         else list(enumerate(branch_fns))
-    out = default() if default is not None else None
+    if default is None:
+        if not items:
+            raise ValueError("switch_case needs branch_fns")
+        default = items[-1][1]  # reference: last branch is the fallback
+    out = default()
     for idx, fn in items:
         eq = apply_op(lambda b, i=int(idx): b.astype(jnp.int32) == i,
                       branch_index, _op_name="switch_eq")

@@ -139,11 +139,13 @@ class UCIHousing(_FileDataset):
                 if len(vals) == 14:
                     rows.append(vals)
         arr = _np.asarray(rows, _np.float32)
+        # normalize with FULL-dataset statistics, then split (the
+        # reference preprocesses before splitting, so train/test share
+        # one feature scale)
+        mean, std = arr[:, :13].mean(0), arr[:, :13].std(0) + 1e-8
         n = len(arr)
         split = int(n * 0.8)
         arr = arr[:split] if self.mode == "train" else arr[split:]
-        # feature-wise normalization (reference preprocesses the same way)
-        mean, std = arr[:, :13].mean(0), arr[:, :13].std(0) + 1e-8
         return [((r[:13] - mean) / std, r[13:]) for r in arr]
 
 

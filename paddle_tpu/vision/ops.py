@@ -162,16 +162,17 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
         base_y, base_x = jnp.meshgrid(ys, xs, indexing="ij")
         cols = []
         off = off.reshape(N, deformable_groups, kh * kw, 2, oh, ow)
+        cg_sz = C // deformable_groups  # channels per deformable group
         for t in range(kh * kw):
             ky, kx = divmod(t, kw)
             dy = off[:, :, t, 0]
             dx = off[:, :, t, 1]
-            # collapse deformable groups by broadcast (dg=1 common case)
             py = base_y[None, None] + ky * dl[0] + dy
             px = base_x[None, None] + kx * dl[1] + dx
             gy = 2.0 * py / jnp.maximum(Hp - 1, 1) - 1.0
             gx = 2.0 * px / jnp.maximum(Wp - 1, 1) - 1.0
-            grid = jnp.stack([gx[:, 0], gy[:, 0]], axis=-1)  # [N,oh,ow,2]
+            # per-deformable-group grid [N, dg, oh, ow, 2]
+            grid_g = jnp.stack([gx, gy], axis=-1)
 
             # bilinear sample all channels at the tap locations
             def bil(img, g):
@@ -192,10 +193,19 @@ def deform_conv2d(x, offset, weight, bias=None, stride=1, padding=0,
                      gat(y1, x0) * (1 - wx) * wy +
                      gat(y1, x1) * wx * wy)
                 return v
-            sampled = jax.vmap(bil)(a_p, grid)  # [N, C, oh, ow]
+            # sample each deformable group's channel slab with its own
+            # offsets, then concat back to [N, C, oh, ow]
+            slabs = []
+            for g_i in range(deformable_groups):
+                sl = jax.vmap(bil)(
+                    a_p[:, g_i * cg_sz:(g_i + 1) * cg_sz],
+                    grid_g[:, g_i])
+                slabs.append(sl)
+            sampled = jnp.concatenate(slabs, axis=1)
             if msk is not None:
                 m = msk.reshape(N, deformable_groups, kh * kw, oh, ow)
-                sampled = sampled * m[:, 0, t][:, None]
+                mg = jnp.repeat(m[:, :, t], cg_sz, axis=1)
+                sampled = sampled * mg
             cols.append(sampled)
         col = jnp.stack(cols, axis=2)  # [N, C, kh*kw, oh, ow]
         col = col.reshape(N, C * kh * kw, oh * ow)
@@ -256,8 +266,14 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         output_size = (output_size, output_size)
     oh, ow = output_size
 
-    def f(feat, rois, _n):
-        def one_roi(roi):
+    def f(feat, rois, n_per_img):
+        N = feat.shape[0]
+        n_rois = rois.shape[0]
+        img_of_roi = jnp.repeat(jnp.arange(N), n_per_img,
+                                total_repeat_length=n_rois)
+
+        def one_roi(roi, img_idx):
+            img = feat[img_idx]
             x1, y1, x2, y2 = [v * spatial_scale for v in
                               (roi[0], roi[1], roi[2], roi[3])]
             H, W = feat.shape[-2:]
@@ -273,10 +289,10 @@ def roi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                     xi = jnp.arange(W)
                     mask_x = (xi >= jnp.floor(xs_)) & (xi < jnp.ceil(xe) + 1e-6)
                     m = mask_y[:, None] & mask_x[None, :]
-                    region = jnp.where(m[None], feat[0], -jnp.inf)
+                    region = jnp.where(m[None], img, -jnp.inf)
                     outs.append(jnp.max(region, axis=(-2, -1)))
             return jnp.stack(outs, -1).reshape(-1, oh, ow)
-        return jax.vmap(one_roi)(rois)
+        return jax.vmap(one_roi)(rois, img_of_roi)
     return apply_op(f, x, boxes, boxes_num, _op_name="roi_pool")
 
 
@@ -289,11 +305,16 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         output_size = (output_size, output_size)
     oh, ow = output_size
 
-    def f(feat, rois, _n):
+    def f(feat, rois, n_per_img):
+        N = feat.shape[0]
         C = feat.shape[1]
         co = C // (oh * ow)
+        n_rois = rois.shape[0]
+        img_of_roi = jnp.repeat(jnp.arange(N), n_per_img,
+                                total_repeat_length=n_rois)
 
-        def one_roi(roi):
+        def one_roi(roi, img_idx):
+            img = feat[img_idx]
             x1, y1, x2, y2 = [v * spatial_scale for v in
                               (roi[0], roi[1], roi[2], roi[3])]
             H, W = feat.shape[-2:]
@@ -310,13 +331,13 @@ def psroi_pool(x, boxes, boxes_num, output_size, spatial_scale=1.0,
                          (yi[:, None] < jnp.ceil(ye) + 1e-6) &
                          (xi[None, :] >= jnp.floor(xs_)) &
                          (xi[None, :] < jnp.ceil(xe) + 1e-6))
-                    grp = feat[0, (i * ow + j) * co:(i * ow + j + 1) * co]
+                    grp = img[(i * ow + j) * co:(i * ow + j + 1) * co]
                     cnt = jnp.maximum(jnp.sum(m), 1)
                     v = jnp.sum(jnp.where(m[None], grp, 0.0),
                                 axis=(-2, -1)) / cnt
                     outs = outs.at[:, i, j].set(v)
             return outs
-        return jax.vmap(one_roi)(rois)
+        return jax.vmap(one_roi)(rois, img_of_roi)
     return apply_op(f, x, boxes, boxes_num, _op_name="psroi_pool")
 
 
@@ -453,11 +474,21 @@ def generate_proposals(scores, bbox_deltas, img_size, anchors, variances,
                        pixel_offset=False, return_rois_num=False,
                        name=None):
     """RPN proposal generation (host-side composition of decode+nms)."""
-    s = np.asarray(_unwrap(scores), np.float32)[0].reshape(-1)
+    s_raw = np.asarray(_unwrap(scores), np.float32)[0]
     d = np.asarray(_unwrap(bbox_deltas), np.float32)[0]
     a = np.asarray(_unwrap(anchors), np.float32).reshape(-1, 4)
     v = np.asarray(_unwrap(variances), np.float32).reshape(-1, 4)
-    d = d.reshape(4, -1).T if d.ndim == 3 else d.reshape(-1, 4)
+    # layouts: deltas [A*4, H, W] (anchor-major channel blocks), scores
+    # [A, H, W], anchors [H, W, A, 4]-flattened (h, w, a)-major — align
+    # everything to (h, w, a)-major rows
+    if d.ndim == 3:
+        A = d.shape[0] // 4
+        H, W = d.shape[1], d.shape[2]
+        d = d.reshape(A, 4, H, W).transpose(2, 3, 0, 1).reshape(-1, 4)
+        s = s_raw.reshape(A, H, W).transpose(1, 2, 0).reshape(-1)
+    else:
+        d = d.reshape(-1, 4)
+        s = s_raw.reshape(-1)
     order = np.argsort(-s)[:pre_nms_top_n]
     aw = a[:, 2] - a[:, 0]
     ah = a[:, 3] - a[:, 1]

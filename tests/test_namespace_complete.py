@@ -238,3 +238,80 @@ def test_text_dataset_file_backed(tmp_path):
     assert len(ds) == 16
     with pytest.raises(FileNotFoundError):
         paddle.text.WMT14(data_file="/nonexistent")
+
+
+def test_roi_pool_batched_images():
+    """RoIs must pool from THEIR image (boxes_num mapping)."""
+    from paddle_tpu.vision.ops import roi_pool
+    x0 = np.zeros((1, 1, 4, 4), "f4")
+    x1 = np.ones((1, 1, 4, 4), "f4") * 7
+    x = paddle.to_tensor(np.concatenate([x0, x1]))
+    rois = paddle.to_tensor(np.array([[0, 0, 4, 4], [0, 0, 4, 4]], "f4"))
+    nums = paddle.to_tensor(np.array([1, 1], "i4"))
+    out = roi_pool(x, rois, nums, 1)
+    np.testing.assert_allclose(out.numpy().reshape(-1), [0.0, 7.0])
+
+
+def test_deform_conv2d_groups():
+    from paddle_tpu.vision.ops import deform_conv2d
+    paddle.seed(0)
+    x = paddle.to_tensor(np.random.RandomState(0).randn(
+        1, 4, 6, 6).astype("f4"))
+    w = paddle.to_tensor(np.random.RandomState(1).randn(
+        4, 4, 3, 3).astype("f4") * 0.1)
+    off = paddle.zeros([1, 2 * 2 * 9, 6, 6])  # dg=2
+    out = deform_conv2d(x, off, w, padding=1, deformable_groups=2)
+    ref = paddle.nn.functional.conv2d(x, w, padding=1)
+    np.testing.assert_allclose(out.numpy(), ref.numpy(), atol=1e-3)
+
+
+def test_case_without_default_and_ema_ctx():
+    paddle.enable_static()
+    try:
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [1], "float32")
+            out = static.nn.case([(x.sum() > 10, lambda: x * 2.0),
+                                  (x.sum() > 0, lambda: x * 3.0)])
+        exe = static.Executor()
+        (o,) = exe.run(main, feed={"x": np.array([1.0], "f4")},
+                       fetch_list=[out])
+        np.testing.assert_allclose(o, [3.0])
+        # last branch is the fallback
+        (o2,) = exe.run(main, feed={"x": np.array([-1.0], "f4")},
+                        fetch_list=[out])
+        np.testing.assert_allclose(o2, [-3.0])
+        # EMA: apply is a restoring context
+        main2 = static.Program()
+        with static.program_guard(main2):
+            y = static.data("y", [None, 2], "float32")
+            pred = static.nn.fc(y, 1, bias_attr=False)
+        w = main2.all_parameters()[0]
+        ema = static.ExponentialMovingAverage(0.5)
+        import paddle_tpu.framework as fw
+        with fw.no_grad():
+            w._data = w._data * 0 + 1.0
+        # build EMA against main2's params
+        from paddle_tpu.static.graph import default_main_program
+        with static.program_guard(main2):
+            ema.update(main2)
+            with fw.no_grad():
+                w._data = w._data * 0 + 3.0
+            ema.update(main2)
+            before = w.numpy().copy()
+            with ema.apply():
+                applied = w.numpy().copy()
+            after = w.numpy()
+        assert not np.allclose(applied, before)
+        np.testing.assert_allclose(after, before)  # restored on exit
+    finally:
+        paddle.disable_static()
+
+
+def test_identity_loss_codes():
+    x = paddle.to_tensor(np.array([1.0, 3.0], "f4"))
+    np.testing.assert_allclose(float(paddle.incubate.identity_loss(
+        x, 0).numpy()), 4.0)  # 0 = sum
+    np.testing.assert_allclose(float(paddle.incubate.identity_loss(
+        x, 1).numpy()), 2.0)  # 1 = mean
+    assert paddle.incubate.identity_loss(x, 2) is x
