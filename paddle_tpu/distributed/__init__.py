@@ -28,6 +28,15 @@ from . import rpc  # noqa: F401
 from . import watchdog  # noqa: F401
 from . import ps  # noqa: F401
 from .store import TCPStore  # noqa: F401
+from .extras import (alltoall, alltoall_single, gather,  # noqa: F401
+                     broadcast_object_list, scatter_object_list,
+                     destroy_process_group, get_backend, is_available,
+                     gloo_init_parallel_env, gloo_barrier, gloo_release,
+                     ParallelMode, ReduceType, DistAttr, Strategy,
+                     shard_dataloader, shard_scaler, split,
+                     QueueDataset, InMemoryDataset, CountFilterEntry,
+                     ProbabilityEntry, ShowClickEntry)
+from . import io  # noqa: F401
 from .checkpoint import load_state_dict, save_state_dict
 from .launch import spawn
 

@@ -1,0 +1,312 @@
+"""Long-tail distributed API parity (python/paddle/distributed/
+__init__.py remainder): collective aliases/object collectives, PS-era
+dataset classes, auto-parallel Strategy/DistAttr, TP split op."""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .collective import all_to_all, all_to_all_single
+
+__all__ = ["alltoall", "alltoall_single", "gather",
+           "broadcast_object_list", "scatter_object_list",
+           "destroy_process_group", "get_backend", "is_available",
+           "gloo_init_parallel_env", "gloo_barrier", "gloo_release",
+           "ParallelMode", "ReduceType", "DistAttr", "Strategy",
+           "shard_dataloader", "shard_scaler", "split",
+           "QueueDataset", "InMemoryDataset", "CountFilterEntry",
+           "ProbabilityEntry", "ShowClickEntry"]
+
+# collective aliases (communication/all_to_all.py exports both names)
+alltoall = all_to_all
+alltoall_single = all_to_all_single
+
+
+def gather(tensor, gather_list=None, dst=0, group=None, sync_op=True):
+    """Single-controller semantics: every rank's value is the global
+    value, so gather materializes nranks copies at dst."""
+    if gather_list is None:
+        gather_list = []
+    if group is None:
+        from .collective import _get_default_group
+        group = _get_default_group()
+    n = group.nranks
+    for _ in range(max(n, 1)):
+        gather_list.append(Tensor(tensor._data,
+                                  stop_gradient=tensor.stop_gradient))
+    return gather_list
+
+
+def broadcast_object_list(object_list, src=0, group=None):
+    return object_list  # value already global in single-controller view
+
+
+def scatter_object_list(out_object_list, in_object_list=None, src=0,
+                        group=None):
+    from .env import get_rank
+    if in_object_list:
+        out_object_list.append(
+            in_object_list[get_rank() % len(in_object_list)])
+    return out_object_list
+
+
+def destroy_process_group(group=None):
+    from . import collective
+    if group is None:
+        collective._groups.clear()
+        collective._default_group = None
+    else:
+        collective._groups.pop(group.id, None)
+
+
+def get_backend(group=None) -> str:
+    return "xla"  # collectives are XLA HLO over ICI/DCN
+
+
+def is_available() -> bool:
+    return True
+
+
+def gloo_init_parallel_env(rank_id, rank_num, server_endpoint):
+    """CPU-barrier env (reference uses gloo): the TCPStore covers the
+    same rendezvous contract."""
+    from .store import TCPStore
+    host, port = server_endpoint.rsplit(":", 1)
+    global _gloo_store
+    _gloo_store = TCPStore(host, int(port), is_master=(rank_id == 0),
+                           world_size=rank_num)
+    return _gloo_store
+
+
+_gloo_store = None
+
+
+def gloo_barrier():
+    if _gloo_store is None:
+        raise RuntimeError("call gloo_init_parallel_env first")
+    _gloo_store.barrier()
+
+
+def gloo_release():
+    global _gloo_store
+    if _gloo_store is not None:
+        _gloo_store.close()
+        _gloo_store = None
+
+
+from .fleet.topology import ParallelMode  # noqa: E402,F401
+
+
+class ReduceType:
+    """auto_parallel reduce types (dist_attribute.h ReduceType)."""
+    kRedSum = 0
+    kRedMax = 1
+    kRedMin = 2
+    kRedProd = 3
+    kRedAvg = 4
+    kRedAny = 5
+    kRedAll = 6
+
+
+class DistAttr:
+    """TensorDistAttr surface (phi/core/distributed/auto_parallel/
+    dist_attr.h:81): process mesh + per-dim sharding names."""
+
+    def __init__(self, mesh=None, sharding_specs=None):
+        self.process_mesh = mesh
+        self.sharding_specs = list(sharding_specs or [])
+        self.dims_mapping = []
+        if mesh is not None and sharding_specs is not None:
+            names = list(mesh.dim_names)
+            self.dims_mapping = [
+                names.index(s) if s in names else -1
+                for s in self.sharding_specs]
+
+    def __repr__(self):
+        return (f"DistAttr(mesh={self.process_mesh}, "
+                f"specs={self.sharding_specs})")
+
+
+class Strategy:
+    """auto_parallel.Strategy (auto_parallel/strategy.py): nested config
+    switches consumed by dist.to_static/Engine."""
+
+    class _Cfg:
+        def __init__(self, **kw):
+            self.enable = False
+            self.__dict__.update(kw)
+
+    def __init__(self, config=None):
+        self.amp = Strategy._Cfg(dtype="float16", level="o1")
+        self.sharding = Strategy._Cfg(stage=1, degree=8)
+        self.recompute = Strategy._Cfg()
+        self.pipeline = Strategy._Cfg(schedule_mode="1F1B",
+                                      micro_batch_size=1,
+                                      accumulate_steps=1)
+        self.gradient_merge = Strategy._Cfg(k_steps=1, avg=True)
+        self.fused_passes = Strategy._Cfg(fused_passes_list=[])
+        if config:
+            for k, v in config.items():
+                if hasattr(self, k) and isinstance(v, dict):
+                    getattr(self, k).__dict__.update(v)
+
+
+def shard_dataloader(dataloader, meshes, shard_dims=None,
+                     input_keys=None, is_dataset_splitted=False):
+    """Wrap a DataLoader so each batch lands data-sharded on the mesh
+    (auto_parallel/api.py shard_dataloader): with a single global mesh
+    the batch is device_put with the dp axis sharded."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    mesh = meshes[0] if isinstance(meshes, (list, tuple)) else meshes
+    jm = mesh.jax_mesh() if hasattr(mesh, "jax_mesh") else mesh
+    axis = jm.axis_names[0]
+
+    class _Sharded:
+        def __init__(self, dl):
+            self._dl = dl
+
+        def __iter__(self):
+            for batch in self._dl:
+                yield jax.tree.map(self._place, batch)
+
+        def _place(self, x):
+            if isinstance(x, Tensor) and x._data.ndim and \
+                    x._data.shape[0] % jm.shape[axis] == 0:
+                spec = [None] * x._data.ndim
+                spec[0] = axis
+                return Tensor(jax.device_put(
+                    x._data, NamedSharding(jm, PartitionSpec(*spec))),
+                    stop_gradient=x.stop_gradient)
+            return x
+
+        def __len__(self):
+            return len(self._dl)
+    return _Sharded(dataloader)
+
+
+def shard_scaler(scaler):
+    """GradScaler under sharding (auto_parallel/api.py shard_scaler):
+    scale/unscale are elementwise and found/inf reduction is a global
+    jnp.isfinite-all, which already sees the global array — identity."""
+    return scaler
+
+
+def split(x, size, operation="linear", axis=0, num_partitions=1,
+          gather_out=True, weight_attr=None, bias_attr=None, name=None):
+    """Megatron-style distributed fc/embedding op
+    (python/paddle/distributed/collective.py split): axis=0 row-parallel,
+    axis=1 column-parallel; backed by the fleet TP layer library."""
+    from .fleet.mp_layers import (ColumnParallelLinear, RowParallelLinear,
+                                  VocabParallelEmbedding)
+    if operation == "embedding":
+        layer = VocabParallelEmbedding(size[0], size[1])
+        return layer(x)
+    if axis == 1:
+        layer = ColumnParallelLinear(size[0], size[1],
+                                     gather_output=gather_out,
+                                     bias_attr=bias_attr)
+    else:
+        layer = RowParallelLinear(size[0], size[1],
+                                  input_is_parallel=False,
+                                  bias_attr=bias_attr)
+    return layer(x)
+
+
+# ---------------------------------------------------------------------------
+# PS-era dataset classes (fluid DataFeed/Dataset zoo; file-list driven)
+# ---------------------------------------------------------------------------
+
+class _EntryBase:
+    def __init__(self, *a):
+        self._args = a
+
+
+class CountFilterEntry(_EntryBase):
+    """Sparse-table admission rule: keep keys seen >= threshold
+    (table/ctr_accessor.cc entry configs)."""
+
+    def __init__(self, threshold: int):
+        super().__init__(threshold)
+        self.threshold = threshold
+
+
+class ProbabilityEntry(_EntryBase):
+    def __init__(self, probability: float):
+        super().__init__(probability)
+        self.probability = probability
+
+
+class ShowClickEntry(_EntryBase):
+    def __init__(self, show_name: str, click_name: str):
+        super().__init__(show_name, click_name)
+        self.show_name = show_name
+        self.click_name = click_name
+
+
+class QueueDataset:
+    """Streaming file-list dataset (fluid data_feed.cc QueueDataset):
+    iterates example lines from a file list through the native blocking
+    queue when available."""
+
+    def __init__(self):
+        self._files: List[str] = []
+        self._parse = None
+        self.batch_size = 1
+
+    def init(self, batch_size=1, use_var=None, pipe_command=None,
+             thread_num=1, **kwargs):
+        self.batch_size = batch_size
+
+    def set_filelist(self, filelist: Sequence[str]):
+        self._files = list(filelist)
+
+    def set_parse_fn(self, fn):
+        self._parse = fn
+
+    def __iter__(self):
+        batch = []
+        for path in self._files:
+            with open(path, encoding="utf-8", errors="ignore") as f:
+                for line in f:
+                    item = self._parse(line) if self._parse else line
+                    batch.append(item)
+                    if len(batch) == self.batch_size:
+                        yield batch
+                        batch = []
+        if batch:
+            yield batch
+
+
+class InMemoryDataset(QueueDataset):
+    """Loaded-then-shuffled variant (data_set.cc InMemoryDataset)."""
+
+    def __init__(self):
+        super().__init__()
+        self._samples: List[Any] = []
+
+    def load_into_memory(self):
+        self._samples = []
+        for path in self._files:
+            with open(path, encoding="utf-8", errors="ignore") as f:
+                for line in f:
+                    self._samples.append(
+                        self._parse(line) if self._parse else line)
+
+    def local_shuffle(self):
+        np.random.shuffle(self._samples)
+
+    def global_shuffle(self, fleet=None, thread_num=12):
+        self.local_shuffle()
+
+    def release_memory(self):
+        self._samples = []
+
+    def get_memory_data_size(self, fleet=None):
+        return len(self._samples)
+
+    def __iter__(self):
+        for i in range(0, len(self._samples), self.batch_size):
+            yield self._samples[i:i + self.batch_size]

@@ -1,0 +1,34 @@
+"""paddle.distributed.io (reference: python/paddle/distributed/io.py —
+persistables save/load helpers). The sharded-checkpoint machinery lives
+in distributed.checkpoint; this module is the io-surface mirror so
+``import paddle.distributed.io`` style code ports unchanged."""
+from .checkpoint import load_state_dict, save_state_dict  # noqa: F401
+
+
+def is_persistable(var) -> bool:
+    return bool(getattr(var, "persistable", False))
+
+
+def save_persistables(executor, dirname, main_program=None,
+                      filename=None):
+    """Persist a static Program's parameters (distributed/io.py)."""
+    from ..static.executor import save as static_save
+    from ..static.graph import default_main_program
+    import os
+    program = main_program or default_main_program()
+    path = os.path.join(dirname, filename or "persistables")
+    static_save(program, path)
+
+
+def load_persistables(executor, dirname, main_program=None,
+                      filename=None):
+    from ..static.executor import load as static_load
+    from ..static.graph import default_main_program
+    import os
+    program = main_program or default_main_program()
+    path = os.path.join(dirname, filename or "persistables")
+    static_load(program, path)
+
+
+__all__ = ["save_state_dict", "load_state_dict", "is_persistable",
+           "save_persistables", "load_persistables"]

@@ -66,9 +66,63 @@ def nms(boxes, iou_threshold=0.3, scores=None, category_idxs=None,
     return Tensor(np.asarray(keep_all, np.int64))
 
 
-def box_coder(prior_box, prior_box_var, target_box, code_type="encode_center_size",
-              box_normalized=True, axis=0):
-    raise NotImplementedError("box_coder lands with the detection suite")
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True,
+              axis=0, name=None):
+    """Encode/decode boxes against priors (SSD box coder,
+    phi/kernels/box_coder_kernel)."""
+    from ..framework.tensor import apply_op
+    norm = 0.0 if box_normalized else 1.0
+    if prior_box_var is None:
+        prior_box_var = Tensor(np.ones((1, 4), np.float32))
+    elif not isinstance(prior_box_var, Tensor):
+        prior_box_var = Tensor(np.asarray(prior_box_var,
+                                          np.float32).reshape(-1, 4))
+
+    def enc(pb, pbv, tb):
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw / 2
+        pcy = pb[:, 1] + ph / 2
+        tw = tb[:, None, 2] - tb[:, None, 0] + norm
+        th = tb[:, None, 3] - tb[:, None, 1] + norm
+        tcx = tb[:, None, 0] + tw / 2
+        tcy = tb[:, None, 1] + th / 2
+        ex = (tcx - pcx[None]) / pw[None]
+        ey = (tcy - pcy[None]) / ph[None]
+        ew = jnp.log(jnp.abs(tw / pw[None]))
+        eh = jnp.log(jnp.abs(th / ph[None]))
+        out = jnp.stack([ex, ey, ew, eh], axis=-1)
+        return out / pbv[None] if pbv.ndim == 2 else out / pbv
+
+    def dec(pb, pbv, tb):
+        pw = pb[:, 2] - pb[:, 0] + norm
+        ph = pb[:, 3] - pb[:, 1] + norm
+        pcx = pb[:, 0] + pw / 2
+        pcy = pb[:, 1] + ph / 2
+        # `axis` selects which target dim indexes the priors (decode
+        # contract): broadcast prior stats along the OTHER dim
+        if tb.ndim == 3 and axis == 0:
+            exp = (slice(None), None)
+        elif tb.ndim == 3:
+            exp = (None, slice(None))
+        else:
+            exp = (slice(None),)
+        t = tb * (pbv if pbv.shape[0] == tb.shape[axis]
+                  else jnp.broadcast_to(pbv, (tb.shape[axis], 4)))[exp]             if tb.ndim == 3 else tb * pbv
+        dcx = t[..., 0] * pw[exp] + pcx[exp]
+        dcy = t[..., 1] * ph[exp] + pcy[exp]
+        dw = jnp.exp(t[..., 2]) * pw[exp]
+        dh = jnp.exp(t[..., 3]) * ph[exp]
+        # reference: min corner has no offset; max corner drops the full
+        # pixel when boxes are unnormalized
+        return jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                          dcx + dw / 2 - norm,
+                          dcy + dh / 2 - norm], axis=-1)
+
+    fn = enc if code_type.startswith("encode") else dec
+    return apply_op(fn, prior_box, prior_box_var, target_box,
+                    _op_name="box_coder")
 
 
 def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
@@ -123,10 +177,59 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
     return apply_op(f, x, boxes, _op_name="roi_align")
 
 
-def yolo_box(x, img_size, anchors, class_num, conf_thresh, downsample_ratio,
-             clip_bbox=True, name=None, scale_x_y=1.0, iou_aware=False,
-             iou_aware_factor=0.5):
-    raise NotImplementedError("yolo_box lands with the detection suite")
+def yolo_box(x, img_size, anchors, class_num, conf_thresh,
+             downsample_ratio, clip_bbox=True, name=None, scale_x_y=1.0,
+             iou_aware=False, iou_aware_factor=0.5):
+    """Decode YOLOv3 head output [N, A*(5+C), H, W] to boxes + scores
+    (phi yolo_box kernel): sigmoid xy with scale, exp wh against the
+    anchors, confidence-gated class scores."""
+    from ..framework.tensor import apply_op
+    A = len(anchors) // 2
+
+    def f(pred, imsz):
+        N, _, H, W = pred.shape
+        if iou_aware:
+            # layout [N, A + A*(5+C), H, W]: first A channels are IoU
+            iou_p = jax.nn.sigmoid(pred[:, :A])
+            pred = pred[:, A:]
+        p = pred.reshape(N, A, 5 + class_num, H, W)
+        anc = jnp.asarray(anchors, jnp.float32).reshape(A, 2)
+        gx = jnp.arange(W, dtype=jnp.float32)
+        gy = jnp.arange(H, dtype=jnp.float32)
+        cx = (jax.nn.sigmoid(p[:, :, 0]) * scale_x_y
+              - (scale_x_y - 1) / 2 + gx[None, None, None, :]) / W
+        cy = (jax.nn.sigmoid(p[:, :, 1]) * scale_x_y
+              - (scale_x_y - 1) / 2 + gy[None, None, :, None]) / H
+        in_w = W * downsample_ratio
+        in_h = H * downsample_ratio
+        bw = jnp.exp(p[:, :, 2]) * anc[None, :, 0, None, None] / in_w
+        bh = jnp.exp(p[:, :, 3]) * anc[None, :, 1, None, None] / in_h
+        conf = jax.nn.sigmoid(p[:, :, 4])
+        if iou_aware:
+            conf = conf ** (1 - iou_aware_factor) * \
+                iou_p ** iou_aware_factor
+        cls = jax.nn.sigmoid(p[:, :, 5:])
+        score = conf[:, :, None] * cls  # [N, A, C, H, W]
+        imh = imsz[:, 0].astype(jnp.float32)[:, None, None, None]
+        imw = imsz[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (cx - bw / 2) * imw
+        y1 = (cy - bh / 2) * imh
+        x2 = (cx + bw / 2) * imw
+        y2 = (cy + bh / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], axis=-1)  # [N,A,H,W,4]
+        boxes = boxes.reshape(N, A * H * W, 4)
+        scores = score.transpose(0, 1, 3, 4, 2).reshape(
+            N, A * H * W, class_num)
+        keep = (conf.reshape(N, A * H * W) >= conf_thresh)
+        boxes = jnp.where(keep[..., None], boxes, 0.0)
+        scores = jnp.where(keep[..., None], scores, 0.0)
+        return boxes, scores
+    return apply_op(f, x, img_size, _op_name="yolo_box")
 
 
 # ---------------------------------------------------------------------------

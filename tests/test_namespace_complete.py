@@ -315,3 +315,69 @@ def test_identity_loss_codes():
     np.testing.assert_allclose(float(paddle.incubate.identity_loss(
         x, 1).numpy()), 2.0)  # 1 = mean
     assert paddle.incubate.identity_loss(x, 2) is x
+
+
+def test_distributed_namespace_parity():
+    ref = "/root/reference/python/paddle/distributed/__init__.py"
+    if not os.path.exists(ref):
+        pytest.skip("reference not mounted")
+    src = open(ref).read()
+    m = re.search(r"__all__ = \[(.*?)\]", src, re.S)
+    names = sorted(set(re.findall(r'"([a-zA-Z_][\w]*)"', m.group(1))))
+    import paddle_tpu.distributed as d
+    missing = [n for n in names if not hasattr(d, n)]
+    assert not missing, f"distributed missing: {missing}"
+
+
+def test_yolo_box_and_box_coder():
+    from paddle_tpu.vision.ops import box_coder, yolo_box
+    pred = paddle.to_tensor(np.random.RandomState(0).randn(
+        1, 3 * 7, 4, 4).astype("f4"))
+    imsz = paddle.to_tensor(np.array([[64, 64]], "int32"))
+    boxes, scores = yolo_box(pred, imsz,
+                             anchors=[10, 13, 16, 30, 33, 23],
+                             class_num=2, conf_thresh=0.0,
+                             downsample_ratio=16)
+    assert boxes.shape == [1, 48, 4] and scores.shape == [1, 48, 2]
+    assert (boxes.numpy() >= 0).all() and (boxes.numpy() <= 63).all()
+    pb = paddle.to_tensor(np.array([[0., 0., 10., 10.]], "f4"))
+    pbv = paddle.to_tensor(np.array([[1., 1., 1., 1.]], "f4"))
+    tb = paddle.to_tensor(np.array([[2., 2., 8., 8.]], "f4"))
+    enc = box_coder(pb, pbv, tb, "encode_center_size")
+    dec = box_coder(pb, pbv, enc[:, 0], "decode_center_size")
+    np.testing.assert_allclose(dec.numpy()[0], tb.numpy()[0], atol=1e-4)
+
+
+def test_distributed_extras_behaviors():
+    import paddle_tpu.distributed as dist
+    assert dist.get_backend() == "xla" and dist.is_available()
+    s = dist.Strategy({"sharding": {"enable": True, "stage": 2}})
+    assert s.sharding.enable and s.sharding.stage == 2
+    mesh = dist.ProcessMesh([0, 1], dim_names=["dp"])
+    attr = dist.DistAttr(mesh, ["dp", None])
+    assert attr.dims_mapping == [0, -1]
+    # shard_dataloader places batches data-sharded
+    dist.set_mesh(mesh)
+    try:
+        from paddle_tpu.io import DataLoader, TensorDataset
+        xs = paddle.to_tensor(np.arange(16, dtype="f4").reshape(8, 2))
+        ys = paddle.to_tensor(np.zeros((8,), "i8"))
+        dl = DataLoader(TensorDataset([xs, ys]), batch_size=4)
+        sdl = dist.shard_dataloader(dl, mesh)
+        batch = next(iter(sdl))
+        assert batch[0].shape[0] == 4
+    finally:
+        dist.set_mesh(None)
+    ds = dist.InMemoryDataset()
+    import tempfile, os as _os
+    with tempfile.NamedTemporaryFile("w", suffix=".txt",
+                                     delete=False) as f:
+        f.write("a\nb\nc\n")
+        path = f.name
+    ds.init(batch_size=2)
+    ds.set_filelist([path])
+    ds.load_into_memory()
+    assert ds.get_memory_data_size() == 3
+    batches = list(ds)
+    assert len(batches) == 2
+    _os.unlink(path)
