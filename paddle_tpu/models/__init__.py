@@ -1,8 +1,11 @@
 """Flagship model families (GPT for causal LM, BERT/ERNIE encoders)."""
 from . import bert  # noqa: F401
+from . import llama  # noqa: F401
 from . import gpt  # noqa: F401
 from .bert import (BertConfig, BertForPretraining,  # noqa: F401
                    BertForSequenceClassification, BertModel, ErnieModel,
                    ErnieForSequenceClassification, bert_base, bert_large)
 from .gpt import (GPTConfig, GPTForCausalLM, GPTModel,  # noqa: F401
                   GPTSpmdTrainer, build_mesh)
+from .llama import (LlamaConfig, LlamaForCausalLM,  # noqa: F401
+                    LlamaModel, llama_tiny_config)

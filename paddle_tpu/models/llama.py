@@ -1,0 +1,272 @@
+"""Llama model family (RMSNorm + RoPE + SwiGLU decoder).
+
+Reference shape: the reference's end-to-end auto-parallel parity test is
+a Llama (test/auto_parallel/hybrid_strategy/semi_auto_llama.py:98 —
+full model under DPxMPxPP configs with acc-align and save/load). Built
+from this framework's layers so it runs eagerly, under jit.to_static,
+under dist.to_static/DistModel, and with the fleet TP layer library when
+``use_tp`` — mirroring the GPT family's two-path design.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import apply_op
+from ..nn import functional as F
+from ..nn.layer_base import Layer
+from ..nn.layer.common import Embedding, Linear
+from ..nn.layer.container import LayerList
+from ..nn.layer.norm import RMSNorm
+
+__all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
+           "llama_tiny_config"]
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: Optional[int] = None  # GQA; None = MHA
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-6
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+
+    @property
+    def head_dim(self):
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def kv_heads(self):
+        return self.num_key_value_heads or self.num_attention_heads
+
+
+def llama_tiny_config(**kw) -> LlamaConfig:
+    kw.setdefault("vocab_size", 128)
+    kw.setdefault("hidden_size", 64)
+    kw.setdefault("intermediate_size", 128)
+    kw.setdefault("num_hidden_layers", 2)
+    kw.setdefault("num_attention_heads", 4)
+    kw.setdefault("max_position_embeddings", 64)
+    return LlamaConfig(**kw)
+
+
+def _rope_cache(head_dim: int, max_len: int, theta: float):
+    inv = 1.0 / (theta ** (np.arange(0, head_dim, 2, dtype=np.float32)
+                           / head_dim))
+    t = np.arange(max_len, dtype=np.float32)
+    freqs = np.outer(t, inv)                      # [T, D/2]
+    return np.cos(freqs), np.sin(freqs)
+
+
+def _apply_rope(x, cos, sin):
+    """x [B, T, H, D]; rotate pairs (x0,x1) per RoPE."""
+    d2 = x.shape[-1] // 2
+    x1 = x[..., :d2]
+    x2 = x[..., d2:]
+    c = cos[None, :, None, :]
+    s = sin[None, :, None, :]
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+class LlamaAttention(Layer):
+    def __init__(self, cfg: LlamaConfig, use_tp: bool = False,
+                 rope_cache=None):
+        super().__init__()
+        self.cfg = cfg
+        H, KV, D = cfg.num_attention_heads, cfg.kv_heads, cfg.head_dim
+        if use_tp:
+            from ..distributed.fleet.mp_layers import (
+                ColumnParallelLinear, RowParallelLinear)
+            self.q_proj = ColumnParallelLinear(cfg.hidden_size, H * D,
+                                               gather_output=False,
+                                               has_bias=False)
+            self.k_proj = ColumnParallelLinear(cfg.hidden_size, KV * D,
+                                               gather_output=False,
+                                               has_bias=False)
+            self.v_proj = ColumnParallelLinear(cfg.hidden_size, KV * D,
+                                               gather_output=False,
+                                               has_bias=False)
+            self.o_proj = RowParallelLinear(H * D, cfg.hidden_size,
+                                            input_is_parallel=True,
+                                            has_bias=False)
+        else:
+            self.q_proj = Linear(cfg.hidden_size, H * D, bias_attr=False)
+            self.k_proj = Linear(cfg.hidden_size, KV * D,
+                                 bias_attr=False)
+            self.v_proj = Linear(cfg.hidden_size, KV * D,
+                                 bias_attr=False)
+            self.o_proj = Linear(H * D, cfg.hidden_size, bias_attr=False)
+        if rope_cache is None:  # standalone use; model shares one cache
+            cos, sin = _rope_cache(D, cfg.max_position_embeddings,
+                                   cfg.rope_theta)
+            rope_cache = (jnp.asarray(cos), jnp.asarray(sin))
+        self._cos, self._sin = rope_cache
+
+    def forward(self, x, attn_mask=None):
+        cfg = self.cfg
+        b, t, _ = x.shape
+        if t > cfg.max_position_embeddings:
+            raise ValueError(
+                f"sequence length {t} exceeds max_position_embeddings="
+                f"{cfg.max_position_embeddings}")
+        D = cfg.head_dim
+        q = self.q_proj(x)
+        k = self.k_proj(x)
+        v = self.v_proj(x)
+        h_local = q.shape[-1] // D
+        kv_local = k.shape[-1] // D
+        q = q.reshape([b, t, h_local, D])
+        k = k.reshape([b, t, kv_local, D])
+        v = v.reshape([b, t, kv_local, D])
+        cos, sin = self._cos[:t], self._sin[:t]
+        q = apply_op(lambda a: _apply_rope(a, cos, sin), q,
+                     _op_name="rope_q")
+        k = apply_op(lambda a: _apply_rope(a, cos, sin), k,
+                     _op_name="rope_k")
+        if kv_local != h_local:  # GQA: repeat kv heads
+            rep = h_local // kv_local
+            k = apply_op(lambda a: jnp.repeat(a, rep, axis=2), k,
+                         _op_name="gqa_repeat_k")
+            v = apply_op(lambda a: jnp.repeat(a, rep, axis=2), v,
+                         _op_name="gqa_repeat_v")
+        if attn_mask is not None:
+            # combine with causality: a decoder NEVER attends forward,
+            # mask or not (a padding mask must not disable the triangle)
+            causal = apply_op(
+                lambda m: jnp.logical_and(
+                    m.astype(bool),
+                    jnp.tril(jnp.ones((t, t), bool))[None, None]),
+                attn_mask, _op_name="causal_and_mask")
+            attn = F.scaled_dot_product_attention(
+                q, k, v, attn_mask=causal, training=self.training)
+        else:
+            attn = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, training=self.training)
+        attn = attn.reshape([b, t, h_local * D])
+        return self.o_proj(attn)
+
+
+class LlamaMLP(Layer):
+    def __init__(self, cfg: LlamaConfig, use_tp: bool = False):
+        super().__init__()
+        if use_tp:
+            from ..distributed.fleet.mp_layers import (
+                ColumnParallelLinear, RowParallelLinear)
+            self.gate_proj = ColumnParallelLinear(
+                cfg.hidden_size, cfg.intermediate_size,
+                gather_output=False, has_bias=False)
+            self.up_proj = ColumnParallelLinear(
+                cfg.hidden_size, cfg.intermediate_size,
+                gather_output=False, has_bias=False)
+            self.down_proj = RowParallelLinear(
+                cfg.intermediate_size, cfg.hidden_size,
+                input_is_parallel=True, has_bias=False)
+        else:
+            self.gate_proj = Linear(cfg.hidden_size,
+                                    cfg.intermediate_size,
+                                    bias_attr=False)
+            self.up_proj = Linear(cfg.hidden_size, cfg.intermediate_size,
+                                  bias_attr=False)
+            self.down_proj = Linear(cfg.intermediate_size,
+                                    cfg.hidden_size, bias_attr=False)
+
+    def forward(self, x):
+        return self.down_proj(F.silu(self.gate_proj(x)) *
+                              self.up_proj(x))
+
+
+class LlamaDecoderLayer(Layer):
+    def __init__(self, cfg: LlamaConfig, use_tp: bool = False,
+                 rope_cache=None):
+        super().__init__()
+        self.input_layernorm = RMSNorm(cfg.hidden_size,
+                                       epsilon=cfg.rms_norm_eps)
+        self.self_attn = LlamaAttention(cfg, use_tp, rope_cache)
+        self.post_attention_layernorm = RMSNorm(
+            cfg.hidden_size, epsilon=cfg.rms_norm_eps)
+        self.mlp = LlamaMLP(cfg, use_tp)
+
+    def forward(self, x, attn_mask=None):
+        x = x + self.self_attn(self.input_layernorm(x), attn_mask)
+        return x + self.mlp(self.post_attention_layernorm(x))
+
+
+class LlamaModel(Layer):
+    def __init__(self, cfg: LlamaConfig, use_tp: bool = False):
+        super().__init__()
+        self.config = cfg
+        if use_tp:
+            from ..distributed.fleet.mp_layers import (
+                VocabParallelEmbedding)
+            self.embed_tokens = VocabParallelEmbedding(cfg.vocab_size,
+                                                       cfg.hidden_size)
+        else:
+            self.embed_tokens = Embedding(cfg.vocab_size,
+                                          cfg.hidden_size)
+        cos, sin = _rope_cache(cfg.head_dim,
+                               cfg.max_position_embeddings,
+                               cfg.rope_theta)
+        rope_cache = (jnp.asarray(cos), jnp.asarray(sin))
+        self.layers = LayerList(
+            [LlamaDecoderLayer(cfg, use_tp, rope_cache)
+             for _ in range(cfg.num_hidden_layers)])
+        self.norm = RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
+
+    def forward(self, input_ids, attn_mask=None):
+        x = self.embed_tokens(input_ids)
+        for layer in self.layers:
+            x = layer(x, attn_mask)
+        return self.norm(x)
+
+
+class LlamaForCausalLM(Layer):
+    def __init__(self, cfg: LlamaConfig, use_tp: bool = False):
+        super().__init__()
+        self.config = cfg
+        self.llama = LlamaModel(cfg, use_tp)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size,
+                                  bias_attr=False)
+
+    def forward(self, input_ids, attn_mask=None):
+        h = self.llama(input_ids, attn_mask)
+        if self.config.tie_word_embeddings:
+            from ..ops.linalg import matmul
+            return matmul(h, self.llama.embed_tokens.weight,
+                          transpose_y=True)
+        return self.lm_head(h)
+
+    def loss(self, input_ids, labels):
+        logits = self(input_ids)
+        return F.cross_entropy(
+            logits.reshape([-1, self.config.vocab_size]),
+            labels.reshape([-1]))
+
+    def generate(self, input_ids, max_new_tokens: int = 16,
+                 temperature: float = 0.0, top_p: float = 1.0):
+        """Greedy / nucleus decoding (host loop; full-context forward
+        each step — KV-cached decoding is the serving engine's job)."""
+        import paddle_tpu as paddle
+        ids = input_ids
+        for _ in range(max_new_tokens):
+            logits = self(ids)
+            last = logits[:, -1]
+            if temperature <= 0:
+                nxt = apply_op(
+                    lambda a: jnp.argmax(a, axis=-1).astype(jnp.int64)[
+                        :, None], last, _op_name="greedy")
+            else:
+                probs = F.softmax(last / temperature, axis=-1)
+                ps = paddle.full([ids.shape[0]], top_p, dtype="float32")
+                _, nxt = paddle.top_p_sampling(probs, ps)
+            from ..ops.manipulation import concat
+            ids = concat([ids, nxt], axis=1)
+        return ids
