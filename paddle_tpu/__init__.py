@@ -51,6 +51,7 @@ from . import hub  # noqa: F401
 from . import regularizer  # noqa: F401
 from . import onnx  # noqa: F401
 from . import sysconfig  # noqa: F401
+from . import callbacks  # noqa: F401
 from .regularizer import L1Decay, L2Decay  # noqa: F401
 from .hapi.model import Model  # noqa: F401
 from .framework.io import save, load  # noqa: F401

@@ -1,0 +1,9 @@
+"""paddle.callbacks namespace (reference: python/paddle/callbacks.py
+re-exporting hapi.callbacks)."""
+from .hapi.callbacks import (Callback, EarlyStopping,  # noqa: F401
+                             LRScheduler, ModelCheckpoint, ProgBarLogger,
+                             ReduceLROnPlateau, VisualDL, WandbCallback)
+
+__all__ = ["Callback", "EarlyStopping", "LRScheduler", "ModelCheckpoint",
+           "ProgBarLogger", "ReduceLROnPlateau", "VisualDL",
+           "WandbCallback"]

@@ -8,7 +8,7 @@ from typing import List, Optional
 import numpy as np
 
 __all__ = ["Callback", "ProgBarLogger", "ModelCheckpoint", "LRScheduler",
-           "EarlyStopping", "CallbackList", "config_callbacks"]
+           "EarlyStopping", "CallbackList", "config_callbacks", "ReduceLROnPlateau", "VisualDL", "WandbCallback"]
 
 
 class Callback:
@@ -202,3 +202,126 @@ def config_callbacks(callbacks=None, model=None, epochs=None, steps=None,
     cbk_list.set_params({"epochs": epochs, "steps": steps,
                          "verbose": verbose, "metrics": metrics or []})
     return cbk_list
+
+
+class ReduceLROnPlateau(Callback):
+    """Reduce optimizer LR when a monitored metric plateaus
+    (hapi/callbacks.py ReduceLROnPlateau)."""
+
+    def __init__(self, monitor="loss", factor=0.1, patience=10,
+                 verbose=1, mode="auto", min_delta=1e-4, cooldown=0,
+                 min_lr=0.0):
+        super().__init__()
+        self.monitor = monitor
+        self.factor = factor
+        self.patience = patience
+        self.verbose = verbose
+        self.min_delta = min_delta
+        self.cooldown = cooldown
+        self.min_lr = min_lr
+        # auto rule matches EarlyStopping above: maximize only for
+        # accuracy-style monitors, minimize everything else
+        if mode == "auto":
+            self.mode = "max" if "acc" in monitor else "min"
+        else:
+            self.mode = mode
+        self._best = None
+        self._wait = 0
+        self._cooldown_left = 0
+
+    def _better(self, cur):
+        if self._best is None:
+            return True
+        if self.mode == "min":
+            return cur < self._best - self.min_delta
+        return cur > self._best + self.min_delta
+
+    def _observe(self, cur):
+        improved = self._better(cur)
+        if improved:
+            self._best = cur  # track best even through cooldown
+        if self._cooldown_left > 0:
+            self._cooldown_left -= 1
+            return
+        if improved:
+            self._wait = 0
+            return
+        self._wait += 1
+        if self._wait >= self.patience:
+            opt = getattr(self.model, "_optimizer", None)
+            if opt is not None:
+                new_lr = max(opt.get_lr() * self.factor, self.min_lr)
+                opt.set_lr(new_lr)
+                if self.verbose:
+                    print(f"ReduceLROnPlateau: lr -> {new_lr:.3e}")
+            self._wait = 0
+            self._cooldown_left = self.cooldown
+
+    def _metric_from(self, logs):
+        logs = logs or {}
+        cur = logs.get(self.monitor, logs.get(f"eval_{self.monitor}"))
+        if cur is None:
+            return None
+        return float(cur[0] if isinstance(cur, (list, tuple)) else cur)
+
+    def on_eval_end(self, logs=None):
+        cur = self._metric_from(logs)
+        if cur is not None:
+            self._observe(cur)
+
+    def on_epoch_end(self, epoch, logs=None):
+        # fit() merges eval metrics into epoch logs (eval_ prefix) and
+        # never fires eval events — same dispatch path EarlyStopping uses
+        cur = self._metric_from(logs)
+        if cur is not None:
+            self._observe(cur)
+
+
+class VisualDL(Callback):
+    """Scalar logger (hapi VisualDL callback). The visualdl package is
+    not bundled; scalars are appended as JSON lines under ``log_dir`` so
+    runs remain inspectable (and visualdl can ingest later)."""
+
+    def __init__(self, log_dir="./log"):
+        super().__init__()
+        self.log_dir = log_dir
+        self._step = {"train": 0, "eval": 0}
+
+    def _write(self, phase, logs):
+        import json
+        import os
+        os.makedirs(self.log_dir, exist_ok=True)
+        path = os.path.join(self.log_dir, f"{phase}.jsonl")
+        rec = {"step": self._step[phase]}
+        for k, v in (logs or {}).items():
+            try:
+                rec[k] = float(v[0] if isinstance(v, (list, tuple))
+                               else v)
+            except (TypeError, ValueError):
+                continue
+        with open(path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        self._step[phase] += 1
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._write("train", logs)
+
+    def on_eval_end(self, logs=None):
+        self._write("eval", logs)
+
+
+class WandbCallback(Callback):
+    """Weights & Biases logger: delegates when wandb is importable,
+    otherwise raises at construction (no silent no-op)."""
+
+    def __init__(self, project=None, **kwargs):
+        super().__init__()
+        try:
+            import wandb
+        except ImportError as e:
+            raise ImportError(
+                "WandbCallback requires the wandb package") from e
+        self._run = wandb.init(project=project, **kwargs)
+
+    def on_epoch_end(self, epoch, logs=None):
+        self._run.log(dict(logs or {}))

@@ -381,3 +381,47 @@ def test_distributed_extras_behaviors():
     batches = list(ds)
     assert len(batches) == 2
     _os.unlink(path)
+
+
+def test_callbacks_namespace_and_reduce_lr(tmp_path):
+    ref = "/root/reference/python/paddle/callbacks.py"
+    if os.path.exists(ref):
+        names = sorted(set(re.findall(r"'([A-Za-z_]+)'",
+                                      open(ref).read())))
+        missing = [n for n in names
+                   if not hasattr(paddle.callbacks, n)]
+        assert not missing, missing
+    # ReduceLROnPlateau drops the LR after `patience` flat evals
+    cb = paddle.callbacks.ReduceLROnPlateau(monitor="loss", factor=0.5,
+                                            patience=2, verbose=0)
+
+    class _M:
+        pass
+    m = _M()
+    lin = paddle.nn.Linear(2, 2)
+    m._optimizer = paddle.optimizer.SGD(learning_rate=1.0,
+                                        parameters=lin.parameters())
+    cb.model = m
+    for loss in (1.0, 1.0, 1.0):
+        cb.on_eval_end({"loss": loss})
+    assert m._optimizer.get_lr() == 0.5
+    # dispatched via epoch logs too (fit merges eval metrics there)
+    cb2 = paddle.callbacks.ReduceLROnPlateau(monitor="loss", factor=0.5,
+                                             patience=1, verbose=0)
+    m2 = _M()
+    lin2 = paddle.nn.Linear(2, 2)
+    m2._optimizer = paddle.optimizer.SGD(learning_rate=1.0,
+                                         parameters=lin2.parameters())
+    cb2.model = m2
+    cb2.on_epoch_end(0, {"eval_loss": 2.0})
+    cb2.on_epoch_end(1, {"eval_loss": 2.0})
+    assert m2._optimizer.get_lr() == 0.5
+    # auto mode minimizes non-acc metrics
+    assert paddle.callbacks.ReduceLROnPlateau(
+        monitor="mae", mode="auto").mode == "min"
+    # VisualDL writes jsonl scalars
+    v = paddle.callbacks.VisualDL(log_dir=str(tmp_path))
+    v.on_epoch_end(0, {"loss": 1.25})
+    import json
+    rec = json.loads(open(str(tmp_path / "train.jsonl")).read())
+    assert rec["loss"] == 1.25
