@@ -70,6 +70,7 @@ class CallbackList:
         self._call_all("set_params", params)
 
     def on_begin(self, mode, logs=None):
+        # Callback.on_begin itself routes to on_{mode}_begin
         self._call_all("on_begin", mode, logs)
 
     def on_end(self, mode, logs=None):
@@ -267,11 +268,16 @@ class ReduceLROnPlateau(Callback):
     def on_eval_end(self, logs=None):
         cur = self._metric_from(logs)
         if cur is not None:
+            self._saw_eval_event = True
             self._observe(cur)
 
     def on_epoch_end(self, epoch, logs=None):
-        # fit() merges eval metrics into epoch logs (eval_ prefix) and
-        # never fires eval events — same dispatch path EarlyStopping uses
+        # fallback path: standalone loops that only report merged epoch
+        # logs (eval_ prefix). Skipped when the eval event already fired
+        # this epoch, so one evaluation is never counted twice.
+        if getattr(self, "_saw_eval_event", False):
+            self._saw_eval_event = False
+            return
         cur = self._metric_from(logs)
         if cur is not None:
             self._observe(cur)

@@ -115,7 +115,9 @@ class Model:
                 if num_iters is not None and step + 1 >= num_iters:
                     break
             if eval_loader is not None and (epoch + 1) % eval_freq == 0:
+                cbks.on_begin("eval")
                 eval_logs = self.evaluate(eval_loader, verbose=0)
+                cbks.on_end("eval", eval_logs)
                 logs.update({f"eval_{k}": v for k, v in eval_logs.items()})
             cbks.on_epoch_end(epoch, logs)
             if save_dir and (epoch + 1) % save_freq == 0:
@@ -127,6 +129,14 @@ class Model:
     def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
                  num_workers=0, callbacks=None, num_iters=None):
         loader = _as_loader(eval_data, batch_size, False, False, num_workers)
+        cbks = None
+        if callbacks:
+            cbks = config_callbacks(callbacks, model=self, epochs=1,
+                                    steps=_safe_len(loader),
+                                    log_freq=log_freq, verbose=verbose,
+                                    metrics=["loss"]
+                                    + self._metrics_names())
+            cbks.on_begin("eval")
         for m in self._metrics:
             m.reset()
         total_loss, n = 0.0, 0
@@ -143,6 +153,8 @@ class Model:
             logs["loss"] = total_loss / n
         for m in self._metrics:
             logs[_name_of(m)] = m.accumulate()
+        if cbks is not None:
+            cbks.on_end("eval", logs)
         return logs
 
     def predict(self, test_data, batch_size=1, num_workers=0,

@@ -425,3 +425,35 @@ def test_callbacks_namespace_and_reduce_lr(tmp_path):
     import json
     rec = json.loads(open(str(tmp_path / "train.jsonl")).read())
     assert rec["loss"] == 1.25
+
+
+def test_model_fit_dispatches_eval_events():
+    """fit/evaluate fire on_eval_begin/on_eval_end (reference hapi
+    contract); one evaluation is observed exactly once by
+    ReduceLROnPlateau despite the epoch-log fallback path."""
+    from paddle_tpu.io import TensorDataset
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 1))
+    model = paddle.Model(net)
+    opt = paddle.optimizer.SGD(learning_rate=1.0,
+                               parameters=net.parameters())
+    model.prepare(opt, paddle.nn.MSELoss())
+    rng = np.random.RandomState(0)
+    ds = TensorDataset([paddle.to_tensor(rng.randn(8, 4).astype("f4")),
+                        paddle.to_tensor(np.full((8, 1), 1e6, "f4"))])
+    events = []
+
+    class Spy(paddle.callbacks.Callback):
+        def on_eval_end(self, logs=None):
+            events.append(dict(logs or {}))
+
+    observed = []
+    cb = paddle.callbacks.ReduceLROnPlateau(monitor="loss", patience=100)
+    orig = cb._observe
+    cb._observe = lambda cur: (observed.append(cur), orig(cur))
+    model.fit(ds, eval_data=ds, epochs=2, batch_size=8, verbose=0,
+              callbacks=[Spy(), cb])
+    assert len(events) == 2       # one eval event per epoch
+    assert len(observed) == 2     # no double counting
+    model.evaluate(ds, batch_size=8, verbose=0, callbacks=[Spy()])
+    assert len(events) == 3       # evaluate() honors its callbacks
