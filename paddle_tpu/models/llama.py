@@ -110,13 +110,17 @@ class LlamaAttention(Layer):
             rope_cache = (jnp.asarray(cos), jnp.asarray(sin))
         self._cos, self._sin = rope_cache
 
-    def forward(self, x, attn_mask=None):
+    def forward(self, x, attn_mask=None, cache=None):
+        """cache: optional (k_cache, v_cache) Tensors [B, T_past, KV, D];
+        when given, ``x`` holds only the NEW tokens and the return is
+        (out, (k_cache', v_cache')) — the serving decode path."""
         cfg = self.cfg
         b, t, _ = x.shape
-        if t > cfg.max_position_embeddings:
+        past = cache[0].shape[1] if cache is not None else 0
+        if past + t > cfg.max_position_embeddings:
             raise ValueError(
-                f"sequence length {t} exceeds max_position_embeddings="
-                f"{cfg.max_position_embeddings}")
+                f"sequence length {past + t} exceeds "
+                f"max_position_embeddings={cfg.max_position_embeddings}")
         D = cfg.head_dim
         q = self.q_proj(x)
         k = self.k_proj(x)
@@ -126,17 +130,30 @@ class LlamaAttention(Layer):
         q = q.reshape([b, t, h_local, D])
         k = k.reshape([b, t, kv_local, D])
         v = v.reshape([b, t, kv_local, D])
-        cos, sin = self._cos[:t], self._sin[:t]
+        cos, sin = self._cos[past:past + t], self._sin[past:past + t]
         q = apply_op(lambda a: _apply_rope(a, cos, sin), q,
                      _op_name="rope_q")
         k = apply_op(lambda a: _apply_rope(a, cos, sin), k,
                      _op_name="rope_k")
+        if cache is not None:
+            from ..ops.manipulation import concat
+            k = concat([cache[0], k], axis=1)
+            v = concat([cache[1], v], axis=1)
+            new_cache = (k, v)
         if kv_local != h_local:  # GQA: repeat kv heads
             rep = h_local // kv_local
             k = apply_op(lambda a: jnp.repeat(a, rep, axis=2), k,
                          _op_name="gqa_repeat_k")
             v = apply_op(lambda a: jnp.repeat(a, rep, axis=2), v,
                          _op_name="gqa_repeat_v")
+        if cache is not None:
+            # decoding: new queries may attend all cached positions plus
+            # the causal prefix of the new block (sdpa aligns the
+            # triangle to the last rows when Sq < Skv)
+            attn = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, training=self.training)
+            attn = attn.reshape([b, t, h_local * D])
+            return self.o_proj(attn), new_cache
         if attn_mask is not None:
             # combine with causality: a decoder NEVER attends forward,
             # mask or not (a padding mask must not disable the triangle)
@@ -194,7 +211,13 @@ class LlamaDecoderLayer(Layer):
             cfg.hidden_size, epsilon=cfg.rms_norm_eps)
         self.mlp = LlamaMLP(cfg, use_tp)
 
-    def forward(self, x, attn_mask=None):
+    def forward(self, x, attn_mask=None, cache=None):
+        if cache is not None:
+            a, new_cache = self.self_attn(self.input_layernorm(x),
+                                          attn_mask, cache)
+            x = x + a
+            return x + self.mlp(self.post_attention_layernorm(x)), \
+                new_cache
         x = x + self.self_attn(self.input_layernorm(x), attn_mask)
         return x + self.mlp(self.post_attention_layernorm(x))
 
@@ -220,8 +243,14 @@ class LlamaModel(Layer):
              for _ in range(cfg.num_hidden_layers)])
         self.norm = RMSNorm(cfg.hidden_size, epsilon=cfg.rms_norm_eps)
 
-    def forward(self, input_ids, attn_mask=None):
+    def forward(self, input_ids, attn_mask=None, caches=None):
         x = self.embed_tokens(input_ids)
+        if caches is not None:
+            new_caches = []
+            for layer, c in zip(self.layers, caches):
+                x, nc = layer(x, attn_mask, c)
+                new_caches.append(nc)
+            return self.norm(x), new_caches
         for layer in self.layers:
             x = layer(x, attn_mask)
         return self.norm(x)
@@ -237,12 +266,7 @@ class LlamaForCausalLM(Layer):
                                   bias_attr=False)
 
     def forward(self, input_ids, attn_mask=None):
-        h = self.llama(input_ids, attn_mask)
-        if self.config.tie_word_embeddings:
-            from ..ops.linalg import matmul
-            return matmul(h, self.llama.embed_tokens.weight,
-                          transpose_y=True)
-        return self.lm_head(h)
+        return self._head(self.llama(input_ids, attn_mask))
 
     def loss(self, input_ids, labels):
         logits = self(input_ids)
@@ -250,23 +274,56 @@ class LlamaForCausalLM(Layer):
             logits.reshape([-1, self.config.vocab_size]),
             labels.reshape([-1]))
 
+    def _head(self, h):
+        if self.config.tie_word_embeddings:
+            from ..ops.linalg import matmul
+            return matmul(h, self.llama.embed_tokens.weight,
+                          transpose_y=True)
+        return self.lm_head(h)
+
     def generate(self, input_ids, max_new_tokens: int = 16,
-                 temperature: float = 0.0, top_p: float = 1.0):
-        """Greedy / nucleus decoding (host loop; full-context forward
-        each step — KV-cached decoding is the serving engine's job)."""
+                 temperature: float = 0.0, top_p: float = 1.0,
+                 use_cache: bool = True):
+        """Greedy / nucleus decoding. With ``use_cache`` (default) each
+        step attends cached K/V and computes only the new token —
+        O(T) per step instead of re-running the full context."""
         import paddle_tpu as paddle
+        from ..ops.manipulation import concat
         ids = input_ids
-        for _ in range(max_new_tokens):
-            logits = self(ids)
-            last = logits[:, -1]
+
+        def pick(last):
             if temperature <= 0:
-                nxt = apply_op(
+                return apply_op(
                     lambda a: jnp.argmax(a, axis=-1).astype(jnp.int64)[
                         :, None], last, _op_name="greedy")
-            else:
-                probs = F.softmax(last / temperature, axis=-1)
-                ps = paddle.full([ids.shape[0]], top_p, dtype="float32")
-                _, nxt = paddle.top_p_sampling(probs, ps)
-            from ..ops.manipulation import concat
+            probs = F.softmax(last / temperature, axis=-1)
+            ps = paddle.full([ids.shape[0]], top_p, dtype="float32")
+            return paddle.top_p_sampling(probs, ps)[1]
+
+        if not use_cache:
+            for _ in range(max_new_tokens):
+                nxt = pick(self(ids)[:, -1])
+                ids = concat([ids, nxt], axis=1)
+            return ids
+
+        # prefill: run the prompt once, keep per-layer caches
+        caches = [None] * len(self.llama.layers)
+        x = self.llama.embed_tokens(ids)
+        new_caches = []
+        for layer in self.llama.layers:
+            b, t, _ = x.shape
+            empty = (paddle.zeros(
+                [b, 0, self.config.kv_heads, self.config.head_dim]),
+                paddle.zeros(
+                [b, 0, self.config.kv_heads, self.config.head_dim]))
+            x, nc = layer(x, None, empty)
+            new_caches.append(nc)
+        caches = new_caches
+        h = self.llama.norm(x)
+        nxt = pick(self._head(h[:, -1:])[:, -1])
+        ids = concat([ids, nxt], axis=1)
+        for _ in range(max_new_tokens - 1):
+            h, caches = self.llama(nxt, caches=caches)
+            nxt = pick(self._head(h[:, -1:])[:, -1])
             ids = concat([ids, nxt], axis=1)
         return ids

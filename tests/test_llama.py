@@ -121,3 +121,14 @@ def test_llama_padding_mask_stays_causal():
                                rtol=1e-5, atol=1e-6)
     with pytest.raises(ValueError):
         model(paddle.to_tensor(np.zeros((1, 70), "int64")))
+
+
+def test_llama_kv_cache_decode_matches_full():
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny_config())
+    model.eval()
+    ids = paddle.to_tensor(np.array([[5, 6, 7, 8]], "int64"))
+    cached = model.generate(ids, max_new_tokens=6, use_cache=True)
+    full = model.generate(ids, max_new_tokens=6, use_cache=False)
+    np.testing.assert_array_equal(cached.numpy(), full.numpy())
+    assert cached.shape == [1, 10]
