@@ -116,7 +116,8 @@ class LlamaAttention(Layer):
         (out, (k_cache', v_cache')) — the serving decode path."""
         cfg = self.cfg
         b, t, _ = x.shape
-        past = cache[0].shape[1] if cache is not None else 0
+        past = cache[0].shape[1] if cache is not None \
+            and cache[0] is not None else 0
         if past + t > cfg.max_position_embeddings:
             raise ValueError(
                 f"sequence length {past + t} exceeds "
@@ -136,9 +137,10 @@ class LlamaAttention(Layer):
         k = apply_op(lambda a: _apply_rope(a, cos, sin), k,
                      _op_name="rope_k")
         if cache is not None:
-            from ..ops.manipulation import concat
-            k = concat([cache[0], k], axis=1)
-            v = concat([cache[1], v], axis=1)
+            if cache[0] is not None:  # (None, None) = empty prefill cache
+                from ..ops.manipulation import concat
+                k = concat([cache[0], k], axis=1)
+                v = concat([cache[1], v], axis=1)
             new_cache = (k, v)
         if kv_local != h_local:  # GQA: repeat kv heads
             rep = h_local // kv_local
@@ -147,9 +149,13 @@ class LlamaAttention(Layer):
             v = apply_op(lambda a: jnp.repeat(a, rep, axis=2), v,
                          _op_name="gqa_repeat_v")
         if cache is not None:
-            # decoding: new queries may attend all cached positions plus
-            # the causal prefix of the new block (sdpa aligns the
-            # triangle to the last rows when Sq < Skv)
+            if attn_mask is not None:
+                raise NotImplementedError(
+                    "attn_mask with KV cache is not supported; pad-free "
+                    "batches only in cached decoding")
+            # decoding: new queries attend all cached positions plus the
+            # causal prefix of the new block (the XLA sdpa bottom-right-
+            # aligns the triangle when Sq < Skv)
             attn = F.scaled_dot_product_attention(
                 q, k, v, is_causal=True, training=self.training)
             attn = attn.reshape([b, t, h_local * D])
@@ -300,26 +306,19 @@ class LlamaForCausalLM(Layer):
             ps = paddle.full([ids.shape[0]], top_p, dtype="float32")
             return paddle.top_p_sampling(probs, ps)[1]
 
+        if max_new_tokens <= 0:
+            return ids
         if not use_cache:
             for _ in range(max_new_tokens):
                 nxt = pick(self(ids)[:, -1])
                 ids = concat([ids, nxt], axis=1)
             return ids
 
-        # prefill: run the prompt once, keep per-layer caches
-        caches = [None] * len(self.llama.layers)
-        x = self.llama.embed_tokens(ids)
-        new_caches = []
-        for layer in self.llama.layers:
-            b, t, _ = x.shape
-            empty = (paddle.zeros(
-                [b, 0, self.config.kv_heads, self.config.head_dim]),
-                paddle.zeros(
-                [b, 0, self.config.kv_heads, self.config.head_dim]))
-            x, nc = layer(x, None, empty)
-            new_caches.append(nc)
-        caches = new_caches
-        h = self.llama.norm(x)
+        # prefill through the model's own cache path: (None, None) makes
+        # each layer seed its cache with ITS local k/v (correct head
+        # count and dtype under tensor parallelism too)
+        h, caches = self.llama(
+            ids, caches=[(None, None)] * len(self.llama.layers))
         nxt = pick(self._head(h[:, -1:])[:, -1])
         ids = concat([ids, nxt], axis=1)
         for _ in range(max_new_tokens - 1):

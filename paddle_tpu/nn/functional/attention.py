@@ -59,7 +59,7 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
     rkey = rnd.op_key(query, key, value) if drop > 0.0 else None
 
     use_pallas = (attn_mask is None and drop == 0.0 and
-                  _pallas_eligible(query))
+                  _pallas_eligible(query, key))
     if use_pallas:
         from ...ops.pallas_ops import flash_attention_fwd
         return apply_op(
@@ -85,14 +85,17 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
         query, key, value, _op_name="sdpa")
 
 
-def _pallas_eligible(q) -> bool:
+def _pallas_eligible(q, k) -> bool:
     try:
         import jax
         if jax.default_backend() not in ("tpu", "axon"):
             return False
         d = q.shape[-1]
         s = q.shape[1]
-        return d in (64, 128, 256) and s % 128 == 0
+        # the kernel assumes square self-attention (Sq == Skv); cached
+        # decode with Sq < Skv must take the XLA path
+        return (d in (64, 128, 256) and s % 128 == 0
+                and k.shape[1] == s)
     except Exception:
         return False
 

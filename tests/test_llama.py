@@ -132,3 +132,26 @@ def test_llama_kv_cache_decode_matches_full():
     full = model.generate(ids, max_new_tokens=6, use_cache=False)
     np.testing.assert_array_equal(cached.numpy(), full.numpy())
     assert cached.shape == [1, 10]
+
+
+def test_llama_generate_edge_cases():
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny_config())
+    model.eval()
+    ids = paddle.to_tensor(np.array([[1, 2]], "int64"))
+    for uc in (True, False):
+        out = model.generate(ids, max_new_tokens=0, use_cache=uc)
+        assert out.shape == [1, 2], uc
+    # TP cached decode seeds caches with local head counts
+    import paddle_tpu.distributed as dist
+    mesh = dist.ProcessMesh(np.arange(8).reshape(2, 4).tolist(),
+                            dim_names=["dp", "mp"])
+    dist.set_mesh(mesh)
+    try:
+        paddle.seed(0)
+        tp = LlamaForCausalLM(llama_tiny_config(), use_tp=True)
+        tp.eval()
+        out = tp.generate(ids, max_new_tokens=3, use_cache=True)
+        assert out.shape == [1, 5]
+    finally:
+        dist.set_mesh(None)
