@@ -1,22 +1,35 @@
 """Numerical debugging (reference: python/paddle/amp/debugging.py —
-check_numerics, tensor stats; plus FLAGS_check_nan_inf hooks in
-fluid/eager/nan_inf_utils.cc which here live in framework.tensor.apply_op)."""
+check_numerics, operator stats, compare_accuracy; plus
+FLAGS_check_nan_inf hooks in fluid/eager/nan_inf_utils.cc which here live
+in framework.tensor.apply_op, and the in-graph accuracy_check kernel
+phi/kernels/accuracy_check_kernel.h / ops.yaml:31)."""
 from __future__ import annotations
 
 import contextlib
+import csv
+import json
+import os
+from collections import defaultdict
 from enum import Enum
-from typing import List, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
+from ..framework import tensor as _tensor_mod
 from ..framework.flags import set_flags
 from ..framework.tensor import Tensor
 
-__all__ = ["enable_operator_stats_collection", "check_numerics",
-           "enable_tensor_checker", "disable_tensor_checker",
-           "collect_operator_numerical_stats", "DebugMode",
-           "TensorCheckerConfig"]
+__all__ = [
+    "DebugMode", "TensorCheckerConfig", "check_numerics",
+    "enable_operator_stats_collection",
+    "disable_operator_stats_collection", "collect_operator_stats",
+    "enable_tensor_checker", "disable_tensor_checker",
+    "compare_accuracy", "check_layer_numerics",
+    "set_checked_op_list", "set_skipped_op_list",
+    "collect_operator_numerical_stats", "accuracy_check",
+    "save_tensor_stats",
+]
 
 
 class DebugMode(Enum):
@@ -25,23 +38,55 @@ class DebugMode(Enum):
     CHECK_ALL = 2
 
 
+_checked_ops: Optional[set] = None
+_skipped_ops: set = set()
+
+
+def set_checked_op_list(checked_op_list: Sequence[str] | None) -> None:
+    global _checked_ops
+    _checked_ops = set(checked_op_list) if checked_op_list else None
+
+
+def set_skipped_op_list(skipped_op_list: Sequence[str] | None) -> None:
+    global _skipped_ops
+    _skipped_ops = set(skipped_op_list) if skipped_op_list else set()
+
+
 class TensorCheckerConfig:
-    def __init__(self, enable=True, debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
+    def __init__(self, enable=True,
+                 debug_mode=DebugMode.CHECK_NAN_INF_AND_ABORT,
                  output_dir=None, checked_op_list=None,
                  skipped_op_list=None):
         self.enable = enable
         self.debug_mode = debug_mode
+        self.output_dir = output_dir
+        self.checked_op_list = checked_op_list
+        self.skipped_op_list = skipped_op_list
+
+
+_checker_set_lists = False
 
 
 def enable_tensor_checker(config: TensorCheckerConfig):
+    global _checker_set_lists
     set_flags({"FLAGS_check_nan_inf": config.enable})
     set_flags({"FLAGS_check_nan_inf_level":
                0 if config.debug_mode == DebugMode.CHECK_NAN_INF_AND_ABORT
                else 1})
+    if config.checked_op_list is not None or \
+            config.skipped_op_list is not None:
+        set_checked_op_list(config.checked_op_list)
+        set_skipped_op_list(config.skipped_op_list)
+        _checker_set_lists = True
 
 
 def disable_tensor_checker():
+    global _checker_set_lists
     set_flags({"FLAGS_check_nan_inf": False})
+    if _checker_set_lists:  # don't wipe lists set independently
+        set_checked_op_list(None)
+        set_skipped_op_list(None)
+        _checker_set_lists = False
 
 
 def check_numerics(tensor: Tensor, op_type: str = "", var_name: str = "",
@@ -61,10 +106,94 @@ def check_numerics(tensor: Tensor, op_type: str = "", var_name: str = "",
             Tensor(jnp.asarray(n_zero)))
 
 
+def check_layer_numerics(func):
+    """Decorator for Layer.forward: checks inputs/outputs for NaN/Inf
+    (reference debugging.py:78). Tracer values (under jit/vjp tracing)
+    pass through unchecked, like the apply_op-level _check_finite."""
+    import functools
+    import jax as _jax
+
+    def _bad(a):
+        return (not isinstance(a, _jax.core.Tracer)
+                and jnp.issubdtype(a.dtype, jnp.floating)
+                and not bool(jnp.isfinite(a).all()))
+
+    @functools.wraps(func)
+    def wrapper(self, *args, **kwargs):
+        for name, a in list(enumerate(args)) + list(kwargs.items()):
+            if isinstance(a, Tensor) and _bad(a._data):
+                raise FloatingPointError(
+                    f"NaN/Inf in input {name} of "
+                    f"{type(self).__name__}.forward")
+        out = func(self, *args, **kwargs)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        for o in outs:
+            if isinstance(o, Tensor) and _bad(o._data):
+                raise FloatingPointError(
+                    f"NaN/Inf in output of {type(self).__name__}.forward")
+        return out
+    return wrapper
+
+
+# ---------------------------------------------------------------------------
+# operator stats collection (reference debugging.py:481 — per-op call
+# counts split by output dtype, printed as a table)
+# ---------------------------------------------------------------------------
+
+_op_stats: Optional[dict] = None
+
+
+def op_filtered(name: str) -> bool:
+    """True when the checked/skipped op lists exclude this op (shared by
+    operator-stats collection and the apply_op NaN/Inf checker)."""
+    if _checked_ops is not None and name not in _checked_ops:
+        return True
+    return name in _skipped_ops
+
+
+def _observe(name, tensors):
+    if _op_stats is None or op_filtered(name) or not tensors:
+        return
+    # one count per op CALL (not per output); classify by the first
+    # output's dtype — the op's compute dtype under AMP
+    dt = getattr(tensors[0]._data.dtype, "name",
+                 str(tensors[0]._data.dtype))
+    idx = {"float16": 0, "bfloat16": 1, "float32": 2}.get(dt, 3)
+    _op_stats[name][idx] += 1
+
+
+def enable_operator_stats_collection() -> None:
+    global _op_stats
+    _op_stats = defaultdict(lambda: [0, 0, 0, 0])
+    _tensor_mod._op_observer = _observe
+
+
+def disable_operator_stats_collection() -> None:
+    global _op_stats
+    _tensor_mod._op_observer = None
+    stats, _op_stats = _op_stats, None
+    if stats:
+        _print_operator_stats(stats)
+
+
+def _print_operator_stats(stats) -> None:
+    print("<{:-^120}>".format(" op list "))
+    head = "{:<40} | {:<17} | {:<17} | {:<17} | {:<17}".format(
+        "OP Type", "FP16 Calls", "BF16 Calls", "FP32 Calls", "Other Calls")
+    print(head)
+    for op, (f16, bf16, f32, other) in sorted(stats.items()):
+        print("{:<40} | {:<17} | {:<17} | {:<17} | {:<17}".format(
+            op, f16, bf16, f32, other))
+    print("<{:-^120}>".format(" op count: " + str(len(stats)) + " "))
+
+
 @contextlib.contextmanager
-def enable_operator_stats_collection():
-    stats: List[Tuple[str, str]] = []
-    yield stats
+def collect_operator_stats():
+    enable_operator_stats_collection()
+    try:
+        yield
+    finally:
+        disable_operator_stats_collection()
 
 
 def collect_operator_numerical_stats(tensor: Tensor):
@@ -73,3 +202,88 @@ def collect_operator_numerical_stats(tensor: Tensor):
             "mean": float(a.mean()),
             "num_nan": int(np.isnan(a).sum()),
             "num_inf": int(np.isinf(a).sum())}
+
+
+# ---------------------------------------------------------------------------
+# accuracy comparison tooling
+# ---------------------------------------------------------------------------
+
+def accuracy_check(x, y, fn_name: str = "", rtol: float = 1e-5,
+                   atol: float = 1e-8, equal_nan: bool = False):
+    """In-graph tensor comparison (phi accuracy_check kernel,
+    ops.yaml:31): returns a scalar bool Tensor; raises in eager mode when
+    the tensors differ so acc-align runs fail loudly."""
+    xa = x._data if isinstance(x, Tensor) else jnp.asarray(x)
+    ya = y._data if isinstance(y, Tensor) else jnp.asarray(y)
+    ok = jnp.allclose(xa.astype(jnp.float32), ya.astype(jnp.float32),
+                      rtol=rtol, atol=atol, equal_nan=equal_nan)
+    import jax
+    if not isinstance(ok, jax.core.Tracer) and not bool(ok):
+        diff = float(jnp.abs(xa.astype(jnp.float32)
+                             - ya.astype(jnp.float32)).max())
+        raise AssertionError(
+            f"accuracy_check failed for {fn_name!r}: max |diff|={diff:g} "
+            f"(rtol={rtol}, atol={atol})")
+    return Tensor(ok)
+
+
+def save_tensor_stats(path: str, tag: str, tensors: dict) -> None:
+    """Dump per-tensor numerical stats as jsonl for compare_accuracy."""
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, f"{tag}.jsonl"), "w") as f:
+        for name, t in tensors.items():
+            rec = {"name": name}
+            rec.update(collect_operator_numerical_stats(
+                t if isinstance(t, Tensor) else Tensor(jnp.asarray(t))))
+            f.write(json.dumps(rec) + "\n")
+
+
+def compare_accuracy(dump_path: str, another_dump_path: str,
+                     output_filename: str = "compare.csv",
+                     loss_scale: float = 1.0,
+                     dump_all_tensors: bool = False) -> List[dict]:
+    """Compare two run dumps written by save_tensor_stats (reference
+    debugging.py compare_accuracy reads workerlog dumps and writes an
+    excel sheet; here jsonl in → csv out). Returns the row dicts;
+    dump_all_tensors additionally includes both runs' raw per-tensor
+    stats (min/max/mean/nan/inf) in each row."""
+    def load(path):
+        recs = {}
+        for fn in sorted(os.listdir(path)):
+            if not fn.endswith(".jsonl"):
+                continue
+            with open(os.path.join(path, fn)) as f:
+                for line in f:
+                    r = json.loads(line)
+                    recs[r["name"]] = r
+        return recs
+
+    a, b = load(dump_path), load(another_dump_path)
+    rows = []
+    stat_keys = ("min", "max", "mean", "num_nan", "num_inf")
+    for name in sorted(set(a) | set(b)):
+        ra, rb = a.get(name), b.get(name)
+        row = {"name": name,
+               "in_both": ra is not None and rb is not None}
+        if ra and rb:
+            row["mean_diff"] = abs(ra["mean"] - rb["mean"]) / loss_scale
+            row["max_diff"] = abs(ra["max"] - rb["max"]) / loss_scale
+            row["nan_mismatch"] = ra["num_nan"] != rb["num_nan"]
+            row["inf_mismatch"] = ra["num_inf"] != rb["num_inf"]
+        if dump_all_tensors:
+            for tag, rec in (("a", ra), ("b", rb)):
+                for kk in stat_keys:
+                    row[f"{tag}_{kk}"] = rec.get(kk, "") if rec else ""
+        rows.append(row)
+    if output_filename:
+        fields = ["name", "in_both", "mean_diff", "max_diff",
+                  "nan_mismatch", "inf_mismatch"]
+        if dump_all_tensors:
+            fields += [f"{tag}_{kk}" for tag in ("a", "b")
+                       for kk in stat_keys]
+        with open(output_filename, "w", newline="") as f:
+            w = csv.DictWriter(f, fieldnames=fields)
+            w.writeheader()
+            for r in rows:
+                w.writerow({k: r.get(k, "") for k in fields})
+    return rows

@@ -89,9 +89,14 @@ def _place_of_array(arr) -> Place:
 
 def set_device(device: str) -> Place:
     """paddle.set_device analog. Accepts 'tpu', 'cpu', 'tpu:0', also 'gpu'
-    (mapped to the available accelerator)."""
+    (mapped to the available accelerator) and registered custom device
+    types (device/custom.py registry)."""
     global _current_device
     name, _, idx = device.partition(":")
+    from . import custom as _custom
+    if name in _custom._REGISTRY:
+        _current_device = device
+        return Place(name, int(idx) if idx else 0)
     name = _canonical(name)
     _current_device = device
     return Place(name, int(idx) if idx else 0)
@@ -104,7 +109,8 @@ def get_device() -> str:
 
 
 def get_all_custom_device_type() -> List[str]:
-    return []
+    from . import custom as _custom
+    return _custom.get_all_custom_device_type()
 
 
 def is_compiled_with_cuda() -> bool:
@@ -220,7 +226,10 @@ def get_available_device() -> List[str]:
 
 
 def get_available_custom_device() -> List[str]:
-    return []
+    from . import custom as _custom
+    return [f"{name}:{i}"
+            for name in _custom.get_all_custom_device_type()
+            for i in range(_custom.get_custom_device(name).device_count())]
 
 
 def get_cudnn_version():
@@ -244,7 +253,8 @@ def is_compiled_with_ipu() -> bool:
 
 
 def is_compiled_with_custom_device(device_type: str) -> bool:
-    return False
+    from . import custom as _custom
+    return device_type in _custom._REGISTRY
 
 
 def is_compiled_with_distribute() -> bool:
@@ -262,3 +272,5 @@ class _DeviceNS:
 gpu = _DeviceNS()
 xpu = _DeviceNS()
 npu = _DeviceNS()
+
+from . import custom  # noqa: E402,F401

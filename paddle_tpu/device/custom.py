@@ -1,0 +1,123 @@
+"""Custom-device plugin interface — the device_ext.h / capi analog.
+
+Reference: ``paddle/phi/backends/device_ext.h`` (C ABI
+``C_DeviceInterface`` for out-of-tree "CustomDevice" plugins),
+``paddle/phi/backends/device_manager.h:134`` (DeviceManager registry),
+``paddle/phi/capi`` (kernel-registration C ABI), and the in-tree fake
+device used by tests (``paddle/phi/backends/custom/fake_cpu_device.h``,
+``test/custom_runtime/test_custom_cpu_plugin.py``).
+
+TPU-native rethink: out-of-tree hardware reaches JAX as a **PJRT
+plugin** — XLA owns kernels, streams, and memory, so the reference's
+per-kernel C ABI disappears. What remains meaningful, and is provided
+here, is the *registry* contract: a named device type with lifecycle
+hooks (init/sync/memory stats) that ``paddle.device.set_device`` can
+target, a PJRT-plugin loader for real out-of-tree backends, and a
+``FakeCPUDevice`` so plugin plumbing is exercised hardware-free exactly
+like the reference's fake_cpu_device tests.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["DeviceInterface", "CustomDevice", "register_custom_device",
+           "unregister_custom_device", "get_all_custom_device_type",
+           "get_custom_device", "load_pjrt_plugin", "FakeCPUDevice"]
+
+
+@dataclass
+class DeviceInterface:
+    """Lifecycle hooks a plugin may provide (C_DeviceInterface mirror —
+    the subset that is not owned by XLA/PJRT on TPU-style backends)."""
+    visible_device_count: Callable[[], int] = lambda: 1
+    initialize: Callable[[], None] = lambda: None
+    finalize: Callable[[], None] = lambda: None
+    synchronize_device: Callable[[int], None] = lambda i: None
+    memory_stats: Callable[[int], dict] = lambda i: {}
+
+
+@dataclass
+class CustomDevice:
+    name: str                      # device type string, e.g. "my_npu"
+    interface: DeviceInterface
+    jax_platform: Optional[str] = None   # PJRT platform it maps to
+    initialized: bool = field(default=False, init=False)
+
+    def device_count(self) -> int:
+        return self.interface.visible_device_count()
+
+    def init(self):
+        if not self.initialized:
+            self.interface.initialize()
+            self.initialized = True
+
+    def synchronize(self, device_id: int = 0):
+        self.interface.synchronize_device(device_id)
+
+
+_REGISTRY: Dict[str, CustomDevice] = {}
+
+
+def register_custom_device(name: str,
+                           interface: Optional[DeviceInterface] = None,
+                           jax_platform: Optional[str] = None
+                           ) -> CustomDevice:
+    """Register a custom device type (DeviceManager::Register analog).
+
+    After registration ``paddle.device.set_device(f"{name}:0")`` resolves
+    through this registry; compute runs on ``jax_platform`` when given
+    (a loaded PJRT plugin), else on the current default backend.
+    """
+    if name in _REGISTRY:
+        raise ValueError(f"custom device {name!r} already registered")
+    dev = CustomDevice(name, interface or DeviceInterface(), jax_platform)
+    _REGISTRY[name] = dev
+    dev.init()
+    return dev
+
+
+def unregister_custom_device(name: str) -> None:
+    dev = _REGISTRY.pop(name, None)
+    if dev is not None and dev.initialized:
+        dev.interface.finalize()
+
+
+def get_all_custom_device_type() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def get_custom_device(name: str) -> CustomDevice:
+    return _REGISTRY[name]
+
+
+def load_pjrt_plugin(name: str, library_path: str,
+                     register: bool = True) -> Optional[CustomDevice]:
+    """Load an out-of-tree PJRT plugin .so and expose it as a custom
+    device type (the reference loads C_DeviceInterface plugins from
+    CUSTOM_DEVICE_ROOT at import; JAX's equivalent is a PJRT C-API
+    plugin). With register=False only the PJRT platform is loaded and
+    None is returned; call register_custom_device(name) separately."""
+    import jax._src.xla_bridge as xb
+    xb.register_plugin(name, library_path=library_path)
+    if register:
+        return register_custom_device(name, jax_platform=name)
+    return None
+
+
+class FakeCPUDevice(CustomDevice):
+    """In-tree fake device (fake_cpu_device.h analog): backs a custom
+    device type with the host CPU so plugin/device-manager plumbing and
+    collective bootstrap can be tested without special hardware."""
+
+    def __init__(self, name: str = "fake_cpu", num_devices: int = 1):
+        calls = self.calls = []
+        iface = DeviceInterface(
+            visible_device_count=lambda: num_devices,
+            initialize=lambda: calls.append("init"),
+            finalize=lambda: calls.append("finalize"),
+            synchronize_device=lambda i: calls.append(f"sync:{i}"),
+            memory_stats=lambda i: {"bytes_in_use": 0,
+                                    "peak_bytes_in_use": 0},
+        )
+        super().__init__(name, iface, jax_platform="cpu")

@@ -127,6 +127,11 @@ class GradNode:
 
 
 def _check_finite(name, arrays):
+    # honor amp.debugging op filters (only consulted on this slow path,
+    # which is gated on FLAGS_check_nan_inf)
+    from ..amp import debugging as _dbg
+    if _dbg.op_filtered(name):
+        return
     for a in arrays:
         if isinstance(a, jax.core.Tracer):
             return
@@ -142,6 +147,11 @@ def _check_finite(name, arrays):
 # here so lazy inputs divert the dispatch into the current Program.
 _lazy_cls = None
 _lazy_record = None
+
+# observability hook: amp.debugging installs a callable(op_name, tensors)
+# during operator-stats collection windows (reference hooks the generated
+# ad_func chain via FLAGS; one None-check on the fast path here).
+_op_observer = None
 
 
 def apply_op(fn: Callable, *inputs, _op_name: Optional[str] = None, **kwargs):
@@ -176,6 +186,8 @@ def apply_op(fn: Callable, *inputs, _op_name: Optional[str] = None, **kwargs):
         res = _wrap_outputs(out, None, name)
         if flag_value("FLAGS_check_nan_inf"):
             _check_finite(name, [t._data for t in _flatten_tensors(res)])
+        if _op_observer is not None:
+            _op_observer(name, _flatten_tensors(res))
         return res
 
     def pure(*t_arrs):
@@ -196,6 +208,8 @@ def apply_op(fn: Callable, *inputs, _op_name: Optional[str] = None, **kwargs):
     res = _wrap_outputs(out, node, name)
     if flag_value("FLAGS_check_nan_inf"):
         _check_finite(name, [t._data for t in _flatten_tensors(res)])
+    if _op_observer is not None:
+        _op_observer(name, _flatten_tensors(res))
     return res
 
 

@@ -1,0 +1,124 @@
+"""Custom-device plugin registry (reference: phi/backends/device_ext.h,
+fake_cpu_device.h, test/custom_runtime/test_custom_cpu_plugin.py) and
+amp.debugging operator stats / accuracy tooling
+(python/paddle/amp/debugging.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.amp import debugging as dbg
+from paddle_tpu.device import custom as custom_dev
+
+
+@pytest.fixture
+def fake_device():
+    dev = custom_dev.FakeCPUDevice("fake_cpu", num_devices=2)
+    custom_dev._REGISTRY[dev.name] = dev
+    dev.init()
+    yield dev
+    custom_dev.unregister_custom_device("fake_cpu")
+
+
+def test_fake_device_registry(fake_device):
+    assert paddle.device.get_all_custom_device_type() == ["fake_cpu"]
+    assert paddle.device.is_compiled_with_custom_device("fake_cpu")
+    assert not paddle.device.is_compiled_with_custom_device("other")
+    assert paddle.device.get_available_custom_device() == \
+        ["fake_cpu:0", "fake_cpu:1"]
+    assert fake_device.calls == ["init"]
+    fake_device.synchronize(1)
+    assert fake_device.calls[-1] == "sync:1"
+
+
+def test_set_device_custom_type(fake_device):
+    place = paddle.device.set_device("fake_cpu:1")
+    assert place.device_type == "fake_cpu" and place.device_id == 1
+    assert paddle.device.get_device() == "fake_cpu:1"
+    paddle.device.set_device("cpu")
+
+
+def test_unregister_finalizes():
+    dev = custom_dev.register_custom_device("tmp_dev")
+    assert "tmp_dev" in custom_dev.get_all_custom_device_type()
+    custom_dev.unregister_custom_device("tmp_dev")
+    assert "tmp_dev" not in custom_dev.get_all_custom_device_type()
+
+
+def test_duplicate_registration_raises(fake_device):
+    with pytest.raises(ValueError):
+        custom_dev.register_custom_device("fake_cpu")
+
+
+# -- amp.debugging ---------------------------------------------------------
+
+def test_operator_stats_collection(capsys):
+    x = paddle.to_tensor(np.ones((2, 2), np.float32))
+    with dbg.collect_operator_stats():
+        _ = x + x
+        _ = x * 2
+        _ = (x + x) * x
+    out = capsys.readouterr().out
+    assert "add" in out and "multiply" in out and "op list" in out
+    # observer detaches after the window
+    from paddle_tpu.framework import tensor as tmod
+    assert tmod._op_observer is None
+
+
+def test_operator_stats_checked_op_list(capsys):
+    x = paddle.to_tensor(np.ones((2,), np.float32))
+    dbg.set_checked_op_list(["add"])
+    try:
+        with dbg.collect_operator_stats():
+            _ = x + x
+            _ = x * 2
+    finally:
+        dbg.set_checked_op_list(None)
+    out = capsys.readouterr().out
+    assert "add" in out and "multiply" not in out
+
+
+def test_accuracy_check_pass_and_fail():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32))
+    ok = dbg.accuracy_check(x, x + 1e-9, "close")
+    assert bool(ok._data)
+    with pytest.raises(AssertionError, match="accuracy_check failed"):
+        dbg.accuracy_check(x, x + 1.0, "far")
+
+
+def test_accuracy_check_under_jit():
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(a, b):
+        return dbg.accuracy_check(paddle.Tensor(a), paddle.Tensor(b))._data
+
+    assert bool(f(jnp.ones(3), jnp.ones(3)))
+    assert not bool(f(jnp.ones(3), jnp.zeros(3)))
+
+
+def test_compare_accuracy_roundtrip(tmp_path):
+    x = paddle.to_tensor(np.arange(4, dtype=np.float32))
+    run_a, run_b = str(tmp_path / "a"), str(tmp_path / "b")
+    dbg.save_tensor_stats(run_a, "step0", {"loss": x, "grad": x * 2})
+    dbg.save_tensor_stats(run_b, "step0", {"loss": x, "grad": x * 4})
+    out_csv = str(tmp_path / "cmp.csv")
+    rows = dbg.compare_accuracy(run_a, run_b, out_csv)
+    byname = {r["name"]: r for r in rows}
+    assert byname["loss"]["max_diff"] == 0.0
+    assert byname["grad"]["max_diff"] == 6.0
+    assert os.path.exists(out_csv)
+
+
+def test_check_layer_numerics():
+    import paddle_tpu.nn as nn
+
+    class Bad(nn.Layer):
+        @dbg.check_layer_numerics
+        def forward(self, x):
+            return x / 0.0
+
+    with pytest.raises(FloatingPointError):
+        Bad()(paddle.to_tensor(np.ones(3, np.float32)))
