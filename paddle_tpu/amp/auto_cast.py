@@ -50,20 +50,28 @@ def amp_state() -> Optional[_AmpState]:
 
 
 def maybe_autocast_inputs(op_name: str, arrs):
-    """Called by apply_op: cast input arrays per O1 lists. Returns the
-    (possibly) cast list."""
+    """Called by apply_op: cast input arrays per the amp level. O1 casts
+    white-listed ops down / black-listed ops up; O2 casts EVERY op's fp32
+    inputs down except the black list (reference amp_guard O2 semantics —
+    params are already low precision via ``decorate``, masters stay fp32
+    in the optimizer). Returns the (possibly) cast list."""
     st = amp_state()
-    if st is None or not st.enable or st.level != "O1":
+    if st is None or not st.enable or st.level not in ("O1", "O2"):
         return arrs
-    if op_name in WHITE_LIST:
-        tgt = st.dtype
-        return [a.astype(tgt)
-                if hasattr(a, "dtype") and a.dtype == jnp.float32 else a
-                for a in arrs]
     if op_name in BLACK_LIST:
         return [a.astype(jnp.float32)
                 if hasattr(a, "dtype") and a.dtype in (jnp.float16,
                                                        jnp.bfloat16) else a
+                for a in arrs]
+    # explicit dtype conversion is the user's escape hatch out of the
+    # autocast region — never intercept it (a cast-to-fp32 would
+    # otherwise round-trip through the low dtype and truncate)
+    if op_name == "cast":
+        return arrs
+    if st.level == "O2" or op_name in WHITE_LIST:
+        tgt = st.dtype
+        return [a.astype(tgt)
+                if hasattr(a, "dtype") and a.dtype == jnp.float32 else a
                 for a in arrs]
     return arrs
 

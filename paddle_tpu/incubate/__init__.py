@@ -5,6 +5,7 @@ from . import moe  # noqa: F401
 from .moe import MoELayer, GShardGate, SwitchGate  # noqa: F401
 from . import asp  # noqa: F401
 from . import autograd  # noqa: F401
+from . import multiprocessing  # noqa: F401
 
 # top-level incubate re-exports (python/paddle/incubate/__init__.py)
 from ..geometric import (segment_max, segment_mean,  # noqa: F401

@@ -1,0 +1,64 @@
+"""Tensor sharing across processes (reference:
+python/paddle/incubate/multiprocessing/ — registers ForkingPickler
+reducers so ``multiprocessing`` queues/pipes can carry Tensors through
+shared memory instead of pickling the bytes;
+reductions.py:95 ``_reduce_tensor``).
+
+TPU-native rethink: device arrays are owned by XLA, so the shared
+payload is the host copy in a ``multiprocessing.shared_memory`` block
+(the reference's file_system strategy). The consumer rebuilds a Tensor
+from the block; the producer unlinks it at GC. Useful for DataLoader
+workers and any host-side pipeline (fleet_executor stages in separate
+processes).
+"""
+from __future__ import annotations
+
+import weakref
+from multiprocessing import *  # noqa: F401,F403
+from multiprocessing import reduction, shared_memory
+
+import numpy as np
+
+__all__ = []  # mirrors the reference: everything comes from stdlib mp
+
+_OWNED: dict = {}
+
+
+def _rebuild_tensor(shm_name, shape, dtype_str):
+    from ..framework.tensor import Tensor
+    shm = shared_memory.SharedMemory(name=shm_name)
+    try:
+        arr = np.ndarray(shape, dtype=np.dtype(dtype_str),
+                         buffer=shm.buf).copy()
+    finally:
+        shm.close()
+    import jax.numpy as jnp
+    return Tensor(jnp.asarray(arr))
+
+
+def _reduce_tensor(tensor):
+    arr = np.asarray(tensor._data)
+    shm = shared_memory.SharedMemory(create=True, size=max(arr.nbytes, 1))
+    np.ndarray(arr.shape, dtype=arr.dtype, buffer=shm.buf)[...] = arr
+    # producer keeps the block alive until the Tensor is collected
+    _OWNED[shm.name] = shm
+    weakref.finalize(tensor, _release, shm.name)
+    return _rebuild_tensor, (shm.name, arr.shape, arr.dtype.str)
+
+
+def _release(name):
+    shm = _OWNED.pop(name, None)
+    if shm is not None:
+        shm.close()
+        try:
+            shm.unlink()
+        except FileNotFoundError:
+            pass
+
+
+def init_reductions():
+    from ..framework.tensor import Tensor
+    reduction.ForkingPickler.register(Tensor, _reduce_tensor)
+
+
+init_reductions()

@@ -1,0 +1,126 @@
+"""OpTest harness — the reference's op-unit-test pattern
+(test/legacy_test/op_test.py:418) rebuilt for this framework: each op is
+checked against a NumPy reference in eager mode across a dtype matrix
+(fp32 exact-ish, fp16/bf16 loose), against the same computation under
+jit.to_static, and its analytic gradient against a central-difference
+numeric gradient (get_numeric_gradient analog)."""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import jit
+
+DTYPE_TOL = {
+    "float32": dict(rtol=1e-5, atol=1e-5),
+    "float16": dict(rtol=2e-2, atol=2e-2),
+    "bfloat16": dict(rtol=8e-2, atol=8e-2),
+}
+
+
+def _to_np(t):
+    a = t.numpy()
+    if a.dtype == np.dtype("bfloat16") if hasattr(np, "bfloat16") else False:
+        a = a.astype(np.float32)
+    return np.asarray(a, dtype=np.float32) if a.dtype.kind == "f" else a
+
+
+def check_op(op: Callable, ref: Callable,
+             inputs: Dict[str, np.ndarray],
+             attrs: Optional[dict] = None,
+             dtypes: Sequence[str] = ("float32", "float16", "bfloat16"),
+             check_grad: bool = True,
+             grad_targets: Optional[Sequence[str]] = None,
+             check_static: bool = True,
+             grad_eps: float = 1e-3,
+             grad_rtol: float = 5e-2,
+             grad_atol: float = 5e-3):
+    """Run the full OpTest protocol for one op.
+
+    op(**tensors, **attrs) -> Tensor; ref(**arrays, **attrs) -> ndarray.
+    inputs are float32 ndarrays (cast per dtype); non-float inputs pass
+    through uncast and are never differentiated.
+    """
+    attrs = attrs or {}
+    float_names = [k for k, v in inputs.items() if v.dtype.kind == "f"]
+
+    # -- forward, dtype matrix --------------------------------------------
+    ref_out = ref(*[v.copy() for v in inputs.values()], **attrs)
+    for dtype in dtypes:
+        tol = DTYPE_TOL[dtype]
+        tensors = {
+            k: paddle.to_tensor(v.astype(dtype) if k in float_names else v)
+            for k, v in inputs.items()}
+        out = op(*tensors.values(), **attrs)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        refs = ref_out if isinstance(ref_out, (tuple, list)) else [ref_out]
+        for o, r in zip(outs, refs):
+            got = _to_np(o)
+            want = np.asarray(r)
+            if want.dtype.kind == "f":
+                np.testing.assert_allclose(
+                    got, want.astype(np.float32), **tol,
+                    err_msg=f"forward mismatch dtype={dtype}")
+            else:
+                np.testing.assert_array_equal(got, want)
+
+    # -- to_static parity (fp32) ------------------------------------------
+    if check_static:
+        tensors = {k: paddle.to_tensor(v) for k, v in inputs.items()}
+        st = jit.to_static(lambda *a: op(*a, **attrs))
+        out_s = st(*tensors.values())
+        outs_s = out_s if isinstance(out_s, (tuple, list)) else [out_s]
+        refs = ref_out if isinstance(ref_out, (tuple, list)) else [ref_out]
+        for o, r in zip(outs_s, refs):
+            want = np.asarray(r)
+            if want.dtype.kind == "f":
+                np.testing.assert_allclose(
+                    _to_np(o), want.astype(np.float32), rtol=1e-5,
+                    atol=1e-5, err_msg="to_static mismatch")
+            else:
+                np.testing.assert_array_equal(_to_np(o), want)
+
+    # -- gradient check (fp32, central differences) -----------------------
+    if check_grad:
+        targets = list(grad_targets or float_names)
+
+        def scalar_loss(arrs: Dict[str, np.ndarray]) -> float:
+            tensors = {k: paddle.to_tensor(v) for k, v in arrs.items()}
+            out = op(*tensors.values(), **attrs)
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            return float(sum(o.astype("float32").sum() for o in outs
+                             if o.dtype.name.startswith("float")).numpy())
+
+        tensors = {
+            k: paddle.to_tensor(v, stop_gradient=k not in targets)
+            for k, v in inputs.items()}
+        out = op(*tensors.values(), **attrs)
+        outs = out if isinstance(out, (tuple, list)) else [out]
+        loss = sum(o.astype("float32").sum() for o in outs
+                   if o.dtype.name.startswith("float"))
+        grads = paddle.grad(loss, [tensors[k] for k in targets])
+        for name, g in zip(targets, grads):
+            num = _numeric_grad(scalar_loss, inputs, name, grad_eps)
+            np.testing.assert_allclose(
+                _to_np(g), num, rtol=grad_rtol, atol=grad_atol,
+                err_msg=f"analytic vs numeric grad mismatch for {name}")
+
+
+def _numeric_grad(loss_fn, inputs, name, eps):
+    """Central-difference gradient (reference get_numeric_gradient)."""
+    base = {k: v.copy() for k, v in inputs.items()}
+    x = base[name]
+    g = np.zeros_like(x, dtype=np.float32)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        f_hi = loss_fn(base)
+        flat[i] = orig - eps
+        f_lo = loss_fn(base)
+        flat[i] = orig
+        gf[i] = (f_hi - f_lo) / (2 * eps)
+    return g

@@ -43,6 +43,36 @@ def test_decorate_o2_keeps_norm_fp32():
     assert net[1].weight.dtype == paddle.float32
 
 
+def test_autocast_o2_casts_all_but_blacklist():
+    """O2: every op's fp32 inputs cast down except the black list — this
+    is what lets fp32 activations meet decorate()'d bf16 conv/linear
+    weights (reference amp_guard O2)."""
+    a = paddle.randn([4, 4])
+    with amp.auto_cast(level="O2", dtype="bfloat16"):
+        r = a + a          # not white-listed; O2 still casts
+        assert r.dtype == paddle.bfloat16
+        s = F.softmax(a)   # black list stays fp32
+        assert s.dtype == paddle.float32
+    assert (a + a).dtype == paddle.float32
+
+
+def test_autocast_o2_cast_escape_hatch():
+    """Explicit astype inside O2 must NOT round-trip through bf16."""
+    t = paddle.to_tensor(np.float32(1.0000001))
+    with amp.auto_cast(level="O2", dtype="bfloat16"):
+        u = t.astype(paddle.float32)
+    assert float(u.numpy()) == float(t.numpy())
+
+
+def test_autocast_o2_conv_with_decorated_model():
+    net = nn.Conv2D(3, 4, 3)
+    amp.decorate(net, level="O2", dtype="bfloat16")
+    x = paddle.randn([1, 3, 8, 8])  # fp32 input, bf16 weights
+    with amp.auto_cast(level="O2", dtype="bfloat16"):
+        out = net(x)
+    assert out.dtype == paddle.bfloat16
+
+
 def test_grad_scaler_protocol():
     net = nn.Linear(2, 2)
     opt = SGD(learning_rate=0.1, parameters=net.parameters())

@@ -1,0 +1,76 @@
+"""Cross-process Tensor sharing (reference:
+python/paddle/incubate/multiprocessing/reductions.py — ForkingPickler
+reducers over shared memory). The cross-process test uses a subprocess
+(the launcher pattern of reference distributed tests) because
+multiprocessing.spawn re-imports pytest's __main__."""
+import io
+import os
+import subprocess
+import sys
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.incubate.multiprocessing as pmp  # noqa: F401  (registers)
+
+
+def test_forking_pickler_registered():
+    from multiprocessing.reduction import ForkingPickler
+    from paddle_tpu.framework.tensor import Tensor
+    assert Tensor in ForkingPickler._extra_reducers
+
+
+def test_reduce_rebuild_roundtrip_same_process():
+    from paddle_tpu.incubate.multiprocessing import (_rebuild_tensor,
+                                                     _reduce_tensor)
+    t = paddle.to_tensor(np.arange(6, dtype=np.int32))
+    fn, args = _reduce_tensor(t)
+    assert fn is _rebuild_tensor
+    t2 = fn(*args)
+    np.testing.assert_array_equal(np.asarray(t2._data),
+                                  np.asarray(t._data))
+
+
+def test_tensor_shared_to_subprocess(tmp_path):
+    """Serialize with the mp reducer, deserialize in a fresh process —
+    the payload rides shared memory, not the pickle stream."""
+    from multiprocessing.reduction import ForkingPickler
+    big = np.zeros((256, 1024), np.float32)
+    big[:3, :4] = np.arange(12, dtype=np.float32).reshape(3, 4)
+    t = paddle.to_tensor(big[:3, :4].copy())
+    buf = io.BytesIO()
+    ForkingPickler(buf).dump(paddle.to_tensor(big))
+    # shm payload must NOT be inlined in the pickle bytes (1MB tensor,
+    # tiny pickle)
+    assert len(buf.getvalue()) < 4096
+    buf = io.BytesIO()
+    ForkingPickler(buf).dump(t)
+    blob = tmp_path / "t.pkl"
+    blob.write_bytes(buf.getvalue())
+
+    child = (
+        "import os; os.environ['JAX_PLATFORMS']='cpu'\n"
+        "import pickle, sys, numpy as np\n"
+        "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+        "import paddle_tpu.incubate.multiprocessing  # register reducers\n"
+        f"t = pickle.load(open({str(blob)!r}, 'rb'))\n"
+        "print('CHILDSUM', float(t.sum()))\n"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               PYTHONPATH=os.pathsep.join(
+                   [os.path.dirname(os.path.dirname(__file__))]
+                   + os.environ.get("PYTHONPATH", "").split(os.pathsep)))
+    out = subprocess.run([sys.executable, "-c", child], env=env,
+                         capture_output=True, text=True, timeout=180)
+    assert "CHILDSUM 66.0" in out.stdout, (out.stdout, out.stderr)
+
+
+def test_shared_block_released_on_gc():
+    import gc
+    from paddle_tpu.incubate import multiprocessing as m
+    t = paddle.to_tensor(np.ones(4, np.float32))
+    _, (name, _, _) = m._reduce_tensor(t)
+    assert name in m._OWNED
+    del t
+    gc.collect()
+    assert name not in m._OWNED

@@ -1,0 +1,300 @@
+"""Broad op coverage in the reference's OpTest style (SURVEY.md §4
+takeaway 1): numeric-vs-NumPy forward across a dtype matrix, to_static
+parity, analytic-vs-numeric gradients. One row ≈ one reference
+test/legacy_test/test_*_op.py file."""
+import numpy as np
+import pytest
+from scipy import special as sp
+
+import paddle_tpu as paddle
+from op_test import check_op
+
+rng = np.random.RandomState(7)
+
+
+def _x(shape=(3, 4), lo=-2.0, hi=2.0):
+    return rng.uniform(lo, hi, shape).astype(np.float32)
+
+
+# (name, paddle op, numpy ref, inputs, attrs, kwargs-for-check_op)
+UNARY = [
+    ("exp", paddle.exp, np.exp, dict(x=_x()), {}, {}),
+    ("log", paddle.log, np.log, dict(x=_x((3, 4), 0.2, 3.0)), {}, {}),
+    ("sqrt", paddle.sqrt, np.sqrt, dict(x=_x((3, 4), 0.1, 4.0)), {}, {}),
+    ("rsqrt", paddle.rsqrt, lambda x: 1 / np.sqrt(x),
+     dict(x=_x((3, 4), 0.2, 4.0)), {}, {}),
+    ("abs", paddle.abs, np.abs, dict(x=_x()), {},
+     dict(check_grad=False)),  # kink at 0 is fine but keep numeric clean
+    ("sin", paddle.sin, np.sin, dict(x=_x()), {}, {}),
+    ("cos", paddle.cos, np.cos, dict(x=_x()), {}, {}),
+    ("tanh", paddle.tanh, np.tanh, dict(x=_x()), {}, {}),
+    ("sigmoid", paddle.nn.functional.sigmoid,
+     lambda x: 1 / (1 + np.exp(-x)), dict(x=_x()), {}, {}),
+    ("erf", paddle.erf, sp.erf, dict(x=_x()), {}, {}),
+    ("floor", paddle.floor, np.floor, dict(x=_x()), {},
+     dict(check_grad=False)),
+    ("ceil", paddle.ceil, np.ceil, dict(x=_x()), {},
+     dict(check_grad=False)),
+    ("round", paddle.round, np.round, dict(x=_x()), {},
+     dict(check_grad=False)),
+    ("expm1", paddle.expm1, np.expm1, dict(x=_x()), {}, {}),
+    ("log1p", paddle.log1p, np.log1p, dict(x=_x((3, 4), -0.5, 2.0)),
+     {}, {}),
+    ("reciprocal", paddle.reciprocal, lambda x: 1 / x,
+     dict(x=_x((3, 4), 0.5, 3.0)), {}, {}),
+    ("square", paddle.square, np.square, dict(x=_x()), {}, {}),
+    ("softplus", paddle.nn.functional.softplus,
+     lambda x: np.log1p(np.exp(x)), dict(x=_x()), {}, {}),
+    ("silu", paddle.nn.functional.silu,
+     lambda x: x / (1 + np.exp(-x)), dict(x=_x()), {}, {}),
+    ("gelu", paddle.nn.functional.gelu,
+     lambda x: x * 0.5 * (1 + sp.erf(x / np.sqrt(2))), dict(x=_x()),
+     {}, {}),
+    ("relu", paddle.nn.functional.relu, lambda x: np.maximum(x, 0),
+     dict(x=_x() + 0.05), {}, {}),  # keep away from the kink
+    ("leaky_relu", paddle.nn.functional.leaky_relu,
+     lambda x, negative_slope=0.01: np.where(x > 0, x,
+                                             negative_slope * x),
+     dict(x=_x() + 0.05), dict(negative_slope=0.1), {}),
+    ("hardswish", paddle.nn.functional.hardswish,
+     lambda x: x * np.clip(x + 3, 0, 6) / 6, dict(x=_x()), {},
+     dict(check_grad=False)),
+    ("atan", paddle.atan, np.arctan, dict(x=_x()), {}, {}),
+    ("asinh", paddle.asinh, np.arcsinh, dict(x=_x()), {}, {}),
+    ("digamma", paddle.digamma, sp.digamma,
+     dict(x=_x((3, 4), 0.5, 4.0)), {}, dict(dtypes=("float32",))),
+]
+
+
+@pytest.mark.parametrize("name,op,ref,inputs,attrs,kw",
+                         UNARY, ids=[u[0] for u in UNARY])
+def test_unary_op(name, op, ref, inputs, attrs, kw):
+    check_op(op, ref, inputs, attrs, **kw)
+
+
+BINARY = [
+    ("add", paddle.add, np.add, dict(x=_x(), y=_x()), {}, {}),
+    ("subtract", paddle.subtract, np.subtract,
+     dict(x=_x(), y=_x()), {}, {}),
+    ("multiply", paddle.multiply, np.multiply,
+     dict(x=_x(), y=_x()), {}, {}),
+    ("divide", paddle.divide, np.divide,
+     dict(x=_x(), y=_x((3, 4), 0.5, 3.0)), {}, {}),
+    ("maximum", paddle.maximum, np.maximum,
+     dict(x=_x(), y=_x()), {}, dict(check_grad=False)),
+    ("minimum", paddle.minimum, np.minimum,
+     dict(x=_x(), y=_x()), {}, dict(check_grad=False)),
+    ("pow", paddle.pow, np.power,
+     dict(x=_x((3, 4), 0.5, 2.0), y=_x((3, 4), 0.5, 2.0)), {}, {}),
+    ("fmax", paddle.fmax, np.fmax, dict(x=_x(), y=_x()), {},
+     dict(check_grad=False)),
+    ("atan2", paddle.atan2, np.arctan2,
+     dict(x=_x((3, 4), 0.3, 2.0), y=_x((3, 4), 0.3, 2.0)), {}, {}),
+    ("broadcast_add", paddle.add, np.add,
+     dict(x=_x((3, 4)), y=_x((1, 4))), {}, {}),
+    ("broadcast_mul", paddle.multiply, np.multiply,
+     dict(x=_x((2, 3, 4)), y=_x((4,))), {}, {}),
+]
+
+
+@pytest.mark.parametrize("name,op,ref,inputs,attrs,kw",
+                         BINARY, ids=[b[0] for b in BINARY])
+def test_binary_op(name, op, ref, inputs, attrs, kw):
+    check_op(op, ref, inputs, attrs, **kw)
+
+
+MATMUL = [
+    ("matmul", dict(x=_x((3, 5)), y=_x((5, 4))), {}),
+    ("matmul_tx", dict(x=_x((5, 3)), y=_x((5, 4))),
+     dict(transpose_x=True)),
+    ("matmul_ty", dict(x=_x((3, 5)), y=_x((4, 5))),
+     dict(transpose_y=True)),
+    ("matmul_batched", dict(x=_x((2, 3, 5)), y=_x((2, 5, 4))), {}),
+]
+
+
+@pytest.mark.parametrize("name,inputs,attrs", MATMUL,
+                         ids=[m[0] for m in MATMUL])
+def test_matmul_op(name, inputs, attrs):
+    def ref(x, y, transpose_x=False, transpose_y=False):
+        if transpose_x:
+            x = np.swapaxes(x, -1, -2)
+        if transpose_y:
+            y = np.swapaxes(y, -1, -2)
+        return x @ y
+    check_op(paddle.matmul, ref, inputs, attrs,
+             dtypes=("float32", "bfloat16"))
+
+
+REDUCE = [
+    ("sum", paddle.sum, np.sum, {}, {}),
+    ("sum_axis", paddle.sum, np.sum, dict(axis=1), {}),
+    ("mean", paddle.mean, np.mean, {}, {}),
+    ("mean_keepdim", paddle.mean,
+     lambda x, axis, keepdim: np.mean(x, axis, keepdims=keepdim),
+     dict(axis=0, keepdim=True), {}),
+    ("max", paddle.max, np.max, {}, dict(check_grad=False)),
+    ("min", paddle.min, np.min, {}, dict(check_grad=False)),
+    ("prod", paddle.prod, np.prod, {}, dict(grad_rtol=0.1)),
+    ("logsumexp", paddle.logsumexp, sp.logsumexp, {}, {}),
+]
+
+
+@pytest.mark.parametrize("name,op,ref,attrs,kw", REDUCE,
+                         ids=[r[0] for r in REDUCE])
+def test_reduce_op(name, op, ref, attrs, kw):
+    check_op(op, ref, dict(x=_x((3, 4), 0.2, 1.5)), attrs, **kw)
+
+
+def test_softmax_op():
+    def ref(x, axis=-1):
+        e = np.exp(x - x.max(axis, keepdims=True))
+        return e / e.sum(axis, keepdims=True)
+    check_op(paddle.nn.functional.softmax, ref, dict(x=_x()),
+             dict(axis=-1))
+
+
+def test_log_softmax_op():
+    def ref(x, axis=-1):
+        e = np.exp(x - x.max(axis, keepdims=True))
+        return np.log(e / e.sum(axis, keepdims=True))
+    check_op(paddle.nn.functional.log_softmax, ref, dict(x=_x()),
+             dict(axis=-1))
+
+
+SHAPE_OPS = [
+    ("transpose", paddle.transpose,
+     lambda x, perm: np.transpose(x, perm),
+     dict(x=_x((2, 3, 4))), dict(perm=[2, 0, 1])),
+    ("reshape", paddle.reshape,
+     lambda x, shape: np.reshape(x, shape),
+     dict(x=_x((2, 6))), dict(shape=[3, 4])),
+    ("squeeze", paddle.squeeze, lambda x, axis: np.squeeze(x, axis),
+     dict(x=_x((3, 1, 4))), dict(axis=1)),
+    ("unsqueeze", paddle.unsqueeze,
+     lambda x, axis: np.expand_dims(x, axis),
+     dict(x=_x((3, 4))), dict(axis=0)),
+    ("tile", paddle.tile,
+     lambda x, repeat_times: np.tile(x, repeat_times),
+     dict(x=_x((2, 3))), dict(repeat_times=[2, 2])),
+    ("flip", paddle.flip, lambda x, axis: np.flip(x, axis),
+     dict(x=_x((3, 4))), dict(axis=[0])),
+    ("roll", paddle.roll,
+     lambda x, shifts, axis: np.roll(x, shifts, axis),
+     dict(x=_x((3, 4))), dict(shifts=1, axis=0)),
+    ("clip", paddle.clip,
+     lambda x, min, max: np.clip(x, min, max),
+     dict(x=_x()), dict(min=-0.5, max=0.5)),
+]
+
+
+@pytest.mark.parametrize("name,op,ref,inputs,attrs", SHAPE_OPS,
+                         ids=[s[0] for s in SHAPE_OPS])
+def test_shape_op(name, op, ref, inputs, attrs):
+    check_op(op, ref, inputs, attrs, check_grad=False)
+
+
+def test_concat_op():
+    a, b = _x((2, 3)), _x((2, 3))
+    out = paddle.concat([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+    np.testing.assert_allclose(out.numpy(), np.concatenate([a, b], 0),
+                               rtol=1e-6)
+
+
+def test_stack_split_op():
+    a, b = _x((2, 3)), _x((2, 3))
+    s = paddle.stack([paddle.to_tensor(a), paddle.to_tensor(b)], axis=0)
+    np.testing.assert_allclose(s.numpy(), np.stack([a, b]), rtol=1e-6)
+    parts = paddle.split(paddle.to_tensor(a), 3, axis=1)
+    for p, r in zip(parts, np.split(a, 3, 1)):
+        np.testing.assert_allclose(p.numpy(), r, rtol=1e-6)
+
+
+INDEX_OPS = [
+    ("argmax", lambda x: paddle.argmax(paddle.to_tensor(x), axis=1),
+     lambda x: np.argmax(x, 1)),
+    ("argmin", lambda x: paddle.argmin(paddle.to_tensor(x), axis=1),
+     lambda x: np.argmin(x, 1)),
+    ("argsort", lambda x: paddle.argsort(paddle.to_tensor(x), axis=1),
+     lambda x: np.argsort(x, 1, kind="stable")),
+    ("sort", lambda x: paddle.sort(paddle.to_tensor(x), axis=1),
+     lambda x: np.sort(x, 1)),
+    ("cumsum", lambda x: paddle.cumsum(paddle.to_tensor(x), axis=1),
+     lambda x: np.cumsum(x, 1)),
+    ("cumprod", lambda x: paddle.cumprod(paddle.to_tensor(x), dim=1),
+     lambda x: np.cumprod(x, 1)),
+]
+
+
+@pytest.mark.parametrize("name,op,ref", INDEX_OPS,
+                         ids=[i[0] for i in INDEX_OPS])
+def test_index_op(name, op, ref):
+    x = _x((3, 5))
+    got = op(x).numpy()
+    np.testing.assert_allclose(got, ref(x), rtol=1e-6)
+
+
+def test_gather_take_along_axis():
+    x = _x((4, 5))
+    idx = np.array([0, 2, 3])
+    np.testing.assert_allclose(
+        paddle.gather(paddle.to_tensor(x), paddle.to_tensor(idx)).numpy(),
+        x[idx], rtol=1e-6)
+    ia = np.argsort(x, axis=1)
+    np.testing.assert_allclose(
+        paddle.take_along_axis(paddle.to_tensor(x), paddle.to_tensor(ia),
+                               axis=1).numpy(),
+        np.take_along_axis(x, ia, 1), rtol=1e-6)
+
+
+def test_where_masked_ops():
+    x, y = _x((3, 4)), _x((3, 4))
+    c = x > 0
+    np.testing.assert_allclose(
+        paddle.where(paddle.to_tensor(c), paddle.to_tensor(x),
+                     paddle.to_tensor(y)).numpy(),
+        np.where(c, x, y), rtol=1e-6)
+
+
+def test_topk_op():
+    x = _x((3, 6))
+    v, i = paddle.topk(paddle.to_tensor(x), k=2, axis=1)
+    ref_i = np.argsort(-x, 1)[:, :2]
+    np.testing.assert_allclose(v.numpy(),
+                               np.take_along_axis(x, ref_i, 1), rtol=1e-6)
+
+
+def test_one_hot_op():
+    idx = np.array([0, 2, 1])
+    out = paddle.nn.functional.one_hot(paddle.to_tensor(idx),
+                                       num_classes=4)
+    np.testing.assert_array_equal(out.numpy(), np.eye(4)[idx])
+
+
+def test_cross_entropy_op():
+    logits = _x((4, 7))
+    labels = np.array([1, 0, 6, 3])
+
+    def ref(x, label):
+        e = np.exp(x - x.max(-1, keepdims=True))
+        p = e / e.sum(-1, keepdims=True)
+        return -np.mean(np.log(p[np.arange(len(label)), label]))
+
+    got = paddle.nn.functional.cross_entropy(
+        paddle.to_tensor(logits), paddle.to_tensor(labels))
+    np.testing.assert_allclose(float(got), ref(logits, labels), rtol=1e-5)
+
+
+def test_layer_norm_op():
+    x = _x((4, 8))
+    g, b = np.ones(8, np.float32), np.zeros(8, np.float32)
+
+    def ref(x):
+        m = x.mean(-1, keepdims=True)
+        v = x.var(-1, keepdims=True)
+        return (x - m) / np.sqrt(v + 1e-5)
+
+    got = paddle.nn.functional.layer_norm(
+        paddle.to_tensor(x), normalized_shape=[8],
+        weight=paddle.to_tensor(g), bias=paddle.to_tensor(b))
+    np.testing.assert_allclose(got.numpy(), ref(x), rtol=1e-5, atol=1e-5)
