@@ -3,6 +3,7 @@ from .static_function import (to_static, not_to_static, StaticFunction,
                               InputSpec)
 from .functional import TrainStep, functional_call, value_and_grad
 from .save_load import save, load, TranslatedLayer
+from . import dy2static  # noqa: F401  (AST control-flow conversion)
 
 __all__ = ["to_static", "not_to_static", "StaticFunction", "InputSpec",
            "TrainStep", "functional_call", "value_and_grad", "save", "load",

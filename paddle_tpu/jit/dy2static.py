@@ -1,0 +1,573 @@
+"""Dy2Static: AST conversion of Python control flow to compilable ops.
+
+Reference: ``python/paddle/jit/dy2static/`` — ``program_translator.py``
+StaticFunction/ConcreteProgram and the AST transformers under
+``transformers/`` that rewrite ``if``/``while``/``for`` into
+``cond``/``while_loop`` ops (plus the early-return and loop-variable
+analyses).
+
+TPU-native rethink: under jax tracing, a tensor-dependent ``if pred:``
+raises (a tracer has no truth value) — exactly the reference's
+dygraph-to-static problem. The converter rewrites control flow into
+calls to the runtime helpers below, which dispatch on the *runtime*
+value of the predicate:
+
+- concrete value (eager, or Python scalar): plain Python control flow —
+  identical to reference dygraph semantics;
+- traced value (inside ``jit.to_static``/``jax.jit``): ``lax.cond`` /
+  ``lax.while_loop`` — branch/body closures are re-expressed as pure
+  functions of the variables they assign, with initial values captured
+  by deferred loaders (unbound names become ``UndefinedVar``, the
+  reference's placeholder for maybe-unassigned branch variables).
+
+Conversion is best-effort with graph-break semantics (SURVEY.md §7 hard
+part 4): if a function can't be converted (no source, exotic syntax),
+the original function is used unchanged.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+from typing import Any, Callable, Dict
+
+import jax
+
+__all__ = ["convert_to_static", "convert_ifelse", "convert_while_loop",
+           "convert_for_range", "convert_logical_and",
+           "convert_logical_or", "convert_logical_not", "UndefinedVar"]
+
+_CONVERTED: Dict[Callable, Callable] = {}
+
+
+class _Unchanged(Exception):
+    """Internal: AST pass found no control flow to convert."""
+
+
+class UndefinedVar:
+    """Placeholder for a branch/loop variable with no value yet
+    (reference dy2static UndefinedVar). Any USE of it raises, preserving
+    Python's unbound-variable error semantics; only identity checks and
+    repr are allowed."""
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "<UndefinedVar>"
+
+    def _raise(self, *a, **k):
+        raise NameError(
+            "dy2static: variable was not assigned on the taken branch "
+            "(UndefinedVar used)")
+
+    __bool__ = __call__ = __iter__ = __len__ = _raise
+    __add__ = __radd__ = __sub__ = __mul__ = __eq__ = __lt__ = _raise
+    __getitem__ = __getattr__ = _raise
+
+    def __hash__(self):
+        return object.__hash__(self)
+
+
+UNDEFINED = UndefinedVar()
+
+
+# ---------------------------------------------------------------------------
+# runtime helpers
+# ---------------------------------------------------------------------------
+
+def _is_traced(x) -> bool:
+    from ..framework.tensor import Tensor
+    if isinstance(x, Tensor):
+        x = x._data
+    return isinstance(x, jax.core.Tracer)
+
+
+def _data(x):
+    from ..framework.tensor import Tensor
+    return x._data if isinstance(x, Tensor) else x
+
+
+def _load_inits(loaders):
+    out = []
+    for ld in loaders:
+        try:
+            out.append(ld())
+        except NameError:
+            out.append(UNDEFINED)
+    return tuple(out)
+
+
+def _unwrap(tree):
+    from ..framework.tensor import Tensor
+    return jax.tree.map(
+        lambda t: t._data if isinstance(t, Tensor) else t, tree,
+        is_leaf=lambda t: isinstance(t, Tensor))
+
+
+def _rewrap(data_tree, template_tree):
+    from ..framework.tensor import Tensor
+    flat_d = jax.tree.leaves(data_tree)
+    flat_t, treedef = jax.tree.flatten(
+        template_tree, is_leaf=lambda t: isinstance(t, Tensor))
+    out = [Tensor(d, stop_gradient=True) if isinstance(t, Tensor) else d
+           for d, t in zip(flat_d, flat_t)]
+    return jax.tree.unflatten(treedef, out)
+
+
+def _check_no_undefined(tree, what):
+    if any(isinstance(v, UndefinedVar) for v in jax.tree.leaves(
+            tree, is_leaf=lambda v: isinstance(v, UndefinedVar))):
+        raise ValueError(
+            f"dy2static: {what} must be initialized before a "
+            f"tensor-dependent (traced) control-flow statement")
+
+
+def convert_ifelse(pred, true_fn, false_fn, loaders=(),
+                   returns_value=False):
+    """`if pred:` with branches lifted to functions of their assigned
+    variables. Concrete pred → Python semantics; traced pred →
+    lax.cond."""
+    init = _load_inits(loaders)
+    if not _is_traced(pred):
+        return true_fn(*init) if bool(_data(pred)) else false_fn(*init)
+
+    template = {}
+
+    def wrap(fn):
+        def inner(_):
+            out = fn(*init)
+            # a branch may receive UndefinedVar initials (vars assigned
+            # in both branches); it must not RETURN one — that means one
+            # branch left a variable unassigned that the other assigns
+            _check_no_undefined(out, "every variable assigned in a "
+                                "traced if/else branch")
+            template.setdefault("t", out)
+            return _unwrap(out)
+        return inner
+
+    out = jax.lax.cond(_data(pred), wrap(true_fn), wrap(false_fn), None)
+    return _rewrap(out, template["t"])
+
+
+def convert_while_loop(cond_fn, body_fn, loaders=()):
+    """`while cond: body` — all assigned names become loop carries. The
+    traced path is taken when any loop variable is a tracer; a traced
+    condition over non-carried values would have raised in the original
+    code too, so no extra condition probe is made (side-effecting
+    conditions run exactly as often as in the source)."""
+    loop_vars = _load_inits(loaders)
+    traced = any(
+        _is_traced(v) for v in jax.tree.leaves(
+            _unwrap(loop_vars),
+            is_leaf=lambda v: isinstance(v, UndefinedVar)))
+    if not traced:
+        while bool(_data(cond_fn(*loop_vars))):
+            loop_vars = tuple(body_fn(*loop_vars))
+        return loop_vars
+
+    _check_no_undefined(loop_vars, "loop variables")
+    template = tuple(loop_vars)
+
+    def cond_w(carry):
+        return _data(cond_fn(*_rewrap(carry, template)))
+
+    def body_w(carry):
+        return _unwrap(tuple(body_fn(*_rewrap(carry, template))))
+
+    out = jax.lax.while_loop(cond_w, body_w, _unwrap(template))
+    return _rewrap(out, template)
+
+
+def convert_for_range(start, stop, step, body_fn, loaders=()):
+    """`for i in range(...)` — body_fn(i, *loop_vars) -> loop_vars."""
+    loop_vars = _load_inits(loaders)
+    if not any(_is_traced(v) for v in (start, stop, step)):
+        for i in range(int(_data(start)), int(_data(stop)),
+                       int(_data(step))):
+            loop_vars = tuple(body_fn(i, *loop_vars))
+        return loop_vars
+
+    _check_no_undefined(loop_vars, "loop variables")
+    import jax.numpy as jnp
+    step_d = _data(step)
+
+    def cond_fn(i, *vs):
+        from ..framework.tensor import Tensor
+        return Tensor(jnp.where(step_d > 0,
+                                _data(i) < _data(stop),
+                                _data(i) > _data(stop)),
+                      stop_gradient=True)
+
+    def body_w(i, *vs):
+        out = body_fn(i, *vs)
+        return (i + step, *out)
+
+    out = convert_while_loop(cond_fn, body_w,
+                             tuple([lambda s=start: s]
+                                   + [lambda v=v: v for v in loop_vars]))
+    return tuple(out[1:])
+
+
+def convert_logical_and(lhs_fn, rhs_fn):
+    lhs = lhs_fn()
+    if not _is_traced(lhs):
+        return lhs and rhs_fn()
+    import jax.numpy as jnp
+    from ..framework.tensor import Tensor
+    return Tensor(jnp.logical_and(_data(lhs), _data(rhs_fn())),
+                  stop_gradient=True)
+
+
+def convert_logical_or(lhs_fn, rhs_fn):
+    lhs = lhs_fn()
+    if not _is_traced(lhs):
+        return lhs or rhs_fn()
+    import jax.numpy as jnp
+    from ..framework.tensor import Tensor
+    return Tensor(jnp.logical_or(_data(lhs), _data(rhs_fn())),
+                  stop_gradient=True)
+
+
+def convert_logical_not(x):
+    if not _is_traced(x):
+        return not bool(_data(x))
+    import jax.numpy as jnp
+    from ..framework.tensor import Tensor
+    return Tensor(jnp.logical_not(_data(x)), stop_gradient=True)
+
+
+# ---------------------------------------------------------------------------
+# AST analysis
+# ---------------------------------------------------------------------------
+
+class _NameCollector(ast.NodeVisitor):
+    def __init__(self):
+        self.stored = set()
+        self.loaded = set()
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, ast.Store):
+            self.stored.add(node.id)
+        else:
+            self.loaded.add(node.id)
+
+    def visit_FunctionDef(self, node):
+        pass  # don't descend into nested defs
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+    visit_Lambda = visit_FunctionDef
+
+    def _visit_comp(self, node):
+        """Comprehension targets live in their own scope (py3) — they
+        are NOT assignments of the enclosing block."""
+        targets = _NameCollector()
+        sub = _NameCollector()
+        for gen in node.generators:
+            targets.visit(gen.target)
+            sub.visit(gen.iter)
+            for cond in gen.ifs:
+                sub.visit(cond)
+        for attr in ("elt", "key", "value"):
+            if hasattr(node, attr):
+                sub.visit(getattr(node, attr))
+        self.stored |= (sub.stored - targets.stored)
+        self.loaded |= (sub.loaded - targets.stored)
+
+    visit_ListComp = _visit_comp
+    visit_SetComp = _visit_comp
+    visit_DictComp = _visit_comp
+    visit_GeneratorExp = _visit_comp
+
+
+def _names(nodes, kind):
+    c = _NameCollector()
+    for n in nodes:
+        c.visit(n)
+    return c.stored if kind == "store" else c.loaded
+
+
+_DISALLOWED = (ast.Return, ast.Break, ast.Continue, ast.Yield,
+               ast.YieldFrom, ast.Global, ast.Nonlocal, ast.Import,
+               ast.ImportFrom, ast.FunctionDef, ast.AsyncFunctionDef,
+               ast.ClassDef)
+
+
+def _has_disallowed(nodes, allow_trailing_return=False):
+    """Bodies we can't lift into a closure: control-transfer statements
+    (a trailing return is allowed in return-style branches),
+    name-scope-changing statements (global/nonlocal/import/def), and
+    attribute/subscript stores (side effects a lax.cond would apply
+    unconditionally while tracing both branches)."""
+    seq = list(nodes)
+    if allow_trailing_return and seq and isinstance(seq[-1], ast.Return):
+        seq = seq[:-1]
+    for n in seq:
+        for sub in ast.walk(n):
+            if isinstance(sub, _DISALLOWED):
+                return True
+            if isinstance(sub, (ast.Attribute, ast.Subscript)) and \
+                    isinstance(sub.ctx, (ast.Store, ast.Del)):
+                return True
+    return False
+
+
+def _ends_with_return(body):
+    return bool(body) and isinstance(body[-1], ast.Return)
+
+
+def _dy2st_attr(name):
+    return ast.Attribute(value=ast.Name(id="__dy2st", ctx=ast.Load()),
+                         attr=name, ctx=ast.Load())
+
+
+def _empty_args(n_args=0, names=None):
+    args = [ast.arg(arg=a) for a in (names or [])]
+    return ast.arguments(posonlyargs=[], args=args, vararg=None,
+                         kwonlyargs=[], kw_defaults=[], kwarg=None,
+                         defaults=[])
+
+
+def _loaders_tuple(names):
+    """(lambda: x, lambda: y, ...) — deferred loads so unbound names
+    surface as UndefinedVar at runtime, not NameError at the call."""
+    return ast.Tuple(
+        elts=[ast.Lambda(args=_empty_args(), body=ast.Name(
+            id=n, ctx=ast.Load())) for n in names],
+        ctx=ast.Load())
+
+
+def _name_tuple(names, ctx):
+    return ast.Tuple(elts=[ast.Name(id=n, ctx=ctx()) for n in names],
+                     ctx=ctx())
+
+
+class _EarlyReturnMerger(ast.NodeTransformer):
+    """stmts [If(test, body..return, orelse=[]), rest...] →
+    If(test, body..return, orelse=rest) — the reference's early-return
+    normalization, making both branches return-style convertible."""
+
+    def _merge(self, stmts):
+        out = []
+        for i, st in enumerate(stmts):
+            st = self.visit(st)
+            if (isinstance(st, ast.If) and _ends_with_return(st.body)
+                    and not st.orelse and i + 1 < len(stmts)):
+                rest = self._merge(stmts[i + 1:])
+                st.orelse = rest
+                out.append(st)
+                return out
+            out.append(st)
+        return out
+
+    def visit_FunctionDef(self, node):
+        node.body = self._merge(node.body)
+        return node
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+
+class _ControlFlowTransformer(ast.NodeTransformer):
+    def __init__(self):
+        self.counter = 0
+        self.changed = False
+
+    def _uid(self):
+        self.counter += 1
+        return self.counter
+
+    # -- if ---------------------------------------------------------------
+    def visit_If(self, node):
+        self.generic_visit(node)
+        body_ret = _ends_with_return(node.body)
+        orelse_ret = _ends_with_return(node.orelse)
+        if body_ret and orelse_ret:
+            if _has_disallowed(node.body, True) or \
+                    _has_disallowed(node.orelse, True):
+                return node
+            return self._convert_if(node, returns_value=True)
+        if _has_disallowed(node.body) or _has_disallowed(node.orelse):
+            return node
+        return self._convert_if(node, returns_value=False)
+
+    def _convert_if(self, node, returns_value):
+        self.changed = True
+        assigned = sorted(_names(node.body, "store")
+                          | _names(node.orelse, "store"))
+        uid = self._uid()
+        tname, fname = f"__dy2st_true_{uid}", f"__dy2st_false_{uid}"
+        if returns_value:
+            tbody = list(node.body)
+            fbody = list(node.orelse)
+        else:
+            ret = ast.Return(value=_name_tuple(assigned, ast.Load))
+            tbody = list(node.body) + [ret]
+            fbody = (list(node.orelse) if node.orelse else []) + [ret]
+        true_def = ast.FunctionDef(name=tname,
+                                   args=_empty_args(names=assigned),
+                                   body=tbody, decorator_list=[])
+        false_def = ast.FunctionDef(name=fname,
+                                    args=_empty_args(names=assigned),
+                                    body=fbody, decorator_list=[])
+        call = ast.Call(
+            func=_dy2st_attr("convert_ifelse"),
+            args=[node.test,
+                  ast.Name(id=tname, ctx=ast.Load()),
+                  ast.Name(id=fname, ctx=ast.Load()),
+                  _loaders_tuple(assigned),
+                  ast.Constant(returns_value)],
+            keywords=[])
+        if returns_value:
+            stmt = ast.Return(value=call)
+        elif assigned:
+            stmt = ast.Assign(targets=[_name_tuple(assigned, ast.Store)],
+                              value=call)
+        else:
+            stmt = ast.Expr(value=call)
+        return [true_def, false_def, stmt]
+
+    # -- while ------------------------------------------------------------
+    def visit_While(self, node):
+        self.generic_visit(node)
+        if _has_disallowed(node.body) or node.orelse:
+            return node
+        if _names([node.test], "store"):
+            return node  # walrus in the condition: leave as Python
+        loop_vars = sorted(_names(node.body, "store"))
+        if not loop_vars:
+            return node
+        uid = self._uid()
+        cname, bname = f"__dy2st_cond_{uid}", f"__dy2st_body_{uid}"
+        cond_def = ast.FunctionDef(
+            name=cname, args=_empty_args(names=loop_vars),
+            body=[ast.Return(value=node.test)], decorator_list=[])
+        ret = ast.Return(value=_name_tuple(loop_vars, ast.Load))
+        body_def = ast.FunctionDef(
+            name=bname, args=_empty_args(names=loop_vars),
+            body=list(node.body) + [ret], decorator_list=[])
+        call = ast.Call(
+            func=_dy2st_attr("convert_while_loop"),
+            args=[ast.Name(id=cname, ctx=ast.Load()),
+                  ast.Name(id=bname, ctx=ast.Load()),
+                  _loaders_tuple(loop_vars)],
+            keywords=[])
+        assign = ast.Assign(targets=[_name_tuple(loop_vars, ast.Store)],
+                            value=call)
+        self.changed = True
+        return [cond_def, body_def, assign]
+
+    # -- for i in range(...) ----------------------------------------------
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if (_has_disallowed(node.body) or node.orelse
+                or not isinstance(node.target, ast.Name)
+                or not (isinstance(node.iter, ast.Call)
+                        and isinstance(node.iter.func, ast.Name)
+                        and node.iter.func.id == "range")
+                or node.iter.keywords):
+            return node
+        rargs = node.iter.args
+        if len(rargs) == 1:
+            start, stop, step = ast.Constant(0), rargs[0], ast.Constant(1)
+        elif len(rargs) == 2:
+            start, stop, step = rargs[0], rargs[1], ast.Constant(1)
+        elif len(rargs) == 3:
+            start, stop, step = rargs
+        else:
+            return node
+        ivar = node.target.id
+        loop_vars = sorted(_names(node.body, "store") - {ivar})
+        uid = self._uid()
+        bname = f"__dy2st_forbody_{uid}"
+        ret = ast.Return(value=_name_tuple(loop_vars, ast.Load))
+        body_def = ast.FunctionDef(
+            name=bname, args=_empty_args(names=[ivar] + loop_vars),
+            body=list(node.body) + [ret], decorator_list=[])
+        call = ast.Call(
+            func=_dy2st_attr("convert_for_range"),
+            args=[start, stop, step,
+                  ast.Name(id=bname, ctx=ast.Load()),
+                  _loaders_tuple(loop_vars)],
+            keywords=[])
+        if loop_vars:
+            stmt = ast.Assign(targets=[_name_tuple(loop_vars, ast.Store)],
+                              value=call)
+        else:
+            stmt = ast.Expr(value=call)
+        self.changed = True
+        return [body_def, stmt]
+
+    # -- boolean operators -------------------------------------------------
+    def visit_BoolOp(self, node):
+        self.generic_visit(node)
+        self.changed = True
+        helper = ("convert_logical_and"
+                  if isinstance(node.op, ast.And)
+                  else "convert_logical_or")
+        expr = node.values[-1]
+        for val in reversed(node.values[:-1]):
+            expr = ast.Call(
+                func=_dy2st_attr(helper),
+                args=[ast.Lambda(args=_empty_args(), body=val),
+                      ast.Lambda(args=_empty_args(), body=expr)],
+                keywords=[])
+        return expr
+
+    def visit_UnaryOp(self, node):
+        self.generic_visit(node)
+        if isinstance(node.op, ast.Not):
+            self.changed = True
+            return ast.Call(func=_dy2st_attr("convert_logical_not"),
+                            args=[node.operand], keywords=[])
+        return node
+
+
+def convert_to_static(fn: Callable) -> Callable:
+    """AST-convert a function's control flow; returns the original on any
+    failure (graph-break fallback). Results are cached per function."""
+    if fn in _CONVERTED:
+        return _CONVERTED[fn]
+    try:
+        # re-exec'ing at module scope loses the __class__ cell (no-arg
+        # super()) and class-body name mangling — bail for such functions
+        if "__class__" in fn.__code__.co_freevars:
+            raise ValueError("uses zero-arg super()/__class__")
+        src = textwrap.dedent(inspect.getsource(fn))
+        tree = ast.parse(src)
+        fdef = tree.body[0]
+        if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            raise ValueError("not a function definition")
+        fdef.decorator_list = []  # avoid re-applying @to_static etc.
+        tree = _EarlyReturnMerger().visit(tree)
+        transformer = _ControlFlowTransformer()
+        new_tree = transformer.visit(tree)
+        if not transformer.changed:
+            # nothing to convert: keep the original function (original
+            # closure/__class__ cells, zero recompilation risk)
+            raise _Unchanged()
+        ast.fix_missing_locations(new_tree)
+        code = compile(new_tree, filename=f"<dy2static {fn.__name__}>",
+                       mode="exec")
+        import paddle_tpu.jit.dy2static as _self
+        glb = dict(fn.__globals__)
+        glb["__dy2st"] = _self
+        if fn.__closure__:
+            # closure cells SHADOW same-named module globals
+            for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+                try:
+                    glb[name] = cell.cell_contents
+                except ValueError:
+                    pass
+        ns: dict = {}
+        exec(code, glb, ns)
+        converted = ns[fdef.name]
+        converted = functools.wraps(fn)(converted)
+        converted.__dy2static_converted__ = True
+    except Exception:
+        converted = fn
+    _CONVERTED[fn] = converted
+    return converted

@@ -52,10 +52,16 @@ def save(layer, path: str, input_spec: Optional[Sequence] = None, **configs):
     params, buffers = net.raw_state()
     net.eval()
 
+    # AST-convert the forward so tensor-dependent control flow exports
+    # as lax.cond/while_loop instead of failing under tracing (same pass
+    # StaticFunction._build applies)
+    from .dy2static import convert_to_static
+    fwd = convert_to_static(type(net).forward)
+
     def infer_fn(params_, buffers_, *inputs):
         wrapped = [Tensor(a) for a in inputs]
         with net.bind_state(params_, buffers_):
-            out = net(*wrapped)
+            out = fwd(net, *wrapped)
         return _unwrap_tree(out)
 
     avals = [_spec_to_aval(s) for s in specs]

@@ -61,7 +61,14 @@ class StaticFunction:
 
     def _build(self):
         layer = self._layer
-        fn = self._fn
+        # AST pass first (dy2static.py): tensor-dependent if/while/for
+        # become lax.cond/while_loop instead of tracer errors; returns
+        # the original fn unchanged when conversion isn't possible
+        if not getattr(self._fn, "_not_to_static", False):
+            from .dy2static import convert_to_static
+            fn = convert_to_static(self._fn)
+        else:
+            fn = self._fn
 
         if layer is not None:
             def pure(params, buffers, training, *arg_arrays):

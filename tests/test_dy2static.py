@@ -1,0 +1,241 @@
+"""Dy2Static AST conversion (reference: python/paddle/jit/dy2static —
+if/while/for → cond/while_loop ops; here → lax.cond/while_loop under
+tracing, plain Python when eager)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit
+from paddle_tpu.jit.dy2static import convert_to_static
+
+
+def test_tensor_if_compiles_under_jit():
+    @jit.to_static
+    def f(x):
+        if x.sum() > 0:
+            y = x * 2
+        else:
+            y = x - 1
+        return y
+
+    xp = paddle.to_tensor(np.array([1.0, 2.0], np.float32))
+    xn = paddle.to_tensor(np.array([-1.0, -2.0], np.float32))
+    np.testing.assert_allclose(f(xp).numpy(), [2.0, 4.0])
+    np.testing.assert_allclose(f(xn).numpy(), [-2.0, -3.0])
+
+
+def test_if_without_else_branch():
+    @jit.to_static
+    def f(x):
+        y = x + 1
+        if x.sum() > 0:
+            y = y * 10
+        return y
+
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.ones(2, np.float32))).numpy(), [20.0, 20.0])
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(-np.ones(2, np.float32))).numpy(), [0.0, 0.0])
+
+
+def test_tensor_while_loop_under_jit():
+    @jit.to_static
+    def f(x):
+        s = x * 0
+        while s.sum() < 10:
+            s = s + x
+        return s
+
+    out = f(paddle.to_tensor(np.array([1.0, 1.5], np.float32)))
+    assert float(out.sum()) >= 10
+
+
+def test_for_range_tensor_carry():
+    @jit.to_static
+    def f(x):
+        acc = x * 0
+        for i in range(4):
+            acc = acc + x
+        return acc
+
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.array([2.0], np.float32))).numpy(), [8.0])
+
+
+def test_nested_if_in_loop():
+    @jit.to_static
+    def f(x):
+        acc = x * 0
+        for i in range(3):
+            if acc.sum() > 2:
+                acc = acc + x * 2
+            else:
+                acc = acc + x
+        return acc
+
+    # i=0: acc=1; i=1: acc=2; i=2: acc=3 (sum 2 not > 2)... -> 3
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.array([1.0], np.float32))).numpy(), [3.0])
+
+
+def test_eager_python_semantics_preserved():
+    """Concrete predicates keep exact Python behavior (incl. early
+    return, which the converter leaves untouched)."""
+    def f(x, flag):
+        if flag:
+            return x + 1
+        return x - 1
+
+    g = convert_to_static(f)
+    x = paddle.to_tensor(np.zeros(2, np.float32))
+    np.testing.assert_allclose(g(x, True).numpy(), [1.0, 1.0])
+    np.testing.assert_allclose(g(x, False).numpy(), [-1.0, -1.0])
+
+
+def test_conversion_fallback_on_unsupported():
+    src_less = eval("lambda x: x + 1")
+    g = convert_to_static(src_less)  # lambda body IS retrievable...
+    x = paddle.to_tensor(np.ones(2, np.float32))
+    np.testing.assert_allclose(g(x).numpy(), [2.0, 2.0])
+
+
+def test_bool_ops_on_traced_tensors():
+    @jit.to_static
+    def f(x):
+        if (x.sum() > 0) and (x.max() < 10):
+            return x * 2
+        return x
+
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.array([1.0], np.float32))).numpy(), [2.0])
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.array([20.0], np.float32))).numpy(), [20.0])
+
+
+def test_gradients_through_converted_cond():
+    from paddle_tpu.jit.functional import value_and_grad
+
+    class Net(paddle.nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.lin = paddle.nn.Linear(2, 2)
+
+        def forward(self, x):
+            h = self.lin(x)
+            if h.sum() > 0:
+                return h * 2
+            return h
+
+    net = Net()
+    sf = jit.to_static(net)
+    x = paddle.to_tensor(np.ones((1, 2), np.float32))
+    out = sf(x)
+    assert out.shape == [1, 2]
+
+
+def test_converted_marker_and_cache():
+    def f(x):
+        if x.sum() > 0:
+            y = x
+        else:
+            y = -x
+        return y
+
+    g1 = convert_to_static(f)
+    g2 = convert_to_static(f)
+    assert g1 is g2
+    assert getattr(g1, "__dy2static_converted__", False)
+
+
+def test_comprehension_targets_not_branch_vars():
+    @jit.to_static
+    def f(x):
+        if x.sum() > 0:
+            ys = sum([x * k for k in (1, 2)])
+        else:
+            ys = x
+        return ys
+
+    np.testing.assert_allclose(
+        f(paddle.to_tensor(np.array([1.0], np.float32))).numpy(), [3.0])
+
+
+def test_zero_arg_super_falls_back():
+    class Base(paddle.nn.Layer):
+        def forward(self, x):
+            return x + 1
+
+    class Child(Base):
+        def forward(self, x):
+            return super().forward(x) * 2
+
+    out = jit.to_static(Child())(
+        paddle.to_tensor(np.ones(2, np.float32)))
+    np.testing.assert_allclose(out.numpy(), [4.0, 4.0])
+
+
+def test_closure_shadows_global():
+    def factory(scale):
+        def f(x):
+            if x.sum() > 0:
+                y = x * scale
+            else:
+                y = x
+            return y
+        return f
+
+    g = convert_to_static(factory(3.0))
+    np.testing.assert_allclose(
+        g(paddle.to_tensor(np.array([2.0], np.float32))).numpy(), [6.0])
+
+
+def test_no_control_flow_keeps_original_function():
+    def f(x):
+        return x * 2
+
+    assert convert_to_static(f) is f
+
+
+def test_attribute_store_branch_not_converted():
+    class Holder:
+        pass
+
+    h = Holder()
+
+    def f(x, flag):
+        if flag:
+            h.val = 1
+        else:
+            h.val = 2
+        return x
+
+    g = convert_to_static(f)
+    g(paddle.to_tensor(np.ones(1, np.float32)), True)
+    assert h.val == 1
+    g(paddle.to_tensor(np.ones(1, np.float32)), False)
+    assert h.val == 2
+
+
+def test_undefined_var_use_raises():
+    def f(x, flag):
+        if flag:
+            y = x + 1
+        return y  # unbound when flag is False
+
+    g = convert_to_static(f)
+    x = paddle.to_tensor(np.ones(1, np.float32))
+    np.testing.assert_allclose(g(x, True).numpy(), [2.0])
+    with pytest.raises(NameError):
+        float(g(x, False).sum())
+
+
+def test_walrus_condition_left_as_python():
+    def f(xs):
+        it = iter(xs)
+        total = 0.0
+        while (v := next(it, None)) is not None:
+            total = total + v
+        return total
+
+    g = convert_to_static(f)
+    assert g([1.0, 2.0, 3.0]) == 6.0

@@ -201,6 +201,7 @@ def test_lbfgs_quadratic():
     rng = np.random.RandomState(0)
     x = paddle.to_tensor(rng.randn(8, 4).astype(np.float32))
     b = paddle.to_tensor(rng.randn(8, 2).astype(np.float32))
+    paddle.seed(7)  # layer init must not depend on suite-order RNG state
     lin = paddle.nn.Linear(4, 2)
     opt = paddle.optimizer.LBFGS(learning_rate=0.5, max_iter=30,
                                  parameters=lin.parameters(),
