@@ -1,0 +1,33 @@
+"""Run every BASELINE config benchmark; one JSON line each
+(BASELINE.md: 'performance baselines must be produced by our own
+measurement harness'). Each script is standalone; failures don't stop
+the rest."""
+import os
+import subprocess
+import sys
+
+SCRIPTS = ["bench_resnet50.py", "bench_bert_dp.py", "bench_gpt_hybrid.py",
+           "bench_ernie_zero3.py", "bench_ppyoloe_infer.py"]
+
+
+def main():
+    here = os.path.dirname(os.path.abspath(__file__))
+    for s in SCRIPTS:
+        r = subprocess.run([sys.executable, os.path.join(here, s)],
+                           capture_output=True, text=True, timeout=1800,
+                           env=dict(os.environ,
+                                    PYTHONPATH=os.pathsep.join(
+                                        [os.path.dirname(here)] +
+                                        os.environ.get("PYTHONPATH", "")
+                                        .split(os.pathsep))))
+        for line in r.stdout.splitlines():
+            if line.startswith("{"):
+                print(line)
+        if r.returncode != 0:
+            print(f'{{"metric": "{s} FAILED", "value": null, '
+                  f'"unit": "", "vs_baseline": null}}')
+            sys.stderr.write(r.stderr[-2000:] + "\n")
+
+
+if __name__ == "__main__":
+    main()

@@ -138,14 +138,27 @@ class TrainStep:
         # ensure accumulators exist with correct shapes before first trace
         if all(not v for v in opt_state.values()):
             with no_grad():
-                for n, p in named.items():
-                    if not p.stop_gradient:
-                        # warm-init state slots on a sacrificial copy of the
-                        # param (the update rules donate their param buffer)
-                        real = p._data
-                        p._data = jnp.copy(real)
-                        opt._update_param(p, jnp.zeros_like(real))
-                        p._data = real
+                # run a sacrificial update so every _acc() slot is CREATED
+                # with its optimizer-defined init, while a stubbed
+                # _set_acc discards the update's outputs — the warm update
+                # runs at _step_count=0 where Adam-family bias correction
+                # divides by 1-beta^0 == 0, so its results (NaN master
+                # weights under AMP-O2, advanced NAdam/Rprop schedules)
+                # must never be stored.
+                opt._set_acc = lambda p, name, value: None
+                try:
+                    # disable_jit: the update rules' inner jits donate
+                    # their slot buffers — running them eagerly keeps the
+                    # freshly _acc()-created slot arrays alive
+                    with jax.disable_jit(), no_grad():
+                        for n, p in named.items():
+                            if not p.stop_gradient:
+                                real = p._data
+                                p._data = jnp.copy(real)
+                                opt._update_param(p, jnp.zeros_like(real))
+                                p._data = real
+                finally:
+                    del opt.__dict__["_set_acc"]  # back to the class method
             opt_state = {p.name: dict(opt._accumulators.get(p.name, {}))
                          for p in named.values()}
         opt._step_count += 1

@@ -159,9 +159,13 @@ class Rprop(Optimizer):
         g = g.astype(jnp.float32)
         prev = self._acc(p, "prev_grad",
                          init=jnp.zeros(p._data.shape, jnp.float32))
+        # init must stay traceable: inside a jitted TrainStep the lr is
+        # a tracer and float() would concretize (the expression evaluates
+        # even when the slot already exists)
         step = self._acc(p, "step_size",
                          init=jnp.full(p._data.shape,
-                                       float(self.get_lr()), jnp.float32))
+                                       jnp.asarray(self.get_lr(),
+                                                   jnp.float32)))
         sign = jnp.sign(g * prev)
         step2 = jnp.clip(
             jnp.where(sign > 0, step * self._eta_pos,

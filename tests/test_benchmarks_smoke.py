@@ -1,0 +1,96 @@
+"""Smoke: every BASELINE-config benchmark script runs in CPU mode and
+prints a well-formed JSON metric line, and the TrainStep AMP-O2 path they
+depend on stays finite (regression: warm-init at step 0 used to divide
+by 1-beta^0 and poison bf16 master weights with NaN)."""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("script", [
+    "bench_resnet50.py", "bench_bert_dp.py", "bench_gpt_hybrid.py",
+    "bench_ernie_zero3.py", "bench_ppyoloe_infer.py",
+])
+def test_benchmark_script_smoke(script):
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               XLA_FLAGS="--xla_force_host_platform_device_count=8",
+               PYTHONPATH=os.pathsep.join(
+                   [HERE] + os.environ.get("PYTHONPATH", "")
+                   .split(os.pathsep)))
+    r = subprocess.run(
+        [sys.executable, os.path.join(HERE, "benchmarks", script)],
+        capture_output=True, text=True, timeout=900, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
+    assert lines, r.stdout
+    for line in lines:
+        rec = json.loads(line)
+        assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
+        assert rec["value"] is not None and np.isfinite(rec["value"])
+
+
+def test_trainstep_amp_o2_master_weights_finite():
+    """bf16-decorated AdamW through TrainStep must not NaN: the
+    warm-init previously ran the update at _step_count=0 (bias
+    correction 1-beta^0 == 0) and stored NaN master weights."""
+    import jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.functional import TrainStep
+
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(8, 16),
+                               paddle.nn.ReLU(),
+                               paddle.nn.Linear(16, 2))
+    opt = paddle.optimizer.AdamW(learning_rate=1e-3,
+                                 parameters=net.parameters())
+    net, opt = paddle.amp.decorate(models=net, optimizers=opt,
+                                   level="O2", dtype="bfloat16")
+    step = TrainStep(net, opt, paddle.nn.CrossEntropyLoss())
+    rng = np.random.RandomState(0)
+    x = paddle.to_tensor(rng.rand(4, 8).astype(np.float32))
+    y = paddle.to_tensor(np.array([0, 1, 0, 1]))
+    losses = []
+    for _ in range(6):
+        with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+            losses.append(float(step(x, y).numpy()))
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0]
+    for _, p in net.named_parameters():
+        assert bool(jnp.isfinite(p._data).all())
+    for slots in opt._accumulators.values():
+        for name, arr in slots.items():
+            assert bool(jnp.isfinite(arr).all()), name
+
+
+def test_trainstep_preserves_nonzero_slot_inits():
+    """Warm-init must not overwrite optimizer-defined slot inits (NAdam
+    mu_prod starts at 1, Rprop step_size at lr, Adagrad moment at the
+    initial accumulator value)."""
+    import paddle_tpu as paddle
+
+    def first_slots(opt_cls, **kw):
+        from paddle_tpu.jit.functional import TrainStep
+        paddle.seed(0)
+        net = paddle.nn.Linear(4, 2)
+        opt = opt_cls(parameters=net.parameters(), **kw)
+        step = TrainStep(net, opt, paddle.nn.CrossEntropyLoss())
+        x = paddle.to_tensor(np.ones((2, 4), np.float32))
+        y = paddle.to_tensor(np.array([0, 1]))
+        l0 = float(step(x, y).numpy())
+        for _ in range(4):
+            l1 = float(step(x, y).numpy())
+        assert np.isfinite(l1) and l1 < l0, (opt_cls.__name__, l0, l1)
+        return opt
+
+    opt = first_slots(paddle.optimizer.NAdam, learning_rate=0.05)
+    for slots in opt._accumulators.values():
+        assert float(np.asarray(slots["mu_prod"])) > 0  # never zeroed
+    first_slots(paddle.optimizer.Rprop, learning_rate=0.01)
+    first_slots(paddle.optimizer.Adagrad, learning_rate=0.1,
+                initial_accumulator_value=0.5)
