@@ -66,14 +66,51 @@ def reshard(x: Tensor, mesh: ProcessMesh,
             "resharding TO a Partial placement is not supported (matches "
             "the reference, which only supports partial as a source)")
     sharding = named_sharding(mesh, placements)
-    if isinstance(x._data, jax.core.Tracer):
-        arr = jax.lax.with_sharding_constraint(x._data, sharding)
-        out = Tensor(arr, stop_gradient=x.stop_gradient)
+
+    # p->r / p->s: reduce the pending partial terms over the partial mesh
+    # axes first (reference p_to_r/p_to_s reshard functions; each replica
+    # holds a partial contribution, so the reduce combines them)
+    src = getattr(x, "_dist_placements", None)
+    partials = [(mesh.dim_names[i], p.reduce_type)
+                for i, p in enumerate(src or [])
+                if isinstance(p, Partial)] if src is not None else []
+
+    def transfer(a):
+        if partials:
+            from .placements import placements_to_spec
+            nonpartial = [Replicate() if isinstance(p, Partial) else p
+                          for p in src]
+            spec = placements_to_spec(mesh, nonpartial)
+
+            def reduce_local(b):
+                for ax, rt in partials:
+                    if rt == "sum":
+                        b = jax.lax.psum(b, ax)
+                    elif rt == "avg":
+                        b = jax.lax.pmean(b, ax)
+                    elif rt == "max":
+                        b = jax.lax.pmax(b, ax)
+                    elif rt == "min":
+                        b = jax.lax.pmin(b, ax)
+                    else:
+                        raise NotImplementedError(
+                            f"partial reduce_type {rt!r}")
+                return b
+
+            a = jax.shard_map(reduce_local, mesh=mesh.jax_mesh(),
+                              in_specs=(spec,), out_specs=spec,
+                              check_vma=False)(a)
+        if isinstance(a, jax.core.Tracer):
+            return jax.lax.with_sharding_constraint(a, sharding)
+        return jax.device_put(a, sharding)
+
+    if isinstance(x._data, jax.core.Tracer) or x.stop_gradient:
+        out = Tensor(transfer(x._data), stop_gradient=x.stop_gradient)
     else:
-        arr = jax.device_put(x._data, sharding)
-        out = Tensor(arr, stop_gradient=x.stop_gradient)
-        out.grad_node = x.grad_node
-        out._out_idx = x._out_idx
+        # record the transition on the tape so gradients reshard back
+        # (the reference registers a grad per reshard function)
+        from ..framework.tensor import apply_op
+        out = apply_op(transfer, x, _op_name="reshard")
     out._dist_mesh = mesh
     out._dist_placements = list(placements)
     return out

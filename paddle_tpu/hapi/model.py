@@ -33,9 +33,22 @@ class Model:
 
     # -- setup ------------------------------------------------------------
     def prepare(self, optimizer=None, loss=None, metrics=None,
-                amp_configs=None):
+                amp_configs=None, jit=None):
+        """jit=None (auto): on accelerators the train step runs as ONE
+        jitted program (forward+grad+update — the reference's to_static
+        Engine path); on CPU it stays eager like reference dygraph. Pass
+        jit=True/False to force either. Eager fallback also covers
+        update=False micro-accumulation."""
         self._optimizer = optimizer
         self._loss = loss
+        if jit is None:
+            import jax
+            jit = jax.default_backend() not in ("cpu",)
+        self._jit_pref = bool(jit)
+        self._use_jit = self._jit_pref and optimizer is not None \
+            and loss is not None
+        self._jit_step = None
+        self._jit_fwd = None
         if metrics is None:
             self._metrics = []
         elif isinstance(metrics, Metric):
@@ -48,6 +61,20 @@ class Model:
         self.network.train()
         inputs = _to_list(inputs)
         labels = _to_list(labels)
+        if getattr(self, "_use_jit", False) and update \
+                and not self._pending_grads():
+            from ..jit.functional import TrainStep
+            if self._jit_step is None or \
+                    self._jit_step.num_labels != len(labels):
+                self._jit_step = TrainStep(self.network, self._optimizer,
+                                           self._loss,
+                                           return_outputs=True,
+                                           num_labels=len(labels))
+            _, outs, comps = self._jit_step(*(inputs + labels))
+            for m in self._metrics:
+                m.update(m.compute(outs[0], *labels))
+            return [float(c) for c in comps], \
+                [m.accumulate() for m in self._metrics]
         outputs = self.network(*inputs)
         outs = _to_list(outputs)
         losses = self._loss(*(outs + labels))
@@ -65,12 +92,29 @@ class Model:
         return [float(l) for l in loss_list], \
             [m.accumulate() for m in self._metrics]
 
+    def _pending_grads(self) -> bool:
+        """True when eager update=False batches left accumulated grads —
+        the jitted step computes fresh grads and would drop them, so
+        finish the micro-batch group on the eager path."""
+        return any(p.grad is not None
+                   for p in self.network.parameters()
+                   if not p.stop_gradient)
+
+    def _forward(self, *inputs):
+        """Eval/predict forward; one jitted program when jit is on."""
+        if getattr(self, "_jit_pref", False):
+            if self._jit_fwd is None:
+                from .. import jit as _jit
+                self._jit_fwd = _jit.to_static(self.network)
+            return self._jit_fwd(*inputs)
+        return self.network(*inputs)
+
     @no_grad()
     def eval_batch(self, inputs, labels=None):
         self.network.eval()
         inputs = _to_list(inputs)
         labels = _to_list(labels)
-        outs = _to_list(self.network(*inputs))
+        outs = _to_list(self._forward(*inputs))
         losses = _to_list(self._loss(*(outs + labels))) if self._loss \
             else []
         for m in self._metrics:
@@ -81,7 +125,7 @@ class Model:
     @no_grad()
     def predict_batch(self, inputs):
         self.network.eval()
-        outs = self.network(*_to_list(inputs))
+        outs = self._forward(*_to_list(inputs))
         return _to_list(outs)
 
     # -- loops ------------------------------------------------------------

@@ -29,14 +29,25 @@ class IterableDataset(Dataset):
 
 
 class TensorDataset(Dataset):
+    """Samples are row slices of the given tensors (reference
+    io/dataset.py TensorDataset). The tensor VALUES are snapshotted to
+    host memory at construction: per-sample device slicing would
+    dispatch one program per sample on an accelerator, making the data
+    pipeline the bottleneck; host rows collate into one upload per
+    batch."""
+
     def __init__(self, tensors: Sequence):
         lens = {t.shape[0] for t in tensors}
         if len(lens) != 1:
             raise ValueError("all tensors must share dim 0")
         self.tensors = list(tensors)
+        import numpy as _np
+        self._host = [_np.asarray(getattr(t, "_data", t))
+                      for t in self.tensors]
 
     def __getitem__(self, idx):
-        return tuple(t[idx] for t in self.tensors)
+        from ..framework.tensor import Tensor
+        return tuple(Tensor(h[idx]) for h in self._host)
 
     def __len__(self):
         return self.tensors[0].shape[0]

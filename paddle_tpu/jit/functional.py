@@ -42,11 +42,14 @@ def functional_call(layer: Layer, params: Dict[str, jax.Array],
     return out_arr, new_buffers
 
 
-def value_and_grad(layer: Layer, loss_fn: Callable):
-    """Build fn(params, buffers, *batch) -> ((loss, new_buffers), grads).
+def value_and_grad(layer: Layer, loss_fn: Callable,
+                   return_outputs: bool = False):
+    """Build fn(params, buffers, *batch) -> ((loss, aux), grads) where
+    aux is new_buffers, or (new_buffers, outputs) with return_outputs.
 
     loss_fn receives (output_tensor(s), *batch_labels_tensors) and must
-    return a scalar Tensor. Differentiates w.r.t. params only.
+    return a scalar Tensor (or a list whose entries are summed).
+    Differentiates w.r.t. params only.
     """
     def compute(params, buffers, inputs, labels):
         out_arr, new_buffers = functional_call(layer, params, buffers,
@@ -55,7 +58,13 @@ def value_and_grad(layer: Layer, loss_fn: Callable):
         out_tensors = [Tensor(o, stop_gradient=True) for o in outs]
         label_tensors = [Tensor(l, stop_gradient=True) for l in labels]
         loss = loss_fn(*(out_tensors + label_tensors))
-        return loss._data, new_buffers
+        comps = loss if isinstance(loss, (tuple, list)) else [loss]
+        total = comps[0]
+        for extra in comps[1:]:
+            total = total + extra
+        aux = ((new_buffers, outs, tuple(c._data for c in comps))
+               if return_outputs else new_buffers)
+        return total._data, aux
 
     return jax.value_and_grad(compute, argnums=0, has_aux=True)
 
@@ -74,11 +83,14 @@ class TrainStep:
     """
 
     def __init__(self, model: Layer, optimizer, loss_fn: Callable,
-                 donate: bool = True):
+                 donate: bool = True, return_outputs: bool = False,
+                 num_labels: int = 1):
         self.model = model
         self.optimizer = optimizer
         self.loss_fn = loss_fn
-        self._vg = value_and_grad(model, loss_fn)
+        self.return_outputs = return_outputs
+        self.num_labels = num_labels  # trailing batch entries -> loss_fn
+        self._vg = value_and_grad(model, loss_fn, return_outputs)
         self._jitted = None
         self._param_names = [n for n, _ in model.named_parameters()]
         self._donate = donate
@@ -88,8 +100,11 @@ class TrainStep:
         model = self.model
 
         def step(params, buffers, opt_state, lr, t, inputs, labels):
-            (loss, new_buffers), grads = self._vg(params, buffers, inputs,
-                                                  labels)
+            (loss, aux), grads = self._vg(params, buffers, inputs, labels)
+            if self.return_outputs:
+                new_buffers, outs, comps = aux
+            else:
+                new_buffers, outs, comps = aux, (), ()
             # run optimizer updates inside the trace
             named = dict(model.named_parameters())
             saved_acc = {k: dict(v) for k, v in opt._accumulators.items()}
@@ -121,7 +136,7 @@ class TrainStep:
                 opt.__dict__.pop("get_lr", None)
                 opt._accumulators = saved_acc
                 opt._step_count = saved_step
-            return loss, new_params, new_buffers, new_state
+            return loss, new_params, new_buffers, new_state, outs, comps
 
         donate = (0, 2) if self._donate else ()
         return jax.jit(step, donate_argnums=donate)
@@ -164,7 +179,8 @@ class TrainStep:
         opt._step_count += 1
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
         t = jnp.asarray(opt._step_count, jnp.int32)
-        loss, new_params, new_buffers, new_state = self._jitted(
+        loss, new_params, new_buffers, new_state, outs, comps = \
+            self._jitted(
             params, buffers, opt_state, lr, t,
             tuple(x._data if isinstance(x, Tensor) else x for x in inputs),
             tuple(y._data if isinstance(y, Tensor) else y for y in labels))
@@ -177,10 +193,15 @@ class TrainStep:
                     b._data = new_buffers[n]
             for pname, slots in new_state.items():
                 opt._accumulators[pname] = slots
-        return Tensor(loss, stop_gradient=True)
+        loss_t = Tensor(loss, stop_gradient=True)
+        if self.return_outputs:
+            return (loss_t,
+                    tuple(Tensor(o, stop_gradient=True) for o in outs),
+                    tuple(Tensor(c, stop_gradient=True) for c in comps))
+        return loss_t
 
-    @staticmethod
-    def _split(batch) -> Tuple[tuple, tuple]:
-        if len(batch) < 2:
+    def _split(self, batch) -> Tuple[tuple, tuple]:
+        n = min(self.num_labels, max(len(batch) - 1, 0))
+        if n == 0:
             return tuple(batch), ()
-        return tuple(batch[:-1]), (batch[-1],)
+        return tuple(batch[:-n]), tuple(batch[-n:])

@@ -166,3 +166,98 @@ def test_model_fit_evaluate_predict(tmp_path):
     assert preds[0].shape == (48, 3)
     model.save(str(tmp_path / "ckpt"))
     model.load(str(tmp_path / "ckpt"))
+
+
+def test_model_fit_jit_path_matches_eager():
+    """Model.prepare(jit=True) runs one jitted train step; losses must
+    track the eager path."""
+    from paddle_tpu.hapi import Model
+    from paddle_tpu.io import TensorDataset, DataLoader
+
+    rng = np.random.RandomState(0)
+    xs = rng.rand(16, 4).astype(np.float32)
+    ys = (xs.sum(1) > 2).astype(np.int64)
+
+    def run(jit):
+        paddle.seed(0)
+        net = nn.Sequential(nn.Linear(4, 8), nn.ReLU(), nn.Linear(8, 2))
+        m = Model(net)
+        m.prepare(optimizer=Adam(learning_rate=0.05,
+                                 parameters=net.parameters()),
+                  loss=nn.CrossEntropyLoss(),
+                  metrics=Accuracy(), jit=jit)
+        losses = []
+        for _ in range(4):
+            l, _ = m.train_batch(paddle.to_tensor(xs),
+                                 paddle.to_tensor(ys))
+            losses.append(l[0])
+        ev = m.eval_batch(paddle.to_tensor(xs), paddle.to_tensor(ys))
+        pred = m.predict_batch(paddle.to_tensor(xs))
+        assert pred[0].shape == [16, 2]
+        return losses, ev[0][0]
+
+    lj, ej = run(True)
+    le, ee = run(False)
+    np.testing.assert_allclose(lj, le, rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(ej, ee, rtol=1e-4, atol=1e-5)
+
+
+def test_model_jit_path_multi_label_and_multi_loss():
+    """jit path must honor multiple labels and per-component losses
+    (eager/jit parity of train_batch return shape)."""
+    from paddle_tpu.hapi import Model
+
+    class TwoHead(nn.Layer):
+        def __init__(self):
+            super(TwoHead, self).__init__()
+            self.a = nn.Linear(4, 2)
+            self.b = nn.Linear(4, 3)
+
+        def forward(self, x):
+            return self.a(x), self.b(x)
+
+    def loss_fn(o1, o2, y1, y2):
+        return [F.cross_entropy(o1, y1), F.cross_entropy(o2, y2)]
+
+    rng = np.random.RandomState(0)
+    xs = paddle.to_tensor(rng.rand(8, 4).astype(np.float32))
+    y1 = paddle.to_tensor((np.arange(8) % 2).astype(np.int64))
+    y2 = paddle.to_tensor((np.arange(8) % 3).astype(np.int64))
+
+    def run(jit):
+        paddle.seed(0)
+        net = TwoHead()
+        m = Model(net)
+        m.prepare(optimizer=Adam(learning_rate=0.05,
+                                 parameters=net.parameters()),
+                  loss=loss_fn, jit=jit)
+        return [m.train_batch([xs], [y1, y2])[0] for _ in range(3)]
+
+    lj = run(True)
+    le = run(False)
+    assert all(len(l) == 2 for l in lj)  # per-component losses kept
+    np.testing.assert_allclose(lj, le, rtol=1e-4, atol=1e-5)
+
+
+def test_model_jit_micro_accumulation_falls_back():
+    """update=False accumulation then update=True must use ALL batches
+    (jit path defers to eager when grads are pending)."""
+    from paddle_tpu.hapi import Model
+    rng = np.random.RandomState(0)
+    x1 = paddle.to_tensor(rng.rand(4, 4).astype(np.float32))
+    x2 = paddle.to_tensor(rng.rand(4, 4).astype(np.float32))
+    y = paddle.to_tensor(np.array([0, 1, 0, 1]))
+
+    def run(jit):
+        paddle.seed(0)
+        net = nn.Linear(4, 2)
+        m = Model(net)
+        m.prepare(optimizer=SGD(learning_rate=0.1,
+                                parameters=net.parameters()),
+                  loss=nn.CrossEntropyLoss(), jit=jit)
+        m.train_batch([x1], [y], update=False)
+        m.train_batch([x2], [y], update=True)
+        return net.weight.numpy().copy()
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-5,
+                               atol=1e-6)
