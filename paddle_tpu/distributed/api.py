@@ -69,9 +69,12 @@ def reshard(x: Tensor, mesh: ProcessMesh,
 
     # p->r / p->s: reduce the pending partial terms over the partial mesh
     # axes first (reference p_to_r/p_to_s reshard functions; each replica
-    # holds a partial contribution, so the reduce combines them)
+    # holds a partial contribution, so the reduce combines them). The
+    # reduce runs on the SOURCE mesh — that's where the contributions
+    # live — before any cross-mesh transfer.
     src = getattr(x, "_dist_placements", None)
-    partials = [(mesh.dim_names[i], p.reduce_type)
+    src_mesh = getattr(x, "_dist_mesh", None) or mesh
+    partials = [(src_mesh.dim_names[i], p.reduce_type)
                 for i, p in enumerate(src or [])
                 if isinstance(p, Partial)] if src is not None else []
 
@@ -80,7 +83,7 @@ def reshard(x: Tensor, mesh: ProcessMesh,
             from .placements import placements_to_spec
             nonpartial = [Replicate() if isinstance(p, Partial) else p
                           for p in src]
-            spec = placements_to_spec(mesh, nonpartial)
+            spec = placements_to_spec(src_mesh, nonpartial)
 
             def reduce_local(b):
                 for ax, rt in partials:
@@ -97,9 +100,13 @@ def reshard(x: Tensor, mesh: ProcessMesh,
                             f"partial reduce_type {rt!r}")
                 return b
 
-            a = jax.shard_map(reduce_local, mesh=mesh.jax_mesh(),
+            a = jax.shard_map(reduce_local, mesh=src_mesh.jax_mesh(),
                               in_specs=(spec,), out_specs=spec,
                               check_vma=False)(a)
+            if not isinstance(a, jax.core.Tracer) and \
+                    src_mesh is not mesh:
+                # detach from the source mesh before the cross-mesh put
+                a = jax.numpy.asarray(np.asarray(a))
         if isinstance(a, jax.core.Tracer):
             return jax.lax.with_sharding_constraint(a, sharding)
         return jax.device_put(a, sharding)

@@ -111,6 +111,53 @@ def interpolate(x, size=None, scale_factor=None, mode="nearest",
         method = {"nearest": "nearest", "bilinear": "bilinear",
                   "trilinear": "trilinear", "bicubic": "cubic",
                   "linear": "linear", "area": "linear"}[mode]
+        if align_corners and mode == "nearest":
+            raise ValueError(
+                "align_corners option can only be set with the "
+                "interpolating modes: linear | bilinear | bicubic | "
+                "trilinear")
+        if align_corners and mode in ("linear", "bilinear", "trilinear",
+                                      "bicubic"):
+            # corner-aligned sampling: out position i maps to input
+            # i*(in-1)/(out-1) (jax.image.resize only does half-pixel
+            # centers). Separable per spatial axis; bicubic uses the
+            # Keys cubic-convolution kernel (a=-0.75, the reference's).
+            for ax, out_s in zip(spatial_axes, out_sizes):
+                in_s = a.shape[ax]
+                if out_s == in_s:
+                    continue
+                if out_s == 1 or in_s == 1:
+                    a = jnp.take(a, jnp.zeros(out_s, jnp.int32), axis=ax)
+                    continue
+                pos = jnp.linspace(0.0, in_s - 1.0, out_s)
+                lo = jnp.floor(pos).astype(jnp.int32)
+                t = (pos - lo).astype(a.dtype)
+                shape = [1] * a.ndim
+                shape[ax] = out_s
+                t = t.reshape(shape)
+                if mode == "bicubic":
+                    A = -0.75
+
+                    def k1(u):  # |u| <= 1
+                        return ((A + 2) * u - (A + 3)) * u * u + 1
+
+                    def k2(u):  # 1 < |u| < 2
+                        return ((A * u - 5 * A) * u + 8 * A) * u - 4 * A
+
+                    taps, wts = [], []
+                    for off, ker, arg in ((-1, k2, lambda t: 1 + t),
+                                          (0, k1, lambda t: t),
+                                          (1, k1, lambda t: 1 - t),
+                                          (2, k2, lambda t: 2 - t)):
+                        idx = jnp.clip(lo + off, 0, in_s - 1)
+                        taps.append(jnp.take(a, idx, axis=ax))
+                        wts.append(ker(arg(t)))
+                    a = sum(tp * w for tp, w in zip(taps, wts))
+                else:
+                    hi = jnp.minimum(lo + 1, in_s - 1)
+                    a = jnp.take(a, lo, axis=ax) * (1 - t) + \
+                        jnp.take(a, hi, axis=ax) * t
+            return a
         return jax.image.resize(a, tuple(new_shape), method=method)
     return apply_op(f, x, _op_name="interpolate")
 

@@ -1,0 +1,293 @@
+"""OpTest-style coverage, part 2: nn.functional ops (conv/pool/norm/
+embedding/pad/interpolate), indexing mutations, and linalg vs
+scipy/numpy references (reference: test/legacy_test/test_conv2d_op.py,
+test_pool2d_op.py, test_batch_norm_op.py, test_linalg_*."""
+import numpy as np
+import pytest
+from scipy import linalg as sla
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+from op_test import check_op
+
+rng = np.random.RandomState(11)
+
+
+def _x(shape, lo=-1.0, hi=1.0):
+    return rng.uniform(lo, hi, shape).astype(np.float32)
+
+
+# -- conv / pool -----------------------------------------------------------
+
+def _np_conv2d(x, w, stride=1, padding=0):
+    N, C, Hi, Wi = x.shape
+    O, _, kh, kw = w.shape
+    if padding:
+        x = np.pad(x, ((0, 0), (0, 0), (padding, padding),
+                       (padding, padding)))
+    Ho = (x.shape[2] - kh) // stride + 1
+    Wo = (x.shape[3] - kw) // stride + 1
+    out = np.zeros((N, O, Ho, Wo), np.float32)
+    for i in range(Ho):
+        for j in range(Wo):
+            patch = x[:, :, i*stride:i*stride+kh, j*stride:j*stride+kw]
+            out[:, :, i, j] = np.einsum("nchw,ochw->no", patch, w)
+    return out
+
+
+@pytest.mark.parametrize("stride,padding", [(1, 0), (2, 1)])
+def test_conv2d_op(stride, padding):
+    x, w = _x((2, 3, 8, 8)), _x((4, 3, 3, 3))
+    got = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                   stride=stride, padding=padding)
+    np.testing.assert_allclose(got.numpy(),
+                               _np_conv2d(x, w, stride, padding),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_conv2d_grad_numeric():
+    x, w = _x((1, 2, 5, 5)), _x((3, 2, 3, 3))
+    check_op(lambda x, weight: F.conv2d(x, weight),
+             lambda x, weight: _np_conv2d(x, weight),
+             dict(x=x, weight=w), dtypes=("float32",), check_static=True,
+             grad_eps=1e-2, grad_rtol=8e-2, grad_atol=1e-2)
+
+
+def test_max_avg_pool2d():
+    x = _x((2, 3, 8, 8))
+    got = F.max_pool2d(paddle.to_tensor(x), kernel_size=2, stride=2)
+    ref = x.reshape(2, 3, 4, 2, 4, 2).max((3, 5))
+    np.testing.assert_allclose(got.numpy(), ref, rtol=1e-6)
+    got = F.avg_pool2d(paddle.to_tensor(x), kernel_size=2, stride=2)
+    ref = x.reshape(2, 3, 4, 2, 4, 2).mean((3, 5))
+    np.testing.assert_allclose(got.numpy(), ref, rtol=1e-6)
+
+
+def test_adaptive_avg_pool2d():
+    x = _x((2, 3, 8, 8))
+    got = F.adaptive_avg_pool2d(paddle.to_tensor(x), output_size=1)
+    np.testing.assert_allclose(got.numpy(),
+                               x.mean((2, 3), keepdims=True), rtol=1e-6)
+
+
+# -- norms -----------------------------------------------------------------
+
+def test_batch_norm_train_and_eval():
+    x = _x((8, 4, 3, 3))
+    bn = paddle.nn.BatchNorm2D(4)
+    bn.train()
+    out = bn(paddle.to_tensor(x))
+    m = x.mean((0, 2, 3))
+    v = x.var((0, 2, 3))
+    ref = (x - m[None, :, None, None]) / np.sqrt(
+        v[None, :, None, None] + 1e-5)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-4, atol=1e-4)
+    # running stats update toward batch stats
+    np.testing.assert_allclose(np.asarray(bn._mean._data), 0.1 * m,
+                               rtol=1e-4, atol=1e-5)
+    bn.eval()
+    out_e = bn(paddle.to_tensor(x))
+    assert not np.allclose(out_e.numpy(), out.numpy())
+
+
+def test_group_norm():
+    x = _x((2, 4, 4, 4))
+    got = F.group_norm(paddle.to_tensor(x), num_groups=2, epsilon=1e-5)
+    xr = x.reshape(2, 2, 2, 4, 4)
+    m = xr.mean((2, 3, 4), keepdims=True)
+    v = xr.var((2, 3, 4), keepdims=True)
+    ref = ((xr - m) / np.sqrt(v + 1e-5)).reshape(x.shape)
+    np.testing.assert_allclose(got.numpy(), ref, rtol=1e-4, atol=1e-4)
+
+
+def test_rms_norm_functional():
+    x = _x((3, 8))
+    w = np.ones(8, np.float32)
+    from paddle_tpu.incubate.nn.functional import fused_rms_norm
+    got = fused_rms_norm(paddle.to_tensor(x), paddle.to_tensor(w),
+                         epsilon=1e-6)
+    out = got[0] if isinstance(got, (tuple, list)) else got
+    ref = x / np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+    np.testing.assert_allclose(out.numpy(), ref, rtol=1e-5, atol=1e-5)
+
+
+# -- embedding / pad / interpolate ----------------------------------------
+
+def test_embedding_op_and_grad():
+    table = _x((10, 6))
+    idx = np.array([[1, 3], [7, 1]])
+    t = paddle.to_tensor(table, stop_gradient=False)
+    out = F.embedding(paddle.to_tensor(idx), t)
+    np.testing.assert_allclose(out.numpy(), table[idx], rtol=1e-6)
+    out.sum().backward()
+    g = np.zeros_like(table)
+    for i in idx.flatten():
+        g[i] += 1
+    np.testing.assert_allclose(np.asarray(t.grad._data), g)
+
+
+def test_pad_op():
+    x = _x((2, 3))
+    got = F.pad(paddle.to_tensor(x), [1, 2], value=5.0)
+    ref = np.pad(x, ((0, 0), (1, 2)), constant_values=5.0)
+    np.testing.assert_allclose(got.numpy(), ref)
+
+
+def test_interpolate_nearest_and_bilinear():
+    x = _x((1, 1, 4, 4))
+    got = F.interpolate(paddle.to_tensor(x), scale_factor=2,
+                        mode="nearest")
+    assert got.shape == [1, 1, 8, 8]
+    np.testing.assert_allclose(got.numpy()[0, 0, ::2, ::2], x[0, 0],
+                               rtol=1e-6)
+    got2 = F.interpolate(paddle.to_tensor(x), size=[2, 2],
+                         mode="bilinear", align_corners=True)
+    assert got2.shape == [1, 1, 2, 2]
+    np.testing.assert_allclose(got2.numpy()[0, 0, 0, 0], x[0, 0, 0, 0],
+                               rtol=1e-5)
+
+
+# -- indexing mutations ----------------------------------------------------
+
+def test_scatter_and_put_along_axis():
+    x = np.zeros((4, 3), np.float32)
+    idx = np.array([1, 3])
+    upd = _x((2, 3))
+    got = paddle.scatter(paddle.to_tensor(x), paddle.to_tensor(idx),
+                         paddle.to_tensor(upd))
+    ref = x.copy()
+    ref[idx] = upd
+    np.testing.assert_allclose(got.numpy(), ref)
+
+    a = _x((3, 4))
+    ia = np.array([[0, 1, 2, 0]])
+    va = np.full((1, 4), 9.0, np.float32)
+    got2 = paddle.put_along_axis(paddle.to_tensor(a),
+                                 paddle.to_tensor(ia),
+                                 paddle.to_tensor(va), axis=0)
+    ref2 = a.copy()
+    np.put_along_axis(ref2, ia, va, 0)
+    np.testing.assert_allclose(got2.numpy(), ref2)
+
+
+def test_index_select_masked_select():
+    x = _x((4, 3))
+    got = paddle.index_select(paddle.to_tensor(x),
+                              paddle.to_tensor(np.array([0, 2])), axis=0)
+    np.testing.assert_allclose(got.numpy(), x[[0, 2]])
+    mask = x > 0
+    got2 = paddle.masked_select(paddle.to_tensor(x),
+                                paddle.to_tensor(mask))
+    np.testing.assert_allclose(got2.numpy(), x[mask])
+
+
+def test_tril_triu_diag():
+    x = _x((4, 4))
+    np.testing.assert_allclose(paddle.tril(paddle.to_tensor(x)).numpy(),
+                               np.tril(x))
+    np.testing.assert_allclose(
+        paddle.triu(paddle.to_tensor(x), diagonal=1).numpy(),
+        np.triu(x, 1))
+    v = _x((4,))
+    np.testing.assert_allclose(paddle.diag(paddle.to_tensor(v)).numpy(),
+                               np.diag(v))
+
+
+# -- linalg ----------------------------------------------------------------
+
+def _spd(n):
+    a = _x((n, n))
+    return (a @ a.T + n * np.eye(n)).astype(np.float32)
+
+
+def test_linalg_inv_det_solve():
+    a = _spd(4)
+    np.testing.assert_allclose(
+        paddle.linalg.inv(paddle.to_tensor(a)).numpy(),
+        np.linalg.inv(a), rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(
+        float(paddle.linalg.det(paddle.to_tensor(a))),
+        np.linalg.det(a), rtol=1e-4)
+    b = _x((4, 2))
+    np.testing.assert_allclose(
+        paddle.linalg.solve(paddle.to_tensor(a),
+                            paddle.to_tensor(b)).numpy(),
+        np.linalg.solve(a, b), rtol=1e-4, atol=1e-4)
+
+
+def test_linalg_cholesky_qr_svd():
+    a = _spd(4)
+    L = paddle.linalg.cholesky(paddle.to_tensor(a)).numpy()
+    np.testing.assert_allclose(L @ L.T, a, rtol=1e-4, atol=1e-4)
+    m = _x((5, 3))
+    q, r = paddle.linalg.qr(paddle.to_tensor(m))
+    np.testing.assert_allclose(q.numpy() @ r.numpy(), m, rtol=1e-4,
+                               atol=1e-4)
+    u, s, vh = paddle.linalg.svd(paddle.to_tensor(m))
+    np.testing.assert_allclose(
+        u.numpy()[:, :3] * s.numpy() @ vh.numpy()[:3], m,
+        rtol=1e-4, atol=1e-4)
+
+
+def test_linalg_eigh_norm():
+    a = _spd(4)
+    w, v = paddle.linalg.eigh(paddle.to_tensor(a))
+    np.testing.assert_allclose(np.sort(w.numpy()),
+                               np.sort(np.linalg.eigvalsh(a)),
+                               rtol=1e-4)
+    x = _x((3, 4))
+    np.testing.assert_allclose(
+        float(paddle.linalg.norm(paddle.to_tensor(x))),
+        np.linalg.norm(x), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(paddle.linalg.cond(paddle.to_tensor(a))),
+        np.linalg.cond(a), rtol=1e-3)
+
+
+def test_einsum_forms():
+    a, b = _x((3, 4)), _x((4, 5))
+    np.testing.assert_allclose(
+        paddle.einsum("ij,jk->ik", paddle.to_tensor(a),
+                      paddle.to_tensor(b)).numpy(),
+        np.einsum("ij,jk->ik", a, b), rtol=1e-5)
+    c = _x((2, 3, 4))
+    np.testing.assert_allclose(
+        paddle.einsum("bij->bji", paddle.to_tensor(c)).numpy(),
+        np.einsum("bij->bji", c), rtol=1e-6)
+    np.testing.assert_allclose(
+        paddle.einsum("bij,bij->b", paddle.to_tensor(c),
+                      paddle.to_tensor(c)).numpy(),
+        np.einsum("bij,bij->b", c, c), rtol=1e-5)
+
+
+def test_bmm_mv_outer():
+    a, b = _x((2, 3, 4)), _x((2, 4, 5))
+    np.testing.assert_allclose(
+        paddle.bmm(paddle.to_tensor(a), paddle.to_tensor(b)).numpy(),
+        a @ b, rtol=1e-5)
+    m, v = _x((3, 4)), _x((4,))
+    np.testing.assert_allclose(
+        paddle.mv(paddle.to_tensor(m), paddle.to_tensor(v)).numpy(),
+        m @ v, rtol=1e-5)
+    u = _x((3,))
+    np.testing.assert_allclose(
+        paddle.outer(paddle.to_tensor(u), paddle.to_tensor(v)).numpy(),
+        np.outer(u, v), rtol=1e-6)
+
+
+def test_interpolate_bicubic_align_corners():
+    x = _x((1, 1, 4, 4))
+    got = F.interpolate(paddle.to_tensor(x), size=[7, 7], mode="bicubic",
+                        align_corners=True)
+    # corners preserved exactly under align_corners
+    np.testing.assert_allclose(got.numpy()[0, 0, 0, 0], x[0, 0, 0, 0],
+                               rtol=1e-5)
+    np.testing.assert_allclose(got.numpy()[0, 0, -1, -1], x[0, 0, -1, -1],
+                               rtol=1e-5)
+
+
+def test_interpolate_nearest_align_corners_rejected():
+    x = paddle.to_tensor(_x((1, 1, 4, 4)))
+    with pytest.raises(ValueError, match="align_corners"):
+        F.interpolate(x, scale_factor=2, mode="nearest",
+                      align_corners=True)

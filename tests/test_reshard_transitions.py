@@ -167,3 +167,18 @@ def test_transition_grad_flow(mesh1d):
     loss.backward()
     assert t.grad is not None
     np.testing.assert_allclose(np.asarray(t.grad._data), 2 * v)
+
+
+def test_cross_mesh_partial_reduction():
+    """Partial reduce must run on the SOURCE mesh before a cross-mesh
+    transfer (8 source contributions, not the target mesh size)."""
+    big = dist.ProcessMesh([0, 1, 2, 3, 4, 5, 6, 7], dim_names=["x"])
+    sub = dist.ProcessMesh([0, 1, 2, 3], dim_names=["x"])
+    v = _value()
+    dist.set_mesh(big)
+    try:
+        t = dist.shard_tensor(paddle.to_tensor(v), big, [Partial()])
+        out = dist.reshard(t, sub, [Replicate()])
+        np.testing.assert_allclose(np.asarray(out._data), 8 * v)
+    finally:
+        dist.set_mesh(None)
