@@ -7,7 +7,8 @@ import subprocess
 import sys
 
 SCRIPTS = ["bench_resnet50.py", "bench_bert_dp.py", "bench_gpt_hybrid.py",
-           "bench_ernie_zero3.py", "bench_ppyoloe_infer.py"]
+           "bench_ernie_zero3.py", "bench_ppyoloe_infer.py",
+           "bench_llama_decode.py"]
 
 
 def main():

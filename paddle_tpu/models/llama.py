@@ -12,6 +12,7 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -116,8 +117,9 @@ class LlamaAttention(Layer):
         (out, (k_cache', v_cache')) — the serving decode path."""
         cfg = self.cfg
         b, t, _ = x.shape
+        static_cache = cache is not None and len(cache) == 3
         past = cache[0].shape[1] if cache is not None \
-            and cache[0] is not None else 0
+            and not static_cache and cache[0] is not None else 0
         if past + t > cfg.max_position_embeddings:
             raise ValueError(
                 f"sequence length {past + t} exceeds "
@@ -131,6 +133,16 @@ class LlamaAttention(Layer):
         q = q.reshape([b, t, h_local, D])
         k = k.reshape([b, t, kv_local, D])
         v = v.reshape([b, t, kv_local, D])
+        if static_cache:
+            # STATIC cache: (k_cache, v_cache, pos) with fixed [B, Tmax]
+            # buffers and a (possibly traced) write position — the
+            # compile-once serving decode path (one program per step
+            # instead of a shape-changing concat per token).
+            if attn_mask is not None:
+                raise NotImplementedError(
+                    "attn_mask with KV cache is not supported; pad-free "
+                    "batches only in cached decoding")
+            return self._forward_static_cache(x, q, k, v, cache)
         cos, sin = self._cos[past:past + t], self._sin[past:past + t]
         q = apply_op(lambda a: _apply_rope(a, cos, sin), q,
                      _op_name="rope_q")
@@ -175,6 +187,60 @@ class LlamaAttention(Layer):
                 q, k, v, is_causal=True, training=self.training)
         attn = attn.reshape([b, t, h_local * D])
         return self.o_proj(attn)
+
+
+    def _forward_static_cache(self, x, q, k, v, cache):
+        """Fixed-size cache attention: write the new k/v block at ``pos``
+        (dynamic_update_slice), attend over the masked full buffer.
+        q/k/v arrive reshaped [b, t, heads_local, D]; cache =
+        (k_cache [b, Tmax, KV, D], v_cache, pos scalar)."""
+        cfg = self.cfg
+        b, t, h_local, D = (x.shape[0], q.shape[1], q.shape[2],
+                            cfg.head_dim)
+        kv_local = k.shape[2]
+        k_cache, v_cache, pos = cache
+        Tmax = k_cache.shape[1]
+        concrete_pos = pos if isinstance(pos, int) else (
+            None if isinstance(getattr(pos, "_data", pos),
+                               jax.core.Tracer)
+            else int(np.asarray(getattr(pos, "_data", pos))))
+        if concrete_pos is not None and concrete_pos + t > Tmax:
+            # dynamic_update_slice would silently clamp and corrupt the
+            # cache tail — fail loudly while the position is checkable
+            raise ValueError(
+                f"static cache overflow: pos {concrete_pos} + {t} new "
+                f"tokens exceeds cache length {Tmax}")
+        cos_full, sin_full = self._cos, self._sin
+        rep = h_local // kv_local
+
+        def f(q, k, v, kc, vc, p):
+            p = jnp.asarray(p, jnp.int32)
+            cos = jax.lax.dynamic_slice_in_dim(cos_full, p, t)
+            sin = jax.lax.dynamic_slice_in_dim(sin_full, p, t)
+            qr = _apply_rope(q, cos, sin)
+            kr = _apply_rope(k, cos, sin)
+            kc = jax.lax.dynamic_update_slice(
+                kc, kr.astype(kc.dtype), (0, p, 0, 0))
+            vc = jax.lax.dynamic_update_slice(
+                vc, v.astype(vc.dtype), (0, p, 0, 0))
+            # GQA without materializing a head-repeated cache copy: fold
+            # the query group dim into the einsum against kv-head caches
+            qg = qr.reshape(b, t, kv_local, rep, D)
+            scores = jnp.einsum("bqgrd,bkgd->bgrqk",
+                                qg.astype(jnp.float32),
+                                kc.astype(jnp.float32)) / (D ** 0.5)
+            qpos = p + jnp.arange(t)[:, None]          # [t, 1]
+            kpos = jnp.arange(Tmax)[None, :]           # [1, Tmax]
+            mask = kpos <= qpos                        # causal over buffer
+            scores = jnp.where(mask[None, None, None], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+            out = jnp.einsum("bgrqk,bkgd->bqgrd", probs,
+                             vc.astype(q.dtype))
+            return out.reshape(b, t, h_local * D), kc, vc
+
+        out, kc2, vc2 = apply_op(f, q, k, v, k_cache, v_cache, pos,
+                                 _op_name="static_cache_attn")
+        return self.o_proj(out), (kc2, vc2, pos + t)
 
 
 class LlamaMLP(Layer):
@@ -289,10 +355,17 @@ class LlamaForCausalLM(Layer):
 
     def generate(self, input_ids, max_new_tokens: int = 16,
                  temperature: float = 0.0, top_p: float = 1.0,
-                 use_cache: bool = True):
-        """Greedy / nucleus decoding. With ``use_cache`` (default) each
-        step attends cached K/V and computes only the new token —
-        O(T) per step instead of re-running the full context."""
+                 use_cache="static"):
+        """Greedy / nucleus decoding.
+
+        use_cache:
+          - True / "static" (default): compile-once serving path — one
+            jitted prefill program + one jitted decode-step program over
+            fixed-size KV buffers written at the current position.
+          - "dynamic": concat-grown KV cache, one trace per length
+            (numerics reference; also used automatically under tracing).
+          - False: no cache, full-context recompute per token.
+        """
         import paddle_tpu as paddle
         from ..ops.manipulation import concat
         ids = input_ids
@@ -314,9 +387,14 @@ class LlamaForCausalLM(Layer):
                 ids = concat([ids, nxt], axis=1)
             return ids
 
-        # prefill through the model's own cache path: (None, None) makes
-        # each layer seed its cache with ITS local k/v (correct head
-        # count and dtype under tensor parallelism too)
+        if use_cache != "dynamic" and not isinstance(
+                ids._data, jax.core.Tracer):
+            return self._generate_static(ids, max_new_tokens, pick)
+
+        # dynamic-cache path (shape grows per step; kept for tracing and
+        # as the numerics reference): (None, None) makes each layer seed
+        # its cache with ITS local k/v (correct head count and dtype
+        # under tensor parallelism too)
         h, caches = self.llama(
             ids, caches=[(None, None)] * len(self.llama.layers))
         nxt = pick(self._head(h[:, -1:])[:, -1])
@@ -325,4 +403,62 @@ class LlamaForCausalLM(Layer):
             h, caches = self.llama(nxt, caches=caches)
             nxt = pick(self._head(h[:, -1:])[:, -1])
             ids = concat([ids, nxt], axis=1)
+        return ids
+
+    # -- compile-once serving decode --------------------------------------
+    def _decode_pure(self):
+        """One jitted program covering prefill (t=prompt) and decode
+        (t=1): runs the static-cache path and returns last-token logits
+        plus the updated fixed-size caches (donated)."""
+        if getattr(self, "_decode_jit", None) is not None:
+            return self._decode_jit
+        from ..framework.tensor import Tensor as _T
+
+        def pure(params, buffers, ids_arr, ks, vs, pos):
+            caches = [(_T(k), _T(v), _T(jnp.asarray(pos)))
+                      for k, v in zip(ks, vs)]
+            with self.bind_state(params, buffers):
+                h, new_caches = self.llama(_T(ids_arr), None, caches)
+                logits = self._head(h[:, -1:])
+            ks2 = [c[0]._data for c in new_caches]
+            vs2 = [c[1]._data for c in new_caches]
+            return logits._data[:, -1], ks2, vs2
+
+        self._decode_jit = jax.jit(pure, donate_argnums=(3, 4))
+        return self._decode_jit
+
+    def _generate_static(self, ids, max_new_tokens, pick):
+        from ..ops.manipulation import concat
+        import paddle_tpu as paddle
+        cfg = self.config
+        B, T0 = ids.shape
+        L = len(self.llama.layers)
+        D = cfg.head_dim
+        attn0 = self.llama.layers[0].self_attn
+        kv_local = attn0.k_proj.weight.shape[-1] // D
+        dtype = self.llama.embed_tokens.weight._data.dtype
+        # round the buffer up so nearby generation lengths share programs
+        want = T0 + max_new_tokens
+        max_len = min(cfg.max_position_embeddings,
+                      ((want + 63) // 64) * 64)
+        if want > cfg.max_position_embeddings:
+            raise ValueError(
+                f"sequence length {want} exceeds "
+                f"max_position_embeddings={cfg.max_position_embeddings}")
+        params, buffers = self.raw_state()
+        ks = [jnp.zeros((B, max_len, kv_local, D), dtype)
+              for _ in range(L)]
+        vs = [jnp.zeros((B, max_len, kv_local, D), dtype)
+              for _ in range(L)]
+        fn = self._decode_pure()
+        from ..framework.tensor import Tensor as _T
+        last, ks, vs = fn(params, buffers, ids._data, ks, vs, 0)
+        nxt = pick(_T(last))
+        ids = concat([ids, nxt], axis=1)
+        pos = T0
+        for _ in range(max_new_tokens - 1):
+            last, ks, vs = fn(params, buffers, nxt._data, ks, vs, pos)
+            nxt = pick(_T(last))
+            ids = concat([ids, nxt], axis=1)
+            pos += 1
         return ids

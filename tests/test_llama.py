@@ -155,3 +155,50 @@ def test_llama_generate_edge_cases():
         assert out.shape == [1, 5]
     finally:
         dist.set_mesh(None)
+
+
+def test_static_decode_matches_dynamic_cache():
+    """The compile-once static-cache decode must produce the same tokens
+    as the dynamic concat-cache path and the no-cache path."""
+    import paddle_tpu as paddle
+    paddle.seed(0)
+    cfg = llama_tiny_config(max_position_embeddings=128)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids = paddle.to_tensor(np.random.RandomState(3)
+                           .randint(0, cfg.vocab_size, (2, 9))
+                           .astype(np.int64))
+    out_static = model.generate(ids, max_new_tokens=7)          # static
+    out_dyn = model.generate(ids, max_new_tokens=7,
+                             use_cache="dynamic")
+    out_nocache = model.generate(ids, max_new_tokens=7,
+                                 use_cache=False)
+    np.testing.assert_array_equal(out_static.numpy(), out_dyn.numpy())
+    np.testing.assert_array_equal(out_static.numpy(),
+                                  out_nocache.numpy())
+
+
+def test_static_decode_gqa():
+    import paddle_tpu as paddle
+    paddle.seed(0)
+    cfg = llama_tiny_config(num_key_value_heads=2,
+                            max_position_embeddings=128)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids = paddle.to_tensor(np.random.RandomState(1)
+                           .randint(0, cfg.vocab_size, (1, 5))
+                           .astype(np.int64))
+    out_static = model.generate(ids, max_new_tokens=6)
+    out_nocache = model.generate(ids, max_new_tokens=6, use_cache=False)
+    np.testing.assert_array_equal(out_static.numpy(),
+                                  out_nocache.numpy())
+
+
+def test_static_decode_rejects_overflow():
+    import paddle_tpu as paddle
+    cfg = llama_tiny_config(max_position_embeddings=16)
+    model = LlamaForCausalLM(cfg)
+    model.eval()
+    ids = paddle.to_tensor(np.zeros((1, 10), np.int64))
+    with pytest.raises(ValueError, match="max_position_embeddings"):
+        model.generate(ids, max_new_tokens=20)
