@@ -360,14 +360,37 @@ def ring_attention(q, k, v, mesh, axis: str = "sep", causal: bool = False,
     ``ppermute`` while each rank accumulates its queries' online softmax —
     peak memory per chip is O(S/N), comm is overlapped block-by-block over
     ICI. Layout [B, S, H, D] global view; S sharded over ``axis``.
+
+    Differentiable with O(S/N) residual memory: a custom VJP saves only
+    the local q/k/v blocks, output, and logsumexp; the backward pass
+    re-rotates k/v (flash-attention-style recomputation) while dk/dv
+    partial sums travel the ring with their blocks back to the owner —
+    jax's default scan autodiff would instead save every rotated block
+    (the full sequence per chip), defeating ring attention's point.
     """
     from jax.sharding import PartitionSpec as P
 
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
     N = mesh.shape[axis]
+    perm = [(i, (i + 1) % N) for i in range(N)]
 
+    @jax.custom_vjp
     def per_rank(ql, kl, vl):
+        return _ring_fwd(ql, kl, vl)[0]
+
+    def _block_scores(qf, kb, rank, src_rank, Sl):
+        s = jnp.einsum("bqhd,bkhd->bqhk", qf,
+                       kb.astype(jnp.float32)) * scale
+        if causal:
+            iq = rank * Sl + jax.lax.broadcasted_iota(
+                jnp.int32, (Sl, Sl), 0)
+            ik = src_rank * Sl + jax.lax.broadcasted_iota(
+                jnp.int32, (Sl, Sl), 1)
+            s = jnp.where((iq >= ik)[None, :, None, :], s, NEG_INF)
+        return s
+
+    def _ring_fwd(ql, kl, vl):
         rank = jax.lax.axis_index(axis)
         B, Sl, H, D = ql.shape
         qf = ql.astype(jnp.float32)
@@ -378,22 +401,13 @@ def ring_attention(q, k, v, mesh, axis: str = "sep", causal: bool = False,
         def step(carry, t):
             acc, m, l, kb, vb = carry
             src_rank = (rank - t) % N  # whose k/v block we hold now
-            s = jnp.einsum("bqhd,bkhd->bqhk", qf,
-                           kb.astype(jnp.float32)) * scale
-            if causal:
-                iq = rank * Sl + jax.lax.broadcasted_iota(
-                    jnp.int32, (Sl, Sl), 0)
-                ik = src_rank * Sl + jax.lax.broadcasted_iota(
-                    jnp.int32, (Sl, Sl), 1)
-                mask = (iq >= ik)[None, :, None, :]
-                s = jnp.where(mask, s, NEG_INF)
+            s = _block_scores(qf, kb, rank, src_rank, Sl)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1))
             p = jnp.exp(s - m_new[..., None])
             alpha = jnp.exp(m - m_new)
             l_new = l * alpha + jnp.sum(p, axis=-1)
             acc_new = acc * alpha[..., None] + jnp.einsum(
                 "bqhk,bkhd->bqhd", p, vb.astype(jnp.float32))
-            perm = [(i, (i + 1) % N) for i in range(N)]
             kb2 = jax.lax.ppermute(kb, axis, perm)
             vb2 = jax.lax.ppermute(vb, axis, perm)
             return (acc_new, m_new, l_new, kb2, vb2), None
@@ -401,7 +415,50 @@ def ring_attention(q, k, v, mesh, axis: str = "sep", causal: bool = False,
         (acc, m, l, _, _), _ = jax.lax.scan(
             step, (acc, m, l, kl, vl), jnp.arange(N))
         l_safe = jnp.where(l == 0.0, 1.0, l)
-        return (acc / l_safe[..., None]).astype(ql.dtype)
+        out = (acc / l_safe[..., None]).astype(ql.dtype)
+        lse = m + jnp.log(l_safe)
+        return out, lse
+
+    def fwd_rule(ql, kl, vl):
+        out, lse = _ring_fwd(ql, kl, vl)
+        return out, (ql, kl, vl, out, lse)
+
+    def bwd_rule(res, g):
+        ql, kl, vl, out, lse = res
+        rank = jax.lax.axis_index(axis)
+        B, Sl, H, D = ql.shape
+        qf = ql.astype(jnp.float32)
+        gf = g.astype(jnp.float32)
+        delta = jnp.sum(out.astype(jnp.float32) * gf, axis=-1)  # [B,S,H]
+        dq = jnp.zeros((B, Sl, H, D), jnp.float32)
+
+        def step(carry, t):
+            dq, kb, vb, dkb, dvb = carry
+            src_rank = (rank - t) % N
+            s = _block_scores(qf, kb, rank, src_rank, Sl)
+            p = jnp.exp(s - lse[..., None])           # [B,Sq,H,Sk]
+            dp = jnp.einsum("bqhd,bkhd->bqhk", gf,
+                            vb.astype(jnp.float32))
+            ds = p * (dp - delta[..., None]) * scale
+            dq = dq + jnp.einsum("bqhk,bkhd->bqhd", ds,
+                                 kb.astype(jnp.float32))
+            dkb = dkb + jnp.einsum("bqhk,bqhd->bkhd", ds, qf)
+            dvb = dvb + jnp.einsum("bqhk,bqhd->bkhd", p, gf)
+            # k/v grads travel WITH their blocks; after N hops both are
+            # back at the owner rank with every rank's contribution
+            kb = jax.lax.ppermute(kb, axis, perm)
+            vb = jax.lax.ppermute(vb, axis, perm)
+            dkb = jax.lax.ppermute(dkb, axis, perm)
+            dvb = jax.lax.ppermute(dvb, axis, perm)
+            return (dq, kb, vb, dkb, dvb), None
+
+        zeros = jnp.zeros((B, Sl, H, D), jnp.float32)
+        (dq, _, _, dk, dv), _ = jax.lax.scan(
+            step, (dq, kl, vl, zeros, zeros), jnp.arange(N))
+        return (dq.astype(ql.dtype), dk.astype(kl.dtype),
+                dv.astype(vl.dtype))
+
+    per_rank.defvjp(fwd_rule, bwd_rule)
 
     spec = P(None, axis, None, None)
     fn = jax.shard_map(per_rank, mesh=mesh, in_specs=(spec, spec, spec),
