@@ -1,0 +1,125 @@
+"""RNN-family numeric checks against hand-rolled NumPy references
+(reference: test/legacy_test/test_lstm_op.py, test_gru_op.py,
+test_simple_rnn_op.py — cell math, multi-layer stacking, bidirection,
+gradients)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _params(cell):
+    return (np.asarray(cell.weight_ih._data), np.asarray(cell.weight_hh._data),
+            np.asarray(cell.bias_ih._data), np.asarray(cell.bias_hh._data))
+
+
+def test_lstm_cell_matches_numpy():
+    paddle.seed(0)
+    cell = nn.LSTMCell(4, 6)
+    x = np.random.RandomState(0).rand(3, 4).astype(np.float32)
+    h0 = np.zeros((3, 6), np.float32)
+    c0 = np.zeros((3, 6), np.float32)
+    out, (h1, c1) = cell(paddle.to_tensor(x),
+                         (paddle.to_tensor(h0), paddle.to_tensor(c0)))
+    wi, wh, bi, bh = _params(cell)
+    gates = x @ wi.T + h0 @ wh.T + bi + bh
+    i, f, g, o = np.split(gates, 4, axis=1)
+    c_ref = _sigmoid(f) * c0 + _sigmoid(i) * np.tanh(g)
+    h_ref = _sigmoid(o) * np.tanh(c_ref)
+    np.testing.assert_allclose(h1.numpy(), h_ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(c1.numpy(), c_ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(out.numpy(), h_ref, rtol=1e-5, atol=1e-5)
+
+
+def test_gru_cell_matches_numpy():
+    paddle.seed(0)
+    cell = nn.GRUCell(4, 6)
+    x = np.random.RandomState(1).rand(2, 4).astype(np.float32)
+    h0 = np.random.RandomState(2).rand(2, 6).astype(np.float32)
+    out, h1 = cell(paddle.to_tensor(x), paddle.to_tensor(h0))
+    wi, wh, bi, bh = _params(cell)
+    gi = x @ wi.T + bi
+    gh = h0 @ wh.T + bh
+    ir, iz, ic = np.split(gi, 3, axis=1)
+    hr, hz, hc = np.split(gh, 3, axis=1)
+    r = _sigmoid(ir + hr)
+    z = _sigmoid(iz + hz)
+    c = np.tanh(ic + r * hc)
+    h_ref = (1 - z) * c + z * h0
+    np.testing.assert_allclose(h1.numpy(), h_ref, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(out.numpy(), h_ref, rtol=1e-5, atol=1e-5)
+
+
+def test_simple_rnn_cell_matches_numpy():
+    paddle.seed(0)
+    cell = nn.SimpleRNNCell(4, 6)
+    x = np.random.RandomState(3).rand(2, 4).astype(np.float32)
+    h0 = np.random.RandomState(4).rand(2, 6).astype(np.float32)
+    out, h1 = cell(paddle.to_tensor(x), paddle.to_tensor(h0))
+    wi, wh, bi, bh = _params(cell)
+    h_ref = np.tanh(x @ wi.T + bi + h0 @ wh.T + bh)
+    np.testing.assert_allclose(h1.numpy(), h_ref, rtol=1e-5, atol=1e-5)
+
+
+def test_lstm_layer_final_state_consistent():
+    paddle.seed(0)
+    lstm = nn.LSTM(4, 6, num_layers=1)
+    T, B = 5, 2
+    x = np.random.RandomState(5).rand(B, T, 4).astype(np.float32)
+    out, (h, c) = lstm(paddle.to_tensor(x))
+    assert out.shape == [B, T, 6]
+    # the returned final hidden state is the last output step
+    np.testing.assert_allclose(h.numpy()[0], out.numpy()[:, -1],
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_bidirectional_gru_shapes_and_grad():
+    paddle.seed(0)
+    gru = nn.GRU(4, 6, num_layers=2, direction="bidirect")
+    x = paddle.to_tensor(np.random.RandomState(6)
+                         .rand(3, 7, 4).astype(np.float32),
+                         stop_gradient=False)
+    out, h = gru(x)
+    assert out.shape == [3, 7, 12]  # fwd+bwd concat
+    out.sum().backward()
+    assert x.grad is not None
+    assert np.isfinite(x.grad.numpy()).all()
+
+
+def test_lstm_learns_sequence_task():
+    """End-to-end: LSTM learns to output the sum sign of a sequence."""
+    paddle.seed(1)
+    rng = np.random.RandomState(0)
+    X = rng.randn(64, 6, 2).astype(np.float32)
+    Y = (X.sum((1, 2)) > 0).astype(np.int64)
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super(Net, self).__init__()
+            self.rnn = nn.LSTM(2, 16)
+            self.fc = nn.Linear(16, 2)
+
+        def forward(self, x):
+            out, _ = self.rnn(x)
+            return self.fc(out[:, -1])
+
+    net = Net()
+    opt = paddle.optimizer.Adam(learning_rate=0.02,
+                                parameters=net.parameters())
+    xt, yt = paddle.to_tensor(X), paddle.to_tensor(Y)
+    losses = []
+    for _ in range(30):
+        loss = nn.functional.cross_entropy(net(xt), yt)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.5, (losses[0], losses[-1])
+    acc = float((paddle.argmax(net(xt), axis=1) == yt)
+                .astype("float32").mean())
+    assert acc > 0.8, acc
