@@ -12,10 +12,22 @@ import numpy as np
 from ..framework import random as rnd
 from ..framework.tensor import Tensor, apply_op, _unwrap
 
+from .transform import (AbsTransform, AffineTransform,  # noqa: F401
+                        ChainTransform, ExpTransform,
+                        IndependentTransform, PowerTransform,
+                        ReshapeTransform, SigmoidTransform,
+                        SoftmaxTransform, StackTransform,
+                        StickBreakingTransform, TanhTransform, Transform)
+
 __all__ = ["Distribution", "Normal", "Uniform", "Bernoulli", "Categorical",
            "Exponential", "Beta", "Gamma", "Dirichlet", "Multinomial",
            "LogNormal", "Laplace", "Gumbel", "Geometric", "Poisson",
-           "Cauchy", "StudentT", "kl_divergence", "register_kl"]
+           "Cauchy", "StudentT", "kl_divergence", "register_kl",
+           "Transform", "AbsTransform", "AffineTransform",
+           "ChainTransform", "ExpTransform", "IndependentTransform",
+           "PowerTransform", "ReshapeTransform", "SigmoidTransform",
+           "SoftmaxTransform", "StackTransform", "StickBreakingTransform",
+           "TanhTransform"]
 
 
 def _t(x):
@@ -75,6 +87,11 @@ class Normal(Distribution):
     def __init__(self, loc, scale, name=None):
         self.loc = _t(loc)
         self.scale = _t(scale)
+        # keep Tensor params AS Tensors: rsample/log_prob/entropy record
+        # their math on the tape so gradients reach them; raw Python
+        # containers are normalized to arrays once
+        self._loc_p = loc if isinstance(loc, Tensor) else self.loc
+        self._scale_p = scale if isinstance(scale, Tensor) else self.scale
         super().__init__(jnp.broadcast_shapes(self.loc.shape,
                                               self.scale.shape))
 
@@ -95,17 +112,36 @@ class Normal(Distribution):
                                 _shape(shape) + self.batch_shape)
         return Tensor(self.loc + self.scale * eps)
 
-    rsample = sample
+    def rsample(self, shape=()):
+        """Reparameterized: loc + scale * eps recorded on the autograd
+        tape (reference normal.py rsample pathwise derivative)."""
+        eps = jax.random.normal(rnd.next_key(),
+                                _shape(shape) + self.batch_shape)
+        return apply_op(lambda l, s: l + s * eps,
+                        self._loc_p, self._scale_p,
+                        _op_name="normal_rsample")
 
     def log_prob(self, value):
-        v = _t(value)
-        var = self.scale ** 2
-        return Tensor(-((v - self.loc) ** 2) / (2 * var) -
-                      jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+        # tape-recorded in BOTH value and parameters: variational
+        # objectives differentiate log q(z) w.r.t. q's loc/scale and
+        # through z (the reference's dygraph log_prob is differentiable
+        # the same way)
+        def f(v, l, s):
+            return (-((v - l) ** 2) / (2 * s ** 2) - jnp.log(s)
+                    - 0.5 * math.log(2 * math.pi))
+
+        v = value if isinstance(value, Tensor) else _t(value)
+        return apply_op(f, v, self._loc_p, self._scale_p,
+                        _op_name="normal_log_prob")
 
     def entropy(self):
-        e = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
-        return Tensor(jnp.broadcast_to(e, self.batch_shape))
+        shape = self.batch_shape
+
+        def f(s):
+            e = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(s)
+            return jnp.broadcast_to(e, shape)
+
+        return apply_op(f, self._scale_p, _op_name="normal_entropy")
 
     def cdf(self, value):
         return Tensor(jax.scipy.stats.norm.cdf(_t(value), self.loc,
@@ -115,6 +151,11 @@ class Normal(Distribution):
 class LogNormal(Normal):
     def sample(self, shape=()):
         return Tensor(jnp.exp(_t(super().sample(shape))))
+
+    def rsample(self, shape=()):
+        # exp applied ON the tape so pathwise grads flow through it
+        return apply_op(jnp.exp, super().rsample(shape),
+                        _op_name="lognormal_rsample_exp")
 
     def log_prob(self, value):
         v = _t(value)
@@ -265,6 +306,15 @@ class Beta(Distribution):
         return Tensor(jax.scipy.stats.beta.logpdf(_t(value), self.alpha,
                                                   self.beta))
 
+    def entropy(self):
+        a, b = self.alpha, self.beta
+        dg = jax.scipy.special.digamma
+        ln_beta = (jax.scipy.special.gammaln(a)
+                   + jax.scipy.special.gammaln(b)
+                   - jax.scipy.special.gammaln(a + b))
+        return Tensor(ln_beta - (a - 1) * dg(a) - (b - 1) * dg(b)
+                      + (a + b - 2) * dg(a + b))
+
 
 class Gamma(Distribution):
     def __init__(self, concentration, rate, name=None):
@@ -285,6 +335,11 @@ class Gamma(Distribution):
     def log_prob(self, value):
         return Tensor(jax.scipy.stats.gamma.logpdf(
             _t(value), self.concentration, scale=1.0 / self.rate))
+
+    def entropy(self):
+        a, b = self.concentration, self.rate
+        return Tensor(a - jnp.log(b) + jax.scipy.special.gammaln(a)
+                      + (1 - a) * jax.scipy.special.digamma(a))
 
 
 class Dirichlet(Distribution):
@@ -370,23 +425,27 @@ class Gumbel(Distribution):
 
 
 class Geometric(Distribution):
+    """Number of FAILURES before the first success, support {0,1,2,…} —
+    the reference's convention (distribution/geometric.py: pmf(k) =
+    (1-p)^k p), which is scipy's shifted by one."""
+
     def __init__(self, probs, name=None):
         self.probs = _t(probs)
         super().__init__(self.probs.shape)
 
     @property
     def mean(self):
-        return Tensor(1.0 / self.probs)
+        return Tensor((1.0 - self.probs) / self.probs)
 
     def sample(self, shape=()):
-        return Tensor(jax.random.geometric(
+        # jax.random.geometric counts trials (support {1,2,…})
+        return Tensor((jax.random.geometric(
             rnd.next_key(), self.probs,
-            _shape(shape) + self.batch_shape).astype(jnp.float32))
+            _shape(shape) + self.batch_shape) - 1).astype(jnp.float32))
 
     def log_prob(self, value):
         v = _t(value)
-        return Tensor((v - 1) * jnp.log1p(-self.probs) +
-                      jnp.log(self.probs))
+        return Tensor(v * jnp.log1p(-self.probs) + jnp.log(self.probs))
 
 
 class Poisson(Distribution):

@@ -1,0 +1,315 @@
+"""Probability transforms (reference: python/paddle/distribution/
+transform.py — Transform base + the bijector family used by
+TransformedDistribution)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor
+
+__all__ = ["Transform", "AbsTransform", "AffineTransform",
+           "ChainTransform", "ExpTransform", "IndependentTransform",
+           "PowerTransform", "ReshapeTransform", "SigmoidTransform",
+           "SoftmaxTransform", "StackTransform",
+           "StickBreakingTransform", "TanhTransform"]
+
+
+def _t(x):
+    return x._data if isinstance(x, Tensor) else jnp.asarray(x)
+
+
+class Transform:
+    """Bijector base: forward/inverse plus log|det J| in both
+    directions (reference transform.py Transform). ``_event_rank`` is
+    the number of trailing dims the transform's log-det is already
+    reduced over (0 = elementwise) — the reference's domain event_rank,
+    used by ChainTransform to align contributions."""
+
+    _type = "bijection"
+    _event_rank = 0
+
+    def forward(self, x):
+        return Tensor(self._forward(_t(x)))
+
+    def inverse(self, y):
+        return Tensor(self._inverse(_t(y)))
+
+    def forward_log_det_jacobian(self, x):
+        return Tensor(self._forward_log_det_jacobian(_t(x)))
+
+    def inverse_log_det_jacobian(self, y):
+        return Tensor(-self._forward_log_det_jacobian(
+            self._inverse(_t(y))))
+
+    def forward_shape(self, shape):
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        return tuple(shape)
+
+    # subclass hooks on raw arrays
+    def _forward(self, x):
+        raise NotImplementedError
+
+    def _inverse(self, y):
+        raise NotImplementedError
+
+    def _forward_log_det_jacobian(self, x):
+        raise NotImplementedError
+
+
+class ExpTransform(Transform):
+    def _forward(self, x):
+        return jnp.exp(x)
+
+    def _inverse(self, y):
+        return jnp.log(y)
+
+    def _forward_log_det_jacobian(self, x):
+        return x
+
+
+class AbsTransform(Transform):
+    """y = |x| (not injective; inverse returns the positive branch)."""
+
+    _type = "other"
+
+    def _forward(self, x):
+        return jnp.abs(x)
+
+    def _inverse(self, y):
+        return y
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.zeros_like(x)
+
+
+class AffineTransform(Transform):
+    def __init__(self, loc, scale):
+        self.loc = _t(loc)
+        self.scale = _t(scale)
+
+    def _forward(self, x):
+        return self.loc + self.scale * x
+
+    def _inverse(self, y):
+        return (y - self.loc) / self.scale
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.broadcast_to(jnp.log(jnp.abs(self.scale)), x.shape)
+
+
+class PowerTransform(Transform):
+    def __init__(self, power):
+        self.power = _t(power)
+
+    def _forward(self, x):
+        return jnp.power(x, self.power)
+
+    def _inverse(self, y):
+        return jnp.power(y, 1.0 / self.power)
+
+    def _forward_log_det_jacobian(self, x):
+        return jnp.log(jnp.abs(self.power * jnp.power(x, self.power - 1)))
+
+
+class SigmoidTransform(Transform):
+    def _forward(self, x):
+        return jax.nn.sigmoid(x)
+
+    def _inverse(self, y):
+        return jnp.log(y) - jnp.log1p(-y)
+
+    def _forward_log_det_jacobian(self, x):
+        return -jax.nn.softplus(-x) - jax.nn.softplus(x)
+
+
+class TanhTransform(Transform):
+    def _forward(self, x):
+        return jnp.tanh(x)
+
+    def _inverse(self, y):
+        return jnp.arctanh(y)
+
+    def _forward_log_det_jacobian(self, x):
+        # log(1 - tanh^2 x) = 2*(log2 - x - softplus(-2x))
+        return 2.0 * (jnp.log(2.0) - x - jax.nn.softplus(-2.0 * x))
+
+
+class SoftmaxTransform(Transform):
+    """y = softmax(x) over the last axis (not a bijection; log-det is
+    undefined — matches the reference, which only supports
+    forward/inverse)."""
+
+    _type = "other"
+
+    def _forward(self, x):
+        return jax.nn.softmax(x, axis=-1)
+
+    def _inverse(self, y):
+        x = jnp.log(y)
+        return x - x.max(-1, keepdims=True)
+
+
+class StickBreakingTransform(Transform):
+    """R^{K-1} -> K-simplex via stick breaking (transform.py)."""
+
+    _event_rank = 1
+
+    def _forward(self, x):
+        offset = x.shape[-1] - jnp.arange(x.shape[-1], dtype=x.dtype)
+        z = jax.nn.sigmoid(x - jnp.log(offset))
+        z_pad = jnp.concatenate(
+            [z, jnp.ones(z.shape[:-1] + (1,), z.dtype)], axis=-1)
+        one_minus = jnp.concatenate(
+            [jnp.ones(z.shape[:-1] + (1,), z.dtype), 1 - z], axis=-1)
+        return z_pad * jnp.cumprod(one_minus, axis=-1)
+
+    def _inverse(self, y):
+        y_crop = y[..., :-1]
+        rem = 1.0 - jnp.cumsum(y_crop, axis=-1)
+        rem = jnp.concatenate(
+            [jnp.ones(y.shape[:-1] + (1,), y.dtype), rem[..., :-1]],
+            axis=-1)
+        z = y_crop / rem
+        offset = y_crop.shape[-1] - jnp.arange(y_crop.shape[-1],
+                                               dtype=y.dtype)
+        return jnp.log(z) - jnp.log1p(-z) + jnp.log(offset)
+
+    def _forward_log_det_jacobian(self, x):
+        # triangular Jacobian: dy_i/dx_i = z_i(1-z_i)rem_i with
+        # y_i = z_i*rem_i  =>  ldj = sum_i log y_i + log(1-z_i)
+        offset = x.shape[-1] - jnp.arange(x.shape[-1], dtype=x.dtype)
+        xo = x - jnp.log(offset)
+        y = self._forward(x)[..., :-1]
+        return jnp.sum(jnp.log(y) - jax.nn.softplus(xo), axis=-1)
+
+    def forward_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] + 1,)
+
+    def inverse_shape(self, shape):
+        return tuple(shape[:-1]) + (shape[-1] - 1,)
+
+
+class ChainTransform(Transform):
+    def __init__(self, transforms):
+        self.transforms = list(transforms)
+
+    def _forward(self, x):
+        for t in self.transforms:
+            x = t._forward(x)
+        return x
+
+    def _inverse(self, y):
+        for t in reversed(self.transforms):
+            y = t._inverse(y)
+        return y
+
+    @property
+    def _event_rank(self):
+        return max(t._event_rank for t in self.transforms)
+
+    def _forward_log_det_jacobian(self, x):
+        # align contributions: an elementwise transform's per-element
+        # log-det must be summed down to the chain's event rank before
+        # adding to already-reduced ones (reference _sum_rightmost)
+        rank = max(t._event_rank for t in self.transforms)
+        total = 0.0
+        for t in self.transforms:
+            ld = t._forward_log_det_jacobian(x)
+            extra = rank - t._event_rank
+            if extra:
+                ld = jnp.sum(ld, axis=tuple(range(-extra, 0)))
+            total = total + ld
+            x = t._forward(x)
+        return total
+
+    def forward_shape(self, shape):
+        for t in self.transforms:
+            shape = t.forward_shape(shape)
+        return tuple(shape)
+
+    def inverse_shape(self, shape):
+        for t in reversed(self.transforms):
+            shape = t.inverse_shape(shape)
+        return tuple(shape)
+
+
+class IndependentTransform(Transform):
+    """Reinterpret trailing batch dims of ``base`` as event dims: sums
+    the log-det over the last ``reinterpreted_batch_ndims`` axes."""
+
+    def __init__(self, base, reinterpreted_batch_ndims):
+        self.base = base
+        self.ndims = int(reinterpreted_batch_ndims)
+
+    def _forward(self, x):
+        return self.base._forward(x)
+
+    def _inverse(self, y):
+        return self.base._inverse(y)
+
+    def _forward_log_det_jacobian(self, x):
+        ld = self.base._forward_log_det_jacobian(x)
+        return jnp.sum(ld, axis=tuple(range(-self.ndims, 0)))
+
+    @property
+    def _event_rank(self):
+        return self.base._event_rank + self.ndims
+
+    def forward_shape(self, shape):
+        return self.base.forward_shape(shape)
+
+    def inverse_shape(self, shape):
+        return self.base.inverse_shape(shape)
+
+
+class ReshapeTransform(Transform):
+    def __init__(self, in_event_shape, out_event_shape):
+        self._event_rank = len(tuple(in_event_shape))
+        self.in_event_shape = tuple(in_event_shape)
+        self.out_event_shape = tuple(out_event_shape)
+
+    def _forward(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return x.reshape(batch + self.out_event_shape)
+
+    def _inverse(self, y):
+        batch = y.shape[:y.ndim - len(self.out_event_shape)]
+        return y.reshape(batch + self.in_event_shape)
+
+    def _forward_log_det_jacobian(self, x):
+        batch = x.shape[:x.ndim - len(self.in_event_shape)]
+        return jnp.zeros(batch, x.dtype)
+
+    def forward_shape(self, shape):
+        n = len(shape) - len(self.in_event_shape)
+        return tuple(shape[:n]) + self.out_event_shape
+
+    def inverse_shape(self, shape):
+        n = len(shape) - len(self.out_event_shape)
+        return tuple(shape[:n]) + self.in_event_shape
+
+
+class StackTransform(Transform):
+    """Apply the i-th transform to the i-th slice along ``axis``."""
+
+    def __init__(self, transforms, axis=0):
+        self.transforms = list(transforms)
+        self.axis = int(axis)
+
+    def _map(self, fn_name, x):
+        parts = jnp.split(x, len(self.transforms), axis=self.axis)
+        outs = [getattr(t, fn_name)(jnp.squeeze(p, self.axis))
+                for t, p in zip(self.transforms, parts)]
+        return jnp.stack(outs, axis=self.axis)
+
+    def _forward(self, x):
+        return self._map("_forward", x)
+
+    def _inverse(self, y):
+        return self._map("_inverse", y)
+
+    def _forward_log_det_jacobian(self, x):
+        return self._map("_forward_log_det_jacobian", x)
