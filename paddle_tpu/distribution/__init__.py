@@ -201,12 +201,20 @@ class Uniform(Distribution):
 
 class Bernoulli(Distribution):
     def __init__(self, probs=None, logits=None, name=None):
+        # _param_p keeps the ORIGINAL Tensor so log_prob/entropy record
+        # on the tape (policy gradients need d log p / d params)
         if probs is not None:
             self.probs = _t(probs)
             self.logits = jnp.log(self.probs) - jnp.log1p(-self.probs)
+            self._param_p = probs if isinstance(probs, Tensor) \
+                else self.probs
+            self._param_is_probs = True
         else:
             self.logits = _t(logits)
             self.probs = jax.nn.sigmoid(self.logits)
+            self._param_p = logits if isinstance(logits, Tensor) \
+                else self.logits
+            self._param_is_probs = False
         super().__init__(self.probs.shape)
 
     @property
@@ -223,24 +231,52 @@ class Bernoulli(Distribution):
             _shape(shape) + self.batch_shape).astype(jnp.float32))
 
     def log_prob(self, value):
-        v = _t(value)
-        return Tensor(v * jax.nn.log_sigmoid(self.logits) +
-                      (1 - v) * jax.nn.log_sigmoid(-self.logits))
+        is_probs = self._param_is_probs
+
+        def f(v, param):
+            logits = (jnp.log(param) - jnp.log1p(-param)) if is_probs \
+                else param
+            return (v * jax.nn.log_sigmoid(logits) +
+                    (1 - v) * jax.nn.log_sigmoid(-logits))
+
+        v = value if isinstance(value, Tensor) else _t(value)
+        return apply_op(f, v, self._param_p, _op_name="bernoulli_log_prob")
 
     def entropy(self):
-        p = self.probs
-        return Tensor(-(p * jnp.log(jnp.maximum(p, 1e-12)) +
-                        (1 - p) * jnp.log(jnp.maximum(1 - p, 1e-12))))
+        is_probs = self._param_is_probs
+
+        def f(param):
+            p = param if is_probs else jax.nn.sigmoid(param)
+            return -(p * jnp.log(jnp.maximum(p, 1e-12)) +
+                     (1 - p) * jnp.log(jnp.maximum(1 - p, 1e-12)))
+
+        return apply_op(f, self._param_p, _op_name="bernoulli_entropy")
+
+
+def _cat_log_softmax(param, is_probs):
+    """Normalized log-probs from probs or logits (free function so tape
+    closures don't retain the Distribution instance)."""
+    if is_probs:
+        lg = jnp.log(jnp.maximum(param, 1e-30))
+        return lg - jax.scipy.special.logsumexp(lg, axis=-1,
+                                                keepdims=True)
+    return jax.nn.log_softmax(param, axis=-1)
 
 
 class Categorical(Distribution):
     def __init__(self, logits=None, probs=None, name=None):
         if logits is not None:
             self.logits = jax.nn.log_softmax(_t(logits), axis=-1)
+            self._param_p = logits if isinstance(logits, Tensor) \
+                else self.logits
+            self._param_is_probs = False
         else:
             self.logits = jnp.log(jnp.maximum(_t(probs), 1e-30))
             self.logits = self.logits - jax.scipy.special.logsumexp(
                 self.logits, axis=-1, keepdims=True)
+            self._param_p = probs if isinstance(probs, Tensor) \
+                else self.logits
+            self._param_is_probs = isinstance(probs, Tensor)
         self.probs = jnp.exp(self.logits)
         super().__init__(self.logits.shape[:-1])
 
@@ -251,11 +287,29 @@ class Categorical(Distribution):
 
     def log_prob(self, value):
         idx = _t(value).astype(jnp.int32)
-        return Tensor(jnp.take_along_axis(
-            self.logits, idx[..., None], axis=-1)[..., 0])
+        is_probs = self._param_is_probs
+
+        def f(param):
+            lg = _cat_log_softmax(param, is_probs)
+            # two-way broadcast: sample-shaped values against batched
+            # logits AND size-1 value dims against the batch
+            bshape = jnp.broadcast_shapes(idx.shape, lg.shape[:-1])
+            lgb = jnp.broadcast_to(lg, bshape + lg.shape[-1:])
+            idxb = jnp.broadcast_to(idx, bshape)
+            return jnp.take_along_axis(lgb, idxb[..., None],
+                                       axis=-1)[..., 0]
+
+        return apply_op(f, self._param_p,
+                        _op_name="categorical_log_prob")
 
     def entropy(self):
-        return Tensor(-jnp.sum(self.probs * self.logits, axis=-1))
+        is_probs = self._param_is_probs
+
+        def f(param):
+            lg = _cat_log_softmax(param, is_probs)
+            return -jnp.sum(jnp.exp(lg) * lg, axis=-1)
+
+        return apply_op(f, self._param_p, _op_name="categorical_entropy")
 
 
 class Exponential(Distribution):
