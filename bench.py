@@ -2,9 +2,13 @@
 
 This is the BASELINE.json north-star config (GPT-3 1.3B class: hidden
 2048, 24 layers, dh=128) running a full AdamW training step — bf16
-compute, fp32 master weights, bf16 Adam moments (fits the 16G chip),
-Pallas flash attention, vocab-chunked fused cross-entropy, full per-block
-remat.
+compute, bf16 master weights updated with exact stochastic rounding,
+bf16 Adam moments, Pallas flash attention (grid-pipelined Mosaic
+kernels), int8-MXU forward matmuls with exact bf16 backward
+(ops/quant_matmul.py; 40-step loss parity vs bf16 within 3e-4 —
+benchmarks/RESULTS.md), "save_main" remat policy (saves matmul outputs
++ flash residuals; backward recomputes only layernorm/elementwise and
+the small attention-proj matmul), vocab-chunked fused cross-entropy.
 
 Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
 vs_baseline is reported as achieved model-FLOPs-utilization (MFU) against
@@ -45,8 +49,12 @@ def main():
         size = "tiny"
 
     mesh = build_mesh(n_devices=1, pipe=1, model=1, fsdp=1, sep=1)
-    trainer = GPTSpmdTrainer(cfg, mesh, microbatches=1, remat=on_tpu,
-                             moment_dtype=moment_dtype)
+    trainer = GPTSpmdTrainer(
+        cfg, mesh, microbatches=1,
+        remat="save_main" if on_tpu else False,
+        moment_dtype=moment_dtype,
+        master_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
+        quant8=on_tpu)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
     labels = np.roll(ids, -1, axis=1)

@@ -212,7 +212,9 @@ class GPTSpmdTrainer:
                  use_flash: Optional[bool] = None,
                  remat: bool = True,
                  mixed_precision: bool = True,
-                 moment_dtype: Any = jnp.float32):
+                 moment_dtype: Any = jnp.float32,
+                 master_dtype: Any = jnp.float32,
+                 quant8: bool = False):
         self.cfg = cfg
         self.mesh = mesh
         self.remat = remat  # per-block activation checkpointing
@@ -224,6 +226,18 @@ class GPTSpmdTrainer:
         # AdamW moment storage dtype; bf16 moments let ~1.3B params fit
         # a single 16G chip (update math still fp32)
         self.moment_dtype = moment_dtype
+        # Master-weight storage dtype. fp32 = classic AMP-O2 masters.
+        # bf16 = store masters AT compute precision and apply the AdamW
+        # update with stochastic rounding (update math in fp32, the
+        # rounding noise is unbiased so tiny updates accumulate in
+        # expectation — the bf16+SR training recipe). Halves master HBM
+        # and removes the per-step master->compute cast entirely, which
+        # is what frees enough HBM for save_dots remat at 1.3B/16G.
+        self.master_dtype = master_dtype
+        self._stoch_round = (jnp.dtype(master_dtype) == jnp.bfloat16)
+        # int8 MXU forward for the wide block matmuls (qkv/ffn), exact
+        # bf16 backward — ~2x MXU rate on v5e (ops/quant_matmul.py)
+        self.quant8 = quant8
         # Pallas flash attention on real TPU; XLA einsum attention
         # elsewhere (interpret-mode pallas is orders slower on CPU, and
         # the Mosaic kernel does not lower on GPU backends)
@@ -259,16 +273,19 @@ class GPTSpmdTrainer:
         std = 0.02
         resid_std = std / math.sqrt(2 * cfg.num_layers)
 
+        mdt = self.master_dtype
+
         def init(key, shape, scale, spec):
-            arr = scale * jax.random.normal(key, shape, jnp.float32)
+            arr = (scale * jax.random.normal(key, shape,
+                                             jnp.float32)).astype(mdt)
             return jax.device_put(arr, _spec(self.mesh, *spec))
 
         def zeros(shape, spec):
-            return jax.device_put(jnp.zeros(shape, jnp.float32),
+            return jax.device_put(jnp.zeros(shape, mdt),
                                   _spec(self.mesh, *spec))
 
         def ones(shape, spec):
-            return jax.device_put(jnp.ones(shape, jnp.float32),
+            return jax.device_put(jnp.ones(shape, mdt),
                                   _spec(self.mesh, *spec))
 
         params = {
@@ -311,9 +328,17 @@ class GPTSpmdTrainer:
         # fp32 internally, so a bf16 output dtype only rounds the final
         # result while halving the HBM write (measured ~7% step win vs
         # preferred_element_type=f32 + cast)
+        if self.quant8:
+            from ..ops.quant_matmul import int8_linear
+            mm = int8_linear
+        else:
+            mm = lambda a, w: jnp.einsum(  # noqa: E731
+                "btd,df->btf", a, w)
+
         h = _layer_norm(x, bp["ln1_g"], bp["ln1_b"])
-        qkv = jnp.einsum("btd,df->btf", h, bp["wqkv"].astype(x.dtype))
+        qkv = mm(h, bp["wqkv"].astype(x.dtype))
         qkv = qkv + bp["bqkv"].astype(x.dtype)
+        qkv = checkpoint_name(qkv, "qkv_out")
         qkv = qkv.reshape(mb, T, 3, H, dh)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         attn = self._attention(q, k, v, act)
@@ -324,10 +349,13 @@ class GPTSpmdTrainer:
         x = act(x, _spec(self.mesh, "data", "sep", None))
 
         h = _layer_norm(x, bp["ln2_g"], bp["ln2_b"])
-        a = jnp.einsum("btd,df->btf", h, bp["win"].astype(x.dtype))
-        a = jax.nn.gelu(a + bp["bin"].astype(x.dtype), approximate=True)
+        a = mm(h, bp["win"].astype(x.dtype))
+        a = a + bp["bin"].astype(x.dtype)
+        a = checkpoint_name(a, "ffn1_out")  # pre-gelu: gelu vjp needs it
+        a = jax.nn.gelu(a, approximate=True)
         a = checkpoint_name(a, "ffn_act")
-        o = jnp.einsum("btf,fd->btd", a, bp["wout"].astype(x.dtype))
+        o = mm(a, bp["wout"].astype(x.dtype))
+        o = checkpoint_name(o, "ffn2_out")
         x = x + o + bp["bout"].astype(x.dtype)
         return act(x, _spec(self.mesh, "data", "sep", None))
 
@@ -389,8 +417,10 @@ class GPTSpmdTrainer:
         remat: False = save everything; True = full per-block remat;
         "save_attn" / "save_attn_ffn" = selective policies that keep the
         expensive flash-attention output (and optionally the ffn
-        activation) while recomputing the cheap elementwise tail —
-        remat's 2N extra FLOPs shrink to ~0 at modest memory cost."""
+        activation) while recomputing the cheap elementwise tail;
+        "save_dots" = save every matmul output (recompute only norms /
+        elementwise) — remat's 2N extra FLOPs shrink to ~0 at the cost
+        of ~9 activation buffers per layer."""
         if not self.remat:
             blk = self._block
         elif self.remat == "save_attn":
@@ -399,6 +429,24 @@ class GPTSpmdTrainer:
         elif self.remat == "save_attn_ffn":
             pol = jax.checkpoint_policies.save_only_these_names(
                 "attn_out", "ffn_act")
+            blk = jax.checkpoint(self._block, policy=pol)
+        elif self.remat == "save_dots":
+            # matmul outputs + the flash kernel's own residuals (out,
+            # lse): backward recomputes only layernorms/elementwise —
+            # remat overhead drops from ~33% of step FLOPs to ~0
+            pol = jax.checkpoint_policies.save_from_both_policies(
+                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+                jax.checkpoint_policies.save_only_these_names(
+                    "flash_out", "flash_lse"))
+            blk = jax.checkpoint(self._block, policy=pol)
+        elif self.remat == "save_main":
+            # like save_dots but drops the attention-proj output buffer
+            # (cheapest matmul, 2/24 of block FLOPs to recompute) —
+            # ~0.6G less HBM at bs6/1.3B, which is what lets this fit
+            # alongside bf16 masters on a 16G chip
+            pol = jax.checkpoint_policies.save_only_these_names(
+                "qkv_out", "ffn1_out", "ffn2_out",
+                "flash_out", "flash_lse")
             blk = jax.checkpoint(self._block, policy=pol)
         else:
             blk = jax.checkpoint(self._block)
@@ -466,15 +514,18 @@ class GPTSpmdTrainer:
         gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
                              for g in jax.tree.leaves(grads)))
         scale = jnp.minimum(1.0, self.grad_clip / (gnorm + 1e-6))
+        step_u32 = step.astype(jnp.uint32)
 
-        def upd(p, g, m, v):
+        def upd(p, g, m, v, key):
             g = g.astype(jnp.float32) * scale
             m2 = b1 * m.astype(jnp.float32) + (1 - b1) * g
             v2 = b2 * v.astype(jnp.float32) + (1 - b2) * g * g
             mhat = m2 / (1 - b1 ** tf)
             vhat = v2 / (1 - b2 ** tf)
-            p2 = p * (1 - self.lr * self.wd) - \
+            p2 = p.astype(jnp.float32) * (1 - self.lr * self.wd) - \
                 self.lr * mhat / (jnp.sqrt(vhat) + 1e-8)
+            if self._stoch_round:
+                p2 = _stochastic_round_bf16(p2, key)
             return (p2, m2.astype(self.moment_dtype),
                     v2.astype(self.moment_dtype))
 
@@ -483,8 +534,13 @@ class GPTSpmdTrainer:
         flat_m = jax.tree.leaves(opt_state["m"])
         flat_v = jax.tree.leaves(opt_state["v"])
         new_p, new_m, new_v = [], [], []
-        for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v):
-            p2, m2, v2 = upd(p, g, m, v)
+        for i, (p, g, m, v) in enumerate(zip(flat_p, flat_g, flat_m,
+                                             flat_v)):
+            # rbg keys are cheap to build and the generator is ~10x
+            # faster than threefry on TPU (SR needs 16 bits/param/step)
+            key = jnp.array([0x5eed, 0xbeef, i, 0], jnp.uint32) \
+                .at[3].set(step_u32) if self._stoch_round else None
+            p2, m2, v2 = upd(p, g, m, v, key)
             new_p.append(p2)
             new_m.append(m2)
             new_v.append(v2)
@@ -498,7 +554,12 @@ class GPTSpmdTrainer:
             return self._step_fn
 
         def step(params, opt_state, input_ids, labels):
-            if self.mixed_precision:
+            if self._stoch_round:
+                # bf16 masters ARE the compute params — no cast, no
+                # second weight copy in HBM
+                loss, grads = jax.value_and_grad(self._forward_loss)(
+                    params, input_ids, labels)
+            elif self.mixed_precision:
                 # cast masters -> compute dtype OUTSIDE the diff'd fn so
                 # grads materialize at cfg.dtype (AMP-O2 master-weight
                 # semantics; halves grad HBM)
@@ -532,6 +593,25 @@ class GPTSpmdTrainer:
     def n_params(self) -> int:
         return sum(int(np.prod(l.shape))
                    for l in jax.tree.leaves(self.params))
+
+
+def _stochastic_round_bf16(x_f32, key):
+    """Unbiased fp32 -> bf16 rounding: bf16 is the top 16 bits of f32,
+    so adding uniform-[0, 2^16) bits to the f32 representation and
+    truncating rounds up with probability exactly equal to the dropped
+    fraction (exact stochastic rounding, no special-casing of ulp).
+
+    ``key``: uint32[4] rbg key (hardware bit generator; threefry costs
+    ~2x the whole AdamW update at 1.3B params)."""
+    bits = jax.lax.bitcast_convert_type(x_f32, jnp.uint32)
+    _, r32 = jax.lax.rng_bit_generator(
+        key, x_f32.shape, jnp.uint32,
+        algorithm=jax.lax.RandomAlgorithm.RNG_DEFAULT)
+    y = bits + (r32 & jnp.uint32(0xFFFF))
+    # inf/nan inputs: the add could wrap the exponent; keep them verbatim
+    y = jnp.where(jnp.isfinite(x_f32), y, bits)
+    return jax.lax.bitcast_convert_type(
+        (y >> 16).astype(jnp.uint16), jnp.bfloat16)
 
 
 def _layer_norm(x, g, b, eps=1e-5):

@@ -20,12 +20,15 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.ad_checkpoint import checkpoint_name
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+_LANES = 128  # VPU lane width: scalar-per-row carries live as [bq, 128]
 
 
-def _choose_block(seq_len: int, target: int = 128) -> int:
+def _choose_block(seq_len: int, target: int = 512) -> int:
     b = min(target, seq_len)
     while seq_len % b:
         b //= 2
@@ -39,73 +42,104 @@ def _interpret() -> bool:
 # ---------------------------------------------------------------------------
 # forward
 # ---------------------------------------------------------------------------
+# Mosaic-native structure: the k/v block index is a GRID axis (innermost,
+# 'arbitrary'), so block DMAs double-buffer automatically while the MXU
+# works; the online-softmax carry (acc, m, l) persists in VMEM scratch
+# across the innermost axis. Causal masking touches only diagonal blocks
+# and strictly-upper blocks are skipped entirely.
 
-def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, seq_len,
-                   causal, scale):
+def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                   acc_ref, m_ref, l_ref, *, bq, bk, nk, causal, scale):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)  # [bq, d]
-    d = q.shape[-1]
+    j = pl.program_id(2)
+    j_last = jnp.minimum(((qi + 1) * bq - 1) // bk, nk - 1) if causal \
+        else nk - 1
+    run = j <= j_last if causal else True
 
-    nk = seq_len // bk
-    if causal:
-        # blocks strictly after this q block contribute nothing
-        upper = (qi + 1) * bq + bk - 1
-        nk_eff = jnp.minimum((upper // bk), nk)
-    else:
-        nk_eff = nk
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
 
-    def body(j, carry):
-        acc, m, l = carry
-        k = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]  # [bq, d] bf16: MXU takes bf16 in, accumulates fp32
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(
             q, k, (((1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32) * scale  # [bq, bk]
         if causal:
-            iq = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            ik = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(iq >= ik, s, NEG_INF)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
-        l_new = l * alpha + jnp.sum(p, axis=-1)
-        acc_new = acc * alpha[:, None] + jax.lax.dot(
-            p, v, preferred_element_type=jnp.float32)
-        return acc_new, m_new, l_new
+            # mask only when this block straddles the diagonal
+            diag = (j + 1) * bk - 1 > qi * bq
 
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    m0 = jnp.full((bq,), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq,), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, nk_eff, body, (acc0, m0, l0))
+            @pl.when(diag)
+            def _():
+                iq = qi * bq + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 0)
+                ik = j * bk + jax.lax.broadcasted_iota(
+                    jnp.int32, (bq, bk), 1)
+                s_ref_val = jnp.where(iq >= ik, s, NEG_INF)
+                _online_update(s_ref_val, v, acc_ref, m_ref, l_ref)
 
-    l_safe = jnp.where(l == 0.0, 1.0, l)
-    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
-    # lse carries a trailing singleton lane dim: TPU block mappings need
-    # the last two block dims (8,128)-divisible OR equal to the array dims
-    lse_ref[0] = (m + jnp.log(l_safe)).astype(jnp.float32)[:, None]
+            @pl.when(jnp.logical_not(diag))
+            def _():
+                _online_update(s, v, acc_ref, m_ref, l_ref)
+        else:
+            _online_update(s, v, acc_ref, m_ref, l_ref)
+
+    @pl.when(j == j_last)
+    def _finish():
+        l = l_ref[:, 0]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
+        lse_ref[0] = (m_ref[:, :1] + jnp.log(l_safe)[:, None]) \
+            .astype(jnp.float32)
+
+
+def _online_update(s, v, acc_ref, m_ref, l_ref):
+    m_prev = m_ref[:, 0]
+    l_prev = l_ref[:, 0]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_new[:, None])
+    alpha = jnp.exp(m_prev - m_new)
+    l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+    acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot(
+        p.astype(v.dtype), v, preferred_element_type=jnp.float32)
+    m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+    l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
 
 
 def _fa_forward(q, k, v, causal, scale, bq, bk):
     BH, S, D = q.shape
-    grid = (BH, S // bq)
-    kernel = functools.partial(_fa_fwd_kernel, bq=bq, bk=bk, seq_len=S,
+    nk = S // bk
+    grid = (BH, S // bq, nk)
+    kernel = functools.partial(_fa_fwd_kernel, bq=bq, bk=bk, nk=nk,
                                causal=causal, scale=scale)
     out, lse = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, S, D), q.dtype),
             jax.ShapeDtypeStruct((BH, S, 1), jnp.float32),
         ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=_interpret(),
     )(q, k, v)
     return out, lse
@@ -115,24 +149,27 @@ def _fa_forward(q, k, v, causal, scale, bq, bk):
 # backward
 # ---------------------------------------------------------------------------
 
-def _fa_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                        dk_ref, dv_ref, *, bq, bk, seq_len, causal, scale):
+def _fa_bwd_dkdv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                        dk_ref, dv_ref, dk_acc, dv_acc,
+                        *, bq, bk, nq, causal, scale):
     ki = pl.program_id(1)
-    k = k_ref[0].astype(jnp.float32)  # [bk, d]
-    v = v_ref[0].astype(jnp.float32)
-    d = k.shape[-1]
-    nq = seq_len // bq
-    if causal:
-        start = (ki * bk) // bq  # first q block that can see this k block
-    else:
-        start = 0
+    i = pl.program_id(2)
+    i_start = (ki * bk) // bq if causal else 0
+    run = i >= i_start if causal else True
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        do = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * bq, bq), :][:, 0]
-        delta = delta_ref[0, pl.ds(i * bq, bq), :][:, 0]
+    @pl.when(i == 0)
+    def _init():
+        dk_acc[...] = jnp.zeros_like(dk_acc)
+        dv_acc[...] = jnp.zeros_like(dv_acc)
+
+    @pl.when(run)
+    def _body():
+        k = k_ref[0]  # [bk, d]
+        v = v_ref[0]
+        q = q_ref[0]  # [bq, d]
+        do = do_ref[0]
+        lse = lse_ref[0][:, 0]
+        delta = delta_ref[0][:, 0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -140,41 +177,43 @@ def _fa_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ik = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             s = jnp.where(iq >= ik, s, NEG_INF)
         p = jnp.exp(s - lse[:, None])  # [bq, bk]
-        dv_new = dv + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
+        pb = p.astype(do.dtype)
+        dv_acc[...] += jax.lax.dot_general(
+            pb, do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
-        dk_new = dk + jax.lax.dot_general(
+        ds = (p * (dp - delta[:, None]) * scale).astype(q.dtype)
+        dk_acc[...] += jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        return dk_new, dv_new
 
-    dk0 = jnp.zeros((bk, d), jnp.float32)
-    dv0 = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(start, nq, body, (dk0, dv0))
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    @pl.when(i == nq - 1)
+    def _finish():
+        dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                      dq_ref, *, bq, bk, seq_len, causal, scale):
+def _fa_bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
+                      dq_ref, dq_acc, *, bq, bk, nk, causal, scale):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32)
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, 0]
-    delta = delta_ref[0][:, 0]
-    d = q.shape[-1]
-    nk = seq_len // bk
-    if causal:
-        nk_eff = jnp.minimum(((qi + 1) * bq + bk - 1) // bk, nk)
-    else:
-        nk_eff = nk
+    j = pl.program_id(2)
+    j_last = jnp.minimum(((qi + 1) * bq - 1) // bk, nk - 1) if causal \
+        else nk - 1
+    run = j <= j_last if causal else True
 
-    def body(j, dq):
-        k = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+    @pl.when(j == 0)
+    def _init():
+        dq_acc[...] = jnp.zeros_like(dq_acc)
+
+    @pl.when(run)
+    def _body():
+        q = q_ref[0]
+        do = do_ref[0]
+        lse = lse_ref[0][:, 0]
+        delta = delta_ref[0][:, 0]
+        k = k_ref[0]
+        v = v_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
         if causal:
@@ -184,12 +223,13 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         p = jnp.exp(s - lse[:, None])
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None]) * scale
-        return dq + jax.lax.dot(ds, k,
-                                preferred_element_type=jnp.float32)
+        ds = (p * (dp - delta[:, None]) * scale).astype(k.dtype)
+        dq_acc[...] += jax.lax.dot(ds, k,
+                                   preferred_element_type=jnp.float32)
 
-    dq = jax.lax.fori_loop(0, nk_eff, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0] = dq.astype(dq_ref.dtype)
+    @pl.when(j == j_last)
+    def _finish():
+        dq_ref[0] = dq_acc[...].astype(dq_ref.dtype)
 
 
 def _fa_backward(res, g, causal, scale, bq, bk):
@@ -198,45 +238,54 @@ def _fa_backward(res, g, causal, scale, bq, bk):
     delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32),
                     axis=-1)[..., None]  # [BH, S, 1] (lane-dim, see fwd)
     interp = _interpret()
-    dkdv = pl.pallas_call(
-        functools.partial(_fa_bwd_dkdv_kernel, bq=bq, bk=bk, seq_len=S,
+    nq, nk = S // bq, S // bk
+    seq_params = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "arbitrary"))
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkdv_kernel, bq=bq, bk=bk, nq=nq,
                           causal=causal, scale=scale),
-        grid=(BH, S // bk),
+        grid=(BH, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, S, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, S, D), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, S, 1), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, S, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, j, i: (b, i, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((BH, S, D), q.dtype),
             jax.ShapeDtypeStruct((BH, S, D), q.dtype),
         ],
-        interpret=interp,
-    )(q, k, v, g, lse, delta)
-    dk, dv = dkdv
-    dq = pl.pallas_call(
-        functools.partial(_fa_bwd_dq_kernel, bq=bq, bk=bk, seq_len=S,
-                          causal=causal, scale=scale),
-        grid=(BH, S // bq),
-        in_specs=[
-            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, S, D), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
         ],
-        out_specs=[pl.BlockSpec((1, bq, D), lambda b, i: (b, i, 0))],
-        out_shape=[jax.ShapeDtypeStruct((BH, S, D), q.dtype)],
+        compiler_params=seq_params,
         interpret=interp,
-    )(q, k, v, g, lse, delta)[0]
+    )(k, v, q, g, lse, delta)
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, bq=bq, bk=bk, nk=nk,
+                          causal=causal, scale=scale),
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((BH, S, D), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=seq_params,
+        interpret=interp,
+    )(q, g, lse, delta, k, v)[0]
     return dq, dk, dv
 
 
@@ -265,6 +314,11 @@ def _flash_fwd_rule(q, k, v, causal, scale):
     bk = _choose_block(S)
     qp, kp, vp = _pack(q), _pack(k), _pack(v)
     out, lse = _fa_forward(qp, kp, vp, causal, scale, bq, bk)
+    # named so remat policies can keep the flash residuals and skip the
+    # whole forward-kernel recompute in the backward pass
+    # (models/gpt.py "save_dots" saves these alongside matmul outputs)
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
     return _unpack(out, B, H), (qp, kp, vp, out, lse, B, H, bq, bk)
 
 
