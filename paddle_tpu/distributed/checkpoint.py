@@ -6,11 +6,16 @@ shards + a global Metadata mapping tensor -> (local shape, offset, file);
 load reads intersecting shards and reshards to the current placements.
 
 TPU-native: the same contract over jax.Array addressable shards. Every
-process writes the shards it owns (dedup: only the lowest-rank replica
-writes); metadata records global shape + index ranges; load assembles the
-requested region and ``device_put``s with the *target* sharding — loading
-under a different mesh/parallelism works by construction. ``async_save``
-snapshots to host then writes on a worker thread (reference's async_save).
+process writes the shards it owns (global dedup: only the shard whose
+``replica_id`` is 0 is written, so replicated params land exactly once
+across the whole job) plus its own ``{rank}.metadata.json``; load globs
+every rank's metadata, merges the shard lists, and reads ONLY the file
+regions intersecting each local device's slice of the *target* sharding
+(np.load mmap reads) — loading under a different mesh/parallelism
+reshards by construction, without ever materializing the global tensor
+in host RAM. A coverage check raises on orphaned/missing shards instead
+of silently zero-filling. ``async_save`` snapshots to host then writes
+on a worker thread (reference's async_save).
 """
 from __future__ import annotations
 
@@ -55,7 +60,25 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     os.makedirs(path, exist_ok=True)
     flat = _flatten(state_dict)
     rank = jax.process_index()
-    meta: Dict[str, Any] = {"tensors": {}, "non_tensors": {}}
+    # overwrite semantics: remove this rank's previous shard files (from
+    # its old metadata) so a re-save with a different sharding cannot
+    # leave stale shards that a later load would merge in. A re-save
+    # with FEWER processes is caught at load time via world_size.
+    old_meta_path = os.path.join(path, f"{rank}.metadata.json")
+    if os.path.exists(old_meta_path):
+        try:
+            with open(old_meta_path) as f:
+                old = json.load(f)
+            for entry in old.get("tensors", {}).values():
+                for shard in entry.get("shards", []):
+                    try:
+                        os.remove(os.path.join(path, shard["file"]))
+                    except OSError:
+                        pass
+        except (json.JSONDecodeError, OSError):
+            pass
+    meta: Dict[str, Any] = {"tensors": {}, "non_tensors": {},
+                            "world_size": jax.process_count()}
     writes = []
 
     for key, val in flat.items():
@@ -71,26 +94,31 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
                      arr)).dtype) if not hasattr(arr, "dtype")
                  else str(np.dtype(arr.dtype)), "shards": []}
         if isinstance(arr, jax.Array) and hasattr(arr, "addressable_shards"):
-            seen_index = set()
             for i, shard in enumerate(arr.addressable_shards):
+                # replica_id is global: exactly one copy of every shard
+                # index is written across ALL processes (reference
+                # save_state_dict.py dedup_tensor, rank-0-replica rule)
+                if shard.replica_id != 0:
+                    continue
                 idx = tuple(
                     (0 if s.start is None else s.start,
                      dim if s.stop is None else s.stop)
                     for s, dim in zip(shard.index, np.shape(arr)))
-                if idx in seen_index:
-                    continue  # dedup replicated shards on this process
-                seen_index.add(idx)
                 fname = f"{key.replace('/', '_')}.{rank}.{i}.distcp.npy"
                 entry["shards"].append({"file": fname,
                                         "index": [list(p) for p in idx]})
                 writes.append((os.path.join(path, fname),
                                shard.data))
         else:
-            fname = f"{key.replace('/', '_')}.{rank}.0.distcp.npy"
-            entry["shards"].append({
-                "file": fname,
-                "index": [[0, d] for d in np.shape(arr)]})
-            writes.append((os.path.join(path, fname), arr))
+            # host-side arrays are identical on every process: only the
+            # coordinator writes (the jax.Array branch dedups via
+            # replica_id; this is the same rule for np data)
+            if rank == coordinator_rank:
+                fname = f"{key.replace('/', '_')}.{rank}.0.distcp.npy"
+                entry["shards"].append({
+                    "file": fname,
+                    "index": [[0, d] for d in np.shape(arr)]})
+                writes.append((os.path.join(path, fname), arr))
         meta["tensors"][key] = entry
 
     def do_write():
@@ -106,9 +134,16 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     else:
         do_write()
 
-    if rank == coordinator_rank:
-        with open(os.path.join(path, f"{rank}.metadata.json"), "w") as f:
-            json.dump(meta, f)
+    # EVERY rank writes its own metadata file: each process only knows
+    # about its addressable shards, so a coordinator-only write would
+    # orphan every other rank's shard files (load merges the globbed
+    # {rank}.metadata.json files)
+    with open(os.path.join(path, f"{rank}.metadata.json"), "w") as f:
+        # numpy scalars (np.int32 step counters etc.) land in
+        # non_tensors; serialize them as their python values
+        json.dump(meta, f,
+                  default=lambda o: o.item() if hasattr(o, "item")
+                  else str(o))
 
 
 _pending = []
@@ -130,30 +165,142 @@ def load_state_dict(state_dict: Dict[str, Any], path: str,
     if not metas:
         raise FileNotFoundError(f"no metadata.json under {path}")
     meta = {"tensors": {}, "non_tensors": {}}
+    world_sizes = set()
     for m in metas:
         with open(os.path.join(path, m)) as f:
             part = json.load(f)
-        meta["tensors"].update(part.get("tensors", {}))
+        world_sizes.add(part.get("world_size"))
+        # merge per-rank metadata: same tensor key appears in several
+        # rank files, each contributing its own shard list
+        for key, entry in part.get("tensors", {}).items():
+            cur = meta["tensors"].setdefault(
+                key, {"shape": entry["shape"], "dtype": entry["dtype"],
+                      "shards": []})
+            if list(cur["shape"]) != list(entry["shape"]):
+                raise ValueError(
+                    f"inconsistent shapes for {key!r} across rank "
+                    f"metadata: {cur['shape']} vs {entry['shape']}")
+            cur["shards"].extend(entry["shards"])
         meta["non_tensors"].update(part.get("non_tensors", {}))
+    ws = world_sizes - {None}
+    if len(ws) > 1 or (ws and len(metas) != next(iter(ws))):
+        raise ValueError(
+            f"stale checkpoint at {path}: {len(metas)} rank metadata "
+            f"files but world_size(s) {sorted(ws)} — was the directory "
+            f"re-used by a save with a different process count?")
+    for key, entry in meta["tensors"].items():
+        _check_no_overlap(key, entry["shards"])
 
     flat = _flatten(state_dict)
     for key, target in flat.items():
         if key in meta["non_tensors"]:
+            _set_nested(state_dict, key, meta["non_tensors"][key])
             continue
         info = meta["tensors"].get(key)
         if info is None:
             raise KeyError(f"checkpoint missing tensor {key!r}")
-        full = np.zeros(info["shape"], dtype=np.dtype(info["dtype"]))
-        for shard in info["shards"]:
-            data = np.load(os.path.join(path, shard["file"]))
-            sl = tuple(slice(a, b) for a, b in shard["index"])
-            full[sl] = data
+        shape = tuple(info["shape"])
         if isinstance(target, Tensor):
+            tgt_dtype = np.dtype(str(np.dtype(target._data.dtype)))
             sharding = getattr(target._data, "sharding", None)
-            arr = jax.device_put(full.astype(
-                np.dtype(str(np.dtype(target._data.dtype)))), sharding) \
-                if sharding is not None else jax.numpy.asarray(full)
+            if sharding is not None and tuple(target._data.shape) == shape:
+                # shard-wise load: each local device reads ONLY the file
+                # regions intersecting its slice of the target sharding
+                # (memoized — replicated dims map many devices to the
+                # same region; read it once)
+                idx_map = sharding.addressable_devices_indices_map(shape)
+                cache: Dict[Any, np.ndarray] = {}
+                bufs = []
+                for dev, idx in idx_map.items():
+                    region = _normalize_index(idx, shape)
+                    ck = tuple(region)
+                    if ck not in cache:
+                        cache[ck] = _read_region(path, info, region,
+                                                 tgt_dtype, key)
+                    bufs.append(jax.device_put(cache[ck], dev))
+                arr = jax.make_array_from_single_device_arrays(
+                    shape, sharding, bufs)
+            else:
+                full = _read_region(
+                    path, info, [(0, d) for d in shape], tgt_dtype, key)
+                arr = jax.device_put(full, sharding) \
+                    if sharding is not None else jax.numpy.asarray(full)
             target._data = arr
             target.grad_node = None
         else:
-            flat[key] = full
+            loaded = _read_region(
+                path, info, [(0, d) for d in shape],
+                np.dtype(info["dtype"]), key)
+            if isinstance(target, np.ndarray) and target.shape == shape:
+                target[...] = loaded  # in-place keeps aliases coherent
+            else:
+                _set_nested(state_dict, key, loaded)
+
+
+def _set_nested(state: Dict[str, Any], key: str, value) -> None:
+    """Write a loaded non-Tensor leaf back into the nested state dict."""
+    parts = key.split(".")
+    d = state
+    for p in parts[:-1]:
+        d = d[p]
+    d[parts[-1]] = value
+
+
+def _check_no_overlap(key, shards):
+    """Merged shard lists must tile without overlap — overlapping
+    regions mean two saves' files got mixed in one directory.
+    Sweep over dim-0 start offsets keeps this near-linear for the
+    common leading-dim sharding instead of all-pairs."""
+    order = sorted(range(len(shards)),
+                   key=lambda i: [p[0] for p in shards[i]["index"]])
+    for oi in range(len(order)):
+        i = order[oi]
+        a = shards[i]["index"]
+        if not a:
+            continue
+        for oj in range(oi + 1, len(order)):
+            j = order[oj]
+            b = shards[j]["index"]
+            if b[0][0] >= a[0][1]:
+                break  # sorted by dim-0 start: no further dim-0 overlap
+            if all(max(a0, b0) < min(a1, b1)
+                   for (a0, a1), (b0, b1) in zip(a, b)):
+                raise ValueError(
+                    f"overlapping shards for {key!r}: {a} vs {b} "
+                    f"({shards[i]['file']}, {shards[j]['file']}) — "
+                    f"stale files from a previous save?")
+
+
+def _normalize_index(idx, shape):
+    """jax device index (tuple of slices, possibly open) -> [(a, b)]."""
+    return [(0 if s.start is None else int(s.start),
+             d if s.stop is None else int(s.stop))
+            for s, d in zip(idx, shape)]
+
+
+def _read_region(path, info, region, out_dtype, key):
+    """Assemble one rectangular region of a checkpointed tensor from the
+    intersecting shard files (mmap reads — only the needed bytes move).
+    Raises if any part of the region is not covered by a shard."""
+    out = np.zeros([b - a for a, b in region], out_dtype)
+    want = int(np.prod([b - a for a, b in region], dtype=np.int64))
+    got = 0
+    for shard in info["shards"]:
+        s_idx = shard["index"]
+        inter = [(max(a1, a2), min(b1, b2))
+                 for (a1, b1), (a2, b2) in zip(region, s_idx)]
+        if any(a >= b for a, b in inter):
+            continue
+        data = np.load(os.path.join(path, shard["file"]), mmap_mode="r")
+        src = tuple(slice(a - s0, b - s0)
+                    for (a, b), (s0, _) in zip(inter, s_idx))
+        dst = tuple(slice(a - r0, b - r0)
+                    for (a, b), (r0, _) in zip(inter, region))
+        out[dst] = np.asarray(data[src]).astype(out_dtype)
+        got += int(np.prod([b - a for a, b in inter], dtype=np.int64))
+    if got < want:
+        raise ValueError(
+            f"checkpoint shards cover only {got}/{want} elements of the "
+            f"requested region of {key!r} — missing or orphaned shard "
+            f"files (was the checkpoint saved by every rank?)")
+    return out
