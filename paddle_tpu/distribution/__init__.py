@@ -158,9 +158,14 @@ class LogNormal(Normal):
                         _op_name="lognormal_rsample_exp")
 
     def log_prob(self, value):
-        v = _t(value)
-        return Tensor(jax.scipy.stats.norm.logpdf(jnp.log(v), self.loc,
-                                                  self.scale) - jnp.log(v))
+        def f(v, l, s):
+            lv = jnp.log(v)
+            return (-((lv - l) ** 2) / (2 * s ** 2) - jnp.log(s)
+                    - 0.5 * math.log(2 * math.pi) - lv)
+
+        v = value if isinstance(value, Tensor) else _t(value)
+        return apply_op(f, v, self._loc_p, self._scale_p,
+                        _op_name="lognormal_log_prob")
 
     @property
     def mean(self):
@@ -171,6 +176,11 @@ class Uniform(Distribution):
     def __init__(self, low, high, name=None):
         self.low = _t(low)
         self.high = _t(high)
+        # original Tensors kept so log_prob/entropy/rsample record on
+        # the tape (same contract as Normal above; reference
+        # distribution/uniform.py is differentiable in low/high)
+        self._low_p = low if isinstance(low, Tensor) else self.low
+        self._high_p = high if isinstance(high, Tensor) else self.high
         super().__init__(jnp.broadcast_shapes(self.low.shape,
                                               self.high.shape))
 
@@ -187,16 +197,26 @@ class Uniform(Distribution):
                                _shape(shape) + self.batch_shape)
         return Tensor(self.low + (self.high - self.low) * u)
 
-    rsample = sample
+    def rsample(self, shape=()):
+        u = jax.random.uniform(rnd.next_key(),
+                               _shape(shape) + self.batch_shape)
+        return apply_op(lambda lo, hi: lo + (hi - lo) * u,
+                        self._low_p, self._high_p,
+                        _op_name="uniform_rsample")
 
     def log_prob(self, value):
-        v = _t(value)
-        inside = (v >= self.low) & (v < self.high)
-        return Tensor(jnp.where(inside, -jnp.log(self.high - self.low),
-                                -jnp.inf))
+        def f(v, lo, hi):
+            inside = (v >= lo) & (v < hi)
+            return jnp.where(inside, -jnp.log(hi - lo), -jnp.inf)
+
+        v = value if isinstance(value, Tensor) else _t(value)
+        return apply_op(f, v, self._low_p, self._high_p,
+                        _op_name="uniform_log_prob")
 
     def entropy(self):
-        return Tensor(jnp.log(self.high - self.low))
+        return apply_op(lambda lo, hi: jnp.log(hi - lo),
+                        self._low_p, self._high_p,
+                        _op_name="uniform_entropy")
 
 
 class Bernoulli(Distribution):
@@ -315,6 +335,7 @@ class Categorical(Distribution):
 class Exponential(Distribution):
     def __init__(self, rate, name=None):
         self.rate = _t(rate)
+        self._rate_p = rate if isinstance(rate, Tensor) else self.rate
         super().__init__(self.rate.shape)
 
     @property
@@ -330,20 +351,28 @@ class Exponential(Distribution):
                                    _shape(shape) + self.batch_shape)
         return Tensor(e / self.rate)
 
-    rsample = sample
+    def rsample(self, shape=()):
+        e = jax.random.exponential(rnd.next_key(),
+                                   _shape(shape) + self.batch_shape)
+        return apply_op(lambda r: e / r, self._rate_p,
+                        _op_name="exponential_rsample")
 
     def log_prob(self, value):
-        v = _t(value)
-        return Tensor(jnp.log(self.rate) - self.rate * v)
+        v = value if isinstance(value, Tensor) else _t(value)
+        return apply_op(lambda vv, r: jnp.log(r) - r * vv,
+                        v, self._rate_p, _op_name="exponential_log_prob")
 
     def entropy(self):
-        return Tensor(1.0 - jnp.log(self.rate))
+        return apply_op(lambda r: 1.0 - jnp.log(r), self._rate_p,
+                        _op_name="exponential_entropy")
 
 
 class Beta(Distribution):
     def __init__(self, alpha, beta, name=None):
         self.alpha = _t(alpha)
         self.beta = _t(beta)
+        self._alpha_p = alpha if isinstance(alpha, Tensor) else self.alpha
+        self._beta_p = beta if isinstance(beta, Tensor) else self.beta
         super().__init__(jnp.broadcast_shapes(self.alpha.shape,
                                               self.beta.shape))
 
@@ -356,24 +385,55 @@ class Beta(Distribution):
                                       self.beta,
                                       _shape(shape) + self.batch_shape))
 
+    def rsample(self, shape=()):
+        """Implicitly reparameterized via two gamma draws — jax's
+        gamma sampler carries implicit-gradient rules w.r.t. its shape
+        parameter (the reference relies on paddle.standard_gamma's
+        implicit grads the same way)."""
+        out_shape = _shape(shape) + self.batch_shape
+        k1, k2 = jax.random.split(rnd.next_key())
+
+        def f(a, b):
+            ga = jax.random.gamma(k1, jnp.broadcast_to(a, out_shape))
+            gb = jax.random.gamma(k2, jnp.broadcast_to(b, out_shape))
+            return ga / (ga + gb)
+
+        return apply_op(f, self._alpha_p, self._beta_p,
+                        _op_name="beta_rsample")
+
     def log_prob(self, value):
-        return Tensor(jax.scipy.stats.beta.logpdf(_t(value), self.alpha,
-                                                  self.beta))
+        def f(v, a, b):
+            gammaln = jax.scipy.special.gammaln
+            ok = (v > 0) & (v < 1)
+            vs = jnp.where(ok, v, 0.5)  # keep the grad path nan-free
+            lp = ((a - 1) * jnp.log(vs) + (b - 1) * jnp.log1p(-vs)
+                  - (gammaln(a) + gammaln(b) - gammaln(a + b)))
+            return jnp.where(ok, lp, -jnp.inf)
+
+        v = value if isinstance(value, Tensor) else _t(value)
+        return apply_op(f, v, self._alpha_p, self._beta_p,
+                        _op_name="beta_log_prob")
 
     def entropy(self):
-        a, b = self.alpha, self.beta
-        dg = jax.scipy.special.digamma
-        ln_beta = (jax.scipy.special.gammaln(a)
-                   + jax.scipy.special.gammaln(b)
-                   - jax.scipy.special.gammaln(a + b))
-        return Tensor(ln_beta - (a - 1) * dg(a) - (b - 1) * dg(b)
-                      + (a + b - 2) * dg(a + b))
+        def f(a, b):
+            dg = jax.scipy.special.digamma
+            ln_beta = (jax.scipy.special.gammaln(a)
+                       + jax.scipy.special.gammaln(b)
+                       - jax.scipy.special.gammaln(a + b))
+            return (ln_beta - (a - 1) * dg(a) - (b - 1) * dg(b)
+                    + (a + b - 2) * dg(a + b))
+
+        return apply_op(f, self._alpha_p, self._beta_p,
+                        _op_name="beta_entropy")
 
 
 class Gamma(Distribution):
     def __init__(self, concentration, rate, name=None):
         self.concentration = _t(concentration)
         self.rate = _t(rate)
+        self._conc_p = concentration if isinstance(concentration, Tensor) \
+            else self.concentration
+        self._rate_p = rate if isinstance(rate, Tensor) else self.rate
         super().__init__(jnp.broadcast_shapes(self.concentration.shape,
                                               self.rate.shape))
 
@@ -386,19 +446,45 @@ class Gamma(Distribution):
                              _shape(shape) + self.batch_shape)
         return Tensor(g / self.rate)
 
+    def rsample(self, shape=()):
+        """jax.random.gamma implements implicit reparameterization
+        gradients w.r.t. the concentration; rate is pathwise."""
+        out_shape = _shape(shape) + self.batch_shape
+        key = rnd.next_key()
+
+        def f(a, r):
+            g = jax.random.gamma(key, jnp.broadcast_to(a, out_shape))
+            return g / r
+
+        return apply_op(f, self._conc_p, self._rate_p,
+                        _op_name="gamma_rsample")
+
     def log_prob(self, value):
-        return Tensor(jax.scipy.stats.gamma.logpdf(
-            _t(value), self.concentration, scale=1.0 / self.rate))
+        def f(v, a, r):
+            ok = v > 0
+            vs = jnp.where(ok, v, 1.0)
+            lp = (a * jnp.log(r) + (a - 1) * jnp.log(vs) - r * vs
+                  - jax.scipy.special.gammaln(a))
+            return jnp.where(ok, lp, -jnp.inf)
+
+        v = value if isinstance(value, Tensor) else _t(value)
+        return apply_op(f, v, self._conc_p, self._rate_p,
+                        _op_name="gamma_log_prob")
 
     def entropy(self):
-        a, b = self.concentration, self.rate
-        return Tensor(a - jnp.log(b) + jax.scipy.special.gammaln(a)
-                      + (1 - a) * jax.scipy.special.digamma(a))
+        def f(a, b):
+            return (a - jnp.log(b) + jax.scipy.special.gammaln(a)
+                    + (1 - a) * jax.scipy.special.digamma(a))
+
+        return apply_op(f, self._conc_p, self._rate_p,
+                        _op_name="gamma_entropy")
 
 
 class Dirichlet(Distribution):
     def __init__(self, concentration, name=None):
         self.concentration = _t(concentration)
+        self._conc_p = concentration \
+            if isinstance(concentration, Tensor) else self.concentration
         super().__init__(self.concentration.shape[:-1],
                          self.concentration.shape[-1:])
 
@@ -407,15 +493,49 @@ class Dirichlet(Distribution):
             rnd.next_key(), self.concentration,
             _shape(shape) + self.batch_shape))
 
+    def rsample(self, shape=()):
+        """Normalized implicit-gradient gamma draws."""
+        out_shape = (_shape(shape) + self.batch_shape
+                     + self.event_shape)
+        key = rnd.next_key()
+
+        def f(c):
+            g = jax.random.gamma(key, jnp.broadcast_to(c, out_shape))
+            return g / jnp.sum(g, axis=-1, keepdims=True)
+
+        return apply_op(f, self._conc_p, _op_name="dirichlet_rsample")
+
     def log_prob(self, value):
-        return Tensor(jax.scipy.stats.dirichlet.logpdf(
-            jnp.moveaxis(_t(value), -1, 0), self.concentration))
+        def f(v, c):
+            gammaln = jax.scipy.special.gammaln
+            ok = jnp.all(v > 0, axis=-1)
+            vs = jnp.where(v > 0, v, 1.0)
+            lp = (jnp.sum((c - 1) * jnp.log(vs), axis=-1)
+                  + gammaln(jnp.sum(c, axis=-1))
+                  - jnp.sum(gammaln(c), axis=-1))
+            return jnp.where(ok, lp, -jnp.inf)
+
+        v = value if isinstance(value, Tensor) else _t(value)
+        return apply_op(f, v, self._conc_p, _op_name="dirichlet_log_prob")
+
+    def entropy(self):
+        def f(c):
+            gammaln = jax.scipy.special.gammaln
+            dg = jax.scipy.special.digamma
+            c0 = jnp.sum(c, axis=-1)
+            k = c.shape[-1]
+            ln_b = jnp.sum(gammaln(c), axis=-1) - gammaln(c0)
+            return (ln_b + (c0 - k) * dg(c0)
+                    - jnp.sum((c - 1) * dg(c), axis=-1))
+
+        return apply_op(f, self._conc_p, _op_name="dirichlet_entropy")
 
 
 class Multinomial(Distribution):
     def __init__(self, total_count, probs, name=None):
         self.total_count = int(total_count)
         self.probs = _t(probs)
+        self._probs_p = probs if isinstance(probs, Tensor) else self.probs
         super().__init__(self.probs.shape[:-1], self.probs.shape[-1:])
 
     def sample(self, shape=()):
@@ -427,17 +547,25 @@ class Multinomial(Distribution):
         return Tensor(counts)
 
     def log_prob(self, value):
-        v = _t(value)
-        logits = jnp.log(jnp.maximum(self.probs, 1e-30))
-        return Tensor(jax.scipy.special.gammaln(self.total_count + 1) -
-                      jnp.sum(jax.scipy.special.gammaln(v + 1), -1) +
-                      jnp.sum(v * logits, -1))
+        n = self.total_count
+
+        def f(v, p):
+            logits = jnp.log(jnp.maximum(p, 1e-30))
+            return (jax.scipy.special.gammaln(n + 1.0) -
+                    jnp.sum(jax.scipy.special.gammaln(v + 1), -1) +
+                    jnp.sum(v * logits, -1))
+
+        v = value if isinstance(value, Tensor) else _t(value)
+        return apply_op(f, v, self._probs_p,
+                        _op_name="multinomial_log_prob")
 
 
 class Laplace(Distribution):
     def __init__(self, loc, scale, name=None):
         self.loc = _t(loc)
         self.scale = _t(scale)
+        self._loc_p = loc if isinstance(loc, Tensor) else self.loc
+        self._scale_p = scale if isinstance(scale, Tensor) else self.scale
         super().__init__(jnp.broadcast_shapes(self.loc.shape,
                                               self.scale.shape))
 
@@ -449,19 +577,32 @@ class Laplace(Distribution):
         return Tensor(self.loc + self.scale * jax.random.laplace(
             rnd.next_key(), _shape(shape) + self.batch_shape))
 
+    def rsample(self, shape=()):
+        eps = jax.random.laplace(rnd.next_key(),
+                                 _shape(shape) + self.batch_shape)
+        return apply_op(lambda l, s: l + s * eps,
+                        self._loc_p, self._scale_p,
+                        _op_name="laplace_rsample")
+
     def log_prob(self, value):
-        v = _t(value)
-        return Tensor(-jnp.abs(v - self.loc) / self.scale -
-                      jnp.log(2 * self.scale))
+        def f(v, l, s):
+            return -jnp.abs(v - l) / s - jnp.log(2 * s)
+
+        v = value if isinstance(value, Tensor) else _t(value)
+        return apply_op(f, v, self._loc_p, self._scale_p,
+                        _op_name="laplace_log_prob")
 
     def entropy(self):
-        return Tensor(1 + jnp.log(2 * self.scale))
+        return apply_op(lambda s: 1 + jnp.log(2 * s), self._scale_p,
+                        _op_name="laplace_entropy")
 
 
 class Gumbel(Distribution):
     def __init__(self, loc, scale, name=None):
         self.loc = _t(loc)
         self.scale = _t(scale)
+        self._loc_p = loc if isinstance(loc, Tensor) else self.loc
+        self._scale_p = scale if isinstance(scale, Tensor) else self.scale
         super().__init__(jnp.broadcast_shapes(self.loc.shape,
                                               self.scale.shape))
 
@@ -473,9 +614,25 @@ class Gumbel(Distribution):
         return Tensor(self.loc + self.scale * jax.random.gumbel(
             rnd.next_key(), _shape(shape) + self.batch_shape))
 
+    def rsample(self, shape=()):
+        g = jax.random.gumbel(rnd.next_key(),
+                              _shape(shape) + self.batch_shape)
+        return apply_op(lambda l, s: l + s * g,
+                        self._loc_p, self._scale_p,
+                        _op_name="gumbel_rsample")
+
     def log_prob(self, value):
-        z = (_t(value) - self.loc) / self.scale
-        return Tensor(-(z + jnp.exp(-z)) - jnp.log(self.scale))
+        def f(v, l, s):
+            z = (v - l) / s
+            return -(z + jnp.exp(-z)) - jnp.log(s)
+
+        v = value if isinstance(value, Tensor) else _t(value)
+        return apply_op(f, v, self._loc_p, self._scale_p,
+                        _op_name="gumbel_log_prob")
+
+    def entropy(self):
+        return apply_op(lambda s: jnp.log(s) + 1 + np.euler_gamma,
+                        self._scale_p, _op_name="gumbel_entropy")
 
 
 class Geometric(Distribution):
@@ -485,6 +642,7 @@ class Geometric(Distribution):
 
     def __init__(self, probs, name=None):
         self.probs = _t(probs)
+        self._probs_p = probs if isinstance(probs, Tensor) else self.probs
         super().__init__(self.probs.shape)
 
     @property
@@ -498,13 +656,23 @@ class Geometric(Distribution):
             _shape(shape) + self.batch_shape) - 1).astype(jnp.float32))
 
     def log_prob(self, value):
-        v = _t(value)
-        return Tensor(v * jnp.log1p(-self.probs) + jnp.log(self.probs))
+        v = value if isinstance(value, Tensor) else _t(value)
+        return apply_op(lambda vv, p: vv * jnp.log1p(-p) + jnp.log(p),
+                        v, self._probs_p, _op_name="geometric_log_prob")
+
+    def entropy(self):
+        def f(p):
+            q = 1 - p
+            return -(q * jnp.log(jnp.maximum(q, 1e-12)) +
+                     p * jnp.log(jnp.maximum(p, 1e-12))) / p
+
+        return apply_op(f, self._probs_p, _op_name="geometric_entropy")
 
 
 class Poisson(Distribution):
     def __init__(self, rate, name=None):
         self.rate = _t(rate)
+        self._rate_p = rate if isinstance(rate, Tensor) else self.rate
         super().__init__(self.rate.shape)
 
     @property
@@ -517,13 +685,20 @@ class Poisson(Distribution):
             _shape(shape) + self.batch_shape).astype(jnp.float32))
 
     def log_prob(self, value):
-        return Tensor(jax.scipy.stats.poisson.logpmf(_t(value), self.rate))
+        def f(v, r):
+            return (v * jnp.log(r) - r
+                    - jax.scipy.special.gammaln(v + 1))
+
+        v = value if isinstance(value, Tensor) else _t(value)
+        return apply_op(f, v, self._rate_p, _op_name="poisson_log_prob")
 
 
 class Cauchy(Distribution):
     def __init__(self, loc, scale, name=None):
         self.loc = _t(loc)
         self.scale = _t(scale)
+        self._loc_p = loc if isinstance(loc, Tensor) else self.loc
+        self._scale_p = scale if isinstance(scale, Tensor) else self.scale
         super().__init__(jnp.broadcast_shapes(self.loc.shape,
                                               self.scale.shape))
 
@@ -531,9 +706,25 @@ class Cauchy(Distribution):
         return Tensor(self.loc + self.scale * jax.random.cauchy(
             rnd.next_key(), _shape(shape) + self.batch_shape))
 
+    def rsample(self, shape=()):
+        c = jax.random.cauchy(rnd.next_key(),
+                              _shape(shape) + self.batch_shape)
+        return apply_op(lambda l, s: l + s * c,
+                        self._loc_p, self._scale_p,
+                        _op_name="cauchy_rsample")
+
     def log_prob(self, value):
-        return Tensor(jax.scipy.stats.cauchy.logpdf(_t(value), self.loc,
-                                                    self.scale))
+        def f(v, l, s):
+            z = (v - l) / s
+            return -math.log(math.pi) - jnp.log(s) - jnp.log1p(z * z)
+
+        v = value if isinstance(value, Tensor) else _t(value)
+        return apply_op(f, v, self._loc_p, self._scale_p,
+                        _op_name="cauchy_log_prob")
+
+    def entropy(self):
+        return apply_op(lambda s: jnp.log(4 * math.pi * s),
+                        self._scale_p, _op_name="cauchy_entropy")
 
 
 class StudentT(Distribution):
@@ -541,6 +732,9 @@ class StudentT(Distribution):
         self.df = _t(df)
         self.loc = _t(loc)
         self.scale = _t(scale)
+        self._df_p = df if isinstance(df, Tensor) else self.df
+        self._loc_p = loc if isinstance(loc, Tensor) else self.loc
+        self._scale_p = scale if isinstance(scale, Tensor) else self.scale
         super().__init__(jnp.broadcast_shapes(self.df.shape,
                                               self.loc.shape,
                                               self.scale.shape))
@@ -549,9 +743,39 @@ class StudentT(Distribution):
         return Tensor(self.loc + self.scale * jax.random.t(
             rnd.next_key(), self.df, _shape(shape) + self.batch_shape))
 
+    def rsample(self, shape=()):
+        """Pathwise in loc/scale (the t draw itself is not
+        differentiated w.r.t. df — matches torch's StudentT.rsample)."""
+        t = jax.random.t(rnd.next_key(), self.df,
+                         _shape(shape) + self.batch_shape)
+        return apply_op(lambda l, s: l + s * t,
+                        self._loc_p, self._scale_p,
+                        _op_name="studentt_rsample")
+
     def log_prob(self, value):
-        return Tensor(jax.scipy.stats.t.logpdf(_t(value), self.df,
-                                               self.loc, self.scale))
+        def f(v, df, l, s):
+            gammaln = jax.scipy.special.gammaln
+            z = (v - l) / s
+            return (gammaln((df + 1) / 2) - gammaln(df / 2)
+                    - 0.5 * jnp.log(df * math.pi) - jnp.log(s)
+                    - (df + 1) / 2 * jnp.log1p(z * z / df))
+
+        v = value if isinstance(value, Tensor) else _t(value)
+        return apply_op(f, v, self._df_p, self._loc_p, self._scale_p,
+                        _op_name="studentt_log_prob")
+
+    def entropy(self):
+        def f(df, s):
+            gammaln = jax.scipy.special.gammaln
+            dg = jax.scipy.special.digamma
+            h = ((df + 1) / 2 * (dg((df + 1) / 2) - dg(df / 2))
+                 + 0.5 * jnp.log(df) +
+                 (gammaln(df / 2) + gammaln(0.5)
+                  - gammaln((df + 1) / 2)))
+            return h + jnp.log(s)
+
+        return apply_op(f, self._df_p, self._scale_p,
+                        _op_name="studentt_entropy")
 
 
 # -- KL registry -----------------------------------------------------------
@@ -621,6 +845,7 @@ class Binomial(Distribution):
     def __init__(self, total_count, probs, name=None):
         self.total_count = _t(total_count)
         self.probs = _t(probs)
+        self._probs_p = probs if isinstance(probs, Tensor) else self.probs
         super().__init__(jnp.broadcast_shapes(self.total_count.shape,
                                               self.probs.shape))
 
@@ -639,12 +864,17 @@ class Binomial(Distribution):
         return Tensor(out.astype(jnp.int64))
 
     def log_prob(self, value):
-        v = _t(value).astype(jnp.float32)
         n = self.total_count.astype(jnp.float32)
-        from jax.scipy.special import gammaln
-        logc = gammaln(n + 1) - gammaln(v + 1) - gammaln(n - v + 1)
-        p = jnp.clip(self.probs, 1e-7, 1 - 1e-7)
-        return Tensor(logc + v * jnp.log(p) + (n - v) * jnp.log1p(-p))
+
+        def f(v, p):
+            from jax.scipy.special import gammaln
+            v = v.astype(jnp.float32)
+            logc = gammaln(n + 1) - gammaln(v + 1) - gammaln(n - v + 1)
+            p = jnp.clip(p, 1e-7, 1 - 1e-7)
+            return logc + v * jnp.log(p) + (n - v) * jnp.log1p(-p)
+
+        v = value if isinstance(value, Tensor) else _t(value)
+        return apply_op(f, v, self._probs_p, _op_name="binomial_log_prob")
 
     def entropy(self):
         # 2nd-order Stirling approximation (reference uses the same)
@@ -658,12 +888,16 @@ class Chi2(Gamma):
 
     def __init__(self, df, name=None):
         self.df = _t(df)
-        super().__init__(self.df / 2.0, jnp.full_like(_t(df), 0.5))
+        # keep df on the tape when it arrives as a Tensor (the /2 is
+        # itself a recorded op, so grads flow Chi2 -> Gamma -> df)
+        conc = df / 2.0 if isinstance(df, Tensor) else self.df / 2.0
+        super().__init__(conc, jnp.full_like(self.df, 0.5))
 
 
 class ContinuousBernoulli(Distribution):
     def __init__(self, probs, lims=(0.499, 0.501), name=None):
         self.probs = jnp.clip(_t(probs), 1e-6, 1 - 1e-6)
+        self._probs_p = probs if isinstance(probs, Tensor) else self.probs
         self._lims = lims
         super().__init__(self.probs.shape)
 
@@ -676,10 +910,20 @@ class ContinuousBernoulli(Distribution):
         return jnp.where(near_half, jnp.log(2.0), c)
 
     def log_prob(self, value):
-        v = _t(value)
-        p = self.probs
-        return Tensor(v * jnp.log(p) + (1 - v) * jnp.log1p(-p) +
-                      self._log_norm())
+        lims = self._lims
+
+        def f(v, p):
+            p = jnp.clip(p, 1e-6, 1 - 1e-6)
+            near_half = jnp.abs(p - 0.5) < (lims[1] - 0.5)
+            safe = jnp.where(near_half, 0.4, p)
+            c = jnp.log((2 * jnp.arctanh(1 - 2 * safe)) /
+                        (1 - 2 * safe + 1e-12))
+            log_norm = jnp.where(near_half, jnp.log(2.0), c)
+            return v * jnp.log(p) + (1 - v) * jnp.log1p(-p) + log_norm
+
+        v = value if isinstance(value, Tensor) else _t(value)
+        return apply_op(f, v, self._probs_p,
+                        _op_name="continuous_bernoulli_log_prob")
 
     def _near_half(self):
         return jnp.abs(self.probs - 0.5) < (self._lims[1] - 0.5)
@@ -740,16 +984,32 @@ class MultivariateNormal(Distribution):
     def __init__(self, loc, covariance_matrix=None, precision_matrix=None,
                  scale_tril=None, name=None):
         self.loc = _t(loc)
+        self._loc_p = loc if isinstance(loc, Tensor) else self.loc
+        # factorization stays ON the tape when the matrix arrives as a
+        # Tensor: cholesky/inv are recorded ops, so log_prob/rsample
+        # grads reach the covariance parameters
         if scale_tril is not None:
-            self._tril = _t(scale_tril)
+            self._tril_p = scale_tril if isinstance(scale_tril, Tensor) \
+                else _t(scale_tril)
         elif covariance_matrix is not None:
-            self._tril = jnp.linalg.cholesky(_t(covariance_matrix))
+            if isinstance(covariance_matrix, Tensor):
+                self._tril_p = apply_op(jnp.linalg.cholesky,
+                                        covariance_matrix,
+                                        _op_name="mvn_cholesky")
+            else:
+                self._tril_p = jnp.linalg.cholesky(_t(covariance_matrix))
         elif precision_matrix is not None:
-            self._tril = jnp.linalg.cholesky(
-                jnp.linalg.inv(_t(precision_matrix)))
+            if isinstance(precision_matrix, Tensor):
+                self._tril_p = apply_op(
+                    lambda p: jnp.linalg.cholesky(jnp.linalg.inv(p)),
+                    precision_matrix, _op_name="mvn_prec_cholesky")
+            else:
+                self._tril_p = jnp.linalg.cholesky(
+                    jnp.linalg.inv(_t(precision_matrix)))
         else:
             raise ValueError("one of covariance_matrix/precision_matrix/"
                              "scale_tril is required")
+        self._tril = _t(self._tril_p)
         super().__init__(self.loc.shape[:-1], self.loc.shape[-1:])
 
     @property
@@ -771,25 +1031,36 @@ class MultivariateNormal(Distribution):
         z = jax.random.normal(
             rnd.next_key(),
             _shape(shape) + self.batch_shape + self.event_shape)
-        return Tensor(self.loc + jnp.einsum("...ij,...j->...i",
-                                            self._tril, z))
+        return apply_op(
+            lambda l, t: l + jnp.einsum("...ij,...j->...i", t, z),
+            self._loc_p, self._tril_p, _op_name="mvn_rsample")
 
     def log_prob(self, value):
         d = self.event_shape[0]
-        diff = _t(value) - self.loc
-        import jax.scipy.linalg as jsl
-        sol = jsl.solve_triangular(self._tril, diff[..., None],
-                                   lower=True)[..., 0]
-        maha = jnp.sum(sol ** 2, axis=-1)
-        logdet = jnp.sum(jnp.log(jnp.abs(jnp.diagonal(
-            self._tril, axis1=-2, axis2=-1))), axis=-1)
-        return Tensor(-0.5 * (maha + d * jnp.log(2 * jnp.pi)) - logdet)
+
+        def f(v, l, t):
+            import jax.scipy.linalg as jsl
+            diff = v - l
+            sol = jsl.solve_triangular(t, diff[..., None],
+                                       lower=True)[..., 0]
+            maha = jnp.sum(sol ** 2, axis=-1)
+            logdet = jnp.sum(jnp.log(jnp.abs(jnp.diagonal(
+                t, axis1=-2, axis2=-1))), axis=-1)
+            return -0.5 * (maha + d * jnp.log(2 * jnp.pi)) - logdet
+
+        v = value if isinstance(value, Tensor) else _t(value)
+        return apply_op(f, v, self._loc_p, self._tril_p,
+                        _op_name="mvn_log_prob")
 
     def entropy(self):
         d = self.event_shape[0]
-        logdet = jnp.sum(jnp.log(jnp.abs(jnp.diagonal(
-            self._tril, axis1=-2, axis2=-1))), axis=-1)
-        return Tensor(0.5 * d * (1 + jnp.log(2 * jnp.pi)) + logdet)
+
+        def f(t):
+            logdet = jnp.sum(jnp.log(jnp.abs(jnp.diagonal(
+                t, axis1=-2, axis2=-1))), axis=-1)
+            return 0.5 * d * (1 + jnp.log(2 * jnp.pi)) + logdet
+
+        return apply_op(f, self._tril_p, _op_name="mvn_entropy")
 
 
 class TransformedDistribution(Distribution):

@@ -1,0 +1,231 @@
+"""Gradient flow through distribution parameters — every distribution's
+log_prob/entropy/rsample must record on the tape (reference:
+python/paddle/distribution/* are differentiable by construction; round-1
+gap: only Normal/Bernoulli/Categorical were).
+
+Two layers of evidence:
+  1. per-distribution: -log_prob(data).mean() backward => finite,
+     nonzero parameter grads (and entropy / rsample where defined);
+  2. MLE/VI fits actually converge for Beta/Gamma/Laplace/StudentT.
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+D = paddle.distribution
+
+
+def _p(x):
+    return paddle.to_tensor(np.asarray(x, np.float32),
+                            stop_gradient=False)
+
+
+def _grad_ok(t, allow_zero=False):
+    assert t.grad is not None, "no grad recorded"
+    g = np.asarray(t.grad.numpy(), np.float64)
+    assert np.all(np.isfinite(g)), f"non-finite grad {g}"
+    if not allow_zero:
+        assert np.any(g != 0), "grad is identically zero"
+
+
+# (name, param builder -> (dist, [param tensors]), sample data)
+GRAD_CASES = [
+    ("Normal", lambda: ((lambda l, s: (D.Normal(l, s), [l, s]))(
+        _p(0.3), _p(1.2))), [0.1, -0.5, 2.0]),
+    ("LogNormal", lambda: ((lambda l, s: (D.LogNormal(l, s), [l, s]))(
+        _p(0.1), _p(0.9))), [0.5, 1.5, 3.0]),
+    ("Uniform", lambda: ((lambda a, b: (D.Uniform(a, b), [a, b]))(
+        _p(-1.0), _p(2.0))), [0.0, 0.5, 1.5]),
+    ("Exponential", lambda: ((lambda r: (D.Exponential(r), [r]))(
+        _p(1.5))), [0.2, 1.0, 2.5]),
+    ("Beta", lambda: ((lambda a, b: (D.Beta(a, b), [a, b]))(
+        _p(2.0), _p(3.0))), [0.2, 0.5, 0.8]),
+    ("Gamma", lambda: ((lambda a, r: (D.Gamma(a, r), [a, r]))(
+        _p(2.0), _p(1.5))), [0.5, 1.0, 3.0]),
+    ("Laplace", lambda: ((lambda l, s: (D.Laplace(l, s), [l, s]))(
+        _p(0.2), _p(0.9))), [-1.0, 0.5, 2.0]),
+    ("Gumbel", lambda: ((lambda l, s: (D.Gumbel(l, s), [l, s]))(
+        _p(0.0), _p(1.0))), [-0.5, 0.5, 2.0]),
+    ("Cauchy", lambda: ((lambda l, s: (D.Cauchy(l, s), [l, s]))(
+        _p(0.0), _p(1.0))), [-2.0, 0.3, 1.7]),
+    ("StudentT", lambda: ((lambda d, l, s: (D.StudentT(d, l, s),
+                                            [d, l, s]))(
+        _p(5.0), _p(0.0), _p(1.0))), [-1.0, 0.2, 1.5]),
+    ("Geometric", lambda: ((lambda p: (D.Geometric(p), [p]))(
+        _p(0.4))), [0.0, 1.0, 3.0]),
+    ("Poisson", lambda: ((lambda r: (D.Poisson(r), [r]))(
+        _p(2.5))), [0.0, 2.0, 4.0]),
+    ("Binomial", lambda: ((lambda p: (D.Binomial(10.0, p), [p]))(
+        _p(0.3))), [2.0, 5.0, 7.0]),
+    ("ContinuousBernoulli",
+     lambda: ((lambda p: (D.ContinuousBernoulli(p), [p]))(
+         _p(0.3))), [0.1, 0.5, 0.9]),
+]
+
+
+@pytest.mark.parametrize("name,build,data",
+                         GRAD_CASES, ids=[c[0] for c in GRAD_CASES])
+def test_log_prob_param_grads(name, build, data):
+    dist, params = build()
+    lp = dist.log_prob(paddle.to_tensor(np.asarray(data, np.float32)))
+    (-lp.mean()).backward()
+    for t in params:
+        _grad_ok(t)
+
+
+@pytest.mark.parametrize(
+    "name,build", [(n, b) for n, b, _ in GRAD_CASES
+                   if n in ("Normal", "Uniform", "Exponential", "Beta",
+                            "Gamma", "Laplace", "Gumbel", "Cauchy",
+                            "Geometric", "StudentT")],
+    ids=[n for n, _, _ in GRAD_CASES
+         if n in ("Normal", "Uniform", "Exponential", "Beta", "Gamma",
+                  "Laplace", "Gumbel", "Cauchy", "Geometric",
+                  "StudentT")])
+def test_entropy_param_grads(name, build):
+    dist, params = build()
+    dist.entropy().sum().backward()
+    # entropy is scale-only for location families: loc grads are zero
+    got = [t for t in params if t.grad is not None and
+           np.any(np.asarray(t.grad.numpy()) != 0)]
+    assert got, f"{name}: entropy produced no nonzero param grad"
+    for t in got:
+        _grad_ok(t)
+
+
+@pytest.mark.parametrize(
+    "name,build", [(n, b) for n, b, _ in GRAD_CASES
+                   if n in ("Normal", "LogNormal", "Uniform",
+                            "Exponential", "Beta", "Gamma", "Laplace",
+                            "Gumbel", "Cauchy")],
+    ids=[n for n, _, _ in GRAD_CASES
+         if n in ("Normal", "LogNormal", "Uniform", "Exponential",
+                  "Beta", "Gamma", "Laplace", "Gumbel", "Cauchy")])
+def test_rsample_param_grads(name, build):
+    paddle.seed(7)
+    dist, params = build()
+    s = dist.rsample([64])
+    s.mean().backward()
+    got = [t for t in params if t.grad is not None and
+           np.any(np.asarray(t.grad.numpy()) != 0)]
+    assert got, f"{name}: rsample produced no nonzero param grad"
+
+
+def test_dirichlet_multinomial_mvn_grads():
+    c = _p([2.0, 3.0, 4.0])
+    d = D.Dirichlet(c)
+    lp = d.log_prob(paddle.to_tensor(
+        np.asarray([0.2, 0.3, 0.5], np.float32)))
+    lp.sum().backward()
+    _grad_ok(c)
+
+    p = _p([0.2, 0.3, 0.5])
+    m = D.Multinomial(5, p)
+    m.log_prob(paddle.to_tensor(
+        np.asarray([1.0, 2.0, 2.0], np.float32))).sum().backward()
+    _grad_ok(p)
+
+    loc = _p([0.0, 0.0])
+    cov = _p([[2.0, 0.3], [0.3, 1.0]])
+    mvn = D.MultivariateNormal(loc, covariance_matrix=cov)
+    mvn.log_prob(paddle.to_tensor(
+        np.asarray([0.5, -0.5], np.float32))).sum().backward()
+    _grad_ok(loc)
+    _grad_ok(cov)
+
+
+def _fit(make_dist, data, params, lr=0.05, steps=300):
+    """Tiny MLE loop driven by the eager tape (the VI/RL usage shape)."""
+    opt = paddle.optimizer.Adam(learning_rate=lr, parameters=params)
+    losses = []
+    for _ in range(steps):
+        dist = make_dist()
+        loss = -dist.log_prob(data).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+@pytest.mark.parametrize("family", ["Beta", "Gamma", "Laplace",
+                                    "StudentT"])
+def test_mle_fit_converges(family):
+    """The VERDICT done-criterion: a fit actually converges for
+    Beta/Gamma/Laplace/StudentT now that log_prob is differentiable."""
+    rng = np.random.RandomState(0)
+    if family == "Beta":
+        data = paddle.to_tensor(
+            rng.beta(4.0, 2.0, 512).astype(np.float32))
+        la, lb = _p(0.0), _p(0.0)  # softplus-parameterized
+        import paddle_tpu.nn.functional as F
+
+        def make():
+            return D.Beta(F.softplus(la) + 1e-3, F.softplus(lb) + 1e-3)
+
+        params = [la, lb]
+    elif family == "Gamma":
+        data = paddle.to_tensor(
+            (rng.gamma(3.0, 1.0, 512) / 2.0).astype(np.float32))
+        la, lr_ = _p(0.0), _p(0.0)
+        import paddle_tpu.nn.functional as F
+
+        def make():
+            return D.Gamma(F.softplus(la) + 1e-3, F.softplus(lr_) + 1e-3)
+
+        params = [la, lr_]
+    elif family == "Laplace":
+        data = paddle.to_tensor(
+            rng.laplace(1.5, 0.7, 512).astype(np.float32))
+        loc, ls = _p(0.0), _p(0.0)
+        import paddle_tpu.nn.functional as F
+
+        def make():
+            return D.Laplace(loc, F.softplus(ls) + 1e-3)
+
+        params = [loc, ls]
+    else:
+        data = paddle.to_tensor(
+            (0.5 + 1.2 * rng.standard_t(6.0, 512)).astype(np.float32))
+        df_raw, loc, ls = _p(1.0), _p(0.0), _p(0.0)
+        import paddle_tpu.nn.functional as F
+
+        def make():
+            return D.StudentT(F.softplus(df_raw) + 2.0, loc,
+                              F.softplus(ls) + 1e-3)
+
+        params = [df_raw, loc, ls]
+
+    losses = _fit(make, data, params)
+    assert losses[-1] < losses[0] - 0.05, \
+        f"{family} MLE did not converge: {losses[0]} -> {losses[-1]}"
+    assert np.isfinite(losses[-1])
+
+
+def test_beta_vi_fit():
+    """A tiny VI fit: q=Beta(a,b) matched to a Beta posterior via
+    reparameterized ELBO (rsample grads through the gamma sampler)."""
+    paddle.seed(3)
+    import paddle_tpu.nn.functional as F
+    la, lb = _p(0.0), _p(0.0)
+    target = D.Beta(6.0, 2.0)
+    opt = paddle.optimizer.Adam(learning_rate=0.05, parameters=[la, lb])
+    first = last = None
+    for i in range(200):
+        q = D.Beta(F.softplus(la) + 1e-3, F.softplus(lb) + 1e-3)
+        z = q.rsample([128])
+        zc = paddle.clip(z, 1e-4, 1 - 1e-4)
+        elbo = target.log_prob(zc).mean() - q.log_prob(zc).mean()
+        loss = -elbo
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        v = float(loss.numpy())
+        first = v if first is None else first
+        last = v
+    assert last < first - 0.05, f"VI did not improve: {first} -> {last}"
+    a = float(np.asarray(F.softplus(la).numpy())) + 1e-3
+    b = float(np.asarray(F.softplus(lb).numpy())) + 1e-3
+    # KL(q||p)=0 at (6,2); loose check that q moved toward the target
+    assert a > b, f"fitted ({a:.2f},{b:.2f}) not skewed like Beta(6,2)"
