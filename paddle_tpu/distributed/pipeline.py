@@ -27,7 +27,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax import shard_map
 
-__all__ = ["pipeline_forward", "stack_stage_params"]
+__all__ = ["pipeline_forward", "pipeline_train_1f1b",
+           "stack_stage_params"]
 
 
 def stack_stage_params(param_trees):
@@ -101,3 +102,169 @@ def pipeline_forward(stage_fn: Callable, stacked_params: Any,
                    out_specs=out_specs, axis_names={axis},
                    check_vma=False)
     return fn(stacked_params, x_micro)
+
+
+def pipeline_train_1f1b(stage_fn: Callable, head_loss_fn: Callable,
+                        stacked_params: Any, head_params: Any,
+                        x_micro: jax.Array, labels_micro: jax.Array,
+                        mesh: Mesh, axis: str = "pipe"):
+    """One-F-one-B pipeline schedule executed ON DEVICE as one jitted
+    SPMD program (reference: the dygraph 1F1B runtime of
+    fleet/meta_parallel/pipeline_parallel.py:575 and the static
+    pipeline_scheduler_pass/pipeline_1f1b.py:39 — there driven by NCCL
+    p2p; here one ``lax.scan`` over schedule ticks with
+    ``lax.ppermute`` hops).
+
+    Schedule (F and B each one tick): stage ``r`` runs F of microbatch
+    ``i`` at tick ``2i + r`` and B of microbatch ``j`` at tick
+    ``2j + 2S - 1 - r``; per-rank in-flight forward state is therefore
+    at most ``S - r`` microbatches — the 1F1B memory property — so the
+    residual ring buffer is ``S`` deep instead of GPipe's ``M``.
+    Backward recomputes the stage forward from the saved stage INPUT
+    (activation-checkpointed 1F1B, matching the remat convention of the
+    GPipe engine above). The loss head runs inside the LAST stage's B
+    tick (guarded by ``lax.cond`` so only that rank pays for it), which
+    is what lets a full train step — loss, parameter grads, input
+    grads — come out of one schedule.
+
+    Args:
+      stage_fn(params, x) -> y: one pipeline stage (same for all).
+      head_loss_fn(head_params, y, labels) -> scalar mean loss of one
+        microbatch.
+      stacked_params: pytree, leaves [S, ...], sharded over ``axis``.
+      head_params: pytree used by the last stage's loss head.
+      x_micro: [M, mb, ...] pipeline inputs (e.g. embedded tokens).
+      labels_micro: [M, mb, ...] integer labels.
+    Returns (mean_loss, stacked_param_grads [S, ...], head_grads,
+    dx_micro [M, mb, ...]) — dx_micro feeds the embedding backward.
+    """
+    S = mesh.shape[axis]
+    M = x_micro.shape[0]
+    T_ticks = 2 * M + 2 * S - 2
+    mb_shape = x_micro.shape[1:]
+    x_dtype = x_micro.dtype
+
+    def per_rank(params, head_p, xs, labels):
+        params = jax.tree.map(lambda a: a[0], params)
+        rank = jax.lax.axis_index(axis)
+
+        f32 = jnp.float32
+        gacc0 = jax.tree.map(lambda a: jnp.zeros(a.shape, f32), params)
+        ghead0 = jax.tree.map(lambda a: jnp.zeros(a.shape, f32), head_p)
+        carry0 = {
+            "fwd_in": jnp.zeros(mb_shape, x_dtype),
+            "bwd_in": jnp.zeros(mb_shape, x_dtype),
+            "resid": jnp.zeros((S,) + mb_shape, x_dtype),
+            "gacc": gacc0,
+            "ghead": ghead0,
+            "loss": jnp.zeros((), f32),
+            "dx_buf": jnp.zeros((M,) + mb_shape, x_dtype),
+        }
+
+        def tick(carry, t):
+            # schedule predicates for this (tick, rank)
+            fi = (t - rank) // 2
+            do_f = ((t - rank) >= 0) & ((t - rank) % 2 == 0) & (fi < M)
+            bj = (t - (2 * S - 1) + rank) // 2
+            do_b = ((t - (2 * S - 1) + rank) >= 0) & \
+                   ((t - (2 * S - 1) + rank) % 2 == 0) & (bj < M)
+            fi = jnp.clip(fi, 0, M - 1)
+            bj = jnp.clip(bj, 0, M - 1)
+
+            # ---- forward slot -------------------------------------
+            def run_f(c):
+                x_in = jnp.where(rank == 0,
+                                 jax.lax.dynamic_index_in_dim(
+                                     xs, fi, 0, keepdims=False),
+                                 c["fwd_in"])
+                y = stage_fn(params, x_in)
+                c = dict(c)
+                c["resid"] = jax.lax.dynamic_update_index_in_dim(
+                    c["resid"], x_in, fi % S, 0)
+                return c, y
+
+            def skip_f(c):
+                return c, c["fwd_in"]
+
+            carry, y_send = jax.lax.cond(do_f, run_f, skip_f, carry)
+
+            # ---- backward slot ------------------------------------
+            def run_b(c):
+                x_saved = jax.lax.dynamic_index_in_dim(
+                    c["resid"], bj % S, 0, keepdims=False)
+                y2, stage_vjp = jax.vjp(stage_fn, params, x_saved)
+                lab = jax.lax.dynamic_index_in_dim(labels, bj, 0,
+                                                   keepdims=False)
+
+                def last_rank_seed(_):
+                    loss_j, head_vjp = jax.vjp(
+                        lambda hp, yy: head_loss_fn(hp, yy, lab),
+                        head_p, y2)
+                    # seed with 1/M: the schedule accumulates M
+                    # per-microbatch MEAN losses, and the reported loss
+                    # (and the gpipe baseline's grads) is their mean
+                    dhp, dy = head_vjp(jnp.full((), 1.0 / M, f32))
+                    return loss_j, dy.astype(x_dtype), dhp
+
+                def other_rank_seed(_):
+                    return (jnp.zeros((), f32), c["bwd_in"],
+                            jax.tree.map(lambda a: jnp.zeros(
+                                a.shape, f32), head_p))
+
+                loss_j, g_out, dhp = jax.lax.cond(
+                    rank == S - 1, last_rank_seed, other_rank_seed,
+                    operand=None)
+                dparams, dx = stage_vjp(g_out.astype(y2.dtype))
+                c = dict(c)
+                c["gacc"] = jax.tree.map(
+                    lambda g, d: g + d.astype(f32), c["gacc"], dparams)
+                c["ghead"] = jax.tree.map(
+                    lambda g, d: g + d.astype(f32), c["ghead"], dhp)
+                c["loss"] = c["loss"] + loss_j
+                dxc = dx.astype(x_dtype)
+                c["dx_buf"] = jax.lax.cond(
+                    rank == 0,
+                    lambda b: jax.lax.dynamic_update_index_in_dim(
+                        b, dxc, bj, 0),
+                    lambda b: b, c["dx_buf"])
+                return c, dxc
+
+            def skip_b(c):
+                return c, c["bwd_in"]
+
+            carry, dx_send = jax.lax.cond(do_b, run_b, skip_b, carry)
+
+            # ---- ring hops (fwd down, cotangent up) ---------------
+            carry["fwd_in"] = jax.lax.ppermute(
+                y_send, axis, [(i, (i + 1) % S) for i in range(S)])
+            carry["bwd_in"] = jax.lax.ppermute(
+                dx_send, axis, [(i, (i - 1) % S) for i in range(S)])
+            return carry, None
+
+        carry, _ = jax.lax.scan(tick, carry0, jnp.arange(T_ticks))
+
+        loss = jax.lax.psum(carry["loss"], axis) / M
+        ghead = jax.tree.map(lambda g: jax.lax.psum(g, axis),
+                             carry["ghead"])
+        dx = jax.lax.psum(
+            jnp.where(rank == 0, carry["dx_buf"],
+                      jnp.zeros_like(carry["dx_buf"])), axis)
+        gstacked = jax.tree.map(lambda g: g[None], carry["gacc"])
+        return loss, gstacked, ghead, dx
+
+    in_specs = (
+        jax.tree.map(lambda _: P(axis), stacked_params),
+        jax.tree.map(lambda _: P(), head_params),
+        P(*([None] * x_micro.ndim)),
+        P(*([None] * labels_micro.ndim)),
+    )
+    out_specs = (
+        P(),
+        jax.tree.map(lambda _: P(axis), stacked_params),
+        jax.tree.map(lambda _: P(), head_params),
+        P(*([None] * x_micro.ndim)),
+    )
+    fn = shard_map(per_rank, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, axis_names={axis},
+                   check_vma=False)
+    return fn(stacked_params, head_params, x_micro, labels_micro)

@@ -214,7 +214,8 @@ class GPTSpmdTrainer:
                  mixed_precision: bool = True,
                  moment_dtype: Any = jnp.float32,
                  master_dtype: Any = jnp.float32,
-                 quant8: bool = False):
+                 quant8: bool = False,
+                 pipeline_schedule: str = "gpipe"):
         self.cfg = cfg
         self.mesh = mesh
         self.remat = remat  # per-block activation checkpointing
@@ -238,6 +239,15 @@ class GPTSpmdTrainer:
         # int8 MXU forward for the wide block matmuls (qkv/ffn), exact
         # bf16 backward — ~2x MXU rate on v5e (ops/quant_matmul.py)
         self.quant8 = quant8
+        # pp schedule: "gpipe" = autodiff'd scan+ppermute forward
+        # (F-then-B); "1f1b" = explicit on-device 1F1B train schedule
+        # (distributed/pipeline.pipeline_train_1f1b) with O(S) instead
+        # of O(M) in-flight activations per stage
+        if pipeline_schedule not in ("gpipe", "fthenb", "1f1b"):
+            raise ValueError(f"unknown pipeline_schedule "
+                             f"{pipeline_schedule!r}")
+        self.pipeline_schedule = "gpipe" if pipeline_schedule == "fthenb" \
+            else pipeline_schedule
         # Pallas flash attention on real TPU; XLA einsum attention
         # elsewhere (interpret-mode pallas is orders slower on CPU, and
         # the Mosaic kernel does not lower on GPU backends)
@@ -454,15 +464,21 @@ class GPTSpmdTrainer:
                             x, stage_params)
         return x
 
+    def _embed(self, wte, wpe, input_ids):
+        """Token + position embedding, activation-sharded (shared by the
+        autodiff'd path and the explicit 1F1B path)."""
+        T = input_ids.shape[1]
+        dtype = self.cfg.dtype
+        x = wte.astype(dtype)[input_ids] + \
+            wpe.astype(dtype)[jnp.arange(T)][None]
+        return jax.lax.with_sharding_constraint(
+            x, _spec(self.mesh, "data", "sep", None))
+
     def _forward_loss(self, params, input_ids, labels):
         cfg = self.cfg
         B, T = input_ids.shape
         dtype = cfg.dtype
-        pos = jnp.arange(T)
-        x = params["wte"].astype(dtype)[input_ids] + \
-            params["wpe"].astype(dtype)[pos][None]
-        x = jax.lax.with_sharding_constraint(
-            x, _spec(self.mesh, "data", "sep", None))
+        x = self._embed(params["wte"], params["wpe"], input_ids)
 
         if self.S == 1:
             # no pipeline: run the (single) stage outside the pipe
@@ -504,6 +520,62 @@ class GPTSpmdTrainer:
         lp = jax.nn.log_softmax(logits, axis=-1)
         ll = jnp.take_along_axis(lp, labels[..., None], axis=-1)[..., 0]
         return -jnp.mean(ll)
+
+    def _loss_and_grads_1f1b(self, params, input_ids, labels):
+        """Full loss+grads via the explicit on-device 1F1B schedule:
+        embedding fwd/bwd outside the pipe, blocks + loss head inside
+        (distributed/pipeline.pipeline_train_1f1b)."""
+        from ..distributed.pipeline import pipeline_train_1f1b
+        cfg = self.cfg
+        B, T = input_ids.shape
+        dtype = cfg.dtype
+        M = self.M
+        mb = B // M
+
+        def embed(ep):
+            return self._embed(ep["wte"], ep["wpe"], input_ids)
+
+        emb_p = {"wte": params["wte"], "wpe": params["wpe"]}
+        x, embed_vjp = jax.vjp(embed, emb_p)
+        x_micro = x.reshape(M, mb, T, cfg.hidden_size)
+        labels_micro = labels.reshape(M, mb, T)
+
+        head_p = {"ln_f_g": params["ln_f_g"], "ln_f_b": params["ln_f_b"]}
+        if cfg.tie_embeddings:
+            head_p["wte"] = params["wte"]
+        else:
+            head_p["head"] = params["head"]
+
+        def head_loss(hp, y, lab):
+            h = _layer_norm(y, hp["ln_f_g"], hp["ln_f_b"])
+            hw = hp["wte"].T if cfg.tie_embeddings else hp["head"]
+            logits = jnp.einsum("btd,dv->btv", h, hw.astype(h.dtype),
+                                preferred_element_type=jnp.float32)
+            # same sharding as _forward_loss's head: vocab over 'model'
+            logits = jax.lax.with_sharding_constraint(
+                logits, _spec(self.mesh, "data", "sep", "model"))
+            lp = jax.nn.log_softmax(logits, axis=-1)
+            ll = jnp.take_along_axis(lp, lab[..., None], axis=-1)[..., 0]
+            return -jnp.mean(ll)
+
+        loss, gblocks, ghead, dx_micro = pipeline_train_1f1b(
+            self._stage_fn, head_loss, params["blocks"], head_p,
+            x_micro, labels_micro, self.mesh, axis="pipe")
+
+        (demb,) = embed_vjp(dx_micro.reshape(B, T, cfg.hidden_size))
+        gwte = demb["wte"].astype(jnp.float32)
+        if cfg.tie_embeddings:
+            gwte = gwte + ghead["wte"]
+        grads = {
+            "wte": gwte,
+            "wpe": demb["wpe"].astype(jnp.float32),
+            "ln_f_g": ghead["ln_f_g"],
+            "ln_f_b": ghead["ln_f_b"],
+            "blocks": gblocks,
+        }
+        if not cfg.tie_embeddings:
+            grads["head"] = ghead["head"]
+        return loss, grads
 
     # -- optimizer (fused AdamW, sharded like params) ----------------------
     def _adamw(self, params, grads, opt_state):
@@ -554,7 +626,13 @@ class GPTSpmdTrainer:
             return self._step_fn
 
         def step(params, opt_state, input_ids, labels):
-            if self._stoch_round:
+            if self.S > 1 and self.pipeline_schedule == "1f1b":
+                cparams = params if self._stoch_round else jax.tree.map(
+                    lambda p: p.astype(self.cfg.dtype), params) \
+                    if self.mixed_precision else params
+                loss, grads = self._loss_and_grads_1f1b(
+                    cparams, input_ids, labels)
+            elif self._stoch_round:
                 # bf16 masters ARE the compute params — no cast, no
                 # second weight copy in HBM
                 loss, grads = jax.value_and_grad(self._forward_loss)(
