@@ -389,7 +389,8 @@ class LlamaForCausalLM(Layer):
 
         if use_cache != "dynamic" and not isinstance(
                 ids._data, jax.core.Tracer):
-            return self._generate_static(ids, max_new_tokens, pick)
+            return self._generate_static(ids, max_new_tokens, pick,
+                                         greedy=temperature <= 0)
 
         # dynamic-cache path (shape grows per step; kept for tracing and
         # as the numerics reference): (None, None) makes each layer seed
@@ -427,7 +428,53 @@ class LlamaForCausalLM(Layer):
         self._decode_jit = jax.jit(pure, donate_argnums=(3, 4))
         return self._decode_jit
 
-    def _generate_static(self, ids, max_new_tokens, pick):
+    def _decode_fused_greedy(self, steps):
+        """Prefill + the ENTIRE greedy decode loop as ONE jitted program
+        (lax.scan over decode steps). The per-step host loop costs ~5 ms
+        of dispatch per program through a tunneled/remote chip — 3
+        programs/token made bs=1 decode dispatch-bound; fused, a whole
+        generate() is a single dispatch."""
+        cache = getattr(self, "_decode_fused_jit", None)
+        if cache is None:
+            cache = self._decode_fused_jit = {}
+        if steps in cache:
+            return cache[steps]
+        from ..framework.tensor import Tensor as _T
+
+        def pure(params, buffers, ids_arr, ks, vs):
+            T0 = ids_arr.shape[1]
+
+            def step(tok, ks, vs, pos):
+                caches = [(_T(k), _T(v), _T(pos))
+                          for k, v in zip(ks, vs)]
+                with self.bind_state(params, buffers):
+                    h, new_caches = self.llama(_T(tok), None, caches)
+                    logits = self._head(h[:, -1:])
+                return (logits._data[:, -1],
+                        [c[0]._data for c in new_caches],
+                        [c[1]._data for c in new_caches])
+
+            last, ks, vs = step(ids_arr, ks, vs, jnp.asarray(0))
+            first = jnp.argmax(last, axis=-1) \
+                .astype(ids_arr.dtype)[:, None]
+
+            def body(carry, _):
+                tok, ks, vs, pos = carry
+                last, ks, vs = step(tok, ks, vs, pos)
+                nxt = jnp.argmax(last, axis=-1) \
+                    .astype(ids_arr.dtype)[:, None]
+                return (nxt, ks, vs, pos + 1), nxt[:, 0]
+
+            _, toks = jax.lax.scan(
+                body, (first, ks, vs, jnp.asarray(T0)), None,
+                length=steps - 1)
+            # [prompt | first generated token | scan-emitted tokens]
+            return jnp.concatenate([ids_arr, first, toks.T], axis=1)
+
+        cache[steps] = jax.jit(pure, donate_argnums=(3, 4))
+        return cache[steps]
+
+    def _generate_static(self, ids, max_new_tokens, pick, greedy=False):
         from ..ops.manipulation import concat
         import paddle_tpu as paddle
         cfg = self.config
@@ -450,8 +497,11 @@ class LlamaForCausalLM(Layer):
               for _ in range(L)]
         vs = [jnp.zeros((B, max_len, kv_local, D), dtype)
               for _ in range(L)]
-        fn = self._decode_pure()
         from ..framework.tensor import Tensor as _T
+        if greedy:
+            fused = self._decode_fused_greedy(max_new_tokens)
+            return _T(fused(params, buffers, ids._data, ks, vs))
+        fn = self._decode_pure()
         last, ks, vs = fn(params, buffers, ids._data, ks, vs, 0)
         nxt = pick(_T(last))
         ids = concat([ids, nxt], axis=1)
