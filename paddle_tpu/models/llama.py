@@ -407,62 +407,58 @@ class LlamaForCausalLM(Layer):
         return ids
 
     # -- compile-once serving decode --------------------------------------
+    def _cached_step(self, params, buffers, tok_arr, ks, vs, pos):
+        """One static-cache model step (shared by the per-step and the
+        fused decode programs): tokens in, last-token logits + updated
+        fixed-size caches out."""
+        from ..framework.tensor import Tensor as _T
+        caches = [(_T(k), _T(v), _T(pos)) for k, v in zip(ks, vs)]
+        with self.bind_state(params, buffers):
+            h, new_caches = self.llama(_T(tok_arr), None, caches)
+            logits = self._head(h[:, -1:])
+        return (logits._data[:, -1],
+                [c[0]._data for c in new_caches],
+                [c[1]._data for c in new_caches])
+
     def _decode_pure(self):
         """One jitted program covering prefill (t=prompt) and decode
         (t=1): runs the static-cache path and returns last-token logits
         plus the updated fixed-size caches (donated)."""
         if getattr(self, "_decode_jit", None) is not None:
             return self._decode_jit
-        from ..framework.tensor import Tensor as _T
 
         def pure(params, buffers, ids_arr, ks, vs, pos):
-            caches = [(_T(k), _T(v), _T(jnp.asarray(pos)))
-                      for k, v in zip(ks, vs)]
-            with self.bind_state(params, buffers):
-                h, new_caches = self.llama(_T(ids_arr), None, caches)
-                logits = self._head(h[:, -1:])
-            ks2 = [c[0]._data for c in new_caches]
-            vs2 = [c[1]._data for c in new_caches]
-            return logits._data[:, -1], ks2, vs2
+            return self._cached_step(params, buffers, ids_arr, ks, vs,
+                                     jnp.asarray(pos))
 
         self._decode_jit = jax.jit(pure, donate_argnums=(3, 4))
         return self._decode_jit
 
-    def _decode_fused_greedy(self, steps):
+    def _decode_fused_greedy(self):
         """Prefill + the ENTIRE greedy decode loop as ONE jitted program
         (lax.scan over decode steps). The per-step host loop costs ~5 ms
         of dispatch per program through a tunneled/remote chip — 3
         programs/token made bs=1 decode dispatch-bound; fused, a whole
-        generate() is a single dispatch."""
-        cache = getattr(self, "_decode_fused_jit", None)
-        if cache is None:
-            cache = self._decode_fused_jit = {}
-        if steps in cache:
-            return cache[steps]
-        from ..framework.tensor import Tensor as _T
+        generate() is a single dispatch. ``steps`` is a static arg, so
+        jax's own compile cache keys on it."""
+        fn = getattr(self, "_decode_fused_jit", None)
+        if fn is not None:
+            return fn
 
-        def pure(params, buffers, ids_arr, ks, vs):
+        def greedy(logits, dtype):
+            return jnp.argmax(logits, axis=-1).astype(dtype)[:, None]
+
+        def pure(params, buffers, ids_arr, ks, vs, steps):
             T0 = ids_arr.shape[1]
-
-            def step(tok, ks, vs, pos):
-                caches = [(_T(k), _T(v), _T(pos))
-                          for k, v in zip(ks, vs)]
-                with self.bind_state(params, buffers):
-                    h, new_caches = self.llama(_T(tok), None, caches)
-                    logits = self._head(h[:, -1:])
-                return (logits._data[:, -1],
-                        [c[0]._data for c in new_caches],
-                        [c[1]._data for c in new_caches])
-
-            last, ks, vs = step(ids_arr, ks, vs, jnp.asarray(0))
-            first = jnp.argmax(last, axis=-1) \
-                .astype(ids_arr.dtype)[:, None]
+            last, ks, vs = self._cached_step(params, buffers, ids_arr,
+                                             ks, vs, jnp.asarray(0))
+            first = greedy(last, ids_arr.dtype)
 
             def body(carry, _):
                 tok, ks, vs, pos = carry
-                last, ks, vs = step(tok, ks, vs, pos)
-                nxt = jnp.argmax(last, axis=-1) \
-                    .astype(ids_arr.dtype)[:, None]
+                last, ks, vs = self._cached_step(params, buffers, tok,
+                                                 ks, vs, pos)
+                nxt = greedy(last, ids_arr.dtype)
                 return (nxt, ks, vs, pos + 1), nxt[:, 0]
 
             _, toks = jax.lax.scan(
@@ -471,8 +467,9 @@ class LlamaForCausalLM(Layer):
             # [prompt | first generated token | scan-emitted tokens]
             return jnp.concatenate([ids_arr, first, toks.T], axis=1)
 
-        cache[steps] = jax.jit(pure, donate_argnums=(3, 4))
-        return cache[steps]
+        fn = jax.jit(pure, donate_argnums=(3, 4), static_argnums=(5,))
+        self._decode_fused_jit = fn
+        return fn
 
     def _generate_static(self, ids, max_new_tokens, pick, greedy=False):
         from ..ops.manipulation import concat
@@ -499,8 +496,9 @@ class LlamaForCausalLM(Layer):
               for _ in range(L)]
         from ..framework.tensor import Tensor as _T
         if greedy:
-            fused = self._decode_fused_greedy(max_new_tokens)
-            return _T(fused(params, buffers, ids._data, ks, vs))
+            fused = self._decode_fused_greedy()
+            return _T(fused(params, buffers, ids._data, ks, vs,
+                            max_new_tokens))
         fn = self._decode_pure()
         last, ks, vs = fn(params, buffers, ids._data, ks, vs, 0)
         nxt = pick(_T(last))
