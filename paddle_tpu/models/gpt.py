@@ -199,6 +199,13 @@ class GPTSpmdTrainer:
         win  [S,Lps,D,F]   ('pipe', None, 'fsdp', 'model')
         wout [S,Lps,F,D]   ('pipe', None, 'model', 'fsdp')
         ln scales/biases   ('pipe', None, None)
+      with moe_experts=E, win/bin/wout/bout are replaced by:
+        wg    [S,Lps,D,E]    ('pipe', None, None, None)  — gate
+        w_in  [S,Lps,E,D,F]  ('pipe', None, 'data', 'fsdp', 'model')
+        b_in  [S,Lps,E,F]    ('pipe', None, 'data', 'model')
+        w_out [S,Lps,E,F,D]  ('pipe', None, 'data', 'model', 'fsdp')
+        b_out [S,Lps,E,D]    ('pipe', None, 'data', None)
+        (experts sharded over 'data' = expert parallelism)
       ln_f [D]            (None,)
     Activations: (batch='data', seq='sep') with q-local/kv-gathered
     attention (Megatron-SP over 'sep').
@@ -215,7 +222,10 @@ class GPTSpmdTrainer:
                  moment_dtype: Any = jnp.float32,
                  master_dtype: Any = jnp.float32,
                  quant8: bool = False,
-                 pipeline_schedule: str = "gpipe"):
+                 pipeline_schedule: str = "gpipe",
+                 moe_experts: int = 0,
+                 moe_capacity_factor: float = 1.25,
+                 moe_aux_weight: float = 1e-2):
         self.cfg = cfg
         self.mesh = mesh
         self.remat = remat  # per-block activation checkpointing
@@ -248,6 +258,19 @@ class GPTSpmdTrainer:
                              f"{pipeline_schedule!r}")
         self.pipeline_schedule = "gpipe" if pipeline_schedule == "fthenb" \
             else pipeline_schedule
+        # MoE-FFN variant: E experts per block, GShard top-2 dispatch,
+        # experts sharded over the 'data' mesh axis (expert parallelism
+        # — the dispatch/combine einsums lower to the all-to-all pair
+        # the reference's global_scatter/global_gather implement by
+        # hand, moe_layer.py:263); the load-balance aux loss is
+        # accumulated through the layer scan and added to the CE loss.
+        self.moe_experts = int(moe_experts)
+        self.moe_capacity_factor = moe_capacity_factor
+        self.moe_aux_weight = moe_aux_weight
+        if self.moe_experts and mesh.shape["pipe"] > 1:
+            raise NotImplementedError(
+                "MoE + pipeline parallelism is not wired yet "
+                "(aux-loss side channel through the pipe)")
         # Pallas flash attention on real TPU; XLA einsum attention
         # elsewhere (interpret-mode pallas is orders slower on CPU, and
         # the Mosaic kernel does not lower on GPU backends)
@@ -314,37 +337,53 @@ class GPTSpmdTrainer:
                 "wproj": init(k[3], (S, L, D, D), resid_std,
                               ("pipe", None, "model", "fsdp")),
                 "bproj": zeros((S, L, D), ("pipe", None, None)),
+            },
+        }
+        if not self.moe_experts:
+            params["blocks"].update({
                 "win": init(k[4], (S, L, D, Ff), std,
                             ("pipe", None, "fsdp", "model")),
                 "bin": zeros((S, L, Ff), ("pipe", None, "model")),
                 "wout": init(k[5], (S, L, Ff, D), resid_std,
                              ("pipe", None, "model", "fsdp")),
                 "bout": zeros((S, L, D), ("pipe", None, None)),
-            },
-        }
+            })
+        else:
+            E = self.moe_experts
+            b = params["blocks"]
+            km = jax.random.split(k[7], 3)
+            # experts over 'data' (expert parallelism), fsdp/tp inside
+            # each expert; the gate is tiny and replicated
+            b["wg"] = init(km[0], (S, L, D, E), std,
+                           ("pipe", None, None, None))
+            b["w_in"] = init(km[1], (S, L, E, D, Ff), std,
+                             ("pipe", None, "data", "fsdp", "model"))
+            b["b_in"] = zeros((S, L, E, Ff), ("pipe", None, "data",
+                                              "model"))
+            b["w_out"] = init(km[2], (S, L, E, Ff, D), resid_std,
+                              ("pipe", None, "data", "model", "fsdp"))
+            b["b_out"] = zeros((S, L, E, D), ("pipe", None, "data",
+                                              None))
         if not self.cfg.tie_embeddings:
             params["head"] = init(k[6], (D, V), std, ("fsdp", "model"))
         return params
 
     # -- model -------------------------------------------------------------
-    def _block(self, x, bp):
-        """One transformer block on [mb, T, D] activations (GSPMD view)."""
-        cfg = self.cfg
-        mb, T, D = x.shape
-        H, dh = cfg.num_heads, cfg.head_dim
-        act = partial(jax.lax.with_sharding_constraint)
-
+    def _mm(self):
         # bf16 in/out einsums: the TPU MXU accumulates bf16 products in
         # fp32 internally, so a bf16 output dtype only rounds the final
         # result while halving the HBM write (measured ~7% step win vs
         # preferred_element_type=f32 + cast)
         if self.quant8:
             from ..ops.quant_matmul import int8_linear
-            mm = int8_linear
-        else:
-            mm = lambda a, w: jnp.einsum(  # noqa: E731
-                "btd,df->btf", a, w)
+            return int8_linear
+        return lambda a, w: jnp.einsum("btd,df->btf", a, w)
 
+    def _attn_sublayer(self, x, bp, mm, act):
+        """ln1 + qkv + attention + proj + residual on [mb, T, D]."""
+        cfg = self.cfg
+        mb, T, D = x.shape
+        H, dh = cfg.num_heads, cfg.head_dim
         h = _layer_norm(x, bp["ln1_g"], bp["ln1_b"])
         qkv = mm(h, bp["wqkv"].astype(x.dtype))
         qkv = qkv + bp["bqkv"].astype(x.dtype)
@@ -356,7 +395,13 @@ class GPTSpmdTrainer:
         attn = attn.reshape(mb, T, H * dh)
         proj = jnp.einsum("btf,fd->btd", attn, bp["wproj"].astype(x.dtype))
         x = x + proj + bp["bproj"].astype(x.dtype)
-        x = act(x, _spec(self.mesh, "data", "sep", None))
+        return act(x, _spec(self.mesh, "data", "sep", None))
+
+    def _block(self, x, bp):
+        """One transformer block on [mb, T, D] activations (GSPMD view)."""
+        act = partial(jax.lax.with_sharding_constraint)
+        mm = self._mm()
+        x = self._attn_sublayer(x, bp, mm, act)
 
         h = _layer_norm(x, bp["ln2_g"], bp["ln2_b"])
         a = mm(h, bp["win"].astype(x.dtype))
@@ -368,6 +413,36 @@ class GPTSpmdTrainer:
         o = checkpoint_name(o, "ffn2_out")
         x = x + o + bp["bout"].astype(x.dtype)
         return act(x, _spec(self.mesh, "data", "sep", None))
+
+    def _block_moe(self, x, bp):
+        """Transformer block with a GShard top-2 MoE FFN; returns
+        (x, load_balance_aux). Experts live on the 'data' mesh axis —
+        the dispatch/combine einsums below ARE the all-to-all pair."""
+        from ..incubate.moe import moe_dispatch_combine
+        act = partial(jax.lax.with_sharding_constraint)
+        mm = self._mm()
+        x = self._attn_sublayer(x, bp, mm, act)
+        mb, T, D = x.shape
+        E = self.moe_experts
+
+        h = _layer_norm(x, bp["ln2_g"], bp["ln2_b"])
+        hf = h.reshape(mb * T, D)
+        logits = jnp.einsum("td,de->te", hf.astype(jnp.float32),
+                            bp["wg"].astype(jnp.float32))
+        capacity = max(1, int(self.moe_capacity_factor * mb * T * 2 / E))
+        expert_in, combine, aux = moe_dispatch_combine(hf, logits,
+                                                       capacity)
+        expert_in = act(expert_in,
+                        _spec(self.mesh, "data", None, "fsdp"))
+        a = jnp.einsum("ecd,edf->ecf", expert_in,
+                       bp["w_in"].astype(h.dtype))
+        a = jax.nn.gelu(a + bp["b_in"][:, None, :].astype(h.dtype),
+                        approximate=True)
+        o = jnp.einsum("ecf,efd->ecd", a, bp["w_out"].astype(h.dtype))
+        o = o + bp["b_out"][:, None, :].astype(h.dtype)
+        y = jnp.einsum("tec,ecd->td", combine.astype(h.dtype), o)
+        x = x + y.reshape(mb, T, D)
+        return act(x, _spec(self.mesh, "data", "sep", None)), aux
 
     def _attention(self, q, k, v, act):
         """Causal self-attention on [mb, T, H, dh]; Pallas flash kernel on
@@ -464,6 +539,23 @@ class GPTSpmdTrainer:
                             x, stage_params)
         return x
 
+    def _stage_fn_moe(self, stage_params, x):
+        """MoE stage: like _stage_fn but threads the summed
+        load-balance aux loss through the layer scan."""
+        if not self.remat:
+            blk = self._block_moe
+        else:
+            blk = jax.checkpoint(self._block_moe)
+
+        def body(carry, bp):
+            x, aux = carry
+            x, a = blk(x, bp)
+            return (x, aux + a), None
+
+        (x, aux), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)),
+                                   stage_params)
+        return x, aux
+
     def _embed(self, wte, wpe, input_ids):
         """Token + position embedding, activation-sharded (shared by the
         autodiff'd path and the explicit 1F1B path)."""
@@ -480,20 +572,31 @@ class GPTSpmdTrainer:
         dtype = cfg.dtype
         x = self._embed(params["wte"], params["wpe"], input_ids)
 
+        moe_aux = None
         if self.S == 1:
             # no pipeline: run the (single) stage outside the pipe
             # shard_map (lets Pallas flash run); microbatches still scan
             # so per-step working shapes match the pipelined path
             stage = jax.tree.map(lambda a: a[0], params["blocks"])
+            stage_fn = self._stage_fn_moe if self.moe_experts \
+                else self._stage_fn
             if self.M > 1:
                 if B % self.M:
                     raise ValueError(
                         f"batch {B} not divisible by microbatches {self.M}")
                 xm = x.reshape(self.M, B // self.M, T, cfg.hidden_size)
-                x = jax.lax.map(partial(self._stage_fn, stage), xm)
+                out = jax.lax.map(partial(stage_fn, stage), xm)
+                if self.moe_experts:
+                    x, aux_m = out
+                    moe_aux = jnp.mean(aux_m)
+                else:
+                    x = out
                 x = x.reshape(B, T, cfg.hidden_size)
             else:
-                x = self._stage_fn(stage, x)
+                if self.moe_experts:
+                    x, moe_aux = stage_fn(stage, x)
+                else:
+                    x = stage_fn(stage, x)
         else:
             M = self.M
             mb = B // M
@@ -511,15 +614,21 @@ class GPTSpmdTrainer:
         if (shape["model"] == 1 and shape["sep"] == 1
                 and cfg.vocab_size % 8 == 0):
             from ..ops.fused_ce import fused_softmax_cross_entropy
-            return fused_softmax_cross_entropy(x, head.astype(dtype),
+            loss = fused_softmax_cross_entropy(x, head.astype(dtype),
                                                labels, n_chunks=8)
-        logits = jnp.einsum("btd,dv->btv", x, head.astype(dtype),
-                            preferred_element_type=jnp.float32)
-        logits = jax.lax.with_sharding_constraint(
-            logits, _spec(self.mesh, "data", "sep", "model"))
-        lp = jax.nn.log_softmax(logits, axis=-1)
-        ll = jnp.take_along_axis(lp, labels[..., None], axis=-1)[..., 0]
-        return -jnp.mean(ll)
+        else:
+            logits = jnp.einsum("btd,dv->btv", x, head.astype(dtype),
+                                preferred_element_type=jnp.float32)
+            logits = jax.lax.with_sharding_constraint(
+                logits, _spec(self.mesh, "data", "sep", "model"))
+            lp = jax.nn.log_softmax(logits, axis=-1)
+            ll = jnp.take_along_axis(lp, labels[..., None],
+                                     axis=-1)[..., 0]
+            loss = -jnp.mean(ll)
+        if moe_aux is not None:
+            # mean over layers, weighted (GShard's l_aux term)
+            loss = loss + self.moe_aux_weight * moe_aux / self.Lps
+        return loss
 
     def _loss_and_grads_1f1b(self, params, input_ids, labels):
         """Full loss+grads via the explicit on-device 1F1B schedule:

@@ -1,0 +1,68 @@
+"""MoE GPT variant: GShard top-2 expert-parallel FFN inside the SPMD
+trainer — balance loss flows into training and decreases on skewed
+data (reference: incubate/distributed/models/moe/moe_layer.py:263
+carries l_aux into the training objective the same way)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.models.gpt import GPTConfig, GPTSpmdTrainer, build_mesh
+
+
+def _trainer(**kw):
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=64, dtype=jnp.float32)
+    mesh = build_mesh(n_devices=8, pipe=1, data=4, fsdp=1, sep=1,
+                      model=2)
+    return cfg, GPTSpmdTrainer(cfg, mesh, microbatches=1, seed=0,
+                               mixed_precision=False, moe_experts=4,
+                               **kw)
+
+
+def test_moe_gpt_trains_and_balances():
+    cfg, tr = _trainer()
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, cfg.vocab_size, (8, 64)).astype(np.int32)
+    lab = np.roll(ids, -1, 1)
+    losses = [float(jax.device_get(tr.train_step(ids, lab)))
+              for _ in range(12)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] - 0.2, losses
+
+    # the aux term decreases as the gate balances: measure it directly
+    def aux_of(params):
+        stage = jax.tree.map(lambda a: a[0], params["blocks"])
+        x = tr._embed(params["wte"], params["wpe"], jnp.asarray(ids))
+        _, aux = tr._stage_fn_moe(stage, x)
+        return float(jax.device_get(aux))
+
+    # re-measure aux at the initial params vs trained params
+    tr2 = _trainer()[1]
+    aux_start = aux_of(tr2.params)
+    aux_end = aux_of(tr.params)
+    # GShard aux has minimum E*mean(density)*mean(proxy) ~= 1 at perfect
+    # balance (per layer; summed over 2 layers here)
+    assert aux_end <= aux_start + 1e-3, (aux_start, aux_end)
+
+
+def test_moe_gate_gets_gradients():
+    cfg, tr = _trainer()
+    rng = np.random.RandomState(1)
+    ids = rng.randint(0, cfg.vocab_size, (8, 64)).astype(np.int32)
+    lab = np.roll(ids, -1, 1)
+    loss, grads = jax.value_and_grad(tr._forward_loss)(
+        tr.params, jnp.asarray(ids), jnp.asarray(lab))
+    g_gate = np.asarray(jax.device_get(grads["blocks"]["wg"]))
+    g_exp = np.asarray(jax.device_get(grads["blocks"]["w_in"]))
+    assert np.isfinite(g_gate).all() and np.any(g_gate != 0)
+    assert np.isfinite(g_exp).all() and np.any(g_exp != 0)
+
+
+def test_moe_rejects_pipeline():
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=64, dtype=jnp.float32)
+    mesh = build_mesh(n_devices=8, pipe=2, data=2, fsdp=1, sep=1,
+                      model=2)
+    with pytest.raises(NotImplementedError):
+        GPTSpmdTrainer(cfg, mesh, moe_experts=4)
