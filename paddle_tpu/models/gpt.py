@@ -506,15 +506,21 @@ class GPTSpmdTrainer:
         "save_dots" = save every matmul output (recompute only norms /
         elementwise) — remat's 2N extra FLOPs shrink to ~0 at the cost
         of ~9 activation buffers per layer."""
+        blk = self._remat_wrap(self._block)
+        x, _ = jax.lax.scan(lambda carry, bp: (blk(carry, bp), None),
+                            x, stage_params)
+        return x
+
+    def _remat_wrap(self, block_fn):
+        """Apply the configured remat policy to a block fn (shared by
+        the dense and MoE stages)."""
         if not self.remat:
-            blk = self._block
-        elif self.remat == "save_attn":
+            return block_fn
+        if self.remat == "save_attn":
             pol = jax.checkpoint_policies.save_only_these_names("attn_out")
-            blk = jax.checkpoint(self._block, policy=pol)
         elif self.remat == "save_attn_ffn":
             pol = jax.checkpoint_policies.save_only_these_names(
                 "attn_out", "ffn_act")
-            blk = jax.checkpoint(self._block, policy=pol)
         elif self.remat == "save_dots":
             # matmul outputs + the flash kernel's own residuals (out,
             # lse): backward recomputes only layernorms/elementwise —
@@ -523,7 +529,6 @@ class GPTSpmdTrainer:
                 jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
                 jax.checkpoint_policies.save_only_these_names(
                     "flash_out", "flash_lse"))
-            blk = jax.checkpoint(self._block, policy=pol)
         elif self.remat == "save_main":
             # like save_dots but drops the attention-proj output buffer
             # (cheapest matmul, 2/24 of block FLOPs to recompute) —
@@ -532,20 +537,14 @@ class GPTSpmdTrainer:
             pol = jax.checkpoint_policies.save_only_these_names(
                 "qkv_out", "ffn1_out", "ffn2_out",
                 "flash_out", "flash_lse")
-            blk = jax.checkpoint(self._block, policy=pol)
         else:
-            blk = jax.checkpoint(self._block)
-        x, _ = jax.lax.scan(lambda carry, bp: (blk(carry, bp), None),
-                            x, stage_params)
-        return x
+            return jax.checkpoint(block_fn)
+        return jax.checkpoint(block_fn, policy=pol)
 
     def _stage_fn_moe(self, stage_params, x):
         """MoE stage: like _stage_fn but threads the summed
         load-balance aux loss through the layer scan."""
-        if not self.remat:
-            blk = self._block_moe
-        else:
-            blk = jax.checkpoint(self._block_moe)
+        blk = self._remat_wrap(self._block_moe)
 
         def body(carry, bp):
             x, aux = carry

@@ -132,8 +132,12 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         output_size = (output_size, output_size)
     oh, ow = output_size
 
+    # static sample count per bin: the reference's adaptive
+    # ceil(roi/bin) is data-dependent (not jittable); <=0 selects 2,
+    # the common detector setting
+    ratio = sampling_ratio if sampling_ratio > 0 else 2
+
     def f(feat, rois):
-        n_rois = rois.shape[0]
         c, h, w = feat.shape[1], feat.shape[2], feat.shape[3]
         offset = 0.5 if aligned else 0.0
         x1 = rois[:, 0] * spatial_scale - offset
@@ -142,24 +146,24 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
         y2 = rois[:, 3] * spatial_scale - offset
         rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
         rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
-        ys = y1[:, None] + (jnp.arange(oh) + 0.5)[None, :] * (rh[:, None] / oh)
-        xs = x1[:, None] + (jnp.arange(ow) + 0.5)[None, :] * (rw[:, None] / ow)
+        # ratio x ratio bilinear samples per bin, then averaged
+        # (sample s of bin i sits at (i + (s+0.5)/ratio) * bin_size)
+        grid_i = jnp.arange(oh * ratio) // ratio
+        grid_s = (jnp.arange(oh * ratio) % ratio + 0.5) / ratio
+        ys = y1[:, None] + (grid_i + grid_s)[None, :] * (rh[:, None] / oh)
+        grid_i = jnp.arange(ow * ratio) // ratio
+        grid_s = (jnp.arange(ow * ratio) % ratio + 0.5) / ratio
+        xs = x1[:, None] + (grid_i + grid_s)[None, :] * (rw[:, None] / ow)
 
-        def bilinear(img, yy, xx):
-            y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
-            x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
-            y1_ = jnp.clip(y0 + 1, 0, h - 1)
-            x1_ = jnp.clip(x0 + 1, 0, w - 1)
-            wy = yy - y0
-            wx = xx - x0
-            g = lambda yi, xi: img[:, yi, :][:, :, xi]
-            v = (g(y0, x0) * (1 - wy)[None] * (1 - wx)[None] +
-                 g(y1_, x0) * wy[None] * (1 - wx)[None])
-            # separable: gather rows then cols
-            return v
-        # simple per-roi loop via vmap (single image batch assumption)
+        # per-roi bilinear sample grid via vmap (single image batch)
         def sample_roi(yy, xx):
-            # yy [oh], xx [ow] -> [c, oh, ow]
+            # reference semantics: samples beyond [-1, size] contribute
+            # zero; in-range coords clamp to the border (no negative
+            # extrapolation weights)
+            yv = (yy >= -1.0) & (yy <= h)
+            xv = (xx >= -1.0) & (xx <= w)
+            yy = jnp.clip(yy, 0.0, h - 1.0)
+            xx = jnp.clip(xx, 0.0, w - 1.0)
             y0 = jnp.clip(jnp.floor(yy).astype(jnp.int32), 0, h - 1)
             y1_ = jnp.clip(y0 + 1, 0, h - 1)
             x0 = jnp.clip(jnp.floor(xx).astype(jnp.int32), 0, w - 1)
@@ -171,8 +175,10 @@ def roi_align(x, boxes, boxes_num, output_size, spatial_scale=1.0,
             p01 = img[:, y0][:, :, x1_]
             p10 = img[:, y1_][:, :, x0]
             p11 = img[:, y1_][:, :, x1_]
-            return (p00 * (1 - wy) * (1 - wx) + p01 * (1 - wy) * wx +
+            full = (p00 * (1 - wy) * (1 - wx) + p01 * (1 - wy) * wx +
                     p10 * wy * (1 - wx) + p11 * wy * wx)
+            full = full * (yv[None, :, None] & xv[None, None, :])
+            return full.reshape(c, oh, ratio, ow, ratio).mean((2, 4))
         return jax.vmap(sample_roi)(ys, xs)
     return apply_op(f, x, boxes, _op_name="roi_align")
 
