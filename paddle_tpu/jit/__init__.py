@@ -1,13 +1,14 @@
 """JIT capture + export (reference: python/paddle/jit/, 34.7k LoC)."""
 from .static_function import (to_static, not_to_static, StaticFunction,
-                              InputSpec)
+                              InputSpec, capture_report,
+                              reset_capture_report)
 from .functional import TrainStep, functional_call, value_and_grad
 from .save_load import save, load, TranslatedLayer
 from . import dy2static  # noqa: F401  (AST control-flow conversion)
 
 __all__ = ["to_static", "not_to_static", "StaticFunction", "InputSpec",
            "TrainStep", "functional_call", "value_and_grad", "save", "load",
-           "TranslatedLayer"]
+           "TranslatedLayer", "capture_report", "reset_capture_report"]
 
 
 # verbosity / capture-control compat (python/paddle/jit/api.py + sot flags)

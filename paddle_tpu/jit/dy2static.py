@@ -159,28 +159,42 @@ def convert_while_loop(cond_fn, body_fn, loaders=()):
     traced path is taken when any loop variable is a tracer; a traced
     condition over non-carried values would have raised in the original
     code too, so no extra condition probe is made (side-effecting
-    conditions run exactly as often as in the source)."""
+    conditions run exactly as often as in the source).
+
+    Graph-break recovery (the SOT fallback idea): if staging the body
+    fails because it needs a concrete value of a carried python scalar
+    (e.g. ``float(i)`` on the loop counter), fall back to the eager
+    python loop — the body unrolls into the surrounding trace instead
+    of erroring out. Caveat: the failed staging attempt traced the body
+    once, so python-level side effects NOT expressed through loop vars
+    (e.g. list.append on a closed-over list) would run twice; lifted
+    bodies produced by the AST pass only assign loop vars, keeping the
+    retry safe for converted code."""
     loop_vars = _load_inits(loaders)
     traced = any(
         _is_traced(v) for v in jax.tree.leaves(
             _unwrap(loop_vars),
             is_leaf=lambda v: isinstance(v, UndefinedVar)))
-    if not traced:
-        while bool(_data(cond_fn(*loop_vars))):
-            loop_vars = tuple(body_fn(*loop_vars))
-        return loop_vars
+    if traced:
+        _check_no_undefined(loop_vars, "loop variables")
+        template = tuple(loop_vars)
 
-    _check_no_undefined(loop_vars, "loop variables")
-    template = tuple(loop_vars)
+        def cond_w(carry):
+            return _data(cond_fn(*_rewrap(carry, template)))
 
-    def cond_w(carry):
-        return _data(cond_fn(*_rewrap(carry, template)))
+        def body_w(carry):
+            return _unwrap(tuple(body_fn(*_rewrap(carry, template))))
 
-    def body_w(carry):
-        return _unwrap(tuple(body_fn(*_rewrap(carry, template))))
-
-    out = jax.lax.while_loop(cond_w, body_w, _unwrap(template))
-    return _rewrap(out, template)
+        try:
+            out = jax.lax.while_loop(cond_w, body_w, _unwrap(template))
+            return _rewrap(out, template)
+        except (jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerIntegerConversionError):
+            pass  # body needs concrete python values: unroll below
+    while bool(_data(cond_fn(*loop_vars))):
+        loop_vars = tuple(body_fn(*loop_vars))
+    return loop_vars
 
 
 def convert_for_range(start, stop, step, body_fn, loaders=()):
@@ -302,18 +316,25 @@ def _has_disallowed(nodes, allow_trailing_return=False):
     (a trailing return is allowed in return-style branches),
     name-scope-changing statements (global/nonlocal/import/def), and
     attribute/subscript stores (side effects a lax.cond would apply
-    unconditionally while tracing both branches)."""
+    unconditionally while tracing both branches). Closures GENERATED by
+    this converter (``__dy2st_*``) are self-contained and allowed —
+    they appear when an inner if/loop has already been lowered."""
     seq = list(nodes)
     if allow_trailing_return and seq and isinstance(seq[-1], ast.Return):
         seq = seq[:-1]
-    for n in seq:
-        for sub in ast.walk(n):
-            if isinstance(sub, _DISALLOWED):
-                return True
-            if isinstance(sub, (ast.Attribute, ast.Subscript)) and \
-                    isinstance(sub.ctx, (ast.Store, ast.Del)):
-                return True
-    return False
+
+    def scan(node):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and node.name.startswith("__dy2st_"):
+            return False
+        if isinstance(node, _DISALLOWED):
+            return True
+        if isinstance(node, (ast.Attribute, ast.Subscript)) and \
+                isinstance(node.ctx, (ast.Store, ast.Del)):
+            return True
+        return any(scan(c) for c in ast.iter_child_nodes(node))
+
+    return any(scan(n) for n in seq)
 
 
 def _ends_with_return(body):
@@ -369,6 +390,152 @@ class _EarlyReturnMerger(ast.NodeTransformer):
         return node
 
     visit_AsyncFunctionDef = visit_FunctionDef
+
+
+def _has_break_continue(stmts):
+    """True if a break/continue binds to THIS loop level (don't descend
+    into nested loops or function defs, whose break/continue are theirs)."""
+    stop = (ast.While, ast.For, ast.FunctionDef, ast.AsyncFunctionDef,
+            ast.Lambda)
+
+    def scan(nodes):
+        for n in nodes:
+            if isinstance(n, (ast.Break, ast.Continue)):
+                return True
+            if isinstance(n, stop):
+                continue
+            if scan(list(ast.iter_child_nodes(n))):
+                return True
+        return False
+
+    return scan(stmts)
+
+
+def _assign_flag(name, value):
+    return ast.Assign(targets=[ast.Name(id=name, ctx=ast.Store())],
+                      value=ast.Constant(value))
+
+
+class _BreakContinueNormalizer(ast.NodeTransformer):
+    """Rewrite break/continue into boolean flag variables (the
+    reference's break_continue_transformer.py): a `break` becomes
+    `__dy2st_brk_N = True`, `continue` becomes `__dy2st_cont_N = True`,
+    statements after a potential flag-set are guarded by
+    `if not (brk or cont):`, and the loop condition gains
+    `not brk and ...`. The flags are ordinary assigned names, so the
+    later _ControlFlowTransformer turns the guards into lax.cond and
+    the loop into lax.while_loop — break/continue on tensor predicates
+    become device control flow instead of graph breaks."""
+
+    def __init__(self):
+        self.counter = 0
+
+    def _uid(self):
+        self.counter += 1
+        return self.counter
+
+    def _rewrite_stmt(self, st, brk, cont):
+        if isinstance(st, ast.Break):
+            return [_assign_flag(brk, True)]
+        if isinstance(st, ast.Continue):
+            return [_assign_flag(cont, True)]
+        if isinstance(st, ast.If):
+            st = ast.If(test=st.test,
+                        body=self._guard(st.body, brk, cont),
+                        orelse=self._guard(st.orelse, brk, cont))
+        return [st]
+
+    def _guard(self, stmts, brk, cont):
+        out = []
+        for i, st in enumerate(stmts):
+            may_flag = _has_break_continue([st])
+            out.extend(self._rewrite_stmt(st, brk, cont))
+            rest = stmts[i + 1:]
+            if may_flag and rest:
+                test = ast.UnaryOp(op=ast.Not(), operand=ast.BoolOp(
+                    op=ast.Or(),
+                    values=[ast.Name(id=brk, ctx=ast.Load()),
+                            ast.Name(id=cont, ctx=ast.Load())]))
+                out.append(ast.If(test=test,
+                                  body=self._guard(rest, brk, cont),
+                                  orelse=[]))
+                return out
+        return out
+
+    def visit_While(self, node):
+        self.generic_visit(node)  # innermost loops first
+        if not _has_break_continue(node.body) or node.orelse:
+            return node
+        uid = self._uid()
+        brk, cont = f"__dy2st_brk_{uid}", f"__dy2st_cont_{uid}"
+        body = [_assign_flag(cont, False)] + \
+            self._guard(node.body, brk, cont)
+        test = ast.BoolOp(op=ast.And(), values=[
+            ast.UnaryOp(op=ast.Not(),
+                        operand=ast.Name(id=brk, ctx=ast.Load())),
+            node.test])
+        # cont is (re)set inside the body but is a carried loop var of
+        # the eventual lax.while_loop -> must exist before loop entry
+        return [_assign_flag(brk, False), _assign_flag(cont, False),
+                ast.While(test=test, body=body, orelse=[])]
+
+    def visit_For(self, node):
+        self.generic_visit(node)
+        if (not _has_break_continue(node.body) or node.orelse
+                or not isinstance(node.target, ast.Name)
+                or not (isinstance(node.iter, ast.Call)
+                        and isinstance(node.iter.func, ast.Name)
+                        and node.iter.func.id == "range")
+                or node.iter.keywords):
+            return node
+        rargs = node.iter.args
+        if len(rargs) == 1:
+            start, stop, step = ast.Constant(0), rargs[0], ast.Constant(1)
+        elif len(rargs) == 2:
+            start, stop, step = rargs[0], rargs[1], ast.Constant(1)
+        elif len(rargs) == 3:
+            start, stop, step = rargs
+        else:
+            return node
+        if not (isinstance(step, ast.Constant) and isinstance(
+                step.value, int) and step.value > 0):
+            return node  # only forward constant-step ranges
+        # rewrite to a while so the break flag can live in the
+        # condition. The internal counter advances at the TOP of the
+        # body (before any continue-guarded region), so `continue`
+        # cannot skip the increment. start/stop are captured ONCE into
+        # temps (range() evaluates its arguments once; re-evaluating a
+        # side-effecting/expensive stop per iteration would diverge).
+        uid = self._uid()
+        ivar = node.target.id
+        cnt = f"__dy2st_iter_{uid}"
+        stop_v = f"__dy2st_stop_{uid}"
+        header = [
+            ast.Assign(targets=[ast.Name(id=ivar, ctx=ast.Store())],
+                       value=ast.Name(id=cnt, ctx=ast.Load())),
+            ast.Assign(targets=[ast.Name(id=cnt, ctx=ast.Store())],
+                       value=ast.BinOp(
+                           left=ast.Name(id=cnt, ctx=ast.Load()),
+                           op=ast.Add(), right=step)),
+        ]
+        loop = ast.While(
+            test=ast.Compare(left=ast.Name(id=cnt, ctx=ast.Load()),
+                             ops=[ast.Lt()],
+                             comparators=[ast.Name(id=stop_v,
+                                                   ctx=ast.Load())]),
+            body=header + list(node.body), orelse=[])
+        init = [
+            ast.Assign(targets=[ast.Name(id=cnt, ctx=ast.Store())],
+                       value=start),
+            ast.Assign(targets=[ast.Name(id=stop_v, ctx=ast.Store())],
+                       value=stop),
+            # ivar is a carried var of the lowered while_loop:
+            # initialize it (Python leaves it unbound when the range is
+            # empty — acceptable divergence for the staged path)
+            ast.Assign(targets=[ast.Name(id=ivar, ctx=ast.Store())],
+                       value=ast.Name(id=cnt, ctx=ast.Load())),
+        ]
+        return init + self.visit_While(loop)
 
 
 class _ControlFlowTransformer(ast.NodeTransformer):
@@ -543,6 +710,7 @@ def convert_to_static(fn: Callable) -> Callable:
             raise ValueError("not a function definition")
         fdef.decorator_list = []  # avoid re-applying @to_static etc.
         tree = _EarlyReturnMerger().visit(tree)
+        tree = _BreakContinueNormalizer().visit(tree)
         transformer = _ControlFlowTransformer()
         new_tree = transformer.visit(tree)
         if not transformer.changed:

@@ -1,16 +1,26 @@
-"""to_static: trace-based graph capture.
+"""to_static: trace-based graph capture with SOT-style guards.
 
 Reference: python/paddle/jit/api.py to_static with two capture paths — AST
 rewriting (dy2static/program_translator.py:1751) and bytecode JIT (sot/,
 ~23k LoC + PEP-523 C hook). TPU-native: the Tensor façade dispatches every
 op through jax functions, so ordinary jax.jit tracing captures the whole
-model without AST or bytecode machinery (SURVEY.md §7 hard part #4 —
-trace-based capture with shape/dtype guards via jax.jit's cache; python
-control flow on tensor *values* falls back to eager like SOT graph breaks).
+model without AST or bytecode machinery (SURVEY.md §7 hard part #4).
+
+Guard semantics (the down-payment on SOT's guard system,
+sot/opcode_translator/executor/guard.py): tensor args are guarded on
+shape+dtype by jax.jit's own cache; NON-tensor args (python scalars,
+strings, tuples/lists of scalars, None) become STATIC guards — each
+distinct value keys a separate compiled program, so `if flag:` python
+branching on a bool argument specializes per value instead of raising a
+tracer error or falling back to eager. Keyword args participate the
+same way (bound through the signature). Unhashable/unknown arg types
+are the remaining graph break (per-call eager), and every break is
+counted: ``paddle.jit.capture_report()``.
 """
 from __future__ import annotations
 
 import functools
+import inspect
 from typing import Any, Callable, Optional, Sequence
 
 import jax
@@ -20,7 +30,55 @@ from ..framework.tensor import Tensor, no_grad
 from ..nn.layer_base import Layer
 from .functional import functional_call
 
-__all__ = ["to_static", "not_to_static", "StaticFunction", "InputSpec"]
+__all__ = ["to_static", "not_to_static", "StaticFunction", "InputSpec",
+           "capture_report", "reset_capture_report"]
+
+# graph-capture telemetry: how often calls compile vs fall back
+_capture_stats = {"whole_graph_calls": 0, "graph_break_calls": 0,
+                  "breaks": {}}
+
+
+def capture_report():
+    """Return {whole_graph_calls, graph_break_calls, breaks: {reason:
+    count}} accumulated across all StaticFunction calls."""
+    return {"whole_graph_calls": _capture_stats["whole_graph_calls"],
+            "graph_break_calls": _capture_stats["graph_break_calls"],
+            "breaks": dict(_capture_stats["breaks"])}
+
+
+def reset_capture_report():
+    _capture_stats["whole_graph_calls"] = 0
+    _capture_stats["graph_break_calls"] = 0
+    _capture_stats["breaks"] = {}
+
+
+def _note_break(reason: str):
+    _capture_stats["graph_break_calls"] += 1
+    _capture_stats["breaks"][reason] = \
+        _capture_stats["breaks"].get(reason, 0) + 1
+
+
+# per-function bound on guard specializations: beyond this, distinct
+# static values (e.g. a fresh float each call) evict + recompile, which
+# is recorded as a graph break rather than leaking compiled programs
+_CACHE_LIMIT = 64
+
+
+def _static_guard_key(v):
+    """Hashable guard for a non-tensor argument, or raise TypeError.
+    Containers of guardable values guard on their contents."""
+    if v is None or isinstance(v, (bool, int, float, str, bytes)):
+        return (type(v).__name__, v)
+    if isinstance(v, (tuple, list)):
+        return ("seq", type(v).__name__,
+                tuple(_static_guard_key(e) for e in v))
+    if isinstance(v, dict):
+        return ("dict", tuple(sorted(
+            (k, _static_guard_key(val)) for k, val in v.items())))
+    if isinstance(v, np.dtype) or (isinstance(v, type)
+                                   and issubclass(v, np.generic)):
+        return ("dtype", str(v))
+    raise TypeError(f"unguardable argument type {type(v).__name__}")
 
 
 class InputSpec:
@@ -52,32 +110,99 @@ class StaticFunction:
             self._fn = function
             self._bound_self = None
         self._input_spec = input_spec
-        self._jitted = None
+        self._cache = {}  # static-guard key -> jitted program
+        self._sig = None  # lazily-computed signature (kwargs path)
         functools.update_wrapper(self, self._fn)
 
     @property
     def layer(self):
         return self._layer
 
-    def _build(self):
-        layer = self._layer
+    def _converted(self):
         # AST pass first (dy2static.py): tensor-dependent if/while/for
         # become lax.cond/while_loop instead of tracer errors; returns
         # the original fn unchanged when conversion isn't possible
         if not getattr(self._fn, "_not_to_static", False):
             from .dy2static import convert_to_static
-            fn = convert_to_static(self._fn)
+            return convert_to_static(self._fn)
+        return self._fn
+
+    def _split_args(self, args, kwargs):
+        """Bind through the signature, then split into (layout,
+        dynamic_arrays, static_key). Layout entries rebuild the call as
+        (args, kwargs) inside the traced fn — keyword-only params stay
+        keywords and *args tuples re-expand positionally. Raises
+        TypeError on unguardable values (the caller falls back to
+        eager = graph break)."""
+        entries = []  # ("pos"|("kw", name), "dyn"|"static", payload)
+
+        def add(dest, v, dyn, skey):
+            if isinstance(v, Tensor):
+                entries.append((dest, "dyn", len(dyn)))
+                dyn.append(v._data)
+            elif isinstance(v, (jax.Array, np.ndarray)):
+                entries.append((dest, "dyn", len(dyn)))
+                dyn.append(v)
+            else:
+                skey.append(_static_guard_key(v))
+                entries.append((dest, "static", v))
+
+        dyn, skey = [], []
+        if kwargs:
+            if self._sig is None:
+                self._sig = inspect.signature(self._fn)
+            sig = self._sig
+            ba = sig.bind(*(((self._bound_self,) + args)
+                            if self._bound_self is not None else args),
+                          **kwargs)
+            ba.apply_defaults()
+            params = list(sig.parameters.values())
+            if self._bound_self is not None:
+                params = params[1:]
+            for p in params:
+                if p.name not in ba.arguments:
+                    continue
+                v = ba.arguments[p.name]
+                if p.kind == p.VAR_POSITIONAL:
+                    for e in v:
+                        add("pos", e, dyn, skey)
+                elif p.kind == p.VAR_KEYWORD:
+                    for k2, e in v.items():
+                        add(("kw", k2), e, dyn, skey)
+                elif p.kind == p.KEYWORD_ONLY:
+                    add(("kw", p.name), v, dyn, skey)
+                else:
+                    add("pos", v, dyn, skey)
         else:
-            fn = self._fn
+            for v in args:
+                add("pos", v, dyn, skey)
+        return tuple(entries), tuple(dyn), tuple(skey)
+
+    def _build(self, layout):
+        layer = self._layer
+        fn = self._converted()
+
+        def rebuild(arg_arrays):
+            pos, kw = [], {}
+            for dest, kind, v in layout:
+                if kind == "dyn":
+                    a = arg_arrays[v]
+                    a = Tensor(a) if isinstance(
+                        a, (jax.Array, jax.core.Tracer)) else a
+                else:
+                    a = v
+                if dest == "pos":
+                    pos.append(a)
+                else:
+                    kw[dest[1]] = a
+            return pos, kw
 
         if layer is not None:
             def pure(params, buffers, training, *arg_arrays):
                 layer.train() if training else layer.eval()
-                wrapped = [Tensor(a) if isinstance(
-                    a, (jax.Array, jax.core.Tracer)) else a
-                    for a in arg_arrays]
+                pos, kw = rebuild(arg_arrays)
                 with layer.bind_state(params, buffers):
-                    out = fn(layer, *wrapped)
+                    out = fn(layer, *pos, **kw)
                     new_buffers = {n: b._data
                                    for n, b in layer.named_buffers()
                                    if b is not None}
@@ -85,39 +210,57 @@ class StaticFunction:
             return jax.jit(pure, static_argnums=(2,))
 
         def pure(*arg_arrays):
-            wrapped = [Tensor(a) if isinstance(
-                a, (jax.Array, jax.core.Tracer)) else a
-                for a in arg_arrays]
-            return _unwrap_tree(fn(*wrapped))
+            pos, kw = rebuild(arg_arrays)
+            return _unwrap_tree(fn(*pos, **kw))
         return jax.jit(pure)
+
+    def _eager(self, args, kwargs):
+        if self._bound_self is not None:
+            return self._fn(self._bound_self, *args, **kwargs)
+        return self._fn(*args, **kwargs)
 
     def __call__(self, *args, **kwargs):
         from . import _to_static_enabled
         if not _to_static_enabled[0]:
             # paddle.jit.enable_to_static(False): eager passthrough
-            if self._bound_self is not None:
-                return self._fn(self._bound_self, *args, **kwargs)
-            return self._fn(*args, **kwargs)
-        if kwargs:
-            # keyword args force eager fallback (graph-break semantics)
-            if self._bound_self is not None:
-                return self._fn(self._bound_self, *args, **kwargs)
-            return self._fn(*args, **kwargs)
-        if self._jitted is None:
-            self._jitted = self._build()
-        arg_arrays = tuple(a._data if isinstance(a, Tensor) else a
-                           for a in args)
+            return self._eager(args, kwargs)
+        try:
+            layout, dyn, skey = self._split_args(args, kwargs)
+        except TypeError as e:
+            _note_break(f"unguardable arg: {e}")
+            return self._eager(args, kwargs)
+        key = (skey, tuple((dest, kind) for dest, kind, _ in layout))
+        jitted = self._cache.get(key)
+        if jitted is None:
+            if len(self._cache) >= _CACHE_LIMIT:
+                # guard explosion (e.g. a fresh float every call):
+                # evict oldest and record the churn as graph breaks
+                self._cache.pop(next(iter(self._cache)))
+                _note_break("guard cache overflow")
+            jitted = self._cache[key] = self._build(layout)
+        try:
+            if self._layer is not None:
+                params, buffers = self._layer.raw_state()
+                out, new_buffers = jitted(params, buffers,
+                                          self._layer.training, *dyn)
+            else:
+                out = jitted(*dyn)
+        except (jax.errors.ConcretizationTypeError,
+                jax.errors.TracerArrayConversionError,
+                jax.errors.TracerBoolConversionError,
+                jax.errors.TracerIntegerConversionError) as e:
+            # data-dependent python control flow the AST pass could not
+            # lower: SOT-style graph break, run eagerly
+            _note_break(f"trace failure: {type(e).__name__}")
+            return self._eager(args, kwargs)
+        _capture_stats["whole_graph_calls"] += 1
         if self._layer is not None:
-            params, buffers = self._layer.raw_state()
-            out, new_buffers = self._jitted(params, buffers,
-                                            self._layer.training,
-                                            *arg_arrays)
             with no_grad():
                 for n, b in self._layer.named_buffers():
                     if b is not None and n in new_buffers:
                         b._data = new_buffers[n]
             return _wrap_tree(out)
-        return _wrap_tree(self._jitted(*arg_arrays))
+        return _wrap_tree(out)
 
     def concrete_program_specify_input_spec(self, *a, **k):
         return None

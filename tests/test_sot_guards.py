@@ -1,0 +1,174 @@
+"""Guard-based capture (SOT down-payment; reference:
+jit/sot/opcode_translator/executor/guard.py + opcode_executor.py:1603):
+non-tensor args become static guards keyed into the compile cache,
+kwargs bind through the signature, break/continue lower to flag-based
+lax control flow, and the graph-break rate is measurable."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import jit
+
+
+def _arr(*shape):
+    return paddle.to_tensor(
+        np.random.RandomState(0).randn(*shape).astype(np.float32))
+
+
+def test_bool_flag_specializes_per_value():
+    calls = {"n": 0}
+
+    @jit.to_static
+    def f(x, use_relu):
+        calls["n"] += 1  # traces once per guard specialization
+        if use_relu:  # PYTHON branch on the static guard
+            return paddle.nn.functional.relu(x)
+        return x * 2.0
+
+    x = _arr(4)
+    a = f(x, True)
+    b = f(x, False)
+    np.testing.assert_allclose(np.asarray(a.numpy()),
+                               np.maximum(np.asarray(x.numpy()), 0))
+    np.testing.assert_allclose(np.asarray(b.numpy()),
+                               np.asarray(x.numpy()) * 2)
+    f(x, True)
+    f(x, False)
+    assert calls["n"] == 2, "each guard value must compile exactly once"
+
+
+def test_kwargs_bind_instead_of_graph_break():
+    jit.reset_capture_report()
+
+    @jit.to_static
+    def f(x, scale=1.0, bias=0.0):
+        return x * scale + bias
+
+    x = _arr(3)
+    out = f(x, bias=5.0, scale=2.0)
+    np.testing.assert_allclose(np.asarray(out.numpy()),
+                               np.asarray(x.numpy()) * 2 + 5,
+                               rtol=1e-6)
+    rep = jit.capture_report()
+    assert rep["whole_graph_calls"] == 1
+    assert rep["graph_break_calls"] == 0
+
+
+def test_container_guard_and_cache_keying():
+    @jit.to_static
+    def f(x, dims):
+        return x.sum(axis=list(dims))
+
+    x = _arr(2, 3, 4)
+    a = np.asarray(f(x, (0, 1)).numpy())
+    b = np.asarray(f(x, (2,)).numpy())
+    xn = np.asarray(x.numpy())
+    np.testing.assert_allclose(a, xn.sum((0, 1)), rtol=1e-6)
+    np.testing.assert_allclose(b, xn.sum(2), rtol=1e-5, atol=1e-5)
+
+
+def test_unguardable_arg_counts_as_break():
+    jit.reset_capture_report()
+
+    class Weird:
+        pass
+
+    @jit.to_static
+    def f(x, w):
+        return x + 1.0
+
+    f(_arr(2), Weird())
+    rep = jit.capture_report()
+    assert rep["graph_break_calls"] == 1
+    assert any("unguardable" in k for k in rep["breaks"])
+
+
+def test_break_in_tensor_while_compiles():
+    @jit.to_static
+    def f(x):
+        total = x * 0.0
+        i = paddle.to_tensor(np.float32(0.0))
+        while i < 100.0:  # tensor condition -> lax.while_loop
+            total = total + x
+            i = i + 1.0
+            if i >= 3.0:  # tensor predicate break -> flag + cond
+                break
+        return total
+
+    x = _arr(4)
+    out = np.asarray(f(x).numpy())
+    np.testing.assert_allclose(out, np.asarray(x.numpy()) * 3, rtol=1e-6)
+    assert getattr(f._converted(), "__dy2static_converted__", False), \
+        "break in tensor while must AST-convert, not fall back"
+
+
+def test_continue_in_range_for_compiles():
+    @jit.to_static
+    def f(x):
+        total = x * 0.0
+        for i in range(6):
+            if i % 2 == 0:
+                continue
+            total = total + x * float(i)
+        return total
+
+    x = _arr(3)
+    out = np.asarray(f(x).numpy())
+    np.testing.assert_allclose(out, np.asarray(x.numpy()) * (1 + 3 + 5),
+                               rtol=1e-6)
+
+
+def test_break_after_continue_mixed():
+    @jit.to_static
+    def f(x):
+        acc = x * 0.0
+        for i in range(10):
+            if i == 1:
+                continue
+            if i == 4:
+                break
+            acc = acc + x * float(i)
+        return acc
+
+    x = _arr(2)
+    # i = 0, 2, 3 contribute
+    np.testing.assert_allclose(np.asarray(f(x).numpy()),
+                               np.asarray(x.numpy()) * 5.0, rtol=1e-6)
+
+
+def test_capture_rate_over_model_suite():
+    """The VERDICT-9 measurement: run the framework's model zoo through
+    to_static and report whole-graph capture rate."""
+    jit.reset_capture_report()
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.models.llama import llama_tiny_config, \
+        LlamaForCausalLM
+    from paddle_tpu.vision.models import resnet18
+
+    rng = np.random.RandomState(0)
+    models = []
+    gpt = GPTForCausalLM(GPTConfig(vocab_size=64, hidden_size=32,
+                                   num_layers=2, num_heads=2,
+                                   max_seq_len=16))
+    models.append((gpt, paddle.to_tensor(
+        rng.randint(0, 64, (2, 8)).astype(np.int64))))
+    llama = LlamaForCausalLM(llama_tiny_config())
+    models.append((llama, paddle.to_tensor(
+        rng.randint(0, 128, (2, 8)).astype(np.int64))))
+    rn = resnet18(num_classes=10)
+    models.append((rn, paddle.to_tensor(
+        rng.randn(1, 3, 32, 32).astype(np.float32))))
+
+    for m, x in models:
+        m.eval()
+        sf = jit.to_static(m)
+        eager = np.asarray(m(x).numpy())
+        static = np.asarray(sf(x).numpy())
+        np.testing.assert_allclose(static, eager, rtol=5e-4, atol=5e-4)
+    rep = jit.capture_report()
+    total = rep["whole_graph_calls"] + rep["graph_break_calls"]
+    assert total >= len(models)
+    rate = rep["whole_graph_calls"] / total
+    print(f"whole-graph capture rate over model suite: {rate:.2%} "
+          f"({rep})")
+    assert rate == 1.0, f"graph breaks in model suite: {rep['breaks']}"
