@@ -1,41 +1,176 @@
-"""Op decomposition API (paddle.decomposition compat).
+"""Op decomposition: composite ops -> closed primitive set.
 
-Reference: python/paddle/decomposition/decomp.py — rewrites composite ops
-(batch_norm, dropout, gelu, ...) in a PIR program into primitive ops so
-the CINN compiler and higher-order AD see a closed primitive set.
+Reference: python/paddle/decomposition/decomp.py + the
+paddle/fluid/primitive rule registry — rewrites composite ops
+(gelu, softmax, layer_norm, dropout, ...) into primitive ops so the
+compiler and higher-order AD see a closed primitive set.
 
-TPU-native: there is nothing to decompose — every op in this framework
-is already expressed as jax primitives at record time, and XLA/StableHLO
-is the closed primitive set (jax.jvp/grad compose on it directly, cf.
-incubate.autograd). The API is kept so reference code importing
-paddle.decomposition keeps working; ``decompose`` verifies its inputs
-and returns the program's ops unchanged.
+TPU-native: composite ops here are the framework-level op names flowing
+through the ``apply_op`` funnel; each registers a decomposition RULE
+written in basic jnp/lax primitives (add/mul/exp/max/sum/rsqrt/...).
+Under ``decomposing(...)`` (or a ``decompose()``-wrapped callable), the
+op sites in nn.functional dispatch the rule instead of the fused
+jax.nn implementation, so ``jax.make_jaxpr`` of the result contains no
+``erf_inv``/``logistic``/fused-activation primitives beyond the closed
+set — the property the reference's prim system exists for (and that
+tests assert here).
+
+For static Programs the deferred op closures are created at build time,
+so decomposition is selected at build: ``with decomposing(): <build>``
+or pass a decomposed callable to ``to_static``. The legacy
+``decompose(program, src_vars)`` signature remains for reference-code
+compatibility and validates its inputs.
 """
 from __future__ import annotations
 
-from typing import Optional, Sequence
+import contextlib
+from typing import Callable, Dict, Optional, Sequence
 
-__all__ = ["decompose", "decomp_ops_contain"]
+__all__ = ["decompose", "decomp_ops_contain", "decomposing",
+           "register_decomp", "active", "get_rule"]
 
-# ops the reference decomposes (decomp_rule registry) — informational
-_REFERENCE_DECOMPOSED = {
-    "batch_norm", "layer_norm", "dropout", "gelu", "silu", "softmax",
-    "mean", "pow", "relu", "rsqrt", "sigmoid", "squeeze", "stack",
-    "unsqueeze", "full_like", "instance_norm", "group_norm",
-}
+_RULES: Dict[str, Callable] = {}
+_ACTIVE: list = [None]  # None = off; set of op names = on
+
+
+def register_decomp(name: str):
+    """Register the primitive-form rule for a composite op name."""
+    def deco(fn):
+        _RULES[name] = fn
+        return fn
+    return deco
+
+
+def get_rule(name: str) -> Optional[Callable]:
+    return _RULES.get(name)
+
+
+def active(name: str) -> bool:
+    """Is decomposition currently requested for this op?"""
+    s = _ACTIVE[0]
+    return s is not None and name in s and name in _RULES
+
+
+@contextlib.contextmanager
+def decomposing(ops: Optional[Sequence[str]] = None,
+                blacklist: Optional[Sequence[str]] = None):
+    """Ops built inside this context use their primitive decomposition
+    rules instead of fused library implementations."""
+    sel = set(_RULES) if ops is None else set(ops)
+    if blacklist:
+        sel -= set(blacklist)
+    prev = _ACTIVE[0]
+    _ACTIVE[0] = sel
+    try:
+        yield
+    finally:
+        _ACTIVE[0] = prev
 
 
 def decomp_ops_contain(op_name: str) -> bool:
-    return op_name in _REFERENCE_DECOMPOSED
+    return op_name in _RULES
 
 
-def decompose(program, src_vars: Optional[Sequence] = None,
+def decompose(program=None, src_vars: Optional[Sequence] = None,
               blacklist: Optional[Sequence[str]] = None,
               whitelist: Optional[Sequence[str]] = None):
-    """No-op pass-through: recorded ops are jax-primitive closures, the
-    decomposed form by construction. Returns ``src_vars`` (or the
-    program) unchanged, matching the reference signature."""
+    """Callable form: ``decompose(fn)`` returns fn running under
+    ``decomposing(whitelist, blacklist)``. Program form (legacy
+    signature): deferred op closures were created at build time, so the
+    pass validates and returns unchanged — build the program inside
+    ``decomposing()`` to get decomposed closures.
+    """
+    if callable(program):
+        fn = program
+
+        def wrapped(*a, **k):
+            with decomposing(whitelist, blacklist):
+                return fn(*a, **k)
+        return wrapped
     from .static.graph import Program
     if program is not None and not isinstance(program, Program):
-        raise TypeError("decompose expects a paddle_tpu.static.Program")
+        raise TypeError("decompose expects a paddle_tpu.static.Program "
+                        "or a callable")
     return list(src_vars) if src_vars is not None else program
+
+
+# ---------------------------------------------------------------------------
+# rules — written ONLY in basic primitives (add/sub/mul/div/exp/log/
+# tanh/erf/max/sum/rsqrt/where/broadcast); no jax.nn fused forms
+# ---------------------------------------------------------------------------
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _stop_gradient(x):
+    import jax
+    return jax.lax.stop_gradient(x)
+
+
+@register_decomp("gelu")
+def _gelu_rule(x, approximate=True):
+    jnp = _jnp()
+    if approximate:
+        c = 0.7978845608028654  # sqrt(2/pi)
+        return 0.5 * x * (1.0 + jnp.tanh(c * (x + 0.044715 * x * x * x)))
+    # exact form through lax.erf — erf is IN the closed primitive set
+    # (the reference's primitive yaml keeps erf as a primitive too)
+    import jax
+    return 0.5 * x * (1.0 + jax.lax.erf(x / 1.4142135623730951))
+
+
+@register_decomp("silu")
+def _silu_rule(x):
+    jnp = _jnp()
+    return x / (1.0 + jnp.exp(-x))
+
+
+@register_decomp("sigmoid")
+def _sigmoid_rule(x):
+    jnp = _jnp()
+    return 1.0 / (1.0 + jnp.exp(-x))
+
+
+@register_decomp("relu")
+def _relu_rule(x):
+    jnp = _jnp()
+    return jnp.maximum(x, 0.0)
+
+
+@register_decomp("softmax")
+def _softmax_rule(x, axis=-1):
+    jnp = _jnp()
+    m = _stop_gradient(jnp.max(x, axis=axis, keepdims=True))
+    e = jnp.exp(x - m)
+    return e / jnp.sum(e, axis=axis, keepdims=True)
+
+
+@register_decomp("log_softmax")
+def _log_softmax_rule(x, axis=-1):
+    jnp = _jnp()
+    s = x - _stop_gradient(jnp.max(x, axis=axis, keepdims=True))
+    return s - jnp.log(jnp.sum(jnp.exp(s), axis=axis, keepdims=True))
+
+
+@register_decomp("layer_norm")
+def _layer_norm_rule(x, weight=None, bias=None, epsilon=1e-5, axes=None):
+    jnp = _jnp()
+    import jax
+    if axes is None:
+        axes = (-1,)
+    mean = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.mean((x - mean) * (x - mean), axis=axes, keepdims=True)
+    out = (x - mean) * jax.lax.rsqrt(var + epsilon)
+    if weight is not None:
+        out = out * weight
+    if bias is not None:
+        out = out + bias
+    return out
+
+
+@register_decomp("rsqrt")
+def _rsqrt_rule(x):
+    import jax
+    return jax.lax.rsqrt(x)

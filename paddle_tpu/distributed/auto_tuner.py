@@ -21,7 +21,7 @@ import time
 from typing import Callable, Dict, List, Optional
 
 __all__ = ["TunerConfig", "Candidate", "AutoTuner", "default_candidates",
-           "prune_by_memory"]
+           "prune_by_memory", "tune_gpt"]
 
 
 @dataclasses.dataclass
@@ -40,6 +40,14 @@ class Candidate:
 
     def as_dict(self):
         return dataclasses.asdict(self)
+
+    def build_mesh(self):
+        """The candidate AS a hybrid device mesh — the direct tie into
+        GPTSpmdTrainer / shard_* mesh construction."""
+        from ..models.gpt import build_mesh
+        return build_mesh(n_devices=self.world, pipe=self.pp,
+                          data=self.dp, fsdp=self.sharding,
+                          sep=self.sep, model=self.mp)
 
 
 @dataclasses.dataclass
@@ -132,3 +140,52 @@ class AutoTuner:
             with open(self.history_path, "w") as f:
                 json.dump(self.history, f, indent=2)
         return best
+
+
+def tune_gpt(model_cfg, tuner_cfg: TunerConfig, steps: int = 3,
+             trainer_kwargs: Optional[Dict] = None,
+             history_path: Optional[str] = None):
+    """End-to-end tuner over GPTSpmdTrainer (the reference's
+    auto_tuner/tuner.py launches each candidate as a real training
+    trial; here each trial is a jitted train_step on the candidate's
+    mesh — same measurement, no process relaunch).
+
+    Returns (best_candidate, history). Build the production trainer
+    with ``GPTSpmdTrainer(model_cfg, best.build_mesh(), ...)``.
+    """
+    import numpy as np
+
+    trainer_kwargs = dict(trainer_kwargs or {})
+
+    def trial(cand: Candidate) -> float:
+        from ..models.gpt import GPTSpmdTrainer
+        import jax
+        mesh = cand.build_mesh()
+        m = max(2 * cand.pp, 1)
+        trainer = GPTSpmdTrainer(
+            model_cfg, mesh, microbatches=m,
+            remat=cand.use_recompute, **trainer_kwargs)
+        # every candidate is measured at the SAME global batch the real
+        # job will run (tokens/s comparable across candidates); configs
+        # that cannot tile it raise and are recorded as failed trials
+        batch = tuner_cfg.global_batch_size
+        if batch % m:
+            raise ValueError(
+                f"global_batch_size {batch} not divisible by "
+                f"{m} microbatches (pp={cand.pp})")
+        seq = model_cfg.max_seq_len
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, model_cfg.vocab_size,
+                          (batch, seq)).astype(np.int32)
+        labels = np.roll(ids, -1, 1)
+        # warmup/compile outside the timed region
+        float(jax.device_get(trainer.train_step(ids, labels)))
+        t0 = time.time()
+        for _ in range(steps):
+            loss = trainer.train_step(ids, labels)
+        float(jax.device_get(loss))
+        return batch * seq * steps / (time.time() - t0)
+
+    tuner = AutoTuner(tuner_cfg, trial, history_path=history_path)
+    best = tuner.tune()
+    return best, tuner.history

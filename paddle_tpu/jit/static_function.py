@@ -63,6 +63,8 @@ def _note_break(reason: str):
 # is recorded as a graph break rather than leaking compiled programs
 _CACHE_LIMIT = 64
 
+_BROKEN = object()  # cache sentinel: this specialization cannot trace
+
 
 def _static_guard_key(v):
     """Hashable guard for a non-tensor argument, or raise TypeError.
@@ -140,9 +142,12 @@ class StaticFunction:
             if isinstance(v, Tensor):
                 entries.append((dest, "dyn", len(dyn)))
                 dyn.append(v._data)
-            elif isinstance(v, (jax.Array, np.ndarray)):
+            elif isinstance(v, (jax.Array, np.ndarray, np.generic)):
+                # numpy scalars (np.float32(x)) are dynamic operands,
+                # like the arrays they broadcast with
                 entries.append((dest, "dyn", len(dyn)))
-                dyn.append(v)
+                dyn.append(np.asarray(v) if isinstance(v, np.generic)
+                           else v)
             else:
                 skey.append(_static_guard_key(v))
                 entries.append((dest, "static", v))
@@ -231,10 +236,19 @@ class StaticFunction:
             return self._eager(args, kwargs)
         key = (skey, tuple((dest, kind) for dest, kind, _ in layout))
         jitted = self._cache.get(key)
-        if jitted is None:
+        if jitted is _BROKEN:
+            # this specialization failed tracing before: stay eager
+            # without paying a full re-trace per call
+            _note_break("known graph break (cached)")
+            return self._eager(args, kwargs)
+        if jitted is not None:
+            # LRU refresh so churn on other keys can't evict hot entries
+            self._cache.pop(key)
+            self._cache[key] = jitted
+        else:
             if len(self._cache) >= _CACHE_LIMIT:
                 # guard explosion (e.g. a fresh float every call):
-                # evict oldest and record the churn as graph breaks
+                # evict least-recently-used, record churn as breaks
                 self._cache.pop(next(iter(self._cache)))
                 _note_break("guard cache overflow")
             jitted = self._cache[key] = self._build(layout)
@@ -250,7 +264,9 @@ class StaticFunction:
                 jax.errors.TracerBoolConversionError,
                 jax.errors.TracerIntegerConversionError) as e:
             # data-dependent python control flow the AST pass could not
-            # lower: SOT-style graph break, run eagerly
+            # lower: SOT-style graph break, run eagerly — and remember,
+            # so later calls skip the (expensive) doomed re-trace
+            self._cache[key] = _BROKEN
             _note_break(f"trace failure: {type(e).__name__}")
             return self._eager(args, kwargs)
         _capture_stats["whole_graph_calls"] += 1

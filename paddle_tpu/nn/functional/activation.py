@@ -20,6 +20,9 @@ def _u(fn, name, x, **kw):
 
 
 def relu(x, name=None):
+    from ... import decomposition as _dec
+    if _dec.active("relu"):
+        return _u(_dec.get_rule("relu"), "relu", x)
     return _u(jax.nn.relu, "relu", x)
 
 
@@ -34,10 +37,17 @@ def relu6(x, name=None):
 
 
 def gelu(x, approximate=False, name=None):
+    from ... import decomposition as _dec
+    if _dec.active("gelu"):
+        rule = _dec.get_rule("gelu")
+        return _u(lambda a: rule(a, approximate=approximate), "gelu", x)
     return _u(lambda a: jax.nn.gelu(a, approximate=approximate), "gelu", x)
 
 
 def silu(x, name=None):
+    from ... import decomposition as _dec
+    if _dec.active("silu"):
+        return _u(_dec.get_rule("silu"), "silu", x)
     return _u(jax.nn.silu, "silu", x)
 
 
@@ -46,6 +56,9 @@ def swish(x, name=None):
 
 
 def sigmoid(x, name=None):
+    from ... import decomposition as _dec
+    if _dec.active("sigmoid"):
+        return _u(_dec.get_rule("sigmoid"), "sigmoid", x)
     return _u(jax.nn.sigmoid, "sigmoid", x)
 
 
@@ -58,19 +71,30 @@ def tanh(x, name=None):
 
 
 def softmax(x, axis=-1, dtype=None, name=None):
+    from ... import decomposition as _dec
+    rule = _dec.get_rule("softmax") if _dec.active("softmax") else None
+
     def f(a):
         if dtype is not None:
             from ...framework.dtype import to_dtype
             a = a.astype(to_dtype(dtype).np_dtype)
+        if rule is not None:
+            return rule(a, axis=axis)
         return jax.nn.softmax(a, axis=axis)
     return _u(f, "softmax", x)
 
 
 def log_softmax(x, axis=-1, dtype=None, name=None):
+    from ... import decomposition as _dec
+    rule = _dec.get_rule("log_softmax") if _dec.active("log_softmax") \
+        else None
+
     def f(a):
         if dtype is not None:
             from ...framework.dtype import to_dtype
             a = a.astype(to_dtype(dtype).np_dtype)
+        if rule is not None:
+            return rule(a, axis=axis)
         return jax.nn.log_softmax(a, axis=axis)
     return _u(f, "log_softmax", x)
 

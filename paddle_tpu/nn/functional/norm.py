@@ -72,13 +72,22 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
         else [normalized_shape]
     axes = tuple(range(x.ndim - len(ns), x.ndim))
 
+    from ... import decomposition as _dec
+    decomp = _dec.active("layer_norm")
+
     def f(a, *wb):
         # fp32 accumulation for bf16 inputs (matches reference fp16/bf16
         # layer_norm numerics: compute in fp32, cast back)
         af = a.astype(jnp.float32)
-        m = jnp.mean(af, axis=axes, keepdims=True)
-        v = jnp.var(af, axis=axes, keepdims=True)
-        out = (af - m) * jax.lax.rsqrt(v + epsilon)
+        if decomp:
+            # primitive rule: mean/sub/mul/rsqrt only (no jnp.var fused
+            # form); weight/bias applied below as in the fused path
+            out = _dec.get_rule("layer_norm")(af, epsilon=epsilon,
+                                              axes=axes)
+        else:
+            m = jnp.mean(af, axis=axes, keepdims=True)
+            v = jnp.var(af, axis=axes, keepdims=True)
+            out = (af - m) * jax.lax.rsqrt(v + epsilon)
         out = out.astype(a.dtype)
         i = 0
         if weight is not None:

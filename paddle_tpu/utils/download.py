@@ -1,0 +1,86 @@
+"""Pretrained-weight distribution: download + cache.
+
+Reference: python/paddle/utils/download.py (get_weights_path_from_url,
+get_path_from_url — DOWNLOAD_RETRY_LIMIT, md5 validation, WEIGHTS_HOME
+cache under ~/.cache/paddle) consumed by every vision model's
+``model_urls`` table (e.g. python/paddle/vision/models/resnet.py:56).
+
+TPU-native: same contract over urllib; ``file://`` URLs are first-class
+(air-gapped clusters stage weights on shared storage), the cache root
+honors $PADDLE_TPU_HOME, and md5 mismatches re-download once before
+failing loudly.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import shutil
+import urllib.parse
+import urllib.request
+
+__all__ = ["get_weights_path_from_url", "get_path_from_url",
+           "WEIGHTS_HOME"]
+
+WEIGHTS_HOME = os.path.join(
+    os.environ.get("PADDLE_TPU_HOME",
+                   os.path.join(os.path.expanduser("~"), ".cache",
+                                "paddle_tpu")),
+    "weights")
+
+DOWNLOAD_RETRY_LIMIT = 3
+
+
+def _md5(path: str) -> str:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _fetch(url: str, dst: str):
+    parsed = urllib.parse.urlparse(url)
+    tmp = dst + ".part"
+    if parsed.scheme == "file" or parsed.scheme == "":
+        src = parsed.path if parsed.scheme == "file" else url
+        shutil.copyfile(src, tmp)
+    else:
+        with urllib.request.urlopen(url, timeout=60) as r, \
+                open(tmp, "wb") as f:
+            shutil.copyfileobj(r, f)
+    os.replace(tmp, dst)
+
+
+def get_path_from_url(url: str, root_dir: str, md5sum: str = None,
+                      check_exist: bool = True) -> str:
+    """Download ``url`` into ``root_dir`` (cached by filename), verify
+    md5 when given, and return the local path."""
+    os.makedirs(root_dir, exist_ok=True)
+    fname = os.path.basename(urllib.parse.urlparse(url).path) or "weights"
+    # cache key includes a hash of the full URL: two different URLs with
+    # the same basename must not share a cache entry
+    tag = hashlib.sha1(url.encode()).hexdigest()[:10]
+    dst = os.path.join(root_dir, f"{tag}_{fname}")
+    if check_exist and os.path.exists(dst) and (
+            md5sum is None or _md5(dst) == md5sum):
+        return dst
+    last_err = None
+    for _ in range(DOWNLOAD_RETRY_LIMIT):
+        try:
+            _fetch(url, dst)
+        except Exception as e:  # network hiccup: retry
+            last_err = e
+            continue
+        if md5sum is None or _md5(dst) == md5sum:
+            return dst
+        last_err = ValueError(
+            f"md5 mismatch for {url}: got {_md5(dst)}, want {md5sum}")
+        os.remove(dst)
+    raise RuntimeError(
+        f"failed to fetch {url} after {DOWNLOAD_RETRY_LIMIT} attempts: "
+        f"{last_err}")
+
+
+def get_weights_path_from_url(url: str, md5sum: str = None) -> str:
+    """Download model weights into the shared weights cache."""
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

@@ -122,12 +122,44 @@ class ResNet(Layer):
         return x
 
 
-def _resnet(block, depth, pretrained=False, **kwargs):
+# pretrained-weight registry (reference resnet.py:56 model_urls):
+# override/extend via register_model_url — air-gapped deployments point
+# these at file:// paths on shared storage
+model_urls = {
+    "resnet18": (None, None),
+    "resnet34": (None, None),
+    "resnet50": (None, None),
+    "resnet101": (None, None),
+    "resnet152": (None, None),
+}
+
+
+def register_model_url(arch: str, url: str, md5: str = None):
+    model_urls[arch] = (url, md5)
+
+
+def _load_pretrained(model, arch):
+    url, md5 = model_urls.get(arch) or (None, None)
+    if not url:
+        raise ValueError(
+            f"no pretrained weights registered for {arch!r}; point "
+            f"model_urls[{arch!r}] at a weights file "
+            f"(register_model_url supports file:// for air-gapped "
+            f"clusters) or load a state dict via set_state_dict")
+    from ...utils.download import get_weights_path_from_url
+    from ...framework.io import load
+    path = get_weights_path_from_url(url, md5)
+    model.set_state_dict(load(path))
+    return model
+
+
+def _resnet(block, depth, pretrained=False, arch=None, **kwargs):
+    model = ResNet(block, depth, **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights unavailable (no network access); load a "
-            "state dict via set_state_dict")
-    return ResNet(block, depth, **kwargs)
+        # each variant has its OWN arch key: wide/resnext weights are
+        # not interchangeable with the base resnet of the same depth
+        _load_pretrained(model, arch or f"resnet{depth}")
+    return model
 
 
 def resnet18(pretrained=False, **kwargs):
@@ -152,48 +184,56 @@ def resnet152(pretrained=False, **kwargs):
 
 def wide_resnet50_2(pretrained=False, **kwargs):
     kwargs["width"] = 128
-    return _resnet(BottleneckBlock, 50, pretrained, **kwargs)
+    return _resnet(BottleneckBlock, 50, pretrained,
+                   arch="wide_resnet50_2", **kwargs)
 
 
 def wide_resnet101_2(pretrained=False, **kwargs):
     kwargs["width"] = 128
-    return _resnet(BottleneckBlock, 101, pretrained, **kwargs)
+    return _resnet(BottleneckBlock, 101, pretrained,
+                   arch="wide_resnet101_2", **kwargs)
 
 
 def resnext50_32x4d(pretrained=False, **kwargs):
     kwargs["groups"] = 32
     kwargs["width"] = 4
-    return _resnet(BottleneckBlock, 50, pretrained, **kwargs)
+    return _resnet(BottleneckBlock, 50, pretrained,
+                   arch="resnext50_32x4d", **kwargs)
 
 
 def resnext101_32x4d(pretrained=False, **kwargs):
     kwargs["groups"] = 32
     kwargs["width"] = 4
-    return _resnet(BottleneckBlock, 101, pretrained, **kwargs)
+    return _resnet(BottleneckBlock, 101, pretrained,
+                   arch="resnext101_32x4d", **kwargs)
 
 
 def resnext50_64x4d(pretrained=False, **kwargs):
     kwargs["groups"] = 64
     kwargs["width"] = 4
-    return _resnet(BottleneckBlock, 50, pretrained, **kwargs)
+    return _resnet(BottleneckBlock, 50, pretrained,
+                   arch="resnext50_64x4d", **kwargs)
 
 
 def resnext101_64x4d(pretrained=False, **kwargs):
     kwargs["groups"] = 64
     kwargs["width"] = 4
-    return _resnet(BottleneckBlock, 101, pretrained, **kwargs)
+    return _resnet(BottleneckBlock, 101, pretrained,
+                   arch="resnext101_64x4d", **kwargs)
 
 
 def resnext152_32x4d(pretrained=False, **kwargs):
     kwargs["groups"] = 32
     kwargs["width"] = 4
-    return _resnet(BottleneckBlock, 152, pretrained, **kwargs)
+    return _resnet(BottleneckBlock, 152, pretrained,
+                   arch="resnext152_32x4d", **kwargs)
 
 
 def resnext152_64x4d(pretrained=False, **kwargs):
     kwargs["groups"] = 64
     kwargs["width"] = 4
-    return _resnet(BottleneckBlock, 152, pretrained, **kwargs)
+    return _resnet(BottleneckBlock, 152, pretrained,
+                   arch="resnext152_64x4d", **kwargs)
 
 
 __all__ += ["resnext50_64x4d", "resnext101_64x4d", "resnext152_32x4d",

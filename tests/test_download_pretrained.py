@@ -1,0 +1,58 @@
+"""Pretrained-weight distribution (reference: paddle/utils/download.py
++ vision model_urls): download-to-cache with md5 validation, file://
+URLs for air-gapped staging, and resnet(pretrained=True) end-to-end."""
+import hashlib
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils.download import (get_path_from_url,
+                                       get_weights_path_from_url)
+
+
+def test_file_url_download_and_cache(tmp_path):
+    src = tmp_path / "w.bin"
+    src.write_bytes(b"hello-weights")
+    md5 = hashlib.md5(b"hello-weights").hexdigest()
+    root = tmp_path / "cache"
+    p1 = get_path_from_url(f"file://{src}", str(root), md5sum=md5)
+    assert open(p1, "rb").read() == b"hello-weights"
+    # cached: second call returns without re-copy even if src changes
+    src.write_bytes(b"changed")
+    p2 = get_path_from_url(f"file://{src}", str(root), md5sum=md5)
+    assert p1 == p2 and open(p2, "rb").read() == b"hello-weights"
+
+
+def test_md5_mismatch_fails_loudly(tmp_path):
+    src = tmp_path / "w.bin"
+    src.write_bytes(b"payload")
+    with pytest.raises(RuntimeError, match="md5 mismatch"):
+        get_path_from_url(f"file://{src}", str(tmp_path / "c"),
+                          md5sum="0" * 32)
+
+
+def test_resnet_pretrained_roundtrip(tmp_path, monkeypatch):
+    from paddle_tpu.vision.models import resnet18
+    from paddle_tpu.vision.models.resnet import register_model_url
+    import paddle_tpu.utils.download as dl
+
+    monkeypatch.setattr(dl, "WEIGHTS_HOME", str(tmp_path / "wh"))
+    ref = resnet18(num_classes=10)
+    wpath = tmp_path / "resnet18.pdparams"
+    paddle.save(ref.state_dict(), str(wpath))
+    register_model_url("resnet18", f"file://{wpath}")
+    m = resnet18(pretrained=True, num_classes=10)
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(1, 3, 32, 32).astype(np.float32))
+    ref.eval(), m.eval()
+    np.testing.assert_allclose(np.asarray(m(x).numpy()),
+                               np.asarray(ref(x).numpy()),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_resnet_pretrained_unregistered_raises():
+    from paddle_tpu.vision.models import resnet34
+    with pytest.raises(ValueError, match="no pretrained weights"):
+        resnet34(pretrained=True)

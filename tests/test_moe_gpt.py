@@ -66,3 +66,36 @@ def test_moe_rejects_pipeline():
                       model=2)
     with pytest.raises(NotImplementedError):
         GPTSpmdTrainer(cfg, mesh, moe_experts=4)
+
+
+def test_auto_tuner_runs_real_trials(tmp_path):
+    """VERDICT weak-8: the tuner launches real GPTSpmdTrainer trials on
+    candidate meshes and its best candidate constructs the mesh."""
+    import json
+    from paddle_tpu.distributed.auto_tuner import TunerConfig, tune_gpt
+    from paddle_tpu.models.gpt import GPTSpmdTrainer
+
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=32, dtype=jnp.float32)
+    tcfg = TunerConfig(n_devices=8, global_batch_size=32, max_mp=2,
+                       max_pp=2, model_params=2e5, hidden_size=64,
+                       seq_len=32, layers=2, max_trials=3)
+    hist_path = str(tmp_path / "hist.json")
+    best, history = tune_gpt(cfg, tcfg, steps=1,
+                             trainer_kwargs={"mixed_precision": False},
+                             history_path=hist_path)
+    assert best is not None
+    ok = [h for h in history if h["error"] is None]
+    assert ok, history
+    assert all(h["score"] > 0 for h in ok)
+    assert json.load(open(hist_path))
+    # the tie-in: best candidate -> mesh -> trainer -> one step
+    tr = GPTSpmdTrainer(cfg, best.build_mesh(),
+                        microbatches=max(2 * best.pp, 1),
+                        mixed_precision=False)
+    ids = np.random.RandomState(0).randint(
+        0, 128, (max(best.dp * best.sharding, 1)
+                 * best.micro_batch_size * max(2 * best.pp, 1),
+                 32)).astype(np.int32)
+    loss = float(jax.device_get(tr.train_step(ids, np.roll(ids, -1, 1))))
+    assert np.isfinite(loss)
