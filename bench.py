@@ -54,7 +54,7 @@ def main():
         remat="save_main" if on_tpu else False,
         moment_dtype=moment_dtype,
         master_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
-        quant8=on_tpu)
+        quant8="dgrad" if on_tpu else False)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
     labels = np.roll(ids, -1, axis=1)

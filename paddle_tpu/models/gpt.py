@@ -247,7 +247,9 @@ class GPTSpmdTrainer:
         self.master_dtype = master_dtype
         self._stoch_round = (jnp.dtype(master_dtype) == jnp.bfloat16)
         # int8 MXU forward for the wide block matmuls (qkv/ffn), exact
-        # bf16 backward — ~2x MXU rate on v5e (ops/quant_matmul.py)
+        # bf16 backward — ~2x MXU rate on v5e (ops/quant_matmul.py).
+        # quant8="dgrad" additionally runs the activation gradient on
+        # the int8 MXU (wgrad stays exact bf16).
         self.quant8 = quant8
         # pp schedule: "gpipe" = autodiff'd scan+ppermute forward
         # (F-then-B); "1f1b" = explicit on-device 1F1B train schedule
@@ -374,6 +376,9 @@ class GPTSpmdTrainer:
         # fp32 internally, so a bf16 output dtype only rounds the final
         # result while halving the HBM write (measured ~7% step win vs
         # preferred_element_type=f32 + cast)
+        if self.quant8 == "dgrad":
+            from ..ops.quant_matmul import int8_linear_dgrad8
+            return int8_linear_dgrad8
         if self.quant8:
             from ..ops.quant_matmul import int8_linear
             return int8_linear

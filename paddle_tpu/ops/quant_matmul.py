@@ -23,7 +23,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["int8_linear", "quantize_rowwise"]
+__all__ = ["int8_linear", "int8_linear_dgrad8", "quantize_rowwise"]
 
 
 def quantize_rowwise(x, axis):
@@ -69,3 +69,35 @@ def _bwd(res, g):
 
 
 int8_linear.defvjp(_fwd, _bwd)
+
+
+@jax.custom_vjp
+def int8_linear_dgrad8(x, w):
+    """Like int8_linear but the ACTIVATION gradient (dgrad) also runs on
+    the int8 MXU: per-row scales on the incoming cotangent, per-row
+    scales on w's contraction dim. The WEIGHT gradient stays exact bf16
+    — it feeds the optimizer's moment estimates directly, where
+    quantization noise integrates over steps."""
+    return _int8_matmul(x, w)
+
+
+def _fwd8(x, w):
+    return _int8_matmul(x, w), (x, w)
+
+
+def _bwd8(res, g):
+    x, w = res
+    # dx = g [..., N] @ w.T [N, K], both sides int8-quantized along N
+    gq, gs = quantize_rowwise(g, axis=-1)            # [..., 1]
+    wq, ws = quantize_rowwise(w, axis=1)             # [K, 1]
+    y = jax.lax.dot_general(gq, wq, (((g.ndim - 1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.int32)
+    dx = (y.astype(jnp.float32) * gs *
+          jnp.reshape(ws, (1,) * (g.ndim - 1) + (-1,)))
+    k = x.ndim - 1
+    dw = jax.lax.dot_general(
+        x, g, ((tuple(range(k)), tuple(range(k))), ((), ())))
+    return dx.astype(x.dtype), dw.astype(w.dtype)
+
+
+int8_linear_dgrad8.defvjp(_fwd8, _bwd8)

@@ -99,3 +99,25 @@ def test_auto_tuner_runs_real_trials(tmp_path):
                  32)).astype(np.int32)
     loss = float(jax.device_get(tr.train_step(ids, np.roll(ids, -1, 1))))
     assert np.isfinite(loss)
+
+
+def test_int8_linear_dgrad8_grads_close_to_exact():
+    from paddle_tpu.ops.quant_matmul import (int8_linear,
+                                             int8_linear_dgrad8)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16, 32), jnp.float32)
+    w = jnp.asarray(rng.randn(32, 24), jnp.float32)
+    g = jnp.asarray(rng.randn(16, 24), jnp.float32)
+
+    def run(fn):
+        out, vjp = jax.vjp(fn, x, w)
+        return out, *vjp(g)
+
+    o1, dx1, dw1 = run(int8_linear)
+    o2, dx2, dw2 = run(int8_linear_dgrad8)
+    np.testing.assert_allclose(np.asarray(o1), np.asarray(o2))
+    np.testing.assert_array_equal(np.asarray(dw1), np.asarray(dw2))
+    # dgrad is int8-quantized: close to the exact bf16 dgrad, not equal
+    ref = np.asarray(g) @ np.asarray(w).T
+    err = np.abs(np.asarray(dx2) - ref).max() / np.abs(ref).max()
+    assert err < 0.02, err
