@@ -393,11 +393,35 @@ class GPTSpmdTrainer:
         qkv = mm(h, bp["wqkv"].astype(x.dtype))
         qkv = qkv + bp["bqkv"].astype(x.dtype)
         qkv = checkpoint_name(qkv, "qkv_out")
-        qkv = qkv.reshape(mb, T, 3, H, dh)
-        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        attn = self._attention(q, k, v, act)
+        shape = self.mesh.shape
+        # zero-relayout path: the hsplit flash kernel consumes the qkv
+        # matmul's native [mb, T, H*dh] layout (column slices per head
+        # inside the kernel's BlockSpecs) — no (T,H) transposes at all.
+        # Gated to model==1: with TP the packed 3HD columns are sharded
+        # over 'model', and a plain column slice would cross shards.
+        # dh must be lane-aligned (128): the kernel's column blocks are
+        # dh wide, and Mosaic requires the last block dim % 128 == 0
+        # when it is not the whole array dim (interpret mode does NOT
+        # check this — dh=64 passes CPU tests but fails on hardware)
+        hsplit_ok = (self.use_flash and shape["sep"] == 1
+                     and shape["pipe"] == 1 and shape["model"] == 1
+                     and T % 128 == 0 and dh % 128 == 0
+                     and mb % shape["data"] == 0)
+        if hsplit_ok:
+            from ..ops.pallas_ops import flash_attention_qkv_fused
+            spec = P("data", None, None)
+            f = jax.shard_map(
+                partial(flash_attention_qkv_fused, num_heads=H,
+                        causal=True),
+                in_specs=(spec,), out_specs=spec,
+                axis_names=set(self.mesh.axis_names),
+                check_vma=False)
+            attn = f(qkv)
+        else:
+            qkv4 = qkv.reshape(mb, T, 3, H, dh)
+            q, k, v = qkv4[:, :, 0], qkv4[:, :, 1], qkv4[:, :, 2]
+            attn = self._attention(q, k, v, act).reshape(mb, T, H * dh)
         attn = checkpoint_name(attn, "attn_out")
-        attn = attn.reshape(mb, T, H * dh)
         proj = jnp.einsum("btf,fd->btd", attn, bp["wproj"].astype(x.dtype))
         x = x + proj + bp["bproj"].astype(x.dtype)
         return act(x, _spec(self.mesh, "data", "sep", None))

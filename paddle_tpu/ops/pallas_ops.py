@@ -49,9 +49,10 @@ def _interpret() -> bool:
 # and strictly-upper blocks are skipped entirely.
 
 def _fa_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
-                   acc_ref, m_ref, l_ref, *, bq, bk, nk, causal, scale):
-    qi = pl.program_id(1)
-    j = pl.program_id(2)
+                   acc_ref, m_ref, l_ref, *, bq, bk, nk, causal, scale,
+                   id_axes=(1, 2)):
+    qi = pl.program_id(id_axes[0])
+    j = pl.program_id(id_axes[1])
     j_last = jnp.minimum(((qi + 1) * bq - 1) // bk, nk - 1) if causal \
         else nk - 1
     run = j <= j_last if causal else True
@@ -151,9 +152,9 @@ def _fa_forward(q, k, v, causal, scale, bq, bk):
 
 def _fa_bwd_dkdv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
                         dk_ref, dv_ref, dk_acc, dv_acc,
-                        *, bq, bk, nq, causal, scale):
-    ki = pl.program_id(1)
-    i = pl.program_id(2)
+                        *, bq, bk, nq, causal, scale, id_axes=(1, 2)):
+    ki = pl.program_id(id_axes[0])
+    i = pl.program_id(id_axes[1])
     i_start = (ki * bk) // bq if causal else 0
     run = i >= i_start if causal else True
 
@@ -195,9 +196,10 @@ def _fa_bwd_dkdv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
 
 
 def _fa_bwd_dq_kernel(q_ref, do_ref, lse_ref, delta_ref, k_ref, v_ref,
-                      dq_ref, dq_acc, *, bq, bk, nk, causal, scale):
-    qi = pl.program_id(1)
-    j = pl.program_id(2)
+                      dq_ref, dq_acc, *, bq, bk, nk, causal, scale,
+                      id_axes=(1, 2)):
+    qi = pl.program_id(id_axes[0])
+    j = pl.program_id(id_axes[1])
     j_last = jnp.minimum(((qi + 1) * bq - 1) // bk, nk - 1) if causal \
         else nk - 1
     run = j <= j_last if causal else True
@@ -518,3 +520,171 @@ def ring_attention(q, k, v, mesh, axis: str = "sep", causal: bool = False,
     fn = jax.shard_map(per_rank, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, axis_names={axis}, check_vma=False)
     return fn(q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# fused-layout flash attention: [B, S, H*D] activations, zero relayouts
+# ---------------------------------------------------------------------------
+# The packed [B*H, S, D] API above needs a (B,S,H,D)->(B,H,S,D)
+# transpose on every input/output — ~34 ms/step of pure relayout in the
+# GPT-1.3B profile. These wrappers read each head's slice DIRECTLY from
+# the qkv matmul's natural [B, S, H*D] layout via BlockSpec index maps
+# (head = a grid axis selecting a column block), so q/k/v/out never
+# change layout between the projection matmuls and the kernel. lse
+# keeps the [B*H, S, 1] shape via a computed (b*H + h) index map.
+
+def _fa_backward_hsplit(res, g, H, causal, scale, bq, bk):
+    q, k, v, out, lse = res
+    B, S, HD = q.shape
+    D = HD // H
+    delta_full = out.astype(jnp.float32) * g.astype(jnp.float32)
+    # per-head delta: sum each head's D-column block -> [B*H, S, 1]
+    delta = jnp.sum(delta_full.reshape(B, S, H, D), axis=-1)
+    delta = jnp.moveaxis(delta, -1, 1).reshape(B * H, S, 1)
+    interp = _interpret()
+    nq, nk = S // bq, S // bk
+    seq4 = pltpu.CompilerParams(
+        dimension_semantics=("parallel", "parallel", "parallel",
+                             "arbitrary"))
+    lse_spec_q = pl.BlockSpec((1, bq, 1),
+                              lambda b, h, j, i: (b * H + h, i, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_fa_bwd_dkdv_kernel, bq=bq, bk=bk, nq=nq,
+                          causal=causal, scale=scale, id_axes=(2, 3)),
+        grid=(B, H, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, h, j, i: (b, j, h)),
+            pl.BlockSpec((1, bk, D), lambda b, h, j, i: (b, j, h)),
+            pl.BlockSpec((1, bq, D), lambda b, h, j, i: (b, i, h)),
+            pl.BlockSpec((1, bq, D), lambda b, h, j, i: (b, i, h)),
+            lse_spec_q,
+            lse_spec_q,
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, D), lambda b, h, j, i: (b, j, h)),
+            pl.BlockSpec((1, bk, D), lambda b, h, j, i: (b, j, h)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, S, HD), q.dtype),
+            jax.ShapeDtypeStruct((B, S, HD), q.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, D), jnp.float32),
+            pltpu.VMEM((bk, D), jnp.float32),
+        ],
+        compiler_params=seq4,
+        interpret=interp,
+    )(k, v, q, g, lse, delta)
+    dq = pl.pallas_call(
+        functools.partial(_fa_bwd_dq_kernel, bq=bq, bk=bk, nk=nk,
+                          causal=causal, scale=scale, id_axes=(2, 3)),
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, h, i, j: (b, i, h)),
+            pl.BlockSpec((1, bq, D), lambda b, h, i, j: (b, i, h)),
+            pl.BlockSpec((1, bq, 1),
+                         lambda b, h, i, j: (b * H + h, i, 0)),
+            pl.BlockSpec((1, bq, 1),
+                         lambda b, h, i, j: (b * H + h, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, h, i, j: (b, j, h)),
+            pl.BlockSpec((1, bk, D), lambda b, h, i, j: (b, j, h)),
+        ],
+        out_specs=[pl.BlockSpec((1, bq, D),
+                                lambda b, h, i, j: (b, i, h))],
+        out_shape=[jax.ShapeDtypeStruct((B, S, HD), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((bq, D), jnp.float32)],
+        compiler_params=seq4,
+        interpret=interp,
+    )(q, g, lse, delta, k, v)[0]
+    return dq, dk, dv
+
+
+def _fa_forward_qkvpacked(qkv, H, causal, scale, bq, bk):
+    """Forward directly from the projection output [B, S, 3*H*D]:
+    q/k/v are the same array with BlockSpec column offsets 0/H/2H."""
+    B, S, HD3 = qkv.shape
+    D = HD3 // (3 * H)
+    nq, nk = S // bq, S // bk
+    kernel = functools.partial(_fa_fwd_kernel, bq=bq, bk=bk, nk=nk,
+                               causal=causal, scale=scale,
+                               id_axes=(2, 3))
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, h, i, j: (b, i, h)),
+            pl.BlockSpec((1, bk, D),
+                         lambda b, h, i, j: (b, j, H + h)),
+            pl.BlockSpec((1, bk, D),
+                         lambda b, h, i, j: (b, j, 2 * H + h)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, h, i, j: (b, i, h)),
+            pl.BlockSpec((1, bq, 1),
+                         lambda b, h, i, j: (b * H + h, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, S, H * D), qkv.dtype),
+            jax.ShapeDtypeStruct((B * H, S, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+            pltpu.VMEM((bq, _LANES), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=_interpret(),
+    )(qkv, qkv, qkv)
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def _flash_qkvpacked(qkv, H, causal, scale):
+    return _flash_qkvpacked_fwd(qkv, H, causal, scale)[0]
+
+
+def _flash_qkvpacked_fwd(qkv, H, causal, scale):
+    S = qkv.shape[1]
+    bq = _choose_block(S)
+    bk = _choose_block(S)
+    out, lse = _fa_forward_qkvpacked(qkv, H, causal, scale, bq, bk)
+    out = checkpoint_name(out, "flash_out")
+    lse = checkpoint_name(lse, "flash_lse")
+    return out, (qkv, out, lse, bq, bk)
+
+
+def _flash_qkvpacked_bwd(H, causal, scale, res, g):
+    qkv, out, lse, bq, bk = res
+    HD = out.shape[-1]
+    q = qkv[..., :HD]
+    k = qkv[..., HD:2 * HD]
+    v = qkv[..., 2 * HD:]
+    dq, dk, dv = _fa_backward_hsplit((q, k, v, out, lse), g, H, causal,
+                                     scale, bq, bk)
+    return (jnp.concatenate([dq, dk, dv], axis=-1),)
+
+
+_flash_qkvpacked.defvjp(_flash_qkvpacked_fwd, _flash_qkvpacked_bwd)
+
+
+def flash_attention_qkv_fused(qkv, num_heads, causal=False, scale=None):
+    """Fused attention straight off the qkv projection output
+    [batch, seq, 3*heads*head_dim]; returns [batch, seq, heads*head_dim]
+    with no relayout or slicing on the forward path.
+
+    head_dim must be a multiple of 128 (Mosaic lane constraint on the
+    column blocks — checked here because interpret mode does not)."""
+    if qkv.shape[-1] % (3 * num_heads):
+        raise ValueError(
+            f"last dim {qkv.shape[-1]} is not 3*num_heads*head_dim "
+            f"(num_heads={num_heads})")
+    head_dim = qkv.shape[-1] // (3 * num_heads)
+    if head_dim % 128:
+        raise ValueError(
+            f"head_dim {head_dim} must be a multiple of 128 for the "
+            f"fused-layout kernel; use flash_attention_fwd instead")
+    if scale is None:
+        scale = 1.0 / math.sqrt(head_dim)
+    return _flash_qkvpacked(qkv, num_heads, causal, scale)

@@ -192,3 +192,50 @@ def test_ring_attention_grads_match_dense(causal):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-4,
                                    err_msg=f"d{name} mismatch")
+
+
+def test_qkv_fused_matches_packed_layout():
+    """flash_attention_qkv_fused consumes [B,S,3*H*D] directly and must
+    match the packed-layout kernel exactly (fwd and grads)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from paddle_tpu.ops.pallas_ops import (flash_attention_fwd,
+                                           flash_attention_qkv_fused)
+    B, T, H, dh = 2, 256, 2, 128
+    rng = np.random.RandomState(0)
+    q4 = jnp.asarray(rng.randn(B, T, H, dh), jnp.float32)
+    k4 = jnp.asarray(rng.randn(B, T, H, dh), jnp.float32)
+    v4 = jnp.asarray(rng.randn(B, T, H, dh), jnp.float32)
+    qkv = jnp.concatenate([q4.reshape(B, T, -1), k4.reshape(B, T, -1),
+                           v4.reshape(B, T, -1)], -1)
+    ref = flash_attention_fwd(q4, k4, v4, causal=True).reshape(B, T, -1)
+    got = flash_attention_qkv_fused(qkv, H, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+    g1 = jax.grad(lambda a: jnp.sum(
+        flash_attention_qkv_fused(a, H, causal=True) ** 2))(qkv)
+
+    def ref_loss(a):
+        HD = H * dh
+        q = a[..., :HD].reshape(B, T, H, dh)
+        k = a[..., HD:2 * HD].reshape(B, T, H, dh)
+        v = a[..., 2 * HD:].reshape(B, T, H, dh)
+        return jnp.sum(flash_attention_fwd(q, k, v, causal=True) ** 2)
+
+    g2 = jax.grad(ref_loss)(qkv)
+    np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_qkv_fused_rejects_bad_shapes():
+    import jax.numpy as jnp
+    import numpy as np
+    import pytest
+    from paddle_tpu.ops.pallas_ops import flash_attention_qkv_fused
+    x = jnp.zeros((1, 128, 3 * 2 * 64), jnp.float32)
+    with pytest.raises(ValueError, match="multiple of 128"):
+        flash_attention_qkv_fused(x, 2)  # head_dim 64: lane-misaligned
+    with pytest.raises(ValueError, match="not 3"):
+        flash_attention_qkv_fused(jnp.zeros((1, 128, 100)), 3)
