@@ -21,8 +21,11 @@ def main():
         cfg = GPTConfig(vocab_size=50304, hidden_size=2048, num_layers=24,
                         num_heads=16, max_seq_len=1024, dtype=jnp.bfloat16)
         mesh = build_mesh(n_devices=1, pipe=1, model=1, fsdp=1, sep=1)
-        trainer = GPTSpmdTrainer(cfg, mesh, microbatches=1, remat=True,
-                                 moment_dtype=jnp.bfloat16)
+        trainer = GPTSpmdTrainer(cfg, mesh, microbatches=1,
+                                 remat="save_main",
+                                 moment_dtype=jnp.bfloat16,
+                                 master_dtype=jnp.bfloat16,
+                                 quant8="dgrad")
         B, T, steps = 6, 1024, 10
     else:
         cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=4,
