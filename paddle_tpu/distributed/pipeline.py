@@ -107,7 +107,9 @@ def pipeline_forward(stage_fn: Callable, stacked_params: Any,
 def pipeline_train_1f1b(stage_fn: Callable, head_loss_fn: Callable,
                         stacked_params: Any, head_params: Any,
                         x_micro: jax.Array, labels_micro: jax.Array,
-                        mesh: Mesh, axis: str = "pipe"):
+                        mesh: Mesh, axis: str = "pipe",
+                        stage_aux_weight: float = 0.0,
+                        stage_has_aux: bool = None):
     """One-F-one-B pipeline schedule executed ON DEVICE as one jitted
     SPMD program (reference: the dygraph 1F1B runtime of
     fleet/meta_parallel/pipeline_parallel.py:575 and the static
@@ -135,9 +137,20 @@ def pipeline_train_1f1b(stage_fn: Callable, head_loss_fn: Callable,
       head_params: pytree used by the last stage's loss head.
       x_micro: [M, mb, ...] pipeline inputs (e.g. embedded tokens).
       labels_micro: [M, mb, ...] integer labels.
+      stage_aux_weight: weight of the per-stage aux term; with
+        ``stage_has_aux`` (defaults to ``stage_aux_weight != 0``)
+        ``stage_fn`` returns (y, aux)
+        (e.g. an MoE load-balance loss summed over the stage's layers)
+        and ``stage_aux_weight * aux`` joins the objective — the vjp is
+        seeded with the weight, so balance gradients reach the gates
+        through the SAME schedule (this explicit-backward engine is what
+        makes MoE+PP composable; the autodiff'd GPipe scan has no side
+        channel for it).
     Returns (mean_loss, stacked_param_grads [S, ...], head_grads,
     dx_micro [M, mb, ...]) — dx_micro feeds the embedding backward.
     """
+    if stage_has_aux is None:
+        stage_has_aux = bool(stage_aux_weight)
     S = mesh.shape[axis]
     M = x_micro.shape[0]
     T_ticks = 2 * M + 2 * S - 2
@@ -178,6 +191,8 @@ def pipeline_train_1f1b(stage_fn: Callable, head_loss_fn: Callable,
                                      xs, fi, 0, keepdims=False),
                                  c["fwd_in"])
                 y = stage_fn(params, x_in)
+                if stage_has_aux:
+                    y = y[0]  # fwd slot only routes activations
                 c = dict(c)
                 c["resid"] = jax.lax.dynamic_update_index_in_dim(
                     c["resid"], x_in, fi % S, 0)
@@ -192,7 +207,11 @@ def pipeline_train_1f1b(stage_fn: Callable, head_loss_fn: Callable,
             def run_b(c):
                 x_saved = jax.lax.dynamic_index_in_dim(
                     c["resid"], bj % S, 0, keepdims=False)
-                y2, stage_vjp = jax.vjp(stage_fn, params, x_saved)
+                if stage_has_aux:
+                    (y2, aux2), stage_vjp = jax.vjp(stage_fn, params,
+                                                    x_saved)
+                else:
+                    y2, stage_vjp = jax.vjp(stage_fn, params, x_saved)
                 lab = jax.lax.dynamic_index_in_dim(labels, bj, 0,
                                                    keepdims=False)
 
@@ -214,7 +233,17 @@ def pipeline_train_1f1b(stage_fn: Callable, head_loss_fn: Callable,
                 loss_j, g_out, dhp = jax.lax.cond(
                     rank == S - 1, last_rank_seed, other_rank_seed,
                     operand=None)
-                dparams, dx = stage_vjp(g_out.astype(y2.dtype))
+                if stage_has_aux:
+                    # aux joins the objective with coefficient
+                    # stage_aux_weight * (1/M): the loss accumulator is
+                    # divided by M at exit, so the tick adds aux2 * w
+                    # while the vjp seed carries the full w/M
+                    dparams, dx = stage_vjp(
+                        (g_out.astype(y2.dtype),
+                         jnp.full((), stage_aux_weight / M, f32)))
+                    loss_j = loss_j + aux2 * stage_aux_weight
+                else:
+                    dparams, dx = stage_vjp(g_out.astype(y2.dtype))
                 c = dict(c)
                 c["gacc"] = jax.tree.map(
                     lambda g, d: g + d.astype(f32), c["gacc"], dparams)

@@ -269,10 +269,12 @@ class GPTSpmdTrainer:
         self.moe_experts = int(moe_experts)
         self.moe_capacity_factor = moe_capacity_factor
         self.moe_aux_weight = moe_aux_weight
-        if self.moe_experts and mesh.shape["pipe"] > 1:
+        if self.moe_experts and mesh.shape["pipe"] > 1 \
+                and self.pipeline_schedule != "1f1b":
             raise NotImplementedError(
-                "MoE + pipeline parallelism is not wired yet "
-                "(aux-loss side channel through the pipe)")
+                "MoE + pipeline parallelism requires the explicit "
+                "1F1B engine (pipeline_schedule='1f1b'): the "
+                "autodiff'd GPipe scan has no aux-loss side channel")
         # Pallas flash attention on real TPU; XLA einsum attention
         # elsewhere (interpret-mode pallas is orders slower on CPU, and
         # the Mosaic kernel does not lower on GPU backends)
@@ -695,9 +697,20 @@ class GPTSpmdTrainer:
             ll = jnp.take_along_axis(lp, lab[..., None], axis=-1)[..., 0]
             return -jnp.mean(ll)
 
+        if self.moe_experts:
+            # MoE+PP composition: the explicit schedule carries the
+            # balance-loss side channel (normalized per layer to match
+            # the non-pipelined objective)
+            stage_fn = self._stage_fn_moe
+            aux_w = self.moe_aux_weight / cfg.num_layers
+        else:
+            stage_fn = self._stage_fn
+            aux_w = 0.0
         loss, gblocks, ghead, dx_micro = pipeline_train_1f1b(
-            self._stage_fn, head_loss, params["blocks"], head_p,
-            x_micro, labels_micro, self.mesh, axis="pipe")
+            stage_fn, head_loss, params["blocks"], head_p,
+            x_micro, labels_micro, self.mesh, axis="pipe",
+            stage_aux_weight=aux_w,
+            stage_has_aux=bool(self.moe_experts))
 
         (demb,) = embed_vjp(dx_micro.reshape(B, T, cfg.hidden_size))
         gwte = demb["wte"].astype(jnp.float32)

@@ -59,13 +59,34 @@ def test_moe_gate_gets_gradients():
     assert np.isfinite(g_exp).all() and np.any(g_exp != 0)
 
 
-def test_moe_rejects_pipeline():
+def test_moe_rejects_gpipe_but_runs_under_1f1b():
     cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
                     num_heads=4, max_seq_len=64, dtype=jnp.float32)
     mesh = build_mesh(n_devices=8, pipe=2, data=2, fsdp=1, sep=1,
-                      model=2)
+                      model=1)
     with pytest.raises(NotImplementedError):
         GPTSpmdTrainer(cfg, mesh, moe_experts=4)
+
+    # MoE + PP composes through the explicit 1F1B engine (aux side
+    # channel seeded into the scheduled backward)
+    tr = GPTSpmdTrainer(cfg, mesh, microbatches=4, moe_experts=2,
+                        mixed_precision=False,
+                        pipeline_schedule="1f1b", seed=0)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 128, (8, 64)).astype(np.int32)
+    lab = np.roll(ids, -1, 1)
+    losses = [float(jax.device_get(tr.train_step(ids, lab)))
+              for _ in range(8)]
+    assert np.isfinite(losses).all(), losses
+    assert losses[-1] < losses[0] - 0.1, losses
+    # gate weights get gradients through the pipelined schedule
+    # (jitted: the partial-manual shard_map engine runs under jit)
+    with jax.set_mesh(tr.mesh):
+        g = jax.jit(lambda p, i, l: tr._loss_and_grads_1f1b(
+            p, i, l)[1]["blocks"]["wg"])(
+            tr.params, jnp.asarray(ids), jnp.asarray(lab))
+    g = np.asarray(jax.device_get(g))
+    assert np.isfinite(g).all() and np.any(g != 0)
 
 
 def test_auto_tuner_runs_real_trials(tmp_path):
