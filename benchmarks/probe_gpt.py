@@ -24,6 +24,8 @@ def main():
     ap.add_argument("--moments", default="bf16")
     ap.add_argument("--masters", default="fp32", choices=["fp32", "bf16"])
     ap.add_argument("--quant8", default="", choices=["", "fwd", "dgrad"])
+    ap.add_argument("--unroll", type=int, default=1)
+    ap.add_argument("--no-fused-opt", action="store_true")
     ap.add_argument("--compile-only", action="store_true")
     args = ap.parse_args()
 
@@ -43,7 +45,9 @@ def main():
         else jnp.float32,
         master_dtype=jnp.bfloat16 if args.masters == "bf16"
         else jnp.float32,
-        quant8={"": False, "fwd": True, "dgrad": "dgrad"}[args.quant8])
+        quant8={"": False, "fwd": True, "dgrad": "dgrad"}[args.quant8],
+        layer_unroll=args.unroll,
+        fused_optimizer=False if args.no_fused_opt else None)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size,
                       (args.bs, args.seq)).astype(np.int32)

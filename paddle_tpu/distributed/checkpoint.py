@@ -60,24 +60,33 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
     os.makedirs(path, exist_ok=True)
     flat = _flatten(state_dict)
     rank = jax.process_index()
-    # overwrite semantics: remove this rank's previous shard files (from
-    # its old metadata) so a re-save with a different sharding cannot
-    # leave stale shards that a later load would merge in. A re-save
-    # with FEWER processes is caught at load time via world_size.
+    # overwrite semantics: this rank's previous shard files (from its
+    # old metadata) are collected now but only removed AFTER the new
+    # save is fully staged and atomically published — a crash mid-save
+    # must leave either the complete old or the complete new checkpoint
+    # loadable, never neither. A re-save with FEWER processes is caught
+    # at load time via world_size.
     old_meta_path = os.path.join(path, f"{rank}.metadata.json")
+    old_files = []
+    old_gen = -1
     if os.path.exists(old_meta_path):
         try:
             with open(old_meta_path) as f:
                 old = json.load(f)
+            old_gen = int(old.get("gen", 0))
             for entry in old.get("tensors", {}).values():
                 for shard in entry.get("shards", []):
-                    try:
-                        os.remove(os.path.join(path, shard["file"]))
-                    except OSError:
-                        pass
-        except (json.JSONDecodeError, OSError):
+                    old_files.append(shard["file"])
+        except (json.JSONDecodeError, OSError, ValueError):
             pass
+    # generation tag in every shard filename: a re-save with identical
+    # sharding must NOT overwrite the previous save's files in place,
+    # or a crash between shard writes and the metadata flip would leave
+    # the old metadata pointing at new shard contents (torn state). The
+    # flip below is only a commit point if new files are new names.
+    gen = old_gen + 1
     meta: Dict[str, Any] = {"tensors": {}, "non_tensors": {},
+                            "gen": gen,
                             "world_size": jax.process_count()}
     writes = []
 
@@ -104,7 +113,7 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
                     (0 if s.start is None else s.start,
                      dim if s.stop is None else s.stop)
                     for s, dim in zip(shard.index, np.shape(arr)))
-                fname = f"{key.replace('/', '_')}.{rank}.{i}.distcp.npy"
+                fname = f"{key.replace('/', '_')}.{rank}.{i}.g{gen}.distcp.npy"
                 entry["shards"].append({"file": fname,
                                         "index": [list(p) for p in idx]})
                 writes.append((os.path.join(path, fname),
@@ -114,16 +123,43 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
             # coordinator writes (the jax.Array branch dedups via
             # replica_id; this is the same rule for np data)
             if rank == coordinator_rank:
-                fname = f"{key.replace('/', '_')}.{rank}.0.distcp.npy"
+                fname = f"{key.replace('/', '_')}.{rank}.0.g{gen}.distcp.npy"
                 entry["shards"].append({
                     "file": fname,
                     "index": [[0, d] for d in np.shape(arr)]})
                 writes.append((os.path.join(path, fname), arr))
         meta["tensors"][key] = entry
 
+    new_files = {os.path.basename(f) for f, _ in writes}
+
     def do_write():
+        # stage everything under temp names, then publish with
+        # os.replace (atomic on POSIX): shards first, metadata last —
+        # the metadata flip is the commit point. Old shards the new
+        # save does not reuse are deleted only after the commit.
         for fpath, data in writes:
-            np.save(fpath, np.asarray(jax.device_get(data)))
+            tmp = fpath + ".tmp"
+            with open(tmp, "wb") as fh:  # np.save would append .npy
+                np.save(fh, np.asarray(jax.device_get(data)))
+            os.replace(tmp, fpath)
+        # EVERY rank writes its own metadata file: each process only
+        # knows about its addressable shards, so a coordinator-only
+        # write would orphan every other rank's shard files (load
+        # merges the globbed {rank}.metadata.json files)
+        meta_tmp = old_meta_path + ".tmp"
+        with open(meta_tmp, "w") as f:
+            # numpy scalars (np.int32 step counters etc.) land in
+            # non_tensors; serialize them as their python values
+            json.dump(meta, f,
+                      default=lambda o: o.item() if hasattr(o, "item")
+                      else str(o))
+        os.replace(meta_tmp, old_meta_path)
+        for fname in old_files:
+            if fname not in new_files:
+                try:
+                    os.remove(os.path.join(path, fname))
+                except OSError:
+                    pass
 
     if async_save:
         # snapshot to host first (device buffers may be donated later)
@@ -133,17 +169,6 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
         _pending.append(t)
     else:
         do_write()
-
-    # EVERY rank writes its own metadata file: each process only knows
-    # about its addressable shards, so a coordinator-only write would
-    # orphan every other rank's shard files (load merges the globbed
-    # {rank}.metadata.json files)
-    with open(os.path.join(path, f"{rank}.metadata.json"), "w") as f:
-        # numpy scalars (np.int32 step counters etc.) land in
-        # non_tensors; serialize them as their python values
-        json.dump(meta, f,
-                  default=lambda o: o.item() if hasattr(o, "item")
-                  else str(o))
 
 
 _pending = []

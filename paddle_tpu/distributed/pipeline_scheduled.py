@@ -1,0 +1,443 @@
+"""Table-driven SPMD pipeline engine: executes ANY validated job table
+(1F1B / interleaved-VPP / ZeroBubble) ON DEVICE as one jitted program.
+
+Reference behavior: the pipeline_scheduler_pass family reorders a static
+program's microbatch jobs into per-rank instruction lists and executes
+them over NCCL p2p (pipeline_vpp.py:42 interleaved, with the dygraph
+runtime at fleet/meta_parallel/pipeline_parallel.py:1174;
+pipeline_zero_bubble.py:62 ZB-H1). TPU-native design: the job table
+(distributed.pipeline_schedules) is lowered to per-tick int32 arrays
+that drive one ``lax.scan``; each tick every rank ``lax.switch``es into
+its job (IDLE/F/B/B_INPUT/B_WEIGHT) and activations/cotangents hop the
+ring via ``lax.ppermute`` as (payload, chunk, mb, valid) packets.
+Per-(rank,chunk) packet inboxes and residual stores are ring buffers
+whose depths are computed STATICALLY from the schedule timeline, so
+memory stays at the schedule's true live-window size (the 1F1B/VPP
+memory property) instead of O(M).
+
+ZeroBubble's split backward maps to two vjps against the recomputed
+stage forward: B_INPUT takes the cotangent w.r.t. the stage input (the
+inter-stage critical path), pushing (saved_input, cotangent) onto a
+FIFO; B_WEIGHT pops it and runs the params-only vjp in what was the
+cooldown bubble. Activation-checkpointed style: each backward kind
+recomputes the stage forward from the saved input, so ZB pays one extra
+stage-forward per microbatch versus fused B — the schedule buys it back
+by shortening the per-tick critical path and filling bubbles.
+
+Interleaved VPP: stacked params carry a leading chunk dim [V, S, ...];
+chunk ``c`` of rank ``r`` is global virtual stage ``c*S + r``. The ring
+hop r=S-1 -> r=0 advances the chunk index, which is carried in the
+packet tag.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from jax import shard_map
+
+from .pipeline_schedules import (PipelineSchedule, Job, F, B, BI, BW,
+                                 IDLE)
+
+__all__ = ["pipeline_train_scheduled", "schedule_arrays",
+           "schedule_ring_sizes"]
+
+_KIND = {IDLE: 0, F: 1, B: 2, BI: 3, BW: 4}
+
+
+def schedule_arrays(sched: PipelineSchedule):
+    """Lower a schedule's timeline to [S, T] int32 arrays
+    (kind, mb, chunk)."""
+    tl = sched.timeline()
+    S = len(tl)
+    T = len(tl[0])
+    kind = np.zeros((S, T), np.int32)
+    mb = np.zeros((S, T), np.int32)
+    chunk = np.zeros((S, T), np.int32)
+    for r, row in enumerate(tl):
+        for t, j in enumerate(row):
+            kind[r, t] = _KIND[j.kind]
+            mb[r, t] = max(j.mb, 0)
+            chunk[r, t] = j.chunk
+    return kind, mb, chunk
+
+
+def schedule_ring_sizes(sched: PipelineSchedule) -> Dict[str, int]:
+    """Static ring-buffer depths implied by the timeline's live windows.
+
+    resid:  stage inputs saved at F, freed at the LAST backward kind
+            that recomputes from them (B, or B_WEIGHT when split).
+    inbox_f: forward packets arrive one tick after the upstream F and
+            wait until this rank's F consumes them.
+    inbox_b: cotangent packets arrive one tick after the downstream
+            B/B_INPUT and wait until this rank's backward.
+    wqueue: (input, cotangent) pairs pushed at B_INPUT, popped at
+            B_WEIGHT (FIFO per rank).
+    """
+    tl = sched.timeline()
+    S = len(tl)
+    V = sched.num_chunks
+    T = len(tl[0])
+    f_tick: Dict[Tuple[int, int], int] = {}
+    b_tick: Dict[Tuple[int, int], int] = {}   # B or B_INPUT
+    w_tick: Dict[Tuple[int, int], int] = {}
+    for r, row in enumerate(tl):
+        for t, j in enumerate(row):
+            v = j.chunk * S + r
+            if j.kind == F:
+                f_tick[(j.mb, v)] = t
+            elif j.kind in (B, BI):
+                b_tick[(j.mb, v)] = t
+            elif j.kind == BW:
+                w_tick[(j.mb, v)] = t
+
+    def max_live(windows: List[Tuple[int, int]]) -> int:
+        events = []
+        for a, b in windows:
+            events.append((a, 1))
+            events.append((b + 1, -1))
+        live = peak = 0
+        for _, d in sorted(events):
+            live += d
+            peak = max(peak, live)
+        return max(peak, 1)
+
+    resid_w, inf_w, inb_w, wq_w = [], [], [], []
+    depth = S * V
+    for v in range(depth):
+        resid_w.append(max_live(
+            [(f_tick[(m, v)], w_tick.get((m, v), b_tick[(m, v)]))
+             for m in range(sched.M) if (m, v) in f_tick]))
+        if v > 0:
+            inf_w.append(max_live(
+                [(f_tick[(m, v - 1)] + 1, f_tick[(m, v)])
+                 for m in range(sched.M) if (m, v) in f_tick]))
+        if v < depth - 1:
+            inb_w.append(max_live(
+                [(b_tick[(m, v + 1)] + 1, b_tick[(m, v)])
+                 for m in range(sched.M) if (m, v) in b_tick]))
+    for r, row in enumerate(tl):
+        pend = peak = 0
+        for j in row:
+            if j.kind == BI:
+                pend += 1
+                peak = max(peak, pend)
+            elif j.kind == BW:
+                pend -= 1
+        wq_w.append(max(peak, 1))
+    return {"resid": max(resid_w), "inbox_f": max(inf_w or [1]),
+            "inbox_b": max(inb_w or [1]), "wqueue": max(wq_w),
+            "ticks": T}
+
+
+def pipeline_train_scheduled(stage_fn: Callable, head_loss_fn: Callable,
+                             stacked_params: Any, head_params: Any,
+                             x_micro: jax.Array,
+                             labels_micro: jax.Array,
+                             mesh: Mesh, sched: PipelineSchedule,
+                             axis: str = "pipe",
+                             stage_aux_weight: float = 0.0,
+                             stage_has_aux: bool = None):
+    """Run a full train step (loss, param grads, head grads, input
+    grads) for any job table from ``pipeline_schedules``.
+
+    Args mirror ``pipeline_train_1f1b`` except:
+      stacked_params: pytree with leaves [V, S, ...] — chunk-major
+        virtual stages (V = sched.num_chunks; plain schedules use V=1).
+    Returns (mean_loss, grads [V, S, ...], head_grads, dx_micro).
+    """
+    if stage_has_aux is None:
+        stage_has_aux = bool(stage_aux_weight)
+    sched.validate()
+    S = mesh.shape[axis]
+    if sched.S != S:
+        raise ValueError(f"schedule built for {sched.S} stages, mesh "
+                         f"axis {axis!r} has {S}")
+    V = sched.num_chunks
+    M = x_micro.shape[0]
+    if sched.M != M:
+        raise ValueError(f"schedule built for {sched.M} microbatches, "
+                         f"got {M}")
+    kind_tab, mb_tab, chunk_tab = schedule_arrays(sched)
+    rings = schedule_ring_sizes(sched)
+    T = rings["ticks"]
+    R_RES, R_INF, R_INB, R_WQ = (rings["resid"], rings["inbox_f"],
+                                 rings["inbox_b"], rings["wqueue"])
+    mb_shape = x_micro.shape[1:]
+    x_dtype = x_micro.dtype
+    f32 = jnp.float32
+    down = [(i, (i + 1) % S) for i in range(S)]
+    up = [(i, (i - 1) % S) for i in range(S)]
+
+    def per_rank(params, head_p, xs, labels, kind_row, mb_row,
+                 chunk_row):
+        # leaves arrive [V, 1, ...] (local stage shard) -> [V, ...]
+        params = jax.tree.map(lambda a: a[:, 0], params)
+        rank = jax.lax.axis_index(axis)
+
+        def pick_chunk(c):
+            return jax.tree.map(
+                lambda a: jax.lax.dynamic_index_in_dim(
+                    a, c, 0, keepdims=False), params)
+
+        gacc0 = jax.tree.map(lambda a: jnp.zeros(a.shape, f32), params)
+        zero_pkt = {"y": jnp.zeros(mb_shape, x_dtype),
+                    "chunk": jnp.zeros((), jnp.int32),
+                    "mb": jnp.zeros((), jnp.int32),
+                    "valid": jnp.zeros((), jnp.bool_)}
+        carry0 = {
+            "fwd_pkt": zero_pkt,            # arrived last tick (down)
+            "bwd_pkt": dict(zero_pkt),      # arrived last tick (up)
+            "inbox_f": jnp.zeros((V, R_INF) + mb_shape, x_dtype),
+            "inbox_b": jnp.zeros((V, R_INB) + mb_shape, x_dtype),
+            "resid": jnp.zeros((V, R_RES) + mb_shape, x_dtype),
+            "wq_x": jnp.zeros((R_WQ,) + mb_shape, x_dtype),
+            "wq_g": jnp.zeros((R_WQ,) + mb_shape, x_dtype),
+            "wq_chunk": jnp.zeros((R_WQ,), jnp.int32),
+            "w_push": jnp.zeros((), jnp.int32),
+            "w_pop": jnp.zeros((), jnp.int32),
+            "gacc": gacc0,
+            "ghead": jax.tree.map(lambda a: jnp.zeros(a.shape, f32),
+                                  head_p),
+            "loss": jnp.zeros((), f32),
+            "dx_buf": jnp.zeros((M,) + mb_shape, x_dtype),
+        }
+
+        def store_pkt(buf, pkt, ring):
+            slot = pkt["mb"] % ring
+            cur = jax.lax.dynamic_slice(
+                buf, (pkt["chunk"], slot) + (0,) * len(mb_shape),
+                (1, 1) + mb_shape)
+            new = jnp.where(pkt["valid"], pkt["y"][None, None], cur)
+            return jax.lax.dynamic_update_slice(
+                buf, new.astype(buf.dtype),
+                (pkt["chunk"], slot) + (0,) * len(mb_shape))
+
+        def load2(buf, c, slot):
+            return jax.lax.dynamic_slice(
+                buf, (c, slot) + (0,) * len(mb_shape),
+                (1, 1) + mb_shape)[0, 0]
+
+        def recompute(chunk_params, x_saved):
+            if stage_has_aux:
+                return stage_fn(chunk_params, x_saved)
+            return stage_fn(chunk_params, x_saved), None
+
+        def tick(carry, xs_t):
+            kind_t, mb_t, chunk_t = xs_t
+            c = dict(carry)
+            # file arrivals from last tick's hops
+            c["inbox_f"] = store_pkt(c["inbox_f"], c["fwd_pkt"], R_INF)
+            c["inbox_b"] = store_pkt(c["inbox_b"], c["bwd_pkt"], R_INB)
+            v_here = chunk_t * S + rank
+            is_first = v_here == 0
+            is_last = v_here == V * S - 1
+
+            no_pkt = {"y": jnp.zeros(mb_shape, x_dtype),
+                      "chunk": jnp.zeros((), jnp.int32),
+                      "mb": mb_t, "valid": jnp.zeros((), jnp.bool_)}
+
+            # ---- job branches: each returns (carry, fpkt, bpkt) ----
+            def do_idle(c):
+                return c, no_pkt, dict(no_pkt)
+
+            def do_f(c):
+                cp = pick_chunk(chunk_t)
+                x_in = jnp.where(
+                    is_first,
+                    jax.lax.dynamic_index_in_dim(xs, mb_t, 0,
+                                                 keepdims=False),
+                    load2(c["inbox_f"], chunk_t, mb_t % R_INF))
+                y, _ = recompute(cp, x_in)
+                c = dict(c)
+                c["resid"] = jax.lax.dynamic_update_slice(
+                    c["resid"], x_in[None, None].astype(x_dtype),
+                    (chunk_t, mb_t % R_RES) + (0,) * len(mb_shape))
+                # receiver's chunk: +1 when the hop wraps S-1 -> 0
+                fpkt = {"y": y.astype(x_dtype),
+                        "chunk": jnp.where(rank == S - 1, chunk_t + 1,
+                                           chunk_t),
+                        "mb": mb_t,
+                        "valid": jnp.logical_not(is_last)}
+                return c, fpkt, dict(no_pkt)
+
+            def seed_cotangent(c, y2):
+                """Loss-head seed on the last virtual stage; inbox
+                cotangent elsewhere. Returns (loss_j, g_out, dhp)."""
+                lab = jax.lax.dynamic_index_in_dim(labels, mb_t, 0,
+                                                   keepdims=False)
+
+                def from_head(_):
+                    loss_j, head_vjp = jax.vjp(
+                        lambda hp, yy: head_loss_fn(hp, yy, lab),
+                        head_p, y2)
+                    dhp, dy = head_vjp(jnp.full((), 1.0 / M, f32))
+                    return loss_j, dy.astype(x_dtype), dhp
+
+                def from_inbox(_):
+                    return (jnp.zeros((), f32),
+                            load2(c["inbox_b"], chunk_t, mb_t % R_INB),
+                            jax.tree.map(
+                                lambda a: jnp.zeros(a.shape, f32),
+                                head_p))
+
+                return jax.lax.cond(is_last, from_head, from_inbox,
+                                    operand=None)
+
+            def bwd_common(c):
+                """Recompute + full vjp; B uses both cotangents,
+                B_INPUT discards dparams (W deferred to the queue)."""
+                cp = pick_chunk(chunk_t)
+                x_saved = load2(c["resid"], chunk_t, mb_t % R_RES)
+                if stage_has_aux:
+                    (y2, aux2), vjp_fn = jax.vjp(
+                        lambda p, x: stage_fn(p, x), cp, x_saved)
+                else:
+                    y2, vjp_fn = jax.vjp(stage_fn, cp, x_saved)
+                    aux2 = None
+                loss_j, g_out, dhp = seed_cotangent(c, y2)
+                if stage_has_aux:
+                    seed = (g_out.astype(y2.dtype),
+                            jnp.full((), stage_aux_weight / M, f32))
+                else:
+                    seed = g_out.astype(y2.dtype)
+                dparams, dx = vjp_fn(seed)
+                return (loss_j, g_out, dhp, dparams, dx, aux2, x_saved)
+
+            def accum(c, chunk_idx, dparams, dhp, loss_j, aux2):
+                c = dict(c)
+                if dparams is not None:
+                    c["gacc"] = jax.tree.map(
+                        lambda g, d: jax.lax.dynamic_update_index_in_dim(
+                            g,
+                            jax.lax.dynamic_index_in_dim(
+                                g, chunk_idx, 0, keepdims=False)
+                            + d.astype(f32),
+                            chunk_idx, 0),
+                        c["gacc"], dparams)
+                c["ghead"] = jax.tree.map(
+                    lambda g, d: g + d.astype(f32), c["ghead"], dhp)
+                loss_j = loss_j + (0.0 if aux2 is None
+                                   else aux2 * stage_aux_weight)
+                c["loss"] = c["loss"] + loss_j
+                return c
+
+            def emit_dx(c, dx):
+                dxc = dx.astype(x_dtype)
+                c = dict(c)
+                c["dx_buf"] = jax.lax.cond(
+                    is_first,
+                    lambda b: jax.lax.dynamic_update_index_in_dim(
+                        b, dxc, mb_t, 0),
+                    lambda b: b, c["dx_buf"])
+                bpkt = {"y": dxc,
+                        "chunk": jnp.where(rank == 0, chunk_t - 1,
+                                           chunk_t),
+                        "mb": mb_t,
+                        "valid": jnp.logical_not(is_first)}
+                return c, bpkt
+
+            def do_b(c):
+                (loss_j, _g, dhp, dparams, dx, aux2, _x) = bwd_common(c)
+                c = accum(c, chunk_t, dparams, dhp, loss_j, aux2)
+                c, bpkt = emit_dx(c, dx)
+                return c, dict(no_pkt), bpkt
+
+            def do_bi(c):
+                (loss_j, g_out, dhp, _dp, dx, aux2, x_saved) = \
+                    bwd_common(c)
+                c = accum(c, chunk_t, None, dhp, loss_j, aux2)
+                # push (input, cotangent) for the deferred W job
+                slot = c["w_push"] % R_WQ
+                c["wq_x"] = jax.lax.dynamic_update_index_in_dim(
+                    c["wq_x"], x_saved, slot, 0)
+                c["wq_g"] = jax.lax.dynamic_update_index_in_dim(
+                    c["wq_g"], g_out.astype(x_dtype), slot, 0)
+                c["wq_chunk"] = jax.lax.dynamic_update_index_in_dim(
+                    c["wq_chunk"], chunk_t, slot, 0)
+                c["w_push"] = c["w_push"] + 1
+                c, bpkt = emit_dx(c, dx)
+                return c, dict(no_pkt), bpkt
+
+            def do_bw(c):
+                c = dict(c)
+                slot = c["w_pop"] % R_WQ
+                x_saved = jax.lax.dynamic_index_in_dim(
+                    c["wq_x"], slot, 0, keepdims=False)
+                g_out = jax.lax.dynamic_index_in_dim(
+                    c["wq_g"], slot, 0, keepdims=False)
+                wchunk = jax.lax.dynamic_index_in_dim(
+                    c["wq_chunk"], slot, 0, keepdims=False)
+                c["w_pop"] = c["w_pop"] + 1
+                cp = pick_chunk(wchunk)
+                if stage_has_aux:
+                    (y2, aux2), vjp_fn = jax.vjp(
+                        lambda p: stage_fn(p, x_saved), cp)
+                    seed = (g_out.astype(y2.dtype),
+                            jnp.full((), stage_aux_weight / M, f32))
+                else:
+                    y2, vjp_fn = jax.vjp(
+                        lambda p: stage_fn(p, x_saved), cp)
+                    seed = g_out.astype(y2.dtype)
+                (dparams,) = vjp_fn(seed)
+                c["gacc"] = jax.tree.map(
+                    lambda g, d: jax.lax.dynamic_update_index_in_dim(
+                        g,
+                        jax.lax.dynamic_index_in_dim(
+                            g, wchunk, 0, keepdims=False)
+                        + d.astype(f32),
+                        wchunk, 0),
+                    c["gacc"], dparams)
+                return c, dict(no_pkt), dict(no_pkt)
+
+            c, fpkt, bpkt = jax.lax.switch(
+                kind_t, [do_idle, do_f, do_b, do_bi, do_bw], c)
+
+            # ---- ring hops (every rank, every tick) ---------------
+            c["fwd_pkt"] = jax.tree.map(
+                lambda a: jax.lax.ppermute(a, axis, down), fpkt)
+            c["bwd_pkt"] = jax.tree.map(
+                lambda a: jax.lax.ppermute(a, axis, up), bpkt)
+            return c, None
+
+        xs_scan = (kind_row, mb_row, chunk_row)
+        carry, _ = jax.lax.scan(tick, carry0, xs_scan)
+
+        loss = jax.lax.psum(carry["loss"], axis) / M
+        ghead = jax.tree.map(lambda g: jax.lax.psum(g, axis),
+                             carry["ghead"])
+        dx = jax.lax.psum(
+            jnp.where(rank == 0, carry["dx_buf"],
+                      jnp.zeros_like(carry["dx_buf"])), axis)
+        gstacked = jax.tree.map(lambda g: g[:, None], carry["gacc"])
+        return loss, gstacked, ghead, dx
+
+    # per-rank job rows ride the shard_map as 'pipe'-sharded operands
+    kind_rows = jnp.asarray(kind_tab)
+    mb_rows = jnp.asarray(mb_tab)
+    chunk_rows = jnp.asarray(chunk_tab)
+
+    def per_rank_rows(params, head_p, xs, labels, kr, mr, cr):
+        return per_rank(params, head_p, xs, labels, kr[0], mr[0], cr[0])
+
+    in_specs = (
+        jax.tree.map(lambda _: P(None, axis), stacked_params),
+        jax.tree.map(lambda _: P(), head_params),
+        P(*([None] * x_micro.ndim)),
+        P(*([None] * labels_micro.ndim)),
+        P(axis), P(axis), P(axis),
+    )
+    out_specs = (
+        P(),
+        jax.tree.map(lambda _: P(None, axis), stacked_params),
+        jax.tree.map(lambda _: P(), head_params),
+        P(*([None] * x_micro.ndim)),
+    )
+    fn = shard_map(per_rank_rows, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_specs, axis_names={axis},
+                   check_vma=False)
+    return fn(stacked_params, head_params, x_micro, labels_micro,
+              kind_rows, mb_rows, chunk_rows)

@@ -174,6 +174,12 @@ class DataLoader:
             # must not run post-fork -> default those to threads
             worker_mode = "process" \
                 if self.collate_fn is default_collate_fn else "thread"
+        if worker_mode == "process" \
+                and "fork" not in mp.get_all_start_methods():
+            # no fork (Windows; macOS default is spawn): spawn would
+            # re-import jax and re-pickle the dataset in every child —
+            # thread workers are the safe degradation
+            worker_mode = "thread"
         self.worker_mode = worker_mode
         self._pool = None  # persistent map-style process pool
         self._iterable_mode = isinstance(dataset, IterableDataset)

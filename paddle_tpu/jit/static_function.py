@@ -113,6 +113,7 @@ class StaticFunction:
             self._bound_self = None
         self._input_spec = input_spec
         self._cache = {}  # static-guard key -> jitted program
+        self._overflow_warned = False
         self._sig = None  # lazily-computed signature (kwargs path)
         functools.update_wrapper(self, self._fn)
 
@@ -251,6 +252,18 @@ class StaticFunction:
                 # evict least-recently-used, record churn as breaks
                 self._cache.pop(next(iter(self._cache)))
                 _note_break("guard cache overflow")
+                if not self._overflow_warned:
+                    self._overflow_warned = True
+                    import warnings
+                    warnings.warn(
+                        f"to_static function "
+                        f"{getattr(self._fn, '__name__', '?')!r} exceeded "
+                        f"{_CACHE_LIMIT} guard specializations — a "
+                        f"non-tensor argument is taking a fresh value "
+                        f"every call (step counter, growing length?), "
+                        f"forcing a recompile per call. Pass it as a "
+                        f"Tensor/array to trace it dynamically.",
+                        RuntimeWarning, stacklevel=3)
             jitted = self._cache[key] = self._build(layout)
         try:
             if self._layer is not None:

@@ -223,9 +223,12 @@ class GPTSpmdTrainer:
                  master_dtype: Any = jnp.float32,
                  quant8: bool = False,
                  pipeline_schedule: str = "gpipe",
+                 vpp_chunks: int = 2,
                  moe_experts: int = 0,
                  moe_capacity_factor: float = 1.25,
-                 moe_aux_weight: float = 1e-2):
+                 moe_aux_weight: float = 1e-2,
+                 fused_optimizer: Optional[bool] = None,
+                 layer_unroll: int = 1):
         self.cfg = cfg
         self.mesh = mesh
         self.remat = remat  # per-block activation checkpointing
@@ -254,12 +257,25 @@ class GPTSpmdTrainer:
         # pp schedule: "gpipe" = autodiff'd scan+ppermute forward
         # (F-then-B); "1f1b" = explicit on-device 1F1B train schedule
         # (distributed/pipeline.pipeline_train_1f1b) with O(S) instead
-        # of O(M) in-flight activations per stage
-        if pipeline_schedule not in ("gpipe", "fthenb", "1f1b"):
+        # of O(M) in-flight activations per stage; "vpp" = interleaved
+        # virtual-pipeline (each rank holds vpp_chunks model chunks —
+        # fill bubble shrinks by 1/V) and "zb" = ZeroBubble ZB-H1
+        # (backward split into input-grad and weight-grad jobs, W fills
+        # the cooldown bubble) — both execute their job tables on
+        # device via distributed/pipeline_scheduled.py
+        aliases = {"fthenb": "gpipe", "zero_bubble": "zb",
+                   "interleaved": "vpp"}
+        pipeline_schedule = aliases.get(pipeline_schedule,
+                                        pipeline_schedule)
+        if pipeline_schedule not in ("gpipe", "1f1b", "vpp", "zb"):
             raise ValueError(f"unknown pipeline_schedule "
                              f"{pipeline_schedule!r}")
-        self.pipeline_schedule = "gpipe" if pipeline_schedule == "fthenb" \
-            else pipeline_schedule
+        self.pipeline_schedule = pipeline_schedule
+        # chunked params only make sense with a pipe axis: with pipe=1
+        # every schedule degenerates to the plain forward, which
+        # consumes unchunked [S=1, L, ...] stage params
+        self.V = int(vpp_chunks) if (pipeline_schedule == "vpp"
+                                     and mesh.shape["pipe"] > 1) else 1
         # MoE-FFN variant: E experts per block, GShard top-2 dispatch,
         # experts sharded over the 'data' mesh axis (expert parallelism
         # — the dispatch/combine einsums lower to the all-to-all pair
@@ -269,11 +285,31 @@ class GPTSpmdTrainer:
         self.moe_experts = int(moe_experts)
         self.moe_capacity_factor = moe_capacity_factor
         self.moe_aux_weight = moe_aux_weight
+        # single-pass Pallas AdamW (ops/fused_adamw.py): one kernel per
+        # leaf reads p/g/m/v and writes p/m/v with in-kernel SR random
+        # bits — 14 bytes/param of HBM traffic vs ~26 for the XLA
+        # multi-pass schedule. Only meaningful on a real TPU; the
+        # unsharded leaves the kernel needs exist when no mesh axis
+        # shards params in ways the 2-D collapse can't see, so gate to
+        # single-device meshes (GSPMD partitions pallas_call manually
+        # sharded kernels poorly).
+        if fused_optimizer is None:
+            fused_optimizer = (jax.default_backend() in ("tpu", "axon")
+                               and mesh.size == 1)
+        self.fused_optimizer = fused_optimizer
+        # unroll factor for the per-stage layer scan: with the scan
+        # rolled, every remat-saved residual round-trips HBM through a
+        # dynamic-update-slice into the [L, ...] stacked buffer (plus a
+        # matching dynamic-slice in the backward) — measured ~49 ms of
+        # pure stacking traffic on the 1.3B step. Unrolling lets XLA
+        # write each layer's residuals straight from the producing
+        # fusion. Costs compile time roughly linearly in the factor.
+        self.layer_unroll = int(layer_unroll)
         if self.moe_experts and mesh.shape["pipe"] > 1 \
-                and self.pipeline_schedule != "1f1b":
+                and self.pipeline_schedule == "gpipe":
             raise NotImplementedError(
-                "MoE + pipeline parallelism requires the explicit "
-                "1F1B engine (pipeline_schedule='1f1b'): the "
+                "MoE + pipeline parallelism requires an explicit "
+                "schedule engine ('1f1b', 'vpp' or 'zb'): the "
                 "autodiff'd GPipe scan has no aux-loss side channel")
         # Pallas flash attention on real TPU; XLA einsum attention
         # elsewhere (interpret-mode pallas is orders slower on CPU, and
@@ -282,10 +318,16 @@ class GPTSpmdTrainer:
             use_flash = jax.default_backend() in ("tpu", "axon")
         self.use_flash = use_flash
         self.S = mesh.shape["pipe"]
-        if cfg.num_layers % self.S:
-            raise ValueError("num_layers must divide pp degree")
-        self.Lps = cfg.num_layers // self.S
+        if cfg.num_layers % (self.S * self.V):
+            raise ValueError("num_layers must divide pp degree "
+                             "(x vpp_chunks for 'vpp')")
+        self.Lps = cfg.num_layers // (self.S * self.V)
         self.M = microbatches or max(2 * self.S, 1)
+        if self.pipeline_schedule == "vpp" and self.S > 1 \
+                and self.M % self.S:
+            raise ValueError("interleaved schedule needs "
+                             "microbatches % pp degree == 0")
+        self._sched_cache = None
         self.lr = learning_rate
         self.wd = weight_decay
         self.betas = (beta1, beta2)
@@ -311,17 +353,29 @@ class GPTSpmdTrainer:
         resid_std = std / math.sqrt(2 * cfg.num_layers)
 
         mdt = self.master_dtype
+        n_chunks = self.V
+
+        def vshape(shape, spec):
+            # interleaved VPP: blocks leaves grow a leading chunk dim
+            # [V, S, ...] — chunk c of pipe-rank r is virtual stage
+            # c*S + r (pipeline_scheduled.py)
+            if n_chunks > 1 and spec and spec[0] == "pipe":
+                return (n_chunks,) + shape, (None,) + spec
+            return shape, spec
 
         def init(key, shape, scale, spec):
+            shape, spec = vshape(shape, spec)
             arr = (scale * jax.random.normal(key, shape,
                                              jnp.float32)).astype(mdt)
             return jax.device_put(arr, _spec(self.mesh, *spec))
 
         def zeros(shape, spec):
+            shape, spec = vshape(shape, spec)
             return jax.device_put(jnp.zeros(shape, mdt),
                                   _spec(self.mesh, *spec))
 
         def ones(shape, spec):
+            shape, spec = vshape(shape, spec)
             return jax.device_put(jnp.ones(shape, mdt),
                                   _spec(self.mesh, *spec))
 
@@ -539,7 +593,8 @@ class GPTSpmdTrainer:
         of ~9 activation buffers per layer."""
         blk = self._remat_wrap(self._block)
         x, _ = jax.lax.scan(lambda carry, bp: (blk(carry, bp), None),
-                            x, stage_params)
+                            x, stage_params,
+                            unroll=min(self.layer_unroll, self.Lps))
         return x
 
     def _remat_wrap(self, block_fn):
@@ -583,7 +638,8 @@ class GPTSpmdTrainer:
             return (x, aux + a), None
 
         (x, aux), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)),
-                                   stage_params)
+                                   stage_params,
+                                   unroll=min(self.layer_unroll, self.Lps))
         return x, aux
 
     def _embed(self, wte, wpe, input_ids):
@@ -642,10 +698,10 @@ class GPTSpmdTrainer:
         # fused vocab-chunked CE when no axis shards the vocab/seq dims:
         # never materializes [B,T,V] logits (ops/fused_ce.py)
         if (shape["model"] == 1 and shape["sep"] == 1
-                and cfg.vocab_size % 8 == 0):
+                and cfg.vocab_size % 16 == 0):
             from ..ops.fused_ce import fused_softmax_cross_entropy
             loss = fused_softmax_cross_entropy(x, head.astype(dtype),
-                                               labels, n_chunks=8)
+                                               labels, n_chunks=16)
         else:
             logits = jnp.einsum("btd,dv->btv", x, head.astype(dtype),
                                 preferred_element_type=jnp.float32)
@@ -706,11 +762,26 @@ class GPTSpmdTrainer:
         else:
             stage_fn = self._stage_fn
             aux_w = 0.0
-        loss, gblocks, ghead, dx_micro = pipeline_train_1f1b(
-            stage_fn, head_loss, params["blocks"], head_p,
-            x_micro, labels_micro, self.mesh, axis="pipe",
-            stage_aux_weight=aux_w,
-            stage_has_aux=bool(self.moe_experts))
+        if self.pipeline_schedule == "1f1b":
+            loss, gblocks, ghead, dx_micro = pipeline_train_1f1b(
+                stage_fn, head_loss, params["blocks"], head_p,
+                x_micro, labels_micro, self.mesh, axis="pipe",
+                stage_aux_weight=aux_w,
+                stage_has_aux=bool(self.moe_experts))
+        else:  # "vpp" / "zb": table-driven on-device engine
+            from ..distributed.pipeline_scheduled import \
+                pipeline_train_scheduled
+            sched = self._get_schedule()
+            blocks = params["blocks"]
+            if self.V == 1:  # engine expects a leading chunk dim
+                blocks = jax.tree.map(lambda a: a[None], blocks)
+            loss, gblocks, ghead, dx_micro = pipeline_train_scheduled(
+                stage_fn, head_loss, blocks, head_p,
+                x_micro, labels_micro, self.mesh, sched, axis="pipe",
+                stage_aux_weight=aux_w,
+                stage_has_aux=bool(self.moe_experts))
+            if self.V == 1:
+                gblocks = jax.tree.map(lambda a: a[0], gblocks)
 
         (demb,) = embed_vjp(dx_micro.reshape(B, T, cfg.hidden_size))
         gwte = demb["wte"].astype(jnp.float32)
@@ -726,6 +797,18 @@ class GPTSpmdTrainer:
         if not cfg.tie_embeddings:
             grads["head"] = ghead["head"]
         return loss, grads
+
+    def _get_schedule(self):
+        """Job table for the 'vpp'/'zb' engines (cached; host-side)."""
+        if self._sched_cache is None:
+            from ..distributed.pipeline_schedules import (
+                InterleavedSchedule, ZeroBubbleSchedule)
+            if self.pipeline_schedule == "vpp":
+                self._sched_cache = InterleavedSchedule(
+                    self.S, self.M, num_chunks=self.V)
+            else:
+                self._sched_cache = ZeroBubbleSchedule(self.S, self.M)
+        return self._sched_cache
 
     # -- optimizer (fused AdamW, sharded like params) ----------------------
     def _adamw(self, params, grads, opt_state):
@@ -751,6 +834,14 @@ class GPTSpmdTrainer:
             return (p2, m2.astype(self.moment_dtype),
                     v2.astype(self.moment_dtype))
 
+        use_fused = self.fused_optimizer
+        if use_fused:
+            from ..ops.fused_adamw import (fused_adamw_update,
+                                           fused_adamw_eligible)
+            b1f, b2f = float(b1), float(b2)
+            inv_bc1 = 1.0 / (1.0 - b1f ** tf)
+            inv_bc2 = 1.0 / (1.0 - b2f ** tf)
+
         flat_p, tdef = jax.tree.flatten(params)
         flat_g = jax.tree.leaves(grads)
         flat_m = jax.tree.leaves(opt_state["m"])
@@ -758,6 +849,17 @@ class GPTSpmdTrainer:
         new_p, new_m, new_v = [], [], []
         for i, (p, g, m, v) in enumerate(zip(flat_p, flat_g, flat_m,
                                              flat_v)):
+            if use_fused and fused_adamw_eligible(p):
+                p2, m2, v2 = fused_adamw_update(
+                    p, g, m, v, scale, inv_bc1, inv_bc2,
+                    step.astype(jnp.int32),
+                    lr=float(self.lr), wd=float(self.wd),
+                    b1=b1f, b2=b2f, eps=1e-8,
+                    stoch_round=self._stoch_round, leaf_id=i)
+                new_p.append(p2)
+                new_m.append(m2.astype(self.moment_dtype))
+                new_v.append(v2.astype(self.moment_dtype))
+                continue
             # rbg keys are cheap to build and the generator is ~10x
             # faster than threefry on TPU (SR needs 16 bits/param/step)
             key = jnp.array([0x5eed, 0xbeef, i, 0], jnp.uint32) \
@@ -776,7 +878,8 @@ class GPTSpmdTrainer:
             return self._step_fn
 
         def step(params, opt_state, input_ids, labels):
-            if self.S > 1 and self.pipeline_schedule == "1f1b":
+            if self.S > 1 and self.pipeline_schedule in ("1f1b", "vpp",
+                                                         "zb"):
                 cparams = params if self._stoch_round else jax.tree.map(
                     lambda p: p.astype(self.cfg.dtype), params) \
                     if self.mixed_precision else params

@@ -1,0 +1,123 @@
+"""Parity of the one-pass Pallas AdamW kernel (ops/fused_adamw.py)
+against the trainer's reference update math
+(models/gpt.py:GPTSpmdTrainer._adamw), run in interpret mode on CPU.
+
+Reference analog: paddle/phi/kernels/gpu/fused_adam_kernel.cu
+(multi-tensor fused Adam) — numerics contract is the plain AdamW
+recurrence with decoupled weight decay and bias correction.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.fused_adamw import (fused_adamw_update,
+                                        fused_adamw_eligible)
+
+LR, WD, B1, B2, EPS = 3e-4, 0.1, 0.9, 0.95, 1e-8
+
+
+def _ref_update(p, g, m, v, scale, ib1, ib2):
+    gf = g.astype(jnp.float32) * scale
+    m2 = B1 * m.astype(jnp.float32) + (1 - B1) * gf
+    v2 = B2 * v.astype(jnp.float32) + (1 - B2) * gf * gf
+    p2 = p.astype(jnp.float32) * (1 - LR * WD) - \
+        LR * (m2 * ib1) / (jnp.sqrt(v2 * ib2) + EPS)
+    return p2, m2, v2
+
+
+def test_eligibility():
+    z = jnp.zeros
+    assert fused_adamw_eligible(z((512, 1024)))
+    assert fused_adamw_eligible(z((1, 24, 2048, 6144)))
+    assert not fused_adamw_eligible(z((2048,)))          # rank 1
+    assert not fused_adamw_eligible(z((100, 100)))       # lanes % 128
+    assert not fused_adamw_eligible(z((8, 128)))         # too small
+
+
+def test_fp32_parity_exact():
+    k = jax.random.key(0)
+    R, C = 64, 384  # non-power-of-two lane tile (vocab-remainder case)
+    p = jax.random.normal(k, (R, C), jnp.float32)
+    g = jax.random.normal(jax.random.fold_in(k, 1), (R, C), jnp.float32)
+    m = 0.1 * jax.random.normal(jax.random.fold_in(k, 2), (R, C),
+                                jnp.float32)
+    v = 0.01 * jnp.abs(jax.random.normal(jax.random.fold_in(k, 3),
+                                         (R, C), jnp.float32))
+    t = 7
+    scale = jnp.float32(0.5)
+    ib1 = 1.0 / (1.0 - B1 ** t)
+    ib2 = 1.0 / (1.0 - B2 ** t)
+    po, mo, vo = fused_adamw_update(
+        p, g, m, v, scale, ib1, ib2, 0, lr=LR, wd=WD, b1=B1, b2=B2,
+        eps=EPS, stoch_round=False, interpret=True)
+    pr, mr, vr = _ref_update(p, g, m, v, scale, ib1, ib2)
+    # interpret mode may associate fp32 ops differently: 1-2 ulp
+    np.testing.assert_allclose(np.asarray(po), np.asarray(pr),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(mo), np.asarray(mr),
+                               rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(vo), np.asarray(vr),
+                               rtol=1e-6, atol=1e-7)
+
+
+def test_bf16_moments_and_grads():
+    """Mixed dtypes as the trainer uses them: bf16 p/g/m/v in, bf16
+    out, fp32 math inside."""
+    k = jax.random.key(1)
+    R, C = 32, 256
+    p = jax.random.normal(k, (R, C), jnp.bfloat16)
+    g = jax.random.normal(jax.random.fold_in(k, 1), (R, C),
+                          jnp.bfloat16)
+    m = jnp.zeros((R, C), jnp.bfloat16)
+    v = jnp.zeros((R, C), jnp.bfloat16)
+    po, mo, vo = fused_adamw_update(
+        p, g, m, v, 1.0, 1.0 / (1 - B1), 1.0 / (1 - B2), 0,
+        lr=LR, wd=WD, b1=B1, b2=B2, eps=EPS, stoch_round=False,
+        interpret=True)
+    pr, mr, vr = _ref_update(p, g, m, v, jnp.float32(1.0),
+                             1.0 / (1 - B1), 1.0 / (1 - B2))
+    assert po.dtype == jnp.bfloat16
+    for got, want in ((po, pr), (mo, mr), (vo, vr)):
+        # fp32 math may differ by ~1 ulp pre-rounding: allow 1 bf16 ulp
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32),
+            np.asarray(want.astype(jnp.bfloat16), np.float32),
+            rtol=2 ** -7, atol=1e-9)
+
+
+def test_stochastic_rounding_neighbors_and_unbiased():
+    """SR output must be one of the two bf16 neighbors of the fp32
+    target, and the mean over seeds must approach the fp32 value."""
+    k = jax.random.key(2)
+    R, C = 16, 128
+    p = jax.random.normal(k, (R, C), jnp.bfloat16)
+    g = jax.random.normal(jax.random.fold_in(k, 1), (R, C),
+                          jnp.bfloat16)
+    m = jnp.zeros((R, C), jnp.bfloat16)
+    v = jnp.zeros((R, C), jnp.bfloat16)
+    ib1, ib2 = 1.0 / (1 - B1), 1.0 / (1 - B2)
+    p_t, _, _ = _ref_update(p, g, m, v, jnp.float32(1.0), ib1, ib2)
+    try:
+        outs = []
+        for s in range(32):
+            ps, _, _ = fused_adamw_update(
+                p, g, m, v, 1.0, ib1, ib2, s, lr=LR, wd=WD, b1=B1,
+                b2=B2, eps=EPS, stoch_round=True, interpret=True)
+            outs.append(np.asarray(ps, np.float32))
+    except Exception as e:  # pragma: no cover
+        pytest.skip(f"pltpu.prng_* unsupported in interpret mode: {e}")
+    pt = np.asarray(p_t)
+    ulp = np.abs(pt.astype(np.float32)) * 2 ** -7 + 1e-30
+    for o in outs:
+        assert np.all(np.abs(o - pt) <= ulp * 1.001)
+    bias = (np.mean(outs, axis=0) - pt) / ulp
+    assert abs(float(np.mean(bias))) < 0.05
+    # determinism: same seed -> same bits
+    a, _, _ = fused_adamw_update(p, g, m, v, 1.0, ib1, ib2, 5, lr=LR,
+                                 wd=WD, b1=B1, b2=B2, eps=EPS,
+                                 stoch_round=True, interpret=True)
+    b, _, _ = fused_adamw_update(p, g, m, v, 1.0, ib1, ib2, 5, lr=LR,
+                                 wd=WD, b1=B1, b2=B2, eps=EPS,
+                                 stoch_round=True, interpret=True)
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))

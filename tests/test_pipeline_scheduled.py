@@ -1,0 +1,237 @@
+"""On-device execution of the named pipeline schedules (1F1B, VPP,
+ZeroBubble) through the table-driven engine, validated for loss AND
+gradient parity against the plain (non-pipelined) computation.
+
+Reference: pipeline_scheduler_pass/pipeline_vpp.py:42 and
+pipeline_zero_bubble.py:62 execute these schedules over NCCL p2p; here
+one jitted scan+ppermute program per schedule (see
+distributed/pipeline_scheduled.py).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from paddle_tpu.distributed.pipeline_schedules import (
+    OneFOneBSchedule, InterleavedSchedule, ZeroBubbleSchedule)
+from paddle_tpu.distributed.pipeline_scheduled import (
+    pipeline_train_scheduled, schedule_ring_sizes)
+
+S, V, M, MB, T, D = 4, 2, 8, 2, 8, 16
+
+
+def make_mesh():
+    devs = jax.devices()
+    if len(devs) < S:
+        pytest.skip(f"needs {S} devices")
+    return Mesh(np.array(devs[:S]).reshape(S), ("pipe",))
+
+
+def stage_fn(p, x):
+    h = jnp.tanh(x @ p["w1"] + p["b1"])
+    return x + h @ p["w2"]
+
+
+def head_loss(hp, y, labels):
+    logits = y @ hp["wo"]
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(lp, labels[..., None], axis=-1)[..., 0]
+    return -jnp.mean(ll)
+
+
+def make_params(depth, key):
+    ks = jax.random.split(key, depth)
+    per = [{"w1": 0.3 * jax.random.normal(k, (D, D), jnp.float32),
+            "b1": jnp.zeros((D,), jnp.float32),
+            "w2": 0.3 * jax.random.normal(
+                jax.random.fold_in(k, 1), (D, D), jnp.float32)}
+           for k in ks]
+    return per
+
+
+def stack_vs(per, v_chunks):
+    """[depth] list -> leaves [V, S, ...] with global stage c*S+r."""
+    s = S
+    return jax.tree.map(
+        lambda *xs: jnp.stack(
+            [jnp.stack([xs[c * s + r] for r in range(s)])
+             for c in range(v_chunks)]), *per)
+
+
+def reference_loss_grads(per, head_p, x_micro, labels_micro):
+    def loss_fn(per, head_p):
+        total = 0.0
+        for m in range(M):
+            y = x_micro[m]
+            for p in per:
+                y = stage_fn(p, y)
+            total = total + head_loss(head_p, y, labels_micro[m])
+        return total / M
+    (loss), grads = jax.value_and_grad(loss_fn, argnums=(0, 1))(
+        per, head_p)
+    return loss, grads
+
+
+@pytest.fixture(scope="module")
+def problem():
+    key = jax.random.key(0)
+    x = jax.random.normal(jax.random.fold_in(key, 10),
+                          (M, MB, T, D), jnp.float32)
+    labels = jax.random.randint(jax.random.fold_in(key, 11),
+                                (M, MB, T), 0, D)
+    head_p = {"wo": 0.3 * jax.random.normal(
+        jax.random.fold_in(key, 12), (D, D), jnp.float32)}
+    return key, x, labels, head_p
+
+
+def run_sched(sched, v_chunks, problem):
+    key, x, labels, head_p = problem
+    mesh = make_mesh()
+    per = make_params(S * v_chunks, key)
+    stacked = stack_vs(per, v_chunks)
+    with jax.set_mesh(mesh):
+        loss, grads, ghead, dx = jax.jit(
+            lambda sp, hp, xm, lm: pipeline_train_scheduled(
+                stage_fn, head_loss, sp, hp, xm, lm, mesh, sched))(
+                    stacked, head_p, x, labels)
+    ref_loss, (ref_g_per, ref_ghead) = reference_loss_grads(
+        per, head_p, x, labels)
+    ref_stacked = stack_vs(ref_g_per, v_chunks)
+    np.testing.assert_allclose(float(loss), float(ref_loss),
+                               rtol=2e-5, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(grads),
+                    jax.tree.leaves(ref_stacked)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+    for a, b in zip(jax.tree.leaves(ghead),
+                    jax.tree.leaves(ref_ghead)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
+    # dx parity: grad w.r.t. the pipeline input
+    def in_loss(xm):
+        total = 0.0
+        for m in range(M):
+            y = xm[m]
+            for p in per:
+                y = stage_fn(p, y)
+            total = total + head_loss(head_p, y, labels[m])
+        return total / M
+    ref_dx = jax.grad(in_loss)(x)
+    np.testing.assert_allclose(np.asarray(dx), np.asarray(ref_dx),
+                               rtol=2e-4, atol=2e-5)
+    return loss
+
+
+def test_1f1b_table_on_device(problem):
+    run_sched(OneFOneBSchedule(S, M), 1, problem)
+
+
+def test_interleaved_vpp_on_device(problem):
+    sched = InterleavedSchedule(S, M, num_chunks=V)
+    assert sched.validate()
+    run_sched(sched, V, problem)
+
+
+def test_zero_bubble_on_device(problem):
+    sched = ZeroBubbleSchedule(S, M)
+    assert sched.validate()
+    run_sched(sched, 1, problem)
+
+
+def test_ring_sizes_bounded():
+    """The engine's memory property: ring depths stay at the schedule's
+    live window (<= S for 1F1B resid), not O(M)."""
+    r1 = schedule_ring_sizes(OneFOneBSchedule(S, 16))
+    assert r1["resid"] <= S
+    assert r1["wqueue"] == 1  # no split backward
+    rz = schedule_ring_sizes(ZeroBubbleSchedule(S, 16))
+    # ZB-H1 trades memory for the bubble: stage inputs stay live until
+    # their deferred B_WEIGHT, which this greedy variant can push to
+    # the cooldown tail — bounded by M, not S
+    assert rz["resid"] <= 16
+    assert rz["wqueue"] >= 2     # W jobs actually deferred
+    rv = schedule_ring_sizes(InterleavedSchedule(S, 16, V))
+    assert rv["resid"] <= 16     # < M per chunk
+    # ZB fills the cooldown bubble with W jobs: strictly fewer idles
+    b_1f1b = OneFOneBSchedule(S, 16).bubble_fraction()
+    b_zb = ZeroBubbleSchedule(S, 16).bubble_fraction()
+    assert b_zb < b_1f1b
+    # VPP's win is the FILL bubble: each tick is 1/V of a stage, so
+    # rank S-1 starts useful work after (S-1) chunk-ticks = (S-1)/V
+    # stage units vs 1F1B's (S-1) full-stage wait
+    def fill_ticks(sched):
+        row = sched.timeline()[S - 1]
+        return next(i for i, j in enumerate(row) if j.kind != "IDLE")
+    assert fill_ticks(InterleavedSchedule(S, 16, V)) == \
+        fill_ticks(OneFOneBSchedule(S, 16))  # same tick count...
+    # ...but VPP ticks carry 1/V the layers: time-units fill = half
+
+
+def test_zb_vs_1f1b_same_loss(problem):
+    l_a = run_sched(OneFOneBSchedule(S, M), 1, problem)
+    l_b = run_sched(ZeroBubbleSchedule(S, M), 1, problem)
+    np.testing.assert_allclose(float(l_a), float(l_b), rtol=1e-6)
+
+
+# -- GPTSpmdTrainer integration (hybrid mesh: pp x fsdp x tp) ----------
+
+def _mk_trainer(sched, seed=0):
+    from paddle_tpu.models.gpt import GPTConfig, GPTSpmdTrainer, \
+        build_mesh
+    if len(jax.devices()) < 8:
+        pytest.skip("needs 8 devices")
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=4,
+                    num_heads=4, max_seq_len=64, dtype=jnp.float32)
+    mesh = build_mesh(n_devices=8, pipe=2, data=1, fsdp=2, sep=1,
+                      model=2)
+    # grad_clip off: uniform grad-scale bugs must not be normalized away
+    return GPTSpmdTrainer(cfg, mesh, microbatches=4, seed=seed,
+                          mixed_precision=False, grad_clip=1e9,
+                          pipeline_schedule=sched)
+
+
+def _vpp_remap(gpipe_blocks, V_, S_, Lc):
+    """gpipe [S, L, ...] layer r*L+i -> vpp [V, S, Lc, ...] where
+    chunk c of rank r holds layers (c*S+r)*Lc + j."""
+    def remap(leaf):
+        a = np.asarray(leaf)
+        L_ = a.shape[1]
+        flat = a.reshape((S_ * L_,) + a.shape[2:])
+        idx = np.array([(c * S_ + r) * Lc + j
+                        for c in range(V_) for r in range(S_)
+                        for j in range(Lc)])
+        return jnp.asarray(flat[idx].reshape(
+            (V_, S_, Lc) + a.shape[2:]))
+    return jax.tree.map(remap, gpipe_blocks)
+
+
+def test_trainer_vpp_matches_gpipe():
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 128, (8, 64)).astype(np.int32)
+    lab = rng.randint(0, 128, (8, 64)).astype(np.int32)
+    tr_g = _mk_trainer("gpipe")
+    tr_v = _mk_trainer("vpp")
+    tr_v.params["blocks"] = _vpp_remap(tr_g.params["blocks"], 2, 2, 1)
+    tr_v.opt_state["m"] = jax.tree.map(jnp.zeros_like, tr_v.params)
+    tr_v.opt_state["v"] = jax.tree.map(jnp.zeros_like, tr_v.params)
+    lg0 = float(jax.device_get(tr_g.train_step(ids, lab)))
+    lv0 = float(jax.device_get(tr_v.train_step(ids, lab)))
+    assert abs(lg0 - lv0) < 1e-4
+    lg1 = float(jax.device_get(tr_g.train_step(ids, lab)))
+    lv1 = float(jax.device_get(tr_v.train_step(ids, lab)))
+    assert abs(lg1 - lv1) < 5e-3  # after one identical AdamW update
+
+
+def test_trainer_zb_matches_gpipe():
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 128, (8, 64)).astype(np.int32)
+    lab = rng.randint(0, 128, (8, 64)).astype(np.int32)
+    losses = {}
+    for sched in ("gpipe", "zb"):
+        tr = _mk_trainer(sched)
+        l0 = float(jax.device_get(tr.train_step(ids, lab)))
+        l1 = float(jax.device_get(tr.train_step(ids, lab)))
+        losses[sched] = (l0, l1)
+    assert abs(losses["gpipe"][0] - losses["zb"][0]) < 1e-4
+    assert abs(losses["gpipe"][1] - losses["zb"][1]) < 5e-3
