@@ -6,9 +6,11 @@ compute, bf16 master weights updated with exact stochastic rounding,
 bf16 Adam moments, Pallas flash attention (grid-pipelined Mosaic
 kernels), int8-MXU forward matmuls with exact bf16 backward
 (ops/quant_matmul.py; 40-step loss parity vs bf16 within 3e-4 —
-benchmarks/RESULTS.md), "save_main" remat policy (saves matmul outputs
-+ flash residuals; backward recomputes only layernorm/elementwise and
-the small attention-proj matmul), vocab-chunked fused cross-entropy.
+benchmarks/RESULTS.md), a single-pass Pallas AdamW update with
+in-kernel stochastic-rounding PRNG (ops/fused_adamw.py), "save_qkv_ffn"
+remat policy (saves only the qkv/ffn1 projections; backward re-runs the
+flash forward kernel and the elementwise tail), vocab-chunked fused
+cross-entropy.
 
 Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
 vs_baseline is reported as achieved model-FLOPs-utilization (MFU) against
@@ -51,10 +53,11 @@ def main():
     mesh = build_mesh(n_devices=1, pipe=1, model=1, fsdp=1, sep=1)
     trainer = GPTSpmdTrainer(
         cfg, mesh, microbatches=1,
-        remat="save_main" if on_tpu else False,
+        remat="save_qkv_ffn" if on_tpu else False,
         moment_dtype=moment_dtype,
         master_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
-        quant8="dgrad" if on_tpu else False)
+        quant8="dgrad" if on_tpu else False,
+        ce_chunks=4 if on_tpu else 16)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
     labels = np.roll(ids, -1, axis=1)

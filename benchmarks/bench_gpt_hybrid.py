@@ -22,10 +22,11 @@ def main():
                         num_heads=16, max_seq_len=1024, dtype=jnp.bfloat16)
         mesh = build_mesh(n_devices=1, pipe=1, model=1, fsdp=1, sep=1)
         trainer = GPTSpmdTrainer(cfg, mesh, microbatches=1,
-                                 remat="save_main",
+                                 remat="save_qkv_ffn",
                                  moment_dtype=jnp.bfloat16,
                                  master_dtype=jnp.bfloat16,
-                                 quant8="dgrad")
+                                 quant8="dgrad",
+                                 ce_chunks=4)
         B, T, steps = 6, 1024, 10
     else:
         cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=4,

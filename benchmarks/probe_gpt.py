@@ -25,6 +25,7 @@ def main():
     ap.add_argument("--masters", default="fp32", choices=["fp32", "bf16"])
     ap.add_argument("--quant8", default="", choices=["", "fwd", "dgrad"])
     ap.add_argument("--unroll", type=int, default=1)
+    ap.add_argument("--ce-chunks", type=int, default=16)
     ap.add_argument("--no-fused-opt", action="store_true")
     ap.add_argument("--compile-only", action="store_true")
     args = ap.parse_args()
@@ -47,6 +48,7 @@ def main():
         else jnp.float32,
         quant8={"": False, "fwd": True, "dgrad": "dgrad"}[args.quant8],
         layer_unroll=args.unroll,
+        ce_chunks=args.ce_chunks,
         fused_optimizer=False if args.no_fused_opt else None)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size,

@@ -1,12 +1,16 @@
 """paddle.hub parity: list/help/load entrypoints from a hubconf.py in a
-local directory or github-style repo dir (reference: python/paddle/hub.py).
-Network fetch is gated off (zero-egress environments); local sources work
-fully."""
+local directory or a github/gitee repo (reference: python/paddle/hub.py
+_get_cache_or_reload). Remote repos resolve to an archive URL fetched
+through the same download cache the vision zoo uses
+(utils/download.py) — ``file://`` archive URLs are first-class, so
+air-gapped clusters mirror hub repos on shared storage."""
 from __future__ import annotations
 
 import importlib.util
 import os
+import shutil
 import sys
+import zipfile
 
 __all__ = ["list", "help", "load"]
 
@@ -27,27 +31,70 @@ def _load_hubconf(repo_dir):
     return mod
 
 
-def _resolve(repo_dir, source):
+def _archive_url(repo, source):
+    """'owner/repo[:branch]' -> the host's source-archive zip URL; a
+    full URL (any scheme, incl. file://) passes through untouched."""
+    if "://" in repo:
+        return repo
+    name, _, branch = repo.partition(":")
+    branch = branch or "main"
+    if source == "github":
+        return f"https://github.com/{name}/archive/{branch}.zip"
+    # gitee serves source archives under /repository/archive/
+    return f"https://gitee.com/{name}/repository/archive/{branch}.zip"
+
+
+def _resolve(repo_dir, source, force_reload=False):
     if source not in ("local", "github", "gitee"):
         raise ValueError(
             f"unknown source {source!r}: expected local/github/gitee")
-    if source != "local":
-        raise RuntimeError(
-            "remote hub sources are unavailable in this build (no network "
-            "egress); clone the repo and use source='local'")
-    return repo_dir
+    if source == "local":
+        return repo_dir
+    from .utils.download import get_path_from_url, WEIGHTS_HOME
+    root = os.path.join(os.path.dirname(WEIGHTS_HOME), "hub")
+    # force_reload bypasses the archive cache too — a moved branch tag
+    # must re-fetch, not re-extract the stale zip
+    archive = get_path_from_url(_archive_url(repo_dir, source), root,
+                                check_exist=not force_reload)
+    edir = archive + ".extracted"
+    if force_reload and os.path.isdir(edir):
+        shutil.rmtree(edir, ignore_errors=True)
+    if not os.path.isdir(edir):
+        # per-process tmp + tolerate a concurrent winner: hub caches
+        # live on shared storage (air-gapped mirrors), so two jobs may
+        # extract the same archive at once
+        tmp = f"{edir}.tmp.{os.getpid()}"
+        shutil.rmtree(tmp, ignore_errors=True)
+        with zipfile.ZipFile(archive) as z:
+            z.extractall(tmp)
+        try:
+            os.replace(tmp, edir)
+        except OSError:
+            if not os.path.isdir(edir):
+                raise
+            shutil.rmtree(tmp, ignore_errors=True)
+    if os.path.exists(os.path.join(edir, MODULE_HUBCONF)):
+        return edir
+    # github/gitee archives nest everything under repo-branch/
+    for sub in sorted(os.listdir(edir)):
+        cand = os.path.join(edir, sub)
+        if os.path.isdir(cand) and \
+                os.path.exists(os.path.join(cand, MODULE_HUBCONF)):
+            return cand
+    raise FileNotFoundError(
+        f"no {MODULE_HUBCONF} in archive from {repo_dir!r}")
 
 
 def list(repo_dir, source="github", force_reload=False):  # noqa: A001
     """List callable entrypoints exposed by the repo's hubconf."""
-    mod = _load_hubconf(_resolve(repo_dir, source))
+    mod = _load_hubconf(_resolve(repo_dir, source, force_reload))
     return [k for k, v in vars(mod).items()
             if callable(v) and not k.startswith("_")]
 
 
 def help(repo_dir, model, source="github", force_reload=False):  # noqa: A001
     """Return the docstring of one entrypoint."""
-    mod = _load_hubconf(_resolve(repo_dir, source))
+    mod = _load_hubconf(_resolve(repo_dir, source, force_reload))
     entry = getattr(mod, model, None)
     if entry is None or not callable(entry):
         raise RuntimeError(f"no callable entrypoint {model!r} in hubconf")
@@ -55,8 +102,10 @@ def help(repo_dir, model, source="github", force_reload=False):  # noqa: A001
 
 
 def load(repo_dir, model, source="github", force_reload=False, **kwargs):
-    """Instantiate an entrypoint: hub.load(dir, 'resnet50', source='local')."""
-    mod = _load_hubconf(_resolve(repo_dir, source))
+    """Instantiate an entrypoint: hub.load(dir, 'resnet50',
+    source='local'), or hub.load('owner/repo:branch', 'resnet50') with
+    the archive fetched through the weights download cache."""
+    mod = _load_hubconf(_resolve(repo_dir, source, force_reload))
     entry = getattr(mod, model, None)
     if entry is None or not callable(entry):
         raise RuntimeError(f"no callable entrypoint {model!r} in hubconf")

@@ -228,7 +228,8 @@ class GPTSpmdTrainer:
                  moe_capacity_factor: float = 1.25,
                  moe_aux_weight: float = 1e-2,
                  fused_optimizer: Optional[bool] = None,
-                 layer_unroll: int = 1):
+                 layer_unroll: int = 1,
+                 ce_chunks: int = 16):
         self.cfg = cfg
         self.mesh = mesh
         self.remat = remat  # per-block activation checkpointing
@@ -305,6 +306,9 @@ class GPTSpmdTrainer:
         # write each layer's residuals straight from the producing
         # fusion. Costs compile time roughly linearly in the factor.
         self.layer_unroll = int(layer_unroll)
+        # vocab-chunk count for the fused CE: fewer chunks = bigger
+        # (faster) head matmuls but a larger live logits buffer
+        self.ce_chunks = int(ce_chunks)
         if self.moe_experts and mesh.shape["pipe"] > 1 \
                 and self.pipeline_schedule == "gpipe":
             raise NotImplementedError(
@@ -619,10 +623,18 @@ class GPTSpmdTrainer:
             # like save_dots but drops the attention-proj output buffer
             # (cheapest matmul, 2/24 of block FLOPs to recompute) —
             # ~0.6G less HBM at bs6/1.3B, which is what lets this fit
-            # alongside bf16 masters on a 16G chip
+            # alongside bf16 masters on a 16G chip. ffn2_out is NOT
+            # saved: the residual-add backward is identity in it, so
+            # saving it only costs a stacked buffer + copy traffic
             pol = jax.checkpoint_policies.save_only_these_names(
-                "qkv_out", "ffn1_out", "ffn2_out",
-                "flash_out", "flash_lse")
+                "qkv_out", "ffn1_out", "flash_out", "flash_lse")
+        elif self.remat == "save_qkv_ffn":
+            # drops the flash out/lse residuals too: backward re-runs
+            # the flash FORWARD kernel from the saved qkv projection
+            # (~13 ms/step at 1.3B) in exchange for ~1.2 GB of stacked
+            # residual HBM — the trade that buys layer_unroll room
+            pol = jax.checkpoint_policies.save_only_these_names(
+                "qkv_out", "ffn1_out")
         else:
             return jax.checkpoint(block_fn)
         return jax.checkpoint(block_fn, policy=pol)
@@ -698,10 +710,11 @@ class GPTSpmdTrainer:
         # fused vocab-chunked CE when no axis shards the vocab/seq dims:
         # never materializes [B,T,V] logits (ops/fused_ce.py)
         if (shape["model"] == 1 and shape["sep"] == 1
-                and cfg.vocab_size % 16 == 0):
+                and cfg.vocab_size % self.ce_chunks == 0):
             from ..ops.fused_ce import fused_softmax_cross_entropy
             loss = fused_softmax_cross_entropy(x, head.astype(dtype),
-                                               labels, n_chunks=16)
+                                               labels,
+                                               n_chunks=self.ce_chunks)
         else:
             logits = jnp.einsum("btd,dv->btv", x, head.astype(dtype),
                                 preferred_element_type=jnp.float32)

@@ -1,4 +1,6 @@
 """Vision models (reference: python/paddle/vision/models/)."""
+from ._registry import (model_urls, register_model_url,  # noqa: F401
+                        load_pretrained)
 from .resnet import *  # noqa: F401,F403
 from .small import *  # noqa: F401,F403
 from .mobilenetv3 import *  # noqa: F401,F403

@@ -1,0 +1,57 @@
+"""Shared pretrained-weight registry for the vision model zoo.
+
+Reference behavior: every family module ships a ``model_urls`` dict of
+(url, md5) pairs consumed through the download cache
+(python/paddle/vision/models/vgg.py, mobilenetv3.py, densenet.py, ...
+via paddle/utils/download.py get_weights_path_from_url). Here one
+registry serves the whole zoo; deployments register their own mirrors
+(``file://`` paths work for air-gapped clusters) with
+``register_model_url``.
+"""
+from __future__ import annotations
+
+__all__ = ["model_urls", "register_model_url", "load_pretrained"]
+
+# arch -> (url, md5). Entries default to (None, None): this framework
+# does not ship Paddle's binary weights (different parameter layout);
+# users or org mirrors register equivalents. Every constructor in the
+# zoo honors pretrained=True through this table.
+model_urls = {arch: (None, None) for arch in [
+    "resnet18", "resnet34", "resnet50", "resnet101", "resnet152",
+    "wide_resnet50_2", "wide_resnet101_2",
+    "resnext50_32x4d", "resnext101_32x4d", "resnext101_64x4d",
+    "resnext152_32x4d",
+    "vgg11", "vgg13", "vgg16", "vgg19",
+    "vgg11_bn", "vgg13_bn", "vgg16_bn", "vgg19_bn",
+    "alexnet", "lenet",
+    "mobilenet_v1", "mobilenet_v2",
+    "mobilenet_v3_small", "mobilenet_v3_large",
+    "densenet121", "densenet161", "densenet169", "densenet201",
+    "densenet264",
+    "googlenet", "inception_v3",
+    "shufflenet_v2_x0_25", "shufflenet_v2_x0_33", "shufflenet_v2_x0_5",
+    "shufflenet_v2_x1_0", "shufflenet_v2_x1_5", "shufflenet_v2_x2_0",
+    "shufflenet_v2_swish",
+    "squeezenet1_0", "squeezenet1_1",
+]}
+
+
+def register_model_url(arch: str, url: str, md5: str = None):
+    """Point ``arch`` at a weights file; http(s):// and file:// both
+    go through the download cache."""
+    model_urls[arch] = (url, md5)
+
+
+def load_pretrained(model, arch: str):
+    url, md5 = model_urls.get(arch) or (None, None)
+    if not url:
+        raise ValueError(
+            f"no pretrained weights registered for {arch!r}; point "
+            f"model_urls[{arch!r}] at a weights file "
+            f"(register_model_url supports file:// for air-gapped "
+            f"clusters) or load a state dict via set_state_dict")
+    from ...utils.download import get_weights_path_from_url
+    from ...framework.io import load
+    path = get_weights_path_from_url(url, md5)
+    model.set_state_dict(load(path))
+    return model

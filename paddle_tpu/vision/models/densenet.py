@@ -2,6 +2,8 @@
 densenet121/161/169/201/264)."""
 from __future__ import annotations
 
+from ._registry import load_pretrained as _load_pretrained
+
 from ... import ops
 from ...nn import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D,
                    Dropout, Layer, LayerList, Linear, MaxPool2D, ReLU,
@@ -108,40 +110,35 @@ def _densenet(layers, pretrained=False, **kwargs):
 
 
 def densenet121(pretrained=False, **kwargs):
+    model = _densenet(121, pretrained, **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights unavailable (no network access); load a "
-            "state dict via set_state_dict")
-    return _densenet(121, pretrained, **kwargs)
+        _load_pretrained(model, "densenet121")
+    return model
 
 
 def densenet161(pretrained=False, **kwargs):
+    model = _densenet(161, pretrained, **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights unavailable (no network access); load a "
-            "state dict via set_state_dict")
-    return _densenet(161, pretrained, **kwargs)
+        _load_pretrained(model, "densenet161")
+    return model
 
 
 def densenet169(pretrained=False, **kwargs):
+    model = _densenet(169, pretrained, **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights unavailable (no network access); load a "
-            "state dict via set_state_dict")
-    return _densenet(169, pretrained, **kwargs)
+        _load_pretrained(model, "densenet169")
+    return model
 
 
 def densenet201(pretrained=False, **kwargs):
+    model = _densenet(201, pretrained, **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights unavailable (no network access); load a "
-            "state dict via set_state_dict")
-    return _densenet(201, pretrained, **kwargs)
+        _load_pretrained(model, "densenet201")
+    return model
 
 
 def densenet264(pretrained=False, **kwargs):
+    model = _densenet(264, pretrained, **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights unavailable (no network access); load a "
-            "state dict via set_state_dict")
-    return _densenet(264, pretrained, **kwargs)
+        _load_pretrained(model, "densenet264")
+    return model

@@ -2,6 +2,8 @@
 python/paddle/vision/models/googlenet.py, inceptionv3.py)."""
 from __future__ import annotations
 
+from ._registry import load_pretrained as _load_pretrained
+
 from ... import ops
 from ...nn import (AdaptiveAvgPool2D, AvgPool2D, BatchNorm2D, Conv2D,
                    Dropout, Layer, Linear, MaxPool2D, ReLU, Sequential)
@@ -219,16 +221,14 @@ class InceptionV3(Layer):
 
 
 def googlenet(pretrained=False, **kwargs):
+    model = GoogLeNet(**kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights unavailable (no network access); load a "
-            "state dict via set_state_dict")
-    return GoogLeNet(**kwargs)
+        _load_pretrained(model, "googlenet")
+    return model
 
 
 def inception_v3(pretrained=False, **kwargs):
+    model = InceptionV3(**kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights unavailable (no network access); load a "
-            "state dict via set_state_dict")
-    return InceptionV3(**kwargs)
+        _load_pretrained(model, "inception_v3")
+    return model

@@ -2,6 +2,8 @@
 mobilenetv2.py — depthwise-separable convs / inverted residuals)."""
 from __future__ import annotations
 
+from ._registry import load_pretrained as _load_pretrained
+
 from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Dropout, Layer,
                    Linear, ReLU, ReLU6, Sequential)
 from .mobilenetv3 import _make_divisible
@@ -117,12 +119,14 @@ class MobileNetV2(Layer):
 
 
 def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
+    model = MobileNetV1(scale=scale, **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights are not bundled")
-    return MobileNetV1(scale=scale, **kwargs)
+        _load_pretrained(model, "mobilenet_v1")
+    return model
 
 
 def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
+    model = MobileNetV2(scale=scale, **kwargs)
     if pretrained:
-        raise NotImplementedError("pretrained weights are not bundled")
-    return MobileNetV2(scale=scale, **kwargs)
+        _load_pretrained(model, "mobilenet_v2")
+    return model

@@ -2,6 +2,8 @@
 MobileNetV3Small/Large, SE blocks, hardswish activations)."""
 from __future__ import annotations
 
+from ._registry import load_pretrained as _load_pretrained
+
 from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Dropout,
                    Hardsigmoid, Hardswish, Layer, Linear, ReLU, Sequential)
 
@@ -129,16 +131,14 @@ class MobileNetV3Small(_MobileNetV3):
 
 
 def mobilenet_v3_small(pretrained=False, scale=1.0, **kwargs):
+    model = MobileNetV3Small(scale=scale, **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights unavailable (no network access); load a "
-            "state dict via set_state_dict")
-    return MobileNetV3Small(scale=scale, **kwargs)
+        _load_pretrained(model, "mobilenet_v3_small")
+    return model
 
 
 def mobilenet_v3_large(pretrained=False, scale=1.0, **kwargs):
+    model = MobileNetV3Large(scale=scale, **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights unavailable (no network access); load a "
-            "state dict via set_state_dict")
-    return MobileNetV3Large(scale=scale, **kwargs)
+        _load_pretrained(model, "mobilenet_v3_large")
+    return model

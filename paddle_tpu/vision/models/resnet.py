@@ -123,34 +123,10 @@ class ResNet(Layer):
 
 
 # pretrained-weight registry (reference resnet.py:56 model_urls):
-# override/extend via register_model_url — air-gapped deployments point
-# these at file:// paths on shared storage
-model_urls = {
-    "resnet18": (None, None),
-    "resnet34": (None, None),
-    "resnet50": (None, None),
-    "resnet101": (None, None),
-    "resnet152": (None, None),
-}
-
-
-def register_model_url(arch: str, url: str, md5: str = None):
-    model_urls[arch] = (url, md5)
-
-
-def _load_pretrained(model, arch):
-    url, md5 = model_urls.get(arch) or (None, None)
-    if not url:
-        raise ValueError(
-            f"no pretrained weights registered for {arch!r}; point "
-            f"model_urls[{arch!r}] at a weights file "
-            f"(register_model_url supports file:// for air-gapped "
-            f"clusters) or load a state dict via set_state_dict")
-    from ...utils.download import get_weights_path_from_url
-    from ...framework.io import load
-    path = get_weights_path_from_url(url, md5)
-    model.set_state_dict(load(path))
-    return model
+# shared across the whole zoo — see _registry.py; re-exported here for
+# back-compat with round-2 callers
+from ._registry import (model_urls, register_model_url,  # noqa: F401
+                        load_pretrained as _load_pretrained)
 
 
 def _resnet(block, depth, pretrained=False, arch=None, **kwargs):

@@ -3,6 +3,8 @@ x0_25..x2_0 + swish variant; channel-shuffle via reshape/transpose, which XLA
 lowers to a pure layout change)."""
 from __future__ import annotations
 
+from ._registry import load_pretrained as _load_pretrained
+
 from ... import ops
 from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Layer, Linear,
                    MaxPool2D, ReLU, Sequential, Swish)
@@ -102,56 +104,49 @@ class ShuffleNetV2(Layer):
 
 
 def shufflenet_v2_x0_25(pretrained=False, **kwargs):
+    model = ShuffleNetV2(scale=0.25, **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights unavailable (no network access); load a "
-            "state dict via set_state_dict")
-    return ShuffleNetV2(scale=0.25, **kwargs)
+        _load_pretrained(model, "shufflenet_v2_x0_25")
+    return model
 
 
 def shufflenet_v2_x0_33(pretrained=False, **kwargs):
+    model = ShuffleNetV2(scale=0.33, **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights unavailable (no network access); load a "
-            "state dict via set_state_dict")
-    return ShuffleNetV2(scale=0.33, **kwargs)
+        _load_pretrained(model, "shufflenet_v2_x0_33")
+    return model
 
 
 def shufflenet_v2_x0_5(pretrained=False, **kwargs):
+    model = ShuffleNetV2(scale=0.5, **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights unavailable (no network access); load a "
-            "state dict via set_state_dict")
-    return ShuffleNetV2(scale=0.5, **kwargs)
+        _load_pretrained(model, "shufflenet_v2_x0_5")
+    return model
 
 
 def shufflenet_v2_x1_0(pretrained=False, **kwargs):
+    model = ShuffleNetV2(scale=1.0, **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights unavailable (no network access); load a "
-            "state dict via set_state_dict")
-    return ShuffleNetV2(scale=1.0, **kwargs)
+        _load_pretrained(model, "shufflenet_v2_x1_0")
+    return model
 
 
 def shufflenet_v2_x1_5(pretrained=False, **kwargs):
+    model = ShuffleNetV2(scale=1.5, **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights unavailable (no network access); load a "
-            "state dict via set_state_dict")
-    return ShuffleNetV2(scale=1.5, **kwargs)
+        _load_pretrained(model, "shufflenet_v2_x1_5")
+    return model
 
 
 def shufflenet_v2_x2_0(pretrained=False, **kwargs):
+    model = ShuffleNetV2(scale=2.0, **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights unavailable (no network access); load a "
-            "state dict via set_state_dict")
-    return ShuffleNetV2(scale=2.0, **kwargs)
+        _load_pretrained(model, "shufflenet_v2_x2_0")
+    return model
 
 
 def shufflenet_v2_swish(pretrained=False, **kwargs):
+    model = ShuffleNetV2(scale=1.0, act="swish", **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights unavailable (no network access); load a "
-            "state dict via set_state_dict")
-    return ShuffleNetV2(scale=1.0, act="swish", **kwargs)
+        _load_pretrained(model, "shufflenet_v2_swish")
+    return model

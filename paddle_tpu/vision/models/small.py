@@ -1,13 +1,15 @@
-"""LeNet / AlexNet / VGG / MobileNet (reference:
-python/paddle/vision/models/{lenet,alexnet,vgg,mobilenetv1,mobilenetv2}.py)."""
+"""LeNet / AlexNet / VGG (reference:
+python/paddle/vision/models/{lenet,alexnet,vgg}.py; the mobilenet
+families live in mobilenet.py / mobilenetv3.py)."""
 from __future__ import annotations
+
+from ._registry import load_pretrained as _load_pretrained
 
 from ...nn import (AdaptiveAvgPool2D, BatchNorm2D, Conv2D, Dropout, Flatten,
                    Layer, Linear, MaxPool2D, ReLU, ReLU6, Sequential)
 
-__all__ = ["LeNet", "AlexNet", "alexnet", "VGG", "vgg11", "vgg13", "vgg16",
-           "vgg19", "MobileNetV1", "mobilenet_v1", "MobileNetV2",
-           "mobilenet_v2"]
+__all__ = ["LeNet", "AlexNet", "alexnet", "VGG", "vgg11", "vgg13",
+           "vgg16", "vgg19"]
 
 
 class LeNet(Layer):
@@ -49,7 +51,10 @@ class AlexNet(Layer):
 
 
 def alexnet(pretrained=False, **kwargs):
-    return AlexNet(**kwargs)
+    model = AlexNet(**kwargs)
+    if pretrained:
+        _load_pretrained(model, "alexnet")
+    return model
 
 
 _VGG_CFG = {
@@ -99,7 +104,11 @@ def _make_vgg_layers(cfg, batch_norm=False):
 
 
 def _vgg(depth, batch_norm=False, pretrained=False, **kwargs):
-    return VGG(_make_vgg_layers(_VGG_CFG[depth], batch_norm), **kwargs)
+    model = VGG(_make_vgg_layers(_VGG_CFG[depth], batch_norm), **kwargs)
+    if pretrained:
+        _load_pretrained(model, f"vgg{depth}_bn" if batch_norm
+                         else f"vgg{depth}")
+    return model
 
 
 def vgg11(pretrained=False, batch_norm=False, **kwargs):
@@ -116,114 +125,3 @@ def vgg16(pretrained=False, batch_norm=False, **kwargs):
 
 def vgg19(pretrained=False, batch_norm=False, **kwargs):
     return _vgg(19, batch_norm, pretrained, **kwargs)
-
-
-def _conv_bn(inp, oup, stride):
-    return Sequential(Conv2D(inp, oup, 3, stride, 1, bias_attr=False),
-                      BatchNorm2D(oup), ReLU())
-
-
-def _conv_dw(inp, oup, stride):
-    return Sequential(
-        Conv2D(inp, inp, 3, stride, 1, groups=inp, bias_attr=False),
-        BatchNorm2D(inp), ReLU(),
-        Conv2D(inp, oup, 1, 1, 0, bias_attr=False),
-        BatchNorm2D(oup), ReLU())
-
-
-class MobileNetV1(Layer):
-    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
-        super().__init__()
-        s = lambda c: max(int(c * scale), 8)
-        self.features = Sequential(
-            _conv_bn(3, s(32), 2),
-            _conv_dw(s(32), s(64), 1),
-            _conv_dw(s(64), s(128), 2),
-            _conv_dw(s(128), s(128), 1),
-            _conv_dw(s(128), s(256), 2),
-            _conv_dw(s(256), s(256), 1),
-            _conv_dw(s(256), s(512), 2),
-            *[_conv_dw(s(512), s(512), 1) for _ in range(5)],
-            _conv_dw(s(512), s(1024), 2),
-            _conv_dw(s(1024), s(1024), 1))
-        self.with_pool = with_pool
-        if with_pool:
-            self.pool = AdaptiveAvgPool2D(1)
-        self.num_classes = num_classes
-        if num_classes > 0:
-            self.fc = Linear(s(1024), num_classes)
-            self._out_c = s(1024)
-
-    def forward(self, x):
-        x = self.features(x)
-        if self.with_pool:
-            x = self.pool(x)
-        if self.num_classes > 0:
-            x = x.flatten(1)
-            x = self.fc(x)
-        return x
-
-
-def mobilenet_v1(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV1(scale=scale, **kwargs)
-
-
-class _InvertedResidual(Layer):
-    def __init__(self, inp, oup, stride, expand_ratio):
-        super().__init__()
-        hidden = int(round(inp * expand_ratio))
-        self.use_res = stride == 1 and inp == oup
-        layers = []
-        if expand_ratio != 1:
-            layers += [Conv2D(inp, hidden, 1, bias_attr=False),
-                       BatchNorm2D(hidden), ReLU6()]
-        layers += [
-            Conv2D(hidden, hidden, 3, stride, 1, groups=hidden,
-                   bias_attr=False),
-            BatchNorm2D(hidden), ReLU6(),
-            Conv2D(hidden, oup, 1, bias_attr=False), BatchNorm2D(oup)]
-        self.conv = Sequential(*layers)
-
-    def forward(self, x):
-        out = self.conv(x)
-        return x + out if self.use_res else out
-
-
-class MobileNetV2(Layer):
-    def __init__(self, scale=1.0, num_classes=1000, with_pool=True):
-        super().__init__()
-        cfg = [(1, 16, 1, 1), (6, 24, 2, 2), (6, 32, 3, 2), (6, 64, 4, 2),
-               (6, 96, 3, 1), (6, 160, 3, 2), (6, 320, 1, 1)]
-        in_c = int(32 * scale)
-        features = [Conv2D(3, in_c, 3, 2, 1, bias_attr=False),
-                    BatchNorm2D(in_c), ReLU6()]
-        for t, c, n, s in cfg:
-            out_c = int(c * scale)
-            for i in range(n):
-                features.append(_InvertedResidual(
-                    in_c, out_c, s if i == 0 else 1, t))
-                in_c = out_c
-        last = max(int(1280 * scale), 1280)
-        features += [Conv2D(in_c, last, 1, bias_attr=False),
-                     BatchNorm2D(last), ReLU6()]
-        self.features = Sequential(*features)
-        self.with_pool = with_pool
-        self.num_classes = num_classes
-        if with_pool:
-            self.pool = AdaptiveAvgPool2D(1)
-        if num_classes > 0:
-            self.classifier = Sequential(Dropout(0.2),
-                                         Linear(last, num_classes))
-
-    def forward(self, x):
-        x = self.features(x)
-        if self.with_pool:
-            x = self.pool(x)
-        if self.num_classes > 0:
-            x = x.flatten(1)
-            x = self.classifier(x)
-        return x
-
-
-def mobilenet_v2(pretrained=False, scale=1.0, **kwargs):
-    return MobileNetV2(scale=scale, **kwargs)

@@ -2,6 +2,8 @@
 squeezenet1_0/1_1 with Fire modules)."""
 from __future__ import annotations
 
+from ._registry import load_pretrained as _load_pretrained
+
 from ... import ops
 from ...nn import (AdaptiveAvgPool2D, Conv2D, Dropout, Layer, MaxPool2D,
                    ReLU, Sequential)
@@ -71,16 +73,14 @@ class SqueezeNet(Layer):
 
 
 def squeezenet1_0(pretrained=False, **kwargs):
+    model = SqueezeNet(version="1.0", **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights unavailable (no network access); load a "
-            "state dict via set_state_dict")
-    return SqueezeNet(version="1.0", **kwargs)
+        _load_pretrained(model, "squeezenet1_0")
+    return model
 
 
 def squeezenet1_1(pretrained=False, **kwargs):
+    model = SqueezeNet(version="1.1", **kwargs)
     if pretrained:
-        raise NotImplementedError(
-            "pretrained weights unavailable (no network access); load a "
-            "state dict via set_state_dict")
-    return SqueezeNet(version="1.1", **kwargs)
+        _load_pretrained(model, "squeezenet1_1")
+    return model

@@ -56,3 +56,70 @@ def test_resnet_pretrained_unregistered_raises():
     from paddle_tpu.vision.models import resnet34
     with pytest.raises(ValueError, match="no pretrained weights"):
         resnet34(pretrained=True)
+
+
+@pytest.mark.parametrize("ctor_name,arch,kwargs", [
+    ("vgg11", "vgg11", {}),
+    ("alexnet", "alexnet", {}),
+    ("mobilenet_v1", "mobilenet_v1", {}),
+    ("mobilenet_v2", "mobilenet_v2", {}),
+    ("mobilenet_v3_small", "mobilenet_v3_small", {}),
+    ("densenet121", "densenet121", {}),
+    ("googlenet", "googlenet", {}),
+    ("shufflenet_v2_x0_25", "shufflenet_v2_x0_25", {}),
+    ("squeezenet1_0", "squeezenet1_0", {}),
+])
+def test_zoo_pretrained_roundtrip(tmp_path, monkeypatch, ctor_name,
+                                  arch, kwargs):
+    """Every family honors pretrained=True through the shared registry
+    (reference ships model_urls across the zoo: vgg.py, mobilenetv3.py,
+    densenet.py, ...)."""
+    import paddle_tpu.vision.models as zoo
+    from paddle_tpu.vision.models._registry import register_model_url
+    import paddle_tpu.utils.download as dl
+
+    monkeypatch.setattr(dl, "WEIGHTS_HOME", str(tmp_path / "wh"))
+    ctor = getattr(zoo, ctor_name)
+    ref = ctor(num_classes=10, **kwargs)
+    wpath = tmp_path / f"{arch}.pdparams"
+    paddle.save(ref.state_dict(), str(wpath))
+    register_model_url(arch, f"file://{wpath}")
+    try:
+        m = ctor(pretrained=True, num_classes=10, **kwargs)
+    finally:
+        register_model_url(arch, None)
+    for a, b in zip(ref.state_dict().values(), m.state_dict().values()):
+        np.testing.assert_array_equal(np.asarray(a.numpy()),
+                                      np.asarray(b.numpy()))
+
+
+def test_zoo_unregistered_raises_not_silent():
+    """pretrained=True without a registered URL must raise, never
+    silently return random weights."""
+    import paddle_tpu.vision.models as zoo
+    for name in ("vgg13", "densenet161", "inception_v3",
+                 "squeezenet1_1", "shufflenet_v2_x1_0",
+                 "mobilenet_v3_large"):
+        with pytest.raises(ValueError, match="no pretrained weights"):
+            getattr(zoo, name)(pretrained=True)
+
+
+def test_hub_remote_archive(tmp_path, monkeypatch):
+    """hub.load from a repo archive URL through the download cache —
+    file:// stands in for the github zip (reference hub.py
+    _get_cache_or_reload)."""
+    import zipfile
+    from paddle_tpu import hub
+    import paddle_tpu.utils.download as dl
+
+    monkeypatch.setattr(dl, "WEIGHTS_HOME", str(tmp_path / "wh"))
+    zpath = tmp_path / "repo-main.zip"
+    with zipfile.ZipFile(zpath, "w") as z:
+        z.writestr("myrepo-main/hubconf.py",
+                   "def answer(scale=1):\n"
+                   "    'the answer'\n"
+                   "    return 42 * scale\n")
+    url = f"file://{zpath}"
+    assert "answer" in hub.list(url, source="github")
+    assert hub.help(url, "answer", source="github") == "the answer"
+    assert hub.load(url, "answer", source="github", scale=2) == 84
