@@ -29,50 +29,62 @@ __all__ = ["MoELayer", "GShardGate", "SwitchGate", "NaiveGate",
            "moe_dispatch_combine"]
 
 
-def _top2_gating(logits, capacity, key=None):
-    """GShard top-2 gating with capacity, returning dispatch+combine
-    tensors and the load-balancing aux loss."""
+def _topk_gating(logits, capacity, topk=2):
+    """GShard top-k (k=1 Switch, k=2 GShard) gating with capacity,
+    returning dispatch+combine tensors and the load-balancing aux
+    loss. This is THE routing core: the GPTSpmdTrainer's MoE blocks
+    (models/gpt.py:_block_moe) and the nn-API MoELayer below both run
+    through it."""
+    if topk not in (1, 2):
+        raise ValueError(f"topk must be 1 or 2, got {topk}")
     T, E = logits.shape
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
     g1_idx = jnp.argmax(probs, axis=-1)
     m1 = jax.nn.one_hot(g1_idx, E, dtype=jnp.float32)
-    probs_wo1 = probs * (1 - m1)
-    g2_idx = jnp.argmax(probs_wo1, axis=-1)
-    m2 = jax.nn.one_hot(g2_idx, E, dtype=jnp.float32)
 
     # positions within each expert (prefix-sum over tokens)
     pos1 = jnp.cumsum(m1, axis=0) * m1 - m1  # 0-based slot of each token
-    pos2 = (jnp.cumsum(m2, axis=0) - m2 +
-            jnp.sum(m1, axis=0, keepdims=True)) * m2
     keep1 = jnp.sum(pos1 * m1, axis=-1) < capacity
-    keep2 = jnp.sum(pos2 * m2, axis=-1) < capacity
     m1 = m1 * keep1[:, None]
-    m2 = m2 * keep2[:, None]
-
     w1 = jnp.sum(probs * m1, axis=-1)
-    w2 = jnp.sum(probs * m2, axis=-1)
-    denom = jnp.maximum(w1 + w2, 1e-9)
-    w1, w2 = w1 / denom, w2 / denom
-
     slot1 = jnp.sum(pos1 * m1, axis=-1).astype(jnp.int32)
-    slot2 = jnp.sum(pos2 * m2, axis=-1).astype(jnp.int32)
     c1 = jax.nn.one_hot(slot1, capacity, dtype=jnp.float32)
-    c2 = jax.nn.one_hot(slot2, capacity, dtype=jnp.float32)
-    combine = (w1[:, None, None] * m1[:, :, None] * c1[:, None, :] +
-               w2[:, None, None] * m2[:, :, None] * c2[:, None, :])
+
+    if topk == 2:
+        probs_wo1 = probs * (1 - m1)
+        g2_idx = jnp.argmax(probs_wo1, axis=-1)
+        m2 = jax.nn.one_hot(g2_idx, E, dtype=jnp.float32)
+        pos2 = (jnp.cumsum(m2, axis=0) - m2 +
+                jnp.sum(m1, axis=0, keepdims=True)) * m2
+        keep2 = jnp.sum(pos2 * m2, axis=-1) < capacity
+        m2 = m2 * keep2[:, None]
+        w2 = jnp.sum(probs * m2, axis=-1)
+        denom = jnp.maximum(w1 + w2, 1e-9)
+        w1n, w2n = w1 / denom, w2 / denom
+        slot2 = jnp.sum(pos2 * m2, axis=-1).astype(jnp.int32)
+        c2 = jax.nn.one_hot(slot2, capacity, dtype=jnp.float32)
+        combine = (w1n[:, None, None] * m1[:, :, None] * c1[:, None, :]
+                   + w2n[:, None, None] * m2[:, :, None]
+                   * c2[:, None, :])
+    else:  # Switch: route everything to the single winner
+        combine = w1[:, None, None] * m1[:, :, None] * c1[:, None, :]
     dispatch = combine > 0.0
 
-    # load-balance aux loss (GShard eq.4)
+    # load-balance aux loss (GShard eq.4 / Switch eq.): fraction of
+    # tokens whose top-1 is e, times the mean router prob of e
     density = jnp.mean(m1, axis=0)
     density_proxy = jnp.mean(probs, axis=0)
     aux = jnp.sum(density * density_proxy) * E
     return dispatch, combine, aux
 
 
-def moe_dispatch_combine(x, gate_logits, capacity):
+_top2_gating = _topk_gating  # back-compat alias
+
+
+def moe_dispatch_combine(x, gate_logits, capacity, topk=2):
     """Return (expert_inputs [E, C, D], combine [T, E, C], aux_loss)."""
-    dispatch, combine, aux = _top2_gating(gate_logits, capacity)
+    dispatch, combine, aux = _topk_gating(gate_logits, capacity, topk)
     expert_inputs = jnp.einsum("tec,td->ecd",
                                dispatch.astype(x.dtype), x)
     return expert_inputs, combine, aux
@@ -127,6 +139,11 @@ class MoELayer(Layer):
                                            default_initializer=init)
         self.b_out = self.create_parameter([num_experts, d_model],
                                            is_bias=True)
+        # set by forward(); ON the autograd tape — add
+        # ``aux_weight * layer.aux_loss`` to the training objective so
+        # balance gradients reach the gate (the trainer does exactly
+        # this through the schedule's aux side channel; at the nn API
+        # the user owns the objective, reference moe_layer.py:263)
         self.aux_loss = None
         self._shard_experts()
 
@@ -152,10 +169,14 @@ class MoELayer(Layer):
         logits = self.gate(xf)
         T = xf.shape[0]
         capacity = max(
-            1, int(self.capacity_factor * T * 2 / self.num_experts))
+            1, int(self.capacity_factor * T
+                   * getattr(self.gate, "topk", 2) / self.num_experts))
+
+        topk = getattr(self.gate, "topk", 2)
 
         def run(x2, lg, wi, bi, wo, bo):
-            expert_in, combine, aux = moe_dispatch_combine(x2, lg, capacity)
+            expert_in, combine, aux = moe_dispatch_combine(
+                x2, lg, capacity, topk=topk)
             h = jnp.einsum("ecd,edh->ech", expert_in, wi.astype(x2.dtype))
             h = jax.nn.gelu(h + bi[:, None, :].astype(x2.dtype),
                             approximate=True)

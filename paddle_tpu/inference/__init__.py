@@ -231,6 +231,7 @@ class Predictor:
         from ..jit.save_load import load_artifacts
         self._exported, params, buffers = load_artifacts(prefix)
 
+        self._int8_scales = None
         if config._precision in (PrecisionType.Half, PrecisionType.Bfloat16):
             # Weight-only bf16: halve HBM for weights; the convert back to
             # the program's traced dtype is fused into the consuming dot by
@@ -240,6 +241,30 @@ class Predictor:
                               if jnp.issubdtype(t.dtype, jnp.floating) else t)
             params = {k: cast(v) for k, v in params.items()}
             buffers = {k: cast(v) for k, v in buffers.items()}
+            self._weight_only = True
+        elif config._precision == PrecisionType.Int8:
+            # Weight-only int8 (reference: TRT int8 / weight-only-quant
+            # passes): params stored as int8 + per-channel scales (4x
+            # less weight HBM traffic); dequant runs INSIDE the jitted
+            # program so XLA fuses it into consumers. For REAL int8
+            # compute (activations too), export a PTQ
+            # convert(real=True) model — its program already carries
+            # int8 dots and needs no Config flag.
+            from ..quantization.int8_layers import _quantize_weight
+            self._int8_scales = {}
+            qparams = {}
+            for k, v in params.items():
+                # matrices and conv filters only: 1-D vectors would
+                # carry a same-sized fp32 scale (negative compression)
+                if jnp.issubdtype(v.dtype, jnp.floating) \
+                        and v.ndim >= 2 and v.size > 256:
+                    axis = 0 if v.ndim >= 3 else (v.ndim - 1)
+                    q, scale = _quantize_weight(v, axis)
+                    qparams[k] = jnp.asarray(q)
+                    self._int8_scales[k] = (jnp.asarray(scale), v.dtype)
+                else:
+                    qparams[k] = v
+            params = qparams
             self._weight_only = True
         else:
             self._weight_only = False
@@ -286,6 +311,12 @@ class Predictor:
 
     # --- execution ----------------------------------------------------------
     def _fn(self, params, buffers, *args):
+        if self._int8_scales:
+            params = {k: (v.astype(jnp.float32)
+                          * self._int8_scales[k][0]).astype(
+                              self._int8_scales[k][1])
+                      if k in self._int8_scales else v
+                      for k, v in params.items()}
         flat, treedef = jax.tree.flatten((params, buffers, *args))
         flat = [x.astype(av.dtype) if x.dtype != av.dtype else x
                 for x, av in zip(flat, self._exported.in_avals)]

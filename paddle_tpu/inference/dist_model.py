@@ -1,0 +1,98 @@
+"""Multi-rank served models: pipeline inference over FleetExecutor
+actors.
+
+Reference: paddle/fluid/distributed/fleet_executor/dist_model.cc —
+DistModel::Init loads one program partition per rank and Run() drives
+feed → fleet-executor pipeline → fetch over brpc. TPU-native version:
+each stage is an exported StableHLO artifact served by a Predictor
+(its own AOT-compiled XLA program); stages are chained by the actor
+Carrier/Interceptor runtime (distributed/fleet_executor.py) with
+credit-based micro-batch flow, so stage k runs micro-batch i while
+stage k+1 runs micro-batch i-1 — host-side pipeline parallelism for
+serving, the inference analog of the training schedules.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+
+from . import Config, Predictor, create_predictor
+from ..distributed.fleet_executor import FleetExecutor
+
+__all__ = ["DistModelConfig", "DistModel"]
+
+
+class DistModelConfig:
+    """dist_model.h DistModelConfig analog: the per-stage model paths
+    plus pipeline knobs."""
+
+    def __init__(self, model_prefixes: Sequence[str],
+                 precision=None, num_micro_batches: int = 2,
+                 buffer_size: int = 2):
+        if not model_prefixes:
+            raise ValueError("need at least one stage model")
+        self.model_prefixes = list(model_prefixes)
+        self.precision = precision
+        self.num_micro_batches = int(num_micro_batches)
+        self.buffer_size = int(buffer_size)
+
+
+class DistModel:
+    """Serve a model split into pipeline stages, each an exported
+    artifact; `run(feed)` pipelines micro-batches through the stages."""
+
+    def __init__(self, config: DistModelConfig):
+        self._config = config
+        self._predictors: List[Predictor] = []
+        self._initialized = False
+
+    def init(self) -> bool:
+        if self._initialized:
+            return True
+        for prefix in self._config.model_prefixes:
+            c = Config(prefix)
+            if self._config.precision is not None:
+                c.set_precision(self._config.precision)
+            self._predictors.append(create_predictor(c))
+        # the actor graph depends only on the stage fns: build once;
+        # run() spins a fresh carrier over it per batch
+        self._executor = FleetExecutor(
+            [self._stage_fn(i) for i in range(len(self._predictors))],
+            num_micro_batches=self._config.num_micro_batches,
+            buffer_size=self._config.buffer_size)
+        self._initialized = True
+        return True
+
+    def _stage_fn(self, idx: int):
+        pred = self._predictors[idx]
+
+        def run(payload):
+            outs = pred.run(list(payload) if isinstance(
+                payload, (list, tuple)) else [payload])
+            outs = [o.copy_to_cpu() for o in outs]
+            return outs if len(outs) > 1 else outs[0]
+
+        return run
+
+    def run(self, feed: Sequence[Any],
+            timeout: float = 300.0) -> List[np.ndarray]:
+        """Run one batch: ``feed`` is split into ``num_micro_batches``
+        along axis 0, pipelined through the stages, and re-concatenated
+        (dist_model.cc Run feed→fetch)."""
+        if not self._initialized:
+            self.init()
+        M = self._config.num_micro_batches
+        feed = [np.asarray(getattr(x, "_data", x)) for x in feed]
+        B = feed[0].shape[0]
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by "
+                             f"{M} micro-batches")
+        micro = [[x[i * (B // M):(i + 1) * (B // M)] for x in feed]
+                 for i in range(M)]
+        outs = self._executor.run(micro, timeout=timeout)
+        first = outs[0]
+        if isinstance(first, (list, tuple)):
+            return [np.concatenate([np.asarray(o[j]) for o in outs])
+                    for j in range(len(first))]
+        return [np.concatenate([np.asarray(o) for o in outs])]

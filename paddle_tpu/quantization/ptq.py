@@ -53,21 +53,36 @@ class PTQ(Quantization):
                 self._walk(child, full)
 
     def convert(self, model: Layer, inplace: bool = False,
-                remove_quanter: bool = True) -> Layer:
-        """Replace observers with fixed-scale qdq layers."""
+                remove_quanter: bool = True, real: bool = False) -> Layer:
+        """Replace observers with deploy-time layers.
+
+        ``real=False`` (reference parity): fixed-scale qdq simulation.
+        ``real=True``: swap observed Linear/Conv2D for REAL int8 layers
+        (quantization/int8_layers.py) executing on the int8 MXU —
+        weights stored int8 per-channel, activations quantized with the
+        calibrated static scale. Layers without an int8 kernel keep the
+        qdq fallback. ``to_static``/``jit.save`` after this exports an
+        int8 program the inference Predictor serves as-is.
+        """
         if not inplace:
             model = copy.deepcopy(model)
-        self._convert_walk(model)
+        self._convert_walk(model, real)
         model.eval()
         return model
 
-    def _convert_walk(self, layer: Layer):
+    def _convert_walk(self, layer: Layer, real: bool = False):
         for name, child in list(layer.named_children()):
             if isinstance(child, ObserveWrapper):
                 obs = child.observer
                 qmax = float(2 ** (obs.bit_length() - 1) - 1)
                 absmax = obs.scales() * qmax
                 source = child.observed
+                if real and obs.bit_length() == 8:
+                    from .int8_layers import realize_int8
+                    int8 = realize_int8(source, absmax)
+                    if int8 is not None:
+                        layer.add_sublayer(name, int8)
+                        continue
                 wf = getattr(child, "_weight_factory", None)
                 if wf is not None and getattr(source, "weight", None) \
                         is not None:
@@ -80,4 +95,4 @@ class PTQ(Quantization):
                     name, _CalibratedLayer(source, absmax,
                                            obs.bit_length()))
             else:
-                self._convert_walk(child)
+                self._convert_walk(child, real)

@@ -96,3 +96,111 @@ def test_convert_to_mixed_precision(saved_model, tmp_path):
     outs = pred.run([x])
     np.testing.assert_allclose(outs[0].copy_to_cpu().astype(np.float32),
                                ref, rtol=5e-2, atol=5e-2)
+
+
+class ConvNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2D(3, 8, 3, stride=1, padding=1)
+        self.fc = nn.Linear(8 * 4 * 4, 5)
+
+    def forward(self, x):
+        h = nn.functional.relu(self.conv(x))
+        return self.fc(h.reshape([x.shape[0], -1]))
+
+
+def test_ptq_real_int8_parity_and_serving(tmp_path):
+    """PTQ observers -> real int8 MXU layers -> export -> Predictor:
+    the deployed program carries int8 dots/convs (reference: TRT int8
+    via analysis_predictor; here quantization/int8_layers.py)."""
+    from paddle_tpu.quantization import PTQ, QuantConfig
+    from paddle_tpu.quantization.observers import AbsmaxObserver
+    from paddle_tpu.quantization.int8_layers import Int8Linear, Int8Conv2D
+
+    net = ConvNet()
+    net.eval()
+    rng = np.random.RandomState(0)
+    calib = [rng.randn(2, 3, 4, 4).astype(np.float32) for _ in range(4)]
+    x = paddle.to_tensor(calib[0])
+    ref = net(x).numpy()
+
+    cfg = QuantConfig(activation=AbsmaxObserver, weight=None)
+    cfg.add_type_config([nn.Conv2D, nn.Linear],
+                        activation=AbsmaxObserver, weight=None)
+    ptq = PTQ(cfg)
+    observed = ptq.quantize(net)
+    for c in calib:
+        observed(paddle.to_tensor(c))
+    q = ptq.convert(observed, real=True)
+    assert isinstance(q.conv, Int8Conv2D)
+    assert isinstance(q.fc, Int8Linear)
+    assert q.conv.wq.numpy().dtype == np.int8
+
+    out = q(x).numpy()
+    # int8 tolerance: ~1% relative of activation scale
+    assert np.max(np.abs(out - ref)) < 0.05 * np.max(np.abs(ref)) + 1e-3
+
+    # export the REAL int8 program and serve it
+    from paddle_tpu import inference
+    path = str(tmp_path / "int8_model")
+    paddle.jit.save(q, path,
+                    input_spec=[InputSpec([2, 3, 4, 4], "float32",
+                                          name="x")])
+    pred = inference.create_predictor(inference.Config(path))
+    outs = pred.run([calib[0]])
+    np.testing.assert_allclose(outs[0].copy_to_cpu(), out,
+                               rtol=2e-2, atol=2e-3)
+
+
+def test_predictor_weight_only_int8(saved_model):
+    """Config.set_precision(Int8): weights stored int8 + scales, dequant
+    inside the program; outputs stay close to full precision."""
+    from paddle_tpu import inference
+    path, x, ref = saved_model
+    cfg = inference.Config(path)
+    cfg.set_precision(inference.PrecisionType.Int8)
+    pred = inference.create_predictor(cfg)
+    for v in pred._params.values():
+        if v.size > 256:
+            assert v.dtype == np.int8
+    outs = pred.run([x])
+    got = outs[0].copy_to_cpu()
+    assert np.max(np.abs(got - ref)) < 0.03 * np.max(np.abs(ref)) + 1e-3
+
+
+def test_dist_model_two_stage_serving(tmp_path):
+    """DistModel: 2-stage pipeline over FleetExecutor actors matches the
+    monolithic model (reference dist_model.cc Init/Run)."""
+    from paddle_tpu.inference.dist_model import DistModel, DistModelConfig
+
+    class Stage1(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(8, 16)
+
+        def forward(self, x):
+            return nn.functional.relu(self.fc(x))
+
+    class Stage2(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc = nn.Linear(16, 4)
+
+        def forward(self, h):
+            return self.fc(h)
+
+    s1, s2 = Stage1(), Stage2()
+    s1.eval(), s2.eval()
+    p1 = str(tmp_path / "stage1")
+    p2 = str(tmp_path / "stage2")
+    paddle.jit.save(s1, p1, input_spec=[InputSpec([2, 8], "float32",
+                                                  name="x")])
+    paddle.jit.save(s2, p2, input_spec=[InputSpec([2, 16], "float32",
+                                                  name="h")])
+    x = np.random.RandomState(1).randn(8, 8).astype(np.float32)
+    ref = s2(s1(paddle.to_tensor(x))).numpy()
+
+    dm = DistModel(DistModelConfig([p1, p2], num_micro_batches=4))
+    assert dm.init()
+    outs = dm.run([x])
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-5)

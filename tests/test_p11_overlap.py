@@ -16,9 +16,9 @@ compiled for a v5e:2x4 topology via libtpu (no chips needed):
    so HLO-level "overlap" assertions are not obtainable; this is
    documented in benchmarks/RESULTS.md with the measured schedule.
 3. The framework's own knob — the ``fsdp`` (ZeRO) mesh axis — removes
-   the end-of-backward all-reduce altogether: gradients leave the
-   backward as ``reduce-scatter`` (each rank keeps only its shard)
-   and parameters are gathered at use. That is the structural fix the
+   the end-of-backward gradient collective from the fsdp axis
+   altogether: parameters are all-gathered at use and each rank
+   computes its gradient shard locally. That is the structural fix the
    reference's reordering pass only approximates, and it is asserted
    here against the compiled executable.
 """
@@ -40,7 +40,7 @@ def _topology():
         pytest.skip(f"TPU AOT topology unavailable: {e}")
 
 
-def _abstract_trainer(mesh, fsdp):
+def _abstract_trainer(mesh):
     from paddle_tpu.models.gpt import GPTConfig, GPTSpmdTrainer
     cfg = GPTConfig(vocab_size=512, hidden_size=256, num_layers=2,
                     num_heads=4, max_seq_len=128, dtype=jnp.bfloat16)
@@ -103,7 +103,7 @@ def test_dp_grad_allreduce_combined_and_scheduled():
     topo = _topology()
     devs = np.array(topo.devices).reshape(1, 8, 1, 1, 1)
     mesh = Mesh(devs, ("pipe", "data", "fsdp", "sep", "model"))
-    txt = _compile_step(_abstract_trainer(mesh, fsdp=False))
+    txt = _compile_step(_abstract_trainer(mesh))
     assert "is_scheduled=true" in txt
     ars = re.findall(r" all-reduce\(", txt)
     assert ars, "DP step lost its gradient all-reduce"
@@ -124,6 +124,6 @@ def test_fsdp_axis_gathers_params_at_use():
     topo = _topology()
     devs = np.array(topo.devices).reshape(1, 1, 8, 1, 1)
     mesh = Mesh(devs, ("pipe", "data", "fsdp", "sep", "model"))
-    txt = _compile_step(_abstract_trainer(mesh, fsdp=True))
+    txt = _compile_step(_abstract_trainer(mesh))
     assert "all-gather" in txt, (
         "fsdp step should gather sharded params at use (ZeRO-3)")
