@@ -44,33 +44,87 @@ class ElasticManager:
                  heartbeat_interval: float = 10.0,
                  heartbeat_timeout: float = 120.0,
                  elastic_level: ElasticLevel = ElasticLevel.FAULT_TOLERANCE,
-                 on_failure: Optional[Callable] = None):
+                 on_failure: Optional[Callable] = None,
+                 store=None):
         self.checkpoint_dir = checkpoint_dir or os.environ.get(
             "PADDLE_ELASTIC_CKPT_DIR", "/tmp/paddle_tpu_elastic")
         self.heartbeat_interval = heartbeat_interval
         self.heartbeat_timeout = heartbeat_timeout
         self.elastic_level = elastic_level
         self.on_failure = on_failure
+        # etcd-registry analog (reference manager.py:125): a shared
+        # TCPStore holds one `elastic/node/{rank}` counter per worker,
+        # bumped by heartbeats. Liveness is judged by READER-side
+        # change detection: a peer is alive while its counter keeps
+        # changing within heartbeat_timeout on the reader's MONOTONIC
+        # clock — no cross-host wall-clock comparison (unsynchronized
+        # clocks must not shrink the TTL). Without a store, falls back
+        # to the in-process table (single-process tests).
+        self.store = store
         self._last_beats = {}
+        self._seen = {}          # rank -> (last value, reader-mono time)
+        self._register_mono = None
+        self._rank = None
+        self._world = None
         self._stop = threading.Event()
         self._watcher: Optional[threading.Thread] = None
         self._failed = False
 
     # -- membership (coordination-service analog of etcd registry) --------
-    def register(self):
-        import jax
-        self._rank = jax.process_index()
-        self._world = jax.process_count()
-        self._last_beats = {r: time.monotonic()
-                            for r in range(self._world)}
+    def register(self, rank: Optional[int] = None,
+                 world: Optional[int] = None):
+        if rank is None or world is None:
+            import jax
+            rank = jax.process_index() if rank is None else rank
+            world = jax.process_count() if world is None else world
+        self._rank = rank
+        self._world = world
+        self._register_mono = time.monotonic()
+        if self.store is not None:
+            self.store.add(f"elastic/node/{rank}", 1)
+        self._last_beats = {r: time.monotonic() for r in range(world)}
         return self
 
     def heartbeat(self, rank: Optional[int] = None):
-        import jax
-        r = rank if rank is not None else jax.process_index()
-        self._last_beats[r] = time.monotonic()
+        if rank is None:
+            if self._rank is None:
+                import jax
+                self._rank = jax.process_index()
+            rank = self._rank
+        self._last_beats[rank] = time.monotonic()
+        if self.store is not None:
+            self.store.add(f"elastic/node/{rank}", 1)
+
+    def _store_fresh(self, r, now):
+        try:
+            # non-blocking read: a missing key raises immediately
+            v = self.store.get(f"elastic/node/{r}", timeout=0.05)
+        except Exception:
+            v = None
+        if v is not None:
+            prev = self._seen.get(r)
+            if prev is None or prev[0] != v:
+                self._seen[r] = (v, now)   # counter moved: alive now
+                return True
+            return now - prev[1] <= self.heartbeat_timeout
+        # never-registered peers get the same grace a fresh heartbeat
+        # would: a slow-starting rank is not a failure yet
+        base = self._seen.get(r, (None, self._register_mono or now))[1]
+        return now - base <= self.heartbeat_timeout
+
+    def alive_nodes(self):
+        """Ranks whose registry entry is fresh (TTL not expired)."""
+        now = time.monotonic()
+        if self.store is None:
+            return [r for r, t in self._last_beats.items()
+                    if now - t <= self.heartbeat_timeout]
+        return [r for r in range(self._world)
+                if self._store_fresh(r, now)]
 
     def dead_peers(self):
+        if self.store is not None:
+            alive = set(self.alive_nodes())
+            return [r for r in range(self._world) if r not in alive]
         now = time.monotonic()
         return [r for r, t in self._last_beats.items()
                 if now - t > self.heartbeat_timeout]

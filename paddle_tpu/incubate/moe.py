@@ -40,16 +40,13 @@ def _topk_gating(logits, capacity, topk=2):
     T, E = logits.shape
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
+    # expert SELECTION happens on the raw masks; capacity masking is
+    # applied only afterwards — a token whose top-1 overflowed must
+    # still pick its true second-best expert, not re-pick the full one
     g1_idx = jnp.argmax(probs, axis=-1)
     m1 = jax.nn.one_hot(g1_idx, E, dtype=jnp.float32)
-
     # positions within each expert (prefix-sum over tokens)
     pos1 = jnp.cumsum(m1, axis=0) * m1 - m1  # 0-based slot of each token
-    keep1 = jnp.sum(pos1 * m1, axis=-1) < capacity
-    m1 = m1 * keep1[:, None]
-    w1 = jnp.sum(probs * m1, axis=-1)
-    slot1 = jnp.sum(pos1 * m1, axis=-1).astype(jnp.int32)
-    c1 = jax.nn.one_hot(slot1, capacity, dtype=jnp.float32)
 
     if topk == 2:
         probs_wo1 = probs * (1 - m1)
@@ -58,6 +55,14 @@ def _topk_gating(logits, capacity, topk=2):
         pos2 = (jnp.cumsum(m2, axis=0) - m2 +
                 jnp.sum(m1, axis=0, keepdims=True)) * m2
         keep2 = jnp.sum(pos2 * m2, axis=-1) < capacity
+
+    keep1 = jnp.sum(pos1 * m1, axis=-1) < capacity
+    m1 = m1 * keep1[:, None]
+    w1 = jnp.sum(probs * m1, axis=-1)
+    slot1 = jnp.sum(pos1 * m1, axis=-1).astype(jnp.int32)
+    c1 = jax.nn.one_hot(slot1, capacity, dtype=jnp.float32)
+
+    if topk == 2:
         m2 = m2 * keep2[:, None]
         w2 = jnp.sum(probs * m2, axis=-1)
         denom = jnp.maximum(w1 + w2, 1e-9)

@@ -109,17 +109,11 @@ class Int8Conv2D(Layer):
                 xs = jnp.where(amax == 0.0, 1.0, amax) / 127.0
             xq = jnp.clip(jnp.round(x.astype(jnp.float32) / xs),
                           -127, 127).astype(jnp.int8)
-            pad = self._padding
-            if isinstance(pad, int):
-                pad = [(pad, pad), (pad, pad)]
-            elif isinstance(pad, (list, tuple)) and \
-                    all(isinstance(p, int) for p in pad):
-                pad = [(p, p) for p in pad]
-            stride = self._stride if isinstance(self._stride, (list, tuple)) \
-                else (self._stride, self._stride)
-            dil = self._dilation if isinstance(self._dilation,
-                                               (list, tuple)) \
-                else (self._dilation, self._dilation)
+            # normalize exactly like the fp conv path does
+            from ..nn.functional.conv import _padding, _tuple
+            pad = _padding(self._padding, 2)
+            stride = _tuple(self._stride, 2)
+            dil = _tuple(self._dilation, 2)
             y = jax.lax.conv_general_dilated(
                 xq, wq, window_strides=tuple(stride), padding=pad,
                 rhs_dilation=tuple(dil),

@@ -1,0 +1,151 @@
+"""Elastic fault-tolerance end to end: TTL membership in the native
+TCPStore, real worker death, heartbeat detection, relaunch exit code,
+and resharded checkpoint restore in the next incarnation.
+
+Reference: fleet/elastic/manager.py:125 (etcd node registry with TTL +
+ELASTIC_EXIT_CODE relaunch protocol) + the launcher watch loop. Here
+the registry is the native TCPStore (csrc/tcp_store.cc) and the
+restore path is the sharded checkpoint (distributed/checkpoint.py),
+which reshards across changed world sizes by construction.
+"""
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.store import TCPStore, get_lib
+from paddle_tpu.distributed.launch import ELASTIC_EXIT_CODE
+
+pytestmark = pytest.mark.skipif(get_lib() is None,
+                                reason="native TCPStore unavailable")
+
+_WORKER = r"""
+import os, sys, time
+rank = int(sys.argv[1]); port = int(sys.argv[2]); ck = sys.argv[3]
+crash_rank = int(sys.argv[4])
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                  ELASTIC_EXIT_CODE)
+
+store = TCPStore("127.0.0.1", port, is_master=False, world_size=2)
+em = ElasticManager(checkpoint_dir=ck, heartbeat_interval=0.1,
+                    heartbeat_timeout=1.2, store=store)
+em.register(rank=rank, world=2)
+
+# toy training state: both ranks advance identically; rank 0 writes
+# the checkpoint (single-process save; world_size=1 metadata so the
+# next incarnation with ONE process can load it)
+w = jnp.arange(8, dtype=jnp.float32)
+for step in range(1, 4):
+    w = w + 1.0
+    em.heartbeat()
+    if rank == 0:
+        from paddle_tpu.distributed.checkpoint import save_state_dict
+        save_state_dict({"w": w, "step": np.int32(step)},
+                        os.path.join(ck, f"step_{step}"))
+        with open(os.path.join(ck, "LATEST"), "w") as f:
+            f.write(str(step))
+    time.sleep(0.15)
+
+if rank == crash_rank:
+    os._exit(17)  # die WITHOUT deregistering: the TTL must catch it
+
+# survivor: keep heartbeating own key; watch for the dead peer
+deadline = time.time() + 15
+while time.time() < deadline:
+    em.heartbeat()
+    dead = em.dead_peers()
+    if dead:
+        assert dead == [crash_rank], dead
+        # the reference protocol: exit with the relaunch code so the
+        # launcher watch loop restarts the job
+        sys.stdout.write(f"detected dead peers {dead}\n")
+        sys.stdout.flush()
+        os._exit(ELASTIC_EXIT_CODE)
+    time.sleep(0.1)
+os._exit(3)  # detection never happened
+"""
+
+_RELAUNCH = r"""
+import os, sys
+port = int(sys.argv[1]); ck = sys.argv[2]
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+em = ElasticManager(checkpoint_dir=ck)
+step = em.latest_step()
+assert step == 3, step
+tmpl = {"w": jnp.zeros(8, jnp.float32), "step": np.int32(0)}
+got = em.restore(tmpl)
+assert got == 3
+np.testing.assert_array_equal(np.asarray(tmpl["w"]),
+                              np.arange(8, dtype=np.float32) + 3)
+print("restored step", got)
+"""
+
+
+def test_kill_detect_relaunch_restore(tmp_path):
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2)
+    try:
+        ck = str(tmp_path / "elastic_ck")
+        os.makedirs(ck, exist_ok=True)
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", _WORKER, str(r), str(master.port),
+             ck, "1"],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True) for r in range(2)]
+        out0, _ = procs[0].communicate(timeout=120)
+        out1, _ = procs[1].communicate(timeout=120)
+        assert procs[1].returncode == 17, out1        # the crash
+        assert procs[0].returncode == ELASTIC_EXIT_CODE, out0
+        assert "detected dead peers [1]" in out0
+
+        # the launcher's relaunch: a new (downsized) incarnation
+        # restores the last completed checkpoint
+        r = subprocess.run(
+            [sys.executable, "-c", _RELAUNCH, str(master.port), ck],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, timeout=120)
+        assert r.returncode == 0, r.stdout
+        assert "restored step 3" in r.stdout
+    finally:
+        master.close()
+
+
+def test_store_ttl_membership(tmp_path):
+    """Registry semantics directly: stale key -> dead; refresh -> alive."""
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=1)
+    try:
+        em = ElasticManager(checkpoint_dir=str(tmp_path),
+                            heartbeat_timeout=1.0, store=master)
+        em.register(rank=0, world=2)
+        # startup grace: a not-yet-registered peer is NOT dead until
+        # the TTL elapses (slow-starting ranks are normal)
+        assert em.dead_peers() == []
+        time.sleep(1.2)
+        em.heartbeat()
+        assert em.dead_peers() == [1]     # never appeared -> expired
+        master.add("elastic/node/1", 1)   # rank 1 comes up
+        assert em.dead_peers() == []
+        time.sleep(1.2)      # rank 1's counter stops moving...
+        em.heartbeat()       # ...while rank 0 refreshes
+        assert em.dead_peers() == [1]
+        assert em.alive_nodes() == [0]
+    finally:
+        master.close()

@@ -62,7 +62,9 @@ UNARY = [
     ("atan", paddle.atan, np.arctan, dict(x=_x()), {}, {}),
     ("asinh", paddle.asinh, np.arcsinh, dict(x=_x()), {}, {}),
     ("digamma", paddle.digamma, sp.digamma,
-     dict(x=_x((3, 4), 0.5, 4.0)), {}, dict(dtypes=("float32",))),
+     dict(x=_x((3, 4), 0.5, 4.0)), {},
+     # fp16 overflows digamma's pole-adjacent intermediate terms
+     dict(dtypes=("float32", "bfloat16"))),
 ]
 
 

@@ -51,6 +51,12 @@ def test_conv2d_grad_numeric():
              lambda x, weight: _np_conv2d(x, weight),
              dict(x=x, weight=w), dtypes=("float32",), check_static=True,
              grad_eps=1e-2, grad_rtol=8e-2, grad_atol=1e-2)
+    # half-precision forward coverage (numeric grad differences are
+    # too noisy below fp32; the grad path is covered above)
+    check_op(lambda x, weight: F.conv2d(x, weight),
+             lambda x, weight: _np_conv2d(x, weight),
+             dict(x=x, weight=w), dtypes=("float16", "bfloat16"),
+             check_static=False, check_grad=False)
 
 
 def test_max_avg_pool2d():
