@@ -75,8 +75,9 @@ def main():
 
     def grads_of(quant8):
         trb.quant8 = quant8  # read at trace time by _mm()
-        loss, g = jax.jit(jax.value_and_grad(trb._forward_loss))(
-            trb.params, jnp.asarray(ids), jnp.asarray(labels))
+        with jax.set_mesh(mesh):
+            loss, g = jax.jit(jax.value_and_grad(trb._forward_loss))(
+                trb.params, jnp.asarray(ids), jnp.asarray(labels))
         return jax.device_get(g)
 
     g_exact = grads_of(False)

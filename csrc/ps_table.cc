@@ -28,6 +28,16 @@
 //         6 NUM_KEYS      -> u64
 //         7 SAVE          (payload: path) — all tables, binary file
 //         8 LOAD          (payload: path)
+//         9 CREATE_SPARSE_SSD (payload: u32 dim, u8 opt, f32 lr,
+//               f32 init, u64 mem_budget_rows, u32 plen, char path[])
+//               — bounded hot-row cache + append-only disk spill
+//               (reference ssd_sparse_table.cc: hot rows in memory,
+//               cold rows on SSD; its trillion-parameter claim)
+//        10 GRAPH_ADD_EDGES (payload: i64 src[n], i64 dst[n])
+//        11 GRAPH_SAMPLE    (payload: i64 nodes[n], u32 k, u64 seed)
+//               -> i64 neighbors[n*k], -1-padded (uniform with
+//               replacement; reference common_graph_table.cc)
+//        12 GRAPH_DEGREE    (payload: i64 nodes[n]) -> i64 deg[n]
 #include <arpa/inet.h>
 #include <netdb.h>
 #include <netinet/in.h>
@@ -41,6 +51,7 @@
 #include <cstdint>
 #include <cstdio>
 #include <cstring>
+#include <list>
 #include <mutex>
 #include <string>
 #include <thread>
@@ -92,9 +103,129 @@ struct Table {
   std::vector<float> dense;
   std::vector<float> dense_accum;
 
+  // SSD spill (reference ssd_sparse_table.cc): when mem_budget > 0,
+  // only that many rows stay hot in memory; LRU victims append to a
+  // spill file (weights + adagrad state) and return on demand
+  uint64_t mem_budget = 0;  // 0 => pure in-memory table
+  std::string spill_path;
+  std::FILE* spill_f = nullptr;
+  std::unordered_map<int64_t, uint64_t> disk_index;  // key -> offset
+  std::list<int64_t> lru;  // front = most recently used
+  std::unordered_map<int64_t, std::list<int64_t>::iterator> lru_pos;
+
+  // graph table (reference common_graph_table.cc): adjacency + sample
+  bool is_graph = false;
+  std::unordered_map<int64_t, std::vector<int64_t>> adj;
+
+  ~Table() {
+    if (spill_f) std::fclose(spill_f);
+  }
+
+  size_t rec_floats() const {
+    return static_cast<size_t>(dim) * (opt == 1 ? 2 : 1);
+  }
+
+  bool spill_open() {
+    if (spill_f) return true;
+    if (spill_path.empty()) return false;
+    spill_f = std::fopen(spill_path.c_str(), "wb+");
+    return spill_f != nullptr;
+  }
+
+  void touch(int64_t key) {
+    if (!mem_budget) return;
+    auto it = lru_pos.find(key);
+    if (it != lru_pos.end()) lru.erase(it->second);
+    lru.push_front(key);
+    lru_pos[key] = lru.begin();
+  }
+
+  void evict_over_budget() {
+    if (!mem_budget || !spill_open()) return;
+    while (rows.size() > mem_budget && !lru.empty()) {
+      int64_t victim = lru.back();
+      lru.pop_back();
+      lru_pos.erase(victim);
+      auto rit = rows.find(victim);
+      if (rit == rows.end()) continue;
+      std::vector<float> rec(rec_floats(), 0.0f);
+      std::memcpy(rec.data(), rit->second.data(), dim * 4);
+      if (opt == 1) {
+        auto ai = accum.find(victim);
+        if (ai != accum.end())
+          std::memcpy(rec.data() + dim, ai->second.data(), dim * 4);
+      }
+      std::fseek(spill_f, 0, SEEK_END);
+      uint64_t off = static_cast<uint64_t>(std::ftell(spill_f));
+      if (std::fwrite(rec.data(), 4, rec.size(), spill_f) !=
+          rec.size()) {
+        // spill device full/broken: KEEP the row in memory (exceeding
+        // the budget beats silently resetting trained parameters) and
+        // stop evicting this round
+        touch(victim);
+        break;
+      }
+      disk_index[victim] = off;  // supersedes any older record
+      rows.erase(rit);
+      accum.erase(victim);
+    }
+  }
+
+  bool read_spilled(int64_t key, float* out) {
+    auto it = disk_index.find(key);
+    if (it == disk_index.end() || !spill_f) return false;
+    std::fflush(spill_f);
+    if (std::fseek(spill_f, static_cast<long>(it->second), SEEK_SET))
+      return false;
+    return std::fread(out, 4, rec_floats(), spill_f) == rec_floats();
+  }
+
+  bool fetch_from_disk(int64_t key) {
+    std::vector<float> rec(rec_floats());
+    if (!read_spilled(key, rec.data())) return false;
+    std::vector<float> w(dim);
+    std::memcpy(w.data(), rec.data(), dim * 4);
+    rows.emplace(key, std::move(w));
+    if (opt == 1) {
+      std::vector<float> a(dim);
+      std::memcpy(a.data(), rec.data() + dim, dim * 4);
+      accum.emplace(key, std::move(a));
+    }
+    return true;
+  }
+
+  uint64_t live_keys() {
+    uint64_t extra = 0;
+    for (auto& kv : disk_index)
+      if (rows.find(kv.first) == rows.end()) ++extra;
+    return rows.size() + extra;
+  }
+
+  void reset_cache_after_load() {
+    // loaded rows supersede every spilled record
+    lru.clear();
+    lru_pos.clear();
+    disk_index.clear();
+    if (spill_f) {
+      std::fclose(spill_f);
+      spill_f = nullptr;
+      if (!spill_path.empty()) std::remove(spill_path.c_str());
+    }
+    for (auto& kv : rows) touch(kv.first);
+    evict_over_budget();
+  }
+
   std::vector<float>& row(int64_t key) {
     auto it = rows.find(key);
-    if (it != rows.end()) return it->second;
+    if (it != rows.end()) {
+      touch(key);
+      return it->second;
+    }
+    if (mem_budget && fetch_from_disk(key)) {
+      touch(key);
+      evict_over_budget();  // the new front survives; victims = LRU tail
+      return rows.find(key)->second;
+    }
     std::vector<float> r(dim);
     uint64_t h = splitmix64(static_cast<uint64_t>(key) ^ seed);
     for (uint32_t i = 0; i < dim; ++i) {
@@ -103,7 +234,10 @@ struct Table {
                 static_cast<float>(1ull << 53);  // [0,1)
       r[i] = (2.0f * u - 1.0f) * init_scale;
     }
-    return rows.emplace(key, std::move(r)).first->second;
+    auto& ref = rows.emplace(key, std::move(r)).first->second;
+    touch(key);
+    evict_over_budget();
+    return ref;
   }
 
   void apply(float* w, float* acc, const float* g, uint32_t n) {
@@ -148,10 +282,15 @@ struct PsServer {
   void accept_loop();
 };
 
+// versioned checkpoint magic: v2 adds the per-table is_graph flag and
+// adjacency section; files without it parse as the v1 layout
+constexpr uint64_t kPsMagicV2 = 0x5054505300000002ull;
+
 bool PsServer::save(const std::string& path) {
   std::FILE* f = std::fopen(path.c_str(), "wb");
   if (!f) return false;
   std::lock_guard<std::mutex> lk(tables_mu);
+  std::fwrite(&kPsMagicV2, 8, 1, f);
   uint64_t ntab = tables.size();
   std::fwrite(&ntab, 8, 1, f);
   for (auto& kv : tables) {
@@ -165,7 +304,19 @@ bool PsServer::save(const std::string& path) {
     std::fwrite(&t->lr, 4, 1, f);
     std::fwrite(&t->init_scale, 4, 1, f);
     std::fwrite(&t->seed, 8, 1, f);
-    uint64_t nrows = t->rows.size();
+    uint8_t is_graph = t->is_graph;
+    std::fwrite(&is_graph, 1, 1, f);
+    if (is_graph) {
+      uint64_t nnodes = t->adj.size();
+      std::fwrite(&nnodes, 8, 1, f);
+      for (auto& e : t->adj) {
+        std::fwrite(&e.first, 8, 1, f);
+        uint64_t deg = e.second.size();
+        std::fwrite(&deg, 8, 1, f);
+        std::fwrite(e.second.data(), 8, deg, f);
+      }
+    }
+    uint64_t nrows = t->live_keys();
     std::fwrite(&nrows, 8, 1, f);
     for (auto& r : t->rows) {
       std::fwrite(&r.first, 8, 1, f);
@@ -174,6 +325,20 @@ bool PsServer::save(const std::string& path) {
       uint8_t has_acc = ai != t->accum.end();
       std::fwrite(&has_acc, 1, 1, f);
       if (has_acc) std::fwrite(ai->second.data(), 4, t->dim, f);
+    }
+    // spilled (disk-only) rows read straight from the spill file
+    if (t->mem_budget) {
+      std::vector<float> rec(t->rec_floats());
+      for (auto& kv : t->disk_index) {
+        if (t->rows.find(kv.first) != t->rows.end()) continue;
+        if (!t->read_spilled(kv.first, rec.data())) continue;
+        std::fwrite(&kv.first, 8, 1, f);
+        std::fwrite(rec.data(), 4, t->dim, f);
+        uint8_t has_acc = t->opt == 1;
+        std::fwrite(&has_acc, 1, 1, f);
+        if (has_acc)
+          std::fwrite(rec.data() + t->dim, 4, t->dim, f);
+      }
     }
     if (t->dense_size) {
       std::fwrite(t->dense.data(), 4, t->dense_size, f);
@@ -200,6 +365,8 @@ bool PsServer::load(const std::string& path) {
   };
   uint64_t ntab = 0;
   if (std::fread(&ntab, 8, 1, f) != 1) return fail();
+  bool v2 = ntab == kPsMagicV2;
+  if (v2 && std::fread(&ntab, 8, 1, f) != 1) return fail();
   for (uint64_t i = 0; i < ntab; ++i) {
     uint32_t id;
     Table* t = new Table();
@@ -210,6 +377,26 @@ bool PsServer::load(const std::string& path) {
               std::fread(&t->lr, 4, 1, f) == 1 &&
               std::fread(&t->init_scale, 4, 1, f) == 1 &&
               std::fread(&t->seed, 8, 1, f) == 1;
+    if (ok && v2) {
+      uint8_t is_graph = 0;
+      ok = std::fread(&is_graph, 1, 1, f) == 1;
+      t->is_graph = is_graph;
+      if (ok && is_graph) {
+        uint64_t nnodes = 0;
+        ok = std::fread(&nnodes, 8, 1, f) == 1;
+        for (uint64_t g = 0; ok && g < nnodes; ++g) {
+          int64_t node;
+          uint64_t deg = 0;
+          ok = std::fread(&node, 8, 1, f) == 1 &&
+               std::fread(&deg, 8, 1, f) == 1 &&
+               deg <= (1ull << 32);
+          if (!ok) break;
+          std::vector<int64_t> nb(deg);
+          ok = deg == 0 || std::fread(nb.data(), 8, deg, f) == deg;
+          if (ok) t->adj.emplace(node, std::move(nb));
+        }
+      }
+    }
     uint64_t nrows = 0;
     ok = ok && std::fread(&nrows, 8, 1, f) == 1;
     for (uint64_t r = 0; ok && r < nrows; ++r) {
@@ -264,6 +451,9 @@ bool PsServer::load(const std::string& path) {
     live->accum.swap(nt->accum);
     live->dense.swap(nt->dense);
     live->dense_accum.swap(nt->dense_accum);
+    live->is_graph = nt->is_graph;
+    live->adj.swap(nt->adj);
+    if (live->mem_budget) live->reset_cache_after_load();
     delete nt;
   }
   return true;
@@ -405,7 +595,7 @@ void PsServer::handle_conn(int fd) {
         Table* t = table(table_id);
         if (!t) { status = 1; break; }
         std::lock_guard<std::mutex> lk(t->mu);
-        uint64_t nk = t->rows.size();
+        uint64_t nk = t->live_keys();
         payload.resize(8);
         std::memcpy(payload.data(), &nk, 8);
         break;
@@ -417,6 +607,127 @@ void PsServer::handle_conn(int fd) {
         if (!io_ok) break;
         bool ok = cmd == 7 ? save(path) : load(path);
         if (!ok) status = 1;
+        break;
+      }
+      case 9: {  // CREATE_SPARSE_SSD
+        struct { uint32_t dim; uint8_t opt; float lr; float init;
+                 uint64_t budget; uint32_t plen; }
+            __attribute__((packed)) args;
+        io_ok = recv_all(fd, &args, sizeof(args));
+        if (!io_ok || args.plen > 4096) { io_ok = false; break; }
+        std::string spath(args.plen, '\0');
+        io_ok = args.plen == 0 ||
+                recv_all(fd, &spath[0], args.plen);
+        if (!io_ok) break;
+        std::lock_guard<std::mutex> lk(tables_mu);
+        auto it = tables.find(table_id);
+        if (it != tables.end()) {
+          Table* live = it->second;
+          if (live->dim != args.dim || live->dense_size ||
+              live->is_graph) {
+            status = 1;  // conflicting existing table
+            break;
+          }
+          // idempotent re-create keeps trained rows but must still
+          // APPLY the memory bound: after a checkpoint restore the
+          // table exists as plain in-memory, and losing the budget
+          // here would silently grow it unbounded
+          std::lock_guard<std::mutex> tl(live->mu);
+          if (!live->mem_budget) {
+            live->mem_budget = args.budget ? args.budget : 1;
+            live->spill_path = spath;
+            for (auto& kv : live->rows) live->touch(kv.first);
+            live->evict_over_budget();
+          }
+          break;
+        }
+        Table* t = new Table();
+        t->dim = args.dim;
+        t->opt = args.opt;
+        t->lr = args.lr;
+        t->init_scale = args.init;
+        t->seed = splitmix64(table_id + 0x1234u);
+        t->mem_budget = args.budget ? args.budget : 1;
+        t->spill_path = spath;
+        tables[table_id] = t;
+        break;
+      }
+      case 10: {  // GRAPH_ADD_EDGES: i64 src[n], i64 dst[n]
+        if (n > (1ull << 28)) { io_ok = false; break; }
+        std::vector<int64_t> src(n), dst(n);
+        io_ok = n == 0 || (recv_all(fd, src.data(), n * 8) &&
+                           recv_all(fd, dst.data(), n * 8));
+        if (!io_ok) break;
+        Table* t;
+        {
+          std::lock_guard<std::mutex> lk(tables_mu);
+          auto it = tables.find(table_id);
+          if (it == tables.end()) {
+            t = new Table();
+            t->is_graph = true;
+            tables[table_id] = t;
+          } else {
+            t = it->second;
+          }
+        }
+        if (!t->is_graph) { status = 1; break; }
+        std::lock_guard<std::mutex> lk(t->mu);
+        for (uint64_t i = 0; i < n; ++i)
+          t->adj[src[i]].push_back(dst[i]);
+        break;
+      }
+      case 11: {  // GRAPH_SAMPLE: i64 nodes[n] | u32 k | u64 seed
+        if (n > (1ull << 28)) { io_ok = false; break; }
+        std::vector<int64_t> nodes(n);
+        io_ok = n == 0 || recv_all(fd, nodes.data(), n * 8);
+        uint32_t k = 0;
+        uint64_t sseed = 0;
+        io_ok = io_ok && recv_all(fd, &k, 4) && recv_all(fd, &sseed, 8);
+        // bound the RESPONSE allocation too: n and k individually in
+        // range can still multiply into an OOM that would terminate
+        // the detached handler thread (and with it the whole server)
+        if (!io_ok || k > (1u << 20) ||
+            n * static_cast<uint64_t>(k) > (1ull << 27)) {
+          io_ok = false;
+          break;
+        }
+        Table* t = table(table_id);
+        if (!t || !t->is_graph) { status = 1; break; }
+        payload.resize(n * k * 8);
+        int64_t* out = reinterpret_cast<int64_t*>(payload.data());
+        std::lock_guard<std::mutex> lk(t->mu);
+        uint64_t h = splitmix64(sseed ^ 0x5eedu);
+        for (uint64_t i = 0; i < n; ++i) {
+          auto it = t->adj.find(nodes[i]);
+          if (it == t->adj.end() || it->second.empty()) {
+            for (uint32_t j = 0; j < k; ++j) out[i * k + j] = -1;
+            continue;
+          }
+          const auto& nb = it->second;
+          for (uint32_t j = 0; j < k; ++j) {  // uniform w/ replacement
+            h = splitmix64(h + nodes[i]);
+            out[i * k + j] =
+                nb[static_cast<size_t>(h % nb.size())];
+          }
+        }
+        break;
+      }
+      case 12: {  // GRAPH_DEGREE: i64 nodes[n] -> i64 deg[n]
+        if (n > (1ull << 28)) { io_ok = false; break; }
+        std::vector<int64_t> nodes(n);
+        io_ok = n == 0 || recv_all(fd, nodes.data(), n * 8);
+        if (!io_ok) break;
+        Table* t = table(table_id);
+        if (!t || !t->is_graph) { status = 1; break; }
+        payload.resize(n * 8);
+        int64_t* out = reinterpret_cast<int64_t*>(payload.data());
+        std::lock_guard<std::mutex> lk(t->mu);
+        for (uint64_t i = 0; i < n; ++i) {
+          auto it = t->adj.find(nodes[i]);
+          out[i] = it == t->adj.end()
+                       ? 0
+                       : static_cast<int64_t>(it->second.size());
+        }
         break;
       }
       default:
@@ -591,6 +902,59 @@ int psc_create_sparse(void* h, uint32_t table_id, uint32_t dim, int opt,
                    sizeof(args), nullptr, 0, &out)
              ? 0
              : -1;
+}
+
+int psc_create_sparse_ssd(void* h, uint32_t table_id, uint32_t dim,
+                          int opt, float lr, float init_scale,
+                          uint64_t mem_budget_rows,
+                          const char* spill_path) {
+  struct { uint32_t dim; uint8_t opt; float lr; float init;
+           uint64_t budget; uint32_t plen; }
+      __attribute__((packed)) args{dim, static_cast<uint8_t>(opt), lr,
+                                   init_scale, mem_budget_rows, 0};
+  size_t plen = std::strlen(spill_path);
+  args.plen = static_cast<uint32_t>(plen);
+  std::vector<uint8_t> out;
+  return roundtrip(static_cast<PsClient*>(h), 9, table_id, 0, &args,
+                   sizeof(args), spill_path, plen, &out)
+             ? 0
+             : -1;
+}
+
+int psc_graph_add_edges(void* h, uint32_t table_id, const int64_t* src,
+                        const int64_t* dst, uint64_t n) {
+  std::vector<uint8_t> out;
+  return roundtrip(static_cast<PsClient*>(h), 10, table_id, n, src,
+                   n * 8, dst, n * 8, &out)
+             ? 0
+             : -1;
+}
+
+int psc_graph_sample(void* h, uint32_t table_id, const int64_t* nodes,
+                     uint64_t n, uint32_t k, uint64_t seed,
+                     int64_t* out_neighbors) {
+  struct { uint32_t k; uint64_t seed; } __attribute__((packed))
+      tail{k, seed};
+  std::vector<uint8_t> out;
+  if (!roundtrip(static_cast<PsClient*>(h), 11, table_id, n, nodes,
+                 n * 8, &tail, sizeof(tail), &out)) {
+    return -1;
+  }
+  if (out.size() != n * k * 8) return -1;
+  std::memcpy(out_neighbors, out.data(), out.size());
+  return 0;
+}
+
+int psc_graph_degree(void* h, uint32_t table_id, const int64_t* nodes,
+                     uint64_t n, int64_t* out_deg) {
+  std::vector<uint8_t> out;
+  if (!roundtrip(static_cast<PsClient*>(h), 12, table_id, n, nodes,
+                 n * 8, nullptr, 0, &out)) {
+    return -1;
+  }
+  if (out.size() != n * 8) return -1;
+  std::memcpy(out_deg, out.data(), out.size());
+  return 0;
 }
 
 int psc_pull_sparse(void* h, uint32_t table_id, const int64_t* keys,

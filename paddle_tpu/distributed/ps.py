@@ -28,7 +28,8 @@ from ..framework.tensor import Tensor
 from ..nn.layer_base import Layer
 from ..utils.native_build import build_native_so
 
-__all__ = ["PsServer", "PsClient", "SparseTable", "DistributedEmbedding",
+__all__ = ["PsServer", "PsClient", "SparseTable", "SsdSparseTable",
+           "GraphTable", "DistributedEmbedding",
            "init_server", "run_server", "init_worker", "stop_worker",
            "get_client"]
 
@@ -90,6 +91,27 @@ def _get_lib():
         lib.psc_save.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
         lib.psc_load.restype = ctypes.c_int
         lib.psc_load.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.psc_create_sparse_ssd.restype = ctypes.c_int
+        lib.psc_create_sparse_ssd.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint32,
+            ctypes.c_int, ctypes.c_float, ctypes.c_float,
+            ctypes.c_uint64, ctypes.c_char_p]
+        lib.psc_graph_add_edges.restype = ctypes.c_int
+        lib.psc_graph_add_edges.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_uint64]
+        lib.psc_graph_sample.restype = ctypes.c_int
+        lib.psc_graph_sample.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_uint64,
+            ctypes.c_uint32, ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int64)]
+        lib.psc_graph_degree.restype = ctypes.c_int
+        lib.psc_graph_degree.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint32,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_uint64,
+            ctypes.POINTER(ctypes.c_int64)]
         _lib = lib
         return _lib
 
@@ -165,6 +187,78 @@ class PsClient:
                 f"create_sparse_table({table_id}) failed (an existing "
                 f"table with this id and a different dim?)")
         self._table_dims[table_id] = dim
+
+    def create_sparse_ssd_table(self, table_id: int, dim: int,
+                                optimizer: str = "sgd",
+                                lr: float = 0.01,
+                                init_scale: float = 0.05,
+                                mem_budget_rows: int = 1 << 20,
+                                spill_path: Optional[str] = None):
+        """SSD-spill sparse table (reference ssd_sparse_table.cc): only
+        ``mem_budget_rows`` hot rows stay in server memory; LRU victims
+        — weights AND optimizer state — spill to ``spill_path`` and
+        return transparently on access. Same pull/push/save/load API
+        as the in-memory table."""
+        import tempfile
+        opt = OPTIMIZERS[optimizer]
+        if spill_path is None:
+            # unique per call: a shared /tmp name would let a second
+            # server truncate the first one's live spill file
+            fd, spill_path = tempfile.mkstemp(
+                prefix=f"ps_spill_{table_id}_", suffix=".bin")
+            os.close(fd)
+        with self._mu:
+            rc = self._lib.psc_create_sparse_ssd(
+                self._handle(), table_id, dim, opt, lr, init_scale,
+                mem_budget_rows, spill_path.encode())
+        if rc != 0:
+            raise RuntimeError(
+                f"create_sparse_ssd_table({table_id}) failed")
+        self._table_dims[table_id] = dim
+
+    def graph_add_edges(self, table_id: int, src, dst):
+        src = np.ascontiguousarray(src, dtype=np.int64).ravel()
+        dst = np.ascontiguousarray(dst, dtype=np.int64).ravel()
+        if src.size != dst.size:
+            raise ValueError("src/dst length mismatch")
+        with self._mu:
+            rc = self._lib.psc_graph_add_edges(
+                self._handle(), table_id,
+                src.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                dst.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                src.size)
+        if rc != 0:
+            raise RuntimeError(f"graph_add_edges({table_id}) failed")
+
+    def graph_sample_neighbors(self, table_id: int, nodes, k: int,
+                               seed: int = 0) -> np.ndarray:
+        """Uniform-with-replacement neighbor sampling; rows of -1 for
+        isolated nodes (reference common_graph_table.cc
+        random_sample_neighbors)."""
+        nodes = np.ascontiguousarray(nodes, dtype=np.int64).ravel()
+        out = np.empty((nodes.size, k), np.int64)
+        with self._mu:
+            rc = self._lib.psc_graph_sample(
+                self._handle(), table_id,
+                nodes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                nodes.size, k, seed,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if rc != 0:
+            raise RuntimeError(f"graph_sample({table_id}) failed")
+        return out
+
+    def graph_degree(self, table_id: int, nodes) -> np.ndarray:
+        nodes = np.ascontiguousarray(nodes, dtype=np.int64).ravel()
+        out = np.empty(nodes.size, np.int64)
+        with self._mu:
+            rc = self._lib.psc_graph_degree(
+                self._handle(), table_id,
+                nodes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+                nodes.size,
+                out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+        if rc != 0:
+            raise RuntimeError(f"graph_degree({table_id}) failed")
+        return out
 
     def pull_sparse(self, table_id: int, keys) -> np.ndarray:
         keys = np.ascontiguousarray(keys, dtype=np.int64).ravel()
@@ -255,8 +349,7 @@ class SparseTable:
                  lr: float = 0.01, init_scale: float = 0.05,
                  table_id: Optional[int] = None):
         if table_id is None:
-            SparseTable._next_id[0] += 1
-            table_id = SparseTable._next_id[0]
+            table_id = _alloc_table_id()
         self.client = client
         self.table_id = table_id
         self.dim = dim
@@ -271,6 +364,56 @@ class SparseTable:
 
     def num_keys(self) -> int:
         return self.client.num_keys(self.table_id)
+
+
+def _alloc_table_id() -> int:
+    SparseTable._next_id[0] += 1
+    return SparseTable._next_id[0]
+
+
+class SsdSparseTable(SparseTable):
+    """Sparse table whose cold rows spill to disk
+    (ssd_sparse_table.cc analog): bounded server memory regardless of
+    the number of live keys — the mechanism behind the reference's
+    trillion-parameter parameter-server claim."""
+
+    def __init__(self, client: PsClient, dim: int,
+                 optimizer: str = "sgd", lr: float = 0.01,
+                 init_scale: float = 0.05,
+                 mem_budget_rows: int = 1 << 20,
+                 spill_path: Optional[str] = None,
+                 table_id: Optional[int] = None):
+        if table_id is None:
+            table_id = _alloc_table_id()
+        self.client = client
+        self.table_id = table_id
+        self.dim = dim
+        client.create_sparse_ssd_table(table_id, dim, optimizer, lr,
+                                       init_scale, mem_budget_rows,
+                                       spill_path)
+
+
+class GraphTable:
+    """Adjacency store + uniform neighbor sampling on the PS
+    (common_graph_table.cc analog) — the storage side of GNN sampling
+    pipelines; the compute side is paddle_tpu.geometric."""
+
+    def __init__(self, client: PsClient,
+                 table_id: Optional[int] = None):
+        if table_id is None:
+            table_id = _alloc_table_id()
+        self.client = client
+        self.table_id = table_id
+
+    def add_edges(self, src, dst):
+        self.client.graph_add_edges(self.table_id, src, dst)
+
+    def sample_neighbors(self, nodes, k: int, seed: int = 0):
+        return self.client.graph_sample_neighbors(self.table_id, nodes,
+                                                  k, seed)
+
+    def degree(self, nodes):
+        return self.client.graph_degree(self.table_id, nodes)
 
 
 class DistributedEmbedding(Layer):
