@@ -33,21 +33,26 @@ from .functional import functional_call
 __all__ = ["to_static", "not_to_static", "StaticFunction", "InputSpec",
            "capture_report", "reset_capture_report"]
 
-# graph-capture telemetry: how often calls compile vs fall back
-_capture_stats = {"whole_graph_calls": 0, "graph_break_calls": 0,
-                  "breaks": {}}
+# graph-capture telemetry: how often calls compile vs fall back.
+# bytecode_graph_calls counts whole-graph captures that needed the SOT
+# bytecode tier (opcode_executor.py) after plain tracing failed.
+_capture_stats = {"whole_graph_calls": 0, "bytecode_graph_calls": 0,
+                  "graph_break_calls": 0, "breaks": {}}
 
 
 def capture_report():
-    """Return {whole_graph_calls, graph_break_calls, breaks: {reason:
-    count}} accumulated across all StaticFunction calls."""
+    """Return {whole_graph_calls, bytecode_graph_calls,
+    graph_break_calls, breaks: {reason: count}} accumulated across all
+    StaticFunction calls."""
     return {"whole_graph_calls": _capture_stats["whole_graph_calls"],
+            "bytecode_graph_calls": _capture_stats["bytecode_graph_calls"],
             "graph_break_calls": _capture_stats["graph_break_calls"],
             "breaks": dict(_capture_stats["breaks"])}
 
 
 def reset_capture_report():
     _capture_stats["whole_graph_calls"] = 0
+    _capture_stats["bytecode_graph_calls"] = 0
     _capture_stats["graph_break_calls"] = 0
     _capture_stats["breaks"] = {}
 
@@ -112,9 +117,14 @@ class StaticFunction:
             self._fn = function
             self._bound_self = None
         self._input_spec = input_spec
-        self._cache = {}  # static-guard key -> jitted program
+        self._cache = {}  # static-guard key -> (tier, jitted program)
         self._overflow_warned = False
         self._sig = None  # lazily-computed signature (kwargs path)
+        # generators/coroutines yield control mid-body — not a graph;
+        # always run them eagerly instead of crashing in jit
+        self._never_trace = (inspect.isgeneratorfunction(self._fn)
+                             or inspect.iscoroutinefunction(self._fn)
+                             or inspect.isasyncgenfunction(self._fn))
         functools.update_wrapper(self, self._fn)
 
     @property
@@ -184,9 +194,16 @@ class StaticFunction:
                 add("pos", v, dyn, skey)
         return tuple(entries), tuple(dyn), tuple(skey)
 
-    def _build(self, layout):
+    def _build(self, layout, bytecode=False):
         layer = self._layer
-        fn = self._converted()
+        if bytecode:
+            # SOT tier: interpret the ORIGINAL function's bytecode
+            # (tensor-if becomes lax.cond inside the interpreter); used
+            # when AST conversion + plain tracing already failed
+            from .opcode_executor import OpcodeFunction
+            fn = OpcodeFunction(self._fn)
+        else:
+            fn = self._converted()
 
         def rebuild(arg_arrays):
             pos, kw = [], {}
@@ -227,8 +244,9 @@ class StaticFunction:
 
     def __call__(self, *args, **kwargs):
         from . import _to_static_enabled
-        if not _to_static_enabled[0]:
-            # paddle.jit.enable_to_static(False): eager passthrough
+        if not _to_static_enabled[0] or self._never_trace:
+            # enable_to_static(False) passthrough, or a generator /
+            # coroutine function (cannot be a graph)
             return self._eager(args, kwargs)
         try:
             layout, dyn, skey = self._split_args(args, kwargs)
@@ -236,16 +254,17 @@ class StaticFunction:
             _note_break(f"unguardable arg: {e}")
             return self._eager(args, kwargs)
         key = (skey, tuple((dest, kind) for dest, kind, _ in layout))
-        jitted = self._cache.get(key)
-        if jitted is _BROKEN:
+        entry = self._cache.get(key)
+        if entry is _BROKEN:
             # this specialization failed tracing before: stay eager
             # without paying a full re-trace per call
             _note_break("known graph break (cached)")
             return self._eager(args, kwargs)
-        if jitted is not None:
+        if entry is not None:
             # LRU refresh so churn on other keys can't evict hot entries
             self._cache.pop(key)
-            self._cache[key] = jitted
+            self._cache[key] = entry
+            tier, jitted = entry
         else:
             if len(self._cache) >= _CACHE_LIMIT:
                 # guard explosion (e.g. a fresh float every call):
@@ -264,25 +283,48 @@ class StaticFunction:
                         f"forcing a recompile per call. Pass it as a "
                         f"Tensor/array to trace it dynamically.",
                         RuntimeWarning, stacklevel=3)
-            jitted = self._cache[key] = self._build(layout)
-        try:
+            tier = "ast"
+            jitted = self._build(layout)
+            self._cache[key] = (tier, jitted)
+
+        def _run(j):
             if self._layer is not None:
                 params, buffers = self._layer.raw_state()
-                out, new_buffers = jitted(params, buffers,
-                                          self._layer.training, *dyn)
+                return j(params, buffers, self._layer.training, *dyn)
+            return j(*dyn), None
+
+        from .opcode_executor import GraphBreak
+        _TRACE_ERRS = (GraphBreak,
+                       jax.errors.ConcretizationTypeError,
+                       jax.errors.TracerArrayConversionError,
+                       jax.errors.TracerBoolConversionError,
+                       jax.errors.TracerIntegerConversionError)
+        try:
+            out, new_buffers = _run(jitted)
+        except _TRACE_ERRS as e:
+            if tier == "ast":
+                # data-dependent python control flow the AST pass could
+                # not lower: escalate to the SOT bytecode tier, which
+                # if-converts tensor branches at the opcode level
+                try:
+                    tier = "sot"
+                    jitted = self._build(layout, bytecode=True)
+                    out, new_buffers = _run(jitted)
+                    self._cache[key] = (tier, jitted)
+                except _TRACE_ERRS as e2:
+                    self._cache[key] = _BROKEN
+                    _note_break(f"graph break: {e2}")
+                    return self._eager(args, kwargs)
             else:
-                out = jitted(*dyn)
-        except (jax.errors.ConcretizationTypeError,
-                jax.errors.TracerArrayConversionError,
-                jax.errors.TracerBoolConversionError,
-                jax.errors.TracerIntegerConversionError) as e:
-            # data-dependent python control flow the AST pass could not
-            # lower: SOT-style graph break, run eagerly — and remember,
-            # so later calls skip the (expensive) doomed re-trace
-            self._cache[key] = _BROKEN
-            _note_break(f"trace failure: {type(e).__name__}")
-            return self._eager(args, kwargs)
+                # a RETRACE of a cached SOT program (e.g. the layer
+                # flipped train->eval) can hit a fresh GraphBreak too —
+                # same answer either way: go eager, remember the break
+                self._cache[key] = _BROKEN
+                _note_break(f"trace failure: {type(e).__name__}")
+                return self._eager(args, kwargs)
         _capture_stats["whole_graph_calls"] += 1
+        if tier == "sot":
+            _capture_stats["bytecode_graph_calls"] += 1
         if self._layer is not None:
             with no_grad():
                 for n, b in self._layer.named_buffers():

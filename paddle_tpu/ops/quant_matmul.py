@@ -20,10 +20,14 @@ end-to-end loss parity (see benchmarks/RESULTS.md).
 """
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
 
-__all__ = ["int8_linear", "int8_linear_dgrad8", "quantize_rowwise"]
+__all__ = ["int8_linear", "int8_linear_dgrad8", "quantize_rowwise",
+           "quantize_rowwise_fast"]
 
 
 def quantize_rowwise(x, axis):
@@ -37,10 +41,105 @@ def quantize_rowwise(x, axis):
     return q, scale
 
 
+# ---------------------------------------------------------------------------
+# single-pass Pallas quantize
+# ---------------------------------------------------------------------------
+# XLA lowers quantize_rowwise to two passes over x in HBM: a reduce
+# fusion for amax, then an elementwise fusion that re-reads x to scale
+# and cast. The row fits in VMEM, so a Pallas kernel does amax + scale
+# in ONE read of x — quantize passes were ~12 ms of the 411 ms flagship
+# step (benchmarks/RESULTS.md round-3 decomposition), roughly half of
+# which is the second read this kernel removes.
+
+def _rowq_kernel(x_ref, q_ref, s_ref):
+    x = x_ref[...].astype(jnp.float32)                     # [bm, K]
+    amax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
+    scale = jnp.where(amax == 0.0, 1.0, amax) / 127.0
+    q_ref[...] = jnp.clip(jnp.round(x / scale), -127, 127) \
+        .astype(jnp.int8)
+    s_ref[...] = scale
+
+
+def _colq_kernel(x_ref, q_ref, s_ref):
+    x = x_ref[...].astype(jnp.float32)                     # [K, bn]
+    amax = jnp.max(jnp.abs(x), axis=0, keepdims=True)
+    scale = jnp.where(amax == 0.0, 1.0, amax) / 127.0
+    q_ref[...] = jnp.clip(jnp.round(x / scale), -127, 127) \
+        .astype(jnp.int8)
+    s_ref[...] = scale
+
+
+def _pick_block(rows: int, row_bytes: int, budget: int = 2 << 20) -> int:
+    for b in (512, 256, 128, 64, 32, 16, 8):
+        if rows % b == 0 and b * row_bytes <= budget:
+            return b
+    return 0
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _rowq_call(x2, interpret):
+    M, K = x2.shape
+    bm = _pick_block(M, K * x2.dtype.itemsize)
+    kernel = pl.pallas_call(
+        _rowq_kernel, grid=(M // bm,),
+        in_specs=[pl.BlockSpec((bm, K), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((bm, K), lambda i: (i, 0)),
+                   pl.BlockSpec((bm, 1), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((M, K), jnp.int8),
+                   jax.ShapeDtypeStruct((M, 1), jnp.float32)],
+        interpret=interpret)
+    return kernel(x2)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _colq_call(x2, interpret):
+    K, N = x2.shape
+    bn = _pick_block(N, K * x2.dtype.itemsize)
+    kernel = pl.pallas_call(
+        _colq_kernel, grid=(N // bn,),
+        in_specs=[pl.BlockSpec((K, bn), lambda j: (0, j))],
+        out_specs=[pl.BlockSpec((K, bn), lambda j: (0, j)),
+                   pl.BlockSpec((1, bn), lambda j: (0, j))],
+        out_shape=[jax.ShapeDtypeStruct((K, N), jnp.int8),
+                   jax.ShapeDtypeStruct((1, N), jnp.float32)],
+        interpret=interpret)
+    return kernel(x2)
+
+
+def quantize_rowwise_fast(x, axis, interpret=None):
+    """quantize_rowwise with a single-pass Pallas kernel where the
+    layout permits (TPU backend, lane-aligned reduced dim, divisible
+    row count); falls back to the XLA version otherwise."""
+    if interpret is None:
+        # single-device TPU only: under GSPMD the pallas_call is an
+        # opaque custom call the partitioner would replicate, so
+        # multi-device meshes keep the (partitionable) XLA fusion path
+        if jax.default_backend() not in ("tpu", "axon") \
+                or jax.device_count() != 1:
+            return quantize_rowwise(x, axis)
+        interpret = False
+    axis = axis % x.ndim
+    if axis == x.ndim - 1:
+        lead = x.shape[:-1]
+        K = x.shape[-1]
+        M = 1
+        for s in lead:
+            M *= s
+        if K % 128 == 0 and _pick_block(M, K * x.dtype.itemsize):
+            q, s = _rowq_call(x.reshape(M, K), interpret)
+            return q.reshape(x.shape), s.reshape(lead + (1,))
+    elif axis == 0 and x.ndim == 2:
+        K, N = x.shape
+        if N % 128 == 0 and K % 8 == 0 \
+                and _pick_block(N, K * x.dtype.itemsize):
+            return _colq_call(x, interpret)
+    return quantize_rowwise(x, axis)
+
+
 def _int8_matmul(x, w):
     """x [..., K] @ w [K, N] with int8 MXU math, output in x.dtype."""
-    xq, xs = quantize_rowwise(x, axis=-1)          # [..., 1]
-    wq, ws = quantize_rowwise(w, axis=0)           # [1, N]
+    xq, xs = quantize_rowwise_fast(x, axis=-1)     # [..., 1]
+    wq, ws = quantize_rowwise_fast(w, axis=0)      # [1, N]
     y = jax.lax.dot_general(xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
                             preferred_element_type=jnp.int32)
     return (y.astype(jnp.float32) * xs * ws).astype(x.dtype)
@@ -88,8 +187,8 @@ def _fwd8(x, w):
 def _bwd8(res, g):
     x, w = res
     # dx = g [..., N] @ w.T [N, K], both sides int8-quantized along N
-    gq, gs = quantize_rowwise(g, axis=-1)            # [..., 1]
-    wq, ws = quantize_rowwise(w, axis=1)             # [K, 1]
+    gq, gs = quantize_rowwise_fast(g, axis=-1)       # [..., 1]
+    wq, ws = quantize_rowwise_fast(w, axis=1)        # [K, 1]
     y = jax.lax.dot_general(gq, wq, (((g.ndim - 1,), (1,)), ((), ())),
                             preferred_element_type=jnp.int32)
     dx = (y.astype(jnp.float32) * gs *

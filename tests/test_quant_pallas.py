@@ -1,0 +1,62 @@
+"""Single-pass Pallas quantize kernel vs the two-pass XLA reference.
+
+The kernels (`ops/quant_matmul.py::_rowq_kernel/_colq_kernel`) must be
+bit-identical to `quantize_rowwise`: same amax, same round-half-even,
+same clip. Run under interpret=True on the CPU mesh; the real-TPU
+engagement is exercised by bench_gpt_hybrid (quant8 defaults).
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.quant_matmul import (quantize_rowwise,
+                                         quantize_rowwise_fast)
+
+
+def _check(x, axis):
+    q0, s0 = quantize_rowwise(x, axis)
+    q1, s1 = quantize_rowwise_fast(x, axis, interpret=True)
+    # XLA may fold /127.0 to a reciprocal multiply on one path and not
+    # the other — allow 1 ULP on the scale, which can shift a value
+    # sitting exactly on a rounding boundary by one quantization step
+    np.testing.assert_allclose(np.asarray(s0), np.asarray(s1),
+                               rtol=1e-6)
+    dq = np.abs(np.asarray(q0, np.int32) - np.asarray(q1, np.int32))
+    assert dq.max() <= 1 and (dq != 0).mean() < 0.01
+    assert q1.dtype == jnp.int8 and s1.shape == s0.shape
+
+
+@pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float32])
+def test_row_quantize_2d(dtype):
+    x = jax.random.normal(jax.random.key(0), (64, 256), dtype)
+    _check(x, axis=-1)
+    _check(x, axis=1)
+
+
+def test_row_quantize_3d():
+    x = jax.random.normal(jax.random.key(1), (4, 16, 384), jnp.bfloat16)
+    _check(x, axis=-1)
+
+
+def test_col_quantize_weight():
+    w = jax.random.normal(jax.random.key(2), (256, 384), jnp.bfloat16)
+    _check(w, axis=0)
+
+
+def test_zero_row_scale_is_one():
+    x = jnp.zeros((16, 128), jnp.float32).at[0, 0].set(3.0)
+    q, s = quantize_rowwise_fast(x, axis=-1, interpret=True)
+    np.testing.assert_allclose(np.asarray(s[1:]),
+                               np.full((15, 1), 1.0 / 127.0, np.float32),
+                               rtol=0, atol=0)
+    assert int(q[0, 0]) == 127
+
+
+def test_unaligned_shapes_fall_back():
+    # lane-unaligned K and odd row counts must route to the XLA path
+    x = jax.random.normal(jax.random.key(3), (7, 100), jnp.float32)
+    q0, s0 = quantize_rowwise(x, -1)
+    q1, s1 = quantize_rowwise_fast(x, -1, interpret=True)
+    np.testing.assert_array_equal(np.asarray(q0), np.asarray(q1))
+    np.testing.assert_array_equal(np.asarray(s0), np.asarray(s1))
