@@ -110,6 +110,7 @@ struct Table {
   std::string spill_path;
   std::FILE* spill_f = nullptr;
   std::unordered_map<int64_t, uint64_t> disk_index;  // key -> offset
+  std::vector<uint64_t> free_slots;  // reusable record offsets
   std::list<int64_t> lru;  // front = most recently used
   std::unordered_map<int64_t, std::list<int64_t>::iterator> lru_pos;
 
@@ -118,7 +119,12 @@ struct Table {
   std::unordered_map<int64_t, std::vector<int64_t>> adj;
 
   ~Table() {
-    if (spill_f) std::fclose(spill_f);
+    if (spill_f) {
+      std::fclose(spill_f);
+      // the spill file is a cache keyed by the in-memory disk_index —
+      // meaningless after the table dies; don't orphan GBs in /tmp
+      if (!spill_path.empty()) std::remove(spill_path.c_str());
+    }
   }
 
   size_t rec_floats() const {
@@ -155,17 +161,33 @@ struct Table {
         if (ai != accum.end())
           std::memcpy(rec.data() + dim, ai->second.data(), dim * 4);
       }
-      std::fseek(spill_f, 0, SEEK_END);
-      uint64_t off = static_cast<uint64_t>(std::ftell(spill_f));
-      if (std::fwrite(rec.data(), 4, rec.size(), spill_f) !=
-          rec.size()) {
+      // records are fixed-size: reuse a freed slot, else append — the
+      // file is bounded by the high-water mark of cold rows, not total
+      // eviction count. Invariant: a key in `rows` is never also in
+      // `disk_index` (fetch_from_disk frees the slot on promotion),
+      // so the victim has no record of its own to overwrite.
+      uint64_t off;
+      bool from_free = false;
+      if (!free_slots.empty()) {
+        off = free_slots.back();
+        free_slots.pop_back();
+        from_free = true;
+      } else {
+        std::fseek(spill_f, 0, SEEK_END);
+        off = static_cast<uint64_t>(std::ftell(spill_f));
+      }
+      if (std::fseek(spill_f, static_cast<long>(off), SEEK_SET) ||
+          std::fwrite(rec.data(), 4, rec.size(), spill_f) !=
+              rec.size()) {
         // spill device full/broken: KEEP the row in memory (exceeding
         // the budget beats silently resetting trained parameters) and
-        // stop evicting this round
+        // stop evicting this round. A partially-written slot is only
+        // ever indexed after a later FULL write, so it stays unread.
+        if (from_free) free_slots.push_back(off);
         touch(victim);
         break;
       }
-      disk_index[victim] = off;  // supersedes any older record
+      disk_index[victim] = off;
       rows.erase(rit);
       accum.erase(victim);
     }
@@ -191,6 +213,10 @@ struct Table {
       std::memcpy(a.data(), rec.data() + dim, dim * 4);
       accum.emplace(key, std::move(a));
     }
+    // the in-memory row now owns the state; recycle the disk slot
+    auto di = disk_index.find(key);
+    free_slots.push_back(di->second);
+    disk_index.erase(di);
     return true;
   }
 
@@ -206,6 +232,7 @@ struct Table {
     lru.clear();
     lru_pos.clear();
     disk_index.clear();
+    free_slots.clear();
     if (spill_f) {
       std::fclose(spill_f);
       spill_f = nullptr;
@@ -331,7 +358,14 @@ bool PsServer::save(const std::string& path) {
       std::vector<float> rec(t->rec_floats());
       for (auto& kv : t->disk_index) {
         if (t->rows.find(kv.first) != t->rows.end()) continue;
-        if (!t->read_spilled(kv.first, rec.data())) continue;
+        if (!t->read_spilled(kv.first, rec.data())) {
+          // a skipped row would desync the nrows header written above
+          // and shift every later table's bytes — fail the save LOUDLY
+          // instead of writing a corrupt checkpoint
+          std::fclose(f);
+          std::remove(path.c_str());
+          return false;
+        }
         std::fwrite(&kv.first, 8, 1, f);
         std::fwrite(rec.data(), 4, t->dim, f);
         uint8_t has_acc = t->opt == 1;
@@ -683,12 +717,16 @@ void PsServer::handle_conn(int fd) {
         uint32_t k = 0;
         uint64_t sseed = 0;
         io_ok = io_ok && recv_all(fd, &k, 4) && recv_all(fd, &sseed, 8);
+        if (!io_ok) break;
         // bound the RESPONSE allocation too: n and k individually in
         // range can still multiply into an OOM that would terminate
-        // the detached handler thread (and with it the whole server)
-        if (!io_ok || k > (1u << 20) ||
+        // the detached handler thread (and with it the whole server).
+        // The payload is fully consumed at this point, so reply
+        // status 1 and KEEP the connection in protocol sync (same
+        // rule as the PUSH handler above)
+        if (k > (1u << 20) ||
             n * static_cast<uint64_t>(k) > (1ull << 27)) {
-          io_ok = false;
+          status = 1;
           break;
         }
         Table* t = table(table_id);

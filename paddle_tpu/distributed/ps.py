@@ -158,6 +158,7 @@ class PsClient:
         # table_id -> row dim, registered by create_sparse_table; needed
         # to size pull buffers (per-connection, NOT shared across clients)
         self._table_dims: Dict[int, int] = {}
+        self._tmp_spills: list = []  # mkstemp'd spill paths we own
         self._h = lib.psc_connect(host.encode(), port,
                                   int(timeout_s * 1000))
         if not self._h:
@@ -173,6 +174,15 @@ class PsClient:
             if self._h:
                 self._lib.psc_close(self._h)
                 self._h = None
+            # unlinking only drops the NAME: a co-located server keeps
+            # its open fd (freed on its own fclose), a later reopen by
+            # the server recreates the path, and ~Table removes it
+            for p in self._tmp_spills:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            self._tmp_spills = []
 
     # -- tables ------------------------------------------------------------
     def create_sparse_table(self, table_id: int, dim: int,
@@ -207,6 +217,7 @@ class PsClient:
             fd, spill_path = tempfile.mkstemp(
                 prefix=f"ps_spill_{table_id}_", suffix=".bin")
             os.close(fd)
+            self._tmp_spills.append(spill_path)
         with self._mu:
             rc = self._lib.psc_create_sparse_ssd(
                 self._handle(), table_id, dim, opt, lr, init_scale,
@@ -236,6 +247,13 @@ class PsClient:
         isolated nodes (reference common_graph_table.cc
         random_sample_neighbors)."""
         nodes = np.ascontiguousarray(nodes, dtype=np.int64).ravel()
+        # mirror the server's response-size bound BEFORE allocating:
+        # a co-located client must not OOM on the very request the
+        # server-side bound rejects
+        if k > (1 << 20) or nodes.size * k > (1 << 27):
+            raise ValueError(
+                f"sample response {nodes.size}x{k} exceeds the "
+                f"2^27-element bound; batch the nodes")
         out = np.empty((nodes.size, k), np.int64)
         with self._mu:
             rc = self._lib.psc_graph_sample(

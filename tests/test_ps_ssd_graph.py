@@ -143,3 +143,71 @@ def test_budget_reapplied_after_restore(ps, tmp_path):
     np.testing.assert_array_equal(got,
                                   np.full((n, dim), -1.0, np.float32))
     assert cli.num_keys(701) == n
+
+
+def test_spill_file_bounded_under_churn(ps):
+    """Re-evicting the same keys must REUSE disk slots (fixed-size
+    records), not append forever: the spill file is bounded by the
+    cold-row high-water mark, not by total eviction count."""
+    srv, cli, tmp = ps
+    dim, n, budget = 4, 64, 8
+    path = tmp / "churn.bin"
+    cli.create_sparse_ssd_table(401, dim, "sgd", lr=0.1,
+                                init_scale=0.0, mem_budget_rows=budget,
+                                spill_path=str(path))
+    keys = np.arange(n, dtype=np.int64)
+    rng = np.random.RandomState(0)
+    for _ in range(25):  # ~25x full churn of the working set
+        order = rng.permutation(n)
+        grads = rng.randn(n, dim).astype(np.float32)
+        for idx in np.array_split(order, 8):
+            cli.push_sparse(401, keys[idx], grads[idx])
+    rec_bytes = dim * 4  # sgd: weights only
+    # every key cold at once is the worst case; allow slack for the
+    # rows that are hot at the moment of each eviction decision
+    assert path.stat().st_size <= (n + budget) * rec_bytes, \
+        f"spill file grew to {path.stat().st_size} bytes"
+    assert cli.num_keys(401) == n
+
+
+def test_graph_sample_oversize_request_keeps_connection(ps):
+    """An n*k response larger than the server's allocation bound must
+    come back as a status error on a LIVE connection (payload already
+    consumed), not kill the socket."""
+    srv, cli, tmp = ps
+    g = GraphTable(cli, table_id=402)
+    g.add_edges([1, 1], [2, 3])
+    # client mirrors the bound BEFORE allocating the n*k buffer
+    with pytest.raises(ValueError):
+        cli.graph_sample_neighbors(402, np.arange(1 << 10),
+                                   k=1 << 18)  # n*k = 2^28 > 2^27
+    # server-side bound: raw call past the client check. The server
+    # replies status=1 with NO payload, so the small out buffer is safe
+    import ctypes
+    nodes = np.arange(1 << 10, dtype=np.int64)
+    out = np.empty(1, np.int64)
+    rc = cli._lib.psc_graph_sample(
+        cli._handle(), 402,
+        nodes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        nodes.size, 1 << 18, 0,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    assert rc != 0
+    # same client handle must still work after both rejections
+    out = cli.graph_sample_neighbors(402, np.asarray([1]), k=4)
+    assert set(out.ravel().tolist()) <= {2, 3}
+
+
+def test_tmp_spill_paths_cleaned_on_close():
+    """Client-default (mkstemp) spill paths must not be orphaned."""
+    import glob
+    srv = PsServer()
+    cli = PsClient(port=srv.port)
+    cli.create_sparse_ssd_table(403, 4, "sgd", mem_budget_rows=2,
+                                init_scale=0.0)
+    spills = list(cli._tmp_spills)
+    assert spills and all(os.path.exists(p) for p in spills)
+    keys = np.arange(32, dtype=np.int64)
+    cli.push_sparse(403, keys, np.ones((32, 4), np.float32))
+    cli.close()
+    srv.stop()
+    assert all(not os.path.exists(p) for p in spills)
