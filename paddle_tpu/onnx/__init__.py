@@ -1,19 +1,116 @@
-"""ONNX export stub (reference: python/paddle/onnx/export.py — a thin
-delegation to the external paddle2onnx package).
+"""paddle.onnx.export analog — a native jaxpr -> ONNX exporter.
 
-TPU-native: the first-class interchange format here is StableHLO
-(paddle_tpu.jit.save / paddle_tpu.inference export that portable bytecode);
-ONNX export delegates to an optional converter package if present."""
+Reference: python/paddle/onnx/export.py (a thin delegation to the
+external paddle2onnx package, which translates the static Program
+op-by-op). Here the model traces to a jaxpr and `convert.py` lowers
+each primitive to ONNX ops; weights become initializers; the protobuf
+is serialized by `proto.py` (no onnx/protobuf dependency — field
+numbers cross-validated against the descriptor embedded in libtorch).
+
+Covers inference graphs (conv/pool/matmul/normalization/activations/
+reshape ops — the vision zoo exports end to end); training steps and
+control-flow graphs should use paddle_tpu.jit.save (StableHLO), the
+first-class interchange format of this framework.
+"""
 from __future__ import annotations
+
+import numpy as np
 
 __all__ = ["export"]
 
 
-def export(layer, path, input_spec=None, opset_version=9, **configs):
+def export(layer, path, input_spec=None, opset_version=13, **configs):
+    """Trace ``layer`` (nn.Layer or callable on Tensors) with
+    ``input_spec`` and write ``<path>.onnx`` (the reference appends
+    the suffix the same way). Returns the written path.
+
+    ``input_spec``: list of InputSpec (None dims export as symbolic
+    dim_params and trace at size 2) or example Tensors/ndarrays.
+    """
+    import jax
+
+    from ..framework.tensor import Tensor
+    from ..jit.static_function import InputSpec
+    from .convert import jaxpr_to_model
+
+    if input_spec is None:
+        raise ValueError("onnx.export requires input_spec")
+    if opset_version < 13:
+        raise ValueError(
+            f"opset_version={opset_version}: this exporter emits "
+            f"opset-13 op forms (axes-as-input ReduceSum/Unsqueeze/"
+            f"Squeeze, input-form Slice/Clip); pass >= 13")
+
+    # each symbolic (None) dim traces at its OWN distinctive prime so
+    # the converter can recognize the sizes inside static shape params
+    # (by divisibility, for flatten-style products) and emit -1 /
+    # dim_params instead of baking traced sizes. Distinct primes keep
+    # independent dynamic dims independent.
+    PRIMES = [1867, 2003, 2129, 2213, 2339, 2459, 2579, 2693]
+    prime_iter = iter(PRIMES)
+    used_primes = []
+    example = []
+    dims = []
+    names = []
+    for i, spec in enumerate(input_spec):
+        if isinstance(spec, InputSpec):
+            shape = []
+            declared = []
+            for d in spec.shape:
+                if d is None:
+                    try:
+                        p = next(prime_iter)
+                    except StopIteration:
+                        raise ValueError("too many dynamic dims (>8)")
+                    used_primes.append(p)
+                    shape.append(p)
+                    declared.append(f"dyn_{p}")
+                else:
+                    shape.append(int(d))
+                    declared.append(int(d))
+            example.append(np.zeros(shape, np.dtype(spec.dtype)))
+            names.append(spec.name or f"input_{i}")
+        else:
+            arr = spec.numpy() if isinstance(spec, Tensor) \
+                else np.asarray(spec)
+            example.append(arr)
+            declared = list(arr.shape)
+            names.append(f"input_{i}")
+        dims.append(declared)
+
+    from ..nn.layer_base import Layer
+    is_layer = isinstance(layer, Layer)
+    was_training = is_layer and layer.training
+    if is_layer:
+        layer.eval()
     try:
-        import paddle2onnx  # noqa: F401
-    except ImportError:
-        raise NotImplementedError(
-            "ONNX export requires the optional paddle2onnx converter, which "
-            "is not installed. Use paddle_tpu.jit.save(...) for StableHLO "
-            "export — the portable deployment format of this framework.")
+        def fn(*xs):
+            out = layer(*[Tensor(x) for x in xs])
+            return _unwrap(out)
+
+        closed = jax.make_jaxpr(fn)(*example)
+    finally:
+        if was_training:
+            layer.train()
+
+    data = jaxpr_to_model(
+        closed, names, dims,
+        graph_name=type(layer).__name__, opset=opset_version,
+        dynamic_sizes=tuple(used_primes))
+    out_path = str(path)
+    if not out_path.endswith(".onnx"):
+        out_path += ".onnx"
+    with open(out_path, "wb") as f:
+        f.write(data)
+    return out_path
+
+
+def _unwrap(out):
+    from ..framework.tensor import Tensor
+    if isinstance(out, Tensor):
+        return out._data
+    if isinstance(out, (list, tuple)):
+        return tuple(_unwrap(o) for o in out)
+    if isinstance(out, dict):
+        return {k: _unwrap(v) for k, v in out.items()}
+    return out

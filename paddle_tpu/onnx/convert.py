@@ -1,0 +1,689 @@
+"""jaxpr -> ONNX graph converter.
+
+Reference analog: paddle2onnx's program translator (the reference's
+python/paddle/onnx/export.py hands the static Program to the external
+paddle2onnx package, ~50k LoC of per-op converters). TPU-native: the
+model is traced to a jaxpr (the same IR everything else here uses) and
+each primitive lowers to ONNX ops. Weights arrive as jaxpr constants
+and become initializers. Higher-order primitives (pjit, custom_jvp,
+remat) are inlined; control-flow primitives (scan/while/cond) are
+rejected with a clear error — export inference graphs, not training
+steps.
+
+Op coverage targets the inference zoo: conv/pool/matmul/normalization/
+activations/reshapes. Anything unmapped raises NotImplementedError
+naming the primitive.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.extend import core as jcore
+
+from . import proto
+from .proto import Msg, node as pnode
+
+INT64_MIN = -(1 << 63) + 1
+
+
+class _Ctx:
+    def __init__(self, dynamic_sizes=()):
+        self.nodes: List[Msg] = []
+        self.initializers: List[Msg] = []
+        self.names: Dict[Any, str] = {}
+        self.n = 0
+        self.const_cache: Dict[Any, str] = {}
+        # trace-time sizes that stand in for symbolic dims (the export
+        # entry traces None dims at a distinctive prime so they can be
+        # recognized inside static shape parameters and emitted as -1)
+        self.dynamic_sizes = set(dynamic_sizes)
+        # names whose produced array is SMALLER than the aval claims:
+        # broadcast_in_dim defers its stretch to consumers' numpy-style
+        # broadcasting; non-broadcasting consumers call name_mat to
+        # materialize with an explicit Expand
+        self.deferred: Dict[str, tuple] = {}
+        self._materialized: Dict[str, str] = {}
+
+    def reshape_target(self, dims) -> List[int]:
+        """Static reshape target with dynamic placeholder sizes mapped
+        to -1. Placeholders are large primes, so a target dim that
+        CONTAINS a dynamic dim (e.g. flatten's batch*features) is
+        recognized by divisibility."""
+        out = []
+        subbed = 0
+        for d in dims:
+            d = int(d)
+            hits = [p for p in self.dynamic_sizes if d % p == 0]
+            if len(hits) > 1 or (hits and d // hits[0]
+                                 in self.dynamic_sizes):
+                raise NotImplementedError(
+                    "a Reshape merges two independent dynamic dims — "
+                    "fix one of them to a concrete size for export")
+            if hits:
+                if subbed:
+                    raise NotImplementedError(
+                        "Reshape with two dynamic target dims")
+                out.append(-1)
+                subbed += 1
+            else:
+                out.append(d)
+        return out
+
+    def fresh(self, hint: str) -> str:
+        self.n += 1
+        return f"{hint}_{self.n}"
+
+    def name_of(self, v) -> str:
+        if isinstance(v, jcore.Literal):
+            arr = np.asarray(v.val)
+            return self.const(arr, "lit")
+        return self.names[v]
+
+    def set_name(self, v, name: str):
+        self.names[v] = name
+
+    def const(self, arr, hint: str) -> str:
+        arr = np.asarray(arr)
+        # byte-exact dedup for small consts (shape vectors, scalars,
+        # norm stats); big weights dedup by object identity so the
+        # cache never holds a second copy of hundreds of MB
+        if arr.nbytes <= (1 << 16):
+            key = (arr.dtype.str, arr.shape, arr.tobytes())
+        else:
+            # the cache VALUE retains arr, so the id cannot be reused
+            # by a new object while this entry lives
+            key = (arr.dtype.str, arr.shape, id(arr))
+        got = self.const_cache.get(key)
+        if got is not None:
+            return got[0]
+        name = self.fresh(hint)
+        self.initializers.append(proto.tensor_proto(name, arr))
+        self.const_cache[key] = (name, arr)
+        return name
+
+    def i64(self, vals, hint="shape") -> str:
+        return self.const(np.asarray(vals, np.int64), hint)
+
+    def emit(self, op: str, ins: Sequence[str], outs: Sequence[str],
+             **attrs):
+        self.nodes.append(pnode(op, ins, outs,
+                                name=self.fresh(op.lower()), **attrs))
+
+    def emit1(self, op: str, ins: Sequence[str], hint=None, **attrs):
+        out = self.fresh(hint or op.lower())
+        self.emit(op, ins, [out], **attrs)
+        return out
+
+    def emit_identity(self, src: str, dst: str):
+        self.emit("Identity", [src], [dst])
+        if src in self.deferred:
+            self.deferred[dst] = self.deferred[src]
+
+    def name_mat(self, v) -> str:
+        """Like name_of, but guarantees the array has its full aval
+        shape (materializes a deferred broadcast with Expand)."""
+        nm = self.name_of(v)
+        shape = self.deferred.get(nm)
+        if shape is None:
+            return nm
+        got = self._materialized.get(nm)
+        if got is None:
+            got = self.emit1("Expand", [nm, self.i64(shape, "bshape")])
+            self._materialized[nm] = got
+        return got
+
+
+def _np_dtype(aval):
+    return np.dtype(aval.dtype) if str(aval.dtype) != "bfloat16" \
+        else aval.dtype
+
+
+def _onnx_dtype_of(aval) -> int:
+    return proto.onnx_dtype(aval.dtype)
+
+
+# ---------------------------------------------------------------------------
+# primitive handlers
+# ---------------------------------------------------------------------------
+PRIMS: Dict[str, Any] = {}
+
+
+def _prim(*names):
+    def deco(fn):
+        for n in names:
+            PRIMS[n] = fn
+        return fn
+    return deco
+
+
+def _binop(op):
+    def h(ctx, eqn):
+        a, b = (ctx.name_of(v) for v in eqn.invars)
+        ctx.emit(op, [a, b], [ctx.name_of(eqn.outvars[0])])
+    return h
+
+
+def _unop(op):
+    def h(ctx, eqn):
+        ctx.emit(op, [ctx.name_of(eqn.invars[0])],
+                 [ctx.name_of(eqn.outvars[0])])
+    return h
+
+
+for prim, op in [("add", "Add"), ("sub", "Sub"), ("mul", "Mul"),
+                 ("div", "Div"), ("max", "Max"), ("min", "Min"),
+                 ("pow", "Pow"), ("add_any", "Add"),
+                 ("and", "And"), ("or", "Or"), ("xor", "Xor"),
+                 ("eq", "Equal"), ("lt", "Less"), ("gt", "Greater"),
+                 ("le", "LessOrEqual"), ("ge", "GreaterOrEqual"),
+                 ("atan2", "Atan2")]:
+    PRIMS[prim] = _binop(op)
+
+for prim, op in [("exp", "Exp"), ("log", "Log"), ("tanh", "Tanh"),
+                 ("abs", "Abs"), ("neg", "Neg"), ("sqrt", "Sqrt"),
+                 ("sign", "Sign"), ("floor", "Floor"),
+                 ("ceil", "Ceil"), ("round_nearest_even", "Round"),
+                 ("logistic", "Sigmoid"), ("erf", "Erf"),
+                 ("sin", "Sin"), ("cos", "Cos"), ("not", "Not"),
+                 ("copy", "Identity"), ("stop_gradient", "Identity")]:
+    PRIMS[prim] = _unop(op)
+
+
+@_prim("ne")
+def _ne(ctx, eqn):
+    a, b = (ctx.name_of(v) for v in eqn.invars)
+    e = ctx.emit1("Equal", [a, b])
+    ctx.emit("Not", [e], [ctx.name_of(eqn.outvars[0])])
+
+
+@_prim("rsqrt")
+def _rsqrt(ctx, eqn):
+    s = ctx.emit1("Sqrt", [ctx.name_of(eqn.invars[0])])
+    ctx.emit("Reciprocal", [s], [ctx.name_of(eqn.outvars[0])])
+
+
+@_prim("square")
+def _square(ctx, eqn):
+    a = ctx.name_of(eqn.invars[0])
+    ctx.emit("Mul", [a, a], [ctx.name_of(eqn.outvars[0])])
+
+
+@_prim("log1p")
+def _log1p(ctx, eqn):
+    aval = eqn.invars[0].aval
+    one = ctx.const(np.ones((), _np_dtype(aval)), "one")
+    s = ctx.emit1("Add", [ctx.name_of(eqn.invars[0]), one])
+    ctx.emit("Log", [s], [ctx.name_of(eqn.outvars[0])])
+
+
+@_prim("expm1")
+def _expm1(ctx, eqn):
+    aval = eqn.invars[0].aval
+    one = ctx.const(np.ones((), _np_dtype(aval)), "one")
+    e = ctx.emit1("Exp", [ctx.name_of(eqn.invars[0])])
+    ctx.emit("Sub", [e, one], [ctx.name_of(eqn.outvars[0])])
+
+
+@_prim("erfc")
+def _erfc(ctx, eqn):
+    aval = eqn.invars[0].aval
+    one = ctx.const(np.ones((), _np_dtype(aval)), "one")
+    e = ctx.emit1("Erf", [ctx.name_of(eqn.invars[0])])
+    ctx.emit("Sub", [one, e], [ctx.name_of(eqn.outvars[0])])
+
+
+@_prim("integer_pow")
+def _integer_pow(ctx, eqn):
+    aval = eqn.invars[0].aval
+    y = ctx.const(np.asarray(eqn.params["y"], _np_dtype(aval)), "exp")
+    ctx.emit("Pow", [ctx.name_of(eqn.invars[0]), y],
+             [ctx.name_of(eqn.outvars[0])])
+
+
+@_prim("rem")
+def _rem(ctx, eqn):
+    a, b = (ctx.name_of(v) for v in eqn.invars)
+    ctx.emit("Mod", [a, b], [ctx.name_of(eqn.outvars[0])], fmod=1)
+
+
+@_prim("clamp")
+def _clamp(ctx, eqn):
+    lo, x, hi = (ctx.name_of(v) for v in eqn.invars)
+    ctx.emit("Clip", [x, lo, hi], [ctx.name_of(eqn.outvars[0])])
+
+
+@_prim("select_n")
+def _select_n(ctx, eqn):
+    if len(eqn.invars) != 3:
+        raise NotImplementedError("select_n with >2 cases")
+    which, f, t = (ctx.name_of(v) for v in eqn.invars)
+    # select_n picks cases[which]: which=True -> second case
+    ctx.emit("Where", [which, t, f], [ctx.name_of(eqn.outvars[0])])
+
+
+@_prim("convert_element_type")
+def _convert(ctx, eqn):
+    to = proto.onnx_dtype(eqn.params["new_dtype"])
+    ctx.emit("Cast", [ctx.name_of(eqn.invars[0])],
+             [ctx.name_of(eqn.outvars[0])], to=to)
+
+
+@_prim("reshape")
+def _reshape(ctx, eqn):
+    x = ctx.name_mat(eqn.invars[0])
+    if eqn.params.get("dimensions") is not None:
+        x = ctx.emit1("Transpose", [x],
+                      perm=list(eqn.params["dimensions"]))
+    shape = ctx.i64(ctx.reshape_target(eqn.params["new_sizes"]))
+    ctx.emit("Reshape", [x, shape], [ctx.name_of(eqn.outvars[0])])
+
+
+@_prim("transpose")
+def _transpose(ctx, eqn):
+    ctx.emit("Transpose", [ctx.name_mat(eqn.invars[0])],
+             [ctx.name_of(eqn.outvars[0])],
+             perm=list(eqn.params["permutation"]))
+
+
+@_prim("broadcast_in_dim")
+def _broadcast(ctx, eqn):
+    # rank promotion as Unsqueeze (shape-agnostic: no baked batch
+    # sizes); the size-1 stretch itself is DEFERRED to the consumer's
+    # numpy-style ONNX broadcasting (Add/Mul/Where/MatMul... all
+    # broadcast). A consumer that does not broadcast (Concat) would
+    # need an explicit Expand — the evaluator-backed tests own that.
+    x = ctx.name_of(eqn.invars[0])
+    shape = eqn.params["shape"]
+    bdims = eqn.params["broadcast_dimensions"]
+    in_shape = eqn.invars[0].aval.shape
+    insert = [d for d in range(len(shape)) if d not in bdims]
+    # an input dim can also be MOVED (bdims not ascending is illegal in
+    # lax, so positions are ascending — Unsqueeze composes correctly)
+    if insert:
+        x = ctx.emit1("Unsqueeze", [x, ctx.i64(insert, "axes")])
+    out = ctx.name_of(eqn.outvars[0])
+    ctx.emit("Identity", [x], [out])
+    interim = [1] * len(shape)
+    for i, d in enumerate(bdims):
+        interim[d] = in_shape[i]
+    if tuple(interim) != tuple(shape):
+        # register the pending stretch so non-broadcasting consumers
+        # (Reshape/Concat/reduce/MatMul/outputs) materialize it
+        ctx.deferred[out] = tuple(shape)
+
+
+@_prim("concatenate")
+def _concat(ctx, eqn):
+    ctx.emit("Concat", [ctx.name_mat(v) for v in eqn.invars],
+             [ctx.name_of(eqn.outvars[0])],
+             axis=int(eqn.params["dimension"]))
+
+
+@_prim("slice")
+def _slice(ctx, eqn):
+    p = eqn.params
+    nd = len(p["start_indices"])
+    strides = p["strides"] or (1,) * nd
+    ctx.emit("Slice",
+             [ctx.name_mat(eqn.invars[0]),
+              ctx.i64(p["start_indices"], "starts"),
+              ctx.i64(p["limit_indices"], "ends"),
+              ctx.i64(range(nd), "axes"),
+              ctx.i64(strides, "steps")],
+             [ctx.name_of(eqn.outvars[0])])
+
+
+@_prim("rev")
+def _rev(ctx, eqn):
+    dims = list(eqn.params["dimensions"])
+    ctx.emit("Slice",
+             [ctx.name_mat(eqn.invars[0]),
+              ctx.i64([-1] * len(dims), "starts"),
+              ctx.i64([INT64_MIN] * len(dims), "ends"),
+              ctx.i64(dims, "axes"),
+              ctx.i64([-1] * len(dims), "steps")],
+             [ctx.name_of(eqn.outvars[0])])
+
+
+@_prim("pad")
+def _pad(ctx, eqn):
+    cfg = eqn.params["padding_config"]
+    if any(i != 0 for _, _, i in cfg):
+        raise NotImplementedError("interior (dilation) padding")
+    if any(lo < 0 or hi < 0 for lo, hi, _ in cfg):
+        raise NotImplementedError("negative padding")
+    pads = [lo for lo, _, _ in cfg] + [hi for _, hi, _ in cfg]
+    ctx.emit("Pad",
+             [ctx.name_mat(eqn.invars[0]), ctx.i64(pads, "pads"),
+              ctx.name_of(eqn.invars[1])],
+             [ctx.name_of(eqn.outvars[0])])
+
+
+@_prim("iota")
+def _iota(ctx, eqn):
+    p = eqn.params
+    arr = np.asarray(
+        jax.lax.iota(p["dtype"], int(np.prod(p["shape"])))
+        if len(p["shape"]) == 1 else
+        jax.lax.broadcasted_iota(p["dtype"], p["shape"], p["dimension"]))
+    ctx.emit("Identity", [ctx.const(arr, "iota")],
+             [ctx.name_of(eqn.outvars[0])])
+
+
+def _reduce(op, axes_as_input):
+    def h(ctx, eqn):
+        axes = list(eqn.params["axes"])
+        x = ctx.name_mat(eqn.invars[0])
+        out = ctx.name_of(eqn.outvars[0])
+        if axes_as_input:  # ReduceSum since opset 13
+            ctx.emit(op, [x, ctx.i64(axes, "axes")], [out], keepdims=0)
+        else:
+            ctx.emit(op, [x], [out], axes=axes, keepdims=0)
+    return h
+
+
+PRIMS["reduce_sum"] = _reduce("ReduceSum", True)
+PRIMS["reduce_max"] = _reduce("ReduceMax", False)
+PRIMS["reduce_min"] = _reduce("ReduceMin", False)
+PRIMS["reduce_prod"] = _reduce("ReduceProd", False)
+
+
+@_prim("argmax", "argmin")
+def _argmax(ctx, eqn):
+    op = "ArgMax" if eqn.primitive.name == "argmax" else "ArgMin"
+    axes = eqn.params["axes"]
+    if len(axes) != 1:
+        raise NotImplementedError(f"{op} over multiple axes")
+    a = ctx.emit1(op, [ctx.name_mat(eqn.invars[0])],
+                  axis=int(axes[0]), keepdims=0)
+    ctx.emit("Cast", [a], [ctx.name_of(eqn.outvars[0])],
+             to=_onnx_dtype_of(eqn.outvars[0].aval))
+
+
+@_prim("cumsum")
+def _cumsum(ctx, eqn):
+    ax = ctx.const(np.asarray(eqn.params["axis"], np.int64), "axis")
+    if eqn.params.get("reverse"):
+        raise NotImplementedError("reverse cumsum")
+    ctx.emit("CumSum", [ctx.name_mat(eqn.invars[0]), ax],
+             [ctx.name_of(eqn.outvars[0])])
+
+
+@_prim("dot_general")
+def _dot_general(ctx, eqn):
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    lhs, rhs = eqn.invars
+    ls, rs = lhs.aval.shape, rhs.aval.shape
+    nl, nr = len(ls), len(rs)
+    lfree = [d for d in range(nl) if d not in lc and d not in lb]
+    rfree = [d for d in range(nr) if d not in rc and d not in rb]
+    nb = len(lb)
+
+    # Fast path: ONNX MatMul has numpy @ semantics — [..., m, k] @
+    # [k, n] and leading-batch [..B.., m, k] @ [..B.., k, n] both map
+    # directly, with NO reshapes (keeps symbolic batch dims symbolic).
+    std = (tuple(lb) == tuple(range(nb))
+           and tuple(rb) == tuple(range(nb))
+           and tuple(lc) == (nl - 1,)
+           and tuple(rc) == (nb,)
+           and lfree == list(range(nb, nl - 1))
+           and rfree == list(range(nb + 1, nr))
+           and (nb == 0 and nr == 2 or nb > 0))
+    ln, rn = ctx.name_mat(lhs), ctx.name_mat(rhs)
+    out_aval = eqn.outvars[0].aval
+    if std and nl >= 2:
+        final = ctx.emit1("MatMul", [ln, rn])
+    else:
+        def prep(name, shape, batch, free, contract, contract_first):
+            order = list(batch) + (list(contract) + list(free)
+                                   if contract_first
+                                   else list(free) + list(contract))
+            if order != list(range(len(shape))):
+                name = ctx.emit1("Transpose", [name], perm=order)
+            b = int(np.prod([shape[d] for d in batch])) if batch \
+                else None
+            f = int(np.prod([shape[d] for d in free])) if free else 1
+            c = int(np.prod([shape[d] for d in contract]))
+            tgt = ([b] if b is not None else []) + \
+                ([c, f] if contract_first else [f, c])
+            return ctx.emit1(
+                "Reshape", [name, ctx.i64(ctx.reshape_target(tgt))])
+
+        a = prep(ln, ls, lb, lfree, lc, False)
+        b = prep(rn, rs, rb, rfree, rc, True)
+        mm = ctx.emit1("MatMul", [a, b])
+        final = ctx.emit1(
+            "Reshape", [mm, ctx.i64(ctx.reshape_target(out_aval.shape))])
+    if jnp.dtype(out_aval.dtype) != jnp.dtype(lhs.aval.dtype):
+        final = ctx.emit1("Cast", [final],
+                          to=_onnx_dtype_of(out_aval))
+    ctx.emit("Identity", [final], [ctx.name_of(eqn.outvars[0])])
+
+
+@_prim("conv_general_dilated")
+def _conv(ctx, eqn):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    lhs_spec, rhs_spec, out_spec = dn.lhs_spec, dn.rhs_spec, dn.out_spec
+    if any(d != 1 for d in p["lhs_dilation"]):
+        raise NotImplementedError("transposed conv (lhs_dilation)")
+    if p.get("batch_group_count", 1) != 1:
+        raise NotImplementedError("batch_group_count")
+    x = ctx.name_mat(eqn.invars[0])
+    w = ctx.name_mat(eqn.invars[1])
+    nsp = len(lhs_spec) - 2
+    # to NCHW / OIHW
+    if list(lhs_spec) != list(range(nsp + 2)):
+        x = ctx.emit1("Transpose", [x], perm=list(lhs_spec))
+    if list(rhs_spec) != list(range(nsp + 2)):
+        w = ctx.emit1("Transpose", [w], perm=list(rhs_spec))
+    pads = [lo for lo, _ in p["padding"]] + \
+        [hi for _, hi in p["padding"]]
+    y = ctx.emit1("Conv", [x, w],
+                  strides=list(p["window_strides"]),
+                  pads=pads,
+                  dilations=list(p["rhs_dilation"]),
+                  group=int(p["feature_group_count"]))
+    # from NCHW to out_spec
+    inv = [0] * (nsp + 2)
+    for logical, physical in enumerate(out_spec):
+        inv[physical] = logical
+    if inv != list(range(nsp + 2)):
+        y = ctx.emit1("Transpose", [y], perm=inv)
+    ctx.emit("Identity", [y], [ctx.name_of(eqn.outvars[0])])
+
+
+def _pool(ctx, eqn, op, extra_attrs):
+    p = eqn.params
+    wd = list(p["window_dimensions"])
+    ws = list(p["window_strides"])
+    pad = list(p["padding"])
+    if any(d != 1 for d in p.get("base_dilation", (1,) * len(wd))) or \
+            any(d != 1 for d in p.get("window_dilation",
+                                      (1,) * len(wd))):
+        raise NotImplementedError("dilated pooling")
+    spatial = [i for i, d in enumerate(wd) if d != 1 or ws[i] != 1
+               or pad[i] != (0, 0)]
+    if not spatial:
+        # degenerate 1x1 window (e.g. adaptive pool when the input is
+        # already the target size): the reduction is an identity
+        return ctx.name_mat(eqn.invars[0]), [1]
+    passive = [i for i in range(len(wd)) if i not in spatial]
+    if len(passive) != 2:
+        raise NotImplementedError(f"pool layout wd={wd}")
+    x = ctx.name_mat(eqn.invars[0])
+    order = passive + spatial  # -> NC + spatial
+    if order != list(range(len(wd))):
+        x = ctx.emit1("Transpose", [x], perm=order)
+    pads = [pad[i][0] for i in spatial] + [pad[i][1] for i in spatial]
+    y = ctx.emit1(op, [x],
+                  kernel_shape=[wd[i] for i in spatial],
+                  strides=[ws[i] for i in spatial],
+                  pads=pads, **extra_attrs)
+    inv = [0] * len(order)
+    for a, b in enumerate(order):
+        inv[b] = a
+    if inv != list(range(len(wd))):
+        y = ctx.emit1("Transpose", [y], perm=inv)
+    return y, [wd[i] for i in spatial]
+
+
+@_prim("reduce_window_max")
+def _maxpool(ctx, eqn):
+    y, _ = _pool(ctx, eqn, "MaxPool", {})
+    ctx.emit("Identity", [y], [ctx.name_of(eqn.outvars[0])])
+
+
+@_prim("reduce_window_sum")
+def _sumpool(ctx, eqn):
+    y, kshape = _pool(ctx, eqn, "AveragePool",
+                      {"count_include_pad": 1})
+    scale = ctx.const(
+        np.asarray(np.prod(kshape),
+                   _np_dtype(eqn.invars[0].aval)), "winsz")
+    ctx.emit("Mul", [y, scale], [ctx.name_of(eqn.outvars[0])])
+
+
+@_prim("gather")
+def _gather(ctx, eqn):
+    # embedding-style take along axis 0: operand [V, ...], int indices
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    operand, indices = eqn.invars
+    oshape = operand.aval.shape
+    ishape = indices.aval.shape
+    ss = tuple(p["slice_sizes"])
+    if (tuple(dn.start_index_map) == (0,)
+            and tuple(dn.collapsed_slice_dims) == (0,)
+            and ss == (1,) + tuple(oshape[1:])
+            and ishape and ishape[-1] == 1):
+        idx = ctx.emit1(
+            "Squeeze",
+            [ctx.name_mat(indices),
+             ctx.i64([len(ishape) - 1], "axes")])
+        idx64 = ctx.emit1("Cast", [idx], to=proto.INT64)
+        ctx.emit("Gather", [ctx.name_mat(operand), idx64],
+                 [ctx.name_of(eqn.outvars[0])], axis=0)
+        return
+    raise NotImplementedError(
+        "general gather (only embedding-style take is exported)")
+
+
+@_prim("dynamic_slice")
+def _dynamic_slice(ctx, eqn):
+    x = eqn.invars[0]
+    starts = eqn.invars[1:]
+    sizes = eqn.params["slice_sizes"]
+    nd = len(sizes)
+    parts = []
+    for s in starts:
+        c = ctx.emit1("Cast", [ctx.name_of(s)], to=proto.INT64)
+        parts.append(ctx.emit1(
+            "Reshape", [c, ctx.i64([1], "one")]))
+    start_cat = ctx.emit1("Concat", parts, axis=0)
+    # lax.dynamic_slice CLAMPS the start so the output keeps its full
+    # size; ONNX Slice clamps the END and would SHRINK the output —
+    # clamp starts to [0, dim - size] first (static dims from the aval)
+    maxs = [int(d) - int(s) for d, s in zip(x.aval.shape, sizes)]
+    start_cl = ctx.emit1(
+        "Clip", [start_cat, ctx.i64([0] * nd, "zero"),
+                 ctx.i64(maxs, "maxstart")])
+    ends = ctx.emit1("Add", [start_cl, ctx.i64(sizes, "sizes")])
+    ctx.emit("Slice",
+             [ctx.name_mat(x), start_cl, ends,
+              ctx.i64(range(nd), "axes")],
+             [ctx.name_of(eqn.outvars[0])])
+
+
+# higher-order primitives: inline the inner jaxpr
+def _inline(ctx, inner_closed, invals, outvars):
+    inner = inner_closed.jaxpr
+    for cv, cval in zip(inner.constvars, inner_closed.consts):
+        ctx.set_name(cv, ctx.const(np.asarray(cval), "const"))
+    for iv, nm in zip(inner.invars, invals):
+        ctx.set_name(iv, nm)
+    _convert_eqns(ctx, inner)
+    for ov, outer in zip(inner.outvars, outvars):
+        ctx.emit_identity(ctx.name_of(ov), ctx.name_of(outer))
+
+
+@_prim("pjit", "jit", "closed_call", "core_call", "xla_call")
+def _pjit(ctx, eqn):
+    _inline(ctx, eqn.params["jaxpr"],
+            [ctx.name_of(v) for v in eqn.invars], eqn.outvars)
+
+
+@_prim("custom_jvp_call", "custom_vjp_call", "custom_vjp_call_jaxpr",
+       "custom_jvp_call_jaxpr")
+def _custom_call(ctx, eqn):
+    inner = eqn.params.get("call_jaxpr") or eqn.params.get("fun_jaxpr")
+    if inner is None:
+        raise NotImplementedError(
+            f"{eqn.primitive.name} without call_jaxpr")
+    _inline(ctx, inner, [ctx.name_of(v) for v in eqn.invars],
+            eqn.outvars)
+
+
+@_prim("remat", "checkpoint", "remat2")
+def _remat(ctx, eqn):
+    inner = eqn.params["jaxpr"]
+    closed = jcore.ClosedJaxpr(inner, ())
+    _inline(ctx, closed, [ctx.name_of(v) for v in eqn.invars],
+            eqn.outvars)
+
+
+def _convert_eqns(ctx: _Ctx, jaxpr):
+    for eqn in jaxpr.eqns:
+        for ov in eqn.outvars:
+            if ov not in ctx.names:
+                ctx.set_name(ov, ctx.fresh("v"))
+        h = PRIMS.get(eqn.primitive.name)
+        if h is None:
+            raise NotImplementedError(
+                f"no ONNX lowering for primitive "
+                f"'{eqn.primitive.name}' — this exporter covers "
+                f"inference graphs (conv/pool/matmul/elementwise); "
+                f"use paddle_tpu.jit.save for StableHLO export of "
+                f"anything else")
+        h(ctx, eqn)
+
+
+def jaxpr_to_model(closed_jaxpr, input_names: Sequence[str],
+                   input_dims: Sequence[Sequence],
+                   graph_name: str = "paddle_tpu",
+                   opset: int = 13,
+                   dynamic_sizes: Sequence[int] = ()) -> bytes:
+    """Convert a ClosedJaxpr to serialized ONNX ModelProto bytes.
+
+    input_dims entries may contain strings (symbolic dim_params) in
+    place of ints — declared in the ValueInfo, and when the symbolic
+    dim was traced at a size from ``dynamic_sizes``, occurrences of
+    that size inside Reshape targets are emitted as -1 so the graph
+    stays batch-size agnostic."""
+    jaxpr = closed_jaxpr.jaxpr
+    ctx = _Ctx(dynamic_sizes=dynamic_sizes)
+    for cv, cval in zip(jaxpr.constvars, closed_jaxpr.consts):
+        ctx.set_name(cv, ctx.const(np.asarray(cval), "w"))
+    inputs = []
+    for iv, nm, dims in zip(jaxpr.invars, input_names, input_dims):
+        ctx.set_name(iv, nm)
+        inputs.append(proto.value_info(
+            nm, _onnx_dtype_of(iv.aval), dims))
+    _convert_eqns(ctx, jaxpr)
+    outputs = []
+    dyn = {s: f"dyn_{s}" for s in ctx.dynamic_sizes}
+    for i, ov in enumerate(jaxpr.outvars):
+        nm = f"output_{i}"
+        # outputs must carry their full aval shape (materialize any
+        # deferred broadcast), declared with symbolic dims where the
+        # traced placeholder size appears
+        ctx.emit("Identity", [ctx.name_mat(ov)], [nm])
+        outputs.append(proto.value_info(
+            nm, _onnx_dtype_of(ov.aval),
+            [dyn.get(int(d), int(d)) for d in ov.aval.shape]))
+    g = proto.graph(ctx.nodes, graph_name, inputs, outputs,
+                    ctx.initializers)
+    return proto.model(g, opset=opset)

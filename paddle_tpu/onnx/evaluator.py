@@ -1,0 +1,206 @@
+"""Reference evaluator for exported ONNX models (numpy).
+
+This environment has no onnxruntime; this evaluator executes the op
+subset `convert.py` emits so exports can be validated numerically
+in-repo (tests compare against the eager paddle forward). It reads the
+decoded proto from `proto.load`, so a test run exercises writer →
+reader → semantics end to end.
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+import numpy as np
+
+from .proto import DecodedModel, ONNX2NP, BFLOAT16
+
+
+def _cast(arr, onnx_type):
+    if onnx_type == BFLOAT16:
+        # numpy has no bfloat16: evaluate in float32 (values identical
+        # up to bf16 rounding, which the tolerance owns)
+        return arr.astype(np.float32)
+    return arr.astype(ONNX2NP[onnx_type])
+
+
+def _conv(x, w, strides, pads, dilations, group):
+    n, c, *ispatial = x.shape
+    o, cg, *kspatial = w.shape
+    nd = len(ispatial)
+    pad_width = [(0, 0), (0, 0)] + [
+        (pads[i], pads[nd + i]) for i in range(nd)]
+    x = np.pad(x, pad_width)
+    out_sp = [
+        (x.shape[2 + i] - (dilations[i] * (kspatial[i] - 1) + 1))
+        // strides[i] + 1 for i in range(nd)]
+    y = np.zeros([n, o] + out_sp, np.float32)
+    og = o // group
+    for g in range(group):
+        xs = x[:, g * cg:(g + 1) * cg]
+        for oi in range(og):
+            ko = g * og + oi
+            acc = np.zeros([n] + out_sp, np.float32)
+            for idx in np.ndindex(*kspatial):
+                sl = tuple(
+                    slice(idx[i] * dilations[i],
+                          idx[i] * dilations[i]
+                          + out_sp[i] * strides[i],
+                          strides[i]) for i in range(nd))
+                patch = xs[(slice(None), slice(None)) + sl]
+                acc += np.einsum("nc...,c->n...",
+                                 patch.astype(np.float32),
+                                 w[ko][(slice(None),) + idx]
+                                 .astype(np.float32))
+            y[:, ko] = acc
+    return y.astype(x.dtype)
+
+
+def _pool(x, kshape, strides, pads, mode):
+    n, c, *ispatial = x.shape
+    nd = len(kshape)
+    fill = -np.inf if mode == "max" else 0.0
+    pad_width = [(0, 0), (0, 0)] + [
+        (pads[i], pads[nd + i]) for i in range(nd)]
+    x = np.pad(x, pad_width, constant_values=fill)
+    out_sp = [(x.shape[2 + i] - kshape[i]) // strides[i] + 1
+              for i in range(nd)]
+    from numpy.lib.stride_tricks import sliding_window_view
+    win = sliding_window_view(x, kshape, axis=tuple(range(2, 2 + nd)))
+    sl = tuple(slice(None, out_sp[i] * strides[i], strides[i])
+               for i in range(nd))
+    win = win[(slice(None), slice(None)) + sl]
+    red = tuple(range(2 + nd, 2 + 2 * nd))
+    return (win.max(axis=red) if mode == "max"
+            else win.mean(axis=red, dtype=np.float32).astype(x.dtype))
+
+
+def run(model: DecodedModel,
+        feeds: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    g = model.graph
+    env: Dict[str, np.ndarray] = dict(g.initializers)
+    for vi in g.inputs:
+        if vi.name not in feeds:
+            raise ValueError(f"missing input {vi.name}")
+        env[vi.name] = np.asarray(feeds[vi.name])
+
+    for nd in g.nodes:
+        i = [env[x] for x in nd.inputs if x]
+        a = nd.attrs
+        op = nd.op_type
+        if op == "Identity":
+            r = i[0]
+        elif op in ("Add", "Sub", "Mul", "Div", "Pow"):
+            f = {"Add": np.add, "Sub": np.subtract,
+                 "Mul": np.multiply, "Div": np.divide,
+                 "Pow": np.power}[op]
+            r = f(i[0], i[1])
+            if i[0].dtype.kind in "fiu":
+                r = r.astype(np.result_type(i[0], i[1]))
+        elif op == "MatMul":
+            r = np.matmul(i[0].astype(np.float32),
+                          i[1].astype(np.float32)).astype(i[0].dtype) \
+                if i[0].dtype.kind == "f" else np.matmul(i[0], i[1])
+        elif op == "Max":
+            r = np.maximum(i[0], i[1])
+        elif op == "Min":
+            r = np.minimum(i[0], i[1])
+        elif op == "Cast":
+            r = _cast(i[0], a["to"])
+        elif op == "Reshape":
+            shape = [int(d) for d in i[1]]
+            r = i[0].reshape(shape)
+        elif op == "Transpose":
+            r = np.transpose(i[0], a["perm"])
+        elif op == "Expand":
+            r = np.broadcast_to(i[0], [int(d) for d in i[1]]).copy()
+        elif op == "Unsqueeze":
+            r = i[0]
+            for ax in sorted(int(d) for d in i[1]):
+                r = np.expand_dims(r, ax)
+        elif op == "Squeeze":
+            r = np.squeeze(i[0], tuple(int(d) for d in i[1])) \
+                if len(i) > 1 else np.squeeze(i[0])
+        elif op == "Concat":
+            r = np.concatenate(i, axis=a["axis"])
+        elif op == "Slice":
+            starts, ends = i[1], i[2]
+            axes = i[3] if len(i) > 3 else np.arange(len(starts))
+            steps = i[4] if len(i) > 4 else np.ones_like(starts)
+            sl = [slice(None)] * i[0].ndim
+            for s, e, ax, st in zip(starts, ends, axes, steps):
+                s, e, st = int(s), int(e), int(st)
+                lo = None if e <= -(1 << 62) and st < 0 else e
+                sl[int(ax)] = slice(s, lo, st)
+            r = i[0][tuple(sl)]
+        elif op == "Pad":
+            pads = [int(p) for p in i[1]]
+            nd_ = i[0].ndim
+            pw = [(pads[k], pads[nd_ + k]) for k in range(nd_)]
+            cv = i[2].item() if len(i) > 2 else 0.0
+            r = np.pad(i[0], pw, constant_values=cv)
+        elif op == "Conv":
+            r = _conv(i[0], i[1], a["strides"], a["pads"],
+                      a["dilations"], a.get("group", 1))
+        elif op == "MaxPool":
+            r = _pool(i[0], a["kernel_shape"], a["strides"],
+                      a["pads"], "max")
+        elif op == "AveragePool":
+            r = _pool(i[0], a["kernel_shape"], a["strides"],
+                      a["pads"], "avg")
+        elif op in ("ReduceSum", "ReduceMax", "ReduceMin",
+                    "ReduceProd"):
+            if op == "ReduceSum":
+                axes = tuple(int(x) for x in i[1])
+            else:
+                axes = tuple(a["axes"])
+            keep = bool(a.get("keepdims", 1))
+            f = {"ReduceSum": np.sum, "ReduceMax": np.max,
+                 "ReduceMin": np.min, "ReduceProd": np.prod}[op]
+            r = f(i[0], axis=axes, keepdims=keep)
+            if i[0].dtype.kind == "f":
+                r = r.astype(i[0].dtype)
+        elif op in ("ArgMax", "ArgMin"):
+            f = np.argmax if op == "ArgMax" else np.argmin
+            r = f(i[0], axis=a["axis"])
+            if a.get("keepdims", 1):
+                r = np.expand_dims(r, a["axis"])
+            r = r.astype(np.int64)
+        elif op == "CumSum":
+            r = np.cumsum(i[0], axis=int(i[1]))
+        elif op == "Gather":
+            r = np.take(i[0], i[1], axis=a.get("axis", 0))
+        elif op == "Where":
+            r = np.where(i[0], i[1], i[2])
+        elif op == "Clip":
+            r = np.clip(i[0], i[1], i[2])
+        elif op == "Mod":
+            r = np.fmod(i[0], i[1]) if a.get("fmod") else \
+                np.mod(i[0], i[1])
+        elif op in ("Exp", "Log", "Tanh", "Abs", "Neg", "Sqrt",
+                    "Sign", "Floor", "Ceil", "Round", "Sin", "Cos",
+                    "Erf", "Sigmoid", "Reciprocal", "Not"):
+            import scipy.special
+            f = {"Exp": np.exp, "Log": np.log, "Tanh": np.tanh,
+                 "Abs": np.abs, "Neg": np.negative, "Sqrt": np.sqrt,
+                 "Sign": np.sign, "Floor": np.floor, "Ceil": np.ceil,
+                 "Round": np.round, "Sin": np.sin, "Cos": np.cos,
+                 "Erf": scipy.special.erf,
+                 "Sigmoid": lambda x: 1 / (1 + np.exp(-x)),
+                 "Reciprocal": np.reciprocal,
+                 "Not": np.logical_not}[op]
+            r = f(i[0])
+            if i[0].dtype.kind == "f" and op != "Not":
+                r = r.astype(i[0].dtype)
+        elif op in ("Equal", "Less", "Greater", "LessOrEqual",
+                    "GreaterOrEqual", "And", "Or", "Xor"):
+            f = {"Equal": np.equal, "Less": np.less,
+                 "Greater": np.greater, "LessOrEqual": np.less_equal,
+                 "GreaterOrEqual": np.greater_equal,
+                 "And": np.logical_and, "Or": np.logical_or,
+                 "Xor": np.logical_xor}[op]
+            r = f(i[0], i[1])
+        else:
+            raise NotImplementedError(f"evaluator: op {op}")
+        env[nd.outputs[0]] = r
+
+    return {vo.name: env[vo.name] for vo in g.outputs}

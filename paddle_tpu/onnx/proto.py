@@ -1,0 +1,370 @@
+"""Minimal ONNX protobuf wire format: writer + reader, no deps.
+
+Reference analog: python/paddle/onnx/export.py delegates to the
+external paddle2onnx package; this environment has neither that nor the
+`onnx` python package, so the exporter serializes the ONNX protobuf
+itself. Field numbers and enum values below were extracted from the
+authoritative FileDescriptorProto embedded in libtorch_cpu.so's
+compiled onnx_onnx_torch-ml.proto (see
+tests/test_onnx_export.py::test_schema_matches_libtorch_descriptor,
+which re-extracts and cross-checks them), not recalled from memory.
+
+Only the subset of messages the exporter emits is implemented:
+ModelProto, GraphProto, NodeProto, AttributeProto, TensorProto,
+ValueInfoProto, TypeProto(.Tensor), TensorShapeProto(.Dimension),
+OperatorSetIdProto, StringStringEntryProto.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+# TensorProto.DataType
+FLOAT, UINT8, INT8, UINT16, INT16, INT32, INT64 = 1, 2, 3, 4, 5, 6, 7
+STRING, BOOL, FLOAT16, DOUBLE, UINT32, UINT64 = 8, 9, 10, 11, 12, 13
+BFLOAT16 = 16
+
+# AttributeProto.AttributeType
+ATTR_FLOAT, ATTR_INT, ATTR_STRING, ATTR_TENSOR, ATTR_GRAPH = 1, 2, 3, 4, 5
+ATTR_FLOATS, ATTR_INTS, ATTR_STRINGS = 6, 7, 8
+
+NP2ONNX = {
+    np.dtype(np.float32): FLOAT, np.dtype(np.uint8): UINT8,
+    np.dtype(np.int8): INT8, np.dtype(np.uint16): UINT16,
+    np.dtype(np.int16): INT16, np.dtype(np.int32): INT32,
+    np.dtype(np.int64): INT64, np.dtype(np.bool_): BOOL,
+    np.dtype(np.float16): FLOAT16, np.dtype(np.float64): DOUBLE,
+    np.dtype(np.uint32): UINT32, np.dtype(np.uint64): UINT64,
+}
+
+ONNX2NP = {v: k for k, v in NP2ONNX.items()}
+
+
+def onnx_dtype(np_dtype) -> int:
+    if str(np_dtype) == "bfloat16":
+        return BFLOAT16
+    try:
+        return NP2ONNX[np.dtype(np_dtype)]
+    except KeyError:
+        raise NotImplementedError(f"no ONNX dtype for {np_dtype}")
+
+
+# ---------------------------------------------------------------------------
+# writer
+# ---------------------------------------------------------------------------
+
+def _varint(v: int) -> bytes:
+    if v < 0:  # proto int64: 10-byte two's complement
+        v += 1 << 64
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+class Msg:
+    """Append-only protobuf message writer."""
+
+    __slots__ = ("buf",)
+
+    def __init__(self):
+        self.buf = bytearray()
+
+    def int(self, field: int, v: int) -> "Msg":
+        self.buf += _varint(field << 3 | 0) + _varint(int(v))
+        return self
+
+    def float32(self, field: int, v: float) -> "Msg":
+        self.buf += _varint(field << 3 | 5) + struct.pack("<f", v)
+        return self
+
+    def bytes_(self, field: int, b: bytes) -> "Msg":
+        self.buf += _varint(field << 3 | 2) + _varint(len(b)) + b
+        return self
+
+    def str(self, field: int, s: str) -> "Msg":
+        return self.bytes_(field, s.encode("utf-8"))
+
+    def msg(self, field: int, m: "Msg") -> "Msg":
+        return self.bytes_(field, bytes(m.buf))
+
+    def __bytes__(self):
+        return bytes(self.buf)
+
+
+def tensor_proto(name: str, arr) -> Msg:
+    """TensorProto from a numpy (or bfloat16 jax) array via raw_data."""
+    t = Msg()
+    shape = arr.shape
+    if str(arr.dtype) == "bfloat16":
+        dt = BFLOAT16
+        raw = np.asarray(arr).view(np.uint16).tobytes()
+    else:
+        arr = np.ascontiguousarray(np.asarray(arr))
+        dt = onnx_dtype(arr.dtype)
+        raw = arr.tobytes()
+    for d in shape:
+        t.int(1, d)
+    t.int(2, dt)
+    t.str(8, name)
+    t.bytes_(9, raw)
+    return t
+
+
+def value_info(name: str, elem_type: int,
+               shape: Sequence[Union[int, str]]) -> Msg:
+    tt = Msg().int(1, elem_type)
+    sh = Msg()
+    for d in shape:
+        dim = Msg()
+        if isinstance(d, str):
+            dim.str(2, d)      # dim_param (symbolic)
+        else:
+            dim.int(1, int(d))  # dim_value
+        sh.msg(1, dim)
+    tt.msg(2, sh)
+    tp = Msg().msg(1, tt)      # TypeProto.tensor_type
+    vi = Msg().str(1, name).msg(2, tp)
+    return vi
+
+
+def attribute(name: str, v) -> Msg:
+    a = Msg().str(1, name)
+    if isinstance(v, float):
+        a.float32(2, v).int(20, ATTR_FLOAT)
+    elif isinstance(v, bool):
+        a.int(3, int(v)).int(20, ATTR_INT)
+    elif isinstance(v, int):
+        a.int(3, v).int(20, ATTR_INT)
+    elif isinstance(v, str):
+        a.bytes_(4, v.encode()).int(20, ATTR_STRING)
+    elif isinstance(v, bytes):
+        a.bytes_(4, v).int(20, ATTR_STRING)
+    elif isinstance(v, Msg):  # pre-built TensorProto
+        a.msg(5, v).int(20, ATTR_TENSOR)
+    elif isinstance(v, (list, tuple)) and v and isinstance(v[0], float):
+        for x in v:
+            a.float32(7, x)
+        a.int(20, ATTR_FLOATS)
+    elif isinstance(v, (list, tuple)):
+        for x in v:
+            a.int(8, int(x))
+        a.int(20, ATTR_INTS)
+    else:
+        raise NotImplementedError(f"attribute {name}={v!r}")
+    return a
+
+
+def node(op_type: str, inputs: Sequence[str], outputs: Sequence[str],
+         name: str = "", **attrs) -> Msg:
+    n = Msg()
+    for i in inputs:
+        n.str(1, i)
+    for o in outputs:
+        n.str(2, o)
+    if name:
+        n.str(3, name)
+    n.str(4, op_type)
+    for k in sorted(attrs):
+        n.msg(5, attribute(k, attrs[k]))
+    return n
+
+
+def graph(nodes: Sequence[Msg], name: str,
+          inputs: Sequence[Msg], outputs: Sequence[Msg],
+          initializers: Sequence[Msg] = ()) -> Msg:
+    g = Msg()
+    for n in nodes:
+        g.msg(1, n)
+    g.str(2, name)
+    for t in initializers:
+        g.msg(5, t)
+    for vi in inputs:
+        g.msg(11, vi)
+    for vo in outputs:
+        g.msg(12, vo)
+    return g
+
+
+def model(graph_msg: Msg, opset: int = 13,
+          producer: str = "paddle_tpu") -> bytes:
+    m = Msg()
+    m.int(1, 8)  # ir_version 8 (onnx 1.13 era; pairs with opset 13)
+    m.str(2, producer)
+    m.str(3, "0.1")
+    opset_id = Msg().str(1, "").int(2, opset)
+    m.msg(7, graph_msg)
+    m.msg(8, opset_id)
+    return bytes(m)
+
+
+# ---------------------------------------------------------------------------
+# reader (for tests / the bundled evaluator)
+# ---------------------------------------------------------------------------
+
+def read_fields(b: bytes) -> List[Tuple[int, int, Any]]:
+    """[(field_number, wire_type, raw_value)] — varints as int, length-
+    delimited as bytes, fixed32/64 as raw bytes."""
+    out = []
+    i = 0
+    n = len(b)
+    while i < n:
+        tag, i = _read_varint(b, i)
+        num, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = _read_varint(b, i)
+        elif wt == 2:
+            ln, i = _read_varint(b, i)
+            v = b[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = b[i:i + 4]
+            i += 4
+        elif wt == 1:
+            v = b[i:i + 8]
+            i += 8
+        else:
+            raise ValueError(f"wire type {wt}")
+        out.append((num, wt, v))
+    return out
+
+
+def _read_varint(b: bytes, i: int) -> Tuple[int, int]:
+    v = 0
+    s = 0
+    while True:
+        x = b[i]
+        i += 1
+        v |= (x & 0x7F) << s
+        if not x & 0x80:
+            if v >= 1 << 63:  # negative int64
+                v -= 1 << 64
+            return v, i
+        s += 7
+
+
+def _group(b: bytes) -> Dict[int, list]:
+    d: Dict[int, list] = {}
+    for num, wt, v in read_fields(b):
+        d.setdefault(num, []).append((wt, v))
+    return d
+
+
+def _first(d, num, default=None):
+    return d[num][0][1] if num in d else default
+
+
+class DecodedTensor:
+    def __init__(self, b: bytes):
+        d = _group(b)
+        self.dims = tuple(v for wt, v in d.get(1, ()))
+        self.data_type = _first(d, 2, 0)
+        self.name = _first(d, 8, b"").decode()
+        raw = _first(d, 9)
+        if raw is not None:
+            if self.data_type == BFLOAT16:
+                u16 = np.frombuffer(raw, np.uint16).reshape(self.dims)
+                self.array = (u16.astype(np.uint32) << 16).view(
+                    np.float32).astype(np.float32)
+            else:
+                self.array = np.frombuffer(
+                    raw, ONNX2NP[self.data_type]).reshape(self.dims)
+        else:  # int64_data/float_data fallbacks
+            if self.data_type == INT64:
+                vals = [v for wt, v in d.get(7, ())]
+            elif self.data_type == FLOAT:
+                vals = [struct.unpack("<f", v)[0]
+                        for wt, v in d.get(4, ())]
+            else:
+                raise NotImplementedError(
+                    f"tensor data fields for dtype {self.data_type}")
+            self.array = np.asarray(vals, ONNX2NP[self.data_type]) \
+                .reshape(self.dims)
+
+
+class DecodedAttr:
+    def __init__(self, b: bytes):
+        d = _group(b)
+        self.name = _first(d, 1, b"").decode()
+        ty = _first(d, 20, 0)
+        if ty == ATTR_FLOAT:
+            self.value = struct.unpack("<f", _first(d, 2))[0]
+        elif ty == ATTR_INT:
+            self.value = _first(d, 3)
+        elif ty == ATTR_STRING:
+            self.value = _first(d, 4).decode()
+        elif ty == ATTR_TENSOR:
+            self.value = DecodedTensor(_first(d, 5))
+        elif ty == ATTR_FLOATS:
+            self.value = [struct.unpack("<f", v)[0]
+                          for wt, v in d.get(7, ())]
+        elif ty == ATTR_INTS:
+            self.value = [v for wt, v in d.get(8, ())]
+        else:
+            raise NotImplementedError(f"attr type {ty}")
+
+
+class DecodedNode:
+    def __init__(self, b: bytes):
+        d = _group(b)
+        self.inputs = [v.decode() for wt, v in d.get(1, ())]
+        self.outputs = [v.decode() for wt, v in d.get(2, ())]
+        self.name = _first(d, 3, b"").decode()
+        self.op_type = _first(d, 4, b"").decode()
+        self.attrs = {a.name: a.value
+                      for a in (DecodedAttr(v) for wt, v in d.get(5, ()))}
+
+
+class DecodedValueInfo:
+    def __init__(self, b: bytes):
+        d = _group(b)
+        self.name = _first(d, 1, b"").decode()
+        tp = _group(_first(d, 2, b""))
+        tt = _group(_first(tp, 1, b""))
+        self.elem_type = _first(tt, 1, 0)
+        self.shape = []
+        sh = _first(tt, 2)
+        if sh is not None:
+            for wt, v in _group(sh).get(1, ()):
+                dd = _group(v)
+                if 1 in dd:
+                    self.shape.append(_first(dd, 1))
+                else:
+                    self.shape.append(_first(dd, 2, b"?").decode())
+
+
+class DecodedGraph:
+    def __init__(self, b: bytes):
+        d = _group(b)
+        self.name = _first(d, 2, b"").decode()
+        self.nodes = [DecodedNode(v) for wt, v in d.get(1, ())]
+        self.initializers = {t.name: t.array for t in
+                             (DecodedTensor(v) for wt, v in d.get(5, ()))}
+        self.inputs = [DecodedValueInfo(v) for wt, v in d.get(11, ())]
+        self.outputs = [DecodedValueInfo(v) for wt, v in d.get(12, ())]
+
+
+class DecodedModel:
+    def __init__(self, b: bytes):
+        d = _group(b)
+        self.ir_version = _first(d, 1, 0)
+        self.producer = _first(d, 2, b"").decode()
+        self.graph = DecodedGraph(_first(d, 7, b""))
+        self.opsets = {}
+        for wt, v in d.get(8, ()):
+            od = _group(v)
+            self.opsets[_first(od, 1, b"").decode()] = _first(od, 2, 0)
+
+
+def load(path_or_bytes) -> DecodedModel:
+    if isinstance(path_or_bytes, (bytes, bytearray)):
+        return DecodedModel(bytes(path_or_bytes))
+    with open(path_or_bytes, "rb") as f:
+        return DecodedModel(f.read())
