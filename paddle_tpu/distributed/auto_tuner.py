@@ -33,6 +33,7 @@ class Candidate:
     sep: int = 1
     micro_batch_size: int = 1
     use_recompute: bool = False
+    moe_experts: int = 0  # 0 = dense FFN
 
     @property
     def world(self):
@@ -63,6 +64,15 @@ class TunerConfig:
     layers: int = 24
     dtype_bytes: int = 2
     max_trials: int = 16
+    num_heads: int = 16
+    # schedule the trial trainer will run; MoE+pp candidates are only
+    # emitted for the explicit-backward schedules (1f1b/vpp/zb)
+    pipeline_schedule: str = "gpipe"
+    # sequence-parallel degrees to sweep (Ulysses engages at sep>1);
+    # only degrees compatible with heads/seq divisibility are emitted
+    max_sep: int = 1
+    # expert counts to sweep in addition to the dense FFN (0)
+    moe_options: tuple = ()
 
 
 def default_candidates(cfg: TunerConfig) -> List[Candidate]:
@@ -78,14 +88,35 @@ def default_candidates(cfg: TunerConfig) -> List[Candidate]:
     for mp in powers(min(cfg.max_mp, n)):
         for pp in powers(min(cfg.max_pp, n // mp)):
             rest = n // (mp * pp)
-            for sharding in powers(rest):
-                dp = rest // sharding
-                for mbs in (1, 2, 4, 8):
-                    if cfg.global_batch_size % (dp * mbs):
-                        continue
-                    for rc in (False, True):
-                        out.append(Candidate(dp, mp, pp, sharding, 1, mbs,
-                                             rc))
+            for sep in powers(min(cfg.max_sep, rest)):
+                if sep > 1 and (cfg.num_heads % (mp * sep)
+                                or cfg.seq_len % sep
+                                or pp > 1):
+                    # Ulysses needs head/seq divisibility and no pipe
+                    # (models/gpt.py flash/ulysses gating)
+                    continue
+                for sharding in powers(rest // sep):
+                    dp = rest // (sep * sharding)
+                    for mbs in (1, 2, 4, 8):
+                        if cfg.global_batch_size % (dp * mbs):
+                            continue
+                        for rc in (False, True):
+                            for moe in (0,) + tuple(cfg.moe_options):
+                                if moe and moe % dp:
+                                    # experts shard over 'data': each
+                                    # data shard holds E/dp experts
+                                    continue
+                                if moe and pp > 1 and \
+                                        cfg.pipeline_schedule == \
+                                        "gpipe":
+                                    # MoE composes with pipe only via
+                                    # the explicit-backward schedules
+                                    # (1f1b/vpp/zb); the autodiff'd
+                                    # gpipe path rejects it
+                                    continue
+                                out.append(Candidate(
+                                    dp, mp, pp, sharding, sep, mbs,
+                                    rc, moe))
     return out
 
 
@@ -99,12 +130,21 @@ def prune_by_memory(cand: Candidate, cfg: TunerConfig) -> bool:
     if cfg.hidden_size % cand.mp:
         return False
     shard_ways = cand.mp * cand.pp * cand.sharding
+    params = cfg.model_params
+    if cand.moe_experts:
+        # ~2/3 of block params are FFN; each data shard holds E/dp
+        # expert copies of that share
+        ffn = params * 2 / 3
+        params = (params - ffn) + ffn * cand.moe_experts / cand.dp
     # fp32 master + adam m/v (12B) sharded; bf16 working copy
-    param_bytes = cfg.model_params * (12 / shard_ways + 2 / (cand.mp *
-                                                             cand.pp))
+    param_bytes = params * (12 / shard_ways + 2 / (cand.mp *
+                                                   cand.pp))
+    # activations shard over BOTH 'model' and 'sep' in the trainer
+    # (specs ('data', 'sep', ...) — seq-sharded residual stream)
     act_per_layer = (cand.micro_batch_size * cfg.seq_len *
                      cfg.hidden_size * cfg.dtype_bytes *
-                     (2 if cand.use_recompute else 14) / cand.mp)
+                     (2 if cand.use_recompute else 14)
+                     / (cand.mp * cand.sep))
     act_bytes = act_per_layer * cfg.layers / cand.pp
     return (param_bytes + act_bytes) < 0.9 * cfg.hbm_bytes
 
@@ -164,7 +204,8 @@ def tune_gpt(model_cfg, tuner_cfg: TunerConfig, steps: int = 3,
         m = max(2 * cand.pp, 1)
         trainer = GPTSpmdTrainer(
             model_cfg, mesh, microbatches=m,
-            remat=cand.use_recompute, **trainer_kwargs)
+            remat=cand.use_recompute,
+            moe_experts=cand.moe_experts, **trainer_kwargs)
         # every candidate is measured at the SAME global batch the real
         # job will run (tokens/s comparable across candidates); configs
         # that cannot tile it raise and are recorded as failed trials
