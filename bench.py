@@ -57,7 +57,7 @@ def main():
         moment_dtype=moment_dtype,
         master_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
         quant8="dgrad" if on_tpu else False,
-        ce_chunks=4 if on_tpu else 16)
+        ce_chunks=1 if on_tpu else 16)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
     labels = np.roll(ids, -1, axis=1)

@@ -26,7 +26,7 @@ def main():
                                  moment_dtype=jnp.bfloat16,
                                  master_dtype=jnp.bfloat16,
                                  quant8="dgrad",
-                                 ce_chunks=4)
+                                 ce_chunks=1)
         B, T, steps = 6, 1024, 10
     else:
         cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=4,

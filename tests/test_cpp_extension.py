@@ -1,0 +1,155 @@
+"""Custom C++ op loader (utils/cpp_extension.py; reference:
+python/paddle/utils/cpp_extension with PD_BUILD_OP). A user .cc with
+pd_op_/pd_grad_ exports becomes a framework op: Tensor-in/Tensor-out,
+works eagerly, under jit.to_static, and through Tensor.backward()."""
+import shutil
+import subprocess
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils.cpp_extension import load
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no g++")
+
+_SRC = r"""
+#include <cstdint>
+#include <cmath>
+
+extern "C" void pd_op_swishish(const float** ins, int n_ins,
+                               float* out, const int64_t* shape,
+                               int ndim) {
+  int64_t n = 1;
+  for (int i = 0; i < ndim; ++i) n *= shape[i];
+  const float* x = ins[0];
+  for (int64_t i = 0; i < n; ++i)
+    out[i] = x[i] / (1.0f + std::exp(-x[i]));
+}
+
+extern "C" void pd_grad_swishish(const float** ins, int n_ins,
+                                 const float* gout, float** gins,
+                                 const int64_t* shape, int ndim) {
+  int64_t n = 1;
+  for (int i = 0; i < ndim; ++i) n *= shape[i];
+  const float* x = ins[0];
+  for (int64_t i = 0; i < n; ++i) {
+    float s = 1.0f / (1.0f + std::exp(-x[i]));
+    gins[0][i] = gout[i] * (s + x[i] * s * (1.0f - s));
+  }
+}
+
+extern "C" void pd_op_addmul(const float** ins, int n_ins, float* out,
+                             const int64_t* shape, int ndim) {
+  int64_t n = 1;
+  for (int i = 0; i < ndim; ++i) n *= shape[i];
+  for (int64_t i = 0; i < n; ++i)
+    out[i] = ins[0][i] + 2.0f * ins[1][i];
+}
+"""
+
+
+@pytest.fixture(scope="module")
+def ext(tmp_path_factory):
+    d = tmp_path_factory.mktemp("ext")
+    src = d / "ops.cc"
+    src.write_text(_SRC)
+    return load("user_ops", [str(src)], build_directory=str(d))
+
+
+def _swish(x):
+    return x / (1 + np.exp(-x))
+
+
+def test_discovers_ops(ext):
+    assert set(ext.operators()) == {"swishish", "addmul"}
+    assert ext.cdll is not None
+
+
+def test_forward_eager_tensor(ext):
+    x = np.random.RandomState(0).randn(4, 5).astype(np.float32)
+    y = ext.swishish(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(y.numpy()), _swish(x),
+                               rtol=1e-6)
+
+
+def test_multi_input(ext):
+    a = np.ones((3,), np.float32)
+    b = np.full((3,), 2.0, np.float32)
+    y = ext.addmul(paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(np.asarray(y.numpy()), [5.0, 5.0, 5.0])
+
+
+def test_backward_through_tape(ext):
+    x = paddle.to_tensor(
+        np.random.RandomState(1).randn(6).astype(np.float32))
+    x.stop_gradient = False
+    y = ext.swishish(x)
+    y.sum().backward()
+    xs = np.asarray(x.numpy())
+    s = 1 / (1 + np.exp(-xs))
+    expect = s + xs * s * (1 - s)
+    np.testing.assert_allclose(np.asarray(x.grad.numpy()), expect,
+                               rtol=1e-5)
+
+
+def test_under_to_static(ext):
+    @paddle.jit.to_static
+    def f(x):
+        return ext.swishish(x * 2.0)
+
+    x = np.random.RandomState(2).randn(3, 3).astype(np.float32)
+    out = f(paddle.to_tensor(x))
+    np.testing.assert_allclose(np.asarray(out.numpy()), _swish(2 * x),
+                               rtol=1e-5)
+
+
+def test_shape_mismatch_rejected(ext):
+    with pytest.raises(ValueError, match="shape"):
+        ext.addmul(paddle.to_tensor(np.ones((2,), np.float32)),
+                   paddle.to_tensor(np.ones((3,), np.float32)))
+
+
+def test_rebuild_cache(ext, tmp_path):
+    # second load with same mtime reuses the .so (no recompile crash)
+    src = tmp_path / "ops2.cc"
+    src.write_text(_SRC)
+    m1 = load("user_ops2", [str(src)], build_directory=str(tmp_path))
+    m2 = load("user_ops2", [str(src)], build_directory=str(tmp_path))
+    a = np.ones((2,), np.float32)
+    np.testing.assert_allclose(
+        np.asarray(m2.swishish(paddle.to_tensor(a)).numpy()),
+        _swish(a), rtol=1e-6)
+
+
+def test_gradless_op_forward_with_tracked_input(ext):
+    """A pd_op without pd_grad must still run FORWARD on a tensor that
+    requires grad (apply_op takes the vjp path); only backward errors,
+    and with a message naming the missing symbol."""
+    a = paddle.to_tensor(np.ones((3,), np.float32))
+    b = paddle.to_tensor(np.ones((3,), np.float32))
+    a.stop_gradient = False
+    y = ext.addmul(a, b)  # must not raise
+    np.testing.assert_allclose(np.asarray(y.numpy()), [3.0, 3.0, 3.0])
+    with pytest.raises(Exception, match="pd_grad_addmul"):
+        y.sum().backward()
+
+
+def test_non_f32_input_casts(ext):
+    """The C ABI is float32; other dtypes cast inside the op and
+    gradients chain back through the cast to the caller's dtype."""
+    x = paddle.to_tensor(np.linspace(-1, 1, 5).astype(np.float64))
+    x.stop_gradient = False
+    y = ext.swishish(x)
+    y.sum().backward()
+    xs = np.linspace(-1, 1, 5)
+    s = 1 / (1 + np.exp(-xs))
+    np.testing.assert_allclose(np.asarray(x.grad.numpy()),
+                               s + xs * s * (1 - s), rtol=1e-4)
+
+
+def test_scalar_input_coerced(ext):
+    y = ext.swishish(2.0)
+    np.testing.assert_allclose(np.asarray(y), _swish(np.float32(2.0)),
+                               rtol=1e-6)
