@@ -479,7 +479,11 @@ class LlamaForCausalLM(Layer):
         L = len(self.llama.layers)
         D = cfg.head_dim
         attn0 = self.llama.layers[0].self_attn
-        kv_local = attn0.k_proj.weight.shape[-1] // D
+        # k_proj may be a Linear (weight [in, out]) or a weight-only
+        # Int8Linear (wq [in, out] int8) after quantization
+        kp = attn0.k_proj
+        kw = kp.weight if hasattr(kp, "weight") else kp.wq
+        kv_local = kw.shape[-1] // D
         dtype = self.llama.embed_tokens.weight._data.dtype
         # round the buffer up so nearby generation lengths share programs
         want = T0 + max_new_tokens

@@ -17,10 +17,13 @@ from .qat import QAT  # noqa: F401
 from .ptq import PTQ  # noqa: F401
 from .wrapper import (  # noqa: F401
     ObserveWrapper, QuantedLinear, QuantedConv2D, quant_dequant)
+from .int8_layers import (  # noqa: F401
+    Int8Linear, Int8Conv2D, weight_only_int8)
 
 __all__ = [
     "QuantConfig", "SingleLayerConfig", "AbsmaxObserver", "AVGObserver",
     "FakeQuanterWithAbsMaxObserver",
     "FakeQuanterChannelWiseAbsMaxObserver", "QAT", "PTQ",
     "ObserveWrapper", "QuantedLinear", "QuantedConv2D", "quant_dequant",
+    "Int8Linear", "Int8Conv2D", "weight_only_int8",
 ]

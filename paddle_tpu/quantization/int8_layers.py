@@ -145,3 +145,37 @@ def realize_int8(source: Layer, act_absmax):
     if type(source) is Conv2D and source._data_format == "NCHW":
         return Int8Conv2D(source, act_absmax)
     return None
+
+
+def weight_only_int8(model: Layer, min_features: int = 256,
+                     inplace: bool = True) -> Layer:
+    """Swap every nn.Linear / NCHW Conv2D in ``model`` for its int8
+    deployment layer with DYNAMIC activation scales (no calibration) —
+    the weight-only serving recipe: weights live in HBM as int8 +
+    per-channel scales (half the bytes of bf16, 4x fp32), which is the
+    whole cost of memory-bound decode. Reference analog: the
+    weight_only_quant pass family under
+    paddle/fluid/inference (analysis_predictor.h:105 int8 story).
+
+    ``min_features``: skip layers whose weight matrix is smaller than
+    min_features x min_features — tiny layers gain nothing and per-row
+    scale overhead can exceed the win."""
+    if not inplace:
+        import copy
+        model = copy.deepcopy(model)
+    for name, child in list(model._sub_layers.items()):
+        from ..nn.layer.common import Linear
+        from ..nn.layer.conv import Conv2D
+        repl = None
+        if isinstance(child, Linear):
+            w = child.weight
+            if min(w.shape) >= min_features:
+                repl = Int8Linear(child, None)
+        elif type(child) is Conv2D and child._data_format == "NCHW":
+            if child.weight.shape[1] >= min_features // 4:
+                repl = Int8Conv2D(child, None)
+        if repl is not None:
+            model._sub_layers[name] = repl
+        else:
+            weight_only_int8(child, min_features, inplace=True)
+    return model

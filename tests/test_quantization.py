@@ -216,3 +216,66 @@ def test_channelwise_quanter_axis():
     # each row quantized with its own scale → small row survives
     np.testing.assert_allclose(out[0], [1.0, -1.0], atol=0.02)
     np.testing.assert_allclose(out[1], [100.0, -100.0], atol=1.0)
+
+
+def test_weight_only_int8_swaps_and_preserves():
+    """weight_only_int8: serving transform — Linears above the size
+    floor become Int8Linear (dynamic activation scales), numerics stay
+    within int8 tolerance, the source model is untouched when
+    inplace=False, and tiny layers are left alone."""
+    import numpy as np
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.quantization import weight_only_int8, Int8Linear
+
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.big = nn.Linear(256, 384)
+            self.small = nn.Linear(8, 4)   # below min_features
+
+        def forward(self, x, y):
+            return self.big(x).sum() + self.small(y).sum()
+
+    m = Net()
+    x = paddle.to_tensor(
+        np.random.RandomState(0).randn(3, 256).astype(np.float32))
+    y = paddle.to_tensor(
+        np.random.RandomState(1).randn(3, 8).astype(np.float32))
+    ref = float(m(x, y).numpy())
+    q = weight_only_int8(m, min_features=64, inplace=False)
+    assert isinstance(q.big, Int8Linear)
+    assert not isinstance(q.small, Int8Linear)
+    assert isinstance(m.big, nn.Linear)  # source untouched
+    got = float(q(x, y).numpy())
+    assert abs(got - ref) / (abs(ref) + 1e-9) < 0.05
+    # inplace=True mutates the model itself
+    weight_only_int8(m, min_features=64)
+    assert isinstance(m.big, Int8Linear)
+
+
+def test_weight_only_int8_llama_greedy_parity():
+    import numpy as np
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import (llama_tiny_config,
+                                         LlamaForCausalLM)
+    from paddle_tpu.quantization import weight_only_int8
+
+    cfg = llama_tiny_config(vocab_size=256, hidden_size=256,
+                            num_hidden_layers=2,
+                            num_attention_heads=4,
+                            intermediate_size=512)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    ids = paddle.to_tensor(np.random.RandomState(0).randint(
+        0, 256, (1, 16)).astype(np.int64))
+    q = weight_only_int8(m, min_features=128, inplace=False)
+    rel = np.abs(np.asarray(q(ids).numpy())
+                 - np.asarray(m(ids).numpy())).max() \
+        / (np.abs(np.asarray(m(ids).numpy())).max() + 1e-9)
+    assert rel < 0.05
+    g1 = np.asarray(m.generate(ids, max_new_tokens=8).numpy())
+    g2 = np.asarray(q.generate(ids, max_new_tokens=8).numpy())
+    # random tiny weights put logits near ties; demand strong but not
+    # perfect agreement
+    assert (g1 == g2).mean() >= 0.8
