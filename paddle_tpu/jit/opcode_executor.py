@@ -52,6 +52,136 @@ class GraphBreak(Exception):
     """Raised when the bytecode cannot be captured; caller goes eager."""
 
 
+class _State:
+    """Execution state shared across forks and recursive callees.
+
+    ``fork_depth`` > 0 means a tensor-``if`` fork is active: BOTH arms
+    execute under trace, so a mutation of any object that outlives the
+    call (a global, a closure cell, an attribute target, anything that
+    escaped) would leak the untaken arm's side effects into real Python
+    state — eager runs exactly one arm (ADVICE r3, high).
+
+    The side-effect policy is therefore:
+
+      * objects CREATED during this call ("fresh": containers from
+        BUILD_* opcodes, vetted constructor calls, iterators) are
+        call-local — each fork arm receives its own deep copy of the
+        fresh objects reachable from the frame (``_copy_fresh``), so
+        arms can mutate them freely without seeing each other or
+        touching the originals;
+      * a fresh object DEMOTES (stops being fresh) the moment it could
+        escape: stored into a non-fresh target, or passed as an
+        argument to an un-vetted native callee;
+      * everything else GraphBreaks on mutation while a fork is active
+        — the whole call falls back to eager, which is always correct.
+
+    ``fresh`` maps id(obj) -> (obj, fork-epoch at creation). Keeping
+    the object reference both pins the id (no reuse) and lets the fork
+    copier find the object. Mutation under a fork is allowed only for
+    objects created (or copied) under the CURRENT innermost fork epoch.
+    """
+
+    __slots__ = ("instructions", "forks", "epochs", "serial", "fresh")
+
+    def __init__(self, instructions=_MAX_INSTRUCTIONS, forks=_MAX_FORKS):
+        self.instructions = instructions
+        self.forks = forks
+        self.epochs: list = []   # stack of active fork serials
+        self.serial = 0
+        self.fresh: dict = {}    # id(obj) -> (obj, epoch at creation)
+
+    @property
+    def fork_depth(self) -> int:
+        return len(self.epochs)
+
+    def push_fork(self):
+        self.serial += 1
+        self.epochs.append(self.serial)
+
+    def pop_fork(self):
+        self.epochs.pop()
+
+    def _epoch(self) -> int:
+        return self.epochs[-1] if self.epochs else 0
+
+    def mark_fresh(self, obj):
+        self.fresh[id(obj)] = (obj, self._epoch())
+
+    def is_fresh(self, obj) -> bool:
+        e = self.fresh.get(id(obj))
+        return e is not None and e[0] is obj
+
+    def is_fresh_current(self, obj) -> bool:
+        e = self.fresh.get(id(obj))
+        return e is not None and e[0] is obj and e[1] == self._epoch()
+
+    def demote(self, obj):
+        """Remove obj (and, recursively, fresh members) from fresh —
+        it may now be reachable from state that outlives the call."""
+        e = self.fresh.pop(id(obj), None)
+        if e is None or e[0] is not obj:
+            if e is not None:
+                self.fresh[id(obj)] = e  # id collision: put it back
+            return
+        if isinstance(obj, dict):
+            for v in list(obj.values()):
+                self.demote(v)
+        elif isinstance(obj, (list, tuple, set, frozenset)):
+            for v in list(obj):
+                self.demote(v)
+
+    def guard_mutation(self, obj, what: str):
+        """GraphBreak unless mutating ``obj`` is safe under the fork."""
+        if self.epochs and not self.is_fresh_current(obj):
+            raise GraphBreak(
+                f"{what} on a pre-fork object inside a tensor-if arm "
+                "(side effect would leak into the untaken branch)")
+
+    def copy_fresh_into(self, frame):
+        """Give a fork arm its own copies of the fresh objects reachable
+        from the frame, registered under the new fork epoch. Preserves
+        aliasing within the frame; uncopyable fresh objects (iterators)
+        stay shared and keep their old epoch, so mutating/advancing
+        them inside the arm GraphBreaks."""
+        memo: dict = {}
+
+        def cp(v):
+            vid = id(v)
+            if vid in memo:
+                return memo[vid]
+            if not self.is_fresh(v):
+                return v
+            if isinstance(v, list):
+                c = []
+                memo[vid] = c
+                c.extend(cp(x) for x in v)
+            elif isinstance(v, dict):
+                c = {}
+                memo[vid] = c
+                for k, x in v.items():
+                    c[k] = cp(x)
+            elif isinstance(v, set):
+                c = set(v)
+                memo[vid] = c
+            elif isinstance(v, bytearray):
+                c = bytearray(v)
+                memo[vid] = c
+            elif isinstance(v, tuple):
+                # tuples are immutable but may ALIAS fresh containers;
+                # copy so each arm reaches its own members (interpreted
+                # code cannot build self-referential tuples, so the
+                # post-build memo entry is safe)
+                c = tuple(cp(x) for x in v)
+                memo[vid] = c
+            else:
+                return v
+            self.mark_fresh(c)
+            return c
+
+        frame.stack = [cp(v) for v in frame.stack]
+        frame.locals = [cp(v) for v in frame.locals]
+
+
 class _Null:
     """CPython's internal NULL stack sentinel (PUSH_NULL et al.)."""
     __slots__ = ()
@@ -81,6 +211,89 @@ _CMP_OPS = {
     "<": operator.lt, "<=": operator.le, "==": operator.eq,
     "!=": operator.ne, ">": operator.gt, ">=": operator.ge,
 }
+
+
+# -- call vetting under a tensor-if fork (ADVICE r3 high) ----------------
+# Object kinds whose native call is allowed while both fork arms run.
+_PURE_BUILTINS = frozenset({
+    len, abs, min, max, sum, sorted, reversed, range, enumerate, zip,
+    isinstance, issubclass, getattr, hasattr, repr, format, all, any,
+    divmod, round, pow, ord, chr, callable, iter, hash, vars,
+})
+_FORBIDDEN_BUILTINS = frozenset({
+    print, input, exec, eval, setattr, delattr, open, __import__,
+    globals, locals, compile, breakpoint,
+})
+_CTOR_TYPES = frozenset({
+    list, dict, set, tuple, frozenset, str, int, float, bool, complex,
+    bytes, bytearray, slice, object, type,
+})
+_FRESH_TYPES = (list, dict, set, bytearray)
+# iterables whose iterator protocol runs no user Python
+_SAFE_ITERABLES = (list, tuple, dict, set, frozenset, str, bytes,
+                   bytearray, range)
+_MUTATING_METHODS = frozenset({
+    "append", "extend", "insert", "pop", "remove", "clear", "add",
+    "discard", "update", "setdefault", "popitem", "sort", "reverse",
+    "__setitem__", "__delitem__", "__iadd__", "__ior__", "__iand__",
+    "__ixor__", "__isub__", "__imul__", "send", "throw", "close",
+})
+# in-place only on ndarrays — str.partition / bytes.fill etc. are pure
+_NDARRAY_MUTATING_METHODS = frozenset({
+    "fill", "partition", "put", "resize", "setflags", "itemset",
+    "byteswap", "sort",
+})
+_TRUSTED_MODULE_PREFIXES = (
+    "jax", "numpy", "math", "cmath", "operator", "itertools", "einops",
+    "paddle_tpu.ops", "paddle_tpu.nn.functional",
+    "paddle_tpu.tensor_module", "paddle_tpu.linalg", "paddle_tpu.fft",
+    "paddle_tpu.signal", "paddle_tpu.framework.tensor",
+    "paddle_tpu.framework.dtype",
+)
+
+
+def _trusted_module(mod) -> bool:
+    """Functional-API modules whose calls are side-effect-free."""
+    if not mod:
+        return False
+    return any(mod == p or mod.startswith(p + ".")
+               for p in _TRUSTED_MODULE_PREFIXES)
+
+
+def _unwrap_partials(func):
+    import functools
+    while isinstance(func, functools.partial):
+        func = func.func
+    return func
+
+
+def _safe_in(obj, s) -> bool:
+    """Membership test that treats unhashable objects as absent."""
+    try:
+        return obj in s
+    except TypeError:
+        return False
+
+
+def _is_mutating_method(name: str, self_obj) -> bool:
+    if name in _MUTATING_METHODS:
+        return True
+    return name in _NDARRAY_MUTATING_METHODS \
+        and type(self_obj).__module__ == "numpy"
+
+
+# callees that consume the iteration protocol of their arguments
+_ITERATING_BUILTINS = frozenset({
+    iter, reversed, enumerate, zip, sorted, sum, min, max, any, all,
+})
+
+
+def _fork_iter_safe(a) -> bool:
+    """May this value be handed to an iterating callee while a fork is
+    active? True only when its iteration protocol runs no user Python."""
+    return isinstance(a, _SAFE_ITERABLES) or _is_tensorish(a) \
+        or isinstance(a, (int, float, bool, complex, type(None))) \
+        or type(a).__module__ == "builtins"
 
 
 def _is_tensorish(v) -> bool:
@@ -119,9 +332,10 @@ class _Frame:
         f = _Frame.__new__(_Frame)
         f.stack = list(self.stack)
         f.locals = list(self.locals)
-        # cells are shared (real CellType) — matches CPython, where both
-        # control-flow paths see one closure environment
-        f.cells = self.cells
+        # The cells LIST is copied so MAKE_CELL in one arm cannot bind a
+        # cell the other arm sees; the CellType objects themselves stay
+        # shared for reads, and STORE_DEREF GraphBreaks while forked.
+        f.cells = list(self.cells)
         f.pc = self.pc
         f.kwnames = self.kwnames
         return f
@@ -131,14 +345,14 @@ class OpcodeExecutor:
     """Interprets one code object with concrete/traced values."""
 
     def __init__(self, code: types.CodeType, fglobals: dict,
-                 closure: Optional[tuple], budget: list,
+                 closure: Optional[tuple], state: _State,
                  call_depth: int = 0):
         if code.co_flags & _GEN_FLAGS:
             raise GraphBreak("generator/coroutine bytecode")
         self.code = code
         self.globals = fglobals
         self.closure = closure or ()
-        self.budget = budget  # [instructions_left, forks_left] (shared)
+        self.state = state  # shared across forks and callees
         self.call_depth = call_depth
         self.instrs = list(dis.get_instructions(code, show_caches=False))
         self.off2idx = {i.offset: n for n, i in enumerate(self.instrs)}
@@ -170,8 +384,8 @@ class OpcodeExecutor:
         while True:
             if f.pc >= n:
                 raise GraphBreak("fell off code end")
-            self.budget[0] -= 1
-            if self.budget[0] <= 0:
+            self.state.instructions -= 1
+            if self.state.instructions <= 0:
                 raise GraphBreak("instruction budget exhausted "
                                  "(unbounded loop under trace?)")
             ins = instrs[f.pc]
@@ -204,15 +418,23 @@ class OpcodeExecutor:
         """Fork on a traced bool: run the fallthrough and jump paths
         each to RETURN, merge the returns with lax.cond. ``jump_when``
         is the condition value that takes the jump."""
-        self.budget[1] -= 1
-        if self.budget[1] <= 0:
+        self.state.forks -= 1
+        if self.state.forks <= 0:
             raise GraphBreak("too many tensor-branch forks")
         taken = f.fork()
         self._jump(taken, jump_offset)
         fall = f.fork()
         fall.pc += 1
-        out_taken = self._execute(taken)
-        out_fall = self._execute(fall)
+        self.state.push_fork()
+        try:
+            # each arm mutates its OWN copies of call-local objects;
+            # the originals (and the other arm) never see the effects
+            self.state.copy_fresh_into(taken)
+            out_taken = self._execute(taken)
+            self.state.copy_fresh_into(fall)
+            out_fall = self._execute(fall)
+        finally:
+            self.state.pop_fork()
 
         import jax.numpy as jnp
         from ..framework.tensor import Tensor
@@ -233,7 +455,19 @@ class OpcodeExecutor:
         merged = list(lt)
         for i, (a, b) in enumerate(zip(lt, lf)):
             if not _is_tensorish(a) and not _is_tensorish(b):
-                if a is b or (type(a) is type(b) and a == b):
+                if a is b:
+                    continue
+                same = False
+                if type(a) is type(b):
+                    # __eq__ may raise or return a non-bool (numpy
+                    # arrays): any such leaf counts as "differing" and
+                    # falls through to the GraphBreak below, never to
+                    # a user-visible crash
+                    try:
+                        same = bool(a == b)
+                    except Exception:
+                        same = False
+                if same:
                     continue
                 if not isinstance(a, (bool, int, float)) \
                         or not isinstance(b, (bool, int, float)):
@@ -326,7 +560,13 @@ class OpcodeExecutor:
                 raise GraphBreak(f"NameError: {name}")
 
     def _op_STORE_GLOBAL(self, f, ins):
-        self.globals[ins.argval] = f.stack.pop()
+        if self.state.fork_depth > 0:
+            raise GraphBreak(
+                "global store inside a tensor-if arm (side effect "
+                "would leak into the untaken branch)")
+        v = f.stack.pop()
+        self.state.demote(v)
+        self.globals[ins.argval] = v
 
     def _op_PUSH_NULL(self, f, ins):
         f.stack.append(_NULL)
@@ -380,7 +620,13 @@ class OpcodeExecutor:
             raise GraphBreak(f"empty cell {ins.argval!r}")
 
     def _op_STORE_DEREF(self, f, ins):
-        self._get_cell(f, ins).cell_contents = f.stack.pop()
+        if self.state.fork_depth > 0:
+            raise GraphBreak(
+                "cell store inside a tensor-if arm (closure cells are "
+                "shared by both branches)")
+        v = f.stack.pop()
+        self.state.demote(v)
+        self._get_cell(f, ins).cell_contents = v
 
     def _op_LOAD_CLOSURE(self, f, ins):
         f.stack.append(self._get_cell(f, ins))
@@ -401,6 +647,9 @@ class OpcodeExecutor:
     def _op_STORE_ATTR(self, f, ins):
         obj = f.stack.pop()
         v = f.stack.pop()
+        self.state.guard_mutation(obj, "attribute store")
+        if not self.state.is_fresh(obj):
+            self.state.demote(v)  # v escapes into longer-lived state
         setattr(obj, ins.argval, v)
 
     def _op_BINARY_SUBSCR(self, f, ins):
@@ -412,11 +661,15 @@ class OpcodeExecutor:
         k = f.stack.pop()
         obj = f.stack.pop()
         v = f.stack.pop()
+        self.state.guard_mutation(obj, "subscript store")
+        if not self.state.is_fresh(obj):
+            self.state.demote(v)
         obj[k] = v
 
     def _op_DELETE_SUBSCR(self, f, ins):
         k = f.stack.pop()
         obj = f.stack.pop()
+        self.state.guard_mutation(obj, "subscript delete")
         del obj[k]
 
     def _op_BINARY_SLICE(self, f, ins):
@@ -430,6 +683,9 @@ class OpcodeExecutor:
         start = f.stack.pop()
         obj = f.stack.pop()
         v = f.stack.pop()
+        self.state.guard_mutation(obj, "slice store")
+        if not self.state.is_fresh(obj):
+            self.state.demote(v)
         obj[slice(start, stop)] = v
 
     # -- operators --------------------------------------------------------
@@ -487,22 +743,33 @@ class OpcodeExecutor:
         return vs
 
     def _op_BUILD_TUPLE(self, f, ins):
-        f.stack.append(tuple(self._popn(f, ins.arg)))
+        v = tuple(self._popn(f, ins.arg))
+        if any(self.state.is_fresh(x) for x in v):
+            self.state.mark_fresh(v)  # aliases call-local objects
+        f.stack.append(v)
 
     def _op_BUILD_LIST(self, f, ins):
-        f.stack.append(self._popn(f, ins.arg))
+        v = self._popn(f, ins.arg)
+        self.state.mark_fresh(v)
+        f.stack.append(v)
 
     def _op_BUILD_SET(self, f, ins):
-        f.stack.append(set(self._popn(f, ins.arg)))
+        v = set(self._popn(f, ins.arg))
+        self.state.mark_fresh(v)
+        f.stack.append(v)
 
     def _op_BUILD_MAP(self, f, ins):
         vs = self._popn(f, 2 * ins.arg)
-        f.stack.append({vs[i]: vs[i + 1] for i in range(0, len(vs), 2)})
+        v = {vs[i]: vs[i + 1] for i in range(0, len(vs), 2)}
+        self.state.mark_fresh(v)
+        f.stack.append(v)
 
     def _op_BUILD_CONST_KEY_MAP(self, f, ins):
         keys = f.stack.pop()
         vs = self._popn(f, ins.arg)
-        f.stack.append(dict(zip(keys, vs)))
+        v = dict(zip(keys, vs))
+        self.state.mark_fresh(v)
+        f.stack.append(v)
 
     def _op_BUILD_SLICE(self, f, ins):
         f.stack.append(slice(*self._popn(f, ins.arg)))
@@ -525,28 +792,40 @@ class OpcodeExecutor:
 
     def _op_LIST_EXTEND(self, f, ins):
         it = f.stack.pop()
-        f.stack[-ins.arg].extend(it)
+        tgt = f.stack[-ins.arg]
+        self.state.guard_mutation(tgt, "list extend")
+        tgt.extend(it)
 
     def _op_LIST_APPEND(self, f, ins):
         v = f.stack.pop()
-        f.stack[-ins.arg].append(v)
+        tgt = f.stack[-ins.arg]
+        self.state.guard_mutation(tgt, "list append")
+        tgt.append(v)
 
     def _op_SET_ADD(self, f, ins):
         v = f.stack.pop()
-        f.stack[-ins.arg].add(v)
+        tgt = f.stack[-ins.arg]
+        self.state.guard_mutation(tgt, "set add")
+        tgt.add(v)
 
     def _op_SET_UPDATE(self, f, ins):
         it = f.stack.pop()
-        f.stack[-ins.arg].update(it)
+        tgt = f.stack[-ins.arg]
+        self.state.guard_mutation(tgt, "set update")
+        tgt.update(it)
 
     def _op_MAP_ADD(self, f, ins):
         v = f.stack.pop()
         k = f.stack.pop()
-        f.stack[-ins.arg][k] = v
+        tgt = f.stack[-ins.arg]
+        self.state.guard_mutation(tgt, "dict add")
+        tgt[k] = v
 
     def _op_DICT_UPDATE(self, f, ins):
         d = f.stack.pop()
-        f.stack[-ins.arg].update(d)
+        tgt = f.stack[-ins.arg]
+        self.state.guard_mutation(tgt, "dict update")
+        tgt.update(d)
 
     _op_DICT_MERGE = _op_DICT_UPDATE
 
@@ -601,10 +880,25 @@ class OpcodeExecutor:
 
     # -- iteration --------------------------------------------------------
     def _op_GET_ITER(self, f, ins):
-        f.stack.append(iter(f.stack.pop()))
+        src = f.stack.pop()
+        if self.state.fork_depth > 0 \
+                and type(src).__module__ != "builtins" \
+                and not isinstance(src, _SAFE_ITERABLES) \
+                and not _is_tensorish(src):
+            # iter() on a user object runs its __iter__ (and each loop
+            # step its __next__) natively — unvetted code in both arms
+            raise GraphBreak(
+                f"iterating user object {type(src).__name__} under fork")
+        it = iter(src)
+        self.state.mark_fresh(it)
+        f.stack.append(it)
 
     def _op_FOR_ITER(self, f, ins):
         it = f.stack[-1]
+        # advancing an iterator created BEFORE the fork would double-
+        # advance it (both arms run); loops wholly inside an arm made
+        # their iterator post-fork via GET_ITER, which marks it fresh
+        self.state.guard_mutation(it, "advancing a pre-fork iterator")
         try:
             f.stack.append(next(it))
         except StopIteration:
@@ -648,22 +942,170 @@ class OpcodeExecutor:
         f.stack.append(self._call(func, args, dict(kwargs)))
 
     def _call(self, func, args, kwargs):
+        st = self.state
+        if st.fork_depth > 0:
+            if self._vet_forked(func, args) == "interpret":
+                return self._interpret(func, args, kwargs)
+        elif self._may_retain_args(func):
+            # an un-vetted native callee may retain its arguments —
+            # they can no longer be treated as call-local
+            for v in args:
+                st.demote(v)
+            for v in kwargs.values():
+                st.demote(v)
         try:
-            return func(*args, **kwargs)
+            r = func(*args, **kwargs)
         except jax.errors.TracerBoolConversionError:
             # the callee branches on a tensor: interpret it too
-            if self.call_depth >= _MAX_CALL_DEPTH:
-                raise GraphBreak("tensor branch too deep in callees")
-            target = func
-            if isinstance(target, types.MethodType):
-                args = [target.__self__] + list(args)
-                target = target.__func__
-            if not isinstance(target, types.FunctionType):
+            return self._interpret(func, args, kwargs)
+        f0 = _unwrap_partials(func)
+        if _safe_in(f0, _CTOR_TYPES) or f0 is sorted:
+            if isinstance(r, _FRESH_TYPES):
+                st.mark_fresh(r)  # constructor results are new objects
+            elif isinstance(r, tuple) and \
+                    any(st.is_fresh(x) for x in r):
+                st.mark_fresh(r)
+        return r
+
+    def _may_retain_args(self, func) -> bool:
+        """Could a native call alias its arguments into state that
+        outlives this call? Known-pure callees cannot; a mutating
+        container method retains args only inside its receiver, which
+        is harmless when the receiver itself is call-local."""
+        f0 = _unwrap_partials(func)
+        if _safe_in(f0, _PURE_BUILTINS) or f0 is next or f0 is print:
+            return False
+        if isinstance(f0, type):
+            return f0 not in _CTOR_TYPES
+        self_obj = getattr(f0, "__self__", None)
+        if self_obj is not None \
+                and not isinstance(self_obj, types.ModuleType):
+            if _is_tensorish(self_obj):
+                return False
+            name = getattr(f0, "__name__", "")
+            if _is_mutating_method(name, self_obj):
+                return not self.state.is_fresh(self_obj)
+            if type(self_obj).__module__ == "builtins":
+                return False
+            return True
+        mod = getattr(f0, "__module__", None)
+        if mod is None and isinstance(self_obj, types.ModuleType):
+            mod = self_obj.__name__
+        if mod and _trusted_module(mod):
+            return False
+        return True
+
+    def _interpret(self, func, args, kwargs):
+        """Run a callee through the interpreter (shared state, so its
+        side-effecting opcodes stay guarded while a fork is active)."""
+        if self.call_depth >= _MAX_CALL_DEPTH:
+            raise GraphBreak("interpreted callee too deep")
+        import functools
+        target = func
+        while isinstance(target, functools.partial):
+            args = list(target.args) + list(args)
+            kwargs = {**target.keywords, **kwargs}
+            target = target.func
+        if isinstance(target, types.MethodType):
+            args = [target.__self__] + list(args)
+            target = target.__func__
+        if isinstance(target, OpcodeFunction):
+            target = target.fn  # re-enter with OUR shared state
+        if not isinstance(target, types.FunctionType):
+            raise GraphBreak(f"cannot interpret callee {func!r}")
+        # A fork INSIDE the callee copies only the callee frame's view
+        # of these objects; our continuation would keep reading the
+        # originals and miss the taken arm's mutations — so they stop
+        # being call-local here (mutation under a fork then GraphBreaks
+        # instead of silently diverging).
+        for v in args:
+            self.state.demote(v)
+        for v in kwargs.values():
+            self.state.demote(v)
+        sub = OpcodeFunction(target, state=self.state,
+                             call_depth=self.call_depth + 1)
+        return sub(*args, **kwargs)
+
+    def _vet_forked(self, func, args) -> str:
+        """Decide how to perform a call while a tensor-if fork is
+        active: ``"native"`` (known side-effect-free, or mutation target
+        verified fresh), ``"interpret"`` (Python code — run it through
+        the interpreter so its effects stay guarded), or GraphBreak.
+        Both arms of the fork execute under trace, so an unvetted native
+        call could leak the untaken arm's side effects (ADVICE r3)."""
+        st = self.state
+        f0 = _unwrap_partials(func)
+        if isinstance(f0, OpcodeFunction):
+            return "interpret"
+        if isinstance(f0, types.MethodType):
+            inner = f0.__func__
+            self_obj = f0.__self__
+            if _is_tensorish(self_obj):
+                name = getattr(inner, "__name__", "")
+                if name.endswith("_") and not name.endswith("__"):
+                    raise GraphBreak(
+                        f"in-place tensor method {name!r} under fork")
+                return "native"
+            # Python-level methods always go through the interpreter —
+            # a native call could mutate globals/attrs unvetted even
+            # when the receiver itself is arm-local
+            if isinstance(inner, types.FunctionType):
+                return "interpret"
+            if st.is_fresh_current(self_obj):
+                return "native"
+            raise GraphBreak(
+                f"bound method {f0!r} on a pre-fork object under fork")
+        if isinstance(f0, type):
+            if f0 in _CTOR_TYPES or _trusted_module(f0.__module__):
+                # container ctors iterate their args — a user __iter__
+                # would run natively in both arms
+                if f0 in (list, tuple, set, frozenset, dict) and \
+                        not all(_fork_iter_safe(a) for a in args):
+                    raise GraphBreak(
+                        "ctor iterating a user object under fork")
+                return "native"
+            raise GraphBreak(f"constructor {f0!r} under fork")
+        if _safe_in(f0, _FORBIDDEN_BUILTINS):
+            raise GraphBreak(
+                f"side-effecting builtin {f0!r} under fork")
+        if _safe_in(f0, _ITERATING_BUILTINS) and \
+                not all(_fork_iter_safe(a) for a in args):
+            raise GraphBreak(
+                "builtin iterating a user object under fork")
+        if _safe_in(f0, _PURE_BUILTINS):
+            return "native"
+        if f0 is next:
+            if args:
+                st.guard_mutation(args[0], "next() advancing iterator")
+            return "native"
+        self_obj = getattr(f0, "__self__", None)
+        if self_obj is not None \
+                and not isinstance(self_obj, types.ModuleType):
+            # bound C-level method (list.append, ndarray.sum, ...)
+            if _is_tensorish(self_obj):
+                return "native"
+            name = getattr(f0, "__name__", "")
+            if _is_mutating_method(name, self_obj):
+                st.guard_mutation(self_obj, f"method .{name}()")
+                return "native"
+            tm = type(self_obj).__module__
+            if tm == "builtins" or _trusted_module(tm):
+                return "native"
+            raise GraphBreak(
+                f"C method {f0!r} on unknown object under fork")
+        mod = getattr(f0, "__module__", None)
+        if mod is None and isinstance(self_obj, types.ModuleType):
+            mod = self_obj.__name__
+        if mod and _trusted_module(mod):
+            name = getattr(f0, "__name__", "")
+            if name.endswith("_") and not name.endswith("__"):
                 raise GraphBreak(
-                    f"tensor bool inside non-Python callee {func!r}")
-            sub = OpcodeFunction(target, budget=self.budget,
-                                 call_depth=self.call_depth + 1)
-            return sub(*args, **kwargs)
+                    f"in-place API {name!r} under fork")
+            return "native"
+        if isinstance(f0, types.FunctionType):
+            return "interpret"
+        raise GraphBreak(
+            f"potentially side-effecting callee {f0!r} under fork")
 
     def _op_MAKE_FUNCTION(self, f, ins):
         code = f.stack.pop()
@@ -723,7 +1165,8 @@ class OpcodeFunction:
     conversion produce a compiled ``lax.cond``.
     """
 
-    def __init__(self, fn: Callable, budget=None, call_depth=0):
+    def __init__(self, fn: Callable, state: Optional[_State] = None,
+                 call_depth=0):
         if isinstance(fn, types.MethodType):
             self._self = fn.__self__
             fn = fn.__func__
@@ -732,7 +1175,7 @@ class OpcodeFunction:
         if not isinstance(fn, types.FunctionType):
             raise GraphBreak(f"not a Python function: {fn!r}")
         self.fn = fn
-        self.budget = budget
+        self.state = state
         self.call_depth = call_depth
 
     def __call__(self, *args, **kwargs):
@@ -744,10 +1187,9 @@ class OpcodeFunction:
         except TypeError as e:
             raise GraphBreak(f"bad call signature: {e}")
         ba.apply_defaults()
-        budget = self.budget if self.budget is not None \
-            else [_MAX_INSTRUCTIONS, _MAX_FORKS]
+        state = self.state if self.state is not None else _State()
         ex = OpcodeExecutor(fn.__code__, fn.__globals__, fn.__closure__,
-                            budget, self.call_depth)
+                            state, self.call_depth)
         return ex.run(dict(ba.arguments))
 
 

@@ -365,6 +365,15 @@ def _pad(ctx, eqn):
 @_prim("iota")
 def _iota(ctx, eqn):
     p = eqn.params
+    # the iota is baked as a constant at trace-time sizes, so a dim that
+    # the caller declared dynamic would silently be pinned to its
+    # placeholder prime — fail loudly instead (ADVICE r3)
+    hits = [d for d in p["shape"]
+            if any(d % q == 0 for q in ctx.dynamic_sizes)]
+    if hits:
+        raise NotImplementedError(
+            f"iota over dynamic dims {hits}: the exported constant "
+            "would pin the dynamic dim to its trace-time size")
     arr = np.asarray(
         jax.lax.iota(p["dtype"], int(np.prod(p["shape"])))
         if len(p["shape"]) == 1 else

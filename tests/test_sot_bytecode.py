@@ -206,3 +206,174 @@ def test_arm_structure_mismatch_breaks_not_wrong():
     out = f(_t([1.0]))  # eager fallback must still run correctly
     assert isinstance(out, tuple) and len(out) == 2
     assert jit.capture_report()["graph_break_calls"] >= 1
+
+
+# -- side-effect safety under tensor-if forks (ADVICE r3, high) ----------
+
+def test_untaken_arm_list_mutation_does_not_leak():
+    # The advisor's repro: BOTH arms execute under trace, so without
+    # copy-on-fork the untaken arm's scale[0]=3.0 leaked into the taken
+    # arm's read. Each arm must see its own copy of the call-local list.
+    f = jit.to_static(_exec_def("""
+        def f(x):
+            scale = [1.0]
+            if x.sum() > 0:
+                pass
+            else:
+                scale[0] = 3.0
+            return x * scale[0]
+    """))
+    np.testing.assert_allclose(f(_t([2.0])).numpy(), [2.0])   # 2 * 1.0
+    np.testing.assert_allclose(f(_t([-2.0])).numpy(), [-6.0])  # -2 * 3.0
+
+
+def test_untaken_arm_global_mutation_breaks_to_eager():
+    # A global mutated inside an arm outlives the call: the capture must
+    # GraphBreak to eager (which runs exactly one arm) rather than let
+    # the untaken arm's store leak into real module state.
+    jit.reset_capture_report()
+    ns = {"paddle": paddle, "G": {"v": 1.0}}
+    exec(textwrap.dedent("""
+        def f(x):
+            global G
+            if x.sum() > 0:
+                pass
+            else:
+                G = {"v": 3.0}
+            return x * G["v"]
+    """), ns)
+    f = jit.to_static(ns["f"])
+    np.testing.assert_allclose(f(_t([2.0])).numpy(), [2.0])
+    assert ns["G"]["v"] == 1.0  # positive path must not touch G
+    np.testing.assert_allclose(f(_t([-2.0])).numpy(), [-6.0])
+    assert ns["G"]["v"] == 3.0  # eager ran the else arm for real
+
+
+def test_untaken_arm_attr_mutation_breaks_to_eager():
+    class Holder:
+        pass
+
+    h = Holder()
+    h.v = 1.0
+    ns = {"paddle": paddle, "h": h}
+    exec(textwrap.dedent("""
+        def f(x):
+            if x.sum() > 0:
+                pass
+            else:
+                h.v = 3.0
+            return x * h.v
+    """), ns)
+    f = jit.to_static(ns["f"])
+    np.testing.assert_allclose(f(_t([2.0])).numpy(), [2.0])
+    assert h.v == 1.0  # the untaken arm must not have run for real
+    np.testing.assert_allclose(f(_t([-2.0])).numpy(), [-6.0])
+    assert h.v == 3.0
+
+
+def test_arm_local_dict_and_list_still_capture():
+    # Building and mutating call-local containers inside the arms is
+    # side-effect-free w.r.t. the outside world and must still capture.
+    jit.reset_capture_report()
+    f = jit.to_static(_exec_def("""
+        def f(x):
+            acc = []
+            if x.sum() > 0:
+                acc.append(x * 2.0)
+                tag = {"s": 1.0}
+            else:
+                acc.append(x * 3.0)
+                tag = {"s": -1.0}
+            return acc[0] * tag["s"]
+    """))
+    np.testing.assert_allclose(f(_t([1.0])).numpy(), [2.0])
+    np.testing.assert_allclose(f(_t([-1.0])).numpy(), [3.0])
+    rep = jit.capture_report()
+    assert rep["graph_break_calls"] == 0
+
+
+def test_arm_reading_other_arm_write_is_isolated():
+    # One arm writes a key the other arm only READS: without per-arm
+    # copies the second arm would see the first arm's write.
+    f = jit.to_static(_exec_def("""
+        def f(x):
+            out = {}
+            if x.sum() > 0:
+                out["y"] = 5.0
+            else:
+                pass
+            return x * out.get("y", 1.0)
+    """))
+    np.testing.assert_allclose(f(_t([2.0])).numpy(), [10.0])
+    np.testing.assert_allclose(f(_t([-2.0])).numpy(), [-2.0])
+
+
+def test_nonbool_eq_return_leaf_falls_back_not_crash():
+    # Arms returning numpy-array leaves: comparing them with == yields
+    # an array (truth-value error) — must GraphBreak to eager, never
+    # surface a ValueError to the user.
+    ns = {"paddle": paddle, "np": np}
+    exec(textwrap.dedent("""
+        def f(x):
+            if x.sum() > 0:
+                meta = np.array([1.0, 2.0])
+            else:
+                meta = np.array([3.0, 4.0])
+            return x * float(meta[0])
+    """), ns)
+    f = jit.to_static(ns["f"])
+    np.testing.assert_allclose(f(_t([2.0])).numpy(), [2.0])
+    np.testing.assert_allclose(f(_t([-2.0])).numpy(), [-6.0])
+
+
+def test_user_iter_side_effect_under_fork_breaks_to_eager():
+    # Iterating a user object runs its __iter__/__next__ natively; under
+    # a fork that code would execute for BOTH arms. Must fall to eager.
+    log = []
+
+    class Emitter:
+        def __iter__(self):
+            log.append("iter")
+            return iter([1.0, 2.0])
+
+    ns = {"paddle": paddle, "em": Emitter()}
+    exec(textwrap.dedent("""
+        def f(x):
+            if x.sum() > 0:
+                s = 0.0
+                for v in em:
+                    s = s + v
+            else:
+                s = -1.0
+            return x * s
+    """), ns)
+    f = jit.to_static(ns["f"])
+    np.testing.assert_allclose(f(_t([2.0])).numpy(), [6.0])
+    np.testing.assert_allclose(f(_t([-2.0])).numpy(), [2.0])
+    # eager fallback runs __iter__ exactly once per positive call
+    assert log.count("iter") == 1
+
+
+def test_unhashable_callable_does_not_crash_capture():
+    # frozenset membership on an unhashable callable must not raise
+    class Scaler:
+        __hash__ = None
+
+        def __init__(self, k):
+            self.k = k
+
+        def __call__(self, v):
+            return v * self.k
+
+    ns = {"paddle": paddle, "scale": Scaler(3.0)}
+    exec(textwrap.dedent("""
+        def f(x):
+            if x.sum() > 0:
+                y = scale(x)
+            else:
+                y = x
+            return y + 0.0
+    """), ns)
+    f = jit.to_static(ns["f"])
+    np.testing.assert_allclose(f(_t([2.0])).numpy(), [6.0])
+    np.testing.assert_allclose(f(_t([-2.0])).numpy(), [-2.0])
