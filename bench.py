@@ -56,7 +56,7 @@ def main():
         remat="save_qkv_ffn" if on_tpu else False,
         moment_dtype=moment_dtype,
         master_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
-        quant8="dgrad" if on_tpu else False,
+        quant8="wgrad" if on_tpu else False,
         ce_chunks=1 if on_tpu else 16)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)

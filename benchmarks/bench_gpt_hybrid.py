@@ -5,6 +5,8 @@ On the virtual CPU mesh: one full hybrid step over pipe=2 x model=2 x
 fsdp=2 — the allgather/reduce-scatter path the reference drives through
 fleet; here one jitted program whose collectives GSPMD emits.
 """
+import _path  # noqa: F401  (repo-root import shim)
+
 import json
 import time
 
@@ -25,7 +27,7 @@ def main():
                                  remat="save_qkv_ffn",
                                  moment_dtype=jnp.bfloat16,
                                  master_dtype=jnp.bfloat16,
-                                 quant8="dgrad",
+                                 quant8="wgrad",
                                  ce_chunks=1)
         B, T, steps = 6, 1024, 10
     else:

@@ -9,6 +9,8 @@ toward convergence, so early-step agreement alone is not evidence).
 Usage: python benchmarks/parity_int8.py [--steps 500] [--layers 24] ...
 Prints one JSON line; full curves to --out.
 """
+import _path  # noqa: F401  (repo-root import shim)
+
 import argparse
 import json
 import time
@@ -24,6 +26,8 @@ def main():
     ap.add_argument("--seq", type=int, default=1024)
     ap.add_argument("--every", type=int, default=10)
     ap.add_argument("--out", default="/tmp/parity_int8.json")
+    ap.add_argument("--quant8", default="dgrad",
+                    choices=["dgrad", "wgrad"])
     args = ap.parse_args()
 
     import jax
@@ -59,7 +63,7 @@ def main():
         return tr, losses, dt
 
     import gc
-    tr8, l8, dt8 = run("dgrad")
+    tr8, l8, dt8 = run(args.quant8)
     # only one 7.8 GB trainer fits: keep the curves, free the state
     del tr8
     gc.collect()
@@ -81,7 +85,7 @@ def main():
         return jax.device_get(g)
 
     g_exact = grads_of(False)
-    g_int8 = grads_of("dgrad")
+    g_int8 = grads_of(args.quant8)
     snrs = {}
     for k in ("wqkv", "win", "wout", "wproj"):
         a = np.asarray(g_exact["blocks"][k], np.float32)
@@ -94,14 +98,14 @@ def main():
     result = {
         "steps": args.steps,
         "loss_bf16_first3": lb[:3], "loss_bf16_last3": lb[-3:],
-        "loss_int8_first3": l8[:3], "loss_int8_last3": l8[-3:],
+        "quant8": args.quant8, "loss_int8_first3": l8[:3], "loss_int8_last3": l8[-3:],
         "final_gap": round(abs(lb[-1] - l8[-1]), 4),
         "max_gap": max(gaps), "mean_gap": round(float(np.mean(gaps)), 5),
         "grad_snr_at_end": snrs,
         "minutes": round((dt8 + dtb) / 60, 1),
     }
     with open(args.out, "w") as f:
-        json.dump({"bf16": lb, "int8_dgrad": l8, **result}, f)
+        json.dump({"bf16": lb, "int8_" + args.quant8: l8, **result}, f)
     print(json.dumps(result))
 
 

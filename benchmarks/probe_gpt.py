@@ -11,6 +11,8 @@ import argparse
 import json
 import time
 
+import _path  # noqa: F401  (repo-root import shim)
+
 
 def main():
     ap = argparse.ArgumentParser()
@@ -23,7 +25,8 @@ def main():
     ap.add_argument("--heads", type=int, default=16)
     ap.add_argument("--moments", default="bf16")
     ap.add_argument("--masters", default="fp32", choices=["fp32", "bf16"])
-    ap.add_argument("--quant8", default="", choices=["", "fwd", "dgrad"])
+    ap.add_argument("--quant8", default="",
+                    choices=["", "fwd", "dgrad", "wgrad"])
     ap.add_argument("--unroll", type=int, default=1)
     ap.add_argument("--ce-chunks", type=int, default=16)
     ap.add_argument("--no-fused-opt", action="store_true")
@@ -46,7 +49,8 @@ def main():
         else jnp.float32,
         master_dtype=jnp.bfloat16 if args.masters == "bf16"
         else jnp.float32,
-        quant8={"": False, "fwd": True, "dgrad": "dgrad"}[args.quant8],
+        quant8={"": False, "fwd": True, "dgrad": "dgrad",
+                "wgrad": "wgrad"}[args.quant8],
         layer_unroll=args.unroll,
         ce_chunks=args.ce_chunks,
         fused_optimizer=False if args.no_fused_opt else None)

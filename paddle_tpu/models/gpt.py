@@ -253,8 +253,22 @@ class GPTSpmdTrainer:
         # int8 MXU forward for the wide block matmuls (qkv/ffn), exact
         # bf16 backward — ~2x MXU rate on v5e (ops/quant_matmul.py).
         # quant8="dgrad" additionally runs the activation gradient on
-        # the int8 MXU (wgrad stays exact bf16).
+        # the int8 MXU (wgrad stays exact bf16). quant8="wgrad" runs
+        # ALL THREE matmuls int8 — the weight gradient quantizes with
+        # stochastic rounding along the token axis, which keeps it
+        # unbiased so Adam's moments integrate the noise to zero
+        # (ops/quant_matmul.int8_linear_all8); SR streams are seeded
+        # per (step, layer, site) from the optimizer step counter.
         self.quant8 = quant8
+        if quant8 == "wgrad" and moe_experts:
+            raise ValueError("quant8='wgrad' not wired for MoE blocks")
+        if quant8 == "wgrad" and mesh.shape.get("pipe", 1) > 1:
+            # the pipeline paths do not thread the per-step SR seed;
+            # running them would silently reuse one stream every step —
+            # exactly the data-correlated bias SR exists to remove
+            raise ValueError(
+                "quant8='wgrad' supports single-stage meshes (pipe=1); "
+                "pipeline schedules keep wgrad exact (use 'dgrad')")
         # pp schedule: "gpipe" = autodiff'd scan+ppermute forward
         # (F-then-B); "1f1b" = explicit on-device 1F1B train schedule
         # (distributed/pipeline.pipeline_train_1f1b) with O(S) instead
@@ -431,18 +445,26 @@ class GPTSpmdTrainer:
         return params
 
     # -- model -------------------------------------------------------------
-    def _mm(self):
+    def _mm(self, seed=None):
         # bf16 in/out einsums: the TPU MXU accumulates bf16 products in
         # fp32 internally, so a bf16 output dtype only rounds the final
         # result while halving the HBM write (measured ~7% step win vs
-        # preferred_element_type=f32 + cast)
+        # preferred_element_type=f32 + cast). ``site`` decorrelates the
+        # SR streams of the three matmul sites in a block (wgrad mode).
+        if self.quant8 == "wgrad":
+            from ..ops.quant_matmul import int8_linear_all8
+            s = jnp.int32(1) if seed is None else seed
+            # layer seeds arrive 16 apart (_stage_fn), so *8+site keeps
+            # (layer, site) streams distinct; int32 wrap just mixes
+            return lambda a, w, site=0: int8_linear_all8(
+                a, w, s * jnp.int32(8) + jnp.int32(site))
         if self.quant8 == "dgrad":
             from ..ops.quant_matmul import int8_linear_dgrad8
-            return int8_linear_dgrad8
+            return lambda a, w, site=0: int8_linear_dgrad8(a, w)
         if self.quant8:
             from ..ops.quant_matmul import int8_linear
-            return int8_linear
-        return lambda a, w: jnp.einsum("btd,df->btf", a, w)
+            return lambda a, w, site=0: int8_linear(a, w)
+        return lambda a, w, site=0: jnp.einsum("btd,df->btf", a, w)
 
     def _attn_sublayer(self, x, bp, mm, act):
         """ln1 + qkv + attention + proj + residual on [mb, T, D]."""
@@ -450,7 +472,7 @@ class GPTSpmdTrainer:
         mb, T, D = x.shape
         H, dh = cfg.num_heads, cfg.head_dim
         h = _layer_norm(x, bp["ln1_g"], bp["ln1_b"])
-        qkv = mm(h, bp["wqkv"].astype(x.dtype))
+        qkv = mm(h, bp["wqkv"].astype(x.dtype), 1)
         qkv = qkv + bp["bqkv"].astype(x.dtype)
         qkv = checkpoint_name(qkv, "qkv_out")
         shape = self.mesh.shape
@@ -486,19 +508,19 @@ class GPTSpmdTrainer:
         x = x + proj + bp["bproj"].astype(x.dtype)
         return act(x, _spec(self.mesh, "data", "sep", None))
 
-    def _block(self, x, bp):
+    def _block(self, x, bp, seed=None):
         """One transformer block on [mb, T, D] activations (GSPMD view)."""
         act = partial(jax.lax.with_sharding_constraint)
-        mm = self._mm()
+        mm = self._mm(seed)
         x = self._attn_sublayer(x, bp, mm, act)
 
         h = _layer_norm(x, bp["ln2_g"], bp["ln2_b"])
-        a = mm(h, bp["win"].astype(x.dtype))
+        a = mm(h, bp["win"].astype(x.dtype), 2)
         a = a + bp["bin"].astype(x.dtype)
         a = checkpoint_name(a, "ffn1_out")  # pre-gelu: gelu vjp needs it
         a = jax.nn.gelu(a, approximate=True)
         a = checkpoint_name(a, "ffn_act")
-        o = mm(a, bp["wout"].astype(x.dtype))
+        o = mm(a, bp["wout"].astype(x.dtype), 3)
         o = checkpoint_name(o, "ffn2_out")
         x = x + o + bp["bout"].astype(x.dtype)
         return act(x, _spec(self.mesh, "data", "sep", None))
@@ -585,7 +607,7 @@ class GPTSpmdTrainer:
         probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
         return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
-    def _stage_fn(self, stage_params, x):
+    def _stage_fn(self, stage_params, x, seed=None):
         """One pipeline stage = Lps blocks, scanned.
 
         remat: False = save everything; True = full per-block remat;
@@ -596,8 +618,17 @@ class GPTSpmdTrainer:
         elementwise) — remat's 2N extra FLOPs shrink to ~0 at the cost
         of ~9 activation buffers per layer."""
         blk = self._remat_wrap(self._block)
-        x, _ = jax.lax.scan(lambda carry, bp: (blk(carry, bp), None),
-                            x, stage_params,
+        if self.quant8 == "wgrad":
+            # scan (params, per-layer SR seed) pairs so each layer's
+            # wgrad quantization draws from its own stream
+            base = jnp.int32(1) if seed is None else seed
+            xs = (stage_params,
+                  base + jnp.arange(self.Lps, dtype=jnp.int32) * 16)
+            body = lambda carry, t: (blk(carry, t[0], t[1]), None)
+        else:
+            xs = stage_params
+            body = lambda carry, bp: (blk(carry, bp), None)
+        x, _ = jax.lax.scan(body, x, xs,
                             unroll=min(self.layer_unroll, self.Lps))
         return x
 
@@ -664,10 +695,12 @@ class GPTSpmdTrainer:
         return jax.lax.with_sharding_constraint(
             x, _spec(self.mesh, "data", "sep", None))
 
-    def _forward_loss(self, params, input_ids, labels):
+    def _forward_loss(self, params, input_ids, labels, seed=None):
         cfg = self.cfg
         B, T = input_ids.shape
         dtype = cfg.dtype
+        if self.quant8 == "wgrad" and seed is None:
+            seed = jnp.int32(1)
         x = self._embed(params["wte"], params["wpe"], input_ids)
 
         moe_aux = None
@@ -683,7 +716,16 @@ class GPTSpmdTrainer:
                     raise ValueError(
                         f"batch {B} not divisible by microbatches {self.M}")
                 xm = x.reshape(self.M, B // self.M, T, cfg.hidden_size)
-                out = jax.lax.map(partial(stage_fn, stage), xm)
+                if self.quant8 == "wgrad":
+                    # fold the microbatch index into the SR seed so the
+                    # M summed wgrads draw independent streams
+                    mb_seeds = seed + (jnp.arange(self.M, dtype=jnp.int32)
+                                       + 1) * jnp.int32(-1640531527)
+                    out = jax.lax.map(
+                        lambda t: self._stage_fn(stage, t[0], t[1]),
+                        (xm, mb_seeds))
+                else:
+                    out = jax.lax.map(partial(stage_fn, stage), xm)
                 if self.moe_experts:
                     x, aux_m = out
                     moe_aux = jnp.mean(aux_m)
@@ -693,6 +735,8 @@ class GPTSpmdTrainer:
             else:
                 if self.moe_experts:
                     x, moe_aux = stage_fn(stage, x)
+                elif self.quant8 == "wgrad":
+                    x = self._stage_fn(stage, x, seed)
                 else:
                     x = stage_fn(stage, x)
         else:
@@ -891,6 +935,11 @@ class GPTSpmdTrainer:
             return self._step_fn
 
         def step(params, opt_state, input_ids, labels):
+            # per-step SR seed for wgrad quantization; int32 multiply
+            # wraps, which only mixes the stream (never collapses it
+            # the way f32 rounding of big bases would)
+            sr_seed = (opt_state["step"].astype(jnp.int32) + 1) \
+                * jnp.int32(40503) if self.quant8 == "wgrad" else None
             if self.S > 1 and self.pipeline_schedule in ("1f1b", "vpp",
                                                          "zb"):
                 cparams = params if self._stoch_round else jax.tree.map(
@@ -902,7 +951,7 @@ class GPTSpmdTrainer:
                 # bf16 masters ARE the compute params — no cast, no
                 # second weight copy in HBM
                 loss, grads = jax.value_and_grad(self._forward_loss)(
-                    params, input_ids, labels)
+                    params, input_ids, labels, sr_seed)
             elif self.mixed_precision:
                 # cast masters -> compute dtype OUTSIDE the diff'd fn so
                 # grads materialize at cfg.dtype (AMP-O2 master-weight
@@ -910,10 +959,10 @@ class GPTSpmdTrainer:
                 cparams = jax.tree.map(
                     lambda p: p.astype(self.cfg.dtype), params)
                 loss, grads = jax.value_and_grad(self._forward_loss)(
-                    cparams, input_ids, labels)
+                    cparams, input_ids, labels, sr_seed)
             else:
                 loss, grads = jax.value_and_grad(self._forward_loss)(
-                    params, input_ids, labels)
+                    params, input_ids, labels, sr_seed)
             params, opt_state = self._adamw(params, grads, opt_state)
             return params, opt_state, loss
 

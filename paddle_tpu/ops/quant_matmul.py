@@ -26,8 +26,9 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-__all__ = ["int8_linear", "int8_linear_dgrad8", "quantize_rowwise",
-           "quantize_rowwise_fast"]
+__all__ = ["int8_linear", "int8_linear_dgrad8", "int8_linear_all8",
+           "quantize_rowwise", "quantize_rowwise_fast",
+           "sr_quantize_colwise"]
 
 
 def quantize_rowwise(x, axis):
@@ -200,3 +201,129 @@ def _bwd8(res, g):
 
 
 int8_linear_dgrad8.defvjp(_fwd8, _bwd8)
+
+
+# ---------------------------------------------------------------------------
+# int8 wgrad with stochastic rounding (round 4)
+# ---------------------------------------------------------------------------
+# The weight gradient dw[k,n] = sum_m x[m,k] g[m,n] contracts the token
+# axis. Round-to-nearest int8 there would feed a persistent, data-
+# correlated bias straight into Adam's moments; stochastic rounding
+# makes each quantization UNBIASED (E[q*s] = value), so over steps the
+# wgrad noise integrates to zero like SGD noise instead of drifting.
+# Streams are decorrelated per (step, layer, site, operand) via the
+# seed, drawn in-kernel from the TPU hardware PRNG (no HBM rng buffer —
+# the XLA lowering would write+read a full uint32 buffer per operand).
+
+def _colq_sr_kernel(seed_ref, x_ref, q_ref, s_ref):
+    from jax.experimental.pallas import tpu as pltpu
+    x = x_ref[...].astype(jnp.float32)                     # [M, bn]
+    amax = jnp.max(jnp.abs(x), axis=0, keepdims=True)
+    scale = jnp.where(amax == 0.0, 1.0, amax) / 127.0
+    pltpu.prng_seed(seed_ref[0], pl.program_id(0))
+    bits = pltpu.prng_random_bits(x.shape).astype(jnp.uint32)
+    f = jax.lax.bitcast_convert_type(
+        jnp.uint32(0x3F800000) | (bits >> 9), jnp.float32)
+    q_ref[...] = jnp.clip(jnp.floor(x / scale + (f - 1.0)),
+                          -127, 127).astype(jnp.int8)
+    s_ref[...] = scale
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _sr_colq_pallas(x2, seed_i, interpret):
+    """Column-wise (per output channel) symmetric int8 SR quantize of
+    [M, C] in ONE read of x: full-column blocks (M x 128 lanes) hold
+    the whole reduction in VMEM, so amax, SR bits, and the cast happen
+    in a single pass — the XLA lowering is a convert+abs+reduce pass
+    PLUS a re-reading cast pass (~33 ms/step of abs_reduce fusions on
+    the GPT-1.3B step before this kernel)."""
+    M, C = x2.shape
+    # f32 temps are M*bn*4 and several are live at once (x, bits, u,
+    # q-pre-cast) plus double-buffered IO: ~4.5 copies must fit the
+    # 16M scoped-vmem budget
+    bn = 256 if (C % 256 == 0 and M * 256 * 4 * 9 // 2 <= (15 << 20)) \
+        else 128
+    kernel = pl.pallas_call(
+        _colq_sr_kernel, grid=(C // bn,),
+        in_specs=[pl.BlockSpec(memory_space=pltpu_smem()),
+                  pl.BlockSpec((M, bn), lambda j: (0, j))],
+        out_specs=[pl.BlockSpec((M, bn), lambda j: (0, j)),
+                   pl.BlockSpec((1, bn), lambda j: (0, j))],
+        out_shape=[jax.ShapeDtypeStruct((M, C), jnp.int8),
+                   jax.ShapeDtypeStruct((1, C), jnp.float32)],
+        interpret=interpret)
+    return kernel(seed_i.reshape(1), x2)
+
+
+def pltpu_smem():
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.SMEM
+
+
+def _sr_colq_xla(x2, seed_i):
+    """Portable SR column quantize (CPU tests / ineligible layouts)."""
+    amax = jnp.max(jnp.abs(x2.astype(jnp.float32)), axis=0,
+                   keepdims=True)
+    scale = jnp.where(amax == 0.0, 1.0, amax) / 127.0
+    key = jax.random.fold_in(jax.random.PRNGKey(0),
+                             seed_i.astype(jnp.uint32))
+    u = jax.random.uniform(key, x2.shape, jnp.float32)
+    q = jnp.clip(jnp.floor(x2.astype(jnp.float32) / scale + u),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def sr_quantize_colwise(x2, seed_i):
+    """Unbiased int8 quantize of [M, C] with per-column scales."""
+    M, C = x2.shape
+    if jax.default_backend() in ("tpu", "axon") \
+            and jax.device_count() == 1 \
+            and C % 128 == 0 and M % 8 == 0 \
+            and M * 128 * 4 * 9 // 2 <= (15 << 20):
+        return _sr_colq_pallas(x2, seed_i, False)
+    return _sr_colq_xla(x2, seed_i)
+
+
+@jax.custom_vjp
+def int8_linear_all8(x, w, seed):
+    """int8 MXU matmul on all three step matmuls: forward and dgrad as
+    in ``int8_linear_dgrad8``; wgrad ALSO int8, with stochastic-rounding
+    quantization along the token axis (unbiased — see module note).
+    ``seed`` is a traced int32 scalar decorrelating SR streams per
+    (step, microbatch, layer, site); int32 wrap-around only mixes the
+    stream, it never collapses distinct seeds onto each other the way
+    f32 rounding of large bases would. Its cotangent is float0."""
+    del seed
+    return _int8_matmul(x, w)
+
+
+def _fwd_all8(x, w, seed):
+    return _int8_matmul(x, w), (x, w, seed)
+
+
+def _bwd_all8(res, g):
+    x, w, seed = res
+    # dgrad: int8 per-row, as int8_linear_dgrad8
+    gq, gs = quantize_rowwise_fast(g, axis=-1)
+    wq, ws = quantize_rowwise_fast(w, axis=1)
+    y = jax.lax.dot_general(gq, wq, (((g.ndim - 1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.int32)
+    dx = (y.astype(jnp.float32) * gs *
+          jnp.reshape(ws, (1,) * (g.ndim - 1) + (-1,)))
+    # wgrad: int8 with SR quantization along the contraction (tokens)
+    K = x.shape[-1]
+    N = g.shape[-1]
+    x2 = x.reshape(-1, K)
+    g2 = g.reshape(-1, N)
+    base = jnp.asarray(seed, jnp.int32) * jnp.int32(1000003)
+    xq, xs = sr_quantize_colwise(x2, base + jnp.int32(7919))
+    gq2, gs2 = sr_quantize_colwise(g2, base + jnp.int32(104729))
+    dwi = jax.lax.dot_general(xq, gq2, (((0,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    dw = dwi.astype(jnp.float32) * xs.reshape(K, 1) * gs2  # [K,N]
+    import numpy as np
+    return (dx.astype(x.dtype), dw.astype(w.dtype),
+            np.zeros((), jax.dtypes.float0))
+
+
+int8_linear_all8.defvjp(_fwd_all8, _bwd_all8)
