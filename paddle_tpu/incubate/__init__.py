@@ -171,3 +171,5 @@ class ModelAverage:
 
 
 from .. import inference  # noqa: F401  (paddle.incubate.inference alias)
+
+from . import optimizer  # noqa: F401,E402

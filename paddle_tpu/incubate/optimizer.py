@@ -1,0 +1,13 @@
+"""paddle.incubate.optimizer namespace (reference:
+python/paddle/incubate/optimizer/__init__.py): LARS momentum, plus the
+incubating wrappers (LookAhead lives at the top incubate level here)."""
+from ..optimizer.lars_dgc import LarsMomentumOptimizer  # noqa: F401
+
+__all__ = ["LarsMomentumOptimizer", "LookAhead"]
+
+
+def __getattr__(name):
+    if name == "LookAhead":
+        from . import LookAhead
+        return LookAhead
+    raise AttributeError(name)

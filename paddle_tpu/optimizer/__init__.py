@@ -4,4 +4,6 @@ from .adam import Adam, AdamW, Adamax, Lamb  # noqa: F401
 from .misc import (RMSProp, Adagrad, Adadelta, ASGD, Rprop,  # noqa: F401
                    NAdam, RAdam)
 from .lbfgs import LBFGS  # noqa: F401
+from .lars_dgc import (LarsMomentumOptimizer,  # noqa: F401
+                       DGCMomentumOptimizer)
 from . import lr  # noqa: F401

@@ -1,0 +1,168 @@
+"""LARS + DGC optimizers (reference:
+incubate/optimizer/lars_momentum.py, fleet/meta_optimizers/
+dgc_optimizer.py). Convergence checked against a Momentum baseline on
+a small regression problem; DGC additionally pins the sparsification
+recurrence (residual accumulation, rampup schedule) and the DP
+allreduce hook semantics."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.optimizer import (Momentum, LarsMomentumOptimizer,
+                                  DGCMomentumOptimizer)
+
+
+def _problem(seed=0, n=256, din=16):
+    rng = np.random.RandomState(seed)
+    X = rng.randn(n, din).astype(np.float32)
+    Wtrue = rng.randn(din, 1).astype(np.float32)
+    y = X @ Wtrue + 0.01 * rng.randn(n, 1).astype(np.float32)
+    return X, y
+
+
+def _train(make_opt, steps=120, seed=0):
+    paddle.seed(7)
+    X, y = _problem(seed)
+    net = nn.Sequential(nn.Linear(16, 32), nn.Tanh(), nn.Linear(32, 1))
+    opt = make_opt(net.parameters())
+    xb = paddle.to_tensor(X)
+    yb = paddle.to_tensor(y)
+    loss_fn = nn.MSELoss()
+    losses = []
+    for _ in range(steps):
+        out = net(xb)
+        loss = loss_fn(out, yb)
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(loss.numpy()))
+    return losses
+
+
+def test_lars_converges_like_momentum():
+    base = _train(lambda ps: Momentum(learning_rate=0.03, momentum=0.9,
+                                      parameters=ps))
+    lars = _train(lambda ps: LarsMomentumOptimizer(
+        learning_rate=2.0, momentum=0.9, lars_coeff=0.02,
+        lars_weight_decay=1e-4, parameters=ps))
+    assert lars[-1] < lars[0] * 0.2          # it optimizes
+    assert lars[-1] < max(base[-1] * 3, 0.5)  # and lands near baseline
+
+
+def test_lars_trust_ratio_scales_per_layer():
+    # two params with very different norms get different local lrs:
+    # check the update magnitude ratio tracks ||p||/||g|| scaling
+    p_small = paddle.create_parameter([8, 8], "float32")
+    p_big = paddle.create_parameter([8, 8], "float32")
+    with paddle.no_grad():
+        p_small.set_value(paddle.full([8, 8], 0.01))
+        p_big.set_value(paddle.full([8, 8], 10.0))
+    opt = LarsMomentumOptimizer(learning_rate=0.1, momentum=0.0,
+                                lars_coeff=0.001, lars_weight_decay=0.0,
+                                parameters=[p_small, p_big])
+    (p_small.sum() + p_big.sum()).backward()
+    before_s = p_small.numpy().copy()
+    before_b = p_big.numpy().copy()
+    opt.step()
+    ds = np.abs(before_s - p_small.numpy()).mean()
+    db = np.abs(before_b - p_big.numpy()).mean()
+    # same gradient (ones), so update ratio == norm ratio == 1000
+    assert db / ds > 100
+
+
+def test_lars_exclude_from_weight_decay():
+    p = paddle.create_parameter([4, 4], "float32", name="bn_scale")
+    with paddle.no_grad():
+        p.set_value(paddle.full([4, 4], 2.0))
+    opt = LarsMomentumOptimizer(learning_rate=0.1, momentum=0.0,
+                                lars_coeff=0.001, lars_weight_decay=0.5,
+                                parameters=[p],
+                                exclude_from_weight_decay=["bn_"])
+    p.sum().backward()
+    opt.step()
+    # excluded => plain momentum at base lr: p - lr * g = 2.0 - 0.1
+    np.testing.assert_allclose(p.numpy(), np.full((4, 4), 1.9), rtol=1e-5)
+
+
+def test_dgc_converges_with_high_sparsity():
+    base = _train(lambda ps: Momentum(learning_rate=0.03, momentum=0.9,
+                                      parameters=ps), steps=200)
+    # the reference's 99.9% sparsity presumes million-entry tensors
+    # (update interval ~ 1/(1-s) steps per coordinate); on these 512-
+    # param test layers 0.9 already means ~10-step delays
+    dgc = _train(lambda ps: DGCMomentumOptimizer(
+        learning_rate=0.03, momentum=0.9, rampup_begin_step=20,
+        rampup_step=40, sparsity=[0.5, 0.75, 0.9],
+        parameters=ps), steps=200)
+    assert dgc[-1] < dgc[0] * 0.2
+    assert dgc[-1] < max(base[-1] * 5, 0.5)
+
+
+def test_dgc_rampup_schedule():
+    p = paddle.create_parameter([8, 128], "float32")
+    opt = DGCMomentumOptimizer(learning_rate=0.1, momentum=0.9,
+                               rampup_begin_step=3, rampup_step=4,
+                               sparsity=[0.5, 0.99], parameters=[p])
+    seen = []
+    for step in range(8):
+        seen.append(opt.current_sparsity())
+        p.sum().backward()
+        opt.step()
+        opt.clear_grad()
+    assert seen[:3] == [0.0, 0.0, 0.0]       # dense before rampup
+    assert seen[3] == 0.5 and seen[-1] == 0.99
+
+
+def test_dgc_residual_accumulation_preserves_mass():
+    # entries suppressed by the mask stay in the residual v and are
+    # eventually sent: with a constant gradient, total applied update
+    # over many steps approaches the dense equivalent
+    p = paddle.create_parameter([4, 256], "float32")
+    with paddle.no_grad():
+        p.set_value(paddle.zeros([4, 256]))
+    opt = DGCMomentumOptimizer(learning_rate=1.0, momentum=0.0,
+                               rampup_begin_step=0, rampup_step=1,
+                               sparsity=[0.9], parameters=[p])
+    g = np.linspace(0.001, 0.1, 1024).astype(np.float32).reshape(4, 256)
+    gt = paddle.to_tensor(g)
+    steps = 60
+    for _ in range(steps):
+        (p * gt).sum().backward()
+        opt.step()
+        opt.clear_grad()
+    # conservation: applied (-p) plus the residual still waiting in v
+    # equals the dense total steps*g exactly — nothing is lost, only
+    # delayed (momentum=0 makes the algebra exact)
+    v = np.asarray(opt._accumulators[p.name]["_dgc_v_"])
+    np.testing.assert_allclose(-p.numpy() + v, steps * g, rtol=2e-4)
+    # and the frequently-sent large coordinates are nearly fully
+    # applied: their residual is worth only a few steps' gradient,
+    # while the smallest coordinates may still be accumulating
+    big = g > np.quantile(g, 0.9)
+    assert (v[big] <= g[big] * 5).all()
+
+
+def test_dgc_allreduce_hook_applies_to_sparse_grad():
+    calls = []
+
+    def fake_allreduce(x):
+        calls.append(x.size)
+        return x * 2.0  # pretend 2 workers summed identical grads
+
+    p = paddle.create_parameter([8, 128], "float32")
+    opt = DGCMomentumOptimizer(learning_rate=0.1, momentum=0.0,
+                               rampup_begin_step=0, rampup_step=1,
+                               sparsity=[0.9], parameters=[p],
+                               allreduce=fake_allreduce)
+    gm = np.linspace(0.1, 1.0, 1024).astype(np.float32).reshape(8, 128)
+    before = p.numpy().copy()
+    (p * paddle.to_tensor(gm)).sum().backward()
+    opt.step()
+    assert calls  # the hook saw the sparsified gradient
+    moved = np.abs(before - p.numpy())
+    # only ~10% of entries moved, each by 2x lr (the hooked doubling)
+    frac = (moved > 0).mean()
+    assert 0.02 < frac < 0.25
+    np.testing.assert_allclose(moved[moved > 0].max(), 0.2, rtol=1e-3)
