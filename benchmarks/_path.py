@@ -1,0 +1,15 @@
+"""Repo-root import shim for the benchmark scripts.
+
+Run as `python benchmarks/<script>.py`: sys.path[0] is benchmarks/, so
+`paddle_tpu` is not importable — and exporting PYTHONPATH=/root/repo is
+NOT an option because the axon TPU plugin fails to register when
+PYTHONPATH is set (observed round 4: "Backend 'axon' is not in the list
+of known backends"). Every benchmark does `import _path` first; the
+insert must happen in-process.
+"""
+import os
+import sys
+
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _repo not in sys.path:
+    sys.path.insert(0, _repo)

@@ -6,6 +6,8 @@ the same step — the DP allreduce path the reference drives with
 EagerReducer bucketed NCCL (here: GSPMD data-axis sharding; XLA fuses
 the gradient allreduce into the backward).
 """
+import _path  # noqa: F401  (repo-root import shim)
+
 import json
 import time
 

@@ -2,6 +2,8 @@
 sharding (GroupShardedStage3 analog: param/grad/optimizer-state sharding
 over the dp axis).
 """
+import _path  # noqa: F401  (repo-root import shim)
+
 import json
 import time
 

@@ -11,6 +11,8 @@ fp32), batched decode bs in {1, 8, 32}, fp32-vs-bf16 greedy parity
 check, and a proper device-side drain (the tunneled chip dispatches
 async — timing without forcing the last token undercounts).
 """
+import _path  # noqa: F401  (repo-root import shim)
+
 import json
 import time
 

@@ -2,6 +2,8 @@
 through the serving Predictor, latency + throughput (the reference's
 AnalysisPredictor/TensorRT path).
 """
+import _path  # noqa: F401  (repo-root import shim)
+
 import json
 import os
 import tempfile

@@ -3,6 +3,8 @@ bf16, jitted TrainStep) and inference images/sec on one chip.
 
 Prints one JSON line per phase. CPU smoke mode uses a tiny batch.
 """
+import _path  # noqa: F401  (repo-root import shim)
+
 import json
 import time
 

@@ -2,6 +2,8 @@
 (BASELINE.md: 'performance baselines must be produced by our own
 measurement harness'). Each script is standalone; failures don't stop
 the rest."""
+import _path  # noqa: F401  (repo-root import shim)
+
 import os
 import subprocess
 import sys

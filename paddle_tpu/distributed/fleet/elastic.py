@@ -69,6 +69,7 @@ class ElasticManager:
         self._stop = threading.Event()
         self._watcher: Optional[threading.Thread] = None
         self._failed = False
+        self._scale_up: list = []
 
     # -- membership (coordination-service analog of etcd registry) --------
     def register(self, rank: Optional[int] = None,
@@ -82,6 +83,19 @@ class ElasticManager:
         self._register_mono = time.monotonic()
         if self.store is not None:
             self.store.add(f"elastic/node/{rank}", 1)
+            # Registry keys are never deleted, so a key beyond the
+            # current world may be a STALE leftover from a larger past
+            # incarnation. Snapshot such keys pre-expired: only a
+            # counter that MOVES after this point (a live joiner
+            # heartbeating) can report as a scale-up — a frozen relic
+            # cannot flap the job into a relaunch loop.
+            expired = self._register_mono - self.heartbeat_timeout - 1.0
+            for r in range(world, world + 8):
+                try:
+                    v = self.store.get(f"elastic/node/{r}", timeout=0.05)
+                except Exception:
+                    continue
+                self._seen[r] = (v, expired)
         self._last_beats = {r: time.monotonic() for r in range(world)}
         return self
 
@@ -129,8 +143,42 @@ class ElasticManager:
         return [r for r, t in self._last_beats.items()
                 if now - t > self.heartbeat_timeout]
 
-    def watch(self):
-        """Background failure watch (launcher controller.py poll analog)."""
+    # -- scale-up (reference manager.py watches BOTH directions) ----------
+    def announce_join(self, rank: int):
+        """Called by a NEW worker (rank >= current world) asking the
+        job to grow; existing workers see it via ``joined_peers`` and
+        exit for an upsized relaunch (reference: the etcd watch on the
+        node prefix firing for added members, manager.py:125)."""
+        if self.store is None:
+            raise RuntimeError("announce_join requires a shared store")
+        self.store.add(f"elastic/node/{rank}", 1)
+
+    def joined_peers(self, probe: int = 8):
+        """Fresh registry entries BEYOND the current world size — i.e.
+        new workers waiting to be folded in at the next relaunch. Only
+        ranks with an actual registry key count (absent ranks get no
+        startup grace here; they never claimed to exist)."""
+        if self.store is None or self._world is None:
+            return []
+        now = time.monotonic()
+        out = []
+        for r in range(self._world, self._world + probe):
+            try:
+                v = self.store.get(f"elastic/node/{r}", timeout=0.05)
+            except Exception:
+                continue
+            prev = self._seen.get(r)
+            if prev is None or prev[0] != v:
+                self._seen[r] = (v, now)
+                out.append(r)
+            elif now - prev[1] <= self.heartbeat_timeout:
+                out.append(r)
+        return out
+
+    def watch(self, on_scale_up: Optional[Callable] = None):
+        """Background failure watch (launcher controller.py poll analog).
+        With ``elastic_level=ELASTIC`` (or an ``on_scale_up`` callback)
+        the loop also fires when new peers announce themselves."""
         def loop():
             while not self._stop.is_set():
                 dead = self.dead_peers()
@@ -139,6 +187,16 @@ class ElasticManager:
                     if self.on_failure is not None:
                         self.on_failure(dead)
                     break
+                if on_scale_up is not None or \
+                        self.elastic_level == ElasticLevel.ELASTIC:
+                    joined = self.joined_peers()
+                    if joined:
+                        # always observable: the host polls .scale_up
+                        # (or .failed) after the watcher ends
+                        self._scale_up = joined
+                        if on_scale_up is not None:
+                            on_scale_up(joined)
+                        break
                 self._stop.wait(self.heartbeat_interval)
 
         self._watcher = threading.Thread(target=loop, daemon=True)
@@ -151,6 +209,13 @@ class ElasticManager:
     @property
     def failed(self) -> bool:
         return self._failed
+
+    @property
+    def scale_up(self) -> list:
+        """New peer ranks the watch loop detected (empty if none).
+        The watcher thread ends on either event — poll ``failed`` and
+        ``scale_up`` to tell which fired."""
+        return self._scale_up
 
     # -- checkpoint-restart protocol --------------------------------------
     def save(self, state_dict, step: int):

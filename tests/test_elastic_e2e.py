@@ -149,3 +149,125 @@ def test_store_ttl_membership(tmp_path):
         assert em.alive_nodes() == [0]
     finally:
         master.close()
+
+
+_WORKER_UP = r"""
+import os, sys, time
+rank = int(sys.argv[1]); port = int(sys.argv[2]); ck = sys.argv[3]
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.distributed.fleet.elastic import (ElasticManager,
+                                                  ELASTIC_EXIT_CODE)
+
+store = TCPStore("127.0.0.1", port, is_master=False, world_size=2)
+em = ElasticManager(checkpoint_dir=ck, heartbeat_interval=0.1,
+                    heartbeat_timeout=2.0, store=store)
+em.register(rank=rank, world=2)
+
+w = jnp.arange(8, dtype=jnp.float32)
+for step in range(1, 4):
+    w = w + 1.0
+    em.heartbeat()
+    if rank == 0:
+        from paddle_tpu.distributed.checkpoint import save_state_dict
+        save_state_dict({"w": w, "step": np.int32(step)},
+                        os.path.join(ck, f"step_{step}"))
+        with open(os.path.join(ck, "LATEST"), "w") as f:
+            f.write(str(step))
+    time.sleep(0.1)
+
+# steady state: heartbeat while watching for NEW peers wanting in
+deadline = time.time() + 15
+while time.time() < deadline:
+    em.heartbeat()
+    joined = em.joined_peers()
+    if joined:
+        assert joined == [2], joined
+        sys.stdout.write(f"scale-up: new peers {joined}\n")
+        sys.stdout.flush()
+        os._exit(ELASTIC_EXIT_CODE)   # relaunch with the larger world
+    time.sleep(0.1)
+os._exit(3)
+"""
+
+_JOINER = r"""
+import sys, time
+port = int(sys.argv[1])
+from paddle_tpu.distributed.store import TCPStore
+from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+store = TCPStore("127.0.0.1", port, is_master=False, world_size=2)
+em = ElasticManager(checkpoint_dir="/tmp", store=store)
+em.announce_join(rank=2)
+# keep the key fresh until the incumbents have seen it
+for _ in range(30):
+    store.add("elastic/node/2", 1)
+    time.sleep(0.1)
+print("announced")
+"""
+
+_RELAUNCH_UP = r"""
+import os, sys
+rank = int(sys.argv[1]); ck = sys.argv[2]
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+em = ElasticManager(checkpoint_dir=ck)
+tmpl = {"w": jnp.zeros(8, jnp.float32), "step": np.int32(0)}
+step = em.restore(tmpl)
+assert step == 3, step
+np.testing.assert_array_equal(np.asarray(tmpl["w"]),
+                              np.arange(8, dtype=np.float32) + 3)
+# resume: one more training step in the GROWN world
+w = tmpl["w"] + 1.0
+print(f"rank {rank} of 3 resumed at step {step+1}, w0={float(w[0])}")
+"""
+
+
+def test_scale_up_detect_relaunch_resume(tmp_path):
+    """A new peer announces itself mid-run; the incumbents detect it,
+    exit with the relaunch code, and the next incarnation resumes from
+    the checkpoint with world grown 2 -> 3 (reference: manager.py:125
+    watches both scale directions)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    master = TCPStore("127.0.0.1", 0, is_master=True, world_size=2)
+    try:
+        ck = str(tmp_path / "elastic_up_ck")
+        os.makedirs(ck, exist_ok=True)
+        workers = [subprocess.Popen(
+            [sys.executable, "-c", _WORKER_UP, str(r), str(master.port),
+             ck],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True) for r in range(2)]
+        time.sleep(1.0)  # let them reach steady state
+        joiner = subprocess.Popen(
+            [sys.executable, "-c", _JOINER, str(master.port)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True)
+        outs = [p.communicate(timeout=120)[0] for p in workers]
+        joiner.communicate(timeout=120)
+        for r, (p, out) in enumerate(zip(workers, outs)):
+            assert p.returncode == ELASTIC_EXIT_CODE, (r, out)
+            assert "scale-up: new peers [2]" in out
+
+        # upsized relaunch: THREE ranks resume from the checkpoint
+        for r in range(3):
+            res = subprocess.run(
+                [sys.executable, "-c", _RELAUNCH_UP, str(r), ck],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True, timeout=120)
+            assert res.returncode == 0, res.stdout
+            assert f"rank {r} of 3 resumed at step 4, w0=4.0" \
+                in res.stdout
+    finally:
+        master.close()
