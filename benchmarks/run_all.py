@@ -16,13 +16,11 @@ SCRIPTS = ["bench_resnet50.py", "bench_bert_dp.py", "bench_gpt_hybrid.py",
 def main():
     here = os.path.dirname(os.path.abspath(__file__))
     for s in SCRIPTS:
+        # plain environment: each script resolves the repo root via
+        # benchmarks/_path.py, and PYTHONPATH must stay unset (it
+        # breaks axon TPU plugin registration)
         r = subprocess.run([sys.executable, os.path.join(here, s)],
-                           capture_output=True, text=True, timeout=1800,
-                           env=dict(os.environ,
-                                    PYTHONPATH=os.pathsep.join(
-                                        [os.path.dirname(here)] +
-                                        os.environ.get("PYTHONPATH", "")
-                                        .split(os.pathsep))))
+                           capture_output=True, text=True, timeout=1800)
         for line in r.stdout.splitlines():
             if line.startswith("{"):
                 print(line)

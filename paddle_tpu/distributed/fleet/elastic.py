@@ -155,9 +155,15 @@ class ElasticManager:
 
     def joined_peers(self, probe: int = 8):
         """Fresh registry entries BEYOND the current world size — i.e.
-        new workers waiting to be folded in at the next relaunch. Only
-        ranks with an actual registry key count (absent ranks get no
-        startup grace here; they never claimed to exist)."""
+        new workers waiting to be folded in at the next relaunch.
+
+        A key only counts once its counter is OBSERVED MOVING: a
+        first-seen key is recorded and reported on a later poll when it
+        has advanced. Registry keys are never deleted, so a frozen
+        relic from a larger past incarnation (any rank, inside or
+        outside register()'s snapshot window) can never flap the job
+        into a relaunch loop; a real joiner heartbeats and is seen one
+        poll later."""
         if self.store is None or self._world is None:
             return []
         now = time.monotonic()
@@ -168,7 +174,9 @@ class ElasticManager:
             except Exception:
                 continue
             prev = self._seen.get(r)
-            if prev is None or prev[0] != v:
+            if prev is None:
+                self._seen[r] = (v, now - self.heartbeat_timeout - 1.0)
+            elif prev[0] != v:
                 self._seen[r] = (v, now)
                 out.append(r)
             elif now - prev[1] <= self.heartbeat_timeout:

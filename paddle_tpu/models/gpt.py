@@ -659,6 +659,13 @@ class GPTSpmdTrainer:
             # saving it only costs a stacked buffer + copy traffic
             pol = jax.checkpoint_policies.save_only_these_names(
                 "qkv_out", "ffn1_out", "flash_out", "flash_lse")
+        elif self.remat == "save_qkv":
+            # S=2048 memory recipe: drops the stacked ffn1_out residual
+            # too (~3.2 GB at bs4/seq2048) — backward re-runs the ffn1
+            # matmul and gelu from the recomputed ln2 output in exchange
+            # for the batch size the freed HBM buys
+            pol = jax.checkpoint_policies.save_only_these_names(
+                "qkv_out")
         elif self.remat == "save_qkv_ffn":
             # drops the flash out/lse residuals too: backward re-runs
             # the flash FORWARD kernel from the saved qkv projection

@@ -29,6 +29,15 @@ _LANES = 128  # VPU lane width: scalar-per-row carries live as [bq, 128]
 
 
 def _choose_block(seq_len: int, target: int = 512) -> int:
+    import os
+    raw = os.environ.get("PTPU_FLASH_BLOCK", "")
+    if raw:
+        try:
+            override = int(raw)
+        except ValueError:
+            override = 0
+        if override >= 1:  # invalid/sentinel values keep the default
+            target = override
     b = min(target, seq_len)
     while seq_len % b:
         b //= 2

@@ -202,8 +202,9 @@ from paddle_tpu.distributed.fleet.elastic import ElasticManager
 store = TCPStore("127.0.0.1", port, is_master=False, world_size=2)
 em = ElasticManager(checkpoint_dir="/tmp", store=store)
 em.announce_join(rank=2)
-# keep the key fresh until the incumbents have seen it
-for _ in range(30):
+# keep the key fresh until the incumbents have seen it — long enough
+# to outlive a slow (cold jax import) worker startup
+for _ in range(150):
     store.add("elastic/node/2", 1)
     time.sleep(0.1)
 print("announced")
