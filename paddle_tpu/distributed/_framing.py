@@ -1,0 +1,52 @@
+"""Shared length-prefixed socket framing ('<Q' header + body).
+
+One protocol, two transports: the rpc agent (distributed/rpc.py) and
+the cross-process DistModel pipeline (inference/dist_model_mp.py) —
+kept here so a framing change (checksums, size guards) cannot silently
+diverge between them. csrc/tcp_store.cc uses the same shape natively.
+"""
+from __future__ import annotations
+
+import socket
+import struct
+from typing import Optional
+
+__all__ = ["send_msg", "recv_msg", "recv_exact", "nodelay"]
+
+
+def nodelay(sock: socket.socket) -> socket.socket:
+    """Small frames + request/response chaining: Nagle batching would
+    park them on delayed-ACK ticks (measured +548% on the 2-stage
+    serving pipeline before this)."""
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return sock
+
+
+def send_msg(sock: socket.socket, data: bytes) -> None:
+    sock.sendall(struct.pack("<Q", len(data)) + data)
+
+
+def recv_msg(sock: socket.socket,
+             eof_ok: bool = False) -> Optional[bytes]:
+    """One frame; on clean EOF returns None (eof_ok) or raises
+    ConnectionError. EOF mid-frame always raises."""
+    hdr = recv_exact(sock, 8, eof_ok=eof_ok)
+    if hdr is None:
+        return None
+    (n,) = struct.unpack("<Q", hdr)
+    return recv_exact(sock, n)
+
+
+def recv_exact(sock: socket.socket, n: int,
+               eof_ok: bool = False) -> Optional[bytes]:
+    chunks = []
+    got = 0
+    while got < n:
+        b = sock.recv(min(n - got, 1 << 20))
+        if not b:
+            if eof_ok and got == 0:
+                return None
+            raise ConnectionError("peer closed mid-frame")
+        chunks.append(b)
+        got += len(b)
+    return b"".join(chunks)

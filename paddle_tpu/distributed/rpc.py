@@ -131,25 +131,8 @@ class _RpcAgent:
         self._client_pool.shutdown(wait=False)
 
 
-def _send_msg(s: socket.socket, data: bytes):
-    s.sendall(struct.pack("<Q", len(data)) + data)
-
-
-def _recv_msg(s: socket.socket) -> bytes:
-    hdr = _recv_exact(s, 8)
-    (n,) = struct.unpack("<Q", hdr)
-    return _recv_exact(s, n)
-
-
-def _recv_exact(s: socket.socket, n: int) -> bytes:
-    chunks = []
-    while n:
-        b = s.recv(min(n, 1 << 20))
-        if not b:
-            raise ConnectionError("rpc peer closed")
-        chunks.append(b)
-        n -= len(b)
-    return b"".join(chunks)
+from ._framing import send_msg as _send_msg, recv_msg as _recv_msg, \
+    recv_exact as _recv_exact  # shared '<Q' framing (one protocol)
 
 
 _agent: Optional[_RpcAgent] = None
