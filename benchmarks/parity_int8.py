@@ -28,6 +28,12 @@ def main():
     ap.add_argument("--out", default="/tmp/parity_int8.json")
     ap.add_argument("--quant8", default="dgrad",
                     choices=["dgrad", "wgrad"])
+    ap.add_argument("--decay", action="store_true",
+                    help="cosine-decay lr to 10%% over the run: the "
+                         "gradients shrink into the quantization "
+                         "noise floor, the regime the fixed-lr runs "
+                         "never test")
+    ap.add_argument("--guard-period", type=int, default=0)
     args = ap.parse_args()
 
     import jax
@@ -41,11 +47,19 @@ def main():
                     max_seq_len=args.seq, dtype=jnp.bfloat16)
     mesh = build_mesh(n_devices=1, pipe=1, model=1, fsdp=1, sep=1)
 
+    sched = None
+    if args.decay:
+        import jax.numpy as jnp2
+        T = float(args.steps)
+        sched = lambda t: 0.1 + 0.45 * (1 + jnp2.cos(
+            jnp2.pi * jnp2.minimum(t / T, 1.0)))
+
     def make(quant8):
         return GPTSpmdTrainer(
             cfg, mesh, microbatches=1, remat="save_qkv_ffn",
             moment_dtype=jnp.bfloat16, master_dtype=jnp.bfloat16,
-            quant8=quant8, ce_chunks=4, seed=0)
+            quant8=quant8, ce_chunks=4, seed=0, lr_schedule=sched,
+            int8_guard_period=args.guard_period if quant8 else 0)
 
     def run(quant8):
         tr = make(quant8)
@@ -64,6 +78,7 @@ def main():
 
     import gc
     tr8, l8, dt8 = run(args.quant8)
+    tr8_events = tr8.guard_events()
     # only one 7.8 GB trainer fits: keep the curves, free the state
     del tr8
     gc.collect()
@@ -102,6 +117,8 @@ def main():
         "final_gap": round(abs(lb[-1] - l8[-1]), 4),
         "max_gap": max(gaps), "mean_gap": round(float(np.mean(gaps)), 5),
         "grad_snr_at_end": snrs,
+        "decay": bool(args.decay),
+        "guard_events": getattr(tr8_events, "copy", lambda: [])(),
         "minutes": round((dt8 + dtb) / 60, 1),
     }
     with open(args.out, "w") as f:

@@ -229,7 +229,10 @@ class GPTSpmdTrainer:
                  moe_aux_weight: float = 1e-2,
                  fused_optimizer: Optional[bool] = None,
                  layer_unroll: int = 1,
-                 ce_chunks: int = 16):
+                 ce_chunks: int = 16,
+                 lr_schedule=None,
+                 int8_guard_period: int = 0,
+                 int8_guard_threshold: float = 0.10):
         self.cfg = cfg
         self.mesh = mesh
         self.remat = remat  # per-block activation checkpointing
@@ -260,6 +263,28 @@ class GPTSpmdTrainer:
         # (ops/quant_matmul.int8_linear_all8); SR streams are seeded
         # per (step, layer, site) from the optimizer step counter.
         self.quant8 = quant8
+        # lr_schedule: traced fn step_f32 -> multiplier on the base lr
+        # (cosine decay etc.); costs nothing — the multiplier rides the
+        # fused kernel's scalar vector.
+        self.lr_schedule = lr_schedule
+        # int8 drift guard: every `period` steps measure the relative
+        # dgrad error of the int8 path on ONE layer-0 matmul (~1% of a
+        # step); if it exceeds the threshold, fall back one quant tier
+        # (wgrad -> dgrad -> exact) and recompile the step. Exists
+        # because the 500-step parity runs end with wqkv SNR ~1 — the
+        # default is earned, but nothing should drift unwatched.
+        self.int8_guard_period = int(int8_guard_period)
+        self.int8_guard_threshold = float(int8_guard_threshold)
+        if self.int8_guard_period and mesh.shape.get("pipe", 1) > 1:
+            # the probe indexes blocks leaves as [S, L, ...][0, 0];
+            # pipelined/VPP layouts need their own probe — refuse
+            # loudly rather than crash inside the jitted probe
+            raise ValueError(
+                "int8_guard_period requires a single-stage mesh "
+                "(pipe=1)")
+        self._guard_fn = None
+        self._guard_events = []
+        self._host_step = 0
         if quant8 == "wgrad" and moe_experts:
             raise ValueError("quant8='wgrad' not wired for MoE blocks")
         if quant8 == "wgrad" and mesh.shape.get("pipe", 1) > 1:
@@ -885,14 +910,18 @@ class GPTSpmdTrainer:
         scale = jnp.minimum(1.0, self.grad_clip / (gnorm + 1e-6))
         step_u32 = step.astype(jnp.uint32)
 
+        lr_mult = jnp.float32(1.0) if self.lr_schedule is None \
+            else jnp.asarray(self.lr_schedule(tf), jnp.float32)
+
         def upd(p, g, m, v, key):
             g = g.astype(jnp.float32) * scale
             m2 = b1 * m.astype(jnp.float32) + (1 - b1) * g
             v2 = b2 * v.astype(jnp.float32) + (1 - b2) * g * g
             mhat = m2 / (1 - b1 ** tf)
             vhat = v2 / (1 - b2 ** tf)
-            p2 = p.astype(jnp.float32) * (1 - self.lr * self.wd) - \
-                self.lr * mhat / (jnp.sqrt(vhat) + 1e-8)
+            lr_t = self.lr * lr_mult
+            p2 = p.astype(jnp.float32) * (1 - lr_t * self.wd) - \
+                lr_t * mhat / (jnp.sqrt(vhat) + 1e-8)
             if self._stoch_round:
                 p2 = _stochastic_round_bf16(p2, key)
             return (p2, m2.astype(self.moment_dtype),
@@ -919,7 +948,8 @@ class GPTSpmdTrainer:
                     step.astype(jnp.int32),
                     lr=float(self.lr), wd=float(self.wd),
                     b1=b1f, b2=b2f, eps=1e-8,
-                    stoch_round=self._stoch_round, leaf_id=i)
+                    stoch_round=self._stoch_round, leaf_id=i,
+                    lr_scale=lr_mult)
                 new_p.append(p2)
                 new_m.append(m2.astype(self.moment_dtype))
                 new_v.append(v2.astype(self.moment_dtype))
@@ -979,6 +1009,83 @@ class GPTSpmdTrainer:
             in_shardings=(None, None, data_spec, data_spec))
         return self._step_fn
 
+    def _build_guard(self):
+        """Jitted drift probe: relative error of the int8 dgrad (and,
+        in wgrad mode, the SR int8 wgrad) on layer 0's qkv matmul with
+        the CURRENT weights — ~1% of a step. The 500-step parity runs
+        end with wqkv SNR ~1, so the int8 default is watched, not
+        assumed (benchmarks/RESULTS.md)."""
+        from ..ops.quant_matmul import (quantize_rowwise_fast,
+                                        sr_quantize_colwise)
+        wgrad_mode = self.quant8 == "wgrad"
+
+        def probe(params, input_ids, seed):
+            x = self._embed(params["wte"], params["wpe"], input_ids)
+            bp = jax.tree.map(lambda a: a[0, 0], params["blocks"])
+            h = _layer_norm(x, bp["ln1_g"], bp["ln1_b"])
+            w = bp["wqkv"].astype(h.dtype)
+            key = jax.random.PRNGKey(seed.astype(jnp.uint32))
+            g = jax.random.normal(
+                key, h.shape[:-1] + (w.shape[1],)).astype(h.dtype)
+            dx_e = jax.lax.dot_general(
+                g, w, (((g.ndim - 1,), (1,)), ((), ()))) \
+                .astype(jnp.float32)
+            gq, gs = quantize_rowwise_fast(g, axis=-1)
+            wq, ws = quantize_rowwise_fast(w, axis=1)
+            y = jax.lax.dot_general(
+                gq, wq, (((g.ndim - 1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            dx_i = (y.astype(jnp.float32) * gs *
+                    jnp.reshape(ws, (1,) * (g.ndim - 1) + (-1,)))
+            rel = jnp.linalg.norm(dx_i - dx_e) / \
+                (jnp.linalg.norm(dx_e) + 1e-30)
+            if wgrad_mode:
+                D = h.shape[-1]
+                N = w.shape[1]
+                h2 = h.reshape(-1, D)
+                g2 = g.reshape(-1, N)
+                dw_e = jax.lax.dot_general(
+                    h2, g2, (((0,), (0,)), ((), ()))) \
+                    .astype(jnp.float32)
+                si = seed.astype(jnp.int32)
+                xq, xs = sr_quantize_colwise(h2, si)
+                gq2, gs2 = sr_quantize_colwise(g2, si + 1)
+                dwi = jax.lax.dot_general(
+                    xq, gq2, (((0,), (0,)), ((), ())),
+                    preferred_element_type=jnp.int32)
+                dw_i = dwi.astype(jnp.float32) * \
+                    xs.reshape(D, 1) * gs2
+                relw = jnp.linalg.norm(dw_i - dw_e) / \
+                    (jnp.linalg.norm(dw_e) + 1e-30)
+                rel = jnp.maximum(rel, relw)
+            return rel
+
+        return jax.jit(probe)
+
+    def _run_guard(self, input_ids):
+        """Measure drift; fall back one int8 tier if it exceeds the
+        threshold (wgrad -> dgrad -> exact bf16). Returns the measured
+        relative error."""
+        if self._guard_fn is None:
+            self._guard_fn = self._build_guard()
+        seed = self.opt_state["step"].astype(jnp.float32)
+        r = float(jax.device_get(
+            self._guard_fn(self.params, input_ids, seed)))
+        if r > self.int8_guard_threshold:
+            ladder = {"wgrad": "dgrad", "dgrad": False, True: False}
+            nxt = ladder.get(self.quant8, False)
+            self._guard_events.append(
+                {"step": int(jax.device_get(self.opt_state["step"])),
+                 "rel_err": r, "from": self.quant8, "to": nxt})
+            self.quant8 = nxt
+            self._step_fn = None   # recompile without the drifted tier
+            self._guard_fn = None
+        return r
+
+    def guard_events(self):
+        """Drift-guard fallback log: [{step, rel_err, from, to}]."""
+        return list(self._guard_events)
+
     def train_step(self, input_ids, labels) -> float:
         fn = self.build_step()
         if isinstance(input_ids, Tensor):
@@ -986,8 +1093,13 @@ class GPTSpmdTrainer:
         if isinstance(labels, Tensor):
             labels = labels._data
         with jax.set_mesh(self.mesh):
+            if self.quant8 and self.int8_guard_period and \
+                    self._host_step % self.int8_guard_period == 0:
+                self._run_guard(jnp.asarray(input_ids))
+                fn = self.build_step()  # guard may have recompiled
             self.params, self.opt_state, loss = fn(
                 self.params, self.opt_state, input_ids, labels)
+        self._host_step += 1
         return loss
 
     def n_params(self) -> int:

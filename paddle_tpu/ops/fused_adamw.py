@@ -35,6 +35,9 @@ def _kernel(sc_ref, seed_ref, p_ref, g_ref, m_ref, v_ref,
     scale = sc_ref[0]
     inv_bc1 = sc_ref[1]
     inv_bc2 = sc_ref[2]
+    # dynamic lr multiplier (schedules trace per step; the base lr
+    # stays a compile-time constant so the schedule costs nothing)
+    lr = lr * sc_ref[3]
     g = g_ref[...].astype(jnp.float32) * scale
     m2 = b1 * m_ref[...].astype(jnp.float32) + (1.0 - b1) * g
     v2 = b2 * v_ref[...].astype(jnp.float32) + (1.0 - b2) * g * g
@@ -87,7 +90,7 @@ def fused_adamw_eligible(p) -> bool:
     "interpret"))
 def fused_adamw_update(p, g, m, v, scale, inv_bc1, inv_bc2, seed, *,
                        lr, wd, b1, b2, eps=1e-8, stoch_round=False,
-                       leaf_id=0, interpret=False):
+                       leaf_id=0, interpret=False, lr_scale=1.0):
     """One-pass AdamW: returns (p', m', v').
 
     ``scale``: global grad-clip multiplier (traced f32 scalar).
@@ -109,7 +112,8 @@ def fused_adamw_update(p, g, m, v, scale, inv_bc1, inv_bc2, seed, *,
     v2 = v.reshape(R, C)
     sc = jnp.stack([jnp.asarray(scale, jnp.float32),
                     jnp.asarray(inv_bc1, jnp.float32),
-                    jnp.asarray(inv_bc2, jnp.float32)])
+                    jnp.asarray(inv_bc2, jnp.float32),
+                    jnp.asarray(lr_scale, jnp.float32)])
     seed = jnp.asarray(seed, jnp.int32).reshape(1)
     grid = (R // br, C // bc)
     blk = pl.BlockSpec((br, bc), lambda i, j: (i, j))
