@@ -2,13 +2,15 @@
 from .static_function import (to_static, not_to_static, StaticFunction,
                               InputSpec, capture_report,
                               reset_capture_report)
+from .auto_capture import auto_capture, AutoCapture  # noqa: F401
 from .functional import TrainStep, functional_call, value_and_grad
 from .save_load import save, load, TranslatedLayer
 from . import dy2static  # noqa: F401  (AST control-flow conversion)
 
 __all__ = ["to_static", "not_to_static", "StaticFunction", "InputSpec",
            "TrainStep", "functional_call", "value_and_grad", "save", "load",
-           "TranslatedLayer", "capture_report", "reset_capture_report"]
+           "TranslatedLayer", "capture_report", "reset_capture_report",
+           "auto_capture", "AutoCapture"]
 
 
 # verbosity / capture-control compat (python/paddle/jit/api.py + sot flags)

@@ -1,0 +1,158 @@
+"""Transparent capture: no-decorator to_static for whole namespaces.
+
+Reference: the SOT eval-frame hook intercepts EVERY frame via PEP 523
+(paddle/fluid/pybind/sot/eval_frame.c) so user code gets compiled
+without decorating anything. CPython 3.12 removed the sanctioned
+Python-level path to frame REPLACEMENT, but ships ``sys.monitoring`` —
+observation-only, per-code-object, near-zero overhead when disabled.
+
+TPU-native design: observe PY_START events with sys.monitoring, count
+calls per code object, and when a function inside a registered
+namespace turns HOT, REBIND it (module attribute / class method) to a
+``StaticFunction`` wrapper. Subsequent calls go straight through the
+capture tiers (AST -> bytecode -> break-and-resume) with zero
+per-call interposition — the rebind IS the interception, monitoring
+only decides where it pays. Lambdas/closures that are not reachable as
+attributes cannot be rebound and stay eager (reported, not silent).
+
+Usage::
+
+    with paddle.jit.auto_capture(my_models_module, threshold=2):
+        train()            # hot functions compile transparently
+
+or ``ac = paddle.jit.auto_capture(mod); ac.start(); ...; ac.stop()``.
+"""
+from __future__ import annotations
+
+import sys
+import types
+from typing import Any, Dict, List, Optional
+
+__all__ = ["auto_capture", "AutoCapture"]
+
+_TOOL_NAME = "paddle_tpu.auto_capture"
+
+
+class AutoCapture:
+    def __init__(self, *namespaces, threshold: int = 2):
+        if not namespaces:
+            raise ValueError("auto_capture needs at least one module "
+                             "or class namespace")
+        for ns in namespaces:
+            if not isinstance(ns, (types.ModuleType, type)):
+                raise TypeError(
+                    f"namespace must be a module or class, got "
+                    f"{type(ns).__name__}")
+        self._namespaces = namespaces
+        self._threshold = int(threshold)
+        self._counts: Dict[Any, int] = {}
+        self._rebound: List[tuple] = []   # (owner, name, original)
+        self._unreboundable: Dict[str, str] = {}
+        self._tool_id: Optional[int] = None
+        # code object -> (owner, attr name, function)
+        self._index = self._build_index()
+
+    def _build_index(self):
+        idx = {}
+
+        def add_owner(owner):
+            for name, v in list(vars(owner).items()):
+                if isinstance(v, types.FunctionType):
+                    if getattr(v, "_not_to_static", False) or \
+                            name.startswith("__"):
+                        continue
+                    idx[v.__code__] = (owner, name, v)
+                elif isinstance(v, type) and owner is not v:
+                    # classes defined in the module: capture methods
+                    mod = getattr(v, "__module__", None)
+                    for ns in self._namespaces:
+                        if isinstance(ns, types.ModuleType) and \
+                                mod == ns.__name__:
+                            add_owner(v)
+                            break
+
+        for ns in self._namespaces:
+            add_owner(ns)
+        return idx
+
+    # -- monitoring hook ---------------------------------------------------
+    def _on_py_start(self, code, _offset):
+        mon = sys.monitoring
+        hit = self._index.get(code)
+        if hit is None:
+            return mon.DISABLE      # never look at this code again
+        n = self._counts.get(code, 0) + 1
+        self._counts[code] = n
+        if n < self._threshold:
+            return None
+        owner, name, fn = hit
+        self._rebind(owner, name, fn)
+        del self._index[code]
+        return mon.DISABLE
+
+    def _rebind(self, owner, name, fn):
+        from .static_function import StaticFunction
+        current = vars(owner).get(name)
+        if current is not fn:
+            # somebody else rebound it meanwhile — leave theirs alone
+            self._unreboundable[f"{owner.__name__}.{name}"] = \
+                "attribute changed since indexing"
+            return
+        wrapped = StaticFunction(fn)
+        setattr(owner, name, wrapped)
+        self._rebound.append((owner, name, fn))
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "AutoCapture":
+        if self._tool_id is not None:
+            return self
+        mon = sys.monitoring
+        tid = None
+        for cand in range(6):
+            if mon.get_tool(cand) is None:
+                tid = cand
+                break
+        if tid is None:
+            raise RuntimeError("no free sys.monitoring tool id")
+        mon.use_tool_id(tid, _TOOL_NAME)
+        mon.register_callback(tid, mon.events.PY_START,
+                              self._on_py_start)
+        mon.set_events(tid, mon.events.PY_START)
+        self._tool_id = tid
+        return self
+
+    def stop(self, unbind: bool = False):
+        if self._tool_id is not None:
+            mon = sys.monitoring
+            mon.set_events(self._tool_id, 0)
+            mon.register_callback(self._tool_id,
+                                  mon.events.PY_START, None)
+            mon.free_tool_id(self._tool_id)
+            self._tool_id = None
+        if unbind:
+            for owner, name, fn in reversed(self._rebound):
+                setattr(owner, name, fn)
+            self._rebound.clear()
+
+    def report(self):
+        """What got captured transparently (and what could not be)."""
+        return {
+            "rebound": [f"{o.__name__}.{n}"
+                        for o, n, _ in self._rebound],
+            "unreboundable": dict(self._unreboundable),
+            "watched": len(self._index),
+        }
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+def auto_capture(*namespaces, threshold: int = 2) -> AutoCapture:
+    """Transparent capture for every function/method defined in the
+    given modules or classes: hot functions (>= threshold calls) are
+    rebound to ``to_static`` wrappers via a ``sys.monitoring`` observer
+    (see module docstring for the PEP-523 relationship)."""
+    return AutoCapture(*namespaces, threshold=threshold)

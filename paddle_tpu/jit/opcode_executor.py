@@ -21,13 +21,18 @@ values (tracers during jit tracing), so:
   * a callee that itself branches on a tensor is interpreted
     recursively (the tracer error never escapes to the user);
   * anything outside the supported envelope raises ``GraphBreak``,
-    which the caller (jit/static_function.py) turns into an eager
-    fallback — never a wrong answer.
+    which the caller (jit/static_function.py) turns into SEGMENTED
+    capture (partial_capture.py: compile the prefix, run the breaking
+    op eagerly, resume) or, failing that, whole-function eager —
+    never a wrong answer;
+  * under ``strict`` state (any jit-traced run), mutations of objects
+    that OUTLIVE the call also GraphBreak: a traced side effect would
+    execute once at trace time and never again on cached runs — the
+    segment boundary replays it eagerly every call instead.
 
-Tensor-valued ``while`` conditions remain the AST tier's job
-(jit/dy2static.py lowers them to lax.while_loop when source exists):
-a bytecode-level while needs loop-variable discovery across a backward
-jump, which the fork-to-return strategy cannot express — those break.
+Tensor-valued ``while``: the AST tier lowers source-available ones to
+lax.while_loop; at the bytecode level the segmented tier runs the body
+as a compiled segment per iteration with only the condition eager.
 """
 from __future__ import annotations
 
@@ -81,14 +86,23 @@ class _State:
     objects created (or copied) under the CURRENT innermost fork epoch.
     """
 
-    __slots__ = ("instructions", "forks", "epochs", "serial", "fresh")
+    __slots__ = ("instructions", "forks", "epochs", "serial", "fresh",
+                 "strict")
 
-    def __init__(self, instructions=_MAX_INSTRUCTIONS, forks=_MAX_FORKS):
+    def __init__(self, instructions=_MAX_INSTRUCTIONS, forks=_MAX_FORKS,
+                 strict=False):
         self.instructions = instructions
         self.forks = forks
         self.epochs: list = []   # stack of active fork serials
         self.serial = 0
         self.fresh: dict = {}    # id(obj) -> (obj, epoch at creation)
+        # strict: this execution is a jit TRACE of the whole call —
+        # a mutation of anything that outlives the call would run at
+        # trace time ONCE and then never again on cached executions,
+        # silently dropping repeat side effects. Strict mode breaks
+        # instead; the partial-capture tier turns the break into a
+        # segment boundary whose op replays eagerly EVERY call.
+        self.strict = strict
 
     @property
     def fork_depth(self) -> int:
@@ -131,11 +145,15 @@ class _State:
                 self.demote(v)
 
     def guard_mutation(self, obj, what: str):
-        """GraphBreak unless mutating ``obj`` is safe under the fork."""
+        """GraphBreak unless mutating ``obj`` is safe to capture."""
         if self.epochs and not self.is_fresh_current(obj):
             raise GraphBreak(
                 f"{what} on a pre-fork object inside a tensor-if arm "
                 "(side effect would leak into the untaken branch)")
+        if self.strict and not self.is_fresh(obj):
+            raise GraphBreak(
+                f"{what} on an object that outlives the call (a traced "
+                "side effect would run once, not per call)")
 
     def copy_fresh_into(self, frame):
         """Give a fork arm its own copies of the fresh objects reachable
@@ -193,6 +211,7 @@ class _Null:
 _NULL = _Null()
 _JUMPED = object()   # handler already set pc
 _UNBOUND = object()  # empty local slot
+_STOPPED = object()  # _execute reached stop_pc (partial capture)
 
 _BIN_OPS = {
     "+": operator.add, "-": operator.sub, "*": operator.mul,
@@ -354,12 +373,13 @@ class OpcodeExecutor:
         self.closure = closure or ()
         self.state = state  # shared across forks and callees
         self.call_depth = call_depth
+        self.last_break_pc: Optional[int] = None
         self.instrs = list(dis.get_instructions(code, show_caches=False))
         self.off2idx = {i.offset: n for n, i in enumerate(self.instrs)}
 
     # -- entry ------------------------------------------------------------
-    def run(self, bound_args: dict):
-        """bound_args: parameter name -> value (defaults applied)."""
+    def make_frame(self, bound_args: dict) -> "_Frame":
+        """Frame with parameters bound (defaults applied by caller)."""
         code = self.code
         f = _Frame(code.co_nlocals,
                    len(code.co_cellvars) + len(code.co_freevars))
@@ -374,14 +394,27 @@ class OpcodeExecutor:
             slot += 1
         if code.co_flags & 0x08:  # **kwargs
             name = code.co_varnames[slot]
-            f.locals[slot] = dict(bound_args.get(name, {}))
-        return self._execute(f)
+            kw = dict(bound_args.get(name, {}))
+            self.state.mark_fresh(kw)
+            f.locals[slot] = kw
+        return f
+
+    def run(self, bound_args: dict):
+        """bound_args: parameter name -> value (defaults applied)."""
+        return self._execute(self.make_frame(bound_args))
 
     # -- main loop --------------------------------------------------------
-    def _execute(self, f: _Frame):
+    def _execute(self, f: _Frame, stop_pc: Optional[int] = None):
+        """Interpret to RETURN; with ``stop_pc``, stop (and return the
+        sentinel ``_STOPPED``) when that instruction index is reached
+        AFTER at least one step — the partial-capture driver replays a
+        discovered segment up to (not including) its breaking op."""
         instrs = self.instrs
         n = len(instrs)
+        steps = 0
         while True:
+            if stop_pc is not None and f.pc == stop_pc and steps > 0:
+                return _STOPPED
             if f.pc >= n:
                 raise GraphBreak("fell off code end")
             self.state.instructions -= 1
@@ -389,22 +422,44 @@ class OpcodeExecutor:
                 raise GraphBreak("instruction budget exhausted "
                                  "(unbounded loop under trace?)")
             ins = instrs[f.pc]
-            handler = getattr(self, "_op_" + ins.opname, None)
-            if handler is None:
-                raise GraphBreak(f"unsupported opcode {ins.opname}")
+            steps += 1
             try:
-                r = handler(f, ins)
+                r = self._step(f, ins)
             except GraphBreak:
+                # where the capture broke — the partial-capture driver
+                # turns this pc into a segment boundary
+                self.last_break_pc = f.pc
                 raise
-            except jax.errors.TracerBoolConversionError:
-                raise GraphBreak(
-                    f"tensor bool outside a branch ({ins.opname})")
             if r is None:
                 f.pc += 1
             elif r is _JUMPED:
                 pass
             else:
                 return r[0]
+
+    def _step(self, f: _Frame, ins=None):
+        """Execute exactly one instruction; returns the handler result
+        (None = fall through, _JUMPED, or a 1-tuple return value)."""
+        if ins is None:
+            ins = self.instrs[f.pc]
+        handler = getattr(self, "_op_" + ins.opname, None)
+        if handler is None:
+            raise GraphBreak(f"unsupported opcode {ins.opname}")
+        try:
+            return handler(f, ins)
+        except GraphBreak:
+            raise
+        except jax.errors.TracerBoolConversionError:
+            raise GraphBreak(
+                f"tensor bool outside a branch ({ins.opname})")
+        except (jax.errors.TracerArrayConversionError,
+                jax.errors.TracerIntegerConversionError,
+                jax.errors.ConcretizationTypeError) as e:
+            # float()/int()/np.asarray() on a traced value: fine in
+            # eager, impossible under trace — a segment boundary
+            raise GraphBreak(
+                f"tensor concretization in {ins.opname}: "
+                f"{type(e).__name__}")
 
     def _jump(self, f: _Frame, target_offset: int):
         try:
@@ -560,10 +615,10 @@ class OpcodeExecutor:
                 raise GraphBreak(f"NameError: {name}")
 
     def _op_STORE_GLOBAL(self, f, ins):
-        if self.state.fork_depth > 0:
+        if self.state.fork_depth > 0 or self.state.strict:
             raise GraphBreak(
-                "global store inside a tensor-if arm (side effect "
-                "would leak into the untaken branch)")
+                "global store under capture (side effect would bake "
+                "at trace time)")
         v = f.stack.pop()
         self.state.demote(v)
         self.globals[ins.argval] = v
@@ -620,10 +675,10 @@ class OpcodeExecutor:
             raise GraphBreak(f"empty cell {ins.argval!r}")
 
     def _op_STORE_DEREF(self, f, ins):
-        if self.state.fork_depth > 0:
+        if self.state.fork_depth > 0 or self.state.strict:
             raise GraphBreak(
-                "cell store inside a tensor-if arm (closure cells are "
-                "shared by both branches)")
+                "cell store under capture (closure cells outlive the "
+                "call)")
         v = f.stack.pop()
         self.state.demote(v)
         self._get_cell(f, ins).cell_contents = v
@@ -881,7 +936,7 @@ class OpcodeExecutor:
     # -- iteration --------------------------------------------------------
     def _op_GET_ITER(self, f, ins):
         src = f.stack.pop()
-        if self.state.fork_depth > 0 \
+        if (self.state.fork_depth > 0 or self.state.strict) \
                 and type(src).__module__ != "builtins" \
                 and not isinstance(src, _SAFE_ITERABLES) \
                 and not _is_tensorish(src):
@@ -943,7 +998,7 @@ class OpcodeExecutor:
 
     def _call(self, func, args, kwargs):
         st = self.state
-        if st.fork_depth > 0:
+        if st.fork_depth > 0 or st.strict:
             if self._vet_forked(func, args) == "interpret":
                 return self._interpret(func, args, kwargs)
         elif self._may_retain_args(func):
@@ -1056,15 +1111,17 @@ class OpcodeExecutor:
             raise GraphBreak(
                 f"bound method {f0!r} on a pre-fork object under fork")
         if isinstance(f0, type):
-            if f0 in _CTOR_TYPES or _trusted_module(f0.__module__):
-                # container ctors iterate their args — a user __iter__
-                # would run natively in both arms
-                if f0 in (list, tuple, set, frozenset, dict) and \
-                        not all(_fork_iter_safe(a) for a in args):
+            # range/enumerate/zip/reversed are TYPES in CPython — vet
+            # them (and container ctors) for iteration-protocol safety
+            if f0 in (list, tuple, set, frozenset, dict, range,
+                      enumerate, zip, reversed):
+                if not all(_fork_iter_safe(a) for a in args):
                     raise GraphBreak(
-                        "ctor iterating a user object under fork")
+                        "ctor iterating a user object under capture")
                 return "native"
-            raise GraphBreak(f"constructor {f0!r} under fork")
+            if f0 in _CTOR_TYPES or _trusted_module(f0.__module__):
+                return "native"
+            raise GraphBreak(f"constructor {f0!r} under capture")
         if _safe_in(f0, _FORBIDDEN_BUILTINS):
             raise GraphBreak(
                 f"side-effecting builtin {f0!r} under fork")
@@ -1166,7 +1223,8 @@ class OpcodeFunction:
     """
 
     def __init__(self, fn: Callable, state: Optional[_State] = None,
-                 call_depth=0):
+                 call_depth=0, strict=False):
+        self._strict = strict
         if isinstance(fn, types.MethodType):
             self._self = fn.__self__
             fn = fn.__func__
@@ -1187,7 +1245,8 @@ class OpcodeFunction:
         except TypeError as e:
             raise GraphBreak(f"bad call signature: {e}")
         ba.apply_defaults()
-        state = self.state if self.state is not None else _State()
+        state = self.state if self.state is not None \
+            else _State(strict=self._strict)
         ex = OpcodeExecutor(fn.__code__, fn.__globals__, fn.__closure__,
                             state, self.call_depth)
         return ex.run(dict(ba.arguments))

@@ -37,6 +37,8 @@ __all__ = ["to_static", "not_to_static", "StaticFunction", "InputSpec",
 # bytecode_graph_calls counts whole-graph captures that needed the SOT
 # bytecode tier (opcode_executor.py) after plain tracing failed.
 _capture_stats = {"whole_graph_calls": 0, "bytecode_graph_calls": 0,
+                  "partial_graph_calls": 0, "partial_segments_run": 0,
+                  "partial_eager_ops": 0,
                   "graph_break_calls": 0, "breaks": {}}
 
 
@@ -44,8 +46,15 @@ def capture_report():
     """Return {whole_graph_calls, bytecode_graph_calls,
     graph_break_calls, breaks: {reason: count}} accumulated across all
     StaticFunction calls."""
+    segs = _capture_stats["partial_segments_run"]
+    eag = _capture_stats["partial_eager_ops"]
     return {"whole_graph_calls": _capture_stats["whole_graph_calls"],
             "bytecode_graph_calls": _capture_stats["bytecode_graph_calls"],
+            "partial_graph_calls": _capture_stats["partial_graph_calls"],
+            "partial_segments_run": segs,
+            "partial_eager_ops": eag,
+            "partial_compiled_fraction": round(
+                segs / (segs + eag), 4) if segs + eag else None,
             "graph_break_calls": _capture_stats["graph_break_calls"],
             "breaks": dict(_capture_stats["breaks"])}
 
@@ -53,6 +62,9 @@ def capture_report():
 def reset_capture_report():
     _capture_stats["whole_graph_calls"] = 0
     _capture_stats["bytecode_graph_calls"] = 0
+    _capture_stats["partial_graph_calls"] = 0
+    _capture_stats["partial_segments_run"] = 0
+    _capture_stats["partial_eager_ops"] = 0
     _capture_stats["graph_break_calls"] = 0
     _capture_stats["breaks"] = {}
 
@@ -69,6 +81,7 @@ def _note_break(reason: str):
 _CACHE_LIMIT = 64
 
 _BROKEN = object()  # cache sentinel: this specialization cannot trace
+_NO_PARTIAL = object()  # _try_partial: outside the segmentable envelope
 
 
 def _static_guard_key(v):
@@ -119,13 +132,30 @@ class StaticFunction:
         self._input_spec = input_spec
         self._cache = {}  # static-guard key -> (tier, jitted program)
         self._overflow_warned = False
+        self._partial = None  # SegmentedFunction (tier 3), lazily built
         self._sig = None  # lazily-computed signature (kwargs path)
         # generators/coroutines yield control mid-body — not a graph;
         # always run them eagerly instead of crashing in jit
         self._never_trace = (inspect.isgeneratorfunction(self._fn)
                              or inspect.iscoroutinefunction(self._fn)
                              or inspect.isasyncgenfunction(self._fn))
+        # no source => the AST tier would fall through to PLAIN jit
+        # tracing, which cannot see side effects (they bake at trace
+        # time and silently stop repeating). Start such functions at
+        # the bytecode tier, whose strict mode catches them.
+        try:
+            inspect.getsource(self._fn)
+            self._prefer_bytecode = False
+        except (OSError, TypeError):
+            self._prefer_bytecode = True
         functools.update_wrapper(self, self._fn)
+
+    def __get__(self, obj, objtype=None):
+        # descriptor protocol: auto_capture rebinds class METHODS to
+        # StaticFunction; instance calls must still bind self
+        if obj is None:
+            return self
+        return functools.partial(self, obj)
 
     @property
     def layer(self):
@@ -201,7 +231,10 @@ class StaticFunction:
             # (tensor-if becomes lax.cond inside the interpreter); used
             # when AST conversion + plain tracing already failed
             from .opcode_executor import OpcodeFunction
-            fn = OpcodeFunction(self._fn)
+            # strict: side effects on objects that outlive the call
+            # must not bake at trace time — they GraphBreak, and tier 3
+            # replays them eagerly at a segment boundary
+            fn = OpcodeFunction(self._fn, strict=True)
         else:
             fn = self._converted()
 
@@ -242,6 +275,32 @@ class StaticFunction:
             return self._fn(self._bound_self, *args, **kwargs)
         return self._fn(*args, **kwargs)
 
+    def _try_partial(self, args, kwargs, key, break_err):
+        """Tier 3: segmented capture. Returns _NO_PARTIAL when the
+        function is outside the segmentable envelope (layer-bound,
+        closures, generators) or segmentation itself breaks."""
+        from .opcode_executor import GraphBreak
+        from .partial_capture import SegmentedFunction, segmentable
+        if self._layer is not None or self._bound_self is not None \
+                or not segmentable(self._fn):
+            return _NO_PARTIAL
+        entry = self._partial
+        if entry is None:
+            try:
+                entry = SegmentedFunction(self._fn)
+            except GraphBreak:
+                return _NO_PARTIAL
+            self._partial = entry
+        try:
+            out = entry(*args, **kwargs)
+        except GraphBreak:
+            # refusal happens BEFORE any eager op runs (driver design:
+            # a mid-call failure raises RuntimeError, never re-runs)
+            return _NO_PARTIAL
+        self._cache[key] = ("sotp", entry)
+        _capture_stats["partial_graph_calls"] += 1
+        return out
+
     def __call__(self, *args, **kwargs):
         from . import _to_static_enabled
         if not _to_static_enabled[0] or self._never_trace:
@@ -265,6 +324,19 @@ class StaticFunction:
             self._cache.pop(key)
             self._cache[key] = entry
             tier, jitted = entry
+            if tier == "sotp":
+                # segmented capture executes with the ORIGINAL call
+                # convention (it owns its per-segment jits)
+                from .opcode_executor import GraphBreak
+                try:
+                    out = jitted(*args, **kwargs)
+                except GraphBreak as e:
+                    # a fresh specialization can refuse (e.g. newly
+                    # unsegmentable state before any side effect ran)
+                    _note_break(f"partial refused: {e}")
+                    return self._eager(args, kwargs)
+                _capture_stats["partial_graph_calls"] += 1
+                return out
         else:
             if len(self._cache) >= _CACHE_LIMIT:
                 # guard explosion (e.g. a fresh float every call):
@@ -283,8 +355,12 @@ class StaticFunction:
                         f"forcing a recompile per call. Pass it as a "
                         f"Tensor/array to trace it dynamically.",
                         RuntimeWarning, stacklevel=3)
-            tier = "ast"
-            jitted = self._build(layout)
+            if self._prefer_bytecode and self._layer is None:
+                from .opcode_executor import interpretable
+                tier = "sot" if interpretable(self._fn) else "ast"
+            else:
+                tier = "ast"
+            jitted = self._build(layout, bytecode=(tier == "sot"))
             self._cache[key] = (tier, jitted)
 
         def _run(j):
@@ -312,13 +388,23 @@ class StaticFunction:
                     out, new_buffers = _run(jitted)
                     self._cache[key] = (tier, jitted)
                 except _TRACE_ERRS as e2:
+                    # tier 3: break-and-resume. Compile the prefix,
+                    # run the breaking op eagerly, resume capture —
+                    # a mid-body break no longer abandons the whole
+                    # function (reference _break_graph_when_*).
+                    out = self._try_partial(args, kwargs, key, e2)
+                    if out is not _NO_PARTIAL:
+                        return out
                     self._cache[key] = _BROKEN
                     _note_break(f"graph break: {e2}")
                     return self._eager(args, kwargs)
             else:
-                # a RETRACE of a cached SOT program (e.g. the layer
-                # flipped train->eval) can hit a fresh GraphBreak too —
-                # same answer either way: go eager, remember the break
+                # the sot tier broke — whether freshly built (source-
+                # less functions START here) or on a retrace of a
+                # cached program: try break-and-resume before eager
+                out = self._try_partial(args, kwargs, key, e)
+                if out is not _NO_PARTIAL:
+                    return out
                 self._cache[key] = _BROKEN
                 _note_break(f"trace failure: {type(e).__name__}")
                 return self._eager(args, kwargs)

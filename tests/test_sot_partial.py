@@ -1,0 +1,194 @@
+"""SOT tier 3: graph-break-and-resume + transparent auto-capture
+(reference: sot _break_graph_when_* + the PEP-523 eval_frame.c hook;
+here jit/partial_capture.py + jit/auto_capture.py)."""
+import textwrap
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import jit
+
+
+def _t(v):
+    return paddle.to_tensor(np.asarray(v, np.float32))
+
+
+def _exec_def(src, extra=None):
+    ns = {"paddle": paddle}
+    ns.update(extra or {})
+    exec(textwrap.dedent(src), ns)
+    return ns["f"], ns
+
+
+def test_midbody_side_effect_compiles_prefix_and_suffix():
+    jit.reset_capture_report()
+    f, ns = _exec_def("""
+        def f(x):
+            y = x * 2.0
+            z = y + 1.0
+            LOG.append(float(z.sum()))   # breaks: concretize + append
+            w = z * 3.0
+            return w - y
+    """, {"LOG": []})
+    sf = jit.to_static(f)
+    np.testing.assert_allclose(sf(_t([1.0, 2.0])).numpy(), [7.0, 11.0])
+    assert ns["LOG"] == [8.0]
+    np.testing.assert_allclose(sf(_t([2.0, 3.0])).numpy(), [11.0, 15.0])
+    assert ns["LOG"] == [8.0, 12.0]
+    rep = jit.capture_report()
+    assert rep["partial_graph_calls"] == 2
+    # prefix + suffix segments both compiled; only the append is eager
+    assert rep["partial_segments_run"] >= 4
+    assert rep["partial_compiled_fraction"] >= 0.5
+
+
+def test_segment_cache_reused_across_calls():
+    jit.reset_capture_report()
+    f, ns = _exec_def("""
+        def f(x):
+            a = x + 1.0
+            SEEN.append(1)
+            return a * 2.0
+    """, {"SEEN": []})
+    sf = jit.to_static(f)
+    for i in range(5):
+        np.testing.assert_allclose(
+            sf(_t([float(i)])).numpy(), [(i + 1.0) * 2.0])
+    rep = jit.capture_report()
+    assert rep["partial_graph_calls"] == 5
+    assert len(ns["SEEN"]) == 5
+
+
+def test_bytecode_tensor_while_compiled_body():
+    jit.reset_capture_report()
+    f, _ = _exec_def("""
+        def f(x):
+            while x.sum() < 20.0:
+                x = x * 2.0 + 1.0
+            return x
+    """)
+    sf = jit.to_static(f)
+    ref = np.asarray([1.0, 2.0], np.float32)
+    while ref.sum() < 20.0:
+        ref = ref * 2.0 + 1.0
+    np.testing.assert_allclose(sf(_t([1.0, 2.0])).numpy(), ref)
+    rep = jit.capture_report()
+    assert rep["partial_graph_calls"] == 1
+    assert rep["partial_segments_run"] >= 2  # body compiled per iter
+
+
+def test_partial_only_when_needed():
+    # functions that capture whole must NOT go through segmentation
+    jit.reset_capture_report()
+    f, _ = _exec_def("""
+        def f(x):
+            if x.sum() > 0:
+                return x * 2.0
+            return x - 1.0
+    """)
+    sf = jit.to_static(f)
+    np.testing.assert_allclose(sf(_t([1.0])).numpy(), [2.0])
+    rep = jit.capture_report()
+    assert rep["partial_graph_calls"] == 0
+    assert rep["bytecode_graph_calls"] >= 1
+
+
+def test_real_user_errors_surface_not_swallowed():
+    f, _ = _exec_def("""
+        def f(x):
+            y = x * 2.0
+            float(y.sum())      # forces segmentation
+            raise ValueError("user bug")
+    """)
+    sf = jit.to_static(f)
+    try:
+        sf(_t([1.0]))
+    except ValueError as e:
+        assert "user bug" in str(e)
+    else:
+        raise AssertionError("expected the user error")
+
+
+def test_auto_capture_rebinds_hot_functions():
+    import types
+    mod = types.ModuleType("fake_user_models")
+
+    src = textwrap.dedent("""
+        def scale_add(x, y):
+            return x * 2.0 + y
+    """)
+    exec(src, mod.__dict__)
+    jit.reset_capture_report()
+    with jit.auto_capture(mod, threshold=2) as ac:
+        a, b = _t([1.0]), _t([3.0])
+        for _ in range(4):
+            out = mod.scale_add(a, b)
+    np.testing.assert_allclose(out.numpy(), [5.0])
+    rep = ac.report()
+    assert "fake_user_models.scale_add" in rep["rebound"]
+    assert jit.capture_report()["whole_graph_calls"] >= 1
+    # the wrapper persists after stop (capture stays transparent)
+    assert isinstance(mod.scale_add, jit.StaticFunction)
+    ac.stop(unbind=True)
+    assert isinstance(mod.scale_add, types.FunctionType)
+
+
+def test_auto_capture_monitoring_overhead_free_when_cold():
+    import types
+    mod = types.ModuleType("fake_cold_models")
+    exec("def rarely(x):\n    return x + 1.0", mod.__dict__)
+    with jit.auto_capture(mod, threshold=100) as ac:
+        mod.rarely(_t([1.0]))
+    assert ac.report()["rebound"] == []
+    assert isinstance(mod.rarely, types.FunctionType)
+
+
+def test_aliased_containers_stay_correct():
+    # reviewer repro: two names for one list across a boundary — the
+    # driver must refuse segmentation there and interpret eagerly
+    f, _ = _exec_def("""
+        def f(x):
+            a = [0.0]
+            b = a
+            float(x.sum())      # boundary
+            a.append(1.0)
+            return x * float(len(b))
+    """)
+    sf = jit.to_static(f)
+    np.testing.assert_allclose(sf(_t([3.0])).numpy(), [6.0])  # len==2
+
+
+def test_runaway_tensor_while_finishes_eagerly_once():
+    # past the segment cap the call FINISHES eagerly: side effects ran
+    # once; eager fallback re-execution would double them
+    log = []
+    f, _ = _exec_def("""
+        def f(x):
+            LOG.append(1)
+            while x.sum() < 600.0:
+                x = x + 1.0
+            return x
+    """, {"LOG": log})
+    sf = jit.to_static(f)
+    out = sf(_t([0.0]))
+    np.testing.assert_allclose(out.numpy(), [600.0])
+    assert log == [1]
+
+
+def test_auto_capture_class_method_binds_self():
+    import types as pytypes
+    mod = pytypes.ModuleType("fake_method_models")
+    exec(textwrap.dedent("""
+        class Scaler:
+            def __init__(self, k):
+                self.k = k
+
+            def scale(self, x):
+                return x * self.k
+    """), mod.__dict__)
+    s = mod.Scaler(3.0)
+    with jit.auto_capture(mod, threshold=2) as ac:
+        for _ in range(4):
+            out = s.scale(_t([2.0]))
+    np.testing.assert_allclose(out.numpy(), [6.0])
+    assert "Scaler.scale" in ac.report()["rebound"]
