@@ -91,14 +91,30 @@ def main():
         rng.randint(0, cfg.vocab_size, (1, T0)).astype(np.int64))
     g_bf16 = np.asarray(jax.device_get(
         model.generate(ids_cmp, max_new_tokens=new)._data))
-    g_int8 = np.asarray(jax.device_get(
-        q_model.generate(ids_cmp, max_new_tokens=new)._data))
+
+    def _retry(fn, attempts=3):
+        # the tunnel's remote-compile endpoint can drop long compiles
+        # (broken pipe); the compile cache makes retries cheap-ish
+        for i in range(attempts):
+            try:
+                return fn()
+            except Exception:
+                if i == attempts - 1:
+                    raise
+                time.sleep(5)
+
+    g_int8 = np.asarray(jax.device_get(_retry(
+        lambda: q_model.generate(ids_cmp, max_new_tokens=new))._data))
     agree = float((g_bf16 == g_int8).mean())
     results8 = {}
-    for bs in batches:
+    # int8 decode is measured where it matters: small batch is weight-
+    # READ-bound (each extra whole-generate program costs a ~10 min
+    # tunnel compile, so the sweep stays small)
+    for bs in batches[:2]:
         ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (bs, T0))
                                .astype(np.int64))
-        tps, _ = _gen_tokens_per_s(q_model, ids, new, runs)
+        tps, _ = _retry(lambda: _gen_tokens_per_s(q_model, ids, new,
+                                                  runs))
         results8[bs] = round(tps, 1)
 
     bs_hero = batches[-1]

@@ -66,6 +66,39 @@ def _has_aliased_mutables(state) -> bool:
     return walk(list(state))
 
 
+def _mutable_ids(obj, acc=None) -> frozenset:
+    """ids of every mutable container reachable from ``obj``."""
+    if acc is None:
+        acc = set()
+    if isinstance(obj, (list, dict, set, bytearray)):
+        if id(obj) in acc:
+            return acc
+        acc.add(id(obj))
+    if isinstance(obj, dict):
+        for v in obj.values():
+            _mutable_ids(v, acc)
+    elif isinstance(obj, (list, tuple)):
+        for v in obj:
+            _mutable_ids(v, acc)
+    return acc
+
+
+def _contains_ids(state, ids) -> bool:
+    if not ids:
+        return False
+
+    def walk(v):
+        if id(v) in ids:
+            return True
+        if isinstance(v, dict):
+            return any(walk(x) for x in v.values())
+        if isinstance(v, (list, tuple)):
+            return any(walk(x) for x in v)
+        return False
+
+    return walk(list(state))
+
+
 def segmentable(fn) -> bool:
     target = fn.__func__ if isinstance(fn, types.MethodType) else fn
     if not isinstance(target, types.FunctionType):
@@ -152,7 +185,13 @@ class SegmentedFunction:
         # must not drop it (it is a static tuple of strings)
         return (list(f.stack), list(f.locals), f.kwnames)
 
-    def _segment_key(self, pc: int, state):
+    def _segment_key(self, pc: int, state, arg_mut_ids=frozenset()):
+        if _contains_ids(state, arg_mut_ids):
+            # a mutable container the CALLER holds a reference to: the
+            # pytree round-trip at a boundary would rebuild it as a new
+            # object, so post-boundary mutations would miss the
+            # caller's copy — eager-step instead
+            return None, None
         if _has_aliased_mutables(state):
             # the pytree round-trip would materialize aliases as
             # SEPARATE objects; post-boundary mutations would miss the
@@ -246,6 +285,9 @@ class SegmentedFunction:
         eager_ex = OpcodeExecutor(fn.__code__, fn.__globals__, None,
                                   eager_state)
         f = eager_ex.make_frame(dict(ba.arguments))
+        # mutable containers the CALLER can still see (argument-
+        # reachable): crossing a jit boundary must never clone them
+        arg_mut_ids = frozenset(_mutable_ids(list(ba.arguments.values())))
         segments_run = 0
         while True:
             segments_run += 1
@@ -257,7 +299,7 @@ class SegmentedFunction:
             key = dyn = None
             if not overloaded:
                 key, dyn = self._segment_key(
-                    f.pc, (f.stack, f.locals, f.kwnames))
+                    f.pc, (f.stack, f.locals, f.kwnames), arg_mut_ids)
             rec = None
             if key is not None:
                 rec = self._segments.get(key)

@@ -275,7 +275,7 @@ class StaticFunction:
             return self._fn(self._bound_self, *args, **kwargs)
         return self._fn(*args, **kwargs)
 
-    def _try_partial(self, args, kwargs, key, break_err):
+    def _try_partial(self, args, kwargs, key):
         """Tier 3: segmented capture. Returns _NO_PARTIAL when the
         function is outside the segmentable envelope (layer-bound,
         closures, generators) or segmentation itself breaks."""
@@ -392,7 +392,7 @@ class StaticFunction:
                     # run the breaking op eagerly, resume capture —
                     # a mid-body break no longer abandons the whole
                     # function (reference _break_graph_when_*).
-                    out = self._try_partial(args, kwargs, key, e2)
+                    out = self._try_partial(args, kwargs, key)
                     if out is not _NO_PARTIAL:
                         return out
                     self._cache[key] = _BROKEN
@@ -402,7 +402,7 @@ class StaticFunction:
                 # the sot tier broke — whether freshly built (source-
                 # less functions START here) or on a retrace of a
                 # cached program: try break-and-resume before eager
-                out = self._try_partial(args, kwargs, key, e)
+                out = self._try_partial(args, kwargs, key)
                 if out is not _NO_PARTIAL:
                     return out
                 self._cache[key] = _BROKEN

