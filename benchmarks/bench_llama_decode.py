@@ -85,44 +85,92 @@ def main():
     # weight-READ-bound, so int8 weights (+ per-channel scales, dequant
     # on the output side of the int8 MXU dot) halve the per-token HBM
     # floor vs bf16. Greedy-token agreement vs bf16 measured alongside.
-    from paddle_tpu.quantization import weight_only_int8
-    q_model = weight_only_int8(model, inplace=False)
-    ids_cmp = paddle.to_tensor(
-        rng.randint(0, cfg.vocab_size, (1, T0)).astype(np.int64))
-    g_bf16 = np.asarray(jax.device_get(
-        model.generate(ids_cmp, max_new_tokens=new)._data))
-
-    def _retry(fn, attempts=3):
-        # the tunnel's remote-compile endpoint can drop long compiles
-        # (broken pipe); the compile cache makes retries cheap-ish
-        for i in range(attempts):
-            try:
-                return fn()
-            except Exception:
-                if i == attempts - 1:
-                    raise
-                time.sleep(5)
-
-    g_int8 = np.asarray(jax.device_get(_retry(
-        lambda: q_model.generate(ids_cmp, max_new_tokens=new))._data))
-    agree = float((g_bf16 == g_int8).mean())
+    # int8 phase runs in a FRESH process: the tunnel's remote-compile
+    # endpoint degrades over a session of large compiles (observed:
+    # bf16 phase then int8 phase in one process reliably dies with
+    # "remote_compile: Broken pipe"; a fresh process compiles the same
+    # int8 program in minutes). Greedy agreement is not reported at
+    # random weights (near-tied logits make it meaningless — the
+    # last-logit rel err is the honest parity stat, measured 0.0404
+    # with identical argmax).
     results8 = {}
-    # int8 decode is measured where it matters: small batch is weight-
-    # READ-bound (each extra whole-generate program costs a ~10 min
-    # tunnel compile, so the sweep stays small)
-    for bs in batches[:2]:
-        ids = paddle.to_tensor(rng.randint(0, cfg.vocab_size, (bs, T0))
-                               .astype(np.int64))
-        tps, _ = _retry(lambda: _gen_tokens_per_s(q_model, ids, new,
-                                                  runs))
-        results8[bs] = round(tps, 1)
+    int8_relerr = None
+    if on_tpu:
+        import json as _json
+        import os as _os
+        import subprocess as _sp
+        import sys as _sys
+        code = (
+            "import sys, time, json, numpy as np\n"
+            "sys.path.insert(0, %r)\n"
+            "import jax\n"
+            "import paddle_tpu as paddle\n"
+            "from paddle_tpu.models.llama import LlamaConfig, "
+            "LlamaForCausalLM\n"
+            "from paddle_tpu.quantization import weight_only_int8\n"
+            "cfg = LlamaConfig(vocab_size=%d, hidden_size=%d,"
+            "num_hidden_layers=%d, num_attention_heads=%d,"
+            "intermediate_size=%d, max_position_embeddings=%d)\n"
+            "paddle.seed(0)\n"
+            "m = LlamaForCausalLM(cfg); m.eval(); "
+            "m.to(dtype='bfloat16')\n"
+            "q = weight_only_int8(m, inplace=False)\n"
+            "rng = np.random.RandomState(0)\n"
+            # parity measured HERE every run, not quoted from a past
+            # hand measurement: prefix-forward last-logit rel err
+            "idsp = paddle.to_tensor(rng.randint(0, cfg.vocab_size,"
+            " (1, %d)).astype(np.int64))\n"
+            "lb = np.asarray(jax.device_get(m(idsp)._data))[0, -1]"
+            ".astype(np.float64)\n"
+            "li = np.asarray(jax.device_get(q(idsp)._data))[0, -1]"
+            ".astype(np.float64)\n"
+            "rel = float(np.max(np.abs(lb - li)) / "
+            "max(np.max(np.abs(lb)), 1e-9))\n"
+            "same = bool(np.argmax(lb) == np.argmax(li))\n"
+            "del m\n"
+            "res = {'rel_err': round(rel, 4), 'argmax_same': same}\n"
+            "for bs in (1, 8):\n"
+            "    ids = paddle.to_tensor(rng.randint(0, 32000, (bs, %d))"
+            ".astype(np.int64))\n"
+            "    out = q.generate(ids, max_new_tokens=%d)\n"
+            "    int(np.asarray(jax.device_get(out._data[0, -1])))\n"
+            "    t0 = time.perf_counter()\n"
+            "    for _ in range(%d):\n"
+            "        out = q.generate(ids, max_new_tokens=%d)\n"
+            "    int(np.asarray(jax.device_get(out._data[0, -1])))\n"
+            "    res[bs] = round(bs * %d / ((time.perf_counter() - t0)"
+            " / %d), 1)\n"
+            "print('INT8RES ' + json.dumps(res))\n"
+        ) % (_os.path.dirname(_os.path.dirname(
+            _os.path.abspath(__file__))), cfg.vocab_size,
+             cfg.hidden_size, cfg.num_hidden_layers,
+             cfg.num_attention_heads, cfg.intermediate_size,
+             cfg.max_position_embeddings, T0, T0, new, runs, new, new,
+             runs)
+        env = {k: v for k, v in _os.environ.items()
+               if k != "PYTHONPATH"}
+        r = _sp.run([_sys.executable, "-c", code], env=env,
+                    capture_output=True, text=True, timeout=3600)
+        got = None
+        for line in r.stdout.splitlines():
+            if line.startswith("INT8RES "):
+                got = _json.loads(line[8:])
+        if got is None:
+            # surface the child's failure instead of printing a
+            # successful-looking metric with an empty int8 dict
+            _sys.stderr.write(
+                f"int8 phase FAILED (rc={r.returncode}):\n"
+                + r.stderr[-2000:] + "\n")
+        else:
+            int8_relerr = (got.pop("rel_err"), got.pop("argmax_same"))
+            results8 = {int(k): v for k, v in got.items()}
 
     bs_hero = batches[-1]
     print(json.dumps({
         "metric": f"Llama decode tokens/s (N={n/1e9:.2f}B, bf16, "
                   f"prompt {T0}, KV-cached static decode; "
                   f"per-bs {results}; weight-only-int8 {results8} "
-                  f"(greedy agreement {agree:.3f}); fp32-vs-bf16 "
+                  f"(int8 last-logit {int8_relerr}); fp32-vs-bf16 "
                   f"last-logit rel err {rel_err:.4f})",
         "value": results[bs_hero], "unit": f"tokens/s@bs{bs_hero}",
         "vs_baseline": results[1]}))

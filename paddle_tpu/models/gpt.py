@@ -188,6 +188,15 @@ def _spec(mesh: Mesh, *entries) -> NamedSharding:
 
 
 class GPTSpmdTrainer:
+    # class-level defaults so __new__-built instances (AOT tests) and
+    # hot paths see consistent attributes without per-site guards
+    lr_schedule = None
+    int8_guard_period = 0
+    int8_guard_threshold = 0.10
+    _host_step = 0
+    _guard_fn = None
+    _guard_events = ()   # __init__ replaces with a per-instance list
+
     """Functional GPT pretraining step, fully sharded.
 
     Parameter shardings (fp32 masters; bf16 cast inside the step):
