@@ -34,6 +34,7 @@ def main():
                          "noise floor, the regime the fixed-lr runs "
                          "never test")
     ap.add_argument("--guard-period", type=int, default=0)
+    ap.add_argument("--ce-int8", action="store_true")
     args = ap.parse_args()
 
     import jax
@@ -58,7 +59,9 @@ def main():
         return GPTSpmdTrainer(
             cfg, mesh, microbatches=1, remat="save_qkv_ffn",
             moment_dtype=jnp.bfloat16, master_dtype=jnp.bfloat16,
-            quant8=quant8, ce_chunks=4, seed=0, lr_schedule=sched,
+            quant8=quant8, ce_chunks=4 if not args.ce_int8 else 1,
+            ce_int8=bool(quant8) and args.ce_int8, seed=0,
+            lr_schedule=sched,
             int8_guard_period=args.guard_period if quant8 else 0)
 
     def run(quant8):

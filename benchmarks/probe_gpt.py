@@ -29,6 +29,7 @@ def main():
                     choices=["", "fwd", "dgrad", "wgrad"])
     ap.add_argument("--unroll", type=int, default=1)
     ap.add_argument("--ce-chunks", type=int, default=16)
+    ap.add_argument("--ce-int8", action="store_true")
     ap.add_argument("--no-fused-opt", action="store_true")
     ap.add_argument("--compile-only", action="store_true")
     args = ap.parse_args()
@@ -53,6 +54,7 @@ def main():
                 "wgrad": "wgrad"}[args.quant8],
         layer_unroll=args.unroll,
         ce_chunks=args.ce_chunks,
+        ce_int8=args.ce_int8,
         fused_optimizer=False if args.no_fused_opt else None)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size,

@@ -239,6 +239,7 @@ class GPTSpmdTrainer:
                  fused_optimizer: Optional[bool] = None,
                  layer_unroll: int = 1,
                  ce_chunks: int = 16,
+                 ce_int8: bool = False,
                  lr_schedule=None,
                  int8_guard_period: int = 0,
                  int8_guard_threshold: float = 0.10):
@@ -357,6 +358,10 @@ class GPTSpmdTrainer:
         # vocab-chunk count for the fused CE: fewer chunks = bigger
         # (faster) head matmuls but a larger live logits buffer
         self.ce_chunks = int(ce_chunks)
+        # int8-MXU CE head matmuls (fwd + recompute + dx; dhead exact —
+        # it feeds the tied embedding's Adam state). ~31 ms of head
+        # matmuls at the flagship shape; earn/reject via parity_int8.
+        self.ce_int8 = bool(ce_int8)
         if self.moe_experts and mesh.shape["pipe"] > 1 \
                 and self.pipeline_schedule == "gpipe":
             raise NotImplementedError(
@@ -799,7 +804,8 @@ class GPTSpmdTrainer:
             from ..ops.fused_ce import fused_softmax_cross_entropy
             loss = fused_softmax_cross_entropy(x, head.astype(dtype),
                                                labels,
-                                               n_chunks=self.ce_chunks)
+                                               n_chunks=self.ce_chunks,
+                                               int8=self.ce_int8)
         else:
             logits = jnp.einsum("btd,dv->btv", x, head.astype(dtype),
                                 preferred_element_type=jnp.float32)

@@ -28,15 +28,35 @@ def _chunk_heads(head, n_chunks):
     return head.reshape(D, n_chunks, Vc).transpose(1, 0, 2)  # [C, D, Vc]
 
 
-def _forward(x, head, labels, n_chunks):
+def _quantized_x(x, int8):
+    """Quantize the activations ONCE, outside the vocab-chunk scan —
+    the Pallas quantize is an opaque custom call XLA cannot hoist out
+    of lax.scan itself. Scale structure = the block matmuls' proven
+    per-row/per-col recipe (ops/quant_matmul.py)."""
+    if not int8:
+        return None
+    from .quant_matmul import quantize_rowwise_fast
+    return quantize_rowwise_fast(x, axis=-1)
+
+
+def _head_logits_int8(xq_xs, hc):
+    from .quant_matmul import quantize_rowwise_fast, int8_dot_dequant
+    xq, xs = xq_xs
+    hq, hs = quantize_rowwise_fast(hc, axis=0)
+    return int8_dot_dequant(xq, xs, hq, hs, ((xq.ndim - 1,), (0,)))
+
+
+def _forward(x, head, labels, n_chunks, int8=False):
     """Online logsumexp over vocab chunks; returns (loss, (max, sumexp))."""
     Vc = head.shape[1] // n_chunks
     hb = _chunk_heads(head.astype(x.dtype), n_chunks)
+    xq_xs = _quantized_x(x, int8)
 
     def body(carry, hc):
         m, s, lterm, off = carry
-        lg = jnp.einsum("btd,dv->btv", x, hc,
-                        preferred_element_type=jnp.float32)
+        lg = _head_logits_int8(xq_xs, hc) if int8 else \
+            jnp.einsum("btd,dv->btv", x, hc,
+                       preferred_element_type=jnp.float32)
         m2 = jnp.maximum(m, lg.max(-1))
         s = s * jnp.exp(m - m2) + jnp.exp(lg - m2[..., None]).sum(-1)
         idx = labels - off
@@ -52,8 +72,9 @@ def _forward(x, head, labels, n_chunks):
     return jnp.mean(lse - lterm), (m, s)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3,))
-def fused_softmax_cross_entropy(x, head, labels, n_chunks=8):
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def fused_softmax_cross_entropy(x, head, labels, n_chunks=8,
+                                int8=False):
     """Mean token NLL of ``softmax(x @ head)`` against integer ``labels``.
 
     x: [..., D] activations (bf16/f32); head: [D, V]; labels: [...] int.
@@ -61,33 +82,48 @@ def fused_softmax_cross_entropy(x, head, labels, n_chunks=8):
     ``-mean(log_softmax(x @ head)[labels])`` with fp32 accumulation, but
     O(V/n_chunks) peak memory.
     """
-    return _forward(x, head, labels, n_chunks)[0]
+    return _forward(x, head, labels, n_chunks, int8)[0]
 
 
-def _ce_fwd(x, head, labels, n_chunks):
-    loss, (m, s) = _forward(x, head, labels, n_chunks)
+def _ce_fwd(x, head, labels, n_chunks, int8):
+    loss, (m, s) = _forward(x, head, labels, n_chunks, int8)
     return loss, (x, head, labels, m, s)
 
 
-def _ce_bwd(n_chunks, res, g):
+def _ce_bwd(n_chunks, int8, res, g):
     x, head, labels, m, s = res
     D, V = head.shape
     Vc = V // n_chunks
     hb = _chunk_heads(head.astype(x.dtype), n_chunks)
     n_tokens = np.float32(np.prod(x.shape[:-1]))
 
+    xq_xs = _quantized_x(x, int8)
+
     def body(carry, hc):
         dx, off = carry
-        lg = jnp.einsum("btd,dv->btv", x, hc,
-                        preferred_element_type=jnp.float32)
+        # the recompute must match the forward's arithmetic exactly —
+        # softmax normalizers (m, s) were computed on THOSE logits
+        lg = _head_logits_int8(xq_xs, hc) if int8 else \
+            jnp.einsum("btd,dv->btv", x, hc,
+                       preferred_element_type=jnp.float32)
         p = jnp.exp(lg - m[..., None]) / s[..., None]
         idx = labels - off
         inb = (idx >= 0) & (idx < Vc)
         onehot = jax.nn.one_hot(jnp.where(inb, idx, -1), Vc, dtype=p.dtype)
         dlg = (p - onehot) * (g / n_tokens)
         dlg = dlg.astype(x.dtype)
-        dxc = jnp.einsum("btv,dv->btd", dlg, hc,
-                         preferred_element_type=jnp.float32)
+        if int8:
+            from .quant_matmul import (quantize_rowwise_fast,
+                                       int8_dot_dequant)
+            gq, gs = quantize_rowwise_fast(dlg, axis=-1)
+            hcq, hcs = quantize_rowwise_fast(hc, axis=1)
+            dxc = int8_dot_dequant(
+                gq, gs, hcq,
+                jnp.reshape(hcs, (1,) * (dlg.ndim - 1) + (-1,)),
+                ((dlg.ndim - 1,), (1,)))
+        else:
+            dxc = jnp.einsum("btv,dv->btd", dlg, hc,
+                             preferred_element_type=jnp.float32)
         dhc = jnp.einsum("btd,btv->dv", x, dlg,
                          preferred_element_type=jnp.float32)
         return (dx + dxc, off + Vc), dhc

@@ -27,6 +27,7 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 __all__ = ["int8_linear", "int8_linear_dgrad8", "int8_linear_all8",
+           "int8_dot_dequant",
            "quantize_rowwise", "quantize_rowwise_fast",
            "sr_quantize_colwise"]
 
@@ -137,13 +138,22 @@ def quantize_rowwise_fast(x, axis, interpret=None):
     return quantize_rowwise(x, axis)
 
 
+def int8_dot_dequant(aq, a_scale, bq, b_scale, dims):
+    """int8 dot_general + f32 dequant. ``dims`` = (a_axes, b_axes)
+    contraction dims; scales must already broadcast against the
+    result. The ONE quantized-matmul core shared by the block matmuls
+    and the CE head (three call paths, one arithmetic)."""
+    y = jax.lax.dot_general(aq, bq, (dims, ((), ())),
+                            preferred_element_type=jnp.int32)
+    return y.astype(jnp.float32) * a_scale * b_scale
+
+
 def _int8_matmul(x, w):
     """x [..., K] @ w [K, N] with int8 MXU math, output in x.dtype."""
     xq, xs = quantize_rowwise_fast(x, axis=-1)     # [..., 1]
     wq, ws = quantize_rowwise_fast(w, axis=0)      # [1, N]
-    y = jax.lax.dot_general(xq, wq, (((x.ndim - 1,), (0,)), ((), ())),
-                            preferred_element_type=jnp.int32)
-    return (y.astype(jnp.float32) * xs * ws).astype(x.dtype)
+    y = int8_dot_dequant(xq, xs, wq, ws, ((x.ndim - 1,), (0,)))
+    return y.astype(x.dtype)
 
 
 @jax.custom_vjp
