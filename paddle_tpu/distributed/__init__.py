@@ -27,6 +27,7 @@ from . import auto_tuner  # noqa: F401
 from . import rpc  # noqa: F401
 from . import watchdog  # noqa: F401
 from . import ps  # noqa: F401
+from . import ps_device_cache  # noqa: F401
 from . import fleet_executor  # noqa: F401
 from .store import TCPStore  # noqa: F401
 from .extras import (alltoall, alltoall_single, gather,  # noqa: F401

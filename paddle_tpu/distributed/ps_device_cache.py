@@ -1,0 +1,151 @@
+"""Device-resident sparse-embedding training (the GPU-PS analog).
+
+Reference: paddle/fluid/framework/ps_gpu_trainer.cc +
+fleet/ps_gpu_wrapper.cc — embedding rows are cached in accelerator
+memory for the duration of a pass, the optimizer runs ON the
+accelerator, and the parameter server is the capacity/persistence tier
+(pull on miss, write back on eviction/flush) instead of a per-step
+round-trip.
+
+TPU-native version: the cache is a dense ``[slots, dim]`` device
+Parameter — lookups are device gathers through the tape, so any eager
+optimizer trains the resident rows at HBM speed. Keys touched since
+the last ``release_pins()`` are PINNED: they can neither be evicted
+nor have their slot reassigned, so a gradient still in flight can
+never be scattered into a row that now belongs to a different key —
+call ``release_pins()`` after ``optimizer.step()``. The host keeps the
+key->slot map (LRU) plus each row's PULL-TIME baseline; eviction and
+``flush()`` write rows back EXACTLY by pushing ``baseline - current``
+into a server-side ``sgd, lr=1.0`` table (new = old - 1.0*(old - new)),
+so no raw-assign RPC is needed and the C++ server (csrc/ps_table.cc)
+stays unchanged. Only MISSING rows ever cross the host<->device
+boundary; hot ids never leave HBM — the property ps_gpu_trainer exists
+for.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from ..framework.tensor import Parameter, Tensor, no_grad
+from .ps import PsClient, SparseTable
+
+__all__ = ["DeviceCachedEmbedding"]
+
+
+class DeviceCachedEmbedding:
+    """A trainable embedding whose working set lives on device and
+    whose full key space lives on the parameter server."""
+
+    def __init__(self, client: PsClient, dim: int, cache_slots: int,
+                 init_scale: float = 0.05,
+                 table_id: Optional[int] = None):
+        # lr=1.0 sgd makes push(delta) an exact raw write-back
+        self.table = SparseTable(client, dim, optimizer="sgd", lr=1.0,
+                                 init_scale=init_scale,
+                                 table_id=table_id)
+        self.dim = int(dim)
+        self.slots = int(cache_slots)
+        self.weight = Parameter(
+            jnp.zeros((self.slots, self.dim), jnp.float32),
+            name=f"device_cached_emb_{self.table.table_id}")
+        self._key_slot: "OrderedDict[int, int]" = OrderedDict()  # LRU
+        self._free: List[int] = list(range(self.slots))
+        self._baseline = np.zeros((self.slots, self.dim), np.float32)
+        self._pinned: set = set()   # keys with gradients in flight
+        self.stats = {"pulls": 0, "hits": 0, "evictions": 0}
+
+    # -- host-side cache management ---------------------------------------
+    def _ensure_resident(self, keys: np.ndarray) -> Dict[int, int]:
+        uniq = np.unique(keys)
+        if len(uniq) > self.slots:
+            raise ValueError(
+                f"batch touches {len(uniq)} unique keys > "
+                f"{self.slots} cache slots")
+        missing = [int(k) for k in uniq if int(k) not in self._key_slot]
+        self.stats["hits"] += len(uniq) - len(missing)
+        for k in uniq:
+            k = int(k)
+            if k in self._key_slot:
+                self._key_slot.move_to_end(k)   # refresh LRU
+            self._pinned.add(k)
+        if missing:
+            slots = self._take_slots(len(missing))
+            rows = self.table.pull(np.asarray(missing, np.int64))
+            self.stats["pulls"] += len(missing)
+            with no_grad():
+                self.weight._data = self.weight._data.at[
+                    np.asarray(slots)].set(jnp.asarray(rows))
+            self._baseline[slots] = rows
+            for k, s in zip(missing, slots):
+                self._key_slot[k] = s
+        return {int(k): self._key_slot[int(k)] for k in uniq}
+
+    def _take_slots(self, n: int) -> List[int]:
+        out = []
+        while self._free and len(out) < n:
+            out.append(self._free.pop())
+        if len(out) < n:
+            # evict the LRU tail — but never a PINNED key (its slot may
+            # still receive a gradient from an earlier lookup)
+            need = n - len(out)
+            victims = [(k, s) for k, s in self._key_slot.items()
+                       if k not in self._pinned][:need]
+            if len(victims) < need:
+                self._free.extend(out)   # undo: a refused lookup must
+                out.clear()              # not leak the slots it took
+                raise ValueError(
+                    f"need {need} slots but only {len(victims)} "
+                    f"unpinned evictable rows — call release_pins() "
+                    f"after optimizer.step(), or grow cache_slots")
+            self._writeback([s for _, s in victims],
+                            [k for k, _ in victims])
+            for k, s in victims:
+                del self._key_slot[k]
+                out.append(s)
+            self.stats["evictions"] += need
+        return out
+
+    def _writeback(self, slots: List[int], keys: List[int]):
+        if not slots:
+            return
+        cur = np.asarray(self.weight._data[np.asarray(slots)],
+                         np.float32)
+        delta = self._baseline[slots] - cur     # sgd lr=1.0 => assign
+        self.table.push(np.asarray(keys, np.int64), delta)
+        self._baseline[slots] = cur
+
+    # -- public API --------------------------------------------------------
+    def lookup(self, ids) -> Tensor:
+        """Embedding rows for ``ids`` (any int array-like); gradients
+        flow to the resident device table."""
+        ids_np = np.asarray(getattr(ids, "_data", ids)).astype(np.int64)
+        mapping = self._ensure_resident(ids_np.reshape(-1))
+        if ids_np.size:
+            uniq = np.asarray(sorted(mapping), np.int64)
+            slots_for_uniq = np.fromiter(
+                (mapping[int(k)] for k in uniq), np.int64,
+                count=len(uniq))
+            slot_ids = slots_for_uniq[np.searchsorted(uniq, ids_np)]
+        else:
+            slot_ids = ids_np
+        return self.weight[Tensor(jnp.asarray(slot_ids))]
+
+    def release_pins(self):
+        """Declare in-flight gradients applied (call after
+        ``optimizer.step()``): previously-looked-up rows become
+        evictable again."""
+        self._pinned.clear()
+
+    def flush(self):
+        """Write every resident row's trained value back to the PS
+        (pass end / checkpoint)."""
+        items = list(self._key_slot.items())
+        self._writeback([s for _, s in items], [k for k, _ in items])
+
+    def parameters(self):
+        return [self.weight]
