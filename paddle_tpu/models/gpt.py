@@ -191,6 +191,7 @@ class GPTSpmdTrainer:
     # class-level defaults so __new__-built instances (AOT tests) and
     # hot paths see consistent attributes without per-site guards
     lr_schedule = None
+    ce_int8 = False
     int8_guard_period = 0
     int8_guard_threshold = 0.10
     _host_step = 0
