@@ -18,9 +18,17 @@ def main():
     for s in SCRIPTS:
         # plain environment: each script resolves the repo root via
         # benchmarks/_path.py, and PYTHONPATH must stay unset (it
-        # breaks axon TPU plugin registration)
+        # breaks axon TPU plugin registration). On CPU the multi-chip
+        # configs need the virtual 8-device mesh.
+        env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+        if env.get("JAX_PLATFORMS") == "cpu":
+            flags = [f for f in env.get("XLA_FLAGS", "").split()
+                     if "host_platform_device_count" not in f]
+            flags.append("--xla_force_host_platform_device_count=8")
+            env["XLA_FLAGS"] = " ".join(flags)
         r = subprocess.run([sys.executable, os.path.join(here, s)],
-                           capture_output=True, text=True, timeout=1800)
+                           capture_output=True, text=True, timeout=1800,
+                           env=env)
         for line in r.stdout.splitlines():
             if line.startswith("{"):
                 print(line)
