@@ -296,8 +296,6 @@ class GPTSpmdTrainer:
         self._guard_fn = None
         self._guard_events = []
         self._host_step = 0
-        if quant8 == "wgrad" and moe_experts:
-            raise ValueError("quant8='wgrad' not wired for MoE blocks")
         if quant8 == "wgrad" and mesh.shape.get("pipe", 1) > 1:
             # the pipeline paths do not thread the per-step SR seed;
             # running them would silently reuse one stream every step —
@@ -565,13 +563,13 @@ class GPTSpmdTrainer:
         x = x + o + bp["bout"].astype(x.dtype)
         return act(x, _spec(self.mesh, "data", "sep", None))
 
-    def _block_moe(self, x, bp):
+    def _block_moe(self, x, bp, seed=None):
         """Transformer block with a GShard top-2 MoE FFN; returns
         (x, load_balance_aux). Experts live on the 'data' mesh axis —
         the dispatch/combine einsums below ARE the all-to-all pair."""
         from ..incubate.moe import moe_dispatch_combine
         act = partial(jax.lax.with_sharding_constraint)
-        mm = self._mm()
+        mm = self._mm(seed)
         x = self._attn_sublayer(x, bp, mm, act)
         mb, T, D = x.shape
         E = self.moe_experts
@@ -659,11 +657,7 @@ class GPTSpmdTrainer:
         of ~9 activation buffers per layer."""
         blk = self._remat_wrap(self._block)
         if self.quant8 == "wgrad":
-            # scan (params, per-layer SR seed) pairs so each layer's
-            # wgrad quantization draws from its own stream
-            base = jnp.int32(1) if seed is None else seed
-            xs = (stage_params,
-                  base + jnp.arange(self.Lps, dtype=jnp.int32) * 16)
+            xs = (stage_params, self._layer_seeds(seed))
             body = lambda carry, t: (blk(carry, t[0], t[1]), None)
         else:
             xs = stage_params
@@ -671,6 +665,13 @@ class GPTSpmdTrainer:
         x, _ = jax.lax.scan(body, x, xs,
                             unroll=min(self.layer_unroll, self.Lps))
         return x
+
+    def _layer_seeds(self, seed):
+        """Per-layer SR seed array for the wgrad scan: layers sit 16
+        apart so _mm's ``s*8 + site`` keeps (layer, site) streams
+        distinct — ONE definition for the dense and MoE stages."""
+        base = jnp.int32(1) if seed is None else seed
+        return base + jnp.arange(self.Lps, dtype=jnp.int32) * 16
 
     def _remat_wrap(self, block_fn):
         """Apply the configured remat policy to a block fn (shared by
@@ -717,18 +718,27 @@ class GPTSpmdTrainer:
             return jax.checkpoint(block_fn)
         return jax.checkpoint(block_fn, policy=pol)
 
-    def _stage_fn_moe(self, stage_params, x):
+    def _stage_fn_moe(self, stage_params, x, seed=None):
         """MoE stage: like _stage_fn but threads the summed
         load-balance aux loss through the layer scan."""
         blk = self._remat_wrap(self._block_moe)
+        if self.quant8 == "wgrad":
+            xs = (stage_params, self._layer_seeds(seed))
 
-        def body(carry, bp):
-            x, aux = carry
-            x, a = blk(x, bp)
-            return (x, aux + a), None
+            def body(carry, t):
+                x, aux = carry
+                x, a = blk(x, t[0], t[1])
+                return (x, aux + a), None
+        else:
+            xs = stage_params
+
+            def body(carry, bp):
+                x, aux = carry
+                x, a = blk(x, bp)
+                return (x, aux + a), None
 
         (x, aux), _ = jax.lax.scan(body, (x, jnp.zeros((), jnp.float32)),
-                                   stage_params,
+                                   xs,
                                    unroll=min(self.layer_unroll, self.Lps))
         return x, aux
 
@@ -769,7 +779,7 @@ class GPTSpmdTrainer:
                     mb_seeds = seed + (jnp.arange(self.M, dtype=jnp.int32)
                                        + 1) * jnp.int32(-1640531527)
                     out = jax.lax.map(
-                        lambda t: self._stage_fn(stage, t[0], t[1]),
+                        lambda t: stage_fn(stage, t[0], t[1]),
                         (xm, mb_seeds))
                 else:
                     out = jax.lax.map(partial(stage_fn, stage), xm)
@@ -780,10 +790,11 @@ class GPTSpmdTrainer:
                     x = out
                 x = x.reshape(B, T, cfg.hidden_size)
             else:
-                if self.moe_experts:
+                if self.quant8 == "wgrad":
+                    out = stage_fn(stage, x, seed)
+                    x, moe_aux = out if self.moe_experts else (out, None)
+                elif self.moe_experts:
                     x, moe_aux = stage_fn(stage, x)
-                elif self.quant8 == "wgrad":
-                    x = self._stage_fn(stage, x, seed)
                 else:
                     x = stage_fn(stage, x)
         else:

@@ -186,3 +186,27 @@ def test_tuner_search_space_covers_sep_and_moe():
     seq2 = Candidate(dp=4, sep=2, micro_batch_size=1)
     assert not prune_by_memory(flat, big)
     assert prune_by_memory(seq2, big)
+
+
+def test_moe_trainer_wgrad_int8():
+    # round 4 removed the MoE restriction: the SR seed threads through
+    # the MoE layer scan, so all-int8 matmuls compose with expert
+    # parallelism (attention sublayer int8; expert einsums exact).
+    # microbatches=2 exercises the lax.map (xm, mb_seeds) dispatch too.
+    cfg = GPTConfig(vocab_size=128, hidden_size=64, num_layers=2,
+                    num_heads=4, max_seq_len=32, dtype=jnp.float32)
+    mesh = build_mesh(n_devices=1, pipe=1, model=1, fsdp=1, sep=1)
+    rng = np.random.RandomState(0)
+    ids = rng.randint(0, 128, (4, 32)).astype(np.int32)
+    labels = np.roll(ids, -1, 1)
+    for M in (1, 2):
+        losses = {}
+        for q8 in (False, "wgrad"):
+            tr = GPTSpmdTrainer(cfg, mesh, microbatches=M, remat=False,
+                                quant8=q8, moe_experts=2, seed=0,
+                                use_flash=False)
+            for _ in range(3):
+                loss = tr.train_step(ids, labels)
+            losses[q8] = float(jax.device_get(loss))
+        assert np.isfinite(losses["wgrad"])
+        assert abs(losses["wgrad"] - losses[False]) < 0.08, (M, losses)
