@@ -180,7 +180,9 @@ for step in range(1, 4):
     time.sleep(0.1)
 
 # steady state: heartbeat while watching for NEW peers wanting in
-deadline = time.time() + 15
+# (generous deadline: on a loaded 1-core CI host the joiner process
+# pays a slow jax import before it can announce)
+deadline = time.time() + 90
 while time.time() < deadline:
     em.heartbeat()
     joined = em.joined_peers()
@@ -203,8 +205,8 @@ store = TCPStore("127.0.0.1", port, is_master=False, world_size=2)
 em = ElasticManager(checkpoint_dir="/tmp", store=store)
 em.announce_join(rank=2)
 # keep the key fresh until the incumbents have seen it — long enough
-# to outlive a slow (cold jax import) worker startup
-for _ in range(150):
+# to outlive a slow (cold jax import) worker startup on a loaded host
+for _ in range(600):
     store.add("elastic/node/2", 1)
     time.sleep(0.1)
 print("announced")
