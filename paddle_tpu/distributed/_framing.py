@@ -11,7 +11,14 @@ import socket
 import struct
 from typing import Optional
 
-__all__ = ["send_msg", "recv_msg", "recv_exact", "nodelay"]
+__all__ = ["send_msg", "recv_msg", "recv_exact", "nodelay",
+           "MAX_FRAME_BYTES"]
+
+# Upper bound on a single frame: a corrupt or hostile header must not
+# drive recv_exact into a near-2^64 allocation loop. 4 GiB covers the
+# largest activation tensors the serving pipeline ships; override via
+# paddle_tpu.distributed._framing.MAX_FRAME_BYTES for larger payloads.
+MAX_FRAME_BYTES = 4 << 30
 
 
 def nodelay(sock: socket.socket) -> socket.socket:
@@ -34,6 +41,10 @@ def recv_msg(sock: socket.socket,
     if hdr is None:
         return None
     (n,) = struct.unpack("<Q", hdr)
+    if n > MAX_FRAME_BYTES:
+        raise ConnectionError(
+            f"frame length {n} exceeds MAX_FRAME_BYTES "
+            f"({MAX_FRAME_BYTES}): corrupt or hostile header")
     return recv_exact(sock, n)
 
 

@@ -70,6 +70,7 @@ class ElasticManager:
         self._watcher: Optional[threading.Thread] = None
         self._failed = False
         self._scale_up: list = []
+        self._announcers: dict = {}  # rank -> (stop Event, thread)
 
     # -- membership (coordination-service analog of etcd registry) --------
     def register(self, rank: Optional[int] = None,
@@ -144,14 +145,52 @@ class ElasticManager:
                 if now - t > self.heartbeat_timeout]
 
     # -- scale-up (reference manager.py watches BOTH directions) ----------
-    def announce_join(self, rank: int):
+    def announce_join(self, rank: int, keepalive: bool = True):
         """Called by a NEW worker (rank >= current world) asking the
         job to grow; existing workers see it via ``joined_peers`` and
         exit for an upsized relaunch (reference: the etcd watch on the
-        node prefix firing for added members, manager.py:125)."""
+        node prefix firing for added members, manager.py:125).
+
+        ``joined_peers`` only reports a key whose counter is OBSERVED
+        MOVING (stale-key immunity), so a single add would never be
+        detected. By default this therefore starts a daemon keep-alive
+        thread re-adding the key every ``heartbeat_timeout / 3`` s until
+        ``stop_announce()`` (or process exit). Pass ``keepalive=False``
+        to manage refreshing yourself — then you MUST keep calling
+        ``announce_join`` at < heartbeat_timeout intervals."""
         if self.store is None:
             raise RuntimeError("announce_join requires a shared store")
         self.store.add(f"elastic/node/{rank}", 1)
+        if keepalive and rank not in self._announcers:
+            stop = threading.Event()
+
+            def _refresh():
+                # transient store errors (relaunch churn, timeouts)
+                # must not kill the refresher: keep trying until
+                # stop_announce() — a joiner whose counter goes quiet
+                # silently vanishes from joined_peers()
+                try:
+                    while not stop.wait(self.heartbeat_timeout / 3.0):
+                        try:
+                            self.store.add(f"elastic/node/{rank}", 1)
+                        except Exception:
+                            continue
+                finally:
+                    # a dead thread must not block a re-announce
+                    self._announcers.pop(rank, None)
+            t = threading.Thread(target=_refresh, daemon=True,
+                                 name=f"elastic-join-{rank}")
+            t.start()
+            self._announcers[rank] = (stop, t)
+
+    def stop_announce(self, rank: Optional[int] = None):
+        """Stop the keep-alive refresher(s) started by announce_join
+        (call once the joiner has been folded into the new world)."""
+        ranks = list(self._announcers) if rank is None else [rank]
+        for r in ranks:
+            ent = self._announcers.pop(r, None)
+            if ent is not None:
+                ent[0].set()
 
     def joined_peers(self, probe: int = 8):
         """Fresh registry entries BEYOND the current world size — i.e.

@@ -57,7 +57,47 @@ class DeviceCachedEmbedding:
         self._free: List[int] = list(range(self.slots))
         self._baseline = np.zeros((self.slots, self.dim), np.float32)
         self._pinned: set = set()   # keys with gradients in flight
+        self._slot_reset_hooks: List = []
         self.stats = {"pulls": 0, "hits": 0, "evictions": 0}
+
+    # -- optimizer-state hygiene ------------------------------------------
+    def register_slot_reset_hook(self, fn):
+        """``fn(slot_indices: np.ndarray)`` runs whenever those cache
+        slots are (re)assigned to NEW keys. Stateful optimizers (Adam
+        moments, momentum velocity) index their accumulators by cache
+        slot of the dense weight Parameter — without a reset, a slot
+        reassigned after eviction would INHERIT the evicted key's
+        moment state. Use :meth:`attach_optimizer` for the common
+        case."""
+        self._slot_reset_hooks.append(fn)
+        return fn
+
+    def attach_optimizer(self, opt):
+        """Zero ``opt``'s accumulator rows for the cache weight whenever
+        a slot changes owner, making any stateful eager optimizer
+        correct under slot reuse. (Resident rows with zero gradient
+        still receive the optimizer's dense update, matching the
+        reference's non-lazy ``adam(lazy_mode=False)`` semantics;
+        non-resident rows receive none.)"""
+        name = self.weight.name
+
+        def _reset(slots: np.ndarray):
+            accs = getattr(opt, "_accumulators", {}).get(name)
+            if not accs:
+                return
+            idx = jnp.asarray(slots)
+            for sname, arr in accs.items():
+                if getattr(arr, "shape", ())[:1] != (self.slots,):
+                    continue
+                if sname == "master_weight":
+                    # masters mirror the weight, not a decayed moment:
+                    # re-seed from the freshly pulled rows, never zero
+                    accs[sname] = arr.at[idx].set(
+                        self.weight._data[idx].astype(arr.dtype))
+                else:
+                    accs[sname] = arr.at[idx].set(0)
+
+        return self.register_slot_reset_hook(_reset)
 
     # -- host-side cache management ---------------------------------------
     def _ensure_resident(self, keys: np.ndarray) -> Dict[int, int]:
@@ -83,6 +123,8 @@ class DeviceCachedEmbedding:
             self._baseline[slots] = rows
             for k, s in zip(missing, slots):
                 self._key_slot[k] = s
+            for hook in self._slot_reset_hooks:
+                hook(np.asarray(slots, np.int64))
         return {int(k): self._key_slot[int(k)] for k in uniq}
 
     def _take_slots(self, n: int) -> List[int]:

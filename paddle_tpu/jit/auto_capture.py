@@ -118,6 +118,10 @@ class AutoCapture:
         mon.register_callback(tid, mon.events.PY_START,
                               self._on_py_start)
         mon.set_events(tid, mon.events.PY_START)
+        # per-code DISABLE state survives free_tool_id: without this a
+        # session reusing a freed tool id would silently never see
+        # PY_START for code objects a PREVIOUS session disabled
+        mon.restart_events()
         self._tool_id = tid
         return self
 

@@ -46,6 +46,7 @@ class _AliasedState(Exception):
 
 _MAX_SEGMENTS_PER_CALL = 512   # past this, finish eagerly (no abort)
 _MAX_CACHED_SEGMENTS = 128     # per function; beyond: eager-step only
+_MISSING_GLOBAL = object()     # guard token for an unbound global name
 
 
 def _has_aliased_mutables(state) -> bool:
@@ -178,6 +179,35 @@ class SegmentedFunction:
         self.fn = fn
         # (start_pc, static_key, avals) -> segment record
         self._segments: Dict[Tuple, Tuple] = {}
+        # Global reads are trace-time constants inside a compiled
+        # segment, but this tier exists for SIDE-EFFECTING functions —
+        # where a baked read feeds a replayed write (``G = G + 1``
+        # would re-store the trace-time G+1 forever). Guard segment
+        # keys on the current values of every name the bytecode
+        # LOAD_GLOBALs: a changed global re-specializes the segment
+        # (bounded by _MAX_CACHED_SEGMENTS, past which the driver
+        # eager-steps — correct, and self-limiting for globals that
+        # change every call).
+        import dis
+        self._global_names = tuple(sorted({
+            ins.argval for ins in dis.get_instructions(fn.__code__)
+            if ins.opname == "LOAD_GLOBAL"}))
+
+    def _globals_guard(self):
+        toks = []
+        g = self.fn.__globals__
+        for name in self._global_names:
+            v = g.get(name, _MISSING_GLOBAL)
+            if isinstance(v, (int, float, bool, str, bytes,
+                              type(None))):
+                toks.append((name, type(v).__name__, v))
+            else:
+                # objects (modules, functions, classes): identity-
+                # stable in practice; id() keys re-binding, not
+                # interior mutation (interior mutation of a read-only
+                # global is out of scope, as in the reference SOT)
+                toks.append((name, "id", id(v)))
+        return tuple(toks)
 
     # -- frame state <-> pytree -------------------------------------------
     def _snapshot(self, f: _Frame):
@@ -207,7 +237,8 @@ class SegmentedFunction:
                 # time and replay exhausted — eager-step instead
                 return None, None
         avals = tuple((tuple(a.shape), str(a.dtype)) for a in dyn)
-        return (pc, tuple(static), spec, treedef, avals), dyn
+        return (pc, tuple(static), spec, treedef, avals,
+                self._globals_guard()), dyn
 
     # -- one segment ------------------------------------------------------
     def _discover(self, pc: int, state, dyn):

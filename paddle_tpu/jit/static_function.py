@@ -42,6 +42,50 @@ _capture_stats = {"whole_graph_calls": 0, "bytecode_graph_calls": 0,
                   "graph_break_calls": 0, "breaks": {}}
 
 
+# Opcodes that REBIND names which always survive the call (module
+# globals, closure cells). Functions containing these are routed to
+# the strict bytecode tier, where such stores replay every call
+# instead of baking at trace time. STORE_ATTR/STORE_SUBSCR are NOT
+# scanned: their targets are usually call-local (and instrumentation
+# like ``stats["n"] += 1`` is common in hot code) — static scanning
+# cannot separate those from caller-owned targets, and demoting every
+# such function to the break-prone strict tier would deoptimize far
+# more than it fixes. docs/MIGRATION.md scopes the replay guarantee
+# accordingly.
+_EFFECT_OPNAMES = frozenset({"STORE_GLOBAL", "DELETE_GLOBAL"})
+_DEREF_OPNAMES = frozenset({"STORE_DEREF", "DELETE_DEREF"})
+
+
+def _writes_surviving_state(fn) -> bool:
+    import dis
+    import types as _types
+    target = fn.__func__ if inspect.ismethod(fn) else fn
+    if not isinstance(target, _types.FunctionType):
+        return False
+    # Cells INHERITED from an enclosing scope (co_freevars) outlive the
+    # call; the function's OWN cellvars (a local captured by a nested
+    # lambda/def — ubiquitous in jax-style code) die with it and must
+    # NOT demote the function to the strict tier.
+    surviving = set(target.__code__.co_freevars)
+
+    def scan(code) -> bool:
+        for ins in dis.get_instructions(code):
+            if ins.opname in _EFFECT_OPNAMES:
+                return True
+            if ins.opname in _DEREF_OPNAMES and ins.argval in surviving:
+                return True
+        # nested defs/lambdas/comprehensions can store through the
+        # same inherited cells (their freevars chain up through the
+        # outer function's freevars — `surviving` filters to those)
+        return any(isinstance(c, _types.CodeType) and scan(c)
+                   for c in code.co_consts)
+
+    try:
+        return scan(target.__code__)
+    except Exception:
+        return True  # unscannable: assume effects, strict tier is safe
+
+
 def capture_report():
     """Return {whole_graph_calls, bytecode_graph_calls,
     graph_break_calls, breaks: {reason: count}} accumulated across all
@@ -142,10 +186,18 @@ class StaticFunction:
         # no source => the AST tier would fall through to PLAIN jit
         # tracing, which cannot see side effects (they bake at trace
         # time and silently stop repeating). Start such functions at
-        # the bytecode tier, whose strict mode catches them.
+        # the bytecode tier, whose strict mode catches them. The same
+        # hazard exists for SOURCE-AVAILABLE functions whose bytecode
+        # REBINDS surviving names (STORE_GLOBAL, or STORE_DEREF to an
+        # inherited cell): the AST tier's plain jit would run the
+        # write once at trace time and drop it on cached calls —
+        # pre-scan the opcodes and start those at the bytecode tier
+        # too. (Attribute/item stores and mutating method calls are
+        # deliberately NOT scanned — see _EFFECT_OPNAMES; docs/
+        # MIGRATION.md scopes the replay guarantee accordingly.)
         try:
             inspect.getsource(self._fn)
-            self._prefer_bytecode = False
+            self._prefer_bytecode = _writes_surviving_state(self._fn)
         except (OSError, TypeError):
             self._prefer_bytecode = True
         functools.update_wrapper(self, self._fn)

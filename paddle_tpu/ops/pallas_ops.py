@@ -28,16 +28,28 @@ NEG_INF = -1e30
 _LANES = 128  # VPU lane width: scalar-per-row carries live as [bq, 128]
 
 
-def _choose_block(seq_len: int, target: int = 512) -> int:
+def _choose_block(seq_len: int, target: int = 512,
+                  which: str = "") -> int:
+    """Block size for one kernel axis. Env overrides, most specific
+    wins: PTPU_FLASH_BWD_BQ/_BWD_BK beat PTPU_FLASH_BQ/_BK beat the
+    all-four fallback PTPU_FLASH_BLOCK — the fwd and bwd kernels have
+    different reuse patterns, so their optima differ (the step-level
+    sweep lives in benchmarks/)."""
     import os
-    raw = os.environ.get("PTPU_FLASH_BLOCK", "")
-    if raw:
-        try:
-            override = int(raw)
-        except ValueError:
-            override = 0
-        if override >= 1:  # invalid/sentinel values keep the default
-            target = override
+    names = {"fwd_q": ("PTPU_FLASH_BQ",),
+             "fwd_k": ("PTPU_FLASH_BK",),
+             "bwd_q": ("PTPU_FLASH_BWD_BQ", "PTPU_FLASH_BQ"),
+             "bwd_k": ("PTPU_FLASH_BWD_BK", "PTPU_FLASH_BK")}
+    for name in names.get(which, ()) + ("PTPU_FLASH_BLOCK",):
+        raw = os.environ.get(name, "")
+        if raw:
+            try:
+                override = int(raw)
+            except ValueError:
+                override = 0
+            if override >= 1:  # invalid/sentinel values keep default
+                target = override
+                break
     b = min(target, seq_len)
     while seq_len % b:
         b //= 2
@@ -321,8 +333,8 @@ def _unpack(x, B, H):
 
 def _flash_fwd_rule(q, k, v, causal, scale):
     B, S, H, D = q.shape
-    bq = _choose_block(S)
-    bk = _choose_block(S)
+    bq = _choose_block(S, which="fwd_q")
+    bk = _choose_block(S, which="fwd_k")
     qp, kp, vp = _pack(q), _pack(k), _pack(v)
     out, lse = _fa_forward(qp, kp, vp, causal, scale, bq, bk)
     # named so remat policies can keep the flash residuals and skip the
@@ -334,7 +346,10 @@ def _flash_fwd_rule(q, k, v, causal, scale):
 
 
 def _flash_bwd_rule(causal, scale, res, g):
-    qp, kp, vp, out, lse, B, H, bq, bk = res
+    qp, kp, vp, out, lse, B, H, _, _ = res  # fwd blocks: not reused
+    S = qp.shape[1]
+    bq, bk = (_choose_block(S, which="bwd_q"),
+              _choose_block(S, which="bwd_k"))
     gp = _pack(g)
     dq, dk, dv = _fa_backward((qp, kp, vp, out, lse), gp, causal, scale,
                               bq, bk)
@@ -656,8 +671,8 @@ def _flash_qkvpacked(qkv, H, causal, scale):
 
 def _flash_qkvpacked_fwd(qkv, H, causal, scale):
     S = qkv.shape[1]
-    bq = _choose_block(S)
-    bk = _choose_block(S)
+    bq = _choose_block(S, which="fwd_q")
+    bk = _choose_block(S, which="fwd_k")
     out, lse = _fa_forward_qkvpacked(qkv, H, causal, scale, bq, bk)
     out = checkpoint_name(out, "flash_out")
     lse = checkpoint_name(lse, "flash_lse")
@@ -665,7 +680,10 @@ def _flash_qkvpacked_fwd(qkv, H, causal, scale):
 
 
 def _flash_qkvpacked_bwd(H, causal, scale, res, g):
-    qkv, out, lse, bq, bk = res
+    qkv, out, lse, _, _ = res  # fwd blocks: not reused by the bwd
+    S = qkv.shape[1]
+    bq, bk = (_choose_block(S, which="bwd_q"),
+              _choose_block(S, which="bwd_k"))
     HD = out.shape[-1]
     q = qkv[..., :HD]
     k = qkv[..., HD:2 * HD]

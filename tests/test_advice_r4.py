@@ -1,0 +1,132 @@
+"""Regression tests for the round-4 advisor findings (ADVICE.md):
+frame-size guard, elastic announce_join keep-alive, auto_capture
+monitoring-state reset. (The two medium items are covered in
+test_sot_bytecode.py and test_ps_device_cache.py.)"""
+import socket
+import struct
+import threading
+import time
+
+import pytest
+
+
+def test_recv_msg_rejects_hostile_length_header():
+    from paddle_tpu.distributed import _framing
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    cli = socket.socket()
+    cli.connect(("127.0.0.1", port))
+    conn, _ = srv.accept()
+    try:
+        # a near-2^64 length header must raise, not allocate
+        cli.sendall(struct.pack("<Q", 2 ** 63) + b"xx")
+        with pytest.raises(ConnectionError, match="MAX_FRAME_BYTES"):
+            _framing.recv_msg(conn)
+        # sane frames still round-trip on a fresh pair
+    finally:
+        for s in (cli, conn, srv):
+            s.close()
+
+
+def test_recv_msg_normal_roundtrip_under_guard():
+    from paddle_tpu.distributed import _framing
+
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    cli = socket.socket()
+    cli.connect(("127.0.0.1", srv.getsockname()[1]))
+    conn, _ = srv.accept()
+    try:
+        _framing.send_msg(cli, b"payload")
+        assert _framing.recv_msg(conn) == b"payload"
+    finally:
+        for s in (cli, conn, srv):
+            s.close()
+
+
+def test_announce_join_keepalive_refreshes_key():
+    """A ONE-SHOT announce_join must be detectable: joined_peers only
+    reports keys whose counter MOVES, so announce_join starts a
+    refresher (the advisor's repro was a single call that was never
+    seen)."""
+    from paddle_tpu.distributed.fleet.elastic import ElasticManager
+
+    class FakeStore:
+        def __init__(self):
+            self.kv = {}
+
+        def add(self, k, v):
+            self.kv[k] = self.kv.get(k, 0) + v
+            return self.kv[k]
+
+        def get(self, k, timeout=None):
+            if k not in self.kv:
+                raise KeyError(k)
+            return self.kv[k]
+
+    store = FakeStore()
+    incumbent = ElasticManager(checkpoint_dir="/tmp", store=store,
+                               heartbeat_timeout=0.3)
+    incumbent.register(rank=0, world=2)
+    joiner = ElasticManager(checkpoint_dir="/tmp", store=store,
+                            heartbeat_timeout=0.3)
+    joiner.announce_join(rank=2)          # ONE call, default keepalive
+    try:
+        incumbent.joined_peers()          # first sight: recorded
+        deadline = time.monotonic() + 3.0
+        seen = []
+        while time.monotonic() < deadline and not seen:
+            time.sleep(0.12)
+            seen = incumbent.joined_peers()
+        assert seen == [2], f"one-shot announce_join never seen: {seen}"
+    finally:
+        joiner.stop_announce()
+    # after stop_announce the counter must go quiet
+    v0 = store.kv["elastic/node/2"]
+    time.sleep(0.4)
+    assert store.kv["elastic/node/2"] == v0
+
+
+def test_auto_capture_sessions_see_code_disabled_by_prior_session():
+    """sys.monitoring DISABLE state persists across free_tool_id; a new
+    AutoCapture session must restart_events so earlier sessions'
+    DISABLEs cannot blind it."""
+    import sys
+    import types
+
+    from paddle_tpu.jit.auto_capture import AutoCapture
+
+    mod = types.ModuleType("ac_probe_mod")
+
+    def warm(x):
+        return x + 1
+
+    warm.__module__ = mod.__name__
+    mod.warm = warm
+    sys.modules[mod.__name__] = mod
+    try:
+        # session 1 watches an UNRELATED namespace: every call into
+        # mod.warm returns DISABLE for its code object
+        other = types.ModuleType("ac_other_mod")
+        sys.modules[other.__name__] = other
+        ac1 = AutoCapture(other, threshold=1)
+        ac1.start()
+        for _ in range(3):
+            mod.warm(1)
+        ac1.stop()
+        # session 2 watches mod: without restart_events it would never
+        # receive PY_START for mod.warm
+        ac2 = AutoCapture(mod, threshold=2)
+        ac2.start()
+        for _ in range(4):
+            mod.warm(1)
+        rep = ac2.report()       # before stop(unbind=True) clears it
+        ac2.stop(unbind=True)
+        assert "ac_probe_mod.warm" in rep["rebound"], rep
+    finally:
+        sys.modules.pop(mod.__name__, None)
+        sys.modules.pop("ac_other_mod", None)

@@ -390,3 +390,99 @@ def test_unhashable_callable_does_not_crash_capture():
     f = jit.to_static(ns["f"])
     np.testing.assert_allclose(f(_t([2.0])).numpy(), [6.0])
     np.testing.assert_allclose(f(_t([-2.0])).numpy(), [-2.0])
+
+
+# -- ADVICE r4 (medium): source-AVAILABLE functions with side effects
+# must not silently bake them at trace time in the AST tier — the
+# opcode pre-scan (_writes_surviving_state) routes them to the strict
+# bytecode tier, where mutations of surviving state replay every call.
+
+_COUNTER = {"calls-via-global-store": 0}
+_N_CALLS = 0
+
+
+def _counting_scale(x):
+    # STORE_GLOBAL: detectable by the pre-scan; this function HAS
+    # source (defined in this file), so round 4 would have traced it
+    # with plain jax.jit and run the increment exactly once.
+    global _N_CALLS
+    _N_CALLS = _N_CALLS + 1
+    return x * 2.0
+
+
+def test_source_available_global_store_replays_every_call():
+    global _N_CALLS
+    _N_CALLS = 0
+    f = jit.to_static(_counting_scale)
+    for i in range(3):
+        np.testing.assert_allclose(f(_t([1.0 + i])).numpy(),
+                                   [2.0 + 2 * i])
+    assert _N_CALLS == 3, (
+        f"side effect baked at trace time: ran {_N_CALLS}x for 3 calls")
+
+
+def test_effect_prescan_scope():
+    from paddle_tpu.jit.static_function import _writes_surviving_state
+
+    def pure(x):
+        y = x + 1
+        return y * 2
+
+    def attr_store(obj, x):
+        # attr/item stores are deliberately NOT flagged (targets are
+        # usually call-local; see _EFFECT_OPNAMES comment) — the
+        # MIGRATION.md guarantee is scoped to name rebinding
+        obj.v = x
+        return x
+
+    def own_cell(x):
+        # mutates its OWN cellvar through a nested def: the cell dies
+        # with the call — must NOT demote to the strict tier
+        n = 0
+
+        def inner():
+            nonlocal n
+            n += 1
+        inner()
+        return x
+
+    def make_counter():
+        n = 0
+
+        def bump(x):
+            # STORE_DEREF to an INHERITED cell (co_freevars): the cell
+            # outlives bump's call — must be flagged
+            nonlocal n
+            n += 1
+            return x
+        return bump
+
+    def captures_local(x):
+        h = x + 1          # STORE_DEREF (own cellvar, captured below)
+        return (lambda: h)()
+
+    assert not _writes_surviving_state(pure)
+    assert not _writes_surviving_state(attr_store)
+    assert not _writes_surviving_state(own_cell)
+    assert not _writes_surviving_state(captures_local)
+    assert _writes_surviving_state(_counting_scale)
+    assert _writes_surviving_state(make_counter())
+
+
+def test_incrementing_global_reads_fresh_value_each_call():
+    """The segment guard must re-read a changed global: G = G + 1
+    three times ends at G0+3, not G0+1 re-stored (stale-read bake)."""
+    import paddle_tpu.jit.static_function as sfm
+    ns = {}
+    exec(textwrap.dedent("""
+        G = 5
+        def f(x):
+            global G
+            G = G + 1
+            return x * G
+    """), ns)
+    f = jit.to_static(ns["f"])
+    for _ in range(3):
+        out = f(_t([1.0]))
+    assert ns["G"] == 8, f"stale global read: G={ns['G']} (want 8)"
+    np.testing.assert_allclose(out.numpy(), [8.0])
