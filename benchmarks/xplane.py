@@ -1,0 +1,149 @@
+"""Minimal XPlane (jax.profiler) parser: per-op device-time totals.
+
+jax.profiler.start_trace writes ``plugins/profile/<ts>/*.xplane.pb``
+(tensorflow XSpace proto). This decodes just enough of the schema —
+planes → lines → events with per-plane event-metadata tables — to
+produce the step-decomposition ledgers in RESULTS.md without any
+tensorflow/tensorboard dependency. Wire format details follow
+tsl/profiler/protobuf/xplane.proto; decoding is the same
+varint/length-delimited walk as paddle_tpu/onnx/proto.py:read_fields.
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import os
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+
+def _read_varint(b: bytes, i: int) -> Tuple[int, int]:
+    out = 0
+    shift = 0
+    while True:
+        byte = b[i]
+        i += 1
+        out |= (byte & 0x7F) << shift
+        if not byte & 0x80:
+            return out, i
+        shift += 7
+
+
+def fields(b: bytes):
+    """Yield (field_no, wire_type, value) — value is int for varint,
+    bytes for length-delimited; fixed32/64 returned as raw ints."""
+    i = 0
+    n = len(b)
+    while i < n:
+        tag, i = _read_varint(b, i)
+        fno, wt = tag >> 3, tag & 7
+        if wt == 0:
+            v, i = _read_varint(b, i)
+        elif wt == 2:
+            ln, i = _read_varint(b, i)
+            v = b[i:i + ln]
+            i += ln
+        elif wt == 5:
+            v = int.from_bytes(b[i:i + 4], "little")
+            i += 4
+        elif wt == 1:
+            v = int.from_bytes(b[i:i + 8], "little")
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wt}")
+        yield fno, wt, v
+
+
+def _decode_plane(pb: bytes):
+    name = ""
+    lines = []
+    meta: Dict[int, str] = {}
+    for fno, _, v in fields(pb):
+        if fno == 2:
+            name = v.decode(errors="replace")
+        elif fno == 3:
+            lines.append(v)
+        elif fno == 4:  # map<int64, XEventMetadata>
+            k = m_name = None
+            for f2, _, v2 in fields(v):
+                if f2 == 1:
+                    k = v2
+                elif f2 == 2:
+                    for f3, _, v3 in fields(v2):
+                        if f3 == 2:
+                            m_name = v3.decode(errors="replace")
+                        elif f3 == 3 and not m_name:
+                            m_name = v3.decode(errors="replace")
+            if k is not None and m_name:
+                meta[k] = m_name
+    return name, lines, meta
+
+
+def _line_events(line_pb: bytes):
+    """Yield (metadata_id, duration_ps) per event on the line."""
+    for fno, _, v in fields(line_pb):
+        if fno == 4:  # XEvent
+            mid = dur = 0
+            for f2, wt2, v2 in fields(v):
+                if f2 == 1:
+                    mid = v2
+                elif f2 == 3:
+                    dur = v2
+            yield mid, dur
+
+
+def op_times(xplane_path: str,
+             plane_filter: str = "TPU") -> Dict[str, float]:
+    """op/fusion name -> total device ms across matching planes."""
+    raw = open(xplane_path, "rb").read()
+    if xplane_path.endswith(".gz"):
+        raw = gzip.decompress(raw)
+    totals: Dict[str, float] = defaultdict(float)
+    for fno, _, v in fields(raw):
+        if fno != 1:       # XSpace.planes
+            continue
+        name, lines, meta = _decode_plane(v)
+        if plane_filter not in name:
+            continue
+        for line_pb in lines:
+            for mid, dur in _line_events(line_pb):
+                totals[meta.get(mid, f"#{mid}")] += dur / 1e9  # ps->ms
+    return dict(totals)
+
+
+def latest_xplane(logdir: str) -> str:
+    paths = sorted(glob.glob(os.path.join(
+        logdir, "plugins", "profile", "*", "*.xplane.pb")))
+    if not paths:
+        raise FileNotFoundError(f"no xplane.pb under {logdir}")
+    return paths[-1]
+
+
+_BUCKETS = [
+    ("flash-fwd", lambda n: "fa_fwd" in n or "_fa_fwd" in n),
+    ("flash-bwd", lambda n: "fa_bwd" in n or "_fa_bwd" in n),
+    ("pallas-other", lambda n: "custom-call" in n or "tpu_custom_call"
+        in n or "pallas" in n),
+    ("matmul", lambda n: "dot" in n or "gemm" in n or "convolution"
+        in n),
+    ("copy/transpose", lambda n: "copy" in n or "transpose" in n
+        or "bitcast" in n),
+    ("allreduce/collective", lambda n: "all-reduce" in n or
+        "all-gather" in n or "reduce-scatter" in n or "collective" in n),
+    ("rng", lambda n: "rng" in n),
+    ("fusion-other", lambda n: "fusion" in n),
+]
+
+
+def bucketize(totals: Dict[str, float]) -> List[Tuple[str, float]]:
+    """Collapse per-op totals into readable buckets (ms)."""
+    out: Dict[str, float] = defaultdict(float)
+    for name, ms in totals.items():
+        low = name.lower()
+        for bucket, pred in _BUCKETS:
+            if pred(low):
+                out[bucket] += ms
+                break
+        else:
+            out["other"] += ms
+    return sorted(out.items(), key=lambda kv: -kv[1])

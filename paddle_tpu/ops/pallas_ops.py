@@ -28,14 +28,23 @@ NEG_INF = -1e30
 _LANES = 128  # VPU lane width: scalar-per-row carries live as [bq, 128]
 
 
-def _choose_block(seq_len: int, target: int = 512,
+def _choose_block(seq_len: int, target: int = 0,
                   which: str = "") -> int:
     """Block size for one kernel axis. Env overrides, most specific
     wins: PTPU_FLASH_BWD_BQ/_BWD_BK beat PTPU_FLASH_BQ/_BK beat the
     all-four fallback PTPU_FLASH_BLOCK — the fwd and bwd kernels have
     different reuse patterns, so their optima differ (the step-level
-    sweep lives in benchmarks/)."""
+    sweep lives in benchmarks/).
+
+    Default (round-5 step-level sweep, RESULTS.md): whole-sequence
+    blocks up to 1024 — at S=1024 fwd+bwd all-1024 measures 348 ms/step
+    vs 373 at the old 512 default (fewer grid steps, no online-softmax
+    carry rescaling, and the PV matmul's contraction grows to S). Past
+    1024 the S² fp32 score block would pressure VMEM; 512 stays the
+    default there (the r4 S=2048 sweep: 512 beat 256/1024)."""
     import os
+    if target <= 0:
+        target = seq_len if seq_len <= 1024 else 512
     names = {"fwd_q": ("PTPU_FLASH_BQ",),
              "fwd_k": ("PTPU_FLASH_BK",),
              "bwd_q": ("PTPU_FLASH_BWD_BQ", "PTPU_FLASH_BQ"),
