@@ -1,10 +1,14 @@
 """paddle_tpu.audio (reference: /root/reference/python/paddle/audio/
-__init__.py — features, functional; backends/datasets are IO-bound and
-delegated to paddle_tpu.io datasets)."""
+__init__.py — functional, features, backends (PCM16 wave I/O with
+swappable backends), datasets (ESC50/TESS))."""
 from . import functional  # noqa: F401
 from . import features  # noqa: F401
+from . import backends  # noqa: F401
+from . import datasets  # noqa: F401
+from .backends.backend import info, load, save  # noqa: F401
 from .features import (  # noqa: F401
     MFCC, LogMelSpectrogram, MelSpectrogram, Spectrogram)
 
-__all__ = ["functional", "features", "Spectrogram", "MelSpectrogram",
-           "LogMelSpectrogram", "MFCC"]
+__all__ = ["functional", "features", "backends", "datasets",
+           "info", "load", "save",
+           "Spectrogram", "MelSpectrogram", "LogMelSpectrogram", "MFCC"]

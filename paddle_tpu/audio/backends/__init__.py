@@ -1,0 +1,12 @@
+"""paddle.audio.backends (reference: python/paddle/audio/backends/
+__init__.py)."""
+from . import backend, wave_backend  # noqa: F401
+from .backend import AudioInfo  # noqa: F401
+from .init_backend import (  # noqa: F401
+    _init_set_audio_backend, get_current_backend,
+    list_available_backends, register_backend, set_backend)
+
+_init_set_audio_backend()
+
+__all__ = ["AudioInfo", "get_current_backend", "list_available_backends",
+           "register_backend", "set_backend", "wave_backend"]

@@ -1,0 +1,34 @@
+"""Dispatch surface for the active audio backend.
+
+Reference: python/paddle/audio/backends/backend.py — AudioInfo plus
+module-level info/load/save that init_backend.py rebinds when the
+backend changes. Same shape here: ``set_backend`` swaps these three
+attributes (and paddle.audio's copies) in place.
+"""
+from __future__ import annotations
+
+
+class AudioInfo:
+    """Audio info, return type of the backend ``info`` function."""
+
+    def __init__(self, sample_rate: int, num_samples: int,
+                 num_channels: int, bits_per_sample: int,
+                 encoding: str) -> None:
+        self.sample_rate = sample_rate
+        self.num_samples = num_samples
+        self.num_channels = num_channels
+        self.bits_per_sample = bits_per_sample
+        self.encoding = encoding
+
+    def __repr__(self):
+        return (f"AudioInfo(sample_rate={self.sample_rate}, "
+                f"num_samples={self.num_samples}, "
+                f"num_channels={self.num_channels}, "
+                f"bits_per_sample={self.bits_per_sample}, "
+                f"encoding={self.encoding!r})")
+
+
+# rebound by init_backend._init_set_audio_backend / set_backend
+info = None
+load = None
+save = None

@@ -1,0 +1,58 @@
+"""Backend selection: list/get/set, with a registration hook.
+
+Reference: python/paddle/audio/backends/init_backend.py — the reference
+discovers extra backends by importing the ``paddleaudio`` wheel; here
+third-party backends register explicitly via ``register_backend`` (a
+module or object exposing info/load/save), which is the same
+set_backend-swaps-the-functions mechanism without the import-time
+probing.
+"""
+from __future__ import annotations
+
+from typing import List
+
+from . import backend, wave_backend
+
+_BACKENDS = {"wave_backend": wave_backend}
+_current = "wave_backend"
+
+
+def register_backend(name: str, module) -> None:
+    """Make ``module`` (exposing info/load/save) selectable via
+    :func:`set_backend`."""
+    for func in ("info", "load", "save"):
+        if not callable(getattr(module, func, None)):
+            raise TypeError(f"backend {name!r} lacks callable {func}()")
+    _BACKENDS[name] = module
+
+
+def list_available_backends() -> List[str]:
+    """Names accepted by :func:`set_backend` (always includes the
+    built-in ``wave_backend``)."""
+    return sorted(_BACKENDS)
+
+
+def get_current_backend() -> str:
+    """Name of the backend currently serving paddle.audio.load/save/
+    info."""
+    return _current
+
+
+def set_backend(backend_name: str) -> None:
+    """Route paddle.audio.{info,load,save} through the named backend."""
+    global _current
+    if backend_name not in _BACKENDS:
+        raise NotImplementedError(
+            f"unknown audio backend {backend_name!r}; available: "
+            f"{list_available_backends()} (register_backend to add)")
+    module = _BACKENDS[backend_name]
+    import paddle_tpu.audio as _audio
+    for func in ("save", "load", "info"):
+        setattr(backend, func, getattr(module, func))
+        setattr(_audio, func, getattr(module, func))
+    _current = backend_name
+
+
+def _init_set_audio_backend() -> None:
+    for func in ("save", "load", "info"):
+        setattr(backend, func, getattr(wave_backend, func))

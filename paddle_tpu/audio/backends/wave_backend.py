@@ -1,0 +1,105 @@
+"""PCM16 WAV I/O via the stdlib ``wave`` module.
+
+Reference: python/paddle/audio/backends/wave_backend.py — info/load/save
+restricted to PCM16 WAV, with the same shapes, dtypes, normalize and
+channels_first semantics. TPU-native note: audio files decode on the
+HOST (numpy); tensors land on device only when the caller moves them —
+the dataloader's device path stays the single host→HBM hop.
+"""
+from __future__ import annotations
+
+import wave
+from typing import BinaryIO, Optional, Tuple, Union
+
+import numpy as np
+
+from .backend import AudioInfo
+
+
+def _error_message() -> str:
+    return ("only PCM16 WAV supported by the built-in wave_backend; "
+            "register a richer backend via "
+            "paddle.audio.backends.register_backend(name, module) and "
+            "select it with set_backend(name)")
+
+
+def _open(filepath):
+    """(wave.Wave_read, owned_file_obj_or_None) for a path or file."""
+    file_obj = filepath if hasattr(filepath, "read") else \
+        open(filepath, "rb")
+    try:
+        return wave.open(file_obj), file_obj
+    except (wave.Error, EOFError):
+        try:
+            file_obj.seek(0)
+        finally:
+            file_obj.close()
+        raise NotImplementedError(_error_message()) from None
+
+
+def info(filepath: Union[str, BinaryIO]) -> AudioInfo:
+    """Signal information of an audio file (PCM16 WAV)."""
+    f, file_obj = _open(filepath)
+    try:
+        return AudioInfo(sample_rate=f.getframerate(),
+                         num_samples=f.getnframes(),
+                         num_channels=f.getnchannels(),
+                         bits_per_sample=f.getsampwidth() * 8,
+                         encoding="PCM_S")
+    finally:
+        file_obj.close()
+
+
+def load(filepath, frame_offset: int = 0, num_frames: int = -1,
+         normalize: bool = True,
+         channels_first: bool = True) -> Tuple["object", int]:
+    """Load audio as (Tensor, sample_rate).
+
+    normalize=True → float32 in (-1, 1); False → raw int16 values (as
+    float32, matching the reference). channels_first=True → [C, T].
+    """
+    from ...framework.tensor import Tensor
+    import jax.numpy as jnp
+
+    f, file_obj = _open(filepath)
+    try:
+        channels = f.getnchannels()
+        sample_rate = f.getframerate()
+        frames = f.getnframes()
+        if f.getsampwidth() != 2:
+            raise NotImplementedError(_error_message())
+        raw = f.readframes(frames)
+    finally:
+        file_obj.close()
+    audio = np.frombuffer(raw, dtype=np.int16).astype(np.float32)
+    if normalize:
+        audio = audio / float(2 ** 15)
+    waveform = audio.reshape(frames, channels)
+    if num_frames != -1:
+        waveform = waveform[frame_offset:frame_offset + num_frames, :]
+    elif frame_offset:
+        waveform = waveform[frame_offset:, :]
+    if channels_first:
+        waveform = waveform.T
+    return Tensor(jnp.asarray(waveform)), sample_rate
+
+
+def save(filepath: str, src, sample_rate: int,
+         channels_first: bool = True,
+         encoding: Optional[str] = None,
+         bits_per_sample: Optional[int] = 16) -> None:
+    """Save a 2D audio tensor as PCM16 WAV."""
+    arr = np.asarray(getattr(src, "_data", src))
+    if arr.ndim != 2:
+        raise AssertionError("Expected 2D tensor")
+    if bits_per_sample not in (None, 16):
+        raise ValueError("Invalid bits_per_sample, only support 16 bit")
+    if channels_first:
+        arr = arr.T          # -> (time, channels)
+    if arr.dtype != np.int16:
+        arr = (arr.astype(np.float32) * (2 ** 15)).astype("<h")
+    with wave.open(filepath, "w") as f:
+        f.setnchannels(arr.shape[1])
+        f.setsampwidth(2)
+        f.setframerate(sample_rate)
+        f.writeframes(np.ascontiguousarray(arr).tobytes())

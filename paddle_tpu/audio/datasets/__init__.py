@@ -1,0 +1,211 @@
+"""paddle.audio.datasets: ESC50 / TESS audio-classification datasets.
+
+Reference: python/paddle/audio/datasets/{dataset.py,esc50.py,tess.py} —
+AudioClassificationDataset loads each wav through paddle.audio.load and
+optionally extracts a feature (melspectrogram/mfcc/...), ESC50 splits
+by the meta csv's fold column, TESS round-robins files into n_folds.
+Same archives, URLs, md5s, label lists and split semantics here; the
+download rides utils/download.get_path_from_url (file:// URLs work for
+air-gapped clusters, ``archive=`` overrides the source).
+"""
+from __future__ import annotations
+
+import os
+from collections import namedtuple
+from typing import Dict, List, Optional, Tuple
+
+from ...io.dataset import Dataset
+from ...utils.download import DATA_HOME, get_path_from_url
+from ..features import MFCC, LogMelSpectrogram, MelSpectrogram, \
+    Spectrogram
+
+__all__ = ["AudioClassificationDataset", "ESC50", "TESS"]
+
+feat_funcs = {
+    "raw": None,
+    "melspectrogram": MelSpectrogram,
+    "mfcc": MFCC,
+    "logmelspectrogram": LogMelSpectrogram,
+    "spectrogram": Spectrogram,
+}
+
+
+class AudioClassificationDataset(Dataset):
+    """Base class: (waveform-or-feature, label) records over wav files
+    (reference dataset.py AudioClassificationDataset)."""
+
+    def __init__(self, files: List[str], labels: List[int],
+                 feat_type: str = "raw",
+                 sample_rate: Optional[int] = None, **kwargs):
+        super().__init__()
+        if feat_type not in feat_funcs:
+            raise RuntimeError(
+                f"Unknown feat_type: {feat_type}, it must be one in "
+                f"{list(feat_funcs.keys())}")
+        self.files = files
+        self.labels = labels
+        self.feat_type = feat_type
+        self.sample_rate = sample_rate
+        self.feat_config = kwargs
+        self._feat = None        # built once: depends only on sr+config
+
+    def _convert_to_record(self, idx: int):
+        import paddle_tpu.audio as audio
+
+        file, label = self.files[idx], self.labels[idx]
+        waveform, sample_rate = audio.load(file)
+        self.sample_rate = sample_rate
+        feat_cls = feat_funcs[self.feat_type]
+        if waveform.ndim == 2:
+            waveform = waveform.squeeze(0)  # mono: [T]
+        if feat_cls is not None:
+            if self._feat is None:
+                # mel filterbank/window construction amortizes across
+                # the epoch (same sr for a whole corpus)
+                self._feat = feat_cls(sr=sample_rate,
+                                      **self.feat_config)
+            # [1, T] -> [1, n_feat, frames] -> [n_feat, frames]
+            waveform = self._feat(waveform.unsqueeze(0)).squeeze(0)
+        return waveform, label
+
+    def __getitem__(self, idx: int):
+        return self._convert_to_record(idx)
+
+    def __len__(self) -> int:
+        return len(self.files)
+
+
+class ESC50(AudioClassificationDataset):
+    """ESC-50: 2000 environmental recordings, 50 classes, 5 folds
+    (reference esc50.py; split semantics: ``mode='train'`` takes folds
+    != split, ``'dev'`` takes fold == split)."""
+
+    archive: Dict[str, str] = {
+        "url": "https://paddleaudio.bj.bcebos.com/datasets/"
+               "ESC-50-master.zip",
+        "md5": "7771e4b9d86d0945acce719c7a59305a",
+    }
+    label_list: List[str] = [
+        # Animals
+        "Dog", "Rooster", "Pig", "Cow", "Frog", "Cat", "Hen",
+        "Insects (flying)", "Sheep", "Crow",
+        # Natural soundscapes & water sounds
+        "Rain", "Sea waves", "Crackling fire", "Crickets",
+        "Chirping birds", "Water drops", "Wind", "Pouring water",
+        "Toilet flush", "Thunderstorm",
+        # Human, non-speech sounds
+        "Crying baby", "Sneezing", "Clapping", "Breathing", "Coughing",
+        "Footsteps", "Laughing", "Brushing teeth", "Snoring",
+        "Drinking - sipping",
+        # Interior/domestic sounds
+        "Door knock", "Mouse click", "Keyboard typing",
+        "Door - wood creaks", "Can opening", "Washing machine",
+        "Vacuum cleaner", "Clock alarm", "Clock tick", "Glass breaking",
+        # Exterior/urban noises
+        "Helicopter", "Chainsaw", "Siren", "Car horn", "Engine",
+        "Train", "Church bells", "Airplane", "Fireworks", "Hand saw",
+    ]
+    meta: str = os.path.join("ESC-50-master", "meta", "esc50.csv")
+    audio_path: str = os.path.join("ESC-50-master", "audio")
+    meta_info = namedtuple(
+        "meta_info",
+        ("filename", "fold", "target", "category", "esc10", "src_file",
+         "take"))
+
+    def __init__(self, mode: str = "train", split: int = 1,
+                 feat_type: str = "raw",
+                 archive: Optional[Dict[str, str]] = None, **kwargs):
+        assert split in range(1, 6), (
+            f"The selected split should be integer, and 1 <= split <= "
+            f"5, but got {split}")
+        if archive is not None:
+            self.archive = archive
+        files, labels = self._get_data(mode, split)
+        super().__init__(files=files, labels=labels, feat_type=feat_type,
+                         **kwargs)
+
+    def _get_meta_info(self):
+        ret = []
+        with open(os.path.join(DATA_HOME, self.meta)) as rf:
+            for line in rf.readlines()[1:]:
+                ret.append(self.meta_info(*line.strip().split(",")))
+        return ret
+
+    def _get_data(self, mode: str,
+                  split: int) -> Tuple[List[str], List[int]]:
+        if not os.path.isdir(os.path.join(DATA_HOME, self.audio_path)) \
+                or not os.path.isfile(os.path.join(DATA_HOME, self.meta)):
+            get_path_from_url(self.archive["url"], DATA_HOME,
+                              self.archive["md5"], decompress=True)
+        meta_info = self._get_meta_info()
+        files, labels = [], []
+        for sample in meta_info:
+            filename, fold, target = sample[0], sample[1], sample[2]
+            if (mode == "train" and int(fold) != split) or \
+                    (mode != "train" and int(fold) == split):
+                files.append(os.path.join(DATA_HOME, self.audio_path,
+                                          filename))
+                labels.append(int(target))
+        return files, labels
+
+
+class TESS(AudioClassificationDataset):
+    """TESS: 2800 emotional speech recordings, 7 classes (reference
+    tess.py; files round-robin into ``n_folds``, ``'train'`` takes
+    folds != split, ``'dev'`` takes fold == split)."""
+
+    archive: Dict[str, str] = {
+        "url": "https://bj.bcebos.com/paddleaudio/datasets/"
+               "TESS_Toronto_emotional_speech_set.zip",
+        "md5": "1465311b24d1de704c4c63e4ccc470c7",
+    }
+    label_list: List[str] = [
+        "angry", "disgust", "fear", "happy", "neutral",
+        "ps",  # pleasant surprise
+        "sad",
+    ]
+    audio_path: str = "TESS_Toronto_emotional_speech_set"
+    meta_info = namedtuple("meta_info", ("speaker", "word", "emotion"))
+
+    def __init__(self, mode: str = "train", n_folds: int = 5,
+                 split: int = 1, feat_type: str = "raw",
+                 archive: Optional[Dict[str, str]] = None, **kwargs):
+        assert isinstance(n_folds, int) and n_folds >= 1, (
+            f"the n_folds should be integer and n_folds >= 1, but got "
+            f"{n_folds}")
+        assert split in range(1, n_folds + 1), (
+            f"The selected split should be integer and should be "
+            f"1 <= split <= {n_folds}, but got {split}")
+        if archive is not None:
+            self.archive = archive
+        files, labels = self._get_data(mode, n_folds, split)
+        super().__init__(files=files, labels=labels, feat_type=feat_type,
+                         **kwargs)
+
+    def _get_meta_info(self, files):
+        ret = []
+        for file in files:
+            base = os.path.basename(file)[:-4]
+            ret.append(self.meta_info(*base.split("_")))
+        return ret
+
+    def _get_data(self, mode: str, n_folds: int,
+                  split: int) -> Tuple[List[str], List[int]]:
+        if not os.path.isdir(os.path.join(DATA_HOME, self.audio_path)):
+            get_path_from_url(self.archive["url"], DATA_HOME,
+                              self.archive["md5"], decompress=True)
+        wav_files = []
+        for root, _, fnames in os.walk(
+                os.path.join(DATA_HOME, self.audio_path)):
+            for fname in sorted(fnames):
+                if fname.endswith(".wav"):
+                    wav_files.append(os.path.join(root, fname))
+        files, labels = [], []
+        for idx, sample in enumerate(self._get_meta_info(wav_files)):
+            target = self.label_list.index(sample.emotion)
+            fold = idx % n_folds + 1
+            if (mode == "train" and fold != split) or \
+                    (mode != "train" and fold == split):
+                files.append(wav_files[idx])
+                labels.append(target)
+        return files, labels

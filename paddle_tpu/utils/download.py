@@ -19,13 +19,14 @@ import urllib.parse
 import urllib.request
 
 __all__ = ["get_weights_path_from_url", "get_path_from_url",
-           "WEIGHTS_HOME"]
+           "WEIGHTS_HOME", "DATA_HOME"]
 
-WEIGHTS_HOME = os.path.join(
-    os.environ.get("PADDLE_TPU_HOME",
-                   os.path.join(os.path.expanduser("~"), ".cache",
-                                "paddle_tpu")),
-    "weights")
+_CACHE_ROOT = os.environ.get(
+    "PADDLE_TPU_HOME",
+    os.path.join(os.path.expanduser("~"), ".cache", "paddle_tpu"))
+WEIGHTS_HOME = os.path.join(_CACHE_ROOT, "weights")
+# dataset archives (reference: paddle.dataset.common.DATA_HOME)
+DATA_HOME = os.path.join(_CACHE_ROOT, "datasets")
 
 DOWNLOAD_RETRY_LIMIT = 3
 
@@ -52,9 +53,12 @@ def _fetch(url: str, dst: str):
 
 
 def get_path_from_url(url: str, root_dir: str, md5sum: str = None,
-                      check_exist: bool = True) -> str:
+                      check_exist: bool = True,
+                      decompress: bool = False) -> str:
     """Download ``url`` into ``root_dir`` (cached by filename), verify
-    md5 when given, and return the local path."""
+    md5 when given, and return the local path. ``decompress=True``
+    additionally extracts zip/tar archives into ``root_dir`` (reference
+    download.py decompress flag used by the dataset loaders)."""
     os.makedirs(root_dir, exist_ok=True)
     fname = os.path.basename(urllib.parse.urlparse(url).path) or "weights"
     # cache key includes a hash of the full URL: two different URLs with
@@ -63,6 +67,8 @@ def get_path_from_url(url: str, root_dir: str, md5sum: str = None,
     dst = os.path.join(root_dir, f"{tag}_{fname}")
     if check_exist and os.path.exists(dst) and (
             md5sum is None or _md5(dst) == md5sum):
+        if decompress:
+            _decompress(dst, root_dir)
         return dst
     last_err = None
     for _ in range(DOWNLOAD_RETRY_LIMIT):
@@ -72,6 +78,8 @@ def get_path_from_url(url: str, root_dir: str, md5sum: str = None,
             last_err = e
             continue
         if md5sum is None or _md5(dst) == md5sum:
+            if decompress:
+                _decompress(dst, root_dir)
             return dst
         last_err = ValueError(
             f"md5 mismatch for {url}: got {_md5(dst)}, want {md5sum}")
@@ -79,6 +87,29 @@ def get_path_from_url(url: str, root_dir: str, md5sum: str = None,
     raise RuntimeError(
         f"failed to fetch {url} after {DOWNLOAD_RETRY_LIMIT} attempts: "
         f"{last_err}")
+
+
+def _decompress(path: str, root_dir: str) -> None:
+    """Extract a zip/tar archive next to its cache entry (idempotent:
+    a marker file records the extracted archive's md5, so a
+    re-downloaded/refreshed archive re-extracts instead of silently
+    serving the stale tree)."""
+    marker = path + ".extracted"
+    cur = _md5(path)
+    if os.path.exists(marker) and open(marker).read().strip() == cur:
+        return
+    import tarfile
+    import zipfile
+    if zipfile.is_zipfile(path):
+        with zipfile.ZipFile(path) as z:
+            z.extractall(root_dir)
+    elif tarfile.is_tarfile(path):
+        with tarfile.open(path) as t:
+            t.extractall(root_dir, filter="data")
+    else:
+        raise ValueError(f"not a zip/tar archive: {path}")
+    with open(marker, "w") as f:
+        f.write(cur)
 
 
 def get_weights_path_from_url(url: str, md5sum: str = None) -> str:

@@ -1,0 +1,169 @@
+"""paddle.audio.backends (PCM16 wave I/O, backend registry) and
+paddle.audio.datasets (ESC50/TESS) — reference:
+python/paddle/audio/backends/wave_backend.py, datasets/esc50.py,
+tess.py. Archives are synthesized locally and served over file:// (the
+download cache's air-gap path), so no network is touched."""
+import hashlib
+import os
+import struct
+import wave
+import zipfile
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import audio
+
+
+def _write_wav(path, sr=16000, n=800, channels=1, freq=440.0):
+    t = np.arange(n) / sr
+    sig = (0.3 * np.sin(2 * np.pi * freq * t)).astype(np.float32)
+    data = (sig * (2 ** 15)).astype("<h")
+    if channels == 2:
+        data = np.stack([data, -data], 1).reshape(-1)
+    with wave.open(str(path), "w") as f:
+        f.setnchannels(channels)
+        f.setsampwidth(2)
+        f.setframerate(sr)
+        f.writeframes(data.tobytes())
+    return sig
+
+
+def test_save_load_info_roundtrip(tmp_path):
+    sr, n = 16000, 1000
+    wav = np.linspace(-0.5, 0.5, n, dtype=np.float32)[None]  # [1, T]
+    p = str(tmp_path / "t.wav")
+    audio.save(p, paddle.to_tensor(wav), sr)
+    meta = audio.info(p)
+    assert (meta.sample_rate, meta.num_samples, meta.num_channels,
+            meta.bits_per_sample, meta.encoding) == (sr, n, 1, 16,
+                                                     "PCM_S")
+    back, sr2 = audio.load(p)
+    assert sr2 == sr and tuple(back.shape) == (1, n)
+    np.testing.assert_allclose(np.asarray(back.numpy()), wav,
+                               atol=1 / (2 ** 15))
+    # un-normalized load returns raw int16 values
+    raw, _ = audio.load(p, normalize=False)
+    assert float(np.abs(np.asarray(raw.numpy())).max()) > 1.0
+
+
+def test_load_frame_offset_and_channels_last(tmp_path):
+    p = tmp_path / "c2.wav"
+    _write_wav(p, channels=2, n=600)
+    w, _ = audio.load(str(p), frame_offset=100, num_frames=200,
+                      channels_first=False)
+    assert tuple(w.shape) == (200, 2)
+    full, _ = audio.load(str(p))
+    assert tuple(full.shape) == (2, 600)
+    np.testing.assert_allclose(np.asarray(w.numpy()),
+                               np.asarray(full.numpy()).T[100:300],
+                               atol=1e-6)
+
+
+def test_info_rejects_non_wav(tmp_path):
+    p = tmp_path / "x.mp3"
+    p.write_bytes(b"ID3\x04\x00garbage")
+    with pytest.raises(NotImplementedError, match="PCM16"):
+        audio.info(str(p))
+
+
+def test_backend_registry_and_switch(tmp_path):
+    assert audio.backends.list_available_backends() == ["wave_backend"]
+    assert audio.backends.get_current_backend() == "wave_backend"
+    with pytest.raises(NotImplementedError):
+        audio.backends.set_backend("soundfile")
+
+    class FakeBackend:
+        def info(self, *a, **k):
+            return "fake-info"
+
+        def load(self, *a, **k):
+            return "fake-load"
+
+        def save(self, *a, **k):
+            return "fake-save"
+
+    audio.backends.register_backend("fake", FakeBackend())
+    try:
+        audio.backends.set_backend("fake")
+        assert audio.info("whatever") == "fake-info"
+        assert audio.backends.get_current_backend() == "fake"
+    finally:
+        audio.backends.set_backend("wave_backend")
+    p = str(tmp_path / "ok.wav")
+    _write_wav(p)
+    assert audio.info(p).num_channels == 1  # real backend restored
+
+
+def _md5(path):
+    return hashlib.md5(open(path, "rb").read()).hexdigest()
+
+
+@pytest.fixture
+def esc50_env(tmp_path, monkeypatch):
+    """Synthetic 10-file ESC-50 archive served over file://."""
+    from paddle_tpu.audio import datasets as adm
+    home = tmp_path / "home"
+    monkeypatch.setattr(adm, "DATA_HOME", str(home))
+    src = tmp_path / "src"
+    (src / "ESC-50-master" / "audio").mkdir(parents=True)
+    (src / "ESC-50-master" / "meta").mkdir(parents=True)
+    rows = ["filename,fold,target,category,esc10,src_file,take"]
+    for i in range(10):
+        fold = i % 5 + 1
+        name = f"{fold}-{100 + i}-A-{i % 3}.wav"
+        _write_wav(src / "ESC-50-master" / "audio" / name, n=400,
+                   freq=200.0 + 40 * i)
+        rows.append(f"{name},{fold},{i % 3},cat{i % 3},False,src,A")
+    (src / "ESC-50-master" / "meta" / "esc50.csv").write_text(
+        "\n".join(rows) + "\n")
+    zpath = tmp_path / "ESC-50-master.zip"
+    with zipfile.ZipFile(zpath, "w") as z:
+        for root, _, files in os.walk(src):
+            for f in files:
+                full = os.path.join(root, f)
+                z.write(full, os.path.relpath(full, src))
+    archive = {"url": f"file://{zpath}", "md5": _md5(zpath)}
+    return archive
+
+
+def test_esc50_split_semantics_and_features(esc50_env):
+    train = audio.datasets.ESC50(mode="train", split=1,
+                                 archive=esc50_env)
+    dev = audio.datasets.ESC50(mode="dev", split=1, archive=esc50_env)
+    assert len(train) == 8 and len(dev) == 2   # folds 2-5 / fold 1
+    wavf, label = train[0]
+    assert wavf.ndim == 1 and 0 <= label < 3
+    # feature extraction path: mfcc [n_mfcc, frames]
+    mf = audio.datasets.ESC50(mode="dev", split=1, archive=esc50_env,
+                              feat_type="mfcc", n_mfcc=13, n_fft=128)
+    feat, _ = mf[0]
+    assert feat.shape[0] == 13 and feat.ndim == 2
+
+
+def test_tess_round_robin_folds(tmp_path, monkeypatch):
+    from paddle_tpu.audio import datasets as adm
+    home = tmp_path / "home"
+    monkeypatch.setattr(adm, "DATA_HOME", str(home))
+    src = tmp_path / "src"
+    d = src / "TESS_Toronto_emotional_speech_set"
+    d.mkdir(parents=True)
+    emotions = ["angry", "happy", "sad", "fear", "neutral"]
+    for i, emo in enumerate(emotions * 2):   # 10 files
+        _write_wav(d / f"OAF_word{i}_{emo}.wav", n=300)
+    zpath = tmp_path / "TESS_Toronto_emotional_speech_set.zip"
+    with zipfile.ZipFile(zpath, "w") as z:
+        for root, _, files in os.walk(src):
+            for f in files:
+                full = os.path.join(root, f)
+                z.write(full, os.path.relpath(full, src))
+    archive = {"url": f"file://{zpath}", "md5": _md5(zpath)}
+    train = audio.datasets.TESS(mode="train", n_folds=5, split=2,
+                                archive=archive)
+    dev = audio.datasets.TESS(mode="dev", n_folds=5, split=2,
+                              archive=archive)
+    assert len(train) == 8 and len(dev) == 2
+    w, label = dev[0]
+    assert w.ndim == 1
+    assert 0 <= label < len(audio.datasets.TESS.label_list)

@@ -36,6 +36,8 @@ NAMESPACES = [
     ("jit/__init__.py", "jit"),
     ("metric/__init__.py", "metric"),
     ("audio/__init__.py", "audio"),
+    ("audio/backends/__init__.py", "audio.backends"),
+    ("audio/datasets/__init__.py", "audio.datasets"),
     ("profiler/__init__.py", "profiler"),
     ("framework/__init__.py", "framework"),
 ]
