@@ -176,8 +176,11 @@ class ElasticManager:
                         except Exception:
                             continue
                 finally:
-                    # a dead thread must not block a re-announce
-                    self._announcers.pop(rank, None)
+                    # a dead thread must not block a re-announce — but
+                    # only remove OUR entry: a successor registered
+                    # after stop_announce() must stay stoppable
+                    if self._announcers.get(rank, (None,))[0] is stop:
+                        self._announcers.pop(rank, None)
             t = threading.Thread(target=_refresh, daemon=True,
                                  name=f"elastic-join-{rank}")
             t.start()

@@ -9,11 +9,15 @@ TPU-first notes:
   the reference's csr softmax kernel
   (paddle/phi/kernels/sparse/gpu/softmax_kernel.cu) done with XLA segment
   ops.
-- Sparse convs lower to dense XLA conv on ``to_dense()`` then re-mask:
-  on TPU the MXU makes the dense conv the *fast* path for the
-  point-cloud densities these layers target; gather-based sparse conv
-  would serialize. SubmConv re-masks to the input pattern, matching
-  submanifold semantics.
+- SubmConv2D/3D (stride 1, groups 1 — the LiDAR/point-cloud hot path)
+  run a REAL sparse conv: host-built rulebook + device gather/GEMM/
+  scatter (sparse/rulebook.py; reference conv_kernel.cu + conv.cu.h).
+  Compute scales with nnz, not voxel volume.
+- Strided/grouped sparse convs lower to dense XLA conv on
+  ``to_dense()`` then re-sparsify: with stride the output support is
+  the kernel-reachable set (data-dependent size — a host round trip
+  anyway), and the MXU makes dense conv competitive at moderate
+  densities.
 """
 from __future__ import annotations
 
@@ -108,8 +112,65 @@ class SyncBatchNorm(BatchNorm):
     the sharded batch axis) so Sync==local BatchNorm by construction."""
 
 
+def _subm_conv_rulebook(x: SparseCooTensor, weight, bias, padding,
+                        dilation, dims):
+    """Real sparse submanifold conv: host-built rulebook + device
+    gather/GEMM/scatter (reference conv_kernel.cu). Compute scales with
+    nnz, not voxel volume — see sparse/rulebook.py. Caller
+    (_dense_conv_nd) guarantees per-dim int padding/dilation."""
+    import numpy as np
+    from ..nn.layer.conv import _ntuple
+    from .rulebook import apply_rulebook, build_subm_rulebook
+
+    coo = x.coalesce() if not x._coalesced else x
+    spatial = tuple(coo._shape[1:1 + dims])
+    ks = tuple(weight.shape[2:2 + dims])
+    dil = _ntuple(dilation, dims)
+    pad = _ntuple(padding, dims)
+    idx_np = np.asarray(coo._indices)
+    in_idx, out_idx, _ = build_subm_rulebook(idx_np, spatial, ks, dil,
+                                             pad)
+    nnz = idx_np.shape[1]
+
+    def f(vals, w, *maybe_bias):
+        import jax.numpy as jnp
+        K = in_idx.shape[0]
+        # [Cout, Cin, *ks] -> [K, Cin, Cout]
+        wk = jnp.moveaxis(w.reshape(w.shape[0], w.shape[1], K),
+                          (0, 1, 2), (2, 1, 0))
+        out = apply_rulebook(vals, wk, in_idx, out_idx, nnz)
+        if maybe_bias:
+            out = out + maybe_bias[0]
+        return out.astype(vals.dtype)
+
+    args = (coo.values(), weight) + (() if bias is None else (bias,))
+    out_vals = apply_op(f, *args, _op_name="subm_conv_rulebook")
+    out_shape = tuple(coo._shape[:-1]) + (weight.shape[0],)
+    return SparseCooTensor(coo._indices, out_vals, out_shape,
+                           coalesced=True)
+
+
 def _dense_conv_nd(x: SparseCooTensor, weight, bias, stride, padding,
                    dilation, groups, dims, subm):
+    if subm and groups == 1:
+        from ..nn.layer.conv import _ntuple
+        strides = _ntuple(stride, dims)
+        pad_t = _ntuple(padding, dims)
+        dil_t = _ntuple(dilation, dims)
+
+        def _ints(t):
+            return len(t) == dims and all(
+                isinstance(v, (int,)) and not isinstance(v, bool)
+                for v in t)
+
+        if all(s == 1 for s in strides) and _ints(pad_t) \
+                and _ints(dil_t):
+            # stride-1 submanifold with plain per-dim int geometry: the
+            # rulebook path (output support == input support; padding
+            # only shifts the window). String/asymmetric paddings keep
+            # the dense lowering below, which resolves them.
+            return _subm_conv_rulebook(x, weight, bias, pad_t, dil_t,
+                                       dims)
     dense = x.to_dense()
     conv = F_dense.conv3d if dims == 3 else F_dense.conv2d
     fmt = "NDHWC" if dims == 3 else "NHWC"

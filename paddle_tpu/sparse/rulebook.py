@@ -1,0 +1,128 @@
+"""Rulebook sparse convolution: gather → per-offset GEMM → scatter-add.
+
+Reference: paddle/phi/kernels/sparse/gpu/conv_kernel.cu + conv.cu.h —
+the GPU path builds a "rulebook" of (kernel_offset, in_idx, out_idx)
+triples, then runs one gathered GEMM per kernel offset. Same
+decomposition here, split TPU-first:
+
+- Rulebook CONSTRUCTION is host-side numpy over the COO indices
+  (eager indices are concrete; XLA wants static shapes, and the
+  pair-counts are data-dependent). Buckets are padded to power-of-two
+  capacities so the device program recompiles O(log nnz) times, not
+  per batch.
+- Rulebook APPLICATION is one jitted program: for each kernel offset
+  k, ``out[out_k] += vals[in_k] @ W[k]`` — a dense [n_k, Cin]x[Cin,
+  Cout] MXU matmul per offset (K=27 for 3³ kernels), with sentinel
+  indices pointing at a zero pad row so padding contributes nothing.
+
+Compute scales with nnz (sum of bucket sizes ~ nnz * avg kernel
+occupancy), NOT with the dense voxel volume — the property the
+reference's sparse conv exists for (SubmConv on LiDAR voxel grids at
+<<1% density).
+"""
+from __future__ import annotations
+
+import hashlib
+from itertools import product
+from typing import Tuple
+
+import numpy as np
+
+__all__ = ["build_subm_rulebook", "apply_rulebook"]
+
+_RULEBOOK_CACHE: dict = {}
+_CACHE_LIMIT = 64
+
+
+def _pad_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return max(p, 8)
+
+
+def build_subm_rulebook(indices: np.ndarray, spatial: Tuple[int, ...],
+                        kernel_size: Tuple[int, ...],
+                        dilation: Tuple[int, ...],
+                        padding: Tuple[int, ...]):
+    """Submanifold rulebook: output support == input support.
+
+    indices: [1 + d, nnz] int array (batch + d spatial coords, NDHWC
+    order without the channel dim). Returns (in_idx, out_idx) arrays of
+    shape [K, cap] padded with ``nnz`` (the zero-row sentinel), plus
+    the per-offset pair counts. The neighbor relation follows the
+    reference conv geometry at stride 1: ``q = p - padding +
+    off*dilation`` — padding = (kernel_size//2)*dilation centers the
+    window; other paddings shift it (same semantics as the reference
+    rulebook, which never raises for off-center subm windows).
+    """
+    key = (hashlib.sha1(np.ascontiguousarray(indices)).hexdigest(),
+           tuple(spatial), tuple(kernel_size), tuple(dilation),
+           tuple(padding))
+    hit = _RULEBOOK_CACHE.get(key)
+    if hit is not None:
+        return hit
+    nd = len(spatial)
+    nnz = indices.shape[1]
+    coords = indices.T.astype(np.int64)          # [nnz, 1+d]
+    # linearize (batch, spatial...) for O(log n) membership via sort
+    mults = np.ones(nd + 1, np.int64)
+    for i in range(nd - 1, -1, -1):
+        mults[i] = mults[i + 1] * spatial[i]
+    lin = coords @ mults
+    order = np.argsort(lin)
+    lin_sorted = lin[order]
+
+    in_list, out_list, counts = [], [], []
+    for off in product(*[range(k) for k in kernel_size]):
+        delta = np.array([0] + [o * dil - p for o, dil, p
+                                in zip(off, dilation, padding)],
+                         np.int64)
+        q = coords + delta
+        ok = np.ones(nnz, bool)
+        for i in range(nd):
+            ok &= (q[:, 1 + i] >= 0) & (q[:, 1 + i] < spatial[i])
+        qlin = q[ok] @ mults
+        pos = np.searchsorted(lin_sorted, qlin)
+        pos = np.clip(pos, 0, nnz - 1)
+        found = lin_sorted[pos] == qlin
+        out_rows = np.nonzero(ok)[0][found]      # output = point p
+        in_rows = order[pos[found]]              # input  = neighbor q
+        in_list.append(in_rows)
+        out_list.append(out_rows)
+        counts.append(len(in_rows))
+
+    cap = _pad_pow2(max(counts) if counts else 1)
+    K = len(in_list)
+    in_idx = np.full((K, cap), nnz, np.int32)    # nnz = zero-row pad
+    out_idx = np.full((K, cap), nnz, np.int32)
+    for k in range(K):
+        in_idx[k, :counts[k]] = in_list[k]
+        out_idx[k, :counts[k]] = out_list[k]
+    if len(_RULEBOOK_CACHE) >= _CACHE_LIMIT:
+        _RULEBOOK_CACHE.pop(next(iter(_RULEBOOK_CACHE)))
+    res = (in_idx, out_idx, np.asarray(counts, np.int64))
+    _RULEBOOK_CACHE[key] = res
+    return res
+
+
+def apply_rulebook(values, weight_k, in_idx, out_idx, nnz: int):
+    """out[out_idx[k]] += values[in_idx[k]] @ weight_k[k] for all k, in
+    one traceable program.
+
+    values: [nnz, Cin]; weight_k: [K, Cin, Cout]; in_idx/out_idx:
+    [K, cap] with sentinel ``nnz`` rows contributing zero.
+    """
+    import jax.numpy as jnp
+
+    K = in_idx.shape[0]
+    cout = weight_k.shape[-1]
+    vpad = jnp.concatenate(
+        [values, jnp.zeros((1, values.shape[-1]), values.dtype)], 0)
+    out = jnp.zeros((nnz + 1, cout),
+                    jnp.promote_types(values.dtype, weight_k.dtype))
+    for k in range(K):           # K is small & static (27 for 3x3x3)
+        gathered = vpad[in_idx[k]]              # [cap, Cin]
+        contrib = gathered @ weight_k[k]        # MXU GEMM
+        out = out.at[out_idx[k]].add(contrib)
+    return out[:nnz]

@@ -188,13 +188,19 @@ def test_mask_as_duplicate_mask_entries():
     assert out.to_dense().numpy()[0, 0] == pytest.approx(1.0)
 
 
-def test_subm_conv_rejects_shrinking():
+def test_subm_conv_off_center_padding_keeps_support():
+    # padding=0 with k=3 shifts the submanifold window (reference
+    # rulebook semantics: q = p - padding + off*dilation); the output
+    # support is STILL the input support — round 4's dense fallback
+    # raised here only because its XLA conv shrank spatial dims
     dense = np.zeros((1, 4, 4, 2), np.float32)
     dense[0, 3, 3] = 1.0
+    dense[0, 2, 2] = 2.0
     x = sparse.to_sparse_coo(paddle.to_tensor(dense), 3)
     conv = sparse.nn.SubmConv2D(2, 3, kernel_size=3, padding=0)
-    with pytest.raises(ValueError):
-        conv(x)
+    out = conv(x)
+    assert out.nnz() == x.nnz()
+    assert out.shape == [1, 4, 4, 3]
 
 
 def test_conv_bias_keeps_sparsity():
@@ -218,3 +224,88 @@ def test_mask_as():
     expect = np.zeros((3, 4), np.float32)
     expect[idx[0], idx[1]] = x.numpy()[idx[0], idx[1]]
     np.testing.assert_allclose(out.to_dense().numpy(), expect, rtol=1e-6)
+
+
+# -- rulebook sparse conv (round 5: real sparse compute, not densify) --
+
+def _rand_voxels(shape_sp, nnz, cin, seed=0):
+    """Random COO voxel tensor [1, *shape_sp, cin] with nnz points."""
+    rng = np.random.RandomState(seed)
+    vol = int(np.prod(shape_sp))
+    flat = rng.choice(vol, size=nnz, replace=False)
+    coords = np.stack(np.unravel_index(flat, shape_sp))
+    idx = np.concatenate([np.zeros((1, nnz), np.int64), coords], 0)
+    vals = rng.randn(nnz, cin).astype(np.float32)
+    dense = np.zeros((1, *shape_sp, cin), np.float32)
+    dense[(np.zeros(nnz, np.int64),) + tuple(coords)] = vals
+    return idx, vals, dense
+
+
+def test_subm_conv3d_rulebook_matches_dense_reference():
+    cin, cout = 2, 3
+    idx, vals, dense = _rand_voxels((5, 6, 4), nnz=17, cin=cin, seed=3)
+    x = sparse.sparse_coo_tensor(idx, vals, (1, 5, 6, 4, cin))
+    conv = sparse.nn.SubmConv3D(cin, cout, kernel_size=3, padding=1)
+    out = conv(x)
+    # dense reference: conv then mask to the input support
+    import jax.numpy as jnp
+    from paddle_tpu.nn import functional as F
+    ref = F.conv3d(paddle.to_tensor(dense), conv.weight, bias=None,
+                   stride=1, padding=1, data_format="NDHWC")
+    ref_np = np.asarray(ref.numpy())[tuple(np.asarray(x._indices))]
+    ref_np = ref_np + np.asarray(conv.bias.numpy())
+    got = {}
+    oidx = np.asarray(out._indices)
+    for i in range(out.nnz()):
+        got[tuple(oidx[:, i])] = np.asarray(out.values().numpy())[i]
+    want_keys = [tuple(np.asarray(x._indices)[:, i])
+                 for i in range(x.nnz())]
+    assert sorted(got) == sorted(want_keys)
+    want = {k: ref_np[i] for i, k in enumerate(want_keys)}
+    for k in want:
+        np.testing.assert_allclose(got[k], want[k], atol=2e-5)
+
+
+def test_subm_conv_rulebook_grads_flow():
+    cin, cout = 2, 2
+    idx, vals, _ = _rand_voxels((4, 4, 4), nnz=9, cin=cin, seed=5)
+    x = sparse.sparse_coo_tensor(idx, vals, (1, 4, 4, 4, cin))
+    conv = sparse.nn.SubmConv3D(cin, cout, kernel_size=3, padding=1)
+    out = conv(x)
+    loss = (out.values() ** 2).sum()
+    loss.backward()
+    gw = np.asarray(conv.weight.grad.numpy())
+    assert np.isfinite(gw).all() and np.abs(gw).max() > 0
+    gb = np.asarray(conv.bias.grad.numpy())
+    assert np.isfinite(gb).all()
+
+
+def test_rulebook_compute_scales_with_nnz_not_volume():
+    """The property the reference sparse conv exists for: gather/GEMM
+    work is proportional to rulebook pairs (~nnz * kernel occupancy),
+    not voxel volume."""
+    from paddle_tpu.sparse.rulebook import build_subm_rulebook
+    sp_small, sp_big = (8, 8, 8), (64, 64, 64)
+    nnz = 20
+    for sp in (sp_small, sp_big):
+        idx, _, _ = _rand_voxels(sp, nnz=nnz, cin=1, seed=11)
+        in_idx, out_idx, counts = build_subm_rulebook(
+            idx, sp, (3, 3, 3), (1, 1, 1), (1, 1, 1))
+        # pairs bounded by nnz * 27 regardless of volume; padded
+        # capacity is pow2(max bucket) —far below volume
+        assert counts.sum() <= nnz * 27
+        assert in_idx.shape[1] <= max(8, 2 * nnz)
+    # and the 512x denser volume produced the SAME bounded work
+    # (both asserts above passed for sp_big) — no volume term anywhere
+
+
+def test_rulebook_dilation_and_cache():
+    from paddle_tpu.sparse import rulebook as rb
+    idx = np.array([[0, 0], [1, 3], [2, 2], [1, 1]], np.int64)
+    r1 = rb.build_subm_rulebook(idx, (6, 6, 6), (3, 3, 3), (2, 2, 2),
+                                (2, 2, 2))
+    r2 = rb.build_subm_rulebook(idx, (6, 6, 6), (3, 3, 3), (2, 2, 2),
+                                (2, 2, 2))
+    assert r1 is r2  # cached
+    # dilation 2: the two points are 2 apart in every dim -> neighbors
+    assert r1[2].sum() >= 2
