@@ -7,6 +7,12 @@ produce the step-decomposition ledgers in RESULTS.md without any
 tensorflow/tensorboard dependency. Wire format details follow
 tsl/profiler/protobuf/xplane.proto; decoding is the same
 varint/length-delimited walk as paddle_tpu/onnx/proto.py:read_fields.
+
+Key subtlety: a line's events NEST (a while-loop region event contains
+its body's op events), and DMA lines record ASYNC copies that overlap
+compute — summing raw durations double-counts. ``op_self_times``
+computes per-op SELF time (duration minus contained children) per
+line, which is what a step waterfall needs.
 """
 from __future__ import annotations
 
@@ -14,7 +20,7 @@ import glob
 import gzip
 import os
 from collections import defaultdict
-from typing import Dict, List, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
 
 
 def _read_varint(b: bytes, i: int) -> Tuple[int, int]:
@@ -79,35 +85,86 @@ def _decode_plane(pb: bytes):
     return name, lines, meta
 
 
-def _line_events(line_pb: bytes):
-    """Yield (metadata_id, duration_ps) per event on the line."""
+def _decode_line(line_pb: bytes):
+    """(line_name, [(metadata_id, offset_ps, duration_ps), ...])."""
+    name = ""
+    events = []
     for fno, _, v in fields(line_pb):
-        if fno == 4:  # XEvent
-            mid = dur = 0
-            for f2, wt2, v2 in fields(v):
+        if fno == 2:
+            name = v.decode(errors="replace")
+        elif fno == 4:  # XEvent
+            mid = off = dur = 0
+            for f2, _, v2 in fields(v):
                 if f2 == 1:
                     mid = v2
+                elif f2 == 2:
+                    off = v2
                 elif f2 == 3:
                     dur = v2
-            yield mid, dur
+            events.append((mid, off, dur))
+    return name, events
+
+
+def planes(xplane_path: str):
+    """Yield (plane_name, [(line_name, events)], metadata) per plane."""
+    raw = open(xplane_path, "rb").read()
+    if xplane_path.endswith(".gz"):
+        raw = gzip.decompress(raw)
+    for fno, _, v in fields(raw):
+        if fno != 1:       # XSpace.planes
+            continue
+        name, line_pbs, meta = _decode_plane(v)
+        yield name, [_decode_line(lp) for lp in line_pbs], meta
+
+
+def op_self_times(xplane_path: str, plane_filter: str = "TPU",
+                  line_filter: Optional[str] = None
+                  ) -> Dict[str, Dict[str, float]]:
+    """{line_name: {op_name: self_ms}} for matching planes.
+
+    Self time = event duration minus time covered by nested (contained)
+    events on the same line — leaf ops keep their full duration, loop/
+    region envelopes only their non-child remainder.
+    """
+    out: Dict[str, Dict[str, float]] = {}
+    for pname, lines, meta in planes(xplane_path):
+        if plane_filter not in pname:
+            continue
+        for lname, events in lines:
+            if line_filter is not None and line_filter not in lname:
+                continue
+            acc = out.setdefault(lname, defaultdict(float))
+            # sort by start asc, end desc => parents before children
+            evs = sorted(((off, off + dur, mid)
+                          for mid, off, dur in events),
+                         key=lambda e: (e[0], -e[1]))
+            stack: List[list] = []   # [start, end, mid, child_cover]
+            def pop_into_parent(ev):
+                start, end, mid, cover = ev
+                self_ps = max(end - start - cover, 0)
+                acc[meta.get(mid, f"#{mid}")] += self_ps / 1e9
+                if stack:
+                    stack[-1][3] += end - start
+            for start, end, mid in evs:
+                while stack and start >= stack[-1][1]:
+                    pop_into_parent(stack.pop())
+                stack.append([start, end, mid, 0])
+            while stack:
+                pop_into_parent(stack.pop())
+    return {k: dict(v) for k, v in out.items()}
 
 
 def op_times(xplane_path: str,
              plane_filter: str = "TPU") -> Dict[str, float]:
-    """op/fusion name -> total device ms across matching planes."""
-    raw = open(xplane_path, "rb").read()
-    if xplane_path.endswith(".gz"):
-        raw = gzip.decompress(raw)
+    """op name -> total RAW duration ms (all lines; overlap-naive —
+    prefer op_self_times for waterfalls)."""
     totals: Dict[str, float] = defaultdict(float)
-    for fno, _, v in fields(raw):
-        if fno != 1:       # XSpace.planes
+    for pname, lines, meta in planes(xplane_path):
+        if plane_filter not in pname:
             continue
-        name, lines, meta = _decode_plane(v)
-        if plane_filter not in name:
-            continue
-        for line_pb in lines:
-            for mid, dur in _line_events(line_pb):
-                totals[meta.get(mid, f"#{mid}")] += dur / 1e9  # ps->ms
+        for _, events in lines:
+            for mid, _, dur in events:
+                totals[meta.get(mid, f"#{mid}")] += dur / 1e9
     return dict(totals)
 
 
@@ -119,29 +176,44 @@ def latest_xplane(logdir: str) -> str:
     return paths[-1]
 
 
+import re as _re
+
+_SYM_RE = _re.compile(r"^%?([\w.\-]+)")
+
+
+def op_symbol(event_name: str) -> str:
+    """The HLO lhs symbol (``%fusion.339 = ...`` -> ``fusion.339``) —
+    event names embed the whole instruction text including operand
+    lists, so classification must NEVER substring-match the full
+    name."""
+    m = _SYM_RE.match(event_name)
+    return m.group(1) if m else event_name
+
+
 _BUCKETS = [
-    ("flash-fwd", lambda n: "fa_fwd" in n or "_fa_fwd" in n),
-    ("flash-bwd", lambda n: "fa_bwd" in n or "_fa_bwd" in n),
-    ("pallas-other", lambda n: "custom-call" in n or "tpu_custom_call"
-        in n or "pallas" in n),
-    ("matmul", lambda n: "dot" in n or "gemm" in n or "convolution"
-        in n),
-    ("copy/transpose", lambda n: "copy" in n or "transpose" in n
-        or "bitcast" in n),
-    ("allreduce/collective", lambda n: "all-reduce" in n or
-        "all-gather" in n or "reduce-scatter" in n or "collective" in n),
-    ("rng", lambda n: "rng" in n),
-    ("fusion-other", lambda n: "fusion" in n),
+    ("custom-call", ("custom-call", "checkpoint", "rematted",
+                     "closed_call", "fused_adamw", "_rowq", "_colq",
+                     "_sr_colq", "fa_fwd", "fa_bwd")),
+    ("matmul/conv", ("dot", "gemm", "convolution")),
+    ("copy/slice", ("copy", "transpose", "bitcast", "slice",
+                    "dynamic-update-slice", "dynamic-slice", "pad",
+                    "concatenate", "reshape")),
+    ("collective", ("all-reduce", "all-gather", "reduce-scatter",
+                    "all-to-all", "collective-permute")),
+    ("rng", ("rng",)),
+    ("loop/control", ("while", "condition", "body", "conditional")),
+    ("fusion", ("fusion",)),
 ]
 
 
 def bucketize(totals: Dict[str, float]) -> List[Tuple[str, float]]:
-    """Collapse per-op totals into readable buckets (ms)."""
+    """Collapse per-op totals into readable buckets (ms), classifying
+    by the lhs SYMBOL only (operand text is full of red herrings)."""
     out: Dict[str, float] = defaultdict(float)
     for name, ms in totals.items():
-        low = name.lower()
-        for bucket, pred in _BUCKETS:
-            if pred(low):
+        sym = op_symbol(name).lower()
+        for bucket, keys in _BUCKETS:
+            if any(k in sym for k in keys):
                 out[bucket] += ms
                 break
         else:

@@ -319,6 +319,21 @@ class DistModel:
         with no_grad():
             return self.network(*data)
 
+    def lower(self, *data):
+        """Lower the train step with the batch sharded exactly as
+        ``__call__`` would shard it — the compiled distributed program
+        (``.compile().as_text()`` = optimized HLO with the GSPMD
+        collectives) for traffic auditing
+        (benchmarks/scaling_model.py)."""
+        if self._mode != "train":
+            raise RuntimeError("lower() audits the train step; call "
+                               ".train() first")
+        data = tuple(self._shard_batch(d) for d in data)
+        if self._step is None:
+            from ..jit.functional import TrainStep
+            self._step = TrainStep(self.network, self._opt, self._loss)
+        return self._step.lower(*data)
+
     def state_dict(self, *a, **k):
         return self.network.state_dict(*a, **k)
 

@@ -141,10 +141,23 @@ class TrainStep:
         donate = (0, 2) if self._donate else ()
         return jax.jit(step, donate_argnums=donate)
 
-    def __call__(self, *batch) -> Tensor:
+    def lower(self, *batch):
+        """``jax.jit(...).lower`` for the assembled step — the compiled
+        distributed program (StableHLO/optimized HLO via .compile()
+        .as_text()) for collective-traffic auditing
+        (benchmarks/scaling_model.py)."""
         inputs, labels = self._split(batch)
         if self._jitted is None:
             self._jitted = self._build()
+        args = self._assemble(inputs, labels, advance=False)
+        return self._jitted.lower(*args)
+
+    def _assemble(self, inputs, labels, advance=True):
+        """(params, buffers, opt_state, lr, t, inputs, labels) in the
+        jitted step's calling convention, creating optimizer slots on
+        first use (shared by __call__ and lower; ``advance=False``
+        leaves the optimizer's step counter untouched — lowering is
+        not a step)."""
         params, buffers = self.model.raw_state()
         named = dict(self.model.named_parameters())
         opt = self.optimizer
@@ -176,14 +189,25 @@ class TrainStep:
                     del opt.__dict__["_set_acc"]  # back to the class method
             opt_state = {p.name: dict(opt._accumulators.get(p.name, {}))
                          for p in named.values()}
-        opt._step_count += 1
+        if advance:
+            opt._step_count += 1
         lr = jnp.asarray(opt.get_lr(), jnp.float32)
-        t = jnp.asarray(opt._step_count, jnp.int32)
+        t = jnp.asarray(opt._step_count + (0 if advance else 1),
+                        jnp.int32)
+        return (params, buffers, opt_state, lr, t,
+                tuple(x._data if isinstance(x, Tensor) else x
+                      for x in inputs),
+                tuple(y._data if isinstance(y, Tensor) else y
+                      for y in labels))
+
+    def __call__(self, *batch) -> Tensor:
+        inputs, labels = self._split(batch)
+        if self._jitted is None:
+            self._jitted = self._build()
+        named = dict(self.model.named_parameters())
+        opt = self.optimizer
         loss, new_params, new_buffers, new_state, outs, comps = \
-            self._jitted(
-            params, buffers, opt_state, lr, t,
-            tuple(x._data if isinstance(x, Tensor) else x for x in inputs),
-            tuple(y._data if isinstance(y, Tensor) else y for y in labels))
+            self._jitted(*self._assemble(inputs, labels))
         with no_grad():
             for n, p in named.items():
                 p._data = new_params[n]

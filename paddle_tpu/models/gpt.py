@@ -807,18 +807,27 @@ class GPTSpmdTrainer:
                                    remat=False)
             x = out.reshape(B, T, cfg.hidden_size)
         x = _layer_norm(x, params["ln_f_g"], params["ln_f_b"])
-        head = params["wte"].T if cfg.tie_embeddings else params["head"]
         shape = self.mesh.shape
         # fused vocab-chunked CE when no axis shards the vocab/seq dims:
         # never materializes [B,T,V] logits (ops/fused_ce.py)
         if (shape["model"] == 1 and shape["sep"] == 1
                 and cfg.vocab_size % self.ce_chunks == 0):
             from ..ops.fused_ce import fused_softmax_cross_entropy
+            # tied head passes wte's native [V, D] layout straight
+            # through (vocab_major): the .T would cost a materialized
+            # 200MB transpose for dhead in the backward (~7 ms/step,
+            # r5 chrome trace bitcast_convert_fusion); untied heads
+            # are stored [D, V] and keep the head-major path
+            vm = bool(cfg.tie_embeddings)
+            head = params["wte"] if vm else params["head"]
             loss = fused_softmax_cross_entropy(x, head.astype(dtype),
                                                labels,
                                                n_chunks=self.ce_chunks,
-                                               int8=self.ce_int8)
+                                               int8=self.ce_int8,
+                                               vocab_major=vm)
         else:
+            head = params["wte"].T if cfg.tie_embeddings \
+                else params["head"]
             logits = jnp.einsum("btd,dv->btv", x, head.astype(dtype),
                                 preferred_element_type=jnp.float32)
             logits = jax.lax.with_sharding_constraint(

@@ -22,10 +22,21 @@ import numpy as np
 __all__ = ["fused_softmax_cross_entropy"]
 
 
-def _chunk_heads(head, n_chunks):
+def _chunk_heads(head, n_chunks, vocab_major):
+    if vocab_major:                       # head: [V, D]
+        V, D = head.shape
+        return head.reshape(n_chunks, V // n_chunks, D)  # [C, Vc, D]
     D, V = head.shape
     Vc = V // n_chunks
     return head.reshape(D, n_chunks, Vc).transpose(1, 0, 2)  # [C, D, Vc]
+
+
+def _chunk_logits(x, hc, vocab_major):
+    """fp32-accumulated logits for one head chunk, either layout —
+    vocab-major keeps the TIED embedding's native [V, D] layout end to
+    end (no 200MB transpose materialized for dhead in the backward)."""
+    eq = "btd,vd->btv" if vocab_major else "btd,dv->btv"
+    return jnp.einsum(eq, x, hc, preferred_element_type=jnp.float32)
 
 
 def _quantized_x(x, int8):
@@ -39,24 +50,30 @@ def _quantized_x(x, int8):
     return quantize_rowwise_fast(x, axis=-1)
 
 
-def _head_logits_int8(xq_xs, hc):
+def _head_logits_int8(xq_xs, hc, vocab_major=False):
     from .quant_matmul import quantize_rowwise_fast, int8_dot_dequant
     xq, xs = xq_xs
-    hq, hs = quantize_rowwise_fast(hc, axis=0)
-    return int8_dot_dequant(xq, xs, hq, hs, ((xq.ndim - 1,), (0,)))
+    hq, hs = quantize_rowwise_fast(hc, axis=1 if vocab_major else 0)
+    if vocab_major:
+        # hc [Vc, D] -> per-vocab-row scales [Vc, 1]: broadcast against
+        # [..., Vc] logits needs the LAST axis
+        hs = jnp.reshape(hs, (1,) * (xq.ndim - 1) + (-1,))
+    cdim = ((xq.ndim - 1,), (1,) if vocab_major else (0,))
+    return int8_dot_dequant(xq, xs, hq, hs, cdim)
 
 
-def _forward(x, head, labels, n_chunks, int8=False):
+def _forward(x, head, labels, n_chunks, int8=False,
+             vocab_major=False):
     """Online logsumexp over vocab chunks; returns (loss, (max, sumexp))."""
-    Vc = head.shape[1] // n_chunks
-    hb = _chunk_heads(head.astype(x.dtype), n_chunks)
+    V = head.shape[0] if vocab_major else head.shape[1]
+    Vc = V // n_chunks
+    hb = _chunk_heads(head.astype(x.dtype), n_chunks, vocab_major)
     xq_xs = _quantized_x(x, int8)
 
     def body(carry, hc):
         m, s, lterm, off = carry
-        lg = _head_logits_int8(xq_xs, hc) if int8 else \
-            jnp.einsum("btd,dv->btv", x, hc,
-                       preferred_element_type=jnp.float32)
+        lg = _head_logits_int8(xq_xs, hc, vocab_major) if int8 else \
+            _chunk_logits(x, hc, vocab_major)
         m2 = jnp.maximum(m, lg.max(-1))
         s = s * jnp.exp(m - m2) + jnp.exp(lg - m2[..., None]).sum(-1)
         idx = labels - off
@@ -72,29 +89,33 @@ def _forward(x, head, labels, n_chunks, int8=False):
     return jnp.mean(lse - lterm), (m, s)
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def fused_softmax_cross_entropy(x, head, labels, n_chunks=8,
-                                int8=False):
+                                int8=False, vocab_major=False):
     """Mean token NLL of ``softmax(x @ head)`` against integer ``labels``.
 
-    x: [..., D] activations (bf16/f32); head: [D, V]; labels: [...] int.
-    V must divide by n_chunks. Equivalent to
+    x: [..., D] activations (bf16/f32); head: [D, V] (or [V, D] with
+    ``vocab_major=True`` — the tied-embedding layout, gradient returned
+    in the same layout with no transpose); labels: [...] int. V must
+    divide by n_chunks. Equivalent to
     ``-mean(log_softmax(x @ head)[labels])`` with fp32 accumulation, but
     O(V/n_chunks) peak memory.
     """
-    return _forward(x, head, labels, n_chunks, int8)[0]
+    return _forward(x, head, labels, n_chunks, int8, vocab_major)[0]
 
 
-def _ce_fwd(x, head, labels, n_chunks, int8):
-    loss, (m, s) = _forward(x, head, labels, n_chunks, int8)
+def _ce_fwd(x, head, labels, n_chunks, int8, vocab_major):
+    loss, (m, s) = _forward(x, head, labels, n_chunks, int8,
+                            vocab_major)
     return loss, (x, head, labels, m, s)
 
 
-def _ce_bwd(n_chunks, int8, res, g):
+def _ce_bwd(n_chunks, int8, vocab_major, res, g):
     x, head, labels, m, s = res
-    D, V = head.shape
+    V = head.shape[0] if vocab_major else head.shape[1]
+    D = head.shape[1] if vocab_major else head.shape[0]
     Vc = V // n_chunks
-    hb = _chunk_heads(head.astype(x.dtype), n_chunks)
+    hb = _chunk_heads(head.astype(x.dtype), n_chunks, vocab_major)
     n_tokens = np.float32(np.prod(x.shape[:-1]))
 
     xq_xs = _quantized_x(x, int8)
@@ -103,9 +124,8 @@ def _ce_bwd(n_chunks, int8, res, g):
         dx, off = carry
         # the recompute must match the forward's arithmetic exactly —
         # softmax normalizers (m, s) were computed on THOSE logits
-        lg = _head_logits_int8(xq_xs, hc) if int8 else \
-            jnp.einsum("btd,dv->btv", x, hc,
-                       preferred_element_type=jnp.float32)
+        lg = _head_logits_int8(xq_xs, hc, vocab_major) if int8 else \
+            _chunk_logits(x, hc, vocab_major)
         p = jnp.exp(lg - m[..., None]) / s[..., None]
         idx = labels - off
         inb = (idx >= 0) & (idx < Vc)
@@ -116,21 +136,28 @@ def _ce_bwd(n_chunks, int8, res, g):
             from .quant_matmul import (quantize_rowwise_fast,
                                        int8_dot_dequant)
             gq, gs = quantize_rowwise_fast(dlg, axis=-1)
-            hcq, hcs = quantize_rowwise_fast(hc, axis=1)
+            hcq, hcs = quantize_rowwise_fast(hc,
+                                             axis=0 if vocab_major
+                                             else 1)
             dxc = int8_dot_dequant(
                 gq, gs, hcq,
                 jnp.reshape(hcs, (1,) * (dlg.ndim - 1) + (-1,)),
-                ((dlg.ndim - 1,), (1,)))
+                ((dlg.ndim - 1,), (0,) if vocab_major else (1,)))
         else:
-            dxc = jnp.einsum("btv,dv->btd", dlg, hc,
+            eq = "btv,vd->btd" if vocab_major else "btv,dv->btd"
+            dxc = jnp.einsum(eq, dlg, hc,
                              preferred_element_type=jnp.float32)
-        dhc = jnp.einsum("btd,btv->dv", x, dlg,
+        eqh = "btv,btd->vd" if vocab_major else "btd,btv->dv"
+        dhc = jnp.einsum(eqh, *((dlg, x) if vocab_major else (x, dlg)),
                          preferred_element_type=jnp.float32)
         return (dx + dxc, off + Vc), dhc
 
     dx0 = jnp.zeros(x.shape, jnp.float32)
     (dx, _), dh = jax.lax.scan(body, (dx0, 0), hb)
-    dh = dh.transpose(1, 0, 2).reshape(D, V)
+    if vocab_major:
+        dh = dh.reshape(V, D)        # [C, Vc, D] stack: zero-copy
+    else:
+        dh = dh.transpose(1, 0, 2).reshape(D, V)
     return dx.astype(x.dtype), dh.astype(head.dtype), None
 
 
