@@ -1,0 +1,88 @@
+"""One quantized-decode measurement phase, run in a FRESH process.
+
+Child of bench_llama_decode.py (one process per precision: the
+tunnel's remote-compile endpoint degrades across a session of large
+compiles — RESULTS.md round-4 root-cause). Prints one line:
+``PHASERES {json}`` with per-bs tokens/s and prefix-logit parity vs
+bf16 measured in-run.
+"""
+import argparse
+import json
+import sys
+import time
+
+import _path  # noqa: F401
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--precision", choices=["int8", "int4"],
+                    required=True)
+    ap.add_argument("--vocab", type=int, default=32000)
+    ap.add_argument("--hidden", type=int, default=2048)
+    ap.add_argument("--layers", type=int, default=16)
+    ap.add_argument("--heads", type=int, default=16)
+    ap.add_argument("--ffn", type=int, default=5504)
+    ap.add_argument("--maxpos", type=int, default=1024)
+    ap.add_argument("--prompt", type=int, default=64)
+    ap.add_argument("--new", type=int, default=128)
+    ap.add_argument("--runs", type=int, default=2)
+    ap.add_argument("--batches", type=int, nargs="+", default=[1, 8])
+    ap.add_argument("--group", type=int, default=128,
+                    help="int4 quantization group size")
+    args = ap.parse_args()
+
+    import numpy as np
+
+    import jax
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import LlamaConfig, LlamaForCausalLM
+
+    cfg = LlamaConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                      num_hidden_layers=args.layers,
+                      num_attention_heads=args.heads,
+                      intermediate_size=args.ffn,
+                      max_position_embeddings=args.maxpos)
+    paddle.seed(0)
+    m = LlamaForCausalLM(cfg)
+    m.eval()
+    m.to(dtype="bfloat16")
+    if args.precision == "int8":
+        from paddle_tpu.quantization import weight_only_int8
+        q = weight_only_int8(m, inplace=False)
+    else:
+        from paddle_tpu.quantization import weight_only_int4
+        q = weight_only_int4(m, group=args.group, inplace=False)
+
+    rng = np.random.RandomState(0)
+    idsp = paddle.to_tensor(
+        rng.randint(0, cfg.vocab_size, (1, args.prompt))
+        .astype(np.int64))
+    lb = np.asarray(jax.device_get(m(idsp)._data))[0, -1] \
+        .astype(np.float64)
+    li = np.asarray(jax.device_get(q(idsp)._data))[0, -1] \
+        .astype(np.float64)
+    rel = float(np.max(np.abs(lb - li)) / max(np.max(np.abs(lb)),
+                                              1e-9))
+    res = {"rel_err": round(rel, 4),
+           "argmax_same": bool(np.argmax(lb) == np.argmax(li))}
+    del m
+
+    for bs in args.batches:
+        ids = paddle.to_tensor(
+            rng.randint(0, cfg.vocab_size, (bs, args.prompt))
+            .astype(np.int64))
+        out = q.generate(ids, max_new_tokens=args.new)
+        int(np.asarray(jax.device_get(out._data[0, -1])))
+        t0 = time.perf_counter()
+        for _ in range(args.runs):
+            out = q.generate(ids, max_new_tokens=args.new)
+        int(np.asarray(jax.device_get(out._data[0, -1])))
+        res[bs] = round(
+            bs * args.new * args.runs / (time.perf_counter() - t0), 1)
+    print("PHASERES " + json.dumps(res))
+    sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

@@ -11,10 +11,35 @@ import time
 import numpy as np
 
 
+def build_train_step(bs: int, img_hw: int = 224):
+    """Zero-arg AMP-O2 train-step thunk over fixed random data (shared
+    by main() and benchmarks/probe_trace.py)."""
+    import paddle_tpu as paddle
+    from paddle_tpu.jit.functional import TrainStep
+    from paddle_tpu.vision.models import resnet50
+
+    model = resnet50()
+    model.train()
+    x = paddle.to_tensor(
+        np.random.rand(bs, 3, img_hw, img_hw).astype(np.float32))
+    labels = paddle.to_tensor(
+        np.random.randint(0, 1000, (bs,)).astype(np.int64))
+    opt = paddle.optimizer.Momentum(learning_rate=0.01,
+                                    parameters=model.parameters())
+    model, opt = paddle.amp.decorate(models=model, optimizers=opt,
+                                     level="O2", dtype="bfloat16")
+    step = TrainStep(model, opt, paddle.nn.CrossEntropyLoss())
+
+    def amp_step():
+        with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
+            return step(x, labels)
+
+    return amp_step
+
+
 def main():
     import jax
     import paddle_tpu as paddle
-    from paddle_tpu.jit.functional import TrainStep
     from paddle_tpu.vision.models import resnet50
     import paddle_tpu.jit as jit
 
@@ -43,17 +68,7 @@ def main():
                       "vs_baseline": None}))
 
     # -- training (AMP-O2) -------------------------------------------------
-    model.train()
-    opt = paddle.optimizer.Momentum(learning_rate=0.01,
-                                    parameters=model.parameters())
-    model, opt = paddle.amp.decorate(models=model, optimizers=opt,
-                                     level="O2", dtype="bfloat16")
-    step = TrainStep(model, opt, paddle.nn.CrossEntropyLoss())
-
-    def amp_step():
-        with paddle.amp.auto_cast(level="O2", dtype="bfloat16"):
-            return step(x, labels)
-
+    amp_step = build_train_step(bs, img[-1])
     loss = amp_step()
     float(loss.numpy())
     loss = amp_step()

@@ -59,22 +59,35 @@ def main():
         from bench_bert_dp import build_train_step
         step = build_train_step(args.bs or 32)
 
+    def drain(out):
+        # paddle Tensor or raw jax array/loss tuple
+        arr = getattr(out, "_data", None)
+        if arr is None:
+            arr = jax.tree.leaves(out)[0]
+        float(jax.device_get(arr).reshape(-1)[0])
+
     # warm up / compile outside the trace window
     for _ in range(2):
         out = step()
-    float(jax.device_get(jax.tree.leaves(out)[0].reshape(-1)[0]))
+    drain(out)
 
     logdir = tempfile.mkdtemp(prefix="ptpu_trace_")
     jax.profiler.start_trace(logdir)
     for _ in range(args.steps):
         out = step()
-    float(jax.device_get(jax.tree.leaves(out)[0].reshape(-1)[0]))
+    drain(out)
     jax.profiler.stop_trace()
 
     path = xplane.latest_xplane(logdir)
-    totals = xplane.op_times(path)
-    per_step = {k: v / args.steps for k, v in totals.items()}
-    print(f"# {path}")
+    per_line = xplane.op_self_times(path)
+    if not per_line:
+        print(f"# {path}: no TPU plane in trace (CPU run?) — nothing "
+              f"to decompose")
+        return
+    ops_line = "XLA Ops" if "XLA Ops" in per_line else \
+        max(per_line, key=lambda k: len(per_line[k]))
+    per_step = {k: v / args.steps for k, v in per_line[ops_line].items()}
+    print(f"# {path} (line {ops_line!r}; self-times)")
     print(f"# total device ms/step: "
           f"{sum(per_step.values()):.1f}")
     print("## buckets (ms/step)")

@@ -26,7 +26,9 @@ from typing import Any, Callable, Optional, Sequence
 import jax
 import numpy as np
 
-from ..framework.tensor import Tensor, no_grad
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, apply_op, grad_enabled, no_grad
 from ..nn.layer_base import Layer
 from .functional import functional_call
 
@@ -162,15 +164,23 @@ class StaticFunction:
                  build_strategy=None, full_graph=True, backend=None):
         if isinstance(function, Layer):
             self._layer = function
-            self._fn = type(function).forward
+            # vars() not getattr: auto_capture may have REBOUND the
+            # class's forward to a StaticFunction (left in place by
+            # design) — unwrap to the original function
+            fwd = type(function).__dict__.get("forward",
+                                              type(function).forward)
+            self._fn = fwd._fn if isinstance(fwd, StaticFunction) else fwd
             self._bound_self = function
         elif hasattr(function, "__self__") and isinstance(
                 function.__self__, Layer):
             self._layer = function.__self__
-            self._fn = function.__func__
+            fn = function.__func__
+            self._fn = fn._fn if isinstance(fn, StaticFunction) else fn
             self._bound_self = function.__self__
         else:
             self._layer = None
+            if isinstance(function, StaticFunction):
+                function = function._fn
             self._fn = function
             self._bound_self = None
         self._input_spec = input_spec
@@ -207,6 +217,25 @@ class StaticFunction:
         # StaticFunction; instance calls must still bind self
         if obj is None:
             return self
+        if isinstance(obj, Layer):
+            # route through the LAYER path per instance: params/buffers
+            # become traced inputs via bind_state, so optimizer updates
+            # are seen every call. Baking `self` as a static closure
+            # would constant-fold the parameters at trace time — the
+            # model would silently stop learning in the compiled path
+            # (and guarding the instance is impossible anyway).
+            # The per-instance StaticFunction lives ON the instance:
+            # the only strong path is obj -> sf -> obj, a plain gc-
+            # collectable cycle (a dict on the class would make every
+            # instance ever called immortal — r5 review repro).
+            attr = "_ptpu_sf_" + getattr(self._fn, "__name__", "fn")
+            sf = obj.__dict__.get(attr)
+            if sf is None:
+                import types
+                sf = StaticFunction(types.MethodType(self._fn, obj),
+                                    input_spec=self._input_spec)
+                object.__setattr__(obj, attr, sf)
+            return sf
         return functools.partial(self, obj)
 
     @property
@@ -233,8 +262,13 @@ class StaticFunction:
 
         def add(dest, v, dyn, skey):
             if isinstance(v, Tensor):
+                # keep the TENSOR (not v._data): the training-mode tape
+                # path needs the original object so gradients flow to
+                # callers upstream of the captured function — r5 review
+                # repro: an embedding feeding a captured block silently
+                # stopped learning when this held the raw array
                 entries.append((dest, "dyn", len(dyn)))
-                dyn.append(v._data)
+                dyn.append(v)
             elif isinstance(v, (jax.Array, np.ndarray, np.generic)):
                 # numpy scalars (np.float32(x)) are dynamic operands,
                 # like the arrays they broadcast with
@@ -274,7 +308,9 @@ class StaticFunction:
         else:
             for v in args:
                 add("pos", v, dyn, skey)
-        return tuple(entries), tuple(dyn), tuple(skey)
+        raw = tuple(x._data if isinstance(x, Tensor) else x
+                    for x in dyn)
+        return tuple(entries), raw, tuple(skey), tuple(dyn)
 
     def _build(self, layout, bytecode=False):
         layer = self._layer
@@ -360,7 +396,7 @@ class StaticFunction:
             # coroutine function (cannot be a graph)
             return self._eager(args, kwargs)
         try:
-            layout, dyn, skey = self._split_args(args, kwargs)
+            layout, dyn, skey, dyn_src = self._split_args(args, kwargs)
         except TypeError as e:
             _note_break(f"unguardable arg: {e}")
             return self._eager(args, kwargs)
@@ -416,10 +452,53 @@ class StaticFunction:
             self._cache[key] = (tier, jitted)
 
         def _run(j):
-            if self._layer is not None:
-                params, buffers = self._layer.raw_state()
-                return j(params, buffers, self._layer.training, *dyn)
-            return j(*dyn), None
+            if self._layer is None:
+                return j(*dyn), None, False
+            buffers = {n: b._data
+                       for n, b in self._layer.named_buffers()
+                       if b is not None}
+            training = self._layer.training
+            params_t = dict(self._layer.named_parameters())
+            tape = grad_enabled() and (
+                any(not p.stop_gradient for p in params_t.values())
+                or any(isinstance(t, Tensor) and not t.stop_gradient
+                       for t in dyn_src))
+            if not tape:
+                params = {n: p._data for n, p in params_t.items()}
+                out, new_buffers = j(params, buffers, training, *dyn)
+                return out, new_buffers, False
+            # TRAINING-mode capture: the compiled program must stay ON
+            # the autograd tape — returning detached outputs would make
+            # loss.backward() a silent no-op and freeze learning (round
+            # 5 regression test). The whole jitted program becomes ONE
+            # tape op via apply_op; jax.vjp differentiates through the
+            # jit, params/inputs are traced operands every call (never
+            # baked constants).
+            pnames = list(params_t)
+            td_cell = []
+
+            def fwrap(*arrs):
+                ps = dict(zip(pnames, arrs[:len(pnames)]))
+                out, new_buffers = j(ps, buffers, training,
+                                     *arrs[len(pnames):])
+                leaves, td = jax.tree.flatten((out, new_buffers))
+                td_cell.clear()
+                td_cell.append(td)
+                return tuple(leaves)
+
+            tensor_args = [params_t[n] for n in pnames] + [
+                t if isinstance(t, Tensor) else Tensor(
+                    jnp.asarray(t), stop_gradient=True)
+                for t in dyn_src]
+            res = apply_op(
+                fwrap, *tensor_args,
+                _op_name=f"to_static[{getattr(self._fn, '__name__', 'fn')}]")
+            tensors = list(res) if isinstance(res, (tuple, list)) \
+                else [res]
+            out, new_buffers = jax.tree.unflatten(td_cell[0], tensors)
+            new_buffers = {n: (b._data if isinstance(b, Tensor) else b)
+                           for n, b in new_buffers.items()}
+            return out, new_buffers, True
 
         from .opcode_executor import GraphBreak
         _TRACE_ERRS = (GraphBreak,
@@ -428,7 +507,7 @@ class StaticFunction:
                        jax.errors.TracerBoolConversionError,
                        jax.errors.TracerIntegerConversionError)
         try:
-            out, new_buffers = _run(jitted)
+            out, new_buffers, wrapped = _run(jitted)
         except _TRACE_ERRS as e:
             if tier == "ast":
                 # data-dependent python control flow the AST pass could
@@ -437,7 +516,7 @@ class StaticFunction:
                 try:
                     tier = "sot"
                     jitted = self._build(layout, bytecode=True)
-                    out, new_buffers = _run(jitted)
+                    out, new_buffers, wrapped = _run(jitted)
                     self._cache[key] = (tier, jitted)
                 except _TRACE_ERRS as e2:
                     # tier 3: break-and-resume. Compile the prefix,
@@ -468,8 +547,8 @@ class StaticFunction:
                 for n, b in self._layer.named_buffers():
                     if b is not None and n in new_buffers:
                         b._data = new_buffers[n]
-            return _wrap_tree(out)
-        return _wrap_tree(out)
+            return out if wrapped else _wrap_tree(out)
+        return out if wrapped else _wrap_tree(out)
 
     def concrete_program_specify_input_spec(self, *a, **k):
         return None

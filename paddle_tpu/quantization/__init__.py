@@ -19,6 +19,8 @@ from .wrapper import (  # noqa: F401
     ObserveWrapper, QuantedLinear, QuantedConv2D, quant_dequant)
 from .int8_layers import (  # noqa: F401
     Int8Linear, Int8Conv2D, weight_only_int8)
+from .int4_layers import (  # noqa: F401
+    Int4Linear, weight_only_int4)
 
 __all__ = [
     "QuantConfig", "SingleLayerConfig", "AbsmaxObserver", "AVGObserver",
@@ -26,4 +28,5 @@ __all__ = [
     "FakeQuanterChannelWiseAbsMaxObserver", "QAT", "PTQ",
     "ObserveWrapper", "QuantedLinear", "QuantedConv2D", "quant_dequant",
     "Int8Linear", "Int8Conv2D", "weight_only_int8",
+    "Int4Linear", "weight_only_int4",
 ]

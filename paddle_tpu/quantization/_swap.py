@@ -1,0 +1,27 @@
+"""Shared module-swap traversal for the weight-only quantizers."""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from ..nn.layer_base import Layer
+
+__all__ = ["swap_layers"]
+
+
+def swap_layers(model: Layer,
+                factory: Callable[[Layer], Optional[Layer]],
+                inplace: bool = True) -> Layer:
+    """Replace sublayers bottom-up: ``factory(child)`` returns the
+    replacement layer or None to recurse into the child instead. One
+    traversal shared by weight_only_int8/int4 so the deepcopy/inplace
+    contract and recursion rules cannot diverge."""
+    if not inplace:
+        import copy
+        model = copy.deepcopy(model)
+    for name, child in list(model._sub_layers.items()):
+        repl = factory(child)
+        if repl is not None:
+            model._sub_layers[name] = repl
+        else:
+            swap_layers(child, factory, inplace=True)
+    return model

@@ -1,0 +1,104 @@
+"""Weight-only int4 serving layers: per-group scales, two weights/byte.
+
+Reference analog: the weight_only_quant int4 pass family under
+paddle/fluid/inference (analysis_predictor.h int8/int4 story) and
+llm.int4-style serving. Decode at small batch is WEIGHT-READ-bound
+(benchmarks/RESULTS.md: int8 already converts halved bytes into 1.83x
+bs1 tokens/s); int4 halves the bytes again. TPU-native storage is
+``jnp.int4`` — XLA packs two nibbles per byte in HBM and the convert
+fuses into the consuming dot's operand read — with per-GROUP symmetric
+scales along the contraction dim (group ~128) to hold accuracy at
+4-bit.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.tensor import Tensor, apply_op
+from ..nn.layer_base import Layer
+
+__all__ = ["Int4Linear", "weight_only_int4"]
+
+
+def quantize_weight_int4(w: np.ndarray, group: int):
+    """[in, out] float -> (q int4-valued int8 [in, out],
+    scales f32 [n_groups, out]); symmetric, q in [-7, 7]."""
+    in_f, out_f = w.shape
+    if in_f % group:
+        raise ValueError(f"in_features {in_f} % group {group} != 0")
+    g = in_f // group
+    wg = w.reshape(g, group, out_f).astype(np.float32)
+    scale = np.abs(wg).max(axis=1) / 7.0          # [g, out]
+    scale = np.where(scale == 0.0, 1.0, scale)
+    q = np.clip(np.round(wg / scale[:, None, :]), -7, 7)
+    return q.reshape(in_f, out_f).astype(np.int8), scale
+
+
+class Int4Linear(Layer):
+    """Weight-only int4 linear: bf16 activations, int4 weights
+    dequantized group-wise on the operand read (no bf16 weight copy
+    ever lands in HBM)."""
+
+    def __init__(self, source, group: int = 128):
+        super().__init__()
+        w = np.asarray(source.weight.numpy())      # [in, out]
+        q, scale = quantize_weight_int4(w, group)
+        self.group = group
+        self._in, self._out = w.shape
+        self.register_buffer("wq", Tensor(jnp.asarray(q, jnp.int4)))
+        self.register_buffer("w_scale",
+                             Tensor(jnp.asarray(scale, jnp.float32)))
+        self.bias = source.bias
+
+    def forward(self, x):
+        group, in_f, out_f = self.group, self._in, self._out
+        g = in_f // group
+
+        def f(x, wq, ws, b):
+            # per-group matmul: [..., g, group] x [g, group, out],
+            # scales applied to the PARTIAL sums — the int4->bf16
+            # convert stays fused into the dot operand, so HBM reads
+            # remain 0.5 B/weight
+            # bf16 on TPU (MXU dtype); f32 on CPU tests (the CPU
+            # backend's DotThunk rejects bf16 x bf16 -> f32)
+            cd = jnp.bfloat16 if jax.default_backend() in (
+                "tpu", "axon") else jnp.float32
+            xg = x.reshape(x.shape[:-1] + (g, group)).astype(cd)
+            wg = wq.reshape(g, group, out_f).astype(cd)
+            part = jnp.einsum("...gk,gko->...go", xg, wg,
+                              preferred_element_type=jnp.float32)
+            y = jnp.sum(part * ws, axis=-2)     # ws [g, out] broadcasts
+            if b is not None:
+                y = y + b.astype(jnp.float32)
+            return y.astype(x.dtype)
+
+        args = [x, self.wq, self.w_scale,
+                self.bias if self.bias is not None else None]
+        if isinstance(x, Tensor):
+            return apply_op(f, *args, _op_name="int4_linear")
+        return f(x, getattr(self.wq, "_data", self.wq),
+                 getattr(self.w_scale, "_data", self.w_scale),
+                 getattr(self.bias, "_data", self.bias)
+                 if self.bias is not None else None)
+
+
+def weight_only_int4(model: Layer, group: int = 128,
+                     min_features: int = 256,
+                     inplace: bool = True) -> Layer:
+    """Swap every big-enough nn.Linear for Int4Linear (see
+    weight_only_int8 — same traversal, half the weight bytes)."""
+    from ..nn.layer.common import Linear
+    from ._swap import swap_layers
+
+    def factory(child):
+        if isinstance(child, Linear):
+            w = child.weight
+            if min(w.shape) >= min_features and \
+                    w.shape[0] % group == 0:
+                return Int4Linear(child, group)
+        return None
+
+    return swap_layers(model, factory, inplace=inplace)

@@ -160,22 +160,17 @@ def weight_only_int8(model: Layer, min_features: int = 256,
     ``min_features``: skip layers whose weight matrix is smaller than
     min_features x min_features — tiny layers gain nothing and per-row
     scale overhead can exceed the win."""
-    if not inplace:
-        import copy
-        model = copy.deepcopy(model)
-    for name, child in list(model._sub_layers.items()):
-        from ..nn.layer.common import Linear
-        from ..nn.layer.conv import Conv2D
-        repl = None
+    from ..nn.layer.common import Linear
+    from ..nn.layer.conv import Conv2D
+    from ._swap import swap_layers
+
+    def factory(child):
         if isinstance(child, Linear):
-            w = child.weight
-            if min(w.shape) >= min_features:
-                repl = Int8Linear(child, None)
+            if min(child.weight.shape) >= min_features:
+                return Int8Linear(child, None)
         elif type(child) is Conv2D and child._data_format == "NCHW":
             if child.weight.shape[1] >= min_features // 4:
-                repl = Int8Conv2D(child, None)
-        if repl is not None:
-            model._sub_layers[name] = repl
-        else:
-            weight_only_int8(child, min_features, inplace=True)
-    return model
+                return Int8Conv2D(child, None)
+        return None
+
+    return swap_layers(model, factory, inplace=inplace)

@@ -1,0 +1,53 @@
+"""Weight-only int4 serving layers (round 5): group quantization
+error bounds, layer parity vs fp32, swap traversal."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import nn
+from paddle_tpu.quantization import Int4Linear, weight_only_int4
+from paddle_tpu.quantization.int4_layers import quantize_weight_int4
+
+
+def test_quantize_roundtrip_error_bounded():
+    rng = np.random.RandomState(0)
+    w = rng.randn(256, 64).astype(np.float32)
+    q, s = quantize_weight_int4(w, group=128)
+    assert q.min() >= -7 and q.max() <= 7
+    deq = (q.reshape(2, 128, 64) * s[:, None, :]).reshape(256, 64)
+    # 4-bit symmetric: per-element error <= scale/2 = absmax/14
+    err = np.abs(deq - w)
+    bound = np.repeat(s, 128, axis=0) / 2 + 1e-6
+    assert (err <= bound).all()
+
+
+def test_int4_linear_close_to_fp32():
+    rng = np.random.RandomState(1)
+    lin = nn.Linear(256, 128)
+    x = paddle.to_tensor(rng.randn(4, 256).astype(np.float32))
+    ref = lin(x).numpy()
+    q = Int4Linear(lin, group=128)
+    got = q(x).numpy()
+    got, ref = np.asarray(got), np.asarray(ref)
+    rel = np.abs(got - ref).mean() / (np.abs(ref).mean() + 1e-9)
+    # 4-bit symmetric, group 128: weight RMS err ~ scale/sqrt(12) ~ 7%
+    # of weight RMS; the matmul's cancellation inflates mean-abs
+    # relative error — correlation is the meaningful fidelity metric
+    assert rel < 0.2, rel
+    corr = np.corrcoef(got.ravel(), ref.ravel())[0, 1]
+    assert corr > 0.99, corr
+
+
+def test_weight_only_int4_swaps_big_layers_only():
+    m = nn.Sequential(nn.Linear(512, 512), nn.ReLU(),
+                      nn.Linear(16, 16))
+    m2 = weight_only_int4(m, inplace=False)
+    kinds = [type(l).__name__ for l in m2]
+    assert kinds[0] == "Int4Linear" and kinds[2] == "Linear"
+    # original untouched (inplace=False)
+    assert type(m[0]).__name__ == "Linear"
+
+
+def test_group_must_divide():
+    with pytest.raises(ValueError):
+        quantize_weight_int4(np.zeros((100, 8), np.float32), group=128)

@@ -237,7 +237,8 @@ def test_weight_only_int8_swaps_and_preserves():
         def forward(self, x, y):
             return self.big(x).sum() + self.small(y).sum()
 
-    m = Net()
+    paddle.seed(11)  # ``ref`` is a near-cancelling SUM: the relative
+    m = Net()        # tolerance is ambient-RNG sensitive without a pin
     x = paddle.to_tensor(
         np.random.RandomState(0).randn(3, 256).astype(np.float32))
     y = paddle.to_tensor(
@@ -265,7 +266,8 @@ def test_weight_only_int8_llama_greedy_parity():
                             num_hidden_layers=2,
                             num_attention_heads=4,
                             intermediate_size=512)
-    m = LlamaForCausalLM(cfg)
+    paddle.seed(7)   # pin init: greedy agreement on random weights is
+    m = LlamaForCausalLM(cfg)  # threshold-sensitive to ambient RNG
     m.eval()
     ids = paddle.to_tensor(np.random.RandomState(0).randint(
         0, 256, (1, 16)).astype(np.int64))
