@@ -20,7 +20,7 @@ from .wrapper import (  # noqa: F401
 from .int8_layers import (  # noqa: F401
     Int8Linear, Int8Conv2D, weight_only_int8)
 from .int4_layers import (  # noqa: F401
-    Int4Linear, weight_only_int4)
+    Int4Linear, pack_rows_int4, quantize_int4_rows, weight_only_int4)
 
 __all__ = [
     "QuantConfig", "SingleLayerConfig", "AbsmaxObserver", "AVGObserver",
@@ -28,5 +28,6 @@ __all__ = [
     "FakeQuanterChannelWiseAbsMaxObserver", "QAT", "PTQ",
     "ObserveWrapper", "QuantedLinear", "QuantedConv2D", "quant_dequant",
     "Int8Linear", "Int8Conv2D", "weight_only_int8",
-    "Int4Linear", "weight_only_int4",
+    "Int4Linear", "weight_only_int4", "quantize_int4_rows",
+    "pack_rows_int4",
 ]

@@ -2,13 +2,14 @@
 
 Reference analog: the weight_only_quant int4 pass family under
 paddle/fluid/inference (analysis_predictor.h int8/int4 story) and
-llm.int4-style serving. Decode at small batch is WEIGHT-READ-bound
-(benchmarks/RESULTS.md: int8 already converts halved bytes into 1.83x
-bs1 tokens/s); int4 halves the bytes again. TPU-native storage is
-``jnp.int4`` — XLA packs two nibbles per byte in HBM and the convert
-fuses into the consuming dot's operand read — with per-GROUP symmetric
-scales along the contraction dim (group ~128) to hold accuracy at
-4-bit.
+llm.int4-style serving. Storage is EXPLICIT uint8 nibble packing
+(ops/int4_matmul.pack_rows_int4 halves layout) consumed by the fused
+Pallas unpack-matmul kernel; per-GROUP symmetric scales along the
+contraction dim hold accuracy at 4-bit. NOTE the measured verdict
+(benchmarks/RESULTS.md round-5): on v5e the VPU unpack cost exceeds
+the halved-HBM saving, so int4 decode is SLOWER than the int8-MXU
+path — these layers earn their keep on memory capacity (2x model per
+chip), not latency.
 """
 from __future__ import annotations
 
@@ -20,65 +21,62 @@ import jax.numpy as jnp
 from ..framework.tensor import Tensor, apply_op
 from ..nn.layer_base import Layer
 
-__all__ = ["Int4Linear", "weight_only_int4"]
+from ..ops.int4_matmul import (  # noqa: F401  (re-exports)
+    pack_rows_int4, quantize_int4_rows)
 
-
-def quantize_weight_int4(w: np.ndarray, group: int):
-    """[in, out] float -> (q int4-valued int8 [in, out],
-    scales f32 [n_groups, out]); symmetric, q in [-7, 7]."""
-    in_f, out_f = w.shape
-    if in_f % group:
-        raise ValueError(f"in_features {in_f} % group {group} != 0")
-    g = in_f // group
-    wg = w.reshape(g, group, out_f).astype(np.float32)
-    scale = np.abs(wg).max(axis=1) / 7.0          # [g, out]
-    scale = np.where(scale == 0.0, 1.0, scale)
-    q = np.clip(np.round(wg / scale[:, None, :]), -7, 7)
-    return q.reshape(in_f, out_f).astype(np.int8), scale
+__all__ = ["Int4Linear", "weight_only_int4", "quantize_int4_rows",
+           "pack_rows_int4"]
 
 
 class Int4Linear(Layer):
-    """Weight-only int4 linear: bf16 activations, int4 weights
-    dequantized group-wise on the operand read (no bf16 weight copy
-    ever lands in HBM)."""
+    """Weight-only int4 linear: weights stored as PACKED uint8 nibble
+    pairs (0.5 B/weight in HBM — the axon backend cannot materialize
+    S4 buffers eagerly, so packing is explicit), unpacked + dequantized
+    INSIDE the Pallas matmul kernel (ops/int4_matmul.py). A plain XLA
+    unpack lowering materializes the bf16 weight copy per call and
+    measured 5x SLOWER than bf16 decode — the fused kernel is the
+    whole point."""
 
     def __init__(self, source, group: int = 128):
         super().__init__()
+        from ..ops.int4_matmul import pack_rows_int4, quantize_int4_rows
         w = np.asarray(source.weight.numpy())      # [in, out]
-        q, scale = quantize_weight_int4(w, group)
+        if (w.shape[0] // 2) % group:
+            # halves packing needs group | K/2; fall back to a group
+            # size that divides (still int4, coarser scaling)
+            group = int(np.gcd(w.shape[0] // 2, group))
+        q, scale = quantize_int4_rows(w, group)
         self.group = group
         self._in, self._out = w.shape
-        self.register_buffer("wq", Tensor(jnp.asarray(q, jnp.int4)))
+        self.register_buffer("wq",
+                             Tensor(jnp.asarray(pack_rows_int4(q))))
         self.register_buffer("w_scale",
                              Tensor(jnp.asarray(scale, jnp.float32)))
         self.bias = source.bias
 
     def forward(self, x):
-        group, in_f, out_f = self.group, self._in, self._out
-        g = in_f // group
+        from ..ops.int4_matmul import int4_matmul
+        in_f, out_f = self._in, self._out
+        group = self.group
 
         def f(x, wq, ws, b):
-            # per-group matmul: [..., g, group] x [g, group, out],
-            # scales applied to the PARTIAL sums — the int4->bf16
-            # convert stays fused into the dot operand, so HBM reads
-            # remain 0.5 B/weight
-            # bf16 on TPU (MXU dtype); f32 on CPU tests (the CPU
-            # backend's DotThunk rejects bf16 x bf16 -> f32)
-            cd = jnp.bfloat16 if jax.default_backend() in (
-                "tpu", "axon") else jnp.float32
-            xg = x.reshape(x.shape[:-1] + (g, group)).astype(cd)
-            wg = wq.reshape(g, group, out_f).astype(cd)
-            part = jnp.einsum("...gk,gko->...go", xg, wg,
-                              preferred_element_type=jnp.float32)
-            y = jnp.sum(part * ws, axis=-2)     # ws [g, out] broadcasts
+            lead = x.shape[:-1]
+            x2 = x.reshape((-1, in_f))
+            y = int4_matmul(x2, wq, ws, group=group)
+            y = y.reshape(lead + (out_f,))
             if b is not None:
-                y = y + b.astype(jnp.float32)
+                y = y + b.astype(y.dtype)
             return y.astype(x.dtype)
 
         args = [x, self.wq, self.w_scale,
                 self.bias if self.bias is not None else None]
         if isinstance(x, Tensor):
-            return apply_op(f, *args, _op_name="int4_linear")
+            # inference-only layer (like the reference's weight-only
+            # pass output): the Pallas kernel has no vjp, so the call
+            # never records on the tape
+            from ..framework.tensor import no_grad
+            with no_grad():
+                return apply_op(f, *args, _op_name="int4_linear")
         return f(x, getattr(self.wq, "_data", self.wq),
                  getattr(self.w_scale, "_data", self.w_scale),
                  getattr(self.bias, "_data", self.bias)

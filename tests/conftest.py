@@ -18,3 +18,49 @@ if "host_platform_device_count" not in flags:
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+# ---------------------------------------------------------------------------
+# Test tiers (round-5 verdict #10): `pytest -m "not full" tests/` is the
+# SMOKE tier (~5 min on a 1-core host — every subsystem touched once);
+# the unmarked default runs everything (>50 min on 1 core). Files listed
+# here auto-receive the `full` marker: e2e/multi-process suites, big op
+# matrices, and numerics batteries whose value is breadth, not speed.
+# ---------------------------------------------------------------------------
+import pytest  # noqa: E402
+
+_FULL_TIER_FILES = {
+    # multi-process / e2e orchestration
+    "test_elastic_e2e.py", "test_multiproc_checkpoint.py",
+    "test_dist_model_mp.py", "test_bert_distmodel.py",
+    "test_dataloader_workers.py", "test_incubate_multiprocessing.py",
+    "test_ps_ssd_graph.py", "test_store_rpc.py",
+    # big model-level suites (minutes each on 1 core)
+    "test_moe_gpt.py", "test_llama.py", "test_ppyoloe.py",
+    "test_vision_models.py", "test_auto_capture_zoo.py",
+    "test_download_pretrained.py",
+    # op matrices / numerics batteries
+    "test_op_suite.py", "test_op_suite_nn_linalg.py",
+    "test_op_rows_extras.py", "test_ops_extras.py",
+    "test_nn_extras.py", "test_distribution_numeric.py",
+    "test_distribution_grads.py", "test_rnn_numeric.py",
+    # pipeline schedule batteries (every schedule x factorization)
+    "test_pipeline_scheduled.py", "test_pipeline_schedules.py",
+    "test_pipeline_1f1b.py", "test_reshard_transitions.py",
+    # compile-heavy
+    "test_scaling_model.py", "test_benchmarks_smoke.py",
+    "test_sot_partial.py", "test_quant_pallas.py",
+}
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "full: slow/e2e tests excluded from the smoke tier "
+        "(run smoke with -m 'not full')")
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if os.path.basename(str(item.fspath)) in _FULL_TIER_FILES:
+            item.add_marker(pytest.mark.full)

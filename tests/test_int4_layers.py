@@ -6,19 +6,32 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu import nn
 from paddle_tpu.quantization import Int4Linear, weight_only_int4
-from paddle_tpu.quantization.int4_layers import quantize_weight_int4
+from paddle_tpu.ops.int4_matmul import quantize_int4_rows
 
 
 def test_quantize_roundtrip_error_bounded():
     rng = np.random.RandomState(0)
     w = rng.randn(256, 64).astype(np.float32)
-    q, s = quantize_weight_int4(w, group=128)
+    q, s = quantize_int4_rows(w, group=128)
     assert q.min() >= -7 and q.max() <= 7
     deq = (q.reshape(2, 128, 64) * s[:, None, :]).reshape(256, 64)
     # 4-bit symmetric: per-element error <= scale/2 = absmax/14
     err = np.abs(deq - w)
     bound = np.repeat(s, 128, axis=0) / 2 + 1e-6
     assert (err <= bound).all()
+
+
+def test_int4_matmul_rejects_group_not_dividing_half():
+    import jax.numpy as jnp
+    import pytest as _pytest
+    from paddle_tpu.ops.int4_matmul import (int4_matmul, pack_rows_int4,
+                                            quantize_int4_rows)
+    w = np.random.RandomState(0).randn(384, 128).astype(np.float32)
+    q, s = quantize_int4_rows(w, group=128)     # 128 | 384 but not 192
+    packed = pack_rows_int4(q)
+    with _pytest.raises(ValueError, match="K//2"):
+        int4_matmul(jnp.ones((2, 384), jnp.float32),
+                    jnp.asarray(packed), jnp.asarray(s), group=128)
 
 
 def test_int4_linear_close_to_fp32():
@@ -50,4 +63,35 @@ def test_weight_only_int4_swaps_big_layers_only():
 
 def test_group_must_divide():
     with pytest.raises(ValueError):
-        quantize_weight_int4(np.zeros((100, 8), np.float32), group=128)
+        quantize_int4_rows(np.zeros((100, 8), np.float32), group=128)
+
+
+def test_pack_rows_roundtrip():
+    from paddle_tpu.ops.int4_matmul import pack_rows_int4
+    rng = np.random.RandomState(2)
+    q = rng.randint(-7, 8, (64, 16)).astype(np.int8)
+    p = pack_rows_int4(q)
+    assert p.shape == (32, 16) and p.dtype == np.uint8
+    hi = (p.astype(np.int16) >> 4) - 8           # rows 0..32
+    lo = (p.astype(np.int16) & 0xF) - 8          # rows 32..64
+    np.testing.assert_array_equal(hi, q[:32])
+    np.testing.assert_array_equal(lo, q[32:])
+
+
+def test_int4_matmul_kernel_matches_dequant_reference():
+    import jax.numpy as jnp
+    from paddle_tpu.ops.int4_matmul import (int4_matmul, pack_rows_int4,
+                                            quantize_int4_rows)
+    rng = np.random.RandomState(3)
+    B, K, N, group = 4, 256, 384, 64
+    w = rng.randn(K, N).astype(np.float32)
+    x = rng.randn(B, K).astype(np.float32)
+    q, s = quantize_int4_rows(w, group)
+    packed = pack_rows_int4(q)
+    got = np.asarray(int4_matmul(jnp.asarray(x), jnp.asarray(packed),
+                                 jnp.asarray(s), group=group,
+                                 block_n=128))
+    deq = (q.reshape(K // group, group, N)
+           * s[:, None, :]).reshape(K, N)
+    ref = x @ deq
+    np.testing.assert_allclose(got, ref, rtol=2e-4, atol=2e-3)
