@@ -241,6 +241,7 @@ class GPTSpmdTrainer:
                  layer_unroll: int = 1,
                  ce_chunks: int = 16,
                  ce_int8: bool = False,
+                 fuse_gelu_quant: Optional[bool] = None,
                  lr_schedule=None,
                  int8_guard_period: int = 0,
                  int8_guard_threshold: float = 0.10):
@@ -361,6 +362,19 @@ class GPTSpmdTrainer:
         # it feeds the tied embedding's Adam state). ~31 ms of head
         # matmuls at the flagship shape; earn/reject via parity_int8.
         self.ce_int8 = bool(ce_int8)
+        # producer-fused gelu->quantize for the ffn2 site (round-5
+        # lever d); auto-on for the all-int8 recipe. Note: removes the
+        # standalone "ffn_act" residual, so policies that SAVE ffn_act
+        # (save_attn_ffn) force it off.
+        if fuse_gelu_quant and quant8 != "wgrad":
+            raise ValueError(
+                "fuse_gelu_quant rides the all-int8 recipe: it needs "
+                "quant8='wgrad' (the fused op quantizes both the fwd "
+                "row and the wgrad SR column streams)")
+        if fuse_gelu_quant is None:
+            fuse_gelu_quant = quant8 == "wgrad"
+        self.fuse_gelu_quant = bool(fuse_gelu_quant) and \
+            remat != "save_attn_ffn"
         if self.moe_experts and mesh.shape["pipe"] > 1 \
                 and self.pipeline_schedule == "gpipe":
             raise NotImplementedError(
@@ -490,12 +504,9 @@ class GPTSpmdTrainer:
         # preferred_element_type=f32 + cast). ``site`` decorrelates the
         # SR streams of the three matmul sites in a block (wgrad mode).
         if self.quant8 == "wgrad":
-            from ..ops.quant_matmul import int8_linear_all8
-            s = jnp.int32(1) if seed is None else seed
-            # layer seeds arrive 16 apart (_stage_fn), so *8+site keeps
-            # (layer, site) streams distinct; int32 wrap just mixes
+            from ..ops.quant_matmul import int8_linear_all8, site_seed
             return lambda a, w, site=0: int8_linear_all8(
-                a, w, s * jnp.int32(8) + jnp.int32(site))
+                a, w, site_seed(seed, site))
         if self.quant8 == "dgrad":
             from ..ops.quant_matmul import int8_linear_dgrad8
             return lambda a, w, site=0: int8_linear_dgrad8(a, w)
@@ -556,9 +567,18 @@ class GPTSpmdTrainer:
         a = mm(h, bp["win"].astype(x.dtype), 2)
         a = a + bp["bin"].astype(x.dtype)
         a = checkpoint_name(a, "ffn1_out")  # pre-gelu: gelu vjp needs it
-        a = jax.nn.gelu(a, approximate=True)
-        a = checkpoint_name(a, "ffn_act")
-        o = mm(a, bp["wout"].astype(x.dtype), 3)
+        if self.quant8 == "wgrad" and self.fuse_gelu_quant:
+            # round-5 lever d: gelu computed INSIDE the ffn2 quantize
+            # kernels (fwd rowq + wgrad SR colq) — the bf16 gelu output
+            # never lands in HBM and the quantizers stop re-reading it
+            from ..ops.quant_matmul import (int8_gelu_linear_all8,
+                                            site_seed)
+            o = int8_gelu_linear_all8(a, bp["wout"].astype(x.dtype),
+                                      site_seed(seed, 3))
+        else:
+            a = jax.nn.gelu(a, approximate=True)
+            a = checkpoint_name(a, "ffn_act")
+            o = mm(a, bp["wout"].astype(x.dtype), 3)
         o = checkpoint_name(o, "ffn2_out")
         x = x + o + bp["bout"].astype(x.dtype)
         return act(x, _spec(self.mesh, "data", "sep", None))

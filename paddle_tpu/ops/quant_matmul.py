@@ -27,9 +27,19 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 __all__ = ["int8_linear", "int8_linear_dgrad8", "int8_linear_all8",
-           "int8_dot_dequant",
+           "int8_gelu_linear_all8", "int8_dot_dequant",
            "quantize_rowwise", "quantize_rowwise_fast",
-           "sr_quantize_colwise"]
+           "sr_quantize_colwise", "site_seed"]
+
+
+def site_seed(seed, site: int):
+    """The (layer, site) SR-stream derivation used by EVERY int8 block
+    matmul: layer seeds arrive 16 apart (_layer_seeds), so seed*8+site
+    keeps streams distinct; int32 wrap just mixes. One definition —
+    _mm's closure and the fused gelu site both call this."""
+    import jax.numpy as _jnp
+    s = _jnp.int32(1) if seed is None else seed
+    return s * _jnp.int32(8) + _jnp.int32(site)
 
 
 def quantize_rowwise(x, axis):
@@ -53,8 +63,22 @@ def quantize_rowwise(x, axis):
 # step (benchmarks/RESULTS.md round-3 decomposition), roughly half of
 # which is the second read this kernel removes.
 
-def _rowq_kernel(x_ref, q_ref, s_ref):
-    x = x_ref[...].astype(jnp.float32)                     # [bm, K]
+def _apply_act(x, act):
+    """Producer-fused activation inside the quantize kernels: the
+    activation's own HBM write + the quantizer's re-read disappear
+    (round-5 lever d: ~27 ms of gelu+rowq+colq passes on the GPT step
+    touch the same [6144, 8192] tensor three times without this)."""
+    if act is None:
+        return x
+    if act == "gelu":
+        # tanh-approximate gelu, matching jax.nn.gelu(approximate=True)
+        c = jnp.float32(0.7978845608028654)      # sqrt(2/pi)
+        return 0.5 * x * (1.0 + jnp.tanh(c * (x + 0.044715 * x * x * x)))
+    raise ValueError(f"unsupported fused act {act!r}")
+
+
+def _rowq_kernel(x_ref, q_ref, s_ref, *, act=None):
+    x = _apply_act(x_ref[...].astype(jnp.float32), act)    # [bm, K]
     amax = jnp.max(jnp.abs(x), axis=1, keepdims=True)
     scale = jnp.where(amax == 0.0, 1.0, amax) / 127.0
     q_ref[...] = jnp.clip(jnp.round(x / scale), -127, 127) \
@@ -78,12 +102,12 @@ def _pick_block(rows: int, row_bytes: int, budget: int = 2 << 20) -> int:
     return 0
 
 
-@functools.partial(jax.jit, static_argnums=(1,))
-def _rowq_call(x2, interpret):
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _rowq_call(x2, interpret, act=None):
     M, K = x2.shape
     bm = _pick_block(M, K * x2.dtype.itemsize)
     kernel = pl.pallas_call(
-        _rowq_kernel, grid=(M // bm,),
+        functools.partial(_rowq_kernel, act=act), grid=(M // bm,),
         in_specs=[pl.BlockSpec((bm, K), lambda i: (i, 0))],
         out_specs=[pl.BlockSpec((bm, K), lambda i: (i, 0)),
                    pl.BlockSpec((bm, 1), lambda i: (i, 0))],
@@ -108,17 +132,25 @@ def _colq_call(x2, interpret):
     return kernel(x2)
 
 
-def quantize_rowwise_fast(x, axis, interpret=None):
+def quantize_rowwise_fast(x, axis, interpret=None, act=None):
     """quantize_rowwise with a single-pass Pallas kernel where the
     layout permits (TPU backend, lane-aligned reduced dim, divisible
-    row count); falls back to the XLA version otherwise."""
+    row count); falls back to the XLA version otherwise. ``act``
+    applies a producer-fused activation (see _apply_act) before
+    quantizing — one read of x instead of act-write + quantize-read."""
+    def _fallback(x, axis):
+        if act is not None:
+            # f32 like the Pallas kernel, so the two paths quantize
+            # the same values (bit-identical across eligibility)
+            x = _apply_act(x.astype(jnp.float32), act).astype(x.dtype)
+        return quantize_rowwise(x, axis)
     if interpret is None:
         # single-device TPU only: under GSPMD the pallas_call is an
         # opaque custom call the partitioner would replicate, so
         # multi-device meshes keep the (partitionable) XLA fusion path
         if jax.default_backend() not in ("tpu", "axon") \
                 or jax.device_count() != 1:
-            return quantize_rowwise(x, axis)
+            return _fallback(x, axis)
         interpret = False
     axis = axis % x.ndim
     if axis == x.ndim - 1:
@@ -128,14 +160,14 @@ def quantize_rowwise_fast(x, axis, interpret=None):
         for s in lead:
             M *= s
         if K % 128 == 0 and _pick_block(M, K * x.dtype.itemsize):
-            q, s = _rowq_call(x.reshape(M, K), interpret)
+            q, s = _rowq_call(x.reshape(M, K), interpret, act)
             return q.reshape(x.shape), s.reshape(lead + (1,))
-    elif axis == 0 and x.ndim == 2:
+    elif axis == 0 and x.ndim == 2 and act is None:
         K, N = x.shape
         if N % 128 == 0 and K % 8 == 0 \
                 and _pick_block(N, K * x.dtype.itemsize):
             return _colq_call(x, interpret)
-    return quantize_rowwise(x, axis)
+    return _fallback(x, axis)
 
 
 def int8_dot_dequant(aq, a_scale, bq, b_scale, dims):
@@ -225,9 +257,9 @@ int8_linear_dgrad8.defvjp(_fwd8, _bwd8)
 # seed, drawn in-kernel from the TPU hardware PRNG (no HBM rng buffer —
 # the XLA lowering would write+read a full uint32 buffer per operand).
 
-def _colq_sr_kernel(seed_ref, x_ref, q_ref, s_ref):
+def _colq_sr_kernel(seed_ref, x_ref, q_ref, s_ref, *, act=None):
     from jax.experimental.pallas import tpu as pltpu
-    x = x_ref[...].astype(jnp.float32)                     # [M, bn]
+    x = _apply_act(x_ref[...].astype(jnp.float32), act)    # [M, bn]
     amax = jnp.max(jnp.abs(x), axis=0, keepdims=True)
     scale = jnp.where(amax == 0.0, 1.0, amax) / 127.0
     pltpu.prng_seed(seed_ref[0], pl.program_id(0))
@@ -239,8 +271,8 @@ def _colq_sr_kernel(seed_ref, x_ref, q_ref, s_ref):
     s_ref[...] = scale
 
 
-@functools.partial(jax.jit, static_argnums=(2,))
-def _sr_colq_pallas(x2, seed_i, interpret):
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _sr_colq_pallas(x2, seed_i, interpret, act=None):
     """Column-wise (per output channel) symmetric int8 SR quantize of
     [M, C] in ONE read of x: full-column blocks (M x 128 lanes) hold
     the whole reduction in VMEM, so amax, SR bits, and the cast happen
@@ -254,7 +286,7 @@ def _sr_colq_pallas(x2, seed_i, interpret):
     bn = 256 if (C % 256 == 0 and M * 256 * 4 * 9 // 2 <= (15 << 20)) \
         else 128
     kernel = pl.pallas_call(
-        _colq_sr_kernel, grid=(C // bn,),
+        functools.partial(_colq_sr_kernel, act=act), grid=(C // bn,),
         in_specs=[pl.BlockSpec(memory_space=pltpu_smem()),
                   pl.BlockSpec((M, bn), lambda j: (0, j))],
         out_specs=[pl.BlockSpec((M, bn), lambda j: (0, j)),
@@ -270,8 +302,10 @@ def pltpu_smem():
     return pltpu.SMEM
 
 
-def _sr_colq_xla(x2, seed_i):
+def _sr_colq_xla(x2, seed_i, act=None):
     """Portable SR column quantize (CPU tests / ineligible layouts)."""
+    if act is not None:
+        x2 = _apply_act(x2.astype(jnp.float32), act)
     amax = jnp.max(jnp.abs(x2.astype(jnp.float32)), axis=0,
                    keepdims=True)
     scale = jnp.where(amax == 0.0, 1.0, amax) / 127.0
@@ -283,15 +317,16 @@ def _sr_colq_xla(x2, seed_i):
     return q, scale
 
 
-def sr_quantize_colwise(x2, seed_i):
-    """Unbiased int8 quantize of [M, C] with per-column scales."""
+def sr_quantize_colwise(x2, seed_i, act=None):
+    """Unbiased int8 quantize of [M, C] with per-column scales;
+    ``act`` fuses an activation before quantization (one read)."""
     M, C = x2.shape
     if jax.default_backend() in ("tpu", "axon") \
             and jax.device_count() == 1 \
             and C % 128 == 0 and M % 8 == 0 \
             and M * 128 * 4 * 9 // 2 <= (15 << 20):
-        return _sr_colq_pallas(x2, seed_i, False)
-    return _sr_colq_xla(x2, seed_i)
+        return _sr_colq_pallas(x2, seed_i, False, act)
+    return _sr_colq_xla(x2, seed_i, act)
 
 
 @jax.custom_vjp
@@ -337,3 +372,59 @@ def _bwd_all8(res, g):
 
 
 int8_linear_all8.defvjp(_fwd_all8, _bwd_all8)
+
+
+@jax.custom_vjp
+def int8_gelu_linear_all8(x, w, seed):
+    """``int8_linear_all8(gelu(x), w, seed)`` with the gelu computed
+    INSIDE the quantize kernels (round-5 lever d): x here is the
+    PRE-activation (the saved ffn1 residual). Forward and wgrad each
+    read x once and never materialize the bf16 gelu output; dgrad
+    chains through gelu' outside (one fused elementwise)."""
+    del seed
+    return _int8_matmul_gelu(x, w)
+
+
+def _int8_matmul_gelu(x, w):
+    xq, xs = quantize_rowwise_fast(x, axis=-1, act="gelu")
+    wq, ws = quantize_rowwise_fast(w, axis=0)
+    y = int8_dot_dequant(xq, xs, wq, ws, ((x.ndim - 1,), (0,)))
+    return y.astype(x.dtype)
+
+
+def _fwd_gelu_all8(x, w, seed):
+    return _int8_matmul_gelu(x, w), (x, w, seed)
+
+
+def _bwd_gelu_all8(res, g):
+    x, w, seed = res
+    # dgrad w.r.t. a = gelu(x): int8 per-row, as int8_linear_all8
+    gq, gs = quantize_rowwise_fast(g, axis=-1)
+    wq, ws = quantize_rowwise_fast(w, axis=1)
+    y = jax.lax.dot_general(gq, wq, (((g.ndim - 1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.int32)
+    da = (y.astype(jnp.float32) * gs *
+          jnp.reshape(ws, (1,) * (g.ndim - 1) + (-1,)))
+    # chain through gelu' (tanh approximation, matching _apply_act)
+    _, gelu_vjp = jax.vjp(
+        lambda t: jax.nn.gelu(t.astype(jnp.float32), approximate=True),
+        x)
+    dx = gelu_vjp(da)[0]
+    # wgrad: SR int8 of a = gelu(x), fused in the colq kernel
+    K = x.shape[-1]
+    N = g.shape[-1]
+    x2 = x.reshape(-1, K)
+    g2 = g.reshape(-1, N)
+    base = jnp.asarray(seed, jnp.int32) * jnp.int32(1000003)
+    aq, as_ = sr_quantize_colwise(x2, base + jnp.int32(7919),
+                                  act="gelu")
+    gq2, gs2 = sr_quantize_colwise(g2, base + jnp.int32(104729))
+    dwi = jax.lax.dot_general(aq, gq2, (((0,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    dw = dwi.astype(jnp.float32) * as_.reshape(K, 1) * gs2
+    import numpy as np
+    return (dx.astype(x.dtype), dw.astype(w.dtype),
+            np.zeros((), jax.dtypes.float0))
+
+
+int8_gelu_linear_all8.defvjp(_fwd_gelu_all8, _bwd_gelu_all8)

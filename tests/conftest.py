@@ -50,6 +50,9 @@ _FULL_TIER_FILES = {
     # compile-heavy
     "test_scaling_model.py", "test_benchmarks_smoke.py",
     "test_sot_partial.py", "test_quant_pallas.py",
+    # measured >30s each on the 1-core host (--durations, r5)
+    "test_fft_signal_utils.py", "test_baseline_configs.py",
+    "test_int8_guard.py", "test_fused_ce.py",
 }
 
 

@@ -183,3 +183,50 @@ def test_wgrad_microbatches_fold_seed():
     for _ in range(2):
         loss = tr.train_step(ids, labels)
     assert np.isfinite(float(jax.device_get(loss)))
+
+
+# -- round-5 producer-fused gelu->quantize (lever d) -------------------
+
+def test_act_fused_rowq_matches_gelu_then_quant():
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.quant_matmul import (quantize_rowwise,
+                                             quantize_rowwise_fast)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16, 256).astype(np.float32))
+    q1, s1 = quantize_rowwise_fast(x, axis=-1, act="gelu",
+                                   interpret=True)
+    q2, s2 = quantize_rowwise(jax.nn.gelu(x, approximate=True), -1)
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s2),
+                               rtol=1e-5)
+    # rounding at +-0.5 boundaries may flip the odd value
+    assert (np.asarray(q1) == np.asarray(q2)).mean() > 0.999
+
+
+def test_int8_gelu_linear_all8_matches_unfused():
+    """Fused gelu+int8 matmul == int8_linear_all8(gelu(x)) in fwd and
+    grads (same seeds -> same SR streams on the wgrad side; dgrad adds
+    the gelu' chain)."""
+    import jax
+    import jax.numpy as jnp
+    from paddle_tpu.ops.quant_matmul import (int8_gelu_linear_all8,
+                                             int8_linear_all8)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(32, 128).astype(np.float32))
+    w = jnp.asarray(rng.randn(128, 192).astype(np.float32) * 0.1)
+    seed = jnp.int32(17)
+
+    def fused(x, w):
+        return (int8_gelu_linear_all8(x, w, seed) ** 2).sum()
+
+    def unfused(x, w):
+        a = jax.nn.gelu(x, approximate=True)
+        return (int8_linear_all8(a, w, seed) ** 2).sum()
+
+    f1, (gx1, gw1) = jax.value_and_grad(fused, argnums=(0, 1))(x, w)
+    f2, (gx2, gw2) = jax.value_and_grad(unfused, argnums=(0, 1))(x, w)
+    np.testing.assert_allclose(float(f1), float(f2), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(gw1), np.asarray(gw2),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(gx1), np.asarray(gx2),
+                               rtol=1e-3, atol=1e-4)
