@@ -36,15 +36,16 @@ def _choose_block(seq_len: int, target: int = 0,
     different reuse patterns, so their optima differ (the step-level
     sweep lives in benchmarks/).
 
-    Default (round-5 step-level sweep, RESULTS.md): whole-sequence
-    blocks up to 1024 — at S=1024 fwd+bwd all-1024 measures 348 ms/step
-    vs 373 at the old 512 default (fewer grid steps, no online-softmax
-    carry rescaling, and the PV matmul's contraction grows to S). Past
-    1024 the S² fp32 score block would pressure VMEM; 512 stays the
-    default there (the r4 S=2048 sweep: 512 beat 256/1024)."""
+    Default (round-5 step-level sweeps, RESULTS.md): 1024 blocks
+    everywhere — at S=1024 fwd+bwd all-1024 measures 348 ms/step vs
+    373 at the old 512 default (fewer grid steps, no online-softmax
+    carry rescaling, the PV matmul's contraction grows with the
+    block), and the S=2048 re-sweep with SEPARATE fwd/bwd knobs also
+    prefers 1024 (407 vs 419 ms/step; the r4 '512 wins at 2048'
+    result was an artifact of the single shared knob)."""
     import os
     if target <= 0:
-        target = seq_len if seq_len <= 1024 else 512
+        target = min(seq_len, 1024)
     names = {"fwd_q": ("PTPU_FLASH_BQ",),
              "fwd_k": ("PTPU_FLASH_BK",),
              "bwd_q": ("PTPU_FLASH_BWD_BQ", "PTPU_FLASH_BQ"),
