@@ -31,6 +31,9 @@ def main():
     ap.add_argument("--ce-chunks", type=int, default=16)
     ap.add_argument("--ce-int8", action="store_true")
     ap.add_argument("--no-fused-opt", action="store_true")
+    ap.add_argument("--fuse-ln", default="off",
+                    choices=["off", "both", "qkv", "ffn1"])
+    ap.add_argument("--no-fuse-gelu", action="store_true")
     ap.add_argument("--compile-only", action="store_true")
     args = ap.parse_args()
 
@@ -55,7 +58,10 @@ def main():
         layer_unroll=args.unroll,
         ce_chunks=args.ce_chunks,
         ce_int8=args.ce_int8,
-        fused_optimizer=False if args.no_fused_opt else None)
+        fused_optimizer=False if args.no_fused_opt else None,
+        fuse_ln_quant={"off": False, "both": True, "qkv": "qkv",
+                       "ffn1": "ffn1"}[args.fuse_ln],
+        fuse_gelu_quant=False if args.no_fuse_gelu else None)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size,
                       (args.bs, args.seq)).astype(np.int32)

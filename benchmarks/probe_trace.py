@@ -26,6 +26,8 @@ def main():
     ap.add_argument("--top", type=int, default=25)
     ap.add_argument("--raw", action="store_true",
                     help="dump every op, not just top-N + buckets")
+    ap.add_argument("--fuse-ln", action="store_true",
+                    help="enable the (default-off) LN->quantize fusion")
     args = ap.parse_args()
 
     import jax
@@ -44,7 +46,8 @@ def main():
                                  remat="save_qkv_ffn",
                                  moment_dtype=jnp.bfloat16,
                                  master_dtype=jnp.bfloat16,
-                                 quant8="wgrad", ce_chunks=1)
+                                 quant8="wgrad", ce_chunks=1,
+                                 fuse_ln_quant=args.fuse_ln)
         bs = args.bs or 6
         rng = np.random.RandomState(0)
         ids = rng.randint(0, cfg.vocab_size, (bs, 1024)).astype(np.int32)

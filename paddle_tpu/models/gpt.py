@@ -242,6 +242,7 @@ class GPTSpmdTrainer:
                  ce_chunks: int = 16,
                  ce_int8: bool = False,
                  fuse_gelu_quant: Optional[bool] = None,
+                 fuse_ln_quant: Optional[bool] = None,
                  lr_schedule=None,
                  int8_guard_period: int = 0,
                  int8_guard_threshold: float = 0.10):
@@ -375,6 +376,30 @@ class GPTSpmdTrainer:
             fuse_gelu_quant = quant8 == "wgrad"
         self.fuse_gelu_quant = bool(fuse_gelu_quant) and \
             remat != "save_attn_ffn"
+        # producer-fused LayerNorm->quantize for the qkv/ffn1 sites
+        # (round-5 lever a): same mechanism as fuse_gelu_quant — the
+        # rowq kernel computes LN stats + normalize + quantize in one
+        # read of the pre-LN residual; the wgrad colq kernel reuses the
+        # emitted [M,1] stats. Default OFF: measured a structural LOSS
+        # on the flagship step (337.4 -> 344-356 ms across full/qkv/
+        # ffn1/fwd-only variants) — the custom-call boundary breaks
+        # XLA's residual-add/bias/save fusions around each site, which
+        # costs more than the saved LN-output round-trip (trace diff in
+        # benchmarks/RESULTS.md; contrast fuse_gelu_quant, whose site
+        # feeds another custom call, not an XLA fusion).
+        if fuse_ln_quant and quant8 != "wgrad":
+            raise ValueError(
+                "fuse_ln_quant rides the all-int8 recipe: it needs "
+                "quant8='wgrad' (the fused op quantizes both the fwd "
+                "row and the wgrad SR column streams)")
+        if fuse_ln_quant is None:
+            fuse_ln_quant = False
+        # True = both sites; "qkv"/"ffn1" = that site only (A/B probes)
+        if fuse_ln_quant not in (True, False, "qkv", "ffn1"):
+            raise ValueError(
+                f"fuse_ln_quant must be True/False/'qkv'/'ffn1', got "
+                f"{fuse_ln_quant!r}")
+        self.fuse_ln_quant = fuse_ln_quant
         if self.moe_experts and mesh.shape["pipe"] > 1 \
                 and self.pipeline_schedule == "gpipe":
             raise NotImplementedError(
@@ -515,13 +540,19 @@ class GPTSpmdTrainer:
             return lambda a, w, site=0: int8_linear(a, w)
         return lambda a, w, site=0: jnp.einsum("btd,df->btf", a, w)
 
-    def _attn_sublayer(self, x, bp, mm, act):
+    def _attn_sublayer(self, x, bp, mm, act, seed=None):
         """ln1 + qkv + attention + proj + residual on [mb, T, D]."""
         cfg = self.cfg
         mb, T, D = x.shape
         H, dh = cfg.num_heads, cfg.head_dim
-        h = _layer_norm(x, bp["ln1_g"], bp["ln1_b"])
-        qkv = mm(h, bp["wqkv"].astype(x.dtype), 1)
+        if self.quant8 == "wgrad" and self.fuse_ln_quant in (True, "qkv"):
+            from ..ops.quant_matmul import int8_ln_linear_all8, site_seed
+            qkv = int8_ln_linear_all8(
+                x, bp["ln1_g"], bp["ln1_b"],
+                bp["wqkv"].astype(x.dtype), site_seed(seed, 1))
+        else:
+            h = _layer_norm(x, bp["ln1_g"], bp["ln1_b"])
+            qkv = mm(h, bp["wqkv"].astype(x.dtype), 1)
         qkv = qkv + bp["bqkv"].astype(x.dtype)
         qkv = checkpoint_name(qkv, "qkv_out")
         shape = self.mesh.shape
@@ -561,10 +592,16 @@ class GPTSpmdTrainer:
         """One transformer block on [mb, T, D] activations (GSPMD view)."""
         act = partial(jax.lax.with_sharding_constraint)
         mm = self._mm(seed)
-        x = self._attn_sublayer(x, bp, mm, act)
+        x = self._attn_sublayer(x, bp, mm, act, seed)
 
-        h = _layer_norm(x, bp["ln2_g"], bp["ln2_b"])
-        a = mm(h, bp["win"].astype(x.dtype), 2)
+        if self.quant8 == "wgrad" and self.fuse_ln_quant in (True, "ffn1"):
+            from ..ops.quant_matmul import int8_ln_linear_all8, site_seed
+            a = int8_ln_linear_all8(
+                x, bp["ln2_g"], bp["ln2_b"],
+                bp["win"].astype(x.dtype), site_seed(seed, 2))
+        else:
+            h = _layer_norm(x, bp["ln2_g"], bp["ln2_b"])
+            a = mm(h, bp["win"].astype(x.dtype), 2)
         a = a + bp["bin"].astype(x.dtype)
         a = checkpoint_name(a, "ffn1_out")  # pre-gelu: gelu vjp needs it
         if self.quant8 == "wgrad" and self.fuse_gelu_quant:
@@ -590,7 +627,7 @@ class GPTSpmdTrainer:
         from ..incubate.moe import moe_dispatch_combine
         act = partial(jax.lax.with_sharding_constraint)
         mm = self._mm(seed)
-        x = self._attn_sublayer(x, bp, mm, act)
+        x = self._attn_sublayer(x, bp, mm, act, seed)
         mb, T, D = x.shape
         E = self.moe_experts
 

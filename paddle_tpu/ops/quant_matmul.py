@@ -27,9 +27,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
 __all__ = ["int8_linear", "int8_linear_dgrad8", "int8_linear_all8",
-           "int8_gelu_linear_all8", "int8_dot_dequant",
+           "int8_gelu_linear_all8", "int8_ln_linear_all8",
+           "int8_dot_dequant",
            "quantize_rowwise", "quantize_rowwise_fast",
-           "sr_quantize_colwise", "site_seed"]
+           "ln_quantize_rowwise", "sr_quantize_colwise",
+           "sr_quantize_colwise_ln", "site_seed"]
 
 
 def site_seed(seed, site: int):
@@ -168,6 +170,156 @@ def quantize_rowwise_fast(x, axis, interpret=None, act=None):
                 and _pick_block(N, K * x.dtype.itemsize):
             return _colq_call(x, interpret)
     return _fallback(x, axis)
+
+
+# ---------------------------------------------------------------------------
+# producer-fused LayerNorm -> quantize (round-5 lever a)
+# ---------------------------------------------------------------------------
+# The qkv and ffn1 matmuls consume LayerNorm outputs. Unfused, each site
+# pays: LN reads x + writes h, then the rowq kernel re-reads h — three
+# HBM passes over a [6144, 2048] activation, twice per layer per
+# execution (forward + remat recompute). LN is row-wise and the rowq
+# kernel already holds full rows in VMEM, so stats + normalize + scale
+# + amax + cast collapse into ONE read of the pre-LN activation. The
+# wgrad SR column kernel cannot compute row stats from its column
+# blocks, so the row kernel also emits mean/rstd ([M,1] f32 — 24 KB at
+# the flagship shape) for the backward to reuse.
+
+_LN_EPS = 1e-5
+_FUSE_BWD_COLQ = False
+
+
+def _rowq_ln_kernel(x_ref, g_ref, b_ref, q_ref, s_ref, m_ref, r_ref):
+    x = x_ref[...].astype(jnp.float32)                     # [bm, K]
+    m = jnp.mean(x, axis=1, keepdims=True)
+    xc = x - m
+    v = jnp.mean(xc * xc, axis=1, keepdims=True)
+    r = jax.lax.rsqrt(v + _LN_EPS)
+    h = xc * r * g_ref[...].astype(jnp.float32) \
+        + b_ref[...].astype(jnp.float32)
+    amax = jnp.max(jnp.abs(h), axis=1, keepdims=True)
+    scale = jnp.where(amax == 0.0, 1.0, amax) / 127.0
+    q_ref[...] = jnp.clip(jnp.round(h / scale), -127, 127) \
+        .astype(jnp.int8)
+    s_ref[...] = scale
+    m_ref[...] = m
+    r_ref[...] = r
+
+
+@functools.partial(jax.jit, static_argnums=(3,))
+def _rowq_ln_call(x2, g, b, interpret):
+    M, K = x2.shape
+    bm = _pick_block(M, K * x2.dtype.itemsize)
+    kernel = pl.pallas_call(
+        _rowq_ln_kernel, grid=(M // bm,),
+        in_specs=[pl.BlockSpec((bm, K), lambda i: (i, 0)),
+                  pl.BlockSpec((1, K), lambda i: (0, 0)),
+                  pl.BlockSpec((1, K), lambda i: (0, 0))],
+        out_specs=[pl.BlockSpec((bm, K), lambda i: (i, 0)),
+                   pl.BlockSpec((bm, 1), lambda i: (i, 0)),
+                   pl.BlockSpec((bm, 1), lambda i: (i, 0)),
+                   pl.BlockSpec((bm, 1), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((M, K), jnp.int8),
+                   jax.ShapeDtypeStruct((M, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((M, 1), jnp.float32),
+                   jax.ShapeDtypeStruct((M, 1), jnp.float32)],
+        interpret=interpret)
+    return kernel(x2, g.reshape(1, K), b.reshape(1, K))
+
+
+def _ln_stats(x2):
+    xf = x2.astype(jnp.float32)
+    m = jnp.mean(xf, axis=-1, keepdims=True)
+    v = jnp.var(xf, axis=-1, keepdims=True)
+    return m, jax.lax.rsqrt(v + _LN_EPS)
+
+
+def ln_quantize_rowwise(x2, g, b, interpret=None):
+    """LayerNorm + symmetric per-row int8 quantize of [M, K] in one
+    pass: returns (q, scale, mean, rstd). The stats make the backward's
+    column-quantize of LN(x) possible without re-deriving them from
+    full rows (see sr_quantize_colwise_ln)."""
+    M, K = x2.shape
+    if interpret is None:
+        if jax.default_backend() not in ("tpu", "axon") \
+                or jax.device_count() != 1:
+            interpret = None          # fall through to XLA
+        else:
+            interpret = False
+    if interpret is not None and K % 128 == 0 \
+            and _pick_block(M, K * x2.dtype.itemsize):
+        return _rowq_ln_call(x2, g, b, interpret)
+    m, r = _ln_stats(x2)
+    h = (x2.astype(jnp.float32) - m) * r \
+        * g.astype(jnp.float32) + b.astype(jnp.float32)
+    q, s = quantize_rowwise(h, axis=-1)
+    return q, s, m, r
+
+
+def _sr_cast_ln_kernel(seed_ref, x_ref, m_ref, r_ref, g_ref, b_ref,
+                       sc_ref, q_ref):
+    # Tiled SR cast with the column scale precomputed: a whole-column
+    # one-pass variant (amax in-kernel) needs the full [M, bn] block
+    # plus an f32 LN temp resident, which blows the 16M scoped-vmem
+    # budget at the flagship [6144, 2048] (the non-LN colq kernel fit
+    # with 343K to spare; +h does not). Splitting amax out to one XLA
+    # reduce fusion costs a second bf16 read of x but keeps the
+    # in-kernel hardware PRNG (the XLA SR path would write+read a full
+    # uint32 rng buffer per operand — the bigger tax).
+    from jax.experimental.pallas import tpu as pltpu
+    x = x_ref[...].astype(jnp.float32)
+    h = (x - m_ref[...]) * r_ref[...] \
+        * g_ref[...].astype(jnp.float32) \
+        + b_ref[...].astype(jnp.float32)
+    # Mosaic caps prng_seed at 2 values: fold the 2-D grid id into one
+    pltpu.prng_seed(seed_ref[0], pl.program_id(0) * pl.num_programs(1)
+                    + pl.program_id(1))
+    bits = pltpu.prng_random_bits(h.shape).astype(jnp.uint32)
+    f = jax.lax.bitcast_convert_type(
+        jnp.uint32(0x3F800000) | (bits >> 9), jnp.float32)
+    q_ref[...] = jnp.clip(jnp.floor(h / sc_ref[...] + (f - 1.0)),
+                          -127, 127).astype(jnp.int8)
+
+
+@functools.partial(jax.jit, static_argnums=(6,))
+def _sr_colq_ln_pallas(x2, m, r, g, b, seed_i, interpret):
+    M, C = x2.shape
+    gf = g.astype(jnp.float32).reshape(1, C)
+    bf = b.astype(jnp.float32).reshape(1, C)
+    h_for_amax = (x2.astype(jnp.float32) - m) * r * gf + bf
+    amax = jnp.max(jnp.abs(h_for_amax), axis=0, keepdims=True)
+    scale = jnp.where(amax == 0.0, 1.0, amax) / 127.0
+    bm = _pick_block(M, 256 * 4)
+    bn = 256 if C % 256 == 0 else 128
+    kernel = pl.pallas_call(
+        _sr_cast_ln_kernel, grid=(M // bm, C // bn),
+        in_specs=[pl.BlockSpec(memory_space=pltpu_smem()),
+                  pl.BlockSpec((bm, bn), lambda i, j: (i, j)),
+                  pl.BlockSpec((bm, 1), lambda i, j: (i, 0)),
+                  pl.BlockSpec((bm, 1), lambda i, j: (i, 0)),
+                  pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+                  pl.BlockSpec((1, bn), lambda i, j: (0, j)),
+                  pl.BlockSpec((1, bn), lambda i, j: (0, j))],
+        out_specs=[pl.BlockSpec((bm, bn), lambda i, j: (i, j))],
+        out_shape=[jax.ShapeDtypeStruct((M, C), jnp.int8)],
+        interpret=interpret)
+    (q,) = kernel(seed_i.reshape(1), x2, m, r,
+                  g.reshape(1, C), b.reshape(1, C), scale)
+    return q, scale
+
+
+def sr_quantize_colwise_ln(x2, m, r, g, b, seed_i):
+    """Unbiased int8 column quantize of LN(x2) given precomputed row
+    stats; one read of the PRE-LN activation instead of an LN pass plus
+    a re-read of its output."""
+    M, C = x2.shape
+    if jax.default_backend() in ("tpu", "axon") \
+            and jax.device_count() == 1 \
+            and C % 128 == 0 and _pick_block(M, 256 * 4):
+        return _sr_colq_ln_pallas(x2, m, r, g, b, seed_i, False)
+    h = ((x2.astype(jnp.float32) - m) * r
+         * g.astype(jnp.float32) + b.astype(jnp.float32))
+    return _sr_colq_xla(h, seed_i)
 
 
 def int8_dot_dequant(aq, a_scale, bq, b_scale, dims):
@@ -428,3 +580,80 @@ def _bwd_gelu_all8(res, g):
 
 
 int8_gelu_linear_all8.defvjp(_fwd_gelu_all8, _bwd_gelu_all8)
+
+
+def _int8_matmul_ln(x, g_ln, b_ln, w):
+    lead, K = x.shape[:-1], x.shape[-1]
+    x2 = x.reshape(-1, K)
+    q, s, m, r = ln_quantize_rowwise(x2, g_ln, b_ln)
+    wq, ws = quantize_rowwise_fast(w, axis=0)
+    y = int8_dot_dequant(q, s, wq, ws, ((1,), (0,)))
+    return y.reshape(lead + (w.shape[1],)).astype(x.dtype), m, r
+
+
+@jax.custom_vjp
+def int8_ln_linear_all8(x, g_ln, b_ln, w, seed):
+    """``int8_linear_all8(layer_norm(x, g_ln, b_ln), w, seed)`` with
+    the LayerNorm computed INSIDE the quantize kernels (round-5 lever
+    a): x is the PRE-LN residual stream. Forward and wgrad each read x
+    once and never materialize the bf16 LN output; the backward chains
+    the LN vjp outside (one fused elementwise + row reductions) and
+    returns real gradients for g_ln/b_ln."""
+    del seed
+    return _int8_matmul_ln(x, g_ln, b_ln, w)[0]
+
+
+def _fwd_ln_all8(x, g_ln, b_ln, w, seed):
+    y, m, r = _int8_matmul_ln(x, g_ln, b_ln, w)
+    return y, (x, g_ln, b_ln, w, seed, m, r)
+
+
+def _bwd_ln_all8(res, gy):
+    x, g_ln, b_ln, w, seed, m, r = res
+    K = x.shape[-1]
+    N = gy.shape[-1]
+    # dgrad w.r.t. h = LN(x): int8 per-row, as int8_linear_all8
+    gq, gs = quantize_rowwise_fast(gy, axis=-1)
+    wq, ws = quantize_rowwise_fast(w, axis=1)
+    y = jax.lax.dot_general(gq, wq, (((gy.ndim - 1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.int32)
+    da = (y.astype(jnp.float32) * gs *
+          jnp.reshape(ws, (1,) * (gy.ndim - 1) + (-1,)))
+    # LN vjp via jax.vjp on the bf16 cotangent — replays the exact
+    # graph the unfused path's autodiff built. A hand-written f32 vjp
+    # from the saved stats measured +23.6 ms/step: the f32 [M, K]
+    # cotangent feeds three row reductions XLA cannot fuse into one
+    # pass, while this form fuses like any other LN backward.
+    def _ref_ln(xx, gg, bb):
+        xf = xx.astype(jnp.float32)
+        mu = jnp.mean(xf, axis=-1, keepdims=True)
+        va = jnp.var(xf, axis=-1, keepdims=True)
+        out = (xf - mu) * jax.lax.rsqrt(va + _LN_EPS)
+        return (out * gg + bb).astype(xx.dtype)
+
+    h, ln_vjp = jax.vjp(_ref_ln, x, g_ln, b_ln)
+    dx, dg_ln, db_ln = ln_vjp(da.astype(x.dtype))
+    # wgrad: SR int8 of h = LN(x). _FUSE_BWD_COLQ=True computes the LN
+    # inside the colq path (amax pass + tiled SR cast, two reads of x,
+    # no h buffer); False materializes h once (shared with the vjp
+    # above) and runs the plain one-pass colq kernel — the bwd then
+    # matches the unfused path op-for-op (A/B isolation knob).
+    g2 = gy.reshape(-1, N)
+    base = jnp.asarray(seed, jnp.int32) * jnp.int32(1000003)
+    if _FUSE_BWD_COLQ:
+        hq, hs = sr_quantize_colwise_ln(x.reshape(-1, K), m, r,
+                                        g_ln, b_ln,
+                                        base + jnp.int32(7919))
+    else:
+        hq, hs = sr_quantize_colwise(h.reshape(-1, K),
+                                     base + jnp.int32(7919))
+    gq2, gs2 = sr_quantize_colwise(g2, base + jnp.int32(104729))
+    dwi = jax.lax.dot_general(hq, gq2, (((0,), (0,)), ((), ())),
+                              preferred_element_type=jnp.int32)
+    dw = dwi.astype(jnp.float32) * hs.reshape(K, 1) * gs2
+    import numpy as np
+    return (dx, dg_ln, db_ln, dw.astype(w.dtype),
+            np.zeros((), jax.dtypes.float0))
+
+
+int8_ln_linear_all8.defvjp(_fwd_ln_all8, _bwd_ln_all8)
