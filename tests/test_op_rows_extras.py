@@ -37,9 +37,13 @@ OPS_ROWS = {
                {"x": R.randn(5).astype(np.float32)},
                {}, dict(check_grad=False)),
     "copysign": (paddle.copysign, np.copysign,
-                 {"x": R.randn(4, 3).astype(np.float32),
+                 # |x| >= 0.5: the numeric grad's central difference
+                 # must not straddle the |x| kink at 0
+                 {"x": (_pos(4, 3) *
+                        np.where(R.rand(4, 3) < 0.5, -1.0, 1.0)
+                        ).astype(np.float32),
                   "y": R.randn(4, 3).astype(np.float32)},
-                 {}, dict(check_grad=False)),
+                 {}, dict(grad_targets=["x"])),
     "nextafter": (paddle.nextafter, np.nextafter,
                   {"x": R.randn(6).astype(np.float32),
                    "y": R.randn(6).astype(np.float32)},
@@ -47,7 +51,7 @@ OPS_ROWS = {
     "ldexp": (paddle.ldexp, np.ldexp,
               {"x": R.randn(5).astype(np.float32),
                "y": R.randint(-3, 4, 5).astype(np.int32)},
-              {}, dict(check_grad=False)),
+              {}, dict(grad_targets=["x"])),
     "frexp": (paddle.frexp, np.frexp,
               {"x": np.array([0.5, 3.0, -6.25, 0.0], np.float32)},
               {}, dict(check_grad=False)),
@@ -65,10 +69,10 @@ OPS_ROWS = {
                   lambda x, n=1: special.polygamma(n, x).astype(
                       np.float32),
                   {"x": _pos(5) * 2}, {"n": 1},
-                  dict(check_grad=False)),
+                  dict()),
     "gammainc": (paddle.gammainc, special.gammainc,
                  {"x": _pos(5) * 2, "y": _pos(5) * 2}, {},
-                 dict(check_grad=False)),
+                 dict(grad_targets=["y"])),
     "gammaincc": (paddle.gammaincc, special.gammaincc,
                   {"x": _pos(5) * 2, "y": _pos(5) * 2}, {},
                   dict(check_grad=False)),
@@ -76,14 +80,17 @@ OPS_ROWS = {
                      lambda x, p=2: special.multigammaln(x, p).astype(
                          np.float32),
                      {"x": _pos(5) * 3 + 2.0}, {"p": 2},
-                     dict(check_grad=False)),
+                     dict()),
     "sgn": (paddle.sgn, np.sign, {"x": R.randn(7).astype(np.float32)},
             {}, dict(check_grad=False)),
     "floor_mod": (paddle.floor_mod, np.mod,
-                  {"x": R.randn(6).astype(np.float32) * 5,
+                  # x offsets chosen off the mod-boundary grid so the
+                  # numeric grad's central difference stays one-sided
+                  {"x": np.array([0.7, -1.2, 0.4, 3.3, -0.6, 2.9],
+                                 np.float32),
                    "y": np.array([2.0, -3.0, 1.5, 2.0, -1.0, 4.0],
                                  np.float32)},
-                  {}, dict(check_grad=False)),
+                  {}, dict(grad_targets=["x"])),
     "nanquantile": (paddle.nanquantile,
                     lambda x, q=0.3: np.nanquantile(x, 0.3).astype(
                         np.float32),
@@ -117,11 +124,11 @@ OPS_ROWS = {
                   np.float32),
               {"x": R.randn(5, 3).astype(np.float32),
                "y": R.randn(4, 3).astype(np.float32)}, {},
-              dict(check_grad=False)),
+              dict()),
     "pdist": (paddle.pdist,
               lambda x: spatial.distance.pdist(x).astype(np.float32),
               {"x": R.randn(5, 3).astype(np.float32)}, {},
-              dict(check_grad=False)),
+              dict()),
     "combinations": (
         paddle.combinations,
         lambda x, r=2: np.array(list(
@@ -140,13 +147,13 @@ OPS_ROWS = {
         {"x": R.randn(4, 3).astype(np.float32),
          "index": np.array([0, 2], np.int64)},
         {"axis": 0, "value": 9.0},
-        dict(check_grad=False)),
+        dict(grad_targets=["x"])),
     "index_sample": (
         paddle.index_sample,
         lambda x, index: np.take_along_axis(x, index, axis=1),
         {"x": R.randn(3, 5).astype(np.float32),
          "index": R.randint(0, 5, (3, 2)).astype(np.int64)}, {},
-        dict(check_grad=False)),
+        dict(grad_targets=["x"])),
     "scatter_nd": (
         paddle.scatter_nd,
         lambda index, updates, shape=(6,): _np_scatter_nd(
@@ -154,7 +161,7 @@ OPS_ROWS = {
         {"index": np.array([[1], [3], [1]], np.int64),
          "updates": np.array([9.0, 10.0, 11.0], np.float32)},
         {"shape": (6,)},
-        dict(check_grad=False)),
+        dict(grad_targets=["updates"])),
     "dstack": (lambda a, b: paddle.dstack([a, b]),
                lambda a, b: np.dstack([a, b]),
                {"a": R.randn(3, 4).astype(np.float32),
@@ -185,7 +192,7 @@ OPS_ROWS = {
                          if i + 3 <= 8]),
                {"x": R.randn(8).astype(np.float32)},
                {"axis": 0, "size": 3, "step": 2},
-               dict(check_grad=False)),
+               dict()),
     "vander": (paddle.vander,
                lambda x, n=4, increasing=True: np.vander(
                    x, 4, increasing=True).astype(np.float32),
@@ -205,7 +212,7 @@ OPS_ROWS = {
                   {"a": R.randn(4, 3).astype(np.float32),
                    "b": R.randn(4, 3).astype(np.float32),
                    "index": np.array([[0], [1], [1], [0]], np.int64)},
-                  {}, dict(check_grad=False)),
+                  {}, dict(grad_targets=["a", "b"])),
     "isin": (paddle.isin,
              lambda x, test_x: np.isin(x, test_x),
              {"x": np.array([1.0, 2.0, 3.0, 4.0], np.float32),
@@ -215,7 +222,7 @@ OPS_ROWS = {
                lambda x, p=2.0, axis=0, max_norm=1.0: _np_renorm(x),
                {"x": R.randn(3, 4).astype(np.float32) * 2},
                {"p": 2.0, "axis": 0, "max_norm": 1.0},
-               dict(check_grad=False)),
+               dict()),
 }
 
 
@@ -508,7 +515,11 @@ def test_row_fractional_max_pool3d():
     # every pooled value must be attained somewhere in the input
     assert np.isin(got.ravel(),
                    x.ravel()).all()
-    assert got.max() == x.max()
+    # the random region offsets need not cover the global argmax, so
+    # equality with x.max() is NOT part of the op's contract (an
+    # unlucky draw made it flaky); <= is
+    assert got.max() <= x.max()
+    assert got.min() >= x.min()
 
 
 def test_row_inplace_activations():
@@ -631,7 +642,12 @@ def test_row_yolo_box():
             cy = (i + sig(xr[0, 0, 1, i, j])) * 32 / (H * 32) * 64
             w = np.exp(xr[0, 0, 2, i, j]) * anchors[0] / (H * 32) * 64
             h = np.exp(xr[0, 0, 3, i, j]) * anchors[1] / (H * 32) * 64
-            want = [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2]
+            # clip_bbox=True (the default) clamps to the image box —
+            # the reference loop must clamp too or an unlucky exp(wh)
+            # draw makes the row flaky
+            want = np.clip(
+                [cx - w / 2, cy - h / 2, cx + w / 2, cy + h / 2],
+                0.0, 63.0)
             np.testing.assert_allclose(got_b[0, bi], want, rtol=2e-3,
                                        atol=0.25)
             conf = sig(xr[0, 0, 4, i, j])
