@@ -28,7 +28,8 @@ def main():
                                  moment_dtype=jnp.bfloat16,
                                  master_dtype=jnp.bfloat16,
                                  quant8="wgrad",
-                                 ce_chunks=1)
+                                 ce_chunks=1,
+                                 moment8=True)
         B, T, steps = 6, 1024, 10
     else:
         cfg = GPTConfig(vocab_size=512, hidden_size=64, num_layers=4,

@@ -35,6 +35,9 @@ def main():
                          "never test")
     ap.add_argument("--guard-period", type=int, default=0)
     ap.add_argument("--ce-int8", action="store_true")
+    ap.add_argument("--moment8", action="store_true",
+                    help="int8 moment storage on the quantized run "
+                         "(the bf16 reference run keeps bf16 moments)")
     args = ap.parse_args()
 
     import jax
@@ -62,6 +65,7 @@ def main():
             quant8=quant8, ce_chunks=4 if not args.ce_int8 else 1,
             ce_int8=bool(quant8) and args.ce_int8, seed=0,
             lr_schedule=sched,
+            moment8=bool(quant8) and args.moment8,
             int8_guard_period=args.guard_period if quant8 else 0)
 
     def run(quant8):

@@ -31,6 +31,7 @@ def main():
     ap.add_argument("--ce-chunks", type=int, default=16)
     ap.add_argument("--ce-int8", action="store_true")
     ap.add_argument("--no-fused-opt", action="store_true")
+    ap.add_argument("--moment8", action="store_true")
     ap.add_argument("--fuse-ln", default="off",
                     choices=["off", "both", "qkv", "ffn1"])
     ap.add_argument("--no-fuse-gelu", action="store_true")
@@ -59,6 +60,7 @@ def main():
         ce_chunks=args.ce_chunks,
         ce_int8=args.ce_int8,
         fused_optimizer=False if args.no_fused_opt else None,
+        moment8=args.moment8,
         fuse_ln_quant={"off": False, "both": True, "qkv": "qkv",
                        "ffn1": "ffn1"}[args.fuse_ln],
         fuse_gelu_quant=False if args.no_fuse_gelu else None)

@@ -47,6 +47,7 @@ def main():
                                  moment_dtype=jnp.bfloat16,
                                  master_dtype=jnp.bfloat16,
                                  quant8="wgrad", ce_chunks=1,
+                                 moment8=True,
                                  fuse_ln_quant=args.fuse_ln)
         bs = args.bs or 6
         rng = np.random.RandomState(0)

@@ -238,6 +238,7 @@ class GPTSpmdTrainer:
                  moe_capacity_factor: float = 1.25,
                  moe_aux_weight: float = 1e-2,
                  fused_optimizer: Optional[bool] = None,
+                 moment8: bool = False,
                  layer_unroll: int = 1,
                  ce_chunks: int = 16,
                  ce_int8: bool = False,
@@ -348,6 +349,16 @@ class GPTSpmdTrainer:
             fused_optimizer = (jax.default_backend() in ("tpu", "axon")
                                and mesh.size == 1)
         self.fused_optimizer = fused_optimizer
+        # int8 moment storage for fused-eligible leaves (round-5 lever
+        # b): m int8-SR, v as sqrt(v) int8-SR, per-row f32
+        # scales — 14 -> ~10 B/param of optimizer HBM traffic
+        # (ops/fused_adamw.fused_adamw_update8). Parity-gated like every
+        # quantization default: benchmarks/parity_int8.py --moment8.
+        self.moment8 = bool(moment8)
+        if self.moment8 and not self.fused_optimizer:
+            raise ValueError(
+                "moment8 rides the fused AdamW kernel (single-device "
+                "TPU mesh); it has no XLA fallback path")
         # unroll factor for the per-stage layer scan: with the scan
         # rolled, every remat-saved residual round-trips HBM through a
         # dynamic-update-slice into the [L, ...] stacked buffer (plus a
@@ -430,11 +441,33 @@ class GPTSpmdTrainer:
         self.params = self._init_params(jax.random.key(seed))
         zeros_moment = lambda p: jnp.zeros(  # noqa: E731
             p.shape, self.moment_dtype, device=p.sharding)
-        self.opt_state = {
-            "step": jnp.zeros((), jnp.int32),
-            "m": jax.tree.map(zeros_moment, self.params),
-            "v": jax.tree.map(zeros_moment, self.params),
-        }
+        if self.moment8:
+            from ..ops.fused_adamw import (moment8_eligible,
+                                           moment8_init)
+
+            def m_leaf(p):
+                if moment8_eligible(p):
+                    mq, msc, _, _ = moment8_init(p)
+                    return (mq, msc)
+                return zeros_moment(p)
+
+            def v_leaf(p):
+                if moment8_eligible(p):
+                    _, _, vq, vsc = moment8_init(p)
+                    return (vq, vsc)
+                return zeros_moment(p)
+
+            self.opt_state = {
+                "step": jnp.zeros((), jnp.int32),
+                "m": jax.tree.map(m_leaf, self.params),
+                "v": jax.tree.map(v_leaf, self.params),
+            }
+        else:
+            self.opt_state = {
+                "step": jnp.zeros((), jnp.int32),
+                "m": jax.tree.map(zeros_moment, self.params),
+                "v": jax.tree.map(zeros_moment, self.params),
+            }
         self._step_fn = None
 
     # -- init --------------------------------------------------------------
@@ -1028,13 +1061,29 @@ class GPTSpmdTrainer:
             inv_bc1 = 1.0 / (1.0 - b1f ** tf)
             inv_bc2 = 1.0 / (1.0 - b2f ** tf)
 
+        _is8 = lambda x: isinstance(x, tuple)  # noqa: E731
         flat_p, tdef = jax.tree.flatten(params)
         flat_g = jax.tree.leaves(grads)
-        flat_m = jax.tree.leaves(opt_state["m"])
-        flat_v = jax.tree.leaves(opt_state["v"])
+        flat_m = jax.tree.flatten(opt_state["m"], is_leaf=_is8)[0]
+        flat_v = jax.tree.flatten(opt_state["v"], is_leaf=_is8)[0]
         new_p, new_m, new_v = [], [], []
         for i, (p, g, m, v) in enumerate(zip(flat_p, flat_g, flat_m,
                                              flat_v)):
+            if _is8(m):
+                # int8 moment storage: (q, scale) pairs ride the fused
+                # kernel's int8 variant (moment8 implies fused+eligible)
+                from ..ops.fused_adamw import fused_adamw_update8
+                p2, mq, msc, vq, vsc = fused_adamw_update8(
+                    p, g, m[0], m[1], v[0], v[1], scale, inv_bc1,
+                    inv_bc2, step.astype(jnp.int32),
+                    lr=float(self.lr), wd=float(self.wd),
+                    b1=b1f, b2=b2f, eps=1e-8,
+                    stoch_round=self._stoch_round, leaf_id=i,
+                    lr_scale=lr_mult)
+                new_p.append(p2)
+                new_m.append((mq, msc))
+                new_v.append((vq, vsc))
+                continue
             if use_fused and fused_adamw_eligible(p):
                 p2, m2, v2 = fused_adamw_update(
                     p, g, m, v, scale, inv_bc1, inv_bc2,

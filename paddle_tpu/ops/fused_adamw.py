@@ -26,7 +26,9 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-__all__ = ["fused_adamw_update", "fused_adamw_eligible"]
+__all__ = ["fused_adamw_update", "fused_adamw_eligible",
+           "fused_adamw_update8", "moment8_init", "moment8_unpack",
+           "moment8_eligible"]
 
 
 def _kernel(sc_ref, seed_ref, p_ref, g_ref, m_ref, v_ref,
@@ -83,6 +85,156 @@ def fused_adamw_eligible(p) -> bool:
     return c % 128 == 0 and r % 8 == 0 and \
         _tile(c, (2048, 1024, 512, 384, 256, 128)) is not None and \
         _tile(r, (512, 256, 128, 64, 32, 16, 8)) is not None
+
+
+# ---------------------------------------------------------------------------
+# int8 moment storage (round-5 lever b): 14 -> 10 bytes/param
+# ---------------------------------------------------------------------------
+# The bf16-moment kernel's HBM floor is 14 B/param (read p,g,m,v; write
+# p,m,v). Storing both moments int8 with per-row f32 scales
+# cuts that to ~10 B/param: m quantizes directly (zero-mean; stochastic
+# rounding keeps the EMA recurrence unbiased), v stores sqrt(v) (halves
+# the dynamic range an int8 grid must span; also the quantity the
+# update actually divides by). A v entry whose sqrt SR-rounds to zero
+# is refreshed by the (1-b2) g^2 term the same step, which bounds the
+# worst-case update inflation at ~sqrt(1/(1-b2)) ~ 4.5x of a normal
+# Adam step — a spike, not a blow-up; the 300-step parity harness is
+# the accept/reject gate (benchmarks/parity_int8.py --moment8).
+# Scales are per-ROW [R, 1] f32 (one per 2048-6144 values): the kernel
+# takes full-row blocks on a 1-D grid, so the row amax is computable
+# in-block and the scale block shape satisfies Mosaic's lane rules.
+
+def _kernel8(sc_ref, seed_ref, p_ref, g_ref, m_ref, ms_ref, v_ref,
+             vs_ref, po_ref, mo_ref, mso_ref, vo_ref, vso_ref, *,
+             lr, wd, b1, b2, eps, stoch_round, leaf_id):
+    scale = sc_ref[0]
+    inv_bc1 = sc_ref[1]
+    inv_bc2 = sc_ref[2]
+    lr = lr * sc_ref[3]
+    # 1-D grid of full-row blocks: per-ROW scales ([R,1] f32 — the
+    # (br,1) scale block satisfies Mosaic's last-dim rule, which a
+    # per-(row, col-tile) [R, C/bc] layout does not)
+    pltpu.prng_seed(seed_ref[0] + jnp.int32(leaf_id * 1000003),
+                    pl.program_id(0))
+
+    def _unif(shape):
+        bits = pltpu.prng_random_bits(shape).astype(jnp.uint32)
+        return jax.lax.bitcast_convert_type(
+            jnp.uint32(0x3F800000) | (bits >> 9), jnp.float32) - 1.0
+
+    g = g_ref[...].astype(jnp.float32) * scale
+    m = m_ref[...].astype(jnp.float32) * ms_ref[...]
+    vsq = v_ref[...].astype(jnp.float32) * vs_ref[...]
+    v = vsq * vsq
+    m2 = b1 * m + (1.0 - b1) * g
+    v2 = b2 * v + (1.0 - b2) * g * g
+    p2 = p_ref[...].astype(jnp.float32) * (1.0 - lr * wd) - \
+        lr * (m2 * inv_bc1) / (jnp.sqrt(v2 * inv_bc2) + eps)
+    if stoch_round:
+        bits = pltpu.prng_random_bits(p2.shape).astype(jnp.uint32)
+        u = jax.lax.bitcast_convert_type(p2, jnp.uint32)
+        y = u + (bits & jnp.uint32(0xFFFF))
+        y = jnp.where(jnp.isfinite(p2), y, u)
+        po_ref[...] = jax.lax.bitcast_convert_type(
+            y & jnp.uint32(0xFFFF0000), jnp.float32).astype(jnp.bfloat16)
+    else:
+        po_ref[...] = p2.astype(po_ref.dtype)
+    # requantize m with SR (unbiased: the EMA must not drift)
+    ma = jnp.max(jnp.abs(m2), axis=1, keepdims=True)
+    msc = jnp.where(ma == 0.0, 1.0, ma) / 127.0
+    mo_ref[...] = jnp.clip(jnp.floor(m2 / msc + _unif(m2.shape)),
+                           -127, 127).astype(jnp.int8)
+    mso_ref[...] = msc
+    # requantize sqrt(v) with SR (non-negative: codes 0..127)
+    s2 = jnp.sqrt(v2)
+    va = jnp.max(s2, axis=1, keepdims=True)
+    vsc = jnp.where(va == 0.0, 1.0, va) / 127.0
+    vo_ref[...] = jnp.clip(jnp.floor(s2 / vsc + _unif(s2.shape)),
+                           0, 127).astype(jnp.int8)
+    vso_ref[...] = vsc
+
+
+def _row_block(R: int, C: int):
+    # full-row blocks: ~10 live [br, C] f32 temps must fit scoped VMEM
+    for br in (512, 256, 128, 64, 32, 16, 8):
+        if R % br == 0 and br * C <= (1 << 18):
+            return br
+    return None
+
+
+def moment8_eligible(p) -> bool:
+    """fused_adamw_eligible AND rows narrow enough that a full row
+    block fits VMEM (the vocab-head leaves stay bf16)."""
+    if not fused_adamw_eligible(p):
+        return False
+    C = p.shape[-1]
+    return _row_block(p.size // C, C) is not None
+
+
+def moment8_init(p):
+    """Zero int8-moment state for one eligible leaf: returns
+    (m_q, m_scale, v_q, v_scale) — [R, C] int8 + per-row [R, 1] f32."""
+    C = p.shape[-1]
+    R = p.size // C
+    z8 = jnp.zeros((R, C), jnp.int8)
+    sc = jnp.full((R, 1), 1.0 / 127.0, jnp.float32)
+    return z8, sc, z8, sc
+
+
+def moment8_unpack(mq, msc, vq, vsc, shape):
+    """Dequantize int8 moment state back to f32 (checkpoint export /
+    debugging): inverse of the kernel's requantize."""
+    m = (mq.astype(jnp.float32) * msc).reshape(shape)
+    s = (vq.astype(jnp.float32) * vsc).reshape(shape)
+    return m, (s * s).reshape(shape)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "lr", "wd", "b1", "b2", "eps", "stoch_round", "leaf_id",
+    "interpret"))
+def fused_adamw_update8(p, g, mq, msc, vq, vsc, scale, inv_bc1,
+                        inv_bc2, seed, *, lr, wd, b1, b2, eps=1e-8,
+                        stoch_round=False, leaf_id=0, interpret=False,
+                        lr_scale=1.0):
+    """One-pass AdamW with int8 moment storage: returns
+    (p', m_q', m_scale', v_q', v_scale'). Same contract as
+    fused_adamw_update otherwise."""
+    shape = p.shape
+    C = shape[-1]
+    R = p.size // C
+    br = _row_block(R, C)
+    sc = jnp.stack([jnp.asarray(scale, jnp.float32),
+                    jnp.asarray(inv_bc1, jnp.float32),
+                    jnp.asarray(inv_bc2, jnp.float32),
+                    jnp.asarray(lr_scale, jnp.float32)])
+    seed = jnp.asarray(seed, jnp.int32).reshape(1)
+    blk = pl.BlockSpec((br, C), lambda i: (i, 0))
+    sblk = pl.BlockSpec((br, 1), lambda i: (i, 0))
+    out_dtype = jnp.bfloat16 if stoch_round else p.dtype
+    po, mo, mso, vo, vso = pl.pallas_call(
+        functools.partial(_kernel8, lr=lr, wd=wd, b1=b1, b2=b2,
+                          eps=eps, stoch_round=stoch_round,
+                          leaf_id=leaf_id),
+        grid=(R // br,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            blk, blk, blk, sblk, blk, sblk,
+        ],
+        out_specs=[blk, blk, sblk, blk, sblk],
+        out_shape=[
+            jax.ShapeDtypeStruct((R, C), out_dtype),
+            jax.ShapeDtypeStruct((R, C), jnp.int8),
+            jax.ShapeDtypeStruct((R, 1), jnp.float32),
+            jax.ShapeDtypeStruct((R, C), jnp.int8),
+            jax.ShapeDtypeStruct((R, 1), jnp.float32),
+        ],
+        input_output_aliases={2: 0, 4: 1, 5: 2, 6: 3, 7: 4},
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel",)),
+        interpret=interpret,
+    )(sc, seed, p.reshape(R, C), g.reshape(R, C), mq, msc, vq, vsc)
+    return po.reshape(shape), mo, mso, vo, vso
 
 
 @functools.partial(jax.jit, static_argnames=(

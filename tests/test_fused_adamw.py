@@ -121,3 +121,77 @@ def test_stochastic_rounding_neighbors_and_unbiased():
                                  wd=WD, b1=B1, b2=B2, eps=EPS,
                                  stoch_round=True, interpret=True)
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- int8 moment storage (round-5) --------------------------------------
+
+def test_moment8_eligibility_and_init():
+    from paddle_tpu.ops.fused_adamw import (moment8_eligible,
+                                            moment8_init)
+    z = jnp.zeros
+    assert moment8_eligible(z((512, 1024)))
+    assert moment8_eligible(z((24, 2048, 6144)))
+    # vocab-head rows too wide for a full-row VMEM block -> bf16 path
+    assert not moment8_eligible(z((2048, 50304)))
+    assert not moment8_eligible(z((2048,)))
+    mq, msc, vq, vsc = moment8_init(z((24, 2048, 6144)))
+    assert mq.shape == (24 * 2048, 6144) and mq.dtype == jnp.int8
+    assert msc.shape == (24 * 2048, 1) and msc.dtype == jnp.float32
+    assert vq.shape == mq.shape and vsc.shape == msc.shape
+
+
+def test_moment8_unpack_roundtrip():
+    from paddle_tpu.ops.fused_adamw import moment8_unpack
+    rng = np.random.RandomState(0)
+    R, C = 16, 256
+    m = rng.randn(R, C).astype(np.float32)
+    v = np.abs(rng.randn(R, C)).astype(np.float32) * 1e-4
+    # quantize by the kernel's rule (RTN here; kernel uses SR)
+    ms = np.abs(m).max(1, keepdims=True) / 127.0
+    mq = np.clip(np.round(m / ms), -127, 127).astype(np.int8)
+    s = np.sqrt(v)
+    vs = s.max(1, keepdims=True) / 127.0
+    vq = np.clip(np.round(s / vs), 0, 127).astype(np.int8)
+    m2, v2 = moment8_unpack(jnp.asarray(mq), jnp.asarray(ms),
+                            jnp.asarray(vq), jnp.asarray(vs), (R, C))
+    np.testing.assert_allclose(np.asarray(m2), m, atol=float(ms.max()))
+    # v reconstructs through sqrt-domain quantization: tolerance is
+    # one sqrt-step around each value
+    np.testing.assert_allclose(np.sqrt(np.asarray(v2)), s,
+                               atol=float(vs.max()))
+
+
+def test_moment8_kernel_interpret_or_skip():
+    """The int8-moment kernel always draws SR bits, so it runs only
+    where pltpu.prng_* exists (TPU); interpret mode documents the
+    skip the same way the SR-master path does."""
+    from paddle_tpu.ops.fused_adamw import (fused_adamw_update8,
+                                            moment8_init)
+    k = jax.random.key(0)
+    R, C = 64, 256
+    p = jax.random.normal(k, (R, C), jnp.float32)
+    g = jax.random.normal(jax.random.fold_in(k, 1), (R, C), jnp.float32)
+    mq, msc, vq, vsc = moment8_init(p)
+    try:
+        p2, mq2, ms2, vq2, vs2 = fused_adamw_update8(
+            p, g, mq, msc, vq, vsc, 1.0, 1.0, 1.0, 3,
+            lr=LR, wd=WD, b1=B1, b2=B2, interpret=True)
+    except Exception as e:  # noqa: BLE001
+        pytest.skip(f"pltpu.prng_* unsupported in interpret mode: {e}")
+    # from zero state: m2 = (1-b1) g, v2 = (1-b2) g^2 — check the
+    # dequantized m is within one SR step of the reference
+    from paddle_tpu.ops.fused_adamw import moment8_unpack
+    m2, v2 = moment8_unpack(mq2, ms2, vq2, vs2, (R, C))
+    ref = (1 - B1) * np.asarray(g, np.float32)
+    step = np.asarray(ms2).max()
+    assert np.abs(np.asarray(m2) - ref).max() <= step + 1e-6
+
+
+def test_trainer_moment8_requires_fused():
+    from paddle_tpu.models.gpt import (GPTConfig, GPTSpmdTrainer,
+                                       build_mesh)
+    cfg = GPTConfig(vocab_size=256, hidden_size=128, num_layers=1,
+                    num_heads=2, max_seq_len=32, dtype=jnp.float32)
+    with pytest.raises(ValueError, match="moment8"):
+        GPTSpmdTrainer(cfg, build_mesh(1, 1, 1, 1, 1),
+                       fused_optimizer=False, moment8=True)
