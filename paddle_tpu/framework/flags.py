@@ -78,6 +78,12 @@ define_flag("FLAGS_check_nan_inf", False,
 define_flag("FLAGS_check_nan_inf_level", 0,
             "0: fatal on nan/inf; >0: log only")
 define_flag("FLAGS_benchmark", False, "emit per-step timing logs")
+define_flag("FLAGS_bn_pallas", False,
+            "route training BatchNorm through the Pallas streaming "
+            "kernels (ops/bn_pallas.py). Default OFF: measured SLOWER "
+            "than XLA's BN fusions on v5e NCHW shapes (165-220 vs "
+            "263-395 GB/s - the unaligned spatial lane dim defeats "
+            "Pallas block DMA; benchmarks/RESULTS.md round-5)")
 define_flag("FLAGS_use_stride_kernel", True, "views share storage (no-op on XLA)")
 define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "gc threshold (XLA-managed)")
 define_flag("FLAGS_low_precision_op_list", 0, "record AMP op dtype decisions")

@@ -198,7 +198,22 @@ def apply_op(fn: Callable, *inputs, _op_name: Optional[str] = None, **kwargs):
             full = maybe_autocast_inputs(name, full)
         return fn(*full, **kwargs)
 
-    out, vjp_fn = jax.vjp(pure, *(arrs[i] for i in tensor_pos))
+    primals = tuple(arrs[i] for i in tensor_pos)
+    if getattr(fn, "_direct_custom_vjp", False) and \
+            any(isinstance(a, jax.core.Tracer) for a in primals):
+        # fn carries its own jax.custom_vjp and we are inside an outer
+        # jax transform (jitted TrainStep value_and_grad): calling
+        # jax.vjp here would put the op's forward under the OUTER
+        # transform's jvp, which custom_vjp (and Pallas kernels) do not
+        # support. Call fn directly so the outer AD engages the custom
+        # rule; the tape's vjp is built lazily (re-running the forward)
+        # for the eager-replay path, which traced tensors never take.
+        out = pure(*primals)
+
+        def vjp_fn(cts, _pure=pure, _primals=primals):
+            return jax.vjp(_pure, *_primals)[1](cts)
+    else:
+        out, vjp_fn = jax.vjp(pure, *primals)
 
     multi = isinstance(out, (tuple, list))
     outs = list(out) if multi else [out]

@@ -1056,6 +1056,7 @@ class GPTSpmdTrainer:
         use_fused = self.fused_optimizer
         if use_fused:
             from ..ops.fused_adamw import (fused_adamw_update,
+                                           fused_adamw_update8,
                                            fused_adamw_eligible)
             b1f, b2f = float(b1), float(b2)
             inv_bc1 = 1.0 / (1.0 - b1f ** tf)
@@ -1072,7 +1073,6 @@ class GPTSpmdTrainer:
             if _is8(m):
                 # int8 moment storage: (q, scale) pairs ride the fused
                 # kernel's int8 variant (moment8 implies fused+eligible)
-                from ..ops.fused_adamw import fused_adamw_update8
                 p2, mq, msc, vq, vsc = fused_adamw_update8(
                     p, g, m[0], m[1], v[0], v[1], scale, inv_bc1,
                     inv_bc2, step.astype(jnp.int32),

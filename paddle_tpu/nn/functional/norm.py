@@ -16,6 +16,21 @@ __all__ = ["batch_norm", "layer_norm", "group_norm", "instance_norm",
            "local_response_norm", "rms_norm"]
 
 
+def _update_running_stats(running_mean, running_var, m_t, v_t,
+                          momentum, x, ch_axis):
+    # paddle momentum convention: running = momentum*running +
+    # (1-momentum)*batch, var unbiased by n/(n-1)
+    with no_grad():
+        n = x.size // x.shape[ch_axis]
+        unbiased = v_t._data * (n / max(n - 1, 1))
+        running_mean._data = (momentum * running_mean._data +
+                              (1 - momentum) * m_t._data).astype(
+            running_mean._data.dtype)
+        running_var._data = (momentum * running_var._data +
+                             (1 - momentum) * unbiased).astype(
+            running_var._data.dtype)
+
+
 def batch_norm(x, running_mean, running_var, weight=None, bias=None,
                training=False, momentum=0.9, epsilon=1e-5,
                data_format="NCHW", use_global_stats=None, name=None):
@@ -24,6 +39,38 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
     use_batch = training and not use_global_stats
 
     if use_batch:
+        # Pallas streaming BN (ops/bn_pallas.py), OPT-IN via
+        # FLAGS_bn_pallas and default OFF: measured SLOWER than XLA's
+        # BN fusions on v5e NCHW shapes (165-220 vs 263-395 GB/s — the
+        # unaligned spatial lane dim defeats Pallas block DMA; XLA
+        # re-layouts globally and wins; benchmarks/RESULTS.md round-5).
+        # Kept: the custom_vjp collapses BN backward to a per-channel
+        # FMA, and C-minor layouts (point clouds, 3-D voxels with
+        # aligned S) may flip the verdict per-model.
+        import jax as _jax
+        from ...framework.flags import flag_value
+        pallas_ok = False
+        if flag_value("FLAGS_bn_pallas") and ch_axis == 1 \
+                and x.ndim >= 3 \
+                and _jax.default_backend() in ("tpu", "axon") \
+                and _jax.device_count() == 1:
+            from ...ops.bn_pallas import bn_train, bn_train_eligible
+            pallas_ok = bn_train_eligible(x._data)
+        if pallas_ok:
+            args = [a for a in (x, weight, bias) if a is not None]
+            nw = len(args) - 1
+
+            def f_pallas(a, *wb):
+                w_ = wb[0] if weight is not None else None
+                b_ = wb[nw - 1] if bias is not None else None
+                return bn_train(a, w_, b_, epsilon)
+
+            f_pallas._direct_custom_vjp = True
+            out, m_t, v_t = apply_op(f_pallas, *args,
+                                     _op_name="batch_norm")
+            _update_running_stats(running_mean, running_var, m_t, v_t,
+                                  momentum, x, ch_axis)
+            return out
         # compute batch stats; update running stats (paddle momentum
         # convention: running = momentum*running + (1-momentum)*batch)
         def stats(a):
@@ -39,15 +86,8 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
             v = jnp.maximum(s2 / n - m * m, 0.0)
             return m, v
         m_t, v_t = apply_op(stats, x, _op_name="bn_stats")
-        with no_grad():
-            n = x.size // x.shape[ch_axis]
-            unbiased = v_t._data * (n / max(n - 1, 1))
-            running_mean._data = (momentum * running_mean._data +
-                                  (1 - momentum) * m_t._data).astype(
-                running_mean._data.dtype)
-            running_var._data = (momentum * running_var._data +
-                                 (1 - momentum) * unbiased).astype(
-                running_var._data.dtype)
+        _update_running_stats(running_mean, running_var, m_t, v_t,
+                              momentum, x, ch_axis)
         mean_used, var_used = m_t, v_t
     else:
         mean_used, var_used = running_mean, running_var

@@ -1,0 +1,77 @@
+"""Pallas training BatchNorm (ops/bn_pallas.py) vs the XLA reference:
+forward values, batch stats, and all three gradients, in interpret
+mode on CPU. The real-TPU engagement is measured by bench_resnet50."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.ops.bn_pallas import bn_train, bn_train_eligible
+
+EPS = 1e-5
+
+
+def _ref(x, g, b, relu=False):
+    xf = x.astype(jnp.float32)
+    mu = xf.mean(axis=(0, 2, 3), keepdims=True)
+    var = xf.var(axis=(0, 2, 3), keepdims=True)
+    y = (xf - mu) * jax.lax.rsqrt(var + EPS)
+    y = y * g.reshape(1, -1, 1, 1) + b.reshape(1, -1, 1, 1)
+    if relu:
+        y = jnp.maximum(y, 0.0)
+    return y.astype(x.dtype)
+
+
+@pytest.mark.parametrize("relu", [False, True])
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+def test_bn_train_matches_reference(relu, dtype):
+    rng = np.random.RandomState(0)
+    N, C, H, W = 4, 16, 6, 5      # S=30: not lane-aligned on purpose
+    x = jnp.asarray(rng.randn(N, C, H, W).astype(np.float32), dtype)
+    g = jnp.asarray(rng.rand(C).astype(np.float32) + 0.5)
+    b = jnp.asarray(rng.randn(C).astype(np.float32) * 0.1)
+    assert bn_train_eligible(x)
+
+    def f_pallas(x, g, b):
+        y, m, v = bn_train(x, g, b, EPS, relu, True)
+        return (y.astype(jnp.float32) ** 2).sum(), (y, m, v)
+
+    def f_ref(x, g, b):
+        y = _ref(x, g, b, relu)
+        return (y.astype(jnp.float32) ** 2).sum(), y
+
+    (l1, (y1, m1, v1)), g1 = jax.value_and_grad(
+        f_pallas, argnums=(0, 1, 2), has_aux=True)(x, g, b)
+    (l2, y2), g2 = jax.value_and_grad(
+        f_ref, argnums=(0, 1, 2), has_aux=True)(x, g, b)
+
+    tol = dict(rtol=2e-2, atol=2e-2) if dtype == jnp.bfloat16 \
+        else dict(rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(y1, np.float32),
+                               np.asarray(y2, np.float32), **tol)
+    xf = np.asarray(x, np.float32)
+    np.testing.assert_allclose(np.asarray(m1), xf.mean((0, 2, 3)),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(v1), xf.var((0, 2, 3)),
+                               rtol=1e-3, atol=1e-4)
+    for a1, a2 in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a1, np.float32),
+                                   np.asarray(a2, np.float32), **tol)
+    np.testing.assert_allclose(float(l1), float(l2),
+                               rtol=1e-2 if dtype == jnp.bfloat16
+                               else 1e-5)
+
+
+def test_bn_train_no_affine():
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(2, 8, 4, 4).astype(np.float32))
+    y, m, v = bn_train(x, None, None, EPS, False, True)
+    ref = _ref(x, jnp.ones((8,)), jnp.zeros((8,)))
+    np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_bn_eligibility():
+    assert not bn_train_eligible(jnp.zeros((4, 7, 6, 6)))   # C % 8
+    assert not bn_train_eligible(jnp.zeros((16, 16)))       # rank
+    assert bn_train_eligible(jnp.zeros((1, 64, 112, 112)))
