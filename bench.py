@@ -3,13 +3,14 @@
 This is the BASELINE.json north-star config (GPT-3 1.3B class: hidden
 2048, 24 layers, dh=128) running a full AdamW training step — bf16
 compute, bf16 master weights updated with exact stochastic rounding,
-bf16 Adam moments, Pallas flash attention (grid-pipelined Mosaic
-kernels), int8-MXU forward matmuls with exact bf16 backward
-(ops/quant_matmul.py; 40-step loss parity vs bf16 within 3e-4 —
-benchmarks/RESULTS.md), a single-pass Pallas AdamW update with
-in-kernel stochastic-rounding PRNG (ops/fused_adamw.py), "save_qkv_ffn"
-remat policy (saves only the qkv/ffn1 projections; backward re-runs the
-flash forward kernel and the elementwise tail), vocab-chunked fused
+int8 Adam moments (m int8-SR, v sqrt-int8-SR, per-row scales —
+ops/fused_adamw.fused_adamw_update8; 300-step parity in
+benchmarks/RESULTS.md), Pallas flash attention (grid-pipelined Mosaic
+kernels, whole-sequence blocks), ALL-int8 MXU block matmuls (fwd +
+dgrad RTN, wgrad stochastic-rounding — ops/quant_matmul.py; 500-step
+parity), producer-fused gelu->quantize, a single-pass Pallas AdamW
+update with in-kernel stochastic-rounding PRNG, "save_main" remat
+(save_qkv_ffn until int8 moments freed the HBM), unchunked fused
 cross-entropy.
 
 Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
@@ -53,7 +54,7 @@ def main():
     mesh = build_mesh(n_devices=1, pipe=1, model=1, fsdp=1, sep=1)
     trainer = GPTSpmdTrainer(
         cfg, mesh, microbatches=1,
-        remat="save_qkv_ffn" if on_tpu else False,
+        remat="save_main" if on_tpu else False,  # save_qkv_ffn until moment8 freed the HBM (RESULTS.md r5)
         moment_dtype=moment_dtype,
         master_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
         quant8="wgrad" if on_tpu else False,

@@ -24,7 +24,7 @@ def main():
                         num_heads=16, max_seq_len=1024, dtype=jnp.bfloat16)
         mesh = build_mesh(n_devices=1, pipe=1, model=1, fsdp=1, sep=1)
         trainer = GPTSpmdTrainer(cfg, mesh, microbatches=1,
-                                 remat="save_qkv_ffn",
+                                 remat="save_main",
                                  moment_dtype=jnp.bfloat16,
                                  master_dtype=jnp.bfloat16,
                                  quant8="wgrad",
