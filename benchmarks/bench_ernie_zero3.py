@@ -22,7 +22,7 @@ def main():
         kw = dict(vocab_size=18000, hidden_size=768, num_hidden_layers=12,
                   num_attention_heads=12, intermediate_size=3072,
                   max_position_embeddings=512)
-        B, T, steps = 16, 128, 10
+        B, T, steps = 256, 128, 10
     else:
         kw = dict(vocab_size=512, hidden_size=64, num_hidden_layers=2,
                   num_attention_heads=4, intermediate_size=128,
