@@ -88,6 +88,11 @@ def _worker_main(model_prefix: str, listen_port: int, next_addr: str,
     conn, _ = srv.accept()
     _nodelay(conn)
     try:
+        # diagnostic dwell per micro-batch: lets a 1-core host DEMONSTRATE
+        # the pipeline's stage overlap (sleeps overlap where CPU-bound
+        # compute cannot; tests/test_dist_model_mp.py asserts the
+        # (M + S - 1) x dwell pipelined wall against the M x S serial one)
+        dwell_s = float(os.environ.get("PTPU_STAGE_DWELL_MS", "0")) / 1e3
         while True:
             msg = _recv(conn)
             if msg is None or msg[0] == "stop":
@@ -95,6 +100,8 @@ def _worker_main(model_prefix: str, listen_port: int, next_addr: str,
             tag, payload = msg
             outs = pred.run([np.asarray(x) for x in payload])
             outs = [o.copy_to_cpu() for o in outs]
+            if dwell_s:
+                time.sleep(dwell_s)
             _send(nxt if nxt is not None else conn, (tag, outs))
         if nxt is not None:
             _send(nxt, ("stop", None))

@@ -98,3 +98,29 @@ def test_int8_precision_composes_across_processes(tmp_path):
         got = dm.run([x])[0]
     scale = np.max(np.abs(ref))
     assert np.max(np.abs(got - ref)) < 0.05 * scale + 1e-3
+
+
+def test_stage_overlap_arithmetic(tmp_path, monkeypatch):
+    """The credit-window pipeline OVERLAPS stages: with a per-micro-
+    batch dwell D injected into every stage worker (PTPU_STAGE_DWELL_MS
+    — sleeps overlap even on a 1-core host, where CPU-bound compute
+    cannot), M micro-batches through S stages must take ~(M + S - 1) x D,
+    not the serial M x S x D. This pins the favorable regime the +63%
+    1-core serving tax (benchmarks/RESULTS.md) cannot show."""
+    from paddle_tpu.inference.dist_model_mp import (DistModelMP,
+                                                    DistModelConfig)
+    _, (p1, p2) = _export_stages(tmp_path)
+    M, S, D = 6, 2, 0.06
+    monkeypatch.setenv("PTPU_STAGE_DWELL_MS", str(int(D * 1000)))
+    x = np.random.RandomState(2).randn(4 * M, 8).astype(np.float32)
+    with DistModelMP(DistModelConfig([p1, p2],
+                                     num_micro_batches=M)) as dm:
+        dm.run([x])                       # warm the pipeline
+        t0 = time.perf_counter()
+        dm.run([x])
+        wall = time.perf_counter() - t0
+    serial = M * S * D
+    pipelined = (M + S - 1) * D
+    # must beat serial decisively and cannot beat the schedule bound
+    assert wall < 0.8 * serial, (wall, serial)
+    assert wall >= pipelined * 0.9, (wall, pipelined)
