@@ -195,3 +195,25 @@ def test_trainer_moment8_requires_fused():
     with pytest.raises(ValueError, match="moment8"):
         GPTSpmdTrainer(cfg, build_mesh(1, 1, 1, 1, 1),
                        fused_optimizer=False, moment8=True)
+
+
+def test_moment8_state_checkpoint_roundtrip(tmp_path):
+    """(q, scale) tuple leaves must survive paddle.save/load with their
+    TUPLE-ness intact — _adamw dispatches on isinstance(leaf, tuple),
+    so a serializer that returns lists would silently break resume.
+    (Full TPU resume verified live on-chip; RESULTS.md round-5.)"""
+    import paddle_tpu as paddle
+    from paddle_tpu.ops.fused_adamw import moment8_init
+    mq, msc, vq, vsc = moment8_init(jnp.zeros((64, 256)))
+    state = {"step": jnp.ones((), jnp.int32),
+             "m": {"w": (mq, msc), "b": jnp.zeros((8,))},
+             "v": {"w": (vq, vsc), "b": jnp.zeros((8,))}}
+    p = str(tmp_path / "m8.pdparams")
+    paddle.save(state, p)
+    got = paddle.load(p)
+    assert isinstance(got["m"]["w"], tuple) and len(got["m"]["w"]) == 2
+    assert isinstance(got["v"]["w"], tuple)
+    q2, s2 = got["m"]["w"]
+    assert np.asarray(q2).dtype == np.int8
+    np.testing.assert_array_equal(np.asarray(q2), np.asarray(mq))
+    np.testing.assert_allclose(np.asarray(s2), np.asarray(msc))
