@@ -53,6 +53,7 @@ _FULL_TIER_FILES = {
     # measured >30s each on the 1-core host (--durations, r5)
     "test_fft_signal_utils.py", "test_baseline_configs.py",
     "test_int8_guard.py", "test_fused_ce.py",
+    "test_fuse_ln_modes.py",
 }
 
 
