@@ -1073,6 +1073,16 @@ class GPTSpmdTrainer:
             if _is8(m):
                 # int8 moment storage: (q, scale) pairs ride the fused
                 # kernel's int8 variant (moment8 implies fused+eligible)
+                if not use_fused:
+                    # e.g. a moment8 checkpoint resumed on a trainer
+                    # built without the fused optimizer (CPU debug):
+                    # fail with the diagnosis, not an UnboundLocalError
+                    raise RuntimeError(
+                        "opt_state carries int8 (q, scale) moment "
+                        "pairs but this trainer runs without the "
+                        "fused optimizer; rebuild with moment8=True "
+                        "on a single-device TPU mesh, or dequantize "
+                        "the state via ops.fused_adamw.moment8_unpack")
                 p2, mq, msc, vq, vsc = fused_adamw_update8(
                     p, g, m[0], m[1], v[0], v[1], scale, inv_bc1,
                     inv_bc2, step.astype(jnp.int32),

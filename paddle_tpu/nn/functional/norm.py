@@ -20,6 +20,12 @@ def _update_running_stats(running_mean, running_var, m_t, v_t,
                           momentum, x, ch_axis):
     # paddle momentum convention: running = momentum*running +
     # (1-momentum)*batch, var unbiased by n/(n-1)
+    if getattr(m_t, "_data", None) is None:
+        # static-graph capture: the batch stats are lazy Variables with
+        # no concrete value. Static programs carry stats explicitly
+        # (module docstring) — the eager in-place EMA has no meaning
+        # at capture time and used to crash on _data=None here.
+        return
     with no_grad():
         n = x.size // x.shape[ch_axis]
         unbiased = v_t._data * (n / max(n - 1, 1))
@@ -52,8 +58,11 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         pallas_ok = False
         if flag_value("FLAGS_bn_pallas") and ch_axis == 1 \
                 and x.ndim >= 3 \
+                and getattr(x, "_data", None) is not None \
                 and _jax.default_backend() in ("tpu", "axon") \
                 and _jax.device_count() == 1:
+            # _data is None for static-graph Variables (lazy capture):
+            # those must fall through to apply_op's _lazy_cls dispatch
             from ...ops.bn_pallas import bn_train, bn_train_eligible
             pallas_ok = bn_train_eligible(x._data)
         if pallas_ok:

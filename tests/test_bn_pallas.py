@@ -75,3 +75,25 @@ def test_bn_eligibility():
     assert not bn_train_eligible(jnp.zeros((4, 7, 6, 6)))   # C % 8
     assert not bn_train_eligible(jnp.zeros((16, 16)))       # rank
     assert bn_train_eligible(jnp.zeros((1, 64, 112, 112)))
+
+
+def test_static_graph_bn_training_capture():
+    """Static-graph capture of a TRAINING BatchNorm must not touch the
+    eager running-stats EMA (lazy Variables have no value at capture
+    time — this crashed on _data=None before round-5 part 2), with the
+    Pallas flag in either state."""
+    import paddle_tpu as paddle
+    import paddle_tpu.static as static
+    from paddle_tpu.framework.flags import set_flags
+    for flag in (True, False):
+        set_flags({"FLAGS_bn_pallas": flag})
+        try:
+            main, start = static.Program(), static.Program()
+            with static.program_guard(main, start):
+                x = static.data("x", [4, 16, 8, 8], "float32")
+                bn = paddle.nn.BatchNorm2D(16)
+                bn.train()
+                y = bn(x)
+            assert tuple(y.shape) == (4, 16, 8, 8)
+        finally:
+            set_flags({"FLAGS_bn_pallas": False})

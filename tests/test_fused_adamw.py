@@ -217,3 +217,22 @@ def test_moment8_state_checkpoint_roundtrip(tmp_path):
     assert np.asarray(q2).dtype == np.int8
     np.testing.assert_array_equal(np.asarray(q2), np.asarray(mq))
     np.testing.assert_allclose(np.asarray(s2), np.asarray(msc))
+
+
+def test_moment8_state_without_fused_optimizer_diagnoses():
+    """int8 (q, scale) moment pairs reaching a non-fused trainer must
+    fail with the diagnosis, not an UnboundLocalError (e.g. a moment8
+    checkpoint resumed on a CPU debug trainer)."""
+    from paddle_tpu.models.gpt import (GPTConfig, GPTSpmdTrainer,
+                                       build_mesh)
+    from paddle_tpu.ops.fused_adamw import moment8_init
+    cfg = GPTConfig(vocab_size=256, hidden_size=128, num_layers=1,
+                    num_heads=2, max_seq_len=32, dtype=jnp.float32)
+    tr = GPTSpmdTrainer(cfg, build_mesh(1, 1, 1, 1, 1), microbatches=1,
+                        fused_optimizer=False)
+    mq, msc, vq, vsc = moment8_init(jnp.zeros((256, 128)))
+    tr.opt_state["m"]["wte"] = (mq, msc)
+    tr.opt_state["v"]["wte"] = (vq, vsc)
+    ids = np.zeros((2, 32), np.int32)
+    with pytest.raises(RuntimeError, match="int8 .q, scale."):
+        tr.train_step(ids, ids)
