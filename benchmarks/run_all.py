@@ -16,11 +16,21 @@ SCRIPTS = ["bench_resnet50.py", "bench_bert_dp.py", "bench_gpt_hybrid.py",
 def main():
     here = os.path.dirname(os.path.abspath(__file__))
     for s in SCRIPTS:
-        # plain environment: each script resolves the repo root via
-        # benchmarks/_path.py, and PYTHONPATH must stay unset (it
-        # breaks axon TPU plugin registration). On CPU the multi-chip
+        # Each script resolves the repo root via benchmarks/_path.py,
+        # so REPO entries are dropped from PYTHONPATH — but non-repo
+        # entries must survive: the axon TPU plugin registers through
+        # PYTHONPATH (/root/.axon_site) in current images, and
+        # stripping it wholesale silently downgraded every child to
+        # 'backend axon not known' failures. On CPU the multi-chip
         # configs need the virtual 8-device mesh.
-        env = {k: v for k, v in os.environ.items() if k != "PYTHONPATH"}
+        env = dict(os.environ)
+        repo = os.path.dirname(here)
+        pp = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+              if p and os.path.abspath(p) != repo]
+        if pp:
+            env["PYTHONPATH"] = os.pathsep.join(pp)
+        else:
+            env.pop("PYTHONPATH", None)
         if env.get("JAX_PLATFORMS") == "cpu":
             flags = [f for f in env.get("XLA_FLAGS", "").split()
                      if "host_platform_device_count" not in f]
