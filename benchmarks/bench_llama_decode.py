@@ -103,10 +103,19 @@ def main():
         import subprocess as _sp
         import sys as _sys
         # each precision phase in a FRESH process (tunnel remote-compile
-        # degradation across large compiles — see _decode_phase.py)
-        env = {k: v for k, v in _os.environ.items()
-               if k != "PYTHONPATH"}
+        # degradation across large compiles — see _decode_phase.py).
+        # Keep non-repo PYTHONPATH entries: the axon TPU plugin
+        # registers through PYTHONPATH in current images (run_all.py
+        # had the same silent-downgrade bug).
         here = _os.path.dirname(_os.path.abspath(__file__))
+        env = dict(_os.environ)
+        _repo = _os.path.dirname(here)
+        _pp = [p for p in env.get("PYTHONPATH", "").split(_os.pathsep)
+               if p and _os.path.abspath(p) != _repo]
+        if _pp:
+            env["PYTHONPATH"] = _os.pathsep.join(_pp)
+        else:
+            env.pop("PYTHONPATH", None)
 
         def phase(precision):
             r = _sp.run(

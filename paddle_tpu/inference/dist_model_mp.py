@@ -145,12 +145,31 @@ class DistModelMP:
         self._fetch_srv.listen(1)
         fetch_port = self._fetch_srv.getsockname()[1]
 
-        env = {k: v for k, v in os.environ.items()
-               if k != "PYTHONPATH"}  # breaks the axon TPU plugin
-        env["JAX_PLATFORMS"] = env.get("PTPU_DIST_MODEL_PLATFORM",
-                                       "cpu")
+        # PYTHONPATH handling is platform-dependent: the axon TPU
+        # plugin registers through PYTHONPATH in current images AND
+        # its site dir forces the accelerator backend onto any child
+        # that can import it (JAX_PLATFORMS=cpu does not win). So the
+        # default CPU workers strip PYTHONPATH wholesale (load-
+        # bearing: a kept axon site hijacks them onto the chip and
+        # their cpu-exported StableHLO refuses to run), while workers
+        # explicitly pointed at an accelerator via
+        # PTPU_DIST_MODEL_PLATFORM keep the non-repo entries the
+        # plugin needs. Repo imports ride sys.argv[4] below.
         repo = os.path.dirname(os.path.dirname(
             os.path.dirname(os.path.abspath(__file__))))
+        platform = os.environ.get("PTPU_DIST_MODEL_PLATFORM", "cpu")
+        if platform == "cpu":
+            env = {k: v for k, v in os.environ.items()
+                   if k != "PYTHONPATH"}
+        else:
+            env = dict(os.environ)
+            pp = [p for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                  if p and os.path.abspath(p) != repo]
+            if pp:
+                env["PYTHONPATH"] = os.pathsep.join(pp)
+            else:
+                env.pop("PYTHONPATH", None)
+        env["JAX_PLATFORMS"] = platform
         ports: List[int] = []
         try:
             # spawn back to front so each stage can name its successor
