@@ -35,6 +35,10 @@ def main():
                          "never test")
     ap.add_argument("--guard-period", type=int, default=0)
     ap.add_argument("--ce-int8", action="store_true")
+    ap.add_argument("--remat", default="save_qkv_ffn",
+                    help="remat policy for BOTH runs (save_main = the "
+                         "committed bench recipe; numerics identical "
+                         "modulo f32 reassociation)")
     ap.add_argument("--moment8", action="store_true",
                     help="int8 moment storage on the quantized run "
                          "(the bf16 reference run keeps bf16 moments)")
@@ -60,7 +64,7 @@ def main():
 
     def make(quant8):
         return GPTSpmdTrainer(
-            cfg, mesh, microbatches=1, remat="save_qkv_ffn",
+            cfg, mesh, microbatches=1, remat=args.remat,
             moment_dtype=jnp.bfloat16, master_dtype=jnp.bfloat16,
             quant8=quant8, ce_chunks=4 if not args.ce_int8 else 1,
             ce_int8=bool(quant8) and args.ce_int8, seed=0,
