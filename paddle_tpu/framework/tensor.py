@@ -210,8 +210,22 @@ def apply_op(fn: Callable, *inputs, _op_name: Optional[str] = None, **kwargs):
         # for the eager-replay path, which traced tensors never take.
         out = pure(*primals)
 
-        def vjp_fn(cts, _pure=pure, _primals=primals):
-            return jax.vjp(_pure, *_primals)[1](cts)
+        def vjp_fn(cts, _pure=pure, _primals=primals, _name=name):
+            try:
+                return jax.vjp(_pure, *_primals)[1](cts)
+            except jax.errors.UnexpectedTracerError as e:
+                # the closed-over primals were tracers of an outer jax
+                # transform that has since exited (dead tracers) — fail
+                # HERE with the diagnosis instead of letting JAX's
+                # leaked-tracer error surface far from the cause
+                raise RuntimeError(
+                    f"eager tape replay of custom-vjp op '{_name}' "
+                    "reached a dead tracer: its forward ran under an "
+                    "outer jax transform (jit/grad/vmap) that has "
+                    "already finished, so the saved primals no longer "
+                    "exist. Run backward() inside the same transform, "
+                    "or keep the op's forward out of jax tracing for "
+                    "eager-tape use.") from e
     else:
         out, vjp_fn = jax.vjp(pure, *primals)
 

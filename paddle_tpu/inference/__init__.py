@@ -33,6 +33,7 @@ import numpy as np
 __all__ = [
     "Config", "Predictor", "create_predictor", "PrecisionType",
     "PlaceType", "Tensor", "get_version",
+    "ServingEngine", "SamplingParams",
 ]
 
 
@@ -372,6 +373,11 @@ class Predictor:
 def create_predictor(config: Config) -> Predictor:
     """paddle_infer::CreatePredictor analog."""
     return Predictor(config)
+
+
+# Continuous-batching LLM serving (slot-pool scheduler over the static
+# KV-cache decode path) — full docs in paddle_tpu/serving.
+from ..serving import SamplingParams, ServingEngine  # noqa: E402,F401
 
 
 def convert_to_mixed_precision(model_file: str, params_file: str,

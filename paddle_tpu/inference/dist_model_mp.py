@@ -91,8 +91,25 @@ def _worker_main(model_prefix: str, listen_port: int, next_addr: str,
         # diagnostic dwell per micro-batch: lets a 1-core host DEMONSTRATE
         # the pipeline's stage overlap (sleeps overlap where CPU-bound
         # compute cannot; tests/test_dist_model_mp.py asserts the
-        # (M + S - 1) x dwell pipelined wall against the M x S serial one)
+        # (M + S - 1) x dwell pipelined wall against the M x S serial one).
+        # Honored ONLY under an explicit debug marker or on the cpu
+        # platform — an operator inheriting the env var from a test
+        # session must not silently slow every production request.
         dwell_s = float(os.environ.get("PTPU_STAGE_DWELL_MS", "0")) / 1e3
+        if dwell_s:
+            import jax
+            if not (os.environ.get("PTPU_STAGE_DWELL_DEBUG")
+                    or jax.default_backend() == "cpu"):
+                sys.stderr.write(
+                    "PTPU_STAGE_DWELL_MS set but ignored: stage runs on "
+                    f"'{jax.default_backend()}' and "
+                    "PTPU_STAGE_DWELL_DEBUG is unset\n")
+                dwell_s = 0.0
+            else:
+                sys.stderr.write(  # log once, loudly — never silent
+                    f"stage dwell ACTIVE: {dwell_s * 1e3:.0f} ms per "
+                    "micro-batch (PTPU_STAGE_DWELL_MS diagnostic)\n")
+            sys.stderr.flush()
         while True:
             msg = _recv(conn)
             if msg is None or msg[0] == "stop":

@@ -360,6 +360,16 @@ class _Frame:
         return f
 
 
+def instructions_sans_caches(code):
+    """dis.get_instructions without CACHE entries, on every CPython:
+    3.11+ takes show_caches=False; 3.10 has no CACHE slots (and no
+    kwarg) so the plain call is already cache-free."""
+    try:
+        return list(dis.get_instructions(code, show_caches=False))
+    except TypeError:
+        return list(dis.get_instructions(code))
+
+
 class OpcodeExecutor:
     """Interprets one code object with concrete/traced values."""
 
@@ -374,7 +384,7 @@ class OpcodeExecutor:
         self.state = state  # shared across forks and callees
         self.call_depth = call_depth
         self.last_break_pc: Optional[int] = None
-        self.instrs = list(dis.get_instructions(code, show_caches=False))
+        self.instrs = instructions_sans_caches(code)
         self.off2idx = {i.offset: n for n, i in enumerate(self.instrs)}
 
     # -- entry ------------------------------------------------------------

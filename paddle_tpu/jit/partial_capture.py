@@ -171,8 +171,8 @@ class SegmentedFunction:
         # static pre-check: EVERY opcode must have a handler, so the
         # driver can never die mid-call on an unknown op after side
         # effects already ran (it could not safely re-run eagerly)
-        import dis
-        for ins in dis.get_instructions(fn.__code__, show_caches=False):
+        from .opcode_executor import instructions_sans_caches
+        for ins in instructions_sans_caches(fn.__code__):
             if not hasattr(OpcodeExecutor, "_op_" + ins.opname):
                 raise GraphBreak(
                     f"unsupported opcode {ins.opname} (pre-check)")

@@ -1,0 +1,84 @@
+"""Shared fixed-buffer KV-cache attention for serving decode.
+
+One pure-jax routine used by every causal LM's static-cache path
+(llama RoPE attention, gpt learned-position attention): write the new
+k/v block into the fixed ``[B, Tmax, KV, D]`` buffers at the write
+position (``dynamic_update_slice``) and attend over the causally
+masked full buffer.
+
+The write position ``p`` is either a SCALAR (the whole batch is at one
+position — the synchronized ``generate()`` decode) or a PER-ROW
+``[B]`` vector (every row at its own position — the continuous-batching
+slot-pool decode, ``paddle_tpu/serving``). Both lower to the same
+einsum contraction so per-row results are bitwise identical to the
+scalar path's, which is what makes the serving engine's greedy outputs
+token-identical to ``generate()``'s.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["cache_attend", "check_cache_pos"]
+
+
+def check_cache_pos(pos, t: int, Tmax: int) -> bool:
+    """Validate a static-cache write position against the buffer and
+    classify it: returns per_row (True when ``pos`` is a [B] vector).
+
+    When the position is concrete (not under a jax trace), a write past
+    the buffer fails HERE with a diagnosis — dynamic_update_slice would
+    otherwise silently clamp and corrupt the cache tail."""
+    pos_data = getattr(pos, "_data", pos)
+    per_row = getattr(pos_data, "ndim", 0) >= 1
+    concrete = pos if isinstance(pos, int) else (
+        None if isinstance(pos_data, jax.core.Tracer)
+        else int(np.asarray(pos_data).max()))
+    if concrete is not None and concrete + t > Tmax:
+        raise ValueError(
+            f"static cache overflow: pos {concrete} + {t} new "
+            f"tokens exceeds cache length {Tmax}")
+    return per_row
+
+
+def cache_attend(qr, kr, v, kc, vc, p, per_row: bool):
+    """Masked fixed-buffer cache attention.
+
+    qr: [B, t, H, D] position-encoded queries; kr/v: [B, t, KV, D] new
+    keys (position-encoded) / values; kc/vc: [B, Tmax, KV, D] cache
+    buffers; p: int32 write position — scalar, or [B] when ``per_row``.
+    GQA folds the query-group dim into the einsum against kv-head
+    caches instead of materializing a head-repeated cache copy.
+
+    Returns (out [B, t, H*D], kc', vc').
+    """
+    b, t, h, D = qr.shape
+    kv = kr.shape[2]
+    rep = h // kv
+    Tmax = kc.shape[1]
+    if per_row:
+        upd = lambda c, u, pi: jax.lax.dynamic_update_slice(
+            c, u.astype(c.dtype), (pi, 0, 0))
+        kc = jax.vmap(upd)(kc, kr, p)
+        vc = jax.vmap(upd)(vc, v, p)
+        qpos = p[:, None] + jnp.arange(t)[None, :]            # [B, t]
+        mask = jnp.arange(Tmax)[None, None, :] <= qpos[:, :, None]
+        maskx = mask[:, None, None]                    # [B,1,1,t,Tmax]
+    else:
+        kc = jax.lax.dynamic_update_slice(
+            kc, kr.astype(kc.dtype), (0, p, 0, 0))
+        vc = jax.lax.dynamic_update_slice(
+            vc, v.astype(vc.dtype), (0, p, 0, 0))
+        qpos = p + jnp.arange(t)[:, None]                     # [t, 1]
+        kpos = jnp.arange(Tmax)[None, :]                      # [1, Tmax]
+        mask = kpos <= qpos                          # causal over buffer
+        maskx = mask[None, None, None]                 # [1,1,1,t,Tmax]
+    qg = qr.reshape(b, t, kv, rep, D)
+    scores = jnp.einsum("bqgrd,bkgd->bgrqk",
+                        qg.astype(jnp.float32),
+                        kc.astype(jnp.float32)) / (D ** 0.5)
+    scores = jnp.where(maskx, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(qr.dtype)
+    out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, vc.astype(qr.dtype))
+    return out.reshape(b, t, h * D), kc, vc

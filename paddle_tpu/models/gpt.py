@@ -40,7 +40,8 @@ from ..nn.layer_base import Layer
 from ..nn.layer.common import Dropout, Embedding, Linear
 from ..nn.layer.norm import LayerNorm
 from ..nn.layer.container import LayerList
-from ..framework.tensor import Tensor
+from ..framework.tensor import Tensor, apply_op
+from ._decode_cache import cache_attend, check_cache_pos
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "GPTSpmdTrainer",
            "build_mesh"]
@@ -96,20 +97,40 @@ class GPTBlock(Layer):
             self.fc2 = Linear(cfg.ffn_size, cfg.hidden_size)
         self.drop = Dropout(cfg.dropout)
 
-    def forward(self, x):
+    def forward(self, x, cache=None):
+        """cache: optional (k_cache [b, Tmax, H, D], v_cache, pos) — the
+        fixed-buffer serving decode path (mirrors llama's static cache;
+        pos is a scalar or a per-row [b] vector of write positions).
+        Returns (out, cache') when given."""
         b, t, d = x.shape
         h = self.ln1(x)
         qkv = self.qkv(h)
         n_local = qkv.shape[-1] // (3 * self.cfg.head_dim)
         qkv = qkv.reshape([b, t, 3, n_local, self.cfg.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        attn = F.scaled_dot_product_attention(q, k, v, is_causal=True,
-                                              training=self.training)
-        attn = attn.reshape([b, t, n_local * self.cfg.head_dim])
+        new_cache = None
+        if cache is not None:
+            k_cache, v_cache, pos = cache
+            per_row = check_cache_pos(pos, t, k_cache.shape[1])
+
+            def f(q, k, v, kc, vc, p):
+                return cache_attend(q, k, v, kc, vc,
+                                    jnp.asarray(p, jnp.int32), per_row)
+
+            attn, kc2, vc2 = apply_op(f, q, k, v, k_cache, v_cache,
+                                      pos,
+                                      _op_name="gpt_static_cache_attn")
+            new_cache = (kc2, vc2, pos + t)
+        else:
+            attn = F.scaled_dot_product_attention(
+                q, k, v, is_causal=True, training=self.training)
+            attn = attn.reshape([b, t, n_local * self.cfg.head_dim])
+        # ONE tail for both paths: the engine's token-parity guarantee
+        # rides on cached and uncached decode sharing these exact ops
         x = x + self.drop(self.proj(attn))
         h = self.ln2(x)
         x = x + self.drop(self.fc2(F.gelu(self.fc1(h), approximate=True)))
-        return x
+        return x if new_cache is None else (x, new_cache)
 
 
 class GPTModel(Layer):
@@ -127,9 +148,28 @@ class GPTModel(Layer):
                                  for _ in range(cfg.num_layers)])
         self.ln_f = LayerNorm(cfg.hidden_size)
 
-    def forward(self, input_ids):
+    def forward(self, input_ids, caches=None):
         b, t = input_ids.shape
         from ..ops.creation import arange
+        if caches is not None:
+            # serving decode: learned positions come from the cache's
+            # write position (scalar, or per-row for the slot pool)
+            base = caches[0][2]
+
+            def mk_pos(p):
+                p = jnp.asarray(p, jnp.int32)
+                ar = jnp.arange(t, dtype=jnp.int32)
+                if p.ndim >= 1:
+                    return p[:, None] + ar[None, :]
+                return (p + ar)[None, :]
+
+            positions = apply_op(mk_pos, base, _op_name="gpt_cache_pos")
+            x = self.wte(input_ids) + self.wpe(positions)
+            new_caches = []
+            for blk, c in zip(self.blocks, caches):
+                x, nc = blk(x, c)
+                new_caches.append(nc)
+            return self.ln_f(x), new_caches
         pos = arange(t, dtype="int64").unsqueeze(0)
         x = self.wte(input_ids) + self.wpe(pos)
         for blk in self.blocks:
@@ -146,8 +186,13 @@ class GPTForCausalLM(Layer):
             self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size,
                                   bias_attr=False)
 
-    def forward(self, input_ids):
-        h = self.gpt(input_ids)
+    def forward(self, input_ids, caches=None):
+        if caches is not None:
+            h, new_caches = self.gpt(input_ids, caches=caches)
+            return self._head(h), new_caches
+        return self._head(self.gpt(input_ids))
+
+    def _head(self, h):
         if self.cfg.tie_embeddings:
             from ..ops.linalg import matmul
             return matmul(h, self.gpt.wte.weight, transpose_y=True)
@@ -355,10 +400,18 @@ class GPTSpmdTrainer:
         # (ops/fused_adamw.fused_adamw_update8). Parity-gated like every
         # quantization default: benchmarks/parity_int8.py --moment8.
         self.moment8 = bool(moment8)
-        if self.moment8 and not self.fused_optimizer:
+        if self.moment8 and not (self.fused_optimizer
+                                 and mesh.size == 1):
+            # mesh.size must be checked here too: fused_optimizer=True
+            # passed explicitly on a multi-device mesh would otherwise
+            # let the opaque fused_adamw_update8 pallas_call reach the
+            # partitioner, which replicates custom calls (same gate as
+            # quantize_rowwise_fast's device_count()==1)
             raise ValueError(
-                "moment8 rides the fused AdamW kernel (single-device "
-                "TPU mesh); it has no XLA fallback path")
+                "moment8 rides the fused AdamW kernel, which requires "
+                "a SINGLE-device TPU mesh (got fused_optimizer="
+                f"{self.fused_optimizer}, mesh.size={mesh.size}); it "
+                "has no XLA fallback path")
         # unroll factor for the per-stage layer scan: with the scan
         # rolled, every remat-saved residual round-trips HBM through a
         # dynamic-update-slice into the [L, ...] stacked buffer (plus a

@@ -10,6 +10,7 @@ under dist.to_static/DistModel, and with the fleet TP layer library when
 from __future__ import annotations
 
 import dataclasses
+from functools import partial
 from typing import Optional
 
 import jax
@@ -17,6 +18,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.tensor import apply_op
+from ._decode_cache import cache_attend, check_cache_pos
 from ..nn import functional as F
 from ..nn.layer_base import Layer
 from ..nn.layer.common import Embedding, Linear
@@ -68,12 +70,19 @@ def _rope_cache(head_dim: int, max_len: int, theta: float):
 
 
 def _apply_rope(x, cos, sin):
-    """x [B, T, H, D]; rotate pairs (x0,x1) per RoPE."""
+    """x [B, T, H, D]; rotate pairs (x0,x1) per RoPE.
+
+    cos/sin are [T, D/2] (shared positions) or [B, T, D/2] (per-row
+    positions — the serving slot-pool decode)."""
     d2 = x.shape[-1] // 2
     x1 = x[..., :d2]
     x2 = x[..., d2:]
-    c = cos[None, :, None, :]
-    s = sin[None, :, None, :]
+    if cos.ndim == 3:
+        c = cos[:, :, None, :]
+        s = sin[:, :, None, :]
+    else:
+        c = cos[None, :, None, :]
+        s = sin[None, :, None, :]
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
 
 
@@ -193,50 +202,28 @@ class LlamaAttention(Layer):
         """Fixed-size cache attention: write the new k/v block at ``pos``
         (dynamic_update_slice), attend over the masked full buffer.
         q/k/v arrive reshaped [b, t, heads_local, D]; cache =
-        (k_cache [b, Tmax, KV, D], v_cache, pos scalar)."""
-        cfg = self.cfg
-        b, t, h_local, D = (x.shape[0], q.shape[1], q.shape[2],
-                            cfg.head_dim)
-        kv_local = k.shape[2]
+        (k_cache [b, Tmax, KV, D], v_cache, pos). ``pos`` is a scalar
+        (whole batch at one position — generate()) or a [b] vector of
+        per-row positions (every row independent — the continuous-
+        batching slot pool, paddle_tpu/serving)."""
+        t = q.shape[1]
         k_cache, v_cache, pos = cache
-        Tmax = k_cache.shape[1]
-        concrete_pos = pos if isinstance(pos, int) else (
-            None if isinstance(getattr(pos, "_data", pos),
-                               jax.core.Tracer)
-            else int(np.asarray(getattr(pos, "_data", pos))))
-        if concrete_pos is not None and concrete_pos + t > Tmax:
-            # dynamic_update_slice would silently clamp and corrupt the
-            # cache tail — fail loudly while the position is checkable
-            raise ValueError(
-                f"static cache overflow: pos {concrete_pos} + {t} new "
-                f"tokens exceeds cache length {Tmax}")
+        per_row = check_cache_pos(pos, t, k_cache.shape[1])
         cos_full, sin_full = self._cos, self._sin
-        rep = h_local // kv_local
 
         def f(q, k, v, kc, vc, p):
             p = jnp.asarray(p, jnp.int32)
-            cos = jax.lax.dynamic_slice_in_dim(cos_full, p, t)
-            sin = jax.lax.dynamic_slice_in_dim(sin_full, p, t)
+            if per_row:
+                sl = lambda tbl, pi: jax.lax.dynamic_slice_in_dim(
+                    tbl, pi, t)
+                cos = jax.vmap(partial(sl, cos_full))(p)   # [b, t, D/2]
+                sin = jax.vmap(partial(sl, sin_full))(p)
+            else:
+                cos = jax.lax.dynamic_slice_in_dim(cos_full, p, t)
+                sin = jax.lax.dynamic_slice_in_dim(sin_full, p, t)
             qr = _apply_rope(q, cos, sin)
             kr = _apply_rope(k, cos, sin)
-            kc = jax.lax.dynamic_update_slice(
-                kc, kr.astype(kc.dtype), (0, p, 0, 0))
-            vc = jax.lax.dynamic_update_slice(
-                vc, v.astype(vc.dtype), (0, p, 0, 0))
-            # GQA without materializing a head-repeated cache copy: fold
-            # the query group dim into the einsum against kv-head caches
-            qg = qr.reshape(b, t, kv_local, rep, D)
-            scores = jnp.einsum("bqgrd,bkgd->bgrqk",
-                                qg.astype(jnp.float32),
-                                kc.astype(jnp.float32)) / (D ** 0.5)
-            qpos = p + jnp.arange(t)[:, None]          # [t, 1]
-            kpos = jnp.arange(Tmax)[None, :]           # [1, Tmax]
-            mask = kpos <= qpos                        # causal over buffer
-            scores = jnp.where(mask[None, None, None], scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-            out = jnp.einsum("bgrqk,bkgd->bqgrd", probs,
-                             vc.astype(q.dtype))
-            return out.reshape(b, t, h_local * D), kc, vc
+            return cache_attend(qr, kr, v, kc, vc, p, per_row)
 
         out, kc2, vc2 = apply_op(f, q, k, v, k_cache, v_cache, pos,
                                  _op_name="static_cache_attn")

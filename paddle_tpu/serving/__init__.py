@@ -1,0 +1,29 @@
+"""paddle_tpu.serving — continuous-batching LLM serving engine.
+
+Iteration-level scheduling (Orca) over a slot-pool static KV cache
+(vLLM's slot management, without paging — fixed ``(max_slots,
+max_len)`` buffers fit the repo's compile-once decode design): one
+compiled decode-step program serves ANY mix of in-flight requests, new
+requests are admitted into freed slots every step through a small set
+of power-of-2 prefill buckets, and finished sequences (EOS / length
+cap) are evicted immediately instead of idling their slot until the
+longest batch member finishes.
+
+    engine = ServingEngine(model, max_slots=8, max_len=512, eos_id=2)
+    req = engine.submit(prompt_ids, max_new_tokens=64)
+    done = engine.run()            # or step() per iteration
+    print(req.output_ids, engine.metrics.summary())
+
+Compile count is 1 decode program + O(log max_len) prefill buckets,
+asserted in tests/test_serving_engine.py via trace counting.
+"""
+from .engine import ServingEngine  # noqa: F401
+from .metrics import EngineMetrics  # noqa: F401
+from .sampling import SamplingParams, sample_token  # noqa: F401
+from .scheduler import (FIFOScheduler, Request, bucket_for,  # noqa: F401
+                        prefill_buckets)
+from .slot_cache import SlotKVCache  # noqa: F401
+
+__all__ = ["ServingEngine", "EngineMetrics", "SamplingParams",
+           "sample_token", "FIFOScheduler", "Request", "bucket_for",
+           "prefill_buckets", "SlotKVCache"]

@@ -1,0 +1,304 @@
+"""Continuous-batching serving engine over the static KV-cache decode
+path.
+
+ONE compiled decode-step program (fixed ``[max_slots, 1]`` token block,
+per-slot positions, active-slot mask) serves any mix of in-flight
+requests; prefill compiles once per power-of-2 length bucket. Compare
+``benchmarks/bench_llama_decode.py``'s synchronized path, where every
+sequence in a batch starts and stops together and slots idle while the
+longest request finishes — here freed slots are refilled from the
+queue at every iteration (Orca-style iteration-level scheduling), so
+ragged traffic keeps the batch dense.
+
+Synchronous API by design (the repo's serving story is one compiled
+program per step, driven by a host loop):
+
+    engine = ServingEngine(model, max_slots=8, max_len=256, eos_id=2)
+    r1 = engine.submit(prompt, max_new_tokens=32)
+    while engine.has_work():
+        finished = engine.step()
+    print(r1.output_ids, engine.metrics.summary())
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.tensor import Tensor
+from .metrics import EngineMetrics
+from .sampling import SamplingParams, sample_token
+from .scheduler import FIFOScheduler, Request, bucket_for
+from .slot_cache import SlotKVCache
+
+__all__ = ["ServingEngine"]
+
+
+class _ModelAdapter:
+    """Uniform view over the causal LMs that expose the static-cache
+    path (models/llama.py natively; models/gpt.py via its cache-aware
+    forward): a backbone callable taking (ids, caches), a logits head,
+    and the cache geometry."""
+
+    def __init__(self, model):
+        self.model = model
+        if hasattr(model, "llama"):          # LlamaForCausalLM
+            cfg = model.config
+            backbone = model.llama
+            self.call = lambda ids, caches: backbone(ids, None, caches)
+            self.head = model._head
+            self.num_layers = len(backbone.layers)
+            self.head_dim = cfg.head_dim
+            attn0 = backbone.layers[0].self_attn
+            kp = attn0.k_proj       # Linear (weight) or Int8Linear (wq)
+            kw = kp.weight if hasattr(kp, "weight") else kp.wq
+            self.kv_heads = kw.shape[-1] // cfg.head_dim
+            self.max_positions = cfg.max_position_embeddings
+            self.dtype = backbone.embed_tokens.weight._data.dtype
+        elif hasattr(model, "gpt"):          # GPTForCausalLM
+            cfg = model.cfg
+            backbone = model.gpt
+            self.call = lambda ids, caches: backbone(ids, caches=caches)
+            self.head = model._head
+            self.num_layers = len(backbone.blocks)
+            self.head_dim = cfg.head_dim
+            qw = backbone.blocks[0].qkv.weight
+            self.kv_heads = qw.shape[-1] // (3 * cfg.head_dim)
+            self.max_positions = cfg.max_seq_len
+            self.dtype = backbone.wte.weight._data.dtype
+        else:
+            raise TypeError(
+                f"{type(model).__name__} exposes no static-cache decode "
+                "path the serving engine can drive (expected a .llama "
+                "or .gpt backbone with a (k, v, pos) cache forward)")
+
+
+class ServingEngine:
+    """Slot-based continuous-batching engine (see module docstring)."""
+
+    def __init__(self, model, max_slots: int = 8,
+                 max_len: Optional[int] = None,
+                 eos_id: Optional[int] = None,
+                 min_bucket: int = 16,
+                 time_fn: Callable[[], float] = time.perf_counter):
+        self.adapter = _ModelAdapter(model)
+        model.eval()
+        self.max_slots = int(max_slots)
+        self.max_len = int(max_len or self.adapter.max_positions)
+        if self.max_len > self.adapter.max_positions:
+            raise ValueError(
+                f"max_len {self.max_len} exceeds the model's position "
+                f"range {self.adapter.max_positions}")
+        self.eos_id = eos_id
+        self.min_bucket = min(int(min_bucket), self.max_len)
+        self.cache = SlotKVCache(
+            self.adapter.num_layers, self.max_slots, self.max_len,
+            self.adapter.kv_heads, self.adapter.head_dim,
+            self.adapter.dtype)
+        self.scheduler = FIFOScheduler()
+        self.metrics = EngineMetrics(self.max_slots, time_fn)
+        self._params, self._buffers = model.raw_state()
+        self._decode_jit = None
+        self._prefill_jit = None
+        self._next_rid = 0
+        # python-side-effect counters bumped at TRACE time: the compile-
+        # count contract (1 decode + O(log max_len) prefill buckets) is
+        # asserted against these in tests
+        self.trace_counts = {"decode": 0, "prefill": {}}
+
+    # -- public API ----------------------------------------------------
+    def submit(self, prompt_ids, max_new_tokens: int = 16,
+               sampling: Optional[SamplingParams] = None) -> Request:
+        """Queue one request; returns its handle (tokens appear on it
+        as steps run)."""
+        ids = np.asarray(getattr(prompt_ids, "numpy", lambda: prompt_ids)()
+                         ).astype(np.int64)
+        if ids.ndim == 2 and ids.shape[0] == 1:   # [1, T] batch-of-one
+            ids = ids[0]
+        if ids.ndim != 1:
+            # a [B, T] batch must not silently flatten into ONE merged
+            # request — submit() takes one sequence per call
+            raise ValueError(
+                f"submit() takes a single prompt sequence; got shape "
+                f"{ids.shape}. Call submit() once per request.")
+        if ids.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if ids.size + max_new_tokens - 1 > self.max_len:
+            raise ValueError(
+                f"prompt ({ids.size}) + max_new_tokens "
+                f"({max_new_tokens}) - 1 exceeds max_len {self.max_len}")
+        sampling = sampling or SamplingParams()
+        sampling.validate()
+        req = Request(rid=self._next_rid, prompt=ids,
+                      max_new_tokens=int(max_new_tokens),
+                      sampling=sampling)
+        req._rng = np.random.RandomState(
+            sampling.seed if sampling.seed is not None
+            else 0x5EED + req.rid)
+        self._next_rid += 1
+        self.scheduler.add(req)
+        self.metrics.on_submit(req.rid)
+        return req
+
+    def has_work(self) -> bool:
+        return self.scheduler.has_pending() or \
+            bool(self.cache.active_slots())
+
+    def step(self) -> List[Request]:
+        """One engine iteration: admit into free slots (bucketed
+        prefill), then one decode step over every occupied slot, then
+        evict finished sequences. Returns requests finished this step."""
+        finished: List[Request] = []
+        # re-snapshot the weights so checkpoint loads / quantization on
+        # the live model object take effect next step (same pytree
+        # structure -> no retrace; the arrays are just jit arguments)
+        self._params, self._buffers = self.adapter.model.raw_state()
+        # 1) admission — freed slots refill BEFORE the decode so a new
+        # request's first decode token rides this very step
+        for slot, req in self.scheduler.admissions(
+                self.cache.free_slots()):
+            self._prefill(slot, req)
+            if req.finished:
+                self.cache.release(slot)
+                req.slot = None
+                finished.append(req)
+        # 2) one decode step over all occupied slots
+        active = self.cache.active_slots()
+        if active:
+            toks = np.zeros((self.max_slots, 1), np.int64)
+            pos = np.zeros((self.max_slots,), np.int32)
+            mask = np.zeros((self.max_slots,), bool)
+            for s in active:
+                req = self.cache.slots[s]
+                toks[s, 0] = req.out_tokens[-1]
+                pos[s] = req.next_pos
+                mask[s] = True
+            logits, ks, vs = self._decode_fn()(
+                self._params, self._buffers, toks, pos, mask,
+                self.cache.ks, self.cache.vs)
+            self.cache.ks, self.cache.vs = list(ks), list(vs)
+            logits = np.asarray(jax.device_get(logits))
+            for s in active:
+                req = self.cache.slots[s]
+                tok = sample_token(logits[s], req.sampling, req._rng)
+                req.out_tokens.append(tok)
+                self.metrics.on_token(req.rid)
+                if self._is_finished(req, tok):
+                    self.cache.release(s)
+                    req.slot = None
+                    finished.append(req)
+        self.metrics.on_step(len(active))
+        return finished
+
+    def run(self, max_steps: Optional[int] = None) -> List[Request]:
+        """Drive step() until the queue and every slot drain."""
+        done: List[Request] = []
+        steps = 0
+        while self.has_work():
+            done.extend(self.step())
+            steps += 1
+            if max_steps is not None and steps >= max_steps:
+                break
+        return done
+
+    # -- internals -----------------------------------------------------
+    def _is_finished(self, req: Request, tok: int) -> bool:
+        if self.eos_id is not None and tok == self.eos_id:
+            req.finished, req.finish_reason = True, "eos"
+        elif len(req.out_tokens) >= req.max_new_tokens:
+            req.finished, req.finish_reason = True, "length"
+        return req.finished
+
+    def _prefill(self, slot: int, req: Request) -> None:
+        """Run the bucketed prefill program for one request, write its
+        k/v into the slot row, and sample its first token (TTFT)."""
+        bucket = bucket_for(req.prompt_len, self.min_bucket,
+                            self.max_len)
+        ids = np.zeros((1, bucket), np.int64)
+        ids[0, :req.prompt_len] = req.prompt
+        logits, ks, vs = self._prefill_fn()(
+            self._params, self._buffers, ids,
+            np.int32(req.prompt_len), np.int32(slot),
+            self.cache.ks, self.cache.vs)
+        self.cache.ks, self.cache.vs = list(ks), list(vs)
+        self.cache.assign(slot, req)
+        req.slot = slot
+        tok = sample_token(np.asarray(jax.device_get(logits)),
+                           req.sampling, req._rng)
+        req.out_tokens.append(tok)
+        self.metrics.on_token(req.rid)
+        self._is_finished(req, tok)
+
+    def _prefill_fn(self):
+        """Prefill program, one compile per bucket length: run the
+        prompt through a local [1, bucket] static cache, take the
+        logits at the LAST REAL token (the bucket tail is padding), and
+        splice the local k/v into the slot row of the donated pool.
+        Pad-tail garbage in the row is harmless: the per-slot causal
+        mask hides positions > the current length, and each decode step
+        overwrites position ``len`` right before attending it."""
+        if self._prefill_jit is not None:
+            return self._prefill_jit
+        ad = self.adapter
+
+        def pure(params, buffers, ids, true_len, slot, ks, vs):
+            Lb = ids.shape[1]
+            self.trace_counts["prefill"][Lb] = \
+                self.trace_counts["prefill"].get(Lb, 0) + 1
+            shape = (1, Lb, ad.kv_heads, ad.head_dim)
+            local = [(jnp.zeros(shape, ad.dtype),
+                      jnp.zeros(shape, ad.dtype), 0)
+                     for _ in range(ad.num_layers)]
+            with ad.model.bind_state(params, buffers):
+                h, new_caches = ad.call(Tensor(ids), local)
+                h_last = jax.lax.dynamic_slice_in_dim(
+                    h._data, true_len - 1, 1, axis=1)
+                logits = ad.head(Tensor(h_last))._data[0, -1]
+            splice = lambda pool, c: jax.lax.dynamic_update_slice(
+                pool, getattr(c, "_data", c).astype(pool.dtype),
+                (slot, 0, 0, 0))
+            ks = [splice(p, c[0]) for p, c in zip(ks, new_caches)]
+            vs = [splice(p, c[1]) for p, c in zip(vs, new_caches)]
+            return logits, ks, vs
+
+        self._prefill_jit = jax.jit(pure,
+                                    donate_argnums=self._donate())
+        return self._prefill_jit
+
+    def _decode_fn(self):
+        """THE decode-step program (compiled once): every occupied slot
+        advances one token at its own position; the active-slot mask
+        pins inactive lanes to position 0 and zeroes their logits so
+        they stay numerically inert whatever garbage their row holds."""
+        if self._decode_jit is not None:
+            return self._decode_jit
+        ad = self.adapter
+
+        def pure(params, buffers, toks, pos, active, ks, vs):
+            self.trace_counts["decode"] += 1
+            pos_eff = jnp.where(active, pos, 0).astype(jnp.int32)
+            caches = [(k, v, pos_eff) for k, v in zip(ks, vs)]
+            with ad.model.bind_state(params, buffers):
+                h, new_caches = ad.call(Tensor(toks), caches)
+                logits = ad.head(h[:, -1:])._data[:, -1]
+            logits = jnp.where(active[:, None], logits, 0.0)
+            ks2 = [getattr(c[0], "_data", c[0]) for c in new_caches]
+            vs2 = [getattr(c[1], "_data", c[1]) for c in new_caches]
+            return logits, ks2, vs2
+
+        self._decode_jit = jax.jit(pure,
+                                   donate_argnums=self._donate())
+        return self._decode_jit
+
+    @staticmethod
+    def _donate():
+        """Donate the cache pools (args 5/6 of both programs) so the
+        update is in-place on device; CPU ignores donation and warns,
+        so skip it there."""
+        return () if jax.default_backend() == "cpu" else (5, 6)

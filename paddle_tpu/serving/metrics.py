@@ -1,0 +1,84 @@
+"""Per-engine serving metrics: tokens/s, TTFT, per-token latency
+percentiles, slot occupancy.
+
+The clock is injectable (``time_fn``) so benchmarks can drive the
+engine on a VIRTUAL timeline (arrival replay without sleeps) and tests
+can assert exact accounting with a fake clock.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+__all__ = ["EngineMetrics"]
+
+
+class _ReqStats:
+    __slots__ = ("t_submit", "t_first", "token_times")
+
+    def __init__(self, t_submit: float):
+        self.t_submit = t_submit
+        self.t_first: Optional[float] = None
+        self.token_times: List[float] = []
+
+
+class EngineMetrics:
+    def __init__(self, max_slots: int,
+                 time_fn: Callable[[], float] = time.perf_counter):
+        self.max_slots = max_slots
+        self.now = time_fn
+        self._reqs: Dict[int, _ReqStats] = {}
+        self._occupancy: List[int] = []       # active slots per step
+        self._t0: Optional[float] = None
+        self._t_last: Optional[float] = None
+
+    # -- event hooks (engine calls these) ------------------------------
+    def on_submit(self, rid: int) -> None:
+        t = self.now()
+        self._reqs[rid] = _ReqStats(t)
+        if self._t0 is None:
+            self._t0 = t
+        self._t_last = t
+
+    def on_token(self, rid: int) -> None:
+        t = self.now()
+        r = self._reqs[rid]
+        if r.t_first is None:
+            r.t_first = t
+        r.token_times.append(t)
+        self._t_last = t
+
+    def on_step(self, active_slots: int) -> None:
+        self._occupancy.append(active_slots)
+        self._t_last = self.now()
+
+    # -- aggregation ---------------------------------------------------
+    def summary(self) -> Dict[str, float]:
+        toks = sum(len(r.token_times) for r in self._reqs.values())
+        wall = ((self._t_last - self._t0)
+                if self._t0 is not None and self._t_last is not None
+                else 0.0)
+        ttft = [r.t_first - r.t_submit for r in self._reqs.values()
+                if r.t_first is not None]
+        # per-token (inter-token) latency: gaps between consecutive
+        # tokens of one request — the stream cadence a client sees
+        gaps: List[float] = []
+        for r in self._reqs.values():
+            gaps.extend(np.diff(r.token_times).tolist())
+        pct = lambda xs, q: float(np.percentile(xs, q)) if xs else 0.0
+        return {
+            "requests": len(self._reqs),
+            "total_tokens": toks,
+            "wall_s": wall,
+            "tokens_per_s": toks / wall if wall > 0 else 0.0,
+            "ttft_p50_s": pct(ttft, 50),
+            "ttft_p99_s": pct(ttft, 99),
+            "tok_latency_p50_s": pct(gaps, 50),
+            "tok_latency_p99_s": pct(gaps, 99),
+            "occupancy_mean": (float(np.mean(self._occupancy))
+                               / self.max_slots
+                               if self._occupancy else 0.0),
+            "steps": len(self._occupancy),
+        }

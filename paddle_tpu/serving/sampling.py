@@ -1,0 +1,47 @@
+"""Token sampling for the serving engine.
+
+Host-side by design: continuous batching already requires a host
+round-trip every step (EOS detection + admission/eviction decisions),
+so sampling rides the same fetched ``[slots, vocab]`` logits instead
+of adding a second compiled program per sampling configuration.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["SamplingParams", "sample_token"]
+
+
+@dataclasses.dataclass
+class SamplingParams:
+    """Per-request sampling configuration.
+
+    temperature <= 0 means greedy (argmax); top_k == 0 means no top-k
+    truncation. ``seed`` pins the request's private RNG stream so a
+    replayed trace reproduces token-for-token.
+    """
+    temperature: float = 0.0
+    top_k: int = 0
+    seed: Optional[int] = None
+
+    def validate(self):
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+
+
+def sample_token(logits: np.ndarray, params: SamplingParams,
+                 rng: np.random.RandomState) -> int:
+    """Pick one token id from a [vocab] logits row."""
+    if params.temperature <= 0:
+        return int(np.argmax(logits))
+    z = logits.astype(np.float64) / params.temperature
+    if 0 < params.top_k < z.size:
+        kth = np.partition(z, -params.top_k)[-params.top_k]
+        z = np.where(z >= kth, z, -np.inf)
+    z -= z.max()
+    p = np.exp(z)
+    p /= p.sum()
+    return int(rng.choice(z.size, p=p))

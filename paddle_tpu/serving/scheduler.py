@@ -1,0 +1,112 @@
+"""Iteration-level request scheduling (Orca-style).
+
+The scheduler owns the waiting queue and the admission policy; it
+decides WHICH request enters WHICH freed slot at every engine step.
+Prefill lengths are rounded up to power-of-2 buckets so the number of
+compiled prefill programs stays O(log max_len) no matter how many
+distinct prompt lengths the traffic carries.
+"""
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Deque, List, Optional, Tuple
+
+import numpy as np
+
+from .sampling import SamplingParams
+
+__all__ = ["Request", "FIFOScheduler", "bucket_for", "prefill_buckets"]
+
+
+def _pow2_floor_bucket(min_bucket: int) -> int:
+    # normalize to a power of 2 so bucket_for and prefill_buckets
+    # enumerate the SAME set for any min_bucket
+    return 1 << (max(1, min_bucket) - 1).bit_length()
+
+
+def bucket_for(prompt_len: int, min_bucket: int, max_len: int) -> int:
+    """Smallest power-of-2 >= prompt_len, floored at min_bucket
+    (rounded up to a power of 2) and capped at max_len (the cap only
+    binds when max_len itself is not a power of 2; prompt_len <=
+    max_len is enforced at submit)."""
+    if prompt_len < 1:
+        raise ValueError(f"prompt_len must be >= 1, got {prompt_len}")
+    b = max(_pow2_floor_bucket(min_bucket),
+            1 << (prompt_len - 1).bit_length())
+    return min(b, max_len)
+
+
+def prefill_buckets(min_bucket: int, max_len: int) -> List[int]:
+    """All bucket lengths bucket_for can produce: the O(log max_len)
+    compile-count budget asserted in tests."""
+    out = []
+    b = _pow2_floor_bucket(min_bucket)
+    while b < max_len:
+        out.append(b)
+        b *= 2
+    out.append(max_len)
+    return out
+
+
+@dataclasses.dataclass
+class Request:
+    """One in-flight generation request."""
+    rid: int
+    prompt: np.ndarray                  # [T] int64
+    max_new_tokens: int
+    sampling: SamplingParams
+    out_tokens: List[int] = dataclasses.field(default_factory=list)
+    slot: Optional[int] = None
+    finished: bool = False
+    finish_reason: Optional[str] = None
+    _rng: Optional[np.random.RandomState] = None
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt.shape[0])
+
+    @property
+    def output_ids(self) -> List[int]:
+        return list(self.out_tokens)
+
+    @property
+    def full_ids(self) -> np.ndarray:
+        return np.concatenate(
+            [self.prompt, np.asarray(self.out_tokens, np.int64)])
+
+    # position the NEXT decode step writes at: the last generated
+    # token's k/v goes in right after the prompt + earlier outputs
+    @property
+    def next_pos(self) -> int:
+        return self.prompt_len + len(self.out_tokens) - 1
+
+
+class FIFOScheduler:
+    """First-come-first-served admission into freed slots.
+
+    Iteration-level: ``admissions`` is consulted every engine step, so
+    a request waits only for A slot, never for the whole batch."""
+
+    def __init__(self):
+        self._queue: Deque[Request] = deque()
+
+    def add(self, req: Request) -> None:
+        self._queue.append(req)
+
+    def has_pending(self) -> bool:
+        return bool(self._queue)
+
+    @property
+    def depth(self) -> int:
+        return len(self._queue)
+
+    def admissions(self, free_slots: List[int]) \
+            -> List[Tuple[int, Request]]:
+        """Pair queued requests with free slots, FCFS, one per slot."""
+        picked = []
+        for slot in free_slots:
+            if not self._queue:
+                break
+            picked.append((slot, self._queue.popleft()))
+        return picked

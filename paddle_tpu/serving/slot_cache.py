@@ -1,0 +1,55 @@
+"""Slot-pool KV cache: fixed ``(max_slots, max_len)`` buffers + slot
+bookkeeping.
+
+The pool is allocated ONCE; slots are leased to requests and recycled
+on eviction. Rows are never cleared on release — a freshly admitted
+request's prefill overwrites positions ``0..bucket-1`` of its row, and
+the per-slot causal mask (``kpos <= qpos`` in
+models/_decode_cache.cache_attend) keeps any stale tail beyond the
+current length invisible, so recycling costs zero device work.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+__all__ = ["SlotKVCache"]
+
+
+class SlotKVCache:
+    """Per-layer [max_slots, max_len, kv_heads, head_dim] k/v buffers
+    plus the slot lease table."""
+
+    def __init__(self, num_layers: int, max_slots: int, max_len: int,
+                 kv_heads: int, head_dim: int, dtype):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        self.max_slots = max_slots
+        self.max_len = max_len
+        shape = (max_slots, max_len, kv_heads, head_dim)
+        self.ks = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        self.vs = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        # lease table: slot -> request (None = free); requests carry
+        # their own position/length state
+        self.slots: List[Optional[object]] = [None] * max_slots
+
+    def free_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self.slots) if r is None]
+
+    def active_slots(self) -> List[int]:
+        return [i for i, r in enumerate(self.slots) if r is not None]
+
+    def assign(self, slot: int, req) -> None:
+        if self.slots[slot] is not None:
+            raise RuntimeError(f"slot {slot} is already leased")
+        self.slots[slot] = req
+
+    def release(self, slot: int) -> None:
+        if self.slots[slot] is None:
+            raise RuntimeError(f"slot {slot} is already free")
+        self.slots[slot] = None
+
+    @property
+    def occupancy(self) -> float:
+        return len(self.active_slots()) / self.max_slots

@@ -8,6 +8,7 @@ interpreter startup, so the env var alone is not enough — we must also
 update the jax config before any backend is initialized.
 """
 import os
+import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
@@ -57,6 +58,17 @@ _FULL_TIER_FILES = {
 }
 
 
+# shared interpreter-version gates (import in test files:
+# `from conftest import needs_monitoring, needs_311_bytecode`)
+needs_monitoring = pytest.mark.skipif(
+    not hasattr(sys, "monitoring"),
+    reason="jit.auto_capture rides sys.monitoring (CPython 3.12+)")
+needs_311_bytecode = pytest.mark.skipif(
+    sys.version_info < (3, 11),
+    reason="SOT bytecode executor targets the CPython 3.11+ opcode "
+           "set; older interpreters take the eager fallback")
+
+
 def pytest_configure(config):
     config.addinivalue_line(
         "markers",
@@ -64,7 +76,41 @@ def pytest_configure(config):
         "(run smoke with -m 'not full')")
 
 
+# ---------------------------------------------------------------------------
+# Old-jax environment gates. The codebase targets the jax.shard_map-era
+# surface; on pre-0.5 lines paddle_tpu installs compat shims
+# (paddle_tpu/__init__.py) that cover everything EXCEPT:
+#   - partial-auto shard_map (pipe>1 pipelining): axis_index/ppermute
+#     inside auto regions PartitionId-crash in old XLA lowering,
+#   - CPU multiprocess collectives (old jaxlib: unimplemented),
+#   - HLO collective-combining byte accounting (old XLA emits different
+#     collectives, breaking exact wire-byte laws),
+#   - RNG-sequence-sensitive training-trajectory asserts.
+# These tests run unchanged on the targeted jax and skip here.
+# ---------------------------------------------------------------------------
+_OLD_JAX_BLOCKED = {
+    "test_distributed.py::test_gpt_spmd_trainer_8dev",
+    "test_benchmarks_smoke.py::"
+    "test_benchmark_script_smoke[bench_gpt_hybrid.py]",
+    "test_moe_gpt.py::test_moe_rejects_gpipe_but_runs_under_1f1b",
+    "test_pipeline_1f1b.py::test_1f1b_matches_gpipe_two_steps",
+    "test_pipeline_1f1b.py::test_1f1b_inflight_memory_is_O_S_not_O_M",
+    "test_pipeline_scheduled.py::test_trainer_vpp_matches_gpipe",
+    "test_pipeline_scheduled.py::test_trainer_zb_matches_gpipe",
+    "test_multiproc_checkpoint.py::test_two_process_save_load_reshard",
+    "test_scaling_model.py::test_bert_dp_allreduce_matches_param_bytes",
+    "test_moe_layer.py::test_balance_loss_decreases_in_training",
+}
+
+
 def pytest_collection_modifyitems(config, items):
+    import paddle_tpu
+    old_jax = getattr(paddle_tpu, "_jax_compat_old_shard_map", False)
+    skip_old = pytest.mark.skip(
+        reason="needs the jax.shard_map-era surface; this environment "
+               "runs paddle_tpu's pre-0.5 jax compat shims")
     for item in items:
         if os.path.basename(str(item.fspath)) in _FULL_TIER_FILES:
             item.add_marker(pytest.mark.full)
+        if old_jax and item.nodeid.split("/")[-1] in _OLD_JAX_BLOCKED:
+            item.add_marker(skip_old)

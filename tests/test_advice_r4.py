@@ -4,10 +4,12 @@ monitoring-state reset. (The two medium items are covered in
 test_sot_bytecode.py and test_ps_device_cache.py.)"""
 import socket
 import struct
+import sys
 import threading
 import time
 
 import pytest
+from conftest import needs_monitoring
 
 
 def test_recv_msg_rejects_hostile_length_header():
@@ -91,6 +93,7 @@ def test_announce_join_keepalive_refreshes_key():
     assert store.kv["elastic/node/2"] == v0
 
 
+@needs_monitoring
 def test_auto_capture_sessions_see_code_disabled_by_prior_session():
     """sys.monitoring DISABLE state persists across free_tool_id; a new
     AutoCapture session must restart_events so earlier sessions'

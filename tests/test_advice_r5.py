@@ -1,0 +1,70 @@
+"""Regression tests for the round-5 advisor findings (ADVICE.md):
+dead-tracer diagnosis in the lazy custom-vjp replay, the moment8
+multi-device-mesh gate, and the stage-dwell debug gating. (The
+test_stage_overlap_arithmetic de-flake rides in test_dist_model_mp.py;
+the dwell env-var gating's honored path is exercised there too.)"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def test_direct_custom_vjp_dead_tracer_diagnosis():
+    """A _direct_custom_vjp op traced under an outer jit records a LAZY
+    vjp closure over the trace's primals. Replaying that closure after
+    the trace has exited must fail with the diagnosis, not with JAX's
+    leaked-tracer error pointing far from the cause."""
+    from paddle_tpu.framework.tensor import Tensor, apply_op
+
+    def dbl(a):
+        return a * 2.0
+    dbl._direct_custom_vjp = True
+
+    captured = {}
+
+    def traced(x):
+        t = Tensor(x, stop_gradient=False)
+        out = apply_op(dbl, t, _op_name="dbl")
+        captured["node"] = out.grad_node
+        return out._data
+
+    jax.jit(traced)(jnp.ones((3,), jnp.float32))
+    node = captured["node"]
+    assert node is not None          # the lazy-vjp branch was taken
+    with pytest.raises(RuntimeError, match="dead tracer"):
+        node.vjp_fn(jnp.ones((3,), jnp.float32))
+
+
+def test_direct_custom_vjp_eager_replay_still_works():
+    """The lazy closure must keep working when the primals are live
+    concrete arrays (the eager-tape path the laziness exists for)."""
+    from paddle_tpu.framework.tensor import Tensor, apply_op
+
+    def dbl(a):
+        return a * 2.0
+    dbl._direct_custom_vjp = True
+
+    t = Tensor(jnp.ones((3,), jnp.float32), stop_gradient=False)
+    out = apply_op(dbl, t, _op_name="dbl")
+    # concrete primals -> the standard jax.vjp branch records eagerly
+    (g,) = out.grad_node.vjp_fn(jnp.ones((3,), jnp.float32))
+    np.testing.assert_allclose(np.asarray(g), 2.0 * np.ones(3))
+
+
+def test_moment8_rejects_multi_device_mesh():
+    """fused_optimizer=True passed EXPLICITLY on a multi-device mesh
+    must not let moment8 through to the opaque fused_adamw_update8
+    pallas_call (the partitioner would replicate it); the constructor
+    gate requires mesh.size == 1, not just fused_optimizer."""
+    from paddle_tpu.models.gpt import (GPTConfig, GPTSpmdTrainer,
+                                       build_mesh)
+    cfg = GPTConfig(vocab_size=256, hidden_size=128, num_layers=1,
+                    num_heads=2, max_seq_len=32, dtype=jnp.float32)
+    mesh = build_mesh(2)             # 2 virtual cpu devices (conftest)
+    assert mesh.size == 2
+    with pytest.raises(ValueError, match="SINGLE-device"):
+        GPTSpmdTrainer(cfg, mesh, fused_optimizer=True, moment8=True)
+    # the original gate still holds on a single-device mesh
+    with pytest.raises(ValueError, match="moment8|SINGLE-device"):
+        GPTSpmdTrainer(cfg, build_mesh(1, 1, 1, 1, 1),
+                       fused_optimizer=False, moment8=True)

@@ -6,6 +6,9 @@ constants)."""
 import numpy as np
 
 import paddle_tpu as paddle
+from conftest import needs_monitoring
+
+
 from paddle_tpu import jit
 
 
@@ -17,6 +20,7 @@ def _frac(rep):
     return (w + p) / tot if tot else 0.0
 
 
+@needs_monitoring
 def test_gpt_eager_training_captures_and_learns():
     jit.reset_capture_report()
     import paddle_tpu.models.gpt as gptmod
@@ -47,6 +51,7 @@ def test_gpt_eager_training_captures_and_learns():
     assert losses[-1] < losses[2] - 0.05, losses
 
 
+@needs_monitoring
 def test_resnet18_and_mobilenet_capture_fraction():
     from paddle_tpu.vision import models as vm
 

@@ -16,7 +16,7 @@ HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 @pytest.mark.parametrize("script", [
     "bench_resnet50.py", "bench_bert_dp.py", "bench_gpt_hybrid.py",
     "bench_ernie_zero3.py", "bench_ppyoloe_infer.py",
-    "bench_llama_decode.py",
+    "bench_llama_decode.py", "bench_serving_engine.py",
 ])
 def test_benchmark_script_smoke(script):
     env = dict(os.environ, JAX_PLATFORMS="cpu",

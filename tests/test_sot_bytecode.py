@@ -10,6 +10,9 @@ import numpy as np
 import pytest
 
 import paddle_tpu as paddle
+from conftest import needs_311_bytecode
+
+
 from paddle_tpu import jit
 
 
@@ -25,6 +28,7 @@ def _exec_def(src):
     return ns["f"]
 
 
+@needs_311_bytecode
 def test_tensor_if_captures_via_bytecode():
     jit.reset_capture_report()
     f = jit.to_static(_exec_def("""
@@ -71,6 +75,7 @@ def test_nested_callee_tensor_branch():
     np.testing.assert_allclose(f(_t([-1.0])).numpy(), [-11.0])
 
 
+@needs_311_bytecode
 def test_branch_arms_update_different_locals():
     jit.reset_capture_report()
     f = jit.to_static(_exec_def("""
@@ -92,6 +97,7 @@ def test_branch_arms_update_different_locals():
     assert rep["graph_break_calls"] == 0
 
 
+@needs_311_bytecode
 def test_tensor_while_now_captures_via_segments():
     # round 4 upgraded this: a bytecode-level tensor while no longer
     # abandons the function — the body compiles as a segment per
@@ -147,6 +153,7 @@ def test_fstring_with_block_and_unpack():
     np.testing.assert_allclose(f(_t([4.0])).numpy(), [4.0])
 
 
+@needs_311_bytecode
 def test_interpreter_handles_kwargs_and_defaults():
     from paddle_tpu.jit.opcode_executor import OpcodeFunction
     import jax.numpy as jnp
@@ -284,6 +291,7 @@ def test_untaken_arm_attr_mutation_breaks_to_eager():
     assert h.v == 3.0
 
 
+@needs_311_bytecode
 def test_arm_local_dict_and_list_still_capture():
     # Building and mutating call-local containers inside the arms is
     # side-effect-free w.r.t. the outside world and must still capture.

@@ -6,6 +6,9 @@ import textwrap
 import numpy as np
 
 import paddle_tpu as paddle
+from conftest import needs_311_bytecode, needs_monitoring
+
+
 from paddle_tpu import jit
 
 
@@ -20,6 +23,7 @@ def _exec_def(src, extra=None):
     return ns["f"], ns
 
 
+@needs_311_bytecode
 def test_midbody_side_effect_compiles_prefix_and_suffix():
     jit.reset_capture_report()
     f, ns = _exec_def("""
@@ -42,6 +46,7 @@ def test_midbody_side_effect_compiles_prefix_and_suffix():
     assert rep["partial_compiled_fraction"] >= 0.5
 
 
+@needs_311_bytecode
 def test_segment_cache_reused_across_calls():
     jit.reset_capture_report()
     f, ns = _exec_def("""
@@ -59,6 +64,7 @@ def test_segment_cache_reused_across_calls():
     assert len(ns["SEEN"]) == 5
 
 
+@needs_311_bytecode
 def test_bytecode_tensor_while_compiled_body():
     jit.reset_capture_report()
     f, _ = _exec_def("""
@@ -77,6 +83,7 @@ def test_bytecode_tensor_while_compiled_body():
     assert rep["partial_segments_run"] >= 2  # body compiled per iter
 
 
+@needs_311_bytecode
 def test_partial_only_when_needed():
     # functions that capture whole must NOT go through segmentation
     jit.reset_capture_report()
@@ -109,6 +116,7 @@ def test_real_user_errors_surface_not_swallowed():
         raise AssertionError("expected the user error")
 
 
+@needs_monitoring
 def test_auto_capture_rebinds_hot_functions():
     import types
     mod = types.ModuleType("fake_user_models")
@@ -133,6 +141,7 @@ def test_auto_capture_rebinds_hot_functions():
     assert isinstance(mod.scale_add, types.FunctionType)
 
 
+@needs_monitoring
 def test_auto_capture_monitoring_overhead_free_when_cold():
     import types
     mod = types.ModuleType("fake_cold_models")
@@ -175,6 +184,7 @@ def test_runaway_tensor_while_finishes_eagerly_once():
     assert log == [1]
 
 
+@needs_monitoring
 def test_auto_capture_class_method_binds_self():
     import types as pytypes
     mod = pytypes.ModuleType("fake_method_models")
