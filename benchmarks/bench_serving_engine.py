@@ -23,6 +23,7 @@ tests/test_serving_engine.py.
 import _path  # noqa: F401  (repo-root import shim)
 
 import json
+import os
 import time
 
 import numpy as np
@@ -181,6 +182,20 @@ def main():
         "value": round(eng["tokens_per_s"], 1),
         "unit": "tokens/s",
         "vs_baseline": round(base["tokens_per_s"], 1)}))
+
+    # metrics snapshot (schema-guarded in tests/test_benchmarks_smoke):
+    # the engine summary keys are a STABLE contract, and the registry
+    # family list shows which subsystems published this run
+    from paddle_tpu.observability import default_registry
+    reg = default_registry()
+    print("METRICS " + json.dumps({
+        "engine_summary": {k: round(float(v), 6)
+                           for k, v in eng.items()},
+        "families": reg.families()}))
+    prom_out = os.environ.get("PTPU_PROM_OUT")
+    if prom_out:
+        with open(prom_out, "w") as f:
+            f.write(reg.to_prometheus())
 
 
 if __name__ == "__main__":

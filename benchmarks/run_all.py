@@ -1,19 +1,32 @@
 """Run every BASELINE config benchmark; one JSON line each
 (BASELINE.md: 'performance baselines must be produced by our own
 measurement harness'). Each script is standalone; failures don't stop
-the rest."""
+the rest.
+
+``--prom-out DIR`` additionally makes each instrumented script write
+its observability registry as Prometheus text exposition to
+``DIR/<script>.prom`` (via the PTPU_PROM_OUT env var) — the metrics
+snapshot that belongs next to the BENCH json."""
 import _path  # noqa: F401  (repo-root import shim)
 
+import argparse
 import os
 import subprocess
 import sys
 
 SCRIPTS = ["bench_resnet50.py", "bench_bert_dp.py", "bench_gpt_hybrid.py",
            "bench_ernie_zero3.py", "bench_ppyoloe_infer.py",
-           "bench_llama_decode.py"]
+           "bench_llama_decode.py", "bench_serving_engine.py"]
 
 
 def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--prom-out", default=None, metavar="DIR",
+                    help="write each script's Prometheus metrics "
+                         "snapshot to DIR/<script>.prom")
+    opts = ap.parse_args()
+    if opts.prom_out:
+        os.makedirs(opts.prom_out, exist_ok=True)
     here = os.path.dirname(os.path.abspath(__file__))
     for s in SCRIPTS:
         # Each script resolves the repo root via benchmarks/_path.py,
@@ -36,6 +49,9 @@ def main():
                      if "host_platform_device_count" not in f]
             flags.append("--xla_force_host_platform_device_count=8")
             env["XLA_FLAGS"] = " ".join(flags)
+        if opts.prom_out:
+            env["PTPU_PROM_OUT"] = os.path.join(
+                opts.prom_out, s.replace(".py", "") + ".prom")
         r = subprocess.run([sys.executable, os.path.join(here, s)],
                            capture_output=True, text=True, timeout=1800,
                            env=env)

@@ -31,12 +31,19 @@ class CommWatchdog:
     def __init__(self, store, rank: int, world_size: int,
                  timeout: float = 60.0, interval: float = 2.0,
                  on_failure: Optional[Callable] = None,
-                 auto_beat: bool = False):
+                 auto_beat: bool = False,
+                 flight_recorder=None, registry=None):
         """``auto_beat``: heartbeat from the background thread (process
         liveness only — a rank hung inside a collective still beats).
         Default False: the training loop must call beat() at step
         boundaries, so a hang IS detected once timeout < hang duration;
-        size timeout above the longest legitimate step."""
+        size timeout above the longest legitimate step.
+
+        Observability: each sweep publishes per-peer heartbeat age to
+        the ``ptpu_dist_heartbeat_age_seconds`` gauge; newly-detected
+        failures bump ``ptpu_dist_watchdog_failures_total`` and dump
+        the flight recorder (once) so the last N step records survive
+        the peer's death."""
         self.store = store
         self.rank = rank
         self.world_size = world_size
@@ -49,6 +56,22 @@ class CommWatchdog:
         self._exceptions: List[str] = []
         self._start_time = time.time()
         self._thread: Optional[threading.Thread] = None
+        from ..observability import default_recorder, default_registry
+        # `is None`, not truthiness: an empty FlightRecorder is falsy
+        self.flight_recorder = flight_recorder \
+            if flight_recorder is not None else default_recorder()
+        reg = registry if registry is not None else default_registry()
+        self._registry = reg
+        self._m_age = reg.gauge(
+            "ptpu_dist_heartbeat_age_seconds",
+            "seconds since each peer's last heartbeat",
+            labels=("rank",))
+        self._m_failures = reg.counter(
+            "ptpu_dist_watchdog_failures_total",
+            "peer failures detected (stale heartbeat or reported "
+            "exception)")
+        self._counted_failures: set = set()
+        self._dumped = False
 
     # -- lifecycle ---------------------------------------------------------
     def start(self):
@@ -116,22 +139,59 @@ class CommWatchdog:
                 "distributed watchdog: " + "; ".join(self._failed))
 
     # -- internals ---------------------------------------------------------
+    def _sweep(self) -> bool:
+        """One watchdog pass (the loop body, callable directly from
+        tests): refresh peer exception/staleness state, publish
+        heartbeat-age gauges, count new failures, and dump the flight
+        recorder the first time anything fails. Returns True when
+        failures exist."""
+        for r, msg in self.peer_exceptions().items():
+            note = f"rank {r} reported: {msg}"
+            if note not in self._exceptions:
+                self._exceptions.append(note)
+        # staleness recomputed each sweep: a rank that recovers
+        # (heartbeat resumes) drops off; exceptions stay sticky
+        ages = self.peer_ages()
+        for r, age in ages.items():
+            try:
+                self._m_age.labels(rank=r).set(age)
+            except Exception:
+                # telemetry must never kill the watchdog: past the
+                # registry's label-cardinality guard (world_size >
+                # max_label_sets) extra ranks just go unpublished
+                pass
+        stale_ranks = [(r, age) for r, age in ages.items()
+                       if age > self.timeout]
+        stale = [f"rank {r} heartbeat stale "
+                 f"({age:.1f}s > {self.timeout}s)"
+                 for r, age in stale_ranks]
+        self._failed = self._exceptions + stale
+        # dedup on STABLE keys (the stale note embeds a changing age,
+        # so the note string itself would re-count every sweep)
+        for key in ([("exc", n) for n in self._exceptions]
+                    + [("stale", r) for r, _ in stale_ranks]):
+            if key not in self._counted_failures:
+                self._counted_failures.add(key)
+                self._m_failures.inc()
+        if self._failed and not self._dumped:
+            self._dumped = True
+            try:
+                self.flight_recorder.record(
+                    "watchdog.failure", rank=self.rank,
+                    failures=list(self._failed))
+                self.flight_recorder.dump(
+                    reason=f"watchdog rank {self.rank}: "
+                           + "; ".join(self._failed),
+                    registry=self._registry)
+            except Exception:
+                pass       # telemetry must never kill the watchdog
+        return bool(self._failed)
+
     def _loop(self):
         while not self._stop.wait(self.interval):
             if self.auto_beat:
                 self.beat()
-            for r, msg in self.peer_exceptions().items():
-                note = f"rank {r} reported: {msg}"
-                if note not in self._exceptions:
-                    self._exceptions.append(note)
-            # staleness recomputed each sweep: a rank that recovers
-            # (heartbeat resumes) drops off; exceptions stay sticky
-            stale = [f"rank {r} heartbeat stale "
-                     f"({age:.1f}s > {self.timeout}s)"
-                     for r, age in self.peer_ages().items()
-                     if age > self.timeout]
-            self._failed = self._exceptions + stale
-            if self._failed and self.on_failure is not None:
+            if self._sweep() and self.on_failure is not None:
                 try:
                     self.on_failure(list(self._failed))
                 finally:

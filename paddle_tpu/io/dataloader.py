@@ -17,6 +17,7 @@ import itertools
 import multiprocessing as mp
 import queue
 import threading
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Optional
 
@@ -223,6 +224,25 @@ class DataLoader:
             yield self.collate_fn(batch)
 
     def __iter__(self):
+        # batch-wait histogram: how long the consumer (the training
+        # loop) blocked for each batch — THE input-pipeline health
+        # metric; near-zero waits mean the loader keeps up, spikes mean
+        # the accelerator starves
+        from ..observability import default_registry
+        hist = default_registry().histogram(
+            "ptpu_io_batch_wait_seconds",
+            "time the consumer blocked waiting for the next batch")
+        it = self._iter_impl()
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            hist.observe(time.perf_counter() - t0)
+            yield batch
+
+    def _iter_impl(self):
         if self._iterable_mode:
             if self.num_workers > 0 and self.worker_mode == "process":
                 yield from self._iter_proc_iterable()

@@ -1,7 +1,7 @@
 """JIT capture + export (reference: python/paddle/jit/, 34.7k LoC)."""
 from .static_function import (to_static, not_to_static, StaticFunction,
                               InputSpec, capture_report,
-                              reset_capture_report)
+                              reset_capture_report, capture_telemetry)
 from .auto_capture import auto_capture, AutoCapture  # noqa: F401
 from .functional import TrainStep, functional_call, value_and_grad
 from .save_load import save, load, TranslatedLayer
@@ -10,7 +10,7 @@ from . import dy2static  # noqa: F401  (AST control-flow conversion)
 __all__ = ["to_static", "not_to_static", "StaticFunction", "InputSpec",
            "TrainStep", "functional_call", "value_and_grad", "save", "load",
            "TranslatedLayer", "capture_report", "reset_capture_report",
-           "auto_capture", "AutoCapture"]
+           "capture_telemetry", "auto_capture", "AutoCapture"]
 
 
 # verbosity / capture-control compat (python/paddle/jit/api.py + sot flags)

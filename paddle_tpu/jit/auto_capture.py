@@ -91,16 +91,24 @@ class AutoCapture:
         return mon.DISABLE
 
     def _rebind(self, owner, name, fn):
+        from ..observability.registry import default_registry
         from .static_function import StaticFunction
+        reg = default_registry()
         current = vars(owner).get(name)
         if current is not fn:
             # somebody else rebound it meanwhile — leave theirs alone
             self._unreboundable[f"{owner.__name__}.{name}"] = \
                 "attribute changed since indexing"
+            reg.counter("ptpu_jit_autocapture_unreboundable_total",
+                        "hot functions auto-capture could not rebind"
+                        ).inc()
             return
         wrapped = StaticFunction(fn)
         setattr(owner, name, wrapped)
         self._rebound.append((owner, name, fn))
+        reg.counter("ptpu_jit_autocapture_rebinds_total",
+                    "hot functions transparently rebound to "
+                    "StaticFunction").inc()
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "AutoCapture":

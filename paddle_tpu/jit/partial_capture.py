@@ -303,7 +303,7 @@ class SegmentedFunction:
 
     # -- driver -----------------------------------------------------------
     def __call__(self, *args, **kwargs):
-        from .static_function import _capture_stats
+        from .static_function import capture_telemetry
         fn = self.fn
         if self._self is not None:
             args = (self._self,) + args
@@ -348,7 +348,7 @@ class SegmentedFunction:
             if rec is None or rec[0] == "eager-op":
                 # unsegmentable state or an op that refuses to trace:
                 # run ONE instruction eagerly and resume capture
-                _capture_stats["partial_eager_ops"] += 1
+                capture_telemetry.bump("partial_eager_ops")
                 try:
                     r = eager_ex._step(f)
                 except GraphBreak as e:
@@ -363,7 +363,7 @@ class SegmentedFunction:
                     return r[0]
                 continue
             kind = rec[2]["v"][0]
-            _capture_stats["partial_segments_run"] += 1
+            capture_telemetry.bump("partial_segments_run")
             if kind == "returned":
                 _, st_o, sp_o, td_o = rec[2]["v"]
                 return _unflatten_vals(list(out), st_o, sp_o, td_o)

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import functools
 import inspect
+import threading
 from typing import Any, Callable, Optional, Sequence
 
 import jax
@@ -30,18 +31,85 @@ import jax.numpy as jnp
 
 from ..framework.tensor import Tensor, apply_op, grad_enabled, no_grad
 from ..nn.layer_base import Layer
+from ..observability.registry import default_registry
 from .functional import functional_call
 
 __all__ = ["to_static", "not_to_static", "StaticFunction", "InputSpec",
-           "capture_report", "reset_capture_report"]
+           "capture_report", "reset_capture_report", "capture_telemetry"]
 
-# graph-capture telemetry: how often calls compile vs fall back.
-# bytecode_graph_calls counts whole-graph captures that needed the SOT
-# bytecode tier (opcode_executor.py) after plain tracing failed.
-_capture_stats = {"whole_graph_calls": 0, "bytecode_graph_calls": 0,
-                  "partial_graph_calls": 0, "partial_segments_run": 0,
-                  "partial_eager_ops": 0,
-                  "graph_break_calls": 0, "breaks": {}}
+
+class _CaptureTelemetry:
+    """Graph-capture telemetry, registry-backed (replaces the bare
+    module-global dict): every count is a ``ptpu_jit_*_total`` counter
+    in the observability default registry, and ``snapshot()`` /
+    ``reset()`` are the public API — tests and dashboards stop
+    reaching into module globals. ``bytecode_graph_calls`` counts
+    whole-graph captures that needed the SOT bytecode tier
+    (opcode_executor.py) after plain tracing failed."""
+
+    _KEYS = {
+        "whole_graph_calls":
+            "calls served by a whole-graph compiled program",
+        "bytecode_graph_calls":
+            "whole-graph captures that needed the SOT bytecode tier",
+        "partial_graph_calls":
+            "calls served by segmented (break-and-resume) capture",
+        "partial_segments_run":
+            "compiled segments executed by the partial tier",
+        "partial_eager_ops":
+            "single instructions run eagerly inside partial capture",
+        "graph_break_calls":
+            "calls that fell back to eager execution",
+        "never_trace_calls":
+            "calls dispatched eagerly because the function can never "
+            "be a graph (generator / coroutine)",
+        "cache_hit_calls":
+            "calls that reused an existing compiled specialization",
+        "compile_calls":
+            "specializations built (guard-key misses)",
+    }
+
+    def __init__(self):
+        reg = default_registry()
+        self._c = {k: reg.counter(f"ptpu_jit_{k}_total", d)
+                   for k, d in self._KEYS.items()}
+        self._break_reasons = reg.counter(
+            "ptpu_jit_graph_breaks_total",
+            "graph breaks by normalized reason", labels=("reason",))
+        self._lock = threading.Lock()
+        self._breaks: dict = {}
+
+    def bump(self, key: str, n: int = 1) -> None:
+        self._c[key].inc(n)
+
+    def note_break(self, reason: str) -> None:
+        self._c["graph_break_calls"].inc()
+        # the LABEL is the prefix before ':' so embedded exception text
+        # cannot explode label cardinality; the full reason keeps its
+        # own exact count in the breaks dict
+        self._break_reasons.labels(
+            reason=reason.split(":", 1)[0].strip()).inc()
+        with self._lock:
+            self._breaks[reason] = self._breaks.get(reason, 0) + 1
+
+    def snapshot(self) -> dict:
+        out = {k: int(c.value) for k, c in self._c.items()}
+        segs, eag = out["partial_segments_run"], out["partial_eager_ops"]
+        out["partial_compiled_fraction"] = round(
+            segs / (segs + eag), 4) if segs + eag else None
+        with self._lock:
+            out["breaks"] = dict(self._breaks)
+        return out
+
+    def reset(self) -> None:
+        for c in self._c.values():
+            c.reset()
+        self._break_reasons.reset()
+        with self._lock:
+            self._breaks = {}
+
+
+capture_telemetry = _CaptureTelemetry()
 
 
 # Opcodes that REBIND names which always survive the call (module
@@ -89,36 +157,19 @@ def _writes_surviving_state(fn) -> bool:
 
 
 def capture_report():
-    """Return {whole_graph_calls, bytecode_graph_calls,
-    graph_break_calls, breaks: {reason: count}} accumulated across all
-    StaticFunction calls."""
-    segs = _capture_stats["partial_segments_run"]
-    eag = _capture_stats["partial_eager_ops"]
-    return {"whole_graph_calls": _capture_stats["whole_graph_calls"],
-            "bytecode_graph_calls": _capture_stats["bytecode_graph_calls"],
-            "partial_graph_calls": _capture_stats["partial_graph_calls"],
-            "partial_segments_run": segs,
-            "partial_eager_ops": eag,
-            "partial_compiled_fraction": round(
-                segs / (segs + eag), 4) if segs + eag else None,
-            "graph_break_calls": _capture_stats["graph_break_calls"],
-            "breaks": dict(_capture_stats["breaks"])}
+    """``capture_telemetry.snapshot()``: {whole_graph_calls,
+    bytecode_graph_calls, partial_*, graph_break_calls,
+    never_trace_calls, cache_hit_calls, compile_calls, breaks:
+    {reason: count}} accumulated across all StaticFunction calls."""
+    return capture_telemetry.snapshot()
 
 
 def reset_capture_report():
-    _capture_stats["whole_graph_calls"] = 0
-    _capture_stats["bytecode_graph_calls"] = 0
-    _capture_stats["partial_graph_calls"] = 0
-    _capture_stats["partial_segments_run"] = 0
-    _capture_stats["partial_eager_ops"] = 0
-    _capture_stats["graph_break_calls"] = 0
-    _capture_stats["breaks"] = {}
+    capture_telemetry.reset()
 
 
 def _note_break(reason: str):
-    _capture_stats["graph_break_calls"] += 1
-    _capture_stats["breaks"][reason] = \
-        _capture_stats["breaks"].get(reason, 0) + 1
+    capture_telemetry.note_break(reason)
 
 
 # per-function bound on guard specializations: beyond this, distinct
@@ -386,14 +437,17 @@ class StaticFunction:
             # a mid-call failure raises RuntimeError, never re-runs)
             return _NO_PARTIAL
         self._cache[key] = ("sotp", entry)
-        _capture_stats["partial_graph_calls"] += 1
+        capture_telemetry.bump("partial_graph_calls")
         return out
 
     def __call__(self, *args, **kwargs):
         from . import _to_static_enabled
-        if not _to_static_enabled[0] or self._never_trace:
-            # enable_to_static(False) passthrough, or a generator /
-            # coroutine function (cannot be a graph)
+        if not _to_static_enabled[0]:
+            # enable_to_static(False) passthrough
+            return self._eager(args, kwargs)
+        if self._never_trace:
+            # generator / coroutine function: cannot be a graph
+            capture_telemetry.bump("never_trace_calls")
             return self._eager(args, kwargs)
         try:
             layout, dyn, skey, dyn_src = self._split_args(args, kwargs)
@@ -411,6 +465,7 @@ class StaticFunction:
             # LRU refresh so churn on other keys can't evict hot entries
             self._cache.pop(key)
             self._cache[key] = entry
+            capture_telemetry.bump("cache_hit_calls")
             tier, jitted = entry
             if tier == "sotp":
                 # segmented capture executes with the ORIGINAL call
@@ -423,7 +478,7 @@ class StaticFunction:
                     # unsegmentable state before any side effect ran)
                     _note_break(f"partial refused: {e}")
                     return self._eager(args, kwargs)
-                _capture_stats["partial_graph_calls"] += 1
+                capture_telemetry.bump("partial_graph_calls")
                 return out
         else:
             if len(self._cache) >= _CACHE_LIMIT:
@@ -450,6 +505,7 @@ class StaticFunction:
                 tier = "ast"
             jitted = self._build(layout, bytecode=(tier == "sot"))
             self._cache[key] = (tier, jitted)
+            capture_telemetry.bump("compile_calls")
 
         def _run(j):
             if self._layer is None:
@@ -516,6 +572,7 @@ class StaticFunction:
                 try:
                     tier = "sot"
                     jitted = self._build(layout, bytecode=True)
+                    capture_telemetry.bump("compile_calls")
                     out, new_buffers, wrapped = _run(jitted)
                     self._cache[key] = (tier, jitted)
                 except _TRACE_ERRS as e2:
@@ -539,9 +596,9 @@ class StaticFunction:
                 self._cache[key] = _BROKEN
                 _note_break(f"trace failure: {type(e).__name__}")
                 return self._eager(args, kwargs)
-        _capture_stats["whole_graph_calls"] += 1
+        capture_telemetry.bump("whole_graph_calls")
         if tier == "sot":
-            _capture_stats["bytecode_graph_calls"] += 1
+            capture_telemetry.bump("bytecode_graph_calls")
         if self._layer is not None:
             with no_grad():
                 for n, b in self._layer.named_buffers():

@@ -79,6 +79,10 @@ def cache_attend(qr, kr, v, kc, vc, p, per_row: bool):
                         qg.astype(jnp.float32),
                         kc.astype(jnp.float32)) / (D ** 0.5)
     scores = jnp.where(maskx, scores, -1e30)
-    probs = jax.nn.softmax(scores, axis=-1).astype(qr.dtype)
-    out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, vc.astype(qr.dtype))
+    # cast back to the CACHE dtype (the model dtype), not qr.dtype:
+    # RoPE's float32 cos/sin tables promote a bf16 q to f32, and
+    # keying on qr.dtype would upcast the whole value cache + output
+    # to f32 on the bf16 decode path
+    probs = jax.nn.softmax(scores, axis=-1).astype(vc.dtype)
+    out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, vc)
     return out.reshape(b, t, h * D), kc, vc

@@ -1,0 +1,35 @@
+"""paddle_tpu.observability — one telemetry substrate for every layer.
+
+Three pieces (docs/OBSERVABILITY.md has the full guide):
+
+- **Metrics registry** (``registry.py``): thread-safe ``Counter`` /
+  ``Gauge`` / ``Histogram`` families with label sets and an injectable
+  clock; Prometheus text exposition + JSON exporters. The process
+  default (``default_registry()``) is what serving, jit, io, and
+  distributed publish to.
+- **Spans** (``tracing.py``): host annotations that forward to
+  ``profiler.RecordEvent`` / ``jax.profiler.TraceAnnotation`` and carry
+  structured args — serving spans carry request ids, so one request is
+  traceable across engine iterations in the chrome trace.
+- **Flight recorder** (``flight_recorder.py``): bounded ring of the
+  last N step records (latency, occupancy, queue depth, compile
+  events) dumped to disk when a step raises, the watchdog flags a dead
+  peer, or an unhandled exception escapes.
+
+Instrumented out of the box: ``serving/engine.py`` (per-step spans,
+queue/eviction/prefill counters, TTFT + inter-token + queue-wait
+histograms), ``jit/static_function.py`` + ``jit/auto_capture.py``
+(compile / cache-hit / graph-break / never-trace counters),
+``distributed/watchdog.py`` (heartbeat-age gauge, failure counter,
+dump hook), ``io/dataloader.py`` (batch-wait histogram), and
+``profiler.Profiler.export_metrics`` (one chrome trace + one metrics
+snapshot from the same run).
+"""
+from .registry import (Counter, Gauge, Histogram,  # noqa: F401
+                       MetricError, MetricRegistry, default_registry)
+from .tracing import Span, span  # noqa: F401
+from .flight_recorder import FlightRecorder, default_recorder  # noqa: F401
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricError",
+           "MetricRegistry", "default_registry", "Span", "span",
+           "FlightRecorder", "default_recorder"]

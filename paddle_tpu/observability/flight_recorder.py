@@ -1,0 +1,143 @@
+"""Crash flight recorder: the last N step records, dumped on failure.
+
+The reference framework's comm-task flight recorder answers the only
+question that matters when a multi-hour run dies: *what was the system
+doing in the seconds before?* This is the host-side analog — a
+bounded, thread-safe ring buffer that instrumented layers append
+step records to (serving step latency + slot occupancy + queue depth,
+compile events, watchdog sweeps), and that dumps itself to a JSON file
+when
+
+- an instrumented step raises (``ServingEngine.step`` wraps itself),
+- the distributed watchdog flags a dead/hung peer, or
+- the process hits an unhandled exception (``install_excepthook``).
+
+Records are plain dicts so the dump is greppable without any tooling;
+the ring bound means a week-long run costs the same memory as a
+minute-long one.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+__all__ = ["FlightRecorder", "default_recorder"]
+
+
+class FlightRecorder:
+    def __init__(self, capacity: int = 256,
+                 time_fn: Callable[[], float] = time.time,
+                 dump_dir: Optional[str] = None, registry=None):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.capacity = int(capacity)
+        self.now = time_fn
+        self.dump_dir = dump_dir
+        # registry whose snapshot embeds in dumps (None = the process
+        # default; callers with an injected registry pass it at dump
+        # time so the post-mortem carries THEIR metrics)
+        self.registry = registry
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._dumps = 0
+        self._prev_hook = None
+
+    # -- recording -----------------------------------------------------
+    def record(self, kind: str, **fields) -> dict:
+        """Append one record; oldest records fall off past capacity."""
+        with self._lock:
+            rec = {"seq": self._seq, "t": float(self.now()),
+                   "kind": kind, **fields}
+            self._seq += 1
+            self._ring.append(rec)
+        return rec
+
+    def snapshot(self) -> List[dict]:
+        """Oldest-to-newest copy of the ring."""
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    # -- dumping -------------------------------------------------------
+    def _default_path(self) -> str:
+        d = self.dump_dir or os.environ.get("PTPU_FLIGHT_DIR") \
+            or tempfile.gettempdir()
+        os.makedirs(d, exist_ok=True)
+        with self._lock:
+            self._dumps += 1
+            n = self._dumps
+        return os.path.join(
+            d, f"ptpu_flight_{os.getpid()}_{n:03d}.json")
+
+    def dump(self, path: Optional[str] = None, reason: str = "",
+             extra: Optional[Dict] = None, registry=None) -> str:
+        """Write the ring (plus a metrics snapshot) to ``path`` and
+        return it. The snapshot comes from ``registry``, else the
+        recorder's own, else the process default. Callers on a crash
+        path should wrap this in try/except so a full disk never masks
+        the original error."""
+        path = path or self._default_path()
+        payload = {"reason": reason, "dumped_at": float(self.now()),
+                   "pid": os.getpid(), "records": self.snapshot()}
+        if extra:
+            payload.update(extra)
+        try:
+            reg = registry if registry is not None else self.registry
+            if reg is None:
+                from .registry import default_registry
+                reg = default_registry()
+            payload["metrics"] = reg.to_json()
+        except Exception:
+            pass
+        with open(path, "w") as f:
+            json.dump(payload, f, indent=1, default=repr)
+        return path
+
+    # -- crash hook ----------------------------------------------------
+    def install_excepthook(self) -> "FlightRecorder":
+        """Chain onto sys.excepthook: dump the ring before the default
+        traceback printing on any unhandled exception."""
+        if self._prev_hook is not None:
+            return self
+        prev = sys.excepthook
+
+        def hook(exc_type, exc, tb):
+            try:
+                p = self.dump(
+                    reason=f"unhandled {exc_type.__name__}: {exc}")
+                print(f"[flight-recorder] dumped to {p}",
+                      file=sys.stderr)
+            except Exception:
+                pass
+            prev(exc_type, exc, tb)
+
+        self._prev_hook = prev
+        sys.excepthook = hook
+        return self
+
+    def uninstall_excepthook(self) -> None:
+        if self._prev_hook is not None:
+            sys.excepthook = self._prev_hook
+            self._prev_hook = None
+
+
+_default = FlightRecorder()
+
+
+def default_recorder() -> FlightRecorder:
+    """The process-global recorder the built-in layers append to."""
+    return _default

@@ -1,0 +1,66 @@
+"""Request-correlated spans.
+
+A ``span`` is the host-side annotation every instrumented layer opens
+around its hot sections. It forwards to ``profiler.RecordEvent`` — so
+when a ``profiler.Profiler`` is recording, the span lands in BOTH the
+chrome-trace host timeline and (via RecordEvent's TraceAnnotation
+forwarding) the XPlane device trace — and it carries structured
+attributes (``request_id`` first among them) into the chrome event's
+``args``, which is what makes serving timelines correlatable: filter
+the trace by ``args.request_id`` and one request's prefill/decode
+steps line up across engine iterations.
+
+Spans are cheap when nothing records: RecordEvent no-ops its event
+append unless the profiler state machine is in RECORD.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+__all__ = ["Span", "span"]
+
+
+class Span:
+    """Context manager wrapping profiler.RecordEvent with attributes.
+
+    ``set_attr`` may be called inside the span (attributes are read at
+    exit, when the chrome event is emitted).
+    """
+
+    def __init__(self, name: str, request_id: Optional[int] = None,
+                 **attrs: Any):
+        self.name = name
+        self.attrs: Dict[str, Any] = {}
+        if request_id is not None:
+            self.attrs["request_id"] = request_id
+        self.attrs.update(attrs)
+        self._ev = None
+
+    def set_attr(self, key: str, value: Any) -> "Span":
+        self.attrs[key] = value
+        return self
+
+    def __enter__(self) -> "Span":
+        # lazy import: profiler is a peer package and observability
+        # must stay importable on its own
+        from .. import profiler
+        self._ev = profiler.RecordEvent(self.name, args=self.attrs)
+        self._ev.begin()
+        return self
+
+    def __exit__(self, *exc):
+        if self._ev is not None:
+            self._ev.end()
+            self._ev = None
+        return False
+
+
+def span(name: str, request_id: Optional[int] = None,
+         **attrs: Any) -> Span:
+    """Open a host span; ``request_id``/attrs flow into the chrome
+    trace event's ``args``::
+
+        with span("serving.prefill", request_id=req.rid, bucket=32):
+            ...
+    """
+    return Span(name, request_id=request_id, **attrs)

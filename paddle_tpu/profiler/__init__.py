@@ -20,8 +20,8 @@ from enum import Enum
 from typing import Callable, Iterable, List, Optional
 
 __all__ = ["Profiler", "ProfilerState", "ProfilerTarget", "RecordEvent",
-           "make_scheduler", "export_chrome_tracing", "load_profiler_result",
-           "SortedKeys", "benchmark"]
+           "make_scheduler", "export_chrome_tracing", "export_metrics",
+           "load_profiler_result", "SortedKeys", "benchmark"]
 
 
 class ProfilerState(Enum):
@@ -47,20 +47,30 @@ class SortedKeys(Enum):
 
 _events_lock = threading.Lock()
 _events: List[dict] = []
-_recording = threading.local()
+# PROCESS-WIDE recording flag (was threading.local(): Profiler.start()
+# only flipped the flag in the calling thread, so RecordEvents from
+# dataloader/watchdog worker threads were silently dropped — the whole
+# point of host tracing is seeing those threads). One-element list so
+# _transition mutates in place; _events_lock still guards the list.
+_recording = [False]
 
 
 def _is_recording() -> bool:
-    return getattr(_recording, "on", False)
+    return _recording[0]
 
 
 class RecordEvent:
     """Host-side annotation (reference: platform/profiler/event_tracing.h:43
     RecordEvent — emitted inside every generated ad_func). Also forwards to
-    jax.profiler.TraceAnnotation so events appear in XPlane traces."""
+    jax.profiler.TraceAnnotation so events appear in XPlane traces.
 
-    def __init__(self, name: str, event_type=None):
+    ``args`` (a dict) lands in the chrome trace event's ``args`` field —
+    observability spans use it to carry request ids; it is read at
+    ``end()`` time, so attributes added mid-span are captured."""
+
+    def __init__(self, name: str, event_type=None, args=None):
         self.name = name
+        self.args = args
         self._t0 = None
         self._jax_ann = None
 
@@ -82,14 +92,19 @@ class RecordEvent:
             self._jax_ann.__exit__(None, None, None)
             self._jax_ann = None
         if _is_recording():
+            ev = {
+                "name": self.name, "ph": "X", "pid": os.getpid(),
+                "tid": threading.get_ident(),
+                "ts": self._t0 / 1000.0,
+                "dur": (t1 - self._t0) / 1000.0,
+                "cat": "host",
+            }
+            if self.args:
+                ev["args"] = {k: (v if isinstance(
+                    v, (int, float, str, bool, type(None))) else repr(v))
+                    for k, v in self.args.items()}
             with _events_lock:
-                _events.append({
-                    "name": self.name, "ph": "X", "pid": os.getpid(),
-                    "tid": threading.get_ident(),
-                    "ts": self._t0 / 1000.0,
-                    "dur": (t1 - self._t0) / 1000.0,
-                    "cat": "host",
-                })
+                _events.append(ev)
         self._t0 = None
 
     def __enter__(self):
@@ -131,6 +146,27 @@ def export_chrome_tracing(dir_name: str, worker_name: Optional[str] = None):
         path = os.path.join(dir_name, f"{fname}.json")
         prof._export_chrome(path)
         print(f"[profiler] chrome trace written to {path}")
+
+    return handler
+
+
+def export_metrics(dir_name: str, worker_name: Optional[str] = None,
+                   fmt: str = "prometheus"):
+    """on_trace_ready-style handler writing the observability metrics
+    registry snapshot next to the trace, so one run yields BOTH a
+    chrome trace and a metrics snapshot::
+
+        prof = Profiler(on_trace_ready=lambda p: (
+            export_chrome_tracing("./out")(p),
+            export_metrics("./out")(p)))
+    """
+    def handler(prof: "Profiler"):
+        os.makedirs(dir_name, exist_ok=True)
+        fname = worker_name or f"worker_{os.getpid()}"
+        ext = "prom" if fmt == "prometheus" else "json"
+        path = os.path.join(dir_name, f"{fname}.{ext}")
+        prof.export_metrics(path, fmt=fmt)
+        print(f"[profiler] metrics snapshot written to {path}")
 
     return handler
 
@@ -183,7 +219,7 @@ class Profiler:
         will_record = new_state in (ProfilerState.RECORD,
                                     ProfilerState.RECORD_AND_RETURN)
         if will_record and not recording:
-            _recording.on = True
+            _recording[0] = True
             if not self._timer_only:
                 try:
                     import jax.profiler
@@ -193,7 +229,7 @@ class Profiler:
                     self._jax_dir = None
         if (recording and not will_record) or \
                 (final and recording):
-            _recording.on = False
+            _recording[0] = False
             if self._jax_dir is not None:
                 try:
                     import jax.profiler
@@ -223,6 +259,19 @@ class Profiler:
 
     def export_chrome_tracing(self, path: str):
         self._export_chrome(path)
+
+    def export_metrics(self, path: str, fmt: str = "prometheus") -> str:
+        """Write the observability default-registry snapshot to
+        ``path`` (``fmt``: "prometheus" text exposition or "json") and
+        return the serialized text — the metrics half of a run whose
+        chrome/XPlane traces come from this same profiler."""
+        from ..observability import default_registry
+        reg = default_registry()
+        text = reg.to_prometheus() if fmt == "prometheus" \
+            else reg.to_json_str(indent=1)
+        with open(path, "w") as f:
+            f.write(text)
+        return text
 
     def summary(self, sorted_by=SortedKeys.CPUTotal, op_detail=True,
                 thread_sep=False, time_unit="ms"):

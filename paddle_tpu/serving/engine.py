@@ -29,6 +29,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.tensor import Tensor
+from ..observability import default_recorder, default_registry, span
 from .metrics import EngineMetrics
 from .sampling import SamplingParams, sample_token
 from .scheduler import FIFOScheduler, Request, bucket_for
@@ -83,7 +84,8 @@ class ServingEngine:
                  max_len: Optional[int] = None,
                  eos_id: Optional[int] = None,
                  min_bucket: int = 16,
-                 time_fn: Callable[[], float] = time.perf_counter):
+                 time_fn: Callable[[], float] = time.perf_counter,
+                 registry=None, flight_recorder=None):
         self.adapter = _ModelAdapter(model)
         model.eval()
         self.max_slots = int(max_slots)
@@ -99,21 +101,50 @@ class ServingEngine:
             self.adapter.kv_heads, self.adapter.head_dim,
             self.adapter.dtype)
         self.scheduler = FIFOScheduler()
-        self.metrics = EngineMetrics(self.max_slots, time_fn)
+        self.registry = registry if registry is not None \
+            else default_registry()
+        # `is None`, not truthiness: an EMPTY FlightRecorder is falsy
+        # (it has __len__), and `or` would silently swap it for the
+        # global one
+        self.recorder = flight_recorder if flight_recorder is not None \
+            else default_recorder()
+        self.metrics = EngineMetrics(self.max_slots, time_fn,
+                                     registry=self.registry)
         self._params, self._buffers = model.raw_state()
         self._decode_jit = None
         self._prefill_jit = None
         self._next_rid = 0
+        self._step_idx = 0
+        self._poisoned: Optional[str] = None
         # python-side-effect counters bumped at TRACE time: the compile-
         # count contract (1 decode + O(log max_len) prefill buckets) is
         # asserted against these in tests
         self.trace_counts = {"decode": 0, "prefill": {}}
+        reg = self.registry
+        self._m_queue_depth = reg.gauge(
+            "ptpu_serving_queue_depth", "requests waiting for a slot")
+        self._m_active = reg.gauge(
+            "ptpu_serving_active_slots", "slots decoding this step")
+        self._m_step = reg.histogram(
+            "ptpu_serving_step_seconds",
+            "wall time of one engine iteration (engine clock)")
+        self._m_prefill = reg.counter(
+            "ptpu_serving_prefills_total", "prefill program runs",
+            labels=("bucket",))
+        self._m_evict = reg.counter(
+            "ptpu_serving_evictions_total", "slots freed",
+            labels=("reason",))
 
     # -- public API ----------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 16,
                sampling: Optional[SamplingParams] = None) -> Request:
         """Queue one request; returns its handle (tokens appear on it
         as steps run)."""
+        if self._poisoned:
+            raise RuntimeError(
+                f"ServingEngine is unrecoverable (donated cache pools "
+                f"invalidated by a failed step: {self._poisoned}); "
+                f"build a fresh engine.")
         ids = np.asarray(getattr(prompt_ids, "numpy", lambda: prompt_ids)()
                          ).astype(np.int64)
         if ids.ndim == 2 and ids.shape[0] == 1:   # [1, T] batch-of-one
@@ -144,6 +175,7 @@ class ServingEngine:
         self._next_rid += 1
         self.scheduler.add(req)
         self.metrics.on_submit(req.rid)
+        self._m_queue_depth.set(self.scheduler.depth)
         return req
 
     def has_work(self) -> bool:
@@ -153,8 +185,69 @@ class ServingEngine:
     def step(self) -> List[Request]:
         """One engine iteration: admit into free slots (bucketed
         prefill), then one decode step over every occupied slot, then
-        evict finished sequences. Returns requests finished this step."""
+        evict finished sequences. Returns requests finished this step.
+
+        Every step appends a flight-recorder record (latency, slot
+        occupancy, queue depth, admissions/evictions, compile events);
+        if the step raises, the recorder ring dumps to disk before the
+        exception propagates — the post-mortem for a dead serving
+        loop."""
+        if self._poisoned:
+            raise RuntimeError(
+                f"ServingEngine is unrecoverable: a previous step "
+                f"failed after its cache pools were donated (device "
+                f"buffers invalidated) — {self._poisoned}. Build a "
+                f"fresh engine; the flight-recorder dump has the "
+                f"post-mortem.")
+        t0 = self.metrics.now()
+        step_idx = self._step_idx
+        self._step_idx += 1
+        tc0 = (self.trace_counts["decode"],
+               sum(self.trace_counts["prefill"].values()))
+        try:
+            with span("serving.step", step=step_idx) as sp:
+                finished, admitted, n_active = self._step_inner()
+                sp.set_attr("active_slots", n_active)
+        except Exception as e:
+            if self._donate():
+                # the jit call may have CONSUMED the donated pools
+                # before failing: ks/vs can reference deleted device
+                # buffers, and any later step would die confusingly —
+                # refuse further use instead
+                self._poisoned = f"step #{step_idx}: " \
+                                 f"{type(e).__name__}: {e}"
+            try:
+                self.recorder.record(
+                    "serving.step_error", step=step_idx,
+                    error=f"{type(e).__name__}: {e}")
+                path = self.recorder.dump(
+                    reason=f"ServingEngine.step #{step_idx} raised "
+                           f"{type(e).__name__}: {e}",
+                    registry=self.registry)
+                import sys
+                print(f"[serving] flight recorder dumped to {path}",
+                      file=sys.stderr)
+            except Exception:
+                pass               # never mask the original failure
+            raise
+        dt = self.metrics.now() - t0
+        depth = self.scheduler.depth
+        self._m_step.observe(dt)
+        self._m_queue_depth.set(depth)
+        self._m_active.set(n_active)
+        self.recorder.record(
+            "serving.step", step=step_idx, step_latency_s=dt,
+            active_slots=n_active, queue_depth=depth,
+            admitted=admitted,
+            evicted=[(r.rid, r.finish_reason) for r in finished],
+            compiles_decode=self.trace_counts["decode"] - tc0[0],
+            compiles_prefill=(
+                sum(self.trace_counts["prefill"].values()) - tc0[1]))
+        return finished
+
+    def _step_inner(self):
         finished: List[Request] = []
+        admitted: List[int] = []
         # re-snapshot the weights so checkpoint loads / quantization on
         # the live model object take effect next step (same pytree
         # structure -> no retrace; the arrays are just jit arguments)
@@ -164,10 +257,9 @@ class ServingEngine:
         for slot, req in self.scheduler.admissions(
                 self.cache.free_slots()):
             self._prefill(slot, req)
+            admitted.append(req.rid)
             if req.finished:
-                self.cache.release(slot)
-                req.slot = None
-                finished.append(req)
+                self._evict(slot, req, finished)
         # 2) one decode step over all occupied slots
         active = self.cache.active_slots()
         if active:
@@ -179,22 +271,31 @@ class ServingEngine:
                 toks[s, 0] = req.out_tokens[-1]
                 pos[s] = req.next_pos
                 mask[s] = True
-            logits, ks, vs = self._decode_fn()(
-                self._params, self._buffers, toks, pos, mask,
-                self.cache.ks, self.cache.vs)
-            self.cache.ks, self.cache.vs = list(ks), list(vs)
-            logits = np.asarray(jax.device_get(logits))
+            with span("serving.decode", batch=len(active),
+                      request_ids=[self.cache.slots[s].rid
+                                   for s in active]):
+                logits, ks, vs = self._decode_fn()(
+                    self._params, self._buffers, toks, pos, mask,
+                    self.cache.ks, self.cache.vs)
+                self.cache.ks, self.cache.vs = list(ks), list(vs)
+                logits = np.asarray(jax.device_get(logits))
             for s in active:
                 req = self.cache.slots[s]
                 tok = sample_token(logits[s], req.sampling, req._rng)
                 req.out_tokens.append(tok)
                 self.metrics.on_token(req.rid)
                 if self._is_finished(req, tok):
-                    self.cache.release(s)
-                    req.slot = None
-                    finished.append(req)
+                    self._evict(s, req, finished)
         self.metrics.on_step(len(active))
-        return finished
+        return finished, admitted, len(active)
+
+    def _evict(self, slot: int, req: Request,
+               finished: List[Request]) -> None:
+        self.cache.release(slot)
+        req.slot = None
+        finished.append(req)
+        self._m_evict.labels(reason=req.finish_reason or "unknown").inc()
+        self.metrics.on_finished(req.rid)
 
     def run(self, max_steps: Optional[int] = None) -> List[Request]:
         """Drive step() until the queue and every slot drain."""
@@ -220,13 +321,17 @@ class ServingEngine:
         k/v into the slot row, and sample its first token (TTFT)."""
         bucket = bucket_for(req.prompt_len, self.min_bucket,
                             self.max_len)
-        ids = np.zeros((1, bucket), np.int64)
-        ids[0, :req.prompt_len] = req.prompt
-        logits, ks, vs = self._prefill_fn()(
-            self._params, self._buffers, ids,
-            np.int32(req.prompt_len), np.int32(slot),
-            self.cache.ks, self.cache.vs)
-        self.cache.ks, self.cache.vs = list(ks), list(vs)
+        self.metrics.on_first_prefill(req.rid)   # queue wait ends here
+        self._m_prefill.labels(bucket=bucket).inc()
+        with span("serving.prefill", request_id=req.rid, slot=slot,
+                  bucket=bucket, prompt_len=req.prompt_len):
+            ids = np.zeros((1, bucket), np.int64)
+            ids[0, :req.prompt_len] = req.prompt
+            logits, ks, vs = self._prefill_fn()(
+                self._params, self._buffers, ids,
+                np.int32(req.prompt_len), np.int32(slot),
+                self.cache.ks, self.cache.vs)
+            self.cache.ks, self.cache.vs = list(ks), list(vs)
         self.cache.assign(slot, req)
         req.slot = slot
         tok = sample_token(np.asarray(jax.device_get(logits)),

@@ -1,14 +1,28 @@
-"""Per-engine serving metrics: tokens/s, TTFT, per-token latency
-percentiles, slot occupancy.
+"""Per-engine serving metrics: tokens/s, TTFT, queue wait, per-token
+latency percentiles, slot occupancy.
 
 The clock is injectable (``time_fn``) so benchmarks can drive the
 engine on a VIRTUAL timeline (arrival replay without sleeps) and tests
 can assert exact accounting with a fake clock.
+
+Bridged to the observability registry: every hook also publishes to
+the framework-wide ``ptpu_serving_*`` counter/histogram families
+(``registry`` defaults to the process registry), so one Prometheus
+snapshot carries serving latency distributions next to jit/dataloader
+telemetry.
+
+Memory is bounded for long-running engines: per-request state is O(1)
+(no per-token lists), finished requests are dropped on eviction
+(``on_finished``), totals/occupancy are cumulative scalars, and the
+percentile sample pools are rolling windows of the last ``window``
+observations — exact until traffic exceeds the window, recent-biased
+after (the registry histograms carry the all-time distributions).
 """
 from __future__ import annotations
 
 import time
-from typing import Callable, Dict, List, Optional
+from collections import deque
+from typing import Callable, Dict, Optional
 
 import numpy as np
 
@@ -16,69 +30,118 @@ __all__ = ["EngineMetrics"]
 
 
 class _ReqStats:
-    __slots__ = ("t_submit", "t_first", "token_times")
+    __slots__ = ("t_submit", "t_first", "t_prefill", "t_last_token")
 
     def __init__(self, t_submit: float):
         self.t_submit = t_submit
         self.t_first: Optional[float] = None
-        self.token_times: List[float] = []
+        self.t_prefill: Optional[float] = None
+        self.t_last_token: Optional[float] = None
 
 
 class EngineMetrics:
     def __init__(self, max_slots: int,
-                 time_fn: Callable[[], float] = time.perf_counter):
+                 time_fn: Callable[[], float] = time.perf_counter,
+                 registry=None, window: int = 65536):
         self.max_slots = max_slots
         self.now = time_fn
-        self._reqs: Dict[int, _ReqStats] = {}
-        self._occupancy: List[int] = []       # active slots per step
+        self._reqs: Dict[int, _ReqStats] = {}      # in-flight only
+        self._n_requests = 0
+        self._n_tokens = 0
+        self._n_steps = 0
+        self._occ_sum = 0                          # exact all-time mean
+        self._ttft: deque = deque(maxlen=window)
+        self._qwait: deque = deque(maxlen=window)
+        self._gaps: deque = deque(maxlen=window)
         self._t0: Optional[float] = None
         self._t_last: Optional[float] = None
+        if registry is None:
+            from ..observability import default_registry
+            registry = default_registry()
+        self._m_requests = registry.counter(
+            "ptpu_serving_requests_total", "requests submitted")
+        self._m_tokens = registry.counter(
+            "ptpu_serving_tokens_total", "tokens emitted")
+        self._m_ttft = registry.histogram(
+            "ptpu_serving_ttft_seconds",
+            "submit-to-first-token latency")
+        self._m_gap = registry.histogram(
+            "ptpu_serving_inter_token_seconds",
+            "gap between consecutive tokens of one request")
+        self._m_queue_wait = registry.histogram(
+            "ptpu_serving_queue_wait_seconds",
+            "submit-to-first-prefill wait (scheduler queueing, "
+            "prefill compute excluded)")
 
     # -- event hooks (engine calls these) ------------------------------
     def on_submit(self, rid: int) -> None:
         t = self.now()
         self._reqs[rid] = _ReqStats(t)
+        self._n_requests += 1
+        self._m_requests.inc()
         if self._t0 is None:
             self._t0 = t
         self._t_last = t
+
+    def on_first_prefill(self, rid: int) -> None:
+        """Request leaves the queue: its prefill program starts. The
+        submit->here gap is pure scheduler queueing — TTFT minus this
+        is prefill+decode compute, so scheduler regressions stop
+        hiding inside TTFT."""
+        r = self._reqs[rid]
+        if r.t_prefill is None:
+            r.t_prefill = self.now()
+            w = r.t_prefill - r.t_submit
+            self._qwait.append(w)
+            self._m_queue_wait.observe(w)
 
     def on_token(self, rid: int) -> None:
         t = self.now()
         r = self._reqs[rid]
         if r.t_first is None:
             r.t_first = t
-        r.token_times.append(t)
+            self._ttft.append(t - r.t_submit)
+            self._m_ttft.observe(t - r.t_submit)
+        else:
+            gap = t - r.t_last_token
+            self._gaps.append(gap)
+            self._m_gap.observe(gap)
+        r.t_last_token = t
+        self._n_tokens += 1
+        self._m_tokens.inc()
         self._t_last = t
 
     def on_step(self, active_slots: int) -> None:
-        self._occupancy.append(active_slots)
+        self._n_steps += 1
+        self._occ_sum += active_slots
         self._t_last = self.now()
+
+    def on_finished(self, rid: int) -> None:
+        """Evict the request's per-request state (its samples already
+        live in the rolling windows / registry histograms) — without
+        this, a long-running engine retains every request forever."""
+        self._reqs.pop(rid, None)
 
     # -- aggregation ---------------------------------------------------
     def summary(self) -> Dict[str, float]:
-        toks = sum(len(r.token_times) for r in self._reqs.values())
         wall = ((self._t_last - self._t0)
                 if self._t0 is not None and self._t_last is not None
                 else 0.0)
-        ttft = [r.t_first - r.t_submit for r in self._reqs.values()
-                if r.t_first is not None]
-        # per-token (inter-token) latency: gaps between consecutive
-        # tokens of one request — the stream cadence a client sees
-        gaps: List[float] = []
-        for r in self._reqs.values():
-            gaps.extend(np.diff(r.token_times).tolist())
-        pct = lambda xs, q: float(np.percentile(xs, q)) if xs else 0.0
+        pct = lambda xs, q: float(np.percentile(list(xs), q)) \
+            if xs else 0.0
         return {
-            "requests": len(self._reqs),
-            "total_tokens": toks,
+            "requests": self._n_requests,
+            "total_tokens": self._n_tokens,
             "wall_s": wall,
-            "tokens_per_s": toks / wall if wall > 0 else 0.0,
-            "ttft_p50_s": pct(ttft, 50),
-            "ttft_p99_s": pct(ttft, 99),
-            "tok_latency_p50_s": pct(gaps, 50),
-            "tok_latency_p99_s": pct(gaps, 99),
-            "occupancy_mean": (float(np.mean(self._occupancy))
+            "tokens_per_s": self._n_tokens / wall if wall > 0 else 0.0,
+            "ttft_p50_s": pct(self._ttft, 50),
+            "ttft_p99_s": pct(self._ttft, 99),
+            "queue_wait_p50_s": pct(self._qwait, 50),
+            "queue_wait_p99_s": pct(self._qwait, 99),
+            "tok_latency_p50_s": pct(self._gaps, 50),
+            "tok_latency_p99_s": pct(self._gaps, 99),
+            "occupancy_mean": (self._occ_sum / self._n_steps
                                / self.max_slots
-                               if self._occupancy else 0.0),
-            "steps": len(self._occupancy),
+                               if self._n_steps else 0.0),
+            "steps": self._n_steps,
         }

@@ -12,18 +12,30 @@ import pytest
 
 HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# the serving engine's metrics-summary schema is a STABLE contract:
+# dashboards and the Prometheus bridge key on these — a key vanishing
+# here is a breaking change, caught by the schema guard below
+SERVING_SUMMARY_KEYS = {
+    "requests", "total_tokens", "wall_s", "tokens_per_s",
+    "ttft_p50_s", "ttft_p99_s", "queue_wait_p50_s", "queue_wait_p99_s",
+    "tok_latency_p50_s", "tok_latency_p99_s", "occupancy_mean", "steps",
+}
+
 
 @pytest.mark.parametrize("script", [
     "bench_resnet50.py", "bench_bert_dp.py", "bench_gpt_hybrid.py",
     "bench_ernie_zero3.py", "bench_ppyoloe_infer.py",
     "bench_llama_decode.py", "bench_serving_engine.py",
 ])
-def test_benchmark_script_smoke(script):
+def test_benchmark_script_smoke(script, tmp_path):
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                XLA_FLAGS="--xla_force_host_platform_device_count=8",
                PYTHONPATH=os.pathsep.join(
                    [HERE] + os.environ.get("PYTHONPATH", "")
                    .split(os.pathsep)))
+    prom_path = tmp_path / "snapshot.prom"
+    if script == "bench_serving_engine.py":
+        env["PTPU_PROM_OUT"] = str(prom_path)
     r = subprocess.run(
         [sys.executable, os.path.join(HERE, "benchmarks", script)],
         capture_output=True, text=True, timeout=900, env=env)
@@ -34,6 +46,24 @@ def test_benchmark_script_smoke(script):
         rec = json.loads(line)
         assert {"metric", "value", "unit", "vs_baseline"} <= set(rec)
         assert rec["value"] is not None and np.isfinite(rec["value"])
+    if script == "bench_serving_engine.py":
+        # schema guard: the METRICS line carries the engine summary
+        # (stable key set) + the registry family list, and PTPU_PROM_OUT
+        # produced a Prometheus snapshot with the serving families
+        mlines = [l for l in r.stdout.splitlines()
+                  if l.startswith("METRICS ")]
+        assert mlines, r.stdout
+        snap = json.loads(mlines[-1][len("METRICS "):])
+        assert SERVING_SUMMARY_KEYS <= set(snap["engine_summary"]), \
+            sorted(snap["engine_summary"])
+        fams = set(snap["families"])
+        assert {"ptpu_serving_ttft_seconds",
+                "ptpu_serving_queue_wait_seconds",
+                "ptpu_serving_step_seconds",
+                "ptpu_serving_prefills_total"} <= fams, sorted(fams)
+        prom = prom_path.read_text()
+        assert "# TYPE ptpu_serving_ttft_seconds histogram" in prom
+        assert "ptpu_serving_requests_total" in prom
 
 
 def test_trainstep_amp_o2_master_weights_finite():
