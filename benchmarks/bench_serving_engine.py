@@ -19,11 +19,17 @@ Baseline prompt padding changes its token CONTENT (pad-token prefix
 noise) but not its compute shape; only throughput/latency are scored
 here — token parity of the engine itself is pinned in
 tests/test_serving_engine.py.
+
+``--chaos``: resilience smoke mode instead — replay the trace twice
+(clean, then with ONE injected decode-step failure mid-trace followed
+by ``recover()``), verify greedy token identity between the two, and
+report recovery latency alongside tokens/s (docs/RESILIENCE.md).
 """
 import _path  # noqa: F401  (repo-root import shim)
 
 import json
 import os
+import sys
 import time
 
 import numpy as np
@@ -135,6 +141,72 @@ def _run_sync_baseline(model, arrivals, prompts, new, batch_size,
     }
 
 
+def _replay(model, prompts, new, slots, max_len, min_bucket,
+            fault_after=None):
+    """One straight (virtual-arrival-free) replay of the trace; with
+    ``fault_after`` set, a decode-step fault is injected after that
+    many decode steps, recover() is exercised, and the recovery wall
+    time is measured. Returns (outputs, tokens/s, recovery_latency_s,
+    replay_mismatches)."""
+    from paddle_tpu.resilience import InjectedFault, faults
+    from paddle_tpu.serving import ServingEngine
+
+    eng = ServingEngine(model, max_slots=slots, max_len=max_len,
+                        min_bucket=min_bucket)
+    reqs = [eng.submit(p, n) for p, n in zip(prompts, new)]
+    if fault_after is not None:
+        faults.inject("serving.step.decode", times=1,
+                      after=fault_after)
+    recovery_s, mismatches = None, 0
+    t0 = time.perf_counter()
+    try:
+        while eng.has_work():
+            try:
+                eng.step()
+            except InjectedFault:
+                r0 = time.perf_counter()
+                rep = eng.recover()
+                recovery_s = time.perf_counter() - r0
+                mismatches = rep["replay_mismatches"]
+    finally:
+        faults.clear("serving.step.decode")
+    wall = time.perf_counter() - t0
+    toks = sum(len(r.out_tokens) for r in reqs)
+    return ([r.output_ids for r in reqs], toks / wall if wall else 0.0,
+            recovery_s, mismatches)
+
+
+def run_chaos_smoke(model, prompts, new, slots, max_len, min_bucket):
+    """--chaos: clean replay vs fault-injected replay of the same
+    trace; greedy outputs must be token-identical across recovery."""
+    clean_out, clean_tps, _, _ = _replay(
+        model, prompts, new, slots, max_len, min_bucket)
+    mid = max(2, sum(new) // (2 * slots))     # mid-trace decode step
+    chaos_out, chaos_tps, recovery_s, mismatches = _replay(
+        model, prompts, new, slots, max_len, min_bucket,
+        fault_after=mid)
+    identical = chaos_out == clean_out
+    print(json.dumps({
+        "metric": (
+            f"serving chaos smoke: 1 injected decode failure after "
+            f"{mid} steps, recover() latency "
+            f"{(recovery_s or 0.0) * 1e3:.1f} ms, replay mismatches "
+            f"{mismatches}, greedy outputs token-identical="
+            f"{identical} (baseline=uninjected replay of the same "
+            f"{len(prompts)}-request trace)"),
+        "value": round(chaos_tps, 1),
+        "unit": "tokens/s",
+        "vs_baseline": round(clean_tps, 1)}))
+    print("CHAOS " + json.dumps({
+        "recovery_latency_s": recovery_s,
+        "replay_mismatches": mismatches,
+        "token_identical": identical}))
+    if recovery_s is None or not identical:
+        raise SystemExit(
+            "chaos smoke failed: fault did not fire or outputs "
+            "diverged across recovery")
+
+
 def main():
     import jax
     import paddle_tpu as paddle
@@ -163,6 +235,11 @@ def main():
 
     rng = np.random.RandomState(0)
     prompts, new = _make_trace(rng, n_req, lens, news)
+
+    if "--chaos" in sys.argv:
+        run_chaos_smoke(model, prompts, new, slots, max_len,
+                        min_bucket)
+        return
 
     eng, traces, arrivals = _run_engine(model, prompts, new, slots,
                                         max_len, min_bucket, rng)

@@ -104,6 +104,7 @@ from . import vision  # noqa: F401
 from . import metric  # noqa: F401
 from . import profiler  # noqa: F401
 from . import observability  # noqa: F401
+from . import resilience  # noqa: F401
 from . import distribution  # noqa: F401
 from . import incubate  # noqa: F401
 from . import distributed  # noqa: F401

@@ -16,20 +16,78 @@ reshards by construction, without ever materializing the global tensor
 in host RAM. A coverage check raises on orphaned/missing shards instead
 of silently zero-filling. ``async_save`` snapshots to host then writes
 on a worker thread (reference's async_save).
+
+Failure contract (docs/RESILIENCE.md): ``save_state_dict`` returns an
+:class:`AsyncSaveHandle` — ``wait()`` re-raises anything the writer
+thread hit (async worker exceptions no longer vanish), and the same
+error also surfaces at the next ``wait_for_pending_saves()`` /
+``load_state_dict()``. An ``atexit`` hook drains pending async saves
+before interpreter exit instead of silently dropping them. Individual
+shard writes retry transient ``OSError`` through
+``resilience.RetryPolicy`` (``io_retry_policy``, swappable); the fault
+points ``checkpoint.shard_write`` (inside the retried write) and
+``checkpoint.commit`` (after shards, before the metadata flip) make
+both the retry path and the commit-point crash contract testable.
 """
 from __future__ import annotations
 
+import atexit
 import json
 import os
+import sys
 import threading
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
 
 from ..framework.tensor import Tensor
+from ..resilience.faults import InjectedFault, maybe_fail
+from ..resilience.retry import RetryPolicy
 
-__all__ = ["save_state_dict", "load_state_dict"]
+__all__ = ["save_state_dict", "load_state_dict", "AsyncSaveHandle",
+           "wait_for_pending_saves", "io_retry_policy"]
+
+# shard/metadata writes ride this policy (module-level so deployments
+# can swap in a longer-suffering one for flaky network filesystems)
+io_retry_policy = RetryPolicy(
+    max_attempts=3, base_delay=0.02, max_delay=0.2,
+    retry_on=(OSError, InjectedFault))
+
+
+class AsyncSaveHandle:
+    """Completion handle for one ``save_state_dict`` call.
+
+    ``wait()`` blocks until the writer finished and RE-RAISES any
+    exception it hit — a failed async save is a caller-visible event,
+    not a silently-dropped daemon thread. Synchronous saves return an
+    already-done handle for API uniformity.
+    """
+
+    def __init__(self):
+        self._done = threading.Event()
+        self._error: Optional[BaseException] = None
+        # once a caller has SEEN the error through wait(), the
+        # background drain (wait_for_pending_saves / load) must not
+        # re-raise it — a handled save failure would otherwise poison
+        # the next unrelated load (e.g. the auto-resume driver's
+        # restore-from-previous-checkpoint path)
+        self._observed = False
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def wait(self, timeout: Optional[float] = None) -> None:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"checkpoint save still writing after {timeout}s")
+        if self._error is not None:
+            self._observed = True
+            raise self._error
+
+    def _finish(self, error: Optional[BaseException] = None) -> None:
+        self._error = error
+        self._done.set()
 
 
 def _flatten(state: Dict[str, Any], prefix="") -> Dict[str, Any]:
@@ -132,16 +190,30 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
 
     new_files = {os.path.basename(f) for f, _ in writes}
 
+    def write_one(fpath, data):
+        # one staged shard write; transient OSErrors retry through
+        # io_retry_policy, and the fault point sits INSIDE the retried
+        # body so injected write faults exercise the retry path
+        maybe_fail("checkpoint.shard_write", file=fpath)
+        tmp = fpath + ".tmp"
+        with open(tmp, "wb") as fh:  # np.save would append .npy
+            np.save(fh, np.asarray(jax.device_get(data)))
+        os.replace(tmp, fpath)
+
     def do_write():
         # stage everything under temp names, then publish with
         # os.replace (atomic on POSIX): shards first, metadata last —
         # the metadata flip is the commit point. Old shards the new
         # save does not reuse are deleted only after the commit.
         for fpath, data in writes:
-            tmp = fpath + ".tmp"
-            with open(tmp, "wb") as fh:  # np.save would append .npy
-                np.save(fh, np.asarray(jax.device_get(data)))
-            os.replace(tmp, fpath)
+            io_retry_policy.call(write_one, fpath, data,
+                                 op="checkpoint.shard_write")
+        # a crash HERE (new shards staged, metadata still old) must
+        # leave the previous generation fully loadable — the torn
+        # g{gen} files are invisible to load (only metadata-listed
+        # files are read) and the next save's identical names
+        # overwrite them
+        maybe_fail("checkpoint.commit", path=path)
         # EVERY rank writes its own metadata file: each process only
         # knows about its addressable shards, so a coordinator-only
         # write would orphan every other rank's shard files (load
@@ -161,22 +233,87 @@ def save_state_dict(state_dict: Dict[str, Any], path: str,
                 except OSError:
                     pass
 
+    handle = AsyncSaveHandle()
     if async_save:
         # snapshot to host first (device buffers may be donated later)
         writes = [(f, np.asarray(jax.device_get(d))) for f, d in writes]
-        t = threading.Thread(target=do_write, daemon=True)
+
+        def runner():
+            try:
+                do_write()
+            except BaseException as e:  # captured, surfaced at wait()
+                handle._finish(e)
+            else:
+                handle._finish()
+
+        t = threading.Thread(target=runner, daemon=True)
         t.start()
-        _pending.append(t)
+        _pending.append(handle)
     else:
-        do_write()
+        try:
+            do_write()
+        except BaseException as e:
+            handle._finish(e)
+            raise
+        handle._finish()
+    return handle
 
 
-_pending = []
+_pending: List[AsyncSaveHandle] = []
 
 
-def _wait_pending():
-    for t in _pending:
-        t.join()
+def wait_for_pending_saves(timeout: Optional[float] = None) -> None:
+    """Block until every in-flight async save finished; re-raise the
+    FIRST not-yet-observed writer error (after all have settled, so no
+    save is left racing). Errors a caller already saw via
+    ``AsyncSaveHandle.wait()`` are considered handled and skipped.
+    Called implicitly by ``load_state_dict`` and at interpreter exit.
+
+    On ``timeout``, handles still writing STAY pending (the atexit
+    drain and later calls keep waiting for them) and a TimeoutError is
+    raised after the sweep — unless a real writer error is also ready,
+    which wins. Each call delivers at most ONE error; handles whose
+    error was not delivered stay pending so the next call (or load)
+    surfaces them rather than silently swallowing all but the first."""
+    first_err = None
+    remaining = []
+    timed_out = False
+    for h in _pending:
+        if not h._done.wait(timeout):
+            remaining.append(h)
+            timed_out = True
+            continue
+        if h._error is not None and not h._observed:
+            if first_err is None:
+                h._observed = True
+                first_err = h._error
+            else:
+                remaining.append(h)
+    _pending[:] = remaining
+    if first_err is not None:
+        raise first_err
+    if timed_out:
+        raise TimeoutError(
+            f"checkpoint save still writing after {timeout}s")
+
+
+_wait_pending = wait_for_pending_saves       # internal alias (pre-PR3)
+
+
+@atexit.register
+def _drain_pending_at_exit():
+    # pending async saves must complete before the interpreter tears
+    # down (daemon writer threads would otherwise be killed mid-file);
+    # unhandled errors print rather than raise — nothing can catch
+    # them here, and already-observed ones were the caller's to handle
+    for h in list(_pending):
+        if not h._done.wait(timeout=60.0):
+            print("[checkpoint] async save still writing 60s after "
+                  "exit was requested; abandoning it", file=sys.stderr)
+        elif h._error is not None and not h._observed:
+            print(f"[checkpoint] async save failed at exit: "
+                  f"{type(h._error).__name__}: {h._error}",
+                  file=sys.stderr)
     _pending.clear()
 
 

@@ -13,6 +13,8 @@ import ctypes
 import threading
 from typing import Optional
 
+from ..resilience.faults import maybe_fail
+
 __all__ = ["TCPStore"]
 
 _lock = threading.Lock()
@@ -107,14 +109,19 @@ class TCPStore:
         return self._client
 
     def set(self, key: str, value) -> None:
+        maybe_fail("store.set", key=key)
         data = value if isinstance(value, bytes) else str(value).encode()
         with self._mu:
             rc = self._lib.pts_set(self._h(), key.encode(), data,
                                    len(data))
         if rc != 0:
-            raise RuntimeError(f"TCPStore.set({key!r}) failed")
+            # transport failure, typed like get/add so RetryPolicy's
+            # default classification covers all client ops uniformly
+            raise ConnectionError(f"TCPStore.set({key!r}): io error "
+                                  f"(store unreachable)")
 
     def get(self, key: str, timeout: Optional[float] = None) -> bytes:
+        maybe_fail("store.get", key=key)
         out = ctypes.POINTER(ctypes.c_uint8)()
         out_len = ctypes.c_uint64()
         tmo = self.timeout_ms if timeout is None else int(timeout * 1000)
@@ -134,6 +141,7 @@ class TCPStore:
                 self._lib.pts_free(out)
 
     def add(self, key: str, delta: int = 1) -> int:
+        maybe_fail("store.add", key=key)
         out = ctypes.c_int64()
         with self._mu:
             rc = self._lib.pts_add(self._h(), key.encode(), delta,
@@ -146,6 +154,7 @@ class TCPStore:
         return int(out.value)
 
     def wait(self, key: str, timeout: Optional[float] = None) -> None:
+        maybe_fail("store.wait", key=key)
         tmo = self.timeout_ms if timeout is None else int(timeout * 1000)
         with self._mu:
             rc = self._lib.pts_wait(self._h(), key.encode(), tmo)

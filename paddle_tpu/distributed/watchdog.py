@@ -17,12 +17,26 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from typing import Callable, List, Optional
 
-__all__ = ["CommWatchdog", "monitored_barrier"]
+from ..resilience.faults import maybe_fail
+
+__all__ = ["CommWatchdog", "monitored_barrier",
+           "StoreUnreachableError"]
 
 _HB_PREFIX = "__watchdog__/hb"
 _ERR_PREFIX = "__watchdog__/err"
+
+# "no value for this key" answers from the supported store flavors
+# (TCPStore raises TimeoutError, dict-backed test stores KeyError);
+# anything else from a store read means the store itself is failing
+_KEY_MISSING = (TimeoutError, KeyError)
+
+
+class StoreUnreachableError(ConnectionError):
+    """A store READ failed (transport error) — not the same thing as a
+    peer that merely hasn't heartbeat yet."""
 
 
 class CommWatchdog:
@@ -91,13 +105,20 @@ class CommWatchdog:
     # -- heartbeat ---------------------------------------------------------
     def beat(self):
         """Publish liveness; call at step boundaries."""
+        maybe_fail("watchdog.beat", rank=self.rank)
         self.store.set(f"{_HB_PREFIX}/{self.rank}",
                        repr(time.time()).encode())
 
-    def peer_ages(self) -> dict:
+    def peer_ages(self, on_unreachable: str = "raise") -> dict:
         """Seconds since each peer's last heartbeat. A peer that never
         heartbeat ages from THIS watchdog's start (startup grace: a
-        late-initializing rank is not instantly stale)."""
+        late-initializing rank is not instantly stale).
+
+        Grace applies ONLY to a missing key; a store read that fails at
+        the transport level raises :class:`StoreUnreachableError` (set
+        ``on_unreachable="grace"`` for the old swallow-everything
+        behavior) — a dead store must not masquerade as N healthy
+        just-started peers."""
         now = time.time()
         ages = {}
         for r in range(self.world_size):
@@ -106,7 +127,13 @@ class CommWatchdog:
             try:
                 raw = self.store.get(f"{_HB_PREFIX}/{r}", timeout=1.0)
                 ages[r] = now - float(raw.decode())
-            except Exception:
+            except _KEY_MISSING:
+                ages[r] = now - self._start_time
+            except Exception as e:
+                if on_unreachable == "raise":
+                    raise StoreUnreachableError(
+                        f"heartbeat read for rank {r} failed: "
+                        f"{type(e).__name__}: {e}") from e
                 ages[r] = now - self._start_time
         return ages
 
@@ -150,8 +177,15 @@ class CommWatchdog:
             if note not in self._exceptions:
                 self._exceptions.append(note)
         # staleness recomputed each sweep: a rank that recovers
-        # (heartbeat resumes) drops off; exceptions stay sticky
-        ages = self.peer_ages()
+        # (heartbeat resumes) drops off; exceptions stay sticky.
+        # An unreachable STORE is its own failure mode (rendezvous
+        # gone), not N peers in startup grace.
+        store_notes = []
+        try:
+            ages = self.peer_ages()
+        except StoreUnreachableError as e:
+            ages = {}
+            store_notes = [f"store unreachable: {e}"]
         for r, age in ages.items():
             try:
                 self._m_age.labels(rank=r).set(age)
@@ -165,11 +199,18 @@ class CommWatchdog:
         stale = [f"rank {r} heartbeat stale "
                  f"({age:.1f}s > {self.timeout}s)"
                  for r, age in stale_ranks]
-        self._failed = self._exceptions + stale
+        self._failed = self._exceptions + stale + store_notes
+        if not store_notes:
+            # outage episodes count individually: once the store is
+            # reachable again, a FUTURE outage must bump the failures
+            # counter anew (unlike sticky peer exceptions)
+            self._counted_failures.discard(("store", "unreachable"))
         # dedup on STABLE keys (the stale note embeds a changing age,
         # so the note string itself would re-count every sweep)
         for key in ([("exc", n) for n in self._exceptions]
-                    + [("stale", r) for r, _ in stale_ranks]):
+                    + [("stale", r) for r, _ in stale_ranks]
+                    + [("store", "unreachable")
+                       for _ in store_notes]):
             if key not in self._counted_failures:
                 self._counted_failures.add(key)
                 self._m_failures.inc()
@@ -190,7 +231,13 @@ class CommWatchdog:
     def _loop(self):
         while not self._stop.wait(self.interval):
             if self.auto_beat:
-                self.beat()
+                try:
+                    self.beat()
+                except Exception:
+                    # a transient store write failure must not kill the
+                    # watchdog thread; the NEXT interval beats again
+                    # (peers see at most one widened heartbeat gap)
+                    pass
             if self._sweep() and self.on_failure is not None:
                 try:
                     self.on_failure(list(self._failed))
@@ -198,7 +245,27 @@ class CommWatchdog:
                     self._stop.set()
 
 
-_barrier_rounds: dict = {}
+# rounds key on the store OBJECT, not id(store): after a store is
+# garbage-collected, CPython reuses its address, and an id-keyed dict
+# would hand a brand-new store the dead one's round numbers (skewed
+# barrier keys between ranks). WeakKeyDictionary also frees the
+# bookkeeping with the store instead of leaking one entry per store.
+_barrier_rounds: "weakref.WeakKeyDictionary" = \
+    weakref.WeakKeyDictionary()
+_barrier_rounds_fallback: dict = {}      # stores that refuse weakrefs
+
+
+def _rounds_for(store) -> dict:
+    try:
+        d = _barrier_rounds.get(store)
+        if d is None:
+            d = {}
+            _barrier_rounds[store] = d
+        return d
+    except TypeError:
+        # non-weakref-able store (e.g. __slots__ without __weakref__):
+        # best-effort id keying, the pre-fix behavior
+        return _barrier_rounds_fallback.setdefault(id(store), {})
 
 
 def monitored_barrier(store, rank: int, world_size: int,
@@ -208,9 +275,9 @@ def monitored_barrier(store, rank: int, world_size: int,
     rank 0 waits for all and publishes the release key. Each use of a
     tag is round-numbered per process, so reuse works as long as all
     ranks call the same barriers in order (collective contract)."""
-    rkey = (id(store), tag)
-    rnd = _barrier_rounds.get(rkey, 0)
-    _barrier_rounds[rkey] = rnd + 1
+    rounds = _rounds_for(store)
+    rnd = rounds.get(tag, 0)
+    rounds[tag] = rnd + 1
     key = f"__watchdog__/barrier/{tag}/{rnd}"
     store.set(f"{key}/arrived/{rank}", b"1")
     deadline = time.time() + timeout

@@ -24,6 +24,7 @@ from typing import Any, Callable, Optional
 import numpy as np
 
 from ..framework.tensor import Tensor
+from ..resilience.faults import maybe_fail   # stdlib-only: fork-safe
 from .dataset import Dataset, IterableDataset
 from .sampler import BatchSampler
 
@@ -93,6 +94,7 @@ def _worker_loop(dataset, index_queue, data_queue, collate, init_fn,
             seq = 0
             batch = []
             for sample in dataset:
+                maybe_fail("io.dataloader.worker", wid=wid)
                 if batch_size is None:
                     data_queue.put((wid, seq, sample))
                     seq += 1
@@ -111,6 +113,9 @@ def _worker_loop(dataset, index_queue, data_queue, collate, init_fn,
             if task is None:
                 return
             bidx, indices = task
+            # PTPU_FAULTS is inherited across the fork, so chaos tests
+            # can kill a worker from the parent's environment
+            maybe_fail("io.dataloader.worker", wid=wid)
             samples = [dataset[i] for i in indices]
             data_queue.put((wid, bidx, collate(samples)))
     except KeyboardInterrupt:
@@ -206,6 +211,9 @@ class DataLoader:
         return len(self.batch_sampler)
 
     def _fetch(self, indices):
+        # same fault point as the process workers: thread-mode and
+        # in-process loaders are injectable through one name
+        maybe_fail("io.dataloader.worker")
         samples = [self.dataset[i] for i in indices]
         return self.collate_fn(samples)
 

@@ -1,0 +1,48 @@
+"""paddle_tpu.resilience — make failures survivable, and prove it.
+
+Four pieces (docs/RESILIENCE.md has the full guide):
+
+- **Fault injection** (``faults``): named fault points wired into the
+  serving step, prefill, TCPStore client ops, checkpoint shard writes,
+  the commit point, watchdog heartbeats, and dataloader workers;
+  armed programmatically or via ``PTPU_FAULTS``. Every recovery path
+  below is exercised on CPU by injecting the failure it survives.
+- **RetryPolicy** (``retry``): exponential backoff + seeded jitter,
+  deadline-aware, per-attempt metrics; ``RetryingStore`` applies it to
+  TCPStore get/set/add/wait, and checkpoint shard I/O retries through
+  the same class.
+- **Serving recovery** (``serving.engine``): ``recover()`` rebuilds
+  the slot-pool KV cache from host-side request state and re-prefills
+  in-flight requests (greedy replay verified token-identical), plus
+  request deadlines, a bounded admission queue (typed ``QueueFull``),
+  and ``drain()`` — see ``paddle_tpu.serving.errors``.
+- **ResilientTrainLoop** (``train_loop``): watchdog check + periodic
+  async checkpoints + restore-latest-then-continue, on the
+  ElasticManager checkpoint layout.
+
+This package is stdlib-only at import time (``train_loop`` loads
+lazily), so dataloader worker processes and the TCPStore client can
+import fault points without dragging in jax.
+"""
+from . import faults  # noqa: F401
+from .faults import InjectedFault, maybe_fail  # noqa: F401
+from .retry import RetryError, RetryPolicy, RetryingStore  # noqa: F401
+
+__all__ = ["faults", "InjectedFault", "maybe_fail", "RetryError",
+           "RetryPolicy", "RetryingStore", "ResilientTrainLoop",
+           "TrainLoopError", "RestartLimitExceeded", "train_loop"]
+
+_LAZY = {"ResilientTrainLoop", "TrainLoopError", "RestartLimitExceeded"}
+
+
+def __getattr__(name):
+    # train_loop pulls in distributed.checkpoint (jax) — load lazily so
+    # importing the fault/retry primitives stays dependency-free.
+    # importlib, NOT `from . import`: the fromlist machinery getattrs
+    # the package, which would re-enter this hook and recurse
+    if name in _LAZY or name == "train_loop":
+        import importlib
+        mod = importlib.import_module(".train_loop", __name__)
+        return mod if name == "train_loop" else getattr(mod, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,250 @@
+"""Deliberate fault injection: named fault points, activated on demand.
+
+Crash-only-software discipline: every recovery path in the framework is
+exercised by *injecting* the failure it claims to survive, on CPU, in
+tier-1 tests — not by waiting for a TPU pod to actually lose a host.
+Instrumented layers call ``maybe_fail("<point>")`` at the spots where
+real systems die; the call is a dict lookup when no fault is armed, and
+raises :class:`InjectedFault` (or a caller-chosen exception type) when
+one is.
+
+Wired-in points (see docs/RESILIENCE.md for the catalogue):
+
+===========================  ===========================================
+``serving.step.decode``      right before the decode-step jit call
+``serving.step.prefill``     inside the (re-)prefill program driver
+``store.set/get/add/wait``   TCPStore client ops, before the C call
+``checkpoint.shard_write``   inside the retried per-file shard write
+``checkpoint.commit``        after shards, BEFORE the metadata flip
+``watchdog.beat``            heartbeat publish
+``io.dataloader.worker``     per task/batch in dataloader workers
+``train.step``               ResilientTrainLoop, before step_fn
+===========================  ===========================================
+
+Activation is programmatic::
+
+    from paddle_tpu.resilience import faults
+    faults.inject("serving.step.decode", times=1, after=3)
+    with faults.injected("store.get", times=2, exc=ConnectionError):
+        ...
+
+or via ``PTPU_FAULTS`` (inherited by forked dataloader workers)::
+
+    PTPU_FAULTS="serving.step.decode:1@3,io.dataloader.worker:1"
+    PTPU_FAULTS="store.get:p0.25~seed7"        # seeded Bernoulli per hit
+
+Grammar: ``point:TIMES[@SKIP]`` fails TIMES times after skipping SKIP
+hits; ``point:pRATE~seedSEED`` fails each hit with probability RATE from
+a deterministic per-point RNG. Schedules are deterministic: the same
+arm + the same hit sequence fires the same faults.
+
+Every evaluation is counted per point (``hits()``) and every raise is
+counted per point (``fired()``) and bumped on the
+``ptpu_fault_injections_total{point}`` observability counter, so tests
+can assert both that a recovery path works *and* that the fault point
+it rides is still wired.
+
+stdlib-only on purpose: imported by dataloader worker processes (no jax
+post-fork) and by the TCPStore client.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import threading
+from typing import Dict, Optional
+
+__all__ = ["InjectedFault", "maybe_fail", "inject", "clear", "injected",
+           "hits", "fired", "reset_counts", "parse_spec"]
+
+
+class InjectedFault(RuntimeError):
+    """The default exception a firing fault point raises."""
+
+    def __init__(self, point: str, hit: int):
+        super().__init__(f"injected fault at {point!r} (hit #{hit})")
+        self.point = point
+        self.hit = hit
+
+
+class _Rule:
+    __slots__ = ("times", "after", "rate", "rng", "exc", "from_env")
+
+    def __init__(self, times=None, after=0, rate=None, seed=None,
+                 exc=None, from_env=False):
+        if times is None and rate is None:
+            times = 1
+        self.times = times
+        self.after = int(after)
+        self.rate = rate
+        self.rng = random.Random(seed) if rate is not None else None
+        self.exc = exc
+        self.from_env = from_env
+
+    def should_fire(self) -> bool:
+        if self.rate is not None:
+            return self.rng.random() < self.rate
+        if self.after > 0:
+            self.after -= 1
+            return False
+        if self.times <= 0:
+            return False
+        self.times -= 1
+        return True
+
+    def make_exc(self, point: str, hit: int) -> BaseException:
+        if self.exc is None:
+            return InjectedFault(point, hit)
+        if isinstance(self.exc, BaseException):
+            return self.exc
+        try:        # class or factory; fall back to a bare call
+            return self.exc(f"injected fault at {point!r} (hit #{hit})")
+        except TypeError:
+            return self.exc()
+
+
+_lock = threading.RLock()
+_rules: Dict[str, _Rule] = {}
+_hits: Dict[str, int] = {}
+_fired: Dict[str, int] = {}
+_env_cache: Optional[str] = None
+
+
+def parse_spec(spec: str) -> Dict[str, _Rule]:
+    """Parse a ``PTPU_FAULTS`` string into rules (exposed for tests)."""
+    out: Dict[str, _Rule] = {}
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        if ":" not in entry:
+            raise ValueError(
+                f"bad PTPU_FAULTS entry {entry!r}: expected "
+                f"'point:TIMES[@SKIP]' or 'point:pRATE[~seedN]'")
+        point, _, arm = entry.rpartition(":")
+        if arm.startswith("p"):
+            rate_s, _, seed_s = arm[1:].partition("~seed")
+            out[point] = _Rule(rate=float(rate_s),
+                               seed=int(seed_s) if seed_s else 0,
+                               from_env=True)
+        else:
+            times_s, _, after_s = arm.partition("@")
+            out[point] = _Rule(times=int(times_s),
+                               after=int(after_s) if after_s else 0,
+                               from_env=True)
+    return out
+
+
+def _load_env(env: str) -> None:
+    global _env_cache
+    with _lock:
+        _env_cache = env
+        for point in [p for p, r in _rules.items() if r.from_env]:
+            del _rules[point]
+        try:
+            _rules.update(parse_spec(env))
+        except ValueError:
+            # a malformed env spec must not take the process down from
+            # inside an instrumented hot path; it just arms nothing
+            pass
+
+
+def maybe_fail(point: str, **ctx) -> None:
+    """Evaluate the fault point; raise if a fault is armed and due.
+
+    ``ctx`` kwargs are for call-site readability only (they document
+    what the point guards); the raised exception carries the point name
+    and per-point hit number.
+
+    Disarmed cost is one env read + one dict truthiness check — no
+    lock, no counting — because this sits in per-sample dataloader and
+    per-op store hot paths. Hit counts therefore accumulate only while
+    at least one rule is armed (i.e. during chaos sessions, which is
+    when tests assert wiring via ``hits()``/``fired()``).
+    """
+    env = os.environ.get("PTPU_FAULTS", "")
+    if env != _env_cache:
+        _load_env(env)
+    if not _rules:
+        return
+    with _lock:
+        _hits[point] = _hits.get(point, 0) + 1
+        rule = _rules.get(point)
+        if rule is None or not rule.should_fire():
+            return
+        _fired[point] = _fired.get(point, 0) + 1
+        exc = rule.make_exc(point, _fired[point])
+    try:        # observability is optional here (forked workers, early
+        from ..observability import default_registry   # import paths)
+        default_registry().counter(
+            "ptpu_fault_injections_total",
+            "deliberately injected faults (resilience.faults)",
+            labels=("point",)).labels(point=point).inc()
+    except Exception:
+        pass
+    raise exc
+
+
+def inject(point: str, times: Optional[int] = None, after: int = 0,
+           rate: Optional[float] = None, seed: Optional[int] = None,
+           exc=None) -> None:
+    """Arm a fault at ``point``: fail ``times`` times after skipping
+    ``after`` hits, or (``rate``) each hit with seeded probability.
+    ``exc`` overrides the raised exception (instance, class, or
+    factory)."""
+    with _lock:
+        _rules[point] = _Rule(times=times, after=after, rate=rate,
+                              seed=seed, exc=exc)
+
+
+def clear(point: Optional[str] = None) -> None:
+    """Disarm one point, or every point (``None``)."""
+    with _lock:
+        if point is None:
+            _rules.clear()
+        else:
+            _rules.pop(point, None)
+
+
+@contextlib.contextmanager
+def injected(point: str, times: Optional[int] = None, after: int = 0,
+             rate: Optional[float] = None, seed: Optional[int] = None,
+             exc=None):
+    """Scoped ``inject``: restores the point's previous rule on exit."""
+    with _lock:
+        prev = _rules.get(point)
+    inject(point, times=times, after=after, rate=rate, seed=seed,
+           exc=exc)
+    try:
+        yield
+    finally:
+        with _lock:
+            if prev is None:
+                _rules.pop(point, None)
+            else:
+                _rules[point] = prev
+
+
+def hits(point: Optional[str] = None):
+    """Evaluation count per point (dict), or for one point (int).
+    Counted only while at least one rule is armed (the disarmed hot
+    path skips all bookkeeping)."""
+    with _lock:
+        if point is not None:
+            return _hits.get(point, 0)
+        return dict(_hits)
+
+
+def fired(point: Optional[str] = None):
+    """Raise count per point (dict), or for one point (int)."""
+    with _lock:
+        if point is not None:
+            return _fired.get(point, 0)
+        return dict(_fired)
+
+
+def reset_counts() -> None:
+    with _lock:
+        _hits.clear()
+        _fired.clear()

@@ -1,0 +1,195 @@
+"""Generic retry with exponential backoff + jitter, deadline-aware.
+
+One policy object serves every transient-failure site in the framework
+(TCPStore client ops, checkpoint shard I/O, watchdog heartbeats): it
+classifies exceptions (``retry_on``), backs off exponentially with
+seeded jitter, respects a per-call wall-clock budget (never sleeps past
+the deadline), and publishes per-attempt metrics
+(``ptpu_retry_attempts_total{op}`` / ``..._failures_total`` /
+``..._exhausted_total``) so a flaky dependency is visible long before
+it becomes an outage.
+
+Clock and sleep are injectable, so tests drive the full
+backoff/deadline logic without real waiting::
+
+    policy = RetryPolicy(max_attempts=5, base_delay=0.05, seed=0,
+                         sleep_fn=fake_sleep, time_fn=fake_clock)
+    value = policy.call(store.get, "key", op="store.get")
+
+``InjectedFault`` (resilience.faults) is retryable by default — fault
+points exist precisely to prove these retry paths on CPU.
+"""
+from __future__ import annotations
+
+import functools
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+from .faults import InjectedFault
+
+__all__ = ["RetryError", "RetryPolicy", "RetryingStore"]
+
+
+class RetryError(RuntimeError):
+    """Raised when every attempt failed (or the deadline cut retries
+    short); chains from the last underlying exception."""
+
+    def __init__(self, op: str, attempts: int, last: BaseException,
+                 reason: str = "attempts exhausted"):
+        super().__init__(
+            f"{op}: {reason} after {attempts} attempt(s); last error: "
+            f"{type(last).__name__}: {last}")
+        self.op = op
+        self.attempts = attempts
+        self.last = last
+
+
+class RetryPolicy:
+    """Exponential backoff with jitter; see module docstring.
+
+    ``deadline`` is a per-call wall-clock budget in seconds (measured on
+    ``time_fn``): an attempt whose backoff sleep would overrun it gives
+    up immediately instead of sleeping into a guaranteed timeout.
+    """
+
+    def __init__(self, max_attempts: int = 4, base_delay: float = 0.05,
+                 max_delay: float = 2.0, multiplier: float = 2.0,
+                 jitter: float = 0.25,
+                 deadline: Optional[float] = None,
+                 retry_on: Tuple[Type[BaseException], ...] = (
+                     ConnectionError, TimeoutError, OSError,
+                     InjectedFault),
+                 no_retry_on: Tuple[Type[BaseException], ...] = (),
+                 sleep_fn: Callable[[float], None] = time.sleep,
+                 time_fn: Callable[[], float] = time.monotonic,
+                 seed: Optional[int] = None, registry=None):
+        if max_attempts < 1:
+            raise ValueError(
+                f"max_attempts must be >= 1, got {max_attempts}")
+        self.max_attempts = int(max_attempts)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.multiplier = float(multiplier)
+        self.jitter = float(jitter)
+        self.deadline = deadline
+        self.retry_on = tuple(retry_on)
+        # carve-outs win over retry_on: needed because the exception
+        # tree overlaps (TimeoutError IS an OSError on 3.10+, and "key
+        # not set" timeouts are answers, not faults)
+        self.no_retry_on = tuple(no_retry_on)
+        self.sleep = sleep_fn
+        self.now = time_fn
+        self._rng = random.Random(seed)
+        self._registry = registry
+        self._m_attempts = self._m_failures = self._m_exhausted = None
+
+    def _metrics(self):
+        if self._m_attempts is None:
+            reg = self._registry
+            if reg is None:
+                from ..observability import default_registry
+                reg = default_registry()
+            self._m_attempts = reg.counter(
+                "ptpu_retry_attempts_total",
+                "retry-policy call attempts", labels=("op",))
+            self._m_failures = reg.counter(
+                "ptpu_retry_failures_total",
+                "retryable attempt failures", labels=("op",))
+            self._m_exhausted = reg.counter(
+                "ptpu_retry_exhausted_total",
+                "calls that gave up (attempts or deadline)",
+                labels=("op",))
+        return self._m_attempts, self._m_failures, self._m_exhausted
+
+    def backoff(self, attempt: int) -> float:
+        """Jittered delay after failed attempt ``attempt`` (1-based)."""
+        d = min(self.max_delay,
+                self.base_delay * self.multiplier ** (attempt - 1))
+        if self.jitter:
+            d *= 1.0 + self.jitter * (2.0 * self._rng.random() - 1.0)
+        return max(0.0, d)
+
+    def call(self, fn: Callable, *args, op: Optional[str] = None,
+             deadline: Optional[float] = None, **kwargs):
+        """Run ``fn(*args, **kwargs)`` under the policy and return its
+        value. Non-retryable exceptions propagate immediately."""
+        op = op or getattr(fn, "__name__", "call")
+        budget = self.deadline if deadline is None else deadline
+        t0 = self.now()
+        m_att, m_fail, m_exh = self._metrics()
+        attempt = 0
+        while True:
+            attempt += 1
+            m_att.labels(op=op).inc()
+            try:
+                return fn(*args, **kwargs)
+            except self.retry_on as e:
+                if self.no_retry_on and \
+                        isinstance(e, self.no_retry_on):
+                    raise
+                m_fail.labels(op=op).inc()
+                if attempt >= self.max_attempts:
+                    m_exh.labels(op=op).inc()
+                    raise RetryError(op, attempt, e) from e
+                delay = self.backoff(attempt)
+                if budget is not None and \
+                        (self.now() - t0) + delay > budget:
+                    m_exh.labels(op=op).inc()
+                    raise RetryError(
+                        op, attempt, e,
+                        reason=f"deadline {budget}s would be exceeded"
+                    ) from e
+                self.sleep(delay)
+
+    def wrap(self, fn: Callable, op: Optional[str] = None) -> Callable:
+        """Decorator form of :meth:`call`."""
+        op = op or getattr(fn, "__name__", "call")
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return self.call(fn, *args, op=op, **kwargs)
+
+        return wrapped
+
+
+class RetryingStore:
+    """A store wrapper applying a RetryPolicy to the client ops.
+
+    ``TimeoutError`` from ``get``/``wait`` is the store's legitimate
+    "key not set yet" answer, NOT a transient fault — the default
+    policy here retries only transport-level errors (ConnectionError /
+    OSError / injected faults), so watchdog-style polling keeps its
+    latency. Pass a custom policy to change the classification.
+    """
+
+    def __init__(self, store, policy: Optional[RetryPolicy] = None):
+        self.store = store
+        self.policy = policy or RetryPolicy(
+            retry_on=(ConnectionError, OSError, InjectedFault),
+            no_retry_on=(TimeoutError,))
+
+    def set(self, key, value):
+        return self.policy.call(self.store.set, key, value,
+                                op="store.set")
+
+    def get(self, key, timeout=None):
+        return self.policy.call(self.store.get, key, timeout=timeout,
+                                op="store.get")
+
+    def add(self, key, delta=1):
+        # NOT idempotent: a retry after a lost *response* double-counts.
+        # Safe for the framework's uses (heartbeat counters, where only
+        # "the value moved" matters); don't route exactly-once counters
+        # through this wrapper.
+        return self.policy.call(self.store.add, key, delta,
+                                op="store.add")
+
+    def wait(self, key, timeout=None):
+        return self.policy.call(self.store.wait, key, timeout=timeout,
+                                op="store.wait")
+
+    def __getattr__(self, name):
+        # everything else (world_size, barrier, close, ...) passes
+        # through un-retried
+        return getattr(self.store, name)

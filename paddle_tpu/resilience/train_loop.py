@@ -1,0 +1,253 @@
+"""Fault-tolerant training driver: watchdog + periodic async
+checkpoints + restore-latest-then-continue.
+
+This composes the three pieces the repo already had but never wired
+end-to-end (ISSUE 3): ``CommWatchdog.check()`` at step boundaries,
+CheckFreq-style frequent low-overhead checkpointing via
+``distributed.checkpoint.save_state_dict(async_save=True)``, and — the
+part that was missing — an automatic restore-latest-and-continue path
+when a step dies, so a transient failure costs ``<= save_every`` steps
+of recompute instead of the whole run.
+
+Checkpoint layout is the ElasticManager contract (``step_{n}/`` dirs +
+a ``LATEST`` pointer under ``checkpoint_dir``), with one correctness
+upgrade: ``LATEST`` flips (atomic ``os.replace``) only after the async
+save's writer thread has *completed*, so a crash mid-save can never
+leave ``LATEST`` pointing at a torn checkpoint. A job relaunched by the
+elastic launcher (``ELASTIC_EXIT_CODE``) therefore resumes from the
+same directory this driver writes — in-process recovery and
+process-relaunch recovery share one on-disk format.
+
+Contract for ``step_fn(state, step) -> loss``: it must be restartable —
+running it again from checkpointed ``state`` reproduces the run (the
+chaos test pins loss-curve continuity across an injected mid-run
+crash). ``state`` is a (nested) dict whose Tensor/ndarray leaves are
+checkpointed in place; non-tensor leaves ride the checkpoint metadata.
+
+    loop = ResilientTrainLoop(step_fn, state, ckpt_dir, save_every=20,
+                              watchdog=wd)
+    report = loop.run(num_steps=1000)
+
+Peer failures (watchdog) propagate — a dead peer is not survivable from
+inside one process; the launcher's relaunch loop (fleet.elastic) owns
+that, and this driver's on-start auto-resume completes the circle.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Callable, Dict, Optional, Tuple
+
+from . import faults
+from .retry import RetryPolicy
+
+__all__ = ["ResilientTrainLoop", "TrainLoopError",
+           "RestartLimitExceeded"]
+
+
+class TrainLoopError(RuntimeError):
+    pass
+
+
+class RestartLimitExceeded(TrainLoopError):
+    """More step failures than ``max_recoveries``; chains from the last
+    step exception."""
+
+
+class ResilientTrainLoop:
+    def __init__(self, step_fn: Callable, state: Dict,
+                 checkpoint_dir: str, *, save_every: int = 50,
+                 watchdog=None, max_recoveries: int = 3,
+                 recoverable: Tuple = (Exception,),
+                 retry_policy: Optional[RetryPolicy] = None,
+                 final_save: bool = True,
+                 registry=None, flight_recorder=None,
+                 time_fn: Callable[[], float] = time.monotonic):
+        if save_every < 1:
+            raise ValueError(
+                f"save_every must be >= 1, got {save_every}")
+        self.step_fn = step_fn
+        self.state = state
+        self.checkpoint_dir = checkpoint_dir
+        self.save_every = int(save_every)
+        self.watchdog = watchdog
+        self.max_recoveries = int(max_recoveries)
+        self.recoverable = recoverable
+        self.retry_policy = retry_policy
+        self.final_save = final_save
+        self.now = time_fn
+        from ..observability import default_recorder, default_registry
+        # `is None`, not truthiness: an empty FlightRecorder is falsy
+        self.recorder = flight_recorder if flight_recorder is not None \
+            else default_recorder()
+        reg = registry if registry is not None else default_registry()
+        self.registry = reg
+        self._m_steps = reg.counter(
+            "ptpu_train_steps_total", "training steps completed")
+        self._m_ckpts = reg.counter(
+            "ptpu_train_checkpoints_total",
+            "checkpoints published (LATEST flipped)")
+        self._m_ckpt_fail = reg.counter(
+            "ptpu_train_checkpoint_failures_total",
+            "async checkpoint saves that errored (LATEST kept)")
+        self._m_recoveries = reg.counter(
+            "ptpu_train_recoveries_total",
+            "step failures absorbed by restore-latest-and-continue")
+        # (step, AsyncSaveHandle) of the in-flight async save, if any
+        self._pending: Optional[Tuple[int, object]] = None
+
+    # -- checkpoint protocol (ElasticManager layout) -------------------
+    def _ckpt_path(self, step: int) -> str:
+        return os.path.join(self.checkpoint_dir, f"step_{step}")
+
+    def _wrapped(self, step: int) -> Dict:
+        # "step" rides the checkpoint's non-tensor metadata; load fills
+        # it back so restore knows how many steps are complete
+        return {"state": self.state, "step": int(step)}
+
+    def latest_step(self) -> Optional[int]:
+        p = os.path.join(self.checkpoint_dir, "LATEST")
+        if not os.path.exists(p):
+            return None
+        with open(p) as f:
+            return int(f.read().strip())
+
+    def _publish(self, step: int) -> None:
+        """Atomically flip LATEST — the resume commit point."""
+        p = os.path.join(self.checkpoint_dir, "LATEST")
+        tmp = p + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(str(step))
+        os.replace(tmp, p)
+        self._m_ckpts.inc()
+
+    def _save_async(self, step: int) -> None:
+        from ..distributed.checkpoint import save_state_dict
+        # one async save in flight at a time: settle (publish or
+        # discard) the previous one before starting the next
+        self._settle_pending(wait=True)
+        os.makedirs(self.checkpoint_dir, exist_ok=True)
+        handle = save_state_dict(self._wrapped(step),
+                                 self._ckpt_path(step), async_save=True)
+        self._pending = (step, handle)
+
+    def _settle_pending(self, wait: bool = False) -> None:
+        """Publish the pending async save once its writer finished; a
+        failed save is counted and dropped (LATEST keeps pointing at
+        the previous good checkpoint — training state in memory is
+        fine, the next save point tries again)."""
+        if self._pending is None:
+            return
+        step, handle = self._pending
+        if not wait and not handle.done():
+            return
+        self._pending = None
+        try:
+            handle.wait()
+        except Exception as e:
+            self._m_ckpt_fail.inc()
+            self.recorder.record("train.ckpt_error", step=step,
+                                 error=f"{type(e).__name__}: {e}")
+            return
+        self._publish(step)
+
+    def restore_latest(self) -> Optional[int]:
+        """Load the newest published checkpoint into ``state`` (in
+        place) and return its completed-step count, or None."""
+        step = self.latest_step()
+        if step is None:
+            return None
+        from ..distributed.checkpoint import load_state_dict
+        tmpl = self._wrapped(0)
+        load_state_dict(tmpl, self._ckpt_path(step))
+        return int(tmpl["step"])
+
+    # -- the driver ----------------------------------------------------
+    def _beat_and_check(self) -> None:
+        if self.watchdog is None:
+            return
+        if self.retry_policy is not None:
+            self.retry_policy.call(self.watchdog.beat,
+                                   op="watchdog.beat")
+        else:
+            self.watchdog.beat()
+        # peer failures propagate: not survivable in-process (the
+        # launcher's relaunch loop owns that; on restart, run() resumes
+        # from LATEST automatically)
+        self.watchdog.check()
+
+    def run(self, num_steps: int) -> Dict:
+        """Drive ``step_fn`` to ``num_steps`` completed steps, saving
+        every ``save_every`` and auto-resuming from the latest
+        published checkpoint on start and after recoverable step
+        failures. Returns a report dict (losses, recoveries, restores,
+        published checkpoints)."""
+        report = {"losses": [], "recoveries": 0, "restores": [],
+                  "published": [], "start_step": 0}
+        resumed = self.restore_latest()
+        step = 0 if resumed is None else resumed
+        report["start_step"] = step
+        while step < num_steps:
+            self._beat_and_check()
+            self._settle_pending()
+            try:
+                faults.maybe_fail("train.step", step=step)
+                loss = self.step_fn(self.state, step)
+            except self.recoverable as e:
+                report["recoveries"] += 1
+                self._m_recoveries.inc()
+                self.recorder.record(
+                    "train.crash", step=step,
+                    error=f"{type(e).__name__}: {e}")
+                if report["recoveries"] > self.max_recoveries:
+                    raise RestartLimitExceeded(
+                        f"{report['recoveries']} step failures > "
+                        f"max_recoveries={self.max_recoveries}") from e
+                # an in-flight async save that completes is a
+                # legitimate (newer) restore point — settle it first
+                self._settle_pending(wait=True)
+                restored = self.restore_latest()
+                if restored is None:
+                    # nothing to restore to: the crash may have left
+                    # `state` torn, so continuing silently would train
+                    # on garbage
+                    raise TrainLoopError(
+                        "step failed before the first checkpoint was "
+                        "published; nothing to restore") from e
+                step = restored
+                # drop losses past the restore point: the replayed
+                # steps re-record, and the reported curve stays a
+                # single clean trajectory (no duplicate step entries)
+                report["losses"] = [(s, l) for s, l in report["losses"]
+                                    if s < restored]
+                report["restores"].append(restored)
+                self.recorder.record("train.restore", step=restored)
+                continue
+            report["losses"].append((step, float(loss)))
+            self._m_steps.inc()
+            step += 1
+            if step % self.save_every == 0:
+                self._save_async(step)
+        self._settle_pending(wait=True)
+        if self.final_save and self.latest_step() != num_steps:
+            from ..distributed.checkpoint import save_state_dict
+            handle = save_state_dict(self._wrapped(num_steps),
+                                     self._ckpt_path(num_steps),
+                                     async_save=False)
+            handle.wait()
+            self._publish(num_steps)
+        report["published"] = self._published_steps()
+        return report
+
+    def _published_steps(self):
+        latest = self.latest_step()
+        steps = []
+        if os.path.isdir(self.checkpoint_dir):
+            for name in os.listdir(self.checkpoint_dir):
+                if name.startswith("step_"):
+                    try:
+                        steps.append(int(name[5:]))
+                    except ValueError:
+                        pass
+        return sorted(s for s in steps
+                      if latest is not None and s <= latest)

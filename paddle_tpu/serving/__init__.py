@@ -16,8 +16,17 @@ longest batch member finishes.
 
 Compile count is 1 decode program + O(log max_len) prefill buckets,
 asserted in tests/test_serving_engine.py via trace counting.
+
+Failure contract (docs/RESILIENCE.md): typed errors in ``errors``
+(``QueueFull`` / ``DeadlineExceeded`` / ``EngineBroken`` /
+``EngineIdle`` / ``EngineClosed``), ``ServingEngine.recover()`` after
+a donated-pool step failure, per-request ``deadline_s``, bounded
+``max_queue`` admission, and ``drain()`` for graceful shutdown.
 """
 from .engine import ServingEngine  # noqa: F401
+from .errors import (DeadlineExceeded, EngineBroken,  # noqa: F401
+                     EngineClosed, EngineIdle, QueueFull,
+                     RequestCancelled, ServingError)
 from .metrics import EngineMetrics  # noqa: F401
 from .sampling import SamplingParams, sample_token  # noqa: F401
 from .scheduler import (FIFOScheduler, Request, bucket_for,  # noqa: F401
@@ -26,4 +35,6 @@ from .slot_cache import SlotKVCache  # noqa: F401
 
 __all__ = ["ServingEngine", "EngineMetrics", "SamplingParams",
            "sample_token", "FIFOScheduler", "Request", "bucket_for",
-           "prefill_buckets", "SlotKVCache"]
+           "prefill_buckets", "SlotKVCache", "ServingError",
+           "QueueFull", "DeadlineExceeded", "EngineBroken",
+           "EngineIdle", "EngineClosed", "RequestCancelled"]

@@ -18,6 +18,17 @@ program per step, driven by a host loop):
     while engine.has_work():
         finished = engine.step()
     print(r1.output_ids, engine.metrics.summary())
+
+Resilience contract (docs/RESILIENCE.md): a step that fails with
+donated cache pools marks the engine broken — ``recover()`` rebuilds
+the slot-pool KV cache from host-side request state (re-prefilling
+in-flight requests; greedy replay is verified token-identical) instead
+of the old permanently-poisoned dead-end. Admission is bounded
+(``max_queue`` → typed ``QueueFull``), requests carry optional
+deadlines (cancelled at step boundaries with ``finish_reason ==
+"deadline"``), and ``drain()`` shuts down gracefully. Fault points
+``serving.step.decode`` / ``serving.step.prefill``
+(resilience.faults) make every one of these paths testable on CPU.
 """
 from __future__ import annotations
 
@@ -30,6 +41,9 @@ import numpy as np
 
 from ..framework.tensor import Tensor
 from ..observability import default_recorder, default_registry, span
+from ..resilience.faults import maybe_fail
+from .errors import (DeadlineExceeded, EngineBroken, EngineClosed,
+                     EngineIdle, QueueFull, RequestCancelled)
 from .metrics import EngineMetrics
 from .sampling import SamplingParams, sample_token
 from .scheduler import FIFOScheduler, Request, bucket_for
@@ -84,6 +98,7 @@ class ServingEngine:
                  max_len: Optional[int] = None,
                  eos_id: Optional[int] = None,
                  min_bucket: int = 16,
+                 max_queue: Optional[int] = None,
                  time_fn: Callable[[], float] = time.perf_counter,
                  registry=None, flight_recorder=None):
         self.adapter = _ModelAdapter(model)
@@ -95,6 +110,10 @@ class ServingEngine:
                 f"max_len {self.max_len} exceeds the model's position "
                 f"range {self.adapter.max_positions}")
         self.eos_id = eos_id
+        if max_queue is not None and max_queue < 1:
+            raise ValueError(
+                f"max_queue must be >= 1 or None, got {max_queue}")
+        self.max_queue = max_queue
         self.min_bucket = min(int(min_bucket), self.max_len)
         self.cache = SlotKVCache(
             self.adapter.num_layers, self.max_slots, self.max_len,
@@ -115,7 +134,14 @@ class ServingEngine:
         self._prefill_jit = None
         self._next_rid = 0
         self._step_idx = 0
-        self._poisoned: Optional[str] = None
+        # set when a step fails after donating the cache pools (device
+        # buffers invalidated); recover() clears it
+        self._broken: Optional[str] = None
+        self._closed = False
+        # requests that completed inside a failed step, awaiting
+        # delivery through a SUCCESSFUL recover() report (survives a
+        # recover() that itself faults mid-re-prefill)
+        self._recover_finished: List[Request] = []
         # python-side-effect counters bumped at TRACE time: the compile-
         # count contract (1 decode + O(log max_len) prefill buckets) is
         # asserted against these in tests
@@ -134,17 +160,44 @@ class ServingEngine:
         self._m_evict = reg.counter(
             "ptpu_serving_evictions_total", "slots freed",
             labels=("reason",))
+        self._m_reject = reg.counter(
+            "ptpu_serving_rejected_total",
+            "submissions refused at admission", labels=("reason",))
+        self._m_deadline = reg.counter(
+            "ptpu_serving_deadline_cancellations_total",
+            "requests cancelled at their deadline (queued + in-flight)")
+        self._m_recover = reg.counter(
+            "ptpu_serving_recoveries_total",
+            "successful recover() calls after a broken step")
+        self._m_replay_mismatch = reg.counter(
+            "ptpu_serving_recover_replay_mismatch_total",
+            "recovery re-prefills whose greedy replay token diverged "
+            "from the already-delivered token")
 
     # -- public API ----------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 16,
-               sampling: Optional[SamplingParams] = None) -> Request:
+               sampling: Optional[SamplingParams] = None,
+               deadline_s: Optional[float] = None) -> Request:
         """Queue one request; returns its handle (tokens appear on it
-        as steps run)."""
-        if self._poisoned:
-            raise RuntimeError(
-                f"ServingEngine is unrecoverable (donated cache pools "
-                f"invalidated by a failed step: {self._poisoned}); "
-                f"build a fresh engine.")
+        as steps run).
+
+        ``deadline_s`` (seconds from now, engine clock): the request is
+        cancelled at the first step boundary past the deadline —
+        ``finish_reason`` becomes ``"deadline"`` and ``Request.error``
+        carries a typed :class:`DeadlineExceeded`.
+
+        Typed refusals: :class:`EngineClosed` after ``drain()``,
+        :class:`EngineBroken` until ``recover()``, :class:`QueueFull`
+        when ``max_queue`` requests are already waiting.
+        """
+        if self._closed:
+            raise EngineClosed()
+        if self._broken:
+            raise EngineBroken(self._broken)
+        if self.max_queue is not None \
+                and self.scheduler.depth >= self.max_queue:
+            self._m_reject.labels(reason="queue_full").inc()
+            raise QueueFull(self.scheduler.depth, self.max_queue)
         ids = np.asarray(getattr(prompt_ids, "numpy", lambda: prompt_ids)()
                          ).astype(np.int64)
         if ids.ndim == 2 and ids.shape[0] == 1:   # [1, T] batch-of-one
@@ -166,9 +219,14 @@ class ServingEngine:
                 f"({max_new_tokens}) - 1 exceeds max_len {self.max_len}")
         sampling = sampling or SamplingParams()
         sampling.validate()
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be > 0, got {deadline_s}")
         req = Request(rid=self._next_rid, prompt=ids,
                       max_new_tokens=int(max_new_tokens),
-                      sampling=sampling)
+                      sampling=sampling,
+                      deadline=(self.metrics.now() + deadline_s
+                                if deadline_s is not None else None))
         req._rng = np.random.RandomState(
             sampling.seed if sampling.seed is not None
             else 0x5EED + req.rid)
@@ -191,14 +249,16 @@ class ServingEngine:
         occupancy, queue depth, admissions/evictions, compile events);
         if the step raises, the recorder ring dumps to disk before the
         exception propagates — the post-mortem for a dead serving
-        loop."""
-        if self._poisoned:
-            raise RuntimeError(
-                f"ServingEngine is unrecoverable: a previous step "
-                f"failed after its cache pools were donated (device "
-                f"buffers invalidated) — {self._poisoned}. Build a "
-                f"fresh engine; the flight-recorder dump has the "
-                f"post-mortem.")
+        loop.
+
+        Typed refusals: :class:`EngineBroken` until ``recover()`` after
+        a donated-pool step failure; :class:`EngineIdle` when there is
+        no queued or in-flight work (guard loops with ``has_work()``).
+        """
+        if self._broken:
+            raise EngineBroken(self._broken)
+        if not self.has_work():
+            raise EngineIdle()
         t0 = self.metrics.now()
         step_idx = self._step_idx
         self._step_idx += 1
@@ -213,9 +273,9 @@ class ServingEngine:
                 # the jit call may have CONSUMED the donated pools
                 # before failing: ks/vs can reference deleted device
                 # buffers, and any later step would die confusingly —
-                # refuse further use instead
-                self._poisoned = f"step #{step_idx}: " \
-                                 f"{type(e).__name__}: {e}"
+                # refuse further use until recover() rebuilds them
+                self._broken = f"step #{step_idx}: " \
+                               f"{type(e).__name__}: {e}"
             try:
                 self.recorder.record(
                     "serving.step_error", step=step_idx,
@@ -248,15 +308,28 @@ class ServingEngine:
     def _step_inner(self):
         finished: List[Request] = []
         admitted: List[int] = []
+        # 0) deadline sweep — cancel expired requests BEFORE spending
+        # a prefill or decode slot-step on them
+        self._expire_deadlines(finished)
         # re-snapshot the weights so checkpoint loads / quantization on
         # the live model object take effect next step (same pytree
         # structure -> no retrace; the arrays are just jit arguments)
         self._params, self._buffers = self.adapter.model.raw_state()
         # 1) admission — freed slots refill BEFORE the decode so a new
         # request's first decode token rides this very step
-        for slot, req in self.scheduler.admissions(
-                self.cache.free_slots()):
-            self._prefill(slot, req)
+        pairs = self.scheduler.admissions(self.cache.free_slots())
+        for i, (slot, req) in enumerate(pairs):
+            try:
+                self._prefill(slot, req)
+            except Exception:
+                # admissions() popped the WHOLE batch: everything not
+                # yet prefilled goes back to the queue head in FCFS
+                # order, or a recovered engine silently loses them
+                for _, later in reversed(pairs[i + 1:]):
+                    self.scheduler.requeue(later)
+                if req.slot is None and not req.out_tokens:
+                    self.scheduler.requeue(req)
+                raise
             admitted.append(req.rid)
             if req.finished:
                 self._evict(slot, req, finished)
@@ -271,6 +344,7 @@ class ServingEngine:
                 toks[s, 0] = req.out_tokens[-1]
                 pos[s] = req.next_pos
                 mask[s] = True
+            maybe_fail("serving.step.decode", step=self._step_idx - 1)
             with span("serving.decode", batch=len(active),
                       request_ids=[self.cache.slots[s].rid
                                    for s in active]):
@@ -297,6 +371,132 @@ class ServingEngine:
         self._m_evict.labels(reason=req.finish_reason or "unknown").inc()
         self.metrics.on_finished(req.rid)
 
+    def _expire_deadlines(self, finished: List[Request]) -> None:
+        """Cancel queued and in-flight requests past their deadline
+        (step-boundary sweep; XLA steps are not interruptible
+        mid-kernel, so the boundary is the cancellation grain)."""
+        now = self.metrics.now()
+        for req in self.scheduler.expire(now):
+            req.finished, req.finish_reason = True, "deadline"
+            req.error = DeadlineExceeded(
+                req.rid, "expired while queued")
+            self._m_deadline.inc()
+            self.metrics.on_finished(req.rid)
+            finished.append(req)
+        for s in self.cache.active_slots():
+            req = self.cache.slots[s]
+            if req.deadline is not None and now > req.deadline:
+                req.finished, req.finish_reason = True, "deadline"
+                req.error = DeadlineExceeded(
+                    req.rid, f"expired in slot {s} after "
+                             f"{len(req.out_tokens)} token(s)")
+                self._m_deadline.inc()
+                self._evict(s, req, finished)
+
+    def cancel(self, req: Request, reason: str = "cancelled") -> bool:
+        """Cancel one request (queued or in-flight); returns False if
+        it already finished. Delivered tokens stay on the handle."""
+        if req.finished:
+            return False
+        if self.scheduler.remove(req):
+            pass
+        elif req.slot is not None \
+                and self.cache.slots[req.slot] is req:
+            self.cache.release(req.slot)
+            req.slot = None
+            self._m_evict.labels(reason=reason).inc()
+        else:
+            return False
+        req.finished, req.finish_reason = True, reason
+        req.error = RequestCancelled(req.rid, reason)
+        self.metrics.on_finished(req.rid)
+        return True
+
+    def recover(self) -> dict:
+        """Rebuild device state from host-side request state after a
+        failed step, instead of abandoning the engine.
+
+        Fresh KV pools are allocated (the old ones may reference
+        deleted device buffers after donation), every in-flight request
+        is re-prefilled over its prompt + already-delivered tokens
+        (positions ``0..next_pos-1``), and decoding resumes exactly
+        where it stopped. For greedy requests the re-prefill logits
+        re-predict the last delivered token — verified and counted in
+        ``ptpu_serving_recover_replay_mismatch_total`` (delivered
+        tokens are never retracted). Safe to call repeatedly: a fault
+        during recovery leaves the engine broken and the next
+        ``recover()`` starts over from the same host state.
+
+        Returns a report: recovered slot count, replay mismatches,
+        latency, finished requests that were evicted (they completed
+        in the failed step but were never returned).
+        """
+        t0 = self.metrics.now()
+        reason = self._broken
+        in_flight = [(s, r) for s, r in enumerate(self.cache.slots)
+                     if r is not None]
+        ad = self.adapter
+        self.cache = SlotKVCache(
+            ad.num_layers, self.max_slots, self.max_len, ad.kv_heads,
+            ad.head_dim, ad.dtype)
+        self._params, self._buffers = ad.model.raw_state()
+        # accumulate on the ENGINE, not a local: if a re-prefill below
+        # faults, these requests are gone from the slot table, and the
+        # retrying recover() must still deliver them in its report
+        finished = self._recover_finished
+        todo = []
+        for s, req in in_flight:
+            if req.finished:
+                # completed inside the failed step, never delivered:
+                # evict now and hand it back via the report
+                req.slot = None
+                self._m_evict.labels(
+                    reason=req.finish_reason or "unknown").inc()
+                self.metrics.on_finished(req.rid)
+                finished.append(req)
+            else:
+                # re-assign bookkeeping FIRST so a fault mid-re-prefill
+                # leaves the slot table complete and recover() can
+                # simply run again
+                self.cache.assign(s, req)
+                todo.append((s, req))
+        mismatches = 0
+        for s, req in todo:
+            if not req.out_tokens:
+                # the failed step died between slot assignment and the
+                # first sampled token: finish the prefill now
+                logits = self._prefill_raw(s, req.prompt,
+                                           request_id=req.rid)
+                tok = sample_token(logits, req.sampling, req._rng)
+                req.out_tokens.append(tok)
+                self.metrics.on_token(req.rid)
+                if self._is_finished(req, tok):
+                    self._evict(s, req, finished)
+                continue
+            ids = req.prompt if len(req.out_tokens) <= 1 else \
+                np.concatenate([req.prompt,
+                                np.asarray(req.out_tokens[:-1],
+                                           np.int64)])
+            logits = self._prefill_raw(s, ids, request_id=req.rid)
+            if req.sampling.temperature <= 0 \
+                    and int(np.argmax(logits)) != req.out_tokens[-1]:
+                mismatches += 1
+                self._m_replay_mismatch.inc()
+        self._broken = None
+        self._m_recover.inc()
+        self._recover_finished = []
+        dt = self.metrics.now() - t0
+        report = {"reason": reason,
+                  "recovered_slots": len(todo),
+                  "replay_mismatches": mismatches,
+                  "finished": list(finished),
+                  "latency_s": dt}
+        self.recorder.record(
+            "serving.recover", reason=reason, latency_s=dt,
+            recovered_slots=len(todo), replay_mismatches=mismatches,
+            evicted=[(r.rid, r.finish_reason) for r in finished])
+        return report
+
     def run(self, max_steps: Optional[int] = None) -> List[Request]:
         """Drive step() until the queue and every slot drain."""
         done: List[Request] = []
@@ -306,6 +506,39 @@ class ServingEngine:
             steps += 1
             if max_steps is not None and steps >= max_steps:
                 break
+        return done
+
+    def drain(self, max_steps: Optional[int] = None) -> List[Request]:
+        """Graceful shutdown: refuse new submissions (submit() raises
+        :class:`EngineClosed` from now on) and serve the queue plus
+        every in-flight slot to completion. If ``max_steps`` runs out
+        first — or the engine is (or becomes) broken and the caller
+        chose shutdown over ``recover()`` — whatever remains is
+        cancelled (``finish_reason == "cancelled"``) instead of being
+        stranded un-finished. Returns every request finished or
+        cancelled during the drain."""
+        self._closed = True
+        done: List[Request] = []
+        steps = 0
+        while self.has_work():
+            cutoff = "drain cutoff" if (
+                max_steps is not None and steps >= max_steps) else (
+                f"drain on broken engine ({self._broken})"
+                if self._broken else None)
+            if cutoff is not None:
+                for req in self.scheduler.drain():
+                    req.finished, req.finish_reason = True, "cancelled"
+                    req.error = RequestCancelled(req.rid, cutoff)
+                    self.metrics.on_finished(req.rid)
+                    done.append(req)
+                for s in self.cache.active_slots():
+                    req = self.cache.slots[s]
+                    req.finished, req.finish_reason = True, "cancelled"
+                    req.error = RequestCancelled(req.rid, cutoff)
+                    self._evict(s, req, done)
+                break
+            done.extend(self.step())
+            steps += 1
         return done
 
     # -- internals -----------------------------------------------------
@@ -319,26 +552,37 @@ class ServingEngine:
     def _prefill(self, slot: int, req: Request) -> None:
         """Run the bucketed prefill program for one request, write its
         k/v into the slot row, and sample its first token (TTFT)."""
-        bucket = bucket_for(req.prompt_len, self.min_bucket,
-                            self.max_len)
         self.metrics.on_first_prefill(req.rid)   # queue wait ends here
-        self._m_prefill.labels(bucket=bucket).inc()
-        with span("serving.prefill", request_id=req.rid, slot=slot,
-                  bucket=bucket, prompt_len=req.prompt_len):
-            ids = np.zeros((1, bucket), np.int64)
-            ids[0, :req.prompt_len] = req.prompt
-            logits, ks, vs = self._prefill_fn()(
-                self._params, self._buffers, ids,
-                np.int32(req.prompt_len), np.int32(slot),
-                self.cache.ks, self.cache.vs)
-            self.cache.ks, self.cache.vs = list(ks), list(vs)
+        logits = self._prefill_raw(slot, req.prompt,
+                                   request_id=req.rid)
         self.cache.assign(slot, req)
         req.slot = slot
-        tok = sample_token(np.asarray(jax.device_get(logits)),
-                           req.sampling, req._rng)
+        tok = sample_token(logits, req.sampling, req._rng)
         req.out_tokens.append(tok)
         self.metrics.on_token(req.rid)
         self._is_finished(req, tok)
+
+    def _prefill_raw(self, slot: int, ids: np.ndarray,
+                     request_id=None) -> np.ndarray:
+        """Write ``ids``'s k/v into positions ``0..len-1`` of the slot
+        row via the bucketed prefill program and return the host
+        logits at the last real token. Shared by admission prefill and
+        ``recover()``'s re-prefill (which replays prompt + delivered
+        tokens through the same program)."""
+        maybe_fail("serving.step.prefill", slot=slot)
+        n = int(ids.shape[0])
+        bucket = bucket_for(n, self.min_bucket, self.max_len)
+        self._m_prefill.labels(bucket=bucket).inc()
+        with span("serving.prefill", request_id=request_id, slot=slot,
+                  bucket=bucket, prompt_len=n):
+            padded = np.zeros((1, bucket), np.int64)
+            padded[0, :n] = ids
+            logits, ks, vs = self._prefill_fn()(
+                self._params, self._buffers, padded,
+                np.int32(n), np.int32(slot),
+                self.cache.ks, self.cache.vs)
+            self.cache.ks, self.cache.vs = list(ks), list(vs)
+        return np.asarray(jax.device_get(logits))
 
     def _prefill_fn(self):
         """Prefill program, one compile per bucket length: run the

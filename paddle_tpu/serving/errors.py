@@ -1,0 +1,76 @@
+"""Typed serving-engine errors (the engine's failure contract).
+
+Callers branch on these instead of parsing RuntimeError strings:
+
+- :class:`QueueFull` — ``submit()`` with the bounded admission queue at
+  ``max_queue``; shed load or apply backpressure upstream.
+- :class:`DeadlineExceeded` — a request missed its deadline: set as
+  ``Request.error`` (with ``finish_reason == "deadline"``) when the
+  engine cancels a queued or in-flight request at a step boundary.
+  Never raised by ``submit()`` — whether a deadline is meetable
+  depends on the queue ahead of it (a non-positive ``deadline_s`` is a
+  ``ValueError``).
+- :class:`EngineBroken` — ``step()``/``submit()`` after a step failed
+  with donated cache pools; call ``recover()`` to rebuild and resume.
+- :class:`EngineIdle` — ``step()`` with no queued or in-flight work
+  (guard loops with ``has_work()``).
+- :class:`EngineClosed` — ``submit()`` after ``drain()``.
+- :class:`RequestCancelled` — set as ``Request.error`` by
+  ``cancel()``/``drain(max_steps=...)`` cutoffs.
+"""
+from __future__ import annotations
+
+__all__ = ["ServingError", "QueueFull", "DeadlineExceeded",
+           "EngineBroken", "EngineIdle", "EngineClosed",
+           "RequestCancelled"]
+
+
+class ServingError(RuntimeError):
+    """Base class for the serving engine's typed failures."""
+
+
+class QueueFull(ServingError):
+    def __init__(self, depth: int, max_queue: int):
+        super().__init__(
+            f"admission queue full ({depth} waiting >= max_queue="
+            f"{max_queue}); retry later or raise max_queue")
+        self.depth = depth
+        self.max_queue = max_queue
+
+
+class DeadlineExceeded(ServingError):
+    def __init__(self, rid, detail: str = ""):
+        super().__init__(
+            f"request {rid} missed its deadline"
+            + (f": {detail}" if detail else ""))
+        self.rid = rid
+
+
+class EngineBroken(ServingError):
+    def __init__(self, reason: str):
+        super().__init__(
+            f"ServingEngine is broken (a step failed after its cache "
+            f"pools were donated — device buffers invalidated): "
+            f"{reason}. Call recover() to rebuild the KV pools from "
+            f"host-side request state and resume; the flight-recorder "
+            f"dump has the post-mortem.")
+        self.reason = reason
+
+
+class EngineIdle(ServingError):
+    def __init__(self):
+        super().__init__(
+            "step() called with no queued or in-flight work; guard the "
+            "loop with has_work()")
+
+
+class EngineClosed(ServingError):
+    def __init__(self):
+        super().__init__(
+            "ServingEngine is draining/closed; submit() refused")
+
+
+class RequestCancelled(ServingError):
+    def __init__(self, rid, reason: str = "cancelled"):
+        super().__init__(f"request {rid} cancelled: {reason}")
+        self.rid = rid

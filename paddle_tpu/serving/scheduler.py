@@ -60,6 +60,11 @@ class Request:
     slot: Optional[int] = None
     finished: bool = False
     finish_reason: Optional[str] = None
+    # absolute deadline on the ENGINE clock (None = no deadline); a
+    # request past it is cancelled at the next step boundary with
+    # finish_reason "deadline" and `error` set to the typed exception
+    deadline: Optional[float] = None
+    error: Optional[BaseException] = None
     _rng: Optional[np.random.RandomState] = None
 
     @property
@@ -110,3 +115,30 @@ class FIFOScheduler:
                 break
             picked.append((slot, self._queue.popleft()))
         return picked
+
+    def requeue(self, req: Request) -> None:
+        """Put a request back at the HEAD (a failed admission must not
+        lose its FCFS position — or the request itself)."""
+        self._queue.appendleft(req)
+
+    def remove(self, req: Request) -> bool:
+        """Drop one queued request (cancellation); False if absent."""
+        try:
+            self._queue.remove(req)
+            return True
+        except ValueError:
+            return False
+
+    def expire(self, now: float) -> List[Request]:
+        """Pop every queued request whose deadline has passed."""
+        out = [r for r in self._queue
+               if r.deadline is not None and now > r.deadline]
+        for r in out:
+            self._queue.remove(r)
+        return out
+
+    def drain(self) -> List[Request]:
+        """Pop the whole queue (engine shutdown cutoff)."""
+        out = list(self._queue)
+        self._queue.clear()
+        return out

@@ -74,6 +74,10 @@ def pytest_configure(config):
         "markers",
         "full: slow/e2e tests excluded from the smoke tier "
         "(run smoke with -m 'not full')")
+    config.addinivalue_line(
+        "markers",
+        "chaos: fast fault-injection/recovery tests (tier-1 by "
+        "design; run the subset alone with -m chaos)")
 
 
 # ---------------------------------------------------------------------------

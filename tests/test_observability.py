@@ -535,25 +535,31 @@ def test_dump_embeds_the_owning_registry(tmp_path):
         "ptpu_serving_requests_total"]["samples"][0]["value"] == 1
 
 
-def test_engine_poisoned_after_donating_step_failure(tmp_path):
+def test_engine_broken_after_donating_step_failure(tmp_path):
     """When the failing step ran with DONATED cache pools (TPU path),
     the pools may reference deleted device buffers — the engine must
-    refuse further use with a descriptive error instead of dying
-    confusingly on the next decode."""
-    from paddle_tpu.serving import ServingEngine
+    refuse further use with a typed error until recover() rebuilds
+    the pools from host-side request state (the full recovery contract
+    is pinned in tests/test_serving_engine.py and
+    tests/test_resilience.py)."""
+    from paddle_tpu.serving import EngineBroken, ServingEngine
     fr = FlightRecorder(capacity=8, dump_dir=str(tmp_path))
     eng = ServingEngine(_tiny_llama(), max_slots=2, max_len=32,
                         min_bucket=8, flight_recorder=fr)
     eng._donate = lambda: (5, 6)           # simulate the TPU donation
-    eng.submit(np.arange(1, 5), 4)
+    req = eng.submit(np.arange(1, 5), 4)
 
     def boom(n):
         raise RuntimeError("device OOM mid-step")
 
-    eng.metrics.on_step = boom
+    orig_on_step, eng.metrics.on_step = eng.metrics.on_step, boom
     with pytest.raises(RuntimeError, match="device OOM"):
         eng.step()
-    with pytest.raises(RuntimeError, match="unrecoverable"):
+    with pytest.raises(EngineBroken, match="recover"):
         eng.step()
-    with pytest.raises(RuntimeError, match="unrecoverable"):
+    with pytest.raises(EngineBroken, match="recover"):
         eng.submit(np.arange(1, 5), 4)
+    eng.metrics.on_step = orig_on_step
+    eng.recover()
+    eng.run()
+    assert req.finished and len(req.output_ids) == 4

@@ -219,6 +219,67 @@ def test_eos_evicts_early():
     assert eng.cache.free_slots() == [0]
 
 
+def test_typed_admission_errors():
+    """Flow-control failures are TYPED: a full bounded queue raises
+    QueueFull (not silent unbounded growth), step() on an empty engine
+    raises EngineIdle (not a silent no-op)."""
+    from paddle_tpu.serving import EngineIdle, QueueFull, ServingError
+    model = _tiny_llama()
+    eng = ServingEngine(model, max_slots=1, max_len=32, max_queue=2)
+    with pytest.raises(EngineIdle):
+        eng.step()
+    prompt = np.arange(1, 5)
+    eng.submit(prompt, 2)
+    eng.submit(prompt, 2)
+    with pytest.raises(QueueFull) as ei:
+        eng.submit(prompt, 2)
+    assert ei.value.max_queue == 2 and ei.value.depth == 2
+    assert isinstance(ei.value, ServingError)     # catchable as base
+    eng.step()                  # one admitted: a slot frees queue room
+    eng.submit(prompt, 2)       # accepted again
+    eng.run()
+    with pytest.raises(EngineIdle):
+        eng.step()
+
+
+def test_broken_recover_token_identical_replay():
+    """The poisoned -> recover() -> token-identical-replay path: after
+    a step fails with donated pools, recover() rebuilds the KV pools by
+    re-prefilling prompt + delivered tokens, and the remaining greedy
+    decode matches an unbroken engine token-for-token."""
+    from paddle_tpu.serving import EngineBroken
+    model = _tiny_llama()
+    rng = np.random.RandomState(8)
+    prompts = _prompts(rng, [6, 9, 4])
+
+    ref = ServingEngine(model, max_slots=2, max_len=64, min_bucket=8)
+    refs = [ref.submit(p, max_new_tokens=8) for p in prompts]
+    ref.run()
+
+    eng = ServingEngine(model, max_slots=2, max_len=64, min_bucket=8)
+    eng._donate = lambda: (5, 6)          # simulate the TPU path
+    reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+    eng.step()
+    eng.step()
+
+    def boom(n):
+        raise RuntimeError("device fault mid-step")
+
+    orig_on_step, eng.metrics.on_step = eng.metrics.on_step, boom
+    with pytest.raises(RuntimeError, match="device fault"):
+        eng.step()
+    eng.metrics.on_step = orig_on_step
+    with pytest.raises(EngineBroken, match="recover"):
+        eng.step()
+    report = eng.recover()
+    assert report["recovered_slots"] >= 1
+    assert report["replay_mismatches"] == 0   # greedy replay verified
+    eng.run()
+    for r_ref, r in zip(refs, reqs):
+        assert r_ref.output_ids == r.output_ids, (r_ref.rid, r.rid)
+    assert eng.cache.free_slots() == [0, 1]
+
+
 def test_submit_validation():
     model = _tiny_llama()
     eng = ServingEngine(model, max_slots=1, max_len=32)
