@@ -22,7 +22,12 @@ import sys
 import time
 
 
-def main():
+def build_flagship():
+    """Build the flagship (TPU) or smoke (CPU) trainer + batch at the
+    COMMITTED bench defaults; returns (trainer, ids, labels, info).
+    Shared with ``benchmarks/step_budget.py --run gpt`` so the
+    STEP_BUDGET decomposition profiles exactly the recipe behind the
+    headline — the two drifting apart would make the artifact lie."""
     import os
 
     import jax
@@ -51,6 +56,18 @@ def main():
         moment_dtype = jnp.float32
         size = "tiny"
 
+    # layer_unroll="full" (round-6 tentpole): blocks params live as a
+    # per-layer pytree and the stage runs unrolled, so remat-saved
+    # residuals and the per-layer wgrad dequants write straight from
+    # their producing fusions instead of DUS-stacking into [L, ...]
+    # buffers (the 72 ms copy/slice bucket of the r05 decomposition).
+    # PTPU_LAYER_UNROLL=1 falls back to the rolled scan; an int >1 is
+    # the classic scan-body unroll A/B.
+    unroll_env = os.environ.get("PTPU_LAYER_UNROLL", "full")
+    layer_unroll = "full" if unroll_env == "full" else int(unroll_env)
+    if not on_tpu:
+        layer_unroll = 1  # smoke mode keeps the (faster-compiling) scan
+
     mesh = build_mesh(n_devices=1, pipe=1, model=1, fsdp=1, sep=1)
     trainer = GPTSpmdTrainer(
         cfg, mesh, microbatches=1,
@@ -59,12 +76,27 @@ def main():
         master_dtype=jnp.bfloat16 if on_tpu else jnp.float32,
         quant8="wgrad" if on_tpu else False,
         ce_chunks=1 if on_tpu else 16,
+        layer_unroll=layer_unroll,
         # int8 moment storage (round-5 lever b): -5 ms/step and 2.4 GB
         # of optimizer HBM; parity earned in benchmarks/RESULTS.md
         moment8=on_tpu)
     rng = np.random.RandomState(0)
     ids = rng.randint(0, cfg.vocab_size, (batch, seq)).astype(np.int32)
     labels = np.roll(ids, -1, axis=1)
+    info = {"backend": backend, "on_tpu": on_tpu, "batch": batch,
+            "seq": seq, "steps": steps, "size": size}
+    return trainer, ids, labels, info
+
+
+def main():
+    import os
+
+    import jax
+
+    trainer, ids, labels, info = build_flagship()
+    backend, on_tpu = info["backend"], info["on_tpu"]
+    batch, seq, steps = info["batch"], info["seq"], info["steps"]
+    size = info["size"]
 
     # warmup (compile). NOTE: the barrier is a device_get of the scalar
     # loss — block_until_ready returns early on tunneled TPU backends,
@@ -79,6 +111,34 @@ def main():
         loss = trainer.train_step(ids, labels)
     float(jax.device_get(loss))  # drains the whole dispatched pipeline
     dt = time.perf_counter() - t0
+
+    # step-budget decomposition (round 6): bucket a profiled step via
+    # benchmarks/step_budget.py and print the schema-stable line next
+    # to the tokens/s JSON, so BENCH carries the decomposition, not
+    # just the headline. On by default on TPU; PTPU_STEP_BUDGET=1
+    # forces the attempt elsewhere, =0 disables. Never allowed to sink
+    # the bench itself.
+    want_budget = os.environ.get("PTPU_STEP_BUDGET",
+                                 "1" if on_tpu else "0")
+    if want_budget not in ("0", "", "false"):
+        try:
+            sys.path.insert(0, os.path.join(
+                os.path.dirname(os.path.abspath(__file__)),
+                "benchmarks"))
+            from step_budget import capture, format_line
+            budget = capture(lambda: trainer.train_step(ids, labels),
+                             steps=3)
+            if budget is not None:
+                print(format_line(budget))
+                out_path = os.environ.get("PTPU_STEP_BUDGET_OUT")
+                if out_path:
+                    with open(out_path, "w") as f:
+                        json.dump(budget, f, sort_keys=True)
+                        f.write("\n")
+            else:
+                print("# step_budget: no device plane in trace")
+        except Exception as e:  # profiling is best-effort
+            print(f"# step_budget unavailable: {type(e).__name__}: {e}")
 
     tokens_per_sec = batch * seq * steps / dt
     n_params = trainer.n_params()

@@ -27,7 +27,8 @@ def main():
     ap.add_argument("--masters", default="fp32", choices=["fp32", "bf16"])
     ap.add_argument("--quant8", default="",
                     choices=["", "fwd", "dgrad", "wgrad"])
-    ap.add_argument("--unroll", type=int, default=1)
+    ap.add_argument("--unroll", default="1",
+                    help="int scan-unroll factor, or 'full' for the\n                    per-layer-pytree unrolled stage (round 6)")
     ap.add_argument("--ce-chunks", type=int, default=16)
     ap.add_argument("--ce-int8", action="store_true")
     ap.add_argument("--no-fused-opt", action="store_true")
@@ -56,7 +57,8 @@ def main():
         else jnp.float32,
         quant8={"": False, "fwd": True, "dgrad": "dgrad",
                 "wgrad": "wgrad"}[args.quant8],
-        layer_unroll=args.unroll,
+        layer_unroll=args.unroll if args.unroll == "full"
+        else int(args.unroll),
         ce_chunks=args.ce_chunks,
         ce_int8=args.ce_int8,
         fused_optimizer=False if args.no_fused_opt else None,

@@ -28,6 +28,9 @@ def main():
                     help="dump every op, not just top-N + buckets")
     ap.add_argument("--fuse-ln", action="store_true",
                     help="enable the (default-off) LN->quantize fusion")
+    ap.add_argument("--unroll", default="full",
+                    help="layer_unroll: 'full' (per-layer pytree, the "
+                         "round-6 default) or an int scan-unroll")
     args = ap.parse_args()
 
     import jax
@@ -48,6 +51,9 @@ def main():
                                  master_dtype=jnp.bfloat16,
                                  quant8="wgrad", ce_chunks=1,
                                  moment8=True,
+                                 layer_unroll=args.unroll
+                                 if args.unroll == "full"
+                                 else int(args.unroll),
                                  fuse_ln_quant=args.fuse_ln)
         bs = args.bs or 6
         rng = np.random.RandomState(0)
@@ -103,6 +109,11 @@ def main():
         print(f"{ms:9.3f}  {name[:110]}")
     print(json.dumps({"total_ms_per_step":
                       round(sum(per_step.values()), 1)}))
+    # the machine-checked form of the bucket table above (round 6)
+    import step_budget
+    print(step_budget.format_line(step_budget.budget_from_times(
+        per_line[ops_line], steps=args.steps, line=ops_line,
+        plane="TPU")))
 
 
 if __name__ == "__main__":

@@ -168,6 +168,76 @@ def op_times(xplane_path: str,
     return dict(totals)
 
 
+# ---------------------------------------------------------------------------
+# minimal writer — the inverse of ``planes()`` for exactly the subset
+# this parser reads. Exists so selftests can ship a CHECKED-IN miniature
+# fixture (benchmarks/step_budget.py --selftest) and unit tests can
+# round-trip synthetic traces without TPU hardware.
+# ---------------------------------------------------------------------------
+
+def _enc_varint(n: int) -> bytes:
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _enc_tag(fno: int, wt: int) -> bytes:
+    return _enc_varint((fno << 3) | wt)
+
+
+def _enc_len(fno: int, payload: bytes) -> bytes:
+    return _enc_tag(fno, 2) + _enc_varint(len(payload)) + payload
+
+
+def _enc_int(fno: int, v: int) -> bytes:
+    return _enc_tag(fno, 0) + _enc_varint(v)
+
+
+def encode_xspace(planes_data) -> bytes:
+    """Encode [(plane_name, [(line_name, [(op_name, offset_ps,
+    duration_ps), ...]), ...]), ...] as an XSpace proto byte string.
+    Event-metadata ids are assigned per plane in first-seen order."""
+    space = bytearray()
+    for pname, lines in planes_data:
+        plane = bytearray()
+        plane += _enc_len(2, pname.encode())
+        meta_ids: Dict[str, int] = {}
+        line_blobs = []
+        for lname, events in lines:
+            line = bytearray()
+            line += _enc_len(2, lname.encode())
+            for op_name, off, dur in events:
+                mid = meta_ids.setdefault(op_name, len(meta_ids) + 1)
+                ev = (_enc_int(1, mid) + _enc_int(2, int(off))
+                      + _enc_int(3, int(dur)))
+                line += _enc_len(4, bytes(ev))
+            line_blobs.append(bytes(line))
+        for lb in line_blobs:
+            plane += _enc_len(3, lb)
+        for op_name, mid in meta_ids.items():
+            md = _enc_int(1, mid) + _enc_len(2, op_name.encode())
+            entry = _enc_int(1, mid) + _enc_len(2, md)
+            plane += _enc_len(4, entry)
+        space += _enc_len(1, bytes(plane))
+    return bytes(space)
+
+
+def write_xspace(path: str, planes_data) -> str:
+    """Write an ``encode_xspace`` fixture to ``path`` (.gz honored)."""
+    raw = encode_xspace(planes_data)
+    if path.endswith(".gz"):
+        raw = gzip.compress(raw)
+    with open(path, "wb") as f:
+        f.write(raw)
+    return path
+
+
 def latest_xplane(logdir: str) -> str:
     paths = sorted(glob.glob(os.path.join(
         logdir, "plugins", "profile", "*", "*.xplane.pb")))
@@ -190,18 +260,32 @@ def op_symbol(event_name: str) -> str:
     return m.group(1) if m else event_name
 
 
+# Shared op-family substring tables — consumed by ``bucketize`` below
+# AND by benchmarks/step_budget.py's schema classifier. Edit HERE only:
+# the two bucketizers drifting apart on the same trace is exactly the
+# hand-transcription failure mode the tooling exists to eliminate.
+FLASH_KEYS = ("fa_fwd", "fa_bwd", "flash_attention")
+QUANTIZE_KEYS = ("_rowq", "_colq", "_sr_colq", "rowq_ln",
+                 "sr_cast_ln", "quantize")
+OPTIMIZER_KEYS = ("fused_adamw", "adamw")
+MATMUL_KEYS = ("dot", "gemm", "convolution")
+COPY_KEYS = ("copy", "transpose", "bitcast", "slice",
+             "dynamic-update-slice", "dynamic-slice", "pad",
+             "concatenate", "reshape", "convert", "reduce-precision")
+COLLECTIVE_KEYS = ("all-reduce", "all-gather", "reduce-scatter",
+                   "all-to-all", "collective-permute")
+RNG_KEYS = ("rng",)
+LOOP_KEYS = ("while", "condition", "body", "conditional")
+
 _BUCKETS = [
     ("custom-call", ("custom-call", "checkpoint", "rematted",
-                     "closed_call", "fused_adamw", "_rowq", "_colq",
-                     "_sr_colq", "fa_fwd", "fa_bwd")),
-    ("matmul/conv", ("dot", "gemm", "convolution")),
-    ("copy/slice", ("copy", "transpose", "bitcast", "slice",
-                    "dynamic-update-slice", "dynamic-slice", "pad",
-                    "concatenate", "reshape")),
-    ("collective", ("all-reduce", "all-gather", "reduce-scatter",
-                    "all-to-all", "collective-permute")),
-    ("rng", ("rng",)),
-    ("loop/control", ("while", "condition", "body", "conditional")),
+                     "closed_call") + OPTIMIZER_KEYS + QUANTIZE_KEYS
+                    + FLASH_KEYS),
+    ("matmul/conv", MATMUL_KEYS),
+    ("copy/slice", COPY_KEYS),
+    ("collective", COLLECTIVE_KEYS),
+    ("rng", RNG_KEYS),
+    ("loop/control", LOOP_KEYS),
     ("fusion", ("fusion",)),
 ]
 

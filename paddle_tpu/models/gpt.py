@@ -211,6 +211,27 @@ class GPTForCausalLM(Layer):
 
 AXES = ("pipe", "data", "fsdp", "sep", "model")
 
+# Sharding specs of the stacked [S, L, ...] blocks leaves (mirrors
+# _init_params); the per-layer pytree layout (layer_unroll="full")
+# re-places each unstacked leaf with the tail of the same spec.
+_BLOCK_SPECS = {
+    "ln1_g": ("pipe", None, None), "ln1_b": ("pipe", None, None),
+    "ln2_g": ("pipe", None, None), "ln2_b": ("pipe", None, None),
+    "wqkv": ("pipe", None, "fsdp", "model"),
+    "bqkv": ("pipe", None, "model"),
+    "wproj": ("pipe", None, "model", "fsdp"),
+    "bproj": ("pipe", None, None),
+    "win": ("pipe", None, "fsdp", "model"),
+    "bin": ("pipe", None, "model"),
+    "wout": ("pipe", None, "model", "fsdp"),
+    "bout": ("pipe", None, None),
+    "wg": ("pipe", None, None, None),
+    "w_in": ("pipe", None, "data", "fsdp", "model"),
+    "b_in": ("pipe", None, "data", "model"),
+    "w_out": ("pipe", None, "data", "model", "fsdp"),
+    "b_out": ("pipe", None, "data", None),
+}
+
 
 def build_mesh(n_devices: Optional[int] = None,
                pipe: int = 1, data: Optional[int] = None, fsdp: int = 1,
@@ -239,6 +260,8 @@ class GPTSpmdTrainer:
     ce_int8 = False
     int8_guard_period = 0
     int8_guard_threshold = 0.10
+    _unroll_full = False
+    fuse_bwd_colq = False
     _host_step = 0
     _guard_fn = None
     _guard_events = ()   # __init__ replaces with a per-instance list
@@ -289,6 +312,7 @@ class GPTSpmdTrainer:
                  ce_int8: bool = False,
                  fuse_gelu_quant: Optional[bool] = None,
                  fuse_ln_quant: Optional[bool] = None,
+                 fuse_bwd_colq: Optional[bool] = None,
                  lr_schedule=None,
                  int8_guard_period: int = 0,
                  int8_guard_threshold: float = 0.10):
@@ -412,14 +436,33 @@ class GPTSpmdTrainer:
                 "a SINGLE-device TPU mesh (got fused_optimizer="
                 f"{self.fused_optimizer}, mesh.size={mesh.size}); it "
                 "has no XLA fallback path")
-        # unroll factor for the per-stage layer scan: with the scan
-        # rolled, every remat-saved residual round-trips HBM through a
-        # dynamic-update-slice into the [L, ...] stacked buffer (plus a
-        # matching dynamic-slice in the backward) — measured ~49 ms of
-        # pure stacking traffic on the 1.3B step. Unrolling lets XLA
-        # write each layer's residuals straight from the producing
-        # fusion. Costs compile time roughly linearly in the factor.
-        self.layer_unroll = int(layer_unroll)
+        # unroll policy for the per-stage layer loop. An int is the
+        # classic lax.scan body-unroll factor: the body is replicated
+        # but params/carries stay STACKED [L, ...], so every
+        # remat-saved residual still round-trips HBM through a
+        # dynamic-update-slice into the stacked buffer (plus a matching
+        # dynamic-slice in the backward) — measured ~49 ms of pure
+        # stacking traffic on the 1.3B step, and scan-unroll alone
+        # measured a LOSS (round 3/5). "full" is the structural fix
+        # (round 6): blocks params live as a PER-LAYER pytree (a dict
+        # of "layer_NNN" subtrees, no [L, ...] leading dim anywhere —
+        # dict-shaped so checkpointing flattens it like any state), the
+        # stage runs as a Python loop, and remat saves/gradients/
+        # optimizer state are per-layer leaves — XLA writes each
+        # layer's residuals and weight-grad dequants straight from the
+        # producing fusion instead of DUS-stacking them. Costs compile
+        # time roughly linearly in num_layers; requires pipe=1 (the
+        # pipeline shard_map consumes stacked stage params).
+        self._unroll_full = (layer_unroll == "full")
+        if self._unroll_full:
+            if mesh.shape["pipe"] > 1 or self.V > 1:
+                raise ValueError(
+                    "layer_unroll='full' requires a single-stage mesh "
+                    "(pipe=1, vpp_chunks=1): pipeline schedules consume "
+                    "stacked [S, L, ...] stage params")
+            self.layer_unroll = cfg.num_layers
+        else:
+            self.layer_unroll = int(layer_unroll)
         # vocab-chunk count for the fused CE: fewer chunks = bigger
         # (faster) head matmuls but a larger live logits buffer
         self.ce_chunks = int(ce_chunks)
@@ -464,6 +507,18 @@ class GPTSpmdTrainer:
                 f"fuse_ln_quant must be True/False/'qkv'/'ffn1', got "
                 f"{fuse_ln_quant!r}")
         self.fuse_ln_quant = fuse_ln_quant
+        # fuse_ln_quant's wgrad sub-knob (ADVICE r5): True computes the
+        # LN inside the backward column-quantize path from the saved
+        # [M,1] stats (two reads of the pre-LN x, no h buffer); False
+        # re-materializes LN(x) once and runs the plain one-pass colq
+        # kernel. None defers to env PTPU_FUSE_BWD_COLQ (default off —
+        # the A/B that earned the default is in benchmarks/RESULTS.md).
+        # The [M,1] mean/rstd residuals are only SAVED when the branch
+        # is on (ops/quant_matmul.int8_ln_linear_all8).
+        if fuse_bwd_colq is None:
+            from ..ops.quant_matmul import _env_fuse_bwd_colq
+            fuse_bwd_colq = _env_fuse_bwd_colq()
+        self.fuse_bwd_colq = bool(fuse_bwd_colq)
         if self.moe_experts and mesh.shape["pipe"] > 1 \
                 and self.pipeline_schedule == "gpipe":
             raise NotImplementedError(
@@ -605,6 +660,26 @@ class GPTSpmdTrainer:
                                               None))
         if not self.cfg.tie_embeddings:
             params["head"] = init(k[6], (D, V), std, ("fsdp", "model"))
+        if self._unroll_full:
+            # per-layer pytree layout (layer_unroll="full"): blocks is
+            # a dict of per-layer subtrees keyed "layer_000".. — no
+            # [S, L, ...] leading dims, so remat saves, gradients, and
+            # optimizer state are per-layer leaves that never
+            # round-trip HBM through dynamic-update-slice stacking.
+            # Zero-padded string keys keep sorted() == layer order AND
+            # keep the tree dict-shaped, which is what
+            # distributed/checkpoint.save_state_dict flattens. Values
+            # come from the SAME stacked init (identical RNG draws),
+            # so rolled/unrolled trainers with equal seeds start
+            # bit-identical.
+            blocks = params["blocks"]
+            params["blocks"] = {
+                f"layer_{li:03d}": {
+                    k2: jax.device_put(
+                        v[0, li],
+                        _spec(self.mesh, *_BLOCK_SPECS[k2][2:]))
+                    for k2, v in blocks.items()}
+                for li in range(L)}
         return params
 
     # -- model -------------------------------------------------------------
@@ -635,7 +710,8 @@ class GPTSpmdTrainer:
             from ..ops.quant_matmul import int8_ln_linear_all8, site_seed
             qkv = int8_ln_linear_all8(
                 x, bp["ln1_g"], bp["ln1_b"],
-                bp["wqkv"].astype(x.dtype), site_seed(seed, 1))
+                bp["wqkv"].astype(x.dtype), site_seed(seed, 1),
+                fuse_bwd_colq=self.fuse_bwd_colq)
         else:
             h = _layer_norm(x, bp["ln1_g"], bp["ln1_b"])
             qkv = mm(h, bp["wqkv"].astype(x.dtype), 1)
@@ -684,7 +760,8 @@ class GPTSpmdTrainer:
             from ..ops.quant_matmul import int8_ln_linear_all8, site_seed
             a = int8_ln_linear_all8(
                 x, bp["ln2_g"], bp["ln2_b"],
-                bp["win"].astype(x.dtype), site_seed(seed, 2))
+                bp["win"].astype(x.dtype), site_seed(seed, 2),
+                fuse_bwd_colq=self.fuse_bwd_colq)
         else:
             h = _layer_norm(x, bp["ln2_g"], bp["ln2_b"])
             a = mm(h, bp["win"].astype(x.dtype), 2)
@@ -799,6 +876,17 @@ class GPTSpmdTrainer:
         elementwise) — remat's 2N extra FLOPs shrink to ~0 at the cost
         of ~9 activation buffers per layer."""
         blk = self._remat_wrap(self._block)
+        if self._unroll_full:
+            # per-layer pytree path: stage_params maps "layer_NNN" ->
+            # per-layer dict; residual saves and weight grads are
+            # per-layer leaves (no stacked carries, no DUS)
+            for li, key in enumerate(sorted(stage_params)):
+                bp = stage_params[key]
+                if self.quant8 == "wgrad":
+                    x = blk(x, bp, self._layer_seed(seed, li))
+                else:
+                    x = blk(x, bp)
+            return x
         if self.quant8 == "wgrad":
             xs = (stage_params, self._layer_seeds(seed))
             body = lambda carry, t: (blk(carry, t[0], t[1]), None)
@@ -815,6 +903,13 @@ class GPTSpmdTrainer:
         distinct — ONE definition for the dense and MoE stages."""
         base = jnp.int32(1) if seed is None else seed
         return base + jnp.arange(self.Lps, dtype=jnp.int32) * 16
+
+    def _layer_seed(self, seed, li):
+        """Scalar layer seed for the unrolled path — same derivation
+        as _layer_seeds, so rolled and unrolled draw IDENTICAL SR
+        streams (the bit-parity test relies on it)."""
+        base = jnp.int32(1) if seed is None else seed
+        return base + jnp.int32(li * 16)
 
     def _remat_wrap(self, block_fn):
         """Apply the configured remat policy to a block fn (shared by
@@ -865,6 +960,16 @@ class GPTSpmdTrainer:
         """MoE stage: like _stage_fn but threads the summed
         load-balance aux loss through the layer scan."""
         blk = self._remat_wrap(self._block_moe)
+        if self._unroll_full:
+            aux = jnp.zeros((), jnp.float32)
+            for li, key in enumerate(sorted(stage_params)):
+                bp = stage_params[key]
+                if self.quant8 == "wgrad":
+                    x, a = blk(x, bp, self._layer_seed(seed, li))
+                else:
+                    x, a = blk(x, bp)
+                aux = aux + a
+            return x, aux
         if self.quant8 == "wgrad":
             xs = (stage_params, self._layer_seeds(seed))
 
@@ -908,7 +1013,8 @@ class GPTSpmdTrainer:
             # no pipeline: run the (single) stage outside the pipe
             # shard_map (lets Pallas flash run); microbatches still scan
             # so per-step working shapes match the pipelined path
-            stage = jax.tree.map(lambda a: a[0], params["blocks"])
+            stage = params["blocks"] if self._unroll_full \
+                else jax.tree.map(lambda a: a[0], params["blocks"])
             stage_fn = self._stage_fn_moe if self.moe_experts \
                 else self._stage_fn
             if self.M > 1:
@@ -1226,7 +1332,8 @@ class GPTSpmdTrainer:
 
         def probe(params, input_ids, seed):
             x = self._embed(params["wte"], params["wpe"], input_ids)
-            bp = jax.tree.map(lambda a: a[0, 0], params["blocks"])
+            bp = params["blocks"]["layer_000"] if self._unroll_full \
+                else jax.tree.map(lambda a: a[0, 0], params["blocks"])
             h = _layer_norm(x, bp["ln1_g"], bp["ln1_b"])
             w = bp["wqkv"].astype(h.dtype)
             key = jax.random.PRNGKey(seed.astype(jnp.uint32))

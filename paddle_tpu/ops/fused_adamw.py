@@ -16,6 +16,14 @@ correction, optional exact stochastic rounding to bf16 masters). The
 reference's analog is the fused multi-tensor Adam CUDA kernels
 (paddle/phi/kernels/gpu/fused_adam_kernel.cu, multi_tensor_adam);
 this is the TPU-native version.
+
+Dtype-discipline audit (round 6, part of the convert-tail sweep): all
+bf16<->f32 conversion happens INSIDE the kernels on VMEM-resident
+blocks — no dtype boundary here materializes an HBM convert. The
+kernels sit at the 14 B/param (bf16 moments) / ~10 B/param (int8
+moments) information floor; the remaining optimizer-adjacent HBM
+passes live in the caller (grad-clip global norm reads every leaf
+once) and are shared with the unfused path.
 """
 from __future__ import annotations
 

@@ -47,11 +47,14 @@ def site_seed(seed, site: int):
 def quantize_rowwise(x, axis):
     """Symmetric int8 quantization along ``axis``: returns (q, scale)
     with x ~= q * scale, scale shaped like x with ``axis`` size 1."""
-    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=axis,
-                   keepdims=True)
+    # one hoisted upcast: the amax pass and the cast pass share the f32
+    # view instead of each materializing their own convert (dtype-
+    # discipline pass, round 6 — XLA usually CSEs this, but the jaxpr
+    # should not rely on it)
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=axis, keepdims=True)
     scale = jnp.where(amax == 0.0, 1.0, amax) / 127.0
-    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127) \
-        .astype(jnp.int8)
+    q = jnp.clip(jnp.round(xf / scale), -127, 127).astype(jnp.int8)
     return q, scale
 
 
@@ -186,7 +189,6 @@ def quantize_rowwise_fast(x, axis, interpret=None, act=None):
 # the flagship shape) for the backward to reuse.
 
 _LN_EPS = 1e-5
-_FUSE_BWD_COLQ = False
 
 
 def _rowq_ln_kernel(x_ref, g_ref, b_ref, q_ref, s_ref, m_ref, r_ref):
@@ -322,22 +324,27 @@ def sr_quantize_colwise_ln(x2, m, r, g, b, seed_i):
     return _sr_colq_xla(h, seed_i)
 
 
-def int8_dot_dequant(aq, a_scale, bq, b_scale, dims):
+def int8_dot_dequant(aq, a_scale, bq, b_scale, dims, out_dtype=None):
     """int8 dot_general + f32 dequant. ``dims`` = (a_axes, b_axes)
     contraction dims; scales must already broadcast against the
     result. The ONE quantized-matmul core shared by the block matmuls
-    and the CE head (three call paths, one arithmetic)."""
+    and the CE head (three call paths, one arithmetic). ``out_dtype``
+    folds the final downcast into the dequant epilogue so the fusion
+    writes the consumer dtype directly instead of an f32 buffer plus a
+    separate convert (dtype-discipline pass, round 6); scale math stays
+    f32 either way."""
     y = jax.lax.dot_general(aq, bq, (dims, ((), ())),
                             preferred_element_type=jnp.int32)
-    return y.astype(jnp.float32) * a_scale * b_scale
+    out = y.astype(jnp.float32) * a_scale * b_scale
+    return out if out_dtype is None else out.astype(out_dtype)
 
 
 def _int8_matmul(x, w):
     """x [..., K] @ w [K, N] with int8 MXU math, output in x.dtype."""
     xq, xs = quantize_rowwise_fast(x, axis=-1)     # [..., 1]
     wq, ws = quantize_rowwise_fast(w, axis=0)      # [1, N]
-    y = int8_dot_dequant(xq, xs, wq, ws, ((x.ndim - 1,), (0,)))
-    return y.astype(x.dtype)
+    return int8_dot_dequant(xq, xs, wq, ws, ((x.ndim - 1,), (0,)),
+                            out_dtype=x.dtype)
 
 
 @jax.custom_vjp
@@ -540,8 +547,8 @@ def int8_gelu_linear_all8(x, w, seed):
 def _int8_matmul_gelu(x, w):
     xq, xs = quantize_rowwise_fast(x, axis=-1, act="gelu")
     wq, ws = quantize_rowwise_fast(w, axis=0)
-    y = int8_dot_dequant(xq, xs, wq, ws, ((x.ndim - 1,), (0,)))
-    return y.astype(x.dtype)
+    return int8_dot_dequant(xq, xs, wq, ws, ((x.ndim - 1,), (0,)),
+                            out_dtype=x.dtype)
 
 
 def _fwd_gelu_all8(x, w, seed):
@@ -587,29 +594,55 @@ def _int8_matmul_ln(x, g_ln, b_ln, w):
     x2 = x.reshape(-1, K)
     q, s, m, r = ln_quantize_rowwise(x2, g_ln, b_ln)
     wq, ws = quantize_rowwise_fast(w, axis=0)
-    y = int8_dot_dequant(q, s, wq, ws, ((1,), (0,)))
-    return y.reshape(lead + (w.shape[1],)).astype(x.dtype), m, r
+    y = int8_dot_dequant(q, s, wq, ws, ((1,), (0,)),
+                         out_dtype=x.dtype)
+    return y.reshape(lead + (w.shape[1],)), m, r
 
 
-@jax.custom_vjp
-def int8_ln_linear_all8(x, g_ln, b_ln, w, seed):
+def _env_fuse_bwd_colq() -> bool:
+    import os
+    return os.environ.get("PTPU_FUSE_BWD_COLQ", "0") \
+        not in ("0", "", "false")
+
+
+def int8_ln_linear_all8(x, g_ln, b_ln, w, seed, fuse_bwd_colq=None):
     """``int8_linear_all8(layer_norm(x, g_ln, b_ln), w, seed)`` with
     the LayerNorm computed INSIDE the quantize kernels (round-5 lever
     a): x is the PRE-LN residual stream. Forward and wgrad each read x
     once and never materialize the bf16 LN output; the backward chains
     the LN vjp outside (one fused elementwise + row reductions) and
-    returns real gradients for g_ln/b_ln."""
+    returns real gradients for g_ln/b_ln.
+
+    ``fuse_bwd_colq`` (ADVICE r5 — was the dead module constant
+    _FUSE_BWD_COLQ): True computes the wgrad column quantize of LN(x)
+    from the forward's saved [M,1] mean/rstd stats
+    (sr_quantize_colwise_ln — two reads of the pre-LN x, no h buffer);
+    False re-materializes h once (shared with the LN vjp) and runs the
+    plain one-pass colq kernel, and the [M,1] stats are NOT saved as
+    residuals at all. None defers to env PTPU_FUSE_BWD_COLQ; the
+    trainer threads its own knob (GPTSpmdTrainer(fuse_bwd_colq=...))."""
+    if fuse_bwd_colq is None:
+        fuse_bwd_colq = _env_fuse_bwd_colq()
+    return _int8_ln_linear_all8(bool(fuse_bwd_colq), x, g_ln, b_ln, w,
+                                seed)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _int8_ln_linear_all8(fuse_bwd_colq, x, g_ln, b_ln, w, seed):
     del seed
     return _int8_matmul_ln(x, g_ln, b_ln, w)[0]
 
 
-def _fwd_ln_all8(x, g_ln, b_ln, w, seed):
+def _fwd_ln_all8(fuse_bwd_colq, x, g_ln, b_ln, w, seed):
     y, m, r = _int8_matmul_ln(x, g_ln, b_ln, w)
-    return y, (x, g_ln, b_ln, w, seed, m, r)
+    # the [M,1] stats are residuals ONLY for the fused-bwd-colq branch;
+    # when it is off they would be dead saves (ADVICE r5)
+    stats = (m, r) if fuse_bwd_colq else None
+    return y, (x, g_ln, b_ln, w, seed, stats)
 
 
-def _bwd_ln_all8(res, gy):
-    x, g_ln, b_ln, w, seed, m, r = res
+def _bwd_ln_all8(fuse_bwd_colq, res, gy):
+    x, g_ln, b_ln, w, seed, stats = res
     K = x.shape[-1]
     N = gy.shape[-1]
     # dgrad w.r.t. h = LN(x): int8 per-row, as int8_linear_all8
@@ -633,14 +666,16 @@ def _bwd_ln_all8(res, gy):
 
     h, ln_vjp = jax.vjp(_ref_ln, x, g_ln, b_ln)
     dx, dg_ln, db_ln = ln_vjp(da.astype(x.dtype))
-    # wgrad: SR int8 of h = LN(x). _FUSE_BWD_COLQ=True computes the LN
+    # wgrad: SR int8 of h = LN(x). fuse_bwd_colq=True computes the LN
     # inside the colq path (amax pass + tiled SR cast, two reads of x,
-    # no h buffer); False materializes h once (shared with the vjp
-    # above) and runs the plain one-pass colq kernel — the bwd then
-    # matches the unfused path op-for-op (A/B isolation knob).
+    # no h buffer) from the saved stats; False materializes h once
+    # (shared with the vjp above) and runs the plain one-pass colq
+    # kernel — the bwd then matches the unfused path op-for-op (A/B
+    # isolation knob).
     g2 = gy.reshape(-1, N)
     base = jnp.asarray(seed, jnp.int32) * jnp.int32(1000003)
-    if _FUSE_BWD_COLQ:
+    if fuse_bwd_colq:
+        m, r = stats
         hq, hs = sr_quantize_colwise_ln(x.reshape(-1, K), m, r,
                                         g_ln, b_ln,
                                         base + jnp.int32(7919))
@@ -656,4 +691,4 @@ def _bwd_ln_all8(res, gy):
             np.zeros((), jax.dtypes.float0))
 
 
-int8_ln_linear_all8.defvjp(_fwd_ln_all8, _bwd_ln_all8)
+_int8_ln_linear_all8.defvjp(_fwd_ln_all8, _bwd_ln_all8)

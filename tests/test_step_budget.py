@@ -1,0 +1,122 @@
+"""Step-budget tool (benchmarks/step_budget.py): the selftest fixture
+parses with stable bucket keys on CPU-only CI, the xplane writer
+round-trips through the parser, and the classifier buckets the op
+families the RESULTS.md ledgers talk about (tier-1 by design — the tool
+must not silently rot between TPU rounds)."""
+import json
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(HERE, "benchmarks")
+sys.path.insert(0, BENCH)
+
+import step_budget  # noqa: E402
+import xplane  # noqa: E402
+
+
+def test_selftest_fixture_parses_with_stable_schema():
+    budget = step_budget.selftest()
+    assert budget["schema"] == "ptpu_step_budget_v1"
+    assert set(budget["buckets"]) == set(step_budget.BUCKET_KEYS)
+
+
+def test_selftest_cli_entrypoint():
+    r = subprocess.run(
+        [sys.executable, os.path.join(BENCH, "step_budget.py"),
+         "--selftest"],
+        capture_output=True, text=True, timeout=120,
+        env=dict(os.environ, JAX_PLATFORMS="cpu"))
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [l for l in r.stdout.splitlines()
+             if l.startswith("STEP_BUDGET ")]
+    assert lines, r.stdout
+    rec = json.loads(lines[0][len("STEP_BUDGET "):])
+    assert set(rec["buckets"]) == set(step_budget.BUCKET_KEYS)
+    assert "selftest OK" in r.stdout
+
+
+def test_writer_parser_roundtrip(tmp_path):
+    path = str(tmp_path / "t.xplane.pb")
+    xplane.write_xspace(path, [
+        ("/device:TPU:0", [
+            ("XLA Ops", [("%dot.1 = f32[2,2] dot(...)", 0, 2_000_000),
+                         ("%copy.2 = ...", 2_000_000, 1_000_000)]),
+        ]),
+    ])
+    per_line = xplane.op_self_times(path)
+    assert "XLA Ops" in per_line
+    ops = per_line["XLA Ops"]
+    assert abs(sum(ops.values()) - 0.003) < 1e-9, ops  # ms
+    # nesting: an envelope keeps only its non-child remainder
+    path2 = str(tmp_path / "n.xplane.pb")
+    xplane.write_xspace(path2, [
+        ("/device:TPU:0", [
+            ("XLA Ops", [("%while.1 = ...", 0, 10_000_000),
+                         ("%dot.2 = ...", 1_000_000, 4_000_000)]),
+        ]),
+    ])
+    ops2 = xplane.op_self_times(path2)["XLA Ops"]
+    assert abs(ops2["%while.1 = ..."] - 0.006) < 1e-9, ops2
+    assert abs(ops2["%dot.2 = ..."] - 0.004) < 1e-9, ops2
+
+
+def test_classifier_buckets_known_op_families():
+    c = step_budget.classify
+    assert c("%fusion.339 = bf16[6144,8192] fusion(...)") == "fusion"
+    assert c("%dot.5 = ...") == "matmul"
+    assert c("%convolution.2 = ...") == "matmul"
+    assert c("%dynamic-update-slice.7 = ...") == "copy_slice"
+    assert c("%convert.12 = f32[...] convert(...)") == "copy_slice"
+    assert c("%reduce-precision.3 = ...") == "copy_slice"
+    assert c("%fa_fwd.1 = custom-call(...)") == "flash"
+    assert c("%fa_bwd.4 = custom-call(...)") == "flash"
+    assert c("%_sr_colq_pallas.9 = ...") == "quantize"
+    assert c("%_rowq_call.2 = ...") == "quantize"
+    assert c("%fused_adamw.3 = ...") == "optimizer"
+    assert c("%all-reduce.1 = ...") == "collective"
+    assert c("%rng-bit-generator.6 = ...") == "rng"
+    assert c("%while.9 = ...") == "loop"
+    assert c("%exponential.2 = ...") == "other"
+    # classification keys off the lhs SYMBOL only: a dot in the operand
+    # text must not hijack the bucket
+    assert c("%fusion.1 = fusion(%dot.5, %copy.2)") == "fusion"
+
+
+def test_budget_from_times_schema_and_per_step_division():
+    per_op = {"%dot.1 = ...": 6.0, "%copy.2 = ...": 3.0}
+    b = step_budget.budget_from_times(per_op, steps=3, line="XLA Ops",
+                                      plane="TPU")
+    assert b["schema"] == step_budget.SCHEMA
+    assert set(b["buckets"]) == set(step_budget.BUCKET_KEYS)
+    assert b["buckets"]["matmul"] == 2.0
+    assert b["buckets"]["copy_slice"] == 1.0
+    assert b["buckets"]["flash"] == 0.0  # absent families stay present
+    assert b["total_ms"] == 3.0
+    # the printed artifact is byte-stable for a given record
+    assert step_budget.format_line(b) == step_budget.format_line(
+        json.loads(json.dumps(b)))
+
+
+def test_budget_none_when_no_matching_plane(tmp_path):
+    path = str(tmp_path / "cpu.xplane.pb")
+    xplane.write_xspace(path, [("/host:CPU", [("python", [
+        ("noise", 0, 10)])])])
+    assert step_budget.budget_from_xplane(path) is None
+
+
+def test_fixture_is_committed_and_regenerable(tmp_path):
+    """The checked-in fixture must byte-match what --write-fixture
+    produces: a drifted writer (or a hand-edited fixture) fails here
+    instead of silently changing what the selftest asserts."""
+    assert os.path.exists(step_budget.FIXTURE), step_budget.FIXTURE
+    fresh = str(tmp_path / "fresh.xplane.pb")
+    xplane.write_xspace(fresh, [
+        ("/device:TPU:0 (fixture)",
+         [("XLA Ops", step_budget._FIXTURE_EVENTS),
+          ("Steps", [("train_step.0", 0, 22_000_000_000)])]),
+        ("/host:CPU (fixture)", [("python", [("noise", 0, 10)])]),
+    ])
+    with open(step_budget.FIXTURE, "rb") as a, open(fresh, "rb") as b:
+        assert a.read() == b.read()
