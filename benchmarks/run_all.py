@@ -16,7 +16,9 @@ import sys
 
 SCRIPTS = ["bench_resnet50.py", "bench_bert_dp.py", "bench_gpt_hybrid.py",
            "bench_ernie_zero3.py", "bench_ppyoloe_infer.py",
-           "bench_llama_decode.py", "bench_serving_engine.py"]
+           "bench_llama_decode.py", "bench_serving_engine.py",
+           # budget via PTPU_CHAOS_EPISODES / PTPU_CHAOS_SECONDS
+           "chaos_soak.py"]
 
 
 def main():

@@ -36,6 +36,7 @@ import json
 import os
 import sys
 import threading
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -269,17 +270,23 @@ def wait_for_pending_saves(timeout: Optional[float] = None) -> None:
     ``AsyncSaveHandle.wait()`` are considered handled and skipped.
     Called implicitly by ``load_state_dict`` and at interpreter exit.
 
-    On ``timeout``, handles still writing STAY pending (the atexit
-    drain and later calls keep waiting for them) and a TimeoutError is
-    raised after the sweep — unless a real writer error is also ready,
-    which wins. Each call delivers at most ONE error; handles whose
-    error was not delivered stay pending so the next call (or load)
-    surfaces them rather than silently swallowing all but the first."""
+    ``timeout`` is one TOTAL deadline shared across every pending
+    handle — N in-flight saves block for at most ``timeout`` seconds
+    overall, not N x timeout. On expiry, handles still writing STAY
+    pending (the atexit drain and later calls keep waiting for them)
+    and a TimeoutError is raised after the sweep — unless a real
+    writer error is also ready, which wins. Each call delivers at most
+    ONE error; handles whose error was not delivered stay pending so
+    the next call (or load) surfaces them rather than silently
+    swallowing all but the first."""
+    deadline = None if timeout is None else time.monotonic() + timeout
     first_err = None
     remaining = []
     timed_out = False
     for h in _pending:
-        if not h._done.wait(timeout):
+        left = None if deadline is None \
+            else max(0.0, deadline - time.monotonic())
+        if not h._done.wait(left):
             remaining.append(h)
             timed_out = True
             continue

@@ -19,10 +19,18 @@ Four pieces (docs/RESILIENCE.md has the full guide):
 - **ResilientTrainLoop** (``train_loop``): watchdog check + periodic
   async checkpoints + restore-latest-then-continue, on the
   ElasticManager checkpoint layout.
+- **Chaos soak** (``chaos`` + ``invariants``): a seeded scheduler
+  samples randomized fault schedules over every registered point
+  (``faults.KNOWN_POINTS``) and drives full serving/training
+  episodes, then asserts the end-to-end conservation invariants —
+  exactly-once request delivery, greedy token identity, loss
+  continuity, checkpoint monotonicity, no leaks. A red episode is a
+  seed: one line reproduces it.
 
-This package is stdlib-only at import time (``train_loop`` loads
-lazily), so dataloader worker processes and the TCPStore client can
-import fault points without dragging in jax.
+This package is stdlib-only at import time (``train_loop``,
+``chaos`` and ``invariants`` load lazily), so dataloader worker
+processes and the TCPStore client can import fault points without
+dragging in jax or numpy.
 """
 from . import faults  # noqa: F401
 from .faults import InjectedFault, maybe_fail  # noqa: F401
@@ -30,19 +38,30 @@ from .retry import RetryError, RetryPolicy, RetryingStore  # noqa: F401
 
 __all__ = ["faults", "InjectedFault", "maybe_fail", "RetryError",
            "RetryPolicy", "RetryingStore", "ResilientTrainLoop",
-           "TrainLoopError", "RestartLimitExceeded", "train_loop"]
+           "TrainLoopError", "RestartLimitExceeded", "train_loop",
+           "chaos", "invariants", "ConservationLedger",
+           "InvariantViolation"]
 
-_LAZY = {"ResilientTrainLoop", "TrainLoopError", "RestartLimitExceeded"}
+_LAZY = {"ResilientTrainLoop": "train_loop",
+         "TrainLoopError": "train_loop",
+         "RestartLimitExceeded": "train_loop",
+         "train_loop": "train_loop",
+         "chaos": "chaos",
+         "invariants": "invariants",
+         "ConservationLedger": "invariants",
+         "InvariantViolation": "invariants"}
 
 
 def __getattr__(name):
-    # train_loop pulls in distributed.checkpoint (jax) — load lazily so
-    # importing the fault/retry primitives stays dependency-free.
+    # train_loop pulls in distributed.checkpoint (jax), chaos pulls in
+    # numpy/serving — load lazily so importing the fault/retry
+    # primitives stays dependency-free.
     # importlib, NOT `from . import`: the fromlist machinery getattrs
     # the package, which would re-enter this hook and recurse
-    if name in _LAZY or name == "train_loop":
+    modname = _LAZY.get(name)
+    if modname is not None:
         import importlib
-        mod = importlib.import_module(".train_loop", __name__)
-        return mod if name == "train_loop" else getattr(mod, name)
+        mod = importlib.import_module("." + modname, __name__)
+        return mod if name == modname else getattr(mod, name)
     raise AttributeError(
         f"module {__name__!r} has no attribute {name!r}")

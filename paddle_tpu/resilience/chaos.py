@@ -1,0 +1,544 @@
+"""Seeded chaos-soak scheduler: randomized fault schedules, checked
+against the conservation invariants after every episode.
+
+One episode = one seed. The seed deterministically samples a fault
+schedule over the registered fault points (``faults.KNOWN_POINTS``)
+*and* a workload, drives a full system episode — the serving engine
+under Poisson arrivals with deadlines, cancels and ``recover()``, or a
+:class:`~paddle_tpu.resilience.train_loop.ResilientTrainLoop` with
+injected crashes, torn checkpoints, flaky stores and process
+relaunches — and then audits every invariant in
+``resilience/invariants.py``:
+
+- request conservation (exactly-once delivery, via the engine's
+  ``auditor`` hooks),
+- greedy token identity against an uninjected replay of the same
+  prompts,
+- loss-trajectory continuity against an uninjected baseline run,
+- checkpoint-generation monotonicity with torn-file tolerance,
+- no leaked slots / queue entries / pending save handles / non-daemon
+  threads.
+
+A violation is therefore a *seed*: re-running the same seed replays
+the identical fault schedule and workload (virtual clocks, seeded
+RNGs, no wall-clock anywhere), so every red episode is a one-line
+reproducer. ``tests/test_chaos.py`` runs a fixed seed matrix in
+tier-1 and pins seeds that catch the PR-3 deferred failure-path bug
+classes; ``benchmarks/chaos_soak.py`` runs the open-ended soak under
+a time/episode budget.
+
+The training episode simulates its peers instead of spawning them:
+:class:`ChaosStore` is a dict-backed TCPStore stand-in wired to the
+SAME ``store.*`` fault points as the native client, the watchdog's
+rank-1 peer heartbeats are replayed through that store, and the
+``io.dataloader.worker`` point fires inside the step function the way
+a dead worker process surfaces inside a real step. Everything runs
+single-process on CPU.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import faults
+from .invariants import (ConservationLedger, checkpoint_monotonic_violations,
+                         engine_leak_violations, loss_trajectory_violations,
+                         pending_save_violations, thread_leak_violations,
+                         token_prefix_violations)
+
+__all__ = ["FaultArm", "EpisodeResult", "ChaosStore",
+           "SERVING_SWEEP", "TRAINING_SWEEP",
+           "run_serving_episode", "run_training_episode",
+           "run_episode"]
+
+# the sweep partition: every KNOWN point is sampled by exactly one
+# episode kind (tests assert the union covers the whole catalogue)
+SERVING_SWEEP = ("serving.step.decode", "serving.step.prefill")
+TRAINING_SWEEP = ("train.step", "io.dataloader.worker",
+                  "checkpoint.shard_write", "checkpoint.commit",
+                  "watchdog.beat",
+                  "store.set", "store.get", "store.add", "store.wait")
+
+
+@dataclasses.dataclass
+class FaultArm:
+    """One sampled injection: fail ``times`` times after ``after``
+    hits at ``point`` (the deterministic count-based grammar — finite
+    budgets guarantee every episode terminates)."""
+    point: str
+    times: int
+    after: int
+
+    def arm(self) -> None:
+        faults.inject(self.point, times=self.times, after=self.after)
+
+
+@dataclasses.dataclass
+class EpisodeResult:
+    seed: int
+    kind: str                     # "serving" | "training"
+    violations: List[str]         # empty = every invariant held
+    schedule: List[FaultArm]      # what the seed armed (reproducer)
+    fired: Dict[str, int]         # faults that actually fired
+    stats: Dict[str, object]
+
+    @property
+    def ok(self) -> bool:
+        return not self.violations
+
+
+# ---------------------------------------------------------------------------
+# serving episodes
+# ---------------------------------------------------------------------------
+
+# fixed prompt pool + reference outputs, cached per process: the
+# references ARE the uninjected replay (same engine, same greedy
+# decode), computed once; greedy decoding is prefix-stable, so any
+# episode request over pool prompt i must emit a prefix of _REFS[i].
+# The model is deliberately minuscule (1 layer, d=32): every episode
+# compiles its own engine programs, and the soak's value is in the
+# failure bookkeeping, not the matmuls.
+_MAX_LEN = 32
+_MIN_BUCKET = 8
+_REF_HORIZON = 8
+_model = None
+_refs: Optional[List[List[int]]] = None
+_pool: Optional[List[np.ndarray]] = None
+
+
+def _prompt_pool() -> List[np.ndarray]:
+    global _pool
+    if _pool is None:
+        rng = np.random.RandomState(1234)
+        _pool = [rng.randint(1, 96, (int(n),)).astype(np.int64)
+                 for n in (3, 4, 5, 7, 9, 12)]
+    return _pool
+
+
+def _serving_model():
+    global _model
+    if _model is None:
+        import paddle_tpu as paddle
+        from ..models.llama import LlamaForCausalLM, llama_tiny_config
+        paddle.seed(0)
+        _model = LlamaForCausalLM(llama_tiny_config(
+            num_hidden_layers=1, hidden_size=32, intermediate_size=64,
+            num_attention_heads=2, max_position_embeddings=_MAX_LEN))
+        _model.eval()
+    return _model
+
+
+def _reference_outputs() -> List[List[int]]:
+    """Uninjected greedy replay of every pool prompt (fault-free
+    engine run), the token-identity baseline for all episodes."""
+    global _refs
+    if _refs is None:
+        from ..observability import FlightRecorder, MetricRegistry
+        from ..serving import ServingEngine
+        faults.clear()
+        eng = ServingEngine(_serving_model(), max_slots=2,
+                            max_len=_MAX_LEN, min_bucket=_MIN_BUCKET,
+                            registry=MetricRegistry(),
+                            flight_recorder=FlightRecorder(capacity=4))
+        reqs = [eng.submit(p, max_new_tokens=_REF_HORIZON)
+                for p in _prompt_pool()]
+        eng.run()
+        _refs = [list(r.out_tokens) for r in reqs]
+    return _refs
+
+
+def _sample_arms(rng, specs) -> List[FaultArm]:
+    """``specs``: (point, probability, times_range, after_range)."""
+    arms = []
+    for point, prob, (t0, t1), (a0, a1) in specs:
+        if rng.random() < prob:
+            arms.append(FaultArm(point, times=int(rng.randint(t0, t1)),
+                                 after=int(rng.randint(a0, a1))))
+    return arms
+
+
+def run_serving_episode(seed: int, max_iters: int = 300) \
+        -> EpisodeResult:
+    """One seeded serving episode: Poisson arrivals over the fixed
+    prompt pool with sampled deadlines/cancels, decode/prefill faults
+    (donated-pool and CPU flavors), ``recover()`` after broken steps,
+    and a final ``drain()`` — possibly itself under fire. Every
+    invariant is audited at the end."""
+    from ..observability import FlightRecorder, MetricRegistry
+    from ..serving import ServingEngine
+
+    model = _serving_model()
+    refs = _reference_outputs()
+    pool = _prompt_pool()
+    faults.clear()
+    faults.reset_counts()
+    rng = np.random.RandomState(seed)
+    ledger = ConservationLedger()
+    clock = {"t": 0.0}
+    max_slots = int(rng.randint(1, 4))
+    donate = bool(rng.randint(0, 2))    # TPU-like donated pools or CPU
+    eng = ServingEngine(model, max_slots=max_slots, max_len=_MAX_LEN,
+                        min_bucket=_MIN_BUCKET,
+                        time_fn=lambda: clock["t"],
+                        registry=MetricRegistry(),
+                        flight_recorder=FlightRecorder(capacity=8),
+                        auditor=ledger)
+    if donate:
+        eng._donate = lambda: (5, 6)
+
+    n_req = int(rng.randint(4, 9))
+    plan = []                 # (arrival_t, pool_idx, max_new, deadline)
+    t = 0.0
+    for _ in range(n_req):
+        t += float(rng.exponential(1.5))
+        # 1-token requests finish AT prefill — the admission-batch
+        # finisher that a later prefill fault in the same step
+        # strands; short deadlines expire queued/in-flight requests
+        # in the same steps other faults land in
+        max_new = 1 if rng.random() < 0.25 \
+            else int(rng.randint(2, _REF_HORIZON + 1))
+        plan.append((t, int(rng.randint(0, len(pool))), max_new,
+                     float(rng.randint(2, 18))
+                     if rng.random() < 0.45 else None))
+    cancels = []              # (submit order, loop iteration)
+    if rng.random() < 0.4:
+        cancels.append((int(rng.randint(0, n_req)),
+                        int(rng.randint(1, 12))))
+    schedule = _sample_arms(rng, [
+        ("serving.step.decode", 0.6, (1, 3), (0, 8)),
+        ("serving.step.prefill", 0.5, (1, 3), (0, 8)),
+    ])
+    # shutdown chaos: half the episodes stop serving mid-trace and
+    # drain() with the queue and slots still loaded — optionally with
+    # one more decode fault armed right before the drain, the
+    # mid-drain-failure regime drain() must survive without losing
+    # its already-finished results
+    shutdown_iter = int(rng.randint(2, 10)) \
+        if rng.random() < 0.5 else None
+    drain_arm = None
+    if rng.random() < 0.5:
+        drain_arm = FaultArm("serving.step.decode", times=1,
+                             after=int(rng.randint(0, 3)))
+        schedule = schedule + [drain_arm]
+    for arm in schedule:
+        if arm is not drain_arm:
+            arm.arm()
+
+    violations: List[str] = []
+    submitted: List[Tuple[object, int]] = []
+    recoveries = 0
+    steps_ok = 0
+    i = 0
+    iters = 0
+    try:
+        while i < len(plan) or eng.has_work():
+            iters += 1
+            if iters > max_iters:
+                violations.append(
+                    f"episode did not quiesce within {max_iters} "
+                    f"iterations")
+                break
+            if shutdown_iter is not None and iters >= shutdown_iter:
+                # early shutdown: submit whatever the trace still owes
+                # (so the drain inherits a loaded queue), then fall
+                # through to drain()
+                while i < len(plan):
+                    _, pi, mn, dl = plan[i]
+                    submitted.append(
+                        (eng.submit(pool[pi], max_new_tokens=mn,
+                                    deadline_s=dl), pi))
+                    i += 1
+                break
+            clock["t"] += 1.0
+            while i < len(plan) and plan[i][0] <= clock["t"]:
+                _, pi, mn, dl = plan[i]
+                submitted.append(
+                    (eng.submit(pool[pi], max_new_tokens=mn,
+                                deadline_s=dl), pi))
+                i += 1
+            for order, at_iter in cancels:
+                if at_iter == iters and order < len(submitted):
+                    eng.cancel(submitted[order][0])
+            if not eng.has_work():
+                continue
+            try:
+                eng.step()
+                steps_ok += 1
+            except Exception:
+                # a broken engine (donated pools) needs recover() —
+                # which may itself fault and is simply retried; a
+                # non-broken fault left the request re-queued and the
+                # next loop pass retries the step
+                attempts = 0
+                while eng._broken:
+                    attempts += 1
+                    if attempts > 10:
+                        violations.append(
+                            "recover() did not converge within 10 "
+                            "attempts")
+                        return _serving_result(
+                            seed, violations, schedule, ledger,
+                            submitted, refs, eng, recoveries, steps_ok)
+                    try:
+                        eng.recover()
+                        recoveries += 1
+                    except Exception:
+                        continue
+        if drain_arm is not None:
+            drain_arm.arm()
+        eng.drain()
+    except Exception as e:  # noqa: BLE001 — any escape breaks the
+        violations.append(  # "drain()/step() never strand work" law
+            f"episode escaped with {type(e).__name__}: {e}")
+    return _serving_result(seed, violations, schedule, ledger,
+                           submitted, refs, eng, recoveries, steps_ok)
+
+
+def _serving_result(seed, violations, schedule, ledger, submitted,
+                    refs, eng, recoveries, steps_ok) -> EpisodeResult:
+    fired = faults.fired()
+    faults.clear()
+    violations = list(violations)
+    violations += ledger.violations()
+    violations += engine_leak_violations(eng)
+    violations += token_prefix_violations(
+        (req, refs[pi]) for req, pi in submitted)
+    return EpisodeResult(
+        seed=seed, kind="serving", violations=violations,
+        schedule=schedule, fired=fired,
+        stats={"requests": len(submitted), "recoveries": recoveries,
+               "steps": steps_ok,
+               "donate": eng._donate() != (),
+               "max_slots": eng.max_slots})
+
+
+# ---------------------------------------------------------------------------
+# training episodes
+# ---------------------------------------------------------------------------
+
+class ChaosStore:
+    """Dict-backed TCPStore stand-in wired to the SAME ``store.*``
+    fault points as the native client (distributed/store.py), so the
+    chaos sweep exercises store-outage handling without a server."""
+
+    def __init__(self):
+        self._d = {}
+        self.world_size = 1
+
+    def set(self, k, v):
+        faults.maybe_fail("store.set", key=k)
+        self._d[k] = v if isinstance(v, bytes) else str(v).encode()
+
+    def get(self, k, timeout=None):
+        faults.maybe_fail("store.get", key=k)
+        if k not in self._d:
+            raise TimeoutError(f"no value for {k}")
+        return self._d[k]
+
+    def add(self, k, delta=1):
+        faults.maybe_fail("store.add", key=k)
+        cur = int(self._d.get(k, b"0")) + delta
+        self._d[k] = str(cur).encode()
+        return cur
+
+    def wait(self, k, timeout=None):
+        faults.maybe_fail("store.wait", key=k)
+        if k not in self._d:
+            raise TimeoutError(k)
+
+
+class _PeeredWatchdog:
+    """A world_size=2 CommWatchdog whose rank-1 peer is simulated:
+    every beat also refreshes the peer's heartbeat through the (chaos)
+    store, and check() reads peer ages first, so ``watchdog.beat`` AND
+    ``store.set``/``store.get`` fault points all fire on the training
+    loop's per-step watchdog path."""
+
+    def __init__(self, store, registry, recorder):
+        from ..distributed.watchdog import CommWatchdog
+        self.store = store
+        self.wd = CommWatchdog(store, rank=0, world_size=2,
+                               timeout=3600.0, registry=registry,
+                               flight_recorder=recorder)
+
+    def beat(self):
+        self.store.set("__watchdog__/hb/1",
+                       repr(time.time()).encode())
+        self.wd.beat()
+
+    def check(self):
+        # grace: an injected store outage must degrade to "peer in
+        # startup grace", not kill the run — RetryingStore has already
+        # absorbed what the retry budget covers
+        self.wd.peer_ages(on_unreachable="grace")
+        self.wd.check()
+
+
+def _read_latest(ckpt_dir: str) -> Optional[int]:
+    p = os.path.join(ckpt_dir, "LATEST")
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        return int(f.read().strip())
+
+
+def run_training_episode(seed: int, workdir: str,
+                         num_steps: int = 12, save_every: int = 4,
+                         max_relaunches: int = 8) -> EpisodeResult:
+    """One seeded training episode: a ResilientTrainLoop over a
+    deterministic numpy step function, with crashes injected into the
+    step (``train.step``), the simulated data pipeline
+    (``io.dataloader.worker``), checkpoint shard writes and the commit
+    point, watchdog beats, and every chaos-store op. An exception that
+    escapes ``run()`` is treated as a process crash: the loop is
+    relaunched with FRESH state (memory is gone) and auto-resumes from
+    the LATEST published checkpoint — in-process recovery and relaunch
+    recovery share one on-disk format, and both must preserve the loss
+    trajectory."""
+    from .retry import RetryPolicy, RetryingStore
+    from .train_loop import ResilientTrainLoop
+    from ..distributed.checkpoint import wait_for_pending_saves
+    from ..observability import FlightRecorder, MetricRegistry
+
+    faults.clear()
+    faults.reset_counts()
+    rng = np.random.RandomState(seed)
+    threads_before = list(threading.enumerate())
+    ckpt_dir = os.path.join(workdir, f"chaos_train_{seed}")
+    data = np.random.RandomState(20240 + 7).randn(32, 4) \
+        .astype(np.float32)
+
+    def fresh_state():
+        return {"w": np.zeros((4,), np.float32), "seen": 0}
+
+    def step_fn(state, step):
+        # the dataloader-worker fault point fires where a dead worker
+        # process surfaces in a real run: inside the step, before the
+        # update — recoverable, replayed from the last checkpoint
+        faults.maybe_fail("io.dataloader.worker", step=step)
+        g = data[step % len(data)]
+        state["w"] = state["w"] - 0.1 * (state["w"] - g)
+        state["seen"] = int(state["seen"]) + 1
+        return float(np.sum(state["w"] ** 2))
+
+    # uninjected baseline (no rules armed yet: maybe_fail is a no-op)
+    base_state = fresh_state()
+    base_losses = [(s, step_fn(base_state, s))
+                   for s in range(num_steps)]
+
+    # crash-type faults must land AFTER the first publishable
+    # checkpoint exists (a crash before it is typed-fatal by design);
+    # retryable-I/O faults stay under the retry budgets so schedules
+    # are survivable by construction — what is being tested is that
+    # the SURVIVAL bookkeeping never loses or corrupts anything
+    schedule = _sample_arms(rng, [
+        ("train.step", 0.5, (1, 3), (save_every, num_steps)),
+        ("io.dataloader.worker", 0.35, (1, 2),
+         (save_every + 1, num_steps + 4)),
+        ("checkpoint.shard_write", 0.5, (1, 5), (0, 6)),
+        ("checkpoint.commit", 0.4, (1, 2), (0, 3)),
+        ("watchdog.beat", 0.5, (1, 3), (0, num_steps)),
+        ("store.set", 0.35, (1, 3), (0, 12)),
+        ("store.get", 0.35, (1, 3), (0, 12)),
+        ("store.add", 0.3, (1, 2), (0, 4)),
+        ("store.wait", 0.3, (1, 2), (0, 4)),
+    ])
+    for arm in schedule:
+        arm.arm()
+
+    reg = MetricRegistry()
+    no_sleep = lambda d: None          # noqa: E731 — injected sleep
+    store = RetryingStore(ChaosStore(), RetryPolicy(
+        max_attempts=4, base_delay=0.001, jitter=0.0,
+        sleep_fn=no_sleep,
+        retry_on=(ConnectionError, OSError, faults.InjectedFault),
+        no_retry_on=(TimeoutError,), registry=reg))
+    recorder = FlightRecorder(capacity=8)
+    watchdog = _PeeredWatchdog(store, reg, recorder)
+    retry_pol = RetryPolicy(
+        max_attempts=4, base_delay=0.001, jitter=0.0,
+        sleep_fn=no_sleep, registry=reg)
+
+    violations: List[str] = []
+    reports: List[dict] = []
+    latest_history: List[Optional[int]] = []
+    crashes: List[str] = []
+    state = None
+    completed = False
+    for _ in range(max_relaunches):
+        state = fresh_state()          # relaunch: memory is gone
+        loop = ResilientTrainLoop(
+            step_fn, state, ckpt_dir, save_every=save_every,
+            watchdog=watchdog, max_recoveries=10,
+            retry_policy=retry_pol, registry=MetricRegistry(),
+            flight_recorder=FlightRecorder(capacity=32))
+        try:
+            reports.append(loop.run(num_steps))
+            latest_history.append(_read_latest(ckpt_dir))
+            completed = True
+            break
+        except Exception as e:  # noqa: BLE001 — "process crash"
+            crashes.append(f"{type(e).__name__}: {e}")
+            latest_history.append(_read_latest(ckpt_dir))
+        # store health probe between relaunches: exercises add/wait
+        # through the retry wrapper (absorbed by budget construction)
+        try:
+            store.add("__chaos__/relaunches", 1)
+            store.wait("__chaos__/relaunches")
+        except Exception as e:  # noqa: BLE001
+            violations.append(f"store probe escaped retries: "
+                              f"{type(e).__name__}: {e}")
+    if not completed:
+        violations.append(
+            f"training did not converge within {max_relaunches} "
+            f"relaunches (crashes: {crashes})")
+
+    # settle every async save; each call may deliver one previously
+    # unobserved writer error (that IS the surfacing contract)
+    for _ in range(8):
+        try:
+            wait_for_pending_saves(timeout=60.0)
+            break
+        except TimeoutError:
+            violations.append("async saves still writing after the "
+                              "episode settled")
+            break
+        except Exception:
+            continue
+    fired = faults.fired()
+    faults.clear()
+
+    violations += pending_save_violations()
+    violations += thread_leak_violations(threads_before)
+    violations += loss_trajectory_violations(reports, base_losses)
+    if completed:
+        if not np.array_equal(state["w"], base_state["w"]):
+            violations.append(
+                "final weights diverged from the uninjected baseline")
+        violations += checkpoint_monotonic_violations(
+            ckpt_dir,
+            lambda: {"state": fresh_state(), "step": 0},
+            latest_history, expect_final=num_steps)
+    return EpisodeResult(
+        seed=seed, kind="training", violations=violations,
+        schedule=schedule, fired=fired,
+        stats={"relaunches": len(crashes), "crashes": crashes,
+               "recoveries": sum(r["recoveries"] for r in reports),
+               "num_steps": num_steps})
+
+
+def run_episode(seed: int, kind: str, workdir: Optional[str] = None) \
+        -> EpisodeResult:
+    """Dispatch one episode; training episodes need a ``workdir``."""
+    if kind == "serving":
+        return run_serving_episode(seed)
+    if kind == "training":
+        if workdir is None:
+            raise ValueError("training episodes need a workdir")
+        return run_training_episode(seed, workdir)
+    raise ValueError(f"unknown episode kind {kind!r}")

@@ -56,7 +56,24 @@ import threading
 from typing import Dict, Optional
 
 __all__ = ["InjectedFault", "maybe_fail", "inject", "clear", "injected",
-           "hits", "fired", "reset_counts", "parse_spec"]
+           "hits", "fired", "reset_counts", "parse_spec",
+           "KNOWN_POINTS"]
+
+# The registered fault-point catalogue (must match the call sites and
+# the docs/RESILIENCE.md table). The chaos sweep (resilience/chaos.py)
+# samples its randomized schedules over THIS tuple, so adding a point
+# here (after instrumenting a call site) automatically enrolls it in
+# the soak; tests/test_chaos.py asserts the sweep covers every entry.
+KNOWN_POINTS = (
+    "serving.step.decode",
+    "serving.step.prefill",
+    "store.set", "store.get", "store.add", "store.wait",
+    "checkpoint.shard_write",
+    "checkpoint.commit",
+    "watchdog.beat",
+    "io.dataloader.worker",
+    "train.step",
+)
 
 
 class InjectedFault(RuntimeError):

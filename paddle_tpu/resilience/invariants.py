@@ -1,0 +1,279 @@
+"""End-to-end conservation invariants for chaos soaking.
+
+PR 3 built the fault-injection and recovery machinery, and its review
+still found failure-path bugs *by hand* — every one of them an
+instance of a checkable global law (a finished request vanished across
+a step fault; ``drain()`` dropped results it had already collected; a
+timeout multiplied by the handle count). This module states those laws
+once, as code, so the chaos scheduler (``resilience/chaos.py``) can
+assert them after every randomized episode instead of waiting for the
+next reviewer to spot the next instance:
+
+- **Request conservation** (:class:`ConservationLedger`): every
+  submitted request is delivered to a caller exactly once — via a
+  ``step()`` return, a ``recover()`` report, a ``drain()`` return, or
+  a successful ``cancel()`` — across any number of step faults and
+  recoveries. Never lost, never duplicated, always in a terminal
+  state. The serving engine feeds the ledger through its ``auditor``
+  hooks at exactly the external delivery boundaries.
+- **Greedy token identity** (:func:`token_prefix_violations`): a
+  request's delivered tokens are a prefix of the uninjected greedy
+  replay of the same prompt — faults and recoveries may shorten output
+  (deadline/cancel) but never corrupt it.
+- **Loss-trajectory continuity** (:func:`loss_trajectory_violations`):
+  every (step, loss) a resilient training run reports matches the
+  uninjected baseline bit-for-bit, whatever crashes and restores
+  happened in between.
+- **Checkpoint-generation monotonicity**
+  (:func:`checkpoint_monotonic_violations`): the LATEST pointer never
+  moves backwards and always names a loadable checkpoint, with torn
+  shard files from interrupted saves tolerated.
+- **No leaks** (:func:`engine_leak_violations`,
+  :func:`thread_leak_violations`, :func:`pending_save_violations`): a
+  quiesced engine holds no slots, queue entries, or undelivered
+  requests; an episode spawns no surviving non-daemon threads and
+  settles every async save handle.
+
+Checkers return a list of human-readable violation strings (empty =
+invariant holds) so one episode can report every broken law at once;
+``ConservationLedger.check()`` wraps that in a raised
+:class:`InvariantViolation` for direct test use. Everything here is
+stdlib+engine-state only — no clocks, no randomness — so a violation
+is a deterministic function of the episode it audits.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+__all__ = ["InvariantViolation", "ConservationLedger",
+           "token_prefix_violations", "engine_leak_violations",
+           "thread_leak_violations", "pending_save_violations",
+           "loss_trajectory_violations",
+           "checkpoint_monotonic_violations"]
+
+
+class InvariantViolation(AssertionError):
+    """A conservation law broke; the message lists every violation."""
+
+    def __init__(self, violations: Sequence[str]):
+        self.violations = list(violations)
+        super().__init__(
+            f"{len(self.violations)} invariant violation(s):\n  - "
+            + "\n  - ".join(self.violations))
+
+
+class ConservationLedger:
+    """Double-entry accounting for serving requests.
+
+    Plug into the engine (``ServingEngine(..., auditor=ledger)``): the
+    engine calls :meth:`on_submitted` once per accepted ``submit()``
+    and :meth:`on_delivered` each time a request surfaces at an
+    external boundary (``step`` / ``recover`` / ``drain`` / ``cancel``
+    — internal step() calls inside drain() are NOT boundaries).
+    :meth:`violations` then audits the books: every submission must
+    have exactly one delivery, every delivery a submission, and every
+    delivered request a terminal state.
+    """
+
+    def __init__(self):
+        self.submitted: Dict[int, object] = {}        # rid -> Request
+        self.delivered: Dict[int, List[str]] = {}     # rid -> [via...]
+
+    # -- hooks (the engine calls these) --------------------------------
+    def on_submitted(self, req) -> None:
+        if req.rid in self.submitted:
+            # recorded as a delivery-side anomaly at audit time
+            self.delivered.setdefault(req.rid, []).append("resubmit!")
+        self.submitted[req.rid] = req
+
+    def on_delivered(self, req, via: str = "step") -> None:
+        self.delivered.setdefault(req.rid, []).append(via)
+
+    # -- audit ---------------------------------------------------------
+    def violations(self) -> List[str]:
+        out = []
+        for rid, req in sorted(self.submitted.items()):
+            vias = self.delivered.get(rid, [])
+            if not vias:
+                out.append(
+                    f"request {rid} LOST: submitted, reached "
+                    f"finished={req.finished} "
+                    f"reason={req.finish_reason!r}, never delivered")
+            elif len(vias) > 1:
+                out.append(
+                    f"request {rid} DELIVERED {len(vias)} times "
+                    f"(via {vias})")
+            if vias and not req.finished:
+                out.append(
+                    f"request {rid} delivered via {vias} but not in a "
+                    f"terminal state (finished=False)")
+            if vias and req.finished and req.finish_reason is None:
+                out.append(
+                    f"request {rid} finished without a finish_reason")
+        for rid, vias in sorted(self.delivered.items()):
+            if rid not in self.submitted:
+                out.append(
+                    f"request {rid} delivered via {vias} but never "
+                    f"submitted (phantom)")
+        return out
+
+    def check(self) -> None:
+        v = self.violations()
+        if v:
+            raise InvariantViolation(v)
+
+
+def token_prefix_violations(
+        pairs: Iterable[Tuple[object, Sequence[int]]]) -> List[str]:
+    """Greedy token identity vs the uninjected replay.
+
+    ``pairs`` yields ``(request, reference_tokens)`` where
+    ``reference_tokens`` is the clean greedy generation for the same
+    prompt, at least as long as the request could have produced. A
+    normally-finished request (``length``/``eos``) must match the
+    reference exactly over its full output; a deadline-cancelled or
+    caller-cancelled request may stop early but every token it DID
+    deliver must still match (prefix property of greedy decoding:
+    token *t* depends only on the prefix, so recovery re-prefills must
+    reproduce it bit-for-bit).
+    """
+    out = []
+    for req, ref in pairs:
+        got = list(req.out_tokens)
+        if len(got) > len(ref):
+            out.append(
+                f"request {req.rid} emitted {len(got)} tokens, "
+                f"reference replay has only {len(ref)}")
+            continue
+        if got != list(ref[:len(got)]):
+            out.append(
+                f"request {req.rid} tokens diverged from the "
+                f"uninjected replay: got {got}, want "
+                f"{list(ref[:len(got)])} "
+                f"(reason={req.finish_reason!r})")
+        if req.finish_reason == "length" \
+                and len(got) != req.max_new_tokens:
+            out.append(
+                f"request {req.rid} finished 'length' with "
+                f"{len(got)}/{req.max_new_tokens} tokens")
+    return out
+
+
+def engine_leak_violations(engine) -> List[str]:
+    """A quiesced engine must hold nothing: no leased slots, no queued
+    requests, no undelivered terminal requests."""
+    out = []
+    active = engine.cache.active_slots()
+    if active:
+        out.append(
+            f"leaked slots {active}: "
+            f"{[engine.cache.slots[s].rid for s in active]}")
+    queued = engine.scheduler.pending()
+    if queued:
+        out.append(
+            f"leaked queue entries {[r.rid for r in queued]}")
+    if engine._undelivered:
+        out.append(
+            f"undelivered terminal requests "
+            f"{[r.rid for r in engine._undelivered]}")
+    return out
+
+
+def thread_leak_violations(before: Iterable[threading.Thread]) \
+        -> List[str]:
+    """No NEW non-daemon thread may survive an episode (async
+    checkpoint writers are daemons and must already be joined via
+    ``wait_for_pending_saves``)."""
+    known = set(before)
+    out = []
+    for t in threading.enumerate():
+        if t not in known and t.is_alive() and not t.daemon:
+            out.append(f"leaked non-daemon thread {t.name!r}")
+    return out
+
+
+def pending_save_violations() -> List[str]:
+    """Every async checkpoint save is settled (the episode must call
+    ``wait_for_pending_saves`` first; this audits that none raced
+    past it)."""
+    from ..distributed import checkpoint
+    out = []
+    for h in checkpoint._pending:
+        if not h.done():
+            out.append("async save handle still writing after the "
+                       "episode settled")
+    return out
+
+
+def loss_trajectory_violations(
+        reports: Sequence[dict],
+        baseline_losses: Sequence[Tuple[int, float]]) -> List[str]:
+    """Every (step, loss) recorded across the episode's run attempts
+    (in-process restores AND process relaunches) must match the
+    uninjected baseline, and each report must be one clean trajectory
+    (strictly increasing steps — restores re-record, they don't
+    duplicate)."""
+    base = dict(baseline_losses)
+    out = []
+    for i, rep in enumerate(reports):
+        steps = [s for s, _ in rep["losses"]]
+        if steps != sorted(set(steps)):
+            out.append(
+                f"run {i}: loss trajectory not strictly increasing "
+                f"({steps})")
+        for s, l in rep["losses"]:
+            if s not in base:
+                out.append(f"run {i}: loss recorded for unknown "
+                           f"step {s}")
+            elif l != base[s]:
+                out.append(
+                    f"run {i}: loss at step {s} diverged from the "
+                    f"uninjected baseline: {l!r} != {base[s]!r}")
+    return out
+
+
+def checkpoint_monotonic_violations(
+        ckpt_dir: str, template_factory,
+        latest_history: Sequence[Optional[int]] = (),
+        expect_final: Optional[int] = None) -> List[str]:
+    """The LATEST pointer never moves backwards and always names a
+    loadable checkpoint, whatever torn shard files interrupted saves
+    left behind.
+
+    ``template_factory`` builds a fresh state template for
+    ``load_state_dict``; ``latest_history`` is the sequence of LATEST
+    values the episode observed (None = not yet published) and must be
+    non-decreasing; ``expect_final`` pins the final pointer value.
+    """
+    import os
+
+    from ..distributed.checkpoint import load_state_dict
+    out = []
+    seen = [s for s in latest_history if s is not None]
+    if any(b < a for a, b in zip(seen, seen[1:])):
+        out.append(f"LATEST moved backwards: {seen}")
+    p = os.path.join(ckpt_dir, "LATEST")
+    if not os.path.exists(p):
+        out.append(f"no LATEST pointer under {ckpt_dir}")
+        return out
+    with open(p) as f:
+        latest = int(f.read().strip())
+    if expect_final is not None and latest != expect_final:
+        out.append(f"LATEST == {latest}, expected {expect_final}")
+    if seen and latest < seen[-1]:
+        out.append(
+            f"final LATEST {latest} older than observed {seen[-1]}")
+    try:
+        tmpl = template_factory()
+        load_state_dict(tmpl, os.path.join(ckpt_dir,
+                                           f"step_{latest}"))
+        if int(tmpl["step"]) != latest:
+            out.append(
+                f"LATEST checkpoint carries step {tmpl['step']}, "
+                f"pointer says {latest}")
+    except Exception as e:      # noqa: BLE001 — any load failure is
+        out.append(             # exactly what this invariant forbids
+            f"LATEST checkpoint step_{latest} failed to load: "
+            f"{type(e).__name__}: {e}")
+    return out

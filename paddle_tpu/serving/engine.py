@@ -100,7 +100,8 @@ class ServingEngine:
                  min_bucket: int = 16,
                  max_queue: Optional[int] = None,
                  time_fn: Callable[[], float] = time.perf_counter,
-                 registry=None, flight_recorder=None):
+                 registry=None, flight_recorder=None,
+                 auditor=None):
         self.adapter = _ModelAdapter(model)
         model.eval()
         self.max_slots = int(max_slots)
@@ -138,10 +139,19 @@ class ServingEngine:
         # buffers invalidated); recover() clears it
         self._broken: Optional[str] = None
         self._closed = False
-        # requests that completed inside a failed step, awaiting
-        # delivery through a SUCCESSFUL recover() report (survives a
-        # recover() that itself faults mid-re-prefill)
-        self._recover_finished: List[Request] = []
+        # requests that reached a terminal state inside a FAILED step
+        # (deadline sweep, decode finisher evicted before the raise) or
+        # were discovered finished-in-slot by recover(): they must
+        # still surface through the next successful step()/recover()/
+        # drain() exactly once — never lost, never duplicated. The
+        # list survives a recover() that itself faults mid-re-prefill.
+        self._undelivered: List[Request] = []
+        # optional conservation auditor (resilience.invariants duck
+        # type: on_submitted(req) / on_delivered(req, via)) — called at
+        # the EXTERNAL delivery boundaries only, so a ledger sees
+        # exactly what callers see
+        self.auditor = auditor
+        self._in_drain = False
         # python-side-effect counters bumped at TRACE time: the compile-
         # count contract (1 decode + O(log max_len) prefill buckets) is
         # asserted against these in tests
@@ -234,6 +244,8 @@ class ServingEngine:
         self.scheduler.add(req)
         self.metrics.on_submit(req.rid)
         self._m_queue_depth.set(self.scheduler.depth)
+        if self.auditor is not None:
+            self.auditor.on_submitted(req)
         return req
 
     def has_work(self) -> bool:
@@ -264,11 +276,19 @@ class ServingEngine:
         self._step_idx += 1
         tc0 = (self.trace_counts["decode"],
                sum(self.trace_counts["prefill"].values()))
+        # the finished list is allocated HERE, outside the try: a
+        # request that reaches a terminal state early in the step
+        # (deadline sweep, decode finisher) is already evicted from its
+        # slot/queue, so if the step then faults it exists nowhere else
+        # — it must survive the raise or it is lost forever
+        finished: List[Request] = []
         try:
             with span("serving.step", step=step_idx) as sp:
-                finished, admitted, n_active = self._step_inner()
+                admitted, n_active = self._step_inner(finished)
                 sp.set_attr("active_slots", n_active)
         except Exception as e:
+            if finished:
+                self._undelivered.extend(finished)
             if self._donate():
                 # the jit call may have CONSUMED the donated pools
                 # before failing: ks/vs can reference deleted device
@@ -295,6 +315,15 @@ class ServingEngine:
         self._m_step.observe(dt)
         self._m_queue_depth.set(depth)
         self._m_active.set(n_active)
+        if self._undelivered:
+            # requests stranded by an earlier FAILED step ride the
+            # first successful step out (they finished first: prepend)
+            finished = self._undelivered + finished
+        # the whole batch stays OWED until the return below actually
+        # happens: if the recorder or a caller-supplied auditor raises
+        # first, the next step()/recover()/drain() still delivers
+        # (at worst re-auditing a prefix — detectable — never losing)
+        self._undelivered = finished
         self.recorder.record(
             "serving.step", step=step_idx, step_latency_s=dt,
             active_slots=n_active, queue_depth=depth,
@@ -303,11 +332,17 @@ class ServingEngine:
             compiles_decode=self.trace_counts["decode"] - tc0[0],
             compiles_prefill=(
                 sum(self.trace_counts["prefill"].values()) - tc0[1]))
+        if self.auditor is not None and not self._in_drain:
+            # drain() audits its aggregate return instead, so each
+            # request is audited at exactly ONE external boundary
+            for r in finished:
+                self.auditor.on_delivered(r, via="step")
+        self._undelivered = []
         return finished
 
-    def _step_inner(self):
-        finished: List[Request] = []
+    def _step_inner(self, finished: List[Request]):
         admitted: List[int] = []
+
         # 0) deadline sweep — cancel expired requests BEFORE spending
         # a prefill or decode slot-step on them
         self._expire_deadlines(finished)
@@ -361,7 +396,7 @@ class ServingEngine:
                 if self._is_finished(req, tok):
                     self._evict(s, req, finished)
         self.metrics.on_step(len(active))
-        return finished, admitted, len(active)
+        return admitted, len(active)
 
     def _evict(self, slot: int, req: Request,
                finished: List[Request]) -> None:
@@ -410,6 +445,8 @@ class ServingEngine:
         req.finished, req.finish_reason = True, reason
         req.error = RequestCancelled(req.rid, reason)
         self.metrics.on_finished(req.rid)
+        if self.auditor is not None:
+            self.auditor.on_delivered(req, via="cancel")
         return True
 
     def recover(self) -> dict:
@@ -442,8 +479,10 @@ class ServingEngine:
         self._params, self._buffers = ad.model.raw_state()
         # accumulate on the ENGINE, not a local: if a re-prefill below
         # faults, these requests are gone from the slot table, and the
-        # retrying recover() must still deliver them in its report
-        finished = self._recover_finished
+        # retrying recover() must still deliver them in its report.
+        # _undelivered also carries requests a FAILED step finished but
+        # never returned (same conservation debt, same payoff point).
+        finished = self._undelivered
         todo = []
         for s, req in in_flight:
             if req.finished:
@@ -484,7 +523,6 @@ class ServingEngine:
                 self._m_replay_mismatch.inc()
         self._broken = None
         self._m_recover.inc()
-        self._recover_finished = []
         dt = self.metrics.now() - t0
         report = {"reason": reason,
                   "recovered_slots": len(todo),
@@ -495,6 +533,13 @@ class ServingEngine:
             "serving.recover", reason=reason, latency_s=dt,
             recovered_slots=len(todo), replay_mismatches=mismatches,
             evicted=[(r.rid, r.finish_reason) for r in finished])
+        if self.auditor is not None:
+            for r in report["finished"]:
+                self.auditor.on_delivered(r, via="recover")
+        # consumed only once the report is actually on its way to the
+        # caller: a recorder/auditor raise above leaves the debt in
+        # place for the next step()/recover() instead of losing it
+        self._undelivered = []
         return report
 
     def run(self, max_steps: Optional[int] = None) -> List[Request]:
@@ -513,33 +558,77 @@ class ServingEngine:
         :class:`EngineClosed` from now on) and serve the queue plus
         every in-flight slot to completion. If ``max_steps`` runs out
         first — or the engine is (or becomes) broken and the caller
-        chose shutdown over ``recover()`` — whatever remains is
-        cancelled (``finish_reason == "cancelled"``) instead of being
-        stranded un-finished. Returns every request finished or
-        cancelled during the drain."""
+        chose shutdown over ``recover()``, or steps keep failing —
+        whatever remains is cancelled (``finish_reason ==
+        "cancelled"``) instead of being stranded un-finished. Returns
+        every request finished or cancelled during the drain.
+
+        drain() never raises out of the step loop: a mid-drain step
+        exception must not discard the already-finished ``done`` list.
+        A transient step failure (engine not broken: the faulted
+        request was re-queued) is retried; after ``_DRAIN_MAX_FAILURES``
+        consecutive failures the remainder is cancelled with the last
+        error attached, and ``done`` is returned intact."""
         self._closed = True
         done: List[Request] = []
         steps = 0
-        while self.has_work():
-            cutoff = "drain cutoff" if (
-                max_steps is not None and steps >= max_steps) else (
-                f"drain on broken engine ({self._broken})"
-                if self._broken else None)
-            if cutoff is not None:
-                for req in self.scheduler.drain():
-                    req.finished, req.finish_reason = True, "cancelled"
-                    req.error = RequestCancelled(req.rid, cutoff)
-                    self.metrics.on_finished(req.rid)
-                    done.append(req)
-                for s in self.cache.active_slots():
-                    req = self.cache.slots[s]
-                    req.finished, req.finish_reason = True, "cancelled"
-                    req.error = RequestCancelled(req.rid, cutoff)
-                    self._evict(s, req, done)
-                break
-            done.extend(self.step())
-            steps += 1
+        failures = 0
+        last_err: Optional[BaseException] = None
+        self._in_drain = True
+        try:
+            while self.has_work():
+                if max_steps is not None and steps >= max_steps:
+                    cutoff = "drain cutoff"
+                elif self._broken:
+                    cutoff = f"drain on broken engine ({self._broken})"
+                elif failures >= self._DRAIN_MAX_FAILURES:
+                    cutoff = (f"drain aborted after {failures} "
+                              f"consecutive step failures "
+                              f"({type(last_err).__name__}: {last_err})")
+                else:
+                    cutoff = None
+                if cutoff is not None:
+                    for req in self.scheduler.drain():
+                        req.finished, req.finish_reason = \
+                            True, "cancelled"
+                        req.error = RequestCancelled(req.rid, cutoff)
+                        self.metrics.on_finished(req.rid)
+                        done.append(req)
+                    for s in self.cache.active_slots():
+                        req = self.cache.slots[s]
+                        req.finished, req.finish_reason = \
+                            True, "cancelled"
+                        req.error = RequestCancelled(req.rid, cutoff)
+                        self._evict(s, req, done)
+                    break
+                try:
+                    done.extend(self.step())
+                    steps += 1
+                    failures = 0
+                except Exception as e:
+                    # the failed step's own finishers sit in
+                    # _undelivered (see step()); the next loop pass
+                    # either retries, or the cutoff collects them below
+                    failures += 1
+                    last_err = e
+        finally:
+            self._in_drain = False
+        if self._undelivered:
+            # terminal requests stranded by a failed step with no
+            # successful step left to carry them out
+            done.extend(self._undelivered)
+        # owe the whole return until it happens: if the auditor raises
+        # here, a re-issued drain() flushes the debt to the caller
+        self._undelivered = done
+        if self.auditor is not None:
+            for r in done:
+                self.auditor.on_delivered(r, via="drain")
+        self._undelivered = []
         return done
+
+    # consecutive failed steps a drain() absorbs before giving up on
+    # serving the backlog and cancelling the remainder
+    _DRAIN_MAX_FAILURES = 3
 
     # -- internals -----------------------------------------------------
     def _is_finished(self, req: Request, tok: int) -> bool:

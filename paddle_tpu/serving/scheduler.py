@@ -121,6 +121,13 @@ class FIFOScheduler:
         lose its FCFS position — or the request itself)."""
         self._queue.appendleft(req)
 
+    def pending(self) -> List[Request]:
+        """Snapshot of the waiting queue in FCFS order — the
+        accounting surface conservation audits read
+        (resilience/invariants.py): after a drain every queue must be
+        empty and every popped request accounted for elsewhere."""
+        return list(self._queue)
+
     def remove(self, req: Request) -> bool:
         """Drop one queued request (cancellation); False if absent."""
         try:

@@ -26,6 +26,7 @@ SERVING_SUMMARY_KEYS = {
     "bench_resnet50.py", "bench_bert_dp.py", "bench_gpt_hybrid.py",
     "bench_ernie_zero3.py", "bench_ppyoloe_infer.py",
     "bench_llama_decode.py", "bench_serving_engine.py",
+    "chaos_soak.py",
 ])
 def test_benchmark_script_smoke(script, tmp_path):
     env = dict(os.environ, JAX_PLATFORMS="cpu",
@@ -36,6 +37,8 @@ def test_benchmark_script_smoke(script, tmp_path):
     prom_path = tmp_path / "snapshot.prom"
     if script == "bench_serving_engine.py":
         env["PTPU_PROM_OUT"] = str(prom_path)
+    if script == "chaos_soak.py":
+        env["PTPU_CHAOS_EPISODES"] = "6"    # smoke budget
     r = subprocess.run(
         [sys.executable, os.path.join(HERE, "benchmarks", script)],
         capture_output=True, text=True, timeout=900, env=env)
@@ -64,6 +67,17 @@ def test_benchmark_script_smoke(script, tmp_path):
         prom = prom_path.read_text()
         assert "# TYPE ptpu_serving_ttft_seconds histogram" in prom
         assert "ptpu_serving_requests_total" in prom
+    if script == "chaos_soak.py":
+        # the soak summary line is the artifact the CI budgeted run
+        # keys on: every episode green, schema stable
+        slines = [l for l in r.stdout.splitlines()
+                  if l.startswith("CHAOS_SOAK ")]
+        assert slines, r.stdout
+        soak = json.loads(slines[-1][len("CHAOS_SOAK "):])
+        assert {"episodes", "green", "red_seeds", "faults_fired",
+                "recoveries", "relaunches"} <= set(soak)
+        assert soak["episodes"] == 6 and soak["green"] == 6
+        assert soak["red_seeds"] == []
 
 
 def test_trainstep_amp_o2_master_weights_finite():

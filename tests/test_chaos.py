@@ -1,0 +1,268 @@
+"""Chaos-soak harness (resilience/chaos.py + invariants.py): the
+deterministic seed matrix asserted in tier-1, the conservation-ledger
+and invariant-checker units, and the pinned seeds that demonstrably
+catch the PR-3 deferred failure-path bug classes — each pinned test
+re-introduces the pre-fix code path via monkeypatch and asserts the
+harness goes red on that exact seed, then green on the fixed code.
+Everything runs on CPU with virtual clocks and seeded RNG: a red
+episode is reproducible from its seed alone."""
+import threading
+import types
+
+import numpy as np
+import pytest
+
+from paddle_tpu.resilience import chaos, faults, invariants
+from paddle_tpu.resilience.invariants import (ConservationLedger,
+                                              InvariantViolation)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    faults.reset_counts()
+    yield
+    faults.clear()
+
+
+# -- the sweep covers the whole fault-point catalogue ------------------
+
+def test_sweep_covers_registered_fault_points():
+    """Adding a fault point to faults.KNOWN_POINTS without enrolling
+    it in an episode kind silently shrinks the soak — fail loudly."""
+    swept = set(chaos.SERVING_SWEEP) | set(chaos.TRAINING_SWEEP)
+    assert swept == set(faults.KNOWN_POINTS)
+    assert not set(chaos.SERVING_SWEEP) & set(chaos.TRAINING_SWEEP)
+
+
+# -- conservation ledger units (no engine, injected state) -------------
+
+def _req(rid, finished=True, reason="length", toks=(), max_new=4):
+    return types.SimpleNamespace(
+        rid=rid, finished=finished, finish_reason=reason,
+        out_tokens=list(toks), max_new_tokens=max_new)
+
+
+def test_ledger_exactly_once_accounting():
+    led = ConservationLedger()
+    a, b, c = _req(0), _req(1), _req(2)
+    for r in (a, b, c):
+        led.on_submitted(r)
+    led.on_delivered(a, via="step")
+    led.on_delivered(b, via="recover")
+    led.on_delivered(c, via="drain")
+    assert led.violations() == []
+    led.check()                                  # no raise
+
+
+def test_ledger_catches_lost_duplicate_phantom_nonterminal():
+    led = ConservationLedger()
+    lost = _req(0)                               # never delivered
+    dup = _req(1)
+    nonterm = _req(2, finished=False, reason=None)
+    noreason = _req(3, finished=True, reason=None)
+    for r in (lost, dup, nonterm, noreason):
+        led.on_submitted(r)
+    led.on_delivered(dup, via="step")
+    led.on_delivered(dup, via="recover")         # double delivery
+    led.on_delivered(nonterm, via="step")        # not terminal
+    led.on_delivered(noreason, via="step")       # no finish_reason
+    phantom = _req(9)
+    led.on_delivered(phantom, via="step")        # never submitted
+    v = "\n".join(led.violations())
+    assert "request 0 LOST" in v
+    assert "request 1 DELIVERED 2 times" in v
+    assert "not in a terminal state" in v
+    assert "without a finish_reason" in v
+    assert "phantom" in v
+    with pytest.raises(InvariantViolation, match="LOST"):
+        led.check()
+
+
+def test_token_prefix_invariant():
+    ref = [5, 6, 7, 8]
+    ok_full = _req(0, reason="length", toks=[5, 6, 7, 8], max_new=4)
+    ok_part = _req(1, reason="deadline", toks=[5, 6], max_new=4)
+    bad_tok = _req(2, reason="length", toks=[5, 9], max_new=2)
+    too_long = _req(3, reason="length", toks=[5, 6, 7, 8, 1],
+                    max_new=5)
+    short_len = _req(4, reason="length", toks=[5], max_new=3)
+    v = invariants.token_prefix_violations(
+        [(ok_full, ref), (ok_part, ref), (bad_tok, ref),
+         (too_long, ref), (short_len, ref)])
+    joined = "\n".join(v)
+    assert "request 0" not in joined and "request 1" not in joined
+    assert "request 2 tokens diverged" in joined
+    assert "request 3" in joined            # longer than the replay
+    assert "request 4 finished 'length' with 1/3" in joined
+
+
+def test_loss_trajectory_invariant():
+    base = [(0, 1.0), (1, 0.5), (2, 0.25)]
+    ok = {"losses": [(0, 1.0), (1, 0.5), (2, 0.25)]}
+    resumed = {"losses": [(2, 0.25)]}       # relaunch tail: still ok
+    assert invariants.loss_trajectory_violations([ok, resumed],
+                                                 base) == []
+    diverged = {"losses": [(0, 1.0), (1, 0.75)]}
+    dup_step = {"losses": [(0, 1.0), (0, 1.0)]}
+    v = "\n".join(invariants.loss_trajectory_violations(
+        [diverged, dup_step], base))
+    assert "diverged from the uninjected baseline" in v
+    assert "not strictly increasing" in v
+
+
+def test_thread_leak_invariant():
+    before = list(threading.enumerate())
+    assert invariants.thread_leak_violations(before) == []
+    stop = threading.Event()
+    t = threading.Thread(target=stop.wait, name="chaos-leak",
+                         daemon=False)
+    t.start()
+    try:
+        v = invariants.thread_leak_violations(before)
+        assert v and "chaos-leak" in v[0]
+    finally:
+        stop.set()
+        t.join()
+
+
+# -- the deterministic seed matrix (acceptance criterion) --------------
+# >= 25 seeded episodes spanning serving and training, every invariant
+# asserted per episode. A red seed reproduces standalone:
+#   python -c "from paddle_tpu.resilience import chaos; \
+#              print(chaos.run_serving_episode(SEED).violations)"
+
+SERVING_SEEDS = list(range(0, 13))
+TRAINING_SEEDS = list(range(100, 112))
+
+
+@pytest.mark.parametrize("seed", SERVING_SEEDS)
+def test_serving_episode_matrix(seed):
+    res = chaos.run_serving_episode(seed)
+    assert res.ok, "\n".join(res.violations)
+    assert res.stats["requests"] >= 1
+
+
+@pytest.mark.parametrize("seed", TRAINING_SEEDS)
+def test_training_episode_matrix(seed, tmp_path):
+    res = chaos.run_training_episode(seed, str(tmp_path))
+    assert res.ok, "\n".join(res.violations)
+
+
+def test_matrix_spans_both_kinds_and_enough_episodes():
+    assert len(SERVING_SEEDS) + len(TRAINING_SEEDS) >= 25
+
+
+def test_episodes_are_deterministic():
+    """Same seed, same schedule, same faults fired, same verdict —
+    the property that makes a red episode a one-line reproducer."""
+    a = chaos.run_serving_episode(3)
+    b = chaos.run_serving_episode(3)
+    assert [(x.point, x.times, x.after) for x in a.schedule] \
+        == [(x.point, x.times, x.after) for x in b.schedule]
+    assert a.fired == b.fired
+    assert a.violations == b.violations
+    assert a.stats == b.stats
+
+
+# -- open-ended soak (slow tier: excluded from smoke via `full`) -------
+
+@pytest.mark.full
+def test_open_ended_soak(tmp_path):
+    """A wider randomized seed band than the tier-1 matrix — the
+    `full`-tier soak; benchmarks/chaos_soak.py runs the same episodes
+    under a wall/episode budget for longer hunts."""
+    red = []
+    for seed in range(200, 240):
+        kind = "serving" if seed % 2 == 0 else "training"
+        res = chaos.run_episode(seed, kind, workdir=str(tmp_path))
+        if not res.ok:
+            red.append((seed, kind, res.violations))
+    assert not red, red
+
+
+# -- pinned seeds: the harness catches the PR-3 deferred bug classes ---
+# Each test re-introduces the PRE-FIX code path and asserts the pinned
+# seed's fault schedule drives the ledger red (the bug class is
+# DETECTED), while the fixed code stays green on the same seed.
+
+PINNED_SEED_BUG_A = 6       # deadline expiry in the step a decode
+PINNED_SEED_BUG_B = 7       # fault lands in / fault mid-drain
+
+
+def test_pinned_seed_catches_lost_finished_on_failed_step(monkeypatch):
+    """Deferred bug (a): pre-fix, a request that reached a terminal
+    state inside a step that then faulted (deadline-cancel sweep +
+    decode fault in the same step) lived only in step()'s local
+    `finished` list and vanished with the raise."""
+    from paddle_tpu.serving import ServingEngine
+    orig_step = ServingEngine.step
+
+    def prefix_step(self):
+        n = len(self._undelivered)
+        try:
+            return orig_step(self)
+        except Exception:
+            del self._undelivered[n:]   # pre-fix: the list was a local
+            raise
+
+    monkeypatch.setattr(ServingEngine, "step", prefix_step)
+    red = chaos.run_serving_episode(PINNED_SEED_BUG_A)
+    assert not red.ok
+    assert any("LOST" in v for v in red.violations), red.violations
+    monkeypatch.setattr(ServingEngine, "step", orig_step)
+    green = chaos.run_serving_episode(PINNED_SEED_BUG_A)
+    assert green.ok, "\n".join(green.violations)
+
+
+def test_pinned_seed_catches_drain_discarding_done(monkeypatch):
+    """Deferred bug (b): pre-fix, drain()'s step loop let a mid-drain
+    exception propagate, discarding the already-finished `done` list
+    — the caller lost every result the drain had collected."""
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving.errors import RequestCancelled
+    orig_drain = ServingEngine.drain
+
+    def prefix_drain(self, max_steps=None):
+        self._closed = True
+        done = []
+        steps = 0
+        self._in_drain = True
+        try:
+            while self.has_work():
+                cutoff = "drain cutoff" if (
+                    max_steps is not None and steps >= max_steps) \
+                    else (f"drain on broken engine ({self._broken})"
+                          if self._broken else None)
+                if cutoff is not None:
+                    for req in self.scheduler.drain():
+                        req.finished, req.finish_reason = \
+                            True, "cancelled"
+                        req.error = RequestCancelled(req.rid, cutoff)
+                        self.metrics.on_finished(req.rid)
+                        done.append(req)
+                    for s in self.cache.active_slots():
+                        req = self.cache.slots[s]
+                        req.finished, req.finish_reason = \
+                            True, "cancelled"
+                        req.error = RequestCancelled(req.rid, cutoff)
+                        self._evict(s, req, done)
+                    break
+                done.extend(self.step())   # pre-fix: a raise here
+                steps += 1                 # discards `done`
+        finally:
+            self._in_drain = False
+        if self.auditor is not None:
+            for r in done:
+                self.auditor.on_delivered(r, via="drain")
+        return done
+
+    monkeypatch.setattr(ServingEngine, "drain", prefix_drain)
+    red = chaos.run_serving_episode(PINNED_SEED_BUG_B)
+    assert not red.ok
+    assert any("LOST" in v for v in red.violations), red.violations
+    monkeypatch.setattr(ServingEngine, "drain", orig_drain)
+    green = chaos.run_serving_episode(PINNED_SEED_BUG_B)
+    assert green.ok, "\n".join(green.violations)

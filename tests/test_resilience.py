@@ -513,6 +513,33 @@ def test_async_save_error_surfaces_at_wait_and_load(tmp_path):
     wait_for_pending_saves()               # both delivered -> clean
 
 
+def test_wait_for_pending_saves_timeout_is_total_deadline():
+    """Deferred PR-3 bug (c): ``timeout`` is ONE total deadline shared
+    across every pending handle — N stuck saves block ~timeout
+    seconds overall, not N x timeout."""
+    import time as _time
+
+    from paddle_tpu.distributed import checkpoint
+    from paddle_tpu.distributed.checkpoint import (
+        AsyncSaveHandle, wait_for_pending_saves)
+    handles = [AsyncSaveHandle() for _ in range(4)]
+    checkpoint._pending.extend(handles)
+    try:
+        t0 = _time.monotonic()
+        with pytest.raises(TimeoutError):
+            wait_for_pending_saves(timeout=0.2)
+        elapsed = _time.monotonic() - t0
+        assert elapsed < 0.6, \
+            f"timeout applied per handle: {elapsed:.2f}s for 4 handles"
+        # still-writing handles STAY pending for later drains
+        assert all(h in checkpoint._pending for h in handles)
+    finally:
+        for h in handles:
+            h._finish()
+        wait_for_pending_saves()
+    assert not any(h in checkpoint._pending for h in handles)
+
+
 # -- watchdog satellites -----------------------------------------------
 
 class _HbStore(_DictStore):

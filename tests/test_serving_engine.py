@@ -20,6 +20,15 @@ def _tiny_llama(**kw):
     return model
 
 
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    from paddle_tpu.resilience import faults
+    faults.clear()
+    faults.reset_counts()
+    yield
+    faults.clear()
+
+
 def _prompts(rng, lens, vocab=128):
     return [rng.randint(0, vocab, (n,)).astype(np.int64) for n in lens]
 
@@ -278,6 +287,142 @@ def test_broken_recover_token_identical_replay():
     for r_ref, r in zip(refs, reqs):
         assert r_ref.output_ids == r.output_ids, (r_ref.rid, r.rid)
     assert eng.cache.free_slots() == [0, 1]
+
+
+def test_finished_in_failed_step_delivered_once_via_recover():
+    """Deferred PR-3 bug (a): a deadline-cancel sweep and a decode
+    fault land in the SAME step (donated pools). The expired request
+    reached its terminal state inside the failed step — it must
+    surface exactly once, through the recover() report, never lost
+    and never duplicated."""
+    from paddle_tpu.resilience import faults
+    model = _tiny_llama()
+    clock = {"t": 0.0}
+    eng = ServingEngine(model, max_slots=1, max_len=64, min_bucket=8,
+                        time_fn=lambda: clock["t"])
+    eng._donate = lambda: (5, 6)          # simulate the TPU path
+    a = eng.submit(np.arange(1, 6), max_new_tokens=6)
+    b = eng.submit(np.arange(1, 6), max_new_tokens=6, deadline_s=1.0)
+    eng.step()                            # a takes the slot; b queued
+    faults.inject("serving.step.decode", times=1)
+    clock["t"] = 5.0                      # b expires at the sweep...
+    with pytest.raises(faults.InjectedFault):
+        eng.step()                        # ...then the decode dies
+    assert b.finished and b.finish_reason == "deadline"
+    report = eng.recover()
+    assert [r.rid for r in report["finished"]] == [b.rid]
+    done = eng.run()
+    assert b not in done                  # exactly once
+    assert a in done and a.finish_reason == "length"
+
+
+def test_finished_in_failed_step_delivered_once_via_next_step():
+    """Bug (a), undonated (CPU) flavor: the engine is not broken after
+    the failed step, so the stranded terminal request rides the next
+    SUCCESSFUL step() return instead."""
+    from paddle_tpu.resilience import faults
+    model = _tiny_llama()
+    clock = {"t": 0.0}
+    eng = ServingEngine(model, max_slots=1, max_len=64, min_bucket=8,
+                        time_fn=lambda: clock["t"])
+    a = eng.submit(np.arange(1, 6), max_new_tokens=6)
+    b = eng.submit(np.arange(1, 6), max_new_tokens=6, deadline_s=1.0)
+    eng.step()
+    faults.inject("serving.step.decode", times=1)
+    clock["t"] = 5.0
+    with pytest.raises(faults.InjectedFault):
+        eng.step()
+    finished = eng.step()                 # first successful step
+    assert b in finished
+    rest = eng.run()
+    assert b not in rest and a in rest
+
+
+def test_drain_preserves_done_across_mid_drain_failure():
+    """Deferred PR-3 bug (b): a transient step failure inside drain()
+    must not discard the already-finished `done` list — the drain
+    retries and returns every result."""
+    from paddle_tpu.resilience import faults
+    model = _tiny_llama()
+    eng = ServingEngine(model, max_slots=1, max_len=64, min_bucket=8)
+    r1 = eng.submit(np.arange(1, 6), max_new_tokens=2)
+    r2 = eng.submit(np.arange(1, 6), max_new_tokens=4)
+    # r1 finishes on the 1st decode; the fault fires on the 3rd, well
+    # after r1 already sits in drain()'s done list
+    faults.inject("serving.step.decode", times=1, after=2)
+    done = eng.drain()
+    assert faults.fired("serving.step.decode") == 1
+    assert {r.rid for r in done} == {r1.rid, r2.rid}
+    assert r1.finish_reason == "length"
+    assert r2.finish_reason == "length"   # transient fault retried
+
+
+def test_drain_broken_mid_drain_returns_done_and_cancels_rest():
+    """Bug (b), donated flavor: the engine BREAKS mid-drain; drain()
+    keeps the finished results and cancels the remainder instead of
+    raising them away."""
+    from paddle_tpu.resilience import faults
+    model = _tiny_llama()
+    eng = ServingEngine(model, max_slots=1, max_len=64, min_bucket=8)
+    eng._donate = lambda: (5, 6)
+    r1 = eng.submit(np.arange(1, 6), max_new_tokens=2)
+    r2 = eng.submit(np.arange(1, 6), max_new_tokens=6)
+    faults.inject("serving.step.decode", times=1, after=2)
+    done = eng.drain()
+    assert {r.rid for r in done} == {r1.rid, r2.rid}
+    assert r1.finish_reason == "length"
+    assert r2.finish_reason == "cancelled"
+    assert "broken" in str(r2.error)
+
+
+def test_drain_gives_up_after_repeated_transient_failures():
+    """A drain that cannot make progress (every step fails, engine not
+    broken) cancels the backlog after a bounded number of consecutive
+    failures instead of looping or raising."""
+    from paddle_tpu.resilience import faults
+    model = _tiny_llama()
+    eng = ServingEngine(model, max_slots=1, max_len=64, min_bucket=8)
+    r1 = eng.submit(np.arange(1, 6), max_new_tokens=2)
+    faults.inject("serving.step.prefill", times=10)
+    done = eng.drain()
+    assert done == [r1]
+    assert r1.finish_reason == "cancelled"
+    assert "consecutive step failures" in str(r1.error)
+    assert not eng.has_work()
+
+
+def test_raising_auditor_never_loses_requests():
+    """Review rider: delivery is consumed only when the return
+    actually happens — a caller-supplied auditor that raises leaves
+    the debt owed, and the next call (here: drain) flushes it instead
+    of losing the finished request."""
+
+    class BoomAuditor:
+        def __init__(self):
+            self.fail = 1
+            self.seen = []
+
+        def on_submitted(self, req):
+            pass
+
+        def on_delivered(self, req, via):
+            if self.fail:
+                self.fail -= 1
+                raise RuntimeError("audit boom")
+            self.seen.append((req.rid, via))
+
+    model = _tiny_llama()
+    aud = BoomAuditor()
+    eng = ServingEngine(model, max_slots=1, max_len=64, min_bucket=8,
+                        auditor=aud)
+    r = eng.submit(np.arange(1, 6), max_new_tokens=1)
+    with pytest.raises(RuntimeError, match="audit boom"):
+        eng.step()
+    assert r.finished and eng._undelivered == [r]   # owed, not lost
+    done = eng.drain()
+    assert done == [r] and r.finish_reason == "length"
+    assert aud.seen == [(r.rid, "drain")]
+    assert eng._undelivered == []
 
 
 def test_submit_validation():
