@@ -14,9 +14,12 @@ import os
 import subprocess
 import sys
 
+# entries may carry script args (split on whitespace)
 SCRIPTS = ["bench_resnet50.py", "bench_bert_dp.py", "bench_gpt_hybrid.py",
            "bench_ernie_zero3.py", "bench_ppyoloe_infer.py",
            "bench_llama_decode.py", "bench_serving_engine.py",
+           # paged-KV concurrency under a shared byte budget
+           "bench_serving_engine.py --prefix-share",
            # budget via PTPU_CHAOS_EPISODES / PTPU_CHAOS_SECONDS
            "chaos_soak.py"]
 
@@ -51,12 +54,15 @@ def main():
                      if "host_platform_device_count" not in f]
             flags.append("--xla_force_host_platform_device_count=8")
             env["XLA_FLAGS"] = " ".join(flags)
+        argv = s.split()
         if opts.prom_out:
             env["PTPU_PROM_OUT"] = os.path.join(
-                opts.prom_out, s.replace(".py", "") + ".prom")
-        r = subprocess.run([sys.executable, os.path.join(here, s)],
-                           capture_output=True, text=True, timeout=1800,
-                           env=env)
+                opts.prom_out,
+                s.replace(".py", "").replace(" --", "_").replace("-", "_")
+                + ".prom")
+        r = subprocess.run(
+            [sys.executable, os.path.join(here, argv[0])] + argv[1:],
+            capture_output=True, text=True, timeout=1800, env=env)
         for line in r.stdout.splitlines():
             if line.startswith("{"):
                 print(line)

@@ -375,8 +375,9 @@ def create_predictor(config: Config) -> Predictor:
     return Predictor(config)
 
 
-# Continuous-batching LLM serving (slot-pool scheduler over the static
-# KV-cache decode path) — full docs in paddle_tpu/serving.
+# Continuous-batching LLM serving (paged-KV scheduler with COW prefix
+# sharing over the compile-once decode path) — full docs in
+# paddle_tpu/serving.
 from ..serving import SamplingParams, ServingEngine  # noqa: E402,F401
 
 

@@ -13,6 +13,21 @@ slot-pool decode, ``paddle_tpu/serving``). Both lower to the same
 einsum contraction so per-row results are bitwise identical to the
 scalar path's, which is what makes the serving engine's greedy outputs
 token-identical to ``generate()``'s.
+
+``paged_cache_attend`` is the PAGE-TABLE flavor of the same attention:
+instead of one contiguous ``[B, Tmax, KV, D]`` row per sequence, k/v
+live in a shared pool of fixed-size pages ``[num_pages, page, KV, D]``
+and each row carries a static ``[B, pages_per_seq]`` int32 page table.
+Writes scatter the new tokens through the table (flat position ``f``
+lands in page ``table[b, f // page]`` at offset ``f % page``); reads
+gather the row's pages back into a ``[B, pages_per_seq * page, KV, D]``
+view and run the IDENTICAL masked einsum as ``cache_attend`` — when
+``pages_per_seq * page == Tmax`` the contraction shapes match the
+contiguous path exactly, which is what keeps paged greedy decode
+token-identical to the slot-pool path. Optional int8 storage keeps the
+pools in int8 with per-page f32 scales (one scale per page slot ×
+position × kv-head, absmax over head_dim) and dequantizes inside the
+attend.
 """
 from __future__ import annotations
 
@@ -20,7 +35,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["cache_attend", "check_cache_pos"]
+__all__ = ["cache_attend", "check_cache_pos", "paged_cache_attend",
+           "quantize_kv_page"]
 
 
 def check_cache_pos(pos, t: int, Tmax: int) -> bool:
@@ -86,3 +102,88 @@ def cache_attend(qr, kr, v, kc, vc, p, per_row: bool):
     probs = jax.nn.softmax(scores, axis=-1).astype(vc.dtype)
     out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, vc)
     return out.reshape(b, t, h * D), kc, vc
+
+
+def quantize_kv_page(x):
+    """Symmetric int8 quantization of a k/v block ``[..., KV, D]``:
+    per-(position, kv-head) absmax over head_dim. Returns (int8 values,
+    f32 scales ``[..., KV]``). The scale floor keeps all-zero rows
+    (never-written page tails) from dividing by zero."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1)
+    scale = jnp.maximum(amax / 127.0, 1e-8)
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def _dequant(pool_rows, scale_rows):
+    return pool_rows.astype(jnp.float32) * scale_rows[..., None]
+
+
+def paged_cache_attend(qr, kr, v, kp, vp, ks, vs, table, p,
+                       out_dtype):
+    """Masked paged-pool cache attention (see module docstring).
+
+    qr: [B, t, H, D] position-encoded queries; kr/v: [B, t, KV, D] new
+    keys/values; kp/vp: [num_pages, page, KV, D] pools (int8 when
+    ks/vs scales are given, else the model dtype); ks/vs: per-page f32
+    scales [num_pages, page, KV] or None; table: [B, pages_per_seq]
+    int32 page table (rows of inactive lanes must point at the
+    reserved trash page 0); p: int32 write position, scalar or [B].
+
+    Returns (out [B, t, H*D], kp', vp', ks', vs').
+    """
+    b, t, h, D = qr.shape
+    kv = kr.shape[2]
+    rep = h // kv
+    page = kp.shape[1]
+    Tmax = table.shape[1] * page
+    pv = jnp.asarray(p, jnp.int32)
+    if pv.ndim == 0:
+        pv = jnp.broadcast_to(pv, (b,))
+    qpos = pv[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+    # bucket-padded writes (the shared-prefix extend prefill pads its
+    # token block) can run past the table: redirect them into the
+    # reserved trash page 0 — the gather clamp would otherwise smear
+    # them over a REAL page at a wrong offset
+    w_ok = qpos < Tmax
+    pidx = jnp.minimum(qpos // page, table.shape[1] - 1)
+    pid = jnp.where(w_ok,
+                    jnp.take_along_axis(table, pidx, axis=1),
+                    0)                                       # [B, t]
+    off = jnp.where(w_ok, qpos % page, 0)
+    quant = ks is not None
+    if quant:
+        kq, ksc = quantize_kv_page(kr)
+        vq, vsc = quantize_kv_page(v)
+        kp = kp.at[pid, off].set(kq)
+        vp = vp.at[pid, off].set(vq)
+        ks = ks.at[pid, off].set(ksc)
+        vs = vs.at[pid, off].set(vsc)
+    else:
+        kp = kp.at[pid, off].set(kr.astype(kp.dtype))
+        vp = vp.at[pid, off].set(v.astype(vp.dtype))
+    # gather the row's pages into the contiguous attend view; with
+    # pages_per_seq * page == Tmax this is value-identical to the
+    # contiguous buffer, so the einsum below matches cache_attend's
+    gather = lambda pool: pool[table].reshape(
+        b, Tmax, *pool.shape[2:])
+    kc = _dequant(gather(kp), gather(ks)) if quant else gather(kp)
+    mask = jnp.arange(Tmax)[None, None, :] <= qpos[:, :, None]
+    maskx = mask[:, None, None]                    # [B,1,1,t,Tmax]
+    qg = qr.reshape(b, t, kv, rep, D)
+    scores = jnp.einsum("bqgrd,bkgd->bgrqk",
+                        qg.astype(jnp.float32),
+                        kc.astype(jnp.float32)) / (D ** 0.5)
+    scores = jnp.where(maskx, scores, -1e30)
+    if quant:
+        vc = _dequant(gather(vp), gather(vs)).astype(out_dtype)
+        probs = jax.nn.softmax(scores, axis=-1).astype(out_dtype)
+    else:
+        # bf16 non-shared token-identity contract: same probs dtype
+        # and same value einsum as the contiguous cache_attend
+        vc = gather(vp)
+        probs = jax.nn.softmax(scores, axis=-1).astype(vc.dtype)
+    out = jnp.einsum("bgrqk,bkgd->bqgrd", probs, vc)
+    return (out.reshape(b, t, h * D).astype(out_dtype),
+            kp, vp, ks, vs)

@@ -41,7 +41,8 @@ from ..nn.layer.common import Dropout, Embedding, Linear
 from ..nn.layer.norm import LayerNorm
 from ..nn.layer.container import LayerList
 from ..framework.tensor import Tensor, apply_op
-from ._decode_cache import cache_attend, check_cache_pos
+from ._decode_cache import (cache_attend, check_cache_pos,
+                            paged_cache_attend)
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "GPTSpmdTrainer",
            "build_mesh"]
@@ -109,7 +110,33 @@ class GPTBlock(Layer):
         qkv = qkv.reshape([b, t, 3, n_local, self.cfg.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         new_cache = None
-        if cache is not None:
+        if cache is not None and len(cache) == 6:
+            # paged pool flavor (see llama._forward_static_cache):
+            # (k_pool, v_pool, k_scale, v_scale, page_table, pos)
+            kp, vp, ksc, vsc, table, pos = cache
+            # t=1: bucket-padded extend writes past the table are
+            # legal (trash-redirected); only the start pos is checked
+            check_cache_pos(pos, 1, table.shape[1] * kp.shape[1])
+            out_dtype = getattr(x, "_data", x).dtype
+
+            def fp(q, k, v, kp, vp, table, p, *scales):
+                ks, vs = scales if scales else (None, None)
+                out, kp2, vp2, ks2, vs2 = paged_cache_attend(
+                    q, k, v, kp, vp, ks, vs, table,
+                    jnp.asarray(p, jnp.int32), jnp.dtype(out_dtype))
+                return (out, kp2, vp2, ks2, vs2) if scales \
+                    else (out, kp2, vp2)
+
+            args = (q, k, v, kp, vp, table, pos) + \
+                ((ksc, vsc) if ksc is not None else ())
+            res = apply_op(fp, *args,
+                           _op_name="gpt_paged_cache_attn")
+            if ksc is not None:
+                attn, kp2, vp2, ks2, vs2 = res
+            else:
+                (attn, kp2, vp2), ks2, vs2 = res, None, None
+            new_cache = (kp2, vp2, ks2, vs2, table, pos + t)
+        elif cache is not None:
             k_cache, v_cache, pos = cache
             per_row = check_cache_pos(pos, t, k_cache.shape[1])
 
@@ -153,8 +180,10 @@ class GPTModel(Layer):
         from ..ops.creation import arange
         if caches is not None:
             # serving decode: learned positions come from the cache's
-            # write position (scalar, or per-row for the slot pool)
-            base = caches[0][2]
+            # write position (scalar, or per-row for the slot pool);
+            # pos is the LAST element in both the contiguous 3-tuple
+            # and the paged 6-tuple cache flavors
+            base = caches[0][-1]
 
             def mk_pos(p):
                 p = jnp.asarray(p, jnp.int32)

@@ -18,7 +18,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..framework.tensor import apply_op
-from ._decode_cache import cache_attend, check_cache_pos
+from ._decode_cache import (cache_attend, check_cache_pos,
+                            paged_cache_attend)
 from ..nn import functional as F
 from ..nn.layer_base import Layer
 from ..nn.layer.common import Embedding, Linear
@@ -126,7 +127,10 @@ class LlamaAttention(Layer):
         (out, (k_cache', v_cache')) — the serving decode path."""
         cfg = self.cfg
         b, t, _ = x.shape
-        static_cache = cache is not None and len(cache) == 3
+        # cache flavors: len 3 = contiguous static buffers (k, v, pos);
+        # len 6 = paged pool (k_pool, v_pool, k_scale, v_scale,
+        # page_table, pos) — paddle_tpu/serving's paged KV cache
+        static_cache = cache is not None and len(cache) in (3, 6)
         past = cache[0].shape[1] if cache is not None \
             and not static_cache and cache[0] is not None else 0
         if past + t > cfg.max_position_embeddings:
@@ -205,24 +209,74 @@ class LlamaAttention(Layer):
         (k_cache [b, Tmax, KV, D], v_cache, pos). ``pos`` is a scalar
         (whole batch at one position — generate()) or a [b] vector of
         per-row positions (every row independent — the continuous-
-        batching slot pool, paddle_tpu/serving)."""
-        t = q.shape[1]
-        k_cache, v_cache, pos = cache
-        per_row = check_cache_pos(pos, t, k_cache.shape[1])
-        cos_full, sin_full = self._cos, self._sin
+        batching slot pool, paddle_tpu/serving).
 
-        def f(q, k, v, kc, vc, p):
-            p = jnp.asarray(p, jnp.int32)
+        The 6-tuple flavor routes through paged_cache_attend instead:
+        (k_pool, v_pool, k_scale, v_scale, page_table, pos) with
+        [num_pages, page, KV, D] pools and a [b, pages_per_seq] int32
+        table per row (scales None = model-dtype pages, set = int8
+        pages with per-page f32 scales)."""
+        t = q.shape[1]
+        paged = len(cache) == 6
+        if paged:
+            kp, vp, ksc, vsc, table, pos = cache
+            # t=1: only the START position must be in range — the
+            # extend prefill's bucket padding may overshoot the table
+            # and is redirected into the trash page by the attend
+            per_row = check_cache_pos(
+                pos, 1, table.shape[1] * kp.shape[1])
+        else:
+            k_cache, v_cache, pos = cache
+            per_row = check_cache_pos(pos, t, k_cache.shape[1])
+        cos_full, sin_full = self._cos, self._sin
+        out_dtype = getattr(x, "_data", x).dtype   # the MODEL dtype
+
+        def _rope(q, k, p):
             if per_row:
                 sl = lambda tbl, pi: jax.lax.dynamic_slice_in_dim(
                     tbl, pi, t)
                 cos = jax.vmap(partial(sl, cos_full))(p)   # [b, t, D/2]
                 sin = jax.vmap(partial(sl, sin_full))(p)
+            elif paged:
+                # per-POSITION gather, not dynamic_slice: the paged
+                # extend prefill's bucket padding may run p + t past
+                # the rope table, and a clamped SLICE start would
+                # silently shift the rotation of the real tail tokens.
+                # Gathering clamps only the padding rows (whose writes
+                # are trash-redirected / overwritten before any read).
+                idx = jnp.clip(p + jnp.arange(t, dtype=jnp.int32),
+                               0, cos_full.shape[0] - 1)
+                cos, sin = cos_full[idx], sin_full[idx]
             else:
                 cos = jax.lax.dynamic_slice_in_dim(cos_full, p, t)
                 sin = jax.lax.dynamic_slice_in_dim(sin_full, p, t)
-            qr = _apply_rope(q, cos, sin)
-            kr = _apply_rope(k, cos, sin)
+            return _apply_rope(q, cos, sin), _apply_rope(k, cos, sin)
+
+        if paged:
+            def f(q, k, v, kp, vp, table, p, *scales):
+                p = jnp.asarray(p, jnp.int32)
+                qr, kr = _rope(q, k, p)
+                ks, vs = scales if scales else (None, None)
+                out, kp2, vp2, ks2, vs2 = paged_cache_attend(
+                    qr, kr, v, kp, vp, ks, vs, table, p,
+                    jnp.dtype(out_dtype))
+                return (out, kp2, vp2, ks2, vs2) if scales \
+                    else (out, kp2, vp2)
+
+            args = (q, k, v, kp, vp, table, pos) + \
+                ((ksc, vsc) if ksc is not None else ())
+            res = apply_op(f, *args, _op_name="paged_cache_attn")
+            if ksc is not None:
+                out, kp2, vp2, ks2, vs2 = res
+            else:
+                out, kp2, vp2 = res
+                ks2, vs2 = None, None
+            return self.o_proj(out), (kp2, vp2, ks2, vs2, table,
+                                      pos + t)
+
+        def f(q, k, v, kc, vc, p):
+            p = jnp.asarray(p, jnp.int32)
+            qr, kr = _rope(q, k, p)
             return cache_attend(qr, kr, v, kc, vc, p, per_row)
 
         out, kc2, vc2 = apply_op(f, q, k, v, k_cache, v_cache, pos,

@@ -16,7 +16,9 @@ relaunches — and then audits every invariant in
   prompts,
 - loss-trajectory continuity against an uninjected baseline run,
 - checkpoint-generation monotonicity with torn-file tolerance,
-- no leaked slots / queue entries / pending save handles / non-daemon
+- no leaked slots / queue entries / KV pages (paged-cache refcounts
+  return to zero, including across mid-prefill faults on
+  shared-prefix admissions) / pending save handles / non-daemon
   threads.
 
 A violation is therefore a *seed*: re-running the same seed replays
@@ -48,8 +50,8 @@ import numpy as np
 from . import faults
 from .invariants import (ConservationLedger, checkpoint_monotonic_violations,
                          engine_leak_violations, loss_trajectory_violations,
-                         pending_save_violations, thread_leak_violations,
-                         token_prefix_violations)
+                         page_leak_violations, pending_save_violations,
+                         thread_leak_violations, token_prefix_violations)
 
 __all__ = ["FaultArm", "EpisodeResult", "ChaosStore",
            "SERVING_SWEEP", "TRAINING_SWEEP",
@@ -58,7 +60,8 @@ __all__ = ["FaultArm", "EpisodeResult", "ChaosStore",
 
 # the sweep partition: every KNOWN point is sampled by exactly one
 # episode kind (tests assert the union covers the whole catalogue)
-SERVING_SWEEP = ("serving.step.decode", "serving.step.prefill")
+SERVING_SWEEP = ("serving.step.decode", "serving.step.prefill",
+                 "serving.prefill.paged")
 TRAINING_SWEEP = ("train.step", "io.dataloader.worker",
                   "checkpoint.shard_write", "checkpoint.commit",
                   "watchdog.beat",
@@ -117,6 +120,15 @@ def _prompt_pool() -> List[np.ndarray]:
         rng = np.random.RandomState(1234)
         _pool = [rng.randint(1, 96, (int(n),)).astype(np.int64)
                  for n in (3, 4, 5, 7, 9, 12)]
+        # shared-prefix prompts (episodes run the PAGED engine with
+        # page_size 8): one full-page hit on the 12-token prompt's
+        # first page, and one mid-page hit that forces a COW — so
+        # mid-prefill faults land on shared-prefix admissions too
+        base = _pool[5]
+        _pool.append(np.concatenate(
+            [base[:8], rng.randint(1, 96, (3,))]).astype(np.int64))
+        _pool.append(np.concatenate(
+            [base[:6], rng.randint(1, 96, (1,))]).astype(np.int64))
     return _pool
 
 
@@ -182,8 +194,14 @@ def run_serving_episode(seed: int, max_iters: int = 300) \
     clock = {"t": 0.0}
     max_slots = int(rng.randint(1, 4))
     donate = bool(rng.randint(0, 2))    # TPU-like donated pools or CPU
+    # paged geometry: page_size 8 (4 pages per full-length row) with a
+    # sampled pool budget — small budgets exercise page-gated
+    # admission and queue growth under oversubscription
+    num_pages = int(rng.randint(_MAX_LEN // 8 + 1,
+                                max_slots * (_MAX_LEN // 8) + 2))
     eng = ServingEngine(model, max_slots=max_slots, max_len=_MAX_LEN,
                         min_bucket=_MIN_BUCKET,
+                        page_size=8, num_pages=num_pages,
                         time_fn=lambda: clock["t"],
                         registry=MetricRegistry(),
                         flight_recorder=FlightRecorder(capacity=8),
@@ -212,6 +230,10 @@ def run_serving_episode(seed: int, max_iters: int = 300) \
     schedule = _sample_arms(rng, [
         ("serving.step.decode", 0.6, (1, 3), (0, 8)),
         ("serving.step.prefill", 0.5, (1, 3), (0, 8)),
+        # mid-prefill on the paged cache: pages already claimed, so
+        # the abort path (refcount unwind) is what's under fire —
+        # including on shared-prefix admissions from the pool
+        ("serving.prefill.paged", 0.4, (1, 3), (0, 8)),
     ])
     # shutdown chaos: half the episodes stop serving mid-trace and
     # drain() with the queue and slots still loaded — optionally with
@@ -306,6 +328,7 @@ def _serving_result(seed, violations, schedule, ledger, submitted,
     violations = list(violations)
     violations += ledger.violations()
     violations += engine_leak_violations(eng)
+    violations += page_leak_violations(eng)
     violations += token_prefix_violations(
         (req, refs[pi]) for req, pi in submitted)
     return EpisodeResult(
@@ -314,7 +337,10 @@ def _serving_result(seed, violations, schedule, ledger, submitted,
         stats={"requests": len(submitted), "recoveries": recoveries,
                "steps": steps_ok,
                "donate": eng._donate() != (),
-               "max_slots": eng.max_slots})
+               "max_slots": eng.max_slots,
+               "num_pages": eng.cache.num_pages,
+               "prefix_hit_tokens": eng.cache.prefix_hit_tokens,
+               "cow_copies": eng.cache.cow_copies})
 
 
 # ---------------------------------------------------------------------------

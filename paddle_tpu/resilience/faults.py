@@ -13,6 +13,7 @@ Wired-in points (see docs/RESILIENCE.md for the catalogue):
 ===========================  ===========================================
 ``serving.step.decode``      right before the decode-step jit call
 ``serving.step.prefill``     inside the (re-)prefill program driver
+``serving.prefill.paged``    paged prefill, AFTER pages are claimed
 ``store.set/get/add/wait``   TCPStore client ops, before the C call
 ``checkpoint.shard_write``   inside the retried per-file shard write
 ``checkpoint.commit``        after shards, BEFORE the metadata flip
@@ -67,6 +68,9 @@ __all__ = ["InjectedFault", "maybe_fail", "inject", "clear", "injected",
 KNOWN_POINTS = (
     "serving.step.decode",
     "serving.step.prefill",
+    # mid-prefill on the PAGED cache: pages claimed, table row live,
+    # prefill program not yet run — the abort path must return them
+    "serving.prefill.paged",
     "store.set", "store.get", "store.add", "store.wait",
     "checkpoint.shard_write",
     "checkpoint.commit",

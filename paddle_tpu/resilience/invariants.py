@@ -29,10 +29,12 @@ next reviewer to spot the next instance:
   moves backwards and always names a loadable checkpoint, with torn
   shard files from interrupted saves tolerated.
 - **No leaks** (:func:`engine_leak_violations`,
-  :func:`thread_leak_violations`, :func:`pending_save_violations`): a
-  quiesced engine holds no slots, queue entries, or undelivered
-  requests; an episode spawns no surviving non-daemon threads and
-  settles every async save handle.
+  :func:`page_leak_violations`, :func:`thread_leak_violations`,
+  :func:`pending_save_violations`): a quiesced engine holds no slots,
+  queue entries, or undelivered requests; every paged-KV refcount is
+  back to zero (pages free or cached, reservations returned, no
+  stale page-table rows); an episode spawns no surviving non-daemon
+  threads and settles every async save handle.
 
 Checkers return a list of human-readable violation strings (empty =
 invariant holds) so one episode can report every broken law at once;
@@ -48,6 +50,7 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = ["InvariantViolation", "ConservationLedger",
            "token_prefix_violations", "engine_leak_violations",
+           "page_leak_violations",
            "thread_leak_violations", "pending_save_violations",
            "loss_trajectory_violations",
            "checkpoint_monotonic_violations"]
@@ -177,6 +180,58 @@ def engine_leak_violations(engine) -> List[str]:
         out.append(
             f"undelivered terminal requests "
             f"{[r.rid for r in engine._undelivered]}")
+    return out
+
+
+def page_leak_violations(engine) -> List[str]:
+    """No-leaked-pages law for the PAGED KV cache: once an engine
+    quiesces (drain/recover complete, no active slots), every page
+    refcount must be back to zero — each page is either on the free
+    list or parked refcount-0 in the prefix index (cached), the
+    reservation budget is fully returned, and no freed slot's page
+    table row still points at a page. A violation means some
+    failure path (aborted prefill, eviction, deadline cancel,
+    recover) dropped a refcount on the floor — exactly the class of
+    bug paging adds to the engine's failure surface.
+
+    No-op (empty) for a contiguous-pool engine."""
+    cache = engine.cache
+    if not getattr(engine, "paged", False):
+        return []
+    out = []
+    import numpy as np
+    referenced = np.nonzero(cache.refcnt[1:] > 0)[0] + 1
+    if len(referenced):
+        out.append(
+            f"leaked page refcounts: pages {referenced.tolist()} "
+            f"held {cache.refcnt[referenced].tolist()} refs after "
+            f"quiesce")
+    if cache.committed_pages != 0:
+        out.append(
+            f"leaked page reservations: committed budget "
+            f"{cache.committed_pages} != 0 after quiesce")
+    if cache._plans:
+        out.append(
+            f"leaked admission plans for rids "
+            f"{sorted(cache._plans)}")
+    exact_cached = sum(1 for n in cache._node_of_page.values()
+                       if cache.refcnt[n.page] == 0)
+    if exact_cached != cache.cached_page_count():
+        out.append(
+            f"cached-page counter drifted: maintained "
+            f"{cache.cached_page_count()} != scanned {exact_cached}")
+    accounted = cache.free_page_count() + exact_cached
+    if accounted != cache.num_pages - 1:
+        out.append(
+            f"page accounting hole: free ({cache.free_page_count()})"
+            f" + cached ({exact_cached}) != "
+            f"{cache.num_pages - 1} usable pages")
+    rows = np.nonzero(cache.page_table.any(axis=1))[0]
+    stale = [int(s) for s in rows if cache.slots[s] is None]
+    if stale:
+        out.append(
+            f"freed slots {stale} still hold page-table entries "
+            f"{[cache.page_table[s].tolist() for s in stale]}")
     return out
 
 

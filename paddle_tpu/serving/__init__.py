@@ -1,21 +1,25 @@
 """paddle_tpu.serving — continuous-batching LLM serving engine.
 
-Iteration-level scheduling (Orca) over a slot-pool static KV cache
-(vLLM's slot management, without paging — fixed ``(max_slots,
-max_len)`` buffers fit the repo's compile-once decode design): one
-compiled decode-step program serves ANY mix of in-flight requests, new
-requests are admitted into freed slots every step through a small set
-of power-of-2 prefill buckets, and finished sequences (EOS / length
-cap) are evicted immediately instead of idling their slot until the
-longest batch member finishes.
+Iteration-level scheduling (Orca) over a BLOCK-PAGED KV cache
+(PagedAttention-style fixed-size pages + static per-slot page tables,
+copy-on-write prefix sharing keyed by prompt content, optional int8
+KV with per-page scales — all inside the repo's compile-once decode
+design): one compiled decode-step program serves ANY mix of in-flight
+requests, admission is gated by free PAGES (worst-case span reserved,
+so decode never preempts), and finished sequences (EOS / length cap)
+are evicted immediately, their shared prompt pages staying cached for
+later requests. ``kv_layout="contiguous"`` selects the original
+slot-pool flavor (fixed ``(max_slots, max_len)`` rows) for A/B.
 
     engine = ServingEngine(model, max_slots=8, max_len=512, eos_id=2)
     req = engine.submit(prompt_ids, max_new_tokens=64)
     done = engine.run()            # or step() per iteration
     print(req.output_ids, engine.metrics.summary())
 
-Compile count is 1 decode program + O(log max_len) prefill buckets,
-asserted in tests/test_serving_engine.py via trace counting.
+Compile count is 1 decode program + O(log max_len) prefill/extend
+buckets (+1 COW copy program), asserted in
+tests/test_serving_engine.py + tests/test_paged_kv.py via trace
+counting — paging adds ZERO decode compiles.
 
 Failure contract (docs/RESILIENCE.md): typed errors in ``errors``
 (``QueueFull`` / ``DeadlineExceeded`` / ``EngineBroken`` /
@@ -31,10 +35,11 @@ from .metrics import EngineMetrics  # noqa: F401
 from .sampling import SamplingParams, sample_token  # noqa: F401
 from .scheduler import (FIFOScheduler, Request, bucket_for,  # noqa: F401
                         prefill_buckets)
-from .slot_cache import SlotKVCache  # noqa: F401
+from .slot_cache import PagedKVCache, SlotKVCache  # noqa: F401
 
 __all__ = ["ServingEngine", "EngineMetrics", "SamplingParams",
            "sample_token", "FIFOScheduler", "Request", "bucket_for",
-           "prefill_buckets", "SlotKVCache", "ServingError",
+           "prefill_buckets", "SlotKVCache", "PagedKVCache",
+           "ServingError",
            "QueueFull", "DeadlineExceeded", "EngineBroken",
            "EngineIdle", "EngineClosed", "RequestCancelled"]

@@ -2,13 +2,17 @@
 path.
 
 ONE compiled decode-step program (fixed ``[max_slots, 1]`` token block,
-per-slot positions, active-slot mask) serves any mix of in-flight
-requests; prefill compiles once per power-of-2 length bucket. Compare
+per-slot positions, active-slot mask — and, on the default PAGED
+layout, the static page table) serves any mix of in-flight requests;
+prefill compiles once per power-of-2 length bucket (full-prompt and
+shared-prefix-extend flavors). Compare
 ``benchmarks/bench_llama_decode.py``'s synchronized path, where every
 sequence in a batch starts and stops together and slots idle while the
 longest request finishes — here freed slots are refilled from the
 queue at every iteration (Orca-style iteration-level scheduling), so
-ragged traffic keeps the batch dense.
+ragged traffic keeps the batch dense, and the paged pool admits by
+FREE PAGES rather than worst-case rows, so the same KV bytes carry
+several times more concurrent requests (docs/SERVING.md).
 
 Synchronous API by design (the repo's serving story is one compiled
 program per step, driven by a host loop):
@@ -47,7 +51,7 @@ from .errors import (DeadlineExceeded, EngineBroken, EngineClosed,
 from .metrics import EngineMetrics
 from .sampling import SamplingParams, sample_token
 from .scheduler import FIFOScheduler, Request, bucket_for
-from .slot_cache import SlotKVCache
+from .slot_cache import PagedKVCache, SlotKVCache
 
 __all__ = ["ServingEngine"]
 
@@ -101,7 +105,12 @@ class ServingEngine:
                  max_queue: Optional[int] = None,
                  time_fn: Callable[[], float] = time.perf_counter,
                  registry=None, flight_recorder=None,
-                 auditor=None):
+                 auditor=None,
+                 kv_layout: str = "paged",
+                 page_size: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 kv_dtype: Optional[str] = None,
+                 prefix_sharing: Optional[bool] = None):
         self.adapter = _ModelAdapter(model)
         model.eval()
         self.max_slots = int(max_slots)
@@ -116,10 +125,34 @@ class ServingEngine:
                 f"max_queue must be >= 1 or None, got {max_queue}")
         self.max_queue = max_queue
         self.min_bucket = min(int(min_bucket), self.max_len)
-        self.cache = SlotKVCache(
-            self.adapter.num_layers, self.max_slots, self.max_len,
-            self.adapter.kv_heads, self.adapter.head_dim,
-            self.adapter.dtype)
+        if kv_layout not in ("paged", "contiguous"):
+            raise ValueError(
+                f"kv_layout must be 'paged' or 'contiguous', got "
+                f"{kv_layout!r}")
+        if kv_dtype not in (None, "int8"):
+            raise ValueError(
+                f"kv_dtype must be None (model dtype) or 'int8', got "
+                f"{kv_dtype!r}")
+        if kv_layout == "contiguous" and (
+                page_size is not None or num_pages is not None
+                or kv_dtype is not None or prefix_sharing is not None):
+            raise ValueError(
+                "page_size/num_pages/kv_dtype/prefix_sharing only "
+                "apply to the paged kv_layout")
+        self.paged = kv_layout == "paged"
+        if self.paged:
+            if page_size is None:
+                # largest power-of-2 divisor of max_len, capped at 128
+                # (the TPU-friendly default page)
+                page_size = 128
+                while self.max_len % page_size:
+                    page_size //= 2
+            self.page_size = int(page_size)
+            self.num_pages = num_pages        # None = capacity parity
+            self.kv_quant = kv_dtype == "int8"
+            self.prefix_sharing = True if prefix_sharing is None \
+                else bool(prefix_sharing)
+        self.cache = self._new_cache()
         self.scheduler = FIFOScheduler()
         self.registry = registry if registry is not None \
             else default_registry()
@@ -133,6 +166,8 @@ class ServingEngine:
         self._params, self._buffers = model.raw_state()
         self._decode_jit = None
         self._prefill_jit = None
+        self._extend_jit = None
+        self._copy_jit = None
         self._next_rid = 0
         self._step_idx = 0
         # set when a step fails after donating the cache pools (device
@@ -155,7 +190,8 @@ class ServingEngine:
         # python-side-effect counters bumped at TRACE time: the compile-
         # count contract (1 decode + O(log max_len) prefill buckets) is
         # asserted against these in tests
-        self.trace_counts = {"decode": 0, "prefill": {}}
+        self.trace_counts = {"decode": 0, "prefill": {},
+                             "extend": {}, "copy": 0}
         reg = self.registry
         self._m_queue_depth = reg.gauge(
             "ptpu_serving_queue_depth", "requests waiting for a slot")
@@ -183,6 +219,76 @@ class ServingEngine:
             "ptpu_serving_recover_replay_mismatch_total",
             "recovery re-prefills whose greedy replay token diverged "
             "from the already-delivered token")
+        if self.paged:
+            self._m_pages_free = reg.gauge(
+                "ptpu_serving_pages_free", "KV pages on the free list")
+            self._m_pages_active = reg.gauge(
+                "ptpu_serving_pages_active",
+                "KV pages referenced by at least one request")
+            self._m_pages_cached = reg.gauge(
+                "ptpu_serving_pages_cached",
+                "refcount-0 prefix-index pages (reclaimable)")
+            self._m_kv_bytes = reg.gauge(
+                "ptpu_serving_kv_bytes",
+                "total device bytes of the paged KV pool (+scales)")
+            self._m_kv_bytes.set(self.cache.kv_bytes())
+            self._m_prefix_hit = reg.counter(
+                "ptpu_serving_prefix_hit_tokens_total",
+                "prompt tokens served from shared prefix pages")
+            self._m_prefix_lookup = reg.counter(
+                "ptpu_serving_prefix_lookup_tokens_total",
+                "prompt tokens eligible for prefix matching")
+            self._m_cow = reg.counter(
+                "ptpu_serving_cow_copies_total",
+                "copy-on-write page copies")
+            self._last_page_stats = {"prefix_hit_tokens": 0,
+                                     "prefix_lookup_tokens": 0,
+                                     "cow_copies": 0}
+            self.peak_active_slots = 0
+
+    def _new_cache(self):
+        """Fresh KV pool in the configured layout (init + recover)."""
+        ad = self.adapter
+        if self.paged:
+            return PagedKVCache(
+                ad.num_layers, self.max_slots, self.max_len,
+                ad.kv_heads, ad.head_dim, ad.dtype,
+                page_size=self.page_size, num_pages=self.num_pages,
+                quant=self.kv_quant,
+                prefix_sharing=self.prefix_sharing)
+        return SlotKVCache(
+            ad.num_layers, self.max_slots, self.max_len,
+            ad.kv_heads, ad.head_dim, ad.dtype)
+
+    def _publish_page_stats(self) -> None:
+        if not self.paged:
+            return
+        c = self.cache
+        self._m_pages_free.set(c.free_page_count())
+        self._m_pages_active.set(c.active_page_count())
+        self._m_pages_cached.set(c.cached_page_count())
+        last = self._last_page_stats
+        for counter, key in ((self._m_prefix_hit, "prefix_hit_tokens"),
+                             (self._m_prefix_lookup,
+                              "prefix_lookup_tokens"),
+                             (self._m_cow, "cow_copies")):
+            cur = getattr(c, key)
+            if cur > last[key]:
+                counter.inc(cur - last[key])
+            last[key] = cur
+
+    def paged_stats(self) -> dict:
+        """Paged-pool snapshot for benchmarks/dashboards (raises on a
+        contiguous engine): cache page/prefix/COW counters plus the
+        peak concurrent in-flight requests this engine reached."""
+        if not self.paged:
+            raise RuntimeError("paged_stats() on a contiguous engine")
+        s = self.cache.stats()
+        s["peak_active_slots"] = self.peak_active_slots
+        s["prefix_hit_rate"] = (
+            s["prefix_hit_tokens"] / s["prefix_lookup_tokens"]
+            if s["prefix_lookup_tokens"] else 0.0)
+        return s
 
     # -- public API ----------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 16,
@@ -351,8 +457,17 @@ class ServingEngine:
         # structure -> no retrace; the arrays are just jit arguments)
         self._params, self._buffers = self.adapter.model.raw_state()
         # 1) admission — freed slots refill BEFORE the decode so a new
-        # request's first decode token rides this very step
-        pairs = self.scheduler.admissions(self.cache.free_slots())
+        # request's first decode token rides this very step. Paged:
+        # admission is gated by FREE PAGES, not just free slots — the
+        # claim reserves the request's worst-case page span so decode
+        # can never run out of pages mid-flight
+        claim = None
+        if self.paged:
+            claim = lambda req: self.cache.try_reserve(
+                req, req.prompt,
+                req.prompt_len + req.max_new_tokens)
+        pairs = self.scheduler.admissions(self.cache.free_slots(),
+                                          claim=claim)
         for i, (slot, req) in enumerate(pairs):
             try:
                 self._prefill(slot, req)
@@ -360,9 +475,14 @@ class ServingEngine:
                 # admissions() popped the WHOLE batch: everything not
                 # yet prefilled goes back to the queue head in FCFS
                 # order, or a recovered engine silently loses them
+                # (their page reservations return with them)
                 for _, later in reversed(pairs[i + 1:]):
+                    if self.paged:
+                        self.cache.cancel_reservation(later)
                     self.scheduler.requeue(later)
                 if req.slot is None and not req.out_tokens:
+                    if self.paged:
+                        self.cache.cancel_reservation(req)
                     self.scheduler.requeue(req)
                 raise
             admitted.append(req.rid)
@@ -374,19 +494,37 @@ class ServingEngine:
             toks = np.zeros((self.max_slots, 1), np.int64)
             pos = np.zeros((self.max_slots,), np.int32)
             mask = np.zeros((self.max_slots,), bool)
+            copies = []
             for s in active:
                 req = self.cache.slots[s]
                 toks[s, 0] = req.out_tokens[-1]
                 pos[s] = req.next_pos
                 mask[s] = True
+                if self.paged:
+                    # the write may cross into a new page (allocate)
+                    # or a shared one (COW) — resolve BEFORE the step
+                    c = self.cache.ensure_decode_page(s, req.next_pos)
+                    if c is not None:
+                        copies.append(c)
             maybe_fail("serving.step.decode", step=self._step_idx - 1)
             with span("serving.decode", batch=len(active),
                       request_ids=[self.cache.slots[s].rid
                                    for s in active]):
-                logits, ks, vs = self._decode_fn()(
-                    self._params, self._buffers, toks, pos, mask,
-                    self.cache.ks, self.cache.vs)
-                self.cache.ks, self.cache.vs = list(ks), list(vs)
+                if self.paged:
+                    self._run_copies(copies)
+                    logits, ks, vs, kss, vss = self._decode_fn()(
+                        self._params, self._buffers, toks, pos, mask,
+                        self.cache.page_table.copy(),
+                        self.cache.ks, self.cache.vs,
+                        self.cache.kss, self.cache.vss)
+                    self.cache.ks, self.cache.vs = list(ks), list(vs)
+                    self.cache.kss, self.cache.vss = \
+                        list(kss), list(vss)
+                else:
+                    logits, ks, vs = self._decode_fn()(
+                        self._params, self._buffers, toks, pos, mask,
+                        self.cache.ks, self.cache.vs)
+                    self.cache.ks, self.cache.vs = list(ks), list(vs)
                 logits = np.asarray(jax.device_get(logits))
             for s in active:
                 req = self.cache.slots[s]
@@ -396,6 +534,10 @@ class ServingEngine:
                 if self._is_finished(req, tok):
                     self._evict(s, req, finished)
         self.metrics.on_step(len(active))
+        if self.paged:
+            self.peak_active_slots = max(self.peak_active_slots,
+                                         len(active))
+            self._publish_page_stats()
         return admitted, len(active)
 
     def _evict(self, slot: int, req: Request,
@@ -473,9 +615,14 @@ class ServingEngine:
         in_flight = [(s, r) for s, r in enumerate(self.cache.slots)
                      if r is not None]
         ad = self.adapter
-        self.cache = SlotKVCache(
-            ad.num_layers, self.max_slots, self.max_len, ad.kv_heads,
-            ad.head_dim, ad.dtype)
+        if self.paged:
+            # flush the dying pool's counter deltas, then re-baseline:
+            # the fresh pool restarts its raw counters at zero and a
+            # stale baseline would swallow all increments after this
+            self._publish_page_stats()
+            self._last_page_stats = {k: 0
+                                     for k in self._last_page_stats}
+        self.cache = self._new_cache()
         self._params, self._buffers = ad.model.raw_state()
         # accumulate on the ENGINE, not a local: if a re-prefill below
         # faults, these requests are gone from the slot table, and the
@@ -505,7 +652,8 @@ class ServingEngine:
                 # the failed step died between slot assignment and the
                 # first sampled token: finish the prefill now
                 logits = self._prefill_raw(s, req.prompt,
-                                           request_id=req.rid)
+                                           request_id=req.rid,
+                                           req=req)
                 tok = sample_token(logits, req.sampling, req._rng)
                 req.out_tokens.append(tok)
                 self.metrics.on_token(req.rid)
@@ -516,7 +664,8 @@ class ServingEngine:
                 np.concatenate([req.prompt,
                                 np.asarray(req.out_tokens[:-1],
                                            np.int64)])
-            logits = self._prefill_raw(s, ids, request_id=req.rid)
+            logits = self._prefill_raw(s, ids, request_id=req.rid,
+                                       req=req)
             if req.sampling.temperature <= 0 \
                     and int(np.argmax(logits)) != req.out_tokens[-1]:
                 mismatches += 1
@@ -643,7 +792,7 @@ class ServingEngine:
         k/v into the slot row, and sample its first token (TTFT)."""
         self.metrics.on_first_prefill(req.rid)   # queue wait ends here
         logits = self._prefill_raw(slot, req.prompt,
-                                   request_id=req.rid)
+                                   request_id=req.rid, req=req)
         self.cache.assign(slot, req)
         req.slot = slot
         tok = sample_token(logits, req.sampling, req._rng)
@@ -652,40 +801,128 @@ class ServingEngine:
         self._is_finished(req, tok)
 
     def _prefill_raw(self, slot: int, ids: np.ndarray,
-                     request_id=None) -> np.ndarray:
+                     request_id=None, req=None) -> np.ndarray:
         """Write ``ids``'s k/v into positions ``0..len-1`` of the slot
         row via the bucketed prefill program and return the host
         logits at the last real token. Shared by admission prefill and
         ``recover()``'s re-prefill (which replays prompt + delivered
-        tokens through the same program)."""
+        tokens through the same program).
+
+        Paged: the prompt is first matched against the prefix index —
+        matched pages are referenced instead of recomputed and only
+        the tail runs through a prefill program (the full-prompt
+        program when nothing matched, the paged EXTEND program — which
+        attends over the shared pages — otherwise). A failure after
+        pages were claimed unwinds them (abort_sequence)."""
         maybe_fail("serving.step.prefill", slot=slot)
         n = int(ids.shape[0])
-        bucket = bucket_for(n, self.min_bucket, self.max_len)
-        self._m_prefill.labels(bucket=bucket).inc()
-        with span("serving.prefill", request_id=request_id, slot=slot,
-                  bucket=bucket, prompt_len=n):
-            padded = np.zeros((1, bucket), np.int64)
-            padded[0, :n] = ids
-            logits, ks, vs = self._prefill_fn()(
-                self._params, self._buffers, padded,
-                np.int32(n), np.int32(slot),
-                self.cache.ks, self.cache.vs)
-            self.cache.ks, self.cache.vs = list(ks), list(vs)
-        return np.asarray(jax.device_get(logits))
+        if not self.paged:
+            bucket = bucket_for(n, self.min_bucket, self.max_len)
+            self._m_prefill.labels(bucket=bucket).inc()
+            with span("serving.prefill", request_id=request_id,
+                      slot=slot, bucket=bucket, prompt_len=n):
+                padded = np.zeros((1, bucket), np.int64)
+                padded[0, :n] = ids
+                logits, ks, vs = self._prefill_fn()(
+                    self._params, self._buffers, padded,
+                    np.int32(n), np.int32(slot),
+                    self.cache.ks, self.cache.vs)
+                self.cache.ks, self.cache.vs = list(ks), list(vs)
+            return np.asarray(jax.device_get(logits))
+        cache = self.cache
+        if req.rid not in cache._plans:
+            # admission reserves at claim time; recover()'s re-prefill
+            # reserves here (a fresh pool always fits what it held)
+            if not cache.try_reserve(req, ids,
+                                     req.prompt_len
+                                     + req.max_new_tokens):
+                raise RuntimeError(
+                    f"request {req.rid}: page reservation failed on "
+                    f"re-prefill (pool too small for in-flight set)")
+        try:
+            # same-wave sharing: earlier admissions in THIS batch have
+            # registered their pages since the claim — re-match now
+            cache.refresh_reservation(req, ids)
+            start, copies = cache.begin_sequence(slot, req, ids)
+            # mid-prefill fault point: pages are claimed, the table
+            # row is live, nothing has run on device yet — the abort
+            # path below must return every page (chaos-audited)
+            maybe_fail("serving.prefill.paged", slot=slot,
+                       shared=start > 0)
+            self._run_copies(copies)
+            tail = n - start
+            bucket = bucket_for(tail, self.min_bucket, self.max_len)
+            self._m_prefill.labels(bucket=bucket).inc()
+            with span("serving.prefill", request_id=request_id,
+                      slot=slot, bucket=bucket, prompt_len=n,
+                      shared_prefix=start):
+                padded = np.zeros((1, bucket), np.int64)
+                padded[0, :tail] = ids[start:]
+                row = cache.page_table[slot]
+                if start == 0:
+                    npages = (bucket + cache.page_size - 1) \
+                        // cache.page_size
+                    logits, ks, vs, kss, vss = self._prefill_fn()(
+                        self._params, self._buffers, padded,
+                        np.int32(n), row[:npages].copy(),
+                        cache.ks, cache.vs, cache.kss, cache.vss)
+                else:
+                    logits, ks, vs, kss, vss = self._extend_fn()(
+                        self._params, self._buffers, padded,
+                        np.int32(start), np.int32(tail), row.copy(),
+                        cache.ks, cache.vs, cache.kss, cache.vss)
+                cache.ks, cache.vs = list(ks), list(vs)
+                cache.kss, cache.vss = list(kss), list(vss)
+            cache.register_prefix(slot, ids)
+            return np.asarray(jax.device_get(logits))
+        except Exception:
+            cache.abort_sequence(slot, req)
+            raise
+
+    def _run_copies(self, copies) -> None:
+        """Run COW page copies on device (host-picked src/dst, one
+        tiny compiled program reused for every copy)."""
+        for src, dst in copies:
+            c = self.cache
+            out = self._copy_fn()(np.int32(src), np.int32(dst),
+                                  c.ks, c.vs, c.kss, c.vss)
+            c.ks, c.vs = list(out[0]), list(out[1])
+            c.kss, c.vss = list(out[2]), list(out[3])
+
+    def _paged_caches(self, ks, vs, kss, vss, table, pos):
+        """Per-layer paged cache tuples for the model forward
+        (scales None on the model-dtype path)."""
+        return [(k, v, kss[i] if kss else None,
+                 vss[i] if vss else None, table, pos)
+                for i, (k, v) in enumerate(zip(ks, vs))]
+
+    @staticmethod
+    def _unpack_paged(new_caches):
+        d = lambda x: getattr(x, "_data", x)
+        ks2 = [d(c[0]) for c in new_caches]
+        vs2 = [d(c[1]) for c in new_caches]
+        kss2 = [d(c[2]) for c in new_caches] \
+            if new_caches[0][2] is not None else []
+        vss2 = [d(c[3]) for c in new_caches] \
+            if new_caches[0][3] is not None else []
+        return ks2, vs2, kss2, vss2
 
     def _prefill_fn(self):
-        """Prefill program, one compile per bucket length: run the
-        prompt through a local [1, bucket] static cache, take the
-        logits at the LAST REAL token (the bucket tail is padding), and
-        splice the local k/v into the slot row of the donated pool.
-        Pad-tail garbage in the row is harmless: the per-slot causal
-        mask hides positions > the current length, and each decode step
-        overwrites position ``len`` right before attending it."""
+        """Full-prompt prefill program, one compile per bucket length:
+        run the prompt through a local [1, bucket] static cache, take
+        the logits at the LAST REAL token (the bucket tail is
+        padding), and splice the local k/v into the pool — the slot
+        row of the contiguous pool, or the allocated pages (quantized
+        on the int8 path) of the paged pool. Pad-tail garbage is
+        harmless: the per-slot causal mask hides positions > the
+        current length, and each decode step overwrites position
+        ``len`` right before attending it; padded PAGE slots point at
+        the reserved trash page."""
         if self._prefill_jit is not None:
             return self._prefill_jit
         ad = self.adapter
 
-        def pure(params, buffers, ids, true_len, slot, ks, vs):
+        def local_run(params, buffers, ids, true_len):
             Lb = ids.shape[1]
             self.trace_counts["prefill"][Lb] = \
                 self.trace_counts["prefill"].get(Lb, 0) + 1
@@ -698,25 +935,134 @@ class ServingEngine:
                 h_last = jax.lax.dynamic_slice_in_dim(
                     h._data, true_len - 1, 1, axis=1)
                 logits = ad.head(Tensor(h_last))._data[0, -1]
-            splice = lambda pool, c: jax.lax.dynamic_update_slice(
-                pool, getattr(c, "_data", c).astype(pool.dtype),
-                (slot, 0, 0, 0))
-            ks = [splice(p, c[0]) for p, c in zip(ks, new_caches)]
-            vs = [splice(p, c[1]) for p, c in zip(vs, new_caches)]
-            return logits, ks, vs
+            return logits, new_caches
 
-        self._prefill_jit = jax.jit(pure,
-                                    donate_argnums=self._donate())
+        if not self.paged:
+            def pure(params, buffers, ids, true_len, slot, ks, vs):
+                logits, new_caches = local_run(params, buffers, ids,
+                                               true_len)
+                splice = lambda pool, c: jax.lax.dynamic_update_slice(
+                    pool, getattr(c, "_data", c).astype(pool.dtype),
+                    (slot, 0, 0, 0))
+                ks = [splice(p, c[0]) for p, c in zip(ks, new_caches)]
+                vs = [splice(p, c[1]) for p, c in zip(vs, new_caches)]
+                return logits, ks, vs
+
+            self._prefill_jit = jax.jit(pure,
+                                        donate_argnums=self._donate())
+            return self._prefill_jit
+
+        from ..models._decode_cache import quantize_kv_page
+        P = self.cache.page_size
+        quant = self.kv_quant
+
+        def pure(params, buffers, ids, true_len, page_ids, ks, vs,
+                 kss, vss):
+            logits, new_caches = local_run(params, buffers, ids,
+                                           true_len)
+            npg = page_ids.shape[0]
+            pad = npg * P - ids.shape[1]
+
+            def paginate(c):
+                a = getattr(c, "_data", c)
+                if pad:
+                    a = jnp.pad(a, ((0, 0), (0, pad), (0, 0), (0, 0)))
+                return a.reshape(npg, P, *a.shape[2:])
+
+            for i, c in enumerate(new_caches):
+                kpg, vpg = paginate(c[0]), paginate(c[1])
+                if quant:
+                    kq, ksc = quantize_kv_page(kpg)
+                    vq, vsc = quantize_kv_page(vpg)
+                    ks[i] = ks[i].at[page_ids].set(kq)
+                    vs[i] = vs[i].at[page_ids].set(vq)
+                    kss[i] = kss[i].at[page_ids].set(ksc)
+                    vss[i] = vss[i].at[page_ids].set(vsc)
+                else:
+                    ks[i] = ks[i].at[page_ids].set(
+                        kpg.astype(ks[i].dtype))
+                    vs[i] = vs[i].at[page_ids].set(
+                        vpg.astype(vs[i].dtype))
+            return logits, ks, vs, kss, vss
+
+        self._prefill_jit = jax.jit(
+            pure, donate_argnums=self._donate_idx(5, 6, 7, 8))
         return self._prefill_jit
+
+    def _extend_fn(self):
+        """Shared-prefix tail prefill ("extend"), one compile per tail
+        bucket: the tail tokens run through the PAGED cache path at
+        start position ``start``, attending over the already-shared
+        prefix pages through the slot's page table and writing their
+        own k/v through it (bucket-padding writes past the table fall
+        into the trash page). Logits at the last REAL tail token."""
+        if self._extend_jit is not None:
+            return self._extend_jit
+        ad = self.adapter
+
+        def pure(params, buffers, ids, start, true_tail, row, ks, vs,
+                 kss, vss):
+            Lb = ids.shape[1]
+            self.trace_counts["extend"][Lb] = \
+                self.trace_counts["extend"].get(Lb, 0) + 1
+            caches = self._paged_caches(ks, vs, kss, vss,
+                                        row[None, :], start)
+            with ad.model.bind_state(params, buffers):
+                h, new_caches = ad.call(Tensor(ids), caches)
+                h_last = jax.lax.dynamic_slice_in_dim(
+                    h._data, true_tail - 1, 1, axis=1)
+                logits = ad.head(Tensor(h_last))._data[0, -1]
+            return (logits,) + self._unpack_paged(new_caches)
+
+        self._extend_jit = jax.jit(
+            pure, donate_argnums=self._donate_idx(6, 7, 8, 9))
+        return self._extend_jit
+
+    def _copy_fn(self):
+        """COW page copy (compiled once): pool[dst] <- pool[src] for
+        every layer's k/v (+scale) pool."""
+        if self._copy_jit is not None:
+            return self._copy_jit
+
+        def pure(src, dst, ks, vs, kss, vss):
+            self.trace_counts["copy"] += 1
+            cp = lambda pool: pool.at[dst].set(pool[src])
+            return ([cp(p) for p in ks], [cp(p) for p in vs],
+                    [cp(p) for p in kss], [cp(p) for p in vss])
+
+        self._copy_jit = jax.jit(
+            pure, donate_argnums=self._donate_idx(2, 3, 4, 5))
+        return self._copy_jit
 
     def _decode_fn(self):
         """THE decode-step program (compiled once): every occupied slot
         advances one token at its own position; the active-slot mask
         pins inactive lanes to position 0 and zeroes their logits so
-        they stay numerically inert whatever garbage their row holds."""
+        they stay numerically inert whatever garbage their row holds.
+        Paged flavor: same contract, but k/v flow through the page
+        tables (inactive rows pinned to the trash page) — paging adds
+        ZERO decode compiles beyond this one program."""
         if self._decode_jit is not None:
             return self._decode_jit
         ad = self.adapter
+
+        if self.paged:
+            def pure(params, buffers, toks, pos, active, tables, ks,
+                     vs, kss, vss):
+                self.trace_counts["decode"] += 1
+                pos_eff = jnp.where(active, pos, 0).astype(jnp.int32)
+                tab_eff = jnp.where(active[:, None], tables, 0)
+                caches = self._paged_caches(ks, vs, kss, vss,
+                                            tab_eff, pos_eff)
+                with ad.model.bind_state(params, buffers):
+                    h, new_caches = ad.call(Tensor(toks), caches)
+                    logits = ad.head(h[:, -1:])._data[:, -1]
+                logits = jnp.where(active[:, None], logits, 0.0)
+                return (logits,) + self._unpack_paged(new_caches)
+
+            self._decode_jit = jax.jit(
+                pure, donate_argnums=self._donate_idx(6, 7, 8, 9))
+            return self._decode_jit
 
         def pure(params, buffers, toks, pos, active, ks, vs):
             self.trace_counts["decode"] += 1
@@ -736,7 +1082,13 @@ class ServingEngine:
 
     @staticmethod
     def _donate():
-        """Donate the cache pools (args 5/6 of both programs) so the
-        update is in-place on device; CPU ignores donation and warns,
-        so skip it there."""
+        """Donation enable flag + the contiguous programs' pool
+        argument indices (args 5/6): non-empty means the jit update is
+        in-place on device. CPU ignores donation and warns, so skip
+        it there. Paged programs derive their own indices from this
+        flag via ``_donate_idx`` (tests monkeypatch ``_donate`` to
+        simulate the TPU donated-pool failure mode)."""
         return () if jax.default_backend() == "cpu" else (5, 6)
+
+    def _donate_idx(self, *idx):
+        return idx if self._donate() else ()

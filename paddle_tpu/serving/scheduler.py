@@ -106,12 +106,22 @@ class FIFOScheduler:
     def depth(self) -> int:
         return len(self._queue)
 
-    def admissions(self, free_slots: List[int]) \
+    def admissions(self, free_slots: List[int], claim=None) \
             -> List[Tuple[int, Request]]:
-        """Pair queued requests with free slots, FCFS, one per slot."""
+        """Pair queued requests with free slots, FCFS, one per slot.
+
+        ``claim`` (optional) gates each admission on a resource besides
+        the slot — the paged engine passes its page-reservation check,
+        so admission is bounded by FREE PAGES, not just free slots.
+        ``claim(head)`` returning False stops the batch with the head
+        still queued (FCFS: no skipping ahead of a request that does
+        not fit yet). A truthy claim is a COMMITTED reservation: the
+        caller unwinds it if the admission later fails."""
         picked = []
         for slot in free_slots:
             if not self._queue:
+                break
+            if claim is not None and not claim(self._queue[0]):
                 break
             picked.append((slot, self._queue.popleft()))
         return picked

@@ -1,55 +1,574 @@
-"""Slot-pool KV cache: fixed ``(max_slots, max_len)`` buffers + slot
-bookkeeping.
+"""KV-cache pools for the serving engine: the contiguous slot pool and
+its block-paged successor.
 
-The pool is allocated ONCE; slots are leased to requests and recycled
-on eviction. Rows are never cleared on release — a freshly admitted
-request's prefill overwrites positions ``0..bucket-1`` of its row, and
-the per-slot causal mask (``kpos <= qpos`` in
-models/_decode_cache.cache_attend) keeps any stale tail beyond the
-current length invisible, so recycling costs zero device work.
+``SlotKVCache`` is the original fixed ``(max_slots, max_len)`` pool:
+one full row reserved per slot, so concurrency is capped by the
+worst-case request length. ``PagedKVCache`` replaces the row with a
+pool of fixed-size PAGES (``[num_pages, page_size, kv_heads,
+head_dim]`` per layer) and a static per-slot page table
+(``[max_slots, pages_per_slot]`` int32 — the ONE compiled decode
+program gathers through it, see models/_decode_cache.paged_cache_attend),
+so a request only holds pages covering the tokens it has actually
+written and the pool oversubscribes: many more concurrent requests fit
+the same KV bytes.
+
+On top of paging it adds:
+
+- **copy-on-write prefix sharing** — prompts are matched against a
+  page-granular radix index keyed by token content (chained full-page
+  chunks, plus a partial match into the first divergent page). Matched
+  pages are refcounted and referenced, not re-prefilled; the first
+  write into a shared page copies it first (COW). Released requests
+  leave their full prompt pages behind as refcount-0 CACHED pages,
+  reclaimed LRU-first under allocation pressure.
+- **int8 KV storage** — pools held in int8 with per-page f32 scales
+  (``[num_pages, page_size, kv_heads]``, absmax over head_dim),
+  dequantized inside the attend. Roughly halves KV bytes per token vs
+  bf16.
+- **reservation-based admission** — a request is admitted only when
+  its worst-case page span (minus fully shared pages) fits the pool,
+  so decode can never hit an out-of-pages wall mid-flight (no
+  preemption needed).
+
+Slot bookkeeping is maintained incrementally (free/active sets) —
+``free_slots``/``active_slots``/``occupancy`` are O(active), not
+O(max_slots) list scans, since the engine consults them every step.
+
+Page 0 is a reserved TRASH page: unallocated page-table entries point
+at it, and masked/padded writes land in it, so stale table rows can
+never corrupt live data. Rows are never cleared on the device — the
+per-slot causal mask (``kpos <= qpos``) keeps any stale tail beyond
+the current length invisible, so recycling costs zero device work.
 """
 from __future__ import annotations
 
-from typing import List, Optional
+from collections import deque
+from typing import Dict, List, Optional, Tuple
 
 import jax.numpy as jnp
+import numpy as np
 
-__all__ = ["SlotKVCache"]
+__all__ = ["SlotKVCache", "PagedKVCache"]
 
 
-class SlotKVCache:
-    """Per-layer [max_slots, max_len, kv_heads, head_dim] k/v buffers
-    plus the slot lease table."""
+def _validate_geometry(num_layers: int, max_slots: int, max_len: int,
+                       kv_heads: int, head_dim: int) -> None:
+    if num_layers < 1:
+        raise ValueError(f"num_layers must be >= 1, got {num_layers}")
+    if max_slots < 1:
+        raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+    if max_len < 1:
+        raise ValueError(f"max_len must be >= 1, got {max_len}")
+    if kv_heads < 1:
+        raise ValueError(f"kv_heads must be >= 1, got {kv_heads}")
+    if head_dim < 1:
+        raise ValueError(f"head_dim must be >= 1, got {head_dim}")
 
-    def __init__(self, num_layers: int, max_slots: int, max_len: int,
-                 kv_heads: int, head_dim: int, dtype):
-        if max_slots < 1:
-            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+
+class _SlotTable:
+    """Slot lease bookkeeping shared by both pool flavors: incremental
+    free/active sets instead of per-call O(max_slots) scans."""
+
+    def __init__(self, max_slots: int):
         self.max_slots = max_slots
-        self.max_len = max_len
-        shape = (max_slots, max_len, kv_heads, head_dim)
-        self.ks = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
-        self.vs = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
-        # lease table: slot -> request (None = free); requests carry
-        # their own position/length state
         self.slots: List[Optional[object]] = [None] * max_slots
+        self._free = set(range(max_slots))
+        self._active: set = set()
 
     def free_slots(self) -> List[int]:
-        return [i for i, r in enumerate(self.slots) if r is None]
+        return sorted(self._free)
 
     def active_slots(self) -> List[int]:
-        return [i for i, r in enumerate(self.slots) if r is not None]
+        return sorted(self._active)
 
     def assign(self, slot: int, req) -> None:
         if self.slots[slot] is not None:
             raise RuntimeError(f"slot {slot} is already leased")
         self.slots[slot] = req
+        self._free.discard(slot)
+        self._active.add(slot)
 
     def release(self, slot: int) -> None:
         if self.slots[slot] is None:
             raise RuntimeError(f"slot {slot} is already free")
         self.slots[slot] = None
+        self._active.discard(slot)
+        self._free.add(slot)
 
     @property
     def occupancy(self) -> float:
-        return len(self.active_slots()) / self.max_slots
+        return len(self._active) / self.max_slots
+
+    def kv_bytes(self) -> int:
+        """Total device bytes of the KV pools (+scales when paged) —
+        ONE accounting used by the kv_bytes gauge and the benchmark's
+        byte-budget comparison."""
+        pools = list(self.ks) + list(self.vs) \
+            + list(getattr(self, "kss", [])) \
+            + list(getattr(self, "vss", []))
+        return sum(p.size * p.dtype.itemsize for p in pools)
+
+
+class SlotKVCache(_SlotTable):
+    """Per-layer [max_slots, max_len, kv_heads, head_dim] k/v buffers
+    plus the slot lease table (the contiguous pool)."""
+
+    def __init__(self, num_layers: int, max_slots: int, max_len: int,
+                 kv_heads: int, head_dim: int, dtype):
+        _validate_geometry(num_layers, max_slots, max_len, kv_heads,
+                           head_dim)
+        super().__init__(max_slots)
+        self.max_len = max_len
+        shape = (max_slots, max_len, kv_heads, head_dim)
+        self.ks = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        self.vs = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+
+
+class _PrefixNode:
+    """One page of the prefix-sharing radix index: ``chunk`` is the
+    token content this page was prefilled with (a full page, except
+    that matching may use only a prefix of it), ``page`` the pool page
+    holding its k/v. The path from the root IS the key: a node's page
+    is only valid context-free given every ancestor matched first."""
+
+    __slots__ = ("chunk", "page", "parent", "children", "lru")
+
+    def __init__(self, chunk: Tuple[int, ...], page: int, parent):
+        self.chunk = chunk
+        self.page = page
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_PrefixNode"] = {}
+        self.lru = 0
+
+
+class PagedKVCache(_SlotTable):
+    """Block-paged KV pool with COW prefix sharing and optional int8
+    storage (see module docstring). ``num_pages`` INCLUDES the
+    reserved trash page 0."""
+
+    def __init__(self, num_layers: int, max_slots: int, max_len: int,
+                 kv_heads: int, head_dim: int, dtype,
+                 page_size: int = 128, num_pages: Optional[int] = None,
+                 quant: bool = False, prefix_sharing: bool = True):
+        _validate_geometry(num_layers, max_slots, max_len, kv_heads,
+                           head_dim)
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if max_len % page_size != 0:
+            raise ValueError(
+                f"max_len ({max_len}) must be a multiple of page_size "
+                f"({page_size}) so prefill buckets tile into pages")
+        self.max_len = max_len
+        self.page_size = page_size
+        self.pages_per_slot = max_len // page_size
+        if num_pages is None:
+            # capacity parity with the contiguous pool by default;
+            # benchmarks pass a smaller pool to oversubscribe
+            num_pages = max_slots * self.pages_per_slot + 1
+        if num_pages < self.pages_per_slot + 1:
+            raise ValueError(
+                f"num_pages ({num_pages}) must cover at least one "
+                f"full-length request plus the trash page "
+                f"({self.pages_per_slot + 1})")
+        super().__init__(max_slots)
+        self.num_pages = num_pages
+        self.quant = bool(quant)
+        self.prefix_sharing = bool(prefix_sharing)
+        self.dtype = dtype
+        shape = (num_pages, page_size, kv_heads, head_dim)
+        pool_dtype = jnp.int8 if self.quant else dtype
+        self.ks = [jnp.zeros(shape, pool_dtype)
+                   for _ in range(num_layers)]
+        self.vs = [jnp.zeros(shape, pool_dtype)
+                   for _ in range(num_layers)]
+        sshape = (num_pages, page_size, kv_heads)
+        self.kss = [jnp.zeros(sshape, jnp.float32)
+                    for _ in range(num_layers)] if self.quant else []
+        self.vss = [jnp.zeros(sshape, jnp.float32)
+                    for _ in range(num_layers)] if self.quant else []
+        # static shape: the one compiled decode program takes the whole
+        # table; rows of freed slots are zeroed (-> trash page)
+        self.page_table = np.zeros((max_slots, self.pages_per_slot),
+                                   np.int32)
+        self.refcnt = np.zeros((num_pages,), np.int64)
+        self.refcnt[0] = 1                     # trash page: pinned
+        self._free_pages = deque(range(1, num_pages))
+        self._plans: Dict[int, dict] = {}      # rid -> admission plan
+        self._committed = 0   # reserved-but-not-yet-allocated pages
+        self._cached = 0      # indexed pages at refcount 0 (O(1) —
+        #                       maintained on refcnt 0<->1 transitions)
+        self._root = _PrefixNode((), 0, None)
+        self._node_of_page: Dict[int, _PrefixNode] = {}
+        self._lru_tick = 0
+        # counters surfaced through engine gauges / the PAGED_KV line
+        self.cow_copies = 0
+        self.prefix_hit_tokens = 0
+        self.prefix_lookup_tokens = 0
+        self.pages_reclaimed = 0
+
+    # -- page accounting ----------------------------------------------
+    def page_span(self, total_len: int) -> int:
+        """Pages needed for a request whose prompt+output totals
+        ``total_len`` tokens: the last WRITE lands at position
+        total_len - 2 (the final sampled token's k/v is never
+        written)."""
+        return (max(0, total_len - 2)) // self.page_size + 1
+
+    def free_page_count(self) -> int:
+        return len(self._free_pages)
+
+    def cached_page_count(self) -> int:
+        """Index-owned pages no request references: reclaimable."""
+        return self._cached
+
+    def active_page_count(self) -> int:
+        return int((self.refcnt[1:] > 0).sum())
+
+    def usable_pages(self) -> int:
+        return self.free_page_count() + self.cached_page_count()
+
+    @property
+    def committed_pages(self) -> int:
+        return self._committed
+
+    # -- prefix index ---------------------------------------------------
+    def _touch(self, node: _PrefixNode) -> None:
+        self._lru_tick += 1
+        node.lru = self._lru_tick
+
+    def _match_prefix(self, ids: np.ndarray):
+        """Longest shared prefix of ``ids`` in the index. Matching
+        stops at ``len(ids) - 1``: the LAST prompt token is always
+        recomputed so the prefill has logits to sample from. Returns
+        (matched_len, [pages], deepest_node); a trailing partial match
+        (first divergent page) is allowed — its page gets COW'd by the
+        first write."""
+        matchable = ids[:-1]
+        P = self.page_size
+        node = self._root
+        pages: List[int] = []
+        m = 0
+        while m + P <= len(matchable):
+            child = node.children.get(tuple(int(t) for t in
+                                            matchable[m:m + P]))
+            if child is None:
+                break
+            node = child
+            self._touch(node)
+            pages.append(node.page)
+            m += P
+        # partial match into the first DIVERGENT page: the prompt may
+        # run out mid-page, or its content may diverge mid-page from
+        # every indexed chunk — either way the longest common prefix
+        # of the next page is shareable (COW privatizes it on the
+        # first write)
+        want = [int(t) for t in matchable[m:m + P]]
+        if want:
+            best, best_child = 0, None
+            for chunk, child in node.children.items():
+                common = 0
+                for a, b in zip(chunk, want):
+                    if a != b:
+                        break
+                    common += 1
+                if common > best:
+                    best, best_child = common, child
+            if best_child is not None:
+                self._touch(best_child)
+                pages.append(best_child.page)
+                m += best
+        # hit/lookup counters are bumped by try_reserve only when the
+        # reservation COMMITS — a blocked queue head is re-claimed
+        # every step and must not inflate the prefix-hit-rate artifact
+        return m, pages, node
+
+    def register_prefix(self, slot: int, ids: np.ndarray) -> None:
+        """Index every FULL page of ``ids`` (just prefilled into
+        ``slot``) so later prompts can reference them. Indexed pages
+        become immutable — but the owning request only writes at
+        positions >= len(ids), past every full page, so it never COWs
+        its own registration."""
+        if not self.prefix_sharing:
+            return
+        P = self.page_size
+        node = self._root
+        row = self.page_table[slot]
+        for i in range(int(len(ids)) // P):
+            chunk = tuple(int(t) for t in ids[i * P:(i + 1) * P])
+            child = node.children.get(chunk)
+            if child is None:
+                page = int(row[i])
+                if page == 0 or page in self._node_of_page:
+                    # defensive: never re-own a page (or index the
+                    # trash page) — stop registering deeper instead
+                    break
+                child = _PrefixNode(chunk, page, node)
+                node.children[chunk] = child
+                self._node_of_page[page] = child
+            node = child
+            self._touch(node)
+
+    def _reclaim_one(self) -> bool:
+        """Free at least one cached page: drop the LRU refcount-0
+        indexed subtree (descendants lose their index entry; their
+        pages free now if unreferenced, or on release otherwise).
+        The victim itself is refcount-0, so one pass always frees at
+        least the victim's page."""
+        candidates = [n for n in self._node_of_page.values()
+                      if self.refcnt[n.page] == 0]
+        if not candidates:
+            return False
+        victim = min(candidates, key=lambda n: n.lru)
+        victim.parent.children.pop(victim.chunk, None)
+        stack = [victim]
+        while stack:
+            nd = stack.pop()
+            self._node_of_page.pop(nd.page, None)
+            if self.refcnt[nd.page] == 0:
+                self._cached -= 1           # cached -> free
+                self._free_pages.append(nd.page)
+                self.pages_reclaimed += 1
+            stack.extend(nd.children.values())
+            nd.children = {}
+        return True
+
+    # -- allocation / reservation ---------------------------------------
+    def _alloc_page(self, plan: Optional[dict]) -> int:
+        if not self._free_pages and not self._reclaim_one():
+            raise RuntimeError(
+                "KV page pool exhausted — admission reservation "
+                "should have prevented this (pages "
+                f"{self.num_pages}, committed {self._committed})")
+        page = int(self._free_pages.popleft())
+        self.refcnt[page] = 1
+        if plan is not None:
+            plan["allocated"] += 1
+            self._committed -= 1
+        return page
+
+    def _ref(self, page: int) -> None:
+        self.refcnt[page] += 1
+        if self.refcnt[page] == 1 and page in self._node_of_page:
+            self._cached -= 1               # pinned: not reclaimable
+        # a refcount-0 NON-indexed page is on the free list and must
+        # never be pinned directly — only _alloc_page hands those out
+
+    def _unref(self, page: int) -> None:
+        self.refcnt[page] -= 1
+        if self.refcnt[page] < 0:
+            raise RuntimeError(f"page {page} refcount underflow")
+        if self.refcnt[page] == 0:
+            if page in self._node_of_page:
+                self._cached += 1           # parked in the index
+            else:
+                self._free_pages.append(page)
+
+    def try_reserve(self, req, ids: np.ndarray,
+                    total_len: int) -> bool:
+        """Admission gate: match the prompt against the prefix index,
+        pin the matched pages, and reserve the worst-case number of
+        NEW pages this request can touch (its full span minus fully
+        shared pages; a partially shared page counts as new — its COW
+        copy needs a page). False = does not fit right now (the
+        matched pages are unpinned again)."""
+        if req.rid in self._plans:
+            raise RuntimeError(
+                f"request {req.rid} already holds a reservation")
+        budget = self.usable_pages() - self._committed
+        # cheap precheck before the O(prompt) radix match: even a
+        # FULLY shared prompt still needs span - full_prompt_pages new
+        # pages — a blocked FCFS head is re-claimed every step and
+        # must not pay the match just to learn it still does not fit
+        if self.page_span(total_len) \
+                - (max(0, int(len(ids)) - 1)) // self.page_size \
+                > budget:
+            return False
+        if self.prefix_sharing:
+            matched, pages, _ = self._match_prefix(ids)
+        else:
+            matched, pages = 0, []
+        for p in pages:
+            self._ref(p)
+        need_new = self.page_span(total_len) \
+            - matched // self.page_size
+        # strict check AFTER pinning: matched cached pages are no
+        # longer reclaimable, so they cannot back the new allocations
+        if need_new > self.usable_pages() - self._committed:
+            for p in pages:
+                self._unref(p)
+            return False
+        self._committed += need_new
+        lookup = max(0, int(len(ids)) - 1) if self.prefix_sharing \
+            else 0
+        self.prefix_lookup_tokens += lookup
+        self.prefix_hit_tokens += matched
+        self._plans[req.rid] = {
+            "state": "reserved", "matched": matched,
+            "pages": list(pages), "need_new": need_new,
+            "allocated": 0, "slot": None,
+            "total_len": int(total_len),
+            # what this plan added to the hit/lookup counters — rolled
+            # back if the reservation is cancelled or the prefill
+            # aborts, so a requeued request counts exactly ONCE
+            "hit_counted": matched, "lookup_counted": lookup,
+        }
+        return True
+
+    def refresh_reservation(self, req, ids: np.ndarray) -> None:
+        """Re-match a still-unconsumed reservation against the index
+        right before prefill: requests admitted in the SAME wave claim
+        before any of them has prefilled, so the head of the wave
+        registers pages the rest can only see now. A longer match
+        strictly shrinks the reservation (never grows it), so this is
+        always safe; the freed budget returns immediately."""
+        plan = self._plans.get(req.rid)
+        if plan is None or plan["state"] != "reserved" \
+                or not self.prefix_sharing:
+            return
+        matched, pages, _ = self._match_prefix(ids)
+        if matched <= plan["matched"]:
+            return
+        for p in pages:
+            self._ref(p)
+        for p in plan["pages"]:
+            self._unref(p)
+        need_new = self.page_span(plan["total_len"]) \
+            - matched // self.page_size
+        self._committed += need_new - plan["need_new"]
+        self.prefix_hit_tokens += matched - plan["matched"]
+        plan["hit_counted"] += matched - plan["matched"]
+        plan.update(matched=matched, pages=list(pages),
+                    need_new=need_new)
+
+    def cancel_reservation(self, req) -> None:
+        """Drop an unconsumed reservation (failed admission batch:
+        the request goes back to the queue). No-op once the request
+        holds pages in a slot — use release()/abort for that."""
+        plan = self._plans.get(req.rid)
+        if plan is None or plan["state"] != "reserved":
+            return
+        for p in plan["pages"]:
+            self._unref(p)
+        self._committed -= plan["need_new"]
+        self.prefix_hit_tokens -= plan["hit_counted"]
+        self.prefix_lookup_tokens -= plan["lookup_counted"]
+        del self._plans[req.rid]
+
+    # -- sequence lifecycle ---------------------------------------------
+    def begin_sequence(self, slot: int, req,
+                      ids: np.ndarray) -> Tuple[int, List[Tuple[int, int]]]:
+        """Consume the request's reservation into slot state: point the
+        page table at the matched shared pages, COW the partially
+        shared page (if any), and allocate fresh pages for the
+        prefill tail. Returns (matched_len, [(src, dst) page copies
+        the engine must run on device BEFORE the prefill program])."""
+        plan = self._plans[req.rid]
+        if plan["state"] != "reserved":
+            raise RuntimeError(
+                f"request {req.rid} reservation in state "
+                f"{plan['state']!r}")
+        P = self.page_size
+        n = int(len(ids))
+        m = plan["matched"]
+        # flip to active FIRST: if an allocation below fails mid-way,
+        # abort_sequence()'s row walk unwinds exactly what was placed
+        plan["state"] = "active"
+        plan["slot"] = slot
+        row = self.page_table[slot]
+        row[:] = 0
+        for j, p in enumerate(plan["pages"]):
+            row[j] = p
+        copies: List[Tuple[int, int]] = []
+        first_new = m // P
+        if m % P:
+            # mid-page divergence: the first tail write lands inside
+            # the shared page — copy it first (COW)
+            src = int(row[first_new])
+            dst = self._alloc_page(plan)
+            copies.append((src, dst))
+            row[first_new] = dst
+            self._unref(src)
+            self.cow_copies += 1
+            first_new += 1
+        for j in range(first_new, (n - 1) // P + 1):
+            row[j] = self._alloc_page(plan)
+        return m, copies
+
+    def ensure_decode_page(self, slot: int, pos: int) \
+            -> Optional[Tuple[int, int]]:
+        """Make position ``pos`` writable for this step's decode:
+        allocate the page when the write crosses a page boundary, COW
+        it if it is shared (defensive — prefill-time COW should have
+        privatized every page a request decodes into). Returns a
+        (src, dst) device copy to run before the step, or None."""
+        idx = pos // self.page_size
+        row = self.page_table[slot]
+        req = self.slots[slot]
+        plan = self._plans.get(req.rid) if req is not None else None
+        page = int(row[idx])
+        if page == 0:
+            row[idx] = self._alloc_page(plan)
+            return None
+        if self.refcnt[page] > 1 or page in self._node_of_page:
+            dst = self._alloc_page(plan)
+            row[idx] = dst
+            self._unref(page)
+            self.cow_copies += 1
+            return (page, dst)
+        return None
+
+    def release(self, slot: int) -> None:
+        """Free the slot lease AND its pages: every referenced page
+        drops a refcount (shared pages stay for their other readers;
+        index-owned pages stay CACHED at refcount 0), the unused tail
+        of the admission reservation returns to the budget, and the
+        table row is zeroed (-> trash) so a stale row can never reach
+        the decode gather."""
+        req = self.slots[slot]
+        super().release(slot)
+        row = self.page_table[slot]
+        for j in range(self.pages_per_slot):
+            if row[j]:
+                self._unref(int(row[j]))
+        row[:] = 0
+        plan = self._plans.pop(req.rid, None)
+        if plan is not None:
+            self._committed -= plan["need_new"] - plan["allocated"]
+
+    def abort_sequence(self, slot: int, req) -> None:
+        """Unwind a failed prefill: pages held by the slot row (and the
+        reservation remainder) are returned. The slot LEASE (if held —
+        recover() assigns before re-prefilling) is deliberately left
+        alone: a retried recover() rebuilds from the slot table and
+        must still find the request there."""
+        plan = self._plans.pop(req.rid, None)
+        row = self.page_table[slot]
+        if plan is not None and plan["state"] == "active":
+            for j in range(self.pages_per_slot):
+                if row[j]:
+                    self._unref(int(row[j]))
+            row[:] = 0
+        elif plan is not None:              # still just a reservation
+            for p in plan["pages"]:
+                self._unref(p)
+        if plan is not None:
+            self._committed -= plan["need_new"] - plan["allocated"]
+            # the requeued request will reserve (and count) again
+            self.prefix_hit_tokens -= plan["hit_counted"]
+            self.prefix_lookup_tokens -= plan["lookup_counted"]
+
+    # -- introspection --------------------------------------------------
+    def stats(self) -> Dict[str, float]:
+        return {
+            "num_pages": self.num_pages - 1,     # usable (sans trash)
+            "page_size": self.page_size,
+            "pages_free": self.free_page_count(),
+            "pages_active": self.active_page_count(),
+            "pages_cached": self.cached_page_count(),
+            "pages_committed": self._committed,
+            "cow_copies": self.cow_copies,
+            "pages_reclaimed": self.pages_reclaimed,
+            "prefix_hit_tokens": self.prefix_hit_tokens,
+            "prefix_lookup_tokens": self.prefix_lookup_tokens,
+            "kv_bytes": self.kv_bytes(),
+        }

@@ -22,10 +22,25 @@ SERVING_SUMMARY_KEYS = {
 }
 
 
+# the PAGED_KV line (bench_serving_engine --prefix-share) is the
+# artifact the paged-KV acceptance keys on: schema stable, gains over
+# the contiguous pool asserted at the ISSUE-6 bars (>= 4x paged,
+# >= 10x with int8 + shared prefixes)
+PAGED_KV_KEYS = {
+    "budget_bytes", "page_size", "num_pages",
+    "peak_concurrency_contiguous", "peak_concurrency_paged",
+    "peak_concurrency_paged_int8", "concurrency_gain",
+    "concurrency_gain_int8", "prefix_hit_rate", "pages_per_token",
+    "cow_copies", "int8_greedy_agreement", "tokens_per_s_paged",
+    "tokens_per_s_contiguous", "decode_compiles",
+}
+
+
 @pytest.mark.parametrize("script", [
     "bench_resnet50.py", "bench_bert_dp.py", "bench_gpt_hybrid.py",
     "bench_ernie_zero3.py", "bench_ppyoloe_infer.py",
     "bench_llama_decode.py", "bench_serving_engine.py",
+    "bench_serving_engine.py --prefix-share",
     "chaos_soak.py",
 ])
 def test_benchmark_script_smoke(script, tmp_path):
@@ -39,8 +54,10 @@ def test_benchmark_script_smoke(script, tmp_path):
         env["PTPU_PROM_OUT"] = str(prom_path)
     if script == "chaos_soak.py":
         env["PTPU_CHAOS_EPISODES"] = "6"    # smoke budget
+    argv = script.split()
     r = subprocess.run(
-        [sys.executable, os.path.join(HERE, "benchmarks", script)],
+        [sys.executable, os.path.join(HERE, "benchmarks", argv[0])]
+        + argv[1:],
         capture_output=True, text=True, timeout=900, env=env)
     assert r.returncode == 0, r.stderr[-2000:]
     lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
@@ -67,6 +84,18 @@ def test_benchmark_script_smoke(script, tmp_path):
         prom = prom_path.read_text()
         assert "# TYPE ptpu_serving_ttft_seconds histogram" in prom
         assert "ptpu_serving_requests_total" in prom
+    if script == "bench_serving_engine.py --prefix-share":
+        plines = [l for l in r.stdout.splitlines()
+                  if l.startswith("PAGED_KV ")]
+        assert plines, r.stdout
+        pk = json.loads(plines[-1][len("PAGED_KV "):])
+        assert PAGED_KV_KEYS <= set(pk), sorted(pk)
+        # ISSUE-6 acceptance bars, deterministic on the burst trace
+        assert pk["concurrency_gain"] >= 4.0, pk
+        assert pk["concurrency_gain_int8"] >= 10.0, pk
+        assert pk["decode_compiles"] == 1, pk
+        assert pk["prefix_hit_rate"] > 0.5, pk
+        assert pk["int8_greedy_agreement"] >= 0.9, pk
     if script == "chaos_soak.py":
         # the soak summary line is the artifact the CI budgeted run
         # keys on: every episode green, schema stable

@@ -188,8 +188,10 @@ def test_open_ended_soak(tmp_path):
 # seed's fault schedule drives the ledger red (the bug class is
 # DETECTED), while the fixed code stays green on the same seed.
 
-PINNED_SEED_BUG_A = 6       # deadline expiry in the step a decode
+PINNED_SEED_BUG_A = 3       # deadline expiry in the step a decode
 PINNED_SEED_BUG_B = 7       # fault lands in / fault mid-drain
+# (re-pinned for the paged engine's episode flow — the paged-prefill
+# fault arm and page-budget sampling shifted every seed's schedule)
 
 
 def test_pinned_seed_catches_lost_finished_on_failed_step(monkeypatch):
@@ -214,6 +216,28 @@ def test_pinned_seed_catches_lost_finished_on_failed_step(monkeypatch):
     assert any("LOST" in v for v in red.violations), red.violations
     monkeypatch.setattr(ServingEngine, "step", orig_step)
     green = chaos.run_serving_episode(PINNED_SEED_BUG_A)
+    assert green.ok, "\n".join(green.violations)
+
+
+PINNED_SEED_PAGE_LEAK = 4   # paged-prefill fault mid-admission
+
+
+def test_pinned_seed_catches_leaked_pages_on_aborted_prefill(
+        monkeypatch):
+    """No-leaked-pages law (paged KV): a prefill that faults AFTER
+    claiming pages must unwind them (abort_sequence). With the unwind
+    disabled, the pinned seed's mid-prefill fault strands refcounts
+    and the page-leak audit goes red; the real code stays green."""
+    from paddle_tpu.serving.slot_cache import PagedKVCache
+    orig = PagedKVCache.abort_sequence
+    monkeypatch.setattr(PagedKVCache, "abort_sequence",
+                        lambda self, slot, req: None)
+    red = chaos.run_serving_episode(PINNED_SEED_PAGE_LEAK)
+    assert not red.ok
+    assert any("leaked page" in v or "reservation" in v
+               for v in red.violations), red.violations
+    monkeypatch.setattr(PagedKVCache, "abort_sequence", orig)
+    green = chaos.run_serving_episode(PINNED_SEED_PAGE_LEAK)
     assert green.ok, "\n".join(green.violations)
 
 
