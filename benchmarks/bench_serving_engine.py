@@ -353,6 +353,191 @@ def run_prefix_share(model, max_len, min_bucket, page_size, sys_lens,
     }))
 
 
+def run_frontdoor_slo(model, *, n_replicas, slots, max_len, min_bucket,
+                      n_clients, total_requests, max_new, seed=0):
+    """--frontdoor: closed-loop load test against the production front
+    door (FrontDoor over a ReplicaRouter): ``n_clients`` closed-loop
+    clients (submit -> stream -> think -> resubmit) sustain load while
+    a replica is KILLED mid-run and a rate-limited noisy tenant hammers
+    admission. Runs on the virtual clock (arrivals/think times virtual,
+    compute measured wall), so QPS and TTFT come out in units of the
+    MEASURED decode-step wall — machine-independent SLO bars. The
+    conservation ledger is mounted at the front door: the run fails if
+    any request is lost or double-delivered through the failover."""
+    from paddle_tpu.observability import FlightRecorder, MetricRegistry
+    from paddle_tpu.resilience.invariants import ConservationLedger
+    from paddle_tpu.serving import (ClientStream, FrontDoor,
+                                    ReplicaRouter, ServingEngine,
+                                    ServingError, TenantPolicy)
+
+    rng = np.random.RandomState(seed)
+    clock = {"t": 0.0}
+    ledger = ConservationLedger()
+    engines = [ServingEngine(model, max_slots=slots, max_len=max_len,
+                             min_bucket=min_bucket,
+                             time_fn=lambda: clock["t"],
+                             registry=MetricRegistry(),
+                             flight_recorder=FlightRecorder(capacity=8))
+               for _ in range(n_replicas)]
+    router = ReplicaRouter(engines, registry=MetricRegistry())
+    front = FrontDoor(
+        router, auditor=ledger, time_fn=lambda: clock["t"],
+        registry=MetricRegistry(),
+        tenants={"noisy": TenantPolicy(rate_qps=2.0, burst=2,
+                                       max_inflight=1)})
+
+    class TimedStream(ClientStream):
+        def __init__(self):
+            super().__init__()
+            self.t_first = None
+
+        def write(self, event):
+            if event.get("event") == "token" and self.t_first is None:
+                self.t_first = clock["t"]
+            super().write(event)
+
+    prompt_lens = [4, 7, 12, 20]
+    prompts = [rng.randint(1, 100, (L,)).astype(np.int64)
+               for L in prompt_lens]
+
+    # warm every replica's programs (round-robin via least-loaded
+    # dispatch), then calibrate the per-pump step wall under full load
+    for _ in range(2 * n_replicas):
+        for p in prompts:
+            front.submit(p, 2, tenant="warm")
+    while front.has_work():
+        front.pump()
+    for _ in range(n_clients):
+        front.submit(prompts[0], max_new, tenant="warm")
+    w0, n_steps = time.perf_counter(), 0
+    while front.has_work():
+        front.pump()
+        n_steps += 1
+    step_wall = (time.perf_counter() - w0) / max(1, n_steps)
+
+    # closed loop
+    t_submit, t_done, misses, rejected = {}, {}, 0, 0
+    streams = {}
+    idle_until = {c: 0.0 for c in range(n_clients)}
+    handles = {}
+    completed = 0
+    submitted = 0
+    kill_at = total_requests // 3
+    killed = False
+    t_loop0, n_pumps = clock["t"], 0
+    # iteration bound (chaos-episode discipline): a conservation bug
+    # that strands a request must fail HERE with the ledger printed,
+    # not spin until the CI subprocess timeout eats the diagnostic
+    max_iters = 400 * total_requests
+    iters = 0
+    while completed < total_requests:
+        iters += 1
+        if iters > max_iters:
+            for v in ledger.violations():
+                print("  - " + v, file=sys.stderr)
+            raise SystemExit(
+                f"front-door SLO run stalled: {completed}/"
+                f"{total_requests} after {max_iters} iterations "
+                f"(has_work={front.has_work()})")
+        for c in range(n_clients):
+            if c in handles or clock["t"] < idle_until[c] \
+                    or submitted >= total_requests:
+                continue
+            st = TimedStream()
+            dl = (max_new + 40.0) * 10.0 * step_wall \
+                if rng.random() < 0.3 else None
+            h = front.submit(
+                prompts[int(rng.randint(0, len(prompts)))], max_new,
+                tenant="bench", deadline_s=dl, stream=st)
+            handles[c] = h
+            streams[h.req.rid] = st
+            t_submit[h.req.rid] = clock["t"]
+            submitted += 1
+        # noisy neighbor: hammers a rate-limited tenant every
+        # iteration; its typed rejections must not dent the SLO
+        try:
+            front.submit(prompts[0], 1, tenant="noisy")
+        except (ServingError, ValueError):
+            rejected += 1
+        if not killed and completed >= kill_at:
+            router.replicas[0].kill()
+            killed = True
+        w0 = time.perf_counter()
+        front.pump()
+        clock["t"] += time.perf_counter() - w0
+        n_pumps += 1
+        for c, h in list(handles.items()):
+            if h.finished:
+                del handles[c]
+                rid = h.req.rid
+                t_done[rid] = clock["t"]
+                if h.req.finish_reason == "deadline":
+                    misses += 1
+                completed += 1
+                idle_until[c] = clock["t"] \
+                    + float(rng.exponential(2.0 * step_wall))
+    front.drain()
+
+    ttfts = [streams[r].t_first - t_submit[r] for r in t_done
+             if streams[r].t_first is not None]
+    wall = max(t_done.values()) - min(t_submit.values())
+    qps = completed / wall if wall > 0 else 0.0
+    p99_ttft = float(np.percentile(ttfts, 99)) if ttfts else 0.0
+    # SLO bars in units of the step wall measured DURING the loaded
+    # phase (not the quiet warmup calibration): TTFT numerator and
+    # step-wall denominator then inflate together under CPU
+    # contention, so the bar is a scheduling property of the front
+    # door (how many pump-steps did a client wait), not a machine-
+    # speed one. A closed-loop client waits O(n_clients/replicas)
+    # steps for a slot plus a prefill; x4 headroom covers the
+    # one-replica-down phase of the run.
+    step_wall = (clock["t"] - t_loop0) / max(1, n_pumps)
+    ttft_slo = step_wall * (4.0 * n_clients / max(1, n_replicas - 1)
+                            + 8.0)
+    miss_rate = misses / max(1, completed)
+    viol = ledger.violations()
+    lost = sum("LOST" in v for v in viol)
+    dups = sum("DELIVERED" in v for v in viol)
+    summary = {
+        "replicas": n_replicas,
+        "clients": n_clients,
+        "requests": total_requests,
+        "completed": completed,
+        "rejected_noisy": rejected,
+        "qps": round(qps, 2),
+        "p99_ttft_s": round(p99_ttft, 5),
+        "ttft_slo_s": round(ttft_slo, 5),
+        "p99_ttft_steps": round(p99_ttft / step_wall, 2)
+        if step_wall else 0.0,
+        "slo_ok": bool(p99_ttft <= ttft_slo),
+        "deadline_miss_rate": round(miss_rate, 4),
+        "failovers": int(router._m_failover.value),
+        "failover_requests": int(router._m_failover_req.value),
+        "lost": int(lost),
+        "duplicates": int(dups),
+        "ledger_green": not viol,
+        "step_wall_ms": round(step_wall * 1e3, 3),
+    }
+    print(json.dumps({
+        "metric": (
+            f"front-door closed-loop SLO: {completed} requests from "
+            f"{n_clients} clients over {n_replicas} replicas (1 "
+            f"KILLED mid-run, {summary['failover_requests']} requests "
+            f"failed over; noisy tenant rejected {rejected}x), p99 "
+            f"TTFT {summary['p99_ttft_steps']} step-walls vs SLO "
+            f"{round(ttft_slo / step_wall, 1)}, deadline miss rate "
+            f"{miss_rate:.3f}, exactly-once ledger "
+            f"{'GREEN' if not viol else 'RED'}; baseline=SLO bar)"),
+        "value": round(qps, 2),
+        "unit": "req/s",
+        "vs_baseline": round(1.0 / ttft_slo if ttft_slo else 0.0, 2)}))
+    print("SERVING_SLO " + json.dumps(summary))
+    if viol:
+        for v in viol:
+            print("  - " + v, file=sys.stderr)
+        raise SystemExit("front-door SLO run lost conservation")
+
+
 def main():
     import jax
     import paddle_tpu as paddle
@@ -390,6 +575,19 @@ def main():
                              page_size=8, sys_lens=(40, 40),
                              n_req=60, suffix_len=2, max_new=4,
                              contig_slots=4)
+        return
+
+    if "--frontdoor" in sys.argv:
+        if on_tpu:
+            run_frontdoor_slo(model, n_replicas=2, slots=16,
+                              max_len=512, min_bucket=32,
+                              n_clients=48, total_requests=192,
+                              max_new=32)
+        else:
+            run_frontdoor_slo(model, n_replicas=2, slots=4,
+                              max_len=64, min_bucket=8,
+                              n_clients=10, total_requests=36,
+                              max_new=6)
         return
 
     rng = np.random.RandomState(0)

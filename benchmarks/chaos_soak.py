@@ -53,7 +53,7 @@ def main():
         while len(results) < opts.episodes:
             if opts.seconds and time.time() - t0 > opts.seconds:
                 break
-            kind = "serving" if seed % 2 == 0 else "training"
+            kind = ("serving", "training", "frontdoor")[seed % 3]
             r = chaos.run_episode(seed, kind, workdir=workdir)
             results.append(r)
             for p, n in r.fired.items():
@@ -72,11 +72,13 @@ def main():
     wall = time.time() - t0
     red = [r for r in results if not r.ok]
     n_serving = sum(1 for r in results if r.kind == "serving")
+    n_front = sum(1 for r in results if r.kind == "frontdoor")
     summary = {
         "episodes": len(results),
         "green": len(results) - len(red),
         "serving_episodes": n_serving,
-        "training_episodes": len(results) - n_serving,
+        "frontdoor_episodes": n_front,
+        "training_episodes": len(results) - n_serving - n_front,
         "seed_range": [opts.seed_base, seed - 1],
         "red_seeds": [{"seed": r.seed, "kind": r.kind,
                        "violations": r.violations} for r in red],
@@ -91,8 +93,8 @@ def main():
         "metric": (
             f"chaos soak: {summary['green']}/{summary['episodes']} "
             f"episodes green (seeds {opts.seed_base}..{seed - 1}, "
-            f"{n_serving} serving + "
-            f"{summary['training_episodes']} training, "
+            f"{n_serving} serving + {n_front} front-door/replica-kill"
+            f" + {summary['training_episodes']} training, "
             f"{sum(fired.values())} faults fired over "
             f"{len(fired)} points, {summary['recoveries']} "
             f"recoveries, {summary['relaunches']} relaunches; every "

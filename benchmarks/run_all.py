@@ -20,6 +20,9 @@ SCRIPTS = ["bench_resnet50.py", "bench_bert_dp.py", "bench_gpt_hybrid.py",
            "bench_llama_decode.py", "bench_serving_engine.py",
            # paged-KV concurrency under a shared byte budget
            "bench_serving_engine.py --prefix-share",
+           # front-door closed-loop SLO (replica killed mid-run,
+           # exactly-once ledger at the boundary)
+           "bench_serving_engine.py --frontdoor",
            # budget via PTPU_CHAOS_EPISODES / PTPU_CHAOS_SECONDS
            "chaos_soak.py"]
 

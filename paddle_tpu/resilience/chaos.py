@@ -49,19 +49,27 @@ import numpy as np
 
 from . import faults
 from .invariants import (ConservationLedger, checkpoint_monotonic_violations,
-                         engine_leak_violations, loss_trajectory_violations,
+                         engine_leak_violations, frontdoor_leak_violations,
+                         loss_trajectory_violations,
                          page_leak_violations, pending_save_violations,
+                         router_leak_violations,
                          thread_leak_violations, token_prefix_violations)
 
 __all__ = ["FaultArm", "EpisodeResult", "ChaosStore",
-           "SERVING_SWEEP", "TRAINING_SWEEP",
+           "SERVING_SWEEP", "TRAINING_SWEEP", "FRONTDOOR_SWEEP",
            "run_serving_episode", "run_training_episode",
-           "run_episode"]
+           "run_frontdoor_episode", "run_episode"]
 
 # the sweep partition: every KNOWN point is sampled by exactly one
-# episode kind (tests assert the union covers the whole catalogue)
+# episode kind (tests assert the union covers the whole catalogue).
+# Front-door episodes ALSO sample the serving points (the full stack
+# includes the engines), but coverage of those is owned by the
+# serving sweep.
 SERVING_SWEEP = ("serving.step.decode", "serving.step.prefill",
                  "serving.prefill.paged")
+FRONTDOOR_SWEEP = ("router.dispatch", "router.health_probe",
+                   "frontdoor.stream_write",
+                   "frontdoor.client_disconnect")
 TRAINING_SWEEP = ("train.step", "io.dataloader.worker",
                   "checkpoint.shard_write", "checkpoint.commit",
                   "watchdog.beat",
@@ -344,6 +352,220 @@ def _serving_result(seed, violations, schedule, ledger, submitted,
 
 
 # ---------------------------------------------------------------------------
+# front-door episodes: replica kills through the full client stack
+# ---------------------------------------------------------------------------
+
+def run_frontdoor_episode(seed: int, max_iters: int = 300) \
+        -> EpisodeResult:
+    """One seeded FRONT-DOOR episode: Poisson client arrivals (token
+    streams, tenants with sampled rate limits / in-flight caps,
+    deadlines, explicit cancels and disconnects) through a
+    :class:`~paddle_tpu.serving.frontdoor.FrontDoor` over a
+    :class:`~paddle_tpu.serving.router.ReplicaRouter` of 2–3 engine
+    replicas — under decode/prefill faults on the replicas,
+    dispatch/probe/stream faults on the router and front door, and
+    WHOLE-REPLICA KILLS: flag kills between steps and mid-step kills
+    (a :class:`ReplicaDead` raised from inside a prefill or decode, so
+    death lands mid-prefill and mid-stream). The conservation ledger
+    is mounted at the front door, so exactly-once delivery and the
+    admission (attempt = accept|reject) law are audited END-TO-END
+    through the router, plus token identity vs the uninjected replay,
+    stream consistency (what each connected client saw matches the
+    request's terminal state), and router/front-door/page leaks."""
+    from ..observability import FlightRecorder, MetricRegistry
+    from ..serving import (FrontDoor, ClientStream, ReplicaDead,
+                           ReplicaRouter, ServingEngine, ServingError,
+                           TenantPolicy)
+
+    model = _serving_model()
+    refs = _reference_outputs()
+    pool = _prompt_pool()
+    faults.clear()
+    faults.reset_counts()
+    rng = np.random.RandomState(seed)
+    ledger = ConservationLedger()
+    clock = {"t": 0.0}
+    n_replicas = int(rng.randint(2, 4))
+    engines = []
+    for _ in range(n_replicas):
+        max_slots = int(rng.randint(1, 3))
+        num_pages = int(rng.randint(_MAX_LEN // 8 + 1,
+                                    max_slots * (_MAX_LEN // 8) + 2))
+        eng = ServingEngine(model, max_slots=max_slots,
+                            max_len=_MAX_LEN, min_bucket=_MIN_BUCKET,
+                            page_size=8, num_pages=num_pages,
+                            time_fn=lambda: clock["t"],
+                            registry=MetricRegistry(),
+                            flight_recorder=FlightRecorder(capacity=8))
+        if rng.randint(0, 2):           # TPU-like donated pools
+            eng._donate = lambda: (5, 6)
+        engines.append(eng)
+    router = ReplicaRouter(engines, registry=MetricRegistry(),
+                           flight_recorder=FlightRecorder(capacity=8))
+    tenants = {}
+    if rng.random() < 0.5:
+        # one throttled tenant so typed rejections flow through the
+        # admission side of the ledger
+        tenants["b"] = TenantPolicy(
+            rate_qps=float(rng.randint(1, 4)) / 4.0, burst=2,
+            max_inflight=int(rng.randint(1, 4)))
+    front = FrontDoor(router, auditor=ledger,
+                      time_fn=lambda: clock["t"],
+                      registry=MetricRegistry(),
+                      flight_recorder=FlightRecorder(capacity=8),
+                      tenants=tenants)
+
+    n_req = int(rng.randint(4, 9))
+    plan = []      # (arrival_t, pool_idx, max_new, deadline, tenant)
+    t = 0.0
+    for _ in range(n_req):
+        t += float(rng.exponential(1.5))
+        max_new = 1 if rng.random() < 0.2 \
+            else int(rng.randint(2, _REF_HORIZON + 1))
+        plan.append((t, int(rng.randint(0, len(pool))), max_new,
+                     float(rng.randint(2, 18))
+                     if rng.random() < 0.35 else None,
+                     "b" if (tenants and rng.random() < 0.4) else "a"))
+    cancels = []              # (submit order, loop iteration)
+    if rng.random() < 0.3:
+        cancels.append((int(rng.randint(0, n_req)),
+                        int(rng.randint(1, 12))))
+    disconnects = []          # explicit socket-gone (submit order, it)
+    if rng.random() < 0.4:
+        disconnects.append((int(rng.randint(0, n_req)),
+                            int(rng.randint(1, 12))))
+    # replica kills: flag kills between iterations, and mid-step kills
+    # (ReplicaDead raised from INSIDE a replica's prefill/decode — the
+    # mid-prefill / mid-stream death regime)
+    kills = []                # (iteration, replica index)
+    if rng.random() < 0.7:
+        kills.append((int(rng.randint(2, 12)),
+                      int(rng.randint(0, n_replicas))))
+    if n_replicas > 2 and rng.random() < 0.25:
+        kills.append((int(rng.randint(6, 16)),
+                      int(rng.randint(0, n_replicas))))
+    mid_kill = None
+    if rng.random() < 0.5:
+        point = ("serving.step.decode", "serving.step.prefill",
+                 "serving.prefill.paged")[int(rng.randint(0, 3))]
+        mid_kill = FaultArm(point, times=1,
+                            after=int(rng.randint(0, 10)))
+    schedule = _sample_arms(rng, [
+        ("serving.step.decode", 0.4, (1, 3), (0, 8)),
+        ("serving.step.prefill", 0.35, (1, 3), (0, 8)),
+        ("serving.prefill.paged", 0.3, (1, 3), (0, 8)),
+        ("router.dispatch", 0.35, (1, 2), (0, 6)),
+        ("router.health_probe", 0.4, (1, 3), (0, 12)),
+        ("frontdoor.stream_write", 0.4, (1, 3), (0, 10)),
+        ("frontdoor.client_disconnect", 0.4, (1, 2), (0, 20)),
+    ])
+    for arm in schedule:
+        arm.arm()
+    if mid_kill is not None:
+        faults.inject(mid_kill.point, times=mid_kill.times,
+                      after=mid_kill.after, exc=ReplicaDead)
+        schedule = schedule + [mid_kill]
+    shutdown_iter = int(rng.randint(2, 12)) \
+        if rng.random() < 0.4 else None
+
+    violations: List[str] = []
+    submitted = []            # (handle, pool idx)
+    rejected = 0
+
+    def _submit(pi, mn, dl, tenant):
+        nonlocal rejected
+        try:
+            submitted.append(
+                (front.submit(pool[pi], mn, tenant=tenant,
+                              deadline_s=dl, stream=ClientStream()),
+                 pi))
+        except (ServingError, ValueError, faults.InjectedFault):
+            rejected += 1     # typed refusal: audited via on_rejected
+
+    i = 0
+    iters = 0
+    try:
+        while i < len(plan) or front.has_work():
+            iters += 1
+            if iters > max_iters:
+                violations.append(
+                    f"episode did not quiesce within {max_iters} "
+                    f"iterations")
+                break
+            if shutdown_iter is not None and iters >= shutdown_iter:
+                while i < len(plan):
+                    _, pi, mn, dl, tn = plan[i]
+                    _submit(pi, mn, dl, tn)
+                    i += 1
+                break
+            clock["t"] += 1.0
+            for at_iter, ridx in kills:
+                if at_iter == iters:
+                    router.replicas[ridx].kill()
+            while i < len(plan) and plan[i][0] <= clock["t"]:
+                _, pi, mn, dl, tn = plan[i]
+                _submit(pi, mn, dl, tn)
+                i += 1
+            for order, at_iter in cancels:
+                if at_iter == iters and order < len(submitted):
+                    front.cancel(submitted[order][0])
+            for order, at_iter in disconnects:
+                if at_iter == iters and order < len(submitted):
+                    front.disconnect(submitted[order][0])
+            if front.has_work():
+                front.pump()
+        front.drain()
+    except Exception as e:  # noqa: BLE001 — any escape breaks the
+        violations.append(  # "the front door never strands work" law
+            f"episode escaped with {type(e).__name__}: {e}")
+
+    fired = faults.fired()
+    faults.clear()
+    violations += ledger.violations()
+    violations += router_leak_violations(router)
+    violations += frontdoor_leak_violations(front)
+    violations += token_prefix_violations(
+        (h.req, refs[pi]) for h, pi in submitted)
+    # stream-consistency law: what a still-connected client SAW must
+    # match the request's terminal state — streamed tokens are a
+    # prefix of out_tokens, and the final event carries the full
+    # output and finish reason
+    for h, _ in submitted:
+        evs = h.stream.events()
+        toks = [e["token"] for e in evs if e["event"] == "token"]
+        dones = [e for e in evs if e["event"] == "done"]
+        if toks != list(h.req.out_tokens[:len(toks)]):
+            violations.append(
+                f"request {h.req.rid}: streamed tokens {toks} are "
+                f"not a prefix of delivered {h.req.out_tokens}")
+        if h.disconnected:
+            continue
+        if len(dones) != 1:
+            violations.append(
+                f"request {h.req.rid}: connected client got "
+                f"{len(dones)} 'done' events (want exactly 1)")
+        elif dones[0]["output_ids"] != h.req.output_ids \
+                or dones[0]["finish_reason"] != h.req.finish_reason:
+            violations.append(
+                f"request {h.req.rid}: done event "
+                f"{dones[0]['output_ids']}/{dones[0]['finish_reason']}"
+                f" != request {h.req.output_ids}/"
+                f"{h.req.finish_reason}")
+    deaths = sum(1 for r in router.replicas if r.state == "dead")
+    return EpisodeResult(
+        seed=seed, kind="frontdoor", violations=violations,
+        schedule=schedule, fired=fired,
+        stats={"requests": len(submitted), "rejected": rejected,
+               "replicas": n_replicas, "replica_deaths": deaths,
+               "failovers": int(router._m_failover.value),
+               "failover_requests":
+                   int(router._m_failover_req.value),
+               "kills_scheduled": len(kills),
+               "mid_kill": mid_kill.point if mid_kill else None,
+               "attempts": ledger.attempts})
+
+
+# ---------------------------------------------------------------------------
 # training episodes
 # ---------------------------------------------------------------------------
 
@@ -563,6 +785,8 @@ def run_episode(seed: int, kind: str, workdir: Optional[str] = None) \
     """Dispatch one episode; training episodes need a ``workdir``."""
     if kind == "serving":
         return run_serving_episode(seed)
+    if kind == "frontdoor":
+        return run_frontdoor_episode(seed)
     if kind == "training":
         if workdir is None:
             raise ValueError("training episodes need a workdir")

@@ -14,6 +14,10 @@ Wired-in points (see docs/RESILIENCE.md for the catalogue):
 ``serving.step.decode``      right before the decode-step jit call
 ``serving.step.prefill``     inside the (re-)prefill program driver
 ``serving.prefill.paged``    paged prefill, AFTER pages are claimed
+``router.dispatch``          router submit, before replica binding
+``router.health_probe``      inside the per-round replica probe
+``frontdoor.stream_write``   writing a token/done event to a client
+``frontdoor.client_disconnect``  the client-liveness probe
 ``store.set/get/add/wait``   TCPStore client ops, before the C call
 ``checkpoint.shard_write``   inside the retried per-file shard write
 ``checkpoint.commit``        after shards, BEFORE the metadata flip
@@ -71,6 +75,16 @@ KNOWN_POINTS = (
     # mid-prefill on the PAGED cache: pages claimed, table row live,
     # prefill program not yet run — the abort path must return them
     "serving.prefill.paged",
+    # router/front-door boundary (serving/router.py, frontdoor.py):
+    # dispatch-path crash before a request binds to a replica; health-
+    # probe infrastructure failure (must degrade to draining, never
+    # lose requests); a client-stream write failing (broken pipe);
+    # the client-liveness probe finding the client gone — including
+    # MID-prefill, after KV pages are claimed
+    "router.dispatch",
+    "router.health_probe",
+    "frontdoor.stream_write",
+    "frontdoor.client_disconnect",
     "store.set", "store.get", "store.add", "store.wait",
     "checkpoint.shard_write",
     "checkpoint.commit",

@@ -50,7 +50,8 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 __all__ = ["InvariantViolation", "ConservationLedger",
            "token_prefix_violations", "engine_leak_violations",
-           "page_leak_violations",
+           "page_leak_violations", "router_leak_violations",
+           "frontdoor_leak_violations",
            "thread_leak_violations", "pending_save_violations",
            "loss_trajectory_violations",
            "checkpoint_monotonic_violations"]
@@ -77,13 +78,28 @@ class ConservationLedger:
     :meth:`violations` then audits the books: every submission must
     have exactly one delivery, every delivery a submission, and every
     delivered request a terminal state.
+
+    Mounted at the FRONT DOOR (``serving/frontdoor.py``) the ledger
+    additionally audits the admission boundary itself: the front door
+    calls :meth:`on_attempt` once per client call and then either
+    :meth:`on_submitted` (accepted) or :meth:`on_rejected` (typed
+    refusal) — exactly one outcome per attempt, so a request cannot
+    vanish between the client and the router.
     """
 
     def __init__(self):
         self.submitted: Dict[int, object] = {}        # rid -> Request
         self.delivered: Dict[int, List[str]] = {}     # rid -> [via...]
+        self.attempts = 0
+        self.rejected: List[Tuple[str, str]] = []   # (tenant, reason)
 
     # -- hooks (the engine calls these) --------------------------------
+    def on_attempt(self) -> None:
+        self.attempts += 1
+
+    def on_rejected(self, tenant: str = "", reason: str = "") -> None:
+        self.rejected.append((tenant, reason))
+
     def on_submitted(self, req) -> None:
         if req.rid in self.submitted:
             # recorded as a delivery-side anomaly at audit time
@@ -119,6 +135,17 @@ class ConservationLedger:
                 out.append(
                     f"request {rid} delivered via {vias} but never "
                     f"submitted (phantom)")
+        # front-door admission law: every attempt gets exactly one
+        # outcome (accept | typed reject) — only audited when the
+        # boundary reports attempts at all
+        if self.attempts:
+            outcomes = len(self.submitted) + len(self.rejected)
+            if outcomes != self.attempts:
+                out.append(
+                    f"front door saw {self.attempts} attempts but "
+                    f"recorded {len(self.submitted)} accepts + "
+                    f"{len(self.rejected)} rejects = {outcomes} "
+                    f"outcomes (a request vanished at the boundary)")
         return out
 
     def check(self) -> None:
@@ -232,6 +259,55 @@ def page_leak_violations(engine) -> List[str]:
         out.append(
             f"freed slots {stale} still hold page-table entries "
             f"{[cache.page_table[s].tolist() for s in stale]}")
+    return out
+
+
+def router_leak_violations(router) -> List[str]:
+    """Cross-replica no-leak law: a quiesced router tracks nothing
+    (its exactly-once in-flight table is empty) and every LIVE replica
+    passes the single-engine leak audits — slots, queue entries,
+    undelivered terminal requests, and paged-KV refcounts. DEAD
+    replicas are exempt from engine/page audits (their pools died with
+    the process; what must not leak is REQUESTS, which the in-flight
+    table and the conservation ledger audit), but failover must have
+    left their host containers empty — a request still sitting in a
+    dead replica is a request nobody will ever serve."""
+    out = []
+    if router._inflight:
+        out.append(
+            f"router still tracks rids "
+            f"{sorted(router._inflight)} after quiesce")
+    for rep in router.replicas:
+        if rep.state == "dead":
+            eng = rep.engine
+            stranded = [r.rid for r in eng.scheduler.pending()]
+            stranded += [eng.cache.slots[s].rid
+                         for s in eng.cache.active_slots()]
+            stranded += [r.rid for r in eng._undelivered]
+            if stranded:
+                out.append(
+                    f"dead replica {rep.id} still holds rids "
+                    f"{sorted(stranded)} (failover left them behind)")
+            continue
+        for v in engine_leak_violations(rep.engine):
+            out.append(f"replica {rep.id}: {v}")
+        for v in page_leak_violations(rep.engine):
+            out.append(f"replica {rep.id}: {v}")
+    return out
+
+
+def frontdoor_leak_violations(front) -> List[str]:
+    """Boundary no-leak law: once the front door drains, every handle
+    was closed out (no client left waiting forever) and every
+    tenant's in-flight depth is back to zero."""
+    out = []
+    if front._handles:
+        out.append(
+            f"front door still holds handles for rids "
+            f"{sorted(front._handles)} after quiesce")
+    bad = {t: d for t, d in front._tenant_depth.items() if d != 0}
+    if bad:
+        out.append(f"tenant depth counters not back to zero: {bad}")
     return out
 
 

@@ -29,9 +29,14 @@ a donated-pool step failure, per-request ``deadline_s``, bounded
 """
 from .engine import ServingEngine  # noqa: F401
 from .errors import (DeadlineExceeded, EngineBroken,  # noqa: F401
-                     EngineClosed, EngineIdle, QueueFull,
-                     RequestCancelled, ServingError)
+                     EngineClosed, EngineIdle, NoHealthyReplicas,
+                     QueueFull, RateLimited, ReplicaDead,
+                     RequestCancelled, ServingError, TenantQueueFull)
+from .frontdoor import (ClientStream, FrontDoor,  # noqa: F401
+                        FrontDoorHandle, FrontDoorHTTPServer,
+                        TenantPolicy, TokenBucket)
 from .metrics import EngineMetrics  # noqa: F401
+from .router import Replica, ReplicaRouter  # noqa: F401
 from .sampling import SamplingParams, sample_token  # noqa: F401
 from .scheduler import (FIFOScheduler, Request, bucket_for,  # noqa: F401
                         prefill_buckets)
@@ -42,4 +47,9 @@ __all__ = ["ServingEngine", "EngineMetrics", "SamplingParams",
            "prefill_buckets", "SlotKVCache", "PagedKVCache",
            "ServingError",
            "QueueFull", "DeadlineExceeded", "EngineBroken",
-           "EngineIdle", "EngineClosed", "RequestCancelled"]
+           "EngineIdle", "EngineClosed", "RequestCancelled",
+           "RateLimited", "TenantQueueFull", "ReplicaDead",
+           "NoHealthyReplicas",
+           "ReplicaRouter", "Replica",
+           "FrontDoor", "FrontDoorHTTPServer", "FrontDoorHandle",
+           "ClientStream", "TenantPolicy", "TokenBucket"]

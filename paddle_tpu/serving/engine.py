@@ -106,6 +106,7 @@ class ServingEngine:
                  time_fn: Callable[[], float] = time.perf_counter,
                  registry=None, flight_recorder=None,
                  auditor=None,
+                 cancel_probe: Optional[Callable] = None,
                  kv_layout: str = "paged",
                  page_size: Optional[int] = None,
                  num_pages: Optional[int] = None,
@@ -186,6 +187,14 @@ class ServingEngine:
         # the EXTERNAL delivery boundaries only, so a ledger sees
         # exactly what callers see
         self.auditor = auditor
+        # optional liveness callback(req) -> bool (True = the client
+        # behind this request is gone). The front door installs one so
+        # a disconnect observed on an HTTP thread propagates into
+        # engine cancellation at the next safe point: the step-boundary
+        # sweep, or mid-prefill AFTER pages are claimed (so the abort
+        # path unwinds them). Requests also carry their own
+        # `cancel_requested` flag, checked first.
+        self.cancel_probe = cancel_probe
         self._in_drain = False
         # python-side-effect counters bumped at TRACE time: the compile-
         # count contract (1 decode + O(log max_len) prefill buckets) is
@@ -212,6 +221,9 @@ class ServingEngine:
         self._m_deadline = reg.counter(
             "ptpu_serving_deadline_cancellations_total",
             "requests cancelled at their deadline (queued + in-flight)")
+        self._m_disconnect = reg.counter(
+            "ptpu_serving_disconnects_total",
+            "requests cancelled because their client went away")
         self._m_recover = reg.counter(
             "ptpu_serving_recoveries_total",
             "successful recover() calls after a broken step")
@@ -293,7 +305,8 @@ class ServingEngine:
     # -- public API ----------------------------------------------------
     def submit(self, prompt_ids, max_new_tokens: int = 16,
                sampling: Optional[SamplingParams] = None,
-               deadline_s: Optional[float] = None) -> Request:
+               deadline_s: Optional[float] = None,
+               tenant: Optional[str] = None) -> Request:
         """Queue one request; returns its handle (tokens appear on it
         as steps run).
 
@@ -306,6 +319,15 @@ class ServingEngine:
         :class:`EngineBroken` until ``recover()``, :class:`QueueFull`
         when ``max_queue`` requests are already waiting.
         """
+        # refuse BEFORE building: a typed refusal must not consume a
+        # rid or pay input validation (submit_request re-checks for
+        # callers that build first, e.g. the router)
+        self._check_admission()
+        return self.submit_request(self._build_request(
+            prompt_ids, max_new_tokens, sampling, deadline_s,
+            tenant=tenant))
+
+    def _check_admission(self) -> None:
         if self._closed:
             raise EngineClosed()
         if self._broken:
@@ -314,6 +336,16 @@ class ServingEngine:
                 and self.scheduler.depth >= self.max_queue:
             self._m_reject.labels(reason="queue_full").inc()
             raise QueueFull(self.scheduler.depth, self.max_queue)
+
+    def _build_request(self, prompt_ids, max_new_tokens: int = 16,
+                       sampling: Optional[SamplingParams] = None,
+                       deadline_s: Optional[float] = None,
+                       rid: Optional[int] = None,
+                       tenant: Optional[str] = None) -> Request:
+        """Validate inputs and build a Request WITHOUT enqueuing it.
+        ``rid=None`` draws from this engine's counter; the replica
+        router passes its own (globally unique across replicas, so a
+        request keeps one identity through failover adoption)."""
         ids = np.asarray(getattr(prompt_ids, "numpy", lambda: prompt_ids)()
                          ).astype(np.int64)
         if ids.ndim == 2 and ids.shape[0] == 1:   # [1, T] batch-of-one
@@ -338,20 +370,48 @@ class ServingEngine:
         if deadline_s is not None and deadline_s <= 0:
             raise ValueError(
                 f"deadline_s must be > 0, got {deadline_s}")
-        req = Request(rid=self._next_rid, prompt=ids,
+        if rid is None:
+            rid = self._next_rid
+            self._next_rid += 1
+        req = Request(rid=rid, prompt=ids,
                       max_new_tokens=int(max_new_tokens),
                       sampling=sampling,
                       deadline=(self.metrics.now() + deadline_s
-                                if deadline_s is not None else None))
+                                if deadline_s is not None else None),
+                      tenant=tenant)
         req._rng = np.random.RandomState(
             sampling.seed if sampling.seed is not None
             else 0x5EED + req.rid)
-        self._next_rid += 1
+        return req
+
+    def submit_request(self, req: Request) -> Request:
+        """Enqueue a pre-built Request (typed admission checks apply;
+        ``submit()`` is ``submit_request(_build_request(...))``)."""
+        self._check_admission()
         self.scheduler.add(req)
         self.metrics.on_submit(req.rid)
         self._m_queue_depth.set(self.scheduler.depth)
         if self.auditor is not None:
             self.auditor.on_submitted(req)
+        return req
+
+    def adopt(self, req: Request) -> Request:
+        """Take over an existing request mid-flight (router failover:
+        its previous replica died). The request may already carry
+        delivered tokens — admission then re-prefills prompt + those
+        tokens via the ``recover()`` replay contract, so greedy output
+        stays token-identical and nothing is retracted. Bypasses
+        ``max_queue`` (a failover must never drop a request the
+        service already accepted) and does NOT re-audit submission
+        (the request was audited where it first entered)."""
+        if self._closed:
+            raise EngineClosed()
+        if self._broken:
+            raise EngineBroken(self._broken)
+        req.slot = None
+        self.scheduler.add(req)
+        self.metrics.on_submit(req.rid)
+        self._m_queue_depth.set(self.scheduler.depth)
         return req
 
     def has_work(self) -> bool:
@@ -449,9 +509,11 @@ class ServingEngine:
     def _step_inner(self, finished: List[Request]):
         admitted: List[int] = []
 
-        # 0) deadline sweep — cancel expired requests BEFORE spending
-        # a prefill or decode slot-step on them
+        # 0) deadline + disconnect sweeps — cancel expired requests and
+        # requests whose client went away BEFORE spending a prefill or
+        # decode slot-step on them
         self._expire_deadlines(finished)
+        self._sweep_disconnects(finished)
         # re-snapshot the weights so checkpoint loads / quantization on
         # the live model object take effect next step (same pytree
         # structure -> no retrace; the arrays are just jit arguments)
@@ -471,6 +533,13 @@ class ServingEngine:
         for i, (slot, req) in enumerate(pairs):
             try:
                 self._prefill(slot, req)
+            except RequestCancelled as e:
+                # the client vanished while THIS request was being
+                # prefilled: the abort path already unwound its pages
+                # (paged) and no slot was assigned — cancel just this
+                # request and keep admitting the rest of the batch
+                self._finish_disconnect(req, exc=e, finished=finished)
+                continue
             except Exception:
                 # admissions() popped the WHOLE batch: everything not
                 # yet prefilled goes back to the queue head in FCFS
@@ -570,6 +639,67 @@ class ServingEngine:
                 self._m_deadline.inc()
                 self._evict(s, req, finished)
 
+    def _cancel_requested(self, req: Request) -> bool:
+        """True if the client behind ``req`` is known gone: either the
+        request's own flag (set by the front door, possibly from an
+        HTTP thread) or the installed ``cancel_probe``. A probe that
+        itself dies must never take the engine down — it just reads
+        as 'still connected'."""
+        if req.cancel_requested:
+            return True
+        probe = self.cancel_probe
+        if probe is None:
+            return False
+        try:
+            if probe(req):
+                req.cancel_requested = True
+                return True
+        except Exception:
+            return False
+        return False
+
+    def _finish_disconnect(self, req: Request,
+                           detail: Optional[str] = None,
+                           exc: Optional[BaseException] = None,
+                           finished: Optional[List[Request]] = None) \
+            -> None:
+        """Terminal bookkeeping shared by every path that observes the
+        client gone (prefill abort, queued/slot sweeps, recover): one
+        place to keep the disconnect state/metric story consistent.
+        Callers that evict a slot pass ``finished=None`` and let
+        ``_evict`` do the delivery accounting."""
+        req.finished, req.finish_reason = True, "disconnect"
+        req.error = exc if exc is not None \
+            else RequestCancelled(req.rid, detail or "disconnect")
+        self._m_disconnect.inc()
+        if finished is not None:
+            self.metrics.on_finished(req.rid)
+            finished.append(req)
+
+    def _sweep_disconnects(self, finished: List[Request]) -> None:
+        """Cancel queued and in-flight requests whose client went away
+        (same step-boundary grain as the deadline sweep); freed slots
+        return their KV pages via the normal release path."""
+        if self.cancel_probe is None and \
+                not any(r.cancel_requested
+                        for r in self.scheduler.pending()) and \
+                not any(self.cache.slots[s].cancel_requested
+                        for s in self.cache.active_slots()):
+            return
+        for req in list(self.scheduler.pending()):
+            if self._cancel_requested(req):
+                self.scheduler.remove(req)
+                self._finish_disconnect(
+                    req, "client disconnected while queued",
+                    finished=finished)
+        for s in self.cache.active_slots():
+            req = self.cache.slots[s]
+            if self._cancel_requested(req):
+                self._finish_disconnect(
+                    req, f"client disconnected in slot {s} after "
+                         f"{len(req.out_tokens)} token(s)")
+                self._evict(s, req, finished)
+
     def cancel(self, req: Request, reason: str = "cancelled") -> bool:
         """Cancel one request (queued or in-flight); returns False if
         it already finished. Delivered tokens stay on the handle."""
@@ -648,6 +778,15 @@ class ServingEngine:
                 todo.append((s, req))
         mismatches = 0
         for s, req in todo:
+            if self._cancel_requested(req):
+                # the client vanished while the engine was down: don't
+                # pay a re-prefill nobody is listening to
+                self.cache.release(s)
+                req.slot = None
+                self._finish_disconnect(
+                    req, "client disconnected during recover()",
+                    finished=finished)
+                continue
             if not req.out_tokens:
                 # the failed step died between slot assignment and the
                 # first sampled token: finish the prefill now
@@ -789,10 +928,30 @@ class ServingEngine:
 
     def _prefill(self, slot: int, req: Request) -> None:
         """Run the bucketed prefill program for one request, write its
-        k/v into the slot row, and sample its first token (TTFT)."""
+        k/v into the slot row, and sample its first token (TTFT).
+
+        A request adopted mid-flight (router failover: it already
+        carries delivered tokens) re-prefills prompt + those tokens
+        instead — the ``recover()`` replay contract: greedy replay
+        re-predicts the last delivered token (mismatches counted,
+        tokens never retracted) and decode resumes where it stopped."""
         self.metrics.on_first_prefill(req.rid)   # queue wait ends here
+        if req.out_tokens:
+            ids = req.prompt if len(req.out_tokens) <= 1 else \
+                np.concatenate([req.prompt,
+                                np.asarray(req.out_tokens[:-1],
+                                           np.int64)])
+            logits = self._prefill_raw(slot, ids, request_id=req.rid,
+                                       req=req, cancel_check=True)
+            self.cache.assign(slot, req)
+            req.slot = slot
+            if req.sampling.temperature <= 0 \
+                    and int(np.argmax(logits)) != req.out_tokens[-1]:
+                self._m_replay_mismatch.inc()
+            return
         logits = self._prefill_raw(slot, req.prompt,
-                                   request_id=req.rid, req=req)
+                                   request_id=req.rid, req=req,
+                                   cancel_check=True)
         self.cache.assign(slot, req)
         req.slot = slot
         tok = sample_token(logits, req.sampling, req._rng)
@@ -801,7 +960,8 @@ class ServingEngine:
         self._is_finished(req, tok)
 
     def _prefill_raw(self, slot: int, ids: np.ndarray,
-                     request_id=None, req=None) -> np.ndarray:
+                     request_id=None, req=None,
+                     cancel_check: bool = False) -> np.ndarray:
         """Write ``ids``'s k/v into positions ``0..len-1`` of the slot
         row via the bucketed prefill program and return the host
         logits at the last real token. Shared by admission prefill and
@@ -817,6 +977,13 @@ class ServingEngine:
         maybe_fail("serving.step.prefill", slot=slot)
         n = int(ids.shape[0])
         if not self.paged:
+            if cancel_check and req is not None \
+                    and self._cancel_requested(req):
+                # disconnect observed before the prefill program runs
+                # (the paged path checks AFTER pages are claimed, so
+                # the abort path is what gets exercised there)
+                raise RequestCancelled(
+                    req.rid, "client disconnected before prefill")
             bucket = bucket_for(n, self.min_bucket, self.max_len)
             self._m_prefill.labels(bucket=bucket).inc()
             with span("serving.prefill", request_id=request_id,
@@ -849,6 +1016,13 @@ class ServingEngine:
             # path below must return every page (chaos-audited)
             maybe_fail("serving.prefill.paged", slot=slot,
                        shared=start > 0)
+            if cancel_check and self._cancel_requested(req):
+                # disconnect landed MID-prefill: pages are claimed and
+                # the table row is live — raising here routes through
+                # abort_sequence below, which must return every page
+                # (pinned by the page-leak chaos law)
+                raise RequestCancelled(
+                    req.rid, "client disconnected mid-prefill")
             self._run_copies(copies)
             tail = n - start
             bucket = bucket_for(tail, self.min_bucket, self.max_len)

@@ -16,13 +16,27 @@ Callers branch on these instead of parsing RuntimeError strings:
   (guard loops with ``has_work()``).
 - :class:`EngineClosed` — ``submit()`` after ``drain()``.
 - :class:`RequestCancelled` — set as ``Request.error`` by
-  ``cancel()``/``drain(max_steps=...)`` cutoffs.
+  ``cancel()``/``drain(max_steps=...)`` cutoffs, and (with reason
+  ``"disconnect"``) when the front door observes the client gone.
+
+Front-door / router additions (serving/frontdoor.py, serving/router.py):
+
+- :class:`RateLimited` — a tenant exceeded its token-bucket rate; the
+  carried ``retry_after_s`` is the earliest the bucket refills.
+- :class:`TenantQueueFull` — a tenant hit its per-tenant in-flight cap
+  (tenant isolation: one tenant's backlog cannot starve the others).
+- :class:`ReplicaDead` — a replica is gone (health probe, or raised
+  out of a dying replica's step); the router fails its in-flight
+  requests over to peers.
+- :class:`NoHealthyReplicas` — the router has no live replica to
+  dispatch to; shed load upstream.
 """
 from __future__ import annotations
 
 __all__ = ["ServingError", "QueueFull", "DeadlineExceeded",
            "EngineBroken", "EngineIdle", "EngineClosed",
-           "RequestCancelled"]
+           "RequestCancelled", "RateLimited", "TenantQueueFull",
+           "ReplicaDead", "NoHealthyReplicas"]
 
 
 class ServingError(RuntimeError):
@@ -74,3 +88,37 @@ class RequestCancelled(ServingError):
     def __init__(self, rid, reason: str = "cancelled"):
         super().__init__(f"request {rid} cancelled: {reason}")
         self.rid = rid
+
+
+class RateLimited(ServingError):
+    def __init__(self, tenant: str, retry_after_s: float = 0.0):
+        super().__init__(
+            f"tenant {tenant!r} rate-limited; retry in "
+            f"{retry_after_s:.3f}s")
+        self.tenant = tenant
+        self.retry_after_s = retry_after_s
+
+
+class TenantQueueFull(ServingError):
+    def __init__(self, tenant: str, depth: int, max_inflight: int):
+        super().__init__(
+            f"tenant {tenant!r} has {depth} requests in flight "
+            f">= max_inflight={max_inflight}")
+        self.tenant = tenant
+        self.depth = depth
+        self.max_inflight = max_inflight
+
+
+class ReplicaDead(ServingError):
+    def __init__(self, detail: str = ""):
+        super().__init__(
+            "replica is dead" + (f": {detail}" if detail else ""))
+        self.detail = detail
+
+
+class NoHealthyReplicas(ServingError):
+    def __init__(self, total: int):
+        super().__init__(
+            f"no healthy replica to dispatch to ({total} registered, "
+            f"all draining or dead)")
+        self.total = total

@@ -1,0 +1,669 @@
+"""The serving front door: streaming client API over an engine or a
+replica router, chaos-certified at the boundary where clients sit.
+
+Everything below this module is a library; this is the piece that
+speaks to a client. Two layers, separable on purpose:
+
+- :class:`FrontDoor` — transport-independent core: per-tenant
+  admission (token-bucket rate limits + per-tenant in-flight caps →
+  typed :class:`RateLimited` / :class:`TenantQueueFull`), deadline
+  forwarding into the engine's ``deadline_s`` path, token streaming
+  onto :class:`ClientStream` objects, client-disconnect propagation
+  (a failed stream write, or the ``frontdoor.client_disconnect``
+  probe, flags ``Request.cancel_requested`` — the engine cancels at
+  the next safe point, unwinding claimed KV pages via the paged abort
+  path), and the **conservation auditor mount**: ``on_attempt`` /
+  ``on_submitted`` / ``on_rejected`` / ``on_delivered`` fire at THIS
+  external boundary, so the chaos ledger audits exactly-once delivery
+  end-to-end through the router, not just per engine.
+- :class:`FrontDoorHTTPServer` — a stdlib-only (``http.server``)
+  HTTP/SSE binding: ``POST /v1/generate`` (``"stream": true`` →
+  ``text/event-stream`` token events; else one JSON response),
+  ``GET /healthz`` (router replica states), ``GET /metrics``
+  (Prometheus exposition), ``DELETE /v1/requests/<rid>``. A broken
+  client socket mid-stream cancels the request in the engine.
+
+The core is driven by ``pump()`` — one backend step + event routing —
+so chaos episodes and benchmarks run it single-threaded on a virtual
+clock (deterministic, sleep-free), while the HTTP server runs the
+same loop on a background thread.
+
+Fault points: ``frontdoor.stream_write`` (a token/final write to the
+client fails — treated as the client going away) and
+``frontdoor.client_disconnect`` (the liveness probe finds the client
+gone — including MID-prefill, after KV pages are claimed).
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from ..observability import default_recorder, default_registry
+from ..resilience.faults import maybe_fail
+from .errors import (EngineClosed, QueueFull, RateLimited,
+                     ServingError, TenantQueueFull)
+from .sampling import SamplingParams
+from .scheduler import Request
+
+__all__ = ["TenantPolicy", "TokenBucket", "ClientStream",
+           "FrontDoorHandle", "FrontDoor", "FrontDoorHTTPServer"]
+
+
+@dataclasses.dataclass
+class TenantPolicy:
+    """Admission envelope for one tenant: sustained ``rate_qps`` with
+    ``burst`` headroom (None = unlimited), and at most
+    ``max_inflight`` accepted-but-unfinished requests (None =
+    unbounded). Tenant isolation is the point: one tenant's backlog
+    or arrival spike cannot starve the others' admission."""
+    rate_qps: Optional[float] = None
+    burst: int = 8
+    max_inflight: Optional[int] = None
+
+
+class TokenBucket:
+    """Seeded-clock token bucket (``time_fn`` injectable so chaos and
+    benchmarks run it on a virtual timeline)."""
+
+    def __init__(self, rate: float, burst: int,
+                 time_fn: Callable[[], float]):
+        self.rate = float(rate)
+        self.burst = float(burst)
+        self._now = time_fn
+        self._tokens = float(burst)
+        self._t_last = time_fn()
+
+    def _refill(self) -> None:
+        t = self._now()
+        self._tokens = min(self.burst,
+                           self._tokens + (t - self._t_last) * self.rate)
+        self._t_last = t
+
+    def try_take(self, n: float = 1.0) -> bool:
+        self._refill()
+        if self._tokens >= n:
+            self._tokens -= n
+            return True
+        return False
+
+    def retry_after_s(self, n: float = 1.0) -> float:
+        self._refill()
+        need = n - self._tokens
+        return max(0.0, need / self.rate) if self.rate > 0 else 0.0
+
+
+class ClientStream:
+    """Server-side half of one client connection: ``write(event)`` is
+    called by the pump (engine loop); readers (the HTTP handler
+    thread, or a test) block on ``next_event``. A transport that can
+    fail writes subclasses ``write`` to raise — the front door treats
+    any write failure as the client being gone."""
+
+    def __init__(self):
+        self._events: deque = deque()
+        self._cond = threading.Condition()
+        self.closed = False
+
+    def write(self, event: dict) -> None:
+        with self._cond:
+            self._events.append(event)
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        with self._cond:
+            self.closed = True
+            self._cond.notify_all()
+
+    def next_event(self, timeout: Optional[float] = None) \
+            -> Optional[dict]:
+        """Pop the next event, blocking up to ``timeout``; None when
+        closed-and-empty or on timeout."""
+        with self._cond:
+            while not self._events and not self.closed:
+                if not self._cond.wait(timeout=timeout):
+                    return None
+            return self._events.popleft() if self._events else None
+
+    def events(self) -> List[dict]:
+        with self._cond:
+            return list(self._events)
+
+
+class FrontDoorHandle:
+    """One accepted request as the front door tracks it."""
+
+    def __init__(self, req: Request, stream: Optional[ClientStream],
+                 tenant: str):
+        self.req = req
+        self.stream = stream
+        self.tenant = tenant
+        self.sent = 0                  # tokens already written out
+        self.disconnected = False
+        self.finished = False
+
+    @property
+    def rid(self) -> int:
+        return self.req.rid
+
+
+class FrontDoor:
+    """Transport-independent serving front door (module docstring)."""
+
+    def __init__(self, backend, *,
+                 default_policy: Optional[TenantPolicy] = None,
+                 tenants: Optional[Dict[str, TenantPolicy]] = None,
+                 auditor=None, registry=None, flight_recorder=None,
+                 time_fn: Callable[[], float] = time.monotonic):
+        self.backend = backend
+        self.default_policy = default_policy or TenantPolicy()
+        self.tenant_policies = dict(tenants or {})
+        self.auditor = auditor
+        self.now = time_fn
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.recorder = flight_recorder if flight_recorder is not None \
+            else default_recorder()
+        self._handles: Dict[int, FrontDoorHandle] = {}
+        self._tenant_depth: Dict[str, int] = {}
+        self._buckets: Dict[str, TokenBucket] = {}
+        self._closed = False
+        self._consecutive_pump_failures = 0
+        # serialize core entry points: the engine below is not thread-
+        # safe, and the HTTP binding calls in from handler threads
+        # while the pump loop runs on another
+        self._lock = threading.RLock()
+        reg = self.registry
+        self._m_depth = reg.gauge(
+            "ptpu_frontdoor_tenant_depth",
+            "accepted-but-unfinished requests per tenant",
+            labels=("tenant",))
+        self._m_reject = reg.counter(
+            "ptpu_frontdoor_rejected_total",
+            "submissions refused at the front door",
+            labels=("reason",))
+        self._m_accept = reg.counter(
+            "ptpu_frontdoor_accepted_total",
+            "submissions accepted", labels=("tenant",))
+        self._m_stream_ev = reg.counter(
+            "ptpu_frontdoor_stream_events_total",
+            "events written to client streams")
+        self._m_disconnect = reg.counter(
+            "ptpu_frontdoor_disconnects_total",
+            "client connections observed gone")
+        # client-disconnect propagation: the engine evaluates this
+        # probe at its safe cancellation points (step-boundary sweep
+        # and MID-prefill, after KV pages are claimed)
+        if hasattr(backend, "cancel_probe"):
+            backend.cancel_probe = self._client_gone
+
+    # -- admission -----------------------------------------------------
+    def _policy(self, tenant: str) -> TenantPolicy:
+        return self.tenant_policies.get(tenant, self.default_policy)
+
+    def _bucket(self, tenant: str) -> Optional[TokenBucket]:
+        pol = self._policy(tenant)
+        if pol.rate_qps is None:
+            return None
+        b = self._buckets.get(tenant)
+        if b is None:
+            b = TokenBucket(pol.rate_qps, pol.burst, self.now)
+            self._buckets[tenant] = b
+        return b
+
+    def _reject(self, tenant: str, reason: str) -> None:
+        self._m_reject.labels(reason=reason).inc()
+        if self.auditor is not None \
+                and hasattr(self.auditor, "on_rejected"):
+            self.auditor.on_rejected(tenant=tenant, reason=reason)
+
+    def submit(self, prompt_ids, max_new_tokens: int = 16, *,
+               tenant: str = "default",
+               deadline_s: Optional[float] = None,
+               sampling: Optional[SamplingParams] = None,
+               stream: Optional[ClientStream] = None) \
+            -> FrontDoorHandle:
+        """Admit one client request. Every call gets exactly one
+        outcome — an accepted handle (whose request the ledger then
+        tracks to exactly-once delivery) or a typed refusal (audited
+        via ``on_rejected``); the attempt itself is audited first, so
+        the ledger can prove no request vanished at the boundary."""
+        with self._lock:
+            if self.auditor is not None \
+                    and hasattr(self.auditor, "on_attempt"):
+                self.auditor.on_attempt()
+            if self._closed:
+                self._reject(tenant, "closed")
+                raise EngineClosed()
+            pol = self._policy(tenant)
+            depth = self._tenant_depth.get(tenant, 0)
+            if pol.max_inflight is not None \
+                    and depth >= pol.max_inflight:
+                self._reject(tenant, "tenant_queue_full")
+                raise TenantQueueFull(tenant, depth, pol.max_inflight)
+            bucket = self._bucket(tenant)
+            if bucket is not None and not bucket.try_take():
+                self._reject(tenant, "rate_limited")
+                raise RateLimited(tenant, bucket.retry_after_s())
+            try:
+                req = self.backend.submit(
+                    prompt_ids, max_new_tokens, sampling=sampling,
+                    deadline_s=deadline_s, tenant=tenant)
+            except QueueFull:
+                self._reject(tenant, "queue_full")
+                raise
+            except ServingError:
+                self._reject(tenant, "unavailable")
+                raise
+            except ValueError:
+                self._reject(tenant, "invalid")
+                raise
+            except Exception:
+                # dispatch-path crash (router.dispatch fault): nothing
+                # was half-submitted — a typed refusal to the caller
+                self._reject(tenant, "dispatch_error")
+                raise
+            handle = FrontDoorHandle(req, stream, tenant)
+            self._handles[req.rid] = handle
+            self._tenant_depth[tenant] = depth + 1
+            self._m_depth.labels(tenant=tenant).set(depth + 1)
+            self._m_accept.labels(tenant=tenant).inc()
+            if self.auditor is not None:
+                self.auditor.on_submitted(req)
+            return handle
+
+    # -- disconnect propagation ---------------------------------------
+    def _client_gone(self, req: Request) -> bool:
+        """Engine-side liveness probe (installed as ``cancel_probe``):
+        True = nobody is listening to this request anymore."""
+        h = self._handles.get(req.rid)
+        if h is None:
+            return False
+        if h.disconnected:
+            return True
+        try:
+            maybe_fail("frontdoor.client_disconnect", rid=req.rid,
+                       tenant=h.tenant)
+        except Exception:
+            self._on_disconnect(h)
+            return True
+        return False
+
+    def _on_disconnect(self, h: FrontDoorHandle) -> None:
+        if h.disconnected:
+            return
+        h.disconnected = True
+        h.req.cancel_requested = True
+        self._m_disconnect.inc()
+        if h.stream is not None:
+            try:
+                h.stream.close()
+            except Exception:
+                pass
+
+    def disconnect(self, handle: FrontDoorHandle) -> None:
+        """The transport observed the client gone (broken socket).
+        The engine cancels at its next safe point; the request still
+        surfaces through ``pump()`` exactly once (via='disconnect')."""
+        with self._lock:
+            self._on_disconnect(handle)
+
+    def cancel(self, handle: FrontDoorHandle,
+               reason: str = "cancelled") -> bool:
+        """Explicit client cancellation (DELETE); returns False if the
+        request already finished."""
+        with self._lock:
+            if handle.finished:
+                return False
+            if self.backend.cancel(handle.req, reason):
+                self._finish(handle.req, [], via="cancel")
+                return True
+            return False
+
+    # -- the serving loop ---------------------------------------------
+    def pump(self) -> List[Request]:
+        """One front-door iteration: one backend step, then route
+        tokens/results to client streams and audit deliveries. Returns
+        the requests that reached the client this call."""
+        with self._lock:
+            if not self.backend.has_work():
+                return []
+            try:
+                done = self.backend.step()
+                self._consecutive_pump_failures = 0
+            except Exception:
+                # a router backend absorbs replica failures itself; a
+                # bare-engine backend can break — recover() it, else
+                # count the transient (the engine re-queued the
+                # faulted request) and let the next pump retry
+                self._consecutive_pump_failures += 1
+                if getattr(self.backend, "_broken", None):
+                    try:
+                        done = self.backend.recover()["finished"]
+                        self._consecutive_pump_failures = 0
+                    except Exception:
+                        return []
+                else:
+                    return []
+            self._route_tokens()
+            out: List[Request] = []
+            for req in done:
+                self._finish(req, out)
+            return out
+
+    def _push(self, h: FrontDoorHandle, event: dict) -> bool:
+        try:
+            maybe_fail("frontdoor.stream_write", rid=h.req.rid)
+            h.stream.write(event)
+        except Exception:
+            # broken pipe: the client is gone — cancellation
+            # propagates through the engine's next safe point
+            self._on_disconnect(h)
+            return False
+        self._m_stream_ev.inc()
+        return True
+
+    def _route_tokens(self) -> None:
+        for h in list(self._handles.values()):
+            if h.stream is None or h.disconnected:
+                continue
+            toks = h.req.out_tokens
+            while h.sent < len(toks):
+                if not self._push(h, {"event": "token",
+                                      "rid": h.req.rid,
+                                      "index": h.sent,
+                                      "token": int(toks[h.sent])}):
+                    break
+                h.sent += 1
+
+    def _finish(self, req: Request, out: List[Request],
+                via: Optional[str] = None) -> None:
+        h = self._handles.pop(req.rid, None)
+        if h is None:
+            # not front-door traffic (or already finished): backends
+            # deliver exactly once, so nothing to do
+            return
+        h.finished = True
+        depth = self._tenant_depth.get(h.tenant, 1) - 1
+        self._tenant_depth[h.tenant] = depth
+        self._m_depth.labels(tenant=h.tenant).set(depth)
+        if h.stream is not None and not h.disconnected:
+            self._push(h, {
+                "event": "done", "rid": req.rid,
+                "finish_reason": req.finish_reason,
+                "output_ids": req.output_ids,
+                "error": (f"{type(req.error).__name__}: {req.error}"
+                          if req.error is not None else None)})
+        if h.stream is not None:
+            h.stream.close()
+        if via is None:
+            via = "disconnect" if h.disconnected else \
+                ("stream" if h.stream is not None else "response")
+        if self.auditor is not None:
+            self.auditor.on_delivered(req, via=via)
+        out.append(req)
+
+    def has_work(self) -> bool:
+        return self.backend.has_work()
+
+    def run_until_idle(self, max_steps: int = 10000) -> List[Request]:
+        out: List[Request] = []
+        steps = 0
+        while self.has_work() and steps < max_steps:
+            out.extend(self.pump())
+            steps += 1
+            if self._consecutive_pump_failures >= 10:
+                break
+        return out
+
+    def drain(self, max_steps: Optional[int] = None) -> List[Request]:
+        """Graceful shutdown: refuse new submissions, keep streaming
+        until the backend empties (or ``max_steps`` / repeated pump
+        failures cut it off), then let the backend's own ``drain()``
+        cancel the remainder — every accepted request still reaches
+        its client-facing terminal event exactly once."""
+        with self._lock:
+            self._closed = True
+            out: List[Request] = []
+            steps = 0
+            failures0 = self._consecutive_pump_failures
+            while self.backend.has_work():
+                if max_steps is not None and steps >= max_steps:
+                    break
+                if self._consecutive_pump_failures - failures0 >= 3:
+                    break
+                out.extend(self.pump())
+                steps += 1
+            for req in self.backend.drain(max_steps=0):
+                self._finish(req, out, via="drain")
+            return out
+
+
+# ---------------------------------------------------------------------------
+# stdlib HTTP/SSE binding
+# ---------------------------------------------------------------------------
+
+class FrontDoorHTTPServer:
+    """``http.server``-based binding (no dependencies by design):
+
+    - ``POST /v1/generate`` — body ``{"prompt_ids": [...],
+      "max_new_tokens": N, "stream": bool, "tenant": str,
+      "deadline_s": float}``. Streaming responses are Server-Sent
+      Events (``data: {json}\\n\\n`` per token, then a ``done``
+      event); unary responses are one JSON object. Typed refusals map
+      to HTTP: 429 (rate limit / queues full), 503 (broken /
+      no replicas / closed), 400 (validation).
+    - ``GET /healthz`` — backend health (router replica states).
+    - ``GET /metrics`` — Prometheus text exposition.
+    - ``DELETE /v1/requests/<rid>`` — cancel.
+
+    One background thread runs the pump loop; handler threads only
+    touch the front door through its lock. A client socket that dies
+    mid-stream surfaces as a failed SSE write in the handler thread →
+    ``front.disconnect()`` → engine cancellation (KV pages unwound)."""
+
+    def __init__(self, front: FrontDoor, host: str = "127.0.0.1",
+                 port: int = 0, pump_interval_s: float = 0.002):
+        import http.server
+        import json as _json
+
+        self.front = front
+        self._stop = threading.Event()
+        self._pump_interval_s = pump_interval_s
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):   # quiet by default
+                pass
+
+            def _json_response(self, code: int, obj: dict) -> None:
+                body = _json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/healthz":
+                    backend = outer.front.backend
+                    health = backend.health() \
+                        if hasattr(backend, "health") else {}
+                    ok = (not health) or any(
+                        h["state"] == "healthy"
+                        for h in health.values())
+                    self._json_response(
+                        200 if ok else 503,
+                        {"ok": ok, "replicas": health})
+                elif self.path == "/metrics":
+                    body = outer.front.registry.to_prometheus() \
+                        .encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type",
+                                     "text/plain; version=0.0.4")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self._json_response(404, {"error": "not found"})
+
+            def do_DELETE(self):
+                parts = self.path.rstrip("/").split("/")
+                if len(parts) == 4 and parts[1] == "v1" \
+                        and parts[2] == "requests":
+                    try:
+                        rid = int(parts[3])
+                    except ValueError:
+                        self._json_response(400,
+                                            {"error": "bad rid"})
+                        return
+                    h = outer.front._handles.get(rid)
+                    ok = h is not None and outer.front.cancel(h)
+                    self._json_response(200 if ok else 404,
+                                        {"cancelled": ok, "rid": rid})
+                else:
+                    self._json_response(404, {"error": "not found"})
+
+            def do_POST(self):
+                if self.path != "/v1/generate":
+                    self._json_response(404, {"error": "not found"})
+                    return
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    body = _json.loads(self.rfile.read(n) or b"{}")
+                    prompt = body["prompt_ids"]
+                except Exception as e:
+                    self._json_response(
+                        400, {"error": f"bad request: {e}"})
+                    return
+                stream = ClientStream() if body.get("stream") \
+                    else None
+                from . import errors as E
+                try:
+                    handle = outer.front.submit(
+                        prompt,
+                        int(body.get("max_new_tokens", 16)),
+                        tenant=str(body.get("tenant", "default")),
+                        deadline_s=body.get("deadline_s"),
+                        stream=stream)
+                except (E.RateLimited, E.TenantQueueFull,
+                        E.QueueFull) as e:
+                    self._json_response(
+                        429, {"error": type(e).__name__,
+                              "detail": str(e)})
+                    return
+                except ValueError as e:
+                    self._json_response(
+                        400, {"error": "ValueError", "detail": str(e)})
+                    return
+                except Exception as e:  # noqa: BLE001 — typed 503 tail
+                    self._json_response(
+                        503, {"error": type(e).__name__,
+                              "detail": str(e)})
+                    return
+                outer._kick()
+                if stream is None:
+                    self._unary(handle)
+                else:
+                    self._sse(handle, stream)
+
+            def _unary(self, handle):
+                while not handle.finished \
+                        and not outer._stop.is_set():
+                    outer._done_cond_wait()
+                req = handle.req
+                self._json_response(200, {
+                    "rid": req.rid,
+                    "output_ids": req.output_ids,
+                    "finish_reason": req.finish_reason,
+                    "error": (f"{type(req.error).__name__}: "
+                              f"{req.error}"
+                              if req.error is not None else None)})
+
+            def _sse(self, handle, stream):
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Cache-Control", "no-cache")
+                self.send_header("Connection", "close")
+                self.end_headers()
+                while True:
+                    ev = stream.next_event(timeout=0.05)
+                    if ev is None:
+                        if stream.closed and not stream.events():
+                            break
+                        if outer._stop.is_set():
+                            break
+                        continue
+                    try:
+                        self.wfile.write(
+                            b"data: " + _json.dumps(ev).encode()
+                            + b"\n\n")
+                        self.wfile.flush()
+                    except Exception:
+                        # client socket is gone: propagate into the
+                        # engine (cancel at the next safe point)
+                        outer.front.disconnect(handle)
+                        break
+                    if ev.get("event") == "done":
+                        break
+                try:
+                    self.wfile.flush()
+                except Exception:
+                    pass
+                self.close_connection = True
+
+        class Server(http.server.ThreadingHTTPServer):
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._done_cond = threading.Condition()
+        self._serve_thread = threading.Thread(
+            target=self._server.serve_forever, name="frontdoor-http",
+            daemon=True)
+        self._pump_thread = threading.Thread(
+            target=self._pump_loop, name="frontdoor-pump",
+            daemon=True)
+
+    def _kick(self) -> None:
+        with self._done_cond:
+            self._done_cond.notify_all()
+
+    def _done_cond_wait(self, timeout: float = 0.05) -> None:
+        with self._done_cond:
+            self._done_cond.wait(timeout=timeout)
+
+    def _pump_loop(self) -> None:
+        while not self._stop.is_set():
+            if self.front.has_work():
+                done = self.front.pump()
+                if done:
+                    self._kick()
+            else:
+                self._done_cond_wait(self._pump_interval_s)
+
+    def start(self) -> "FrontDoorHTTPServer":
+        self._serve_thread.start()
+        self._pump_thread.start()
+        return self
+
+    def shutdown(self, drain: bool = True) -> None:
+        if drain:
+            try:
+                self.front.drain()
+            except Exception:
+                pass
+        self._stop.set()
+        self._kick()
+        self._server.shutdown()
+        self._server.server_close()
+        self._serve_thread.join(timeout=5)
+        self._pump_thread.join(timeout=5)
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"

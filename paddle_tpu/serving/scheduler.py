@@ -65,6 +65,14 @@ class Request:
     # finish_reason "deadline" and `error` set to the typed exception
     deadline: Optional[float] = None
     error: Optional[BaseException] = None
+    # front-door fields: the owning tenant (admission/rate-limit unit)
+    # and the client-disconnect flag — set (possibly from another
+    # thread) when the client goes away; the engine cancels the
+    # request at the next safe point (step-boundary sweep, or
+    # mid-prefill before the program runs) with finish_reason
+    # "disconnect"
+    tenant: Optional[str] = None
+    cancel_requested: bool = False
     _rng: Optional[np.random.RandomState] = None
 
     @property

@@ -22,6 +22,18 @@ SERVING_SUMMARY_KEYS = {
 }
 
 
+# the SERVING_SLO line (bench_serving_engine --frontdoor) is the
+# ISSUE-7 acceptance artifact: a closed-loop load test against the
+# front door with a replica KILLED mid-run — schema stable, exactly-
+# once ledger green, SLO met, failover actually exercised
+SERVING_SLO_KEYS = {
+    "replicas", "clients", "requests", "completed", "rejected_noisy",
+    "qps", "p99_ttft_s", "ttft_slo_s", "p99_ttft_steps", "slo_ok",
+    "deadline_miss_rate", "failovers", "failover_requests",
+    "lost", "duplicates", "ledger_green", "step_wall_ms",
+}
+
+
 # the PAGED_KV line (bench_serving_engine --prefix-share) is the
 # artifact the paged-KV acceptance keys on: schema stable, gains over
 # the contiguous pool asserted at the ISSUE-6 bars (>= 4x paged,
@@ -41,6 +53,7 @@ PAGED_KV_KEYS = {
     "bench_ernie_zero3.py", "bench_ppyoloe_infer.py",
     "bench_llama_decode.py", "bench_serving_engine.py",
     "bench_serving_engine.py --prefix-share",
+    "bench_serving_engine.py --frontdoor",
     "chaos_soak.py",
 ])
 def test_benchmark_script_smoke(script, tmp_path):
@@ -96,6 +109,21 @@ def test_benchmark_script_smoke(script, tmp_path):
         assert pk["decode_compiles"] == 1, pk
         assert pk["prefix_hit_rate"] > 0.5, pk
         assert pk["int8_greedy_agreement"] >= 0.9, pk
+    if script == "bench_serving_engine.py --frontdoor":
+        slines = [l for l in r.stdout.splitlines()
+                  if l.startswith("SERVING_SLO ")]
+        assert slines, r.stdout
+        slo = json.loads(slines[-1][len("SERVING_SLO "):])
+        assert SERVING_SLO_KEYS <= set(slo), sorted(slo)
+        assert slo["completed"] == slo["requests"], slo
+        assert slo["slo_ok"] is True, slo
+        assert slo["ledger_green"] is True, slo
+        assert slo["lost"] == 0 and slo["duplicates"] == 0, slo
+        # the run is not vacuous: a replica really died mid-run with
+        # requests failed over, and the noisy tenant was really shed
+        assert slo["failovers"] >= 1, slo
+        assert slo["failover_requests"] >= 1, slo
+        assert slo["rejected_noisy"] >= 1, slo
     if script == "chaos_soak.py":
         # the soak summary line is the artifact the CI budgeted run
         # keys on: every episode green, schema stable

@@ -32,9 +32,15 @@ def _clean_faults():
 def test_sweep_covers_registered_fault_points():
     """Adding a fault point to faults.KNOWN_POINTS without enrolling
     it in an episode kind silently shrinks the soak — fail loudly."""
-    swept = set(chaos.SERVING_SWEEP) | set(chaos.TRAINING_SWEEP)
+    swept = set(chaos.SERVING_SWEEP) | set(chaos.TRAINING_SWEEP) \
+        | set(chaos.FRONTDOOR_SWEEP)
     assert swept == set(faults.KNOWN_POINTS)
+    # coverage ownership is a partition (front-door episodes also
+    # SAMPLE the serving points — the full stack includes the
+    # engines — but each point is owned by exactly one sweep)
     assert not set(chaos.SERVING_SWEEP) & set(chaos.TRAINING_SWEEP)
+    assert not set(chaos.SERVING_SWEEP) & set(chaos.FRONTDOOR_SWEEP)
+    assert not set(chaos.FRONTDOOR_SWEEP) & set(chaos.TRAINING_SWEEP)
 
 
 # -- conservation ledger units (no engine, injected state) -------------
@@ -79,6 +85,29 @@ def test_ledger_catches_lost_duplicate_phantom_nonterminal():
     assert "phantom" in v
     with pytest.raises(InvariantViolation, match="LOST"):
         led.check()
+
+
+def test_ledger_frontdoor_attempt_law():
+    """Mounted at the front door, the ledger also audits admission:
+    every attempt gets exactly one outcome (accept | typed reject) —
+    an attempt that produced neither is a vanished request."""
+    led = ConservationLedger()
+    a, b = _req(0), _req(1)
+    led.on_attempt()
+    led.on_submitted(a)
+    led.on_attempt()
+    led.on_rejected(tenant="t", reason="rate_limited")
+    led.on_delivered(a, via="stream")
+    led.on_delivered(b, via="stream")   # phantom — never submitted
+    v = "\n".join(led.violations())
+    assert "phantom" in v
+    led2 = ConservationLedger()
+    led2.on_attempt()
+    led2.on_attempt()                   # outcome never recorded
+    led2.on_submitted(a)
+    led2.on_delivered(a, via="stream")
+    assert any("vanished at the boundary" in s
+               for s in led2.violations())
 
 
 def test_token_prefix_invariant():
@@ -136,6 +165,13 @@ def test_thread_leak_invariant():
 
 SERVING_SEEDS = list(range(0, 13))
 TRAINING_SEEDS = list(range(100, 112))
+# the replica-kill + front-door arm (ISSUE 7): FrontDoor over a 2-3
+# replica router, whole-replica kills (flag + mid-step, i.e. mid-
+# prefill/mid-stream), audited END-TO-END at the front door. Across
+# this band: >= 12 episodes with at least one replica death and
+# >= 10 with requests failed over to a peer (pinned below so the
+# band cannot silently go quiet).
+FRONTDOOR_SEEDS = list(range(300, 325))
 
 
 @pytest.mark.parametrize("seed", SERVING_SEEDS)
@@ -151,8 +187,37 @@ def test_training_episode_matrix(seed, tmp_path):
     assert res.ok, "\n".join(res.violations)
 
 
-def test_matrix_spans_both_kinds_and_enough_episodes():
+_frontdoor_death_tally = {"episodes": 0, "deaths": 0,
+                          "failover_requests": 0}
+
+
+@pytest.mark.parametrize("seed", FRONTDOOR_SEEDS)
+def test_frontdoor_episode_matrix(seed):
+    res = chaos.run_frontdoor_episode(seed)
+    assert res.ok, "\n".join(res.violations)
+    assert res.stats["requests"] >= 1
+    _frontdoor_death_tally["episodes"] += 1
+    _frontdoor_death_tally["deaths"] += \
+        1 if res.stats["replica_deaths"] else 0
+    _frontdoor_death_tally["failover_requests"] += \
+        res.stats["failover_requests"]
+
+
+def test_frontdoor_matrix_actually_kills_replicas():
+    """The replica-kill arm must stay LOADED: if sampling drift ever
+    stops killing replicas (or failing requests over), the matrix
+    would go green by vacuity — pin the coverage floor."""
+    if _frontdoor_death_tally["episodes"] < len(FRONTDOOR_SEEDS):
+        pytest.skip("full front-door matrix did not run")
+    assert _frontdoor_death_tally["deaths"] >= 12, \
+        _frontdoor_death_tally
+    assert _frontdoor_death_tally["failover_requests"] >= 10, \
+        _frontdoor_death_tally
+
+
+def test_matrix_spans_all_kinds_and_enough_episodes():
     assert len(SERVING_SEEDS) + len(TRAINING_SEEDS) >= 25
+    assert len(FRONTDOOR_SEEDS) >= 25      # ISSUE-7 acceptance bar
 
 
 def test_episodes_are_deterministic():
@@ -165,6 +230,19 @@ def test_episodes_are_deterministic():
     assert a.fired == b.fired
     assert a.violations == b.violations
     assert a.stats == b.stats
+
+
+def test_frontdoor_episodes_are_deterministic():
+    """Replica kills, failover adoption order, stream faults — all a
+    function of the seed alone (virtual clocks, seeded RNG)."""
+    a = chaos.run_frontdoor_episode(306)
+    b = chaos.run_frontdoor_episode(306)
+    assert [(x.point, x.times, x.after) for x in a.schedule] \
+        == [(x.point, x.times, x.after) for x in b.schedule]
+    assert a.fired == b.fired
+    assert a.violations == b.violations
+    assert a.stats == b.stats
+    assert a.stats["replica_deaths"] >= 1     # the arm is loaded
 
 
 # -- open-ended soak (slow tier: excluded from smoke via `full`) -------
@@ -239,6 +317,39 @@ def test_pinned_seed_catches_leaked_pages_on_aborted_prefill(
     monkeypatch.setattr(PagedKVCache, "abort_sequence", orig)
     green = chaos.run_serving_episode(PINNED_SEED_PAGE_LEAK)
     assert green.ok, "\n".join(green.violations)
+
+
+PINNED_SEED_NO_FAILOVER = 306   # replica death with requests aboard
+
+
+def test_pinned_seed_catches_disabled_failover(monkeypatch):
+    """ISSUE-7 pinned red seed: with the router's failover path
+    DISABLED (a dead replica's requests die with it — the pre-router
+    world, where a dead engine took its requests along), the
+    front-door ledger must go RED with LOST violations THROUGH the
+    router; the real failover path stays green on the same seed."""
+    from paddle_tpu.serving.router import ReplicaRouter
+    orig = ReplicaRouter._failover
+
+    def no_failover(self, rep):
+        # pre-fix semantics: the replica's host state is gone and the
+        # router forgets everything it had dispatched there
+        eng = rep.engine
+        gone = list(eng._undelivered) + eng.scheduler.pending() \
+            + [eng.cache.slots[s] for s in eng.cache.active_slots()]
+        for req in gone:
+            self._inflight.pop(req.rid, None)
+            self._owner.pop(req.rid, None)
+
+    monkeypatch.setattr(ReplicaRouter, "_failover", no_failover)
+    red = chaos.run_frontdoor_episode(PINNED_SEED_NO_FAILOVER)
+    assert not red.ok
+    assert any("LOST" in v for v in red.violations), red.violations
+    monkeypatch.setattr(ReplicaRouter, "_failover", orig)
+    green = chaos.run_frontdoor_episode(PINNED_SEED_NO_FAILOVER)
+    assert green.ok, "\n".join(green.violations)
+    assert green.stats["replica_deaths"] >= 1
+    assert green.stats["failover_requests"] >= 1
 
 
 def test_pinned_seed_catches_drain_discarding_done(monkeypatch):

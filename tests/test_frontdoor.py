@@ -1,0 +1,463 @@
+"""Serving front door + replica router (paddle_tpu/serving/frontdoor
++ router): per-tenant admission, token streaming, client-disconnect
+propagation (including MID-prefill page unwinding — the PR-6 abort
+path), failover adoption with token-identical greedy replay, drain
+composition across replicas, and the stdlib HTTP/SSE binding over a
+real socket."""
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+from paddle_tpu.observability import FlightRecorder, MetricRegistry
+from paddle_tpu.resilience import faults
+from paddle_tpu.resilience.invariants import (
+    ConservationLedger, frontdoor_leak_violations,
+    page_leak_violations, router_leak_violations)
+from paddle_tpu.serving import (ClientStream, FrontDoor,
+                                FrontDoorHTTPServer, RateLimited,
+                                ReplicaRouter, ServingEngine,
+                                TenantPolicy, TenantQueueFull,
+                                TokenBucket)
+
+
+def _tiny_llama(**kw):
+    paddle.seed(0)
+    kw.setdefault("max_position_embeddings", 64)
+    model = LlamaForCausalLM(llama_tiny_config(
+        num_hidden_layers=1, hidden_size=32, intermediate_size=64,
+        num_attention_heads=2, **kw))
+    model.eval()
+    return model
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("min_bucket", 8)
+    kw.setdefault("registry", MetricRegistry())
+    kw.setdefault("flight_recorder", FlightRecorder(capacity=4))
+    return ServingEngine(model, **kw)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    faults.reset_counts()
+    yield
+    faults.clear()
+
+
+def _prompts(rng, lens, vocab=96):
+    return [rng.randint(1, vocab, (n,)).astype(np.int64) for n in lens]
+
+
+# -- token bucket / tenant admission -----------------------------------
+
+def test_token_bucket_virtual_clock():
+    clock = {"t": 0.0}
+    b = TokenBucket(rate=2.0, burst=2, time_fn=lambda: clock["t"])
+    assert b.try_take() and b.try_take()
+    assert not b.try_take()                 # burst spent
+    assert b.retry_after_s() == pytest.approx(0.5)
+    clock["t"] += 0.5                       # one token refilled
+    assert b.try_take() and not b.try_take()
+
+
+def test_tenant_rate_limit_and_inflight_cap():
+    model = _tiny_llama()
+    clock = {"t": 0.0}
+    eng = _engine(model, time_fn=lambda: clock["t"])
+    reg = MetricRegistry()
+    front = FrontDoor(
+        eng, registry=reg, time_fn=lambda: clock["t"],
+        tenants={"lim": TenantPolicy(rate_qps=1.0, burst=1,
+                                     max_inflight=2)})
+    p = np.arange(1, 6)
+    front.submit(p, 2, tenant="lim")
+    with pytest.raises(RateLimited) as ei:
+        front.submit(p, 2, tenant="lim")
+    assert ei.value.retry_after_s > 0
+    clock["t"] += 1.0                       # bucket refills
+    front.submit(p, 2, tenant="lim")
+    clock["t"] += 1.0
+    with pytest.raises(TenantQueueFull):    # 2 in flight = the cap
+        front.submit(p, 2, tenant="lim")
+    # an unlimited tenant is untouched by the noisy one (isolation)
+    front.submit(p, 2, tenant="other")
+    c = reg.counter("ptpu_frontdoor_rejected_total",
+                    labels=("reason",))
+    assert c.labels(reason="rate_limited").value == 1
+    assert c.labels(reason="tenant_queue_full").value == 1
+    front.run_until_idle()
+    assert frontdoor_leak_violations(front) == []
+
+
+# -- streaming ----------------------------------------------------------
+
+def test_stream_tokens_and_done_event():
+    model = _tiny_llama()
+    eng = _engine(model)
+    front = FrontDoor(eng, registry=MetricRegistry())
+    rng = np.random.RandomState(0)
+    streams = [ClientStream() for _ in range(3)]
+    hs = [front.submit(p, 5, stream=s)
+          for p, s in zip(_prompts(rng, [4, 7, 11]), streams)]
+    front.run_until_idle()
+    for h, s in zip(hs, streams):
+        evs = s.events()
+        toks = [e["token"] for e in evs if e["event"] == "token"]
+        assert toks == h.req.output_ids     # every token streamed
+        done = [e for e in evs if e["event"] == "done"]
+        assert len(done) == 1
+        assert done[0]["finish_reason"] == "length"
+        assert done[0]["output_ids"] == h.req.output_ids
+        assert s.closed
+
+
+def test_disconnect_mid_stream_cancels_in_engine():
+    """A stream whose write starts failing (broken pipe) = the client
+    is gone: the engine cancels the request at the next boundary,
+    tokens already delivered stay on the handle, nothing leaks."""
+
+    class FlakyStream(ClientStream):
+        def __init__(self, fail_after):
+            super().__init__()
+            self.fail_after = fail_after
+
+        def write(self, event):
+            if len(self._events) >= self.fail_after \
+                    and event.get("event") == "token":
+                raise BrokenPipeError("client went away")
+            super().write(event)
+
+    model = _tiny_llama()
+    eng = _engine(model)
+    ledger = ConservationLedger()
+    front = FrontDoor(eng, registry=MetricRegistry(), auditor=ledger)
+    s = FlakyStream(fail_after=2)
+    h = front.submit(np.arange(1, 6), 8, stream=s)
+    front.run_until_idle()
+    assert h.req.finished and h.req.finish_reason == "disconnect"
+    assert h.disconnected
+    assert 2 <= len(h.req.out_tokens) < 8   # stopped early, not empty
+    assert ledger.violations() == []        # delivered exactly once
+    assert page_leak_violations(eng) == []
+    assert frontdoor_leak_violations(front) == []
+
+
+def test_disconnect_mid_paged_prefill_unwinds_pages():
+    """ISSUE-7 satellite pin: a client disconnect landing MID-prefill
+    (pages already claimed, program not yet run) must unwind the
+    claimed page reservations via the PR-6 abort path — after
+    quiesce, page_leak_violations is empty and the request is
+    terminal with reason 'disconnect'."""
+    model = _tiny_llama()
+    eng = _engine(model, page_size=8)
+    front = FrontDoor(eng, registry=MetricRegistry())
+    # probe evaluations: #1 at the queued-request sweep, #2 at the
+    # MID-prefill check (after begin_sequence claimed the pages) —
+    # fire exactly there
+    faults.inject("frontdoor.client_disconnect", times=1, after=1)
+    h = front.submit(np.arange(1, 20), 8, stream=ClientStream())
+    front.run_until_idle()
+    assert faults.fired("frontdoor.client_disconnect") == 1
+    assert h.req.finished and h.req.finish_reason == "disconnect"
+    assert h.req.out_tokens == []           # died before first token
+    assert page_leak_violations(eng) == []
+    assert eng.cache.active_slots() == []
+    assert frontdoor_leak_violations(front) == []
+
+
+# -- engine adoption (the failover replay primitive) --------------------
+
+def test_adopt_mid_stream_is_token_identical():
+    """Move a request between two engines mid-generation: the
+    adopting engine re-prefills prompt + delivered tokens (recover()
+    replay contract) and the final output is bit-identical to an
+    uninterrupted run."""
+    model = _tiny_llama()
+    rng = np.random.RandomState(3)
+    prompt = _prompts(rng, [9])[0]
+    ref_eng = _engine(model)
+    ref = ref_eng.submit(prompt, 8)
+    ref_eng.run()
+
+    a, b = _engine(model), _engine(model)
+    req = a.submit(prompt, 8)
+    for _ in range(3):                      # a few tokens on engine A
+        a.step()
+    assert 0 < len(req.out_tokens) < 8
+    # "replica A died": strip its slot state, adopt on B
+    a.cache.release(req.slot)
+    req.slot = None
+    b.adopt(req)
+    while b.has_work():
+        b.step()
+    assert req.finish_reason == "length"
+    assert req.output_ids == ref.output_ids
+    rm = b.registry.counter(
+        "ptpu_serving_recover_replay_mismatch_total")
+    assert rm.value == 0                    # greedy replay re-agreed
+
+
+# -- router -------------------------------------------------------------
+
+def test_router_failover_token_identity_and_exactly_once():
+    """Kill a replica mid-flight: every in-flight request is adopted
+    by the peer, finishes with output identical to an undisturbed
+    single-engine run, and the ledger (mounted at the front door)
+    stays green end-to-end."""
+    model = _tiny_llama()
+    rng = np.random.RandomState(7)
+    prompts = _prompts(rng, [4, 6, 9, 12, 5, 8])
+    ref_eng = _engine(model, max_slots=len(prompts))
+    refs = [ref_eng.submit(p, 8) for p in prompts]
+    ref_eng.run()
+
+    engines = [_engine(model), _engine(model)]
+    router = ReplicaRouter(engines, registry=MetricRegistry(),
+                           flight_recorder=FlightRecorder(capacity=4))
+    ledger = ConservationLedger()
+    front = FrontDoor(router, auditor=ledger,
+                      registry=MetricRegistry())
+    hs = [front.submit(p, 8, stream=ClientStream()) for p in prompts]
+    # both replicas carry load (least-loaded dispatch spread them)
+    assert all(e.has_work() for e in engines)
+    for _ in range(3):
+        front.pump()
+    router.replicas[0].kill()               # die mid-stream
+    front.run_until_idle()
+    assert router.replicas[0].state == "dead"
+    assert int(router._m_failover.value) == 1
+    for h, ref in zip(hs, refs):
+        assert h.req.finish_reason == "length"
+        assert h.req.output_ids == ref.output_ids
+    assert ledger.violations() == []
+    assert router_leak_violations(router) == []
+    assert frontdoor_leak_violations(front) == []
+
+
+def test_router_dispatch_fault_is_typed_rejection():
+    model = _tiny_llama()
+    router = ReplicaRouter([_engine(model)],
+                           registry=MetricRegistry())
+    ledger = ConservationLedger()
+    front = FrontDoor(router, auditor=ledger,
+                      registry=MetricRegistry())
+    faults.inject("router.dispatch", times=1)
+    with pytest.raises(faults.InjectedFault):
+        front.submit(np.arange(1, 5), 2)
+    # rejected, not half-submitted: the ledger's admission law holds
+    assert ledger.attempts == 1 and len(ledger.rejected) == 1
+    assert ledger.violations() == []
+    h = front.submit(np.arange(1, 5), 2)    # next one goes through
+    front.run_until_idle()
+    assert h.req.finish_reason == "length"
+    assert ledger.violations() == []
+
+
+def test_router_probe_failures_drain_then_kill():
+    """One probe failure -> SUSPECT (no new dispatches, keeps
+    serving); threshold consecutive failures -> DEAD + failover."""
+    model = _tiny_llama()
+    engines = [_engine(model), _engine(model)]
+    router = ReplicaRouter(engines, registry=MetricRegistry(),
+                           probe_fail_threshold=2)
+    r0 = router.submit(np.arange(1, 5), 6)
+    assert router._owner[r0.rid] == "0"     # least-loaded: replica 0
+    faults.inject("router.health_probe", times=1)   # one flaky probe
+    router.step()                           # replica 0 -> SUSPECT
+    assert router.replicas[0].state == "suspect"
+    r1 = router.submit(np.arange(1, 7), 2)
+    assert router._owner[r1.rid] == "1"     # suspect not dispatched
+    done = []
+    while router.has_work():
+        done.extend(router.step())          # clean probe -> healthy
+    assert router.replicas[0].state == "healthy"
+    assert {r.rid for r in done} == {r0.rid, r1.rid}
+    assert r0.finish_reason == "length"
+    # now fail probes past the threshold for the victim only: 3 fires
+    # land 2 consecutive failures on replica 0 (probed first -> DEAD +
+    # failover) but only 1 on replica 1, which recovers and adopts
+    r2 = router.submit(np.arange(1, 5), 4)
+    assert router._owner[r2.rid] == "0"     # least-loaded again
+    faults.inject("router.health_probe", times=3)
+    router.step()
+    router.step()
+    assert router.replicas[0].state == "dead"
+    out = []
+    while router.has_work():
+        out.extend(router.step())
+    assert router.replicas[1].state == "healthy"
+    assert r2.finish_reason == "length"     # survived via the peer
+    assert router_leak_violations(router) == []
+
+
+def test_router_drain_replica_keeps_serving():
+    """drain_replica: queued work moves to peers immediately, in-slot
+    work finishes, the replica retires — the service never stops."""
+    model = _tiny_llama()
+    engines = [_engine(model, max_slots=1), _engine(model, max_slots=1)]
+    router = ReplicaRouter(engines, registry=MetricRegistry())
+    reqs = [router.submit(np.arange(1, 5 + i), 4) for i in range(4)]
+    router.step()                           # both replicas busy
+    router.drain_replica("0")
+    out = router.step_until_retired("0")
+    assert router.replicas[0].state == "retired"
+    rest = []
+    while router.has_work():
+        rest.extend(router.step())
+    assert {r.rid for r in out + rest} == {r.rid for r in reqs}
+    assert all(r.finish_reason == "length" for r in reqs)
+    # retired replica never dispatched again
+    r = router.submit(np.arange(1, 4), 1)
+    assert router._owner[r.rid] == "1"
+    while router.has_work():
+        router.step()
+
+
+def test_frontdoor_drain_composes_across_replicas():
+    model = _tiny_llama()
+    engines = [_engine(model, max_slots=1), _engine(model, max_slots=1)]
+    router = ReplicaRouter(engines, registry=MetricRegistry())
+    ledger = ConservationLedger()
+    front = FrontDoor(router, auditor=ledger,
+                      registry=MetricRegistry())
+    rng = np.random.RandomState(1)
+    hs = [front.submit(p, 4, stream=ClientStream())
+          for p in _prompts(rng, [4, 5, 6, 7])]
+    front.pump()
+    done = front.drain(max_steps=2)         # cutoff mid-backlog
+    assert {r.rid for r in done} == {h.req.rid for h in hs}
+    # every client got a terminal event exactly once, served or not
+    for h in hs:
+        assert h.req.finished
+        assert h.req.finish_reason in ("length", "cancelled")
+        evs = h.stream.events()
+        assert len([e for e in evs if e["event"] == "done"]) == 1
+    with pytest.raises(Exception):          # closed to new work
+        front.submit(np.arange(1, 4), 1)
+    assert ledger.violations() == []
+    assert router_leak_violations(router) == []
+
+
+# -- observability ------------------------------------------------------
+
+def test_router_and_frontdoor_metric_families():
+    model = _tiny_llama()
+    reg = MetricRegistry()
+    engines = [_engine(model), _engine(model)]
+    router = ReplicaRouter(engines, registry=reg)
+    front = FrontDoor(router, registry=reg,
+                      tenants={"t": TenantPolicy(max_inflight=1)})
+    h = front.submit(np.arange(1, 6), 2, tenant="t",
+                     stream=ClientStream())
+    with pytest.raises(TenantQueueFull):
+        front.submit(np.arange(1, 6), 2, tenant="t")
+    router.replicas[1].kill()
+    front.run_until_idle()
+    fams = set(reg.families())
+    assert {"ptpu_router_replica_healthy",
+            "ptpu_router_replica_inflight",
+            "ptpu_router_dispatches_total",
+            "ptpu_router_failovers_total",
+            "ptpu_frontdoor_tenant_depth",
+            "ptpu_frontdoor_rejected_total",
+            "ptpu_frontdoor_accepted_total",
+            "ptpu_frontdoor_stream_events_total"} <= fams, sorted(fams)
+    assert reg.gauge("ptpu_router_replica_healthy",
+                     labels=("replica",)).labels(replica="1").value == 0
+    assert reg.gauge("ptpu_frontdoor_tenant_depth",
+                     labels=("tenant",)).labels(tenant="t").value == 0
+    assert h.req.finished
+
+
+# -- HTTP/SSE binding ---------------------------------------------------
+
+@pytest.fixture()
+def http_front():
+    model = _tiny_llama()
+    eng = _engine(model, page_size=8)
+    front = FrontDoor(eng, registry=MetricRegistry())
+    srv = FrontDoorHTTPServer(front, port=0).start()
+    yield srv, front, eng
+    srv.shutdown()
+
+
+def test_http_unary_and_sse_stream(http_front):
+    srv, front, eng = http_front
+    body = json.dumps({"prompt_ids": [1, 2, 3, 4],
+                       "max_new_tokens": 4}).encode()
+    req = urllib.request.Request(
+        srv.url + "/v1/generate", data=body,
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        out = json.loads(resp.read())
+    assert out["finish_reason"] == "length"
+    assert len(out["output_ids"]) == 4
+
+    body = json.dumps({"prompt_ids": [1, 2, 3, 4],
+                       "max_new_tokens": 4, "stream": True}).encode()
+    req = urllib.request.Request(
+        srv.url + "/v1/generate", data=body,
+        headers={"Content-Type": "application/json"})
+    events = []
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        assert resp.headers["Content-Type"] == "text/event-stream"
+        for raw in resp:
+            raw = raw.strip()
+            if raw.startswith(b"data: "):
+                events.append(json.loads(raw[len(b"data: "):]))
+            if events and events[-1].get("event") == "done":
+                break
+    toks = [e["token"] for e in events if e["event"] == "token"]
+    done = [e for e in events if e["event"] == "done"]
+    assert len(done) == 1 and done[0]["output_ids"] == out["output_ids"]
+    assert toks == out["output_ids"]        # same greedy tokens
+
+    with urllib.request.urlopen(srv.url + "/healthz",
+                                timeout=10) as resp:
+        assert json.loads(resp.read())["ok"] is True
+    with urllib.request.urlopen(srv.url + "/metrics",
+                                timeout=10) as resp:
+        prom = resp.read().decode()
+    assert "ptpu_frontdoor_accepted_total" in prom
+
+
+def test_http_client_disconnect_cancels_request(http_front):
+    """Close the client socket mid-SSE-stream: the handler thread's
+    failed write propagates to front.disconnect -> engine cancel at
+    the next boundary; no KV pages leak."""
+    import socket as socketmod
+
+    srv, front, eng = http_front
+    body = json.dumps({"prompt_ids": list(range(1, 18)),
+                       "max_new_tokens": 40, "stream": True}).encode()
+    # raw socket so we can slam it shut mid-stream
+    s = socketmod.create_connection((srv.host, srv.port), timeout=10)
+    s.sendall((f"POST /v1/generate HTTP/1.1\r\n"
+               f"Host: {srv.host}\r\nContent-Type: application/json"
+               f"\r\nContent-Length: {len(body)}\r\n\r\n"
+               ).encode() + body)
+    buf = b""
+    while b"data: " not in buf:             # first token arrived
+        chunk = s.recv(4096)
+        assert chunk, buf
+        buf += chunk
+    s.close()                               # client vanishes
+    handle = next(iter(front._handles.values()))
+    deadline = threading.Event()
+    for _ in range(400):                    # wait for the engine sweep
+        if handle.req.finished:
+            break
+        deadline.wait(0.02)
+    assert handle.req.finished
+    assert handle.req.finish_reason == "disconnect"
+    assert len(handle.req.out_tokens) < 40  # cancelled early
+    assert page_leak_violations(eng) == []
+    assert frontdoor_leak_violations(front) == []

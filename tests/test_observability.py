@@ -513,6 +513,73 @@ def test_one_run_three_artifacts(tmp_path):
     eng.step()
 
 
+def test_router_frontdoor_gauges_counters_and_spans(tmp_path):
+    """ISSUE-7 observability satellite: serving through the front
+    door over a 2-replica router (one replica killed mid-run) leaves
+    — in ONE registry next to the existing serving families —
+    per-replica health/inflight gauges, per-tenant queue-depth gauges
+    and rejected{reason} counters, failover counters; and the chrome
+    trace carries router.dispatch spans with request ids plus the
+    router.failover span for the death."""
+    from paddle_tpu import profiler
+    from paddle_tpu.serving import (FrontDoor, ReplicaRouter,
+                                    ServingEngine, TenantPolicy,
+                                    TenantQueueFull)
+
+    reg = MetricRegistry()
+    model = _tiny_llama()
+    engines = [ServingEngine(model, max_slots=2, max_len=64,
+                             min_bucket=8, registry=reg,
+                             flight_recorder=FlightRecorder(capacity=4))
+               for _ in range(2)]
+    router = ReplicaRouter(engines, registry=reg,
+                           flight_recorder=FlightRecorder(capacity=4))
+    front = FrontDoor(router, registry=reg,
+                      tenants={"cap": TenantPolicy(max_inflight=1)})
+    prof = profiler.Profiler(timer_only=True)
+    prof.start()
+    hs = [front.submit(np.arange(1, 5 + i), 4, tenant="cap" if i == 0
+                       else "default") for i in range(4)]
+    with pytest.raises(TenantQueueFull):
+        front.submit(np.arange(1, 5), 4, tenant="cap")
+    for _ in range(2):
+        front.pump()
+    router.replicas[1].kill()               # death mid-run
+    front.run_until_idle()
+    prof.stop()
+    assert all(h.req.finished for h in hs)
+
+    # per-replica gauges, per-tenant gauge/counters, failover counters
+    # — in the SAME exposition as the serving families
+    text = reg.to_prometheus()
+    _, samples = _parse_prom(text)
+    assert samples['ptpu_router_replica_healthy{replica="0"}'] == 1
+    assert samples['ptpu_router_replica_healthy{replica="1"}'] == 0
+    assert samples['ptpu_router_replica_inflight{replica="0"}'] == 0
+    assert samples['ptpu_router_dispatches_total{replica="0"}'] >= 1
+    assert samples["ptpu_router_failovers_total"] == 1
+    # replica 1 holds in-flight work when killed (2 pumps into 4
+    # requests of 4 tokens), so the kill really re-homed requests
+    assert samples["ptpu_router_failover_requests_total"] >= 1
+    assert samples['ptpu_frontdoor_tenant_depth{tenant="cap"}'] == 0
+    assert samples['ptpu_frontdoor_rejected_total'
+                   '{reason="tenant_queue_full"}'] == 1
+    assert samples['ptpu_frontdoor_accepted_total{tenant="cap"}'] == 1
+    assert "# TYPE ptpu_serving_step_seconds" in text  # same registry
+
+    # chrome trace: dispatch spans carry request ids; the failover
+    # span marks which replica died
+    trace_path = str(tmp_path / "trace.json")
+    prof.export_chrome_tracing(trace_path)
+    evs = json.load(open(trace_path))["traceEvents"]
+    dispatches = [e for e in evs if e["name"] == "router.dispatch"]
+    assert {e["args"]["request_id"] for e in dispatches} \
+        >= {h.req.rid for h in hs}
+    assert all("replica" in e["args"] for e in dispatches)
+    failovers = [e for e in evs if e["name"] == "router.failover"]
+    assert [e["args"]["replica"] for e in failovers] == ["1"]
+
+
 def test_dump_embeds_the_owning_registry(tmp_path):
     """An engine built on an INJECTED registry must produce crash
     dumps whose metrics section carries that registry's families, not
