@@ -20,6 +20,8 @@ SCRIPTS = ["bench_resnet50.py", "bench_bert_dp.py", "bench_gpt_hybrid.py",
            "bench_llama_decode.py", "bench_serving_engine.py",
            # paged-KV concurrency under a shared byte budget
            "bench_serving_engine.py --prefix-share",
+           # self-speculative decoding on the repetitive-suffix trace
+           "bench_serving_engine.py --speculative",
            # front-door closed-loop SLO (replica killed mid-run,
            # exactly-once ledger at the boundary)
            "bench_serving_engine.py --frontdoor",

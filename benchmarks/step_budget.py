@@ -9,6 +9,14 @@ JSON line.  Every future claim about the non-matmul tail ("copy/slice is
 72 ms", "quantize is 31 ms") is produced by this tool instead of being
 hand-transcribed from chrome traces.
 
+v2 adds the ``collectives`` record (ROADMAP item #3's multichip-overlap
+tail): per-collective-kind totals (all-reduce / all-gather / reduce-
+scatter / all-to-all / collective-permute) plus the EXPOSED vs
+OVERLAPPED split against the union of compute intervals — run it under
+the 8-chip hybrid meshes and an async collective silently turning
+synchronous becomes a schema-guarded ``exposed_ms`` regression, not a
+profiler anecdote.
+
 Usage:
   # decompose an existing trace directory (jax.profiler logdir)
   python benchmarks/step_budget.py --logdir DIR --steps 3
@@ -40,12 +48,23 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import _path  # noqa: F401, E402  (repo-root import shim)
 import xplane  # noqa: E402
 
-SCHEMA = "ptpu_step_budget_v1"
+SCHEMA = "ptpu_step_budget_v2"
 
 # The stable bucket-key set. Adding a key is a schema bump; the
 # selftest and tests/test_step_budget.py pin this exact set.
+# v2 keeps the buckets of v1 and ADDS the top-level `collectives`
+# record (per-kind totals + exposed-vs-overlapped split) — the
+# multichip-overlap artifact ROADMAP item #3 asks for.
 BUCKET_KEYS = ("matmul", "flash", "quantize", "optimizer", "copy_slice",
                "collective", "fusion", "rng", "loop", "other")
+
+# Buckets whose device time counts as COMPUTE COVER for the collective
+# overlap split: a collective interval inside their union is hidden
+# behind useful work, the remainder is EXPOSED wall time. copy/loop/
+# rng/other are deliberately excluded — a while-envelope spans the
+# whole step and would declare every collective "overlapped".
+COMPUTE_COVER_BUCKETS = ("matmul", "flash", "fusion", "quantize",
+                         "optimizer")
 
 # Classification by the HLO lhs SYMBOL only (xplane.op_symbol) — the
 # event name embeds the whole instruction text including operand lists,
@@ -75,9 +94,69 @@ def classify(op_name: str) -> str:
     return "other"
 
 
+def empty_collectives() -> dict:
+    """The zero collectives record (CPU smoke, single-chip steps)."""
+    return {"by_kind": {}, "total_ms": 0.0, "exposed_ms": 0.0,
+            "overlapped_ms": 0.0, "overlap_frac": 0.0}
+
+
+def collective_detail(events, steps: int = 1) -> dict:
+    """The multichip-overlap artifact: decompose one line's RAW event
+    intervals ``[(op_name, start_ps, end_ps)]`` into per-collective-
+    kind totals and the EXPOSED vs OVERLAPPED split — the part of
+    every collective's span covered by the union of compute intervals
+    (COMPUTE_COVER_BUCKETS) is hidden behind useful work; the rest is
+    serial communication wall time. An overlap REGRESSION (async
+    collectives silently turning synchronous) shows up as exposed_ms
+    growing at constant total_ms — schema-guarded instead of being a
+    profiler anecdote."""
+    coll = []
+    cover = []
+    by_kind = defaultdict(float)
+    n = max(steps, 1)
+    for name, s, e in events:
+        b = classify(name)
+        if b == "collective":
+            sym = xplane.op_symbol(name).lower()
+            kind = next((k for k in xplane.COLLECTIVE_KEYS
+                         if k in sym), "collective")
+            coll.append((s, e, kind))
+        elif b in COMPUTE_COVER_BUCKETS:
+            cover.append((s, e))
+    merged = []
+    for s, e in sorted(cover):
+        if merged and s <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], e)
+        else:
+            merged.append([s, e])
+    total_ps = overlapped_ps = 0
+    for s, e, kind in coll:
+        total_ps += e - s
+        by_kind[kind] += (e - s) / 1e9 / n
+        for cs, ce in merged:
+            if ce <= s:
+                continue
+            if cs >= e:
+                break
+            overlapped_ps += min(e, ce) - max(s, cs)
+    ms = lambda ps: round(ps / 1e9 / n, 3)
+    return {
+        "by_kind": {k: round(v, 3) for k, v in sorted(by_kind.items())},
+        "total_ms": ms(total_ps),
+        "exposed_ms": ms(total_ps - overlapped_ps),
+        "overlapped_ms": ms(overlapped_ps),
+        "overlap_frac": (round(overlapped_ps / total_ps, 4)
+                         if total_ps else 0.0),
+    }
+
+
 def budget_from_times(per_op: Dict[str, float], steps: int = 1,
-                      line: str = "", plane: str = "") -> dict:
-    """Collapse {op_name: total_ms} into the schema-stable record."""
+                      line: str = "", plane: str = "",
+                      collectives: Optional[dict] = None) -> dict:
+    """Collapse {op_name: total_ms} into the schema-stable record.
+    ``collectives`` carries the interval-level overlap record when the
+    caller has one (budget_from_xplane does); else the zero record —
+    the key is always present, schema-stable."""
     buckets = defaultdict(float)
     for name, ms in per_op.items():
         buckets[classify(name)] += ms / max(steps, 1)
@@ -89,6 +168,8 @@ def budget_from_times(per_op: Dict[str, float], steps: int = 1,
         "line": line,
         "total_ms": round(sum(out.values()), 3),
         "buckets": out,
+        "collectives": (collectives if collectives is not None
+                        else empty_collectives()),
     }
 
 
@@ -100,14 +181,23 @@ def budget_from_xplane(path: str, steps: int = 1,
     SELF times (nested region envelopes keep only their non-child
     remainder), and picks the 'XLA Ops' line when present — the per-op
     device line — else the busiest line."""
+    # ONE proto walk feeds both views — a multi-step flagship trace is
+    # tens of MB and this runs per bench invocation
+    pd = list(xplane.planes(path))
     per_line = xplane.op_self_times(path, plane_filter=plane_filter,
-                                    line_filter=line_filter)
+                                    line_filter=line_filter,
+                                    planes_data=pd)
     if not per_line:
         return None
     line = "XLA Ops" if "XLA Ops" in per_line else \
         max(per_line, key=lambda k: len(per_line[k]))
+    intervals = xplane.op_intervals(path, plane_filter=plane_filter,
+                                    line_filter=line_filter,
+                                    planes_data=pd)
     return budget_from_times(per_line[line], steps=steps, line=line,
-                             plane=plane_filter)
+                             plane=plane_filter,
+                             collectives=collective_detail(
+                                 intervals.get(line, []), steps=steps))
 
 
 def budget_from_logdir(logdir: str, steps: int = 1,
@@ -227,6 +317,13 @@ def selftest() -> dict:
         assert abs(got - want) < 1e-6, (k, got, want)
     assert abs(budget["total_ms"] - sum(_FIXTURE_EXPECT.values())) \
         < 1e-6, budget["total_ms"]
+    # v2 collectives record: the fixture's all-reduce sits outside
+    # every compute interval — fully EXPOSED
+    coll = budget["collectives"]
+    assert coll["by_kind"] == {"all-reduce": 0.125}, coll
+    assert abs(coll["total_ms"] - 0.125) < 1e-6, coll
+    assert abs(coll["exposed_ms"] - 0.125) < 1e-6, coll
+    assert coll["overlapped_ms"] == 0.0 and coll["overlap_frac"] == 0.0
     return budget
 
 

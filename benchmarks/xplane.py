@@ -118,16 +118,19 @@ def planes(xplane_path: str):
 
 
 def op_self_times(xplane_path: str, plane_filter: str = "TPU",
-                  line_filter: Optional[str] = None
-                  ) -> Dict[str, Dict[str, float]]:
+                  line_filter: Optional[str] = None,
+                  planes_data=None) -> Dict[str, Dict[str, float]]:
     """{line_name: {op_name: self_ms}} for matching planes.
 
     Self time = event duration minus time covered by nested (contained)
     events on the same line — leaf ops keep their full duration, loop/
-    region envelopes only their non-child remainder.
+    region envelopes only their non-child remainder. ``planes_data``
+    (a materialized ``planes()`` result) skips re-parsing the proto
+    when the caller needs several views of one trace.
     """
     out: Dict[str, Dict[str, float]] = {}
-    for pname, lines, meta in planes(xplane_path):
+    for pname, lines, meta in (planes(xplane_path)
+                               if planes_data is None else planes_data):
         if plane_filter not in pname:
             continue
         for lname, events in lines:
@@ -152,6 +155,29 @@ def op_self_times(xplane_path: str, plane_filter: str = "TPU",
             while stack:
                 pop_into_parent(stack.pop())
     return {k: dict(v) for k, v in out.items()}
+
+
+def op_intervals(xplane_path: str, plane_filter: str = "TPU",
+                 line_filter: Optional[str] = None,
+                 planes_data=None
+                 ) -> Dict[str, List[Tuple[str, int, int]]]:
+    """{line_name: [(op_name, start_ps, end_ps)]} — RAW event
+    intervals for matching planes, no self-time subtraction. Overlap
+    analysis (step_budget's collective exposed-vs-hidden split) needs
+    the original spans, envelopes included. ``planes_data`` as in
+    :func:`op_self_times`."""
+    out: Dict[str, List[Tuple[str, int, int]]] = {}
+    for pname, lines, meta in (planes(xplane_path)
+                               if planes_data is None else planes_data):
+        if plane_filter not in pname:
+            continue
+        for lname, events in lines:
+            if line_filter is not None and line_filter not in lname:
+                continue
+            acc = out.setdefault(lname, [])
+            for mid, off, dur in events:
+                acc.append((meta.get(mid, f"#{mid}"), off, off + dur))
+    return out
 
 
 def op_times(xplane_path: str,

@@ -14,6 +14,28 @@ einsum contraction so per-row results are bitwise identical to the
 scalar path's, which is what makes the serving engine's greedy outputs
 token-identical to ``generate()``'s.
 
+Both flavors also take an optional per-row write length ``wlen``
+(``[B]`` int32) — the SPECULATIVE-VERIFY contract: row ``b`` carries
+``wlen[b]`` real tokens (the last emitted token + its draft window)
+followed by ``t - wlen[b]`` padding, and only the real tokens write
+their k/v (token ``j``'s write is DROPPED when ``j >= wlen[b]`` —
+out-of-range scatter index on the contiguous path, trash-page redirect
+on the paged path), so padded lanes can never clobber live positions
+or run past a row's budget. Reads are untouched: position ``j`` still
+attends causally over everything ``<= pos + j``, so the per-position
+outputs for ``j < wlen[b]`` are bitwise what a sequential
+one-token-at-a-time decode would have computed — the greedy-identity
+proof obligation of speculative decoding (paddle_tpu/serving engine,
+``speculative=True``). NOTE: draft tokens the verifier then REJECTS
+are within ``wlen`` and DO write — their k/v is garbage sitting at
+positions >= the new write position. That is safe for the same reason
+stale tails have always been safe here (the causal mask hides
+positions beyond the current length, and each later step overwrites a
+position right before first attending it), but it means decode-written
+pages/rows must never be shared or indexed, and the serving engine's
+page rollback only returns OVER-ALLOCATED pages, it does not (and need
+not) scrub accepted-range pages.
+
 ``paged_cache_attend`` is the PAGE-TABLE flavor of the same attention:
 instead of one contiguous ``[B, Tmax, KV, D]`` row per sequence, k/v
 live in a shared pool of fixed-size pages ``[num_pages, page, KV, D]``
@@ -58,26 +80,51 @@ def check_cache_pos(pos, t: int, Tmax: int) -> bool:
     return per_row
 
 
-def cache_attend(qr, kr, v, kc, vc, p, per_row: bool):
+def cache_attend(qr, kr, v, kc, vc, p, per_row: bool, wlen=None):
     """Masked fixed-buffer cache attention.
 
     qr: [B, t, H, D] position-encoded queries; kr/v: [B, t, KV, D] new
     keys (position-encoded) / values; kc/vc: [B, Tmax, KV, D] cache
     buffers; p: int32 write position — scalar, or [B] when ``per_row``.
-    GQA folds the query-group dim into the einsum against kv-head
-    caches instead of materializing a head-repeated cache copy.
+    ``wlen`` ([B] int32, per_row only): only the first ``wlen[b]``
+    incoming tokens of row ``b`` write their k/v (speculative verify —
+    see module docstring); None = every token writes. GQA folds the
+    query-group dim into the einsum against kv-head caches instead of
+    materializing a head-repeated cache copy.
 
     Returns (out [B, t, H*D], kc', vc').
     """
+    if wlen is not None and not per_row:
+        # the scalar-pos path writes the whole block unconditionally;
+        # silently dropping wlen would break the verify write contract
+        raise ValueError(
+            "cache_attend: wlen requires per-row positions (the "
+            "speculative verify flavor); got a scalar pos")
     b, t, h, D = qr.shape
     kv = kr.shape[2]
     rep = h // kv
     Tmax = kc.shape[1]
     if per_row:
-        upd = lambda c, u, pi: jax.lax.dynamic_update_slice(
-            c, u.astype(c.dtype), (pi, 0, 0))
-        kc = jax.vmap(upd)(kc, kr, p)
-        vc = jax.vmap(upd)(vc, v, p)
+        if wlen is None:
+            upd = lambda c, u, pi: jax.lax.dynamic_update_slice(
+                c, u.astype(c.dtype), (pi, 0, 0))
+            kc = jax.vmap(upd)(kc, kr, p)
+            vc = jax.vmap(upd)(vc, v, p)
+        else:
+            # write-masked scatter: token j of row b lands at p[b]+j
+            # only when j < wlen[b] AND in range; everything else gets
+            # index Tmax and mode="drop" discards it (a clamped
+            # dynamic_update_slice would smear masked/overflowing
+            # writes over the live tail instead)
+            idx = p[:, None] + jnp.arange(t, dtype=jnp.int32)[None, :]
+            ok = (jnp.arange(t, dtype=jnp.int32)[None, :]
+                  < wlen[:, None]) & (idx < Tmax)
+            widx = jnp.where(ok, idx, Tmax)
+            bidx = jnp.arange(b)[:, None]
+            kc = kc.at[bidx, widx].set(kr.astype(kc.dtype),
+                                       mode="drop")
+            vc = vc.at[bidx, widx].set(v.astype(vc.dtype),
+                                       mode="drop")
         qpos = p[:, None] + jnp.arange(t)[None, :]            # [B, t]
         mask = jnp.arange(Tmax)[None, None, :] <= qpos[:, :, None]
         maskx = mask[:, None, None]                    # [B,1,1,t,Tmax]
@@ -121,7 +168,7 @@ def _dequant(pool_rows, scale_rows):
 
 
 def paged_cache_attend(qr, kr, v, kp, vp, ks, vs, table, p,
-                       out_dtype):
+                       out_dtype, wlen=None):
     """Masked paged-pool cache attention (see module docstring).
 
     qr: [B, t, H, D] position-encoded queries; kr/v: [B, t, KV, D] new
@@ -129,7 +176,10 @@ def paged_cache_attend(qr, kr, v, kp, vp, ks, vs, table, p,
     ks/vs scales are given, else the model dtype); ks/vs: per-page f32
     scales [num_pages, page, KV] or None; table: [B, pages_per_seq]
     int32 page table (rows of inactive lanes must point at the
-    reserved trash page 0); p: int32 write position, scalar or [B].
+    reserved trash page 0); p: int32 write position, scalar or [B];
+    ``wlen`` ([B] int32): only the first ``wlen[b]`` incoming tokens
+    of row ``b`` write (speculative verify — masked writes land in the
+    trash page); None = every token writes.
 
     Returns (out [B, t, H*D], kp', vp', ks', vs').
     """
@@ -147,6 +197,9 @@ def paged_cache_attend(qr, kr, v, kp, vp, ks, vs, table, p,
     # reserved trash page 0 — the gather clamp would otherwise smear
     # them over a REAL page at a wrong offset
     w_ok = qpos < Tmax
+    if wlen is not None:
+        w_ok = w_ok & (jnp.arange(t, dtype=jnp.int32)[None, :]
+                       < wlen[:, None])
     pidx = jnp.minimum(qpos // page, table.shape[1] - 1)
     pid = jnp.where(w_ok,
                     jnp.take_along_axis(table, pidx, axis=1),

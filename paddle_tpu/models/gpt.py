@@ -110,25 +110,38 @@ class GPTBlock(Layer):
         qkv = qkv.reshape([b, t, 3, n_local, self.cfg.head_dim])
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         new_cache = None
-        if cache is not None and len(cache) == 6:
+        if cache is not None and len(cache) in (6, 7):
             # paged pool flavor (see llama._forward_static_cache):
-            # (k_pool, v_pool, k_scale, v_scale, page_table, pos)
-            kp, vp, ksc, vsc, table, pos = cache
+            # (k_pool, v_pool, k_scale, v_scale, page_table, pos);
+            # the 7-tuple appends a per-row write length `wlen` — the
+            # speculative VERIFY flavor (masked writes -> trash page)
+            if len(cache) == 7:
+                kp, vp, ksc, vsc, table, pos, wlen = cache
+            else:
+                kp, vp, ksc, vsc, table, pos = cache
+                wlen = None
             # t=1: bucket-padded extend writes past the table are
             # legal (trash-redirected); only the start pos is checked
             check_cache_pos(pos, 1, table.shape[1] * kp.shape[1])
             out_dtype = getattr(x, "_data", x).dtype
+            has_wl = wlen is not None
 
-            def fp(q, k, v, kp, vp, table, p, *scales):
-                ks, vs = scales if scales else (None, None)
+            def fp(q, k, v, kp, vp, table, p, *rest):
+                if has_wl:
+                    wl, rest = jnp.asarray(rest[0], jnp.int32), rest[1:]
+                else:
+                    wl = None
+                ks, vs = rest if rest else (None, None)
                 out, kp2, vp2, ks2, vs2 = paged_cache_attend(
                     q, k, v, kp, vp, ks, vs, table,
-                    jnp.asarray(p, jnp.int32), jnp.dtype(out_dtype))
-                return (out, kp2, vp2, ks2, vs2) if scales \
+                    jnp.asarray(p, jnp.int32), jnp.dtype(out_dtype),
+                    wlen=wl)
+                return (out, kp2, vp2, ks2, vs2) if rest \
                     else (out, kp2, vp2)
 
-            args = (q, k, v, kp, vp, table, pos) + \
-                ((ksc, vsc) if ksc is not None else ())
+            args = (q, k, v, kp, vp, table, pos) \
+                + ((wlen,) if has_wl else ()) \
+                + ((ksc, vsc) if ksc is not None else ())
             res = apply_op(fp, *args,
                            _op_name="gpt_paged_cache_attn")
             if ksc is not None:
@@ -137,15 +150,25 @@ class GPTBlock(Layer):
                 (attn, kp2, vp2), ks2, vs2 = res, None, None
             new_cache = (kp2, vp2, ks2, vs2, table, pos + t)
         elif cache is not None:
-            k_cache, v_cache, pos = cache
-            per_row = check_cache_pos(pos, t, k_cache.shape[1])
+            if len(cache) == 4:     # speculative VERIFY flavor
+                k_cache, v_cache, pos, wlen = cache
+            else:
+                k_cache, v_cache, pos = cache
+                wlen = None
+            # verify writes past the buffer are index-dropped, so only
+            # the start position is checked on that flavor
+            per_row = check_cache_pos(
+                pos, 1 if wlen is not None else t, k_cache.shape[1])
 
-            def f(q, k, v, kc, vc, p):
+            def f(q, k, v, kc, vc, p, *rest):
+                wl = jnp.asarray(rest[0], jnp.int32) if rest else None
                 return cache_attend(q, k, v, kc, vc,
-                                    jnp.asarray(p, jnp.int32), per_row)
+                                    jnp.asarray(p, jnp.int32), per_row,
+                                    wlen=wl)
 
-            attn, kc2, vc2 = apply_op(f, q, k, v, k_cache, v_cache,
-                                      pos,
+            args = (q, k, v, k_cache, v_cache, pos) \
+                + ((wlen,) if wlen is not None else ())
+            attn, kc2, vc2 = apply_op(f, *args,
                                       _op_name="gpt_static_cache_attn")
             new_cache = (kc2, vc2, pos + t)
         else:
@@ -181,16 +204,23 @@ class GPTModel(Layer):
         if caches is not None:
             # serving decode: learned positions come from the cache's
             # write position (scalar, or per-row for the slot pool);
-            # pos is the LAST element in both the contiguous 3-tuple
-            # and the paged 6-tuple cache flavors
-            base = caches[0][-1]
+            # pos is the LAST element of the contiguous 3-tuple and
+            # paged 6-tuple flavors, second-to-last in the speculative
+            # VERIFY flavors (4/7-tuples, which append `wlen`)
+            verify = len(caches[0]) in (4, 7)
+            base = caches[0][-2] if verify else caches[0][-1]
 
             def mk_pos(p):
                 p = jnp.asarray(p, jnp.int32)
                 ar = jnp.arange(t, dtype=jnp.int32)
-                if p.ndim >= 1:
-                    return p[:, None] + ar[None, :]
-                return (p + ar)[None, :]
+                out = p[:, None] + ar[None, :] if p.ndim >= 1 \
+                    else (p + ar)[None, :]
+                if verify:
+                    # rows near their cap may run p + t past the wpe
+                    # table; those positions are write-masked anyway —
+                    # clip so the embedding gather stays in range
+                    out = jnp.minimum(out, self.cfg.max_seq_len - 1)
+                return out
 
             positions = apply_op(mk_pos, base, _op_name="gpt_cache_pos")
             x = self.wte(input_ids) + self.wpe(positions)

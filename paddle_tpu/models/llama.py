@@ -129,8 +129,11 @@ class LlamaAttention(Layer):
         b, t, _ = x.shape
         # cache flavors: len 3 = contiguous static buffers (k, v, pos);
         # len 6 = paged pool (k_pool, v_pool, k_scale, v_scale,
-        # page_table, pos) — paddle_tpu/serving's paged KV cache
-        static_cache = cache is not None and len(cache) in (3, 6)
+        # page_table, pos) — paddle_tpu/serving's paged KV cache;
+        # len 4 / len 7 append a per-row write-length `wlen` — the
+        # speculative k-token VERIFY flavor (only the first wlen[b]
+        # incoming tokens of row b write their k/v)
+        static_cache = cache is not None and len(cache) in (3, 4, 6, 7)
         past = cache[0].shape[1] if cache is not None \
             and not static_cache and cache[0] is not None else 0
         if past + t > cfg.max_position_embeddings:
@@ -215,24 +218,50 @@ class LlamaAttention(Layer):
         (k_pool, v_pool, k_scale, v_scale, page_table, pos) with
         [num_pages, page, KV, D] pools and a [b, pages_per_seq] int32
         table per row (scales None = model-dtype pages, set = int8
-        pages with per-page f32 scales)."""
+        pages with per-page f32 scales).
+
+        The 4-tuple (k, v, pos, wlen) and 7-tuple (... pos, wlen)
+        flavors are the speculative VERIFY forms: per-row [b] write
+        lengths gate which of the t incoming tokens write their k/v
+        (rejected-draft positions never touch the pools)."""
         t = q.shape[1]
-        paged = len(cache) == 6
+        paged = len(cache) in (6, 7)
+        wlen = None
         if paged:
-            kp, vp, ksc, vsc, table, pos = cache
+            if len(cache) == 7:
+                kp, vp, ksc, vsc, table, pos, wlen = cache
+            else:
+                kp, vp, ksc, vsc, table, pos = cache
             # t=1: only the START position must be in range — the
             # extend prefill's bucket padding may overshoot the table
             # and is redirected into the trash page by the attend
             per_row = check_cache_pos(
                 pos, 1, table.shape[1] * kp.shape[1])
         else:
-            k_cache, v_cache, pos = cache
-            per_row = check_cache_pos(pos, t, k_cache.shape[1])
+            if len(cache) == 4:
+                k_cache, v_cache, pos, wlen = cache
+            else:
+                k_cache, v_cache, pos = cache
+            # verify flavor: writes past the buffer are index-dropped
+            # (cache_attend wlen scatter), so only the START position
+            # must be in range, like the paged flavor
+            per_row = check_cache_pos(
+                pos, 1 if wlen is not None else t, k_cache.shape[1])
         cos_full, sin_full = self._cos, self._sin
         out_dtype = getattr(x, "_data", x).dtype   # the MODEL dtype
 
         def _rope(q, k, p):
-            if per_row:
+            if per_row and wlen is not None:
+                # verify: p + t may run past the rope table for rows
+                # near their length cap — a clamped SLICE start would
+                # mis-rotate the real leading tokens, so gather per
+                # POSITION with a clip that only touches the masked
+                # tail (same fix as the paged extend path below)
+                idx = jnp.clip(
+                    p[:, None] + jnp.arange(t, dtype=jnp.int32)[None],
+                    0, cos_full.shape[0] - 1)
+                cos, sin = cos_full[idx], sin_full[idx]    # [b, t, D/2]
+            elif per_row:
                 sl = lambda tbl, pi: jax.lax.dynamic_slice_in_dim(
                     tbl, pi, t)
                 cos = jax.vmap(partial(sl, cos_full))(p)   # [b, t, D/2]
@@ -252,19 +281,25 @@ class LlamaAttention(Layer):
                 sin = jax.lax.dynamic_slice_in_dim(sin_full, p, t)
             return _apply_rope(q, cos, sin), _apply_rope(k, cos, sin)
 
+        has_wl = wlen is not None
         if paged:
-            def f(q, k, v, kp, vp, table, p, *scales):
+            def f(q, k, v, kp, vp, table, p, *rest):
                 p = jnp.asarray(p, jnp.int32)
+                if has_wl:
+                    wl, rest = jnp.asarray(rest[0], jnp.int32), rest[1:]
+                else:
+                    wl = None
                 qr, kr = _rope(q, k, p)
-                ks, vs = scales if scales else (None, None)
+                ks, vs = rest if rest else (None, None)
                 out, kp2, vp2, ks2, vs2 = paged_cache_attend(
                     qr, kr, v, kp, vp, ks, vs, table, p,
-                    jnp.dtype(out_dtype))
-                return (out, kp2, vp2, ks2, vs2) if scales \
+                    jnp.dtype(out_dtype), wlen=wl)
+                return (out, kp2, vp2, ks2, vs2) if rest \
                     else (out, kp2, vp2)
 
-            args = (q, k, v, kp, vp, table, pos) + \
-                ((ksc, vsc) if ksc is not None else ())
+            args = (q, k, v, kp, vp, table, pos) \
+                + ((wlen,) if has_wl else ()) \
+                + ((ksc, vsc) if ksc is not None else ())
             res = apply_op(f, *args, _op_name="paged_cache_attn")
             if ksc is not None:
                 out, kp2, vp2, ks2, vs2 = res
@@ -274,12 +309,15 @@ class LlamaAttention(Layer):
             return self.o_proj(out), (kp2, vp2, ks2, vs2, table,
                                       pos + t)
 
-        def f(q, k, v, kc, vc, p):
+        def f(q, k, v, kc, vc, p, *rest):
             p = jnp.asarray(p, jnp.int32)
+            wl = jnp.asarray(rest[0], jnp.int32) if rest else None
             qr, kr = _rope(q, k, p)
-            return cache_attend(qr, kr, v, kc, vc, p, per_row)
+            return cache_attend(qr, kr, v, kc, vc, p, per_row, wlen=wl)
 
-        out, kc2, vc2 = apply_op(f, q, k, v, k_cache, v_cache, pos,
+        args = (q, k, v, k_cache, v_cache, pos) \
+            + ((wlen,) if has_wl else ())
+        out, kc2, vc2 = apply_op(f, *args,
                                  _op_name="static_cache_attn")
         return self.o_proj(out), (kc2, vc2, pos + t)
 

@@ -65,8 +65,8 @@ __all__ = ["FaultArm", "EpisodeResult", "ChaosStore",
 # Front-door episodes ALSO sample the serving points (the full stack
 # includes the engines), but coverage of those is owned by the
 # serving sweep.
-SERVING_SWEEP = ("serving.step.decode", "serving.step.prefill",
-                 "serving.prefill.paged")
+SERVING_SWEEP = ("serving.step.decode", "serving.decode.verify",
+                 "serving.step.prefill", "serving.prefill.paged")
 FRONTDOOR_SWEEP = ("router.dispatch", "router.health_probe",
                    "frontdoor.stream_write",
                    "frontdoor.client_disconnect")
@@ -137,6 +137,14 @@ def _prompt_pool() -> List[np.ndarray]:
             [base[:8], rng.randint(1, 96, (3,))]).astype(np.int64))
         _pool.append(np.concatenate(
             [base[:6], rng.randint(1, 96, (1,))]).astype(np.int64))
+        # repetitive prompts (periodic suffix / repeated token): the
+        # SPECULATIVE episodes' n-gram draft proposer finds matches
+        # here, so verify steps really accept multi-token runs — and
+        # the pinned broken-acceptance seed really diverges
+        pat = rng.randint(1, 96, (3,)).astype(np.int64)
+        _pool.append(np.tile(pat, 4))                    # period 3
+        _pool.append(np.full((10,), int(rng.randint(1, 96)),
+                             np.int64))                  # period 1
     return _pool
 
 
@@ -202,18 +210,24 @@ def run_serving_episode(seed: int, max_iters: int = 300) \
     clock = {"t": 0.0}
     max_slots = int(rng.randint(1, 4))
     donate = bool(rng.randint(0, 2))    # TPU-like donated pools or CPU
+    # half the episodes run the SPECULATIVE engine: n-gram drafts +
+    # the widened verify program, audited against the SAME
+    # non-speculative reference outputs — the token-identity law IS
+    # the speculative-correctness law
+    speculative = bool(rng.randint(0, 2))
     # paged geometry: page_size 8 (4 pages per full-length row) with a
     # sampled pool budget — small budgets exercise page-gated
     # admission and queue growth under oversubscription
     num_pages = int(rng.randint(_MAX_LEN // 8 + 1,
                                 max_slots * (_MAX_LEN // 8) + 2))
+    spec_kw = {"speculative": True, "spec_k": 4} if speculative else {}
     eng = ServingEngine(model, max_slots=max_slots, max_len=_MAX_LEN,
                         min_bucket=_MIN_BUCKET,
                         page_size=8, num_pages=num_pages,
                         time_fn=lambda: clock["t"],
                         registry=MetricRegistry(),
                         flight_recorder=FlightRecorder(capacity=8),
-                        auditor=ledger)
+                        auditor=ledger, **spec_kw)
     if donate:
         eng._donate = lambda: (5, 6)
 
@@ -237,6 +251,10 @@ def run_serving_episode(seed: int, max_iters: int = 300) \
                         int(rng.randint(1, 12))))
     schedule = _sample_arms(rng, [
         ("serving.step.decode", 0.6, (1, 3), (0, 8)),
+        # mid-VERIFY-step kill (speculative episodes only reach it):
+        # drafts built and speculative pages claimed — recovery must
+        # replay token-identically and the rollback must leak nothing
+        ("serving.decode.verify", 0.5, (1, 3), (0, 8)),
         ("serving.step.prefill", 0.5, (1, 3), (0, 8)),
         # mid-prefill on the paged cache: pages already claimed, so
         # the abort path (refcount unwind) is what's under fire —
@@ -345,6 +363,12 @@ def _serving_result(seed, violations, schedule, ledger, submitted,
         stats={"requests": len(submitted), "recoveries": recoveries,
                "steps": steps_ok,
                "donate": eng._donate() != (),
+               "speculative": eng.speculative,
+               "spec_emitted": (eng._spec["emitted"]
+                                if eng.speculative else 0),
+               "spec_accepted_drafts": (
+                   eng._spec["accepted_draft_tokens"]
+                   if eng.speculative else 0),
                "max_slots": eng.max_slots,
                "num_pages": eng.cache.num_pages,
                "prefix_hit_tokens": eng.cache.prefix_hit_tokens,

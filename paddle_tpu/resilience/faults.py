@@ -12,6 +12,7 @@ Wired-in points (see docs/RESILIENCE.md for the catalogue):
 
 ===========================  ===========================================
 ``serving.step.decode``      right before the decode-step jit call
+``serving.decode.verify``    mid-verify-step (speculative decoding)
 ``serving.step.prefill``     inside the (re-)prefill program driver
 ``serving.prefill.paged``    paged prefill, AFTER pages are claimed
 ``router.dispatch``          router submit, before replica binding
@@ -71,6 +72,10 @@ __all__ = ["InjectedFault", "maybe_fail", "inject", "clear", "injected",
 # the soak; tests/test_chaos.py asserts the sweep covers every entry.
 KNOWN_POINTS = (
     "serving.step.decode",
+    # speculative verify step: drafts built, pages claimed/COW'd,
+    # the widened program not yet run — recovery must replay
+    # token-identically and the page rollback must leak nothing
+    "serving.decode.verify",
     "serving.step.prefill",
     # mid-prefill on the PAGED cache: pages claimed, table row live,
     # prefill program not yet run — the abort path must return them

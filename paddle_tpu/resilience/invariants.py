@@ -19,7 +19,10 @@ next reviewer to spot the next instance:
 - **Greedy token identity** (:func:`token_prefix_violations`): a
   request's delivered tokens are a prefix of the uninjected greedy
   replay of the same prompt — faults and recoveries may shorten output
-  (deadline/cancel) but never corrupt it.
+  (deadline/cancel) but never corrupt it. SPECULATIVE engines are
+  audited against the same non-speculative references, so draft
+  acceptance and rejected-tail rollback sit under this law too: a
+  broken acceptance rule reads as divergence, not as a new invariant.
 - **Loss-trajectory continuity** (:func:`loss_trajectory_violations`):
   every (step, loss) a resilient training run reports matches the
   uninjected baseline bit-for-bit, whatever crashes and restores
@@ -192,7 +195,10 @@ def token_prefix_violations(
 
 def engine_leak_violations(engine) -> List[str]:
     """A quiesced engine must hold nothing: no leased slots, no queued
-    requests, no undelivered terminal requests."""
+    requests, no undelivered terminal requests — and, on a SPECULATIVE
+    engine, no draft-proposer state for requests that are no longer in
+    a slot (eviction/deadline/cancel/recover must release it, or a
+    long-lived engine's proposer index grows without bound)."""
     out = []
     active = engine.cache.active_slots()
     if active:
@@ -207,6 +213,15 @@ def engine_leak_violations(engine) -> List[str]:
         out.append(
             f"undelivered terminal requests "
             f"{[r.rid for r in engine._undelivered]}")
+    if getattr(engine, "speculative", False):
+        live = {engine.cache.slots[s].rid
+                for s in engine.cache.active_slots()}
+        stale = [rid for rid in engine.proposer.tracked()
+                 if rid not in live]
+        if stale:
+            out.append(
+                f"leaked draft-proposer state for rids {stale} "
+                f"(request gone, n-gram index still held)")
     return out
 
 

@@ -21,6 +21,15 @@ buckets (+1 COW copy program), asserted in
 tests/test_serving_engine.py + tests/test_paged_kv.py via trace
 counting — paging adds ZERO decode compiles.
 
+``speculative=True`` turns on SELF-SPECULATIVE decoding: an n-gram /
+prompt-lookup proposer (``spec_decode.NgramProposer``, no second
+model) drafts up to ``spec_k - 1`` tokens per greedy row per step and
+ONE widened verify program scores all k candidate positions in a
+single weight pass, emitting the longest accepted prefix — provably
+token-identical to non-speculative greedy decode (the acceptance rule
+IS sequential greedy run k steps ahead; tests/test_spec_decode.py).
+Rows with no usable draft run at k=1 inside the same program.
+
 Failure contract (docs/RESILIENCE.md): typed errors in ``errors``
 (``QueueFull`` / ``DeadlineExceeded`` / ``EngineBroken`` /
 ``EngineIdle`` / ``EngineClosed``), ``ServingEngine.recover()`` after
@@ -41,10 +50,12 @@ from .sampling import SamplingParams, sample_token  # noqa: F401
 from .scheduler import (FIFOScheduler, Request, bucket_for,  # noqa: F401
                         prefill_buckets)
 from .slot_cache import PagedKVCache, SlotKVCache  # noqa: F401
+from .spec_decode import NgramProposer  # noqa: F401
 
 __all__ = ["ServingEngine", "EngineMetrics", "SamplingParams",
            "sample_token", "FIFOScheduler", "Request", "bucket_for",
            "prefill_buckets", "SlotKVCache", "PagedKVCache",
+           "NgramProposer",
            "ServingError",
            "QueueFull", "DeadlineExceeded", "EngineBroken",
            "EngineIdle", "EngineClosed", "RequestCancelled",

@@ -23,6 +23,12 @@ program per step, driven by a host loop):
         finished = engine.step()
     print(r1.output_ids, engine.metrics.summary())
 
+``speculative=True`` swaps the decode step for ONE widened k-token
+VERIFY program fed by self-drafted n-gram proposals
+(spec_decode.NgramProposer) — greedy outputs stay provably
+token-identical to this path and to ``generate()``; see
+docs/SERVING.md "Speculative decoding".
+
 Resilience contract (docs/RESILIENCE.md): a step that fails with
 donated cache pools marks the engine broken — ``recover()`` rebuilds
 the slot-pool KV cache from host-side request state (re-prefilling
@@ -52,6 +58,7 @@ from .metrics import EngineMetrics
 from .sampling import SamplingParams, sample_token
 from .scheduler import FIFOScheduler, Request, bucket_for
 from .slot_cache import PagedKVCache, SlotKVCache
+from .spec_decode import NgramProposer
 
 __all__ = ["ServingEngine"]
 
@@ -111,7 +118,10 @@ class ServingEngine:
                  page_size: Optional[int] = None,
                  num_pages: Optional[int] = None,
                  kv_dtype: Optional[str] = None,
-                 prefix_sharing: Optional[bool] = None):
+                 prefix_sharing: Optional[bool] = None,
+                 speculative: bool = False,
+                 spec_k: int = 4,
+                 spec_ngram: int = 2):
         self.adapter = _ModelAdapter(model)
         model.eval()
         self.max_slots = int(max_slots)
@@ -153,6 +163,22 @@ class ServingEngine:
             self.kv_quant = kv_dtype == "int8"
             self.prefix_sharing = True if prefix_sharing is None \
                 else bool(prefix_sharing)
+        # self-speculative decoding: n-gram drafts verified k tokens
+        # per weight pass through ONE widened verify program (greedy
+        # rows only; everything else falls back to k=1 IN the same
+        # program). See docs/SERVING.md "Speculative decoding".
+        self.speculative = bool(speculative)
+        if self.speculative:
+            if spec_k < 2:
+                raise ValueError(
+                    f"spec_k must be >= 2 (k includes the k=1 base "
+                    f"token), got {spec_k}")
+            self.spec_k = int(spec_k)
+            self.proposer = NgramProposer(ngram=spec_ngram,
+                                          max_draft=self.spec_k - 1)
+        elif spec_k != 4 or spec_ngram != 2:
+            raise ValueError(
+                "spec_k/spec_ngram only apply with speculative=True")
         self.cache = self._new_cache()
         self.scheduler = FIFOScheduler()
         self.registry = registry if registry is not None \
@@ -166,6 +192,7 @@ class ServingEngine:
                                      registry=self.registry)
         self._params, self._buffers = model.raw_state()
         self._decode_jit = None
+        self._verify_jit = None
         self._prefill_jit = None
         self._extend_jit = None
         self._copy_jit = None
@@ -199,7 +226,7 @@ class ServingEngine:
         # python-side-effect counters bumped at TRACE time: the compile-
         # count contract (1 decode + O(log max_len) prefill buckets) is
         # asserted against these in tests
-        self.trace_counts = {"decode": 0, "prefill": {},
+        self.trace_counts = {"decode": 0, "verify": 0, "prefill": {},
                              "extend": {}, "copy": 0}
         reg = self.registry
         self._m_queue_depth = reg.gauge(
@@ -257,6 +284,28 @@ class ServingEngine:
                                      "prefix_lookup_tokens": 0,
                                      "cow_copies": 0}
             self.peak_active_slots = 0
+        if self.speculative:
+            self._m_spec_acc = reg.histogram(
+                "ptpu_serving_spec_accepted_length",
+                "tokens emitted per row per verify step (1 = k=1 "
+                "fallback or fully rejected draft)",
+                buckets=tuple(float(i) for i in
+                              range(1, self.spec_k + 1)))
+            self._m_spec_draft = reg.counter(
+                "ptpu_serving_spec_draft_tokens_total",
+                "draft tokens proposed to the verify program")
+            self._m_spec_accepted = reg.counter(
+                "ptpu_serving_spec_accepted_draft_tokens_total",
+                "draft tokens confirmed by the verify program")
+            self._m_spec_hit = reg.gauge(
+                "ptpu_serving_spec_draft_hit_rate",
+                "cumulative accepted/proposed draft-token ratio")
+            # host-side aggregate: the SPEC_DECODE bench line and
+            # spec_stats() read this (registry histograms only keep
+            # bucketized counts)
+            self._spec = {"steps": 0, "rows": 0, "emitted": 0,
+                          "draft_tokens": 0, "accepted_draft_tokens": 0,
+                          "acc_len_hist": [0] * (self.spec_k + 1)}
 
     def _new_cache(self):
         """Fresh KV pool in the configured layout (init + recover)."""
@@ -288,6 +337,23 @@ class ServingEngine:
             if cur > last[key]:
                 counter.inc(cur - last[key])
             last[key] = cur
+
+    def spec_stats(self) -> dict:
+        """Speculative-decoding snapshot (raises on a non-speculative
+        engine): verify steps, per-row emission totals, draft
+        proposal/acceptance counts, accepted-length histogram."""
+        if not self.speculative:
+            raise RuntimeError("spec_stats() on a non-speculative "
+                               "engine")
+        s = dict(self._spec)
+        s["acc_len_hist"] = list(s["acc_len_hist"])
+        s["k"] = self.spec_k
+        s["draft_hit_rate"] = (
+            s["accepted_draft_tokens"] / s["draft_tokens"]
+            if s["draft_tokens"] else 0.0)
+        s["accepted_per_step"] = (
+            s["emitted"] / s["rows"] if s["rows"] else 0.0)
+        return s
 
     def paged_stats(self) -> dict:
         """Paged-pool snapshot for benchmarks/dashboards (raises on a
@@ -557,57 +623,196 @@ class ServingEngine:
             admitted.append(req.rid)
             if req.finished:
                 self._evict(slot, req, finished)
-        # 2) one decode step over all occupied slots
+        # 2) one decode step over all occupied slots — the speculative
+        # engine runs its widened k-token VERIFY program instead (same
+        # contract: ONE compiled program for any request mix)
         active = self.cache.active_slots()
         if active:
-            toks = np.zeros((self.max_slots, 1), np.int64)
-            pos = np.zeros((self.max_slots,), np.int32)
-            mask = np.zeros((self.max_slots,), bool)
-            copies = []
-            for s in active:
-                req = self.cache.slots[s]
-                toks[s, 0] = req.out_tokens[-1]
-                pos[s] = req.next_pos
-                mask[s] = True
-                if self.paged:
-                    # the write may cross into a new page (allocate)
-                    # or a shared one (COW) — resolve BEFORE the step
-                    c = self.cache.ensure_decode_page(s, req.next_pos)
-                    if c is not None:
-                        copies.append(c)
-            maybe_fail("serving.step.decode", step=self._step_idx - 1)
-            with span("serving.decode", batch=len(active),
-                      request_ids=[self.cache.slots[s].rid
-                                   for s in active]):
-                if self.paged:
-                    self._run_copies(copies)
-                    logits, ks, vs, kss, vss = self._decode_fn()(
-                        self._params, self._buffers, toks, pos, mask,
-                        self.cache.page_table.copy(),
-                        self.cache.ks, self.cache.vs,
-                        self.cache.kss, self.cache.vss)
-                    self.cache.ks, self.cache.vs = list(ks), list(vs)
-                    self.cache.kss, self.cache.vss = \
-                        list(kss), list(vss)
-                else:
-                    logits, ks, vs = self._decode_fn()(
-                        self._params, self._buffers, toks, pos, mask,
-                        self.cache.ks, self.cache.vs)
-                    self.cache.ks, self.cache.vs = list(ks), list(vs)
-                logits = np.asarray(jax.device_get(logits))
-            for s in active:
-                req = self.cache.slots[s]
-                tok = sample_token(logits[s], req.sampling, req._rng)
-                req.out_tokens.append(tok)
-                self.metrics.on_token(req.rid)
-                if self._is_finished(req, tok):
-                    self._evict(s, req, finished)
+            if self.speculative:
+                self._decode_verify(active, finished)
+            else:
+                self._decode_plain(active, finished)
         self.metrics.on_step(len(active))
         if self.paged:
             self.peak_active_slots = max(self.peak_active_slots,
                                          len(active))
             self._publish_page_stats()
         return admitted, len(active)
+
+    def _decode_plain(self, active, finished: List[Request]) -> None:
+        """The k=1 decode step (non-speculative engines)."""
+        toks = np.zeros((self.max_slots, 1), np.int64)
+        pos = np.zeros((self.max_slots,), np.int32)
+        mask = np.zeros((self.max_slots,), bool)
+        copies = []
+        for s in active:
+            req = self.cache.slots[s]
+            toks[s, 0] = req.out_tokens[-1]
+            pos[s] = req.next_pos
+            mask[s] = True
+            if self.paged:
+                # the write may cross into a new page (allocate)
+                # or a shared one (COW) — resolve BEFORE the step
+                c = self.cache.ensure_decode_page(s, req.next_pos)
+                if c is not None:
+                    copies.append(c)
+        # COW copies run BEFORE the fault point: ensure_decode_page
+        # already flipped the table rows, and a retried (non-broken)
+        # step would not re-issue a lost copy — device state must be
+        # consistent with the table when the fault can fire
+        if self.paged:
+            self._run_copies(copies)
+        maybe_fail("serving.step.decode", step=self._step_idx - 1)
+        with span("serving.decode", batch=len(active),
+                  request_ids=[self.cache.slots[s].rid
+                               for s in active]):
+            if self.paged:
+                logits, ks, vs, kss, vss = self._decode_fn()(
+                    self._params, self._buffers, toks, pos, mask,
+                    self.cache.page_table.copy(),
+                    self.cache.ks, self.cache.vs,
+                    self.cache.kss, self.cache.vss)
+                self.cache.ks, self.cache.vs = list(ks), list(vs)
+                self.cache.kss, self.cache.vss = \
+                    list(kss), list(vss)
+            else:
+                logits, ks, vs = self._decode_fn()(
+                    self._params, self._buffers, toks, pos, mask,
+                    self.cache.ks, self.cache.vs)
+                self.cache.ks, self.cache.vs = list(ks), list(vs)
+            logits = np.asarray(jax.device_get(logits))
+        for s in active:
+            req = self.cache.slots[s]
+            tok = sample_token(logits[s], req.sampling, req._rng)
+            req.out_tokens.append(tok)
+            self.metrics.on_token(req.rid)
+            if self._is_finished(req, tok):
+                self._evict(s, req, finished)
+
+    def _decode_verify(self, active, finished: List[Request]) -> None:
+        """One speculative verify step: draft up to k-1 tokens per
+        greedy row from its own history (n-gram prompt lookup), score
+        all k candidate positions in ONE widened forward over the
+        static cache, and emit the longest accepted prefix — provably
+        the tokens sequential greedy decode would have produced, since
+        each position's logits are computed under the identical causal
+        mask and cache state (see docs/SERVING.md).
+
+        Rows without a usable draft (no n-gram hit, sampled decoding,
+        or 1 token of budget left) run at per-row length 1 INSIDE the
+        same program — the k=1 fallback costs no extra compile.
+        wlen write-masks the PADDED lanes beyond each row's draft
+        window; drafted-but-rejected tokens DO write k/v, which is
+        safe because those positions sit beyond the new write position
+        (causal-masked until overwritten, exactly like any stale
+        tail) and are never shared/indexed — so the only rollback
+        needed is returning over-allocated pages."""
+        K = self.spec_k
+        toks = np.zeros((self.max_slots, K), np.int64)
+        pos = np.zeros((self.max_slots,), np.int32)
+        wlen = np.zeros((self.max_slots,), np.int32)
+        mask = np.zeros((self.max_slots,), bool)
+        copies = []
+        for s in active:
+            req = self.cache.slots[s]
+            toks[s, 0] = req.out_tokens[-1]
+            pos[s] = req.next_pos
+            mask[s] = True
+            n = 1
+            # a draft longer than the remaining token budget is wasted
+            # verify compute AND would write past the admission
+            # reservation — clamp so every write stays inside the
+            # request's reserved span
+            budget = req.max_new_tokens - len(req.out_tokens)
+            if budget > 1 and req.sampling.temperature <= 0:
+                draft = self.proposer.propose(
+                    req.rid, req.full_ids, min(K - 1, budget - 1))
+                if len(draft):
+                    toks[s, 1:1 + len(draft)] = draft
+                    n = 1 + len(draft)
+                    self._spec["draft_tokens"] += len(draft)
+                    self._m_spec_draft.inc(len(draft))
+            wlen[s] = n
+            if self.paged:
+                copies += self.cache.ensure_decode_range(
+                    s, req.next_pos, n)
+        # COW copies BEFORE the kill point (same reason as the plain
+        # decode: flipped table rows must never outrun their copies)
+        if self.paged:
+            self._run_copies(copies)
+        # mid-verify-step kill point: drafts built, pages
+        # claimed/COW'd, nothing emitted yet — recovery must replay
+        # token-identically and leak no pages (chaos-audited)
+        maybe_fail("serving.decode.verify", step=self._step_idx - 1)
+        with span("serving.verify", batch=len(active), k=K,
+                  request_ids=[self.cache.slots[s].rid
+                               for s in active]):
+            if self.paged:
+                logits, greedy, acc, ks, vs, kss, vss = \
+                    self._verify_fn()(
+                        self._params, self._buffers, toks, pos, mask,
+                        wlen, self.cache.page_table.copy(),
+                        self.cache.ks, self.cache.vs,
+                        self.cache.kss, self.cache.vss)
+                self.cache.ks, self.cache.vs = list(ks), list(vs)
+                self.cache.kss, self.cache.vss = list(kss), list(vss)
+            else:
+                logits, greedy, acc, ks, vs = self._verify_fn()(
+                    self._params, self._buffers, toks, pos, mask,
+                    wlen, self.cache.ks, self.cache.vs)
+                self.cache.ks, self.cache.vs = list(ks), list(vs)
+            logits = np.asarray(jax.device_get(logits))
+            greedy = np.asarray(jax.device_get(greedy))
+            acc = np.asarray(jax.device_get(acc))
+        for s in active:
+            req = self.cache.slots[s]
+            emitted = self._emit_verified(s, req, greedy[s],
+                                          int(acc[s]), logits[s])
+            self._spec["rows"] += 1
+            self._spec["emitted"] += emitted
+            self._spec["accepted_draft_tokens"] += emitted - 1
+            self._spec["acc_len_hist"][min(emitted, K)] += 1
+            self._m_spec_acc.observe(float(emitted))
+            if emitted > 1:
+                self._m_spec_accepted.inc(emitted - 1)
+            if self.paged and not req.finished:
+                # return pages past the next write position that only
+                # rejected draft tokens touched (finished rows release
+                # everything below)
+                self.cache.rollback_speculation(s, req.next_pos)
+            if req.finished:
+                self._evict(s, req, finished)
+        self._spec["steps"] += 1
+        if self._spec["draft_tokens"]:
+            self._m_spec_hit.set(self._spec["accepted_draft_tokens"]
+                                 / self._spec["draft_tokens"])
+
+    def _emit_verified(self, slot: int, req: Request,
+                       greedy_row: np.ndarray, acc: int,
+                       logits_row: np.ndarray) -> int:
+        """Apply one row's verify result: append the accepted tokens
+        (greedy rows: the first ``acc`` in-program argmax tokens,
+        stopping AT an EOS exactly like sequential decode; sampled
+        rows: one host-sampled token from position 0). Returns how
+        many tokens were emitted. Factored out so the chaos pinned-red
+        test can swap in a deliberately broken acceptance."""
+        if req.sampling.temperature > 0:
+            tok = sample_token(logits_row[0], req.sampling, req._rng)
+            req.out_tokens.append(tok)
+            self.metrics.on_token(req.rid)
+            self._is_finished(req, tok)
+            return 1
+        emitted = 0
+        for j in range(acc):
+            tok = int(greedy_row[j])
+            req.out_tokens.append(tok)
+            self.metrics.on_token(req.rid)
+            emitted += 1
+            if self._is_finished(req, tok):
+                # sequential decode stops AT the EOS — accepted
+                # tokens beyond it must not surface
+                break
+        return emitted
 
     def _evict(self, slot: int, req: Request,
                finished: List[Request]) -> None:
@@ -616,6 +821,8 @@ class ServingEngine:
         finished.append(req)
         self._m_evict.labels(reason=req.finish_reason or "unknown").inc()
         self.metrics.on_finished(req.rid)
+        if self.speculative:
+            self.proposer.release(req.rid)
 
     def _expire_deadlines(self, finished: List[Request]) -> None:
         """Cancel queued and in-flight requests past their deadline
@@ -717,6 +924,8 @@ class ServingEngine:
         req.finished, req.finish_reason = True, reason
         req.error = RequestCancelled(req.rid, reason)
         self.metrics.on_finished(req.rid)
+        if self.speculative:
+            self.proposer.release(req.rid)
         if self.auditor is not None:
             self.auditor.on_delivered(req, via="cancel")
         return True
@@ -809,6 +1018,12 @@ class ServingEngine:
                     and int(np.argmax(logits)) != req.out_tokens[-1]:
                 mismatches += 1
                 self._m_replay_mismatch.inc()
+        if self.speculative:
+            # prune draft-proposer state to the requests that survived
+            # into the rebuilt slot table (a finished/disconnected
+            # request's index must not outlive it — the no-leak law)
+            self.proposer.retain(
+                r.rid for r in self.cache.slots if r is not None)
         self._broken = None
         self._m_recover.inc()
         dt = self.metrics.now() - t0
@@ -905,6 +1120,8 @@ class ServingEngine:
             # terminal requests stranded by a failed step with no
             # successful step left to carry them out
             done.extend(self._undelivered)
+        if self.speculative:
+            self.proposer.retain(())       # drained engine holds none
         # owe the whole return until it happens: if the auditor raises
         # here, a re-issued drain() flushes the debt to the caller
         self._undelivered = done
@@ -1063,11 +1280,14 @@ class ServingEngine:
             c.ks, c.vs = list(out[0]), list(out[1])
             c.kss, c.vss = list(out[2]), list(out[3])
 
-    def _paged_caches(self, ks, vs, kss, vss, table, pos):
+    def _paged_caches(self, ks, vs, kss, vss, table, pos, wlen=None):
         """Per-layer paged cache tuples for the model forward
-        (scales None on the model-dtype path)."""
+        (scales None on the model-dtype path; ``wlen`` appends the
+        per-row write-length element — the speculative verify
+        7-tuple flavor)."""
+        tail = (wlen,) if wlen is not None else ()
         return [(k, v, kss[i] if kss else None,
-                 vss[i] if vss else None, table, pos)
+                 vss[i] if vss else None, table, pos) + tail
                 for i, (k, v) in enumerate(zip(ks, vs))]
 
     @staticmethod
@@ -1253,6 +1473,87 @@ class ServingEngine:
         self._decode_jit = jax.jit(pure,
                                    donate_argnums=self._donate())
         return self._decode_jit
+
+    def _verify_fn(self):
+        """THE speculative verify program (compiled once per engine):
+        every occupied slot advances up to k tokens at its own
+        position. The input block per row is [last emitted token,
+        draft_1 .. draft_{k-1}] (padded past the row's per-row length
+        ``wlen``); the cache write of token j is masked to j < wlen
+        (models/_decode_cache wlen contract), the causal mask already
+        scopes position j to everything <= pos + j, and the program
+        returns, for every row: the k position logits, the k greedy
+        (argmax) tokens, and the ACCEPTED LENGTH — 1 (the k=1 base
+        token, always emitted) plus the leading run of draft tokens
+        that equal the greedy token predicted one position earlier.
+        That acceptance rule is exactly greedy sequential decode run k
+        steps ahead, which is the token-identity proof: an accepted
+        token had the same logits inputs (same cache state, same
+        causal scope) as its sequential counterpart. k=1 fallback rows
+        are just wlen=1 rows of the SAME program — zero extra
+        compiles, trace-count asserted."""
+        if self._verify_jit is not None:
+            return self._verify_jit
+        ad = self.adapter
+
+        def accept(toks, logits, wl_eff, active):
+            K = toks.shape[1]
+            g = jnp.argmax(logits, axis=-1).astype(jnp.int32)  # [B, K]
+            if K > 1:
+                # draft j (input position j, 1-based) is accepted iff
+                # it equals the greedy prediction at position j-1 AND
+                # is a real draft token (j < wlen); the leading-run
+                # length is a cumprod sum
+                m = (toks[:, 1:].astype(jnp.int32) == g[:, :-1]) \
+                    & (jnp.arange(1, K, dtype=jnp.int32)[None, :]
+                       < wl_eff[:, None])
+                acc = 1 + jnp.sum(jnp.cumprod(m.astype(jnp.int32),
+                                              axis=1), axis=1)
+            else:
+                acc = jnp.ones(toks.shape[0], jnp.int32)
+            acc = jnp.where(active, acc, 0).astype(jnp.int32)
+            return g, acc
+
+        if self.paged:
+            def pure(params, buffers, toks, pos, active, wlen, tables,
+                     ks, vs, kss, vss):
+                self.trace_counts["verify"] += 1
+                pos_eff = jnp.where(active, pos, 0).astype(jnp.int32)
+                wl_eff = jnp.where(active, wlen, 0).astype(jnp.int32)
+                tab_eff = jnp.where(active[:, None], tables, 0)
+                caches = self._paged_caches(ks, vs, kss, vss,
+                                            tab_eff, pos_eff,
+                                            wlen=wl_eff)
+                with ad.model.bind_state(params, buffers):
+                    h, new_caches = ad.call(Tensor(toks), caches)
+                    logits = ad.head(h)._data        # [B, K, vocab]
+                logits = jnp.where(active[:, None, None], logits, 0.0)
+                g, acc = accept(toks, logits, wl_eff, active)
+                return (logits, g, acc) \
+                    + self._unpack_paged(new_caches)
+
+            self._verify_jit = jax.jit(
+                pure, donate_argnums=self._donate_idx(7, 8, 9, 10))
+            return self._verify_jit
+
+        def pure(params, buffers, toks, pos, active, wlen, ks, vs):
+            self.trace_counts["verify"] += 1
+            pos_eff = jnp.where(active, pos, 0).astype(jnp.int32)
+            wl_eff = jnp.where(active, wlen, 0).astype(jnp.int32)
+            caches = [(k, v, pos_eff, wl_eff)
+                      for k, v in zip(ks, vs)]
+            with ad.model.bind_state(params, buffers):
+                h, new_caches = ad.call(Tensor(toks), caches)
+                logits = ad.head(h)._data            # [B, K, vocab]
+            logits = jnp.where(active[:, None, None], logits, 0.0)
+            g, acc = accept(toks, logits, wl_eff, active)
+            ks2 = [getattr(c[0], "_data", c[0]) for c in new_caches]
+            vs2 = [getattr(c[1], "_data", c[1]) for c in new_caches]
+            return logits, g, acc, ks2, vs2
+
+        self._verify_jit = jax.jit(
+            pure, donate_argnums=self._donate_idx(6, 7))
+        return self._verify_jit
 
     @staticmethod
     def _donate():

@@ -517,6 +517,51 @@ class PagedKVCache(_SlotTable):
             return (page, dst)
         return None
 
+    def ensure_decode_range(self, slot: int, pos: int,
+                            n: int) -> List[Tuple[int, int]]:
+        """Make positions ``pos .. pos + n - 1`` writable for a
+        speculative verify step: every page the range touches is
+        allocated (or COW'd if shared) exactly like
+        :meth:`ensure_decode_page` does for the single k=1 position.
+        Returns the (src, dst) device copies to run before the step.
+        The range never exceeds the request's admission reservation
+        (the engine clamps ``n`` to the tokens the request may still
+        emit), so allocation cannot outrun the committed budget."""
+        copies: List[Tuple[int, int]] = []
+        P = self.page_size
+        for idx in range(pos // P, (pos + n - 1) // P + 1):
+            c = self.ensure_decode_page(slot, max(pos, idx * P))
+            if c is not None:
+                copies.append(c)
+        return copies
+
+    def rollback_speculation(self, slot: int,
+                             next_write_pos: int) -> int:
+        """Return the pages a verify step allocated beyond what the
+        ACCEPTED tokens need: every row page past the page holding
+        ``next_write_pos`` (where the next decode token's k/v will
+        land) goes back to the pool and its reservation budget is
+        restored. Safe by construction: pages past that index can only
+        hold rejected-draft garbage — shared/indexed prompt pages all
+        live at or below the next write position (matching is capped
+        at prompt_len - 1 <= next_write_pos), so a rollback never
+        drops a COW source or an index-owned page."""
+        req = self.slots[slot]
+        row = self.page_table[slot]
+        plan = self._plans.get(req.rid) if req is not None else None
+        freed = 0
+        for j in range(next_write_pos // self.page_size + 1,
+                       self.pages_per_slot):
+            page = int(row[j])
+            if page:
+                row[j] = 0
+                self._unref(page)
+                freed += 1
+        if freed and plan is not None:
+            plan["allocated"] -= freed
+            self._committed += freed
+        return freed
+
     def release(self, slot: int) -> None:
         """Free the slot lease AND its pages: every referenced page
         drops a refcount (shared pages stay for their other readers;

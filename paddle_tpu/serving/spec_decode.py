@@ -1,0 +1,127 @@
+"""Self-speculative draft proposal: n-gram / prompt-lookup decoding.
+
+The draft side of speculative decoding without a second model: each
+request's own token history (prompt + generated tokens) is indexed by
+n-gram, and when the current suffix n-gram has occurred before, the
+tokens that FOLLOWED that earlier occurrence are proposed as the next
+draft window. On repetitive traffic — code, templated chat, extraction
+over a quoted document, or any greedy loop that falls into a cycle —
+the continuation after a repeated n-gram is very often the same
+continuation again, so the verify program accepts several tokens per
+weight pass. On non-repetitive traffic the proposer simply finds no
+match and the engine runs that row at k=1 inside the same compiled
+verify program (the fallback costs no extra compile and no extra host
+round-trip).
+
+Pure host-side and deterministic by construction: proposals are a
+function of the token history alone (no RNG, no clock), which is what
+keeps speculative greedy decoding replayable — and lets the chaos
+harness treat drafts as part of the seeded episode.
+
+State is per-request and incremental (each call only indexes the
+tokens appended since the last call), so the per-step cost is O(new
+tokens x ngram span), not O(history). The engine releases a request's
+state when its slot is evicted (finish, deadline, cancel, disconnect)
+and prunes to the surviving in-flight set after ``recover()`` — the
+no-leak law for proposer state is audited by the chaos invariants.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional
+
+import numpy as np
+
+__all__ = ["NgramProposer"]
+
+_EMPTY = np.zeros((0,), np.int64)
+
+
+class NgramProposer:
+    """Prompt-lookup draft proposer over per-request token history.
+
+    ``ngram`` is the longest suffix n-gram matched (the proposer backs
+    off to shorter n-grams down to ``min_ngram`` — a single repeated
+    token already drafts on a 1-gram); ``max_draft`` caps the proposed
+    window (the engine passes ``spec_k - 1``). Matching prefers the
+    longest n-gram, and within one n-gram length the MOST RECENT
+    earlier occurrence (recency tracks the local pattern of the
+    sequence better than the first occurrence).
+    """
+
+    def __init__(self, ngram: int = 2, max_draft: int = 3,
+                 min_ngram: int = 1):
+        if ngram < 1:
+            raise ValueError(f"ngram must be >= 1, got {ngram}")
+        if not 1 <= min_ngram <= ngram:
+            raise ValueError(
+                f"min_ngram must be in [1, ngram={ngram}], got "
+                f"{min_ngram}")
+        if max_draft < 0:
+            raise ValueError(
+                f"max_draft must be >= 0, got {max_draft}")
+        self.ngram = int(ngram)
+        self.min_ngram = int(min_ngram)
+        self.max_draft = int(max_draft)
+        # rid -> {"done": processed history length,
+        #         "maps": {n: {ngram tuple: last end position}}}
+        self._state: Dict[int, dict] = {}
+
+    # -- state lifecycle (engine hooks) --------------------------------
+    def release(self, rid: int) -> None:
+        """Drop one request's index (slot eviction: finish, deadline,
+        cancel, disconnect)."""
+        self._state.pop(rid, None)
+
+    def retain(self, rids: Iterable[int]) -> None:
+        """Keep only the given requests' indexes (``recover()`` prunes
+        to the rebuilt in-flight set; ``drain()`` passes ())."""
+        keep = set(rids)
+        for rid in [r for r in self._state if r not in keep]:
+            del self._state[rid]
+
+    def tracked(self) -> list:
+        """Rids with live index state (the no-leak audit surface)."""
+        return sorted(self._state)
+
+    # -- proposal ------------------------------------------------------
+    def _update(self, st: dict, ids: np.ndarray) -> None:
+        """Index every n-gram ENDING strictly before the final
+        position (the suffix about to be looked up must only match
+        EARLIER occurrences), resuming from the last processed
+        length."""
+        end = len(ids) - 1               # exclusive bound on ngram end
+        maps = st["maps"]
+        for n in range(self.min_ngram, self.ngram + 1):
+            m = maps[n]
+            for i in range(max(n - 1, st["done"]), end):
+                m[tuple(int(t) for t in ids[i - n + 1:i + 1])] = i
+        st["done"] = end
+
+    def propose(self, rid: int, ids: np.ndarray,
+                max_tokens: Optional[int] = None) -> np.ndarray:
+        """Draft up to ``max_tokens`` (default ``max_draft``) next
+        tokens for the sequence ``ids`` (prompt + generated so far).
+        Returns an int64 array, possibly empty (no match -> the engine
+        falls back to k=1 for this row)."""
+        want = self.max_draft if max_tokens is None \
+            else min(int(max_tokens), self.max_draft)
+        L = int(len(ids))
+        if want < 1 or L < self.min_ngram + 1:
+            return _EMPTY
+        st = self._state.get(rid)
+        if st is None or st["done"] > L - 1:
+            # unknown rid, or history SHRANK (adoption/replay edge):
+            # rebuild from scratch — correctness over cleverness
+            st = {"done": 0,
+                  "maps": {n: {} for n in
+                           range(self.min_ngram, self.ngram + 1)}}
+            self._state[rid] = st
+        self._update(st, ids)
+        for n in range(min(self.ngram, L - 1), self.min_ngram - 1, -1):
+            key = tuple(int(t) for t in ids[L - n:])
+            pos = st["maps"][n].get(key)
+            if pos is not None:
+                draft = ids[pos + 1:pos + 1 + want]
+                if len(draft):
+                    return np.asarray(draft, np.int64)
+        return _EMPTY

@@ -34,6 +34,21 @@ SERVING_SLO_KEYS = {
 }
 
 
+# the SPEC_DECODE line (bench_serving_engine --speculative) is the
+# ISSUE-8 acceptance artifact: self-drafted k-token verification on a
+# repetitive-suffix trace — schema stable, > 1.5 accepted tokens per
+# verify step, >= 25% fewer decode steps than the k=1 engine, greedy
+# outputs token-identical, exactly one verify compile
+SPEC_DECODE_KEYS = {
+    "k", "requests", "tokens", "steps_speculative", "steps_k1",
+    "step_reduction", "accepted_per_step", "draft_hit_rate",
+    "draft_tokens", "accepted_draft_tokens", "acc_len_hist",
+    "tok_latency_p50_s", "tok_latency_p99_s", "tok_latency_p50_s_k1",
+    "tok_latency_p99_s_k1", "tokens_per_s_speculative",
+    "tokens_per_s_k1", "verify_compiles", "token_identical",
+}
+
+
 # the PAGED_KV line (bench_serving_engine --prefix-share) is the
 # artifact the paged-KV acceptance keys on: schema stable, gains over
 # the contiguous pool asserted at the ISSUE-6 bars (>= 4x paged,
@@ -53,6 +68,7 @@ PAGED_KV_KEYS = {
     "bench_ernie_zero3.py", "bench_ppyoloe_infer.py",
     "bench_llama_decode.py", "bench_serving_engine.py",
     "bench_serving_engine.py --prefix-share",
+    "bench_serving_engine.py --speculative",
     "bench_serving_engine.py --frontdoor",
     "chaos_soak.py",
 ])
@@ -109,6 +125,20 @@ def test_benchmark_script_smoke(script, tmp_path):
         assert pk["decode_compiles"] == 1, pk
         assert pk["prefix_hit_rate"] > 0.5, pk
         assert pk["int8_greedy_agreement"] >= 0.9, pk
+    if script == "bench_serving_engine.py --speculative":
+        slines = [l for l in r.stdout.splitlines()
+                  if l.startswith("SPEC_DECODE ")]
+        assert slines, r.stdout
+        sd = json.loads(slines[-1][len("SPEC_DECODE "):])
+        assert SPEC_DECODE_KEYS <= set(sd), sorted(sd)
+        # ISSUE-8 acceptance bars, deterministic on the burst trace
+        assert sd["accepted_per_step"] > 1.5, sd
+        assert sd["step_reduction"] >= 0.25, sd
+        assert sd["token_identical"] is True, sd
+        assert sd["verify_compiles"] == 1, sd
+        assert sd["draft_hit_rate"] > 0.2, sd
+        # the accepted-length histogram really has multi-token accepts
+        assert sum(sd["acc_len_hist"][2:]) > 0, sd
     if script == "bench_serving_engine.py --frontdoor":
         slines = [l for l in r.stdout.splitlines()
                   if l.startswith("SERVING_SLO ")]

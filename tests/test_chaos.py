@@ -174,11 +174,35 @@ TRAINING_SEEDS = list(range(100, 112))
 FRONTDOOR_SEEDS = list(range(300, 325))
 
 
+_serving_spec_tally = {"episodes": 0, "speculative": 0,
+                       "accepted_drafts": 0, "verify_kills": 0}
+
+
 @pytest.mark.parametrize("seed", SERVING_SEEDS)
 def test_serving_episode_matrix(seed):
     res = chaos.run_serving_episode(seed)
     assert res.ok, "\n".join(res.violations)
     assert res.stats["requests"] >= 1
+    _serving_spec_tally["episodes"] += 1
+    _serving_spec_tally["speculative"] += \
+        1 if res.stats["speculative"] else 0
+    _serving_spec_tally["accepted_drafts"] += \
+        res.stats["spec_accepted_drafts"]
+    _serving_spec_tally["verify_kills"] += \
+        res.fired.get("serving.decode.verify", 0)
+
+
+def test_serving_matrix_actually_speculates():
+    """The speculative arm must stay LOADED: episodes that really run
+    the verify program, really accept drafted tokens, and really get
+    killed mid-verify-step — otherwise the speculative-mode soak goes
+    green by vacuity."""
+    if _serving_spec_tally["episodes"] < len(SERVING_SEEDS):
+        pytest.skip("full serving matrix did not run")
+    assert _serving_spec_tally["speculative"] >= 4, _serving_spec_tally
+    assert _serving_spec_tally["accepted_drafts"] >= 3, \
+        _serving_spec_tally
+    assert _serving_spec_tally["verify_kills"] >= 2, _serving_spec_tally
 
 
 @pytest.mark.parametrize("seed", TRAINING_SEEDS)
@@ -266,10 +290,11 @@ def test_open_ended_soak(tmp_path):
 # seed's fault schedule drives the ledger red (the bug class is
 # DETECTED), while the fixed code stays green on the same seed.
 
-PINNED_SEED_BUG_A = 3       # deadline expiry in the step a decode
+PINNED_SEED_BUG_A = 17      # deadline expiry in the step a decode
 PINNED_SEED_BUG_B = 7       # fault lands in / fault mid-drain
-# (re-pinned for the paged engine's episode flow — the paged-prefill
-# fault arm and page-budget sampling shifted every seed's schedule)
+# (re-pinned for the SPECULATIVE episode flow — the speculative-engine
+# sampling, verify fault arm and repetitive pool prompts shifted every
+# seed's schedule)
 
 
 def test_pinned_seed_catches_lost_finished_on_failed_step(monkeypatch):
@@ -297,7 +322,7 @@ def test_pinned_seed_catches_lost_finished_on_failed_step(monkeypatch):
     assert green.ok, "\n".join(green.violations)
 
 
-PINNED_SEED_PAGE_LEAK = 4   # paged-prefill fault mid-admission
+PINNED_SEED_PAGE_LEAK = 14  # paged-prefill fault mid-admission
 
 
 def test_pinned_seed_catches_leaked_pages_on_aborted_prefill(
@@ -401,3 +426,39 @@ def test_pinned_seed_catches_drain_discarding_done(monkeypatch):
     monkeypatch.setattr(ServingEngine, "drain", orig_drain)
     green = chaos.run_serving_episode(PINNED_SEED_BUG_B)
     assert green.ok, "\n".join(green.violations)
+
+
+PINNED_SEED_BROKEN_SPEC = 5   # speculative episode with real accepts
+
+
+def test_pinned_seed_catches_broken_speculative_acceptance(
+        monkeypatch):
+    """Speculative-mode pinned red seed (ISSUE 8): with the verify
+    step's acceptance/rollback DELIBERATELY broken — the engine trusts
+    the whole draft window instead of the in-program accepted length,
+    i.e. rejected draft tokens are emitted as if verified — the token-
+    identity audit must go RED (the stream carries tokens sequential
+    greedy would never have produced). The real acceptance rule stays
+    green on the same seed, with drafts genuinely accepted and the
+    mid-verify kill arm genuinely fired — so the law is not green by
+    vacuity."""
+    from paddle_tpu.serving import ServingEngine
+    orig = ServingEngine._emit_verified
+
+    def trust_the_whole_draft(self, slot, req, greedy_row, acc,
+                              logits_row):
+        return orig(self, slot, req, greedy_row, len(greedy_row),
+                    logits_row)
+
+    monkeypatch.setattr(ServingEngine, "_emit_verified",
+                        trust_the_whole_draft)
+    red = chaos.run_serving_episode(PINNED_SEED_BROKEN_SPEC)
+    assert not red.ok
+    assert any("diverged" in v or "emitted" in v
+               for v in red.violations), red.violations
+    monkeypatch.setattr(ServingEngine, "_emit_verified", orig)
+    green = chaos.run_serving_episode(PINNED_SEED_BROKEN_SPEC)
+    assert green.ok, "\n".join(green.violations)
+    assert green.stats["speculative"]
+    assert green.stats["spec_accepted_drafts"] >= 1
+    assert green.fired.get("serving.decode.verify", 0) >= 1

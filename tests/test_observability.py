@@ -386,6 +386,54 @@ def test_watchdog_gauge_counter_and_dump(tmp_path):
         w.check()
 
 
+# -- speculative-decoding gauges (ISSUE-8 satellite) -------------------
+
+def test_speculative_metrics_published():
+    """A speculative engine publishes the accepted-length histogram,
+    draft/accepted counters and the cumulative draft-hit-rate gauge in
+    its registry — consistent with the engine's own spec_stats(), and
+    present in the Prometheus exposition."""
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama_tiny_config)
+    from paddle_tpu.serving import ServingEngine
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny_config(
+        max_position_embeddings=128))
+    model.eval()
+    reg = MetricRegistry()
+    eng = ServingEngine(model, max_slots=2, max_len=64, min_bucket=8,
+                        speculative=True, spec_k=4, registry=reg,
+                        flight_recorder=FlightRecorder(capacity=4))
+    rng = np.random.RandomState(0)
+    pat = np.tile(rng.randint(1, 100, (2,)), 6).astype(np.int64)
+    eng.submit(pat, max_new_tokens=16)
+    eng.submit(rng.randint(1, 100, (7,)).astype(np.int64),
+               max_new_tokens=6)
+    eng.run()
+    st = eng.spec_stats()
+    assert st["rows"] > 0 and st["emitted"] >= st["rows"]
+    hist = reg.histogram("ptpu_serving_spec_accepted_length")
+    assert hist.count == st["rows"]
+    assert hist.sum == pytest.approx(st["emitted"])
+    assert reg.counter(
+        "ptpu_serving_spec_draft_tokens_total").value \
+        == st["draft_tokens"]
+    assert reg.counter(
+        "ptpu_serving_spec_accepted_draft_tokens_total").value \
+        == st["accepted_draft_tokens"]
+    assert reg.gauge("ptpu_serving_spec_draft_hit_rate").value \
+        == pytest.approx(st["draft_hit_rate"])
+    text = reg.to_prometheus()
+    assert "# TYPE ptpu_serving_spec_accepted_length histogram" in text
+    assert "ptpu_serving_spec_draft_hit_rate" in text
+    # non-speculative engines do not grow the spec families
+    reg2 = MetricRegistry()
+    ServingEngine(model, max_slots=1, max_len=64, registry=reg2,
+                  flight_recorder=FlightRecorder(capacity=4))
+    assert "ptpu_serving_spec_accepted_length" not in reg2.families()
+
+
 # -- acceptance: one serving run, three artifacts ----------------------
 
 def _tiny_llama():

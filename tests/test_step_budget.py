@@ -18,8 +18,12 @@ import xplane  # noqa: E402
 
 def test_selftest_fixture_parses_with_stable_schema():
     budget = step_budget.selftest()
-    assert budget["schema"] == "ptpu_step_budget_v1"
+    assert budget["schema"] == "ptpu_step_budget_v2"
     assert set(budget["buckets"]) == set(step_budget.BUCKET_KEYS)
+    # v2: the collectives record is always present, stable keys
+    assert set(budget["collectives"]) == {
+        "by_kind", "total_ms", "exposed_ms", "overlapped_ms",
+        "overlap_frac"}
 
 
 def test_selftest_cli_entrypoint():
@@ -94,9 +98,74 @@ def test_budget_from_times_schema_and_per_step_division():
     assert b["buckets"]["copy_slice"] == 1.0
     assert b["buckets"]["flash"] == 0.0  # absent families stay present
     assert b["total_ms"] == 3.0
+    # no interval data -> the ZERO collectives record, key still there
+    assert b["collectives"] == step_budget.empty_collectives()
     # the printed artifact is byte-stable for a given record
     assert step_budget.format_line(b) == step_budget.format_line(
         json.loads(json.dumps(b)))
+
+
+# -- v2 collectives: the multichip-overlap artifact --------------------
+
+def test_collective_detail_exposed_vs_overlapped_split():
+    """An all-reduce half-hidden under a dot, an all-gather fully
+    exposed: the split must attribute exactly the covered picoseconds
+    to overlapped and the remainder to exposed, per step."""
+    events = [
+        ("%dot.1 = ...", 0, 4_000_000_000),            # compute 0-4ms
+        # all-reduce 2-6 ms: 2 ms under the dot, 2 ms exposed
+        ("%all-reduce.2 = ...", 2_000_000_000, 6_000_000_000),
+        # all-gather 7-8 ms: nothing covers it
+        ("%all-gather.3 = ...", 7_000_000_000, 8_000_000_000),
+        # a while envelope spanning everything must NOT count as cover
+        ("%while.4 = ...", 0, 10_000_000_000),
+    ]
+    c = step_budget.collective_detail(events, steps=1)
+    assert c["by_kind"] == {"all-reduce": 4.0, "all-gather": 1.0}
+    assert c["total_ms"] == 5.0
+    assert c["overlapped_ms"] == 2.0
+    assert c["exposed_ms"] == 3.0
+    assert c["overlap_frac"] == 0.4
+    # per-step division
+    c2 = step_budget.collective_detail(events, steps=2)
+    assert c2["total_ms"] == 2.5 and c2["overlapped_ms"] == 1.0
+    assert c2["overlap_frac"] == 0.4          # fraction is step-free
+
+
+def test_collective_detail_merges_fragmented_compute_cover():
+    """Abutting/overlapping compute intervals merge before the
+    intersection — double-covered time must not count twice."""
+    events = [
+        ("%fusion.1 = ...", 0, 3_000_000_000),
+        ("%dot.2 = ...", 2_000_000_000, 5_000_000_000),  # overlaps
+        ("%reduce-scatter.3 = ...", 1_000_000_000, 6_000_000_000),
+    ]
+    c = step_budget.collective_detail(events)
+    assert c["by_kind"] == {"reduce-scatter": 5.0}
+    assert c["overlapped_ms"] == 4.0          # covered 1-5 ms, once
+    assert c["exposed_ms"] == 1.0
+
+
+def test_collectives_flow_through_budget_from_xplane(tmp_path):
+    path = str(tmp_path / "c.xplane.pb")
+    xplane.write_xspace(path, [
+        ("/device:TPU:0", [
+            ("XLA Ops", [
+                ("%dot.1 = ...", 0, 4_000_000),
+                ("%all-reduce.2 = ...", 3_000_000, 2_000_000),
+            ]),
+        ]),
+    ])
+    b = step_budget.budget_from_xplane(path, steps=1)
+    assert b["schema"] == "ptpu_step_budget_v2"
+    c = b["collectives"]
+    assert c["by_kind"] == {"all-reduce": 0.002}
+    assert c["overlapped_ms"] == 0.001        # 3-4 ms... (us scale)
+    assert c["exposed_ms"] == 0.001
+    assert c["overlap_frac"] == 0.5
+    # raw-interval reader round-trips the writer
+    iv = xplane.op_intervals(path)["XLA Ops"]
+    assert ("%all-reduce.2 = ...", 3_000_000, 5_000_000) in iv
 
 
 def test_budget_none_when_no_matching_plane(tmp_path):
