@@ -25,6 +25,9 @@ SCRIPTS = ["bench_resnet50.py", "bench_bert_dp.py", "bench_gpt_hybrid.py",
            # front-door closed-loop SLO (replica killed mid-run,
            # exactly-once ledger at the boundary)
            "bench_serving_engine.py --frontdoor",
+           # tensor-parallel + disaggregated serving on the emulated
+           # mesh (token identity + compile-once per mesh shape)
+           "bench_serving_engine.py --tensor-parallel",
            # budget via PTPU_CHAOS_EPISODES / PTPU_CHAOS_SECONDS
            "chaos_soak.py"]
 

@@ -201,13 +201,17 @@ def budget_from_xplane(path: str, steps: int = 1,
 
 
 def budget_from_logdir(logdir: str, steps: int = 1,
-                       plane_filter: str = "TPU") -> Optional[dict]:
+                       plane_filter: str = "TPU",
+                       line_filter: Optional[str] = None
+                       ) -> Optional[dict]:
     return budget_from_xplane(xplane.latest_xplane(logdir),
-                              steps=steps, plane_filter=plane_filter)
+                              steps=steps, plane_filter=plane_filter,
+                              line_filter=line_filter)
 
 
 def capture(step_fn, steps: int = 3, plane_filter: str = "TPU",
-            logdir: Optional[str] = None) -> Optional[dict]:
+            logdir: Optional[str] = None,
+            line_filter: Optional[str] = None) -> Optional[dict]:
     """Profile ``steps`` calls of ``step_fn`` under jax.profiler and
     decompose. Caller is responsible for warmup (compile OUTSIDE the
     trace window). Returns None when the trace has no matching device
@@ -238,7 +242,8 @@ def capture(step_fn, steps: int = 3, plane_filter: str = "TPU",
             jax.profiler.stop_trace()
         try:
             return budget_from_logdir(logdir, steps=steps,
-                                      plane_filter=plane_filter)
+                                      plane_filter=plane_filter,
+                                      line_filter=line_filter)
         except FileNotFoundError:
             return None
     finally:
@@ -327,6 +332,58 @@ def selftest() -> dict:
     return budget
 
 
+def mesh_collectives_smoke(steps: int = 3) -> Optional[dict]:
+    """ROADMAP item-#3 tail that needs no real chips: run a distilled
+    HYBRID-MESH (fsdp x model) training-shaped step on the live device
+    set — the CPU-emulated 8-device mesh in CI (same
+    ``--xla_force_host_platform_device_count=8`` emulation as the
+    MULTICHIP artifacts), real chips on TPU — profile it, and
+    decompose with the v2 ``collectives`` record. This exercises the
+    exposed-vs-overlapped split against an ACTUAL multi-device
+    execution's all-reduce/all-gather intervals instead of the
+    synthetic fixture: the flow the on-chip BENCH_r06 run will reuse.
+
+    The step is Megatron-shaped in miniature: activations data-
+    parallel over `fsdp`, both weights output/contraction-sharded over
+    `model`, so the forward needs a model-axis all-reduce (the
+    row-parallel psum) and the loss reduction crosses `fsdp`. On CPU
+    the XLA thunk executor records per-device op events (all-reduce /
+    dot / fusion) on its client lines, which the CPU plane filter +
+    executor line filter pick up; on TPU the usual 'XLA Ops' line
+    serves.  Returns None when no device plane matched."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    n = jax.device_count()
+    if n < 4 or n % 2:
+        return None
+    mesh = Mesh(np.asarray(jax.devices()).reshape(n // 2, 2),
+                ("fsdp", "model"))
+    sh = lambda *spec: NamedSharding(mesh, P(*spec))
+    rng = np.random.RandomState(0)
+    x = jax.device_put(
+        rng.randn(8 * (n // 2), 128).astype(np.float32),
+        sh("fsdp", None))
+    w1 = jax.device_put(rng.randn(128, 256).astype(np.float32),
+                        sh(None, "model"))
+    w2 = jax.device_put(rng.randn(256, 128).astype(np.float32),
+                        sh("model", None))
+
+    @jax.jit
+    def step(x, w1, w2):
+        h = jnp.maximum(x @ w1, 0.0)     # col-parallel over `model`
+        y = h @ w2                       # row-parallel -> all-reduce
+        return jnp.sum((y - x) ** 2)     # loss crosses `fsdp` too
+
+    step(x, w1, w2).block_until_ready()  # compile outside the trace
+    on_tpu = jax.default_backend() not in ("cpu",)
+    return capture(lambda: step(x, w1, w2), steps=steps,
+                   plane_filter="TPU" if on_tpu else "CPU",
+                   line_filter=None if on_tpu else "XLATfrtCpuClient")
+
+
 def _run_gpt_step():
     """Return a zero-arg step closure over the COMMITTED bench recipe
     (bench.build_flagship — one definition, so this tool's STEP_BUDGET
@@ -344,8 +401,10 @@ def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--logdir", help="existing jax.profiler logdir")
     ap.add_argument("--xplane", help="existing .xplane.pb file")
-    ap.add_argument("--run", choices=["gpt"],
-                    help="profile this workload then decompose")
+    ap.add_argument("--run", choices=["gpt", "mesh-smoke"],
+                    help="profile this workload then decompose "
+                         "(mesh-smoke: distilled hybrid-mesh step on "
+                         "the live devices, collectives record)")
     ap.add_argument("--steps", type=int, default=3)
     ap.add_argument("--plane", default="TPU",
                     help="plane-name substring filter (default TPU)")
@@ -362,7 +421,19 @@ def main():
         print(format_line(budget))
         print("selftest OK")
         return
-    if args.run:
+    if args.run == "mesh-smoke":
+        import jax
+        if jax.device_count() < 4 or jax.device_count() % 2:
+            print("# mesh-smoke needs >= 4 devices (an even count); "
+                  "on CPU set XLA_FLAGS="
+                  "--xla_force_host_platform_device_count=8")
+            return
+        budget = mesh_collectives_smoke(steps=args.steps)
+        if budget is None:
+            print("# no device plane/executor line matched the "
+                  "mesh-smoke trace — nothing to decompose")
+            return
+    elif args.run:
         import jax
         step = _run_gpt_step()
         for _ in range(2):  # compile outside the trace window

@@ -36,6 +36,15 @@ pages/rows must never be shared or indexed, and the serving engine's
 page rollback only returns OVER-ALLOCATED pages, it does not (and need
 not) scrub accepted-range pages.
 
+TENSOR-PARALLEL serving note (serving/mesh.py): both attends are
+mesh-safe by construction when the cache buffers/pools shard on their
+``kv_heads`` axis — every einsum batches over that axis (GQA groups
+fold into the per-kv-head contraction instead of crossing it), the
+softmax reduces over positions, and the write scatter indexes only
+batch/position dims, so no arithmetic ever crosses kv-heads and GSPMD
+partitioning preserves BITWISE identity with the single-chip program.
+The serving engine relies on this for its sharded token-identity law.
+
 ``paged_cache_attend`` is the PAGE-TABLE flavor of the same attention:
 instead of one contiguous ``[B, Tmax, KV, D]`` row per sequence, k/v
 live in a shared pool of fixed-size pages ``[num_pages, page, KV, D]``

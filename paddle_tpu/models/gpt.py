@@ -45,7 +45,37 @@ from ._decode_cache import (cache_attend, check_cache_pos,
                             paged_cache_attend)
 
 __all__ = ["GPTConfig", "GPTModel", "GPTForCausalLM", "GPTSpmdTrainer",
-           "build_mesh"]
+           "build_mesh", "tp_param_spec"]
+
+
+# Tensor-parallel SERVING shard rules for the imperative GPT family
+# (GPTForCausalLM.raw_state() names). Output-dim-only, same contract
+# as models/llama.tp_param_spec: shards only non-contracted dims so
+# sharded decode stays bitwise token-identical to single-chip (fc1
+# stays replicated — sharding it would turn fc2's contraction into a
+# float-reassociating psum). The fused qkv output and its bias shard
+# along 3*H*D; the tied wte shards over vocab (it is both the
+# embedding table and the logits head's rhs, contracted over hidden).
+_TP_OUT_DIM = ("qkv.weight", "proj.weight", "fc2.weight")
+_TP_OUT_BIAS = ("qkv.bias", "proj.bias", "fc2.bias")
+
+
+def tp_param_spec(name: str, shape, tp: int, axis: str = "model"):
+    """PartitionSpec for one ``raw_state()`` param under the serving
+    engine's tensor-parallel mesh, or None for replicated (see
+    models/llama.tp_param_spec — same contract)."""
+    if tp <= 1:
+        return None
+    if name.endswith(_TP_OUT_DIM) and len(shape) == 2 \
+            and shape[-1] % tp == 0:
+        return P(None, axis)
+    if name.endswith(_TP_OUT_BIAS) and len(shape) == 1 \
+            and shape[0] % tp == 0:
+        return P(axis)
+    if name.endswith("wte.weight") and len(shape) == 2 \
+            and shape[0] % tp == 0:
+        return P(axis, None)
+    return None
 
 
 @dataclasses.dataclass

@@ -27,7 +27,34 @@ from ..nn.layer.container import LayerList
 from ..nn.layer.norm import RMSNorm
 
 __all__ = ["LlamaConfig", "LlamaModel", "LlamaForCausalLM",
-           "llama_tiny_config"]
+           "llama_tiny_config", "tp_param_spec"]
+
+
+# raw_state() param names shardable along their OUTPUT (non-contracted)
+# dim under tensor-parallel serving. Output-dim-only sharding is the
+# deliberate TP slice that keeps sharded decode provably BITWISE
+# token-identical to the single-chip engine: each shard computes full
+# contractions over identical operands, collectives are pure data
+# movement (all-gather), and no psum ever re-associates a float sum.
+# gate/up_proj stay replicated — splitting them would shard
+# down_proj's contraction dim and turn it into a partial-sum psum
+# (serving/mesh.py, docs/SERVING.md "Multi-chip serving").
+_TP_OUT_DIM_PARAMS = ("q_proj.weight", "k_proj.weight", "v_proj.weight",
+                      "o_proj.weight", "down_proj.weight",
+                      "lm_head.weight")
+
+
+def tp_param_spec(name: str, shape, tp: int, axis: str = "model"):
+    """PartitionSpec for one ``raw_state()`` param under the serving
+    engine's tensor-parallel mesh, or None for replicated. Params a
+    rule does not cover (norms, embeddings, gate/up_proj, quantized
+    weights with their own names) replicate — always correct, just
+    unsharded."""
+    from jax.sharding import PartitionSpec
+    if tp > 1 and name.endswith(_TP_OUT_DIM_PARAMS) \
+            and len(shape) == 2 and shape[-1] % tp == 0:
+        return PartitionSpec(None, axis)
+    return None
 
 
 @dataclasses.dataclass

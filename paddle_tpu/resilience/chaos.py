@@ -66,7 +66,9 @@ __all__ = ["FaultArm", "EpisodeResult", "ChaosStore",
 # includes the engines), but coverage of those is owned by the
 # serving sweep.
 SERVING_SWEEP = ("serving.step.decode", "serving.decode.verify",
-                 "serving.step.prefill", "serving.prefill.paged")
+                 "serving.decode.sharded",
+                 "serving.step.prefill", "serving.prefill.paged",
+                 "serving.kv.handoff")
 FRONTDOOR_SWEEP = ("router.dispatch", "router.health_probe",
                    "frontdoor.stream_write",
                    "frontdoor.client_disconnect")
@@ -190,13 +192,24 @@ def _sample_arms(rng, specs) -> List[FaultArm]:
     return arms
 
 
-def run_serving_episode(seed: int, max_iters: int = 300) \
+def run_serving_episode(seed: int, max_iters: int = 300,
+                        mesh_flavor: Optional[str] = None) \
         -> EpisodeResult:
     """One seeded serving episode: Poisson arrivals over the fixed
     prompt pool with sampled deadlines/cancels, decode/prefill faults
     (donated-pool and CPU flavors), ``recover()`` after broken steps,
     and a final ``drain()`` — possibly itself under fire. Every
-    invariant is audited at the end."""
+    invariant is audited at the end.
+
+    ``mesh_flavor`` pins the engine's mesh layout: ``"local"``
+    (single-chip), ``"tp"`` (TP=2 over the emulated mesh) or
+    ``"disagg"`` (2 prefill + 2 decode devices, KV handoff path).
+    None samples it — from a SEPARATE rng stream, so every pre-mesh
+    seed's fault schedule and workload stay bit-identical. Mesh
+    flavors degrade to "local" when the process has too few (virtual)
+    devices; mesh episodes are audited against the SAME single-chip
+    reference outputs — cross-flavor token identity IS the
+    tensor-parallel correctness law."""
     from ..observability import FlightRecorder, MetricRegistry
     from ..serving import ServingEngine
 
@@ -221,13 +234,37 @@ def run_serving_episode(seed: int, max_iters: int = 300) \
     num_pages = int(rng.randint(_MAX_LEN // 8 + 1,
                                 max_slots * (_MAX_LEN // 8) + 2))
     spec_kw = {"speculative": True, "spec_k": 4} if speculative else {}
+    import jax
+    rng2 = np.random.RandomState(770000 + seed)
+    r_mesh = rng2.random()
+    if mesh_flavor is None:
+        if jax.device_count() >= 4 and r_mesh < 0.18:
+            mesh_flavor = "disagg"
+        elif jax.device_count() >= 2 and r_mesh < 0.38:
+            mesh_flavor = "tp"
+        else:
+            mesh_flavor = "local"
+    elif jax.device_count() < (4 if mesh_flavor == "disagg" else 2):
+        # a PINNED flavor degrades too (not just the sampled path):
+        # an image without the virtual-device emulation runs the
+        # episode single-chip instead of crashing mid-matrix — the
+        # coverage-floor test guards against this going vacuous
+        mesh_flavor = "local"
+    mesh_kw = {}
+    if mesh_flavor == "tp":
+        from ..distributed import ProcessMesh
+        mesh_kw = {"mesh": ProcessMesh(np.arange(2), ["model"])}
+    elif mesh_flavor == "disagg":
+        from ..distributed import ProcessMesh
+        mesh_kw = {"mesh": ProcessMesh(np.arange(4), ["model"]),
+                   "prefill_devices": 2}
     eng = ServingEngine(model, max_slots=max_slots, max_len=_MAX_LEN,
                         min_bucket=_MIN_BUCKET,
                         page_size=8, num_pages=num_pages,
                         time_fn=lambda: clock["t"],
                         registry=MetricRegistry(),
                         flight_recorder=FlightRecorder(capacity=8),
-                        auditor=ledger, **spec_kw)
+                        auditor=ledger, **spec_kw, **mesh_kw)
     if donate:
         eng._donate = lambda: (5, 6)
 
@@ -261,6 +298,19 @@ def run_serving_episode(seed: int, max_iters: int = 300) \
         # including on shared-prefix admissions from the pool
         ("serving.prefill.paged", 0.4, (1, 3), (0, 8)),
     ])
+    # mesh-only kill arms, drawn from the separate rng2 stream (same
+    # reason as the flavor itself: pre-mesh seeds stay bit-identical):
+    # the sharded-decode point fires right before the TP program, the
+    # handoff point mid-handoff — KV computed on the prefill group,
+    # not yet installed on the decode pool
+    if mesh_flavor != "local" and rng2.random() < 0.5:
+        schedule.append(FaultArm("serving.decode.sharded",
+                                 times=int(rng2.randint(1, 3)),
+                                 after=int(rng2.randint(0, 8))))
+    if mesh_flavor == "disagg" and rng2.random() < 0.6:
+        schedule.append(FaultArm("serving.kv.handoff",
+                                 times=int(rng2.randint(1, 3)),
+                                 after=int(rng2.randint(0, 6))))
     # shutdown chaos: half the episodes stop serving mid-trace and
     # drain() with the queue and slots still loaded — optionally with
     # one more decode fault armed right before the drain, the
@@ -363,6 +413,11 @@ def _serving_result(seed, violations, schedule, ledger, submitted,
         stats={"requests": len(submitted), "recoveries": recoveries,
                "steps": steps_ok,
                "donate": eng._donate() != (),
+               "mesh": ("disagg" if eng.meshctx is not None
+                        and eng.meshctx.disaggregated
+                        else "tp" if eng.meshctx is not None
+                        else "local"),
+               "tp": eng.meshctx.tp if eng.meshctx is not None else 0,
                "speculative": eng.speculative,
                "spec_emitted": (eng._spec["emitted"]
                                 if eng.speculative else 0),

@@ -13,8 +13,10 @@ Wired-in points (see docs/RESILIENCE.md for the catalogue):
 ===========================  ===========================================
 ``serving.step.decode``      right before the decode-step jit call
 ``serving.decode.verify``    mid-verify-step (speculative decoding)
+``serving.decode.sharded``   mesh engines, before the SHARDED program
 ``serving.step.prefill``     inside the (re-)prefill program driver
 ``serving.prefill.paged``    paged prefill, AFTER pages are claimed
+``serving.kv.handoff``       disaggregated prefill->decode KV handoff
 ``router.dispatch``          router submit, before replica binding
 ``router.health_probe``      inside the per-round replica probe
 ``frontdoor.stream_write``   writing a token/done event to a client
@@ -76,10 +78,19 @@ KNOWN_POINTS = (
     # the widened program not yet run — recovery must replay
     # token-identically and the page rollback must leak nothing
     "serving.decode.verify",
+    # tensor-parallel engines (ServingEngine(mesh=...)): right before
+    # the SHARDED decode/verify program — recovery must rebuild the
+    # mesh-sharded pools and replay token-identically
+    "serving.decode.sharded",
     "serving.step.prefill",
     # mid-prefill on the PAGED cache: pages claimed, table row live,
     # prefill program not yet run — the abort path must return them
     "serving.prefill.paged",
+    # disaggregated prefill/decode: the KV span is computed on the
+    # prefill group but NOT yet installed on the decode pool — the
+    # abort path must unwind the half-handed-off request on BOTH
+    # groups (page claims returned, staged span dropped)
+    "serving.kv.handoff",
     # router/front-door boundary (serving/router.py, frontdoor.py):
     # dispatch-path crash before a request binds to a replica; health-
     # probe infrastructure failure (must degrade to draining, never

@@ -198,8 +198,19 @@ def engine_leak_violations(engine) -> List[str]:
     requests, no undelivered terminal requests — and, on a SPECULATIVE
     engine, no draft-proposer state for requests that are no longer in
     a slot (eviction/deadline/cancel/recover must release it, or a
-    long-lived engine's proposer index grows without bound)."""
+    long-lived engine's proposer index grows without bound). On a
+    DISAGGREGATED mesh engine this is also the cross-group law's
+    engine half: no request may still hold a KV span staged on the
+    prefill group (computed but never installed on the decode pool —
+    every handoff must complete or unwind); the decode-group half is
+    :func:`page_leak_violations`, which audits the pool the handoff
+    targets."""
     out = []
+    staged = getattr(engine, "_staged_handoffs", None)
+    if staged:
+        out.append(
+            f"staged KV handoffs for rids {sorted(staged)} never "
+            f"installed on the decode group or unwound")
     active = engine.cache.active_slots()
     if active:
         out.append(

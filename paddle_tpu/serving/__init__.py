@@ -30,6 +30,13 @@ token-identical to non-speculative greedy decode (the acceptance rule
 IS sequential greedy run k steps ahead; tests/test_spec_decode.py).
 Rows with no usable draft run at k=1 inside the same program.
 
+``mesh=`` (a ProcessMesh with a ``model`` axis) makes the engine
+TENSOR-PARALLEL — KV pools and shardable params split across chips,
+one decode program per mesh shape, greedy outputs bitwise identical
+to single-chip — and ``prefill_devices=k`` DISAGGREGATES prefill from
+decode with an explicit KV handoff between the two chip groups
+(serving/mesh.py, docs/SERVING.md "Multi-chip serving").
+
 Failure contract (docs/RESILIENCE.md): typed errors in ``errors``
 (``QueueFull`` / ``DeadlineExceeded`` / ``EngineBroken`` /
 ``EngineIdle`` / ``EngineClosed``), ``ServingEngine.recover()`` after
@@ -44,6 +51,7 @@ from .errors import (DeadlineExceeded, EngineBroken,  # noqa: F401
 from .frontdoor import (ClientStream, FrontDoor,  # noqa: F401
                         FrontDoorHandle, FrontDoorHTTPServer,
                         TenantPolicy, TokenBucket)
+from .mesh import MeshContext  # noqa: F401
 from .metrics import EngineMetrics  # noqa: F401
 from .router import Replica, ReplicaRouter  # noqa: F401
 from .sampling import SamplingParams, sample_token  # noqa: F401
@@ -52,7 +60,8 @@ from .scheduler import (FIFOScheduler, Request, bucket_for,  # noqa: F401
 from .slot_cache import PagedKVCache, SlotKVCache  # noqa: F401
 from .spec_decode import NgramProposer  # noqa: F401
 
-__all__ = ["ServingEngine", "EngineMetrics", "SamplingParams",
+__all__ = ["ServingEngine", "EngineMetrics", "MeshContext",
+           "SamplingParams",
            "sample_token", "FIFOScheduler", "Request", "bucket_for",
            "prefill_buckets", "SlotKVCache", "PagedKVCache",
            "NgramProposer",

@@ -27,7 +27,18 @@ program per step, driven by a host loop):
 VERIFY program fed by self-drafted n-gram proposals
 (spec_decode.NgramProposer) — greedy outputs stay provably
 token-identical to this path and to ``generate()``; see
-docs/SERVING.md "Speculative decoding".
+docs/SERVING.md "Speculative decoding". Steps where no row has a
+draft are GATED back onto the k=1 decode program (identical tokens at
+1/k the compute; ``spec_gate=False`` pins the always-widened flavor).
+
+``mesh=`` (a ProcessMesh with a single ``model`` axis) makes the
+engine TENSOR-PARALLEL: KV pools shard on kv_heads, params by the
+family's output-dim-only ``tp_param_spec`` rules, and every program
+jits under the mesh with explicit shardings — still ONE decode
+program per mesh shape, and still bitwise token-identical to the
+single-chip engine. ``prefill_devices=k`` partitions the mesh into a
+prefill group and a decode group with an explicit device_put KV
+handoff between them (docs/SERVING.md "Multi-chip serving").
 
 Resilience contract (docs/RESILIENCE.md): a step that fails with
 donated cache pools marks the engine broken — ``recover()`` rebuilds
@@ -54,6 +65,7 @@ from ..observability import default_recorder, default_registry, span
 from ..resilience.faults import maybe_fail
 from .errors import (DeadlineExceeded, EngineBroken, EngineClosed,
                      EngineIdle, QueueFull, RequestCancelled)
+from .mesh import MeshContext
 from .metrics import EngineMetrics
 from .sampling import SamplingParams, sample_token
 from .scheduler import FIFOScheduler, Request, bucket_for
@@ -71,7 +83,13 @@ class _ModelAdapter:
 
     def __init__(self, model):
         self.model = model
+        # tensor-parallel shard rules for raw_state() param names
+        # (serving/mesh.py builds NamedShardings from these); None =
+        # every param replicated, which is always correct
+        self.tp_param_spec = None
         if hasattr(model, "llama"):          # LlamaForCausalLM
+            from ..models.llama import tp_param_spec
+            self.tp_param_spec = tp_param_spec
             cfg = model.config
             backbone = model.llama
             self.call = lambda ids, caches: backbone(ids, None, caches)
@@ -85,6 +103,8 @@ class _ModelAdapter:
             self.max_positions = cfg.max_position_embeddings
             self.dtype = backbone.embed_tokens.weight._data.dtype
         elif hasattr(model, "gpt"):          # GPTForCausalLM
+            from ..models.gpt import tp_param_spec
+            self.tp_param_spec = tp_param_spec
             cfg = model.cfg
             backbone = model.gpt
             self.call = lambda ids, caches: backbone(ids, caches=caches)
@@ -121,7 +141,10 @@ class ServingEngine:
                  prefix_sharing: Optional[bool] = None,
                  speculative: bool = False,
                  spec_k: int = 4,
-                 spec_ngram: int = 2):
+                 spec_ngram: int = 2,
+                 spec_gate: bool = True,
+                 mesh=None,
+                 prefill_devices: int = 0):
         self.adapter = _ModelAdapter(model)
         model.eval()
         self.max_slots = int(max_slots)
@@ -176,9 +199,43 @@ class ServingEngine:
             self.spec_k = int(spec_k)
             self.proposer = NgramProposer(ngram=spec_ngram,
                                           max_draft=self.spec_k - 1)
-        elif spec_k != 4 or spec_ngram != 2:
+            # skip the k-wide verify program on steps where NO row has
+            # a draft (all wlen == 1): the k=1 decode program emits the
+            # provably identical token at 1/k the verify compute.
+            # Trace counts stay bounded: <= 1 decode + <= 1 verify.
+            self.spec_gate = bool(spec_gate)
+        elif spec_k != 4 or spec_ngram != 2 or spec_gate is not True:
             raise ValueError(
-                "spec_k/spec_ngram only apply with speculative=True")
+                "spec_k/spec_ngram/spec_gate only apply with "
+                "speculative=True")
+        # tensor-parallel serving mesh (docs/SERVING.md "Multi-chip
+        # serving"): KV pools + shardable params split over the
+        # mesh's `model` axis; with prefill_devices > 0 the mesh is
+        # PARTITIONED into a prefill group and a decode group and
+        # finished prefill KV spans are handed off via device_put
+        self.meshctx = None
+        if mesh is not None:
+            self.meshctx = MeshContext(mesh,
+                                       kv_heads=self.adapter.kv_heads,
+                                       prefill_devices=prefill_devices)
+        elif prefill_devices:
+            raise ValueError(
+                "prefill_devices (disaggregated prefill/decode) "
+                "requires mesh=")
+        # rid -> slot for requests whose prefilled KV is computed on
+        # the prefill group but not yet installed on the decode pool —
+        # the cross-group no-leak law audits this is empty at quiesce
+        self._staged_handoffs = {}
+        # name -> (source array, mesh-placed copy), per group:
+        # re-placing every step would re-transfer params the model
+        # still holds. Keyed by NAME with the source kept alive in the
+        # entry (an id()-keyed cache would go stale when a checkpoint
+        # load frees old arrays and a new one reuses the address)
+        self._placed = {"decode": {}, "prefill": {}}
+        # group -> (param-name key, shardings dict): the shardings are
+        # static per (names, mesh), so don't rebuild NamedShardings on
+        # every step
+        self._shardings_cache = {}
         self.cache = self._new_cache()
         self.scheduler = FIFOScheduler()
         self.registry = registry if registry is not None \
@@ -190,12 +247,14 @@ class ServingEngine:
             else default_recorder()
         self.metrics = EngineMetrics(self.max_slots, time_fn,
                                      registry=self.registry)
-        self._params, self._buffers = model.raw_state()
+        self._params_pf = self._buffers_pf = None
+        self._refresh_state()
         self._decode_jit = None
         self._verify_jit = None
         self._prefill_jit = None
         self._extend_jit = None
         self._copy_jit = None
+        self._install_jit = None
         self._next_rid = 0
         self._step_idx = 0
         # set when a step fails after donating the cache pools (device
@@ -227,7 +286,7 @@ class ServingEngine:
         # count contract (1 decode + O(log max_len) prefill buckets) is
         # asserted against these in tests
         self.trace_counts = {"decode": 0, "verify": 0, "prefill": {},
-                             "extend": {}, "copy": 0}
+                             "extend": {}, "copy": 0, "install": {}}
         reg = self.registry
         self._m_queue_depth = reg.gauge(
             "ptpu_serving_queue_depth", "requests waiting for a slot")
@@ -303,23 +362,92 @@ class ServingEngine:
             # host-side aggregate: the SPEC_DECODE bench line and
             # spec_stats() read this (registry histograms only keep
             # bucketized counts)
-            self._spec = {"steps": 0, "rows": 0, "emitted": 0,
+            self._spec = {"steps": 0, "gated_steps": 0, "rows": 0,
+                          "emitted": 0,
                           "draft_tokens": 0, "accepted_draft_tokens": 0,
                           "acc_len_hist": [0] * (self.spec_k + 1)}
 
     def _new_cache(self):
-        """Fresh KV pool in the configured layout (init + recover)."""
+        """Fresh KV pool in the configured layout (init + recover).
+        On a mesh engine the pools are committed SHARDED (kv_heads
+        over the `model` axis) to the DECODE group, which owns all
+        pool state — disaggregated prefills hand their KV over."""
         ad = self.adapter
+        kv_sh = sc_sh = None
+        if self.meshctx is not None:
+            kv_sh = self.meshctx.kv_sharding()
+            sc_sh = self.meshctx.scale_sharding()
         if self.paged:
             return PagedKVCache(
                 ad.num_layers, self.max_slots, self.max_len,
                 ad.kv_heads, ad.head_dim, ad.dtype,
                 page_size=self.page_size, num_pages=self.num_pages,
                 quant=self.kv_quant,
-                prefix_sharing=self.prefix_sharing)
+                prefix_sharing=self.prefix_sharing,
+                kv_sharding=kv_sh, scale_sharding=sc_sh)
         return SlotKVCache(
             ad.num_layers, self.max_slots, self.max_len,
-            ad.kv_heads, ad.head_dim, ad.dtype)
+            ad.kv_heads, ad.head_dim, ad.dtype, kv_sharding=kv_sh)
+
+    def _refresh_state(self) -> None:
+        """Re-snapshot the model weights (checkpoint loads /
+        quantization on the live model take effect next step). Mesh
+        engines additionally commit the snapshot to the mesh via the
+        family's tp_param_spec rules — cached by source-array identity
+        so an unchanged model costs no transfer — and, when
+        disaggregated, keep a second placed copy on the prefill group
+        (each chip group holds its own weights, the standard
+        disaggregated-serving memory layout)."""
+        params, buffers = self.adapter.model.raw_state()
+        if self.meshctx is None:
+            self._params, self._buffers = params, buffers
+            return
+        m = self.meshctx
+        self._params, self._buffers = self._place_state(
+            params, buffers, self._param_shardings(params, "decode"),
+            m.repl("decode"), self._placed["decode"])
+        if m.disaggregated:
+            self._params_pf, self._buffers_pf = self._place_state(
+                params, buffers,
+                self._param_shardings(params, "prefill"),
+                m.repl("prefill"), self._placed["prefill"])
+
+    def _param_shardings(self, params, group):
+        """Per-param NamedSharding dict, cached per group: static for
+        a given (param-name set, mesh), so the per-step refresh only
+        pays a tuple compare. A same-NAME shape change (no known
+        path) would surface as a loud device_put error, never a
+        silently wrong sharding."""
+        key = tuple(params)
+        got = self._shardings_cache.get(group)
+        if got is None or got[0] != key:
+            got = (key, self.meshctx.param_shardings(
+                params, self.adapter, group))
+            self._shardings_cache[group] = got
+        return got[1]
+
+    @staticmethod
+    def _place_state(params, buffers, param_sh, repl, cache):
+        fresh = {}
+
+        def put(name, src, sh):
+            got = cache.get(name)
+            # identity check against the LIVE source kept in the
+            # entry: a swapped array (checkpoint load) re-places even
+            # if the new object reuses the old one's address
+            if got is not None and got[0] is src:
+                placed = got[1]
+            else:
+                placed = jax.device_put(src, sh)
+            fresh[name] = (src, placed)
+            return placed
+
+        p = {n: put(("p", n), a, param_sh[n])
+             for n, a in params.items()}
+        b = {n: put(("b", n), a, repl) for n, a in buffers.items()}
+        cache.clear()
+        cache.update(fresh)
+        return p, b
 
     def _publish_page_stats(self) -> None:
         if not self.paged:
@@ -583,7 +711,7 @@ class ServingEngine:
         # re-snapshot the weights so checkpoint loads / quantization on
         # the live model object take effect next step (same pytree
         # structure -> no retrace; the arrays are just jit arguments)
-        self._params, self._buffers = self.adapter.model.raw_state()
+        self._refresh_state()
         # 1) admission — freed slots refill BEFORE the decode so a new
         # request's first decode token rides this very step. Paged:
         # admission is gated by FREE PAGES, not just free slots — the
@@ -663,6 +791,11 @@ class ServingEngine:
         if self.paged:
             self._run_copies(copies)
         maybe_fail("serving.step.decode", step=self._step_idx - 1)
+        if self.meshctx is not None:
+            # mesh engines: the SHARDED decode program is about to run
+            # (chaos kill point for the tensor-parallel flavor)
+            maybe_fail("serving.decode.sharded",
+                       step=self._step_idx - 1, tp=self.meshctx.tp)
         with span("serving.decode", batch=len(active),
                   request_ids=[self.cache.slots[s].rid
                                for s in active]):
@@ -712,7 +845,6 @@ class ServingEngine:
         pos = np.zeros((self.max_slots,), np.int32)
         wlen = np.zeros((self.max_slots,), np.int32)
         mask = np.zeros((self.max_slots,), bool)
-        copies = []
         for s in active:
             req = self.cache.slots[s]
             toks[s, 0] = req.out_tokens[-1]
@@ -733,9 +865,36 @@ class ServingEngine:
                     self._spec["draft_tokens"] += len(draft)
                     self._m_spec_draft.inc(len(draft))
             wlen[s] = n
-            if self.paged:
+        if self.spec_gate and all(int(wlen[s]) == 1 for s in active):
+            # no row drafted this step: every lane would run the
+            # k-wide program at wlen 1 — the k=1 decode program emits
+            # the PROVABLY identical token (same logits row, same
+            # per-row RNG stream for sampled rows, same page/EOS
+            # bookkeeping) at 1/k the verify compute. No page state
+            # was touched yet, so delegating is clean; trace counts
+            # stay bounded at <= 1 decode + <= 1 verify program.
+            # the mid-verify kill point still guards EVERY speculative
+            # decode step (drafts considered, nothing emitted yet) —
+            # gating must not thin the chaos sweep's kill cadence
+            maybe_fail("serving.decode.verify",
+                       step=self._step_idx - 1, gated=True)
+            n_rows = len(active)
+            self._decode_plain(active, finished)
+            # accounting AFTER the delegated step succeeds: a fault
+            # inside it replays through this gate on recover, and a
+            # pre-bump would double-count rows that delivered once
+            self._spec["gated_steps"] += 1
+            self._spec["rows"] += n_rows
+            self._spec["emitted"] += n_rows
+            self._spec["acc_len_hist"][1] += n_rows
+            for _ in range(n_rows):
+                self._m_spec_acc.observe(1.0)
+            return
+        copies = []
+        if self.paged:
+            for s in active:
                 copies += self.cache.ensure_decode_range(
-                    s, req.next_pos, n)
+                    s, self.cache.slots[s].next_pos, int(wlen[s]))
         # COW copies BEFORE the kill point (same reason as the plain
         # decode: flipped table rows must never outrun their copies)
         if self.paged:
@@ -744,6 +903,9 @@ class ServingEngine:
         # claimed/COW'd, nothing emitted yet — recovery must replay
         # token-identically and leak no pages (chaos-audited)
         maybe_fail("serving.decode.verify", step=self._step_idx - 1)
+        if self.meshctx is not None:
+            maybe_fail("serving.decode.sharded",
+                       step=self._step_idx - 1, tp=self.meshctx.tp)
         with span("serving.verify", batch=len(active), k=K,
                   request_ids=[self.cache.slots[s].rid
                                for s in active]):
@@ -953,7 +1115,6 @@ class ServingEngine:
         reason = self._broken
         in_flight = [(s, r) for s, r in enumerate(self.cache.slots)
                      if r is not None]
-        ad = self.adapter
         if self.paged:
             # flush the dying pool's counter deltas, then re-baseline:
             # the fresh pool restarts its raw counters at zero and a
@@ -962,7 +1123,7 @@ class ServingEngine:
             self._last_page_stats = {k: 0
                                      for k in self._last_page_stats}
         self.cache = self._new_cache()
-        self._params, self._buffers = ad.model.raw_state()
+        self._refresh_state()
         # accumulate on the ENGINE, not a local: if a re-prefill below
         # faults, these requests are gone from the slot table, and the
         # retrying recover() must still deliver them in its report.
@@ -1193,6 +1354,8 @@ class ServingEngine:
         pages were claimed unwinds them (abort_sequence)."""
         maybe_fail("serving.step.prefill", slot=slot)
         n = int(ids.shape[0])
+        disagg = self.meshctx is not None \
+            and self.meshctx.disaggregated
         if not self.paged:
             if cancel_check and req is not None \
                     and self._cancel_requested(req):
@@ -1207,11 +1370,28 @@ class ServingEngine:
                       slot=slot, bucket=bucket, prompt_len=n):
                 padded = np.zeros((1, bucket), np.int64)
                 padded[0, :n] = ids
-                logits, ks, vs = self._prefill_fn()(
-                    self._params, self._buffers, padded,
-                    np.int32(n), np.int32(slot),
-                    self.cache.ks, self.cache.vs)
-                self.cache.ks, self.cache.vs = list(ks), list(vs)
+                if disagg:
+                    # compute on the PREFILL group, then hand the
+                    # finished rows to the decode-owned pool; a
+                    # failed handoff's staged span dies with this
+                    # frame (the contiguous pool has no page claims
+                    # to unwind — the slot was never assigned)
+                    logits, kb, vb = self._prefill_fn()(
+                        self._params_pf, self._buffers_pf, padded,
+                        np.int32(n))
+                    try:
+                        self._kv_handoff(req, slot, (kb, vb),
+                                         cancel_check=cancel_check)
+                    except Exception:
+                        if req is not None:
+                            self._staged_handoffs.pop(req.rid, None)
+                        raise
+                else:
+                    logits, ks, vs = self._prefill_fn()(
+                        self._params, self._buffers, padded,
+                        np.int32(n), np.int32(slot),
+                        self.cache.ks, self.cache.vs)
+                    self.cache.ks, self.cache.vs = list(ks), list(vs)
             return np.asarray(jax.device_get(logits))
         cache = self.cache
         if req.rid not in cache._plans:
@@ -1250,23 +1430,44 @@ class ServingEngine:
                 padded = np.zeros((1, bucket), np.int64)
                 padded[0, :tail] = ids[start:]
                 row = cache.page_table[slot]
-                if start == 0:
+                if start == 0 and disagg:
+                    # full prefill on the PREFILL group; the page
+                    # blocks (int8-quantized there when configured)
+                    # hand off to the decode pool at the claimed ids
+                    npages = (bucket + cache.page_size - 1) \
+                        // cache.page_size
+                    logits, kb, vb, ksb, vsb = self._prefill_fn()(
+                        self._params_pf, self._buffers_pf, padded,
+                        np.int32(n))
+                    self._kv_handoff(req, slot, (kb, vb, ksb, vsb),
+                                     page_ids=row[:npages].copy(),
+                                     cancel_check=cancel_check)
+                elif start == 0:
                     npages = (bucket + cache.page_size - 1) \
                         // cache.page_size
                     logits, ks, vs, kss, vss = self._prefill_fn()(
                         self._params, self._buffers, padded,
                         np.int32(n), row[:npages].copy(),
                         cache.ks, cache.vs, cache.kss, cache.vss)
+                    cache.ks, cache.vs = list(ks), list(vs)
+                    cache.kss, cache.vss = list(kss), list(vss)
                 else:
+                    # prefix-hit EXTEND: stays on the decode group —
+                    # it attends over shared pages already resident
+                    # in the decode-owned pool
                     logits, ks, vs, kss, vss = self._extend_fn()(
                         self._params, self._buffers, padded,
                         np.int32(start), np.int32(tail), row.copy(),
                         cache.ks, cache.vs, cache.kss, cache.vss)
-                cache.ks, cache.vs = list(ks), list(vs)
-                cache.kss, cache.vss = list(kss), list(vss)
+                    cache.ks, cache.vs = list(ks), list(vs)
+                    cache.kss, cache.vss = list(kss), list(vss)
             cache.register_prefix(slot, ids)
             return np.asarray(jax.device_get(logits))
         except Exception:
+            # the cross-group unwind: drop the staged prefill-side
+            # span (if a handoff was in flight) WITH the decode-side
+            # page claims — the leak audit checks both halves
+            self._staged_handoffs.pop(req.rid, None)
             cache.abort_sequence(slot, req)
             raise
 
@@ -1279,6 +1480,21 @@ class ServingEngine:
                                   c.ks, c.vs, c.kss, c.vss)
             c.ks, c.vs = list(out[0]), list(out[1])
             c.kss, c.vss = list(out[2]), list(out[3])
+
+    def _prog_shardings(self, group: str = "decode"):
+        """Sharding trees for jitting one engine program under the
+        mesh: (params dict, buffers dict, replicated, per-layer KV
+        pool list, per-layer scale list — empty when not int8)."""
+        m, ad = self.meshctx, self.adapter
+        L = ad.num_layers
+        params = self._params if group == "decode" else self._params_pf
+        bufs = self._buffers if group == "decode" else self._buffers_pf
+        return (self._param_shardings(params, group),
+                m.replicated_tree(bufs, group),
+                m.repl(group),
+                [m.kv_sharding(group)] * L,
+                [m.scale_sharding(group)] * L
+                if (self.paged and self.kv_quant) else [])
 
     def _paged_caches(self, ks, vs, kss, vss, table, pos, wlen=None):
         """Per-layer paged cache tuples for the model forward
@@ -1311,7 +1527,13 @@ class ServingEngine:
         harmless: the per-slot causal mask hides positions > the
         current length, and each decode step overwrites position
         ``len`` right before attending it; padded PAGE slots point at
-        the reserved trash page."""
+        the reserved trash page.
+
+        DISAGGREGATED engines compile a COMPUTE-ONLY flavor on the
+        PREFILL group instead: it returns the finished KV span (local
+        rows, or paginated + int8-quantized page blocks) rather than
+        writing the pool — the decode group owns the pool, and
+        ``_kv_handoff`` ships + installs the span explicitly."""
         if self._prefill_jit is not None:
             return self._prefill_jit
         ad = self.adapter
@@ -1331,7 +1553,25 @@ class ServingEngine:
                 logits = ad.head(Tensor(h_last))._data[0, -1]
             return logits, new_caches
 
+        disagg = self.meshctx is not None \
+            and self.meshctx.disaggregated
+
         if not self.paged:
+            if disagg:
+                def pure(params, buffers, ids, true_len):
+                    logits, new_caches = local_run(params, buffers,
+                                                   ids, true_len)
+                    d = lambda c: getattr(c, "_data", c)
+                    return (logits,
+                            [d(c[0]) for c in new_caches],
+                            [d(c[1]) for c in new_caches])
+
+                psh, bsh, R, kv, _ = self._prog_shardings("prefill")
+                self._prefill_jit = jax.jit(
+                    pure, in_shardings=(psh, bsh, R, R),
+                    out_shardings=(R, kv, kv))
+                return self._prefill_jit
+
             def pure(params, buffers, ids, true_len, slot, ks, vs):
                 logits, new_caches = local_run(params, buffers, ids,
                                                true_len)
@@ -1342,26 +1582,63 @@ class ServingEngine:
                 vs = [splice(p, c[1]) for p, c in zip(vs, new_caches)]
                 return logits, ks, vs
 
+            jit_kw = {}
+            if self.meshctx is not None:
+                psh, bsh, R, kv, _ = self._prog_shardings()
+                jit_kw = dict(in_shardings=(psh, bsh, R, R, R, kv, kv),
+                              out_shardings=(R, kv, kv))
             self._prefill_jit = jax.jit(pure,
-                                        donate_argnums=self._donate())
+                                        donate_argnums=self._donate(),
+                                        **jit_kw)
             return self._prefill_jit
 
         from ..models._decode_cache import quantize_kv_page
         P = self.cache.page_size
         quant = self.kv_quant
 
-        def pure(params, buffers, ids, true_len, page_ids, ks, vs,
-                 kss, vss):
-            logits, new_caches = local_run(params, buffers, ids,
-                                           true_len)
-            npg = page_ids.shape[0]
-            pad = npg * P - ids.shape[1]
-
+        def paginate_fn(npg, pad):
             def paginate(c):
                 a = getattr(c, "_data", c)
                 if pad:
                     a = jnp.pad(a, ((0, 0), (0, pad), (0, 0), (0, 0)))
                 return a.reshape(npg, P, *a.shape[2:])
+            return paginate
+
+        if disagg:
+            def pure(params, buffers, ids, true_len):
+                logits, new_caches = local_run(params, buffers, ids,
+                                               true_len)
+                npg = (ids.shape[1] + P - 1) // P
+                paginate = paginate_fn(npg, npg * P - ids.shape[1])
+                kb, vb, ksb, vsb = [], [], [], []
+                for c in new_caches:
+                    kpg, vpg = paginate(c[0]), paginate(c[1])
+                    if quant:
+                        # quantize on the PREFILL group: the handoff
+                        # then ships int8 + scales, not model-dtype
+                        kq, ksc = quantize_kv_page(kpg)
+                        vq, vsc = quantize_kv_page(vpg)
+                        kb.append(kq)
+                        vb.append(vq)
+                        ksb.append(ksc)
+                        vsb.append(vsc)
+                    else:
+                        kb.append(kpg)
+                        vb.append(vpg)
+                return logits, kb, vb, ksb, vsb
+
+            psh, bsh, R, kv, sc = self._prog_shardings("prefill")
+            self._prefill_jit = jax.jit(
+                pure, in_shardings=(psh, bsh, R, R),
+                out_shardings=(R, kv, kv, sc, sc))
+            return self._prefill_jit
+
+        def pure(params, buffers, ids, true_len, page_ids, ks, vs,
+                 kss, vss):
+            logits, new_caches = local_run(params, buffers, ids,
+                                           true_len)
+            npg = page_ids.shape[0]
+            paginate = paginate_fn(npg, npg * P - ids.shape[1])
 
             for i, c in enumerate(new_caches):
                 kpg, vpg = paginate(c[0]), paginate(c[1])
@@ -1379,8 +1656,15 @@ class ServingEngine:
                         vpg.astype(vs[i].dtype))
             return logits, ks, vs, kss, vss
 
+        jit_kw = {}
+        if self.meshctx is not None:
+            psh, bsh, R, kv, sc = self._prog_shardings()
+            jit_kw = dict(
+                in_shardings=(psh, bsh, R, R, R, kv, kv, sc, sc),
+                out_shardings=(R, kv, kv, sc, sc))
         self._prefill_jit = jax.jit(
-            pure, donate_argnums=self._donate_idx(5, 6, 7, 8))
+            pure, donate_argnums=self._donate_idx(5, 6, 7, 8),
+            **jit_kw)
         return self._prefill_jit
 
     def _extend_fn(self):
@@ -1389,10 +1673,21 @@ class ServingEngine:
         start position ``start``, attending over the already-shared
         prefix pages through the slot's page table and writing their
         own k/v through it (bucket-padding writes past the table fall
-        into the trash page). Logits at the last REAL tail token."""
+        into the trash page). Logits at the last REAL tail token.
+
+        Disaggregation note: extends run on the DECODE group even when
+        full prefills are offloaded — they attend over shared pages
+        that already live in the decode-owned pool, and a prefix-hit
+        tail is short by construction (docs/SERVING.md)."""
         if self._extend_jit is not None:
             return self._extend_jit
         ad = self.adapter
+        jit_kw = {}
+        if self.meshctx is not None:
+            psh, bsh, R, kv, sc = self._prog_shardings()
+            jit_kw = dict(
+                in_shardings=(psh, bsh, R, R, R, R, kv, kv, sc, sc),
+                out_shardings=(R, kv, kv, sc, sc))
 
         def pure(params, buffers, ids, start, true_tail, row, ks, vs,
                  kss, vss):
@@ -1409,14 +1704,135 @@ class ServingEngine:
             return (logits,) + self._unpack_paged(new_caches)
 
         self._extend_jit = jax.jit(
-            pure, donate_argnums=self._donate_idx(6, 7, 8, 9))
+            pure, donate_argnums=self._donate_idx(6, 7, 8, 9),
+            **jit_kw)
         return self._extend_jit
+
+    def _install_fn(self, key):
+        """Decode-group INSTALL program for one handed-off KV span
+        (disaggregated engines only), compiled once per block shape:
+        paged — scatter the shipped page blocks (int8 + scales on the
+        quantized path) into the pool at the claimed page ids;
+        contiguous — splice the shipped rows into the slot row. The
+        shape key space is the prefill bucket set, so installs stay
+        inside the same O(log max_len) compile budget as prefills."""
+        if self._install_jit is None:
+            self._install_jit = {}
+        fn = self._install_jit.get(key)
+        if fn is not None:
+            return fn
+        m = self.meshctx
+        L = self.adapter.num_layers
+        R = m.repl()
+        kv = [m.kv_sharding()] * L
+        sc = [m.scale_sharding()] * L \
+            if (self.paged and self.kv_quant) else []
+
+        def count():
+            self.trace_counts["install"][key] = \
+                self.trace_counts["install"].get(key, 0) + 1
+
+        if self.paged:
+            def pure(page_ids, kb, vb, ksb, vsb, ks, vs, kss, vss):
+                count()
+                ks = [p.at[page_ids].set(b.astype(p.dtype))
+                      for p, b in zip(ks, kb)]
+                vs = [p.at[page_ids].set(b.astype(p.dtype))
+                      for p, b in zip(vs, vb)]
+                kss = [p.at[page_ids].set(b)
+                       for p, b in zip(kss, ksb)]
+                vss = [p.at[page_ids].set(b)
+                       for p, b in zip(vss, vsb)]
+                return ks, vs, kss, vss
+
+            fn = jax.jit(
+                pure,
+                in_shardings=(R, kv, kv, sc, sc, kv, kv, sc, sc),
+                out_shardings=(kv, kv, sc, sc),
+                donate_argnums=self._donate_idx(5, 6, 7, 8))
+        else:
+            def pure(slot, kb, vb, ks, vs):
+                count()
+                splice = lambda pool, b: jax.lax.dynamic_update_slice(
+                    pool, b.astype(pool.dtype), (slot, 0, 0, 0))
+                return ([splice(p, b) for p, b in zip(ks, kb)],
+                        [splice(p, b) for p, b in zip(vs, vb)])
+
+            fn = jax.jit(pure,
+                         in_shardings=(R, kv, kv, kv, kv),
+                         out_shardings=(kv, kv),
+                         donate_argnums=self._donate_idx(3, 4))
+        self._install_jit[key] = fn
+        return fn
+
+    def _kv_handoff(self, req, slot, blocks, page_ids=None,
+                    cancel_check: bool = False) -> None:
+        """Disaggregated prefill -> decode KV handoff: ship a finished
+        prefill's KV span from the prefill group to the decode group
+        (explicit cross-group ``jax.device_put``) and install it into
+        the decode-owned pool. The ``serving.kv.handoff`` fault point
+        fires BETWEEN compute and install — a raise here (injected
+        fault, client disconnect observed mid-handoff) routes through
+        the caller's abort path, so a half-handed-off request unwinds
+        on BOTH groups: the staged span is dropped with this frame and
+        the decode pool's page claims return via abort_sequence. The
+        staging ledger `_staged_handoffs` is audited empty at quiesce
+        (cross-group no-leak law, resilience/invariants.py)."""
+        m = self.meshctx
+        rid = req.rid if req is not None else -1
+        # staged BEFORE the kill point; popped on successful install,
+        # or by the caller's ABORT path on any raise below — the same
+        # path that returns the decode-side page claims, so a
+        # regression that forgets either unwind half trips the
+        # cross-group leak audit (a finally here would clear it
+        # unconditionally and make that audit vacuous)
+        self._staged_handoffs[rid] = slot
+        maybe_fail("serving.kv.handoff", slot=slot, rid=rid)
+        if cancel_check and req is not None \
+                and self._cancel_requested(req):
+            # the client vanished while its KV sat staged on the
+            # prefill group: don't ship or install a span nobody
+            # will decode — the abort path frees the page claims
+            raise RequestCancelled(
+                req.rid, "client disconnected mid-KV-handoff")
+        L = self.adapter.num_layers
+        dec_kv = [m.kv_sharding()] * L
+        c = self.cache
+        with span("serving.kv_handoff", slot=slot, request_id=rid):
+            if self.paged:
+                kb, vb, ksb, vsb = blocks
+                kb = jax.device_put(list(kb), dec_kv)
+                vb = jax.device_put(list(vb), dec_kv)
+                if self.kv_quant:
+                    dec_sc = [m.scale_sharding()] * L
+                    ksb = jax.device_put(list(ksb), dec_sc)
+                    vsb = jax.device_put(list(vsb), dec_sc)
+                out = self._install_fn(
+                    ("paged", int(page_ids.shape[0])))(
+                    page_ids, kb, vb, list(ksb), list(vsb),
+                    c.ks, c.vs, c.kss, c.vss)
+                c.ks, c.vs = list(out[0]), list(out[1])
+                c.kss, c.vss = list(out[2]), list(out[3])
+            else:
+                kb, vb = blocks
+                kb = jax.device_put(list(kb), dec_kv)
+                vb = jax.device_put(list(vb), dec_kv)
+                ks, vs = self._install_fn(
+                    ("contig", int(kb[0].shape[1])))(
+                    np.int32(slot), kb, vb, c.ks, c.vs)
+                c.ks, c.vs = list(ks), list(vs)
+        self._staged_handoffs.pop(rid, None)
 
     def _copy_fn(self):
         """COW page copy (compiled once): pool[dst] <- pool[src] for
         every layer's k/v (+scale) pool."""
         if self._copy_jit is not None:
             return self._copy_jit
+        jit_kw = {}
+        if self.meshctx is not None:
+            _, _, R, kv, sc = self._prog_shardings()
+            jit_kw = dict(in_shardings=(R, R, kv, kv, sc, sc),
+                          out_shardings=(kv, kv, sc, sc))
 
         def pure(src, dst, ks, vs, kss, vss):
             self.trace_counts["copy"] += 1
@@ -1425,7 +1841,8 @@ class ServingEngine:
                     [cp(p) for p in kss], [cp(p) for p in vss])
 
         self._copy_jit = jax.jit(
-            pure, donate_argnums=self._donate_idx(2, 3, 4, 5))
+            pure, donate_argnums=self._donate_idx(2, 3, 4, 5),
+            **jit_kw)
         return self._copy_jit
 
     def _decode_fn(self):
@@ -1435,10 +1852,27 @@ class ServingEngine:
         they stay numerically inert whatever garbage their row holds.
         Paged flavor: same contract, but k/v flow through the page
         tables (inactive rows pinned to the trash page) — paging adds
-        ZERO decode compiles beyond this one program."""
+        ZERO decode compiles beyond this one program.
+
+        Mesh flavor: the SAME program jitted under the decode group's
+        mesh with explicit in/out shardings — params by the family's
+        tp_param_spec rules, pools split on kv_heads, token/position/
+        mask blocks replicated. Still exactly ONE compile per mesh
+        shape, and bitwise token-identical to the single-chip program
+        (output-dim-only sharding: no float sum is re-associated)."""
         if self._decode_jit is not None:
             return self._decode_jit
         ad = self.adapter
+        jit_kw = {}
+        if self.meshctx is not None:
+            psh, bsh, R, kv, sc = self._prog_shardings()
+            if self.paged:
+                jit_kw = dict(
+                    in_shardings=(psh, bsh, R, R, R, R, kv, kv, sc, sc),
+                    out_shardings=(R, kv, kv, sc, sc))
+            else:
+                jit_kw = dict(in_shardings=(psh, bsh, R, R, R, kv, kv),
+                              out_shardings=(R, kv, kv))
 
         if self.paged:
             def pure(params, buffers, toks, pos, active, tables, ks,
@@ -1455,7 +1889,8 @@ class ServingEngine:
                 return (logits,) + self._unpack_paged(new_caches)
 
             self._decode_jit = jax.jit(
-                pure, donate_argnums=self._donate_idx(6, 7, 8, 9))
+                pure, donate_argnums=self._donate_idx(6, 7, 8, 9),
+                **jit_kw)
             return self._decode_jit
 
         def pure(params, buffers, toks, pos, active, ks, vs):
@@ -1470,8 +1905,8 @@ class ServingEngine:
             vs2 = [getattr(c[1], "_data", c[1]) for c in new_caches]
             return logits, ks2, vs2
 
-        self._decode_jit = jax.jit(pure,
-                                   donate_argnums=self._donate())
+        self._decode_jit = jax.jit(pure, donate_argnums=self._donate(),
+                                   **jit_kw)
         return self._decode_jit
 
     def _verify_fn(self):
@@ -1495,6 +1930,18 @@ class ServingEngine:
         if self._verify_jit is not None:
             return self._verify_jit
         ad = self.adapter
+        jit_kw = {}
+        if self.meshctx is not None:
+            psh, bsh, R, kv, sc = self._prog_shardings()
+            if self.paged:
+                jit_kw = dict(
+                    in_shardings=(psh, bsh, R, R, R, R, R,
+                                  kv, kv, sc, sc),
+                    out_shardings=(R, R, R, kv, kv, sc, sc))
+            else:
+                jit_kw = dict(
+                    in_shardings=(psh, bsh, R, R, R, R, kv, kv),
+                    out_shardings=(R, R, R, kv, kv))
 
         def accept(toks, logits, wl_eff, active):
             K = toks.shape[1]
@@ -1533,7 +1980,8 @@ class ServingEngine:
                     + self._unpack_paged(new_caches)
 
             self._verify_jit = jax.jit(
-                pure, donate_argnums=self._donate_idx(7, 8, 9, 10))
+                pure, donate_argnums=self._donate_idx(7, 8, 9, 10),
+                **jit_kw)
             return self._verify_jit
 
         def pure(params, buffers, toks, pos, active, wlen, ks, vs):
@@ -1552,7 +2000,7 @@ class ServingEngine:
             return logits, g, acc, ks2, vs2
 
         self._verify_jit = jax.jit(
-            pure, donate_argnums=self._donate_idx(6, 7))
+            pure, donate_argnums=self._donate_idx(6, 7), **jit_kw)
         return self._verify_jit
 
     @staticmethod

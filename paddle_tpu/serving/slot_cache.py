@@ -109,19 +109,35 @@ class _SlotTable:
         return sum(p.size * p.dtype.itemsize for p in pools)
 
 
+def _place_pools(pools, sharding):
+    """Commit freshly allocated pool buffers to a device sharding (the
+    tensor-parallel serving mesh: kv_heads split over the ``model``
+    axis — serving/mesh.py). None = single-device default placement."""
+    if sharding is None:
+        return pools
+    import jax
+    return [jax.device_put(p, sharding) for p in pools]
+
+
 class SlotKVCache(_SlotTable):
     """Per-layer [max_slots, max_len, kv_heads, head_dim] k/v buffers
-    plus the slot lease table (the contiguous pool)."""
+    plus the slot lease table (the contiguous pool). ``kv_sharding``
+    commits the pools to a tensor-parallel mesh (split on kv_heads)."""
 
     def __init__(self, num_layers: int, max_slots: int, max_len: int,
-                 kv_heads: int, head_dim: int, dtype):
+                 kv_heads: int, head_dim: int, dtype,
+                 kv_sharding=None):
         _validate_geometry(num_layers, max_slots, max_len, kv_heads,
                            head_dim)
         super().__init__(max_slots)
         self.max_len = max_len
         shape = (max_slots, max_len, kv_heads, head_dim)
-        self.ks = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
-        self.vs = [jnp.zeros(shape, dtype) for _ in range(num_layers)]
+        self.ks = _place_pools(
+            [jnp.zeros(shape, dtype) for _ in range(num_layers)],
+            kv_sharding)
+        self.vs = _place_pools(
+            [jnp.zeros(shape, dtype) for _ in range(num_layers)],
+            kv_sharding)
 
 
 class _PrefixNode:
@@ -149,7 +165,8 @@ class PagedKVCache(_SlotTable):
     def __init__(self, num_layers: int, max_slots: int, max_len: int,
                  kv_heads: int, head_dim: int, dtype,
                  page_size: int = 128, num_pages: Optional[int] = None,
-                 quant: bool = False, prefix_sharing: bool = True):
+                 quant: bool = False, prefix_sharing: bool = True,
+                 kv_sharding=None, scale_sharding=None):
         _validate_geometry(num_layers, max_slots, max_len, kv_heads,
                            head_dim)
         if page_size < 1:
@@ -177,15 +194,19 @@ class PagedKVCache(_SlotTable):
         self.dtype = dtype
         shape = (num_pages, page_size, kv_heads, head_dim)
         pool_dtype = jnp.int8 if self.quant else dtype
-        self.ks = [jnp.zeros(shape, pool_dtype)
-                   for _ in range(num_layers)]
-        self.vs = [jnp.zeros(shape, pool_dtype)
-                   for _ in range(num_layers)]
+        self.ks = _place_pools([jnp.zeros(shape, pool_dtype)
+                                for _ in range(num_layers)], kv_sharding)
+        self.vs = _place_pools([jnp.zeros(shape, pool_dtype)
+                                for _ in range(num_layers)], kv_sharding)
         sshape = (num_pages, page_size, kv_heads)
-        self.kss = [jnp.zeros(sshape, jnp.float32)
-                    for _ in range(num_layers)] if self.quant else []
-        self.vss = [jnp.zeros(sshape, jnp.float32)
-                    for _ in range(num_layers)] if self.quant else []
+        self.kss = _place_pools(
+            [jnp.zeros(sshape, jnp.float32)
+             for _ in range(num_layers)],
+            scale_sharding) if self.quant else []
+        self.vss = _place_pools(
+            [jnp.zeros(sshape, jnp.float32)
+             for _ in range(num_layers)],
+            scale_sharding) if self.quant else []
         # static shape: the one compiled decode program takes the whole
         # table; rows of freed slots are zeroed (-> trash page)
         self.page_table = np.zeros((max_slots, self.pages_per_slot),

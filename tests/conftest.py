@@ -10,11 +10,24 @@ update the jax config before any backend is initialized.
 import os
 import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = \
-        flags + " --xla_force_host_platform_device_count=8"
+
+def force_virtual_devices(n: int = 8) -> None:
+    """The multi-device CPU emulation used by the MULTICHIP benches,
+    benchmarks/run_all.py and this test suite, in ONE place: force the
+    CPU backend and ``n`` virtual XLA host devices. MUST run before
+    jax initializes a backend — import-time here; benchmarks call
+    their own copy of this dance before importing jax (they cannot
+    import tests/conftest). No-op when an XLA_FLAGS device count is
+    already pinned, so nesting (pytest -> subprocess bench -> this)
+    keeps the outer setting."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = \
+            flags + f" --xla_force_host_platform_device_count={n}"
+
+
+force_virtual_devices(8)
 
 import jax  # noqa: E402
 
@@ -56,6 +69,34 @@ _FULL_TIER_FILES = {
     "test_int8_guard.py", "test_fused_ce.py",
     "test_fuse_ln_modes.py",
 }
+
+
+# ---------------------------------------------------------------------------
+# Shared multi-device helpers (import in test files: `from conftest
+# import require_devices, serving_model_mesh`): mesh-sharded serving
+# tests ride the SAME 8 virtual devices forced above — a guarded skip
+# instead of a hard failure keeps the suite honest on images where the
+# emulation is unavailable, without polluting single-device tests
+# (programs not built under a mesh still place on device 0 only).
+# ---------------------------------------------------------------------------
+
+def require_devices(n: int):
+    """Skip the calling test unless >= n (virtual) devices exist."""
+    if jax.device_count() < n:
+        pytest.skip(f"needs {n} devices, have {jax.device_count()} "
+                    f"(XLA host-device emulation not active)")
+
+
+def serving_model_mesh(tp: int = 2, prefill: int = 0):
+    """A ProcessMesh with a `model` axis over ``tp + prefill``
+    devices, for ServingEngine(mesh=...) tests: the first ``prefill``
+    devices become the disaggregated prefill group when the engine is
+    built with prefill_devices=prefill."""
+    require_devices(tp + prefill)
+    import numpy as _np
+
+    from paddle_tpu.distributed import ProcessMesh
+    return ProcessMesh(_np.arange(tp + prefill), ["model"])
 
 
 # shared interpreter-version gates (import in test files:

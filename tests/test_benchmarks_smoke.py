@@ -49,6 +49,21 @@ SPEC_DECODE_KEYS = {
 }
 
 
+# the TP_SERVING line (bench_serving_engine --tensor-parallel) is the
+# ISSUE-9 acceptance artifact: the same burst trace through the
+# single-chip, TP=2 and disaggregated (2 prefill + 2 decode) engines
+# on the emulated mesh — schema stable, greedy token-identical across
+# all three, ONE decode compile per mesh shape, handoff installs
+# bounded by the prefill-bucket shape set
+TP_SERVING_KEYS = {
+    "devices", "tp", "prefill_devices", "requests",
+    "tokens_per_s_single", "tokens_per_s_tp", "tokens_per_s_disagg",
+    "ttft_p99_s_single", "ttft_p99_s_tp", "ttft_p99_s_disagg",
+    "token_identical", "decode_compiles_tp", "decode_compiles_disagg",
+    "install_compiles", "install_shapes", "kv_shards",
+}
+
+
 # the PAGED_KV line (bench_serving_engine --prefix-share) is the
 # artifact the paged-KV acceptance keys on: schema stable, gains over
 # the contiguous pool asserted at the ISSUE-6 bars (>= 4x paged,
@@ -70,6 +85,7 @@ PAGED_KV_KEYS = {
     "bench_serving_engine.py --prefix-share",
     "bench_serving_engine.py --speculative",
     "bench_serving_engine.py --frontdoor",
+    "bench_serving_engine.py --tensor-parallel",
     "chaos_soak.py",
 ])
 def test_benchmark_script_smoke(script, tmp_path):
@@ -154,6 +170,23 @@ def test_benchmark_script_smoke(script, tmp_path):
         assert slo["failovers"] >= 1, slo
         assert slo["failover_requests"] >= 1, slo
         assert slo["rejected_noisy"] >= 1, slo
+    if script == "bench_serving_engine.py --tensor-parallel":
+        tlines = [l for l in r.stdout.splitlines()
+                  if l.startswith("TP_SERVING ")]
+        assert tlines, r.stdout
+        tps = json.loads(tlines[-1][len("TP_SERVING "):])
+        assert TP_SERVING_KEYS <= set(tps), sorted(tps)
+        # ISSUE-9 acceptance bars, deterministic on the burst trace:
+        # identity across all three flavors, compile-once per mesh
+        # shape, handoff installs bounded by the prefill bucket set
+        assert tps["token_identical"] is True, tps
+        assert tps["decode_compiles_tp"] == 1, tps
+        assert tps["decode_compiles_disagg"] == 1, tps
+        assert tps["tp"] == 2 and tps["kv_shards"] == 2, tps
+        assert 1 <= tps["install_shapes"] <= 5, tps
+        assert tps["install_compiles"] == tps["install_shapes"], tps
+        assert tps["tokens_per_s_tp"] > 0, tps
+        assert tps["tokens_per_s_disagg"] > 0, tps
     if script == "chaos_soak.py":
         # the soak summary line is the artifact the CI budgeted run
         # keys on: every episode green, schema stable

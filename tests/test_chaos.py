@@ -172,6 +172,14 @@ TRAINING_SEEDS = list(range(100, 112))
 # >= 10 with requests failed over to a peer (pinned below so the
 # band cannot silently go quiet).
 FRONTDOOR_SEEDS = list(range(300, 325))
+# the tensor-parallel + disaggregated arm (ISSUE 9): mesh engines over
+# the emulated 8-device CPU mesh — TP=2 on odd seeds, disaggregated
+# 2-prefill + 2-decode on even seeds — with the sharded-decode and
+# mid-KV-handoff kill arms sampled on top of the usual serving faults.
+# Every episode is audited against the SAME single-chip reference
+# outputs (cross-flavor token identity) plus the page/slot/staged-
+# handoff no-leak laws across both chip groups.
+TP_SERVING_SEEDS = list(range(400, 425))
 
 
 _serving_spec_tally = {"episodes": 0, "speculative": 0,
@@ -211,6 +219,43 @@ def test_training_episode_matrix(seed, tmp_path):
     assert res.ok, "\n".join(res.violations)
 
 
+_tp_tally = {"episodes": 0, "disagg": 0, "handoff_kills": 0,
+             "sharded_kills": 0, "recoveries": 0}
+
+
+@pytest.mark.parametrize("seed", TP_SERVING_SEEDS)
+def test_tp_serving_episode_matrix(seed):
+    import jax
+    if jax.device_count() < 4:
+        pytest.skip("mesh episodes need the 8-device emulation")
+    flavor = "disagg" if seed % 2 == 0 else "tp"
+    res = chaos.run_serving_episode(seed, mesh_flavor=flavor)
+    assert res.ok, "\n".join(res.violations)
+    assert res.stats["mesh"] == flavor
+    assert res.stats["tp"] == 2          # both flavors decode at TP=2
+    _tp_tally["episodes"] += 1
+    _tp_tally["disagg"] += 1 if res.stats["mesh"] == "disagg" else 0
+    _tp_tally["handoff_kills"] += \
+        res.fired.get("serving.kv.handoff", 0)
+    _tp_tally["sharded_kills"] += \
+        res.fired.get("serving.decode.sharded", 0)
+    _tp_tally["recoveries"] += res.stats["recoveries"]
+
+
+def test_tp_matrix_actually_kills_handoffs_and_sharded_decodes():
+    """The mesh arm must stay LOADED: episodes that really run
+    disaggregated, really get killed MID-KV-HANDOFF (span computed on
+    the prefill group, not yet installed on the decode pool) and
+    mid-sharded-decode, and really recover — otherwise the
+    tensor-parallel soak goes green by vacuity."""
+    if _tp_tally["episodes"] < len(TP_SERVING_SEEDS):
+        pytest.skip("full TP serving matrix did not run")
+    assert _tp_tally["disagg"] >= 10, _tp_tally
+    assert _tp_tally["handoff_kills"] >= 5, _tp_tally
+    assert _tp_tally["sharded_kills"] >= 8, _tp_tally
+    assert _tp_tally["recoveries"] >= 5, _tp_tally
+
+
 _frontdoor_death_tally = {"episodes": 0, "deaths": 0,
                           "failover_requests": 0}
 
@@ -242,6 +287,7 @@ def test_frontdoor_matrix_actually_kills_replicas():
 def test_matrix_spans_all_kinds_and_enough_episodes():
     assert len(SERVING_SEEDS) + len(TRAINING_SEEDS) >= 25
     assert len(FRONTDOOR_SEEDS) >= 25      # ISSUE-7 acceptance bar
+    assert len(TP_SERVING_SEEDS) >= 25     # ISSUE-9 acceptance bar
 
 
 def test_episodes_are_deterministic():
@@ -428,7 +474,11 @@ def test_pinned_seed_catches_drain_discarding_done(monkeypatch):
     assert green.ok, "\n".join(green.violations)
 
 
-PINNED_SEED_BROKEN_SPEC = 5   # speculative episode with real accepts
+PINNED_SEED_BROKEN_SPEC = 6   # speculative episode with real accepts
+# (re-pinned 5 -> 6 for the ISSUE-9 verify GATE: no-draft steps now
+# run the k=1 decode program, so the broken-acceptance patch only
+# distorts steps that really carry drafts — seed 6 has partially
+# rejected drafts, which is exactly what the patch mis-emits)
 
 
 def test_pinned_seed_catches_broken_speculative_acceptance(
@@ -462,3 +512,42 @@ def test_pinned_seed_catches_broken_speculative_acceptance(
     assert green.stats["speculative"]
     assert green.stats["spec_accepted_drafts"] >= 1
     assert green.fired.get("serving.decode.verify", 0) >= 1
+
+
+PINNED_SEED_DROPPED_HANDOFF = 412   # disagg episode, handoff kill
+
+
+def test_pinned_seed_dropped_kv_handoff_goes_lost(monkeypatch):
+    """ISSUE-9 pinned red seed: a DROPPED KV handoff must be detected.
+    With the handoff failure SWALLOWED (the pre-fix shape: the engine
+    eats the mid-handoff exception instead of routing it through the
+    abort/requeue path, so the request is neither served nor
+    returned), the conservation ledger must go RED with LOST on the
+    pinned disaggregated seed; the real path — abort_sequence unwinds
+    the decode-side page claims, the staged span dies with the frame,
+    and the request requeues — stays green on the same seed, with the
+    handoff kill arm genuinely fired (not green by vacuity)."""
+    from paddle_tpu.resilience.faults import InjectedFault
+    from paddle_tpu.serving import ServingEngine
+    orig = ServingEngine._prefill
+
+    def swallow_handoff_failure(self, slot, req):
+        try:
+            return orig(self, slot, req)
+        except InjectedFault as e:
+            if getattr(e, "point", "") != "serving.kv.handoff":
+                raise
+            return          # pre-fix: request dropped on the floor
+
+    monkeypatch.setattr(ServingEngine, "_prefill",
+                        swallow_handoff_failure)
+    red = chaos.run_serving_episode(PINNED_SEED_DROPPED_HANDOFF,
+                                    mesh_flavor="disagg")
+    assert not red.ok
+    assert any("LOST" in v for v in red.violations), red.violations
+    monkeypatch.setattr(ServingEngine, "_prefill", orig)
+    green = chaos.run_serving_episode(PINNED_SEED_DROPPED_HANDOFF,
+                                      mesh_flavor="disagg")
+    assert green.ok, "\n".join(green.violations)
+    assert green.fired.get("serving.kv.handoff", 0) >= 1
+    assert green.stats["mesh"] == "disagg"

@@ -185,9 +185,11 @@ def _run_band(model, layout, seeds, *, max_len=64, shared=False,
                 (seed, a.rid, a.output_ids, b.output_ids)
     accepted = spec._spec["accepted_draft_tokens"]
     # compile-once contract across every ragged mix in the band:
-    # exactly ONE verify program, zero k=1-fallback recompiles
+    # exactly ONE verify program, and at most one k=1 decode program
+    # (the ISSUE-9 verify GATE routes draft-less steps through it
+    # instead of paying the k-wide program)
     assert spec.trace_counts["verify"] == 1
-    assert spec.trace_counts["decode"] == 0   # spec engine never k=1's
+    assert spec.trace_counts["decode"] <= 1
     return spec, accepted
 
 
@@ -259,13 +261,20 @@ def test_speculative_eos_stops_inside_accepted_run():
 def test_sampled_requests_fall_back_to_k1_in_same_program():
     """Non-greedy rows run at per-row length 1 INSIDE the verify
     program (host sampling rides position-0 logits): same seeded
-    output as the non-speculative engine, still one verify compile."""
+    output as the non-speculative engine, one verify compile. With
+    the default GATE (ISSUE 9), all-sampled traffic never drafts, so
+    the k-wide program is never even compiled — the k=1 decode
+    program serves every step; ``spec_gate=False`` pins the original
+    in-program fallback."""
     model = _tiny_llama()
     rng = np.random.RandomState(7)
     prompt = rng.randint(1, 100, (6,)).astype(np.int64)
     outs = []
-    for speculative in (False, True):
-        kw = {"speculative": True, "spec_k": 4} if speculative else {}
+    for mode in ("base", "gated", "ungated"):
+        kw = {}
+        if mode != "base":
+            kw = {"speculative": True, "spec_k": 4,
+                  "spec_gate": mode == "gated"}
         eng = ServingEngine(model, max_slots=2, max_len=64,
                             min_bucket=8, **kw)
         r = eng.submit(prompt, max_new_tokens=8,
@@ -273,11 +282,17 @@ def test_sampled_requests_fall_back_to_k1_in_same_program():
                                                top_k=20, seed=11))
         eng.run()
         outs.append(r.output_ids)
-        if speculative:
+        if mode == "gated":
+            assert eng.trace_counts["verify"] == 0
+            assert eng.trace_counts["decode"] == 1
+            assert eng._spec["gated_steps"] > 0
+        elif mode == "ungated":
             assert eng.trace_counts["verify"] == 1
-            # sampled rows never consumed a draft
+            assert eng.trace_counts["decode"] == 0
+        if mode != "base":
+            # sampled rows never consumed a draft either way
             assert eng._spec["draft_tokens"] == 0
-    assert outs[0] == outs[1]
+    assert outs[0] == outs[1] == outs[2]
 
 
 def test_spec_config_validation():
