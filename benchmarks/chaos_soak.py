@@ -2,11 +2,15 @@
 out, every episode audited against the end-to-end conservation
 invariants (resilience/chaos.py, docs/RESILIENCE.md).
 
-Episodes alternate between the serving engine (Poisson arrivals,
+Episodes rotate across the serving engine (Poisson arrivals,
 deadlines, cancels, decode/prefill faults, recover(), drain-under-
-fire) and the resilient training loop (step crashes, torn checkpoint
-writes, flaky stores/watchdog beats, process relaunches). Each seed
-fully determines its episode: a red seed printed here reproduces with
+fire), the resilient training loop (step crashes, torn checkpoint
+writes, flaky stores/watchdog beats, process relaunches), the
+front-door/replica-kill stack, and the CROSS-PROCESS cluster (worker
+subprocesses behind RPC replicas; cooperative kills, real SIGKILLs,
+socket partitions, supervisor respawns — skipped back to serving when
+the native TCPStore extension is unavailable). Each seed fully
+determines its episode: a red seed printed here reproduces with
 
     python -c "from paddle_tpu.resilience import chaos; \\
                print(chaos.run_serving_episode(SEED).violations)"
@@ -44,6 +48,11 @@ def main():
     opts = ap.parse_args()
 
     from paddle_tpu.resilience import chaos
+    try:
+        from paddle_tpu.distributed.store import get_lib
+        have_cluster = get_lib() is not None
+    except Exception:
+        have_cluster = False
     workdir = tempfile.mkdtemp(prefix="ptpu_chaos_")
     t0 = time.time()
     results = []
@@ -53,7 +62,10 @@ def main():
         while len(results) < opts.episodes:
             if opts.seconds and time.time() - t0 > opts.seconds:
                 break
-            kind = ("serving", "training", "frontdoor")[seed % 3]
+            kind = ("serving", "training", "frontdoor",
+                    "cluster")[seed % 4]
+            if kind == "cluster" and not have_cluster:
+                kind = "serving"   # no native store -> no workers
             r = chaos.run_episode(seed, kind, workdir=workdir)
             results.append(r)
             for p, n in r.fired.items():
@@ -68,17 +80,21 @@ def main():
         # one checkpoint tree per training episode lives under the
         # workdir — a long soak must not leak it into /tmp
         shutil.rmtree(workdir, ignore_errors=True)
+        chaos._shutdown_cluster()   # reap the warm worker pool
 
     wall = time.time() - t0
     red = [r for r in results if not r.ok]
     n_serving = sum(1 for r in results if r.kind == "serving")
     n_front = sum(1 for r in results if r.kind == "frontdoor")
+    n_cluster = sum(1 for r in results if r.kind == "cluster")
     summary = {
         "episodes": len(results),
         "green": len(results) - len(red),
         "serving_episodes": n_serving,
         "frontdoor_episodes": n_front,
-        "training_episodes": len(results) - n_serving - n_front,
+        "cluster_episodes": n_cluster,
+        "training_episodes":
+            len(results) - n_serving - n_front - n_cluster,
         "seed_range": [opts.seed_base, seed - 1],
         "red_seeds": [{"seed": r.seed, "kind": r.kind,
                        "violations": r.violations} for r in red],
@@ -86,6 +102,8 @@ def main():
                           for r in results),
         "relaunches": sum(int(r.stats.get("relaunches", 0))
                           for r in results),
+        "respawns": sum(int(r.stats.get("respawns", 0))
+                        for r in results),
         "faults_fired": fired,
         "wall_s": round(wall, 2),
     }
@@ -94,7 +112,8 @@ def main():
             f"chaos soak: {summary['green']}/{summary['episodes']} "
             f"episodes green (seeds {opts.seed_base}..{seed - 1}, "
             f"{n_serving} serving + {n_front} front-door/replica-kill"
-            f" + {summary['training_episodes']} training, "
+            f" + {n_cluster} cluster + "
+            f"{summary['training_episodes']} training, "
             f"{sum(fired.values())} faults fired over "
             f"{len(fired)} points, {summary['recoveries']} "
             f"recoveries, {summary['relaunches']} relaunches; every "

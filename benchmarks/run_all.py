@@ -28,6 +28,10 @@ SCRIPTS = ["bench_resnet50.py", "bench_bert_dp.py", "bench_gpt_hybrid.py",
            # tensor-parallel + disaggregated serving on the emulated
            # mesh (token identity + compile-once per mesh shape)
            "bench_serving_engine.py --tensor-parallel",
+           # cross-process cluster SLO (worker process SIGKILLED
+           # mid-run, supervisor respawn, exactly-once ledger;
+           # self-skips without the native TCPStore extension)
+           "bench_serving_engine.py --cluster",
            # budget via PTPU_CHAOS_EPISODES / PTPU_CHAOS_SECONDS
            "chaos_soak.py"]
 

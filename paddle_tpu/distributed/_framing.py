@@ -1,15 +1,26 @@
 """Shared length-prefixed socket framing ('<Q' header + body).
 
-One protocol, two transports: the rpc agent (distributed/rpc.py) and
-the cross-process DistModel pipeline (inference/dist_model_mp.py) —
+One protocol, three transports: the rpc agent (distributed/rpc.py),
+the cross-process DistModel pipeline (inference/dist_model_mp.py) and
+the serving cluster RPC (serving/cluster.py / serving/worker.py) —
 kept here so a framing change (checksums, size guards) cannot silently
 diverge between them. csrc/tcp_store.cc uses the same shape natively.
+
+Fault points ``cluster.rpc.send`` / ``cluster.rpc.recv`` fire here, so
+network faults are injectable everywhere the framing layer is used.
+Whatever exception is armed, callers observe a typed
+:class:`ConnectionError` — a network fault IS a broken connection, and
+after one the socket's stream position is undefined (``recv_msg`` may
+have consumed a header whose body is still in flight), so the only
+legal reaction is to close the socket. Never a partial-frame hang.
 """
 from __future__ import annotations
 
 import socket
 import struct
 from typing import Optional
+
+from ..resilience.faults import maybe_fail  # stdlib-only at import
 
 __all__ = ["send_msg", "recv_msg", "recv_exact", "nodelay",
            "MAX_FRAME_BYTES"]
@@ -29,7 +40,20 @@ def nodelay(sock: socket.socket) -> socket.socket:
     return sock
 
 
+def _fault(point: str, **ctx) -> None:
+    """Injection hook: re-type any armed fault as ConnectionError so
+    the caller's socket-error handling (close + reconnect/retry) runs
+    for injected faults exactly as for real ones."""
+    try:
+        maybe_fail(point, **ctx)
+    except ConnectionError:
+        raise
+    except Exception as e:
+        raise ConnectionError(f"injected at {point}: {e}") from e
+
+
 def send_msg(sock: socket.socket, data: bytes) -> None:
+    _fault("cluster.rpc.send", nbytes=len(data))
     sock.sendall(struct.pack("<Q", len(data)) + data)
 
 
@@ -41,6 +65,10 @@ def recv_msg(sock: socket.socket,
     if hdr is None:
         return None
     (n,) = struct.unpack("<Q", hdr)
+    # fires AFTER the header: the worst spot — the body is (or will
+    # be) in the socket buffer, so a caller that kept reading would
+    # desync on a stale frame. Raising ConnectionError forces a close.
+    _fault("cluster.rpc.recv", nbytes=n)
     if n > MAX_FRAME_BYTES:
         raise ConnectionError(
             f"frame length {n} exceeds MAX_FRAME_BYTES "

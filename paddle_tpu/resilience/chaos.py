@@ -57,8 +57,10 @@ from .invariants import (ConservationLedger, checkpoint_monotonic_violations,
 
 __all__ = ["FaultArm", "EpisodeResult", "ChaosStore",
            "SERVING_SWEEP", "TRAINING_SWEEP", "FRONTDOOR_SWEEP",
+           "CLUSTER_SWEEP",
            "run_serving_episode", "run_training_episode",
-           "run_frontdoor_episode", "run_episode"]
+           "run_frontdoor_episode", "run_cluster_episode",
+           "run_episode"]
 
 # the sweep partition: every KNOWN point is sampled by exactly one
 # episode kind (tests assert the union covers the whole catalogue).
@@ -76,6 +78,10 @@ TRAINING_SWEEP = ("train.step", "io.dataloader.worker",
                   "checkpoint.shard_write", "checkpoint.commit",
                   "watchdog.beat",
                   "store.set", "store.get", "store.add", "store.wait")
+# the RPC wire points live in distributed/_framing.py and fire in
+# whichever process does the send/recv: armed client-side they are
+# the network-partition kill kind of the cluster episodes
+CLUSTER_SWEEP = ("cluster.rpc.send", "cluster.rpc.recv")
 
 
 @dataclasses.dataclass
@@ -645,6 +651,319 @@ def run_frontdoor_episode(seed: int, max_iters: int = 300) \
 
 
 # ---------------------------------------------------------------------------
+# cluster episodes (cross-process replicas, real kills)
+# ---------------------------------------------------------------------------
+
+_cluster_sup = None
+
+
+def _shutdown_cluster() -> None:
+    global _cluster_sup
+    if _cluster_sup is not None:
+        try:
+            _cluster_sup.shutdown()
+        except Exception:
+            pass
+        _cluster_sup = None
+
+
+def _cluster_supervisor():
+    """The band-shared 2-worker cluster: spawning a worker process
+    costs seconds (jax import + model build), so episodes re-arm the
+    WARM pool via ``new_episode`` instead of paying it per seed."""
+    global _cluster_sup
+    if _cluster_sup is None:
+        import atexit
+        from ..observability import FlightRecorder, MetricRegistry
+        from ..serving.cluster import ClusterSupervisor
+        spec = {"tiny": True, "model_seed": 0,
+                "model_config": dict(
+                    num_hidden_layers=1, hidden_size=32,
+                    intermediate_size=64, num_attention_heads=2,
+                    max_position_embeddings=_MAX_LEN),
+                "engine": {"max_slots": 2, "max_len": _MAX_LEN,
+                           "min_bucket": _MIN_BUCKET},
+                "virtual_clock": True}
+        _cluster_sup = ClusterSupervisor(
+            spec, n_workers=2, max_respawns=8,
+            registry=MetricRegistry(),
+            flight_recorder=FlightRecorder(capacity=16),
+            dump_on_death=False)
+        _cluster_sup.start()
+        atexit.register(_shutdown_cluster)
+    return _cluster_sup
+
+
+def run_cluster_episode(seed: int, max_iters: int = 300,
+                        respawn: bool = True) -> EpisodeResult:
+    """One seeded CROSS-PROCESS episode: the front door + ledger from
+    the frontdoor episodes, but the replicas are ``RemoteEngine``
+    clients over real worker *processes* and the kills are real:
+
+    - **coop** — ``Replica.kill()``: the router-side flag kill; the
+      worker process stays warm and the supervisor soft-reclaims it
+      with a ``reset`` RPC (fencing without a spawn).
+    - **sigkill** — ``os.kill(pid, SIGKILL)``, either immediately or
+      armed INSIDE the worker at a serving fault point (``kill=True``
+      → the process dies mid-prefill / mid-decode). The supervisor
+      pays a real process respawn.
+    - **partition** — ``cluster.rpc.send``/``recv`` armed CLIENT-side
+      past the retry budget: the socket dies mid-frame, retries
+      exhaust, the replica goes ``ReplicaDead`` while the worker
+      process is still alive — the supervisor must fence it.
+
+    Failover + respawn run under the load; audits are the frontdoor
+    set END-TO-END (ledger conservation, token identity vs the
+    in-process reference replay — the cross-process identity law —
+    stream consistency, router/front-door leaks) plus an in-worker
+    page/slot-leak audit over the survivors. ``respawn=False`` turns
+    the supervisor into fence-only (the pinned-red-seed mode)."""
+    import signal as _signal
+    from ..observability import FlightRecorder, MetricRegistry
+    from ..serving import ClientStream, FrontDoor, ServingError, TenantPolicy
+
+    refs = _reference_outputs()
+    pool = _prompt_pool()
+    faults.clear()
+    faults.reset_counts()
+    rng = np.random.RandomState(seed)
+    ledger = ConservationLedger()
+    clock = {"t": 0.0}
+    sup = _cluster_supervisor()
+    sup.respawn = respawn
+
+    max_slots = int(rng.randint(1, 3))
+    num_pages = int(rng.randint(_MAX_LEN // 8 + 1,
+                                max_slots * (_MAX_LEN // 8) + 2))
+    eng_kw = dict(max_slots=max_slots, max_len=_MAX_LEN,
+                  min_bucket=_MIN_BUCKET, page_size=8,
+                  num_pages=num_pages)
+    donate = bool(rng.randint(0, 2))
+    router = sup.new_episode(eng_kw, donate=donate, virtual_clock=True,
+                             time_fn=lambda: clock["t"])
+    # the supervisor's registry is band-lived: snapshot the router
+    # counters so the stats below are THIS episode's deltas
+    fail0 = int(router._m_failover.value)
+    fail_req0 = int(router._m_failover_req.value)
+    tenants = {}
+    if rng.random() < 0.5:
+        tenants["b"] = TenantPolicy(
+            rate_qps=float(rng.randint(1, 4)) / 4.0, burst=2,
+            max_inflight=int(rng.randint(1, 4)))
+    front = FrontDoor(router, auditor=ledger,
+                      time_fn=lambda: clock["t"],
+                      registry=MetricRegistry(),
+                      flight_recorder=FlightRecorder(capacity=8),
+                      tenants=tenants)
+
+    n_req = int(rng.randint(4, 9))
+    plan = []      # (arrival_t, pool_idx, max_new, deadline, tenant)
+    t = 0.0
+    for _ in range(n_req):
+        t += float(rng.exponential(1.5))
+        max_new = 1 if rng.random() < 0.2 \
+            else int(rng.randint(2, _REF_HORIZON + 1))
+        plan.append((t, int(rng.randint(0, len(pool))), max_new,
+                     float(rng.randint(4, 20))
+                     if rng.random() < 0.3 else None,
+                     "b" if (tenants and rng.random() < 0.4) else "a"))
+    cancels = []
+    if rng.random() < 0.3:
+        cancels.append((int(rng.randint(0, n_req)),
+                        int(rng.randint(1, 12))))
+    disconnects = []
+    if rng.random() < 0.4:
+        disconnects.append((int(rng.randint(0, n_req)),
+                            int(rng.randint(1, 12))))
+    # the three kill kinds, sampled independently (an episode may mix
+    # them — or stay quiet); every draw happens HERE so the schedule
+    # is a pure function of the seed
+    kills = []     # (iteration, kind, live-replica pick)
+    if rng.random() < 0.45:
+        kills.append((int(rng.randint(2, 12)), "coop",
+                      int(rng.randint(0, 8))))
+    sig_point = ("serving.step.decode", "serving.step.prefill",
+                 "serving.prefill.paged")[int(rng.randint(0, 3))]
+    sig_immediate = bool(rng.randint(0, 2))
+    sig_after = int(rng.randint(0, 4))
+    if rng.random() < 0.45:
+        kills.append((int(rng.randint(2, 14)), "sigkill",
+                      int(rng.randint(0, 8))))
+    part_point = CLUSTER_SWEEP[int(rng.randint(0, 2))]
+    part_times = int(rng.randint(4, 8))     # > the 3-attempt budget
+    part_after = int(rng.randint(0, 8))
+    if rng.random() < 0.40:
+        kills.append((int(rng.randint(2, 14)), "partition",
+                      int(rng.randint(0, 8))))
+    # non-fatal wire blips: below the retry budget, the client must
+    # absorb them without the replica ever going suspect
+    blips = _sample_arms(rng, [
+        ("cluster.rpc.send", 0.3, (1, 3), (2, 24)),
+        ("cluster.rpc.recv", 0.3, (1, 3), (2, 24)),
+    ])
+    # in-worker engine faults (typed InjectedFault over the wire →
+    # the router's transient/broken handling + recover() RPC)
+    worker_arm = None
+    if rng.random() < 0.35:
+        worker_arm = (int(rng.randint(0, sup.n_workers)),
+                      ("serving.step.decode",
+                       "serving.step.prefill")[int(rng.randint(0, 2))],
+                      int(rng.randint(1, 3)), int(rng.randint(0, 6)))
+    shutdown_iter = int(rng.randint(2, 12)) \
+        if rng.random() < 0.3 else None
+
+    for arm in blips:
+        arm.arm()
+    schedule = list(blips)
+    if worker_arm is not None:
+        widx, point, times, after = worker_arm
+        try:
+            sup.workers[widx].client.arm_fault(point, times=times,
+                                               after=after)
+            schedule.append(FaultArm(point, times=times, after=after))
+        except Exception:
+            worker_arm = None
+
+    violations: List[str] = []
+    submitted = []
+    rejected = 0
+    kind_counts = {"coop": 0, "sigkill": 0, "partition": 0}
+
+    def _submit(pi, mn, dl, tenant):
+        nonlocal rejected
+        try:
+            submitted.append(
+                (front.submit(pool[pi], mn, tenant=tenant,
+                              deadline_s=dl, stream=ClientStream()),
+                 pi))
+        except (ServingError, ValueError, faults.InjectedFault):
+            rejected += 1
+
+    def _fire_kill(kind, pick):
+        live = [r for r in router.replicas if r.state == "healthy"]
+        if not live:
+            return
+        rep = live[pick % len(live)]
+        kind_counts[kind] += 1
+        if kind == "coop":
+            rep.kill()
+        elif kind == "sigkill":
+            if sig_immediate or rep.handle.pid is None:
+                try:
+                    os.kill(rep.handle.pid, _signal.SIGKILL)
+                except (OSError, TypeError):
+                    pass
+            else:
+                try:
+                    rep.engine.arm_fault(sig_point, times=1,
+                                         after=sig_after, kill=True)
+                    schedule.append(FaultArm(sig_point, times=1,
+                                             after=sig_after))
+                except Exception:
+                    pass
+        else:                        # partition: client-side, fatal
+            arm = FaultArm(part_point, times=part_times,
+                           after=part_after)
+            arm.arm()
+            schedule.append(arm)
+
+    i = 0
+    iters = 0
+    try:
+        while i < len(plan) or front.has_work():
+            iters += 1
+            if iters > max_iters:
+                violations.append(
+                    f"episode did not quiesce within {max_iters} "
+                    f"iterations")
+                break
+            if shutdown_iter is not None and iters >= shutdown_iter:
+                while i < len(plan):
+                    _, pi, mn, dl, tn = plan[i]
+                    _submit(pi, mn, dl, tn)
+                    i += 1
+                break
+            clock["t"] += 1.0
+            for at_iter, kind, pick in kills:
+                if at_iter == iters:
+                    _fire_kill(kind, pick)
+            while i < len(plan) and plan[i][0] <= clock["t"]:
+                _, pi, mn, dl, tn = plan[i]
+                _submit(pi, mn, dl, tn)
+                i += 1
+            for order, at_iter in cancels:
+                if at_iter == iters and order < len(submitted):
+                    front.cancel(submitted[order][0])
+            for order, at_iter in disconnects:
+                if at_iter == iters and order < len(submitted):
+                    front.disconnect(submitted[order][0])
+            if front.has_work():
+                front.pump()
+            sup.poll()
+        front.drain()
+        sup.poll()
+    except Exception as e:  # noqa: BLE001 — any escape breaks the
+        violations.append(  # "the cluster never strands work" law
+            f"episode escaped with {type(e).__name__}: {e}")
+
+    fired = faults.fired()
+    faults.clear()
+    violations += ledger.violations()
+    violations += router_leak_violations(router)
+    violations += frontdoor_leak_violations(front)
+    violations += token_prefix_violations(
+        (h.req, refs[pi]) for h, pi in submitted)
+    for h, _ in submitted:
+        evs = h.stream.events()
+        toks = [e["token"] for e in evs if e["event"] == "token"]
+        dones = [e for e in evs if e["event"] == "done"]
+        if toks != list(h.req.out_tokens[:len(toks)]):
+            violations.append(
+                f"request {h.req.rid}: streamed tokens {toks} are "
+                f"not a prefix of delivered {h.req.out_tokens}")
+        if h.disconnected:
+            continue
+        if len(dones) != 1:
+            violations.append(
+                f"request {h.req.rid}: connected client got "
+                f"{len(dones)} 'done' events (want exactly 1)")
+        elif dones[0]["output_ids"] != h.req.output_ids \
+                or dones[0]["finish_reason"] != h.req.finish_reason:
+            violations.append(
+                f"request {h.req.rid}: done event "
+                f"{dones[0]['output_ids']}/{dones[0]['finish_reason']}"
+                f" != request {h.req.output_ids}/"
+                f"{h.req.finish_reason}")
+    # in-worker audit: the mirror can't see device pools, so page
+    # leaks after mid-prefill deaths are only visible from inside
+    for slot in sup.workers:
+        rep = slot.replica
+        if rep is None or rep.state != "healthy" \
+                or slot.client is None:
+            continue
+        try:
+            violations += [f"worker {slot.wid}: {v}"
+                           for v in slot.client.remote_audit()]
+        except Exception as e:
+            violations.append(
+                f"worker {slot.wid}: remote audit failed with "
+                f"{type(e).__name__}: {e}")
+    deaths = sum(1 for r in router.replicas if r.state == "dead")
+    return EpisodeResult(
+        seed=seed, kind="cluster", violations=violations,
+        schedule=schedule, fired=fired,
+        stats={"requests": len(submitted), "rejected": rejected,
+               "replica_deaths": deaths,
+               "failovers": int(router._m_failover.value) - fail0,
+               "failover_requests":
+                   int(router._m_failover_req.value) - fail_req0,
+               "kills": dict(kind_counts),
+               "respawns": sup.respawns_used,
+               "worker_arm": worker_arm,
+               "attempts": ledger.attempts})
+
+
+# ---------------------------------------------------------------------------
 # training episodes
 # ---------------------------------------------------------------------------
 
@@ -866,6 +1185,8 @@ def run_episode(seed: int, kind: str, workdir: Optional[str] = None) \
         return run_serving_episode(seed)
     if kind == "frontdoor":
         return run_frontdoor_episode(seed)
+    if kind == "cluster":
+        return run_cluster_episode(seed)
     if kind == "training":
         if workdir is None:
             raise ValueError("training episodes need a workdir")

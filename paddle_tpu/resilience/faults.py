@@ -21,6 +21,8 @@ Wired-in points (see docs/RESILIENCE.md for the catalogue):
 ``router.health_probe``      inside the per-round replica probe
 ``frontdoor.stream_write``   writing a token/done event to a client
 ``frontdoor.client_disconnect``  the client-liveness probe
+``cluster.rpc.send``         socket framing, before a frame is written
+``cluster.rpc.recv``         socket framing, after a frame header is read
 ``store.set/get/add/wait``   TCPStore client ops, before the C call
 ``checkpoint.shard_write``   inside the retried per-file shard write
 ``checkpoint.commit``        after shards, BEFORE the metadata flip
@@ -101,6 +103,13 @@ KNOWN_POINTS = (
     "router.health_probe",
     "frontdoor.stream_write",
     "frontdoor.client_disconnect",
+    # cluster RPC framing (distributed/_framing.py): fires inside
+    # send_msg / recv_msg wherever the '<Q' framing is used (serving
+    # cluster, rpc agent, dist_model_mp). recv fires AFTER the header
+    # is consumed — the mid-frame partition case — and both surface as
+    # typed ConnectionError (the socket is unusable afterwards).
+    "cluster.rpc.send",
+    "cluster.rpc.recv",
     "store.set", "store.get", "store.add", "store.wait",
     "checkpoint.shard_write",
     "checkpoint.commit",
@@ -117,6 +126,12 @@ class InjectedFault(RuntimeError):
         super().__init__(f"injected fault at {point!r} (hit #{hit})")
         self.point = point
         self.hit = hit
+
+    def __reduce__(self):
+        # default exception pickling would replay __init__ with the
+        # formatted message; these cross the serving-cluster RPC
+        # boundary as shipped worker errors
+        return type(self), (self.point, self.hit)
 
 
 class _Rule:

@@ -43,10 +43,12 @@ Failure contract (docs/RESILIENCE.md): typed errors in ``errors``
 a donated-pool step failure, per-request ``deadline_s``, bounded
 ``max_queue`` admission, and ``drain()`` for graceful shutdown.
 """
+from .cluster import (ClusterSupervisor, RemoteEngine,  # noqa: F401
+                      RemoteReplica, WorkerHandle)
 from .engine import ServingEngine  # noqa: F401
 from .errors import (DeadlineExceeded, EngineBroken,  # noqa: F401
                      EngineClosed, EngineIdle, NoHealthyReplicas,
-                     QueueFull, RateLimited, ReplicaDead,
+                     QueueFull, RateLimited, RemoteError, ReplicaDead,
                      RequestCancelled, ServingError, TenantQueueFull)
 from .frontdoor import (ClientStream, FrontDoor,  # noqa: F401
                         FrontDoorHandle, FrontDoorHTTPServer,
@@ -69,7 +71,9 @@ __all__ = ["ServingEngine", "EngineMetrics", "MeshContext",
            "QueueFull", "DeadlineExceeded", "EngineBroken",
            "EngineIdle", "EngineClosed", "RequestCancelled",
            "RateLimited", "TenantQueueFull", "ReplicaDead",
-           "NoHealthyReplicas",
+           "NoHealthyReplicas", "RemoteError",
            "ReplicaRouter", "Replica",
+           "ClusterSupervisor", "RemoteEngine", "RemoteReplica",
+           "WorkerHandle",
            "FrontDoor", "FrontDoorHTTPServer", "FrontDoorHandle",
            "ClientStream", "TenantPolicy", "TokenBucket"]

@@ -1,0 +1,824 @@
+"""Cross-process serving cluster: RPC replicas + a reaping supervisor.
+
+The :class:`~paddle_tpu.serving.router.ReplicaRouter` is certified
+in-process (PR 7); this module puts a *process boundary* under the
+same state machine without changing it. Three pieces:
+
+- :class:`RemoteEngine` — an engine-shaped RPC client for one worker
+  process (``serving/worker.py``). The router drives replicas through
+  the engine surface (``submit_request / step / probe / adopt / drain
+  / cancel / recover``) *and* reads engine host state directly during
+  failover (``scheduler``, ``cache``, ``_undelivered``, ``_broken``)
+  — so the client IS an engine-shaped object: every RPC response
+  refreshes a host-side **mirror** (the authoritative ``Request``
+  objects the router tracks by identity, plus queue order / slot map),
+  and when the worker dies the router's ``_failover`` re-homes
+  everything from the mirror exactly as it would from a local engine.
+  Per-call deadlines, :class:`~paddle_tpu.resilience.retry.RetryPolicy`
+  backoff on transient socket errors (resends are dedup'd worker-side
+  by ``(token, seq)``, so retries never double-execute), and typed
+  :class:`~paddle_tpu.serving.errors.ReplicaDead` when the connection
+  is gone for good. A *slow* worker is not a dead one: a probe that
+  exceeds its timeout budget raises ``TimeoutError`` — the router
+  marks SUSPECT (drain) and only escalates on repetition.
+- :class:`RemoteReplica` — ``Replica`` subclass pairing the client
+  with its process handle (pid/poll for the supervisor).
+- :class:`ClusterSupervisor` — spawns workers (TCPStore rendezvous),
+  builds the router over their clients, and ``poll()``-s the cluster:
+  a replica the router declared DEAD is *reaped* (its process
+  SIGKILLed if still running — fencing: a partitioned worker must not
+  keep computing into pools nobody reads) and *respawned* (a warm
+  process is re-armed with a ``reset`` RPC; an exited one is
+  re-spawned), bounded by ``max_respawns`` → typed
+  :class:`~paddle_tpu.resilience.train_loop.RestartLimitExceeded`;
+  the fresh replica re-registers with the running router via
+  ``router.add_replica``. ``new_episode()`` re-arms the whole cluster
+  (fresh engines + fresh router over warm processes) so a chaos band
+  amortizes process spawns across seeds.
+
+Trust boundary: the RPC payloads are pickled python objects, exactly
+like ``distributed/rpc.py`` — workers bind 127.0.0.1 and the protocol
+must never be exposed beyond the launcher's private network.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import signal
+import socket
+import subprocess
+import sys
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from ..distributed._framing import nodelay, recv_msg, send_msg
+from ..observability import default_recorder, default_registry
+from ..resilience.retry import RetryError, RetryPolicy
+from ..resilience.train_loop import RestartLimitExceeded
+from .errors import ReplicaDead
+from .router import DEAD, Replica, ReplicaRouter
+from .sampling import SamplingParams
+from .scheduler import Request
+
+__all__ = ["RemoteEngine", "RemoteReplica", "ClusterSupervisor",
+           "WorkerHandle"]
+
+
+# ---------------------------------------------------------------------------
+# host-side mirrors: the engine-shaped state the router reads directly
+# ---------------------------------------------------------------------------
+
+class _MirrorScheduler:
+    """FIFO view of the worker's admission queue, in rid order."""
+
+    def __init__(self, client: "RemoteEngine"):
+        self._c = client
+
+    @property
+    def depth(self) -> int:
+        return len(self._c._queued)
+
+    def has_pending(self) -> bool:
+        return bool(self._c._queued)
+
+    def pending(self) -> List[Request]:
+        reqs = self._c._reqs
+        return [reqs[rid] for rid in self._c._queued if rid in reqs]
+
+    def drain(self) -> List[Request]:
+        """Take every queued request (failover / drain_replica). When
+        the worker is still reachable it is told to drop them too —
+        otherwise a rolling restart would leave the queue double-owned;
+        when it is not (that's the failover path), local state IS the
+        truth and this must never raise."""
+        out = self.pending()
+        if out and not self._c._dead:
+            try:
+                self._c._call("unqueue", retry=False)
+                # _apply already rebuilt the mirror from the response
+            except Exception:
+                pass
+        for r in out:
+            self._c._reqs.pop(r.rid, None)
+        self._c._queued = [rid for rid in self._c._queued
+                           if rid in self._c._reqs]
+        return out
+
+    def requeue(self, req: Request) -> None:
+        if not self._c._dead:
+            try:
+                self._c._call("requeue", {"req": req}, retry=False)
+                return
+            except Exception:
+                pass
+        self._c._reqs[req.rid] = req
+        self._c._queued.insert(0, req.rid)
+
+
+class _MirrorCache:
+    """Slot map view; ``slots`` indexes by slot id like the real one."""
+
+    def __init__(self, client: "RemoteEngine"):
+        self._c = client
+
+    @property
+    def slots(self) -> Dict[int, Request]:
+        reqs = self._c._reqs
+        return {s: reqs[rid] for s, rid in self._c._slots.items()
+                if rid in reqs}
+
+    def active_slots(self) -> List[int]:
+        return [s for s, rid in self._c._slots.items()
+                if rid in self._c._reqs]
+
+    def release(self, s: int) -> None:
+        self._c._slots.pop(s, None)
+
+
+# ---------------------------------------------------------------------------
+# the RPC client
+# ---------------------------------------------------------------------------
+
+class RemoteEngine:
+    """Engine-shaped client for one worker process (module doc)."""
+
+    def __init__(self, host: str, port: int, *, name: str = "worker",
+                 engine_kw: Optional[Dict[str, Any]] = None,
+                 time_fn: Callable[[], float] = time.monotonic,
+                 registry=None,
+                 call_deadline_s: float = 30.0,
+                 step_deadline_s: float = 180.0,
+                 probe_timeout_s: Optional[float] = None,
+                 proc: Optional[subprocess.Popen] = None):
+        self.host, self.port, self.name = host, int(port), name
+        ekw = dict(engine_kw or {})
+        # the validation surface _build_request needs, mirrored from
+        # the spec so admission errors are raised host-side and typed
+        self.max_slots = int(ekw.get("max_slots", 8))
+        self.max_len = int(ekw.get("max_len", 0)) or None
+        self.min_bucket = int(ekw.get("min_bucket", 16))
+        self.max_queue = ekw.get("max_queue")
+        # leak audits on the *client* object see an unpaged,
+        # non-speculative mirror; the real engine's page/handoff laws
+        # are audited worker-side via remote_audit()
+        self.paged = False
+        self.speculative = False
+        self.meshctx = None
+        self.cancel_probe = None
+        self._now = time_fn
+        self._proc = proc
+        self._call_deadline = float(call_deadline_s)
+        self._step_deadline = float(step_deadline_s)
+        self._probe_deadline = float(
+            probe_timeout_s if probe_timeout_s is not None
+            else call_deadline_s)
+        self._sock: Optional[socket.socket] = None
+        self._seq = 0
+        self._token = uuid.uuid4().hex   # resend-dedup namespace
+        self._dead = False
+        self._reqs: Dict[int, Request] = {}
+        self._queued: List[int] = []
+        self._slots: Dict[int, int] = {}
+        self._undelivered: List[Request] = []
+        self._broken: Optional[str] = None
+        self.worker_pid: Optional[int] = None
+        self.scheduler = _MirrorScheduler(self)
+        self.cache = _MirrorCache(self)
+        reg = registry if registry is not None else default_registry()
+        self._m_latency = reg.histogram(
+            "ptpu_cluster_rpc_latency_seconds",
+            "wall time of one cluster RPC (incl. retries)",
+            labels=("op",))
+        self._m_inflight = reg.gauge(
+            "ptpu_cluster_worker_rpc_inflight",
+            "1 while an RPC to this worker is on the wire",
+            labels=("worker",))
+        self._retry = RetryPolicy(
+            max_attempts=3, base_delay=0.02, max_delay=0.25,
+            retry_on=(ConnectionError, OSError),
+            no_retry_on=(TimeoutError,), seed=0)
+
+    # -- wire ----------------------------------------------------------
+    def _close_sock(self) -> None:
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+            self._sock = None
+
+    def _attempt(self, blob: bytes, seq: int, deadline: float) -> dict:
+        if self._proc is not None and self._proc.poll() is not None:
+            raise ReplicaDead(
+                f"worker {self.name} process exited with "
+                f"{self._proc.returncode}")
+        if self._sock is None:
+            self._sock = nodelay(socket.create_connection(
+                (self.host, self.port), timeout=min(deadline, 5.0)))
+        self._sock.settimeout(deadline)
+        try:
+            send_msg(self._sock, blob)
+            resp_blob = recv_msg(self._sock)
+        except Exception:
+            # after any wire error the stream position is undefined
+            # (see _framing): the socket must die with the attempt
+            self._close_sock()
+            raise
+        resp = pickle.loads(resp_blob)
+        if resp.get("seq") != seq:
+            self._close_sock()
+            raise ConnectionError(
+                f"rpc seq desync (sent {seq}, got {resp.get('seq')})")
+        return resp
+
+    def _call(self, op: str, payload: Optional[dict] = None,
+              deadline: Optional[float] = None,
+              retry: bool = True) -> dict:
+        if self._dead:
+            raise ReplicaDead(f"worker {self.name} marked dead")
+        self._seq += 1
+        seq = self._seq
+        msg = {"op": op, "seq": seq, "token": self._token,
+               "now": self._now()}
+        if payload:
+            msg.update(payload)
+        blob = pickle.dumps(msg)
+        dl = float(deadline if deadline is not None
+                   else self._call_deadline)
+        t0 = time.monotonic()
+        self._m_inflight.labels(worker=self.name).set(1)
+        try:
+            if retry:
+                try:
+                    resp = self._retry.call(self._attempt, blob, seq,
+                                            dl, op=f"cluster.{op}")
+                except RetryError as e:
+                    self._dead = True
+                    raise ReplicaDead(
+                        f"worker {self.name} unreachable after "
+                        f"retries ({e})") from e
+            else:
+                resp = self._attempt(blob, seq, dl)
+        except ReplicaDead:
+            self._dead = True
+            raise
+        finally:
+            self._m_inflight.labels(worker=self.name).set(0)
+            self._m_latency.labels(op=op).observe(
+                time.monotonic() - t0)
+        self._apply(resp)
+        if not resp.get("ok", False):
+            err = resp.get("error") or ReplicaDead(
+                f"worker {self.name} sent a malformed error response")
+            raise err
+        return resp
+
+    def _apply(self, resp: dict) -> None:
+        """Refresh the host-side mirror from a worker response."""
+        for rid, u in (resp.get("updates") or {}).items():
+            req = self._reqs.get(rid)
+            if req is None:
+                continue
+            req.out_tokens[:] = u["out"]
+            req.finished = u["finished"]
+            req.finish_reason = u["reason"]
+            req.error = u["error"]
+            req.slot = u["slot"]
+        st = resp.get("state")
+        if st is not None:
+            self._queued = [rid for rid in st["queued"]
+                            if rid in self._reqs]
+            self._slots = {s: rid for s, rid in st["slots"].items()
+                           if rid in self._reqs}
+            self._undelivered = [self._reqs[rid]
+                                 for rid in st["undelivered"]
+                                 if rid in self._reqs]
+            self._broken = st["broken"]
+
+    def _take_finished(self, resp: dict) -> List[Request]:
+        out = []
+        for rid in resp.get("finished") or ():
+            req = self._reqs.pop(rid, None)
+            if req is not None:
+                out.append(req)
+        self._queued = [r for r in self._queued if r in self._reqs]
+        self._slots = {s: r for s, r in self._slots.items()
+                       if r in self._reqs}
+        return out
+
+    def _cancel_rids(self) -> List[int]:
+        """Client-side disconnect sweep: the FrontDoor flags *these*
+        Request objects; ship the rids so the worker engine's own
+        sweep runs the real abort paths (mid-prefill page unwind)."""
+        rids = []
+        probe = self.cancel_probe
+        for rid, req in self._reqs.items():
+            hit = req.cancel_requested
+            if not hit and probe is not None:
+                try:
+                    hit = bool(probe(req))
+                except Exception:
+                    hit = False
+            if hit:
+                req.cancel_requested = True
+                rids.append(rid)
+        return rids
+
+    # -- the engine surface the router drives --------------------------
+    def _build_request(self, prompt_ids, max_new_tokens: int = 16,
+                       sampling: Optional[SamplingParams] = None,
+                       deadline_s: Optional[float] = None,
+                       rid: Optional[int] = None,
+                       tenant: Optional[str] = None) -> Request:
+        # mirror of ServingEngine._build_request: validate HERE so a
+        # bad request is a typed host-side refusal, never an RPC
+        import numpy as np
+        ids = np.asarray(getattr(prompt_ids, "numpy",
+                                 lambda: prompt_ids)()).astype(np.int64)
+        if ids.ndim == 2 and ids.shape[0] == 1:
+            ids = ids[0]
+        if ids.ndim != 1:
+            raise ValueError(
+                f"submit() takes a single prompt sequence; got shape "
+                f"{ids.shape}. Call submit() once per request.")
+        if ids.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if self.max_len is not None and \
+                ids.size + max_new_tokens - 1 > self.max_len:
+            raise ValueError(
+                f"prompt ({ids.size}) + max_new_tokens "
+                f"({max_new_tokens}) - 1 exceeds max_len "
+                f"{self.max_len}")
+        sampling = sampling or SamplingParams()
+        sampling.validate()
+        if deadline_s is not None and deadline_s <= 0:
+            raise ValueError(
+                f"deadline_s must be > 0, got {deadline_s}")
+        req = Request(rid=rid if rid is not None else 0,
+                      prompt=ids, max_new_tokens=int(max_new_tokens),
+                      sampling=sampling,
+                      deadline=(self._now() + deadline_s
+                                if deadline_s is not None else None),
+                      tenant=tenant)
+        req._rng = np.random.RandomState(
+            sampling.seed if sampling.seed is not None
+            else 0x5EED + req.rid)
+        return req
+
+    def submit_request(self, req: Request) -> Request:
+        self._call("submit", {"req": req})
+        self._reqs[req.rid] = req
+        if req.rid not in self._queued:
+            self._queued.append(req.rid)
+        return req
+
+    def adopt(self, req: Request) -> Request:
+        self._call("adopt", {"req": req})
+        self._reqs[req.rid] = req
+        if req.rid not in self._queued:
+            self._queued.append(req.rid)
+        return req
+
+    def has_work(self) -> bool:
+        return bool(self._queued or self._slots)
+
+    def probe(self, timeout: Optional[float] = None) -> dict:
+        resp = self._call("probe", deadline=(
+            timeout if timeout is not None else self._probe_deadline))
+        self.worker_pid = resp.get("pid", self.worker_pid)
+        return resp.get("health") or {}
+
+    def step(self) -> List[Request]:
+        payload = {"cancel_rids": self._cancel_rids()}
+        resp = self._call("step", payload,
+                          deadline=self._step_deadline)
+        return self._take_finished(resp)
+
+    def recover(self) -> dict:
+        resp = self._call("recover", deadline=self._step_deadline)
+        return {"finished": self._take_finished(resp)}
+
+    def drain(self, max_steps: Optional[int] = None) -> List[Request]:
+        resp = self._call("drain",
+                          {"max_steps": max_steps,
+                           "cancel_rids": self._cancel_rids()},
+                          deadline=self._step_deadline)
+        return self._take_finished(resp)
+
+    def cancel(self, req: Request, reason: str = "cancelled") -> bool:
+        resp = self._call("cancel", {"rid": req.rid, "reason": reason})
+        self._take_finished(resp)
+        return bool(resp.get("cancelled"))
+
+    # -- cluster extras -------------------------------------------------
+    def remote_audit(self) -> List[str]:
+        """Run the engine/page leak audits inside the worker (the
+        mirror can't see device pools) and return the violations."""
+        resp = self._call("audit")
+        return list(resp.get("violations") or ())
+
+    def reset(self, engine_kw: Optional[Dict[str, Any]] = None,
+              donate: bool = False, virtual_clock: bool = False,
+              deadline: Optional[float] = None) -> None:
+        self._call("reset", {"engine": dict(engine_kw or {}),
+                             "donate": donate,
+                             "virtual_clock": virtual_clock},
+                   deadline=deadline if deadline is not None
+                   else self._call_deadline)
+        self._reqs, self._queued, self._slots = {}, [], {}
+        self._undelivered, self._broken = [], None
+        if engine_kw:
+            self.max_slots = int(engine_kw.get("max_slots",
+                                               self.max_slots))
+            self.max_len = int(engine_kw.get("max_len",
+                                             self.max_len or 0)) or None
+            self.min_bucket = int(engine_kw.get("min_bucket",
+                                                self.min_bucket))
+
+    def arm_fault(self, point: str, times: int = 1, after: int = 0,
+                  kill: bool = False) -> None:
+        self._call("arm", {"point": point, "times": times,
+                           "after": after, "kill": kill})
+
+    def stall(self, seconds: float,
+              deadline: Optional[float] = None) -> None:
+        self._call("stall", {"seconds": seconds}, deadline=deadline)
+
+    def close(self) -> None:
+        """Drop the connection without any RPC. The worker serves ONE
+        connection at a time, so a superseded client (dead replica,
+        previous episode) MUST close its socket or the next client
+        waits in the listen backlog behind it."""
+        self._dead = True
+        self._close_sock()
+
+    def shutdown(self) -> None:
+        try:
+            self._call("shutdown", retry=False, deadline=5.0)
+        except Exception:
+            pass
+        self._close_sock()
+
+
+class RemoteReplica(Replica):
+    """A router replica whose engine lives in another process."""
+
+    def __init__(self, replica_id: str, engine: RemoteEngine,
+                 handle: "WorkerHandle"):
+        super().__init__(replica_id, engine)
+        self.handle = handle
+
+
+# ---------------------------------------------------------------------------
+# supervisor
+# ---------------------------------------------------------------------------
+
+class WorkerHandle:
+    """One worker *slot*: the process currently filling it, plus the
+    supervisor's bookkeeping. The slot label (``w<index>``) is stable
+    across respawns; the worker id (``w<index>g<generation>``) names
+    one process generation (store keys must not collide)."""
+
+    def __init__(self, index: int):
+        self.index = index
+        self.generation = 0
+        self.proc: Optional[subprocess.Popen] = None
+        self.host = "127.0.0.1"
+        self.port: Optional[int] = None
+        self.pid: Optional[int] = None
+        self.client: Optional[RemoteEngine] = None
+        self.replica: Optional[Replica] = None
+        self.respawns = 0
+        self.reaped = False
+
+    @property
+    def slot_label(self) -> str:
+        return f"w{self.index}"
+
+    @property
+    def wid(self) -> str:
+        return f"w{self.index}g{self.generation}"
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+class ClusterSupervisor:
+    """Spawn worker processes, route over them, reap + respawn the
+    dead (module docstring). Lifecycle::
+
+        sup = ClusterSupervisor(spec, n_workers=2, max_respawns=4)
+        sup.start()              # spawn processes, build the router
+        ...drive sup.router (submit/step/drain), call sup.poll()
+           between rounds so dead workers respawn...
+        sup.shutdown()
+
+    ``spec`` (pickled to workers over the TCPStore): ``model_config``
+    (+ ``tiny`` / ``model_seed``), ``engine`` (ServingEngine kwargs),
+    ``virtual_clock``. ``new_episode()`` re-arms warm processes with
+    fresh engines and a fresh router — the chaos band's per-seed
+    entry point."""
+
+    def __init__(self, spec: Dict[str, Any], *, n_workers: int = 2,
+                 max_respawns: int = 2, respawn: bool = True,
+                 registry=None, flight_recorder=None, auditor=None,
+                 router_kwargs: Optional[Dict[str, Any]] = None,
+                 client_kwargs: Optional[Dict[str, Any]] = None,
+                 dump_on_death: bool = True,
+                 spawn_timeout_s: float = 120.0):
+        if n_workers < 1:
+            raise ValueError(f"n_workers must be >= 1, got {n_workers}")
+        self.spec = dict(spec)
+        self.n_workers = int(n_workers)
+        self.max_respawns = int(max_respawns)
+        self.respawn = bool(respawn)
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.recorder = flight_recorder if flight_recorder is not None \
+            else default_recorder()
+        self.auditor = auditor
+        self._router_kwargs = dict(router_kwargs or {})
+        self._client_kwargs = dict(client_kwargs or {})
+        self._dump_on_death = bool(dump_on_death)
+        self._spawn_timeout = float(spawn_timeout_s)
+        self._store = None
+        self._prefix = f"cluster/{uuid.uuid4().hex[:8]}"
+        self._slots: List[WorkerHandle] = []
+        self.router: Optional[ReplicaRouter] = None
+        self.respawns_used = 0
+        self._episode = {"engine": dict(self.spec.get("engine") or {}),
+                         "donate": bool(self.spec.get("donate")),
+                         "virtual_clock":
+                             bool(self.spec.get("virtual_clock"))}
+        self._time_fn: Callable[[], float] = time.monotonic
+        reg = self.registry
+        self._m_alive = reg.gauge(
+            "ptpu_cluster_worker_alive",
+            "1 = worker process serving, 0 = reaped/down",
+            labels=("worker",))
+        self._m_worker_respawns = reg.gauge(
+            "ptpu_cluster_worker_respawns",
+            "respawns this worker slot has consumed",
+            labels=("worker",))
+        self._m_respawns = reg.counter(
+            "ptpu_cluster_respawns_total",
+            "dead workers the supervisor respawned")
+        self._m_kills = reg.counter(
+            "ptpu_cluster_worker_kills_total",
+            "worker processes reaped, by how they died",
+            labels=("kind",))
+
+    # -- process lifecycle ---------------------------------------------
+    def _spawn_process(self, slot: WorkerHandle) -> None:
+        import paddle_tpu
+        slot.generation += 1
+        root = os.path.dirname(os.path.dirname(
+            os.path.abspath(paddle_tpu.__file__)))
+        env = os.environ.copy()
+        env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+        slot.proc = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.serving.worker",
+             "--store-host", "127.0.0.1",
+             "--store-port", str(self._store.port),
+             "--prefix", self._prefix,
+             "--worker-id", slot.wid],
+            env=env, cwd=root)
+
+    def _await_ready(self, slot: WorkerHandle) -> None:
+        key = f"{self._prefix}/{slot.wid}/port"
+        deadline = time.monotonic() + self._spawn_timeout
+        while True:
+            try:
+                self._store.wait(key, timeout=2.0)
+                break
+            except Exception:
+                if slot.proc.poll() is not None:
+                    raise RuntimeError(
+                        f"cluster worker {slot.wid} exited with "
+                        f"{slot.proc.returncode} before publishing "
+                        f"its port")
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"cluster worker {slot.wid} not ready within "
+                        f"{self._spawn_timeout}s")
+        slot.port = int(self._store.get(key))
+        slot.pid = int(self._store.get(
+            f"{self._prefix}/{slot.wid}/pid"))
+        self._m_alive.labels(worker=slot.slot_label).set(1)
+
+    def _make_client(self, slot: WorkerHandle) -> RemoteEngine:
+        if slot.client is not None:
+            slot.client.close()   # single-connection worker: the old
+            #                       socket must die before the new
+            #                       client can be heard (serve loop)
+        client = RemoteEngine(
+            slot.host, slot.port, name=slot.slot_label,
+            engine_kw=self._episode["engine"], time_fn=self._time_fn,
+            registry=self.registry, proc=slot.proc,
+            **self._client_kwargs)
+        client.worker_pid = slot.pid
+        slot.client = client
+        return client
+
+    def start(self) -> ReplicaRouter:
+        """Spawn ``n_workers`` processes and build the router."""
+        from ..distributed.store import TCPStore
+        if self._store is not None:
+            raise RuntimeError("ClusterSupervisor already started")
+        self._store = TCPStore("127.0.0.1", 0, is_master=True,
+                               world_size=1)
+        self._store.set(f"{self._prefix}/spec",
+                        pickle.dumps(self.spec))
+        self._slots = [WorkerHandle(i) for i in range(self.n_workers)]
+        for slot in self._slots:          # spawn all, then wait all:
+            self._spawn_process(slot)     # startups overlap
+        for slot in self._slots:
+            self._await_ready(slot)
+        return self._build_router()
+
+    def _build_router(self) -> ReplicaRouter:
+        replicas = [RemoteReplica(str(slot.index),
+                                  self._make_client(slot), slot)
+                    for slot in self._slots]
+        for slot, rep in zip(self._slots, replicas):
+            slot.replica = rep
+            slot.reaped = False
+        self.router = ReplicaRouter(
+            replicas, registry=self.registry,
+            flight_recorder=self.recorder, auditor=self.auditor,
+            **self._router_kwargs)
+        return self.router
+
+    def new_episode(self, engine_kw: Optional[Dict[str, Any]] = None,
+                    *, donate: bool = False,
+                    virtual_clock: Optional[bool] = None,
+                    time_fn: Optional[Callable[[], float]] = None,
+                    auditor=None) -> ReplicaRouter:
+        """Re-arm the cluster over the WARM worker processes: fresh
+        engines (one ``reset`` RPC each; a process that died since the
+        last episode is respawned, budget-free), fresh clients, fresh
+        router, respawn budget restored."""
+        if self._store is None:
+            raise RuntimeError("start() the supervisor first")
+        self._episode = {
+            "engine": dict(engine_kw if engine_kw is not None
+                           else self.spec.get("engine") or {}),
+            "donate": bool(donate),
+            "virtual_clock": bool(
+                self._episode["virtual_clock"]
+                if virtual_clock is None else virtual_clock)}
+        if time_fn is not None:
+            self._time_fn = time_fn
+        if auditor is not None:
+            self.auditor = auditor
+        self.respawns_used = 0
+        for slot in self._slots:
+            if not self._reset_slot(slot):
+                self._hard_respawn(slot)
+        return self._build_router()
+
+    def _reset_slot(self, slot: WorkerHandle) -> bool:
+        if not slot.alive():
+            return False
+        try:
+            client = self._make_client(slot)
+            client.reset(self._episode["engine"],
+                         donate=self._episode["donate"],
+                         virtual_clock=self._episode["virtual_clock"])
+            return True
+        except Exception:
+            return False
+
+    def _hard_respawn(self, slot: WorkerHandle) -> None:
+        if slot.alive():
+            slot.proc.kill()
+            slot.proc.wait()
+        self._spawn_process(slot)
+        self._await_ready(slot)
+        if not self._reset_slot(slot):
+            raise RuntimeError(
+                f"cluster worker {slot.wid} respawned but failed "
+                f"its engine reset")
+
+    # -- reap + respawn -------------------------------------------------
+    def poll(self) -> None:
+        """Reap every replica the router declared DEAD: fence its
+        process (SIGKILL if still running — a partitioned worker must
+        not keep computing), record the death (flight-recorder dump
+        carries the post-mortem), and — with ``respawn`` — bring a
+        fresh replica up and re-register it, bounded by
+        ``max_respawns`` → typed :class:`RestartLimitExceeded`."""
+        if self.router is None:
+            return
+        for slot in self._slots:
+            rep = slot.replica
+            if rep is None or rep.state != DEAD or slot.reaped:
+                continue
+            self._reap(slot)
+
+    def _reap(self, slot: WorkerHandle) -> None:
+        slot.reaped = True
+        exited = not slot.alive()
+        self._m_alive.labels(worker=slot.slot_label).set(0)
+        self.recorder.record(
+            "cluster.worker_dead", worker=slot.wid,
+            replica=slot.replica.id if slot.replica else None,
+            exited=exited,
+            returncode=slot.proc.returncode if exited else None)
+        if self._dump_on_death:
+            try:
+                self.recorder.dump(
+                    reason=f"cluster worker {slot.wid} dead",
+                    registry=self.registry)
+            except Exception:
+                pass
+        if self.router is None or getattr(self.router, "_closed",
+                                          False):
+            # the router already drained (episode over): there is
+            # nobody to re-register a fresh replica with, and nothing
+            # in flight to recover — fence the process and leave the
+            # slot dead; the next new_episode() respawns it
+            # budget-free.
+            if not exited:
+                slot.proc.kill()
+                slot.proc.wait()
+            self._m_kills.labels(
+                kind="exited" if exited else "sigkill").inc()
+            return
+        soft = False
+        if not self.respawn or self.respawns_used >= self.max_respawns:
+            # fence even when not respawning: the orphaned process
+            # must not keep decoding into pools nobody reads
+            if not exited:
+                slot.proc.kill()
+                slot.proc.wait()
+            self._m_kills.labels(
+                kind="exited" if exited else "sigkill").inc()
+            if self.respawn:
+                raise RestartLimitExceeded(
+                    f"cluster supervisor: worker {slot.wid} died but "
+                    f"the respawn budget is exhausted "
+                    f"({self.respawns_used} used, max_respawns="
+                    f"{self.max_respawns})")
+            return
+        if not exited:
+            # warm process behind a dead *replica* (cooperative kill,
+            # exhausted partition): reclaim it with a reset — same
+            # fencing effect (all engine state discarded), no spawn
+            soft = self._reset_slot(slot)
+            if not soft:
+                slot.proc.kill()
+                slot.proc.wait()
+                self._m_kills.labels(kind="sigkill").inc()
+        if exited:
+            self._m_kills.labels(kind="exited").inc()
+        if not soft:
+            self._spawn_process(slot)
+            self._await_ready(slot)
+            if not self._reset_slot(slot):
+                raise RuntimeError(
+                    f"cluster worker {slot.wid} respawned but failed "
+                    f"its engine reset")
+        self.respawns_used += 1
+        slot.respawns += 1
+        self._m_respawns.inc()
+        self._m_worker_respawns.labels(
+            worker=slot.slot_label).set(slot.respawns)
+        self._m_alive.labels(worker=slot.slot_label).set(1)
+        new_id = f"{slot.index}r{slot.respawns}"
+        rep = RemoteReplica(new_id, slot.client, slot)
+        self.router.add_replica(rep)
+        slot.replica = rep
+        slot.reaped = False
+        self.recorder.record("cluster.worker_respawned",
+                             worker=slot.wid, replica=new_id,
+                             soft=soft)
+
+    # -- teardown -------------------------------------------------------
+    def shutdown(self) -> None:
+        for slot in self._slots:
+            if slot.client is not None and slot.alive():
+                slot.client.shutdown()
+            if slot.proc is not None:
+                if slot.proc.poll() is None:
+                    slot.proc.kill()
+                try:
+                    slot.proc.wait(timeout=10.0)
+                except Exception:
+                    pass
+            self._m_alive.labels(worker=slot.slot_label).set(0)
+        if self._store is not None:
+            try:
+                self._store.close()
+            except Exception:
+                pass
+            self._store = None
+
+    # -- introspection --------------------------------------------------
+    @property
+    def workers(self) -> List[WorkerHandle]:
+        return list(self._slots)

@@ -612,6 +612,19 @@ class ServingEngine:
         return self.scheduler.has_pending() or \
             bool(self.cache.active_slots())
 
+    def probe(self, timeout: Optional[float] = None) -> dict:
+        """Health probe: a cheap, non-mutating liveness summary. The
+        router calls this on every replica each round; the cluster's
+        RemoteReplica turns it into one RPC with ``timeout`` as the
+        per-call deadline (a slow worker surfaces as TimeoutError →
+        SUSPECT, never an instant ReplicaDead). In-process, a broken
+        engine is still *alive* — it answers probes and recovers — so
+        this never raises."""
+        del timeout  # in-process: answering at all is the liveness
+        return {"broken": self._broken,
+                "queued": self.scheduler.depth,
+                "active": len(self.cache.active_slots())}
+
     def step(self) -> List[Request]:
         """One engine iteration: admit into free slots (bucketed
         prefill), then one decode step over every occupied slot, then

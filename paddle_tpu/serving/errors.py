@@ -36,11 +36,31 @@ from __future__ import annotations
 __all__ = ["ServingError", "QueueFull", "DeadlineExceeded",
            "EngineBroken", "EngineIdle", "EngineClosed",
            "RequestCancelled", "RateLimited", "TenantQueueFull",
-           "ReplicaDead", "NoHealthyReplicas"]
+           "ReplicaDead", "NoHealthyReplicas", "RemoteError"]
+
+
+def _rebuild_error(cls, args, attrs):
+    # bypass the subclass __init__ (whose signature is structured, not
+    # (message,)): restore message via RuntimeError and attributes
+    # (rid, tenant, retry_after_s, ...) from __dict__
+    e = cls.__new__(cls)
+    RuntimeError.__init__(e, *args)
+    e.__dict__.update(attrs)
+    return e
 
 
 class ServingError(RuntimeError):
-    """Base class for the serving engine's typed failures."""
+    """Base class for the serving engine's typed failures.
+
+    Pickle-safe by construction: these cross the serving-cluster RPC
+    boundary (serving/cluster.py ships a worker's typed refusal back
+    to the router), and default exception pickling would call the
+    subclass ``__init__`` with the formatted message — a TypeError for
+    every subclass with a structured signature.
+    """
+
+    def __reduce__(self):
+        return _rebuild_error, (type(self), self.args, dict(self.__dict__))
 
 
 class QueueFull(ServingError):
@@ -122,3 +142,14 @@ class NoHealthyReplicas(ServingError):
             f"no healthy replica to dispatch to ({total} registered, "
             f"all draining or dead)")
         self.total = total
+
+
+class RemoteError(ServingError):
+    """A cluster worker raised an exception that cannot itself cross
+    the pickle boundary (unknown type, unpicklable payload); carries
+    the type name and rendered message instead."""
+
+    def __init__(self, type_name: str, detail: str):
+        super().__init__(f"worker raised {type_name}: {detail}")
+        self.type_name = type_name
+        self.detail = detail

@@ -110,11 +110,19 @@ class ReplicaRouter:
                  auditor=None,
                  probe_fail_threshold: int = 2,
                  step_fail_threshold: int = 3,
-                 recover_fail_threshold: int = 3):
+                 recover_fail_threshold: int = 3,
+                 probe_timeout_s: Optional[float] = 1.0):
         if not engines:
             raise ValueError("ReplicaRouter needs at least one engine")
-        self.replicas = [Replica(str(i), e)
+        # pre-built Replica objects pass through (the cluster
+        # supervisor registers RemoteReplica subclasses); bare engines
+        # are wrapped with positional ids
+        self.replicas = [e if isinstance(e, Replica) else
+                         Replica(str(i), e)
                          for i, e in enumerate(engines)]
+        ids = [r.id for r in self.replicas]
+        if len(set(ids)) != len(ids):
+            raise ValueError(f"duplicate replica ids: {sorted(ids)}")
         self.registry = registry if registry is not None \
             else default_registry()
         self.recorder = flight_recorder if flight_recorder is not None \
@@ -125,6 +133,11 @@ class ReplicaRouter:
         self.probe_fail_threshold = int(probe_fail_threshold)
         self.step_fail_threshold = int(step_fail_threshold)
         self.recover_fail_threshold = int(recover_fail_threshold)
+        # per-probe time budget, DISTINCT from the DEAD threshold: a
+        # probe that exceeds it raises TimeoutError and takes the
+        # transient path (SUSPECT → drain), so ONE hung RPC never
+        # triggers an instant failover. None = unbounded probes.
+        self.probe_timeout_s = probe_timeout_s
         # router rids live in their own namespace, above anything an
         # engine's private counter (0, 1, ...) can reach, so a direct
         # engine.submit() on a routed engine can never mint a rid that
@@ -212,6 +225,35 @@ class ReplicaRouter:
         return any(r.live and r.engine.has_work()
                    for r in self.replicas)
 
+    def add_replica(self, engine, replica_id: Optional[str] = None):
+        """Register a fresh replica on a RUNNING router (the cluster
+        supervisor's respawn path; also hot capacity adds). Accepts a
+        bare engine or a pre-built :class:`Replica`; the new replica
+        inherits the installed ``cancel_probe`` and is dispatchable
+        immediately. Typed :class:`EngineClosed` after ``drain()``."""
+        if self._closed:
+            raise EngineClosed()
+        if isinstance(engine, Replica):
+            rep = engine
+        else:
+            rep = Replica(replica_id if replica_id is not None
+                          else str(len(self.replicas)), engine)
+        if any(r.id == rep.id for r in self.replicas):
+            raise ValueError(
+                f"replica id {rep.id!r} already registered")
+        probe = None
+        try:
+            probe = self.replicas[0].engine.cancel_probe
+        except Exception:
+            pass
+        if probe is not None:
+            rep.engine.cancel_probe = probe
+        self.replicas.append(rep)
+        self._m_healthy.labels(replica=rep.id).set(1)
+        self._m_inflight.labels(replica=rep.id).set(0)
+        self.recorder.record("router.replica_added", replica=rep.id)
+        return rep
+
     # -- health --------------------------------------------------------
     def probe(self, rep: Replica) -> bool:
         """One health probe: True = clean. Raises nothing; state
@@ -223,6 +265,14 @@ class ReplicaRouter:
             if not rep.alive:
                 raise ReplicaDead(f"replica {rep.id} health probe: "
                                   f"process gone")
+            # engines with a real liveness check (remote replicas: one
+            # RPC) answer within the probe budget. SLOW is not DEAD:
+            # a TimeoutError lands in the generic arm below — SUSPECT
+            # first, DEAD only after probe_fail_threshold repeats.
+            # Only a torn connection (ReplicaDead) kills instantly.
+            probe_fn = getattr(rep.engine, "probe", None)
+            if probe_fn is not None:
+                probe_fn(timeout=self.probe_timeout_s)
         except ReplicaDead as e:
             self._mark_dead(rep, str(e))
             return False
@@ -435,10 +485,20 @@ class ReplicaRouter:
         self._closed = True
         out: List[Request] = []
         self._pending_out = out
-        for rep in self.replicas:
+        for rep in list(self.replicas):
             if not rep.live:
                 continue
-            for req in rep.engine.drain(max_steps):
+            try:
+                done = rep.engine.drain(max_steps)
+            except Exception as e:
+                # a replica dying DURING shutdown must not abort the
+                # drain of its peers: fail it over (adoption lands on
+                # peers not yet drained, or the straggler sweep below
+                # cancels typed) and keep going
+                self._mark_dead(rep, f"died during drain: "
+                                     f"{type(e).__name__}: {e}")
+                continue
+            for req in done:
                 self._deliver(req, out)
             self._m_inflight.labels(replica=rep.id).set(0)
         for req in list(self._inflight.values()):

@@ -1,0 +1,330 @@
+"""Serving-cluster worker: one ServingEngine behind a framed RPC loop.
+
+``python -m paddle_tpu.serving.worker`` is the entrypoint
+:class:`~paddle_tpu.serving.cluster.ClusterSupervisor` spawns — one
+process per replica. Rendezvous rides the native TCPStore: the
+supervisor publishes a pickled *spec* (model config + engine kwargs)
+under ``<prefix>/spec``; the worker builds the model, binds an
+ephemeral TCP port, publishes it under ``<prefix>/<worker-id>/port``
+(pid alongside, so the supervisor can SIGKILL a partitioned worker),
+and serves framed request/response RPC forever.
+
+Protocol (one pickled dict per ``_framing`` frame, trusted-job
+boundary only — pickle is never exposed past the launcher's private
+network, same caveat as ``distributed/rpc.py``):
+
+- every request carries ``(token, seq)``; the worker caches its last
+  response per token so a client that lost a response to a partition
+  can reconnect and *resend* without the operation running twice —
+  the exactly-once property the router's delivery gate needs holds
+  across retries, not just clean calls.
+- ``step``/``drain``/``recover`` responses carry the rids the
+  operation *returned* (the router delivers exactly those) plus a
+  full per-rid state refresh (tokens so far, finish reason, error)
+  and an engine summary (queue order, slot map, undelivered debt) —
+  the client mirrors it so the router's failover can re-home
+  everything from host-side state when this process dies.
+- ``reset`` swaps in a fresh engine (and clears armed faults), so a
+  chaos band reuses warm worker processes across episodes instead of
+  paying a process spawn per seed.
+- ``arm`` arms a resilience fault point in THIS process; with
+  ``kill=True`` the "exception" is ``os.kill(getpid(), SIGKILL)`` —
+  the mid-step hard-death kind the failover certification needs.
+- ``stall`` delays every subsequent response: the hung-worker case a
+  probe timeout must classify as SUSPECT, not DEAD.
+
+Engine clock: with ``spec["virtual_clock"]`` the engine's ``time_fn``
+returns the last ``now`` any RPC carried — the chaos episodes' virtual
+clock spans the process boundary, so deadline laws stay deterministic.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import signal
+import socket
+import time
+from typing import Any, Dict, List, Optional
+
+__all__ = ["main", "WorkerServer"]
+
+
+def _wire_error(e: BaseException) -> BaseException:
+    """Best-effort typed error across the pickle boundary."""
+    from .errors import RemoteError, ServingError
+    if isinstance(e, ServingError):
+        return e
+    try:
+        pickle.loads(pickle.dumps(e))
+        return e
+    except Exception:
+        return RemoteError(type(e).__name__, str(e))
+
+
+class WorkerServer:
+    """The in-process half: owns the engine, dispatches ops."""
+
+    def __init__(self, spec: Dict[str, Any], worker_id: str):
+        self.spec = spec
+        self.worker_id = worker_id
+        self._clock = {"t": 0.0}
+        self._virtual = bool(spec.get("virtual_clock"))
+        self._stall_s = 0.0
+        # (token, seq) -> response blob: resend-dedup (see module doc)
+        self._last_key: Optional[tuple] = None
+        self._last_blob: Optional[bytes] = None
+        self._model = self._build_model(spec)
+        self.engine = None
+        self._reqs: Dict[int, Any] = {}
+        self._make_engine(spec.get("engine") or {},
+                          donate=bool(spec.get("donate")))
+
+    # -- construction --------------------------------------------------
+    @staticmethod
+    def _build_model(spec: Dict[str, Any]):
+        import paddle_tpu as paddle
+        from ..models.llama import (LlamaConfig, LlamaForCausalLM,
+                                    llama_tiny_config)
+        paddle.seed(int(spec.get("model_seed", 0)))
+        kw = dict(spec.get("model_config") or {})
+        cfg = llama_tiny_config(**kw) if spec.get("tiny", True) \
+            else LlamaConfig(**kw)
+        model = LlamaForCausalLM(cfg)
+        model.eval()
+        return model
+
+    def _now(self) -> float:
+        return self._clock["t"] if self._virtual else time.monotonic()
+
+    def _make_engine(self, engine_kw: Dict[str, Any],
+                     donate: bool = False) -> None:
+        from ..observability import FlightRecorder, MetricRegistry
+        from ..resilience import faults
+        from .engine import ServingEngine
+        faults.clear()           # episode hygiene: no armed leftovers
+        self.engine = ServingEngine(
+            self._model, time_fn=self._now,
+            registry=MetricRegistry(),
+            flight_recorder=FlightRecorder(capacity=64), **engine_kw)
+        if donate:
+            # chaos: a step failure invalidates the cache pools, so
+            # recover()/failover paths are exercised for real
+            self.engine._donate = lambda: (5, 6)
+        self._reqs = {}
+
+    # -- response plumbing ---------------------------------------------
+    def _state(self) -> Dict[str, Any]:
+        eng = self.engine
+        return {
+            "queued": [r.rid for r in eng.scheduler.pending()],
+            "slots": {int(s): eng.cache.slots[s].rid
+                      for s in eng.cache.active_slots()},
+            "undelivered": [r.rid for r in eng._undelivered],
+            "broken": eng._broken,
+        }
+
+    def _updates(self, extra: Optional[List] = None) -> Dict[int, dict]:
+        ups: Dict[int, dict] = {}
+        for req in list(self._reqs.values()) + list(extra or []):
+            ups[req.rid] = {
+                "out": list(req.out_tokens),
+                "finished": bool(req.finished),
+                "reason": req.finish_reason,
+                "error": _wire_error(req.error)
+                if req.error is not None else None,
+                "slot": req.slot,
+            }
+        return ups
+
+    def _ok(self, finished: Optional[List] = None, **extra) -> dict:
+        done = finished or []
+        resp = {"ok": True, "finished": [r.rid for r in done],
+                "updates": self._updates(done),
+                "state": self._state()}
+        resp.update(extra)
+        self._prune()
+        return resp
+
+    def _err(self, e: BaseException) -> dict:
+        resp = {"ok": False, "error": _wire_error(e),
+                "updates": self._updates(), "state": self._state()}
+        self._prune()
+        return resp
+
+    def _prune(self) -> None:
+        # terminal requests were reported (and the blob is cached for
+        # a resend) — drop them so updates stay O(in-flight)
+        self._reqs = {rid: r for rid, r in self._reqs.items()
+                      if not r.finished}
+
+    def _mark_cancels(self, msg: dict) -> None:
+        # the client's FrontDoor flags disconnects on ITS Request
+        # objects; forward the flags so the engine's own sweep runs
+        # the real mid-prefill/mid-handoff abort paths
+        for rid in msg.get("cancel_rids") or ():
+            req = self._reqs.get(rid)
+            if req is not None:
+                req.cancel_requested = True
+
+    # -- ops -----------------------------------------------------------
+    def dispatch(self, msg: dict) -> dict:
+        if "now" in msg and msg["now"] is not None:
+            self._clock["t"] = float(msg["now"])
+        op = msg["op"]
+        eng = self.engine
+        try:
+            if op == "probe":
+                health = eng.probe()
+                return self._ok(pid=os.getpid(), health=health)
+            if op == "submit":
+                req = msg["req"]
+                eng.submit_request(req)
+                self._reqs[req.rid] = req
+                return self._ok()
+            if op == "adopt":
+                req = msg["req"]
+                eng.adopt(req)
+                self._reqs[req.rid] = req
+                return self._ok()
+            if op == "step":
+                self._mark_cancels(msg)
+                if not eng.has_work():
+                    return self._ok()
+                return self._ok(finished=eng.step())
+            if op == "recover":
+                report = eng.recover()
+                return self._ok(finished=report["finished"])
+            if op == "drain":
+                self._mark_cancels(msg)
+                return self._ok(finished=eng.drain(msg.get("max_steps")))
+            if op == "cancel":
+                req = self._reqs.get(msg["rid"])
+                hit = req is not None and \
+                    eng.cancel(req, msg.get("reason", "cancelled"))
+                return self._ok(finished=[req] if hit else None,
+                                cancelled=bool(hit))
+            if op == "unqueue":
+                # drain_replica: queued requests move to peers NOW
+                moved = eng.scheduler.drain()
+                for r in moved:
+                    self._reqs.pop(r.rid, None)
+                return self._ok(moved=[r.rid for r in moved])
+            if op == "requeue":
+                req = msg["req"]
+                eng.scheduler.requeue(req)
+                self._reqs[req.rid] = req
+                return self._ok()
+            if op == "audit":
+                from ..resilience.invariants import (
+                    engine_leak_violations, page_leak_violations)
+                v = engine_leak_violations(eng) \
+                    + page_leak_violations(eng)
+                return self._ok(violations=v,
+                                trace_counts=eng.trace_counts)
+            if op == "reset":
+                self._make_engine(msg.get("engine") or {},
+                                  donate=bool(msg.get("donate")))
+                self._virtual = bool(msg.get("virtual_clock",
+                                             self._virtual))
+                self._stall_s = 0.0
+                return self._ok()
+            if op == "stall":
+                self._stall_s = float(msg.get("seconds", 0.0))
+                return self._ok()
+            if op == "arm":
+                from ..resilience import faults
+                if msg.get("kill"):
+                    def _suicide(*_a, **_k):
+                        os.kill(os.getpid(), signal.SIGKILL)
+                    exc = _suicide
+                else:
+                    exc = None
+                faults.inject(msg["point"],
+                              times=msg.get("times", 1),
+                              after=msg.get("after", 0), exc=exc)
+                return self._ok()
+            raise ValueError(f"unknown worker op {op!r}")
+        except Exception as e:  # typed refusal, not a dead worker
+            return self._err(e)
+
+    # -- the serve loop ------------------------------------------------
+    def serve(self, srv: socket.socket) -> None:
+        from ..distributed._framing import nodelay, recv_msg, send_msg
+        while True:
+            conn, _ = srv.accept()
+            nodelay(conn)
+            try:
+                while True:
+                    blob = recv_msg(conn, eof_ok=True)
+                    if blob is None:
+                        break
+                    msg = pickle.loads(blob)
+                    key = (msg.get("token"), msg.get("seq"))
+                    stall = self._stall_s
+                    if key == self._last_key \
+                            and self._last_blob is not None:
+                        out = self._last_blob   # resend, don't re-run
+                    elif msg.get("op") == "shutdown":
+                        send_msg(conn, pickle.dumps(
+                            {"ok": True, "seq": msg.get("seq")}))
+                        os._exit(0)
+                    else:
+                        resp = self.dispatch(msg)
+                        resp["seq"] = msg.get("seq")
+                        try:
+                            out = pickle.dumps(resp)
+                        except Exception as e:
+                            out = pickle.dumps(
+                                {"ok": False, "seq": msg.get("seq"),
+                                 "error": _wire_error(e)})
+                        self._last_key, self._last_blob = key, out
+                    if stall:
+                        time.sleep(stall)
+                    send_msg(conn, out)
+            except (ConnectionError, OSError):
+                pass             # client gone; wait for a reconnect
+            finally:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+
+
+def main(argv=None) -> None:
+    parser = argparse.ArgumentParser(
+        description="paddle_tpu serving-cluster worker")
+    parser.add_argument("--store-host", default="127.0.0.1")
+    parser.add_argument("--store-port", type=int, required=True)
+    parser.add_argument("--prefix", required=True)
+    parser.add_argument("--worker-id", required=True)
+    args = parser.parse_args(argv)
+
+    # the TPU plugin force-sets jax_platforms at interpreter startup;
+    # honor the env the supervisor handed us (tests/benches force cpu)
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+
+    from ..distributed.store import TCPStore
+    store = TCPStore(args.store_host, args.store_port,
+                     is_master=False, world_size=1)
+    spec = pickle.loads(store.get(f"{args.prefix}/spec", timeout=60.0))
+    server = WorkerServer(spec, args.worker_id)
+
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(8)
+    port = srv.getsockname()[1]
+    store.set(f"{args.prefix}/{args.worker_id}/pid",
+              str(os.getpid()).encode())
+    store.set(f"{args.prefix}/{args.worker_id}/port",
+              str(port).encode())
+    store.close()
+    server.serve(srv)
+
+
+if __name__ == "__main__":
+    main()

@@ -64,6 +64,20 @@ TP_SERVING_KEYS = {
 }
 
 
+# the CLUSTER_SLO line (bench_serving_engine --cluster) is the
+# ISSUE-11 acceptance artifact: the closed-loop SLO run with worker
+# PROCESSES behind RPC replicas and a real mid-run SIGKILL — schema
+# stable, exactly-once ledger green through the process death,
+# supervisor respawn exercised
+CLUSTER_SLO_KEYS = {
+    "workers", "clients", "requests", "completed", "rejected_noisy",
+    "qps", "p99_ttft_s", "ttft_slo_s", "p99_ttft_steps", "slo_ok",
+    "deadline_miss_rate", "worker_sigkills", "failovers",
+    "failover_requests", "respawns", "lost", "duplicates",
+    "ledger_green", "step_wall_ms",
+}
+
+
 # the PAGED_KV line (bench_serving_engine --prefix-share) is the
 # artifact the paged-KV acceptance keys on: schema stable, gains over
 # the contiguous pool asserted at the ISSUE-6 bars (>= 4x paged,
@@ -86,9 +100,14 @@ PAGED_KV_KEYS = {
     "bench_serving_engine.py --speculative",
     "bench_serving_engine.py --frontdoor",
     "bench_serving_engine.py --tensor-parallel",
+    "bench_serving_engine.py --cluster",
     "chaos_soak.py",
 ])
 def test_benchmark_script_smoke(script, tmp_path):
+    if "--cluster" in script:
+        from paddle_tpu.distributed.store import get_lib
+        if get_lib() is None:
+            pytest.skip("native TCPStore extension unavailable")
     env = dict(os.environ, JAX_PLATFORMS="cpu",
                XLA_FLAGS="--xla_force_host_platform_device_count=8",
                PYTHONPATH=os.pathsep.join(
@@ -170,6 +189,23 @@ def test_benchmark_script_smoke(script, tmp_path):
         assert slo["failovers"] >= 1, slo
         assert slo["failover_requests"] >= 1, slo
         assert slo["rejected_noisy"] >= 1, slo
+    if script == "bench_serving_engine.py --cluster":
+        clines = [l for l in r.stdout.splitlines()
+                  if l.startswith("CLUSTER_SLO ")]
+        assert clines, r.stdout
+        slo = json.loads(clines[-1][len("CLUSTER_SLO "):])
+        assert CLUSTER_SLO_KEYS <= set(slo), sorted(slo)
+        assert slo["completed"] == slo["requests"], slo
+        assert slo["slo_ok"] is True, slo
+        assert slo["ledger_green"] is True, slo
+        assert slo["lost"] == 0 and slo["duplicates"] == 0, slo
+        # not vacuous: a worker PROCESS was really SIGKILLED mid-run,
+        # its requests failed over, and the supervisor respawned it
+        assert slo["worker_sigkills"] == 1, slo
+        assert slo["failovers"] >= 1, slo
+        assert slo["failover_requests"] >= 1, slo
+        assert slo["respawns"] >= 1, slo
+        assert slo["rejected_noisy"] >= 1, slo
     if script == "bench_serving_engine.py --tensor-parallel":
         tlines = [l for l in r.stdout.splitlines()
                   if l.startswith("TP_SERVING ")]
@@ -195,7 +231,8 @@ def test_benchmark_script_smoke(script, tmp_path):
         assert slines, r.stdout
         soak = json.loads(slines[-1][len("CHAOS_SOAK "):])
         assert {"episodes", "green", "red_seeds", "faults_fired",
-                "recoveries", "relaunches"} <= set(soak)
+                "recoveries", "relaunches", "cluster_episodes",
+                "respawns"} <= set(soak)
         assert soak["episodes"] == 6 and soak["green"] == 6
         assert soak["red_seeds"] == []
 

@@ -678,3 +678,71 @@ def test_engine_broken_after_donating_step_failure(tmp_path):
     eng.recover()
     eng.run()
     assert req.finished and len(req.output_ids) == 4
+
+
+def test_cluster_metric_families_and_death_dump(tmp_path):
+    """ISSUE-11 observability satellite: a cluster run leaves — in ONE
+    registry — per-worker liveness/respawn gauges, respawn and kill
+    counters, the per-op RPC latency histogram and per-worker inflight
+    gauges; and a worker death dumps the flight recorder (the
+    post-mortem) with the cluster's death/respawn records aboard."""
+    import signal
+
+    from paddle_tpu.distributed.store import get_lib
+    if get_lib() is None:
+        pytest.skip("native TCPStore extension unavailable")
+    from paddle_tpu.serving import ClusterSupervisor
+
+    reg = MetricRegistry()
+    fr = FlightRecorder(capacity=32, dump_dir=str(tmp_path))
+    sup = ClusterSupervisor(
+        {"tiny": True, "model_seed": 0,
+         "model_config": dict(num_hidden_layers=1, hidden_size=32,
+                              intermediate_size=64,
+                              num_attention_heads=2,
+                              max_position_embeddings=64),
+         "engine": {"max_slots": 2, "max_len": 64, "min_bucket": 8}},
+        n_workers=2, max_respawns=2, registry=reg,
+        flight_recorder=fr, dump_on_death=True)
+    try:
+        router = sup.start()
+        reqs = [router.submit(np.arange(1, 6 + i), 3)
+                for i in range(3)]
+        while router.has_work():
+            router.step()
+            sup.poll()
+        os.kill(sup.workers[0].pid, signal.SIGKILL)   # a real death
+        router.step()            # probe -> ReplicaDead -> failover
+        sup.poll()               # reap: dump the post-mortem, respawn
+        r2 = router.submit(np.arange(1, 4), 2)
+        while router.has_work():
+            router.step()
+            sup.poll()
+        assert all(r.finished for r in reqs) and r2.finished
+        text = reg.to_prometheus()   # BEFORE shutdown zeroes liveness
+    finally:
+        sup.shutdown()
+
+    _, samples = _parse_prom(text)
+    assert samples['ptpu_cluster_worker_alive{worker="w0"}'] == 1
+    assert samples['ptpu_cluster_worker_alive{worker="w1"}'] == 1
+    assert samples['ptpu_cluster_worker_respawns{worker="w0"}'] == 1
+    assert samples["ptpu_cluster_respawns_total"] == 1
+    assert samples['ptpu_cluster_worker_kills_total'
+                   '{kind="exited"}'] == 1
+    assert samples['ptpu_cluster_worker_rpc_inflight'
+                   '{worker="w0"}'] == 0
+    assert samples['ptpu_cluster_rpc_latency_seconds_count'
+                   '{op="step"}'] >= 1
+    assert samples['ptpu_cluster_rpc_latency_seconds_count'
+                   '{op="probe"}'] >= 1
+    assert samples["ptpu_router_failovers_total"] == 1
+
+    dumps = [f for f in os.listdir(tmp_path)
+             if f.startswith("ptpu_flight_")]
+    assert len(dumps) == 1       # exactly one death, one post-mortem
+    payload = json.load(open(tmp_path / dumps[0]))
+    assert "cluster worker" in payload["reason"]
+    kinds = [r["kind"] for r in payload["records"]]
+    assert "cluster.worker_dead" in kinds
+    assert "ptpu_cluster_respawns_total" in payload["metrics"]["metrics"]
