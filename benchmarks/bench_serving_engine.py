@@ -761,8 +761,11 @@ def run_cluster_slo(cfg_kwargs, *, n_workers, slots, max_len,
     any lost or double-delivered request through the real process
     death."""
     import signal as _signal
+    import tempfile
 
-    from paddle_tpu.observability import FlightRecorder, MetricRegistry
+    from paddle_tpu.observability import (ClusterTelemetry,
+                                          FlightRecorder,
+                                          MetricRegistry)
     from paddle_tpu.resilience.invariants import ConservationLedger
     from paddle_tpu.serving import (ClientStream, ClusterSupervisor,
                                     FrontDoor, ServingError,
@@ -771,6 +774,7 @@ def run_cluster_slo(cfg_kwargs, *, n_workers, slots, max_len,
     rng = np.random.RandomState(seed)
     clock = {"t": 0.0}
     ledger = ConservationLedger()
+    tel = ClusterTelemetry()
     spec = {"tiny": False, "model_seed": 0,
             "model_config": dict(cfg_kwargs),
             "engine": dict(max_slots=slots, max_len=max_len,
@@ -780,7 +784,8 @@ def run_cluster_slo(cfg_kwargs, *, n_workers, slots, max_len,
         spec, n_workers=n_workers, max_respawns=4,
         registry=MetricRegistry(),
         flight_recorder=FlightRecorder(capacity=16),
-        dump_on_death=False)
+        dump_on_death=False,
+        telemetry=tel, scrape_interval=1)
     old_plat = os.environ.get("JAX_PLATFORMS")
     os.environ["JAX_PLATFORMS"] = "cpu"
     try:
@@ -795,7 +800,7 @@ def run_cluster_slo(cfg_kwargs, *, n_workers, slots, max_len,
     router = sup.router
     front = FrontDoor(
         router, auditor=ledger, time_fn=lambda: clock["t"],
-        registry=MetricRegistry(),
+        registry=MetricRegistry(), telemetry=tel,
         tenants={"noisy": TenantPolicy(rate_qps=2.0, burst=2,
                                        max_inflight=1)})
 
@@ -887,9 +892,11 @@ def run_cluster_slo(cfg_kwargs, *, n_workers, slots, max_len,
                         + float(rng.exponential(2.0 * step_wall))
         front.drain()
         sup.poll()
+        sup.scrape_all()     # final drain of every worker's buffer
         respawns = sup.respawns_used
         failovers = int(router._m_failover.value)
         failover_req = int(router._m_failover_req.value)
+        merged_metrics = tel.merged_prometheus()
     finally:
         sup.shutdown()
 
@@ -946,6 +953,36 @@ def run_cluster_slo(cfg_kwargs, *, n_workers, slots, max_len,
         "unit": "req/s",
         "vs_baseline": round(1.0 / ttft_slo if ttft_slo else 0.0, 2)}))
     print("CLUSTER_SLO " + json.dumps(summary))
+
+    # one merged chrome-trace + SLO-attribution artifact across the
+    # router and every worker incarnation (ISSUE-13 acceptance)
+    chrome = tel.chrome_trace()
+    slo = tel.slo_attribution()
+    losses = tel.scrape_losses()
+    worker_pids = sorted({int(s.get("pid", 0))
+                          for s in tel.aligned_spans()
+                          if str(s.get("proc"))
+                          not in ("router", "frontdoor", "supervisor")})
+    out_path = os.environ.get("PTPU_TRACE_OUT") or os.path.join(
+        tempfile.gettempdir(), f"ptpu_cluster_trace_{os.getpid()}.json")
+    with open(out_path, "w") as f:
+        json.dump({"chrome_trace": chrome,
+                   "slo_attribution": slo,
+                   "scrape_losses": losses,
+                   "merged_metrics": merged_metrics}, f)
+    flows = sum(1 for e in chrome["traceEvents"]
+                if e.get("ph") in ("s", "t", "f"))
+    print("TRACE_TIMELINE " + json.dumps({
+        "artifact": out_path,
+        "spans": sum(1 for e in chrome["traceEvents"]
+                     if e.get("ph") == "X"),
+        "lanes": len(slo),
+        "worker_pids": worker_pids,
+        "failover_flow_events": flows,
+        "scrape_losses": len(losses),
+        "slo_requests": len(slo),
+        "merged_metric_lines": len(merged_metrics.splitlines()),
+    }))
     if viol:
         for v in viol:
             print("  - " + v, file=sys.stderr)

@@ -14,7 +14,14 @@ Three pieces (docs/OBSERVABILITY.md has the full guide):
 - **Flight recorder** (``flight_recorder.py``): bounded ring of the
   last N step records (latency, occupancy, queue depth, compile
   events) dumped to disk when a step raises, the watchdog flags a dead
-  peer, or an unhandled exception escapes.
+  peer, or an unhandled exception escapes; workers additionally spill
+  the ring periodically so even a SIGKILL leaves a post-mortem.
+- **Cluster timeline** (``timeline.py``): merges per-process trace
+  buffers and registry snapshots (scraped over the cluster
+  ``telemetry`` RPC) into one chrome trace with per-request lanes, a
+  per-request SLO attribution, and one cluster-wide Prometheus
+  exposition (counters summed, gauges worker-labeled, histograms
+  bucket-merged).
 
 Instrumented out of the box: ``serving/engine.py`` (per-step spans,
 queue/eviction/prefill counters, TTFT + inter-token + queue-wait
@@ -27,9 +34,17 @@ snapshot from the same run).
 """
 from .registry import (Counter, Gauge, Histogram,  # noqa: F401
                        MetricError, MetricRegistry, default_registry)
-from .tracing import Span, span  # noqa: F401
+from .tracing import (Span, span, TraceContext,  # noqa: F401
+                      TraceBuffer, install_trace_buffer,
+                      current_trace_buffer, bind_request,
+                      unbind_request, clear_bindings, context_for,
+                      active_context)
 from .flight_recorder import FlightRecorder, default_recorder  # noqa: F401
+from .timeline import ClusterTelemetry  # noqa: F401
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricError",
            "MetricRegistry", "default_registry", "Span", "span",
-           "FlightRecorder", "default_recorder"]
+           "TraceContext", "TraceBuffer", "install_trace_buffer",
+           "current_trace_buffer", "bind_request", "unbind_request",
+           "clear_bindings", "context_for", "active_context",
+           "FlightRecorder", "default_recorder", "ClusterTelemetry"]

@@ -33,7 +33,9 @@ __all__ = ["FlightRecorder", "default_recorder"]
 class FlightRecorder:
     def __init__(self, capacity: int = 256,
                  time_fn: Callable[[], float] = time.time,
-                 dump_dir: Optional[str] = None, registry=None):
+                 dump_dir: Optional[str] = None, registry=None,
+                 spill_path: Optional[str] = None,
+                 spill_every: int = 0):
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
         self.capacity = int(capacity)
@@ -43,6 +45,12 @@ class FlightRecorder:
         # default; callers with an injected registry pass it at dump
         # time so the post-mortem carries THEIR metrics)
         self.registry = registry
+        # SIGKILL survivability: a kill -9 never runs dump(), so a
+        # worker can spill the ring to a well-known path every
+        # spill_every records (and on SIGTERM) — the supervisor's
+        # death dump attaches whatever the victim last spilled
+        self.spill_path = spill_path
+        self.spill_every = int(spill_every)
         self._ring: deque = deque(maxlen=self.capacity)
         self._lock = threading.Lock()
         self._seq = 0
@@ -57,7 +65,31 @@ class FlightRecorder:
                    "kind": kind, **fields}
             self._seq += 1
             self._ring.append(rec)
+            due = (self.spill_path is not None and self.spill_every > 0
+                   and self._seq % self.spill_every == 0)
+        if due:
+            self.spill()
         return rec
+
+    def spill(self) -> Optional[str]:
+        """Atomically write the ring to ``spill_path`` (tmp + rename;
+        a kill mid-write leaves the previous spill intact). Errors are
+        swallowed — spilling is best-effort insurance, never a reason
+        to fail the step that triggered it."""
+        path = self.spill_path
+        if not path:
+            return None
+        try:
+            payload = {"pid": os.getpid(),
+                       "spilled_at": float(self.now()),
+                       "records": self.snapshot()}
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(payload, f, default=repr)
+            os.replace(tmp, path)
+            return path
+        except Exception:
+            return None
 
     def snapshot(self) -> List[dict]:
         """Oldest-to-newest copy of the ring."""

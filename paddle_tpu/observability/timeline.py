@@ -1,0 +1,503 @@
+"""Cluster telemetry plane: merge N processes into one timeline.
+
+The serving path spans processes (frontdoor → router → RPC → worker
+engines), so its observability is sharded: each worker owns a private
+:class:`~.tracing.TraceBuffer` and :class:`~.registry.MetricRegistry`,
+scraped over the ``telemetry`` RPC by the supervisor.
+:class:`ClusterTelemetry` is the host-side accumulator that turns
+those shards into the three cluster-level artifacts:
+
+- **one chrome-trace JSON** (``chrome_trace``) with per-request lanes:
+  every span becomes a ``ph:"X"`` event on (process pid, lane =
+  request id), clock-aligned via the offset between the scraping
+  host's clock and the ``now`` each payload carries (zero under the
+  chaos virtual clock, which rides every RPC frame already). A
+  failover shows up as the router's annotated
+  ``router.failover.rehome`` span plus a flow arrow linking the
+  request's two worker lanes through it.
+- **one SLO-attribution record per request** (``slo_attribution``):
+  queue / dispatch-RPC / prefill / decode / handoff / failover-replay
+  seconds, from the same spans.
+- **one Prometheus exposition** (``merged_prometheus``) served from
+  the front door's ``/metrics``: counters summed across processes,
+  gauges labeled ``worker=<label>`` (point-in-time values must stay
+  distinguishable), histograms merged at the **bucket** level —
+  never averaging percentiles; a quantile of merged buckets is
+  meaningful, a mean of per-worker quantiles is not.
+
+Trust rules the merge enforces rather than assumes:
+
+- **Scrape loss is detected, not papered over.** Each payload carries
+  the buffer's cumulative ``drained_total``/``dropped_total``; a gap
+  between what was drained and what this plane ingested means a
+  scrape response died on the wire (or the ring overflowed) and is
+  recorded as a loss (``scrape_losses``) — the chaos trace-
+  conservation law downgrades itself on losses instead of failing on
+  a silently truncated timeline.
+- **Counter resets add, never subtract.** A respawned (or
+  soft-reclaimed) worker restarts its registry from zero; a sample
+  below the previous one banks the old value as a completed
+  incarnation (``base += last``) so cluster counters stay monotonic.
+- **Label/schema collisions raise** ``MetricError``: same family at
+  different type/labels/buckets across processes, a worker gauge
+  already declaring a ``worker`` label, or two host registries
+  exporting the same gauge sample.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+from .registry import MetricError, _escape_label, _fmt
+
+__all__ = ["ClusterTelemetry"]
+
+_HOST_PROCS = ("router", "frontdoor", "supervisor")
+_US = 1e6  # chrome trace wants microseconds
+
+
+def _span_rids(rec: dict) -> List[int]:
+    """Request lane(s) a span record belongs to — batch spans
+    (decode/verify) carry ``request_ids`` and fan out."""
+    attrs = rec.get("attrs") or {}
+    if attrs.get("request_id") is not None:
+        return [int(attrs["request_id"])]
+    ids = attrs.get("request_ids")
+    if ids:
+        return [int(r) for r in ids]
+    return []
+
+
+def _dur(rec: dict) -> float:
+    return max(0.0, float(rec["t1"]) - float(rec["t0"]))
+
+
+def _le_key(le: str) -> float:
+    return math.inf if le == "+Inf" else float(le)
+
+
+class ClusterTelemetry:
+    """Accumulates scraped worker payloads + host registries/buffers
+    and exports the merged artifacts. Single-episode lifecycle:
+    ``begin_episode()`` clears everything accumulated (worker engines
+    are reset to fresh buffers/registries at the same moment)."""
+
+    def __init__(self):
+        self._host_regs: List[Tuple[str, Any]] = []
+        self._spans: List[dict] = []
+        self._losses: List[dict] = []
+        # (worker, pid) -> {"drained": int, "dropped": int}
+        self._continuity: Dict[Tuple[str, int], Dict[str, int]] = {}
+        # (worker, family, labelkey) -> reset-adjustment state
+        self._counter_state: Dict[tuple, dict] = {}
+        self._snapshots: Dict[str, dict] = {}   # worker -> effective
+        self._worker_pids: Dict[str, int] = {}
+
+    # -- registration ---------------------------------------------------
+    def add_host_registry(self, registry, name: str) -> None:
+        """A host-process registry (router, frontdoor) merged live —
+        no scrape hop, so it is read at export time."""
+        for n, r in self._host_regs:
+            if r is registry:
+                return
+            if n == name:
+                raise MetricError(
+                    f"host registry name {name!r} already registered")
+        self._host_regs.append((name, registry))
+
+    def begin_episode(self) -> None:
+        self._spans.clear()
+        self._losses.clear()
+        self._continuity.clear()
+        self._counter_state.clear()
+        self._snapshots.clear()
+        self._worker_pids.clear()
+
+    # -- ingestion ------------------------------------------------------
+    def ingest_worker(self, worker: str, payload: dict,
+                      host_now: Optional[float] = None) -> bool:
+        """One ``telemetry`` scrape payload. Returns False when the
+        payload is a duplicate (resent blob already ingested)."""
+        pid = int(payload.get("pid") or 0)
+        spans = list(payload.get("spans") or ())
+        drained = int(payload.get("drained_total", len(spans)))
+        dropped = int(payload.get("dropped_total", 0))
+        key = (worker, pid)
+        prev = self._continuity.get(key)
+        if prev is not None and drained <= prev["drained"]:
+            return False                       # replayed scrape blob
+        seen = prev["drained"] if prev is not None else 0
+        seen_drop = prev["dropped"] if prev is not None else 0
+        before = drained - len(spans)          # drained prior to this
+        if before > seen:
+            self._losses.append(
+                {"worker": worker, "pid": pid, "kind": "missed_scrape",
+                 "lost_spans": before - seen})
+        if dropped > seen_drop:
+            self._losses.append(
+                {"worker": worker, "pid": pid, "kind": "overflow",
+                 "lost_spans": dropped - seen_drop})
+        self._continuity[key] = {"drained": drained, "dropped": dropped}
+        self._worker_pids[worker] = pid
+
+        off = 0.0
+        if host_now is not None and payload.get("now") is not None:
+            off = float(host_now) - float(payload["now"])
+        for rec in spans:
+            tagged = dict(rec)
+            tagged["proc"] = worker
+            tagged["offset"] = off
+            tagged.setdefault("pid", pid)
+            self._spans.append(tagged)
+
+        snap = payload.get("registry")
+        if snap:
+            self._snapshots[worker] = self._account(worker, snap)
+        return True
+
+    def ingest_host(self, spans: List[dict], proc: str = "router") -> None:
+        """Spans drained from a host-process TraceBuffer (in-process:
+        lossless, no clock offset)."""
+        for rec in spans:
+            tagged = dict(rec)
+            tagged["proc"] = proc
+            tagged["offset"] = 0.0
+            self._spans.append(tagged)
+
+    def rebaseline(self, worker: str, pid: int) -> None:
+        """The worker deliberately swapped in a fresh trace buffer
+        (engine reset / soft reclaim): drop continuity for this
+        incarnation WITHOUT recording a loss, so the next scrape's
+        restarted counters aren't mistaken for a replayed blob."""
+        self._continuity.pop((worker, int(pid)), None)
+
+    def forget(self, worker: str, pid: int,
+               reason: str = "scrape_failed") -> None:
+        """A scrape (usually the death-reap one) could not reach the
+        worker: whatever its buffer held is gone. Recorded as a loss
+        so consumers degrade instead of trusting a truncated view."""
+        self._continuity.pop((worker, int(pid)), None)
+        self._losses.append(
+            {"worker": worker, "pid": int(pid), "kind": reason})
+
+    # -- snapshot accounting (counter-reset detection) ------------------
+    def _account(self, worker: str, snap: dict) -> dict:
+        """Effective snapshot: reset-adjusted counters/histograms so a
+        respawned worker's restart-from-zero ADDS an incarnation
+        instead of subtracting (cluster counters stay monotonic)."""
+        out = {"ts": snap.get("ts"), "metrics": {}}
+        for name, fam in (snap.get("metrics") or {}).items():
+            rows = []
+            for s in fam.get("samples", ()):
+                labels = dict(s.get("labels") or {})
+                key = (worker, name,
+                       tuple(sorted(labels.items())))
+                if fam.get("type") == "counter":
+                    cur = float(s.get("value", 0.0))
+                    st = self._counter_state.setdefault(
+                        key, {"base": 0.0, "last": 0.0})
+                    if cur < st["last"]:       # new incarnation
+                        st["base"] += st["last"]
+                    st["last"] = cur
+                    rows.append({"labels": labels,
+                                 "value": st["base"] + cur})
+                elif fam.get("type") == "histogram":
+                    cur_b = dict(s.get("buckets") or {})
+                    cur_s = float(s.get("sum", 0.0))
+                    cur_n = int(s.get("count", 0))
+                    st = self._counter_state.setdefault(
+                        key, {"base": {"buckets": {}, "sum": 0.0,
+                                       "count": 0},
+                              "last": {"buckets": {}, "sum": 0.0,
+                                       "count": 0}})
+                    if cur_n < st["last"]["count"]:
+                        b = st["base"]
+                        for le, c in st["last"]["buckets"].items():
+                            b["buckets"][le] = \
+                                b["buckets"].get(le, 0) + c
+                        b["sum"] += st["last"]["sum"]
+                        b["count"] += st["last"]["count"]
+                    st["last"] = {"buckets": cur_b, "sum": cur_s,
+                                  "count": cur_n}
+                    base = st["base"]
+                    eff_b = {le: base["buckets"].get(le, 0) + c
+                             for le, c in cur_b.items()}
+                    rows.append({"labels": labels, "buckets": eff_b,
+                                 "sum": base["sum"] + cur_s,
+                                 "count": base["count"] + cur_n})
+                else:                          # gauge: point-in-time
+                    rows.append({"labels": labels,
+                                 "value": float(s.get("value", 0.0))})
+            out["metrics"][name] = {
+                "type": fam.get("type"), "help": fam.get("help", ""),
+                "label_names": list(fam.get("label_names") or ()),
+                "samples": rows}
+        return out
+
+    # -- span access ----------------------------------------------------
+    @property
+    def spans(self) -> List[dict]:
+        return list(self._spans)
+
+    def aligned_spans(self) -> List[dict]:
+        """Spans with clock-aligned ``t0``/``t1`` (offset applied),
+        sorted by start time."""
+        out = []
+        for r in self._spans:
+            off = float(r.get("offset", 0.0))
+            a = dict(r)
+            a["t0"] = float(r["t0"]) + off
+            a["t1"] = float(r["t1"]) + off
+            out.append(a)
+        out.sort(key=lambda r: (r["t0"], r["t1"]))
+        return out
+
+    def spans_for(self, rid: int) -> List[dict]:
+        rid = int(rid)
+        return [r for r in self.aligned_spans()
+                if rid in _span_rids(r)]
+
+    def scrape_losses(self) -> List[dict]:
+        return list(self._losses)
+
+    def worker_snapshots(self) -> Dict[str, dict]:
+        """Latest reset-adjusted registry snapshot per worker label."""
+        return dict(self._snapshots)
+
+    # -- chrome trace ---------------------------------------------------
+    def chrome_trace(self) -> dict:
+        """One merged chrome-trace object: pid = real process, lane
+        (tid) = request id, flow arrows through every
+        ``router.failover.rehome`` span linking the old and new
+        worker lanes of the re-homed request."""
+        events: List[dict] = []
+        aligned = self.aligned_spans()
+        procs: Dict[int, str] = {}
+        lanes = set()
+        for r in aligned:
+            procs.setdefault(int(r.get("pid", 0)),
+                             str(r.get("proc", "?")))
+        for pid, proc in sorted(procs.items()):
+            events.append({"ph": "M", "name": "process_name",
+                           "pid": pid, "tid": 0,
+                           "args": {"name": proc}})
+        for r in aligned:
+            rids = _span_rids(r) or [0]
+            attrs = r.get("attrs") or {}
+            pid = int(r.get("pid", 0))
+            for rid in rids:
+                args = dict(attrs)
+                args["proc"] = r.get("proc")
+                trace = r.get("trace") or (
+                    f"req-{rid}" if rid else None)
+                if trace:
+                    args["trace_id"] = trace
+                if r.get("error"):
+                    args["error"] = r["error"]
+                events.append({
+                    "ph": "X", "name": r["name"], "cat": "span",
+                    "pid": pid, "tid": rid,
+                    "ts": r["t0"] * _US, "dur": _dur(r) * _US,
+                    "args": args})
+                if rid and (pid, rid) not in lanes:
+                    lanes.add((pid, rid))
+                    events.append({"ph": "M", "name": "thread_name",
+                                   "pid": pid, "tid": rid,
+                                   "args": {"name": f"req {rid}"}})
+        events.extend(self._failover_flows(aligned))
+        return {"traceEvents": events,
+                "displayTimeUnit": "ms",
+                "metadata": {"scrape_losses": self.scrape_losses()}}
+
+    def _failover_flows(self, aligned: List[dict]) -> List[dict]:
+        flows: List[dict] = []
+        rehomes = [r for r in aligned
+                   if r["name"] == "router.failover.rehome"]
+        for k, rh in enumerate(rehomes):
+            rids = _span_rids(rh)
+            if not rids:
+                continue
+            rid = rids[0]
+            rh_pid = int(rh.get("pid", 0))
+            others = [r for r in aligned
+                      if rid in _span_rids(r)
+                      and int(r.get("pid", 0)) != rh_pid]
+            pre = [r for r in others if r["t1"] <= rh["t1"] + 1e-9]
+            post = [r for r in others if r["t0"] >= rh["t0"] - 1e-9]
+            src = pre[-1] if pre else None
+            dst = next((r for r in post if src is None
+                        or int(r.get("pid", 0))
+                        != int(src.get("pid", 0))), None)
+            if src is None or dst is None:
+                continue
+            fid = f"failover-{rid}-{k}"
+            flows.append({"ph": "s", "name": "failover",
+                          "cat": "failover", "id": fid,
+                          "pid": int(src["pid"]), "tid": rid,
+                          "ts": src["t1"] * _US})
+            flows.append({"ph": "t", "name": "failover",
+                          "cat": "failover", "id": fid,
+                          "pid": rh_pid, "tid": rid,
+                          "ts": rh["t0"] * _US})
+            flows.append({"ph": "f", "bp": "e", "name": "failover",
+                          "cat": "failover", "id": fid,
+                          "pid": int(dst["pid"]), "tid": rid,
+                          "ts": dst["t0"] * _US})
+        return flows
+
+    # -- SLO attribution ------------------------------------------------
+    def slo_attribution(self) -> List[dict]:
+        """Per-request time accounting from the merged spans. Replay
+        prefills (failover re-execution) bill to ``failover_replay_s``,
+        not ``prefill_s`` — a re-homed request's first prefill already
+        happened on the dead worker."""
+        per: Dict[int, List[dict]] = {}
+        for r in self.aligned_spans():
+            for rid in _span_rids(r):
+                per.setdefault(rid, []).append(r)
+        out = []
+        for rid in sorted(per):
+            recs = per[rid]
+
+            def named(*names):
+                return [r for r in recs if r["name"] in names]
+
+            prefills = named("serving.prefill")
+            replays = [r for r in prefills
+                       if (r.get("attrs") or {}).get("replay")]
+            first = [r for r in prefills if r not in replays]
+            dispatch = named("router.dispatch")
+            rehomes = named("router.failover.rehome")
+            queue_s = 0.0
+            if first and dispatch:
+                queue_s = max(0.0, min(r["t0"] for r in first)
+                              - min(r["t1"] for r in dispatch))
+            workers = sorted({str(r.get("proc")) for r in recs
+                              if str(r.get("proc"))
+                              not in _HOST_PROCS})
+            out.append({
+                "request_id": rid,
+                "trace_id": f"req-{rid}",
+                "queue_s": queue_s,
+                "dispatch_rpc_s": sum(_dur(r) for r in dispatch),
+                "prefill_s": sum(_dur(r) for r in first),
+                "decode_s": sum(_dur(r) for r in named(
+                    "serving.decode", "serving.verify")),
+                "handoff_s": sum(_dur(r) for r in named(
+                    "serving.kv_handoff")),
+                "failover_replay_s": sum(_dur(r) for r in replays)
+                + sum(_dur(r) for r in rehomes),
+                "failovers": len(rehomes),
+                "workers": workers,
+                "pids": sorted({int(r.get("pid", 0)) for r in recs}),
+                "spans": len(recs)})
+        return out
+
+    # -- merged exposition ----------------------------------------------
+    def _sources(self) -> List[Tuple[str, str, dict]]:
+        srcs = [("host", name, reg.to_json())
+                for name, reg in self._host_regs]
+        srcs.extend(("worker", w, self._snapshots[w])
+                    for w in sorted(self._snapshots))
+        return srcs
+
+    def merged_snapshot(self) -> dict:
+        """The merged family tree behind ``merged_prometheus`` —
+        counters summed, worker gauges re-labeled, histograms
+        bucket-merged; raises :class:`MetricError` on any schema or
+        label collision."""
+        fams: Dict[str, dict] = {}
+        for kind, src, snap in self._sources():
+            for name, fam in (snap.get("metrics") or {}).items():
+                ftype = fam.get("type")
+                lnames = tuple(fam.get("label_names") or ())
+                if ftype == "gauge" and kind == "worker":
+                    if "worker" in lnames:
+                        raise MetricError(
+                            f"gauge {name} from worker {src} already "
+                            f"declares a 'worker' label — merge would "
+                            f"collide with the injected worker label")
+                    lnames = lnames + ("worker",)
+                ent = fams.get(name)
+                if ent is None:
+                    ent = fams[name] = {
+                        "type": ftype, "help": fam.get("help", ""),
+                        "label_names": lnames, "samples": {}}
+                else:
+                    if ent["type"] != ftype:
+                        raise MetricError(
+                            f"metric {name}: type conflict across "
+                            f"processes ({ent['type']} vs {ftype})")
+                    if ent["label_names"] != lnames:
+                        raise MetricError(
+                            f"metric {name}: label schema conflict "
+                            f"across processes ({ent['label_names']} "
+                            f"vs {lnames})")
+                for s in fam.get("samples", ()):
+                    labels = dict(s.get("labels") or {})
+                    if ftype == "gauge" and kind == "worker":
+                        labels["worker"] = src
+                    key = tuple(str(labels.get(n, ""))
+                                for n in ent["label_names"])
+                    cur = ent["samples"].get(key)
+                    if ftype == "counter":
+                        ent["samples"][key] = \
+                            (cur or 0.0) + float(s.get("value", 0.0))
+                    elif ftype == "gauge":
+                        if cur is not None:
+                            raise MetricError(
+                                f"gauge {name}{dict(zip(ent['label_names'], key))}: "
+                                f"sample collision across processes — "
+                                f"gauges merge by labeling, not "
+                                f"summing")
+                        ent["samples"][key] = float(s.get("value", 0.0))
+                    else:                      # histogram
+                        b = dict(s.get("buckets") or {})
+                        if cur is None:
+                            ent["samples"][key] = {
+                                "buckets": b,
+                                "sum": float(s.get("sum", 0.0)),
+                                "count": int(s.get("count", 0))}
+                        else:
+                            if set(cur["buckets"]) != set(b):
+                                raise MetricError(
+                                    f"histogram {name}: bucket schema "
+                                    f"mismatch across processes — "
+                                    f"refusing a lossy merge")
+                            for le, c in b.items():
+                                cur["buckets"][le] += c
+                            cur["sum"] += float(s.get("sum", 0.0))
+                            cur["count"] += int(s.get("count", 0))
+        return fams
+
+    def merged_prometheus(self) -> str:
+        """Cluster-wide Prometheus text exposition 0.0.4."""
+        fams = self.merged_snapshot()
+        lines: List[str] = []
+
+        def lbl(names, values, extra=()):
+            pairs = [f'{n}="{_escape_label(v)}"'
+                     for n, v in zip(names, values)] + list(extra)
+            return "{" + ",".join(pairs) + "}" if pairs else ""
+
+        for name in sorted(fams):
+            ent = fams[name]
+            if ent["help"]:
+                h = ent["help"].replace("\\", r"\\") \
+                    .replace("\n", r"\n")
+                lines.append(f"# HELP {name} {h}")
+            lines.append(f"# TYPE {name} {ent['type']}")
+            for key in sorted(ent["samples"]):
+                val = ent["samples"][key]
+                ls = lbl(ent["label_names"], key)
+                if ent["type"] == "histogram":
+                    for le in sorted(val["buckets"], key=_le_key):
+                        bl = lbl(ent["label_names"], key,
+                                 [f'le="{le}"'])
+                        lines.append(
+                            f"{name}_bucket{bl} {val['buckets'][le]}")
+                    lines.append(f"{name}_sum{ls} {_fmt(val['sum'])}")
+                    lines.append(f"{name}_count{ls} {val['count']}")
+                else:
+                    lines.append(f"{name}{ls} {_fmt(val)}")
+        return "\n".join(lines) + "\n"

@@ -53,7 +53,8 @@ from .invariants import (ConservationLedger, checkpoint_monotonic_violations,
                          loss_trajectory_violations,
                          page_leak_violations, pending_save_violations,
                          router_leak_violations,
-                         thread_leak_violations, token_prefix_violations)
+                         thread_leak_violations, timeline_violations,
+                         token_prefix_violations)
 
 __all__ = ["FaultArm", "EpisodeResult", "ChaosStore",
            "SERVING_SWEEP", "TRAINING_SWEEP", "FRONTDOOR_SWEEP",
@@ -674,7 +675,8 @@ def _cluster_supervisor():
     global _cluster_sup
     if _cluster_sup is None:
         import atexit
-        from ..observability import FlightRecorder, MetricRegistry
+        from ..observability import (ClusterTelemetry, FlightRecorder,
+                                     MetricRegistry)
         from ..serving.cluster import ClusterSupervisor
         spec = {"tiny": True, "model_seed": 0,
                 "model_config": dict(
@@ -688,7 +690,8 @@ def _cluster_supervisor():
             spec, n_workers=2, max_respawns=8,
             registry=MetricRegistry(),
             flight_recorder=FlightRecorder(capacity=16),
-            dump_on_death=False)
+            dump_on_death=False,
+            telemetry=ClusterTelemetry(), scrape_interval=1)
         _cluster_sup.start()
         atexit.register(_shutdown_cluster)
     return _cluster_sup
@@ -902,6 +905,7 @@ def run_cluster_episode(seed: int, max_iters: int = 300,
             sup.poll()
         front.drain()
         sup.poll()
+        sup.scrape_all()     # pick up spans from the drain's steps
     except Exception as e:  # noqa: BLE001 — any escape breaks the
         violations.append(  # "the cluster never strands work" law
             f"episode escaped with {type(e).__name__}: {e}")
@@ -909,6 +913,10 @@ def run_cluster_episode(seed: int, max_iters: int = 300,
     fired = faults.fired()
     faults.clear()
     violations += ledger.violations()
+    violations += timeline_violations(
+        sup.telemetry,
+        [ledger.submitted[rid] for rid in ledger.delivered
+         if rid in ledger.submitted])
     violations += router_leak_violations(router)
     violations += frontdoor_leak_violations(front)
     violations += token_prefix_violations(

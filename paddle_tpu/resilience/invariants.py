@@ -57,7 +57,8 @@ __all__ = ["InvariantViolation", "ConservationLedger",
            "frontdoor_leak_violations",
            "thread_leak_violations", "pending_save_violations",
            "loss_trajectory_violations",
-           "checkpoint_monotonic_violations"]
+           "checkpoint_monotonic_violations",
+           "timeline_violations"]
 
 
 class InvariantViolation(AssertionError):
@@ -155,6 +156,63 @@ class ConservationLedger:
         v = self.violations()
         if v:
             raise InvariantViolation(v)
+
+
+def timeline_violations(telemetry, requests) -> List[str]:
+    """Chaos trace-conservation law: every request the ledger marks
+    DELIVERED has a complete merged timeline — a ``router.dispatch``
+    span; a ``serving.prefill`` span if it produced tokens; a
+    ``serving.decode``/``serving.verify`` span if it produced more
+    than one; and, when its spans come from two different worker
+    processes, a ``router.failover.rehome`` span linking the lanes.
+
+    The law is loss-aware, not loss-blind: when the telemetry plane
+    DETECTED a dropped scrape (``scrape_losses`` carries a degrading
+    kind), worker-side span checks are skipped for the episode —
+    detection is the requirement; a detected loss must not read as a
+    phantom violation — while host-side spans (dispatch, rehome),
+    which never cross the scrape, stay mandatory.
+    """
+    from ..observability.timeline import _HOST_PROCS, _span_rids
+    out: List[str] = []
+    # ANY recorded loss degrades: a SIGKILLed worker takes its
+    # un-scraped buffer with it, and a drain can deliver several
+    # steps between scrapes — so even "worker_died" may have eaten
+    # spans of a delivered request.
+    degraded = bool(telemetry.scrape_losses())
+    per: Dict[int, List[dict]] = {}
+    for rec in telemetry.aligned_spans():
+        for rid in _span_rids(rec):
+            per.setdefault(rid, []).append(rec)
+    for req in requests:
+        recs = per.get(req.rid, [])
+        names = {r["name"] for r in recs}
+        if "router.dispatch" not in names:
+            out.append(
+                f"request {req.rid} delivered but the merged timeline "
+                f"has no router.dispatch span")
+        if degraded:
+            continue
+        if req.out_tokens and "serving.prefill" not in names:
+            out.append(
+                f"request {req.rid} delivered {len(req.out_tokens)} "
+                f"tokens but the merged timeline has no "
+                f"serving.prefill span")
+        if len(req.out_tokens) > 1 and not names & {
+                "serving.decode", "serving.verify"}:
+            out.append(
+                f"request {req.rid} delivered {len(req.out_tokens)} "
+                f"tokens but the merged timeline has no decode/verify "
+                f"span")
+        worker_pids = {int(r.get("pid", 0)) for r in recs
+                       if str(r.get("proc")) not in _HOST_PROCS}
+        if len(worker_pids) >= 2 \
+                and "router.failover.rehome" not in names:
+            out.append(
+                f"request {req.rid} has spans from worker pids "
+                f"{sorted(worker_pids)} but no router.failover.rehome "
+                f"span links its lanes")
+    return out
 
 
 def token_prefix_violations(

@@ -42,18 +42,22 @@ must never be exposed beyond the launcher's private network.
 """
 from __future__ import annotations
 
+import json
 import os
 import pickle
 import signal
 import socket
 import subprocess
 import sys
+import tempfile
 import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional
 
 from ..distributed._framing import nodelay, recv_msg, send_msg
-from ..observability import default_recorder, default_registry
+from ..observability import (TraceBuffer, active_context,
+                             default_recorder, default_registry,
+                             install_trace_buffer)
 from ..resilience.retry import RetryError, RetryPolicy
 from ..resilience.train_loop import RestartLimitExceeded
 from .errors import ReplicaDead
@@ -62,7 +66,20 @@ from .sampling import SamplingParams
 from .scheduler import Request
 
 __all__ = ["RemoteEngine", "RemoteReplica", "ClusterSupervisor",
-           "WorkerHandle"]
+           "WorkerHandle", "normalize_op"]
+
+# every op the protocol speaks; anything else (a future/misspelled op
+# would otherwise mint a fresh metric label per value) collapses to
+# "other" before reaching the latency histogram's label set
+_RPC_OPS = frozenset({
+    "probe", "submit", "adopt", "step", "recover", "drain", "cancel",
+    "unqueue", "requeue", "audit", "reset", "stall", "arm",
+    "telemetry", "shutdown"})
+
+
+def normalize_op(op: str) -> str:
+    """Bound RPC op names to the known protocol set for labels."""
+    return op if op in _RPC_OPS else "other"
 
 
 # ---------------------------------------------------------------------------
@@ -239,8 +256,11 @@ class RemoteEngine:
             raise ReplicaDead(f"worker {self.name} marked dead")
         self._seq += 1
         seq = self._seq
+        # every frame carries the virtual clock AND the active trace
+        # context (the span enclosing this call, e.g. router.dispatch)
+        # so worker-side spans clock-align and parent correctly
         msg = {"op": op, "seq": seq, "token": self._token,
-               "now": self._now()}
+               "now": self._now(), "trace": active_context()}
         if payload:
             msg.update(payload)
         blob = pickle.dumps(msg)
@@ -265,7 +285,7 @@ class RemoteEngine:
             raise
         finally:
             self._m_inflight.labels(worker=self.name).set(0)
-            self._m_latency.labels(op=op).observe(
+            self._m_latency.labels(op=normalize_op(op)).observe(
                 time.monotonic() - t0)
         self._apply(resp)
         if not resp.get("ok", False):
@@ -415,6 +435,15 @@ class RemoteEngine:
         return bool(resp.get("cancelled"))
 
     # -- cluster extras -------------------------------------------------
+    def telemetry(self, deadline: Optional[float] = None) -> dict:
+        """Scrape the worker's telemetry: its trace-buffer drain
+        (+ the cumulative drain/drop counters the merger's loss
+        detection needs), its clock, and a registry snapshot. A
+        retried scrape returns the worker's cached response blob
+        (resend dedup), never a second drain."""
+        resp = self._call("telemetry", deadline=deadline)
+        return resp.get("telemetry") or {}
+
     def remote_audit(self) -> List[str]:
         """Run the engine/page leak audits inside the worker (the
         mirror can't see device pools) and return the violations."""
@@ -529,10 +558,25 @@ class ClusterSupervisor:
                  router_kwargs: Optional[Dict[str, Any]] = None,
                  client_kwargs: Optional[Dict[str, Any]] = None,
                  dump_on_death: bool = True,
-                 spawn_timeout_s: float = 120.0):
+                 spawn_timeout_s: float = 120.0,
+                 telemetry=None, scrape_interval: int = 1,
+                 spill_dir: Optional[str] = None,
+                 spill_every: int = 8):
         if n_workers < 1:
             raise ValueError(f"n_workers must be >= 1, got {n_workers}")
         self.spec = dict(spec)
+        # workers spill their flight ring here (flight_<pid>.json) so
+        # a SIGKILL still leaves a post-mortem the death dump attaches
+        self._spill_dir = spill_dir or tempfile.gettempdir()
+        self.spec.setdefault("spill_dir", self._spill_dir)
+        self.spec.setdefault("spill_every", int(spill_every))
+        # observability.ClusterTelemetry (optional): the supervisor
+        # scrapes every worker's telemetry RPC each `scrape_interval`
+        # polls (and on death-reap) and feeds the merger
+        self.telemetry = telemetry
+        self.scrape_interval = int(scrape_interval)
+        self._polls = 0
+        self._host_buffer: Optional[TraceBuffer] = None
         self.n_workers = int(n_workers)
         self.max_respawns = int(max_respawns)
         self.respawn = bool(respawn)
@@ -555,6 +599,14 @@ class ClusterSupervisor:
                          "virtual_clock":
                              bool(self.spec.get("virtual_clock"))}
         self._time_fn: Callable[[], float] = time.monotonic
+        if self.telemetry is not None:
+            self.telemetry.add_host_registry(self.registry,
+                                             name="router")
+            # router/dispatch spans land here; the lambda tracks
+            # whatever clock the current episode installed
+            self._host_buffer = TraceBuffer(
+                time_fn=lambda: self._time_fn())
+            install_trace_buffer(self._host_buffer)
         reg = self.registry
         self._m_alive = reg.gauge(
             "ptpu_cluster_worker_alive",
@@ -676,6 +728,14 @@ class ClusterSupervisor:
         if auditor is not None:
             self.auditor = auditor
         self.respawns_used = 0
+        self._polls = 0
+        if self.telemetry is not None:
+            # the tier-1 suite runs many supervisors in ONE process:
+            # re-claim the global buffer in case a later supervisor
+            # installed its own, and start the episode's merge clean
+            install_trace_buffer(self._host_buffer)
+            self._host_buffer.drain()       # stale pre-episode spans
+            self.telemetry.begin_episode()
         for slot in self._slots:
             if not self._reset_slot(slot):
                 self._hard_respawn(slot)
@@ -689,6 +749,11 @@ class ClusterSupervisor:
             client.reset(self._episode["engine"],
                          donate=self._episode["donate"],
                          virtual_clock=self._episode["virtual_clock"])
+            if self.telemetry is not None and slot.pid is not None:
+                # reset installs a FRESH worker trace buffer (counters
+                # restart at 0) — rebaseline so the next scrape isn't
+                # mistaken for a replayed blob
+                self.telemetry.rebaseline(slot.slot_label, slot.pid)
             return True
         except Exception:
             return False
@@ -719,6 +784,73 @@ class ClusterSupervisor:
             if rep is None or rep.state != DEAD or slot.reaped:
                 continue
             self._reap(slot)
+        if self.telemetry is not None and self.scrape_interval > 0:
+            self._polls += 1
+            if self._polls % self.scrape_interval == 0:
+                self.scrape_all()
+
+    # -- telemetry scrape -----------------------------------------------
+    def scrape_all(self) -> None:
+        """One telemetry sweep: scrape every live worker's trace
+        buffer + registry snapshot into the merger, then drain the
+        host-side buffer (router/dispatch spans). A scrape that cannot
+        reach its worker is recorded as a LOSS in the merger — a
+        truncated timeline must be detectable, not silent."""
+        tel = self.telemetry
+        if tel is None:
+            return
+        for slot in self._slots:
+            client, rep = slot.client, slot.replica
+            if client is None or client._dead \
+                    or rep is None or not rep.live:
+                continue
+            try:
+                payload = client.telemetry()
+            except Exception:
+                tel.forget(slot.slot_label,
+                           client.worker_pid or slot.pid or 0)
+                continue
+            tel.ingest_worker(slot.slot_label, payload,
+                              host_now=self._time_fn())
+        if self._host_buffer is not None:
+            tel.ingest_host(self._host_buffer.drain(), proc="router")
+
+    def _death_scrape(self, slot: WorkerHandle) -> None:
+        """Last-chance scrape of a dead REPLICA whose process still
+        runs (cooperative kill, client-side partition): the old client
+        is done for, so a short-deadline fresh connection pulls the
+        final spans before the slot is fenced/reset."""
+        tel = self.telemetry
+        try:
+            if slot.client is not None:
+                slot.client.close()   # single-connection worker
+            tmp = RemoteEngine(
+                slot.host, slot.port, name=slot.slot_label,
+                engine_kw=self._episode["engine"],
+                time_fn=self._time_fn, registry=self.registry,
+                proc=slot.proc, call_deadline_s=5.0)
+            try:
+                payload = tmp.telemetry()
+                tel.ingest_worker(slot.slot_label, payload,
+                                  host_now=self._time_fn())
+            finally:
+                tmp.close()
+        except Exception:
+            tel.forget(slot.slot_label, slot.pid or 0,
+                       reason="death_scrape_failed")
+
+    def _load_victim_flight(self, slot: WorkerHandle) -> Optional[dict]:
+        """The dead worker's last flight-recorder spill, if any."""
+        if slot.pid is None:
+            return None
+        path = os.path.join(str(self.spec.get("spill_dir")
+                                or self._spill_dir),
+                            f"flight_{slot.pid}.json")
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except Exception:
+            return None
 
     def _reap(self, slot: WorkerHandle) -> None:
         slot.reaped = True
@@ -729,11 +861,22 @@ class ClusterSupervisor:
             replica=slot.replica.id if slot.replica else None,
             exited=exited,
             returncode=slot.proc.returncode if exited else None)
+        if self.telemetry is not None:
+            if exited:
+                # SIGKILL/crash: the un-scraped tail of its trace
+                # buffer died with the process — record the loss
+                self.telemetry.forget(slot.slot_label, slot.pid or 0,
+                                      reason="worker_died")
+            else:
+                self._death_scrape(slot)
         if self._dump_on_death:
             try:
+                victim = self._load_victim_flight(slot)
                 self.recorder.dump(
                     reason=f"cluster worker {slot.wid} dead",
-                    registry=self.registry)
+                    registry=self.registry,
+                    extra={"victim_flight": victim}
+                    if victim is not None else None)
             except Exception:
                 pass
         if self.router is None or getattr(self.router, "_closed",

@@ -1380,7 +1380,8 @@ class ServingEngine:
             bucket = bucket_for(n, self.min_bucket, self.max_len)
             self._m_prefill.labels(bucket=bucket).inc()
             with span("serving.prefill", request_id=request_id,
-                      slot=slot, bucket=bucket, prompt_len=n):
+                      slot=slot, bucket=bucket, prompt_len=n,
+                      replay=bool(req is not None and req.out_tokens)):
                 padded = np.zeros((1, bucket), np.int64)
                 padded[0, :n] = ids
                 if disagg:
@@ -1439,7 +1440,8 @@ class ServingEngine:
             self._m_prefill.labels(bucket=bucket).inc()
             with span("serving.prefill", request_id=request_id,
                       slot=slot, bucket=bucket, prompt_len=n,
-                      shared_prefix=start):
+                      shared_prefix=start,
+                      replay=bool(req.out_tokens)):
                 padded = np.zeros((1, bucket), np.int64)
                 padded[0, :tail] = ids[start:]
                 row = cache.page_table[slot]

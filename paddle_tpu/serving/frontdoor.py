@@ -156,6 +156,7 @@ class FrontDoor:
                  default_policy: Optional[TenantPolicy] = None,
                  tenants: Optional[Dict[str, TenantPolicy]] = None,
                  auditor=None, registry=None, flight_recorder=None,
+                 telemetry=None,
                  time_fn: Callable[[], float] = time.monotonic):
         self.backend = backend
         self.default_policy = default_policy or TenantPolicy()
@@ -164,6 +165,13 @@ class FrontDoor:
         self.now = time_fn
         self.registry = registry if registry is not None \
             else default_registry()
+        # observability.ClusterTelemetry (optional): when the backend
+        # is a cluster, /metrics serves the CLUSTER-merged exposition
+        # (workers + router + this registry) instead of host-only
+        self.telemetry = telemetry
+        if telemetry is not None:
+            telemetry.add_host_registry(self.registry,
+                                        name="frontdoor")
         self.recorder = flight_recorder if flight_recorder is not None \
             else default_recorder()
         self._handles: Dict[int, FrontDoorHandle] = {}
@@ -198,6 +206,16 @@ class FrontDoor:
         # and MID-prefill, after KV pages are claimed)
         if hasattr(backend, "cancel_probe"):
             backend.cancel_probe = self._client_gone
+
+    # -- metrics --------------------------------------------------------
+    def metrics_exposition(self) -> str:
+        """The text served from ``/metrics``: the cluster-merged
+        exposition when a :class:`ClusterTelemetry` is attached
+        (counters summed across workers, gauges worker-labeled,
+        histograms bucket-merged), else this process's registry."""
+        if self.telemetry is not None:
+            return self.telemetry.merged_prometheus()
+        return self.registry.to_prometheus()
 
     # -- admission -----------------------------------------------------
     def _policy(self, tenant: str) -> TenantPolicy:
@@ -456,7 +474,8 @@ class FrontDoorHTTPServer:
       to HTTP: 429 (rate limit / queues full), 503 (broken /
       no replicas / closed), 400 (validation).
     - ``GET /healthz`` — backend health (router replica states).
-    - ``GET /metrics`` — Prometheus text exposition.
+    - ``GET /metrics`` — Prometheus text exposition; cluster-merged
+      across workers when a ``ClusterTelemetry`` is attached.
     - ``DELETE /v1/requests/<rid>`` — cancel.
 
     One background thread runs the pump loop; handler threads only
@@ -500,7 +519,7 @@ class FrontDoorHTTPServer:
                         200 if ok else 503,
                         {"ok": ok, "replicas": health})
                 elif self.path == "/metrics":
-                    body = outer.front.registry.to_prometheus() \
+                    body = outer.front.metrics_exposition() \
                         .encode()
                     self.send_response(200)
                     self.send_header("Content-Type",

@@ -49,15 +49,42 @@ from __future__ import annotations
 
 from typing import Callable, Dict, List, Optional
 
-from ..observability import default_recorder, default_registry, span
+from ..observability import (TraceContext, default_recorder,
+                             default_registry, span)
 from ..resilience.faults import maybe_fail
 from .errors import (EngineClosed, NoHealthyReplicas, ReplicaDead,
                      RequestCancelled)
 from .scheduler import Request
 from .sampling import SamplingParams
 
-__all__ = ["Replica", "ReplicaRouter",
+__all__ = ["Replica", "ReplicaRouter", "death_kind",
            "HEALTHY", "SUSPECT", "DEAD", "RETIRED"]
+
+# free-text death reasons (which embed exception strings) normalized
+# to a bounded label set before they reach a metric label or span
+# attr — the registry's cardinality guard would otherwise trip on the
+# embedded message text. Order matters: the router-level
+# classification ("died mid-step: ...") wins over the wrapped
+# ReplicaDead message it embeds.
+_DEATH_KINDS = (
+    ("probe failures", "probe_failures"),
+    ("step failures", "step_failures"),
+    ("recover() failed", "recover_failed"),
+    ("died mid-step", "died_mid_step"),
+    ("died during drain", "died_during_drain"),
+    ("process gone", "process_gone"),
+    ("process exited", "process_exited"),
+    ("unreachable", "unreachable"),
+)
+
+
+def death_kind(reason: str) -> str:
+    """Normalize a free-text replica-death reason to a bounded set."""
+    r = str(reason)
+    for sub, kind in _DEATH_KINDS:
+        if sub in r:
+            return kind
+    return "other"
 
 HEALTHY = "healthy"    # probed clean: dispatchable
 SUSPECT = "suspect"    # failed probe(s): draining, no new dispatches
@@ -173,6 +200,10 @@ class ReplicaRouter:
         self._m_failover_req = reg.counter(
             "ptpu_router_failover_requests_total",
             "requests re-homed to a peer after a replica death")
+        self._m_deaths = reg.counter(
+            "ptpu_router_replica_deaths_total",
+            "replica deaths by normalized reason (death_kind)",
+            labels=("reason",))
         for rep in self.replicas:
             self._m_healthy.labels(replica=rep.id).set(1)
             self._m_inflight.labels(replica=rep.id).set(0)
@@ -209,8 +240,12 @@ class ReplicaRouter:
         req = target.engine._build_request(
             prompt_ids, max_new_tokens, sampling, deadline_s,
             rid=self._next_rid, tenant=tenant)
+        # mint the distributed trace BEFORE the dispatch RPC: the
+        # context rides the pickled request to the worker, and the
+        # dispatch span (ctx=) stamps it on the RPC frame too
+        req.trace = TraceContext.for_request(req.rid)
         with span("router.dispatch", request_id=req.rid,
-                  replica=target.id):
+                  replica=target.id, ctx=req.trace):
             target.engine.submit_request(req)
         self._next_rid += 1
         self._inflight[req.rid] = req
@@ -300,10 +335,12 @@ class ReplicaRouter:
         rep.alive = False
         self._m_healthy.labels(replica=rep.id).set(0)
         self._m_inflight.labels(replica=rep.id).set(0)
+        kind = death_kind(reason)
         self._m_failover.inc()
+        self._m_deaths.labels(reason=kind).inc()
         self.recorder.record("router.replica_dead", replica=rep.id,
                              reason=reason)
-        with span("router.failover", replica=rep.id):
+        with span("router.failover", replica=rep.id, reason=kind):
             self._failover(rep)
 
     def _failover(self, rep: Replica) -> None:
@@ -334,7 +371,7 @@ class ReplicaRouter:
             if req.finished:
                 self._deliver(req, self._pending_out)
                 continue
-            peer = self._adopt_elsewhere(req)
+            peer = self._adopt_elsewhere(req, from_replica=rep.id)
             if peer is None:
                 req.finished, req.finish_reason = True, "cancelled"
                 req.error = RequestCancelled(
@@ -345,17 +382,27 @@ class ReplicaRouter:
                 self._owner[req.rid] = peer.id
                 self._m_failover_req.inc()
 
-    def _adopt_elsewhere(self, req: Request) -> Optional[Replica]:
+    def _adopt_elsewhere(self, req: Request,
+                         from_replica: Optional[str] = None
+                         ) -> Optional[Replica]:
         cands = sorted((r for r in self.replicas if r.live),
                        key=lambda r: (r.state != HEALTHY, r.load(),
                                       r.id))
-        for rep in cands:
-            try:
-                rep.engine.adopt(req)
-                return rep
-            except Exception:
-                continue
-        return None
+        # the annotated failover span: in the merged timeline it sits
+        # on the router lane between the request's two worker lanes,
+        # and the chrome-trace flow arrows hang off it
+        with span("router.failover.rehome", request_id=req.rid,
+                  ctx=getattr(req, "trace", None),
+                  from_replica=from_replica) as sp:
+            for rep in cands:
+                try:
+                    rep.engine.adopt(req)
+                    sp.set_attr("to_replica", rep.id)
+                    return rep
+                except Exception:
+                    continue
+            sp.set_attr("to_replica", None)
+            return None
 
     # -- the serving loop ---------------------------------------------
     def step(self) -> List[Request]:
@@ -452,7 +499,7 @@ class ReplicaRouter:
         rep.state = SUSPECT
         self._m_healthy.labels(replica=rep.id).set(0)
         for req in rep.engine.scheduler.drain():
-            peer = self._adopt_elsewhere(req)
+            peer = self._adopt_elsewhere(req, from_replica=rep.id)
             if peer is not None:
                 self._owner[req.rid] = peer.id
             else:                      # nowhere to go: put it back

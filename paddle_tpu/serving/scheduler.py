@@ -73,6 +73,10 @@ class Request:
     # "disconnect"
     tenant: Optional[str] = None
     cancel_requested: bool = False
+    # distributed-tracing context (observability.TraceContext), minted
+    # at the router; rides the pickled request across submit/adopt/
+    # requeue RPCs so worker-side spans join the request's trace
+    trace: Optional[object] = None
     _rng: Optional[np.random.RandomState] = None
 
     @property

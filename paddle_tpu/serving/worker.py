@@ -77,6 +77,7 @@ class WorkerServer:
         self._model = self._build_model(spec)
         self.engine = None
         self._reqs: Dict[int, Any] = {}
+        self._trace_buf = None
         self._make_engine(spec.get("engine") or {},
                           donate=bool(spec.get("donate")))
 
@@ -99,14 +100,36 @@ class WorkerServer:
 
     def _make_engine(self, engine_kw: Dict[str, Any],
                      donate: bool = False) -> None:
-        from ..observability import FlightRecorder, MetricRegistry
+        from ..observability import (FlightRecorder, MetricRegistry,
+                                     TraceBuffer, clear_bindings,
+                                     install_trace_buffer)
         from ..resilience import faults
         from .engine import ServingEngine
         faults.clear()           # episode hygiene: no armed leftovers
+        clear_bindings()
+        # fresh buffer per engine incarnation: counters restart at 0,
+        # which the host-side merger treats as a rebaseline (the
+        # supervisor calls telemetry.rebaseline after each reset)
+        self._trace_buf = TraceBuffer(
+            capacity=int(self.spec.get("trace_capacity", 2048)),
+            time_fn=self._now)
+        install_trace_buffer(self._trace_buf)
+        # flight ring spills to <spill_dir>/flight_<pid>.json every
+        # spill_every records (and on SIGTERM), so even a SIGKILLed
+        # worker leaves its last records for the supervisor's death
+        # dump to attach
+        spill_dir = self.spec.get("spill_dir")
+        spill_path = os.path.join(
+            str(spill_dir), f"flight_{os.getpid()}.json") \
+            if spill_dir else None
         self.engine = ServingEngine(
             self._model, time_fn=self._now,
             registry=MetricRegistry(),
-            flight_recorder=FlightRecorder(capacity=64), **engine_kw)
+            flight_recorder=FlightRecorder(
+                capacity=64, time_fn=self._now,
+                spill_path=spill_path,
+                spill_every=int(self.spec.get("spill_every", 8))),
+            **engine_kw)
         if donate:
             # chaos: a step failure invalidates the cache pools, so
             # recover()/failover paths are exercised for real
@@ -154,9 +177,22 @@ class WorkerServer:
 
     def _prune(self) -> None:
         # terminal requests were reported (and the blob is cached for
-        # a resend) — drop them so updates stay O(in-flight)
+        # a resend) — drop them so updates stay O(in-flight); their
+        # trace bindings go with them (bounded binding table)
+        from ..observability import unbind_request
+        for rid, r in self._reqs.items():
+            if r.finished:
+                unbind_request(rid)
         self._reqs = {rid: r for rid, r in self._reqs.items()
                       if not r.finished}
+
+    @staticmethod
+    def _bind_trace(req) -> None:
+        # the router minted req.trace before the dispatch RPC; bind
+        # rid → context so engine spans (which only carry request_id)
+        # join the request's distributed trace
+        from ..observability import bind_request
+        bind_request(req.rid, getattr(req, "trace", None))
 
     def _mark_cancels(self, msg: dict) -> None:
         # the client's FrontDoor flags disconnects on ITS Request
@@ -179,11 +215,13 @@ class WorkerServer:
                 return self._ok(pid=os.getpid(), health=health)
             if op == "submit":
                 req = msg["req"]
+                self._bind_trace(req)
                 eng.submit_request(req)
                 self._reqs[req.rid] = req
                 return self._ok()
             if op == "adopt":
                 req = msg["req"]
+                self._bind_trace(req)
                 eng.adopt(req)
                 self._reqs[req.rid] = req
                 return self._ok()
@@ -206,15 +244,31 @@ class WorkerServer:
                                 cancelled=bool(hit))
             if op == "unqueue":
                 # drain_replica: queued requests move to peers NOW
+                from ..observability import unbind_request
                 moved = eng.scheduler.drain()
                 for r in moved:
                     self._reqs.pop(r.rid, None)
+                    unbind_request(r.rid)
                 return self._ok(moved=[r.rid for r in moved])
             if op == "requeue":
                 req = msg["req"]
+                self._bind_trace(req)
                 eng.scheduler.requeue(req)
                 self._reqs[req.rid] = req
                 return self._ok()
+            if op == "telemetry":
+                buf = self._trace_buf
+                payload = {
+                    "pid": os.getpid(), "now": self._now(),
+                    "spans": buf.drain() if buf is not None else [],
+                    "drained_total":
+                        buf.drained_total if buf is not None else 0,
+                    "dropped_total":
+                        buf.dropped_total if buf is not None else 0,
+                    "recorded_total":
+                        buf.recorded_total if buf is not None else 0,
+                    "registry": eng.registry.to_json()}
+                return self._ok(telemetry=payload)
             if op == "audit":
                 from ..resilience.invariants import (
                     engine_leak_violations, page_leak_violations)
@@ -312,6 +366,19 @@ def main(argv=None) -> None:
                      is_master=False, world_size=1)
     spec = pickle.loads(store.get(f"{args.prefix}/spec", timeout=60.0))
     server = WorkerServer(spec, args.worker_id)
+
+    def _sigterm(_signum, _frame):
+        # graceful kill: spill the flight ring so the supervisor's
+        # death dump can attach it, then exit hard (the serve loop
+        # holds no state worth unwinding)
+        try:
+            rec = getattr(server.engine, "recorder", None)
+            if rec is not None:
+                rec.spill()
+        finally:
+            os._exit(0)
+
+    signal.signal(signal.SIGTERM, _sigterm)
 
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)

@@ -116,6 +116,9 @@ def test_benchmark_script_smoke(script, tmp_path):
     prom_path = tmp_path / "snapshot.prom"
     if script == "bench_serving_engine.py":
         env["PTPU_PROM_OUT"] = str(prom_path)
+    trace_path = tmp_path / "cluster_trace.json"
+    if "--cluster" in script:
+        env["PTPU_TRACE_OUT"] = str(trace_path)
     if script == "chaos_soak.py":
         env["PTPU_CHAOS_EPISODES"] = "6"    # smoke budget
     argv = script.split()
@@ -206,6 +209,27 @@ def test_benchmark_script_smoke(script, tmp_path):
         assert slo["failover_requests"] >= 1, slo
         assert slo["respawns"] >= 1, slo
         assert slo["rejected_noisy"] >= 1, slo
+        # ISSUE-13: the merged-timeline artifact + schema-guarded line
+        tlines = [l for l in r.stdout.splitlines()
+                  if l.startswith("TRACE_TIMELINE ")]
+        assert tlines, r.stdout
+        tt = json.loads(tlines[-1][len("TRACE_TIMELINE "):])
+        assert {"artifact", "spans", "lanes", "worker_pids",
+                "failover_flow_events", "scrape_losses",
+                "slo_requests", "merged_metric_lines"} <= set(tt), \
+            sorted(tt)
+        # spans from >= 2 distinct worker pids in ONE merged trace
+        assert len(set(tt["worker_pids"])) >= 2, tt
+        assert tt["spans"] > 0 and tt["slo_requests"] > 0, tt
+        assert tt["failover_flow_events"] >= 3, tt   # linked lanes
+        art = json.loads(trace_path.read_text())
+        evs = art["chrome_trace"]["traceEvents"]
+        span_pids = {e["pid"] for e in evs if e.get("ph") == "X"}
+        assert set(tt["worker_pids"]) <= span_pids, tt
+        assert len(span_pids & set(tt["worker_pids"])) >= 2
+        assert any(e.get("ph") == "s" for e in evs)   # flow start
+        assert art["slo_attribution"], "empty SLO attribution"
+        assert "# TYPE" in art["merged_metrics"]
     if script == "bench_serving_engine.py --tensor-parallel":
         tlines = [l for l in r.stdout.splitlines()
                   if l.startswith("TP_SERVING ")]

@@ -18,7 +18,8 @@ import pytest
 import paddle_tpu as paddle
 from paddle_tpu.distributed.store import get_lib
 from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
-from paddle_tpu.observability import FlightRecorder, MetricRegistry
+from paddle_tpu.observability import (ClusterTelemetry, FlightRecorder,
+                                      MetricRegistry)
 from paddle_tpu.resilience import faults
 from paddle_tpu.resilience.train_loop import RestartLimitExceeded
 from paddle_tpu.serving import ClusterSupervisor, ServingEngine
@@ -51,7 +52,9 @@ def cluster():
     sup = ClusterSupervisor(SPEC, n_workers=2, max_respawns=4,
                             registry=MetricRegistry(),
                             flight_recorder=FlightRecorder(capacity=16),
-                            dump_on_death=False)
+                            dump_on_death=False,
+                            telemetry=ClusterTelemetry(),
+                            scrape_interval=1)
     sup.start()
     yield sup
     sup.shutdown()
@@ -248,3 +251,136 @@ def test_framing_peer_close_mid_frame_raises():
             recv_msg(b)                  # EOF mid-frame: typed, no hang
     finally:
         b.close()
+
+
+# -- ISSUE-13: distributed tracing + cluster telemetry acceptance ------
+
+def test_merged_trace_after_real_sigkill(cluster, ref_model):
+    """THE acceptance artifact: a real SIGKILL + failover episode
+    yields ONE merged chrome-trace containing the router's lane and
+    engine spans from >= 2 distinct worker pids, with the re-homed
+    request's two worker lanes linked through the host-side
+    ``router.failover.rehome`` span (flow arrows in the trace)."""
+    from paddle_tpu.resilience.invariants import timeline_violations
+    rng = np.random.RandomState(23)
+    prompts = _prompts(rng, [9, 12, 10, 14])
+    router = cluster.new_episode(ENGINE_KW)
+    tel = cluster.telemetry
+    # let the victim decode a few steps first (its spans get scraped
+    # by the per-step poll), THEN die mid-decode: the merged trace
+    # holds the request's PRE-death lane on the old pid
+    cluster.workers[0].client.arm_fault("serving.step.decode",
+                                        times=1, after=3, kill=True)
+    victim_pid = cluster.workers[0].pid
+    reqs = [router.submit(p, 8) for p in prompts]
+    _drive(cluster, router)
+    cluster.scrape_all()
+    assert all(r.finish_reason == "length" for r in reqs)
+    assert cluster.workers[0].pid != victim_pid      # kill was real
+
+    spans = tel.aligned_spans()
+    all_pids = {int(s["pid"]) for s in spans}
+    worker_pids = {int(s["pid"]) for s in spans
+                   if s.get("proc") not in ("router", "frontdoor",
+                                            "supervisor")}
+    assert os.getpid() in all_pids           # the router's own lane
+    assert victim_pid in worker_pids         # pre-death spans survive
+    assert len(worker_pids) >= 2             # ... next to the peer's
+    rehomed = [s for s in spans
+               if s["name"] == "router.failover.rehome"
+               and s.get("attrs", {}).get("to_replica")]
+    assert rehomed                           # host-side, lossless
+    rids = {s["attrs"]["request_id"] for s in rehomed}
+    assert rids <= {r.rid for r in reqs}
+
+    ct = tel.chrome_trace()
+    flows = [e for e in ct["traceEvents"] if e.get("ph") in
+             ("s", "t", "f")]
+    assert flows                             # lanes ARE linked
+    flow_tids = {e["tid"] for e in flows}
+    assert flow_tids & rids                  # ... on the re-homed lane
+    # every flow id resolves to a start/step/end triple
+    by_id = {}
+    for e in flows:
+        by_id.setdefault(e["id"], set()).add(e["ph"])
+    assert all(phs == {"s", "t", "f"} for phs in by_id.values())
+    # the law: complete timeline per delivered request, or the loss
+    # (the victim's un-scraped dying step) explicitly DETECTED
+    assert timeline_violations(tel, reqs) == []
+
+
+def test_cluster_metrics_merge_is_sum_never_average(cluster):
+    """The cluster exposition is the SUM of the per-worker snapshots:
+    counters added, histograms merged bucket-by-bucket (never averaged
+    percentiles), gauges labeled by worker instead of collapsed."""
+    router = cluster.new_episode(ENGINE_KW)
+    tel = cluster.telemetry
+    rng = np.random.RandomState(31)
+    reqs = [router.submit(p, 4) for p in _prompts(rng, [5, 8, 6])]
+    _drive(cluster, router)
+    cluster.scrape_all()
+    assert all(r.finish_reason == "length" for r in reqs)
+
+    snaps = tel.worker_snapshots()
+    assert set(snaps) == {s.slot_label for s in cluster.workers}
+    merged = tel.merged_snapshot()
+
+    # counters: merged total == sum over workers, exactly
+    per_worker = [snaps[w]["metrics"].get("ptpu_serving_prefills_total")
+                  for w in snaps]
+    per_worker = [f for f in per_worker if f]
+    assert per_worker                        # the episode did prefills
+    want = sum(s["value"] for f in per_worker for s in f["samples"])
+    got_total = sum(
+        merged["ptpu_serving_prefills_total"]["samples"].values())
+    assert got_total == want
+    assert want > 0
+
+    # histograms: bucket counts added bucket-by-bucket
+    hists = [snaps[w]["metrics"].get("ptpu_serving_step_seconds")
+             for w in snaps]
+    hists = [f for f in hists if f]
+    assert hists
+    got = merged["ptpu_serving_step_seconds"]["samples"][()]
+    for le in got["buckets"]:
+        assert got["buckets"][le] == sum(
+            f["samples"][0]["buckets"][le] for f in hists)
+    assert got["count"] == sum(f["samples"][0]["count"] for f in hists)
+
+    # gauges: one sample per worker, disambiguated by a worker label
+    g = merged["ptpu_serving_queue_depth"]
+    assert g["label_names"][-1] == "worker"
+    workers_seen = {key[-1] for key in g["samples"]}
+    assert workers_seen == set(snaps)
+
+    # the rendered exposition agrees with the merged snapshot
+    text = tel.merged_prometheus()
+    assert "ptpu_serving_prefills_total" in text
+    assert 'worker="' in text
+
+
+def test_dropped_scrape_is_detected_not_truncated(cluster):
+    """A telemetry scrape that dies on the wire must surface as a
+    RECORDED loss — never a silently truncated timeline. (The armed
+    wire fault outlives the retry budget, so the scrape RPC fails for
+    real against a live worker.)"""
+    router = cluster.new_episode(ENGINE_KW)
+    tel = cluster.telemetry
+    rng = np.random.RandomState(37)
+    req = router.submit(_prompts(rng, [6])[0], 3)
+    _drive(cluster, router)
+    assert req.finish_reason == "length"
+    assert tel.scrape_losses() == []         # clean so far
+    faults.inject("cluster.rpc.send", times=8)   # > retry budget
+    cluster.scrape_all()
+    faults.clear()
+    losses = tel.scrape_losses()
+    assert losses and any(l["kind"] == "scrape_failed" for l in losses)
+    # detection degrades the law instead of inventing violations
+    from paddle_tpu.resilience.invariants import timeline_violations
+    assert timeline_violations(tel, [req]) == []
+    # the pool heals for the next test: dead-marked clients respawn
+    router = cluster.new_episode(ENGINE_KW)
+    req2 = router.submit(_prompts(rng, [5])[0], 2)
+    _drive(cluster, router)
+    assert req2.finish_reason == "length"

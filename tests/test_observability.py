@@ -703,7 +703,8 @@ def test_cluster_metric_families_and_death_dump(tmp_path):
                               max_position_embeddings=64),
          "engine": {"max_slots": 2, "max_len": 64, "min_bucket": 8}},
         n_workers=2, max_respawns=2, registry=reg,
-        flight_recorder=fr, dump_on_death=True)
+        flight_recorder=fr, dump_on_death=True,
+        spill_dir=str(tmp_path), spill_every=1)
     try:
         router = sup.start()
         reqs = [router.submit(np.arange(1, 6 + i), 3)
@@ -711,6 +712,7 @@ def test_cluster_metric_families_and_death_dump(tmp_path):
         while router.has_work():
             router.step()
             sup.poll()
+        victim_pid = sup.workers[0].pid
         os.kill(sup.workers[0].pid, signal.SIGKILL)   # a real death
         router.step()            # probe -> ReplicaDead -> failover
         sup.poll()               # reap: dump the post-mortem, respawn
@@ -746,3 +748,130 @@ def test_cluster_metric_families_and_death_dump(tmp_path):
     kinds = [r["kind"] for r in payload["records"]]
     assert "cluster.worker_dead" in kinds
     assert "ptpu_cluster_respawns_total" in payload["metrics"]["metrics"]
+    # ISSUE-13: the victim's own last flight spill rides the dump —
+    # the post-mortem shows what the WORKER saw, not just the host
+    victim = payload["victim_flight"]
+    assert victim["pid"] == victim_pid
+    assert victim["records"]            # it recorded engine steps
+
+
+# -- ISSUE-13: flight spill + label-cardinality normalizers ------------
+
+def test_flight_recorder_spill_file(tmp_path):
+    """The worker-side flight recorder spills its ring to a well-known
+    path every N records (atomic rename, failures swallowed) so a
+    SIGKILLed worker still leaves a post-mortem behind."""
+    p = tmp_path / f"flight_{os.getpid()}.json"
+    fr = FlightRecorder(capacity=8, spill_path=str(p), spill_every=2)
+    fr.record("a", i=1)
+    assert not p.exists()               # 1 record: not due yet
+    fr.record("b", i=2)
+    assert p.exists()                   # every 2nd record spills
+    payload = json.load(open(p))
+    assert payload["pid"] == os.getpid()
+    assert [r["kind"] for r in payload["records"]] == ["a", "b"]
+    fr.record("c", i=3)
+    fr.record("d", i=4)
+    payload = json.load(open(p))        # overwritten in place
+    assert [r["kind"] for r in payload["records"]] == \
+        ["a", "b", "c", "d"]
+    # no leftover temp files from the atomic rename
+    assert [f for f in os.listdir(tmp_path) if ".tmp." in f] == []
+    # an unwritable spill path must never take the engine down
+    fr2 = FlightRecorder(capacity=4, spill_path="/nonexistent/x.json",
+                         spill_every=1)
+    fr2.record("still", fine=True)
+    assert fr2.spill() is None
+    # explicit spill (the SIGTERM path) works without a cadence
+    fr3 = FlightRecorder(capacity=4, spill_path=str(tmp_path / "s.json"))
+    fr3.record("x")
+    assert fr3.spill() == str(tmp_path / "s.json")
+
+
+def test_rpc_op_label_cardinality_is_bounded():
+    """Every RPC latency sample goes through normalize_op: known ops
+    pass, anything else collapses to 'other' — a buggy or hostile op
+    string can never mint a new Prometheus label value."""
+    from paddle_tpu.serving.cluster import _RPC_OPS, normalize_op
+    assert "telemetry" in _RPC_OPS      # the scrape op is first-class
+    for op in _RPC_OPS:
+        assert normalize_op(op) == op
+    weird = ["", "probe2", "TELEMETRY", "step; DROP TABLE", "x" * 999,
+             None, 42]
+    assert {normalize_op(w) for w in weird} == {"other"}
+    # the full image is the closed set — bounded cardinality by law
+    assert {normalize_op(x) for x in
+            list(_RPC_OPS) + weird} == set(_RPC_OPS) | {"other"}
+
+
+def test_death_kind_label_cardinality_is_bounded():
+    """Failover reasons are free-form prose; the death counter label
+    must come from the closed death_kind vocabulary."""
+    from paddle_tpu.serving.router import _DEATH_KINDS, death_kind
+    vocab = {kind for _, kind in _DEATH_KINDS} | {"other"}
+    cases = {
+        "3 consecutive probe failures": "probe_failures",
+        "2 step failures": "step_failures",
+        "recover() failed: ConnectionError": "recover_failed",
+        "worker died mid-step (ConnectionError)": "died_mid_step",
+        "worker died during drain": "died_during_drain",
+        "process gone (pid 123)": "process_gone",
+        "process exited with rc=-9": "process_exited",
+        "peer unreachable": "unreachable",
+        "": "other",
+        "novel alien failure mode": "other",
+    }
+    for reason, want in cases.items():
+        got = death_kind(reason)
+        assert got == want, (reason, got, want)
+        assert got in vocab
+    assert death_kind(None) == "other"
+
+
+def test_frontdoor_metrics_is_cluster_merged_when_telemetry_attached():
+    """ISSUE-13: with a telemetry plane attached, the front door's
+    /metrics body is the CLUSTER exposition — host families pass
+    through, worker-only counters appear, worker gauges come back
+    labeled by worker — while a plain front door keeps serving its
+    own registry untouched."""
+    from paddle_tpu.observability import ClusterTelemetry
+    from paddle_tpu.serving import (FrontDoor, ReplicaRouter,
+                                    ServingEngine)
+
+    reg = MetricRegistry()
+    model = _tiny_llama()
+    eng = ServingEngine(model, max_slots=2, max_len=64, min_bucket=8,
+                        registry=reg,
+                        flight_recorder=FlightRecorder(capacity=4))
+    router = ReplicaRouter([eng], registry=reg,
+                           flight_recorder=FlightRecorder(capacity=4))
+    tel = ClusterTelemetry()
+    front = FrontDoor(router, registry=reg, telemetry=tel)
+    h = front.submit(np.arange(1, 6), 3)
+    front.run_until_idle()
+    assert h.req.finished
+
+    snap = {"ts": 0.0, "metrics": {
+        "ptpu_t_worker_only_total": {
+            "type": "counter", "help": "", "label_names": [],
+            "samples": [{"labels": {}, "value": 4.0}]},
+        "ptpu_t_worker_depth": {
+            "type": "gauge", "help": "", "label_names": [],
+            "samples": [{"labels": {}, "value": 2.0}]}}}
+    tel.ingest_worker("w0", {"pid": 999, "now": 0.0, "spans": [],
+                             "drained_total": 0, "dropped_total": 0,
+                             "recorded_total": 0, "registry": snap},
+                      host_now=0.0)
+
+    text = front.metrics_exposition()
+    _, samples = _parse_prom(text)
+    assert samples["ptpu_t_worker_only_total"] == 4.0
+    assert samples['ptpu_t_worker_depth{worker="w0"}'] == 2.0
+    # the host-side serving/frontdoor families ride the SAME body
+    assert "# TYPE ptpu_serving_step_seconds" in text
+    assert "ptpu_frontdoor_accepted_total" in text
+
+    # no telemetry attached: /metrics is the plain process registry
+    front2 = FrontDoor(ReplicaRouter([eng], registry=MetricRegistry()),
+                       registry=MetricRegistry())
+    assert "ptpu_t_worker_only_total" not in front2.metrics_exposition()

@@ -1,0 +1,439 @@
+"""Cluster telemetry plane (paddle_tpu/observability/timeline.py +
+tracing.py): the bounded per-process trace buffer and its loss
+accounting, request-scoped trace-context propagation into spans,
+scrape continuity (duplicate blobs, missed scrapes, deliberate
+rebaselines), counter-reset detection across worker incarnations
+(add, never subtract), the merged cluster exposition (counters
+summed, gauges worker-labeled, histograms bucket-merged — never
+averaged percentiles), the merged chrome trace with per-request
+lanes and failover flow links, SLO attribution, and the chaos
+trace-conservation law's loss-aware degradation. Pure host-side
+units: synthetic scrape payloads, no worker processes."""
+import json
+
+import pytest
+
+from paddle_tpu.observability import (ClusterTelemetry, MetricError,
+                                      MetricRegistry, Span,
+                                      TraceBuffer, TraceContext,
+                                      active_context, bind_request,
+                                      clear_bindings,
+                                      install_trace_buffer, span,
+                                      unbind_request)
+from paddle_tpu.resilience.invariants import timeline_violations
+
+
+@pytest.fixture(autouse=True)
+def _isolated_buffer():
+    """Each test gets a private installed buffer and clean bindings;
+    the previous (possibly None) buffer is restored afterwards."""
+    t = {"t": 0.0}
+    buf = TraceBuffer(capacity=64, time_fn=lambda: t["t"])
+    prev = install_trace_buffer(buf)
+    clear_bindings()
+    yield buf, t
+    clear_bindings()
+    install_trace_buffer(prev)
+
+
+# -- trace buffer ------------------------------------------------------
+
+def test_trace_buffer_bounded_with_loss_counters(_isolated_buffer):
+    buf = TraceBuffer(capacity=3, time_fn=lambda: 0.0)
+    for i in range(5):
+        buf.record({"name": f"s{i}", "t0": 0.0, "t1": 0.0})
+    assert len(buf) == 3
+    assert buf.recorded_total == 5
+    assert buf.dropped_total == 2            # oldest evicted, counted
+    spans = buf.drain()
+    assert [s["name"] for s in spans] == ["s2", "s3", "s4"]
+    assert buf.drained_total == 3
+    assert len(buf) == 0 and buf.drain() == []
+
+
+def test_span_records_context_and_attrs(_isolated_buffer):
+    buf, t = _isolated_buffer
+    ctx = TraceContext.for_request(7)
+    t["t"] = 1.0
+    with span("unit.work", request_id=7, ctx=ctx) as sp:
+        t["t"] = 3.0
+        sp.set_attr("tokens", 5)
+    (rec,) = buf.drain()
+    assert rec["name"] == "unit.work"
+    assert (rec["t0"], rec["t1"]) == (1.0, 3.0)
+    assert rec["trace"] == "req-7"
+    assert rec["attrs"] == {"request_id": 7, "tokens": 5}
+
+
+def test_span_context_via_binding_and_nesting(_isolated_buffer):
+    buf, _ = _isolated_buffer
+    bind_request(9, TraceContext.for_request(9))
+    with span("outer", request_id=9):
+        # nested span with NO explicit ids inherits the active context
+        assert active_context() is not None
+        with span("inner"):
+            pass
+    unbind_request(9)
+    inner, outer = buf.drain()
+    assert (inner["name"], outer["name"]) == ("inner", "outer")
+    assert inner["trace"] == outer["trace"] == "req-9"
+    assert active_context() is None
+
+
+def test_span_records_on_exception(_isolated_buffer):
+    buf, _ = _isolated_buffer
+    with pytest.raises(ValueError):
+        with span("unit.fails", request_id=1):
+            raise ValueError("boom")
+    (rec,) = buf.drain()
+    assert rec["error"] == "ValueError"
+
+
+def test_span_without_installed_buffer_is_harmless():
+    prev = install_trace_buffer(None)
+    try:
+        with span("unit.orphan", request_id=3):
+            pass                             # no buffer: no crash
+    finally:
+        install_trace_buffer(prev)
+
+
+# -- scrape continuity -------------------------------------------------
+
+def _payload(pid, spans, drained, dropped=0, now=0.0, registry=None):
+    return {"pid": pid, "now": now, "spans": spans,
+            "drained_total": drained, "dropped_total": dropped,
+            "recorded_total": drained + dropped,
+            "registry": registry or {"ts": 0.0, "metrics": {}}}
+
+
+def _span(name, t0, t1, pid, rid=None, **attrs):
+    rec = {"name": name, "t0": t0, "t1": t1, "pid": pid}
+    if rid is not None:
+        attrs["request_id"] = rid
+    if attrs:
+        rec["attrs"] = dict(attrs)
+    if rid is not None:
+        rec["trace"] = f"req-{rid}"
+    return rec
+
+
+def test_resent_scrape_blob_is_not_double_ingested():
+    tel = ClusterTelemetry()
+    p = _payload(100, [_span("serving.step", 0, 1, 100)], drained=1)
+    assert tel.ingest_worker("w0", p, host_now=0.0) is True
+    assert tel.ingest_worker("w0", p, host_now=0.0) is False
+    assert len(tel.spans) == 1
+    assert tel.scrape_losses() == []
+
+
+def test_missed_scrape_is_a_recorded_loss():
+    tel = ClusterTelemetry()
+    tel.ingest_worker("w0", _payload(
+        100, [_span("a", 0, 1, 100)], drained=1), host_now=0.0)
+    # two drains happened worker-side but only this one arrived:
+    # drained_total jumped 1 -> 5 while carrying 2 spans
+    tel.ingest_worker("w0", _payload(
+        100, [_span("b", 2, 3, 100), _span("c", 3, 4, 100)],
+        drained=5), host_now=0.0)
+    (loss,) = tel.scrape_losses()
+    assert loss["kind"] == "missed_scrape"
+    assert loss["lost_spans"] == 2           # 5 - 2 seen before != 1
+
+
+def test_buffer_overflow_is_a_recorded_loss():
+    tel = ClusterTelemetry()
+    tel.ingest_worker("w0", _payload(
+        100, [_span("a", 0, 1, 100)], drained=1, dropped=3),
+        host_now=0.0)
+    (loss,) = tel.scrape_losses()
+    assert loss["kind"] == "overflow" and loss["lost_spans"] == 3
+
+
+def test_rebaseline_forgives_a_fresh_buffer_without_loss():
+    tel = ClusterTelemetry()
+    tel.ingest_worker("w0", _payload(
+        100, [_span("a", 0, 1, 100)], drained=4), host_now=0.0)
+    assert len(tel.scrape_losses()) == 1     # lost-first-scrape: real
+    tel.rebaseline("w0", 100)                # deliberate engine reset
+    assert tel.ingest_worker("w0", _payload(
+        100, [_span("b", 2, 3, 100)], drained=1), host_now=0.0)
+    assert len(tel.scrape_losses()) == 1     # no NEW loss for restart
+    assert [s["name"] for s in tel.spans] == ["a", "b"]
+
+
+def test_forget_records_the_loss():
+    tel = ClusterTelemetry()
+    tel.forget("w1", 200, reason="death_scrape_failed")
+    (loss,) = tel.scrape_losses()
+    assert loss == {"worker": "w1", "pid": 200,
+                    "kind": "death_scrape_failed"}
+
+
+def test_begin_episode_clears_state_but_keeps_host_registries():
+    tel = ClusterTelemetry()
+    reg = MetricRegistry()
+    reg.counter("ptpu_tl_host_total", "h").inc()
+    tel.add_host_registry(reg, name="router")
+    tel.ingest_worker("w0", _payload(
+        100, [_span("a", 0, 1, 100)], drained=1), host_now=0.0)
+    tel.begin_episode()
+    assert tel.spans == [] and tel.scrape_losses() == []
+    assert "ptpu_tl_host_total" in tel.merged_snapshot()
+    with pytest.raises(MetricError):         # name stays reserved
+        tel.add_host_registry(MetricRegistry(), name="router")
+
+
+# -- counter-reset detection (worker incarnations) ---------------------
+
+def _reg_snap(counter=None, gauge=None, hist=None):
+    m = {}
+    if counter is not None:
+        m["ptpu_tl_ops_total"] = {
+            "type": "counter", "help": "", "label_names": [],
+            "samples": [{"labels": {}, "value": counter}]}
+    if gauge is not None:
+        m["ptpu_tl_depth"] = {
+            "type": "gauge", "help": "", "label_names": [],
+            "samples": [{"labels": {}, "value": gauge}]}
+    if hist is not None:
+        buckets, total = hist
+        m["ptpu_tl_lat_seconds"] = {
+            "type": "histogram", "help": "", "label_names": [],
+            "samples": [{"labels": {}, "buckets": dict(buckets),
+                         "sum": float(total), "count":
+                             int(buckets["+Inf"])}]}
+    return {"ts": 0.0, "metrics": m}
+
+
+def test_counter_reset_adds_never_subtracts():
+    """A respawned worker restarts its counters from zero; the merged
+    view must treat the drop as a new incarnation and ADD, so the
+    cluster total never goes backwards."""
+    tel = ClusterTelemetry()
+    tel.ingest_worker("w0", _payload(
+        100, [], 1, registry=_reg_snap(counter=10.0)), host_now=0.0)
+    assert tel.merged_snapshot()["ptpu_tl_ops_total"]["samples"][()] \
+        == 10.0
+    # same incarnation, monotone growth: effective value tracks it
+    tel.ingest_worker("w0", _payload(
+        100, [], 2, registry=_reg_snap(counter=14.0)), host_now=0.0)
+    assert tel.merged_snapshot()["ptpu_tl_ops_total"]["samples"][()] \
+        == 14.0
+    # respawn: pid changes, counter restarts at 3 -> 14 + 3, not 3
+    tel.rebaseline("w0", 100)
+    tel.ingest_worker("w0", _payload(
+        101, [], 1, registry=_reg_snap(counter=3.0)), host_now=0.0)
+    assert tel.merged_snapshot()["ptpu_tl_ops_total"]["samples"][()] \
+        == 17.0
+
+
+def test_histogram_reset_merges_bucketwise():
+    tel = ClusterTelemetry()
+    tel.ingest_worker("w0", _payload(
+        100, [], 1,
+        registry=_reg_snap(hist=({"0.1": 2, "+Inf": 4}, 1.0))),
+        host_now=0.0)
+    tel.rebaseline("w0", 100)
+    tel.ingest_worker("w0", _payload(
+        101, [], 1,
+        registry=_reg_snap(hist=({"0.1": 1, "+Inf": 1}, 0.05))),
+        host_now=0.0)
+    s = tel.merged_snapshot()["ptpu_tl_lat_seconds"]["samples"][()]
+    assert s["buckets"] == {"0.1": 3, "+Inf": 5}
+    assert s["count"] == 5 and abs(s["sum"] - 1.05) < 1e-9
+
+
+# -- merged exposition guards ------------------------------------------
+
+def test_worker_gauges_are_labeled_counters_summed():
+    tel = ClusterTelemetry()
+    tel.ingest_worker("w0", _payload(
+        100, [], 1, registry=_reg_snap(counter=2.0, gauge=5.0)),
+        host_now=0.0)
+    tel.ingest_worker("w1", _payload(
+        200, [], 1, registry=_reg_snap(counter=3.0, gauge=7.0)),
+        host_now=0.0)
+    fams = tel.merged_snapshot()
+    assert fams["ptpu_tl_ops_total"]["samples"][()] == 5.0
+    g = fams["ptpu_tl_depth"]
+    assert g["label_names"] == ("worker",)
+    assert g["samples"] == {("w0",): 5.0, ("w1",): 7.0}
+    text = tel.merged_prometheus()
+    assert "ptpu_tl_ops_total 5" in text
+    assert 'ptpu_tl_depth{worker="w0"} 5' in text
+    assert 'ptpu_tl_depth{worker="w1"} 7' in text
+
+
+def test_merge_guards_refuse_silent_corruption():
+    # a worker gauge that already declares 'worker' would collide
+    tel = ClusterTelemetry()
+    snap = {"ts": 0.0, "metrics": {"ptpu_tl_g": {
+        "type": "gauge", "help": "", "label_names": ["worker"],
+        "samples": [{"labels": {"worker": "x"}, "value": 1.0}]}}}
+    tel.ingest_worker("w0", _payload(100, [], 1, registry=snap),
+                      host_now=0.0)
+    with pytest.raises(MetricError, match="worker"):
+        tel.merged_snapshot()
+    # type conflict across processes
+    tel2 = ClusterTelemetry()
+    tel2.ingest_worker("w0", _payload(
+        100, [], 1, registry=_reg_snap(counter=1.0)), host_now=0.0)
+    bad = {"ts": 0.0, "metrics": {"ptpu_tl_ops_total": {
+        "type": "gauge", "help": "", "label_names": [],
+        "samples": [{"labels": {}, "value": 1.0}]}}}
+    tel2.ingest_worker("w1", _payload(200, [], 1, registry=bad),
+                       host_now=0.0)
+    with pytest.raises(MetricError, match="type conflict"):
+        tel2.merged_snapshot()
+    # histogram bucket-schema mismatch: refuse, never lossy-merge
+    tel3 = ClusterTelemetry()
+    tel3.ingest_worker("w0", _payload(
+        100, [], 1,
+        registry=_reg_snap(hist=({"0.1": 1, "+Inf": 1}, 0.1))),
+        host_now=0.0)
+    tel3.ingest_worker("w1", _payload(
+        200, [], 1,
+        registry=_reg_snap(hist=({"0.5": 1, "+Inf": 1}, 0.1))),
+        host_now=0.0)
+    with pytest.raises(MetricError, match="bucket"):
+        tel3.merged_snapshot()
+
+
+# -- merged chrome trace -----------------------------------------------
+
+def _failover_fixture():
+    """Router + two workers; request 5 starts on pid 100, the router
+    re-homes it, it finishes on pid 200."""
+    tel = ClusterTelemetry()
+    tel.ingest_host([
+        _span("router.dispatch", 0.0, 0.1, 1, rid=5, replica="w0"),
+        _span("router.failover.rehome", 2.0, 2.1, 1, rid=5,
+              from_replica="w0", to_replica="w1"),
+    ], proc="router")
+    tel.ingest_worker("w0", _payload(100, [
+        _span("serving.prefill", 0.2, 0.5, 100, rid=5, replay=False),
+        _span("serving.decode", 0.5, 1.0, 100,
+              request_ids=[5, 6]),
+    ], drained=2), host_now=0.0)
+    tel.ingest_worker("w1", _payload(200, [
+        _span("serving.prefill", 2.2, 2.6, 200, rid=5, replay=True),
+        _span("serving.decode", 2.6, 3.0, 200, request_ids=[5]),
+    ], drained=2), host_now=0.0)
+    return tel
+
+
+def test_chrome_trace_lanes_fanout_and_failover_links():
+    tel = _failover_fixture()
+    ct = tel.chrome_trace()
+    evs = ct["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    # the batch decode span fans out into BOTH request lanes
+    w0_decode = [e for e in xs if e["name"] == "serving.decode"
+                 and e["pid"] == 100]
+    assert {e["tid"] for e in w0_decode} == {5, 6}
+    # one lane per (pid, rid), named for the request
+    names = [e for e in evs if e["ph"] == "M"
+             and e["name"] == "thread_name"]
+    assert {(e["pid"], e["tid"]) for e in names} >= {
+        (1, 5), (100, 5), (100, 6), (200, 5)}
+    # failover flow: start on the dying lane, through the router's
+    # rehome span, finish on the adoptive worker's lane
+    flows = [e for e in evs if e["ph"] in ("s", "t", "f")]
+    assert [e["ph"] for e in flows] == ["s", "t", "f"]
+    assert [e["pid"] for e in flows] == [100, 1, 200]
+    assert all(e["tid"] == 5 for e in flows)
+    json.dumps(ct)                           # artifact-serializable
+
+
+def test_chrome_trace_applies_clock_offsets():
+    tel = ClusterTelemetry()
+    # worker clock says 10.0 while the host says 14.0: offset +4
+    p = _payload(100, [_span("serving.step", 9.0, 10.0, 100)],
+                 drained=1, now=10.0)
+    tel.ingest_worker("w0", p, host_now=14.0)
+    (s,) = tel.aligned_spans()
+    assert (s["t0"], s["t1"]) == (13.0, 14.0)
+
+
+# -- SLO attribution ---------------------------------------------------
+
+def test_slo_attribution_bills_replay_to_failover():
+    tel = _failover_fixture()
+    recs = {r["request_id"]: r for r in tel.slo_attribution()}
+    r5 = recs[5]
+    assert r5["trace_id"] == "req-5"
+    assert r5["failovers"] == 1
+    assert sorted(r5["workers"]) == ["w0", "w1"]
+    assert abs(r5["prefill_s"] - 0.3) < 1e-9      # first, real prefill
+    # the replay prefill (0.4) + rehome span (0.1) bill to failover
+    assert abs(r5["failover_replay_s"] - 0.5) < 1e-9
+    assert abs(r5["decode_s"] - 0.9) < 1e-9       # both decode spans
+    assert abs(r5["queue_s"] - 0.1) < 1e-9        # dispatch -> prefill
+    # request 6 only ever decoded: no prefill/failover attribution
+    assert recs[6]["failovers"] == 0
+    assert recs[6]["prefill_s"] == 0
+
+
+# -- the chaos trace-conservation law ----------------------------------
+
+class _Req:
+    def __init__(self, rid, out_tokens):
+        self.rid = rid
+        self.out_tokens = list(out_tokens)
+
+
+def test_timeline_law_passes_on_complete_failover_timeline():
+    tel = _failover_fixture()
+    assert timeline_violations(tel, [_Req(5, [1, 2, 3])]) == []
+
+
+def test_timeline_law_catches_missing_spans():
+    tel = _failover_fixture()
+    # a delivered request with NO spans at all: dispatch missing
+    v = timeline_violations(tel, [_Req(99, [1])])
+    assert any("router.dispatch" in m for m in v)
+    # spans from two worker pids but no rehome span linking them
+    tel2 = ClusterTelemetry()
+    tel2.ingest_host([_span("router.dispatch", 0, 0.1, 1, rid=4)],
+                     proc="router")
+    tel2.ingest_worker("w0", _payload(100, [
+        _span("serving.prefill", 0.2, 0.4, 100, rid=4)],
+        drained=1), host_now=0.0)
+    tel2.ingest_worker("w1", _payload(200, [
+        _span("serving.decode", 0.5, 0.9, 200, request_ids=[4]),
+        _span("serving.prefill", 0.4, 0.5, 200, rid=4, replay=True)],
+        drained=2), host_now=0.0)
+    v2 = timeline_violations(tel2, [_Req(4, [1, 2])])
+    assert any("rehome" in m for m in v2)
+
+
+def test_timeline_law_degrades_on_detected_loss_not_phantoms():
+    """Satellite pin: a DROPPED scrape must be detected and must
+    degrade the law to host-side checks — a known-truncated timeline
+    can neither fail the band with phantom violations nor silently
+    pass as complete."""
+    tel = ClusterTelemetry()
+    tel.ingest_host([_span("router.dispatch", 0, 0.1, 1, rid=8)],
+                    proc="router")
+    # the worker's only scrape arrives with a continuity gap: the
+    # prefill/decode spans for request 8 died with a dropped scrape
+    tel.ingest_worker("w0", _payload(
+        100, [_span("serving.step", 1.0, 1.1, 100)], drained=6),
+        host_now=0.0)
+    assert any(l["kind"] == "missed_scrape"
+               for l in tel.scrape_losses())
+    # worker-side checks are waived; the lossless host side is not
+    assert timeline_violations(tel, [_Req(8, [1, 2])]) == []
+    v = timeline_violations(tel, [_Req(9, [1, 2])])
+    assert v and all("router.dispatch" in m for m in v)
+    # same timeline WITHOUT the detected loss: worker checks fire
+    tel2 = ClusterTelemetry()
+    tel2.ingest_host([_span("router.dispatch", 0, 0.1, 1, rid=8)],
+                     proc="router")
+    tel2.ingest_worker("w0", _payload(
+        100, [_span("serving.step", 1.0, 1.1, 100)], drained=1),
+        host_now=0.0)
+    v2 = timeline_violations(tel2, [_Req(8, [1, 2])])
+    assert any("serving.prefill" in m for m in v2)
+    assert any("decode/verify" in m for m in v2)
