@@ -34,6 +34,15 @@ token identity and emits the schema-guarded ``SPEC_DECODE`` line
 rate, per-token latency percentiles) — the ISSUE-8 acceptance
 artifact, bars asserted in tests/test_benchmarks_smoke.py.
 
+``--chunked-prefill``: stall-free decode mode — a mixed trace (short
+requests decoding while long prompts arrive mid-stream) through the
+unchunked and ``prefill_chunk`` engines; the schema-guarded
+``CHUNKED_PREFILL`` line reports the max decode stall (the longest
+inter-token gap an in-flight short request saw) and p99 inter-token
+latency for both, with greedy token identity and the 1-decode-program
++ bounded-chunk-compile contract asserted — the ISSUE-14 tail-latency
+SLO artifact, bars in tests/test_benchmarks_smoke.py.
+
 ``--prefix-share``: paged-KV concurrency mode — production-chat-shaped
 traffic (N-way shared system prompts + short unique suffixes, burst
 submitted) against three engines holding the SAME KV-pool byte
@@ -458,6 +467,125 @@ def run_speculative(model, *, slots, max_len, min_bucket, page_size,
     if not identical:
         raise SystemExit(
             "speculative outputs diverged from the k=1 engine")
+
+
+def run_chunked_prefill(model, *, slots, max_len, min_bucket, chunk,
+                        page_size, short_lens, short_new, long_lens,
+                        long_new, seed=0):
+    """--chunked-prefill: mixed long-prompt / short-decode traffic
+    through the unchunked engine and the ``prefill_chunk`` engine.
+
+    The trace is step-indexed (identical on both engines): short
+    requests enter first and start decoding, then the long prompts
+    arrive mid-stream. Unchunked, the step that admits a long prompt
+    runs its WHOLE prefill inline and every in-flight decode stalls
+    behind it; chunked, no step carries more than ``chunk`` prefill
+    tokens, so the stall is bounded by one chunk. Both runs use the
+    virtual clock (compute measured wall, programs prewarmed), the
+    stall metric is the MAX inter-token gap across the short
+    requests, and greedy outputs must be token-identical — the
+    schema-guarded ``CHUNKED_PREFILL`` line is the ISSUE-14
+    acceptance artifact (>= 3x stall reduction, 1 decode program,
+    chunk compiles inside the prefill-bucket budget)."""
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving.scheduler import prefill_buckets
+
+    rng = np.random.RandomState(seed)
+    shorts = [rng.randint(1, 100, (L,)).astype(np.int64)
+              for L in short_lens]
+    longs = [rng.randint(1, 100, (L,)).astype(np.int64)
+             for L in long_lens]
+
+    def drive(**chunk_kw):
+        # prefix sharing OFF: the warm pass would otherwise register
+        # the long prompts in the prefix index and the measured phase
+        # would hit the cache instead of paying the prefill this mode
+        # exists to measure
+        eng = ServingEngine(model, max_slots=slots, max_len=max_len,
+                            min_bucket=min_bucket, page_size=page_size,
+                            prefix_sharing=False, **chunk_kw)
+        for p in shorts + longs:        # warm every program the trace
+            eng.submit(p, 2)            # needs (incl. chunk flavors)
+        while eng.has_work():
+            eng.step()
+        s_reqs = [eng.submit(p, short_new) for p in shorts]
+        l_reqs = []
+        clock = 0.0
+        seen = {id(r): (0, None) for r in s_reqs}   # (n_toks, t_last)
+        gaps = []
+        steps = 0
+        while eng.has_work():
+            if steps == 3:              # longs arrive mid-decode
+                l_reqs = [eng.submit(p, long_new) for p in longs]
+            w0 = time.perf_counter()
+            eng.step()
+            clock += time.perf_counter() - w0
+            steps += 1
+            for r in s_reqs:
+                n, t_last = seen[id(r)]
+                if len(r.out_tokens) > n:
+                    if t_last is not None:
+                        gaps.append(clock - t_last)
+                    seen[id(r)] = (len(r.out_tokens), clock)
+        outs = [r.output_ids for r in s_reqs + l_reqs]
+        return {"engine": eng, "outputs": outs, "steps": steps,
+                "gaps": gaps, "wall_s": clock}
+
+    base = drive()
+    ck = drive(prefill_chunk=chunk)
+    identical = ck["outputs"] == base["outputs"]
+    stall_base = max(base["gaps"]) if base["gaps"] else 0.0
+    stall_ck = max(ck["gaps"]) if ck["gaps"] else 0.0
+    reduction = stall_base / stall_ck if stall_ck > 0 else 0.0
+    budget = len(prefill_buckets(min_bucket, max_len))
+    chunk_traces = ck["engine"].trace_counts["chunk"]
+    summary = {
+        "chunk": chunk,
+        "requests_short": len(shorts),
+        "requests_long": len(longs),
+        "long_prompt_lens": [int(p.shape[0]) for p in longs],
+        "max_decode_stall_s_unchunked": round(stall_base, 6),
+        "max_decode_stall_s_chunked": round(stall_ck, 6),
+        "stall_reduction": round(reduction, 3),
+        "tok_latency_p99_s_unchunked":
+            round(float(np.percentile(base["gaps"], 99)), 6),
+        "tok_latency_p99_s_chunked":
+            round(float(np.percentile(ck["gaps"], 99)), 6),
+        "steps_unchunked": base["steps"],
+        "steps_chunked": ck["steps"],
+        "chunk_steps":
+            int(ck["engine"]._m_chunk_steps.value),
+        "token_identical": bool(identical),
+        "decode_compiles": ck["engine"].trace_counts["decode"],
+        "chunk_compiles": sum(chunk_traces.values()),
+        "chunk_compile_shapes": len(chunk_traces),
+        "chunk_compile_budget": budget,
+    }
+    print(json.dumps({
+        "metric": (
+            f"chunked prefill under mixed traffic ({len(shorts)} "
+            f"short decoders + {len(longs)} long prompts "
+            f"{summary['long_prompt_lens']} arriving mid-stream, "
+            f"chunk={chunk}, {slots} slots): max decode stall "
+            f"{stall_ck * 1e3:.2f} ms vs unchunked "
+            f"{stall_base * 1e3:.2f} ms ({reduction:.1f}x lower), "
+            f"p99 inter-token {summary['tok_latency_p99_s_chunked'] * 1e3:.2f} "
+            f"ms vs {summary['tok_latency_p99_s_unchunked'] * 1e3:.2f} ms, "
+            f"greedy token-identical={identical}, 1 decode program + "
+            f"{summary['chunk_compile_shapes']} chunk shapes (budget "
+            f"{budget}); baseline=unchunked engine on the same trace)"),
+        "value": round(reduction, 2),
+        "unit": "x stall reduction",
+        "vs_baseline": 1.0}))
+    print("CHUNKED_PREFILL " + json.dumps(summary))
+    if not identical:
+        raise SystemExit(
+            "chunked-prefill outputs diverged from the unchunked "
+            "engine")
+    if summary["decode_compiles"] != 1:
+        raise SystemExit(
+            f"decode compiled {summary['decode_compiles']}x under "
+            f"chunked prefill (contract: exactly 1)")
 
 
 def run_tensor_parallel(model, *, slots, max_len, min_bucket,
@@ -1030,6 +1158,31 @@ def main():
                  max_position_embeddings=256),
             n_workers=2, slots=4, max_len=64, min_bucket=8,
             n_clients=12, total_requests=36, max_new=6)
+        return
+
+    if "--chunked-prefill" in sys.argv:
+        # this mode carries its own model: the stall ratio under test
+        # is prefill-compute vs chunk-compute, so the model must be
+        # big enough that a full-length prefill dwarfs per-step
+        # dispatch overhead even on CPU
+        paddle.seed(0)
+        if on_tpu:
+            cp_cfg = cfg
+            cp = dict(slots=16, max_len=512, min_bucket=32, chunk=64,
+                      page_size=128, short_lens=(24, 48),
+                      short_new=64, long_lens=(420, 480), long_new=4)
+        else:
+            cp_cfg = LlamaConfig(vocab_size=128, hidden_size=256,
+                                 num_hidden_layers=4,
+                                 num_attention_heads=4,
+                                 intermediate_size=512,
+                                 max_position_embeddings=512)
+            cp = dict(slots=4, max_len=512, min_bucket=8, chunk=16,
+                      page_size=8, short_lens=(5, 7), short_new=48,
+                      long_lens=(420, 480), long_new=4)
+        cp_model = LlamaForCausalLM(cp_cfg)
+        cp_model.eval()
+        run_chunked_prefill(cp_model, **cp)
         return
 
     paddle.seed(0)

@@ -22,6 +22,10 @@ SCRIPTS = ["bench_resnet50.py", "bench_bert_dp.py", "bench_gpt_hybrid.py",
            "bench_serving_engine.py --prefix-share",
            # self-speculative decoding on the repetitive-suffix trace
            "bench_serving_engine.py --speculative",
+           # chunked prefill: bounded decode stalls under mixed
+           # long-prompt / short-decode traffic (token identity +
+           # the tail-latency SLO artifact)
+           "bench_serving_engine.py --chunked-prefill",
            # front-door closed-loop SLO (replica killed mid-run,
            # exactly-once ledger at the boundary)
            "bench_serving_engine.py --frontdoor",

@@ -103,16 +103,17 @@ def cache_attend(qr, kr, v, kc, vc, p, per_row: bool, wlen=None):
 
     Returns (out [B, t, H*D], kc', vc').
     """
-    if wlen is not None and not per_row:
-        # the scalar-pos path writes the whole block unconditionally;
-        # silently dropping wlen would break the verify write contract
-        raise ValueError(
-            "cache_attend: wlen requires per-row positions (the "
-            "speculative verify flavor); got a scalar pos")
     b, t, h, D = qr.shape
     kv = kr.shape[2]
     rep = h // kv
     Tmax = kc.shape[1]
+    if wlen is not None and not per_row:
+        # scalar-pos + wlen is the CHUNKED-PREFILL flavor (one row at
+        # one position, a real-token count gating the padded tail):
+        # broadcast the position and take the per-row masked-scatter
+        # path, which is bitwise-identical for the same positions
+        p = jnp.broadcast_to(jnp.asarray(p, jnp.int32), (b,))
+        per_row = True
     if per_row:
         if wlen is None:
             upd = lambda c, u, pi: jax.lax.dynamic_update_slice(

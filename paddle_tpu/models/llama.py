@@ -278,14 +278,16 @@ class LlamaAttention(Layer):
         out_dtype = getattr(x, "_data", x).dtype   # the MODEL dtype
 
         def _rope(q, k, p):
-            if per_row and wlen is not None:
-                # verify: p + t may run past the rope table for rows
-                # near their length cap — a clamped SLICE start would
-                # mis-rotate the real leading tokens, so gather per
-                # POSITION with a clip that only touches the masked
-                # tail (same fix as the paged extend path below)
+            if wlen is not None:
+                # verify / chunked prefill: p + t may run past the rope
+                # table for rows near their length cap — a clamped
+                # SLICE start would mis-rotate the real leading tokens,
+                # so gather per POSITION with a clip that only touches
+                # the masked tail (same fix as the paged extend path
+                # below). p is [b] (verify) or a scalar (chunk flavor).
+                pb = p[:, None] if getattr(p, "ndim", 0) >= 1 else p
                 idx = jnp.clip(
-                    p[:, None] + jnp.arange(t, dtype=jnp.int32)[None],
+                    pb + jnp.arange(t, dtype=jnp.int32)[None],
                     0, cos_full.shape[0] - 1)
                 cos, sin = cos_full[idx], sin_full[idx]    # [b, t, D/2]
             elif per_row:

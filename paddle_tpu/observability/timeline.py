@@ -16,8 +16,8 @@ those shards into the three cluster-level artifacts:
   ``router.failover.rehome`` span plus a flow arrow linking the
   request's two worker lanes through it.
 - **one SLO-attribution record per request** (``slo_attribution``):
-  queue / dispatch-RPC / prefill / decode / handoff / failover-replay
-  seconds, from the same spans.
+  queue / dispatch-RPC / prefill / chunked-prefill / decode /
+  handoff / failover-replay seconds, from the same spans.
 - **one Prometheus exposition** (``merged_prometheus``) served from
   the front door's ``/metrics``: counters summed across processes,
   gauges labeled ``worker=<label>`` (point-in-time values must stay
@@ -363,14 +363,17 @@ class ClusterTelemetry:
                 return [r for r in recs if r["name"] in names]
 
             prefills = named("serving.prefill")
-            replays = [r for r in prefills
+            chunks = named("serving.chunk_prefill")
+            replays = [r for r in prefills + chunks
                        if (r.get("attrs") or {}).get("replay")]
             first = [r for r in prefills if r not in replays]
+            chunk_first = [r for r in chunks if r not in replays]
             dispatch = named("router.dispatch")
             rehomes = named("router.failover.rehome")
             queue_s = 0.0
-            if first and dispatch:
-                queue_s = max(0.0, min(r["t0"] for r in first)
+            if (first or chunk_first) and dispatch:
+                queue_s = max(0.0, min(r["t0"] for r in
+                                       first + chunk_first)
                               - min(r["t1"] for r in dispatch))
             workers = sorted({str(r.get("proc")) for r in recs
                               if str(r.get("proc"))
@@ -381,6 +384,10 @@ class ClusterTelemetry:
                 "queue_s": queue_s,
                 "dispatch_rpc_s": sum(_dur(r) for r in dispatch),
                 "prefill_s": sum(_dur(r) for r in first),
+                # chunked prefill is its own SLO phase: the prompt's
+                # KV was written across several bounded chunk steps
+                # interleaved with other requests' decode
+                "chunked_prefill_s": sum(_dur(r) for r in chunk_first),
                 "decode_s": sum(_dur(r) for r in named(
                     "serving.decode", "serving.verify")),
                 "handoff_s": sum(_dur(r) for r in named(

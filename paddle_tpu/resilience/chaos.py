@@ -71,7 +71,7 @@ __all__ = ["FaultArm", "EpisodeResult", "ChaosStore",
 SERVING_SWEEP = ("serving.step.decode", "serving.decode.verify",
                  "serving.decode.sharded",
                  "serving.step.prefill", "serving.prefill.paged",
-                 "serving.kv.handoff")
+                 "serving.prefill.chunk", "serving.kv.handoff")
 FRONTDOOR_SWEEP = ("router.dispatch", "router.health_probe",
                    "frontdoor.stream_write",
                    "frontdoor.client_disconnect")
@@ -265,13 +265,24 @@ def run_serving_episode(seed: int, max_iters: int = 300,
         from ..distributed import ProcessMesh
         mesh_kw = {"mesh": ProcessMesh(np.arange(4), ["model"]),
                    "prefill_devices": 2}
+    # chunked prefill, drawn from a THIRD rng stream (same reason as
+    # the mesh flavor: every pre-chunk seed's fault schedule, mesh
+    # draw and workload stay bit-identical). Biased toward None so
+    # most of the historical seed universe keeps exercising the
+    # monolithic prefill path.
+    rng3 = np.random.RandomState(880000 + seed)
+    prefill_chunk = [None, None, None, 8, 16][int(rng3.randint(0, 5))]
+    chunk_kw = {} if prefill_chunk is None \
+        else {"prefill_chunk": prefill_chunk,
+              "admission_lookahead": int(rng3.randint(0, 3))}
     eng = ServingEngine(model, max_slots=max_slots, max_len=_MAX_LEN,
                         min_bucket=_MIN_BUCKET,
                         page_size=8, num_pages=num_pages,
                         time_fn=lambda: clock["t"],
                         registry=MetricRegistry(),
                         flight_recorder=FlightRecorder(capacity=8),
-                        auditor=ledger, **spec_kw, **mesh_kw)
+                        auditor=ledger, **spec_kw, **mesh_kw,
+                        **chunk_kw)
     if donate:
         eng._donate = lambda: (5, 6)
 
@@ -318,6 +329,14 @@ def run_serving_episode(seed: int, max_iters: int = 300,
         schedule.append(FaultArm("serving.kv.handoff",
                                  times=int(rng2.randint(1, 3)),
                                  after=int(rng2.randint(0, 6))))
+    # chunk-boundary kill arm, drawn from the rng3 stream that owns
+    # chunked-prefill sampling: fires between chunks of a PREFILLING
+    # request — slot leased, pages claimed, part of the prompt
+    # written — the unwind + requeue + re-chunk path is under fire
+    if prefill_chunk is not None and rng3.random() < 0.55:
+        schedule.append(FaultArm("serving.prefill.chunk",
+                                 times=int(rng3.randint(1, 3)),
+                                 after=int(rng3.randint(0, 6))))
     # shutdown chaos: half the episodes stop serving mid-trace and
     # drain() with the queue and slots still loaded — optionally with
     # one more decode fault armed right before the drain, the
@@ -431,6 +450,7 @@ def _serving_result(seed, violations, schedule, ledger, submitted,
                "spec_accepted_drafts": (
                    eng._spec["accepted_draft_tokens"]
                    if eng.speculative else 0),
+               "prefill_chunk": eng.prefill_chunk,
                "max_slots": eng.max_slots,
                "num_pages": eng.cache.num_pages,
                "prefix_hit_tokens": eng.cache.prefix_hit_tokens,

@@ -16,6 +16,7 @@ Wired-in points (see docs/RESILIENCE.md for the catalogue):
 ``serving.decode.sharded``   mesh engines, before the SHARDED program
 ``serving.step.prefill``     inside the (re-)prefill program driver
 ``serving.prefill.paged``    paged prefill, AFTER pages are claimed
+``serving.prefill.chunk``    between chunks of a chunked prefill
 ``serving.kv.handoff``       disaggregated prefill->decode KV handoff
 ``router.dispatch``          router submit, before replica binding
 ``router.health_probe``      inside the per-round replica probe
@@ -88,6 +89,11 @@ KNOWN_POINTS = (
     # mid-prefill on the PAGED cache: pages claimed, table row live,
     # prefill program not yet run — the abort path must return them
     "serving.prefill.paged",
+    # chunked prefill: between chunks of a PREFILLING request — slot
+    # leased, pages claimed, part of the prompt already written — the
+    # unwind must free the pages AND the slot lease and requeue the
+    # request (replay re-chunks token-identically)
+    "serving.prefill.chunk",
     # disaggregated prefill/decode: the KV span is computed on the
     # prefill group but NOT yet installed on the decode pool — the
     # abort path must unwind the half-handed-off request on BOTH

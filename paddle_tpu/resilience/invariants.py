@@ -291,6 +291,20 @@ def engine_leak_violations(engine) -> List[str]:
             out.append(
                 f"leaked draft-proposer state for rids {stale} "
                 f"(request gone, n-gram index still held)")
+    # chunked-prefill half of the law: a quiesced engine may hold no
+    # PREFILLING work — the chunk FIFO must be empty (every chunked
+    # admission either finished its final chunk or was unwound) and no
+    # per-request local KV buffers may survive (disaggregated chunk
+    # prefills stage them until the final-chunk handoff)
+    fifo = getattr(engine, "_chunk_fifo", None)
+    if fifo:
+        out.append(
+            f"leaked PREFILLING slots {list(fifo)} in the chunk FIFO "
+            f"(mid-prefill request neither finished nor unwound)")
+    local = getattr(engine, "_chunk_local", None)
+    if local:
+        out.append(
+            f"leaked chunk-local KV buffers for rids {sorted(local)}")
     return out
 
 

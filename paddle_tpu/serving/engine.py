@@ -144,7 +144,9 @@ class ServingEngine:
                  spec_ngram: int = 2,
                  spec_gate: bool = True,
                  mesh=None,
-                 prefill_devices: int = 0):
+                 prefill_devices: int = 0,
+                 prefill_chunk: Optional[int] = None,
+                 admission_lookahead: int = 0):
         self.adapter = _ModelAdapter(model)
         model.eval()
         self.max_slots = int(max_slots)
@@ -159,6 +161,31 @@ class ServingEngine:
                 f"max_queue must be >= 1 or None, got {max_queue}")
         self.max_queue = max_queue
         self.min_bucket = min(int(min_bucket), self.max_len)
+        # chunked prefill (docs/SERVING.md "Chunked prefill"): split
+        # every admitted prompt into `prefill_chunk`-token chunks and
+        # run at most ONE chunk per step alongside the decode program,
+        # so a long prompt can never stall in-flight decodes for its
+        # whole prefill. Power-of-2 and >= the bucket floor so every
+        # non-final chunk IS its own bucket (zero padding) and the
+        # chunk-program compile count stays O(log max_len).
+        self.prefill_chunk = None
+        if prefill_chunk is not None:
+            c = int(prefill_chunk)
+            if c < 1 or (c & (c - 1)):
+                raise ValueError(
+                    f"prefill_chunk must be a power of 2, got "
+                    f"{prefill_chunk}")
+            if bucket_for(c, self.min_bucket, self.max_len) != c:
+                raise ValueError(
+                    f"prefill_chunk {c} must be a prefill bucket "
+                    f"(>= the min_bucket floor and <= max_len "
+                    f"{self.max_len})")
+            self.prefill_chunk = c
+        if admission_lookahead < 0:
+            raise ValueError(
+                f"admission_lookahead must be >= 0, got "
+                f"{admission_lookahead}")
+        self.admission_lookahead = int(admission_lookahead)
         if kv_layout not in ("paged", "contiguous"):
             raise ValueError(
                 f"kv_layout must be 'paged' or 'contiguous', got "
@@ -226,6 +253,13 @@ class ServingEngine:
         # the prefill group but not yet installed on the decode pool —
         # the cross-group no-leak law audits this is empty at quiesce
         self._staged_handoffs = {}
+        # chunked-prefill state: PREFILLING slots in admission order
+        # (the head advances one chunk per step) and, on disaggregated
+        # engines, rid -> per-layer local KV buffers accumulating the
+        # chunks on the PREFILL group until the final-span handoff.
+        # Both are audited empty at quiesce (no-leak law).
+        self._chunk_fifo: List[int] = []
+        self._chunk_local = {}
         # name -> (source array, mesh-placed copy), per group:
         # re-placing every step would re-transfer params the model
         # still holds. Keyed by NAME with the source kept alive in the
@@ -255,6 +289,9 @@ class ServingEngine:
         self._extend_jit = None
         self._copy_jit = None
         self._install_jit = None
+        self._chunk_jit = None
+        self._chunk_local_jit = None
+        self._chunk_fin_jit = None
         self._next_rid = 0
         self._step_idx = 0
         # set when a step fails after donating the cache pools (device
@@ -286,7 +323,8 @@ class ServingEngine:
         # count contract (1 decode + O(log max_len) prefill buckets) is
         # asserted against these in tests
         self.trace_counts = {"decode": 0, "verify": 0, "prefill": {},
-                             "extend": {}, "copy": 0, "install": {}}
+                             "extend": {}, "copy": 0, "install": {},
+                             "chunk": {}}
         reg = self.registry
         self._m_queue_depth = reg.gauge(
             "ptpu_serving_queue_depth", "requests waiting for a slot")
@@ -317,6 +355,13 @@ class ServingEngine:
             "ptpu_serving_recover_replay_mismatch_total",
             "recovery re-prefills whose greedy replay token diverged "
             "from the already-delivered token")
+        if self.prefill_chunk is not None:
+            self._m_chunk_steps = reg.counter(
+                "ptpu_serving_chunk_steps_total",
+                "chunked-prefill chunk program runs")
+            self._m_chunk_depth = reg.gauge(
+                "ptpu_serving_chunk_queue_depth",
+                "PREFILLING requests mid-chunked-prefill")
         if self.paged:
             self._m_pages_free = reg.gauge(
                 "ptpu_serving_pages_free", "KV pages on the free list")
@@ -582,8 +627,13 @@ class ServingEngine:
         """Enqueue a pre-built Request (typed admission checks apply;
         ``submit()`` is ``submit_request(_build_request(...))``)."""
         self._check_admission()
+        # sampled BEFORE the request enters the queue: a request that
+        # arrives while other work is in flight may see its first
+        # token blocked behind prefills — the decode-stall histogram's
+        # population (docs/SERVING.md "Chunked prefill")
+        stalled = self.has_work()
         self.scheduler.add(req)
-        self.metrics.on_submit(req.rid)
+        self.metrics.on_submit(req.rid, stalled=stalled)
         self._m_queue_depth.set(self.scheduler.depth)
         if self.auditor is not None:
             self.auditor.on_submitted(req)
@@ -603,8 +653,10 @@ class ServingEngine:
         if self._broken:
             raise EngineBroken(self._broken)
         req.slot = None
+        req.prefill_pos = None
+        stalled = self.has_work()
         self.scheduler.add(req)
-        self.metrics.on_submit(req.rid)
+        self.metrics.on_submit(req.rid, stalled=stalled)
         self._m_queue_depth.set(self.scheduler.depth)
         return req
 
@@ -735,11 +787,30 @@ class ServingEngine:
             claim = lambda req: self.cache.try_reserve(
                 req, req.prompt,
                 req.prompt_len + req.max_new_tokens)
-        pairs = self.scheduler.admissions(self.cache.free_slots(),
-                                          claim=claim)
+        pairs = self.scheduler.admissions(
+            self.cache.free_slots(), claim=claim,
+            lookahead=self.admission_lookahead)
+        # per-step prefill token budget (chunked engines): one chunk's
+        # worth. Prompts that fit run the MONOLITHIC prefill program
+        # inside the budget (the degenerate case IS the unchunked
+        # path); longer prompts claim their slot/pages now and enter
+        # the PREFILLING fifo, advancing one chunk per step below —
+        # so no step ever runs more than `prefill_chunk` prefill
+        # tokens plus the one-token-per-slot decode.
+        chunk = self.prefill_chunk
+        budget = chunk
         for i, (slot, req) in enumerate(pairs):
             try:
-                self._prefill(slot, req)
+                if chunk is None:
+                    self._prefill(slot, req)
+                else:
+                    n_ids = req.prompt_len + max(
+                        0, len(req.out_tokens) - 1)
+                    if not self._chunk_fifo and n_ids <= budget:
+                        self._prefill(slot, req)
+                        budget -= n_ids
+                    else:
+                        self._begin_chunked(slot, req)
             except RequestCancelled as e:
                 # the client vanished while THIS request was being
                 # prefilled: the abort path already unwound its pages
@@ -764,10 +835,23 @@ class ServingEngine:
             admitted.append(req.rid)
             if req.finished:
                 self._evict(slot, req, finished)
+        # 1b) one chunk of PREFILLING work, if it fits what is left of
+        # the step's prefill budget — at most ONE chunk program run
+        # per step, interleaved with the decode below
+        if chunk is not None and self._chunk_fifo:
+            head = self.cache.slots[self._chunk_fifo[0]]
+            n_ids = head.prompt_len + max(0, len(head.out_tokens) - 1)
+            if min(chunk, n_ids - head.prefill_pos) <= budget:
+                self._chunk_step(finished)
+        if chunk is not None:
+            self._m_chunk_depth.set(len(self._chunk_fifo))
         # 2) one decode step over all occupied slots — the speculative
         # engine runs its widened k-token VERIFY program instead (same
-        # contract: ONE compiled program for any request mix)
-        active = self.cache.active_slots()
+        # contract: ONE compiled program for any request mix).
+        # PREFILLING slots (mid-chunked-prefill) hold no decodable
+        # token yet and are skipped until their final chunk.
+        active = [s for s in self.cache.active_slots()
+                  if self.cache.slots[s].prefill_pos is None]
         if active:
             if self.speculative:
                 self._decode_verify(active, finished)
@@ -991,6 +1075,10 @@ class ServingEngine:
 
     def _evict(self, slot: int, req: Request,
                finished: List[Request]) -> None:
+        # a PREFILLING request can reach a terminal state mid-chunked-
+        # prefill (deadline, disconnect, drain cutoff): drop its chunk
+        # bookkeeping so release() below is the whole cleanup
+        self._clear_chunk_state(slot, req)
         self.cache.release(slot)
         req.slot = None
         finished.append(req)
@@ -1091,6 +1179,7 @@ class ServingEngine:
             pass
         elif req.slot is not None \
                 and self.cache.slots[req.slot] is req:
+            self._clear_chunk_state(req.slot, req)
             self.cache.release(req.slot)
             req.slot = None
             self._m_evict.labels(reason=reason).inc()
@@ -1128,6 +1217,15 @@ class ServingEngine:
         reason = self._broken
         in_flight = [(s, r) for s, r in enumerate(self.cache.slots)
                      if r is not None]
+        # chunked-prefill state dies with the old pools: recovery
+        # re-prefills every in-flight request MONOLITHICALLY (the
+        # re-prefill program writes the whole span in one pass, which
+        # is the chunked path's degenerate case — token-identical);
+        # fresh admissions after recovery re-chunk normally
+        self._chunk_fifo.clear()
+        self._chunk_local.clear()
+        for _, r in in_flight:
+            r.prefill_pos = None
         if self.paged:
             # flush the dying pool's counter deltas, then re-baseline:
             # the fresh pool restarts its raw counters at zero and a
@@ -1486,6 +1584,225 @@ class ServingEngine:
             cache.abort_sequence(slot, req)
             raise
 
+    # -- chunked prefill ----------------------------------------------
+    @staticmethod
+    def _replay_ids(req: Request) -> np.ndarray:
+        """The token span a (re-)prefill writes: the prompt, plus all
+        but the last delivered token for adopted/replayed requests
+        (the last token is re-predicted by the final logits — the
+        recover() replay contract)."""
+        return req.prompt if len(req.out_tokens) <= 1 else \
+            np.concatenate([req.prompt,
+                            np.asarray(req.out_tokens[:-1], np.int64)])
+
+    def _begin_chunked(self, slot: int, req: Request) -> None:
+        """Claim a slot for a CHUNKED prefill without running any
+        compute: the request enters the PREFILLING state (slot leased,
+        pages placed, ``prefill_pos`` at the shared-prefix boundary)
+        and advances one chunk per step from the fifo head
+        (``_chunk_step``). Paged admission already committed the
+        worst-case page reservation at claim time, so chunking can
+        never run out of pages mid-prompt."""
+        self.metrics.on_first_prefill(req.rid)   # queue wait ends here
+        ids = self._replay_ids(req)
+        start = 0
+        if self.paged:
+            cache = self.cache
+            if req.rid not in cache._plans:
+                if not cache.try_reserve(req, ids,
+                                         req.prompt_len
+                                         + req.max_new_tokens):
+                    raise RuntimeError(
+                        f"request {req.rid}: page reservation failed "
+                        f"at chunked admission")
+            try:
+                cache.refresh_reservation(req, ids)
+                start, copies = cache.begin_sequence(slot, req, ids)
+                self._run_copies(copies)
+            except Exception:
+                # pages claimed but the slot never assigned: the
+                # standard abort path returns every claim, and the
+                # caller (_step_inner) requeues the request
+                cache.abort_sequence(slot, req)
+                raise
+        self.cache.assign(slot, req)
+        req.slot = slot
+        req.prefill_pos = int(start)
+        self._chunk_fifo.append(slot)
+        if self._params_pf is not None and \
+                (not self.paged or start == 0):
+            # disaggregated: chunks accumulate in local buffers on the
+            # PREFILL group; the final span hands off to the decode
+            # pool. Paged prefix-hit admissions (start > 0) instead
+            # chunk through the decode-group program, like extends —
+            # they attend over shared pages resident in that pool.
+            self._chunk_local[req.rid] = self._new_chunk_local()
+
+    def _new_chunk_local(self):
+        """Fresh per-layer [1, max_len] KV buffers on the prefill
+        group (zeros: never-written tails stay finite, and the causal
+        mask zeroes their softmax weight exactly)."""
+        ad = self.adapter
+        shape = (1, self.max_len, ad.kv_heads, ad.head_dim)
+        sh = self.meshctx.kv_sharding("prefill")
+        mk = lambda: [jax.device_put(jnp.zeros(shape, ad.dtype), sh)
+                      for _ in range(ad.num_layers)]
+        return mk(), mk()
+
+    def _chunk_step(self, finished: List[Request]) -> None:
+        """Advance the PREFILLING fifo head by one chunk: write chunk
+        tokens ``prefill_pos .. prefill_pos + t - 1`` into the slot's
+        KV (attending over everything already written — bitwise what
+        the monolithic prefill computed for the same positions), and
+        on the FINAL chunk sample the first token and enter decode."""
+        slot = self._chunk_fifo[0]
+        req = self.cache.slots[slot]
+        ids = self._replay_ids(req)
+        n = int(ids.shape[0])
+        pos = req.prefill_pos
+        t = min(self.prefill_chunk, n - pos)
+        final = pos + t >= n
+        try:
+            # mid-chunk fault point: slot leased, pages claimed, part
+            # of the prompt already written — the unwind below must
+            # free pages AND the lease and requeue (chaos-audited)
+            maybe_fail("serving.prefill.chunk", slot=slot, pos=pos,
+                       final=final)
+            if self._cancel_requested(req):
+                raise RequestCancelled(
+                    req.rid, "client disconnected mid-chunked-prefill")
+            bucket = bucket_for(t, self.min_bucket, self.max_len)
+            self._m_prefill.labels(bucket=bucket).inc()
+            with span("serving.chunk_prefill", request_id=req.rid,
+                      slot=slot, pos=pos, chunk=t, final=final,
+                      replay=bool(req.out_tokens)):
+                padded = np.zeros((1, bucket), np.int64)
+                padded[0, :t] = ids[pos:pos + t]
+                logits = self._run_chunk(slot, req, padded, pos, t,
+                                         final, ids)
+        except RequestCancelled as e:
+            self._unwind_chunk(slot, req, requeue=False)
+            self._finish_disconnect(req, exc=e, finished=finished)
+            return
+        except Exception:
+            self._unwind_chunk(slot, req, requeue=True)
+            raise
+        req.prefill_pos = pos + t
+        self._m_chunk_steps.inc()
+        if final:
+            self._finish_chunked(slot, req, ids, logits, finished)
+
+    def _run_chunk(self, slot: int, req: Request, padded, pos: int,
+                   t: int, final: bool, ids) -> np.ndarray:
+        """Run one chunk program in the layout/mesh-appropriate
+        flavor and return the host logits at the chunk's last real
+        token (only the FINAL chunk's logits are consumed)."""
+        if req.rid in self._chunk_local:
+            # disaggregated local-buffer mode (contiguous, or paged
+            # full prefill): compute on the prefill group; the final
+            # span ships through the _kv_handoff staging contract
+            logits = self._chunk_local_run(req, padded, pos, t)
+            if final:
+                if self.paged:
+                    self._chunk_finalize_handoff(slot, req,
+                                                 int(ids.shape[0]))
+                else:
+                    kb, vb = self._chunk_local[req.rid]
+                    self._kv_handoff(req, slot, (kb, vb))
+            return logits
+        cache = self.cache
+        if self.paged:
+            row = cache.page_table[slot]
+            logits, ks, vs, kss, vss = self._chunk_fn()(
+                self._params, self._buffers, padded,
+                np.int32(pos), np.int32(t), row.copy(),
+                cache.ks, cache.vs, cache.kss, cache.vss)
+            cache.ks, cache.vs = list(ks), list(vs)
+            cache.kss, cache.vss = list(kss), list(vss)
+        else:
+            logits, ks, vs = self._chunk_fn()(
+                self._params, self._buffers, padded,
+                np.int32(pos), np.int32(t), np.int32(slot),
+                cache.ks, cache.vs)
+            cache.ks, cache.vs = list(ks), list(vs)
+        return np.asarray(jax.device_get(logits))
+
+    def _chunk_local_run(self, req: Request, padded, pos: int,
+                         t: int) -> np.ndarray:
+        kb, vb = self._chunk_local[req.rid]
+        logits, kb2, vb2 = self._chunk_local_fn()(
+            self._params_pf, self._buffers_pf, padded,
+            np.int32(pos), np.int32(t), kb, vb)
+        self._chunk_local[req.rid] = (list(kb2), list(vb2))
+        return np.asarray(jax.device_get(logits))
+
+    def _chunk_finalize_handoff(self, slot: int, req: Request,
+                                n: int) -> None:
+        """Paged disaggregated final chunk: paginate (and int8-
+        quantize, when configured) the accumulated local buffers and
+        install them at the claimed page ids via the standard KV
+        handoff."""
+        cache = self.cache
+        bucket = bucket_for(n, self.min_bucket, self.max_len)
+        npg = (bucket + cache.page_size - 1) // cache.page_size
+        kb, vb = self._chunk_local[req.rid]
+        blocks = self._chunk_fin_fn(npg)(kb, vb)
+        row = cache.page_table[slot]
+        self._kv_handoff(req, slot, blocks,
+                         page_ids=row[:npg].copy())
+
+    def _finish_chunked(self, slot: int, req: Request, ids,
+                        logits: np.ndarray,
+                        finished: List[Request]) -> None:
+        """Final chunk done: leave the PREFILLING state and enter
+        decode (or, on a replay, verify the re-predicted token) —
+        exactly what the tail of the monolithic ``_prefill`` does."""
+        self._chunk_fifo.pop(0)
+        req.prefill_pos = None
+        self._chunk_local.pop(req.rid, None)
+        if self.paged:
+            self.cache.register_prefix(slot, ids)
+        if req.out_tokens:
+            if req.sampling.temperature <= 0 \
+                    and int(np.argmax(logits)) != req.out_tokens[-1]:
+                self._m_replay_mismatch.inc()
+            return
+        tok = sample_token(logits, req.sampling, req._rng)
+        req.out_tokens.append(tok)
+        self.metrics.on_token(req.rid)
+        if self._is_finished(req, tok):
+            self._evict(slot, req, finished)
+
+    def _clear_chunk_state(self, slot: int, req: Request) -> None:
+        """Drop a PREFILLING request's chunk bookkeeping (fifo entry,
+        local buffers, staged handoff) WITHOUT touching the cache —
+        the terminal paths (_evict, cancel) release the slot
+        themselves."""
+        if req.prefill_pos is None:
+            return
+        req.prefill_pos = None
+        if slot in self._chunk_fifo:
+            self._chunk_fifo.remove(slot)
+        self._chunk_local.pop(req.rid, None)
+        self._staged_handoffs.pop(req.rid, None)
+
+    def _unwind_chunk(self, slot: int, req: Request,
+                      requeue: bool) -> None:
+        """Unwind a PREFILLING slot after a mid-chunk fault or
+        cancel: chunk bookkeeping dies, the paged claims return via
+        the standard abort path, and the lease frees (abort_sequence
+        zeroed the table row and popped the plan, so release() has
+        nothing left to double-unref). ``requeue`` puts the request
+        back at the queue head — its replay re-chunks
+        token-identically."""
+        self._clear_chunk_state(slot, req)
+        if self.paged:
+            self.cache.abort_sequence(slot, req)
+        self.cache.release(slot)
+        req.slot = None
+        if requeue:
+            self.scheduler.requeue(req)
+
     def _run_copies(self, copies) -> None:
         """Run COW page copies on device (host-picked src/dst, one
         tiny compiled program reused for every copy)."""
@@ -1723,6 +2040,168 @@ class ServingEngine:
             **jit_kw)
         return self._extend_jit
 
+    def _chunk_fn(self):
+        """Chunked-prefill chunk program, one compile per chunk
+        bucket: write chunk tokens ``start .. start + true_len - 1``
+        into the slot's KV and attend over everything already written
+        — positions beyond each query are masked to EXACT zero
+        probability, so the outputs are bitwise what the monolithic
+        prefill computed for the same positions (the greedy-identity
+        argument, docs/SERVING.md "Chunked prefill"). Non-final
+        chunks are exactly ``prefill_chunk`` tokens — their own
+        bucket, zero padding; the final chunk's bucket padding is
+        write-masked by ``true_len`` (contiguous) or trash-redirected
+        (paged), the standard stale-tail story.
+
+        Paged flavor: the paged EXTEND machinery verbatim (page-table
+        writes at a mid-prompt start), counted under "chunk" so the
+        compile-budget pins see chunk programs separately. Contiguous
+        flavor: slice the slot row out of the pool, run the
+        write-masked static-cache path at a scalar start, splice the
+        row back."""
+        if self._chunk_jit is not None:
+            return self._chunk_jit
+        ad = self.adapter
+
+        if self.paged:
+            jit_kw = {}
+            if self.meshctx is not None:
+                psh, bsh, R, kv, sc = self._prog_shardings()
+                jit_kw = dict(
+                    in_shardings=(psh, bsh, R, R, R, R, kv, kv,
+                                  sc, sc),
+                    out_shardings=(R, kv, kv, sc, sc))
+
+            def pure(params, buffers, ids, start, true_len, row, ks,
+                     vs, kss, vss):
+                Lb = ids.shape[1]
+                self.trace_counts["chunk"][Lb] = \
+                    self.trace_counts["chunk"].get(Lb, 0) + 1
+                caches = self._paged_caches(ks, vs, kss, vss,
+                                            row[None, :], start)
+                with ad.model.bind_state(params, buffers):
+                    h, new_caches = ad.call(Tensor(ids), caches)
+                    h_last = jax.lax.dynamic_slice_in_dim(
+                        h._data, true_len - 1, 1, axis=1)
+                    logits = ad.head(Tensor(h_last))._data[0, -1]
+                return (logits,) + self._unpack_paged(new_caches)
+
+            self._chunk_jit = jax.jit(
+                pure, donate_argnums=self._donate_idx(6, 7, 8, 9),
+                **jit_kw)
+            return self._chunk_jit
+
+        jit_kw = {}
+        if self.meshctx is not None:
+            psh, bsh, R, kv, _ = self._prog_shardings()
+            jit_kw = dict(
+                in_shardings=(psh, bsh, R, R, R, R, kv, kv),
+                out_shardings=(R, kv, kv))
+
+        def pure(params, buffers, ids, start, true_len, slot, ks, vs):
+            Lb = ids.shape[1]
+            self.trace_counts["chunk"][Lb] = \
+                self.trace_counts["chunk"].get(Lb, 0) + 1
+            rows = lambda pool: jax.lax.dynamic_slice(
+                pool, (slot, 0, 0, 0), (1,) + pool.shape[1:])
+            wl = jnp.reshape(jnp.asarray(true_len, jnp.int32), (1,))
+            caches = [(rows(k), rows(v), start, wl)
+                      for k, v in zip(ks, vs)]
+            with ad.model.bind_state(params, buffers):
+                h, new_caches = ad.call(Tensor(ids), caches)
+                h_last = jax.lax.dynamic_slice_in_dim(
+                    h._data, true_len - 1, 1, axis=1)
+                logits = ad.head(Tensor(h_last))._data[0, -1]
+            splice = lambda pool, c: jax.lax.dynamic_update_slice(
+                pool, getattr(c, "_data", c).astype(pool.dtype),
+                (slot, 0, 0, 0))
+            ks = [splice(p, c[0]) for p, c in zip(ks, new_caches)]
+            vs = [splice(p, c[1]) for p, c in zip(vs, new_caches)]
+            return logits, ks, vs
+
+        self._chunk_jit = jax.jit(
+            pure, donate_argnums=self._donate_idx(6, 7), **jit_kw)
+        return self._chunk_jit
+
+    def _chunk_local_fn(self):
+        """Disaggregated chunk program on the PREFILL group: advance
+        one chunk through the request's local [1, max_len] contiguous
+        buffers (write-masked past ``true_len``); the final span
+        ships via ``_kv_handoff`` (contiguous) or the paged finalize
+        program. One compile per chunk bucket — the buffers are
+        always full-length, so the key space is the ids bucket
+        alone."""
+        if self._chunk_local_jit is not None:
+            return self._chunk_local_jit
+        ad = self.adapter
+
+        def pure(params, buffers, ids, start, true_len, kb, vb):
+            Lb = ids.shape[1]
+            key = ("local", Lb)
+            self.trace_counts["chunk"][key] = \
+                self.trace_counts["chunk"].get(key, 0) + 1
+            wl = jnp.reshape(jnp.asarray(true_len, jnp.int32), (1,))
+            caches = [(k, v, start, wl) for k, v in zip(kb, vb)]
+            with ad.model.bind_state(params, buffers):
+                h, new_caches = ad.call(Tensor(ids), caches)
+                h_last = jax.lax.dynamic_slice_in_dim(
+                    h._data, true_len - 1, 1, axis=1)
+                logits = ad.head(Tensor(h_last))._data[0, -1]
+            kb2 = [getattr(c[0], "_data", c[0]) for c in new_caches]
+            vb2 = [getattr(c[1], "_data", c[1]) for c in new_caches]
+            return logits, kb2, vb2
+
+        psh, bsh, R, kv, _ = self._prog_shardings("prefill")
+        self._chunk_local_jit = jax.jit(
+            pure, in_shardings=(psh, bsh, R, R, R, kv, kv),
+            out_shardings=(R, kv, kv),
+            donate_argnums=self._donate_idx(5, 6))
+        return self._chunk_local_jit
+
+    def _chunk_fin_fn(self, npg: int):
+        """Paged disaggregated finalize program, one compile per page
+        count: paginate the accumulated local buffers into the
+        request's ``npg`` page blocks (int8-quantized here on the
+        quantized path — every page is complete by now, so per-page
+        scales are exact) for the standard handoff install."""
+        if self._chunk_fin_jit is None:
+            self._chunk_fin_jit = {}
+        fn = self._chunk_fin_jit.get(npg)
+        if fn is not None:
+            return fn
+        from ..models._decode_cache import quantize_kv_page
+        P = self.cache.page_size
+        quant = self.kv_quant
+        m = self.meshctx
+        L = self.adapter.num_layers
+        kv = [m.kv_sharding("prefill")] * L
+        sc = [m.scale_sharding("prefill")] * L if quant else []
+
+        def pure(kb, vb):
+            key = ("fin", npg)
+            self.trace_counts["chunk"][key] = \
+                self.trace_counts["chunk"].get(key, 0) + 1
+            kpg, vpg, kspg, vspg = [], [], [], []
+            for k, v in zip(kb, vb):
+                kp = k[:, :npg * P].reshape(npg, P, *k.shape[2:])
+                vp = v[:, :npg * P].reshape(npg, P, *v.shape[2:])
+                if quant:
+                    kq, ksc = quantize_kv_page(kp)
+                    vq, vsc = quantize_kv_page(vp)
+                    kpg.append(kq)
+                    vpg.append(vq)
+                    kspg.append(ksc)
+                    vspg.append(vsc)
+                else:
+                    kpg.append(kp)
+                    vpg.append(vp)
+            return kpg, vpg, kspg, vspg
+
+        fn = jax.jit(pure, in_shardings=(kv, kv),
+                     out_shardings=(kv, kv, sc, sc))
+        self._chunk_fin_jit[npg] = fn
+        return fn
+
     def _install_fn(self, key):
         """Decode-group INSTALL program for one handed-off KV span
         (disaggregated engines only), compiled once per block shape:
@@ -1908,10 +2387,24 @@ class ServingEngine:
                 **jit_kw)
             return self._decode_jit
 
+        masked = self.prefill_chunk is not None
+
         def pure(params, buffers, toks, pos, active, ks, vs):
             self.trace_counts["decode"] += 1
             pos_eff = jnp.where(active, pos, 0).astype(jnp.int32)
-            caches = [(k, v, pos_eff) for k, v in zip(ks, vs)]
+            if masked:
+                # chunked engines write-mask INACTIVE lanes: the plain
+                # flavor writes every lane's k/v at position 0, which
+                # was harmless while every admission rewrote the whole
+                # row — but a PREFILLING slot's row must survive the
+                # decode steps interleaved between its chunks. Active
+                # lanes' writes/attends are bitwise unchanged (the
+                # wlen scatter lands the same k/v at the same
+                # positions), so greedy outputs stay identical.
+                wl = jnp.where(active, 1, 0).astype(jnp.int32)
+                caches = [(k, v, pos_eff, wl) for k, v in zip(ks, vs)]
+            else:
+                caches = [(k, v, pos_eff) for k, v in zip(ks, vs)]
             with ad.model.bind_state(params, buffers):
                 h, new_caches = ad.call(Tensor(toks), caches)
                 logits = ad.head(h[:, -1:])._data[:, -1]

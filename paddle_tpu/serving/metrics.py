@@ -30,13 +30,18 @@ __all__ = ["EngineMetrics"]
 
 
 class _ReqStats:
-    __slots__ = ("t_submit", "t_first", "t_prefill", "t_last_token")
+    __slots__ = ("t_submit", "t_first", "t_prefill", "t_last_token",
+                 "stalled")
 
-    def __init__(self, t_submit: float):
+    def __init__(self, t_submit: float, stalled: bool = False):
         self.t_submit = t_submit
         self.t_first: Optional[float] = None
         self.t_prefill: Optional[float] = None
         self.t_last_token: Optional[float] = None
+        # submitted while the engine already had work in flight: its
+        # first token was (potentially) blocked behind other requests'
+        # prefill/decode — the decode-stall histogram population
+        self.stalled = stalled
 
 
 class EngineMetrics:
@@ -72,11 +77,15 @@ class EngineMetrics:
             "ptpu_serving_queue_wait_seconds",
             "submit-to-first-prefill wait (scheduler queueing, "
             "prefill compute excluded)")
+        self._m_stall = registry.histogram(
+            "ptpu_serving_decode_stall_seconds",
+            "submit-to-first-token gap for requests submitted while "
+            "other work was in flight (decode blocked behind prefills)")
 
     # -- event hooks (engine calls these) ------------------------------
-    def on_submit(self, rid: int) -> None:
+    def on_submit(self, rid: int, stalled: bool = False) -> None:
         t = self.now()
-        self._reqs[rid] = _ReqStats(t)
+        self._reqs[rid] = _ReqStats(t, stalled=stalled)
         self._n_requests += 1
         self._m_requests.inc()
         if self._t0 is None:
@@ -102,6 +111,8 @@ class EngineMetrics:
             r.t_first = t
             self._ttft.append(t - r.t_submit)
             self._m_ttft.observe(t - r.t_submit)
+            if r.stalled:
+                self._m_stall.observe(t - r.t_submit)
         else:
             gap = t - r.t_last_token
             self._gaps.append(gap)

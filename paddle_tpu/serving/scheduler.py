@@ -58,6 +58,11 @@ class Request:
     sampling: SamplingParams
     out_tokens: List[int] = dataclasses.field(default_factory=list)
     slot: Optional[int] = None
+    # PREFILLING state (chunked prefill): tokens 0..prefill_pos-1 of
+    # the prompt are written to the claimed slot's KV but the request
+    # is not yet decoding; None = not mid-prefill (the only state the
+    # unchunked engine ever sees)
+    prefill_pos: Optional[int] = None
     finished: bool = False
     finish_reason: Optional[str] = None
     # absolute deadline on the ENGINE clock (None = no deadline); a
@@ -118,8 +123,8 @@ class FIFOScheduler:
     def depth(self) -> int:
         return len(self._queue)
 
-    def admissions(self, free_slots: List[int], claim=None) \
-            -> List[Tuple[int, Request]]:
+    def admissions(self, free_slots: List[int], claim=None,
+                   lookahead: int = 0) -> List[Tuple[int, Request]]:
         """Pair queued requests with free slots, FCFS, one per slot.
 
         ``claim`` (optional) gates each admission on a resource besides
@@ -128,14 +133,31 @@ class FIFOScheduler:
         ``claim(head)`` returning False stops the batch with the head
         still queued (FCFS: no skipping ahead of a request that does
         not fit yet). A truthy claim is a COMMITTED reservation: the
-        caller unwinds it if the admission later fails."""
+        caller unwinds it if the admission later fails.
+
+        ``lookahead`` bounds head-of-line blocking: when the head's
+        claim fails, up to ``lookahead`` blocked requests may be passed
+        over (keeping their queue positions) to admit a smaller request
+        behind them that DOES fit. 0 (the default) is strict FCFS —
+        bit-identical to the historical policy."""
         picked = []
+        idx = 0          # scan position in the queue
+        skipped = 0      # blocked requests passed over (<= lookahead)
         for slot in free_slots:
-            if not self._queue:
+            got = None
+            while idx < len(self._queue):
+                req = self._queue[idx]
+                if claim is None or claim(req):
+                    got = req
+                    del self._queue[idx]
+                    break
+                skipped += 1
+                if skipped > lookahead:
+                    break
+                idx += 1
+            if got is None:
                 break
-            if claim is not None and not claim(self._queue[0]):
-                break
-            picked.append((slot, self._queue.popleft()))
+            picked.append((slot, got))
         return picked
 
     def requeue(self, req: Request) -> None:

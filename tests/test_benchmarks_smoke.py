@@ -78,6 +78,22 @@ CLUSTER_SLO_KEYS = {
 }
 
 
+# the CHUNKED_PREFILL line (bench_serving_engine --chunked-prefill)
+# is the ISSUE-14 acceptance artifact: mixed long-prompt/short-decode
+# traffic through the unchunked and prefill_chunk engines — schema
+# stable, max decode stall reduced >= 3x, greedy token-identical,
+# exactly one decode compile, chunk compiles inside the prefill-
+# bucket budget
+CHUNKED_PREFILL_KEYS = {
+    "chunk", "requests_short", "requests_long", "long_prompt_lens",
+    "max_decode_stall_s_unchunked", "max_decode_stall_s_chunked",
+    "stall_reduction", "tok_latency_p99_s_unchunked",
+    "tok_latency_p99_s_chunked", "steps_unchunked", "steps_chunked",
+    "chunk_steps", "token_identical", "decode_compiles",
+    "chunk_compiles", "chunk_compile_shapes", "chunk_compile_budget",
+}
+
+
 # the PAGED_KV line (bench_serving_engine --prefix-share) is the
 # artifact the paged-KV acceptance keys on: schema stable, gains over
 # the contiguous pool asserted at the ISSUE-6 bars (>= 4x paged,
@@ -98,6 +114,7 @@ PAGED_KV_KEYS = {
     "bench_llama_decode.py", "bench_serving_engine.py",
     "bench_serving_engine.py --prefix-share",
     "bench_serving_engine.py --speculative",
+    "bench_serving_engine.py --chunked-prefill",
     "bench_serving_engine.py --frontdoor",
     "bench_serving_engine.py --tensor-parallel",
     "bench_serving_engine.py --cluster",
@@ -177,6 +194,23 @@ def test_benchmark_script_smoke(script, tmp_path):
         assert sd["draft_hit_rate"] > 0.2, sd
         # the accepted-length histogram really has multi-token accepts
         assert sum(sd["acc_len_hist"][2:]) > 0, sd
+    if script == "bench_serving_engine.py --chunked-prefill":
+        clines = [l for l in r.stdout.splitlines()
+                  if l.startswith("CHUNKED_PREFILL ")]
+        assert clines, r.stdout
+        cp = json.loads(clines[-1][len("CHUNKED_PREFILL "):])
+        assert CHUNKED_PREFILL_KEYS <= set(cp), sorted(cp)
+        # ISSUE-14 acceptance bars, deterministic on the mixed trace:
+        # stall bounded by the chunk budget, identity preserved, the
+        # compile contract intact
+        assert cp["stall_reduction"] >= 3.0, cp
+        assert cp["max_decode_stall_s_chunked"] \
+            < cp["max_decode_stall_s_unchunked"], cp
+        assert cp["token_identical"] is True, cp
+        assert cp["decode_compiles"] == 1, cp
+        assert 1 <= cp["chunk_compile_shapes"] \
+            <= cp["chunk_compile_budget"], cp
+        assert cp["chunk_steps"] > 0, cp
     if script == "bench_serving_engine.py --frontdoor":
         slines = [l for l in r.stdout.splitlines()
                   if l.startswith("SERVING_SLO ")]

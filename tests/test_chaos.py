@@ -206,7 +206,8 @@ def _have_cluster():
 
 
 _serving_spec_tally = {"episodes": 0, "speculative": 0,
-                       "accepted_drafts": 0, "verify_kills": 0}
+                       "accepted_drafts": 0, "verify_kills": 0,
+                       "chunked": 0, "chunk_kills": 0}
 
 
 @pytest.mark.parametrize("seed", SERVING_SEEDS)
@@ -221,6 +222,10 @@ def test_serving_episode_matrix(seed):
         res.stats["spec_accepted_drafts"]
     _serving_spec_tally["verify_kills"] += \
         res.fired.get("serving.decode.verify", 0)
+    _serving_spec_tally["chunked"] += \
+        1 if res.stats["prefill_chunk"] else 0
+    _serving_spec_tally["chunk_kills"] += \
+        res.fired.get("serving.prefill.chunk", 0)
 
 
 def test_serving_matrix_actually_speculates():
@@ -236,6 +241,18 @@ def test_serving_matrix_actually_speculates():
     assert _serving_spec_tally["verify_kills"] >= 2, _serving_spec_tally
 
 
+def test_serving_matrix_actually_chunks():
+    """The chunked-prefill arm must stay LOADED: episodes that really
+    run with a ``prefill_chunk`` budget (sampled on its own rng stream
+    so pre-chunk seeds stay bit-identical) and really get killed
+    MID-CHUNK (between chunks of a PREFILLING request) — otherwise
+    the ``serving.prefill.chunk`` coverage goes green by vacuity."""
+    if _serving_spec_tally["episodes"] < len(SERVING_SEEDS):
+        pytest.skip("full serving matrix did not run")
+    assert _serving_spec_tally["chunked"] >= 3, _serving_spec_tally
+    assert _serving_spec_tally["chunk_kills"] >= 1, _serving_spec_tally
+
+
 @pytest.mark.parametrize("seed", TRAINING_SEEDS)
 def test_training_episode_matrix(seed, tmp_path):
     res = chaos.run_training_episode(seed, str(tmp_path))
@@ -243,7 +260,8 @@ def test_training_episode_matrix(seed, tmp_path):
 
 
 _tp_tally = {"episodes": 0, "disagg": 0, "handoff_kills": 0,
-             "sharded_kills": 0, "recoveries": 0}
+             "sharded_kills": 0, "recoveries": 0, "chunked": 0,
+             "chunk_kills": 0}
 
 
 @pytest.mark.parametrize("seed", TP_SERVING_SEEDS)
@@ -263,6 +281,9 @@ def test_tp_serving_episode_matrix(seed):
     _tp_tally["sharded_kills"] += \
         res.fired.get("serving.decode.sharded", 0)
     _tp_tally["recoveries"] += res.stats["recoveries"]
+    _tp_tally["chunked"] += 1 if res.stats["prefill_chunk"] else 0
+    _tp_tally["chunk_kills"] += \
+        res.fired.get("serving.prefill.chunk", 0)
 
 
 def test_tp_matrix_actually_kills_handoffs_and_sharded_decodes():
@@ -277,6 +298,10 @@ def test_tp_matrix_actually_kills_handoffs_and_sharded_decodes():
     assert _tp_tally["handoff_kills"] >= 5, _tp_tally
     assert _tp_tally["sharded_kills"] >= 8, _tp_tally
     assert _tp_tally["recoveries"] >= 5, _tp_tally
+    # chunked prefill composes with the mesh: episodes really chunk
+    # on the mesh engines and really get killed mid-chunk there too
+    assert _tp_tally["chunked"] >= 6, _tp_tally
+    assert _tp_tally["chunk_kills"] >= 2, _tp_tally
 
 
 _frontdoor_death_tally = {"episodes": 0, "deaths": 0,
@@ -455,7 +480,11 @@ def test_pinned_seed_catches_lost_finished_on_failed_step(monkeypatch):
     assert green.ok, "\n".join(green.violations)
 
 
-PINNED_SEED_PAGE_LEAK = 14  # paged-prefill fault mid-admission
+PINNED_SEED_PAGE_LEAK = 15  # paged-prefill fault mid-admission
+# (re-pinned from 14 for the CHUNKED episode flow — seed 14 now draws
+# a prefill_chunk budget on the chunk rng stream, which routes its
+# mid-prefill fault through the chunk unwind instead of the
+# monolithic abort path this pin exercises; 15 stays unchunked)
 
 
 def test_pinned_seed_catches_leaked_pages_on_aborted_prefill(
@@ -475,6 +504,39 @@ def test_pinned_seed_catches_leaked_pages_on_aborted_prefill(
     monkeypatch.setattr(PagedKVCache, "abort_sequence", orig)
     green = chaos.run_serving_episode(PINNED_SEED_PAGE_LEAK)
     assert green.ok, "\n".join(green.violations)
+
+
+PINNED_SEED_CHUNK_LOST = 1   # chunk fault mid-prefill (chunk=8)
+
+
+def test_pinned_seed_catches_swallowed_chunk_fault(monkeypatch):
+    """ISSUE-14 pinned red seed: a fault BETWEEN chunks of a
+    PREFILLING request must unwind the slot (paged claims aborted,
+    lease freed) AND requeue the request for a token-identical
+    replay. With the pre-fix semantics — the faulted request is
+    silently dropped on the floor, its slot/page claims torn down but
+    nobody re-queued — the conservation ledger goes RED with a LOST
+    request; the real unwind+requeue path stays green on the same
+    seed and really fires the ``serving.prefill.chunk`` fault."""
+    from paddle_tpu.serving import ServingEngine
+    orig = ServingEngine._unwind_chunk
+
+    def dropped(self, slot, req, requeue):
+        # pre-fix: swallow the unwind's requeue half — the request
+        # vanishes mid-prefill
+        self._clear_chunk_state(slot, req)
+        self.cache.release(slot)
+        req.slot = None
+
+    monkeypatch.setattr(ServingEngine, "_unwind_chunk", dropped)
+    red = chaos.run_serving_episode(PINNED_SEED_CHUNK_LOST)
+    assert not red.ok
+    assert any("LOST" in v for v in red.violations), red.violations
+    monkeypatch.setattr(ServingEngine, "_unwind_chunk", orig)
+    green = chaos.run_serving_episode(PINNED_SEED_CHUNK_LOST)
+    assert green.ok, "\n".join(green.violations)
+    assert green.stats["prefill_chunk"] == 8
+    assert green.fired.get("serving.prefill.chunk", 0) >= 1
 
 
 PINNED_SEED_NO_FAILOVER = 306   # replica death with requests aboard

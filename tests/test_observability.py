@@ -434,6 +434,69 @@ def test_speculative_metrics_published():
     assert "ptpu_serving_spec_accepted_length" not in reg2.families()
 
 
+# -- chunked-prefill metrics + spans (ISSUE-14 satellite) --------------
+
+def test_chunked_prefill_metrics_and_spans(tmp_path):
+    """A chunked engine publishes the chunk-step counter, the
+    chunk-queue-depth gauge and the decode-stall histogram in its
+    registry, and its chrome trace carries ``serving.chunk_prefill``
+    spans with request ids. Unchunked engines do not grow the chunk
+    families."""
+    from paddle_tpu import profiler
+    from paddle_tpu.serving import ServingEngine
+
+    model = _tiny_llama()
+    reg = MetricRegistry()
+    eng = ServingEngine(model, max_slots=2, max_len=64, min_bucket=8,
+                        prefill_chunk=8, registry=reg,
+                        flight_recorder=FlightRecorder(capacity=4))
+    prof = profiler.Profiler(timer_only=True)
+    prof.start()
+    rng = np.random.RandomState(0)
+    # a long prompt chunks; the short request behind it decodes while
+    # the chunks run — its first token is a measured decode stall
+    long_req = eng.submit(rng.randint(1, 100, (40,)).astype(np.int64),
+                          max_new_tokens=4)
+    short = eng.submit(rng.randint(1, 100, (5,)).astype(np.int64),
+                       max_new_tokens=8)
+    while eng.has_work():
+        eng.step()
+    prof.stop()
+    assert long_req.finished and short.finished
+
+    chunk_steps = reg.counter("ptpu_serving_chunk_steps_total").value
+    assert chunk_steps >= 5                    # ceil(40/8) chunks
+    assert reg.gauge("ptpu_serving_chunk_queue_depth").value == 0
+    stall = reg.histogram("ptpu_serving_decode_stall_seconds")
+    assert stall.count >= 1                    # the short request
+    text = reg.to_prometheus()
+    assert "# TYPE ptpu_serving_chunk_steps_total counter" in text
+    assert "# TYPE ptpu_serving_chunk_queue_depth gauge" in text
+    assert "# TYPE ptpu_serving_decode_stall_seconds histogram" in text
+
+    trace_path = str(tmp_path / "trace.json")
+    prof.export_chrome_tracing(trace_path)
+    evs = json.load(open(trace_path))["traceEvents"]
+    chunks = [e for e in evs if e["name"] == "serving.chunk_prefill"]
+    assert len(chunks) == chunk_steps
+    # every admission chunks (the short prompt as ONE whole-prompt
+    # chunk), and every span carries its request id
+    assert {e["args"]["request_id"] for e in chunks} \
+        == {long_req.rid, short.rid}
+    assert all("chunk" in e["args"] and "pos" in e["args"]
+               for e in chunks)
+    assert sum(1 for e in chunks if e["args"]["final"]) == 2
+    assert sum(1 for e in chunks
+               if e["args"]["request_id"] == long_req.rid) >= 5
+
+    # unchunked engines do not grow the chunk families
+    reg2 = MetricRegistry()
+    ServingEngine(model, max_slots=1, max_len=64, registry=reg2,
+                  flight_recorder=FlightRecorder(capacity=4))
+    assert "ptpu_serving_chunk_steps_total" not in reg2.families()
+    assert "ptpu_serving_chunk_queue_depth" not in reg2.families()
+
+
 # -- acceptance: one serving run, three artifacts ----------------------
 
 def _tiny_llama():

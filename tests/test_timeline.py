@@ -375,6 +375,33 @@ def test_slo_attribution_bills_replay_to_failover():
     assert recs[6]["prefill_s"] == 0
 
 
+def test_slo_attribution_has_chunked_prefill_phase():
+    """ISSUE-14: chunked prefill is its own SLO phase. Chunk spans
+    bill to ``chunked_prefill_s`` (not ``prefill_s``), they end the
+    queue phase like a monolithic prefill would, and a REPLAY chunk
+    (failover re-execution) bills to ``failover_replay_s``."""
+    tel = ClusterTelemetry()
+    tel.ingest_host([
+        _span("router.dispatch", 0.0, 0.1, 1, rid=9, replica="w0"),
+    ], proc="router")
+    tel.ingest_worker("w0", _payload(100, [
+        _span("serving.chunk_prefill", 0.3, 0.5, 100, rid=9,
+              chunk=8, final=False, replay=False),
+        _span("serving.chunk_prefill", 0.6, 0.9, 100, rid=9,
+              chunk=8, final=True, replay=False),
+        _span("serving.chunk_prefill", 2.0, 2.4, 100, rid=9,
+              chunk=8, final=True, replay=True),
+        _span("serving.decode", 0.9, 1.4, 100, request_ids=[9]),
+    ], drained=4), host_now=0.0)
+    (r9,) = tel.slo_attribution()
+    assert r9["request_id"] == 9
+    assert abs(r9["chunked_prefill_s"] - 0.5) < 1e-9   # 0.2 + 0.3
+    assert r9["prefill_s"] == 0                # no monolithic prefill
+    assert abs(r9["queue_s"] - 0.2) < 1e-9     # dispatch -> 1st chunk
+    assert abs(r9["failover_replay_s"] - 0.4) < 1e-9   # replay chunk
+    assert abs(r9["decode_s"] - 0.5) < 1e-9
+
+
 # -- the chaos trace-conservation law ----------------------------------
 
 class _Req:
