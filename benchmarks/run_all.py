@@ -10,6 +10,7 @@ snapshot that belongs next to the BENCH json."""
 import _path  # noqa: F401  (repo-root import shim)
 
 import argparse
+import json
 import os
 import subprocess
 import sys
@@ -40,15 +41,49 @@ SCRIPTS = ["bench_resnet50.py", "bench_bert_dp.py", "bench_gpt_hybrid.py",
            "chaos_soak.py"]
 
 
+def lint_preflight(repo: str) -> bool:
+    """Run ptpu-lint over the package before any benchmark burns
+    minutes of compute: a fresh invariant violation (leaked page
+    acquisition, unguarded shared state, orphan fault point) is
+    exactly the kind of bug a long soak then rediscovers the hard
+    way. Emits the finding counts as a JSON benchmark line plus the
+    Prometheus-style ``ptpu_lint_findings_total`` gauges."""
+    r = subprocess.run(
+        [sys.executable, "-m", "tools.ptpu_lint", "paddle_tpu",
+         "--json", "--metrics"],
+        capture_output=True, text=True, timeout=600, cwd=repo)
+    body = r.stdout.split("ptpu_lint_findings_total")[0]
+    try:
+        payload = json.loads(body)
+        n_new = len(payload["findings"])
+        n_base = payload["baselined"]
+    except (ValueError, KeyError):
+        n_new, n_base = -1, -1
+    print(json.dumps({"metric": "ptpu_lint_new_findings",
+                      "value": n_new, "unit": "findings",
+                      "vs_baseline": None}))
+    print(f'ptpu_lint_findings_total{{status="new"}} {n_new}')
+    print(f'ptpu_lint_findings_total{{status="baselined"}} {n_base}')
+    if r.returncode != 0:
+        sys.stderr.write("ptpu_lint pre-flight failed "
+                         f"(rc={r.returncode}):\n" + body[-2000:]
+                         + r.stderr[-1000:] + "\n")
+    return r.returncode == 0
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--prom-out", default=None, metavar="DIR",
                     help="write each script's Prometheus metrics "
                          "snapshot to DIR/<script>.prom")
+    ap.add_argument("--skip-lint", action="store_true",
+                    help="skip the ptpu-lint pre-flight")
     opts = ap.parse_args()
     if opts.prom_out:
         os.makedirs(opts.prom_out, exist_ok=True)
     here = os.path.dirname(os.path.abspath(__file__))
+    if not opts.skip_lint:
+        lint_preflight(os.path.dirname(here))
     for s in SCRIPTS:
         # Each script resolves the repo root via benchmarks/_path.py,
         # so REPO entries are dropped from PYTHONPATH — but non-repo

@@ -4,9 +4,9 @@ Crash-only-software discipline: every recovery path in the framework is
 exercised by *injecting* the failure it claims to survive, on CPU, in
 tier-1 tests — not by waiting for a TPU pod to actually lose a host.
 Instrumented layers call ``maybe_fail("<point>")`` at the spots where
-real systems die; the call is a dict lookup when no fault is armed, and
-raises :class:`InjectedFault` (or a caller-chosen exception type) when
-one is.
+real systems die; the call is one cached bool plus one env probe when
+no fault is armed, and raises :class:`InjectedFault` (or a
+caller-chosen exception type) when one is.
 
 Wired-in points (see docs/RESILIENCE.md for the catalogue):
 
@@ -181,6 +181,17 @@ _rules: Dict[str, _Rule] = {}
 _hits: Dict[str, int] = {}
 _fired: Dict[str, int] = {}
 _env_cache: Optional[str] = None
+# THE disarmed-hot-path flag: True exactly when _rules is empty.
+# Every mutation of _rules (inject/clear/injected/_load_env) calls
+# _recompute_disarmed(); maybe_fail's fast path reads this one
+# cached bool plus one env probe and touches nothing else — no lock,
+# no string compare against _env_cache, no dict truthiness walk.
+_disarmed = True
+
+
+def _recompute_disarmed() -> None:
+    global _disarmed
+    _disarmed = not _rules
 
 
 def parse_spec(spec: str) -> Dict[str, _Rule]:
@@ -220,6 +231,7 @@ def _load_env(env: str) -> None:
             # a malformed env spec must not take the process down from
             # inside an instrumented hot path; it just arms nothing
             pass
+        _recompute_disarmed()
 
 
 def maybe_fail(point: str, **ctx) -> None:
@@ -229,12 +241,20 @@ def maybe_fail(point: str, **ctx) -> None:
     what the point guards); the raised exception carries the point name
     and per-point hit number.
 
-    Disarmed cost is one env read + one dict truthiness check — no
-    lock, no counting — because this sits in per-sample dataloader and
-    per-op store hot paths. Hit counts therefore accumulate only while
-    at least one rule is armed (i.e. during chaos sessions, which is
-    when tests assert wiring via ``hits()``/``fired()``).
+    Disarmed cost is a single cached emptiness check (the
+    ``_disarmed`` bool, maintained by every rule mutation) plus one
+    env probe — no lock, no ``_env_cache`` string compare, no dict
+    walk, no counting — because this sits in per-sample dataloader
+    and per-op store hot paths (micro-asserted in tests/test_chaos.py:
+    the disarmed path never touches ``_lock``). The env probe cannot
+    be cached away: ``PTPU_FAULTS`` set mid-process (monkeypatch,
+    forked workers) must arm lazily on the very next evaluation. Hit
+    counts therefore accumulate only while at least one rule is armed
+    (i.e. during chaos sessions, which is when tests assert wiring
+    via ``hits()``/``fired()``).
     """
+    if _disarmed and not os.environ.get("PTPU_FAULTS"):
+        return
     env = os.environ.get("PTPU_FAULTS", "")
     if env != _env_cache:
         _load_env(env)
@@ -268,6 +288,7 @@ def inject(point: str, times: Optional[int] = None, after: int = 0,
     with _lock:
         _rules[point] = _Rule(times=times, after=after, rate=rate,
                               seed=seed, exc=exc)
+        _recompute_disarmed()
 
 
 def clear(point: Optional[str] = None) -> None:
@@ -277,6 +298,7 @@ def clear(point: Optional[str] = None) -> None:
             _rules.clear()
         else:
             _rules.pop(point, None)
+        _recompute_disarmed()
 
 
 @contextlib.contextmanager
@@ -296,6 +318,7 @@ def injected(point: str, times: Optional[int] = None, after: int = 0,
                 _rules.pop(point, None)
             else:
                 _rules[point] = prev
+            _recompute_disarmed()
 
 
 def hits(point: Optional[str] = None):

@@ -988,41 +988,63 @@ class ServingEngine:
                 self._m_spec_acc.observe(1.0)
             return
         copies = []
-        if self.paged:
-            for s in active:
-                copies += self.cache.ensure_decode_range(
-                    s, self.cache.slots[s].next_pos, int(wlen[s]))
-        # COW copies BEFORE the kill point (same reason as the plain
-        # decode: flipped table rows must never outrun their copies)
-        if self.paged:
-            self._run_copies(copies)
-        # mid-verify-step kill point: drafts built, pages
-        # claimed/COW'd, nothing emitted yet — recovery must replay
-        # token-identically and leak no pages (chaos-audited)
-        maybe_fail("serving.decode.verify", step=self._step_idx - 1)
-        if self.meshctx is not None:
-            maybe_fail("serving.decode.sharded",
-                       step=self._step_idx - 1, tp=self.meshctx.tp)
-        with span("serving.verify", batch=len(active), k=K,
-                  request_ids=[self.cache.slots[s].rid
-                               for s in active]):
+        try:
             if self.paged:
-                logits, greedy, acc, ks, vs, kss, vss = \
-                    self._verify_fn()(
+                for s in active:
+                    copies += self.cache.ensure_decode_range(
+                        s, self.cache.slots[s].next_pos, int(wlen[s]))
+                # COW copies BEFORE the kill point (same reason as the
+                # plain decode: flipped table rows must never outrun
+                # their copies)
+                self._run_copies(copies)
+            # mid-verify-step kill point: drafts built, pages
+            # claimed/COW'd, nothing emitted yet — recovery must
+            # replay token-identically and leak no pages
+            # (chaos-audited)
+            maybe_fail("serving.decode.verify",
+                       step=self._step_idx - 1)
+            if self.meshctx is not None:
+                maybe_fail("serving.decode.sharded",
+                           step=self._step_idx - 1,
+                           tp=self.meshctx.tp)
+            with span("serving.verify", batch=len(active), k=K,
+                      request_ids=[self.cache.slots[s].rid
+                                   for s in active]):
+                if self.paged:
+                    logits, greedy, acc, ks, vs, kss, vss = \
+                        self._verify_fn()(
+                            self._params, self._buffers, toks, pos,
+                            mask, wlen, self.cache.page_table.copy(),
+                            self.cache.ks, self.cache.vs,
+                            self.cache.kss, self.cache.vss)
+                    self.cache.ks, self.cache.vs = list(ks), list(vs)
+                    self.cache.kss, self.cache.vss = \
+                        list(kss), list(vss)
+                else:
+                    logits, greedy, acc, ks, vs = self._verify_fn()(
                         self._params, self._buffers, toks, pos, mask,
-                        wlen, self.cache.page_table.copy(),
-                        self.cache.ks, self.cache.vs,
-                        self.cache.kss, self.cache.vss)
-                self.cache.ks, self.cache.vs = list(ks), list(vs)
-                self.cache.kss, self.cache.vss = list(kss), list(vss)
-            else:
-                logits, greedy, acc, ks, vs = self._verify_fn()(
-                    self._params, self._buffers, toks, pos, mask,
-                    wlen, self.cache.ks, self.cache.vs)
-                self.cache.ks, self.cache.vs = list(ks), list(vs)
-            logits = np.asarray(jax.device_get(logits))
-            greedy = np.asarray(jax.device_get(greedy))
-            acc = np.asarray(jax.device_get(acc))
+                        wlen, self.cache.ks, self.cache.vs)
+                    self.cache.ks, self.cache.vs = list(ks), list(vs)
+                logits = np.asarray(jax.device_get(logits))
+                greedy = np.asarray(jax.device_get(greedy))
+                acc = np.asarray(jax.device_get(acc))
+        except Exception:
+            # a verify step that dies here (fault point, program
+            # failure) never emitted a token, but ensure_decode_range
+            # already claimed every page the k-wide write window
+            # touches. Those extra pages sit past each row's next
+            # write position and nothing frees them until the request
+            # finishes — on a non-broken engine they silently shrink
+            # the admission pool on every faulted step. Return them
+            # NOW; the retried step re-claims idempotently (the page
+            # holding next_pos itself is kept — the retry writes it).
+            if self.paged:
+                for s in active:
+                    req = self.cache.slots[s]
+                    if req is not None:
+                        self.cache.rollback_speculation(
+                            s, req.next_pos)
+            raise
         for s in active:
             req = self.cache.slots[s]
             emitted = self._emit_verified(s, req, greedy[s],
@@ -1506,16 +1528,20 @@ class ServingEngine:
                     self.cache.ks, self.cache.vs = list(ks), list(vs)
             return np.asarray(jax.device_get(logits))
         cache = self.cache
-        if req.rid not in cache._plans:
-            # admission reserves at claim time; recover()'s re-prefill
-            # reserves here (a fresh pool always fits what it held)
-            if not cache.try_reserve(req, ids,
-                                     req.prompt_len
-                                     + req.max_new_tokens):
-                raise RuntimeError(
-                    f"request {req.rid}: page reservation failed on "
-                    f"re-prefill (pool too small for in-flight set)")
         try:
+            if req.rid not in cache._plans:
+                # admission reserves at claim time; recover()'s
+                # re-prefill reserves here (a fresh pool always fits
+                # what it held). Inside the unwind scope: a failure
+                # here routes through abort_sequence, which no-ops on
+                # a missing plan
+                if not cache.try_reserve(req, ids,
+                                         req.prompt_len
+                                         + req.max_new_tokens):
+                    raise RuntimeError(
+                        f"request {req.rid}: page reservation failed "
+                        f"on re-prefill (pool too small for "
+                        f"in-flight set)")
             # same-wave sharing: earlier admissions in THIS batch have
             # registered their pages since the claim — re-match now
             cache.refresh_reservation(req, ids)
@@ -1608,14 +1634,17 @@ class ServingEngine:
         start = 0
         if self.paged:
             cache = self.cache
-            if req.rid not in cache._plans:
-                if not cache.try_reserve(req, ids,
-                                         req.prompt_len
-                                         + req.max_new_tokens):
-                    raise RuntimeError(
-                        f"request {req.rid}: page reservation failed "
-                        f"at chunked admission")
             try:
+                if req.rid not in cache._plans:
+                    # inside the unwind scope (abort_sequence no-ops
+                    # on a missing plan), so a reservation that fails
+                    # halfway can never strand its pinned pages
+                    if not cache.try_reserve(req, ids,
+                                             req.prompt_len
+                                             + req.max_new_tokens):
+                        raise RuntimeError(
+                            f"request {req.rid}: page reservation "
+                            f"failed at chunked admission")
                 cache.refresh_reservation(req, ids)
                 start, copies = cache.begin_sequence(slot, req, ids)
                 self._run_copies(copies)

@@ -103,9 +103,9 @@ class ClientStream:
     any write failure as the client being gone."""
 
     def __init__(self):
-        self._events: deque = deque()
+        self._events: deque = deque()        # guarded-by: _cond
         self._cond = threading.Condition()
-        self.closed = False
+        self.closed = False                  # guarded-by: _cond
 
     def write(self, event: dict) -> None:
         with self._cond:
@@ -130,6 +130,13 @@ class ClientStream:
     def events(self) -> List[dict]:
         with self._cond:
             return list(self._events)
+
+    def drained(self) -> bool:
+        """True when closed AND nothing is left to deliver — the SSE
+        loop's locked exit probe (one lock round for what would
+        otherwise be two racy reads)."""
+        with self._cond:
+            return self.closed and not self._events
 
 
 class FrontDoorHandle:
@@ -174,11 +181,11 @@ class FrontDoor:
                                         name="frontdoor")
         self.recorder = flight_recorder if flight_recorder is not None \
             else default_recorder()
-        self._handles: Dict[int, FrontDoorHandle] = {}
-        self._tenant_depth: Dict[str, int] = {}
-        self._buckets: Dict[str, TokenBucket] = {}
-        self._closed = False
-        self._consecutive_pump_failures = 0
+        self._handles: Dict[int, FrontDoorHandle] = {}  # guarded-by: _lock
+        self._tenant_depth: Dict[str, int] = {}         # guarded-by: _lock
+        self._buckets: Dict[str, TokenBucket] = {}      # guarded-by: _lock
+        self._closed = False                            # guarded-by: _lock
+        self._consecutive_pump_failures = 0             # guarded-by: _lock
         # serialize core entry points: the engine below is not thread-
         # safe, and the HTTP binding calls in from handler threads
         # while the pump loop runs on another
@@ -221,6 +228,7 @@ class FrontDoor:
     def _policy(self, tenant: str) -> TenantPolicy:
         return self.tenant_policies.get(tenant, self.default_policy)
 
+    # requires-lock: _lock
     def _bucket(self, tenant: str) -> Optional[TokenBucket]:
         pol = self._policy(tenant)
         if pol.rate_qps is None:
@@ -293,6 +301,9 @@ class FrontDoor:
             return handle
 
     # -- disconnect propagation ---------------------------------------
+    # the engine evaluates this probe inside backend.step(), which
+    # only ever runs under pump()'s lock:
+    # requires-lock: _lock
     def _client_gone(self, req: Request) -> bool:
         """Engine-side liveness probe (installed as ``cancel_probe``):
         True = nobody is listening to this request anymore."""
@@ -309,6 +320,7 @@ class FrontDoor:
             return True
         return False
 
+    # requires-lock: _lock
     def _on_disconnect(self, h: FrontDoorHandle) -> None:
         if h.disconnected:
             return
@@ -327,6 +339,13 @@ class FrontDoor:
         surfaces through ``pump()`` exactly once (via='disconnect')."""
         with self._lock:
             self._on_disconnect(handle)
+
+    def get_handle(self, rid: int) -> Optional[FrontDoorHandle]:
+        """Locked handle lookup for transport threads (the DELETE
+        handler resolves rid -> handle through this, never by reading
+        ``_handles`` directly from its own thread)."""
+        with self._lock:
+            return self._handles.get(rid)
 
     def cancel(self, handle: FrontDoorHandle,
                reason: str = "cancelled") -> bool:
@@ -371,6 +390,7 @@ class FrontDoor:
                 self._finish(req, out)
             return out
 
+    # requires-lock: _lock
     def _push(self, h: FrontDoorHandle, event: dict) -> bool:
         try:
             maybe_fail("frontdoor.stream_write", rid=h.req.rid)
@@ -383,6 +403,7 @@ class FrontDoor:
         self._m_stream_ev.inc()
         return True
 
+    # requires-lock: _lock
     def _route_tokens(self) -> None:
         for h in list(self._handles.values()):
             if h.stream is None or h.disconnected:
@@ -396,6 +417,7 @@ class FrontDoor:
                     break
                 h.sent += 1
 
+    # requires-lock: _lock
     def _finish(self, req: Request, out: List[Request],
                 via: Optional[str] = None) -> None:
         h = self._handles.pop(req.rid, None)
@@ -432,7 +454,9 @@ class FrontDoor:
         while self.has_work() and steps < max_steps:
             out.extend(self.pump())
             steps += 1
-            if self._consecutive_pump_failures >= 10:
+            with self._lock:
+                failures = self._consecutive_pump_failures
+            if failures >= 10:
                 break
         return out
 
@@ -540,7 +564,7 @@ class FrontDoorHTTPServer:
                         self._json_response(400,
                                             {"error": "bad rid"})
                         return
-                    h = outer.front._handles.get(rid)
+                    h = outer.front.get_handle(rid)
                     ok = h is not None and outer.front.cancel(h)
                     self._json_response(200 if ok else 404,
                                         {"cancelled": ok, "rid": rid})
@@ -612,7 +636,7 @@ class FrontDoorHTTPServer:
                 while True:
                     ev = stream.next_event(timeout=0.05)
                     if ev is None:
-                        if stream.closed and not stream.events():
+                        if stream.drained():
                             break
                         if outer._stop.is_set():
                             break

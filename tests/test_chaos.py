@@ -738,3 +738,55 @@ def test_pinned_seed_dropped_kv_handoff_goes_lost(monkeypatch):
     assert green.ok, "\n".join(green.violations)
     assert green.fired.get("serving.kv.handoff", 0) >= 1
     assert green.stats["mesh"] == "disagg"
+
+
+# -- disarmed maybe_fail is (nearly) free ------------------------------
+
+def test_maybe_fail_disarmed_path_is_lock_free(monkeypatch):
+    """The zero-cost contract for every instrumented hot path
+    (per-sample dataloader, per-op store, per-step engines): with no
+    rule armed and no PTPU_FAULTS, ``maybe_fail`` is ONE cached bool
+    plus one env probe — it never touches ``_lock`` and never bumps a
+    counter. Arming a rule flips it onto the locked slow path; an env
+    arm set mid-process (forked workers, monkeypatch) must still take
+    effect on the very next evaluation."""
+
+    class _CountingLock:
+        def __init__(self, inner):
+            self.inner = inner
+            self.acquisitions = 0
+
+        def __enter__(self):
+            self.acquisitions += 1
+            return self.inner.__enter__()
+
+        def __exit__(self, *exc):
+            return self.inner.__exit__(*exc)
+
+    monkeypatch.delenv("PTPU_FAULTS", raising=False)
+    probe = _CountingLock(faults._lock)
+    monkeypatch.setattr(faults, "_lock", probe)
+
+    assert faults._disarmed is True
+    for _ in range(1000):
+        faults.maybe_fail("serving.step.decode")
+    assert probe.acquisitions == 0
+    assert faults.hits("serving.step.decode") == 0  # no bookkeeping
+
+    faults.inject("serving.step.decode", times=1)
+    assert faults._disarmed is False
+    before = probe.acquisitions
+    with pytest.raises(faults.InjectedFault):
+        faults.maybe_fail("serving.step.decode")
+    assert probe.acquisitions > before       # armed = the locked walk
+    assert faults.fired("serving.step.decode") == 1
+    faults.clear()
+    assert faults._disarmed is True
+
+    # the env probe is the one read that cannot be cached away
+    monkeypatch.setenv("PTPU_FAULTS", "serving.step.decode:1")
+    with pytest.raises(faults.InjectedFault):
+        faults.maybe_fail("serving.step.decode")
+    monkeypatch.delenv("PTPU_FAULTS")
+    faults.maybe_fail("serving.step.decode")  # disarms lazily, no raise
+    assert faults._disarmed is True

@@ -461,3 +461,77 @@ def test_http_client_disconnect_cancels_request(http_front):
     assert len(handle.req.out_tokens) < 40  # cancelled early
     assert page_leak_violations(eng) == []
     assert frontdoor_leak_violations(front) == []
+
+
+# -- locked handle lookup (ptpu-lint PTL201 regression) -----------------
+
+def test_get_handle_is_a_locked_lookup():
+    """Regression: the HTTP DELETE handler used to read
+    ``front._handles`` directly from its transport thread — an
+    unguarded racy read against pump()'s mutations. The fix routes it
+    through ``get_handle``; this pins that the accessor really takes
+    ``_lock`` (a delegating probe counts acquisitions)."""
+    model = _tiny_llama()
+    front = FrontDoor(_engine(model), registry=MetricRegistry())
+    h = front.submit(np.arange(1, 6), 4)
+
+    class _Probe:
+        def __init__(self, inner):
+            self.inner = inner
+            self.entered = 0
+
+        def __enter__(self):
+            self.entered += 1
+            return self.inner.__enter__()
+
+        def __exit__(self, *exc):
+            return self.inner.__exit__(*exc)
+
+    probe = _Probe(front._lock)
+    front._lock = probe
+    try:
+        assert front.get_handle(h.req.rid) is h
+        assert front.get_handle(10 ** 9) is None
+        assert probe.entered == 2
+    finally:
+        front._lock = probe.inner
+    assert front.cancel(h)                  # cleanup: no leaked handle
+    assert frontdoor_leak_violations(front) == []
+
+
+def test_http_delete_cancels_inflight_request():
+    """DELETE /v1/requests/<rid> through a real socket while the
+    request is deterministically in flight (transport thread running,
+    pump thread NOT started): the handler resolves the rid via the
+    locked accessor, cancels exactly once, and a second DELETE is a
+    clean 404 — not a crash on a torn read."""
+    import urllib.error
+
+    model = _tiny_llama()
+    eng = _engine(model, page_size=8)
+    front = FrontDoor(eng, registry=MetricRegistry())
+    srv = FrontDoorHTTPServer(front, port=0)
+    srv._serve_thread.start()
+    try:
+        h = front.submit(np.arange(1, 6), 8, stream=ClientStream())
+        url = srv.url + f"/v1/requests/{h.req.rid}"
+        req = urllib.request.Request(url, method="DELETE")
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            out = json.loads(resp.read())
+        assert out == {"cancelled": True, "rid": h.req.rid}
+        assert front.get_handle(h.req.rid) is None
+
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                urllib.request.Request(url, method="DELETE"),
+                timeout=10)
+        assert ei.value.code == 404
+        assert json.loads(ei.value.read()) == \
+            {"cancelled": False, "rid": h.req.rid}
+        assert frontdoor_leak_violations(front) == []
+        assert page_leak_violations(eng) == []
+    finally:
+        srv._stop.set()
+        srv._server.shutdown()
+        srv._server.server_close()
+        srv._serve_thread.join(timeout=5)

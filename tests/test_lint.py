@@ -1,0 +1,489 @@
+"""ptpu-lint in tier-1: the analyzer's fixture corpus plus the
+package-wide green gate.
+
+Three layers (ISSUE 15):
+
+1. a fixture corpus of minimal good/bad snippets per check, asserting
+   the EXACT finding codes and line numbers — the checks' contract;
+2. mechanics: inline suppression and the baseline (code, path,
+   source-line context) matcher;
+3. the gate: linting ``paddle_tpu/`` against the committed baseline
+   yields ZERO new findings, every baseline entry is still live (no
+   silent staleness), and ``docs/FAULT_POINTS.md`` matches the
+   generated catalogue.
+
+No jax import needed — the analyzer is stdlib-``ast`` only.
+"""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parents[1]
+if str(REPO) not in sys.path:
+    sys.path.insert(0, str(REPO))
+
+from tools.ptpu_lint.checks.fault_registry import (  # noqa: E402
+    DOC_PATH, generate_catalog)
+from tools.ptpu_lint.core import (  # noqa: E402
+    Finding, apply_baseline, iter_py_files, lint_paths, lint_source,
+    lint_units, load_baseline, make_baseline, make_unit)
+
+BASELINE_PATH = REPO / "tools" / "ptpu_lint" / "baseline.json"
+
+
+def _hits(findings):
+    """(code, line) pairs — the corpus asserts exact positions."""
+    return [(f.code, f.line) for f in findings]
+
+
+def _src(s: str) -> str:
+    return textwrap.dedent(s).lstrip("\n")
+
+
+# ---------------------------------------------------------------------------
+# trace hygiene (PTL101 / PTL102)
+# ---------------------------------------------------------------------------
+
+def test_ptl101_impure_call_in_jit_decorated_fn():
+    findings = lint_source(_src("""
+        import time
+
+        import jax
+
+
+        @jax.jit
+        def step(x):
+            t = time.time()
+            return x * t
+    """))
+    assert _hits(findings) == [("PTL101", 8)]
+    assert "time.time" in findings[0].message
+
+
+def test_ptl101_jit_call_form_and_host_rng():
+    findings = lint_source(_src("""
+        import jax
+        import numpy as np
+
+
+        def step(a):
+            r = np.random.rand()
+            return a + r
+
+
+        g = jax.jit(step)
+    """))
+    assert _hits(findings) == [("PTL101", 6)]
+
+
+def test_ptl101_os_environ_read():
+    findings = lint_source(_src("""
+        import os
+
+        import jax
+
+
+        @jax.jit
+        def f(x):
+            if os.environ.get("FLAG"):
+                return x
+            return x + 1
+    """))
+    assert _hits(findings) == [("PTL101", 8)]
+    assert "os.environ" in findings[0].message
+
+
+def test_ptl102_if_and_while_on_tracer():
+    findings = lint_source(_src("""
+        import jax
+
+
+        @jax.jit
+        def f(x, n):
+            if x > 0:
+                x = x + 1
+            while n > 0:
+                n = n - 1
+            return x + n
+    """))
+    assert _hits(findings) == [("PTL102", 6), ("PTL102", 8)]
+
+
+def test_ptl102_static_escapes_are_clean():
+    # is-None tests, len(), dict-key membership (pytree structure),
+    # and shape-land attribute reads are all concrete at trace time
+    findings = lint_source(_src("""
+        import jax
+
+
+        @jax.jit
+        def f(x, state):
+            if x is None:
+                return 0
+            if len(x) > 2:
+                x = x[:2]
+            if "w" in state:
+                x = x + state["w"]
+            if x.ndim == 2:
+                x = x.sum()
+            return x
+    """))
+    assert findings == []
+
+
+def test_ptl102_static_argnames_exempt():
+    findings = lint_source(_src("""
+        import functools
+
+        import jax
+
+
+        @functools.partial(jax.jit, static_argnames=("k",))
+        def f(x, k):
+            if k > 0:
+                return x * k
+            return x
+    """))
+    assert findings == []
+
+
+def test_untraced_function_is_not_linted():
+    findings = lint_source(_src("""
+        import time
+
+
+        def host_loop(x):
+            t = time.time()
+            if x > 0:
+                return t
+            return -t
+    """))
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# lock discipline (PTL201 / PTL202 / PTL203)
+# ---------------------------------------------------------------------------
+
+def test_ptl201_guarded_attr_outside_lock():
+    findings = lint_source(_src("""
+        import threading
+
+
+        class Store:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._d = {}  # guarded-by: _lock
+
+            def get(self, k):
+                with self._lock:
+                    return self._d.get(k)
+
+            def bad(self, k):
+                return self._d.get(k)
+    """))
+    assert _hits(findings) == [("PTL201", 14)]
+    assert "Store._d" in findings[0].message
+
+
+def test_ptl201_cross_object_access():
+    findings = lint_source(_src("""
+        import threading
+
+
+        class Owner:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._handles = {}  # guarded-by: _lock
+
+
+        def peek(owner):
+            return owner._handles
+    """))
+    assert _hits(findings) == [("PTL201", 11)]
+    assert "outside its owning class" in findings[0].message
+
+
+def test_ptl202_unknown_lock_name():
+    findings = lint_source(_src("""
+        import threading
+
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._d = {}  # guarded-by: _mu
+    """))
+    assert _hits(findings) == [("PTL202", 7)]
+
+
+def test_ptl203_requires_lock_called_bare():
+    findings = lint_source(_src("""
+        import threading
+
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._d = {}  # guarded-by: _lock
+
+            # requires-lock: _lock
+            def _bump(self, k):
+                self._d[k] = 1
+
+            def ok(self, k):
+                with self._lock:
+                    self._bump(k)
+
+            def bad(self, k):
+                self._bump(k)
+    """))
+    # _bump's own body counts as locked; ok() holds the lock; only
+    # bad()'s bare call fires
+    assert _hits(findings) == [("PTL203", 18)]
+
+
+# ---------------------------------------------------------------------------
+# resource pairing (PTL301)
+# ---------------------------------------------------------------------------
+
+def test_ptl301_acquire_outside_try():
+    findings = lint_source(_src("""
+        class Engine:
+            def step(self, cache, s):
+                cache.try_reserve(s)
+                return s
+    """))
+    assert _hits(findings) == [("PTL301", 3)]
+
+
+def test_ptl301_try_without_release_still_fires():
+    findings = lint_source(_src("""
+        class Engine:
+            def step(self, cache, s):
+                try:
+                    cache.try_reserve(s)
+                except Exception:
+                    raise
+    """))
+    assert _hits(findings) == [("PTL301", 4)]
+
+
+def test_ptl301_handler_release_is_clean():
+    findings = lint_source(_src("""
+        class Engine:
+            def step(self, cache, s, req):
+                try:
+                    cache.try_reserve(s)
+                    return s
+                except Exception:
+                    cache.abort_sequence(s, req)
+                    raise
+    """))
+    assert findings == []
+
+
+def test_ptl301_finally_release_is_clean():
+    findings = lint_source(_src("""
+        class Engine:
+            def step(self, cache, s):
+                try:
+                    cache.ensure_decode_page(s, 0)
+                finally:
+                    cache.release(s)
+    """))
+    assert findings == []
+
+
+def test_ptl301_lambda_call_sites_exempt():
+    # a deferred claim's unwind lives in the eventual caller's handler
+    findings = lint_source(_src("""
+        class Engine:
+            def plan(self, cache, s):
+                return lambda: cache.try_reserve(s)
+    """))
+    assert findings == []
+
+
+def test_ptl301_defining_class_exempt():
+    findings = lint_source(_src("""
+        class SlotCache:
+            def try_reserve(self, s):
+                return s
+
+            def warm(self, s):
+                self.try_reserve(s)
+    """))
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# fault-point registry (PTL401–404) on a synthetic project
+# ---------------------------------------------------------------------------
+
+def test_fault_registry_both_directions():
+    faults = make_unit(_src("""
+        KNOWN_POINTS = (
+            "serving.a",
+            "serving.dead",
+        )
+    """), "pkg/resilience/faults.py")
+    chaos = make_unit(_src("""
+        SERVING_SWEEP = (
+            "serving.a",
+            "serving.orphan",
+        )
+    """), "pkg/resilience/chaos.py")
+    engine = make_unit(_src("""
+        from ..resilience.faults import maybe_fail
+
+
+        def step():
+            maybe_fail("serving.a")
+            maybe_fail("serving.typo")
+    """), "pkg/serving/engine.py")
+
+    findings = lint_units([faults, chaos, engine], project_root=None)
+    assert [(f.code, f.path, f.line) for f in findings] == [
+        ("PTL404", "pkg/resilience/chaos.py", 3),
+        ("PTL402", "pkg/resilience/faults.py", 3),
+        ("PTL403", "pkg/resilience/faults.py", 3),
+        ("PTL401", "pkg/serving/engine.py", 6),
+    ]
+
+
+def test_fault_registry_clean_project():
+    faults = make_unit(_src("""
+        KNOWN_POINTS = (
+            "serving.a",
+        )
+    """), "pkg/resilience/faults.py")
+    chaos = make_unit(_src("""
+        SERVING_SWEEP = (
+            "serving.a",
+        )
+    """), "pkg/resilience/chaos.py")
+    engine = make_unit(_src("""
+        from ..resilience.faults import maybe_fail
+
+
+        def step():
+            maybe_fail("serving.a")
+    """), "pkg/serving/engine.py")
+    findings = lint_units([faults, chaos, engine], project_root=None)
+    assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# mechanics: inline suppression + baseline matching
+# ---------------------------------------------------------------------------
+
+def test_inline_suppression_same_line_with_justification():
+    findings = lint_source(_src("""
+        import time
+
+        import jax
+
+
+        @jax.jit
+        def step(x):
+            t = time.time()  # ptpu-lint: disable=PTL101 -- trace stamp
+            return x * t
+    """))
+    assert findings == []
+
+
+def test_inline_suppression_line_above():
+    findings = lint_source(_src("""
+        class Engine:
+            def step(self, cache, s):
+                # ptpu-lint: disable=PTL301 -- caller unwinds
+                cache.try_reserve(s)
+                return s
+    """))
+    assert findings == []
+
+
+def test_baseline_matches_by_context_not_line(tmp_path):
+    # the same source line at a DIFFERENT line number still matches —
+    # baselines survive edits elsewhere in the file
+    (tmp_path / "m.py").write_text(
+        "# moved down by an unrelated edit\n"
+        "cache.try_reserve(s)\n")
+    f = Finding("PTL301", "msg", "m.py", 2)
+    baseline = [{"code": "PTL301", "path": "m.py",
+                 "context": "cache.try_reserve(s)", "why": "tested"}]
+    new, n = apply_baseline([f], baseline, str(tmp_path))
+    assert new == [] and n == 1
+
+    # a second finding with the same key exceeds the count budget
+    new, n = apply_baseline([f, f], baseline, str(tmp_path))
+    assert n == 1 and _hits(new) == [("PTL301", 2)]
+
+    # a different source line does not match
+    other = Finding("PTL301", "msg", "m.py", 1)
+    new, n = apply_baseline([other], baseline, str(tmp_path))
+    assert n == 0 and len(new) == 1
+
+
+def test_make_baseline_round_trip(tmp_path):
+    (tmp_path / "m.py").write_text("cache.try_reserve(s)\n")
+    f = Finding("PTL301", "msg", "m.py", 1)
+    data = make_baseline([f], str(tmp_path))
+    new, n = apply_baseline([f], data["findings"], str(tmp_path))
+    assert new == [] and n == 1
+
+
+# ---------------------------------------------------------------------------
+# the gate: paddle_tpu/ lints clean against the committed baseline
+# ---------------------------------------------------------------------------
+
+def test_package_lints_clean_with_baseline():
+    findings, errors = lint_paths(["paddle_tpu"],
+                                  project_root=str(REPO))
+    assert errors == []
+    baseline = load_baseline(str(BASELINE_PATH))
+    new, n_baselined = apply_baseline(findings, baseline, str(REPO))
+    assert new == [], "new ptpu-lint findings:\n" + "\n".join(
+        f.format() for f in new)
+    # every baseline entry must still be live — a fixed finding must
+    # be REMOVED from the baseline, not silently absorbed
+    assert n_baselined == len(baseline)
+
+
+def test_baseline_entries_carry_justification():
+    for e in load_baseline(str(BASELINE_PATH)):
+        assert e.get("why", "").strip(), \
+            f"baseline entry without a 'why': {e}"
+        assert "TODO" not in e["why"]
+
+
+def test_fault_points_doc_in_sync():
+    units = []
+    for fp in iter_py_files(["paddle_tpu"], root=str(REPO)):
+        with open(fp, encoding="utf-8") as fh:
+            units.append(make_unit(fh.read(),
+                                   os.path.relpath(fp, str(REPO))))
+    expect = generate_catalog(units, str(REPO))
+    actual = (REPO / DOC_PATH).read_text(encoding="utf-8")
+    assert actual == expect, \
+        "docs/FAULT_POINTS.md drifted — regenerate with " \
+        "`python -m tools.ptpu_lint --write-docs`"
+
+
+def test_cli_exit_zero_and_metrics():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.ptpu_lint", "paddle_tpu",
+         "--json", "--metrics"],
+        cwd=str(REPO), capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    body, _, metrics = proc.stdout.partition(
+        "ptpu_lint_findings_total")
+    payload = json.loads(body)
+    assert payload["findings"] == []
+    assert payload["parse_errors"] == []
+    assert 'ptpu_lint_findings_total{status="new"} 0' \
+        in "ptpu_lint_findings_total" + metrics

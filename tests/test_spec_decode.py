@@ -386,3 +386,67 @@ def test_verify_fault_point_is_wired():
     assert faults.fired("serving.decode.verify") == 1
     eng.run()                            # CPU pools: step just retries
     faults.clear()
+
+
+def test_faulted_verify_returns_overclaimed_pages():
+    """Regression (ptpu-lint PTL301 on the verify step): a paged
+    verify step claims the FULL k-wide write window up front
+    (ensure_decode_range), then hits the mid-step kill point. Before
+    the unwind existed, a faulted-but-retryable step stranded every
+    page past the one holding next_pos — each faulted step silently
+    shrank the admission pool until the request finished. The handler
+    must rollback_speculation() so the pool (free pages AND the
+    reservation budget) is byte-identical to the pre-step snapshot,
+    and the retried step must still produce base-identical output."""
+    from paddle_tpu.observability import MetricRegistry
+    from paddle_tpu.resilience import faults
+    from paddle_tpu.resilience.invariants import page_leak_violations
+    model = _tiny_llama()
+    kw = dict(max_slots=1, max_len=64, min_bucket=8,
+              kv_layout="paged", page_size=8)
+    # own registries: spec_k=8 buckets must not collide with the
+    # default registry's spec_k=4 histograms from earlier tests
+    eng = ServingEngine(model, speculative=True, spec_k=8,
+                        registry=MetricRegistry(), **kw)
+    base = ServingEngine(model, registry=MetricRegistry(), **kw)
+    prompt = np.arange(1, 13).astype(np.int64)   # 12 = 2 full pages
+    h = eng.submit(prompt, max_new_tokens=10)
+    hb = base.submit(prompt, max_new_tokens=10)
+
+    # phase 1 — draft-less steps walk next_pos just past the page
+    # boundary, deterministically
+    eng.proposer.propose = \
+        lambda rid, ids, k: np.empty((0,), np.int64)
+    for _ in range(4):
+        eng.step()
+        if len(h.output_ids) >= 2:
+            break
+    req = eng.cache.slots[0]
+    assert req is not None and req.rid == h.rid
+
+    # phase 2 — force a full-width draft: the 8-wide verify window
+    # crosses into a page the row does not hold yet, so the faulted
+    # step REALLY claims a fresh page before it dies
+    eng.proposer.propose = \
+        lambda rid, ids, k: np.arange(1, 1 + k, dtype=np.int64)
+    last_page = (req.next_pos + eng.spec_k - 1) // 8
+    assert last_page > req.next_pos // 8
+    assert int(eng.cache.page_table[0][last_page]) == 0
+
+    free0 = eng.cache.free_page_count()
+    comm0 = eng.cache._committed
+    faults.inject("serving.decode.verify", times=1)
+    with pytest.raises(faults.InjectedFault):
+        eng.step()
+    assert faults.fired("serving.decode.verify") == 1
+    # the unwind returned the over-claimed window page(s); pre-fix
+    # this reads free0 - 1 and the stranded page never comes back
+    assert eng.cache.free_page_count() == free0
+    assert eng.cache._committed == comm0
+    assert int(eng.cache.page_table[0][last_page]) == 0
+
+    faults.clear()
+    eng.run()                            # retry replays the step
+    base.run()
+    assert h.output_ids == hb.output_ids
+    assert page_leak_violations(eng) == []

@@ -1,0 +1,10 @@
+"""ptpu-lint: AST-based static analyzer for paddle_tpu's framework
+invariants (trace hygiene, lock discipline, resource pairing, the
+fault-point registry). See docs/STATIC_ANALYSIS.md."""
+from .core import (Finding, lint_paths, lint_source, lint_units,
+                   make_unit, load_baseline, apply_baseline,
+                   make_baseline)
+
+__all__ = ["Finding", "lint_paths", "lint_source", "lint_units",
+           "make_unit", "load_baseline", "apply_baseline",
+           "make_baseline"]

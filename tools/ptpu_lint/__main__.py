@@ -1,0 +1,111 @@
+"""CLI: ``python -m tools.ptpu_lint [paths...]``.
+
+Exit codes: 0 = clean (non-baselined findings: none), 1 = new
+findings, 2 = usage/parse failure. ``--json`` emits one JSON object;
+the default human output is one ``path:line:col: CODE message`` per
+finding plus a summary. ``--metrics`` appends Prometheus-style
+``ptpu_lint_findings_total{status=...}`` lines so benchmark
+pre-flights can track the suppressed-baseline trajectory.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import (apply_baseline, iter_py_files, lint_paths,
+                   load_baseline, make_baseline, make_unit)
+from .checks.fault_registry import DOC_PATH, generate_catalog
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(__file__),
+                                "baseline.json")
+
+
+def _project_root() -> str:
+    """The repo root: cwd when it holds paddle_tpu/, else walk up."""
+    d = os.getcwd()
+    while True:
+        if os.path.isdir(os.path.join(d, "paddle_tpu")):
+            return d
+        parent = os.path.dirname(d)
+        if parent == d:
+            return os.getcwd()
+        d = parent
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="ptpu_lint")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: paddle_tpu/)")
+    ap.add_argument("--root", default=None,
+                    help="project root (default: auto-detect)")
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline file (use '' to disable)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="write the current findings as the new "
+                         "baseline and exit")
+    ap.add_argument("--write-docs", action="store_true",
+                    help=f"regenerate {DOC_PATH} and exit")
+    ap.add_argument("--metrics", action="store_true",
+                    help="append ptpu_lint_findings_total lines")
+    opts = ap.parse_args(argv)
+
+    root = os.path.abspath(opts.root) if opts.root \
+        else _project_root()
+    paths = opts.paths or ["paddle_tpu"]
+
+    findings, errors = lint_paths(paths, project_root=root)
+    for e in errors:
+        print(f"ptpu_lint: {e}", file=sys.stderr)
+
+    if opts.write_docs:
+        units = []
+        for fp in iter_py_files(paths, root=root):
+            with open(fp, encoding="utf-8") as fh:
+                units.append(make_unit(fh.read(),
+                                       os.path.relpath(fp, root)))
+        doc = generate_catalog(units, root)
+        out = os.path.join(root, DOC_PATH)
+        os.makedirs(os.path.dirname(out), exist_ok=True)
+        with open(out, "w", encoding="utf-8") as fh:
+            fh.write(doc)
+        print(f"wrote {DOC_PATH}")
+        return 0
+
+    if opts.write_baseline:
+        with open(opts.baseline, "w", encoding="utf-8") as fh:
+            json.dump(make_baseline(findings, root), fh, indent=1)
+            fh.write("\n")
+        print(f"wrote {len(findings)} finding(s) to {opts.baseline}")
+        return 0
+
+    baseline = []
+    if opts.baseline and os.path.exists(opts.baseline):
+        baseline = load_baseline(opts.baseline)
+    new, n_baselined = apply_baseline(findings, baseline, root)
+
+    if opts.as_json:
+        print(json.dumps({
+            "findings": [f.to_json() for f in new],
+            "baselined": n_baselined,
+            "total": len(findings),
+            "parse_errors": errors}, indent=1))
+    else:
+        for f in new:
+            print(f.format())
+        print(f"ptpu_lint: {len(new)} new finding(s), "
+              f"{n_baselined} baselined, "
+              f"{len(iter_py_files(paths, root=root))} file(s)")
+    if opts.metrics:
+        print(f'ptpu_lint_findings_total{{status="new"}} {len(new)}')
+        print(f'ptpu_lint_findings_total{{status="baselined"}} '
+              f'{n_baselined}')
+    if errors:
+        return 2
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,5 @@
+"""Check plugins — importing this package registers every check."""
+from . import trace_hygiene    # noqa: F401
+from . import lock_discipline  # noqa: F401
+from . import resource_pairing  # noqa: F401
+from . import fault_registry   # noqa: F401

@@ -1,0 +1,62 @@
+"""Shared AST helpers for the check plugins."""
+from __future__ import annotations
+
+import ast
+from typing import Dict, Optional
+
+__all__ = ["import_aliases", "dotted_name", "resolved_name",
+           "attr_chain_root"]
+
+
+def import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """Map local names to their imported dotted origin:
+    ``import numpy as np`` -> {"np": "numpy"};
+    ``from jax import jit as J`` -> {"J": "jax.jit"}."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = \
+                    a.name if a.asname else a.name.split(".")[0]
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            for a in node.names:
+                out[a.asname or a.name] = \
+                    f"{mod}.{a.name}" if mod else a.name
+    return out
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def resolved_name(node: ast.AST,
+                  aliases: Dict[str, str]) -> Optional[str]:
+    """Dotted name with the leading alias resolved to its import
+    origin (``np.random.rand`` -> ``numpy.random.rand``)."""
+    dn = dotted_name(node)
+    if dn is None:
+        return None
+    head, _, rest = dn.partition(".")
+    origin = aliases.get(head)
+    if origin is None:
+        return dn
+    return f"{origin}.{rest}" if rest else origin
+
+
+def attr_chain_root(node: ast.AST) -> Optional[str]:
+    """The root Name of an attribute chain (``self`` for
+    ``self.cache.release``)."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    if isinstance(node, ast.Name):
+        return node.id
+    return None

@@ -1,0 +1,226 @@
+"""Fault-point registry (PTL401–405): the catalogue stays closed.
+
+``resilience/faults.KNOWN_POINTS`` is the registry the chaos sweeps
+sample from; ``maybe_fail("<point>")`` call sites (and the
+``_fault(...)`` framing wrapper) are the instrumented reality. This
+pass proves the two agree in both directions, that every point is
+exercised by a chaos sweep or a test, and that the generated
+``docs/FAULT_POINTS.md`` catalogue matches the code.
+
+- PTL401 — ``maybe_fail``/``_fault`` call site names a point that is
+  not in ``KNOWN_POINTS`` (typo'd point: never swept, never killed).
+- PTL402 — ``KNOWN_POINTS`` entry with no call site (dead registry
+  row: the soak arms it, nothing can ever fire).
+- PTL403 — point never referenced by a chaos sweep or a test.
+- PTL404 — chaos sweep entry that is not a known point (orphan arm).
+- PTL405 — ``docs/FAULT_POINTS.md`` missing or out of sync
+  (regenerate with ``python -m tools.ptpu_lint --write-docs``).
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Tuple
+
+from ..core import FileUnit, Finding, project_check
+
+FAULTS_FILE = "resilience/faults.py"
+CHAOS_FILE = "resilience/chaos.py"
+CALL_NAMES = {"maybe_fail", "_fault"}
+DOC_PATH = "docs/FAULT_POINTS.md"
+
+
+def _find_unit(units: List[FileUnit],
+               suffix: str) -> Optional[FileUnit]:
+    for u in units:
+        if u.path.endswith(suffix):
+            return u
+    return None
+
+
+def _known_points(unit: FileUnit) -> Dict[str, int]:
+    """point -> lineno from the KNOWN_POINTS tuple literal."""
+    out: Dict[str, int] = {}
+    for node in unit.tree.body:
+        if isinstance(node, ast.Assign) \
+                and any(isinstance(t, ast.Name)
+                        and t.id == "KNOWN_POINTS"
+                        for t in node.targets) \
+                and isinstance(node.value, (ast.Tuple, ast.List)):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) \
+                        and isinstance(elt.value, str):
+                    out[elt.value] = elt.lineno
+    return out
+
+
+def _call_sites(units: List[FileUnit]
+                ) -> Dict[str, List[Tuple[str, int]]]:
+    out: Dict[str, List[Tuple[str, int]]] = {}
+    for u in units:
+        if u.path.endswith(FAULTS_FILE):
+            continue                 # the implementation itself
+        for node in ast.walk(u.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            name = fn.attr if isinstance(fn, ast.Attribute) else \
+                (fn.id if isinstance(fn, ast.Name) else None)
+            if name not in CALL_NAMES:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) \
+                    and isinstance(arg.value, str):
+                out.setdefault(arg.value, []).append(
+                    (u.path, node.lineno))
+    return out
+
+
+def _sweep_refs(chaos: Optional[FileUnit]
+                ) -> Dict[str, List[Tuple[str, str, int]]]:
+    """point -> [(sweep name, path, lineno)] from *_SWEEP tuples."""
+    out: Dict[str, List[Tuple[str, str, int]]] = {}
+    if chaos is None:
+        return out
+    for node in chaos.tree.body:
+        if not isinstance(node, ast.Assign):
+            continue
+        names = [t.id for t in node.targets
+                 if isinstance(t, ast.Name)]
+        if not names or not names[0].endswith("_SWEEP"):
+            continue
+        if isinstance(node.value, (ast.Tuple, ast.List)):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) \
+                        and isinstance(elt.value, str):
+                    out.setdefault(elt.value, []).append(
+                        (names[0], chaos.path, elt.lineno))
+    return out
+
+
+def _text_refs(project_root: Optional[str],
+               points: List[str]) -> Dict[str, List[str]]:
+    """point -> test/benchmark files mentioning it (raw text scan —
+    tests reference points as string literals)."""
+    out: Dict[str, List[str]] = {p: [] for p in points}
+    if project_root is None:
+        return out
+    for sub in ("tests", "benchmarks"):
+        d = os.path.join(project_root, sub)
+        if not os.path.isdir(d):
+            continue
+        for dirpath, dirnames, filenames in os.walk(d):
+            dirnames[:] = [x for x in dirnames
+                           if x != "__pycache__"]
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                fp = os.path.join(dirpath, fn)
+                try:
+                    with open(fp, encoding="utf-8") as fh:
+                        text = fh.read()
+                except OSError:
+                    continue
+                rel = os.path.relpath(fp, project_root) \
+                    .replace(os.sep, "/")
+                for p in points:
+                    if p in text:
+                        out[p].append(rel)
+    return out
+
+
+def generate_catalog(units: List[FileUnit],
+                     project_root: Optional[str] = None) -> str:
+    """The docs/FAULT_POINTS.md content (deterministic; call-site
+    paths only, no line numbers, so edits elsewhere in a file don't
+    churn the doc)."""
+    faults = _find_unit(units, FAULTS_FILE)
+    if faults is None:
+        return ""
+    known = _known_points(faults)
+    sites = _call_sites(units)
+    sweeps = _sweep_refs(_find_unit(units, CHAOS_FILE))
+    lines = [
+        "# Fault-point catalogue",
+        "",
+        "Generated by `python -m tools.ptpu_lint --write-docs` from",
+        "`resilience/faults.KNOWN_POINTS`, the `maybe_fail()` call",
+        "sites, and the chaos sweeps. Do not edit by hand — the",
+        "fault-registry lint pass (PTL405) fails when this file",
+        "drifts from the code.",
+        "",
+        "| point | instrumented in | owning sweep |",
+        "|---|---|---|",
+    ]
+    for point in known:              # registry order, not sorted —
+        files = sorted({p for p, _ in sites.get(point, [])})
+        sw = sorted({s for s, _, _ in sweeps.get(point, [])})
+        lines.append(
+            f"| `{point}` | {', '.join(f'`{f}`' for f in files)} "
+            f"| {', '.join(sw) if sw else '—'} |")
+    lines.append("")
+    return "\n".join(lines)
+
+
+@project_check("fault-registry")
+def check_fault_registry(units: List[FileUnit],
+                         project_root: Optional[str]
+                         ) -> List[Finding]:
+    faults = _find_unit(units, FAULTS_FILE)
+    if faults is None:
+        return []
+    findings: List[Finding] = []
+    known = _known_points(faults)
+    sites = _call_sites(units)
+    sweeps = _sweep_refs(_find_unit(units, CHAOS_FILE))
+    tests = _text_refs(project_root, list(known))
+
+    for point, where in sorted(sites.items()):
+        if point not in known:
+            for path, line in where:
+                findings.append(Finding(
+                    "PTL401",
+                    f"maybe_fail point {point!r} is not in "
+                    f"faults.KNOWN_POINTS (typo, or register it)",
+                    path, line))
+    for point, line in known.items():
+        if point not in sites:
+            findings.append(Finding(
+                "PTL402",
+                f"KNOWN_POINTS entry {point!r} has no "
+                f"maybe_fail call site — dead registry row",
+                faults.path, line))
+        if point not in sweeps and not tests.get(point):
+            findings.append(Finding(
+                "PTL403",
+                f"fault point {point!r} is referenced by no chaos "
+                f"sweep and no test — nothing exercises its "
+                f"recovery path",
+                faults.path, line))
+    for point, where in sorted(sweeps.items()):
+        if point not in known:
+            for sweep, path, line in where:
+                findings.append(Finding(
+                    "PTL404",
+                    f"chaos sweep {sweep} arms unknown point "
+                    f"{point!r} (orphan arm: maybe_fail never "
+                    f"evaluates it)",
+                    path, line))
+
+    if project_root is not None:
+        expect = generate_catalog(units, project_root)
+        doc = os.path.join(project_root, DOC_PATH)
+        try:
+            with open(doc, encoding="utf-8") as fh:
+                actual = fh.read()
+        except OSError:
+            actual = None
+        if actual != expect:
+            findings.append(Finding(
+                "PTL405",
+                f"{DOC_PATH} is "
+                f"{'missing' if actual is None else 'out of sync'} "
+                f"— regenerate with `python -m tools.ptpu_lint "
+                f"--write-docs`",
+                DOC_PATH, 1))
+    return findings

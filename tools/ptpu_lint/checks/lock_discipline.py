@@ -1,0 +1,215 @@
+"""Lock discipline (PTL201/202/203): a static race detector for the
+threaded parts of the stack (front door + HTTP handler threads,
+observability registries, dataloader worker threads).
+
+Convention (docs/STATIC_ANALYSIS.md):
+
+- ``self._attr = ...  # guarded-by: _lock`` on the attribute's
+  assignment declares that every access to ``self._attr`` must happen
+  lexically inside ``with self._lock:`` (or inside a method annotated
+  as below). The named lock must itself be a ``threading`` primitive
+  assigned on ``self`` in the same class (else PTL202).
+- ``# requires-lock: _lock`` on (or directly above) a ``def`` declares
+  the method is only ever called with the lock already held; its body
+  counts as locked context, and *calling* it from an unlocked context
+  is its own finding (PTL203).
+- ``__init__`` is exempt (single-threaded construction precedes
+  publication).
+- A guarded attribute is private to its class: any access through a
+  different receiver (``other.front._handles``) is PTL201 — go
+  through a locked accessor instead.
+
+Findings:
+
+- PTL201 — guarded attribute accessed outside ``with <lock>`` (or
+  outside its owning class).
+- PTL202 — ``guarded-by`` names a lock not assigned in the class.
+- PTL203 — ``requires-lock`` method called without the lock held.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set
+
+from ..core import FileUnit, Finding, file_check
+from ._ast_util import dotted_name
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
+_REQUIRES_RE = re.compile(r"#\s*requires-lock:\s*([A-Za-z_]\w*)")
+_LOCK_CTORS = ("Lock", "RLock", "Condition", "Semaphore",
+               "BoundedSemaphore")
+
+
+def _self_attr_target(stmt: ast.stmt) -> Optional[str]:
+    """``self.X`` when stmt assigns exactly that, else None."""
+    if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+        t = stmt.targets[0]
+    elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        t = stmt.target
+    else:
+        return None
+    if isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name) \
+            and t.value.id == "self":
+        return t.attr
+    return None
+
+
+def _is_lock_ctor(value: ast.AST) -> bool:
+    if not isinstance(value, ast.Call):
+        return False
+    dn = dotted_name(value.func) or ""
+    return dn.split(".")[-1] in _LOCK_CTORS
+
+
+class _ClassInfo:
+    def __init__(self, node: ast.ClassDef):
+        self.node = node
+        self.guarded: Dict[str, str] = {}        # attr -> lock name
+        self.guard_lines: Dict[str, int] = {}
+        self.locks: Set[str] = set()
+        self.requires: Dict[str, str] = {}       # method -> lock name
+        self.methods: Set[str] = set()
+
+
+def _collect_class(unit: FileUnit, node: ast.ClassDef) -> _ClassInfo:
+    info = _ClassInfo(node)
+    for item in node.body:
+        if not isinstance(item, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        info.methods.add(item.name)
+        for ln in (item.lineno, item.lineno - 1):
+            m = _REQUIRES_RE.search(unit.line_text(ln))
+            if m:
+                info.requires[item.name] = m.group(1)
+                break
+        for stmt in ast.walk(item):
+            if not isinstance(stmt, (ast.Assign, ast.AnnAssign,
+                                     ast.AugAssign)):
+                continue
+            attr = _self_attr_target(stmt)
+            if attr is None:
+                continue
+            value = getattr(stmt, "value", None)
+            if value is not None and _is_lock_ctor(value):
+                info.locks.add(attr)
+            m = _GUARDED_RE.search(unit.line_text(stmt.lineno))
+            if m:
+                info.guarded[attr] = m.group(1)
+                info.guard_lines.setdefault(attr, stmt.lineno)
+    return info
+
+
+def _with_locks(node: ast.With) -> Set[str]:
+    """Lock names taken by ``with self.X [, self.Y]``."""
+    out: Set[str] = set()
+    for item in node.items:
+        e = item.context_expr
+        if isinstance(e, ast.Attribute) \
+                and isinstance(e.value, ast.Name) \
+                and e.value.id == "self":
+            out.add(e.attr)
+    return out
+
+
+def _check_method(unit: FileUnit, info: _ClassInfo,
+                  method: ast.AST, held0: Set[str],
+                  findings: List[Finding]) -> None:
+
+    def visit(node: ast.AST, held: Set[str]) -> None:
+        if isinstance(node, ast.With):
+            held = held | _with_locks(node)
+        if isinstance(node, ast.Attribute) \
+                and isinstance(node.value, ast.Name) \
+                and node.value.id == "self" \
+                and node.attr in info.guarded:
+            lock = info.guarded[node.attr]
+            if lock not in held:
+                findings.append(Finding(
+                    "PTL201",
+                    f"access to {info.node.name}.{node.attr} "
+                    f"(guarded-by: {lock}) outside `with "
+                    f"self.{lock}`",
+                    unit.path, node.lineno, node.col_offset))
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and isinstance(node.func.value, ast.Name) \
+                and node.func.value.id == "self" \
+                and node.func.attr in info.requires:
+            lock = info.requires[node.func.attr]
+            if lock not in held:
+                findings.append(Finding(
+                    "PTL203",
+                    f"{info.node.name}.{node.func.attr}() requires "
+                    f"lock {lock!r} but is called without it held",
+                    unit.path, node.lineno, node.col_offset))
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in method.body:
+        visit(stmt, set(held0))
+
+
+@file_check("lock-discipline")
+def check_lock_discipline(unit: FileUnit) -> List[Finding]:
+    findings: List[Finding] = []
+    classes = [n for n in ast.walk(unit.tree)
+               if isinstance(n, ast.ClassDef)]
+    infos = [_collect_class(unit, c) for c in classes]
+
+    for info in infos:
+        # PTL202: guarded-by names an unknown lock
+        for attr, lock in info.guarded.items():
+            if lock not in info.locks:
+                findings.append(Finding(
+                    "PTL202",
+                    f"{info.node.name}.{attr} is guarded-by "
+                    f"{lock!r}, but no `self.{lock} = "
+                    f"threading.<Lock/RLock/Condition>()` exists in "
+                    f"the class",
+                    unit.path, info.guard_lines.get(attr, 1)))
+                continue
+        for item in info.node.body:
+            if not isinstance(item, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            if item.name in ("__init__", "__del__"):
+                continue
+            held0: Set[str] = set()
+            if item.name in info.requires:
+                held0.add(info.requires[item.name])
+            _check_method(unit, info, item, held0, findings)
+
+    # cross-object accesses: a guarded attribute reached through any
+    # receiver other than `self` inside its owning class
+    owner_of: Dict[str, _ClassInfo] = {}
+    for info in infos:
+        for attr in info.guarded:
+            owner_of[attr] = info
+
+    class_spans = {}
+    for info in infos:
+        end = max((n.lineno for n in ast.walk(info.node)
+                   if hasattr(n, "lineno")), default=info.node.lineno)
+        class_spans[info] = (info.node.lineno, end)
+
+    for node in ast.walk(unit.tree):
+        if not isinstance(node, ast.Attribute) \
+                or node.attr not in owner_of:
+            continue
+        if isinstance(node.value, ast.Name) \
+                and node.value.id == "self":
+            owner = owner_of[node.attr]
+            lo, hi = class_spans[owner]
+            if lo <= node.lineno <= hi:
+                continue            # handled by the per-class pass
+        owner = owner_of[node.attr]
+        lock = owner.guarded[node.attr]
+        findings.append(Finding(
+            "PTL201",
+            f"{owner.node.name}.{node.attr} (guarded-by: {lock}) "
+            f"accessed from outside its owning class — use a locked "
+            f"accessor",
+            unit.path, node.lineno, node.col_offset))
+    return findings

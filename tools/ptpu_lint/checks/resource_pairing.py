@@ -1,0 +1,131 @@
+"""Resource pairing (PTL301): the no-leaked-pages/slots law as lint.
+
+Every page/slot/COW-claim acquisition — ``try_reserve``,
+``begin_sequence``, ``ensure_decode_page``, ``ensure_decode_range`` —
+must sit lexically inside a ``try`` whose except handler (or
+``finally``) reaches the matching release/unwind
+(``abort_sequence``, ``cancel_reservation``, ``release``,
+``rollback_speculation``, ``_unwind_chunk``, or an engine-level
+``recover``/cache rebuild). The chaos soak proves this dynamically per
+seed; this pass proves the *shape* for every call site, including ones
+no seed has hit yet.
+
+Deliberate scope cuts (documented in docs/STATIC_ANALYSIS.md):
+
+- acquisitions inside a ``lambda`` are deferred call sites (the
+  scheduler runs the admission claim); their unwind lives in the
+  caller's handler and is not lexically checkable — skipped;
+- the class that *defines* an acquire method is exempt inside its own
+  module (``ensure_decode_range`` looping over ``ensure_decode_page``
+  is the implementation, not a use site).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..core import FileUnit, Finding, file_check
+
+ACQUIRES = {"try_reserve", "begin_sequence", "ensure_decode_page",
+            "ensure_decode_range"}
+RELEASES = {"release", "abort_sequence", "cancel_reservation",
+            "rollback_speculation", "_unwind_chunk", "recover",
+            "_new_cache"}
+
+
+def _call_attr(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Call) \
+            and isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    return None
+
+
+def _handler_releases(try_node: ast.Try) -> bool:
+    """True when some except handler or the finally block reaches a
+    release call."""
+    bodies = [h.body for h in try_node.handlers]
+    if try_node.finalbody:
+        bodies.append(try_node.finalbody)
+    for body in bodies:
+        for stmt in body:
+            for n in ast.walk(stmt):
+                attr = _call_attr(n)
+                if attr in RELEASES:
+                    return True
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Name) \
+                        and n.func.id in RELEASES:
+                    return True
+    return False
+
+
+class _Ctx:
+    """Lexical context for one node: enclosing tries (innermost
+    last, scoped to the current function) and whether we're inside a
+    lambda or a class that defines acquire methods."""
+
+    def __init__(self):
+        self.tries: List[ast.Try] = []
+        self.in_lambda = False
+        self.in_defining_class = False
+
+
+@file_check("resource-pairing")
+def check_resource_pairing(unit: FileUnit) -> List[Finding]:
+    findings: List[Finding] = []
+
+    def visit(node: ast.AST, ctx: _Ctx) -> None:
+        if isinstance(node, ast.ClassDef):
+            sub = _Ctx()
+            sub.in_defining_class = any(
+                isinstance(item, ast.FunctionDef)
+                and item.name in ACQUIRES
+                for item in node.body) or ctx.in_defining_class
+            for child in ast.iter_child_nodes(node):
+                visit(child, sub)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sub = _Ctx()
+            sub.in_defining_class = ctx.in_defining_class
+            for child in ast.iter_child_nodes(node):
+                visit(child, sub)
+            return
+        if isinstance(node, ast.Lambda):
+            sub = _Ctx()
+            sub.in_lambda = True
+            sub.in_defining_class = ctx.in_defining_class
+            for child in ast.iter_child_nodes(node):
+                visit(child, sub)
+            return
+        if isinstance(node, ast.Try):
+            sub = _Ctx()
+            sub.tries = ctx.tries + [node]
+            sub.in_lambda = ctx.in_lambda
+            sub.in_defining_class = ctx.in_defining_class
+            for stmt in node.body + node.orelse:
+                visit(stmt, sub)
+            # handlers/finally run after the failure: acquisitions
+            # there are judged against the OUTER tries only
+            for h in node.handlers:
+                for stmt in h.body:
+                    visit(stmt, ctx)
+            for stmt in node.finalbody:
+                visit(stmt, ctx)
+            return
+        attr = _call_attr(node)
+        if attr in ACQUIRES and not ctx.in_lambda \
+                and not ctx.in_defining_class:
+            if not any(_handler_releases(t) for t in ctx.tries):
+                findings.append(Finding(
+                    "PTL301",
+                    f"acquisition `{attr}` is not inside a `try` "
+                    f"whose handler reaches a release/unwind "
+                    f"({', '.join(sorted(RELEASES))}) — a failure "
+                    f"between the claim and the step leaks "
+                    f"pages/slots",
+                    unit.path, node.lineno, node.col_offset))
+        for child in ast.iter_child_nodes(node):
+            visit(child, ctx)
+
+    visit(unit.tree, _Ctx())
+    return findings

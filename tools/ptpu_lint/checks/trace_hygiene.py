@@ -1,0 +1,263 @@
+"""Trace hygiene (PTL101/PTL102): the static half of the
+"compile count == 1" invariant.
+
+A function captured by ``jax.jit`` (decorator, ``functools.partial``
+decorator, a ``jax.jit(fn, ...)`` call, or ``@to_static``) is traced:
+its body runs once per compilation, not once per step. Host impurities
+inside it (clocks, host RNG, env reads, fault points, metrics/tracing
+calls) either silently freeze into the compiled program or defeat
+donation — and Python ``if``/``while`` on a *tracer-valued* expression
+raises at best and retraces per shape/value at worst. Both are exactly
+the bug class the engines' trace-count assertions catch dynamically;
+this pass catches them before a program ever runs.
+
+- PTL101 — host-impure call (or ``os.environ`` read) inside a
+  jit-captured function.
+- PTL102 — ``if``/``while`` on an expression derived from a non-static
+  traced argument. Static escapes recognized: ``x is None`` tests,
+  ``isinstance``, and shape-land reads (``len(x)``, ``x.shape``,
+  ``x.ndim``, ``x.dtype``, ``x.size``) — those are concrete at trace
+  time. Arguments named by ``static_argnums``/``static_argnames`` are
+  exempt.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ..core import FileUnit, Finding, file_check
+from ._ast_util import import_aliases, resolved_name
+
+# dotted names (post alias-resolution) that are host-impure inside a
+# traced function — exact matches and prefix families
+IMPURE_EXACT = {
+    "time.time", "time.monotonic", "time.perf_counter", "time.sleep",
+    "os.getenv", "os.getpid", "os.urandom",
+    "maybe_fail", "faults.maybe_fail",
+    "paddle_tpu.resilience.faults.maybe_fail",
+    "print", "input", "open",
+}
+IMPURE_PREFIX = ("numpy.random.", "np.random.", "random.",
+                 "time.clock")
+# tracing / metrics machinery: recording per-call-site data inside a
+# traced body records once per COMPILE, not once per step
+TRACING_NAMES = {"span", "paddle_tpu.observability.span",
+                 "paddle_tpu.observability.tracing.span"}
+METRIC_METHODS = {"observe", "inc", "labels", "set_attr"}
+METRIC_ROOTS = ("self.recorder", "self.metrics", "self._m_")
+
+STATIC_ATTRS = {"shape", "ndim", "dtype", "size"}
+
+
+def _jit_static_args(call: ast.Call) -> Set[str]:
+    """static_argnames from a jax.jit/partial call (argnums resolve to
+    names only at the def site; callers pass position info in)."""
+    names: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value,
+                                                              str):
+                    names.add(n.value)
+    return names
+
+
+def _jit_static_nums(call: ast.Call) -> Set[int]:
+    nums: Set[int] = set()
+    for kw in call.keywords:
+        if kw.arg in ("static_argnums", "donate_argnums"):
+            if kw.arg != "static_argnums":
+                continue
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value,
+                                                              int):
+                    nums.add(n.value)
+    return nums
+
+
+class _JitFn:
+    def __init__(self, fn: ast.AST, static_names: Set[str],
+                 static_nums: Set[int]):
+        self.fn = fn
+        self.static_names = static_names
+        self.static_nums = static_nums
+
+    def traced_params(self) -> Set[str]:
+        args = self.fn.args
+        all_args = list(args.posonlyargs) + list(args.args)
+        out: Set[str] = set()
+        for i, a in enumerate(all_args):
+            if a.arg in ("self", "cls"):
+                continue
+            if i in self.static_nums or a.arg in self.static_names:
+                continue
+            out.add(a.arg)
+        for a in args.kwonlyargs:
+            if a.arg not in self.static_names:
+                out.add(a.arg)
+        return out
+
+
+def _is_jit_name(name: Optional[str]) -> bool:
+    if name is None:
+        return False
+    return name in ("jax.jit", "jax.pjit", "pjit.pjit") \
+        or name.endswith(".to_static") or name == "to_static"
+
+
+def _find_jit_functions(unit: FileUnit) -> List[_JitFn]:
+    aliases = import_aliases(unit.tree)
+    # local function definitions by name (for jax.jit(fn, ...) calls)
+    defs = {}
+    for node in ast.walk(unit.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs.setdefault(node.name, node)
+    out: List[_JitFn] = []
+    seen = set()
+
+    def add(fn, names, nums):
+        if id(fn) in seen:
+            return
+        seen.add(id(fn))
+        out.append(_JitFn(fn, names, nums))
+
+    for node in ast.walk(unit.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _is_jit_name(resolved_name(dec, aliases)):
+                    add(node, set(), set())
+                elif isinstance(dec, ast.Call):
+                    fn_name = resolved_name(dec.func, aliases)
+                    if _is_jit_name(fn_name):
+                        add(node, _jit_static_args(dec),
+                            _jit_static_nums(dec))
+                    elif fn_name in ("functools.partial", "partial") \
+                            and dec.args \
+                            and _is_jit_name(
+                                resolved_name(dec.args[0], aliases)):
+                        add(node, _jit_static_args(dec),
+                            _jit_static_nums(dec))
+        elif isinstance(node, ast.Call) \
+                and _is_jit_name(resolved_name(node.func, aliases)):
+            if node.args:
+                target = node.args[0]
+                if isinstance(target, ast.Name) \
+                        and target.id in defs:
+                    add(defs[target.id], _jit_static_args(node),
+                        _jit_static_nums(node))
+                elif isinstance(target, ast.Lambda):
+                    add(target, _jit_static_args(node),
+                        _jit_static_nums(node))
+    return out
+
+
+def _metric_receiver(dn: Optional[str]) -> bool:
+    if dn is None:
+        return False
+    return any(dn.startswith(r) for r in METRIC_ROOTS)
+
+
+def _impure_call_reason(node: ast.Call, aliases) -> Optional[str]:
+    dn = resolved_name(node.func, aliases)
+    if dn is None:
+        return None
+    if dn in IMPURE_EXACT or dn in TRACING_NAMES:
+        return dn
+    if any(dn.startswith(p) for p in IMPURE_PREFIX):
+        return dn
+    if isinstance(node.func, ast.Attribute) \
+            and node.func.attr in METRIC_METHODS \
+            and _metric_receiver(resolved_name(node.func.value,
+                                               aliases)):
+        return dn
+    return None
+
+
+def _names_in_static_position(test: ast.AST) -> Set[int]:
+    """ids of Name nodes inside ``test`` that sit in a shape-land /
+    type-land position (concrete at trace time)."""
+    static_ids: Set[int] = set()
+
+    def mark(node):
+        for n in ast.walk(node):
+            if isinstance(n, ast.Name):
+                static_ids.add(id(n))
+
+    for n in ast.walk(test):
+        if isinstance(n, ast.Call):
+            fn = n.func
+            if isinstance(fn, ast.Name) \
+                    and fn.id in ("len", "isinstance", "getattr",
+                                  "hasattr", "type"):
+                for a in n.args:
+                    mark(a)
+        elif isinstance(n, ast.Attribute) and n.attr in STATIC_ATTRS:
+            mark(n.value)
+        elif isinstance(n, ast.Compare) \
+                and all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in n.ops):
+            mark(n)
+        elif isinstance(n, ast.Compare) \
+                and all(isinstance(op, (ast.In, ast.NotIn))
+                        for op in n.ops):
+            # `key in traced_dict` tests the pytree's STRUCTURE
+            # (keys are concrete at trace time); only the needle can
+            # carry tracers
+            for c in n.comparators:
+                mark(c)
+    return static_ids
+
+
+def _tracer_valued(test: ast.AST, traced: Set[str]) -> bool:
+    static_ids = _names_in_static_position(test)
+    for n in ast.walk(test):
+        if isinstance(n, ast.Name) and n.id in traced \
+                and id(n) not in static_ids:
+            return True
+    return False
+
+
+@file_check("trace-hygiene")
+def check_trace_hygiene(unit: FileUnit) -> List[Finding]:
+    aliases = import_aliases(unit.tree)
+    findings: List[Finding] = []
+    for jf in _find_jit_functions(unit):
+        traced = jf.traced_params()
+        body = jf.fn.body if isinstance(jf.fn.body, list) \
+            else [jf.fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Call):
+                    reason = _impure_call_reason(node, aliases)
+                    if reason is not None:
+                        findings.append(Finding(
+                            "PTL101",
+                            f"host-impure call {reason!r} inside "
+                            f"jit-captured function "
+                            f"{getattr(jf.fn, 'name', '<lambda>')!r} "
+                            f"(runs at TRACE time, not per step)",
+                            unit.path, node.lineno, node.col_offset))
+                elif isinstance(node, ast.Attribute) \
+                        and node.attr == "environ" \
+                        and resolved_name(node, aliases) \
+                        == "os.environ":
+                    findings.append(Finding(
+                        "PTL101",
+                        f"os.environ read inside jit-captured "
+                        f"function "
+                        f"{getattr(jf.fn, 'name', '<lambda>')!r}",
+                        unit.path, node.lineno, node.col_offset))
+                elif isinstance(node, (ast.If, ast.While)) \
+                        and _tracer_valued(node.test, traced):
+                    kind = "if" if isinstance(node, ast.If) \
+                        else "while"
+                    findings.append(Finding(
+                        "PTL102",
+                        f"Python `{kind}` on a tracer-valued "
+                        f"expression inside jit-captured function "
+                        f"{getattr(jf.fn, 'name', '<lambda>')!r} "
+                        f"(retrace/concretization hazard; use "
+                        f"lax.cond/where or mark the argument "
+                        f"static)",
+                        unit.path, node.lineno, node.col_offset))
+    return findings

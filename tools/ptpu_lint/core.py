@@ -1,0 +1,255 @@
+"""ptpu-lint core: finding model, check registry, suppression, baseline.
+
+The analyzer is stdlib-``ast`` only (no third-party deps — it runs in
+tier-1 and in forked benchmark pre-flights). Checks come in two shapes:
+
+- *file checks* see one parsed file at a time (trace hygiene, lock
+  discipline, resource pairing);
+- *project checks* see every parsed file plus the repo root (the
+  fault-point registry, which must cross-reference call sites, the
+  chaos sweeps, the tests, and the generated catalog).
+
+Suppression has two layers, both requiring a visible justification:
+
+- inline: a ``# ptpu-lint: disable=PTL301 -- why`` comment on the
+  finding's line or the line directly above it;
+- baseline: ``tools/ptpu_lint/baseline.json`` entries matched by
+  (code, path, stripped source line) — line numbers drift, source
+  lines don't — so pre-existing, *justified* findings keep the build
+  green without pinning the file layout.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+import re
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+__all__ = ["Finding", "FileUnit", "make_unit", "file_check",
+           "project_check", "lint_units", "lint_source", "lint_paths",
+           "iter_py_files", "load_baseline", "apply_baseline",
+           "make_baseline"]
+
+
+@dataclasses.dataclass
+class Finding:
+    code: str            # e.g. "PTL301"
+    message: str
+    path: str            # repo-relative, forward slashes
+    line: int
+    col: int = 0
+
+    def context(self, src_lines: Optional[Sequence[str]] = None) -> str:
+        if src_lines and 0 < self.line <= len(src_lines):
+            return src_lines[self.line - 1].strip()
+        return ""
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: " \
+               f"{self.code} {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class FileUnit:
+    """One parsed source file (path is repo-relative)."""
+
+    def __init__(self, path: str, src: str, tree: ast.Module):
+        self.path = path.replace(os.sep, "/")
+        self.src = src
+        self.tree = tree
+        self.lines = src.splitlines()
+
+    def line_text(self, lineno: int) -> str:
+        if 0 < lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+def make_unit(src: str, path: str = "<string>") -> FileUnit:
+    return FileUnit(path, src, ast.parse(src))
+
+
+# -- check registry ---------------------------------------------------
+
+FILE_CHECKS: List[Tuple[str, Callable[[FileUnit], List[Finding]]]] = []
+PROJECT_CHECKS: List[Tuple[str, Callable[[List[FileUnit],
+                                          Optional[str]],
+                                         List[Finding]]]] = []
+
+
+def file_check(name: str):
+    """Register a per-file check: ``fn(unit) -> [Finding]``."""
+    def deco(fn):
+        FILE_CHECKS.append((name, fn))
+        return fn
+    return deco
+
+
+def project_check(name: str):
+    """Register a whole-project check:
+    ``fn(units, project_root) -> [Finding]``."""
+    def deco(fn):
+        PROJECT_CHECKS.append((name, fn))
+        return fn
+    return deco
+
+
+def _ensure_checks_loaded() -> None:
+    # the check modules register themselves on import
+    from . import checks  # noqa: F401
+
+
+# -- inline suppression ----------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*ptpu-lint:\s*disable=([A-Za-z0-9_,\s]+)")
+
+
+def _suppressed_codes(unit: FileUnit, lineno: int) -> set:
+    """Codes disabled for ``lineno`` (same line or the line above)."""
+    out: set = set()
+    for ln in (lineno, lineno - 1):
+        m = _SUPPRESS_RE.search(unit.line_text(ln))
+        if m:
+            out.update(c.strip() for c in m.group(1).split(","))
+    return out
+
+
+def _apply_inline(unit: FileUnit,
+                  findings: List[Finding]) -> List[Finding]:
+    kept = []
+    for f in findings:
+        codes = _suppressed_codes(unit, f.line)
+        if f.code in codes or "all" in codes:
+            continue
+        kept.append(f)
+    return kept
+
+
+# -- running ----------------------------------------------------------
+
+def lint_units(units: List[FileUnit],
+               project_root: Optional[str] = None,
+               run_project_checks: bool = True) -> List[Finding]:
+    _ensure_checks_loaded()
+    findings: List[Finding] = []
+    by_path: Dict[str, FileUnit] = {u.path: u for u in units}
+    for _, fn in FILE_CHECKS:
+        for u in units:
+            findings.extend(_apply_inline(u, fn(u)))
+    if run_project_checks:
+        for _, fn in PROJECT_CHECKS:
+            raw = fn(units, project_root)
+            kept = []
+            for f in raw:
+                u = by_path.get(f.path)
+                if u is not None:
+                    kept.extend(_apply_inline(u, [f]))
+                else:
+                    kept.append(f)
+            findings.extend(kept)
+    findings.sort(key=lambda f: (f.path, f.line, f.code))
+    return findings
+
+
+def lint_source(src: str, path: str = "<string>") -> List[Finding]:
+    """Lint one in-memory file with the file checks only (the fixture
+    corpus entry point — project checks need a project)."""
+    return lint_units([make_unit(src, path)], run_project_checks=False)
+
+
+def iter_py_files(paths: Sequence[str],
+                  root: Optional[str] = None) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        full = os.path.join(root, p) if root and not os.path.isabs(p) \
+            else p
+        if os.path.isfile(full):
+            out.append(full)
+            continue
+        for dirpath, dirnames, filenames in os.walk(full):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+            for fn in sorted(filenames):
+                if fn.endswith(".py"):
+                    out.append(os.path.join(dirpath, fn))
+    return out
+
+
+def lint_paths(paths: Sequence[str],
+               project_root: Optional[str] = None
+               ) -> Tuple[List[Finding], List[str]]:
+    """Lint files/dirs. Returns (findings, parse_errors)."""
+    root = project_root or os.getcwd()
+    units: List[FileUnit] = []
+    errors: List[str] = []
+    for fp in iter_py_files(paths, root=root):
+        rel = os.path.relpath(fp, root)
+        try:
+            with open(fp, encoding="utf-8") as fh:
+                src = fh.read()
+            units.append(make_unit(src, rel))
+        except SyntaxError as e:
+            errors.append(f"{rel}: syntax error: {e}")
+    return lint_units(units, project_root=root), errors
+
+
+# -- baseline ---------------------------------------------------------
+
+def _finding_context(f: Finding, root: Optional[str]) -> str:
+    if root is None:
+        return ""
+    fp = os.path.join(root, f.path)
+    try:
+        with open(fp, encoding="utf-8") as fh:
+            lines = fh.read().splitlines()
+    except OSError:
+        return ""
+    return f.context(lines)
+
+
+def load_baseline(path: str) -> List[dict]:
+    with open(path, encoding="utf-8") as fh:
+        data = json.load(fh)
+    return data.get("findings", [])
+
+
+def apply_baseline(findings: List[Finding], baseline: List[dict],
+                   root: Optional[str] = None
+                   ) -> Tuple[List[Finding], int]:
+    """Split findings into (new, n_baselined). A baseline entry
+    matches by (code, path, context line) and absorbs up to ``count``
+    findings (default 1)."""
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for e in baseline:
+        key = (e["code"], e["path"], e.get("context", ""))
+        budget[key] = budget.get(key, 0) + int(e.get("count", 1))
+    new: List[Finding] = []
+    n_baselined = 0
+    for f in findings:
+        key = (f.code, f.path, _finding_context(f, root))
+        if budget.get(key, 0) > 0:
+            budget[key] -= 1
+            n_baselined += 1
+        else:
+            new.append(f)
+    return new, n_baselined
+
+
+def make_baseline(findings: List[Finding],
+                  root: Optional[str] = None) -> dict:
+    out = []
+    for f in findings:
+        out.append({"code": f.code, "path": f.path,
+                    "context": _finding_context(f, root),
+                    "why": "TODO: justify or fix"})
+    return {"comment":
+            "ptpu-lint baseline: pre-existing, justified findings. "
+            "Every entry needs a 'why'; new code must not add "
+            "entries — fix or inline-suppress with justification.",
+            "findings": out}
