@@ -53,6 +53,15 @@ the contiguous pool's concurrency, >= 10x with int8 + shared
 prefixes (ISSUE 6 acceptance). Emits a schema-guarded ``PAGED_KV``
 summary line (prefix hit rate, pages/token, peak concurrency, gains)
 asserted in tests/test_benchmarks_smoke.py.
+
+``--kv-tiering``: host-RAM page tier + persistent prefix store mode —
+shared-prompt waves under a device-page budget too small to keep
+every system prompt cached, across the untiered paged engine, the
+host-tier engine (cold pages demote instead of being destroyed,
+promote back on radix hit) and the persistent-store engine (prefixes
+survive an engine restart). Emits the schema-guarded ``KV_TIERING``
+line (tier-labelled prefix hit rates, promotion p99, restart-wave hit
+rate, decode compiles == 1), bars in tests/test_benchmarks_smoke.py.
 """
 import _path  # noqa: F401  (repo-root import shim)
 
@@ -369,6 +378,114 @@ def run_prefix_share(model, max_len, min_bucket, page_size, sys_lens,
         "decode_compiles":
             results["paged"]["engine"].trace_counts["decode"],
     }))
+
+
+def run_kv_tiering(model, *, slots, max_len, min_bucket, page_size,
+                   num_pages, sys_len, tail_len, max_new, waves,
+                   wave_width, seed=0):
+    """--kv-tiering: shared-prompt waves under a device-page budget
+    too small to keep every system prompt's pages cached. Waves
+    alternate between two system prompts, so each wave's admission
+    pressure reclaims the OTHER prompt's cold pages — on the untiered
+    engine that destroys them (next hit re-prefills at full price);
+    with the host tier they demote and promote back on the next
+    radix hit; with the persistent store under the RAM tier they also
+    survive an engine "restart" (a fresh engine over the same store
+    directory). Asserts greedy token identity tiered-vs-untiered and
+    emits the schema-guarded ``KV_TIERING`` line (tier-labelled
+    prefix hit rates, promotion p99, decode compiles == 1,
+    restart-wave hit rate)."""
+    import shutil
+    import tempfile
+    from paddle_tpu.serving import ServingEngine
+
+    rng = np.random.RandomState(seed)
+    systems = [rng.randint(1, 100, (sys_len,)).astype(np.int64)
+               for _ in range(2)]
+    tails = [rng.randint(1, 100, (tail_len,)).astype(np.int64)
+             for _ in range(waves * wave_width)]
+
+    def drive(eng, wave_range):
+        outputs = []
+        t0 = time.perf_counter()
+        for w in wave_range:
+            reqs = [eng.submit(np.concatenate(
+                        [systems[w % 2], tails[w * wave_width + j]]),
+                        max_new)
+                    for j in range(wave_width)]
+            while eng.has_work():
+                eng.step()
+            outputs.extend(r.output_ids for r in reqs)
+        wall = time.perf_counter() - t0
+        toks = sum(len(o) for o in outputs)
+        return outputs, toks / wall if wall > 0 else 0.0
+
+    base_kw = dict(max_slots=slots, max_len=max_len,
+                   min_bucket=min_bucket, page_size=page_size,
+                   num_pages=num_pages)
+    untiered = ServingEngine(model, **base_kw)
+    out_u, tps_u = drive(untiered, range(waves))
+    st_u = untiered.paged_stats()
+
+    tiered = ServingEngine(model, kv_host_tier=True, **base_kw)
+    out_t, tps_t = drive(tiered, range(waves))
+    st_t = tiered.paged_stats()
+
+    store_dir = tempfile.mkdtemp(prefix="ptpu_kv_store_")
+    try:
+        persist = ServingEngine(model, prefix_store_dir=store_dir,
+                                **base_kw)
+        out_p, _ = drive(persist, range(waves))
+        st_p = persist.paged_stats()
+        # "restart": a FRESH engine over the same store directory —
+        # its first wave must hit demoted prefixes straight from disk
+        restarted = ServingEngine(model, prefix_store_dir=store_dir,
+                                  **base_kw)
+        out_r, _ = drive(restarted, range(1))
+        st_r = restarted.paged_stats()
+    finally:
+        shutil.rmtree(store_dir, ignore_errors=True)
+
+    identical = (out_t == out_u and out_p == out_u
+                 and out_r == out_u[:wave_width])
+    line = {
+        "device_pages": int(st_u["num_pages"]),
+        "page_size": page_size,
+        "prefix_hit_rate_untiered": round(st_u["prefix_hit_rate"], 4),
+        "prefix_hit_rate_tiered": round(st_t["prefix_hit_rate"], 4),
+        "prefix_hit_rate_persistent":
+            round(st_p["prefix_hit_rate"], 4),
+        "restart_prefix_hit_rate": round(st_r["prefix_hit_rate"], 4),
+        "hit_tokens_host": int(st_t["prefix_hit_tokens_host"]),
+        "hit_tokens_disk": int(st_r["prefix_hit_tokens_disk"]),
+        "demotions": int(st_t["demotions"]),
+        "promotions": int(st_t["promotions"]),
+        "promotion_wait_p99_s": round(
+            tiered.metrics.summary()["promotion_wait_p99_s"], 6),
+        "token_identical": identical,
+        "tokens_per_s_untiered": round(tps_u, 1),
+        "tokens_per_s_tiered": round(tps_t, 1),
+        "decode_compiles": tiered.trace_counts["decode"],
+    }
+    print(json.dumps({
+        "metric": (
+            f"KV-tiered warm-prefix hit rate under device-page "
+            f"pressure ({num_pages} pages, page {page_size}; {waves} "
+            f"waves x {wave_width} reqs over 2 alternating "
+            f"{sys_len}-tok system prompts): tiered "
+            f"{line['prefix_hit_rate_tiered']:.2f} vs untiered "
+            f"{line['prefix_hit_rate_untiered']:.2f}, "
+            f"{line['promotions']} promotions, restart first-wave "
+            f"hit rate {line['restart_prefix_hit_rate']:.2f} from "
+            f"disk; baseline=untiered paged engine"),
+        "value": round(line["prefix_hit_rate_tiered"], 4),
+        "unit": "hit rate",
+        "vs_baseline": round(line["prefix_hit_rate_untiered"], 4)}))
+    print("KV_TIERING " + json.dumps(line))
+    if not identical:
+        raise SystemExit(
+            "kv-tiering bench failed: tiered outputs diverged from "
+            "the untiered engine")
 
 
 def run_speculative(model, *, slots, max_len, min_bucket, page_size,
@@ -1200,6 +1317,19 @@ def main():
                              page_size=8, sys_lens=(40, 40),
                              n_req=60, suffix_len=2, max_new=4,
                              contig_slots=4)
+        return
+
+    if "--kv-tiering" in sys.argv:
+        if on_tpu:
+            run_kv_tiering(model, slots=8, max_len=512,
+                           min_bucket=32, page_size=128,
+                           num_pages=40, sys_len=384, tail_len=16,
+                           max_new=32, waves=6, wave_width=8)
+        else:
+            run_kv_tiering(model, slots=2, max_len=64, min_bucket=8,
+                           page_size=8, num_pages=10, sys_len=24,
+                           tail_len=6, max_new=8, waves=4,
+                           wave_width=2)
         return
 
     if "--speculative" in sys.argv:

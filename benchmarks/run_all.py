@@ -23,6 +23,10 @@ SCRIPTS = ["bench_resnet50.py", "bench_bert_dp.py", "bench_gpt_hybrid.py",
            "bench_serving_engine.py --prefix-share",
            # self-speculative decoding on the repetitive-suffix trace
            "bench_serving_engine.py --speculative",
+           # KV tiering: host-RAM page tier + persistent prefix store
+           # under device-page pressure (tier-labelled hit rates,
+           # restart warm-start)
+           "bench_serving_engine.py --kv-tiering",
            # chunked prefill: bounded decode stalls under mixed
            # long-prompt / short-decode traffic (token identity +
            # the tail-latency SLO artifact)

@@ -392,6 +392,11 @@ class ClusterTelemetry:
                     "serving.decode", "serving.verify")),
                 "handoff_s": sum(_dur(r) for r in named(
                     "serving.kv_handoff")),
+                # KV tiering: time spent promoting demoted prefix
+                # pages back onto device before the extend program —
+                # the latency price of a warm-but-demoted prefix
+                "kv_promotion_s": sum(_dur(r) for r in named(
+                    "serving.kv_promote")),
                 "failover_replay_s": sum(_dur(r) for r in replays)
                 + sum(_dur(r) for r in rehomes),
                 "failovers": len(rehomes),

@@ -71,7 +71,8 @@ __all__ = ["FaultArm", "EpisodeResult", "ChaosStore",
 SERVING_SWEEP = ("serving.step.decode", "serving.decode.verify",
                  "serving.decode.sharded",
                  "serving.step.prefill", "serving.prefill.paged",
-                 "serving.prefill.chunk", "serving.kv.handoff")
+                 "serving.prefill.chunk", "serving.kv.handoff",
+                 "serving.kv.demote", "serving.kv.promote")
 FRONTDOOR_SWEEP = ("router.dispatch", "router.health_probe",
                    "frontdoor.stream_write",
                    "frontdoor.client_disconnect")
@@ -275,6 +276,29 @@ def run_serving_episode(seed: int, max_iters: int = 300,
     chunk_kw = {} if prefill_chunk is None \
         else {"prefill_chunk": prefill_chunk,
               "admission_lookahead": int(rng3.randint(0, 3))}
+    # KV host tier, drawn from a FOURTH rng stream (same bit-identity
+    # reasoning: every pre-tier seed's fault schedule, mesh/chunk
+    # draws and workload are untouched). Draws are UNCONDITIONAL so
+    # the stream stays aligned whatever the flavor; the tier only
+    # applies on single-chip engines (mesh + tier raises by design).
+    # Host-RAM only — the disk store's fault story is owned by
+    # tests/test_kv_tier.py, and chaos must not litter the filesystem.
+    rng4 = np.random.RandomState(990000 + seed)
+    tiered_draw = rng4.random() < 0.45
+    tier_cap = int(rng4.randint(2, 16))
+    tier_unbounded = rng4.random() < 0.35
+    # tier-on episodes squeeze the device pool down near the single-
+    # request floor (draw unconditional, applied only with the tier):
+    # at the sampled budgets above the pool almost never reclaims, so
+    # without this clamp the demote/promote regime would soak green by
+    # vacuity — zero demotions, arms never reached
+    tier_pages = int(rng4.randint(_MAX_LEN // 8 + 1, _MAX_LEN // 8 + 4))
+    tier_kw = {}
+    if tiered_draw and mesh_flavor == "local":
+        tier_kw = {"kv_host_tier": True,
+                   "host_tier_pages": None if tier_unbounded
+                   else tier_cap}
+        num_pages = min(num_pages, tier_pages)
     eng = ServingEngine(model, max_slots=max_slots, max_len=_MAX_LEN,
                         min_bucket=_MIN_BUCKET,
                         page_size=8, num_pages=num_pages,
@@ -282,7 +306,7 @@ def run_serving_episode(seed: int, max_iters: int = 300,
                         registry=MetricRegistry(),
                         flight_recorder=FlightRecorder(capacity=8),
                         auditor=ledger, **spec_kw, **mesh_kw,
-                        **chunk_kw)
+                        **chunk_kw, **tier_kw)
     if donate:
         eng._donate = lambda: (5, 6)
 
@@ -300,6 +324,29 @@ def run_serving_episode(seed: int, max_iters: int = 300,
         plan.append((t, int(rng.randint(0, len(pool))), max_new,
                      float(rng.randint(2, 18))
                      if rng.random() < 0.45 else None))
+    # tier-on episodes append a demote/promote duty cycle (drawn
+    # UNCONDITIONALLY from rng4, applied only with the tier, so every
+    # other seed's workload stays bit-identical): shared-prefix
+    # requests around the pool[5] radix family alternating with
+    # disjoint long prompts. Under the clamped pool this cycles pages
+    # device -> host -> device — pressure the sampled arrivals almost
+    # never produce, without which the demote/promote arms (and the
+    # coverage floors over them) would go green by vacuity.
+    # every rng4 draw below happens even when the value is then capped
+    # or the request dropped — the stream position (and with it every
+    # later arm draw) must not depend on the caps
+    n_tier_req = int(rng4.randint(4, 8))
+    t_tier = t
+    tier_plan = []
+    for i in range(n_tier_req):
+        t_tier += float(rng4.exponential(1.5))
+        idx = (5, 6)[int(rng4.randint(0, 2))] if i % 2 == 0 \
+            else (4, 8, 9)[int(rng4.randint(0, 3))]
+        mn = min(int(rng4.randint(2, _REF_HORIZON + 1)), 4)
+        if i < 5:      # cap the executed cycle; tier-1 runtime budget
+            tier_plan.append((t_tier, idx, mn, None))
+    if tier_kw:
+        plan.extend(tier_plan)
     cancels = []              # (submit order, loop iteration)
     if rng.random() < 0.4:
         cancels.append((int(rng.randint(0, n_req)),
@@ -337,6 +384,24 @@ def run_serving_episode(seed: int, max_iters: int = 300,
         schedule.append(FaultArm("serving.prefill.chunk",
                                  times=int(rng3.randint(1, 3)),
                                  after=int(rng3.randint(0, 6))))
+    # tier kill arms, from the rng4 stream that owns the tier draw
+    # (draws unconditional, armed only when the tier is actually on):
+    # demote fires before either tier mutates — the reclaim falls back
+    # to destroy; promote fires with dst pages claimed and the request
+    # staged — the abort path must return pages AND tier pins
+    r_demote, t_demote, a_demote = (rng4.random(),
+                                    int(rng4.randint(1, 3)),
+                                    int(rng4.randint(0, 7)))
+    r_promote, t_promote, a_promote = (rng4.random(),
+                                       int(rng4.randint(1, 3)),
+                                       int(rng4.randint(0, 5)))
+    if tier_kw:
+        if r_demote < 0.5:
+            schedule.append(FaultArm("serving.kv.demote",
+                                     times=t_demote, after=a_demote))
+        if r_promote < 0.5:
+            schedule.append(FaultArm("serving.kv.promote",
+                                     times=t_promote, after=a_promote))
     # shutdown chaos: half the episodes stop serving mid-trace and
     # drain() with the queue and slots still loaded — optionally with
     # one more decode fault armed right before the drain, the
@@ -454,7 +519,10 @@ def _serving_result(seed, violations, schedule, ledger, submitted,
                "max_slots": eng.max_slots,
                "num_pages": eng.cache.num_pages,
                "prefix_hit_tokens": eng.cache.prefix_hit_tokens,
-               "cow_copies": eng.cache.cow_copies})
+               "cow_copies": eng.cache.cow_copies,
+               "kv_tiered": getattr(eng, "_kv_tier", None) is not None,
+               "demotions": getattr(eng.cache, "demotions", 0),
+               "promotions": getattr(eng.cache, "promotions", 0)})
 
 
 # ---------------------------------------------------------------------------

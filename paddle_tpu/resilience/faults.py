@@ -18,6 +18,8 @@ Wired-in points (see docs/RESILIENCE.md for the catalogue):
 ``serving.prefill.paged``    paged prefill, AFTER pages are claimed
 ``serving.prefill.chunk``    between chunks of a chunked prefill
 ``serving.kv.handoff``       disaggregated prefill->decode KV handoff
+``serving.kv.demote``        tier demotion, BEFORE either tier mutates
+``serving.kv.promote``       tier promotion, pages staged, not installed
 ``router.dispatch``          router submit, before replica binding
 ``router.health_probe``      inside the per-round replica probe
 ``frontdoor.stream_write``   writing a token/done event to a client
@@ -99,6 +101,14 @@ KNOWN_POINTS = (
     # abort path must unwind the half-handed-off request on BOTH
     # groups (page claims returned, staged span dropped)
     "serving.kv.handoff",
+    # KV tiering (serving/kv_tier.py): demotion fires BEFORE any
+    # state moves device -> host, so a raise leaves both tiers
+    # untouched; promotion fires with the request staged in
+    # _staged_promotions and fresh device dst pages claimed but no
+    # payload installed — the unwind must return the dst pages AND
+    # the tier pins (neither tier may leak)
+    "serving.kv.demote",
+    "serving.kv.promote",
     # router/front-door boundary (serving/router.py, frontdoor.py):
     # dispatch-path crash before a request binds to a replica; health-
     # probe infrastructure failure (must degrade to draining, never

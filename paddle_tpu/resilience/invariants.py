@@ -305,6 +305,14 @@ def engine_leak_violations(engine) -> List[str]:
     if local:
         out.append(
             f"leaked chunk-local KV buffers for rids {sorted(local)}")
+    # tiered-KV half: a quiesced engine may hold no request staged
+    # mid-promotion (dst pages claimed, host payload not installed) —
+    # every promotion must commit or unwind through abort_sequence
+    promos = getattr(engine, "_staged_promotions", None)
+    if promos:
+        out.append(
+            f"staged KV promotions for rids {sorted(promos)} never "
+            f"committed or unwound")
     return out
 
 
@@ -357,6 +365,39 @@ def page_leak_violations(engine) -> List[str]:
         out.append(
             f"freed slots {stale} still hold page-table entries "
             f"{[cache.page_table[s].tolist() for s in stale]}")
+    # host/disk tier half of the law, when the cache is tiered: every
+    # promotion pin must be returned, every RAM-resident key must be
+    # anchored by a live HOST node in the radix tree (an unanchored
+    # buffer is host memory nothing can ever promote or evict —
+    # the cross-tier leak), and every HOST node must resolve to tier
+    # data (a dataless node would promote garbage)
+    tier = getattr(cache, "tier", None)
+    if tier is not None:
+        pins = {k: c for k, c in tier.pin_counts().items() if c}
+        if pins:
+            out.append(
+                f"leaked tier pins after quiesce: "
+                f"{[(len(k), c) for k, c in sorted(pins.items())]} "
+                f"(key_len, count)")
+        host_keys = set()
+        stack = [cache._root]
+        while stack:
+            nd = stack.pop()
+            stack.extend(nd.children.values())
+            if nd.page < 0:
+                host_keys.add(cache._node_key(nd))
+        orphans = [k for k in tier.ram_keys() if k not in host_keys]
+        if orphans:
+            out.append(
+                f"orphaned host-tier buffers: {len(orphans)} RAM "
+                f"entries (lens {sorted(len(k) for k in orphans)}) "
+                f"with no HOST radix node anchoring them")
+        dead = [k for k in host_keys if not tier.has(k)]
+        if dead:
+            out.append(
+                f"dataless HOST radix nodes: {len(dead)} nodes "
+                f"(lens {sorted(len(k) for k in dead)}) whose tier "
+                f"entry is gone — a match would promote garbage")
     return out
 
 

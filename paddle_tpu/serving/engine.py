@@ -65,6 +65,7 @@ from ..observability import default_recorder, default_registry, span
 from ..resilience.faults import maybe_fail
 from .errors import (DeadlineExceeded, EngineBroken, EngineClosed,
                      EngineIdle, QueueFull, RequestCancelled)
+from .kv_tier import HostPageTier, PersistentPrefixStore
 from .mesh import MeshContext
 from .metrics import EngineMetrics
 from .sampling import SamplingParams, sample_token
@@ -146,7 +147,10 @@ class ServingEngine:
                  mesh=None,
                  prefill_devices: int = 0,
                  prefill_chunk: Optional[int] = None,
-                 admission_lookahead: int = 0):
+                 admission_lookahead: int = 0,
+                 kv_host_tier: bool = False,
+                 host_tier_pages: Optional[int] = None,
+                 prefix_store_dir: Optional[str] = None):
         self.adapter = _ModelAdapter(model)
         model.eval()
         self.max_slots = int(max_slots)
@@ -213,6 +217,29 @@ class ServingEngine:
             self.kv_quant = kv_dtype == "int8"
             self.prefix_sharing = True if prefix_sharing is None \
                 else bool(prefix_sharing)
+        # KV tiering (docs/SERVING.md "KV tiering"): demote cold
+        # refcount-0 prefix pages to pinned host RAM instead of
+        # destroying them, promote back on radix hit; an optional
+        # disk store under the RAM tier keeps shared prompts warm
+        # across recover() and process restarts
+        self.kv_host_tier = bool(kv_host_tier) \
+            or prefix_store_dir is not None
+        self.prefix_store_dir = prefix_store_dir
+        if host_tier_pages is not None and not self.kv_host_tier:
+            raise ValueError(
+                "host_tier_pages requires kv_host_tier=True (or "
+                "prefix_store_dir=)")
+        if self.kv_host_tier:
+            if not (self.paged and self.prefix_sharing):
+                raise ValueError(
+                    "kv_host_tier requires the paged kv_layout with "
+                    "prefix_sharing enabled (the tier is keyed by "
+                    "radix chunks)")
+            if mesh is not None:
+                raise ValueError(
+                    "kv_host_tier is not supported on mesh engines "
+                    "yet: demotion would have to gather sharded "
+                    "pools per page (see ROADMAP)")
         # self-speculative decoding: n-gram drafts verified k tokens
         # per weight pass through ONE widened verify program (greedy
         # rows only; everything else falls back to k=1 IN the same
@@ -270,6 +297,28 @@ class ServingEngine:
         # static per (names, mesh), so don't rebuild NamedShardings on
         # every step
         self._shardings_cache = {}
+        # host/disk KV tier OUTLIVES the cache object: recover()'s
+        # _new_cache() rebinds a fresh radix tree onto the same tier
+        # (rehydration), which is what keeps warm prefixes across
+        # pool rebuilds
+        self._kv_tier = None
+        if self.kv_host_tier:
+            ad = self.adapter
+            store = None
+            if prefix_store_dir is not None:
+                store = PersistentPrefixStore(
+                    prefix_store_dir, num_layers=ad.num_layers,
+                    page_size=self.page_size, kv_heads=ad.kv_heads,
+                    head_dim=ad.head_dim, dtype=ad.dtype,
+                    quant=self.kv_quant)
+            self._kv_tier = HostPageTier(
+                ad.num_layers, self.page_size, ad.kv_heads,
+                ad.head_dim, ad.dtype, quant=self.kv_quant,
+                capacity_pages=host_tier_pages, store=store)
+        # rid -> slot for requests whose host-tier pages are being
+        # promoted onto fresh device pages but not yet committed —
+        # audited empty at quiesce exactly like _staged_handoffs
+        self._staged_promotions = {}
         self.cache = self._new_cache()
         self.scheduler = FIFOScheduler()
         self.registry = registry if registry is not None \
@@ -289,6 +338,7 @@ class ServingEngine:
         self._extend_jit = None
         self._copy_jit = None
         self._install_jit = None
+        self._promote_jit = None
         self._chunk_jit = None
         self._chunk_local_jit = None
         self._chunk_fin_jit = None
@@ -324,7 +374,7 @@ class ServingEngine:
         # asserted against these in tests
         self.trace_counts = {"decode": 0, "verify": 0, "prefill": {},
                              "extend": {}, "copy": 0, "install": {},
-                             "chunk": {}}
+                             "chunk": {}, "promote": 0}
         reg = self.registry
         self._m_queue_depth = reg.gauge(
             "ptpu_serving_queue_depth", "requests waiting for a slot")
@@ -388,6 +438,23 @@ class ServingEngine:
                                      "prefix_lookup_tokens": 0,
                                      "cow_copies": 0}
             self.peak_active_slots = 0
+        if self._kv_tier is not None:
+            self._m_host_pages = reg.gauge(
+                "ptpu_kv_host_pages",
+                "KV pages resident in the host RAM tier")
+            self._m_demotions = reg.counter(
+                "ptpu_kv_demotions_total",
+                "cold KV pages demoted device -> host tier")
+            self._m_promotions = reg.counter(
+                "ptpu_kv_promotions_total",
+                "tiered KV pages promoted back onto device pages")
+            self._m_tier_hit = reg.counter(
+                "ptpu_kv_tier_prefix_hit_tokens_total",
+                "prompt tokens served from demoted prefix pages, by "
+                "the tier that held them", labels=("tier",))
+            self._last_page_stats.update(
+                demotions=0, promotions=0,
+                prefix_hit_tokens_host=0, prefix_hit_tokens_disk=0)
         if self.speculative:
             self._m_spec_acc = reg.histogram(
                 "ptpu_serving_spec_accepted_length",
@@ -429,7 +496,8 @@ class ServingEngine:
                 page_size=self.page_size, num_pages=self.num_pages,
                 quant=self.kv_quant,
                 prefix_sharing=self.prefix_sharing,
-                kv_sharding=kv_sh, scale_sharding=sc_sh)
+                kv_sharding=kv_sh, scale_sharding=sc_sh,
+                tier=self._kv_tier)
         return SlotKVCache(
             ad.num_layers, self.max_slots, self.max_len,
             ad.kv_heads, ad.head_dim, ad.dtype, kv_sharding=kv_sh)
@@ -510,6 +578,19 @@ class ServingEngine:
             if cur > last[key]:
                 counter.inc(cur - last[key])
             last[key] = cur
+        if self._kv_tier is not None:
+            self._m_host_pages.set(self._kv_tier.host_page_count())
+            for counter, key in (
+                    (self._m_demotions, "demotions"),
+                    (self._m_promotions, "promotions"),
+                    (self._m_tier_hit.labels(tier="host"),
+                     "prefix_hit_tokens_host"),
+                    (self._m_tier_hit.labels(tier="disk"),
+                     "prefix_hit_tokens_disk")):
+                cur = getattr(c, key)
+                if cur > last[key]:
+                    counter.inc(cur - last[key])
+                last[key] = cur
 
     def spec_stats(self) -> dict:
         """Speculative-decoding snapshot (raises on a non-speculative
@@ -789,7 +870,9 @@ class ServingEngine:
                 req.prompt_len + req.max_new_tokens)
         pairs = self.scheduler.admissions(
             self.cache.free_slots(), claim=claim,
-            lookahead=self.admission_lookahead)
+            lookahead=self.admission_lookahead,
+            unclaim=self.cache.cancel_reservation if self.paged
+            else None)
         # per-step prefill token budget (chunked engines): one chunk's
         # worth. Prompts that fit run the MONOLITHIC prefill program
         # inside the budget (the degenerate case IS the unchunked
@@ -1255,6 +1338,10 @@ class ServingEngine:
             self._publish_page_stats()
             self._last_page_stats = {k: 0
                                      for k in self._last_page_stats}
+        # staged promotions die with the old pools; the tier itself
+        # SURVIVES — _new_cache() rehydrates its radix index from the
+        # tier, so demoted prefixes stay warm across the rebuild
+        self._staged_promotions.clear()
         self.cache = self._new_cache()
         self._refresh_state()
         # accumulate on the ENGINE, not a local: if a re-prefill below
@@ -1559,6 +1646,9 @@ class ServingEngine:
                 raise RequestCancelled(
                     req.rid, "client disconnected mid-prefill")
             self._run_copies(copies)
+            # promoted host/disk pages install BEFORE the extend
+            # program attends over them (staged; unwinds on fault)
+            self._stage_promotions(req, slot)
             tail = n - start
             bucket = bucket_for(tail, self.min_bucket, self.max_len)
             self._m_prefill.labels(bucket=bucket).inc()
@@ -1604,9 +1694,11 @@ class ServingEngine:
             return np.asarray(jax.device_get(logits))
         except Exception:
             # the cross-group unwind: drop the staged prefill-side
-            # span (if a handoff was in flight) WITH the decode-side
-            # page claims — the leak audit checks both halves
+            # span (if a handoff was in flight) AND any staged
+            # promotion WITH the decode-side page claims — the leak
+            # audit checks every half
             self._staged_handoffs.pop(req.rid, None)
+            self._staged_promotions.pop(req.rid, None)
             cache.abort_sequence(slot, req)
             raise
 
@@ -1648,10 +1740,12 @@ class ServingEngine:
                 cache.refresh_reservation(req, ids)
                 start, copies = cache.begin_sequence(slot, req, ids)
                 self._run_copies(copies)
+                self._stage_promotions(req, slot)
             except Exception:
                 # pages claimed but the slot never assigned: the
                 # standard abort path returns every claim, and the
                 # caller (_step_inner) requeues the request
+                self._staged_promotions.pop(req.rid, None)
                 cache.abort_sequence(slot, req)
                 raise
         self.cache.assign(slot, req)
@@ -2346,6 +2440,55 @@ class ServingEngine:
                 c.ks, c.vs = list(ks), list(vs)
         self._staged_handoffs.pop(rid, None)
 
+    def _stage_promotions(self, req, slot: int) -> None:
+        """Install this request's planned tier promotions onto their
+        fresh device pages BEFORE the extend program reads them —
+        the host-tier mirror of :meth:`_kv_handoff`'s staged
+        install/abort contract. Staged in ``_staged_promotions``
+        before the ``serving.kv.promote`` kill point; popped on
+        successful commit, or unwound HERE via ``abort_sequence`` on
+        any raise (the caller's handler re-aborting is a safe no-op:
+        the plan is already popped). A fault therefore returns the
+        promotion dst pages AND the tier pins in the same unwind, so
+        neither tier leaks."""
+        plan = self.cache._plans.get(req.rid)
+        if plan is None or not plan["promote"]:
+            return
+        rid = req.rid
+        c = self.cache
+        self._staged_promotions[rid] = slot
+        t0 = self.metrics.now()
+        try:
+            maybe_fail("serving.kv.promote", slot=slot, rid=rid,
+                       pages=len(plan["promote"]))
+            with span("serving.kv_promote", slot=slot,
+                      request_id=rid, pages=len(plan["promote"])):
+                work = c.begin_promotions(req)
+                # async H2D first: every payload is on its way to the
+                # device before the first install dispatch
+                shipped = []
+                for node, dst, payload, label in work:
+                    kb = jax.device_put(list(payload["k"]))
+                    vb = jax.device_put(list(payload["v"]))
+                    ksb = jax.device_put(list(payload["ks"])) \
+                        if self.kv_quant else []
+                    vsb = jax.device_put(list(payload["vs"])) \
+                        if self.kv_quant else []
+                    shipped.append((dst, kb, vb, ksb, vsb))
+                fn = self._promote_fn()
+                for dst, kb, vb, ksb, vsb in shipped:
+                    out = fn(np.int32(dst), kb, vb, ksb, vsb,
+                             c.ks, c.vs, c.kss, c.vss)
+                    c.ks, c.vs = list(out[0]), list(out[1])
+                    c.kss, c.vss = list(out[2]), list(out[3])
+                c.commit_promotions(req, work)
+        except BaseException:
+            self._staged_promotions.pop(rid, None)
+            c.abort_sequence(slot, req)
+            raise
+        self._staged_promotions.pop(rid, None)
+        self.metrics.on_promotion(rid, self.metrics.now() - t0)
+
     def _copy_fn(self):
         """COW page copy (compiled once): pool[dst] <- pool[src] for
         every layer's k/v (+scale) pool."""
@@ -2367,6 +2510,28 @@ class ServingEngine:
             pure, donate_argnums=self._donate_idx(2, 3, 4, 5),
             **jit_kw)
         return self._copy_jit
+
+    def _promote_fn(self):
+        """Tier promotion install (compiled once): scatter ONE host-
+        tier page's k/v blocks (+int8 scales) into a fresh device page
+        across every layer pool. One page per call keeps the program
+        shape static — promotion cost is page-count many dispatches of
+        the same compiled program, never a recompile."""
+        if self._promote_jit is not None:
+            return self._promote_jit
+
+        def pure(dst, kb, vb, ksb, vsb, ks, vs, kss, vss):
+            self.trace_counts["promote"] += 1
+            put = lambda pool, b: pool.at[dst].set(
+                b.astype(pool.dtype))
+            return ([put(p, b) for p, b in zip(ks, kb)],
+                    [put(p, b) for p, b in zip(vs, vb)],
+                    [put(p, b) for p, b in zip(kss, ksb)],
+                    [put(p, b) for p, b in zip(vss, vsb)])
+
+        self._promote_jit = jax.jit(
+            pure, donate_argnums=self._donate_idx(5, 6, 7, 8))
+        return self._promote_jit
 
     def _decode_fn(self):
         """THE decode-step program (compiled once): every occupied slot

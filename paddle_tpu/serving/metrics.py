@@ -58,6 +58,7 @@ class EngineMetrics:
         self._ttft: deque = deque(maxlen=window)
         self._qwait: deque = deque(maxlen=window)
         self._gaps: deque = deque(maxlen=window)
+        self._promo: deque = deque(maxlen=window)
         self._t0: Optional[float] = None
         self._t_last: Optional[float] = None
         if registry is None:
@@ -81,6 +82,10 @@ class EngineMetrics:
             "ptpu_serving_decode_stall_seconds",
             "submit-to-first-token gap for requests submitted while "
             "other work was in flight (decode blocked behind prefills)")
+        self._m_promo = registry.histogram(
+            "ptpu_kv_promotion_wait_seconds",
+            "host/disk -> device KV page promotion wall time per "
+            "request (tier fetch + H2D + install dispatches)")
 
     # -- event hooks (engine calls these) ------------------------------
     def on_submit(self, rid: int, stalled: bool = False) -> None:
@@ -122,6 +127,13 @@ class EngineMetrics:
         self._m_tokens.inc()
         self._t_last = t
 
+    def on_promotion(self, rid: int, wait_s: float) -> None:
+        """One request's KV tier promotion completed: record the wall
+        time its prefill spent installing demoted pages back onto the
+        device (the latency cost of a warm-but-demoted prefix)."""
+        self._promo.append(wait_s)
+        self._m_promo.observe(wait_s)
+
     def on_step(self, active_slots: int) -> None:
         self._n_steps += 1
         self._occ_sum += active_slots
@@ -151,6 +163,7 @@ class EngineMetrics:
             "queue_wait_p99_s": pct(self._qwait, 99),
             "tok_latency_p50_s": pct(self._gaps, 50),
             "tok_latency_p99_s": pct(self._gaps, 99),
+            "promotion_wait_p99_s": pct(self._promo, 99),
             "occupancy_mean": (self._occ_sum / self._n_steps
                                / self.max_slots
                                if self._n_steps else 0.0),

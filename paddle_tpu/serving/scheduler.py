@@ -124,7 +124,8 @@ class FIFOScheduler:
         return len(self._queue)
 
     def admissions(self, free_slots: List[int], claim=None,
-                   lookahead: int = 0) -> List[Tuple[int, Request]]:
+                   lookahead: int = 0,
+                   unclaim=None) -> List[Tuple[int, Request]]:
         """Pair queued requests with free slots, FCFS, one per slot.
 
         ``claim`` (optional) gates each admission on a resource besides
@@ -139,7 +140,16 @@ class FIFOScheduler:
         claim fails, up to ``lookahead`` blocked requests may be passed
         over (keeping their queue positions) to admit a smaller request
         behind them that DOES fit. 0 (the default) is strict FCFS —
-        bit-identical to the historical policy."""
+        bit-identical to the historical policy.
+
+        A claim that RAISES mid-batch must not strand the requests
+        already picked: their claims are unwound via ``unclaim`` and
+        they return to the queue head in FCFS order before the
+        exception propagates. The paged claim is engine code reaching
+        through the cache (radix match, tier pinning) — if any of it
+        ever faults on the second claim of a batch, the first request
+        would otherwise be silently LOST: popped, reserved, and never
+        returned."""
         picked = []
         idx = 0          # scan position in the queue
         skipped = 0      # blocked requests passed over (<= lookahead)
@@ -147,7 +157,15 @@ class FIFOScheduler:
             got = None
             while idx < len(self._queue):
                 req = self._queue[idx]
-                if claim is None or claim(req):
+                try:
+                    ok = claim is None or claim(req)
+                except BaseException:
+                    for _, r in reversed(picked):
+                        if unclaim is not None:
+                            unclaim(r)
+                        self.requeue(r)
+                    raise
+                if ok:
                     got = req
                     del self._queue[idx]
                     break

@@ -157,6 +157,12 @@ class _PrefixNode:
         self.lru = 0
 
 
+# sentinel page id for a radix node whose payload lives in the host
+# tier (serving/kv_tier.py) instead of the device pool: it holds no
+# device page and is absent from _node_of_page until promoted back
+_HOST = -1
+
+
 class PagedKVCache(_SlotTable):
     """Block-paged KV pool with COW prefix sharing and optional int8
     storage (see module docstring). ``num_pages`` INCLUDES the
@@ -166,9 +172,13 @@ class PagedKVCache(_SlotTable):
                  kv_heads: int, head_dim: int, dtype,
                  page_size: int = 128, num_pages: Optional[int] = None,
                  quant: bool = False, prefix_sharing: bool = True,
-                 kv_sharding=None, scale_sharding=None):
+                 kv_sharding=None, scale_sharding=None, tier=None):
         _validate_geometry(num_layers, max_slots, max_len, kv_heads,
                            head_dim)
+        if tier is not None and not prefix_sharing:
+            raise ValueError(
+                "the host KV tier keys pages by their radix chunk — "
+                "it requires prefix_sharing=True")
         if page_size < 1:
             raise ValueError(f"page_size must be >= 1, got {page_size}")
         if max_len % page_size != 0:
@@ -226,6 +236,28 @@ class PagedKVCache(_SlotTable):
         self.prefix_hit_tokens = 0
         self.prefix_lookup_tokens = 0
         self.pages_reclaimed = 0
+        # host/disk page tier (serving/kv_tier.py, docs/SERVING.md "KV
+        # tiering"): _reclaim_one DEMOTES cold refcount-0 index pages
+        # into it instead of destroying them; a radix hit on a demoted
+        # chunk places a freshly allocated device page in the row and
+        # records a PROMOTION the engine installs (async device_put)
+        # before the extend program runs. A demoted node stays in the
+        # radix tree with page == _HOST (and out of _node_of_page), so
+        # the device accounting law — free + cached == num_pages - 1 —
+        # is untouched by tiering.
+        self.tier = tier
+        self.demotions = 0
+        self.promotions = 0
+        self.prefix_hit_tokens_host = 0
+        self.prefix_hit_tokens_disk = 0
+        if tier is not None:
+            # the tier OUTLIVES caches (recover() rebuilds the pool,
+            # warm prefixes survive): rebind the unlink callback and
+            # drop pins the dead cache's plans held, then rebuild host
+            # nodes for every still-resident key
+            tier.on_evict = self._drop_host_key
+            tier.reset_pins()
+            self._rehydrate()
 
     # -- page accounting ----------------------------------------------
     def page_span(self, total_len: int) -> int:
@@ -257,36 +289,167 @@ class PagedKVCache(_SlotTable):
         self._lru_tick += 1
         node.lru = self._lru_tick
 
+    # -- host/disk tier machinery ---------------------------------------
+    @staticmethod
+    def _node_key(node: _PrefixNode) -> Tuple[int, ...]:
+        """The tier key of a radix node: the full token path from the
+        root (the path IS the identity of a prefix page)."""
+        chunks = []
+        while node.parent is not None:
+            chunks.append(node.chunk)
+            node = node.parent
+        out: List[int] = []
+        for c in reversed(chunks):
+            out.extend(c)
+        return tuple(out)
+
+    def _read_page_payload(self, page: int):
+        """Device -> host copy of one page across every layer pool
+        (the demotion payload: k/v blocks plus int8 scales)."""
+        k = np.stack([np.asarray(p[page]) for p in self.ks])
+        v = np.stack([np.asarray(p[page]) for p in self.vs])
+        if self.quant:
+            ks = np.stack([np.asarray(p[page]) for p in self.kss])
+            vs = np.stack([np.asarray(p[page]) for p in self.vss])
+        else:
+            ks = vs = np.zeros((0,), np.float32)
+        return {"k": k, "v": v, "ks": ks, "vs": vs}
+
+    def _unlink_subtree(self, top: _PrefixNode) -> None:
+        """Drop ``top`` and every descendant from the index: device
+        descendants free now if unreferenced (or on release
+        otherwise), host descendants leave the tier with their node —
+        a host payload is meaningless once its chain is gone."""
+        top.parent.children.pop(top.chunk, None)
+        stack = [top]
+        while stack:
+            nd = stack.pop()
+            if nd.page >= 0:
+                self._node_of_page.pop(nd.page, None)
+                if self.refcnt[nd.page] == 0:
+                    self._cached -= 1       # cached -> free
+                    self._free_pages.append(nd.page)
+                    self.pages_reclaimed += 1
+            elif self.tier is not None:
+                self.tier.drop(self._node_key(nd))
+            stack.extend(nd.children.values())
+            nd.children = {}
+
+    def _drop_host_key(self, key) -> None:
+        """Tier eviction callback: the tier is shedding ``key``
+        entirely (no disk copy), so unlink the radix subtree it
+        anchors — a host node without tier data would promote garbage.
+        No-op when the key no longer resolves to a host node."""
+        key = tuple(int(t) for t in key)
+        P = self.page_size
+        node = self._root
+        for j in range(0, len(key), P):
+            node = node.children.get(key[j:j + P])
+            if node is None:
+                return
+        if node.page < 0:
+            self._unlink_subtree(node)
+
+    def _rehydrate(self) -> None:
+        """Rebuild host radix nodes from the tier on a FRESH cache
+        (init / recover / restart): every resident key whose whole
+        ancestor chain is also resident becomes a host node; orphan
+        keys (an ancestor chunk was never demoted, or died with the
+        old pool) are dropped from the tier — a chain with a gap can
+        never be matched, and a resident key with no node is exactly
+        the orphaned-host-buffer leak the invariants audit forbids."""
+        P = self.page_size
+        keys = sorted(self.tier.keys(), key=len)
+        resident = set(keys)
+        for key in keys:
+            if len(key) == 0 or len(key) % P:
+                self.tier.drop(key)
+                continue
+            if any(key[:j] not in resident
+                   for j in range(P, len(key), P)):
+                self.tier.drop(key)
+                resident.discard(key)
+                continue
+            node = self._root
+            for j in range(0, len(key), P):
+                chunk = key[j:j + P]
+                child = node.children.get(chunk)
+                if child is None:
+                    child = _PrefixNode(chunk, _HOST, node)
+                    node.children[chunk] = child
+                node = child
+
+    def _demote(self, victim: _PrefixNode) -> bool:
+        """Move one cold refcount-0 indexed page into the host tier:
+        read its payload off the device, hand it to the tier keyed by
+        its radix path, then free the device page. The node stays in
+        the tree as a HOST node, so later prompts still match it (and
+        promote it back). The ``serving.kv.demote`` fault point fires
+        BEFORE any state mutates — a raise leaves both tiers exactly
+        as they were. Returns False when the tier refuses the entry
+        (RAM full of unevictable keys, no disk underneath); the caller
+        falls back to the destroy path."""
+        from ..resilience.faults import maybe_fail
+        key = self._node_key(victim)
+        payload = self._read_page_payload(victim.page)
+        maybe_fail("serving.kv.demote", page=victim.page,
+                   tokens=len(key))
+        if not self.tier.put(key, payload):
+            return False
+        page = victim.page
+        self._node_of_page.pop(page, None)
+        self._cached -= 1                   # cached -> free
+        self._free_pages.append(page)
+        victim.page = _HOST
+        self.demotions += 1
+        return True
+
     def _match_prefix(self, ids: np.ndarray):
         """Longest shared prefix of ``ids`` in the index. Matching
         stops at ``len(ids) - 1``: the LAST prompt token is always
         recomputed so the prefill has logits to sample from. Returns
-        (matched_len, [pages], deepest_node); a trailing partial match
-        (first divergent page) is allowed — its page gets COW'd by the
-        first write."""
+        (matched_len, [(node, "dev"|"host")], deepest_node) — "host"
+        entries are demoted pages the engine must promote back before
+        the extend; a trailing partial match (first divergent page) is
+        allowed — its page gets COW'd by the first write, so it must
+        be device-resident (host children are skipped there)."""
         matchable = ids[:-1]
         P = self.page_size
         node = self._root
-        pages: List[int] = []
+        entries: List[Tuple[_PrefixNode, str]] = []
+        key: Tuple[int, ...] = ()
         m = 0
         while m + P <= len(matchable):
-            child = node.children.get(tuple(int(t) for t in
-                                            matchable[m:m + P]))
+            chunk = tuple(int(t) for t in matchable[m:m + P])
+            child = node.children.get(chunk)
             if child is None:
                 break
+            key = key + chunk
+            if child.page < 0:
+                if self.tier is None or not self.tier.has(key):
+                    # the tier lost the payload (torn disk entry):
+                    # a host node without data can never be promoted
+                    # — unlink it so matching stops paying for it
+                    self._unlink_subtree(child)
+                    break
+                entries.append((child, "host"))
+            else:
+                entries.append((child, "dev"))
             node = child
             self._touch(node)
-            pages.append(node.page)
             m += P
         # partial match into the first DIVERGENT page: the prompt may
         # run out mid-page, or its content may diverge mid-page from
         # every indexed chunk — either way the longest common prefix
         # of the next page is shareable (COW privatizes it on the
-        # first write)
+        # first write). Host children are not COW sources (the copy
+        # program reads the device pool), so they are skipped.
         want = [int(t) for t in matchable[m:m + P]]
         if want:
             best, best_child = 0, None
             for chunk, child in node.children.items():
+                if child.page < 0:
+                    continue
                 common = 0
                 for a, b in zip(chunk, want):
                     if a != b:
@@ -296,12 +459,12 @@ class PagedKVCache(_SlotTable):
                     best, best_child = common, child
             if best_child is not None:
                 self._touch(best_child)
-                pages.append(best_child.page)
+                entries.append((best_child, "dev"))
                 m += best
         # hit/lookup counters are bumped by try_reserve only when the
         # reservation COMMITS — a blocked queue head is re-claimed
         # every step and must not inflate the prefix-hit-rate artifact
-        return m, pages, node
+        return m, entries, node
 
     def register_prefix(self, slot: int, ids: np.ndarray) -> None:
         """Index every FULL page of ``ids`` (just prefilled into
@@ -326,24 +489,52 @@ class PagedKVCache(_SlotTable):
                 child = _PrefixNode(chunk, page, node)
                 node.children[chunk] = child
                 self._node_of_page[page] = child
+            elif child.page < 0:
+                # a HOST node for a chunk this slot just prefilled
+                # on-device (e.g. the prompt's final full page, which
+                # matching skips — it is capped at len(ids) - 1): adopt
+                # the fresh device page so the index serves it without
+                # a promotion, and shed the now-redundant RAM copy
+                # (the disk copy, if any, stays warm for restarts)
+                page = int(row[i])
+                if page == 0 or page in self._node_of_page:
+                    break
+                child.page = page
+                self._node_of_page[page] = child
+                if self.tier is not None:
+                    self.tier.drop_ram(self._node_key(child))
             node = child
             self._touch(node)
 
     def _reclaim_one(self) -> bool:
-        """Free at least one cached page: drop the LRU refcount-0
-        indexed subtree (descendants lose their index entry; their
-        pages free now if unreferenced, or on release otherwise).
-        The victim itself is refcount-0, so one pass always frees at
-        least the victim's page."""
+        """Free at least one cached page. With a host tier configured,
+        the LRU refcount-0 indexed page is DEMOTED — its payload moves
+        to host RAM (write-through to the disk store when one is
+        layered underneath) and the node stays matchable; the subtree
+        survives. Without a tier (or when the tier refuses the entry),
+        the LRU refcount-0 subtree is destroyed: descendants lose
+        their index entry and their pages free now if unreferenced, or
+        on release otherwise. The victim itself is refcount-0, so one
+        pass always frees at least the victim's page."""
         candidates = [n for n in self._node_of_page.values()
                       if self.refcnt[n.page] == 0]
         if not candidates:
             return False
         victim = min(candidates, key=lambda n: n.lru)
+        if self.tier is not None and self._demote(victim):
+            return True
         victim.parent.children.pop(victim.chunk, None)
         stack = [victim]
         while stack:
             nd = stack.pop()
+            if nd.page < 0:
+                # a demoted descendant dies with its chain — its
+                # payload is unreachable once the subtree unlinks
+                if self.tier is not None:
+                    self.tier.drop(self._node_key(nd))
+                stack.extend(nd.children.values())
+                nd.children = {}
+                continue
             self._node_of_page.pop(nd.page, None)
             if self.refcnt[nd.page] == 0:
                 self._cached -= 1           # cached -> free
@@ -405,18 +596,32 @@ class PagedKVCache(_SlotTable):
                 > budget:
             return False
         if self.prefix_sharing:
-            matched, pages, _ = self._match_prefix(ids)
+            matched, entries, _ = self._match_prefix(ids)
         else:
-            matched, pages = 0, []
-        for p in pages:
-            self._ref(p)
+            matched, entries = 0, []
+        host_pins: List[Tuple[int, ...]] = []
+        for node, kind in entries:
+            if kind == "dev":
+                self._ref(node.page)
+            else:
+                # pin the tier key: neither it nor an ancestor may be
+                # evicted while a promotion plan depends on the chain
+                key = self._node_key(node)
+                self.tier.pin(key)
+                host_pins.append(key)
+        # host-matched pages are CHEAP (no recompute: the prefill tail
+        # shrinks by their tokens) but not FREE — each promotion lands
+        # in a freshly allocated device page, so they count as new
         need_new = self.page_span(total_len) \
-            - matched // self.page_size
+            - matched // self.page_size + len(host_pins)
         # strict check AFTER pinning: matched cached pages are no
         # longer reclaimable, so they cannot back the new allocations
         if need_new > self.usable_pages() - self._committed:
-            for p in pages:
-                self._unref(p)
+            for node, kind in entries:
+                if kind == "dev":
+                    self._unref(node.page)
+            for key in host_pins:
+                self.tier.unpin(key)
             return False
         self._committed += need_new
         lookup = max(0, int(len(ids)) - 1) if self.prefix_sharing \
@@ -425,9 +630,12 @@ class PagedKVCache(_SlotTable):
         self.prefix_hit_tokens += matched
         self._plans[req.rid] = {
             "state": "reserved", "matched": matched,
-            "pages": list(pages), "need_new": need_new,
+            "entries": list(entries), "need_new": need_new,
             "allocated": 0, "slot": None,
             "total_len": int(total_len),
+            # tier keys this plan pinned — released exactly once, by
+            # commit_promotions OR the cancel/abort/release unwind
+            "host_pins": host_pins, "promote": [],
             # what this plan added to the hit/lookup counters — rolled
             # back if the reservation is cancelled or the prefill
             # aborts, so a requeued request counts exactly ONCE
@@ -446,20 +654,32 @@ class PagedKVCache(_SlotTable):
         if plan is None or plan["state"] != "reserved" \
                 or not self.prefix_sharing:
             return
-        matched, pages, _ = self._match_prefix(ids)
+        matched, entries, _ = self._match_prefix(ids)
         if matched <= plan["matched"]:
             return
-        for p in pages:
-            self._ref(p)
-        for p in plan["pages"]:
-            self._unref(p)
+        host_pins: List[Tuple[int, ...]] = []
+        for node, kind in entries:
+            if kind == "dev":
+                self._ref(node.page)
+            else:
+                key = self._node_key(node)
+                self.tier.pin(key)
+                host_pins.append(key)
+        for node, kind in plan["entries"]:
+            if kind == "dev":
+                self._unref(node.page)
+        for key in plan["host_pins"]:
+            self.tier.unpin(key)
+        # each extra matched page shrinks need_new by one and adds at
+        # most one promotion, so a longer match still never GROWS the
+        # reservation — re-matching is always budget-safe
         need_new = self.page_span(plan["total_len"]) \
-            - matched // self.page_size
+            - matched // self.page_size + len(host_pins)
         self._committed += need_new - plan["need_new"]
         self.prefix_hit_tokens += matched - plan["matched"]
         plan["hit_counted"] += matched - plan["matched"]
-        plan.update(matched=matched, pages=list(pages),
-                    need_new=need_new)
+        plan.update(matched=matched, entries=list(entries),
+                    need_new=need_new, host_pins=host_pins)
 
     def cancel_reservation(self, req) -> None:
         """Drop an unconsumed reservation (failed admission batch:
@@ -468,8 +688,11 @@ class PagedKVCache(_SlotTable):
         plan = self._plans.get(req.rid)
         if plan is None or plan["state"] != "reserved":
             return
-        for p in plan["pages"]:
-            self._unref(p)
+        for node, kind in plan["entries"]:
+            if kind == "dev":
+                self._unref(node.page)
+        for key in plan["host_pins"]:
+            self.tier.unpin(key)
         self._committed -= plan["need_new"]
         self.prefix_hit_tokens -= plan["hit_counted"]
         self.prefix_lookup_tokens -= plan["lookup_counted"]
@@ -497,8 +720,21 @@ class PagedKVCache(_SlotTable):
         plan["slot"] = slot
         row = self.page_table[slot]
         row[:] = 0
-        for j, p in enumerate(plan["pages"]):
-            row[j] = p
+        # dev entries FIRST: once a page sits in the row,
+        # abort_sequence()'s row walk unwinds its ref, so a host-dst
+        # allocation failure below cannot strand a reserve-time ref
+        host_slots: List[Tuple[int, "_PrefixNode"]] = []
+        for j, (node, kind) in enumerate(plan["entries"]):
+            if kind == "dev":
+                row[j] = node.page
+            else:
+                host_slots.append((j, node))
+        promote: List[Tuple["_PrefixNode", int]] = []
+        for j, node in host_slots:
+            dst = self._alloc_page(plan)
+            row[j] = dst
+            promote.append((node, dst))
+        plan["promote"] = promote
         copies: List[Tuple[int, int]] = []
         first_new = m // P
         if m % P:
@@ -514,6 +750,62 @@ class PagedKVCache(_SlotTable):
         for j in range(first_new, (n - 1) // P + 1):
             row[j] = self._alloc_page(plan)
         return m, copies
+
+    def begin_promotions(self, req) -> List[Tuple["_PrefixNode", int,
+                                                  Dict[str, np.ndarray],
+                                                  str]]:
+        """Gather the payloads for this request's planned promotions:
+        for each (node, dst) pair from begin_sequence, fetch the page
+        data the engine must install into ``dst`` before the extend
+        program. Returns [(node, dst, payload, tier_label)]. A node
+        another request promoted first (page >= 0 now) is read back
+        from the DEVICE — dst then holds a private copy and the pin is
+        simply released at commit. A payload the tier lost (evicted
+        disk file torn, …) is unrecoverable: the dead chain is
+        unlinked and the request must requeue — the raise unwinds
+        through abort_sequence, so nothing leaks."""
+        plan = self._plans[req.rid]
+        out = []
+        for node, dst in plan["promote"]:
+            if node.page >= 0:
+                out.append((node, dst,
+                            self._read_page_payload(node.page), "dev"))
+                continue
+            key = self._node_key(node)
+            label = self.tier.where(key) or "host"
+            payload = self.tier.get(key)
+            if payload is None:
+                self._drop_host_key(key)
+                raise RuntimeError(
+                    f"host tier lost chunk for request {req.rid} "
+                    f"({len(key)} tokens) mid-promotion — chain "
+                    f"dropped, request must requeue")
+            out.append((node, dst, payload, label))
+        return out
+
+    def commit_promotions(self, req, work) -> None:
+        """The engine installed every promoted payload on device:
+        flip host nodes to device pages (adopting ``dst`` into the
+        index), count the tier-labelled prefix hits, and release the
+        promotion pins. Nodes that raced to device keep ``dst`` as a
+        private page (freed by release like any allocated page). RAM
+        copies of adopted keys are dropped (the device page is now
+        authoritative; a disk copy stays warm for restarts)."""
+        plan = self._plans[req.rid]
+        for node, dst, _payload, label in work:
+            self.promotions += 1
+            if label == "host":
+                self.prefix_hit_tokens_host += self.page_size
+            elif label == "disk":
+                self.prefix_hit_tokens_disk += self.page_size
+            if node.page < 0:
+                node.page = dst
+                self._node_of_page[dst] = node
+                self.tier.drop_ram(self._node_key(node))
+        for key in plan["host_pins"]:
+            self.tier.unpin(key)
+        plan["host_pins"] = []
+        plan["promote"] = []
 
     def ensure_decode_page(self, slot: int, pos: int) \
             -> Optional[Tuple[int, int]]:
@@ -600,6 +892,9 @@ class PagedKVCache(_SlotTable):
         plan = self._plans.pop(req.rid, None)
         if plan is not None:
             self._committed -= plan["need_new"] - plan["allocated"]
+            for key in plan["host_pins"]:    # defensive: normally
+                self.tier.unpin(key)         # empty after commit
+            plan["host_pins"] = []
 
     def abort_sequence(self, slot: int, req) -> None:
         """Unwind a failed prefill: pages held by the slot row (and the
@@ -615,9 +910,13 @@ class PagedKVCache(_SlotTable):
                     self._unref(int(row[j]))
             row[:] = 0
         elif plan is not None:              # still just a reservation
-            for p in plan["pages"]:
-                self._unref(p)
+            for node, kind in plan["entries"]:
+                if kind == "dev":
+                    self._unref(node.page)
         if plan is not None:
+            for key in plan["host_pins"]:
+                self.tier.unpin(key)
+            plan["host_pins"] = []
             self._committed -= plan["need_new"] - plan["allocated"]
             # the requeued request will reserve (and count) again
             self.prefix_hit_tokens -= plan["hit_counted"]
@@ -637,4 +936,10 @@ class PagedKVCache(_SlotTable):
             "prefix_hit_tokens": self.prefix_hit_tokens,
             "prefix_lookup_tokens": self.prefix_lookup_tokens,
             "kv_bytes": self.kv_bytes(),
+            "pages_host": (self.tier.host_page_count()
+                           if self.tier is not None else 0),
+            "demotions": self.demotions,
+            "promotions": self.promotions,
+            "prefix_hit_tokens_host": self.prefix_hit_tokens_host,
+            "prefix_hit_tokens_disk": self.prefix_hit_tokens_disk,
         }

@@ -1,0 +1,424 @@
+"""KV-cache tiering (paddle_tpu/serving/kv_tier.py + the tiered
+PagedKVCache/engine paths): the host-RAM page tier behind the paged
+pool and the disk-backed persistent prefix store underneath it.
+
+Covers the ISSUE-16 acceptance bars: a 25-seed greedy identity band
+(tiered engine under device-page pressure vs the untiered paged engine
+vs ``generate()``, with a promotions floor and the compile-once decode
+contract), deterministic demote -> host -> promote round trips (f32
+and int8), LRU eviction with pin blocking, torn-write tolerance of the
+disk store, restart/recover warm starts, fault unwinds on both tier
+fault points, and the cross-tier half of the no-leak law going RED on
+manufactured inconsistencies."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+from paddle_tpu.resilience.invariants import page_leak_violations
+from paddle_tpu.serving import ServingEngine
+from paddle_tpu.serving.kv_tier import HostPageTier, PersistentPrefixStore
+
+
+def _tiny_llama(**kw):
+    paddle.seed(0)
+    kw.setdefault("max_position_embeddings", 128)
+    kw.setdefault("num_hidden_layers", 1)
+    kw.setdefault("hidden_size", 32)
+    kw.setdefault("intermediate_size", 64)
+    kw.setdefault("num_attention_heads", 2)
+    model = LlamaForCausalLM(llama_tiny_config(**kw))
+    model.eval()
+    return model
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    from paddle_tpu.resilience import faults
+    faults.clear()
+    faults.reset_counts()
+    yield
+    faults.clear()
+
+
+def _quiesced_ok(eng):
+    v = page_leak_violations(eng)
+    assert v == [], "\n".join(v)
+
+
+def _payload(L=1, P=8, H=2, D=4, fill=0.0, quant=False):
+    sc = (L, P, H) if quant else (0,)
+    dt = np.int8 if quant else np.float32
+    return {"k": np.full((L, P, H, D), fill, dt),
+            "v": np.full((L, P, H, D), fill, dt),
+            "ks": np.ones(sc, np.float32),
+            "vs": np.ones(sc, np.float32)}
+
+
+# -- knob / geometry validation -----------------------------------------
+
+def test_tier_knob_validation():
+    model = _tiny_llama()
+    with pytest.raises(ValueError, match="host_tier_pages"):
+        ServingEngine(model, max_slots=2, max_len=64, min_bucket=8,
+                      page_size=8, host_tier_pages=4)
+    with pytest.raises(ValueError, match="paged"):
+        ServingEngine(model, max_slots=2, max_len=64, min_bucket=8,
+                      kv_layout="contiguous", kv_host_tier=True)
+    with pytest.raises(ValueError, match="prefix_sharing"):
+        ServingEngine(model, max_slots=2, max_len=64, min_bucket=8,
+                      page_size=8, prefix_sharing=False,
+                      kv_host_tier=True)
+    with pytest.raises(ValueError, match="capacity_pages"):
+        HostPageTier(1, 8, 2, 4, np.float32, capacity_pages=0)
+    import jax
+    if jax.device_count() >= 2:
+        from paddle_tpu.distributed import ProcessMesh
+        with pytest.raises(ValueError, match="mesh"):
+            ServingEngine(model, max_slots=2, max_len=64, min_bucket=8,
+                          page_size=8, kv_host_tier=True,
+                          mesh=ProcessMesh(np.arange(2), ["model"]))
+
+
+# -- HostPageTier: LRU, pinning, geometry --------------------------------
+
+def test_host_tier_lru_eviction_and_pin_blocking():
+    evicted = []
+    tier = HostPageTier(1, 8, 2, 4, np.float32, capacity_pages=2,
+                        on_evict=evicted.append)
+    a = tuple(range(8))
+    b = a + tuple(range(10, 18))        # descendant chunk of a
+    c = tuple(range(100, 108))
+    tier.put(a, _payload())
+    tier.put(b, _payload())
+    assert tier.put(c, _payload())      # capacity 2: LRU (a) evicted
+    assert evicted == [a]
+    assert tier.where(a) is None
+    assert tier.where(b) == "host" and tier.where(c) == "host"
+    # a directly pinned key is unevictable: the next insert sheds the
+    # oldest UNPINNED key instead
+    tier.pin(b)
+    d = tuple(range(200, 208))
+    assert tier.put(d, _payload())
+    assert tier.where(c) is None and tier.where(b) == "host"
+    # pinning a key blocks its ANCESTORS too (a promotion needs the
+    # whole chain): with b pinned, re-admitting a and then inserting a
+    # fifth key finds nothing evictable but the newcomer itself — the
+    # put is REFUSED and the caller falls back to destroying the page
+    tier.put(a, _payload())             # evicts d (b pinned, a blocked)
+    assert tier.where(d) is None
+    assert not tier.put(tuple(range(300, 308)), _payload())
+    assert tier.host_page_count() == 2
+    tier.unpin(b)
+    with pytest.raises(RuntimeError, match="underflow"):
+        tier.unpin(b)
+    with pytest.raises(ValueError, match="geometry"):
+        tier.put(tuple(range(8)), _payload(P=4))
+
+
+# -- PersistentPrefixStore: atomicity, torn writes, geometry guard ------
+
+def test_store_round_trip_torn_write_and_geometry_guard(tmp_path):
+    geo = dict(num_layers=1, page_size=8, kv_heads=2, head_dim=4,
+               dtype=np.float32, quant=False)
+    store = PersistentPrefixStore(str(tmp_path), **geo)
+    k1 = tuple(range(8))
+    k2 = tuple(range(50, 58))
+    store.put(k1, _payload(fill=3.5))
+    got = store.get(k1)
+    assert got is not None
+    np.testing.assert_array_equal(got["k"],
+                                  np.full((1, 8, 2, 4), 3.5,
+                                          np.float32))
+    # atomic writes leave no temp droppings
+    assert not [n for n in os.listdir(tmp_path)
+                if n.endswith(".tmp")]
+    # a torn/corrupt chunk file reads as ABSENT and is unlinked — it
+    # must never shadow a future put or feed garbage to a promotion
+    store.put(k2, _payload())
+    with open(store._file(k2), "wb") as f:
+        f.write(b"\x00garbage")
+    assert store.get(k2) is None
+    assert not os.path.exists(store._file(k2))
+    store.put(k2, _payload())
+    with open(store._file(k2), "r+b") as f:
+        f.truncate(10)
+    assert store.keys() == [k1]         # scan drops the torn entry too
+    # geometry guard: reopening the directory with a different pool
+    # shape drops the stale entries (they index a different geometry
+    # and could never be installed)
+    other = PersistentPrefixStore(str(tmp_path),
+                                  **{**geo, "head_dim": 8})
+    assert not other.has(k1)
+    assert other.keys() == []
+
+
+# -- deterministic demote -> promote round trip --------------------------
+
+def _pressured(model, **kw):
+    """Tiered engine at a 4-usable-page budget with prompt A's first
+    page demoted to host RAM: A caches 2 full prompt pages, then the
+    disjoint B's allocation reclaims — which now demotes instead of
+    destroying."""
+    eng = ServingEngine(model, max_slots=1, max_len=32, min_bucket=8,
+                        page_size=8, num_pages=5, kv_host_tier=True,
+                        **kw)
+    rng = np.random.RandomState(21)
+    A = rng.randint(1, 128, (17,)).astype(np.int64)
+    B = rng.randint(1, 128, (17,)).astype(np.int64)
+    for p in (A, B):
+        eng.submit(p, max_new_tokens=2)
+        eng.run()
+    return eng, A, B
+
+
+def _serial_outputs(eng, prompts, new=2):
+    out = []
+    for p in prompts:
+        r = eng.submit(p, max_new_tokens=new)
+        eng.run()
+        out.append(r.output_ids)
+    return out
+
+
+@pytest.mark.parametrize("quant", [None, "int8"])
+def test_demote_promote_round_trip_token_identical(quant):
+    model = _tiny_llama()
+    kw = {} if quant is None else {"kv_dtype": quant}
+    eng, A, B = _pressured(model, **kw)
+    st = eng.paged_stats()
+    assert st["demotions"] >= 1, st
+    assert st["pages_host"] >= 1, st
+    # C shares A's first (now host-resident) page and its second
+    # (still device-cached) page: the plan promotes exactly the host
+    # chunk back into a fresh device page ahead of the extend
+    C = np.concatenate([A[:16], [5, 9]]).astype(np.int64)
+    r = eng.submit(C, max_new_tokens=2)
+    eng.run()
+    st = eng.paged_stats()
+    assert st["promotions"] >= 1, st
+    assert st["prefix_hit_tokens_host"] >= 8, st
+    assert eng.trace_counts["promote"] == 1     # compile-once install
+    assert eng.trace_counts["decode"] == 1
+    ref = ServingEngine(model, max_slots=1, max_len=32, min_bucket=8,
+                        page_size=8, num_pages=5, **kw)
+    assert _serial_outputs(ref, (A, B, C)) == \
+        _serial_outputs(ServingEngine(model, max_slots=1, max_len=32,
+                                      min_bucket=8, page_size=8,
+                                      num_pages=5, kv_host_tier=True,
+                                      **kw), (A, B, C))
+    assert r.finish_reason == "length"
+    _quiesced_ok(eng)
+
+
+# -- 25-seed identity band (ISSUE-16 acceptance) -------------------------
+
+BAND_SEEDS = list(range(25))
+_band_done = {"n": 0}
+
+
+@pytest.fixture(scope="module")
+def band():
+    model = _tiny_llama()
+    rng = np.random.RandomState(20)
+    sysA = rng.randint(1, 128, (24,)).astype(np.int64)
+    sysB = rng.randint(1, 128, (24,)).astype(np.int64)
+    kw = dict(max_slots=2, max_len=64, min_bucket=8, page_size=8,
+              num_pages=10)
+    return {"model": model, "sys": (sysA, sysB),
+            "tiered": ServingEngine(model, kv_host_tier=True, **kw),
+            "untiered": ServingEngine(model, **kw)}
+
+
+@pytest.mark.parametrize("seed", BAND_SEEDS)
+def test_tiered_identity_band(band, seed):
+    """Each seed is one wave of two requests sharing that wave's
+    system prompt; waves alternate between two system prompts, so
+    under the 9-usable-page budget each flip demotes the other
+    prompt's pages and the flip back promotes them — the tier cycles
+    continuously while every token stays identical to the untiered
+    paged engine (and, sampled, to ``generate()``)."""
+    rng = np.random.RandomState(5000 + seed)
+    sysp = band["sys"][seed % 2]
+    prompts = [np.concatenate([sysp, rng.randint(1, 128, (6,))])
+               .astype(np.int64) for _ in range(2)]
+    outs = []
+    for name in ("tiered", "untiered"):
+        eng = band[name]
+        reqs = [eng.submit(p, max_new_tokens=8) for p in prompts]
+        eng.run()
+        outs.append([r.output_ids for r in reqs])
+    assert outs[0] == outs[1]
+    if seed % 5 == 0:
+        ref = band["model"].generate(
+            paddle.to_tensor(prompts[0][None]),
+            max_new_tokens=8).numpy()[0, len(prompts[0]):]
+        np.testing.assert_array_equal(ref, outs[0][0])
+    _band_done["n"] += 1
+
+
+def test_identity_band_really_tiered(band):
+    """The band must not go green by vacuity: the tiered engine
+    really demoted and really promoted (the ISSUE-16 promotions
+    floor), the whole band ran on ONE decode program and ONE
+    promotion-install program, and both engines quiesce leak-free
+    across all three tiers."""
+    if _band_done["n"] < len(BAND_SEEDS):
+        pytest.skip("full identity band did not run")
+    st = band["tiered"].paged_stats()
+    assert st["demotions"] >= 5, st
+    assert st["promotions"] >= 5, st
+    assert st["prefix_hit_tokens_host"] >= 5 * 8, st
+    assert band["tiered"].trace_counts["decode"] == 1
+    assert band["tiered"].trace_counts["promote"] == 1
+    assert band["untiered"].trace_counts["decode"] == 1
+    assert band["untiered"].paged_stats()["demotions"] == 0
+    _quiesced_ok(band["tiered"])
+    _quiesced_ok(band["untiered"])
+
+
+# -- persistence: restart + recover warm starts --------------------------
+
+def test_persistent_store_survives_restart(tmp_path):
+    """Process-restart warm start: a fresh engine over the same store
+    directory rehydrates the radix index from disk and serves its
+    FIRST wave with a nonzero disk prefix-hit rate — token-identical
+    to a cold untiered engine."""
+    model = _tiny_llama()
+    rng = np.random.RandomState(22)
+    sysA = rng.randint(1, 128, (24,)).astype(np.int64)
+    sysB = rng.randint(1, 128, (24,)).astype(np.int64)
+    tails = [rng.randint(1, 128, (6,)).astype(np.int64)
+             for _ in range(6)]
+    kw = dict(max_slots=2, max_len=64, min_bucket=8, page_size=8,
+              num_pages=10)
+    eng = ServingEngine(model, prefix_store_dir=str(tmp_path), **kw)
+    for wave in range(4):
+        sysp = (sysA, sysB)[wave % 2]
+        for t in tails[:2]:
+            eng.submit(np.concatenate([sysp, t]), max_new_tokens=8)
+        eng.run()
+    assert eng.paged_stats()["demotions"] >= 1
+    _quiesced_ok(eng)
+
+    restarted = ServingEngine(model, prefix_store_dir=str(tmp_path),
+                              **kw)
+    cold = ServingEngine(model, **kw)
+    wave = [np.concatenate([sysA, t]).astype(np.int64)
+            for t in tails[4:6]]
+    outs = []
+    for eng2 in (restarted, cold):
+        reqs = [eng2.submit(p, max_new_tokens=8) for p in wave]
+        eng2.run()
+        outs.append([r.output_ids for r in reqs])
+    assert outs[0] == outs[1]
+    st = restarted.paged_stats()
+    assert st["prefix_hit_tokens_disk"] > 0, st
+    assert st["promotions"] >= 1, st
+    assert st["prefix_hit_rate"] > 0, st
+    _quiesced_ok(restarted)
+
+
+def test_recover_rehydrates_from_host_tier():
+    """The tier OUTLIVES the cache: ``recover()`` builds a fresh page
+    pool but rebinds the surviving host tier, so demoted chunks are
+    matchable (and promotable) immediately after recovery."""
+    model = _tiny_llama()
+    eng, A, B = _pressured(model)
+    assert eng.cache.tier.host_page_count() >= 1
+    eng.recover()
+    C = np.concatenate([A[:16], [5, 9]]).astype(np.int64)
+    r = eng.submit(C, max_new_tokens=2)
+    eng.run()
+    st = eng.paged_stats()
+    assert st["promotions"] >= 1, st
+    assert st["prefix_hit_tokens_host"] >= 8, st
+    assert r.finish_reason == "length"
+    _quiesced_ok(eng)
+
+
+# -- fault unwinds on both tier points -----------------------------------
+
+def test_demote_fault_unwinds_leak_free():
+    """``serving.kv.demote`` fires BEFORE either tier mutates: the
+    reclaim aborts, the admission unwinds (request requeued with its
+    reservation returned), and the retry demotes cleanly."""
+    from paddle_tpu.resilience import faults
+    model = _tiny_llama()
+    eng = ServingEngine(model, max_slots=1, max_len=32, min_bucket=8,
+                        page_size=8, num_pages=5, kv_host_tier=True)
+    rng = np.random.RandomState(21)
+    A = rng.randint(1, 128, (17,)).astype(np.int64)
+    B = rng.randint(1, 128, (17,)).astype(np.int64)
+    eng.submit(A, max_new_tokens=2)
+    eng.run()
+    faults.inject("serving.kv.demote", times=1)
+    rb = eng.submit(B, max_new_tokens=2)    # allocation must reclaim
+    with pytest.raises(faults.InjectedFault):
+        eng.step()
+    assert faults.fired("serving.kv.demote") == 1
+    assert eng.cache.demotions == 0             # nothing mutated
+    assert eng.cache.tier.host_page_count() == 0
+    assert eng.cache.committed_pages == 0
+    assert eng.scheduler.pending() == [rb]      # requeued, not lost
+    eng.run()
+    assert rb.finish_reason == "length"
+    assert eng.cache.demotions >= 1             # retry demoted
+    _quiesced_ok(eng)
+
+
+def test_promote_fault_unwinds_leak_free():
+    """``serving.kv.promote`` fires with the request STAGED and its
+    dst pages claimed: the unwind must pop the staging entry, return
+    every page AND tier pin, and the requeued retry must promote and
+    finish token-identically."""
+    from paddle_tpu.resilience import faults
+    model = _tiny_llama()
+    eng, A, B = _pressured(model)
+    ref = ServingEngine(model, max_slots=1, max_len=32, min_bucket=8,
+                        page_size=8, num_pages=5)
+    C = np.concatenate([A[:16], [5, 9]]).astype(np.int64)
+    ref_out = _serial_outputs(ref, (A, B, C))[2]
+    faults.inject("serving.kv.promote", times=1)
+    rc = eng.submit(C, max_new_tokens=2)
+    with pytest.raises(faults.InjectedFault):
+        eng.step()
+    assert faults.fired("serving.kv.promote") == 1
+    assert eng._staged_promotions == {}         # staging unwound
+    assert eng.cache.tier.pin_counts() == {}    # pins returned
+    assert eng.cache.committed_pages == 0
+    assert eng.cache.promotions == 0
+    assert eng.scheduler.pending() == [rc]
+    eng.run()
+    assert rc.output_ids == ref_out             # retry promoted
+    assert eng.cache.promotions >= 1
+    _quiesced_ok(eng)
+
+
+# -- cross-tier no-leak audit goes red -----------------------------------
+
+def test_cross_tier_audit_catches_manufactured_leaks():
+    """The extended ``page_leak_violations`` must go RED on each
+    cross-tier inconsistency class: a leaked promotion pin, a host
+    buffer no radix node anchors (memory nothing can promote or
+    evict), and a HOST node whose tier data vanished (a match would
+    promote garbage)."""
+    model = _tiny_llama()
+    eng, A, B = _pressured(model)
+    _quiesced_ok(eng)                           # green before tampering
+    tier = eng.cache.tier
+    key = tier.ram_keys()[0]
+    tier.pin(key)
+    assert any("tier pins" in v for v in page_leak_violations(eng))
+    tier.unpin(key)
+    _quiesced_ok(eng)
+    orphan = tuple(range(1000, 1008))
+    tier.put(orphan, eng.cache._read_page_payload(0))
+    assert any("orphaned host-tier" in v
+               for v in page_leak_violations(eng))
+    tier.drop(orphan)
+    _quiesced_ok(eng)
+    tier.drop(key)                              # data gone, node stays
+    assert any("dataless HOST" in v for v in page_leak_violations(eng))

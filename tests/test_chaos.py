@@ -207,7 +207,9 @@ def _have_cluster():
 
 _serving_spec_tally = {"episodes": 0, "speculative": 0,
                        "accepted_drafts": 0, "verify_kills": 0,
-                       "chunked": 0, "chunk_kills": 0}
+                       "chunked": 0, "chunk_kills": 0,
+                       "tiered": 0, "demotions": 0, "promotions": 0,
+                       "tier_kills": 0}
 
 
 @pytest.mark.parametrize("seed", SERVING_SEEDS)
@@ -226,6 +228,12 @@ def test_serving_episode_matrix(seed):
         1 if res.stats["prefill_chunk"] else 0
     _serving_spec_tally["chunk_kills"] += \
         res.fired.get("serving.prefill.chunk", 0)
+    _serving_spec_tally["tiered"] += 1 if res.stats["kv_tiered"] else 0
+    _serving_spec_tally["demotions"] += res.stats["demotions"]
+    _serving_spec_tally["promotions"] += res.stats["promotions"]
+    _serving_spec_tally["tier_kills"] += \
+        res.fired.get("serving.kv.demote", 0) \
+        + res.fired.get("serving.kv.promote", 0)
 
 
 def test_serving_matrix_actually_speculates():
@@ -251,6 +259,22 @@ def test_serving_matrix_actually_chunks():
         pytest.skip("full serving matrix did not run")
     assert _serving_spec_tally["chunked"] >= 3, _serving_spec_tally
     assert _serving_spec_tally["chunk_kills"] >= 1, _serving_spec_tally
+
+
+def test_serving_matrix_actually_tiers():
+    """The KV-tier arm must stay LOADED: episodes that really run with
+    a host tier attached (sampled on its own rng stream so pre-tier
+    seeds stay bit-identical), episodes that really demote cold pages
+    to host RAM under the clamped pool, and at least one promotion
+    genuinely installing a host page back on-device — otherwise the
+    tier regime soaks green by vacuity. Kills ON the tier fault
+    points are pinned separately (the dropped-promotion seed below
+    fires ``serving.kv.promote`` on every run)."""
+    if _serving_spec_tally["episodes"] < len(SERVING_SEEDS):
+        pytest.skip("full serving matrix did not run")
+    assert _serving_spec_tally["tiered"] >= 3, _serving_spec_tally
+    assert _serving_spec_tally["demotions"] >= 3, _serving_spec_tally
+    assert _serving_spec_tally["promotions"] >= 1, _serving_spec_tally
 
 
 @pytest.mark.parametrize("seed", TRAINING_SEEDS)
@@ -661,11 +685,13 @@ def test_pinned_seed_catches_drain_discarding_done(monkeypatch):
     assert green.ok, "\n".join(green.violations)
 
 
-PINNED_SEED_BROKEN_SPEC = 6   # speculative episode with real accepts
+PINNED_SEED_BROKEN_SPEC = 8   # speculative episode with real accepts
 # (re-pinned 5 -> 6 for the ISSUE-9 verify GATE: no-draft steps now
 # run the k=1 decode program, so the broken-acceptance patch only
-# distorts steps that really carry drafts — seed 6 has partially
-# rejected drafts, which is exactly what the patch mis-emits)
+# distorts steps that really carry drafts; re-pinned 6 -> 8 for the
+# ISSUE-16 tier duty cycle: seed 6's tiered workload changed and its
+# drafts now verify clean — seed 8 still has partially rejected
+# drafts, which is exactly what the patch mis-emits)
 
 
 def test_pinned_seed_catches_broken_speculative_acceptance(
@@ -738,6 +764,47 @@ def test_pinned_seed_dropped_kv_handoff_goes_lost(monkeypatch):
     assert green.ok, "\n".join(green.violations)
     assert green.fired.get("serving.kv.handoff", 0) >= 1
     assert green.stats["mesh"] == "disagg"
+
+
+PINNED_SEED_DROPPED_PROMOTION = 696   # tiered episode, promote kill
+
+
+def test_pinned_seed_dropped_kv_promotion_goes_lost(monkeypatch):
+    """ISSUE-16 pinned red seed: a DROPPED KV promotion must be
+    detected. With the mid-promotion failure SWALLOWED at the prefill
+    boundary (the pre-fix shape: the engine eats the exception after
+    the request was staged and its dst pages claimed, so the request
+    is neither served nor returned), the conservation ledger must go
+    RED with LOST on the pinned tiered seed; the real path — the
+    staged-promotion unwind pops the staging entry, returns the dst
+    pages and the tier pins through ``abort_sequence``, and the
+    request requeues and retries — stays green on the same seed, with
+    the promote kill arm genuinely fired and real demotions AND
+    promotions behind it (not green by vacuity)."""
+    from paddle_tpu.resilience.faults import InjectedFault
+    from paddle_tpu.serving import ServingEngine
+    orig = ServingEngine._prefill
+
+    def swallow_promotion_failure(self, slot, req):
+        try:
+            return orig(self, slot, req)
+        except InjectedFault as e:
+            if getattr(e, "point", "") != "serving.kv.promote":
+                raise
+            return          # pre-fix: request dropped on the floor
+
+    monkeypatch.setattr(ServingEngine, "_prefill",
+                        swallow_promotion_failure)
+    red = chaos.run_serving_episode(PINNED_SEED_DROPPED_PROMOTION)
+    assert not red.ok
+    assert any("LOST" in v for v in red.violations), red.violations
+    monkeypatch.setattr(ServingEngine, "_prefill", orig)
+    green = chaos.run_serving_episode(PINNED_SEED_DROPPED_PROMOTION)
+    assert green.ok, "\n".join(green.violations)
+    assert green.fired.get("serving.kv.promote", 0) >= 1
+    assert green.stats["kv_tiered"]
+    assert green.stats["demotions"] >= 1
+    assert green.stats["promotions"] >= 1
 
 
 # -- disarmed maybe_fail is (nearly) free ------------------------------

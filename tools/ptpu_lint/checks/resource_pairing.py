@@ -1,7 +1,9 @@
 """Resource pairing (PTL301): the no-leaked-pages/slots law as lint.
 
 Every page/slot/COW-claim acquisition — ``try_reserve``,
-``begin_sequence``, ``ensure_decode_page``, ``ensure_decode_range`` —
+``begin_sequence``, ``ensure_decode_page``, ``ensure_decode_range``,
+``begin_promotions`` (the KV-tier promotion handle: dst pages claimed
+and tier pins held until commit or abort) —
 must sit lexically inside a ``try`` whose except handler (or
 ``finally``) reaches the matching release/unwind
 (``abort_sequence``, ``cancel_reservation``, ``release``,
@@ -27,7 +29,7 @@ from typing import List, Optional
 from ..core import FileUnit, Finding, file_check
 
 ACQUIRES = {"try_reserve", "begin_sequence", "ensure_decode_page",
-            "ensure_decode_range"}
+            "ensure_decode_range", "begin_promotions"}
 RELEASES = {"release", "abort_sequence", "cancel_reservation",
             "rollback_speculation", "_unwind_chunk", "recover",
             "_new_cache"}
