@@ -54,6 +54,17 @@ prefixes (ISSUE 6 acceptance). Emits a schema-guarded ``PAGED_KV``
 summary line (prefix hit rate, pages/token, peak concurrency, gains)
 asserted in tests/test_benchmarks_smoke.py.
 
+``--watchtower``: incident-detection certification mode — the same
+burst trace replayed twice through an engine with a ``Watchtower``
+attached (virtual clock): once clean (MUST raise zero incidents) and
+once with an injected mid-decode outage (the virtual clock advances
+past the stall budget while the engine takes no step — an operator-
+visible hang), which MUST raise a ``('stall', 'decode')`` incident
+and flip ``/healthz`` red. Greedy outputs stay token-identical (the
+watchtower never touches engine state) and the hot path stays one
+counter increment per step. Emits the schema-guarded ``WATCHTOWER``
+line asserted in tests/test_benchmarks_smoke.py (ISSUE-17).
+
 ``--kv-tiering``: host-RAM page tier + persistent prefix store mode —
 shared-prompt waves under a device-page budget too small to keep
 every system prompt cached, across the untiered paged engine, the
@@ -486,6 +497,119 @@ def run_kv_tiering(model, *, slots, max_len, min_bucket, page_size,
         raise SystemExit(
             "kv-tiering bench failed: tiered outputs diverged from "
             "the untiered engine")
+
+
+def run_watchtower(model, *, slots, max_len, min_bucket, n_req,
+                   max_new, stall_after_s, seed=0):
+    """--watchtower: clean run vs injected-stall run of one burst
+    trace, with a Watchtower attached to the engine's own registry.
+    The clean replay must raise ZERO incidents (the false-positive
+    bar); the stall replay freezes the engine while the virtual clock
+    runs past the stall budget and must raise a correctly-attributed
+    ``('stall', 'decode')`` incident that flips healthz red. Outputs
+    must stay token-identical across the two runs — detection is
+    read-only."""
+    from paddle_tpu.observability import (MetricRegistry, SLOObjective,
+                                          Watchtower)
+    from paddle_tpu.serving import ServingEngine
+
+    rng = np.random.RandomState(seed)
+    lens = [4, 7, 12, 20]
+    prompts = [rng.randint(1, 100, (int(rng.choice(lens)),))
+               .astype(np.int64) for _ in range(n_req)]
+    new = [max_new] * n_req
+
+    def drive(inject_stall):
+        clock = {"t": 0.0}
+        reg = MetricRegistry()
+        eng = ServingEngine(model, max_slots=slots, max_len=max_len,
+                            min_bucket=min_bucket, registry=reg,
+                            time_fn=lambda: clock["t"])
+        # burn objectives with thresholds in VIRTUAL seconds (the
+        # engine's time_fn is the virtual clock) — generous enough
+        # that the clean run cannot trip them, present so the burn
+        # plumbing runs end-to-end in both replays; anomaly streams
+        # off for the same virtual-clock reason as the chaos bands
+        wt = Watchtower(
+            registry=reg, time_fn=lambda: clock["t"],
+            objectives=(
+                SLOObjective(name="ttft_p99", threshold_s=120.0,
+                             objective=0.5,
+                             family="ptpu_serving_ttft_seconds"),
+                SLOObjective(name="queue_wait_p95", threshold_s=120.0,
+                             objective=0.5,
+                             family="ptpu_serving_queue_wait_seconds"),
+            ),
+            eval_interval_s=0.5, stall_after_s=stall_after_s,
+            anomaly_streams=False)
+        wt.attach_engine(eng)
+        wt.flush()                    # prime counter baselines
+        reqs = [eng.submit(p, n) for p, n in zip(prompts, new)]
+        steps = 0
+        stall_at = max(2, (sum(new) // slots) // 2)
+        while eng.has_work():
+            w0 = time.perf_counter()
+            eng.step()
+            clock["t"] += time.perf_counter() - w0
+            steps += 1
+            if inject_stall and steps == stall_at:
+                # the outage: requests are in flight, the clock keeps
+                # running, the engine takes no step
+                for _ in range(int(stall_after_s * 4)):
+                    clock["t"] += 1.0
+                    wt.poll()
+            wt.poll()
+        wt.flush()
+        return {"outputs": [r.output_ids for r in reqs],
+                "steps": steps, "wt": wt,
+                "kinds": sorted({(i.kind, i.phase)
+                                 for i in wt.incidents()})}
+
+    clean = drive(inject_stall=False)
+    stalled = drive(inject_stall=True)
+    identical = stalled["outputs"] == clean["outputs"]
+    summary = {
+        "requests": n_req,
+        "steps": clean["steps"],
+        "stall_after_s": stall_after_s,
+        "burn_objectives": 2,
+        "incidents_clean": len(clean["wt"].incidents()),
+        "incidents_stalled": len(stalled["wt"].incidents()),
+        "incident_kinds_stalled": [list(k) for k in stalled["kinds"]],
+        "healthz_ok_clean": bool(clean["wt"].healthz()["ok"]),
+        "healthz_ok_stalled": bool(stalled["wt"].healthz()["ok"]),
+        "token_identical": bool(identical),
+    }
+    print(json.dumps({
+        "metric": (
+            f"watchtower incident detection ({n_req} reqs burst, "
+            f"+{max_new} new, {slots} slots, virtual clock): clean "
+            f"replay {summary['incidents_clean']} incidents "
+            f"(healthz ok={summary['healthz_ok_clean']}), injected "
+            f"{stall_after_s:.0f}s-budget stall "
+            f"{summary['incidents_stalled']} incident(s) "
+            f"{summary['incident_kinds_stalled']} (healthz "
+            f"ok={summary['healthz_ok_stalled']}), greedy "
+            f"token-identical={identical}; baseline=0 clean-run "
+            f"incidents)"),
+        "value": float(summary["incidents_stalled"]),
+        "unit": "incidents",
+        "vs_baseline": float(summary["incidents_clean"])}))
+    print("WATCHTOWER " + json.dumps(summary))
+    if summary["incidents_clean"] != 0:
+        raise SystemExit(
+            f"watchtower bench failed: clean run raised "
+            f"{summary['incidents_clean']} incident(s) — false "
+            f"positives")
+    if ["stall", "decode"] not in summary["incident_kinds_stalled"] \
+            or summary["healthz_ok_stalled"]:
+        raise SystemExit(
+            "watchtower bench failed: injected stall did not raise "
+            "a ('stall', 'decode') incident / flip healthz red")
+    if not identical:
+        raise SystemExit(
+            "watchtower bench failed: outputs diverged between the "
+            "watched replays — detection must be read-only")
 
 
 def run_speculative(model, *, slots, max_len, min_bucket, page_size,
@@ -1330,6 +1454,16 @@ def main():
                            page_size=8, num_pages=10, sys_len=24,
                            tail_len=6, max_new=8, waves=4,
                            wave_width=2)
+        return
+
+    if "--watchtower" in sys.argv:
+        if on_tpu:
+            run_watchtower(model, slots=16, max_len=512,
+                           min_bucket=32, n_req=48, max_new=32,
+                           stall_after_s=5.0)
+        else:
+            run_watchtower(model, slots=4, max_len=64, min_bucket=8,
+                           n_req=12, max_new=8, stall_after_s=5.0)
         return
 
     if "--speculative" in sys.argv:

@@ -27,6 +27,10 @@ SCRIPTS = ["bench_resnet50.py", "bench_bert_dp.py", "bench_gpt_hybrid.py",
            # under device-page pressure (tier-labelled hit rates,
            # restart warm-start)
            "bench_serving_engine.py --kv-tiering",
+           # watchtower incident detection: zero incidents on the
+           # clean replay, a correctly-attributed stall incident on
+           # the injected-outage replay
+           "bench_serving_engine.py --watchtower",
            # chunked prefill: bounded decode stalls under mixed
            # long-prompt / short-decode traffic (token identity +
            # the tail-latency SLO artifact)

@@ -16,6 +16,12 @@ Three pieces (docs/OBSERVABILITY.md has the full guide):
   events) dumped to disk when a step raises, the watchdog flags a dead
   peer, or an unhandled exception escapes; workers additionally spill
   the ring periodically so even a SIGKILL leaves a post-mortem.
+- **Watchtower** (``watchtower.py``): the sensing layer over all of
+  the above — multi-window SLO burn rates against declared objectives,
+  EWMA + robust z-score anomaly detectors, stall/orphan/death
+  detection, and deduped structured ``Incident`` records served from
+  the front door's ``/healthz`` + ``/incidents`` endpoints and
+  rendered by ``tools/ptpu_doctor.py``.
 - **Cluster timeline** (``timeline.py``): merges per-process trace
   buffers and registry snapshots (scraped over the cluster
   ``telemetry`` RPC) into one chrome trace with per-request lanes, a
@@ -41,10 +47,17 @@ from .tracing import (Span, span, TraceContext,  # noqa: F401
                       active_context)
 from .flight_recorder import FlightRecorder, default_recorder  # noqa: F401
 from .timeline import ClusterTelemetry  # noqa: F401
+from .watchtower import (Watchtower, Incident,  # noqa: F401
+                         SLOObjective, DEFAULT_OBJECTIVES,
+                         EwmaDetector, RobustZDetector,
+                         render_diagnosis)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricError",
            "MetricRegistry", "default_registry", "Span", "span",
            "TraceContext", "TraceBuffer", "install_trace_buffer",
            "current_trace_buffer", "bind_request", "unbind_request",
            "clear_bindings", "context_for", "active_context",
-           "FlightRecorder", "default_recorder", "ClusterTelemetry"]
+           "FlightRecorder", "default_recorder", "ClusterTelemetry",
+           "Watchtower", "Incident", "SLOObjective",
+           "DEFAULT_OBJECTIVES", "EwmaDetector", "RobustZDetector",
+           "render_diagnosis"]

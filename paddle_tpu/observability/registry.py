@@ -182,6 +182,19 @@ class _HistogramChild(_Child):
             lo = ub if ub != math.inf else lo
         return lo
 
+    def quantile(self, q: float) -> float:
+        """Estimate the q-th quantile (0..1) — the Prometheus
+        ``histogram_quantile`` convention. Linear interpolation
+        between bucket bounds: the owning bucket ``(lo, ub]`` is
+        found by cumulative count, then the estimate is ``lo + (ub -
+        lo) * frac`` where ``frac`` is the target's fractional
+        position among the bucket's own observations (uniform-within-
+        bucket assumption). The open +Inf tail returns its lower
+        bound; an empty histogram returns 0.0."""
+        if not 0.0 <= q <= 1.0:
+            raise MetricError(f"quantile takes q in [0, 1], got {q}")
+        return self.percentile(q * 100.0)
+
     def _reset(self):
         with self._lock:
             self._bucket_counts = [0] * len(self._family.buckets)
@@ -314,6 +327,10 @@ class Histogram(_Family):
     def percentile(self, q: float) -> float:
         return self._default_child().percentile(q)
 
+    def quantile(self, q: float) -> float:
+        """q in 0..1 (see :meth:`_HistogramChild.quantile`)."""
+        return self._default_child().quantile(q)
+
 
 class MetricRegistry:
     """Create-or-get metric families; export the whole set atomically.
@@ -439,6 +456,16 @@ class MetricRegistry:
                     .replace("\n", r"\n")
                 lines.append(f"# HELP {fam.name} {h}")
             lines.append(f"# TYPE {fam.name} {fam.kind}")
+            if fam.kind == "histogram" and not fam._sorted_children():
+                # a labeled histogram family nobody has observed yet
+                # still exposes its _count/_sum (and the +Inf bucket
+                # the pair implies): dashboards and the watchtower
+                # read "registered but zero traffic" instead of
+                # "family missing", and rate() starts from 0 rather
+                # than a gap
+                lines.append(f'{fam.name}_bucket{{le="+Inf"}} 0')
+                lines.append(f"{fam.name}_sum 0")
+                lines.append(f"{fam.name}_count 0")
             for c in fam._sorted_children():
                 ls = lbl(fam.label_names, c._labels)
                 if fam.kind == "histogram":

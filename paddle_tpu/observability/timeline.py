@@ -499,6 +499,13 @@ class ClusterTelemetry:
                     .replace("\n", r"\n")
                 lines.append(f"# HELP {name} {h}")
             lines.append(f"# TYPE {name} {ent['type']}")
+            if ent["type"] == "histogram" and not ent["samples"]:
+                # same zero-observation contract as
+                # MetricRegistry.to_prometheus(): a registered-but-
+                # silent histogram family still exposes _count/_sum
+                lines.append(f'{name}_bucket{{le="+Inf"}} 0')
+                lines.append(f"{name}_sum 0")
+                lines.append(f"{name}_count 0")
             for key in sorted(ent["samples"]):
                 val = ent["samples"][key]
                 ls = lbl(ent["label_names"], key)

@@ -201,7 +201,9 @@ def _sample_arms(rng, specs) -> List[FaultArm]:
 
 
 def run_serving_episode(seed: int, max_iters: int = 300,
-                        mesh_flavor: Optional[str] = None) \
+                        mesh_flavor: Optional[str] = None,
+                        watchtower: bool = False,
+                        arm_faults: bool = True) \
         -> EpisodeResult:
     """One seeded serving episode: Poisson arrivals over the fixed
     prompt pool with sampled deadlines/cancels, decode/prefill faults
@@ -217,7 +219,15 @@ def run_serving_episode(seed: int, max_iters: int = 300,
     flavors degrade to "local" when the process has too few (virtual)
     devices; mesh episodes are audited against the SAME single-chip
     reference outputs — cross-flavor token identity IS the
-    tensor-parallel correctness law."""
+    tensor-parallel correctness law.
+
+    ``watchtower=True`` attaches an observability watchtower to the
+    episode's registry + virtual clock (polled every iteration,
+    flushed at quiesce) and reports its incidents in the episode
+    stats. ``arm_faults=False`` runs the SAME seed — every rng draw
+    happens, the schedule is built, the workload is bit-identical —
+    but no arm is ever armed: the clean band the watchtower's
+    false-positive floor is certified against."""
     from ..observability import FlightRecorder, MetricRegistry
     from ..serving import ServingEngine
 
@@ -299,16 +309,21 @@ def run_serving_episode(seed: int, max_iters: int = 300,
                    "host_tier_pages": None if tier_unbounded
                    else tier_cap}
         num_pages = min(num_pages, tier_pages)
+    registry = MetricRegistry()
     eng = ServingEngine(model, max_slots=max_slots, max_len=_MAX_LEN,
                         min_bucket=_MIN_BUCKET,
                         page_size=8, num_pages=num_pages,
                         time_fn=lambda: clock["t"],
-                        registry=MetricRegistry(),
+                        registry=registry,
                         flight_recorder=FlightRecorder(capacity=8),
                         auditor=ledger, **spec_kw, **mesh_kw,
                         **chunk_kw, **tier_kw)
     if donate:
         eng._donate = lambda: (5, 6)
+    wt = None
+    if watchtower:
+        wt = _serving_watchtower(registry, clock)
+        wt.attach_engine(eng)
 
     n_req = int(rng.randint(4, 9))
     plan = []                 # (arrival_t, pool_idx, max_new, deadline)
@@ -414,9 +429,10 @@ def run_serving_episode(seed: int, max_iters: int = 300,
         drain_arm = FaultArm("serving.step.decode", times=1,
                              after=int(rng.randint(0, 3)))
         schedule = schedule + [drain_arm]
-    for arm in schedule:
-        if arm is not drain_arm:
-            arm.arm()
+    if arm_faults:
+        for arm in schedule:
+            if arm is not drain_arm:
+                arm.arm()
 
     violations: List[str] = []
     submitted: List[Tuple[object, int]] = []
@@ -453,6 +469,8 @@ def run_serving_episode(seed: int, max_iters: int = 300,
             for order, at_iter in cancels:
                 if at_iter == iters and order < len(submitted):
                     eng.cancel(submitted[order][0])
+            if wt is not None:
+                wt.poll()
             if not eng.has_work():
                 continue
             try:
@@ -472,24 +490,63 @@ def run_serving_episode(seed: int, max_iters: int = 300,
                             "attempts")
                         return _serving_result(
                             seed, violations, schedule, ledger,
-                            submitted, refs, eng, recoveries, steps_ok)
+                            submitted, refs, eng, recoveries,
+                            steps_ok, wt)
                     try:
                         eng.recover()
                         recoveries += 1
                     except Exception:
                         continue
-        if drain_arm is not None:
+        if drain_arm is not None and arm_faults:
             drain_arm.arm()
         eng.drain()
     except Exception as e:  # noqa: BLE001 — any escape breaks the
         violations.append(  # "drain()/step() never strand work" law
             f"episode escaped with {type(e).__name__}: {e}")
     return _serving_result(seed, violations, schedule, ledger,
-                           submitted, refs, eng, recoveries, steps_ok)
+                           submitted, refs, eng, recoveries, steps_ok,
+                           wt)
+
+
+def _serving_watchtower(registry, clock):
+    """The watchtower configuration the serving chaos band certifies:
+    burn objectives in VIRTUAL seconds with thresholds far above what
+    any clean episode produces (a clean 25-seed band must raise
+    exactly zero incidents), the orphan detector on (a clean episode
+    must never lose a request the metrics ledger still tracks), and
+    the wall-clock-shaped detectors (stall, heartbeat, EWMA streams)
+    off — an iteration-granular virtual clock freeze-frames between
+    polls, which those detectors would misread as outages. They are
+    certified synthetically in tests/test_watchtower.py instead."""
+    from ..observability.watchtower import SLOObjective, Watchtower
+    objectives = (
+        SLOObjective("ttft_p50_virtual", threshold_s=120.0,
+                     objective=0.5,
+                     family="ptpu_serving_ttft_seconds",
+                     phase="queue", fast_window_s=30.0,
+                     slow_window_s=300.0),
+        SLOObjective("queue_wait_p50_virtual", threshold_s=120.0,
+                     objective=0.5,
+                     family="ptpu_serving_queue_wait_seconds",
+                     phase="queue", fast_window_s=30.0,
+                     slow_window_s=300.0),
+    )
+    return Watchtower(registry=registry, objectives=objectives,
+                      time_fn=lambda: clock["t"],
+                      eval_interval_s=2.0, dedup_window_s=1e9,
+                      stall_after_s=None, heartbeat_max_age_s=None,
+                      anomaly_streams=False)
 
 
 def _serving_result(seed, violations, schedule, ledger, submitted,
-                    refs, eng, recoveries, steps_ok) -> EpisodeResult:
+                    refs, eng, recoveries, steps_ok,
+                    wt=None) -> EpisodeResult:
+    if wt is not None:
+        # two forced evaluations at quiesce: the orphan detector
+        # requires two consecutive sightings, so a request dropped on
+        # the episode's final iteration is still confirmed
+        wt.flush()
+        wt.flush()
     fired = faults.fired()
     faults.clear()
     violations = list(violations)
@@ -522,7 +579,12 @@ def _serving_result(seed, violations, schedule, ledger, submitted,
                "cow_copies": eng.cache.cow_copies,
                "kv_tiered": getattr(eng, "_kv_tier", None) is not None,
                "demotions": getattr(eng.cache, "demotions", 0),
-               "promotions": getattr(eng.cache, "promotions", 0)})
+               "promotions": getattr(eng.cache, "promotions", 0),
+               "incidents": (0 if wt is None
+                             else len(wt.incidents())),
+               "incident_kinds": sorted(
+                   {(i.kind, i.phase) for i in wt.incidents()})
+               if wt is not None else []})
 
 
 # ---------------------------------------------------------------------------
@@ -836,6 +898,20 @@ def run_cluster_episode(seed: int, max_iters: int = 300,
     # counters so the stats below are THIS episode's deltas
     fail0 = int(router._m_failover.value)
     fail_req0 = int(router._m_failover_req.value)
+    # watchtower over the SUPERVISOR registry (where the router's
+    # death/failover counters live — band-lived, so the priming flush
+    # below snapshots pre-episode history the same way fail0 does) +
+    # the cluster telemetry plane for trace excerpts. Wall-clock
+    # detectors are off for the same virtual-clock reason as the
+    # serving band (_serving_watchtower docstring).
+    from ..observability.watchtower import Watchtower
+    wt = Watchtower(registry=sup.registry, objectives=(),
+                    telemetry=sup.telemetry,
+                    time_fn=lambda: clock["t"],
+                    eval_interval_s=2.0, dedup_window_s=1e9,
+                    stall_after_s=None, heartbeat_max_age_s=None,
+                    anomaly_streams=False)
+    wt.flush()                   # prime counter baselines
     tenants = {}
     if rng.random() < 0.5:
         tenants["b"] = TenantPolicy(
@@ -845,7 +921,7 @@ def run_cluster_episode(seed: int, max_iters: int = 300,
                       time_fn=lambda: clock["t"],
                       registry=MetricRegistry(),
                       flight_recorder=FlightRecorder(capacity=8),
-                      tenants=tenants)
+                      tenants=tenants, watchtower=wt)
 
     n_req = int(rng.randint(4, 9))
     plan = []      # (arrival_t, pool_idx, max_new, deadline, tenant)
@@ -991,12 +1067,18 @@ def run_cluster_episode(seed: int, max_iters: int = 300,
             if front.has_work():
                 front.pump()
             sup.poll()
+            wt.poll()        # death counters advance in sup.poll()
         front.drain()
         sup.poll()
         sup.scrape_all()     # pick up spans from the drain's steps
     except Exception as e:  # noqa: BLE001 — any escape breaks the
         violations.append(  # "the cluster never strands work" law
             f"episode escaped with {type(e).__name__}: {e}")
+    # two forced evaluations at quiesce: deaths the final sup.poll()
+    # marked (and any orphan-style double-confirmation) land in this
+    # episode's incident set before the stats snapshot
+    wt.flush()
+    wt.flush()
 
     fired = faults.fired()
     faults.clear()
@@ -1056,7 +1138,11 @@ def run_cluster_episode(seed: int, max_iters: int = 300,
                "kills": dict(kind_counts),
                "respawns": sup.respawns_used,
                "worker_arm": worker_arm,
-               "attempts": ledger.attempts})
+               "attempts": ledger.attempts,
+               "incidents": len(wt.incidents()),
+               "incident_kinds": sorted(
+                   {(inc.kind, inc.phase)
+                    for inc in wt.incidents()})})
 
 
 # ---------------------------------------------------------------------------

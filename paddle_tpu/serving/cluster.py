@@ -194,6 +194,11 @@ class RemoteEngine:
         self._seq = 0
         self._token = uuid.uuid4().hex   # resend-dedup namespace
         self._dead = False
+        # the FIRST fatal cause ("unreachable after retries", "process
+        # exited with ..."): every later marked-dead raise carries it,
+        # so death_kind (and watchtower's partition-vs-death
+        # classification) see the root cause, not the fencing symptom
+        self._dead_reason = ""
         self._reqs: Dict[int, Request] = {}
         self._queued: List[int] = []
         self._slots: Dict[int, int] = {}
@@ -253,7 +258,10 @@ class RemoteEngine:
               deadline: Optional[float] = None,
               retry: bool = True) -> dict:
         if self._dead:
-            raise ReplicaDead(f"worker {self.name} marked dead")
+            raise ReplicaDead(
+                f"worker {self.name} marked dead"
+                + (f" ({self._dead_reason})" if self._dead_reason
+                   else ""))
         self._seq += 1
         seq = self._seq
         # every frame carries the virtual clock AND the active trace
@@ -275,13 +283,17 @@ class RemoteEngine:
                                             dl, op=f"cluster.{op}")
                 except RetryError as e:
                     self._dead = True
+                    self._dead_reason = self._dead_reason \
+                        or "unreachable after retries"
                     raise ReplicaDead(
                         f"worker {self.name} unreachable after "
                         f"retries ({e})") from e
             else:
                 resp = self._attempt(blob, seq, dl)
-        except ReplicaDead:
+        except ReplicaDead as e:
             self._dead = True
+            self._dead_reason = self._dead_reason or e.detail \
+                or str(e)
             raise
         finally:
             self._m_inflight.labels(worker=self.name).set(0)

@@ -368,6 +368,10 @@ class ServingEngine:
         # path unwinds them). Requests also carry their own
         # `cancel_requested` flag, checked first.
         self.cancel_probe = cancel_probe
+        # optional watchtower (observability.watchtower) installed by
+        # Watchtower.attach_engine(); the step hot path bumps its
+        # counter — one increment, nothing else (micro-asserted)
+        self._watchtower = None
         self._in_drain = False
         # python-side-effect counters bumped at TRACE time: the compile-
         # count contract (1 decode + O(log max_len) prefill buckets) is
@@ -821,6 +825,9 @@ class ServingEngine:
         self._m_step.observe(dt)
         self._m_queue_depth.set(depth)
         self._m_active.set(n_active)
+        wt = self._watchtower
+        if wt is not None:
+            wt.observe_step()
         if self._undelivered:
             # requests stranded by an earlier FAILED step ride the
             # first successful step out (they finished first: prepend)
@@ -1425,6 +1432,22 @@ class ServingEngine:
         # place for the next step()/recover() instead of losing it
         self._undelivered = []
         return report
+
+    def inflight_rids(self) -> set:
+        """Every request id the engine itself still owns: queued,
+        decoding in a slot, staged mid-handoff/promotion, or finished
+        but not yet delivered. The complement of this set against
+        ``metrics.inflight_phases()`` is watchtower's orphan detector:
+        a rid the metrics ledger tracks that appears in none of these
+        places has been dropped by a fault that unwound the engine's
+        bookkeeping but never requeued or finished the request."""
+        rids = {r.rid for r in self.scheduler.pending()}
+        for s in self.cache.active_slots():
+            rids.add(self.cache.slots[s].rid)
+        rids.update(r.rid for r in self._undelivered)
+        rids.update(self._staged_handoffs)
+        rids.update(self._staged_promotions)
+        return rids
 
     def run(self, max_steps: Optional[int] = None) -> List[Request]:
         """Drive step() until the queue and every slot drain."""
@@ -2457,6 +2480,7 @@ class ServingEngine:
         rid = req.rid
         c = self.cache
         self._staged_promotions[rid] = slot
+        self.metrics.on_promotion_start(rid)
         t0 = self.metrics.now()
         try:
             maybe_fail("serving.kv.promote", slot=slot, rid=rid,

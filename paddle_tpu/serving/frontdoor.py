@@ -163,7 +163,7 @@ class FrontDoor:
                  default_policy: Optional[TenantPolicy] = None,
                  tenants: Optional[Dict[str, TenantPolicy]] = None,
                  auditor=None, registry=None, flight_recorder=None,
-                 telemetry=None,
+                 telemetry=None, watchtower=None,
                  time_fn: Callable[[], float] = time.monotonic):
         self.backend = backend
         self.default_policy = default_policy or TenantPolicy()
@@ -179,6 +179,10 @@ class FrontDoor:
         if telemetry is not None:
             telemetry.add_host_registry(self.registry,
                                         name="frontdoor")
+        # observability.Watchtower (optional): pump() polls it (cheap
+        # clock-compare between window boundaries) and the HTTP
+        # binding serves its /healthz verdict + /incidents payload
+        self.watchtower = watchtower
         self.recorder = flight_recorder if flight_recorder is not None \
             else default_recorder()
         self._handles: Dict[int, FrontDoorHandle] = {}  # guarded-by: _lock
@@ -364,6 +368,16 @@ class FrontDoor:
         """One front-door iteration: one backend step, then route
         tokens/results to client streams and audit deliveries. Returns
         the requests that reached the client this call."""
+        out = self._pump_locked()
+        # watchtower evaluation runs OUTSIDE the lock: between window
+        # boundaries this is one clock read; at a boundary it reads
+        # registry snapshots, which are internally synchronized
+        wt = self.watchtower
+        if wt is not None:
+            wt.poll()
+        return out
+
+    def _pump_locked(self) -> List[Request]:
         with self._lock:
             if not self.backend.has_work():
                 return []
@@ -539,9 +553,21 @@ class FrontDoorHTTPServer:
                     ok = (not health) or any(
                         h["state"] == "healthy"
                         for h in health.values())
+                    payload = {"ok": ok, "replicas": health}
+                    wt = outer.front.watchtower
+                    if wt is not None:
+                        w = wt.healthz()
+                        payload["watchtower"] = w
+                        payload["ok"] = ok = bool(ok and w["ok"])
                     self._json_response(
-                        200 if ok else 503,
-                        {"ok": ok, "replicas": health})
+                        200 if ok else 503, payload)
+                elif self.path == "/incidents":
+                    wt = outer.front.watchtower
+                    if wt is None:
+                        self._json_response(
+                            404, {"error": "no watchtower attached"})
+                    else:
+                        self._json_response(200, wt.to_json())
                 elif self.path == "/metrics":
                     body = outer.front.metrics_exposition() \
                         .encode()

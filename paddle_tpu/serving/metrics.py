@@ -31,7 +31,7 @@ __all__ = ["EngineMetrics"]
 
 class _ReqStats:
     __slots__ = ("t_submit", "t_first", "t_prefill", "t_last_token",
-                 "stalled")
+                 "stalled", "phase")
 
     def __init__(self, t_submit: float, stalled: bool = False):
         self.t_submit = t_submit
@@ -42,6 +42,10 @@ class _ReqStats:
         # first token was (potentially) blocked behind other requests'
         # prefill/decode — the decode-stall histogram population
         self.stalled = stalled
+        # last lifecycle phase observed for this request; watchtower's
+        # orphan detector attributes a dropped request to the phase it
+        # was last seen in
+        self.phase = "queue"
 
 
 class EngineMetrics:
@@ -50,6 +54,7 @@ class EngineMetrics:
                  registry=None, window: int = 65536):
         self.max_slots = max_slots
         self.now = time_fn
+        self._window = window
         self._reqs: Dict[int, _ReqStats] = {}      # in-flight only
         self._n_requests = 0
         self._n_tokens = 0
@@ -103,6 +108,7 @@ class EngineMetrics:
         is prefill+decode compute, so scheduler regressions stop
         hiding inside TTFT."""
         r = self._reqs[rid]
+        r.phase = "prefill"
         if r.t_prefill is None:
             r.t_prefill = self.now()
             w = r.t_prefill - r.t_submit
@@ -112,6 +118,7 @@ class EngineMetrics:
     def on_token(self, rid: int) -> None:
         t = self.now()
         r = self._reqs[rid]
+        r.phase = "decode"
         if r.t_first is None:
             r.t_first = t
             self._ttft.append(t - r.t_submit)
@@ -127,10 +134,22 @@ class EngineMetrics:
         self._m_tokens.inc()
         self._t_last = t
 
+    def on_promotion_start(self, rid: int) -> None:
+        """The request's prefill is about to install demoted KV pages
+        back onto the device. Phase-only bookkeeping: if the request
+        vanishes between here and :meth:`on_promotion`, watchtower
+        attributes the orphan to ``kv_promotion``."""
+        r = self._reqs.get(rid)
+        if r is not None:
+            r.phase = "kv_promotion"
+
     def on_promotion(self, rid: int, wait_s: float) -> None:
         """One request's KV tier promotion completed: record the wall
         time its prefill spent installing demoted pages back onto the
         device (the latency cost of a warm-but-demoted prefix)."""
+        r = self._reqs.get(rid)
+        if r is not None:
+            r.phase = "prefill"
         self._promo.append(wait_s)
         self._m_promo.observe(wait_s)
 
@@ -144,6 +163,31 @@ class EngineMetrics:
         live in the rolling windows / registry histograms) — without
         this, a long-running engine retains every request forever."""
         self._reqs.pop(rid, None)
+
+    # -- public read surface -------------------------------------------
+    def snapshot_windows(self) -> Dict[str, object]:
+        """Copies of the rolling percentile windows (newest-last) plus
+        the eviction bound. Each deque holds at most ``window``
+        samples — exact until traffic exceeds the bound, recent-biased
+        after — so consumers (watchtower, benchmarks) read them here
+        instead of reaching into private attrs."""
+        return {
+            "ttft": tuple(self._ttft),
+            "queue_wait": tuple(self._qwait),
+            "inter_token": tuple(self._gaps),
+            "promotion_wait": tuple(self._promo),
+            "window": self._window,
+        }
+
+    def inflight_phases(self) -> Dict[int, Dict[str, object]]:
+        """Per-request last-seen phase and age for every request this
+        ledger still considers in flight (``on_finished`` not yet
+        called). Watchtower diffs this against the engine's own
+        in-flight set to find orphaned requests."""
+        now = self.now()
+        return {rid: {"phase": r.phase,
+                      "age_s": now - r.t_submit}
+                for rid, r in self._reqs.items()}
 
     # -- aggregation ---------------------------------------------------
     def summary(self) -> Dict[str, float]:

@@ -63,10 +63,16 @@ __all__ = ["Replica", "ReplicaRouter", "death_kind",
 # free-text death reasons (which embed exception strings) normalized
 # to a bounded label set before they reach a metric label or span
 # attr — the registry's cardinality guard would otherwise trip on the
-# embedded message text. Order matters: the router-level
-# classification ("died mid-step: ...") wins over the wrapped
-# ReplicaDead message it embeds.
+# embedded message text. Order matters two ways: "unreachable" is
+# checked FIRST because retry exhaustion is a root cause, not a
+# symptom — a partition surfaces through whatever RPC happens to run
+# next ("died mid-step: ... unreachable after retries ..."), and the
+# network fault must win over the router-level wrapper so watchtower
+# can tell a partition from a worker death; among the rest, the
+# router-level classification wins over the wrapped ReplicaDead
+# message it embeds.
 _DEATH_KINDS = (
+    ("unreachable", "unreachable"),
     ("probe failures", "probe_failures"),
     ("step failures", "step_failures"),
     ("recover() failed", "recover_failed"),
@@ -74,7 +80,6 @@ _DEATH_KINDS = (
     ("died during drain", "died_during_drain"),
     ("process gone", "process_gone"),
     ("process exited", "process_exited"),
-    ("unreachable", "unreachable"),
 )
 
 

@@ -108,6 +108,18 @@ PAGED_KV_KEYS = {
 }
 
 
+# the WATCHTOWER line (bench_serving_engine --watchtower) is the
+# ISSUE-17 acceptance artifact: the same burst trace replayed clean
+# (must raise ZERO incidents) and with an injected stall (must raise
+# a ('stall', 'decode') incident and flip healthz red), detection
+# read-only (token-identical outputs)
+WATCHTOWER_KEYS = {
+    "requests", "steps", "stall_after_s", "burn_objectives",
+    "incidents_clean", "incidents_stalled", "incident_kinds_stalled",
+    "healthz_ok_clean", "healthz_ok_stalled", "token_identical",
+}
+
+
 # the KV_TIERING line (bench_serving_engine --kv-tiering) is the
 # ISSUE-16 acceptance artifact: shared-prompt waves under device-page
 # pressure across untiered / host-tier / persistent-store engines —
@@ -131,6 +143,7 @@ KV_TIERING_KEYS = {
     "bench_serving_engine.py --prefix-share",
     "bench_serving_engine.py --speculative",
     "bench_serving_engine.py --kv-tiering",
+    "bench_serving_engine.py --watchtower",
     "bench_serving_engine.py --chunked-prefill",
     "bench_serving_engine.py --frontdoor",
     "bench_serving_engine.py --tensor-parallel",
@@ -228,6 +241,21 @@ def test_benchmark_script_smoke(script, tmp_path):
         assert kt["hit_tokens_disk"] > 0, kt
         assert kt["token_identical"] is True, kt
         assert kt["decode_compiles"] == 1, kt
+    if script == "bench_serving_engine.py --watchtower":
+        wlines = [l for l in r.stdout.splitlines()
+                  if l.startswith("WATCHTOWER ")]
+        assert wlines, r.stdout
+        wt = json.loads(wlines[-1][len("WATCHTOWER "):])
+        assert WATCHTOWER_KEYS <= set(wt), sorted(wt)
+        # ISSUE-17 acceptance bars, deterministic on the burst trace:
+        # no false positives clean, the injected outage detected and
+        # attributed to the decode phase, detection read-only
+        assert wt["incidents_clean"] == 0, wt
+        assert wt["healthz_ok_clean"] is True, wt
+        assert wt["incidents_stalled"] >= 1, wt
+        assert ["stall", "decode"] in wt["incident_kinds_stalled"], wt
+        assert wt["healthz_ok_stalled"] is False, wt
+        assert wt["token_identical"] is True, wt
     if script == "bench_serving_engine.py --chunked-prefill":
         clines = [l for l in r.stdout.splitlines()
                   if l.startswith("CHUNKED_PREFILL ")]

@@ -277,6 +277,23 @@ def test_serving_matrix_actually_tiers():
     assert _serving_spec_tally["promotions"] >= 1, _serving_spec_tally
 
 
+# ISSUE-17 chaos certification, the false-positive half: the SAME 25
+# seeded serving workloads (identical rng schedules — every draw still
+# happens; only the fault arming is skipped) with a watchtower mounted
+# must raise ZERO incidents. Any page here is a detector that would
+# cry wolf on healthy production traffic.
+WATCHTOWER_CLEAN_SEEDS = list(range(25))
+
+
+@pytest.mark.parametrize("seed", WATCHTOWER_CLEAN_SEEDS)
+def test_watchtower_clean_band_raises_zero_incidents(seed):
+    res = chaos.run_serving_episode(seed, watchtower=True,
+                                    arm_faults=False)
+    assert res.ok, "\n".join(res.violations)
+    assert res.fired == {}                   # genuinely clean
+    assert res.stats["incidents"] == 0, res.stats["incident_kinds"]
+
+
 @pytest.mark.parametrize("seed", TRAINING_SEEDS)
 def test_training_episode_matrix(seed, tmp_path):
     res = chaos.run_training_episode(seed, str(tmp_path))
@@ -358,7 +375,8 @@ def test_frontdoor_matrix_actually_kills_replicas():
 
 _cluster_tally = {"episodes": 0, "requests": 0, "coop": 0,
                   "sigkill": 0, "partition": 0, "deaths": 0,
-                  "failover_requests": 0, "respawns": 0}
+                  "failover_requests": 0, "respawns": 0,
+                  "partition_incidents": 0, "death_incidents": 0}
 
 
 @pytest.mark.parametrize("seed", CLUSTER_SEEDS)
@@ -380,6 +398,18 @@ def test_cluster_episode_matrix(seed):
     _cluster_tally["failover_requests"] += \
         res.stats["failover_requests"]
     _cluster_tally["respawns"] += res.stats["respawns"]
+    # watchtower attribution law, per episode: an episode where no
+    # worker died must raise NO death-class incidents (the false-
+    # positive bar under full chaos load)
+    kinds = {tuple(k) for k in res.stats["incident_kinds"]}
+    death_kinds = {k for k in kinds
+                   if k[0] in ("partition", "worker_death")}
+    if not res.stats["replica_deaths"]:
+        assert not death_kinds, res.stats
+    _cluster_tally["partition_incidents"] += \
+        1 if ("partition", "dispatch") in kinds else 0
+    _cluster_tally["death_incidents"] += \
+        1 if ("worker_death", "failover") in kinds else 0
 
 
 def test_cluster_matrix_actually_kills_workers():
@@ -397,6 +427,20 @@ def test_cluster_matrix_actually_kills_workers():
     assert _cluster_tally["deaths"] >= 8, _cluster_tally
     assert _cluster_tally["failover_requests"] >= 6, _cluster_tally
     assert _cluster_tally["respawns"] >= 6, _cluster_tally
+
+
+def test_cluster_matrix_watchtower_attributes_kills():
+    """ISSUE-17 chaos certification, band-wide: the watchtower mounted
+    on every cluster episode must raise correctly-attributed incidents
+    for the REAL kills — network partitions as ``(partition,
+    dispatch)`` (the wire died past the retry budget; the worker may
+    be fine) and coop/SIGKILL deaths as ``(worker_death, failover)``.
+    The per-episode false-positive law (no deaths -> no death-class
+    incidents) is asserted inside the matrix itself."""
+    if _cluster_tally["episodes"] < len(CLUSTER_SEEDS):
+        pytest.skip("full cluster matrix did not run")
+    assert _cluster_tally["partition_incidents"] >= 3, _cluster_tally
+    assert _cluster_tally["death_incidents"] >= 3, _cluster_tally
 
 
 def test_matrix_spans_all_kinds_and_enough_episodes():
@@ -795,13 +839,24 @@ def test_pinned_seed_dropped_kv_promotion_goes_lost(monkeypatch):
 
     monkeypatch.setattr(ServingEngine, "_prefill",
                         swallow_promotion_failure)
-    red = chaos.run_serving_episode(PINNED_SEED_DROPPED_PROMOTION)
+    red = chaos.run_serving_episode(PINNED_SEED_DROPPED_PROMOTION,
+                                    watchtower=True)
     assert not red.ok
     assert any("LOST" in v for v in red.violations), red.violations
+    # ISSUE-17: the watchtower detects the same drop LIVE — the
+    # request the metrics plane still tracks but the engine forgot is
+    # an orphan, attributed to the phase it was last seen in
+    # (kv_promotion: on_promotion_start fired at staging, before the
+    # kill point)
+    assert ("request_orphaned", "kv_promotion") \
+        in red.stats["incident_kinds"], red.stats
     monkeypatch.setattr(ServingEngine, "_prefill", orig)
-    green = chaos.run_serving_episode(PINNED_SEED_DROPPED_PROMOTION)
+    green = chaos.run_serving_episode(PINNED_SEED_DROPPED_PROMOTION,
+                                      watchtower=True)
     assert green.ok, "\n".join(green.violations)
     assert green.fired.get("serving.kv.promote", 0) >= 1
+    # the real path unwinds and requeues: nothing orphaned, no page
+    assert green.stats["incidents"] == 0, green.stats
     assert green.stats["kv_tiered"]
     assert green.stats["demotions"] >= 1
     assert green.stats["promotions"] >= 1

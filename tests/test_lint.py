@@ -378,6 +378,81 @@ def test_fault_registry_clean_project():
 
 
 # ---------------------------------------------------------------------------
+# metric-family documentation sync (PTL501) on a synthetic project
+# ---------------------------------------------------------------------------
+
+def _metric_docs_root(tmp_path, rows):
+    """A project root whose docs/OBSERVABILITY.md family table lists
+    exactly ``rows``."""
+    (tmp_path / "docs").mkdir(exist_ok=True)
+    (tmp_path / "docs" / "OBSERVABILITY.md").write_text(
+        "# Observability\n\n| family | type |\n| --- | --- |\n"
+        + "".join(f"| `{r}` | counter |\n" for r in rows),
+        encoding="utf-8")
+    return str(tmp_path)
+
+
+_WT_UNIT_SRC = """
+    def build(reg):
+        reg.counter("ptpu_wt_documented_total", "d")
+        reg.counter("ptpu_wt_undocumented_total", "u")
+        reg.histogram("ptpu_wt_jit_compile_total", "wildcard-hit")
+"""
+
+
+def test_ptl501_both_directions(tmp_path):
+    root = _metric_docs_root(tmp_path, [
+        "ptpu_wt_documented_total",
+        "ptpu_wt_jit_*_total",               # pattern row
+        "ptpu_wt_stale_total",               # registered nowhere
+    ])
+    wt = make_unit(_src(_WT_UNIT_SRC),
+                   "pkg/observability/watchtower.py")
+    findings = lint_units([wt], project_root=root)
+    assert [(f.code, f.path, f.line) for f in findings] == [
+        ("PTL501", "docs/OBSERVABILITY.md", 7),
+        ("PTL501", "pkg/observability/watchtower.py", 3),
+    ]
+    assert "stale doc row" in findings[0].message
+    assert "undocumented telemetry" in findings[1].message
+    # the wildcard row covered ptpu_wt_jit_compile_total (code→doc)
+    # and raised no stale-row finding of its own (doc→code exempt)
+
+
+def test_ptl501_scoped_to_watchtower_plane(tmp_path):
+    # the code→doc direction only bites the files the watchtower
+    # reads and writes; the wider package documents its families in
+    # layer guides — but any registration still satisfies doc rows
+    root = _metric_docs_root(tmp_path, ["ptpu_elsewhere_total"])
+    other = make_unit(_src("""
+        def build(reg):
+            reg.counter("ptpu_elsewhere_total", "documented")
+            reg.counter("ptpu_elsewhere_quiet_total", "not a row")
+    """), "pkg/serving/engine.py")
+    assert lint_units([other], project_root=root) == []
+
+
+def test_ptl501_missing_doc_is_one_finding(tmp_path):
+    wt = make_unit(_src(_WT_UNIT_SRC),
+                   "pkg/observability/watchtower.py")
+    findings = lint_units([wt], project_root=str(tmp_path))
+    assert [(f.code, f.path) for f in findings] == [
+        ("PTL501", "docs/OBSERVABILITY.md")]
+    assert "missing" in findings[0].message
+
+
+def test_ptl501_clean_project(tmp_path):
+    root = _metric_docs_root(tmp_path, [
+        "ptpu_wt_documented_total",
+        "ptpu_wt_undocumented_total",
+        "ptpu_wt_jit_*_total",
+    ])
+    wt = make_unit(_src(_WT_UNIT_SRC),
+                   "pkg/observability/watchtower.py")
+    assert lint_units([wt], project_root=root) == []
+
+
+# ---------------------------------------------------------------------------
 # mechanics: inline suppression + baseline matching
 # ---------------------------------------------------------------------------
 

@@ -300,6 +300,116 @@ def test_merge_guards_refuse_silent_corruption():
         tel3.merged_snapshot()
 
 
+# -- rebaseline/forget × slo_attribution (worker respawn) --------------
+# ISSUE-17 satellite: until now this interaction was only exercised
+# indirectly through the chaos band. Directly: an engine reset swaps
+# in a fresh trace buffer in the SAME process, so drained_total
+# restarts from zero. Without rebaseline() the duplicate-blob guard
+# (keyed on (worker, pid)) mistakes the first post-reset scrape for a
+# replay and the request's recovery spans silently vanish from
+# slo_attribution(). A respawn with a NEW pid is a fresh continuity
+# key and needs no rebaseline — that path is the forget() test below.
+
+def test_engine_reset_without_rebaseline_drops_recovery_spans():
+    tel = ClusterTelemetry()
+    tel.ingest_host([
+        _span("router.dispatch", 0.0, 0.1, 1, rid=5, replica="w0"),
+        _span("router.failover.rehome", 1.0, 1.1, 1, rid=5,
+              from_replica="w0", to_replica="w0"),
+    ], proc="router")
+    tel.ingest_worker("w0", _payload(100, [
+        _span("serving.prefill", 0.2, 0.5, 100, rid=5, replay=False),
+        _span("serving.decode", 0.5, 0.9, 100, request_ids=[5]),
+    ], drained=2), host_now=0.0)
+    # fresh buffer, same pid: drained_total restarted at 2 <= 2, so
+    # the scrape is (wrongly, absent a rebaseline) read as a replay
+    reset = _payload(100, [
+        _span("serving.prefill", 1.2, 1.6, 100, rid=5, replay=True),
+        _span("serving.decode", 1.6, 2.0, 100, request_ids=[5]),
+    ], drained=2)
+    assert tel.ingest_worker("w0", reset, host_now=0.0) is False
+    (r5,) = tel.slo_attribution()
+    assert r5["spans"] == 4                  # recovery spans are GONE
+    assert abs(r5["failover_replay_s"] - 0.1) < 1e-9   # rehome only
+
+
+def test_engine_reset_with_rebaseline_attribution_is_complete():
+    tel = ClusterTelemetry()
+    tel.ingest_host([
+        _span("router.dispatch", 0.0, 0.1, 1, rid=5, replica="w0"),
+        _span("router.failover.rehome", 1.0, 1.1, 1, rid=5,
+              from_replica="w0", to_replica="w0"),
+    ], proc="router")
+    tel.ingest_worker("w0", _payload(100, [
+        _span("serving.prefill", 0.2, 0.5, 100, rid=5, replay=False),
+        _span("serving.decode", 0.5, 0.9, 100, request_ids=[5]),
+    ], drained=2), host_now=0.0)
+    tel.rebaseline("w0", 100)                # deliberate engine reset
+    assert tel.ingest_worker("w0", _payload(100, [
+        _span("serving.prefill", 1.2, 1.6, 100, rid=5, replay=True),
+        _span("serving.decode", 1.6, 2.0, 100, request_ids=[5]),
+    ], drained=2), host_now=0.0) is True
+    assert tel.scrape_losses() == []         # a reset is not a loss
+    (r5,) = tel.slo_attribution()
+    assert r5["spans"] == 6                  # both incarnations merge
+    assert abs(r5["prefill_s"] - 0.3) < 1e-9          # first, real
+    assert abs(r5["decode_s"] - 0.8) < 1e-9           # both decodes
+    # replay prefill (0.4) + rehome span (0.1) bill to failover
+    assert abs(r5["failover_replay_s"] - 0.5) < 1e-9
+    assert r5["failovers"] == 1
+
+
+def test_forget_truncated_attribution_is_flagged_not_phantom():
+    """A death-reap scrape that never arrived: forget() records the
+    loss so slo_attribution() consumers know the dead incarnation's
+    tail is missing, while the spans that DID arrive still attribute
+    normally — no phantom time, no crash."""
+    tel = ClusterTelemetry()
+    tel.ingest_host([
+        _span("router.dispatch", 0.0, 0.1, 1, rid=6, replica="w0"),
+        _span("router.failover.rehome", 1.0, 1.1, 1, rid=6,
+              from_replica="w0", to_replica="w1"),
+    ], proc="router")
+    tel.ingest_worker("w0", _payload(100, [
+        _span("serving.prefill", 0.2, 0.5, 100, rid=6, replay=False),
+    ], drained=1), host_now=0.0)
+    tel.forget("w0", 100, reason="death_scrape_failed")
+    tel.ingest_worker("w1", _payload(200, [
+        _span("serving.prefill", 1.2, 1.6, 200, rid=6, replay=True),
+        _span("serving.decode", 1.6, 2.0, 200, request_ids=[6]),
+    ], drained=2), host_now=0.0)
+    (loss,) = tel.scrape_losses()
+    assert loss == {"worker": "w0", "pid": 100,
+                    "kind": "death_scrape_failed"}
+    (r6,) = tel.slo_attribution()
+    assert sorted(r6["workers"]) == ["w0", "w1"]
+    assert abs(r6["prefill_s"] - 0.3) < 1e-9
+    assert abs(r6["decode_s"] - 0.4) < 1e-9
+    assert abs(r6["failover_replay_s"] - 0.5) < 1e-9
+    # the forgotten continuity really is gone: the same pid scraping
+    # again is a fresh baseline, not a replayed blob
+    assert tel.ingest_worker("w0", _payload(100, [
+        _span("serving.step", 3.0, 3.1, 100)], drained=1),
+        host_now=0.0) is True
+
+
+def test_merged_exposition_zero_observation_histogram():
+    """ISSUE-17 satellite mirror: a registered-but-silent histogram
+    family scraped from a worker still emits _bucket/_sum/_count in
+    the merged cluster exposition (same contract as
+    MetricRegistry.to_prometheus)."""
+    tel = ClusterTelemetry()
+    snap = {"ts": 0.0, "metrics": {"ptpu_tl_silent_seconds": {
+        "type": "histogram", "help": "never observed",
+        "label_names": ["phase"], "samples": []}}}
+    tel.ingest_worker("w0", _payload(100, [], 1, registry=snap),
+                      host_now=0.0)
+    text = tel.merged_prometheus()
+    assert 'ptpu_tl_silent_seconds_bucket{le="+Inf"} 0' in text
+    assert "ptpu_tl_silent_seconds_sum 0" in text
+    assert "ptpu_tl_silent_seconds_count 0" in text
+
+
 # -- merged chrome trace -----------------------------------------------
 
 def _failover_fixture():

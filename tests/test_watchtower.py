@@ -1,0 +1,666 @@
+"""Watchtower (paddle_tpu/observability/watchtower): SLO burn-rate
+engine, anomaly/stall/orphan/death/heartbeat detectors, incident
+dedup + readouts, the ptpu_doctor CLI, the front-door /healthz +
+/incidents binding, and the hot-path zero-cost contract.
+
+Everything runs on fake clocks and synthetic registries: the chaos
+band (tests/test_chaos.py) certifies the same detectors end-to-end
+against real injected kills/partitions/drops."""
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from paddle_tpu.observability import MetricRegistry
+from paddle_tpu.observability.registry import MetricError
+from paddle_tpu.observability.watchtower import (
+    DEFAULT_OBJECTIVES, EwmaDetector, Incident, RobustZDetector,
+    SLOObjective, Watchtower, _good_count, render_diagnosis)
+
+TTFT = "ptpu_serving_ttft_seconds"
+
+
+def _wt(reg, clock, objectives=(), **kw):
+    """A watchtower on a fake clock with every detector the test does
+    not exercise switched off."""
+    kw.setdefault("stall_after_s", None)
+    kw.setdefault("anomaly_streams", False)
+    kw.setdefault("eval_interval_s", 1.0)
+    return Watchtower(registry=reg, time_fn=lambda: clock["t"],
+                      objectives=objectives, **kw)
+
+
+def _burn_objective(**kw):
+    kw.setdefault("name", "ttft")
+    kw.setdefault("threshold_s", 0.5)
+    kw.setdefault("objective", 0.99)
+    kw.setdefault("family", TTFT)
+    kw.setdefault("phase", "queue")
+    return SLOObjective(**kw)
+
+
+# -- SLO objectives -----------------------------------------------------
+
+def test_objective_validates_source_and_target():
+    with pytest.raises(ValueError, match="histogram family"):
+        SLOObjective("x", threshold_s=1.0)
+    with pytest.raises(ValueError, match="target fraction"):
+        SLOObjective("x", threshold_s=1.0, family=TTFT, objective=1.0)
+    for o in DEFAULT_OBJECTIVES:
+        assert o.family is not None and o.phase is not None
+
+
+def test_good_count_snaps_threshold_up_to_bucket_bound():
+    h = {"buckets": {"0.1": 0, "0.5": 10, "1.0": 10, "+Inf": 10},
+         "count": 10}
+    # 0.3 is not a bucket edge: it snaps UP to 0.5, so observations
+    # of 0.4 count as good at histogram resolution
+    assert _good_count(h, 0.3) == 10
+    assert _good_count(h, 0.1) == 0
+    # past the last finite bound: everything under +Inf is good
+    assert _good_count(h, 5.0) == 10
+
+
+# -- burn-rate engine ---------------------------------------------------
+
+def test_burn_trips_on_budget_fire_and_not_on_good_traffic():
+    clock = {"t": 0.0}
+    reg = MetricRegistry()
+    h = reg.histogram(TTFT, "d", buckets=(0.1, 0.5, 1.0))
+    wt = _wt(reg, clock, objectives=(_burn_objective(),))
+    wt.flush()                               # prime baselines
+    for _ in range(50):
+        h.observe(0.05)                      # all good
+    clock["t"] = 5.0
+    assert wt.flush() == []
+    for _ in range(40):
+        h.observe(2.0)                       # budget fire
+    clock["t"] = 10.0
+    incs = wt.flush()
+    assert [i.kind for i in incs] == ["slo_burn"]
+    inc = incs[0]
+    assert inc.phase == "queue"
+    assert inc.detail["fast_burn"] >= 14.0
+    assert inc.detail["slow_burn"] >= 6.0
+    assert "burn" in inc.summary
+    # the counter carries the same (kind, phase)
+    c = reg.counter("ptpu_incidents_total",
+                    labels=("kind", "phase"))
+    assert c.labels(kind="slo_burn", phase="queue").value == 1
+
+
+def test_burn_requires_min_events_and_both_windows():
+    clock = {"t": 0.0}
+    reg = MetricRegistry()
+    h = reg.histogram(TTFT, "d", buckets=(0.1, 0.5, 1.0))
+    wt = _wt(reg, clock,
+             objectives=(_burn_objective(min_events=5),))
+    wt.flush()
+    for _ in range(3):                       # 100% bad but < floor
+        h.observe(2.0)
+    clock["t"] = 5.0
+    assert wt.flush() == []                  # single stragglers never page
+    # fast window clean, slow window dirty -> no page either: age the
+    # bad events past the fast window, then add good-only traffic
+    for _ in range(10):
+        h.observe(2.0)
+    clock["t"] = 10.0
+    wt.flush()
+    for _ in range(200):
+        h.observe(0.05)
+    clock["t"] = 60.0                        # bad burst left the 30s window
+    assert wt.flush() == []
+
+
+def test_burn_primes_on_preexisting_history():
+    clock = {"t": 0.0}
+    reg = MetricRegistry()
+    h = reg.histogram(TTFT, "d", buckets=(0.1, 0.5, 1.0))
+    for _ in range(100):
+        h.observe(2.0)                       # ancient budget fire
+    wt = _wt(reg, clock, objectives=(_burn_objective(),))
+    assert wt.flush() == []                  # history is not an incident
+    clock["t"] = 5.0
+    assert wt.flush() == []                  # no new events, no page
+
+
+def test_burn_from_attribution_phase_with_breakdown():
+    class FakeTel:
+        def __init__(self):
+            self.records = []
+
+        def slo_attribution(self):
+            return list(self.records)
+
+        def aligned_spans(self):
+            return []
+
+    clock = {"t": 0.0}
+    reg = MetricRegistry()
+    tel = FakeTel()
+    obj = SLOObjective("queue_wait", threshold_s=1.0, objective=0.99,
+                       phase="queue", min_events=3)
+    wt = _wt(reg, clock, objectives=(obj,), telemetry=tel)
+    wt.flush()
+    tel.records = [{"request_id": i, "queue_s": 8.0, "decode_s": 1.0}
+                   for i in range(8)]
+    clock["t"] = 5.0
+    incs = wt.flush()
+    assert [i.kind for i in incs] == ["slo_burn"]
+    inc = incs[0]
+    assert inc.phase == "queue"              # dominant by share
+    assert inc.detail["breakdown"]["queue"] > 0.8
+    assert inc.request_ids                   # offending rids attached
+    # the renderer turns the breakdown into the diagnosis line
+    txt = render_diagnosis(wt.to_json())
+    assert "queue-wait" in txt and "admission-bound" in txt
+
+
+# -- anomaly detectors --------------------------------------------------
+
+def test_ewma_detector_constant_then_spike():
+    d = EwmaDetector(alpha=0.3, k=6.0, warmup=8)
+    assert not any(d.update(1.0) for _ in range(30))
+    assert d.update(500.0)                   # the spike trips
+    d2 = EwmaDetector(warmup=8)
+    # warmup samples never trip, however wild
+    assert not any(d2.update(x) for x in (1, 1000, 1, 1000, 2, 999))
+
+
+def test_robust_z_detector_is_outlier_immune():
+    d = RobustZDetector(window=64, z=8.0, min_samples=8)
+    for _ in range(20):
+        assert not d.update(1.0)
+    assert d.update(500.0)                   # trips ...
+    for _ in range(5):
+        d.update(1.0)
+    # ... but the median/MAD barely moved: the stream is still judged
+    # against the bulk, not the outlier
+    assert d.update(500.0)
+
+
+def test_anomaly_stream_requires_both_detectors_and_raises_incident():
+    clock = {"t": 0.0}
+    reg = MetricRegistry()
+    depth = reg.gauge("ptpu_serving_queue_depth", "d")
+    wt = _wt(reg, clock, anomaly_streams=True)
+    depth.set(3.0)
+    for i in range(30):                      # learn the baseline
+        clock["t"] = float(i)
+        assert wt.flush() == []
+    depth.set(5000.0)
+    clock["t"] = 40.0
+    incs = wt.flush()
+    assert [(i.kind, i.phase) for i in incs] == [("anomaly", "queue")]
+    assert incs[0].detail["stream"] == "queue_depth"
+
+
+# -- stall / orphan / death / heartbeat ---------------------------------
+
+def _stall_registry(steps=5, depth=4.0, active=2.0):
+    reg = MetricRegistry()
+    h = reg.histogram("ptpu_serving_step_seconds", "d")
+    for _ in range(steps):
+        h.observe(0.01)
+    reg.gauge("ptpu_serving_queue_depth", "d").set(depth)
+    reg.gauge("ptpu_serving_active_slots", "d").set(active)
+    return reg, h
+
+
+def test_stall_detector_pages_after_budget_and_resets_on_progress():
+    clock = {"t": 0.0}
+    reg, h = _stall_registry()
+    wt = _wt(reg, clock, stall_after_s=10.0)
+    wt.flush()                               # prime
+    clock["t"] = 5.0
+    assert wt.flush() == []                  # stalled 5s < budget
+    clock["t"] = 20.0
+    incs = wt.flush()
+    assert [(i.kind, i.phase) for i in incs] == [("stall", "decode")]
+    assert "no step" in incs[0].summary
+    # progress resets the stall clock: a fresh watchtower that sees
+    # the counter advance between evals never pages
+    wt2 = _wt(reg, clock, stall_after_s=10.0)
+    wt2.flush()
+    for t in (25.0, 40.0, 60.0):
+        h.observe(0.01)
+        clock["t"] = t
+        assert wt2.flush() == []
+
+
+def test_stall_detector_ignores_idle_engine():
+    clock = {"t": 0.0}
+    reg, _ = _stall_registry(depth=0.0, active=0.0)
+    wt = _wt(reg, clock, stall_after_s=10.0)
+    wt.flush()
+    clock["t"] = 1000.0
+    assert wt.flush() == []                  # idle, not stalled
+
+
+def test_orphan_detector_needs_two_consecutive_sightings():
+    class FakeMetrics:
+        def __init__(self):
+            self.inflight = {}
+
+        def inflight_phases(self):
+            return dict(self.inflight)
+
+    class FakeEngine:
+        metrics = None
+        recorder = None
+
+        def inflight_rids(self):
+            return set()
+
+    clock = {"t": 0.0}
+    reg = MetricRegistry()
+    m = FakeMetrics()
+    eng = FakeEngine()
+    eng.metrics = m
+    wt = _wt(reg, clock).attach_engine(eng)
+    m.inflight = {7: {"phase": "decode", "age_s": 3.0}}
+    assert wt.flush() == []                  # first sighting: unconfirmed
+    clock["t"] = 1.0
+    incs = wt.flush()                        # second: confirmed
+    assert [(i.kind, i.phase) for i in incs] \
+        == [("request_orphaned", "decode")]
+    assert incs[0].request_ids == (7,)
+    clock["t"] = 2.0
+    assert wt.flush() == []                  # reported once, not respammed
+    # a transient (gone by the second eval) never pages
+    m.inflight = {9: {"phase": "queue", "age_s": 0.1}}
+    clock["t"] = 3.0
+    wt.flush()
+    m.inflight = {}
+    clock["t"] = 4.0
+    assert wt.flush() == []
+
+
+def test_death_classification_partition_vs_worker_death():
+    clock = {"t": 0.0}
+    reg = MetricRegistry()
+    deaths = reg.counter("ptpu_router_replica_deaths_total", "d",
+                         labels=("replica", "reason"))
+    deaths.labels(replica="0", reason="coop").inc()   # ancient history
+    wt = _wt(reg, clock)
+    assert wt.flush() == []                  # primed, not paged
+    deaths.labels(replica="1", reason="unreachable").inc()
+    clock["t"] = 1.0
+    incs = wt.flush()
+    assert [(i.kind, i.phase) for i in incs] \
+        == [("partition", "dispatch")]
+    deaths.labels(replica="0", reason="died mid-step").inc()
+    clock["t"] = 2.0
+    incs = wt.flush()
+    assert [(i.kind, i.phase) for i in incs] \
+        == [("worker_death", "failover")]
+    assert incs[0].detail["reason"] == "died mid-step"
+
+
+def test_heartbeat_detector_pages_on_silent_worker():
+    class FakeTel:
+        def worker_snapshots(self):
+            return {"w0": {"ts": 0.0}, "w1": {"ts": 95.0}}
+
+        def slo_attribution(self):
+            return []
+
+        def aligned_spans(self):
+            return []
+
+    clock = {"t": 10.0}
+    reg = MetricRegistry()
+    wt = _wt(reg, clock, telemetry=FakeTel(),
+             heartbeat_max_age_s=30.0)
+    wt.flush()                               # prime
+    clock["t"] = 100.0
+    incs = wt.flush()
+    assert [(i.kind, i.phase) for i in incs] == [("stall", "failover")]
+    assert "w0" in incs[0].summary and "w1" not in incs[0].summary
+
+
+# -- incident plumbing --------------------------------------------------
+
+def test_incident_dedup_fingerprint_and_eviction():
+    clock = {"t": 0.0}
+    reg = MetricRegistry()
+    h = reg.histogram(TTFT, "d", buckets=(0.1, 0.5, 1.0))
+    wt = _wt(reg, clock, objectives=(_burn_objective(),),
+             dedup_window_s=100.0, max_incidents=4)
+    wt.flush()
+    for t in (5.0, 10.0, 15.0):
+        for _ in range(40):
+            h.observe(2.0)
+        clock["t"] = t
+        wt.flush()
+    incs = wt.incidents()
+    assert len(incs) == 1                    # same fingerprint, deduped
+    assert incs[0].count == 3
+    assert incs[0].last_ts == 15.0 and incs[0].ts == 5.0
+    c = reg.counter("ptpu_incidents_total",
+                    labels=("kind", "phase"))
+    assert c.labels(kind="slo_burn", phase="queue").value == 1
+    # distinct fingerprints evict oldest past max_incidents
+    for i in range(6):
+        wt._raise([], kind="stall", phase="decode", key=f"k{i}",
+                  now=clock["t"], summary="s", detail={})
+    assert len(wt.incidents()) == 4
+    assert json.dumps(wt.to_json())          # JSON-clean end to end
+
+
+def test_incident_to_json_round_trip():
+    inc = Incident(kind="stall", phase="decode", summary="s", ts=1.0,
+                   fingerprint="ab", detail={"x": 1},
+                   request_ids=(3,), count=2, last_ts=4.0)
+    d = json.loads(json.dumps(inc.to_json()))
+    assert d["kind"] == "stall" and d["request_ids"] == [3]
+    assert d["count"] == 2 and d["last_ts"] == 4.0
+
+
+def test_healthz_and_diagnose_readouts():
+    clock = {"t": 0.0}
+    reg = MetricRegistry()
+    wt = _wt(reg, clock)
+    wt.flush()
+    hz = wt.healthz()
+    assert hz["ok"] is True and hz["incidents"] == 0
+    assert wt.diagnose() == "watchtower: healthy — no incidents"
+    wt._raise([], kind="stall", phase="decode", key="k",
+              now=0.0, summary="engine stalled", detail={})
+    hz = wt.healthz()
+    assert hz["ok"] is False and hz["incidents"] == 1
+    txt = wt.diagnose()
+    assert "1 incident(s)" in txt and "decode-bound" in txt
+
+
+# -- hot-path contract --------------------------------------------------
+
+def test_hot_path_is_one_counter_and_poll_is_one_clock_read():
+    """The zero-cost contract, micro-asserted the same way
+    ``maybe_fail``'s disarmed path is: ``observe_step`` never touches
+    the lock, the clock, or the registry; ``poll`` between window
+    boundaries is exactly one clock read and no evaluation."""
+
+    class _CountingLock:
+        def __init__(self, inner):
+            self.inner = inner
+            self.acquisitions = 0
+
+        def __enter__(self):
+            self.acquisitions += 1
+            return self.inner.__enter__()
+
+        def __exit__(self, *exc):
+            return self.inner.__exit__(*exc)
+
+    clock = {"t": 0.0, "reads": 0}
+
+    def now():
+        clock["reads"] += 1
+        return clock["t"]
+
+    reg = MetricRegistry()
+    wt = Watchtower(registry=reg, time_fn=now, objectives=(),
+                    eval_interval_s=100.0, stall_after_s=None,
+                    anomaly_streams=False)
+    evals = []
+    orig_eval = wt._evaluate
+    wt._evaluate = lambda t: (evals.append(t), orig_eval(t))[1]
+    wt.flush()                               # one boundary evaluation
+    assert len(evals) == 1
+    probe = _CountingLock(wt._lock)
+    wt._lock = probe
+    clock["reads"] = 0
+
+    for _ in range(1000):
+        wt.observe_step()
+    assert clock["reads"] == 0               # no clock on the step path
+    assert probe.acquisitions == 0
+    assert wt._steps == 1000
+
+    for _ in range(1000):
+        assert wt.poll() == []
+    assert clock["reads"] == 1000            # exactly one read per poll
+    assert probe.acquisitions == 0           # never crossed the boundary
+    assert len(evals) == 1
+
+    clock["t"] = 200.0                       # past the window boundary
+    wt.poll()
+    assert probe.acquisitions == 1 and len(evals) == 2
+
+
+# -- registry satellites ------------------------------------------------
+
+def test_histogram_quantile_linear_interpolation():
+    reg = MetricRegistry()
+    h = reg.histogram("ptpu_test_q_seconds", "d",
+                      buckets=(1.0, 2.0, 4.0))
+    for _ in range(10):
+        h.observe(1.5)                       # all in (1, 2]
+    # target rank interpolates linearly inside the owning bucket:
+    # q=0.5 -> 5th of 10 obs in (1, 2] -> 1 + 1 * 5/10
+    assert h.quantile(0.5) == pytest.approx(1.5)
+    assert h.quantile(1.0) == pytest.approx(2.0)
+    assert h.percentile(50.0) == h.quantile(0.5)
+    for bad in (-0.1, 1.5, 100.0):
+        with pytest.raises(MetricError, match=r"q in \[0, 1\]"):
+            h.quantile(bad)
+    assert reg.histogram("ptpu_test_q2_seconds", "d").quantile(0.9) \
+        == 0.0                               # empty histogram
+
+
+def test_zero_observation_family_still_exposes_count_and_sum():
+    reg = MetricRegistry()
+    reg.histogram("ptpu_test_zero_seconds", "d", labels=("phase",))
+    prom = reg.to_prometheus()
+    assert '# TYPE ptpu_test_zero_seconds histogram' in prom
+    assert 'ptpu_test_zero_seconds_bucket{le="+Inf"} 0' in prom
+    assert "ptpu_test_zero_seconds_sum 0" in prom
+    assert "ptpu_test_zero_seconds_count 0" in prom
+
+
+# -- ptpu_doctor CLI ----------------------------------------------------
+
+def _snapshot_file(tmp_path, wt):
+    p = tmp_path / "snap.json"
+    p.write_text(json.dumps(wt.to_json()))
+    return str(p)
+
+
+def test_ptpu_doctor_file_modes_and_exit_codes(tmp_path, capsys):
+    from tools.ptpu_doctor import main
+
+    clock = {"t": 0.0}
+    wt = _wt(MetricRegistry(), clock)
+    wt.flush()
+    healthy = _snapshot_file(tmp_path, wt)
+    assert main([healthy]) == 0
+    assert "healthy" in capsys.readouterr().out
+
+    wt._raise([], kind="stall", phase="decode", key="k", now=0.0,
+              summary="engine stalled", detail={})
+    sick = _snapshot_file(tmp_path, wt)
+    assert main([sick]) == 1
+    assert "decode-bound" in capsys.readouterr().out
+
+    assert main([sick, "--json"]) == 1
+    parsed = json.loads(capsys.readouterr().out)
+    assert parsed["incidents"][0]["kind"] == "stall"
+
+    assert main([str(tmp_path / "missing.json")]) == 2
+    assert "cannot load" in capsys.readouterr().err
+    assert main([]) == 2                     # usage
+
+
+# -- front-door binding -------------------------------------------------
+
+def test_frontdoor_healthz_and_incidents_endpoints():
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama_tiny_config)
+    from paddle_tpu.serving import FrontDoor, FrontDoorHTTPServer
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny_config(
+        num_hidden_layers=1, hidden_size=32, intermediate_size=64,
+        num_attention_heads=2, max_position_embeddings=64))
+    model.eval()
+    from paddle_tpu.serving import ServingEngine
+    reg = MetricRegistry()
+    eng = ServingEngine(model, max_slots=2, max_len=64, min_bucket=8,
+                        registry=reg)
+    wt = Watchtower(registry=reg, objectives=(),
+                    eval_interval_s=1e9, stall_after_s=None,
+                    anomaly_streams=False).attach_engine(eng)
+    front = FrontDoor(eng, registry=reg, watchtower=wt)
+    srv = FrontDoorHTTPServer(front, port=0).start()
+    try:
+        h = front.submit(np.arange(1, 6), 2)
+        front.run_until_idle()
+        assert h.req.finished
+
+        with urllib.request.urlopen(srv.url + "/healthz",
+                                    timeout=10) as resp:
+            hz = json.loads(resp.read())
+        assert hz["ok"] is True
+        assert hz["watchtower"]["ok"] is True
+
+        with urllib.request.urlopen(srv.url + "/incidents",
+                                    timeout=10) as resp:
+            snap = json.loads(resp.read())
+        assert snap["health"]["ok"] is True
+        assert snap["incidents"] == []
+
+        # an incident flips /healthz red: HTTP 503 with the verdict
+        # in the body (load balancers read the status, humans the
+        # payload)
+        wt._raise([], kind="stall", phase="decode", key="k", now=0.0,
+                  summary="engine stalled", detail={})
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(srv.url + "/healthz", timeout=10)
+        assert exc.value.code == 503
+        hz = json.loads(exc.value.read())
+        assert hz["ok"] is False
+        assert hz["watchtower"]["incidents"] == 1
+        with urllib.request.urlopen(srv.url + "/incidents",
+                                    timeout=10) as resp:
+            snap = json.loads(resp.read())
+        assert snap["incidents"][0]["kind"] == "stall"
+    finally:
+        srv.shutdown()
+
+
+def test_frontdoor_incidents_404_without_watchtower():
+    import paddle_tpu as paddle
+    from paddle_tpu.models.llama import (LlamaForCausalLM,
+                                         llama_tiny_config)
+    from paddle_tpu.serving import (FrontDoor, FrontDoorHTTPServer,
+                                    ServingEngine)
+
+    paddle.seed(0)
+    model = LlamaForCausalLM(llama_tiny_config(
+        num_hidden_layers=1, hidden_size=32, intermediate_size=64,
+        num_attention_heads=2, max_position_embeddings=64))
+    model.eval()
+    eng = ServingEngine(model, max_slots=2, max_len=64, min_bucket=8,
+                        registry=MetricRegistry())
+    front = FrontDoor(eng, registry=MetricRegistry())
+    srv = FrontDoorHTTPServer(front, port=0).start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(srv.url + "/incidents", timeout=10)
+        assert exc.value.code == 404
+    finally:
+        srv.shutdown()
+
+
+# -- engine metrics satellites ------------------------------------------
+
+def test_snapshot_windows_pins_eviction_bound():
+    """The rolling percentile pools are bounded at ``window`` and
+    recent-biased past it — the regression this pins: unbounded
+    per-request sample lists on long-running engines."""
+    from paddle_tpu.serving.metrics import EngineMetrics
+
+    clock = {"t": 0.0}
+    m = EngineMetrics(4, time_fn=lambda: clock["t"],
+                      registry=MetricRegistry(), window=8)
+    for rid in range(20):
+        clock["t"] = float(rid)
+        m.on_submit(rid)
+        clock["t"] += 0.1 * rid              # distinct queue waits
+        m.on_first_prefill(rid)
+        m.on_token(rid)
+        m.on_finished(rid)
+    snap = m.snapshot_windows()
+    assert snap["window"] == 8
+    assert set(snap) == {"ttft", "queue_wait", "inter_token",
+                         "promotion_wait", "window"}
+    assert len(snap["ttft"]) == 8            # evicted down to the bound
+    assert len(snap["queue_wait"]) == 8
+    # recent-biased: the survivors are the LAST 8 waits (1.2 .. 1.9)
+    assert snap["queue_wait"] == tuple(
+        pytest.approx(0.1 * rid) for rid in range(12, 20))
+    assert snap["promotion_wait"] == ()
+    # the snapshot is a copy, not a live view
+    m.on_promotion(99, 0.5)
+    assert snap["promotion_wait"] == ()
+
+
+def test_inflight_phases_tracks_lifecycle_and_eviction():
+    from paddle_tpu.serving.metrics import EngineMetrics
+
+    clock = {"t": 0.0}
+    m = EngineMetrics(4, time_fn=lambda: clock["t"],
+                      registry=MetricRegistry())
+    m.on_submit(1)
+    assert m.inflight_phases()[1]["phase"] == "queue"
+    m.on_first_prefill(1)
+    assert m.inflight_phases()[1]["phase"] == "prefill"
+    m.on_promotion_start(1)
+    assert m.inflight_phases()[1]["phase"] == "kv_promotion"
+    m.on_promotion(1, 0.01)
+    assert m.inflight_phases()[1]["phase"] == "prefill"
+    m.on_token(1)
+    clock["t"] = 2.5
+    info = m.inflight_phases()[1]
+    assert info["phase"] == "decode"
+    assert info["age_s"] == pytest.approx(2.5)
+    m.on_finished(1)
+    assert m.inflight_phases() == {}
+
+
+def test_watchtower_poll_is_thread_safe_under_flush_races():
+    """poll()/flush() from multiple threads must not corrupt the
+    incident map (the front-door pump and an operator's /incidents
+    scrape race exactly like this)."""
+    clock = {"t": 0.0}
+    reg = MetricRegistry()
+    h = reg.histogram(TTFT, "d", buckets=(0.1, 0.5, 1.0))
+    wt = _wt(reg, clock, objectives=(_burn_objective(),),
+             eval_interval_s=0.0)
+    wt.flush()
+    for _ in range(40):
+        h.observe(2.0)
+    errs = []
+
+    def spin():
+        try:
+            for i in range(50):
+                clock["t"] += 1.0
+                wt.flush()
+                wt.incidents()
+                wt.healthz()
+        except Exception as e:               # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=spin) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    assert len(wt.incidents()) == 1          # deduped despite the race

@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""ptpu_doctor — human diagnosis from a watchtower snapshot.
+
+Reads the ``/incidents`` JSON either from a live front door or from a
+dumped snapshot file and renders the same diagnosis string
+``Watchtower.diagnose()`` produces, e.g.::
+
+    watchtower: 1 incident(s)
+      burn[ttft_p99]: fast 14.20x, slow 6.40x of error budget
+      slo_burn: 78% queue-wait, 12% prefill-wait, decode healthy — admission-bound
+        offending rids: 17, 21, 24
+
+Usage::
+
+    python -m tools.ptpu_doctor http://localhost:8700        # live
+    python -m tools.ptpu_doctor http://host:port/incidents   # explicit
+    python -m tools.ptpu_doctor /path/to/snapshot.json       # dump
+    ... --json                                               # raw JSON
+
+Stdlib-only on purpose: this runs on operator laptops and inside
+containers that do not have the framework's dependency set — only the
+rendering helper is imported, and that module is dependency-free.
+
+Exit status: 0 healthy, 1 incidents present, 2 usage/fetch error.
+"""
+from __future__ import annotations
+
+import json
+import sys
+
+
+def _load(source: str) -> dict:
+    """Fetch the watchtower JSON from a URL or a file path."""
+    if source.startswith(("http://", "https://")):
+        from urllib.request import urlopen
+        url = source
+        if not url.rstrip("/").endswith("/incidents"):
+            url = url.rstrip("/") + "/incidents"
+        with urlopen(url, timeout=10) as resp:
+            return json.loads(resp.read().decode())
+    with open(source, "r") as f:
+        return json.load(f)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = "--json" in argv
+    argv = [a for a in argv if a != "--json"]
+    if len(argv) != 1 or argv[0] in ("-h", "--help"):
+        print(__doc__, file=sys.stderr)
+        return 2
+    try:
+        snap = _load(argv[0])
+    except Exception as e:
+        print(f"ptpu_doctor: cannot load {argv[0]!r}: "
+              f"{type(e).__name__}: {e}", file=sys.stderr)
+        return 2
+    if as_json:
+        print(json.dumps(snap, indent=2))
+    else:
+        from paddle_tpu.observability.watchtower import render_diagnosis
+        print(render_diagnosis(snap))
+    return 1 if snap.get("incidents") else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,3 +3,4 @@ from . import trace_hygiene    # noqa: F401
 from . import lock_discipline  # noqa: F401
 from . import resource_pairing  # noqa: F401
 from . import fault_registry   # noqa: F401
+from . import metric_docs      # noqa: F401

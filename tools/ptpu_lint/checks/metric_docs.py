@@ -1,0 +1,125 @@
+"""Metric-family documentation sync (PTL501).
+
+``docs/OBSERVABILITY.md`` carries the built-in family table — the
+contract dashboards and the watchtower's objectives are written
+against. This pass proves the table and the code agree in both
+directions for the observability-plane sources:
+
+- PTL501 (code → doc): a metric family registered in
+  ``observability/watchtower.py`` or ``serving/metrics.py`` (the
+  files the watchtower reads and writes) that the family table does
+  not list — an undocumented family nobody can declare an SLO
+  objective or alert over.
+- PTL501 (doc → code): a non-wildcard family named in the table that
+  no linted file registers — a stale doc row describing telemetry
+  that no longer exists.
+
+Wildcard rows (``ptpu_jit_*_total``) document a family *pattern*;
+they satisfy the code→doc direction for any matching name and are
+exempt from the doc→code direction.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Tuple
+
+from ..core import FileUnit, Finding, project_check
+
+DOC_PATH = "docs/OBSERVABILITY.md"
+# the code→doc direction is scoped to the watchtower's own plane; the
+# wider package documents families in layer guides instead
+WATCHED_SUFFIXES = ("observability/watchtower.py",
+                    "serving/metrics.py")
+FACTORY_NAMES = {"counter", "gauge", "histogram"}
+_FAMILY_TOKEN = re.compile(r"`(ptpu_[a-z0-9_*]+)(?:\{[^}]*\})?`")
+
+
+def _registered_families(units: List[FileUnit]
+                         ) -> Dict[str, List[Tuple[str, int]]]:
+    """family name -> [(path, line)] for every
+    ``<registry>.counter/gauge/histogram("ptpu_...")`` literal."""
+    out: Dict[str, List[Tuple[str, int]]] = {}
+    for u in units:
+        for node in ast.walk(u.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute)
+                    and fn.attr in FACTORY_NAMES):
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) \
+                    and isinstance(arg.value, str) \
+                    and arg.value.startswith("ptpu_"):
+                out.setdefault(arg.value, []).append(
+                    (u.path, node.lineno))
+    return out
+
+
+def _doc_families(doc_text: str) -> Dict[str, int]:
+    """family (or ``*`` pattern) -> first table line naming it. Only
+    table rows count — prose and code examples are free to mention
+    family names without declaring them."""
+    out: Dict[str, int] = {}
+    for lineno, line in enumerate(doc_text.splitlines(), 1):
+        if not line.lstrip().startswith("|"):
+            continue
+        for m in _FAMILY_TOKEN.finditer(line):
+            out.setdefault(m.group(1), lineno)
+    return out
+
+
+def _matches(name: str, doc_names: Dict[str, int]) -> bool:
+    if name in doc_names:
+        return True
+    for pat in doc_names:
+        if "*" in pat and re.fullmatch(
+                pat.replace("*", ".*"), name):
+            return True
+    return False
+
+
+@project_check("metric-docs")
+def check_metric_docs(units: List[FileUnit],
+                      project_root: Optional[str]) -> List[Finding]:
+    if project_root is None:
+        return []
+    doc = os.path.join(project_root, DOC_PATH)
+    try:
+        with open(doc, encoding="utf-8") as fh:
+            doc_text = fh.read()
+    except OSError:
+        return [Finding(
+            "PTL501",
+            f"{DOC_PATH} is missing — the metric family table is "
+            f"the contract objectives and alerts are written "
+            f"against", DOC_PATH, 1)]
+    doc_names = _doc_families(doc_text)
+    registered = _registered_families(units)
+    findings: List[Finding] = []
+
+    # code → doc, scoped to the watchtower plane
+    for name in sorted(registered):
+        if _matches(name, doc_names):
+            continue
+        for path, line in registered[name]:
+            if path.endswith(WATCHED_SUFFIXES):
+                findings.append(Finding(
+                    "PTL501",
+                    f"metric family {name!r} is registered here but "
+                    f"missing from the {DOC_PATH} family table — "
+                    f"undocumented telemetry", path, line))
+
+    # doc → code, every non-wildcard row
+    for name, lineno in sorted(doc_names.items()):
+        if "*" in name:
+            continue
+        if name not in registered:
+            findings.append(Finding(
+                "PTL501",
+                f"{DOC_PATH} family table names {name!r} but no "
+                f"linted file registers it — stale doc row",
+                DOC_PATH, lineno))
+    return findings
