@@ -45,6 +45,10 @@ SCRIPTS = ["bench_resnet50.py", "bench_bert_dp.py", "bench_gpt_hybrid.py",
            # mid-run, supervisor respawn, exactly-once ledger;
            # self-skips without the native TCPStore extension)
            "bench_serving_engine.py --cluster",
+           # cross-host serving fabric: authenticated RPC + shared
+           # weight store + wire KV handoff through a SIGKILL and a
+           # partition (self-skips without the TCPStore extension)
+           "bench_serving_engine.py --multihost",
            # budget via PTPU_CHAOS_EPISODES / PTPU_CHAOS_SECONDS
            "chaos_soak.py"]
 

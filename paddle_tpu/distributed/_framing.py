@@ -13,17 +13,38 @@ Whatever exception is armed, callers observe a typed
 after one the socket's stream position is undefined (``recv_msg`` may
 have consumed a header whose body is still in flight), so the only
 legal reaction is to close the socket. Never a partial-frame hang.
+
+Authentication (the cross-host trust boundary): :class:`FrameAuth`
+adds a shared-secret HMAC handshake per connection and a per-frame
+HMAC-SHA256 with strictly-sequential per-direction counters, so a
+tampered, replayed, dropped-and-reordered, or unauthenticated frame is
+rejected with a typed :class:`AuthError` (a ConnectionError subclass —
+every existing close-socket/retry path already does the right thing)
+and counted (:func:`auth_failures`). ``seal``/``open_sealed`` apply
+the same secret to TCPStore rendezvous values (the store daemon treats
+values as opaque bytes), and :func:`restricted_loads` unpickles the
+worker spec under a data-only allowlist so a tampered spec cannot
+execute code. The ``cluster.rpc.auth`` fault point fires inside the
+verification paths.
 """
 from __future__ import annotations
 
+import hashlib
+import hmac as _hmac_mod
+import io
+import os
+import pickle
 import socket
 import struct
-from typing import Optional
+from typing import Callable, List, Optional
 
 from ..resilience.faults import maybe_fail  # stdlib-only at import
 
 __all__ = ["send_msg", "recv_msg", "recv_exact", "nodelay",
-           "MAX_FRAME_BYTES"]
+           "MAX_FRAME_BYTES", "AuthError", "FrameAuth",
+           "client_handshake", "server_handshake", "seal",
+           "open_sealed", "restricted_loads", "auth_failures",
+           "register_auth_failure_hook"]
 
 # Upper bound on a single frame: a corrupt or hostile header must not
 # drive recv_exact into a near-2^64 allocation loop. 4 GiB covers the
@@ -52,15 +73,228 @@ def _fault(point: str, **ctx) -> None:
         raise ConnectionError(f"injected at {point}: {e}") from e
 
 
-def send_msg(sock: socket.socket, data: bytes) -> None:
+# ---------------------------------------------------------------------------
+# Authenticated framing
+# ---------------------------------------------------------------------------
+
+class AuthError(ConnectionError):
+    """Typed auth rejection: failed handshake, missing/garbage frame
+    MAC, replayed or reordered frame, tampered rendezvous value, or a
+    worker spec that tries to smuggle code. Subclasses ConnectionError
+    on purpose — after a rejection the stream position is as undefined
+    as after any wire fault, so the close-socket/retry machinery must
+    treat it identically (blips below the retry budget are absorbed by
+    a reconnect + fresh handshake; a persistent mismatch exhausts the
+    budget into the ordinary typed failover)."""
+
+
+_MAGIC = b"ptpu-auth1"          # hello prefix: absence = unauth peer
+_NONCE_LEN = 16
+_MAC_LEN = 32                   # HMAC-SHA256
+
+_auth_failures = 0
+_auth_failure_hooks: List[Callable[[str], None]] = []
+
+
+def auth_failures() -> int:
+    """Process-wide count of typed auth rejections (mirrored into the
+    ``ptpu_cluster_auth_failures_total`` registry counter by the
+    cluster layer)."""
+    return _auth_failures
+
+
+def register_auth_failure_hook(cb: Callable[[str], None]) -> None:
+    """Call ``cb(reason)`` on every auth rejection — the bridge the
+    supervisor/worker use to publish the registry counter without this
+    stdlib-only module importing observability."""
+    if cb not in _auth_failure_hooks:
+        _auth_failure_hooks.append(cb)
+
+
+def _reject(reason: str, cause: Optional[BaseException] = None):
+    global _auth_failures
+    _auth_failures += 1
+    for cb in list(_auth_failure_hooks):
+        try:
+            cb(reason)
+        except Exception:
+            pass                # a metrics hook must never mask the rejection
+    raise AuthError(reason) from cause
+
+
+def _auth_fault(**ctx) -> None:
+    """``cluster.rpc.auth`` injection hook: any armed fault becomes a
+    counted, typed AuthError — injected auth failures exercise exactly
+    the rejection path real ones take."""
+    try:
+        maybe_fail("cluster.rpc.auth", **ctx)
+    except Exception as e:
+        _reject(f"injected at cluster.rpc.auth: {e}", cause=e)
+
+
+def _mac(key: bytes, *parts: bytes) -> bytes:
+    h = _hmac_mod.new(key, digestmod=hashlib.sha256)
+    for p in parts:
+        h.update(p)
+    return h.digest()
+
+
+class FrameAuth:
+    """Per-connection frame authenticator produced by the handshake:
+    direction-separated session keys plus strictly-sequential send and
+    receive counters. The counter is mixed into every MAC, so a frame
+    that is replayed, dropped, or reordered fails verification even
+    though its MAC was once valid — exactly-once framing below the
+    RPC layer's (token, seq) dedup."""
+
+    def __init__(self, send_key: bytes, recv_key: bytes):
+        self._send_key = send_key
+        self._recv_key = recv_key
+        self._send_seq = 0
+        self._recv_seq = 0
+
+    def seal_frame(self, payload: bytes) -> bytes:
+        mac = _mac(self._send_key, struct.pack("<Q", self._send_seq),
+                   payload)
+        self._send_seq += 1
+        return mac + payload
+
+    def open_frame(self, body: bytes) -> bytes:
+        _auth_fault(nbytes=len(body), seq=self._recv_seq)
+        if len(body) < _MAC_LEN:
+            _reject("frame shorter than its MAC: unauthenticated or "
+                    "tampered peer")
+        mac, payload = body[:_MAC_LEN], body[_MAC_LEN:]
+        want = _mac(self._recv_key, struct.pack("<Q", self._recv_seq),
+                    payload)
+        if not _hmac_mod.compare_digest(mac, want):
+            _reject(f"bad frame MAC at recv seq {self._recv_seq}: "
+                    f"tampered, replayed or reordered frame")
+        self._recv_seq += 1
+        return payload
+
+
+def client_handshake(sock: socket.socket, secret: bytes) -> FrameAuth:
+    """One round trip at connect: prove knowledge of the shared secret
+    in both directions and derive direction-separated session keys.
+    Raises a counted :class:`AuthError` if the server cannot answer
+    the challenge (wrong or missing secret)."""
+    nonce_c = os.urandom(_NONCE_LEN)
+    send_msg(sock, _MAGIC + nonce_c + _mac(secret, b"cli", nonce_c))
+    reply = recv_msg(sock)
+    if len(reply) != _NONCE_LEN + _MAC_LEN:
+        _reject("malformed auth handshake reply")
+    nonce_s, mac = reply[:_NONCE_LEN], reply[_NONCE_LEN:]
+    _auth_fault(stage="client_handshake")
+    if not _hmac_mod.compare_digest(
+            mac, _mac(secret, b"srv", nonce_c, nonce_s)):
+        _reject("server failed the shared-secret handshake (wrong or "
+                "missing cluster secret)")
+    return FrameAuth(_mac(secret, b"c2s", nonce_c, nonce_s),
+                     _mac(secret, b"s2c", nonce_c, nonce_s))
+
+
+def server_handshake(sock: socket.socket, secret: bytes) -> FrameAuth:
+    """Server half of :func:`client_handshake`. A peer that closes
+    without speaking raises plain ConnectionError (port scan, not an
+    auth event); a peer that speaks anything but a valid hello — e.g.
+    an unauthenticated client sending a pickled RPC — is a counted,
+    typed rejection."""
+    hello = recv_msg(sock, eof_ok=True)
+    if hello is None:
+        raise ConnectionError("peer closed before auth hello")
+    if len(hello) != len(_MAGIC) + _NONCE_LEN + _MAC_LEN \
+            or not hello.startswith(_MAGIC):
+        _reject("peer did not speak the auth handshake "
+                "(unauthenticated client rejected)")
+    nonce_c = hello[len(_MAGIC):len(_MAGIC) + _NONCE_LEN]
+    mac = hello[len(_MAGIC) + _NONCE_LEN:]
+    _auth_fault(stage="server_handshake")
+    if not _hmac_mod.compare_digest(mac, _mac(secret, b"cli", nonce_c)):
+        _reject("client failed the shared-secret handshake")
+    nonce_s = os.urandom(_NONCE_LEN)
+    send_msg(sock, nonce_s + _mac(secret, b"srv", nonce_c, nonce_s))
+    return FrameAuth(_mac(secret, b"s2c", nonce_c, nonce_s),
+                     _mac(secret, b"c2s", nonce_c, nonce_s))
+
+
+def seal(secret: bytes, key: str, value: bytes) -> bytes:
+    """HMAC envelope for a TCPStore rendezvous value: the store daemon
+    treats values as opaque bytes, so authn rides inside the value.
+    The MAC covers the store KEY too — a valid value cannot be replayed
+    under a different key (e.g. one worker's port as another's)."""
+    return _mac(secret, b"store", key.encode("utf-8"), b"\x00",
+                value) + value
+
+
+def open_sealed(secret: bytes, key: str, blob: bytes) -> bytes:
+    """Verify + strip a :func:`seal` envelope; counted typed
+    :class:`AuthError` on any mismatch — a tampered rendezvous must
+    never yield bytes."""
+    if len(blob) < _MAC_LEN:
+        _reject(f"sealed store value {key!r} shorter than its MAC")
+    mac, value = blob[:_MAC_LEN], blob[_MAC_LEN:]
+    if not _hmac_mod.compare_digest(
+            mac, _mac(secret, b"store", key.encode("utf-8"), b"\x00",
+                      value)):
+        _reject(f"sealed store value {key!r} failed its MAC: "
+                f"tampered rendezvous")
+    return value
+
+
+# The worker SPEC is plain configuration data: dicts/lists/strings/
+# numbers plus (at most) small numpy scalars/arrays. Everything else —
+# most importantly anything with a __reduce__ that calls code — is
+# rejected. (numpy moved multiarray under numpy._core in 2.x; both
+# spellings stay listed so the allowlist survives the rename.)
+_SPEC_SAFE_GLOBALS = {
+    ("collections", "OrderedDict"),
+    ("numpy", "ndarray"),
+    ("numpy", "dtype"),
+    ("numpy.core.multiarray", "_reconstruct"),
+    ("numpy.core.multiarray", "scalar"),
+    ("numpy._core.multiarray", "_reconstruct"),
+    ("numpy._core.multiarray", "scalar"),
+}
+
+
+class _SpecUnpickler(pickle.Unpickler):
+    def find_class(self, module, name):
+        if (module, name) in _SPEC_SAFE_GLOBALS:
+            return super().find_class(module, name)
+        _reject(f"worker spec pickle requested disallowed global "
+                f"{module}.{name} — tampered spec rejected")
+
+
+def restricted_loads(blob: bytes):
+    """Unpickle the worker spec under the data-only allowlist. Any
+    disallowed global or malformed stream is a counted, typed
+    :class:`AuthError` — never arbitrary code execution. RPC payloads
+    (requests, typed errors) stay ordinary pickle; they only flow over
+    connections that already passed the handshake."""
+    try:
+        return _SpecUnpickler(io.BytesIO(blob)).load()
+    except AuthError:
+        raise
+    except Exception as e:
+        _reject(f"malformed worker spec pickle: {e!r}", cause=e)
+
+
+def send_msg(sock: socket.socket, data: bytes,
+             auth: Optional[FrameAuth] = None) -> None:
+    if auth is not None:
+        data = auth.seal_frame(data)
     _fault("cluster.rpc.send", nbytes=len(data))
     sock.sendall(struct.pack("<Q", len(data)) + data)
 
 
-def recv_msg(sock: socket.socket,
-             eof_ok: bool = False) -> Optional[bytes]:
+def recv_msg(sock: socket.socket, eof_ok: bool = False,
+             auth: Optional[FrameAuth] = None) -> Optional[bytes]:
     """One frame; on clean EOF returns None (eof_ok) or raises
-    ConnectionError. EOF mid-frame always raises."""
+    ConnectionError. EOF mid-frame always raises. With ``auth`` the
+    frame's MAC is verified (and stripped) before the payload is
+    returned — a frame that fails is a counted typed AuthError and
+    the socket must be closed like any other wire error."""
     hdr = recv_exact(sock, 8, eof_ok=eof_ok)
     if hdr is None:
         return None
@@ -73,7 +307,10 @@ def recv_msg(sock: socket.socket,
         raise ConnectionError(
             f"frame length {n} exceeds MAX_FRAME_BYTES "
             f"({MAX_FRAME_BYTES}): corrupt or hostile header")
-    return recv_exact(sock, n)
+    body = recv_exact(sock, n)
+    if auth is not None:
+        body = auth.open_frame(body)
+    return body
 
 
 def recv_exact(sock: socket.socket, n: int,

@@ -392,6 +392,12 @@ class ClusterTelemetry:
                     "serving.decode", "serving.verify")),
                 "handoff_s": sum(_dur(r) for r in named(
                     "serving.kv_handoff")),
+                # cross-host wire hop inside the handoff: the KV
+                # blocks' socket round-trip (serving/kv_wire.py),
+                # billed separately so a slow network shows up as
+                # kv_wire_s, not as generic handoff time
+                "kv_wire_s": sum(_dur(r) for r in named(
+                    "serving.kv_wire")),
                 # KV tiering: time spent promoting demoted prefix
                 # pages back onto device before the extend program —
                 # the latency price of a warm-but-demoted prefix

@@ -82,8 +82,18 @@ TRAINING_SWEEP = ("train.step", "io.dataloader.worker",
                   "store.set", "store.get", "store.add", "store.wait")
 # the RPC wire points live in distributed/_framing.py and fire in
 # whichever process does the send/recv: armed client-side they are
-# the network-partition kill kind of the cluster episodes
-CLUSTER_SWEEP = ("cluster.rpc.send", "cluster.rpc.recv")
+# the network-partition kill kind of the cluster episodes. The auth
+# point fires inside the handshake/per-frame MAC verification (a blip
+# below the retry budget re-handshakes invisibly; past it, the replica
+# partitions); the kv-wire point fires inside the cross-host handoff
+# transport (armed in the SERVING episodes' disagg flavor, which owns
+# the wire-handoff abort law); the weights point fires inside a
+# worker's digest-verified fetch (serving/weight_store.py). The
+# send/recv pair MUST stay first: the partition-kind draw indexes
+# CLUSTER_SWEEP[0:2] and pre-fabric seeds are bit-identical.
+CLUSTER_SWEEP = ("cluster.rpc.send", "cluster.rpc.recv",
+                 "cluster.rpc.auth", "cluster.kv.wire",
+                 "cluster.weights.fetch")
 
 
 @dataclasses.dataclass
@@ -309,6 +319,25 @@ def run_serving_episode(seed: int, max_iters: int = 300,
                    "host_tier_pages": None if tier_unbounded
                    else tier_cap}
         num_pages = min(num_pages, tier_pages)
+    # cross-host KV wire, drawn from a FIFTH rng stream (same
+    # bit-identity reasoning as the mesh/chunk/tier streams): disagg
+    # episodes sometimes route every prefill->decode handoff through
+    # the real-socket transport (serving/kv_wire.py), so the staged
+    # abort contract is certified with actual bytes on an actual wire.
+    # Every draw below is UNCONDITIONAL so the stream stays aligned
+    # whatever the flavor; the transport only applies on disagg.
+    rng5 = np.random.RandomState(1100000 + seed)
+    wire_draw = rng5.random() < 0.6
+    wire_mode = rng5.random()        # <0.45 blip, <0.75 fatal arm
+    wire_blip_times = int(rng5.randint(1, 3))   # < the 3-attempt budget
+    wire_fatal_times = int(rng5.randint(4, 7))  # > it: the abort path
+    wire_after = int(rng5.randint(0, 6))
+    wire_transport = None
+    wire_kw = {}
+    if wire_draw and mesh_flavor == "disagg":
+        from ..serving.kv_wire import LoopbackKVTransport
+        wire_transport = LoopbackKVTransport(secret=b"chaos-kv-wire")
+        wire_kw = {"kv_transport": wire_transport}
     registry = MetricRegistry()
     eng = ServingEngine(model, max_slots=max_slots, max_len=_MAX_LEN,
                         min_bucket=_MIN_BUCKET,
@@ -317,7 +346,7 @@ def run_serving_episode(seed: int, max_iters: int = 300,
                         registry=registry,
                         flight_recorder=FlightRecorder(capacity=8),
                         auditor=ledger, **spec_kw, **mesh_kw,
-                        **chunk_kw, **tier_kw)
+                        **chunk_kw, **tier_kw, **wire_kw)
     if donate:
         eng._donate = lambda: (5, 6)
     wt = None
@@ -417,6 +446,18 @@ def run_serving_episode(seed: int, max_iters: int = 300,
         if r_promote < 0.5:
             schedule.append(FaultArm("serving.kv.promote",
                                      times=t_promote, after=a_promote))
+    # wire arm, from the rng5 stream that owns the transport draw
+    # (draws above are unconditional; armed only when the wire is on):
+    # a blip heals inside the transport's retry budget — token-
+    # identically; a fatal arm outlasts it and must surface through
+    # _kv_handoff's staged abort (pages returned, request requeued,
+    # the prefill replayed — never a silent half-handoff)
+    if wire_kw and wire_mode < 0.75:
+        schedule.append(FaultArm(
+            "cluster.kv.wire",
+            times=(wire_blip_times if wire_mode < 0.45
+                   else wire_fatal_times),
+            after=wire_after))
     # shutdown chaos: half the episodes stop serving mid-trace and
     # drain() with the queue and slots still loaded — optionally with
     # one more decode fault armed right before the drain, the
@@ -547,6 +588,16 @@ def _serving_result(seed, violations, schedule, ledger, submitted,
         # the episode's final iteration is still confirmed
         wt.flush()
         wt.flush()
+    # wire teardown: the transport's server thread and sockets die
+    # with the episode (both result paths funnel through here)
+    wire_shipped = 0
+    transport = getattr(eng, "kv_transport", None)
+    if transport is not None:
+        wire_shipped = int(getattr(transport, "shipped", 0))
+        try:
+            transport.close()
+        except Exception:
+            pass
     fired = faults.fired()
     faults.clear()
     violations = list(violations)
@@ -580,6 +631,8 @@ def _serving_result(seed, violations, schedule, ledger, submitted,
                "kv_tiered": getattr(eng, "_kv_tier", None) is not None,
                "demotions": getattr(eng.cache, "demotions", 0),
                "promotions": getattr(eng.cache, "promotions", 0),
+               "kv_wired": transport is not None,
+               "wire_handoffs": wire_shipped,
                "incidents": (0 if wt is None
                              else len(wt.incidents())),
                "incident_kinds": sorted(
@@ -815,6 +868,10 @@ def _shutdown_cluster() -> None:
             _cluster_sup.shutdown()
         except Exception:
             pass
+        wdir = getattr(_cluster_sup, "_weight_store_dir", None)
+        if wdir:
+            import shutil
+            shutil.rmtree(wdir, ignore_errors=True)
         _cluster_sup = None
 
 
@@ -825,6 +882,7 @@ def _cluster_supervisor():
     global _cluster_sup
     if _cluster_sup is None:
         import atexit
+        import tempfile
         from ..observability import (ClusterTelemetry, FlightRecorder,
                                      MetricRegistry)
         from ..serving.cluster import ClusterSupervisor
@@ -836,12 +894,20 @@ def _cluster_supervisor():
                 "engine": {"max_slots": 2, "max_len": _MAX_LEN,
                            "min_bucket": _MIN_BUCKET},
                 "virtual_clock": True}
+        # band-lived shared weight store: workers load by digest-
+        # verified fetch (same bits as the seed rebuild, so the
+        # cross-process token-identity law is unchanged) and every
+        # engine reset re-verifies — the surface the
+        # cluster.weights.fetch arms land on. Removed in
+        # _shutdown_cluster: chaos must not litter the filesystem.
         _cluster_sup = ClusterSupervisor(
             spec, n_workers=2, max_respawns=8,
             registry=MetricRegistry(),
             flight_recorder=FlightRecorder(capacity=16),
             dump_on_death=False,
-            telemetry=ClusterTelemetry(), scrape_interval=1)
+            telemetry=ClusterTelemetry(), scrape_interval=1,
+            weight_store_dir=tempfile.mkdtemp(
+                prefix="ptpu_chaos_weights_"))
         _cluster_sup.start()
         atexit.register(_shutdown_cluster)
     return _cluster_sup
@@ -978,10 +1044,45 @@ def run_cluster_episode(seed: int, max_iters: int = 300,
                       int(rng.randint(1, 3)), int(rng.randint(0, 6)))
     shutdown_iter = int(rng.randint(2, 12)) \
         if rng.random() < 0.3 else None
+    # serving-fabric arms from a FIFTH rng stream appended AFTER every
+    # pre-existing draw (pre-fabric seeds stay bit-identical): an
+    # authenticated-framing blip below the RPC retry budget (the
+    # client re-handshakes invisibly), an auth partition past it (the
+    # exhausted counted rejection = ReplicaDead while the worker still
+    # runs — the supervisor must fence), and a worker-side weight-
+    # store arm the next digest-verified fetch (engine reset) absorbs
+    # inside ITS retry budget
+    rng5 = np.random.RandomState(1100000 + seed)
+    auth_blip = rng5.random() < 0.35
+    auth_times = int(rng5.randint(1, 3))      # < the 3-attempt budget
+    auth_after = int(rng5.randint(2, 24))
+    auth_part = rng5.random() < 0.25
+    auth_part_at = int(rng5.randint(2, 14))
+    auth_part_pick = int(rng5.randint(0, 8))
+    auth_part_times = int(rng5.randint(4, 8))  # > the budget
+    auth_part_after = int(rng5.randint(0, 8))
+    weights_draw = rng5.random() < 0.4
+    weights_widx = int(rng5.randint(0, sup.n_workers))
+    weights_times = int(rng5.randint(1, 3))   # < the fetch budget
+    if auth_part:
+        kills.append((auth_part_at, "authpart", auth_part_pick))
 
     for arm in blips:
         arm.arm()
     schedule = list(blips)
+    if auth_blip:
+        arm = FaultArm("cluster.rpc.auth", times=auth_times,
+                       after=auth_after)
+        arm.arm()
+        schedule.append(arm)
+    if weights_draw:
+        try:
+            sup.workers[weights_widx].client.arm_fault(
+                "cluster.weights.fetch", times=weights_times, after=0)
+            schedule.append(FaultArm("cluster.weights.fetch",
+                                     times=weights_times, after=0))
+        except Exception:
+            weights_draw = False
     if worker_arm is not None:
         widx, point, times, after = worker_arm
         try:
@@ -994,7 +1095,8 @@ def run_cluster_episode(seed: int, max_iters: int = 300,
     violations: List[str] = []
     submitted = []
     rejected = 0
-    kind_counts = {"coop": 0, "sigkill": 0, "partition": 0}
+    kind_counts = {"coop": 0, "sigkill": 0, "partition": 0,
+                   "authpart": 0}
 
     def _submit(pi, mn, dl, tenant):
         nonlocal rejected
@@ -1028,9 +1130,16 @@ def run_cluster_episode(seed: int, max_iters: int = 300,
                                              after=sig_after))
                 except Exception:
                     pass
-        else:                        # partition: client-side, fatal
+        elif kind == "partition":    # client-side, fatal
             arm = FaultArm(part_point, times=part_times,
                            after=part_after)
+            arm.arm()
+            schedule.append(arm)
+        else:                        # authpart: exhausted auth = wire
+            #                          loss past the budget, fenced
+            #                          exactly like a partition
+            arm = FaultArm("cluster.rpc.auth", times=auth_part_times,
+                           after=auth_part_after)
             arm.arm()
             schedule.append(arm)
 
@@ -1138,6 +1247,8 @@ def run_cluster_episode(seed: int, max_iters: int = 300,
                "kills": dict(kind_counts),
                "respawns": sup.respawns_used,
                "worker_arm": worker_arm,
+               "auth_blip": auth_blip,
+               "weights_arm": weights_draw,
                "attempts": ledger.attempts,
                "incidents": len(wt.incidents()),
                "incident_kinds": sorted(

@@ -126,6 +126,23 @@ KNOWN_POINTS = (
     # typed ConnectionError (the socket is unusable afterwards).
     "cluster.rpc.send",
     "cluster.rpc.recv",
+    # authenticated framing (distributed/_framing.py): fires inside
+    # the handshake + per-frame MAC verification — an armed fault is a
+    # counted typed AuthError (a ConnectionError), so blips below the
+    # RPC retry budget reconnect + re-handshake invisibly and a
+    # persistent mismatch exhausts into the ordinary failover
+    "cluster.rpc.auth",
+    # cross-host KV wire transfer (serving/kv_wire.py): fires inside
+    # the per-attempt ship of a disaggregated prefill→decode handoff —
+    # a raise is a typed retryable KVWireError; past the transport
+    # retry budget it surfaces through _kv_handoff's staged abort path
+    # (page claims returned, staged span dropped, request requeued)
+    "cluster.kv.wire",
+    # shared weight store (serving/weight_store.py): fires inside a
+    # worker's digest-verified chunk fetch — a raise is a typed
+    # retryable WeightStoreError; the worker retries and NEVER serves
+    # silently wrong weights
+    "cluster.weights.fetch",
     "store.set", "store.get", "store.add", "store.wait",
     "checkpoint.shard_write",
     "checkpoint.commit",

@@ -36,15 +36,25 @@ same state machine without changing it. Three pieces:
   (fresh engines + fresh router over warm processes) so a chaos band
   amortizes process spawns across seeds.
 
-Trust boundary: the RPC payloads are pickled python objects, exactly
-like ``distributed/rpc.py`` — workers bind 127.0.0.1 and the protocol
-must never be exposed beyond the launcher's private network.
+Trust boundary: every cluster connection runs the shared-secret HMAC
+handshake + per-frame MAC from ``distributed/_framing.py`` (secret via
+``PTPU_CLUSTER_SECRET`` or the ``secret=`` kwarg; the supervisor
+generates one per cluster when neither is given and hands it to
+spawned workers through their environment — never argv, never the
+store). TCPStore rendezvous values ride sealed HMAC envelopes and the
+worker spec is unpickled under a data-only allowlist, so a tampered
+rendezvous or an unauthenticated client is a counted, typed rejection
+(``ptpu_cluster_auth_failures_total``) — not code execution. Bind and
+advertise addresses are configurable (``bind_host``/``advertise_host``)
+so workers can live on other hosts; RPC *payloads* between
+authenticated peers are still pickle, so the secret is the perimeter.
 """
 from __future__ import annotations
 
 import json
 import os
 import pickle
+import secrets
 import signal
 import socket
 import subprocess
@@ -54,7 +64,10 @@ import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional
 
-from ..distributed._framing import nodelay, recv_msg, send_msg
+from ..distributed._framing import (client_handshake, nodelay,
+                                    open_sealed, recv_msg,
+                                    register_auth_failure_hook, seal,
+                                    send_msg)
 from ..observability import (TraceBuffer, active_context,
                              default_recorder, default_registry,
                              install_trace_buffer)
@@ -80,6 +93,44 @@ _RPC_OPS = frozenset({
 def normalize_op(op: str) -> str:
     """Bound RPC op names to the known protocol set for labels."""
     return op if op in _RPC_OPS else "other"
+
+
+# one process-wide bridge from _framing's auth-failure hook to the
+# registry counter: registered once at import, pointed at whichever
+# registry most recently built the counter (the hook list in _framing
+# dedups by identity, so N supervisors in one test process never
+# double-count a single rejection)
+_AUTH_COUNTER = {"c": None}
+
+
+def _publish_auth_failure(_reason: str) -> None:
+    c = _AUTH_COUNTER["c"]
+    if c is not None:
+        try:
+            c.inc()
+        except Exception:
+            pass
+
+
+def _ensure_auth_counter(reg) -> None:
+    _AUTH_COUNTER["c"] = reg.counter(
+        "ptpu_cluster_auth_failures_total",
+        "typed auth rejections: failed handshakes, bad/replayed frame "
+        "MACs, tampered rendezvous values, disallowed spec globals")
+    register_auth_failure_hook(_publish_auth_failure)
+
+
+def resolve_secret(secret=None) -> bytes:
+    """The cluster shared secret as bytes: the explicit argument, else
+    ``PTPU_CLUSTER_SECRET``, else a fresh random one (single-process
+    clusters that never export the env var still authenticate)."""
+    if secret:
+        return secret if isinstance(secret, bytes) \
+            else str(secret).encode("utf-8")
+    env = os.environ.get("PTPU_CLUSTER_SECRET", "")
+    if env:
+        return env.encode("utf-8")
+    return secrets.token_hex(32).encode("ascii")
 
 
 # ---------------------------------------------------------------------------
@@ -167,8 +218,13 @@ class RemoteEngine:
                  call_deadline_s: float = 30.0,
                  step_deadline_s: float = 180.0,
                  probe_timeout_s: Optional[float] = None,
-                 proc: Optional[subprocess.Popen] = None):
+                 proc: Optional[subprocess.Popen] = None,
+                 secret: Optional[bytes] = None):
         self.host, self.port, self.name = host, int(port), name
+        # None = legacy unauthenticated framing (standalone tests);
+        # the supervisor ALWAYS passes the cluster secret
+        self._secret = secret
+        self._auth = None
         ekw = dict(engine_kw or {})
         # the validation surface _build_request needs, mirrored from
         # the spec so admission errors are raised host-side and typed
@@ -208,6 +264,8 @@ class RemoteEngine:
         self.scheduler = _MirrorScheduler(self)
         self.cache = _MirrorCache(self)
         reg = registry if registry is not None else default_registry()
+        if secret is not None:
+            _ensure_auth_counter(reg)
         self._m_latency = reg.histogram(
             "ptpu_cluster_rpc_latency_seconds",
             "wall time of one cluster RPC (incl. retries)",
@@ -229,6 +287,9 @@ class RemoteEngine:
             except OSError:
                 pass
             self._sock = None
+        # auth session state (keys + frame counters) dies with the
+        # socket; the next _attempt re-handshakes on the fresh one
+        self._auth = None
 
     def _attempt(self, blob: bytes, seq: int, deadline: float) -> dict:
         if self._proc is not None and self._proc.poll() is not None:
@@ -236,12 +297,22 @@ class RemoteEngine:
                 f"worker {self.name} process exited with "
                 f"{self._proc.returncode}")
         if self._sock is None:
-            self._sock = nodelay(socket.create_connection(
+            sock = nodelay(socket.create_connection(
                 (self.host, self.port), timeout=min(deadline, 5.0)))
+            try:
+                if self._secret is not None:
+                    self._auth = client_handshake(sock, self._secret)
+            except Exception:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                raise
+            self._sock = sock
         self._sock.settimeout(deadline)
         try:
-            send_msg(self._sock, blob)
-            resp_blob = recv_msg(self._sock)
+            send_msg(self._sock, blob, auth=self._auth)
+            resp_blob = recv_msg(self._sock, auth=self._auth)
         except Exception:
             # after any wire error the stream position is undefined
             # (see _framing): the socket must die with the attempt
@@ -528,6 +599,8 @@ class WorkerHandle:
         self.index = index
         self.generation = 0
         self.proc: Optional[subprocess.Popen] = None
+        # replaced at rendezvous by the host the worker ADVERTISES
+        # (sealed store value) — never assumed local
         self.host = "127.0.0.1"
         self.port: Optional[int] = None
         self.pid: Optional[int] = None
@@ -573,10 +646,25 @@ class ClusterSupervisor:
                  spawn_timeout_s: float = 120.0,
                  telemetry=None, scrape_interval: int = 1,
                  spill_dir: Optional[str] = None,
-                 spill_every: int = 8):
+                 spill_every: int = 8,
+                 bind_host: str = "127.0.0.1",
+                 advertise_host: Optional[str] = None,
+                 secret=None,
+                 weight_store_dir: Optional[str] = None):
         if n_workers < 1:
             raise ValueError(f"n_workers must be >= 1, got {n_workers}")
         self.spec = dict(spec)
+        # bind = the local interface sockets listen on (store + the
+        # workers' RPC servers); advertise = the address peers dial.
+        # They differ exactly when binding a wildcard/private interface
+        # (bind 0.0.0.0, advertise the routable name).
+        self.bind_host = str(bind_host)
+        self.advertise_host = str(advertise_host or bind_host)
+        self._secret = resolve_secret(secret)
+        # shared weight store (serving/weight_store.py): when set, the
+        # supervisor publishes the state dict once and workers load by
+        # digest-verified fetch instead of rebuilding from the seed
+        self._weight_store_dir = weight_store_dir
         # workers spill their flight ring here (flight_<pid>.json) so
         # a SIGKILL still leaves a post-mortem the death dump attaches
         self._spill_dir = spill_dir or tempfile.gettempdir()
@@ -620,6 +708,7 @@ class ClusterSupervisor:
                 time_fn=lambda: self._time_fn())
             install_trace_buffer(self._host_buffer)
         reg = self.registry
+        _ensure_auth_counter(reg)
         self._m_alive = reg.gauge(
             "ptpu_cluster_worker_alive",
             "1 = worker process serving, 0 = reaped/down",
@@ -644,12 +733,18 @@ class ClusterSupervisor:
             os.path.abspath(paddle_tpu.__file__)))
         env = os.environ.copy()
         env["PYTHONPATH"] = root + os.pathsep + env.get("PYTHONPATH", "")
+        # the secret travels through the child environment only: argv
+        # is world-readable (/proc), the store is what it authenticates
+        env["PTPU_CLUSTER_SECRET"] = self._secret.decode(
+            "utf-8", "surrogateescape")
         slot.proc = subprocess.Popen(
             [sys.executable, "-m", "paddle_tpu.serving.worker",
-             "--store-host", "127.0.0.1",
+             "--store-host", self.advertise_host,
              "--store-port", str(self._store.port),
              "--prefix", self._prefix,
-             "--worker-id", slot.wid],
+             "--worker-id", slot.wid,
+             "--bind-host", self.bind_host,
+             "--advertise-host", self.advertise_host],
             env=env, cwd=root)
 
     def _await_ready(self, slot: WorkerHandle) -> None:
@@ -669,9 +764,16 @@ class ClusterSupervisor:
                     raise TimeoutError(
                         f"cluster worker {slot.wid} not ready within "
                         f"{self._spawn_timeout}s")
-        slot.port = int(self._store.get(key))
-        slot.pid = int(self._store.get(
-            f"{self._prefix}/{slot.wid}/pid"))
+
+        def opened(k: str) -> bytes:
+            # rendezvous values ride sealed envelopes: a tampered
+            # port/pid/host is a typed AuthError, not a wrong dial
+            return open_sealed(self._secret, k, self._store.get(k))
+
+        slot.port = int(opened(key))
+        slot.pid = int(opened(f"{self._prefix}/{slot.wid}/pid"))
+        slot.host = opened(
+            f"{self._prefix}/{slot.wid}/host").decode("utf-8")
         self._m_alive.labels(worker=slot.slot_label).set(1)
 
     def _make_client(self, slot: WorkerHandle) -> RemoteEngine:
@@ -683,7 +785,7 @@ class ClusterSupervisor:
             slot.host, slot.port, name=slot.slot_label,
             engine_kw=self._episode["engine"], time_fn=self._time_fn,
             registry=self.registry, proc=slot.proc,
-            **self._client_kwargs)
+            secret=self._secret, **self._client_kwargs)
         client.worker_pid = slot.pid
         slot.client = client
         return client
@@ -693,16 +795,36 @@ class ClusterSupervisor:
         from ..distributed.store import TCPStore
         if self._store is not None:
             raise RuntimeError("ClusterSupervisor already started")
-        self._store = TCPStore("127.0.0.1", 0, is_master=True,
+        self._store = TCPStore(self.bind_host, 0, is_master=True,
                                world_size=1)
-        self._store.set(f"{self._prefix}/spec",
-                        pickle.dumps(self.spec))
+        if self._weight_store_dir:
+            self._publish_weights()
+        key = f"{self._prefix}/spec"
+        # sealed so a tampered spec fails its MAC before the worker's
+        # restricted unpickler even runs (defense in depth)
+        self._store.set(key, seal(self._secret, key,
+                                  pickle.dumps(self.spec)))
         self._slots = [WorkerHandle(i) for i in range(self.n_workers)]
         for slot in self._slots:          # spawn all, then wait all:
             self._spawn_process(slot)     # startups overlap
         for slot in self._slots:
             self._await_ready(slot)
         return self._build_router()
+
+    def _publish_weights(self) -> None:
+        """Build the model ONCE supervisor-side and publish its state
+        dict into the content-addressed store; the spec then carries
+        nothing but the store root and the manifest digest — workers
+        fetch and sha256-verify every chunk (worker.py
+        ``_apply_published_weights``), so a corrupt store is a typed
+        retryable failure, never silently wrong weights."""
+        from .weight_store import WeightStore
+        from .worker import WorkerServer
+        ws = WeightStore(self._weight_store_dir,
+                         registry=self.registry)
+        model = WorkerServer._build_model(self.spec)
+        digest = ws.publish(model.state_dict())
+        self.spec["weights"] = {"dir": ws.root, "manifest": digest}
 
     def _build_router(self) -> ReplicaRouter:
         replicas = [RemoteReplica(str(slot.index),
@@ -840,7 +962,8 @@ class ClusterSupervisor:
                 slot.host, slot.port, name=slot.slot_label,
                 engine_kw=self._episode["engine"],
                 time_fn=self._time_fn, registry=self.registry,
-                proc=slot.proc, call_deadline_s=5.0)
+                proc=slot.proc, call_deadline_s=5.0,
+                secret=self._secret)
             try:
                 payload = tmp.telemetry()
                 tel.ingest_worker(slot.slot_label, payload,

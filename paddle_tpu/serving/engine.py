@@ -150,7 +150,8 @@ class ServingEngine:
                  admission_lookahead: int = 0,
                  kv_host_tier: bool = False,
                  host_tier_pages: Optional[int] = None,
-                 prefix_store_dir: Optional[str] = None):
+                 prefix_store_dir: Optional[str] = None,
+                 kv_transport=None):
         self.adapter = _ModelAdapter(model)
         model.eval()
         self.max_slots = int(max_slots)
@@ -240,6 +241,19 @@ class ServingEngine:
                     "kv_host_tier is not supported on mesh engines "
                     "yet: demotion would have to gather sharded "
                     "pools per page (see ROADMAP)")
+        # cross-host KV wire (serving/kv_wire.py): when set, every
+        # disaggregated prefill->decode handoff round-trips its KV
+        # blocks through the transport's digest-verified socket path
+        # before the decode-side install — the seam a cross-host
+        # prefill/decode split plugs into. Same staged/abort contract;
+        # a KVWireError past the transport's retry budget aborts the
+        # handoff exactly like a device-fabric failure.
+        self.kv_transport = kv_transport
+        if kv_transport is not None and prefill_devices <= 0:
+            raise ValueError(
+                "kv_transport requires a disaggregated mesh "
+                "(prefill_devices > 0): only the prefill->decode "
+                "handoff crosses the wire")
         # self-speculative decoding: n-gram drafts verified k tokens
         # per weight pass through ONE widened verify program (greedy
         # rows only; everything else falls back to k=1 IN the same
@@ -2435,6 +2449,21 @@ class ServingEngine:
             # will decode — the abort path frees the page claims
             raise RequestCancelled(
                 req.rid, "client disconnected mid-KV-handoff")
+        if self.kv_transport is not None:
+            # cross-host hop: the blocks leave as bytes on a real
+            # socket and come back digest-verified (kv_wire.py) —
+            # what lands on the decode group below is what the wire
+            # delivered, not the local arrays. A KVWireError past the
+            # transport's retry budget raises HERE, inside the staged
+            # window, so the caller's abort path unwinds both halves.
+            parts = [list(p) for p in blocks]
+            flat = [np.asarray(a) for part in parts for a in part]
+            with span("serving.kv_wire", slot=slot, request_id=rid,
+                      arrays=len(flat)):
+                flat = self.kv_transport.ship(rid, flat)
+            it = iter(flat)
+            blocks = tuple([next(it) for _ in part]
+                           for part in parts)
         L = self.adapter.num_layers
         dec_kv = [m.kv_sharding()] * L
         c = self.cache

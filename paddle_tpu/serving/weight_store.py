@@ -1,0 +1,193 @@
+"""Content-addressed, digest-verified shared weight store.
+
+The cross-host answer to "how do workers get the model": instead of
+every worker rebuilding parameters from a pickled config + seed (PR-10
+design, localhost-only) the supervisor publishes the state dict ONCE
+into a store both sides can reach (shared filesystem / NFS / object
+mount) and hands workers nothing but a **manifest digest** inside the
+sealed spec. Workers fetch by digest and verify every byte:
+
+- ``chunks/<sha256>`` — one chunk per tensor, raw ``np.save`` bytes,
+  named by the sha256 of their content. Content addressing makes
+  publishes idempotent and lets many manifests (model versions, LoRA
+  variants later) share unchanged tensors.
+- ``manifests/<sha256>.json`` — tensor name → {chunk, dtype, shape},
+  named by the sha256 of its canonical JSON. The digest in the spec
+  therefore pins the *entire* weight set: a flipped bit anywhere
+  changes some digest and the fetch fails typed.
+
+Writes ride the house atomic idiom (tmp + flush + fsync +
+``os.replace``, chunks before manifest — same machinery as
+``distributed/checkpoint.py`` and the persistent prefix store), so a
+torn publish is invisible: readers either see a complete object or
+none. A corrupt, truncated, or missing chunk on the read side is a
+typed, **retryable** :class:`WeightStoreError` — behind a 3-attempt
+:class:`~paddle_tpu.resilience.retry.RetryPolicy` — and never silently
+wrong weights. The ``cluster.weights.fetch`` fault point fires inside
+each chunk read so chaos can exercise exactly that path.
+"""
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import time
+from collections import OrderedDict
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..resilience.faults import maybe_fail
+from ..resilience.retry import RetryError, RetryPolicy
+
+__all__ = ["WeightStore", "WeightStoreError"]
+
+
+class WeightStoreError(RuntimeError):
+    """Typed, retryable weight-store failure: missing/corrupt/short
+    chunk, digest mismatch, malformed manifest. Retry or die — the
+    one forbidden outcome is serving with silently wrong weights."""
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    """tmp + fsync + os.replace: readers never see a torn object."""
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def _tensor_bytes(arr: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, arr, allow_pickle=False)
+    return buf.getvalue()
+
+
+class WeightStore:
+    """One store root (module doc). Thread-compatible: publish and
+    fetch touch disjoint tmp files and commit via atomic renames."""
+
+    def __init__(self, root: str, registry=None, retries: int = 3):
+        self.root = os.path.abspath(root)
+        self._chunks = os.path.join(self.root, "chunks")
+        self._manifests = os.path.join(self.root, "manifests")
+        os.makedirs(self._chunks, exist_ok=True)
+        os.makedirs(self._manifests, exist_ok=True)
+        if registry is None:
+            from ..observability import default_registry
+            registry = default_registry()
+        self._m_fetch = registry.histogram(
+            "ptpu_cluster_weight_fetch_seconds",
+            "wall time of one digest-verified weight fetch "
+            "(manifest + every chunk, incl. retries)")
+        self._retry = RetryPolicy(
+            max_attempts=int(retries), base_delay=0.02, max_delay=0.2,
+            retry_on=(WeightStoreError, OSError), seed=0)
+
+    # -- publish --------------------------------------------------------
+    def publish(self, state_dict: Dict[str, Any]) -> str:
+        """Write every tensor as a content-addressed chunk, then the
+        manifest; return the manifest digest (the only thing the spec
+        carries). Idempotent: unchanged tensors hit existing chunks."""
+        entries: "OrderedDict[str, dict]" = OrderedDict()
+        for name, t in state_dict.items():
+            arr = np.asarray(getattr(t, "_data", t))
+            data = _tensor_bytes(arr)
+            digest = _sha256(data)
+            cpath = os.path.join(self._chunks, digest)
+            if not os.path.exists(cpath):
+                _atomic_write(cpath, data)
+            entries[name] = {"chunk": digest,
+                             "dtype": str(arr.dtype),
+                             "shape": list(arr.shape)}
+        manifest = json.dumps({"tensors": entries}, sort_keys=True,
+                              separators=(",", ":")).encode("utf-8")
+        mdigest = _sha256(manifest)
+        mpath = os.path.join(self._manifests, mdigest + ".json")
+        if not os.path.exists(mpath):
+            _atomic_write(mpath, manifest)
+        return mdigest
+
+    # -- fetch ----------------------------------------------------------
+    def fetch(self, manifest_digest: str) -> "OrderedDict[str, np.ndarray]":
+        """Digest-verified load of the full state dict named by
+        ``manifest_digest``, with the retry budget applied to the
+        whole attempt (a torn NFS read looks like a short chunk; one
+        re-read usually heals it). Past the budget the last typed
+        error surfaces."""
+        t0 = time.monotonic()
+        try:
+            return self._retry.call(self._fetch_once, manifest_digest,
+                                    op="cluster.weights.fetch")
+        except RetryError as e:
+            raise WeightStoreError(
+                f"weight fetch for manifest {manifest_digest[:12]}… "
+                f"failed past the retry budget: {e.last!r}") from e
+        finally:
+            self._m_fetch.observe(time.monotonic() - t0)
+
+    def _fetch_once(self, manifest_digest: str):
+        mpath = os.path.join(self._manifests,
+                             manifest_digest + ".json")
+        try:
+            with open(mpath, "rb") as f:
+                raw = f.read()
+        except OSError as e:
+            raise WeightStoreError(
+                f"manifest {manifest_digest[:12]}… unreadable: "
+                f"{e}") from e
+        if _sha256(raw) != manifest_digest:
+            raise WeightStoreError(
+                f"manifest {manifest_digest[:12]}… content does not "
+                f"match its digest: tampered or torn store")
+        try:
+            entries = json.loads(raw.decode("utf-8"))["tensors"]
+        except (ValueError, KeyError) as e:
+            raise WeightStoreError(
+                f"manifest {manifest_digest[:12]}… malformed: "
+                f"{e}") from e
+        out: "OrderedDict[str, np.ndarray]" = OrderedDict()
+        for name, ent in entries.items():
+            out[name] = self._read_chunk(name, ent)
+        return out
+
+    def _read_chunk(self, name: str, ent: dict) -> np.ndarray:
+        # the chaos hook: an armed fault IS a corrupt/short read —
+        # typed and retryable, exactly like the real thing
+        try:
+            maybe_fail("cluster.weights.fetch", tensor=name)
+        except WeightStoreError:
+            raise
+        except Exception as e:
+            raise WeightStoreError(
+                f"injected at cluster.weights.fetch "
+                f"(tensor {name!r}): {e}") from e
+        cpath = os.path.join(self._chunks, ent["chunk"])
+        try:
+            with open(cpath, "rb") as f:
+                data = f.read()
+        except OSError as e:
+            raise WeightStoreError(
+                f"chunk for tensor {name!r} unreadable: {e}") from e
+        if _sha256(data) != ent["chunk"]:
+            raise WeightStoreError(
+                f"chunk for tensor {name!r} failed its sha256: "
+                f"corrupt or short read ({len(data)} bytes)")
+        try:
+            arr = np.load(io.BytesIO(data), allow_pickle=False)
+        except Exception as e:
+            raise WeightStoreError(
+                f"chunk for tensor {name!r} undecodable: {e}") from e
+        if str(arr.dtype) != ent["dtype"] \
+                or list(arr.shape) != list(ent["shape"]):
+            raise WeightStoreError(
+                f"tensor {name!r} decoded as {arr.dtype}{arr.shape}, "
+                f"manifest says {ent['dtype']}{tuple(ent['shape'])}")
+        return arr

@@ -9,9 +9,15 @@ ephemeral TCP port, publishes it under ``<prefix>/<worker-id>/port``
 (pid alongside, so the supervisor can SIGKILL a partitioned worker),
 and serves framed request/response RPC forever.
 
-Protocol (one pickled dict per ``_framing`` frame, trusted-job
-boundary only — pickle is never exposed past the launcher's private
-network, same caveat as ``distributed/rpc.py``):
+Protocol (one pickled dict per ``_framing`` frame). With a cluster
+secret (``PTPU_CLUSTER_SECRET``, always set by the supervisor) every
+accepted connection must pass the shared-secret handshake before its
+first frame is parsed, and every frame carries a sequenced MAC — an
+unauthenticated or tampered peer is a counted typed rejection
+(``AuthError``) and the serve loop simply waits for the next
+connection; the worker never crashes and never unpickles bytes that
+failed authentication. The spec itself arrives sealed and is
+unpickled under ``_framing.restricted_loads``'s data-only allowlist.
 
 - every request carries ``(token, seq)``; the worker caches its last
   response per token so a client that lost a response to a partition
@@ -65,9 +71,11 @@ def _wire_error(e: BaseException) -> BaseException:
 class WorkerServer:
     """The in-process half: owns the engine, dispatches ops."""
 
-    def __init__(self, spec: Dict[str, Any], worker_id: str):
+    def __init__(self, spec: Dict[str, Any], worker_id: str,
+                 secret: Optional[bytes] = None):
         self.spec = spec
         self.worker_id = worker_id
+        self._secret = secret
         self._clock = {"t": 0.0}
         self._virtual = bool(spec.get("virtual_clock"))
         self._stall_s = 0.0
@@ -75,6 +83,7 @@ class WorkerServer:
         self._last_key: Optional[tuple] = None
         self._last_blob: Optional[bytes] = None
         self._model = self._build_model(spec)
+        self._apply_published_weights()
         self.engine = None
         self._reqs: Dict[int, Any] = {}
         self._trace_buf = None
@@ -95,11 +104,28 @@ class WorkerServer:
         model.eval()
         return model
 
+    def _apply_published_weights(self) -> None:
+        """Load parameters from the shared weight store when the spec
+        carries a manifest digest. Every chunk is sha256-verified; a
+        corrupt or short read is a typed retryable failure and the
+        worker dies loudly rather than serve silently wrong weights."""
+        w = self.spec.get("weights")
+        if not w:
+            return               # legacy path: seed-built weights stand
+        from .weight_store import WeightStore, WeightStoreError
+        state = WeightStore(w["dir"]).fetch(w["manifest"])
+        missing, unexpected = self._model.set_state_dict(state)
+        if missing or unexpected:
+            raise WeightStoreError(
+                f"published manifest does not cover the model: "
+                f"missing={missing!r} unexpected={unexpected!r}")
+
     def _now(self) -> float:
         return self._clock["t"] if self._virtual else time.monotonic()
 
     def _make_engine(self, engine_kw: Dict[str, Any],
                      donate: bool = False) -> None:
+        from ..distributed._framing import register_auth_failure_hook
         from ..observability import (FlightRecorder, MetricRegistry,
                                      TraceBuffer, clear_bindings,
                                      install_trace_buffer)
@@ -107,6 +133,16 @@ class WorkerServer:
         from .engine import ServingEngine
         faults.clear()           # episode hygiene: no armed leftovers
         clear_bindings()
+        registry = MetricRegistry()
+        # server-side rejections (unauthenticated clients, garbage
+        # MACs) land on the worker's registry and merge through the
+        # ordinary telemetry scrape
+        self._m_auth = registry.counter(
+            "ptpu_cluster_auth_failures_total",
+            "typed auth rejections: failed handshakes, bad/replayed "
+            "frame MACs, tampered rendezvous values, disallowed spec "
+            "globals")
+        register_auth_failure_hook(self._on_auth_failure)
         # fresh buffer per engine incarnation: counters restart at 0,
         # which the host-side merger treats as a rebaseline (the
         # supervisor calls telemetry.rebaseline after each reset)
@@ -124,7 +160,7 @@ class WorkerServer:
             if spill_dir else None
         self.engine = ServingEngine(
             self._model, time_fn=self._now,
-            registry=MetricRegistry(),
+            registry=registry,
             flight_recorder=FlightRecorder(
                 capacity=64, time_fn=self._now,
                 spill_path=spill_path,
@@ -135,6 +171,14 @@ class WorkerServer:
             # recover()/failover paths are exercised for real
             self.engine._donate = lambda: (5, 6)
         self._reqs = {}
+
+    def _on_auth_failure(self, _reason: str) -> None:
+        m = getattr(self, "_m_auth", None)
+        if m is not None:
+            try:
+                m.inc()
+            except Exception:
+                pass            # a metrics hiccup must not mask the rejection
 
     # -- response plumbing ---------------------------------------------
     def _state(self) -> Dict[str, Any]:
@@ -211,7 +255,11 @@ class WorkerServer:
         eng = self.engine
         try:
             if op == "probe":
+                from ..distributed._framing import auth_failures
                 health = eng.probe()
+                # process-wide rejection count: the unauth-client test
+                # asserts it through an AUTHENTICATED probe
+                health["auth_failures"] = auth_failures()
                 return self._ok(pid=os.getpid(), health=health)
             if op == "submit":
                 req = msg["req"]
@@ -277,6 +325,12 @@ class WorkerServer:
                 return self._ok(violations=v,
                                 trace_counts=eng.trace_counts)
             if op == "reset":
+                # re-verify the published weights BEFORE _make_engine
+                # clears armed faults, so a chaos arm on
+                # cluster.weights.fetch lands on this exact fetch; a
+                # failure past the retry budget is a typed refusal and
+                # the supervisor hard-respawns instead of soft-reclaim
+                self._apply_published_weights()
                 self._make_engine(msg.get("engine") or {},
                                   donate=bool(msg.get("donate")))
                 self._virtual = bool(msg.get("virtual_clock",
@@ -304,13 +358,23 @@ class WorkerServer:
 
     # -- the serve loop ------------------------------------------------
     def serve(self, srv: socket.socket) -> None:
-        from ..distributed._framing import nodelay, recv_msg, send_msg
+        from ..distributed._framing import (nodelay, recv_msg,
+                                            send_msg, server_handshake)
         while True:
             conn, _ = srv.accept()
             nodelay(conn)
+            auth = None
             try:
+                if self._secret is not None:
+                    # a peer that cannot pass the handshake — an
+                    # unauthenticated client, a wrong secret, garbage
+                    # bytes — raises a counted typed AuthError here
+                    # (a ConnectionError): this connection dies, the
+                    # loop accepts the next one, no frame of it was
+                    # ever unpickled
+                    auth = server_handshake(conn, self._secret)
                 while True:
-                    blob = recv_msg(conn, eof_ok=True)
+                    blob = recv_msg(conn, eof_ok=True, auth=auth)
                     if blob is None:
                         break
                     msg = pickle.loads(blob)
@@ -321,7 +385,8 @@ class WorkerServer:
                         out = self._last_blob   # resend, don't re-run
                     elif msg.get("op") == "shutdown":
                         send_msg(conn, pickle.dumps(
-                            {"ok": True, "seq": msg.get("seq")}))
+                            {"ok": True, "seq": msg.get("seq")}),
+                            auth=auth)
                         os._exit(0)
                     else:
                         resp = self.dispatch(msg)
@@ -335,9 +400,9 @@ class WorkerServer:
                         self._last_key, self._last_blob = key, out
                     if stall:
                         time.sleep(stall)
-                    send_msg(conn, out)
+                    send_msg(conn, out, auth=auth)
             except (ConnectionError, OSError):
-                pass             # client gone; wait for a reconnect
+                pass             # client gone/rejected; await the next
             finally:
                 try:
                     conn.close()
@@ -352,7 +417,18 @@ def main(argv=None) -> None:
     parser.add_argument("--store-port", type=int, required=True)
     parser.add_argument("--prefix", required=True)
     parser.add_argument("--worker-id", required=True)
+    parser.add_argument("--bind-host", default="127.0.0.1",
+                        help="local interface the RPC server binds")
+    parser.add_argument("--advertise-host", default=None,
+                        help="address published for peers to dial "
+                             "(defaults to --bind-host)")
     args = parser.parse_args(argv)
+    advertise = args.advertise_host or args.bind_host
+    # the supervisor always exports the cluster secret into this
+    # process's environment; absent = legacy unauthenticated framing
+    secret_env = os.environ.get("PTPU_CLUSTER_SECRET", "")
+    secret = secret_env.encode("utf-8", "surrogateescape") \
+        if secret_env else None
 
     # the TPU plugin force-sets jax_platforms at interpreter startup;
     # honor the env the supervisor handed us (tests/benches force cpu)
@@ -361,11 +437,18 @@ def main(argv=None) -> None:
         import jax
         jax.config.update("jax_platforms", plat)
 
+    from ..distributed._framing import open_sealed, restricted_loads
     from ..distributed.store import TCPStore
     store = TCPStore(args.store_host, args.store_port,
                      is_master=False, world_size=1)
-    spec = pickle.loads(store.get(f"{args.prefix}/spec", timeout=60.0))
-    server = WorkerServer(spec, args.worker_id)
+    spec_key = f"{args.prefix}/spec"
+    blob = store.get(spec_key, timeout=60.0)
+    if secret is not None:
+        blob = open_sealed(secret, spec_key, blob)
+    # data-only allowlist regardless of sealing: the spec never needs
+    # to execute code, so it never gets to
+    spec = restricted_loads(blob)
+    server = WorkerServer(spec, args.worker_id, secret=secret)
 
     def _sigterm(_signum, _frame):
         # graceful kill: spill the flight ring so the supervisor's
@@ -380,15 +463,25 @@ def main(argv=None) -> None:
 
     signal.signal(signal.SIGTERM, _sigterm)
 
+    from ..distributed._framing import seal
     srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
     srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-    srv.bind(("127.0.0.1", 0))
+    srv.bind((args.bind_host, 0))
     srv.listen(8)
     port = srv.getsockname()[1]
-    store.set(f"{args.prefix}/{args.worker_id}/pid",
-              str(os.getpid()).encode())
-    store.set(f"{args.prefix}/{args.worker_id}/port",
-              str(port).encode())
+
+    def publish(key: str, value: bytes) -> None:
+        store.set(key, seal(secret, key, value)
+                  if secret is not None else value)
+
+    publish(f"{args.prefix}/{args.worker_id}/pid",
+            str(os.getpid()).encode())
+    publish(f"{args.prefix}/{args.worker_id}/host",
+            advertise.encode("utf-8"))
+    # port LAST: the supervisor waits on it, so host/pid are already
+    # readable when the wait returns
+    publish(f"{args.prefix}/{args.worker_id}/port",
+            str(port).encode())
     store.close()
     server.serve(srv)
 

@@ -78,6 +78,21 @@ CLUSTER_SLO_KEYS = {
 }
 
 
+# the CLUSTER_WAN line (bench_serving_engine --multihost) is the
+# ISSUE-18 acceptance artifact: every disaggregated KV handoff shipped
+# over the authenticated socket transport (token-identical, wire blips
+# absorbed), then an authenticated worker cluster with a shared
+# digest-verified weight store driven through a SIGKILL + a partition,
+# with an unauthenticated raw client provably refused at the end
+CLUSTER_WAN_KEYS = {
+    "devices", "wire_requests", "wire_handoffs", "wire_bytes",
+    "wire_faults_absorbed", "token_identical", "workers",
+    "cluster_requests", "sigkills", "partitions", "failover_requests",
+    "respawns", "unauth_client_rejected", "auth_failures",
+    "weights_published", "weight_manifest", "ledger_green",
+}
+
+
 # the CHUNKED_PREFILL line (bench_serving_engine --chunked-prefill)
 # is the ISSUE-14 acceptance artifact: mixed long-prompt/short-decode
 # traffic through the unchunked and prefill_chunk engines — schema
@@ -148,10 +163,11 @@ KV_TIERING_KEYS = {
     "bench_serving_engine.py --frontdoor",
     "bench_serving_engine.py --tensor-parallel",
     "bench_serving_engine.py --cluster",
+    "bench_serving_engine.py --multihost",
     "chaos_soak.py",
 ])
 def test_benchmark_script_smoke(script, tmp_path):
-    if "--cluster" in script:
+    if "--cluster" in script or "--multihost" in script:
         from paddle_tpu.distributed.store import get_lib
         if get_lib() is None:
             pytest.skip("native TCPStore extension unavailable")
@@ -326,6 +342,28 @@ def test_benchmark_script_smoke(script, tmp_path):
         assert any(e.get("ph") == "s" for e in evs)   # flow start
         assert art["slo_attribution"], "empty SLO attribution"
         assert "# TYPE" in art["merged_metrics"]
+    if script == "bench_serving_engine.py --multihost":
+        wlines = [l for l in r.stdout.splitlines()
+                  if l.startswith("CLUSTER_WAN ")]
+        assert wlines, r.stdout
+        wan = json.loads(wlines[-1][len("CLUSTER_WAN "):])
+        assert CLUSTER_WAN_KEYS <= set(wan), sorted(wan)
+        # ISSUE-18 acceptance bars: the wire path really carried the
+        # handoffs and really healed injected blips token-identically
+        assert wan["wire_handoffs"] >= 1, wan
+        assert wan["wire_faults_absorbed"] >= 1, wan
+        assert wan["token_identical"] is True, wan
+        # the cluster half really survived a SIGKILL and a partition
+        # on the authenticated, weight-store-backed fabric
+        assert wan["sigkills"] == 1 and wan["partitions"] == 1, wan
+        assert wan["failover_requests"] >= 1, wan
+        assert wan["respawns"] >= 1, wan
+        assert wan["weights_published"] is True, wan
+        assert wan["ledger_green"] is True, wan
+        # the trust boundary: a raw unauthenticated client got a
+        # typed refusal and the rejection was counted
+        assert wan["unauth_client_rejected"] is True, wan
+        assert wan["auth_failures"] >= 1, wan
     if script == "bench_serving_engine.py --tensor-parallel":
         tlines = [l for l in r.stdout.splitlines()
                   if l.startswith("TP_SERVING ")]

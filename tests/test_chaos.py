@@ -302,7 +302,8 @@ def test_training_episode_matrix(seed, tmp_path):
 
 _tp_tally = {"episodes": 0, "disagg": 0, "handoff_kills": 0,
              "sharded_kills": 0, "recoveries": 0, "chunked": 0,
-             "chunk_kills": 0}
+             "chunk_kills": 0, "wired": 0, "wire_handoffs": 0,
+             "wire_kills": 0}
 
 
 @pytest.mark.parametrize("seed", TP_SERVING_SEEDS)
@@ -325,6 +326,9 @@ def test_tp_serving_episode_matrix(seed):
     _tp_tally["chunked"] += 1 if res.stats["prefill_chunk"] else 0
     _tp_tally["chunk_kills"] += \
         res.fired.get("serving.prefill.chunk", 0)
+    _tp_tally["wired"] += 1 if res.stats["kv_wired"] else 0
+    _tp_tally["wire_handoffs"] += res.stats["wire_handoffs"]
+    _tp_tally["wire_kills"] += res.fired.get("cluster.kv.wire", 0)
 
 
 def test_tp_matrix_actually_kills_handoffs_and_sharded_decodes():
@@ -343,6 +347,21 @@ def test_tp_matrix_actually_kills_handoffs_and_sharded_decodes():
     # on the mesh engines and really get killed mid-chunk there too
     assert _tp_tally["chunked"] >= 6, _tp_tally
     assert _tp_tally["chunk_kills"] >= 2, _tp_tally
+
+
+def test_tp_matrix_actually_ships_kv_over_the_wire():
+    """The wire-handoff arm (ISSUE 18) must stay LOADED: disaggregated
+    episodes that really route every KV handoff through the
+    authenticated socket transport (sampled on its own rng stream so
+    pre-fabric seeds stay bit-identical), handoffs that really
+    round-trip the wire, and ``cluster.kv.wire`` faults that really
+    fire mid-transfer — otherwise the cross-host handoff soak goes
+    green by vacuity."""
+    if _tp_tally["episodes"] < len(TP_SERVING_SEEDS):
+        pytest.skip("full TP serving matrix did not run")
+    assert _tp_tally["wired"] >= 8, _tp_tally
+    assert _tp_tally["wire_handoffs"] >= 10, _tp_tally
+    assert _tp_tally["wire_kills"] >= 4, _tp_tally
 
 
 _frontdoor_death_tally = {"episodes": 0, "deaths": 0,
@@ -374,9 +393,10 @@ def test_frontdoor_matrix_actually_kills_replicas():
 
 
 _cluster_tally = {"episodes": 0, "requests": 0, "coop": 0,
-                  "sigkill": 0, "partition": 0, "deaths": 0,
-                  "failover_requests": 0, "respawns": 0,
-                  "partition_incidents": 0, "death_incidents": 0}
+                  "sigkill": 0, "partition": 0, "authpart": 0,
+                  "deaths": 0, "failover_requests": 0, "respawns": 0,
+                  "partition_incidents": 0, "death_incidents": 0,
+                  "auth_blips": 0, "weights_arms": 0}
 
 
 @pytest.mark.parametrize("seed", CLUSTER_SEEDS)
@@ -392,8 +412,11 @@ def test_cluster_episode_matrix(seed):
     assert res.stats["attempts"] >= 1
     _cluster_tally["episodes"] += 1
     _cluster_tally["requests"] += res.stats["requests"]
-    for kind in ("coop", "sigkill", "partition"):
+    for kind in ("coop", "sigkill", "partition", "authpart"):
         _cluster_tally[kind] += res.stats["kills"].get(kind, 0)
+    _cluster_tally["auth_blips"] += 1 if res.stats["auth_blip"] else 0
+    _cluster_tally["weights_arms"] += \
+        1 if res.stats["weights_arm"] else 0
     _cluster_tally["deaths"] += 1 if res.stats["replica_deaths"] else 0
     _cluster_tally["failover_requests"] += \
         res.stats["failover_requests"]
@@ -427,6 +450,22 @@ def test_cluster_matrix_actually_kills_workers():
     assert _cluster_tally["deaths"] >= 8, _cluster_tally
     assert _cluster_tally["failover_requests"] >= 6, _cluster_tally
     assert _cluster_tally["respawns"] >= 6, _cluster_tally
+
+
+def test_cluster_matrix_actually_exercises_the_fabric():
+    """The serving-fabric arms (ISSUE 18) must stay LOADED across the
+    band: auth blips (``cluster.rpc.auth`` under the handshake/frame
+    retry budget, healed invisibly), auth partitions (exhausted auth =
+    a fenced worker: respawned like any partition), and weight-store
+    fetch faults (``cluster.weights.fetch`` armed inside the worker
+    against its manifest fetch, absorbed by the digest-verified
+    retry). All sampled on the fabric rng stream so the pre-fabric
+    kill schedules stay bit-identical."""
+    if _cluster_tally["episodes"] < len(CLUSTER_SEEDS):
+        pytest.skip("full cluster matrix did not run")
+    assert _cluster_tally["authpart"] >= 3, _cluster_tally
+    assert _cluster_tally["auth_blips"] >= 6, _cluster_tally
+    assert _cluster_tally["weights_arms"] >= 6, _cluster_tally
 
 
 def test_cluster_matrix_watchtower_attributes_kills():
@@ -808,6 +847,47 @@ def test_pinned_seed_dropped_kv_handoff_goes_lost(monkeypatch):
     assert green.ok, "\n".join(green.violations)
     assert green.fired.get("serving.kv.handoff", 0) >= 1
     assert green.stats["mesh"] == "disagg"
+
+
+PINNED_SEED_WIRE_LOST = 11   # disagg episode, wire arm past budget
+
+
+def test_pinned_seed_swallowed_wire_handoff_goes_lost(monkeypatch):
+    """ISSUE-18 pinned red seed: a wire KV handoff that fails PAST the
+    retry budget must abort and requeue, never vanish. The pinned
+    seed's ``cluster.kv.wire`` arm outlasts the transport's 3-attempt
+    budget, so the typed :class:`KVWireError` surfaces mid-handoff
+    (span staged, decode-side pages claimed). With that error
+    SWALLOWED at the prefill boundary — the pre-fix shape: neither
+    served nor requeued — the conservation ledger goes RED with LOST;
+    the real path (staged span dropped, ``abort_sequence`` returns the
+    page claims, request requeued and re-shipped on a fresh transfer
+    id) stays green on the same seed, with the wire arm genuinely
+    fired past budget and real handoffs genuinely round-tripping the
+    socket (not green by vacuity)."""
+    from paddle_tpu.serving import ServingEngine
+    from paddle_tpu.serving.kv_wire import KVWireError
+    orig = ServingEngine._prefill
+
+    def swallow_wire_failure(self, slot, req):
+        try:
+            return orig(self, slot, req)
+        except KVWireError:
+            return          # pre-fix: request dropped on the floor
+
+    monkeypatch.setattr(ServingEngine, "_prefill",
+                        swallow_wire_failure)
+    red = chaos.run_serving_episode(PINNED_SEED_WIRE_LOST)
+    assert not red.ok
+    assert any("LOST" in v for v in red.violations), red.violations
+    monkeypatch.setattr(ServingEngine, "_prefill", orig)
+    green = chaos.run_serving_episode(PINNED_SEED_WIRE_LOST)
+    assert green.ok, "\n".join(green.violations)
+    assert green.stats["mesh"] == "disagg"
+    assert green.stats["kv_wired"]
+    assert green.stats["wire_handoffs"] >= 1
+    # past-budget: more fires than one ship's 3-attempt budget
+    assert green.fired.get("cluster.kv.wire", 0) >= 4
 
 
 PINNED_SEED_DROPPED_PROMOTION = 696   # tiered episode, promote kill

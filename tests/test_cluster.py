@@ -9,8 +9,11 @@ wire-fault regression (typed ConnectionError, never a partial-frame
 hang). Everything here needs the native TCPStore extension for worker
 rendezvous — skipped, not silently green, where it can't build."""
 import os
+import pickle
 import signal
 import socket
+import struct
+import threading
 
 import numpy as np
 import pytest
@@ -243,7 +246,6 @@ def test_framing_faults_are_typed_and_prompt():
 def test_framing_peer_close_mid_frame_raises():
     a, b = socket.socketpair()
     try:
-        import struct
         a.sendall(struct.pack("<Q", 64) + b"short")   # 64 promised
         a.close()
         from paddle_tpu.distributed._framing import recv_msg
@@ -251,6 +253,139 @@ def test_framing_peer_close_mid_frame_raises():
             recv_msg(b)                  # EOF mid-frame: typed, no hang
     finally:
         b.close()
+
+
+# -- ISSUE-18: the cross-host trust boundary ---------------------------
+# Authenticated framing must reject — typed, counted, never a hang or
+# a desync — every malformed thing a hostile or broken peer can put on
+# the wire: oversized length prefixes, truncated frames, tampered
+# MACs, replayed frames, and clients that skip or fail the handshake.
+
+def test_framing_rejects_oversized_length_prefix():
+    """A corrupt or hostile header must not drive recv into a near-
+    2^64 allocation: the length prefix is bounded BEFORE the body is
+    read."""
+    from paddle_tpu.distributed._framing import MAX_FRAME_BYTES, recv_msg
+    a, b = socket.socketpair()
+    try:
+        a.sendall(struct.pack("<Q", MAX_FRAME_BYTES + 1))
+        with pytest.raises(ConnectionError, match="MAX_FRAME_BYTES"):
+            recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def _auth_pair():
+    from paddle_tpu.distributed._framing import FrameAuth
+    key = bytes(range(32))
+    return FrameAuth(key, key), FrameAuth(key, key)
+
+
+def test_framing_auth_rejects_truncated_and_tampered_frames():
+    from paddle_tpu.distributed import _framing as fr
+    tx, rx = _auth_pair()
+    before = fr.auth_failures()
+    a, b = socket.socketpair()
+    try:
+        # truncated: a frame shorter than its MAC (e.g. a peer that
+        # never sealed it) is an auth rejection, not an index error
+        fr.send_msg(a, b"xy")
+        with pytest.raises(fr.AuthError, match="shorter than its MAC"):
+            fr.recv_msg(b, auth=rx)
+        # tampered: one flipped bit anywhere in MAC or payload
+        frame = tx.seal_frame(b"payload")
+        frame = bytes([frame[0] ^ 0xFF]) + frame[1:]
+        a.sendall(struct.pack("<Q", len(frame)) + frame)
+        with pytest.raises(fr.AuthError, match="bad frame MAC"):
+            fr.recv_msg(b, auth=rx)
+    finally:
+        a.close()
+        b.close()
+    assert fr.auth_failures() >= before + 2    # every rejection counted
+
+
+def test_framing_auth_rejects_replayed_frames():
+    """The per-direction counter is mixed into every MAC: the same
+    sealed bytes are valid exactly once, so capture-and-replay fails
+    verification even though the MAC was once good."""
+    from paddle_tpu.distributed import _framing as fr
+    tx, rx = _auth_pair()
+    a, b = socket.socketpair()
+    try:
+        frame = tx.seal_frame(b"hello")
+        raw = struct.pack("<Q", len(frame)) + frame
+        a.sendall(raw)
+        assert fr.recv_msg(b, auth=rx) == b"hello"
+        a.sendall(raw)                       # verbatim replay
+        with pytest.raises(fr.AuthError, match="replayed"):
+            fr.recv_msg(b, auth=rx)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_handshake_rejects_unauthenticated_and_wrong_secret_peers():
+    from paddle_tpu.distributed import _framing as fr
+    before = fr.auth_failures()
+    # an unauthenticated client: speaks pickled RPC where the hello
+    # belongs (the pre-fabric wire format)
+    a, b = socket.socketpair()
+    try:
+        fr.send_msg(a, pickle.dumps({"op": "step"}))
+        with pytest.raises(fr.AuthError, match="unauthenticated"):
+            fr.server_handshake(b, b"right-secret")
+    finally:
+        a.close()
+        b.close()
+    # a wrong-secret client: correctly-shaped hello, wrong MAC
+    a, b = socket.socketpair()
+    client_err = []
+
+    def dial():
+        try:
+            fr.client_handshake(a, b"wrong-secret")
+        except ConnectionError as e:
+            client_err.append(e)
+
+    t = threading.Thread(target=dial)
+    t.start()
+    try:
+        with pytest.raises(fr.AuthError,
+                           match="failed the shared-secret"):
+            fr.server_handshake(b, b"right-secret")
+    finally:
+        b.close()
+        a.close()
+        t.join(timeout=10)
+    assert client_err                        # the dialer got a typed
+    assert fr.auth_failures() >= before + 2  # refusal too, all counted
+
+
+def test_unauthenticated_client_rejected_by_real_worker(cluster):
+    """ISSUE-18 acceptance bar, end to end: a raw client that dials a
+    REAL worker's RPC port and speaks pickled RPC without the
+    handshake gets a typed refusal (connection dropped, no reply
+    bytes, no unpickling on the worker), the worker's auth-failure
+    counter ticks, and the worker keeps serving authenticated
+    clients."""
+    from paddle_tpu.distributed._framing import recv_msg, send_msg
+    cluster.new_episode(ENGINE_KW)
+    w = cluster.workers[0]
+    base = int(w.client.probe().get("auth_failures", 0))
+    # the worker serves one connection at a time: release the
+    # supervisor's persistent one so the accept loop reaches ours
+    w.client._close_sock()
+    s = socket.create_connection((w.host, w.port), timeout=10)
+    s.settimeout(10)
+    try:
+        send_msg(s, pickle.dumps({"op": "probe"}))
+        with pytest.raises(ConnectionError):
+            recv_msg(s)          # refusal, not a probe response
+    finally:
+        s.close()
+    health = w.client.probe()    # the worker is still serving
+    assert int(health.get("auth_failures", 0)) >= base + 1
 
 
 # -- ISSUE-13: distributed tracing + cluster telemetry acceptance ------
