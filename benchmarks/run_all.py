@@ -23,6 +23,9 @@ SCRIPTS = ["bench_resnet50.py", "bench_bert_dp.py", "bench_gpt_hybrid.py",
            "bench_serving_engine.py --prefix-share",
            # self-speculative decoding on the repetitive-suffix trace
            "bench_serving_engine.py --speculative",
+           # draft-model speculation + sampled acceptance + tuner on
+           # the low-self-similarity trace (ISSUE-19 acceptance)
+           "bench_serving_engine.py --spec-v2",
            # KV tiering: host-RAM page tier + persistent prefix store
            # under device-page pressure (tier-labelled hit rates,
            # restart warm-start)

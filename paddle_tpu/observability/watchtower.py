@@ -782,8 +782,11 @@ class Watchtower:
 
     def to_json(self) -> dict:
         """The ``/incidents`` payload (and the ``ptpu_doctor`` dump
-        format): health summary, declared objectives, incidents."""
-        return {"health": self.healthz(),
+        format): health summary, declared objectives, incidents —
+        plus a ``speculation`` block when the attached engine decodes
+        speculatively (accepted tokens/step, active proposer, tuner
+        state), so one doctor dump answers "is speculation paying?"."""
+        snap = {"health": self.healthz(),
                 "objectives": [
                     {"name": o.name, "threshold_s": o.threshold_s,
                      "objective": o.objective, "family": o.family,
@@ -794,6 +797,13 @@ class Watchtower:
                     for o in self.objectives],
                 "incidents": [i.to_json()
                               for i in self.incidents()]}
+        eng = getattr(self, "_engine", None)
+        if eng is not None and getattr(eng, "speculative", False):
+            try:
+                snap["speculation"] = eng.spec_stats()
+            except Exception:
+                pass
+        return snap
 
     def diagnose(self) -> str:
         return render_diagnosis(self.to_json())
@@ -825,6 +835,17 @@ def render_diagnosis(snap: dict) -> str:
         if fast or slow:
             lines.append(f"  burn[{b_name}]: fast {fast:.2f}x, "
                          f"slow {slow:.2f}x of error budget")
+    spec = snap.get("speculation")
+    if spec:
+        line = (f"  speculation: {spec.get('proposer', 'ngram')} "
+                f"accepted {spec.get('accepted_per_step', 0.0):.1f} "
+                f"tok/step")
+        tuner = spec.get("tuner")
+        if tuner:
+            st = (tuner.get("classes") or {}).get("greedy") or {}
+            line += (f", tuner at k={st.get('k')}" if st.get("on")
+                     else ", tuner off (k=1)")
+        lines.append(line)
     for inc in incs:
         phase = inc.get("phase", "?")
         verdict = _VERDICT.get(phase, f"{phase}-bound")

@@ -72,7 +72,8 @@ SERVING_SWEEP = ("serving.step.decode", "serving.decode.verify",
                  "serving.decode.sharded",
                  "serving.step.prefill", "serving.prefill.paged",
                  "serving.prefill.chunk", "serving.kv.handoff",
-                 "serving.kv.demote", "serving.kv.promote")
+                 "serving.kv.demote", "serving.kv.promote",
+                 "serving.spec.draft", "serving.spec.resample")
 FRONTDOOR_SWEEP = ("router.dispatch", "router.health_probe",
                    "frontdoor.stream_write",
                    "frontdoor.client_disconnect")
@@ -138,6 +139,7 @@ _MAX_LEN = 32
 _MIN_BUCKET = 8
 _REF_HORIZON = 8
 _model = None
+_draft_models: dict = {}
 _refs: Optional[List[List[int]]] = None
 _pool: Optional[List[np.ndarray]] = None
 
@@ -179,6 +181,27 @@ def _serving_model():
             num_attention_heads=2, max_position_embeddings=_MAX_LEN))
         _model.eval()
     return _model
+
+
+def _draft_serving_model(variant: str):
+    """Cached draft models for the DRAFT-PROPOSER episode flavor.
+    ``"same"`` is the target model itself (the oracle draft: every
+    proposal accepted, the widest verify rows exercised); ``"other"``
+    is an independently-seeded twin (disagreeing drafts: the
+    rejection/partial-acceptance paths exercised). Both tiny — the
+    soak's value is the bookkeeping, not the matmuls."""
+    if variant == "same":
+        return _serving_model()
+    if variant not in _draft_models:
+        import paddle_tpu as paddle
+        from ..models.llama import LlamaForCausalLM, llama_tiny_config
+        paddle.seed(7)
+        m = LlamaForCausalLM(llama_tiny_config(
+            num_hidden_layers=1, hidden_size=32, intermediate_size=64,
+            num_attention_heads=2, max_position_embeddings=_MAX_LEN))
+        m.eval()
+        _draft_models[variant] = m
+    return _draft_models[variant]
 
 
 def _reference_outputs() -> List[List[int]]:
@@ -338,6 +361,42 @@ def run_serving_episode(seed: int, max_iters: int = 300,
         from ..serving.kv_wire import LoopbackKVTransport
         wire_transport = LoopbackKVTransport(secret=b"chaos-kv-wire")
         wire_kw = {"kv_transport": wire_transport}
+    # speculation v2, drawn from a SIXTH rng stream (same bit-identity
+    # reasoning as the mesh/chunk/tier/wire streams — every pre-spec-v2
+    # seed's fault schedule and workload are untouched). Draws are
+    # UNCONDITIONAL so the stream stays aligned whatever the flavors;
+    # they only apply on speculative episodes. Flavors: the DRAFT-MODEL
+    # proposer (oracle "same" twin for wide acceptance, independently-
+    # seeded "other" twin for rejection pressure), SAMPLED acceptance
+    # (some requests carry temperature>0 — those are audited for
+    # conservation/leaks but NOT token identity, which is a greedy
+    # law), and the accept-rate TUNER (gating decisions under fire
+    # must stay replayable: pure counters, no RNG).
+    rng6 = np.random.RandomState(1210000 + seed)
+    r_draftp = rng6.random()            # < 0.5 -> draft proposer
+    draft_other = rng6.random() < 0.5   # disagreeing vs oracle draft
+    r_sampled = rng6.random()           # < 0.35 -> sampled acceptance
+    r_tune = rng6.random()              # < 0.4 -> tuner on
+    sampled_flags = rng6.random(16) < 0.4   # per-submit-order flags
+    r_arm_draft, t_arm_draft, a_arm_draft = (rng6.random(),
+                                             int(rng6.randint(1, 3)),
+                                             int(rng6.randint(0, 8)))
+    r_arm_res, t_arm_res, a_arm_res = (rng6.random(),
+                                       int(rng6.randint(1, 3)),
+                                       int(rng6.randint(0, 6)))
+    spec_proposer_kind = "ngram"
+    spec_sampled_on = False
+    if speculative:
+        if r_draftp < 0.5:
+            spec_proposer_kind = "draft"
+            spec_kw["spec_proposer"] = "draft"
+            spec_kw["draft_model"] = _draft_serving_model(
+                "other" if draft_other else "same")
+        if r_sampled < 0.35:
+            spec_sampled_on = True
+            spec_kw["spec_sampled"] = True
+        if r_tune < 0.4:
+            spec_kw["spec_tune"] = True
     registry = MetricRegistry()
     eng = ServingEngine(model, max_slots=max_slots, max_len=_MAX_LEN,
                         min_bucket=_MIN_BUCKET,
@@ -458,6 +517,21 @@ def run_serving_episode(seed: int, max_iters: int = 300,
             times=(wire_blip_times if wire_mode < 0.45
                    else wire_fatal_times),
             after=wire_after))
+    # speculation arms, from the rng6 stream that owns the spec-v2
+    # flavor draws (draws above are unconditional; armed only when the
+    # point is reachable): the draft point fires mid-proposal — the
+    # containment law says the row degrades to k=1 THAT step (draft
+    # state unwound, step still succeeds, token identity holds); the
+    # resample point fires between first rejection and the residual
+    # draw — verified tokens already delivered, so the unwind must
+    # roll speculative pages back without double-emitting
+    if speculative and r_arm_draft < 0.55:
+        schedule.append(FaultArm("serving.spec.draft",
+                                 times=t_arm_draft,
+                                 after=a_arm_draft))
+    if speculative and spec_sampled_on and r_arm_res < 0.5:
+        schedule.append(FaultArm("serving.spec.resample",
+                                 times=t_arm_res, after=a_arm_res))
     # shutdown chaos: half the episodes stop serving mid-trace and
     # drain() with the queue and slots still loaded — optionally with
     # one more decode fault armed right before the drain, the
@@ -477,6 +551,22 @@ def run_serving_episode(seed: int, max_iters: int = 300,
 
     violations: List[str] = []
     submitted: List[Tuple[object, int]] = []
+
+    def _submit(pi, mn, dl):
+        # sampled-acceptance episodes mark some requests (by submit
+        # order, flags pre-drawn from rng6) temperature>0 with a
+        # PINNED per-request seed: the run stays replayable, and the
+        # greedy majority keeps the token-identity audit non-vacuous
+        samp = None
+        order = len(submitted)
+        if spec_sampled_on and order < len(sampled_flags) \
+                and sampled_flags[order]:
+            from ..serving.sampling import SamplingParams
+            samp = SamplingParams(temperature=0.8, top_k=8,
+                                  seed=13579 + 1000 * seed + order)
+        submitted.append((eng.submit(pool[pi], max_new_tokens=mn,
+                                     deadline_s=dl, sampling=samp),
+                          pi))
     recoveries = 0
     steps_ok = 0
     i = 0
@@ -495,17 +585,13 @@ def run_serving_episode(seed: int, max_iters: int = 300,
                 # through to drain()
                 while i < len(plan):
                     _, pi, mn, dl = plan[i]
-                    submitted.append(
-                        (eng.submit(pool[pi], max_new_tokens=mn,
-                                    deadline_s=dl), pi))
+                    _submit(pi, mn, dl)
                     i += 1
                 break
             clock["t"] += 1.0
             while i < len(plan) and plan[i][0] <= clock["t"]:
                 _, pi, mn, dl = plan[i]
-                submitted.append(
-                    (eng.submit(pool[pi], max_new_tokens=mn,
-                                deadline_s=dl), pi))
+                _submit(pi, mn, dl)
                 i += 1
             for order, at_iter in cancels:
                 if at_iter == iters and order < len(submitted):
@@ -604,8 +690,13 @@ def _serving_result(seed, violations, schedule, ledger, submitted,
     violations += ledger.violations()
     violations += engine_leak_violations(eng)
     violations += page_leak_violations(eng)
+    # token identity is a GREEDY law: sampled requests (temperature>0,
+    # the sampled-acceptance episodes) draw from their private rng
+    # streams and legitimately diverge from the greedy references —
+    # they stay in the conservation/leak audits above, just not here
     violations += token_prefix_violations(
-        (req, refs[pi]) for req, pi in submitted)
+        (req, refs[pi]) for req, pi in submitted
+        if req.sampling.temperature <= 0)
     return EpisodeResult(
         seed=seed, kind="serving", violations=violations,
         schedule=schedule, fired=fired,
@@ -623,6 +714,13 @@ def _serving_result(seed, violations, schedule, ledger, submitted,
                "spec_accepted_drafts": (
                    eng._spec["accepted_draft_tokens"]
                    if eng.speculative else 0),
+               "spec_proposer": getattr(eng, "spec_proposer", None),
+               "spec_sampled": getattr(eng, "spec_sampled", False),
+               "spec_tuned": getattr(eng, "_tuner", None) is not None,
+               "spec_draft_faults": (eng._spec["draft_faults"]
+                                     if eng.speculative else 0),
+               "spec_resamples": (eng._spec["resamples"]
+                                  if eng.speculative else 0),
                "prefill_chunk": eng.prefill_chunk,
                "max_slots": eng.max_slots,
                "num_pages": eng.cache.num_pages,

@@ -96,6 +96,18 @@ KNOWN_POINTS = (
     # unwind must free the pages AND the slot lease and requeue the
     # request (replay re-chunks token-identically)
     "serving.prefill.chunk",
+    # speculative draft proposal (one row, pre-forward): the engine
+    # must contain the failure to THAT row's step — fall back to k=1,
+    # unwind the proposer's per-rid state, never drop the request
+    # (the conservation ledger catches the pre-fix request-fatal
+    # shape; see _on_draft_fault)
+    "serving.spec.draft",
+    # sampled-acceptance resampling: first draft rejection, residual
+    # distribution about to be sampled — tokens already accepted this
+    # step stay appended, the retried step continues from the
+    # advanced position (exactly-once delivery, page debt repaid by
+    # the emission-loop rollback arm)
+    "serving.spec.resample",
     # disaggregated prefill/decode: the KV span is computed on the
     # prefill group but NOT yet installed on the decode pool — the
     # abort path must unwind the half-handed-off request on BOTH

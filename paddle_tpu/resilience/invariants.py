@@ -285,12 +285,21 @@ def engine_leak_violations(engine) -> List[str]:
     if getattr(engine, "speculative", False):
         live = {engine.cache.slots[s].rid
                 for s in engine.cache.active_slots()}
-        stale = [rid for rid in engine.proposer.tracked()
-                 if rid not in live]
-        if stale:
-            out.append(
-                f"leaked draft-proposer state for rids {stale} "
-                f"(request gone, n-gram index still held)")
+        # EVERY configured proposer is audited, not just the active
+        # one: the tuner may have routed requests through either, and
+        # the draft proposer additionally leases KV-pool slots whose
+        # leak this catches (free_slots exhaustion = silent k=1
+        # degrade, invisible to token identity)
+        props = getattr(engine, "_proposers", None) \
+            or {"ngram": engine.proposer}
+        for kind in sorted(props):
+            stale = [rid for rid in props[kind].tracked()
+                     if rid not in live]
+            if stale:
+                out.append(
+                    f"leaked {kind} draft-proposer state for rids "
+                    f"{stale} (request gone, proposer state still "
+                    f"held)")
     # chunked-prefill half of the law: a quiesced engine may hold no
     # PREFILLING work — the chunk FIFO must be empty (every chunked
     # admission either finished its final chunk or was unwound) and no

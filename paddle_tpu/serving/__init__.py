@@ -60,13 +60,15 @@ from .sampling import SamplingParams, sample_token  # noqa: F401
 from .scheduler import (FIFOScheduler, Request, bucket_for,  # noqa: F401
                         prefill_buckets)
 from .slot_cache import PagedKVCache, SlotKVCache  # noqa: F401
-from .spec_decode import NgramProposer  # noqa: F401
+from .spec_decode import (DraftModelProposer,  # noqa: F401
+                          NgramProposer)
+from .spec_tune import SpecTuner  # noqa: F401
 
 __all__ = ["ServingEngine", "EngineMetrics", "MeshContext",
            "SamplingParams",
            "sample_token", "FIFOScheduler", "Request", "bucket_for",
            "prefill_buckets", "SlotKVCache", "PagedKVCache",
-           "NgramProposer",
+           "NgramProposer", "DraftModelProposer", "SpecTuner",
            "ServingError",
            "QueueFull", "DeadlineExceeded", "EngineBroken",
            "EngineIdle", "EngineClosed", "RequestCancelled",

@@ -62,16 +62,17 @@ import numpy as np
 
 from ..framework.tensor import Tensor
 from ..observability import default_recorder, default_registry, span
-from ..resilience.faults import maybe_fail
+from ..resilience.faults import InjectedFault, maybe_fail
 from .errors import (DeadlineExceeded, EngineBroken, EngineClosed,
                      EngineIdle, QueueFull, RequestCancelled)
 from .kv_tier import HostPageTier, PersistentPrefixStore
 from .mesh import MeshContext
 from .metrics import EngineMetrics
-from .sampling import SamplingParams, sample_token
+from .sampling import SamplingParams, sample_token, sampling_dist
 from .scheduler import FIFOScheduler, Request, bucket_for
 from .slot_cache import PagedKVCache, SlotKVCache
-from .spec_decode import NgramProposer
+from .spec_decode import DraftModelProposer, NgramProposer
+from .spec_tune import SpecTuner
 
 __all__ = ["ServingEngine"]
 
@@ -144,6 +145,10 @@ class ServingEngine:
                  spec_k: int = 4,
                  spec_ngram: int = 2,
                  spec_gate: bool = True,
+                 spec_proposer: str = "ngram",
+                 draft_model=None,
+                 spec_sampled: bool = False,
+                 spec_tune: bool = False,
                  mesh=None,
                  prefill_devices: int = 0,
                  prefill_chunk: Optional[int] = None,
@@ -254,27 +259,62 @@ class ServingEngine:
                 "kv_transport requires a disaggregated mesh "
                 "(prefill_devices > 0): only the prefill->decode "
                 "handoff crosses the wire")
-        # self-speculative decoding: n-gram drafts verified k tokens
-        # per weight pass through ONE widened verify program (greedy
-        # rows only; everything else falls back to k=1 IN the same
-        # program). See docs/SERVING.md "Speculative decoding".
+        # speculative decoding: drafts (n-gram lookup or a small draft
+        # MODEL) verified k tokens per weight pass through ONE widened
+        # verify program; greedy rows keep the bitwise identity law,
+        # sampled rows opt into rejection-sampling acceptance via
+        # spec_sampled=True, and spec_tune=True closes the loop from
+        # the accepted-length EWMA back to per-step (k, proposer)
+        # choices. See docs/SERVING.md "Speculative decoding".
         self.speculative = bool(speculative)
         if self.speculative:
             if spec_k < 2:
                 raise ValueError(
                     f"spec_k must be >= 2 (k includes the k=1 base "
                     f"token), got {spec_k}")
+            if spec_proposer not in ("ngram", "draft"):
+                raise ValueError(
+                    f"spec_proposer must be 'ngram' or 'draft', got "
+                    f"{spec_proposer!r}")
+            if spec_proposer == "draft" and draft_model is None:
+                raise ValueError(
+                    "spec_proposer='draft' requires draft_model=")
             self.spec_k = int(spec_k)
-            self.proposer = NgramProposer(ngram=spec_ngram,
-                                          max_draft=self.spec_k - 1)
+            # every configured proposer lives for the engine's
+            # lifetime (the tuner switches between them per step) and
+            # is admitted/evicted/recovered in lockstep via
+            # _proposer_release/_proposer_retain
+            self._proposers = {
+                "ngram": NgramProposer(ngram=spec_ngram,
+                                       max_draft=self.spec_k - 1)}
+            if draft_model is not None:
+                self._proposers["draft"] = DraftModelProposer(
+                    draft_model, max_slots=self.max_slots,
+                    max_len=self.max_len,
+                    max_draft=self.spec_k - 1)
+            self.spec_proposer = spec_proposer
+            self.proposer = self._proposers[spec_proposer]
+            self.spec_sampled = bool(spec_sampled)
             # skip the k-wide verify program on steps where NO row has
             # a draft (all wlen == 1): the k=1 decode program emits the
             # provably identical token at 1/k the verify compute.
             # Trace counts stay bounded: <= 1 decode + <= 1 verify.
             self.spec_gate = bool(spec_gate)
-        elif spec_k != 4 or spec_ngram != 2 or spec_gate is not True:
+            # tuner starts optimistic on the CONFIGURED proposer and
+            # probes the others round-robin once traffic stops paying
+            self._tuner = SpecTuner(
+                k_max=self.spec_k,
+                proposers=tuple(
+                    [self.spec_proposer]
+                    + [k for k in self._proposers
+                       if k != self.spec_proposer])) \
+                if spec_tune else None
+        elif spec_k != 4 or spec_ngram != 2 or spec_gate is not True \
+                or spec_proposer != "ngram" or draft_model is not None \
+                or spec_sampled or spec_tune:
             raise ValueError(
-                "spec_k/spec_ngram/spec_gate only apply with "
+                "spec_k/spec_ngram/spec_gate/spec_proposer/"
+                "draft_model/spec_sampled/spec_tune only apply with "
                 "speculative=True")
         # tensor-parallel serving mesh (docs/SERVING.md "Multi-chip
         # serving"): KV pools + shardable params split over the
@@ -390,9 +430,15 @@ class ServingEngine:
         # python-side-effect counters bumped at TRACE time: the compile-
         # count contract (1 decode + O(log max_len) prefill buckets) is
         # asserted against these in tests
-        self.trace_counts = {"decode": 0, "verify": 0, "prefill": {},
+        self.trace_counts = {"decode": 0, "verify": 0, "draft": 0,
+                             "prefill": {},
                              "extend": {}, "copy": 0, "install": {},
                              "chunk": {}, "promote": 0}
+        if self.speculative and "draft" in self._proposers:
+            # the draft proposer's ONE compiled program bumps the
+            # engine's own trace-count ledger, so the compile contract
+            # (1 decode + 1 verify + 1 draft) is asserted in one place
+            self._proposers["draft"].trace_counts = self.trace_counts
         reg = self.registry
         self._m_queue_depth = reg.gauge(
             "ptpu_serving_queue_depth", "requests waiting for a slot")
@@ -477,9 +523,11 @@ class ServingEngine:
             self._m_spec_acc = reg.histogram(
                 "ptpu_serving_spec_accepted_length",
                 "tokens emitted per row per verify step (1 = k=1 "
-                "fallback or fully rejected draft)",
+                "fallback or fully rejected draft), by the proposer "
+                "that drafted the row ('none' = undrafted)",
                 buckets=tuple(float(i) for i in
-                              range(1, self.spec_k + 1)))
+                              range(1, self.spec_k + 1)),
+                labels=("proposer",))
             self._m_spec_draft = reg.counter(
                 "ptpu_serving_spec_draft_tokens_total",
                 "draft tokens proposed to the verify program")
@@ -489,12 +537,24 @@ class ServingEngine:
             self._m_spec_hit = reg.gauge(
                 "ptpu_serving_spec_draft_hit_rate",
                 "cumulative accepted/proposed draft-token ratio")
+            self._m_spec_proposer = reg.counter(
+                "ptpu_spec_proposer_total",
+                "rows drafted per verify step, by proposer kind",
+                labels=("kind",))
+            if self._tuner is not None:
+                self._m_spec_tuner_k = reg.gauge(
+                    "ptpu_spec_tuner_k",
+                    "spec window k the autotuner is running per "
+                    "request class (1 = speculation off)",
+                    labels=("klass",))
             # host-side aggregate: the SPEC_DECODE bench line and
             # spec_stats() read this (registry histograms only keep
             # bucketized counts)
             self._spec = {"steps": 0, "gated_steps": 0, "rows": 0,
                           "emitted": 0,
                           "draft_tokens": 0, "accepted_draft_tokens": 0,
+                          "draft_faults": 0, "resamples": 0,
+                          "draft_s": 0.0,
                           "acc_len_hist": [0] * (self.spec_k + 1)}
 
     def _new_cache(self):
@@ -620,12 +680,30 @@ class ServingEngine:
         s = dict(self._spec)
         s["acc_len_hist"] = list(s["acc_len_hist"])
         s["k"] = self.spec_k
+        s["proposer"] = self.spec_proposer
+        s["sampled"] = self.spec_sampled
         s["draft_hit_rate"] = (
             s["accepted_draft_tokens"] / s["draft_tokens"]
             if s["draft_tokens"] else 0.0)
         s["accepted_per_step"] = (
             s["emitted"] / s["rows"] if s["rows"] else 0.0)
+        if self._tuner is not None:
+            s["tuner"] = self._tuner.snapshot()
         return s
+
+    def _proposer_release(self, rid: int) -> None:
+        """Release one rid's draft state from EVERY configured
+        proposer (the tuner may have moved a request between kinds
+        mid-flight; all of them hold lockstep-evicted state)."""
+        if self.speculative:
+            for p in self._proposers.values():
+                p.release(rid)
+
+    def _proposer_retain(self, rids) -> None:
+        if self.speculative:
+            keep = list(rids)
+            for p in self._proposers.values():
+                p.retain(keep)
 
     def paged_stats(self) -> dict:
         """Paged-pool snapshot for benchmarks/dashboards (raises on a
@@ -1025,46 +1103,95 @@ class ServingEngine:
 
     def _decode_verify(self, active, finished: List[Request]) -> None:
         """One speculative verify step: draft up to k-1 tokens per
-        greedy row from its own history (n-gram prompt lookup), score
-        all k candidate positions in ONE widened forward over the
-        static cache, and emit the longest accepted prefix — provably
-        the tokens sequential greedy decode would have produced, since
-        each position's logits are computed under the identical causal
-        mask and cache state (see docs/SERVING.md).
+        eligible row (n-gram prompt lookup or the small draft model,
+        per the configured/tuned proposer), score all k candidate
+        positions in ONE widened forward over the static cache, and
+        emit the accepted prefix — for greedy rows provably the tokens
+        sequential greedy decode would have produced, since each
+        position's logits are computed under the identical causal mask
+        and cache state; for sampled rows (spec_sampled=True) the
+        rejection-sampling rule in ``_emit_verified``, which preserves
+        the k=1 sampling distribution exactly (see docs/SERVING.md).
 
-        Rows without a usable draft (no n-gram hit, sampled decoding,
-        or 1 token of budget left) run at per-row length 1 INSIDE the
-        same program — the k=1 fallback costs no extra compile.
-        wlen write-masks the PADDED lanes beyond each row's draft
-        window; drafted-but-rejected tokens DO write k/v, which is
-        safe because those positions sit beyond the new write position
-        (causal-masked until overwritten, exactly like any stale
-        tail) and are never shared/indexed — so the only rollback
-        needed is returning over-allocated pages."""
+        Rows without a usable draft (no n-gram hit, sampled decoding
+        without spec_sampled, tuner says off, or 1 token of budget
+        left) run at per-row length 1 INSIDE the same program — the
+        k=1 fallback costs no extra compile. wlen write-masks the
+        PADDED lanes beyond each row's draft window; drafted-but-
+        rejected tokens DO write k/v, which is safe because those
+        positions sit beyond the new write position (causal-masked
+        until overwritten, exactly like any stale tail) and are never
+        shared/indexed — so the only rollback needed is returning
+        over-allocated pages.
+
+        A draft proposal that FAILS (fault point ``serving.spec.draft``
+        or a real draft-model error) is contained to that row's step:
+        the row falls back to k=1, the proposer's state for the rid is
+        unwound (``_on_draft_fault``), and the step proceeds — a draft
+        model must never be able to take down target decoding."""
         K = self.spec_k
         toks = np.zeros((self.max_slots, K), np.int64)
         pos = np.zeros((self.max_slots,), np.int32)
         wlen = np.zeros((self.max_slots,), np.int32)
         mask = np.zeros((self.max_slots,), bool)
+        row_kind = {}          # slot -> proposer kind that DRAFTED
+        row_draft = {}         # slot -> draft tokens (sampled rows)
+        row_qs = {}            # slot -> per-draft q dists ([] = point mass)
+        attempted = {}         # slot -> (klass, kind) fed to the tuner
         for s in active:
             req = self.cache.slots[s]
             toks[s, 0] = req.out_tokens[-1]
             pos[s] = req.next_pos
             mask[s] = True
             n = 1
+            sampled = req.sampling.temperature > 0
+            klass = "sampled" if sampled else "greedy"
+            kind = self.spec_proposer
+            k_cap = K
+            if self._tuner is not None:
+                k_cap, kind = self._tuner.decide(klass)
             # a draft longer than the remaining token budget is wasted
             # verify compute AND would write past the admission
             # reservation — clamp so every write stays inside the
             # request's reserved span
             budget = req.max_new_tokens - len(req.out_tokens)
-            if budget > 1 and req.sampling.temperature <= 0:
-                draft = self.proposer.propose(
-                    req.rid, req.full_ids, min(K - 1, budget - 1))
+            want = min(K - 1, budget - 1, k_cap - 1)
+            if want > 0 and kind is not None \
+                    and (not sampled or self.spec_sampled):
+                prop = self._proposers[kind]
+                attempted[s] = (klass, kind)
+                draft, qs = (), []
+                t0 = self.metrics.now()
+                try:
+                    maybe_fail("serving.spec.draft",
+                               step=self._step_idx - 1, slot=s)
+                    if sampled \
+                            and isinstance(prop, DraftModelProposer):
+                        draft, qs = prop.propose_sampled(
+                            req.rid, req.full_ids, want,
+                            req.sampling, req._rng)
+                    else:
+                        # point-mass proposal: q is a delta on the
+                        # drafted token (qs=[] signals this to the
+                        # acceptance rule)
+                        draft = prop.propose(
+                            req.rid, req.full_ids, want)
+                except Exception as exc:
+                    draft, qs = (), []
+                    self._on_draft_fault(s, req, prop, exc)
+                finally:
+                    dt = self.metrics.now() - t0
+                    self._spec["draft_s"] += dt
+                    self.metrics.on_draft(dt)
                 if len(draft):
                     toks[s, 1:1 + len(draft)] = draft
                     n = 1 + len(draft)
+                    row_kind[s] = kind
+                    if sampled:
+                        row_draft[s], row_qs[s] = draft, qs
                     self._spec["draft_tokens"] += len(draft)
                     self._m_spec_draft.inc(len(draft))
+                    self._m_spec_proposer.labels(kind=kind).inc()
             wlen[s] = n
         if self.spec_gate and all(int(wlen[s]) == 1 for s in active):
             # no row drafted this step: every lane would run the
@@ -1089,7 +1216,11 @@ class ServingEngine:
             self._spec["emitted"] += n_rows
             self._spec["acc_len_hist"][1] += n_rows
             for _ in range(n_rows):
-                self._m_spec_acc.observe(1.0)
+                self._m_spec_acc.labels(proposer="none").observe(1.0)
+            # rows that TRIED to draft and came back empty are signal
+            # the tuner must see (accepted length 1), else an always-
+            # missing proposer never reads as "not paying"
+            self._tuner_step(attempted, {s: 1 for s in attempted})
             return
         copies = []
         try:
@@ -1149,44 +1280,131 @@ class ServingEngine:
                         self.cache.rollback_speculation(
                             s, req.next_pos)
             raise
-        for s in active:
-            req = self.cache.slots[s]
-            emitted = self._emit_verified(s, req, greedy[s],
-                                          int(acc[s]), logits[s])
-            self._spec["rows"] += 1
-            self._spec["emitted"] += emitted
-            self._spec["accepted_draft_tokens"] += emitted - 1
-            self._spec["acc_len_hist"][min(emitted, K)] += 1
-            self._m_spec_acc.observe(float(emitted))
-            if emitted > 1:
-                self._m_spec_accepted.inc(emitted - 1)
-            if self.paged and not req.finished:
-                # return pages past the next write position that only
-                # rejected draft tokens touched (finished rows release
-                # everything below)
-                self.cache.rollback_speculation(s, req.next_pos)
-            if req.finished:
-                self._evict(s, req, finished)
+        emitted_by_slot = {}
+        try:
+            for s in active:
+                req = self.cache.slots[s]
+                emitted = self._emit_verified(
+                    s, req, greedy[s], int(acc[s]), logits[s],
+                    draft=row_draft.get(s), qs=row_qs.get(s))
+                emitted_by_slot[s] = emitted
+                self._spec["rows"] += 1
+                self._spec["emitted"] += emitted
+                self._spec["accepted_draft_tokens"] += emitted - 1
+                self._spec["acc_len_hist"][min(emitted, K)] += 1
+                self._m_spec_acc.labels(
+                    proposer=row_kind.get(s, "none")).observe(
+                        float(emitted))
+                if emitted > 1:
+                    self._m_spec_accepted.inc(emitted - 1)
+                if self.paged and not req.finished:
+                    # return pages past the next write position that
+                    # only rejected draft tokens touched (finished
+                    # rows release everything below)
+                    self.cache.rollback_speculation(s, req.next_pos)
+                if req.finished:
+                    self._evict(s, req, finished)
+        except Exception:
+            # a fault mid-emission (serving.spec.resample) leaves rows
+            # not yet emitted this pass with over-claimed pages — the
+            # same debt the pre-verify except arm pays. Tokens already
+            # appended stay appended (out_tokens only ever grows; the
+            # retried step continues from the advanced next_pos).
+            if self.paged:
+                for s in active:
+                    req = self.cache.slots[s]
+                    if req is not None and not req.finished:
+                        self.cache.rollback_speculation(
+                            s, req.next_pos)
+            raise
         self._spec["steps"] += 1
         if self._spec["draft_tokens"]:
             self._m_spec_hit.set(self._spec["accepted_draft_tokens"]
                                  / self._spec["draft_tokens"])
+        # feed the tuner every ATTEMPTED row's accepted length (an
+        # empty draft reads as 1: speculation didn't pay on that row)
+        self._tuner_step(attempted,
+                         {s: emitted_by_slot.get(s, 1)
+                          for s in attempted})
 
     def _emit_verified(self, slot: int, req: Request,
                        greedy_row: np.ndarray, acc: int,
-                       logits_row: np.ndarray) -> int:
-        """Apply one row's verify result: append the accepted tokens
-        (greedy rows: the first ``acc`` in-program argmax tokens,
-        stopping AT an EOS exactly like sequential decode; sampled
-        rows: one host-sampled token from position 0). Returns how
-        many tokens were emitted. Factored out so the chaos pinned-red
+                       logits_row: np.ndarray, draft=None,
+                       qs=None) -> int:
+        """Apply one row's verify result: append the accepted tokens.
+        Greedy rows: the first ``acc`` in-program argmax tokens,
+        stopping AT an EOS exactly like sequential decode (the bitwise
+        token-identity law). Undrafted sampled rows: one host-sampled
+        token from position 0 — bit-identical to the k=1 path, same
+        per-request RNG stream. Drafted sampled rows
+        (``spec_sampled=True``): speculative REJECTION SAMPLING —
+        draft j is accepted with probability min(1, p_j(t)/q_j(t))
+        where p_j = sampling_dist(logits[j]) is the target
+        distribution at that position and q_j the draft's (a point
+        mass for n-gram drafts, ``qs[j]`` for the draft model, which
+        DREW the token from exactly that q); on the first rejection
+        ONE token is resampled from the normalized residual
+        max(p - q, 0) and the rest of the draft is discarded; if every
+        draft survives, a bonus token is sampled from the position
+        AFTER the draft. By the standard speculative-sampling
+        argument (Leviathan et al.) each emitted token is distributed
+        EXACTLY as sequential sampling from p — the distribution-
+        parity law the seed-band harness checks. Returns how many
+        tokens were emitted. Factored out so the chaos pinned-red
         test can swap in a deliberately broken acceptance."""
         if req.sampling.temperature > 0:
-            tok = sample_token(logits_row[0], req.sampling, req._rng)
+            sp, rng = req.sampling, req._rng
+            if draft is None or len(draft) == 0:
+                tok = sample_token(logits_row[0], sp, rng)
+                req.out_tokens.append(tok)
+                self.metrics.on_token(req.rid)
+                self._is_finished(req, tok)
+                return 1
+            emitted = 0
+            for j in range(len(draft)):
+                t = int(draft[j])
+                p = sampling_dist(logits_row[j], sp)
+                pt = float(p[t])
+                qt = float(qs[j][t]) if qs else 1.0
+                if qt > 0.0 and pt > 0.0 \
+                        and float(rng.uniform()) < min(1.0, pt / qt):
+                    req.out_tokens.append(t)
+                    self.metrics.on_token(req.rid)
+                    emitted += 1
+                    if self._is_finished(req, t):
+                        return emitted
+                    continue
+                # first rejection: emit ONE corrective token from the
+                # residual — conditioned on rejecting q's token, the
+                # residual is exactly what sequential sampling from p
+                # has left (fault-point-guarded: a crash here must
+                # neither lose nor duplicate tokens)
+                maybe_fail("serving.spec.resample",
+                           step=self._step_idx - 1, slot=slot)
+                if qs:
+                    res = np.maximum(p - qs[j], 0.0)
+                else:
+                    res = p.copy()
+                    res[t] = 0.0
+                tot = res.sum()
+                # q >= p everywhere means rejection was measure-zero
+                # (float dust): fall back to p itself
+                res = p if tot <= 0.0 else res / tot
+                tok = int(rng.choice(res.size, p=res))
+                req.out_tokens.append(tok)
+                self.metrics.on_token(req.rid)
+                emitted += 1
+                self._spec["resamples"] += 1
+                self._is_finished(req, tok)
+                return emitted
+            # every draft accepted: the verify pass already computed
+            # the next position's logits — the classic free bonus
+            tok = sample_token(logits_row[len(draft)], sp, rng)
             req.out_tokens.append(tok)
             self.metrics.on_token(req.rid)
+            emitted += 1
             self._is_finished(req, tok)
-            return 1
+            return emitted
         emitted = 0
         for j in range(acc):
             tok = int(greedy_row[j])
@@ -1199,6 +1417,37 @@ class ServingEngine:
                 break
         return emitted
 
+    def _on_draft_fault(self, slot: int, req: Request, proposer,
+                        exc: Exception) -> None:
+        """Contain a failed draft proposal to one row of one step: the
+        row falls back to k=1 and the proposer's state for this rid is
+        unwound (next step re-derives it from confirmed history). A
+        REAL draft-model failure may have died with donated pools in
+        flight, so the draft proposer's whole pool is reset — the same
+        poisoned-donation reasoning as ``recover()``, scoped to the
+        draft side. Factored out (like ``_emit_verified``) so the
+        chaos pinned-red test can re-introduce the pre-fix shape
+        (request-fatal draft faults) and prove the conservation ledger
+        catches it."""
+        if isinstance(exc, InjectedFault) \
+                or not isinstance(proposer, DraftModelProposer):
+            proposer.unwind(req.rid)
+        else:
+            proposer.reset()
+        self._spec["draft_faults"] += 1
+
+    def _tuner_step(self, attempted: dict, accepted: dict) -> None:
+        """Feed one verify step's accepted lengths to the autotuner
+        and advance its clock + gauges (no-op without spec_tune)."""
+        if self._tuner is None:
+            return
+        for s, (klass, kind) in attempted.items():
+            self._tuner.observe(klass, kind, accepted.get(s, 1))
+        self._tuner.on_step()
+        snap = self._tuner.snapshot()
+        for klass, st in snap["classes"].items():
+            self._m_spec_tuner_k.labels(klass=klass).set(st["k"])
+
     def _evict(self, slot: int, req: Request,
                finished: List[Request]) -> None:
         # a PREFILLING request can reach a terminal state mid-chunked-
@@ -1210,8 +1459,7 @@ class ServingEngine:
         finished.append(req)
         self._m_evict.labels(reason=req.finish_reason or "unknown").inc()
         self.metrics.on_finished(req.rid)
-        if self.speculative:
-            self.proposer.release(req.rid)
+        self._proposer_release(req.rid)
 
     def _expire_deadlines(self, finished: List[Request]) -> None:
         """Cancel queued and in-flight requests past their deadline
@@ -1314,8 +1562,7 @@ class ServingEngine:
         req.finished, req.finish_reason = True, reason
         req.error = RequestCancelled(req.rid, reason)
         self.metrics.on_finished(req.rid)
-        if self.speculative:
-            self.proposer.release(req.rid)
+        self._proposer_release(req.rid)
         if self.auditor is not None:
             self.auditor.on_delivered(req, via="cancel")
         return True
@@ -1423,8 +1670,9 @@ class ServingEngine:
         if self.speculative:
             # prune draft-proposer state to the requests that survived
             # into the rebuilt slot table (a finished/disconnected
-            # request's index must not outlive it — the no-leak law)
-            self.proposer.retain(
+            # request's index must not outlive it — the no-leak law);
+            # EVERY configured proposer prunes, not just the active one
+            self._proposer_retain(
                 r.rid for r in self.cache.slots if r is not None)
         self._broken = None
         self._m_recover.inc()
@@ -1538,8 +1786,7 @@ class ServingEngine:
             # terminal requests stranded by a failed step with no
             # successful step left to carry them out
             done.extend(self._undelivered)
-        if self.speculative:
-            self.proposer.retain(())       # drained engine holds none
+        self._proposer_retain(())          # drained engine holds none
         # owe the whole return until it happens: if the auditor raises
         # here, a re-issued drain() flushes the debt to the caller
         self._undelivered = done

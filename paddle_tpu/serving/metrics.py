@@ -64,6 +64,7 @@ class EngineMetrics:
         self._qwait: deque = deque(maxlen=window)
         self._gaps: deque = deque(maxlen=window)
         self._promo: deque = deque(maxlen=window)
+        self._draft: deque = deque(maxlen=window)
         self._t0: Optional[float] = None
         self._t_last: Optional[float] = None
         if registry is None:
@@ -91,6 +92,11 @@ class EngineMetrics:
             "ptpu_kv_promotion_wait_seconds",
             "host/disk -> device KV page promotion wall time per "
             "request (tier fetch + H2D + install dispatches)")
+        self._m_draft = registry.histogram(
+            "ptpu_serving_spec_draft_seconds",
+            "wall time spent proposing one row's speculative draft "
+            "(the spec_draft SLO phase: n-gram lookup or draft-model "
+            "forwards, billed separately from verify compute)")
 
     # -- event hooks (engine calls these) ------------------------------
     def on_submit(self, rid: int, stalled: bool = False) -> None:
@@ -153,6 +159,15 @@ class EngineMetrics:
         self._promo.append(wait_s)
         self._m_promo.observe(wait_s)
 
+    def on_draft(self, wait_s: float) -> None:
+        """One row's draft proposal completed (or faulted): bill its
+        wall time to the ``spec_draft`` phase. Draft overhead is the
+        denominator of the speculation win — accepted tokens/step is
+        meaningless if the draft model eats the saved verify time —
+        so it gets its own histogram + rolling window."""
+        self._draft.append(wait_s)
+        self._m_draft.observe(wait_s)
+
     def on_step(self, active_slots: int) -> None:
         self._n_steps += 1
         self._occ_sum += active_slots
@@ -176,6 +191,7 @@ class EngineMetrics:
             "queue_wait": tuple(self._qwait),
             "inter_token": tuple(self._gaps),
             "promotion_wait": tuple(self._promo),
+            "spec_draft": tuple(self._draft),
             "window": self._window,
         }
 
@@ -208,6 +224,7 @@ class EngineMetrics:
             "tok_latency_p50_s": pct(self._gaps, 50),
             "tok_latency_p99_s": pct(self._gaps, 99),
             "promotion_wait_p99_s": pct(self._promo, 99),
+            "spec_draft_s": float(sum(self._draft)),
             "occupancy_mean": (self._occ_sum / self._n_steps
                                / self.max_slots
                                if self._n_steps else 0.0),

@@ -12,7 +12,7 @@ from typing import Optional
 
 import numpy as np
 
-__all__ = ["SamplingParams", "sample_token"]
+__all__ = ["SamplingParams", "sample_token", "sampling_dist"]
 
 
 @dataclasses.dataclass
@@ -32,11 +32,17 @@ class SamplingParams:
             raise ValueError(f"top_k must be >= 0, got {self.top_k}")
 
 
-def sample_token(logits: np.ndarray, params: SamplingParams,
-                 rng: np.random.RandomState) -> int:
-    """Pick one token id from a [vocab] logits row."""
-    if params.temperature <= 0:
-        return int(np.argmax(logits))
+def sampling_dist(logits: np.ndarray,
+                  params: SamplingParams) -> np.ndarray:
+    """The [vocab] float64 distribution ``sample_token`` draws from.
+
+    Exposed for speculative rejection sampling: acceptance needs the
+    target (and draft) probabilities of the drafted token, and the
+    residual distribution on rejection, under the SAME
+    temperature/top-k transform the plain path uses — anything else
+    breaks the distribution-parity law vs k=1 decoding. Requires
+    temperature > 0 (greedy is a point mass; callers use argmax).
+    """
     z = logits.astype(np.float64) / params.temperature
     if 0 < params.top_k < z.size:
         kth = np.partition(z, -params.top_k)[-params.top_k]
@@ -44,4 +50,13 @@ def sample_token(logits: np.ndarray, params: SamplingParams,
     z -= z.max()
     p = np.exp(z)
     p /= p.sum()
-    return int(rng.choice(z.size, p=p))
+    return p
+
+
+def sample_token(logits: np.ndarray, params: SamplingParams,
+                 rng: np.random.RandomState) -> int:
+    """Pick one token id from a [vocab] logits row."""
+    if params.temperature <= 0:
+        return int(np.argmax(logits))
+    p = sampling_dist(logits, params)
+    return int(rng.choice(p.size, p=p))

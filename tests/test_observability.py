@@ -413,9 +413,11 @@ def test_speculative_metrics_published():
     eng.run()
     st = eng.spec_stats()
     assert st["rows"] > 0 and st["emitted"] >= st["rows"]
-    hist = reg.histogram("ptpu_serving_spec_accepted_length")
-    assert hist.count == st["rows"]
-    assert hist.sum == pytest.approx(st["emitted"])
+    hist = reg.get("ptpu_serving_spec_accepted_length")
+    assert hist.label_names == ("proposer",)   # per-proposer since v19
+    children = hist._sorted_children()
+    assert sum(c.count for c in children) == st["rows"]
+    assert sum(c.sum for c in children) == pytest.approx(st["emitted"])
     assert reg.counter(
         "ptpu_serving_spec_draft_tokens_total").value \
         == st["draft_tokens"]

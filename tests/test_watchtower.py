@@ -598,7 +598,7 @@ def test_snapshot_windows_pins_eviction_bound():
     snap = m.snapshot_windows()
     assert snap["window"] == 8
     assert set(snap) == {"ttft", "queue_wait", "inter_token",
-                         "promotion_wait", "window"}
+                         "promotion_wait", "spec_draft", "window"}
     assert len(snap["ttft"]) == 8            # evicted down to the bound
     assert len(snap["queue_wait"]) == 8
     # recent-biased: the survivors are the LAST 8 waits (1.2 .. 1.9)
