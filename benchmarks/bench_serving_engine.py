@@ -1262,6 +1262,172 @@ def run_frontdoor_slo(model, *, n_replicas, slots, max_len, min_bucket,
         raise SystemExit("front-door SLO run lost conservation")
 
 
+def run_control_plane(model, *, slots, max_len, min_bucket, n_req,
+                      max_new, enter_depth, seed=0):
+    """--control-plane: the same open-loop overload burst replayed
+    twice through the front door — control plane OFF, then ON with a
+    priority brownout over three tenant tiers. Everything runs on the
+    virtual clock (one pump = one step), so both replays are
+    deterministic and machine-independent: the CONTROL_PLANE line
+    compares per-tier p99 TTFT in pump-steps between the unshed and
+    shed runs. The conservation ledger is mounted both times — a shed
+    is an audited typed rejection, never a LOST request."""
+    from paddle_tpu.observability import FlightRecorder, MetricRegistry
+    from paddle_tpu.resilience.invariants import ConservationLedger
+    from paddle_tpu.serving import (BrownoutController, ClientStream,
+                                    ControlPlane, FrontDoor,
+                                    ServingEngine, Shed, TenantPolicy)
+
+    rng = np.random.RandomState(seed)
+    lens = [4, 7, 12, 20]
+    tier_of = {"hi": 0, "mid": 1, "lo": 2}
+    tenants_cycle = ("hi", "mid", "lo")
+    # precomputed trace shared by both replays: a front-loaded burst
+    # (~3 arrivals/step, far past the brownout threshold) then a
+    # trickle tail under capacity so the brownout can decay back out
+    trace = []
+    step = 0
+    for i in range(n_req):
+        if i < (2 * n_req) // 3:
+            step += 0 if i % 3 else 1
+        else:
+            step += 2
+        L = int(lens[int(rng.randint(0, len(lens)))])
+        trace.append((float(step), tenants_cycle[i % 3],
+                      rng.randint(1, 100, (L,)).astype(np.int64)))
+
+    def drive(control_on):
+        clock = {"t": 0.0}
+        ledger = ConservationLedger()
+        reg = MetricRegistry()
+        eng = ServingEngine(model, max_slots=slots, max_len=max_len,
+                            min_bucket=min_bucket,
+                            time_fn=lambda: clock["t"],
+                            registry=reg,
+                            flight_recorder=FlightRecorder(capacity=8))
+        control = ControlPlane(
+            brownout=BrownoutController(
+                tiers=3, enter_depth=enter_depth, exit_depth=2.0,
+                dwell=2, retry_hint_s=0.05, registry=reg),
+            registry=reg) if control_on else None
+        front = FrontDoor(
+            eng, auditor=ledger, registry=reg,
+            time_fn=lambda: clock["t"], control=control,
+            tenants={"hi": TenantPolicy(priority=0),
+                     "mid": TenantPolicy(priority=1),
+                     "lo": TenantPolicy(priority=2)})
+
+        class TimedStream(ClientStream):
+            def __init__(self):
+                super().__init__()
+                self.t_first = None
+
+            def write(self, event):
+                if event.get("event") == "token" \
+                        and self.t_first is None:
+                    self.t_first = clock["t"]
+                super().write(event)
+
+        # warm the programs with the clock frozen: compiles are
+        # invisible to the step-denominated TTFT numbers
+        for L in lens:
+            front.submit(np.arange(1, L + 1, dtype=np.int64), 2,
+                         tenant="hi")
+        while front.has_work():
+            front.pump()
+
+        t_submit, streams = {}, {}
+        sheds, sheds_by_tier = 0, {}
+        attempts = {0: 0, 1: 0, 2: 0}
+        level_max, i = 0, 0
+        while i < len(trace) or front.has_work():
+            while i < len(trace) and trace[i][0] <= clock["t"]:
+                _, tenant, p = trace[i]
+                i += 1
+                tr = tier_of[tenant]
+                attempts[tr] += 1
+                st = TimedStream()
+                try:
+                    h = front.submit(p, max_new, tenant=tenant,
+                                     stream=st)
+                except Shed:
+                    sheds += 1
+                    sheds_by_tier[tr] = sheds_by_tier.get(tr, 0) + 1
+                    continue
+                t_submit[h.req.rid] = clock["t"]
+                streams[h.req.rid] = (st, tr)
+            front.pump()
+            clock["t"] += 1.0
+            if control is not None:
+                level_max = max(level_max, control.brownout.level)
+        front.drain()
+
+        ttfts = {0: [], 1: [], 2: []}
+        for rid, (st, tr) in streams.items():
+            if st.t_first is not None:
+                ttfts[tr].append(st.t_first - t_submit[rid])
+        p99 = {str(t): round(float(np.percentile(v, 99)), 2)
+               if v else 0.0 for t, v in ttfts.items()}
+        viol = ledger.violations()
+        return {
+            "completed": sum(len(v) for v in ttfts.values()),
+            "sheds": sheds,
+            "sheds_by_tier": {str(t): n
+                              for t, n in sorted(sheds_by_tier.items())},
+            "attempts_by_tier": {str(t): n
+                                 for t, n in sorted(attempts.items())},
+            "p99_ttft_steps_by_tier": p99,
+            "brownout_level_max": level_max,
+            "lost": sum("LOST" in v for v in viol),
+            "duplicates": sum("DELIVERED" in v for v in viol),
+            "ledger_green": not viol,
+            "violations": viol,
+        }
+
+    unshed = drive(control_on=False)
+    shed = drive(control_on=True)
+    summary = {
+        "requests": n_req,
+        "tiers": 3,
+        "completed_unshed": unshed["completed"],
+        "completed_shed": shed["completed"],
+        "sheds": shed["sheds"],
+        "sheds_by_tier": shed["sheds_by_tier"],
+        "tier0_sheds": shed["sheds_by_tier"].get("0", 0),
+        "attempts_by_tier": shed["attempts_by_tier"],
+        "p99_ttft_steps_by_tier_unshed":
+            unshed["p99_ttft_steps_by_tier"],
+        "p99_ttft_steps_by_tier_shed": shed["p99_ttft_steps_by_tier"],
+        "brownout_level_max": shed["brownout_level_max"],
+        "lost": unshed["lost"] + shed["lost"],
+        "duplicates": unshed["duplicates"] + shed["duplicates"],
+        "ledger_green": bool(unshed["ledger_green"]
+                             and shed["ledger_green"]),
+    }
+    p99_hi_on = shed["p99_ttft_steps_by_tier"]["0"]
+    p99_hi_off = unshed["p99_ttft_steps_by_tier"]["0"]
+    print(json.dumps({
+        "metric": (
+            f"control-plane brownout on an overload burst ({n_req} "
+            f"reqs over 3 tiers, {slots} slots): shed run dropped "
+            f"{shed['sheds']} low-tier requests (tier-0: "
+            f"{summary['tier0_sheds']}) at brownout level "
+            f"{shed['brownout_level_max']}, tier-0 p99 TTFT "
+            f"{p99_hi_on} pump-steps vs {p99_hi_off} unshed, "
+            f"exactly-once ledger "
+            f"{'GREEN' if summary['ledger_green'] else 'RED'}; "
+            f"baseline=unshed tier-0 p99)"),
+        "value": float(p99_hi_on),
+        "unit": "steps",
+        "vs_baseline": float(p99_hi_off)}))
+    print("CONTROL_PLANE " + json.dumps(summary))
+    for run in (unshed, shed):
+        for v in run["violations"]:
+            print("  - " + v, file=sys.stderr)
+    if not summary["ledger_green"]:
+        raise SystemExit("control-plane run lost conservation")
+
+
 def run_cluster_slo(cfg_kwargs, *, n_workers, slots, max_len,
                     min_bucket, n_clients, total_requests, max_new,
                     seed=0):
@@ -1905,6 +2071,17 @@ def main():
                               max_len=64, min_bucket=8,
                               n_clients=10, total_requests=36,
                               max_new=6)
+        return
+
+    if "--control-plane" in sys.argv:
+        if on_tpu:
+            run_control_plane(model, slots=16, max_len=512,
+                              min_bucket=32, n_req=96, max_new=32,
+                              enter_depth=24.0)
+        else:
+            run_control_plane(model, slots=4, max_len=64,
+                              min_bucket=8, n_req=36, max_new=6,
+                              enter_depth=8.0)
         return
 
     rng = np.random.RandomState(0)

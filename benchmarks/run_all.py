@@ -41,6 +41,9 @@ SCRIPTS = ["bench_resnet50.py", "bench_bert_dp.py", "bench_gpt_hybrid.py",
            # front-door closed-loop SLO (replica killed mid-run,
            # exactly-once ledger at the boundary)
            "bench_serving_engine.py --frontdoor",
+           # control plane: priority brownout on an overload burst —
+           # shed vs unshed per-tier p99 TTFT, zero LOST either way
+           "bench_serving_engine.py --control-plane",
            # tensor-parallel + disaggregated serving on the emulated
            # mesh (token identity + compile-once per mesh shape)
            "bench_serving_engine.py --tensor-parallel",

@@ -386,6 +386,7 @@ class Watchtower:
         self._engine = None
         self._metrics = None
         self._recorder = None
+        self._control = None
         # anomaly streams: name -> (phase, ewma, robust)
         self._anomaly_on = bool(anomaly_streams)
         mk = lambda: (EwmaDetector(alpha=ewma_alpha, k=ewma_k,
@@ -427,6 +428,14 @@ class Watchtower:
         self._recorder = recorder
         return self
 
+    def attach_control(self, control) -> "Watchtower":
+        """Watch a :class:`serving.control.ControlPlane`: its snapshot
+        rides ``to_json()`` (the doctor's control line) and the
+        ``controller_flapping`` detector audits every dwell-gated
+        controller against its own gate."""
+        self._control = control
+        return self
+
     # -- hot path ------------------------------------------------------
     def observe_step(self) -> None:
         """Called from the engine step hot path: ONE counter
@@ -457,6 +466,7 @@ class Watchtower:
         self._eval_orphans(now, new)
         self._eval_deaths(now, view, new)
         self._eval_heartbeats(now, new)
+        self._eval_control(now, new)
         self._primed = True
         return new
 
@@ -710,6 +720,41 @@ class Watchtower:
                             detail={"worker": worker,
                                     "age_s": float(age)})
 
+    def _eval_control(self, now: float, out: List[Incident]) -> None:
+        """``controller_flapping``: every dwell-gated controller can
+        legally transition at most once per dwell (cool-down) period —
+        more means the gate is broken (monkeypatched thresholds, a
+        buggy controller swap) and the data plane is being thrashed."""
+        cp = self._control
+        if cp is None or not self._primed:
+            return
+        try:
+            snap = cp.snapshot()
+        except Exception:
+            return
+        checks = (("brownout", "queue", "flips", "dwell"),
+                  ("chunk", "prefill", "adaptations", "dwell"),
+                  ("autoscale", "failover", "actions", "cooldown"))
+        for name, phase, n_key, gate_key in checks:
+            st = snap.get(name)
+            if not st:
+                continue
+            step = int(st.get("step", 0))
+            gate = max(1, int(st.get(gate_key, 1)))
+            n = int(st.get(n_key, 0))
+            ceiling = step // gate + 1
+            if step > 0 and n > ceiling:
+                self._raise(
+                    out, kind="controller_flapping", phase=phase,
+                    key=f"controller={name}", now=now,
+                    summary=(f"{name} controller flapping: {n} "
+                             f"transitions in {step} steps exceeds "
+                             f"its own gate ({gate}-step dwell "
+                             f"allows {ceiling})"),
+                    detail={"controller": name, "transitions": n,
+                            "steps": step, "gate": gate,
+                            "ceiling": ceiling})
+
     # -- incident plumbing ---------------------------------------------
     def _raise(self, out: List[Incident], *, kind: str, phase: str,
                key: str, now: float, summary: str,
@@ -803,6 +848,11 @@ class Watchtower:
                 snap["speculation"] = eng.spec_stats()
             except Exception:
                 pass
+        if self._control is not None:
+            try:
+                snap["control"] = self._control.snapshot()
+            except Exception:
+                pass
         return snap
 
     def diagnose(self) -> str:
@@ -846,6 +896,28 @@ def render_diagnosis(snap: dict) -> str:
             line += (f", tuner at k={st.get('k')}" if st.get("on")
                      else ", tuner off (k=1)")
         lines.append(line)
+    ctl = snap.get("control")
+    if ctl:
+        parts = []
+        b = ctl.get("brownout")
+        if b:
+            tiers = b.get("sheds_by_tier") or {}
+            shed_s = ",".join(f"t{t}:{n}"
+                              for t, n in sorted(tiers.items())) \
+                or "none"
+            parts.append(f"brownout L{b.get('level', 0)} "
+                         f"sheds {shed_s}")
+        c = ctl.get("chunk")
+        if c:
+            parts.append(f"chunk x{c.get('mult', 1)}")
+        a = ctl.get("autoscale")
+        if a:
+            la = a.get("last_action")
+            last = f"{la[0]}@{la[1]}" if la else "none"
+            parts.append(f"replicas {a.get('replicas', 0)} "
+                         f"last-scale {last}")
+        if parts:
+            lines.append("  control: " + "; ".join(parts))
     for inc in incs:
         phase = inc.get("phase", "?")
         verdict = _VERDICT.get(phase, f"{phase}-bound")

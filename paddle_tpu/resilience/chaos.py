@@ -58,7 +58,7 @@ from .invariants import (ConservationLedger, checkpoint_monotonic_violations,
 
 __all__ = ["FaultArm", "EpisodeResult", "ChaosStore",
            "SERVING_SWEEP", "TRAINING_SWEEP", "FRONTDOOR_SWEEP",
-           "CLUSTER_SWEEP",
+           "CLUSTER_SWEEP", "CONTROL_SWEEP",
            "run_serving_episode", "run_training_episode",
            "run_frontdoor_episode", "run_cluster_episode",
            "run_episode"]
@@ -95,6 +95,16 @@ TRAINING_SWEEP = ("train.step", "io.dataloader.worker",
 CLUSTER_SWEEP = ("cluster.rpc.send", "cluster.rpc.recv",
                  "cluster.rpc.auth", "cluster.kv.wire",
                  "cluster.weights.fetch")
+# control-plane actuator points (serving/control.py). Ownership:
+# frontdoor episodes arm shed/affinity/scale (the controllers live on
+# the front door + router there), serving episodes arm chunk (the
+# budget controller lives on the engine). A fired control arm is
+# CONTAINED by the Actuator — the one actuation is suppressed, the
+# data plane keeps its last setting, admission fails open — so these
+# arms certify that a sick control plane degrades the SLO, never the
+# conservation laws.
+CONTROL_SWEEP = ("control.shed", "control.chunk",
+                 "control.affinity", "control.scale")
 
 
 @dataclasses.dataclass
@@ -397,7 +407,35 @@ def run_serving_episode(seed: int, max_iters: int = 300,
             spec_kw["spec_sampled"] = True
         if r_tune < 0.4:
             spec_kw["spec_tune"] = True
+    # adaptive chunk budget, drawn from a SEVENTH rng stream (same
+    # bit-identity reasoning as streams 2-6: every pre-control seed's
+    # fault schedule and workload stay untouched). Draws are
+    # UNCONDITIONAL so the stream stays aligned; the controller only
+    # applies on chunked engines. Control-on episodes also append an
+    # admission BURST (drawn here) so the queue-depth signal really
+    # crosses the raise threshold — without it the adaptation
+    # coverage floor would go green by vacuity.
+    rng7 = np.random.RandomState(1320000 + seed)
+    ctl_draw = rng7.random() < 0.65
+    ctl_raise = float(rng7.randint(2, 5))
+    r_arm_chunk, t_arm_chunk, a_arm_chunk = (rng7.random(),
+                                             int(rng7.randint(1, 3)),
+                                             int(rng7.randint(0, 4)))
+    ctl_burst_t0 = float(rng7.randint(1, 3))
+    n_ctl_burst = int(rng7.randint(4, 8))
+    ctl_burst_dt = rng7.exponential(0.3, 8)
+    ctl_burst_idx = rng7.randint(0, len(pool), 8)
+    ctl_burst_new = rng7.randint(2, 6, 8)
     registry = MetricRegistry()
+    chunk_control = None
+    if ctl_draw and prefill_chunk is not None:
+        from ..serving.control import Actuator, ChunkBudgetController
+        chunk_control = ChunkBudgetController(
+            raise_depth=ctl_raise, lower_depth=0.5, dwell=2,
+            mults=(1, 2, 4),
+            actuator=Actuator(window=8, registry=registry),
+            registry=registry)
+        chunk_kw["chunk_control"] = chunk_control
     eng = ServingEngine(model, max_slots=max_slots, max_len=_MAX_LEN,
                         min_bucket=_MIN_BUCKET,
                         page_size=8, num_pages=num_pages,
@@ -450,6 +488,17 @@ def run_serving_episode(seed: int, max_iters: int = 300,
             tier_plan.append((t_tier, idx, mn, None))
     if tier_kw:
         plan.extend(tier_plan)
+    # control-on chunked episodes splice in the admission burst drawn
+    # from rng7 above (near-simultaneous arrivals early in the trace)
+    # and re-sort by arrival; with the controller off the plan is
+    # byte-for-byte the historical one
+    if chunk_control is not None:
+        tb = ctl_burst_t0
+        for k in range(n_ctl_burst):
+            tb += float(ctl_burst_dt[k])
+            plan.append((tb, int(ctl_burst_idx[k]),
+                         int(ctl_burst_new[k]), None))
+        plan.sort(key=lambda e: e[0])
     cancels = []              # (submit order, loop iteration)
     if rng.random() < 0.4:
         cancels.append((int(rng.randint(0, n_req)),
@@ -487,6 +536,14 @@ def run_serving_episode(seed: int, max_iters: int = 300,
         schedule.append(FaultArm("serving.prefill.chunk",
                                  times=int(rng3.randint(1, 3)),
                                  after=int(rng3.randint(0, 6))))
+    # chunk-budget actuator arm, from the rng7 stream that owns the
+    # controller draw: fires inside the Actuator as the controller
+    # tries to move the budget multiplier — containment means the
+    # budget keeps its last value (fail-static) and the step proceeds
+    if chunk_control is not None and r_arm_chunk < 0.55:
+        schedule.append(FaultArm("control.chunk",
+                                 times=t_arm_chunk,
+                                 after=a_arm_chunk))
     # tier kill arms, from the rng4 stream that owns the tier draw
     # (draws unconditional, armed only when the tier is actually on):
     # demote fires before either tier mutates — the reclaim falls back
@@ -722,6 +779,12 @@ def _serving_result(seed, violations, schedule, ledger, submitted,
                "spec_resamples": (eng._spec["resamples"]
                                   if eng.speculative else 0),
                "prefill_chunk": eng.prefill_chunk,
+               "chunk_ctl": getattr(eng, "chunk_control", None)
+               is not None,
+               "chunk_adaptations": (
+                   eng.chunk_control.adaptations
+                   if getattr(eng, "chunk_control", None) is not None
+                   else 0),
                "max_slots": eng.max_slots,
                "num_pages": eng.cache.num_pages,
                "prefix_hit_tokens": eng.cache.prefix_hit_tokens,
@@ -762,7 +825,7 @@ def run_frontdoor_episode(seed: int, max_iters: int = 300) \
     from ..observability import FlightRecorder, MetricRegistry
     from ..serving import (FrontDoor, ClientStream, ReplicaDead,
                            ReplicaRouter, ServingEngine, ServingError,
-                           TenantPolicy)
+                           Shed, TenantPolicy)
 
     model = _serving_model()
     refs = _reference_outputs()
@@ -796,11 +859,85 @@ def run_frontdoor_episode(seed: int, max_iters: int = 300) \
         tenants["b"] = TenantPolicy(
             rate_qps=float(rng.randint(1, 4)) / 4.0, burst=2,
             max_inflight=int(rng.randint(1, 4)))
+    # self-driving control plane, drawn from a SEVENTH rng stream
+    # (same bit-identity reasoning as the serving streams 2-6: every
+    # pre-control seed's fault schedule and workload stay untouched;
+    # draws are UNCONDITIONAL, applied only when the control draw is
+    # on). Control-on episodes run brownout shedding over priority
+    # tiers, prefix-affinity dispatch and router autoscaling, plus an
+    # OVERLOAD burst of unthrottled tiered traffic so the brownout
+    # really trips — the graceful-degradation law (shed rate is
+    # monotone in tier, tier 0 never shed) is asserted below whenever
+    # anything was shed.
+    rng7 = np.random.RandomState(1320000 + seed)
+    control_on = rng7.random() < 0.6
+    affinity_on = rng7.random() < 0.7
+    autoscale_on = rng7.random() < 0.6
+    enter_depth = float(rng7.randint(3, 6))
+    up_pressure = float(rng7.randint(2, 4))
+    burst_t0 = float(rng7.randint(1, 4))
+    n_burst = int(rng7.randint(8, 13))
+    # leading edge near-simultaneous (trips the brownout), tail spread
+    # over several virtual seconds (lands on a HOT brownout and gets
+    # shed — dwell means the level only rises a couple of pumps after
+    # the front of the burst is already in the queues)
+    burst_dt = rng7.exponential(0.7, 12)
+    burst_dt[:4] = burst_dt[:4] * 0.1
+    burst_idx = rng7.randint(0, len(pool), 12)
+    burst_affin = rng7.random(12) < 0.5   # bias to the radix family
+    burst_new = rng7.randint(2, 6, 12)
+    r_arm_shed, t_arm_shed, a_arm_shed = (rng7.random(),
+                                          int(rng7.randint(1, 3)),
+                                          int(rng7.randint(0, 6)))
+    r_arm_aff, t_arm_aff, a_arm_aff = (rng7.random(),
+                                       int(rng7.randint(1, 3)),
+                                       int(rng7.randint(0, 6)))
+    r_arm_scale, a_arm_scale = (rng7.random(),
+                                int(rng7.randint(0, 2)))
+    control = None
+    if control_on:
+        from ..serving.control import (Actuator, BrownoutController,
+                                       ControlPlane,
+                                       PrefixAffinityPolicy,
+                                       ReplicaAutoscaler)
+        creg = MetricRegistry()
+        act = Actuator(window=8, registry=creg)
+
+        def _spawn_engine():
+            return ServingEngine(
+                model, max_slots=2, max_len=_MAX_LEN,
+                min_bucket=_MIN_BUCKET, page_size=8,
+                num_pages=_MAX_LEN // 8 + 2,
+                time_fn=lambda: clock["t"],
+                registry=MetricRegistry(),
+                flight_recorder=FlightRecorder(capacity=8))
+
+        aff = PrefixAffinityPolicy(min_tokens=8, actuator=act,
+                                   registry=creg) \
+            if affinity_on else None
+        control = ControlPlane(
+            brownout=BrownoutController(
+                tiers=3, enter_depth=enter_depth, exit_depth=1.0,
+                enter_burn=6.0, exit_burn=1.0, dwell=2,
+                registry=creg),
+            affinity=aff,
+            autoscaler=ReplicaAutoscaler(
+                min_replicas=1, max_replicas=n_replicas + 1,
+                up_pressure=up_pressure, down_pressure=0.25,
+                cooldown=5, registry=creg) if autoscale_on else None,
+            actuator=act, spawn_engine=_spawn_engine, registry=creg)
+        router.affinity = aff
+        # the burst tenants carry NO rate limits — acceptance under
+        # overload is decided by the brownout alone, so the per-tier
+        # degradation law is not confounded by tier-blind throttling
+        tenants["hi"] = TenantPolicy(priority=0)
+        tenants["mid"] = TenantPolicy(priority=1)
+        tenants["lo"] = TenantPolicy(priority=2)
     front = FrontDoor(router, auditor=ledger,
                       time_fn=lambda: clock["t"],
                       registry=MetricRegistry(),
                       flight_recorder=FlightRecorder(capacity=8),
-                      tenants=tenants)
+                      tenants=tenants, control=control)
 
     n_req = int(rng.randint(4, 9))
     plan = []      # (arrival_t, pool_idx, max_new, deadline, tenant)
@@ -813,6 +950,20 @@ def run_frontdoor_episode(seed: int, max_iters: int = 300) \
                      float(rng.randint(2, 18))
                      if rng.random() < 0.35 else None,
                      "b" if (tenants and rng.random() < 0.4) else "a"))
+    # control-on episodes splice in the overload burst drawn from rng7
+    # above: near-simultaneous arrivals cycling through the priority
+    # tiers, biased toward the pool[5]/pool[6] shared-radix family so
+    # prefix affinity has something warm to route to; re-sorted by
+    # arrival. With control off the plan is byte-for-byte historical.
+    if control is not None:
+        tb = burst_t0
+        for k in range(n_burst):
+            tb += float(burst_dt[k])
+            pi = (5, 6)[k % 2] if burst_affin[k] \
+                else int(burst_idx[k])
+            plan.append((tb, pi, int(burst_new[k]), None,
+                         ("lo", "hi", "mid", "lo", "hi")[k % 5]))
+        plan.sort(key=lambda e: e[0])
     cancels = []              # (submit order, loop iteration)
     if rng.random() < 0.3:
         cancels.append((int(rng.randint(0, n_req)),
@@ -846,6 +997,24 @@ def run_frontdoor_episode(seed: int, max_iters: int = 300) \
         ("frontdoor.stream_write", 0.4, (1, 3), (0, 10)),
         ("frontdoor.client_disconnect", 0.4, (1, 2), (0, 20)),
     ])
+    # control-plane arms, from the rng7 stream that owns the control
+    # draws (all draws above are unconditional; armed only when the
+    # matching controller is on): shed fires inside the Actuator as
+    # the brownout tries to refuse — containment means admission
+    # FAILS OPEN (the request goes through); affinity/scale fire as
+    # those actuations commit — containment keeps the least-loaded
+    # pick / the current replica set (fail-static)
+    if control is not None:
+        if r_arm_shed < 0.5:
+            schedule.append(FaultArm("control.shed", times=t_arm_shed,
+                                     after=a_arm_shed))
+        if affinity_on and r_arm_aff < 0.5:
+            schedule.append(FaultArm("control.affinity",
+                                     times=t_arm_aff,
+                                     after=a_arm_aff))
+        if autoscale_on and r_arm_scale < 0.5:
+            schedule.append(FaultArm("control.scale", times=1,
+                                     after=a_arm_scale))
     for arm in schedule:
         arm.arm()
     if mid_kill is not None:
@@ -858,14 +1027,23 @@ def run_frontdoor_episode(seed: int, max_iters: int = 300) \
     violations: List[str] = []
     submitted = []            # (handle, pool idx)
     rejected = 0
+    sheds = 0
+    tier_attempts: dict = {}  # tier -> admission attempts
+    tier_accepted: dict = {}  # tier -> accepted (delivery follows)
 
     def _submit(pi, mn, dl, tenant):
-        nonlocal rejected
+        nonlocal rejected, sheds
+        tr = int(tenants[tenant].priority) if tenant in tenants else 0
+        tier_attempts[tr] = tier_attempts.get(tr, 0) + 1
         try:
             submitted.append(
                 (front.submit(pool[pi], mn, tenant=tenant,
                               deadline_s=dl, stream=ClientStream()),
                  pi))
+            tier_accepted[tr] = tier_accepted.get(tr, 0) + 1
+        except Shed:
+            rejected += 1     # audited via on_rejected, like the rest
+            sheds += 1
         except (ServingError, ValueError, faults.InjectedFault):
             rejected += 1     # typed refusal: audited via on_rejected
 
@@ -938,7 +1116,31 @@ def run_frontdoor_episode(seed: int, max_iters: int = 300) \
                 f"{dones[0]['output_ids']}/{dones[0]['finish_reason']}"
                 f" != request {h.req.output_ids}/"
                 f"{h.req.finish_reason}")
+    # graceful-degradation law: whenever the brownout shed ANYTHING,
+    # tier 0 must never have been shed, and the shed RATE must be
+    # monotone non-decreasing in tier number (tier 0 is the most
+    # important) — brownout protects the top of the priority ladder,
+    # whatever the fault weather did to the rest of the episode
+    if control is not None and control.brownout is not None \
+            and control.brownout.sheds > 0:
+        by_tier = control.brownout.sheds_by_tier
+        if by_tier.get(0, 0):
+            violations.append(
+                f"graceful degradation broken: tier 0 was shed "
+                f"{by_tier[0]} times (must be never)")
+        rates = {tr: by_tier.get(tr, 0) / tier_attempts[tr]
+                 for tr in (0, 1, 2) if tier_attempts.get(tr)}
+        for hi_t in (0, 1):
+            for lo_t in range(hi_t + 1, 3):
+                if hi_t in rates and lo_t in rates \
+                        and rates[hi_t] > rates[lo_t] + 1e-9:
+                    violations.append(
+                        f"graceful degradation broken: tier {hi_t} "
+                        f"shed rate {rates[hi_t]:.3f} > tier {lo_t} "
+                        f"rate {rates[lo_t]:.3f}")
     deaths = sum(1 for r in router.replicas if r.state == "dead")
+    brown = control.brownout if control is not None else None
+    asc = control.autoscaler if control is not None else None
     return EpisodeResult(
         seed=seed, kind="frontdoor", violations=violations,
         schedule=schedule, fired=fired,
@@ -949,7 +1151,26 @@ def run_frontdoor_episode(seed: int, max_iters: int = 300) \
                    int(router._m_failover_req.value),
                "kills_scheduled": len(kills),
                "mid_kill": mid_kill.point if mid_kill else None,
-               "attempts": ledger.attempts})
+               "attempts": ledger.attempts,
+               "control_on": control is not None,
+               "sheds": brown.sheds if brown is not None else 0,
+               "sheds_by_tier": dict(brown.sheds_by_tier)
+               if brown is not None else {},
+               "brownout_level": brown.level
+               if brown is not None else 0,
+               "affinity_hits": (control.affinity.hits
+                                 if control is not None
+                                 and control.affinity is not None
+                                 else 0),
+               "scale_actions": asc.actions if asc is not None else 0,
+               "scale_by_dir": dict(asc.actions_by_dir)
+               if asc is not None else {},
+               "replicas_final": sum(
+                   1 for r in router.replicas if r.dispatchable),
+               "tier_attempts": dict(tier_attempts),
+               "tier_accepted": dict(tier_accepted),
+               "actuator_faulted": (control.actuator.faulted
+                                    if control is not None else 0)})
 
 
 # ---------------------------------------------------------------------------

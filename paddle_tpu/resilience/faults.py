@@ -26,6 +26,7 @@ Wired-in points (see docs/RESILIENCE.md for the catalogue):
 ``frontdoor.client_disconnect``  the client-liveness probe
 ``cluster.rpc.send``         socket framing, before a frame is written
 ``cluster.rpc.recv``         socket framing, after a frame header is read
+``control.shed/chunk/affinity/scale``  control-plane actuator, per kind
 ``store.set/get/add/wait``   TCPStore client ops, before the C call
 ``checkpoint.shard_write``   inside the retried per-file shard write
 ``checkpoint.commit``        after shards, BEFORE the metadata flip
@@ -155,6 +156,16 @@ KNOWN_POINTS = (
     # retryable WeightStoreError; the worker retries and NEVER serves
     # silently wrong weights
     "cluster.weights.fetch",
+    # control plane (serving/control.py): every actuation kind in the
+    # shared Actuator threads its own point — a fired fault is
+    # CONTAINED there (the one actuation is suppressed, the data
+    # plane keeps its last applied setting, admission fails open), so
+    # a sick control plane can only ever degrade the SLO, never the
+    # conservation laws
+    "control.shed",
+    "control.chunk",
+    "control.affinity",
+    "control.scale",
     "store.set", "store.get", "store.add", "store.wait",
     "checkpoint.shard_write",
     "checkpoint.commit",

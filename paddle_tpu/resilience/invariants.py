@@ -149,7 +149,8 @@ class ConservationLedger:
                     f"front door saw {self.attempts} attempts but "
                     f"recorded {len(self.submitted)} accepts + "
                     f"{len(self.rejected)} rejects = {outcomes} "
-                    f"outcomes (a request vanished at the boundary)")
+                    f"outcomes (a request LOST — vanished at the "
+                    f"boundary without an audited accept or reject)")
         return out
 
     def check(self) -> None:

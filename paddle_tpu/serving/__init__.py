@@ -46,10 +46,14 @@ a donated-pool step failure, per-request ``deadline_s``, bounded
 from .cluster import (ClusterSupervisor, RemoteEngine,  # noqa: F401
                       RemoteReplica, WorkerHandle)
 from .engine import ServingEngine  # noqa: F401
+from .control import (Actuator, BrownoutController,  # noqa: F401
+                      ChunkBudgetController, ControlPlane,
+                      PrefixAffinityPolicy, ReplicaAutoscaler)
 from .errors import (DeadlineExceeded, EngineBroken,  # noqa: F401
                      EngineClosed, EngineIdle, NoHealthyReplicas,
                      QueueFull, RateLimited, RemoteError, ReplicaDead,
-                     RequestCancelled, ServingError, TenantQueueFull)
+                     RequestCancelled, ServingError, Shed,
+                     TenantQueueFull)
 from .frontdoor import (ClientStream, FrontDoor,  # noqa: F401
                         FrontDoorHandle, FrontDoorHTTPServer,
                         TenantPolicy, TokenBucket)
@@ -73,8 +77,11 @@ __all__ = ["ServingEngine", "EngineMetrics", "MeshContext",
            "QueueFull", "DeadlineExceeded", "EngineBroken",
            "EngineIdle", "EngineClosed", "RequestCancelled",
            "RateLimited", "TenantQueueFull", "ReplicaDead",
-           "NoHealthyReplicas", "RemoteError",
+           "NoHealthyReplicas", "RemoteError", "Shed",
            "ReplicaRouter", "Replica",
+           "Actuator", "BrownoutController", "ChunkBudgetController",
+           "ControlPlane", "PrefixAffinityPolicy",
+           "ReplicaAutoscaler",
            "ClusterSupervisor", "RemoteEngine", "RemoteReplica",
            "WorkerHandle",
            "FrontDoor", "FrontDoorHTTPServer", "FrontDoorHandle",

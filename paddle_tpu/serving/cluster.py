@@ -1076,6 +1076,73 @@ class ClusterSupervisor:
                              worker=slot.wid, replica=new_id,
                              soft=soft)
 
+    # -- autoscaling ----------------------------------------------------
+    def scale_up(self) -> RemoteReplica:
+        """Hot capacity add (the control plane's autoscaler): spawn a
+        fresh worker process, wait for ready + engine reset, register
+        it with the RUNNING router. The new slot is a first-class
+        worker afterwards — polled, reaped, respawnable."""
+        if self._store is None or self.router is None:
+            raise RuntimeError("start() the supervisor first")
+        slot = WorkerHandle(len(self._slots))
+        self._slots.append(slot)
+        self._spawn_process(slot)
+        self._await_ready(slot)
+        if not self._reset_slot(slot):
+            raise RuntimeError(
+                f"cluster worker {slot.wid} spawned for scale-up but "
+                f"failed its engine reset")
+        rep = RemoteReplica(f"s{slot.index}", slot.client, slot)
+        self.router.add_replica(rep)
+        slot.replica = rep
+        slot.reaped = False
+        self._m_alive.labels(worker=slot.slot_label).set(1)
+        self.recorder.record("cluster.worker_scaled_up",
+                             worker=slot.wid, replica=rep.id)
+        return rep
+
+    def scale_down(self, replica_id: Optional[str] = None) \
+            -> Optional[str]:
+        """Shrink by one worker: ``drain_replica`` re-homes its queued
+        work to peers, then the process is shut down once its engine
+        is empty (else it keeps draining and a later call — or
+        ``poll()`` on death — finishes the job). Never drains the last
+        dispatchable worker. Returns the drained replica id or None."""
+        if self.router is None:
+            raise RuntimeError("start() the supervisor first")
+        live = [s for s in self._slots
+                if s.replica is not None and s.replica.dispatchable]
+        if replica_id is None:
+            cands = live
+        else:
+            cands = [s for s in live if s.replica.id == replica_id]
+        if len(live) <= 1 or not cands:
+            return None
+        slot = cands[-1]
+        rid = slot.replica.id
+        self.router.drain_replica(rid)
+        try:
+            drained = not slot.replica.engine.has_work()
+        except Exception:
+            drained = True
+        if drained and slot.alive():
+            try:
+                slot.client.shutdown()
+            except Exception:
+                pass
+            if slot.proc.poll() is None:
+                slot.proc.kill()
+                try:
+                    slot.proc.wait(timeout=10.0)
+                except Exception:
+                    pass
+            slot.reaped = True
+            self._m_alive.labels(worker=slot.slot_label).set(0)
+        self.recorder.record("cluster.worker_scaled_down",
+                             worker=slot.wid, replica=rid,
+                             drained=drained)
+        return rid
+
     # -- teardown -------------------------------------------------------
     def shutdown(self) -> None:
         for slot in self._slots:

@@ -1,0 +1,590 @@
+"""Self-driving control plane: deterministic feedback controllers
+closing the loop from the telemetry plane (queue depth, SLO burn
+rates, the radix prefix index) back into the serving stack.
+
+Four controllers, one shared contract inherited from ``SpecTuner``:
+
+* **No RNG, no clock.** Every decision is a pure function of the
+  metric stream observed so far, so the same stream yields a bitwise
+  identical action sequence — replayable under chaos and in tests.
+* **Hysteresis dead band.** Each controller raises at one threshold
+  and lowers at a strictly-easier one; equal thresholds would chatter
+  on a noisy signal, so constructors reject them.
+* **Dwell gate.** After any transition a controller holds its setting
+  for ``dwell`` steps before reconsidering.  ``flips`` counts
+  transitions; the watchtower's ``controller_flapping`` detector pages
+  when flips outrun what the dwell gate permits.
+* **Rate-limited actuation with a fault point.** Every actuation
+  passes through the shared :class:`Actuator`, which enforces a
+  per-window budget and threads a ``control.*`` fault point.  A fault
+  (or an exhausted budget) suppresses THAT actuation and nothing
+  else: the data plane keeps its last applied setting (fail-static)
+  and admission fails open (the request is served, not shed).
+
+The controllers:
+
+``BrownoutController``  — priority-tier load shedding at the front
+    door, driven by backend queue depth and the TTFT burn rate.  At
+    brownout level L the lowest L tiers are shed with a typed,
+    *audited* ``Shed`` rejection; tier 0 is never shed.
+``ChunkBudgetController`` — per-step prefill token budget as a
+    multiplier of the fixed compiled chunk size (the chunk program is
+    ONE cached jit; the budget varies how many times it runs per
+    step, never its shape).
+``PrefixAffinityPolicy``  — routes a request whose radix prefix is
+    warm on a replica to THAT replica, via the pure read-only
+    ``probe_prefix`` (no LRU touch, no unlink).
+``ReplicaAutoscaler``     — spawn/drain replicas from per-replica
+    queue pressure and TTFT burn, bounded by min/max and a cool-down
+    that only a *committed* action consumes.
+
+``ControlPlane`` bundles them behind the seams the front door,
+router, and engine call.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ..observability import default_registry
+from ..resilience.faults import InjectedFault, maybe_fail
+
+__all__ = [
+    "Actuator",
+    "BrownoutController",
+    "ChunkBudgetController",
+    "PrefixAffinityPolicy",
+    "ReplicaAutoscaler",
+    "ControlPlane",
+]
+
+
+def _ewma(prev: Optional[float], x: float, alpha: float) -> float:
+    return x if prev is None else prev + alpha * (x - prev)
+
+
+class Actuator:
+    """Shared rate limiter + fault seam for every control actuation.
+
+    Deterministic: the window is counted in controller steps (one per
+    front-door pump / engine step), not wall time.  ``allow`` answers
+    whether ONE actuation of ``kind`` may proceed right now; a denial
+    is always safe because every caller fails static (keep the last
+    setting) or open (admit the request).
+    """
+
+    KINDS = ("shed", "chunk", "affinity", "scale")
+    DEFAULT_BUDGETS = {"shed": 64, "chunk": 4, "affinity": 256, "scale": 1}
+
+    def __init__(self, *, window: int = 32,
+                 budgets: Optional[Dict[str, int]] = None,
+                 registry=None):
+        if window < 1:
+            raise ValueError(f"window must be >= 1, got {window}")
+        self.window = int(window)
+        self.budgets = dict(self.DEFAULT_BUDGETS)
+        if budgets:
+            for k, v in budgets.items():
+                if k not in self.DEFAULT_BUDGETS:
+                    raise ValueError(f"unknown actuation kind {k!r}")
+                if int(v) < 0:
+                    raise ValueError(f"budget for {k!r} must be >= 0")
+                self.budgets[k] = int(v)
+        self._step = 0
+        self._win_id = 0
+        self._win_counts = {k: 0 for k in self.KINDS}
+        self.applied = {k: 0 for k in self.KINDS}
+        self.suppressed = {k: 0 for k in self.KINDS}
+        self.faulted = {k: 0 for k in self.KINDS}
+        self.last: Dict[str, int] = {}
+        reg = registry if registry is not None else default_registry()
+        self._m_applied = reg.counter(
+            "ptpu_control_actuations_total",
+            "control-plane actuations applied", labels=("kind",))
+        self._m_suppressed = reg.counter(
+            "ptpu_control_suppressed_total",
+            "control-plane actuations suppressed (budget or fault)",
+            labels=("kind",))
+
+    def on_step(self) -> None:
+        self._step += 1
+        wid = self._step // self.window
+        if wid != self._win_id:
+            self._win_id = wid
+            for k in self._win_counts:
+                self._win_counts[k] = 0
+
+    def allow(self, kind: str, **ctx) -> bool:
+        if kind not in self._win_counts:
+            raise ValueError(f"unknown actuation kind {kind!r}")
+        if self._win_counts[kind] >= self.budgets[kind]:
+            self.suppressed[kind] += 1
+            self._m_suppressed.labels(kind=kind).inc()
+            return False
+        try:
+            # Literal point names so the PTL402 registry scan sees
+            # each call site.
+            if kind == "shed":
+                maybe_fail("control.shed", **ctx)
+            elif kind == "chunk":
+                maybe_fail("control.chunk", **ctx)
+            elif kind == "affinity":
+                maybe_fail("control.affinity", **ctx)
+            else:
+                maybe_fail("control.scale", **ctx)
+        except InjectedFault:
+            # Contained: a faulted actuator drops this one actuation;
+            # the data plane keeps its last applied setting.
+            self.faulted[kind] += 1
+            self.suppressed[kind] += 1
+            self._m_suppressed.labels(kind=kind).inc()
+            return False
+        self._win_counts[kind] += 1
+        self.applied[kind] += 1
+        self.last[kind] = self._step
+        self._m_applied.labels(kind=kind).inc()
+        return True
+
+    def snapshot(self) -> dict:
+        return {"step": self._step,
+                "applied": dict(self.applied),
+                "suppressed": dict(self.suppressed),
+                "faulted": dict(self.faulted),
+                "last": dict(self.last)}
+
+
+class BrownoutController:
+    """Priority-tier load shedding driven by queue depth + TTFT burn.
+
+    ``level`` ranges 0..tiers-1.  At level L, requests with priority
+    ``>= tiers - L`` are shed — i.e. level 1 sheds only the lowest
+    tier, and tier 0 (highest priority) is never shed at any level.
+    Raising needs EWMA depth/burn above the enter thresholds; lowering
+    needs BOTH below the (strictly easier) exit thresholds.
+    """
+
+    def __init__(self, *, tiers: int = 3,
+                 enter_depth: float = 8.0, exit_depth: float = 2.0,
+                 enter_burn: float = 6.0, exit_burn: float = 1.0,
+                 alpha: float = 0.5, dwell: int = 4,
+                 retry_hint_s: float = 0.05,
+                 actuator: Optional[Actuator] = None,
+                 registry=None):
+        if tiers < 2:
+            raise ValueError(f"tiers must be >= 2, got {tiers}")
+        if exit_depth >= enter_depth:
+            raise ValueError(
+                f"exit_depth must be < enter_depth for a dead band "
+                f"(got exit {exit_depth} >= enter {enter_depth})")
+        if exit_burn >= enter_burn:
+            raise ValueError(
+                f"exit_burn must be < enter_burn for a dead band "
+                f"(got exit {exit_burn} >= enter {enter_burn})")
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        if dwell < 1:
+            raise ValueError(f"dwell must be >= 1, got {dwell}")
+        self.tiers = int(tiers)
+        self.enter_depth, self.exit_depth = float(enter_depth), float(exit_depth)
+        self.enter_burn, self.exit_burn = float(enter_burn), float(exit_burn)
+        self.alpha = float(alpha)
+        self.dwell = int(dwell)
+        self.retry_hint_s = float(retry_hint_s)
+        self.actuator = actuator
+        self.level = 0
+        self.flips = 0
+        self.sheds = 0
+        self.sheds_by_tier: Dict[int, int] = {}
+        self._step = 0
+        self._since = 0
+        self._ewma_depth: Optional[float] = None
+        self._ewma_burn: Optional[float] = None
+        reg = registry if registry is not None else default_registry()
+        self._m_level = reg.gauge(
+            "ptpu_control_brownout_level",
+            "active brownout level (0 = no shedding)")
+        self._m_sheds = reg.counter(
+            "ptpu_control_sheds_total",
+            "requests shed at the front door by priority tier",
+            labels=("tier",))
+        self._m_level.set(0.0)
+
+    def on_step(self, depth: float, burn: float = 0.0) -> None:
+        self._step += 1
+        self._ewma_depth = _ewma(self._ewma_depth, float(depth), self.alpha)
+        self._ewma_burn = _ewma(self._ewma_burn, float(burn), self.alpha)
+        if self._step - self._since < self.dwell:
+            return
+        hot = (self._ewma_depth >= self.enter_depth
+               or self._ewma_burn >= self.enter_burn)
+        cool = (self._ewma_depth <= self.exit_depth
+                and self._ewma_burn <= self.exit_burn)
+        if hot and self.level < self.tiers - 1:
+            self.level += 1
+        elif cool and self.level > 0:
+            self.level -= 1
+        else:
+            return
+        self.flips += 1
+        self._since = self._step
+        self._m_level.set(float(self.level))
+
+    def should_shed(self, priority: int) -> bool:
+        return self.level > 0 and int(priority) >= self.tiers - self.level
+
+    def maybe_shed(self, priority: int, tenant: str = "") -> bool:
+        """True ⇒ reject this request (caller raises an audited
+        ``Shed``); False ⇒ admit.  A denied/faulted actuator fails
+        open: the request is served."""
+        if not self.should_shed(priority):
+            return False
+        if self.actuator is not None and not self.actuator.allow(
+                "shed", tenant=tenant, tier=int(priority)):
+            return False
+        self.sheds += 1
+        tier = int(priority)
+        self.sheds_by_tier[tier] = self.sheds_by_tier.get(tier, 0) + 1
+        self._m_sheds.labels(tier=str(tier)).inc()
+        return True
+
+    def retry_after_s(self) -> float:
+        return self.retry_hint_s * max(1, self.level)
+
+    def snapshot(self) -> dict:
+        return {"step": self._step, "level": self.level,
+                "flips": self.flips, "dwell": self.dwell,
+                "sheds": self.sheds,
+                "sheds_by_tier": dict(self.sheds_by_tier),
+                "ewma_depth": self._ewma_depth,
+                "ewma_burn": self._ewma_burn}
+
+
+class ChunkBudgetController:
+    """Adaptive per-step prefill token budget (PR 12's follow-up).
+
+    The chunk program is ONE cached jit compiled at the fixed
+    ``prefill_chunk`` shape, so the controller never changes the
+    chunk SIZE — it changes how many chunks the engine may run per
+    step, as ``mults[i] * chunk`` tokens.  Deep admission queues push
+    the budget up (drain prefill backlog, protect TTFT); a heavy
+    active-decode population pulls it down (each extra chunk stalls
+    every running decode).
+    """
+
+    def __init__(self, *, raise_depth: float = 6.0,
+                 lower_depth: float = 2.0, stall_brake: float = 8.0,
+                 alpha: float = 0.5, dwell: int = 8,
+                 mults: Sequence[int] = (1, 2, 4),
+                 actuator: Optional[Actuator] = None,
+                 registry=None):
+        if lower_depth >= raise_depth:
+            raise ValueError(
+                f"lower_depth must be < raise_depth for a dead band "
+                f"(got lower {lower_depth} >= raise {raise_depth})")
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        if dwell < 1:
+            raise ValueError(f"dwell must be >= 1, got {dwell}")
+        mults = tuple(int(m) for m in mults)
+        if not mults or any(m < 1 for m in mults) \
+                or list(mults) != sorted(set(mults)):
+            # mult 0 would admit nothing and starve the engine into
+            # EngineIdle; duplicates/disorder would break hysteresis.
+            raise ValueError(
+                f"mults must be distinct ascending positive ints, "
+                f"got {mults}")
+        self.raise_depth, self.lower_depth = float(raise_depth), float(lower_depth)
+        self.stall_brake = float(stall_brake)
+        self.alpha = float(alpha)
+        self.dwell = int(dwell)
+        self.mults = mults
+        self.actuator = actuator
+        self.adaptations = 0  # == flips, in SpecTuner terms
+        self._idx = 0
+        self._step = 0
+        self._since = 0
+        self._ewma_depth: Optional[float] = None
+        self._ewma_stall: Optional[float] = None
+        reg = registry if registry is not None else default_registry()
+        self._m_budget = reg.gauge(
+            "ptpu_control_chunk_budget",
+            "adaptive prefill token budget for the current step")
+        self._m_adapt = reg.counter(
+            "ptpu_control_chunk_adaptations_total",
+            "chunk-budget level transitions applied")
+
+    @property
+    def mult(self) -> int:
+        return self.mults[self._idx]
+
+    @property
+    def flips(self) -> int:
+        return self.adaptations
+
+    def step_budget(self, chunk: int, depth: float,
+                    stall: float = 0.0) -> int:
+        """Token budget for this engine step.  ``depth`` is queued +
+        chunk-pending work; ``stall`` is the active-decode population
+        (the requests each extra chunk would stall)."""
+        self._step += 1
+        self._ewma_depth = _ewma(self._ewma_depth, float(depth), self.alpha)
+        self._ewma_stall = _ewma(self._ewma_stall, float(stall), self.alpha)
+        if self._step - self._since >= self.dwell:
+            want = None
+            if self._ewma_stall >= self.stall_brake and self._idx > 0:
+                want = self._idx - 1
+            elif self._ewma_depth >= self.raise_depth \
+                    and self._idx < len(self.mults) - 1:
+                want = self._idx + 1
+            elif self._ewma_depth <= self.lower_depth and self._idx > 0:
+                want = self._idx - 1
+            if want is not None and (
+                    self.actuator is None or self.actuator.allow(
+                        "chunk", mult=self.mults[want])):
+                self._idx = want
+                self._since = self._step
+                self.adaptations += 1
+                self._m_adapt.inc()
+        budget = self.mults[self._idx] * int(chunk)
+        self._m_budget.set(float(budget))
+        return budget
+
+    def snapshot(self) -> dict:
+        return {"step": self._step, "mult": self.mult,
+                "adaptations": self.adaptations, "dwell": self.dwell,
+                "ewma_depth": self._ewma_depth,
+                "ewma_stall": self._ewma_stall}
+
+
+class PrefixAffinityPolicy:
+    """Route a request to the replica where its radix prefix is warm.
+
+    Probes each candidate's cache via the pure read-only
+    ``probe_prefix`` (replicas without one — e.g. remote mirrors —
+    count as cold).  The best replica needs at least ``min_tokens``
+    matched to beat the least-loaded fallback; ties break by
+    ``(load, id)`` like the router's own pick.
+    """
+
+    def __init__(self, *, min_tokens: int = 8,
+                 actuator: Optional[Actuator] = None,
+                 registry=None):
+        if min_tokens < 1:
+            raise ValueError(f"min_tokens must be >= 1, got {min_tokens}")
+        self.min_tokens = int(min_tokens)
+        self.actuator = actuator
+        self.hits = 0
+        self.misses = 0
+        reg = registry if registry is not None else default_registry()
+        self._m_routed = reg.counter(
+            "ptpu_control_affinity_total",
+            "prefix-affinity routing decisions", labels=("outcome",))
+
+    def pick(self, cands, prompt_ids, fallback):
+        """Choose among ``cands`` (dispatchable replicas); ``fallback``
+        is the router's least-loaded choice."""
+        best = None
+        best_m = 0
+        for r in cands:
+            eng = getattr(r, "engine", None)
+            cache = getattr(eng, "cache", None)
+            probe = getattr(cache, "probe_prefix", None)
+            if probe is None:
+                continue
+            try:
+                m = int(probe(prompt_ids))
+            except Exception:
+                m = 0
+            if m < self.min_tokens or m < best_m:
+                continue
+            if m > best_m or best is None \
+                    or (r.load(), r.id) < (best.load(), best.id):
+                best, best_m = r, m
+        if best is None or best is fallback:
+            self.misses += 1
+            self._m_routed.labels(outcome="miss").inc()
+            return fallback
+        if self.actuator is not None and not self.actuator.allow(
+                "affinity", replica=best.id, matched=best_m):
+            self.misses += 1
+            self._m_routed.labels(outcome="miss").inc()
+            return fallback
+        self.hits += 1
+        self._m_routed.labels(outcome="hit").inc()
+        return best
+
+    def snapshot(self) -> dict:
+        return {"hits": self.hits, "misses": self.misses,
+                "min_tokens": self.min_tokens}
+
+
+class ReplicaAutoscaler:
+    """Spawn/drain replicas from per-replica queue pressure and TTFT
+    burn, bounded by min/max and a cool-down.
+
+    ``decide`` only *proposes*; the cool-down clock is consumed by
+    ``commit`` — so an actuation suppressed by the rate limiter or a
+    ``control.scale`` fault does not burn the cool-down and the
+    proposal retries next step.
+    """
+
+    def __init__(self, *, min_replicas: int = 1, max_replicas: int = 4,
+                 up_pressure: float = 4.0, down_pressure: float = 0.5,
+                 up_burn: float = 6.0, alpha: float = 0.5,
+                 cooldown: int = 16, registry=None):
+        if min_replicas < 1:
+            raise ValueError(
+                f"min_replicas must be >= 1, got {min_replicas}")
+        if max_replicas < min_replicas:
+            raise ValueError(
+                f"max_replicas {max_replicas} < min_replicas "
+                f"{min_replicas}")
+        if down_pressure >= up_pressure:
+            raise ValueError(
+                f"down_pressure must be < up_pressure for a dead band "
+                f"(got down {down_pressure} >= up {up_pressure})")
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        if cooldown < 1:
+            raise ValueError(f"cooldown must be >= 1, got {cooldown}")
+        self.min_replicas, self.max_replicas = int(min_replicas), int(max_replicas)
+        self.up_pressure, self.down_pressure = float(up_pressure), float(down_pressure)
+        self.up_burn = float(up_burn)
+        self.alpha = float(alpha)
+        self.cooldown = int(cooldown)
+        self.actions = 0
+        self.actions_by_dir: Dict[str, int] = {"up": 0, "down": 0}
+        self.last_action: Optional[Tuple[str, int]] = None
+        self._step = 0
+        self._last_commit = -(10 ** 9)  # first action is not gated
+        self._replicas = 0
+        self._ewma_depth: Optional[float] = None
+        self._ewma_burn: Optional[float] = None
+        reg = registry if registry is not None else default_registry()
+        self._m_replicas = reg.gauge(
+            "ptpu_control_replicas",
+            "dispatchable replicas seen by the autoscaler")
+        self._m_actions = reg.counter(
+            "ptpu_control_scale_actions_total",
+            "autoscaler actions committed", labels=("direction",))
+
+    def decide(self, depth: float, replicas: int,
+               burn: float = 0.0) -> Optional[str]:
+        self._step += 1
+        self._ewma_depth = _ewma(self._ewma_depth, float(depth), self.alpha)
+        self._ewma_burn = _ewma(self._ewma_burn, float(burn), self.alpha)
+        self._replicas = int(replicas)
+        self._m_replicas.set(float(replicas))
+        if self._step - self._last_commit < self.cooldown:
+            return None
+        pressure = self._ewma_depth / max(1, int(replicas))
+        if (pressure >= self.up_pressure
+                or self._ewma_burn >= self.up_burn) \
+                and replicas < self.max_replicas:
+            return "up"
+        if pressure <= self.down_pressure \
+                and self._ewma_burn < self.up_burn \
+                and replicas > self.min_replicas:
+            return "down"
+        return None
+
+    def commit(self, direction: str) -> None:
+        if direction not in ("up", "down"):
+            raise ValueError(f"unknown scale direction {direction!r}")
+        self._last_commit = self._step
+        self.actions += 1
+        self.actions_by_dir[direction] += 1
+        self.last_action = (direction, self._step)
+        self._m_actions.labels(direction=direction).inc()
+
+    def snapshot(self) -> dict:
+        return {"step": self._step, "actions": self.actions,
+                "replicas": self._replicas,
+                "by_direction": dict(self.actions_by_dir),
+                "last_action": list(self.last_action)
+                if self.last_action else None,
+                "cooldown": self.cooldown,
+                "ewma_depth": self._ewma_depth,
+                "ewma_burn": self._ewma_burn}
+
+
+class ControlPlane:
+    """Bundle of controllers behind the seams the front door calls.
+
+    ``on_step`` runs once per front-door pump with the backend depth
+    and TTFT burn; ``maybe_shed`` gates admission; ``maybe_scale``
+    drives the router's add/drain machinery.  Controllers left
+    ``None`` are simply inactive.  ``spawn_engine`` is a zero-arg
+    factory producing a fresh engine for scale-up.
+    """
+
+    def __init__(self, *, brownout: Optional[BrownoutController] = None,
+                 chunk: Optional[ChunkBudgetController] = None,
+                 affinity: Optional[PrefixAffinityPolicy] = None,
+                 autoscaler: Optional[ReplicaAutoscaler] = None,
+                 actuator: Optional[Actuator] = None,
+                 spawn_engine: Optional[Callable[[], object]] = None,
+                 registry=None):
+        self.actuator = actuator if actuator is not None \
+            else Actuator(registry=registry)
+        for c in (brownout, chunk, affinity):
+            if c is not None and c.actuator is None:
+                c.actuator = self.actuator
+        self.brownout = brownout
+        self.chunk = chunk
+        self.affinity = affinity
+        self.autoscaler = autoscaler
+        self.spawn_engine = spawn_engine
+        self._depth = 0.0
+        self._burn = 0.0
+        self._scale_seq = 0
+
+    def on_step(self, depth: float, burn: float = 0.0) -> None:
+        self._depth, self._burn = float(depth), float(burn)
+        self.actuator.on_step()
+        if self.brownout is not None:
+            self.brownout.on_step(depth, burn)
+
+    def maybe_shed(self, priority: int, tenant: str = "") -> bool:
+        return self.brownout is not None \
+            and self.brownout.maybe_shed(priority, tenant=tenant)
+
+    def retry_after_s(self) -> float:
+        if self.brownout is None:
+            return 0.0
+        return self.brownout.retry_after_s()
+
+    def maybe_scale(self, router) -> Optional[str]:
+        asc = self.autoscaler
+        if asc is None or router is None:
+            return None
+        disp = [r for r in router.replicas if r.dispatchable]
+        direction = asc.decide(self._depth, len(disp), self._burn)
+        if direction is None:
+            return None
+        if not self.actuator.allow("scale", direction=direction):
+            return None
+        if direction == "up":
+            if self.spawn_engine is None:
+                return None
+            rid = f"scale{self._scale_seq}"
+            self._scale_seq += 1
+            router.add_replica(self.spawn_engine(), replica_id=rid)
+        else:
+            lo = min(r.load() for r in disp)
+            victim = max((r for r in disp if r.load() == lo),
+                         key=lambda r: r.id)
+            router.drain_replica(victim.id)
+        asc.commit(direction)
+        return direction
+
+    def snapshot(self) -> dict:
+        out: dict = {"actuator": self.actuator.snapshot()}
+        if self.brownout is not None:
+            out["brownout"] = self.brownout.snapshot()
+        if self.chunk is not None:
+            out["chunk"] = self.chunk.snapshot()
+        if self.affinity is not None:
+            out["affinity"] = self.affinity.snapshot()
+        if self.autoscaler is not None:
+            out["autoscale"] = self.autoscaler.snapshot()
+        return out

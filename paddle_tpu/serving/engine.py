@@ -152,6 +152,7 @@ class ServingEngine:
                  mesh=None,
                  prefill_devices: int = 0,
                  prefill_chunk: Optional[int] = None,
+                 chunk_control=None,
                  admission_lookahead: int = 0,
                  kv_host_tier: bool = False,
                  host_tier_pages: Optional[int] = None,
@@ -191,6 +192,17 @@ class ServingEngine:
                     f"(>= the min_bucket floor and <= max_len "
                     f"{self.max_len})")
             self.prefill_chunk = c
+        # serving.control.ChunkBudgetController (optional, requires
+        # prefill_chunk): scales the per-step prefill token budget as
+        # a multiple of the FIXED compiled chunk — the chunk program
+        # is one cached jit, so the budget changes how many times it
+        # runs per step, never its shape. None keeps the legacy
+        # at-most-one-chunk-per-step behaviour bit-identical.
+        if chunk_control is not None and self.prefill_chunk is None:
+            raise ValueError(
+                "chunk_control requires prefill_chunk (the controller "
+                "scales the chunked-prefill budget)")
+        self.chunk_control = chunk_control
         if admission_lookahead < 0:
             raise ValueError(
                 f"admission_lookahead must be >= 0, got "
@@ -981,6 +993,14 @@ class ServingEngine:
         # tokens plus the one-token-per-slot decode.
         chunk = self.prefill_chunk
         budget = chunk
+        if chunk is not None and self.chunk_control is not None:
+            # adaptive budget: queued + chunk-pending work pushes it
+            # up, the active-decode population (the requests every
+            # extra chunk would stall) pulls it back down
+            budget = self.chunk_control.step_budget(
+                chunk,
+                self.scheduler.depth + len(self._chunk_fifo),
+                stall=float(len(self.cache.active_slots())))
         for i, (slot, req) in enumerate(pairs):
             try:
                 if chunk is None:
@@ -1017,14 +1037,24 @@ class ServingEngine:
             admitted.append(req.rid)
             if req.finished:
                 self._evict(slot, req, finished)
-        # 1b) one chunk of PREFILLING work, if it fits what is left of
-        # the step's prefill budget — at most ONE chunk program run
-        # per step, interleaved with the decode below
-        if chunk is not None and self._chunk_fifo:
+        # 1b) PREFILLING work within what is left of the step's
+        # prefill budget, interleaved with the decode below. Without a
+        # chunk controller this is AT MOST ONE chunk program run per
+        # step (the legacy contract, bit-identical); with one, the
+        # same compiled program runs back-to-back until the adaptive
+        # budget is spent.
+        ran = 0
+        while chunk is not None and self._chunk_fifo:
             head = self.cache.slots[self._chunk_fifo[0]]
             n_ids = head.prompt_len + max(0, len(head.out_tokens) - 1)
-            if min(chunk, n_ids - head.prefill_pos) <= budget:
-                self._chunk_step(finished)
+            take = min(chunk, n_ids - head.prefill_pos)
+            if take > budget:
+                break
+            self._chunk_step(finished)
+            budget -= take
+            ran += 1
+            if self.chunk_control is None and ran >= 1:
+                break
         if chunk is not None:
             self._m_chunk_depth.set(len(self._chunk_fifo))
         # 2) one decode step over all occupied slots — the speculative

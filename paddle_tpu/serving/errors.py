@@ -25,6 +25,10 @@ Front-door / router additions (serving/frontdoor.py, serving/router.py):
   carried ``retry_after_s`` is the earliest the bucket refills.
 - :class:`TenantQueueFull` — a tenant hit its per-tenant in-flight cap
   (tenant isolation: one tenant's backlog cannot starve the others).
+- :class:`Shed` — the brownout controller rejected a low-priority
+  request under overload (serving/control.py); an *audited* rejection
+  at the client boundary (HTTP 503 + Retry-After), never a LOST
+  request.
 - :class:`ReplicaDead` — a replica is gone (health probe, or raised
   out of a dying replica's step); the router fails its in-flight
   requests over to peers.
@@ -36,7 +40,7 @@ from __future__ import annotations
 __all__ = ["ServingError", "QueueFull", "DeadlineExceeded",
            "EngineBroken", "EngineIdle", "EngineClosed",
            "RequestCancelled", "RateLimited", "TenantQueueFull",
-           "ReplicaDead", "NoHealthyReplicas", "RemoteError"]
+           "Shed", "ReplicaDead", "NoHealthyReplicas", "RemoteError"]
 
 
 def _rebuild_error(cls, args, attrs):
@@ -127,6 +131,17 @@ class TenantQueueFull(ServingError):
         self.tenant = tenant
         self.depth = depth
         self.max_inflight = max_inflight
+
+
+class Shed(ServingError):
+    def __init__(self, tenant: str, tier: int,
+                 retry_after_s: float = 0.0):
+        super().__init__(
+            f"tenant {tenant!r} shed at brownout (tier {tier}); "
+            f"retry in {retry_after_s:.3f}s")
+        self.tenant = tenant
+        self.tier = tier
+        self.retry_after_s = retry_after_s
 
 
 class ReplicaDead(ServingError):

@@ -44,7 +44,7 @@ from typing import Callable, Dict, List, Optional
 from ..observability import default_recorder, default_registry
 from ..resilience.faults import maybe_fail
 from .errors import (EngineClosed, QueueFull, RateLimited,
-                     ServingError, TenantQueueFull)
+                     ServingError, Shed, TenantQueueFull)
 from .sampling import SamplingParams
 from .scheduler import Request
 
@@ -62,6 +62,9 @@ class TenantPolicy:
     rate_qps: Optional[float] = None
     burst: int = 8
     max_inflight: Optional[int] = None
+    # priority tier (0 = highest): under brownout the control plane
+    # sheds the highest-numbered tiers first; tier 0 is never shed
+    priority: int = 0
 
 
 class TokenBucket:
@@ -163,12 +166,17 @@ class FrontDoor:
                  default_policy: Optional[TenantPolicy] = None,
                  tenants: Optional[Dict[str, TenantPolicy]] = None,
                  auditor=None, registry=None, flight_recorder=None,
-                 telemetry=None, watchtower=None,
+                 telemetry=None, watchtower=None, control=None,
                  time_fn: Callable[[], float] = time.monotonic):
         self.backend = backend
         self.default_policy = default_policy or TenantPolicy()
         self.tenant_policies = dict(tenants or {})
         self.auditor = auditor
+        # serving.control.ControlPlane (optional): pump() feeds it the
+        # backend depth + TTFT burn each iteration; submit() asks it
+        # whether to shed (an audited typed rejection, never a LOST
+        # request); a router backend gets autoscaled through it
+        self.control = control
         self.now = time_fn
         self.registry = registry if registry is not None \
             else default_registry()
@@ -202,7 +210,7 @@ class FrontDoor:
         self._m_reject = reg.counter(
             "ptpu_frontdoor_rejected_total",
             "submissions refused at the front door",
-            labels=("reason",))
+            labels=("reason", "tier"))
         self._m_accept = reg.counter(
             "ptpu_frontdoor_accepted_total",
             "submissions accepted", labels=("tenant",))
@@ -243,8 +251,8 @@ class FrontDoor:
             self._buckets[tenant] = b
         return b
 
-    def _reject(self, tenant: str, reason: str) -> None:
-        self._m_reject.labels(reason=reason).inc()
+    def _reject(self, tenant: str, reason: str, tier: int = 0) -> None:
+        self._m_reject.labels(reason=reason, tier=str(tier)).inc()
         if self.auditor is not None \
                 and hasattr(self.auditor, "on_rejected"):
             self.auditor.on_rejected(tenant=tenant, reason=reason)
@@ -264,37 +272,46 @@ class FrontDoor:
             if self.auditor is not None \
                     and hasattr(self.auditor, "on_attempt"):
                 self.auditor.on_attempt()
-            if self._closed:
-                self._reject(tenant, "closed")
-                raise EngineClosed()
             pol = self._policy(tenant)
+            tier = int(getattr(pol, "priority", 0))
+            if self._closed:
+                self._reject(tenant, "closed", tier)
+                raise EngineClosed()
+            if self.control is not None \
+                    and self.control.maybe_shed(tier, tenant=tenant):
+                # brownout: an AUDITED rejection at the boundary — the
+                # attempt above plus this on_rejected keep the ledger's
+                # admission law balanced (shed is never a LOST request)
+                self._reject(tenant, "shed", tier)
+                raise Shed(tenant, tier, self.control.retry_after_s())
             depth = self._tenant_depth.get(tenant, 0)
             if pol.max_inflight is not None \
                     and depth >= pol.max_inflight:
-                self._reject(tenant, "tenant_queue_full")
+                self._reject(tenant, "tenant_queue_full", tier)
                 raise TenantQueueFull(tenant, depth, pol.max_inflight)
             bucket = self._bucket(tenant)
             if bucket is not None and not bucket.try_take():
-                self._reject(tenant, "rate_limited")
+                self._reject(tenant, "rate_limited", tier)
                 raise RateLimited(tenant, bucket.retry_after_s())
             try:
                 req = self.backend.submit(
                     prompt_ids, max_new_tokens, sampling=sampling,
                     deadline_s=deadline_s, tenant=tenant)
             except QueueFull:
-                self._reject(tenant, "queue_full")
+                self._reject(tenant, "queue_full", tier)
                 raise
             except ServingError:
-                self._reject(tenant, "unavailable")
+                self._reject(tenant, "unavailable", tier)
                 raise
             except ValueError:
-                self._reject(tenant, "invalid")
+                self._reject(tenant, "invalid", tier)
                 raise
             except Exception:
                 # dispatch-path crash (router.dispatch fault): nothing
                 # was half-submitted — a typed refusal to the caller
-                self._reject(tenant, "dispatch_error")
+                self._reject(tenant, "dispatch_error", tier)
                 raise
+            req.priority = tier
             handle = FrontDoorHandle(req, stream, tenant)
             self._handles[req.rid] = handle
             self._tenant_depth[tenant] = depth + 1
@@ -377,8 +394,49 @@ class FrontDoor:
             wt.poll()
         return out
 
+    # requires-lock: _lock
+    def _backend_depth(self) -> float:
+        """Queued + in-flight work the control plane regulates on: the
+        sum of dispatchable replica loads for a router backend, else
+        the engine's queue depth + active slots."""
+        b = self.backend
+        reps = getattr(b, "replicas", None)
+        if reps is not None:
+            return float(sum(r.load() for r in reps if r.dispatchable))
+        sched = getattr(b, "scheduler", None)
+        if sched is None:
+            return 0.0
+        cache = getattr(b, "cache", None)
+        active = len(cache.active_slots()) if cache is not None else 0
+        return float(sched.depth + active)
+
+    # requires-lock: _lock
+    def _ttft_burn(self) -> float:
+        """Fast-window TTFT burn rate from the attached watchtower
+        (0.0 without one — the brownout then runs on depth alone)."""
+        wt = self.watchtower
+        if wt is None:
+            return 0.0
+        try:
+            rates = wt.burn_rates()
+        except Exception:
+            return 0.0
+        burn = 0.0
+        for name, w in rates.items():
+            if "ttft" in name:
+                burn = max(burn, float(w.get("fast", 0.0)))
+        return burn
+
     def _pump_locked(self) -> List[Request]:
         with self._lock:
+            cp = self.control
+            if cp is not None:
+                # controllers step BEFORE the idle early-return so the
+                # brownout decays (and the autoscaler can scale down)
+                # while the backend is empty
+                cp.on_step(self._backend_depth(), self._ttft_burn())
+                if hasattr(self.backend, "replicas"):
+                    cp.maybe_scale(self.backend)
             if not self.backend.has_work():
                 return []
             try:
@@ -509,8 +567,9 @@ class FrontDoorHTTPServer:
       "deadline_s": float}``. Streaming responses are Server-Sent
       Events (``data: {json}\\n\\n`` per token, then a ``done``
       event); unary responses are one JSON object. Typed refusals map
-      to HTTP: 429 (rate limit / queues full), 503 (broken /
-      no replicas / closed), 400 (validation).
+      to HTTP: 429 (rate limit / queues full, Retry-After header),
+      503 (shed at brownout — Retry-After from the controller — /
+      broken / no replicas / closed), 400 (validation).
     - ``GET /healthz`` — backend health (router replica states).
     - ``GET /metrics`` — Prometheus text exposition; cluster-merged
       across workers when a ``ClusterTelemetry`` is attached.
@@ -537,11 +596,18 @@ class FrontDoorHTTPServer:
             def log_message(self, *a):   # quiet by default
                 pass
 
-            def _json_response(self, code: int, obj: dict) -> None:
+            def _json_response(self, code: int, obj: dict,
+                               retry_after=None) -> None:
                 body = _json.dumps(obj).encode()
                 self.send_response(code)
                 self.send_header("Content-Type", "application/json")
                 self.send_header("Content-Length", str(len(body)))
+                if retry_after is not None:
+                    # RFC 9110 delta-seconds (integer, >= 1 so an
+                    # immediate-retry hint still reads as a real delay)
+                    self.send_header(
+                        "Retry-After",
+                        str(max(1, int(float(retry_after) + 0.999))))
                 self.end_headers()
                 self.wfile.write(body)
 
@@ -619,11 +685,20 @@ class FrontDoorHTTPServer:
                         tenant=str(body.get("tenant", "default")),
                         deadline_s=body.get("deadline_s"),
                         stream=stream)
+                except E.Shed as e:
+                    # brownout rejection: overload semantics (503),
+                    # with the controller's deterministic retry hint
+                    self._json_response(
+                        503, {"error": "Shed", "detail": str(e),
+                              "tier": e.tier},
+                        retry_after=e.retry_after_s)
+                    return
                 except (E.RateLimited, E.TenantQueueFull,
                         E.QueueFull) as e:
                     self._json_response(
                         429, {"error": type(e).__name__,
-                              "detail": str(e)})
+                              "detail": str(e)},
+                        retry_after=getattr(e, "retry_after_s", 1.0))
                     return
                 except ValueError as e:
                     self._json_response(

@@ -143,7 +143,8 @@ class ReplicaRouter:
                  probe_fail_threshold: int = 2,
                  step_fail_threshold: int = 3,
                  recover_fail_threshold: int = 3,
-                 probe_timeout_s: Optional[float] = 1.0):
+                 probe_timeout_s: Optional[float] = 1.0,
+                 affinity=None):
         if not engines:
             raise ValueError("ReplicaRouter needs at least one engine")
         # pre-built Replica objects pass through (the cluster
@@ -162,6 +163,11 @@ class ReplicaRouter:
         # auditor for STANDALONE router use; under a FrontDoor the
         # ledger mounts there instead and this stays None
         self.auditor = auditor
+        # serving.control.PrefixAffinityPolicy (optional): dispatch
+        # prefers the replica whose radix index already holds the
+        # request's prefix; least-loaded remains the fallback and the
+        # only policy for dead/draining candidates
+        self.affinity = affinity
         self.probe_fail_threshold = int(probe_fail_threshold)
         self.step_fail_threshold = int(step_fail_threshold)
         self.recover_fail_threshold = int(recover_fail_threshold)
@@ -224,11 +230,14 @@ class ReplicaRouter:
             rep.engine.cancel_probe = probe
 
     # -- dispatch ------------------------------------------------------
-    def _pick_replica(self) -> Replica:
+    def _pick_replica(self, prompt_ids=None) -> Replica:
         cands = [r for r in self.replicas if r.dispatchable]
         if not cands:
             raise NoHealthyReplicas(len(self.replicas))
-        return min(cands, key=lambda r: (r.load(), r.id))
+        fallback = min(cands, key=lambda r: (r.load(), r.id))
+        if self.affinity is not None and prompt_ids is not None:
+            return self.affinity.pick(cands, prompt_ids, fallback)
+        return fallback
 
     def submit(self, prompt_ids, max_new_tokens: int = 16,
                sampling: Optional[SamplingParams] = None,
@@ -240,7 +249,7 @@ class ReplicaRouter:
         target engine's admission raises (``QueueFull`` etc.)."""
         if self._closed:
             raise EngineClosed()
-        target = self._pick_replica()
+        target = self._pick_replica(prompt_ids)
         maybe_fail("router.dispatch", replica=target.id)
         req = target.engine._build_request(
             prompt_ids, max_new_tokens, sampling, deadline_s,

@@ -77,6 +77,9 @@ class Request:
     # mid-prefill before the program runs) with finish_reason
     # "disconnect"
     tenant: Optional[str] = None
+    # priority tier (0 = highest) mapped from tenant config; the
+    # brownout controller sheds the highest-numbered tiers first
+    priority: int = 0
     cancel_requested: bool = False
     # distributed-tracing context (observability.TraceContext), minted
     # at the router; rides the pickled request across submit/adopt/

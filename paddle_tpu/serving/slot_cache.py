@@ -466,6 +466,50 @@ class PagedKVCache(_SlotTable):
         # every step and must not inflate the prefix-hit-rate artifact
         return m, entries, node
 
+    def probe_prefix(self, ids) -> int:
+        """PURE read-only twin of ``_match_prefix`` for the control
+        plane's prefix-affinity router: how many prompt tokens are
+        warm in THIS pool's index right now. No LRU touch, no
+        dataless-host unlink, no counters — probing every replica per
+        dispatch must not perturb any cache's eviction order (a
+        dataless host node simply stops the walk; the owning engine
+        repairs it on its own next match)."""
+        if not self.prefix_sharing:
+            return 0
+        ids = np.asarray(ids)
+        if len(ids) < 2:
+            return 0
+        matchable = ids[:-1]
+        P = self.page_size
+        node = self._root
+        key: Tuple[int, ...] = ()
+        m = 0
+        while m + P <= len(matchable):
+            chunk = tuple(int(t) for t in matchable[m:m + P])
+            child = node.children.get(chunk)
+            if child is None:
+                break
+            key = key + chunk
+            if child.page < 0 \
+                    and (self.tier is None or not self.tier.has(key)):
+                break
+            node = child
+            m += P
+        want = [int(t) for t in matchable[m:m + P]]
+        if want:
+            best = 0
+            for chunk, child in node.children.items():
+                if child.page < 0:
+                    continue
+                common = 0
+                for a, b in zip(chunk, want):
+                    if a != b:
+                        break
+                    common += 1
+                best = max(best, common)
+            m += best
+        return m
+
     def register_prefix(self, slot: int, ids: np.ndarray) -> None:
         """Index every FULL page of ``ids`` (just prefilled into
         ``slot``) so later prompts can reference them. Indexed pages

@@ -127,6 +127,19 @@ CHUNKED_PREFILL_KEYS = {
 }
 
 
+# the CONTROL_PLANE line (bench_serving_engine --control-plane) is
+# the ISSUE-20 acceptance artifact: the same virtual-clock overload
+# burst replayed with the priority brownout OFF then ON — schema
+# stable, low tiers really shed, tier 0 NEVER shed, tier-0 p99 TTFT
+# (in pump-steps) no worse than the unshed run, zero LOST both ways
+CONTROL_PLANE_KEYS = {
+    "requests", "tiers", "completed_unshed", "completed_shed",
+    "sheds", "sheds_by_tier", "tier0_sheds", "attempts_by_tier",
+    "p99_ttft_steps_by_tier_unshed", "p99_ttft_steps_by_tier_shed",
+    "brownout_level_max", "lost", "duplicates", "ledger_green",
+}
+
+
 # the PAGED_KV line (bench_serving_engine --prefix-share) is the
 # artifact the paged-KV acceptance keys on: schema stable, gains over
 # the contiguous pool asserted at the ISSUE-6 bars (>= 4x paged,
@@ -180,6 +193,7 @@ KV_TIERING_KEYS = {
     "bench_serving_engine.py --watchtower",
     "bench_serving_engine.py --chunked-prefill",
     "bench_serving_engine.py --frontdoor",
+    "bench_serving_engine.py --control-plane",
     "bench_serving_engine.py --tensor-parallel",
     "bench_serving_engine.py --cluster",
     "bench_serving_engine.py --multihost",
@@ -342,6 +356,26 @@ def test_benchmark_script_smoke(script, tmp_path):
         assert slo["failovers"] >= 1, slo
         assert slo["failover_requests"] >= 1, slo
         assert slo["rejected_noisy"] >= 1, slo
+    if script == "bench_serving_engine.py --control-plane":
+        clines = [l for l in r.stdout.splitlines()
+                  if l.startswith("CONTROL_PLANE ")]
+        assert clines, r.stdout
+        cp = json.loads(clines[-1][len("CONTROL_PLANE "):])
+        assert CONTROL_PLANE_KEYS <= set(cp), sorted(cp)
+        # ISSUE-20 acceptance bars, deterministic on the virtual-clock
+        # burst: brownout really engaged and shed the low tiers, the
+        # top tier was never shed and its p99 TTFT did not regress
+        # versus the unshed replay, and a shed is an audited rejection
+        # — never a lost request — under the conservation ledger
+        assert cp["completed_unshed"] == cp["requests"], cp
+        assert cp["sheds"] >= 1, cp
+        assert cp["tier0_sheds"] == 0, cp
+        assert cp["brownout_level_max"] >= 1, cp
+        assert cp["completed_shed"] + cp["sheds"] == cp["requests"], cp
+        assert cp["p99_ttft_steps_by_tier_shed"]["0"] \
+            <= cp["p99_ttft_steps_by_tier_unshed"]["0"], cp
+        assert cp["lost"] == 0 and cp["duplicates"] == 0, cp
+        assert cp["ledger_green"] is True, cp
     if script == "bench_serving_engine.py --cluster":
         clines = [l for l in r.stdout.splitlines()
                   if l.startswith("CLUSTER_SLO ")]

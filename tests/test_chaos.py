@@ -35,7 +35,8 @@ def test_sweep_covers_registered_fault_points():
     sweeps = {"serving": set(chaos.SERVING_SWEEP),
               "training": set(chaos.TRAINING_SWEEP),
               "frontdoor": set(chaos.FRONTDOOR_SWEEP),
-              "cluster": set(chaos.CLUSTER_SWEEP)}
+              "cluster": set(chaos.CLUSTER_SWEEP),
+              "control": set(chaos.CONTROL_SWEEP)}
     swept = set().union(*sweeps.values())
     assert swept == set(faults.KNOWN_POINTS)
     # coverage ownership is a partition (front-door episodes also
@@ -213,6 +214,10 @@ _serving_spec_tally = {"episodes": 0, "speculative": 0,
                        "spec_sampled": 0, "spec_tuned": 0,
                        "draft_kills": 0, "draft_faults": 0}
 
+# chunk-budget controller coverage, fed by BOTH serving matrices
+# (single-chip and TP — the controller rides on any chunked engine)
+_chunk_ctl_tally = {"bands": set(), "controlled": 0, "adaptations": 0}
+
 
 @pytest.mark.parametrize("seed", SERVING_SEEDS)
 def test_serving_episode_matrix(seed):
@@ -246,6 +251,10 @@ def test_serving_episode_matrix(seed):
         res.fired.get("serving.spec.draft", 0)
     _serving_spec_tally["draft_faults"] += \
         res.stats["spec_draft_faults"]
+    _chunk_ctl_tally["controlled"] += 1 if res.stats["chunk_ctl"] else 0
+    _chunk_ctl_tally["adaptations"] += res.stats["chunk_adaptations"]
+    if _serving_spec_tally["episodes"] == len(SERVING_SEEDS):
+        _chunk_ctl_tally["bands"].add("serving")
 
 
 def test_serving_matrix_actually_speculates():
@@ -366,6 +375,21 @@ def test_tp_serving_episode_matrix(seed):
     _tp_tally["wired"] += 1 if res.stats["kv_wired"] else 0
     _tp_tally["wire_handoffs"] += res.stats["wire_handoffs"]
     _tp_tally["wire_kills"] += res.fired.get("cluster.kv.wire", 0)
+    _chunk_ctl_tally["controlled"] += 1 if res.stats["chunk_ctl"] else 0
+    _chunk_ctl_tally["adaptations"] += res.stats["chunk_adaptations"]
+    if _tp_tally["episodes"] == len(TP_SERVING_SEEDS):
+        _chunk_ctl_tally["bands"].add("tp")
+
+
+def test_serving_matrices_actually_adapt_chunk_budget():
+    """ISSUE-20 coverage floor: the chunk-budget controller must stay
+    LOADED across the chunked serving episodes (both bands feed it) —
+    episodes that really run under the controller and budgets that
+    really move. Otherwise the adaptive-chunk soak is vacuous."""
+    if _chunk_ctl_tally["bands"] != {"serving", "tp"}:
+        pytest.skip("both serving matrices did not run in full")
+    assert _chunk_ctl_tally["controlled"] >= 3, _chunk_ctl_tally
+    assert _chunk_ctl_tally["adaptations"] >= 3, _chunk_ctl_tally
 
 
 def test_tp_matrix_actually_kills_handoffs_and_sharded_decodes():
@@ -402,7 +426,10 @@ def test_tp_matrix_actually_ships_kv_over_the_wire():
 
 
 _frontdoor_death_tally = {"episodes": 0, "deaths": 0,
-                          "failover_requests": 0}
+                          "failover_requests": 0,
+                          "control": 0, "sheds": 0, "tier0_sheds": 0,
+                          "affinity_hits": 0, "scale_actions": 0,
+                          "control_arms": 0}
 
 
 @pytest.mark.parametrize("seed", FRONTDOOR_SEEDS)
@@ -415,6 +442,42 @@ def test_frontdoor_episode_matrix(seed):
         1 if res.stats["replica_deaths"] else 0
     _frontdoor_death_tally["failover_requests"] += \
         res.stats["failover_requests"]
+    _frontdoor_death_tally["control"] += \
+        1 if res.stats["control_on"] else 0
+    _frontdoor_death_tally["sheds"] += res.stats["sheds"]
+    _frontdoor_death_tally["tier0_sheds"] += \
+        res.stats["sheds_by_tier"].get(0, 0)
+    _frontdoor_death_tally["affinity_hits"] += \
+        res.stats["affinity_hits"]
+    _frontdoor_death_tally["scale_actions"] += \
+        res.stats["scale_actions"]
+    _frontdoor_death_tally["control_arms"] += sum(
+        res.fired.get(p, 0) for p in ("control.shed",
+                                      "control.affinity",
+                                      "control.scale"))
+
+
+def test_frontdoor_matrix_actually_controls():
+    """ISSUE-20 coverage floors: the control arms must stay LOADED —
+    across the band the brownout must actually shed (never tier 0),
+    prefix affinity must actually route warm, the autoscaler must
+    actually act, and the control.* actuator faults must actually
+    fire. Otherwise the self-driving soak goes green by vacuity (the
+    per-episode graceful-degradation law lives inside the episode)."""
+    if _frontdoor_death_tally["episodes"] < len(FRONTDOOR_SEEDS):
+        pytest.skip("full front-door matrix did not run")
+    assert _frontdoor_death_tally["control"] >= 8, \
+        _frontdoor_death_tally
+    assert _frontdoor_death_tally["sheds"] >= 3, \
+        _frontdoor_death_tally
+    assert _frontdoor_death_tally["tier0_sheds"] == 0, \
+        _frontdoor_death_tally
+    assert _frontdoor_death_tally["affinity_hits"] >= 3, \
+        _frontdoor_death_tally
+    assert _frontdoor_death_tally["scale_actions"] >= 2, \
+        _frontdoor_death_tally
+    assert _frontdoor_death_tally["control_arms"] >= 2, \
+        _frontdoor_death_tally
 
 
 def test_frontdoor_matrix_actually_kills_replicas():
@@ -681,6 +744,34 @@ def test_pinned_seed_catches_swallowed_chunk_fault(monkeypatch):
     assert green.ok, "\n".join(green.violations)
     assert green.stats["prefill_chunk"] == 8
     assert green.fired.get("serving.prefill.chunk", 0) >= 1
+
+
+PINNED_SEED_SHED = 321   # control-on overload: the brownout sheds
+
+
+def test_pinned_seed_unaudited_shed_goes_lost(monkeypatch):
+    """ISSUE-20 pinned red seed: a shed request that skips its audited
+    rejection (the client still gets the typed ``Shed``, but the
+    ledger never hears about it) must trip the admission law as LOST
+    — brownout is load SHEDDING, never load losing. The real path
+    (every shed flows through ``_reject`` -> ``on_rejected``) stays
+    green on the same seed, and really sheds."""
+    from paddle_tpu.serving.frontdoor import FrontDoor
+    orig = FrontDoor._reject
+
+    def silent_shed(self, tenant, reason, tier=0):
+        if reason == "shed":
+            return       # pre-fix semantics: refusal without audit
+        orig(self, tenant, reason, tier)
+
+    monkeypatch.setattr(FrontDoor, "_reject", silent_shed)
+    red = chaos.run_frontdoor_episode(PINNED_SEED_SHED)
+    assert not red.ok
+    assert any("LOST" in v for v in red.violations), red.violations
+    monkeypatch.setattr(FrontDoor, "_reject", orig)
+    green = chaos.run_frontdoor_episode(PINNED_SEED_SHED)
+    assert green.ok, "\n".join(green.violations)
+    assert green.stats["sheds"] >= 1
 
 
 PINNED_SEED_NO_FAILOVER = 306   # replica death with requests aboard

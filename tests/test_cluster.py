@@ -519,3 +519,50 @@ def test_dropped_scrape_is_detected_not_truncated(cluster):
     req2 = router.submit(_prompts(rng, [5])[0], 2)
     _drive(cluster, router)
     assert req2.finish_reason == "length"
+
+
+# -- control-plane scaling machinery (ISSUE 20) ------------------------
+
+def test_cluster_scale_up_then_down(ref_model):
+    """The autoscaler's cluster seams: ``scale_up`` spawns a real
+    worker process and registers it with the RUNNING router as a
+    first-class replica (token-identical service through it),
+    ``scale_down`` drains one and shuts its process down — and never
+    drains the last dispatchable worker. A private 1-worker pool: the
+    module's warm fixture must not lose workers to this test."""
+    sup = ClusterSupervisor(SPEC, n_workers=1, max_respawns=2,
+                            registry=MetricRegistry(),
+                            flight_recorder=FlightRecorder(capacity=16),
+                            dump_on_death=False,
+                            telemetry=ClusterTelemetry(),
+                            scrape_interval=1)
+    sup.start()
+    try:
+        router = sup.router
+        assert sup.scale_down() is None      # never the last worker
+        rep = sup.scale_up()
+        assert rep.dispatchable
+        assert sum(1 for r in router.replicas
+                   if r.dispatchable) == 2
+        rng = np.random.RandomState(5)
+        prompts = _prompts(rng, [5, 9, 7])
+        reqs = [router.submit(p, 5) for p in prompts]
+        _drive(sup, router)
+        eng = ServingEngine(ref_model, registry=MetricRegistry(),
+                            **ENGINE_KW)
+        refs = [eng.submit(p, 5) for p in prompts]
+        eng.run()
+        for req, ref in zip(reqs, refs):
+            assert req.output_ids == ref.output_ids
+            assert req.finish_reason == ref.finish_reason
+        rid = sup.scale_down()
+        assert rid == rep.id
+        assert sum(1 for r in router.replicas
+                   if r.dispatchable) == 1
+        # the shrunk pool still serves
+        reqs2 = [router.submit(p, 3) for p in prompts[:2]]
+        _drive(sup, router)
+        for req in reqs2:
+            assert req.finish_reason == "length"
+    finally:
+        sup.shutdown()

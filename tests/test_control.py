@@ -1,0 +1,512 @@
+"""Self-driving control plane (serving/control.py): the SpecTuner
+contract each controller inherits — no RNG, no clock, a hysteresis
+dead band the constructors enforce, a dwell/cool-down gate, and
+rate-limited fault-contained actuation — plus the seams: the typed
+audited ``Shed`` at the front door, the adaptive chunk budget on a
+chunked engine (token identity preserved, the compiled chunk program
+untouched), the pure read-only prefix probe behind affinity routing,
+and ``ControlPlane.maybe_scale`` driving a real router. The chaos
+band certifies the same laws under fault weather in test_chaos.py;
+the cross-process scale machinery lives in test_cluster.py."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.llama import LlamaForCausalLM, llama_tiny_config
+from paddle_tpu.observability import FlightRecorder, MetricRegistry
+from paddle_tpu.resilience import faults
+from paddle_tpu.resilience.invariants import ConservationLedger
+from paddle_tpu.serving import (Actuator, BrownoutController,
+                                ChunkBudgetController, ControlPlane,
+                                FrontDoor, PrefixAffinityPolicy,
+                                ReplicaAutoscaler, ReplicaRouter,
+                                ServingEngine, Shed, TenantPolicy)
+
+
+def _tiny_llama(**kw):
+    paddle.seed(0)
+    kw.setdefault("max_position_embeddings", 64)
+    model = LlamaForCausalLM(llama_tiny_config(
+        num_hidden_layers=1, hidden_size=32, intermediate_size=64,
+        num_attention_heads=2, **kw))
+    model.eval()
+    return model
+
+
+def _engine(model, **kw):
+    kw.setdefault("max_slots", 2)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("min_bucket", 8)
+    kw.setdefault("page_size", 8)
+    kw.setdefault("registry", MetricRegistry())
+    kw.setdefault("flight_recorder", FlightRecorder(capacity=4))
+    return ServingEngine(model, **kw)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    faults.reset_counts()
+    yield
+    faults.clear()
+
+
+def _prompts(rng, lens, vocab=96):
+    return [rng.randint(1, vocab, (n,)).astype(np.int64) for n in lens]
+
+
+# -- actuator: rate limit + fault containment --------------------------
+
+def test_actuator_validates_inputs():
+    with pytest.raises(ValueError):
+        Actuator(window=0, registry=MetricRegistry())
+    with pytest.raises(ValueError):
+        Actuator(budgets={"warp": 1}, registry=MetricRegistry())
+    with pytest.raises(ValueError):
+        Actuator(budgets={"shed": -1}, registry=MetricRegistry())
+    act = Actuator(registry=MetricRegistry())
+    with pytest.raises(ValueError):
+        act.allow("warp")
+
+
+def test_actuator_window_budget_resets():
+    act = Actuator(window=4, budgets={"scale": 1},
+                   registry=MetricRegistry())
+    assert act.allow("scale")
+    assert not act.allow("scale")        # budget spent this window
+    assert act.suppressed["scale"] == 1
+    for _ in range(4):
+        act.on_step()                    # next window
+    assert act.allow("scale")
+    assert act.applied["scale"] == 2
+
+
+def test_actuator_contains_injected_faults():
+    act = Actuator(registry=MetricRegistry())
+    faults.inject("control.shed", times=1)
+    assert not act.allow("shed", tenant="t", tier=2)
+    assert act.faulted["shed"] == 1      # contained, counted
+    assert act.suppressed["shed"] == 1
+    assert act.allow("shed", tenant="t", tier=2)   # healed next call
+    assert faults.fired().get("control.shed") == 1
+
+
+# -- brownout: dead band, dwell, tier monotonicity ---------------------
+
+def test_brownout_rejects_degenerate_dead_bands():
+    reg = MetricRegistry()
+    with pytest.raises(ValueError):
+        BrownoutController(enter_depth=4.0, exit_depth=4.0,
+                           registry=reg)
+    with pytest.raises(ValueError):
+        BrownoutController(enter_burn=2.0, exit_burn=3.0,
+                           registry=reg)
+    with pytest.raises(ValueError):
+        BrownoutController(tiers=1, registry=reg)
+    with pytest.raises(ValueError):
+        BrownoutController(dwell=0, registry=reg)
+    with pytest.raises(ValueError):
+        BrownoutController(alpha=0.0, registry=reg)
+
+
+def test_brownout_dwell_and_hysteresis():
+    b = BrownoutController(tiers=3, enter_depth=4.0, exit_depth=1.0,
+                           enter_burn=50.0, exit_burn=1.0,
+                           alpha=1.0, dwell=3,
+                           registry=MetricRegistry())
+    for _ in range(2):
+        b.on_step(depth=10.0)
+    assert b.level == 0                  # dwell still holds
+    b.on_step(depth=10.0)
+    assert b.level == 1 and b.flips == 1
+    for _ in range(3):
+        b.on_step(depth=10.0)
+    assert b.level == 2                  # capped at tiers - 1
+    for _ in range(6):
+        b.on_step(depth=10.0)
+    assert b.level == 2
+    # dead band: depth between exit (1) and enter (4) changes nothing
+    for _ in range(6):
+        b.on_step(depth=2.0)
+    assert b.level == 2
+    # cool signal lowers one level per dwell
+    for _ in range(3):
+        b.on_step(depth=0.0)
+    assert b.level == 1
+    for _ in range(3):
+        b.on_step(depth=0.0)
+    assert b.level == 0
+    assert b.flips == 4
+
+
+def test_brownout_burn_signal_alone_raises():
+    b = BrownoutController(tiers=2, enter_depth=100.0, exit_depth=1.0,
+                           enter_burn=6.0, exit_burn=1.0,
+                           alpha=1.0, dwell=1,
+                           registry=MetricRegistry())
+    b.on_step(depth=0.0, burn=10.0)      # TTFT burning, queue fine
+    assert b.level == 1
+
+
+def test_brownout_shed_order_protects_tier0():
+    b = BrownoutController(tiers=3, enter_depth=4.0, exit_depth=1.0,
+                           alpha=1.0, dwell=1, retry_hint_s=0.05,
+                           registry=MetricRegistry())
+    assert not b.should_shed(2)          # level 0: nobody shed
+    b.on_step(depth=10.0)
+    assert b.level == 1
+    assert b.should_shed(2) and not b.should_shed(1) \
+        and not b.should_shed(0)
+    b.on_step(depth=10.0)
+    assert b.level == 2
+    assert b.should_shed(2) and b.should_shed(1) \
+        and not b.should_shed(0)         # tier 0: never
+    assert b.retry_after_s() == pytest.approx(0.10)
+    assert b.maybe_shed(2, tenant="lo")
+    assert b.sheds_by_tier == {2: 1}
+
+
+def test_brownout_fails_open_on_denied_or_faulted_actuator():
+    reg = MetricRegistry()
+    act = Actuator(budgets={"shed": 0}, registry=reg)
+    b = BrownoutController(tiers=2, enter_depth=1.0, exit_depth=0.5,
+                           alpha=1.0, dwell=1, actuator=act,
+                           registry=reg)
+    b.on_step(depth=5.0)
+    assert b.should_shed(1)
+    assert not b.maybe_shed(1)           # budget 0: admit, don't shed
+    assert b.sheds == 0
+    act.budgets["shed"] = 8
+    faults.inject("control.shed", times=1)
+    assert not b.maybe_shed(1)           # faulted actuator: fail open
+    assert b.sheds == 0 and act.faulted["shed"] == 1
+    assert b.maybe_shed(1)               # healed: the shed applies
+    assert b.sheds == 1
+
+
+# -- chunk budget: dead band, dwell, stall brake, fail static ----------
+
+def test_chunk_budget_rejects_degenerate_configs():
+    reg = MetricRegistry()
+    with pytest.raises(ValueError):
+        ChunkBudgetController(raise_depth=2.0, lower_depth=2.0,
+                              registry=reg)
+    with pytest.raises(ValueError):
+        ChunkBudgetController(mults=(0, 1, 2), registry=reg)
+    with pytest.raises(ValueError):
+        ChunkBudgetController(mults=(1, 1, 2), registry=reg)
+    with pytest.raises(ValueError):
+        ChunkBudgetController(mults=(4, 2, 1), registry=reg)
+    with pytest.raises(ValueError):
+        ChunkBudgetController(dwell=0, registry=reg)
+
+
+def test_chunk_budget_raises_lowers_and_brakes():
+    c = ChunkBudgetController(raise_depth=4.0, lower_depth=1.0,
+                              stall_brake=8.0, alpha=1.0, dwell=2,
+                              mults=(1, 2, 4),
+                              registry=MetricRegistry())
+    assert c.step_budget(8, depth=10.0) == 8     # dwell holds step 1
+    assert c.step_budget(8, depth=10.0) == 16    # raise to x2
+    assert c.step_budget(8, depth=10.0) == 16    # dwell holds
+    assert c.step_budget(8, depth=10.0) == 32    # raise to x4
+    # the stall brake outranks a deep queue: active decodes pay for
+    # every extra chunk, so heavy decode population pulls DOWN
+    c.step_budget(8, depth=10.0, stall=20.0)
+    assert c.step_budget(8, depth=10.0, stall=20.0) == 16
+    # dead band: depth between lower (1) and raise (4) holds
+    for _ in range(4):
+        assert c.step_budget(8, depth=2.0) == 16
+    assert c.step_budget(8, depth=0.0) == 8      # idle: back to x1
+    assert c.adaptations == c.flips == 4
+
+
+def test_chunk_budget_fails_static_on_faulted_actuator():
+    reg = MetricRegistry()
+    act = Actuator(registry=reg)
+    c = ChunkBudgetController(raise_depth=2.0, lower_depth=0.5,
+                              alpha=1.0, dwell=1, actuator=act,
+                              registry=reg)
+    faults.inject("control.chunk", times=1)
+    assert c.step_budget(8, depth=10.0) == 8     # fault: keep budget
+    assert c.adaptations == 0 and act.faulted["chunk"] == 1
+    assert c.step_budget(8, depth=10.0) == 16    # healed: retried
+    assert c.adaptations == 1
+
+
+# -- autoscaler: cool-down burns on commit, bounds hold ----------------
+
+def test_autoscaler_rejects_degenerate_configs():
+    reg = MetricRegistry()
+    with pytest.raises(ValueError):
+        ReplicaAutoscaler(min_replicas=0, registry=reg)
+    with pytest.raises(ValueError):
+        ReplicaAutoscaler(min_replicas=3, max_replicas=2, registry=reg)
+    with pytest.raises(ValueError):
+        ReplicaAutoscaler(up_pressure=1.0, down_pressure=1.0,
+                          registry=reg)
+    with pytest.raises(ValueError):
+        ReplicaAutoscaler(cooldown=0, registry=reg)
+
+
+def test_autoscaler_cooldown_burns_only_on_commit():
+    asc = ReplicaAutoscaler(min_replicas=1, max_replicas=3,
+                            up_pressure=2.0, down_pressure=0.5,
+                            alpha=1.0, cooldown=4,
+                            registry=MetricRegistry())
+    assert asc.decide(depth=10.0, replicas=2) == "up"
+    # an uncommitted proposal (suppressed / faulted actuation) does
+    # NOT consume the cool-down: the proposal simply retries
+    assert asc.decide(depth=10.0, replicas=2) == "up"
+    asc.commit("up")
+    for _ in range(3):
+        assert asc.decide(depth=10.0, replicas=3) is None  # cooling
+    # cooled down; at max_replicas "up" is out, idle proposes "down"
+    assert asc.decide(depth=10.0, replicas=3) is None
+    assert asc.decide(depth=0.0, replicas=3) == "down"
+    asc.commit("down")
+    assert asc.actions == 2
+    assert asc.actions_by_dir == {"up": 1, "down": 1}
+    with pytest.raises(ValueError):
+        asc.commit("sideways")
+
+
+def test_autoscaler_respects_min_and_max():
+    asc = ReplicaAutoscaler(min_replicas=2, max_replicas=2,
+                            up_pressure=2.0, down_pressure=0.5,
+                            alpha=1.0, cooldown=1,
+                            registry=MetricRegistry())
+    assert asc.decide(depth=50.0, replicas=2) is None   # at max
+    assert asc.decide(depth=0.0, replicas=2) is None    # at min
+
+
+# -- determinism: same metric stream, bitwise-identical actions --------
+
+def _drive_controllers(stream):
+    reg = MetricRegistry()
+    b = BrownoutController(tiers=3, enter_depth=4.0, exit_depth=1.0,
+                           dwell=2, registry=reg)
+    c = ChunkBudgetController(raise_depth=4.0, lower_depth=1.0,
+                              dwell=2, registry=reg)
+    a = ReplicaAutoscaler(min_replicas=1, max_replicas=4,
+                          up_pressure=2.0, down_pressure=0.5,
+                          cooldown=3, registry=reg)
+    trace = []
+    replicas = 2
+    for depth, burn, stall in stream:
+        b.on_step(depth, burn)
+        budget = c.step_budget(8, depth, stall=stall)
+        d = a.decide(depth, replicas, burn)
+        if d is not None:
+            a.commit(d)
+            replicas += 1 if d == "up" else -1
+        trace.append((b.level, b.should_shed(2), budget, d))
+    return trace, (b.snapshot(), c.snapshot(), a.snapshot())
+
+
+def test_controllers_are_deterministic_functions_of_the_stream():
+    """ISSUE-20 determinism law: controllers carry no RNG and no
+    clock, so the same observed metric stream must produce a bitwise
+    identical action sequence — the property that makes a control
+    decision replayable from a flight recording."""
+    rng = np.random.RandomState(42)
+    stream = [(float(rng.randint(0, 12)), float(rng.rand() * 8),
+               float(rng.randint(0, 10))) for _ in range(200)]
+    t1, s1 = _drive_controllers(stream)
+    t2, s2 = _drive_controllers(stream)
+    assert t1 == t2
+    assert s1 == s2
+
+
+# -- prefix probe: pure, read-only, and the affinity router ------------
+
+def test_probe_prefix_is_pure_and_counts_warm_tokens():
+    model = _tiny_llama()
+    eng = _engine(model)
+    rng = np.random.RandomState(3)
+    prompt = rng.randint(1, 96, (17,)).astype(np.int64)
+    assert eng.cache.probe_prefix(prompt) == 0       # cold pool
+    eng.submit(prompt, 4)
+    eng.run()
+    warm = eng.cache.probe_prefix(prompt)
+    assert warm >= 8                                 # full pages warm
+    tick = eng.cache._lru_tick
+    for _ in range(5):
+        assert eng.cache.probe_prefix(prompt) == warm
+    # purity: probing never touches the LRU clock (a router probing
+    # every replica per dispatch must not perturb eviction order)
+    assert eng.cache._lru_tick == tick
+    assert eng.cache.probe_prefix(prompt[:1]) == 0   # too short
+
+
+def test_affinity_routes_to_the_warm_replica():
+    model = _tiny_llama()
+    engines = [_engine(model), _engine(model)]
+    reg = MetricRegistry()
+    pol = PrefixAffinityPolicy(min_tokens=8, registry=reg)
+    router = ReplicaRouter(engines, registry=MetricRegistry(),
+                           affinity=pol)
+    rng = np.random.RandomState(4)
+    prompt_a = rng.randint(1, 96, (17,)).astype(np.int64)
+    prompt_b = rng.randint(1, 96, (17,)).astype(np.int64)
+    # a -> replica 0 (id tie-break), b -> replica 1 (a loaded 0)
+    router.submit(prompt_a, 3)
+    r1 = router.submit(prompt_b, 3)
+    warm = router._owner[r1.rid]       # popped at delivery: read now
+    assert pol.hits == 0               # cold pool: nothing warm yet
+    while router.has_work():
+        router.step()
+    # b's radix prefix again: both replicas are idle, so the fallback
+    # is replica 0 — the warm prefix must OVERRIDE the load pick
+    r2 = router.submit(prompt_b, 3)
+    assert router._owner[r2.rid] == warm != "0"
+    assert pol.hits == 1
+    while router.has_work():
+        router.step()
+    # a prompt warm only on the fallback itself routes there anyway
+    # and counts as a miss: affinity didn't change the decision
+    router.submit(prompt_a, 2)
+    assert pol.hits == 1 and pol.misses >= 1
+    while router.has_work():
+        router.step()
+
+
+def test_affinity_falls_back_on_faulted_actuator():
+    model = _tiny_llama()
+    engines = [_engine(model), _engine(model)]
+    reg = MetricRegistry()
+    pol = PrefixAffinityPolicy(min_tokens=8,
+                               actuator=Actuator(registry=reg),
+                               registry=reg)
+    router = ReplicaRouter(engines, registry=MetricRegistry(),
+                           affinity=pol)
+    rng = np.random.RandomState(5)
+    prompt_a = rng.randint(1, 96, (17,)).astype(np.int64)
+    prompt_b = rng.randint(1, 96, (17,)).astype(np.int64)
+    router.submit(prompt_a, 3)           # warms replica 0
+    router.submit(prompt_b, 3)           # warms replica 1
+    while router.has_work():
+        router.step()
+    misses = pol.misses
+    faults.inject("control.affinity", times=1)
+    r = router.submit(prompt_b, 3)       # fault: least-loaded fallback
+    assert router._owner[r.rid] == "0"
+    assert pol.hits == 0 and pol.misses == misses + 1
+    assert pol.actuator.faulted["affinity"] == 1
+    while router.has_work():
+        router.step()
+
+
+# -- the front-door seam: typed, audited Shed --------------------------
+
+def test_frontdoor_shed_is_typed_audited_and_labelled():
+    model = _tiny_llama()
+    eng = _engine(model, max_slots=1)
+    ledger = ConservationLedger()
+    reg = MetricRegistry()
+    control = ControlPlane(
+        brownout=BrownoutController(tiers=3, enter_depth=1.0,
+                                    exit_depth=0.5, alpha=1.0,
+                                    dwell=1, retry_hint_s=0.05,
+                                    registry=reg),
+        registry=reg)
+    front = FrontDoor(eng, auditor=ledger, registry=MetricRegistry(),
+                      tenants={"vip": TenantPolicy(priority=0),
+                               "free": TenantPolicy(priority=2)},
+                      control=control)
+    rng = np.random.RandomState(6)
+    prompts = _prompts(rng, [9, 11, 13, 7])
+    h1 = front.submit(prompts[0], 4, tenant="vip")
+    assert h1.req.priority == 0          # tier stamped on the request
+    front.submit(prompts[1], 4, tenant="free")
+    front.pump()                         # depth 2 >= enter: level 1
+    assert control.brownout.level >= 1
+    with pytest.raises(Shed) as ei:
+        front.submit(prompts[2], 4, tenant="free")
+    assert ei.value.tier == 2
+    assert ei.value.retry_after_s == pytest.approx(0.05)
+    front.submit(prompts[3], 4, tenant="vip")    # tier 0 still served
+    front.drain()
+    assert ledger.violations() == []     # the shed was audited
+    m = front._m_reject.labels(reason="shed", tier="2")
+    assert m.value == 1
+
+
+# -- the engine seam: adaptive budget, token identity ------------------
+
+def test_chunk_controlled_engine_is_token_identical_and_adapts():
+    model = _tiny_llama()
+    rng = np.random.RandomState(7)
+    prompts = _prompts(rng, [19, 23, 17, 21, 18, 20])
+    ref = _engine(model, max_slots=2, prefill_chunk=8)
+    refs = [ref.submit(p, 4) for p in prompts]
+    ref.run()
+    ctl = ChunkBudgetController(raise_depth=2.0, lower_depth=0.5,
+                                alpha=1.0, dwell=1,
+                                registry=MetricRegistry())
+    eng = _engine(model, max_slots=2, prefill_chunk=8,
+                  chunk_control=ctl)
+    reqs = [eng.submit(p, 4) for p in prompts]
+    eng.run()
+    for req, r0 in zip(reqs, refs):
+        assert req.output_ids == r0.output_ids
+        assert req.finish_reason == r0.finish_reason
+    assert ctl.adaptations >= 1          # the budget really moved
+
+
+def test_chunk_control_requires_chunked_prefill():
+    model = _tiny_llama()
+    ctl = ChunkBudgetController(registry=MetricRegistry())
+    with pytest.raises(ValueError):
+        _engine(model, chunk_control=ctl)
+
+
+# -- the router seam: maybe_scale drives add/drain ---------------------
+
+def test_controlplane_scales_router_up_and_down():
+    model = _tiny_llama()
+    reg = MetricRegistry()
+
+    def spawn():
+        return _engine(model)
+
+    control = ControlPlane(
+        autoscaler=ReplicaAutoscaler(min_replicas=1, max_replicas=2,
+                                     up_pressure=1.0,
+                                     down_pressure=0.5, alpha=1.0,
+                                     cooldown=1, registry=reg),
+        actuator=Actuator(window=1, registry=reg),
+        spawn_engine=spawn, registry=reg)
+    router = ReplicaRouter([_engine(model)],
+                           registry=MetricRegistry())
+    control.on_step(depth=8.0)
+    assert control.maybe_scale(router) == "up"
+    disp = [r for r in router.replicas if r.dispatchable]
+    assert len(disp) == 2 and disp[-1].id == "scale0"
+    control.on_step(depth=0.0)        # fresh window: budget restored
+    assert control.maybe_scale(router) == "down"
+    disp = [r for r in router.replicas if r.dispatchable]
+    assert len(disp) == 1                # the spawned one was drained
+    assert control.autoscaler.actions_by_dir == {"up": 1, "down": 1}
+
+
+def test_controlplane_scale_suppressed_by_faulted_actuator():
+    model = _tiny_llama()
+    reg = MetricRegistry()
+    control = ControlPlane(
+        autoscaler=ReplicaAutoscaler(min_replicas=1, max_replicas=2,
+                                     up_pressure=1.0,
+                                     down_pressure=0.5, alpha=1.0,
+                                     cooldown=1, registry=reg),
+        actuator=Actuator(registry=reg),
+        spawn_engine=lambda: _engine(model), registry=reg)
+    router = ReplicaRouter([_engine(model)],
+                           registry=MetricRegistry())
+    control.on_step(depth=8.0)
+    faults.inject("control.scale", times=1)
+    assert control.maybe_scale(router) is None   # fail static
+    assert len(router.replicas) == 1
+    # the uncommitted proposal did not burn the cool-down: it retries
+    control.on_step(depth=8.0)
+    assert control.maybe_scale(router) == "up"
+    assert len(router.replicas) == 2

@@ -6,6 +6,7 @@ composition across replicas, and the stdlib HTTP/SSE binding over a
 real socket."""
 import json
 import threading
+import urllib.error
 import urllib.request
 
 import numpy as np
@@ -18,7 +19,8 @@ from paddle_tpu.resilience import faults
 from paddle_tpu.resilience.invariants import (
     ConservationLedger, frontdoor_leak_violations,
     page_leak_violations, router_leak_violations)
-from paddle_tpu.serving import (ClientStream, FrontDoor,
+from paddle_tpu.serving import (BrownoutController, ClientStream,
+                                ControlPlane, FrontDoor,
                                 FrontDoorHTTPServer, RateLimited,
                                 ReplicaRouter, ServingEngine,
                                 TenantPolicy, TenantQueueFull,
@@ -90,9 +92,9 @@ def test_tenant_rate_limit_and_inflight_cap():
     # an unlimited tenant is untouched by the noisy one (isolation)
     front.submit(p, 2, tenant="other")
     c = reg.counter("ptpu_frontdoor_rejected_total",
-                    labels=("reason",))
-    assert c.labels(reason="rate_limited").value == 1
-    assert c.labels(reason="tenant_queue_full").value == 1
+                    labels=("reason", "tier"))
+    assert c.labels(reason="rate_limited", tier="0").value == 1
+    assert c.labels(reason="tenant_queue_full", tier="0").value == 1
     front.run_until_idle()
     assert frontdoor_leak_violations(front) == []
 
@@ -461,6 +463,88 @@ def test_http_client_disconnect_cancels_request(http_front):
     assert len(handle.req.out_tokens) < 40  # cancelled early
     assert page_leak_violations(eng) == []
     assert frontdoor_leak_violations(front) == []
+
+
+def test_http_rejections_map_to_status_codes_with_retry_after():
+    """Regression, one per refusal reason: RateLimited and
+    TenantQueueFull map to 429, a brownout Shed maps to 503 carrying
+    the controller's deterministic retry hint and the shed tier, every
+    rejection sends an RFC 9110 integer Retry-After header, and the
+    ``{reason,tier}`` label split lands in the /metrics exposition."""
+    model = _tiny_llama()
+    eng = _engine(model, page_size=8)
+    reg = MetricRegistry()
+    control = ControlPlane(
+        brownout=BrownoutController(tiers=3, enter_depth=4.0,
+                                    exit_depth=1.0, dwell=1,
+                                    retry_hint_s=2.0, registry=reg),
+        registry=reg)
+    front = FrontDoor(
+        eng, registry=reg, control=control,
+        tenants={"rl": TenantPolicy(rate_qps=0.01, burst=1),
+                 "cap": TenantPolicy(max_inflight=0),
+                 "lo": TenantPolicy(priority=2)})
+    srv = FrontDoorHTTPServer(front, port=0).start()
+    try:
+        def post(tenant):
+            body = json.dumps({"prompt_ids": [1, 2, 3, 4],
+                               "max_new_tokens": 2,
+                               "tenant": tenant}).encode()
+            req = urllib.request.Request(
+                srv.url + "/v1/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            return urllib.request.urlopen(req, timeout=30)
+
+        # rate_limited -> 429: burst of 1 is spent by the first call
+        with post("rl") as resp:
+            assert json.loads(resp.read())["finish_reason"] == "length"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post("rl")
+        e = ei.value
+        assert e.code == 429
+        assert int(e.headers["Retry-After"]) >= 1
+        assert json.loads(e.read())["error"] == "RateLimited"
+
+        # tenant_queue_full -> 429 (a cap of zero is deterministic)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post("cap")
+        e = ei.value
+        assert e.code == 429
+        assert int(e.headers["Retry-After"]) >= 1
+        assert json.loads(e.read())["error"] == "TenantQueueFull"
+
+        # shed -> 503: force the brownout hot (dwell=1 lets each step
+        # raise a level), then freeze it so the background pump cannot
+        # decay the level before the POST lands
+        for _ in range(2):
+            control.on_step(100.0)
+        assert control.brownout.level == 2
+        control.brownout.dwell = 10 ** 9
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            post("lo")
+        e = ei.value
+        assert e.code == 503
+        shed_body = json.loads(e.read())
+        assert shed_body["error"] == "Shed"
+        assert shed_body["tier"] == 2
+        # retry_hint_s=2.0 at level 2 -> delta-seconds ceil(4.0) = 4
+        assert int(e.headers["Retry-After"]) == 4
+
+        # tier 0 is never shed, even at full brownout depth
+        with post("default") as resp:
+            assert resp.status == 200
+
+        with urllib.request.urlopen(srv.url + "/metrics",
+                                    timeout=10) as resp:
+            prom = resp.read().decode()
+        assert ('ptpu_frontdoor_rejected_total'
+                '{reason="rate_limited",tier="0"} 1') in prom
+        assert ('ptpu_frontdoor_rejected_total'
+                '{reason="tenant_queue_full",tier="0"} 1') in prom
+        assert ('ptpu_frontdoor_rejected_total'
+                '{reason="shed",tier="2"} 1') in prom
+    finally:
+        srv.shutdown()
 
 
 # -- locked handle lookup (ptpu-lint PTL201 regression) -----------------

@@ -676,7 +676,7 @@ def test_router_frontdoor_gauges_counters_and_spans(tmp_path):
     assert samples["ptpu_router_failover_requests_total"] >= 1
     assert samples['ptpu_frontdoor_tenant_depth{tenant="cap"}'] == 0
     assert samples['ptpu_frontdoor_rejected_total'
-                   '{reason="tenant_queue_full"}'] == 1
+                   '{reason="tenant_queue_full",tier="0"}'] == 1
     assert samples['ptpu_frontdoor_accepted_total{tenant="cap"}'] == 1
     assert "# TYPE ptpu_serving_step_seconds" in text  # same registry
 

@@ -376,6 +376,82 @@ def test_healthz_and_diagnose_readouts():
     assert "1 incident(s)" in txt and "decode-bound" in txt
 
 
+# -- control plane ------------------------------------------------------
+
+def test_control_snapshot_rides_to_json_and_diagnosis():
+    """attach_control: the control plane's snapshot appears under
+    ``control`` in ``to_json()`` and the doctor renders one control
+    line (brownout level + per-tier sheds, chunk multiplier, replica
+    count) from it."""
+    from paddle_tpu.serving import (BrownoutController,
+                                    ChunkBudgetController,
+                                    ControlPlane, ReplicaAutoscaler)
+    clock = {"t": 0.0}
+    reg = MetricRegistry()
+    cp = ControlPlane(
+        brownout=BrownoutController(tiers=3, enter_depth=4.0,
+                                    exit_depth=1.0, dwell=1,
+                                    registry=reg),
+        chunk=ChunkBudgetController(raise_depth=4.0, lower_depth=1.0,
+                                    dwell=1, registry=reg),
+        autoscaler=ReplicaAutoscaler(registry=reg),
+        registry=reg)
+    for _ in range(2):                       # hot -> level 2
+        cp.on_step(100.0)
+    assert cp.maybe_shed(2, tenant="lo")     # one tier-2 shed
+    wt = _wt(reg, clock).attach_control(cp)
+    wt.flush()
+    snap = wt.to_json()
+    ctl = snap["control"]
+    assert ctl["brownout"]["level"] == 2
+    assert ctl["brownout"]["sheds_by_tier"] == {2: 1}
+    assert ctl["chunk"]["mult"] == 1
+    assert "autoscale" in ctl and "actuator" in ctl
+    txt = render_diagnosis(snap)
+    assert "control: brownout L2 sheds t2:1" in txt
+    assert "chunk x1" in txt
+    assert "replicas 0 last-scale none" in txt
+
+
+def test_controller_flapping_detector_audits_the_dwell_gate():
+    """``controller_flapping`` pages when a controller reports more
+    transitions than its own dwell gate permits (ceiling =
+    step//dwell + 1) — and stays silent for a healthy control plane,
+    whose gates make over-ceiling transition counts unreachable."""
+    from paddle_tpu.serving import BrownoutController, ControlPlane
+
+    clock = {"t": 0.0}
+    reg = MetricRegistry()
+    healthy = ControlPlane(
+        brownout=BrownoutController(tiers=3, enter_depth=4.0,
+                                    exit_depth=1.0, dwell=2,
+                                    registry=reg),
+        registry=reg)
+    for i in range(50):                      # thrash the inputs hard
+        healthy.on_step(100.0 if i % 2 else 0.0)
+    wt = _wt(reg, clock).attach_control(healthy)
+    wt.flush()                               # prime
+    clock["t"] = 5.0
+    assert wt.flush() == []                  # dwell-gated: no page
+
+    class _Flappy:                           # a broken gate: 40 flips
+        def snapshot(self):                  # in 10 steps vs dwell 4
+            return {"brownout": {"step": 10, "flips": 40,
+                                 "dwell": 4}}
+
+    wt2 = _wt(reg, clock).attach_control(_Flappy())
+    wt2.flush()
+    clock["t"] = 10.0
+    incs = wt2.flush()
+    assert [i.kind for i in incs] == ["controller_flapping"]
+    inc = incs[0]
+    assert inc.phase == "queue"
+    assert inc.detail["controller"] == "brownout"
+    assert inc.detail["transitions"] == 40
+    assert inc.detail["ceiling"] == 10 // 4 + 1
+    assert "flapping" in inc.summary
+
+
 # -- hot-path contract --------------------------------------------------
 
 def test_hot_path_is_one_counter_and_poll_is_one_clock_read():

@@ -31,7 +31,8 @@ DOC_PATH = "docs/OBSERVABILITY.md"
 # the code→doc direction is scoped to the watchtower's own plane; the
 # wider package documents families in layer guides instead
 WATCHED_SUFFIXES = ("observability/watchtower.py",
-                    "serving/metrics.py")
+                    "serving/metrics.py",
+                    "serving/control.py")
 FACTORY_NAMES = {"counter", "gauge", "histogram"}
 _FAMILY_TOKEN = re.compile(r"`(ptpu_[a-z0-9_*]+)(?:\{[^}]*\})?`")
 
